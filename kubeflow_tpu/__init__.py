@@ -1,0 +1,32 @@
+"""kubeflow_tpu — a TPU-native ML platform.
+
+A ground-up rebuild of the capabilities of the Kubeflow v0.5 monorepo
+(reference: kubeflow/kubeflow), designed TPU-first:
+
+- ``api``         — typed object model: KfDef platform config, TPUJob/Notebook/
+                    Profile/PodDefault/StudyJob/KubebenchJob CRD types, and a
+                    lightweight Kubernetes object representation.
+- ``kfctl``       — the deployment CLI (init/generate/apply/delete/show) and its
+                    coordinator over platform drivers + the manifest engine.
+- ``manifests``   — the package registry: programmatic manifest builders replacing
+                    the reference's ksonnet prototypes (reference: kubeflow/ dir).
+- ``cluster``     — Kubernetes API abstraction + in-memory apiserver (the envtest
+                    analog used to test every controller without a cluster).
+- ``controllers`` — reconcilers: the TPUJob operator (gang-scheduled TPU slices),
+                    notebook, profile, admission webhook, application.
+- ``runtime``     — the in-pod JAX worker runtime: distributed bootstrap, mesh
+                    construction from slice topology, train-step engine, orbax
+                    checkpointing, metrics + profiler hooks.
+- ``parallel``    — parallelism as data: DP/TP/PP/SP(CP)/EP sharding specs lowered
+                    to jax.sharding over a Mesh, pipeline microbatching, ring
+                    collectives.
+- ``ops``         — Pallas TPU kernels (ring attention, flash attention, ...).
+- ``models``      — built-in workloads (ResNet-50 benchmark model, Transformer LM).
+- ``serving``     — TPU-backed model server + HTTP front (reference:
+                    components/k8s-model-server).
+- ``katib``       — hyperparameter search (suggestions + study controller).
+- ``kubebench``   — benchmark harness (configurator -> run -> reporter).
+- ``dashboard``   — central dashboard backend API.
+"""
+
+__version__ = "0.1.0"

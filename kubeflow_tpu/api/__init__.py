@@ -1,0 +1,1 @@
+"""Typed API surface: platform config (KfDef), CRD types, k8s object model."""

@@ -1,0 +1,202 @@
+"""KfDef — the platform deployment config (the app.yaml state file).
+
+Reference: bootstrap/pkg/apis/apps/kfdef/v1alpha1/application_types.go
+(KfDefSpec :24-41, AppConfig :124-131, KfDef :159-165, conditions :142-157)
+and the layered config system described in SURVEY.md §5: CLI flags → options →
+KfDef persisted as app.yaml → per-platform shipped defaults → per-component
+params.
+
+The TPU build keeps the same surface: a typed spec with platform, component
+list, per-component params, and status conditions; `kfctl` persists it to the
+app directory and every verb re-loads it (coordinator.LoadKfApp analog).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..utils import yamlio
+
+KFDEF_API_VERSION = "kfdef.tpu.kubeflow.org/v1alpha1"
+KFDEF_KIND = "KfDef"
+APP_FILE = "app.yaml"
+
+# Platforms, mirroring group.go:134-138 (gcp, minikube, dockerfordesktop) plus
+# the "existing cluster" driver that is this build's primary local path.
+PLATFORM_GCP = "gcp"
+PLATFORM_MINIKUBE = "minikube"
+PLATFORM_DOCKER_FOR_DESKTOP = "dockerfordesktop"
+PLATFORM_EXISTING = "existing"
+PLATFORM_NONE = "none"
+ALL_PLATFORMS = (PLATFORM_GCP, PLATFORM_MINIKUBE, PLATFORM_DOCKER_FOR_DESKTOP,
+                 PLATFORM_EXISTING, PLATFORM_NONE)
+
+# Resource enum, group.go:63-69.
+RESOURCE_ALL = "all"
+RESOURCE_K8S = "k8s"
+RESOURCE_PLATFORM = "platform"
+
+# Default component set: the TPU-platform analog of bootstrap/config/default.yaml:4-23.
+DEFAULT_COMPONENTS = [
+    "metacontroller",
+    "application",
+    "istio",
+    "tpu-job-operator",
+    "tf-job-operator",
+    "pytorch-operator",
+    "mpi-operator",
+    "jupyter-web-app",
+    "notebook-controller",
+    "profiles",
+    "admission-webhook",
+    "centraldashboard",
+    "katib",
+    "kubebench",
+    "tpu-serving",
+    "metric-collector",
+    "spartakus",
+]
+
+
+@dataclass
+class Condition:
+    type: str
+    status: str
+    reason: str = ""
+    message: str = ""
+    last_update_time: float = field(default_factory=time.time)
+
+
+@dataclass
+class KfDefSpec:
+    app_dir: str = ""
+    platform: str = PLATFORM_EXISTING
+    project: str = ""                      # cloud project (gcp)
+    zone: str = ""
+    namespace: str = "kubeflow"
+    use_basic_auth: bool = False
+    use_istio: bool = True
+    components: list[str] = field(default_factory=lambda: list(DEFAULT_COMPONENTS))
+    component_params: dict[str, dict[str, Any]] = field(default_factory=dict)
+    # TPU-specific platform defaults applied to every training component
+    default_tpu_topology: str = "v5e-8"
+    version: str = "0.1.0"
+    repo: str = ""                         # manifest repo override (builtin if empty)
+    delete_storage: bool = False
+
+    def params_for(self, component: str) -> dict[str, Any]:
+        return dict(self.component_params.get(component, {}))
+
+
+@dataclass
+class KfDef:
+    name: str
+    spec: KfDefSpec = field(default_factory=KfDefSpec)
+    conditions: list[Condition] = field(default_factory=list)
+    labels: dict[str, str] = field(default_factory=dict)
+
+    # -- (de)serialization --------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "apiVersion": KFDEF_API_VERSION,
+            "kind": KFDEF_KIND,
+            "metadata": {"name": self.name, "labels": self.labels,
+                         "namespace": self.spec.namespace},
+            "spec": {
+                "appDir": self.spec.app_dir,
+                "platform": self.spec.platform,
+                "project": self.spec.project,
+                "zone": self.spec.zone,
+                "namespace": self.spec.namespace,
+                "useBasicAuth": self.spec.use_basic_auth,
+                "useIstio": self.spec.use_istio,
+                "components": list(self.spec.components),
+                "componentParams": self.spec.component_params,
+                "defaultTpuTopology": self.spec.default_tpu_topology,
+                "version": self.spec.version,
+                "repo": self.spec.repo,
+                "deleteStorage": self.spec.delete_storage,
+            },
+            "status": {
+                "conditions": [
+                    {"type": c.type, "status": c.status, "reason": c.reason,
+                     "message": c.message, "lastUpdateTime": c.last_update_time}
+                    for c in self.conditions
+                ]
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "KfDef":
+        spec = d.get("spec", {}) or {}
+        kf = cls(
+            name=d.get("metadata", {}).get("name", "kubeflow"),
+            labels=d.get("metadata", {}).get("labels", {}) or {},
+            spec=KfDefSpec(
+                app_dir=spec.get("appDir", ""),
+                platform=spec.get("platform", PLATFORM_EXISTING),
+                project=spec.get("project", ""),
+                zone=spec.get("zone", ""),
+                namespace=spec.get("namespace", "kubeflow"),
+                use_basic_auth=bool(spec.get("useBasicAuth", False)),
+                use_istio=bool(spec.get("useIstio", True)),
+                components=list(spec.get("components") or DEFAULT_COMPONENTS),
+                component_params=spec.get("componentParams", {}) or {},
+                default_tpu_topology=spec.get("defaultTpuTopology", "v5e-8"),
+                version=spec.get("version", "0.1.0"),
+                repo=spec.get("repo", ""),
+                delete_storage=bool(spec.get("deleteStorage", False)),
+            ),
+        )
+        for c in d.get("status", {}).get("conditions", []) or []:
+            kf.conditions.append(Condition(
+                type=c.get("type", ""), status=c.get("status", ""),
+                reason=c.get("reason", ""), message=c.get("message", ""),
+                last_update_time=c.get("lastUpdateTime", time.time()),
+            ))
+        return kf
+
+    # -- app.yaml persistence (writeConfigFile / LoadKfApp analog) ----------
+
+    def save(self, app_dir: Optional[str] = None) -> str:
+        app_dir = app_dir or self.spec.app_dir
+        if not app_dir:
+            raise ValueError("KfDef.save: no app_dir set")
+        os.makedirs(app_dir, exist_ok=True)
+        path = os.path.join(app_dir, APP_FILE)
+        yamlio.dump_file(self.to_dict(), path)
+        return path
+
+    @classmethod
+    def load(cls, app_dir: str) -> "KfDef":
+        path = os.path.join(app_dir, APP_FILE)
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"{path} not found — run `kfctl init` first (LoadKfApp analog)"
+            )
+        kf = cls.from_dict(yamlio.load_file(path))
+        kf.spec.app_dir = app_dir
+        return kf
+
+    def set_condition(self, ctype: str, status: str, reason: str = "",
+                      message: str = "") -> None:
+        for c in self.conditions:
+            if c.type == ctype:
+                c.status, c.reason, c.message = status, reason, message
+                c.last_update_time = time.time()
+                return
+        self.conditions.append(Condition(ctype, status, reason, message))
+
+    def validate(self) -> None:
+        if self.spec.platform not in ALL_PLATFORMS:
+            raise ValueError(
+                f"unknown platform {self.spec.platform!r}; valid: {ALL_PLATFORMS}"
+            )
+        if self.spec.platform == PLATFORM_GCP and not self.spec.project:
+            raise ValueError("gcp platform requires --project")
+        from .topology import parse_topology
+        parse_topology(self.spec.default_tpu_topology)

@@ -1,0 +1,204 @@
+"""TPU slice topology model.
+
+The reference's cluster-topology contract is TF_CONFIG / MPI hostfiles /
+MASTER_ADDR rendered by the training operators (SURVEY.md §2.5, §3.2). On TPU
+the contract is two-level:
+
+- **ICI** (intra-slice): the physical chip mesh of one slice, over which XLA
+  compiles collectives. Described by a named topology ("v5e-32" = 4x8 chips).
+- **DCN** (inter-slice): data-parallel replication across slices, coordinated
+  by `jax.distributed` (coordinator address + process ids), the analog of the
+  TF_CONFIG cluster dict.
+
+This module is the single source of truth for what a topology name means:
+chip count, per-host chip count, the physical mesh, and how hosts map onto it.
+The TPUJob reconciler uses it to size the gang (hosts = pods) and to render
+the topology contract into worker env; the runtime uses it to build the
+jax.sharding.Mesh.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+# Accelerator generations we model. chips_per_host is the gang-sizing constant:
+# one K8s pod per TPU VM host.
+@dataclass(frozen=True)
+class TpuGeneration:
+    name: str                  # "v5e"
+    chips_per_host: int        # chips on one TPU VM (one pod in the gang)
+    cores_per_chip: int
+    hbm_gib_per_chip: int
+    supported_chip_counts: tuple[int, ...]  # valid slice sizes
+    default_2d: dict[int, tuple[int, int]] = field(default_factory=dict)
+
+
+GENERATIONS: dict[str, TpuGeneration] = {
+    "v4": TpuGeneration(
+        name="v4", chips_per_host=4, cores_per_chip=2, hbm_gib_per_chip=32,
+        supported_chip_counts=(8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096),
+    ),
+    "v5e": TpuGeneration(
+        name="v5e", chips_per_host=4, cores_per_chip=1, hbm_gib_per_chip=16,
+        supported_chip_counts=(1, 4, 8, 16, 32, 64, 128, 256),
+        default_2d={1: (1, 1), 4: (2, 2), 8: (2, 4), 16: (4, 4), 32: (4, 8),
+                    64: (8, 8), 128: (8, 16), 256: (16, 16)},
+    ),
+    "v5p": TpuGeneration(
+        name="v5p", chips_per_host=4, cores_per_chip=2, hbm_gib_per_chip=95,
+        supported_chip_counts=tuple(2 ** i for i in range(2, 14)),
+    ),
+    "v6e": TpuGeneration(
+        name="v6e", chips_per_host=4, cores_per_chip=1, hbm_gib_per_chip=32,
+        supported_chip_counts=(1, 4, 8, 16, 32, 64, 128, 256),
+        default_2d={1: (1, 1), 4: (2, 2), 8: (2, 4), 16: (4, 4), 32: (4, 8),
+                    64: (8, 8), 128: (8, 16), 256: (16, 16)},
+    ),
+}
+
+_TOPOLOGY_RE = re.compile(r"^(v\d+[a-z]*)-(\d+)$")
+
+
+@dataclass(frozen=True)
+class SliceTopology:
+    """A named, validated TPU slice: the atomic scheduling unit (the gang)."""
+
+    name: str                      # "v5e-32"
+    generation: TpuGeneration
+    num_chips: int
+    ici_mesh: tuple[int, ...]      # physical chip mesh, e.g. (4, 8)
+
+    @property
+    def num_hosts(self) -> int:
+        return max(1, self.num_chips // self.generation.chips_per_host)
+
+    @property
+    def chips_per_host(self) -> int:
+        return min(self.num_chips, self.generation.chips_per_host)
+
+    @property
+    def hbm_gib(self) -> int:
+        return self.num_chips * self.generation.hbm_gib_per_chip
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "generation": self.generation.name,
+            "numChips": self.num_chips,
+            "numHosts": self.num_hosts,
+            "chipsPerHost": self.chips_per_host,
+            "iciMesh": list(self.ici_mesh),
+        }
+
+
+def _near_square(n: int) -> tuple[int, int]:
+    a = int(math.isqrt(n))
+    while n % a:
+        a -= 1
+    return (a, n // a)
+
+
+def parse_topology(name: str) -> SliceTopology:
+    """Parse "v5e-32"-style topology names (the `oneOf{tpuTopology, replicas}`
+    API surface, SURVEY.md §2.6 — the TPU analog of MPIJob's `gpus`)."""
+    m = _TOPOLOGY_RE.match(name.strip().lower())
+    if not m:
+        raise ValueError(
+            f"invalid TPU topology {name!r}; expected <generation>-<chips>, e.g. v5e-32"
+        )
+    gen_name, chips_s = m.groups()
+    gen = GENERATIONS.get(gen_name)
+    if gen is None:
+        raise ValueError(
+            f"unknown TPU generation {gen_name!r}; known: {sorted(GENERATIONS)}"
+        )
+    chips = int(chips_s)
+    if chips not in gen.supported_chip_counts:
+        raise ValueError(
+            f"{gen_name} does not come in {chips}-chip slices; "
+            f"valid sizes: {gen.supported_chip_counts}"
+        )
+    ici = gen.default_2d.get(chips) or _near_square(chips)
+    return SliceTopology(name=f"{gen_name}-{chips}", generation=gen,
+                         num_chips=chips, ici_mesh=ici)
+
+
+@dataclass(frozen=True)
+class TopologyContract:
+    """What the operator renders into each worker pod — the TF_CONFIG analog.
+
+    Reference: tf-operator injects TF_CONFIG={"cluster":{...},"task":{...}}
+    (SURVEY.md §3.2); here the contract is the jax.distributed bootstrap tuple
+    plus the two-level mesh description.
+    """
+
+    coordinator_address: str       # "<job>-worker-0.<svc>.<ns>:8476"
+    num_processes: int             # hosts * num_slices
+    process_id: int
+    slice_topology: SliceTopology
+    num_slices: int = 1            # DCN-level data parallel replicas
+    slice_id: int = 0
+
+    ENV_COORDINATOR = "KFTPU_COORDINATOR_ADDRESS"
+    ENV_NUM_PROCESSES = "KFTPU_NUM_PROCESSES"
+    ENV_PROCESS_ID = "KFTPU_PROCESS_ID"
+    ENV_TOPOLOGY = "KFTPU_TOPOLOGY"
+    ENV_NUM_SLICES = "KFTPU_NUM_SLICES"
+    ENV_SLICE_ID = "KFTPU_SLICE_ID"
+
+    def to_env(self) -> dict[str, str]:
+        return {
+            self.ENV_COORDINATOR: self.coordinator_address,
+            self.ENV_NUM_PROCESSES: str(self.num_processes),
+            self.ENV_PROCESS_ID: str(self.process_id),
+            self.ENV_TOPOLOGY: self.slice_topology.name,
+            self.ENV_NUM_SLICES: str(self.num_slices),
+            self.ENV_SLICE_ID: str(self.slice_id),
+        }
+
+    @classmethod
+    def from_env(cls, env: dict[str, str]) -> "TopologyContract":
+        topo = parse_topology(env[cls.ENV_TOPOLOGY])
+        return cls(
+            coordinator_address=env[cls.ENV_COORDINATOR],
+            num_processes=int(env[cls.ENV_NUM_PROCESSES]),
+            process_id=int(env[cls.ENV_PROCESS_ID]),
+            slice_topology=topo,
+            num_slices=int(env.get(cls.ENV_NUM_SLICES, "1")),
+            slice_id=int(env.get(cls.ENV_SLICE_ID, "0")),
+        )
+
+
+def render_contracts(
+    job_name: str,
+    namespace: str,
+    topology: SliceTopology,
+    num_slices: int = 1,
+    port: int = 8476,
+    headless_service: Optional[str] = None,
+) -> list[TopologyContract]:
+    """One contract per worker pod, coordinator = slice 0 / host 0.
+
+    Pod DNS follows the headless-service convention the reference's operators
+    use for replica discovery (tf-operator creates one headless service per
+    replica; we use one per job with stable pod hostnames).
+    """
+    svc = headless_service or f"{job_name}-workers"
+    coord = f"{job_name}-worker-0-0.{svc}.{namespace}:{port}"
+    contracts = []
+    for s in range(num_slices):
+        for h in range(topology.num_hosts):
+            contracts.append(
+                TopologyContract(
+                    coordinator_address=coord,
+                    num_processes=num_slices * topology.num_hosts,
+                    process_id=s * topology.num_hosts + h,
+                    slice_topology=topology,
+                    num_slices=num_slices,
+                    slice_id=s,
+                )
+            )
+    return contracts

@@ -1,0 +1,341 @@
+"""Training-job CRD types: TPUJob plus TFJob/PyTorchJob/MPIJob compatibility.
+
+The north star (BASELINE.json) is that the training-job reconcilers gain a
+``TPU`` replica type: a replica spec that names a slice topology instead of a
+pod count, is gang-scheduled all-or-nothing, and gets the jax.distributed
+topology contract injected instead of TF_CONFIG / MASTER_ADDR / hostfiles.
+
+We therefore model ONE job shape with four API kinds:
+
+- ``TPUJob``     (tpu.kubeflow.org/v1alpha1) — the native kind.
+- ``TFJob``      (kubeflow.org/v1beta2)      — reference CRD
+                 (kubeflow/tf-training/tf-job-operator.libsonnet:52-95), with
+                 replica types Chief/Master/Worker/PS/Evaluator + TPU.
+- ``PyTorchJob`` (kubeflow.org/v1beta2)      — Master/Worker + TPU
+                 (kubeflow/pytorch-job/prototypes/pytorch-job.jsonnet:16-85).
+- ``MPIJob``     (kubeflow.org/v1alpha1)     — oneOf{gpus, replicas} becomes
+                 oneOf{tpuTopology, replicas}
+                 (kubeflow/mpi-job/mpi-operator.libsonnet:27-77; SURVEY §2.6).
+
+All four are reconciled by the same operator (controllers/tpujob.py); the only
+kind-specific behavior is replica-type vocabulary and legacy env rendering
+(TF_CONFIG for TFJob CPU replicas, MASTER_ADDR for PyTorchJob), so Katib and
+kubebench templates written against the reference kinds run unmodified.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from . import k8s
+from .topology import SliceTopology, parse_topology
+
+TPU_API_VERSION = "tpu.kubeflow.org/v1alpha1"
+KF_API_VERSION_V1BETA2 = "kubeflow.org/v1beta2"
+KF_API_VERSION_V1ALPHA1 = "kubeflow.org/v1alpha1"
+
+JOB_KINDS = ("TPUJob", "TFJob", "PyTorchJob", "MPIJob")
+
+# Replica-type vocabulary per kind. "TPU" is valid in every kind — that is the
+# whole point of the build. Validation constraints mirror the reference CRD
+# schemas (Chief/Master max 1: tf-job-operator.libsonnet:14-46).
+REPLICA_TYPES: dict[str, tuple[str, ...]] = {
+    "TPUJob": ("TPU", "Coordinator", "Evaluator"),
+    "TFJob": ("TPU", "Chief", "Master", "Worker", "PS", "Evaluator"),
+    "PyTorchJob": ("TPU", "Master", "Worker"),
+    "MPIJob": ("TPU", "Launcher", "Worker"),
+}
+_MAX_ONE = {"Chief", "Master", "Coordinator", "Launcher"}
+
+# Condition types, mirroring tf-operator's JobCondition vocabulary.
+COND_CREATED = "Created"
+COND_RUNNING = "Running"
+COND_RESTARTING = "Restarting"
+COND_SUCCEEDED = "Succeeded"
+COND_FAILED = "Failed"
+
+# Pod phases we consume (fake or real apiserver).
+POD_PENDING = "Pending"
+POD_RUNNING = "Running"
+POD_SUCCEEDED = "Succeeded"
+POD_FAILED = "Failed"
+
+CLEAN_POD_ALL = "All"
+CLEAN_POD_RUNNING = "Running"
+CLEAN_POD_NONE = "None"
+
+RESTART_POLICY_NEVER = "Never"
+RESTART_POLICY_ON_FAILURE = "OnFailure"
+# Gang restart: any worker failure restarts the whole slice (SURVEY §5
+# "failure detection": a dead worker kills the gang).
+RESTART_POLICY_GANG = "GangOnFailure"
+
+
+@dataclass
+class ReplicaSpec:
+    """One replica group. Either a pod-count replica (CPU roles) or a
+    topology replica (the TPU gang)."""
+
+    replica_type: str
+    replicas: int = 1
+    topology: Optional[SliceTopology] = None   # set iff replica_type == "TPU"
+    num_slices: int = 1
+    template: dict = field(default_factory=dict)  # corev1.PodTemplateSpec
+    restart_policy: str = RESTART_POLICY_GANG
+
+    @property
+    def is_tpu(self) -> bool:
+        return self.replica_type == "TPU"
+
+    @property
+    def pod_count(self) -> int:
+        """Pods this replica group schedules (TPU: one pod per host per slice)."""
+        if self.is_tpu and self.topology is not None:
+            return self.topology.num_hosts * self.num_slices
+        return self.replicas
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {"replicas": self.replicas,
+                             "restartPolicy": self.restart_policy,
+                             "template": self.template}
+        if self.is_tpu and self.topology is not None:
+            d["tpuTopology"] = self.topology.name
+            d["numSlices"] = self.num_slices
+            d.pop("replicas")
+        return d
+
+
+@dataclass
+class RunPolicy:
+    """Job-level execution policy (tf-operator RunPolicy analog)."""
+
+    clean_pod_policy: str = CLEAN_POD_RUNNING
+    backoff_limit: int = 3                      # gang restarts before Failed
+    active_deadline_seconds: Optional[int] = None
+    gang_scheduling: bool = True                # mandatory for TPU replicas
+    ttl_seconds_after_finished: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {
+            "cleanPodPolicy": self.clean_pod_policy,
+            "backoffLimit": self.backoff_limit,
+            "gangScheduling": self.gang_scheduling,
+        }
+        if self.active_deadline_seconds is not None:
+            d["activeDeadlineSeconds"] = self.active_deadline_seconds
+        if self.ttl_seconds_after_finished is not None:
+            d["ttlSecondsAfterFinished"] = self.ttl_seconds_after_finished
+        return d
+
+
+@dataclass
+class ShardingSpec:
+    """Parallelism as job-spec data (SURVEY §2.5 row 5 — absent in the
+    reference; first-class here). Axis sizes multiply to the global chip count;
+    -1 means "fill with remaining chips" (at most one axis).
+
+    Lowered by the runtime to a jax.sharding.Mesh with axes
+    ("data", "fsdp", "expert", "pipeline", "sequence", "tensor") — DCN-major
+    ordering so data parallelism rides DCN and tensor parallelism rides the
+    innermost ICI dimension.
+    """
+
+    data: int = -1        # pure data parallel (DCN-friendly)
+    fsdp: int = 1         # data parallel with sharded params (ZeRO-3 analog)
+    tensor: int = 1       # megatron-style op sharding (innermost ICI)
+    pipeline: int = 1     # pipeline stages
+    sequence: int = 1     # sequence/context parallelism (ring attention)
+    expert: int = 1       # MoE expert parallelism
+
+    AXES = ("data", "fsdp", "expert", "pipeline", "sequence", "tensor")
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {a: getattr(self, a) for a in self.AXES}
+
+    def resolve(self, num_chips: int) -> dict[str, int]:
+        sizes = self.axis_sizes()
+        wildcards = [a for a, s in sizes.items() if s == -1]
+        if len(wildcards) > 1:
+            raise ValueError(f"at most one sharding axis may be -1, got {wildcards}")
+        fixed = 1
+        for a, s in sizes.items():
+            if s != -1:
+                if s < 1:
+                    raise ValueError(f"sharding axis {a} must be >=1 or -1, got {s}")
+                fixed *= s
+        if wildcards:
+            if num_chips % fixed:
+                raise ValueError(
+                    f"fixed sharding axes product {fixed} does not divide {num_chips} chips"
+                )
+            sizes[wildcards[0]] = num_chips // fixed
+        elif fixed != num_chips:
+            raise ValueError(
+                f"sharding axes product {fixed} != total chip count {num_chips} "
+                "(slice chips x numSlices)"
+            )
+        return sizes
+
+    def to_dict(self) -> dict:
+        return self.axis_sizes()
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "ShardingSpec":
+        d = d or {}
+        unknown = set(d) - set(cls.AXES)
+        if unknown:
+            raise ValueError(
+                f"unknown sharding axes {sorted(unknown)}; valid: {list(cls.AXES)}"
+            )
+        return cls(**{a: int(d.get(a, -1 if a == "data" else 1)) for a in cls.AXES})
+
+
+@dataclass
+class TrainingJob:
+    """Typed view over a training-job manifest (any of the four kinds)."""
+
+    kind: str
+    name: str
+    namespace: str
+    replica_specs: dict[str, ReplicaSpec]
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+    sharding: ShardingSpec = field(default_factory=ShardingSpec)
+    raw: dict = field(default_factory=dict)
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def from_manifest(cls, obj: dict) -> "TrainingJob":
+        kind = obj.get("kind", "")
+        if kind not in JOB_KINDS:
+            raise ValueError(f"not a training-job kind: {kind!r}")
+        spec = obj.get("spec", {}) or {}
+        # TFJob v1beta2 uses tfReplicaSpecs, PyTorchJob pytorchReplicaSpecs,
+        # MPIJob replicas/gpus shorthand, TPUJob replicaSpecs.
+        specs_key = {
+            "TFJob": "tfReplicaSpecs",
+            "PyTorchJob": "pytorchReplicaSpecs",
+            "TPUJob": "replicaSpecs",
+            "MPIJob": "replicaSpecs",
+        }[kind]
+        raw_specs = spec.get(specs_key) or spec.get("replicaSpecs") or {}
+        if kind == "MPIJob" and not raw_specs:
+            raw_specs = cls._mpi_shorthand(spec)
+        replica_specs: dict[str, ReplicaSpec] = {}
+        for rtype, rs in raw_specs.items():
+            rs = rs or {}
+            topo_name = rs.get("tpuTopology")
+            topo = parse_topology(topo_name) if topo_name else None
+            if rtype == "TPU" and topo is None:
+                raise ValueError("TPU replica spec requires tpuTopology (e.g. v5e-32)")
+            replica_specs[rtype] = ReplicaSpec(
+                replica_type=rtype,
+                replicas=int(rs.get("replicas", 1)),
+                topology=topo,
+                num_slices=int(rs.get("numSlices", 1)),
+                template=rs.get("template") or {},
+                restart_policy=rs.get(
+                    "restartPolicy",
+                    RESTART_POLICY_GANG if rtype == "TPU" else RESTART_POLICY_ON_FAILURE,
+                ),
+            )
+        rp = spec.get("runPolicy", {}) or {}
+        job = cls(
+            kind=kind,
+            name=k8s.name_of(obj),
+            namespace=k8s.namespace_of(obj, "default"),
+            replica_specs=replica_specs,
+            run_policy=RunPolicy(
+                clean_pod_policy=rp.get("cleanPodPolicy", CLEAN_POD_RUNNING),
+                backoff_limit=int(rp.get("backoffLimit", 3)),
+                active_deadline_seconds=rp.get("activeDeadlineSeconds"),
+                gang_scheduling=bool(rp.get("gangScheduling", True)),
+                ttl_seconds_after_finished=rp.get("ttlSecondsAfterFinished"),
+            ),
+            sharding=ShardingSpec.from_dict(spec.get("sharding")),
+            raw=obj,
+        )
+        job.validate()
+        return job
+
+    @staticmethod
+    def _mpi_shorthand(spec: dict) -> dict:
+        """MPIJob `oneOf{tpuTopology, replicas}` shorthand → replica specs.
+
+        Reference API shape: mpi-operator.libsonnet:27-77 (`oneOf{gpus,
+        replicas}`); here `tpuTopology: v5e-32` names the whole gang.
+        """
+        if "tpuTopology" in spec:
+            return {"TPU": {"tpuTopology": spec["tpuTopology"],
+                            "numSlices": spec.get("numSlices", 1),
+                            "template": spec.get("template", {})}}
+        if "replicas" in spec:
+            return {"Launcher": {"replicas": 1, "template": spec.get("template", {})},
+                    "Worker": {"replicas": int(spec["replicas"]),
+                               "template": spec.get("template", {})}}
+        raise ValueError("MPIJob spec requires one of tpuTopology or replicas")
+
+    # -- validation ---------------------------------------------------------
+
+    def validate(self) -> None:
+        k8s.validate_name(self.name)
+        vocab = REPLICA_TYPES[self.kind]
+        if not self.replica_specs:
+            raise ValueError(f"{self.kind} {self.name}: no replica specs")
+        for rtype, rs in self.replica_specs.items():
+            if rtype not in vocab:
+                raise ValueError(
+                    f"{self.kind} {self.name}: invalid replica type {rtype!r}; "
+                    f"valid: {vocab}"
+                )
+            if rtype in _MAX_ONE and rs.replicas > 1:
+                raise ValueError(f"{self.kind} {self.name}: at most one {rtype} replica")
+            if rs.is_tpu:
+                # Resolving the sharding spec against the slice validates the
+                # axis product here, at admission time, not at runtime.
+                self.sharding.resolve(rs.topology.num_chips * rs.num_slices)
+        if "TPU" in self.replica_specs and not self.run_policy.gang_scheduling:
+            raise ValueError(
+                f"{self.kind} {self.name}: TPU replicas require gangScheduling "
+                "(the slice is the atomic unit)"
+            )
+
+    # -- helpers ------------------------------------------------------------
+
+    @property
+    def tpu_spec(self) -> Optional[ReplicaSpec]:
+        return self.replica_specs.get("TPU")
+
+    def total_pods(self) -> int:
+        return sum(rs.pod_count for rs in self.replica_specs.values())
+
+    def selector(self) -> dict[str, str]:
+        return {"kubeflow.org/job-name": self.name,
+                "kubeflow.org/job-kind": self.kind.lower()}
+
+    def to_manifest(self) -> dict:
+        """Serialize from the typed fields (always — a job parsed from a
+        manifest and then mutated must serialize its mutations). Metadata
+        extras from the source manifest (labels, uid, ...) are preserved."""
+        api_version = TPU_API_VERSION if self.kind == "TPUJob" else (
+            KF_API_VERSION_V1ALPHA1 if self.kind == "MPIJob" else KF_API_VERSION_V1BETA2
+        )
+        specs_key = {"TFJob": "tfReplicaSpecs", "PyTorchJob": "pytorchReplicaSpecs",
+                     "TPUJob": "replicaSpecs", "MPIJob": "replicaSpecs"}[self.kind]
+        out = k8s.make(
+            api_version, self.kind, self.name, self.namespace,
+            spec={
+                specs_key: {t: rs.to_dict() for t, rs in self.replica_specs.items()},
+                "runPolicy": self.run_policy.to_dict(),
+                "sharding": self.sharding.to_dict(),
+            },
+        )
+        if self.raw:
+            out["apiVersion"] = self.raw.get("apiVersion", out["apiVersion"])
+            meta = dict(self.raw.get("metadata", {}))
+            meta.update(out["metadata"])
+            out["metadata"] = meta
+            if "status" in self.raw:
+                out["status"] = self.raw["status"]
+        return out

@@ -1,0 +1,1 @@
+"""kfctl — the deployment CLI (init/generate/apply/delete/show) and coordinator."""

@@ -1,0 +1,39 @@
+"""kfctl command-line entry point.
+
+Verbs mirror the reference CLI (bootstrap/cmd/kfctl: init, generate, apply,
+delete, show, version). Verb implementations live in the coordinator; this
+module is argument parsing only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .. import __version__
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kfctl",
+        description="Deploy and manage the TPU-native Kubeflow platform.",
+    )
+    sub = p.add_subparsers(dest="verb")
+    sub.add_parser("version", help="print version")
+    # init/generate/apply/delete/show are registered by the coordinator module
+    # (imported lazily so `kfctl version` works without cluster deps).
+    from . import verbs
+    verbs.register(sub)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.verb == "version" or args.verb is None:
+        print(f"kfctl (kubeflow-tpu) {__version__}")
+        return 0
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+"""kfctl verb registration (placeholder until the coordinator lands).
+
+Each verb maps to the coordinator fan-out described in SURVEY.md §3.1.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def register(sub: argparse._SubParsersAction) -> None:
+    # Populated by the coordinator milestone; keeping the import seam stable.
+    try:
+        from .coordinator import register_verbs
+    except ImportError:
+        return
+    register_verbs(sub)

@@ -1,0 +1,1 @@
+"""Shared utilities: YAML IO, retry/backoff, structured logging."""

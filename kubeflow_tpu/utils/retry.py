@@ -1,0 +1,79 @@
+"""Retry/backoff helpers.
+
+Reference parity: per-component apply retry (ksonnet.go:148-197, constant
+6x5s), DM-op polling with exponential backoff (gcp.go:267-308,
+newDefaultBackoff :129), pytest @retry decorators (kfctl_go_test.py:14-16).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Type, TypeVar
+
+log = logging.getLogger(__name__)
+T = TypeVar("T")
+
+
+@dataclass
+class Backoff:
+    """Exponential backoff with cap: the gcp.go newDefaultBackoff analog."""
+
+    initial: float = 1.0
+    factor: float = 2.0
+    max_interval: float = 60.0
+    max_elapsed: float = 600.0
+
+    def intervals(self):
+        elapsed, cur = 0.0, self.initial
+        while elapsed < self.max_elapsed:
+            yield cur
+            elapsed += cur
+            cur = min(cur * self.factor, self.max_interval)
+
+
+def retry(
+    fn: Callable[[], T],
+    *,
+    attempts: int = 6,
+    interval: float = 5.0,
+    backoff: Optional[Backoff] = None,
+    retriable: tuple[Type[BaseException], ...] = (Exception,),
+    sleep: Callable[[float], None] = time.sleep,
+    desc: str = "",
+) -> T:
+    """Constant-interval (default: 6x5s, the applyComponent policy) or
+    exponential-backoff retry."""
+    waits = list(backoff.intervals()) if backoff else [interval] * (attempts - 1)
+    last: BaseException | None = None
+    for i in range(len(waits) + 1):
+        try:
+            return fn()
+        except retriable as e:  # noqa: PERF203
+            last = e
+            if i >= len(waits):
+                break
+            log.warning("retry %d/%d %s: %s", i + 1, len(waits) + 1, desc or fn, e)
+            sleep(waits[i])
+    assert last is not None
+    raise last
+
+
+def poll_until(
+    predicate: Callable[[], bool],
+    *,
+    timeout: float = 300.0,
+    interval: float = 2.0,
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+    desc: str = "",
+) -> None:
+    """Poll until predicate() is true (kf_is_ready_test.py:35-68 analog)."""
+    deadline = clock() + timeout
+    while True:
+        if predicate():
+            return
+        if clock() >= deadline:
+            raise TimeoutError(f"timed out after {timeout}s waiting for {desc}")
+        sleep(interval)

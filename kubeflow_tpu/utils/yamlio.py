@@ -1,0 +1,46 @@
+"""YAML IO with a JSON fallback so the core library has zero hard deps."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable
+
+try:
+    import yaml as _yaml
+except ImportError:  # pragma: no cover - PyYAML is present in the dev image
+    _yaml = None
+
+
+def dumps(obj: Any) -> str:
+    if _yaml is not None:
+        return _yaml.safe_dump(obj, sort_keys=False, default_flow_style=False)
+    return json.dumps(obj, indent=2)
+
+
+def loads(text: str) -> Any:
+    if _yaml is not None:
+        return _yaml.safe_load(text)
+    return json.loads(text)
+
+
+def load_all(text: str) -> list[Any]:
+    """Parse a multi-document YAML stream (`---`-separated manifests)."""
+    if _yaml is not None:
+        return [d for d in _yaml.safe_load_all(text) if d is not None]
+    return [json.loads(t) for t in text.split("\n---\n") if t.strip()]
+
+
+def dump_all(objs: Iterable[Any]) -> str:
+    if _yaml is not None:
+        return _yaml.safe_dump_all(list(objs), sort_keys=False, default_flow_style=False)
+    return "\n---\n".join(json.dumps(o, indent=2) for o in objs)
+
+
+def dump_file(obj: Any, path: str) -> None:
+    with open(path, "w") as f:
+        f.write(dumps(obj))
+
+
+def load_file(path: str) -> Any:
+    with open(path) as f:
+        return loads(f.read())

@@ -1,0 +1,18 @@
+"""Test config: force an 8-device virtual CPU mesh BEFORE jax is imported.
+
+All tests run on CPU with 8 virtual devices so multi-chip sharding
+(dp/tp/pp/sp/ep) is exercised without TPU hardware — the build-plan's
+"fake slice backend" tier (SURVEY.md §4).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

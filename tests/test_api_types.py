@@ -1,0 +1,199 @@
+"""Unit tests for the core API types (k8s object model, topology, jobs, KfDef).
+
+Mirrors the reference's API-type round-trip tests
+(bootstrap/.../application_types_test.go) and CRD validation behavior
+(tf-job-operator.libsonnet:14-46 Chief max 1; mpi-operator.libsonnet:27-77
+oneOf validation).
+"""
+
+import pytest
+
+from kubeflow_tpu.api import k8s
+from kubeflow_tpu.api.kfdef import KfDef, KfDefSpec, PLATFORM_GCP
+from kubeflow_tpu.api.topology import (
+    TopologyContract, parse_topology, render_contracts,
+)
+from kubeflow_tpu.api.trainingjob import ShardingSpec, TrainingJob
+
+
+class TestK8sModel:
+    def test_make_and_keys(self):
+        obj = k8s.make("v1", "Service", "svc", "ns", labels={"a": "b"})
+        assert k8s.key_of(obj) == ("v1", "Service", "ns", "svc")
+        assert k8s.labels_of(obj) == {"a": "b"}
+
+    def test_selector(self):
+        obj = k8s.make("v1", "Pod", "p", labels={"app": "x", "tier": "web"})
+        assert k8s.matches_selector(obj, {"app": "x"})
+        assert not k8s.matches_selector(obj, {"app": "y"})
+        assert k8s.selector_from({"matchLabels": {"a": "1"}}) == {"a": "1"}
+
+    def test_owner_refs(self):
+        owner = k8s.make("v1", "Job", "j", "ns")
+        owner["metadata"]["uid"] = "u1"
+        child = k8s.make("v1", "Pod", "p", "ns")
+        k8s.set_owner(child, owner)
+        assert k8s.is_owned_by(child, owner)
+
+    def test_conditions_upsert(self):
+        obj = {}
+        k8s.set_condition(obj, k8s.Condition("Ready", "False", reason="init"))
+        t0 = obj["status"]["conditions"][0]["lastTransitionTime"]
+        k8s.set_condition(obj, k8s.Condition("Ready", "False", reason="still"))
+        assert obj["status"]["conditions"][0]["lastTransitionTime"] == t0
+        k8s.set_condition(obj, k8s.Condition("Ready", "True"))
+        assert len(obj["status"]["conditions"]) == 1
+        assert k8s.condition_true(obj, "Ready")
+
+    def test_param_substitution_preserves_types(self):
+        out = k8s.substitute_params(
+            {"replicas": "$(n)", "img": "repo/$(name):v1"}, {"n": 3, "name": "tpu"})
+        assert out == {"replicas": 3, "img": "repo/tpu:v1"}
+
+    def test_deep_merge(self):
+        merged = k8s.deep_merge({"a": {"b": 1, "c": 2}}, {"a": {"c": 3}, "d": 4})
+        assert merged == {"a": {"b": 1, "c": 3}, "d": 4}
+
+    def test_sort_for_apply(self):
+        objs = [k8s.make("apps/v1", "Deployment", "d"),
+                k8s.make("v1", "Namespace", "ns"),
+                k8s.make("apiextensions.k8s.io/v1", "CustomResourceDefinition", "crd")]
+        kinds = [o["kind"] for o in k8s.sort_for_apply(objs)]
+        assert kinds == ["Namespace", "CustomResourceDefinition", "Deployment"]
+
+
+class TestTopology:
+    def test_parse_v5e_32(self):
+        t = parse_topology("v5e-32")
+        assert t.num_chips == 32
+        assert t.num_hosts == 8
+        assert t.ici_mesh == (4, 8)
+
+    def test_single_chip(self):
+        t = parse_topology("v5e-1")
+        assert t.num_hosts == 1 and t.chips_per_host == 1
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            parse_topology("v5e-13")
+        with pytest.raises(ValueError):
+            parse_topology("h100-8")
+
+    def test_contract_render(self):
+        topo = parse_topology("v5e-32")
+        contracts = render_contracts("train", "kubeflow", topo, num_slices=2)
+        assert len(contracts) == 16  # 2 slices x 8 hosts
+        assert contracts[0].process_id == 0 and contracts[-1].process_id == 15
+        assert contracts[9].slice_id == 1
+        env = contracts[3].to_env()
+        rt = TopologyContract.from_env(env)
+        assert rt.process_id == 3
+        assert rt.slice_topology.num_chips == 32
+        assert "train-worker-0-0" in rt.coordinator_address
+
+
+class TestShardingSpec:
+    def test_wildcard_fill(self):
+        s = ShardingSpec(data=-1, tensor=4)
+        sizes = s.resolve(32)
+        assert sizes["data"] == 8 and sizes["tensor"] == 4
+
+    def test_exact_product(self):
+        s = ShardingSpec(data=2, fsdp=2, tensor=2, pipeline=1, sequence=2, expert=1)
+        assert s.resolve(16)["sequence"] == 2
+
+    def test_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            ShardingSpec(data=3, tensor=3).resolve(8)
+
+
+class TestTrainingJob:
+    def _tpujob(self, **spec_extra):
+        return {
+            "apiVersion": "tpu.kubeflow.org/v1alpha1",
+            "kind": "TPUJob",
+            "metadata": {"name": "mnist", "namespace": "kubeflow"},
+            "spec": {
+                "replicaSpecs": {
+                    "TPU": {"tpuTopology": "v5e-32",
+                            "template": {"spec": {"containers": [{"name": "jax"}]}}},
+                },
+                **spec_extra,
+            },
+        }
+
+    def test_tpujob_parse(self):
+        job = TrainingJob.from_manifest(self._tpujob())
+        assert job.tpu_spec.pod_count == 8
+        assert job.total_pods() == 8
+        assert job.run_policy.gang_scheduling
+
+    def test_tfjob_with_tpu_replica(self):
+        m = {
+            "apiVersion": "kubeflow.org/v1beta2", "kind": "TFJob",
+            "metadata": {"name": "tf-cnn"},
+            "spec": {"tfReplicaSpecs": {
+                "Chief": {"replicas": 1, "template": {}},
+                "TPU": {"tpuTopology": "v5e-8", "template": {}},
+            }},
+        }
+        job = TrainingJob.from_manifest(m)
+        assert job.replica_specs["TPU"].topology.num_hosts == 2
+        assert job.total_pods() == 3
+
+    def test_chief_max_one(self):
+        m = {"apiVersion": "kubeflow.org/v1beta2", "kind": "TFJob",
+             "metadata": {"name": "bad"},
+             "spec": {"tfReplicaSpecs": {"Chief": {"replicas": 2, "template": {}}}}}
+        with pytest.raises(ValueError, match="at most one Chief"):
+            TrainingJob.from_manifest(m)
+
+    def test_mpijob_topology_shorthand(self):
+        m = {"apiVersion": "kubeflow.org/v1alpha1", "kind": "MPIJob",
+             "metadata": {"name": "allreduce"},
+             "spec": {"tpuTopology": "v5e-16", "template": {}}}
+        job = TrainingJob.from_manifest(m)
+        assert job.tpu_spec.pod_count == 4
+
+    def test_mpijob_requires_oneof(self):
+        m = {"apiVersion": "kubeflow.org/v1alpha1", "kind": "MPIJob",
+             "metadata": {"name": "bad"}, "spec": {}}
+        with pytest.raises(ValueError, match="one of"):
+            TrainingJob.from_manifest(m)
+
+    def test_tpu_requires_topology(self):
+        m = self._tpujob()
+        del m["spec"]["replicaSpecs"]["TPU"]["tpuTopology"]
+        with pytest.raises(ValueError, match="tpuTopology"):
+            TrainingJob.from_manifest(m)
+
+    def test_sharding_validated_at_admission(self):
+        m = self._tpujob(sharding={"data": 5, "tensor": 5})
+        with pytest.raises(ValueError):
+            TrainingJob.from_manifest(m)
+
+    def test_roundtrip(self):
+        job = TrainingJob.from_manifest(self._tpujob())
+        m2 = job.to_manifest()
+        job2 = TrainingJob.from_manifest(m2)
+        assert job2.tpu_spec.topology.name == "v5e-32"
+
+
+class TestKfDef:
+    def test_save_load_roundtrip(self, tmp_path):
+        kf = KfDef(name="kf", spec=KfDefSpec(app_dir=str(tmp_path)))
+        kf.set_condition("Available", "True", reason="deployed")
+        kf.save()
+        kf2 = KfDef.load(str(tmp_path))
+        assert kf2.name == "kf"
+        assert kf2.spec.components == kf.spec.components
+        assert kf2.conditions[0].type == "Available"
+
+    def test_validate_gcp_requires_project(self):
+        kf = KfDef(name="kf", spec=KfDefSpec(platform=PLATFORM_GCP))
+        with pytest.raises(ValueError, match="project"):
+            kf.validate()
+
+    def test_load_missing_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            KfDef.load(str(tmp_path / "nope"))

@@ -148,17 +148,18 @@ class Condition:
 
 
 def set_condition(obj: dict, cond: Condition) -> None:
-    """Upsert a condition by type; preserves transition time if status unchanged."""
+    """Upsert a condition by type; preserves transition time if status unchanged.
+    Does not mutate the passed Condition."""
     conds = obj.setdefault("status", {}).setdefault("conditions", [])
+    d = cond.to_dict()
     for existing in conds:
         if existing.get("type") == cond.type:
             if existing.get("status") == cond.status:
-                cond.last_transition_time = existing.get(
-                    "lastTransitionTime", cond.last_transition_time
-                )
-            existing.update(cond.to_dict())
+                d["lastTransitionTime"] = existing.get(
+                    "lastTransitionTime", d["lastTransitionTime"])
+            existing.update(d)
             return
-    conds.append(cond.to_dict())
+    conds.append(d)
 
 
 def get_condition(obj: dict, ctype: str) -> Optional[dict]:

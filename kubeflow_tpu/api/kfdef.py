@@ -166,6 +166,7 @@ class KfDef:
         app_dir = app_dir or self.spec.app_dir
         if not app_dir:
             raise ValueError("KfDef.save: no app_dir set")
+        self.spec.app_dir = app_dir  # persist the dir actually written to
         os.makedirs(app_dir, exist_ok=True)
         path = os.path.join(app_dir, APP_FILE)
         yamlio.dump_file(self.to_dict(), path)

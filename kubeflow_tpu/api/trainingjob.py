@@ -219,7 +219,7 @@ class TrainingJob:
             "TPUJob": "replicaSpecs",
             "MPIJob": "replicaSpecs",
         }[kind]
-        raw_specs = spec.get(specs_key) or spec.get("replicaSpecs") or {}
+        raw_specs = spec.get(specs_key) or {}
         if kind == "MPIJob" and not raw_specs:
             raw_specs = cls._mpi_shorthand(spec)
         replica_specs: dict[str, ReplicaSpec] = {}
@@ -278,8 +278,13 @@ class TrainingJob:
 
     # -- validation ---------------------------------------------------------
 
+    # Derived names ("<name>-worker-<slice>-<host>" pod hostnames,
+    # "<name>-workers" service) must each fit a 63-char DNS label; reserve
+    # headroom for the longest suffix the operator generates.
+    MAX_NAME_LEN = 45
+
     def validate(self) -> None:
-        k8s.validate_name(self.name)
+        k8s.validate_name(self.name, max_len=self.MAX_NAME_LEN)
         vocab = REPLICA_TYPES[self.kind]
         if not self.replica_specs:
             raise ValueError(f"{self.kind} {self.name}: no replica specs")
@@ -292,6 +297,10 @@ class TrainingJob:
             if rtype in _MAX_ONE and rs.replicas > 1:
                 raise ValueError(f"{self.kind} {self.name}: at most one {rtype} replica")
             if rs.is_tpu:
+                if rs.topology is None:
+                    raise ValueError(
+                        f"{self.kind} {self.name}: TPU replica spec requires "
+                        "tpuTopology (e.g. v5e-32)")
                 # Resolving the sharding spec against the slice validates the
                 # axis product here, at admission time, not at runtime.
                 self.sharding.resolve(rs.topology.num_chips * rs.num_slices)

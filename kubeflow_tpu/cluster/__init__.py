@@ -1,0 +1,19 @@
+"""Kubernetes API abstraction.
+
+- ``client``: the narrow client interface every controller and the CLI apply
+  path program against (create/get/list/update/patch/delete/watch).
+- ``fake``: an in-memory apiserver + scheduler implementing that interface —
+  the envtest analog (SURVEY.md §4 tier 2) used by every controller test and
+  by `kfctl apply --dry-run`. Models uids, resourceVersions, watches,
+  owner-reference cascade deletion, nodes with TPU capacity, and all-or-nothing
+  gang binding of pod groups.
+- ``apply``: manifest-set apply/delete with per-object retry (the
+  ksonnet.go applyComponent analog).
+"""
+
+from .client import (AlreadyExistsError, ConflictError, KubeClient,
+                     NotFoundError, WatchEvent)
+from .fake import FakeCluster
+
+__all__ = ["KubeClient", "FakeCluster", "WatchEvent", "NotFoundError",
+           "ConflictError", "AlreadyExistsError"]

@@ -1,0 +1,138 @@
+"""The Kubernetes client interface.
+
+Controllers, the CLI apply path, and the web apps all program against this
+narrow surface; implementations are the in-memory FakeCluster (tests,
+dry-run) and a REST client against a real apiserver (gated: no cluster in the
+dev environment). This mirrors how the reference splits client-go usage from
+reconciler logic (controller-runtime's client.Client).
+"""
+
+from __future__ import annotations
+
+import queue
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+
+class KubeError(Exception):
+    pass
+
+
+class NotFoundError(KubeError):
+    pass
+
+
+class AlreadyExistsError(KubeError):
+    pass
+
+
+class ConflictError(KubeError):
+    """resourceVersion mismatch on update — caller must re-read and retry."""
+
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: str   # ADDED | MODIFIED | DELETED
+    obj: dict
+
+
+class Watch:
+    """A watch subscription: a queue of WatchEvents with an optional
+    (apiVersion, kind) filter. close() detaches it from the server."""
+
+    def __init__(self, api_version: Optional[str] = None, kind: Optional[str] = None):
+        self.api_version = api_version
+        self.kind = kind
+        self.events: "queue.Queue[WatchEvent]" = queue.Queue()
+        self.closed = False
+
+    def matches(self, obj: dict) -> bool:
+        if self.api_version and obj.get("apiVersion") != self.api_version:
+            return False
+        if self.kind and obj.get("kind") != self.kind:
+            return False
+        return True
+
+    def deliver(self, event: WatchEvent) -> None:
+        if not self.closed and self.matches(event.obj):
+            self.events.put(event)
+
+    def get(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        try:
+            return self.events.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def close(self) -> None:
+        self.closed = True
+
+
+class KubeClient:
+    """Abstract client. All objects are manifest dicts (see api.k8s)."""
+
+    def create(self, obj: dict) -> dict:
+        raise NotImplementedError
+
+    def get(self, api_version: str, kind: str, namespace: str, name: str) -> dict:
+        raise NotImplementedError
+
+    def list(self, api_version: str, kind: str, namespace: Optional[str] = None,
+             selector: Optional[dict] = None) -> list[dict]:
+        raise NotImplementedError
+
+    def update(self, obj: dict) -> dict:
+        raise NotImplementedError
+
+    def update_status(self, obj: dict) -> dict:
+        raise NotImplementedError
+
+    def patch(self, api_version: str, kind: str, namespace: str, name: str,
+              patch: dict) -> dict:
+        raise NotImplementedError
+
+    def delete(self, api_version: str, kind: str, namespace: str, name: str,
+               cascade: bool = True) -> None:
+        raise NotImplementedError
+
+    def watch(self, api_version: Optional[str] = None,
+              kind: Optional[str] = None) -> Watch:
+        raise NotImplementedError
+
+    # -- conveniences shared by all implementations -------------------------
+
+    def get_or_none(self, api_version: str, kind: str, namespace: str,
+                    name: str) -> Optional[dict]:
+        try:
+            return self.get(api_version, kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def apply(self, obj: dict) -> dict:
+        """Create-or-update (kubectl apply semantics, spec-level replace)."""
+        from ..api import k8s
+        existing = self.get_or_none(*k8s.key_of(obj))
+        if existing is None:
+            return self.create(obj)
+        merged = dict(existing)
+        for key in ("spec", "data", "stringData", "rules", "webhooks", "subsets"):
+            if key in obj:
+                merged[key] = obj[key]
+        meta = dict(existing.get("metadata", {}))
+        for key in ("labels", "annotations"):
+            if obj.get("metadata", {}).get(key):
+                meta[key] = obj["metadata"][key]
+        merged["metadata"] = meta
+        return self.update(merged)
+
+    def delete_many(self, objs: Iterable[dict]) -> None:
+        from ..api import k8s
+        for obj in objs:
+            try:
+                self.delete(*k8s.key_of(obj))
+            except NotFoundError:
+                pass

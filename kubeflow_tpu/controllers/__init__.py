@@ -1,0 +1,10 @@
+"""Reconcilers: the runtime control plane.
+
+- ``runtime``: the controller manager (watch → workqueue → reconcile), the
+  controller-runtime analog every reconciler plugs into.
+- ``tpujob``: the training-job operator — gang-scheduled TPU slices,
+  topology-contract injection, slice-level failure handling.
+- ``notebook``: Notebook CR → StatefulSet + Service + VirtualService.
+- ``profile``: Profile CR → Namespace + ServiceAccounts + RoleBindings.
+- ``admission``: PodDefault mutating-webhook logic.
+"""

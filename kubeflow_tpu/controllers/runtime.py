@@ -1,0 +1,221 @@
+"""Controller manager: watch → workqueue → reconcile.
+
+The controller-runtime analog (the reference's controllers are kubebuilder
+reconcilers, e.g. notebook_controller.go:57-144 watch wiring + :163
+Reconcile). Semantics kept:
+
+- Level-triggered: reconcilers read desired state from the store, never from
+  the event (events only enqueue keys).
+- One reconcile at a time per controller (single-reconciler concurrency
+  model the reference relies on, SURVEY.md §5 race-detection note).
+- Dedup: a key already queued is not queued twice.
+- Requeue-on-error with bounded retries.
+- Owned-object mapping: events on owned kinds enqueue the owner key
+  (the Owns()/Watches() analog).
+
+Deterministic test drive: `run_pending()` drains the queue synchronously.
+Production drive: `start()` spins a daemon thread per controller.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..api import k8s
+from ..cluster.client import DELETED, KubeClient, Watch
+
+log = logging.getLogger(__name__)
+
+# A reconcile key: (namespace, name) of the primary object.
+Key = tuple[str, str]
+
+
+@dataclass
+class Result:
+    requeue: bool = False
+    requeue_after: float = 0.0
+
+
+class Reconciler:
+    """Interface: reconcile one object identified by key, level-triggered."""
+
+    #: (apiVersion, kind) of the primary resource
+    primary: tuple[str, str] = ("", "")
+    #: (apiVersion, kind) list of owned resources whose events map to owners
+    owns: list[tuple[str, str]] = []
+
+    def reconcile(self, client: KubeClient, key: Key) -> Result:
+        raise NotImplementedError
+
+
+class _WorkQueue:
+    def __init__(self):
+        self._items: list[Key] = []
+        self._set: set[Key] = set()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def add(self, key: Key) -> None:
+        with self._cv:
+            if key not in self._set:
+                self._set.add(key)
+                self._items.append(key)
+                self._cv.notify()
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[Key]:
+        with self._cv:
+            if not self._items and timeout:
+                self._cv.wait(timeout)
+            if not self._items:
+                return None
+            key = self._items.pop(0)
+            self._set.discard(key)
+            return key
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+@dataclass
+class Controller:
+    reconciler: Reconciler
+    client: KubeClient
+    max_retries: int = 5
+    queue: _WorkQueue = field(default_factory=_WorkQueue)
+    _watches: list[Watch] = field(default_factory=list)
+    _retries: dict[Key, int] = field(default_factory=dict)
+    _stop: threading.Event = field(default_factory=threading.Event)
+    _delayed: list[tuple[float, Key]] = field(default_factory=list)
+
+    # -- wiring -------------------------------------------------------------
+
+    def bind_watches(self) -> None:
+        av, kind = self.reconciler.primary
+        w = self.client.watch(av, kind)
+        self._watches.append(w)
+        for oav, okind in self.reconciler.owns:
+            self._watches.append(self.client.watch(oav, okind))
+
+    def enqueue_existing(self) -> None:
+        """Initial list → enqueue (informer initial sync analog)."""
+        av, kind = self.reconciler.primary
+        for obj in self.client.list(av, kind):
+            self.queue.add((k8s.namespace_of(obj, "default"), k8s.name_of(obj)))
+
+    def _map_event_key(self, obj: dict) -> Optional[Key]:
+        av_kind = (obj.get("apiVersion"), obj.get("kind"))
+        if av_kind == self.reconciler.primary:
+            return (k8s.namespace_of(obj, "default"), k8s.name_of(obj))
+        # owned object: map to controller owner reference
+        pav, pkind = self.reconciler.primary
+        for ref in obj.get("metadata", {}).get("ownerReferences", []) or []:
+            if ref.get("kind") == pkind and ref.get("apiVersion") == pav:
+                return (k8s.namespace_of(obj, "default"), ref.get("name", ""))
+        return None
+
+    def pump_events(self, budget: int = 1000) -> int:
+        """Drain watch queues into the workqueue (non-blocking)."""
+        n = 0
+        for w in self._watches:
+            while n < budget:
+                ev = w.get(timeout=0)
+                if ev is None:
+                    break
+                key = self._map_event_key(ev.obj)
+                if key:
+                    # DELETED of primary still enqueues: reconcile observes
+                    # absence and cleans up (level-triggered).
+                    self.queue.add(key)
+                    n += 1
+        now = time.monotonic()
+        due = [k for t, k in self._delayed if t <= now]
+        self._delayed = [(t, k) for t, k in self._delayed if t > now]
+        for k in due:
+            self.queue.add(k)
+        return n
+
+    # -- execution ----------------------------------------------------------
+
+    def process_one(self) -> bool:
+        key = self.queue.pop()
+        if key is None:
+            return False
+        try:
+            res = self.reconciler.reconcile(self.client, key)
+            self._retries.pop(key, None)
+            if res.requeue_after > 0:
+                self._delayed.append((time.monotonic() + res.requeue_after, key))
+            elif res.requeue:
+                self.queue.add(key)
+        except Exception as e:  # noqa: BLE001 - reconcile errors requeue
+            n = self._retries.get(key, 0) + 1
+            self._retries[key] = n
+            if n <= self.max_retries:
+                log.warning("reconcile %s failed (retry %d/%d): %s",
+                            key, n, self.max_retries, e)
+                self.queue.add(key)
+            else:
+                log.error("reconcile %s gave up after %d retries: %s",
+                          key, self.max_retries, e)
+        return True
+
+    def run_pending(self, max_iters: int = 1000) -> int:
+        """Deterministic drain: pump events + process until quiescent."""
+        done = 0
+        for _ in range(max_iters):
+            self.pump_events()
+            if not self.process_one():
+                self.pump_events()
+                if len(self.queue) == 0:
+                    break
+            else:
+                done += 1
+        return done
+
+    def start(self, poll_interval: float = 0.05) -> threading.Thread:
+        def loop():
+            while not self._stop.is_set():
+                self.pump_events()
+                if not self.process_one():
+                    time.sleep(poll_interval)
+        t = threading.Thread(target=loop, daemon=True,
+                             name=f"ctrl-{self.reconciler.primary[1]}")
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class Manager:
+    """Holds a set of controllers over one client (manager.Manager analog)."""
+
+    def __init__(self, client: KubeClient):
+        self.client = client
+        self.controllers: list[Controller] = []
+
+    def add(self, reconciler: Reconciler, **kwargs) -> Controller:
+        c = Controller(reconciler=reconciler, client=self.client, **kwargs)
+        c.bind_watches()
+        c.enqueue_existing()
+        self.controllers.append(c)
+        return c
+
+    def run_pending(self, rounds: int = 10) -> None:
+        """Drain all controllers to quiescence (test/deterministic mode).
+        Multiple rounds because one controller's writes enqueue another's."""
+        for _ in range(rounds):
+            if not any(c.run_pending() for c in self.controllers):
+                break
+
+    def start_all(self) -> list[threading.Thread]:
+        return [c.start() for c in self.controllers]
+
+    def stop_all(self) -> None:
+        for c in self.controllers:
+            c.stop()

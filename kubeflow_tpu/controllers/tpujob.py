@@ -1,0 +1,389 @@
+"""The training-job operator: TPUJob / TFJob / PyTorchJob / MPIJob reconciler.
+
+The TPU-native replacement for tf-operator / pytorch-operator / mpi-operator
+(deployed-by-reference images; CRDs + contracts in
+kubeflow/tf-training/tf-job-operator.libsonnet, kubeflow/pytorch-job/,
+kubeflow/mpi-job/). One reconciler serves all four kinds because the TPU
+execution path is identical — only the legacy env contract differs per kind.
+
+Semantics:
+
+- **Gang scheduling (mandatory for TPU replicas).** All pods of a TPU replica
+  carry a pod-group label + min-member annotation; the scheduler binds them
+  all-or-nothing (the kube-batch PodGroup semantic the reference opts into
+  via --enable-gang-scheduling, tf-job-operator.libsonnet:107-109,298-307).
+  The slice is the atomic unit: the reconciler never creates a partial gang.
+- **Topology contract.** Each TPU pod gets the jax.distributed bootstrap env
+  (KFTPU_* — the TF_CONFIG analog, SURVEY.md §3.2) plus the TPU node
+  selector and google.com/tpu resource request. Legacy replicas get their
+  native contracts: TF_CONFIG (TFJob), MASTER_ADDR/RANK/WORLD_SIZE
+  (PyTorchJob), hostlist env (MPIJob).
+- **Slice-level failure domain.** Any failed pod in the gang restarts the
+  WHOLE gang (delete + recreate) up to runPolicy.backoffLimit, then the job
+  is Failed (SURVEY.md §5: "a dead worker kills the gang").
+- **Success.** Process-0 ("chief") pod success completes the job — the
+  tf-operator chief semantic; remaining pods are cleaned per cleanPodPolicy
+  (the reason the reference's launcher.py:91-93 sleeps forever is exactly
+  this policy; our workers exit and the policy reaps them).
+- **Conditions.** Created/Running/Restarting/Succeeded/Failed, mirroring
+  tf-operator's JobCondition vocabulary.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import logging
+from typing import Optional
+
+from ..api import k8s
+from ..api.topology import TopologyContract, render_contracts
+from ..api.trainingjob import (COND_CREATED, COND_FAILED, COND_RESTARTING,
+                               COND_RUNNING, COND_SUCCEEDED, CLEAN_POD_ALL,
+                               CLEAN_POD_NONE, CLEAN_POD_RUNNING,
+                               KF_API_VERSION_V1ALPHA1, KF_API_VERSION_V1BETA2,
+                               POD_FAILED, POD_RUNNING, POD_SUCCEEDED,
+                               ReplicaSpec, TrainingJob, TPU_API_VERSION)
+from ..cluster.client import KubeClient, NotFoundError
+from ..cluster.fake import POD_GROUP_LABEL, TPU_RESOURCE
+from .runtime import Key, Reconciler, Result
+
+log = logging.getLogger(__name__)
+
+RESTART_COUNT_ANNOTATION = "kubeflow.org/gang-restart-count"
+REPLICA_TYPE_LABEL = "kubeflow.org/replica-type"
+REPLICA_INDEX_LABEL = "kubeflow.org/replica-index"
+DEFAULT_PORT = 2222
+JAX_COORD_PORT = 8476
+
+
+def _replica_pod_name(job: TrainingJob, rtype: str, index) -> str:
+    return f"{job.name}-{rtype.lower()}-{index}"
+
+
+def _tpu_pod_name(job: TrainingJob, slice_id: int, host_id: int) -> str:
+    return f"{job.name}-worker-{slice_id}-{host_id}"
+
+
+def _workers_service_name(job: TrainingJob) -> str:
+    return f"{job.name}-workers"
+
+
+class TrainingJobReconciler(Reconciler):
+    """Reconciler for one job kind; instantiate once per kind."""
+
+    def __init__(self, kind: str = "TPUJob"):
+        api_version = {
+            "TPUJob": TPU_API_VERSION,
+            "TFJob": KF_API_VERSION_V1BETA2,
+            "PyTorchJob": KF_API_VERSION_V1BETA2,
+            "MPIJob": KF_API_VERSION_V1ALPHA1,
+        }[kind]
+        self.kind = kind
+        self.primary = (api_version, kind)
+        self.owns = [("v1", "Pod"), ("v1", "Service")]
+
+    # ------------------------------------------------------------ reconcile
+
+    def reconcile(self, client: KubeClient, key: Key) -> Result:
+        namespace, name = key
+        try:
+            manifest = client.get(self.primary[0], self.kind, namespace, name)
+        except NotFoundError:
+            return Result()  # cascade GC removed the children with the owner
+        job = TrainingJob.from_manifest(manifest)
+
+        if k8s.condition_true(manifest, COND_SUCCEEDED) or \
+                k8s.condition_true(manifest, COND_FAILED):
+            return Result()
+
+        pods = client.list("v1", "Pod", namespace, selector=job.selector())
+        by_name = {k8s.name_of(p): p for p in pods}
+
+        self._ensure_services(client, job, manifest)
+        created = self._ensure_pods(client, job, manifest, by_name)
+        if created:
+            self._set_condition(client, manifest, COND_CREATED, "True",
+                                "JobCreated", f"created {created} pods")
+            return Result(requeue=True)
+
+        phases = {k8s.name_of(p): p.get("status", {}).get("phase", "Pending")
+                  for p in pods}
+        chief = self._chief_pod_name(job)
+        failed = [n for n, ph in phases.items() if ph == POD_FAILED]
+        if failed:
+            return self._handle_gang_failure(client, job, manifest, pods, failed)
+
+        if phases.get(chief) == POD_SUCCEEDED:
+            self._set_condition(client, manifest, COND_SUCCEEDED, "True",
+                                "JobSucceeded", f"chief pod {chief} succeeded")
+            self._cleanup_pods(client, job, pods, policy_on_success=True)
+            return Result()
+
+        running = sum(1 for ph in phases.values() if ph == POD_RUNNING)
+        if running == job.total_pods() and running > 0:
+            self._set_condition(client, manifest, COND_RUNNING, "True",
+                                "JobRunning", "all replicas running")
+        self._update_replica_statuses(client, manifest, job, pods)
+        return Result()
+
+    # ------------------------------------------------------------- children
+
+    def _ensure_services(self, client: KubeClient, job: TrainingJob,
+                         manifest: dict) -> None:
+        svc = k8s.make(
+            "v1", "Service", _workers_service_name(job), job.namespace,
+            labels=job.selector(),
+            spec={
+                "clusterIP": "None",  # headless: stable per-pod DNS
+                "selector": job.selector(),
+                "ports": [
+                    {"name": "jax-coordinator", "port": JAX_COORD_PORT},
+                    {"name": "legacy", "port": DEFAULT_PORT},
+                ],
+            },
+        )
+        k8s.set_owner(svc, manifest)
+        if client.get_or_none(*k8s.key_of(svc)) is None:
+            client.create(svc)
+
+    def _ensure_pods(self, client: KubeClient, job: TrainingJob,
+                     manifest: dict, existing: dict[str, dict]) -> int:
+        created = 0
+        for rtype, rs in job.replica_specs.items():
+            if rs.is_tpu:
+                contracts = render_contracts(
+                    job.name, job.namespace, rs.topology, rs.num_slices,
+                    port=JAX_COORD_PORT)
+                # all-or-nothing create: build every missing member first,
+                # then emit the whole set (never a partial gang)
+                gang_pods = []
+                for c in contracts:
+                    pname = _tpu_pod_name(job, c.slice_id,
+                                          c.process_id % rs.topology.num_hosts)
+                    if pname in existing:
+                        continue
+                    gang_pods.append(self._build_tpu_pod(job, manifest, rs, c, pname))
+                for pod in gang_pods:
+                    client.create(pod)
+                    created += 1
+            else:
+                for i in range(rs.replicas):
+                    pname = _replica_pod_name(job, rtype, i)
+                    if pname in existing:
+                        continue
+                    client.create(self._build_replica_pod(
+                        job, manifest, rs, rtype, i, pname))
+                    created += 1
+        return created
+
+    def _base_pod(self, job: TrainingJob, manifest: dict, rs: ReplicaSpec,
+                  name: str, rtype: str, index: str) -> dict:
+        pod = copy.deepcopy(rs.template) or {}
+        pod.setdefault("spec", {}).setdefault("containers",
+                                              [{"name": "main", "image": "main"}])
+        labels = {**job.selector(), REPLICA_TYPE_LABEL: rtype.lower(),
+                  REPLICA_INDEX_LABEL: str(index),
+                  **(pod.get("metadata", {}).get("labels") or {})}
+        meta = {"name": name, "namespace": job.namespace, "labels": labels,
+                "annotations": dict(pod.get("metadata", {}).get("annotations") or {})}
+        pod = {"apiVersion": "v1", "kind": "Pod", "metadata": meta,
+               "spec": pod.get("spec", {})}
+        pod["spec"].setdefault("restartPolicy", "Never")
+        pod["spec"]["hostname"] = name
+        pod["spec"]["subdomain"] = _workers_service_name(job)
+        k8s.set_owner(pod, manifest)
+        return pod
+
+    def _add_env(self, pod: dict, env: dict[str, str]) -> None:
+        for c in pod["spec"]["containers"]:
+            cenv = c.setdefault("env", [])
+            present = {e.get("name") for e in cenv}
+            for k, v in env.items():
+                if k not in present:
+                    cenv.append({"name": k, "value": v})
+
+    def _build_tpu_pod(self, job: TrainingJob, manifest: dict, rs: ReplicaSpec,
+                       contract: TopologyContract, name: str) -> dict:
+        pod = self._base_pod(job, manifest, rs, name, "TPU",
+                             str(contract.process_id))
+        spec = pod["spec"]
+        # TPU placement: the node selectors GKE TPU node pools carry + the
+        # extended resource request for this host's chips (the GPU-driver
+        # DaemonSet slot of the reference, SURVEY.md §2.6).
+        sel = spec.setdefault("nodeSelector", {})
+        sel.setdefault("cloud.google.com/gke-tpu-accelerator",
+                       f"tpu-{contract.slice_topology.generation.name}")
+        sel.setdefault("cloud.google.com/gke-tpu-topology",
+                       contract.slice_topology.name)
+        for c in spec["containers"]:
+            res = c.setdefault("resources", {})
+            res.setdefault("limits", {})[TPU_RESOURCE] = \
+                contract.slice_topology.chips_per_host
+        # gang group: one group per job covering every slice of the replica
+        group = f"{job.namespace}/{job.name}"
+        pod["metadata"]["labels"][POD_GROUP_LABEL] = group.replace("/", ".")
+        pod["metadata"]["annotations"]["scheduling.kubeflow.org/min-member"] = \
+            str(rs.pod_count)
+        env = contract.to_env()
+        env["KFTPU_SHARDING"] = json.dumps(job.sharding.resolve(
+            contract.slice_topology.num_chips * contract.num_slices))
+        env["KFTPU_JOB_NAME"] = job.name
+        env["KFTPU_JOB_KIND"] = job.kind
+        self._add_env(pod, env)
+        if job.kind == "MPIJob":
+            self._add_env(pod, self._mpi_env(job, rs))
+        return pod
+
+    def _build_replica_pod(self, job: TrainingJob, manifest: dict,
+                           rs: ReplicaSpec, rtype: str, index: int,
+                           name: str) -> dict:
+        pod = self._base_pod(job, manifest, rs, name, rtype, str(index))
+        if job.kind == "TFJob":
+            self._add_env(pod, {"TF_CONFIG": json.dumps(
+                self._tf_config(job, rtype, index))})
+        elif job.kind == "PyTorchJob":
+            self._add_env(pod, self._pytorch_env(job, rtype, index))
+        elif job.kind == "MPIJob":
+            self._add_env(pod, self._mpi_env(job, rs))
+        return pod
+
+    # ---------------------------------------------------- legacy contracts
+
+    def _addr(self, job: TrainingJob, pod_name: str, port: int = DEFAULT_PORT) -> str:
+        return f"{pod_name}.{_workers_service_name(job)}.{job.namespace}:{port}"
+
+    def _tf_config(self, job: TrainingJob, rtype: str, index: int) -> dict:
+        """TF_CONFIG rendered the way tf-operator does (launcher.py:68-88
+        consumes exactly this shape)."""
+        cluster: dict[str, list[str]] = {}
+        for t, rs in job.replica_specs.items():
+            if t == "TPU":
+                cluster["worker"] = [
+                    self._addr(job, _tpu_pod_name(job, s, h))
+                    for s in range(rs.num_slices)
+                    for h in range(rs.topology.num_hosts)]
+            else:
+                cluster[t.lower()] = [
+                    self._addr(job, _replica_pod_name(job, t, i))
+                    for i in range(rs.replicas)]
+        return {"cluster": cluster,
+                "task": {"type": rtype.lower(), "index": index}}
+
+    def _pytorch_env(self, job: TrainingJob, rtype: str, index: int) -> dict:
+        master = _replica_pod_name(job, "Master", 0)
+        world = job.total_pods()
+        rank = 0 if rtype == "Master" else index + 1
+        return {"MASTER_ADDR": f"{master}.{_workers_service_name(job)}.{job.namespace}",
+                "MASTER_PORT": str(DEFAULT_PORT),
+                "RANK": str(rank), "WORLD_SIZE": str(world)}
+
+    def _mpi_env(self, job: TrainingJob, rs: ReplicaSpec) -> dict:
+        """Hostlist env replacing the reference's kubectl-delivery hostfile
+        (mpi-operator.libsonnet:116-135)."""
+        if rs.is_tpu:
+            hosts = [_tpu_pod_name(job, s, h)
+                     for s in range(rs.num_slices)
+                     for h in range(rs.topology.num_hosts)]
+        else:
+            worker = job.replica_specs.get("Worker")
+            hosts = [_replica_pod_name(job, "Worker", i)
+                     for i in range(worker.replicas)] if worker else []
+        fqdn = [f"{h}.{_workers_service_name(job)}.{job.namespace}" for h in hosts]
+        return {"KFTPU_MPI_HOSTS": ",".join(fqdn),
+                "KFTPU_MPI_NUM_HOSTS": str(len(fqdn))}
+
+    # ------------------------------------------------------------- failure
+
+    def _chief_pod_name(self, job: TrainingJob) -> str:
+        for t in ("Chief", "Master", "Launcher", "Coordinator"):
+            if t in job.replica_specs:
+                return _replica_pod_name(job, t, 0)
+        if job.tpu_spec is not None:
+            return _tpu_pod_name(job, 0, 0)
+        first = sorted(job.replica_specs)[0]
+        return _replica_pod_name(job, first, 0)
+
+    def _handle_gang_failure(self, client: KubeClient, job: TrainingJob,
+                             manifest: dict, pods: list[dict],
+                             failed: list[str]) -> Result:
+        restarts = int(k8s.annotations_of(manifest).get(
+            RESTART_COUNT_ANNOTATION, "0"))
+        if restarts >= job.run_policy.backoff_limit:
+            self._set_condition(
+                client, manifest, COND_FAILED, "True", "BackoffLimitExceeded",
+                f"pods {failed} failed; gang restarted {restarts} times")
+            self._cleanup_pods(client, job, pods, policy_on_success=False)
+            return Result()
+        # Gang restart: delete every pod of the job (the slice is the failure
+        # domain), bump the restart counter, requeue to recreate.
+        for p in pods:
+            try:
+                client.delete("v1", "Pod", k8s.namespace_of(p, job.namespace),
+                              k8s.name_of(p))
+            except NotFoundError:
+                pass
+        patched = client.patch(
+            *k8s.key_of(manifest),
+            {"metadata": {"annotations": {
+                RESTART_COUNT_ANNOTATION: str(restarts + 1)}}})
+        self._set_condition(
+            client, patched, COND_RESTARTING, "True", "GangRestart",
+            f"pods {failed} failed; restarting whole gang "
+            f"({restarts + 1}/{job.run_policy.backoff_limit})")
+        return Result(requeue=True)
+
+    def _cleanup_pods(self, client: KubeClient, job: TrainingJob,
+                      pods: list[dict], policy_on_success: bool) -> None:
+        policy = job.run_policy.clean_pod_policy
+        if policy == CLEAN_POD_NONE:
+            return
+        for p in pods:
+            phase = p.get("status", {}).get("phase")
+            if policy == CLEAN_POD_RUNNING and phase not in (POD_RUNNING, None,
+                                                             "Pending"):
+                continue
+            if policy not in (CLEAN_POD_ALL, CLEAN_POD_RUNNING):
+                continue
+            try:
+                client.delete("v1", "Pod", k8s.namespace_of(p, job.namespace),
+                              k8s.name_of(p))
+            except NotFoundError:
+                pass
+
+    # --------------------------------------------------------------- status
+
+    def _set_condition(self, client: KubeClient, manifest: dict, ctype: str,
+                       status: str, reason: str, message: str) -> None:
+        fresh = client.get_or_none(*k8s.key_of(manifest)) or manifest
+        existing = k8s.get_condition(fresh, ctype)
+        if existing and existing.get("status") == status and \
+                existing.get("reason") == reason and \
+                existing.get("message") == message:
+            manifest["status"] = fresh.get("status", {})
+            return  # idempotent: no write, no MODIFIED event, no requeue loop
+        k8s.set_condition(fresh, k8s.Condition(ctype, status, reason, message))
+        client.update_status(fresh)
+        manifest["status"] = fresh["status"]
+
+    def _update_replica_statuses(self, client: KubeClient, manifest: dict,
+                                 job: TrainingJob, pods: list[dict]) -> None:
+        counts: dict[str, dict[str, int]] = {}
+        for p in pods:
+            rtype = k8s.labels_of(p).get(REPLICA_TYPE_LABEL, "unknown")
+            phase = p.get("status", {}).get("phase", "Pending")
+            bucket = {"Running": "active", "Pending": "active",
+                      "Succeeded": "succeeded", "Failed": "failed"}.get(
+                          phase, "active")
+            counts.setdefault(rtype, {"active": 0, "succeeded": 0,
+                                      "failed": 0})[bucket] += 1
+        fresh = client.get_or_none(*k8s.key_of(manifest))
+        if fresh is not None and \
+                fresh.get("status", {}).get("replicaStatuses") != counts:
+            fresh.setdefault("status", {})["replicaStatuses"] = counts
+            client.update_status(fresh)
+
+
+def all_reconcilers() -> list[TrainingJobReconciler]:
+    return [TrainingJobReconciler(k) for k in
+            ("TPUJob", "TFJob", "PyTorchJob", "MPIJob")]

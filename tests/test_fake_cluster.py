@@ -1,0 +1,179 @@
+"""Tests for the in-memory apiserver + gang scheduler (the envtest analog)."""
+
+import pytest
+
+from kubeflow_tpu.api import k8s
+from kubeflow_tpu.cluster import (AlreadyExistsError, ConflictError,
+                                  FakeCluster, NotFoundError)
+from kubeflow_tpu.cluster.apply import apply_manifests, delete_manifests
+from kubeflow_tpu.cluster.fake import POD_GROUP_LABEL, TPU_RESOURCE
+
+
+def make_pod(name, ns="default", chips=0, group=None, min_member=None,
+             node_selector=None):
+    pod = k8s.make("v1", "Pod", name, ns)
+    container = {"name": "main", "image": "img"}
+    if chips:
+        container["resources"] = {"limits": {TPU_RESOURCE: chips}}
+    pod["spec"] = {"containers": [container]}
+    if node_selector:
+        pod["spec"]["nodeSelector"] = node_selector
+    if group:
+        pod["metadata"]["labels"] = {POD_GROUP_LABEL: group}
+        pod["metadata"]["annotations"] = {
+            "scheduling.kubeflow.org/min-member": str(min_member or 1)}
+    return pod
+
+
+class TestCrud:
+    def test_create_get_uid_rv(self):
+        c = FakeCluster()
+        c.create(k8s.make("v1", "ConfigMap", "cm", "ns1"))
+        obj = c.get("v1", "ConfigMap", "ns1", "cm")
+        assert obj["metadata"]["uid"].startswith("uid-")
+        with pytest.raises(AlreadyExistsError):
+            c.create(k8s.make("v1", "ConfigMap", "cm", "ns1"))
+
+    def test_update_conflict(self):
+        c = FakeCluster()
+        c.create(k8s.make("v1", "ConfigMap", "cm"))
+        a = c.get("v1", "ConfigMap", "default", "cm")
+        b = c.get("v1", "ConfigMap", "default", "cm")
+        a["data"] = {"x": "1"}
+        c.update(a)
+        b["data"] = {"x": "2"}
+        with pytest.raises(ConflictError):
+            c.update(b)
+
+    def test_status_subresource_preserves_spec(self):
+        c = FakeCluster()
+        c.create(k8s.make("v1", "Pod", "p", spec={"containers": []}))
+        p = c.get("v1", "Pod", "default", "p")
+        p["status"] = {"phase": "Running"}
+        del p["spec"]
+        c.update_status(p)
+        stored = c.get("v1", "Pod", "default", "p")
+        assert stored["spec"] == {"containers": []}
+        assert stored["status"]["phase"] == "Running"
+
+    def test_list_selector_and_namespace(self):
+        c = FakeCluster()
+        c.create(k8s.make("v1", "Pod", "a", "ns1", labels={"app": "x"}))
+        c.create(k8s.make("v1", "Pod", "b", "ns1", labels={"app": "y"}))
+        c.create(k8s.make("v1", "Pod", "a", "ns2", labels={"app": "x"}))
+        assert len(c.list("v1", "Pod")) == 3
+        assert len(c.list("v1", "Pod", "ns1")) == 2
+        assert len(c.list("v1", "Pod", selector={"app": "x"})) == 2
+
+    def test_cascade_delete(self):
+        c = FakeCluster()
+        owner = c.create(k8s.make("batch/v1", "Job", "j", "ns"))
+        child = k8s.make("v1", "Pod", "p", "ns")
+        k8s.set_owner(child, owner)
+        c.create(child)
+        grandchild = k8s.make("v1", "ConfigMap", "g", "ns")
+        k8s.set_owner(grandchild, c.get("v1", "Pod", "ns", "p"))
+        c.create(grandchild)
+        c.delete("batch/v1", "Job", "ns", "j")
+        with pytest.raises(NotFoundError):
+            c.get("v1", "Pod", "ns", "p")
+        with pytest.raises(NotFoundError):
+            c.get("v1", "ConfigMap", "ns", "g")
+
+    def test_watch_delivers_filtered(self):
+        c = FakeCluster()
+        w = c.watch("v1", "Pod")
+        c.create(k8s.make("v1", "Pod", "p"))
+        c.create(k8s.make("v1", "Service", "s"))
+        ev = w.get(timeout=0.1)
+        assert ev.type == "ADDED" and ev.obj["kind"] == "Pod"
+        assert w.get(timeout=0.01) is None
+
+    def test_apply_create_or_update(self):
+        c = FakeCluster()
+        cm = k8s.make("v1", "ConfigMap", "cm")
+        cm["data"] = {"a": "1"}
+        c.apply(cm)
+        cm2 = k8s.make("v1", "ConfigMap", "cm")
+        cm2["data"] = {"a": "2"}
+        c.apply(cm2)
+        assert c.get("v1", "ConfigMap", "default", "cm")["data"] == {"a": "2"}
+
+
+class TestGangScheduling:
+    def test_gang_binds_all_or_nothing(self):
+        c = FakeCluster(auto_run=False)
+        c.add_tpu_slice_nodes("v5e-8")  # 2 nodes x 4 chips
+        sel = {"cloud.google.com/gke-tpu-topology": "v5e-8"}
+        for i in range(2):
+            c.create(make_pod(f"w{i}", chips=4, group="g1", min_member=3,
+                              node_selector=sel))
+        c.schedule()
+        # only 2 of min-member 3 exist: nothing binds
+        assert all(not p["spec"].get("nodeName") for p in c.list("v1", "Pod"))
+        c.create(make_pod("w2", chips=4, group="g1", min_member=3,
+                          node_selector=sel))
+        c.schedule()
+        # 3 pods x 4 chips > 8 chips capacity: still nothing binds (atomic)
+        assert all(not p["spec"].get("nodeName") for p in c.list("v1", "Pod"))
+
+    def test_gang_binds_when_capacity_fits(self):
+        c = FakeCluster(auto_run=False)
+        c.add_tpu_slice_nodes("v5e-8")
+        for i in range(2):
+            c.create(make_pod(f"w{i}", chips=4, group="g1", min_member=2))
+        c.schedule()
+        nodes = {p["spec"].get("nodeName") for p in c.list("v1", "Pod")}
+        assert len(nodes) == 2 and None not in nodes  # one pod per host
+
+    def test_singles_schedule_independently(self):
+        c = FakeCluster(auto_run=False)
+        c.add_node("cpu-1", {"cpu": 4})
+        c.create(make_pod("solo"))
+        c.schedule()
+        assert c.get("v1", "Pod", "default", "solo")["spec"]["nodeName"] == "cpu-1"
+
+    def test_tick_runs_pods(self):
+        c = FakeCluster()
+        c.add_node("cpu-1", {"cpu": 4})
+        c.create(make_pod("solo"))
+        c.tick()
+        assert c.get("v1", "Pod", "default", "solo")["status"]["phase"] == "Running"
+
+    def test_node_selector_respected(self):
+        c = FakeCluster(auto_run=False)
+        c.add_node("wrong", {TPU_RESOURCE: 8})
+        c.create(make_pod("p", chips=4,
+                          node_selector={"cloud.google.com/gke-tpu-topology": "v5e-8"}))
+        c.schedule()
+        assert not c.get("v1", "Pod", "default", "p")["spec"].get("nodeName")
+
+
+class TestApplyEngine:
+    def test_apply_ordering_and_namespace_defaulting(self):
+        c = FakeCluster()
+        objs = [k8s.make("apps/v1", "Deployment", "d"),
+                k8s.make("v1", "Namespace", "kubeflow")]
+        res = apply_manifests(c, objs, namespace="kubeflow", sleep=lambda s: None)
+        assert res.ok
+        d = c.get("apps/v1", "Deployment", "kubeflow", "d")
+        assert d["metadata"]["namespace"] == "kubeflow"
+
+    def test_apply_retry_then_failure_recorded(self):
+        c = FakeCluster()
+
+        class Boom(FakeCluster):
+            def apply(self, obj):
+                raise RuntimeError("apiserver down")
+
+        res = apply_manifests(Boom(), [k8s.make("v1", "ConfigMap", "cm")],
+                              attempts=2, sleep=lambda s: None)
+        assert not res.ok and len(res.failed) == 1
+
+    def test_delete_manifests(self):
+        c = FakeCluster()
+        objs = [k8s.make("v1", "Namespace", "ns"),
+                k8s.make("v1", "ConfigMap", "cm", "ns")]
+        apply_manifests(c, objs, sleep=lambda s: None)
+        delete_manifests(c, objs)
+        assert c.list("v1", "ConfigMap") == []

@@ -1,0 +1,216 @@
+"""Envtest-style tests for the training-job operator.
+
+The pattern mirrors the reference's controller tests against envtest
+(profile_controller_test.go reconcile-assertion pattern, SURVEY.md §4 tier 2),
+with the scheduler modeled too so gang semantics are testable (the reference
+could only exercise kube-batch E2E).
+"""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.api import k8s
+from kubeflow_tpu.cluster import FakeCluster
+from kubeflow_tpu.cluster.fake import POD_GROUP_LABEL, TPU_RESOURCE
+from kubeflow_tpu.controllers.runtime import Manager
+from kubeflow_tpu.controllers.tpujob import (JAX_COORD_PORT,
+                                             TrainingJobReconciler)
+
+
+def tpujob_manifest(name="train", topology="v5e-8", num_slices=1, **spec_extra):
+    return {
+        "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": name, "namespace": "kubeflow"},
+        "spec": {
+            "replicaSpecs": {
+                "TPU": {"tpuTopology": topology, "numSlices": num_slices,
+                        "template": {"spec": {"containers": [
+                            {"name": "jax", "image": "trainer:v1"}]}}},
+            },
+            "runPolicy": {"backoffLimit": 2},
+            **spec_extra,
+        },
+    }
+
+
+@pytest.fixture
+def env():
+    cluster = FakeCluster()
+    cluster.add_tpu_slice_nodes("v5e-8")
+    mgr = Manager(cluster)
+    ctrl = mgr.add(TrainingJobReconciler("TPUJob"))
+    return cluster, mgr, ctrl
+
+
+def drive(cluster, mgr, ticks=3):
+    for _ in range(ticks):
+        mgr.run_pending()
+        cluster.tick()
+    mgr.run_pending()
+
+
+class TestTPUJobReconcile:
+    def test_creates_gang_and_service(self, env):
+        cluster, mgr, _ = env
+        cluster.create(tpujob_manifest())
+        mgr.run_pending()
+        pods = cluster.list("v1", "Pod", "kubeflow")
+        assert len(pods) == 2  # v5e-8 = 2 hosts
+        names = {k8s.name_of(p) for p in pods}
+        assert names == {"train-worker-0-0", "train-worker-0-1"}
+        svc = cluster.get("v1", "Service", "kubeflow", "train-workers")
+        assert svc["spec"]["clusterIP"] == "None"
+        for p in pods:
+            assert p["metadata"]["labels"][POD_GROUP_LABEL]
+            limits = p["spec"]["containers"][0]["resources"]["limits"]
+            assert limits[TPU_RESOURCE] == 4
+            env_map = {e["name"]: e["value"]
+                       for e in p["spec"]["containers"][0]["env"]}
+            assert env_map["KFTPU_NUM_PROCESSES"] == "2"
+            assert f":{JAX_COORD_PORT}" in env_map["KFTPU_COORDINATOR_ADDRESS"]
+            sharding = json.loads(env_map["KFTPU_SHARDING"])
+            assert sharding["data"] == 8
+
+    def test_running_condition_after_schedule(self, env):
+        cluster, mgr, _ = env
+        cluster.create(tpujob_manifest())
+        drive(cluster, mgr)
+        job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob", "kubeflow", "train")
+        assert k8s.condition_true(job, "Running")
+        assert job["status"]["replicaStatuses"]["tpu"]["active"] == 2
+
+    def test_chief_success_completes_job_and_cleans_running_pods(self, env):
+        cluster, mgr, _ = env
+        cluster.create(tpujob_manifest())
+        drive(cluster, mgr)
+        cluster.set_pod_phase("kubeflow", "train-worker-0-0", "Succeeded")
+        mgr.run_pending()
+        job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob", "kubeflow", "train")
+        assert k8s.condition_true(job, "Succeeded")
+        # cleanPodPolicy=Running (default): the still-running worker is reaped
+        remaining = {k8s.name_of(p) for p in cluster.list("v1", "Pod", "kubeflow")}
+        assert "train-worker-0-1" not in remaining
+
+    def test_worker_failure_restarts_whole_gang(self, env):
+        cluster, mgr, _ = env
+        cluster.create(tpujob_manifest())
+        drive(cluster, mgr)
+        cluster.fail_pod("kubeflow", "train-worker-0-1")
+        mgr.run_pending()
+        job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob", "kubeflow", "train")
+        assert k8s.condition_true(job, "Restarting")
+        assert job["metadata"]["annotations"][
+            "kubeflow.org/gang-restart-count"] == "1"
+        # the whole gang was recreated (fresh pods, unscheduled)
+        pods = cluster.list("v1", "Pod", "kubeflow")
+        assert len(pods) == 2
+        assert all(p.get("status", {}).get("phase", "Pending") == "Pending" or
+                   not p["spec"].get("nodeName") for p in pods)
+
+    def test_backoff_limit_fails_job(self, env):
+        cluster, mgr, _ = env
+        cluster.create(tpujob_manifest())
+        for _ in range(3):
+            drive(cluster, mgr)
+            pods = cluster.list("v1", "Pod", "kubeflow")
+            if not pods:
+                break
+            cluster.fail_pod("kubeflow", k8s.name_of(pods[-1]))
+            mgr.run_pending()
+        job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob", "kubeflow", "train")
+        assert k8s.condition_true(job, "Failed")
+        reason = k8s.get_condition(job, "Failed")["reason"]
+        assert reason == "BackoffLimitExceeded"
+
+    def test_job_delete_cascades_to_pods(self, env):
+        cluster, mgr, _ = env
+        cluster.create(tpujob_manifest())
+        mgr.run_pending()
+        assert len(cluster.list("v1", "Pod", "kubeflow")) == 2
+        cluster.delete("tpu.kubeflow.org/v1alpha1", "TPUJob", "kubeflow", "train")
+        assert cluster.list("v1", "Pod", "kubeflow") == []
+
+    def test_multislice_contract(self, env):
+        cluster, mgr, _ = env
+        cluster.add_tpu_slice_nodes("v5e-8", pool="pool2")
+        cluster.create(tpujob_manifest(name="ms", num_slices=2))
+        mgr.run_pending()
+        pods = cluster.list("v1", "Pod", "kubeflow")
+        assert len(pods) == 4
+        env_map = {}
+        for p in pods:
+            e = {x["name"]: x["value"] for x in p["spec"]["containers"][0]["env"]}
+            env_map[k8s.name_of(p)] = e
+        assert env_map["ms-worker-1-1"]["KFTPU_PROCESS_ID"] == "3"
+        assert env_map["ms-worker-1-1"]["KFTPU_SLICE_ID"] == "1"
+        assert env_map["ms-worker-0-0"]["KFTPU_NUM_PROCESSES"] == "4"
+        coords = {e["KFTPU_COORDINATOR_ADDRESS"] for e in env_map.values()}
+        assert len(coords) == 1  # one coordinator for the whole job
+
+
+class TestLegacyKinds:
+    def test_tfjob_renders_tf_config(self):
+        cluster = FakeCluster()
+        mgr = Manager(cluster)
+        mgr.add(TrainingJobReconciler("TFJob"))
+        cluster.create({
+            "apiVersion": "kubeflow.org/v1beta2", "kind": "TFJob",
+            "metadata": {"name": "tf", "namespace": "kubeflow"},
+            "spec": {"tfReplicaSpecs": {
+                "Chief": {"replicas": 1, "template": {
+                    "spec": {"containers": [{"name": "tf", "image": "i"}]}}},
+                "Worker": {"replicas": 2, "template": {
+                    "spec": {"containers": [{"name": "tf", "image": "i"}]}}},
+            }},
+        })
+        mgr.run_pending()
+        pods = cluster.list("v1", "Pod", "kubeflow")
+        assert len(pods) == 3
+        chief = cluster.get("v1", "Pod", "kubeflow", "tf-chief-0")
+        cfg = json.loads({e["name"]: e["value"] for e in
+                          chief["spec"]["containers"][0]["env"]}["TF_CONFIG"])
+        assert cfg["task"] == {"type": "chief", "index": 0}
+        assert len(cfg["cluster"]["worker"]) == 2
+        assert cfg["cluster"]["chief"][0].startswith("tf-chief-0.tf-workers.kubeflow")
+
+    def test_pytorchjob_renders_master_env(self):
+        cluster = FakeCluster()
+        mgr = Manager(cluster)
+        mgr.add(TrainingJobReconciler("PyTorchJob"))
+        cluster.create({
+            "apiVersion": "kubeflow.org/v1beta2", "kind": "PyTorchJob",
+            "metadata": {"name": "pt", "namespace": "kubeflow"},
+            "spec": {"pytorchReplicaSpecs": {
+                "Master": {"replicas": 1, "template": {
+                    "spec": {"containers": [{"name": "t", "image": "i"}]}}},
+                "Worker": {"replicas": 3, "template": {
+                    "spec": {"containers": [{"name": "t", "image": "i"}]}}},
+            }},
+        })
+        mgr.run_pending()
+        w2 = cluster.get("v1", "Pod", "kubeflow", "pt-worker-2")
+        env_map = {e["name"]: e["value"]
+                   for e in w2["spec"]["containers"][0]["env"]}
+        assert env_map["MASTER_ADDR"].startswith("pt-master-0.")
+        assert env_map["RANK"] == "3" and env_map["WORLD_SIZE"] == "4"
+
+    def test_mpijob_tpu_shorthand_renders_hostlist(self):
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-16")
+        mgr = Manager(cluster)
+        mgr.add(TrainingJobReconciler("MPIJob"))
+        cluster.create({
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "MPIJob",
+            "metadata": {"name": "hvd", "namespace": "kubeflow"},
+            "spec": {"tpuTopology": "v5e-16",
+                     "template": {"spec": {"containers": [
+                         {"name": "m", "image": "i"}]}}},
+        })
+        mgr.run_pending()
+        pods = cluster.list("v1", "Pod", "kubeflow")
+        assert len(pods) == 4
+        env_map = {e["name"]: e["value"]
+                   for e in pods[0]["spec"]["containers"][0]["env"]}
+        assert env_map["KFTPU_MPI_NUM_HOSTS"] == "4"
+        assert env_map["KFTPU_MPI_HOSTS"].count(",") == 3

@@ -1,0 +1,80 @@
+"""Benchmark: ResNet-50 synthetic-ImageNet training throughput on TPU.
+
+The vehicle matches the reference's headline benchmark machinery — the
+tf_cnn_benchmarks ResNet-50 TFJob (tf-controller-examples/tf-cnn/;
+kubeflow/examples/prototypes/tf-job-simple-v1.jsonnet runs it with synthetic
+data). The reference publishes no numbers (BASELINE.md), so the baseline is
+our own recorded first-light figure; vs_baseline = value / BASELINE_IMG_S.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+# First-light measurement on one TPU v5e chip (bf16, batch 256, synthetic
+# data, this repo @ milestone 3). Later rounds must beat it.
+BASELINE_IMG_S = 1000.0
+
+
+def main() -> int:
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    import optax
+
+    from kubeflow_tpu.models import resnet as R
+    from kubeflow_tpu.parallel.mesh import build_mesh
+    from kubeflow_tpu.runtime.trainstep import TrainStepBuilder
+
+    n_chips = len(jax.devices())
+    if on_tpu:
+        batch_per_chip, image_size, steps, warmup = 256, 224, 12, 3
+    else:  # CPU smoke mode so the script stays runnable anywhere
+        batch_per_chip, image_size, steps, warmup = 8, 64, 4, 1
+    global_batch = batch_per_chip * n_chips
+
+    model = R.resnet50(num_classes=1000)
+    builder = TrainStepBuilder(
+        mesh=build_mesh(),
+        loss_fn=R.make_loss_fn(model),
+        optimizer=optax.chain(optax.clip_by_global_norm(1.0),
+                              optax.sgd(0.1, momentum=0.9)),
+    )
+    state = builder.init(R.init_fn(model, image_size=image_size),
+                         jax.random.PRNGKey(0))
+    step_fn = builder.build()
+    batch = builder.place_batch(
+        R.synthetic_batch(jax.random.PRNGKey(1), global_batch, image_size))
+
+    for _ in range(warmup):
+        state, metrics = step_fn(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    img_s = global_batch * steps / dt
+    img_s_chip = img_s / n_chips
+    print(json.dumps({
+        "metric": "resnet50_synthetic_imagenet_train_throughput",
+        "value": round(img_s_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_s_chip / BASELINE_IMG_S, 3),
+    }))
+    print(f"# platform={platform} chips={n_chips} batch={global_batch} "
+          f"image={image_size} steps={steps} wall={dt:.2f}s "
+          f"loss={float(metrics['loss']):.3f}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,13 @@ from typing import Any, Callable, Iterable, Optional
 GROUP = "kubeflow.org"
 TPU_GROUP = "tpu.kubeflow.org"
 
+# Kinds that are not namespaced (shared by every KubeClient implementation).
+CLUSTER_SCOPED_KINDS = {
+    "Namespace", "Node", "CustomResourceDefinition", "ClusterRole",
+    "ClusterRoleBinding", "MutatingWebhookConfiguration",
+    "ValidatingWebhookConfiguration", "PersistentVolume", "Profile",
+}
+
 # ---------------------------------------------------------------------------
 # GVK / naming helpers
 # ---------------------------------------------------------------------------

@@ -37,11 +37,10 @@ def apply_manifests(
     sleep=None,
 ) -> ApplyResult:
     """Apply in dependency order; per-object constant-backoff retry."""
-    from .fake import CLUSTER_SCOPED_KINDS
     result = ApplyResult()
     for obj in k8s.sort_for_apply(objs):
         if (namespace and "namespace" not in obj.get("metadata", {})
-                and obj.get("kind") not in CLUSTER_SCOPED_KINDS):
+                and obj.get("kind") not in k8s.CLUSTER_SCOPED_KINDS):
             k8s.set_namespace(obj, namespace)
         key = k8s.key_of(obj)
         try:

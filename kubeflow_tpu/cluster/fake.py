@@ -25,7 +25,6 @@ Modeled behavior:
 from __future__ import annotations
 
 import copy
-import itertools
 import threading
 from typing import Callable, Optional
 
@@ -36,11 +35,8 @@ from .client import (ADDED, AlreadyExistsError, ConflictError, DELETED,
 POD_GROUP_LABEL = "scheduling.kubeflow.org/pod-group"
 TPU_RESOURCE = "google.com/tpu"
 
-CLUSTER_SCOPED_KINDS = {
-    "Namespace", "Node", "CustomResourceDefinition", "ClusterRole",
-    "ClusterRoleBinding", "MutatingWebhookConfiguration",
-    "ValidatingWebhookConfiguration", "PersistentVolume", "Profile",
-}
+# scope table lives in the shared API layer; re-exported for compatibility
+CLUSTER_SCOPED_KINDS = k8s.CLUSTER_SCOPED_KINDS
 
 
 def _resources_of(pod: dict) -> dict[str, float]:
@@ -58,8 +54,8 @@ class FakeCluster(KubeClient):
     def __init__(self, auto_schedule: bool = True, auto_run: bool = True):
         self._objects: dict[tuple, dict] = {}
         self._watches: list[Watch] = []
-        self._uid = itertools.count(1)
-        self._rv = itertools.count(1)
+        self._uid_n = 0
+        self._rv_n = 0
         self._lock = threading.RLock()
         # auto_schedule: run the scheduler inside tick(); auto_run: scheduled
         # pods transition to Running on the next tick (tests can disable both).
@@ -67,6 +63,34 @@ class FakeCluster(KubeClient):
         self.auto_run = auto_run
         # hook for tests: called with each pod when it starts Running
         self.on_pod_running: Optional[Callable[[dict], None]] = None
+
+    # ------------------------------------------------------------- snapshot
+
+    def to_snapshot(self) -> dict:
+        """Serializable cluster state (used by kfctl to persist the simulated
+        cluster across CLI invocations). Read-only: does not advance counters."""
+        with self._lock:
+            return {"objects": [copy.deepcopy(o) for o in self._objects.values()],
+                    "counters": {"uid": self._uid_n, "rv": self._rv_n}}
+
+    @classmethod
+    def from_snapshot(cls, snap: dict, **kwargs) -> "FakeCluster":
+        c = cls(**kwargs)
+        for obj in snap.get("objects", []):
+            key = c._key(obj)
+            c._objects[key] = copy.deepcopy(obj)
+        counters = snap.get("counters", {})
+        c._uid_n = counters.get("uid", 0)
+        c._rv_n = counters.get("rv", 0)
+        return c
+
+    def _next_uid(self) -> str:
+        self._uid_n += 1
+        return f"uid-{self._uid_n}"
+
+    def _next_rv(self) -> str:
+        self._rv_n += 1
+        return str(self._rv_n)
 
     # ------------------------------------------------------------------ CRUD
 
@@ -86,8 +110,8 @@ class FakeCluster(KubeClient):
             meta = obj.setdefault("metadata", {})
             if key[1] not in CLUSTER_SCOPED_KINDS:
                 meta.setdefault("namespace", "default")
-            meta["uid"] = f"uid-{next(self._uid)}"
-            meta["resourceVersion"] = str(next(self._rv))
+            meta["uid"] = self._next_uid()
+            meta["resourceVersion"] = self._next_rv()
             self._objects[key] = obj
             self._broadcast(WatchEvent(ADDED, copy.deepcopy(obj)))
             return copy.deepcopy(obj)
@@ -128,7 +152,7 @@ class FakeCluster(KubeClient):
                 )
         obj = copy.deepcopy(obj)
         obj.setdefault("metadata", {})["uid"] = existing["metadata"]["uid"]
-        obj["metadata"]["resourceVersion"] = str(next(self._rv))
+        obj["metadata"]["resourceVersion"] = self._next_rv()
         self._objects[key] = obj
         self._broadcast(WatchEvent(MODIFIED, copy.deepcopy(obj)))
         return copy.deepcopy(obj)
@@ -287,7 +311,7 @@ class FakeCluster(KubeClient):
                 stored = self._objects[self._key(pod)]
                 stored.setdefault("spec", {})["nodeName"] = node_name
                 stored.setdefault("status", {}).setdefault("phase", "Pending")
-                stored["metadata"]["resourceVersion"] = str(next(self._rv))
+                stored["metadata"]["resourceVersion"] = self._next_rv()
                 self._broadcast(WatchEvent(MODIFIED, copy.deepcopy(stored)))
                 for r, v in _resources_of(pod).items():
                     free[node_name][r] = free[node_name].get(r, 0.0) - v

@@ -190,6 +190,8 @@ class Controller:
 
     def stop(self) -> None:
         self._stop.set()
+        for w in self._watches:
+            w.close()  # detach from the server so events stop accumulating
 
 
 class Manager:

@@ -110,15 +110,18 @@ class TrainingJobReconciler(Reconciler):
         phases = {k8s.name_of(p): p.get("status", {}).get("phase", "Pending")
                   for p in pods}
         chief = self._chief_pod_name(job)
-        failed = [n for n, ph in phases.items() if ph == POD_FAILED]
-        if failed:
-            return self._handle_gang_failure(client, job, manifest, pods, failed)
-
+        # chief success wins over concurrent worker failures: a completed job
+        # must not be gang-restarted by a non-chief exiting non-zero during
+        # shutdown
         if phases.get(chief) == POD_SUCCEEDED:
             self._set_condition(client, manifest, COND_SUCCEEDED, "True",
                                 "JobSucceeded", f"chief pod {chief} succeeded")
-            self._cleanup_pods(client, job, pods, policy_on_success=True)
+            self._cleanup_pods(client, job, pods)
             return Result()
+
+        failed = [n for n, ph in phases.items() if ph == POD_FAILED]
+        if failed:
+            return self._handle_gang_failure(client, job, manifest, pods, failed)
 
         running = sum(1 for ph in phases.values() if ph == POD_RUNNING)
         if running == job.total_pods() and running > 0:
@@ -182,9 +185,11 @@ class TrainingJobReconciler(Reconciler):
         pod = copy.deepcopy(rs.template) or {}
         pod.setdefault("spec", {}).setdefault("containers",
                                               [{"name": "main", "image": "main"}])
-        labels = {**job.selector(), REPLICA_TYPE_LABEL: rtype.lower(),
-                  REPLICA_INDEX_LABEL: str(index),
-                  **(pod.get("metadata", {}).get("labels") or {})}
+        # operator-required labels LAST: a user template must not be able to
+        # override the selector / replica identity labels
+        labels = {**(pod.get("metadata", {}).get("labels") or {}),
+                  **job.selector(), REPLICA_TYPE_LABEL: rtype.lower(),
+                  REPLICA_INDEX_LABEL: str(index)}
         meta = {"name": name, "namespace": job.namespace, "labels": labels,
                 "annotations": dict(pod.get("metadata", {}).get("annotations") or {})}
         pod = {"apiVersion": "v1", "kind": "Pod", "metadata": meta,
@@ -313,7 +318,7 @@ class TrainingJobReconciler(Reconciler):
             self._set_condition(
                 client, manifest, COND_FAILED, "True", "BackoffLimitExceeded",
                 f"pods {failed} failed; gang restarted {restarts} times")
-            self._cleanup_pods(client, job, pods, policy_on_success=False)
+            self._cleanup_pods(client, job, pods)
             return Result()
         # Gang restart: delete every pod of the job (the slice is the failure
         # domain), bump the restart counter, requeue to recreate.
@@ -334,7 +339,9 @@ class TrainingJobReconciler(Reconciler):
         return Result(requeue=True)
 
     def _cleanup_pods(self, client: KubeClient, job: TrainingJob,
-                      pods: list[dict], policy_on_success: bool) -> None:
+                      pods: list[dict]) -> None:
+        """Reap pods per cleanPodPolicy: Running keeps terminal pods for
+        debugging, All reaps everything, None keeps everything."""
         policy = job.run_policy.clean_pod_policy
         if policy == CLEAN_POD_NONE:
             return

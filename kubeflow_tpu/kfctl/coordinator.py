@@ -1,0 +1,254 @@
+"""The coordinator: fans kfctl verbs to the platform driver + manifest engine.
+
+Reference: bootstrap/pkg/kfapp/coordinator/coordinator.go — NewKfApp (:192,
+flags→KfDef), LoadKfApp (:337, re-read app.yaml), Apply/Generate/Init
+(:407,524,580 fan out to platform + package managers). The package manager
+here is the programmatic manifest registry (manifests/) instead of ksonnet.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import Optional
+
+from ..api import k8s
+from ..api.kfdef import (KfDef, KfDefSpec, RESOURCE_ALL, RESOURCE_K8S,
+                         RESOURCE_PLATFORM)
+from ..cluster import FakeCluster, KubeClient
+from ..cluster.apply import apply_manifests, delete_manifests
+from ..manifests import build_component, component_names
+from ..utils import yamlio
+from .platforms import get_platform
+
+log = logging.getLogger(__name__)
+
+MANIFESTS_DIR = "manifests"
+CLUSTER_STATE_FILE = "cluster-state.json"
+
+
+class Coordinator:
+    """One deployment app (app_dir with app.yaml + generated manifests)."""
+
+    def __init__(self, kfdef: KfDef, client: Optional[KubeClient] = None):
+        self.kfdef = kfdef
+        self.platform = get_platform(kfdef.spec.platform)
+        self._client = client
+
+    # -- construction (NewKfApp / LoadKfApp analogs) ------------------------
+
+    @classmethod
+    def new(cls, app_dir: str, **spec_kwargs) -> "Coordinator":
+        name = os.path.basename(os.path.abspath(app_dir))
+        kfdef = KfDef(name=name,
+                      spec=KfDefSpec(app_dir=os.path.abspath(app_dir),
+                                     **spec_kwargs))
+        kfdef.validate()
+        return cls(kfdef)
+
+    @classmethod
+    def load(cls, app_dir: str) -> "Coordinator":
+        return cls(KfDef.load(os.path.abspath(app_dir)))
+
+    # -- the simulated-cluster client (persisted across CLI invocations) ----
+
+    @property
+    def client(self) -> KubeClient:
+        if self._client is None:
+            path = os.path.join(self.kfdef.spec.app_dir, CLUSTER_STATE_FILE)
+            if os.path.exists(path):
+                with open(path) as f:
+                    self._client = FakeCluster.from_snapshot(json.load(f))
+            else:
+                self._client = FakeCluster()
+        return self._client
+
+    def _persist_client(self) -> None:
+        if isinstance(self._client, FakeCluster):
+            path = os.path.join(self.kfdef.spec.app_dir, CLUSTER_STATE_FILE)
+            with open(path, "w") as f:
+                json.dump(self._client.to_snapshot(), f)
+
+    # -- verbs --------------------------------------------------------------
+
+    def init(self, resources: str = RESOURCE_ALL) -> None:
+        os.makedirs(self.kfdef.spec.app_dir, exist_ok=True)
+        if resources in (RESOURCE_ALL, RESOURCE_PLATFORM):
+            self.platform.init(self.kfdef)
+        self.kfdef.set_condition("Initialized", "True", reason="InitDone")
+        self.kfdef.save()
+        log.info("initialized app at %s (platform=%s, %d components)",
+                 self.kfdef.spec.app_dir, self.kfdef.spec.platform,
+                 len(self.kfdef.spec.components))
+
+    def generate(self, resources: str = RESOURCE_ALL) -> list[str]:
+        """Render every component's manifests to manifests/<name>.yaml
+        (the ksonnet.Generate / componentAdd analog, ksonnet.go:316)."""
+        written = []
+        if resources in (RESOURCE_ALL, RESOURCE_PLATFORM):
+            self.platform.generate(self.kfdef)
+        if resources in (RESOURCE_ALL, RESOURCE_K8S):
+            out_dir = os.path.join(self.kfdef.spec.app_dir, MANIFESTS_DIR)
+            os.makedirs(out_dir, exist_ok=True)
+            for comp in self.kfdef.spec.components:
+                objs = build_component(comp, self.kfdef.spec.params_for(comp))
+                path = os.path.join(out_dir, f"{comp}.yaml")
+                with open(path, "w") as f:
+                    f.write(yamlio.dump_all(objs))
+                written.append(path)
+        self.kfdef.set_condition("Generated", "True", reason="GenerateDone")
+        self.kfdef.save()
+        return written
+
+    def _load_generated(self) -> list[dict]:
+        out_dir = os.path.join(self.kfdef.spec.app_dir, MANIFESTS_DIR)
+        if not os.path.isdir(out_dir):
+            raise FileNotFoundError(
+                f"{out_dir} not found — run `kfctl generate` first")
+        objs: list[dict] = []
+        for comp in self.kfdef.spec.components:
+            path = os.path.join(out_dir, f"{comp}.yaml")
+            if os.path.exists(path):
+                with open(path) as f:
+                    objs.extend(yamlio.load_all(f.read()))
+        return objs
+
+    def apply(self, resources: str = RESOURCE_ALL,
+              sleep=None) -> "ApplyOutcome":
+        if resources in (RESOURCE_ALL, RESOURCE_PLATFORM):
+            self.platform.apply(self.kfdef)
+        outcome = ApplyOutcome()
+        if resources in (RESOURCE_ALL, RESOURCE_K8S):
+            ns = k8s.make("v1", "Namespace", self.kfdef.spec.namespace)
+            objs = [ns, *self._load_generated()]
+            result = apply_manifests(self.client, objs,
+                                     namespace=self.kfdef.spec.namespace,
+                                     sleep=sleep)
+            outcome.applied = len(result.applied)
+            outcome.failed = list(result.failed)
+            self._persist_client()
+        status = "True" if not outcome.failed else "False"
+        self.kfdef.set_condition("Available", status, reason="ApplyDone",
+                                 message=f"{outcome.applied} objects applied")
+        self.kfdef.save()
+        return outcome
+
+    def delete(self, resources: str = RESOURCE_ALL) -> None:
+        if resources in (RESOURCE_ALL, RESOURCE_K8S):
+            try:
+                delete_manifests(self.client, self._load_generated())
+            except FileNotFoundError:
+                pass
+            self.client.delete_many(
+                [k8s.make("v1", "Namespace", self.kfdef.spec.namespace)])
+            self._persist_client()
+        if resources in (RESOURCE_ALL, RESOURCE_PLATFORM):
+            self.platform.delete(self.kfdef)
+        self.kfdef.set_condition("Available", "False", reason="Deleted")
+        self.kfdef.save()
+
+    def show(self) -> dict:
+        comps = {}
+        for comp in self.kfdef.spec.components:
+            path = os.path.join(self.kfdef.spec.app_dir, MANIFESTS_DIR,
+                                f"{comp}.yaml")
+            n = 0
+            if os.path.exists(path):
+                with open(path) as f:
+                    n = len(yamlio.load_all(f.read()))
+            comps[comp] = n
+        return {"name": self.kfdef.name,
+                "platform": self.kfdef.spec.platform,
+                "namespace": self.kfdef.spec.namespace,
+                "components": comps,
+                "conditions": [c.type + "=" + c.status
+                               for c in self.kfdef.conditions]}
+
+
+class ApplyOutcome:
+    def __init__(self):
+        self.applied = 0
+        self.failed: list = []
+
+
+# ---------------------------------------------------------------- CLI verbs
+
+
+def register_verbs(sub: argparse._SubParsersAction) -> None:
+    p_init = sub.add_parser("init", help="create a deployment app directory")
+    p_init.add_argument("app_dir")
+    p_init.add_argument("--platform", default="existing")
+    p_init.add_argument("--project", default="")
+    p_init.add_argument("--zone", default="")
+    p_init.add_argument("--namespace", default="kubeflow")
+    p_init.add_argument("--use-basic-auth", action="store_true")
+    p_init.add_argument("--tpu-topology", default="v5e-8")
+    p_init.add_argument("--components", default="",
+                        help="comma-separated override of the component list")
+    p_init.set_defaults(func=_cmd_init)
+
+    for verb, fn in [("generate", _cmd_generate), ("apply", _cmd_apply),
+                     ("delete", _cmd_delete)]:
+        p = sub.add_parser(verb, help=f"{verb} platform/k8s resources")
+        p.add_argument("resources", nargs="?", default="all",
+                       choices=["all", "k8s", "platform"])
+        p.add_argument("--app-dir", default=".")
+        p.set_defaults(func=fn)
+
+    p_show = sub.add_parser("show", help="show app state")
+    p_show.add_argument("--app-dir", default=".")
+    p_show.set_defaults(func=_cmd_show)
+
+    p_comp = sub.add_parser("components", help="list installable components")
+    p_comp.set_defaults(func=_cmd_components)
+
+
+def _cmd_init(args) -> int:
+    kwargs = dict(platform=args.platform, project=args.project,
+                  zone=args.zone, namespace=args.namespace,
+                  use_basic_auth=args.use_basic_auth,
+                  default_tpu_topology=args.tpu_topology)
+    if args.components:
+        kwargs["components"] = [c.strip() for c in args.components.split(",")]
+    coord = Coordinator.new(args.app_dir, **kwargs)
+    coord.init()
+    print(f"app initialized at {coord.kfdef.spec.app_dir}")
+    return 0
+
+
+def _cmd_generate(args) -> int:
+    coord = Coordinator.load(args.app_dir)
+    written = coord.generate(args.resources)
+    print(f"generated {len(written)} component manifests")
+    return 0
+
+
+def _cmd_apply(args) -> int:
+    coord = Coordinator.load(args.app_dir)
+    outcome = coord.apply(args.resources)
+    print(f"applied {outcome.applied} objects"
+          + (f", {len(outcome.failed)} FAILED" if outcome.failed else ""))
+    return 1 if outcome.failed else 0
+
+
+def _cmd_delete(args) -> int:
+    coord = Coordinator.load(args.app_dir)
+    coord.delete(args.resources)
+    print("deleted")
+    return 0
+
+
+def _cmd_show(args) -> int:
+    coord = Coordinator.load(args.app_dir)
+    print(json.dumps(coord.show(), indent=2))
+    return 0
+
+
+def _cmd_components(args) -> int:
+    from ..manifests import REGISTRY
+    for name in component_names():
+        print(f"{name:24s} {REGISTRY[name].description}")
+    return 0

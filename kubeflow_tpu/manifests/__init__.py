@@ -1,0 +1,16 @@
+"""The manifest registry: programmatic builders replacing ksonnet.
+
+The reference's deployable catalog is 33 jsonnet packages
+(SURVEY.md §2.3, kubeflow/ dir): prototypes with @param headers expanded by
+`ks generate`. Here each package is a typed Python builder
+``build(params) -> [manifests]`` registered by name, keeping the same
+surface (component name + params in KfDef.componentParams) with golden
+tests instead of jsonnet test harnesses.
+"""
+
+from .registry import REGISTRY, build_component, component_names, register
+
+__all__ = ["REGISTRY", "register", "build_component", "component_names"]
+
+# Importing the package modules populates the registry.
+from . import core, training, serving, notebooks, multitenancy, katib, kubebench, observability  # noqa: F401,E402

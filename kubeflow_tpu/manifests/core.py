@@ -1,0 +1,141 @@
+"""Core platform packages: ingress, metacontroller, application, dashboard.
+
+Reference packages: kubeflow/common (ambassador, centraldashboard,
+spartakus, echo-server), kubeflow/metacontroller, kubeflow/application,
+dependencies/istio.
+"""
+
+from __future__ import annotations
+
+from ..api import k8s
+from . import helpers as H
+from .registry import register
+
+VERSION = "v0.1.0"
+IMG = "ghcr.io/kubeflow-tpu"
+
+
+@register("istio", "Istio gateway + kubeflow routing (dependencies/istio analog)")
+def istio(namespace: str = "kubeflow") -> list[dict]:
+    gw = k8s.make("networking.istio.io/v1alpha3", "Gateway",
+                  "kubeflow-gateway", namespace)
+    gw["spec"] = {
+        "selector": {"istio": "ingressgateway"},
+        "servers": [{"hosts": ["*"],
+                     "port": {"name": "http", "number": 80,
+                              "protocol": "HTTP"}}],
+    }
+    return [gw]
+
+
+@register("ambassador", "Ambassador API gateway (kubeflow/common/ambassador.libsonnet)")
+def ambassador(namespace: str = "kubeflow", replicas: int = 3) -> list[dict]:
+    sa = H.service_account("ambassador", namespace)
+    role = H.cluster_role("ambassador", [
+        {"apiGroups": [""], "resources": ["services", "configmaps", "secrets"],
+         "verbs": ["get", "list", "watch"]},
+    ])
+    binding = H.cluster_role_binding("ambassador", "ambassador", "ambassador",
+                                     namespace)
+    dep = H.deployment("ambassador", namespace,
+                       f"{IMG}/ambassador:{VERSION}", replicas=replicas,
+                       port=80, service_account="ambassador")
+    svc = H.service("ambassador", namespace, 80)
+    return [sa, role, binding, dep, svc]
+
+
+@register("metacontroller", "Lambda-controller engine (kubeflow/metacontroller)")
+def metacontroller(namespace: str = "kubeflow") -> list[dict]:
+    crd_comp = H.crd("compositecontrollers", "CompositeController",
+                     "metacontroller.k8s.io", ["v1alpha1"], scope="Cluster")
+    crd_deco = H.crd("decoratorcontrollers", "DecoratorController",
+                     "metacontroller.k8s.io", ["v1alpha1"], scope="Cluster")
+    sa = H.service_account("metacontroller", namespace)
+    binding = H.cluster_role_binding("metacontroller", "cluster-admin",
+                                     "metacontroller", namespace)
+    sts = k8s.make("apps/v1", "StatefulSet", "metacontroller", namespace,
+                   labels=H.std_labels("metacontroller"))
+    sts["spec"] = {
+        "replicas": 1,
+        "serviceName": "metacontroller",
+        "selector": {"matchLabels": {H.APP_LABEL: "metacontroller"}},
+        "template": {
+            "metadata": {"labels": H.std_labels("metacontroller")},
+            "spec": {"serviceAccountName": "metacontroller",
+                     "containers": [{"name": "metacontroller",
+                                     "image": f"{IMG}/metacontroller:{VERSION}"}]},
+        },
+    }
+    return [crd_comp, crd_deco, sa, binding, sts]
+
+
+@register("application", "Application CRD aggregating component resources "
+                         "(kubeflow/application/application.libsonnet)")
+def application(namespace: str = "kubeflow") -> list[dict]:
+    app_crd = H.crd("applications", "Application", "app.k8s.io", ["v1beta1"])
+    sync_cm = H.config_map("application-sync-hook", namespace, {
+        "sync": "builtin:application-controller",
+    })
+    composite = k8s.make("metacontroller.k8s.io/v1alpha1",
+                         "CompositeController", "application-controller")
+    composite["spec"] = {
+        "generateSelector": True,
+        "parentResource": {"apiVersion": "app.k8s.io/v1beta1",
+                           "resource": "applications"},
+        "hooks": {"sync": {"configMapRef": {"name": "application-sync-hook",
+                                            "namespace": namespace}}},
+    }
+    return [app_crd, sync_cm, composite]
+
+
+@register("centraldashboard", "Central dashboard UI + API "
+                              "(components/centraldashboard)")
+def centraldashboard(namespace: str = "kubeflow") -> list[dict]:
+    sa = H.service_account("centraldashboard", namespace)
+    role = H.cluster_role("centraldashboard", [
+        {"apiGroups": [""], "resources": ["events", "namespaces", "nodes",
+                                          "pods"],
+         "verbs": ["get", "list", "watch"]},
+    ])
+    binding = H.cluster_role_binding("centraldashboard", "centraldashboard",
+                                     "centraldashboard", namespace)
+    dep = H.deployment("centraldashboard", namespace,
+                       f"{IMG}/centraldashboard:{VERSION}", port=8082,
+                       service_account="centraldashboard")
+    svc = H.service("centraldashboard", namespace, 80, target_port=8082)
+    vs = H.virtual_service("centraldashboard", namespace, "/", "centraldashboard", 80)
+    return [sa, role, binding, dep, svc, vs]
+
+
+@register("spartakus", "Usage telemetry reporter (kubeflow/common/spartakus.libsonnet)")
+def spartakus(namespace: str = "kubeflow", usage_id: int = 0,
+              report_interval_s: int = 86400) -> list[dict]:
+    dep = H.deployment(
+        "spartakus-volunteer", namespace, f"{IMG}/spartakus:{VERSION}",
+        args=["volunteer", f"--cluster-id={usage_id}",
+              f"--period={report_interval_s}s"])
+    return [dep]
+
+
+@register("echo-server", "Minimal HTTP echo app (CI routing target, "
+                         "components/echo-server)")
+def echo_server(namespace: str = "kubeflow") -> list[dict]:
+    dep = H.deployment("echo-server", namespace, f"{IMG}/echo-server:{VERSION}",
+                       port=8080)
+    svc = H.service("echo-server", namespace, 80, target_port=8080)
+    return [dep, svc]
+
+
+@register("gatekeeper", "Basic-auth gate + login UI (components/gatekeeper, "
+                        "components/kflogin)")
+def gatekeeper(namespace: str = "kubeflow", username: str = "admin") -> list[dict]:
+    secret = k8s.make("v1", "Secret", "kubeflow-login", namespace)
+    secret["stringData"] = {"username": username, "passwordhash": ""}
+    dep = H.deployment("gatekeeper", namespace, f"{IMG}/gatekeeper:{VERSION}",
+                       port=8085, env={"USERNAME_SECRET": "kubeflow-login"})
+    svc = H.service("gatekeeper", namespace, 8085)
+    login = H.deployment("kflogin", namespace, f"{IMG}/kflogin:{VERSION}",
+                         port=5000)
+    login_svc = H.service("kflogin", namespace, 80, target_port=5000)
+    vs = H.virtual_service("kflogin", namespace, "/kflogin", "kflogin", 80)
+    return [secret, dep, svc, login, login_svc, vs]

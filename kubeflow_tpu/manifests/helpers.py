@@ -1,0 +1,124 @@
+"""Shared manifest constructors (the util.libsonnet / common idioms)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..api import k8s
+
+APP_LABEL = "app.kubernetes.io/name"
+PART_OF = "app.kubernetes.io/part-of"
+
+
+def std_labels(name: str) -> dict:
+    return {APP_LABEL: name, PART_OF: "kubeflow"}
+
+
+def deployment(name: str, namespace: str, image: str, *,
+               args: Optional[list] = None, env: Optional[dict] = None,
+               port: Optional[int] = None, replicas: int = 1,
+               service_account: Optional[str] = None,
+               resources: Optional[dict] = None,
+               labels: Optional[dict] = None) -> dict:
+    lbl = {**std_labels(name), **(labels or {})}
+    container: dict = {"name": name, "image": image}
+    if args:
+        container["args"] = list(args)
+    if env:
+        container["env"] = [{"name": k, "value": str(v)} for k, v in env.items()]
+    if port:
+        container["ports"] = [{"containerPort": port}]
+    if resources:
+        container["resources"] = resources
+    spec: dict = {
+        "replicas": replicas,
+        "selector": {"matchLabels": {APP_LABEL: name}},
+        "template": {
+            "metadata": {"labels": lbl},
+            "spec": {"containers": [container]},
+        },
+    }
+    if service_account:
+        spec["template"]["spec"]["serviceAccountName"] = service_account
+    return k8s.make("apps/v1", "Deployment", name, namespace, labels=lbl,
+                    spec=spec)
+
+
+def service(name: str, namespace: str, port: int, target_port: Optional[int] = None,
+            selector_name: Optional[str] = None, headless: bool = False) -> dict:
+    spec: dict = {
+        "selector": {APP_LABEL: selector_name or name},
+        "ports": [{"port": port, "targetPort": target_port or port,
+                   "name": "http"}],
+    }
+    if headless:
+        spec["clusterIP"] = "None"
+    return k8s.make("v1", "Service", name, namespace,
+                    labels=std_labels(name), spec=spec)
+
+
+def service_account(name: str, namespace: str) -> dict:
+    return k8s.make("v1", "ServiceAccount", name, namespace,
+                    labels=std_labels(name))
+
+
+def cluster_role(name: str, rules: Sequence[dict]) -> dict:
+    obj = k8s.make("rbac.authorization.k8s.io/v1", "ClusterRole", name,
+                   labels=std_labels(name))
+    obj["rules"] = list(rules)
+    return obj
+
+
+def cluster_role_binding(name: str, role: str, sa: str, namespace: str) -> dict:
+    obj = k8s.make("rbac.authorization.k8s.io/v1", "ClusterRoleBinding", name,
+                   labels=std_labels(name))
+    obj["roleRef"] = {"apiGroup": "rbac.authorization.k8s.io",
+                      "kind": "ClusterRole", "name": role}
+    obj["subjects"] = [{"kind": "ServiceAccount", "name": sa,
+                        "namespace": namespace}]
+    return obj
+
+
+def config_map(name: str, namespace: str, data: dict) -> dict:
+    obj = k8s.make("v1", "ConfigMap", name, namespace, labels=std_labels(name))
+    obj["data"] = {k: str(v) for k, v in data.items()}
+    return obj
+
+
+def crd(plural: str, kind: str, group: str, versions: Sequence[str],
+        scope: str = "Namespaced",
+        schema: Optional[dict] = None) -> dict:
+    obj = k8s.make("apiextensions.k8s.io/v1", "CustomResourceDefinition",
+                   f"{plural}.{group}")
+    obj["spec"] = {
+        "group": group,
+        "names": {"kind": kind, "plural": plural,
+                  "singular": kind.lower(), "listKind": f"{kind}List"},
+        "scope": scope,
+        "versions": [
+            {"name": v, "served": True, "storage": i == 0,
+             **({"schema": {"openAPIV3Schema": schema}} if schema else {})}
+            for i, v in enumerate(versions)
+        ],
+    }
+    return obj
+
+
+def virtual_service(name: str, namespace: str, prefix: str, svc: str,
+                    port: int, gateway: str = "kubeflow-gateway") -> dict:
+    """Istio route — the idiom most reference packages emit
+    (e.g. tf-job-operator.libsonnet:401-446)."""
+    obj = k8s.make("networking.istio.io/v1alpha3", "VirtualService", name,
+                   namespace, labels=std_labels(name))
+    obj["spec"] = {
+        "hosts": ["*"],
+        "gateways": [gateway],
+        "http": [{
+            "match": [{"uri": {"prefix": prefix}}],
+            "rewrite": {"uri": "/"},
+            "route": [{"destination": {
+                "host": f"{svc}.{namespace}.svc.cluster.local",
+                "port": {"number": port}}}],
+        }],
+    }
+    return obj

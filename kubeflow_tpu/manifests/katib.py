@@ -1,0 +1,93 @@
+"""Katib package: HP search (vizier core, suggestions, studyjob controller).
+
+Reference: kubeflow/katib (vizier.libsonnet:4-20 core+mysql+REST+UI,
+suggestion.libsonnet:50-66 per-algorithm services,
+studyjobcontroller.libsonnet:131-147,294-323,368-408).
+"""
+
+from __future__ import annotations
+
+from ..api import k8s
+from . import helpers as H
+from .registry import register
+
+VERSION = "v0.1.0"
+IMG = "ghcr.io/kubeflow-tpu"
+
+SUGGESTION_ALGORITHMS = ("random", "grid", "hyperband", "bayesianoptimization")
+
+
+@register("katib", "Hyperparameter search: StudyJob CRD, vizier core, "
+                   "suggestion services (kubeflow/katib parity)")
+def katib(namespace: str = "kubeflow",
+          algorithms: str = ",".join(SUGGESTION_ALGORITHMS)) -> list[dict]:
+    out: list[dict] = []
+    study_crd = H.crd("studyjobs", "StudyJob", "kubeflow.org", ["v1alpha1"],
+                      schema={
+                          "type": "object",
+                          "properties": {"spec": {
+                              "type": "object",
+                              "properties": {
+                                  "studyName": {"type": "string"},
+                                  "owner": {"type": "string"},
+                                  "optimizationtype": {
+                                      "type": "string",
+                                      "enum": ["maximize", "minimize"]},
+                                  "objectivevaluename": {"type": "string"},
+                                  "suggestionSpec": {"type": "object"},
+                                  "parameterconfigs": {"type": "array"},
+                                  "workerSpec": {"type": "object"},
+                                  "metricsnames": {"type": "array"},
+                              }}}})
+    out.append(study_crd)
+
+    # vizier core + db (vizier.libsonnet:4-20)
+    db = H.deployment("vizier-db", namespace, f"{IMG}/mysql:{VERSION}",
+                      port=3306, env={"MYSQL_ROOT_PASSWORD": "vizier",
+                                      "MYSQL_DATABASE": "vizier"})
+    db_svc = H.service("vizier-db", namespace, 3306)
+    core = H.deployment("vizier-core", namespace,
+                        f"{IMG}/vizier-core:{VERSION}", port=6789,
+                        env={"DB_ADDRESS": f"vizier-db.{namespace}:3306"})
+    core_svc = H.service("vizier-core", namespace, 6789)
+    ui = H.deployment("katib-ui", namespace, f"{IMG}/katib-ui:{VERSION}",
+                      port=80)
+    ui_svc = H.service("katib-ui", namespace, 80)
+    ui_vs = H.virtual_service("katib-ui", namespace, "/katib/", "katib-ui", 80)
+    out += [db, db_svc, core, core_svc, ui, ui_svc, ui_vs]
+
+    # per-algorithm suggestion services (suggestion.libsonnet:50-66)
+    for algo in algorithms.split(","):
+        algo = algo.strip()
+        if not algo:
+            continue
+        name = f"vizier-suggestion-{algo}"
+        out.append(H.deployment(name, namespace,
+                                f"{IMG}/suggestion-{algo}:{VERSION}",
+                                port=6789))
+        out.append(H.service(name, namespace, 6789))
+
+    # studyjob controller (studyjobcontroller.libsonnet:294-323)
+    sa = H.service_account("studyjob-controller", namespace)
+    role = H.cluster_role("studyjob-controller", [
+        {"apiGroups": ["kubeflow.org", "tpu.kubeflow.org"],
+         "resources": ["studyjobs", "tfjobs", "pytorchjobs", "tpujobs",
+                       "mpijobs"], "verbs": ["*"]},
+        {"apiGroups": ["batch"], "resources": ["jobs", "cronjobs"],
+         "verbs": ["*"]},
+        {"apiGroups": [""], "resources": ["pods", "pods/log", "configmaps"],
+         "verbs": ["*"]},
+    ])
+    binding = H.cluster_role_binding("studyjob-controller",
+                                     "studyjob-controller",
+                                     "studyjob-controller", namespace)
+    ctrl = H.deployment("studyjob-controller", namespace,
+                        f"{IMG}/studyjob-controller:{VERSION}",
+                        service_account="studyjob-controller")
+    # per-trial metrics collector template (studyjobcontroller.libsonnet:131-147)
+    mc_template = H.config_map("metrics-collector-template", namespace, {
+        "template": "builtin:metrics-collector-cronjob",
+        "schedule": "*/1 * * * *",
+    })
+    out += [sa, role, binding, ctrl, mc_template]
+    return out

@@ -1,0 +1,69 @@
+"""Kubebench package: benchmark harness operator.
+
+Reference: kubeflow/kubebench (kubebench-operator.libsonnet:10-27 CRD +
+operator; kubebench-job.libsonnet:6-30,53,100-120 the Argo workflow per
+benchmark: configurator → launch job → reporter, PVC roots, KUBEBENCH_* env
+contract; kubebench-dashboard.libsonnet).
+"""
+
+from __future__ import annotations
+
+from ..api import k8s
+from . import helpers as H
+from .registry import register
+
+VERSION = "v0.1.0"
+IMG = "ghcr.io/kubeflow-tpu"
+
+# The env contract injected into benchmark steps (kubebench-job.libsonnet
+# KUBEBENCH_* vars) — preserved verbatim for workload compatibility.
+KUBEBENCH_ENV = ("KUBEBENCH_CONFIG_ROOT", "KUBEBENCH_DATA_ROOT",
+                 "KUBEBENCH_EXP_ROOT", "KUBEBENCH_EXP_ID")
+
+
+@register("kubebench", "Benchmark harness: KubebenchJob CRD + operator + "
+                       "dashboard (kubeflow/kubebench parity)")
+def kubebench(namespace: str = "kubeflow",
+              config_pvc: str = "kubebench-config",
+              data_pvc: str = "kubebench-data",
+              experiments_pvc: str = "kubebench-exp") -> list[dict]:
+    kb_crd = H.crd("kubebenchjobs", "KubebenchJob", "kubeflow.org",
+                   ["v1alpha1"], schema={
+                       "type": "object",
+                       "properties": {"spec": {
+                           "type": "object",
+                           "properties": {
+                               "jobSpec": {"type": "object"},
+                               "reporterType": {"type": "string"},
+                               "configRoot": {"type": "string"},
+                               "dataRoot": {"type": "string"},
+                               "experimentsRoot": {"type": "string"},
+                           }}}})
+    sa = H.service_account("kubebench-operator", namespace)
+    role = H.cluster_role("kubebench-operator", [
+        {"apiGroups": ["kubeflow.org", "tpu.kubeflow.org"],
+         "resources": ["*"], "verbs": ["*"]},
+        {"apiGroups": ["batch"], "resources": ["jobs"], "verbs": ["*"]},
+        {"apiGroups": [""], "resources": ["pods", "configmaps",
+                                          "persistentvolumeclaims"],
+         "verbs": ["*"]},
+    ])
+    binding = H.cluster_role_binding("kubebench-operator",
+                                     "kubebench-operator",
+                                     "kubebench-operator", namespace)
+    dep = H.deployment("kubebench-operator", namespace,
+                       f"{IMG}/kubebench-operator:{VERSION}",
+                       service_account="kubebench-operator")
+    pvcs = []
+    for pvc_name in (config_pvc, data_pvc, experiments_pvc):
+        pvc = k8s.make("v1", "PersistentVolumeClaim", pvc_name, namespace)
+        pvc["spec"] = {"accessModes": ["ReadWriteMany"],
+                       "resources": {"requests": {"storage": "10Gi"}}}
+        pvcs.append(pvc)
+    dash = H.deployment("kubebench-dashboard", namespace,
+                        f"{IMG}/kubebench-dashboard:{VERSION}", port=9303)
+    dash_svc = H.service("kubebench-dashboard", namespace, 80,
+                         target_port=9303)
+    dash_vs = H.virtual_service("kubebench-dashboard", namespace,
+                                "/kubebench/", "kubebench-dashboard", 80)
+    return [kb_crd, sa, role, binding, dep, *pvcs, dash, dash_svc, dash_vs]

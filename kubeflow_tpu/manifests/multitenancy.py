@@ -1,0 +1,96 @@
+"""Multi-tenancy packages: profiles + admission webhook.
+
+Reference: kubeflow/profiles (Profile/Permission CRDs, sync-profile.jsonnet),
+components/profile-controller, components/admission-webhook (PodDefault),
+components/access-management swagger (SURVEY §2.6).
+"""
+
+from __future__ import annotations
+
+from ..api import k8s
+from . import helpers as H
+from .registry import register
+
+VERSION = "v0.1.0"
+IMG = "ghcr.io/kubeflow-tpu"
+
+
+@register("profiles", "Profile CRD + multi-tenancy controller "
+                      "(components/profile-controller parity)")
+def profiles(namespace: str = "kubeflow") -> list[dict]:
+    profile_crd = H.crd("profiles", "Profile", "kubeflow.org", ["v1alpha1"],
+                        scope="Cluster", schema={
+                            "type": "object",
+                            "properties": {"spec": {
+                                "type": "object",
+                                "properties": {
+                                    "owner": {"type": "object"},
+                                    "resourceQuotaSpec": {"type": "object"},
+                                }}}})
+    permission_crd = H.crd("permissions", "Permission", "kubeflow.org",
+                           ["v1alpha1"])
+    sa = H.service_account("profile-controller", namespace)
+    binding = H.cluster_role_binding("profile-controller", "cluster-admin",
+                                     "profile-controller", namespace)
+    dep = H.deployment("profile-controller", namespace,
+                       f"{IMG}/profile-controller:{VERSION}",
+                       service_account="profile-controller")
+    return [profile_crd, permission_crd, sa, binding, dep]
+
+
+@register("admission-webhook", "PodDefault mutating webhook "
+                               "(components/admission-webhook parity)")
+def admission_webhook(namespace: str = "kubeflow") -> list[dict]:
+    pd_crd = H.crd("poddefaults", "PodDefault", "kubeflow.org", ["v1alpha1"],
+                   schema={
+                       "type": "object",
+                       "properties": {"spec": {
+                           "type": "object",
+                           "properties": {
+                               "selector": {"type": "object"},
+                               "env": {"type": "array"},
+                               "volumes": {"type": "array"},
+                               "volumeMounts": {"type": "array"},
+                           }}}})
+    sa = H.service_account("admission-webhook", namespace)
+    role = H.cluster_role("admission-webhook", [
+        {"apiGroups": ["kubeflow.org"], "resources": ["poddefaults"],
+         "verbs": ["get", "list", "watch"]},
+    ])
+    binding = H.cluster_role_binding("admission-webhook", "admission-webhook",
+                                     "admission-webhook", namespace)
+    dep = H.deployment("admission-webhook", namespace,
+                       f"{IMG}/admission-webhook:{VERSION}", port=4443,
+                       service_account="admission-webhook")
+    svc = H.service("admission-webhook", namespace, 443, target_port=4443)
+    webhook = k8s.make("admissionregistration.k8s.io/v1",
+                       "MutatingWebhookConfiguration", "admission-webhook")
+    webhook["webhooks"] = [{
+        "name": "admission-webhook.kubeflow.org",
+        "clientConfig": {"service": {"name": "admission-webhook",
+                                     "namespace": namespace,
+                                     "path": "/apply-poddefault"}},
+        "rules": [{"apiGroups": [""], "apiVersions": ["v1"],
+                   "operations": ["CREATE"], "resources": ["pods"]}],
+        "admissionReviewVersions": ["v1"],
+        "sideEffects": "None",
+    }]
+    return [pd_crd, sa, role, binding, dep, svc, webhook]
+
+
+@register("credentials-pod-preset", "Cloud-credential PodDefault "
+                                    "(kubeflow/credentials-pod-preset parity)")
+def credentials_pod_preset(namespace: str = "kubeflow",
+                           secret_name: str = "user-cloud-creds") -> list[dict]:
+    pd = k8s.make("kubeflow.org/v1alpha1", "PodDefault", "cloud-credentials",
+                  namespace)
+    pd["spec"] = {
+        "selector": {"matchLabels": {"inject-cloud-creds": "true"}},
+        "env": [{"name": "GOOGLE_APPLICATION_CREDENTIALS",
+                 "value": "/secret/creds.json"}],
+        "volumes": [{"name": "creds",
+                     "secret": {"secretName": secret_name}}],
+        "volumeMounts": [{"name": "creds", "mountPath": "/secret",
+                          "readOnly": True}],
+    }
+    return [pd]

@@ -1,0 +1,87 @@
+"""Notebook packages: Notebook CRD, controller, web app.
+
+Reference: kubeflow/jupyter (notebooks.libsonnet CRD,
+notebook_controller.libsonnet, jupyter-web-app.libsonnet; legacy JupyterHub
+StatefulSet jupyter.libsonnet:128-150).
+"""
+
+from __future__ import annotations
+
+from ..api import k8s
+from . import helpers as H
+from .registry import register
+
+VERSION = "v0.1.0"
+IMG = "ghcr.io/kubeflow-tpu"
+
+# The CR wraps a full PodSpec (notebook_types.go:28-35 idiom — SURVEY §2.6).
+_NOTEBOOK_SCHEMA = {
+    "type": "object",
+    "properties": {"spec": {
+        "type": "object",
+        "properties": {"template": {"type": "object"}},
+    }},
+}
+
+
+@register("notebook-controller", "Notebook CRD + reconciler "
+                                 "(components/notebook-controller parity)")
+def notebook_controller(namespace: str = "kubeflow") -> list[dict]:
+    nb_crd = H.crd("notebooks", "Notebook", "kubeflow.org", ["v1alpha1"],
+                   schema=_NOTEBOOK_SCHEMA)
+    sa = H.service_account("notebook-controller", namespace)
+    role = H.cluster_role("notebook-controller", [
+        {"apiGroups": ["kubeflow.org"], "resources": ["notebooks",
+                                                      "notebooks/status"],
+         "verbs": ["*"]},
+        {"apiGroups": ["apps"], "resources": ["statefulsets"], "verbs": ["*"]},
+        {"apiGroups": [""], "resources": ["services", "pods", "events"],
+         "verbs": ["*"]},
+        {"apiGroups": ["networking.istio.io"],
+         "resources": ["virtualservices"], "verbs": ["*"]},
+    ])
+    binding = H.cluster_role_binding("notebook-controller",
+                                     "notebook-controller",
+                                     "notebook-controller", namespace)
+    dep = H.deployment("notebook-controller", namespace,
+                       f"{IMG}/notebook-controller:{VERSION}",
+                       service_account="notebook-controller",
+                       env={"USE_ISTIO": "true"})
+    return [nb_crd, sa, role, binding, dep]
+
+
+@register("jupyter-web-app", "Notebook spawner web app "
+                             "(components/jupyter-web-app parity)")
+def jupyter_web_app(namespace: str = "kubeflow", ui: str = "default",
+                    prefix: str = "jupyter") -> list[dict]:
+    sa = H.service_account("jupyter-web-app", namespace)
+    role = H.cluster_role("jupyter-web-app", [
+        {"apiGroups": ["kubeflow.org"], "resources": ["notebooks",
+                                                      "poddefaults"],
+         "verbs": ["get", "list", "create", "delete"]},
+        {"apiGroups": [""], "resources": ["persistentvolumeclaims",
+                                          "namespaces", "secrets"],
+         "verbs": ["get", "list", "create", "delete"]},
+        {"apiGroups": ["storage.k8s.io"], "resources": ["storageclasses"],
+         "verbs": ["get", "list"]},
+    ])
+    binding = H.cluster_role_binding("jupyter-web-app", "jupyter-web-app",
+                                     "jupyter-web-app", namespace)
+    spawner_cm = H.config_map("jupyter-web-app-config", namespace, {
+        "ui": ui,
+        # Default notebook images, incl. the TPU-ready image (the
+        # tensorflow-notebook-image slot, components/tensorflow-notebook-image)
+        "notebook-images": ",".join([
+            f"{IMG}/jax-notebook-tpu:{VERSION}",
+            f"{IMG}/jax-notebook-cpu:{VERSION}",
+        ]),
+        "default-tpu-topology": "v5e-1",
+    })
+    dep = H.deployment("jupyter-web-app", namespace,
+                       f"{IMG}/jupyter-web-app:{VERSION}", port=5000,
+                       service_account="jupyter-web-app",
+                       env={"UI": ui, "URL_PREFIX": f"/{prefix}"})
+    svc = H.service("jupyter-web-app", namespace, 80, target_port=5000)
+    vs = H.virtual_service("jupyter-web-app", namespace, f"/{prefix}/",
+                           "jupyter-web-app", 80)
+    return [nb for nb in [sa, role, binding, spawner_cm, dep, svc, vs]]

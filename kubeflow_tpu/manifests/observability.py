@@ -1,0 +1,84 @@
+"""Observability packages: prometheus, metric-collector, TPU device plugin.
+
+Reference: kubeflow/gcp/prototypes/prometheus.jsonnet, metric-collector
+(kubeflow-readiness.py + metric-collector.jsonnet), and the GPU-driver
+DaemonSet slot (kubeflow/gcp/gpu-driver.libsonnet — here the TPU device
+plugin, SURVEY §2.6).
+"""
+
+from __future__ import annotations
+
+from ..api import k8s
+from . import helpers as H
+from .registry import register
+
+VERSION = "v0.1.0"
+IMG = "ghcr.io/kubeflow-tpu"
+
+
+@register("prometheus", "Prometheus deployment (gcp/prototypes/prometheus parity)")
+def prometheus(namespace: str = "kubeflow-monitoring") -> list[dict]:
+    ns = k8s.make("v1", "Namespace", namespace)
+    cm = H.config_map("prometheus-config", namespace, {
+        "prometheus.yml": (
+            "global: {scrape_interval: 30s}\n"
+            "scrape_configs:\n"
+            "- job_name: kubeflow\n"
+            "  kubernetes_sd_configs: [{role: pod}]\n"
+        ),
+    })
+    sa = H.service_account("prometheus", namespace)
+    role = H.cluster_role("prometheus", [
+        {"apiGroups": [""], "resources": ["nodes", "services", "endpoints",
+                                          "pods"],
+         "verbs": ["get", "list", "watch"]},
+    ])
+    binding = H.cluster_role_binding("prometheus", "prometheus", "prometheus",
+                                     namespace)
+    dep = H.deployment("prometheus", namespace, f"{IMG}/prometheus:{VERSION}",
+                       port=9090, service_account="prometheus")
+    svc = H.service("prometheus", namespace, 9090)
+    return [ns, cm, sa, role, binding, dep, svc]
+
+
+@register("metric-collector", "Availability prober exporting "
+                              "kubeflow_availability (metric-collector parity)")
+def metric_collector(namespace: str = "kubeflow",
+                     target_url: str = "http://centraldashboard.kubeflow") -> list[dict]:
+    dep = H.deployment("metric-collector", namespace,
+                       f"{IMG}/metric-collector:{VERSION}", port=8000,
+                       env={"TARGET_URL": target_url,
+                            "PROBE_INTERVAL_S": "30"})
+    svc = H.service("metric-collector", namespace, 8000)
+    svc["metadata"].setdefault("annotations", {})[
+        "prometheus.io/scrape"] = "true"
+    return [dep, svc]
+
+
+@register("tpu-device-plugin", "TPU device-plugin DaemonSet (the GPU-driver "
+                               "installer slot, gcp/gpu-driver.libsonnet)")
+def tpu_device_plugin(namespace: str = "kube-system") -> list[dict]:
+    ds = k8s.make("apps/v1", "DaemonSet", "tpu-device-plugin", namespace,
+                  labels=H.std_labels("tpu-device-plugin"))
+    ds["spec"] = {
+        "selector": {"matchLabels": {H.APP_LABEL: "tpu-device-plugin"}},
+        "template": {
+            "metadata": {"labels": H.std_labels("tpu-device-plugin")},
+            "spec": {
+                "nodeSelector": {"cloud.google.com/gke-tpu-accelerator": ""},
+                "tolerations": [{"operator": "Exists"}],
+                "containers": [{
+                    "name": "device-plugin",
+                    "image": f"{IMG}/tpu-device-plugin:{VERSION}",
+                    "volumeMounts": [{"name": "device-plugin",
+                                      "mountPath": "/var/lib/kubelet/device-plugins"}],
+                }],
+                "volumes": [{"name": "device-plugin",
+                             "hostPath": {
+                                 "path": "/var/lib/kubelet/device-plugins"}}],
+            },
+        },
+    }
+    # match-all selector: GKE labels TPU nodes with non-empty accelerator
+    # values; the empty selector value is patched per node pool at install
+    return [ds]

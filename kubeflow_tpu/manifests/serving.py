@@ -1,0 +1,107 @@
+"""Serving packages: TPU model server, batch predict, tensorboard.
+
+Reference packages: kubeflow/tf-serving (tf-serving.libsonnet: late-bound
+params, deployment + gRPC/REST ports + HTTP proxy + HPA + platform mixins),
+kubeflow/tf-batch-predict, kubeflow/tensorboard.
+"""
+
+from __future__ import annotations
+
+from ..api import k8s
+from . import helpers as H
+from .registry import register
+
+VERSION = "v0.1.0"
+IMG = "ghcr.io/kubeflow-tpu"
+
+
+@register("tpu-serving", "TPU-backed model server (tf-serving.libsonnet parity: "
+                         "gRPC+REST, HTTP proxy, HPA, storage params)")
+def tpu_serving(namespace: str = "kubeflow", name: str = "model-server",
+                model_path: str = "", model_name: str = "model",
+                tpu_topology: str = "v5e-1", num_replicas: int = 1,
+                enable_http_proxy: bool = True, enable_hpa: bool = False,
+                hpa_min: int = 1, hpa_max: int = 4) -> list[dict]:
+    lbl = {**H.std_labels(name), "kubeflow.org/servable": model_name}
+    dep = H.deployment(
+        name, namespace, f"{IMG}/tpu-model-server:{VERSION}",
+        replicas=num_replicas,
+        args=[f"--model-path={model_path}", f"--model-name={model_name}",
+              "--grpc-port=9000", "--rest-port=8500"],
+        labels=lbl, port=9000)
+    pod_spec = dep["spec"]["template"]["spec"]
+    pod_spec["nodeSelector"] = {
+        "cloud.google.com/gke-tpu-topology": tpu_topology}
+    pod_spec["containers"][0]["resources"] = {
+        "limits": {"google.com/tpu": 1}}
+    pod_spec["containers"][0]["ports"] = [
+        {"containerPort": 9000, "name": "grpc"},
+        {"containerPort": 8500, "name": "rest"},
+    ]
+    if enable_http_proxy:
+        pod_spec["containers"].append({
+            "name": "http-proxy",
+            "image": f"{IMG}/serving-http-proxy:{VERSION}",
+            "args": ["--port=8000", "--rpc_timeout=10.0"],
+            "ports": [{"containerPort": 8000, "name": "http"}],
+        })
+    svc = H.service(name, namespace, 9000, selector_name=name)
+    svc["spec"]["ports"] = [
+        {"port": 9000, "targetPort": 9000, "name": "grpc"},
+        {"port": 8500, "targetPort": 8500, "name": "rest"},
+        *([{"port": 8000, "targetPort": 8000, "name": "http"}]
+          if enable_http_proxy else []),
+    ]
+    out = [dep, svc,
+           H.virtual_service(name, namespace, f"/models/{model_name}/",
+                             name, 8000 if enable_http_proxy else 8500)]
+    if enable_hpa:
+        hpa = k8s.make("autoscaling/v2", "HorizontalPodAutoscaler", name,
+                       namespace)
+        hpa["spec"] = {
+            "scaleTargetRef": {"apiVersion": "apps/v1", "kind": "Deployment",
+                               "name": name},
+            "minReplicas": hpa_min, "maxReplicas": hpa_max,
+            "metrics": [{"type": "Resource", "resource": {
+                "name": "cpu",
+                "target": {"type": "Utilization",
+                           "averageUtilization": 80}}}],
+        }
+        out.append(hpa)
+    return out
+
+
+@register("tpu-batch-predict", "Batch prediction Job on TPU "
+                               "(kubeflow/tf-batch-predict parity)")
+def tpu_batch_predict(namespace: str = "kubeflow", name: str = "batch-predict",
+                      model_path: str = "", input_file_patterns: str = "",
+                      output_result_prefix: str = "",
+                      batch_size: int = 64,
+                      tpu_topology: str = "v5e-1") -> list[dict]:
+    job = k8s.make("batch/v1", "Job", name, namespace,
+                   labels=H.std_labels(name))
+    job["spec"] = {"template": {"spec": {
+        "restartPolicy": "Never",
+        "nodeSelector": {"cloud.google.com/gke-tpu-topology": tpu_topology},
+        "containers": [{
+            "name": name,
+            "image": f"{IMG}/tpu-batch-predict:{VERSION}",
+            "args": [f"--model-path={model_path}",
+                     f"--input-file-patterns={input_file_patterns}",
+                     f"--output-result-prefix={output_result_prefix}",
+                     f"--batch-size={batch_size}"],
+            "resources": {"limits": {"google.com/tpu": 1}},
+        }],
+    }}}
+    return [job]
+
+
+@register("tensorboard", "TensorBoard deployment (kubeflow/tensorboard parity)")
+def tensorboard(namespace: str = "kubeflow", name: str = "tensorboard",
+                log_dir: str = "/logs") -> list[dict]:
+    dep = H.deployment(name, namespace, f"{IMG}/tensorboard:{VERSION}",
+                       args=[f"--logdir={log_dir}", "--port=6006"],
+                       port=6006)
+    svc = H.service(name, namespace, 80, target_port=6006)
+    vs = H.virtual_service(name, namespace, f"/{name}/", name, 80)
+    return [dep, svc, vs]

@@ -1,0 +1,171 @@
+"""Training packages: the TPU job operator + legacy-kind CRDs + examples.
+
+Reference packages: kubeflow/tf-training (tf-job-operator.libsonnet),
+kubeflow/pytorch-job, kubeflow/mpi-job, kubeflow/examples/prototypes.
+"""
+
+from __future__ import annotations
+
+from ..api import k8s
+from ..api.trainingjob import (KF_API_VERSION_V1ALPHA1, KF_API_VERSION_V1BETA2,
+                               TPU_API_VERSION)
+from . import helpers as H
+from .registry import register
+
+VERSION = "v0.1.0"
+IMG = "ghcr.io/kubeflow-tpu"
+
+# Replica-count validation mirrored from the reference CRD schemas
+# (tf-job-operator.libsonnet:14-46: Chief max 1, deliberately no pod-template
+# validation per k8s#54579).
+_REPLICA_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "replicas": {"type": "integer", "minimum": 1},
+        "tpuTopology": {"type": "string", "pattern": r"^v\d+[a-z]*-\d+$"},
+        "numSlices": {"type": "integer", "minimum": 1},
+    },
+}
+
+
+def _job_schema(specs_key: str, max_one: list[str]) -> dict:
+    props = {specs_key: {
+        "type": "object",
+        "properties": {
+            t: ({**_REPLICA_SCHEMA,
+                 "properties": {**_REPLICA_SCHEMA["properties"],
+                                "replicas": {"type": "integer", "minimum": 1,
+                                             "maximum": 1}}}
+                if t in max_one else _REPLICA_SCHEMA)
+            for t in ("TPU", "Chief", "Master", "Worker", "PS", "Launcher",
+                      "Evaluator", "Coordinator")
+        },
+    }}
+    return {"type": "object",
+            "properties": {"spec": {"type": "object", "properties": props}}}
+
+
+def _operator_deployment(namespace: str, gang_scheduling: bool) -> list[dict]:
+    sa = H.service_account("tpu-job-operator", namespace)
+    role = H.cluster_role("tpu-job-operator", [
+        {"apiGroups": ["tpu.kubeflow.org", "kubeflow.org"],
+         "resources": ["*"], "verbs": ["*"]},
+        {"apiGroups": [""],
+         "resources": ["pods", "services", "events", "configmaps"],
+         "verbs": ["*"]},
+        # gang-scheduling RBAC, the kube-batch podgroups rule analog
+        # (tf-job-operator.libsonnet:298-307)
+        *([{"apiGroups": ["scheduling.kubeflow.org"],
+            "resources": ["podgroups"], "verbs": ["*"]}]
+          if gang_scheduling else []),
+    ])
+    binding = H.cluster_role_binding("tpu-job-operator", "tpu-job-operator",
+                                     "tpu-job-operator", namespace)
+    args = ["--controller=trainingjobs"]
+    if gang_scheduling:
+        args.append("--enable-gang-scheduling")
+    dep = H.deployment("tpu-job-operator", namespace,
+                       f"{IMG}/tpu-job-operator:{VERSION}", args=args,
+                       service_account="tpu-job-operator", port=8443)
+    cm = H.config_map("tpu-job-operator-config", namespace, {
+        "gang-scheduling": str(gang_scheduling).lower(),
+        "coordinator-port": "8476",
+    })
+    return [sa, role, binding, cm, dep]
+
+
+@register("tpu-job-operator", "TPUJob CRD + the gang-scheduling operator")
+def tpu_job_operator(namespace: str = "kubeflow",
+                     gang_scheduling: bool = True) -> list[dict]:
+    job_crd = H.crd("tpujobs", "TPUJob", "tpu.kubeflow.org", ["v1alpha1"],
+                    schema=_job_schema("replicaSpecs", ["Coordinator"]))
+    return [job_crd, *_operator_deployment(namespace, gang_scheduling)]
+
+
+@register("tf-job-operator", "TFJob CRD served by the TPU operator "
+                             "(kubeflow/tf-training parity)")
+def tf_job_operator(namespace: str = "kubeflow") -> list[dict]:
+    return [H.crd("tfjobs", "TFJob", "kubeflow.org", ["v1beta2", "v1beta1"],
+                  schema=_job_schema("tfReplicaSpecs", ["Chief", "Master"]))]
+
+
+@register("pytorch-operator", "PyTorchJob CRD served by the TPU operator "
+                              "(kubeflow/pytorch-job parity)")
+def pytorch_operator(namespace: str = "kubeflow") -> list[dict]:
+    return [H.crd("pytorchjobs", "PyTorchJob", "kubeflow.org", ["v1beta2"],
+                  schema=_job_schema("pytorchReplicaSpecs", ["Master"]))]
+
+
+@register("mpi-operator", "MPIJob CRD (oneOf{tpuTopology,replicas}) served "
+                          "by the TPU operator (kubeflow/mpi-job parity)")
+def mpi_operator(namespace: str = "kubeflow") -> list[dict]:
+    # The oneOf resource-quantity-first API (mpi-operator.libsonnet:27-77)
+    schema = {
+        "type": "object",
+        "properties": {"spec": {
+            "type": "object",
+            "oneOf": [
+                {"required": ["tpuTopology"]},
+                {"required": ["replicas"]},
+                {"required": ["replicaSpecs"]},
+            ],
+        }},
+    }
+    return [H.crd("mpijobs", "MPIJob", "kubeflow.org", ["v1alpha1"],
+                  schema=schema)]
+
+
+@register("openmpi-controller", "Slice-sidecar config: lifecycle hooks for "
+                                "gang workers (components/openmpi-controller analog)")
+def openmpi_controller(namespace: str = "kubeflow") -> list[dict]:
+    # The reference's sidecar sequenced MPI workers via SIGCONT files and
+    # master-phase polling (controller.py:17-23). The TPU analog is the
+    # jax.distributed barrier; this ships the sidecar config used for
+    # non-JAX payloads needing start sequencing.
+    return [H.config_map("slice-sidecar-config", namespace, {
+        "wait-mode": "coordinator-barrier",
+        "poll-interval-s": "10",
+    })]
+
+
+@register("tpu-job-simple", "Example TPUJob: ResNet-50 synthetic benchmark "
+                            "(examples/prototypes/tf-job-simple-v1.jsonnet analog)")
+def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
+                   topology: str = "v5e-8", steps: int = 100,
+                   global_batch: int = 1024) -> list[dict]:
+    job = k8s.make(TPU_API_VERSION, "TPUJob", name, namespace)
+    job["spec"] = {
+        "replicaSpecs": {
+            "TPU": {
+                "tpuTopology": topology,
+                "template": {"spec": {"containers": [{
+                    "name": "worker",
+                    "image": f"{IMG}/worker:{VERSION}",
+                    "command": ["python", "-m", "kubeflow_tpu.runtime.worker",
+                                "--workload", "resnet50",
+                                "--steps", str(steps),
+                                "--global-batch", str(global_batch)],
+                }]}},
+            },
+        },
+        "runPolicy": {"backoffLimit": 3},
+        "sharding": {"data": -1},
+    }
+    return [job]
+
+
+@register("tf-job-simple", "Example TFJob: 1 chief + 1 worker CPU benchmark "
+                           "(tf-job-simple-v1.jsonnet parity)")
+def tf_job_simple(namespace: str = "kubeflow",
+                  name: str = "tf-job-simple") -> list[dict]:
+    tmpl = {"spec": {"containers": [{
+        "name": "tensorflow", "image": f"{IMG}/tf-cnn-benchmark:{VERSION}",
+        "args": ["--model=resnet50", "--device=cpu", "--batch_size=32",
+                 "--data_name=synthetic"]}],
+        "restartPolicy": "OnFailure"}}
+    job = k8s.make(KF_API_VERSION_V1BETA2, "TFJob", name, namespace)
+    job["spec"] = {"tfReplicaSpecs": {
+        "Chief": {"replicas": 1, "template": tmpl},
+        "Worker": {"replicas": 1, "template": tmpl},
+    }}
+    return [job]

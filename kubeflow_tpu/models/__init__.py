@@ -1,0 +1,9 @@
+"""Built-in workloads.
+
+- ``resnet``: ResNet-50 — the flagship benchmark model, the analog of the
+  reference's tf_cnn_benchmarks ResNet-50 TFJob workload
+  (tf-controller-examples/tf-cnn/, kubeflow/examples/prototypes/
+  tf-job-simple-v1.jsonnet:11-47).
+- ``transformer``: decoder-only LM with logical sharding annotations —
+  the TP/PP/SP/EP showcase (no analog in the reference; SURVEY.md §2.5 row 5).
+"""

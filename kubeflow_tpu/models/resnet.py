@@ -1,0 +1,159 @@
+"""ResNet-50 in flax, TPU-first.
+
+The flagship benchmark workload: the TPU-native counterpart of the
+reference's tf_cnn_benchmarks ResNet-50 TFJob
+(tf-controller-examples/tf-cnn/launcher.py runs tf_cnn_benchmarks with
+variable_update=parameter_server; here the same model trains data-parallel
+over ICI via one pjit step).
+
+TPU design notes:
+- bfloat16 compute / float32 params and batch stats: convs hit the MXU at
+  full rate in bf16.
+- NHWC layout (XLA:TPU's native conv layout).
+- BatchNorm stats folded into the jitted step via the flax mutable-variables
+  path; cross-replica stat sync uses the batch axis only at eval export.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+STAGE_SIZES = {
+    18: [2, 2, 2, 2],
+    34: [3, 4, 6, 3],
+    50: [3, 4, 6, 3],
+    101: [3, 4, 23, 3],
+    152: [3, 8, 36, 3],
+}
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1),
+                                 strides=(self.strides, self.strides),
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1),
+                                 strides=(self.strides, self.strides),
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    num_classes: int = 1000
+    depth: int = 50
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       padding="SAME")
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        block = BottleneckBlock if self.depth >= 50 else BasicBlock
+
+        x = x.astype(self.dtype)
+        x = conv(self.width, (7, 7), strides=(2, 2), name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(STAGE_SIZES[self.depth]):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = block(self.width * 2 ** i, strides, conv, norm,
+                          name=f"stage{i + 1}_block{j + 1}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def resnet50(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(num_classes=num_classes, depth=50, **kw)
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    onehot = jax.nn.one_hot(labels, logits.shape[-1])
+    return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+
+
+def make_loss_fn(model: ResNet) -> Callable:
+    """Loss fn in the TrainStepBuilder signature; threads batch_stats."""
+
+    def loss_fn(params, variables, batch, rng):
+        images, labels = batch["images"], batch["labels"]
+        logits, updated = model.apply(
+            {"params": params, **variables}, images, train=True,
+            mutable=["batch_stats"])
+        loss = cross_entropy_loss(logits, labels)
+        acc = jnp.mean(jnp.argmax(logits, -1) == labels)
+        return loss, {"accuracy": acc, "variables": updated}
+
+    return loss_fn
+
+
+def init_fn(model: ResNet, image_size: int = 224, batch: int = 8) -> Callable:
+    def _init(rng):
+        variables = model.init(
+            rng, jnp.zeros((batch, image_size, image_size, 3), jnp.float32),
+            train=False)
+        params = variables.pop("params")
+        return params, dict(variables)
+
+    return _init
+
+
+def synthetic_batch(rng: jax.Array, batch_size: int, image_size: int = 224,
+                    num_classes: int = 1000) -> dict:
+    """Synthetic ImageNet-shaped data (the tf_cnn_benchmarks --data_name
+    synthetic mode the CI config used)."""
+    k1, k2 = jax.random.split(rng)
+    return {
+        "images": jax.random.normal(
+            k1, (batch_size, image_size, image_size, 3), jnp.float32),
+        "labels": jax.random.randint(k2, (batch_size,), 0, num_classes),
+    }

@@ -1,0 +1,194 @@
+"""Decoder-only Transformer LM with logical sharding annotations.
+
+The parallelism showcase the reference has no analog for (SURVEY.md §2.5
+row 5): every parameter carries logical axis names which LogicalRules lower
+to mesh axes — the same model runs DP, FSDP, TP, SP or any mix by changing
+the TPUJob sharding spec, with XLA inserting the collectives.
+
+TPU design notes:
+- bfloat16 activations/compute, float32 params + layernorm.
+- attention QKV as one fused projection (one big MXU matmul).
+- sequence-parallel ready: activations carry a "sequence" logical axis;
+  with sharding.sequence > 1 XLA shards the sequence dim and the attention
+  block computes over gathered K/V (ring attention kernel in ops/ replaces
+  the gather for long context).
+- causal mask built with lax-friendly iota, no dynamic shapes.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..parallel.sharding_rules import TRANSFORMER_RULES
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 12
+    embed_dim: int = 768
+    num_heads: int = 12
+    head_dim: int = 64
+    mlp_dim: int = 3072
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    remat: bool = False          # jax.checkpoint each block (HBM for FLOPs)
+
+    @classmethod
+    def tiny(cls) -> "TransformerConfig":
+        return cls(vocab_size=256, num_layers=2, embed_dim=64, num_heads=4,
+                   head_dim=16, mlp_dim=128, max_seq_len=128)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, S, E = x.shape
+        qkv = nn.DenseGeneral(
+            (3, cfg.num_heads, cfg.head_dim), axis=-1, dtype=cfg.dtype,
+            param_dtype=jnp.float32, use_bias=False, name="qkv")(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = q / jnp.sqrt(cfg.head_dim).astype(cfg.dtype)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        logits = jnp.where(mask[None, None], logits, jnp.finfo(cfg.dtype).min)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return nn.DenseGeneral(
+            E, axis=(-2, -1), dtype=cfg.dtype, param_dtype=jnp.float32,
+            use_bias=False, name="out")(out)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     use_bias=False, name="wi")(x)
+        h = nn.gelu(h)
+        return nn.Dense(x.shape[-1], dtype=cfg.dtype, param_dtype=jnp.float32,
+                        use_bias=False, name="wo")(h)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + Attention(self.cfg, name="attn")(y)
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        return x + MLP(self.cfg, name="mlp")(y)
+
+
+class TransformerLM(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.embed_dim,
+                     param_dtype=jnp.float32, dtype=cfg.dtype,
+                     name="tok_embed")(tokens)
+        pos = nn.Embed(cfg.max_seq_len, cfg.embed_dim,
+                       param_dtype=jnp.float32, dtype=cfg.dtype,
+                       name="pos_embed")(jnp.arange(tokens.shape[1]))
+        x = x + pos[None]
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block)
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"layer{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, dtype=jnp.float32,
+                          param_dtype=jnp.float32, use_bias=False,
+                          name="head")(x)
+        return logits
+
+
+# Param-path → logical axes. Order matters: first match wins.
+_LOGICAL_PATTERNS: list[tuple[str, tuple]] = [
+    (r"tok_embed.*embedding", ("vocab", "embed")),
+    (r"pos_embed.*embedding", (None, "embed")),
+    (r"attn/qkv.*kernel", ("embed", None, "heads", "head_dim")),
+    (r"attn/out.*kernel", ("heads", "head_dim", "embed")),
+    (r"mlp/wi.*kernel", ("embed", "mlp")),
+    (r"mlp/wo.*kernel", ("mlp", "embed")),
+    (r"head.*kernel", ("embed", "vocab")),
+    (r"(ln\d*|ln_f)/(scale|bias)", ("embed",)),
+]
+
+
+def logical_axes(params) -> Any:
+    """Pytree (matching params) of logical-axis tuples, by path pattern."""
+
+    def assign(path, leaf):
+        path_str = "/".join(str(getattr(p, "key", p)) for p in path)
+        for pat, axes in _LOGICAL_PATTERNS:
+            if re.search(pat, path_str):
+                assert len(axes) == leaf.ndim, \
+                    f"{path_str}: {axes} vs shape {leaf.shape}"
+                return axes
+        return tuple([None] * leaf.ndim)
+
+    return jax.tree_util.tree_map_with_path(assign, params)
+
+
+def make_loss_fn(model: TransformerLM) -> Callable:
+    def loss_fn(params, variables, batch, rng):
+        tokens = batch["tokens"]
+        logits = model.apply({"params": params}, tokens[:, :-1])
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        loss = -jnp.mean(ll)
+        return loss, {"perplexity": jnp.exp(loss)}
+
+    return loss_fn
+
+
+def init_fn(model: TransformerLM, seq_len: int, batch: int = 2) -> Callable:
+    def _init(rng):
+        variables = model.init(
+            rng, jnp.zeros((batch, seq_len - 1), jnp.int32))
+        params = variables.pop("params")
+        return params, dict(variables)
+
+    return _init
+
+
+def synthetic_batch(rng: jax.Array, batch_size: int, seq_len: int,
+                    vocab_size: int) -> dict:
+    return {"tokens": jax.random.randint(
+        rng, (batch_size, seq_len), 0, vocab_size)}
+
+
+def workload_spec(cfg: Optional[TransformerConfig] = None,
+                  seq_len: Optional[int] = None):
+    """WorkloadSpec factory for runtime.worker (annotated for TP/SP/FSDP)."""
+    from ..runtime.worker import WorkloadSpec
+    cfg = cfg or TransformerConfig.tiny()
+    seq_len = seq_len or cfg.max_seq_len
+    model = TransformerLM(cfg)
+    abstract = jax.eval_shape(
+        lambda rng: init_fn(model, seq_len)(rng)[0], jax.random.PRNGKey(0))
+    return WorkloadSpec(
+        name="transformer",
+        init_fn=init_fn(model, seq_len),
+        loss_fn=make_loss_fn(model),
+        batch_fn=lambda rng, bs: synthetic_batch(rng, bs, seq_len,
+                                                 cfg.vocab_size),
+        rules=TRANSFORMER_RULES,
+        param_logical_axes=logical_axes(abstract),
+    )

@@ -1,0 +1,19 @@
+"""Parallelism as data: mesh construction + sharding rules + collectives.
+
+The reference has NO tensor/pipeline/sequence/expert parallelism anywhere
+(SURVEY.md §2.5 row 5 — it only scales data-parallel replica counts and
+delegates the rest to the launched frameworks). This package supplies those
+natively, the TPU way: one jax.sharding.Mesh with named axes, GSPMD sharding
+annotations, and XLA collectives over ICI/DCN — no NCCL, no MPI, no
+user-space communication library.
+"""
+
+from .mesh import (MESH_AXES, build_mesh, data_axes, local_batch_size,
+                   mesh_from_contract, mesh_shape_from_sharding)
+from .sharding_rules import LogicalRules, RESNET_RULES, TRANSFORMER_RULES
+
+__all__ = [
+    "MESH_AXES", "build_mesh", "mesh_from_contract", "mesh_shape_from_sharding",
+    "data_axes", "local_batch_size", "LogicalRules", "RESNET_RULES",
+    "TRANSFORMER_RULES",
+]

@@ -1,0 +1,95 @@
+"""Device-mesh construction from slice topology + sharding spec.
+
+Axis order is DCN-major → ICI-minor so that:
+- the ``data`` axis (pure DP) maps across slices (DCN all-reduce once per
+  step, latency-tolerant gradient sums), and
+- ``tensor``/``sequence`` (latency-sensitive per-layer collectives) map to
+  the innermost ICI dimension.
+
+This is the standard TPU sharding recipe ("How to Scale Your Model"): pick a
+mesh, annotate shardings, let XLA insert the collectives.
+
+Reference parity: the analog of the operator-rendered TF_CONFIG cluster dict
+(SURVEY.md §3.2) consumed at workload startup — here the contract (env) is
+consumed by `mesh_from_contract` in the worker bootstrap.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..api.topology import TopologyContract
+from ..api.trainingjob import ShardingSpec
+
+# Canonical axis order (DCN-major). "data" first: multi-slice DP rides DCN.
+MESH_AXES = ShardingSpec.AXES  # ("data", "fsdp", "expert", "pipeline", "sequence", "tensor")
+
+
+def mesh_shape_from_sharding(sharding: ShardingSpec, num_devices: int) -> dict[str, int]:
+    """Resolve the sharding spec against the global device count."""
+    return sharding.resolve(num_devices)
+
+
+def build_mesh(sharding: Optional[ShardingSpec] = None,
+               devices: Optional[list] = None) -> Mesh:
+    """Build the global mesh over all (or the given) devices.
+
+    Device order: jax's default device list is already ICI-contiguous per
+    process; reshaping row-major into the axis sizes puts the innermost axes
+    (tensor/sequence) on ICI neighbors and the outermost (data) across
+    slices/hosts — the DCN-major layout.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    sharding = sharding or ShardingSpec()
+    sizes = sharding.resolve(len(devices))
+    shape = tuple(sizes[a] for a in MESH_AXES)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def mesh_from_contract(contract: TopologyContract,
+                       sharding: Optional[ShardingSpec] = None) -> Mesh:
+    """Worker-side mesh construction from the operator-rendered contract.
+
+    Validates that the contract's chip count matches the visible devices
+    (after jax.distributed.initialize every process sees the global device
+    list).
+    """
+    expected = contract.slice_topology.num_chips * contract.num_slices
+    devices = jax.devices()
+    if len(devices) != expected:
+        raise RuntimeError(
+            f"topology contract promises {expected} chips "
+            f"({contract.slice_topology.name} x {contract.num_slices}) but "
+            f"jax sees {len(devices)} devices — slice not fully up?"
+        )
+    return build_mesh(sharding, devices)
+
+
+def data_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Axes over which the batch is split (everything data-parallel-like)."""
+    return tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1) or ("data",)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch dim sharded over data+fsdp; sequence dim over the sequence axis."""
+    return NamedSharding(mesh, P(data_axes(mesh)))
+
+
+def local_batch_size(global_batch: int, mesh: Mesh) -> int:
+    dp = 1
+    for a in ("data", "fsdp"):
+        dp *= mesh.shape.get(a, 1)
+    if global_batch % dp:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"data-parallel degree {dp}")
+    return global_batch // dp
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

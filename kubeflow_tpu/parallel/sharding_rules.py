@@ -1,0 +1,94 @@
+"""Logical-axis sharding rules: parameter names → mesh axes.
+
+Models annotate parameters with *logical* axis names ("embed", "mlp",
+"heads", "kv", "vocab", "expert", "stage", ...). A LogicalRules table maps
+logical axes to mesh axes (or None = replicated). This decouples model code
+from the parallelism strategy: the same model runs pure-DP, FSDP, TP, EP or
+any combination by swapping rules — the GSPMD idiom (flax logical axes /
+t5x partitioning).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AxisTarget = Union[str, tuple[str, ...], None]
+
+
+class LogicalRules:
+    """Ordered mapping logical-axis-name → mesh axis (or axes, or None)."""
+
+    def __init__(self, rules: Sequence[tuple[str, AxisTarget]]):
+        self.rules = list(rules)
+        self._map = dict(self.rules)
+
+    def spec_for(self, logical_axes: Sequence[Optional[str]],
+                 mesh: Optional[Mesh] = None) -> P:
+        """PartitionSpec for a param annotated with logical axes.
+
+        Mesh axes of size 1 (or absent) are dropped to keep XLA specs clean;
+        a mesh axis may be consumed by at most one dimension of a given param
+        (first dimension wins, later dims replicate), matching GSPMD rules.
+        """
+        used: set[str] = set()
+        out = []
+        for ax in logical_axes:
+            target = self._map.get(ax) if ax is not None else None
+            if target is None:
+                out.append(None)
+                continue
+            targets = (target,) if isinstance(target, str) else tuple(target)
+            kept = []
+            for t in targets:
+                if mesh is not None and mesh.shape.get(t, 1) <= 1:
+                    continue
+                if t in used:
+                    continue
+                kept.append(t)
+                used.add(t)
+            out.append(tuple(kept) if len(kept) > 1 else (kept[0] if kept else None))
+        while out and out[-1] is None:
+            out.pop()
+        return P(*out)
+
+    def sharding_for(self, logical_axes: Sequence[Optional[str]],
+                     mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, self.spec_for(logical_axes, mesh))
+
+    def tree_shardings(self, mesh: Mesh, logical_tree) -> dict:
+        """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+        return jax.tree.map(
+            lambda axes: self.sharding_for(axes, mesh),
+            logical_tree,
+            is_leaf=lambda x: isinstance(x, tuple) and all(
+                a is None or isinstance(a, str) for a in x),
+        )
+
+
+# Default rule tables. "embed"-style activations shard over tensor; params
+# additionally shard over fsdp for ZeRO-3-style weight sharding.
+TRANSFORMER_RULES = LogicalRules([
+    ("batch", ("data", "fsdp")),
+    ("sequence", "sequence"),
+    ("embed", "fsdp"),          # weight-sharding axis for FSDP
+    ("mlp", "tensor"),
+    ("heads", "tensor"),
+    ("kv", None),
+    ("head_dim", None),
+    ("vocab", "tensor"),
+    ("expert", "expert"),
+    ("stage", "pipeline"),
+])
+
+RESNET_RULES = LogicalRules([
+    ("batch", ("data", "fsdp")),
+    ("height", None),
+    ("width", None),
+    ("in_chan", None),
+    ("out_chan", "tensor"),     # channel-wise TP for the widest convs
+    ("features", "tensor"),
+    ("classes", None),
+])

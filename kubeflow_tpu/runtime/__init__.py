@@ -1,0 +1,11 @@
+"""The in-pod JAX worker runtime (new relative to the reference, which
+delegated compute to the launched frameworks — SURVEY.md §7 phase 4).
+
+- ``bootstrap``: consume the operator-rendered topology contract env,
+  jax.distributed.initialize, build the mesh.
+- ``trainstep``: pjit-compiled train-step engine over sharded state.
+- ``checkpoint``: orbax-backed checkpoint/resume (core component; the
+  reference only passed storage paths through to workloads).
+- ``metrics``: per-step timing, throughput, JSONL metrics, profiler hooks.
+- ``worker``: the in-pod main loop gluing the above (tf-cnn launcher analog).
+"""

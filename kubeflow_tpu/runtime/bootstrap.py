@@ -1,0 +1,113 @@
+"""Worker bootstrap: topology-contract env → jax.distributed → Mesh.
+
+The TPU-native analog of launcher.py:68-88 (TF_CONFIG → CLI flags → TF gRPC
+server): the operator rendered KFTPU_* env (api.topology.TopologyContract);
+this module consumes it, initializes the JAX distributed runtime (the
+coordinator replaces the PS/hostfile machinery), and builds the global mesh.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+from ..api.topology import TopologyContract, parse_topology
+from ..api.trainingjob import ShardingSpec
+from ..parallel.mesh import build_mesh
+
+log = logging.getLogger(__name__)
+
+ENV_SHARDING = "KFTPU_SHARDING"
+
+
+@dataclass
+class WorkerContext:
+    contract: Optional[TopologyContract]
+    sharding: ShardingSpec
+    mesh: Mesh
+    process_id: int
+    num_processes: int
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def sharding_from_env(env) -> ShardingSpec:
+    raw = env.get(ENV_SHARDING)
+    if not raw:
+        return ShardingSpec()
+    sizes = json.loads(raw)
+    return ShardingSpec(**{k: int(v) for k, v in sizes.items()})
+
+
+def initialize(env=None, strict: bool = False) -> WorkerContext:
+    """Bring up the worker. With no contract env (local dev, tests), builds a
+    single-process mesh over whatever devices are visible.
+
+    strict=True enforces that visible devices match the contract (production
+    pods); strict=False logs and falls back to the visible device count
+    (dev machines, CPU meshes).
+    """
+    env = env if env is not None else os.environ
+    contract = None
+    if TopologyContract.ENV_TOPOLOGY in env:
+        contract = TopologyContract.from_env(env)
+        if contract.num_processes > 1:
+            # The gang's rendezvous: every pod blocks here until the whole
+            # slice is up — the runtime-side half of gang scheduling.
+            jax.distributed.initialize(
+                coordinator_address=contract.coordinator_address,
+                num_processes=contract.num_processes,
+                process_id=contract.process_id,
+            )
+    sharding = sharding_from_env(env)
+    if contract is not None:
+        expected = contract.slice_topology.num_chips * contract.num_slices
+        visible = len(jax.devices())
+        if visible != expected:
+            msg = (f"contract promises {expected} chips, jax sees {visible}")
+            if strict:
+                raise RuntimeError(msg)
+            log.warning("%s — falling back to visible devices", msg)
+            sharding = _refit_sharding(sharding, visible)
+    mesh = build_mesh(sharding)
+    return WorkerContext(
+        contract=contract,
+        sharding=sharding,
+        mesh=mesh,
+        process_id=contract.process_id if contract else jax.process_index(),
+        num_processes=contract.num_processes if contract else jax.process_count(),
+    )
+
+
+def _refit_sharding(sharding: ShardingSpec, num_devices: int) -> ShardingSpec:
+    """Shrink a sharding spec to a smaller device count, preserving axis
+    ratios where possible (dev fallback only)."""
+    try:
+        sharding.resolve(num_devices)
+        return sharding
+    except ValueError:
+        log.warning("sharding %s does not fit %d devices; using pure DP",
+                    sharding.axis_sizes(), num_devices)
+        return ShardingSpec()
+
+
+def context_for_topology(name: str, sharding: Optional[ShardingSpec] = None
+                         ) -> WorkerContext:
+    """Dev helper: build a context as if running on the named topology,
+    over the locally visible devices (e.g. 8 virtual CPU devices)."""
+    topo = parse_topology(name)
+    sharding = sharding or ShardingSpec()
+    mesh = build_mesh(sharding)
+    contract = TopologyContract(
+        coordinator_address="localhost:8476", num_processes=1, process_id=0,
+        slice_topology=topo)
+    return WorkerContext(contract=contract, sharding=sharding, mesh=mesh,
+                         process_id=0, num_processes=1)

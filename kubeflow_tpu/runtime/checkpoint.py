@@ -1,0 +1,69 @@
+"""Checkpoint / resume via orbax.
+
+A core component here (the reference delegates model checkpoints entirely to
+workloads via storage params — SURVEY.md §5 "Checkpoint/resume"); the TPUJob
+controller exposes `resumeFrom`, and this module is what the worker runtime
+calls. Restore is sharding-aware: each host restores only its shards.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+import jax
+
+log = logging.getLogger(__name__)
+
+try:
+    import orbax.checkpoint as ocp
+    HAVE_ORBAX = True
+except ImportError:  # pragma: no cover
+    ocp = None
+    HAVE_ORBAX = False
+
+
+class CheckpointManager:
+    """Thin wrapper over orbax CheckpointManager for TrainState pytrees."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 save_interval_steps: int = 1):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        if not HAVE_ORBAX:
+            raise RuntimeError("orbax-checkpoint is not available")
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps),
+        )
+
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        saved = self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force)
+        if saved:
+            log.info("checkpoint saved at step %d -> %s", step, self.directory)
+        return saved
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, state_template: Any, step: Optional[int] = None) -> Any:
+        """Restore into the template's shardings (template = an abstract or
+        concrete TrainState with the target shardings attached)."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            if hasattr(x, "sharding") else x,
+            state_template)
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,106 @@
+"""Per-step metrics, throughput, and profiler hooks.
+
+The reference has NO in-repo tracing/profiling (SURVEY.md §5 — perf
+measurement was kubebench CSV post-processing only). Here it is first-class:
+a step timer that reports examples/sec, a JSONL metrics sink (the kubebench
+reporter consumes it), and jax.profiler trace capture around chosen steps.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class StepStats:
+    step: int
+    step_time_s: float
+    examples_per_sec: float
+    metrics: dict[str, float] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"step": self.step, "step_time_s": self.step_time_s,
+                "examples_per_sec": self.examples_per_sec, **self.metrics}
+
+
+class MetricsLogger:
+    """Accumulates per-step stats; optionally streams JSONL to a file."""
+
+    def __init__(self, path: Optional[str] = None, batch_size: int = 0,
+                 log_every: int = 10):
+        self.path = path
+        self.batch_size = batch_size
+        self.log_every = log_every
+        self.history: list[StepStats] = []
+        self._last_t: Optional[float] = None
+        self._fh = open(path, "a") if path else None
+
+    def start_step(self) -> None:
+        self._last_t = time.perf_counter()
+
+    def end_step(self, step: int, metrics: Optional[dict] = None) -> StepStats:
+        now = time.perf_counter()
+        dt = now - (self._last_t if self._last_t is not None else now)
+        self._last_t = now
+        scalars = {}
+        for k, v in (metrics or {}).items():
+            try:
+                scalars[k] = float(v)
+            except (TypeError, ValueError):
+                continue
+        stats = StepStats(
+            step=step, step_time_s=dt,
+            examples_per_sec=(self.batch_size / dt) if dt > 0 else 0.0,
+            metrics=scalars)
+        self.history.append(stats)
+        if self._fh:
+            self._fh.write(json.dumps(stats.to_dict()) + "\n")
+            self._fh.flush()
+        if self.log_every and step % self.log_every == 0:
+            log.info("step %d: %.1f ex/s %s", step, stats.examples_per_sec,
+                     scalars)
+        return stats
+
+    def summary(self, warmup: int = 1) -> dict[str, float]:
+        """Steady-state throughput, skipping compile/warmup steps."""
+        steady = self.history[warmup:] if len(self.history) > warmup \
+            else self.history
+        if not steady:
+            return {"steps": 0, "examples_per_sec": 0.0, "mean_step_time_s": 0.0}
+        times = [s.step_time_s for s in steady]
+        return {
+            "steps": len(self.history),
+            "mean_step_time_s": sum(times) / len(times),
+            "examples_per_sec": (self.batch_size * len(times) / sum(times))
+            if sum(times) else 0.0,
+        }
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+@contextlib.contextmanager
+def profile_trace(out_dir: Optional[str], enabled: bool = True):
+    """Capture an XLA/JAX profiler trace around a block (view in XProf /
+    tensorboard-plugin-profile)."""
+    if not (enabled and out_dir):
+        yield
+        return
+    import jax
+    os.makedirs(out_dir, exist_ok=True)
+    jax.profiler.start_trace(out_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log.info("profiler trace written to %s", out_dir)

@@ -1,0 +1,171 @@
+"""The pjit train-step engine.
+
+Everything inside one XLA computation: forward, backward, gradient
+all-reduce (inserted by XLA over ICI/DCN from the sharding annotations),
+optimizer update. No user-space communication — the TPU-native replacement
+for the reference's PS gRPC / Horovod-NCCL step loops (SURVEY.md §2.5).
+
+Design points for the MXU/HBM:
+- params live in float32, compute in bfloat16 (models cast), optimizer
+  update in float32;
+- the whole state is donated so the update is in-place in HBM;
+- optional jax.checkpoint (remat) policy for memory-bound models.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import data_axes
+from ..parallel.sharding_rules import LogicalRules
+
+PyTree = Any
+# loss_fn(params, variables, batch, rng) -> (loss, aux_dict)
+LossFn = Callable[[PyTree, PyTree, PyTree, jax.Array], tuple[jax.Array, dict]]
+
+
+@dataclass
+class TrainState:
+    step: jax.Array
+    params: PyTree
+    opt_state: PyTree
+    variables: PyTree = field(default_factory=dict)  # e.g. batch_stats
+    rng: Optional[jax.Array] = None
+
+
+def tree_logical_shardings(mesh: Mesh, rules: LogicalRules,
+                           logical_axes: PyTree) -> PyTree:
+    return rules.tree_shardings(mesh, logical_axes)
+
+
+def replicated_like(mesh: Mesh, tree: PyTree) -> PyTree:
+    rep = NamedSharding(mesh, P())
+    return jax.tree.map(lambda _: rep, tree)
+
+
+@dataclass
+class TrainStepBuilder:
+    """Builds the jitted init and step functions for one training setup."""
+
+    mesh: Mesh
+    loss_fn: LossFn
+    optimizer: optax.GradientTransformation
+    rules: Optional[LogicalRules] = None
+    # pytree (matching params) of logical-axis tuples; None = replicate all
+    param_logical_axes: Optional[PyTree] = None
+    donate: bool = True
+
+    # -- shardings ----------------------------------------------------------
+
+    def param_shardings(self, params: PyTree) -> PyTree:
+        if self.rules is None or self.param_logical_axes is None:
+            return replicated_like(self.mesh, params)
+        return self.rules.tree_shardings(self.mesh, self.param_logical_axes)
+
+    def batch_shardings(self, rank: int = 2) -> NamedSharding:
+        """Batch dim over data axes; dim 1 (sequence, for token arrays) over
+        the sequence axis when sequence parallelism is on."""
+        if rank >= 2 and self.mesh.shape.get("sequence", 1) > 1:
+            return NamedSharding(self.mesh, P(data_axes(self.mesh), "sequence"))
+        return NamedSharding(self.mesh, P(data_axes(self.mesh)))
+
+    def state_shardings(self, state: TrainState) -> TrainState:
+        ps = self.param_shardings(state.params)
+        rep = NamedSharding(self.mesh, P())
+        # optimizer state mirrors param sharding where shapes match (adam
+        # moments), else replicated (scalars, counts)
+        opt_sh = _optimizer_shardings(state.opt_state, state.params, ps, rep)
+        return TrainState(
+            step=rep, params=ps, opt_state=opt_sh,
+            variables=replicated_like(self.mesh, state.variables),
+            rng=rep if state.rng is not None else None,
+        )
+
+    # -- init ---------------------------------------------------------------
+
+    def init(self, init_fn: Callable[[jax.Array], tuple[PyTree, PyTree]],
+             rng: jax.Array) -> TrainState:
+        """Initialize params sharded (never materialized replicated when the
+        rules shard them): init under jit with out_shardings."""
+
+        def _init(rng):
+            params, variables = init_fn(rng)
+            opt_state = self.optimizer.init(params)
+            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              opt_state=opt_state, variables=variables,
+                              rng=rng)
+
+        abstract = jax.eval_shape(_init, rng)
+        shardings = self.state_shardings(abstract)
+        with self.mesh:
+            return jax.jit(_init, out_shardings=shardings)(rng)
+
+    # -- step ---------------------------------------------------------------
+
+    def build(self) -> Callable[[TrainState, PyTree], tuple[TrainState, dict]]:
+        def step_fn(state: TrainState, batch: PyTree) -> tuple[TrainState, dict]:
+            rng = state.rng
+            if rng is not None:
+                rng, step_rng = jax.random.split(rng)
+            else:
+                step_rng = jax.random.PRNGKey(0)
+
+            def loss_wrapper(params):
+                return self.loss_fn(params, state.variables, batch, step_rng)
+
+            (loss, aux), grads = jax.value_and_grad(
+                loss_wrapper, has_aux=True)(state.params)
+            updates, new_opt = self.optimizer.update(
+                grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            new_vars = aux.pop("variables", state.variables)
+            metrics = {"loss": loss,
+                       "grad_norm": optax.global_norm(grads), **aux}
+            new_state = TrainState(step=state.step + 1, params=new_params,
+                                   opt_state=new_opt, variables=new_vars,
+                                   rng=rng)
+            return new_state, metrics
+
+        with self.mesh:
+            fn = jax.jit(
+                step_fn,
+                donate_argnums=(0,) if self.donate else (),
+            )
+        return fn
+
+    def place_batch(self, batch: PyTree) -> PyTree:
+        """Shard a host batch onto the mesh (batch dim over data axes;
+        sequence dim over the sequence axis for rank-2 token arrays)."""
+        return jax.tree.map(
+            lambda x: jax.device_put(
+                x, self.batch_shardings(rank=getattr(x, "ndim", 1))
+                if getattr(x, "ndim", 1) == 2 else
+                NamedSharding(self.mesh, P(data_axes(self.mesh)))),
+            batch)
+
+
+def _optimizer_shardings(opt_state, params, param_shardings, rep):
+    """Shard optimizer moments like their matching params; scalars replicate."""
+    flat_params = jax.tree.leaves(params)
+    flat_shardings = jax.tree.leaves(param_shardings)
+    shape_to_sharding = {}
+    for p, s in zip(flat_params, flat_shardings):
+        shape_to_sharding.setdefault(getattr(p, "shape", None), s)
+
+    def pick(leaf):
+        return shape_to_sharding.get(getattr(leaf, "shape", None), rep)
+
+    return jax.tree.map(pick, opt_state)
+
+
+jax.tree_util.register_dataclass(
+    TrainState,
+    data_fields=["step", "params", "opt_state", "variables", "rng"],
+    meta_fields=[],
+)

@@ -1,0 +1,170 @@
+"""The in-pod worker main: bootstrap → train loop → checkpoint → metrics.
+
+The TPU-native launcher (the analog of tf-controller-examples/tf-cnn/
+launcher.py, which parsed TF_CONFIG into tf_cnn_benchmarks flags). Run as:
+
+    python -m kubeflow_tpu.runtime.worker --workload resnet50 --steps 100 ...
+
+inside a TPUJob pod (the operator injects KFTPU_* env), or standalone on a
+dev machine (no env → local mesh over visible devices). Unlike the
+reference's launcher, workers EXIT on completion — the operator's
+cleanPodPolicy handles pod reaping, so no sleep-forever hack
+(launcher.py:91-93).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax
+import optax
+
+from .bootstrap import WorkerContext, initialize
+from .checkpoint import CheckpointManager, HAVE_ORBAX
+from .metrics import MetricsLogger, profile_trace
+from .trainstep import TrainStepBuilder
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkloadSpec:
+    """Everything the loop needs, supplied per-model by the registry."""
+
+    name: str
+    init_fn: Callable                      # rng -> (params, variables)
+    loss_fn: Callable                      # (params, vars, batch, rng) -> (loss, aux)
+    batch_fn: Callable                     # (rng, batch_size) -> batch pytree
+    rules: Optional[object] = None         # LogicalRules
+    param_logical_axes: Optional[object] = None
+
+
+def _resnet_spec(image_size: int = 224, num_classes: int = 1000) -> WorkloadSpec:
+    from ..models import resnet as R
+    model = R.resnet50(num_classes=num_classes)
+    return WorkloadSpec(
+        name="resnet50",
+        init_fn=R.init_fn(model, image_size=image_size),
+        loss_fn=R.make_loss_fn(model),
+        batch_fn=lambda rng, bs: R.synthetic_batch(
+            rng, bs, image_size, num_classes),
+    )
+
+
+def _transformer_spec(**kw) -> WorkloadSpec:
+    from ..models import transformer as T
+    return T.workload_spec(**kw)
+
+
+WORKLOADS: dict[str, Callable[..., WorkloadSpec]] = {
+    "resnet50": _resnet_spec,
+    "transformer": _transformer_spec,
+}
+
+
+@dataclass
+class TrainResult:
+    steps: int
+    examples_per_sec: float
+    mean_step_time_s: float
+    final_metrics: dict
+
+
+def train(
+    workload: str = "resnet50",
+    steps: int = 20,
+    global_batch: int = 64,
+    learning_rate: float = 0.1,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 100,
+    resume: bool = True,
+    metrics_path: Optional[str] = None,
+    profile_dir: Optional[str] = None,
+    ctx: Optional[WorkerContext] = None,
+    workload_kwargs: Optional[dict] = None,
+    seed: int = 0,
+) -> TrainResult:
+    ctx = ctx or initialize()
+    spec = WORKLOADS[workload](**(workload_kwargs or {}))
+    log.info("worker %d/%d mesh=%s workload=%s", ctx.process_id,
+             ctx.num_processes, dict(ctx.mesh.shape), spec.name)
+
+    optimizer = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.sgd(learning_rate, momentum=0.9),
+    )
+    builder = TrainStepBuilder(
+        mesh=ctx.mesh, loss_fn=spec.loss_fn, optimizer=optimizer,
+        rules=spec.rules, param_logical_axes=spec.param_logical_axes)
+
+    rng = jax.random.PRNGKey(seed)
+    state = builder.init(spec.init_fn, rng)
+
+    ckpt = None
+    if checkpoint_dir and HAVE_ORBAX:
+        ckpt = CheckpointManager(checkpoint_dir,
+                                 save_interval_steps=checkpoint_every)
+        if resume and ckpt.latest_step() is not None:
+            state = ckpt.restore(state)
+            log.info("resumed from step %d", int(state.step))
+
+    step_fn = builder.build()
+    mlog = MetricsLogger(metrics_path, batch_size=global_batch)
+    data_rng = jax.random.PRNGKey(seed + 1)
+
+    start_step = int(state.step)
+    last_metrics: dict = {}
+    with profile_trace(profile_dir, enabled=profile_dir is not None):
+        for step in range(start_step, steps):
+            data_rng, brng = jax.random.split(data_rng)
+            batch = builder.place_batch(spec.batch_fn(brng, global_batch))
+            mlog.start_step()
+            state, metrics = step_fn(state, batch)
+            jax.block_until_ready(metrics["loss"])
+            stats = mlog.end_step(step + 1, metrics)
+            last_metrics = {k: float(v) for k, v in metrics.items()}
+            if ckpt is not None:
+                ckpt.save(step + 1, state)
+    if ckpt is not None:
+        ckpt.wait()
+        ckpt.close()
+    summary = mlog.summary(warmup=1)
+    mlog.close()
+    return TrainResult(
+        steps=summary["steps"],
+        examples_per_sec=summary["examples_per_sec"],
+        mean_step_time_s=summary["mean_step_time_s"],
+        final_metrics=last_metrics,
+    )
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(description="kubeflow-tpu training worker")
+    p.add_argument("--workload", default="resnet50", choices=sorted(WORKLOADS))
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--global-batch", type=int, default=64)
+    p.add_argument("--learning-rate", type=float, default=0.1)
+    p.add_argument("--checkpoint-dir")
+    p.add_argument("--checkpoint-every", type=int, default=100)
+    p.add_argument("--no-resume", action="store_true")
+    p.add_argument("--metrics-path")
+    p.add_argument("--profile-dir")
+    args = p.parse_args(argv)
+    result = train(
+        workload=args.workload, steps=args.steps,
+        global_batch=args.global_batch, learning_rate=args.learning_rate,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every, resume=not args.no_resume,
+        metrics_path=args.metrics_path, profile_dir=args.profile_dir)
+    log.info("done: %d steps, %.1f examples/sec", result.steps,
+             result.examples_per_sec)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

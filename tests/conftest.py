@@ -1,18 +1,28 @@
-"""Test config: force an 8-device virtual CPU mesh BEFORE jax is imported.
+"""Test config: force an 8-device virtual CPU mesh BEFORE jax backends init.
 
 All tests run on CPU with 8 virtual devices so multi-chip sharding
 (dp/tp/pp/sp/ep) is exercised without TPU hardware — the build-plan's
 "fake slice backend" tier (SURVEY.md §4).
+
+Note: the axon site hook imports jax at interpreter startup, so env vars
+alone are too late; jax backends are still uninitialized at conftest import,
+so jax.config.update redirects them.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (already in sys.modules via the axon site hook)
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_platform_name", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

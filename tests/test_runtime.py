@@ -1,0 +1,229 @@
+"""Tests for the JAX runtime layer: mesh, sharding rules, train step,
+bootstrap, metrics, checkpoint — all on the virtual 8-device CPU mesh."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from kubeflow_tpu.api.topology import TopologyContract, parse_topology
+from kubeflow_tpu.api.trainingjob import ShardingSpec
+from kubeflow_tpu.parallel.mesh import (build_mesh, data_axes,
+                                        local_batch_size)
+from kubeflow_tpu.parallel.sharding_rules import (LogicalRules,
+                                                  TRANSFORMER_RULES)
+from kubeflow_tpu.runtime.bootstrap import initialize, sharding_from_env
+from kubeflow_tpu.runtime.metrics import MetricsLogger
+from kubeflow_tpu.runtime.trainstep import TrainStepBuilder
+
+
+class TestMesh:
+    def test_default_mesh_is_pure_dp(self):
+        mesh = build_mesh()
+        assert mesh.shape["data"] == 8
+        assert all(mesh.shape[a] == 1 for a in mesh.axis_names if a != "data")
+
+    def test_dp_tp_mesh(self):
+        mesh = build_mesh(ShardingSpec(data=2, tensor=4))
+        assert mesh.shape["data"] == 2 and mesh.shape["tensor"] == 4
+
+    def test_data_axes_includes_fsdp(self):
+        mesh = build_mesh(ShardingSpec(data=2, fsdp=4))
+        assert data_axes(mesh) == ("data", "fsdp")
+        assert local_batch_size(64, mesh) == 8
+
+    def test_local_batch_must_divide(self):
+        mesh = build_mesh(ShardingSpec(data=8))
+        with pytest.raises(ValueError):
+            local_batch_size(12, mesh)
+
+
+class TestLogicalRules:
+    def test_spec_drops_size1_axes(self):
+        mesh = build_mesh(ShardingSpec(data=8))  # tensor axis size 1
+        spec = TRANSFORMER_RULES.spec_for(("embed", "mlp"), mesh)
+        assert spec == jax.sharding.PartitionSpec()  # all collapsed
+
+    def test_axis_used_once_per_param(self):
+        rules = LogicalRules([("a", "tensor"), ("b", "tensor")])
+        mesh = build_mesh(ShardingSpec(data=2, tensor=4))
+        spec = rules.spec_for(("a", "b"), mesh)
+        assert spec == jax.sharding.PartitionSpec("tensor")  # b replicated
+
+    def test_multi_axis_target(self):
+        mesh = build_mesh(ShardingSpec(data=2, fsdp=4))
+        spec = TRANSFORMER_RULES.spec_for(("batch", None), mesh)
+        assert spec == jax.sharding.PartitionSpec(("data", "fsdp"))
+
+
+class TestBootstrap:
+    def test_no_env_local_mesh(self):
+        ctx = initialize(env={})
+        assert ctx.contract is None
+        assert ctx.mesh.shape["data"] == 8
+        assert ctx.is_coordinator
+
+    def test_contract_fallback_nonstrict(self):
+        topo = parse_topology("v5e-32")
+        contract = TopologyContract("c:1", 1, 0, topo)
+        env = {**contract.to_env(),
+               "KFTPU_SHARDING": json.dumps({"data": 2, "tensor": 4})}
+        ctx = initialize(env=env)  # 8 visible != 32 promised -> refit
+        assert ctx.mesh.shape["tensor"] == 4  # 2x4=8 still fits
+
+    def test_contract_strict_raises(self):
+        topo = parse_topology("v5e-32")
+        env = TopologyContract("c:1", 1, 0, topo).to_env()
+        with pytest.raises(RuntimeError, match="promises 32"):
+            initialize(env=env, strict=True)
+
+    def test_sharding_from_env(self):
+        s = sharding_from_env({"KFTPU_SHARDING": json.dumps(
+            {"data": 1, "fsdp": 8, "tensor": 1, "pipeline": 1,
+             "sequence": 1, "expert": 1})})
+        assert s.fsdp == 8
+
+
+def _linear_spec():
+    """Tiny pure-linen-free workload for fast trainstep tests."""
+
+    def init_fn(rng):
+        k1, k2 = jax.random.split(rng)
+        params = {"w": jax.random.normal(k1, (16, 4)) * 0.1,
+                  "b": jnp.zeros((4,))}
+        return params, {}
+
+    def loss_fn(params, variables, batch, rng):
+        pred = batch["x"] @ params["w"] + params["b"]
+        loss = jnp.mean((pred - batch["y"]) ** 2)
+        return loss, {}
+
+    def batch_fn(rng, bs):
+        k1, k2 = jax.random.split(rng)
+        return {"x": jax.random.normal(k1, (bs, 16)),
+                "y": jax.random.normal(k2, (bs, 4))}
+
+    return init_fn, loss_fn, batch_fn
+
+
+class TestTrainStep:
+    def test_loss_decreases_dp(self):
+        init_fn, loss_fn, batch_fn = _linear_spec()
+        mesh = build_mesh(ShardingSpec(data=8))
+        b = TrainStepBuilder(mesh=mesh, loss_fn=loss_fn,
+                             optimizer=optax.sgd(0.1))
+        state = b.init(init_fn, jax.random.PRNGKey(0))
+        step = b.build()
+        losses = []
+        rng = jax.random.PRNGKey(1)
+        for i in range(10):
+            rng, k = jax.random.split(rng)
+            batch = b.place_batch(batch_fn(jax.random.PRNGKey(42), 16))
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0] * 0.5
+        assert int(state.step) == 10
+
+    def test_tp_matches_dp_numerics(self):
+        """The same training run under TP and pure DP must agree — the
+        collectives XLA inserts are numerically transparent."""
+        init_fn, loss_fn, batch_fn = _linear_spec()
+        rules = LogicalRules([("in", "fsdp"), ("out", "tensor")])
+        axes = {"w": ("in", "out"), "b": ("out",)}
+        results = {}
+        for name, spec in [("dp", ShardingSpec(data=8)),
+                           ("tp", ShardingSpec(data=2, fsdp=2, tensor=2))]:
+            mesh = build_mesh(spec)
+            b = TrainStepBuilder(mesh=mesh, loss_fn=loss_fn,
+                                 optimizer=optax.sgd(0.1), rules=rules,
+                                 param_logical_axes=axes)
+            state = b.init(init_fn, jax.random.PRNGKey(0))
+            step = b.build()
+            batch = b.place_batch(batch_fn(jax.random.PRNGKey(7), 16))
+            for _ in range(3):
+                state, m = step(state, batch)
+            results[name] = float(m["loss"])
+        np.testing.assert_allclose(results["dp"], results["tp"], rtol=1e-5)
+
+    def test_params_actually_sharded(self):
+        init_fn, loss_fn, _ = _linear_spec()
+        rules = LogicalRules([("in", None), ("out", "tensor")])
+        mesh = build_mesh(ShardingSpec(data=2, tensor=4))
+        b = TrainStepBuilder(mesh=mesh, loss_fn=loss_fn,
+                             optimizer=optax.adam(1e-3), rules=rules,
+                             param_logical_axes={"w": ("in", "out"),
+                                                 "b": ("out",)})
+        state = b.init(init_fn, jax.random.PRNGKey(0))
+        assert state.params["w"].sharding.spec == \
+            jax.sharding.PartitionSpec(None, "tensor")
+        # adam moments shard like their params
+        mu_w = state.opt_state[0].mu["w"]
+        assert mu_w.sharding.spec == jax.sharding.PartitionSpec(None, "tensor")
+
+
+class TestTinyModels:
+    def test_transformer_tiny_trains(self):
+        from kubeflow_tpu.models import transformer as T
+        from kubeflow_tpu.runtime.worker import train
+        from kubeflow_tpu.runtime.bootstrap import WorkerContext
+        ctx = initialize(env={"KFTPU_SHARDING": json.dumps(
+            {"data": 2, "fsdp": 2, "tensor": 2})})
+        r = train(workload="transformer", steps=2, global_batch=8, ctx=ctx)
+        assert r.steps == 2
+        assert r.final_metrics["loss"] > 0
+
+    def test_transformer_logical_axes_cover_all_params(self):
+        from kubeflow_tpu.models import transformer as T
+        cfg = T.TransformerConfig.tiny()
+        model = T.TransformerLM(cfg)
+        params = jax.eval_shape(
+            lambda rng: model.init(rng, jnp.zeros((1, 8), jnp.int32)),
+            jax.random.PRNGKey(0))["params"]
+        axes = T.logical_axes(params)
+        flat = jax.tree.leaves(
+            axes, is_leaf=lambda x: isinstance(x, tuple))
+        # every kernel/embedding got a non-trivial annotation
+        annotated = [a for a in flat if any(x is not None for x in a)]
+        assert len(annotated) >= cfg.num_layers * 4 + 3
+
+
+class TestMetrics:
+    def test_summary_skips_warmup(self, tmp_path):
+        m = MetricsLogger(str(tmp_path / "m.jsonl"), batch_size=10,
+                          log_every=0)
+        import time
+        for i in range(3):
+            m.start_step()
+            time.sleep(0.01)
+            m.end_step(i + 1, {"loss": 1.0})
+        s = m.summary(warmup=1)
+        assert s["steps"] == 3
+        assert s["examples_per_sec"] > 0
+        m.close()
+        lines = (tmp_path / "m.jsonl").read_text().strip().splitlines()
+        assert len(lines) == 3 and json.loads(lines[0])["loss"] == 1.0
+
+
+@pytest.mark.slow
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path):
+        from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+        init_fn, loss_fn, batch_fn = _linear_spec()
+        mesh = build_mesh(ShardingSpec(data=8))
+        b = TrainStepBuilder(mesh=mesh, loss_fn=loss_fn,
+                             optimizer=optax.sgd(0.1))
+        state = b.init(init_fn, jax.random.PRNGKey(0))
+        step = b.build()
+        state, _ = step(state, b.place_batch(batch_fn(jax.random.PRNGKey(1), 16)))
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(1, state, force=True)
+        mgr.wait()
+        restored = mgr.restore(state)
+        np.testing.assert_allclose(np.asarray(restored.params["w"]),
+                                   np.asarray(state.params["w"]))
+        assert int(restored.step) == 1
+        mgr.close()

@@ -39,6 +39,17 @@ class TransformerConfig:
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
     remat: bool = False          # jax.checkpoint each block (HBM for FLOPs)
+    # attention implementation: "einsum" (XLA-fused reference), "flash"
+    # (Pallas fused kernel, ops/flash_attention), or "ring" (sequence-
+    # parallel ring attention over mesh axis "sequence" for long context)
+    attention: str = "einsum"
+    mesh: Any = None             # required for attention="ring"
+
+    def __post_init__(self):
+        valid = ("einsum", "flash", "ring")
+        if self.attention not in valid:
+            raise ValueError(
+                f"attention={self.attention!r} not in {valid}")
 
     @classmethod
     def tiny(cls) -> "TransformerConfig":
@@ -57,12 +68,22 @@ class Attention(nn.Module):
             (3, cfg.num_heads, cfg.head_dim), axis=-1, dtype=cfg.dtype,
             param_dtype=jnp.float32, use_bias=False, name="qkv")(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        q = q / jnp.sqrt(cfg.head_dim).astype(cfg.dtype)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
-        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
-        logits = jnp.where(mask[None, None], logits, jnp.finfo(cfg.dtype).min)
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        if cfg.attention == "flash":
+            from ..ops import flash_attention
+            out = flash_attention(q, k, v, causal=True)
+        elif cfg.attention == "ring":
+            from ..ops import ring_attention
+            assert cfg.mesh is not None, "attention='ring' needs cfg.mesh"
+            out = ring_attention(q, k, v, mesh=cfg.mesh, causal=True)
+        else:
+            q = q / jnp.sqrt(cfg.head_dim).astype(cfg.dtype)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+            mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+            logits = jnp.where(mask[None, None], logits,
+                               jnp.finfo(cfg.dtype).min)
+            probs = jax.nn.softmax(
+                logits.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         return nn.DenseGeneral(
             E, axis=(-2, -1), dtype=cfg.dtype, param_dtype=jnp.float32,
             use_bias=False, name="out")(out)
@@ -146,13 +167,21 @@ def logical_axes(params) -> Any:
 
 
 def make_loss_fn(model: TransformerLM) -> Callable:
+    """Next-token loss with full-length input and shift-left targets.
+
+    The input keeps length S (not S-1) so the sequence dim stays divisible
+    by the "sequence" mesh axis under sequence parallelism; the final
+    position is masked out of the loss instead.
+    """
+
     def loss_fn(params, variables, batch, rng):
         tokens = batch["tokens"]
-        logits = model.apply({"params": params}, tokens[:, :-1])
-        targets = tokens[:, 1:]
+        logits = model.apply({"params": params}, tokens)
+        targets = jnp.roll(tokens, -1, axis=1)
         logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        loss = -jnp.mean(ll)
+        mask = jnp.ones_like(ll).at[:, -1].set(0.0)  # no target for last pos
+        loss = -jnp.sum(ll * mask) / jnp.sum(mask)
         return loss, {"perplexity": jnp.exp(loss)}
 
     return loss_fn
@@ -161,7 +190,7 @@ def make_loss_fn(model: TransformerLM) -> Callable:
 def init_fn(model: TransformerLM, seq_len: int, batch: int = 2) -> Callable:
     def _init(rng):
         variables = model.init(
-            rng, jnp.zeros((batch, seq_len - 1), jnp.int32))
+            rng, jnp.zeros((batch, seq_len), jnp.int32))
         params = variables.pop("params")
         return params, dict(variables)
 

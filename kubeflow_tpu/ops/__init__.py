@@ -1,0 +1,14 @@
+"""TPU kernel library (Pallas) + SPMD collective ops.
+
+The compute-path hot ops the reference delegates to external frameworks
+(SURVEY.md §2.5 rows 5-6 — absent upstream, required for the TPU build):
+
+- :mod:`flash_attention` — fused causal attention, Pallas MXU kernel,
+  online-softmax, custom VJP with Pallas backward kernels.
+- :mod:`ring_attention` — sequence/context-parallel attention over the
+  "sequence" mesh axis: K/V chunks rotate the ICI ring via ppermute while
+  each step's block attention overlaps with the transfer (XLA schedules).
+"""
+
+from .flash_attention import flash_attention  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,323 @@
+"""Flash attention for TPU as Pallas kernels (forward + backward).
+
+FlashAttention-2-style online softmax: the S x S score matrix is never
+materialized in HBM; each q-block streams k/v-blocks through VMEM, keeping a
+running (max, sum, accumulator) in f32. The backward pass recomputes scores
+from the saved log-sum-exp (no O(S^2) residuals).
+
+The reference platform has no kernel layer at all (SURVEY.md §5
+"long-context: absent") — this is the TPU-native mechanism behind the
+TPUJob sharding-spec's sequence/context parallelism, used per-chunk by
+:mod:`ring_attention` and directly by the transformer model.
+
+TPU notes:
+- block sizes default to 128 (MXU tile); f32 accumulation via
+  ``preferred_element_type`` on every dot.
+- causal kernels bound the k-loop at the diagonal (no wasted blocks).
+- off-TPU (tests, CPU smoke) the same kernels run with ``interpret=True``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # big-but-finite: avoids NaN from (-inf) - (-inf)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pick_block(seq: int, preferred: int = 128) -> int:
+    """Largest divisor of seq that is <= preferred (TPU-friendly)."""
+    b = min(preferred, seq)
+    while seq % b:
+        b -= 1
+    return b
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_k, seq_k):
+    i = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
+    d = q.shape[-1]
+
+    if causal:
+        # number of k-blocks overlapping [0, (i+1)*bq) — diagonal included
+        num_kv = jax.lax.div((i + 1) * block_q + block_k - 1, block_k)
+    else:
+        num_kv = seq_k // block_k
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return acc, m_new, l
+
+    acc = jnp.zeros((block_q, d), jnp.float32)
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_kv, body, (acc, m, l))
+
+    l = jnp.maximum(l, 1e-30)                          # fully-masked rows
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m[:, 0] + jnp.log(l[:, 0])).astype(jnp.float32)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    """q,k,v: [BH, S, D] → (o [BH,S,D], lse [BH,S])."""
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    grid = (bh, seq_q // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_k=seq_k)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, block_q, block_k, seq_k):
+    i = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
+    d = q.shape[-1]
+
+    if causal:
+        num_kv = jax.lax.div((i + 1) * block_q + block_k - 1, block_k)
+    else:
+        num_kv = seq_k // block_k
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            p = jnp.where(cols <= rows, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(
+        0, num_kv, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q, block_k,
+                    seq_q):
+    j = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                   # [bk, d]
+    v = v_ref[0].astype(jnp.float32)
+    d = k.shape[-1]
+    num_q = seq_q // block_q
+    # causal: q-blocks before the diagonal see nothing of this k-block
+    start_i = jax.lax.div(j * block_k, block_q) if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        p = jnp.exp(s - lse)
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            p = jnp.where(cols <= rows, p, 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # p^T @ do
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # ds^T @ q
+        return dk, dv
+
+    dk = jnp.zeros((block_k, d), jnp.float32)
+    dv = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start_i, num_q, body, (dk, dv))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_k=seq_k),
+        grid=(bh, seq_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_q=seq_q),
+        grid=(bh, seq_k // block_k),
+        in_specs=[
+            pl.BlockSpec((1, seq_q, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, seq_q, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, seq_q), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, seq_q), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(scale, causal, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, scale, causal,
+                            block_q, block_k)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    with_lse: bool = False):
+    """Fused attention. q,k,v: [batch, seq, heads, head_dim].
+
+    Returns [batch, seq, heads, head_dim] (and the per-row log-sum-exp
+    [batch, heads, seq] when ``with_lse`` — the residual ring_attention
+    needs to merge chunks).
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = float(scale if scale is not None else 1.0 / math.sqrt(d))
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+
+    def fold(x):  # [B,S,H,D] -> [B*H, S, D]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    def unfold(x):
+        return x.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+    if with_lse:
+        o, lse = _flash_fwd(fold(q), fold(k), fold(v), scale, causal,
+                            block_q, block_k)
+        return unfold(o), lse.reshape(b, h, sq)
+    return unfold(_flash(fold(q), fold(k), fold(v), scale, causal,
+                         block_q, block_k))
+
+
+def reference_attention(q, k, v, *, causal=True, scale=None):
+    """Naive O(S^2)-memory attention — the correctness oracle for tests."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), jnp.bool_))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)

@@ -1,0 +1,109 @@
+"""Ring attention: sequence/context-parallel attention over the ICI ring.
+
+The sequence axis of the mesh shards Q, K and V along their sequence
+dimension. Each device computes block attention of its local Q chunk
+against the K/V chunk it currently holds, then rotates K/V one hop around
+the ring with ``lax.ppermute`` — after ``ring_size`` steps every Q chunk
+has seen every K/V chunk, with only O(S/n) live memory and the transfer of
+the next chunk overlapping the current block's compute (XLA schedules the
+collective-permute concurrently with the einsums).
+
+Online-softmax accumulation (running max / sum / output in f32) merges the
+per-chunk results exactly — bitwise-independent of ring order.
+
+Reference parity: the reference has *no* long-context mechanism at all
+(SURVEY.md §5 "Long-context: Absent", §2.5 row 5); this op is what the
+TPUJob ``sharding.sequence`` / ``sharding.context`` axes lower to.
+Public-technique citation: Ring Attention (Liu et al. 2023), blockwise
+formulation per PAPERS.md.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _chunk_scores(q, k, scale, my_idx, src_idx, chunk_q, chunk_k, causal):
+    """Masked f32 scores of local q against the chunk that originated at
+    ring position src_idx. [B,Sq,H,D]x[B,Sk,H,D] -> [B,H,Sq,Sk]."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if not causal:
+        return s
+    rows = my_idx * chunk_q + jax.lax.broadcasted_iota(
+        jnp.int32, (chunk_q, chunk_k), 0)
+    cols = src_idx * chunk_k + jax.lax.broadcasted_iota(
+        jnp.int32, (chunk_q, chunk_k), 1)
+    return jnp.where((cols <= rows)[None, None], s, NEG_INF)
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                          scale: float):
+    """SPMD body (runs under shard_map): q,k,v are the local sequence
+    chunks [B, S_local, H, D]."""
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    qf = q.astype(jnp.float32)
+
+    def step(carry, s_idx):
+        acc, m, l, (k_c, v_c) = carry
+        src_idx = (my_idx - s_idx) % n          # origin of the held chunk
+        s = _chunk_scores(qf, k_c.astype(jnp.float32), scale,
+                          my_idx, src_idx, sq, sk, causal)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = (acc * alpha.transpose(0, 2, 1, 3)
+               + jnp.einsum("bhqk,bkhd->bqhd", p,
+                            v_c.astype(jnp.float32),
+                            preferred_element_type=jnp.float32))
+        kv = jax.lax.ppermute((k_c, v_c), axis_name, perm)
+        return (acc, m_new, l, kv), None
+
+    acc0 = jnp.zeros((b, sq, h, d), jnp.float32)
+    m0 = jnp.full((b, h, sq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
+    (acc, m, l, _), _ = jax.lax.scan(
+        step, (acc0, m0, l0, (k, v)), jnp.arange(n))
+    l = jnp.maximum(l, 1e-30)
+    out = acc / l.transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   mesh: Mesh, axis: str = "sequence",
+                   causal: bool = True, scale: Optional[float] = None):
+    """Sequence-parallel attention. q,k,v: [batch, seq, heads, head_dim]
+    with the seq dim (to be) sharded over ``mesh`` axis ``axis``.
+
+    Works inside jit: partial-manual shard_map over the sequence axis only;
+    batch/tensor axes stay under automatic GSPMD sharding.
+    """
+    d = q.shape[-1]
+    scale = float(scale if scale is not None else 1.0 / math.sqrt(d))
+    if mesh.shape.get(axis, 1) <= 1:
+        from .flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+
+    body = functools.partial(
+        _ring_attention_local, axis_name=axis, causal=causal, scale=scale)
+    spec = P(None, axis, None, None)
+    # partial-manual shard_map (axis_names ⊂ mesh axes) only composes
+    # inside jit; the jit wrapper makes eager calls (e.g. flax init) work
+    fn = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names={axis}, check_vma=False))
+    return fn(q, k, v)

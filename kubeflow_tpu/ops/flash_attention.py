@@ -1,9 +1,11 @@
 """Flash attention for TPU as Pallas kernels (forward + backward).
 
 FlashAttention-2-style online softmax: the S x S score matrix is never
-materialized in HBM; each q-block streams k/v-blocks through VMEM, keeping a
-running (max, sum, accumulator) in f32. The backward pass recomputes scores
-from the saved log-sum-exp (no O(S^2) residuals).
+materialized; the grid streams (q-block, k-block) tiles through VMEM while
+running (max, sum, accumulator) state lives in VMEM scratch that persists
+across the innermost grid dimension — memory is O(block^2), not O(S).
+The backward pass recomputes scores from the saved log-sum-exp (no O(S^2)
+residuals).
 
 The reference platform has no kernel layer at all (SURVEY.md §5
 "long-context: absent") — this is the TPU-native mechanism behind the
@@ -11,9 +13,13 @@ TPUJob sharding-spec's sequence/context parallelism, used per-chunk by
 :mod:`ring_attention` and directly by the transformer model.
 
 TPU notes:
-- block sizes default to 128 (MXU tile); f32 accumulation via
-  ``preferred_element_type`` on every dot.
-- causal kernels bound the k-loop at the diagonal (no wasted blocks).
+- block sizes default to 128 (MXU tile) and are kept 8-aligned (f32
+  sublane); shapes with no 8-aligned divisor fall back to the reference
+  implementation rather than feeding Mosaic unaligned tiles.
+- grid order puts k-blocks innermost: XLA/Mosaic double-buffers the
+  k/v-block DMAs against the MXU work automatically.
+- causal tiles above the diagonal skip all compute via pl.when.
+- f32 accumulation via ``preferred_element_type`` on every dot.
 - off-TPU (tests, CPU smoke) the same kernels run with ``interpret=True``.
 """
 
@@ -35,12 +41,37 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _pick_block(seq: int, preferred: int = 128) -> int:
-    """Largest divisor of seq that is <= preferred (TPU-friendly)."""
+def _pick_block(seq: int, preferred: int = 128) -> Optional[int]:
+    """Largest 8-aligned (f32 sublane) divisor of seq that is <= preferred;
+    None if there is none (caller falls back to the reference impl). In
+    interpret mode (no Mosaic tiling) any divisor is fine."""
     b = min(preferred, seq)
-    while seq % b:
-        b -= 1
-    return b
+    if _interpret():
+        while seq % b:
+            b -= 1
+        return b
+    b -= b % 8
+    while b >= 8:
+        if seq % b == 0:
+            return b
+        b -= 8
+    return None
+
+
+def _causal_mask(i, j, block_q, block_k):
+    rows = i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return cols <= rows
+
+
+def _when_relevant(i, j, block_q, block_k, causal):
+    """Run the decorated block only if k-block j intersects the causal
+    triangle of q-block i (always runs when not causal)."""
+    if not causal:
+        return lambda fn: fn()
+    return pl.when(j * block_k <= i * block_q + block_q - 1)
 
 
 # ---------------------------------------------------------------------------
@@ -48,73 +79,76 @@ def _pick_block(seq: int, preferred: int = 128) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_q, block_k, seq_k):
-    i = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
-    d = q.shape[-1]
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, block_q, block_k):
+    i, j = pl.program_id(1), pl.program_id(2)
+    n_k = pl.num_programs(2)
 
-    if causal:
-        # number of k-blocks overlapping [0, (i+1)*bq) — diagonal included
-        num_kv = jax.lax.div((i + 1) * block_q + block_k - 1, block_k)
-    else:
-        num_kv = seq_k // block_k
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
 
-    def body(j, carry):
-        acc, m, l = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @_when_relevant(i, j, block_q, block_k, causal)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bq, bk]
         if causal:
-            rows = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(cols <= rows, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            s = jnp.where(_causal_mask(i, j, block_q, block_k), s, NEG_INF)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot(
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot(
             p, v, preferred_element_type=jnp.float32)
-        return acc, m_new, l
 
-    acc = jnp.zeros((block_q, d), jnp.float32)
-    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, num_kv, body, (acc, m, l))
-
-    l = jnp.maximum(l, 1e-30)                          # fully-masked rows
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m[:, 0] + jnp.log(l[:, 0])).astype(jnp.float32)
+    @pl.when(j == n_k - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:], 1e-30)               # fully-masked rows
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, 0] + jnp.log(l[:, 0])
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
     """q,k,v: [BH, S, D] → (o [BH,S,D], lse [BH,S])."""
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
-    grid = (bh, seq_q // block_q)
+    grid = (bh, seq_q // block_q, seq_k // block_k)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, seq_k=seq_k)
+        block_q=block_q, block_k=block_k)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
             jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),     # acc
+            pltpu.VMEM((block_q, 1), jnp.float32),     # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),     # running sum
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * seq_q * seq_k * d // (2 if causal else 1),
+            bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
+            transcendentals=bh * seq_q * seq_k),
         interpret=_interpret(),
     )(q, k, v)
 
@@ -125,87 +159,81 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, block_q, block_k, seq_k):
-    i = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
-    d = q.shape[-1]
+                   acc_ref, *, scale, causal, block_q, block_k):
+    i, j = pl.program_id(1), pl.program_id(2)
+    n_k = pl.num_programs(2)
 
-    if causal:
-        num_kv = jax.lax.div((i + 1) * block_q + block_k - 1, block_k)
-    else:
-        num_kv = seq_k // block_k
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @_when_relevant(i, j, block_q, block_k, causal)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)
         if causal:
-            rows = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            p = jnp.where(cols <= rows, p, 0.0)
+            p = jnp.where(_causal_mask(i, j, block_q, block_k), p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return dq + jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+        acc_ref[:] += jax.lax.dot(
+            ds, k, preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(
-        0, num_kv, body, jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(j == n_k - 1)
+    def _finish():
+        dq_ref[0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, block_q, block_k,
-                    seq_q):
-    j = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)                   # [bk, d]
-    v = v_ref[0].astype(jnp.float32)
-    d = k.shape[-1]
-    num_q = seq_q // block_q
-    # causal: q-blocks before the diagonal see nothing of this k-block
-    start_i = jax.lax.div(j * block_k, block_q) if causal else 0
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k):
+    # grid: (bh, j over k-blocks, i over q-blocks) — i innermost
+    j, i = pl.program_id(1), pl.program_id(2)
+    n_q = pl.num_programs(2)
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @_when_relevant(i, j, block_q, block_k, causal)
+    def _compute():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
         p = jnp.exp(s - lse)
         if causal:
-            rows = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            p = jnp.where(cols <= rows, p, 0.0)
-        dv = dv + jax.lax.dot_general(
+            p = jnp.where(_causal_mask(i, j, block_q, block_k), p, 0.0)
+        dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # p^T @ do
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        dk = dk + jax.lax.dot_general(
+        dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # ds^T @ q
-        return dk, dv
 
-    dk = jnp.zeros((block_k, d), jnp.float32)
-    dv = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start_i, num_q, body, (dk, dv))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(i == n_q - 1)
+    def _finish():
+        dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
@@ -215,40 +243,45 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_k=seq_k),
-        grid=(bh, seq_q // block_q),
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, seq_q // block_q, seq_k // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_q=seq_q),
-        grid=(bh, seq_k // block_k),
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, seq_k // block_k, seq_q // block_q),
         in_specs=[
-            pl.BlockSpec((1, seq_q, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, seq_q, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, seq_q), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, seq_q), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
@@ -287,15 +320,24 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     with_lse: bool = False):
     """Fused attention. q,k,v: [batch, seq, heads, head_dim].
 
-    Returns [batch, seq, heads, head_dim] (and the per-row log-sum-exp
-    [batch, heads, seq] when ``with_lse`` — the residual ring_attention
-    needs to merge chunks).
+    Returns [batch, seq, heads, head_dim]. With ``with_lse`` also returns
+    the per-row log-sum-exp [batch, heads, seq] (chunk-merge residual for
+    ring attention) — NOTE: the with_lse path is forward-only (no custom
+    VJP); do not differentiate through it.
+
+    Sequence lengths with no 8-aligned block divisor fall back to the
+    reference implementation (Mosaic tiling needs 8-aligned sublanes).
     """
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale = float(scale if scale is not None else 1.0 / math.sqrt(d))
-    block_q = _pick_block(sq, block_q)
-    block_k = _pick_block(sk, block_k)
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+    if bq is None or bk is None:
+        if with_lse:
+            raise ValueError(
+                f"with_lse needs block-divisible seq lens, got {sq},{sk}")
+        return reference_attention(q, k, v, causal=causal, scale=scale)
 
     def fold(x):  # [B,S,H,D] -> [B*H, S, D]
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
@@ -304,15 +346,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         return x.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
     if with_lse:
-        o, lse = _flash_fwd(fold(q), fold(k), fold(v), scale, causal,
-                            block_q, block_k)
+        o, lse = _flash_fwd(fold(q), fold(k), fold(v), scale, causal, bq, bk)
         return unfold(o), lse.reshape(b, h, sq)
-    return unfold(_flash(fold(q), fold(k), fold(v), scale, causal,
-                         block_q, block_k))
+    return unfold(_flash(fold(q), fold(k), fold(v), scale, causal, bq, bk))
 
 
 def reference_attention(q, k, v, *, causal=True, scale=None):
-    """Naive O(S^2)-memory attention — the correctness oracle for tests."""
+    """Naive O(S^2)-memory attention — the correctness oracle for tests
+    and the fallback for shapes the Pallas kernels can't tile."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale

@@ -76,8 +76,11 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
     acc0 = jnp.zeros((b, sq, h, d), jnp.float32)
     m0 = jnp.full((b, h, sq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
+    # checkpoint each ring step: backward recomputes the chunk's scores
+    # instead of storing per-step [B,H,Sq,Sk] probabilities — residual
+    # memory stays O(S) (the rotating K/V chunks), not O(S^2/n)
     (acc, m, l, _), _ = jax.lax.scan(
-        step, (acc0, m0, l0, (k, v)), jnp.arange(n))
+        jax.checkpoint(step), (acc0, m0, l0, (k, v)), jnp.arange(n))
     l = jnp.maximum(l, 1e-30)
     out = acc / l.transpose(0, 2, 1, 3)
     return out.astype(q.dtype)
@@ -98,12 +101,19 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         from .flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, scale=scale)
 
+    return _ring_fn(mesh, axis, causal, scale)(q, k, v)
+
+
+@functools.lru_cache(maxsize=64)
+def _ring_fn(mesh: Mesh, axis: str, causal: bool, scale: float):
+    """Cached jitted shard_map — eager callers (flax init runs once per
+    layer) hit jax's jit cache instead of recompiling per call.
+
+    partial-manual shard_map (axis_names ⊂ mesh axes) only composes
+    inside jit; the jit wrapper also makes eager calls work."""
     body = functools.partial(
         _ring_attention_local, axis_name=axis, causal=causal, scale=scale)
     spec = P(None, axis, None, None)
-    # partial-manual shard_map (axis_names ⊂ mesh axes) only composes
-    # inside jit; the jit wrapper makes eager calls (e.g. flax init) work
-    fn = jax.jit(jax.shard_map(
+    return jax.jit(jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         axis_names={axis}, check_vma=False))
-    return fn(q, k, v)

@@ -1,0 +1,18 @@
+"""TPU serving data plane.
+
+Reference parity (SURVEY.md §3.4): TF-Serving container (gRPC :9000 /
+REST :8500) + Tornado HTTP proxy (components/k8s-model-server/http-proxy/
+server.py) + tf-batch-predict job. Here the model server IS the TPU
+process: a jit-compiled predict function behind a micro-batching queue,
+with a TF-Serving-compatible REST surface.
+
+- :mod:`servable` — model loading (checkpoint → jitted predict), registry.
+- :mod:`batcher`  — micro-batching queue with bucketed padding (static
+  shapes: one XLA compile per bucket, never per request).
+- :mod:`http_server` — REST front: /v1/models/<name>[:predict|/metadata].
+- :mod:`batch_predict` — offline batch prediction job.
+"""
+
+from .servable import Servable, ModelRepository  # noqa: F401
+from .batcher import MicroBatcher  # noqa: F401
+from .http_server import ModelServer  # noqa: F401

@@ -1,0 +1,113 @@
+"""Offline batch prediction job — the tf-batch-predict analog.
+
+Reference: kubeflow/tf-batch-predict/tf-batch-predict.libsonnet:17-31
+(model path, input file patterns, batch size, GPU count → here a TPU
+process). Input is .npy / .npz / .jsonl; output is .jsonl with one
+prediction record per input row, plus a summary line.
+
+TPU note: a fixed batch size (one compiled program) streams the file
+through the device; the tail batch is padded, never recompiled.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import time
+from pathlib import Path
+from typing import Iterator, Optional
+
+import numpy as np
+
+from .servable import ModelRepository, Servable
+
+
+def _iter_input(path: str) -> Iterator[np.ndarray]:
+    if path.endswith(".npy"):
+        yield np.load(path)
+    elif path.endswith(".npz"):
+        data = np.load(path)
+        yield data[list(data.files)[0]]
+    elif path.endswith(".jsonl"):
+        rows = []
+        with open(path) as f:
+            for line in f:
+                if line.strip():
+                    rows.append(json.loads(line)["instance"])
+        if rows:
+            yield np.asarray(rows)
+    else:
+        raise ValueError(f"unsupported input format: {path}")
+
+
+def run_batch_predict(servable: Servable, input_patterns: list[str],
+                      output_path: str, batch_size: int = 64,
+                      input_dtype: Optional[str] = None) -> dict:
+    """Run prediction over all files matching the patterns; returns the
+    summary dict that is also appended to the output file."""
+    files: list[str] = []
+    for pat in input_patterns:
+        files.extend(sorted(glob.glob(pat)))
+    if not files:
+        raise FileNotFoundError(f"no inputs match {input_patterns}")
+
+    out = Path(output_path)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    n_total, t0 = 0, time.perf_counter()
+    with out.open("w") as f:
+        for path in files:
+            for arr in _iter_input(path):
+                if input_dtype:
+                    arr = arr.astype(input_dtype)
+                for i in range(0, arr.shape[0], batch_size):
+                    chunk = arr[i:i + batch_size]
+                    n = chunk.shape[0]
+                    if n < batch_size:  # pad the tail: same compiled shape
+                        pad = np.zeros(
+                            (batch_size - n,) + chunk.shape[1:], chunk.dtype)
+                        chunk = np.concatenate([chunk, pad])
+                    preds = servable.predict(chunk)
+                    preds = {k: np.asarray(v)[:n] for k, v in preds.items()} \
+                        if isinstance(preds, dict) else \
+                        {"output": np.asarray(preds)[:n]}
+                    for j in range(n):
+                        f.write(json.dumps(
+                            {"source": path, "index": n_total + j,
+                             "prediction": {k: np.asarray(v[j]).tolist()
+                                            for k, v in preds.items()}})
+                            + "\n")
+                    n_total += n
+    summary = {"instances": n_total, "files": len(files),
+               "seconds": round(time.perf_counter() - t0, 3),
+               "model": servable.name, "version": servable.version}
+    with out.open("a") as f:
+        f.write(json.dumps({"summary": summary}) + "\n")
+    return summary
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser("tpu-batch-predict")
+    p.add_argument("--model-name", default="model")
+    p.add_argument("--model-type", default="resnet50")
+    p.add_argument("--model-path", default="")
+    p.add_argument("--input-file-patterns", required=True,
+                   help="comma-separated globs")
+    p.add_argument("--output-result-file", required=True)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--input-dtype", default=None)
+    args = p.parse_args(argv)
+
+    repo = ModelRepository()
+    servable = repo.load(args.model_name, args.model_type,
+                         checkpoint_dir=args.model_path or None)
+    summary = run_batch_predict(
+        servable, args.input_file_patterns.split(","),
+        args.output_result_file, batch_size=args.batch_size,
+        input_dtype=args.input_dtype)
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,101 @@
+"""Micro-batching queue: concurrent requests → one device dispatch.
+
+TF-Serving batches on-device; the reference's HTTP proxy forwards one
+request at a time (http-proxy/server.py). On TPU, per-request dispatch
+wastes the MXU — the batcher coalesces requests that arrive within
+``max_latency_ms`` into a single padded batch, runs one jit call, and
+fans results back out to per-request futures.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class _WorkItem:
+    instances: np.ndarray
+    future: Future
+
+
+class MicroBatcher:
+    """Collects requests for one servable and dispatches merged batches."""
+
+    def __init__(self, servable, max_batch: int = 64,
+                 max_latency_ms: float = 5.0):
+        self.servable = servable
+        self.max_batch = max_batch
+        self.max_latency = max_latency_ms / 1000.0
+        self._queue: "queue.Queue[_WorkItem]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"batcher-{servable.name}")
+        self._thread.start()
+
+    def submit(self, instances: np.ndarray) -> Future:
+        if self._stop.is_set():
+            raise RuntimeError("batcher is shut down")
+        item = _WorkItem(np.asarray(instances), Future())
+        self._queue.put(item)
+        return item.future
+
+    def predict(self, instances: np.ndarray, timeout: float = 30.0):
+        return self.submit(instances).result(timeout=timeout)
+
+    def _collect(self) -> list[_WorkItem]:
+        """Block for the first item, then drain what arrives within the
+        latency window (or until the batch is full)."""
+        try:
+            first = self._queue.get(timeout=0.1)
+        except queue.Empty:
+            return []
+        items, total = [first], first.instances.shape[0]
+        deadline = self.max_latency
+        import time
+        t0 = time.perf_counter()
+        while total < self.max_batch:
+            remaining = deadline - (time.perf_counter() - t0)
+            if remaining <= 0:
+                break
+            try:
+                nxt = self._queue.get(timeout=remaining)
+            except queue.Empty:
+                break
+            items.append(nxt)
+            total += nxt.instances.shape[0]
+        return items
+
+    def _loop(self):
+        while not self._stop.is_set():
+            items = self._collect()
+            if not items:
+                continue
+            batch = np.concatenate([it.instances for it in items], axis=0)
+            try:
+                out = self.servable.predict(batch)
+            except Exception as e:  # noqa: BLE001 — fan the error out
+                for it in items:
+                    it.future.set_exception(e)
+                continue
+            ofs = 0
+            for it in items:
+                n = it.instances.shape[0]
+                import jax
+                it.future.set_result(
+                    jax.tree.map(lambda x: x[ofs:ofs + n], out))
+                ofs += n
+
+    def shutdown(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        while True:  # fail any stragglers
+            try:
+                self._queue.get_nowait().future.set_exception(
+                    RuntimeError("batcher shut down"))
+            except queue.Empty:
+                break

@@ -1,0 +1,183 @@
+"""REST model server: the TF-Serving-compatible HTTP surface.
+
+Endpoint shape matches what the reference deploys and its E2E test probes
+(tf-serving.libsonnet REST :8500; testing/test_tf_serving.py:110 posts to
+``:8500/v1/models/mnist:predict``), merged with the http-proxy handlers
+(components/k8s-model-server/http-proxy/server.py:27-40 — predict /
+metadata / status):
+
+- ``GET  /v1/models/<name>``            → version status
+- ``GET  /v1/models/<name>/metadata``   → signature metadata
+- ``POST /v1/models/<name>:predict``    → {"instances": [...]} →
+  {"predictions": [...]}
+- ``GET  /healthz`` and ``GET /metrics`` (prometheus text) — the
+  observability the reference keeps in separate sidecars.
+
+stdlib ThreadingHTTPServer: requests are I/O-light; the device work is
+serialized by the per-model MicroBatcher.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from .batcher import MicroBatcher
+from .servable import ModelRepository, Servable
+
+
+class ModelServer:
+    def __init__(self, repository: Optional[ModelRepository] = None,
+                 host: str = "0.0.0.0", port: int = 8500,
+                 max_batch: int = 64, max_latency_ms: float = 5.0):
+        self.repository = repository or ModelRepository()
+        self.host, self.port = host, port
+        self.max_batch = max_batch
+        self.max_latency_ms = max_latency_ms
+        self._batchers: dict[str, MicroBatcher] = {}
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> int:
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="model-server")
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        for b in self._batchers.values():
+            b.shutdown()
+
+    # -- dispatch -----------------------------------------------------------
+
+    def batcher(self, name: str) -> MicroBatcher:
+        servable = self.repository.get(name)
+        b = self._batchers.get(name)
+        if b is None:
+            b = MicroBatcher(servable, max_batch=self.max_batch,
+                             max_latency_ms=self.max_latency_ms)
+            self._batchers[name] = b
+        return b
+
+    def metrics_text(self) -> str:
+        lines = [
+            "# HELP kubeflow_model_request_count requests per servable",
+            "# TYPE kubeflow_model_request_count counter",
+        ]
+        for name in self.repository.names():
+            s = self.repository.get(name)
+            meta = s.metadata()["stats"]
+            lines.append(
+                f'kubeflow_model_request_count{{model="{name}"}} '
+                f'{meta["request_count"]}')
+            lines.append(
+                f'kubeflow_model_predict_seconds_total{{model="{name}"}} '
+                f'{meta["predict_seconds"]:.6f}')
+        return "\n".join(lines) + "\n"
+
+
+def _make_handler(server: ModelServer):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, code: int, payload, content_type="application/json"):
+            body = (payload if isinstance(payload, bytes)
+                    else json.dumps(payload).encode())
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _error(self, code: int, msg: str):
+            self._send(code, {"error": msg})
+
+        def do_GET(self):
+            path = self.path.rstrip("/")
+            if path == "/healthz":
+                return self._send(200, {"status": "ok"})
+            if path == "/metrics":
+                return self._send(200, server.metrics_text().encode(),
+                                  content_type="text/plain")
+            if path.startswith("/v1/models/"):
+                rest = path[len("/v1/models/"):]
+                try:
+                    if rest.endswith("/metadata"):
+                        name = rest[:-len("/metadata")]
+                        return self._send(
+                            200, server.repository.get(name).metadata())
+                    return self._send(
+                        200, server.repository.get(rest).status())
+                except KeyError as e:
+                    return self._error(404, str(e))
+            self._error(404, f"no route {path}")
+
+        def do_POST(self):
+            if ":" not in self.path:
+                return self._error(404, "expected /v1/models/<name>:predict")
+            route, verb = self.path.rsplit(":", 1)
+            if not route.startswith("/v1/models/") or verb != "predict":
+                return self._error(404, f"no route {self.path}")
+            name = route[len("/v1/models/"):]
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length))
+                instances = np.asarray(req["instances"])
+                if "dtype" in req:
+                    instances = instances.astype(req["dtype"])
+                out = server.batcher(name).predict(instances)
+                predictions = {
+                    k: np.asarray(v).tolist() for k, v in out.items()
+                } if isinstance(out, dict) else np.asarray(out).tolist()
+                self._send(200, {"predictions": predictions})
+            except KeyError as e:
+                self._error(404, str(e))
+            except Exception as e:  # noqa: BLE001 — surface to client
+                self._error(400, f"{type(e).__name__}: {e}")
+
+    return Handler
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    """CLI: the in-pod entry the tpu-serving manifest runs
+    (manifests/serving.py tpu_serving args)."""
+    import argparse
+    p = argparse.ArgumentParser("tpu-model-server")
+    p.add_argument("--model-name", default="model")
+    p.add_argument("--model-type", default="resnet50")
+    p.add_argument("--model-path", default="")
+    p.add_argument("--rest-port", type=int, default=8500)
+    p.add_argument("--max-batch", type=int, default=64)
+    args = p.parse_args(argv)
+
+    repo = ModelRepository()
+    repo.load(args.model_name, args.model_type,
+              checkpoint_dir=args.model_path or None)
+    server = ModelServer(repo, port=args.rest_port,
+                         max_batch=args.max_batch)
+    port = server.start()
+    print(f"model server listening on :{port} "
+          f"(models: {repo.names()})", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

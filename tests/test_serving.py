@@ -1,0 +1,192 @@
+"""Serving data-plane tests: servable buckets, micro-batcher, REST API,
+batch predict — the test_tf_serving.py analog (reference
+testing/test_tf_serving.py:60-124 deploys, probes, posts a predict and
+asserts on the response; here the server runs in-process)."""
+
+import json
+import threading
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving import (MicroBatcher, ModelRepository, ModelServer,
+                                  Servable)
+from kubeflow_tpu.serving.batch_predict import run_batch_predict
+from kubeflow_tpu.serving.servable import next_bucket, register_model
+
+
+@register_model("double")
+def _build_double(dim: int = 4):
+    def init_params():
+        return {"w": jnp.full((dim,), 2.0)}
+
+    def predict(params, x):
+        return {"y": x * params["w"]}
+
+    sig = {"inputs": {"shape": [-1, dim], "dtype": "float32"}}
+    return predict, init_params, sig
+
+
+def _servable(**kw) -> Servable:
+    repo = ModelRepository()
+    return repo.load("double", "double", **kw)
+
+
+def test_next_bucket():
+    assert next_bucket(1, 64) == 1
+    assert next_bucket(3, 64) == 4
+    assert next_bucket(64, 64) == 64
+    assert next_bucket(100, 64) == 64
+
+
+def test_servable_padding_and_split():
+    s = _servable()
+    s.max_batch = 8
+    x = np.arange(12 * 4, dtype=np.float32).reshape(12, 4)
+    out = s.predict(x)  # 12 > max_batch → split into 8 + 4
+    np.testing.assert_allclose(out["y"], x * 2.0)
+    # only buckets ≤ max_batch were compiled
+    assert all(b <= 8 for b in s._compiled)
+
+
+def test_repository_checkpoint_roundtrip(tmp_path):
+    from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    trained = {"params": {"w": jnp.full((4,), 3.0)}}
+    mgr.save(7, trained, force=True)
+    mgr.wait()
+    mgr.close()
+
+    repo = ModelRepository()
+    s = repo.load("double", "double", checkpoint_dir=str(tmp_path / "ckpt"))
+    assert s.version == 7
+    out = s.predict(np.ones((2, 4), np.float32))
+    np.testing.assert_allclose(out["y"], 3.0 * np.ones((2, 4)))
+
+
+def test_repository_unknown_model():
+    repo = ModelRepository()
+    with pytest.raises(KeyError):
+        repo.load("x", "nope")
+    with pytest.raises(KeyError):
+        repo.get("missing")
+
+
+def test_microbatcher_concurrent():
+    s = _servable()
+    b = MicroBatcher(s, max_batch=32, max_latency_ms=20)
+    results = {}
+
+    def worker(i):
+        x = np.full((2, 4), float(i), np.float32)
+        results[i] = b.predict(x)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    b.shutdown()
+    for i in range(8):
+        np.testing.assert_allclose(results[i]["y"], 2.0 * i)
+
+
+def test_microbatcher_error_propagates():
+    s = _servable()
+    b = MicroBatcher(s, max_latency_ms=1)
+    fut = b.submit(np.ones((1, 3), np.float32))  # wrong dim → error
+    with pytest.raises(Exception):
+        fut.result(timeout=10)
+    b.shutdown()
+
+
+@pytest.fixture()
+def server():
+    repo = ModelRepository()
+    repo.load("mnist", "double")
+    srv = ModelServer(repo, host="127.0.0.1", port=0, max_latency_ms=1)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}{path}") as r:
+        return r.status, json.loads(r.read())
+
+
+def _post(srv, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_rest_status_and_metadata(server):
+    code, status = _get(server, "/v1/models/mnist")
+    assert code == 200
+    assert status["model_version_status"][0]["state"] == "AVAILABLE"
+    code, meta = _get(server, "/v1/models/mnist/metadata")
+    assert meta["model_spec"]["name"] == "mnist"
+    code, health = _get(server, "/healthz")
+    assert health == {"status": "ok"}
+
+
+def test_rest_predict(server):
+    code, resp = _post(server, "/v1/models/mnist:predict",
+                       {"instances": [[1, 2, 3, 4], [5, 6, 7, 8]],
+                        "dtype": "float32"})
+    assert code == 200
+    np.testing.assert_allclose(resp["predictions"]["y"],
+                               [[2, 4, 6, 8], [10, 12, 14, 16]])
+
+
+def test_rest_predict_unknown_model(server):
+    code, resp = _post(server, "/v1/models/nope:predict",
+                       {"instances": [[1, 2, 3, 4]]})
+    assert code == 404
+    assert "error" in resp
+
+
+def test_rest_metrics_after_traffic(server):
+    _post(server, "/v1/models/mnist:predict",
+          {"instances": [[1, 2, 3, 4]], "dtype": "float32"})
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics") as r:
+        text = r.read().decode()
+    assert 'kubeflow_model_request_count{model="mnist"}' in text
+
+
+def test_batch_predict_jsonl_and_npy(tmp_path):
+    s = _servable()
+    jsonl = tmp_path / "in.jsonl"
+    with jsonl.open("w") as f:
+        for i in range(5):
+            f.write(json.dumps({"instance": [float(i)] * 4}) + "\n")
+    np.save(tmp_path / "in.npy",
+            np.ones((3, 4), np.float32))
+
+    out = tmp_path / "preds.jsonl"
+    summary = run_batch_predict(
+        s, [str(tmp_path / "in.jsonl"), str(tmp_path / "in.npy")],
+        str(out), batch_size=4, input_dtype="float32")
+    assert summary["instances"] == 8
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    preds = [l for l in lines if "prediction" in l]
+    assert len(preds) == 8
+    np.testing.assert_allclose(preds[1]["prediction"]["y"], [2.0] * 4)
+    assert lines[-1]["summary"]["instances"] == 8
+
+
+def test_batch_predict_no_inputs(tmp_path):
+    s = _servable()
+    with pytest.raises(FileNotFoundError):
+        run_batch_predict(s, [str(tmp_path / "*.npy")], str(tmp_path / "o"))

@@ -138,6 +138,85 @@ class TransformerLM(nn.Module):
         return logits
 
 
+class _Embedder(nn.Module):
+    """Token + position embedding (the pre-pipeline stage-0 prologue)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.embed_dim, param_dtype=jnp.float32,
+                     dtype=cfg.dtype, name="tok_embed")(tokens)
+        pos = nn.Embed(cfg.max_seq_len, cfg.embed_dim,
+                       param_dtype=jnp.float32, dtype=cfg.dtype,
+                       name="pos_embed")(jnp.arange(tokens.shape[1]))
+        return x + pos[None]
+
+
+class _LMHead(nn.Module):
+    """Final layernorm + vocab projection (the post-pipeline epilogue)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        return nn.Dense(self.cfg.vocab_size, dtype=jnp.float32,
+                        param_dtype=jnp.float32, use_bias=False,
+                        name="head")(x)
+
+
+class PipelinedTransformerLM:
+    """Stacked-layer LM for pipeline parallelism (functional, not nn.Module).
+
+    Every block parameter carries a leading ``layers`` dim sharded over the
+    ``pipeline`` mesh axis; apply() routes the blocks through
+    :func:`kubeflow_tpu.parallel.pipeline.pipeline_apply` (GPipe microbatch
+    schedule over ICI ppermute) when the mesh has a pipeline axis, and a
+    plain ``lax.scan`` over layers otherwise — same numerics either way.
+
+    Reference parity: no analog (SURVEY.md §2.5 row 5 — the reference has
+    no pipeline parallelism; this is the TPU-native capability add).
+    """
+
+    def __init__(self, cfg: TransformerConfig):
+        self.cfg = cfg
+        self.embed = _Embedder(cfg)
+        self.block = Block(cfg)
+        self.head = _LMHead(cfg)
+
+    def init(self, rng: jax.Array, tokens: jax.Array) -> dict:
+        r_embed, r_block, r_head = jax.random.split(rng, 3)
+        ev = self.embed.init(r_embed, tokens)
+        x = self.embed.apply(ev, tokens)
+        block_rngs = jax.random.split(r_block, self.cfg.num_layers)
+        p_blocks = jax.vmap(
+            lambda r: self.block.init(r, x)["params"])(block_rngs)
+        hv = self.head.init(r_head, x)
+        return {"embed": ev["params"], "blocks": p_blocks,
+                "head": hv["params"]}
+
+    def apply(self, params: dict, tokens: jax.Array, *,
+              mesh=None, num_microbatches: int = 1) -> jax.Array:
+        from ..parallel.pipeline import pipeline_apply
+        x = self.embed.apply({"params": params["embed"]}, tokens)
+
+        def block_fn(p, h):
+            return self.block.apply({"params": p}, h)
+
+        if self.cfg.remat:
+            block_fn = jax.checkpoint(block_fn)
+        if mesh is not None and mesh.shape.get("pipeline", 1) > 1:
+            x = pipeline_apply(block_fn, params["blocks"], x, mesh=mesh,
+                               num_microbatches=num_microbatches)
+        else:
+            def body(h, p):
+                return block_fn(p, h), None
+            x, _ = jax.lax.scan(body, x, params["blocks"])
+        return self.head.apply({"params": params["head"]}, x)
+
+
 # Param-path → logical axes. Order matters: first match wins.
 _LOGICAL_PATTERNS: list[tuple[str, tuple]] = [
     (r"tok_embed.*embedding", ("vocab", "embed")),
@@ -166,23 +245,46 @@ def logical_axes(params) -> Any:
     return jax.tree_util.tree_map_with_path(assign, params)
 
 
-def make_loss_fn(model: TransformerLM) -> Callable:
+def pipelined_logical_axes(params) -> Any:
+    """Logical axes for the stacked PipelinedTransformerLM param tree:
+    block leaves gain a leading "layers" axis (→ mesh axis "pipeline")."""
+
+    def assign(path, leaf):
+        path_str = "/".join(str(getattr(p, "key", p)) for p in path)
+        stacked = path_str.startswith("blocks")
+        for pat, axes in _LOGICAL_PATTERNS:
+            if re.search(pat, path_str):
+                if stacked:
+                    axes = ("layers",) + axes
+                assert len(axes) == leaf.ndim, \
+                    f"{path_str}: {axes} vs shape {leaf.shape}"
+                return axes
+        base = tuple([None] * (leaf.ndim - (1 if stacked else 0)))
+        return (("layers",) + base) if stacked else base
+
+    return jax.tree_util.tree_map_with_path(assign, params)
+
+
+def next_token_loss(logits: jax.Array, tokens: jax.Array) -> tuple:
     """Next-token loss with full-length input and shift-left targets.
 
     The input keeps length S (not S-1) so the sequence dim stays divisible
     by the "sequence" mesh axis under sequence parallelism; the final
     position is masked out of the loss instead.
     """
+    targets = jnp.roll(tokens, -1, axis=1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = jnp.ones_like(ll).at[:, -1].set(0.0)  # no target for last pos
+    loss = -jnp.sum(ll * mask) / jnp.sum(mask)
+    return loss, {"perplexity": jnp.exp(loss)}
 
+
+def make_loss_fn(model: TransformerLM) -> Callable:
     def loss_fn(params, variables, batch, rng):
         tokens = batch["tokens"]
         logits = model.apply({"params": params}, tokens)
-        targets = jnp.roll(tokens, -1, axis=1)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        mask = jnp.ones_like(ll).at[:, -1].set(0.0)  # no target for last pos
-        loss = -jnp.sum(ll * mask) / jnp.sum(mask)
-        return loss, {"perplexity": jnp.exp(loss)}
+        return next_token_loss(logits, tokens)
 
     return loss_fn
 
@@ -201,6 +303,36 @@ def synthetic_batch(rng: jax.Array, batch_size: int, seq_len: int,
                     vocab_size: int) -> dict:
     return {"tokens": jax.random.randint(
         rng, (batch_size, seq_len), 0, vocab_size)}
+
+
+def pipelined_workload_spec(cfg: Optional[TransformerConfig] = None,
+                            seq_len: Optional[int] = None,
+                            mesh=None, num_microbatches: int = 1):
+    """WorkloadSpec for the stacked/pipelined LM (ShardingSpec.pipeline>1)."""
+    from ..runtime.worker import WorkloadSpec
+    cfg = cfg or TransformerConfig.tiny()
+    seq_len = seq_len or cfg.max_seq_len
+    model = PipelinedTransformerLM(cfg)
+
+    def _init(rng):
+        return model.init(rng, jnp.zeros((2, seq_len), jnp.int32)), {}
+
+    def loss_fn(params, variables, batch, rng):
+        tokens = batch["tokens"]
+        logits = model.apply(params, tokens, mesh=mesh,
+                             num_microbatches=num_microbatches)
+        return next_token_loss(logits, tokens)
+
+    abstract = jax.eval_shape(lambda rng: _init(rng)[0], jax.random.PRNGKey(0))
+    return WorkloadSpec(
+        name="transformer-pipelined",
+        init_fn=_init,
+        loss_fn=loss_fn,
+        batch_fn=lambda rng, bs: synthetic_batch(rng, bs, seq_len,
+                                                 cfg.vocab_size),
+        rules=TRANSFORMER_RULES,
+        param_logical_axes=pipelined_logical_axes(abstract),
+    )
 
 
 def workload_spec(cfg: Optional[TransformerConfig] = None,

@@ -1,0 +1,170 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution.
+
+The reference has no pipeline parallelism anywhere (SURVEY.md §2.5 row 5 —
+platform repo, parallelism delegated to launched frameworks); the TPU build
+supplies it natively as one of the sharding-spec axes of the TPUJob. This
+module is the execution engine behind ``ShardingSpec.pipeline > 1``.
+
+Design (TPU-first):
+- Layers are *stacked*: every block parameter carries a leading ``layers``
+  dim, sharded over the ``pipeline`` mesh axis — contiguous groups of
+  layers land on each stage, so stage weights live entirely in that
+  stage's HBM (the point of PP: fit models deeper than one chip's HBM).
+- Execution runs under a **partial-manual shard_map over only the
+  "pipeline" axis**: data/fsdp/tensor axes stay under automatic GSPMD, so
+  PP composes with DP/FSDP/TP without manual collectives for those axes.
+- The schedule is GPipe: the global batch splits into M microbatches; at
+  tick t, stage s processes microbatch (t-s) and hands its activation to
+  stage s+1 via ``lax.ppermute`` (a point-to-point ICI hop between
+  neighboring stages — the cheapest collective on a TPU torus). The
+  bubble is the standard (S-1)/(M+S-1) fraction; callers pick M >= 4*S.
+- The whole schedule is a ``lax.scan`` over ticks: one traced tick body,
+  XLA-friendly static control flow (SURVEY.md: no data-dependent Python
+  control flow under jit).
+
+Grad flow: ppermute transposes to the inverse permutation, the scan
+transposes to a reverse-time scan — reverse-order pipelining of the
+backward pass falls out of autodiff, no hand-written backward schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+PyTree = Any
+# block_fn(per_layer_params, activations) -> activations (same shape)
+BlockFn = Callable[[PyTree, jax.Array], jax.Array]
+
+PIPELINE_AXIS = "pipeline"
+
+
+def stage_sharding_spec(ndim: int, axis: str = PIPELINE_AXIS) -> P:
+    """PartitionSpec for a stacked-layer param leaf: leading dim over the
+    pipeline axis, the rest replicated (tensor axes may refine under auto
+    GSPMD outside the manual axis)."""
+    return P(axis, *([None] * (ndim - 1)))
+
+
+def num_ticks(num_microbatches: int, num_stages: int) -> int:
+    return num_microbatches + num_stages - 1
+
+
+def pipeline_apply(block_fn: BlockFn,
+                   stacked_params: PyTree,
+                   x: jax.Array,
+                   *,
+                   mesh: Mesh,
+                   num_microbatches: int,
+                   axis: str = PIPELINE_AXIS) -> jax.Array:
+    """Apply ``num_layers`` stacked blocks to ``x`` through a pipeline.
+
+    Args:
+      block_fn: applies ONE block: ``(layer_params, h) -> h`` (same shape).
+      stacked_params: pytree whose leaves have leading dim ``num_layers``
+        (must divide by the pipeline axis size), sharded with
+        :func:`stage_sharding_spec`.
+      x: activations ``[batch, ...]``; batch must divide by
+        ``num_microbatches`` (and the microbatch by the data axes).
+      mesh: the device mesh (must contain ``axis``).
+      num_microbatches: GPipe M. M == 1 degenerates to sequential stages
+        (still correct, maximal bubble).
+
+    Returns activations of the same shape, replicated over the pipeline
+    axis (so the head/loss downstream is pipeline-agnostic).
+    """
+    n_stages = mesh.shape.get(axis, 1)
+    if n_stages <= 1:
+        # No pipeline axis: plain scan over stacked layers.
+        def body(h, p_layer):
+            return block_fn(p_layer, h), None
+        out, _ = jax.lax.scan(body, x, stacked_params)
+        return out
+
+    batch = x.shape[0]
+    if batch % num_microbatches:
+        raise ValueError(
+            f"batch {batch} not divisible by microbatches {num_microbatches}")
+    num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if num_layers % n_stages:
+        raise ValueError(
+            f"{num_layers} layers not divisible by {n_stages} stages")
+
+    mb = batch // num_microbatches
+    x_mb = x.reshape((num_microbatches, mb) + x.shape[1:])
+
+    fwd = _pipeline_shardmap(block_fn, mesh, axis, n_stages,
+                             num_microbatches)
+    out_mb = fwd(stacked_params, x_mb)
+    return out_mb.reshape(x.shape)
+
+
+def _pipeline_shardmap(block_fn: BlockFn, mesh: Mesh, axis: str,
+                       n_stages: int, n_micro: int):
+    """The partial-manual shard_map GPipe schedule over the pipeline axis."""
+    ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    n_tick = num_ticks(n_micro, n_stages)
+
+    def stage_apply(p_local, h):
+        # p_local leaves: [layers_per_stage, ...] — scan the local layers.
+        def body(h, p_layer):
+            return block_fn(p_layer, h), None
+        h, _ = jax.lax.scan(body, h, p_local)
+        return h
+
+    def pp_body(p_local, x_mb, dtype):
+        # x_mb crosses the shard_map boundary in f32: it is replicated over
+        # the pipeline axis, so its transpose is a psum, and bf16 psum under
+        # a partial-manual shard_map crashes XLA's SPMD partitioner on some
+        # backends. Compute still runs in the caller's dtype.
+        x_mb = x_mb.astype(dtype)
+        stage = jax.lax.axis_index(axis)
+        is_first = stage == 0
+        is_last = stage == n_stages - 1
+
+        def tick(carry, t):
+            state, out = carry
+            # Stage 0 ingests microbatch t (clipped; invalid ticks feed a
+            # dummy that never reaches the output window).
+            feed = jax.lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+            h_in = jnp.where(is_first, feed, state)
+            h_out = stage_apply(p_local, h_in)
+            # Last stage finished microbatch t-(S-1) this tick.
+            mb_idx = t - (n_stages - 1)
+            slot = jnp.clip(mb_idx, 0, n_micro - 1)
+            valid = is_last & (mb_idx >= 0)
+            prev = jax.lax.dynamic_index_in_dim(out, slot, 0, keepdims=False)
+            out = jax.lax.dynamic_update_index_in_dim(
+                out, jnp.where(valid, h_out, prev), slot, 0)
+            state = jax.lax.ppermute(h_out, axis, ring)
+            return (state, out), None
+
+        init = (jnp.zeros_like(x_mb[0]), jnp.zeros_like(x_mb))
+        (_, out), _ = jax.lax.scan(tick, init, jnp.arange(n_tick))
+        # Replicate the last stage's outputs across the pipeline axis so the
+        # downstream head/loss sees identical values on every stage. The
+        # psum rides in f32: XLA's partial-manual partitioner rejects bf16
+        # psum on some backends, and f32 matches grad-reduction precision.
+        out_sel = jnp.where(is_last, out, jnp.zeros_like(out))
+        # f32 out through the boundary too (cast back in run()).
+        return jax.lax.psum(out_sel.astype(jnp.float32), axis)
+
+    def specs_for(params):
+        return jax.tree.map(lambda l: stage_sharding_spec(l.ndim, axis),
+                            params)
+
+    def run(stacked_params, x_mb):
+        in_specs = (specs_for(stacked_params), P())
+        dtype = x_mb.dtype
+        body = lambda p, x: pp_body(p, x, dtype)  # noqa: E731
+        out = jax.shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=P(),
+            axis_names={axis}, check_vma=False)(
+                stacked_params, x_mb.astype(jnp.float32))
+        return out.astype(dtype)
+
+    return run

@@ -81,6 +81,7 @@ TRANSFORMER_RULES = LogicalRules([
     ("vocab", "tensor"),
     ("expert", "expert"),
     ("stage", "pipeline"),
+    ("layers", "pipeline"),     # stacked-block leading dim (pipeline stages)
 ])
 
 RESNET_RULES = LogicalRules([

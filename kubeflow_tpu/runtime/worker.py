@@ -60,10 +60,21 @@ def _transformer_spec(**kw) -> WorkloadSpec:
     return T.workload_spec(**kw)
 
 
+def _transformer_pipelined_spec(**kw) -> WorkloadSpec:
+    from ..models import transformer as T
+    return T.pipelined_workload_spec(**kw)
+
+
 WORKLOADS: dict[str, Callable[..., WorkloadSpec]] = {
     "resnet50": _resnet_spec,
     "transformer": _transformer_spec,
+    # stacked-layer LM routed through the GPipe engine when the mesh has a
+    # pipeline axis (factory takes mesh=, injected by train())
+    "transformer-pipelined": _transformer_pipelined_spec,
 }
+
+# workloads whose spec factory needs the live mesh (pipeline scheduling)
+_MESH_AWARE_WORKLOADS = {"transformer-pipelined"}
 
 
 @dataclass
@@ -89,7 +100,10 @@ def train(
     seed: int = 0,
 ) -> TrainResult:
     ctx = ctx or initialize()
-    spec = WORKLOADS[workload](**(workload_kwargs or {}))
+    workload_kwargs = dict(workload_kwargs or {})
+    if workload in _MESH_AWARE_WORKLOADS:
+        workload_kwargs.setdefault("mesh", ctx.mesh)
+    spec = WORKLOADS[workload](**workload_kwargs)
     log.info("worker %d/%d mesh=%s workload=%s", ctx.process_id,
              ctx.num_processes, dict(ctx.mesh.shape), spec.name)
 
@@ -154,13 +168,19 @@ def main(argv=None) -> int:
     p.add_argument("--no-resume", action="store_true")
     p.add_argument("--metrics-path")
     p.add_argument("--profile-dir")
+    p.add_argument("--num-microbatches", type=int, default=4,
+                   help="GPipe microbatches (pipelined workloads)")
     args = p.parse_args(argv)
+    workload_kwargs = {}
+    if args.workload in _MESH_AWARE_WORKLOADS:
+        workload_kwargs["num_microbatches"] = args.num_microbatches
     result = train(
         workload=args.workload, steps=args.steps,
         global_batch=args.global_batch, learning_rate=args.learning_rate,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every, resume=not args.no_resume,
-        metrics_path=args.metrics_path, profile_dir=args.profile_dir)
+        metrics_path=args.metrics_path, profile_dir=args.profile_dir,
+        workload_kwargs=workload_kwargs)
     log.info("done: %d steps, %.1f examples/sec", result.steps,
              result.examples_per_sec)
     return 0

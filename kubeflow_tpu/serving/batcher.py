@@ -11,9 +11,11 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from dataclasses import dataclass
 
+import jax
 import numpy as np
 
 
@@ -33,15 +35,20 @@ class MicroBatcher:
         self.max_latency = max_latency_ms / 1000.0
         self._queue: "queue.Queue[_WorkItem]" = queue.Queue()
         self._stop = threading.Event()
+        self._submit_lock = threading.Lock()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"batcher-{servable.name}")
         self._thread.start()
 
     def submit(self, instances: np.ndarray) -> Future:
-        if self._stop.is_set():
-            raise RuntimeError("batcher is shut down")
         item = _WorkItem(np.asarray(instances), Future())
-        self._queue.put(item)
+        # Lock makes the stop-check + put atomic w.r.t. shutdown()'s
+        # stop-set + drain, so no item can land after the final drain and
+        # leave its future forever unresolved.
+        with self._submit_lock:
+            if self._stop.is_set():
+                raise RuntimeError("batcher is shut down")
+            self._queue.put(item)
         return item.future
 
     def predict(self, instances: np.ndarray, timeout: float = 30.0):
@@ -56,7 +63,6 @@ class MicroBatcher:
             return []
         items, total = [first], first.instances.shape[0]
         deadline = self.max_latency
-        import time
         t0 = time.perf_counter()
         while total < self.max_batch:
             remaining = deadline - (time.perf_counter() - t0)
@@ -70,28 +76,44 @@ class MicroBatcher:
             total += nxt.instances.shape[0]
         return items
 
+    def _dispatch(self, items: list[_WorkItem]):
+        """One device call for a shape-compatible cohort; errors fan out
+        only to that cohort."""
+        batch = np.concatenate([it.instances for it in items], axis=0)
+        try:
+            out = self.servable.predict(batch)
+        except Exception as e:  # noqa: BLE001 — fan the error out
+            for it in items:
+                it.future.set_exception(e)
+            return
+        ofs = 0
+        for it in items:
+            n = it.instances.shape[0]
+            it.future.set_result(
+                jax.tree.map(lambda x: x[ofs:ofs + n], out))
+            ofs += n
+
     def _loop(self):
         while not self._stop.is_set():
             items = self._collect()
             if not items:
                 continue
-            batch = np.concatenate([it.instances for it in items], axis=0)
-            try:
-                out = self.servable.predict(batch)
-            except Exception as e:  # noqa: BLE001 — fan the error out
-                for it in items:
-                    it.future.set_exception(e)
-                continue
-            ofs = 0
+            # Group by trailing shape + dtype: one malformed request must
+            # not poison the other requests coalesced into its window.
+            groups: dict[tuple, list[_WorkItem]] = {}
             for it in items:
-                n = it.instances.shape[0]
-                import jax
-                it.future.set_result(
-                    jax.tree.map(lambda x: x[ofs:ofs + n], out))
-                ofs += n
+                if it.instances.ndim < 1:
+                    it.future.set_exception(ValueError(
+                        "instances must have a batch dimension"))
+                    continue
+                key = (it.instances.shape[1:], str(it.instances.dtype))
+                groups.setdefault(key, []).append(it)
+            for cohort in groups.values():
+                self._dispatch(cohort)
 
     def shutdown(self):
-        self._stop.set()
+        with self._submit_lock:
+            self._stop.set()
         self._thread.join(timeout=5)
         while True:  # fail any stragglers
             try:

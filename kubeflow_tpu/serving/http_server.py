@@ -39,6 +39,7 @@ class ModelServer:
         self.max_batch = max_batch
         self.max_latency_ms = max_latency_ms
         self._batchers: dict[str, MicroBatcher] = {}
+        self._batchers_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -65,11 +66,14 @@ class ModelServer:
 
     def batcher(self, name: str) -> MicroBatcher:
         servable = self.repository.get(name)
-        b = self._batchers.get(name)
-        if b is None:
-            b = MicroBatcher(servable, max_batch=self.max_batch,
-                             max_latency_ms=self.max_latency_ms)
-            self._batchers[name] = b
+        # check-then-set under a lock: handler threads race on first
+        # request, and a losing MicroBatcher would leak its poll thread
+        with self._batchers_lock:
+            b = self._batchers.get(name)
+            if b is None:
+                b = MicroBatcher(servable, max_batch=self.max_batch,
+                                 max_latency_ms=self.max_latency_ms)
+                self._batchers[name] = b
         return b
 
     def metrics_text(self) -> str:
@@ -136,16 +140,20 @@ def _make_handler(server: ModelServer):
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(length))
+                if "instances" not in req:
+                    return self._error(400, "missing 'instances' in request")
                 instances = np.asarray(req["instances"])
                 if "dtype" in req:
                     instances = instances.astype(req["dtype"])
-                out = server.batcher(name).predict(instances)
+                try:
+                    batcher = server.batcher(name)
+                except KeyError as e:  # unknown model only → 404
+                    return self._error(404, str(e))
+                out = batcher.predict(instances)
                 predictions = {
                     k: np.asarray(v).tolist() for k, v in out.items()
                 } if isinstance(out, dict) else np.asarray(out).tolist()
                 self._send(200, {"predictions": predictions})
-            except KeyError as e:
-                self._error(404, str(e))
             except Exception as e:  # noqa: BLE001 — surface to client
                 self._error(400, f"{type(e).__name__}: {e}")
 

@@ -54,19 +54,13 @@ class Servable:
     version: int = 1
     input_signature: dict = field(default_factory=dict)
     max_batch: int = 256
-    _compiled: dict = field(default_factory=dict)
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def __post_init__(self):
         self._stats = {"request_count": 0, "predict_seconds": 0.0}
-
-    def _get_compiled(self, bucket: int):
-        with self._lock:
-            fn = self._compiled.get(bucket)
-            if fn is None:
-                fn = jax.jit(self.predict_fn)
-                self._compiled[bucket] = fn
-            return fn
+        # one jit wrapper: jax caches per input shape, so each padded
+        # bucket gets its own executable without any bookkeeping here
+        self._jit_predict = jax.jit(self.predict_fn)
 
     def predict(self, instances: np.ndarray) -> np.ndarray:
         """Pad to bucket, run on device, slice back. Thread-safe."""
@@ -86,7 +80,7 @@ class Servable:
                            instances.dtype)
             padded = np.concatenate([instances, pad], axis=0)
         t0 = time.perf_counter()
-        out = self._get_compiled(bucket)(self.params, jnp.asarray(padded))
+        out = self._jit_predict(self.params, jnp.asarray(padded))
         out = jax.device_get(out)
         dt = time.perf_counter() - t0
         with self._lock:

@@ -47,8 +47,8 @@ def test_servable_padding_and_split():
     x = np.arange(12 * 4, dtype=np.float32).reshape(12, 4)
     out = s.predict(x)  # 12 > max_batch → split into 8 + 4
     np.testing.assert_allclose(out["y"], x * 2.0)
-    # only buckets ≤ max_batch were compiled
-    assert all(b <= 8 for b in s._compiled)
+    # jit caches per input shape: 12>8 split into an 8-bucket + a 4-bucket
+    assert s._jit_predict._cache_size() == 2
 
 
 def test_repository_checkpoint_roundtrip(tmp_path):

@@ -1,0 +1,20 @@
+"""Katib-equivalent hyperparameter search subsystem.
+
+The reference deploys katib (vizier core + MySQL + per-algorithm suggestion
+services + studyjob-controller, kubeflow/katib/*.libsonnet). Here the same
+capability is native: suggestion algorithms are in-process engines
+(suggestion.py), the observation store is VizierDB with an optional HTTP
+front (vizier.py), and the StudyJob controller drives TPUJob trials through
+the same controller runtime as the training operator (studyjob.py).
+"""
+
+from .suggestion import (ParameterConfig, Suggestion, make_suggestion,
+                         SUGGESTION_ALGORITHMS)
+from .vizier import VizierDB, VizierService
+from .studyjob import StudyJobReconciler
+
+__all__ = [
+    "ParameterConfig", "Suggestion", "make_suggestion",
+    "SUGGESTION_ALGORITHMS", "VizierDB", "VizierService",
+    "StudyJobReconciler",
+]

@@ -1,0 +1,369 @@
+"""Suggestion algorithms: random, grid, hyperband, bayesian optimization.
+
+The reference runs each algorithm as a separate gRPC "suggestion service"
+deployed per-algorithm (kubeflow/katib/suggestion.libsonnet:50-66; the four
+algorithms in kubeflow/katib/prototypes/all.jsonnet:6-9). Here they are
+in-process engines behind one interface; the StudyJob controller calls them
+directly, and the vizier HTTP service exposes them for out-of-process use.
+
+Parameter configs mirror StudyJob ``parameterconfigs``
+(kubeflow/examples/prototypes/katib-studyjob-test-v1alpha1.jsonnet):
+``{name, parametertype: double|int|discrete|categorical, feasible:
+{min, max, list}}``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+SUGGESTION_ALGORITHMS = ("random", "grid", "hyperband", "bayesianoptimization")
+
+DOUBLE = "double"
+INT = "int"
+DISCRETE = "discrete"
+CATEGORICAL = "categorical"
+
+
+@dataclass
+class ParameterConfig:
+    name: str
+    parametertype: str = DOUBLE
+    min: Optional[float] = None
+    max: Optional[float] = None
+    list: Optional[list] = None  # discrete / categorical values
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ParameterConfig":
+        feasible = d.get("feasible", {}) or {}
+        ptype = d.get("parametertype", DOUBLE).lower()
+        lo = feasible.get("min")
+        hi = feasible.get("max")
+        return cls(
+            name=d["name"], parametertype=ptype,
+            min=float(lo) if lo is not None else None,
+            max=float(hi) if hi is not None else None,
+            list=feasible.get("list"),
+        )
+
+    def validate(self) -> None:
+        if self.parametertype in (DOUBLE, INT):
+            if self.min is None or self.max is None or self.min > self.max:
+                raise ValueError(
+                    f"parameter {self.name}: {self.parametertype} needs "
+                    f"feasible min <= max, got [{self.min}, {self.max}]")
+        elif self.parametertype in (DISCRETE, CATEGORICAL):
+            if not self.list:
+                raise ValueError(
+                    f"parameter {self.name}: {self.parametertype} needs a "
+                    f"non-empty feasible list")
+        else:
+            raise ValueError(f"parameter {self.name}: unknown parametertype "
+                             f"{self.parametertype!r}")
+
+    # -- numeric embedding (for the GP): value <-> [0,1] ---------------------
+
+    def dims(self) -> int:
+        """Embedding width: 1 for numeric/discrete, one-hot for categorical."""
+        return len(self.list) if self.parametertype == CATEGORICAL else 1
+
+    def encode(self, value: Any) -> list[float]:
+        if self.parametertype == CATEGORICAL:
+            onehot = [0.0] * len(self.list)
+            onehot[self.list.index(value)] = 1.0
+            return onehot
+        if self.parametertype == DISCRETE:
+            vals = [float(v) for v in self.list]
+            lo, hi = min(vals), max(vals)
+            span = (hi - lo) or 1.0
+            return [(float(value) - lo) / span]
+        span = (self.max - self.min) or 1.0
+        return [(float(value) - self.min) / span]
+
+    def sample(self, rng: random.Random) -> Any:
+        if self.parametertype == DOUBLE:
+            return rng.uniform(self.min, self.max)
+        if self.parametertype == INT:
+            return rng.randint(int(self.min), int(self.max))
+        return rng.choice(self.list)
+
+    def grid(self, n: int) -> list:
+        if self.parametertype in (DISCRETE, CATEGORICAL):
+            return [v for v in self.list]
+        if self.parametertype == INT:
+            lo, hi = int(self.min), int(self.max)
+            count = min(n, hi - lo + 1)
+            if count <= 1:
+                return [lo]
+            return sorted({round(lo + i * (hi - lo) / (count - 1))
+                           for i in range(count)})
+        if n <= 1:
+            return [(self.min + self.max) / 2.0]
+        step = (self.max - self.min) / (n - 1)
+        return [self.min + i * step for i in range(n)]
+
+
+def parse_parameter_configs(raw: list[dict]) -> list[ParameterConfig]:
+    configs = [ParameterConfig.from_dict(d) for d in raw or []]
+    for c in configs:
+        c.validate()
+    return configs
+
+
+class Suggestion:
+    """One study's suggestion engine.
+
+    ``suggest(n)`` returns up to n parameter assignments (fewer when the
+    space or schedule is exhausted); ``observe(params, value)`` feeds back a
+    completed trial's objective, already sign-normalized so that HIGHER is
+    always better (the caller negates for minimize studies).
+    """
+
+    def __init__(self, params: list[ParameterConfig], seed: int = 0,
+                 settings: Optional[dict] = None):
+        self.params = params
+        self.rng = random.Random(seed)
+        self.settings = settings or {}
+        self.observations: list[tuple[dict, float]] = []
+
+    def suggest(self, n: int) -> list[dict[str, Any]]:
+        raise NotImplementedError
+
+    def observe(self, trial_params: dict, value: float) -> None:
+        self.observations.append((dict(trial_params), value))
+
+    def observe_failure(self, trial_params: dict) -> None:
+        """A trial failed with no objective. Default: drop it (random/grid/
+        bayesian draw fresh points anyway); schedule-driven engines override
+        so their pending queues drain instead of re-suggesting the config."""
+
+    def exhausted(self) -> bool:
+        return False
+
+
+class RandomSuggestion(Suggestion):
+    def suggest(self, n: int) -> list[dict[str, Any]]:
+        return [{p.name: p.sample(self.rng) for p in self.params}
+                for _ in range(n)]
+
+
+class GridSuggestion(Suggestion):
+    """Cartesian product; per-param point count from suggestion parameters
+    (``DefaultGrid`` / ``grid_<name>``), katib grid-suggestion semantics."""
+
+    def __init__(self, params, seed=0, settings=None):
+        super().__init__(params, seed, settings)
+        default_n = int(self.settings.get("DefaultGrid", 3))
+        axes = [p.grid(int(self.settings.get(f"grid_{p.name}", default_n)))
+                for p in self.params]
+        self._points = [
+            {p.name: v for p, v in zip(self.params, combo)}
+            for combo in itertools.product(*axes)
+        ]
+        self._cursor = 0
+
+    def suggest(self, n: int) -> list[dict[str, Any]]:
+        batch = self._points[self._cursor:self._cursor + n]
+        self._cursor += len(batch)
+        return batch
+
+    def exhausted(self) -> bool:
+        return self._cursor >= len(self._points)
+
+
+@dataclass
+class _Bracket:
+    s: int
+    n: int            # configs in the first round
+    r: float          # resource per config in the first round
+    rounds_left: int = 0
+    pending: list = field(default_factory=list)     # awaiting results
+    results: list = field(default_factory=list)     # (params, value)
+    configs: list = field(default_factory=list)     # current round's configs
+
+
+class HyperbandSuggestion(Suggestion):
+    """Hyperband (successive halving over brackets).
+
+    Settings: ``eta`` (down-sampling rate, default 3), ``r_l`` (max resource,
+    default 81), ``resourceName`` (the parameter that carries the per-trial
+    budget, e.g. ``--epochs``). Mirrors katib's hyperband suggestion
+    parameters (eta / r_l / ResourceName).
+    """
+
+    def __init__(self, params, seed=0, settings=None):
+        super().__init__(params, seed, settings)
+        self.eta = float(self.settings.get("eta", 3))
+        self.R = float(self.settings.get("r_l", 81))
+        self.resource_name = self.settings.get("resourceName", "--budget")
+        s_max = int(math.floor(math.log(self.R) / math.log(self.eta)))
+        self._brackets: list[_Bracket] = []
+        for s in range(s_max, -1, -1):
+            n = int(math.ceil((s_max + 1) / (s + 1) * self.eta ** s))
+            r = self.R * self.eta ** (-s)
+            self._brackets.append(_Bracket(s=s, n=n, r=r, rounds_left=s + 1))
+        self._bracket_i = 0
+        self._prepare_round(fresh=True)
+
+    # -- schedule ------------------------------------------------------------
+
+    def _bracket(self) -> Optional[_Bracket]:
+        if self._bracket_i >= len(self._brackets):
+            return None
+        return self._brackets[self._bracket_i]
+
+    def _prepare_round(self, fresh: bool) -> None:
+        b = self._bracket()
+        if b is None:
+            return
+        if fresh:
+            # new bracket: n random configs at resource r
+            b.configs = [
+                ({p.name: p.sample(self.rng) for p in self.params}, b.r)
+                for _ in range(b.n)
+            ]
+        b.pending = [c for c in b.configs]
+        b.results = []
+
+    def _advance_if_round_done(self) -> None:
+        b = self._bracket()
+        if b is None or b.pending or not b.configs:
+            return
+        b.rounds_left -= 1
+        keep = int(math.floor(len(b.results) / self.eta))
+        if b.rounds_left <= 0 or keep < 1:
+            self._bracket_i += 1
+            self._prepare_round(fresh=True)
+            return
+        survivors = sorted(b.results, key=lambda t: t[1], reverse=True)[:keep]
+        next_r = min(b.configs[0][1] * self.eta, self.R)
+        b.configs = [(dict(p), next_r) for (p, _v) in survivors]
+        self._prepare_round(fresh=False)
+
+    # -- interface -----------------------------------------------------------
+
+    def suggest(self, n: int) -> list[dict[str, Any]]:
+        b = self._bracket()
+        if b is None:
+            return []
+        out = []
+        for params, r in b.pending[:n]:
+            assignment = dict(params)
+            budget = int(round(r)) if float(r).is_integer() or r >= 1 else r
+            assignment[self.resource_name] = budget
+            out.append(assignment)
+        return out
+
+    def observe(self, trial_params: dict, value: float) -> None:
+        super().observe(trial_params, value)
+        self._settle(trial_params, value)
+
+    def observe_failure(self, trial_params: dict) -> None:
+        # settle as worst-possible so the round drains and the config is
+        # never promoted (it still counts toward the round's population)
+        self._settle(trial_params, float("-inf"))
+
+    def _settle(self, trial_params: dict, value: float) -> None:
+        b = self._bracket()
+        if b is None:
+            return
+        bare = {k: v for k, v in trial_params.items()
+                if k != self.resource_name}
+        for i, (params, _r) in enumerate(b.pending):
+            if params == bare:
+                b.pending.pop(i)
+                b.results.append((params, value))
+                break
+        self._advance_if_round_done()
+
+    def exhausted(self) -> bool:
+        return self._bracket() is None
+
+
+class BayesianOptimizationSuggestion(Suggestion):
+    """GP (RBF kernel) + expected-improvement acquisition, numpy only.
+
+    Settings: ``burn_in`` random trials before the GP engages (default 4),
+    ``length_scale`` (default 0.3), ``noise`` (default 1e-6), ``candidates``
+    (acquisition sampling budget, default 256).
+    """
+
+    def __init__(self, params, seed=0, settings=None):
+        super().__init__(params, seed, settings)
+        self.burn_in = int(self.settings.get("burn_in", 4))
+        self.length_scale = float(self.settings.get("length_scale", 0.3))
+        self.noise = float(self.settings.get("noise", 1e-6))
+        self.n_candidates = int(self.settings.get("candidates", 256))
+
+    def _encode(self, assignment: dict) -> np.ndarray:
+        vec: list[float] = []
+        for p in self.params:
+            vec.extend(p.encode(assignment[p.name]))
+        return np.asarray(vec)
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / self.length_scale ** 2)
+
+    def _ei(self, cand: np.ndarray, x: np.ndarray, y: np.ndarray) -> np.ndarray:
+        y_mean, y_std = y.mean(), y.std() or 1.0
+        yn = (y - y_mean) / y_std
+        k_xx = self._kernel(x, x) + self.noise * np.eye(len(x))
+        k_cx = self._kernel(cand, x)
+        try:
+            chol = np.linalg.cholesky(k_xx)
+        except np.linalg.LinAlgError:
+            chol = np.linalg.cholesky(k_xx + 1e-4 * np.eye(len(x)))
+        alpha = np.linalg.solve(chol.T, np.linalg.solve(chol, yn))
+        mu = k_cx @ alpha
+        v = np.linalg.solve(chol, k_cx.T)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        sigma = np.sqrt(var)
+        best = yn.max()
+        z = (mu - best) / sigma
+        # EI = sigma * (z*Phi(z) + phi(z))
+        phi = np.exp(-0.5 * z ** 2) / math.sqrt(2 * math.pi)
+        Phi = 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2)))
+        return sigma * (z * Phi + phi)
+
+    def suggest(self, n: int) -> list[dict[str, Any]]:
+        out: list[dict[str, Any]] = []
+        random_engine = RandomSuggestion(self.params, seed=self.rng.random())
+        if len(self.observations) < self.burn_in:
+            return random_engine.suggest(n)
+        x = np.stack([self._encode(p) for p, _ in self.observations])
+        y = np.asarray([v for _, v in self.observations])
+        for _ in range(n):
+            cands = random_engine.suggest(self.n_candidates)
+            cand_x = np.stack([self._encode(c) for c in cands])
+            ei = self._ei(cand_x, x, y)
+            best = cands[int(np.argmax(ei))]
+            out.append(best)
+            # pessimistic fantasy so a batch doesn't collapse to one point
+            x = np.concatenate([x, self._encode(best)[None]], 0)
+            y = np.concatenate([y, [y.min()]])
+        return out
+
+
+_ALGORITHMS = {
+    "random": RandomSuggestion,
+    "grid": GridSuggestion,
+    "hyperband": HyperbandSuggestion,
+    "bayesianoptimization": BayesianOptimizationSuggestion,
+}
+
+
+def make_suggestion(algorithm: str, params: list[ParameterConfig],
+                    seed: int = 0,
+                    settings: Optional[dict] = None) -> Suggestion:
+    algo = (algorithm or "random").lower().replace("-", "").replace("_", "")
+    if algo not in _ALGORITHMS:
+        raise ValueError(
+            f"unknown suggestion algorithm {algorithm!r}; "
+            f"supported: {sorted(_ALGORITHMS)}")
+    return _ALGORITHMS[algo](params, seed=seed, settings=settings)

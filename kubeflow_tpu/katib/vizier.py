@@ -1,0 +1,265 @@
+"""Vizier-core analog: study/trial registry + observation metric store.
+
+The reference deploys vizier-core + MySQL + a REST front
+(kubeflow/katib/vizier.libsonnet:4-20) and scrapes worker metrics into it
+via per-trial metrics-collector CronJobs
+(studyjobcontroller.libsonnet:131-147). Here the store is an in-process DB
+(thread-safe, snapshot-serializable) with an optional stdlib HTTP front;
+workers report observations either directly (in-process), over HTTP
+(``report_observation`` with the KFTPU_VIZIER_URL env contract), or by
+writing a ``<trial>-metrics`` ConfigMap that the StudyJob controller
+collects (the metrics-collector path, no sidecar needed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+VIZIER_URL_ENV = "KFTPU_VIZIER_URL"
+STUDY_ENV = "KFTPU_STUDY"
+TRIAL_ENV = "KFTPU_TRIAL"
+
+
+@dataclass
+class Observation:
+    trial: str
+    metric: str
+    value: float
+    step: int = 0
+
+
+@dataclass
+class TrialRecord:
+    name: str
+    parameters: dict[str, Any] = field(default_factory=dict)
+    status: str = "Pending"   # Pending | Running | Succeeded | Failed
+    objective: Optional[float] = None
+
+
+@dataclass
+class StudyRecord:
+    name: str
+    objective_name: str = "loss"
+    optimization_type: str = "minimize"
+    metrics_names: list[str] = field(default_factory=list)
+    trials: dict[str, TrialRecord] = field(default_factory=dict)
+    observations: list[Observation] = field(default_factory=list)
+
+
+class VizierDB:
+    def __init__(self):
+        self._studies: dict[str, StudyRecord] = {}
+        self._lock = threading.RLock()
+
+    def create_study(self, name: str, objective_name: str = "loss",
+                     optimization_type: str = "minimize",
+                     metrics_names: Optional[list[str]] = None) -> StudyRecord:
+        with self._lock:
+            if name not in self._studies:
+                self._studies[name] = StudyRecord(
+                    name=name, objective_name=objective_name,
+                    optimization_type=optimization_type,
+                    metrics_names=list(metrics_names or []))
+            return self._studies[name]
+
+    def get_study(self, name: str) -> Optional[StudyRecord]:
+        with self._lock:
+            return self._studies.get(name)
+
+    def list_studies(self) -> list[str]:
+        with self._lock:
+            return sorted(self._studies)
+
+    def register_trial(self, study: str, trial: str,
+                       parameters: dict[str, Any]) -> None:
+        with self._lock:
+            s = self.create_study(study)
+            s.trials.setdefault(trial, TrialRecord(name=trial,
+                                                   parameters=parameters))
+
+    def set_trial_status(self, study: str, trial: str, status: str) -> None:
+        with self._lock:
+            s = self.create_study(study)
+            s.trials.setdefault(trial, TrialRecord(name=trial)).status = status
+
+    def report(self, study: str, trial: str, metric: str, value: float,
+               step: int = 0) -> None:
+        with self._lock:
+            s = self.create_study(study)
+            s.observations.append(Observation(trial, metric, float(value), step))
+
+    def objective_of(self, study: str, trial: str) -> Optional[float]:
+        """Latest reported value of the study's objective metric."""
+        with self._lock:
+            s = self._studies.get(study)
+            if s is None:
+                return None
+            latest: Optional[Observation] = None
+            for o in s.observations:
+                if o.trial == trial and o.metric == s.objective_name:
+                    if latest is None or o.step >= latest.step:
+                        latest = o
+            return latest.value if latest else None
+
+    def trial_metrics(self, study: str, trial: str) -> dict[str, float]:
+        with self._lock:
+            s = self._studies.get(study)
+            out: dict[str, float] = {}
+            if s is None:
+                return out
+            for o in sorted(s.observations, key=lambda o: o.step):
+                if o.trial == trial:
+                    out[o.metric] = o.value
+            return out
+
+    def best_trial(self, study: str) -> Optional[TrialRecord]:
+        with self._lock:
+            s = self._studies.get(study)
+            if s is None:
+                return None
+            sign = -1.0 if s.optimization_type == "minimize" else 1.0
+            done = [t for t in s.trials.values()
+                    if t.objective is not None and t.status == "Succeeded"]
+            if not done:
+                return None
+            return max(done, key=lambda t: sign * t.objective)
+
+    def to_snapshot(self) -> dict:
+        with self._lock:
+            return {
+                name: {
+                    "objective_name": s.objective_name,
+                    "optimization_type": s.optimization_type,
+                    "metrics_names": s.metrics_names,
+                    "trials": {t.name: {"parameters": t.parameters,
+                                        "status": t.status,
+                                        "objective": t.objective}
+                               for t in s.trials.values()},
+                    "observations": [[o.trial, o.metric, o.value, o.step]
+                                     for o in s.observations],
+                }
+                for name, s in self._studies.items()
+            }
+
+    @classmethod
+    def from_snapshot(cls, snap: dict) -> "VizierDB":
+        db = cls()
+        for name, sd in (snap or {}).items():
+            s = db.create_study(name, sd.get("objective_name", "loss"),
+                                sd.get("optimization_type", "minimize"),
+                                sd.get("metrics_names"))
+            for tname, td in sd.get("trials", {}).items():
+                rec = TrialRecord(name=tname,
+                                  parameters=td.get("parameters", {}),
+                                  status=td.get("status", "Pending"),
+                                  objective=td.get("objective"))
+                s.trials[tname] = rec
+            for trial, metric, value, step in sd.get("observations", []):
+                s.observations.append(Observation(trial, metric, value, step))
+        return db
+
+
+class VizierService:
+    """HTTP front over VizierDB (the vizier REST + UI API analog).
+
+    Routes:
+      POST /api/v1/observation           {study, trial, metric, value, step}
+      GET  /api/v1/studies
+      GET  /api/v1/studies/<name>        study + trials + best
+      GET  /healthz
+    """
+
+    def __init__(self, db: Optional[VizierDB] = None, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.db = db or VizierDB()
+        self._httpd = ThreadingHTTPServer((host, port), _make_handler(self))
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="vizier-http")
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _make_handler(svc: VizierService):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, code: int, payload) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                return self._send(200, {"ok": True})
+            if self.path == "/api/v1/studies":
+                return self._send(200, {"studies": svc.db.list_studies()})
+            if self.path.startswith("/api/v1/studies/"):
+                name = self.path.rsplit("/", 1)[1]
+                study = svc.db.get_study(name)
+                if study is None:
+                    return self._send(404, {"error": f"study {name} not found"})
+                best = svc.db.best_trial(name)
+                return self._send(200, {
+                    "name": study.name,
+                    "objectiveName": study.objective_name,
+                    "optimizationType": study.optimization_type,
+                    "trials": [
+                        {"name": t.name, "parameters": t.parameters,
+                         "status": t.status, "objective": t.objective}
+                        for t in study.trials.values()
+                    ],
+                    "bestTrial": best.name if best else None,
+                })
+            return self._send(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/api/v1/observation":
+                return self._send(404, {"error": "not found"})
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                svc.db.report(req["study"], req["trial"], req["metric"],
+                              float(req["value"]), int(req.get("step", 0)))
+            except (KeyError, ValueError, json.JSONDecodeError) as e:
+                return self._send(400, {"error": str(e)})
+            return self._send(200, {"ok": True})
+
+    return Handler
+
+
+def report_observation(metric: str, value: float, step: int = 0,
+                       url: Optional[str] = None, study: Optional[str] = None,
+                       trial: Optional[str] = None) -> bool:
+    """Worker-side reporter. Reads the KFTPU_VIZIER_URL / KFTPU_STUDY /
+    KFTPU_TRIAL env contract the StudyJob controller injects (the TF_CONFIG
+    idiom applied to metrics collection); no-op when not under a study."""
+    url = url or os.environ.get(VIZIER_URL_ENV)
+    study = study or os.environ.get(STUDY_ENV)
+    trial = trial or os.environ.get(TRIAL_ENV)
+    if not (url and study and trial):
+        return False
+    payload = json.dumps({"study": study, "trial": trial, "metric": metric,
+                          "value": value, "step": step}).encode()
+    req = urllib.request.Request(
+        url.rstrip("/") + "/api/v1/observation", data=payload,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return resp.status == 200

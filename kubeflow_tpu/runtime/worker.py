@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import time
 from dataclasses import dataclass
 from typing import Callable, Optional
@@ -148,6 +149,20 @@ def train(
         ckpt.close()
     summary = mlog.summary(warmup=1)
     mlog.close()
+    # Under a katib study the operator injects KFTPU_STUDY/KFTPU_TRIAL (+
+    # vizier URL); report the final metrics as the trial observation — the
+    # TPU-native metrics-collector contract (katib/vizier.py). No-op
+    # otherwise.
+    if ctx.process_id == 0 and os.environ.get("KFTPU_STUDY"):
+        try:
+            from ..katib.vizier import report_observation
+            for mname, mval in {**last_metrics,
+                                "examples_per_sec":
+                                    summary["examples_per_sec"]}.items():
+                report_observation(mname, float(mval),
+                                   step=summary["steps"])
+        except Exception as e:  # noqa: BLE001 - reporting must not fail runs
+            log.warning("observation report failed: %s", e)
     return TrainResult(
         steps=summary["steps"],
         examples_per_sec=summary["examples_per_sec"],

@@ -167,6 +167,10 @@ class StudyJobReconciler(Reconciler):
                 if t.get("status") == T_SUCCEEDED and t.get("objective") is not None:
                     engine.observe(t.get("parameters", {}),
                                    state.sign * float(t["objective"]))
+                elif t.get("status") == T_FAILED:
+                    # failed trials must settle too, or hyperband's pending
+                    # queue re-suggests known-failed configs after restart
+                    engine.observe_failure(t.get("parameters", {}))
         self._states[sid] = state
         return state
 
@@ -183,6 +187,8 @@ class StudyJobReconciler(Reconciler):
         if k8s.condition_true(manifest, COND_SUCCEEDED) or \
                 k8s.condition_true(manifest, COND_FAILED):
             return Result()
+        import json as _json
+        status_before = _json.dumps(status, sort_keys=True, default=str)
 
         spec = manifest.get("spec", {})
         study = spec.get("studyName") or name
@@ -300,10 +306,11 @@ class StudyJobReconciler(Reconciler):
                              "StudyCompleted", msg, status)
             return Result()
 
-        self._write_status(client, manifest, status)
-        if created or k8s.condition_true(manifest, COND_RUNNING):
-            pass
-        else:
+        # only write on change — an unconditional status write would
+        # re-trigger our own watch and reconcile forever
+        if _json.dumps(status, sort_keys=True, default=str) != status_before:
+            self._write_status(client, manifest, status)
+        if not k8s.condition_true(manifest, COND_RUNNING) and trials:
             self._set_condition(client, manifest, COND_RUNNING,
                                 "TrialsRunning", "trials in progress")
         return Result(requeue_after=0.05) if pending_collect else Result()

@@ -128,6 +128,11 @@ def train(
             log.info("resumed from step %d", int(state.step))
 
     step_fn = builder.build()
+    # kubebench injects KFTPU_METRICS_PATH so the reporter can aggregate
+    # this run's per-step stream (workflows/kubebench.py report_from_metrics)
+    metrics_path = metrics_path or os.environ.get("KFTPU_METRICS_PATH")
+    if metrics_path:
+        os.makedirs(os.path.dirname(metrics_path) or ".", exist_ok=True)
     mlog = MetricsLogger(metrics_path, batch_size=global_batch)
     data_rng = jax.random.PRNGKey(seed + 1)
 

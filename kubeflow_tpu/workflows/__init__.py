@@ -1,0 +1,16 @@
+"""Workflow engine + benchmark harness.
+
+The reference deploys Argo as its workflow engine (kubeflow/argo/
+argo.libsonnet: Workflow CRD + controller + UI) and builds two systems on
+it: the kubebench benchmark harness (kubeflow/kubebench/
+kubebench-job.libsonnet: configurator → job → reporter) and the whole E2E
+CI (testing/workflows/). Here the engine is a native reconciler over the
+same Workflow shape (DAG of container/resource steps), and kubebench is a
+workflow builder + CSV reporter against the KUBEBENCH_* env contract.
+"""
+
+from .engine import WorkflowReconciler, WORKFLOW_API_VERSION
+from .kubebench import KubebenchJobReconciler, build_kubebench_workflow
+
+__all__ = ["WorkflowReconciler", "WORKFLOW_API_VERSION",
+           "KubebenchJobReconciler", "build_kubebench_workflow"]

@@ -1,0 +1,376 @@
+"""Workflow engine: DAG-of-steps reconciler (the Argo controller analog).
+
+The reference installs Argo (kubeflow/argo/argo.libsonnet:13-37 Workflow CRD,
+:112 controller, :194-231 UI/RBAC) and expresses kubebench runs and the whole
+CI system as Workflows (kubeflow/kubebench/kubebench-job.libsonnet,
+testing/workflows/components/workflows.libsonnet:33-60 kfTests DAG). This
+reconciler supports the subset those consumers use:
+
+- ``spec.entrypoint`` naming a template of ``dag.tasks`` (with
+  ``dependencies``) or serial ``steps``.
+- **container templates** → one Pod per task, owner-ref'd to the Workflow.
+- **resource templates** → create an arbitrary manifest (the way kubebench
+  launches its KF job) and wait for ``successCondition`` /
+  ``failureCondition`` (``status.phase=X`` or ``condition:Type=True`` forms).
+- ``spec.arguments.parameters`` substituted as ``$(workflow.parameters.N)``,
+  plus ``$(workflow.name)`` / ``$(workflow.namespace)``.
+- fail-fast: a failed task fails the Workflow; unreached tasks are Omitted.
+- ``activeDeadlineSeconds`` per task — the only wall-time budget the
+  reference CI has (SURVEY.md §6).
+
+Status mirrors Argo's: ``status.phase`` ∈ Pending/Running/Succeeded/Failed
+and per-node records under ``status.nodes``.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Optional
+
+from ..api import k8s
+from ..cluster.client import KubeClient, NotFoundError
+from ..controllers.runtime import Key, Reconciler, Result
+
+log = logging.getLogger(__name__)
+
+WORKFLOW_API_VERSION = "argoproj.io/v1alpha1"
+WORKFLOW_KIND = "Workflow"
+TASK_LABEL = "workflows.kubeflow.org/task"
+WORKFLOW_LABEL = "workflows.kubeflow.org/workflow"
+DEADLINE_ANNOTATION = "workflows.kubeflow.org/deadline-at"
+
+PHASE_PENDING = "Pending"
+PHASE_RUNNING = "Running"
+PHASE_SUCCEEDED = "Succeeded"
+PHASE_FAILED = "Failed"
+PHASE_ERROR = "Error"
+PHASE_OMITTED = "Omitted"
+
+TERMINAL = (PHASE_SUCCEEDED, PHASE_FAILED, PHASE_ERROR, PHASE_OMITTED)
+
+
+def check_condition_expr(obj: dict, expr: str) -> bool:
+    """Evaluate a success/failureCondition expression against an object.
+
+    Forms: ``status.phase = Succeeded`` (dotted path compare, whitespace
+    optional) and ``condition: Type = True`` (status.conditions lookup, the
+    shape our CRDs and Argo's resource templates both use).
+    """
+    expr = expr.strip()
+    if expr.startswith("condition:"):
+        rest = expr[len("condition:"):]
+        ctype, _, want = rest.partition("=")
+        want = want.strip() or "True"
+        c = k8s.get_condition(obj, ctype.strip())
+        return c is not None and c.get("status") == want
+    path, _, want = expr.partition("=")
+    want = want.strip()
+    node: Any = obj
+    for part in path.strip().split("."):
+        if not isinstance(node, dict) or part not in node:
+            return False
+        node = node[part]
+    return str(node) == want
+
+
+class WorkflowReconciler(Reconciler):
+    primary = (WORKFLOW_API_VERSION, WORKFLOW_KIND)
+    # resource templates can create arbitrary kinds; the common ones are
+    # watched for event-driven sync, everything else is covered by the
+    # Running-resource polling requeue in _sync_node
+    owns = [("v1", "Pod"),
+            ("tpu.kubeflow.org/v1alpha1", "TPUJob"),
+            ("kubeflow.org/v1beta2", "TFJob"),
+            ("kubeflow.org/v1beta2", "PyTorchJob"),
+            ("kubeflow.org/v1alpha1", "MPIJob")]
+
+    def __init__(self, clock=time.monotonic, poll_interval: float = 0.25):
+        self.clock = clock
+        # requeue delay for state no watch event covers (unwatched resource
+        # kinds, pending deadlines)
+        self.poll_interval = poll_interval
+
+    # -- template plumbing ---------------------------------------------------
+
+    def _templates(self, spec: dict) -> dict[str, dict]:
+        return {t["name"]: t for t in spec.get("templates", []) or []}
+
+    def _task_list(self, wf: dict) -> Optional[list[dict]]:
+        """Flatten the entrypoint into [{name, template, dependencies}].
+        ``steps`` (serial groups) become a dependency chain, Argo semantics:
+        each group runs after the previous group completes."""
+        spec = wf.get("spec", {})
+        templates = self._templates(spec)
+        entry = templates.get(spec.get("entrypoint", ""))
+        if entry is None:
+            return None
+        def entry_of(t: dict, deps: list[str]) -> dict:
+            if "name" not in t or "template" not in t:
+                raise ValueError(f"task entry needs name and template: {t}")
+            return {"name": t["name"], "template": t["template"],
+                    "dependencies": deps}
+
+        if "dag" in entry:
+            return [entry_of(t, list(t.get("dependencies") or []))
+                    for t in entry["dag"].get("tasks", []) or []]
+        if "steps" in entry:
+            tasks = []
+            prev_group: list[str] = []
+            for group in entry.get("steps", []) or []:
+                group = group if isinstance(group, list) else [group]
+                for s in group:
+                    tasks.append(entry_of(s, list(prev_group)))
+                prev_group = [s["name"] for s in group]
+            return tasks
+        # a bare container/resource entrypoint is a single-task workflow
+        if "container" in entry or "resource" in entry:
+            return [{"name": entry["name"], "template": entry["name"],
+                     "dependencies": []}]
+        return None
+
+    def _params(self, wf: dict) -> dict[str, Any]:
+        out = {"workflow.name": k8s.name_of(wf),
+               "workflow.namespace": k8s.namespace_of(wf, "default")}
+        args = (wf.get("spec", {}).get("arguments") or {})
+        for p in args.get("parameters", []) or []:
+            out[f"workflow.parameters.{p['name']}"] = p.get("value")
+        return out
+
+    # -- reconcile -----------------------------------------------------------
+
+    def reconcile(self, client: KubeClient, key: Key) -> Result:
+        ns, name = key
+        try:
+            wf = client.get(WORKFLOW_API_VERSION, WORKFLOW_KIND, ns, name)
+        except NotFoundError:
+            return Result()
+        status = wf.setdefault("status", {})
+        if status.get("phase") in (PHASE_SUCCEEDED, PHASE_FAILED, PHASE_ERROR):
+            return Result()
+        import json as _json
+        status_before = _json.dumps(status, sort_keys=True, default=str)
+
+        try:
+            tasks = self._task_list(wf)
+        except ValueError as e:
+            self._finish(client, wf, PHASE_ERROR, str(e))
+            return Result()
+        if tasks is None:
+            self._finish(client, wf, PHASE_ERROR,
+                         "entrypoint template missing or not dag/steps/container")
+            return Result()
+        names = [t["name"] for t in tasks]
+        if len(set(names)) != len(names):
+            self._finish(client, wf, PHASE_ERROR, "duplicate task names")
+            return Result()
+        by_name = {t["name"]: t for t in tasks}
+        for t in tasks:
+            for dep in t["dependencies"]:
+                if dep not in by_name:
+                    self._finish(client, wf, PHASE_ERROR,
+                                 f"task {t['name']} depends on unknown {dep}")
+                    return Result()
+
+        templates = self._templates(wf.get("spec", {}))
+        params = self._params(wf)
+        nodes: dict[str, dict] = dict(status.get("nodes", {}))
+        need_requeue = False
+
+        # 1. advance running nodes from their pods / resources
+        for t in tasks:
+            node = nodes.get(t["name"])
+            if not node or node["phase"] in TERMINAL:
+                continue
+            tick = self._sync_node(client, wf, t, templates[t["template"]],
+                                   node)
+            need_requeue = need_requeue or tick
+
+        # 2. launch ready tasks
+        failed = any(n["phase"] in (PHASE_FAILED, PHASE_ERROR)
+                     for n in nodes.values())
+        if not failed:
+            for t in tasks:
+                if t["name"] in nodes:
+                    continue
+                deps = [nodes.get(d, {}).get("phase") for d in t["dependencies"]]
+                if all(p == PHASE_SUCCEEDED for p in deps):
+                    tmpl = templates.get(t["template"])
+                    if tmpl is None:
+                        nodes[t["name"]] = {"phase": PHASE_ERROR,
+                                            "message": f"unknown template "
+                                                       f"{t['template']}"}
+                        failed = True
+                        break
+                    nodes[t["name"]] = self._launch(client, wf, t, tmpl,
+                                                    params)
+
+        # 3. failure propagation: mark unreachable tasks Omitted
+        failed = any(n["phase"] in (PHASE_FAILED, PHASE_ERROR)
+                     for n in nodes.values())
+        if failed:
+            for t in tasks:
+                if t["name"] not in nodes:
+                    nodes[t["name"]] = {"phase": PHASE_OMITTED,
+                                        "message": "upstream failure"}
+
+        # 4. roll up workflow phase
+        phases = [nodes.get(t["name"], {}).get("phase") for t in tasks]
+        status["nodes"] = nodes
+        if failed and all(p in TERMINAL for p in phases):
+            self._finish(client, wf, PHASE_FAILED, "a task failed", nodes)
+            return Result()
+        if all(p == PHASE_SUCCEEDED for p in phases):
+            self._finish(client, wf, PHASE_SUCCEEDED, "all tasks succeeded",
+                         nodes)
+            return Result()
+        status["phase"] = PHASE_RUNNING
+        # only write on change: an unconditional write would re-trigger our
+        # own watch and reconcile forever (level-triggered, not write-happy)
+        if _json.dumps(status, sort_keys=True, default=str) != status_before:
+            self._write_status(client, wf, status)
+        return Result(requeue_after=self.poll_interval) if need_requeue \
+            else Result()
+
+    # -- node lifecycle ------------------------------------------------------
+
+    def _pod_name(self, wf: dict, task: str) -> str:
+        return f"{k8s.name_of(wf)}-{task}"
+
+    def _launch(self, client: KubeClient, wf: dict, task: dict, tmpl: dict,
+                params: dict) -> dict:
+        ns = k8s.namespace_of(wf, "default")
+        tmpl = k8s.substitute_params(tmpl, params)
+        deadline = tmpl.get("activeDeadlineSeconds")
+        node: dict[str, Any] = {"phase": PHASE_RUNNING,
+                                "template": task["template"],
+                                "startedAt": self.clock()}
+        if deadline:
+            node["deadlineAt"] = self.clock() + float(deadline)
+        if "container" in tmpl:
+            pod = {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {
+                    "name": self._pod_name(wf, task["name"]), "namespace": ns,
+                    "labels": {WORKFLOW_LABEL: k8s.name_of(wf),
+                               TASK_LABEL: task["name"]},
+                },
+                "spec": {"restartPolicy": "Never",
+                         "containers": [dict(tmpl["container"],
+                                             name=task["name"])]},
+            }
+            k8s.set_owner(pod, wf)
+            try:
+                client.create(pod)
+            except Exception as e:  # noqa: BLE001 - surfaced as node error
+                return {"phase": PHASE_ERROR, "message": str(e)}
+            node["podName"] = pod["metadata"]["name"]
+            node["type"] = "Pod"
+            return node
+        if "resource" in tmpl:
+            res = tmpl["resource"]
+            manifest = res.get("manifest")
+            if isinstance(manifest, str):
+                import yaml
+                manifest = yaml.safe_load(manifest)
+            if not isinstance(manifest, dict):
+                return {"phase": PHASE_ERROR,
+                        "message": "resource template needs a manifest"}
+            manifest.setdefault("metadata", {}).setdefault("namespace", ns)
+            k8s.set_owner(manifest, wf)
+            action = res.get("action", "create")
+            try:
+                if action == "apply":
+                    client.apply(manifest)
+                else:
+                    client.create(manifest)
+            except Exception as e:  # noqa: BLE001 - surfaced as node error
+                return {"phase": PHASE_ERROR, "message": str(e)}
+            node["type"] = "Resource"
+            node["resource"] = list(k8s.key_of(manifest))
+            node["successCondition"] = res.get("successCondition",
+                                               "status.phase=Succeeded")
+            if res.get("failureCondition"):
+                node["failureCondition"] = res["failureCondition"]
+            return node
+        return {"phase": PHASE_ERROR,
+                "message": f"template {task['template']} has neither "
+                           f"container nor resource"}
+
+    def _sync_node(self, client: KubeClient, wf: dict, task: dict,
+                   tmpl: dict, node: dict) -> bool:
+        """Advance one Running node; returns True when it needs polling (a
+        deadline is pending, or a resource kind no watch covers)."""
+        needs_poll = False
+        if node.get("type") == "Pod":
+            ns = k8s.namespace_of(wf, "default")
+            pod = client.get_or_none("v1", "Pod", ns, node.get("podName", ""))
+            if pod is None:
+                node["phase"] = PHASE_ERROR
+                node["message"] = "pod disappeared"
+                return False
+            phase = pod.get("status", {}).get("phase")
+            if phase == "Succeeded":
+                node["phase"] = PHASE_SUCCEEDED
+            elif phase == "Failed":
+                node["phase"] = PHASE_FAILED
+                node["message"] = pod.get("status", {}).get("message",
+                                                            "pod failed")
+        elif node.get("type") == "Resource":
+            av, kind, rns, rname = node["resource"]
+            obj = client.get_or_none(av, kind, rns, rname)
+            if obj is None:
+                node["phase"] = PHASE_ERROR
+                node["message"] = f"{kind} {rns}/{rname} disappeared"
+                return False
+            if node.get("failureCondition") and \
+                    check_condition_expr(obj, node["failureCondition"]):
+                node["phase"] = PHASE_FAILED
+                node["message"] = f"failureCondition met on {kind} {rname}"
+            elif check_condition_expr(obj, node["successCondition"]):
+                node["phase"] = PHASE_SUCCEEDED
+            # unwatched kinds deliver no events, so poll while running
+            needs_poll = (av, kind) not in self.owns
+        # deadline is checked only after the state read: work that finished
+        # in time must win even when the reconcile lands past the deadline
+        if node["phase"] == PHASE_RUNNING and node.get("deadlineAt"):
+            if self.clock() > node["deadlineAt"]:
+                node["phase"] = PHASE_FAILED
+                node["message"] = "deadline exceeded"
+                self._kill_node(client, wf, node)
+                return False
+            needs_poll = True
+        return needs_poll and node["phase"] == PHASE_RUNNING
+
+    def _kill_node(self, client: KubeClient, wf: dict, node: dict) -> None:
+        ns = k8s.namespace_of(wf, "default")
+        try:
+            if node.get("type") == "Pod" and node.get("podName"):
+                client.delete("v1", "Pod", ns, node["podName"])
+            elif node.get("type") == "Resource":
+                av, kind, rns, rname = node["resource"]
+                client.delete(av, kind, rns, rname)
+        except NotFoundError:
+            pass
+
+    # -- status --------------------------------------------------------------
+
+    def _write_status(self, client: KubeClient, wf: dict, status: dict) -> None:
+        fresh = client.get(WORKFLOW_API_VERSION, WORKFLOW_KIND,
+                           k8s.namespace_of(wf, "default"), k8s.name_of(wf))
+        fresh["status"] = status
+        client.update_status(fresh)
+
+    def _finish(self, client: KubeClient, wf: dict, phase: str, message: str,
+                nodes: Optional[dict] = None) -> None:
+        status = dict(wf.get("status", {}))
+        status["phase"] = phase
+        status["message"] = message
+        if nodes is not None:
+            status["nodes"] = nodes
+        k8s.set_condition(wf, k8s.Condition(
+            "Completed", "True", phase, message))
+        status["conditions"] = wf["status"].get("conditions", [])
+        self._write_status(client, wf, status)
+        log.info("workflow %s/%s %s: %s", k8s.namespace_of(wf, "default"),
+                 k8s.name_of(wf), phase, message)

@@ -1,0 +1,346 @@
+"""Kubebench-equivalent benchmark harness.
+
+The reference's kubebench (kubeflow/kubebench/) runs one Argo Workflow per
+benchmark: a configurator step, the launched KF job, and a post-job reporter
+that writes a CSV — wired together with PVC roots and the ``KUBEBENCH_*``
+env contract (kubebench-job.libsonnet:6-30,53,100-120) plus a
+``KubebenchJob`` CRD + operator (kubebench-operator.libsonnet:10-27).
+
+Here:
+- ``KubebenchJobReconciler`` expands a KubebenchJob CR into a Workflow on
+  our engine: configure → run (resource template creating the training job,
+  gang-scheduled by the TPUJob operator) → report.
+- ``run_benchmark`` + ``write_csv_report`` are the reporter's actual logic
+  (importable in-process and used by ``python -m
+  kubeflow_tpu.workflows.kubebench`` inside the reporter container), so the
+  CSV format is testable without a cluster.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import logging
+import os
+import time
+from typing import Any, Optional
+
+from ..api import k8s
+from ..cluster.client import KubeClient, NotFoundError
+from ..controllers.runtime import Key, Reconciler, Result
+from .engine import (PHASE_FAILED, PHASE_RUNNING, PHASE_SUCCEEDED,
+                     WORKFLOW_API_VERSION, WORKFLOW_KIND)
+
+log = logging.getLogger(__name__)
+
+KUBEBENCH_API_VERSION = "kubebench.operator.kubeflow.org/v1alpha1"
+KUBEBENCH_KIND = "KubebenchJob"
+
+# the reference's env contract, preserved verbatim
+ENV_CONFIG_ROOT = "KUBEBENCH_CONFIG_ROOT"
+ENV_DATA_ROOT = "KUBEBENCH_DATA_ROOT"
+ENV_EXP_ROOT = "KUBEBENCH_EXP_ROOT"
+ENV_EXP_ID = "KUBEBENCH_EXP_ID"
+ENV_EXP_PATH = "KUBEBENCH_EXP_PATH"
+
+DEFAULT_IMAGE = "ghcr.io/kubeflow-tpu/kubebench:v0.1.0"
+
+# env the training worker reads to stream per-step metrics (runtime/worker)
+METRICS_PATH_ENV = "KFTPU_METRICS_PATH"
+
+
+def _inject_job_env(manifest: dict, env: dict[str, str]) -> None:
+    """Append env vars to every container in the job manifest (shape varies
+    by job kind, so walk generically — same idiom as katib's injector)."""
+    def walk(node):
+        if isinstance(node, dict):
+            containers = node.get("containers")
+            if isinstance(containers, list):
+                for c in containers:
+                    if isinstance(c, dict):
+                        ce = c.setdefault("env", [])
+                        present = {e.get("name") for e in ce}
+                        for name, value in env.items():
+                            if name not in present:
+                                ce.append({"name": name, "value": value})
+            for v in node.values():
+                walk(v)
+        elif isinstance(node, list):
+            for v in node:
+                walk(v)
+    walk(manifest)
+
+
+def build_kubebench_workflow(name: str, namespace: str, job_manifest: dict,
+                             *, image: str = DEFAULT_IMAGE,
+                             exp_root: str = "/kubebench/experiments",
+                             config_root: str = "/kubebench/config",
+                             data_root: str = "/kubebench/data",
+                             report_type: str = "csv",
+                             deadline_seconds: int = 3000) -> dict:
+    """The configurator → job → reporter Workflow for one benchmark run
+    (kubebench-job.libsonnet shape, with the KF job as a resource step)."""
+    import copy
+    job_manifest = copy.deepcopy(job_manifest)
+    exp_id = name
+    exp_path = f"{exp_root}/{exp_id}"
+    env = [
+        {"name": ENV_CONFIG_ROOT, "value": config_root},
+        {"name": ENV_DATA_ROOT, "value": data_root},
+        {"name": ENV_EXP_ROOT, "value": exp_root},
+        {"name": ENV_EXP_ID, "value": exp_id},
+        {"name": ENV_EXP_PATH, "value": exp_path},
+    ]
+    job_kind = job_manifest.get("kind", "TPUJob")
+    # the benchmarked job streams its per-step metrics into the experiment
+    # dir (shared volume in a real cluster); the reporter aggregates that
+    # file — the post-job CSV reporter contract
+    _inject_job_env(job_manifest, dict(
+        [(e["name"], e["value"]) for e in env] +
+        [(METRICS_PATH_ENV, f"{exp_path}/metrics.jsonl")]))
+    return {
+        "apiVersion": WORKFLOW_API_VERSION, "kind": WORKFLOW_KIND,
+        "metadata": {"name": f"{name}-wf", "namespace": namespace},
+        "spec": {
+            "entrypoint": "kubebench",
+            "templates": [
+                {"name": "kubebench", "dag": {"tasks": [
+                    {"name": "configure", "template": "configurator"},
+                    {"name": "run", "template": "run-job",
+                     "dependencies": ["configure"]},
+                    {"name": "report", "template": "reporter",
+                     "dependencies": ["run"]},
+                ]}},
+                {"name": "configurator",
+                 "activeDeadlineSeconds": deadline_seconds,
+                 "container": {
+                     "image": image,
+                     "command": ["python", "-m",
+                                 "kubeflow_tpu.workflows.kubebench"],
+                     "args": ["configure"], "env": env}},
+                {"name": "run-job",
+                 "activeDeadlineSeconds": deadline_seconds,
+                 "resource": {
+                     "action": "create",
+                     "manifest": job_manifest,
+                     "successCondition": "condition:Succeeded=True",
+                     "failureCondition": "condition:Failed=True"}},
+                {"name": "reporter",
+                 "activeDeadlineSeconds": deadline_seconds,
+                 "container": {
+                     "image": image,
+                     "command": ["python", "-m",
+                                 "kubeflow_tpu.workflows.kubebench"],
+                     "args": ["report", f"--report-type={report_type}",
+                              f"--job-kind={job_kind}"],
+                     "env": env}},
+            ],
+        },
+    }
+
+
+class KubebenchJobReconciler(Reconciler):
+    """KubebenchJob CR → owned Workflow; status mirrors the workflow phase
+    (the kubebench-operator's job, kubebench-operator.libsonnet:10-27)."""
+
+    primary = (KUBEBENCH_API_VERSION, KUBEBENCH_KIND)
+    owns = [(WORKFLOW_API_VERSION, WORKFLOW_KIND)]
+
+    def reconcile(self, client: KubeClient, key: Key) -> Result:
+        ns, name = key
+        try:
+            kb = client.get(KUBEBENCH_API_VERSION, KUBEBENCH_KIND, ns, name)
+        except NotFoundError:
+            return Result()
+        status = kb.setdefault("status", {})
+        if status.get("phase") in (PHASE_SUCCEEDED, PHASE_FAILED):
+            return Result()
+        spec = kb.get("spec", {})
+        job_manifest = spec.get("jobTemplate")
+        if not job_manifest:
+            status["phase"] = PHASE_FAILED
+            status["message"] = "spec.jobTemplate is required"
+            client.update_status(kb)
+            return Result()
+
+        wf_name = f"{name}-wf"
+        wf = client.get_or_none(WORKFLOW_API_VERSION, WORKFLOW_KIND, ns,
+                                wf_name)
+        if wf is None:
+            import copy
+            job = copy.deepcopy(job_manifest)
+            job.setdefault("metadata", {}).setdefault("name", f"{name}-job")
+            job["metadata"].setdefault("namespace", ns)
+            wf = build_kubebench_workflow(
+                name, ns, job,
+                image=spec.get("image", DEFAULT_IMAGE),
+                exp_root=spec.get("experimentsRoot",
+                                  "/kubebench/experiments"),
+                report_type=spec.get("reportType", "csv"),
+                deadline_seconds=int(spec.get("activeDeadlineSeconds", 3000)))
+            k8s.set_owner(wf, kb)
+            client.create(wf)
+            status["phase"] = PHASE_RUNNING
+            status["workflow"] = wf_name
+            client.update_status(kb)
+            return Result()
+
+        wf_phase = wf.get("status", {}).get("phase")
+        if wf_phase in (PHASE_SUCCEEDED, PHASE_FAILED, "Error"):
+            status["phase"] = PHASE_SUCCEEDED if wf_phase == PHASE_SUCCEEDED \
+                else PHASE_FAILED
+            status["message"] = wf.get("status", {}).get("message", "")
+            status["nodes"] = wf.get("status", {}).get("nodes", {})
+            client.update_status(kb)
+        elif status.get("phase") != PHASE_RUNNING:
+            status["phase"] = PHASE_RUNNING
+            client.update_status(kb)
+        return Result()
+
+
+# ---------------------------------------------------------------------------
+# Reporter / configurator logic (runs inside the workflow's containers, and
+# in-process for local benchmarking + tests)
+
+def experiment_paths(env: Optional[dict] = None) -> dict[str, str]:
+    env = env if env is not None else dict(os.environ)
+    exp_path = env.get(ENV_EXP_PATH) or os.path.join(
+        env.get(ENV_EXP_ROOT, "/kubebench/experiments"),
+        env.get(ENV_EXP_ID, "exp"))
+    return {"exp_path": exp_path,
+            "config": env.get(ENV_CONFIG_ROOT, "/kubebench/config"),
+            "data": env.get(ENV_DATA_ROOT, "/kubebench/data"),
+            "exp_id": env.get(ENV_EXP_ID, "exp")}
+
+
+def configure(env: Optional[dict] = None) -> str:
+    """Configurator step: materialize the experiment directory skeleton
+    (the reference's configurator templates the KF job from ksonnet; our
+    job is rendered by the operator, so configure just prepares the roots)."""
+    paths = experiment_paths(env)
+    os.makedirs(paths["exp_path"], exist_ok=True)
+    marker = os.path.join(paths["exp_path"], "experiment.json")
+    with open(marker, "w") as f:
+        json.dump({"id": paths["exp_id"], "created": time.time()}, f)
+    return paths["exp_path"]
+
+
+def write_csv_report(path: str, rows: list[dict[str, Any]]) -> str:
+    """The csv-reporter: one row per run, stable header union (the
+    post-job reporter output kubebench-job.libsonnet:100-120 points at)."""
+    if not rows:
+        raise ValueError("no rows to report")
+    fieldnames: list[str] = []
+    for r in rows:
+        for k in r:
+            if k not in fieldnames:
+                fieldnames.append(k)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=fieldnames)
+        w.writeheader()
+        for r in rows:
+            w.writerow(r)
+    return path
+
+
+def report_from_metrics(metrics_path: str, *, job_kind: str = "TPUJob",
+                        warmup: int = 1,
+                        env: Optional[dict] = None) -> dict[str, Any]:
+    """Aggregate the benchmarked job's metrics.jsonl (MetricsLogger stream,
+    runtime/metrics.py StepStats rows) into the reporter row. This is the
+    post-job reporter reading the run that actually happened — not a rerun."""
+    rows = []
+    with open(metrics_path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    if not rows:
+        raise ValueError(f"no step records in {metrics_path}")
+    steady = rows[warmup:] if len(rows) > warmup else rows
+    times = [r["step_time_s"] for r in steady]
+    mean_t = sum(times) / len(times) if times else 0.0
+    ex_s = (sum(r.get("examples_per_sec", 0.0) for r in steady) / len(steady)
+            if steady else 0.0)
+    last = rows[-1]
+    envd = env if env is not None else dict(os.environ)
+    # StepStats.to_dict flattens model metrics alongside the timing fields
+    timing_keys = {"step", "step_time_s", "examples_per_sec"}
+    model_metrics = dict(last.get("metrics") or {})
+    model_metrics.update({k: v for k, v in last.items()
+                          if k not in timing_keys and k != "metrics"
+                          and isinstance(v, (int, float))})
+    return {
+        "experiment": envd.get(ENV_EXP_ID, "exp"),
+        "job_kind": job_kind,
+        "steps": last.get("step", len(rows)),
+        "examples_per_sec": round(ex_s, 2),
+        "mean_step_time_s": round(mean_t, 6),
+        **{f"metric_{k}": round(float(v), 6)
+           for k, v in sorted(model_metrics.items())},
+    }
+
+
+def run_benchmark(workload: str = "resnet50", steps: int = 10,
+                  global_batch: int = 32, report_path: Optional[str] = None,
+                  **train_kwargs) -> dict[str, Any]:
+    """In-process benchmark: run the real training loop and produce the
+    reporter row (the tf-cnn-equivalent vehicle, SURVEY.md §6)."""
+    from ..runtime.worker import train
+    result = train(workload=workload, steps=steps, global_batch=global_batch,
+                   **train_kwargs)
+    row = {
+        "experiment": os.environ.get(ENV_EXP_ID, "local"),
+        "workload": workload,
+        "steps": result.steps,
+        "global_batch": global_batch,
+        "examples_per_sec": round(result.examples_per_sec, 2),
+        "mean_step_time_s": round(result.mean_step_time_s, 6),
+        **{f"metric_{k}": round(float(v), 6)
+           for k, v in result.final_metrics.items()},
+    }
+    if report_path:
+        write_csv_report(report_path, [row])
+    return row
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(description="kubebench step entrypoint")
+    p.add_argument("step", choices=["configure", "report"])
+    p.add_argument("--report-type", default="csv")
+    p.add_argument("--job-kind", default="TPUJob")
+    p.add_argument("--local", action="store_true",
+                   help="run the workload in-process instead of reporting "
+                        "on a finished job's metrics (dev benchmarking)")
+    p.add_argument("--workload", default="resnet50")
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--global-batch", type=int, default=32)
+    args = p.parse_args(argv)
+    if args.step == "configure":
+        path = configure()
+        log.info("experiment configured at %s", path)
+        return 0
+    paths = experiment_paths()
+    report = os.path.join(paths["exp_path"], "report.csv")
+    if args.local:
+        row = run_benchmark(workload=args.workload, steps=args.steps,
+                            global_batch=args.global_batch,
+                            report_path=report)
+    else:
+        metrics_path = os.path.join(paths["exp_path"], "metrics.jsonl")
+        if not os.path.exists(metrics_path):
+            log.error("no metrics at %s — did the job run with %s set? "
+                      "(use --local for an in-process benchmark)",
+                      metrics_path, METRICS_PATH_ENV)
+            return 1
+        row = report_from_metrics(metrics_path, job_kind=args.job_kind)
+        write_csv_report(report, [row])
+    log.info("report written to %s: %s", report, row)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,355 @@
+"""Workflow engine + kubebench harness tests.
+
+The reference's analog is Argo (deployed, not tested in-repo) and the Argo
+DAG builder its CI uses (testing/workflows/components/workflows.libsonnet
+kfTests: checkout → deploy → parallel steps → teardown). Here the engine is
+ours, so the DAG semantics — dependency gating, fail-fast + Omitted,
+resource-template condition matching, deadlines — get direct envtest-style
+coverage.
+"""
+
+import os
+
+import pytest
+
+from kubeflow_tpu.api import k8s
+from kubeflow_tpu.cluster import FakeCluster
+from kubeflow_tpu.controllers.runtime import Manager
+from kubeflow_tpu.controllers.tpujob import TrainingJobReconciler
+from kubeflow_tpu.workflows.engine import (WORKFLOW_API_VERSION,
+                                           WorkflowReconciler,
+                                           check_condition_expr)
+from kubeflow_tpu.workflows.kubebench import (KUBEBENCH_API_VERSION,
+                                              KubebenchJobReconciler,
+                                              build_kubebench_workflow,
+                                              write_csv_report)
+
+
+def wf_manifest(name="wf", tasks=None, templates=None, entrypoint="main",
+                **spec_extra):
+    tasks = tasks if tasks is not None else [
+        {"name": "a", "template": "step"},
+        {"name": "b", "template": "step", "dependencies": ["a"]},
+    ]
+    templates = templates if templates is not None else [
+        {"name": "step", "container": {"image": "busybox",
+                                       "command": ["true"]}},
+    ]
+    return {
+        "apiVersion": WORKFLOW_API_VERSION, "kind": "Workflow",
+        "metadata": {"name": name, "namespace": "kubeflow"},
+        "spec": {"entrypoint": entrypoint,
+                 "templates": [{"name": "main", "dag": {"tasks": tasks}}]
+                 + templates,
+                 **spec_extra},
+    }
+
+
+@pytest.fixture
+def env():
+    cluster = FakeCluster()
+    cluster.add_node("cpu-0", {"cpu": 96, "memory": 2 ** 36})
+    mgr = Manager(cluster)
+    mgr.add(WorkflowReconciler())
+    return cluster, mgr
+
+
+def drive(cluster, mgr, rounds=8):
+    for _ in range(rounds):
+        mgr.run_pending()
+        cluster.tick()
+    mgr.run_pending()
+
+
+def get_wf(cluster, name="wf"):
+    return cluster.get(WORKFLOW_API_VERSION, "Workflow", "kubeflow", name)
+
+
+def finish_pods(cluster, phase="Succeeded"):
+    for pod in cluster.list("v1", "Pod", "kubeflow"):
+        if pod.get("status", {}).get("phase") == "Running":
+            cluster.set_pod_phase("kubeflow", k8s.name_of(pod), phase)
+
+
+class TestConditionExpr:
+    def test_status_phase_form(self):
+        assert check_condition_expr({"status": {"phase": "Succeeded"}},
+                                    "status.phase = Succeeded")
+        assert not check_condition_expr({"status": {}}, "status.phase=X")
+
+    def test_condition_form(self):
+        obj = {"status": {"conditions": [
+            {"type": "Succeeded", "status": "True"}]}}
+        assert check_condition_expr(obj, "condition:Succeeded=True")
+        assert not check_condition_expr(obj, "condition:Failed=True")
+
+
+class TestWorkflowEngine:
+    def test_dag_dependency_ordering(self, env):
+        cluster, mgr = env
+        cluster.create(wf_manifest())
+        mgr.run_pending()
+        pods = cluster.list("v1", "Pod", "kubeflow")
+        assert [k8s.name_of(p) for p in pods] == ["wf-a"]  # b gated on a
+        cluster.tick()  # a starts Running
+        cluster.set_pod_phase("kubeflow", "wf-a", "Succeeded")
+        mgr.run_pending()
+        pods = {k8s.name_of(p) for p in cluster.list("v1", "Pod", "kubeflow")}
+        assert pods == {"wf-a", "wf-b"}
+        cluster.tick()
+        cluster.set_pod_phase("kubeflow", "wf-b", "Succeeded")
+        mgr.run_pending()
+        wf = get_wf(cluster)
+        assert wf["status"]["phase"] == "Succeeded"
+        assert wf["status"]["nodes"]["a"]["phase"] == "Succeeded"
+
+    def test_fail_fast_marks_downstream_omitted(self, env):
+        cluster, mgr = env
+        cluster.create(wf_manifest(tasks=[
+            {"name": "a", "template": "step"},
+            {"name": "b", "template": "step", "dependencies": ["a"]},
+            {"name": "c", "template": "step", "dependencies": ["b"]},
+        ]))
+        mgr.run_pending()
+        cluster.tick()
+        cluster.fail_pod("kubeflow", "wf-a")
+        mgr.run_pending()
+        wf = get_wf(cluster)
+        assert wf["status"]["phase"] == "Failed"
+        assert wf["status"]["nodes"]["a"]["phase"] == "Failed"
+        assert wf["status"]["nodes"]["b"]["phase"] == "Omitted"
+        assert wf["status"]["nodes"]["c"]["phase"] == "Omitted"
+
+    def test_steps_template_serial_groups(self, env):
+        cluster, mgr = env
+        m = {
+            "apiVersion": WORKFLOW_API_VERSION, "kind": "Workflow",
+            "metadata": {"name": "wf", "namespace": "kubeflow"},
+            "spec": {"entrypoint": "main", "templates": [
+                {"name": "main", "steps": [
+                    [{"name": "s1", "template": "step"}],
+                    [{"name": "s2a", "template": "step"},
+                     {"name": "s2b", "template": "step"}],
+                ]},
+                {"name": "step", "container": {"image": "busybox"}},
+            ]},
+        }
+        cluster.create(m)
+        mgr.run_pending()
+        assert {k8s.name_of(p) for p in cluster.list("v1", "Pod", "kubeflow")} \
+            == {"wf-s1"}
+        cluster.tick()
+        finish_pods(cluster)
+        mgr.run_pending()
+        # both members of group 2 launch together after group 1
+        assert {k8s.name_of(p) for p in cluster.list("v1", "Pod", "kubeflow")} \
+            == {"wf-s1", "wf-s2a", "wf-s2b"}
+
+    def test_parameter_substitution(self, env):
+        cluster, mgr = env
+        m = wf_manifest(
+            tasks=[{"name": "a", "template": "step"}],
+            templates=[{"name": "step", "container": {
+                "image": "bench:$(workflow.parameters.tag)",
+                "args": ["--run=$(workflow.name)"]}}],
+            arguments={"parameters": [{"name": "tag", "value": "v9"}]})
+        cluster.create(m)
+        mgr.run_pending()
+        pod = cluster.get("v1", "Pod", "kubeflow", "wf-a")
+        assert pod["spec"]["containers"][0]["image"] == "bench:v9"
+        assert pod["spec"]["containers"][0]["args"] == ["--run=wf"]
+
+    def test_resource_template_tracks_condition(self, env):
+        cluster, mgr = env
+        m = wf_manifest(
+            tasks=[{"name": "train", "template": "run-job"}],
+            templates=[{"name": "run-job", "resource": {
+                "action": "create",
+                "manifest": {"apiVersion": "tpu.kubeflow.org/v1alpha1",
+                             "kind": "TPUJob",
+                             "metadata": {"name": "bench-job"},
+                             "spec": {}},
+                "successCondition": "condition:Succeeded=True",
+                "failureCondition": "condition:Failed=True"}}])
+        cluster.create(m)
+        mgr.run_pending()
+        job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob", "kubeflow",
+                          "bench-job")
+        assert job["metadata"]["ownerReferences"][0]["kind"] == "Workflow"
+        assert get_wf(cluster)["status"]["phase"] == "Running"
+        k8s.set_condition(job, k8s.Condition("Succeeded", "True", "Done", ""))
+        cluster.update_status(job)
+        mgr.run_pending()
+        assert get_wf(cluster)["status"]["phase"] == "Succeeded"
+
+    def test_deadline_fails_task(self, env):
+        cluster, mgr = env
+        now = [0.0]
+        recon = WorkflowReconciler(clock=lambda: now[0])
+        mgr2 = Manager(cluster)
+        ctrl = mgr2.add(recon)
+        m = wf_manifest(
+            tasks=[{"name": "a", "template": "slow"}],
+            templates=[{"name": "slow", "activeDeadlineSeconds": 10,
+                        "container": {"image": "busybox"}}],
+            name="dlwf")
+        cluster.create(m)
+        mgr2.run_pending()
+        cluster.tick()  # pod Running
+        now[0] = 11.0
+        # deadline polling: requeue_after fires after the delay elapses
+        import time as _t
+        _t.sleep(0.06)
+        ctrl.pump_events()
+        mgr2.run_pending()
+        wf = get_wf(cluster, "dlwf")
+        assert wf["status"]["phase"] == "Failed"
+        assert "deadline" in wf["status"]["nodes"]["a"]["message"]
+        # the pod was killed
+        assert cluster.get_or_none("v1", "Pod", "kubeflow", "dlwf-a") is None
+
+    def test_bad_entrypoint_errors(self, env):
+        cluster, mgr = env
+        m = wf_manifest(entrypoint="nope")
+        cluster.create(m)
+        mgr.run_pending()
+        assert get_wf(cluster)["status"]["phase"] == "Error"
+
+    def test_unknown_dependency_errors(self, env):
+        cluster, mgr = env
+        cluster.create(wf_manifest(tasks=[
+            {"name": "a", "template": "step", "dependencies": ["ghost"]}]))
+        mgr.run_pending()
+        assert get_wf(cluster)["status"]["phase"] == "Error"
+
+
+class TestKubebench:
+    def test_workflow_shape_and_env_contract(self):
+        wf = build_kubebench_workflow(
+            "bench1", "kubeflow",
+            {"kind": "TPUJob", "metadata": {"name": "bench1-job"},
+             "spec": {}})
+        names = [t["name"] for t in wf["spec"]["templates"]]
+        assert names == ["kubebench", "configurator", "run-job", "reporter"]
+        dag = wf["spec"]["templates"][0]["dag"]["tasks"]
+        assert dag[1]["dependencies"] == ["configure"]
+        assert dag[2]["dependencies"] == ["run"]
+        env = {e["name"]: e["value"]
+               for e in wf["spec"]["templates"][1]["container"]["env"]}
+        assert env["KUBEBENCH_EXP_ID"] == "bench1"
+        assert env["KUBEBENCH_EXP_PATH"].endswith("/bench1")
+
+    def test_kubebenchjob_end_to_end(self):
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        mgr = Manager(cluster)
+        mgr.add(WorkflowReconciler())
+        mgr.add(KubebenchJobReconciler())
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        cluster.create({
+            "apiVersion": KUBEBENCH_API_VERSION, "kind": "KubebenchJob",
+            "metadata": {"name": "bench1", "namespace": "kubeflow"},
+            "spec": {"jobTemplate": {
+                "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+                "spec": {"replicaSpecs": {"TPU": {
+                    "tpuTopology": "v5e-8",
+                    "template": {"spec": {"containers": [
+                        {"name": "bench", "image": "bench:v1"}]}}}}},
+            }},
+        })
+
+        def on_running(pod):
+            # benchmark workload pods finish immediately; workflow step pods
+            # (configurator/reporter) too
+            cluster.set_pod_phase(k8s.namespace_of(pod, "default"),
+                                  k8s.name_of(pod), "Succeeded")
+
+        cluster.on_pod_running = on_running
+        kb = None
+        for _ in range(30):
+            mgr.run_pending()
+            cluster.tick()
+            mgr.run_pending()
+            kb = cluster.get(KUBEBENCH_API_VERSION, "KubebenchJob",
+                             "kubeflow", "bench1")
+            if kb["status"].get("phase") in ("Succeeded", "Failed"):
+                break
+        assert kb["status"]["phase"] == "Succeeded", kb["status"]
+        wf = cluster.get(WORKFLOW_API_VERSION, "Workflow", "kubeflow",
+                         "bench1-wf")
+        assert wf["status"]["nodes"]["run"]["phase"] == "Succeeded"
+        job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob", "kubeflow",
+                          "bench1-job")
+        assert k8s.condition_true(job, "Succeeded")
+
+    def test_csv_report(self, tmp_path):
+        path = str(tmp_path / "out" / "report.csv")
+        write_csv_report(path, [
+            {"experiment": "e1", "examples_per_sec": 100.0},
+            {"experiment": "e2", "examples_per_sec": 120.0, "extra": 1},
+        ])
+        with open(path) as f:
+            lines = f.read().strip().splitlines()
+        assert lines[0] == "experiment,examples_per_sec,extra"
+        assert lines[1].startswith("e1,100.0")
+        assert len(lines) == 3
+
+    def test_csv_report_empty_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            write_csv_report(str(tmp_path / "r.csv"), [])
+
+    def test_job_env_injection(self):
+        wf = build_kubebench_workflow(
+            "b", "kubeflow",
+            {"kind": "TPUJob", "metadata": {"name": "b-job"},
+             "spec": {"replicaSpecs": {"TPU": {"template": {"spec": {
+                 "containers": [{"name": "t", "image": "i"}]}}}}}})
+        manifest = wf["spec"]["templates"][2]["resource"]["manifest"]
+        env = {e["name"]: e["value"] for e in
+               manifest["spec"]["replicaSpecs"]["TPU"]["template"]["spec"][
+                   "containers"][0]["env"]}
+        assert env["KFTPU_METRICS_PATH"].endswith("/b/metrics.jsonl")
+        assert env["KUBEBENCH_EXP_ID"] == "b"
+
+    def test_report_from_metrics_aggregates_job_run(self, tmp_path):
+        import json
+        from kubeflow_tpu.workflows.kubebench import report_from_metrics
+        path = tmp_path / "metrics.jsonl"
+        rows = [{"step": i + 1, "step_time_s": 0.1,
+                 "examples_per_sec": 320.0,
+                 "metrics": {"loss": 2.0 - 0.1 * i}} for i in range(5)]
+        path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        row = report_from_metrics(str(path), job_kind="TFJob",
+                                  env={"KUBEBENCH_EXP_ID": "e7"})
+        assert row["experiment"] == "e7"
+        assert row["job_kind"] == "TFJob"
+        assert row["steps"] == 5
+        assert row["examples_per_sec"] == 320.0
+        assert row["metric_loss"] == pytest.approx(1.6)
+
+
+class TestWorkflowEdgeCases:
+    def test_task_missing_template_key_errors_cleanly(self, env):
+        cluster, mgr = env
+        cluster.create(wf_manifest(tasks=[{"name": "a"}]))
+        mgr.run_pending()
+        wf = get_wf(cluster)
+        assert wf["status"]["phase"] == "Error"
+        assert "name and template" in wf["status"]["message"]
+
+    def test_succeeded_before_deadline_observed_late_still_succeeds(self):
+        cluster = FakeCluster()
+        cluster.add_node("cpu-0", {"cpu": 96, "memory": 2 ** 36})
+        now = [0.0]
+        mgr = Manager(cluster)
+        mgr.add(WorkflowReconciler(clock=lambda: now[0]))
+        cluster.create(wf_manifest(
+            tasks=[{"name": "a", "template": "slow"}],
+            templates=[{"name": "slow", "activeDeadlineSeconds": 10,
+                        "container": {"image": "busybox"}}]))
+        mgr.run_pending()
+        cluster.tick()
+        cluster.set_pod_phase("kubeflow", "wf-a", "Succeeded")
+        now[0] = 100.0  # reconcile lands long after the deadline instant
+        mgr.run_pending()
+        assert get_wf(cluster)["status"]["phase"] == "Succeeded"

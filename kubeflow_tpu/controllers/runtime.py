@@ -34,6 +34,17 @@ log = logging.getLogger(__name__)
 Key = tuple[str, str]
 
 
+def status_snapshot(status: dict) -> str:
+    """Stable serialization of a status dict, for write-on-change guards.
+
+    Reconcilers that unconditionally update_status retrigger their own watch
+    and reconcile forever; compare snapshots taken before/after mutation and
+    skip the write when equal.
+    """
+    import json
+    return json.dumps(status, sort_keys=True, default=str)
+
+
 @dataclass
 class Result:
     requeue: bool = False

@@ -33,7 +33,8 @@ from ..api.trainingjob import (COND_CREATED, COND_FAILED, COND_RUNNING,
                                COND_SUCCEEDED, JOB_KINDS, KF_API_VERSION_V1ALPHA1,
                                KF_API_VERSION_V1BETA2, TPU_API_VERSION)
 from ..cluster.client import KubeClient, NotFoundError
-from ..controllers.runtime import Key, Reconciler, Result
+from ..controllers.runtime import (Key, Reconciler, Result,
+                                   status_snapshot)
 from .suggestion import Suggestion, make_suggestion, parse_parameter_configs
 from .vizier import STUDY_ENV, TRIAL_ENV, VIZIER_URL_ENV, VizierDB
 
@@ -187,8 +188,7 @@ class StudyJobReconciler(Reconciler):
         if k8s.condition_true(manifest, COND_SUCCEEDED) or \
                 k8s.condition_true(manifest, COND_FAILED):
             return Result()
-        import json as _json
-        status_before = _json.dumps(status, sort_keys=True, default=str)
+        status_before = status_snapshot(status)
 
         spec = manifest.get("spec", {})
         study = spec.get("studyName") or name
@@ -306,9 +306,7 @@ class StudyJobReconciler(Reconciler):
                              "StudyCompleted", msg, status)
             return Result()
 
-        # only write on change — an unconditional status write would
-        # re-trigger our own watch and reconcile forever
-        if _json.dumps(status, sort_keys=True, default=str) != status_before:
+        if status_snapshot(status) != status_before:
             self._write_status(client, manifest, status)
         if not k8s.condition_true(manifest, COND_RUNNING) and trials:
             self._set_condition(client, manifest, COND_RUNNING,

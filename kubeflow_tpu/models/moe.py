@@ -1,0 +1,133 @@
+"""Mixture-of-experts MLP with expert parallelism (EP).
+
+The reference has no expert parallelism anywhere (SURVEY.md §2.5 row 5 /
+§5 long-context note: the platform only scales data-parallel replicas); the
+TPU build supplies EP natively as mesh-axis sharding. This is the GShard /
+Switch-Transformer formulation expressed as einsums:
+
+- a float32 router picks top-k experts per token under a capacity limit,
+- dispatch/combine one-hot tensors route tokens to per-expert FFN weights
+  that carry a leading logical "expert" axis (→ mesh axis "expert",
+  parallel/sharding_rules.py),
+- with tokens sharded over data axes and weights over the expert axis, XLA
+  lowers the dispatch/combine einsums to ICI **all-to-all** collectives —
+  the compiler-scheduled equivalent of the manual a2a in NCCL MoE stacks.
+
+Capacity keeps shapes static (XLA requirement): each expert processes at
+most C = ceil(top_k * S * capacity_factor / E) tokens per group; overflow
+tokens are dropped (their combine weight is zero and the residual connection
+carries them through unchanged), the standard TPU MoE trade.
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+AUX_LOSS_COLLECTION = "losses"
+
+
+def _top_k_mask(gates: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+    """Per-token top-k expert assignment, returned one level at a time.
+    Returns (indices [k, B, S], gate values [k, B, S])."""
+    idxs, vals = [], []
+    masked = gates
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)
+        val = jnp.take_along_axis(masked, idx[..., None], axis=-1)[..., 0]
+        idxs.append(idx)
+        vals.append(val)
+        masked = masked * (1.0 - jax.nn.one_hot(idx, gates.shape[-1],
+                                                dtype=gates.dtype))
+    return jnp.stack(idxs), jnp.stack(vals)
+
+
+def load_balancing_loss(router_probs: jax.Array,
+                        expert_index: jax.Array) -> jax.Array:
+    """Switch-Transformer auxiliary loss: E * Σ_e f_e · P_e, minimized at
+    uniform routing. f_e = fraction of tokens whose top-1 choice is e,
+    P_e = mean router probability on e. All in float32."""
+    num_experts = router_probs.shape[-1]
+    f = jnp.mean(jax.nn.one_hot(expert_index, num_experts,
+                                dtype=jnp.float32), axis=(0, 1))
+    p = jnp.mean(router_probs.astype(jnp.float32), axis=(0, 1))
+    return num_experts * jnp.sum(f * p)
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for the dense MLP block.
+
+    Attributes mirror TransformerConfig: ``num_experts``, ``top_k``,
+    ``capacity_factor``, ``mlp_dim``, ``dtype``; aux loss is sown into the
+    "losses" collection for the loss fn to pick up.
+    """
+
+    num_experts: int
+    mlp_dim: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        B, S, M = x.shape
+        E, K = self.num_experts, self.top_k
+        capacity = max(1, int(math.ceil(K * S * self.capacity_factor / E)))
+
+        # router in float32: small matmul, numerically load-bearing
+        router_kernel = self.param(
+            "router", nn.initializers.lecun_normal(), (M, E), jnp.float32)
+        router_logits = jnp.einsum("bsm,me->bse", x.astype(jnp.float32),
+                                   router_kernel)
+        router_probs = jax.nn.softmax(router_logits, axis=-1)
+
+        expert_idx, expert_gate = _top_k_mask(router_probs, K)  # [K,B,S]
+
+        aux = load_balancing_loss(router_probs, expert_idx[0])
+        self.sow(AUX_LOSS_COLLECTION, "moe_aux",
+                 self.aux_loss_weight * aux,
+                 reduce_fn=lambda a, b: a + b, init_fn=lambda: jnp.float32(0))
+
+        # capacity assignment: k-th choices queue behind all (k-1)-th
+        # choices, GShard ordering; position = running count per expert
+        onehots = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # [K,B,S,E]
+        prev = jnp.zeros((B, 1, E), jnp.int32)
+        dispatch_layers = []
+        combine_gate_sum = jnp.zeros((B, S), jnp.float32)
+        for k in range(K):
+            oh = onehots[k]                                    # [B,S,E]
+            pos = jnp.cumsum(oh, axis=1) - oh + prev           # [B,S,E]
+            prev = prev + jnp.sum(oh, axis=1, keepdims=True)
+            pos_tok = jnp.sum(pos * oh, axis=-1)               # [B,S]
+            keep = (pos_tok < capacity).astype(jnp.float32)
+            gate = expert_gate[k] * keep                       # [B,S]
+            combine_gate_sum = combine_gate_sum + gate
+            cap_oh = jax.nn.one_hot(pos_tok, capacity, dtype=jnp.float32)
+            dispatch_layers.append(
+                gate[..., None, None] * oh.astype(jnp.float32)[..., None]
+                * cap_oh[:, :, None, :])                       # [B,S,E,C]
+        combine = sum(dispatch_layers)                         # gated
+        # renormalize so surviving gates sum to 1 per token
+        denom = jnp.where(combine_gate_sum > 0, combine_gate_sum, 1.0)
+        combine = combine / denom[..., None, None]
+        dispatch = (combine > 0).astype(self.dtype)            # [B,S,E,C]
+        combine = combine.astype(self.dtype)
+
+        wi = self.param("wi", nn.initializers.lecun_normal(),
+                        (E, M, self.mlp_dim), jnp.float32)
+        wo = self.param("wo", nn.initializers.lecun_normal(),
+                        (E, self.mlp_dim, M), jnp.float32)
+
+        xd = x.astype(self.dtype)
+        # all-to-all boundary: tokens regroup from data-sharding to
+        # expert-sharding (XLA inserts the collective from the shardings)
+        expert_in = jnp.einsum("bsec,bsm->ebcm", dispatch, xd)
+        h = nn.gelu(jnp.einsum("ebcm,emh->ebch", expert_in,
+                               wi.astype(self.dtype)))
+        expert_out = jnp.einsum("ebch,ehm->ebcm", h, wo.astype(self.dtype))
+        # all-to-all back: expert-sharding → data-sharding
+        return jnp.einsum("bsec,ebcm->bsm", combine, expert_out)

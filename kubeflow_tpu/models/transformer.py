@@ -44,6 +44,12 @@ class TransformerConfig:
     # parallel ring attention over mesh axis "sequence" for long context)
     attention: str = "einsum"
     mesh: Any = None             # required for attention="ring"
+    # mixture-of-experts: num_experts > 0 swaps the dense MLP for MoEMLP
+    # (models/moe.py) with expert-parallel weights (mesh axis "expert")
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     def __post_init__(self):
         valid = ("einsum", "flash", "ring")
@@ -107,10 +113,20 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        cfg = self.cfg
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
-        x = x + Attention(self.cfg, name="attn")(y)
+        x = x + Attention(cfg, name="attn")(y)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
-        return x + MLP(self.cfg, name="mlp")(y)
+        if cfg.num_experts > 0:
+            from .moe import MoEMLP
+            ff = MoEMLP(num_experts=cfg.num_experts, mlp_dim=cfg.mlp_dim,
+                        top_k=cfg.moe_top_k,
+                        capacity_factor=cfg.moe_capacity_factor,
+                        aux_loss_weight=cfg.moe_aux_weight,
+                        dtype=cfg.dtype, name="moe")(y)
+        else:
+            ff = MLP(cfg, name="mlp")(y)
+        return x + ff
 
 
 class TransformerLM(nn.Module):
@@ -225,6 +241,9 @@ _LOGICAL_PATTERNS: list[tuple[str, tuple]] = [
     (r"attn/out.*kernel", ("heads", "head_dim", "embed")),
     (r"mlp/wi.*kernel", ("embed", "mlp")),
     (r"mlp/wo.*kernel", ("mlp", "embed")),
+    (r"moe/router", ("embed", None)),
+    (r"moe/wi", ("expert", "embed", "mlp")),
+    (r"moe/wo", ("expert", "mlp", "embed")),
     (r"head.*kernel", ("embed", "vocab")),
     (r"(ln\d*|ln_f)/(scale|bias)", ("embed",)),
 ]
@@ -281,8 +300,19 @@ def next_token_loss(logits: jax.Array, tokens: jax.Array) -> tuple:
 
 
 def make_loss_fn(model: TransformerLM) -> Callable:
+    moe = model.cfg.num_experts > 0
+
     def loss_fn(params, variables, batch, rng):
         tokens = batch["tokens"]
+        if moe:
+            from .moe import AUX_LOSS_COLLECTION
+            logits, mods = model.apply({"params": params}, tokens,
+                                       mutable=[AUX_LOSS_COLLECTION])
+            loss, metrics = next_token_loss(logits, tokens)
+            aux = sum(jax.tree.leaves(mods.get(AUX_LOSS_COLLECTION, {})),
+                      jnp.float32(0))
+            metrics["moe_aux_loss"] = aux
+            return loss + aux, metrics
         logits = model.apply({"params": params}, tokens)
         return next_token_loss(logits, tokens)
 
@@ -311,6 +341,13 @@ def pipelined_workload_spec(cfg: Optional[TransformerConfig] = None,
     """WorkloadSpec for the stacked/pipelined LM (ShardingSpec.pipeline>1)."""
     from ..runtime.worker import WorkloadSpec
     cfg = cfg or TransformerConfig.tiny()
+    if cfg.num_experts > 0:
+        # the GPipe block scan never makes the "losses" collection mutable,
+        # so MoE aux loss would silently vanish — refuse rather than train a
+        # collapsed router
+        raise NotImplementedError(
+            "MoE (num_experts>0) is not supported on the pipelined path "
+            "yet; use the non-pipelined transformer workload for EP")
     seq_len = seq_len or cfg.max_seq_len
     model = PipelinedTransformerLM(cfg)
 

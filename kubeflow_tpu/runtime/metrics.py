@@ -18,6 +18,10 @@ from typing import Any, Optional
 
 log = logging.getLogger(__name__)
 
+# env contract: where the worker streams per-step JSONL so external
+# harnesses (workflows/kubebench reporter) can aggregate the run
+METRICS_PATH_ENV = "KFTPU_METRICS_PATH"
+
 
 @dataclass
 class StepStats:

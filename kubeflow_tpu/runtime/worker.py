@@ -26,7 +26,7 @@ import optax
 
 from .bootstrap import WorkerContext, initialize
 from .checkpoint import CheckpointManager, HAVE_ORBAX
-from .metrics import MetricsLogger, profile_trace
+from .metrics import METRICS_PATH_ENV, MetricsLogger, profile_trace
 from .trainstep import TrainStepBuilder
 
 log = logging.getLogger(__name__)
@@ -130,7 +130,7 @@ def train(
     step_fn = builder.build()
     # kubebench injects KFTPU_METRICS_PATH so the reporter can aggregate
     # this run's per-step stream (workflows/kubebench.py report_from_metrics)
-    metrics_path = metrics_path or os.environ.get("KFTPU_METRICS_PATH")
+    metrics_path = metrics_path or os.environ.get(METRICS_PATH_ENV)
     if metrics_path:
         os.makedirs(os.path.dirname(metrics_path) or ".", exist_ok=True)
     mlog = MetricsLogger(metrics_path, batch_size=global_batch)

@@ -30,7 +30,8 @@ from typing import Any, Optional
 
 from ..api import k8s
 from ..cluster.client import KubeClient, NotFoundError
-from ..controllers.runtime import Key, Reconciler, Result
+from ..controllers.runtime import (Key, Reconciler, Result,
+                                   status_snapshot)
 
 log = logging.getLogger(__name__)
 
@@ -85,7 +86,9 @@ class WorkflowReconciler(Reconciler):
             ("kubeflow.org/v1beta2", "PyTorchJob"),
             ("kubeflow.org/v1alpha1", "MPIJob")]
 
-    def __init__(self, clock=time.monotonic, poll_interval: float = 0.25):
+    def __init__(self, clock=time.time, poll_interval: float = 0.25):
+        # wall clock, not monotonic: deadlineAt/startedAt persist into
+        # status and must survive controller restarts
         self.clock = clock
         # requeue delay for state no watch event covers (unwatched resource
         # kinds, pending deadlines)
@@ -148,8 +151,7 @@ class WorkflowReconciler(Reconciler):
         status = wf.setdefault("status", {})
         if status.get("phase") in (PHASE_SUCCEEDED, PHASE_FAILED, PHASE_ERROR):
             return Result()
-        import json as _json
-        status_before = _json.dumps(status, sort_keys=True, default=str)
+        status_before = status_snapshot(status)
 
         try:
             tasks = self._task_list(wf)
@@ -225,9 +227,7 @@ class WorkflowReconciler(Reconciler):
                          nodes)
             return Result()
         status["phase"] = PHASE_RUNNING
-        # only write on change: an unconditional write would re-trigger our
-        # own watch and reconcile forever (level-triggered, not write-happy)
-        if _json.dumps(status, sort_keys=True, default=str) != status_before:
+        if status_snapshot(status) != status_before:
             self._write_status(client, wf, status)
         return Result(requeue_after=self.poll_interval) if need_requeue \
             else Result()
@@ -248,6 +248,15 @@ class WorkflowReconciler(Reconciler):
         if deadline:
             node["deadlineAt"] = self.clock() + float(deadline)
         if "container" in tmpl:
+            # volumes: template-level plus workflow-level (Argo spec.volumes
+            # — how kubebench shares its PVC roots across steps)
+            volumes = list(wf.get("spec", {}).get("volumes") or []) + \
+                list(tmpl.get("volumes") or [])
+            pod_spec = {"restartPolicy": "Never",
+                        "containers": [dict(tmpl["container"],
+                                            name=task["name"])]}
+            if volumes:
+                pod_spec["volumes"] = volumes
             pod = {
                 "apiVersion": "v1", "kind": "Pod",
                 "metadata": {
@@ -255,9 +264,7 @@ class WorkflowReconciler(Reconciler):
                     "labels": {WORKFLOW_LABEL: k8s.name_of(wf),
                                TASK_LABEL: task["name"]},
                 },
-                "spec": {"restartPolicy": "Never",
-                         "containers": [dict(tmpl["container"],
-                                             name=task["name"])]},
+                "spec": pod_spec,
             }
             k8s.set_owner(pod, wf)
             try:

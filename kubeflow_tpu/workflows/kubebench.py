@@ -45,8 +45,31 @@ ENV_EXP_PATH = "KUBEBENCH_EXP_PATH"
 
 DEFAULT_IMAGE = "ghcr.io/kubeflow-tpu/kubebench:v0.1.0"
 
-# env the training worker reads to stream per-step metrics (runtime/worker)
-METRICS_PATH_ENV = "KFTPU_METRICS_PATH"
+from ..runtime.metrics import METRICS_PATH_ENV  # noqa: E402 (env contract)
+
+
+def _inject_job_volume(manifest: dict, volume: dict, mount: dict) -> None:
+    """Attach the shared kubebench volume to every pod spec in the job
+    manifest (any dict holding a "containers" list is a pod spec)."""
+    def walk(node):
+        if isinstance(node, dict):
+            containers = node.get("containers")
+            if isinstance(containers, list):
+                vols = node.setdefault("volumes", [])
+                if not any(v.get("name") == volume["name"] for v in vols):
+                    vols.append(volume)
+                for c in containers:
+                    if isinstance(c, dict):
+                        mounts = c.setdefault("volumeMounts", [])
+                        if not any(m.get("name") == mount["name"]
+                                   for m in mounts):
+                            mounts.append(mount)
+            for v in node.values():
+                walk(v)
+        elif isinstance(node, list):
+            for v in node:
+                walk(v)
+    walk(manifest)
 
 
 def _inject_job_env(manifest: dict, env: dict[str, str]) -> None:
@@ -77,13 +100,24 @@ def build_kubebench_workflow(name: str, namespace: str, job_manifest: dict,
                              config_root: str = "/kubebench/config",
                              data_root: str = "/kubebench/data",
                              report_type: str = "csv",
-                             deadline_seconds: int = 3000) -> dict:
+                             deadline_seconds: int = 3000,
+                             pvc: Optional[str] = None) -> dict:
     """The configurator → job → reporter Workflow for one benchmark run
-    (kubebench-job.libsonnet shape, with the KF job as a resource step)."""
+    (kubebench-job.libsonnet shape, with the KF job as a resource step).
+
+    ``pvc`` names the PersistentVolumeClaim mounted at /kubebench in every
+    step AND in the benchmarked job — the cross-step file handoff
+    (experiment dir, metrics stream, CSV report) rides this shared volume,
+    exactly the reference's PVC-roots design (kubebench-job.libsonnet PVC
+    params for config/data/experiments).
+    """
     import copy
     job_manifest = copy.deepcopy(job_manifest)
     exp_id = name
     exp_path = f"{exp_root}/{exp_id}"
+    volume = {"name": "kubebench",
+              "persistentVolumeClaim": {"claimName": pvc}} if pvc else None
+    mount = {"name": "kubebench", "mountPath": "/kubebench"}
     env = [
         {"name": ENV_CONFIG_ROOT, "value": config_root},
         {"name": ENV_DATA_ROOT, "value": data_root},
@@ -98,11 +132,16 @@ def build_kubebench_workflow(name: str, namespace: str, job_manifest: dict,
     _inject_job_env(job_manifest, dict(
         [(e["name"], e["value"]) for e in env] +
         [(METRICS_PATH_ENV, f"{exp_path}/metrics.jsonl")]))
+    if volume:
+        _inject_job_volume(job_manifest, volume, mount)
+    step_container_extra = {"volumeMounts": [mount]} if volume else {}
+    wf_spec_extra = {"volumes": [volume]} if volume else {}
     return {
         "apiVersion": WORKFLOW_API_VERSION, "kind": WORKFLOW_KIND,
         "metadata": {"name": f"{name}-wf", "namespace": namespace},
         "spec": {
             "entrypoint": "kubebench",
+            **wf_spec_extra,
             "templates": [
                 {"name": "kubebench", "dag": {"tasks": [
                     {"name": "configure", "template": "configurator"},
@@ -117,7 +156,8 @@ def build_kubebench_workflow(name: str, namespace: str, job_manifest: dict,
                      "image": image,
                      "command": ["python", "-m",
                                  "kubeflow_tpu.workflows.kubebench"],
-                     "args": ["configure"], "env": env}},
+                     "args": ["configure"], "env": env,
+                     **step_container_extra}},
                 {"name": "run-job",
                  "activeDeadlineSeconds": deadline_seconds,
                  "resource": {
@@ -133,7 +173,8 @@ def build_kubebench_workflow(name: str, namespace: str, job_manifest: dict,
                                  "kubeflow_tpu.workflows.kubebench"],
                      "args": ["report", f"--report-type={report_type}",
                               f"--job-kind={job_kind}"],
-                     "env": env}},
+                     "env": env,
+                     **step_container_extra}},
             ],
         },
     }
@@ -177,7 +218,8 @@ class KubebenchJobReconciler(Reconciler):
                 exp_root=spec.get("experimentsRoot",
                                   "/kubebench/experiments"),
                 report_type=spec.get("reportType", "csv"),
-                deadline_seconds=int(spec.get("activeDeadlineSeconds", 3000)))
+                deadline_seconds=int(spec.get("activeDeadlineSeconds", 3000)),
+                pvc=spec.get("pvcName"))
             k8s.set_owner(wf, kb)
             client.create(wf)
             status["phase"] = PHASE_RUNNING

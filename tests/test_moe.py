@@ -1,0 +1,150 @@
+"""Mixture-of-experts / expert-parallelism tests (8-device CPU mesh).
+
+The reference has no EP anywhere (SURVEY.md §2.5 row 5); these tests pin
+down the native implementation: routing math, capacity semantics, aux-loss
+plumbing, and a real train step with the expert axis sharded over the mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from kubeflow_tpu.api.trainingjob import ShardingSpec
+from kubeflow_tpu.models import transformer as T
+from kubeflow_tpu.models.moe import MoEMLP, load_balancing_loss
+from kubeflow_tpu.parallel.mesh import build_mesh
+from kubeflow_tpu.runtime.trainstep import TrainStepBuilder
+
+
+def tiny_moe_cfg(**kw):
+    base = dict(vocab_size=64, num_layers=2, embed_dim=32, num_heads=2,
+                head_dim=16, mlp_dim=64, max_seq_len=32, num_experts=4)
+    base.update(kw)
+    return T.TransformerConfig(**base)
+
+
+class TestRouting:
+    def test_load_balancing_loss_uniform_is_minimal(self):
+        B, S, E = 2, 16, 4
+        uniform = jnp.full((B, S, E), 1.0 / E)
+        idx = jnp.tile(jnp.arange(S) % E, (B, 1))
+        lb_uniform = load_balancing_loss(uniform, idx)
+        # skewed: all mass and all assignments on expert 0
+        skew = jnp.zeros((B, S, E)).at[..., 0].set(1.0)
+        idx0 = jnp.zeros((B, S), jnp.int32)
+        lb_skew = load_balancing_loss(skew, idx0)
+        assert float(lb_uniform) == pytest.approx(1.0, abs=1e-5)
+        assert float(lb_skew) == pytest.approx(E, abs=1e-4)
+        assert float(lb_skew) > float(lb_uniform)
+
+    def test_moe_layer_shapes_and_aux(self):
+        layer = MoEMLP(num_experts=4, mlp_dim=64, top_k=2)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 32), jnp.float32)
+        variables = layer.init(jax.random.PRNGKey(1), x)
+        y, mods = layer.apply(variables, x, mutable=["losses"])
+        assert y.shape == x.shape
+        aux = jax.tree.leaves(mods["losses"])
+        assert len(aux) == 1 and aux[0].shape == ()
+        assert float(aux[0]) > 0
+
+    def test_expert_params_have_leading_expert_dim(self):
+        layer = MoEMLP(num_experts=4, mlp_dim=64)
+        x = jnp.zeros((1, 8, 32))
+        variables = layer.init(jax.random.PRNGKey(0), x)
+        assert variables["params"]["wi"].shape == (4, 32, 64)
+        assert variables["params"]["wo"].shape == (4, 64, 32)
+
+    def test_zero_capacity_overflow_drops_tokens(self):
+        # capacity factor so tiny every expert takes ~1 token; output must
+        # stay finite and dropped tokens contribute zero (not NaN)
+        layer = MoEMLP(num_experts=2, mlp_dim=16, top_k=1,
+                       capacity_factor=0.01)
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 16, 8), jnp.float32)
+        variables = layer.init(jax.random.PRNGKey(1), x)
+        y = layer.apply(variables, x, mutable=["losses"])[0]
+        assert np.isfinite(np.asarray(y, jnp.float32)).all()
+        # with capacity 1 per expert, at most 2 token rows are nonzero
+        nonzero_rows = int(jnp.sum(jnp.any(jnp.abs(y) > 0, axis=-1)))
+        assert nonzero_rows <= 2
+
+    def test_combine_weights_renormalized(self):
+        # top-2 gating with ample capacity: per-token combine weights sum
+        # to 1, so the layer is a convex mix of expert outputs
+        layer = MoEMLP(num_experts=4, mlp_dim=16, top_k=2,
+                       capacity_factor=4.0)
+        x = jax.random.normal(jax.random.PRNGKey(2), (2, 8, 8), jnp.float32)
+        variables = layer.init(jax.random.PRNGKey(1), x)
+
+        # identity experts: wi = [E, M, H] zeros→gelu(0)=0 makes output 0;
+        # instead probe via the dispatch/combine tensors through a linear
+        # expert: set wo so expert e outputs constant e... simpler: check
+        # output invariance when all experts share identical weights
+        p = variables["params"]
+        wi0 = p["wi"][0]
+        wo0 = p["wo"][0]
+        shared = {"params": {**p,
+                             "wi": jnp.stack([wi0] * 4),
+                             "wo": jnp.stack([wo0] * 4)}}
+        y_shared = layer.apply(shared, x, mutable=["losses"])[0]
+        dense = jnp.einsum("bsm,mh->bsh", x.astype(jnp.bfloat16),
+                           wi0.astype(jnp.bfloat16))
+        import flax.linen as nn
+        dense = jnp.einsum("bsh,hm->bsm", nn.gelu(dense),
+                           wo0.astype(jnp.bfloat16))
+        np.testing.assert_allclose(np.asarray(y_shared, jnp.float32),
+                                   np.asarray(dense, jnp.float32),
+                                   atol=0.15, rtol=0.15)
+
+
+class TestMoETransformer:
+    def test_forward_and_loss(self):
+        cfg = tiny_moe_cfg()
+        model = T.TransformerLM(cfg)
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        variables = jax.jit(model.init)(jax.random.PRNGKey(0), tokens)
+        loss_fn = T.make_loss_fn(model)
+        loss, metrics = loss_fn(variables["params"], {},
+                                {"tokens": tokens}, None)
+        assert jnp.isfinite(loss)
+        assert "moe_aux_loss" in metrics
+        assert float(metrics["moe_aux_loss"]) > 0
+
+    def test_logical_axes_cover_expert_params(self):
+        cfg = tiny_moe_cfg()
+        model = T.TransformerLM(cfg)
+        abstract = jax.eval_shape(
+            lambda rng: T.init_fn(model, 16)(rng)[0], jax.random.PRNGKey(0))
+        axes = T.logical_axes(abstract)
+        layer0 = axes["layer0"]["moe"]
+        assert layer0["wi"] == ("expert", "embed", "mlp")
+        assert layer0["wo"] == ("expert", "mlp", "embed")
+        assert layer0["router"] == ("embed", None)
+
+    def test_train_step_with_expert_axis_sharding(self):
+        # dp=2 x expert=2 x tensor=2 over the 8-device mesh: the EP path
+        # end-to-end through the real TrainStepBuilder
+        sharding = ShardingSpec(data=2, fsdp=1, expert=2, tensor=2)
+        mesh = build_mesh(sharding, jax.devices()[:8])
+        cfg = tiny_moe_cfg()
+        spec = T.workload_spec(cfg=cfg, seq_len=32)
+        builder = TrainStepBuilder(
+            mesh=mesh, loss_fn=spec.loss_fn,
+            optimizer=optax.adamw(1e-2), rules=spec.rules,
+            param_logical_axes=spec.param_logical_axes)
+        state = builder.init(spec.init_fn, jax.random.PRNGKey(0))
+
+        # expert weights actually sharded over the expert mesh axis
+        wi = state.params["layer0"]["moe"]["wi"]
+        specs = wi.sharding.spec
+        assert "expert" in str(specs), specs
+
+        step_fn = builder.build()
+        batch = builder.place_batch(spec.batch_fn(jax.random.PRNGKey(1), 8))
+        losses = []
+        for _ in range(5):
+            state, metrics = step_fn(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0], losses
+        assert all(np.isfinite(losses))

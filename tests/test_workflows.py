@@ -54,13 +54,6 @@ def env():
     return cluster, mgr
 
 
-def drive(cluster, mgr, rounds=8):
-    for _ in range(rounds):
-        mgr.run_pending()
-        cluster.tick()
-    mgr.run_pending()
-
-
 def get_wf(cluster, name="wf"):
     return cluster.get(WORKFLOW_API_VERSION, "Workflow", "kubeflow", name)
 
@@ -297,6 +290,25 @@ class TestKubebench:
     def test_csv_report_empty_rejected(self, tmp_path):
         with pytest.raises(ValueError):
             write_csv_report(str(tmp_path / "r.csv"), [])
+
+    def test_pvc_shared_volume_wiring(self):
+        wf = build_kubebench_workflow(
+            "b", "kubeflow",
+            {"kind": "TPUJob", "metadata": {"name": "b-job"},
+             "spec": {"replicaSpecs": {"TPU": {"template": {"spec": {
+                 "containers": [{"name": "t", "image": "i"}]}}}}}},
+            pvc="kubebench-pvc")
+        assert wf["spec"]["volumes"][0]["persistentVolumeClaim"][
+            "claimName"] == "kubebench-pvc"
+        for tmpl in wf["spec"]["templates"]:
+            if "container" in tmpl:
+                assert tmpl["container"]["volumeMounts"][0][
+                    "mountPath"] == "/kubebench"
+        pod_spec = wf["spec"]["templates"][2]["resource"]["manifest"][
+            "spec"]["replicaSpecs"]["TPU"]["template"]["spec"]
+        assert pod_spec["volumes"][0]["name"] == "kubebench"
+        assert pod_spec["containers"][0]["volumeMounts"][0][
+            "mountPath"] == "/kubebench"
 
     def test_job_env_injection(self):
         wf = build_kubebench_workflow(

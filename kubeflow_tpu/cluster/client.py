@@ -113,13 +113,22 @@ class KubeClient:
             return None
 
     def apply(self, obj: dict) -> dict:
-        """Create-or-update (kubectl apply semantics, spec-level replace)."""
+        """Create-or-update (kubectl apply semantics, spec-level replace).
+
+        No-op when nothing changes: reconcilers apply their children every
+        pass while watching those same kinds, so an unconditional update
+        (which bumps resourceVersion and broadcasts MODIFIED) would
+        re-enqueue the owner forever.
+        """
+        import json
+
         from ..api import k8s
         existing = self.get_or_none(*k8s.key_of(obj))
         if existing is None:
             return self.create(obj)
         merged = dict(existing)
-        for key in ("spec", "data", "stringData", "rules", "webhooks", "subsets"):
+        for key in ("spec", "data", "stringData", "rules", "webhooks",
+                    "subsets", "roleRef", "subjects"):
             if key in obj:
                 merged[key] = obj[key]
         meta = dict(existing.get("metadata", {}))
@@ -127,6 +136,9 @@ class KubeClient:
             if obj.get("metadata", {}).get(key):
                 meta[key] = obj["metadata"][key]
         merged["metadata"] = meta
+        if json.dumps(merged, sort_keys=True, default=str) == \
+                json.dumps(existing, sort_keys=True, default=str):
+            return existing
         return self.update(merged)
 
     def delete_many(self, objs: Iterable[dict]) -> None:

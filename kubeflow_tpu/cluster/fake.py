@@ -63,6 +63,10 @@ class FakeCluster(KubeClient):
         self.auto_run = auto_run
         # hook for tests: called with each pod when it starts Running
         self.on_pod_running: Optional[Callable[[dict], None]] = None
+        # mutating admission hooks (obj -> obj), run on create before
+        # persistence — the MutatingWebhookConfiguration analog
+        # (controllers/admission.py PodDefaultsWebhook plugs in here)
+        self.admission_hooks: list[Callable[[dict], dict]] = []
 
     # ------------------------------------------------------------- snapshot
 
@@ -102,6 +106,8 @@ class FakeCluster(KubeClient):
     def create(self, obj: dict) -> dict:
         with self._lock:
             obj = copy.deepcopy(obj)
+            for hook in self.admission_hooks:
+                obj = hook(obj)
             key = self._key(obj)
             if not key[3]:
                 raise ValueError(f"object has no name: {obj}")

@@ -4,7 +4,41 @@
   controller-runtime analog every reconciler plugs into.
 - ``tpujob``: the training-job operator — gang-scheduled TPU slices,
   topology-contract injection, slice-level failure handling.
+- ``statefulset``: minimal built-in STS → pods reconciler (the
+  kube-controller-manager piece the in-memory control plane needs).
 - ``notebook``: Notebook CR → StatefulSet + Service + VirtualService.
 - ``profile``: Profile CR → Namespace + ServiceAccounts + RoleBindings.
 - ``admission``: PodDefault mutating-webhook logic.
 """
+
+from typing import Optional
+
+
+def build_manager(client, vizier=None, vizier_url: Optional[str] = None):
+    """Assemble the full control plane over one client: training operators
+    (all four job kinds), workflows, kubebench, katib, notebooks, profiles,
+    statefulsets — plus the PodDefault admission hook when the client
+    exposes an admission point (FakeCluster does; a real apiserver gets the
+    webhook via manifests instead)."""
+    from ..katib.studyjob import StudyJobReconciler
+    from ..workflows.engine import WorkflowReconciler
+    from ..workflows.kubebench import KubebenchJobReconciler
+    from .admission import PodDefaultsWebhook
+    from .notebook import NotebookReconciler
+    from .profile import ProfileReconciler
+    from .runtime import Manager
+    from .statefulset import StatefulSetReconciler
+    from .tpujob import all_reconcilers
+
+    mgr = Manager(client)
+    for r in all_reconcilers():
+        mgr.add(r)
+    mgr.add(StatefulSetReconciler())
+    mgr.add(NotebookReconciler())
+    mgr.add(ProfileReconciler())
+    mgr.add(WorkflowReconciler())
+    mgr.add(KubebenchJobReconciler())
+    mgr.add(StudyJobReconciler(vizier=vizier, vizier_url=vizier_url))
+    if hasattr(client, "admission_hooks"):
+        client.admission_hooks.append(PodDefaultsWebhook(client))
+    return mgr

@@ -1,0 +1,143 @@
+"""PodDefault mutating admission: inject env/volumes/mounts into pods.
+
+The reference's admission-webhook (components/admission-webhook/main.go:
+filterPodDefaults :69, conflict checks :96-131, merge :278-316) is a
+mutating webhook server; here the same logic is a pure function applied at
+the apiserver admission point (cluster/fake.py admission hooks — the
+in-memory analog of a MutatingWebhookConfiguration), so controllers and
+tests exercise identical semantics.
+
+PodDefault CR (poddefault_types.go): spec.selector (label selector),
+spec.{env, envFrom, volumeMounts, volumes, annotations, labels,
+serviceAccountName}.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..api import k8s
+from ..cluster.client import KubeClient
+
+log = logging.getLogger(__name__)
+
+PODDEFAULT_API_VERSION = "kubeflow.org/v1alpha1"
+PODDEFAULT_KIND = "PodDefault"
+APPLIED_ANNOTATION_PREFIX = "poddefault.admission.kubeflow.org/poddefault-"
+
+
+class PodDefaultConflict(Exception):
+    """Two selected PodDefaults disagree (same env/volume name, different
+    value) — the reference rejects the pod rather than guess (main.go:96)."""
+
+
+def select_pod_defaults(pod: dict, defaults: list[dict]) -> list[dict]:
+    labels = k8s.labels_of(pod)
+    out = []
+    for pd in defaults:
+        selector = k8s.selector_from(
+            pd.get("spec", {}).get("selector"))
+        # k8s LabelSelector convention: empty selector matches everything
+        if all(labels.get(k) == v for k, v in selector.items()):
+            out.append(pd)
+    return sorted(out, key=k8s.name_of)
+
+
+def check_conflicts(defaults: list[dict]) -> None:
+    env_seen: dict[str, str] = {}
+    vol_seen: dict[str, dict] = {}
+    mount_seen: dict[str, str] = {}
+    for pd in defaults:
+        spec = pd.get("spec", {})
+        for e in spec.get("env", []) or []:
+            name, value = e.get("name"), e.get("value")
+            if name in env_seen and env_seen[name] != value:
+                raise PodDefaultConflict(
+                    f"env {name}: {env_seen[name]!r} vs {value!r} "
+                    f"(poddefault {k8s.name_of(pd)})")
+            env_seen[name] = value
+        for v in spec.get("volumes", []) or []:
+            name = v.get("name")
+            if name in vol_seen and vol_seen[name] != v:
+                raise PodDefaultConflict(
+                    f"volume {name} defined differently by multiple "
+                    f"poddefaults (poddefault {k8s.name_of(pd)})")
+            vol_seen[name] = v
+        for m in spec.get("volumeMounts", []) or []:
+            name, path = m.get("name"), m.get("mountPath")
+            if name in mount_seen and mount_seen[name] != path:
+                raise PodDefaultConflict(
+                    f"volumeMount {name}: {mount_seen[name]!r} vs {path!r} "
+                    f"(poddefault {k8s.name_of(pd)})")
+            mount_seen[name] = path
+
+
+def apply_pod_defaults(pod: dict, defaults: list[dict]) -> dict:
+    """Merge selected PodDefaults into the pod (idempotent: existing names
+    win, the reference's merge semantics main.go:278-316)."""
+    if not defaults:
+        return pod
+    check_conflicts(defaults)
+    spec = pod.setdefault("spec", {})
+    containers = spec.get("containers", []) or []
+    for pd in defaults:
+        pspec = pd.get("spec", {})
+        for v in pspec.get("volumes", []) or []:
+            vols = spec.setdefault("volumes", [])
+            if not any(x.get("name") == v.get("name") for x in vols):
+                vols.append(dict(v))
+        if pspec.get("serviceAccountName") and \
+                not spec.get("serviceAccountName"):
+            spec["serviceAccountName"] = pspec["serviceAccountName"]
+        for c in containers:
+            for e in pspec.get("env", []) or []:
+                env = c.setdefault("env", [])
+                if not any(x.get("name") == e.get("name") for x in env):
+                    env.append(dict(e))
+            for ef in pspec.get("envFrom", []) or []:
+                envfrom = c.setdefault("envFrom", [])
+                if ef not in envfrom:
+                    envfrom.append(dict(ef))
+            for m in pspec.get("volumeMounts", []) or []:
+                mounts = c.setdefault("volumeMounts", [])
+                if not any(x.get("name") == m.get("name") for x in mounts):
+                    mounts.append(dict(m))
+        meta = pod.setdefault("metadata", {})
+        anns = meta.setdefault("annotations", {})
+        for ak, av in (pspec.get("annotations") or {}).items():
+            anns.setdefault(ak, av)
+        labels = meta.setdefault("labels", {})
+        for lk, lv in (pspec.get("labels") or {}).items():
+            labels.setdefault(lk, lv)
+        anns[APPLIED_ANNOTATION_PREFIX + k8s.name_of(pd)] = \
+            pd.get("metadata", {}).get("resourceVersion", "0")
+    return pod
+
+
+class PodDefaultsWebhook:
+    """Admission hook: install with
+    ``cluster.admission_hooks.append(PodDefaultsWebhook(cluster))``.
+
+    On conflict the pod is admitted UNMUTATED with a warning — matching the
+    reference webhook's failurePolicy choice of not blocking pod creation.
+    """
+
+    def __init__(self, client: KubeClient):
+        self.client = client
+
+    def __call__(self, obj: dict) -> dict:
+        if obj.get("kind") != "Pod":
+            return obj
+        ns = k8s.namespace_of(obj, "default")
+        defaults = self.client.list(PODDEFAULT_API_VERSION, PODDEFAULT_KIND,
+                                    ns)
+        selected = select_pod_defaults(obj, defaults)
+        if not selected:
+            return obj
+        try:
+            return apply_pod_defaults(obj, selected)
+        except PodDefaultConflict as e:
+            log.warning("poddefault conflict for pod %s/%s: %s — admitting "
+                        "unmutated", ns, k8s.name_of(obj), e)
+            return obj

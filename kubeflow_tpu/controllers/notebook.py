@@ -1,0 +1,143 @@
+"""Notebook controller: Notebook CR → StatefulSet + Service + VirtualService.
+
+The reference's notebook-controller (components/notebook-controller/
+pkg/controller/notebook/notebook_controller.go: watch wiring :57-144,
+Reconcile :163, generateStatefulSet :313, generateService :367,
+generateVirtualService :414). The CR spec wraps a full PodSpec in a
+template (notebook_types.go:28-35 — SURVEY.md §2.6 "CR wraps PodSpec"),
+and status is condition-based.
+
+TPU-native addition: a notebook whose template requests ``google.com/tpu``
+gets the TPU node selector injected, so interactive development on a
+single-host slice works the same way training pods do.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+
+from ..api import k8s
+from ..cluster.client import KubeClient, NotFoundError
+from .runtime import Key, Reconciler, Result, status_snapshot
+
+log = logging.getLogger(__name__)
+
+NOTEBOOK_API_VERSION = "kubeflow.org/v1alpha1"
+NOTEBOOK_KIND = "Notebook"
+NOTEBOOK_PORT = 8888
+NOTEBOOK_NAME_LABEL = "notebook-name"
+TPU_RESOURCE = "google.com/tpu"
+TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+
+
+def _wants_tpu(pod_spec: dict) -> bool:
+    for c in pod_spec.get("containers", []) or []:
+        res = c.get("resources", {}) or {}
+        for bucket in ("requests", "limits"):
+            if TPU_RESOURCE in (res.get(bucket) or {}):
+                return True
+    return False
+
+
+class NotebookReconciler(Reconciler):
+    primary = (NOTEBOOK_API_VERSION, NOTEBOOK_KIND)
+    # pod state arrives transitively: pod events → STS reconciler updates
+    # STS status → STS MODIFIED maps here (pods carry only the STS owner
+    # ref, so watching pods directly would never map to a Notebook key)
+    owns = [("apps/v1", "StatefulSet"), ("v1", "Service"),
+            ("networking.istio.io/v1alpha3", "VirtualService")]
+
+    def reconcile(self, client: KubeClient, key: Key) -> Result:
+        ns, name = key
+        try:
+            nb = client.get(NOTEBOOK_API_VERSION, NOTEBOOK_KIND, ns, name)
+        except NotFoundError:
+            return Result()  # cascade GC reaps children
+
+        client.apply(self._statefulset(nb))
+        client.apply(self._service(nb))
+        client.apply(self._virtual_service(nb))
+
+        # condition-based status from the notebook pod, the reference's
+        # containerState mirroring (notebook_controller.go pod watch)
+        pod = client.get_or_none("v1", "Pod", ns, f"{name}-0")
+        phase = (pod or {}).get("status", {}).get("phase", "Waiting")
+        status = dict(nb.get("status", {}))
+        before = status_snapshot(status)
+        status["readyReplicas"] = 1 if phase == "Running" else 0
+        status["containerState"] = {"Running": {"running": {}},
+                                    "Pending": {"waiting": {}},
+                                    "Failed": {"terminated": {}}}.get(
+                                        phase, {"waiting": {}})
+        if status_snapshot(status) != before:
+            fresh = client.get(NOTEBOOK_API_VERSION, NOTEBOOK_KIND, ns, name)
+            fresh["status"] = status
+            k8s.set_condition(
+                fresh, k8s.Condition("Ready",
+                                     "True" if phase == "Running" else "False",
+                                     phase, f"notebook pod is {phase}"))
+            client.update_status(fresh)
+        return Result()
+
+    # -- children ------------------------------------------------------------
+
+    def _statefulset(self, nb: dict) -> dict:
+        ns, name = k8s.namespace_of(nb, "default"), k8s.name_of(nb)
+        template = copy.deepcopy(
+            nb.get("spec", {}).get("template", {}) or {})
+        pod_spec = template.setdefault("spec", {})
+        pod_spec.setdefault("securityContext", {"fsGroup": 100})
+        if _wants_tpu(pod_spec):
+            sel = pod_spec.setdefault("nodeSelector", {})
+            sel.setdefault(TPU_ACCELERATOR_LABEL, "tpu-v5e")
+        labels = template.setdefault("metadata", {}).setdefault("labels", {})
+        labels.update({"app": name, NOTEBOOK_NAME_LABEL: name})
+        sts = {
+            "apiVersion": "apps/v1", "kind": "StatefulSet",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "replicas": 1,
+                "serviceName": name,
+                "selector": {"matchLabels": {NOTEBOOK_NAME_LABEL: name}},
+                "template": template,
+            },
+        }
+        k8s.set_owner(sts, nb)
+        return sts
+
+    def _service(self, nb: dict) -> dict:
+        ns, name = k8s.namespace_of(nb, "default"), k8s.name_of(nb)
+        svc = {
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "selector": {NOTEBOOK_NAME_LABEL: name},
+                "ports": [{"name": "http", "port": 80,
+                           "targetPort": NOTEBOOK_PORT}],
+            },
+        }
+        k8s.set_owner(svc, nb)
+        return svc
+
+    def _virtual_service(self, nb: dict) -> dict:
+        ns, name = k8s.namespace_of(nb, "default"), k8s.name_of(nb)
+        prefix = f"/notebook/{ns}/{name}/"
+        vs = {
+            "apiVersion": "networking.istio.io/v1alpha3",
+            "kind": "VirtualService",
+            "metadata": {"name": f"notebook-{name}", "namespace": ns},
+            "spec": {
+                "gateways": ["kubeflow/kubeflow-gateway"],
+                "hosts": ["*"],
+                "http": [{
+                    "match": [{"uri": {"prefix": prefix}}],
+                    "rewrite": {"uri": "/"},
+                    "route": [{"destination": {
+                        "host": f"{name}.{ns}.svc.cluster.local",
+                        "port": {"number": 80}}}],
+                }],
+            },
+        }
+        k8s.set_owner(vs, nb)
+        return vs

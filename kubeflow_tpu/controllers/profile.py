@@ -1,0 +1,104 @@
+"""Profile controller: multi-tenancy namespaces.
+
+The reference's profile-controller reconciles a Profile CR into a Namespace
++ ``default-editor``/``default-viewer`` ServiceAccounts + an owner
+RoleBinding (components/profile-controller/pkg/controller/profile/
+profile_controller.go:109-196, updateServiceAccount :204-209); the
+access-management swagger (SURVEY.md §2.6) defines Profile = owner +
+namespace. ResourceQuota support mirrors the metacontroller sync hook
+(kubeflow/profiles/sync-profile.jsonnet:1-40).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..api import k8s
+from ..cluster.client import KubeClient, NotFoundError
+from .runtime import Key, Reconciler, Result
+
+log = logging.getLogger(__name__)
+
+PROFILE_API_VERSION = "kubeflow.org/v1alpha1"
+PROFILE_KIND = "Profile"
+EDITOR_SA = "default-editor"
+VIEWER_SA = "default-viewer"
+OWNER_ANNOTATION = "owner"
+
+
+class ProfileReconciler(Reconciler):
+    primary = (PROFILE_API_VERSION, PROFILE_KIND)
+    owns = [("v1", "Namespace"), ("v1", "ServiceAccount"),
+            ("rbac.authorization.k8s.io/v1", "RoleBinding"),
+            ("v1", "ResourceQuota")]
+
+    def reconcile(self, client: KubeClient, key: Key) -> Result:
+        _, name = key
+        try:
+            profile = client.get(PROFILE_API_VERSION, PROFILE_KIND,
+                                 key[0] or "default", name)
+        except NotFoundError:
+            return Result()
+        spec = profile.get("spec", {})
+        owner = (spec.get("owner") or {}).get("name", "")
+
+        namespace = {
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {
+                "name": name,
+                "labels": {"katib-metricscollector-injection": "enabled",
+                           "serving.kubeflow.org/inferenceservice": "enabled",
+                           "profile": name},
+                "annotations": {OWNER_ANNOTATION: owner},
+            },
+        }
+        k8s.set_owner(namespace, profile)
+        client.apply(namespace)
+
+        for sa in (EDITOR_SA, VIEWER_SA):
+            obj = {"apiVersion": "v1", "kind": "ServiceAccount",
+                   "metadata": {"name": sa, "namespace": name}}
+            k8s.set_owner(obj, profile)
+            client.apply(obj)
+
+        bindings = [
+            # the profile owner administers the namespace
+            ("namespaceAdmin", "ClusterRole", "kubeflow-admin",
+             [{"kind": (spec.get("owner") or {}).get("kind", "User"),
+               "name": owner}]),
+            ("default-editor", "ClusterRole", "kubeflow-edit",
+             [{"kind": "ServiceAccount", "name": EDITOR_SA,
+               "namespace": name}]),
+            ("default-viewer", "ClusterRole", "kubeflow-view",
+             [{"kind": "ServiceAccount", "name": VIEWER_SA,
+               "namespace": name}]),
+        ]
+        for bname, role_kind, role, subjects in bindings:
+            rb = {
+                "apiVersion": "rbac.authorization.k8s.io/v1",
+                "kind": "RoleBinding",
+                "metadata": {"name": bname, "namespace": name},
+                "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                            "kind": role_kind, "name": role},
+                "subjects": subjects,
+            }
+            k8s.set_owner(rb, profile)
+            client.apply(rb)
+
+        if spec.get("resourceQuotaSpec"):
+            quota = {
+                "apiVersion": "v1", "kind": "ResourceQuota",
+                "metadata": {"name": "kf-resource-quota", "namespace": name},
+                "spec": spec["resourceQuotaSpec"],
+            }
+            k8s.set_owner(quota, profile)
+            client.apply(quota)
+
+        if not k8s.condition_true(profile, "Ready"):
+            fresh = client.get(PROFILE_API_VERSION, PROFILE_KIND,
+                               key[0] or "default", name)
+            k8s.set_condition(fresh, k8s.Condition(
+                "Ready", "True", "ProfileProvisioned",
+                f"namespace {name} provisioned for {owner}"))
+            client.update_status(fresh)
+        return Result()

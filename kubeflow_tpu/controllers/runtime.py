@@ -221,9 +221,14 @@ class Manager:
 
     def run_pending(self, rounds: int = 10) -> None:
         """Drain all controllers to quiescence (test/deterministic mode).
-        Multiple rounds because one controller's writes enqueue another's."""
+        Multiple rounds because one controller's writes enqueue another's.
+        Every controller must drain every round — any() would short-circuit
+        at the first busy controller and starve the rest."""
         for _ in range(rounds):
-            if not any(c.run_pending() for c in self.controllers):
+            done = 0
+            for c in self.controllers:
+                done += c.run_pending()
+            if not done:
                 break
 
     def start_all(self) -> list[threading.Thread]:

@@ -1,0 +1,76 @@
+"""Minimal StatefulSet reconciler (the kube-controller-manager analog).
+
+Real clusters run notebooks as StatefulSets (notebook-controller emits STS,
+notebook_controller.go:313) and rely on the built-in statefulset controller
+to create the pods. Our in-memory control plane (cluster/fake.py) models
+only the apiserver + scheduler, so this reconciler supplies the built-in:
+ordinal pods ``<sts>-0..replicas-1`` from the pod template, owner-ref'd for
+cascade GC, status.readyReplicas from pod phases.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+
+from ..api import k8s
+from ..cluster.client import KubeClient, NotFoundError
+from .runtime import Key, Reconciler, Result, status_snapshot
+
+log = logging.getLogger(__name__)
+
+
+class StatefulSetReconciler(Reconciler):
+    primary = ("apps/v1", "StatefulSet")
+    owns = [("v1", "Pod")]
+
+    def reconcile(self, client: KubeClient, key: Key) -> Result:
+        ns, name = key
+        try:
+            sts = client.get("apps/v1", "StatefulSet", ns, name)
+        except NotFoundError:
+            return Result()
+        spec = sts.get("spec", {})
+        replicas = int(spec.get("replicas", 1))
+        template = spec.get("template", {}) or {}
+        selector = k8s.selector_from(spec.get("selector"))
+
+        pods = [p for p in client.list("v1", "Pod", ns)
+                if k8s.is_owned_by(p, sts)]
+        by_name = {k8s.name_of(p): p for p in pods}
+
+        for i in range(replicas):
+            pod_name = f"{name}-{i}"
+            if pod_name in by_name:
+                continue
+            pod = {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {
+                    "name": pod_name, "namespace": ns,
+                    "labels": {**(template.get("metadata", {})
+                                  .get("labels") or {}), **selector,
+                               "statefulset.kubernetes.io/pod-name": pod_name},
+                },
+                "spec": copy.deepcopy(template.get("spec", {})),
+            }
+            k8s.set_owner(pod, sts)
+            client.create(pod)
+        # scale down: remove highest ordinals first (STS semantics)
+        for pod_name in sorted(by_name):
+            try:
+                ordinal = int(pod_name.rsplit("-", 1)[1])
+            except (IndexError, ValueError):
+                continue
+            if ordinal >= replicas:
+                client.delete("v1", "Pod", ns, pod_name)
+
+        ready = sum(1 for p in pods
+                    if p.get("status", {}).get("phase") == "Running")
+        status = dict(sts.get("status", {}))
+        before = status_snapshot(status)
+        status.update({"replicas": replicas, "readyReplicas": ready})
+        if status_snapshot(status) != before:
+            fresh = client.get("apps/v1", "StatefulSet", ns, name)
+            fresh["status"] = status
+            client.update_status(fresh)
+        return Result()

@@ -1,0 +1,6 @@
+"""User-facing web services (the reference's L4 layer, SURVEY.md §1).
+
+- ``gatekeeper``: basic-auth session server (components/gatekeeper).
+- ``dashboard``: central dashboard API (components/centraldashboard).
+- ``jupyter``: notebook CRUD web API (components/jupyter-web-app).
+"""

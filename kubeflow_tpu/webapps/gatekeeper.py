@@ -1,0 +1,197 @@
+"""Gatekeeper: basic-auth gate with cookie sessions.
+
+The reference's Go auth server (components/gatekeeper/auth/AuthServer.go:
+36-153, main.go:42): credentials from env (KUBEFLOW_USERNAME /
+KUBEFLOW_PASSWORD, apps/group.go:58-59), an in-memory cookie session table
+with 12h expiry, and an ext-authz style check endpoint the ingress calls
+per request (ambassador auth service wiring,
+kubeflow/common/ambassador.libsonnet).
+
+Routes:
+  POST /login        (form or basic auth) → sets session cookie
+  GET  /auth         → 200 if cookie/basic valid else 401 (ext-authz check)
+  GET  /logout       → clears session
+  GET  /healthz
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import secrets
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+USERNAME_ENV = "KUBEFLOW_USERNAME"
+PASSWORD_ENV = "KUBEFLOW_PASSWORD"
+COOKIE_NAME = "kubeflow-session"
+SESSION_TTL_S = 12 * 3600  # 12h, AuthServer.go expiry
+
+
+class SessionStore:
+    def __init__(self, ttl_s: float = SESSION_TTL_S, clock=time.time):
+        self.ttl_s = ttl_s
+        self.clock = clock
+        self._sessions: dict[str, float] = {}  # token -> expiry
+        self._lock = threading.Lock()
+
+    def create(self) -> str:
+        token = secrets.token_urlsafe(32)
+        with self._lock:
+            self._sessions[token] = self.clock() + self.ttl_s
+        return token
+
+    def valid(self, token: Optional[str]) -> bool:
+        if not token:
+            return False
+        with self._lock:
+            expiry = self._sessions.get(token)
+            if expiry is None:
+                return False
+            if self.clock() > expiry:
+                del self._sessions[token]
+                return False
+            return True
+
+    def revoke(self, token: Optional[str]) -> None:
+        with self._lock:
+            self._sessions.pop(token or "", None)
+
+    def sweep(self) -> int:
+        """Drop expired sessions; returns the number removed."""
+        now = self.clock()
+        with self._lock:
+            dead = [t for t, exp in self._sessions.items() if now > exp]
+            for t in dead:
+                del self._sessions[t]
+            return len(dead)
+
+
+class Gatekeeper:
+    def __init__(self, username: Optional[str] = None,
+                 password: Optional[str] = None,
+                 ttl_s: float = SESSION_TTL_S, clock=time.time):
+        self.username = username if username is not None else \
+            os.environ.get(USERNAME_ENV, "admin")
+        # store only the digest, compare in constant time; empty/unset
+        # password FAILS CLOSED — an auth gate with no credentials
+        # configured must reject everything, not admit everything
+        pw = password if password is not None else \
+            os.environ.get(PASSWORD_ENV, "")
+        self._enabled = bool(pw)
+        self._pw_digest = hashlib.sha256(pw.encode()).digest()
+        self.sessions = SessionStore(ttl_s=ttl_s, clock=clock)
+
+    def check_credentials(self, username: str, password: str) -> bool:
+        if not self._enabled:
+            return False
+        digest = hashlib.sha256(password.encode()).digest()
+        return hmac.compare_digest(digest, self._pw_digest) and \
+            hmac.compare_digest(username.encode(), self.username.encode())
+
+    def check_basic_header(self, header: Optional[str]) -> bool:
+        if not header or not header.startswith("Basic "):
+            return False
+        try:
+            decoded = base64.b64decode(header[6:]).decode()
+            username, _, password = decoded.partition(":")
+        except Exception:  # noqa: BLE001 - malformed header is just a 401
+            return False
+        return self.check_credentials(username, password)
+
+    def login(self, username: str, password: str) -> Optional[str]:
+        if not self.check_credentials(username, password):
+            return None
+        return self.sessions.create()
+
+    def authorized(self, cookie_token: Optional[str],
+                   basic_header: Optional[str] = None) -> bool:
+        return self.sessions.valid(cookie_token) or \
+            self.check_basic_header(basic_header)
+
+
+class GatekeeperServer:
+    def __init__(self, gatekeeper: Optional[Gatekeeper] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.gate = gatekeeper or Gatekeeper()
+        self._httpd = ThreadingHTTPServer((host, port),
+                                          _make_handler(self.gate))
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="gatekeeper")
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _cookie_token(handler: BaseHTTPRequestHandler) -> Optional[str]:
+    raw = handler.headers.get("Cookie", "")
+    for part in raw.split(";"):
+        name, _, value = part.strip().partition("=")
+        if name == COOKIE_NAME:
+            return value
+    return None
+
+
+def _make_handler(gate: Gatekeeper):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, code: int, body: bytes = b"",
+                  headers: Optional[dict] = None):
+            self.send_response(code)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                return self._send(200, b"ok")
+            if self.path.startswith("/auth"):
+                if gate.authorized(_cookie_token(self),
+                                   self.headers.get("Authorization")):
+                    return self._send(200)
+                return self._send(401, b"unauthorized",
+                                  {"WWW-Authenticate": "Basic"})
+            if self.path.startswith("/logout"):
+                gate.sessions.revoke(_cookie_token(self))
+                return self._send(
+                    200, b"logged out",
+                    {"Set-Cookie": f"{COOKIE_NAME}=; Max-Age=0"})
+            return self._send(404)
+
+        def do_POST(self):
+            if self.path != "/login":
+                return self._send(404)
+            length = int(self.headers.get("Content-Length", 0))
+            form = urllib.parse.parse_qs(
+                self.rfile.read(length).decode() if length else "")
+            username = (form.get("username") or [""])[0]
+            password = (form.get("password") or [""])[0]
+            if not username and \
+                    gate.check_basic_header(self.headers.get("Authorization")):
+                token = gate.sessions.create()
+            else:
+                token = gate.login(username, password)
+            if token is None:
+                return self._send(401, b"bad credentials")
+            return self._send(
+                200, b"ok",
+                {"Set-Cookie": f"{COOKIE_NAME}={token}; HttpOnly; "
+                               f"Path=/; Max-Age={int(gate.sessions.ttl_s)}"})
+
+    return Handler

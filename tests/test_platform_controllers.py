@@ -1,0 +1,326 @@
+"""Platform controllers: notebook, profile, admission webhook, gatekeeper.
+
+Envtest-style coverage mirroring the reference's controller tests
+(profile_controller_test.go reconcile-assertion pattern, SURVEY.md §4
+tier 2; admission-webhook merge/conflict logic main.go:69-316;
+gatekeeper session table AuthServer.go:36-153).
+"""
+
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.api import k8s
+from kubeflow_tpu.cluster import FakeCluster
+from kubeflow_tpu.controllers.admission import (PodDefaultConflict,
+                                                PodDefaultsWebhook,
+                                                apply_pod_defaults,
+                                                select_pod_defaults)
+from kubeflow_tpu.controllers.notebook import NotebookReconciler
+from kubeflow_tpu.controllers.profile import ProfileReconciler
+from kubeflow_tpu.controllers.runtime import Manager
+from kubeflow_tpu.controllers.statefulset import StatefulSetReconciler
+from kubeflow_tpu.webapps.gatekeeper import (Gatekeeper, GatekeeperServer,
+                                             SessionStore)
+
+
+@pytest.fixture
+def env():
+    cluster = FakeCluster()
+    cluster.add_node("cpu-0", {"cpu": 96, "memory": 2 ** 36})
+    mgr = Manager(cluster)
+    mgr.add(StatefulSetReconciler())
+    mgr.add(NotebookReconciler())
+    mgr.add(ProfileReconciler())
+    return cluster, mgr
+
+
+def notebook_manifest(name="nb", image="jupyter:latest", **resources):
+    container = {"name": "notebook", "image": image}
+    if resources:
+        container["resources"] = resources
+    return {
+        "apiVersion": "kubeflow.org/v1alpha1", "kind": "Notebook",
+        "metadata": {"name": name, "namespace": "alice"},
+        "spec": {"template": {"spec": {"containers": [container]}}},
+    }
+
+
+class TestNotebookController:
+    def test_creates_sts_service_virtualservice(self, env):
+        cluster, mgr = env
+        cluster.create(notebook_manifest())
+        mgr.run_pending()
+        sts = cluster.get("apps/v1", "StatefulSet", "alice", "nb")
+        assert sts["spec"]["replicas"] == 1
+        tmpl = sts["spec"]["template"]
+        assert tmpl["metadata"]["labels"]["notebook-name"] == "nb"
+        assert tmpl["spec"]["securityContext"]["fsGroup"] == 100
+        svc = cluster.get("v1", "Service", "alice", "nb")
+        assert svc["spec"]["ports"][0]["targetPort"] == 8888
+        vs = cluster.get("networking.istio.io/v1alpha3", "VirtualService",
+                         "alice", "notebook-nb")
+        prefix = vs["spec"]["http"][0]["match"][0]["uri"]["prefix"]
+        assert prefix == "/notebook/alice/nb/"
+        # all children owned → cascade GC
+        for obj in (sts, svc, vs):
+            assert obj["metadata"]["ownerReferences"][0]["kind"] == "Notebook"
+
+    def test_sts_controller_creates_pod_and_status_flows(self, env):
+        cluster, mgr = env
+        cluster.create(notebook_manifest())
+        mgr.run_pending()
+        cluster.tick()   # pod scheduled + running
+        mgr.run_pending()
+        pod = cluster.get("v1", "Pod", "alice", "nb-0")
+        assert pod["status"]["phase"] == "Running"
+        nb = cluster.get("kubeflow.org/v1alpha1", "Notebook", "alice", "nb")
+        assert nb["status"]["readyReplicas"] == 1
+        assert k8s.condition_true(nb, "Ready")
+        assert "running" in nb["status"]["containerState"]
+
+    def test_tpu_notebook_gets_node_selector(self, env):
+        cluster, mgr = env
+        cluster.create(notebook_manifest(
+            limits={"google.com/tpu": 4}))
+        mgr.run_pending()
+        sts = cluster.get("apps/v1", "StatefulSet", "alice", "nb")
+        sel = sts["spec"]["template"]["spec"]["nodeSelector"]
+        assert "cloud.google.com/gke-tpu-accelerator" in sel
+
+    def test_delete_cascades(self, env):
+        cluster, mgr = env
+        cluster.create(notebook_manifest())
+        mgr.run_pending()
+        cluster.delete("kubeflow.org/v1alpha1", "Notebook", "alice", "nb")
+        assert cluster.get_or_none("apps/v1", "StatefulSet", "alice",
+                                   "nb") is None
+        assert cluster.get_or_none("v1", "Service", "alice", "nb") is None
+
+
+class TestStatefulSetController:
+    def test_scale_down_removes_high_ordinals(self, env):
+        cluster, mgr = env
+        sts = {
+            "apiVersion": "apps/v1", "kind": "StatefulSet",
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"replicas": 3,
+                     "selector": {"matchLabels": {"app": "web"}},
+                     "template": {"spec": {"containers": [
+                         {"name": "c", "image": "i"}]}}},
+        }
+        cluster.create(sts)
+        mgr.run_pending()
+        assert len(cluster.list("v1", "Pod", "default")) == 3
+        stored = cluster.get("apps/v1", "StatefulSet", "default", "web")
+        stored["spec"]["replicas"] = 1
+        cluster.update(stored)
+        mgr.run_pending()
+        names = {k8s.name_of(p) for p in cluster.list("v1", "Pod", "default")}
+        assert names == {"web-0"}
+
+
+class TestProfileController:
+    def test_provisions_namespace_sas_bindings(self, env):
+        cluster, mgr = env
+        cluster.create({
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "Profile",
+            "metadata": {"name": "team-ml"},
+            "spec": {"owner": {"kind": "User", "name": "alice@example.com"},
+                     "resourceQuotaSpec": {"hard": {"cpu": "8"}}},
+        })
+        mgr.run_pending()
+        ns = cluster.get("v1", "Namespace", "", "team-ml")
+        assert ns["metadata"]["annotations"]["owner"] == "alice@example.com"
+        for sa in ("default-editor", "default-viewer"):
+            assert cluster.get("v1", "ServiceAccount", "team-ml", sa)
+        rb = cluster.get("rbac.authorization.k8s.io/v1", "RoleBinding",
+                         "team-ml", "namespaceAdmin")
+        assert rb["subjects"][0]["name"] == "alice@example.com"
+        quota = cluster.get("v1", "ResourceQuota", "team-ml",
+                            "kf-resource-quota")
+        assert quota["spec"]["hard"]["cpu"] == "8"
+        profile = cluster.get("kubeflow.org/v1alpha1", "Profile", "",
+                              "team-ml")
+        assert k8s.condition_true(profile, "Ready")
+
+
+def pod_default(name, selector, **spec):
+    return {
+        "apiVersion": "kubeflow.org/v1alpha1", "kind": "PodDefault",
+        "metadata": {"name": name, "namespace": "alice",
+                     "resourceVersion": "1"},
+        "spec": {"selector": {"matchLabels": selector}, **spec},
+    }
+
+
+def pod(labels=None):
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "p", "namespace": "alice",
+                     "labels": labels or {}},
+        "spec": {"containers": [{"name": "main", "image": "i"}]},
+    }
+
+
+class TestPodDefaults:
+    def test_selection_by_label(self):
+        pds = [pod_default("a", {"inject": "yes"}),
+               pod_default("b", {"other": "x"})]
+        assert [k8s.name_of(p) for p in
+                select_pod_defaults(pod({"inject": "yes"}), pds)] == ["a"]
+        assert select_pod_defaults(pod({}), pds) == []
+
+    def test_merge_env_volumes_mounts(self):
+        pds = [pod_default(
+            "gcp-creds", {"inject": "yes"},
+            env=[{"name": "GOOGLE_APPLICATION_CREDENTIALS",
+                  "value": "/secret/key.json"}],
+            volumeMounts=[{"name": "creds", "mountPath": "/secret"}],
+            volumes=[{"name": "creds", "secret": {"secretName": "gcp"}}],
+            annotations={"injected": "true"})]
+        p = apply_pod_defaults(pod({"inject": "yes"}), pds)
+        c = p["spec"]["containers"][0]
+        assert c["env"][0]["name"] == "GOOGLE_APPLICATION_CREDENTIALS"
+        assert c["volumeMounts"][0]["mountPath"] == "/secret"
+        assert p["spec"]["volumes"][0]["secret"]["secretName"] == "gcp"
+        assert p["metadata"]["annotations"]["injected"] == "true"
+        assert "poddefault.admission.kubeflow.org/poddefault-gcp-creds" in \
+            p["metadata"]["annotations"]
+
+    def test_existing_env_wins(self):
+        pds = [pod_default("d", {"x": "y"},
+                           env=[{"name": "A", "value": "injected"}])]
+        base = pod({"x": "y"})
+        base["spec"]["containers"][0]["env"] = [
+            {"name": "A", "value": "original"}]
+        p = apply_pod_defaults(base, pds)
+        assert p["spec"]["containers"][0]["env"] == [
+            {"name": "A", "value": "original"}]
+
+    def test_conflicting_defaults_raise(self):
+        pds = [pod_default("a", {"x": "y"},
+                           env=[{"name": "A", "value": "1"}]),
+               pod_default("b", {"x": "y"},
+                           env=[{"name": "A", "value": "2"}])]
+        with pytest.raises(PodDefaultConflict, match="env A"):
+            apply_pod_defaults(pod({"x": "y"}), pds)
+
+    def test_empty_selector_matches_everything(self):
+        # k8s LabelSelector convention: {} selects all pods in the namespace
+        pds = [pod_default("global", {})]
+        assert select_pod_defaults(pod({}), pds) == pds
+        assert select_pod_defaults(pod({"any": "label"}), pds) == pds
+
+    def test_admission_hook_mutates_on_create(self):
+        cluster = FakeCluster()
+        cluster.admission_hooks.append(PodDefaultsWebhook(cluster))
+        cluster.create(pod_default(
+            "tpu-env", {"needs-tpu-env": "true"},
+            env=[{"name": "TPU_RUNTIME", "value": "pjrt"}]))
+        created = cluster.create(pod({"needs-tpu-env": "true"}))
+        env_vars = {e["name"]: e["value"]
+                    for e in created["spec"]["containers"][0]["env"]}
+        assert env_vars["TPU_RUNTIME"] == "pjrt"
+        # non-matching pod untouched
+        other = cluster.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "q", "namespace": "alice"},
+            "spec": {"containers": [{"name": "m", "image": "i"}]}})
+        assert "env" not in other["spec"]["containers"][0]
+
+
+class TestBuildManager:
+    def test_full_control_plane_assembles_and_converges(self):
+        from kubeflow_tpu.controllers import build_manager
+        cluster = FakeCluster()
+        cluster.add_node("cpu-0", {"cpu": 96, "memory": 2 ** 36})
+        mgr = build_manager(cluster)
+        assert len(mgr.controllers) >= 10
+        assert len(cluster.admission_hooks) == 1
+        cluster.create(notebook_manifest())
+        mgr.run_pending()
+        cluster.tick()
+        mgr.run_pending()
+        nb = cluster.get("kubeflow.org/v1alpha1", "Notebook", "alice", "nb")
+        assert k8s.condition_true(nb, "Ready")
+        # QUIESCENCE: with no external changes, a further drain must do
+        # zero reconciles — an apply/status write that always bumps
+        # resourceVersion would re-enqueue owners forever (hot loop under
+        # start_all) and this is the regression guard for that
+        assert sum(c.run_pending() for c in mgr.controllers) == 0
+
+
+class TestGatekeeper:
+    def test_session_lifecycle_and_expiry(self):
+        now = [0.0]
+        store = SessionStore(ttl_s=100, clock=lambda: now[0])
+        token = store.create()
+        assert store.valid(token)
+        now[0] = 101.0
+        assert not store.valid(token)
+        assert not store.valid("bogus")
+
+    def test_no_password_fails_closed(self):
+        import base64
+        gate = Gatekeeper(username="admin", password="")
+        assert not gate.check_credentials("admin", "")
+        header = "Basic " + base64.b64encode(b"admin:").decode()
+        assert not gate.check_basic_header(header)
+        assert gate.login("admin", "") is None
+
+    def test_credential_check(self):
+        gate = Gatekeeper(username="admin", password="s3cret")
+        assert gate.check_credentials("admin", "s3cret")
+        assert not gate.check_credentials("admin", "wrong")
+        assert not gate.check_credentials("root", "s3cret")
+        import base64
+        header = "Basic " + base64.b64encode(b"admin:s3cret").decode()
+        assert gate.check_basic_header(header)
+        assert not gate.check_basic_header("Basic garbage!!")
+
+    def test_http_login_auth_logout_flow(self):
+        server = GatekeeperServer(Gatekeeper(username="u", password="p"))
+        port = server.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            # unauthorized before login
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(f"{base}/auth")
+            assert e.value.code == 401
+            # login → cookie
+            req = urllib.request.Request(
+                f"{base}/login", data=b"username=u&password=p",
+                headers={"Content-Type":
+                         "application/x-www-form-urlencoded"})
+            with urllib.request.urlopen(req) as resp:
+                cookie = resp.headers["Set-Cookie"].split(";")[0]
+            # authorized with cookie
+            req = urllib.request.Request(f"{base}/auth",
+                                         headers={"Cookie": cookie})
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 200
+            # logout revokes
+            req = urllib.request.Request(f"{base}/logout",
+                                         headers={"Cookie": cookie})
+            urllib.request.urlopen(req)
+            req = urllib.request.Request(f"{base}/auth",
+                                         headers={"Cookie": cookie})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req)
+            assert e.value.code == 401
+        finally:
+            server.stop()
+
+    def test_bad_login_rejected(self):
+        server = GatekeeperServer(Gatekeeper(username="u", password="p"))
+        port = server.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/login",
+                data=b"username=u&password=nope")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req)
+            assert e.value.code == 401
+        finally:
+            server.stop()

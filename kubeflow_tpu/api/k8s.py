@@ -35,6 +35,31 @@ CLUSTER_SCOPED_KINDS = {
 # ---------------------------------------------------------------------------
 
 
+def snapshot(obj) -> str:
+    """Stable serialization for write-on-change guards (apply no-ops,
+    status-update no-ops). One definition so the two layers never diverge."""
+    import json
+    return json.dumps(obj, sort_keys=True, default=str)
+
+
+_QUANTITY_SUFFIXES = {
+    "Ki": 2 ** 10, "Mi": 2 ** 20, "Gi": 2 ** 30, "Ti": 2 ** 40,
+    "Pi": 2 ** 50, "k": 10 ** 3, "M": 10 ** 6, "G": 10 ** 9, "T": 10 ** 12,
+    "m": 1e-3,  # millicores
+}
+
+
+def parse_quantity(value) -> float:
+    """Kubernetes resource quantity → float ("8Gi", "500m", 4, "2")."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    s = str(value).strip()
+    for suffix in sorted(_QUANTITY_SUFFIXES, key=len, reverse=True):
+        if s.endswith(suffix):
+            return float(s[:-len(suffix)]) * _QUANTITY_SUFFIXES[suffix]
+    return float(s)
+
+
 def gvk(obj: dict) -> tuple[str, str]:
     """(apiVersion, kind) of a manifest."""
     return obj.get("apiVersion", ""), obj.get("kind", "")

@@ -120,8 +120,6 @@ class KubeClient:
         (which bumps resourceVersion and broadcasts MODIFIED) would
         re-enqueue the owner forever.
         """
-        import json
-
         from ..api import k8s
         existing = self.get_or_none(*k8s.key_of(obj))
         if existing is None:
@@ -136,8 +134,7 @@ class KubeClient:
             if obj.get("metadata", {}).get(key):
                 meta[key] = obj["metadata"][key]
         merged["metadata"] = meta
-        if json.dumps(merged, sort_keys=True, default=str) == \
-                json.dumps(existing, sort_keys=True, default=str):
+        if k8s.snapshot(merged) == k8s.snapshot(existing):
             return existing
         return self.update(merged)
 

@@ -46,7 +46,7 @@ def _resources_of(pod: dict) -> dict[str, float]:
         res = c.get("resources", {}) or {}
         req = res.get("requests") or res.get("limits") or {}
         for k, v in req.items():
-            total[k] = total.get(k, 0.0) + float(v)
+            total[k] = total.get(k, 0.0) + k8s.parse_quantity(v)
     return total
 
 
@@ -256,7 +256,10 @@ class FakeCluster(KubeClient):
         for (_, kind, _, name), node in list(self._objects.items()):
             if kind != "Node":
                 continue
-            free[name] = dict(node.get("status", {}).get("allocatable", {}))
+            free[name] = {
+                r: k8s.parse_quantity(v)
+                for r, v in (node.get("status", {})
+                             .get("allocatable", {}) or {}).items()}
         for (_, kind, _, _), pod in list(self._objects.items()):
             if kind != "Pod":
                 continue

@@ -45,18 +45,21 @@ def select_pod_defaults(pod: dict, defaults: list[dict]) -> list[dict]:
 
 
 def check_conflicts(defaults: list[dict]) -> None:
-    env_seen: dict[str, str] = {}
+    env_seen: dict[str, dict] = {}
     vol_seen: dict[str, dict] = {}
     mount_seen: dict[str, str] = {}
     for pd in defaults:
         spec = pd.get("spec", {})
         for e in spec.get("env", []) or []:
-            name, value = e.get("name"), e.get("value")
-            if name in env_seen and env_seen[name] != value:
+            # compare the FULL entry: two defaults injecting the same name
+            # from different valueFrom sources conflict just as surely as
+            # two literal values do
+            name = e.get("name")
+            if name in env_seen and env_seen[name] != e:
                 raise PodDefaultConflict(
-                    f"env {name}: {env_seen[name]!r} vs {value!r} "
+                    f"env {name}: {env_seen[name]!r} vs {e!r} "
                     f"(poddefault {k8s.name_of(pd)})")
-            env_seen[name] = value
+            env_seen[name] = e
         for v in spec.get("volumes", []) or []:
             name = v.get("name")
             if name in vol_seen and vol_seen[name] != v:

@@ -5,11 +5,9 @@ pkg/controller/notebook/notebook_controller.go: watch wiring :57-144,
 Reconcile :163, generateStatefulSet :313, generateService :367,
 generateVirtualService :414). The CR spec wraps a full PodSpec in a
 template (notebook_types.go:28-35 — SURVEY.md §2.6 "CR wraps PodSpec"),
-and status is condition-based.
-
-TPU-native addition: a notebook whose template requests ``google.com/tpu``
-gets the TPU node selector injected, so interactive development on a
-single-host slice works the same way training pods do.
+and status is condition-based. A notebook requesting ``google.com/tpu``
+schedules onto TPU hosts via the extended resource, so interactive
+development on a single-host slice works the same way training pods do.
 """
 
 from __future__ import annotations
@@ -28,16 +26,6 @@ NOTEBOOK_KIND = "Notebook"
 NOTEBOOK_PORT = 8888
 NOTEBOOK_NAME_LABEL = "notebook-name"
 TPU_RESOURCE = "google.com/tpu"
-TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
-
-
-def _wants_tpu(pod_spec: dict) -> bool:
-    for c in pod_spec.get("containers", []) or []:
-        res = c.get("resources", {}) or {}
-        for bucket in ("requests", "limits"):
-            if TPU_RESOURCE in (res.get(bucket) or {}):
-                return True
-    return False
 
 
 class NotebookReconciler(Reconciler):
@@ -88,9 +76,9 @@ class NotebookReconciler(Reconciler):
             nb.get("spec", {}).get("template", {}) or {})
         pod_spec = template.setdefault("spec", {})
         pod_spec.setdefault("securityContext", {"fsGroup": 100})
-        if _wants_tpu(pod_spec):
-            sel = pod_spec.setdefault("nodeSelector", {})
-            sel.setdefault(TPU_ACCELERATOR_LABEL, "tpu-v5e")
+        # TPU placement: the google.com/tpu resource request drives
+        # scheduling; hardcoding an accelerator nodeSelector here would pin
+        # notebooks to one TPU generation and wedge them on other pools
         labels = template.setdefault("metadata", {}).setdefault("labels", {})
         labels.update({"app": name, NOTEBOOK_NAME_LABEL: name})
         sts = {

@@ -93,6 +93,14 @@ class ProfileReconciler(Reconciler):
             }
             k8s.set_owner(quota, profile)
             client.apply(quota)
+        else:
+            # prune: dropping resourceQuotaSpec must lift the quota, not
+            # leave the old limit enforced forever
+            try:
+                client.delete("v1", "ResourceQuota", name,
+                              "kf-resource-quota")
+            except NotFoundError:
+                pass
 
         if not k8s.condition_true(profile, "Ready"):
             fresh = client.get(PROFILE_API_VERSION, PROFILE_KIND,

@@ -41,8 +41,7 @@ def status_snapshot(status: dict) -> str:
     and reconcile forever; compare snapshots taken before/after mutation and
     skip the write when equal.
     """
-    import json
-    return json.dumps(status, sort_keys=True, default=str)
+    return k8s.snapshot(status)
 
 
 @dataclass

@@ -19,6 +19,13 @@ from .runtime import Key, Reconciler, Result, status_snapshot
 
 log = logging.getLogger(__name__)
 
+TEMPLATE_HASH_LABEL = "controller.kubernetes.io/pod-template-hash"
+
+
+def _template_hash(template: dict) -> str:
+    import hashlib
+    return hashlib.sha1(k8s.snapshot(template).encode()).hexdigest()[:10]
+
 
 class StatefulSetReconciler(Reconciler):
     primary = ("apps/v1", "StatefulSet")
@@ -38,10 +45,21 @@ class StatefulSetReconciler(Reconciler):
         pods = [p for p in client.list("v1", "Pod", ns)
                 if k8s.is_owned_by(p, sts)]
         by_name = {k8s.name_of(p): p for p in pods}
+        thash = _template_hash(template)
 
+        requeue = False
         for i in range(replicas):
             pod_name = f"{name}-{i}"
-            if pod_name in by_name:
+            existing = by_name.get(pod_name)
+            if existing is not None:
+                if k8s.labels_of(existing).get(TEMPLATE_HASH_LABEL) == thash:
+                    continue
+                # template changed: delete + recreate next pass (the STS
+                # rolling-update analog) — silently keeping the stale pod
+                # would make spec edits (e.g. a Notebook image change)
+                # no-ops with a healthy-looking status
+                client.delete("v1", "Pod", ns, pod_name)
+                requeue = True
                 continue
             pod = {
                 "apiVersion": "v1", "kind": "Pod",
@@ -49,7 +67,8 @@ class StatefulSetReconciler(Reconciler):
                     "name": pod_name, "namespace": ns,
                     "labels": {**(template.get("metadata", {})
                                   .get("labels") or {}), **selector,
-                               "statefulset.kubernetes.io/pod-name": pod_name},
+                               "statefulset.kubernetes.io/pod-name": pod_name,
+                               TEMPLATE_HASH_LABEL: thash},
                 },
                 "spec": copy.deepcopy(template.get("spec", {})),
             }
@@ -73,4 +92,4 @@ class StatefulSetReconciler(Reconciler):
             fresh = client.get("apps/v1", "StatefulSet", ns, name)
             fresh["status"] = status
             client.update_status(fresh)
-        return Result()
+        return Result(requeue=requeue)

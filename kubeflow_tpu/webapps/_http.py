@@ -1,0 +1,118 @@
+"""Tiny JSON-over-HTTP routing base for the web apps (stdlib only).
+
+The reference's web backends are Express (centraldashboard) and Flask
+(jupyter-web-app); this is the shared scaffolding for our equivalents: path
+patterns with ``{param}`` captures, JSON bodies in/out, threaded server.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+Route = tuple[str, re.Pattern, Callable]
+
+
+class JsonApp:
+    """Register handlers with ``app.route("GET", "/api/x/{name}")``;
+    handlers receive (params, query, body) and return (status, payload)."""
+
+    def __init__(self):
+        self.routes: list[Route] = []
+
+    def route(self, method: str, pattern: str):
+        regex = re.compile(
+            "^" + re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$")
+
+        def deco(fn):
+            self.routes.append((method, regex, fn))
+            return fn
+
+        return deco
+
+    def dispatch(self, method: str, path: str,
+                 body: Optional[dict]) -> tuple[int, Any]:
+        parsed = urlparse(path)
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        for m, regex, fn in self.routes:
+            if m != method:
+                continue
+            match = regex.match(parsed.path)
+            if match:
+                try:
+                    return fn(match.groupdict(), query, body)
+                except ApiError as e:
+                    return e.status, {"error": str(e)}
+                except Exception as e:  # noqa: BLE001 - 500 boundary
+                    return 500, {"error": f"{type(e).__name__}: {e}"}
+        return 404, {"error": f"no route for {method} {parsed.path}"}
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+class JsonServer:
+    def __init__(self, app: JsonApp, host: str = "127.0.0.1", port: int = 0,
+                 name: str = "webapp"):
+        self.app = app
+        self.name = name
+        self._httpd = ThreadingHTTPServer((host, port), _make_handler(app))
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name=self.name)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _make_handler(app: JsonApp):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _handle(self, method: str):
+            body = None
+            length = int(self.headers.get("Content-Length", 0))
+            if length:
+                try:
+                    body = json.loads(self.rfile.read(length))
+                except json.JSONDecodeError:
+                    self._respond(400, {"error": "invalid JSON body"})
+                    return
+            status, payload = app.dispatch(method, self.path, body)
+            self._respond(status, payload)
+
+        def _respond(self, status: int, payload: Any):
+            data = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            self._handle("GET")
+
+        def do_POST(self):
+            self._handle("POST")
+
+        def do_DELETE(self):
+            self._handle("DELETE")
+
+        def do_PATCH(self):
+            self._handle("PATCH")
+
+    return Handler

@@ -1,0 +1,145 @@
+"""Central dashboard API: namespaces, activities, cluster metrics.
+
+The reference's centraldashboard backend (components/centraldashboard/
+app/api.ts:26-30 router; app/k8s_service.ts namespace/activity proxying;
+app/metrics_service.ts pluggable MetricsService with a Stackdriver impl,
+exercised in api_test.ts:30-99). Same surface here over the KubeClient,
+plus a TPU-native addition: a slice inventory endpoint summarizing TPU
+node pools (topology, chips, schedulable) that the reference's GPU-era
+dashboard had no analog for.
+
+Routes:
+  GET /api/namespaces
+  GET /api/activities/{namespace}          (Events, newest first)
+  GET /api/metrics/{type}?window=          (podcpu | podmem | node)
+  GET /api/tpu/slices
+  GET /healthz
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import k8s
+from ..cluster.client import KubeClient
+from ._http import ApiError, JsonApp, JsonServer
+
+METRIC_TYPES = ("podcpu", "podmem", "node")
+
+
+class MetricsService:
+    """Pluggable cluster-metrics backend (metrics_service.ts interface)."""
+
+    def query(self, metric_type: str, window_s: int) -> list[dict]:
+        raise NotImplementedError
+
+
+class NullMetricsService(MetricsService):
+    """No metrics backend configured (the dashboard renders an empty
+    chart); a Prometheus-backed impl plugs in the same way Stackdriver did."""
+
+    def query(self, metric_type: str, window_s: int) -> list[dict]:
+        return []
+
+
+class ClusterMetricsService(MetricsService):
+    """Derives coarse utilization from the cluster state itself: pod counts
+    per node as a proxy when no timeseries backend exists."""
+
+    def __init__(self, client: KubeClient):
+        self.client = client
+
+    def query(self, metric_type: str, window_s: int) -> list[dict]:
+        pods = self.client.list("v1", "Pod")
+        if metric_type in ("podcpu", "podmem"):
+            bucket = "cpu" if metric_type == "podcpu" else "memory"
+            out = []
+            for p in pods:
+                total = 0.0
+                for c in p.get("spec", {}).get("containers", []) or []:
+                    req = (c.get("resources", {}) or {}).get("requests") or {}
+                    try:
+                        total += k8s.parse_quantity(req.get(bucket, 0))
+                    except (TypeError, ValueError):
+                        continue
+                out.append({"pod": k8s.name_of(p),
+                            "namespace": k8s.namespace_of(p, "default"),
+                            "value": total})
+            return out
+        nodes = self.client.list("v1", "Node")
+        by_node: dict[str, int] = {}
+        for p in pods:
+            node = p.get("spec", {}).get("nodeName")
+            if node:
+                by_node[node] = by_node.get(node, 0) + 1
+        return [{"node": k8s.name_of(n),
+                 "value": by_node.get(k8s.name_of(n), 0)} for n in nodes]
+
+
+def build_dashboard_app(client: KubeClient,
+                        metrics: Optional[MetricsService] = None) -> JsonApp:
+    metrics = metrics or ClusterMetricsService(client)
+    app = JsonApp()
+
+    @app.route("GET", "/healthz")
+    def healthz(params, query, body):
+        return 200, {"ok": True}
+
+    @app.route("GET", "/api/namespaces")
+    def namespaces(params, query, body):
+        return 200, [k8s.name_of(n)
+                     for n in client.list("v1", "Namespace")]
+
+    @app.route("GET", "/api/activities/{namespace}")
+    def activities(params, query, body):
+        events = client.list("v1", "Event", params["namespace"])
+        events.sort(key=lambda e: e.get("lastTimestamp", ""), reverse=True)
+        return 200, [{
+            "reason": e.get("reason", ""),
+            "message": e.get("message", ""),
+            "type": e.get("type", "Normal"),
+            "involvedObject": (e.get("involvedObject") or {}).get("name", ""),
+            "lastTimestamp": e.get("lastTimestamp", ""),
+        } for e in events]
+
+    @app.route("GET", "/api/metrics/{mtype}")
+    def metrics_route(params, query, body):
+        mtype = params["mtype"]
+        if mtype not in METRIC_TYPES:
+            raise ApiError(400, f"metric type {mtype!r} not in "
+                                f"{METRIC_TYPES}")
+        try:
+            window = int(query.get("window", 900))
+        except ValueError:
+            raise ApiError(400, f"window must be an integer, got "
+                                f"{query.get('window')!r}")
+        return 200, metrics.query(mtype, window)
+
+    @app.route("GET", "/api/tpu/slices")
+    def tpu_slices(params, query, body):
+        pools: dict[str, dict] = {}
+        for node in client.list("v1", "Node"):
+            labels = k8s.labels_of(node)
+            topo = labels.get("cloud.google.com/gke-tpu-topology")
+            if not topo:
+                continue
+            alloc = node.get("status", {}).get("allocatable", {}) or {}
+            pool = pools.setdefault(topo, {
+                "topology": topo,
+                "accelerator": labels.get(
+                    "cloud.google.com/gke-tpu-accelerator", ""),
+                "hosts": 0, "chips": 0, "ready": 0})
+            pool["hosts"] += 1
+            pool["chips"] += int(float(alloc.get("google.com/tpu", 0)))
+            if k8s.condition_true(node, "Ready"):
+                pool["ready"] += 1
+        return 200, sorted(pools.values(), key=lambda p: p["topology"])
+
+    return app
+
+
+class DashboardServer(JsonServer):
+    def __init__(self, client: KubeClient,
+                 metrics: Optional[MetricsService] = None, **kw):
+        super().__init__(build_dashboard_app(client, metrics),
+                         name="centraldashboard", **kw)

@@ -41,6 +41,7 @@ class SessionStore:
         self._lock = threading.Lock()
 
     def create(self) -> str:
+        self.sweep()  # opportunistic GC so dead tokens can't accumulate
         token = secrets.token_urlsafe(32)
         with self._lock:
             self._sessions[token] = self.clock() + self.ttl_s

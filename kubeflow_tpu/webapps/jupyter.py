@@ -1,0 +1,210 @@
+"""Jupyter web app backend: Notebook CR + PVC CRUD.
+
+The reference's jupyter-web-app (components/jupyter-web-app/
+kubeflow_jupyter/common/api.py:30-191: list/create/delete Notebook CRs and
+PVCs; main.py default/rok skins; spawner UI config). Same surface over the
+KubeClient; the spawner config gains TPU shapes (a notebook can request a
+single-host slice topology the way the reference's spawner offered GPUs).
+
+Routes:
+  GET    /api/config
+  GET    /api/namespaces/{ns}/notebooks
+  POST   /api/namespaces/{ns}/notebooks
+  DELETE /api/namespaces/{ns}/notebooks/{name}
+  GET    /api/namespaces/{ns}/pvcs
+  POST   /api/namespaces/{ns}/pvcs
+  GET    /healthz
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import k8s
+from ..cluster.client import AlreadyExistsError, KubeClient, NotFoundError
+from ..controllers.notebook import (NOTEBOOK_API_VERSION, NOTEBOOK_KIND,
+                                    TPU_RESOURCE)
+from ._http import ApiError, JsonApp, JsonServer
+
+DEFAULT_IMAGES = [
+    "ghcr.io/kubeflow-tpu/notebook-jax:latest",
+    "ghcr.io/kubeflow-tpu/notebook-jax-tpu:latest",
+]
+# single-host slice shapes a notebook may request interactively
+TPU_SHAPES = ["", "1x1 (1 chip)", "2x2 (4 chips)", "2x4 (8 chips)"]
+_TPU_CHIPS = {"1x1 (1 chip)": 1, "2x2 (4 chips)": 4, "2x4 (8 chips)": 8}
+
+
+def notebook_summary(nb: dict) -> dict:
+    spec = (nb.get("spec", {}).get("template", {}) or {}).get("spec", {})
+    containers = spec.get("containers", []) or []
+    image = containers[0].get("image", "") if containers else ""
+    res = (containers[0].get("resources", {}) or {}) if containers else {}
+    limits = res.get("limits") or {}
+    return {
+        "name": k8s.name_of(nb),
+        "namespace": k8s.namespace_of(nb, "default"),
+        "image": image,
+        "cpu": (res.get("requests") or {}).get("cpu", ""),
+        "memory": (res.get("requests") or {}).get("memory", ""),
+        "tpu": limits.get(TPU_RESOURCE, 0),
+        "status": "Running" if k8s.condition_true(nb, "Ready") else "Waiting",
+    }
+
+
+def workspace_pvc_name(notebook_name: str, ws: dict) -> str:
+    """Single source of the default workspace claim name: the manifest's
+    volume reference and the PVC creation path must agree."""
+    return ws.get("name") or f"workspace-{notebook_name}"
+
+
+def build_notebook_manifest(namespace: str, body: dict) -> dict:
+    """POST body → Notebook CR (api.py:30-81 shape, TPU-aware)."""
+    name = body.get("name")
+    if not name:
+        raise ApiError(400, "name is required")
+    try:
+        k8s.validate_name(name)
+    except ValueError as e:
+        raise ApiError(400, str(e))
+    image = body.get("image") or DEFAULT_IMAGES[0]
+    resources: dict = {"requests": {}, "limits": {}}
+    if body.get("cpu"):
+        resources["requests"]["cpu"] = body["cpu"]
+    if body.get("memory"):
+        resources["requests"]["memory"] = body["memory"]
+    tpu_shape = body.get("tpu") or ""
+    if tpu_shape:
+        chips = _TPU_CHIPS.get(tpu_shape)
+        if chips is None:
+            raise ApiError(400, f"unknown TPU shape {tpu_shape!r}; "
+                                f"choose from {TPU_SHAPES[1:]}")
+        resources["limits"][TPU_RESOURCE] = chips
+    container = {"name": name, "image": image}
+    if resources["requests"] or resources["limits"]:
+        container["resources"] = {k: v for k, v in resources.items() if v}
+    pod_spec: dict = {"containers": [container]}
+    volume_mounts = []
+    volumes = []
+    ws = body.get("workspaceVolume")
+    if ws:
+        volumes.append({"name": "workspace", "persistentVolumeClaim":
+                        {"claimName": workspace_pvc_name(name, ws)}})
+        volume_mounts.append({"name": "workspace",
+                              "mountPath": ws.get("path", "/home/jovyan")})
+    for i, dv in enumerate(body.get("dataVolumes") or []):
+        volumes.append({"name": f"data-{i}", "persistentVolumeClaim":
+                        {"claimName": dv["name"]}})
+        volume_mounts.append({"name": f"data-{i}",
+                              "mountPath": dv.get("path", f"/data/{i}")})
+    if volume_mounts:
+        container["volumeMounts"] = volume_mounts
+        pod_spec["volumes"] = volumes
+    return {
+        "apiVersion": NOTEBOOK_API_VERSION, "kind": NOTEBOOK_KIND,
+        "metadata": {"name": name, "namespace": namespace,
+                     "labels": {"app": name}},
+        "spec": {"template": {"spec": pod_spec}},
+    }
+
+
+def build_pvc_manifest(namespace: str, body: dict) -> dict:
+    name = body.get("name")
+    if not name:
+        raise ApiError(400, "name is required")
+    try:
+        k8s.validate_name(name)
+    except ValueError as e:
+        raise ApiError(400, str(e))
+    return {
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "accessModes": [body.get("mode", "ReadWriteOnce")],
+            "resources": {"requests": {
+                "storage": body.get("size", "10Gi")}},
+            **({"storageClassName": body["class"]}
+               if body.get("class") else {}),
+        },
+    }
+
+
+def build_jupyter_app(client: KubeClient) -> JsonApp:
+    app = JsonApp()
+
+    @app.route("GET", "/healthz")
+    def healthz(params, query, body):
+        return 200, {"ok": True}
+
+    @app.route("GET", "/api/config")
+    def config(params, query, body):
+        return 200, {
+            "images": DEFAULT_IMAGES,
+            "tpuShapes": TPU_SHAPES,
+            "defaultWorkspaceSize": "10Gi",
+        }
+
+    @app.route("GET", "/api/namespaces/{ns}/notebooks")
+    def list_notebooks(params, query, body):
+        nbs = client.list(NOTEBOOK_API_VERSION, NOTEBOOK_KIND, params["ns"])
+        return 200, {"notebooks": [notebook_summary(nb) for nb in nbs]}
+
+    @app.route("POST", "/api/namespaces/{ns}/notebooks")
+    def create_notebook(params, query, body):
+        if not body:
+            raise ApiError(400, "JSON body required")
+        ns = params["ns"]
+        manifest = build_notebook_manifest(ns, body)
+        try:
+            created = client.create(manifest)
+        except AlreadyExistsError:
+            raise ApiError(409, f"notebook {body['name']} already exists")
+        # PVC only after the notebook create succeeds: a 409 must not leak
+        # an orphaned workspace volume
+        ws = body.get("workspaceVolume")
+        if ws and ws.get("create", True):
+            pvc = build_pvc_manifest(ns, {
+                "name": workspace_pvc_name(body["name"], ws),
+                "size": ws.get("size", "10Gi")})
+            try:
+                client.create(pvc)
+            except AlreadyExistsError:
+                pass  # reuse the existing workspace (rok-skin behavior)
+        return 200, {"notebook": notebook_summary(created)}
+
+    @app.route("DELETE", "/api/namespaces/{ns}/notebooks/{name}")
+    def delete_notebook(params, query, body):
+        try:
+            client.delete(NOTEBOOK_API_VERSION, NOTEBOOK_KIND,
+                          params["ns"], params["name"])
+        except NotFoundError:
+            raise ApiError(404, f"notebook {params['name']} not found")
+        return 200, {"deleted": params["name"]}
+
+    @app.route("GET", "/api/namespaces/{ns}/pvcs")
+    def list_pvcs(params, query, body):
+        pvcs = client.list("v1", "PersistentVolumeClaim", params["ns"])
+        return 200, {"pvcs": [{
+            "name": k8s.name_of(p),
+            "size": ((p.get("spec", {}).get("resources") or {})
+                     .get("requests") or {}).get("storage", ""),
+            "mode": (p.get("spec", {}).get("accessModes") or [""])[0],
+        } for p in pvcs]}
+
+    @app.route("POST", "/api/namespaces/{ns}/pvcs")
+    def create_pvc(params, query, body):
+        if not body:
+            raise ApiError(400, "JSON body required")
+        try:
+            created = client.create(build_pvc_manifest(params["ns"], body))
+        except AlreadyExistsError:
+            raise ApiError(409, f"pvc {body.get('name')} already exists")
+        return 200, {"pvc": k8s.name_of(created)}
+
+    return app
+
+
+class JupyterWebApp(JsonServer):
+    def __init__(self, client: KubeClient, **kw):
+        super().__init__(build_jupyter_app(client), name="jupyter-web-app",
+                         **kw)

@@ -79,14 +79,33 @@ class TestNotebookController:
         assert k8s.condition_true(nb, "Ready")
         assert "running" in nb["status"]["containerState"]
 
-    def test_tpu_notebook_gets_node_selector(self, env):
+    def test_tpu_notebook_schedules_on_tpu_pool(self, env):
+        # placement via the extended resource, not a hardcoded accelerator
+        # selector (which would pin notebooks to one TPU generation)
         cluster, mgr = env
-        cluster.create(notebook_manifest(
-            limits={"google.com/tpu": 4}))
+        cluster.add_tpu_slice_nodes("v5p-8")
+        cluster.create(notebook_manifest(limits={"google.com/tpu": 4}))
         mgr.run_pending()
-        sts = cluster.get("apps/v1", "StatefulSet", "alice", "nb")
-        sel = sts["spec"]["template"]["spec"]["nodeSelector"]
-        assert "cloud.google.com/gke-tpu-accelerator" in sel
+        cluster.tick()
+        pod = cluster.get("v1", "Pod", "alice", "nb-0")
+        assert "nodeSelector" not in pod["spec"]
+        assert pod["spec"]["nodeName"].startswith("tpu-pool")
+
+    def test_notebook_image_edit_rolls_the_pod(self, env):
+        cluster, mgr = env
+        cluster.create(notebook_manifest(image="jupyter:v1"))
+        mgr.run_pending()
+        cluster.tick()
+        mgr.run_pending()
+        nb = cluster.get("kubeflow.org/v1alpha1", "Notebook", "alice", "nb")
+        nb["spec"]["template"]["spec"]["containers"][0]["image"] = \
+            "jupyter:v2"
+        cluster.update(nb)
+        mgr.run_pending()
+        cluster.tick()
+        mgr.run_pending()
+        pod = cluster.get("v1", "Pod", "alice", "nb-0")
+        assert pod["spec"]["containers"][0]["image"] == "jupyter:v2"
 
     def test_delete_cascades(self, env):
         cluster, mgr = env
@@ -143,6 +162,25 @@ class TestProfileController:
         profile = cluster.get("kubeflow.org/v1alpha1", "Profile", "",
                               "team-ml")
         assert k8s.condition_true(profile, "Ready")
+
+    def test_dropping_quota_spec_prunes_the_quota(self, env):
+        cluster, mgr = env
+        cluster.create({
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "Profile",
+            "metadata": {"name": "team-ml"},
+            "spec": {"owner": {"kind": "User", "name": "a@x.com"},
+                     "resourceQuotaSpec": {"hard": {"cpu": "8"}}},
+        })
+        mgr.run_pending()
+        assert cluster.get("v1", "ResourceQuota", "team-ml",
+                           "kf-resource-quota")
+        profile = cluster.get("kubeflow.org/v1alpha1", "Profile", "",
+                              "team-ml")
+        del profile["spec"]["resourceQuotaSpec"]
+        cluster.update(profile)
+        mgr.run_pending()
+        assert cluster.get_or_none("v1", "ResourceQuota", "team-ml",
+                                   "kf-resource-quota") is None
 
 
 def pod_default(name, selector, **spec):

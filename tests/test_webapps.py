@@ -1,0 +1,172 @@
+"""Web-app layer tests: central dashboard API + jupyter-web-app backend.
+
+Mirrors the reference's HTTP-level API tests with a mocked MetricsService
+(centraldashboard app/api_test.ts:30-99) and the jupyter-web-app CRUD
+surface (kubeflow_jupyter/common/api.py:30-191), driven over real HTTP
+against the in-memory cluster.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.api import k8s
+from kubeflow_tpu.cluster import FakeCluster
+from kubeflow_tpu.controllers import build_manager
+from kubeflow_tpu.webapps.dashboard import (DashboardServer, MetricsService,
+                                            build_dashboard_app)
+from kubeflow_tpu.webapps.jupyter import (JupyterWebApp,
+                                          build_notebook_manifest)
+from kubeflow_tpu.webapps._http import ApiError
+
+
+def get_json(url):
+    with urllib.request.urlopen(url) as r:
+        return json.loads(r.read())
+
+
+def post_json(url, payload, method="POST"):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method=method,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture
+def cluster():
+    c = FakeCluster()
+    c.add_node("cpu-0", {"cpu": 96, "memory": 2 ** 36})
+    c.add_tpu_slice_nodes("v5e-8")
+    for ns in ("kubeflow", "alice"):
+        c.create({"apiVersion": "v1", "kind": "Namespace",
+                  "metadata": {"name": ns}})
+    return c
+
+
+class TestDashboard:
+    def test_namespaces_and_tpu_slices(self, cluster):
+        server = DashboardServer(cluster)
+        port = server.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+            names = get_json(f"{base}/api/namespaces")
+            assert "kubeflow" in names and "alice" in names
+            slices = get_json(f"{base}/api/tpu/slices")
+            assert len(slices) == 1
+            assert slices[0]["topology"] == "v5e-8"
+            assert slices[0]["chips"] == 8
+            assert slices[0]["hosts"] == 2
+        finally:
+            server.stop()
+
+    def test_activities_sorted_newest_first(self, cluster):
+        for i, ts in enumerate(["2026-01-01", "2026-03-01", "2026-02-01"]):
+            cluster.create({
+                "apiVersion": "v1", "kind": "Event",
+                "metadata": {"name": f"ev{i}", "namespace": "alice"},
+                "reason": f"R{i}", "message": "m", "type": "Normal",
+                "lastTimestamp": ts,
+                "involvedObject": {"name": "nb"}})
+        app = build_dashboard_app(cluster)
+        status, events = app.dispatch("GET", "/api/activities/alice", None)
+        assert status == 200
+        assert [e["reason"] for e in events] == ["R1", "R2", "R0"]
+
+    def test_metrics_pluggable_backend(self, cluster):
+        class Fake(MetricsService):
+            def query(self, metric_type, window_s):
+                return [{"metric": metric_type, "window": window_s}]
+
+        app = build_dashboard_app(cluster, metrics=Fake())
+        status, data = app.dispatch("GET", "/api/metrics/podcpu?window=300",
+                                    None)
+        assert status == 200
+        assert data == [{"metric": "podcpu", "window": 300}]
+        status, err = app.dispatch("GET", "/api/metrics/gpu", None)
+        assert status == 400
+
+    def test_node_metric_counts_pods(self, cluster):
+        cluster.create({"apiVersion": "v1", "kind": "Pod",
+                        "metadata": {"name": "p", "namespace": "alice"},
+                        "spec": {"nodeName": "cpu-0", "containers": []}})
+        app = build_dashboard_app(cluster)
+        status, data = app.dispatch("GET", "/api/metrics/node", None)
+        assert status == 200
+        by_node = {d["node"]: d["value"] for d in data}
+        assert by_node["cpu-0"] == 1
+
+
+class TestJupyterWebApp:
+    def test_notebook_crud_over_http(self, cluster):
+        mgr = build_manager(cluster)
+        server = JupyterWebApp(cluster)
+        port = server.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            cfg = get_json(f"{base}/api/config")
+            assert cfg["tpuShapes"][1] == "1x1 (1 chip)"
+
+            created = post_json(f"{base}/api/namespaces/alice/notebooks", {
+                "name": "research", "image": cfg["images"][1],
+                "cpu": "2", "memory": "8Gi", "tpu": "2x2 (4 chips)",
+                "workspaceVolume": {"size": "20Gi"},
+            })
+            assert created["notebook"]["tpu"] == 4
+
+            # workspace PVC was created alongside
+            pvcs = get_json(f"{base}/api/namespaces/alice/pvcs")["pvcs"]
+            assert pvcs[0]["name"] == "workspace-research"
+            assert pvcs[0]["size"] == "20Gi"
+
+            # the controller picks the CR up and it becomes Ready
+            mgr.run_pending()
+            cluster.tick()
+            mgr.run_pending()
+            listed = get_json(
+                f"{base}/api/namespaces/alice/notebooks")["notebooks"]
+            assert listed[0]["status"] == "Running"
+
+            post_json(f"{base}/api/namespaces/alice/notebooks/research",
+                      {}, method="DELETE")
+            assert get_json(
+                f"{base}/api/namespaces/alice/notebooks")["notebooks"] == []
+            # cascade removed the statefulset too
+            assert cluster.get_or_none("apps/v1", "StatefulSet", "alice",
+                                       "research") is None
+        finally:
+            server.stop()
+
+    def test_duplicate_notebook_409(self, cluster):
+        server = JupyterWebApp(cluster)
+        port = server.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            post_json(f"{base}/api/namespaces/alice/notebooks",
+                      {"name": "nb1"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                post_json(f"{base}/api/namespaces/alice/notebooks",
+                          {"name": "nb1"})
+            assert e.value.code == 409
+        finally:
+            server.stop()
+
+    def test_manifest_builder_validation(self):
+        with pytest.raises(ApiError, match="name is required"):
+            build_notebook_manifest("alice", {})
+        with pytest.raises(ApiError, match="unknown TPU shape"):
+            build_notebook_manifest("alice", {"name": "x",
+                                              "tpu": "8x8 (64 chips)"})
+        m = build_notebook_manifest("alice", {
+            "name": "x", "dataVolumes": [{"name": "ds1", "path": "/ds"}]})
+        spec = m["spec"]["template"]["spec"]
+        assert spec["volumes"][0]["persistentVolumeClaim"][
+            "claimName"] == "ds1"
+        assert spec["containers"][0]["volumeMounts"][0]["mountPath"] == "/ds"
+
+    def test_unknown_route_404(self, cluster):
+        app = build_dashboard_app(cluster)
+        status, err = app.dispatch("GET", "/api/nope", None)
+        assert status == 404

@@ -1,0 +1,13 @@
+"""Host-side input pipeline (the PS-role host path, SURVEY.md §2.5 row 1).
+
+``RecordPipeline`` reads fixed-size records from shard files with seeded
+epoch shuffling and threaded prefetch — native C++ core when the toolchain
+is available (native/datapipe), pure-Python fallback otherwise. Both
+implementations produce IDENTICAL record order for a given seed.
+"""
+
+from .pipeline import PyRecordPipeline, RecordPipeline, epoch_order
+from .native import NativeRecordPipeline, native_available
+
+__all__ = ["RecordPipeline", "PyRecordPipeline", "NativeRecordPipeline",
+           "native_available", "epoch_order"]

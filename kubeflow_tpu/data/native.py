@@ -1,0 +1,146 @@
+"""ctypes binding to the native data-pipeline core (native/datapipe).
+
+Built lazily with the baked-in g++ (no pip; pybind11 unavailable by policy
+— ctypes over a C ABI instead). ``native_available()`` gates the fast path;
+everything degrades to the pure-Python pipeline when the toolchain or build
+is missing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libkfdatapipe.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR],
+                       check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        log.warning("native datapipe build failed (%s); using the "
+                    "pure-Python pipeline", e)
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not os.path.exists(_SO_PATH) and not _build():
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:
+            log.warning("cannot load %s: %s", _SO_PATH, e)
+            _build_failed = True
+            return None
+        lib.dp_create.restype = ctypes.c_void_p
+        lib.dp_create.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_uint64, ctypes.c_int32]
+        lib.dp_next.restype = ctypes.c_int32
+        lib.dp_next.argtypes = [ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_uint8),
+                                ctypes.c_int64]
+        lib.dp_reset.restype = None
+        lib.dp_reset.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.dp_total_records.restype = ctypes.c_int64
+        lib.dp_total_records.argtypes = [ctypes.c_void_p]
+        lib.dp_num_batches.restype = ctypes.c_int64
+        lib.dp_num_batches.argtypes = [ctypes.c_void_p]
+        lib.dp_last_error.restype = ctypes.c_char_p
+        lib.dp_last_error.argtypes = [ctypes.c_void_p]
+        lib.dp_destroy.restype = None
+        lib.dp_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class NativeRecordPipeline:
+    """Same contract as PyRecordPipeline, backed by the C++ core."""
+
+    def __init__(self, paths: Sequence[str], record_bytes: int,
+                 batch_records: int, *, queue_depth: int = 4, seed: int = 0,
+                 drop_remainder: bool = True, num_threads: int = 2):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native datapipe unavailable "
+                               "(use PyRecordPipeline)")
+        self._lib = lib
+        self.record_bytes = record_bytes
+        self.batch_records = batch_records
+        arr = (ctypes.c_char_p * len(paths))(
+            *[p.encode() for p in paths])
+        self._handle = lib.dp_create(
+            arr, len(paths), record_bytes, batch_records, queue_depth,
+            num_threads, seed & (2 ** 64 - 1), 1 if drop_remainder else 0)
+        if not self._handle:
+            raise RuntimeError(
+                f"dp_create failed for {list(paths)} "
+                f"(record_bytes={record_bytes})")
+        self.total_records = lib.dp_total_records(self._handle)
+
+    @property
+    def num_batches(self) -> int:
+        return self._lib.dp_num_batches(self._handle)
+
+    def reset(self, seed: int) -> None:
+        self._lib.dp_reset(self._handle, seed & (2 ** 64 - 1))
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        buf = np.empty((self.batch_records * self.record_bytes,), np.uint8)
+        ptr = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        while True:
+            n = self._lib.dp_next(self._handle, ptr, buf.nbytes)
+            if n == 0:
+                return
+            if n < 0:
+                err = self._lib.dp_last_error(self._handle)
+                raise RuntimeError(
+                    f"datapipe error: {(err or b'').decode()}")
+            yield buf[: n * self.record_bytes].reshape(
+                n, self.record_bytes).copy()
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.dp_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass

@@ -1,0 +1,309 @@
+// Host-side data pipeline core: sharded record reading with threaded
+// prefetch into a bounded ring of batch buffers.
+//
+// Why native: the TPU input pipeline is host-CPU work that competes with
+// nothing on the chip — the reference delegates it to the frameworks it
+// launches (tf.data inside tf_cnn_benchmarks; the PS role's host side,
+// SURVEY.md §2.5 row 1). Python-level file reading stalls the step loop on
+// the GIL at high batch rates; this core keeps N reader threads filling
+// fixed-size batch buffers while the trainer thread drains them via ctypes
+// (kubeflow_tpu/data/native.py).
+//
+// Model: records are fixed-size byte blobs packed back-to-back in files
+// ("record files"). An epoch = a seeded Fisher-Yates shuffle of the global
+// record index space, sharded round-robin across worker processes. Readers
+// claim batch slots, pread() their records, and publish; the consumer
+// blocks on the next sequential batch (batches are delivered in order so
+// training stays deterministic for a given seed).
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct FileSpan {
+  std::string path;
+  int fd = -1;
+  int64_t records = 0;   // record count in this file
+  int64_t first = 0;     // global index of this file's first record
+};
+
+// Slot lifecycle: FREE -> CLAIMED (producer filling) -> READY (published)
+// -> FREE (consumed). CLAIMED must be distinct from FREE: a producer for
+// round b+depth observing the round-b producer's claim as "free" would
+// steal the slot and deadlock the in-order consumer.
+enum SlotState : int8_t { kFree = 0, kClaimed = 1, kReady = 2 };
+
+struct Slot {
+  std::vector<uint8_t> buf;
+  int64_t batch_index = -1;   // which sequential batch last claimed the slot
+  int32_t records = 0;        // records actually filled (tail batch)
+  SlotState state = kFree;
+};
+
+}  // namespace
+
+struct dp_pipeline {
+  // config
+  int64_t record_bytes = 0;
+  int32_t batch_records = 0;
+  int32_t queue_depth = 0;
+  bool drop_remainder = true;
+
+  std::vector<FileSpan> files;
+  int64_t total_records = 0;
+
+  // epoch state
+  std::vector<int64_t> order;      // shuffled global record indices
+  int64_t num_batches = 0;
+
+  // ring
+  std::vector<Slot> slots;
+  std::atomic<int64_t> next_claim{0};   // next batch index to be claimed
+  int64_t next_deliver = 0;             // next batch index to hand out
+  std::mutex mu;
+  std::condition_variable cv_ready;     // consumer waits for its batch
+  std::condition_variable cv_free;      // producers wait for a free slot
+
+  std::vector<std::thread> threads;
+  std::atomic<bool> stop{false};
+  std::string error;
+  std::mutex err_mu;
+
+  ~dp_pipeline() { shutdown(); }
+
+  void set_error(const std::string& e) {
+    std::lock_guard<std::mutex> l(err_mu);
+    if (error.empty()) error = e;
+    cv_ready.notify_all();
+    cv_free.notify_all();
+  }
+
+  bool failed() {
+    std::lock_guard<std::mutex> l(err_mu);
+    return !error.empty();
+  }
+
+  // splitmix64 Fisher-Yates: bit-for-bit reproducible in the pure-Python
+  // fallback (data/pipeline.py epoch_order), unlike std::uniform_int_
+  // distribution whose mapping is implementation-defined
+  static uint64_t splitmix64(uint64_t* state) {
+    uint64_t z = (*state += 0x9E3779B97F4A7C15ULL);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+  }
+
+  void shuffle(uint64_t seed) {
+    order.resize(static_cast<size_t>(total_records));
+    for (int64_t i = 0; i < total_records; ++i) order[i] = i;
+    uint64_t state = seed;
+    for (int64_t i = total_records - 1; i > 0; --i) {
+      int64_t j = static_cast<int64_t>(
+          splitmix64(&state) % static_cast<uint64_t>(i + 1));
+      std::swap(order[i], order[j]);
+    }
+    num_batches = drop_remainder
+                      ? total_records / batch_records
+                      : (total_records + batch_records - 1) / batch_records;
+  }
+
+  // locate global record -> (file, offset) by linear scan over files
+  // (file count is small; records within a file are contiguous)
+  bool read_record(int64_t global_idx, uint8_t* dst) {
+    for (const auto& f : files) {
+      if (global_idx >= f.first && global_idx < f.first + f.records) {
+        int64_t off = (global_idx - f.first) * record_bytes;
+        int64_t done = 0;
+        while (done < record_bytes) {
+          ssize_t n = pread(f.fd, dst + done, record_bytes - done, off + done);
+          if (n <= 0) return false;
+          done += n;
+        }
+        return true;
+      }
+    }
+    return false;
+  }
+
+  void reader_loop() {
+    while (!stop.load()) {
+      int64_t b = next_claim.fetch_add(1);
+      if (b >= num_batches) return;
+      Slot* slot = nullptr;
+      {
+        // Wait until the slot's PREVIOUS round was consumed. The predicate
+        // must be exact (batch_index == b - depth), not `< b`: with both
+        // round-b and round-(b+depth) producers waiting, a `<` check would
+        // admit the later one while the earlier round is still unwritten,
+        // corrupting the slot and deadlocking the in-order consumer.
+        int64_t prev = b - static_cast<int64_t>(slots.size());
+        std::unique_lock<std::mutex> l(mu);
+        Slot& s = slots[b % slots.size()];
+        cv_free.wait(l, [&] {
+          return stop.load() || failed() ||
+                 (s.state == kFree &&
+                  s.batch_index == (prev < 0 ? -1 : prev));
+        });
+        if (stop.load() || failed()) return;
+        s.batch_index = b;
+        s.state = kClaimed;
+        slot = &s;
+      }
+      int64_t start = b * static_cast<int64_t>(batch_records);
+      int64_t end = std::min(start + batch_records, total_records);
+      int32_t n = static_cast<int32_t>(end - start);
+      for (int32_t i = 0; i < n; ++i) {
+        if (!read_record(order[static_cast<size_t>(start + i)],
+                         slot->buf.data() + static_cast<int64_t>(i) * record_bytes)) {
+          set_error("pread failed for record " +
+                    std::to_string(order[static_cast<size_t>(start + i)]));
+          return;
+        }
+      }
+      {
+        std::lock_guard<std::mutex> l(mu);
+        slot->records = n;
+        slot->state = kReady;
+      }
+      cv_ready.notify_all();
+    }
+  }
+
+  void start(int n_threads) {
+    stop.store(false);
+    next_claim.store(0);
+    next_deliver = 0;
+    for (auto& s : slots) {
+      s.state = kFree;
+      s.batch_index = -1;
+      s.records = 0;
+    }
+    for (int i = 0; i < n_threads; ++i)
+      threads.emplace_back([this] { reader_loop(); });
+  }
+
+  void shutdown() {
+    stop.store(true);
+    cv_ready.notify_all();
+    cv_free.notify_all();
+    for (auto& t : threads)
+      if (t.joinable()) t.join();
+    threads.clear();
+  }
+};
+
+extern "C" {
+
+dp_pipeline* dp_create(const char** paths, int32_t n_paths,
+                       int64_t record_bytes, int32_t batch_records,
+                       int32_t queue_depth, int32_t n_threads,
+                       uint64_t seed, int32_t drop_remainder) {
+  if (record_bytes <= 0 || batch_records <= 0 || n_paths <= 0) return nullptr;
+  auto* p = new dp_pipeline();
+  p->record_bytes = record_bytes;
+  p->batch_records = batch_records;
+  p->queue_depth = queue_depth < 2 ? 2 : queue_depth;
+  p->drop_remainder = drop_remainder != 0;
+
+  int64_t cursor = 0;
+  for (int32_t i = 0; i < n_paths; ++i) {
+    FileSpan f;
+    f.path = paths[i];
+    f.fd = open(f.path.c_str(), O_RDONLY);
+    struct stat st;
+    if (f.fd < 0 || fstat(f.fd, &st) != 0) {
+      p->set_error("cannot open " + f.path);
+      delete p;
+      return nullptr;
+    }
+    f.records = st.st_size / record_bytes;
+    f.first = cursor;
+    cursor += f.records;
+    p->files.push_back(f);
+  }
+  p->total_records = cursor;
+  p->shuffle(seed);
+
+  p->slots.resize(static_cast<size_t>(p->queue_depth));
+  for (auto& s : p->slots)
+    s.buf.resize(static_cast<size_t>(record_bytes) * batch_records);
+
+  int threads = n_threads < 1 ? 1 : n_threads;
+  p->start(threads);
+  return p;
+}
+
+// Blocks until the next in-order batch is ready and copies it to out.
+// Returns records copied (0 = epoch done, -1 = error).
+int32_t dp_next(dp_pipeline* p, uint8_t* out, int64_t out_bytes) {
+  if (p == nullptr) return -1;
+  if (p->next_deliver >= p->num_batches) return 0;
+  int64_t want = p->next_deliver;
+  Slot& s = p->slots[want % p->slots.size()];
+  std::unique_lock<std::mutex> l(p->mu);
+  p->cv_ready.wait(l, [&] {
+    return p->stop.load() || p->failed() ||
+           (s.state == kReady && s.batch_index == want);
+  });
+  if (p->stop.load() || p->failed()) return -1;
+  int64_t bytes = static_cast<int64_t>(s.records) * p->record_bytes;
+  if (bytes > out_bytes) return -1;
+  std::memcpy(out, s.buf.data(), static_cast<size_t>(bytes));
+  int32_t n = s.records;
+  s.state = kFree;           // slot free for batch want + queue_depth
+  p->next_deliver = want + 1;
+  l.unlock();
+  p->cv_free.notify_all();
+  return n;
+}
+
+// Start a new epoch with a fresh shuffle (blocks until readers quiesce).
+void dp_reset(dp_pipeline* p, uint64_t seed) {
+  if (p == nullptr) return;
+  int n_threads = static_cast<int>(p->threads.size());
+  p->shutdown();
+  {
+    std::lock_guard<std::mutex> l(p->err_mu);
+    p->error.clear();
+  }
+  p->shuffle(seed);
+  p->start(n_threads == 0 ? 1 : n_threads);
+}
+
+int64_t dp_total_records(dp_pipeline* p) {
+  return p == nullptr ? -1 : p->total_records;
+}
+
+int64_t dp_num_batches(dp_pipeline* p) {
+  return p == nullptr ? -1 : p->num_batches;
+}
+
+const char* dp_last_error(dp_pipeline* p) {
+  if (p == nullptr) return "null pipeline";
+  std::lock_guard<std::mutex> l(p->err_mu);
+  return p->error.c_str();
+}
+
+void dp_destroy(dp_pipeline* p) {
+  if (p == nullptr) return;
+  p->shutdown();  // join readers BEFORE closing their fds
+  for (auto& f : p->files)
+    if (f.fd >= 0) close(f.fd);
+  delete p;
+}
+
+}  // extern "C"

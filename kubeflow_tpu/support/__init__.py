@@ -1,0 +1,13 @@
+"""Support services (SURVEY.md §2.7 small components).
+
+- ``metric_collector``: availability prober exporting the
+  ``kubeflow_availability`` Prometheus gauge (metric-collector/
+  service-readiness/kubeflow-readiness.py:20-37).
+- ``spartakus``: opt-out anonymous usage reporter
+  (kubeflow/common/spartakus.libsonnet:75; opt-out warning
+  coordinator.go:166-190).
+- ``echo_server``: minimal HTTP echo app, the CI routing target
+  (components/echo-server/main.py).
+- ``https_redirect``: plain→TLS redirect shim
+  (components/https-redirect/main.py).
+"""

@@ -1,0 +1,55 @@
+"""Echo server: the minimal routing-verification target.
+
+The reference's components/echo-server/main.py (deployed by
+kubeflow/common/echo-server.libsonnet) exists so CI can verify
+ingress/Ambassador routes end-to-end; the response echoes the request so
+path-rewrite and header behavior is observable.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class EchoServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _echo(self, body: bytes = b""):
+                payload = json.dumps({
+                    "method": self.command,
+                    "path": self.path,
+                    "headers": dict(self.headers.items()),
+                    "body": body.decode("utf-8", "replace"),
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._echo()
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                self._echo(self.rfile.read(length) if length else b"")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="echo-server")
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,42 @@
+"""HTTP→HTTPS redirect shim (components/https-redirect/main.py analog)."""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class RedirectServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 target_host: Optional[str] = None):
+        fixed_host = target_host
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                host = fixed_host or \
+                    (self.headers.get("Host") or "localhost").split(":")[0]
+                self.send_response(301)
+                self.send_header("Location", f"https://{host}{self.path}")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            do_POST = do_GET
+            do_HEAD = do_GET
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="https-redirect")
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

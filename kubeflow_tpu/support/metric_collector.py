@@ -1,0 +1,120 @@
+"""Availability prober: periodic endpoint checks → Prometheus gauge.
+
+The reference's metric-collector probes the IAP-protected kubeflow
+endpoint with an OIDC token and exports ``kubeflow_availability``
+(metric-collector/service-readiness/kubeflow-readiness.py:20-37, deployed
+by kubeflow/gcp/prototypes/metric-collector.jsonnet). Here the prober is
+auth-agnostic (optional header provider) and the exposition is the
+standard Prometheus text format on /metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+GAUGE_NAME = "kubeflow_availability"
+PROBE_COUNT = "kubeflow_availability_probe_total"
+
+
+class AvailabilityProber:
+    def __init__(self, url: str, timeout_s: float = 10.0,
+                 header_provider: Optional[Callable[[], dict]] = None,
+                 fetch: Optional[Callable[[str, dict, float], int]] = None):
+        self.url = url
+        self.timeout_s = timeout_s
+        self.header_provider = header_provider or (lambda: {})
+        self._fetch = fetch or self._http_fetch
+        self._lock = threading.Lock()
+        self.available = 0
+        self.probes = 0
+        self.failures = 0
+        self.last_error: Optional[str] = None
+
+    @staticmethod
+    def _http_fetch(url: str, headers: dict, timeout_s: float) -> int:
+        req = urllib.request.Request(url, headers=headers)
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.status
+
+    def probe(self) -> bool:
+        """One availability check; updates the gauge. The prober never
+        raises — unreachable IS the signal it exists to record."""
+        ok = False
+        err: Optional[str] = None
+        try:
+            status = self._fetch(self.url, self.header_provider(),
+                                 self.timeout_s)
+            ok = 200 <= status < 400
+            if not ok:
+                err = f"status {status}"
+        except Exception as e:  # noqa: BLE001 - outage is data, not a crash
+            err = str(e)
+        with self._lock:
+            self.probes += 1
+            self.available = 1 if ok else 0
+            if not ok:
+                self.failures += 1
+                self.last_error = err
+        return ok
+
+    def metrics_text(self) -> str:
+        with self._lock:
+            return (
+                f"# HELP {GAUGE_NAME} 1 if the kubeflow endpoint is up\n"
+                f"# TYPE {GAUGE_NAME} gauge\n"
+                f"{GAUGE_NAME} {self.available}\n"
+                f"# TYPE {PROBE_COUNT} counter\n"
+                f"{PROBE_COUNT} {self.probes}\n"
+                f"{PROBE_COUNT.replace('_total', '_failures_total')} "
+                f"{self.failures}\n")
+
+    def run_forever(self, interval_s: float = 30.0,
+                    stop: Optional[threading.Event] = None) -> None:
+        stop = stop or threading.Event()
+        while not stop.is_set():
+            self.probe()
+            stop.wait(interval_s)
+
+
+class MetricsServer:
+    """Serves the prober's /metrics (prometheus scrape target)."""
+
+    def __init__(self, prober: AvailabilityProber, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.prober = prober
+        prober_ref = prober
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path != "/metrics":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = prober_ref.metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="metric-collector")
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,98 @@
+"""Anonymous usage reporter (the spartakus analog), strictly opt-out.
+
+The reference deploys spartakus-volunteer with a random cluster id and
+prints an opt-out warning at init (kubeflow/common/spartakus.libsonnet:75;
+coordinator.go:166-190 sets the usageId param and logs how to disable).
+Same contract here: anonymized facts only (counts and versions, never
+names), a persisted random usage id, and reporting disabled by either the
+``KF_DISABLE_USAGE_REPORT`` env or ``enabled=False``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import urllib.request
+from typing import Callable, Optional
+
+from ..api import k8s
+from ..cluster.client import KubeClient
+
+log = logging.getLogger(__name__)
+
+DISABLE_ENV = "KF_DISABLE_USAGE_REPORT"
+
+OPT_OUT_WARNING = (
+    "Usage reporting is enabled: anonymized cluster facts (component "
+    "counts, TPU topology, versions — never names or data) are reported "
+    "to improve the project. Disable with %s=1 or "
+    "spartakus.enabled=false in the KfDef." % DISABLE_ENV)
+
+
+def collect_facts(client: KubeClient, usage_id: int) -> dict:
+    """Anonymized cluster facts: shapes and counts, no identifiers."""
+    nodes = client.list("v1", "Node")
+    tpu_chips = 0
+    topologies: dict[str, int] = {}
+    for n in nodes:
+        alloc = n.get("status", {}).get("allocatable", {}) or {}
+        tpu_chips += int(k8s.parse_quantity(alloc.get("google.com/tpu", 0)))
+        topo = k8s.labels_of(n).get("cloud.google.com/gke-tpu-topology")
+        if topo:
+            topologies[topo] = topologies.get(topo, 0) + 1
+    return {
+        "usageId": usage_id,
+        "nodes": len(nodes),
+        "tpuChips": tpu_chips,
+        "tpuTopologies": topologies,
+        "namespaces": len(client.list("v1", "Namespace")),
+        "trainingJobs": sum(
+            len(client.list(av, kind))
+            for av, kind in (("tpu.kubeflow.org/v1alpha1", "TPUJob"),
+                             ("kubeflow.org/v1beta2", "TFJob"),
+                             ("kubeflow.org/v1beta2", "PyTorchJob"),
+                             ("kubeflow.org/v1alpha1", "MPIJob"))),
+        "notebooks": len(client.list("kubeflow.org/v1alpha1", "Notebook")),
+    }
+
+
+class UsageReporter:
+    def __init__(self, client: KubeClient, *, enabled: bool = True,
+                 usage_id: Optional[int] = None,
+                 sink: Optional[Callable[[dict], None]] = None,
+                 report_url: Optional[str] = None):
+        env_disabled = os.environ.get(DISABLE_ENV, "") not in ("", "0",
+                                                               "false")
+        self.enabled = enabled and not env_disabled
+        self.client = client
+        # random id like the reference's usageId param (coordinator.go)
+        self.usage_id = usage_id if usage_id is not None else \
+            random.SystemRandom().randint(1, 2 ** 31 - 1)
+        self.report_url = report_url
+        self.sink = sink or self._http_sink
+        if self.enabled:
+            log.warning(OPT_OUT_WARNING)
+        else:
+            log.info("usage reporting disabled")
+
+    def _http_sink(self, payload: dict) -> None:
+        if not self.report_url:
+            return
+        req = urllib.request.Request(
+            self.report_url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=10).close()
+
+    def report_once(self) -> Optional[dict]:
+        """Collect + send one report; returns the payload (None when
+        disabled). Reporting failures are logged, never raised."""
+        if not self.enabled:
+            return None
+        payload = collect_facts(self.client, self.usage_id)
+        try:
+            self.sink(payload)
+        except Exception as e:  # noqa: BLE001 - telemetry must not break
+            log.warning("usage report failed: %s", e)
+        return payload

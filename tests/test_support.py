@@ -1,0 +1,134 @@
+"""Support-component tests (SURVEY.md §2.7): metric-collector, spartakus,
+echo-server, https-redirect."""
+
+import json
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.cluster import FakeCluster
+from kubeflow_tpu.support.echo_server import EchoServer
+from kubeflow_tpu.support.https_redirect import RedirectServer
+from kubeflow_tpu.support.metric_collector import (AvailabilityProber,
+                                                   MetricsServer)
+from kubeflow_tpu.support.spartakus import (DISABLE_ENV, UsageReporter,
+                                            collect_facts)
+
+
+class TestMetricCollector:
+    def test_probe_updates_gauge(self):
+        statuses = [200, 503, 200]
+        calls = []
+
+        def fetch(url, headers, timeout):
+            calls.append(headers)
+            return statuses[len(calls) - 1]
+
+        prober = AvailabilityProber(
+            "http://kf.example/healthz", fetch=fetch,
+            header_provider=lambda: {"Authorization": "Bearer tok"})
+        assert prober.probe() is True
+        assert prober.available == 1
+        assert prober.probe() is False
+        assert prober.available == 0
+        assert prober.failures == 1
+        assert prober.probe() is True
+        assert calls[0]["Authorization"] == "Bearer tok"
+
+    def test_unreachable_endpoint_is_recorded_not_raised(self):
+        def fetch(url, headers, timeout):
+            raise OSError("connection refused")
+
+        prober = AvailabilityProber("http://down.example", fetch=fetch)
+        assert prober.probe() is False
+        assert "connection refused" in prober.last_error
+
+    def test_metrics_endpoint_prometheus_format(self):
+        prober = AvailabilityProber("http://x", fetch=lambda *a: 200)
+        prober.probe()
+        server = MetricsServer(prober)
+        port = server.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics") as r:
+                text = r.read().decode()
+            assert "kubeflow_availability 1" in text
+            assert "# TYPE kubeflow_availability gauge" in text
+        finally:
+            server.stop()
+
+
+class TestSpartakus:
+    @pytest.fixture
+    def cluster(self):
+        c = FakeCluster()
+        c.add_tpu_slice_nodes("v5e-8")
+        c.create({"apiVersion": "v1", "kind": "Namespace",
+                  "metadata": {"name": "alice"}})
+        return c
+
+    def test_facts_are_anonymized_counts(self, cluster):
+        facts = collect_facts(cluster, usage_id=42)
+        assert facts["usageId"] == 42
+        assert facts["nodes"] == 2
+        assert facts["tpuChips"] == 8
+        assert facts["tpuTopologies"] == {"v5e-8": 2}
+        # nothing resembling a name leaves the cluster
+        assert "alice" not in json.dumps(facts)
+
+    def test_report_once_uses_sink(self, cluster):
+        sent = []
+        reporter = UsageReporter(cluster, sink=sent.append, usage_id=7)
+        payload = reporter.report_once()
+        assert sent == [payload]
+        assert payload["usageId"] == 7
+
+    def test_env_opt_out(self, cluster, monkeypatch):
+        monkeypatch.setenv(DISABLE_ENV, "1")
+        reporter = UsageReporter(cluster, sink=lambda p: 1 / 0)
+        assert not reporter.enabled
+        assert reporter.report_once() is None
+
+    def test_sink_failure_never_raises(self, cluster):
+        def bad_sink(p):
+            raise OSError("no route")
+
+        reporter = UsageReporter(cluster, sink=bad_sink)
+        assert reporter.report_once() is not None
+
+
+class TestEchoAndRedirect:
+    def test_echo_roundtrip(self):
+        server = EchoServer()
+        port = server.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/some/path?q=1",
+                data=b"hello", headers={"X-Test": "v"})
+            with urllib.request.urlopen(req) as r:
+                body = json.loads(r.read())
+            assert body["method"] == "POST"
+            assert body["path"] == "/some/path?q=1"
+            assert body["body"] == "hello"
+            assert body["headers"]["X-Test"] == "v"
+        finally:
+            server.stop()
+
+    def test_redirect_preserves_path(self):
+        server = RedirectServer(target_host="kubeflow.example.com")
+        port = server.start()
+        try:
+            class NoRedirect(urllib.request.HTTPRedirectHandler):
+                def redirect_request(self, *a, **k):
+                    return None
+
+            opener = urllib.request.build_opener(NoRedirect)
+            try:
+                opener.open(f"http://127.0.0.1:{port}/a/b?x=1")
+                raise AssertionError("expected redirect error")
+            except urllib.error.HTTPError as e:
+                assert e.code == 301
+                assert e.headers["Location"] == \
+                    "https://kubeflow.example.com/a/b?x=1"
+        finally:
+            server.stop()

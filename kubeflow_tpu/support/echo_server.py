@@ -9,12 +9,12 @@ path-rewrite and header behavior is observable.
 from __future__ import annotations
 
 import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from http.server import BaseHTTPRequestHandler
+
+from ..webapps._http import ThreadedServer
 
 
-class EchoServer:
+class EchoServer(ThreadedServer):
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):
@@ -40,16 +40,4 @@ class EchoServer:
                 length = int(self.headers.get("Content-Length", 0))
                 self._echo(self.rfile.read(length) if length else b"")
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread: Optional[threading.Thread] = None
-
-    def start(self) -> int:
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True, name="echo-server")
-        self._thread.start()
-        return self.port
-
-    def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        super().__init__(Handler, host=host, port=port, name="echo-server")

@@ -2,12 +2,22 @@
 
 from __future__ import annotations
 
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Optional
 
+from ..webapps._http import ThreadedServer
 
-class RedirectServer:
+
+def strip_port(host_header: str) -> str:
+    """Host header without the port; IPv6 literals ([::1]:8080) keep
+    their brackets intact."""
+    if host_header.startswith("["):
+        return host_header.split("]")[0] + "]"
+    return host_header.rsplit(":", 1)[0] if ":" in host_header \
+        else host_header
+
+
+class RedirectServer(ThreadedServer):
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  target_host: Optional[str] = None):
         fixed_host = target_host
@@ -18,7 +28,7 @@ class RedirectServer:
 
             def do_GET(self):
                 host = fixed_host or \
-                    (self.headers.get("Host") or "localhost").split(":")[0]
+                    strip_port(self.headers.get("Host") or "localhost")
                 self.send_response(301)
                 self.send_header("Location", f"https://{host}{self.path}")
                 self.send_header("Content-Length", "0")
@@ -27,16 +37,5 @@ class RedirectServer:
             do_POST = do_GET
             do_HEAD = do_GET
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread: Optional[threading.Thread] = None
-
-    def start(self) -> int:
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True, name="https-redirect")
-        self._thread.start()
-        return self.port
-
-    def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        super().__init__(Handler, host=host, port=port,
+                         name="https-redirect")

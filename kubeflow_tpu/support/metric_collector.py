@@ -11,11 +11,11 @@ standard Prometheus text format on /metrics.
 from __future__ import annotations
 
 import threading
-import time
-import urllib.error
 import urllib.request
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Callable, Optional
+
+from ..webapps._http import ThreadedServer
 
 GAUGE_NAME = "kubeflow_availability"
 PROBE_COUNT = "kubeflow_availability_probe_total"
@@ -81,14 +81,11 @@ class AvailabilityProber:
             stop.wait(interval_s)
 
 
-class MetricsServer:
+class MetricsServer(ThreadedServer):
     """Serves the prober's /metrics (prometheus scrape target)."""
 
     def __init__(self, prober: AvailabilityProber, host: str = "127.0.0.1",
                  port: int = 0):
-        self.prober = prober
-        prober_ref = prober
-
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):
                 pass
@@ -98,23 +95,12 @@ class MetricsServer:
                     self.send_response(404)
                     self.end_headers()
                     return
-                body = prober_ref.metrics_text().encode()
+                body = prober.metrics_text().encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread: Optional[threading.Thread] = None
-
-    def start(self) -> int:
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True, name="metric-collector")
-        self._thread.start()
-        return self.port
-
-    def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        super().__init__(Handler, host=host, port=port,
+                         name="metric-collector")

@@ -72,10 +72,13 @@ class UsageReporter:
             random.SystemRandom().randint(1, 2 ** 31 - 1)
         self.report_url = report_url
         self.sink = sink or self._http_sink
-        if self.enabled:
-            log.warning(OPT_OUT_WARNING)
-        else:
+        if not self.enabled:
             log.info("usage reporting disabled")
+        elif sink is None and not report_url:
+            log.warning("usage reporting enabled but no report_url/sink "
+                        "configured — reports will be dropped")
+        else:
+            log.warning(OPT_OUT_WARNING)
 
     def _http_sink(self, payload: dict) -> None:
         if not self.report_url:
@@ -90,9 +93,10 @@ class UsageReporter:
         disabled). Reporting failures are logged, never raised."""
         if not self.enabled:
             return None
-        payload = collect_facts(self.client, self.usage_id)
         try:
+            payload = collect_facts(self.client, self.usage_id)
             self.sink(payload)
         except Exception as e:  # noqa: BLE001 - telemetry must not break
             log.warning("usage report failed: %s", e)
+            return None
         return payload

@@ -58,12 +58,15 @@ class ApiError(Exception):
         self.status = status
 
 
-class JsonServer:
-    def __init__(self, app: JsonApp, host: str = "127.0.0.1", port: int = 0,
+class ThreadedServer:
+    """Shared HTTP server lifecycle: construct with a handler class, start
+    a daemon serve thread, stop with shutdown+close. Every HTTP-serving
+    component builds on this so lifecycle fixes land in one place."""
+
+    def __init__(self, handler_cls, host: str = "127.0.0.1", port: int = 0,
                  name: str = "webapp"):
-        self.app = app
         self.name = name
-        self._httpd = ThreadingHTTPServer((host, port), _make_handler(app))
+        self._httpd = ThreadingHTTPServer((host, port), handler_cls)
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
@@ -76,6 +79,13 @@ class JsonServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+
+
+class JsonServer(ThreadedServer):
+    def __init__(self, app: JsonApp, host: str = "127.0.0.1", port: int = 0,
+                 name: str = "webapp"):
+        self.app = app
+        super().__init__(_make_handler(app), host=host, port=port, name=name)
 
 
 def _make_handler(app: JsonApp):

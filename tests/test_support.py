@@ -94,7 +94,7 @@ class TestSpartakus:
             raise OSError("no route")
 
         reporter = UsageReporter(cluster, sink=bad_sink)
-        assert reporter.report_once() is not None
+        assert reporter.report_once() is None  # logged, not raised
 
 
 class TestEchoAndRedirect:

@@ -144,7 +144,10 @@ def train(
             batch = builder.place_batch(spec.batch_fn(brng, global_batch))
             mlog.start_step()
             state, metrics = step_fn(state, batch)
-            jax.block_until_ready(metrics["loss"])
+            # hard sync via host fetch: block_until_ready is not a reliable
+            # barrier on tunneled platforms (see bench.py), and the step
+            # timer needs a true end-of-step
+            metrics = {k: float(v) for k, v in metrics.items()}
             stats = mlog.end_step(step + 1, metrics)
             last_metrics = {k: float(v) for k, v in metrics.items()}
             if ckpt is not None:

@@ -1,0 +1,221 @@
+"""Bootstrap REST service: deploy-as-a-service over the coordinator.
+
+The reference's bootstrap server (bootstrap/cmd/bootstrap/app/
+ksServer.go:156 NewServer; routes :1462-1470 — /kfctl/apps/create,
+/kfctl/apps/apply, /kfctl/e2eDeploy — plus a Prometheus /metrics) backs
+the click-to-deploy UI and the in-cluster bootstrapper. Same surface here
+as a thin HTTP layer over Coordinator, with deploy counters in Prometheus
+text form and per-app serialization (concurrent deploys of the SAME app
+are rejected 409 the way the reference's per-app mutex serializes them).
+
+Routes:
+  POST /kfctl/apps/create   {name, platform?, components?, params?}
+  POST /kfctl/apps/apply    {name}
+  POST /kfctl/e2eDeploy     {name, ...}        (create + generate + apply)
+  POST /kfctl/apps/delete   {name}
+  GET  /kfctl/apps                              (list + conditions)
+  GET  /kfctl/apps/{name}                       (show)
+  GET  /metrics
+  GET  /healthz
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+from ..webapps._http import ApiError, JsonApp, JsonServer, RawResponse
+from .coordinator import Coordinator
+
+log = logging.getLogger(__name__)
+
+
+class _Counters:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.deploys = 0
+        self.failures = 0
+
+    def inc(self, failed: bool) -> None:
+        with self._lock:
+            self.deploys += 1
+            if failed:
+                self.failures += 1
+
+    def text(self) -> str:
+        with self._lock:
+            return ("# TYPE kubeflow_bootstrap_deploys_total counter\n"
+                    f"kubeflow_bootstrap_deploys_total {self.deploys}\n"
+                    "# TYPE kubeflow_bootstrap_deploy_failures_total counter\n"
+                    f"kubeflow_bootstrap_deploy_failures_total "
+                    f"{self.failures}\n")
+
+
+class BootstrapService:
+    """App registry rooted at ``apps_root``; one directory per app."""
+
+    def __init__(self, apps_root: str):
+        self.apps_root = os.path.abspath(apps_root)
+        os.makedirs(self.apps_root, exist_ok=True)
+        self.counters = _Counters()
+        self._busy: set[str] = set()
+        self._lock = threading.Lock()
+
+    def _app_dir(self, name: str) -> str:
+        if not name or "/" in name or name.startswith("."):
+            raise ApiError(400, f"invalid app name {name!r}")
+        return os.path.join(self.apps_root, name)
+
+    def _acquire(self, name: str) -> None:
+        with self._lock:
+            if name in self._busy:
+                raise ApiError(409, f"app {name} has an operation in "
+                                    f"progress")
+            self._busy.add(name)
+
+    def _release(self, name: str) -> None:
+        with self._lock:
+            self._busy.discard(name)
+
+    # -- operations ---------------------------------------------------------
+
+    def create(self, body: dict) -> dict:
+        name = body.get("name", "")
+        app_dir = self._app_dir(name)
+        spec_kwargs = {}
+        for key in ("platform", "components", "namespace"):
+            if body.get(key) is not None:
+                spec_kwargs[key] = body[key]
+        if body.get("params"):
+            spec_kwargs["component_params"] = body["params"]
+        self._acquire(name)
+        try:
+            # existence check under the busy lock: checked before it, two
+            # racing creates could both pass and the loser would silently
+            # re-initialize (and reset) the winner's app
+            if os.path.exists(os.path.join(app_dir, "app.yaml")):
+                raise ApiError(409, f"app {name} already exists")
+            coord = Coordinator.new(app_dir, **spec_kwargs)
+            coord.init()
+            coord.generate()
+        finally:
+            self._release(name)
+        return coord.show()
+
+    def apply(self, name: str) -> dict:
+        app_dir = self._app_dir(name)
+        if not os.path.exists(os.path.join(app_dir, "app.yaml")):
+            raise ApiError(404, f"app {name} not found")
+        self._acquire(name)
+        try:
+            coord = Coordinator.load(app_dir)
+            try:
+                outcome = coord.apply()
+            except Exception:
+                # hard failures must still count — the failure counter
+                # exists precisely for the prober watching /metrics
+                self.counters.inc(failed=True)
+                raise
+            self.counters.inc(failed=bool(outcome.failed))
+            return {"applied": outcome.applied,
+                    "failed": outcome.failed, **coord.show()}
+        finally:
+            self._release(name)
+
+    def e2e_deploy(self, body: dict) -> dict:
+        """create + generate + apply in one call (the /kfctl/e2eDeploy
+        path click-to-deploy uses, ksServer.go deployHandler). Idempotent
+        on the create half so a failed deploy can be retried."""
+        name = body.get("name", "")
+        if not os.path.exists(os.path.join(self._app_dir(name), "app.yaml")):
+            self.create(body)
+        return self.apply(name)
+
+    def delete(self, name: str) -> dict:
+        """Tear down and REMOVE the app dir: a deleted name must be
+        re-creatable through the API (the CLI keeps the dir; a service has
+        no other way to free the name)."""
+        app_dir = self._app_dir(name)
+        if not os.path.exists(os.path.join(app_dir, "app.yaml")):
+            raise ApiError(404, f"app {name} not found")
+        self._acquire(name)
+        try:
+            Coordinator.load(app_dir).delete()
+            import shutil
+            shutil.rmtree(app_dir, ignore_errors=True)
+        finally:
+            self._release(name)
+        return {"deleted": name}
+
+    def list_apps(self) -> list[dict]:
+        out = []
+        for entry in sorted(os.listdir(self.apps_root)):
+            if os.path.exists(os.path.join(self.apps_root, entry,
+                                           "app.yaml")):
+                try:
+                    out.append(Coordinator.load(
+                        os.path.join(self.apps_root, entry)).show())
+                except Exception as e:  # noqa: BLE001 - listing is best-effort
+                    out.append({"name": entry, "error": str(e)})
+        return out
+
+    def show(self, name: str) -> dict:
+        app_dir = self._app_dir(name)
+        if not os.path.exists(os.path.join(app_dir, "app.yaml")):
+            raise ApiError(404, f"app {name} not found")
+        return Coordinator.load(app_dir).show()
+
+
+def build_bootstrap_app(service: BootstrapService) -> JsonApp:
+    app = JsonApp()
+
+    @app.route("GET", "/healthz")
+    def healthz(params, query, body):
+        return 200, {"ok": True}
+
+    @app.route("GET", "/metrics")
+    def metrics(params, query, body):
+        return 200, RawResponse(service.counters.text())
+
+    @app.route("POST", "/kfctl/apps/create")
+    def create(params, query, body):
+        if not body or not body.get("name"):
+            raise ApiError(400, "name is required")
+        return 200, service.create(body)
+
+    @app.route("POST", "/kfctl/apps/apply")
+    def apply(params, query, body):
+        if not body or not body.get("name"):
+            raise ApiError(400, "name is required")
+        return 200, service.apply(body["name"])
+
+    @app.route("POST", "/kfctl/e2eDeploy")
+    def e2e(params, query, body):
+        if not body or not body.get("name"):
+            raise ApiError(400, "name is required")
+        return 200, service.e2e_deploy(body)
+
+    @app.route("POST", "/kfctl/apps/delete")
+    def delete(params, query, body):
+        if not body or not body.get("name"):
+            raise ApiError(400, "name is required")
+        return 200, service.delete(body["name"])
+
+    @app.route("GET", "/kfctl/apps")
+    def list_apps(params, query, body):
+        return 200, {"apps": service.list_apps()}
+
+    @app.route("GET", "/kfctl/apps/{name}")
+    def show(params, query, body):
+        return 200, service.show(params["name"])
+
+    return app
+
+
+class BootstrapServer(JsonServer):
+    def __init__(self, apps_root: str, **kw):
+        self.service = BootstrapService(apps_root)
+        super().__init__(build_bootstrap_app(self.service), name="bootstrap",
+                         **kw)

@@ -149,7 +149,7 @@ def train(
             # timer needs a true end-of-step
             metrics = {k: float(v) for k, v in metrics.items()}
             stats = mlog.end_step(step + 1, metrics)
-            last_metrics = {k: float(v) for k, v in metrics.items()}
+            last_metrics = metrics
             if ckpt is not None:
                 ckpt.save(step + 1, state)
     if ckpt is not None:

@@ -58,6 +58,15 @@ class ApiError(Exception):
         self.status = status
 
 
+class RawResponse:
+    """Non-JSON payload (e.g. Prometheus text exposition)."""
+
+    def __init__(self, body: str,
+                 content_type: str = "text/plain; version=0.0.4"):
+        self.body = body
+        self.content_type = content_type
+
+
 class ThreadedServer:
     """Shared HTTP server lifecycle: construct with a handler class, start
     a daemon serve thread, stop with shutdown+close. Every HTTP-serving
@@ -106,9 +115,14 @@ def _make_handler(app: JsonApp):
             self._respond(status, payload)
 
         def _respond(self, status: int, payload: Any):
-            data = json.dumps(payload).encode()
+            if isinstance(payload, RawResponse):
+                data = payload.body.encode()
+                ctype = payload.content_type
+            else:
+                data = json.dumps(payload).encode()
+                ctype = "application/json"
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)

@@ -1,0 +1,106 @@
+"""Bootstrap REST service tests: the deploy-as-a-service surface
+(ksServer.go routes /kfctl/apps/create, /kfctl/apps/apply, /kfctl/e2eDeploy,
+/metrics — the reference exercised this with testing/test_deploy_app.py
+as a periodic prober; here it's direct HTTP coverage)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.kfctl.bootstrap_server import BootstrapServer
+
+
+def post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def get(url, raw=False):
+    with urllib.request.urlopen(url) as r:
+        data = r.read()
+        return data.decode() if raw else json.loads(data)
+
+
+@pytest.fixture
+def server(tmp_path):
+    s = BootstrapServer(str(tmp_path / "apps"))
+    s.start()
+    yield s, f"http://127.0.0.1:{s.port}"
+    s.stop()
+
+
+class TestBootstrapServer:
+    def test_e2e_deploy_flow(self, server):
+        _, base = server
+        result = post(f"{base}/kfctl/e2eDeploy",
+                      {"name": "kf-prod",
+                       "components": ["tpu-job-operator", "tpu-serving"]})
+        assert result["applied"] > 0
+        assert result["failed"] == []
+        assert "Available=True" in result["conditions"]
+
+        apps = get(f"{base}/kfctl/apps")["apps"]
+        assert [a["name"] for a in apps] == ["kf-prod"]
+        shown = get(f"{base}/kfctl/apps/kf-prod")
+        assert shown["components"]["tpu-job-operator"] > 0
+
+        metrics = get(f"{base}/metrics", raw=True)
+        assert "kubeflow_bootstrap_deploys_total 1" in metrics
+        assert "deploy_failures_total 0" in metrics
+
+    def test_create_then_apply_separately(self, server):
+        _, base = server
+        created = post(f"{base}/kfctl/apps/create",
+                       {"name": "kf2", "components": ["echo-server"]})
+        assert "Generated=True" in created["conditions"]
+        applied = post(f"{base}/kfctl/apps/apply", {"name": "kf2"})
+        assert applied["applied"] > 0
+
+    def test_duplicate_create_409(self, server):
+        _, base = server
+        post(f"{base}/kfctl/apps/create",
+             {"name": "kf3", "components": ["echo-server"]})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post(f"{base}/kfctl/apps/create",
+                 {"name": "kf3", "components": ["echo-server"]})
+        assert e.value.code == 409
+
+    def test_apply_unknown_app_404(self, server):
+        _, base = server
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post(f"{base}/kfctl/apps/apply", {"name": "ghost"})
+        assert e.value.code == 404
+
+    def test_invalid_name_400(self, server):
+        _, base = server
+        for bad in ("../escape", "", "a/b"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                post(f"{base}/kfctl/apps/create", {"name": bad})
+            assert e.value.code == 400
+
+    def test_delete_frees_the_name(self, server):
+        _, base = server
+        post(f"{base}/kfctl/e2eDeploy",
+             {"name": "kf4", "components": ["echo-server"]})
+        result = post(f"{base}/kfctl/apps/delete", {"name": "kf4"})
+        assert result["deleted"] == "kf4"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(f"{base}/kfctl/apps/kf4")
+        assert e.value.code == 404
+        # the name is reusable — a service has no other way to free it
+        again = post(f"{base}/kfctl/e2eDeploy",
+                     {"name": "kf4", "components": ["echo-server"]})
+        assert again["applied"] > 0
+
+    def test_e2e_deploy_is_retryable(self, server):
+        _, base = server
+        post(f"{base}/kfctl/apps/create",
+             {"name": "kf5", "components": ["echo-server"]})
+        # a repeated e2eDeploy of an existing app applies instead of 409ing
+        result = post(f"{base}/kfctl/e2eDeploy", {"name": "kf5"})
+        assert result["applied"] > 0

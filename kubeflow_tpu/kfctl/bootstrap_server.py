@@ -90,6 +90,12 @@ class BootstrapService:
                 spec_kwargs[key] = body[key]
         if body.get("params"):
             spec_kwargs["component_params"] = body["params"]
+        # unknown components are a 400 before anything touches disk
+        from ..manifests.registry import REGISTRY
+        for comp in spec_kwargs.get("components") or []:
+            if comp not in REGISTRY:
+                raise ApiError(400, f"unknown component {comp!r}; see "
+                                    f"GET /kfctl/components")
         self._acquire(name)
         try:
             # existence check under the busy lock: checked before it, two
@@ -97,19 +103,31 @@ class BootstrapService:
             # re-initialize (and reset) the winner's app
             if os.path.exists(os.path.join(app_dir, "app.yaml")):
                 raise ApiError(409, f"app {name} already exists")
-            coord = Coordinator.new(app_dir, **spec_kwargs)
-            coord.init()
-            coord.generate()
+            try:
+                coord = Coordinator.new(app_dir, **spec_kwargs)
+                coord.init()
+                coord.generate()
+            except ApiError:
+                raise
+            except Exception:
+                # transactional create: a half-initialized app dir would
+                # wedge the name at 409 forever and make a retried
+                # e2eDeploy "succeed" while deploying nothing
+                import shutil
+                shutil.rmtree(app_dir, ignore_errors=True)
+                raise
         finally:
             self._release(name)
         return coord.show()
 
     def apply(self, name: str) -> dict:
         app_dir = self._app_dir(name)
-        if not os.path.exists(os.path.join(app_dir, "app.yaml")):
-            raise ApiError(404, f"app {name} not found")
         self._acquire(name)
         try:
+            # existence check + load under the lock: a racing delete must
+            # yield a clean 404, not a raw FileNotFoundError 500
+            if not os.path.exists(os.path.join(app_dir, "app.yaml")):
+                raise ApiError(404, f"app {name} not found")
             coord = Coordinator.load(app_dir)
             try:
                 outcome = coord.apply()
@@ -127,10 +145,15 @@ class BootstrapService:
     def e2e_deploy(self, body: dict) -> dict:
         """create + generate + apply in one call (the /kfctl/e2eDeploy
         path click-to-deploy uses, ksServer.go deployHandler). Idempotent
-        on the create half so a failed deploy can be retried."""
+        on the create half so a failed deploy can be retried; create-phase
+        failures count as failed deploys in /metrics."""
         name = body.get("name", "")
         if not os.path.exists(os.path.join(self._app_dir(name), "app.yaml")):
-            self.create(body)
+            try:
+                self.create(body)
+            except Exception:
+                self.counters.inc(failed=True)
+                raise
         return self.apply(name)
 
     def delete(self, name: str) -> dict:

@@ -42,6 +42,12 @@ class ModelServer:
         self._batchers_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # experiment routers (A/B, bandit, shadow — serving/router.py)
+        self.routers: dict[str, "object"] = {}
+
+    def add_router(self, routed) -> None:
+        """Mount a RoutedModel at /v1/routers/<name>."""
+        self.routers[routed.name] = routed
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -128,32 +134,68 @@ def _make_handler(server: ModelServer):
                         200, server.repository.get(rest).status())
                 except KeyError as e:
                     return self._error(404, str(e))
+            if path.startswith("/v1/routers/"):
+                name = path[len("/v1/routers/"):]
+                routed = server.routers.get(name)
+                if routed is None:
+                    return self._error(404, f"router {name!r} not found")
+                return self._send(200, routed.status())
             self._error(404, f"no route {path}")
+
+        def _read_body(self) -> dict:
+            length = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(length))
+
+        def _parse_instances(self, req: dict) -> np.ndarray:
+            if "instances" not in req:
+                raise ValueError("missing 'instances' in request")
+            instances = np.asarray(req["instances"])
+            if "dtype" in req:
+                instances = instances.astype(req["dtype"])
+            return instances
+
+        def _run_predict(self, predict, req: dict):
+            """Shared predict body: parse instances, run, serialize —
+            one implementation for model and router endpoints."""
+            out = predict(self._parse_instances(req))
+            predictions = {
+                k: np.asarray(v).tolist() for k, v in out.items()
+            } if isinstance(out, dict) else np.asarray(out).tolist()
+            self._send(200, {"predictions": predictions})
 
         def do_POST(self):
             if ":" not in self.path:
                 return self._error(404, "expected /v1/models/<name>:predict")
             route, verb = self.path.rsplit(":", 1)
+            if route.startswith("/v1/routers/"):
+                return self._router_post(route[len("/v1/routers/"):], verb)
             if not route.startswith("/v1/models/") or verb != "predict":
                 return self._error(404, f"no route {self.path}")
             name = route[len("/v1/models/"):]
             try:
-                length = int(self.headers.get("Content-Length", 0))
-                req = json.loads(self.rfile.read(length))
-                if "instances" not in req:
-                    return self._error(400, "missing 'instances' in request")
-                instances = np.asarray(req["instances"])
-                if "dtype" in req:
-                    instances = instances.astype(req["dtype"])
+                req = self._read_body()
                 try:
                     batcher = server.batcher(name)
                 except KeyError as e:  # unknown model only → 404
                     return self._error(404, str(e))
-                out = batcher.predict(instances)
-                predictions = {
-                    k: np.asarray(v).tolist() for k, v in out.items()
-                } if isinstance(out, dict) else np.asarray(out).tolist()
-                self._send(200, {"predictions": predictions})
+                self._run_predict(batcher.predict, req)
+            except Exception as e:  # noqa: BLE001 — surface to client
+                self._error(400, f"{type(e).__name__}: {e}")
+
+        def _router_post(self, name: str, verb: str):
+            """/v1/routers/<name>:predict and :feedback (the seldon
+            /send-feedback analog)."""
+            routed = server.routers.get(name)
+            if routed is None:
+                return self._error(404, f"router {name!r} not found")
+            try:
+                req = self._read_body()
+                if verb == "feedback":
+                    routed.record_feedback(req["arm"], float(req["reward"]))
+                    return self._send(200, routed.status())
+                if verb != "predict":
+                    return self._error(404, f"unknown verb {verb!r}")
+                self._run_predict(routed.predict, req)
             except Exception as e:  # noqa: BLE001 — surface to client
                 self._error(400, f"{type(e).__name__}: {e}")
 

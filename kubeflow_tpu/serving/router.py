@@ -1,0 +1,191 @@
+"""Experiment routing: A/B splits, shadow traffic, and bandit routing.
+
+The reference ships these as Seldon prototypes — abtest (random traffic
+split), mab / epsilon-greedy multi-armed bandit, and outlier detection
+mixins (kubeflow/seldon/*, SURVEY.md §2.3 "Alt serving stacks"). Here they
+are routers in front of Servables: a router picks the backend per request,
+records outcomes, and exposes per-arm stats. Used standalone or mounted on
+the ModelServer as a virtual model ("router:<name>" predicts via its
+chosen arm).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass
+class ArmStats:
+    name: str
+    requests: int = 0        # routing decisions
+    reward_sum: float = 0.0  # accumulated reward signal
+    reward_count: int = 0    # reward observations (implicit or feedback)
+    failures: int = 0
+
+    @property
+    def mean_reward(self) -> float:
+        return self.reward_sum / self.reward_count if self.reward_count \
+            else 0.0
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "requests": self.requests,
+                "meanReward": round(self.mean_reward, 6),
+                "rewardCount": self.reward_count,
+                "failures": self.failures}
+
+
+class Router:
+    """Base: pick an arm (model name) per request, record outcomes."""
+
+    def __init__(self, arms: list[str], seed: Optional[int] = None):
+        if not arms:
+            raise ValueError("router needs at least one arm")
+        self.arms = list(arms)
+        self.rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.stats = {a: ArmStats(a) for a in self.arms}
+
+    def route(self) -> str:
+        raise NotImplementedError
+
+    def record_request(self, arm: str, failed: bool = False) -> None:
+        """One routing decision served (or failed) by the arm."""
+        with self._lock:
+            s = self.stats[arm]
+            s.requests += 1
+            if failed:
+                s.failures += 1
+
+    def record_reward(self, arm: str, reward: float) -> None:
+        """One reward observation — implicit (serving outcome) or
+        explicit feedback. Deliberately does NOT count a request, so a
+        :feedback call can't double-count traffic."""
+        with self._lock:
+            s = self.stats[arm]
+            s.reward_sum += reward
+            s.reward_count += 1
+
+    def record(self, arm: str, reward: float = 0.0,
+               failed: bool = False) -> None:
+        """Convenience: one request + its reward in one call."""
+        self.record_request(arm, failed=failed)
+        self.record_reward(arm, reward)
+
+    def stats_dict(self) -> list[dict]:
+        with self._lock:
+            return [self.stats[a].to_dict() for a in self.arms]
+
+
+class ABTestRouter(Router):
+    """Random split by traffic weights (the seldon abtest prototype:
+    ``traffic`` percentage between two predictors; generalized to N)."""
+
+    def __init__(self, arms: list[str],
+                 weights: Optional[list[float]] = None,
+                 seed: Optional[int] = None):
+        super().__init__(arms, seed)
+        if weights is None:
+            weights = [1.0] * len(arms)
+        if len(weights) != len(arms) or any(w < 0 for w in weights) or \
+                sum(weights) <= 0:
+            raise ValueError(f"bad weights {weights} for arms {arms}")
+        total = sum(weights)
+        self.weights = [w / total for w in weights]
+
+    def route(self) -> str:
+        r = self.rng.random()
+        acc = 0.0
+        for arm, w in zip(self.arms, self.weights):
+            acc += w
+            if r < acc:
+                return arm
+        return self.arms[-1]
+
+
+class EpsilonGreedyRouter(Router):
+    """Multi-armed bandit (the seldon mab prototype): explore with
+    probability epsilon, otherwise exploit the best mean reward."""
+
+    def __init__(self, arms: list[str], epsilon: float = 0.1,
+                 seed: Optional[int] = None):
+        super().__init__(arms, seed)
+        if not 0.0 <= epsilon <= 1.0:
+            raise ValueError(f"epsilon must be in [0,1], got {epsilon}")
+        self.epsilon = epsilon
+
+    def route(self) -> str:
+        with self._lock:
+            unexplored = [a for a in self.arms
+                          if self.stats[a].requests == 0]
+            if unexplored:
+                return self.rng.choice(unexplored)
+            if self.rng.random() < self.epsilon:
+                return self.rng.choice(self.arms)
+            return max(self.arms, key=lambda a: self.stats[a].mean_reward)
+
+
+class ShadowRouter(Router):
+    """All traffic to the primary; the shadow arm receives a copy whose
+    result is discarded (the canary-validation pattern)."""
+
+    def __init__(self, primary: str, shadow: str,
+                 seed: Optional[int] = None):
+        super().__init__([primary, shadow], seed)
+        self.primary = primary
+        self.shadow = shadow
+
+    def route(self) -> str:
+        return self.primary
+
+
+@dataclass
+class RoutedModel:
+    """A router mounted over a ModelRepository: predict() routes to the
+    chosen arm's servable. With ``implicit_reward`` (default) the serving
+    outcome is the reward signal (success=1, failure=0); experiments with
+    task-level feedback set it False and send rewards via
+    ``record_feedback`` (the seldon /send-feedback analog) so availability
+    doesn't pollute the quality signal."""
+
+    router: Router
+    repository: object  # ModelRepository (duck-typed to avoid the import)
+    name: str = "router"
+    implicit_reward: bool = True
+
+    def predict(self, instances: np.ndarray):
+        arm = self.router.route()
+        try:
+            result = self.repository.get(arm).predict(instances)
+        except Exception:
+            self.router.record_request(arm, failed=True)
+            if self.implicit_reward:
+                self.router.record_reward(arm, 0.0)
+            raise
+        self.router.record_request(arm)
+        if self.implicit_reward:
+            self.router.record_reward(arm, 1.0)
+        if isinstance(self.router, ShadowRouter):
+            shadow = self.router.shadow
+            try:
+                self.repository.get(shadow).predict(instances)
+                self.router.record_request(shadow)
+                if self.implicit_reward:
+                    self.router.record_reward(shadow, 1.0)
+            except Exception:  # noqa: BLE001 - shadow must never break serving
+                self.router.record_request(shadow, failed=True)
+                if self.implicit_reward:
+                    self.router.record_reward(shadow, 0.0)
+        return result
+
+    def record_feedback(self, arm: str, reward: float) -> None:
+        self.router.record_reward(arm, reward)
+
+    def status(self) -> dict:
+        return {"name": self.name,
+                "routerType": type(self.router).__name__,
+                "arms": self.router.stats_dict()}

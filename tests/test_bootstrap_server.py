@@ -97,6 +97,19 @@ class TestBootstrapServer:
                      {"name": "kf4", "components": ["echo-server"]})
         assert again["applied"] > 0
 
+    def test_unknown_component_400_and_name_not_wedged(self, server):
+        _, base = server
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post(f"{base}/kfctl/e2eDeploy",
+                 {"name": "kf6", "components": ["not-a-component"]})
+        assert e.value.code == 400
+        # failed create counted as a failed deploy, and the name is free
+        metrics = get(f"{base}/metrics", raw=True)
+        assert "deploy_failures_total 1" in metrics
+        ok = post(f"{base}/kfctl/e2eDeploy",
+                  {"name": "kf6", "components": ["echo-server"]})
+        assert ok["applied"] > 0
+
     def test_e2e_deploy_is_retryable(self, server):
         _, base = server
         post(f"{base}/kfctl/apps/create",

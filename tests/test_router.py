@@ -1,0 +1,134 @@
+"""Experiment-routing tests: A/B split, epsilon-greedy bandit, shadow
+traffic (the seldon abtest/mab/shadow prototypes, SURVEY.md §2.3)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.router import (ABTestRouter, EpsilonGreedyRouter,
+                                         RoutedModel, Router, ShadowRouter)
+
+
+class TestABTest:
+    def test_split_follows_weights(self):
+        r = ABTestRouter(["a", "b"], weights=[0.8, 0.2], seed=1)
+        picks = [r.route() for _ in range(5000)]
+        frac_a = picks.count("a") / len(picks)
+        assert 0.75 < frac_a < 0.85
+
+    def test_bad_weights_rejected(self):
+        with pytest.raises(ValueError):
+            ABTestRouter(["a", "b"], weights=[1.0])
+        with pytest.raises(ValueError):
+            ABTestRouter(["a", "b"], weights=[-1, 2])
+        with pytest.raises(ValueError):
+            ABTestRouter([])
+
+
+class TestEpsilonGreedy:
+    def test_explores_then_exploits_best_arm(self):
+        r = EpsilonGreedyRouter(["bad", "good"], epsilon=0.1, seed=3)
+        # reward model: good=0.9, bad=0.1
+        for _ in range(300):
+            arm = r.route()
+            r.record(arm, reward=0.9 if arm == "good" else 0.1)
+        stats = {s["name"]: s for s in r.stats_dict()}
+        assert stats["good"]["requests"] > stats["bad"]["requests"] * 3
+        assert stats["good"]["meanReward"] == pytest.approx(0.9)
+
+    def test_unexplored_arms_tried_first(self):
+        r = EpsilonGreedyRouter(["a", "b", "c"], epsilon=0.0, seed=0)
+        first3 = set()
+        for _ in range(3):
+            arm = r.route()
+            first3.add(arm)
+            r.record(arm, reward=1.0)
+        assert first3 == {"a", "b", "c"}
+
+
+class FakeRepo:
+    def __init__(self, outputs, fail=()):
+        self.outputs = outputs
+        self.fail = set(fail)
+        self.calls = []
+
+    def get(self, name):
+        repo = self
+
+        class S:
+            def predict(self, x):
+                repo.calls.append(name)
+                if name in repo.fail:
+                    raise RuntimeError(f"{name} down")
+                return np.full(len(x), repo.outputs[name])
+
+        return S()
+
+
+class TestRoutedModel:
+    def test_shadow_gets_copy_result_from_primary(self):
+        repo = FakeRepo({"prod": 1.0, "canary": 2.0})
+        routed = RoutedModel(ShadowRouter("prod", "canary"), repo)
+        out = routed.predict(np.zeros(4))
+        assert (out == 1.0).all()  # primary's answer
+        assert repo.calls == ["prod", "canary"]  # shadow got the copy
+
+    def test_shadow_failure_never_breaks_serving(self):
+        repo = FakeRepo({"prod": 1.0, "canary": 2.0}, fail={"canary"})
+        routed = RoutedModel(ShadowRouter("prod", "canary"), repo)
+        out = routed.predict(np.zeros(2))
+        assert (out == 1.0).all()
+        stats = {s["name"]: s for s in routed.router.stats_dict()}
+        assert stats["canary"]["failures"] == 1
+
+    def test_primary_failure_recorded_and_raised(self):
+        repo = FakeRepo({"a": 1.0}, fail={"a"})
+        routed = RoutedModel(Router(["a"]), repo)
+        routed.router.route = lambda: "a"
+        with pytest.raises(RuntimeError):
+            routed.predict(np.zeros(2))
+        assert routed.router.stats_dict()[0]["failures"] == 1
+
+
+class TestRouterHTTP:
+    def test_router_predict_and_feedback_over_http(self):
+        from kubeflow_tpu.serving.http_server import ModelServer
+        from kubeflow_tpu.serving.servable import ModelRepository, Servable
+        import jax.numpy as jnp
+
+        repo = ModelRepository()
+        for name, scale in (("m1", 2.0), ("m2", 3.0)):
+            repo.add(Servable(
+                name=name, predict_fn=lambda p, x, s=scale: x * s,
+                params={}, input_signature=((None, 2), jnp.float32)))
+        server = ModelServer(repository=repo, host="127.0.0.1", port=0)
+        routed = RoutedModel(ABTestRouter(["m1", "m2"], seed=5), repo,
+                             name="exp1")
+        server.add_router(routed)
+        port = server.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            req = urllib.request.Request(
+                f"{base}/v1/routers/exp1:predict",
+                data=json.dumps({"instances": [[1.0, 1.0]]}).encode())
+            with urllib.request.urlopen(req) as r:
+                preds = json.loads(r.read())["predictions"]
+            assert preds[0][0] in (2.0, 3.0)
+
+            req = urllib.request.Request(
+                f"{base}/v1/routers/exp1:feedback",
+                data=json.dumps({"arm": "m1", "reward": 0.7}).encode())
+            with urllib.request.urlopen(req) as r:
+                status = json.loads(r.read())
+            arms = {a["name"]: a for a in status["arms"]}
+            # feedback adds a reward observation but NOT a request — a
+            # :feedback call must never double-count traffic
+            assert arms["m1"]["rewardCount"] >= 1
+
+            with urllib.request.urlopen(f"{base}/v1/routers/exp1") as r:
+                status = json.loads(r.read())
+            assert status["routerType"] == "ABTestRouter"
+        finally:
+            server.stop()

@@ -151,6 +151,12 @@ class BootstrapService:
         if not os.path.exists(os.path.join(self._app_dir(name), "app.yaml")):
             try:
                 self.create(body)
+            except ApiError as e:
+                if e.status != 409:
+                    self.counters.inc(failed=True)
+                    raise
+                # a racing e2eDeploy created it first — idempotent: fall
+                # through to apply
             except Exception:
                 self.counters.inc(failed=True)
                 raise
@@ -201,6 +207,11 @@ def build_bootstrap_app(service: BootstrapService) -> JsonApp:
     @app.route("GET", "/metrics")
     def metrics(params, query, body):
         return 200, RawResponse(service.counters.text())
+
+    @app.route("GET", "/kfctl/components")
+    def components(params, query, body):
+        from ..manifests.registry import component_names
+        return 200, {"components": component_names()}
 
     @app.route("POST", "/kfctl/apps/create")
     def create(params, query, body):

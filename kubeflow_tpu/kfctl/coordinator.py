@@ -205,6 +205,18 @@ def register_verbs(sub: argparse._SubParsersAction) -> None:
     p_comp = sub.add_parser("components", help="list installable components")
     p_comp.set_defaults(func=_cmd_components)
 
+    p_compl = sub.add_parser("completion",
+                             help="print bash completion script")
+    p_compl.set_defaults(func=_cmd_completion)
+
+    p_boot = sub.add_parser(
+        "serve-bootstrap",
+        help="run the deploy-as-a-service REST server (ksServer analog)")
+    p_boot.add_argument("--apps-root", default="./apps")
+    p_boot.add_argument("--host", default="127.0.0.1")
+    p_boot.add_argument("--port", type=int, default=8085)
+    p_boot.set_defaults(func=_cmd_serve_bootstrap)
+
 
 def _cmd_init(args) -> int:
     kwargs = dict(platform=args.platform, project=args.project,
@@ -244,6 +256,34 @@ def _cmd_delete(args) -> int:
 def _cmd_show(args) -> int:
     coord = Coordinator.load(args.app_dir)
     print(json.dumps(coord.show(), indent=2))
+    return 0
+
+
+def _cmd_completion(args) -> int:
+    # the cobra-generated completion of the reference, reduced to verbs
+    print("""\
+_kfctl_complete() {
+  local verbs="init generate apply delete show components version \\
+completion serve-bootstrap"
+  COMPREPLY=($(compgen -W "$verbs" -- "${COMP_WORDS[COMP_CWORD]}"))
+}
+complete -F _kfctl_complete kfctl""")
+    return 0
+
+
+def _cmd_serve_bootstrap(args) -> int:
+    import time as _time
+
+    from .bootstrap_server import BootstrapServer
+    server = BootstrapServer(args.apps_root, host=args.host, port=args.port)
+    port = server.start()
+    print(f"bootstrap service listening on {args.host}:{port} "
+          f"(apps under {args.apps_root})")
+    try:
+        while True:
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
     return 0
 
 

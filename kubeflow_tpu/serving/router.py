@@ -156,31 +156,60 @@ class RoutedModel:
     repository: object  # ModelRepository (duck-typed to avoid the import)
     name: str = "router"
     implicit_reward: bool = True
+    # override to route arms through a shared execution path (the model
+    # server sets this to its MicroBatcher so routed and direct traffic
+    # batch together); default is the servable's raw predict
+    predict_resolver: Optional[object] = None
+    # shadow copies run here so shadow latency (e.g. a cold JIT compile)
+    # never adds to the primary response — seldon mirrored-traffic
+    # semantics. Failures and stats are recorded from the worker thread.
+    _shadow_pool: object = field(default=None, repr=False)
+
+    def _arm_predict(self, arm: str):
+        if self.predict_resolver is not None:
+            return self.predict_resolver(arm)
+        return self.repository.get(arm).predict
+
+    def _record(self, arm: str, ok: bool) -> None:
+        self.router.record_request(arm, failed=not ok)
+        if self.implicit_reward:
+            self.router.record_reward(arm, 1.0 if ok else 0.0)
 
     def predict(self, instances: np.ndarray):
         arm = self.router.route()
         try:
-            result = self.repository.get(arm).predict(instances)
+            result = self._arm_predict(arm)(instances)
         except Exception:
-            self.router.record_request(arm, failed=True)
-            if self.implicit_reward:
-                self.router.record_reward(arm, 0.0)
+            self._record(arm, ok=False)
             raise
-        self.router.record_request(arm)
-        if self.implicit_reward:
-            self.router.record_reward(arm, 1.0)
+        self._record(arm, ok=True)
         if isinstance(self.router, ShadowRouter):
-            shadow = self.router.shadow
-            try:
-                self.repository.get(shadow).predict(instances)
-                self.router.record_request(shadow)
-                if self.implicit_reward:
-                    self.router.record_reward(shadow, 1.0)
-            except Exception:  # noqa: BLE001 - shadow must never break serving
-                self.router.record_request(shadow, failed=True)
-                if self.implicit_reward:
-                    self.router.record_reward(shadow, 0.0)
+            self._shadow_submit(self.router.shadow, instances)
         return result
+
+    def _shadow_submit(self, shadow: str, instances: np.ndarray) -> None:
+        if self._shadow_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            object.__setattr__(self, "_shadow_pool",
+                               ThreadPoolExecutor(max_workers=1,
+                                                  thread_name_prefix="shadow"))
+
+        def run():
+            try:
+                self._arm_predict(shadow)(instances)
+                self._record(shadow, ok=True)
+            except Exception:  # noqa: BLE001 - shadow must never break serving
+                self._record(shadow, ok=False)
+
+        self._shadow_pool.submit(run)
+
+    def drain_shadow(self, timeout: float = 10.0) -> None:
+        """Wait for in-flight shadow copies (tests / shutdown)."""
+        if self._shadow_pool is not None:
+            from concurrent.futures import ThreadPoolExecutor
+            pool: ThreadPoolExecutor = self._shadow_pool
+            pool.shutdown(wait=True)
+            object.__setattr__(self, "_shadow_pool", None)
 
     def record_feedback(self, arm: str, reward: float) -> None:
         self.router.record_reward(arm, reward)

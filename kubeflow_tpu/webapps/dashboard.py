@@ -22,9 +22,45 @@ from typing import Optional
 
 from ..api import k8s
 from ..cluster.client import KubeClient
-from ._http import ApiError, JsonApp, JsonServer
+from ._http import ApiError, JsonApp, JsonServer, RawResponse
 
 METRIC_TYPES = ("podcpu", "podmem", "node")
+
+# The SPA shell (the Polymer frontend analog, API-first): one static page
+# that renders the dashboard's own API. Other apps embed via links the way
+# the reference used iframes.
+INDEX_HTML = """<!doctype html>
+<html><head><title>Kubeflow TPU</title><style>
+body{font-family:sans-serif;margin:2rem;max-width:60rem}
+table{border-collapse:collapse;margin:0.5rem 0 1.5rem}
+td,th{border:1px solid #ccc;padding:0.3rem 0.8rem;text-align:left}
+h2{margin-top:1.5rem}</style></head><body>
+<h1>Kubeflow TPU dashboard</h1>
+<h2>TPU slices</h2><table id="slices"></table>
+<h2>Namespaces</h2><table id="namespaces"></table>
+<h2>Nodes</h2><table id="nodes"></table>
+<script>
+function esc(v) {  // values come from cluster objects: escape before HTML
+  return String(v).replace(/[&<>"']/g,
+    ch => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[ch]));
+}
+async function fill(id, rows, cols) {
+  const t = document.getElementById(id);
+  t.innerHTML = "<tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("")
+    + "</tr>" +
+    rows.map(r => "<tr>" + cols.map(c => `<td>${esc(r[c] ?? r)}</td>`)
+             .join("") + "</tr>").join("");
+}
+(async () => {
+  const slices = await (await fetch("api/tpu/slices")).json();
+  fill("slices", slices, ["topology", "accelerator", "hosts", "chips",
+                          "ready"]);
+  const ns = await (await fetch("api/namespaces")).json();
+  fill("namespaces", ns.map(n => ({name: n})), ["name"]);
+  const nodes = await (await fetch("api/metrics/node")).json();
+  fill("nodes", nodes, ["node", "value"]);
+})();
+</script></body></html>"""
 
 
 class MetricsService:
@@ -84,6 +120,11 @@ def build_dashboard_app(client: KubeClient,
     @app.route("GET", "/healthz")
     def healthz(params, query, body):
         return 200, {"ok": True}
+
+    @app.route("GET", "/")
+    def index(params, query, body):
+        return 200, RawResponse(INDEX_HTML,
+                                content_type="text/html; charset=utf-8")
 
     @app.route("GET", "/api/namespaces")
     def namespaces(params, query, body):

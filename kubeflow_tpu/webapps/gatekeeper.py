@@ -32,6 +32,18 @@ PASSWORD_ENV = "KUBEFLOW_PASSWORD"
 COOKIE_NAME = "kubeflow-session"
 SESSION_TTL_S = 12 * 3600  # 12h, AuthServer.go expiry
 
+# the kflogin page analog (components/kflogin React app → one form)
+LOGIN_HTML = """<!doctype html>
+<html><head><title>Kubeflow login</title><style>
+body{font-family:sans-serif;display:flex;justify-content:center;
+margin-top:15vh}form{display:flex;flex-direction:column;gap:0.6rem;
+min-width:18rem}input{padding:0.5rem}button{padding:0.6rem}</style>
+</head><body><form method="post" action="/login">
+<h2>Kubeflow TPU</h2>
+<input name="username" placeholder="username" autofocus>
+<input name="password" type="password" placeholder="password">
+<button type="submit">Log in</button></form></body></html>"""
+
 
 class SessionStore:
     def __init__(self, ttl_s: float = SESSION_TTL_S, clock=time.time):
@@ -162,6 +174,10 @@ def _make_handler(gate: Gatekeeper):
         def do_GET(self):
             if self.path == "/healthz":
                 return self._send(200, b"ok")
+            if self.path in ("/", "/login"):
+                return self._send(200, LOGIN_HTML.encode(),
+                                  {"Content-Type":
+                                   "text/html; charset=utf-8"})
             if self.path.startswith("/auth"):
                 if gate.authorized(_cookie_token(self),
                                    self.headers.get("Authorization")):

@@ -350,6 +350,16 @@ class TestGatekeeper:
         finally:
             server.stop()
 
+    def test_login_page_served(self):
+        server = GatekeeperServer(Gatekeeper(username="u", password="p"))
+        port = server.start()
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/") as r:
+                assert r.headers["Content-Type"].startswith("text/html")
+                assert b'action="/login"' in r.read()
+        finally:
+            server.stop()
+
     def test_bad_login_rejected(self):
         server = GatekeeperServer(Gatekeeper(username="u", password="p"))
         port = server.start()

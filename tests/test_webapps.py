@@ -62,6 +62,18 @@ class TestDashboard:
         finally:
             server.stop()
 
+    def test_index_page_served(self, cluster):
+        server = DashboardServer(cluster)
+        port = server.start()
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/") as r:
+                assert r.headers["Content-Type"].startswith("text/html")
+                html = r.read().decode()
+            assert "Kubeflow TPU dashboard" in html
+            assert "api/tpu/slices" in html
+        finally:
+            server.stop()
+
     def test_activities_sorted_newest_first(self, cluster):
         for i, ts in enumerate(["2026-01-01", "2026-03-01", "2026-02-01"]):
             cluster.create({

@@ -167,10 +167,12 @@ class BootstrapService:
         re-creatable through the API (the CLI keeps the dir; a service has
         no other way to free the name)."""
         app_dir = self._app_dir(name)
-        if not os.path.exists(os.path.join(app_dir, "app.yaml")):
-            raise ApiError(404, f"app {name} not found")
         self._acquire(name)
         try:
+            # existence check under the busy flag (like apply): a racing
+            # delete/apply otherwise hits Coordinator.load on a removed dir
+            if not os.path.exists(os.path.join(app_dir, "app.yaml")):
+                raise ApiError(404, f"app {name} not found")
             Coordinator.load(app_dir).delete()
             import shutil
             shutil.rmtree(app_dir, ignore_errors=True)

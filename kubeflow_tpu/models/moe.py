@@ -76,6 +76,8 @@ class MoEMLP(nn.Module):
     def __call__(self, x):
         B, S, M = x.shape
         E, K = self.num_experts, self.top_k
+        if not 1 <= K <= E:
+            raise ValueError(f"top_k={K} must be in [1, num_experts={E}]")
         capacity = max(1, int(math.ceil(K * S * self.capacity_factor / E)))
 
         # router in float32: small matmul, numerically load-bearing
@@ -111,9 +113,13 @@ class MoEMLP(nn.Module):
                 gate[..., None, None] * oh.astype(jnp.float32)[..., None]
                 * cap_oh[:, :, None, :])                       # [B,S,E,C]
         combine = sum(dispatch_layers)                         # gated
-        # renormalize so surviving gates sum to 1 per token
-        denom = jnp.where(combine_gate_sum > 0, combine_gate_sum, 1.0)
-        combine = combine / denom[..., None, None]
+        if K > 1:
+            # renormalize so surviving gates sum to 1 per token; for K == 1
+            # keep the raw router probability (Switch semantics) — a
+            # renormalized top-1 gate is constant 1.0 and passes the router
+            # zero gradient from the task loss
+            denom = jnp.where(combine_gate_sum > 0, combine_gate_sum, 1.0)
+            combine = combine / denom[..., None, None]
         dispatch = (combine > 0).astype(self.dtype)            # [B,S,E,C]
         combine = combine.astype(self.dtype)
 

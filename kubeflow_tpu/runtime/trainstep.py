@@ -151,17 +151,37 @@ class TrainStepBuilder:
 
 
 def _optimizer_shardings(opt_state, params, param_shardings, rep):
-    """Shard optimizer moments like their matching params; scalars replicate."""
-    flat_params = jax.tree.leaves(params)
-    flat_shardings = jax.tree.leaves(param_shardings)
-    shape_to_sharding = {}
-    for p, s in zip(flat_params, flat_shardings):
-        shape_to_sharding.setdefault(getattr(p, "shape", None), s)
+    """Walk opt_state structurally: any subtree that mirrors the param tree
+    (same treedef AND same leaf shapes — adam mu/nu do) takes the params'
+    shardings wholesale; everything else (counts, scalars) replicates.
 
-    def pick(leaf):
-        return shape_to_sharding.get(getattr(leaf, "shape", None), rep)
+    Structural, not shape-keyed: two same-shape params with different
+    shardings each keep their own sharding in the moments."""
+    pdef = jax.tree.structure(params)
+    pshapes = [getattr(l, "shape", None) for l in jax.tree.leaves(params)]
 
-    return jax.tree.map(pick, opt_state)
+    def mirrors(node):
+        try:
+            if jax.tree.structure(node) != pdef:
+                return False
+        except TypeError:
+            return False
+        return [getattr(l, "shape", None)
+                for l in jax.tree.leaves(node)] == pshapes
+
+    def rec(node):
+        if mirrors(node):
+            return param_shardings
+        if isinstance(node, (list, tuple)):
+            new = [rec(c) for c in node]
+            if hasattr(node, "_fields"):  # namedtuple (optax states)
+                return type(node)(*new)
+            return type(node)(new)
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        return rep
+
+    return rec(opt_state)
 
 
 jax.tree_util.register_dataclass(

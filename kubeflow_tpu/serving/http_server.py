@@ -46,7 +46,13 @@ class ModelServer:
         self.routers: dict[str, "object"] = {}
 
     def add_router(self, routed) -> None:
-        """Mount a RoutedModel at /v1/routers/<name>."""
+        """Mount a RoutedModel at /v1/routers/<name>; when it serves this
+        server's repository, its arms resolve through the server's
+        MicroBatchers so routed and direct traffic batch together. A
+        caller-set resolver or foreign repository is left alone."""
+        if routed.predict_resolver is None and \
+                routed.repository is self.repository:
+            routed.predict_resolver = lambda arm: self.batcher(arm).predict
         self.routers[routed.name] = routed
 
     # -- lifecycle ----------------------------------------------------------

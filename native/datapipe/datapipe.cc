@@ -83,7 +83,11 @@ struct dp_pipeline {
   std::string error;
   std::mutex err_mu;
 
-  ~dp_pipeline() { shutdown(); }
+  ~dp_pipeline() {
+    shutdown();  // join readers BEFORE closing their fds
+    for (auto& f : files)
+      if (f.fd >= 0) close(f.fd);
+  }
 
   void set_error(const std::string& e) {
     std::lock_guard<std::mutex> l(err_mu);
@@ -227,7 +231,8 @@ dp_pipeline* dp_create(const char** paths, int32_t n_paths,
     struct stat st;
     if (f.fd < 0 || fstat(f.fd, &st) != 0) {
       p->set_error("cannot open " + f.path);
-      delete p;
+      if (f.fd >= 0) close(f.fd);  // not yet owned by p->files
+      delete p;                    // dtor closes earlier files' fds
       return nullptr;
     }
     f.records = st.st_size / record_bytes;
@@ -299,11 +304,7 @@ const char* dp_last_error(dp_pipeline* p) {
 }
 
 void dp_destroy(dp_pipeline* p) {
-  if (p == nullptr) return;
-  p->shutdown();  // join readers BEFORE closing their fds
-  for (auto& f : p->files)
-    if (f.fd >= 0) close(f.fd);
-  delete p;
+  delete p;  // dtor joins readers, then closes fds
 }
 
 }  // extern "C"

@@ -26,6 +26,26 @@ def tiny_moe_cfg(**kw):
 
 
 class TestRouting:
+    def test_top1_router_gets_task_gradient(self):
+        """Switch semantics: with top_k=1 the combine weight is the raw
+        router probability, so the task loss backprops into the router."""
+        layer = MoEMLP(num_experts=4, mlp_dim=16, top_k=1,
+                       dtype=jnp.float32)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 12))
+        params = layer.init(jax.random.PRNGKey(1), x)
+
+        def task_loss(p):
+            out, _ = layer.apply(p, x, mutable=["losses"])
+            return jnp.mean(out ** 2)
+
+        g = jax.grad(task_loss)(params)["params"]["router"]
+        assert float(jnp.max(jnp.abs(g))) > 0
+
+    def test_top_k_exceeding_num_experts_rejected(self):
+        layer = MoEMLP(num_experts=2, mlp_dim=16, top_k=3)
+        with pytest.raises(ValueError, match="top_k"):
+            layer.init(jax.random.PRNGKey(0), jnp.zeros((1, 4, 8)))
+
     def test_load_balancing_loss_uniform_is_minimal(self):
         B, S, E = 2, 16, 4
         uniform = jnp.full((B, S, E), 1.0 / E)

@@ -164,6 +164,42 @@ class TestTrainStep:
         mu_w = state.opt_state[0].mu["w"]
         assert mu_w.sharding.spec == jax.sharding.PartitionSpec(None, "tensor")
 
+    def test_same_shape_params_keep_distinct_moment_shardings(self):
+        """Two same-shape params sharded differently: each moment must carry
+        its own param's sharding (structural walk, not a shape dict)."""
+        def init_fn(rng):
+            k1, k2 = jax.random.split(rng)
+            return {"a": jax.random.normal(k1, (16, 16)),
+                    "b": jax.random.normal(k2, (16, 16))}, {}
+
+        def loss_fn(params, variables, batch, rng):
+            pred = batch["x"] @ params["a"] @ params["b"]
+            return jnp.mean((pred - batch["y"]) ** 2), {}
+
+        def batch_fn(rng, bs):
+            k1, k2 = jax.random.split(rng)
+            return {"x": jax.random.normal(k1, (bs, 16)),
+                    "y": jax.random.normal(k2, (bs, 16))}
+
+        rules = LogicalRules([("row", "tensor"), ("col", "tensor")])
+        axes = {"a": ("row", None), "b": (None, "col")}
+        mesh = build_mesh(ShardingSpec(data=2, tensor=4))
+        b = TrainStepBuilder(mesh=mesh, loss_fn=loss_fn,
+                             optimizer=optax.adam(1e-3), rules=rules,
+                             param_logical_axes=axes)
+        state = b.init(init_fn, jax.random.PRNGKey(0))
+        P = jax.sharding.PartitionSpec
+        assert state.params["a"].sharding.spec == P("tensor")
+        assert state.params["b"].sharding.spec == P(None, "tensor")
+        mu = state.opt_state[0].mu
+        assert mu["a"].sharding.spec == P("tensor")
+        assert mu["b"].sharding.spec == P(None, "tensor")
+        # and a step preserves the layouts (no resharding drift)
+        step = b.build()
+        state, _ = step(state, b.place_batch(batch_fn(jax.random.PRNGKey(1), 16)))
+        assert state.opt_state[0].mu["a"].sharding.spec == P("tensor")
+        assert state.opt_state[0].mu["b"].sharding.spec == P(None, "tensor")
+
 
 class TestTinyModels:
     def test_transformer_tiny_trains(self):

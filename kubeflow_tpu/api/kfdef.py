@@ -86,6 +86,9 @@ class KfDefSpec:
     version: str = "0.1.0"
     repo: str = ""                         # manifest repo override (builtin if empty)
     delete_storage: bool = False
+    # path to a kubeconfig: when set, apply/delete target that real
+    # apiserver (HttpKubeClient) instead of the persisted simulated cluster
+    kubeconfig: str = ""
 
     def params_for(self, component: str) -> dict[str, Any]:
         return dict(self.component_params.get(component, {}))
@@ -120,6 +123,7 @@ class KfDef:
                 "version": self.spec.version,
                 "repo": self.spec.repo,
                 "deleteStorage": self.spec.delete_storage,
+                "kubeconfig": self.spec.kubeconfig,
             },
             "status": {
                 "conditions": [
@@ -150,6 +154,7 @@ class KfDef:
                 version=spec.get("version", "0.1.0"),
                 repo=spec.get("repo", ""),
                 delete_storage=bool(spec.get("deleteStorage", False)),
+                kubeconfig=spec.get("kubeconfig", ""),
             ),
         )
         for c in d.get("status", {}).get("conditions", []) or []:

@@ -9,6 +9,10 @@
   gang binding of pod groups.
 - ``apply``: manifest-set apply/delete with per-object retry (the
   ksonnet.go applyComponent analog).
+- ``wire`` / ``apiserver`` / ``http_client``: the kube REST wire format —
+  an HTTP apiserver over any KubeClient backend, and HttpKubeClient, the
+  real-cluster client (kubeconfig, watch streams) every controller and the
+  CLI can run over unchanged.
 """
 
 from .client import (AlreadyExistsError, ConflictError, KubeClient,

@@ -195,6 +195,9 @@ class FakeCluster(KubeClient):
             obj = self._objects.pop(key, None)
             if obj is None:
                 raise NotFoundError(f"{kind} {ns}/{name} not found")
+            # the DELETED event carries a fresh rv (kube semantics) so
+            # watch streams can measure catch-up past deletions
+            obj["metadata"]["resourceVersion"] = self._next_rv()
             self._broadcast(WatchEvent(DELETED, copy.deepcopy(obj)))
             if cascade:
                 self._gc(obj)

@@ -1,0 +1,109 @@
+"""The deployable controller-manager process.
+
+``python -m kubeflow_tpu.controllers --kubeconfig <path>`` runs every
+reconciler the framework ships against a real apiserver over
+HttpKubeClient — the analog of the reference's controller binaries
+(components/notebook-controller/cmd/manager/main.go, profile-controller,
+tf-operator Deployment in tf-job-operator.libsonnet:148-179) collapsed
+into one manager the way controller-runtime managers host many
+controllers.
+
+Without --kubeconfig it serves an in-memory FakeCluster (useful only with
+--serve, which exposes that cluster over the wire for other processes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from ..cluster.fake import FakeCluster
+from .runtime import Manager
+
+log = logging.getLogger(__name__)
+
+# name → zero-arg factory; --controllers selects a subset
+CONTROLLER_FACTORIES = {}
+
+
+def _register_defaults() -> None:
+    from ..katib.studyjob import StudyJobReconciler
+    from ..workflows.engine import WorkflowReconciler
+    from .notebook import NotebookReconciler
+    from .profile import ProfileReconciler
+    from .statefulset import StatefulSetReconciler
+    from .tpujob import TrainingJobReconciler
+
+    for kind in ("TPUJob", "TFJob", "PyTorchJob", "MPIJob"):
+        CONTROLLER_FACTORIES[kind.lower()] = (
+            lambda k=kind: TrainingJobReconciler(k))
+    CONTROLLER_FACTORIES["notebook"] = NotebookReconciler
+    CONTROLLER_FACTORIES["profile"] = ProfileReconciler
+    CONTROLLER_FACTORIES["statefulset"] = StatefulSetReconciler
+    CONTROLLER_FACTORIES["workflow"] = WorkflowReconciler
+    CONTROLLER_FACTORIES["studyjob"] = StudyJobReconciler
+
+
+def build_manager(client, controllers: list[str]) -> Manager:
+    _register_defaults()
+    mgr = Manager(client)
+    for name in controllers:
+        factory = CONTROLLER_FACTORIES.get(name)
+        if factory is None:
+            raise SystemExit(
+                f"unknown controller {name!r}; "
+                f"available: {sorted(CONTROLLER_FACTORIES)}")
+        mgr.add(factory())
+    return mgr
+
+
+def main(argv=None) -> int:
+    _register_defaults()
+    p = argparse.ArgumentParser(
+        "kubeflow-tpu-manager",
+        description="run the controller manager against an apiserver")
+    p.add_argument("--kubeconfig", default="",
+                   help="kubeconfig for the target apiserver (required "
+                        "unless --fake)")
+    p.add_argument("--context", default="",
+                   help="kubeconfig context override")
+    p.add_argument("--controllers",
+                   default=",".join(sorted(CONTROLLER_FACTORIES)),
+                   help="comma-separated subset to run")
+    p.add_argument("--fake", action="store_true",
+                   help="run over an in-memory cluster (demo/testing)")
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    if args.kubeconfig:
+        from ..cluster.http_client import HttpKubeClient
+        client = HttpKubeClient.from_kubeconfig(
+            args.kubeconfig, context=args.context or None)
+    elif args.fake:
+        client = FakeCluster()
+    else:
+        p.error("--kubeconfig is required (or --fake)")
+
+    names = [c.strip() for c in args.controllers.split(",") if c.strip()]
+    mgr = build_manager(client, names)
+    log.info("manager running %d controllers: %s", len(mgr.controllers),
+             ", ".join(names))
+    mgr.start_all()
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    log.info("shutting down")
+    mgr.stop_all()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

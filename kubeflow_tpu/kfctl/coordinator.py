@@ -58,12 +58,19 @@ class Coordinator:
     @property
     def client(self) -> KubeClient:
         if self._client is None:
-            path = os.path.join(self.kfdef.spec.app_dir, CLUSTER_STATE_FILE)
-            if os.path.exists(path):
-                with open(path) as f:
-                    self._client = FakeCluster.from_snapshot(json.load(f))
+            kubeconfig = (self.kfdef.spec.kubeconfig
+                          or os.environ.get("KFTPU_KUBECONFIG", ""))
+            if kubeconfig:
+                from ..cluster.http_client import HttpKubeClient
+                self._client = HttpKubeClient.from_kubeconfig(kubeconfig)
             else:
-                self._client = FakeCluster()
+                path = os.path.join(self.kfdef.spec.app_dir,
+                                    CLUSTER_STATE_FILE)
+                if os.path.exists(path):
+                    with open(path) as f:
+                        self._client = FakeCluster.from_snapshot(json.load(f))
+                else:
+                    self._client = FakeCluster()
         return self._client
 
     def _persist_client(self) -> None:
@@ -188,6 +195,9 @@ def register_verbs(sub: argparse._SubParsersAction) -> None:
     p_init.add_argument("--tpu-topology", default="v5e-8")
     p_init.add_argument("--components", default="",
                         help="comma-separated override of the component list")
+    p_init.add_argument("--kubeconfig", default="",
+                        help="target a real apiserver instead of the "
+                             "persisted simulated cluster")
     p_init.set_defaults(func=_cmd_init)
 
     for verb, fn in [("generate", _cmd_generate), ("apply", _cmd_apply),
@@ -217,6 +227,17 @@ def register_verbs(sub: argparse._SubParsersAction) -> None:
     p_boot.add_argument("--port", type=int, default=8085)
     p_boot.set_defaults(func=_cmd_serve_bootstrap)
 
+    p_api = sub.add_parser(
+        "serve-apiserver",
+        help="serve the app's simulated cluster over the kube REST wire "
+             "format (mock apiserver for the manager / web apps)")
+    p_api.add_argument("--app-dir", default=".")
+    p_api.add_argument("--host", default="127.0.0.1")
+    p_api.add_argument("--port", type=int, default=8443)
+    p_api.add_argument("--write-kubeconfig", default="",
+                       help="also write a kubeconfig pointing at this server")
+    p_api.set_defaults(func=_cmd_serve_apiserver)
+
 
 def _cmd_init(args) -> int:
     kwargs = dict(platform=args.platform, project=args.project,
@@ -225,6 +246,8 @@ def _cmd_init(args) -> int:
                   default_tpu_topology=args.tpu_topology)
     if args.components:
         kwargs["components"] = [c.strip() for c in args.components.split(",")]
+    if args.kubeconfig:
+        kwargs["kubeconfig"] = os.path.abspath(args.kubeconfig)
     coord = Coordinator.new(args.app_dir, **kwargs)
     coord.init()
     print(f"app initialized at {coord.kfdef.spec.app_dir}")
@@ -264,7 +287,7 @@ def _cmd_completion(args) -> int:
     print("""\
 _kfctl_complete() {
   local verbs="init generate apply delete show components version \\
-completion serve-bootstrap"
+completion serve-bootstrap serve-apiserver"
   COMPREPLY=($(compgen -W "$verbs" -- "${COMP_WORDS[COMP_CWORD]}"))
 }
 complete -F _kfctl_complete kfctl""")
@@ -285,6 +308,61 @@ def _cmd_serve_bootstrap(args) -> int:
     except KeyboardInterrupt:
         server.stop()
     return 0
+
+
+def _cmd_serve_apiserver(args) -> int:
+    import signal
+    import threading
+
+    from ..cluster.apiserver import ClusterAPIServer
+
+    # always serve the app's LOCAL simulated cluster — never proxy a
+    # kubeconfig-selected client (serving a real apiserver through this
+    # shim would be a loop, and HttpKubeClient can't back unfiltered
+    # watches)
+    app_dir = os.path.abspath(args.app_dir)
+    state_path = os.path.join(app_dir, CLUSTER_STATE_FILE)
+    if os.path.exists(state_path):
+        with open(state_path) as f:
+            cluster = FakeCluster.from_snapshot(json.load(f))
+    else:
+        cluster = FakeCluster()
+    server = ClusterAPIServer(cluster, host=args.host, port=args.port)
+    port = server.start()
+    print(f"apiserver (simulated cluster) listening on {args.host}:{port}")
+    if args.write_kubeconfig:
+        write_local_kubeconfig(args.write_kubeconfig,
+                               f"http://{args.host}:{port}")
+        print(f"kubeconfig written to {args.write_kubeconfig}")
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    try:
+        stop.wait()
+    finally:
+        # persist on ANY exit path (SIGTERM/SIGINT/crash), not just Ctrl-C
+        server.stop()
+        with open(state_path, "w") as f:
+            json.dump(cluster.to_snapshot(), f)
+    return 0
+
+
+def write_local_kubeconfig(path: str, server_url: str) -> None:
+    """A minimal kubeconfig pointing at a local simulated apiserver."""
+    import yaml
+    cfg = {
+        "apiVersion": "v1", "kind": "Config",
+        "clusters": [{"name": "kubeflow-tpu-sim",
+                      "cluster": {"server": server_url}}],
+        "users": [{"name": "default", "user": {}}],
+        "contexts": [{"name": "kubeflow-tpu-sim",
+                      "context": {"cluster": "kubeflow-tpu-sim",
+                                  "user": "default"}}],
+        "current-context": "kubeflow-tpu-sim",
+    }
+    with open(path, "w") as f:
+        yaml.safe_dump(cfg, f)
 
 
 def _cmd_components(args) -> int:

@@ -24,15 +24,21 @@ from kubeflow_tpu.webapps.gatekeeper import (Gatekeeper, GatekeeperServer,
                                              SessionStore)
 
 
-@pytest.fixture
-def env():
-    cluster = FakeCluster()
+@pytest.fixture(params=["direct", "http"])
+def env(request):
+    """Runs twice: FakeCluster direct and over the HTTP wire
+    (client → apiserver → FakeCluster; see _http_env.py)."""
+    from _http_env import make_env_cluster
+    cluster, cleanup = make_env_cluster(request.param)
     cluster.add_node("cpu-0", {"cpu": 96, "memory": 2 ** 36})
     mgr = Manager(cluster)
     mgr.add(StatefulSetReconciler())
     mgr.add(NotebookReconciler())
     mgr.add(ProfileReconciler())
-    return cluster, mgr
+    yield cluster, mgr
+    for c in mgr.controllers:
+        c.stop()
+    cleanup()
 
 
 def notebook_manifest(name="nb", image="jupyter:latest", **resources):

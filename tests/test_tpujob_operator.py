@@ -34,13 +34,20 @@ def tpujob_manifest(name="train", topology="v5e-8", num_slices=1, **spec_extra):
     }
 
 
-@pytest.fixture
-def env():
-    cluster = FakeCluster()
+@pytest.fixture(params=["direct", "http"])
+def env(request):
+    """The whole matrix runs twice: against FakeCluster directly and over
+    the real HTTP wire (client → apiserver → FakeCluster), so the
+    wire path carries the same reconciler semantics (_http_env.py)."""
+    from _http_env import make_env_cluster
+    cluster, cleanup = make_env_cluster(request.param)
     cluster.add_tpu_slice_nodes("v5e-8")
     mgr = Manager(cluster)
     ctrl = mgr.add(TrainingJobReconciler("TPUJob"))
-    return cluster, mgr, ctrl
+    yield cluster, mgr, ctrl
+    for c in mgr.controllers:
+        c.stop()
+    cleanup()
 
 
 def drive(cluster, mgr, ticks=3):

@@ -201,6 +201,14 @@ class TrainingJob:
     replica_specs: dict[str, ReplicaSpec]
     run_policy: RunPolicy = field(default_factory=RunPolicy)
     sharding: ShardingSpec = field(default_factory=ShardingSpec)
+    # checkpoint/resume contract (SURVEY §5: "checkpoint-resume makes
+    # slice-level failure domains cheap"): checkpointDir is where workers
+    # write (rendered as KFTPU_CHECKPOINT_DIR); resumeFrom is where they
+    # restore before the loop (KFTPU_RESUME_FROM) — set by the user for
+    # warm starts, or by the operator on gang restart so a restarted gang
+    # continues from the last step
+    checkpoint_dir: str = ""
+    resume_from: str = ""
     raw: dict = field(default_factory=dict)
 
     # -- constructors -------------------------------------------------------
@@ -254,6 +262,8 @@ class TrainingJob:
                 ttl_seconds_after_finished=rp.get("ttlSecondsAfterFinished"),
             ),
             sharding=ShardingSpec.from_dict(spec.get("sharding")),
+            checkpoint_dir=spec.get("checkpointDir", "") or "",
+            resume_from=spec.get("resumeFrom", "") or "",
             raw=obj,
         )
         job.validate()
@@ -340,6 +350,10 @@ class TrainingJob:
                 "sharding": self.sharding.to_dict(),
             },
         )
+        if self.checkpoint_dir:
+            out["spec"]["checkpointDir"] = self.checkpoint_dir
+        if self.resume_from:
+            out["spec"]["resumeFrom"] = self.resume_from
         if self.raw:
             out["apiVersion"] = self.raw.get("apiVersion", out["apiVersion"])
             meta = dict(self.raw.get("metadata", {}))

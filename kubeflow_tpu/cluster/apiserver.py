@@ -75,8 +75,30 @@ class ClusterAPIServer:
         self._stopping = threading.Event()
         self._plural_to_kind: dict[str, str] = {}
         self._known_lock = threading.Lock()
+        # resourceVersion high-water mark for BOOKMARKs / delete details:
+        # FakeCluster exposes its counter directly; any other backend is
+        # tracked from the rvs observed in responses and watch events
+        self._rv_high = 0
         for kind in _WELL_KNOWN_KINDS:
             self.learn_kind(kind)
+
+    # -- resourceVersion tracking -------------------------------------------
+
+    def observe_rv(self, value) -> None:
+        try:
+            v = int(value)
+        except (TypeError, ValueError):
+            return
+        with self._known_lock:
+            if v > self._rv_high:
+                self._rv_high = v
+
+    def current_rv(self) -> int:
+        n = getattr(self.backend, "_rv_n", None)
+        if isinstance(n, int):
+            return n
+        with self._known_lock:
+            return self._rv_high
 
     # -- kind bookkeeping ---------------------------------------------------
 
@@ -142,11 +164,26 @@ def _make_handler(server: ClusterAPIServer):
             length = int(self.headers.get("Content-Length", 0))
             return json.loads(self.rfile.read(length)) if length else {}
 
+        @staticmethod
+        def _observed(result: dict) -> dict:
+            server.observe_rv(result.get("metadata", {})
+                              .get("resourceVersion"))
+            return result
+
         # -- dispatch -------------------------------------------------------
 
         def _dispatch(self, verb: str) -> None:
             split = urlsplit(self.path)
             query = parse_qs(split.query)
+            # drain the body up front on mutating verbs: replying before
+            # reading it would desync HTTP/1.1 keep-alive connections
+            body = None
+            if verb in ("POST", "PUT", "PATCH"):
+                try:
+                    body = self._read_body()
+                except (ValueError, json.JSONDecodeError) as e:
+                    return self._send_error(
+                        ApiError(400, "BadRequest", f"invalid body: {e}"))
             if split.path == "/healthz":
                 return self._send_json(200, {"status": "ok"})
             if split.path == "/version":
@@ -160,14 +197,17 @@ def _make_handler(server: ClusterAPIServer):
             if verb == "GET" and query.get("watch", ["false"])[0] == "true":
                 return self._stream_watch(parsed, query)
             try:
-                self._send_json(200, self._handle(verb, parsed, query))
+                self._send_json(200,
+                                self._handle(verb, parsed, query, body))
             except ApiError as e:
                 self._send_error(e)
+            except ValueError as e:  # bad selector/object → client error
+                self._send_error(ApiError(400, "BadRequest", str(e)))
             except Exception as e:  # noqa: BLE001 — map to a Status object
                 self._send_error(_typed_to_api_error(e))
 
         def _handle(self, verb: str, parsed: wire.ParsedPath,
-                    query: dict) -> dict:
+                    query: dict, body) -> dict:
             backend = server.backend
             if verb == "GET":
                 kind = server.kind_for(parsed)
@@ -186,32 +226,30 @@ def _make_handler(server: ClusterAPIServer):
                 if parsed.name:
                     raise ApiError(405, "MethodNotAllowed",
                                    "POST targets collections")
-                body = self._read_body()
                 if parsed.namespace and \
                         body.get("kind") not in k8s.CLUSTER_SCOPED_KINDS:
                     body.setdefault("metadata", {}).setdefault(
                         "namespace", parsed.namespace)
                 server.learn_kind(body.get("kind", ""))
-                return backend.create(body)
+                return self._observed(backend.create(body))
             if verb == "PUT":
                 if not parsed.name:
                     raise ApiError(405, "MethodNotAllowed",
                                    "PUT targets objects")
-                body = self._read_body()
                 if parsed.subresource == "status":
-                    return backend.update_status(body)
+                    return self._observed(backend.update_status(body))
                 if parsed.subresource:
                     raise ApiError(404, "NotFound",
                                    f"subresource {parsed.subresource!r}")
-                return backend.update(body)
+                return self._observed(backend.update(body))
             if verb == "PATCH":
                 if not parsed.name:
                     raise ApiError(405, "MethodNotAllowed",
                                    "PATCH targets objects")
                 kind = server.kind_for(parsed)
-                return backend.patch(parsed.api_version, kind,
-                                     parsed.namespace or "", parsed.name,
-                                     self._read_body())
+                return self._observed(backend.patch(
+                    parsed.api_version, kind, parsed.namespace or "",
+                    parsed.name, body))
             if verb == "DELETE":
                 if not parsed.name:
                     raise ApiError(405, "MethodNotAllowed",
@@ -227,7 +265,7 @@ def _make_handler(server: ClusterAPIServer):
                 # rv high-water mark after the delete (incl. cascades), so
                 # clients can barrier on their watch streams
                 status["details"] = {"resourceVersion":
-                                     str(getattr(backend, "_rv_n", ""))}
+                                     str(server.current_rv())}
                 return status
             raise ApiError(405, "MethodNotAllowed", verb)
 
@@ -270,7 +308,7 @@ def _make_handler(server: ClusterAPIServer):
             # Subscribe BEFORE reading the current rv: a mutation in the gap
             # is then either queued on w or covered by the initial bookmark.
             w = server.backend.watch()
-            current_rv = str(getattr(server.backend, "_rv_n", ""))
+            current_rv = str(server.current_rv())
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Transfer-Encoding", "chunked")
@@ -296,12 +334,14 @@ def _make_handler(server: ClusterAPIServer):
                                     "apiVersion": parsed.api_version,
                                     "kind": kind,
                                     "metadata": {"resourceVersion": str(
-                                        getattr(server.backend, "_rv_n",
-                                                ""))}}}).encode() + b"\n")
+                                        server.current_rv())}}}
+                            ).encode() + b"\n")
                             last_write = _time.monotonic()
                         continue
                     last_write = _time.monotonic()
                     obj = ev.obj
+                    server.observe_rv(obj.get("metadata", {})
+                                      .get("resourceVersion"))
                     matches = (
                         obj.get("apiVersion") == parsed.api_version
                         and obj.get("kind") == kind

@@ -131,9 +131,11 @@ def parse_path(path: str) -> Optional[ParsedPath]:
     if not rest:
         return None
     namespace: Optional[str] = None
-    if rest[0] == "namespaces" and len(rest) >= 3:
+    if rest[0] == "namespaces" and len(rest) >= 3 and \
+            not (len(rest) == 3 and rest[2] in ("status", "finalize")):
         # /namespaces/{ns}/{plural}... — but /namespaces/{name} (the
-        # Namespace resource itself) has len == 2 and falls through below
+        # Namespace object itself, len 2) and /namespaces/{name}/status
+        # (its subresource) address the Namespace resource, not a scope
         namespace, rest = rest[1], rest[2:]
     plural = rest[0]
     name = rest[1] if len(rest) > 1 else None

@@ -198,6 +198,16 @@ class TrainingJobReconciler(Reconciler):
         pod["spec"]["hostname"] = name
         pod["spec"]["subdomain"] = _workers_service_name(job)
         k8s.set_owner(pod, manifest)
+        # checkpoint/resume contract on every replica kind: workers write to
+        # checkpointDir and restore from resumeFrom before the loop
+        # (runtime/worker.py); gang restart sets resumeFrom automatically
+        env = {}
+        if job.checkpoint_dir:
+            env["KFTPU_CHECKPOINT_DIR"] = job.checkpoint_dir
+        if job.resume_from:
+            env["KFTPU_RESUME_FROM"] = job.resume_from
+        if env:
+            self._add_env(pod, env)
         return pod
 
     def _add_env(self, pod: dict, env: dict[str, str]) -> None:
@@ -328,10 +338,14 @@ class TrainingJobReconciler(Reconciler):
                               k8s.name_of(p))
             except NotFoundError:
                 pass
-        patched = client.patch(
-            *k8s.key_of(manifest),
-            {"metadata": {"annotations": {
-                RESTART_COUNT_ANNOTATION: str(restarts + 1)}}})
+        patch: dict = {"metadata": {"annotations": {
+            RESTART_COUNT_ANNOTATION: str(restarts + 1)}}}
+        if job.checkpoint_dir and not job.resume_from:
+            # close the resume loop: the recreated gang restores from the
+            # job's own checkpoints and continues from the last step
+            # (SURVEY §5 — checkpoint-resume makes gang restarts cheap)
+            patch["spec"] = {"resumeFrom": job.checkpoint_dir}
+        patched = client.patch(*k8s.key_of(manifest), patch)
         self._set_condition(
             client, patched, COND_RESTARTING, "True", "GangRestart",
             f"pods {failed} failed; restarting whole gang "

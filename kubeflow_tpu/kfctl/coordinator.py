@@ -30,6 +30,16 @@ MANIFESTS_DIR = "manifests"
 CLUSTER_STATE_FILE = "cluster-state.json"
 
 
+def load_cluster_state(app_dir: str) -> FakeCluster:
+    """The app's persisted simulated cluster (or a fresh one) — the single
+    place that knows the snapshot-file convention."""
+    path = os.path.join(app_dir, CLUSTER_STATE_FILE)
+    if os.path.exists(path):
+        with open(path) as f:
+            return FakeCluster.from_snapshot(json.load(f))
+    return FakeCluster()
+
+
 class Coordinator:
     """One deployment app (app_dir with app.yaml + generated manifests)."""
 
@@ -64,13 +74,7 @@ class Coordinator:
                 from ..cluster.http_client import HttpKubeClient
                 self._client = HttpKubeClient.from_kubeconfig(kubeconfig)
             else:
-                path = os.path.join(self.kfdef.spec.app_dir,
-                                    CLUSTER_STATE_FILE)
-                if os.path.exists(path):
-                    with open(path) as f:
-                        self._client = FakeCluster.from_snapshot(json.load(f))
-                else:
-                    self._client = FakeCluster()
+                self._client = load_cluster_state(self.kfdef.spec.app_dir)
         return self._client
 
     def _persist_client(self) -> None:
@@ -322,11 +326,7 @@ def _cmd_serve_apiserver(args) -> int:
     # watches)
     app_dir = os.path.abspath(args.app_dir)
     state_path = os.path.join(app_dir, CLUSTER_STATE_FILE)
-    if os.path.exists(state_path):
-        with open(state_path) as f:
-            cluster = FakeCluster.from_snapshot(json.load(f))
-    else:
-        cluster = FakeCluster()
+    cluster = load_cluster_state(app_dir)
     server = ClusterAPIServer(cluster, host=args.host, port=args.port)
     port = server.start()
     print(f"apiserver (simulated cluster) listening on {args.host}:{port}")

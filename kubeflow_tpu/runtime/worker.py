@@ -94,6 +94,7 @@ def train(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 100,
     resume: bool = True,
+    resume_from: Optional[str] = None,
     metrics_path: Optional[str] = None,
     profile_dir: Optional[str] = None,
     ctx: Optional[WorkerContext] = None,
@@ -119,6 +120,12 @@ def train(
     rng = jax.random.PRNGKey(seed)
     state = builder.init(spec.init_fn, rng)
 
+    # operator-rendered checkpoint/resume contract (controllers/tpujob.py
+    # renders spec.checkpointDir/resumeFrom as these env vars; gang restart
+    # sets resumeFrom automatically)
+    checkpoint_dir = checkpoint_dir or os.environ.get("KFTPU_CHECKPOINT_DIR")
+    resume_from = resume_from or os.environ.get("KFTPU_RESUME_FROM")
+
     ckpt = None
     if checkpoint_dir and HAVE_ORBAX:
         ckpt = CheckpointManager(checkpoint_dir,
@@ -126,6 +133,17 @@ def train(
         if resume and ckpt.latest_step() is not None:
             state = ckpt.restore(state)
             log.info("resumed from step %d", int(state.step))
+    if resume_from and int(state.step) == 0 and HAVE_ORBAX:
+        # warm start / gang-restart restore: only when the local
+        # checkpoint_dir had nothing newer
+        src = ckpt if resume_from == checkpoint_dir else \
+            CheckpointManager(resume_from)
+        if src.latest_step() is not None:
+            state = src.restore(state)
+            log.info("resumed from %s at step %d", resume_from,
+                     int(state.step))
+        if src is not ckpt:
+            src.close()
 
     step_fn = builder.build()
     # kubebench injects KFTPU_METRICS_PATH so the reporter can aggregate
@@ -189,6 +207,9 @@ def main(argv=None) -> int:
     p.add_argument("--checkpoint-dir")
     p.add_argument("--checkpoint-every", type=int, default=100)
     p.add_argument("--no-resume", action="store_true")
+    p.add_argument("--resume-from",
+                   help="checkpoint dir to restore from before the loop "
+                        "(defaults to $KFTPU_RESUME_FROM)")
     p.add_argument("--metrics-path")
     p.add_argument("--profile-dir")
     p.add_argument("--num-microbatches", type=int, default=4,
@@ -202,6 +223,7 @@ def main(argv=None) -> int:
         global_batch=args.global_batch, learning_rate=args.learning_rate,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every, resume=not args.no_resume,
+        resume_from=args.resume_from,
         metrics_path=args.metrics_path, profile_dir=args.profile_dir,
         workload_kwargs=workload_kwargs)
     log.info("done: %d steps, %.1f examples/sec", result.steps,

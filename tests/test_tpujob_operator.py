@@ -115,6 +115,31 @@ class TestTPUJobReconcile:
         assert all(p.get("status", {}).get("phase", "Pending") == "Pending" or
                    not p["spec"].get("nodeName") for p in pods)
 
+    def test_gang_restart_sets_resume_from(self, env):
+        """The checkpoint/resume loop (SURVEY §5): a job with checkpointDir
+        that gang-restarts gets spec.resumeFrom set automatically, and the
+        recreated pods carry KFTPU_RESUME_FROM."""
+        cluster, mgr, _ = env
+        cluster.create(tpujob_manifest(checkpointDir="/ckpt/train"))
+        drive(cluster, mgr)
+        # first gang: checkpoint dir rendered, no resume
+        pod = cluster.get("v1", "Pod", "kubeflow", "train-worker-0-0")
+        env_map = {e["name"]: e["value"]
+                   for e in pod["spec"]["containers"][0]["env"]}
+        assert env_map["KFTPU_CHECKPOINT_DIR"] == "/ckpt/train"
+        assert "KFTPU_RESUME_FROM" not in env_map
+        cluster.fail_pod("kubeflow", "train-worker-0-1")
+        mgr.run_pending()
+        job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                          "kubeflow", "train")
+        assert job["spec"]["resumeFrom"] == "/ckpt/train"
+        # recreated gang resumes from the job's own checkpoints
+        pod = cluster.get("v1", "Pod", "kubeflow", "train-worker-0-0")
+        env_map = {e["name"]: e["value"]
+                   for e in pod["spec"]["containers"][0]["env"]}
+        assert env_map["KFTPU_RESUME_FROM"] == "/ckpt/train"
+        assert env_map["KFTPU_CHECKPOINT_DIR"] == "/ckpt/train"
+
     def test_backoff_limit_fails_job(self, env):
         cluster, mgr, _ = env
         cluster.create(tpujob_manifest())

@@ -35,7 +35,30 @@ TPU_API_VERSION = "tpu.kubeflow.org/v1alpha1"
 KF_API_VERSION_V1BETA2 = "kubeflow.org/v1beta2"
 KF_API_VERSION_V1ALPHA1 = "kubeflow.org/v1alpha1"
 
-JOB_KINDS = ("TPUJob", "TFJob", "PyTorchJob", "MPIJob")
+JOB_KINDS = ("TPUJob", "TFJob", "PyTorchJob", "MPIJob",
+             "ChainerJob", "MXJob", "PaddleJob")
+
+# apiVersion per kind (reference CRD groups/versions)
+API_VERSIONS = {
+    "TPUJob": TPU_API_VERSION,
+    "TFJob": KF_API_VERSION_V1BETA2,
+    "PyTorchJob": KF_API_VERSION_V1BETA2,
+    "MPIJob": KF_API_VERSION_V1ALPHA1,
+    "ChainerJob": KF_API_VERSION_V1ALPHA1,
+    "MXJob": KF_API_VERSION_V1ALPHA1,
+    "PaddleJob": KF_API_VERSION_V1ALPHA1,
+}
+
+# replica-spec key inside .spec, per kind (reference CRD field names)
+_SPECS_KEY = {
+    "TFJob": "tfReplicaSpecs",
+    "PyTorchJob": "pytorchReplicaSpecs",
+    "TPUJob": "replicaSpecs",
+    "MPIJob": "replicaSpecs",
+    "ChainerJob": "chainerReplicaSpecs",
+    "MXJob": "mxReplicaSpecs",
+    "PaddleJob": "paddleReplicaSpecs",
+}
 
 # Replica-type vocabulary per kind. "TPU" is valid in every kind — that is the
 # whole point of the build. Validation constraints mirror the reference CRD
@@ -45,8 +68,14 @@ REPLICA_TYPES: dict[str, tuple[str, ...]] = {
     "TFJob": ("TPU", "Chief", "Master", "Worker", "PS", "Evaluator"),
     "PyTorchJob": ("TPU", "Master", "Worker"),
     "MPIJob": ("TPU", "Launcher", "Worker"),
+    # reference operators: kubeflow/chainer-job/chainer-operator.libsonnet,
+    # kubeflow/mxnet-job/mxnet-operator.libsonnet,
+    # kubeflow/paddle-job/*.libsonnet
+    "ChainerJob": ("TPU", "Master", "Worker"),
+    "MXJob": ("TPU", "Scheduler", "Server", "Worker"),
+    "PaddleJob": ("TPU", "Pserver", "Trainer"),
 }
-_MAX_ONE = {"Chief", "Master", "Coordinator", "Launcher"}
+_MAX_ONE = {"Chief", "Master", "Coordinator", "Launcher", "Scheduler"}
 
 # Condition types, mirroring tf-operator's JobCondition vocabulary.
 COND_CREATED = "Created"
@@ -221,12 +250,7 @@ class TrainingJob:
         spec = obj.get("spec", {}) or {}
         # TFJob v1beta2 uses tfReplicaSpecs, PyTorchJob pytorchReplicaSpecs,
         # MPIJob replicas/gpus shorthand, TPUJob replicaSpecs.
-        specs_key = {
-            "TFJob": "tfReplicaSpecs",
-            "PyTorchJob": "pytorchReplicaSpecs",
-            "TPUJob": "replicaSpecs",
-            "MPIJob": "replicaSpecs",
-        }[kind]
+        specs_key = _SPECS_KEY[kind]
         raw_specs = spec.get(specs_key) or {}
         if kind == "MPIJob" and not raw_specs:
             raw_specs = cls._mpi_shorthand(spec)
@@ -337,11 +361,8 @@ class TrainingJob:
         """Serialize from the typed fields (always — a job parsed from a
         manifest and then mutated must serialize its mutations). Metadata
         extras from the source manifest (labels, uid, ...) are preserved."""
-        api_version = TPU_API_VERSION if self.kind == "TPUJob" else (
-            KF_API_VERSION_V1ALPHA1 if self.kind == "MPIJob" else KF_API_VERSION_V1BETA2
-        )
-        specs_key = {"TFJob": "tfReplicaSpecs", "PyTorchJob": "pytorchReplicaSpecs",
-                     "TPUJob": "replicaSpecs", "MPIJob": "replicaSpecs"}[self.kind]
+        api_version = API_VERSIONS[self.kind]
+        specs_key = _SPECS_KEY[self.kind]
         out = k8s.make(
             api_version, self.kind, self.name, self.namespace,
             spec={

@@ -36,7 +36,8 @@ def _register_defaults() -> None:
     from .statefulset import StatefulSetReconciler
     from .tpujob import TrainingJobReconciler
 
-    for kind in ("TPUJob", "TFJob", "PyTorchJob", "MPIJob"):
+    from ..api.trainingjob import JOB_KINDS
+    for kind in JOB_KINDS:
         CONTROLLER_FACTORIES[kind.lower()] = (
             lambda k=kind: TrainingJobReconciler(k))
     CONTROLLER_FACTORIES["notebook"] = NotebookReconciler

@@ -38,12 +38,12 @@ from typing import Optional
 
 from ..api import k8s
 from ..api.topology import TopologyContract, render_contracts
-from ..api.trainingjob import (COND_CREATED, COND_FAILED, COND_RESTARTING,
-                               COND_RUNNING, COND_SUCCEEDED, CLEAN_POD_ALL,
-                               CLEAN_POD_NONE, CLEAN_POD_RUNNING,
-                               KF_API_VERSION_V1ALPHA1, KF_API_VERSION_V1BETA2,
-                               POD_FAILED, POD_RUNNING, POD_SUCCEEDED,
-                               ReplicaSpec, TrainingJob, TPU_API_VERSION)
+from ..api.trainingjob import (API_VERSIONS, COND_CREATED, COND_FAILED,
+                               COND_RESTARTING, COND_RUNNING, COND_SUCCEEDED,
+                               CLEAN_POD_ALL, CLEAN_POD_NONE,
+                               CLEAN_POD_RUNNING, JOB_KINDS, POD_FAILED,
+                               POD_RUNNING, POD_SUCCEEDED, ReplicaSpec,
+                               TrainingJob)
 from ..cluster.client import KubeClient, NotFoundError
 from ..cluster.fake import POD_GROUP_LABEL, TPU_RESOURCE
 from .runtime import Key, Reconciler, Result
@@ -73,14 +73,8 @@ class TrainingJobReconciler(Reconciler):
     """Reconciler for one job kind; instantiate once per kind."""
 
     def __init__(self, kind: str = "TPUJob"):
-        api_version = {
-            "TPUJob": TPU_API_VERSION,
-            "TFJob": KF_API_VERSION_V1BETA2,
-            "PyTorchJob": KF_API_VERSION_V1BETA2,
-            "MPIJob": KF_API_VERSION_V1ALPHA1,
-        }[kind]
         self.kind = kind
-        self.primary = (api_version, kind)
+        self.primary = (API_VERSIONS[kind], kind)
         self.owns = [("v1", "Pod"), ("v1", "Service")]
 
     # ------------------------------------------------------------ reconcile
@@ -246,8 +240,8 @@ class TrainingJobReconciler(Reconciler):
         env["KFTPU_JOB_NAME"] = job.name
         env["KFTPU_JOB_KIND"] = job.kind
         self._add_env(pod, env)
-        if job.kind == "MPIJob":
-            self._add_env(pod, self._mpi_env(job, rs))
+        if job.kind in ("MPIJob", "ChainerJob"):
+            self._add_env(pod, self._mpi_env(job))
         return pod
 
     def _build_replica_pod(self, job: TrainingJob, manifest: dict,
@@ -259,8 +253,14 @@ class TrainingJobReconciler(Reconciler):
                 self._tf_config(job, rtype, index))})
         elif job.kind == "PyTorchJob":
             self._add_env(pod, self._pytorch_env(job, rtype, index))
-        elif job.kind == "MPIJob":
-            self._add_env(pod, self._mpi_env(job, rs))
+        elif job.kind in ("MPIJob", "ChainerJob"):
+            # ChainerMN drives workers over MPI (chainer-operator.libsonnet
+            # renders an mpiexec hostfile); same hostlist contract
+            self._add_env(pod, self._mpi_env(job))
+        elif job.kind == "MXJob":
+            self._add_env(pod, self._mxnet_env(job, rtype, index))
+        elif job.kind == "PaddleJob":
+            self._add_env(pod, self._paddle_env(job, rtype, index))
         return pod
 
     # ---------------------------------------------------- legacy contracts
@@ -293,13 +293,16 @@ class TrainingJobReconciler(Reconciler):
                 "MASTER_PORT": str(DEFAULT_PORT),
                 "RANK": str(rank), "WORLD_SIZE": str(world)}
 
-    def _mpi_env(self, job: TrainingJob, rs: ReplicaSpec) -> dict:
+    def _mpi_env(self, job: TrainingJob) -> dict:
         """Hostlist env replacing the reference's kubectl-delivery hostfile
-        (mpi-operator.libsonnet:116-135)."""
-        if rs.is_tpu:
+        (mpi-operator.libsonnet:116-135). Hosts come from the JOB's compute
+        replicas — TPU gang if present, else Worker — the same list on
+        every pod (launcher/master included)."""
+        tpu = job.tpu_spec
+        if tpu is not None and tpu.topology is not None:
             hosts = [_tpu_pod_name(job, s, h)
-                     for s in range(rs.num_slices)
-                     for h in range(rs.topology.num_hosts)]
+                     for s in range(tpu.num_slices)
+                     for h in range(tpu.topology.num_hosts)]
         else:
             worker = job.replica_specs.get("Worker")
             hosts = [_replica_pod_name(job, "Worker", i)
@@ -308,10 +311,48 @@ class TrainingJobReconciler(Reconciler):
         return {"KFTPU_MPI_HOSTS": ",".join(fqdn),
                 "KFTPU_MPI_NUM_HOSTS": str(len(fqdn))}
 
+    def _mxnet_env(self, job: TrainingJob, rtype: str, index: int) -> dict:
+        """DMLC env the way mxnet-operator renders it
+        (mxnet-operator.libsonnet): one Scheduler roots the PS tracker."""
+        scheduler = _replica_pod_name(job, "Scheduler", 0)
+        counts = {t: rs.replicas for t, rs in job.replica_specs.items()}
+        return {
+            "DMLC_PS_ROOT_URI":
+                f"{scheduler}.{_workers_service_name(job)}.{job.namespace}",
+            "DMLC_PS_ROOT_PORT": str(DEFAULT_PORT),
+            "DMLC_ROLE": rtype.lower(),
+            "DMLC_NUM_SERVER": str(counts.get("Server", 0)),
+            "DMLC_NUM_WORKER": str(counts.get("Worker", 0)),
+        }
+
+    def _paddle_env(self, job: TrainingJob, rtype: str, index: int) -> dict:
+        """PADDLE_* env the way paddle-operator renders it
+        (kubeflow/paddle-job/*.libsonnet): pserver endpoints + trainer id."""
+        pservers = job.replica_specs.get("Pserver")
+        endpoints = [
+            self._addr(job, _replica_pod_name(job, "Pserver", i))
+            for i in range(pservers.replicas)] if pservers else []
+        trainers = job.replica_specs.get("Trainer")
+        env = {
+            "PADDLE_PSERVERS": ",".join(endpoints),
+            "PADDLE_PSERVER_PORT": str(DEFAULT_PORT),
+            "PADDLE_TRAINERS": str(trainers.replicas if trainers else 0),
+            "PADDLE_TRAINING_ROLE":
+                "PSERVER" if rtype == "Pserver" else "TRAINER",
+        }
+        if rtype == "Trainer":
+            env["PADDLE_TRAINER_ID"] = str(index)
+        return env
+
     # ------------------------------------------------------------- failure
 
     def _chief_pod_name(self, job: TrainingJob) -> str:
-        for t in ("Chief", "Master", "Launcher", "Coordinator"):
+        # MXNet's Scheduler and Paddle's Pserver run until shutdown; job
+        # completion is signaled by the first worker/trainer (the operator
+        # semantics of mxnet-operator/paddle-operator)
+        preferred = {"MXJob": ("Worker",),
+                     "PaddleJob": ("Trainer",)}.get(job.kind, ())
+        for t in (*preferred, "Chief", "Master", "Launcher", "Coordinator"):
             if t in job.replica_specs:
                 return _replica_pod_name(job, t, 0)
         if job.tpu_spec is not None:
@@ -406,5 +447,4 @@ class TrainingJobReconciler(Reconciler):
 
 
 def all_reconcilers() -> list[TrainingJobReconciler]:
-    return [TrainingJobReconciler(k) for k in
-            ("TPUJob", "TFJob", "PyTorchJob", "MPIJob")]
+    return [TrainingJobReconciler(k) for k in JOB_KINDS]

@@ -13,4 +13,5 @@ from .registry import REGISTRY, build_component, component_names, register
 __all__ = ["REGISTRY", "register", "build_component", "component_names"]
 
 # Importing the package modules populates the registry.
-from . import core, training, serving, notebooks, multitenancy, katib, kubebench, observability  # noqa: F401,E402
+from . import (core, training, serving, notebooks, multitenancy, katib,  # noqa: F401,E402
+               kubebench, observability, cloud_aws)

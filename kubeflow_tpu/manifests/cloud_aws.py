@@ -1,0 +1,153 @@
+"""The AWS package: ALB ingress, EFS/FSx CSI storage, istio ingress.
+
+Reference: kubeflow/aws/prototypes/ (7 prototypes, ~2.3k LoC jsonnet) —
+alb-ingress-controller, EFS/FSx CSI drivers + PVs, istio-ingress. On a TPU
+build these matter for EKS-hosted control planes fronting cloud TPU slices
+(the training data path stays on GCP, but the reference treats the AWS
+catalog as first-class and so do we).
+"""
+
+from __future__ import annotations
+
+from ..api import k8s
+from . import helpers as H
+from .registry import register
+
+VERSION = "v0.1.0"
+
+
+@register("alb-ingress-controller", "AWS ALB ingress controller "
+                                    "(kubeflow/aws alb-ingress parity)")
+def alb_ingress_controller(namespace: str = "kubeflow",
+                           cluster_name: str = "kubeflow-tpu") -> list[dict]:
+    sa = H.service_account("alb-ingress-controller", namespace)
+    role = H.cluster_role("alb-ingress-controller", [
+        {"apiGroups": ["", "extensions", "networking.k8s.io"],
+         "resources": ["configmaps", "endpoints", "events", "ingresses",
+                       "ingresses/status", "services", "nodes", "pods",
+                       "secrets"],
+         "verbs": ["create", "get", "list", "update", "watch", "patch"]},
+    ])
+    binding = H.cluster_role_binding("alb-ingress-controller",
+                                     "alb-ingress-controller",
+                                     "alb-ingress-controller", namespace)
+    dep = H.deployment(
+        "alb-ingress-controller", namespace,
+        "docker.io/amazon/aws-alb-ingress-controller:v1.1.2",
+        args=["--ingress-class=alb", f"--cluster-name={cluster_name}"],
+        service_account="alb-ingress-controller", port=10254)
+    return [sa, role, binding, dep]
+
+
+def _csi_driver(name: str, image: str, namespace: str) -> list[dict]:
+    sa = H.service_account(f"{name}-csi-controller", namespace)
+    role = H.cluster_role(f"{name}-csi", [
+        {"apiGroups": [""],
+         "resources": ["persistentvolumes", "persistentvolumeclaims",
+                       "nodes", "events"],
+         "verbs": ["get", "list", "watch", "create", "delete", "update"]},
+        {"apiGroups": ["storage.k8s.io"],
+         "resources": ["storageclasses", "csinodes", "volumeattachments"],
+         "verbs": ["get", "list", "watch", "update"]},
+    ])
+    binding = H.cluster_role_binding(f"{name}-csi", f"{name}-csi",
+                                     f"{name}-csi-controller", namespace)
+    # node plugin DaemonSet (the csi-driver deployment shape the reference
+    # aws package installs)
+    ds = {
+        "apiVersion": "apps/v1", "kind": "DaemonSet",
+        "metadata": {"name": f"{name}-csi-node", "namespace": namespace,
+                     "labels": H.std_labels(f"{name}-csi-node")},
+        "spec": {
+            "selector": {"matchLabels": {"app": f"{name}-csi-node"}},
+            "template": {
+                "metadata": {"labels": {"app": f"{name}-csi-node"}},
+                "spec": {
+                    "serviceAccountName": f"{name}-csi-controller",
+                    "hostNetwork": True,
+                    "containers": [{
+                        "name": "csi-driver", "image": image,
+                        "securityContext": {"privileged": True},
+                        "volumeMounts": [
+                            {"name": "kubelet-dir",
+                             "mountPath": "/var/lib/kubelet"}],
+                    }],
+                    "volumes": [{
+                        "name": "kubelet-dir",
+                        "hostPath": {"path": "/var/lib/kubelet"}}],
+                },
+            },
+        },
+    }
+    return [sa, role, binding, ds]
+
+
+@register("aws-efs-csi-driver", "EFS CSI driver + default PV/StorageClass "
+                                "(kubeflow/aws efs parity)")
+def aws_efs_csi_driver(namespace: str = "kubeflow",
+                       filesystem_id: str = "",
+                       storage_capacity: str = "100Gi") -> list[dict]:
+    out = _csi_driver("efs", "docker.io/amazon/aws-efs-csi-driver:v0.2.0",
+                      namespace)
+    sc = {"apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+          "metadata": {"name": "efs-sc"},
+          "provisioner": "efs.csi.aws.com"}
+    out.append(sc)
+    if filesystem_id:
+        pv = k8s.make("v1", "PersistentVolume", "efs-pv")
+        pv["spec"] = {
+            "capacity": {"storage": storage_capacity},
+            "accessModes": ["ReadWriteMany"],
+            "persistentVolumeReclaimPolicy": "Retain",
+            "storageClassName": "efs-sc",
+            "csi": {"driver": "efs.csi.aws.com",
+                    "volumeHandle": filesystem_id},
+        }
+        out.append(pv)
+    return out
+
+
+@register("aws-fsx-csi-driver", "FSx for Lustre CSI driver + StorageClass "
+                                "(kubeflow/aws fsx parity)")
+def aws_fsx_csi_driver(namespace: str = "kubeflow",
+                       subnet_id: str = "",
+                       security_group_id: str = "") -> list[dict]:
+    out = _csi_driver("fsx", "docker.io/amazon/aws-fsx-csi-driver:v0.1.0",
+                      namespace)
+    sc = {"apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+          "metadata": {"name": "fsx-sc"},
+          "provisioner": "fsx.csi.aws.com"}
+    if subnet_id:
+        sc["parameters"] = {"subnetId": subnet_id,
+                            "securityGroupIds": security_group_id}
+    out.append(sc)
+    return out
+
+
+@register("aws-istio-ingress", "Istio ingress gateway fronted by an ALB "
+                               "(kubeflow/aws istio-ingress parity)")
+def aws_istio_ingress(namespace: str = "kubeflow",
+                      hostname: str = "*") -> list[dict]:
+    ingress = {
+        "apiVersion": "networking.k8s.io/v1", "kind": "Ingress",
+        "metadata": {
+            "name": "istio-ingress", "namespace": namespace,
+            "annotations": {
+                "kubernetes.io/ingress.class": "alb",
+                "alb.ingress.kubernetes.io/scheme": "internet-facing",
+                "alb.ingress.kubernetes.io/listen-ports":
+                    '[{"HTTP": 80}]',
+            },
+        },
+        "spec": {"rules": [{
+            "host": hostname if hostname != "*" else None,
+            "http": {"paths": [{
+                "path": "/", "pathType": "Prefix",
+                "backend": {"service": {
+                    "name": "istio-ingressgateway",
+                    "port": {"number": 80}}}}]},
+        }]},
+    }
+    if ingress["spec"]["rules"][0]["host"] is None:
+        del ingress["spec"]["rules"][0]["host"]
+    return [ingress]

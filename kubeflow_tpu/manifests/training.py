@@ -38,7 +38,8 @@ def _job_schema(specs_key: str, max_one: list[str]) -> dict:
                                              "maximum": 1}}}
                 if t in max_one else _REPLICA_SCHEMA)
             for t in ("TPU", "Chief", "Master", "Worker", "PS", "Launcher",
-                      "Evaluator", "Coordinator")
+                      "Evaluator", "Coordinator", "Scheduler", "Server",
+                      "Pserver", "Trainer")
         },
     }}
     return {"type": "object",
@@ -113,6 +114,30 @@ def mpi_operator(namespace: str = "kubeflow") -> list[dict]:
     }
     return [H.crd("mpijobs", "MPIJob", "kubeflow.org", ["v1alpha1"],
                   schema=schema)]
+
+
+@register("chainer-operator", "ChainerJob CRD (ChainerMN over the MPI "
+                              "hostlist contract) served by the TPU operator "
+                              "(kubeflow/chainer-job parity)")
+def chainer_operator(namespace: str = "kubeflow") -> list[dict]:
+    return [H.crd("chainerjobs", "ChainerJob", "kubeflow.org", ["v1alpha1"],
+                  schema=_job_schema("chainerReplicaSpecs", ["Master"]))]
+
+
+@register("mxnet-operator", "MXJob CRD (DMLC scheduler/server/worker env) "
+                            "served by the TPU operator "
+                            "(kubeflow/mxnet-job parity)")
+def mxnet_operator(namespace: str = "kubeflow") -> list[dict]:
+    return [H.crd("mxjobs", "MXJob", "kubeflow.org", ["v1alpha1"],
+                  schema=_job_schema("mxReplicaSpecs", ["Scheduler"]))]
+
+
+@register("paddle-operator", "PaddleJob CRD (PADDLE_* pserver/trainer env) "
+                             "served by the TPU operator "
+                             "(kubeflow/paddle-job parity)")
+def paddle_operator(namespace: str = "kubeflow") -> list[dict]:
+    return [H.crd("paddlejobs", "PaddleJob", "kubeflow.org", ["v1alpha1"],
+                  schema=_job_schema("paddleReplicaSpecs", []))]
 
 
 @register("openmpi-controller", "Slice-sidecar config: lifecycle hooks for "

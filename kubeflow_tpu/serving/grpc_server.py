@@ -1,0 +1,214 @@
+"""The gRPC predict surface: TF-Serving's PredictionService on :9000.
+
+The reference deploys TF-Serving with gRPC :9000 + REST :8000
+(tf-serving.libsonnet:137,197) and its http-proxy speaks this exact
+service (components/k8s-model-server/http-proxy/server.py:27-40). The TPU
+model server serves the same wire contract — PredictRequest/PredictResponse
+and GetModelStatus with upstream field numbers (serving/tpu_serving_pb2.py,
+source proto in native/proto/tpu_serving.proto) — so stock TF-Serving
+clients work unmodified.
+
+Implementation notes: grpcio generic handlers (no generated service stubs
+needed — protoc's message codegen plus method registration by full name),
+sharing the ModelServer's MicroBatchers so gRPC and REST traffic batch
+together on the device.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent import futures
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+try:
+    # one guard for the whole optional surface: grpcio AND the protobuf
+    # runtime behind the generated pb2 (neither is a hard dependency; the
+    # REST server must keep starting without them)
+    import grpc
+    from . import tpu_serving_pb2 as pb
+    HAVE_GRPC = True
+except ImportError:  # pragma: no cover - both are in the base image
+    grpc = None
+    pb = None
+    HAVE_GRPC = False
+
+SERVICE = "tensorflow.serving.PredictionService"
+
+if HAVE_GRPC:
+    _NP_TO_DT = {
+        np.dtype(np.float32): pb.DT_FLOAT,
+        np.dtype(np.float64): pb.DT_DOUBLE,
+        np.dtype(np.int32): pb.DT_INT32,
+        np.dtype(np.uint8): pb.DT_UINT8,
+        np.dtype(np.int16): pb.DT_INT16,
+        np.dtype(np.int8): pb.DT_INT8,
+        np.dtype(np.int64): pb.DT_INT64,
+        np.dtype(np.bool_): pb.DT_BOOL,
+        np.dtype(np.uint32): pb.DT_UINT32,
+        np.dtype(np.uint64): pb.DT_UINT64,
+        np.dtype(np.float16): pb.DT_HALF,
+    }
+    _DT_TO_NP = {v: k for k, v in _NP_TO_DT.items()}
+
+    # repeated-field name per dtype for sparse (non-tensor_content)
+    # encoding; DT_HALF is special-cased in tensor_to_ndarray (half_val
+    # carries raw float16 bit patterns in int32 slots, TF convention)
+    _DT_VAL_FIELD = {
+        pb.DT_FLOAT: "float_val", pb.DT_DOUBLE: "double_val",
+        pb.DT_INT32: "int_val", pb.DT_UINT8: "int_val",
+        pb.DT_INT16: "int_val", pb.DT_INT8: "int_val",
+        pb.DT_INT64: "int64_val", pb.DT_BOOL: "bool_val",
+        pb.DT_UINT32: "uint32_val", pb.DT_UINT64: "uint64_val",
+    }
+else:  # pragma: no cover
+    _NP_TO_DT = {}
+    _DT_TO_NP = {}
+    _DT_VAL_FIELD = {}
+
+
+def tensor_to_ndarray(t: pb.TensorProto) -> np.ndarray:
+    """TensorProto → numpy, accepting both tensor_content and *_val forms
+    (clients use either; tf.make_tensor_proto prefers tensor_content)."""
+    if t.dtype not in _DT_TO_NP:
+        raise ValueError(f"unsupported tensor dtype {t.dtype}")
+    np_dtype = _DT_TO_NP[t.dtype]
+    shape = [d.size for d in t.tensor_shape.dim]
+    if t.tensor_content:
+        arr = np.frombuffer(t.tensor_content, dtype=np_dtype)
+    elif t.dtype == pb.DT_HALF:
+        # half_val carries raw float16 bit patterns in int32 slots
+        arr = np.array(list(t.half_val), dtype=np.uint16).view(np.float16)
+    else:
+        field = _DT_VAL_FIELD[t.dtype]
+        arr = np.array(list(getattr(t, field)), dtype=np_dtype)
+        # TF semantics: a single value broadcasts to the full shape
+        n = int(np.prod(shape)) if shape else arr.size
+        if arr.size == 1 and n > 1:
+            arr = np.full(n, arr[0], dtype=np_dtype)
+    return arr.reshape(shape) if shape else arr
+
+
+def ndarray_to_tensor(a: np.ndarray) -> pb.TensorProto:
+    a = np.asarray(a)
+    if a.dtype not in _NP_TO_DT:
+        a = a.astype(np.float32)  # e.g. bfloat16 outputs
+    t = pb.TensorProto()
+    t.dtype = _NP_TO_DT[a.dtype]
+    for s in a.shape:
+        t.tensor_shape.dim.add().size = s
+    t.tensor_content = np.ascontiguousarray(a).tobytes()
+    return t
+
+
+class GrpcPredictServer:
+    """PredictionService over a ModelServer (shares its MicroBatchers)."""
+
+    def __init__(self, model_server, host: str = "0.0.0.0",
+                 port: int = 9000, max_workers: int = 8):
+        if not HAVE_GRPC:
+            raise RuntimeError("grpcio is not available")
+        self.model_server = model_server
+        self.host, self.port = host, port
+        self.max_workers = max_workers
+        self._server: Optional["grpc.Server"] = None
+
+    # -- handlers -----------------------------------------------------------
+
+    def _predict(self, request: pb.PredictRequest,
+                 context) -> pb.PredictResponse:
+        name = request.model_spec.name
+        try:
+            batcher = self.model_server.batcher(name)
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        if not request.inputs:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "no inputs in PredictRequest")
+        # single-input models take the tensor directly; the conventional
+        # key is "instances" (REST parity) or "inputs"
+        key = ("instances" if "instances" in request.inputs else
+               ("inputs" if "inputs" in request.inputs else
+                next(iter(request.inputs))))
+        try:
+            instances = tensor_to_ndarray(request.inputs[key])
+            out = batcher.predict(instances)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except Exception as e:  # noqa: BLE001 — surface as INTERNAL
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{type(e).__name__}: {e}")
+        resp = pb.PredictResponse()
+        resp.model_spec.name = name
+        resp.model_spec.signature_name = (
+            request.model_spec.signature_name or "serving_default")
+        if isinstance(out, dict):
+            wanted = set(request.output_filter)
+            for k, v in out.items():
+                if wanted and k not in wanted:
+                    continue
+                resp.outputs[k].CopyFrom(ndarray_to_tensor(np.asarray(v)))
+        else:
+            resp.outputs["outputs"].CopyFrom(
+                ndarray_to_tensor(np.asarray(out)))
+        return resp
+
+    def _get_model_status(self, request: pb.GetModelStatusRequest,
+                          context) -> pb.GetModelStatusResponse:
+        name = request.model_spec.name
+        resp = pb.GetModelStatusResponse()
+        try:
+            servable = self.model_server.repository.get(name)
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        vs = resp.model_version_status.add()
+        vs.version = int(servable.version)
+        vs.state = pb.ModelVersionStatus.AVAILABLE
+        vs.status.error_code = 0
+        return resp
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> int:
+        handlers = grpc.method_handlers_generic_handler(SERVICE, {
+            "Predict": grpc.unary_unary_rpc_method_handler(
+                self._predict,
+                request_deserializer=pb.PredictRequest.FromString,
+                response_serializer=pb.PredictResponse.SerializeToString),
+            "GetModelStatus": grpc.unary_unary_rpc_method_handler(
+                self._get_model_status,
+                request_deserializer=pb.GetModelStatusRequest.FromString,
+                response_serializer=(
+                    pb.GetModelStatusResponse.SerializeToString)),
+        })
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=self.max_workers,
+                                       thread_name_prefix="grpc-predict"))
+        self._server.add_generic_rpc_handlers((handlers,))
+        self.port = self._server.add_insecure_port(
+            f"{self.host}:{self.port}")
+        self._server.start()
+        log.info("gRPC PredictionService on :%d", self.port)
+        return self.port
+
+    def stop(self, grace: float = 1.0) -> None:
+        if self._server is not None:
+            self._server.stop(grace).wait()
+
+
+def predict_stub(channel):
+    """Client-side multicallables for tests/tools (stub without codegen)."""
+    return {
+        "Predict": channel.unary_unary(
+            f"/{SERVICE}/Predict",
+            request_serializer=pb.PredictRequest.SerializeToString,
+            response_deserializer=pb.PredictResponse.FromString),
+        "GetModelStatus": channel.unary_unary(
+            f"/{SERVICE}/GetModelStatus",
+            request_serializer=pb.GetModelStatusRequest.SerializeToString,
+            response_deserializer=pb.GetModelStatusResponse.FromString),
+    }

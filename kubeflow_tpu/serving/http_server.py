@@ -217,6 +217,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("--model-type", default="resnet50")
     p.add_argument("--model-path", default="")
     p.add_argument("--rest-port", type=int, default=8500)
+    p.add_argument("--grpc-port", type=int, default=9000,
+                   help="TF-Serving-compatible PredictionService port "
+                        "(0 disables)")
     p.add_argument("--max-batch", type=int, default=64)
     args = p.parse_args(argv)
 
@@ -226,11 +229,20 @@ def main(argv: Optional[list[str]] = None) -> int:
     server = ModelServer(repo, port=args.rest_port,
                          max_batch=args.max_batch)
     port = server.start()
+    grpc_server = None
+    if args.grpc_port:
+        from .grpc_server import GrpcPredictServer, HAVE_GRPC
+        if HAVE_GRPC:
+            grpc_server = GrpcPredictServer(server, port=args.grpc_port)
+            gport = grpc_server.start()
+            print(f"gRPC PredictionService on :{gport}", flush=True)
     print(f"model server listening on :{port} "
           f"(models: {repo.names()})", flush=True)
     try:
         threading.Event().wait()
     except KeyboardInterrupt:
+        if grpc_server:
+            grpc_server.stop()
         server.stop()
     return 0
 

@@ -49,6 +49,35 @@ class TestRegistry:
 class TestGoldenManifests:
     """Golden-object asserts (tf-job_test.jsonnet:16-40 idiom)."""
 
+    def test_legacy_job_kind_crds(self):
+        """chainer/mxnet/paddle parity (kubeflow/chainer-job etc.)."""
+        for comp, kind, plural in [
+                ("chainer-operator", "ChainerJob", "chainerjobs"),
+                ("mxnet-operator", "MXJob", "mxjobs"),
+                ("paddle-operator", "PaddleJob", "paddlejobs")]:
+            crd = build_component(comp)[0]
+            assert crd["kind"] == "CustomResourceDefinition"
+            assert crd["spec"]["names"]["kind"] == kind
+            assert crd["spec"]["names"]["plural"] == plural
+            assert crd["spec"]["group"] == "kubeflow.org"
+
+    def test_aws_package_shapes(self):
+        """kubeflow/aws parity: ALB ingress, EFS/FSx CSI, istio ingress."""
+        alb = build_component("alb-ingress-controller")
+        kinds = [o["kind"] for o in alb]
+        assert "Deployment" in kinds and "ClusterRole" in kinds
+        efs = build_component("aws-efs-csi-driver",
+                              {"filesystem_id": "fs-123"})
+        by_kind = {o["kind"]: o for o in efs}
+        assert by_kind["DaemonSet"]["spec"]["template"]["spec"][
+            "containers"][0]["securityContext"]["privileged"]
+        assert by_kind["StorageClass"]["provisioner"] == "efs.csi.aws.com"
+        assert by_kind["PersistentVolume"]["spec"]["csi"][
+            "volumeHandle"] == "fs-123"
+        ing = build_component("aws-istio-ingress")[0]
+        assert ing["metadata"]["annotations"][
+            "kubernetes.io/ingress.class"] == "alb"
+
     def test_tpu_job_operator_shape(self):
         objs = build_component("tpu-job-operator")
         by_kind = {}

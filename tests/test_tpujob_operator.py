@@ -227,6 +227,123 @@ class TestLegacyKinds:
         assert env_map["MASTER_ADDR"].startswith("pt-master-0.")
         assert env_map["RANK"] == "3" and env_map["WORLD_SIZE"] == "4"
 
+    def test_mxjob_renders_dmlc_env(self):
+        cluster = FakeCluster()
+        mgr = Manager(cluster)
+        mgr.add(TrainingJobReconciler("MXJob"))
+        tmpl = {"spec": {"containers": [{"name": "t", "image": "i"}]}}
+        cluster.create({
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "MXJob",
+            "metadata": {"name": "mx", "namespace": "kubeflow"},
+            "spec": {"mxReplicaSpecs": {
+                "Scheduler": {"replicas": 1, "template": tmpl},
+                "Server": {"replicas": 2, "template": tmpl},
+                "Worker": {"replicas": 2, "template": tmpl},
+            }},
+        })
+        mgr.run_pending()
+        w = cluster.get("v1", "Pod", "kubeflow", "mx-worker-1")
+        env_map = {e["name"]: e["value"]
+                   for e in w["spec"]["containers"][0]["env"]}
+        assert env_map["DMLC_PS_ROOT_URI"].startswith("mx-scheduler-0.")
+        assert env_map["DMLC_ROLE"] == "worker"
+        assert env_map["DMLC_NUM_SERVER"] == "2"
+        assert env_map["DMLC_NUM_WORKER"] == "2"
+        # worker (not the long-running scheduler) completes the job
+        cluster.tick()
+        cluster.set_pod_phase("kubeflow", "mx-worker-0", "Succeeded")
+        mgr.run_pending()
+        job = cluster.get("kubeflow.org/v1alpha1", "MXJob", "kubeflow", "mx")
+        assert k8s.condition_true(job, "Succeeded")
+
+    def test_paddlejob_renders_paddle_env(self):
+        cluster = FakeCluster()
+        mgr = Manager(cluster)
+        mgr.add(TrainingJobReconciler("PaddleJob"))
+        tmpl = {"spec": {"containers": [{"name": "t", "image": "i"}]}}
+        cluster.create({
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "PaddleJob",
+            "metadata": {"name": "pd", "namespace": "kubeflow"},
+            "spec": {"paddleReplicaSpecs": {
+                "Pserver": {"replicas": 2, "template": tmpl},
+                "Trainer": {"replicas": 3, "template": tmpl},
+            }},
+        })
+        mgr.run_pending()
+        t = cluster.get("v1", "Pod", "kubeflow", "pd-trainer-2")
+        env_map = {e["name"]: e["value"]
+                   for e in t["spec"]["containers"][0]["env"]}
+        assert env_map["PADDLE_TRAINING_ROLE"] == "TRAINER"
+        assert env_map["PADDLE_TRAINER_ID"] == "2"
+        assert env_map["PADDLE_TRAINERS"] == "3"
+        assert "pd-pserver-0." in env_map["PADDLE_PSERVERS"]
+        assert "pd-pserver-1." in env_map["PADDLE_PSERVERS"]
+        ps = cluster.get("v1", "Pod", "kubeflow", "pd-pserver-0")
+        ps_env = {e["name"]: e["value"]
+                  for e in ps["spec"]["containers"][0]["env"]}
+        assert ps_env["PADDLE_TRAINING_ROLE"] == "PSERVER"
+
+    def test_chainerjob_renders_mpi_hostlist(self):
+        cluster = FakeCluster()
+        mgr = Manager(cluster)
+        mgr.add(TrainingJobReconciler("ChainerJob"))
+        tmpl = {"spec": {"containers": [{"name": "t", "image": "i"}]}}
+        cluster.create({
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "ChainerJob",
+            "metadata": {"name": "ch", "namespace": "kubeflow"},
+            "spec": {"chainerReplicaSpecs": {
+                "Master": {"replicas": 1, "template": tmpl},
+                "Worker": {"replicas": 2, "template": tmpl},
+            }},
+        })
+        mgr.run_pending()
+        m = cluster.get("v1", "Pod", "kubeflow", "ch-master-0")
+        env_map = {e["name"]: e["value"]
+                   for e in m["spec"]["containers"][0]["env"]}
+        assert env_map["KFTPU_MPI_NUM_HOSTS"] == "2"
+        assert "ch-worker-0." in env_map["KFTPU_MPI_HOSTS"]
+
+    def test_chainerjob_tpu_replicas_get_hostlist(self):
+        """A ChainerJob with a TPU gang: the master AND the TPU pods all
+        carry the gang's hostlist (the gap a master-only render leaves)."""
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        mgr = Manager(cluster)
+        mgr.add(TrainingJobReconciler("ChainerJob"))
+        tmpl = {"spec": {"containers": [{"name": "t", "image": "i"}]}}
+        cluster.create({
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "ChainerJob",
+            "metadata": {"name": "cht", "namespace": "kubeflow"},
+            "spec": {"chainerReplicaSpecs": {
+                "Master": {"replicas": 1, "template": tmpl},
+                "TPU": {"tpuTopology": "v5e-8", "template": tmpl},
+            }},
+        })
+        mgr.run_pending()
+        for pod_name in ("cht-master-0", "cht-worker-0-0", "cht-worker-0-1"):
+            p = cluster.get("v1", "Pod", "kubeflow", pod_name)
+            env_map = {e["name"]: e["value"]
+                       for e in p["spec"]["containers"][0]["env"]}
+            assert env_map["KFTPU_MPI_NUM_HOSTS"] == "2", pod_name
+            assert "cht-worker-0-0." in env_map["KFTPU_MPI_HOSTS"], pod_name
+
+    def test_all_kinds_accept_tpu_replicas(self):
+        """The whole point of the build: every legacy kind gains the TPU
+        replica type (BASELINE.json north star)."""
+        from kubeflow_tpu.api.trainingjob import (API_VERSIONS, JOB_KINDS,
+                                                  TrainingJob, _SPECS_KEY)
+        for kind in JOB_KINDS:
+            specs_key = _SPECS_KEY[kind]
+            job = TrainingJob.from_manifest({
+                "apiVersion": API_VERSIONS[kind], "kind": kind,
+                "metadata": {"name": "j", "namespace": "kubeflow"},
+                "spec": {specs_key: {"TPU": {
+                    "tpuTopology": "v5e-8",
+                    "template": {"spec": {"containers": [
+                        {"name": "w", "image": "i"}]}}}}}})
+            assert job.tpu_spec is not None
+            assert job.total_pods() == 2  # v5e-8 = 2 hosts
+
     def test_mpijob_tpu_shorthand_renders_hostlist(self):
         cluster = FakeCluster()
         cluster.add_tpu_slice_nodes("v5e-16")

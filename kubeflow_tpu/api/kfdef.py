@@ -55,6 +55,10 @@ DEFAULT_COMPONENTS = [
     "centraldashboard",
     "katib",
     "kubebench",
+    "argo",
+    "pipeline-scheduledworkflow",
+    "pipeline-apiserver",
+    "pipeline-ui",
     "tpu-serving",
     "metric-collector",
     "spartakus",

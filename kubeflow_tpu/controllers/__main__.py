@@ -40,22 +40,31 @@ def _register_defaults() -> None:
     for kind in JOB_KINDS:
         CONTROLLER_FACTORIES[kind.lower()] = (
             lambda k=kind: TrainingJobReconciler(k))
+    from ..pipelines.scheduled import ScheduledWorkflowReconciler
+
     CONTROLLER_FACTORIES["notebook"] = NotebookReconciler
     CONTROLLER_FACTORIES["profile"] = ProfileReconciler
     CONTROLLER_FACTORIES["statefulset"] = StatefulSetReconciler
     CONTROLLER_FACTORIES["workflow"] = WorkflowReconciler
     CONTROLLER_FACTORIES["studyjob"] = StudyJobReconciler
+    CONTROLLER_FACTORIES["scheduledworkflow"] = ScheduledWorkflowReconciler
 
 
-def build_manager(client, controllers: list[str]) -> Manager:
+def build_manager(client, controllers: list[str],
+                  store_path: str = "") -> Manager:
     _register_defaults()
     mgr = Manager(client)
     for name in controllers:
+        if name == "persistenceagent":
+            # needs the run store (pipeline-apiserver shares the same file)
+            from ..pipelines.store import PersistenceAgent, RunStore
+            mgr.add(PersistenceAgent(RunStore(store_path or ":memory:")))
+            continue
         factory = CONTROLLER_FACTORIES.get(name)
         if factory is None:
             raise SystemExit(
                 f"unknown controller {name!r}; "
-                f"available: {sorted(CONTROLLER_FACTORIES)}")
+                f"available: {sorted(CONTROLLER_FACTORIES) + ['persistenceagent']}")
         mgr.add(factory())
     return mgr
 
@@ -75,6 +84,8 @@ def main(argv=None) -> int:
                    help="comma-separated subset to run")
     p.add_argument("--fake", action="store_true",
                    help="run over an in-memory cluster (demo/testing)")
+    p.add_argument("--store", default="",
+                   help="run-store sqlite path (persistenceagent)")
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args(argv)
 
@@ -92,7 +103,7 @@ def main(argv=None) -> int:
         p.error("--kubeconfig is required (or --fake)")
 
     names = [c.strip() for c in args.controllers.split(",") if c.strip()]
-    mgr = build_manager(client, names)
+    mgr = build_manager(client, names, store_path=args.store)
     log.info("manager running %d controllers: %s", len(mgr.controllers),
              ", ".join(names))
     mgr.start_all()

@@ -1,0 +1,83 @@
+"""The pipelines package: argo engine + Kubeflow Pipelines services.
+
+Reference: kubeflow/argo/argo.libsonnet (Workflow CRD + controller + UI)
+and kubeflow/pipeline/*.libsonnet (apiserver, scheduledworkflow,
+persistenceagent, ui, mysql/minio storage — 1,832 LoC of jsonnet). The
+TPU build's runtimes live in kubeflow_tpu/workflows (engine) and
+kubeflow_tpu/pipelines (scheduled/store/api_server); these manifests
+deploy them.
+"""
+
+from __future__ import annotations
+
+from . import helpers as H
+from .registry import register
+
+VERSION = "v0.1.0"
+IMG = "ghcr.io/kubeflow-tpu"
+
+
+@register("argo", "Workflow CRD + engine controller "
+                  "(kubeflow/argo/argo.libsonnet parity)")
+def argo(namespace: str = "kubeflow") -> list[dict]:
+    crd = H.crd("workflows", "Workflow", "argoproj.io", ["v1alpha1"])
+    sa = H.service_account("workflow-controller", namespace)
+    role = H.cluster_role("workflow-controller", [
+        {"apiGroups": ["argoproj.io"], "resources": ["workflows"],
+         "verbs": ["*"]},
+        {"apiGroups": [""], "resources": ["pods", "configmaps"],
+         "verbs": ["*"]},
+        {"apiGroups": ["tpu.kubeflow.org", "kubeflow.org"],
+         "resources": ["*"], "verbs": ["*"]},  # resource templates
+    ])
+    binding = H.cluster_role_binding("workflow-controller",
+                                     "workflow-controller",
+                                     "workflow-controller", namespace)
+    dep = H.deployment("workflow-controller", namespace,
+                       f"{IMG}/manager:{VERSION}",
+                       args=["--controllers=workflow"],
+                       service_account="workflow-controller", port=9090)
+    return [crd, sa, role, binding, dep]
+
+
+@register("pipeline-scheduledworkflow",
+          "ScheduledWorkflow CRD + cron controller "
+          "(pipeline-scheduledworkflow.libsonnet parity)")
+def pipeline_scheduledworkflow(namespace: str = "kubeflow") -> list[dict]:
+    crd = H.crd("scheduledworkflows", "ScheduledWorkflow", "kubeflow.org",
+                ["v1beta1"])
+    dep = H.deployment("ml-pipeline-scheduledworkflow", namespace,
+                       f"{IMG}/manager:{VERSION}",
+                       args=["--controllers=scheduledworkflow"],
+                       service_account="workflow-controller", port=9091)
+    return [crd, dep]
+
+
+@register("pipeline-apiserver", "Pipeline run/job REST API + persistence "
+                                "(pipeline-apiserver + "
+                                "persistenceagent + mysql parity)")
+def pipeline_apiserver(namespace: str = "kubeflow",
+                       store_path: str = "/var/lib/kubeflow/runs.db"
+                       ) -> list[dict]:
+    dep = H.deployment(
+        "ml-pipeline", namespace, f"{IMG}/pipeline-api:{VERSION}",
+        args=[f"--store={store_path}"],
+        service_account="workflow-controller", port=8888)
+    svc = H.service("ml-pipeline", namespace, 8888)
+    # persistence agent: workflow watcher feeding the run store (the
+    # sqlite file replaces the reference's mysql.libsonnet pod)
+    agent = H.deployment(
+        "ml-pipeline-persistenceagent", namespace,
+        f"{IMG}/manager:{VERSION}",
+        args=["--controllers=persistenceagent", f"--store={store_path}"],
+        service_account="workflow-controller", port=9092)
+    return [dep, svc, agent]
+
+
+@register("pipeline-ui", "Pipelines UI page served by the central "
+                         "dashboard (pipeline-ui.libsonnet parity)")
+def pipeline_ui(namespace: str = "kubeflow") -> list[dict]:
+    svc = H.service("ml-pipeline-ui", namespace, 3000)
+    vs = H.virtual_service("ml-pipeline-ui", namespace, "/pipeline/",
+                           "ml-pipeline-ui", 3000)
+    return [svc, vs]

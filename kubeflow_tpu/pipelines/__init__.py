@@ -1,0 +1,25 @@
+"""Kubeflow Pipelines equivalent over the in-repo workflow engine.
+
+Reference: the ``kubeflow/pipeline`` package deploys four services
+(SURVEY.md §2.3 argo/pipeline row; VERDICT r1 missing item 4):
+``pipeline-apiserver.libsonnet`` (run/pipeline/job REST API),
+``pipeline-scheduledworkflow.libsonnet`` (cron controller),
+``pipeline-persistenceagent.libsonnet`` (workflow → run-history DB),
+``pipeline-ui.libsonnet``. The TPU-native equivalents:
+
+- ``scheduled``  — ScheduledWorkflow CR + reconciler (cron/periodic
+  triggers, maxConcurrency, run history) over the Workflow engine.
+- ``store``      — sqlite run persistence + the persistence-agent
+  reconciler recording every Workflow's lifecycle.
+- ``api_server`` — the REST surface (pipelines/runs/jobs) the UI and
+  clients consume.
+"""
+
+from .scheduled import (SCHEDULED_WF_API_VERSION, SCHEDULED_WF_KIND,
+                        ScheduledWorkflowReconciler, next_fire_time,
+                        parse_cron)
+from .store import PersistenceAgent, RunStore
+
+__all__ = ["ScheduledWorkflowReconciler", "parse_cron", "next_fire_time",
+           "RunStore", "PersistenceAgent", "SCHEDULED_WF_API_VERSION",
+           "SCHEDULED_WF_KIND"]

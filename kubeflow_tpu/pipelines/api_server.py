@@ -1,0 +1,196 @@
+"""The pipeline REST API: pipelines / runs / jobs.
+
+The pipeline-apiserver analog (kubeflow/pipeline/pipeline-apiserver
+.libsonnet; upstream ml-pipeline API shape, v1beta1 path prefix):
+
+- ``POST/GET/DELETE /apis/v1beta1/pipelines`` — uploaded Workflow
+  templates (stored in the RunStore).
+- ``POST /apis/v1beta1/runs`` — create a run from a pipeline id or an
+  inline workflow spec (instantiates a Workflow CR the engine executes);
+  ``GET /apis/v1beta1/runs[?namespace=&phase=&schedule=]`` and
+  ``GET /apis/v1beta1/runs/{ns}/{name}`` read the persisted history.
+- ``POST/GET/DELETE /apis/v1beta1/jobs`` — ScheduledWorkflows ("jobs" in
+  pipeline API vocabulary); ``POST /apis/v1beta1/jobs/{ns}/{name}:enable``
+  / ``:disable`` flip the schedule.
+- ``/healthz``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import k8s
+from ..cluster.client import KubeClient, NotFoundError
+from ..webapps._http import ApiError, JsonApp, JsonServer
+from ..workflows.engine import WORKFLOW_API_VERSION, WORKFLOW_KIND
+from .scheduled import SCHEDULED_WF_API_VERSION, SCHEDULED_WF_KIND
+from .store import RunStore
+
+PREFIX = "/apis/v1beta1"
+
+
+def build_pipeline_app(client: KubeClient, store: RunStore,
+                       namespace: str = "kubeflow") -> JsonApp:
+    app = JsonApp()
+
+    @app.route("GET", "/healthz")
+    def healthz(params, query, body):
+        return 200, {"ok": True}
+
+    # -- pipelines ----------------------------------------------------------
+
+    @app.route("POST", f"{PREFIX}/pipelines")
+    def upload_pipeline(params, query, body):
+        if not body or not body.get("name") or not body.get("workflow"):
+            raise ApiError(400, "name and workflow are required")
+        return 200, store.put_pipeline(body["name"], body["workflow"],
+                                       body.get("description", ""))
+
+    @app.route("GET", f"{PREFIX}/pipelines")
+    def list_pipelines(params, query, body):
+        return 200, {"pipelines": store.list_pipelines()}
+
+    @app.route("GET", f"{PREFIX}/pipelines/{{name}}")
+    def get_pipeline(params, query, body):
+        p = store.get_pipeline(params["name"])
+        if p is None:
+            raise ApiError(404, f"pipeline {params['name']} not found")
+        return 200, p
+
+    @app.route("DELETE", f"{PREFIX}/pipelines/{{name}}")
+    def delete_pipeline(params, query, body):
+        if not store.delete_pipeline(params["name"]):
+            raise ApiError(404, f"pipeline {params['name']} not found")
+        return 200, {"deleted": params["name"]}
+
+    # -- runs ---------------------------------------------------------------
+
+    def _workflow_spec_from(body: dict) -> tuple[dict, Optional[str]]:
+        if body.get("pipeline"):
+            p = store.get_pipeline(body["pipeline"])
+            if p is None:
+                raise ApiError(404, f"pipeline {body['pipeline']} not found")
+            return p["workflow"], body["pipeline"]
+        if body.get("workflow"):
+            return body["workflow"], None
+        raise ApiError(400, "one of pipeline (id) or workflow (spec) "
+                            "is required")
+
+    @app.route("POST", f"{PREFIX}/runs")
+    def create_run(params, query, body):
+        if not body or not body.get("name"):
+            raise ApiError(400, "name is required")
+        wf_spec, pipeline_id = _workflow_spec_from(body)
+        ns = body.get("namespace", namespace)
+        params_list = body.get("parameters") or []
+        spec = dict(wf_spec)
+        if params_list:
+            args = dict(spec.get("arguments") or {})
+            args["parameters"] = params_list
+            spec["arguments"] = args
+        wf = {
+            "apiVersion": WORKFLOW_API_VERSION, "kind": WORKFLOW_KIND,
+            "metadata": {"name": body["name"], "namespace": ns,
+                         "labels": ({"pipelines.kubeflow.org/pipeline":
+                                     pipeline_id} if pipeline_id else {})},
+            "spec": spec,
+        }
+        created = client.create(wf)
+        store.upsert_run(created)
+        return 200, {"run_id": f"{ns}/{body['name']}"}
+
+    @app.route("GET", f"{PREFIX}/runs")
+    def list_runs(params, query, body):
+        return 200, {"runs": store.list_runs(
+            namespace=query.get("namespace"),
+            schedule=query.get("schedule"),
+            phase=query.get("phase"),
+            limit=int(query.get("limit", "100")))}
+
+    @app.route("GET", f"{PREFIX}/runs/{{ns}}/{{name}}")
+    def get_run(params, query, body):
+        run = store.get_run(f"{params['ns']}/{params['name']}")
+        if run is None:
+            raise ApiError(404, f"run {params['ns']}/{params['name']} "
+                                "not found")
+        return 200, run
+
+    # -- jobs (ScheduledWorkflows) ------------------------------------------
+
+    @app.route("POST", f"{PREFIX}/jobs")
+    def create_job(params, query, body):
+        if not body or not body.get("name"):
+            raise ApiError(400, "name is required")
+        if not body.get("trigger"):
+            raise ApiError(400, "trigger is required "
+                                "(cronSchedule or periodicSchedule)")
+        wf_spec, _ = _workflow_spec_from(body)
+        ns = body.get("namespace", namespace)
+        swf = {
+            "apiVersion": SCHEDULED_WF_API_VERSION,
+            "kind": SCHEDULED_WF_KIND,
+            "metadata": {"name": body["name"], "namespace": ns},
+            "spec": {
+                "enabled": body.get("enabled", True),
+                "maxConcurrency": body.get("maxConcurrency", 1),
+                "maxHistory": body.get("maxHistory", 10),
+                "trigger": body["trigger"],
+                "workflow": {"spec": wf_spec},
+            },
+        }
+        client.create(swf)
+        return 200, {"job_id": f"{ns}/{body['name']}"}
+
+    @app.route("GET", f"{PREFIX}/jobs")
+    def list_jobs(params, query, body):
+        jobs = client.list(SCHEDULED_WF_API_VERSION, SCHEDULED_WF_KIND,
+                           namespace=query.get("namespace"))
+        return 200, {"jobs": [{
+            "name": k8s.name_of(j),
+            "namespace": k8s.namespace_of(j, "default"),
+            "enabled": j.get("spec", {}).get("enabled", True),
+            "trigger": j.get("spec", {}).get("trigger"),
+            "status": {k: v for k, v in (j.get("status") or {}).items()
+                       if k in ("lastTriggeredTime", "nextTriggeredTime",
+                                "runs")},
+        } for j in jobs]}
+
+    @app.route("DELETE", f"{PREFIX}/jobs/{{ns}}/{{name}}")
+    def delete_job(params, query, body):
+        try:
+            client.delete(SCHEDULED_WF_API_VERSION, SCHEDULED_WF_KIND,
+                          params["ns"], params["name"])
+        except NotFoundError:
+            raise ApiError(404, f"job {params['name']} not found")
+        return 200, {"deleted": params["name"]}
+
+    def _set_enabled(params, enabled: bool):
+        try:
+            client.patch(SCHEDULED_WF_API_VERSION, SCHEDULED_WF_KIND,
+                         params["ns"], params["name"],
+                         {"spec": {"enabled": enabled}})
+        except NotFoundError:
+            raise ApiError(404, f"job {params['name']} not found")
+        return 200, {"name": params["name"], "enabled": enabled}
+
+    # ':' is not a path separator; the {name} capture excludes '/', so the
+    # verb routes need their own patterns
+    @app.route("POST", f"{PREFIX}/jobs/{{ns}}/{{name}}:enable")
+    def enable_job(params, query, body):
+        return _set_enabled(params, True)
+
+    @app.route("POST", f"{PREFIX}/jobs/{{ns}}/{{name}}:disable")
+    def disable_job(params, query, body):
+        return _set_enabled(params, False)
+
+    return app
+
+
+class PipelineAPIServer(JsonServer):
+    """Deployable pipeline apiserver (pipeline-apiserver.libsonnet role)."""
+
+    def __init__(self, client: KubeClient, store: Optional[RunStore] = None,
+                 namespace: str = "kubeflow", **kw):
+        self.store = store or RunStore()
+        super().__init__(build_pipeline_app(client, self.store, namespace),
+                         name="pipeline-api", **kw)

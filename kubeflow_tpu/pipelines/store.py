@@ -1,0 +1,185 @@
+"""Run persistence: the pipeline-persistenceagent + DB analog.
+
+The reference persists run history through a persistence agent watching
+Argo Workflows into MySQL behind the pipeline apiserver
+(pipeline-persistenceagent.libsonnet, pipeline-apiserver.libsonnet +
+mysql.libsonnet). Here: a sqlite-backed RunStore (stdlib, file or
+in-memory) and a PersistenceAgent reconciler that records every
+Workflow's lifecycle — so run history survives Workflow deletion and is
+queryable over the pipeline API long after the cluster objects are gone.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from typing import Optional
+
+from ..api import k8s
+from ..cluster.client import KubeClient, NotFoundError
+from ..controllers.runtime import Key, Reconciler, Result
+from ..workflows.engine import (TERMINAL, WORKFLOW_API_VERSION,
+                                WORKFLOW_KIND)
+from .scheduled import SCHEDULE_LABEL
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS runs (
+    run_id      TEXT PRIMARY KEY,     -- namespace/name
+    name        TEXT NOT NULL,
+    namespace   TEXT NOT NULL,
+    schedule    TEXT,                 -- owning ScheduledWorkflow, if any
+    phase       TEXT NOT NULL,
+    message     TEXT,
+    created_at  REAL NOT NULL,
+    finished_at REAL,
+    nodes       TEXT                  -- JSON status.nodes snapshot
+);
+CREATE TABLE IF NOT EXISTS pipelines (
+    pipeline_id TEXT PRIMARY KEY,     -- name
+    description TEXT,
+    created_at  REAL NOT NULL,
+    workflow    TEXT NOT NULL         -- JSON Workflow spec template
+);
+"""
+
+
+class RunStore:
+    """sqlite-backed store for run history + uploaded pipeline templates."""
+
+    def __init__(self, path: str = ":memory:"):
+        self.path = path
+        # one connection guarded by a lock: writers are reconcilers and the
+        # API server; sqlite serializes anyway and this keeps :memory: usable
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.row_factory = sqlite3.Row
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    # -- runs ---------------------------------------------------------------
+
+    def upsert_run(self, wf: dict, clock=time.time) -> None:
+        name = k8s.name_of(wf)
+        ns = k8s.namespace_of(wf, "default")
+        run_id = f"{ns}/{name}"
+        status = wf.get("status", {}) or {}
+        phase = status.get("phase", "Pending")
+        finished = clock() if phase in TERMINAL else None
+        with self._lock:
+            existing = self._conn.execute(
+                "SELECT created_at, finished_at FROM runs WHERE run_id=?",
+                (run_id,)).fetchone()
+            created = existing["created_at"] if existing else clock()
+            if existing and existing["finished_at"] is not None:
+                finished = existing["finished_at"]  # terminal time is sticky
+            self._conn.execute(
+                "INSERT INTO runs (run_id, name, namespace, schedule, phase,"
+                " message, created_at, finished_at, nodes)"
+                " VALUES (?,?,?,?,?,?,?,?,?)"
+                " ON CONFLICT(run_id) DO UPDATE SET phase=excluded.phase,"
+                " message=excluded.message, finished_at=excluded.finished_at,"
+                " nodes=excluded.nodes",
+                (run_id, name, ns,
+                 k8s.labels_of(wf).get(SCHEDULE_LABEL),
+                 phase, status.get("message", ""),
+                 created, finished,
+                 json.dumps(status.get("nodes", {}))))
+            self._conn.commit()
+
+    def get_run(self, run_id: str) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT * FROM runs WHERE run_id=?", (run_id,)).fetchone()
+        return self._run_dict(row) if row else None
+
+    def list_runs(self, namespace: Optional[str] = None,
+                  schedule: Optional[str] = None,
+                  phase: Optional[str] = None,
+                  limit: int = 100) -> list[dict]:
+        q = "SELECT * FROM runs WHERE 1=1"
+        args: list = []
+        for col, val in (("namespace", namespace), ("schedule", schedule),
+                         ("phase", phase)):
+            if val:
+                q += f" AND {col}=?"
+                args.append(val)
+        q += " ORDER BY created_at DESC LIMIT ?"
+        args.append(limit)
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        return [self._run_dict(r) for r in rows]
+
+    @staticmethod
+    def _run_dict(row: sqlite3.Row) -> dict:
+        d = dict(row)
+        d["nodes"] = json.loads(d.get("nodes") or "{}")
+        return d
+
+    # -- pipelines (uploaded templates) -------------------------------------
+
+    def put_pipeline(self, name: str, workflow: dict,
+                     description: str = "", clock=time.time) -> dict:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO pipelines (pipeline_id, description,"
+                " created_at, workflow) VALUES (?,?,?,?)"
+                " ON CONFLICT(pipeline_id) DO UPDATE SET"
+                " description=excluded.description,"
+                " workflow=excluded.workflow",
+                (name, description, clock(), json.dumps(workflow)))
+            self._conn.commit()
+        return {"id": name, "description": description}
+
+    def get_pipeline(self, name: str) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT * FROM pipelines WHERE pipeline_id=?",
+                (name,)).fetchone()
+        if row is None:
+            return None
+        d = dict(row)
+        d["workflow"] = json.loads(d["workflow"])
+        return d
+
+    def list_pipelines(self) -> list[dict]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT pipeline_id, description, created_at FROM pipelines"
+                " ORDER BY pipeline_id").fetchall()
+        return [dict(r) for r in rows]
+
+    def delete_pipeline(self, name: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM pipelines WHERE pipeline_id=?", (name,))
+            self._conn.commit()
+            return cur.rowcount > 0
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+class PersistenceAgent(Reconciler):
+    """Watches Workflows, mirrors them into the RunStore — the
+    pipeline-persistenceagent analog. Runs outlive their Workflows: a
+    deleted Workflow keeps its last recorded state."""
+
+    primary = (WORKFLOW_API_VERSION, WORKFLOW_KIND)
+    owns: list = []
+
+    def __init__(self, store: RunStore, clock=time.time):
+        self.store = store
+        self.clock = clock
+
+    def reconcile(self, client: KubeClient, key: Key) -> Result:
+        ns, name = key
+        try:
+            wf = client.get(WORKFLOW_API_VERSION, WORKFLOW_KIND, ns, name)
+        except NotFoundError:
+            return Result()  # keep the last recorded state
+        self.store.upsert_run(wf, clock=self.clock)
+        return Result()

@@ -1,0 +1,360 @@
+"""Pipelines subsystem: ScheduledWorkflow cron controller, run
+persistence, and the pipeline REST API.
+
+Reference parity targets (VERDICT r1 item 6):
+pipeline-scheduledworkflow.libsonnet (cron + run history),
+pipeline-apiserver.libsonnet (runs recorded and listable over HTTP),
+pipeline-persistenceagent.libsonnet (workflow → run DB).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.api import k8s
+from kubeflow_tpu.cluster import FakeCluster
+from kubeflow_tpu.controllers.runtime import Manager
+from kubeflow_tpu.pipelines import (PersistenceAgent, RunStore,
+                                    ScheduledWorkflowReconciler,
+                                    next_fire_time, parse_cron)
+from kubeflow_tpu.pipelines.api_server import PipelineAPIServer
+from kubeflow_tpu.workflows.engine import WorkflowReconciler
+
+
+class FakeClock:
+    def __init__(self, t=1_700_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def swf_manifest(name="sched", trigger=None, wf_steps=None, **spec_extra):
+    container = {"image": "busybox", "command": ["true"]}
+    wf_spec = {
+        "entrypoint": "main",
+        "templates": [{"name": "main", "container": container}],
+    }
+    return {
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "ScheduledWorkflow",
+        "metadata": {"name": name, "namespace": "kubeflow"},
+        "spec": {
+            "trigger": trigger or {"periodicSchedule": {"intervalSecond": 60}},
+            "workflow": {"spec": wf_spec},
+            **spec_extra,
+        },
+    }
+
+
+class TestCron:
+    def test_parse_basic(self):
+        minutes, hours, dom, months, dow = parse_cron("0 * * * *")
+        assert minutes == frozenset({0})
+        assert hours == frozenset(range(24))
+        assert dow == frozenset(range(7))
+
+    def test_parse_steps_ranges_lists(self):
+        minutes, hours, *_ = parse_cron("*/15 9-17 * * 1,3,5")
+        assert minutes == frozenset({0, 15, 30, 45})
+        assert hours == frozenset(range(9, 18))
+
+    def test_sunday_is_0_and_7(self):
+        *_, dow7 = parse_cron("0 0 * * 7")
+        *_, dow0 = parse_cron("0 0 * * 0")
+        assert dow7 == dow0 == frozenset({0})
+
+    def test_invalid_rejected(self):
+        for bad in ("* * * *", "61 * * * *", "* 24 * * *", "*/0 * * * *"):
+            with pytest.raises(ValueError):
+                parse_cron(bad)
+
+    def test_next_fire_hourly(self):
+        # 2023-11-14 22:13:20 UTC → next hourly fire at 23:00:00
+        t = next_fire_time("0 * * * *", 1_700_000_000.0)
+        assert t == 1_700_002_800.0
+
+    def test_next_fire_strictly_after(self):
+        t0 = next_fire_time("* * * * *", 1_700_000_000.0)
+        assert t0 > 1_700_000_000.0
+        assert next_fire_time("* * * * *", t0) == t0 + 60
+
+    def test_dom_dow_either_matches_when_both_restricted(self):
+        # kube-cron: dom=1 OR Sunday, whichever comes first
+        t = next_fire_time("0 0 1 * 0", 1_700_000_000.0)  # Tue Nov 14 2023
+        import time as _time
+        tm = _time.gmtime(t)
+        assert tm.tm_mday == 1 or (tm.tm_wday + 1) % 7 == 0
+
+
+@pytest.fixture
+def env():
+    cluster = FakeCluster()
+    cluster.add_node("cpu-0", {"cpu": 96, "memory": 2 ** 36})
+    clock = FakeClock()
+    mgr = Manager(cluster)
+    mgr.add(ScheduledWorkflowReconciler(clock=clock))
+    mgr.add(WorkflowReconciler(clock=clock))
+    return cluster, mgr, clock
+
+
+def drive(cluster, mgr, rounds=3):
+    for _ in range(rounds):
+        # make timed requeues due NOW: requeue_after delays are held against
+        # real time.monotonic, which FakeClock does not advance
+        for c in mgr.controllers:
+            c._delayed = [(0.0, k) for _, k in c._delayed]
+        mgr.run_pending()
+        cluster.tick()
+    mgr.run_pending()
+
+
+class TestScheduledWorkflow:
+    def test_fires_on_tick_and_records_run(self, env):
+        cluster, mgr, clock = env
+        cluster.create(swf_manifest())
+        mgr.run_pending()
+        # not due yet: next fire anchored at creation + 60
+        assert cluster.list("argoproj.io/v1alpha1", "Workflow",
+                            "kubeflow") == []
+        clock.advance(61)
+        drive(cluster, mgr)
+        wfs = cluster.list("argoproj.io/v1alpha1", "Workflow", "kubeflow")
+        assert len(wfs) == 1
+        assert k8s.name_of(wfs[0]) == "sched-1"
+        # pod runs to completion → run history records Succeeded
+        pod = cluster.list("v1", "Pod", "kubeflow")[0]
+        cluster.set_pod_phase("kubeflow", k8s.name_of(pod), "Succeeded")
+        drive(cluster, mgr)
+        swf = cluster.get("kubeflow.org/v1beta1", "ScheduledWorkflow",
+                          "kubeflow", "sched")
+        runs = swf["status"]["runs"]
+        assert runs[0]["name"] == "sched-1"
+        assert runs[0]["phase"] == "Succeeded"
+
+    def test_cron_trigger(self, env):
+        cluster, mgr, clock = env
+        clock.t = 1_700_000_000.0  # 22:13:20 UTC
+        cluster.create(swf_manifest(
+            trigger={"cronSchedule": {"cron": "0 * * * *"}}))
+        mgr.run_pending()
+        swf = cluster.get("kubeflow.org/v1beta1", "ScheduledWorkflow",
+                          "kubeflow", "sched")
+        assert swf["status"]["nextTriggeredTime"] == 1_700_002_800.0
+        clock.t = 1_700_002_801.0
+        drive(cluster, mgr)
+        assert len(cluster.list("argoproj.io/v1alpha1", "Workflow",
+                                "kubeflow")) == 1
+
+    def test_max_concurrency_holds_trigger(self, env):
+        cluster, mgr, clock = env
+        cluster.create(swf_manifest(maxConcurrency=1))
+        mgr.run_pending()  # anchor the schedule before advancing
+        clock.advance(61)
+        drive(cluster, mgr)
+        assert len(cluster.list("argoproj.io/v1alpha1", "Workflow",
+                                "kubeflow")) == 1
+        # second fire due but first run still active → held
+        clock.advance(61)
+        drive(cluster, mgr)
+        wfs = cluster.list("argoproj.io/v1alpha1", "Workflow", "kubeflow")
+        assert len(wfs) == 1
+        # finish the run → next reconcile triggers the held run
+        pod = cluster.list("v1", "Pod", "kubeflow")[0]
+        cluster.set_pod_phase("kubeflow", k8s.name_of(pod), "Succeeded")
+        drive(cluster, mgr)
+        wfs = cluster.list("argoproj.io/v1alpha1", "Workflow", "kubeflow")
+        assert len(wfs) == 2
+
+    def test_disabled_never_fires(self, env):
+        cluster, mgr, clock = env
+        cluster.create(swf_manifest(enabled=False))
+        clock.advance(3600)
+        drive(cluster, mgr)
+        assert cluster.list("argoproj.io/v1alpha1", "Workflow",
+                            "kubeflow") == []
+
+    def test_history_trimmed(self, env):
+        cluster, mgr, clock = env
+        cluster.create(swf_manifest(maxHistory=2, maxConcurrency=5))
+        mgr.run_pending()  # anchor the schedule before advancing
+        for _ in range(4):
+            clock.advance(61)
+            drive(cluster, mgr)
+            for pod in cluster.list("v1", "Pod", "kubeflow"):
+                if pod.get("status", {}).get("phase") == "Running":
+                    cluster.set_pod_phase("kubeflow", k8s.name_of(pod),
+                                          "Succeeded")
+            drive(cluster, mgr)
+        swf = cluster.get("kubeflow.org/v1beta1", "ScheduledWorkflow",
+                          "kubeflow", "sched")
+        runs = swf["status"]["runs"]
+        assert len(runs) == 2  # trimmed to maxHistory
+        assert {r["name"] for r in runs} == {"sched-3", "sched-4"}
+
+    def test_delete_cascades_to_workflows(self, env):
+        cluster, mgr, clock = env
+        cluster.create(swf_manifest())
+        mgr.run_pending()  # anchor the schedule before advancing
+        clock.advance(61)
+        drive(cluster, mgr)
+        assert len(cluster.list("argoproj.io/v1alpha1", "Workflow",
+                                "kubeflow")) == 1
+        cluster.delete("kubeflow.org/v1beta1", "ScheduledWorkflow",
+                       "kubeflow", "sched")
+        assert cluster.list("argoproj.io/v1alpha1", "Workflow",
+                            "kubeflow") == []
+
+
+class TestRunStore:
+    def test_upsert_and_terminal_sticky(self):
+        store = RunStore()
+        clock = FakeClock()
+        wf = {"apiVersion": "argoproj.io/v1alpha1", "kind": "Workflow",
+              "metadata": {"name": "r1", "namespace": "kubeflow"},
+              "status": {"phase": "Running"}}
+        store.upsert_run(wf, clock=clock)
+        clock.advance(10)
+        wf["status"] = {"phase": "Succeeded", "nodes": {"main": {
+            "phase": "Succeeded"}}}
+        store.upsert_run(wf, clock=clock)
+        run = store.get_run("kubeflow/r1")
+        assert run["phase"] == "Succeeded"
+        assert run["finished_at"] == clock.t
+        finished = run["finished_at"]
+        clock.advance(10)
+        store.upsert_run(wf, clock=clock)  # re-observe: time must not move
+        assert store.get_run("kubeflow/r1")["finished_at"] == finished
+
+    def test_list_filters(self):
+        store = RunStore()
+        for i, phase in enumerate(["Succeeded", "Failed", "Running"]):
+            store.upsert_run({
+                "apiVersion": "argoproj.io/v1alpha1", "kind": "Workflow",
+                "metadata": {"name": f"r{i}", "namespace": "kubeflow",
+                             "labels": {
+                                 "scheduledworkflows.kubeflow.org/name":
+                                     "sched" if i < 2 else ""}},
+                "status": {"phase": phase}})
+        assert len(store.list_runs(namespace="kubeflow")) == 3
+        assert len(store.list_runs(phase="Failed")) == 1
+        assert len(store.list_runs(schedule="sched")) == 2
+
+    def test_persistence_agent_survives_workflow_deletion(self, env):
+        cluster, mgr, clock = env
+        store = RunStore()
+        mgr.add(PersistenceAgent(store, clock=clock))
+        cluster.create(swf_manifest())
+        mgr.run_pending()  # anchor the schedule before advancing
+        clock.advance(61)
+        drive(cluster, mgr)
+        pod = cluster.list("v1", "Pod", "kubeflow")[0]
+        cluster.set_pod_phase("kubeflow", k8s.name_of(pod), "Succeeded")
+        drive(cluster, mgr)
+        cluster.delete("kubeflow.org/v1beta1", "ScheduledWorkflow",
+                       "kubeflow", "sched")
+        mgr.run_pending()
+        run = store.get_run("kubeflow/sched-1")
+        assert run is not None and run["phase"] == "Succeeded"
+
+
+class TestPipelineAPI:
+    @pytest.fixture
+    def api(self):
+        cluster = FakeCluster()
+        clock = FakeClock()
+        mgr = Manager(cluster)
+        mgr.add(ScheduledWorkflowReconciler(clock=clock))
+        mgr.add(WorkflowReconciler(clock=clock))
+        server = PipelineAPIServer(cluster)
+        mgr.add(PersistenceAgent(server.store, clock=clock))
+        port = server.start()
+        yield cluster, mgr, clock, server, f"http://127.0.0.1:{port}"
+        server.stop()
+
+    def _req(self, url, payload=None, method=None):
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            url, data, {"Content-Type": "application/json"}, method=method)
+        try:
+            resp = urllib.request.urlopen(req)
+            return json.loads(resp.read()), resp.status
+        except urllib.error.HTTPError as e:
+            return json.loads(e.read()), e.code
+
+    def test_pipeline_upload_run_lifecycle(self, api):
+        cluster, mgr, clock, server, base = api
+        wf_spec = {"entrypoint": "main", "templates": [
+            {"name": "main", "container": {"image": "busybox"}}]}
+        out, code = self._req(f"{base}/apis/v1beta1/pipelines",
+                              {"name": "bench", "workflow": wf_spec})
+        assert code == 200
+        out, code = self._req(f"{base}/apis/v1beta1/pipelines")
+        assert [p["pipeline_id"] for p in out["pipelines"]] == ["bench"]
+
+        out, code = self._req(f"{base}/apis/v1beta1/runs",
+                              {"name": "bench-run-1", "pipeline": "bench"})
+        assert code == 200 and out["run_id"] == "kubeflow/bench-run-1"
+        drive(cluster, mgr)
+        pod = cluster.list("v1", "Pod", "kubeflow")[0]
+        cluster.set_pod_phase("kubeflow", k8s.name_of(pod), "Succeeded")
+        drive(cluster, mgr)
+        out, code = self._req(
+            f"{base}/apis/v1beta1/runs/kubeflow/bench-run-1")
+        assert code == 200 and out["phase"] == "Succeeded"
+        out, _ = self._req(f"{base}/apis/v1beta1/runs?phase=Succeeded")
+        assert len(out["runs"]) == 1
+
+    def test_job_lifecycle_over_http(self, api):
+        cluster, mgr, clock, server, base = api
+        wf_spec = {"entrypoint": "main", "templates": [
+            {"name": "main", "container": {"image": "busybox"}}]}
+        out, code = self._req(f"{base}/apis/v1beta1/jobs", {
+            "name": "nightly", "workflow": wf_spec,
+            "trigger": {"periodicSchedule": {"intervalSecond": 60}}})
+        assert code == 200
+        mgr.run_pending()  # anchor the schedule before advancing
+        clock.advance(61)
+        drive(cluster, mgr)
+        assert len(cluster.list("argoproj.io/v1alpha1", "Workflow",
+                                "kubeflow")) == 1
+        out, _ = self._req(f"{base}/apis/v1beta1/jobs")
+        assert out["jobs"][0]["name"] == "nightly"
+        out, code = self._req(
+            f"{base}/apis/v1beta1/jobs/kubeflow/nightly:disable", {})
+        assert code == 200 and out["enabled"] is False
+        swf = cluster.get("kubeflow.org/v1beta1", "ScheduledWorkflow",
+                          "kubeflow", "nightly")
+        assert swf["spec"]["enabled"] is False
+        out, code = self._req(f"{base}/apis/v1beta1/jobs/kubeflow/nightly",
+                              method="DELETE")
+        assert code == 200
+        assert cluster.list("kubeflow.org/v1beta1", "ScheduledWorkflow",
+                            "kubeflow") == []
+
+    def test_run_with_inline_workflow_and_params(self, api):
+        cluster, mgr, clock, server, base = api
+        wf_spec = {"entrypoint": "main", "templates": [
+            {"name": "main", "container": {
+                "image": "busybox",
+                "args": ["$(workflow.parameters.msg)"]}}]}
+        out, code = self._req(f"{base}/apis/v1beta1/runs", {
+            "name": "inline", "workflow": wf_spec,
+            "parameters": [{"name": "msg", "value": "hello"}]})
+        assert code == 200
+        mgr.run_pending()
+        pod = cluster.list("v1", "Pod", "kubeflow")[0]
+        assert pod["spec"]["containers"][0]["args"] == ["hello"]
+
+    def test_errors(self, api):
+        _, _, _, _, base = api
+        out, code = self._req(f"{base}/apis/v1beta1/runs",
+                              {"name": "x", "pipeline": "ghost"})
+        assert code == 404
+        out, code = self._req(f"{base}/apis/v1beta1/runs", {"name": "x"})
+        assert code == 400
+        out, code = self._req(f"{base}/apis/v1beta1/pipelines/none")
+        assert code == 404

@@ -210,9 +210,16 @@ class ScheduledWorkflowReconciler(Reconciler):
             runs.append(rec)
         for wname, wf in live.items():
             if wname not in seen:  # adopted (e.g. controller restart)
+                ann = (wf.get("metadata", {}).get("annotations") or {})
+                at = ann.get(
+                    "scheduledworkflows.kubeflow.org/scheduled-at")
+                try:
+                    at = float(at) if at is not None else None
+                except ValueError:
+                    at = None
                 runs.append({
                     "name": wname,
-                    "scheduledAt": None,
+                    "scheduledAt": at,
                     "phase": wf.get("status", {}).get("phase",
                                                       PHASE_RUNNING)})
         return runs
@@ -248,6 +255,17 @@ class ScheduledWorkflowReconciler(Reconciler):
         return {"name": name, "scheduledAt": fire_time,
                 "phase": PHASE_RUNNING}
 
+    @staticmethod
+    def _trigger_index(swf: dict, run_name: str) -> int:
+        """Trigger ordinal encoded in the generated run name, 0 if foreign."""
+        prefix = k8s.name_of(swf) + "-"
+        if run_name.startswith(prefix):
+            try:
+                return int(run_name[len(prefix):])
+            except ValueError:
+                pass
+        return 0
+
     def _trim_history(self, client: KubeClient, swf: dict, runs: list[dict],
                       max_history: int) -> list[dict]:
         """Keep every active run + the most recent terminal ones; GC the
@@ -256,6 +274,13 @@ class ScheduledWorkflowReconciler(Reconciler):
         history beyond this lives in the persistence store."""
         active = [r for r in runs if r["phase"] not in TERMINAL]
         done = [r for r in runs if r["phase"] in TERMINAL]
+        # status.runs keeps active runs at the head, so a run's list position
+        # says nothing about age once it completes.  Order terminal runs
+        # chronologically (scheduledAt, falling back to the trigger index in
+        # the generated name) so the slice below keeps the NEWEST runs.
+        done.sort(key=lambda r: (r.get("scheduledAt") is None,
+                                 r.get("scheduledAt") or 0.0,
+                                 self._trigger_index(swf, r["name"])))
         ns = k8s.namespace_of(swf, "default")
         for rec in done[:-max_history] if max_history else done:
             try:
@@ -263,4 +288,4 @@ class ScheduledWorkflowReconciler(Reconciler):
                               rec["name"])
             except NotFoundError:
                 pass
-        return active + done[-max_history:]
+        return active + (done[-max_history:] if max_history else [])

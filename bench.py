@@ -7,7 +7,13 @@ data). The reference publishes no numbers (BASELINE.md), so the baseline is
 our own recorded first-light figure; vs_baseline = value / BASELINE_IMG_S.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
+   "mfu": N, "extras": {...}}
+
+mfu is computed against the DETECTED chip generation's bf16 peak; extras
+also reports mfu against the chip's *measured* achievable matmul rate
+(calibrated at bench start — see PERF.md for why those differ on tunneled
+chips) and the startup→first-step latency (BASELINE.md north-star #2).
 """
 
 from __future__ import annotations
@@ -20,24 +26,66 @@ import time
 # data, this repo @ milestone 3). Later rounds must beat it.
 BASELINE_IMG_S = 1000.0
 
+# ResNet-50 @224 fwd ≈ 4.09 GFLOP/image; fwd+bwd ≈ 3x fwd (dgrad + wgrad
+# each cost ~one fwd). Conventional MFU flop model (matmul/conv MACs only).
+TRAIN_GFLOP_PER_IMAGE = 3 * 4.09
+
+# bf16 peak TFLOP/s by device_kind substring (public spec sheets)
+PEAK_TFLOPS = {
+    "v5 lite": 197.0, "v5e": 197.0,
+    "v5p": 459.0, "v5": 459.0,          # 'v5' alone = v5p
+    "v4": 275.0, "v3": 123.0, "v2": 46.0,
+    "v6 lite": 918.0, "v6e": 918.0,
+}
+
+
+def detect_peak_tflops(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for key in sorted(PEAK_TFLOPS, key=len, reverse=True):
+        if key in kind:
+            return PEAK_TFLOPS[key]
+    return None
+
+
+def measure_achievable_tflops() -> float:
+    """Calibrate the chip's sustained large-matmul rate (the honest MFU
+    denominator on virtualized/tunneled chips that underdeliver spec)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 8192
+    x = jnp.ones((n, n), jnp.bfloat16)
+    f = jax.jit(lambda a, b: a @ b)
+    y = f(x, x)
+    float(y[0, 0])
+    t0 = time.perf_counter()
+    iters = 20
+    for _ in range(iters):
+        y = f(y, x)
+    float(y[0, 0])
+    dt = time.perf_counter() - t0
+    return 2 * n ** 3 * iters / dt / 1e12
+
 
 def main() -> int:
+    t_start = time.perf_counter()
     import jax
-
-    platform = jax.devices()[0].platform
-    on_tpu = platform == "tpu"
     import optax
 
     from kubeflow_tpu.models import resnet as R
     from kubeflow_tpu.parallel.mesh import build_mesh
     from kubeflow_tpu.runtime.trainstep import TrainStepBuilder
 
+    dev = jax.devices()[0]
+    platform = dev.platform
+    on_tpu = platform == "tpu"
+
     n_chips = len(jax.devices())
     if on_tpu:
         # batch 128/chip measured fastest on v5e (128: ~2600, 256: ~2500,
-        # 512: ~2360, 1024: ~2020 img/s) — larger batches lose to HBM
-        # pressure on this model
-        batch_per_chip, image_size, steps, warmup = 128, 224, 20, 4
+        # 512: ~2360, 1024: ~2020 img/s) — the step is HBM-roofline-bound
+        # (PERF.md), so larger batches only add activation traffic
+        batch_per_chip, image_size, steps, warmup = 128, 224, 40, 4
     else:  # CPU smoke mode so the script stays runnable anywhere
         batch_per_chip, image_size, steps, warmup = 8, 64, 4, 1
     global_batch = batch_per_chip * n_chips
@@ -52,14 +100,25 @@ def main() -> int:
     state = builder.init(R.init_fn(model, image_size=image_size),
                          jax.random.PRNGKey(0))
     step_fn = builder.build()
-    batch = builder.place_batch(
-        R.synthetic_batch(jax.random.PRNGKey(1), global_batch, image_size))
+    batch = R.synthetic_batch(jax.random.PRNGKey(1), global_batch, image_size)
+    if on_tpu:
+        # feed bf16 images: the model's first act is the bf16 cast, so this
+        # is loss-free and halves the input-image HBM read (PERF.md)
+        import jax.numpy as jnp
+        batch["images"] = batch["images"].astype(jnp.bfloat16)
+    batch = builder.place_batch(batch)
 
     # sync via host transfer (float()), not block_until_ready: on the
     # tunneled axon platform block_until_ready returns before the compute
     # finishes, which inflated throughput ~70x; a device->host fetch of the
     # last step's loss is a hard barrier everywhere
-    for _ in range(warmup):
+    state, metrics = step_fn(state, batch)
+    float(metrics["loss"])
+    # startup→first-step latency: process start → first train step done
+    # (init + compile dominated). BASELINE.md north-star metric #2.
+    startup_first_step_s = time.perf_counter() - t_start
+
+    for _ in range(warmup - 1):
         state, metrics = step_fn(state, batch)
     float(metrics["loss"])
 
@@ -71,15 +130,33 @@ def main() -> int:
 
     img_s = global_batch * steps / dt
     img_s_chip = img_s / n_chips
+
+    flops_per_chip = img_s_chip * TRAIN_GFLOP_PER_IMAGE * 1e9
+    peak = detect_peak_tflops(dev)
+    mfu = flops_per_chip / (peak * 1e12) if peak else None
+    extras = {
+        "device_kind": getattr(dev, "device_kind", platform),
+        "startup_first_step_s": round(startup_first_step_s, 2),
+        "peak_tflops_spec": peak,
+        "model_tflops": round(flops_per_chip / 1e12, 1),
+    }
+    if on_tpu:
+        achievable = measure_achievable_tflops()
+        extras["achievable_matmul_tflops"] = round(achievable, 1)
+        extras["mfu_vs_achievable"] = round(flops_per_chip / (achievable * 1e12), 3)
+
     print(json.dumps({
         "metric": "resnet50_synthetic_imagenet_train_throughput",
         "value": round(img_s_chip, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(img_s_chip / BASELINE_IMG_S, 3),
+        "mfu": round(mfu, 3) if mfu is not None else None,
+        "extras": extras,
     }))
     print(f"# platform={platform} chips={n_chips} batch={global_batch} "
           f"image={image_size} steps={steps} wall={dt:.2f}s "
-          f"loss={float(metrics['loss']):.3f}", file=sys.stderr)
+          f"loss={float(metrics['loss']):.3f} "
+          f"first_step={startup_first_step_s:.1f}s", file=sys.stderr)
     return 0
 
 

@@ -1,0 +1,232 @@
+"""The GCP ingress/auth package: IAP ingress, basic-auth ingress,
+cert-manager, cloud-endpoints, Filestore.
+
+Reference: kubeflow/gcp/ (4.3k LoC jsonnet) — the largest reference package:
+iap-ingress (Envoy verifying IAP JWTs, prototypes/iap-ingress.jsonnet:1-16),
+basic-auth-ingress (gatekeeper-backed), cert-manager, cloud-endpoints,
+Filestore PV, gpu-driver (covered by tpu-device-plugin in observability.py),
+prometheus + metric-collector (observability.py).
+
+The data-plane here is in-repo (webapps/ingress.py AuthIngress) rather than
+an Envoy image: the Deployment below runs `python -m kubeflow_tpu.webapps
+.ingress`-shaped entrypoints, so the manifests wire real code.
+"""
+
+from __future__ import annotations
+
+from ..api import k8s
+from . import helpers as H
+from .registry import register
+
+ESP_IMAGE = "kubeflow-tpu/auth-ingress:v0.1.0"  # webapps/ingress.py image
+
+
+@register("iap-ingress", "IAP-style JWT-verifying ingress "
+                         "(kubeflow/gcp/prototypes/iap-ingress parity)")
+def iap_ingress(namespace: str = "kubeflow",
+                hostname: str = "kubeflow.endpoints.example.cloud.goog",
+                audience: str = "",
+                ip_name: str = "kubeflow-ip",
+                upstream: str = "centraldashboard:80") -> list[dict]:
+    """Envoy-analog Deployment + config + GKE Ingress with a static IP.
+
+    The audience is the IAP backend-service id the JWT must be minted
+    for; the signing key arrives via the `iap-ingress-key` Secret (the
+    reference pulls Google's public keys instead — same seam)."""
+    cm = H.config_map("iap-ingress-config", namespace, {
+        "audience": audience or "/projects/0/global/backendServices/0",
+        "issuer": "https://cloud.google.com/iap",
+        "upstream": upstream,
+        "jwt_header": "x-goog-iap-jwt-assertion",
+        "email_header": "x-goog-authenticated-user-email",
+    })
+    dep = H.deployment(
+        "iap-ingress", namespace, ESP_IMAGE,
+        args=["--mode=iap", "--config-dir=/etc/iap",
+              "--key-file=/etc/iap-key/key", "--port=8080"],
+        port=8080, replicas=2, service_account="iap-ingress")
+    # mount config + signing-key secret like the reference's envoy pod
+    pod = dep["spec"]["template"]["spec"]
+    pod["volumes"] = [
+        {"name": "config", "configMap": {"name": "iap-ingress-config"}},
+        {"name": "key", "secret": {"secretName": "iap-ingress-key"}},
+    ]
+    pod["containers"][0]["volumeMounts"] = [
+        {"name": "config", "mountPath": "/etc/iap"},
+        {"name": "key", "mountPath": "/etc/iap-key", "readOnly": True},
+    ]
+    sa = H.service_account("iap-ingress", namespace)
+    svc = H.service("iap-ingress", namespace, 80, target_port=8080)
+    svc["metadata"].setdefault("annotations", {})[
+        "beta.cloud.google.com/backend-config"] = \
+        '{"default": "iap-backendconfig"}'
+    backend_config = {
+        "apiVersion": "cloud.google.com/v1", "kind": "BackendConfig",
+        "metadata": {"name": "iap-backendconfig", "namespace": namespace},
+        "spec": {"iap": {"enabled": True,
+                         "oauthclientCredentials":
+                             {"secretName": "iap-oauth-client"}}},
+    }
+    ingress = {
+        "apiVersion": "networking.k8s.io/v1", "kind": "Ingress",
+        "metadata": {
+            "name": "envoy-ingress", "namespace": namespace,
+            "annotations": {
+                "kubernetes.io/ingress.global-static-ip-name": ip_name,
+                "networking.gke.io/managed-certificates": "kubeflow-cert",
+            },
+        },
+        "spec": {"rules": [{
+            "host": hostname,
+            "http": {"paths": [{
+                "path": "/", "pathType": "Prefix",
+                "backend": {"service": {"name": "iap-ingress",
+                                        "port": {"number": 80}}}}]},
+        }]},
+    }
+    return [sa, cm, dep, svc, backend_config, ingress]
+
+
+@register("basic-auth-ingress", "Gatekeeper-backed auth ingress "
+                                "(kubeflow/gcp basic-auth flavor + "
+                                "common/ambassador authservice parity)")
+def basic_auth_ingress(namespace: str = "kubeflow",
+                       hostname: str = "",
+                       ip_name: str = "kubeflow-ip",
+                       upstream: str = "centraldashboard:80") -> list[dict]:
+    """AuthIngress in ext-authz mode in front of the gatekeeper: every
+    request's Cookie/Authorization is checked against gatekeeper /auth;
+    401 redirects to the login page (webapps/ingress.ExtAuthzVerifier)."""
+    cm = H.config_map("basic-auth-ingress-config", namespace, {
+        "auth_url": "http://gatekeeper:8085/auth",
+        "login_path": "/login",
+        "upstream": upstream,
+    })
+    dep = H.deployment(
+        "basic-auth-ingress", namespace, ESP_IMAGE,
+        args=["--mode=ext-authz", "--config-dir=/etc/auth-ingress",
+              "--port=8080"],
+        port=8080, replicas=2)
+    pod = dep["spec"]["template"]["spec"]
+    pod["volumes"] = [{"name": "config",
+                       "configMap": {"name": "basic-auth-ingress-config"}}]
+    pod["containers"][0]["volumeMounts"] = [
+        {"name": "config", "mountPath": "/etc/auth-ingress"}]
+    svc = H.service("basic-auth-ingress", namespace, 80, target_port=8080)
+    ingress = {
+        "apiVersion": "networking.k8s.io/v1", "kind": "Ingress",
+        "metadata": {
+            "name": "basic-auth-ingress", "namespace": namespace,
+            "annotations":
+                {"kubernetes.io/ingress.global-static-ip-name": ip_name},
+        },
+        "spec": {"rules": [{
+            **({"host": hostname} if hostname else {}),
+            "http": {"paths": [{
+                "path": "/", "pathType": "Prefix",
+                "backend": {"service": {"name": "basic-auth-ingress",
+                                        "port": {"number": 80}}}}]},
+        }]},
+    }
+    return [cm, dep, svc, ingress]
+
+
+@register("cert-manager", "Certificate/Issuer CRDs + controller + "
+                          "self-signed default issuer "
+                          "(kubeflow/gcp/cert-manager parity)")
+def cert_manager(namespace: str = "cert-manager",
+                 acme_email: str = "",
+                 acme_server: str =
+                 "https://acme-v02.api.letsencrypt.org/directory") -> list[dict]:
+    ns = k8s.make("v1", "Namespace", namespace)
+    crds = [
+        H.crd("certificates", "Certificate", "certmanager.k8s.io",
+              ["v1alpha1"]),
+        H.crd("issuers", "Issuer", "certmanager.k8s.io", ["v1alpha1"]),
+        H.crd("clusterissuers", "ClusterIssuer", "certmanager.k8s.io",
+              ["v1alpha1"], scope="Cluster"),
+    ]
+    sa = H.service_account("cert-manager", namespace)
+    role = H.cluster_role("cert-manager", [
+        {"apiGroups": ["certmanager.k8s.io"],
+         "resources": ["certificates", "issuers", "clusterissuers",
+                       "certificates/status", "issuers/status"],
+         "verbs": ["*"]},
+        {"apiGroups": [""],
+         "resources": ["secrets", "events", "services", "pods"],
+         "verbs": ["get", "list", "watch", "create", "update", "delete"]},
+        {"apiGroups": ["networking.k8s.io"], "resources": ["ingresses"],
+         "verbs": ["get", "list", "watch", "create", "update", "delete"]},
+    ])
+    binding = H.cluster_role_binding("cert-manager", "cert-manager",
+                                     "cert-manager", namespace)
+    dep = H.deployment("cert-manager", namespace,
+                       "quay.io/jetstack/cert-manager-controller:v0.4.0",
+                       args=["--cluster-resource-namespace=" + namespace],
+                       service_account="cert-manager", port=9402)
+    issuer = {
+        "apiVersion": "certmanager.k8s.io/v1alpha1", "kind": "ClusterIssuer",
+        "metadata": {"name": "kubeflow-self-signing-issuer"},
+        "spec": {"selfSigned": {}},
+    }
+    out = [ns, *crds, sa, role, binding, dep, issuer]
+    if acme_email:
+        out.append({
+            "apiVersion": "certmanager.k8s.io/v1alpha1",
+            "kind": "ClusterIssuer",
+            "metadata": {"name": "letsencrypt-prod"},
+            "spec": {"acme": {
+                "email": acme_email, "server": acme_server,
+                "privateKeySecretRef": {"name": "letsencrypt-prod-key"},
+                "http01": {}}},
+        })
+    return out
+
+
+@register("cloud-endpoints", "Cloud Endpoints DNS controller + "
+                             "CloudEndpoint CRD (kubeflow/gcp parity)")
+def cloud_endpoints(namespace: str = "kubeflow",
+                    project: str = "") -> list[dict]:
+    crd = H.crd("cloudendpoints", "CloudEndpoint", "ctl.isla.solutions",
+                ["v1"])
+    sa = H.service_account("cloud-endpoints-controller", namespace)
+    role = H.cluster_role("cloud-endpoints-controller", [
+        {"apiGroups": ["ctl.isla.solutions"], "resources": ["cloudendpoints"],
+         "verbs": ["*"]},
+        {"apiGroups": [""], "resources": ["services", "configmaps"],
+         "verbs": ["get", "list"]},
+        {"apiGroups": ["networking.k8s.io"], "resources": ["ingresses"],
+         "verbs": ["get", "list"]},
+    ])
+    binding = H.cluster_role_binding("cloud-endpoints-controller",
+                                     "cloud-endpoints-controller",
+                                     "cloud-endpoints-controller", namespace)
+    dep = H.deployment("cloud-endpoints-controller", namespace,
+                       "gcr.io/cloud-solutions-group/cloud-endpoints-controller:0.2.1",
+                       service_account="cloud-endpoints-controller",
+                       port=80, env={"GOOGLE_PROJECT": project} if project else None)
+    return [crd, sa, role, binding, dep]
+
+
+@register("gcp-filestore", "Filestore NFS PV/PVC for shared artifacts "
+                           "(kubeflow/gcp filestore parity)")
+def gcp_filestore(namespace: str = "kubeflow",
+                  server_ip: str = "",
+                  path: str = "/kubeflow",
+                  capacity: str = "1Ti") -> list[dict]:
+    pv = k8s.make("v1", "PersistentVolume", "kubeflow-filestore")
+    pv["spec"] = {
+        "capacity": {"storage": capacity},
+        "accessModes": ["ReadWriteMany"],
+        "persistentVolumeReclaimPolicy": "Retain",
+        "nfs": {"server": server_ip or "10.0.0.2", "path": path},
+    }
+    pvc = k8s.make("v1", "PersistentVolumeClaim", "kubeflow-filestore",
+                   namespace=namespace)
+    pvc["spec"] = {
+        "accessModes": ["ReadWriteMany"],
+        "storageClassName": "",
+        "volumeName": "kubeflow-filestore",
+        "resources": {"requests": {"storage": capacity}},
+    }
+    return [pv, pvc]

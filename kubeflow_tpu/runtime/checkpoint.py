@@ -40,6 +40,11 @@ class CheckpointManager:
                 save_interval_steps=save_interval_steps),
         )
 
+    def should_save(self, step: int) -> bool:
+        """Whether save() at this step would actually write (interval gate).
+        Lets callers avoid host-syncing device state for skipped steps."""
+        return bool(self._mgr.should_save(step))
+
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         saved = self._mgr.save(
             step, args=ocp.args.StandardSave(state), force=force)

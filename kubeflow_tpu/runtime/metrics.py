@@ -29,10 +29,16 @@ class StepStats:
     step_time_s: float
     examples_per_sec: float
     metrics: dict[str, float] = field(default_factory=dict)
+    # number of device steps this record averages over (>1 when the worker
+    # only syncs every N steps — per-step host fetches defeat async dispatch)
+    window: int = 1
 
     def to_dict(self) -> dict:
-        return {"step": self.step, "step_time_s": self.step_time_s,
-                "examples_per_sec": self.examples_per_sec, **self.metrics}
+        d = {"step": self.step, "step_time_s": self.step_time_s,
+             "examples_per_sec": self.examples_per_sec, **self.metrics}
+        if self.window != 1:
+            d["window"] = self.window
+        return d
 
 
 class MetricsLogger:
@@ -51,8 +57,16 @@ class MetricsLogger:
         self._last_t = time.perf_counter()
 
     def end_step(self, step: int, metrics: Optional[dict] = None) -> StepStats:
+        return self.end_window(step, 1, metrics)
+
+    def end_window(self, step: int, n_steps: int,
+                   metrics: Optional[dict] = None) -> StepStats:
+        """Close a timing window of `n_steps` device steps with ONE host
+        sync. The recorded step_time_s is the window average; the JSONL
+        line carries the window size so consumers can weight it."""
         now = time.perf_counter()
-        dt = now - (self._last_t if self._last_t is not None else now)
+        total = now - (self._last_t if self._last_t is not None else now)
+        dt = total / max(n_steps, 1)
         self._last_t = now
         scalars = {}
         for k, v in (metrics or {}).items():
@@ -63,28 +77,32 @@ class MetricsLogger:
         stats = StepStats(
             step=step, step_time_s=dt,
             examples_per_sec=(self.batch_size / dt) if dt > 0 else 0.0,
-            metrics=scalars)
+            metrics=scalars, window=max(n_steps, 1))
         self.history.append(stats)
         if self._fh:
             self._fh.write(json.dumps(stats.to_dict()) + "\n")
             self._fh.flush()
-        if self.log_every and step % self.log_every == 0:
+        # log when this window crosses a log_every boundary (covers both
+        # per-step records and multi-step windows without flooding)
+        if self.log_every and \
+                step // self.log_every > (step - n_steps) // self.log_every:
             log.info("step %d: %.1f ex/s %s", step, stats.examples_per_sec,
                      scalars)
         return stats
 
     def summary(self, warmup: int = 1) -> dict[str, float]:
-        """Steady-state throughput, skipping compile/warmup steps."""
+        """Steady-state throughput, skipping compile/warmup records.
+        Window records are weighted by the number of steps they cover."""
         steady = self.history[warmup:] if len(self.history) > warmup \
             else self.history
         if not steady:
             return {"steps": 0, "examples_per_sec": 0.0, "mean_step_time_s": 0.0}
-        times = [s.step_time_s for s in steady]
+        n = sum(s.window for s in steady)
+        t = sum(s.step_time_s * s.window for s in steady)
         return {
-            "steps": len(self.history),
-            "mean_step_time_s": sum(times) / len(times),
-            "examples_per_sec": (self.batch_size * len(times) / sum(times))
-            if sum(times) else 0.0,
+            "steps": sum(s.window for s in self.history),
+            "mean_step_time_s": t / n if n else 0.0,
+            "examples_per_sec": (self.batch_size * n / t) if t else 0.0,
         }
 
     def close(self) -> None:

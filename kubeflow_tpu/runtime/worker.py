@@ -100,6 +100,7 @@ def train(
     ctx: Optional[WorkerContext] = None,
     workload_kwargs: Optional[dict] = None,
     seed: int = 0,
+    sync_every: int = 10,
 ) -> TrainResult:
     ctx = ctx or initialize()
     workload_kwargs = dict(workload_kwargs or {})
@@ -156,20 +157,35 @@ def train(
 
     start_step = int(state.step)
     last_metrics: dict = {}
+    # Sync to the host only every `sync_every` steps: a per-step float()
+    # fetch is a full device→host round trip that defeats async dispatch
+    # (r2 verdict item). The window's wall-time is divided evenly over its
+    # steps (the fetch at the window edge is still a hard barrier — see
+    # bench.py on why block_until_ready is not one on tunneled platforms).
+    sync_every = max(1, int(sync_every))
     with profile_trace(profile_dir, enabled=profile_dir is not None):
+        window = 0
+        mlog.start_step()
         for step in range(start_step, steps):
             data_rng, brng = jax.random.split(data_rng)
             batch = builder.place_batch(spec.batch_fn(brng, global_batch))
-            mlog.start_step()
             state, metrics = step_fn(state, batch)
-            # hard sync via host fetch: block_until_ready is not a reliable
-            # barrier on tunneled platforms (see bench.py), and the step
-            # timer needs a true end-of-step
-            metrics = {k: float(v) for k, v in metrics.items()}
-            stats = mlog.end_step(step + 1, metrics)
-            last_metrics = metrics
+            window += 1
+            # checkpoint saves are their own sync point (orbax fetches the
+            # state), so close the timing window first to keep it honest
+            will_ckpt = ckpt is not None and ckpt.should_save(step + 1)
+            closed = window >= sync_every or step + 1 == steps or will_ckpt
+            if closed:
+                last_metrics = {k: float(v) for k, v in metrics.items()}
+                mlog.end_window(step + 1, window, last_metrics)
+                window = 0
             if ckpt is not None:
                 ckpt.save(step + 1, state)
+            if closed:
+                # restart the timer only after the save: orbax fetches the
+                # device state synchronously, and that must not be charged
+                # to the next window
+                mlog.start_step()
     if ckpt is not None:
         ckpt.wait()
         ckpt.close()
@@ -212,6 +228,8 @@ def main(argv=None) -> int:
                         "(defaults to $KFTPU_RESUME_FROM)")
     p.add_argument("--metrics-path")
     p.add_argument("--profile-dir")
+    p.add_argument("--sync-every", type=int, default=10,
+                   help="host-sync (and metric-fetch) interval in steps")
     p.add_argument("--num-microbatches", type=int, default=4,
                    help="GPipe microbatches (pipelined workloads)")
     args = p.parse_args(argv)
@@ -225,7 +243,7 @@ def main(argv=None) -> int:
         checkpoint_every=args.checkpoint_every, resume=not args.no_resume,
         resume_from=args.resume_from,
         metrics_path=args.metrics_path, profile_dir=args.profile_dir,
-        workload_kwargs=workload_kwargs)
+        workload_kwargs=workload_kwargs, sync_every=args.sync_every)
     log.info("done: %d steps, %.1f examples/sec", result.steps,
              result.examples_per_sec)
     return 0

@@ -1,0 +1,346 @@
+"""Auth-checking ingress: the IAP / basic-auth ingress data plane.
+
+The reference's GCP package fronts Kubeflow with an Envoy that verifies
+IAP JWTs per request (kubeflow/gcp/prototypes/iap-ingress.jsonnet:1-16,
+iap.libsonnet envoy config: checks x-goog-iap-jwt-assertion and forwards
+identity headers) or, in the basic-auth flavor, routes every request
+through the gatekeeper's ext-authz check
+(kubeflow/common/ambassador.libsonnet:149-176 authservice annotation +
+kubeflow/gcp basic-auth-ingress prototype).
+
+This is the TPU-native equivalent as one in-repo data-plane component: a
+reverse proxy with a pluggable per-request authenticator —
+
+- ``JwtVerifier``  — IAP mode: verifies the ``x-goog-iap-jwt-assertion``
+  compact JWS (HS256 against a cluster secret here; Google's ES256 public
+  keys slot into the same seam), checks audience/issuer/expiry, and
+  forwards ``x-goog-authenticated-user-email`` upstream exactly as IAP's
+  Envoy filter does.
+- ``ExtAuthzVerifier`` — basic-auth mode: mirrors the Cookie/Authorization
+  headers to the gatekeeper's GET /auth (webapps/gatekeeper.py) and lets
+  the 200/401 decide; 401 redirects the browser to the login page.
+
+Everything is stdlib; no Envoy image, no egress.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler
+from typing import Optional
+
+from ._http import ThreadedServer
+
+IAP_JWT_HEADER = "x-goog-iap-jwt-assertion"
+IAP_EMAIL_HEADER = "x-goog-authenticated-user-email"
+DEFAULT_ISSUER = "https://cloud.google.com/iap"
+
+# hop-by-hop headers a proxy must not forward (RFC 7230 §6.1)
+_HOP_HEADERS = {"connection", "keep-alive", "proxy-authenticate",
+                "proxy-authorization", "te", "trailers",
+                "transfer-encoding", "upgrade", "host"}
+
+
+# -- compact JWS (HS256), stdlib only ---------------------------------------
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _b64url_decode(data: str) -> bytes:
+    pad = "=" * (-len(data) % 4)
+    return base64.urlsafe_b64decode(data + pad)
+
+
+def jwt_encode(claims: dict, key: str) -> str:
+    """Mint an HS256 JWT (test traffic + in-cluster service identity)."""
+    header = _b64url(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    payload = _b64url(json.dumps(claims).encode())
+    signing_input = f"{header}.{payload}".encode()
+    sig = hmac.new(key.encode(), signing_input, hashlib.sha256).digest()
+    return f"{header}.{payload}.{_b64url(sig)}"
+
+
+class JwtError(Exception):
+    pass
+
+
+def jwt_verify(token: str, key: str, audience: Optional[str] = None,
+               issuer: Optional[str] = None, now=time.time) -> dict:
+    """Verify signature + exp/aud/iss; returns the claims.
+
+    The verification contract matches what IAP's Envoy filter enforces
+    (signature, audience = the backend-service id, issuer, expiry); the
+    signature scheme is the pluggable part.
+    """
+    try:
+        header_b64, payload_b64, sig_b64 = token.split(".")
+        header = json.loads(_b64url_decode(header_b64))
+    except (ValueError, json.JSONDecodeError) as e:
+        raise JwtError(f"malformed token: {e}") from None
+    if not isinstance(header, dict) or header.get("alg") != "HS256":
+        raise JwtError("unsupported alg")
+    signing_input = f"{header_b64}.{payload_b64}".encode()
+    expected = hmac.new(key.encode(), signing_input, hashlib.sha256).digest()
+    try:
+        if not hmac.compare_digest(expected, _b64url_decode(sig_b64)):
+            raise JwtError("bad signature")
+        claims = json.loads(_b64url_decode(payload_b64))
+    except (ValueError, json.JSONDecodeError) as e:
+        raise JwtError(f"malformed token: {e}") from None
+    if not isinstance(claims, dict):
+        raise JwtError("claims is not an object")
+    exp = claims.get("exp")
+    if exp is not None and now() >= float(exp):
+        raise JwtError("token expired")
+    if audience is not None and claims.get("aud") != audience:
+        raise JwtError(f"audience mismatch: {claims.get('aud')!r}")
+    if issuer is not None and claims.get("iss") != issuer:
+        raise JwtError(f"issuer mismatch: {claims.get('iss')!r}")
+    return claims
+
+
+# -- authenticators ----------------------------------------------------------
+
+class AuthDecision:
+    def __init__(self, ok: bool, identity: str = "",
+                 redirect: Optional[str] = None, reason: str = ""):
+        self.ok = ok
+        self.identity = identity
+        self.redirect = redirect
+        self.reason = reason
+
+
+@dataclass
+class JwtVerifier:
+    """IAP mode: the request must carry a valid signed assertion."""
+
+    key: str
+    audience: Optional[str] = None
+    issuer: Optional[str] = DEFAULT_ISSUER
+
+    def check(self, headers) -> AuthDecision:
+        token = headers.get(IAP_JWT_HEADER)
+        if not token:
+            return AuthDecision(False, reason="missing IAP assertion")
+        try:
+            claims = jwt_verify(token, self.key, audience=self.audience,
+                                issuer=self.issuer)
+        except JwtError as e:
+            return AuthDecision(False, reason=str(e))
+        return AuthDecision(True, identity=claims.get("email", ""))
+
+
+@dataclass
+class ExtAuthzVerifier:
+    """Basic-auth mode: defer to the gatekeeper's /auth check endpoint,
+    mirroring the credentials headers (the ambassador authservice shape)."""
+
+    auth_url: str                      # e.g. http://127.0.0.1:PORT/auth
+    login_path: str = "/login"
+    forward_headers: tuple = ("Cookie", "Authorization")
+
+    def check(self, headers) -> AuthDecision:
+        req = urllib.request.Request(self.auth_url, method="GET")
+        for name in self.forward_headers:
+            if headers.get(name):
+                req.add_header(name, headers[name])
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                identity = resp.headers.get("X-Auth-User", "")
+                return AuthDecision(True, identity=identity)
+        except urllib.error.HTTPError as e:
+            if e.code in (401, 403):
+                return AuthDecision(False, redirect=self.login_path,
+                                    reason="unauthenticated")
+            return AuthDecision(False, reason=f"authz backend error {e.code}")
+        except OSError as e:
+            # fail closed, like the gatekeeper itself does
+            return AuthDecision(False, reason=f"authz unreachable: {e}")
+
+
+# -- the proxy ---------------------------------------------------------------
+
+@dataclass
+class Route:
+    prefix: str
+    upstream: str                      # host:port
+
+
+class AuthIngress(ThreadedServer):
+    """Authenticate-then-proxy. Longest-prefix route table, identity
+    header injection, hop-header hygiene."""
+
+    def __init__(self, authenticator, routes: list[Route],
+                 host: str = "127.0.0.1", port: int = 0):
+        self.authenticator = authenticator
+        self.routes = sorted(routes, key=lambda r: -len(r.prefix))
+        ingress = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _deny(self, decision: AuthDecision):
+                if decision.redirect:
+                    self.send_response(302)
+                    self.send_header("Location", decision.redirect)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                else:
+                    body = json.dumps({"error": decision.reason}).encode()
+                    self.send_response(401)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+            def _proxy(self, method: str):
+                decision = ingress.authenticator.check(self.headers)
+                if not decision.ok:
+                    self._deny(decision)
+                    return
+                route = ingress.match(self.path)
+                if route is None:
+                    body = b'{"error": "no route"}'
+                    self.send_response(404)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    body = b'{"error": "bad Content-Length"}'
+                    self.send_response(400)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                payload = self.rfile.read(length) if length else None
+                url = f"http://{route.upstream}{self.path}"
+                req = urllib.request.Request(url, data=payload, method=method)
+                # never forward hop headers, the assertion, or any inbound
+                # identity header — identity is MINTED here, client-supplied
+                # values would let callers spoof it (IAP/Envoy strips these
+                # the same way)
+                drop = _HOP_HEADERS | {IAP_JWT_HEADER,
+                                       IAP_EMAIL_HEADER.lower()}
+                for name, value in self.headers.items():
+                    if name.lower() not in drop:
+                        req.add_header(name, value)
+                if decision.identity:
+                    # IAP convention: accounts.google.com:<email>
+                    req.add_header(IAP_EMAIL_HEADER,
+                                   f"accounts.google.com:{decision.identity}")
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as resp:
+                        data = resp.read()
+                        self.send_response(resp.status)
+                        for name, value in resp.headers.items():
+                            if name.lower() not in _HOP_HEADERS and \
+                                    name.lower() != "content-length":
+                                self.send_header(name, value)
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
+                except urllib.error.HTTPError as e:
+                    data = e.read()
+                    self.send_response(e.code)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except OSError as e:
+                    data = json.dumps({"error": f"upstream: {e}"}).encode()
+                    self.send_response(502)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+
+            def do_GET(self):
+                self._proxy("GET")
+
+            def do_POST(self):
+                self._proxy("POST")
+
+            def do_PUT(self):
+                self._proxy("PUT")
+
+            def do_DELETE(self):
+                self._proxy("DELETE")
+
+        super().__init__(Handler, host=host, port=port, name="auth-ingress")
+
+    def match(self, path: str) -> Optional[Route]:
+        for route in self.routes:
+            if path.startswith(route.prefix):
+                return route
+        return None
+
+
+# -- pod entrypoint ----------------------------------------------------------
+
+def _read_config_dir(path: str) -> dict:
+    """ConfigMaps mount as one file per key; read them all."""
+    import os
+    out = {}
+    for name in os.listdir(path):
+        full = os.path.join(path, name)
+        if os.path.isfile(full):
+            with open(full) as f:
+                out[name] = f.read().strip()
+    return out
+
+
+def main(argv=None) -> int:
+    """The container entrypoint the iap-ingress / basic-auth-ingress
+    Deployments run (manifests/cloud_gcp.py)."""
+    import argparse
+    import os
+    import signal
+
+    p = argparse.ArgumentParser(description="kubeflow-tpu auth ingress")
+    p.add_argument("--mode", choices=["iap", "ext-authz"], required=True)
+    p.add_argument("--config-dir", required=True,
+                   help="mounted ConfigMap dir (one file per key)")
+    p.add_argument("--key-file",
+                   help="IAP signing-key secret file (iap mode)")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--host", default="0.0.0.0")
+    args = p.parse_args(argv)
+
+    cfg = _read_config_dir(args.config_dir)
+    routes = [Route("/", cfg["upstream"])]
+    if args.mode == "iap":
+        key_file = args.key_file or "/etc/iap-key/key"
+        with open(key_file) as f:
+            key = f.read().strip()
+        auth = JwtVerifier(key=key, audience=cfg.get("audience") or None,
+                           issuer=cfg.get("issuer", DEFAULT_ISSUER))
+    else:
+        auth = ExtAuthzVerifier(auth_url=cfg["auth_url"],
+                                login_path=cfg.get("login_path", "/login"))
+    ingress = AuthIngress(auth, routes, host=args.host, port=args.port)
+    ingress.start()
+    stop = {"flag": False}
+    signal.signal(signal.SIGTERM, lambda *a: stop.update(flag=True))
+    try:
+        while not stop["flag"]:
+            signal.pause() if hasattr(signal, "pause") else time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    ingress.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

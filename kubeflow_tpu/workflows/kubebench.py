@@ -301,14 +301,18 @@ def report_from_metrics(metrics_path: str, *, job_kind: str = "TPUJob",
     if not rows:
         raise ValueError(f"no step records in {metrics_path}")
     steady = rows[warmup:] if len(rows) > warmup else rows
-    times = [r["step_time_s"] for r in steady]
-    mean_t = sum(times) / len(times) if times else 0.0
-    ex_s = (sum(r.get("examples_per_sec", 0.0) for r in steady) / len(steady)
-            if steady else 0.0)
+    # records may be multi-step windows (worker sync_every): weight by the
+    # number of device steps each record covers
+    weights = [int(r.get("window", 1)) for r in steady]
+    total_w = sum(weights) or 1
+    mean_t = sum(r["step_time_s"] * w
+                 for r, w in zip(steady, weights)) / total_w
+    ex_s = sum(r.get("examples_per_sec", 0.0) * w
+               for r, w in zip(steady, weights)) / total_w
     last = rows[-1]
     envd = env if env is not None else dict(os.environ)
     # StepStats.to_dict flattens model metrics alongside the timing fields
-    timing_keys = {"step", "step_time_s", "examples_per_sec"}
+    timing_keys = {"step", "step_time_s", "examples_per_sec", "window"}
     model_metrics = dict(last.get("metrics") or {})
     model_metrics.update({k: v for k, v in last.items()
                           if k not in timing_keys and k != "metrics"

@@ -238,6 +238,9 @@ class TrainingJob:
     # continues from the last step
     checkpoint_dir: str = ""
     resume_from: str = ""
+    # dataset shard dir (rendered as KFTPU_DATA_DIR; the launcher.py
+    # --data_dir analog) — workers train on real records when set
+    data_dir: str = ""
     raw: dict = field(default_factory=dict)
 
     # -- constructors -------------------------------------------------------
@@ -288,6 +291,7 @@ class TrainingJob:
             sharding=ShardingSpec.from_dict(spec.get("sharding")),
             checkpoint_dir=spec.get("checkpointDir", "") or "",
             resume_from=spec.get("resumeFrom", "") or "",
+            data_dir=spec.get("dataDir", "") or "",
             raw=obj,
         )
         job.validate()
@@ -375,6 +379,8 @@ class TrainingJob:
             out["spec"]["checkpointDir"] = self.checkpoint_dir
         if self.resume_from:
             out["spec"]["resumeFrom"] = self.resume_from
+        if self.data_dir:
+            out["spec"]["dataDir"] = self.data_dir
         if self.raw:
             out["apiVersion"] = self.raw.get("apiVersion", out["apiVersion"])
             meta = dict(self.raw.get("metadata", {}))

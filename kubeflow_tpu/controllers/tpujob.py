@@ -200,6 +200,8 @@ class TrainingJobReconciler(Reconciler):
             env["KFTPU_CHECKPOINT_DIR"] = job.checkpoint_dir
         if job.resume_from:
             env["KFTPU_RESUME_FROM"] = job.resume_from
+        if job.data_dir:
+            env["KFTPU_DATA_DIR"] = job.data_dir
         if env:
             self._add_env(pod, env)
         return pod

@@ -1,0 +1,202 @@
+"""ImageNet-style record dataset: fixed-size image records under the
+record pipeline (native C++ fast path, Python fallback).
+
+The reference's flagship workload trains ResNet-50 on real ImageNet via
+tf_cnn_benchmarks --data_dir (tf-controller-examples/tf-cnn/launcher.py:
+68-93); this is the TPU-native input path for the same job: shard files of
+fixed-size records streamed by the prefetching record pipeline
+(data/native.py / data/pipeline.py), decoded and augmented host-side with
+numpy, fed to the device as one placed batch per step.
+
+Record layout (record_bytes = 4 + H*W*3):
+    int32 LE label | uint8 image[H][W][3]
+
+A `meta.json` sidecar makes shard dirs self-describing:
+    {"image_size": H, "num_classes": N, "record_bytes": B,
+     "num_records": R, "format": "kftpu-imagenet-v1"}
+
+Augmentation is the tf_cnn_benchmarks training default reduced to what
+fixed-size storage supports: random horizontal flip + random crop with
+4-pixel reflection padding, seeded per epoch so runs are deterministic
+per (seed, epoch) — the determinism contract the tests pin down.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from .pipeline import RecordPipeline
+
+META_NAME = "meta.json"
+FORMAT = "kftpu-imagenet-v1"
+LABEL_BYTES = 4
+
+# ImageNet channel stats (tf_cnn_benchmarks preprocessing constants)
+MEAN_RGB = np.array([0.485, 0.456, 0.406], np.float32)
+STDDEV_RGB = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def record_bytes(image_size: int) -> int:
+    return LABEL_BYTES + image_size * image_size * 3
+
+
+def write_shards(out_dir: str, images: np.ndarray, labels: np.ndarray,
+                 *, shard_records: int = 1024,
+                 num_classes: Optional[int] = None) -> dict:
+    """Write (N,H,W,3) uint8 images + (N,) int labels as record shards.
+
+    The fixture/ingest writer (the analog of the reference's imagenet
+    preprocessing scripts feeding tf_cnn_benchmarks)."""
+    images = np.ascontiguousarray(images, np.uint8)
+    labels = np.asarray(labels)
+    if images.ndim != 4 or images.shape[3] != 3 or \
+            images.shape[1] != images.shape[2]:
+        raise ValueError(f"images must be (N,H,H,3) uint8, got {images.shape}")
+    if len(labels) != len(images):
+        raise ValueError("images/labels length mismatch")
+    image_size = images.shape[1]
+    os.makedirs(out_dir, exist_ok=True)
+    n = len(images)
+    shard = 0
+    for start in range(0, n, shard_records):
+        end = min(start + shard_records, n)
+        path = os.path.join(out_dir, f"shard-{shard:05d}.rec")
+        with open(path, "wb") as f:
+            for i in range(start, end):
+                f.write(np.int32(labels[i]).tobytes())
+                f.write(images[i].tobytes())
+        shard += 1
+    meta = {
+        "format": FORMAT,
+        "image_size": image_size,
+        "num_classes": int(num_classes if num_classes is not None
+                           else int(labels.max()) + 1 if n else 0),
+        "record_bytes": record_bytes(image_size),
+        "num_records": n,
+    }
+    with open(os.path.join(out_dir, META_NAME), "w") as f:
+        json.dump(meta, f)
+    return meta
+
+
+def read_meta(data_dir: str) -> dict:
+    path = os.path.join(data_dir, META_NAME)
+    with open(path) as f:
+        meta = json.load(f)
+    if meta.get("format") != FORMAT:
+        raise ValueError(f"{path}: unknown format {meta.get('format')!r}")
+    return meta
+
+
+def shard_paths(data_dir: str) -> list[str]:
+    return sorted(
+        os.path.join(data_dir, f) for f in os.listdir(data_dir)
+        if f.endswith(".rec"))
+
+
+class ImageNetSource:
+    """Decoded, augmented, normalized batches from a shard dir.
+
+    Yields {"images": float32 (B,H,H,3) normalized, "labels": int32 (B,)}.
+    Epochs reshuffle with a derived seed; augmentation RNG is seeded per
+    epoch so the stream is a pure function of (data, seed)."""
+
+    def __init__(self, data_dir: str, batch_size: int, *,
+                 augment: bool = True, pad_px: int = 4,
+                 num_threads: int = 2, queue_depth: int = 4,
+                 image_dtype: Optional[np.dtype] = None):
+        self.meta = read_meta(data_dir)
+        self.image_size = int(self.meta["image_size"])
+        self.num_classes = int(self.meta["num_classes"])
+        self.batch_size = batch_size
+        self.augment = augment
+        self.pad_px = pad_px
+        self.image_dtype = image_dtype or np.float32
+        paths = shard_paths(data_dir)
+        if not paths:
+            raise FileNotFoundError(f"no .rec shards in {data_dir}")
+        self._pipeline = RecordPipeline(
+            paths, self.meta["record_bytes"], batch_size,
+            num_threads=num_threads, queue_depth=queue_depth)
+        self.num_batches = self._pipeline.num_batches
+        if self.num_batches == 0:
+            self._pipeline.close()
+            raise ValueError(
+                f"{data_dir}: {self._pipeline.total_records} records < "
+                f"batch_size {batch_size} (empty epochs)")
+
+    # -- decode / augment (host-side, numpy) --------------------------------
+
+    def _decode(self, raw: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        n = raw.shape[0]
+        labels = raw[:, :LABEL_BYTES].copy().view("<i4").reshape(n)
+        images = raw[:, LABEL_BYTES:].reshape(
+            n, self.image_size, self.image_size, 3)
+        return images, labels
+
+    def _augment(self, images: np.ndarray, rng: np.random.Generator
+                 ) -> np.ndarray:
+        n, h, w, _ = images.shape
+        flip = rng.random(n) < 0.5
+        images = np.where(flip[:, None, None, None],
+                          images[:, :, ::-1, :], images)
+        if self.pad_px:
+            p = self.pad_px
+            padded = np.pad(images, ((0, 0), (p, p), (p, p), (0, 0)),
+                            mode="reflect")
+            ys = rng.integers(0, 2 * p + 1, n)
+            xs = rng.integers(0, 2 * p + 1, n)
+            out = np.empty_like(images)
+            for i in range(n):
+                out[i] = padded[i, ys[i]:ys[i] + h, xs[i]:xs[i] + w]
+            images = out
+        return images
+
+    def _normalize(self, images: np.ndarray) -> np.ndarray:
+        x = images.astype(np.float32) / 255.0
+        x = (x - MEAN_RGB) / STDDEV_RGB
+        return x.astype(self.image_dtype, copy=False)
+
+    # -- iteration -----------------------------------------------------------
+
+    def epoch(self, epoch: int, seed: int = 0, skip: int = 0
+              ) -> Iterator[dict]:
+        """One pass over the data for the given epoch index. ``skip``
+        drops the first N batches (resume); determinism holds because the
+        augment RNG is derived per (seed, epoch, batch index), not drawn
+        sequentially."""
+        self._pipeline.reset(seed + epoch)
+        for i, raw in enumerate(self._pipeline):
+            if i < skip:
+                continue
+            images, labels = self._decode(raw)
+            if self.augment:
+                rng = np.random.default_rng(
+                    ((seed << 20) ^ epoch) * 1_000_003 + i)
+                images = self._augment(images, rng)
+            yield {"images": self._normalize(images),
+                   "labels": labels.astype(np.int32)}
+
+    def batches(self, seed: int = 0, start_batch: int = 0) -> Iterator[dict]:
+        """Infinite stream across epochs (the train-loop feed).
+        ``start_batch`` = global batch index to resume from (checkpoint
+        restarts must not replay already-seen batches)."""
+        epoch = start_batch // self.num_batches
+        skip = start_batch % self.num_batches
+        while True:
+            yield from self.epoch(epoch, seed, skip=skip)
+            epoch += 1
+            skip = 0
+
+    def close(self) -> None:
+        self._pipeline.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

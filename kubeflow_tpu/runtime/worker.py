@@ -77,6 +77,9 @@ WORKLOADS: dict[str, Callable[..., WorkloadSpec]] = {
 # workloads whose spec factory needs the live mesh (pipeline scheduling)
 _MESH_AWARE_WORKLOADS = {"transformer-pipelined"}
 
+# workloads that consume --data-dir (ImageNet-style record shards)
+_IMAGE_WORKLOADS = {"resnet50"}
+
 
 @dataclass
 class TrainResult:
@@ -101,11 +104,26 @@ def train(
     workload_kwargs: Optional[dict] = None,
     seed: int = 0,
     sync_every: int = 10,
+    data_dir: Optional[str] = None,
 ) -> TrainResult:
     ctx = ctx or initialize()
     workload_kwargs = dict(workload_kwargs or {})
     if workload in _MESH_AWARE_WORKLOADS:
         workload_kwargs.setdefault("mesh", ctx.mesh)
+
+    # real-data path: shard dirs are self-describing, so the dataset's
+    # geometry configures the model (launcher.py --data_dir analog)
+    data_dir = data_dir or os.environ.get("KFTPU_DATA_DIR")
+    data_source = None
+    if data_dir:
+        if workload not in _IMAGE_WORKLOADS:
+            raise ValueError(
+                f"workload {workload!r} does not consume --data-dir")
+        from ..data.imagenet import ImageNetSource
+        data_source = ImageNetSource(data_dir, batch_size=global_batch)
+        workload_kwargs.setdefault("image_size", data_source.image_size)
+        workload_kwargs.setdefault("num_classes", data_source.num_classes)
+
     spec = WORKLOADS[workload](**workload_kwargs)
     log.info("worker %d/%d mesh=%s workload=%s", ctx.process_id,
              ctx.num_processes, dict(ctx.mesh.shape), spec.name)
@@ -154,6 +172,12 @@ def train(
         os.makedirs(os.path.dirname(metrics_path) or ".", exist_ok=True)
     mlog = MetricsLogger(metrics_path, batch_size=global_batch)
     data_rng = jax.random.PRNGKey(seed + 1)
+    # the record pipeline prefetches host batches on threads; device_put of
+    # batch N+1 overlaps step N because the loop only syncs at window edges.
+    # Resume picks the stream up at the restored step so restarts never
+    # replay already-consumed batches.
+    data_iter = data_source.batches(seed, start_batch=int(state.step)) \
+        if data_source is not None else None
 
     start_step = int(state.step)
     last_metrics: dict = {}
@@ -167,8 +191,11 @@ def train(
         window = 0
         mlog.start_step()
         for step in range(start_step, steps):
-            data_rng, brng = jax.random.split(data_rng)
-            batch = builder.place_batch(spec.batch_fn(brng, global_batch))
+            if data_iter is not None:
+                batch = builder.place_batch(next(data_iter))
+            else:
+                data_rng, brng = jax.random.split(data_rng)
+                batch = builder.place_batch(spec.batch_fn(brng, global_batch))
             state, metrics = step_fn(state, batch)
             window += 1
             # checkpoint saves are their own sync point (orbax fetches the
@@ -186,6 +213,8 @@ def train(
                 # device state synchronously, and that must not be charged
                 # to the next window
                 mlog.start_step()
+    if data_source is not None:
+        data_source.close()
     if ckpt is not None:
         ckpt.wait()
         ckpt.close()
@@ -230,6 +259,9 @@ def main(argv=None) -> int:
     p.add_argument("--profile-dir")
     p.add_argument("--sync-every", type=int, default=10,
                    help="host-sync (and metric-fetch) interval in steps")
+    p.add_argument("--data-dir",
+                   help="ImageNet-style record-shard dir (defaults to "
+                        "$KFTPU_DATA_DIR); synthetic data when unset")
     p.add_argument("--num-microbatches", type=int, default=4,
                    help="GPipe microbatches (pipelined workloads)")
     args = p.parse_args(argv)
@@ -243,7 +275,8 @@ def main(argv=None) -> int:
         checkpoint_every=args.checkpoint_every, resume=not args.no_resume,
         resume_from=args.resume_from,
         metrics_path=args.metrics_path, profile_dir=args.profile_dir,
-        workload_kwargs=workload_kwargs, sync_every=args.sync_every)
+        workload_kwargs=workload_kwargs, sync_every=args.sync_every,
+        data_dir=args.data_dir)
     log.info("done: %d steps, %.1f examples/sec", result.steps,
              result.examples_per_sec)
     return 0

@@ -190,6 +190,16 @@ class AuthIngress(ThreadedServer):
             def log_message(self, *a):
                 pass
 
+            def _drain_body(self) -> Optional[bytes]:
+                """Read the request body up-front: on keep-alive
+                connections an unread body would be parsed as the next
+                request line. Returns None on a bad Content-Length."""
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    return None
+                return self.rfile.read(length) if length > 0 else b""
+
             def _deny(self, decision: AuthDecision):
                 if decision.redirect:
                     self.send_response(302)
@@ -205,6 +215,16 @@ class AuthIngress(ThreadedServer):
                     self.wfile.write(body)
 
             def _proxy(self, method: str):
+                payload = self._drain_body()
+                if payload is None:
+                    body = b'{"error": "bad Content-Length"}'
+                    self.send_response(400)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    self.wfile.write(body)
+                    self.close_connection = True
+                    return
                 decision = ingress.authenticator.check(self.headers)
                 if not decision.ok:
                     self._deny(decision)
@@ -217,16 +237,7 @@ class AuthIngress(ThreadedServer):
                     self.end_headers()
                     self.wfile.write(body)
                     return
-                try:
-                    length = int(self.headers.get("Content-Length", 0))
-                except ValueError:
-                    body = b'{"error": "bad Content-Length"}'
-                    self.send_response(400)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                    return
-                payload = self.rfile.read(length) if length else None
+                payload = payload or None
                 url = f"http://{route.upstream}{self.path}"
                 req = urllib.request.Request(url, data=payload, method=method)
                 # never forward hop headers, the assertion, or any inbound

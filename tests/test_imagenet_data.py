@@ -1,0 +1,182 @@
+"""Real-data input path: record shards → ImageNetSource → the worker loop
+(the launcher.py --data_dir analog), plus the BASELINE config-matrix
+benchmark driver. Runs on the virtual CPU mesh."""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.data.imagenet import (ImageNetSource, read_meta,
+                                        record_bytes, write_shards)
+from kubeflow_tpu.data.pipeline import epoch_order
+
+SIZE = 16          # tiny images so resnet runs fast on CPU
+N = 48
+CLASSES = 10
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    rng = np.random.default_rng(7)
+    images = rng.integers(0, 256, (N, SIZE, SIZE, 3), dtype=np.uint8)
+    labels = np.arange(N) % CLASSES
+    d = tmp_path_factory.mktemp("imagenet")
+    meta = write_shards(str(d), images, labels, shard_records=20,
+                        num_classes=CLASSES)
+    assert meta["num_records"] == N
+    return str(d), images, labels
+
+
+class TestShardFormat:
+    def test_meta_roundtrip(self, data_dir):
+        d, *_ = data_dir
+        meta = read_meta(d)
+        assert meta["image_size"] == SIZE
+        assert meta["num_classes"] == CLASSES
+        assert meta["record_bytes"] == record_bytes(SIZE)
+        # 48 records / 20 per shard = 3 shards
+        assert len([f for f in os.listdir(d) if f.endswith(".rec")]) == 3
+
+    def test_batches_are_seed_deterministic(self, data_dir):
+        d, images, labels = data_dir
+        with ImageNetSource(d, batch_size=8, augment=False) as src:
+            first = [b["labels"].copy() for b in src.epoch(0, seed=3)]
+        with ImageNetSource(d, batch_size=8, augment=False) as src:
+            second = [b["labels"].copy() for b in src.epoch(0, seed=3)]
+        assert len(first) == 6
+        for a, b in zip(first, second):
+            np.testing.assert_array_equal(a, b)
+        # and the order is the pinned epoch permutation of the record files
+        order = epoch_order(N, 3)
+        np.testing.assert_array_equal(
+            np.concatenate(first), labels[order][: 6 * 8])
+
+    def test_epochs_reshuffle(self, data_dir):
+        d, *_ = data_dir
+        with ImageNetSource(d, batch_size=8, augment=False) as src:
+            e0 = np.concatenate([b["labels"] for b in src.epoch(0, seed=3)])
+            e1 = np.concatenate([b["labels"] for b in src.epoch(1, seed=3)])
+        assert not np.array_equal(e0, e1)
+        assert sorted(e0) == sorted(e1)
+
+    def test_images_decoded_and_normalized(self, data_dir):
+        d, images, labels = data_dir
+        with ImageNetSource(d, batch_size=8, augment=False) as src:
+            batch = next(src.epoch(0, seed=1))
+        order = epoch_order(N, 1)
+        from kubeflow_tpu.data.imagenet import MEAN_RGB, STDDEV_RGB
+        expect = (images[order[0]].astype(np.float32) / 255.0
+                  - MEAN_RGB) / STDDEV_RGB
+        np.testing.assert_allclose(batch["images"][0], expect, rtol=1e-5)
+
+    def test_augment_deterministic_per_seed(self, data_dir):
+        d, *_ = data_dir
+        with ImageNetSource(d, batch_size=8, augment=True) as src:
+            a = next(src.epoch(0, seed=5))["images"].copy()
+        with ImageNetSource(d, batch_size=8, augment=True) as src:
+            b = next(src.epoch(0, seed=5))["images"].copy()
+        with ImageNetSource(d, batch_size=8, augment=True) as src:
+            c = next(src.epoch(0, seed=6))["images"].copy()
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)
+
+    def test_bad_dir_rejected(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            ImageNetSource(str(tmp_path / "nope"), batch_size=4)
+
+    def test_too_few_records_rejected(self, tmp_path):
+        rng = np.random.default_rng(0)
+        d = str(tmp_path / "small")
+        write_shards(d, rng.integers(0, 256, (4, SIZE, SIZE, 3),
+                                     dtype=np.uint8),
+                     np.zeros(4, np.int64), num_classes=1)
+        with pytest.raises(ValueError, match="records"):
+            ImageNetSource(d, batch_size=8)
+
+    def test_resume_skips_consumed_batches(self, data_dir):
+        d, *_ = data_dir
+        with ImageNetSource(d, batch_size=8, augment=True) as src:
+            full = [b["labels"].copy() for _, b in
+                    zip(range(8), src.batches(seed=3))]
+            imgs = [b["images"].copy() for _, b in
+                    zip(range(8), src.batches(seed=3))]
+        with ImageNetSource(d, batch_size=8, augment=True) as src:
+            resumed = list(zip(range(4), src.batches(seed=3, start_batch=4)))
+        for i, (_, b) in enumerate(resumed):
+            np.testing.assert_array_equal(b["labels"], full[4 + i])
+            np.testing.assert_array_equal(b["images"], imgs[4 + i])
+
+
+class TestWorkerRealData:
+    def test_train_consumes_records_deterministically(self, data_dir):
+        d, *_ = data_dir
+        from kubeflow_tpu.runtime.worker import train
+        kw = dict(workload="resnet50", steps=3, global_batch=8,
+                  data_dir=d, sync_every=1, seed=11)
+        r1 = train(**kw)
+        r2 = train(**kw)
+        assert r1.steps == 3
+        assert np.isfinite(r1.final_metrics["loss"])
+        # the whole run is a function of (data, seed)
+        assert r1.final_metrics["loss"] == pytest.approx(
+            r2.final_metrics["loss"])
+
+    def test_env_contract(self, data_dir, monkeypatch):
+        d, *_ = data_dir
+        from kubeflow_tpu.runtime.worker import train
+        monkeypatch.setenv("KFTPU_DATA_DIR", d)
+        r = train(workload="resnet50", steps=1, global_batch=8)
+        assert r.steps == 1
+
+    def test_non_image_workload_rejects_data_dir(self, data_dir):
+        d, *_ = data_dir
+        from kubeflow_tpu.runtime.worker import train
+        with pytest.raises(ValueError, match="data-dir"):
+            train(workload="transformer", steps=1, global_batch=8,
+                  data_dir=d)
+
+
+class TestOperatorDataDir:
+    def test_data_dir_rendered_as_env(self):
+        from kubeflow_tpu.api.trainingjob import TrainingJob
+        manifest = {
+            "apiVersion": "kubeflow.org/v1", "kind": "TFJob",
+            "metadata": {"name": "j", "namespace": "default"},
+            "spec": {
+                "dataDir": "/data/imagenet",
+                "tfReplicaSpecs": {"Worker": {
+                    "replicas": 1,
+                    "template": {"spec": {"containers": [
+                        {"name": "worker", "image": "x"}]}}}},
+            },
+        }
+        job = TrainingJob.from_manifest(manifest)
+        assert job.data_dir == "/data/imagenet"
+        assert job.to_manifest()["spec"]["dataDir"] == "/data/imagenet"
+
+
+class TestBenchmarkMatrix:
+    def test_matrix_produces_csv_per_config(self, tmp_path):
+        from kubeflow_tpu.workflows.kubebench import (CONFIG_MATRIX,
+                                                      benchmark_matrix)
+        out = str(tmp_path / "matrix")
+        rows = benchmark_matrix(
+            out, steps=2, global_batch=8,
+            workload_kwargs={"image_size": 16, "num_classes": 10},
+            configs=["tf_job_simple", "katib_study"])
+        assert set(rows) == {"tf_job_simple", "katib_study"}
+        for name in rows:
+            path = os.path.join(out, f"{name}.csv")
+            with open(path) as f:
+                data = list(csv.DictReader(f))
+            assert len(data) == 1
+        assert rows["tf_job_simple"]["examples_per_sec"] > 0
+        assert rows["katib_study"]["metric_best_learning_rate"] > 0
+        # the full matrix covers every BASELINE.json config
+        assert set(CONFIG_MATRIX) == {
+            "tf_job_simple", "tf_job_dp_allreduce", "pytorch_ddp",
+            "mpi_horovod", "katib_study"}

@@ -140,6 +140,22 @@ class TestIapIngress:
         assert headers[IAP_EMAIL_HEADER] == \
             "accounts.google.com:real@example.com"
 
+    def test_denied_post_does_not_poison_keepalive(self, ingress, echo):
+        # an unread POST body on a persistent connection must not be
+        # parsed as the next request
+        import http.client
+        conn = http.client.HTTPConnection("127.0.0.1", ingress.port,
+                                          timeout=10)
+        conn.request("POST", "/app", body=b"x" * 100)  # no token → 401
+        assert conn.getresponse().read() is not None
+        token = jwt_encode({"email": "u@e.c", "aud": "backend-1",
+                            "iss": "https://cloud.google.com/iap"}, KEY)
+        conn.request("GET", "/app", headers={IAP_JWT_HEADER: token})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read())["path"] == "/app"
+        conn.close()
+
     def test_wrong_audience_401(self, ingress):
         token = jwt_encode({"email": "u@e.c", "aud": "other",
                             "iss": "https://cloud.google.com/iap"}, KEY)

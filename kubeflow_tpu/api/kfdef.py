@@ -57,6 +57,7 @@ DEFAULT_COMPONENTS = [
     "kubebench",
     "argo",
     "pipeline-scheduledworkflow",
+    "pipeline-db",
     "pipeline-apiserver",
     "pipeline-ui",
     "tpu-serving",
@@ -85,6 +86,9 @@ class KfDefSpec:
     use_istio: bool = True
     components: list[str] = field(default_factory=lambda: list(DEFAULT_COMPONENTS))
     component_params: dict[str, dict[str, Any]] = field(default_factory=dict)
+    # named config overlay merged over components/params at generate time
+    # (the kustomize-v2 base+overlay analog, manifests/overlays.py)
+    flavor: str = ""
     # TPU-specific platform defaults applied to every training component
     default_tpu_topology: str = "v5e-8"
     version: str = "0.1.0"
@@ -123,6 +127,7 @@ class KfDef:
                 "useIstio": self.spec.use_istio,
                 "components": list(self.spec.components),
                 "componentParams": self.spec.component_params,
+                "flavor": self.spec.flavor,
                 "defaultTpuTopology": self.spec.default_tpu_topology,
                 "version": self.spec.version,
                 "repo": self.spec.repo,
@@ -154,6 +159,7 @@ class KfDef:
                 use_istio=bool(spec.get("useIstio", True)),
                 components=list(spec.get("components") or DEFAULT_COMPONENTS),
                 component_params=spec.get("componentParams", {}) or {},
+                flavor=spec.get("flavor", "") or "",
                 default_tpu_topology=spec.get("defaultTpuTopology", "v5e-8"),
                 version=spec.get("version", "0.1.0"),
                 repo=spec.get("repo", ""),

@@ -1,0 +1,149 @@
+"""Application controller: aggregate component health into conditions.
+
+The reference deploys the Application CRD (app.k8s.io/v1beta1) with a
+metacontroller CompositeController whose jsonnetd sync hook folds the
+selected components' statuses into the Application's status
+(kubeflow/application/application.libsonnet:213-228 sync hook, :16-41
+CRD). Here the same aggregation is a native reconciler: spec.selector's
+matchLabels + spec.componentKinds choose the components; per-kind health
+rules roll up into status.components and a Ready condition.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..api import k8s
+from ..cluster.client import KubeClient, NotFoundError
+from .runtime import Key, Reconciler, Result, status_snapshot
+
+log = logging.getLogger(__name__)
+
+APPLICATION_API_VERSION = "app.k8s.io/v1beta1"
+APPLICATION_KIND = "Application"
+
+# group → the apiVersion we watch/list that group's kinds at
+_GROUP_VERSIONS = {
+    "": "v1",
+    "core": "v1",
+    "apps": "apps/v1",
+    "batch": "batch/v1",
+    "kubeflow.org": "kubeflow.org/v1",
+    "argoproj.io": "argoproj.io/v1alpha1",
+}
+
+# kinds watched for selector aggregation (bounded: watching every kind in
+# the cluster is the metacontroller's job; these cover what the reference's
+# packages deploy into Applications)
+WATCHED_KINDS = [
+    ("apps/v1", "Deployment"),
+    ("apps/v1", "StatefulSet"),
+    ("v1", "Service"),
+    ("batch/v1", "Job"),
+]
+
+
+def _component_ready(obj: dict) -> tuple[bool, str]:
+    """Per-kind health rule (the kube app controller's heuristics)."""
+    kind = obj.get("kind", "")
+    status = obj.get("status", {}) or {}
+    spec = obj.get("spec", {}) or {}
+    if kind in ("Deployment", "StatefulSet"):
+        want = int(spec.get("replicas", 1))
+        have = int(status.get("readyReplicas", 0))
+        return have >= want, f"{have}/{want} ready"
+    if kind == "Job":
+        if status.get("succeeded"):
+            return True, "succeeded"
+        if status.get("failed"):
+            return False, "failed"
+        return False, "running"
+    if kind == "Pod":
+        phase = status.get("phase", "Pending")
+        return phase in ("Running", "Succeeded"), phase.lower()
+    conditions = {c.get("type"): c.get("status")
+                  for c in status.get("conditions", []) or []}
+    if conditions:
+        for ctype in ("Ready", "Available", "Succeeded"):
+            if ctype in conditions:
+                return conditions[ctype] == "True", f"{ctype}={conditions[ctype]}"
+    # existence is the only signal for plain kinds (Service, ConfigMap)
+    return True, "exists"
+
+
+def _selector_matches(selector: dict, labels: dict) -> bool:
+    match = (selector or {}).get("matchLabels") or {}
+    return bool(match) and all(labels.get(k) == v for k, v in match.items())
+
+
+class ApplicationReconciler(Reconciler):
+    primary = (APPLICATION_API_VERSION, APPLICATION_KIND)
+    owns = list(WATCHED_KINDS)
+
+    def map_event(self, client: KubeClient, obj: dict) -> list[Key]:
+        """A component changed: requeue every Application whose selector
+        matches its labels (the sync-hook trigger shape)."""
+        labels = obj.get("metadata", {}).get("labels") or {}
+        ns = k8s.namespace_of(obj, "default")
+        keys = []
+        for app in client.list(APPLICATION_API_VERSION, APPLICATION_KIND, ns):
+            if _selector_matches(app.get("spec", {}).get("selector"), labels):
+                keys.append((ns, k8s.name_of(app)))
+        return keys
+
+    def reconcile(self, client: KubeClient, key: Key) -> Result:
+        ns, name = key
+        try:
+            app = client.get(APPLICATION_API_VERSION, APPLICATION_KIND,
+                             ns, name)
+        except NotFoundError:
+            return Result()
+        spec = app.get("spec", {}) or {}
+        selector = spec.get("selector") or {}
+        kinds = spec.get("componentKinds") or []
+
+        components = []
+        ready_all = True
+        for ck in kinds:
+            group = ck.get("group", "") or ""
+            kind = ck.get("kind", "")
+            api_version = _GROUP_VERSIONS.get(group, group and f"{group}/v1"
+                                              or "v1")
+            try:
+                objs = client.list(api_version, kind, ns)
+            except Exception:  # noqa: BLE001 - kind not served yet
+                objs = []
+            for obj in objs:
+                labels = obj.get("metadata", {}).get("labels") or {}
+                if not _selector_matches(selector, labels):
+                    continue
+                ok, why = _component_ready(obj)
+                ready_all = ready_all and ok
+                components.append({
+                    "group": group, "kind": kind,
+                    "name": k8s.name_of(obj),
+                    "status": "Ready" if ok else "NotReady",
+                    "reason": why,
+                })
+        if not components:
+            ready_all = False
+
+        status = dict(app.get("status", {}))
+        before = status_snapshot(status)
+        status["observedGeneration"] = app.get("metadata", {}).get(
+            "generation", 0)
+        status["componentsReady"] = (
+            f"{sum(1 for c in components if c['status'] == 'Ready')}"
+            f"/{len(components)}")
+        status["components"] = components
+        k8s.set_condition(app, k8s.Condition(
+            "Ready", "True" if ready_all else "False",
+            "ComponentsReady" if ready_all else "ComponentsNotReady",
+            status["componentsReady"] + " components ready"))
+        status["conditions"] = app["status"].get("conditions", [])
+        if status_snapshot(status) != before:
+            fresh = client.get(APPLICATION_API_VERSION, APPLICATION_KIND,
+                               ns, name)
+            fresh["status"] = status
+            client.update_status(fresh)
+        return Result()

@@ -61,6 +61,13 @@ class Reconciler:
     def reconcile(self, client: KubeClient, key: Key) -> Result:
         raise NotImplementedError
 
+    def map_event(self, client: KubeClient, obj: dict) -> list[Key]:
+        """Optional extra event→keys mapping for watched objects that do
+        not carry an owner reference to the primary (label-selector
+        aggregation, the controller-runtime EnqueueRequestsFromMapFunc
+        analog). Called when owner-ref mapping yields nothing."""
+        return []
+
 
 class _WorkQueue:
     def __init__(self):
@@ -142,6 +149,12 @@ class Controller:
                     # absence and cleans up (level-triggered).
                     self.queue.add(key)
                     n += 1
+                elif (ev.obj.get("apiVersion"), ev.obj.get("kind")) != \
+                        self.reconciler.primary:
+                    for mapped in self.reconciler.map_event(self.client,
+                                                            ev.obj):
+                        self.queue.add(mapped)
+                        n += 1
         now = time.monotonic()
         due = [k for t, k in self._delayed if t <= now]
         self._delayed = [(t, k) for t, k in self._delayed if t > now]

@@ -116,18 +116,20 @@ class ImageNetSource:
         self.augment = augment
         self.pad_px = pad_px
         self.image_dtype = image_dtype or np.float32
-        paths = shard_paths(data_dir)
-        if not paths:
+        self._num_threads = num_threads
+        self._queue_depth = queue_depth
+        self._paths = shard_paths(data_dir)
+        if not self._paths:
             raise FileNotFoundError(f"no .rec shards in {data_dir}")
-        self._pipeline = RecordPipeline(
-            paths, self.meta["record_bytes"], batch_size,
-            num_threads=num_threads, queue_depth=queue_depth)
-        self.num_batches = self._pipeline.num_batches
+        # validate from meta; the pipeline itself is constructed lazily on
+        # first epoch() with the real seed (constructing it here would
+        # start a prefetch pass epoch() immediately throws away)
+        self.num_batches = int(self.meta["num_records"]) // batch_size
         if self.num_batches == 0:
-            self._pipeline.close()
             raise ValueError(
-                f"{data_dir}: {self._pipeline.total_records} records < "
+                f"{data_dir}: {self.meta['num_records']} records < "
                 f"batch_size {batch_size} (empty epochs)")
+        self._pipeline = None
 
     # -- decode / augment (host-side, numpy) --------------------------------
 
@@ -169,7 +171,13 @@ class ImageNetSource:
         drops the first N batches (resume); determinism holds because the
         augment RNG is derived per (seed, epoch, batch index), not drawn
         sequentially."""
-        self._pipeline.reset(seed + epoch)
+        if self._pipeline is None:
+            self._pipeline = RecordPipeline(
+                self._paths, self.meta["record_bytes"], self.batch_size,
+                num_threads=self._num_threads,
+                queue_depth=self._queue_depth, seed=seed + epoch)
+        else:
+            self._pipeline.reset(seed + epoch)
         for i, raw in enumerate(self._pipeline):
             if i < skip:
                 continue
@@ -193,7 +201,9 @@ class ImageNetSource:
             skip = 0
 
     def close(self) -> None:
-        self._pipeline.close()
+        if self._pipeline is not None:
+            self._pipeline.close()
+            self._pipeline = None
 
     def __enter__(self):
         return self

@@ -85,9 +85,16 @@ class BootstrapService:
         name = body.get("name", "")
         app_dir = self._app_dir(name)
         spec_kwargs = {}
-        for key in ("platform", "components", "namespace"):
+        for key in ("platform", "components", "namespace", "project",
+                    "zone", "flavor"):
             if body.get(key) is not None:
                 spec_kwargs[key] = body[key]
+        if spec_kwargs.get("flavor"):
+            from ..manifests.overlays import FLAVORS
+            if spec_kwargs["flavor"] not in FLAVORS:
+                raise ApiError(400, f"unknown flavor "
+                                    f"{spec_kwargs['flavor']!r}; known: "
+                                    f"{sorted(FLAVORS)}")
         if body.get("params"):
             spec_kwargs["component_params"] = body["params"]
         # unknown components are a 400 before anything touches disk
@@ -199,12 +206,60 @@ class BootstrapService:
         return Coordinator.load(app_dir).show()
 
 
+# the click-to-deploy page (gcp-click-to-deploy React UI analog): form →
+# POST /kfctl/e2eDeploy, progress log, app listing — one static JS file
+DEPLOY_HTML = """<!doctype html>
+<html><head><title>Deploy Kubeflow TPU</title><meta charset="utf-8"><style>
+body{font-family:sans-serif;margin:2rem auto;max-width:44rem}
+form{display:grid;grid-template-columns:10rem 1fr;gap:0.6rem}
+input,select{padding:0.4rem}button{grid-column:2;padding:0.6rem}
+#deploy-log{background:#111;color:#9f9;font-family:monospace;
+min-height:8rem;max-height:16rem;overflow-y:auto;padding:0.6rem;
+margin-top:1rem;white-space:pre-wrap}
+#deploy-log .error{color:#f99}#deploy-log .ok{color:#fff}
+.empty{color:#777}</style></head><body>
+<h1>Deploy Kubeflow TPU</h1>
+<form id="deploy-form">
+  <label>deployment name</label><input name="appname" required
+    pattern="[a-z0-9][a-z0-9-]*" value="kubeflow">
+  <label>platform</label><select name="platform">
+    <option value="existing">existing cluster</option>
+    <option value="gcp">gcp</option>
+    <option value="minikube">minikube</option></select>
+  <label>GCP project</label><input name="project" placeholder="(gcp only)">
+  <label>namespace</label><input name="namespace" value="kubeflow">
+  <label>config flavor</label><select name="flavor">
+    <option value="">default</option><option>local</option>
+    <option>iap</option><option>basic_auth</option></select>
+  <button type="submit">Create deployment</button>
+</form>
+<div id="deploy-log"></div>
+<h2>Deployments</h2><ul id="apps"></ul>
+<script src="/deploy.js"></script>
+</body></html>"""
+
+_STATIC_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "webapps", "static")
+
+
 def build_bootstrap_app(service: BootstrapService) -> JsonApp:
     app = JsonApp()
 
     @app.route("GET", "/healthz")
     def healthz(params, query, body):
         return 200, {"ok": True}
+
+    @app.route("GET", "/")
+    def deploy_page(params, query, body):
+        return 200, RawResponse(DEPLOY_HTML,
+                                content_type="text/html; charset=utf-8")
+
+    @app.route("GET", "/deploy.js")
+    def deploy_js(params, query, body):
+        with open(os.path.join(_STATIC_DIR, "deploy.js")) as f:
+            return 200, RawResponse(
+                f.read(),
+                content_type="application/javascript; charset=utf-8")
 
     @app.route("GET", "/metrics")
     def metrics(params, query, body):

@@ -95,6 +95,14 @@ class Coordinator:
                  self.kfdef.spec.app_dir, self.kfdef.spec.platform,
                  len(self.kfdef.spec.components))
 
+    def effective_components(self) -> tuple[list[str], dict]:
+        """Components + params with the spec's flavor overlay merged (the
+        kustomize-v2 MergeKustomization analog, manifests/overlays.py)."""
+        from ..manifests.overlays import resolve
+        return resolve(self.kfdef.spec.components,
+                       self.kfdef.spec.component_params,
+                       self.kfdef.spec.flavor)
+
     def generate(self, resources: str = RESOURCE_ALL) -> list[str]:
         """Render every component's manifests to manifests/<name>.yaml
         (the ksonnet.Generate / componentAdd analog, ksonnet.go:316)."""
@@ -104,8 +112,15 @@ class Coordinator:
         if resources in (RESOURCE_ALL, RESOURCE_K8S):
             out_dir = os.path.join(self.kfdef.spec.app_dir, MANIFESTS_DIR)
             os.makedirs(out_dir, exist_ok=True)
-            for comp in self.kfdef.spec.components:
-                objs = build_component(comp, self.kfdef.spec.params_for(comp))
+            components, params = self.effective_components()
+            for stale in os.listdir(out_dir):
+                # flavor switches drop components: clear stale renders so
+                # apply never picks up the previous flavor's manifests
+                if stale.endswith(".yaml") and \
+                        stale[:-5] not in components:
+                    os.unlink(os.path.join(out_dir, stale))
+            for comp in components:
+                objs = build_component(comp, params.get(comp, {}))
                 path = os.path.join(out_dir, f"{comp}.yaml")
                 with open(path, "w") as f:
                     f.write(yamlio.dump_all(objs))
@@ -120,7 +135,8 @@ class Coordinator:
             raise FileNotFoundError(
                 f"{out_dir} not found — run `kfctl generate` first")
         objs: list[dict] = []
-        for comp in self.kfdef.spec.components:
+        components, _ = self.effective_components()
+        for comp in components:
             path = os.path.join(out_dir, f"{comp}.yaml")
             if os.path.exists(path):
                 with open(path) as f:
@@ -163,7 +179,8 @@ class Coordinator:
 
     def show(self) -> dict:
         comps = {}
-        for comp in self.kfdef.spec.components:
+        components, _ = self.effective_components()  # flavor-aware
+        for comp in components:
             path = os.path.join(self.kfdef.spec.app_dir, MANIFESTS_DIR,
                                 f"{comp}.yaml")
             n = 0
@@ -171,12 +188,15 @@ class Coordinator:
                 with open(path) as f:
                     n = len(yamlio.load_all(f.read()))
             comps[comp] = n
-        return {"name": self.kfdef.name,
-                "platform": self.kfdef.spec.platform,
-                "namespace": self.kfdef.spec.namespace,
-                "components": comps,
-                "conditions": [c.type + "=" + c.status
-                               for c in self.kfdef.conditions]}
+        out = {"name": self.kfdef.name,
+               "platform": self.kfdef.spec.platform,
+               "namespace": self.kfdef.spec.namespace,
+               "components": comps,
+               "conditions": [c.type + "=" + c.status
+                              for c in self.kfdef.conditions]}
+        if self.kfdef.spec.flavor:
+            out["flavor"] = self.kfdef.spec.flavor
+        return out
 
 
 class ApplyOutcome:
@@ -199,6 +219,9 @@ def register_verbs(sub: argparse._SubParsersAction) -> None:
     p_init.add_argument("--tpu-topology", default="v5e-8")
     p_init.add_argument("--components", default="",
                         help="comma-separated override of the component list")
+    p_init.add_argument("--flavor", default="",
+                        help="named config overlay (local | iap | "
+                             "basic_auth) merged at generate time")
     p_init.add_argument("--kubeconfig", default="",
                         help="target a real apiserver instead of the "
                              "persisted simulated cluster")
@@ -210,6 +233,10 @@ def register_verbs(sub: argparse._SubParsersAction) -> None:
         p.add_argument("resources", nargs="?", default="all",
                        choices=["all", "k8s", "platform"])
         p.add_argument("--app-dir", default=".")
+        if verb == "generate":
+            p.add_argument("--flavor", default=None,
+                           help="set the app's config flavor (persisted "
+                                "to app.yaml so apply matches the render)")
         p.set_defaults(func=fn)
 
     p_show = sub.add_parser("show", help="show app state")
@@ -247,7 +274,8 @@ def _cmd_init(args) -> int:
     kwargs = dict(platform=args.platform, project=args.project,
                   zone=args.zone, namespace=args.namespace,
                   use_basic_auth=args.use_basic_auth,
-                  default_tpu_topology=args.tpu_topology)
+                  default_tpu_topology=args.tpu_topology,
+                  flavor=args.flavor)
     if args.components:
         kwargs["components"] = [c.strip() for c in args.components.split(",")]
     if args.kubeconfig:
@@ -260,8 +288,12 @@ def _cmd_init(args) -> int:
 
 def _cmd_generate(args) -> int:
     coord = Coordinator.load(args.app_dir)
+    if getattr(args, "flavor", None) is not None:
+        coord.kfdef.spec.flavor = args.flavor
     written = coord.generate(args.resources)
-    print(f"generated {len(written)} component manifests")
+    print(f"generated {len(written)} component manifests"
+          + (f" (flavor={coord.kfdef.spec.flavor})"
+             if coord.kfdef.spec.flavor else ""))
     return 0
 
 

@@ -1,0 +1,103 @@
+"""Deployment-Manager simulator: the executor for zero-egress dev/test.
+
+Models the surface GcpPlatform.apply drives (platforms.py): deployment
+insert/update returning async operations that progress RUNNING → DONE
+across polls, operation errors, project IAM policy read-modify-write, and
+service-account key minting. The same seam a production executor fills
+with googleapis clients — so the full gcp.go sequence (updateDM →
+blockingWait → IAM → secrets) is exercisable without a cloud.
+"""
+
+from __future__ import annotations
+
+import base64
+import itertools
+import json
+from typing import Optional
+
+
+class GcpSimulator:
+    """call(method, request) -> response, with injectable op behavior."""
+
+    def __init__(self, polls_until_done: int = 2,
+                 fail_op: Optional[str] = None):
+        self.polls_until_done = polls_until_done
+        self.fail_op = fail_op            # op name to fail, if any
+        self.deployments: dict[str, dict] = {}
+        self.iam_policy: dict = {"etag": "etag-0", "bindings": []}
+        self.calls: list[tuple[str, dict]] = []
+        self._ops: dict[str, dict] = {}
+        self._seq = itertools.count(1)
+
+    # -- executor entrypoint -------------------------------------------------
+
+    def __call__(self, method: str, request: dict) -> dict:
+        self.calls.append((method, dict(request)))
+        handler = getattr(self, "_" + method.replace(".", "_"), None)
+        if handler is None:
+            raise ValueError(f"GcpSimulator: unknown method {method!r}")
+        return handler(request)
+
+    # -- deployments ---------------------------------------------------------
+
+    def _new_op(self, kind: str, target: str) -> dict:
+        name = f"op-{next(self._seq)}"
+        op = {"name": name, "operationType": kind, "targetLink": target,
+              "status": "RUNNING", "_polls": 0}
+        self._ops[name] = op
+        return {k: v for k, v in op.items() if not k.startswith("_")}
+
+    def _deployments_get(self, req: dict) -> Optional[dict]:
+        # seam contract: None = not found (platforms.py _update_dm)
+        return self.deployments.get(req["deployment"])
+
+    def _deployments_insert(self, req: dict) -> dict:
+        self.deployments[req["deployment"]] = {
+            "name": req["deployment"], "fingerprint": "fp-1",
+            "config": req.get("config", "")}
+        return self._new_op("insert", req["deployment"])
+
+    def _deployments_update(self, req: dict) -> dict:
+        if req["deployment"] not in self.deployments:
+            raise KeyError(req["deployment"])
+        if req.get("fingerprint") != \
+                self.deployments[req["deployment"]]["fingerprint"]:
+            raise ValueError("fingerprint mismatch (concurrent update)")
+        dep = self.deployments[req["deployment"]]
+        dep["fingerprint"] = f"fp-{next(self._seq)}"
+        dep["config"] = req.get("config", dep["config"])
+        return self._new_op("update", req["deployment"])
+
+    def _deployments_delete(self, req: dict) -> dict:
+        self.deployments.pop(req["deployment"], None)
+        return self._new_op("delete", req["deployment"])
+
+    def _operations_get(self, req: dict) -> dict:
+        op = self._ops[req["operation"]]
+        op["_polls"] += 1
+        if op["_polls"] >= self.polls_until_done:
+            op["status"] = "DONE"
+            if op["name"] == self.fail_op:
+                op["error"] = {"errors": [
+                    {"code": "RESOURCE_ERROR", "message": "quota exceeded"}]}
+        return {k: v for k, v in op.items() if not k.startswith("_")}
+
+    # -- IAM / SA keys -------------------------------------------------------
+
+    def _projects_getIamPolicy(self, req: dict) -> dict:
+        return json.loads(json.dumps(self.iam_policy))
+
+    def _projects_setIamPolicy(self, req: dict) -> dict:
+        policy = req["policy"]
+        if policy.get("etag") != self.iam_policy["etag"]:
+            raise ValueError("etag mismatch (concurrent policy write)")
+        self.iam_policy = {
+            "etag": f"etag-{next(self._seq)}",
+            "bindings": policy.get("bindings", [])}
+        return self.iam_policy
+
+    def _serviceAccounts_keys_create(self, req: dict) -> dict:
+        payload = json.dumps({"type": "service_account",
+                              "client_email": req["name"]}).encode()
+        return {"name": f"{req['name']}/keys/k-{next(self._seq)}",
+                "privateKeyData": base64.b64encode(payload).decode()}

@@ -64,21 +64,87 @@ class DockerForDesktop(Platform):
     name = PLATFORM_DOCKER_FOR_DESKTOP
 
 
+class CloudOpError(RuntimeError):
+    """A cloud operation finished with errors (blockingWait failure)."""
+
+
+class Backoff:
+    """Exponential backoff schedule (gcp.go newDefaultBackoff :129)."""
+
+    def __init__(self, initial_s: float = 1.0, factor: float = 2.0,
+                 max_interval_s: float = 30.0, deadline_s: float = 1200.0):
+        self.initial_s = initial_s
+        self.factor = factor
+        self.max_interval_s = max_interval_s
+        self.deadline_s = deadline_s
+
+    def intervals(self):
+        total, cur = 0.0, self.initial_s
+        while total < self.deadline_s:
+            yield cur
+            total += cur
+            cur = min(cur * self.factor, self.max_interval_s)
+
+
+def blocking_wait(executor: "Callable[[str, dict], dict]", op: dict,
+                  backoff: Optional[Backoff] = None,
+                  sleep: Callable[[float], None] = None) -> dict:
+    """Poll a deployment-manager operation to DONE with exponential
+    backoff (gcp.go blockingWait :267-308). Raises CloudOpError on an
+    errored op, TimeoutError past the backoff deadline."""
+    import time as _time
+    sleep = sleep or _time.sleep
+    backoff = backoff or Backoff()
+    name = op.get("name", "")
+
+    def check(op: dict) -> bool:
+        if op.get("status") != "DONE":
+            return False
+        errors = (op.get("error") or {}).get("errors")
+        if errors:
+            raise CloudOpError(f"operation {name} failed: {errors}")
+        return True
+
+    if check(op):
+        return op
+    for interval in backoff.intervals():
+        sleep(interval)
+        op = executor("operations.get", {"operation": name})
+        if check(op):  # the final poll must count too
+            return op
+    raise TimeoutError(f"operation {name} did not complete within "
+                       f"{backoff.deadline_s}s")
+
+
 class GcpPlatform(Platform):
     """GCP driver (gcp.go analog, 1,616 LoC in the reference).
 
     generate: writes deployment-manager-style configs into
     <app_dir>/gcp_config/ — cluster with TPU pod-slice node pools, IAM
     bindings, storage (generateDMConfigs analog, gcp.go:1238).
-    apply/delete: calls the injected executor with the prepared requests
-    (updateDM analog, gcp.go:562); by default the executor raises, since
-    this build runs with zero cloud egress.
+
+    apply/delete drive the full reference sequence behind the executor
+    seam (zero-egress dev default: no executor → actionable error):
+      1. deployments.get → insert or update        (updateDM, gcp.go:562)
+      2. poll the returned op with exponential backoff
+                                             (blockingWait, gcp.go:267-308)
+      3. getIamPolicy → merge bindings → setIamPolicy
+                                             (updateIamPolicy, gcp.go:392)
+      4. service-account key → k8s secret manifests
+                                             (createSecrets, gcp.go:1391)
+      5. admin RBAC manifest                 (ConfigK8s/bindAdmin, gcp.go:440)
+    The executor is `call(method, request) -> response`; a production
+    executor maps methods onto googleapis clients 1:1.
     """
 
     name = PLATFORM_GCP
 
-    def __init__(self, executor: Optional[Callable[[str, dict], None]] = None):
+    def __init__(self, executor: Optional[Callable[[str, dict], dict]] = None,
+                 backoff: Optional[Backoff] = None,
+                 sleep: Callable[[float], None] = None):
         self.executor = executor
+        self.backoff = backoff
+        self.sleep = sleep
 
     def _config_dir(self, kfdef: KfDef) -> str:
         return os.path.join(kfdef.spec.app_dir, "gcp_config")
@@ -126,6 +192,87 @@ class GcpPlatform(Platform):
         yamlio.dump_file(iam, os.path.join(d, "iam_bindings.yaml"))
         log.info("gcp configs written to %s", d)
 
+    # -- apply stages (updateDM → blockingWait → IAM → secrets → RBAC) ------
+
+    def _deployment_name(self, kfdef: KfDef) -> str:
+        return f"{kfdef.name}-cluster"
+
+    def _update_dm(self, kfdef: KfDef) -> dict:
+        """Insert-or-update the DM deployment (gcp.go updateDM :562)."""
+        name = self._deployment_name(kfdef)
+        config_path = os.path.join(self._config_dir(kfdef),
+                                   "cluster-kubeflow.yaml")
+        request = {"project": kfdef.spec.project, "deployment": name,
+                   "config": config_path}
+        # executor seam convention: deployments.get returns None for a
+        # missing deployment (a googleapis-backed executor catches its
+        # HttpError 404 and returns None — documented contract, not an
+        # exception type the simulator happens to raise)
+        existing = self.executor("deployments.get",
+                                 {"project": kfdef.spec.project,
+                                  "deployment": name})
+        method = "deployments.update" if existing else "deployments.insert"
+        if existing:
+            # DM update requires the current fingerprint (gcp.go :600)
+            request["fingerprint"] = existing.get("fingerprint", "")
+        return self.executor(method, request)
+
+    def _update_iam(self, kfdef: KfDef) -> None:
+        """Read-modify-write the project IAM policy, preserving existing
+        members (gcp.go updateIamPolicy — naive set overwrites races)."""
+        policy = self.executor("projects.getIamPolicy",
+                               {"project": kfdef.spec.project})
+        bindings = {b["role"]: list(b.get("members", []))
+                    for b in policy.get("bindings", [])}
+        wanted = yamlio.load_file(
+            os.path.join(self._config_dir(kfdef), "iam_bindings.yaml"))
+        for b in wanted.get("bindings", []):
+            members = bindings.setdefault(b["role"], [])
+            for m in b.get("members", []):
+                if m not in members:
+                    members.append(m)
+        self.executor("projects.setIamPolicy", {
+            "project": kfdef.spec.project,
+            "policy": {"etag": policy.get("etag", ""),
+                       "bindings": [{"role": r, "members": m}
+                                    for r, m in sorted(bindings.items())]},
+        })
+
+    def _create_secrets(self, kfdef: KfDef) -> None:
+        """Mint the admin SA key and stage it as a k8s Secret manifest for
+        the k8s apply phase (gcp.go createSecrets :1391 creates
+        admin-gcp-sa + user-gcp-sa + oauth secrets in-cluster)."""
+        sa = (f"{kfdef.name}-admin@{kfdef.spec.project}"
+              f".iam.gserviceaccount.com")
+        key = self.executor("serviceAccounts.keys.create", {"name": sa})
+        secrets = [{
+            "apiVersion": "v1", "kind": "Secret",
+            "metadata": {"name": "admin-gcp-sa",
+                         "namespace": kfdef.spec.namespace},
+            "data": {"admin-gcp-sa.json":
+                     key.get("privateKeyData", "")},
+        }]
+        yamlio.dump_file({"secrets": secrets},
+                         os.path.join(self._config_dir(kfdef),
+                                      "secrets.yaml"))
+
+    def _bind_admin(self, kfdef: KfDef) -> None:
+        """Stage the cluster-admin binding applied right after cluster
+        creation (gcp.go ConfigK8s/bindAdmin :440)."""
+        binding = {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": {"name": "default-admin"},
+            "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                        "kind": "ClusterRole", "name": "cluster-admin"},
+            "subjects": [{"kind": "User",
+                          "name": f"{kfdef.name}-admin@{kfdef.spec.project}"
+                                  f".iam.gserviceaccount.com"}],
+        }
+        yamlio.dump_file(binding,
+                         os.path.join(self._config_dir(kfdef),
+                                      "default-admin.yaml"))
+
     def apply(self, kfdef: KfDef) -> None:
         if self.executor is None:
             raise RuntimeError(
@@ -133,13 +280,21 @@ class GcpPlatform(Platform):
                 "environment); configs were generated under gcp_config/ — "
                 "apply them with `gcloud deployment-manager deployments "
                 "create` or inject an executor")
-        self.executor("deployments.insert",
-                      {"config": os.path.join(self._config_dir(kfdef),
-                                              "cluster-kubeflow.yaml")})
+        op = self._update_dm(kfdef)
+        blocking_wait(self.executor, op, backoff=self.backoff,
+                      sleep=self.sleep)
+        self._update_iam(kfdef)
+        self._create_secrets(kfdef)
+        self._bind_admin(kfdef)
 
     def delete(self, kfdef: KfDef) -> None:
-        if self.executor is not None:
-            self.executor("deployments.delete", {"name": f"{kfdef.name}-cluster"})
+        if self.executor is None:
+            return
+        op = self.executor("deployments.delete",
+                           {"project": kfdef.spec.project,
+                            "deployment": self._deployment_name(kfdef)})
+        blocking_wait(self.executor, op, backoff=self.backoff,
+                      sleep=self.sleep)
 
 
 _PLATFORMS: dict[str, Callable[[], Platform]] = {

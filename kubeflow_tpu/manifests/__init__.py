@@ -14,4 +14,5 @@ __all__ = ["REGISTRY", "register", "build_component", "component_names"]
 
 # Importing the package modules populates the registry.
 from . import (core, training, serving, notebooks, multitenancy, katib,  # noqa: F401,E402
-               kubebench, observability, cloud_aws, cloud_gcp, pipelines)
+               kubebench, observability, cloud_aws, cloud_gcp, ecosystem,
+               pipelines)

@@ -139,3 +139,51 @@ def gatekeeper(namespace: str = "kubeflow", username: str = "admin") -> list[dic
     login_svc = H.service("kflogin", namespace, 80, target_port=5000)
     vs = H.virtual_service("kflogin", namespace, "/kflogin", "kflogin", 80)
     return [secret, dep, svc, login, login_svc, vs]
+
+
+@register("bootstrapper", "In-cluster bootstrap StatefulSet — the "
+                          "one-command install (bootstrap/bootstrapper.yaml "
+                          "parity)")
+def bootstrapper(namespace: str = "kubeflow-admin",
+                 apps_root: str = "/opt/bootstrap/apps") -> list[dict]:
+    ns = k8s.make("v1", "Namespace", namespace)
+    sa = H.service_account("kubeflow-bootstrapper", namespace)
+    binding = H.cluster_role_binding("kubeflow-cluster-admin",
+                                     "cluster-admin",
+                                     "kubeflow-bootstrapper", namespace)
+    sts = {
+        "apiVersion": "apps/v1", "kind": "StatefulSet",
+        "metadata": {"name": "kubeflow-bootstrapper",
+                     "namespace": namespace,
+                     "labels": H.std_labels("kubeflow-bootstrapper")},
+        "spec": {
+            "serviceName": "kubeflow-bootstrapper",
+            "replicas": 1,
+            "selector": {"matchLabels":
+                         {H.APP_LABEL: "kubeflow-bootstrapper"}},
+            "template": {
+                "metadata": {"labels":
+                             {H.APP_LABEL: "kubeflow-bootstrapper"}},
+                "spec": {
+                    "serviceAccountName": "kubeflow-bootstrapper",
+                    "containers": [{
+                        "name": "bootstrapper",
+                        "image": f"{IMG}/bootstrapper:{VERSION}",
+                        "args": ["serve-bootstrap",
+                                 f"--apps-root={apps_root}",
+                                 "--host=0.0.0.0", "--port=8085"],
+                        "ports": [{"containerPort": 8085}],
+                        "volumeMounts": [{"name": "apps",
+                                          "mountPath": apps_root}],
+                    }],
+                },
+            },
+            "volumeClaimTemplates": [{
+                "metadata": {"name": "apps"},
+                "spec": {"accessModes": ["ReadWriteOnce"],
+                         "resources": {"requests": {"storage": "10Gi"}}},
+            }],
+        },
+    }
+    svc = H.service("kubeflow-bootstrapper", namespace, 8085)
+    return [ns, sa, binding, sts, svc]

@@ -1,0 +1,95 @@
+"""Config flavors: the kustomize-v2 base+overlay merge analog.
+
+The reference's next-gen package manager walks a config layout of one base
+plus named overlays (bootstrap/config/{base,overlays/{basic_auth,gcp,...}})
+and merges overlay kustomizations over the base with param substitution
+(bootstrap/v2/pkg/kfapp/kustomize/kustomize.go:596-683 MergeKustomization).
+
+Here a flavor is a typed overlay over the KfDef spec: components to add or
+drop plus per-component param overrides, resolved at generate time so
+`kfctl generate --flavor=iap` and `--flavor=basic_auth` render different
+manifest sets from the same app. Explicit user componentParams always win
+over flavor params (the kustomize behavior: the more specific layer wins).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass(frozen=True)
+class Flavor:
+    name: str
+    description: str = ""
+    components_add: tuple = ()
+    components_remove: tuple = ()
+    component_params: dict = field(default_factory=dict)
+
+
+FLAVORS: dict[str, Flavor] = {}
+
+
+def _register(flavor: Flavor) -> Flavor:
+    FLAVORS[flavor.name] = flavor
+    return flavor
+
+
+# base = the KfDef component list untouched (bootstrap/config/base)
+_register(Flavor(
+    name="local",
+    description="no cloud ingress; gatekeeper only "
+                "(overlays/ksonnet local flavor)",
+))
+
+_register(Flavor(
+    name="iap",
+    description="GCP IAP-protected ingress "
+                "(bootstrap/config/kfctl_iap.yaml overlay)",
+    components_add=("iap-ingress", "cert-manager", "cloud-endpoints"),
+    components_remove=("basic-auth-ingress",),
+    component_params={
+        "iap-ingress": {"upstream": "centraldashboard:80"},
+    },
+))
+
+_register(Flavor(
+    name="basic_auth",
+    description="gatekeeper-backed auth ingress "
+                "(bootstrap/config/overlays/basic_auth)",
+    components_add=("basic-auth-ingress", "gatekeeper"),
+    components_remove=("iap-ingress", "cert-manager", "cloud-endpoints"),
+    component_params={
+        "basic-auth-ingress": {"upstream": "centraldashboard:80"},
+    },
+))
+
+
+def flavor_names() -> list[str]:
+    return sorted(FLAVORS)
+
+
+def resolve(components: list[str],
+            component_params: dict[str, dict[str, Any]],
+            flavor: str = "") -> tuple[list[str], dict[str, dict[str, Any]]]:
+    """Merge a flavor over the base (components, params); returns the
+    effective pair without mutating the inputs. Unknown flavor raises."""
+    if not flavor or flavor == "local":
+        if flavor and flavor not in FLAVORS:
+            raise KeyError(
+                f"unknown flavor {flavor!r}; known: {flavor_names()}")
+        return list(components), {k: dict(v)
+                                  for k, v in component_params.items()}
+    if flavor not in FLAVORS:
+        raise KeyError(f"unknown flavor {flavor!r}; known: {flavor_names()}")
+    f = FLAVORS[flavor]
+    out_components = [c for c in components if c not in f.components_remove]
+    for c in f.components_add:
+        if c not in out_components:
+            out_components.append(c)
+    out_params = {k: dict(v) for k, v in component_params.items()}
+    for comp, params in f.component_params.items():
+        merged = dict(params)
+        merged.update(out_params.get(comp, {}))  # user params win
+        out_params[comp] = merged
+    return out_components, out_params

@@ -53,25 +53,113 @@ def pipeline_scheduledworkflow(namespace: str = "kubeflow") -> list[dict]:
     return [crd, dep]
 
 
+def _mount_store(dep: dict, pvc: str, mount_path: str) -> dict:
+    pod = dep["spec"]["template"]["spec"]
+    pod["volumes"] = [{"name": "store",
+                       "persistentVolumeClaim": {"claimName": pvc}}]
+    pod["containers"][0]["volumeMounts"] = [
+        {"name": "store", "mountPath": mount_path}]
+    return dep
+
+
 @register("pipeline-apiserver", "Pipeline run/job REST API + persistence "
                                 "(pipeline-apiserver + "
-                                "persistenceagent + mysql parity)")
+                                "persistenceagent parity)")
 def pipeline_apiserver(namespace: str = "kubeflow",
                        store_path: str = "/var/lib/kubeflow/runs.db"
                        ) -> list[dict]:
-    dep = H.deployment(
+    import os
+    mount = os.path.dirname(store_path) or "/var/lib/kubeflow"
+    dep = _mount_store(H.deployment(
         "ml-pipeline", namespace, f"{IMG}/pipeline-api:{VERSION}",
         args=[f"--store={store_path}"],
-        service_account="workflow-controller", port=8888)
+        service_account="workflow-controller", port=8888),
+        "ml-pipeline-db", mount)
     svc = H.service("ml-pipeline", namespace, 8888)
-    # persistence agent: workflow watcher feeding the run store (the
-    # sqlite file replaces the reference's mysql.libsonnet pod)
-    agent = H.deployment(
-        "ml-pipeline-persistenceagent", namespace,
-        f"{IMG}/manager:{VERSION}",
-        args=["--controllers=persistenceagent", f"--store={store_path}"],
-        service_account="workflow-controller", port=9092)
-    return [dep, svc, agent]
+    # persistence agent rides the SAME pod as a second container: the
+    # store is a PVC-backed sqlite file, so both writers must share a
+    # node (ReadWriteOnce) — co-containering is the reference's
+    # mysql-colocated shape translated to the embedded DB
+    dep["spec"]["template"]["spec"]["containers"].append({
+        "name": "persistenceagent",
+        "image": f"{IMG}/manager:{VERSION}",
+        "args": ["--controllers=persistenceagent", f"--store={store_path}"],
+        "ports": [{"containerPort": 9092}],
+        "volumeMounts": [{"name": "store", "mountPath": mount}],
+    })
+    return [dep, svc]
+
+
+@register("pipeline-db", "Durable run-store volume — the mysql.libsonnet "
+                         "slot (PVC-backed sqlite replaces the MySQL pod)")
+def pipeline_db(namespace: str = "kubeflow",
+                capacity: str = "20Gi",
+                storage_class: str = "") -> list[dict]:
+    pvc = {
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "ml-pipeline-db", "namespace": namespace,
+                     "labels": H.std_labels("ml-pipeline-db")},
+        "spec": {
+            "accessModes": ["ReadWriteOnce"],
+            "resources": {"requests": {"storage": capacity}},
+            **({"storageClassName": storage_class} if storage_class else {}),
+        },
+    }
+    return [pvc]
+
+
+@register("minio", "S3-compatible artifact store "
+                   "(kubeflow/pipeline/minio.libsonnet parity)")
+def minio(namespace: str = "kubeflow",
+          capacity: str = "20Gi",
+          access_key: str = "minio",
+          secret_key: str = "minio123") -> list[dict]:
+    pvc = {
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "minio-pvc", "namespace": namespace},
+        "spec": {"accessModes": ["ReadWriteOnce"],
+                 "resources": {"requests": {"storage": capacity}}},
+    }
+    secret = {
+        "apiVersion": "v1", "kind": "Secret",
+        "metadata": {"name": "mlpipeline-minio-artifact",
+                     "namespace": namespace},
+        "stringData": {"accesskey": access_key, "secretkey": secret_key},
+    }
+    dep = _mount_store(H.deployment(
+        "minio", namespace, "minio/minio:RELEASE.2019-02-26T19-51-55Z",
+        args=["server", "/data"],
+        env={"MINIO_ACCESS_KEY": access_key,
+             "MINIO_SECRET_KEY": secret_key},
+        port=9000), "minio-pvc", "/data")
+    svc = H.service("minio-service", namespace, 9000)
+    svc["spec"]["selector"] = {H.APP_LABEL: "minio"}
+    return [pvc, secret, dep, svc]
+
+
+@register("pipeline-viewercrd", "Viewer CRD + controller for run artifact "
+                                "viewers (pipeline-viewercrd.libsonnet "
+                                "parity)")
+def pipeline_viewercrd(namespace: str = "kubeflow",
+                       max_num_viewers: int = 50) -> list[dict]:
+    crd = H.crd("viewers", "Viewer", "kubeflow.org", ["v1beta1"])
+    sa = H.service_account("ml-pipeline-viewer-crd-sa", namespace)
+    role = H.cluster_role("ml-pipeline-viewer-controller", [
+        {"apiGroups": ["kubeflow.org"], "resources": ["viewers"],
+         "verbs": ["*"]},
+        {"apiGroups": ["apps"], "resources": ["deployments"],
+         "verbs": ["*"]},
+        {"apiGroups": [""], "resources": ["services"], "verbs": ["*"]},
+    ])
+    binding = H.cluster_role_binding("ml-pipeline-viewer-controller",
+                                     "ml-pipeline-viewer-controller",
+                                     "ml-pipeline-viewer-crd-sa", namespace)
+    dep = H.deployment(
+        "ml-pipeline-viewer-controller", namespace,
+        f"{IMG}/viewer-crd-controller:{VERSION}",
+        args=[f"--max_num_viewers={max_num_viewers}"],
+        service_account="ml-pipeline-viewer-crd-sa", port=9093)
+    return [crd, sa, role, binding, dep]
 
 
 @register("pipeline-ui", "Pipelines UI page served by the central "

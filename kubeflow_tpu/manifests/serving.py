@@ -7,6 +7,8 @@ kubeflow/tf-batch-predict, kubeflow/tensorboard.
 
 from __future__ import annotations
 
+import json
+
 from ..api import k8s
 from . import helpers as H
 from .registry import register
@@ -105,3 +107,42 @@ def tensorboard(namespace: str = "kubeflow", name: str = "tensorboard",
     svc = H.service(name, namespace, 80, target_port=6006)
     vs = H.virtual_service(name, namespace, f"/{name}/", name, 80)
     return [dep, svc, vs]
+
+
+@register("serving-request-logger", "Request-log sidecar config for the "
+                                    "model server (k8s-model-server/"
+                                    "fluentd-logger parity)")
+def serving_request_logger(namespace: str = "kubeflow",
+                           serving_name: str = "tpu-serving",
+                           log_path: str = "/var/log/serving/requests.log"
+                           ) -> list[dict]:
+    """Fluentd sidecar ConfigMap tailing the model server's request log
+    into the cluster log pipeline; attach by adding the sidecar to the
+    serving Deployment (the reference ships the same as a fluentd image +
+    conf)."""
+    conf = f"""<source>
+  @type tail
+  path {log_path}
+  pos_file /var/log/serving/requests.pos
+  tag serving.requests
+  format json
+</source>
+<match serving.requests>
+  @type stdout
+</match>
+"""
+    cm = H.config_map(f"{serving_name}-request-logger", namespace,
+                      {"fluent.conf": conf})
+    sidecar = {
+        "name": "request-logger",
+        "image": "fluent/fluentd:v1.3-onbuild",
+        "volumeMounts": [
+            {"name": "request-log", "mountPath": "/var/log/serving"},
+            {"name": "fluentd-conf", "mountPath": "/fluentd/etc"},
+        ],
+    }
+    # the sidecar spec is published as data so installers can graft it
+    # onto the serving pod template (the libsonnet mixin pattern)
+    mixin = H.config_map(f"{serving_name}-request-logger-sidecar", namespace,
+                         {"sidecar.json": json.dumps(sidecar)})
+    return [cm, mixin]

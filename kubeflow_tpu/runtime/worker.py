@@ -187,34 +187,40 @@ def train(
     # steps (the fetch at the window edge is still a hard barrier — see
     # bench.py on why block_until_ready is not one on tunneled platforms).
     sync_every = max(1, int(sync_every))
-    with profile_trace(profile_dir, enabled=profile_dir is not None):
-        window = 0
-        mlog.start_step()
-        for step in range(start_step, steps):
-            if data_iter is not None:
-                batch = builder.place_batch(next(data_iter))
-            else:
-                data_rng, brng = jax.random.split(data_rng)
-                batch = builder.place_batch(spec.batch_fn(brng, global_batch))
-            state, metrics = step_fn(state, batch)
-            window += 1
-            # checkpoint saves are their own sync point (orbax fetches the
-            # state), so close the timing window first to keep it honest
-            will_ckpt = ckpt is not None and ckpt.should_save(step + 1)
-            closed = window >= sync_every or step + 1 == steps or will_ckpt
-            if closed:
-                last_metrics = {k: float(v) for k, v in metrics.items()}
-                mlog.end_window(step + 1, window, last_metrics)
-                window = 0
-            if ckpt is not None:
-                ckpt.save(step + 1, state)
-            if closed:
-                # restart the timer only after the save: orbax fetches the
-                # device state synchronously, and that must not be charged
-                # to the next window
-                mlog.start_step()
-    if data_source is not None:
-        data_source.close()
+    try:
+        with profile_trace(profile_dir, enabled=profile_dir is not None):
+            window = 0
+            mlog.start_step()
+            for step in range(start_step, steps):
+                if data_iter is not None:
+                    batch = builder.place_batch(next(data_iter))
+                else:
+                    data_rng, brng = jax.random.split(data_rng)
+                    batch = builder.place_batch(
+                        spec.batch_fn(brng, global_batch))
+                state, metrics = step_fn(state, batch)
+                window += 1
+                # checkpoint saves are their own sync point (orbax fetches
+                # the state), so close the timing window first
+                will_ckpt = ckpt is not None and ckpt.should_save(step + 1)
+                closed = window >= sync_every or step + 1 == steps \
+                    or will_ckpt
+                if closed:
+                    last_metrics = {k: float(v) for k, v in metrics.items()}
+                    mlog.end_window(step + 1, window, last_metrics)
+                    window = 0
+                if ckpt is not None:
+                    ckpt.save(step + 1, state)
+                if closed:
+                    # restart the timer only after the save: orbax fetches
+                    # the device state synchronously, and that must not be
+                    # charged to the next window
+                    mlog.start_step()
+    finally:
+        # failures must not leak the prefetch threads / shard fds (train
+        # is called repeatedly in-process by katib studies and benchmarks)
+        if data_source is not None:
+            data_source.close()
     if ckpt is not None:
         ckpt.wait()
         ckpt.close()

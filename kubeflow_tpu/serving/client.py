@@ -1,0 +1,97 @@
+"""Serving client: the inception-client / label.py analog.
+
+The reference ships a standalone client that sends an image to the
+deployed model server and prints the top-k labels
+(components/k8s-model-server/inception-client/label.py). Same tool here
+against the TPU model server's TF-Serving-compatible REST surface
+(serving/http_server.py `POST /v1/models/<name>:predict`), reading either
+a record-shard image (data/imagenet.py format) or a raw .npy array.
+
+    python -m kubeflow_tpu.serving.client --server host:8500 \
+        --model resnet50 --npy image.npy --top-k 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from typing import Optional
+
+import numpy as np
+
+
+def predict(server: str, model: str, instances, dtype: str = "float32",
+            timeout_s: float = 60.0) -> dict:
+    url = f"http://{server}/v1/models/{model}:predict"
+    payload = json.dumps({"instances": instances, "dtype": dtype}).encode()
+    req = urllib.request.Request(
+        url, data=payload, method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+def _first_output(predictions) -> list:
+    """predictions is either a list (single-output models) or a dict of
+    named outputs (the TF-Serving response shape); prefer 'logits'."""
+    if isinstance(predictions, dict):
+        for key in ("logits", "y", "outputs"):
+            if key in predictions:
+                return predictions[key]
+        predictions = next(iter(predictions.values()))
+    return predictions
+
+
+def top_k(logits, k: int = 5,
+          labels: Optional[list[str]] = None) -> list[dict]:
+    arr = np.asarray(logits, np.float32)
+    idx = np.argsort(arr)[::-1][:k]
+    exp = np.exp(arr - arr.max())
+    probs = exp / exp.sum()
+    return [{"class": int(i),
+             "label": labels[i] if labels and i < len(labels) else str(i),
+             "score": float(probs[i])} for i in idx]
+
+
+def load_image(npy: Optional[str], data_dir: Optional[str],
+               index: int) -> np.ndarray:
+    if npy:
+        return np.load(npy)
+    if data_dir:
+        from ..data.imagenet import ImageNetSource
+        with ImageNetSource(data_dir, batch_size=1, augment=False) as src:
+            batch = next(src.epoch(0, seed=0, skip=index))
+            return batch["images"][0]
+    raise SystemExit("one of --npy / --data-dir is required")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="TPU model-server client")
+    p.add_argument("--server", default="127.0.0.1:8500")
+    p.add_argument("--model", default="resnet50")
+    p.add_argument("--npy", help="image array (.npy)")
+    p.add_argument("--data-dir", help="record-shard dir; sends record N")
+    p.add_argument("--index", type=int, default=0)
+    p.add_argument("--top-k", type=int, default=5)
+    p.add_argument("--labels", help="text file, one label per line")
+    args = p.parse_args(argv)
+
+    image = load_image(args.npy, args.data_dir, args.index)
+    labels = None
+    if args.labels:
+        with open(args.labels) as f:
+            labels = [line.strip() for line in f]
+    result = predict(args.server, args.model, [image.tolist()])
+    preds = _first_output(result.get("predictions") or [])
+    if not len(preds):
+        print(json.dumps(result))
+        return 1
+    for entry in top_k(preds[0], args.top_k, labels):
+        print(f"{entry['score']:.4f}  {entry['label']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,7 @@ Routes:
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 from ..api import k8s
@@ -26,41 +27,43 @@ from ._http import ApiError, JsonApp, JsonServer, RawResponse
 
 METRIC_TYPES = ("podcpu", "podmem", "node")
 
-# The SPA shell (the Polymer frontend analog, API-first): one static page
-# that renders the dashboard's own API. Other apps embed via links the way
-# the reference used iframes.
+# The SPA shell: sidebar + namespace selector + one view container; all
+# rendering happens in the static app bundle (static/dashboard.js — the
+# Polymer main-page.js analog, no build infra).
 INDEX_HTML = """<!doctype html>
-<html><head><title>Kubeflow TPU</title><style>
-body{font-family:sans-serif;margin:2rem;max-width:60rem}
+<html><head><title>Kubeflow TPU</title><meta charset="utf-8"><style>
+body{font-family:sans-serif;margin:0;display:flex;min-height:100vh}
+#sidebar{background:#1a73e8;color:#fff;min-width:13rem;padding:1rem}
+#sidebar h1{font-size:1.1rem;margin:0 0 1rem}
+#sidebar a{display:block;color:#fff;text-decoration:none;padding:0.45rem
+ 0.6rem;border-radius:4px;margin:0.15rem 0}
+#sidebar a.active,#sidebar a:hover{background:rgba(255,255,255,0.22)}
+#ns-selector{width:100%;padding:0.35rem;margin-bottom:1rem}
+main{flex:1;padding:1.5rem;max-width:70rem}
 table{border-collapse:collapse;margin:0.5rem 0 1.5rem}
 td,th{border:1px solid #ccc;padding:0.3rem 0.8rem;text-align:left}
-h2{margin-top:1.5rem}</style></head><body>
-<h1>Kubeflow TPU dashboard</h1>
-<h2>TPU slices</h2><table id="slices"></table>
-<h2>Namespaces</h2><table id="namespaces"></table>
-<h2>Nodes</h2><table id="nodes"></table>
-<script>
-function esc(v) {  // values come from cluster objects: escape before HTML
-  return String(v).replace(/[&<>"']/g,
-    ch => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[ch]));
-}
-async function fill(id, rows, cols) {
-  const t = document.getElementById(id);
-  t.innerHTML = "<tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("")
-    + "</tr>" +
-    rows.map(r => "<tr>" + cols.map(c => `<td>${esc(r[c] ?? r)}</td>`)
-             .join("") + "</tr>").join("");
-}
-(async () => {
-  const slices = await (await fetch("api/tpu/slices")).json();
-  fill("slices", slices, ["topology", "accelerator", "hosts", "chips",
-                          "ready"]);
-  const ns = await (await fetch("api/namespaces")).json();
-  fill("namespaces", ns.map(n => ({name: n})), ["name"]);
-  const nodes = await (await fetch("api/metrics/node")).json();
-  fill("nodes", nodes, ["node", "value"]);
-})();
-</script></body></html>"""
+nav.tabs a{margin-right:0.8rem}
+.empty{color:#777}.error{color:#b00020}
+</style></head><body>
+<div id="sidebar">
+  <h1>Kubeflow TPU</h1>
+  <select id="ns-selector" aria-label="namespace"></select>
+  <a href="#/overview" data-view="overview">Overview</a>
+  <a href="#/activities" data-view="activities">Activities</a>
+  <a href="#/metrics" data-view="metrics">Metrics</a>
+  <a href="#/notebooks" data-view="notebooks">Notebooks</a>
+  <a href="/logout">Log out</a>
+</div>
+<main><div id="view"></div></main>
+<script src="app.js"></script>
+</body></html>"""
+
+_STATIC_DIR = os.path.join(os.path.dirname(__file__), "static")
+
+
+def _read_app_js() -> str:
+    with open(os.path.join(_STATIC_DIR, "dashboard.js")) as f:
+        return f.read()
 
 
 class MetricsService:
@@ -125,6 +128,12 @@ def build_dashboard_app(client: KubeClient,
     def index(params, query, body):
         return 200, RawResponse(INDEX_HTML,
                                 content_type="text/html; charset=utf-8")
+
+    @app.route("GET", "/app.js")
+    def app_js(params, query, body):
+        return 200, RawResponse(
+            _read_app_js(),
+            content_type="application/javascript; charset=utf-8")
 
     @app.route("GET", "/api/namespaces")
     def namespaces(params, query, body):

@@ -1,0 +1,147 @@
+/* Kubeflow TPU central dashboard SPA.
+ *
+ * The Polymer main-page.js / namespace-selector.js analog rendered
+ * client-side from the dashboard JSON API (webapps/dashboard.py), with:
+ *  - namespace selector (persisted in localStorage) driving activities
+ *  - activities, cluster metrics, TPU slice inventory panels
+ *  - hash routing (#/overview, #/activities, #/notebooks)
+ *  - the notebooks view iframes the jupyter web app, the reference's
+ *    iframe-embedding pattern (main-page.js)
+ *  - every API 401 redirects to the gatekeeper login page
+ */
+(function () {
+  "use strict";
+
+  const LOGIN_PATH = "/login";
+  const JUPYTER_PATH = "/jupyter/";
+  const NS_KEY = "kftpu.namespace";
+
+  function esc(v) {
+    return String(v).replace(/[&<>"']/g, (ch) => ({
+      "&": "&amp;", "<": "&lt;", ">": "&gt;",
+      '"': "&quot;", "'": "&#39;",
+    }[ch]));
+  }
+
+  async function api(path) {
+    const resp = await fetch(path, { credentials: "same-origin" });
+    if (resp.status === 401) {
+      // unauthenticated: bounce through the gatekeeper login page
+      window.location.assign(LOGIN_PATH);
+      throw new Error("unauthenticated");
+    }
+    if (!resp.ok) throw new Error(`${path}: HTTP ${resp.status}`);
+    return resp.json();
+  }
+
+  function table(rows, cols) {
+    const head = "<tr>" + cols.map((c) => `<th>${esc(c)}</th>`).join("") +
+      "</tr>";
+    const body = rows.map((r) =>
+      "<tr>" + cols.map((c) => `<td>${esc(r[c] ?? "")}</td>`).join("") +
+      "</tr>").join("");
+    return `<table>${head}${body}</table>`;
+  }
+
+  // -- namespace selector ----------------------------------------------------
+
+  async function renderNamespaceSelector() {
+    const namespaces = await api("api/namespaces");
+    const current = localStorage.getItem(NS_KEY) || namespaces[0] || "default";
+    const sel = document.getElementById("ns-selector");
+    sel.innerHTML = namespaces.map((n) =>
+      `<option value="${esc(n)}"${n === current ? " selected" : ""}>` +
+      `${esc(n)}</option>`).join("");
+    sel.onchange = () => {
+      localStorage.setItem(NS_KEY, sel.value);
+      render();  // re-render the active view in the new namespace
+    };
+    return current;
+  }
+
+  function selectedNamespace() {
+    const sel = document.getElementById("ns-selector");
+    return (sel && sel.value) || localStorage.getItem(NS_KEY) || "default";
+  }
+
+  // -- views -----------------------------------------------------------------
+
+  async function viewOverview(el) {
+    const [slices, nodes] = await Promise.all([
+      api("api/tpu/slices"), api("api/metrics/node"),
+    ]);
+    el.innerHTML =
+      "<h2>TPU slices</h2>" +
+      (slices.length
+        ? table(slices, ["topology", "accelerator", "hosts", "chips", "ready"])
+        : "<p class=empty>No TPU slices in this cluster.</p>") +
+      "<h2>Nodes</h2>" + table(nodes, ["node", "value"]);
+  }
+
+  async function viewActivities(el) {
+    const ns = selectedNamespace();
+    const acts = await api(`api/activities/${encodeURIComponent(ns)}`);
+    el.innerHTML = `<h2>Activities in ${esc(ns)}</h2>` +
+      (acts.length
+        ? table(acts, ["type", "reason", "involvedObject", "message",
+                       "lastTimestamp"])
+        : "<p class=empty>No recent events.</p>");
+  }
+
+  async function viewMetrics(el) {
+    const kind = (location.hash.split("/")[2]) || "podcpu";
+    const rows = await api(`api/metrics/${encodeURIComponent(kind)}`);
+    const tabs = ["podcpu", "podmem", "node"].map((k) =>
+      `<a href="#/metrics/${k}"${k === kind ? ' class="active"' : ""}>` +
+      `${k}</a>`).join(" ");
+    const cols = kind === "node" ? ["node", "value"]
+      : ["namespace", "pod", "value"];
+    el.innerHTML = `<h2>Cluster metrics</h2><nav class=tabs>${tabs}</nav>` +
+      table(rows, cols);
+  }
+
+  function viewNotebooks(el) {
+    // iframe-embedding, the reference dashboard's integration pattern
+    el.innerHTML = "<h2>Notebooks</h2>" +
+      `<iframe id="jupyter-frame" src="${JUPYTER_PATH}" ` +
+      'style="width:100%;height:70vh;border:1px solid #ccc"></iframe>';
+  }
+
+  const VIEWS = {
+    overview: viewOverview,
+    activities: viewActivities,
+    metrics: viewMetrics,
+    notebooks: viewNotebooks,
+  };
+
+  function activeView() {
+    const name = (location.hash.replace(/^#\//, "") || "overview").split("/")[0];
+    return VIEWS[name] ? name : "overview";
+  }
+
+  async function render() {
+    const name = activeView();
+    document.querySelectorAll("#sidebar a").forEach((a) => {
+      a.classList.toggle("active", a.dataset.view === name);
+    });
+    const el = document.getElementById("view");
+    el.innerHTML = "<p class=empty>Loading…</p>";
+    try {
+      await VIEWS[name](el);
+    } catch (err) {
+      if (err.message !== "unauthenticated") {
+        el.innerHTML = `<p class=error>${esc(err.message)}</p>`;
+      }
+    }
+  }
+
+  async function main() {
+    await renderNamespaceSelector();
+    window.addEventListener("hashchange", render);
+    await render();
+  }
+
+  document.readyState === "loading"
+    ? document.addEventListener("DOMContentLoaded", main)
+    : main();
+})();

@@ -1,0 +1,87 @@
+/* Click-to-deploy UI (components/gcp-click-to-deploy/src/DeployForm.tsx
+ * analog, no build infra): a form over the bootstrap REST service —
+ * POST /kfctl/e2eDeploy, then poll /kfctl/apps/show until conditions
+ * report Available, rendering deploy progress like the React UI's
+ * DeployProgress. */
+(function () {
+  "use strict";
+
+  function esc(v) {
+    return String(v).replace(/[&<>"']/g, (ch) => ({
+      "&": "&amp;", "<": "&lt;", ">": "&gt;",
+      '"': "&quot;", "'": "&#39;",
+    }[ch]));
+  }
+
+  async function post(path, payload) {
+    const resp = await fetch(path, {
+      method: "POST",
+      headers: { "Content-Type": "application/json" },
+      body: JSON.stringify(payload),
+    });
+    const body = await resp.json();
+    if (!resp.ok) throw new Error(body.error || `HTTP ${resp.status}`);
+    return body;
+  }
+
+  async function get(path) {
+    const resp = await fetch(path);
+    const body = await resp.json();
+    if (!resp.ok) throw new Error(body.error || `HTTP ${resp.status}`);
+    return body;
+  }
+
+  function logLine(text, cls) {
+    const el = document.getElementById("deploy-log");
+    el.innerHTML += `<div class="${cls || ""}">${esc(text)}</div>`;
+    el.scrollTop = el.scrollHeight;
+  }
+
+  async function refreshApps() {
+    const apps = (await get("/kfctl/apps")).apps;
+    const el = document.getElementById("apps");
+    el.innerHTML = apps.length
+      ? apps.map((a) =>
+          `<li><b>${esc(a.name)}</b> — ${esc(a.platform || "existing")}` +
+          ` (${esc((a.conditions || []).slice(-1)[0] || "created")})</li>`)
+        .join("")
+      : "<li class=empty>no deployments yet</li>";
+  }
+
+  async function deploy(ev) {
+    ev.preventDefault();
+    const form = ev.target;
+    const name = form.appname.value.trim();
+    const payload = {
+      name: name,
+      platform: form.platform.value,
+      namespace: form.namespace.value.trim() || "kubeflow",
+    };
+    if (form.project.value.trim()) payload.project = form.project.value.trim();
+    if (form.flavor.value) payload.flavor = form.flavor.value;
+    const button = form.querySelector("button");
+    button.disabled = true;
+    logLine(`deploying ${name}…`);
+    try {
+      const result = await post("/kfctl/e2eDeploy", payload);
+      logLine(`applied ${result.applied} objects`, "ok");
+      const show = await get(`/kfctl/apps/${encodeURIComponent(name)}`);
+      (show.conditions || []).forEach((c) => logLine(`condition: ${c}`));
+    } catch (err) {
+      logLine(`deploy failed: ${err.message}`, "error");
+    } finally {
+      button.disabled = false;
+      refreshApps();
+    }
+  }
+
+  function main() {
+    document.getElementById("deploy-form")
+      .addEventListener("submit", deploy);
+    refreshApps();
+  }
+
+  document.readyState === "loading"
+    ? document.addEventListener("DOMContentLoaded", main)
+    : main();
+})();

@@ -1,0 +1,144 @@
+"""CI plumbing: path→workflow mapping + release workflows (L6).
+
+The reference's Prow config maps changed repo paths to Argo test workflows
+(prow_config.yaml:1-8 — each entry: a workflow component, a trigger class,
+and `include`/`job_types`), and releases images through dedicated Argo
+workflows (releasing/releaser/components/workflows.jsonnet; per-component
+releaser apps; postsubmits push to gcr.io/kubeflow-images-public).
+
+Here the same two pieces, native:
+- ``load_ci_config`` / ``select_workflows``: consume ``ci_config.yaml`` at
+  the repo root (one entry per workflow: name, trigger, include globs) and
+  answer "which workflows must run for this changed-file list" — the
+  prow_config contract.
+- ``release_workflow``: build the image-release Workflow manifest our
+  engine runs (build → test → push DAG), the releaser analog.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..utils import yamlio
+from .engine import WORKFLOW_API_VERSION, WORKFLOW_KIND
+
+TRIGGERS = ("presubmit", "postsubmit", "periodic")
+
+
+@dataclass
+class CIEntry:
+    """One prow_config.yaml workflow entry."""
+
+    name: str
+    workflow: str                       # workflow template / component name
+    trigger: str = "presubmit"
+    include: list = field(default_factory=lambda: ["**"])
+    params: dict = field(default_factory=dict)
+
+    def matches(self, path: str) -> bool:
+        path = path.lstrip("./")
+        for pattern in self.include:
+            # '**' crosses directory boundaries (prow-style), fnmatch's
+            # '*' does too — normalize so both spellings work
+            if fnmatch.fnmatch(path, pattern.replace("**", "*")):
+                return True
+        return False
+
+
+def load_ci_config(path: str) -> list[CIEntry]:
+    with open(path) as f:
+        raw = yamlio.loads(f.read())
+    entries = []
+    for w in (raw or {}).get("workflows", []) or []:
+        trigger = w.get("trigger", "presubmit")
+        if trigger not in TRIGGERS:
+            raise ValueError(f"{w.get('name')}: bad trigger {trigger!r}; "
+                             f"valid: {TRIGGERS}")
+        entries.append(CIEntry(
+            name=w["name"], workflow=w.get("workflow", w["name"]),
+            trigger=trigger, include=list(w.get("include") or ["**"]),
+            params=dict(w.get("params") or {})))
+    return entries
+
+
+def select_workflows(changed_files: list[str], entries: list[CIEntry],
+                     trigger: str = "presubmit") -> list[CIEntry]:
+    """The prow path-filter: every entry of the trigger class whose
+    include globs match at least one changed file. Periodic entries
+    never depend on the diff."""
+    out = []
+    for entry in entries:
+        if entry.trigger != trigger:
+            continue
+        if trigger == "periodic" or \
+                any(entry.matches(f) for f in changed_files):
+            out.append(entry)
+    return out
+
+
+# -- release workflow ---------------------------------------------------------
+
+def release_workflow(component: str, version: str,
+                     registry: str = "ghcr.io/kubeflow-tpu",
+                     namespace: str = "kubeflow-ci",
+                     test_command: Optional[list] = None) -> dict:
+    """The image-releaser Workflow (releasing/releaser/components/
+    workflows.jsonnet shape): checkout → unit-test → build image → push,
+    as a DAG our engine executes. Presubmit pushes go to the CI registry,
+    postsubmit to the public one — callers pick via ``registry``."""
+    test_command = test_command or ["python", "-m", "pytest", "tests/",
+                                    "-x", "-q"]
+    image = f"{registry}/{component}:{version}"
+    builder = "gcr.io/kaniko-project/executor:v0.10.0"
+    return {
+        "apiVersion": WORKFLOW_API_VERSION, "kind": WORKFLOW_KIND,
+        "metadata": {"name": f"release-{component}-{version}".replace(".", "-"),
+                     "namespace": namespace,
+                     "labels": {"workflows.kubeflow.org/release": component}},
+        "spec": {
+            "entrypoint": "release",
+            "arguments": {"parameters": [
+                {"name": "component", "value": component},
+                {"name": "version", "value": version},
+                {"name": "image", "value": image},
+            ]},
+            "templates": [
+                {"name": "release", "dag": {"tasks": [
+                    {"name": "checkout", "template": "checkout"},
+                    {"name": "test", "template": "test",
+                     "dependencies": ["checkout"]},
+                    {"name": "build", "template": "build",
+                     "dependencies": ["test"]},
+                    {"name": "push", "template": "push",
+                     "dependencies": ["build"]},
+                ]}},
+                {"name": "checkout", "container": {
+                    "image": "alpine/git:1.0.7",
+                    "command": ["git", "clone", "--depth=1",
+                                "$(workflow.parameters.component)", "/src"]},
+                 "activeDeadlineSeconds": 600},
+                {"name": "test", "container": {
+                    "image": "python:3.12",
+                    "command": test_command},
+                 "activeDeadlineSeconds": 1800},
+                {"name": "build", "container": {
+                    "image": builder,
+                    "command": ["/kaniko/executor", "--context=/src",
+                                f"--destination={image}", "--no-push"]},
+                 "activeDeadlineSeconds": 1800},
+                {"name": "push", "container": {
+                    "image": builder,
+                    "command": ["/kaniko/executor", "--context=/src",
+                                f"--destination={image}"]},
+                 "activeDeadlineSeconds": 1800},
+            ],
+        },
+    }
+
+
+def repo_ci_config_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "ci_config.yaml")

@@ -1,0 +1,60 @@
+"""Child process for the two-process jax.distributed test.
+
+Run with the KFTPU_* contract env rendered the way the TPUJob operator
+renders it (api/topology.render_contracts); exercises the DISTRIBUTED
+branch of runtime/bootstrap.initialize — jax.distributed.initialize over a
+local coordinator — then one cross-process psum-shaped reduction through a
+sharded global array on the contract's mesh.
+
+Prints one JSON line: {"process_id": N, "global_devices": N, "local":
+N, "sum": N, "mesh": {...}} — the parent asserts on it.
+"""
+
+import json
+import os
+import sys
+
+# 4 local CPU devices per process -> 8 global over 2 processes (v5e-8's
+# 2-host gang shape)
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=4")
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubeflow_tpu.runtime.bootstrap import initialize
+
+    ctx = initialize()  # consumes the rendered KFTPU_* env
+    mesh = ctx.mesh
+
+    # one global data-parallel array: each process contributes its local
+    # shard (value = global device index), then an all-reduce-shaped sum
+    # runs across processes through XLA collectives
+    sharding = NamedSharding(mesh, P("data"))
+    n = ctx.num_processes * jax.local_device_count()
+
+    def shard_value(index):
+        # index is a tuple of slices into the global (n,) shape
+        start = index[0].start or 0
+        return jnp.arange(start, (index[0].stop or n), dtype=jnp.float32)
+
+    arr = jax.make_array_from_callback((n,), sharding, shard_value)
+    total = jax.jit(lambda x: jnp.sum(x), out_shardings=None)(arr)
+    print(json.dumps({
+        "process_id": ctx.process_id,
+        "num_processes": ctx.num_processes,
+        "global_devices": len(jax.devices()),
+        "local_devices": jax.local_device_count(),
+        "sum": float(total),
+        "mesh": dict(mesh.shape),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,147 @@
+"""Application aggregation controller (r2 verdict #6): selected component
+statuses roll up into the Application's Ready condition — the native
+replacement for the jsonnetd sync hook
+(kubeflow/application/application.libsonnet:213-228)."""
+
+from __future__ import annotations
+
+import pytest
+
+from kubeflow_tpu.api import k8s
+from kubeflow_tpu.cluster import FakeCluster
+from kubeflow_tpu.controllers.application import (APPLICATION_API_VERSION,
+                                                  APPLICATION_KIND,
+                                                  ApplicationReconciler)
+from kubeflow_tpu.controllers.runtime import Manager
+
+
+def app_manifest(name="kf-app", ns="kubeflow", kinds=None, labels=None):
+    return {
+        "apiVersion": APPLICATION_API_VERSION, "kind": APPLICATION_KIND,
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "selector": {"matchLabels": labels or {"app.kubernetes.io/part-of": name}},
+            "componentKinds": kinds or [{"group": "apps", "kind": "Deployment"},
+                                        {"group": "", "kind": "Service"}],
+        },
+    }
+
+
+def deployment(name, ns="kubeflow", labels=None, ready=0, want=1):
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "spec": {"replicas": want,
+                 "selector": {"matchLabels": {"app": name}},
+                 "template": {"metadata": {"labels": {"app": name}},
+                              "spec": {"containers": [
+                                  {"name": "c", "image": "x"}]}}},
+        "status": {"readyReplicas": ready},
+    }
+
+
+def service(name, ns="kubeflow", labels=None):
+    return {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "spec": {"ports": [{"port": 80}]},
+    }
+
+
+@pytest.fixture
+def env():
+    cluster = FakeCluster(auto_schedule=False, auto_run=False)
+    mgr = Manager(cluster)
+    mgr.add(ApplicationReconciler())
+    yield cluster, mgr
+    for c in mgr.controllers:
+        c.stop()
+
+
+def drive(mgr, rounds=3):
+    for _ in range(rounds):
+        mgr.run_pending()
+
+
+def get_app(cluster, name="kf-app"):
+    return cluster.get(APPLICATION_API_VERSION, APPLICATION_KIND,
+                       "kubeflow", name)
+
+
+def ready_condition(app):
+    for c in app.get("status", {}).get("conditions", []):
+        if c["type"] == "Ready":
+            return c
+    return None
+
+
+class TestApplicationAggregation:
+    LABELS = {"app.kubernetes.io/part-of": "kf-app"}
+
+    def test_no_components_not_ready(self, env):
+        cluster, mgr = env
+        cluster.create(app_manifest())
+        drive(mgr)
+        cond = ready_condition(get_app(cluster))
+        assert cond["status"] == "False"
+
+    def test_ready_flips_with_child_health(self, env):
+        cluster, mgr = env
+        cluster.create(app_manifest())
+        cluster.create(deployment("dash", labels=self.LABELS, ready=0))
+        cluster.create(service("dash", labels=self.LABELS))
+        drive(mgr)
+        app = get_app(cluster)
+        assert ready_condition(app)["status"] == "False"
+        comps = {(c["kind"], c["name"]): c
+                 for c in app["status"]["components"]}
+        assert comps[("Deployment", "dash")]["status"] == "NotReady"
+        assert comps[("Service", "dash")]["status"] == "Ready"
+        assert app["status"]["componentsReady"] == "1/2"  # service ready
+
+        # deployment becomes healthy → Ready flips True via the mapped watch
+        dep = cluster.get("apps/v1", "Deployment", "kubeflow", "dash")
+        dep["status"]["readyReplicas"] = 1
+        cluster.update_status(dep)
+        drive(mgr)
+        app = get_app(cluster)
+        assert ready_condition(app)["status"] == "True"
+        assert app["status"]["componentsReady"] == "2/2"
+
+        # and back down when health degrades
+        dep = cluster.get("apps/v1", "Deployment", "kubeflow", "dash")
+        dep["status"]["readyReplicas"] = 0
+        cluster.update_status(dep)
+        drive(mgr)
+        assert ready_condition(get_app(cluster))["status"] == "False"
+
+    def test_selector_scopes_components(self, env):
+        cluster, mgr = env
+        cluster.create(app_manifest())
+        cluster.create(deployment("mine", labels=self.LABELS, ready=1))
+        cluster.create(deployment("other",
+                                  labels={"app.kubernetes.io/part-of": "x"},
+                                  ready=0))
+        drive(mgr)
+        app = get_app(cluster)
+        names = [c["name"] for c in app["status"]["components"]]
+        assert names == ["mine"]
+        assert ready_condition(app)["status"] == "True"
+
+    def test_two_apps_isolated(self, env):
+        cluster, mgr = env
+        cluster.create(app_manifest("a1", labels={"part": "a1"}))
+        cluster.create(app_manifest("a2", labels={"part": "a2"}))
+        cluster.create(deployment("d1", labels={"part": "a1"}, ready=1))
+        cluster.create(deployment("d2", labels={"part": "a2"}, ready=0))
+        drive(mgr)
+        assert ready_condition(get_app(cluster, "a1"))["status"] == "True"
+        assert ready_condition(get_app(cluster, "a2"))["status"] == "False"
+
+    def test_deleted_app_noop(self, env):
+        cluster, mgr = env
+        cluster.create(app_manifest())
+        drive(mgr)
+        cluster.delete(APPLICATION_API_VERSION, APPLICATION_KIND,
+                       "kubeflow", "kf-app")
+        drive(mgr)  # must not raise

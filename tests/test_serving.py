@@ -190,3 +190,35 @@ def test_batch_predict_no_inputs(tmp_path):
     s = _servable()
     with pytest.raises(FileNotFoundError):
         run_batch_predict(s, [str(tmp_path / "*.npy")], str(tmp_path / "o"))
+
+
+class TestServingClient:
+    """inception-client/label.py analog: the CLI client drives the live
+    model server REST surface end-to-end."""
+
+    def test_predict_and_topk(self, tmp_path, capsys):
+        from kubeflow_tpu.serving.client import main, top_k
+        import numpy as np
+        repo = ModelRepository()
+        repo.load("mnist", "double")
+        srv = ModelServer(repo, host="127.0.0.1", port=0, max_latency_ms=1)
+        srv.start()
+        try:
+            npy = tmp_path / "x.npy"
+            np.save(npy, np.array([1.0, 3.0, 2.0, 0.5], np.float32))
+            rc = main(["--server", f"127.0.0.1:{srv.port}",
+                       "--model", "mnist", "--npy", str(npy),
+                       "--top-k", "2"])
+            assert rc == 0
+            out = capsys.readouterr().out.strip().splitlines()
+            assert len(out) == 2
+            # "double" model doubles the input → class 1 (value 6) first
+            assert out[0].split()[-1] == "1"
+        finally:
+            srv.stop()
+
+    def test_topk_with_labels(self):
+        from kubeflow_tpu.serving.client import top_k
+        out = top_k([0.1, 5.0, 1.0], k=2, labels=["cat", "dog", "fish"])
+        assert out[0]["label"] == "dog"
+        assert abs(sum(o["score"] for o in top_k([0.1, 5.0, 1.0], k=3)) - 1.0) < 1e-5

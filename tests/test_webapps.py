@@ -69,8 +69,29 @@ class TestDashboard:
             with urllib.request.urlopen(f"http://127.0.0.1:{port}/") as r:
                 assert r.headers["Content-Type"].startswith("text/html")
                 html = r.read().decode()
-            assert "Kubeflow TPU dashboard" in html
-            assert "api/tpu/slices" in html
+            # the SPA shell: selector + routed views + app bundle
+            assert 'id="ns-selector"' in html
+            assert 'data-view="activities"' in html
+            assert '<script src="app.js">' in html
+        finally:
+            server.stop()
+
+    def test_spa_bundle_served(self, cluster):
+        server = DashboardServer(cluster)
+        port = server.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/app.js") as r:
+                assert r.headers["Content-Type"].startswith(
+                    "application/javascript")
+                js = r.read().decode()
+            # the SPA consumes the dashboard API, iframes jupyter, and
+            # bounces 401s through the gatekeeper login page
+            for needle in ("api/namespaces", "api/tpu/slices",
+                           "api/activities/", "api/metrics/",
+                           "jupyter-frame", 'LOGIN_PATH = "/login"',
+                           "status === 401"):
+                assert needle in js, needle
         finally:
             server.stop()
 

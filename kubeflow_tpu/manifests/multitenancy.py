@@ -94,3 +94,23 @@ def credentials_pod_preset(namespace: str = "kubeflow",
                           "readOnly": True}],
     }
     return [pd]
+
+
+@register("access-management", "KFAM Profile/Binding grant API "
+                               "(components/access-management swagger, "
+                               "served by webapps/access_management.py)")
+def access_management(namespace: str = "kubeflow") -> list[dict]:
+    sa = H.service_account("kfam", namespace)
+    role = H.cluster_role("kfam", [
+        {"apiGroups": ["kubeflow.org"], "resources": ["profiles"],
+         "verbs": ["get", "list", "create", "delete"]},
+        {"apiGroups": ["rbac.authorization.k8s.io"],
+         "resources": ["rolebindings"],
+         "verbs": ["get", "list", "create", "update", "delete"]},
+    ])
+    binding = H.cluster_role_binding("kfam", "kfam", "kfam", namespace)
+    dep = H.deployment("profiles-kfam", namespace,
+                       f"{IMG}/kfam:{VERSION}", port=8081,
+                       service_account="kfam")
+    svc = H.service("profiles-kfam", namespace, 8081)
+    return [sa, role, binding, dep, svc]

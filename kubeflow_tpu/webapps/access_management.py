@@ -1,0 +1,166 @@
+"""Access-management API: Profile + Binding grants (the KFAM service).
+
+The reference ships this as a design-stage swagger
+(components/access-management/README.md:1-18, api/swagger.yaml): Profile =
+owner + namespace (implemented by the profile controller), Binding = a
+user↔namespace grant. This is the serving implementation of that
+contract: a REST API that mints Profiles and translates Bindings into
+RoleBindings against the kubeflow-{admin,edit,view} ClusterRoles —
+the grant surface the profile controller's owner binding doesn't cover.
+
+Routes (the kfam surface):
+  GET    /kfam/v1/profiles                 | POST | DELETE /{name}
+  GET    /kfam/v1/bindings?namespace=&user=&role=
+  POST   /kfam/v1/bindings   {"user": {...}, "referredNamespace": ns,
+                              "roleRef": {"kind": "ClusterRole",
+                                          "name": "kubeflow-edit"}}
+  DELETE /kfam/v1/bindings   (same body)
+  GET    /healthz
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Optional
+
+from ..api import k8s
+from ..cluster.client import KubeClient, NotFoundError
+from ..controllers.profile import PROFILE_API_VERSION, PROFILE_KIND
+from ._http import ApiError, JsonApp, JsonServer
+
+log = logging.getLogger(__name__)
+
+ROLES = ("kubeflow-admin", "kubeflow-edit", "kubeflow-view")
+BINDING_LABEL = "app.kubernetes.io/managed-by"
+BINDING_MANAGER = "kfam"
+
+
+def _binding_name(user: str, role: str) -> str:
+    safe = re.sub(r"[^a-z0-9-]", "-", user.lower()).strip("-")
+    return f"user-{safe}-clusterrole-{role}"
+
+
+def _validate_binding(body: Optional[dict]) -> tuple[dict, str, str]:
+    if not body:
+        raise ApiError(400, "binding body required")
+    user = body.get("user") or {}
+    if not user.get("name"):
+        raise ApiError(400, "user.name is required")
+    ns = body.get("referredNamespace", "")
+    if not ns:
+        raise ApiError(400, "referredNamespace is required")
+    role = (body.get("roleRef") or {}).get("name", "kubeflow-view")
+    if role not in ROLES:
+        raise ApiError(400, f"roleRef.name {role!r} not in {ROLES}")
+    return user, ns, role
+
+
+def build_kfam_app(client: KubeClient) -> JsonApp:
+    app = JsonApp()
+
+    @app.route("GET", "/healthz")
+    def healthz(params, query, body):
+        return 200, {"ok": True}
+
+    # -- profiles -----------------------------------------------------------
+
+    @app.route("GET", "/kfam/v1/profiles")
+    def list_profiles(params, query, body):
+        profiles = client.list(PROFILE_API_VERSION, PROFILE_KIND)
+        return 200, {"profiles": [{
+            "name": k8s.name_of(p),
+            "owner": (p.get("spec", {}).get("owner") or {}),
+            "ready": k8s.condition_true(p, "Ready"),
+        } for p in profiles]}
+
+    @app.route("POST", "/kfam/v1/profiles")
+    def create_profile(params, query, body):
+        if not body or not body.get("name"):
+            raise ApiError(400, "name is required")
+        owner = body.get("owner") or {}
+        profile = {
+            "apiVersion": PROFILE_API_VERSION, "kind": PROFILE_KIND,
+            "metadata": {"name": body["name"], "namespace": "default"},
+            "spec": {"owner": {"kind": owner.get("kind", "User"),
+                               "name": owner.get("name", "")}},
+        }
+        try:
+            client.create(profile)
+        except Exception as e:  # noqa: BLE001 - conflicts are a 409
+            raise ApiError(409, f"profile {body['name']}: {e}")
+        return 200, {"name": body["name"]}
+
+    @app.route("DELETE", "/kfam/v1/profiles/{name}")
+    def delete_profile(params, query, body):
+        try:
+            client.delete(PROFILE_API_VERSION, PROFILE_KIND, "default",
+                          params["name"])
+        except NotFoundError:
+            raise ApiError(404, f"profile {params['name']} not found")
+        return 200, {"deleted": params["name"]}
+
+    # -- bindings -----------------------------------------------------------
+
+    @app.route("GET", "/kfam/v1/bindings")
+    def list_bindings(params, query, body):
+        out = []
+        selector = {BINDING_LABEL: BINDING_MANAGER}
+        bindings = client.list("rbac.authorization.k8s.io/v1", "RoleBinding",
+                               query.get("namespace") or None,
+                               selector=selector)
+        for rb in bindings:
+            subject = (rb.get("subjects") or [{}])[0]
+            entry = {
+                "user": {"kind": subject.get("kind", "User"),
+                         "name": subject.get("name", "")},
+                "referredNamespace": k8s.namespace_of(rb, "default"),
+                "roleRef": rb.get("roleRef", {}),
+            }
+            if query.get("user") and entry["user"]["name"] != query["user"]:
+                continue
+            if query.get("role") and \
+                    entry["roleRef"].get("name") != query["role"]:
+                continue
+            out.append(entry)
+        return 200, {"bindings": out}
+
+    @app.route("POST", "/kfam/v1/bindings")
+    def create_binding(params, query, body):
+        user, ns, role = _validate_binding(body)
+        rb = {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "RoleBinding",
+            "metadata": {
+                "name": _binding_name(user["name"], role),
+                "namespace": ns,
+                "labels": {BINDING_LABEL: BINDING_MANAGER,
+                           "user": re.sub(r"[^a-zA-Z0-9-_.]", "-",
+                                          user["name"]),
+                           "role": role},
+            },
+            "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                        "kind": "ClusterRole", "name": role},
+            "subjects": [{"kind": user.get("kind", "User"),
+                          "name": user["name"],
+                          "apiGroup": "rbac.authorization.k8s.io"}],
+        }
+        client.apply(rb)
+        return 200, {"binding": rb["metadata"]["name"]}
+
+    @app.route("DELETE", "/kfam/v1/bindings")
+    def delete_binding(params, query, body):
+        user, ns, role = _validate_binding(body)
+        try:
+            client.delete("rbac.authorization.k8s.io/v1", "RoleBinding",
+                          ns, _binding_name(user["name"], role))
+        except NotFoundError:
+            raise ApiError(404, "binding not found")
+        return 200, {"deleted": _binding_name(user["name"], role)}
+
+    return app
+
+
+class AccessManagementServer(JsonServer):
+    def __init__(self, client: KubeClient, **kw):
+        super().__init__(build_kfam_app(client), name="kfam", **kw)

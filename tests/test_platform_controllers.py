@@ -378,3 +378,90 @@ class TestGatekeeper:
             assert e.value.code == 401
         finally:
             server.stop()
+
+
+class TestAccessManagement:
+    """KFAM Binding grant API (SURVEY §2.6 access-management swagger):
+    Profile + Binding over HTTP against the live cluster."""
+
+    @pytest.fixture
+    def kfam(self):
+        from kubeflow_tpu.cluster import FakeCluster
+        from kubeflow_tpu.controllers.profile import ProfileReconciler
+        from kubeflow_tpu.controllers.runtime import Manager
+        from kubeflow_tpu.webapps.access_management import \
+            AccessManagementServer
+        cluster = FakeCluster(auto_schedule=False, auto_run=False)
+        mgr = Manager(cluster)
+        mgr.add(ProfileReconciler())
+        server = AccessManagementServer(cluster)
+        server.start()
+        yield cluster, mgr, server
+        server.stop()
+        for c in mgr.controllers:
+            c.stop()
+
+    def _req(self, server, method, path, payload=None):
+        import json as _json
+        data = _json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}{path}", data=data,
+            method=method, headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, _json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, _json.loads(e.read())
+
+    def test_profile_then_binding_grant_flow(self, kfam):
+        cluster, mgr, server = kfam
+        code, _ = self._req(server, "POST", "/kfam/v1/profiles",
+                            {"name": "team-a",
+                             "owner": {"name": "alice@corp.io"}})
+        assert code == 200
+        for _ in range(3):
+            mgr.run_pending()
+        code, body = self._req(server, "GET", "/kfam/v1/profiles")
+        assert body["profiles"][0]["ready"] is True
+
+        # grant bob edit in team-a
+        binding = {"user": {"kind": "User", "name": "bob@corp.io"},
+                   "referredNamespace": "team-a",
+                   "roleRef": {"kind": "ClusterRole",
+                               "name": "kubeflow-edit"}}
+        code, _ = self._req(server, "POST", "/kfam/v1/bindings", binding)
+        assert code == 200
+        rbs = cluster.list("rbac.authorization.k8s.io/v1", "RoleBinding",
+                           "team-a")
+        granted = [rb for rb in rbs
+                   if rb["metadata"].get("labels", {}).get("user")]
+        assert granted[0]["roleRef"]["name"] == "kubeflow-edit"
+        assert granted[0]["subjects"][0]["name"] == "bob@corp.io"
+
+        # listable + filterable
+        code, body = self._req(
+            server, "GET",
+            "/kfam/v1/bindings?namespace=team-a&user=bob@corp.io")
+        assert len(body["bindings"]) == 1
+        code, body = self._req(
+            server, "GET", "/kfam/v1/bindings?role=kubeflow-admin")
+        assert body["bindings"] == []
+
+        # revoke
+        code, _ = self._req(server, "DELETE", "/kfam/v1/bindings", binding)
+        assert code == 200
+        code, body = self._req(server, "GET",
+                               "/kfam/v1/bindings?namespace=team-a")
+        assert body["bindings"] == []
+
+    def test_binding_validation(self, kfam):
+        _, _, server = kfam
+        code, body = self._req(server, "POST", "/kfam/v1/bindings",
+                               {"user": {"name": "x"},
+                                "referredNamespace": "ns",
+                                "roleRef": {"name": "cluster-admin"}})
+        assert code == 400
+        assert "roleRef" in body["error"]
+        code, _ = self._req(server, "POST", "/kfam/v1/bindings",
+                            {"referredNamespace": "ns"})
+        assert code == 400

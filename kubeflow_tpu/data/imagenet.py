@@ -39,6 +39,71 @@ LABEL_BYTES = 4
 MEAN_RGB = np.array([0.485, 0.456, 0.406], np.float32)
 STDDEV_RGB = np.array([0.229, 0.224, 0.225], np.float32)
 
+_MASK = (1 << 64) - 1
+_GOLDEN = 0x9E3779B97F4A7C15
+
+
+def augment_base(seed: int, epoch: int, batch_index: int) -> int:
+    """The per-batch augment RNG base; stream = pure fn of (data, seed)."""
+    return (((seed << 20) ^ epoch) * 1_000_003 + batch_index) & _MASK
+
+
+def augment_params(base: int, n: int, pad: int
+                   ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-record (flip, dy, dx) — the splitmix64 derivation mirrored in
+    native/augment/augment.cc params_for (keep in sync!). Vectorized."""
+    idx = np.arange(1, n + 1, dtype=np.uint64)
+    state = (np.uint64(base) + idx * np.uint64(_GOLDEN)) & np.uint64(_MASK)
+
+    def splitmix(state):
+        state = (state + np.uint64(_GOLDEN)) & np.uint64(_MASK)
+        z = state
+        z = ((z ^ (z >> np.uint64(30))) *
+             np.uint64(0xBF58476D1CE4E5B9)) & np.uint64(_MASK)
+        z = ((z ^ (z >> np.uint64(27))) *
+             np.uint64(0x94D049BB133111EB)) & np.uint64(_MASK)
+        return z ^ (z >> np.uint64(31)), state
+
+    z1, state = splitmix(state)
+    z2, state = splitmix(state)
+    flip = (z1 & np.uint64(1)) != 0
+    span = np.uint64(2 * pad + 1)
+    dy = ((z2 >> np.uint64(1)) % span).astype(np.int64)
+    dx = ((z2 >> np.uint64(33)) % span).astype(np.int64)
+    return flip, dy, dx
+
+
+def _py_augment(images: np.ndarray, base: int, pad: int, *,
+                do_flip: bool, do_crop: bool) -> np.ndarray:
+    """Numpy fallback producing the native kernel's exact output."""
+    n, h, w, _ = images.shape
+    flip, dy, dx = augment_params(base, n, pad)
+    if not do_flip:
+        flip = np.zeros(n, bool)
+    if not do_crop:
+        dy = np.full(n, pad, np.int64)
+        dx = np.full(n, pad, np.int64)
+    coords = np.arange(h)
+
+    def reflect(v, size):
+        v = np.abs(v)
+        return np.where(v >= size, 2 * size - 2 - v, v)
+
+    out = np.empty((n, h, w, 3), np.float32)
+    for i in range(n):
+        sy = reflect(coords + dy[i] - pad, h)
+        sx = reflect(coords + dx[i] - pad, w)
+        if flip[i]:
+            sx = w - 1 - sx
+        out[i] = images[i][np.ix_(sy, sx)]
+    # same op order as the C++ kernel (x*scale - shift, f32) so the two
+    # paths are bit-identical
+    scale = np.float32(1.0) / (np.float32(255.0) * STDDEV_RGB)
+    shift = MEAN_RGB / STDDEV_RGB
+    out *= scale
+    out -= shift
+    return out
+
 
 def record_bytes(image_size: int) -> int:
     return LABEL_BYTES + image_size * image_size * 3
@@ -98,6 +163,73 @@ def shard_paths(data_dir: str) -> list[str]:
         if f.endswith(".rec"))
 
 
+class _Prefetcher:
+    """Run an iterator on a daemon thread, `depth` items ahead (the
+    input-overlap half of launcher.py's async data pipeline). stop() is
+    safe to call from the consumer side and JOINS the producer, so the
+    owner may tear down resources the iterator uses afterwards."""
+
+    _END = object()
+
+    def __init__(self, it: Iterator, depth: int):
+        import queue
+        import threading
+
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._produce, args=(it,),
+                                        daemon=True,
+                                        name="imagenet-prefetch")
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        import queue
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self, it) -> None:
+        try:
+            for item in it:
+                if not self._put(item):
+                    return
+            self._put(self._END)
+        except BaseException as e:  # noqa: BLE001 - surface to consumer
+            self._put(e)
+
+    def __iter__(self) -> Iterator:
+        import queue
+        try:
+            while True:
+                try:
+                    item = self._q.get(timeout=0.5)
+                except queue.Empty:
+                    if not self._thread.is_alive():
+                        return
+                    continue
+                if item is self._END:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        import queue
+        self._stop.set()
+        try:  # unblock a producer stuck on a full queue
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+
 class ImageNetSource:
     """Decoded, augmented, normalized batches from a shard dir.
 
@@ -130,8 +262,9 @@ class ImageNetSource:
                 f"{data_dir}: {self.meta['num_records']} records < "
                 f"batch_size {batch_size} (empty epochs)")
         self._pipeline = None
+        self._prefetcher = None
 
-    # -- decode / augment (host-side, numpy) --------------------------------
+    # -- decode / augment (host-side) ---------------------------------------
 
     def _decode(self, raw: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         n = raw.shape[0]
@@ -140,28 +273,20 @@ class ImageNetSource:
             n, self.image_size, self.image_size, 3)
         return images, labels
 
-    def _augment(self, images: np.ndarray, rng: np.random.Generator
-                 ) -> np.ndarray:
-        n, h, w, _ = images.shape
-        flip = rng.random(n) < 0.5
-        images = np.where(flip[:, None, None, None],
-                          images[:, :, ::-1, :], images)
-        if self.pad_px:
-            p = self.pad_px
-            padded = np.pad(images, ((0, 0), (p, p), (p, p), (0, 0)),
-                            mode="reflect")
-            ys = rng.integers(0, 2 * p + 1, n)
-            xs = rng.integers(0, 2 * p + 1, n)
-            out = np.empty_like(images)
-            for i in range(n):
-                out[i] = padded[i, ys[i]:ys[i] + h, xs[i]:xs[i] + w]
-            images = out
-        return images
-
-    def _normalize(self, images: np.ndarray) -> np.ndarray:
-        x = images.astype(np.float32) / 255.0
-        x = (x - MEAN_RGB) / STDDEV_RGB
-        return x.astype(self.image_dtype, copy=False)
+    def _augment_normalize(self, images: np.ndarray, base: int,
+                           augment: bool) -> np.ndarray:
+        """One fused pass: flip + reflect-pad crop + normalize. Native C++
+        fast path (native/augment/augment.cc), numpy fallback computing
+        the bit-identical result from the same splitmix64 parameters."""
+        from .native import native_augment, native_available
+        if native_available():
+            out = native_augment(
+                images, base, self.pad_px, MEAN_RGB, STDDEV_RGB,
+                do_flip=augment, do_crop=augment)
+        else:
+            out = _py_augment(images, base, self.pad_px,
+                              do_flip=augment, do_crop=augment)
+        return out.astype(self.image_dtype, copy=False)
 
     # -- iteration -----------------------------------------------------------
 
@@ -182,25 +307,44 @@ class ImageNetSource:
             if i < skip:
                 continue
             images, labels = self._decode(raw)
-            if self.augment:
-                rng = np.random.default_rng(
-                    ((seed << 20) ^ epoch) * 1_000_003 + i)
-                images = self._augment(images, rng)
-            yield {"images": self._normalize(images),
+            base = augment_base(seed, epoch, i)
+            yield {"images": self._augment_normalize(images, base,
+                                                     self.augment),
                    "labels": labels.astype(np.int32)}
 
-    def batches(self, seed: int = 0, start_batch: int = 0) -> Iterator[dict]:
+    def batches(self, seed: int = 0, start_batch: int = 0,
+                prefetch: int = 2) -> Iterator[dict]:
         """Infinite stream across epochs (the train-loop feed).
         ``start_batch`` = global batch index to resume from (checkpoint
-        restarts must not replay already-seen batches)."""
-        epoch = start_batch // self.num_batches
-        skip = start_batch % self.num_batches
-        while True:
-            yield from self.epoch(epoch, seed, skip=skip)
-            epoch += 1
-            skip = 0
+        restarts must not replay already-seen batches). ``prefetch``
+        decode+augment batches ahead on a worker thread so host
+        preprocessing overlaps device compute (0 = synchronous)."""
+        def gen():
+            epoch = start_batch // self.num_batches
+            skip = start_batch % self.num_batches
+            while True:
+                yield from self.epoch(epoch, seed, skip=skip)
+                epoch += 1
+                skip = 0
+
+        if prefetch <= 0:
+            yield from gen()
+            return
+        # the source owns the prefetcher: close() must JOIN the producer
+        # before destroying the pipeline it reads from. One active stream
+        # per source: a new batches() call supersedes the previous one
+        # (two producers would race the shared record pipeline).
+        if self._prefetcher is not None:
+            self._prefetcher.stop()
+        self._prefetcher = _Prefetcher(gen(), depth=prefetch)
+        yield from self._prefetcher
 
     def close(self) -> None:
+        # stop + join the prefetch producer FIRST: it may be inside the
+        # native pipeline's dp_next, which must not race dp_destroy
+        if self._prefetcher is not None:
+            self._prefetcher.stop()
+            self._prefetcher = None
         if self._pipeline is not None:
             self._pipeline.close()
             self._pipeline = None

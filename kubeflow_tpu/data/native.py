@@ -30,11 +30,21 @@ _build_failed = False
 
 
 def _build() -> bool:
+    """make, serialized across processes: a fleet of workers starting
+    with a stale .so must not race g++ against each other's dlopen."""
     try:
-        subprocess.run(["make", "-C", _NATIVE_DIR],
-                       check=True, capture_output=True, timeout=120)
+        os.makedirs(os.path.join(_NATIVE_DIR, "build"), exist_ok=True)
+        lock_path = os.path.join(_NATIVE_DIR, "build", ".build.lock")
+        with open(lock_path, "w") as lock:
+            try:
+                import fcntl
+                fcntl.flock(lock, fcntl.LOCK_EX)
+            except ImportError:  # non-posix: best effort
+                pass
+            subprocess.run(["make", "-C", _NATIVE_DIR],
+                           check=True, capture_output=True, timeout=120)
         return True
-    except (subprocess.SubprocessError, FileNotFoundError) as e:
+    except (OSError, subprocess.SubprocessError) as e:
         log.warning("native datapipe build failed (%s); using the "
                     "pure-Python pipeline", e)
         return False
@@ -47,15 +57,27 @@ def _load() -> Optional[ctypes.CDLL]:
             return _lib
         if _build_failed:
             return None
-        if not os.path.exists(_SO_PATH) and not _build():
-            _build_failed = True
-            return None
-        try:
+        def try_load():
             lib = ctypes.CDLL(_SO_PATH)
-        except OSError as e:
-            log.warning("cannot load %s: %s", _SO_PATH, e)
-            _build_failed = True
-            return None
+            lib.kf_augment  # symbol probe: stale pre-augment builds
+            return lib
+
+        lib = None
+        if os.path.exists(_SO_PATH):
+            try:
+                lib = try_load()
+            except (OSError, AttributeError):
+                lib = None  # stale/corrupt build: rebuild below
+        if lib is None:
+            if not _build():
+                _build_failed = True
+                return None
+            try:
+                lib = try_load()
+            except (OSError, AttributeError) as e:
+                log.warning("cannot load %s: %s", _SO_PATH, e)
+                _build_failed = True
+                return None
         lib.dp_create.restype = ctypes.c_void_p
         lib.dp_create.argtypes = [
             ctypes.POINTER(ctypes.c_char_p), ctypes.c_int32,
@@ -75,6 +97,13 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.dp_last_error.argtypes = [ctypes.c_void_p]
         lib.dp_destroy.restype = None
         lib.dp_destroy.argtypes = [ctypes.c_void_p]
+        lib.kf_augment.restype = None
+        lib.kf_augment.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32]
         _lib = lib
         return _lib
 
@@ -144,3 +173,31 @@ class NativeRecordPipeline:
             self.close()
         except Exception:  # noqa: BLE001 - interpreter teardown
             pass
+
+
+def native_augment(images: "np.ndarray", base_state: int, pad: int,
+                   mean: "np.ndarray", std: "np.ndarray", *,
+                   do_flip: bool = True, do_crop: bool = True,
+                   num_threads: int = 4) -> "np.ndarray":
+    """Fused flip + reflect-pad crop + normalize (native/augment/augment.cc):
+    uint8 (N,H,W,3) records → float32 feed buffer in one multithreaded
+    pass. Parameter derivation matches data/imagenet.py::augment_params
+    bit-identically (the shared splitmix64 spec)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native augment unavailable")
+    images = np.ascontiguousarray(images, np.uint8)
+    n, h, w, c = images.shape
+    if c != 3 or h != w:
+        raise ValueError(f"expected (N,H,H,3) uint8, got {images.shape}")
+    out = np.empty((n, h, w, 3), np.float32)
+    mean32 = np.ascontiguousarray(mean, np.float32)
+    std32 = np.ascontiguousarray(std, np.float32)
+    lib.kf_augment(
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n, h, w, pad, base_state & (2 ** 64 - 1),
+        mean32.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        std32.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        1 if do_flip else 0, 1 if do_crop else 0, num_threads)
+    return out

@@ -25,7 +25,7 @@ import re
 from typing import Optional
 
 from ..api import k8s
-from ..cluster.client import KubeClient, NotFoundError
+from ..cluster.client import AlreadyExistsError, KubeClient, NotFoundError
 from ..controllers.profile import PROFILE_API_VERSION, PROFILE_KIND
 from ._http import ApiError, JsonApp, JsonServer
 
@@ -37,8 +37,13 @@ BINDING_MANAGER = "kfam"
 
 
 def _binding_name(user: str, role: str) -> str:
-    safe = re.sub(r"[^a-z0-9-]", "-", user.lower()).strip("-")
-    return f"user-{safe}-clusterrole-{role}"
+    """DNS-safe, collision-proof: distinct principals must never share a
+    RoleBinding name (apply/delete would cross-grant), so the sanitized
+    slug carries a short digest of the exact user string."""
+    import hashlib
+    safe = re.sub(r"[^a-z0-9-]", "-", user.lower()).strip("-")[:32]
+    digest = hashlib.sha256(user.encode()).hexdigest()[:8]
+    return f"user-{safe}-{digest}-clusterrole-{role}"
 
 
 def _validate_binding(body: Optional[dict]) -> tuple[dict, str, str]:
@@ -87,8 +92,10 @@ def build_kfam_app(client: KubeClient) -> JsonApp:
         }
         try:
             client.create(profile)
-        except Exception as e:  # noqa: BLE001 - conflicts are a 409
+        except AlreadyExistsError as e:
             raise ApiError(409, f"profile {body['name']}: {e}")
+        # validation/transport errors bubble to the 500 boundary — a 409
+        # here would tell callers the profile exists when it does not
         return 200, {"name": body["name"]}
 
     @app.route("DELETE", "/kfam/v1/profiles/{name}")

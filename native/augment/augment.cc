@@ -1,0 +1,125 @@
+// Host-side image augmentation: flip + reflect-pad random crop +
+// ImageNet normalization, fused into one pass over the batch.
+//
+// The data-loader hot path the Python fallback (data/imagenet.py) does in
+// several numpy passes (plus a per-image crop loop); here it is one
+// multithreaded C++ pass from uint8 records to the float32 feed buffer.
+// Augment parameters derive from splitmix64 exactly like the shuffle
+// (datapipe.cc / data/pipeline.py), and data/imagenet.py implements the
+// SAME derivation in numpy — the executable spec the tests pin
+// bit-identically across both paths.
+//
+// C ABI (ctypes, see kubeflow_tpu/data/native.py):
+//   kf_augment(in, out, n, h, w, pad, base_state, mean, std,
+//              do_flip, do_crop, num_threads)
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+inline uint64_t splitmix64(uint64_t* state) {
+  *state += 0x9E3779B97F4A7C15ULL;
+  uint64_t z = *state;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+struct AugParams {
+  bool flip;
+  int32_t dy;
+  int32_t dx;
+};
+
+// Per-record parameter derivation — mirrored in
+// data/imagenet.py::augment_params (keep in sync!).
+inline AugParams params_for(uint64_t base, int64_t index, int32_t pad) {
+  uint64_t state = base + static_cast<uint64_t>(index + 1) *
+                              0x9E3779B97F4A7C15ULL;
+  uint64_t z1 = splitmix64(&state);
+  uint64_t z2 = splitmix64(&state);
+  AugParams p;
+  p.flip = (z1 & 1ULL) != 0;
+  uint32_t span = static_cast<uint32_t>(2 * pad + 1);
+  p.dy = span ? static_cast<int32_t>((z2 >> 1) % span) : 0;
+  p.dx = span ? static_cast<int32_t>((z2 >> 33) % span) : 0;
+  return p;
+}
+
+// reflect-pad index: maps a padded coordinate back into [0, size)
+inline int32_t reflect(int32_t v, int32_t size) {
+  if (v < 0) v = -v;                 // numpy 'reflect' (no edge repeat)
+  if (v >= size) v = 2 * size - 2 - v;
+  return v;
+}
+
+void augment_range(const uint8_t* in, float* out, int64_t lo, int64_t hi,
+                   int32_t h, int32_t w, int32_t pad, uint64_t base,
+                   const float* mean, const float* stddev, bool do_flip,
+                   bool do_crop) {
+  const int64_t img_elems = static_cast<int64_t>(h) * w * 3;
+  float scale[3], shift[3];
+  for (int c = 0; c < 3; ++c) {
+    scale[c] = 1.0f / (255.0f * stddev[c]);
+    shift[c] = mean[c] / stddev[c];
+  }
+  for (int64_t i = lo; i < hi; ++i) {
+    AugParams p = params_for(base, i, pad);
+    if (!do_flip) p.flip = false;
+    if (!do_crop) { p.dy = pad; p.dx = pad; }  // centered = identity
+    const uint8_t* src = in + i * img_elems;
+    float* dst = out + i * img_elems;
+    for (int32_t y = 0; y < h; ++y) {
+      // crop offset within the virtually padded image, reflected back
+      int32_t sy = reflect(y + p.dy - pad, h);
+      const uint8_t* row = src + static_cast<int64_t>(sy) * w * 3;
+      float* drow = dst + static_cast<int64_t>(y) * w * 3;
+      for (int32_t x = 0; x < w; ++x) {
+        int32_t sx = reflect(x + p.dx - pad, w);
+        if (p.flip) sx = w - 1 - sx;
+        const uint8_t* px = row + static_cast<int64_t>(sx) * 3;
+        float* dpx = drow + static_cast<int64_t>(x) * 3;
+        dpx[0] = static_cast<float>(px[0]) * scale[0] - shift[0];
+        dpx[1] = static_cast<float>(px[1]) * scale[1] - shift[1];
+        dpx[2] = static_cast<float>(px[2]) * scale[2] - shift[2];
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// in:  n * h * w * 3 uint8 (decoded records)
+// out: n * h * w * 3 float32 (normalized, augmented feed buffer)
+void kf_augment(const uint8_t* in, float* out, int64_t n, int32_t h,
+                int32_t w, int32_t pad, uint64_t base_state,
+                const float* mean, const float* stddev, int32_t do_flip,
+                int32_t do_crop, int32_t num_threads) {
+  if (n <= 0) return;
+  int32_t workers = num_threads;
+  if (workers < 1) workers = 1;
+  if (workers > n) workers = static_cast<int32_t>(n);
+  if (workers == 1) {
+    augment_range(in, out, 0, n, h, w, pad, base_state, mean, stddev,
+                  do_flip != 0, do_crop != 0);
+    return;
+  }
+  std::vector<std::thread> pool;
+  int64_t chunk = (n + workers - 1) / workers;
+  for (int32_t t = 0; t < workers; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    pool.emplace_back(augment_range, in, out, lo, hi, h, w, pad,
+                      base_state, mean, stddev, do_flip != 0, do_crop != 0);
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // extern "C"

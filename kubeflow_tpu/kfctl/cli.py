@@ -20,10 +20,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sub = p.add_subparsers(dest="verb")
     sub.add_parser("version", help="print version")
-    # init/generate/apply/delete/show are registered by the coordinator module
-    # (imported lazily so `kfctl version` works without cluster deps).
-    from . import verbs
-    verbs.register(sub)
+    # init/generate/apply/delete/show live in the coordinator module
+    # (imported lazily so `kfctl version` works without cluster deps)
+    from .coordinator import register_verbs
+    register_verbs(sub)
     return p
 
 

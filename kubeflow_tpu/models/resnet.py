@@ -157,3 +157,79 @@ def synthetic_batch(rng: jax.Array, batch_size: int, image_size: int = 224,
             k1, (batch_size, image_size, image_size, 3), jnp.float32),
         "labels": jax.random.randint(k2, (batch_size,), 0, num_classes),
     }
+
+
+# -- fused inference path (ops/fused_block.py) -------------------------------
+
+def _affine(bn_params, bn_stats, eps=1e-5):
+    import jax.lax as lax
+    s = bn_params["scale"].astype(jnp.float32) * lax.rsqrt(
+        bn_stats["var"].astype(jnp.float32) + eps)
+    return s, bn_params["bias"].astype(jnp.float32) - \
+        bn_stats["mean"].astype(jnp.float32) * s
+
+
+def _xla_block_eval(x, params, stats, strides, dtype=jnp.bfloat16):
+    """Strided bottleneck block via lax convs with folded BN (the blocks
+    the fused kernel does not cover)."""
+    from jax import lax
+
+    def conv(h, kernel, stride):
+        return lax.conv_general_dilated(
+            h, kernel.astype(dtype), (stride, stride), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def bn_relu(h, name, relu=True):
+        s, b = _affine(params[name], stats[name])
+        h = h.astype(jnp.float32) * s + b
+        if relu:
+            h = jax.nn.relu(h)
+        return h.astype(dtype)
+
+    y = bn_relu(conv(x, params["Conv_0"]["kernel"], 1), "BatchNorm_0")
+    y = bn_relu(conv(y, params["Conv_1"]["kernel"], strides), "BatchNorm_1")
+    y = bn_relu(conv(y, params["Conv_2"]["kernel"], 1), "BatchNorm_2",
+                relu=False)
+    if "conv_proj" in params:
+        res = bn_relu(conv(x, params["conv_proj"]["kernel"], strides),
+                      "norm_proj", relu=False)
+    else:
+        res = x
+    return jax.nn.relu(res.astype(jnp.float32) +
+                       y.astype(jnp.float32)).astype(dtype)
+
+
+def fused_eval_apply(variables: dict, images: jax.Array, *,
+                     depth: int = 50, width: int = 64,
+                     dtype=jnp.bfloat16, block_bt=None) -> jax.Array:
+    """Inference forward with every stride-1 bottleneck running as ONE
+    Pallas kernel (ops/fused_block.py): block interiors stay in VMEM, so
+    the HBM traffic per block drops to input+output. Numerically the same
+    computation as ``model.apply(..., train=False)`` (BN running stats
+    fold to exact affines); the serving path's fast mode."""
+    from jax import lax
+
+    from ..ops.fused_block import fold_block, fused_bottleneck_eval
+
+    params, stats = variables["params"], variables["batch_stats"]
+    x = images.astype(dtype)
+    x = lax.conv_general_dilated(
+        x, params["conv_init"]["kernel"].astype(dtype), (2, 2), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    s, b = _affine(params["bn_init"], stats["bn_init"])
+    x = jax.nn.relu(x.astype(jnp.float32) * s + b).astype(dtype)
+    x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+    for i, n_blocks in enumerate(STAGE_SIZES[depth]):
+        for j in range(n_blocks):
+            name = f"stage{i + 1}_block{j + 1}"
+            strides = 2 if i > 0 and j == 0 else 1
+            if strides == 1:
+                w = fold_block(params[name], stats[name])
+                x = fused_bottleneck_eval(x, w, block_bt=block_bt)
+            else:
+                x = _xla_block_eval(x, params[name], stats[name], strides,
+                                    dtype=dtype)
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    head = params["head"]
+    return x @ head["kernel"].astype(jnp.float32) + head["bias"]

@@ -128,3 +128,56 @@ def test_transformer_attention_impls_agree(seq_mesh):
         losses[impl] = float(loss)
     assert abs(losses["flash"] - losses["einsum"]) < 1e-4, losses
     assert abs(losses["ring"] - losses["einsum"]) < 1e-4, losses
+
+
+class TestFusedBlock:
+    """ops/fused_block.py: the fused bottleneck kernel equals the jnp
+    reference and the flax eval path (interpret mode on CPU)."""
+
+    def _weights(self, rng, cin, cmid, cout, proj):
+        import numpy as np
+        from kubeflow_tpu.ops.fused_block import FusedBlockWeights
+        def arr(*s):
+            return jnp.asarray(rng.normal(0, 0.1, s), jnp.float32)
+        kw = {}
+        if proj:
+            kw = dict(wp=arr(cin, cout), sp=arr(cout) + 1, bp=arr(cout))
+        return FusedBlockWeights(
+            w1=arr(cin, cmid), s1=arr(cmid) + 1, b1=arr(cmid),
+            w2=arr(3, 3, cmid, cmid), s2=arr(cmid) + 1, b2=arr(cmid),
+            w3=arr(cmid, cout), s3=arr(cout) + 1, b3=arr(cout), **kw)
+
+    def test_kernel_matches_reference(self):
+        import numpy as np
+        from kubeflow_tpu.ops.fused_block import (fused_bottleneck_eval,
+                                                  reference_bottleneck_eval)
+        rng = np.random.default_rng(0)
+        for cin, cout, proj, bt in ((16, 32, True, 2), (32, 32, False, 1),
+                                    (32, 32, False, 4)):
+            w = self._weights(rng, cin, 8, cout, proj)
+            x = jnp.asarray(rng.normal(0, 1, (4, 8, 8, cin)), jnp.float32)
+            got = fused_bottleneck_eval(x, w, block_bt=bt)
+            want = reference_bottleneck_eval(x, w)
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_missing_projection_rejected(self):
+        import numpy as np
+        import pytest
+        from kubeflow_tpu.ops.fused_block import fused_bottleneck_eval
+        rng = np.random.default_rng(0)
+        w = self._weights(rng, 16, 8, 32, proj=False)
+        with pytest.raises(ValueError, match="projection"):
+            fused_bottleneck_eval(
+                jnp.zeros((2, 8, 8, 16), jnp.float32), w)
+
+    def test_fused_eval_apply_matches_flax(self):
+        import numpy as np
+        from kubeflow_tpu.models import resnet as R
+        model = R.resnet50(num_classes=10)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 32, 32, 3)), train=False)
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+        want = model.apply(variables, x, train=False)
+        got = R.fused_eval_apply(variables, x)
+        np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+        assert (got.argmax(-1) == want.argmax(-1)).all()

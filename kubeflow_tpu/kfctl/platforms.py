@@ -50,18 +50,66 @@ class ExistingCluster(Platform):
     name = PLATFORM_EXISTING
 
 
+def _subprocess_runner(cmd: list) -> str:
+    """Default runner for the local platform drivers: shell out the way
+    minikube.go does; a missing CLI is a loud, actionable error."""
+    import subprocess
+    try:
+        return subprocess.run(cmd, check=True, capture_output=True,
+                              timeout=30, text=True).stdout
+    except FileNotFoundError:
+        raise RuntimeError(
+            f"{cmd[0]!r} CLI not found — install it or pass a runner")
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(f"{' '.join(cmd)} failed: {e.stderr.strip()}")
+
+
 class Minikube(Platform):
-    """Local minikube (minikube.go analog): validates the VM exists."""
+    """Local minikube driver (minikube.go analog, 154 LoC): verifies the
+    VM is running and the kube context points at it before k8s apply —
+    through an injectable command runner defaulting to subprocess (the
+    reference shells out to `minikube status` / kubectl config)."""
 
     name = PLATFORM_MINIKUBE
 
+    def __init__(self, runner: Callable[[list], str] = _subprocess_runner):
+        self.runner = runner
+
     def init(self, kfdef: KfDef) -> None:
-        log.info("minikube platform: assuming an existing minikube VM "
-                 "(reference parity: minikube.go relies on pre-created VM)")
+        status = self.runner(["minikube", "status",
+                              "--format", "{{.Host}}"]).strip()
+        if status.lower() != "running":
+            raise RuntimeError(
+                f"minikube VM is not running (status={status!r}); "
+                "run `minikube start` first")
+        context = self.runner(["kubectl", "config",
+                               "current-context"]).strip()
+        if context != "minikube":
+            raise RuntimeError(
+                f"kube context is {context!r}, not 'minikube' — "
+                "`kubectl config use-context minikube`")
+
+    def apply(self, kfdef: KfDef) -> None:
+        # platform resources are the VM itself; verify it is still up
+        self.init(kfdef)
 
 
 class DockerForDesktop(Platform):
+    """docker-for-desktop driver (dockerfordesktop.go analog): the
+    reference builds this as a Go .so plugin; here it is just another
+    registered platform that checks the docker-desktop kube context."""
+
     name = PLATFORM_DOCKER_FOR_DESKTOP
+
+    def __init__(self, runner: Callable[[list], str] = _subprocess_runner):
+        self.runner = runner
+
+    def init(self, kfdef: KfDef) -> None:
+        context = self.runner(["kubectl", "config",
+                               "current-context"]).strip()
+        if context not in ("docker-for-desktop", "docker-desktop"):
+            raise RuntimeError(
+                f"kube context is {context!r}, not docker-desktop")
 
 
 class CloudOpError(RuntimeError):

@@ -162,11 +162,8 @@ def synthetic_batch(rng: jax.Array, batch_size: int, image_size: int = 224,
 # -- fused inference path (ops/fused_block.py) -------------------------------
 
 def _affine(bn_params, bn_stats, eps=1e-5):
-    import jax.lax as lax
-    s = bn_params["scale"].astype(jnp.float32) * lax.rsqrt(
-        bn_stats["var"].astype(jnp.float32) + eps)
-    return s, bn_params["bias"].astype(jnp.float32) - \
-        bn_stats["mean"].astype(jnp.float32) * s
+    from ..ops.fused_block import _fold_bn  # one folding formula, one place
+    return _fold_bn(bn_params, bn_stats, eps)
 
 
 def _xla_block_eval(x, params, stats, strides, dtype=jnp.bfloat16):
@@ -200,13 +197,19 @@ def _xla_block_eval(x, params, stats, strides, dtype=jnp.bfloat16):
 
 
 def fused_eval_apply(variables: dict, images: jax.Array, *,
-                     depth: int = 50, width: int = 64,
+                     depth: int = 50,
                      dtype=jnp.bfloat16, block_bt=None) -> jax.Array:
     """Inference forward with every stride-1 bottleneck running as ONE
-    Pallas kernel (ops/fused_block.py): block interiors stay in VMEM, so
-    the HBM traffic per block drops to input+output. Numerically the same
-    computation as ``model.apply(..., train=False)`` (BN running stats
-    fold to exact affines); the serving path's fast mode."""
+    Pallas kernel (ops/fused_block.py). Numerically the same computation
+    as ``model.apply(..., train=False)`` (BN running stats fold to exact
+    affines) — but MEASURED SLOWER than the standard XLA eval path
+    (6.8k vs 11.5k img/s at 224px/bs128, PERF.md): XLA already fuses the
+    folded affines into conv epilogues at inference. Kept as the tested
+    baseline for the training-mode fused kernel, NOT the serving default.
+    Bottleneck depths only (>= 50)."""
+    if depth < 50:
+        raise ValueError("fused_eval_apply supports bottleneck depths "
+                         "(>= 50); BasicBlock models have no Conv_2")
     from jax import lax
 
     from ..ops.fused_block import fold_block, fused_bottleneck_eval

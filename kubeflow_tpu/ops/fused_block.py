@@ -31,7 +31,6 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.experimental import pallas as pl
 
 __all__ = ["FusedBlockWeights", "fold_block", "fused_bottleneck_eval",
@@ -179,6 +178,10 @@ def fused_bottleneck_eval(x: jax.Array, w: FusedBlockWeights, *,
         block_bt = max(1, int((6 * 2 ** 20) // max(per_image, 1)))
         while n % block_bt:
             block_bt -= 1
+    elif n % block_bt:
+        raise ValueError(
+            f"block_bt {block_bt} must divide batch {n} (a partial last "
+            f"tile would leave output rows unwritten)")
     dtype = x.dtype
 
     weights = [w.w1.astype(dtype), w.s1, w.b1,

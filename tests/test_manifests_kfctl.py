@@ -368,3 +368,57 @@ class TestGcpDriver:
         platform.delete(coord.kfdef)
         assert coord.kfdef.name + "-cluster" not in sim.deployments
         assert [m for m, _ in sim.calls].count("deployments.delete") == 1
+
+
+class TestLocalPlatformDrivers:
+    """minikube.go / dockerfordesktop.go parity behind the runner seam."""
+
+    def test_minikube_checks_vm_and_context(self):
+        from kubeflow_tpu.api.kfdef import KfDef
+        from kubeflow_tpu.kfctl.platforms import Minikube
+        calls = []
+
+        def runner(cmd):
+            calls.append(cmd)
+            if cmd[0] == "minikube":
+                return "Running\n"
+            return "minikube\n"
+
+        Minikube(runner=runner).init(KfDef(name="k"))
+        assert calls[0][0] == "minikube"
+        assert calls[1][:2] == ["kubectl", "config"]
+
+    def test_minikube_not_running_rejected(self):
+        from kubeflow_tpu.api.kfdef import KfDef
+        from kubeflow_tpu.kfctl.platforms import Minikube
+        with pytest.raises(RuntimeError, match="not running"):
+            Minikube(runner=lambda cmd: "Stopped").init(KfDef(name="k"))
+
+    def test_minikube_wrong_context_rejected(self):
+        from kubeflow_tpu.api.kfdef import KfDef
+        from kubeflow_tpu.kfctl.platforms import Minikube
+
+        def runner(cmd):
+            return "Running" if cmd[0] == "minikube" else "gke_prod"
+
+        with pytest.raises(RuntimeError, match="context"):
+            Minikube(runner=runner).init(KfDef(name="k"))
+
+    def test_docker_for_desktop_context(self):
+        from kubeflow_tpu.api.kfdef import KfDef
+        from kubeflow_tpu.kfctl.platforms import DockerForDesktop
+        DockerForDesktop(runner=lambda c: "docker-desktop").init(
+            KfDef(name="k"))
+        with pytest.raises(RuntimeError, match="context"):
+            DockerForDesktop(runner=lambda c: "minikube").init(
+                KfDef(name="k"))
+
+    def test_missing_cli_is_loud(self):
+        # default runner shells out; a missing minikube/kubectl CLI must
+        # be an actionable error, not a silent no-op
+        from kubeflow_tpu.api.kfdef import KfDef
+        from kubeflow_tpu.kfctl.platforms import Minikube, _subprocess_runner
+        with pytest.raises(RuntimeError,
+                           match="not found|not running|failed"):
+            Minikube(runner=lambda cmd: _subprocess_runner(
+                ["definitely-not-a-binary-xyz"])).init(KfDef(name="k"))

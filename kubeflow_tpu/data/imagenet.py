@@ -74,7 +74,8 @@ def augment_params(base: int, n: int, pad: int
 
 
 def _py_augment(images: np.ndarray, base: int, pad: int, *,
-                do_flip: bool, do_crop: bool) -> np.ndarray:
+                do_flip: bool, do_crop: bool,
+                normalize: bool = True) -> np.ndarray:
     """Numpy fallback producing the native kernel's exact output."""
     n, h, w, _ = images.shape
     flip, dy, dx = augment_params(base, n, pad)
@@ -89,13 +90,16 @@ def _py_augment(images: np.ndarray, base: int, pad: int, *,
         v = np.abs(v)
         return np.where(v >= size, 2 * size - 2 - v, v)
 
-    out = np.empty((n, h, w, 3), np.float32)
+    out = np.empty((n, h, w, 3),
+                   np.float32 if normalize else np.uint8)
     for i in range(n):
         sy = reflect(coords + dy[i] - pad, h)
         sx = reflect(coords + dx[i] - pad, w)
         if flip[i]:
             sx = w - 1 - sx
         out[i] = images[i][np.ix_(sy, sx)]
+    if not normalize:
+        return out
     # same op order as the C++ kernel (x*scale - shift, f32) so the two
     # paths are bit-identical
     scale = np.float32(1.0) / (np.float32(255.0) * STDDEV_RGB)
@@ -103,6 +107,16 @@ def _py_augment(images: np.ndarray, base: int, pad: int, *,
     out *= scale
     out -= shift
     return out
+
+
+def device_normalize(images_u8):
+    """The on-device half of the uint8 input mode: identical math to the
+    host normalize (x*(1/(255*std)) - mean/std, f32). Runs inside jit on
+    the already-placed batch so only uint8 crosses host→device."""
+    import jax.numpy as jnp
+    scale = jnp.asarray(1.0 / (255.0 * STDDEV_RGB), jnp.float32)
+    shift = jnp.asarray(MEAN_RGB / STDDEV_RGB, jnp.float32)
+    return images_u8.astype(jnp.float32) * scale - shift
 
 
 def record_bytes(image_size: int) -> int:
@@ -240,7 +254,18 @@ class ImageNetSource:
     def __init__(self, data_dir: str, batch_size: int, *,
                  augment: bool = True, pad_px: int = 4,
                  num_threads: int = 2, queue_depth: int = 4,
-                 image_dtype: Optional[np.dtype] = None):
+                 image_dtype: Optional[np.dtype] = None,
+                 output: str = "normalized"):
+        if output not in ("normalized", "uint8"):
+            raise ValueError(f"output {output!r} not in "
+                             "('normalized', 'uint8')")
+        if output == "uint8" and image_dtype is not None:
+            raise ValueError(
+                "image_dtype conflicts with output='uint8' (bytes ship "
+                "as-is; normalize on device picks the compute dtype)")
+        # "uint8": ship raw augmented bytes and normalize ON DEVICE
+        # (device_normalize) — 1/4 the host→device traffic
+        self.output = output
         self.meta = read_meta(data_dir)
         self.image_size = int(self.meta["image_size"])
         self.num_classes = int(self.meta["num_classes"])
@@ -275,10 +300,18 @@ class ImageNetSource:
 
     def _augment_normalize(self, images: np.ndarray, base: int,
                            augment: bool) -> np.ndarray:
-        """One fused pass: flip + reflect-pad crop + normalize. Native C++
-        fast path (native/augment/augment.cc), numpy fallback computing
-        the bit-identical result from the same splitmix64 parameters."""
-        from .native import native_augment, native_available
+        """One fused pass: flip + reflect-pad crop (+ normalize unless in
+        uint8 device-normalize mode). Native C++ fast path
+        (native/augment/augment.cc), numpy fallback computing the
+        bit-identical result from the same splitmix64 parameters."""
+        from .native import (native_augment, native_augment_u8,
+                             native_available)
+        if self.output == "uint8":
+            if native_available():
+                return native_augment_u8(images, base, self.pad_px,
+                                         do_flip=augment, do_crop=augment)
+            return _py_augment(images, base, self.pad_px, do_flip=augment,
+                               do_crop=augment, normalize=False)
         if native_available():
             out = native_augment(
                 images, base, self.pad_px, MEAN_RGB, STDDEV_RGB,

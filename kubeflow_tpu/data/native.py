@@ -59,7 +59,7 @@ def _load() -> Optional[ctypes.CDLL]:
             return None
         def try_load():
             lib = ctypes.CDLL(_SO_PATH)
-            lib.kf_augment  # symbol probe: stale pre-augment builds
+            lib.kf_augment_u8  # symbol probe: stale pre-augment builds
             return lib
 
         lib = None
@@ -103,6 +103,12 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_uint64, ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_float), ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32]
+        lib.kf_augment_u8.restype = None
+        lib.kf_augment_u8.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_int32]
         _lib = lib
         return _lib
@@ -199,5 +205,27 @@ def native_augment(images: "np.ndarray", base_state: int, pad: int,
         n, h, w, pad, base_state & (2 ** 64 - 1),
         mean32.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         std32.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        1 if do_flip else 0, 1 if do_crop else 0, num_threads)
+    return out
+
+
+def native_augment_u8(images: "np.ndarray", base_state: int, pad: int, *,
+                      do_flip: bool = True, do_crop: bool = True,
+                      num_threads: int = 4) -> "np.ndarray":
+    """Augment WITHOUT normalization, uint8→uint8: the device-normalize
+    input mode ships 1/4 the bytes host→device and normalizes inside the
+    jitted step (data/imagenet.py device_normalize)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native augment unavailable")
+    images = np.ascontiguousarray(images, np.uint8)
+    n, h, w, c = images.shape
+    if c != 3 or h != w:
+        raise ValueError(f"expected (N,H,H,3) uint8, got {images.shape}")
+    out = np.empty((n, h, w, 3), np.uint8)
+    lib.kf_augment_u8(
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n, h, w, pad, base_state & (2 ** 64 - 1),
         1 if do_flip else 0, 1 if do_crop else 0, num_threads)
     return out

@@ -60,8 +60,12 @@ def _subprocess_runner(cmd: list) -> str:
     except FileNotFoundError:
         raise RuntimeError(
             f"{cmd[0]!r} CLI not found — install it or pass a runner")
+    except subprocess.TimeoutExpired:
+        raise RuntimeError(f"{' '.join(cmd)} timed out after 30s")
     except subprocess.CalledProcessError as e:
-        raise RuntimeError(f"{' '.join(cmd)} failed: {e.stderr.strip()}")
+        # minikube reports stopped VMs via exit code with detail on stdout
+        detail = (e.stderr or "").strip() or (e.stdout or "").strip()
+        raise RuntimeError(f"{' '.join(cmd)} failed: {detail}")
 
 
 class Minikube(Platform):
@@ -76,8 +80,14 @@ class Minikube(Platform):
         self.runner = runner
 
     def init(self, kfdef: KfDef) -> None:
-        status = self.runner(["minikube", "status",
-                              "--format", "{{.Host}}"]).strip()
+        try:
+            status = self.runner(["minikube", "status",
+                                  "--format", "{{.Host}}"]).strip()
+        except RuntimeError as e:
+            # a stopped/nonexistent VM exits non-zero — same remedy
+            raise RuntimeError(
+                f"minikube VM is not running ({e}); "
+                "run `minikube start` first") from None
         if status.lower() != "running":
             raise RuntimeError(
                 f"minikube VM is not running (status={status!r}); "

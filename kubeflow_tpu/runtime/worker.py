@@ -18,7 +18,7 @@ import argparse
 import logging
 import os
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Callable, Optional
 
 import jax
@@ -120,11 +120,26 @@ def train(
             raise ValueError(
                 f"workload {workload!r} does not consume --data-dir")
         from ..data.imagenet import ImageNetSource
-        data_source = ImageNetSource(data_dir, batch_size=global_batch)
+        # ship uint8 records host→device (1/4 the bytes of f32);
+        # normalization folds into the train step below so XLA fuses it
+        # into the first conv's prologue — transfers are the real-data
+        # bottleneck (PERF.md "Real-data input path")
+        data_source = ImageNetSource(data_dir, batch_size=global_batch,
+                                     output="uint8")
         workload_kwargs.setdefault("image_size", data_source.image_size)
         workload_kwargs.setdefault("num_classes", data_source.num_classes)
 
     spec = WORKLOADS[workload](**workload_kwargs)
+    if data_source is not None:
+        from ..data.imagenet import device_normalize
+        inner_loss = spec.loss_fn
+
+        def loss_fn_u8(params, variables, batch, rng,
+                       _inner=inner_loss):
+            batch = dict(batch, images=device_normalize(batch["images"]))
+            return _inner(params, variables, batch, rng)
+
+        spec = replace(spec, loss_fn=loss_fn_u8)
     log.info("worker %d/%d mesh=%s workload=%s", ctx.process_id,
              ctx.num_processes, dict(ctx.mesh.shape), spec.name)
 

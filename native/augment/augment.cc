@@ -57,38 +57,75 @@ inline int32_t reflect(int32_t v, int32_t size) {
   return v;
 }
 
-void augment_range(const uint8_t* in, float* out, int64_t lo, int64_t hi,
+template <typename Out>
+void augment_range(const uint8_t* in, Out* out, int64_t lo, int64_t hi,
                    int32_t h, int32_t w, int32_t pad, uint64_t base,
                    const float* mean, const float* stddev, bool do_flip,
-                   bool do_crop) {
+                   bool do_crop, bool normalize) {
   const int64_t img_elems = static_cast<int64_t>(h) * w * 3;
-  float scale[3], shift[3];
-  for (int c = 0; c < 3; ++c) {
-    scale[c] = 1.0f / (255.0f * stddev[c]);
-    shift[c] = mean[c] / stddev[c];
+  float scale[3] = {1, 1, 1}, shift[3] = {0, 0, 0};
+  if (normalize) {
+    for (int c = 0; c < 3; ++c) {
+      scale[c] = 1.0f / (255.0f * stddev[c]);
+      shift[c] = mean[c] / stddev[c];
+    }
   }
   for (int64_t i = lo; i < hi; ++i) {
     AugParams p = params_for(base, i, pad);
     if (!do_flip) p.flip = false;
     if (!do_crop) { p.dy = pad; p.dx = pad; }  // centered = identity
     const uint8_t* src = in + i * img_elems;
-    float* dst = out + i * img_elems;
+    Out* dst = out + i * img_elems;
     for (int32_t y = 0; y < h; ++y) {
       // crop offset within the virtually padded image, reflected back
       int32_t sy = reflect(y + p.dy - pad, h);
       const uint8_t* row = src + static_cast<int64_t>(sy) * w * 3;
-      float* drow = dst + static_cast<int64_t>(y) * w * 3;
+      Out* drow = dst + static_cast<int64_t>(y) * w * 3;
       for (int32_t x = 0; x < w; ++x) {
         int32_t sx = reflect(x + p.dx - pad, w);
         if (p.flip) sx = w - 1 - sx;
         const uint8_t* px = row + static_cast<int64_t>(sx) * 3;
-        float* dpx = drow + static_cast<int64_t>(x) * 3;
-        dpx[0] = static_cast<float>(px[0]) * scale[0] - shift[0];
-        dpx[1] = static_cast<float>(px[1]) * scale[1] - shift[1];
-        dpx[2] = static_cast<float>(px[2]) * scale[2] - shift[2];
+        Out* dpx = drow + static_cast<int64_t>(x) * 3;
+        if (normalize) {
+          dpx[0] = static_cast<Out>(
+              static_cast<float>(px[0]) * scale[0] - shift[0]);
+          dpx[1] = static_cast<Out>(
+              static_cast<float>(px[1]) * scale[1] - shift[1]);
+          dpx[2] = static_cast<Out>(
+              static_cast<float>(px[2]) * scale[2] - shift[2]);
+        } else {
+          dpx[0] = static_cast<Out>(px[0]);
+          dpx[1] = static_cast<Out>(px[1]);
+          dpx[2] = static_cast<Out>(px[2]);
+        }
       }
     }
   }
+}
+
+template <typename Out>
+void run_augment(const uint8_t* in, Out* out, int64_t n, int32_t h,
+                 int32_t w, int32_t pad, uint64_t base,
+                 const float* mean, const float* stddev, bool do_flip,
+                 bool do_crop, bool normalize, int32_t num_threads) {
+  if (n <= 0) return;
+  int32_t workers = num_threads < 1 ? 1 : num_threads;
+  if (workers > n) workers = static_cast<int32_t>(n);
+  if (workers == 1) {
+    augment_range<Out>(in, out, 0, n, h, w, pad, base, mean, stddev,
+                       do_flip, do_crop, normalize);
+    return;
+  }
+  std::vector<std::thread> pool;
+  int64_t chunk = (n + workers - 1) / workers;
+  for (int32_t t = 0; t < workers; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    pool.emplace_back(augment_range<Out>, in, out, lo, hi, h, w, pad,
+                      base, mean, stddev, do_flip, do_crop, normalize);
+  }
+  for (auto& th : pool) th.join();
 }
 
 }  // namespace
@@ -101,25 +138,20 @@ void kf_augment(const uint8_t* in, float* out, int64_t n, int32_t h,
                 int32_t w, int32_t pad, uint64_t base_state,
                 const float* mean, const float* stddev, int32_t do_flip,
                 int32_t do_crop, int32_t num_threads) {
-  if (n <= 0) return;
-  int32_t workers = num_threads;
-  if (workers < 1) workers = 1;
-  if (workers > n) workers = static_cast<int32_t>(n);
-  if (workers == 1) {
-    augment_range(in, out, 0, n, h, w, pad, base_state, mean, stddev,
-                  do_flip != 0, do_crop != 0);
-    return;
-  }
-  std::vector<std::thread> pool;
-  int64_t chunk = (n + workers - 1) / workers;
-  for (int32_t t = 0; t < workers; ++t) {
-    int64_t lo = t * chunk;
-    int64_t hi = lo + chunk < n ? lo + chunk : n;
-    if (lo >= hi) break;
-    pool.emplace_back(augment_range, in, out, lo, hi, h, w, pad,
-                      base_state, mean, stddev, do_flip != 0, do_crop != 0);
-  }
-  for (auto& th : pool) th.join();
+  run_augment<float>(in, out, n, h, w, pad, base_state, mean, stddev,
+                     do_flip != 0, do_crop != 0, /*normalize=*/true,
+                     num_threads);
+}
+
+// uint8 variant: augment only, NO normalization — the device-normalize
+// input mode (ship 1/4 the bytes host→device; normalization runs inside
+// the jitted step). Same augment parameters as kf_augment.
+void kf_augment_u8(const uint8_t* in, uint8_t* out, int64_t n, int32_t h,
+                   int32_t w, int32_t pad, uint64_t base_state,
+                   int32_t do_flip, int32_t do_crop, int32_t num_threads) {
+  run_augment<uint8_t>(in, out, n, h, w, pad, base_state, nullptr, nullptr,
+                       do_flip != 0, do_crop != 0, /*normalize=*/false,
+                       num_threads);
 }
 
 }  // extern "C"

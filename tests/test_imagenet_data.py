@@ -221,3 +221,50 @@ class TestNativeAugment:
         b = native_augment(images, 99, 4, MEAN_RGB, STDDEV_RGB,
                            num_threads=8)
         np.testing.assert_array_equal(a, b)
+
+
+class TestUint8DeviceNormalize:
+    """uint8 input mode: augmented bytes ship to the device, normalize
+    runs in jit — the composition equals the host-normalized path."""
+
+    def test_uint8_plus_device_normalize_equals_host(self, data_dir):
+        import jax
+        from kubeflow_tpu.data.imagenet import device_normalize
+        d, *_ = data_dir
+        with ImageNetSource(d, batch_size=8, augment=True,
+                            output="uint8") as src:
+            b_u8 = next(src.epoch(0, seed=9))
+        with ImageNetSource(d, batch_size=8, augment=True) as src:
+            b_f32 = next(src.epoch(0, seed=9))
+        assert b_u8["images"].dtype == np.uint8
+        np.testing.assert_array_equal(b_u8["labels"], b_f32["labels"])
+        on_device = jax.jit(device_normalize)(b_u8["images"])
+        np.testing.assert_allclose(np.asarray(on_device), b_f32["images"],
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_native_u8_matches_python(self):
+        from kubeflow_tpu.data.imagenet import _py_augment
+        from kubeflow_tpu.data.native import (native_augment_u8,
+                                              native_available)
+        if not native_available():
+            pytest.skip("native toolchain unavailable")
+        rng = np.random.default_rng(5)
+        images = rng.integers(0, 256, (9, SIZE, SIZE, 3), dtype=np.uint8)
+        want = _py_augment(images, 42, 4, do_flip=True, do_crop=True,
+                           normalize=False)
+        got = native_augment_u8(images, 42, 4)
+        assert got.dtype == np.uint8
+        np.testing.assert_array_equal(got, want)
+
+    def test_worker_trains_on_uint8_path(self, data_dir):
+        d, *_ = data_dir
+        from kubeflow_tpu.runtime.worker import train
+        r = train(workload="resnet50", steps=2, global_batch=8,
+                  data_dir=d, sync_every=1, seed=2)
+        assert r.steps == 2
+        assert np.isfinite(r.final_metrics["loss"])
+
+    def test_bad_output_mode_rejected(self, data_dir):
+        d, *_ = data_dir
+        with pytest.raises(ValueError, match="output"):
+            ImageNetSource(d, batch_size=8, output="float64")

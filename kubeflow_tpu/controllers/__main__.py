@@ -93,7 +93,8 @@ def main(argv=None) -> int:
 
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        force=True)
 
     if args.kubeconfig:
         from ..cluster.http_client import HttpKubeClient

@@ -10,6 +10,7 @@ from __future__ import annotations
 import json
 
 from ..api import k8s
+from ..api.trainingjob import KF_API_VERSION_V1ALPHA1, TPU_API_VERSION
 from . import helpers as H
 from .registry import register
 
@@ -96,6 +97,80 @@ def tpu_batch_predict(namespace: str = "kubeflow", name: str = "batch-predict",
         }],
     }}}
     return [job]
+
+
+@register("tpu-serving-simple", "Example: serve the sample MNIST model on "
+                                "one TPU chip (examples/prototypes/"
+                                "tf-serving-simple.jsonnet analog)")
+def tpu_serving_simple(namespace: str = "kubeflow",
+                       name: str = "mnist-serving") -> list[dict]:
+    """Canonical serving example: the smallest useful tpu-serving instance,
+    pointed at the sample MNIST servable the batch-predict tests use. The
+    reference's tf-serving-simple prototype is the same idea — tf-serving
+    with an inception/mnist model and default everything."""
+    return tpu_serving(namespace=namespace, name=name,
+                       model_path="gs://kubeflow-tpu-examples/mnist/servable",
+                       model_name="mnist", tpu_topology="v5e-1",
+                       enable_http_proxy=True)
+
+
+@register("katib-studyjob-example", "Example StudyJob: random search over "
+                                    "the ResNet-50 TPUJob's learning rate "
+                                    "(katib-studyjob-test-v1alpha1.jsonnet "
+                                    "analog)")
+def katib_studyjob_example(namespace: str = "kubeflow",
+                           name: str = "studyjob-example",
+                           max_trials: int = 6,
+                           request_number: int = 3) -> list[dict]:
+    """Canonical HP-search example: a StudyJob whose trials are
+    gang-scheduled TPUJobs, sweeping learning rate and per-chip batch size
+    with the random suggestion engine. Field names follow the StudyJob
+    schema reconciled by katib/studyjob.py."""
+    study = k8s.make(KF_API_VERSION_V1ALPHA1, "StudyJob", name, namespace)
+    study["spec"] = {
+        "studyName": name,
+        "owner": "crd",
+        "optimizationtype": "maximize",
+        "objectivevaluename": "accuracy",
+        "metricsnames": ["accuracy", "loss"],
+        "parameterconfigs": [
+            {"name": "--learning-rate", "parametertype": "double",
+             "feasible": {"min": "0.01", "max": "0.3"}},
+            {"name": "--global-batch", "parametertype": "categorical",
+             "feasible": {"list": ["512", "1024", "2048"]}},
+        ],
+        "suggestionSpec": {
+            "suggestionAlgorithm": "random",
+            "requestNumber": request_number,
+        },
+        "maxTrials": max_trials,
+        "maxFailedTrials": 2,
+        "workerSpec": {
+            "injectParameters": True,
+            "template": {
+                "apiVersion": TPU_API_VERSION, "kind": "TPUJob",
+                "metadata": {"name": "$(trialName)",
+                             "namespace": namespace},
+                "spec": {
+                    "replicaSpecs": {"TPU": {
+                        "tpuTopology": "v5e-8",
+                        "template": {"spec": {"containers": [{
+                            "name": "worker",
+                            "image": f"{IMG}/worker:{VERSION}",
+                            "command": [
+                                "python", "-m",
+                                "kubeflow_tpu.runtime.worker",
+                                "--workload", "resnet50",
+                                "--steps", "200"],
+                        }]}},
+                    }},
+                    "runPolicy": {"backoffLimit": 1},
+                    "sharding": {"data": -1},
+                },
+            },
+        },
+    }
+    return [study]
 
 
 @register("tensorboard", "TensorBoard deployment (kubeflow/tensorboard parity)")

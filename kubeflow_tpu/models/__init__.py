@@ -7,3 +7,9 @@
 - ``transformer``: decoder-only LM with logical sharding annotations —
   the TP/PP/SP/EP showcase (no analog in the reference; SURVEY.md §2.5 row 5).
 """
+
+# The supported ResNet family (tf_cnn_benchmarks --model surface). Defined
+# here — not in .resnet — so the worker/serving registries can enumerate the
+# family without importing flax; resnet.STAGE_SIZES is checked against this
+# at import time.
+RESNET_DEPTHS = (18, 34, 50, 101, 152)

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 
 ModuleDef = Any
 
+from . import RESNET_DEPTHS  # noqa: F401 — canonical family tuple
+
 STAGE_SIZES = {
     18: [2, 2, 2, 2],
     34: [3, 4, 6, 3],
@@ -32,6 +34,8 @@ STAGE_SIZES = {
     101: [3, 4, 23, 3],
     152: [3, 8, 36, 3],
 }
+assert set(STAGE_SIZES) == set(RESNET_DEPTHS), \
+    "models.RESNET_DEPTHS out of sync with resnet.STAGE_SIZES"
 
 
 class BottleneckBlock(nn.Module):
@@ -112,8 +116,33 @@ class ResNet(nn.Module):
         return x.astype(jnp.float32)
 
 
+def make_resnet(depth: int, num_classes: int = 1000, **kw) -> ResNet:
+    """The tf_cnn_benchmarks --model family: resnet{18,34,50,101,152}
+    (BasicBlock below depth 50, bottleneck at and above)."""
+    if depth not in STAGE_SIZES:
+        raise ValueError(f"unsupported ResNet depth {depth}; "
+                         f"one of {sorted(STAGE_SIZES)}")
+    return ResNet(num_classes=num_classes, depth=depth, **kw)
+
+
+def resnet18(num_classes: int = 1000, **kw) -> ResNet:
+    return make_resnet(18, num_classes, **kw)
+
+
+def resnet34(num_classes: int = 1000, **kw) -> ResNet:
+    return make_resnet(34, num_classes, **kw)
+
+
 def resnet50(num_classes: int = 1000, **kw) -> ResNet:
-    return ResNet(num_classes=num_classes, depth=50, **kw)
+    return make_resnet(50, num_classes, **kw)
+
+
+def resnet101(num_classes: int = 1000, **kw) -> ResNet:
+    return make_resnet(101, num_classes, **kw)
+
+
+def resnet152(num_classes: int = 1000, **kw) -> ResNet:
+    return make_resnet(152, num_classes, **kw)
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:

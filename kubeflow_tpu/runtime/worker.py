@@ -18,12 +18,14 @@ import argparse
 import logging
 import os
 import time
+from functools import partial
 from dataclasses import dataclass, replace
 from typing import Callable, Optional
 
 import jax
 import optax
 
+from ..models import RESNET_DEPTHS
 from .bootstrap import WorkerContext, initialize
 from .checkpoint import CheckpointManager, HAVE_ORBAX
 from .metrics import METRICS_PATH_ENV, MetricsLogger, profile_trace
@@ -44,11 +46,12 @@ class WorkloadSpec:
     param_logical_axes: Optional[object] = None
 
 
-def _resnet_spec(image_size: int = 224, num_classes: int = 1000) -> WorkloadSpec:
+def _resnet_spec(image_size: int = 224, num_classes: int = 1000,
+                 depth: int = 50) -> WorkloadSpec:
     from ..models import resnet as R
-    model = R.resnet50(num_classes=num_classes)
+    model = R.make_resnet(depth, num_classes=num_classes)
     return WorkloadSpec(
-        name="resnet50",
+        name=f"resnet{depth}",
         init_fn=R.init_fn(model, image_size=image_size),
         loss_fn=R.make_loss_fn(model),
         batch_fn=lambda rng, bs: R.synthetic_batch(
@@ -67,7 +70,9 @@ def _transformer_pipelined_spec(**kw) -> WorkloadSpec:
 
 
 WORKLOADS: dict[str, Callable[..., WorkloadSpec]] = {
-    "resnet50": _resnet_spec,
+    # the tf_cnn_benchmarks --model family
+    **{f"resnet{d}": partial(_resnet_spec, depth=d)
+       for d in RESNET_DEPTHS},
     "transformer": _transformer_spec,
     # stacked-layer LM routed through the GPipe engine when the mesh has a
     # pipeline axis (factory takes mesh=, injected by train())
@@ -78,7 +83,7 @@ WORKLOADS: dict[str, Callable[..., WorkloadSpec]] = {
 _MESH_AWARE_WORKLOADS = {"transformer-pipelined"}
 
 # workloads that consume --data-dir (ImageNet-style record shards)
-_IMAGE_WORKLOADS = {"resnet50"}
+_IMAGE_WORKLOADS = {f"resnet{d}" for d in RESNET_DEPTHS}
 
 
 @dataclass
@@ -274,7 +279,9 @@ def train(
 
 
 def main(argv=None) -> int:
-    logging.basicConfig(level=logging.INFO)
+    # force: importing jax/orbax can install a root handler first, which
+    # would turn this into a no-op and silence the worker entirely
+    logging.basicConfig(level=logging.INFO, force=True)
     p = argparse.ArgumentParser(description="kubeflow-tpu training worker")
     p.add_argument("--workload", default="resnet50", choices=sorted(WORKLOADS))
     p.add_argument("--steps", type=int, default=20)

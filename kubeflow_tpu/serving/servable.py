@@ -15,6 +15,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Any, Callable, Optional
 
 import jax
@@ -157,10 +158,10 @@ class ModelRepository:
             return sorted(self._models)
 
 
-@register_model("resnet50")
-def _build_resnet50(num_classes: int = 1000, image_size: int = 224):
+def _build_resnet(depth: int = 50, num_classes: int = 1000,
+                  image_size: int = 224):
     from ..models import resnet as R
-    model = R.resnet50(num_classes=num_classes)
+    model = R.make_resnet(depth, num_classes=num_classes)
 
     def init_params():
         return jax.jit(lambda rng: model.init(
@@ -176,6 +177,12 @@ def _build_resnet50(num_classes: int = 1000, image_size: int = 224):
                       "dtype": "float32"},
            "outputs": {"logits": [-1, num_classes], "classes": [-1]}}
     return predict, init_params, sig
+
+
+from ..models import RESNET_DEPTHS  # noqa: E402 — light, no flax import
+
+for _depth in RESNET_DEPTHS:
+    register_model(f"resnet{_depth}")(partial(_build_resnet, depth=_depth))
 
 
 @register_model("transformer_lm")

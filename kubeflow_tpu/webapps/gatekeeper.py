@@ -32,17 +32,43 @@ PASSWORD_ENV = "KUBEFLOW_PASSWORD"
 COOKIE_NAME = "kubeflow-session"
 SESSION_TTL_S = 12 * 3600  # 12h, AuthServer.go expiry
 
-# the kflogin page analog (components/kflogin React app → one form)
+# the kflogin page analog (components/kflogin React app → one form with
+# the redirect-back + error-banner behavior of the React page)
 LOGIN_HTML = """<!doctype html>
 <html><head><title>Kubeflow login</title><style>
 body{font-family:sans-serif;display:flex;justify-content:center;
 margin-top:15vh}form{display:flex;flex-direction:column;gap:0.6rem;
-min-width:18rem}input{padding:0.5rem}button{padding:0.6rem}</style>
+min-width:18rem}input{padding:0.5rem}button{padding:0.6rem}
+.err{color:#b00020;margin:0;font-size:0.9rem}</style>
 </head><body><form method="post" action="/login">
 <h2>Kubeflow TPU</h2>
+<!--ERROR--><input type="hidden" name="rd" value="__RD__">
 <input name="username" placeholder="username" autofocus>
 <input name="password" type="password" placeholder="password">
 <button type="submit">Log in</button></form></body></html>"""
+
+ERROR_BANNER = '<p class="err">Invalid username or password.</p>'
+
+
+def safe_redirect(rd: Optional[str]) -> str:
+    """Clamp the post-login destination to a same-origin absolute path —
+    anything else (//evil.com, http://..., relative) is an open-redirect
+    vector and collapses to /."""
+    if (rd and rd.startswith("/") and not rd.startswith("//")
+            and "\\" not in rd  # browsers normalize \ to / → //evil.com
+            # control chars (CR/LF) would splice raw response headers
+            and not any(c < " " or c == "\x7f" for c in rd)):
+        return rd
+    return "/"
+
+
+def render_login(rd: str = "/", error: bool = False) -> str:
+    import html as _html
+    page = LOGIN_HTML.replace("__RD__", _html.escape(safe_redirect(rd),
+                                                     quote=True))
+    if error:
+        page = page.replace("<!--ERROR-->", ERROR_BANNER)
+    return page
 
 
 class SessionStore:
@@ -174,8 +200,12 @@ def _make_handler(gate: Gatekeeper):
         def do_GET(self):
             if self.path == "/healthz":
                 return self._send(200, b"ok")
-            if self.path in ("/", "/login"):
-                return self._send(200, LOGIN_HTML.encode(),
+            parsed = urllib.parse.urlsplit(self.path)
+            if parsed.path in ("/", "/login"):
+                q = urllib.parse.parse_qs(parsed.query)
+                page = render_login(rd=(q.get("rd") or ["/"])[0],
+                                    error=bool(q.get("error")))
+                return self._send(200, page.encode(),
                                   {"Content-Type":
                                    "text/html; charset=utf-8"})
             if self.path.startswith("/auth"):
@@ -199,16 +229,23 @@ def _make_handler(gate: Gatekeeper):
                 self.rfile.read(length).decode() if length else "")
             username = (form.get("username") or [""])[0]
             password = (form.get("password") or [""])[0]
+            rd = (form.get("rd") or [None])[0]
             if not username and \
                     gate.check_basic_header(self.headers.get("Authorization")):
                 token = gate.sessions.create()
             else:
                 token = gate.login(username, password)
             if token is None:
+                if rd is not None:  # browser form flow: back to the page
+                    loc = "/login?error=1&rd=" + \
+                        urllib.parse.quote(safe_redirect(rd), safe="")
+                    return self._send(303, b"", {"Location": loc})
                 return self._send(401, b"bad credentials")
-            return self._send(
-                200, b"ok",
-                {"Set-Cookie": f"{COOKIE_NAME}={token}; HttpOnly; "
-                               f"Path=/; Max-Age={int(gate.sessions.ttl_s)}"})
+            cookie = (f"{COOKIE_NAME}={token}; HttpOnly; Path=/; "
+                      f"Max-Age={int(gate.sessions.ttl_s)}")
+            if rd is not None:  # browser form flow: back to where they were
+                return self._send(303, b"", {"Location": safe_redirect(rd),
+                                             "Set-Cookie": cookie})
+            return self._send(200, b"ok", {"Set-Cookie": cookie})
 
     return Handler

@@ -31,6 +31,7 @@ import hmac
 import json
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler
@@ -174,14 +175,32 @@ class Route:
     upstream: str                      # host:port
 
 
+class _PassThrough(urllib.request.HTTPErrorProcessor):
+    """Return every upstream response verbatim: a proxy must relay 3xx/4xx
+    to the client, not chase redirects or raise (urllib's default would
+    follow an upstream 303 and return the wrong resource)."""
+
+    def http_response(self, request, response):
+        return response
+
+    https_response = http_response
+
+
+_PROXY_OPENER = urllib.request.build_opener(_PassThrough)
+
+
 class AuthIngress(ThreadedServer):
     """Authenticate-then-proxy. Longest-prefix route table, identity
-    header injection, hop-header hygiene."""
+    header injection, hop-header hygiene. ``public_prefixes`` name paths
+    that skip the auth check (the login page itself — otherwise the
+    302-to-login loops through the authenticator forever)."""
 
     def __init__(self, authenticator, routes: list[Route],
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 public_prefixes: tuple = ()):
         self.authenticator = authenticator
         self.routes = sorted(routes, key=lambda r: -len(r.prefix))
+        self.public_prefixes = tuple(public_prefixes)
         ingress = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -202,8 +221,13 @@ class AuthIngress(ThreadedServer):
 
             def _deny(self, decision: AuthDecision):
                 if decision.redirect:
+                    # carry the original destination so the login page can
+                    # send the browser back after auth (kflogin rd param)
+                    sep = "&" if "?" in decision.redirect else "?"
+                    loc = (decision.redirect + sep + "rd=" +
+                           urllib.parse.quote(self.path, safe=""))
                     self.send_response(302)
-                    self.send_header("Location", decision.redirect)
+                    self.send_header("Location", loc)
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                 else:
@@ -225,7 +249,10 @@ class AuthIngress(ThreadedServer):
                     self.wfile.write(body)
                     self.close_connection = True
                     return
-                decision = ingress.authenticator.check(self.headers)
+                if ingress.is_public(self.path):
+                    decision = AuthDecision(True)
+                else:
+                    decision = ingress.authenticator.check(self.headers)
                 if not decision.ok:
                     self._deny(decision)
                     return
@@ -254,7 +281,7 @@ class AuthIngress(ThreadedServer):
                     req.add_header(IAP_EMAIL_HEADER,
                                    f"accounts.google.com:{decision.identity}")
                 try:
-                    with urllib.request.urlopen(req, timeout=30) as resp:
+                    with _PROXY_OPENER.open(req, timeout=30) as resp:
                         data = resp.read()
                         self.send_response(resp.status)
                         for name, value in resp.headers.items():
@@ -297,6 +324,28 @@ class AuthIngress(ThreadedServer):
                 return route
         return None
 
+    def is_public(self, path: str) -> bool:
+        bare = path.split("?", 1)[0]
+        return any(bare == p or bare.startswith(p.rstrip("/") + "/")
+                   for p in self.public_prefixes)
+
+
+def build_ext_authz_ingress(cfg: dict, host: str = "127.0.0.1",
+                            port: int = 0) -> AuthIngress:
+    """Wire the basic-auth flavor: every request checked against the
+    gatekeeper's /auth, EXCEPT the login/logout pages, which proxy to the
+    gatekeeper itself unauthenticated so the browser can actually log in
+    (the ambassador kflogin-mapping shape). Used by main() and tests."""
+    login_path = cfg.get("login_path", "/login")
+    auth_url = cfg["auth_url"]
+    gate_upstream = urllib.parse.urlsplit(auth_url).netloc
+    routes = [Route("/", cfg["upstream"]),
+              Route(login_path, gate_upstream),
+              Route("/logout", gate_upstream)]
+    auth = ExtAuthzVerifier(auth_url=auth_url, login_path=login_path)
+    return AuthIngress(auth, routes, host=host, port=port,
+                       public_prefixes=(login_path, "/logout"))
+
 
 # -- pod entrypoint ----------------------------------------------------------
 
@@ -330,17 +379,17 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     cfg = _read_config_dir(args.config_dir)
-    routes = [Route("/", cfg["upstream"])]
     if args.mode == "iap":
         key_file = args.key_file or "/etc/iap-key/key"
         with open(key_file) as f:
             key = f.read().strip()
         auth = JwtVerifier(key=key, audience=cfg.get("audience") or None,
                            issuer=cfg.get("issuer", DEFAULT_ISSUER))
+        ingress = AuthIngress(auth, [Route("/", cfg["upstream"])],
+                              host=args.host, port=args.port)
     else:
-        auth = ExtAuthzVerifier(auth_url=cfg["auth_url"],
-                                login_path=cfg.get("login_path", "/login"))
-    ingress = AuthIngress(auth, routes, host=args.host, port=args.port)
+        ingress = build_ext_authz_ingress(cfg, host=args.host,
+                                          port=args.port)
     ingress.start()
     stop = {"flag": False}
     signal.signal(signal.SIGTERM, lambda *a: stop.update(flag=True))

@@ -432,7 +432,7 @@ def benchmark_matrix(out_dir: str, *, steps: int = 5, global_batch: int = 16,
 
 def main(argv: Optional[list[str]] = None) -> int:
     import argparse
-    logging.basicConfig(level=logging.INFO)
+    logging.basicConfig(level=logging.INFO, force=True)
     p = argparse.ArgumentParser(description="kubebench step entrypoint")
     p.add_argument("step", choices=["configure", "report", "matrix"])
     p.add_argument("--out-dir", default="bench-matrix",

@@ -18,6 +18,7 @@ from kubeflow_tpu.webapps.gatekeeper import Gatekeeper, GatekeeperServer
 from kubeflow_tpu.webapps.ingress import (AuthIngress, ExtAuthzVerifier,
                                           IAP_EMAIL_HEADER, IAP_JWT_HEADER,
                                           JwtError, JwtVerifier, Route,
+                                          build_ext_authz_ingress,
                                           jwt_encode, jwt_verify)
 
 KEY = "cluster-secret"
@@ -175,10 +176,11 @@ class TestBasicAuthIngress:
 
     @pytest.fixture
     def ingress(self, echo, gate):
-        ing = AuthIngress(
-            ExtAuthzVerifier(
-                auth_url=f"http://127.0.0.1:{gate.port}/auth"),
-            [Route("/", f"127.0.0.1:{echo.port}")])
+        # the production ext-authz wiring (what main() builds from the
+        # mounted ConfigMap): login/logout proxy to the gatekeeper, public
+        ing = build_ext_authz_ingress(
+            {"upstream": f"127.0.0.1:{echo.port}",
+             "auth_url": f"http://127.0.0.1:{gate.port}/auth"})
         ing.start()
         yield ing
         ing.stop()
@@ -192,7 +194,8 @@ class TestBasicAuthIngress:
         except urllib.error.HTTPError as e:
             status, headers = e.code, dict(e.headers)
         assert status == 302
-        assert headers["Location"] == "/login"
+        # original destination rides along so login can send the browser back
+        assert headers["Location"] == "/login?rd=%2Fapp"
 
     def test_basic_header_routes(self, ingress):
         import base64
@@ -215,6 +218,56 @@ class TestBasicAuthIngress:
                                {"Cookie": cookie})
         assert status == 200
         assert json.loads(body)["path"] == "/app"
+
+    def test_full_browser_flow_through_ingress(self, ingress):
+        """Every hop rides the ingress itself: 302 to login, login page
+        served (public path → gatekeeper route), form POST sets the
+        session cookie and 303s back, original page loads."""
+        base = f"http://127.0.0.1:{ingress.port}"
+
+        class NoRedirect(urllib.request.HTTPErrorProcessor):
+            def http_response(self, request, response):
+                return response
+        opener = urllib.request.build_opener(NoRedirect)
+        # 1. protected page → redirect carrying the destination
+        with opener.open(f"{base}/app", timeout=10) as resp:
+            assert resp.status == 302
+            loc = resp.headers["Location"]
+        assert loc == "/login?rd=%2Fapp"
+        # 2. the login page is reachable THROUGH the ingress (no auth loop)
+        with opener.open(base + loc, timeout=10) as resp:
+            assert resp.status == 200
+            page = resp.read().decode()
+        assert 'value="/app"' in page
+        # 3. posting the form through the ingress logs in and redirects back
+        req = urllib.request.Request(
+            f"{base}/login", data=b"username=admin&password=pw&rd=%2Fapp")
+        with opener.open(req, timeout=10) as resp:
+            assert resp.status == 303
+            assert resp.headers["Location"] == "/app"
+            cookie = resp.headers["Set-Cookie"].split(";")[0]
+        # 4. the destination now loads with the session cookie
+        status, body, _ = _get(f"{base}/app", {"Cookie": cookie})
+        assert status == 200
+        assert json.loads(body)["path"] == "/app"
+
+    def test_logout_reachable_and_revokes(self, ingress):
+        import base64
+        cred = base64.b64encode(b"admin:pw").decode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{ingress.port}/login", data=b"",
+            headers={"Authorization": f"Basic {cred}"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            cookie = resp.headers["Set-Cookie"].split(";")[0]
+        _get(f"http://127.0.0.1:{ingress.port}/logout", {"Cookie": cookie})
+        req = urllib.request.Request(f"http://127.0.0.1:{ingress.port}/app",
+                                     headers={"Cookie": cookie})
+        opener = urllib.request.build_opener(_NoRedirect)
+        try:
+            status = opener.open(req, timeout=10).status
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 302  # session gone → back to login
 
     def test_bad_credentials_denied(self, ingress):
         import base64

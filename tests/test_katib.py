@@ -323,6 +323,29 @@ class TestStudyJobController:
         assert k8s.condition_true(study, "Succeeded"), study.get("status")
         assert study["status"]["bestTrial"]["objective"] == 0.91
 
+    def test_example_prototype_end_to_end(self, env):
+        """The shipped katib-studyjob-example prototype runs to completion
+        unmodified through the real controllers (SURVEY §2.3 hard part d:
+        katib works against the TPU replica spec)."""
+        from kubeflow_tpu.manifests import build_component
+        cluster, mgr, vizier = env
+        study_manifest = build_component(
+            "katib-studyjob-example",
+            {"namespace": "kubeflow", "name": "study",
+             "max_trials": 4, "request_number": 2})[0]
+        cluster.create(study_manifest)
+        study = run_trials_to_completion(
+            cluster, mgr, vizier, objective_fn=lambda lr: 0.9)
+        assert k8s.condition_true(study, "Succeeded"), study.get("status")
+        assert study["status"]["trialsTotal"] == 4
+        best = study["status"]["bestTrial"]["name"]
+        job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob", "kubeflow",
+                          best)
+        args = job["spec"]["replicaSpecs"]["TPU"]["template"]["spec"][
+            "containers"][0]["args"]
+        assert any(a.startswith("--learning-rate=") for a in args)
+        assert any(a.startswith("--global-batch=") for a in args)
+
     def test_missing_worker_template_fails_study(self, env):
         cluster, mgr, _ = env
         m = studyjob_manifest()

@@ -137,6 +137,25 @@ class TestGoldenManifests:
         parsed = TrainingJob.from_manifest(job)  # example must be admissible
         assert parsed.tpu_spec.topology.name == "v5e-32"
 
+    def test_tpu_serving_simple_example(self):
+        """tf-serving-simple analog: smallest useful serving instance."""
+        objs = build_component("tpu-serving-simple")
+        dep = next(o for o in objs if o["kind"] == "Deployment")
+        containers = dep["spec"]["template"]["spec"]["containers"]
+        assert "--model-name=mnist" in containers[0]["args"]
+        assert any(c["name"] == "http-proxy" for c in containers)
+
+    def test_katib_studyjob_example_schema(self):
+        """katib-studyjob-test analog: StudyJob sweeping the TPUJob."""
+        study = build_component("katib-studyjob-example")[0]
+        spec = study["spec"]
+        assert spec["suggestionSpec"]["suggestionAlgorithm"] == "random"
+        assert {p["name"] for p in spec["parameterconfigs"]} == {
+            "--learning-rate", "--global-batch"}
+        tmpl = spec["workerSpec"]["template"]
+        assert tmpl["kind"] == "TPUJob"
+        assert tmpl["spec"]["replicaSpecs"]["TPU"]["tpuTopology"] == "v5e-8"
+
     def test_webhook_targets_pods(self):
         objs = build_component("admission-webhook")
         wh = next(o for o in objs

@@ -181,3 +181,30 @@ class TestFusedBlock:
         got = R.fused_eval_apply(variables, x)
         np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
         assert (got.argmax(-1) == want.argmax(-1)).all()
+
+
+class TestResNetFamily:
+    """The tf_cnn_benchmarks --model family surface: resnet{18,34,50,101,152}
+    as workloads and servable types, BasicBlock path included."""
+
+    def test_basic_block_depth_forward(self):
+        from kubeflow_tpu.models import resnet as R
+        model = R.resnet18(num_classes=7)
+        v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
+                       train=False)
+        out = model.apply(v, jnp.zeros((2, 32, 32, 3)), train=False)
+        assert out.shape == (2, 7)
+
+    def test_unsupported_depth_rejected(self):
+        from kubeflow_tpu.models import resnet as R
+        with pytest.raises(ValueError, match="depth"):
+            R.make_resnet(77)
+
+    def test_registries_cover_family(self):
+        from kubeflow_tpu.models import RESNET_DEPTHS
+        from kubeflow_tpu.runtime.worker import WORKLOADS, _IMAGE_WORKLOADS
+        from kubeflow_tpu.serving.servable import _MODEL_BUILDERS
+        family = {f"resnet{d}" for d in RESNET_DEPTHS}
+        assert family <= set(WORKLOADS)
+        assert family <= _IMAGE_WORKLOADS
+        assert family <= set(_MODEL_BUILDERS)

@@ -379,6 +379,53 @@ class TestGatekeeper:
         finally:
             server.stop()
 
+    def test_login_redirects_back_with_rd(self):
+        """kflogin browser flow: rd param rides the form, success 303s
+        back to the original destination, failure 303s to the error page."""
+        server = GatekeeperServer(Gatekeeper(username="u", password="p"))
+        port = server.start()
+        base = f"http://127.0.0.1:{port}"
+
+        class NoRedirect(urllib.request.HTTPErrorProcessor):
+            def http_response(self, request, response):
+                return response
+        opener = urllib.request.build_opener(NoRedirect)
+        try:
+            # the login page embeds the rd and shows the error banner
+            with opener.open(f"{base}/login?rd=%2Fnotebooks&error=1") as r:
+                page = r.read().decode()
+            assert 'value="/notebooks"' in page
+            assert "Invalid username or password" in page
+            # good credentials: 303 to rd with the session cookie
+            req = urllib.request.Request(
+                f"{base}/login", data=b"username=u&password=p&rd=%2Fapp")
+            with opener.open(req) as resp:
+                assert resp.status == 303
+                assert resp.headers["Location"] == "/app"
+                assert "kubeflow-session" in resp.headers["Set-Cookie"]
+            # bad credentials: 303 back to the form with error flag
+            req = urllib.request.Request(
+                f"{base}/login", data=b"username=u&password=no&rd=%2Fapp")
+            with opener.open(req) as resp:
+                assert resp.status == 303
+                assert resp.headers["Location"] == "/login?error=1&rd=%2Fapp"
+        finally:
+            server.stop()
+
+    def test_open_redirect_clamped(self):
+        from kubeflow_tpu.webapps.gatekeeper import safe_redirect
+        assert safe_redirect("/ok/path") == "/ok/path"
+        assert safe_redirect("//evil.com/x") == "/"
+        assert safe_redirect("http://evil.com") == "/"
+        assert safe_redirect(None) == "/"
+        assert safe_redirect("relative") == "/"
+        # browsers fold \ into / — '/\evil.com' would become //evil.com
+        assert safe_redirect("/\\evil.com") == "/"
+        assert safe_redirect("/a\\b") == "/"
+        # CR/LF would splice raw headers into the 303 (response splitting)
+        assert safe_redirect("/a\r\nSet-Cookie: evil=1") == "/"
+        assert safe_redirect("/a%0d%0ax") == "/a%0d%0ax"  # encoded is inert
+
 
 class TestAccessManagement:
     """KFAM Binding grant API (SURVEY §2.6 access-management swagger):

@@ -241,6 +241,8 @@ class TrainingJob:
     # dataset shard dir (rendered as KFTPU_DATA_DIR; the launcher.py
     # --data_dir analog) — workers train on real records when set
     data_dir: str = ""
+    # held-out shard dir for the eval pass (KFTPU_EVAL_DATA_DIR)
+    eval_data_dir: str = ""
     raw: dict = field(default_factory=dict)
 
     # -- constructors -------------------------------------------------------
@@ -292,6 +294,7 @@ class TrainingJob:
             checkpoint_dir=spec.get("checkpointDir", "") or "",
             resume_from=spec.get("resumeFrom", "") or "",
             data_dir=spec.get("dataDir", "") or "",
+            eval_data_dir=spec.get("evalDataDir", "") or "",
             raw=obj,
         )
         job.validate()
@@ -381,6 +384,8 @@ class TrainingJob:
             out["spec"]["resumeFrom"] = self.resume_from
         if self.data_dir:
             out["spec"]["dataDir"] = self.data_dir
+        if self.eval_data_dir:
+            out["spec"]["evalDataDir"] = self.eval_data_dir
         if self.raw:
             out["apiVersion"] = self.raw.get("apiVersion", out["apiVersion"])
             meta = dict(self.raw.get("metadata", {}))

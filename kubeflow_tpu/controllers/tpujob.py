@@ -202,6 +202,8 @@ class TrainingJobReconciler(Reconciler):
             env["KFTPU_RESUME_FROM"] = job.resume_from
         if job.data_dir:
             env["KFTPU_DATA_DIR"] = job.data_dir
+        if job.eval_data_dir:
+            env["KFTPU_EVAL_DATA_DIR"] = job.eval_data_dir
         if env:
             self._add_env(pod, env)
         return pod

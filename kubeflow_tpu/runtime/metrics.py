@@ -90,6 +90,16 @@ class MetricsLogger:
                      scalars)
         return stats
 
+    def event(self, step: int, metrics: dict) -> None:
+        """Stream an out-of-band record (eval results, checkpoints) to the
+        JSONL without touching the timing history."""
+        if self._fh:
+            self._fh.write(json.dumps(
+                {"step": step, "event": True,
+                 "metrics": {k: float(v) for k, v in metrics.items()}})
+                + "\n")
+            self._fh.flush()
+
     def summary(self, warmup: int = 1) -> dict[str, float]:
         """Steady-state throughput, skipping compile/warmup records.
         Window records are weighted by the number of steps they cover."""

@@ -139,6 +139,18 @@ class TrainStepBuilder:
             )
         return fn
 
+    def build_eval(self, eval_fn: Callable[[PyTree, PyTree, PyTree], dict]
+                   ) -> Callable[["TrainState", PyTree], dict]:
+        """Jitted eval step: (state, batch) → metrics. No donation (the
+        state lives on), same mesh/shardings as the train step — metrics
+        come back replicated scalars."""
+
+        def step(state: "TrainState", batch: PyTree) -> dict:
+            return eval_fn(state.params, state.variables, batch)
+
+        with self.mesh:
+            return jax.jit(step)
+
     def place_batch(self, batch: PyTree) -> PyTree:
         """Shard a host batch onto the mesh (batch dim over data axes;
         sequence dim over the sequence axis for rank-2 token arrays)."""

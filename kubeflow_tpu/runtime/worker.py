@@ -23,10 +23,10 @@ from dataclasses import dataclass, replace
 from typing import Callable, Optional
 
 import jax
-import optax
 
 from ..models import RESNET_DEPTHS
 from .bootstrap import WorkerContext, initialize
+from .recipe import make_optimizer, scale_lr
 from .checkpoint import CheckpointManager, HAVE_ORBAX
 from .metrics import METRICS_PATH_ENV, MetricsLogger, profile_trace
 from .trainstep import TrainStepBuilder
@@ -44,18 +44,21 @@ class WorkloadSpec:
     batch_fn: Callable                     # (rng, batch_size) -> batch pytree
     rules: Optional[object] = None         # LogicalRules
     param_logical_axes: Optional[object] = None
+    eval_fn: Optional[Callable] = None     # (params, vars, batch) -> metrics
 
 
 def _resnet_spec(image_size: int = 224, num_classes: int = 1000,
-                 depth: int = 50) -> WorkloadSpec:
+                 depth: int = 50,
+                 label_smoothing: float = 0.0) -> WorkloadSpec:
     from ..models import resnet as R
     model = R.make_resnet(depth, num_classes=num_classes)
     return WorkloadSpec(
         name=f"resnet{depth}",
         init_fn=R.init_fn(model, image_size=image_size),
-        loss_fn=R.make_loss_fn(model),
+        loss_fn=R.make_loss_fn(model, label_smoothing=label_smoothing),
         batch_fn=lambda rng, bs: R.synthetic_batch(
             rng, bs, image_size, num_classes),
+        eval_fn=R.make_eval_fn(model),
     )
 
 
@@ -110,6 +113,16 @@ def train(
     seed: int = 0,
     sync_every: int = 10,
     data_dir: Optional[str] = None,
+    optimizer: str = "momentum",
+    lr_schedule: str = "constant",
+    warmup_steps: int = 0,
+    weight_decay: float = 0.0,
+    momentum: float = 0.9,
+    label_smoothing: float = 0.0,
+    scale_lr_by_batch: bool = False,
+    eval_every: int = 0,
+    eval_batches: int = 8,
+    eval_data_dir: Optional[str] = None,
 ) -> TrainResult:
     ctx = ctx or initialize()
     workload_kwargs = dict(workload_kwargs or {})
@@ -134,6 +147,8 @@ def train(
         workload_kwargs.setdefault("image_size", data_source.image_size)
         workload_kwargs.setdefault("num_classes", data_source.num_classes)
 
+    if label_smoothing and workload in _IMAGE_WORKLOADS:
+        workload_kwargs.setdefault("label_smoothing", label_smoothing)
     spec = WORKLOADS[workload](**workload_kwargs)
     if data_source is not None:
         from ..data.imagenet import device_normalize
@@ -148,12 +163,14 @@ def train(
     log.info("worker %d/%d mesh=%s workload=%s", ctx.process_id,
              ctx.num_processes, dict(ctx.mesh.shape), spec.name)
 
-    optimizer = optax.chain(
-        optax.clip_by_global_norm(1.0),
-        optax.sgd(learning_rate, momentum=0.9),
-    )
+    base_lr = scale_lr(learning_rate, global_batch) if scale_lr_by_batch \
+        else learning_rate
+    opt, lr_fn = make_optimizer(
+        optimizer, base_lr, schedule=lr_schedule, total_steps=steps,
+        warmup_steps=warmup_steps, weight_decay=weight_decay,
+        momentum=momentum)
     builder = TrainStepBuilder(
-        mesh=ctx.mesh, loss_fn=spec.loss_fn, optimizer=optimizer,
+        mesh=ctx.mesh, loss_fn=spec.loss_fn, optimizer=opt,
         rules=spec.rules, param_logical_axes=spec.param_logical_axes)
 
     rng = jax.random.PRNGKey(seed)
@@ -185,6 +202,45 @@ def train(
             src.close()
 
     step_fn = builder.build()
+
+    # -- eval pass (running-stats forward, top-1/top-5) ---------------------
+    eval_data_dir = eval_data_dir or os.environ.get("KFTPU_EVAL_DATA_DIR")
+    eval_step = None
+    eval_source = None
+    if eval_every and spec.eval_fn is not None:
+        eval_step = builder.build_eval(spec.eval_fn)
+        if eval_data_dir:
+            from ..data.imagenet import ImageNetSource
+            # validation reads: no augmentation, normalized on host (eval
+            # is off the hot path, simplicity over transfer bytes)
+            eval_source = ImageNetSource(eval_data_dir,
+                                         batch_size=global_batch,
+                                         augment=False)
+
+    def run_eval(state) -> dict:
+        """Average spec.eval_fn over eval_batches batches: ONE pass over
+        held-out shards when --eval-data-dir is set (never resampled —
+        a small holdout caps the batch count), a fixed synthetic stream
+        otherwise."""
+        if eval_source is not None:
+            eval_iter = eval_source.epoch(0, seed + 2)
+            n_batches = min(eval_batches, eval_source.num_batches)
+            next_batch = lambda i: next(eval_iter)  # noqa: E731
+        else:
+            n_batches = eval_batches
+            next_batch = lambda i: spec.batch_fn(  # noqa: E731
+                jax.random.fold_in(jax.random.PRNGKey(seed + 2), i),
+                global_batch)
+        totals: dict = {}
+        n = 0
+        for i in range(n_batches):
+            eb = builder.place_batch(next_batch(i))
+            em = eval_step(state, eb)
+            for k, v in em.items():
+                totals[k] = totals.get(k, 0.0) + float(v)
+            n += 1
+        return {k: v / n for k, v in totals.items()} if n else {}
+
     # kubebench injects KFTPU_METRICS_PATH so the reporter can aggregate
     # this run's per-step stream (workflows/kubebench.py report_from_metrics)
     metrics_path = metrics_path or os.environ.get(METRICS_PATH_ENV)
@@ -233,14 +289,27 @@ def train(
                 # checkpoint saves are their own sync point (orbax fetches
                 # the state), so close the timing window first
                 will_ckpt = ckpt is not None and ckpt.should_save(step + 1)
+                will_eval = eval_step is not None and (
+                    (step + 1) % eval_every == 0 or step + 1 == steps)
                 closed = window >= sync_every or step + 1 == steps \
-                    or will_ckpt
+                    or will_ckpt or will_eval
                 if closed:
                     last_metrics = {k: float(v) for k, v in metrics.items()}
+                    last_metrics["learning_rate"] = float(lr_fn(step))
                     mlog.end_window(step + 1, window, last_metrics)
                     window = 0
                 if ckpt is not None:
                     ckpt.save(step + 1, state)
+                if will_eval:
+                    # the window closed above, so eval wall-time is never
+                    # charged to throughput; forward-only pass, results
+                    # ride the metric stream
+                    em = run_eval(state)
+                    if em:
+                        last_metrics.update(em)
+                        mlog.event(step + 1, em)
+                        log.info("eval @%d: %s", step + 1,
+                                 {k: round(v, 4) for k, v in em.items()})
                 if closed:
                     # restart the timer only after the save: orbax fetches
                     # the device state synchronously, and that must not be
@@ -251,6 +320,8 @@ def train(
         # is called repeatedly in-process by katib studies and benchmarks)
         if data_source is not None:
             data_source.close()
+        if eval_source is not None:
+            eval_source.close()
     if ckpt is not None:
         ckpt.wait()
         ckpt.close()
@@ -302,6 +373,22 @@ def main(argv=None) -> int:
                         "$KFTPU_DATA_DIR); synthetic data when unset")
     p.add_argument("--num-microbatches", type=int, default=4,
                    help="GPipe microbatches (pipelined workloads)")
+    # training recipe (the tf_cnn_benchmarks flag surface, runtime/recipe.py)
+    from .recipe import OPTIMIZERS, SCHEDULES
+    p.add_argument("--optimizer", default="momentum", choices=OPTIMIZERS)
+    p.add_argument("--lr-schedule", default="constant", choices=SCHEDULES)
+    p.add_argument("--warmup-steps", type=int, default=0)
+    p.add_argument("--weight-decay", type=float, default=0.0)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--label-smoothing", type=float, default=0.0)
+    p.add_argument("--scale-lr-by-batch", action="store_true",
+                   help="linear-scaling rule: lr *= global_batch/256")
+    p.add_argument("--eval-every", type=int, default=0,
+                   help="run the eval pass every N steps (0 = off)")
+    p.add_argument("--eval-batches", type=int, default=8)
+    p.add_argument("--eval-data-dir",
+                   help="held-out shard dir (defaults to "
+                        "$KFTPU_EVAL_DATA_DIR); synthetic eval when unset")
     args = p.parse_args(argv)
     workload_kwargs = {}
     if args.workload in _MESH_AWARE_WORKLOADS:
@@ -314,7 +401,13 @@ def main(argv=None) -> int:
         resume_from=args.resume_from,
         metrics_path=args.metrics_path, profile_dir=args.profile_dir,
         workload_kwargs=workload_kwargs, sync_every=args.sync_every,
-        data_dir=args.data_dir)
+        data_dir=args.data_dir,
+        optimizer=args.optimizer, lr_schedule=args.lr_schedule,
+        warmup_steps=args.warmup_steps, weight_decay=args.weight_decay,
+        momentum=args.momentum, label_smoothing=args.label_smoothing,
+        scale_lr_by_batch=args.scale_lr_by_batch,
+        eval_every=args.eval_every, eval_batches=args.eval_batches,
+        eval_data_dir=args.eval_data_dir)
     log.info("done: %d steps, %.1f examples/sec", result.steps,
              result.examples_per_sec)
     return 0

@@ -291,12 +291,6 @@ class AuthIngress(ThreadedServer):
                         self.send_header("Content-Length", str(len(data)))
                         self.end_headers()
                         self.wfile.write(data)
-                except urllib.error.HTTPError as e:
-                    data = e.read()
-                    self.send_response(e.code)
-                    self.send_header("Content-Length", str(len(data)))
-                    self.end_headers()
-                    self.wfile.write(data)
                 except OSError as e:
                     data = json.dumps({"error": f"upstream: {e}"}).encode()
                     self.send_response(502)

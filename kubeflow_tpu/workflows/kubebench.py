@@ -300,6 +300,16 @@ def report_from_metrics(metrics_path: str, *, job_kind: str = "TPUJob",
                 rows.append(json.loads(line))
     if not rows:
         raise ValueError(f"no step records in {metrics_path}")
+    # out-of-band event records (eval passes) carry no timing; fold their
+    # metrics into the nearest preceding step record and drop the row
+    events = [r for r in rows if r.get("event")]
+    rows = [r for r in rows if not r.get("event")]
+    if not rows:
+        raise ValueError(f"no timed step records in {metrics_path}")
+    for ev in events:
+        tgt = max((r for r in rows if r["step"] <= ev.get("step", 0)),
+                  key=lambda r: r["step"], default=rows[-1])
+        tgt.setdefault("metrics", {}).update(ev.get("metrics") or {})
     steady = rows[warmup:] if len(rows) > warmup else rows
     # records may be multi-step windows (worker sync_every): weight by the
     # number of device steps each record covers

@@ -161,6 +161,44 @@ class TestOperatorDataDir:
         assert job.data_dir == "/data/imagenet"
         assert job.to_manifest()["spec"]["dataDir"] == "/data/imagenet"
 
+    def test_eval_data_dir_rendered_as_env(self):
+        from kubeflow_tpu.api.trainingjob import TrainingJob
+        from kubeflow_tpu.cluster import FakeCluster
+        from kubeflow_tpu.controllers.runtime import Manager
+        from kubeflow_tpu.controllers.tpujob import TrainingJobReconciler
+        cluster = FakeCluster(auto_schedule=False, auto_run=False)
+        cluster.add_tpu_slice_nodes("v5e-8")
+        mgr = Manager(cluster)
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        cluster.create({
+            "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": "j", "namespace": "default"},
+            "spec": {
+                "dataDir": "/data/train", "evalDataDir": "/data/val",
+                "replicaSpecs": {"TPU": {
+                    "tpuTopology": "v5e-8",
+                    "template": {"spec": {"containers": [
+                        {"name": "worker", "image": "x"}]}}}},
+            },
+        })
+        mgr.run_pending()
+        pods = cluster.list("v1", "Pod", "default")
+        assert pods
+        env = {e["name"]: e["value"]
+               for c in pods[0]["spec"]["containers"]
+               for e in c.get("env", [])}
+        assert env["KFTPU_DATA_DIR"] == "/data/train"
+        assert env["KFTPU_EVAL_DATA_DIR"] == "/data/val"
+
+    def test_worker_eval_on_holdout_shards(self, data_dir):
+        d, *_ = data_dir
+        from kubeflow_tpu.runtime.worker import train
+        r = train(workload="resnet50", steps=2, global_batch=8,
+                  data_dir=d, eval_data_dir=d, eval_every=2,
+                  eval_batches=2, sync_every=1, seed=5)
+        assert "top1" in r.final_metrics and "top5" in r.final_metrics
+        assert 0.0 <= r.final_metrics["top1"] <= 1.0
+
 
 class TestBenchmarkMatrix:
     def test_matrix_produces_csv_per_config(self, tmp_path):

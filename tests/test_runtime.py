@@ -307,3 +307,70 @@ class TestCheckpoint:
         r2 = train(workload="transformer", steps=5, global_batch=8,
                    resume_from=env_map["KFTPU_RESUME_FROM"])
         assert r2.steps == 2
+
+
+class TestRecipe:
+    """Training recipes (runtime/recipe.py): the tf_cnn_benchmarks flag
+    surface — schedules, weight decay masking, eval pass."""
+
+    def test_warmup_cosine_shape(self):
+        from kubeflow_tpu.runtime.recipe import lr_schedule
+        s = lr_schedule("cosine", 0.4, total_steps=100, warmup_steps=10)
+        assert float(s(0)) == pytest.approx(0.0)
+        assert float(s(10)) == pytest.approx(0.4, rel=1e-3)
+        assert float(s(55)) < 0.4
+        assert float(s(99)) < 0.01
+
+    def test_step_decay_boundaries(self):
+        from kubeflow_tpu.runtime.recipe import lr_schedule
+        s = lr_schedule("step", 1.0, total_steps=90, warmup_steps=0)
+        assert float(s(0)) == pytest.approx(1.0)
+        assert float(s(31)) == pytest.approx(0.1)
+        assert float(s(61)) == pytest.approx(0.01)
+        assert float(s(85)) == pytest.approx(0.001)
+
+    def test_decay_mask_kernels_only(self):
+        import jax.numpy as jnp
+        from kubeflow_tpu.runtime.recipe import decay_mask
+        params = {"conv": {"kernel": jnp.zeros((3, 3, 4, 8))},
+                  "bn": {"scale": jnp.zeros((8,)), "bias": jnp.zeros((8,))},
+                  "head": {"kernel": jnp.zeros((8, 2)),
+                           "bias": jnp.zeros((2,))}}
+        m = decay_mask(params)
+        assert m["conv"]["kernel"] and m["head"]["kernel"]
+        assert not m["bn"]["scale"] and not m["bn"]["bias"]
+        assert not m["head"]["bias"]
+
+    def test_unknown_names_rejected(self):
+        from kubeflow_tpu.runtime.recipe import make_optimizer, lr_schedule
+        with pytest.raises(ValueError, match="optimizer"):
+            make_optimizer("sgdd", 0.1)
+        with pytest.raises(ValueError, match="schedule"):
+            lr_schedule("cosinee", 0.1, 10)
+
+    def test_worker_full_recipe_with_eval(self):
+        """The worker loop with the ImageNet-style recipe on a tiny
+        resnet18: schedules, decay, smoothing, and the top-1/top-5 eval
+        pass all under one run."""
+        from kubeflow_tpu.runtime.worker import train
+        r = train(workload="resnet18", steps=4, global_batch=16,
+                  learning_rate=0.1, sync_every=2,
+                  workload_kwargs={"image_size": 32, "num_classes": 10},
+                  optimizer="momentum", lr_schedule="cosine",
+                  warmup_steps=1, weight_decay=1e-4, label_smoothing=0.1,
+                  eval_every=2, eval_batches=2, seed=3)
+        assert r.steps == 4
+        for key in ("loss", "learning_rate", "top1", "top5", "eval_loss"):
+            assert key in r.final_metrics, r.final_metrics
+        assert 0.0 <= r.final_metrics["top1"] <= r.final_metrics["top5"] <= 1.0
+        import numpy as np
+        assert np.isfinite(r.final_metrics["loss"])
+
+    def test_label_smoothing_raises_floor(self):
+        import jax.numpy as jnp
+        from kubeflow_tpu.models.resnet import cross_entropy_loss
+        logits = jnp.array([[10.0, -10.0, -10.0]])
+        labels = jnp.array([0])
+        hard = float(cross_entropy_loss(logits, labels))
+        soft = float(cross_entropy_loss(logits, labels, 0.1))
+        assert soft > hard  # smoothing penalizes overconfidence

@@ -24,13 +24,15 @@ def tpu_serving(namespace: str = "kubeflow", name: str = "model-server",
                 model_path: str = "", model_name: str = "model",
                 tpu_topology: str = "v5e-1", num_replicas: int = 1,
                 enable_http_proxy: bool = True, enable_hpa: bool = False,
-                hpa_min: int = 1, hpa_max: int = 4) -> list[dict]:
+                hpa_min: int = 1, hpa_max: int = 4,
+                reload_interval_s: int = 30) -> list[dict]:
     lbl = {**H.std_labels(name), "kubeflow.org/servable": model_name}
     dep = H.deployment(
         name, namespace, f"{IMG}/tpu-model-server:{VERSION}",
         replicas=num_replicas,
         args=[f"--model-path={model_path}", f"--model-name={model_name}",
-              "--grpc-port=9000", "--rest-port=8500"],
+              "--grpc-port=9000", "--rest-port=8500",
+              f"--reload-interval={reload_interval_s}"],
         labels=lbl, port=9000)
     pod_spec = dep["spec"]["template"]["spec"]
     pod_spec["nodeSelector"] = {

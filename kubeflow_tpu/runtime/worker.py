@@ -210,24 +210,30 @@ def train(
     if eval_every and spec.eval_fn is not None:
         eval_step = builder.build_eval(spec.eval_fn)
         if eval_data_dir:
-            from ..data.imagenet import ImageNetSource
+            from ..data.imagenet import ImageNetSource, read_meta
             # validation reads: no augmentation, normalized on host (eval
-            # is off the hot path, simplicity over transfer bytes)
+            # is off the hot path, simplicity over transfer bytes). A
+            # holdout smaller than the (possibly huge) train batch must
+            # not kill the run — clamp the eval batch to the holdout
+            n_rec = int(read_meta(eval_data_dir)["num_records"])
             eval_source = ImageNetSource(eval_data_dir,
-                                         batch_size=global_batch,
+                                         batch_size=min(global_batch,
+                                                        max(n_rec, 1)),
                                          augment=False)
 
     def run_eval(state) -> dict:
-        """Average spec.eval_fn over eval_batches batches: ONE pass over
-        held-out shards when --eval-data-dir is set (never resampled —
-        a small holdout caps the batch count), a fixed synthetic stream
-        otherwise."""
+        """Average spec.eval_fn over at most ONE pass of the held-out
+        shards (never resampled). eval_batches caps the pass for cheap
+        mid-run checks; eval_batches=0 means the FULL holdout — what the
+        final acceptance number must be measured on (a subsample's
+        sampling error can flip a 76%-top-1 verdict)."""
         if eval_source is not None:
             eval_iter = eval_source.epoch(0, seed + 2)
-            n_batches = min(eval_batches, eval_source.num_batches)
+            n_batches = eval_source.num_batches if eval_batches <= 0 \
+                else min(eval_batches, eval_source.num_batches)
             next_batch = lambda i: next(eval_iter)  # noqa: E731
         else:
-            n_batches = eval_batches
+            n_batches = eval_batches if eval_batches > 0 else 8
             next_batch = lambda i: spec.batch_fn(  # noqa: E731
                 jax.random.fold_in(jax.random.PRNGKey(seed + 2), i),
                 global_batch)
@@ -385,7 +391,9 @@ def main(argv=None) -> int:
                    help="linear-scaling rule: lr *= global_batch/256")
     p.add_argument("--eval-every", type=int, default=0,
                    help="run the eval pass every N steps (0 = off)")
-    p.add_argument("--eval-batches", type=int, default=8)
+    p.add_argument("--eval-batches", type=int, default=8,
+                   help="batches per eval pass; 0 = the full holdout "
+                        "(use for the final acceptance number)")
     p.add_argument("--eval-data-dir",
                    help="held-out shard dir (defaults to "
                         "$KFTPU_EVAL_DATA_DIR); synthetic eval when unset")

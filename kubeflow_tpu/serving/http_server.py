@@ -221,11 +221,16 @@ def main(argv: Optional[list[str]] = None) -> int:
                    help="TF-Serving-compatible PredictionService port "
                         "(0 disables)")
     p.add_argument("--max-batch", type=int, default=64)
+    p.add_argument("--reload-interval", type=float, default=30.0,
+                   help="poll the model path for new checkpoint versions "
+                        "every N seconds (TF-Serving fs monitor; 0 = off)")
     args = p.parse_args(argv)
 
     repo = ModelRepository()
     repo.load(args.model_name, args.model_type,
               checkpoint_dir=args.model_path or None)
+    if args.model_path and args.reload_interval:
+        repo.start_polling(args.reload_interval)
     server = ModelServer(repo, port=args.rest_port,
                          max_batch=args.max_batch)
     port = server.start()

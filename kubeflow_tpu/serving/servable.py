@@ -18,9 +18,13 @@ from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Callable, Optional
 
+import logging
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 PyTree = Any
 # predict(params, batch_array) -> predictions array/pytree
@@ -89,6 +93,14 @@ class Servable:
             self._stats["predict_seconds"] += dt
         return jax.tree.map(lambda x: np.asarray(x)[:n], out)
 
+    def swap(self, params: PyTree, version: int) -> None:
+        """Hot-swap to a newer model version. In-flight predicts finish on
+        the old params (they captured the reference); the jit cache keys on
+        shapes, so no recompile when the new version matches."""
+        with self._lock:
+            self.params = params
+            self.version = version
+
     def metadata(self) -> dict:
         """TF-Serving /metadata analog (reference http-proxy
         server.py model-metadata handler)."""
@@ -117,7 +129,10 @@ class ModelRepository:
 
     def __init__(self):
         self._models: dict[str, Servable] = {}
+        self._sources: dict[str, str] = {}  # name → checkpoint dir
         self._lock = threading.Lock()
+        self._stop: Optional[threading.Event] = None
+        self._poll_thread: Optional[threading.Thread] = None
 
     def add(self, servable: Servable) -> None:
         with self._lock:
@@ -144,7 +159,59 @@ class ModelRepository:
         servable = Servable(name=name, predict_fn=predict_fn, params=params,
                             version=version, input_signature=signature)
         self.add(servable)
+        if checkpoint_dir:
+            with self._lock:
+                self._sources[name] = checkpoint_dir
         return servable
+
+    # -- hot version reload (the TF-Serving file-system monitor behavior:
+    # the server watches the model path and serves new versions as the
+    # trainer writes them, old version until the new one is ready) --------
+
+    def reload(self, name: str) -> bool:
+        """Swap in a newer checkpoint version if one landed; False when
+        already current or the model has no checkpoint source."""
+        servable = self.get(name)
+        with self._lock:
+            src = self._sources.get(name)
+        if not src:
+            return False
+        from ..runtime.checkpoint import CheckpointManager
+        mgr = CheckpointManager(src)
+        try:
+            step = mgr.latest_step()
+            if step is None or step <= servable.version:
+                return False
+            restored = mgr.restore({"params": servable.params})
+        finally:
+            mgr.close()
+        servable.swap(restored["params"], step)
+        log.info("model %s reloaded to version %d", name, step)
+        return True
+
+    def start_polling(self, interval_s: float = 30.0) -> None:
+        """Background version monitor over every checkpoint-backed model."""
+        if self._poll_thread is not None:
+            return
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.wait(interval_s):
+                for name in self.names():
+                    try:
+                        self.reload(name)
+                    except Exception as e:  # noqa: BLE001 — keep serving
+                        log.warning("reload %s failed: %s", name, e)
+
+        self._poll_thread = threading.Thread(target=loop, daemon=True,
+                                             name="model-version-poller")
+        self._poll_thread.start()
+
+    def stop_polling(self) -> None:
+        if self._poll_thread is not None:
+            self._stop.set()
+            self._poll_thread.join(timeout=5)
+            self._poll_thread = None
 
     def get(self, name: str) -> Servable:
         with self._lock:

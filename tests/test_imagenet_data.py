@@ -199,6 +199,20 @@ class TestOperatorDataDir:
         assert "top1" in r.final_metrics and "top5" in r.final_metrics
         assert 0.0 <= r.final_metrics["top1"] <= 1.0
 
+    def test_eval_holdout_smaller_than_batch_survives(self, data_dir,
+                                                      tmp_path):
+        """A train batch larger than the whole val set must clamp the eval
+        batch, not kill the run at startup; eval_batches=0 runs the full
+        holdout (one pass, every record counted once)."""
+        d, images, labels = data_dir
+        val = str(tmp_path / "val")
+        write_shards(val, images[:8], labels[:8], num_classes=CLASSES)
+        from kubeflow_tpu.runtime.worker import train
+        r = train(workload="resnet50", steps=1, global_batch=16,
+                  data_dir=d, eval_data_dir=val, eval_every=1,
+                  eval_batches=0, sync_every=1, seed=5)
+        assert "top1" in r.final_metrics
+
 
 class TestBenchmarkMatrix:
     def test_matrix_produces_csv_per_config(self, tmp_path):

@@ -66,6 +66,55 @@ def test_repository_checkpoint_roundtrip(tmp_path):
     np.testing.assert_allclose(out["y"], 3.0 * np.ones((2, 4)))
 
 
+def test_hot_reload_picks_up_new_version(tmp_path):
+    """TF-Serving fs-monitor behavior: the trainer writes a newer
+    checkpoint, the repository swaps it in; older/absent versions no-op."""
+    from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+    ckpt = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(ckpt)
+    mgr.save(1, {"params": {"w": jnp.full((4,), 2.0)}}, force=True)
+    mgr.wait()
+
+    repo = ModelRepository()
+    s = repo.load("double", "double", checkpoint_dir=ckpt)
+    assert s.version == 1
+    assert not repo.reload("double")  # nothing newer yet
+
+    mgr.save(5, {"params": {"w": jnp.full((4,), 10.0)}}, force=True)
+    mgr.wait()
+    mgr.close()
+    assert repo.reload("double")
+    assert s.version == 5
+    out = s.predict(np.ones((2, 4), np.float32))
+    np.testing.assert_allclose(out["y"], 10.0 * np.ones((2, 4)))
+    # no checkpoint source → reload is a no-op, not an error
+    repo2 = ModelRepository()
+    repo2.load("fresh", "double")
+    assert not repo2.reload("fresh")
+
+
+def test_polling_reloads_in_background(tmp_path):
+    import time as _time
+    from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+    ckpt = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(ckpt)
+    mgr.save(1, {"params": {"w": jnp.full((4,), 2.0)}}, force=True)
+    mgr.wait()
+    repo = ModelRepository()
+    s = repo.load("double", "double", checkpoint_dir=ckpt)
+    repo.start_polling(interval_s=0.05)
+    try:
+        mgr.save(9, {"params": {"w": jnp.full((4,), 4.0)}}, force=True)
+        mgr.wait()
+        mgr.close()
+        deadline = _time.time() + 10
+        while s.version != 9 and _time.time() < deadline:
+            _time.sleep(0.05)
+        assert s.version == 9
+    finally:
+        repo.stop_polling()
+
+
 def test_repository_unknown_model():
     repo = ModelRepository()
     with pytest.raises(KeyError):

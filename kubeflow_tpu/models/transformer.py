@@ -319,6 +319,22 @@ def make_loss_fn(model: TransformerLM) -> Callable:
     return loss_fn
 
 
+def make_eval_fn(model: TransformerLM) -> Callable:
+    """Held-out eval: next-token loss / perplexity / token accuracy (the
+    LM analog of the image eval pass's top-1/top-5)."""
+
+    def eval_fn(params, variables, batch):
+        tokens = batch["tokens"]
+        logits = model.apply({"params": params}, tokens)
+        loss, _ = next_token_loss(logits, tokens)
+        preds = jnp.argmax(logits[:, :-1], axis=-1)
+        return {"eval_loss": loss,
+                "eval_perplexity": jnp.exp(loss),
+                "eval_token_accuracy": jnp.mean(preds == tokens[:, 1:])}
+
+    return eval_fn
+
+
 def init_fn(model: TransformerLM, seq_len: int, batch: int = 2) -> Callable:
     def _init(rng):
         variables = model.init(
@@ -389,4 +405,5 @@ def workload_spec(cfg: Optional[TransformerConfig] = None,
                                                  cfg.vocab_size),
         rules=TRANSFORMER_RULES,
         param_logical_axes=logical_axes(abstract),
+        eval_fn=make_eval_fn(model),
     )

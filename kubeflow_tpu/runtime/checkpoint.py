@@ -70,5 +70,19 @@ class CheckpointManager:
             state_template)
         return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
 
+    def restore_params(self, step: Optional[int] = None) -> Any:
+        """Restore just the model params, template-free. The trainer writes
+        full TrainState pytrees; a server watching the directory only wants
+        params and has no opt_state template to offer — restore the raw
+        tree (orbax saves pytrees as nested dicts) and take its 'params'
+        subtree, or the whole tree for params-only checkpoints."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        raw = self._mgr.restore(step, args=ocp.args.StandardRestore())
+        if isinstance(raw, dict) and "params" in raw:
+            return raw["params"]
+        return raw
+
     def close(self) -> None:
         self._mgr.close()

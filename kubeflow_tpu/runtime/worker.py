@@ -95,6 +95,35 @@ class TrainResult:
     examples_per_sec: float
     mean_step_time_s: float
     final_metrics: dict
+    preempted: bool = False
+
+
+class PreemptionGuard:
+    """SIGTERM-aware stop flag: TPU slices get preempted (maintenance,
+    spot reclaim) with a grace period; Kubernetes delivers SIGTERM first.
+    The loop checks ``stop`` at step boundaries, forces a final checkpoint,
+    and exits cleanly so the gang restart resumes instead of replaying.
+    The reference leaned on restartPolicy alone (SURVEY §5 failure
+    handling) — losing up to checkpoint_every steps of work per restart."""
+
+    def __init__(self, install: bool = True):
+        self.stop = False
+        self._prev = None
+        if install:
+            import signal
+            import threading
+            if threading.current_thread() is threading.main_thread():
+                self._prev = signal.signal(signal.SIGTERM, self._on_term)
+
+    def _on_term(self, signum, frame):
+        log.warning("SIGTERM: finishing step, checkpointing, exiting")
+        self.stop = True
+
+    def uninstall(self) -> None:
+        if self._prev is not None:
+            import signal
+            signal.signal(signal.SIGTERM, self._prev)
+            self._prev = None
 
 
 def train(
@@ -123,6 +152,7 @@ def train(
     eval_every: int = 0,
     eval_batches: int = 8,
     eval_data_dir: Optional[str] = None,
+    handle_sigterm: bool = True,
 ) -> TrainResult:
     ctx = ctx or initialize()
     workload_kwargs = dict(workload_kwargs or {})
@@ -211,15 +241,28 @@ def train(
         eval_step = builder.build_eval(spec.eval_fn)
         if eval_data_dir:
             from ..data.imagenet import ImageNetSource, read_meta
+            from ..parallel.mesh import data_axes
             # validation reads: no augmentation, normalized on host (eval
             # is off the hot path, simplicity over transfer bytes). A
             # holdout smaller than the (possibly huge) train batch must
-            # not kill the run — clamp the eval batch to the holdout
+            # not kill the run — clamp the eval batch to the holdout,
+            # rounded down to a data-axis multiple (place_batch shards
+            # dim 0 over the data axes; a non-divisible batch won't place)
+            dp = 1
+            for ax in data_axes(ctx.mesh):
+                dp *= ctx.mesh.shape[ax]
             n_rec = int(read_meta(eval_data_dir)["num_records"])
-            eval_source = ImageNetSource(eval_data_dir,
-                                         batch_size=min(global_batch,
-                                                        max(n_rec, 1)),
-                                         augment=False)
+            eval_bs = (min(global_batch, n_rec) // dp) * dp
+            if eval_bs == 0:
+                log.warning(
+                    "eval disabled: holdout %s has %d records, fewer than "
+                    "the %d-way data-parallel mesh", eval_data_dir, n_rec,
+                    dp)
+                eval_step = None
+            else:
+                eval_source = ImageNetSource(eval_data_dir,
+                                             batch_size=eval_bs,
+                                             augment=False)
 
     def run_eval(state) -> dict:
         """Average spec.eval_fn over at most ONE pass of the held-out
@@ -245,7 +288,15 @@ def train(
             for k, v in em.items():
                 totals[k] = totals.get(k, 0.0) + float(v)
             n += 1
-        return {k: v / n for k, v in totals.items()} if n else {}
+        if not n:
+            return {}
+        out = {k: v / n for k, v in totals.items()}
+        if "eval_perplexity" in out and "eval_loss" in out:
+            # perplexity = exp(MEAN loss); a mean of per-batch exp(loss)
+            # is biased high (Jensen), so rederive from the averaged loss
+            import math
+            out["eval_perplexity"] = math.exp(out["eval_loss"])
+        return out
 
     # kubebench injects KFTPU_METRICS_PATH so the reporter can aggregate
     # this run's per-step stream (workflows/kubebench.py report_from_metrics)
@@ -275,6 +326,8 @@ def train(
 
     start_step = int(state.step)
     last_metrics: dict = {}
+    guard = PreemptionGuard(install=handle_sigterm)
+    preempted = False
     # Sync to the host only every `sync_every` steps: a per-step float()
     # fetch is a full device→host round trip that defeats async dispatch
     # (r2 verdict item). The window's wall-time is divided evenly over its
@@ -294,18 +347,31 @@ def train(
                 window += 1
                 # checkpoint saves are their own sync point (orbax fetches
                 # the state), so close the timing window first
+                # snapshot ONCE per iteration: SIGTERM between the save's
+                # force= evaluation and the break check must not exit
+                # without the forced checkpoint
+                stopping = guard.stop
                 will_ckpt = ckpt is not None and ckpt.should_save(step + 1)
                 will_eval = eval_step is not None and (
                     (step + 1) % eval_every == 0 or step + 1 == steps)
                 closed = window >= sync_every or step + 1 == steps \
-                    or will_ckpt or will_eval
+                    or will_ckpt or will_eval or stopping
                 if closed:
                     last_metrics = {k: float(v) for k, v in metrics.items()}
                     last_metrics["learning_rate"] = float(lr_fn(step))
                     mlog.end_window(step + 1, window, last_metrics)
                     window = 0
                 if ckpt is not None:
-                    ckpt.save(step + 1, state)
+                    # preemption and normal completion force the save
+                    # regardless of cadence: the final state must be
+                    # persisted (resume/serving read it), and under
+                    # preemption the grace period is the budget — resume
+                    # must lose 0 steps
+                    ckpt.save(step + 1, state,
+                              force=stopping or step + 1 == steps)
+                if stopping:
+                    preempted = True
+                    break
                 if will_eval:
                     # the window closed above, so eval wall-time is never
                     # charged to throughput; forward-only pass, results
@@ -328,6 +394,7 @@ def train(
             data_source.close()
         if eval_source is not None:
             eval_source.close()
+        guard.uninstall()
     if ckpt is not None:
         ckpt.wait()
         ckpt.close()
@@ -347,11 +414,15 @@ def train(
                                    step=summary["steps"])
         except Exception as e:  # noqa: BLE001 - reporting must not fail runs
             log.warning("observation report failed: %s", e)
+    if preempted:
+        log.warning("preempted at step %d; checkpoint saved, exiting "
+                    "cleanly for gang-restart resume", int(state.step))
     return TrainResult(
         steps=summary["steps"],
         examples_per_sec=summary["examples_per_sec"],
         mean_step_time_s=summary["mean_step_time_s"],
         final_metrics=last_metrics,
+        preempted=preempted,
     )
 
 

@@ -152,9 +152,13 @@ class ModelRepository:
             mgr = CheckpointManager(checkpoint_dir)
             step = mgr.latest_step()
             if step is not None:
-                restored = mgr.restore({"params": params})
-                params = restored["params"]
+                params = mgr.restore_params(step)
                 version = step
+            else:
+                # nothing written yet (server started before the trainer):
+                # version 0 so the trainer's FIRST checkpoint — possibly
+                # step 1 — is newer and gets picked up by reload
+                version = 0
             mgr.close()
         servable = Servable(name=name, predict_fn=predict_fn, params=params,
                             version=version, input_signature=signature)
@@ -182,10 +186,12 @@ class ModelRepository:
             step = mgr.latest_step()
             if step is None or step <= servable.version:
                 return False
-            restored = mgr.restore({"params": servable.params})
+            # template-free: the trainer writes full TrainState trees, the
+            # server only wants the params subtree
+            params = mgr.restore_params(step)
         finally:
             mgr.close()
-        servable.swap(restored["params"], step)
+        servable.swap(params, step)
         log.info("model %s reloaded to version %d", name, step)
         return True
 

@@ -7,6 +7,7 @@ import json
 import threading
 import urllib.request
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -91,6 +92,60 @@ def test_hot_reload_picks_up_new_version(tmp_path):
     repo2 = ModelRepository()
     repo2.load("fresh", "double")
     assert not repo2.reload("fresh")
+
+
+def test_reload_from_trainer_trainstate_checkpoint(tmp_path):
+    """The real watch flow: the TRAINER writes full TrainState checkpoints
+    (not params-only dicts); the server must extract the params subtree."""
+    from kubeflow_tpu.runtime.worker import train
+    ckpt = str(tmp_path / "ckpt")
+    train(workload="transformer", steps=2, global_batch=8,
+          checkpoint_dir=ckpt, checkpoint_every=1, sync_every=1,
+          workload_kwargs={})
+
+    from kubeflow_tpu.serving.servable import ModelRepository, register_model
+    from kubeflow_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig.tiny()
+    model = TransformerLM(cfg)
+
+    @register_model("tiny_lm")
+    def _tiny_lm():
+        from kubeflow_tpu.models.transformer import init_fn
+        def init():
+            return {"params": init_fn(model, cfg.max_seq_len)(
+                jax.random.PRNGKey(0))[0]}
+        def predict(variables, tokens):
+            return {"next": jnp.argmax(
+                model.apply(variables, tokens)[:, -1], axis=-1)}
+        return predict, init, {}
+
+    repo = ModelRepository()
+    s = repo.load("lm", "tiny_lm", checkpoint_dir=ckpt)
+    assert s.version == 2  # restored from the trainer's TrainState
+    # trainer writes a newer step → reload extracts params again
+    train(workload="transformer", steps=4, global_batch=8,
+          checkpoint_dir=ckpt, checkpoint_every=1, sync_every=1,
+          workload_kwargs={})
+    assert repo.reload("lm")
+    assert s.version == 4
+
+
+def test_server_before_trainer_picks_up_first_checkpoint(tmp_path):
+    """Server starts on an empty model path (version 0 placeholder); the
+    trainer's FIRST checkpoint — even step 1 — must be adopted."""
+    from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+    ckpt = str(tmp_path / "ckpt")
+    repo = ModelRepository()
+    s = repo.load("double", "double", checkpoint_dir=ckpt)
+    assert s.version == 0  # placeholder: serving init params
+    mgr = CheckpointManager(ckpt)
+    mgr.save(1, {"params": {"w": jnp.full((4,), 9.0)}}, force=True)
+    mgr.wait(); mgr.close()
+    assert repo.reload("double")
+    assert s.version == 1
+    out = s.predict(np.ones((1, 4), np.float32))
+    np.testing.assert_allclose(out["y"], 9.0 * np.ones((1, 4)))
 
 
 def test_polling_reloads_in_background(tmp_path):

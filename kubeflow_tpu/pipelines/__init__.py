@@ -13,13 +13,16 @@ Reference: the ``kubeflow/pipeline`` package deploys four services
   reconciler recording every Workflow's lifecycle.
 - ``api_server`` — the REST surface (pipelines/runs/jobs) the UI and
   clients consume.
+- ``dsl``        — pipeline authoring (Python DAG → Workflow manifest),
+  the kfp.dsl/compiler role.
 """
 
+from .dsl import Pipeline, Step
 from .scheduled import (SCHEDULED_WF_API_VERSION, SCHEDULED_WF_KIND,
                         ScheduledWorkflowReconciler, next_fire_time,
                         parse_cron)
 from .store import PersistenceAgent, RunStore
 
-__all__ = ["ScheduledWorkflowReconciler", "parse_cron", "next_fire_time",
+__all__ = ["Pipeline", "Step", "ScheduledWorkflowReconciler", "parse_cron", "next_fire_time",
            "RunStore", "PersistenceAgent", "SCHEDULED_WF_API_VERSION",
            "SCHEDULED_WF_KIND"]

@@ -1,0 +1,190 @@
+"""Pipeline authoring DSL: Python DAG → Workflow manifest.
+
+The kfp.dsl + compiler role for this platform (the reference era shipped
+the Kubeflow Pipelines SDK out-of-repo; in-repo it only had the manifests
+— kubeflow/pipeline/*.libsonnet — and hand-written Argo Workflows,
+testing/workflows/components/workflows.libsonnet:33-60). Here authoring is
+first-class: steps are containers or launched manifests (the kubebench
+resource-template idiom — e.g. "create this TPUJob, wait for Succeeded"),
+compiled to the Workflow shape `workflows/engine.py` reconciles, so the
+whole loop — author → compile → submit → reconcile → run history — runs
+in-platform.
+
+    p = Pipeline("train-then-report", namespace="kubeflow",
+                 parameters={"steps": "100"})
+    prep  = p.container("prep", image="busybox",
+                        command=["sh", "-c", "echo prep"])
+    train = p.launch("train", manifest=tpu_job_manifest,
+                     success_condition="condition: Succeeded=True",
+                     after=[prep])
+    p.container("report", image="busybox",
+                command=["report", "--steps=$(workflow.parameters.steps)"],
+                after=[train])
+    wf = p.compile()          # Workflow manifest (argoproj.io/v1alpha1)
+    p.submit(client)          # create it on the cluster
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Union
+
+from ..api import k8s
+from ..workflows.engine import WORKFLOW_API_VERSION, WORKFLOW_KIND
+
+__all__ = ["Pipeline", "Step"]
+
+
+@dataclass(frozen=True)
+class Step:
+    """Handle returned by Pipeline.container()/launch(); pass via
+    ``after=`` to order steps."""
+
+    name: str
+
+
+StepRef = Union[Step, str]
+
+
+def _names(after: Optional[Sequence[StepRef]]) -> list[str]:
+    return [s.name if isinstance(s, Step) else str(s) for s in (after or [])]
+
+
+@dataclass
+class _Task:
+    name: str
+    template: dict
+    dependencies: list[str] = field(default_factory=list)
+
+
+class Pipeline:
+    """A DAG of steps compiling to one Workflow manifest."""
+
+    def __init__(self, name: str, namespace: str = "kubeflow",
+                 parameters: Optional[dict] = None,
+                 volumes: Optional[list[dict]] = None,
+                 labels: Optional[dict] = None):
+        k8s.validate_name(name)
+        self.name = name
+        self.namespace = namespace
+        self.parameters = dict(parameters or {})
+        self.volumes = list(volumes or [])
+        self.labels = dict(labels or {})
+        self._tasks: list[_Task] = []
+
+    # -- step authoring ------------------------------------------------------
+
+    def container(self, name: str, *, image: str,
+                  command: Optional[list[str]] = None,
+                  args: Optional[list[str]] = None,
+                  env: Optional[dict] = None,
+                  volume_mounts: Optional[list[dict]] = None,
+                  active_deadline_s: Optional[int] = None,
+                  after: Optional[Sequence[StepRef]] = None) -> Step:
+        """A pod step. ``$(workflow.parameters.X)`` / ``$(workflow.name)``
+        placeholders in command/args/env substitute at launch."""
+        container: dict = {"image": image}
+        if command:
+            container["command"] = list(command)
+        if args:
+            container["args"] = list(args)
+        if env:
+            container["env"] = [{"name": k, "value": str(v)}
+                                for k, v in env.items()]
+        if volume_mounts:
+            container["volumeMounts"] = list(volume_mounts)
+        tmpl: dict = {"container": container}
+        if active_deadline_s:
+            tmpl["activeDeadlineSeconds"] = int(active_deadline_s)
+        return self._add(name, tmpl, after)
+
+    def launch(self, name: str, *, manifest: dict,
+               success_condition: str = "condition: Succeeded=True",
+               failure_condition: str = "condition: Failed=True",
+               active_deadline_s: Optional[int] = None,
+               after: Optional[Sequence[StepRef]] = None) -> Step:
+        """A resource step: create ``manifest`` (a TPUJob, StudyJob, any
+        CR) and wait for the success/failure condition — how a pipeline
+        orchestrates training jobs (the kubebench launch idiom,
+        kubebench-job.libsonnet:53)."""
+        if not manifest.get("apiVersion") or not manifest.get("kind") \
+                or not k8s.name_of(manifest):
+            raise ValueError(f"step {name!r}: manifest needs apiVersion, "
+                             "kind and metadata.name (an incomplete "
+                             "manifest would hang the workflow — no "
+                             "reconciler ever matches it)")
+        tmpl: dict = {"resource": {
+            "action": "create",
+            "manifest": copy.deepcopy(manifest),
+            "successCondition": success_condition,
+            "failureCondition": failure_condition,
+        }}
+        if active_deadline_s:
+            tmpl["activeDeadlineSeconds"] = int(active_deadline_s)
+        return self._add(name, tmpl, after)
+
+    def _add(self, name: str, template: dict,
+             after: Optional[Sequence[StepRef]]) -> Step:
+        k8s.validate_name(name)
+        # the engine names pods '{workflow}-{step}': the COMBINED name must
+        # be a valid DNS label too, or pod creation fails only at runtime
+        k8s.validate_name(f"{self.name}-{name}")
+        if name == "main":
+            raise ValueError("step name 'main' is reserved for the "
+                             "entrypoint template")
+        if any(t.name == name for t in self._tasks):
+            raise ValueError(f"duplicate step name {name!r}")
+        deps = _names(after)
+        known = {t.name for t in self._tasks}
+        unknown = [d for d in deps if d not in known]
+        if unknown:
+            raise ValueError(f"step {name!r} depends on unknown {unknown} "
+                             "(declare steps before referencing them)")
+        template = dict(template, name=name)
+        self._tasks.append(_Task(name, template, deps))
+        return Step(name)
+
+    # -- compile / submit ----------------------------------------------------
+
+    def compile(self) -> dict:
+        """The Workflow manifest (pure function of the declared steps —
+        declaration order guarantees the DAG is acyclic by construction)."""
+        if not self._tasks:
+            raise ValueError(f"pipeline {self.name!r} has no steps")
+        entry = {"name": "main", "dag": {"tasks": [
+            {"name": t.name, "template": t.name,
+             **({"dependencies": list(t.dependencies)}
+                if t.dependencies else {})}
+            for t in self._tasks]}}
+        wf = k8s.make(WORKFLOW_API_VERSION, WORKFLOW_KIND, self.name,
+                      self.namespace, labels=self.labels or None)
+        wf["spec"] = {
+            "entrypoint": "main",
+            # deepcopy: compiled manifests must not alias internal state
+            # (or each other) — mutating one output must never change what
+            # a later compile()/submit() produces
+            "templates": [entry] + [copy.deepcopy(t.template)
+                                    for t in self._tasks],
+        }
+        if self.parameters:
+            wf["spec"]["arguments"] = {"parameters": [
+                {"name": k, "value": str(v)}
+                for k, v in self.parameters.items()]}
+        if self.volumes:
+            wf["spec"]["volumes"] = list(self.volumes)
+        return wf
+
+    def submit(self, client, **overrides) -> dict:
+        """Create the Workflow on the cluster; ``overrides`` replace
+        parameter values for this run (the kfp run-with-params surface)."""
+        wf = self.compile()
+        if overrides:
+            unknown = set(overrides) - set(self.parameters)
+            if unknown:
+                raise ValueError(f"unknown parameters {sorted(unknown)}; "
+                                 f"declared: {sorted(self.parameters)}")
+            for p in wf["spec"]["arguments"]["parameters"]:
+                if p["name"] in overrides:
+                    p["value"] = str(overrides[p["name"]])
+        return client.create(wf)

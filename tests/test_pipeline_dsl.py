@@ -1,0 +1,189 @@
+"""Pipeline DSL: author in Python, compile to a Workflow, run it through
+the real engine — the kfp.dsl/compiler role over workflows/engine.py.
+
+E2E tier (SURVEY.md §4): the compiled manifest is reconciled by the real
+WorkflowReconciler on the in-memory apiserver, including a launch step
+that creates a TPUJob the real training-job operator runs to completion.
+"""
+
+import pytest
+
+from kubeflow_tpu.api import k8s
+from kubeflow_tpu.cluster import FakeCluster
+from kubeflow_tpu.controllers.runtime import Manager
+from kubeflow_tpu.controllers.tpujob import TrainingJobReconciler
+from kubeflow_tpu.pipelines import Pipeline
+from kubeflow_tpu.workflows.engine import (WORKFLOW_API_VERSION,
+                                           WorkflowReconciler)
+
+
+def tpu_job(name: str, steps: str = "5") -> dict:
+    return {
+        "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": name, "namespace": "kubeflow"},
+        "spec": {
+            "replicaSpecs": {"TPU": {
+                "tpuTopology": "v5e-8",
+                "template": {"spec": {"containers": [{
+                    "name": "worker", "image": "worker:v1",
+                    "command": ["python", "-m",
+                                "kubeflow_tpu.runtime.worker",
+                                "--steps", steps]}]}},
+            }},
+            "runPolicy": {"backoffLimit": 1},
+        },
+    }
+
+
+class TestCompile:
+    def test_dag_shape(self):
+        p = Pipeline("demo", parameters={"steps": "100"},
+                     labels={"team": "ml"})
+        a = p.container("prep", image="busybox", command=["sh", "-c", "ok"])
+        b = p.launch("train", manifest=tpu_job("t"), after=[a])
+        p.container("report", image="busybox",
+                    args=["--steps=$(workflow.parameters.steps)"],
+                    env={"RUN": "$(workflow.name)"}, after=[b])
+        wf = p.compile()
+        assert wf["apiVersion"] == WORKFLOW_API_VERSION
+        assert wf["metadata"]["labels"] == {"team": "ml"}
+        assert wf["spec"]["entrypoint"] == "main"
+        tmpl = {t["name"]: t for t in wf["spec"]["templates"]}
+        assert set(tmpl) == {"main", "prep", "train", "report"}
+        tasks = {t["name"]: t for t in tmpl["main"]["dag"]["tasks"]}
+        assert "dependencies" not in tasks["prep"]
+        assert tasks["train"]["dependencies"] == ["prep"]
+        assert tasks["report"]["dependencies"] == ["train"]
+        assert tmpl["train"]["resource"]["action"] == "create"
+        assert wf["spec"]["arguments"]["parameters"] == [
+            {"name": "steps", "value": "100"}]
+
+    def test_compile_is_pure(self):
+        p = Pipeline("demo")
+        p.container("a", image="busybox")
+        w1, w2 = p.compile(), p.compile()
+        assert w1 == w2 and w1 is not w2
+        # outputs never alias internal state: mutating one compile()'s
+        # result (or the launch manifest) must not leak into the next
+        w1["spec"]["templates"][1]["container"]["image"] = "debug"
+        assert p.compile()["spec"]["templates"][1]["container"][
+            "image"] == "busybox"
+
+    def test_launch_manifest_snapshot(self):
+        m = tpu_job("j")
+        p = Pipeline("demo")
+        p.launch("train", manifest=m)
+        m["spec"]["runPolicy"]["backoffLimit"] = 99  # caller mutates after
+        tmpl = p.compile()["spec"]["templates"][1]
+        assert tmpl["resource"]["manifest"]["spec"]["runPolicy"][
+            "backoffLimit"] == 1
+
+    def test_authoring_errors(self):
+        p = Pipeline("demo")
+        with pytest.raises(ValueError, match="no steps"):
+            p.compile()
+        p.container("a", image="busybox")
+        with pytest.raises(ValueError, match="duplicate"):
+            p.container("a", image="busybox")
+        with pytest.raises(ValueError, match="unknown"):
+            p.container("b", image="busybox", after=["nope"])
+        with pytest.raises(ValueError, match="reserved"):
+            p.container("main", image="busybox")
+        with pytest.raises(ValueError, match="manifest"):
+            p.launch("l", manifest={"kind": "TPUJob"})
+        with pytest.raises(ValueError, match="apiVersion"):
+            # no apiVersion → nothing would ever reconcile it
+            p.launch("l", manifest={
+                "kind": "TPUJob", "metadata": {"name": "j"}})
+        with pytest.raises(ValueError, match="invalid"):
+            Pipeline("Bad_Name")
+        # combined pod name '{pipeline}-{step}' must fit a DNS label
+        long = Pipeline("p" * 40)
+        with pytest.raises(ValueError, match="invalid"):
+            long.container("s" * 40, image="busybox")
+
+    def test_submit_overrides(self):
+        cluster = FakeCluster()
+        p = Pipeline("demo", parameters={"steps": "100"})
+        p.container("a", image="busybox")
+        with pytest.raises(ValueError, match="unknown parameters"):
+            p.submit(cluster, nope="1")
+        p.submit(cluster, steps="7")
+        wf = cluster.get(WORKFLOW_API_VERSION, "Workflow", "kubeflow",
+                         "demo")
+        assert wf["spec"]["arguments"]["parameters"][0]["value"] == "7"
+
+
+class TestEndToEnd:
+    @pytest.fixture
+    def env(self):
+        cluster = FakeCluster()
+        cluster.add_node("cpu-0", {"cpu": 96, "memory": 2 ** 36})
+        cluster.add_tpu_slice_nodes("v5e-8")
+        mgr = Manager(cluster)
+        mgr.add(WorkflowReconciler())
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        return cluster, mgr
+
+    def drain(self, cluster, mgr, rounds=8):
+        for _ in range(rounds):
+            mgr.run_pending()
+            cluster.tick()
+            for pod in cluster.list("v1", "Pod", "kubeflow"):
+                if pod.get("status", {}).get("phase") == "Running":
+                    cluster.set_pod_phase(k8s.namespace_of(pod, "kubeflow"),
+                                          k8s.name_of(pod), "Succeeded")
+            mgr.run_pending()
+
+    def test_pipeline_orchestrates_training_job(self, env):
+        """The authored DAG runs end-to-end: prep pod → TPUJob (real gang
+        reconciler) → report pod with parameters substituted."""
+        cluster, mgr = env
+        p = Pipeline("train-pipe", parameters={"steps": "9"})
+        prep = p.container("prep", image="busybox",
+                           command=["sh", "-c", "prep"])
+        train = p.launch(
+            "train",
+            manifest=tpu_job("pipe-job",
+                             steps="$(workflow.parameters.steps)"),
+            after=[prep])
+        p.container("report", image="busybox",
+                    args=["--run=$(workflow.name)"], after=[train])
+        p.submit(cluster)
+        self.drain(cluster, mgr)
+        wf = cluster.get(WORKFLOW_API_VERSION, "Workflow", "kubeflow",
+                         "train-pipe")
+        assert wf["status"]["phase"] == "Succeeded", wf["status"]
+        # the launched job went through the REAL operator with the
+        # parameter substituted into the worker command
+        job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob", "kubeflow",
+                          "pipe-job")
+        cmd = job["spec"]["replicaSpecs"]["TPU"]["template"]["spec"][
+            "containers"][0]["command"]
+        assert cmd[-1] == "9"
+        assert k8s.condition_true(job, "Succeeded")
+        # report pod saw the workflow name
+        report = cluster.get("v1", "Pod", "kubeflow", "train-pipe-report")
+        assert report["spec"]["containers"][0]["args"] == [
+            "--run=train-pipe"]
+
+    def test_parallel_fanout(self, env):
+        cluster, mgr = env
+        p = Pipeline("fanout")
+        a = p.container("a", image="busybox")
+        b1 = p.container("b1", image="busybox", after=[a])
+        b2 = p.container("b2", image="busybox", after=[a])
+        p.container("join", image="busybox", after=[b1, b2])
+        p.submit(cluster)
+        # after a completes, b1 and b2 launch together
+        mgr.run_pending()
+        cluster.tick()
+        cluster.set_pod_phase("kubeflow", "fanout-a", "Succeeded")
+        mgr.run_pending()
+        pods = {k8s.name_of(x) for x in cluster.list("v1", "Pod", "kubeflow")}
+        assert {"fanout-b1", "fanout-b2"} <= pods
+        assert "fanout-join" not in pods
+        self.drain(cluster, mgr)
+        wf = cluster.get(WORKFLOW_API_VERSION, "Workflow", "kubeflow",
+                         "fanout")
+        assert wf["status"]["phase"] == "Succeeded"

@@ -243,6 +243,9 @@ class TrainingJob:
     data_dir: str = ""
     # held-out shard dir for the eval pass (KFTPU_EVAL_DATA_DIR)
     eval_data_dir: str = ""
+    # TensorBoard event dir (KFTPU_TB_DIR) — the tensorboard component's
+    # --logdir; process 0 streams scalar events there
+    tensorboard_dir: str = ""
     raw: dict = field(default_factory=dict)
 
     # -- constructors -------------------------------------------------------
@@ -295,6 +298,7 @@ class TrainingJob:
             resume_from=spec.get("resumeFrom", "") or "",
             data_dir=spec.get("dataDir", "") or "",
             eval_data_dir=spec.get("evalDataDir", "") or "",
+            tensorboard_dir=spec.get("tensorboardDir", "") or "",
             raw=obj,
         )
         job.validate()
@@ -386,6 +390,8 @@ class TrainingJob:
             out["spec"]["dataDir"] = self.data_dir
         if self.eval_data_dir:
             out["spec"]["evalDataDir"] = self.eval_data_dir
+        if self.tensorboard_dir:
+            out["spec"]["tensorboardDir"] = self.tensorboard_dir
         if self.raw:
             out["apiVersion"] = self.raw.get("apiVersion", out["apiVersion"])
             meta = dict(self.raw.get("metadata", {}))

@@ -204,6 +204,8 @@ class TrainingJobReconciler(Reconciler):
             env["KFTPU_DATA_DIR"] = job.data_dir
         if job.eval_data_dir:
             env["KFTPU_EVAL_DATA_DIR"] = job.eval_data_dir
+        if job.tensorboard_dir:
+            env["KFTPU_TB_DIR"] = job.tensorboard_dir
         if env:
             self._add_env(pod, env)
         return pod

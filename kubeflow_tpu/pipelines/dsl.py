@@ -175,6 +175,51 @@ class Pipeline:
             wf["spec"]["volumes"] = list(self.volumes)
         return wf
 
+    def schedule(self, cron: Optional[str] = None, *,
+                 interval_s: Optional[int] = None, enabled: bool = True,
+                 max_concurrency: int = 1, max_history: int = 10) -> dict:
+        """A ScheduledWorkflow manifest firing this pipeline on a cron
+        (``"0 * * * *"``) or periodic interval — the recurring-run (kfp
+        "job") surface. Create it on the cluster to activate."""
+        if (not cron) == (interval_s is None):
+            raise ValueError("exactly one of cron / interval_s required")
+        from .scheduled import (SCHEDULED_WF_API_VERSION, SCHEDULED_WF_KIND,
+                                parse_cron)
+        if cron:
+            parse_cron(cron)  # author-time validation, not first-fire
+        # every firing instantiates a fresh Workflow named
+        # '{pipeline}-{index}', so two classes of name break only at run N:
+        for t in self._tasks:
+            # 1. step pod names gain the instance index — re-check the
+            #    DNS-label budget with index headroom
+            k8s.validate_name(f"{self.name}-4294967295-{t.name}")
+            # 2. a launch() manifest with a FIXED name collides on the
+            #    second firing (the engine does a bare create) — require a
+            #    run-unique name via the $(workflow.name) placeholder
+            res = t.template.get("resource")
+            if res and "$(workflow.name)" not in \
+                    k8s.name_of(res["manifest"]):
+                raise ValueError(
+                    f"step {t.name!r}: a scheduled pipeline fires many "
+                    "runs, but the launched manifest's metadata.name "
+                    f"({k8s.name_of(res['manifest'])!r}) is fixed — the "
+                    "second firing would fail with AlreadyExists. Embed "
+                    "$(workflow.name) in the name to make it run-unique")
+        wf = self.compile()
+        swf = k8s.make(SCHEDULED_WF_API_VERSION, SCHEDULED_WF_KIND,
+                       self.name, self.namespace,
+                       labels=self.labels or None)
+        swf["spec"] = {
+            "enabled": enabled,
+            "maxConcurrency": int(max_concurrency),
+            "maxHistory": int(max_history),
+            "trigger": ({"cronSchedule": {"cron": cron}} if cron else
+                        {"periodicSchedule":
+                         {"intervalSecond": int(interval_s)}}),
+            "workflow": {"spec": wf["spec"]},
+        }
+        return swf
+
     def submit(self, client, **overrides) -> dict:
         """Create the Workflow on the cluster; ``overrides`` replace
         parameter values for this run (the kfp run-with-params surface)."""

@@ -45,13 +45,17 @@ class MetricsLogger:
     """Accumulates per-step stats; optionally streams JSONL to a file."""
 
     def __init__(self, path: Optional[str] = None, batch_size: int = 0,
-                 log_every: int = 10):
+                 log_every: int = 10, tensorboard_dir: Optional[str] = None):
         self.path = path
         self.batch_size = batch_size
         self.log_every = log_every
         self.history: list[StepStats] = []
         self._last_t: Optional[float] = None
         self._fh = open(path, "a") if path else None
+        self._tb = None
+        if tensorboard_dir:
+            from ..utils.tbevents import EventWriter
+            self._tb = EventWriter(tensorboard_dir)
 
     def start_step(self) -> None:
         self._last_t = time.perf_counter()
@@ -82,6 +86,10 @@ class MetricsLogger:
         if self._fh:
             self._fh.write(json.dumps(stats.to_dict()) + "\n")
             self._fh.flush()
+        if self._tb:
+            self._tb.add_scalars(
+                {"throughput/examples_per_sec": stats.examples_per_sec,
+                 "timing/step_time_s": dt, **scalars}, step)
         # log when this window crosses a log_every boundary (covers both
         # per-step records and multi-step windows without flooding)
         if self.log_every and \
@@ -99,6 +107,10 @@ class MetricsLogger:
                  "metrics": {k: float(v) for k, v in metrics.items()}})
                 + "\n")
             self._fh.flush()
+        if self._tb:
+            self._tb.add_scalars(
+                {f"eval/{k.removeprefix('eval_')}": float(v)
+                 for k, v in metrics.items()}, step)
 
     def summary(self, warmup: int = 1) -> dict[str, float]:
         """Steady-state throughput, skipping compile/warmup records.
@@ -119,6 +131,9 @@ class MetricsLogger:
         if self._fh:
             self._fh.close()
             self._fh = None
+        if self._tb:
+            self._tb.close()
+            self._tb = None
 
 
 @contextlib.contextmanager

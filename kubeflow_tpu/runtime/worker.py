@@ -153,6 +153,7 @@ def train(
     eval_batches: int = 8,
     eval_data_dir: Optional[str] = None,
     handle_sigterm: bool = True,
+    tensorboard_dir: Optional[str] = None,
 ) -> TrainResult:
     ctx = ctx or initialize()
     workload_kwargs = dict(workload_kwargs or {})
@@ -303,7 +304,11 @@ def train(
     metrics_path = metrics_path or os.environ.get(METRICS_PATH_ENV)
     if metrics_path:
         os.makedirs(os.path.dirname(metrics_path) or ".", exist_ok=True)
-    mlog = MetricsLogger(metrics_path, batch_size=global_batch)
+    tensorboard_dir = tensorboard_dir or os.environ.get("KFTPU_TB_DIR")
+    # TB events come from process 0 only — one curve per run, not per host
+    mlog = MetricsLogger(metrics_path, batch_size=global_batch,
+                         tensorboard_dir=(tensorboard_dir
+                                          if ctx.process_id == 0 else None))
     data_rng = jax.random.PRNGKey(seed + 1)
     # the record pipeline prefetches host batches on threads; device_put of
     # batch N+1 overlaps step N because the loop only syncs at window edges.
@@ -388,18 +393,22 @@ def train(
                     # charged to the next window
                     mlog.start_step()
     finally:
-        # failures must not leak the prefetch threads / shard fds (train
-        # is called repeatedly in-process by katib studies and benchmarks)
+        # failures must not leak the prefetch threads / shard fds / metric
+        # and TB event file handles (train is called repeatedly in-process
+        # by katib studies and benchmarks)
         if data_source is not None:
             data_source.close()
         if eval_source is not None:
             eval_source.close()
         guard.uninstall()
-    if ckpt is not None:
-        ckpt.wait()
-        ckpt.close()
+        if ckpt is not None:
+            try:
+                ckpt.wait()
+                ckpt.close()
+            except Exception as e:  # noqa: BLE001 — never mask loop errors
+                log.warning("checkpoint close failed: %s", e)
+        mlog.close()
     summary = mlog.summary(warmup=1)
-    mlog.close()
     # Under a katib study the operator injects KFTPU_STUDY/KFTPU_TRIAL (+
     # vizier URL); report the final metrics as the trial observation — the
     # TPU-native metrics-collector contract (katib/vizier.py). No-op
@@ -442,6 +451,10 @@ def main(argv=None) -> int:
                    help="checkpoint dir to restore from before the loop "
                         "(defaults to $KFTPU_RESUME_FROM)")
     p.add_argument("--metrics-path")
+    p.add_argument("--tensorboard-dir",
+                   help="write TB scalar events here (defaults to "
+                        "$KFTPU_TB_DIR; the tensorboard component's "
+                        "--logdir)")
     p.add_argument("--profile-dir")
     p.add_argument("--sync-every", type=int, default=10,
                    help="host-sync (and metric-fetch) interval in steps")
@@ -479,6 +492,7 @@ def main(argv=None) -> int:
         checkpoint_every=args.checkpoint_every, resume=not args.no_resume,
         resume_from=args.resume_from,
         metrics_path=args.metrics_path, profile_dir=args.profile_dir,
+        tensorboard_dir=args.tensorboard_dir,
         workload_kwargs=workload_kwargs, sync_every=args.sync_every,
         data_dir=args.data_dir,
         optimizer=args.optimizer, lr_schedule=args.lr_schedule,

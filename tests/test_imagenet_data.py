@@ -175,6 +175,7 @@ class TestOperatorDataDir:
             "metadata": {"name": "j", "namespace": "default"},
             "spec": {
                 "dataDir": "/data/train", "evalDataDir": "/data/val",
+                "tensorboardDir": "/logs/tb",
                 "replicaSpecs": {"TPU": {
                     "tpuTopology": "v5e-8",
                     "template": {"spec": {"containers": [
@@ -189,6 +190,7 @@ class TestOperatorDataDir:
                for e in c.get("env", [])}
         assert env["KFTPU_DATA_DIR"] == "/data/train"
         assert env["KFTPU_EVAL_DATA_DIR"] == "/data/val"
+        assert env["KFTPU_TB_DIR"] == "/logs/tb"
 
     def test_worker_eval_on_holdout_shards(self, data_dir):
         d, *_ = data_dir

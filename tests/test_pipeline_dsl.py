@@ -187,3 +187,65 @@ class TestEndToEnd:
         wf = cluster.get(WORKFLOW_API_VERSION, "Workflow", "kubeflow",
                          "fanout")
         assert wf["status"]["phase"] == "Succeeded"
+
+
+class TestSchedule:
+    def test_schedule_manifest_shapes(self):
+        p = Pipeline("nightly")
+        p.container("a", image="busybox")
+        swf = p.schedule("0 2 * * *", max_concurrency=2, max_history=5)
+        assert swf["kind"] == "ScheduledWorkflow"
+        assert swf["spec"]["trigger"]["cronSchedule"]["cron"] == "0 2 * * *"
+        assert swf["spec"]["maxConcurrency"] == 2
+        assert swf["spec"]["workflow"]["spec"]["entrypoint"] == "main"
+        periodic = p.schedule(interval_s=600)
+        assert periodic["spec"]["trigger"]["periodicSchedule"][
+            "intervalSecond"] == 600
+        with pytest.raises(ValueError, match="exactly one"):
+            p.schedule()
+        with pytest.raises(ValueError, match="exactly one"):
+            p.schedule("0 * * * *", interval_s=60)
+        with pytest.raises(ValueError, match="exactly one"):
+            p.schedule("")  # empty cron is not a schedule
+        with pytest.raises(ValueError):
+            p.schedule("not a cron")  # validated at author time
+
+    def test_schedule_rejects_fixed_launch_names(self):
+        """A fixed launched-manifest name collides on the 2nd firing —
+        caught at author time; $(workflow.name) makes it run-unique."""
+        p = Pipeline("sched")
+        p.launch("train", manifest=tpu_job("fixed-name"))
+        with pytest.raises(ValueError, match="AlreadyExists"):
+            p.schedule(interval_s=60)
+        ok = Pipeline("sched")
+        ok.launch("train", manifest=tpu_job("job-$(workflow.name)"))
+        swf = ok.schedule(interval_s=60)
+        assert swf["kind"] == "ScheduledWorkflow"
+
+    def test_schedule_validates_instance_pod_names(self):
+        # '{pipeline}-{index}-{step}' must fit a DNS label with headroom
+        p = Pipeline("p" * 30)
+        p.container("s" * 22, image="busybox")  # fits '{p}-{s}' one-shot
+        with pytest.raises(ValueError, match="invalid"):
+            p.schedule(interval_s=60)
+
+    def test_scheduled_pipeline_fires_through_controller(self):
+        """The DSL-authored schedule runs through the real
+        ScheduledWorkflow reconciler: tick → Workflow instance → pods."""
+        from test_pipelines import FakeClock, drive
+        from kubeflow_tpu.pipelines import ScheduledWorkflowReconciler
+        cluster = FakeCluster()
+        cluster.add_node("cpu-0", {"cpu": 96, "memory": 2 ** 36})
+        clock = FakeClock()
+        mgr = Manager(cluster)
+        mgr.add(ScheduledWorkflowReconciler(clock=clock))
+        mgr.add(WorkflowReconciler(clock=clock))
+        p = Pipeline("tick")
+        p.container("a", image="busybox", command=["true"])
+        cluster.create(p.schedule(interval_s=60))
+        mgr.run_pending()
+        clock.advance(61)
+        drive(cluster, mgr)
+        wfs = cluster.list(WORKFLOW_API_VERSION, "Workflow", "kubeflow")
+        assert [k8s.name_of(w) for w in wfs] == ["tick-1"]
+        assert cluster.list("v1", "Pod", "kubeflow")  # step pod launched

@@ -183,6 +183,9 @@ class Pipeline:
         "job") surface. Create it on the cluster to activate."""
         if (not cron) == (interval_s is None):
             raise ValueError("exactly one of cron / interval_s required")
+        if interval_s is not None and interval_s < 1:
+            # 0 silently never fires; negatives fire on every reconcile
+            raise ValueError(f"interval_s must be >= 1, got {interval_s}")
         from .scheduled import (SCHEDULED_WF_API_VERSION, SCHEDULED_WF_KIND,
                                 parse_cron)
         if cron:

@@ -402,11 +402,21 @@ def train(
             eval_source.close()
         guard.uninstall()
         if ckpt is not None:
+            import sys
+            loop_failing = sys.exc_info()[0] is not None
             try:
-                ckpt.wait()
+                ckpt.wait()   # surfaces async background-save failures
                 ckpt.close()
-            except Exception as e:  # noqa: BLE001 — never mask loop errors
-                log.warning("checkpoint close failed: %s", e)
+            except Exception as e:  # noqa: BLE001
+                if not loop_failing:
+                    # on the success path a failed (possibly forced final)
+                    # save MUST fail the run — "success" with a missing
+                    # checkpoint breaks the zero-lost-steps resume
+                    # guarantee
+                    raise
+                # a loop error is already propagating; don't mask it
+                log.warning("checkpoint close failed during error "
+                            "handling: %s", e)
         mlog.close()
     summary = mlog.summary(warmup=1)
     # Under a katib study the operator injects KFTPU_STUDY/KFTPU_TRIAL (+

@@ -49,6 +49,7 @@ nav.tabs a{margin-right:0.8rem}
   <h1>Kubeflow TPU</h1>
   <select id="ns-selector" aria-label="namespace"></select>
   <a href="#/overview" data-view="overview">Overview</a>
+  <a href="#/runs" data-view="runs">Runs</a>
   <a href="#/activities" data-view="activities">Activities</a>
   <a href="#/metrics" data-view="metrics">Metrics</a>
   <a href="#/notebooks" data-view="notebooks">Notebooks</a>
@@ -151,6 +152,54 @@ def build_dashboard_app(client: KubeClient,
             "involvedObject": (e.get("involvedObject") or {}).get("name", ""),
             "lastTimestamp": e.get("lastTimestamp", ""),
         } for e in events]
+
+    @app.route("GET", "/api/runs/{namespace}")
+    def runs(params, query, body):
+        """Training jobs + pipeline workflows in one panel — phase,
+        progress, timestamps (the run-history view the reference left to
+        the external pipeline-ui image)."""
+        from ..api.trainingjob import API_VERSIONS, JOB_KINDS
+        from ..cluster.client import KubeError
+        from ..workflows.engine import (WORKFLOW_API_VERSION, WORKFLOW_KIND)
+        ns = params["namespace"]
+
+        def list_kind(api_version, kind):
+            # a kind whose CRD is not installed must not 500 the whole
+            # panel — the runs that DO exist still render
+            try:
+                return client.list(api_version, kind, ns)
+            except KubeError:
+                return []
+
+        out = []
+        for wf in list_kind(WORKFLOW_API_VERSION, WORKFLOW_KIND):
+            st = wf.get("status", {})
+            nodes = st.get("nodes") or {}
+            done = sum(1 for n in nodes.values()
+                       if n.get("phase") == "Succeeded")
+            out.append({
+                "kind": "Workflow", "name": k8s.name_of(wf),
+                "phase": st.get("phase", "Pending"),
+                "progress": f"{done}/{len(nodes)} steps" if nodes else "",
+                "finishedAt": st.get("finishedAt", ""),
+            })
+        for kind in JOB_KINDS:
+            for job in list_kind(API_VERSIONS[kind], kind):
+                phase = "Pending"
+                for cond in ("Succeeded", "Failed", "Running", "Created"):
+                    if k8s.condition_true(job, cond):
+                        phase = cond
+                        break
+                rstat = (job.get("status") or {}).get("replicaStatuses", {})
+                active = sum(int(v.get("active", 0))
+                             for v in rstat.values() if isinstance(v, dict))
+                out.append({
+                    "kind": kind, "name": k8s.name_of(job), "phase": phase,
+                    "progress": f"{active} active" if active else "",
+                    "finishedAt": "",
+                })
+        out.sort(key=lambda r: (r["kind"], r["name"]))
+        return 200, out
 
     @app.route("GET", "/api/metrics/{mtype}")
     def metrics_route(params, query, body):

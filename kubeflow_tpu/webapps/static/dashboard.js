@@ -100,6 +100,15 @@
       table(rows, cols);
   }
 
+  async function viewRuns(el) {
+    const ns = selectedNamespace();
+    const runs = await api(`api/runs/${encodeURIComponent(ns)}`);
+    el.innerHTML = `<h2>Runs in ${esc(ns)}</h2>` +
+      (runs.length
+        ? table(runs, ["kind", "name", "phase", "progress", "finishedAt"])
+        : "<p class=empty>No training jobs or workflow runs.</p>");
+  }
+
   function viewNotebooks(el) {
     // iframe-embedding, the reference dashboard's integration pattern
     el.innerHTML = "<h2>Notebooks</h2>" +
@@ -109,6 +118,7 @@
 
   const VIEWS = {
     overview: viewOverview,
+    runs: viewRuns,
     activities: viewActivities,
     metrics: viewMetrics,
     notebooks: viewNotebooks,

@@ -209,6 +209,10 @@ class TestSchedule:
             p.schedule("")  # empty cron is not a schedule
         with pytest.raises(ValueError):
             p.schedule("not a cron")  # validated at author time
+        with pytest.raises(ValueError, match=">= 1"):
+            p.schedule(interval_s=0)  # would silently never fire
+        with pytest.raises(ValueError, match=">= 1"):
+            p.schedule(interval_s=-60)  # would fire every reconcile
 
     def test_schedule_rejects_fixed_launch_names(self):
         """A fixed launched-manifest name collides on the 2nd firing —

@@ -203,3 +203,50 @@ class TestJupyterWebApp:
         app = build_dashboard_app(cluster)
         status, err = app.dispatch("GET", "/api/nope", None)
         assert status == 404
+
+
+class TestRunsPanel:
+    def test_runs_api_lists_workflows_and_jobs(self, cluster):
+        from kubeflow_tpu.controllers.runtime import Manager
+        from kubeflow_tpu.controllers.tpujob import TrainingJobReconciler
+        from kubeflow_tpu.workflows.engine import WorkflowReconciler
+        from kubeflow_tpu.pipelines import Pipeline
+        mgr = Manager(cluster)
+        mgr.add(WorkflowReconciler())
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        p = Pipeline("pipe")
+        p.container("a", image="busybox", command=["true"])
+        p.submit(cluster)
+        cluster.create({
+            "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": "train", "namespace": "kubeflow"},
+            "spec": {"replicaSpecs": {"TPU": {
+                "tpuTopology": "v5e-8",
+                "template": {"spec": {"containers": [
+                    {"name": "w", "image": "x"}]}}}}},
+        })
+        for _ in range(4):
+            mgr.run_pending()
+            cluster.tick()
+            mgr.run_pending()
+        server = DashboardServer(cluster)
+        port = server.start()
+        try:
+            runs = get_json(f"http://127.0.0.1:{port}/api/runs/kubeflow")
+            by_name = {(r["kind"], r["name"]): r for r in runs}
+            assert ("Workflow", "pipe") in by_name
+            assert ("TPUJob", "train") in by_name
+            assert by_name[("TPUJob", "train")]["phase"] in (
+                "Created", "Running")
+            # the SPA bundle exposes the view and the sidebar links it
+            import urllib.request
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/app.js", timeout=10) as r:
+                js = r.read().decode()
+            assert "viewRuns" in js and "api/runs/" in js
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/", timeout=10) as r:
+                html = r.read().decode()
+            assert 'data-view="runs"' in html
+        finally:
+            server.stop()

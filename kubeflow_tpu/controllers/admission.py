@@ -15,7 +15,6 @@ serviceAccountName}.
 from __future__ import annotations
 
 import logging
-from typing import Optional
 
 from ..api import k8s
 from ..cluster.client import KubeClient

@@ -23,10 +23,10 @@ import logging
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import Optional
 
 from ..api import k8s
-from ..cluster.client import DELETED, KubeClient, Watch
+from ..cluster.client import KubeClient, Watch
 
 log = logging.getLogger(__name__)
 

@@ -34,7 +34,6 @@ from __future__ import annotations
 import copy
 import json
 import logging
-from typing import Optional
 
 from ..api import k8s
 from ..api.topology import TopologyContract, render_contracts

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Iterator, Optional, Sequence
+from typing import Iterator, Optional
 
 import numpy as np
 

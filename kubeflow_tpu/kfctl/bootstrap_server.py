@@ -24,7 +24,6 @@ from __future__ import annotations
 import logging
 import os
 import threading
-from typing import Optional
 
 from ..webapps._http import ApiError, JsonApp, JsonServer, RawResponse
 from .coordinator import Coordinator

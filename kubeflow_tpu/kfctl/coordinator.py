@@ -12,7 +12,6 @@ import argparse
 import json
 import logging
 import os
-import sys
 from typing import Optional
 
 from ..api import k8s

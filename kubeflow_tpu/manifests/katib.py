@@ -7,7 +7,6 @@ studyjobcontroller.libsonnet:131-147,294-323,368-408).
 
 from __future__ import annotations
 
-from ..api import k8s
 from . import helpers as H
 from .registry import register
 

@@ -7,7 +7,6 @@ StatefulSet jupyter.libsonnet:128-150).
 
 from __future__ import annotations
 
-from ..api import k8s
 from . import helpers as H
 from .registry import register
 

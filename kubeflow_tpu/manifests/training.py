@@ -7,7 +7,7 @@ kubeflow/pytorch-job, kubeflow/mpi-job, kubeflow/examples/prototypes.
 from __future__ import annotations
 
 from ..api import k8s
-from ..api.trainingjob import (KF_API_VERSION_V1ALPHA1, KF_API_VERSION_V1BETA2,
+from ..api.trainingjob import (KF_API_VERSION_V1BETA2,
                                TPU_API_VERSION)
 from . import helpers as H
 from .registry import register

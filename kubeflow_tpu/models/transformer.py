@@ -18,7 +18,7 @@ TPU design notes:
 from __future__ import annotations
 
 import re
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
 import flax.linen as nn

@@ -36,8 +36,8 @@ from typing import Optional
 from ..api import k8s
 from ..cluster.client import KubeClient, NotFoundError
 from ..controllers.runtime import Key, Reconciler, Result, status_snapshot
-from ..workflows.engine import (PHASE_FAILED, PHASE_RUNNING, PHASE_SUCCEEDED,
-                                TERMINAL, WORKFLOW_API_VERSION, WORKFLOW_KIND)
+from ..workflows.engine import (PHASE_RUNNING, TERMINAL,
+                                WORKFLOW_API_VERSION, WORKFLOW_KIND)
 
 log = logging.getLogger(__name__)
 

@@ -17,7 +17,7 @@ pytree so XLA sees a fixed program.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Optional
 
 import jax
 import optax

@@ -17,7 +17,6 @@ from __future__ import annotations
 import argparse
 import logging
 import os
-import time
 from functools import partial
 from dataclasses import dataclass, replace
 from typing import Callable, Optional
@@ -241,7 +240,8 @@ def train(
     if eval_every and spec.eval_fn is not None:
         eval_step = builder.build_eval(spec.eval_fn)
         if eval_data_dir:
-            from ..data.imagenet import ImageNetSource, read_meta
+            from ..data.imagenet import (ImageNetSource,  # noqa: F811
+                                         read_meta)
             from ..parallel.mesh import data_axes
             # validation reads: no augmentation, normalized on host (eval
             # is off the hot path, simplicity over transfer bytes). A
@@ -339,6 +339,7 @@ def train(
     # steps (the fetch at the window edge is still a hard barrier — see
     # bench.py on why block_until_ready is not one on tunneled platforms).
     sync_every = max(1, int(sync_every))
+    loop_error: Optional[BaseException] = None
     try:
         with profile_trace(profile_dir, enabled=profile_dir is not None):
             window = 0
@@ -392,6 +393,10 @@ def train(
                     # the device state synchronously, and that must not be
                     # charged to the next window
                     mlog.start_step()
+    except BaseException as e:
+        loop_error = e   # frame-scoped, unlike sys.exc_info() — a caller
+        raise            # invoking train() inside an except must not
+        # make the success path look like the error path
     finally:
         # failures must not leak the prefetch threads / shard fds / metric
         # and TB event file handles (train is called repeatedly in-process
@@ -401,23 +406,27 @@ def train(
         if eval_source is not None:
             eval_source.close()
         guard.uninstall()
+        save_error: Optional[Exception] = None
         if ckpt is not None:
-            import sys
-            loop_failing = sys.exc_info()[0] is not None
             try:
                 ckpt.wait()   # surfaces async background-save failures
-                ckpt.close()
             except Exception as e:  # noqa: BLE001
-                if not loop_failing:
-                    # on the success path a failed (possibly forced final)
-                    # save MUST fail the run — "success" with a missing
-                    # checkpoint breaks the zero-lost-steps resume
-                    # guarantee
-                    raise
-                # a loop error is already propagating; don't mask it
-                log.warning("checkpoint close failed during error "
-                            "handling: %s", e)
+                if loop_error is None:
+                    save_error = e
+                else:   # a loop error is already propagating; don't mask
+                    log.warning("checkpoint wait failed during error "
+                                "handling: %s", e)
+            try:
+                ckpt.close()
+            except Exception as e:  # noqa: BLE001 — close is best-effort
+                log.warning("checkpoint close failed: %s", e)
         mlog.close()
+        if save_error is not None:
+            # on the success path a failed (possibly forced final) save
+            # MUST fail the run — "success" with a missing checkpoint
+            # breaks the zero-lost-steps resume guarantee. Every handle
+            # above is already closed.
+            raise save_error
     summary = mlog.summary(warmup=1)
     # Under a katib study the operator injects KFTPU_STUDY/KFTPU_TRIAL (+
     # vizier URL); report the final metrics as the trial observation — the

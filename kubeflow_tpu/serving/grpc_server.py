@@ -17,7 +17,6 @@ together on the device.
 from __future__ import annotations
 
 import logging
-import threading
 from concurrent import futures
 from typing import Optional
 

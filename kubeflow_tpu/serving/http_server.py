@@ -27,7 +27,7 @@ from typing import Optional
 import numpy as np
 
 from .batcher import MicroBatcher
-from .servable import ModelRepository, Servable
+from .servable import ModelRepository
 
 
 class ModelServer:

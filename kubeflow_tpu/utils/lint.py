@@ -1,0 +1,146 @@
+"""Minimal AST lint: the in-repo analog of the reference's flake8 CI tier
+(testing/test_flake8.py) — no third-party linter is available in the
+image, and the checks the suite actually relies on are small:
+
+- files parse (syntax);
+- imports are used (unused imports are how dead dependencies accrete);
+- no duplicate import of the same binding;
+- no bare ``except:`` (swallows KeyboardInterrupt/SystemExit).
+
+``# noqa`` on the offending line suppresses, flake8-style. ``__init__.py``
+files are exempt from unused-import checks (re-export surface).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def _noqa_lines(source: str) -> set[int]:
+    return {i for i, line in enumerate(source.splitlines(), 1)
+            if "# noqa" in line}
+
+
+def _used_names(tree: ast.AST) -> set[str]:
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # a.b.c marks 'a' used; the chain itself resolves at runtime
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                used.add(root.id)
+    # names exported via __all__ strings count as used
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "__all__" and \
+                        isinstance(node.value, (ast.List, ast.Tuple)):
+                    for elt in node.value.elts:
+                        if isinstance(elt, ast.Constant) and \
+                                isinstance(elt.value, str):
+                            used.add(elt.value)
+    return used
+
+
+def check_file(path: str) -> list[Finding]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "E999", f"syntax error: {e.msg}")]
+
+    noqa = _noqa_lines(source)
+    out: list[Finding] = []
+    is_init = os.path.basename(path) == "__init__.py"
+
+    # -- imports -------------------------------------------------------------
+    # (key, used_name, node) triples. key mirrors flake8's binding key:
+    # 'import a.b' and 'import a.c' coexist (key = dotted path) while the
+    # usage check tracks the bound root name. Scope-aware: duplicates are
+    # only duplicates within the SAME scope — a per-function local import
+    # repeated across tests is idiomatic, not shadowing.
+    def imports_in(body, scope_out):
+        for node in body:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    key = alias.asname or alias.name
+                    used_name = alias.asname or alias.name.split(".")[0]
+                    scope_out.append((key, used_name, node))
+            elif isinstance(node, ast.ImportFrom):
+                if node.module != "__future__":
+                    for alias in node.names:
+                        if alias.name != "*":
+                            name = alias.asname or alias.name
+                            scope_out.append((name, name, node))
+            # one level of nesting inside try/if (conditional imports)
+            for attr in ("body", "orelse", "finalbody"):
+                if isinstance(node, (ast.Try, ast.If)) and \
+                        getattr(node, attr, None):
+                    imports_in(getattr(node, attr), scope_out)
+            for h in getattr(node, "handlers", []) or []:
+                imports_in(h.body, scope_out)
+
+    scopes: list[list] = []
+    module_scope: list = []
+    imports_in(tree.body, module_scope)
+    scopes.append(module_scope)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_scope: list = []
+            imports_in(node.body, fn_scope)
+            scopes.append(fn_scope)
+
+    used = _used_names(tree)
+    for scope in scopes:
+        seen: dict[str, ast.stmt] = {}
+        for key, used_name, node in scope:
+            if node.lineno in noqa:
+                continue
+            prev = seen.get(key)
+            if prev is not None and prev.lineno != node.lineno:
+                out.append(Finding(path, node.lineno, "F811",
+                                   f"redefinition of imported {key!r} "
+                                   f"(first at line {prev.lineno})"))
+            seen[key] = node
+            if not is_init and used_name not in used:
+                out.append(Finding(path, node.lineno, "F401",
+                                   f"{key!r} imported but unused"))
+
+    # -- bare except ---------------------------------------------------------
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None \
+                and node.lineno not in noqa:
+            out.append(Finding(path, node.lineno, "E722",
+                               "bare 'except:' (catches SystemExit/"
+                               "KeyboardInterrupt)"))
+    return out
+
+
+def check_tree(root: str, subdirs: tuple[str, ...]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sub in subdirs:
+        for dirpath, dirnames, filenames in os.walk(os.path.join(root, sub)):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", "build")]
+            for fname in sorted(filenames):
+                if fname.endswith(".py"):
+                    findings.extend(check_file(os.path.join(dirpath, fname)))
+    return findings

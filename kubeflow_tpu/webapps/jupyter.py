@@ -18,7 +18,6 @@ Routes:
 
 from __future__ import annotations
 
-from typing import Optional
 
 from ..api import k8s
 from ..cluster.client import AlreadyExistsError, KubeClient, NotFoundError

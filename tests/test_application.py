@@ -7,7 +7,6 @@ from __future__ import annotations
 
 import pytest
 
-from kubeflow_tpu.api import k8s
 from kubeflow_tpu.cluster import FakeCluster
 from kubeflow_tpu.controllers.application import (APPLICATION_API_VERSION,
                                                   APPLICATION_KIND,

@@ -15,7 +15,7 @@ import pytest
 from kubeflow_tpu.manifests import build_component
 from kubeflow_tpu.support.echo_server import EchoServer
 from kubeflow_tpu.webapps.gatekeeper import Gatekeeper, GatekeeperServer
-from kubeflow_tpu.webapps.ingress import (AuthIngress, ExtAuthzVerifier,
+from kubeflow_tpu.webapps.ingress import (AuthIngress,
                                           IAP_EMAIL_HEADER, IAP_JWT_HEADER,
                                           JwtError, JwtVerifier, Route,
                                           build_ext_authz_ingress,

@@ -6,10 +6,7 @@ kubeflow/tf-training/tests/tf-job_test.jsonnet — plus CLI lifecycle tests
 (kfctl_go_test.py analog, against the simulated cluster instead of GCP).
 """
 
-import json
 import os
-import subprocess
-import sys
 
 import pytest
 

@@ -205,7 +205,6 @@ class TestTinyModels:
     def test_transformer_tiny_trains(self):
         from kubeflow_tpu.models import transformer as T
         from kubeflow_tpu.runtime.worker import train
-        from kubeflow_tpu.runtime.bootstrap import WorkerContext
         ctx = initialize(env={"KFTPU_SHARDING": json.dumps(
             {"data": 2, "fsdp": 2, "tensor": 2})})
         r = train(workload="transformer", steps=2, global_batch=8, ctx=ctx)

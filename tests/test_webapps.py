@@ -12,7 +12,6 @@ import urllib.request
 
 import pytest
 
-from kubeflow_tpu.api import k8s
 from kubeflow_tpu.cluster import FakeCluster
 from kubeflow_tpu.controllers import build_manager
 from kubeflow_tpu.webapps.dashboard import (DashboardServer, MetricsService,

@@ -8,7 +8,6 @@ resource-template condition matching, deadlines — get direct envtest-style
 coverage.
 """
 
-import os
 
 import pytest
 

@@ -1,0 +1,33 @@
+"""Child for the two-process TRAIN test: the full worker loop
+(TrainStepBuilder init/place_batch/step) on a multi-process mesh — the
+scale-out path a real TPUJob gang runs, not just a bare psum."""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=4")
+
+
+def main() -> int:
+    from kubeflow_tpu.runtime.bootstrap import initialize
+    from kubeflow_tpu.runtime.worker import train
+
+    ctx = initialize()
+    r = train(workload="transformer", steps=3, global_batch=16,
+              sync_every=1, ctx=ctx, workload_kwargs={}, seed=4,
+              handle_sigterm=False)
+    print(json.dumps({"process_id": ctx.process_id,
+                      "num_processes": ctx.num_processes,
+                      "steps": r.steps,
+                      "loss": r.final_metrics["loss"],
+                      "grad_norm": r.final_metrics["grad_norm"]}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,11 +3,12 @@
 Renders the KFTPU_* contract exactly as the TPUJob operator does
 (render_contracts), spawns two real OS processes, and asserts the
 DISTRIBUTED branch of bootstrap.initialize runs: coordinator rendezvous,
-8 global devices from 2×4 local, and a cross-process reduction producing
-the same global sum on both processes."""
+8 global devices from 2×4 local, a cross-process reduction, and the full
+worker train loop with cross-process gradient all-reduce."""
 
 from __future__ import annotations
 
+import concurrent.futures
 import json
 import os
 import socket
@@ -18,7 +19,6 @@ import pytest
 
 from kubeflow_tpu.api.topology import parse_topology, render_contracts
 
-CHILD = os.path.join(os.path.dirname(__file__), "_distributed_child.py")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -28,36 +28,70 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_psum():
+def _run_children(job_name: str, child_basename: str,
+                  timeout: float) -> list[dict]:
+    """Spawn one child per contract host and collect their JSON lines.
+
+    Pipes are drained CONCURRENTLY (a chatty child blocking on a full
+    stderr pipe while its peer waits at a collective is a mutual
+    deadlock), and every child is killed on any failure/timeout so a
+    broken run can't leak processes into the rest of the session."""
     port = _free_port()
-    contracts = render_contracts("dj", "default", parse_topology("v5e-8"))
+    contracts = render_contracts(job_name, "default",
+                                 parse_topology("v5e-8"))
     assert len(contracts) == 2  # v5e-8 = 2 hosts -> 2 processes
+    child = os.path.join(os.path.dirname(__file__), child_basename)
 
     procs = []
-    for contract in contracts:
-        env = dict(os.environ)
-        env.pop("XLA_FLAGS", None)  # the child pins its own device count
-        env.update(contract.to_env())
-        # pod DNS doesn't resolve here; point at the local coordinator
-        env["KFTPU_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
-        env["PYTHONPATH"] = REPO
-        procs.append(subprocess.Popen(
-            [sys.executable, CHILD], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
-
+    try:
+        for contract in contracts:
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)  # the child pins its own devices
+            env.update(contract.to_env())
+            # pod DNS doesn't resolve here; point at the local coordinator
+            env["KFTPU_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+            env["PYTHONPATH"] = REPO
+            procs.append(subprocess.Popen(
+                [sys.executable, child], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+        with concurrent.futures.ThreadPoolExecutor(len(procs)) as pool:
+            futures = [pool.submit(p.communicate, timeout=timeout)
+                       for p in procs]
+            results = [f.result(timeout=timeout + 30) for f in futures]
+    except BaseException:
+        for p in procs:
+            p.kill()
+        raise
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=240)
+    for p, (out, err) in zip(procs, results):
         assert p.returncode == 0, f"child failed:\n{err[-3000:]}"
         outs.append(json.loads(out.strip().splitlines()[-1]))
-
-    by_id = {o["process_id"]: o for o in outs}
-    assert set(by_id) == {0, 1}
+    assert {o["process_id"] for o in outs} == {0, 1}
     for o in outs:
         assert o["num_processes"] == 2
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_psum():
+    outs = _run_children("dj", "_distributed_child.py", timeout=240)
+    for o in outs:
         assert o["global_devices"] == 8
         assert o["local_devices"] == 4
         # sum over the 8-element global arange — identical on every process
         assert o["sum"] == sum(range(8))
         assert o["mesh"]["data"] == 8
+
+
+@pytest.mark.slow
+def test_two_process_full_train_loop():
+    """The whole worker loop — sharded init, global batch placement, jitted
+    step with cross-process gradient reduction — over two real processes.
+    Both processes must observe the IDENTICAL loss trajectory (the gradient
+    all-reduce makes the replicated state bit-identical)."""
+    outs = _run_children("mptrain", "_distributed_train_child.py",
+                         timeout=280)
+    for o in outs:
+        assert o["steps"] == 3
+    assert outs[0]["loss"] == outs[1]["loss"]
+    assert outs[0]["grad_norm"] == outs[1]["grad_norm"]

@@ -50,6 +50,9 @@ from .runtime import Key, Reconciler, Result
 log = logging.getLogger(__name__)
 
 RESTART_COUNT_ANNOTATION = "kubeflow.org/gang-restart-count"
+# gang size at last creation: a mismatch with the rendered size means the
+# SPEC was resized (create the new pods), not that members vanished
+GANG_SIZE_ANNOTATION = "kubeflow.org/gang-size"
 REPLICA_TYPE_LABEL = "kubeflow.org/replica-type"
 REPLICA_INDEX_LABEL = "kubeflow.org/replica-index"
 DEFAULT_PORT = 2222
@@ -94,23 +97,54 @@ class TrainingJobReconciler(Reconciler):
         by_name = {k8s.name_of(p): p for p in pods}
 
         self._ensure_services(client, job, manifest)
-        created = self._ensure_pods(client, job, manifest, by_name)
-        if created:
-            self._set_condition(client, manifest, COND_CREATED, "True",
-                                "JobCreated", f"created {created} pods")
-            return Result(requeue=True)
 
         phases = {k8s.name_of(p): p.get("status", {}).get("phase", "Pending")
                   for p in pods}
         chief = self._chief_pod_name(job)
-        # chief success wins over concurrent worker failures: a completed job
-        # must not be gang-restarted by a non-chief exiting non-zero during
-        # shutdown
+        # chief success wins over concurrent worker failures AND vanishes:
+        # a completed job must not be gang-restarted by a non-chief exiting
+        # non-zero (or its pod object disappearing) during shutdown
         if phases.get(chief) == POD_SUCCEEDED:
             self._set_condition(client, manifest, COND_SUCCEEDED, "True",
                                 "JobSucceeded", f"chief pod {chief} succeeded")
             self._cleanup_pods(client, job, pods)
             return Result()
+
+        # A TPU gang member VANISHING mid-run (node loss, preemption
+        # deleting the pod object — no Failed phase ever appears) must
+        # restart the WHOLE gang: the survivors' jax.distributed world
+        # cannot re-admit a fresh peer, so recreating just the missing pod
+        # would hang the slice forever. Scoped to TPU pods only — legacy
+        # CPU replicas (TF PS/worker gRPC) reconnect to a solo recreation
+        # the way the reference operators relied on. The Restarting
+        # condition marks an intentional between-reconciles gap (we just
+        # deleted the gang ourselves); a changed gang size is a spec
+        # resize, not a failure (handled in _ensure_pods).
+        tpu_names = self._tpu_pod_names(job)
+        gang_size_matches = k8s.annotations_of(manifest).get(
+            GANG_SIZE_ANNOTATION) == str(len(tpu_names))
+        if tpu_names and gang_size_matches \
+                and k8s.condition_true(manifest, COND_CREATED) \
+                and not k8s.condition_true(manifest, COND_RESTARTING):
+            missing = [n for n in tpu_names if n not in by_name]
+            if missing:
+                return self._handle_gang_failure(client, job, manifest,
+                                                 pods, missing,
+                                                 reason="GangPodsVanished")
+
+        created = self._ensure_pods(client, job, manifest, by_name)
+        if created:
+            patch = {"metadata": {"annotations": {
+                GANG_SIZE_ANNOTATION: str(len(tpu_names))}}}
+            manifest = client.patch(*k8s.key_of(manifest), patch)
+            self._set_condition(client, manifest, COND_CREATED, "True",
+                                "JobCreated", f"created {created} pods")
+            # the intentional-gap marker is consumed: the gang exists again
+            if k8s.condition_true(manifest, COND_RESTARTING):
+                self._set_condition(client, manifest, COND_RESTARTING,
+                                    "False", "GangRecreated",
+                                    "gang pods recreated")
+            return Result(requeue=True)
 
         failed = [n for n, ph in phases.items() if ph == POD_FAILED]
         if failed:
@@ -143,23 +177,37 @@ class TrainingJobReconciler(Reconciler):
         if client.get_or_none(*k8s.key_of(svc)) is None:
             client.create(svc)
 
+    @staticmethod
+    def _tpu_pod_entries(job: TrainingJob, rs) -> list[tuple[str, object]]:
+        """(pod name, topology contract) for every member of a TPU replica
+        — the ONE place gang pod naming happens (_ensure_pods and the
+        vanish detector both consume it; drift between them would make
+        every pod look missing)."""
+        contracts = render_contracts(
+            job.name, job.namespace, rs.topology, rs.num_slices,
+            port=JAX_COORD_PORT)
+        return [(_tpu_pod_name(job, c.slice_id,
+                               c.process_id % rs.topology.num_hosts), c)
+                for c in contracts]
+
+    def _tpu_pod_names(self, job: TrainingJob) -> list[str]:
+        names = []
+        for rs in job.replica_specs.values():
+            if rs.is_tpu:
+                names.extend(n for n, _ in self._tpu_pod_entries(job, rs))
+        return names
+
     def _ensure_pods(self, client: KubeClient, job: TrainingJob,
                      manifest: dict, existing: dict[str, dict]) -> int:
         created = 0
         for rtype, rs in job.replica_specs.items():
             if rs.is_tpu:
-                contracts = render_contracts(
-                    job.name, job.namespace, rs.topology, rs.num_slices,
-                    port=JAX_COORD_PORT)
                 # all-or-nothing create: build every missing member first,
                 # then emit the whole set (never a partial gang)
-                gang_pods = []
-                for c in contracts:
-                    pname = _tpu_pod_name(job, c.slice_id,
-                                          c.process_id % rs.topology.num_hosts)
-                    if pname in existing:
-                        continue
-                    gang_pods.append(self._build_tpu_pod(job, manifest, rs, c, pname))
+                gang_pods = [
+                    self._build_tpu_pod(job, manifest, rs, c, pname)
+                    for pname, c in self._tpu_pod_entries(job, rs)
+                    if pname not in existing]
                 for pod in gang_pods:
                     client.create(pod)
                     created += 1
@@ -367,7 +415,8 @@ class TrainingJobReconciler(Reconciler):
 
     def _handle_gang_failure(self, client: KubeClient, job: TrainingJob,
                              manifest: dict, pods: list[dict],
-                             failed: list[str]) -> Result:
+                             failed: list[str],
+                             reason: str = "GangRestart") -> Result:
         restarts = int(k8s.annotations_of(manifest).get(
             RESTART_COUNT_ANNOTATION, "0"))
         if restarts >= job.run_policy.backoff_limit:
@@ -393,8 +442,8 @@ class TrainingJobReconciler(Reconciler):
             patch["spec"] = {"resumeFrom": job.checkpoint_dir}
         patched = client.patch(*k8s.key_of(manifest), patch)
         self._set_condition(
-            client, patched, COND_RESTARTING, "True", "GangRestart",
-            f"pods {failed} failed; restarting whole gang "
+            client, patched, COND_RESTARTING, "True", reason,
+            f"pods {failed} failed/vanished; restarting whole gang "
             f"({restarts + 1}/{job.run_policy.backoff_limit})")
         return Result(requeue=True)
 

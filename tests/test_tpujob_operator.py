@@ -106,9 +106,13 @@ class TestTPUJobReconcile:
         cluster.fail_pod("kubeflow", "train-worker-0-1")
         mgr.run_pending()
         job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob", "kubeflow", "train")
-        assert k8s.condition_true(job, "Restarting")
         assert job["metadata"]["annotations"][
             "kubeflow.org/gang-restart-count"] == "1"
+        # Restarting was raised for the delete/recreate gap and consumed
+        # once the gang existed again (GangRecreated)
+        cond = k8s.get_condition(job, "Restarting")
+        assert cond is not None
+        assert cond["status"] == "False" and cond["reason"] == "GangRecreated"
         # the whole gang was recreated (fresh pods, unscheduled)
         pods = cluster.list("v1", "Pod", "kubeflow")
         assert len(pods) == 2
@@ -139,6 +143,62 @@ class TestTPUJobReconcile:
                    for e in pod["spec"]["containers"][0]["env"]}
         assert env_map["KFTPU_RESUME_FROM"] == "/ckpt/train"
         assert env_map["KFTPU_CHECKPOINT_DIR"] == "/ckpt/train"
+
+    def test_vanished_gang_member_restarts_whole_gang(self, env):
+        """Node loss / preemption DELETES the pod object — no Failed phase
+        ever appears. The survivors' jax.distributed world cannot re-admit
+        a fresh peer, so a partial disappearance must gang-restart (with
+        resumeFrom), never recreate the missing pod solo."""
+        cluster, mgr, _ = env
+        cluster.create(tpujob_manifest(checkpointDir="/ckpt/train"))
+        drive(cluster, mgr)
+        cluster.delete("v1", "Pod", "kubeflow", "train-worker-0-1")
+        mgr.run_pending()
+        job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                          "kubeflow", "train")
+        assert job["metadata"]["annotations"][
+            "kubeflow.org/gang-restart-count"] == "1"
+        assert job["spec"]["resumeFrom"] == "/ckpt/train"
+        cond = k8s.get_condition(job, "Restarting")
+        assert cond is not None and cond["reason"] in ("GangPodsVanished",
+                                                       "GangRecreated")
+        mgr.run_pending()
+        # the FULL gang exists again (not just the vanished member)
+        pods = cluster.list("v1", "Pod", "kubeflow")
+        assert {k8s.name_of(p) for p in pods} == \
+            {"train-worker-0-0", "train-worker-0-1"}
+        # and survivors were replaced too: a fresh jax.distributed world
+        job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                          "kubeflow", "train")
+        assert k8s.get_condition(job, "Restarting")["status"] == "False"
+
+    def test_legacy_cpu_replica_recreated_solo(self, env):
+        """CPU-only legacy kinds keep the reference operators' behavior: a
+        deleted PS/worker pod is recreated individually (TF gRPC
+        reconnects), NOT via gang restart."""
+        cluster, mgr, _ = env
+        mgr.add(TrainingJobReconciler("TFJob"))
+        tmpl = {"spec": {"containers": [{"name": "tf", "image": "tf:1"}]}}
+        cluster.create({
+            "apiVersion": "kubeflow.org/v1beta2", "kind": "TFJob",
+            "metadata": {"name": "legacy", "namespace": "kubeflow"},
+            "spec": {"tfReplicaSpecs": {
+                "Worker": {"replicas": 2, "template": tmpl},
+                "PS": {"replicas": 1, "template": tmpl},
+            }},
+        })
+        drive(cluster, mgr)
+        cluster.delete("v1", "Pod", "kubeflow", "legacy-worker-1")
+        mgr.run_pending()
+        job = cluster.get("kubeflow.org/v1beta2", "TFJob", "kubeflow",
+                          "legacy")
+        assert not k8s.condition_true(job, "Restarting")
+        assert "kubeflow.org/gang-restart-count" not in \
+            k8s.annotations_of(job)
+        pods = {k8s.name_of(p) for p in cluster.list("v1", "Pod",
+                                                     "kubeflow")}
+        assert "legacy-worker-1" in pods  # recreated solo
+        assert len(pods) == 3
 
     def test_backoff_limit_fails_job(self, env):
         cluster, mgr, _ = env

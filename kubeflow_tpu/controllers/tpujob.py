@@ -50,9 +50,11 @@ from .runtime import Key, Reconciler, Result
 log = logging.getLogger(__name__)
 
 RESTART_COUNT_ANNOTATION = "kubeflow.org/gang-restart-count"
-# gang size at last creation: a mismatch with the rendered size means the
-# SPEC was resized (create the new pods), not that members vanished
-GANG_SIZE_ANNOTATION = "kubeflow.org/gang-size"
+# gang shape at last creation (topology×slices per TPU replica): a changed
+# fingerprint means the SPEC was resized/reshaped (deliberate restart on
+# the new shape), not that members vanished — pod COUNT alone can't tell
+# (equal-count reshapes exist: 2×2-host → 4×1-host, or 4x4 → 8x2)
+GANG_SHAPE_ANNOTATION = "kubeflow.org/gang-shape"
 REPLICA_TYPE_LABEL = "kubeflow.org/replica-type"
 REPLICA_INDEX_LABEL = "kubeflow.org/replica-index"
 DEFAULT_PORT = 2222
@@ -118,25 +120,43 @@ class TrainingJobReconciler(Reconciler):
         # CPU replicas (TF PS/worker gRPC) reconnect to a solo recreation
         # the way the reference operators relied on. The Restarting
         # condition marks an intentional between-reconciles gap (we just
-        # deleted the gang ourselves); a changed gang size is a spec
-        # resize, not a failure (handled in _ensure_pods).
-        tpu_names = self._tpu_pod_names(job)
-        gang_size_matches = k8s.annotations_of(manifest).get(
-            GANG_SIZE_ANNOTATION) == str(len(tpu_names))
-        if tpu_names and gang_size_matches \
-                and k8s.condition_true(manifest, COND_CREATED) \
+        # deleted the gang ourselves).
+        tpu_entries = {rtype: self._tpu_pod_entries(job, rs)
+                       for rtype, rs in job.replica_specs.items()
+                       if rs.is_tpu}
+        tpu_names = [n for entries in tpu_entries.values()
+                     for n, _ in entries]
+        shape = self._gang_shape(job)
+        shape_anno = k8s.annotations_of(manifest).get(GANG_SHAPE_ANNOTATION)
+        if tpu_names and k8s.condition_true(manifest, COND_CREATED) \
                 and not k8s.condition_true(manifest, COND_RESTARTING):
+            if shape_anno is not None and shape_anno != shape:
+                # spec RESIZE/RESHAPE (numSlices/topology changed): the old
+                # shape is baked into every survivor's KFTPU_* env, so the
+                # gang restarts on the new shape — deliberately, without
+                # burning backoff budget (an operator action, not a
+                # failure). No by_name guard: even with every pod already
+                # gone this path must run so resumeFrom is set.
+                return self._handle_gang_failure(
+                    client, job, manifest, pods,
+                    sorted(by_name) or ["<all>"],
+                    reason="GangResized", count_restart=False)
+            # a missing annotation (pre-annotation operator versions) must
+            # still protect against the slice-hang: default to vanish
+            # semantics, the safe restart
             missing = [n for n in tpu_names if n not in by_name]
             if missing:
-                return self._handle_gang_failure(client, job, manifest,
-                                                 pods, missing,
-                                                 reason="GangPodsVanished")
+                return self._handle_gang_failure(
+                    client, job, manifest, pods, missing,
+                    reason="GangPodsVanished")
 
-        created = self._ensure_pods(client, job, manifest, by_name)
+        created = self._ensure_pods(client, job, manifest, by_name,
+                                    tpu_entries)
         if created:
-            patch = {"metadata": {"annotations": {
-                GANG_SIZE_ANNOTATION: str(len(tpu_names))}}}
-            manifest = client.patch(*k8s.key_of(manifest), patch)
+            if tpu_names and shape_anno != shape:
+                manifest = client.patch(*k8s.key_of(manifest), {
+                    "metadata": {"annotations":
+                                 {GANG_SHAPE_ANNOTATION: shape}}})
             self._set_condition(client, manifest, COND_CREATED, "True",
                                 "JobCreated", f"created {created} pods")
             # the intentional-gap marker is consumed: the gang exists again
@@ -190,15 +210,18 @@ class TrainingJobReconciler(Reconciler):
                                c.process_id % rs.topology.num_hosts), c)
                 for c in contracts]
 
-    def _tpu_pod_names(self, job: TrainingJob) -> list[str]:
-        names = []
-        for rs in job.replica_specs.values():
-            if rs.is_tpu:
-                names.extend(n for n, _ in self._tpu_pod_entries(job, rs))
-        return names
+    @staticmethod
+    def _gang_shape(job: TrainingJob) -> str:
+        """Shape fingerprint of the TPU replicas (topology×slices per
+        replica type): the value behind GANG_SHAPE_ANNOTATION."""
+        parts = [f"{rtype}:{rs.topology.name}x{rs.num_slices}"
+                 for rtype, rs in sorted(job.replica_specs.items())
+                 if rs.is_tpu and rs.topology is not None]
+        return ";".join(parts)
 
     def _ensure_pods(self, client: KubeClient, job: TrainingJob,
-                     manifest: dict, existing: dict[str, dict]) -> int:
+                     manifest: dict, existing: dict[str, dict],
+                     tpu_entries: dict[str, list]) -> int:
         created = 0
         for rtype, rs in job.replica_specs.items():
             if rs.is_tpu:
@@ -206,7 +229,7 @@ class TrainingJobReconciler(Reconciler):
                 # then emit the whole set (never a partial gang)
                 gang_pods = [
                     self._build_tpu_pod(job, manifest, rs, c, pname)
-                    for pname, c in self._tpu_pod_entries(job, rs)
+                    for pname, c in tpu_entries[rtype]
                     if pname not in existing]
                 for pod in gang_pods:
                     client.create(pod)
@@ -416,10 +439,11 @@ class TrainingJobReconciler(Reconciler):
     def _handle_gang_failure(self, client: KubeClient, job: TrainingJob,
                              manifest: dict, pods: list[dict],
                              failed: list[str],
-                             reason: str = "GangRestart") -> Result:
+                             reason: str = "GangRestart",
+                             count_restart: bool = True) -> Result:
         restarts = int(k8s.annotations_of(manifest).get(
             RESTART_COUNT_ANNOTATION, "0"))
-        if restarts >= job.run_policy.backoff_limit:
+        if count_restart and restarts >= job.run_policy.backoff_limit:
             self._set_condition(
                 client, manifest, COND_FAILED, "True", "BackoffLimitExceeded",
                 f"pods {failed} failed; gang restarted {restarts} times")
@@ -433,18 +457,23 @@ class TrainingJobReconciler(Reconciler):
                               k8s.name_of(p))
             except NotFoundError:
                 pass
-        patch: dict = {"metadata": {"annotations": {
-            RESTART_COUNT_ANNOTATION: str(restarts + 1)}}}
+        patch: dict = {"metadata": {"annotations": {}}}
+        if count_restart:
+            patch["metadata"]["annotations"][RESTART_COUNT_ANNOTATION] = \
+                str(restarts + 1)
         if job.checkpoint_dir and not job.resume_from:
             # close the resume loop: the recreated gang restores from the
             # job's own checkpoints and continues from the last step
             # (SURVEY §5 — checkpoint-resume makes gang restarts cheap)
             patch["spec"] = {"resumeFrom": job.checkpoint_dir}
-        patched = client.patch(*k8s.key_of(manifest), patch)
+        patched = client.patch(*k8s.key_of(manifest), patch) \
+            if (patch["metadata"]["annotations"] or "spec" in patch) \
+            else manifest
+        budget = (f" ({restarts + 1}/{job.run_policy.backoff_limit})"
+                  if count_restart else " (not counted against backoff)")
         self._set_condition(
             client, patched, COND_RESTARTING, "True", reason,
-            f"pods {failed} failed/vanished; restarting whole gang "
-            f"({restarts + 1}/{job.run_policy.backoff_limit})")
+            f"pods {failed}: restarting whole gang{budget}")
         return Result(requeue=True)
 
     def _cleanup_pods(self, client: KubeClient, job: TrainingJob,

@@ -172,6 +172,36 @@ class TestTPUJobReconcile:
                           "kubeflow", "train")
         assert k8s.get_condition(job, "Restarting")["status"] == "False"
 
+    def test_spec_resize_restarts_gang_without_backoff(self, env):
+        """numSlices change mid-run: the old world size is baked into every
+        survivor's env, so the gang restarts on the new shape — but as an
+        operator action, not a failure (no backoff budget burned)."""
+        cluster, mgr, _ = env
+        cluster.add_tpu_slice_nodes("v5e-8", pool="tpu-pool-b")
+        cluster.create(tpujob_manifest(checkpointDir="/ckpt/train"))
+        drive(cluster, mgr)
+        job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                          "kubeflow", "train")
+        job["spec"]["replicaSpecs"]["TPU"]["numSlices"] = 2
+        cluster.update(job)
+        drive(cluster, mgr)
+        job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                          "kubeflow", "train")
+        # no failure accounting; resumeFrom set; gang-size re-recorded
+        anns = k8s.annotations_of(job)
+        assert "kubeflow.org/gang-restart-count" not in anns
+        assert anns["kubeflow.org/gang-shape"] == "TPU:v5e-8x2"
+        assert job["spec"]["resumeFrom"] == "/ckpt/train"
+        pods = {k8s.name_of(p) for p in cluster.list("v1", "Pod",
+                                                     "kubeflow")}
+        assert pods == {"train-worker-0-0", "train-worker-0-1",
+                        "train-worker-1-0", "train-worker-1-1"}
+        # every pod (old names included) carries the NEW world size
+        for p in cluster.list("v1", "Pod", "kubeflow"):
+            env_map = {e["name"]: e["value"]
+                       for e in p["spec"]["containers"][0]["env"]}
+            assert env_map["KFTPU_NUM_PROCESSES"] == "4"
+
     def test_legacy_cpu_replica_recreated_solo(self, env):
         """CPU-only legacy kinds keep the reference operators' behavior: a
         deleted PS/worker pod is recreated individually (TF gRPC

@@ -198,6 +198,25 @@ def build_dashboard_app(client: KubeClient,
                     "progress": f"{active} active" if active else "",
                     "finishedAt": "",
                 })
+        from ..katib.studyjob import STUDYJOB_API_VERSION, STUDYJOB_KIND
+        for study in list_kind(STUDYJOB_API_VERSION, STUDYJOB_KIND):
+            st = study.get("status") or {}
+            phase = "Pending"
+            for cond in ("Succeeded", "Failed", "Running", "Created"):
+                if k8s.condition_true(study, cond):
+                    phase = cond
+                    break
+            best = st.get("bestTrial") or {}
+            progress = ""
+            if st.get("trialsTotal"):
+                progress = (f"{st.get('trialsSucceeded', 0)}/"
+                            f"{st['trialsTotal']} trials")
+                if best.get("objective") is not None:
+                    progress += f", best {round(best['objective'], 4)}"
+            out.append({
+                "kind": STUDYJOB_KIND, "name": k8s.name_of(study),
+                "phase": phase, "progress": progress, "finishedAt": "",
+            })
         out.sort(key=lambda r: (r["kind"], r["name"]))
         return 200, out
 

@@ -224,6 +224,14 @@ class TestRunsPanel:
                 "template": {"spec": {"containers": [
                     {"name": "w", "image": "x"}]}}}}},
         })
+        study = {
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "StudyJob",
+            "metadata": {"name": "sweep", "namespace": "kubeflow"},
+            "spec": {},
+            "status": {"trialsTotal": 3, "trialsSucceeded": 2,
+                       "bestTrial": {"name": "t-1", "objective": 0.91}},
+        }
+        cluster.create(study)
         for _ in range(4):
             mgr.run_pending()
             cluster.tick()
@@ -235,6 +243,9 @@ class TestRunsPanel:
             by_name = {(r["kind"], r["name"]): r for r in runs}
             assert ("Workflow", "pipe") in by_name
             assert ("TPUJob", "train") in by_name
+            assert ("StudyJob", "sweep") in by_name
+            assert by_name[("StudyJob", "sweep")]["progress"] == \
+                "2/3 trials, best 0.91"
             assert by_name[("TPUJob", "train")]["phase"] in (
                 "Created", "Running")
             # the SPA bundle exposes the view and the sidebar links it

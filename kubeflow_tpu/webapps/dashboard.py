@@ -158,10 +158,19 @@ def build_dashboard_app(client: KubeClient,
         """Training jobs + pipeline workflows in one panel — phase,
         progress, timestamps (the run-history view the reference left to
         the external pipeline-ui image)."""
-        from ..api.trainingjob import API_VERSIONS, JOB_KINDS
+        from ..api.trainingjob import (API_VERSIONS, COND_CREATED,
+                                       COND_FAILED, COND_RUNNING,
+                                       COND_SUCCEEDED, JOB_KINDS)
         from ..cluster.client import KubeError
         from ..workflows.engine import (WORKFLOW_API_VERSION, WORKFLOW_KIND)
         ns = params["namespace"]
+
+        def phase_of(obj) -> str:
+            for cond in (COND_SUCCEEDED, COND_FAILED, COND_RUNNING,
+                         COND_CREATED):
+                if k8s.condition_true(obj, cond):
+                    return cond
+            return "Pending"
 
         def list_kind(api_version, kind):
             # a kind whose CRD is not installed must not 500 the whole
@@ -185,11 +194,7 @@ def build_dashboard_app(client: KubeClient,
             })
         for kind in JOB_KINDS:
             for job in list_kind(API_VERSIONS[kind], kind):
-                phase = "Pending"
-                for cond in ("Succeeded", "Failed", "Running", "Created"):
-                    if k8s.condition_true(job, cond):
-                        phase = cond
-                        break
+                phase = phase_of(job)
                 rstat = (job.get("status") or {}).get("replicaStatuses", {})
                 active = sum(int(v.get("active", 0))
                              for v in rstat.values() if isinstance(v, dict))
@@ -201,11 +206,7 @@ def build_dashboard_app(client: KubeClient,
         from ..katib.studyjob import STUDYJOB_API_VERSION, STUDYJOB_KIND
         for study in list_kind(STUDYJOB_API_VERSION, STUDYJOB_KIND):
             st = study.get("status") or {}
-            phase = "Pending"
-            for cond in ("Succeeded", "Failed", "Running", "Created"):
-                if k8s.condition_true(study, cond):
-                    phase = cond
-                    break
+            phase = phase_of(study)
             best = st.get("bestTrial") or {}
             progress = ""
             if st.get("trialsTotal"):

@@ -28,8 +28,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_children(job_name: str, child_basename: str,
-                  timeout: float) -> list[dict]:
+def _run_children(job_name: str, child_basename: str, timeout: float,
+                  topology: str = "v5e-8",
+                  num_slices: int = 1) -> list[dict]:
     """Spawn one child per contract host and collect their JSON lines.
 
     Pipes are drained CONCURRENTLY (a chatty child blocking on a full
@@ -38,8 +39,9 @@ def _run_children(job_name: str, child_basename: str,
     broken run can't leak processes into the rest of the session."""
     port = _free_port()
     contracts = render_contracts(job_name, "default",
-                                 parse_topology("v5e-8"))
-    assert len(contracts) == 2  # v5e-8 = 2 hosts -> 2 processes
+                                 parse_topology(topology),
+                                 num_slices=num_slices)
+    assert len(contracts) == 2  # 2 processes either way (hosts x slices)
     child = os.path.join(os.path.dirname(__file__), child_basename)
 
     procs = []
@@ -91,6 +93,20 @@ def test_two_process_full_train_loop():
     all-reduce makes the replicated state bit-identical)."""
     outs = _run_children("mptrain", "_distributed_train_child.py",
                          timeout=280)
+    for o in outs:
+        assert o["steps"] == 3
+    assert outs[0]["loss"] == outs[1]["loss"]
+    assert outs[0]["grad_norm"] == outs[1]["grad_norm"]
+
+
+@pytest.mark.slow
+def test_two_slice_dcn_train_loop():
+    """MULTI-SLICE: two v5e-4 slices (one host each) — the processes sit on
+    opposite sides of the modeled DCN boundary, so the data axis spans
+    slices (DCN-major mesh order) and the gradient all-reduce crosses it.
+    Same bit-identical-trajectory bar as the single-slice test."""
+    outs = _run_children("dcn", "_distributed_train_child.py", timeout=280,
+                         topology="v5e-4", num_slices=2)
     for o in outs:
         assert o["steps"] == 3
     assert outs[0]["loss"] == outs[1]["loss"]

@@ -224,11 +224,19 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("--reload-interval", type=float, default=30.0,
                    help="poll the model path for new checkpoint versions "
                         "every N seconds (TF-Serving fs monitor; 0 = off)")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip compiling the padded-bucket executables at "
+                        "load (first request per bucket then pays the "
+                        "XLA compile)")
     args = p.parse_args(argv)
 
     repo = ModelRepository()
-    repo.load(args.model_name, args.model_type,
-              checkpoint_dir=args.model_path or None)
+    servable = repo.load(args.model_name, args.model_type,
+                         checkpoint_dir=args.model_path or None)
+    servable.max_batch = args.max_batch
+    if not args.no_warmup:
+        buckets = servable.warmup()
+        print(f"warmed buckets {buckets}", flush=True)
     if args.model_path and args.reload_interval:
         repo.start_polling(args.reload_interval)
     server = ModelServer(repo, port=args.rest_port,

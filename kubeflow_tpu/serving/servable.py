@@ -93,6 +93,34 @@ class Servable:
             self._stats["predict_seconds"] += dt
         return jax.tree.map(lambda x: np.asarray(x)[:n], out)
 
+    def warmup(self, buckets: Optional[list[int]] = None) -> list[int]:
+        """Compile the padded-bucket executables BEFORE serving traffic
+        (SURVEY §7 hard part e: serving cold-start — jit compiles per
+        input shape, so the first request on each bucket otherwise pays
+        seconds of XLA compile). Runs a zero batch through each bucket;
+        default = every power-of-two bucket up to max_batch. TF-Serving's
+        model-warmup records play the same role."""
+        sig = self.input_signature.get("inputs") or {}
+        shape_tail = list(sig.get("shape") or [])[1:]
+        if not shape_tail or any(d is None or d <= 0 for d in shape_tail):
+            return []  # no synthesizable input shape declared
+        if buckets is None:
+            buckets, b = [], 1
+            while b < self.max_batch:
+                buckets.append(b)
+                b *= 2
+            # the cap bucket itself: oversized requests pad to max_batch,
+            # which the doubling loop skips when it is not a power of two
+            buckets.append(self.max_batch)
+        dtype = np.dtype(sig.get("dtype", "float32"))
+        with self._lock:
+            before = dict(self._stats)
+        for b in buckets:
+            self.predict(np.zeros((b, *shape_tail), dtype))
+        with self._lock:  # warmup traffic must not move serving metrics
+            self._stats.update(before)
+        return buckets
+
     def swap(self, params: PyTree, version: int) -> None:
         """Hot-swap to a newer model version. In-flight predicts finish on
         the old params (they captured the reference); the jit cache keys on

@@ -326,3 +326,46 @@ class TestServingClient:
         out = top_k([0.1, 5.0, 1.0], k=2, labels=["cat", "dog", "fish"])
         assert out[0]["label"] == "dog"
         assert abs(sum(o["score"] for o in top_k([0.1, 5.0, 1.0], k=3)) - 1.0) < 1e-5
+
+
+def test_warmup_compiles_buckets_without_polluting_stats():
+    """SURVEY §7 hard part (e): cold-start — every padded bucket is
+    compiled at load, so the first real request never pays XLA compile,
+    and warmup traffic does not count in serving stats."""
+    s = _servable()
+    s.max_batch = 8
+    buckets = s.warmup()
+    assert buckets == [1, 2, 4, 8]
+    assert s._jit_predict._cache_size() == 4  # one executable per bucket
+    assert s.metadata()["stats"]["request_count"] == 0
+    # a real request on any bucket is now a cache hit
+    out = s.predict(np.ones((3, 4), np.float32))
+    np.testing.assert_allclose(out["y"], 2.0 * np.ones((3, 4)))
+    assert s._jit_predict._cache_size() == 4  # padded to bucket 4: no compile
+    assert s.metadata()["stats"]["request_count"] == 1
+
+
+def test_warmup_no_signature_is_noop():
+    from kubeflow_tpu.serving.servable import Servable
+    s = Servable(name="x", predict_fn=lambda p, x: {"y": x},
+                 params={}, input_signature={})
+    assert s.warmup() == []
+    # shape-less / dynamic signatures are no-ops too, never KeyErrors
+    s2 = Servable(name="y", predict_fn=lambda p, x: {"y": x},
+                  params={}, input_signature={"inputs": {"dtype": "int32"}})
+    assert s2.warmup() == []
+
+
+def test_warmup_covers_non_power_of_two_cap():
+    s = _servable()
+    s.max_batch = 12
+    assert s.warmup() == [1, 2, 4, 8, 12]  # the cap bucket is warmed too
+
+
+def test_rewarmup_preserves_serving_stats():
+    s = _servable()
+    s.max_batch = 4
+    s.predict(np.ones((2, 4), np.float32))
+    assert s.metadata()["stats"]["request_count"] == 1
+    s.warmup()  # re-warm after serving: counters must not move backwards
+    assert s.metadata()["stats"]["request_count"] == 1

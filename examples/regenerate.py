@@ -1,0 +1,36 @@
+"""Regenerate the example YAMLs from the component builders (the YAMLs in
+this directory are render OUTPUTS — the builders in kubeflow_tpu/manifests
+are the source of truth; tests/test_examples.py keeps them in sync)."""
+
+import os
+
+from kubeflow_tpu.manifests import build_component
+from kubeflow_tpu.utils.yamlio import dump_all
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+EXAMPLES = [
+    ("tpu-job-simple", "tpu-job-simple.yaml", {"topology": "v5e-32"}),
+    ("tf-job-simple", "tf-job-simple.yaml", {}),
+    ("tpu-serving-simple", "tpu-serving-simple.yaml", {}),
+    ("katib-studyjob-example", "katib-studyjob-example.yaml", {}),
+]
+
+
+def render(component: str, params: dict) -> str:
+    header = (f"# Rendered from the {component!r} component "
+              f"(kubeflow_tpu/manifests) — regenerate with\n"
+              f"#   python examples/regenerate.py\n")
+    return header + dump_all(build_component(component, params))
+
+
+def main() -> int:
+    for component, fname, params in EXAMPLES:
+        with open(os.path.join(HERE, fname), "w") as f:
+            f.write(render(component, params))
+        print("wrote", fname)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

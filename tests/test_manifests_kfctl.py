@@ -438,3 +438,74 @@ class TestLocalPlatformDrivers:
                            match="not found|not running|failed"):
             Minikube(runner=lambda cmd: _subprocess_runner(
                 ["definitely-not-a-binary-xyz"])).init(KfDef(name="k"))
+
+
+class TestGoldenManifestsRound3:
+    """Golden-shape asserts for the packages only the generic render loop
+    touched (observability, multitenancy, GCP auth/storage, pipelines)."""
+
+    def test_prometheus_scrapes_platform_targets(self):
+        objs = build_component("prometheus")
+        cm = next(o for o in objs if o["kind"] == "ConfigMap")
+        conf = "".join(cm["data"].values())
+        assert "scrape_configs" in conf
+        dep = next(o for o in objs if o["kind"] == "Deployment")
+        assert "prometheus" in dep["spec"]["template"]["spec"][
+            "containers"][0]["image"]
+
+    def test_tpu_device_plugin_daemonset(self):
+        objs = build_component("tpu-device-plugin")
+        ds = next(o for o in objs if o["kind"] == "DaemonSet")
+        spec = ds["spec"]["template"]["spec"]
+        # lands ONLY on TPU nodes (the gpu-driver.libsonnet slot) — a
+        # toleration alone would schedule it everywhere
+        assert "cloud.google.com/gke-tpu-accelerator" in \
+            spec["nodeSelector"]
+
+    def test_profiles_crd_and_controller(self):
+        objs = build_component("profiles")
+        crd = next(o for o in objs if o["kind"] == "CustomResourceDefinition")
+        assert crd["spec"]["names"]["kind"] == "Profile"
+        assert crd["spec"]["scope"] == "Cluster"
+
+    def test_credentials_pod_preset_shape(self):
+        objs = build_component("credentials-pod-preset")
+        pd = next(o for o in objs if o["kind"] == "PodDefault")
+        assert pd["spec"].get("env") or pd["spec"].get("volumeMounts")
+
+    def test_iap_ingress_wires_jwt_key(self):
+        objs = build_component("iap-ingress")
+        by_kind = {}
+        for o in objs:
+            by_kind.setdefault(o["kind"], []).append(o)
+        dep = by_kind["Deployment"][0]
+        args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--mode=iap" in args
+        secret_vols = {v["secret"]["secretName"]
+                       for v in dep["spec"]["template"]["spec"]["volumes"]
+                       if v.get("secret")}
+        assert "iap-ingress-key" in secret_vols  # the JWT signing key
+
+    def test_cert_manager_crds(self):
+        objs = build_component("cert-manager")
+        kinds = {o["spec"]["names"]["kind"] for o in objs
+                 if o["kind"] == "CustomResourceDefinition"}
+        assert "Certificate" in kinds
+
+    def test_minio_and_db_have_storage(self):
+        for comp in ("minio", "pipeline-db"):
+            objs = build_component(comp)
+            kinds = [o["kind"] for o in objs]
+            assert "PersistentVolumeClaim" in kinds, comp
+
+    def test_pipeline_viewer_crd(self):
+        objs = build_component("pipeline-viewercrd")
+        crd = next(o for o in objs if o["kind"] == "CustomResourceDefinition")
+        assert crd["spec"]["names"]["kind"] == "Viewer"
+
+    def test_gcp_filestore_pv_pvc_pair(self):
+        objs = build_component("gcp-filestore",
+                               {"server_ip": "10.0.0.2"})
+        pv = next(o for o in objs if o["kind"] == "PersistentVolume")
+        assert pv["spec"]["nfs"]["server"] == "10.0.0.2"
+        assert any(o["kind"] == "PersistentVolumeClaim" for o in objs)

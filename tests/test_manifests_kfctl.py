@@ -505,7 +505,9 @@ class TestGoldenManifestsRound3:
 
     def test_gcp_filestore_pv_pvc_pair(self):
         objs = build_component("gcp-filestore",
-                               {"server_ip": "10.0.0.2"})
+                               {"server_ip": "10.9.9.9"})  # non-default:
+        # the builder falls back to 10.0.0.2, so only a non-default value
+        # proves the param is actually wired
         pv = next(o for o in objs if o["kind"] == "PersistentVolume")
-        assert pv["spec"]["nfs"]["server"] == "10.0.0.2"
+        assert pv["spec"]["nfs"]["server"] == "10.9.9.9"
         assert any(o["kind"] == "PersistentVolumeClaim" for o in objs)

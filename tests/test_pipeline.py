@@ -115,6 +115,31 @@ class TestPipelinedTransformer:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=5e-2, atol=8e-2)
 
+    def test_f32_schedule_is_exactly_transparent(self):
+        """At f32 the GPipe schedule is numerically transparent (no bf16
+        boundary-cast rounding): scan path and pipeline path agree to
+        float tolerance for every microbatch count, and different
+        microbatch counts agree with each other."""
+        cfg = T.TransformerConfig(vocab_size=64, num_layers=4, embed_dim=32,
+                                  num_heads=2, head_dim=16, mlp_dim=64,
+                                  max_seq_len=16, dtype=jnp.float32)
+        model = T.PipelinedTransformerLM(cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, 64)
+        params = model.init(jax.random.PRNGKey(1), tokens)
+        plain = model.apply(params, tokens)  # scan path, no mesh
+        mesh = build_mesh(ShardingSpec(data=4, pipeline=2))
+        outs = []
+        for micro in (2, 4, 8):
+            piped = jax.jit(lambda p, t, m=micro: model.apply(
+                p, t, mesh=mesh, num_microbatches=m))(params, tokens)
+            np.testing.assert_allclose(np.asarray(piped), np.asarray(plain),
+                                       rtol=2e-5, atol=2e-5)
+            outs.append(np.asarray(piped))
+        # the schedule must not change WHAT is computed, only when (each
+        # microbatch count is a different XLA program, so float tolerance,
+        # not bit equality)
+        np.testing.assert_allclose(outs[0], outs[2], rtol=2e-5, atol=2e-5)
+
     def test_logical_axes_cover_stacked_tree(self):
         cfg = T.TransformerConfig.tiny()
         model = T.PipelinedTransformerLM(cfg)

@@ -255,7 +255,8 @@ class ImageNetSource:
                  augment: bool = True, pad_px: int = 4,
                  num_threads: int = 2, queue_depth: int = 4,
                  image_dtype: Optional[np.dtype] = None,
-                 output: str = "normalized"):
+                 output: str = "normalized",
+                 drop_remainder: bool = True):
         if output not in ("normalized", "uint8"):
             raise ValueError(f"output {output!r} not in "
                              "('normalized', 'uint8')")
@@ -281,7 +282,10 @@ class ImageNetSource:
         # validate from meta; the pipeline itself is constructed lazily on
         # first epoch() with the real seed (constructing it here would
         # start a prefetch pass epoch() immediately throws away)
-        self.num_batches = int(self.meta["num_records"]) // batch_size
+        self.drop_remainder = drop_remainder
+        n_rec = int(self.meta["num_records"])
+        self.num_batches = (n_rec // batch_size if drop_remainder
+                            else -(-n_rec // batch_size))
         if self.num_batches == 0:
             raise ValueError(
                 f"{data_dir}: {self.meta['num_records']} records < "
@@ -333,7 +337,8 @@ class ImageNetSource:
             self._pipeline = RecordPipeline(
                 self._paths, self.meta["record_bytes"], self.batch_size,
                 num_threads=self._num_threads,
-                queue_depth=self._queue_depth, seed=seed + epoch)
+                queue_depth=self._queue_depth, seed=seed + epoch,
+                drop_remainder=self.drop_remainder)
         else:
             self._pipeline.reset(seed + epoch)
         for i, raw in enumerate(self._pipeline):

@@ -145,15 +145,20 @@ def resnet152(num_classes: int = 1000, **kw) -> ResNet:
     return make_resnet(152, num_classes, **kw)
 
 
-def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
-                       label_smoothing: float = 0.0) -> jax.Array:
+def per_row_cross_entropy(logits: jax.Array, labels: jax.Array,
+                          label_smoothing: float = 0.0) -> jax.Array:
     onehot = jax.nn.one_hot(labels, logits.shape[-1])
     if label_smoothing:
         # the tf_cnn_benchmarks/ResNet recipe regularizer (0.1 for the
         # 76%-top-1 ImageNet run)
         n = logits.shape[-1]
         onehot = onehot * (1.0 - label_smoothing) + label_smoothing / n
-    return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+    return -jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1)
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
+                       label_smoothing: float = 0.0) -> jax.Array:
+    return jnp.mean(per_row_cross_entropy(logits, labels, label_smoothing))
 
 
 def make_loss_fn(model: ResNet, label_smoothing: float = 0.0) -> Callable:
@@ -173,16 +178,26 @@ def make_loss_fn(model: ResNet, label_smoothing: float = 0.0) -> Callable:
 
 def make_eval_fn(model: ResNet) -> Callable:
     """Eval pass: running-stats forward (train=False), top-1/top-5 — the
-    metrics the ImageNet acceptance target is stated in."""
+    metrics the ImageNet acceptance target is stated in.
+
+    An optional ``batch["weight"]`` (float (B,), 0/1) masks rows out of
+    every metric: the worker pads the holdout's final partial batch to
+    the compiled batch shape and zero-weights the padding, so a full
+    eval pass counts every record exactly once."""
 
     def eval_fn(params, variables, batch):
         images, labels = batch["images"], batch["labels"]
         logits = model.apply({"params": params, **variables}, images,
                              train=False)
-        loss = cross_entropy_loss(logits, labels)
-        top1 = jnp.mean(jnp.argmax(logits, -1) == labels)
+        w = batch.get("weight")
+        if w is None:
+            w = jnp.ones((labels.shape[0],), jnp.float32)
+        denom = jnp.maximum(jnp.sum(w), 1.0)
+        loss = jnp.sum(per_row_cross_entropy(logits, labels) * w) / denom
+        top1 = jnp.sum((jnp.argmax(logits, -1) == labels) * w) / denom
         _, top5_idx = jax.lax.top_k(logits, 5)
-        top5 = jnp.mean(jnp.any(top5_idx == labels[:, None], axis=-1))
+        top5 = jnp.sum(
+            jnp.any(top5_idx == labels[:, None], axis=-1) * w) / denom
         return {"eval_loss": loss, "top1": top1, "top5": top5}
 
     return eval_fn

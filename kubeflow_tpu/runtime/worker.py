@@ -162,6 +162,12 @@ def train(
     # real-data path: shard dirs are self-describing, so the dataset's
     # geometry configures the model (launcher.py --data_dir analog)
     data_dir = data_dir or os.environ.get("KFTPU_DATA_DIR")
+    eval_data_dir = eval_data_dir or os.environ.get("KFTPU_EVAL_DATA_DIR")
+    if eval_data_dir and workload not in _IMAGE_WORKLOADS:
+        # mirror the data_dir check below: a transformer job pointed at
+        # image shards must fail at startup, not mid-run at the first eval
+        raise ValueError(
+            f"workload {workload!r} does not consume --eval-data-dir")
     data_source = None
     if data_dir:
         if workload not in _IMAGE_WORKLOADS:
@@ -234,7 +240,6 @@ def train(
     step_fn = builder.build()
 
     # -- eval pass (running-stats forward, top-1/top-5) ---------------------
-    eval_data_dir = eval_data_dir or os.environ.get("KFTPU_EVAL_DATA_DIR")
     eval_step = None
     eval_source = None
     if eval_every and spec.eval_fn is not None:
@@ -261,16 +266,41 @@ def train(
                     dp)
                 eval_step = None
             else:
+                # drop_remainder=False: the final partial batch comes
+                # through short and run_eval pads+masks it, so a full
+                # pass counts every holdout record exactly once
                 eval_source = ImageNetSource(eval_data_dir,
                                              batch_size=eval_bs,
-                                             augment=False)
+                                             augment=False,
+                                             drop_remainder=False)
+
+    def _pad_mask(batch) -> tuple[dict, float]:
+        """Pad a (possibly short) holdout batch to the compiled eval
+        shape, 0/1-weighting the rows so eval_fn masks the padding out
+        of every metric. Returns (batch, real-record count)."""
+        import numpy as np
+        n = int(batch["labels"].shape[0])
+        w = np.ones((n,), np.float32)
+        if n < eval_bs:
+            pad = eval_bs - n
+            batch = {
+                "images": np.concatenate(
+                    [batch["images"],
+                     np.zeros((pad,) + batch["images"].shape[1:],
+                              batch["images"].dtype)]),
+                "labels": np.concatenate(
+                    [batch["labels"], np.zeros((pad,), np.int32)]),
+            }
+            w = np.concatenate([w, np.zeros((pad,), np.float32)])
+        return dict(batch, weight=w), float(n)
 
     def run_eval(state) -> dict:
         """Average spec.eval_fn over at most ONE pass of the held-out
         shards (never resampled). eval_batches caps the pass for cheap
-        mid-run checks; eval_batches=0 means the FULL holdout — what the
-        final acceptance number must be measured on (a subsample's
-        sampling error can flip a 76%-top-1 verdict)."""
+        mid-run checks; eval_batches=0 means the FULL holdout — every
+        record counted exactly once (the tail batch is padded + masked)
+        — what the final acceptance number must be measured on (a
+        subsample's sampling error can flip a 76%-top-1 verdict)."""
         if eval_source is not None:
             eval_iter = eval_source.epoch(0, seed + 2)
             n_batches = eval_source.num_batches if eval_batches <= 0 \
@@ -282,16 +312,21 @@ def train(
                 jax.random.fold_in(jax.random.PRNGKey(seed + 2), i),
                 global_batch)
         totals: dict = {}
-        n = 0
+        denom = 0.0
         for i in range(n_batches):
-            eb = builder.place_batch(next_batch(i))
+            b = next_batch(i)
+            if eval_source is not None:
+                b, bw = _pad_mask(b)
+            else:
+                bw = 1.0
+            eb = builder.place_batch(b)
             em = eval_step(state, eb)
             for k, v in em.items():
-                totals[k] = totals.get(k, 0.0) + float(v)
-            n += 1
-        if not n:
+                totals[k] = totals.get(k, 0.0) + float(v) * bw
+            denom += bw
+        if not denom:
             return {}
-        out = {k: v / n for k, v in totals.items()}
+        out = {k: v / denom for k, v in totals.items()}
         if "eval_perplexity" in out and "eval_loss" in out:
             # perplexity = exp(MEAN loss); a mean of per-batch exp(loss)
             # is biased high (Jensen), so rederive from the averaged loss

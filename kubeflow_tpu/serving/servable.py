@@ -113,12 +113,15 @@ class Servable:
             # which the doubling loop skips when it is not a power of two
             buckets.append(self.max_batch)
         dtype = np.dtype(sig.get("dtype", "float32"))
-        with self._lock:
-            before = dict(self._stats)
+        # Compile through the jit wrapper directly: warmup must not move
+        # serving metrics, and a snapshot/restore of _stats would also
+        # discard increments from REAL requests landing concurrently
+        # (the re-warm-under-traffic case test_serving exercises).
         for b in buckets:
-            self.predict(np.zeros((b, *shape_tail), dtype))
-        with self._lock:  # warmup traffic must not move serving metrics
-            self._stats.update(before)
+            out = self._jit_predict(self.params,
+                                    jnp.asarray(np.zeros((b, *shape_tail),
+                                                         dtype)))
+            jax.device_get(out)
         return buckets
 
     def swap(self, params: PyTree, version: int) -> None:

@@ -307,8 +307,10 @@ def report_from_metrics(metrics_path: str, *, job_kind: str = "TPUJob",
     if not rows:
         raise ValueError(f"no timed step records in {metrics_path}")
     for ev in events:
+        # an event earlier than every timed record folds into the FIRST
+        # record (nearest by step), not the last
         tgt = max((r for r in rows if r["step"] <= ev.get("step", 0)),
-                  key=lambda r: r["step"], default=rows[-1])
+                  key=lambda r: r["step"], default=rows[0])
         tgt.setdefault("metrics", {}).update(ev.get("metrics") or {})
     steady = rows[warmup:] if len(rows) > warmup else rows
     # records may be multi-step windows (worker sync_every): weight by the

@@ -322,3 +322,61 @@ class TestUint8DeviceNormalize:
         d, *_ = data_dir
         with pytest.raises(ValueError, match="output"):
             ImageNetSource(d, batch_size=8, output="float64")
+
+
+class TestEvalTailHandling:
+    """ADVICE r3: eval_batches=0 must count EVERY holdout record — the
+    tail batch comes through short (drop_remainder=False), gets padded
+    to the compiled shape, and the padding is weight-masked out."""
+
+    def test_drop_remainder_false_yields_short_tail(self, data_dir):
+        d, *_ = data_dir
+        with ImageNetSource(d, batch_size=20, augment=False,
+                            drop_remainder=False) as src:
+            assert src.num_batches == 3  # 48 = 2*20 + tail of 8
+            sizes = [b["labels"].shape[0] for b in src.epoch(0, seed=1)]
+        assert sizes == [20, 20, 8]
+
+    def test_eval_fn_weight_masks_padding_exactly(self):
+        import jax
+
+        from kubeflow_tpu.models.resnet import (init_fn, make_eval_fn,
+                                                make_resnet)
+        model = make_resnet(18, num_classes=CLASSES)
+        params, variables = init_fn(model, image_size=SIZE, batch=2)(
+            jax.random.PRNGKey(0))
+        eval_fn = make_eval_fn(model)
+        rng = np.random.default_rng(0)
+        imgs = rng.normal(size=(6, SIZE, SIZE, 3)).astype(np.float32)
+        labels = (np.arange(6) % CLASSES).astype(np.int32)
+        full = eval_fn(params, variables,
+                       {"images": imgs, "labels": labels})
+        # pad 2 garbage rows and mask them: metrics must match exactly
+        pimgs = np.concatenate(
+            [imgs, 7.0 * np.ones((2, SIZE, SIZE, 3), np.float32)])
+        plabels = np.concatenate([labels, np.zeros((2,), np.int32)])
+        w = np.concatenate([np.ones(6), np.zeros(2)]).astype(np.float32)
+        masked = eval_fn(params, variables,
+                         {"images": pimgs, "labels": plabels, "weight": w})
+        for k in full:
+            assert abs(float(full[k]) - float(masked[k])) < 1e-5, k
+
+    def test_full_holdout_covers_non_divisible_val_set(self, data_dir,
+                                                       tmp_path):
+        d, images, labels = data_dir
+        val = str(tmp_path / "val")
+        write_shards(val, images[:10], labels[:10], num_classes=CLASSES)
+        from kubeflow_tpu.runtime.worker import train
+        # global_batch 8 → eval_bs 8 → 10 records = 1 full + padded tail
+        r = train(workload="resnet18", steps=1, global_batch=8,
+                  data_dir=d, eval_data_dir=val, eval_every=1,
+                  eval_batches=0, sync_every=1, seed=5)
+        assert "top1" in r.final_metrics
+        assert 0.0 <= r.final_metrics["top1"] <= 1.0
+
+    def test_eval_data_dir_rejected_for_non_image_workload(self, data_dir):
+        d, *_ = data_dir
+        from kubeflow_tpu.runtime.worker import train
+        with pytest.raises(ValueError, match="eval-data-dir"):
+            train(workload="transformer", steps=1, global_batch=8,
+                  eval_data_dir=d, eval_every=1, seed=0)

@@ -369,3 +369,28 @@ def test_rewarmup_preserves_serving_stats():
     assert s.metadata()["stats"]["request_count"] == 1
     s.warmup()  # re-warm after serving: counters must not move backwards
     assert s.metadata()["stats"]["request_count"] == 1
+
+
+def test_rewarm_under_traffic_keeps_concurrent_request_stats():
+    """ADVICE r3: a re-warm concurrent with live traffic must not discard
+    stats increments from real requests landing during the warmup window
+    (the old snapshot/restore did)."""
+    import threading
+
+    s = _servable()
+    s.max_batch = 8
+    s.warmup()
+    n_requests = 20
+    stop = threading.Event()
+
+    def traffic():
+        for _ in range(n_requests):
+            s.predict(np.ones((3, 4), np.float32))
+        stop.set()
+
+    t = threading.Thread(target=traffic)
+    t.start()
+    while not stop.is_set():  # re-warm repeatedly while traffic flows
+        s.warmup()
+    t.join()
+    assert s.metadata()["stats"]["request_count"] == n_requests

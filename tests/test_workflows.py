@@ -338,6 +338,26 @@ class TestKubebench:
         assert row["examples_per_sec"] == 320.0
         assert row["metric_loss"] == pytest.approx(1.6)
 
+    def test_early_event_folds_into_first_record(self, tmp_path):
+        """An event record earlier than every timed step folds into the
+        FIRST record, not the last (ADVICE r3): it must not masquerade
+        as a final-step model metric."""
+        import json
+        from kubeflow_tpu.workflows.kubebench import report_from_metrics
+        path = tmp_path / "metrics.jsonl"
+        rows = [{"step": 0, "event": "eval",
+                 "metrics": {"startup_top1": 0.001}}]
+        rows += [{"step": i + 1, "step_time_s": 0.1,
+                  "examples_per_sec": 320.0,
+                  "metrics": {"loss": 1.0}} for i in range(3)]
+        rows += [{"step": 3, "event": "eval", "metrics": {"top1": 0.5}}]
+        path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        row = report_from_metrics(str(path), env={})
+        # the late event folded into the last record and is reported...
+        assert row["metric_top1"] == pytest.approx(0.5)
+        # ...the startup event folded into the FIRST record, so it is not
+        assert "metric_startup_top1" not in row
+
 
 class TestWorkflowEdgeCases:
     def test_task_missing_template_key_errors_cleanly(self, env):

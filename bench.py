@@ -1,4 +1,4 @@
-"""Benchmark: ResNet-50 synthetic-ImageNet training throughput on TPU.
+"""Benchmark: training throughput on TPU (ResNet-50 primary + sub-benches).
 
 The vehicle matches the reference's headline benchmark machinery — the
 tf_cnn_benchmarks ResNet-50 TFJob (tf-controller-examples/tf-cnn/;
@@ -6,9 +6,18 @@ kubeflow/examples/prototypes/tf-job-simple-v1.jsonnet runs it with synthetic
 data). The reference publishes no numbers (BASELINE.md), so the baseline is
 our own recorded first-light figure; vs_baseline = value / BASELINE_IMG_S.
 
-Prints ONE JSON line:
+Default run prints ONE JSON line (the driver contract):
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
    "mfu": N, "extras": {...}}
+with two sub-benchmarks folded into extras (each failure-guarded so the
+primary artifact always lands):
+  - extras.fused: the ghost-BN fused-block variant (ops/fused_block_train)
+  - extras.lm: transformer-LM tokens/sec + MFU (bf16, flash attention,
+    chip-filling batch — the compute-bound workload whose MFU the HBM
+    roofline can't excuse)
+
+`--mode resnet|resnet-fused|lm` runs one benchmark standalone and prints
+its own JSON line (used while tuning; the driver runs the default).
 
 mfu is computed against the DETECTED chip generation's bf16 peak; extras
 also reports mfu against the chip's *measured* achievable matmul rate
@@ -100,9 +109,178 @@ def _probe_backend(timeout_s: float = 180.0) -> bool:
     return False
 
 
-def main() -> int:
+def _measure(step_fn, state, batch, steps: int, warmup: int,
+             t_start: float) -> tuple[float, float, float]:
+    """Run the step loop with hard host-fetch barriers. Returns
+    (wall seconds for `steps`, startup→first-step seconds, last loss).
+
+    Sync via host transfer (float()), not block_until_ready: on the
+    tunneled axon platform block_until_ready returns before the compute
+    finishes, which inflated throughput ~70x; a device->host fetch of the
+    last step's loss is a hard barrier everywhere."""
+    state, metrics = step_fn(state, batch)
+    float(metrics["loss"])
+    first_step_s = time.perf_counter() - t_start
+    for _ in range(warmup - 1):
+        state, metrics = step_fn(state, batch)
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch)
+    loss = float(metrics["loss"])
+    return time.perf_counter() - t0, first_step_s, loss
+
+
+def bench_resnet(fused: bool = False, t_start: float | None = None) -> dict:
+    """ResNet-50 synthetic-ImageNet training throughput (the headline
+    number). fused=True runs the opt-in ghost-BN fused-block variant
+    (ops/fused_block_train.py) — same model FLOPs, fewer HBM bytes."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubeflow_tpu.models import resnet as R
+    from kubeflow_tpu.parallel.mesh import build_mesh
+    from kubeflow_tpu.runtime.trainstep import TrainStepBuilder
+
+    t_start = time.perf_counter() if t_start is None else t_start
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    n_chips = len(jax.devices())
+    if on_tpu:
+        # batch 128/chip measured fastest on v5e (128: ~2600, 256: ~2500,
+        # 512: ~2360, 1024: ~2020 img/s) — the step is HBM-roofline-bound
+        # (PERF.md), so larger batches only add activation traffic
+        batch_per_chip, image_size, steps, warmup = 128, 224, 40, 4
+    else:  # CPU smoke mode so the script stays runnable anywhere
+        batch_per_chip, image_size, steps, warmup = 8, 64, 4, 1
+    global_batch = batch_per_chip * n_chips
+
+    mesh = build_mesh()
+    model = R.resnet50(num_classes=1000)
+    loss_fn = R.make_fused_loss_fn(model, mesh=mesh) if fused \
+        else R.make_loss_fn(model)
+    builder = TrainStepBuilder(
+        mesh=mesh,
+        loss_fn=loss_fn,
+        optimizer=optax.chain(optax.clip_by_global_norm(1.0),
+                              optax.sgd(0.1, momentum=0.9)),
+    )
+    state = builder.init(R.init_fn(model, image_size=image_size),
+                         jax.random.PRNGKey(0))
+    step_fn = builder.build()
+    batch = R.synthetic_batch(jax.random.PRNGKey(1), global_batch, image_size)
+    if on_tpu:
+        # feed bf16 images: the model's first act is the bf16 cast, so this
+        # is loss-free and halves the input-image HBM read (PERF.md)
+        batch["images"] = batch["images"].astype(jnp.bfloat16)
+    batch = builder.place_batch(batch)
+
+    dt, first_step_s, loss = _measure(step_fn, state, batch, steps, warmup,
+                                      t_start)
+    img_s_chip = global_batch * steps / dt / n_chips
+    flops_per_chip = img_s_chip * TRAIN_GFLOP_PER_IMAGE * 1e9
+    peak = detect_peak_tflops(dev)
+    return {
+        "metric": "resnet50_synthetic_imagenet_train_throughput" +
+                  ("_fused" if fused else ""),
+        "value": round(img_s_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_s_chip / BASELINE_IMG_S, 3),
+        "mfu": round(flops_per_chip / (peak * 1e12), 3) if peak else None,
+        "extras": {
+            "device_kind": getattr(dev, "device_kind", dev.platform),
+            "startup_first_step_s": round(first_step_s, 2),
+            "peak_tflops_spec": peak,
+            "model_tflops": round(flops_per_chip / 1e12, 1),
+            "global_batch": global_batch,
+            "loss": round(loss, 3),
+        },
+        "_flops_per_chip": flops_per_chip,
+    }
+
+
+def bench_lm(t_start: float | None = None) -> dict:
+    """Transformer-LM training throughput: tokens/sec + MFU (bf16, flash
+    attention, chip-filling batch). The compute-bound companion to the
+    memory-bound ResNet number — its MFU is the honest utilization
+    figure for the LLM parallelism stack (VERDICT r3 item 3)."""
+    import jax
+    import optax
+
+    from kubeflow_tpu.models import transformer as T
+    from kubeflow_tpu.parallel.mesh import build_mesh
+    from kubeflow_tpu.runtime.trainstep import TrainStepBuilder
+
+    t_start = time.perf_counter() if t_start is None else t_start
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    n_chips = len(jax.devices())
+    if on_tpu:
+        # ~217M-param LM (GPT-2-medium width at half its depth); 32k
+        # tokens/step fills the chip (seq 1024 x batch 32/chip) without
+        # breaching v5e HBM
+        cfg = T.TransformerConfig(
+            vocab_size=32000, num_layers=12, embed_dim=1024, num_heads=16,
+            head_dim=64, mlp_dim=4096, max_seq_len=1024, attention="flash")
+        seq_len, batch_per_chip, steps, warmup = 1024, 32, 20, 3
+    else:
+        cfg = T.TransformerConfig.tiny()
+        seq_len, batch_per_chip, steps, warmup = 128, 4, 3, 1
+    global_batch = batch_per_chip * n_chips
+
+    spec = T.workload_spec(cfg, seq_len=seq_len)
+    builder = TrainStepBuilder(
+        mesh=build_mesh(), loss_fn=spec.loss_fn,
+        optimizer=optax.adamw(3e-4),
+        rules=spec.rules, param_logical_axes=spec.param_logical_axes)
+    state = builder.init(spec.init_fn, jax.random.PRNGKey(0))
+    step_fn = builder.build()
+    batch = builder.place_batch(
+        spec.batch_fn(jax.random.PRNGKey(1), global_batch))
+
+    dt, first_step_s, loss = _measure(step_fn, state, batch, steps, warmup,
+                                      t_start)
+    tok_s_chip = global_batch * seq_len * steps / dt / n_chips
+    # 6P per token (fwd+bwd matmul MACs) + attention 12·L·d_attn·s
+    d = cfg.embed_dim
+    p_matmul = (12 * cfg.num_layers * d * d
+                + 2 * cfg.vocab_size * d)       # qkv/proj/mlp + embed/head
+    attn = 12 * cfg.num_layers * (cfg.num_heads * cfg.head_dim) * seq_len
+    flops_per_tok = 6 * p_matmul + attn
+    flops_per_chip = tok_s_chip * flops_per_tok
+    peak = detect_peak_tflops(dev)
+    return {
+        "metric": "transformer_lm_train_throughput",
+        "value": round(tok_s_chip, 0),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,   # first measured LM line IS the baseline
+        "mfu": round(flops_per_chip / (peak * 1e12), 3) if peak else None,
+        "extras": {
+            "device_kind": getattr(dev, "device_kind", dev.platform),
+            "startup_first_step_s": round(first_step_s, 2),
+            "params_m": round(p_matmul / 1e6),
+            "seq_len": seq_len,
+            "global_batch": global_batch,
+            "tokens_per_step": global_batch * seq_len,
+            "model_tflops": round(flops_per_chip / 1e12, 1),
+            "attention": cfg.attention,
+            "loss": round(loss, 3),
+        },
+        "_flops_per_chip": flops_per_chip,
+    }
+
+
+def main(argv=None) -> int:
     t_start = time.perf_counter()
+    import argparse
     import os
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--mode", default="all",
+                   choices=["all", "resnet", "resnet-fused", "lm"])
+    args = p.parse_args(argv)
+
     # the fallback child carries this marker: never probe/respawn again
     # (a second failure must end the chain, not fork a grandchild)
     backend_ok = bool(os.environ.get("KFTPU_BENCH_BACKEND_ERROR")) or \
@@ -114,100 +292,52 @@ def main() -> int:
                "PALLAS_AXON_POOL_IPS": "",
                "KFTPU_BENCH_BACKEND_ERROR": "tpu backend unreachable"}
         import subprocess
-        return subprocess.call([sys.executable, __file__], env=env)
+        return subprocess.call([sys.executable, __file__] +
+                               (argv or sys.argv[1:]), env=env)
     import jax
-    import optax
-
-    from kubeflow_tpu.models import resnet as R
-    from kubeflow_tpu.parallel.mesh import build_mesh
-    from kubeflow_tpu.runtime.trainstep import TrainStepBuilder
 
     dev = jax.devices()[0]
     platform = dev.platform
     on_tpu = platform == "tpu"
 
-    n_chips = len(jax.devices())
-    if on_tpu:
-        # batch 128/chip measured fastest on v5e (128: ~2600, 256: ~2500,
-        # 512: ~2360, 1024: ~2020 img/s) — the step is HBM-roofline-bound
-        # (PERF.md), so larger batches only add activation traffic
-        batch_per_chip, image_size, steps, warmup = 128, 224, 40, 4
-    else:  # CPU smoke mode so the script stays runnable anywhere
-        batch_per_chip, image_size, steps, warmup = 8, 64, 4, 1
-    global_batch = batch_per_chip * n_chips
+    if args.mode == "resnet-fused":
+        row = bench_resnet(fused=True, t_start=t_start)
+    elif args.mode == "lm":
+        row = bench_lm(t_start=t_start)
+    else:
+        row = bench_resnet(fused=False, t_start=t_start)
 
-    model = R.resnet50(num_classes=1000)
-    builder = TrainStepBuilder(
-        mesh=build_mesh(),
-        loss_fn=R.make_loss_fn(model),
-        optimizer=optax.chain(optax.clip_by_global_norm(1.0),
-                              optax.sgd(0.1, momentum=0.9)),
-    )
-    state = builder.init(R.init_fn(model, image_size=image_size),
-                         jax.random.PRNGKey(0))
-    step_fn = builder.build()
-    batch = R.synthetic_batch(jax.random.PRNGKey(1), global_batch, image_size)
-    if on_tpu:
-        # feed bf16 images: the model's first act is the bf16 cast, so this
-        # is loss-free and halves the input-image HBM read (PERF.md)
-        import jax.numpy as jnp
-        batch["images"] = batch["images"].astype(jnp.bfloat16)
-    batch = builder.place_batch(batch)
-
-    # sync via host transfer (float()), not block_until_ready: on the
-    # tunneled axon platform block_until_ready returns before the compute
-    # finishes, which inflated throughput ~70x; a device->host fetch of the
-    # last step's loss is a hard barrier everywhere
-    state, metrics = step_fn(state, batch)
-    float(metrics["loss"])
-    # startup→first-step latency: process start → first train step done
-    # (init + compile dominated). BASELINE.md north-star metric #2.
-    startup_first_step_s = time.perf_counter() - t_start
-
-    for _ in range(warmup - 1):
-        state, metrics = step_fn(state, batch)
-    float(metrics["loss"])
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step_fn(state, batch)
-    float(metrics["loss"])
-    dt = time.perf_counter() - t0
-
-    img_s = global_batch * steps / dt
-    img_s_chip = img_s / n_chips
-
-    flops_per_chip = img_s_chip * TRAIN_GFLOP_PER_IMAGE * 1e9
-    peak = detect_peak_tflops(dev)
-    mfu = flops_per_chip / (peak * 1e12) if peak else None
-    extras = {
-        "device_kind": getattr(dev, "device_kind", platform),
-        "startup_first_step_s": round(startup_first_step_s, 2),
-        "peak_tflops_spec": peak,
-        "model_tflops": round(flops_per_chip / 1e12, 1),
-    }
     backend_error = os.environ.get("KFTPU_BENCH_BACKEND_ERROR")
     if backend_error:
         # this run is the CPU-fallback child: record WHY the number is not
         # a TPU measurement so the artifact is never silently misread
-        extras["error"] = backend_error
+        row["extras"]["error"] = backend_error
+    flops_per_chip = row.pop("_flops_per_chip")
     if on_tpu:
         achievable = measure_achievable_tflops()
-        extras["achievable_matmul_tflops"] = round(achievable, 1)
-        extras["mfu_vs_achievable"] = round(flops_per_chip / (achievable * 1e12), 3)
+        row["extras"]["achievable_matmul_tflops"] = round(achievable, 1)
+        row["extras"]["mfu_vs_achievable"] = round(
+            flops_per_chip / (achievable * 1e12), 3)
 
-    print(json.dumps({
-        "metric": "resnet50_synthetic_imagenet_train_throughput",
-        "value": round(img_s_chip, 1),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(img_s_chip / BASELINE_IMG_S, 3),
-        "mfu": round(mfu, 3) if mfu is not None else None,
-        "extras": extras,
-    }))
-    print(f"# platform={platform} chips={n_chips} batch={global_batch} "
-          f"image={image_size} steps={steps} wall={dt:.2f}s "
-          f"loss={float(metrics['loss']):.3f} "
-          f"first_step={startup_first_step_s:.1f}s", file=sys.stderr)
+    if args.mode == "all":
+        # fold the sub-benchmarks into the primary artifact; each is
+        # guarded so a sub-bench failure can never cost the headline line
+        for key, fn in (("fused", lambda: bench_resnet(fused=True)),
+                        ("lm", bench_lm)):
+            try:
+                sub = fn()
+                row["extras"][key] = {
+                    "metric": sub["metric"], "value": sub["value"],
+                    "unit": sub["unit"], "mfu": sub["mfu"],
+                    **{k: sub["extras"][k] for k in
+                       ("model_tflops", "loss") if k in sub["extras"]},
+                }
+            except Exception as e:  # noqa: BLE001 — artifact must land
+                row["extras"][key] = {"error": f"{type(e).__name__}: {e}"}
+
+    print(json.dumps(row))
+    print(f"# platform={platform} chips={len(jax.devices())} "
+          f"mode={args.mode} extras={row['extras']}", file=sys.stderr)
     return 0
 
 

@@ -303,3 +303,162 @@ def fused_eval_apply(variables: dict, images: jax.Array, *,
     x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
     head = params["head"]
     return x @ head["kernel"].astype(jnp.float32) + head["bias"]
+
+
+# -- fused ghost-BN training path (ops/fused_block_train.py) ------------------
+
+_BN_MOMENTUM = 0.9  # must match the norm partial in ResNet.__call__
+
+
+def _bn_train(a, scale, bias, eps=1e-5):
+    """Train-mode BatchNorm over the full (local) batch in plain jnp —
+    differentiable, for the blocks the fused kernel does not cover.
+    Returns (y, batch_mean, batch_var)."""
+    f32 = jnp.float32
+    af = a.astype(f32)
+    m = jnp.mean(af, axis=(0, 1, 2))
+    v = jnp.mean(jnp.square(af), axis=(0, 1, 2)) - jnp.square(m)
+    xh = (af - m) * jax.lax.rsqrt(v + eps)
+    return (scale * xh + bias).astype(a.dtype), m, v
+
+
+def _xla_block_train(x, params, strides, dtype=jnp.bfloat16, eps=1e-5):
+    """Strided bottleneck block, train mode, via lax convs + _bn_train
+    (the fused kernel covers stride-1 blocks only). Returns
+    (out, batch-moment subtree)."""
+    from jax import lax
+
+    def conv(h, kernel, stride):
+        return lax.conv_general_dilated(
+            h, kernel.astype(dtype), (stride, stride), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    stats = {}
+
+    def bn(h, name, relu=True):
+        y, m, v = _bn_train(h, params[name]["scale"], params[name]["bias"],
+                            eps)
+        stats[name] = {"mean": m, "var": v}
+        return jax.nn.relu(y) if relu else y
+
+    y = bn(conv(x, params["Conv_0"]["kernel"], 1), "BatchNorm_0")
+    y = bn(conv(y, params["Conv_1"]["kernel"], strides), "BatchNorm_1")
+    y = bn(conv(y, params["Conv_2"]["kernel"], 1), "BatchNorm_2",
+           relu=False)
+    if "conv_proj" in params:
+        res = bn(conv(x, params["conv_proj"]["kernel"], strides),
+                 "norm_proj", relu=False)
+    else:
+        res = x
+    out = jax.nn.relu(res.astype(jnp.float32) +
+                      y.astype(jnp.float32)).astype(dtype)
+    return out, stats
+
+
+def fused_train_apply(variables: dict, images: jax.Array, *,
+                      depth: int = 50, tile_bt=None,
+                      dtype=jnp.bfloat16, eps: float = 1e-5,
+                      pmean_axes: tuple = ()) -> tuple[jax.Array, dict]:
+    """Training forward with every stride-1 bottleneck running as ONE
+    fused ghost-BN Pallas kernel (ops/fused_block_train.py) under
+    custom_vjp — the opt-in variant that cuts the HBM traffic the
+    step is roofline-bound on (PERF.md).
+
+    Ghost semantics: BN statistics are per kernel batch-tile (and per
+    data-parallel shard when called inside shard_map); running stats are
+    EMA-updated from the tile-averaged moments, pmean'd over
+    ``pmean_axes`` when set. Returns (logits, new_batch_stats)."""
+    if depth < 50:
+        raise ValueError("fused_train_apply supports bottleneck depths "
+                         "(>= 50); BasicBlock models have no Conv_2")
+    from jax import lax
+
+    from ..ops.fused_block_train import fused_bottleneck_train
+
+    params, stats = variables["params"], variables["batch_stats"]
+    batch_moments: dict = {}
+    x = images.astype(dtype)
+    x = lax.conv_general_dilated(
+        x, params["conv_init"]["kernel"].astype(dtype), (2, 2), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    y, m, v = _bn_train(x, params["bn_init"]["scale"],
+                        params["bn_init"]["bias"], eps)
+    batch_moments["bn_init"] = {"mean": m, "var": v}
+    x = jax.nn.relu(y)
+    x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+    for i, n_blocks in enumerate(STAGE_SIZES[depth]):
+        for j in range(n_blocks):
+            name = f"stage{i + 1}_block{j + 1}"
+            strides = 2 if i > 0 and j == 0 else 1
+            if strides == 1:
+                x, bstats = fused_bottleneck_train(x, params[name],
+                                                   tile_bt=tile_bt,
+                                                   eps=eps)
+            else:
+                x, bstats = _xla_block_train(x, params[name], strides,
+                                             dtype=dtype, eps=eps)
+            batch_moments[name] = bstats
+
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    head = params["head"]
+    logits = x @ head["kernel"].astype(jnp.float32) + head["bias"]
+
+    if pmean_axes:
+        batch_moments = jax.lax.pmean(batch_moments, pmean_axes)
+    # running-stat EMA, flax semantics: ra = m·ra + (1−m)·batch
+    new_stats = jax.tree.map(
+        lambda ra, b: _BN_MOMENTUM * ra + (1.0 - _BN_MOMENTUM)
+        * jax.lax.stop_gradient(b), stats, batch_moments)
+    return logits, new_stats
+
+
+def make_fused_loss_fn(model: ResNet, label_smoothing: float = 0.0,
+                       tile_bt=None, mesh=None) -> Callable:
+    """Loss fn (TrainStepBuilder signature) over fused_train_apply.
+
+    On a mesh with >1 device on the data axes the apply runs inside
+    jax.shard_map over those axes: GSPMD cannot partition an opaque
+    pallas_call, and per-shard ghost BN is exactly the per-replica BN
+    semantics data-parallel trainers ship with. Weight gradients are
+    psummed by the shard_map transpose (replicated in_spec); batch
+    moments are pmean'd explicitly before the EMA."""
+    depth = model.depth
+    if depth < 50:
+        raise ValueError("fused blocks require a bottleneck ResNet "
+                         "(depth >= 50)")
+
+    def apply_fn(variables, images):
+        return fused_train_apply(variables, images, depth=depth,
+                                 tile_bt=tile_bt, dtype=model.dtype)
+
+    run = apply_fn
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import data_axes
+        axes = data_axes(mesh)
+        dp = 1
+        for a in axes:
+            dp *= mesh.shape[a]
+        if dp > 1:
+            def sharded(variables, images):
+                return fused_train_apply(variables, images, depth=depth,
+                                         tile_bt=tile_bt,
+                                         dtype=model.dtype,
+                                         pmean_axes=axes)
+
+            run = jax.shard_map(
+                sharded, mesh=mesh, in_specs=(P(), P(axes)),
+                out_specs=(P(axes), P()), check_vma=False)
+
+    def loss_fn(params, variables, batch, rng):
+        logits, new_stats = run({"params": params, **variables},
+                                batch["images"])
+        labels = batch["labels"]
+        loss = cross_entropy_loss(logits, labels, label_smoothing)
+        acc = jnp.mean(jnp.argmax(logits, -1) == labels)
+        return loss, {"accuracy": acc,
+                      "variables": {"batch_stats": new_stats}}
+
+    return loss_fn

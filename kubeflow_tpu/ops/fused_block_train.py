@@ -1,0 +1,501 @@
+"""Fused ResNet bottleneck block (TRAINING): ghost-BN Pallas fwd + bwd.
+
+The training-mode companion to ops/fused_block.py (which measured the
+inference variant and showed the missing-byte argument only applies to
+training: batch-stat passes + autodiff stashes are the redundant HBM
+traffic — PERF.md "What would actually beat the roofline" item 1).
+
+One stride-1 bottleneck block —
+
+    conv1x1 → BN → relu → conv3x3 → BN → relu → conv1x1 → BN
+    → (+ residual | BN(conv_proj)) → relu
+
+— as ONE forward kernel and ONE backward kernel. Per batch tile the
+forward reads the block input once from HBM and writes the output once;
+the backward reads the input and the upstream gradient once, RECOMPUTES
+the block interior in VMEM, and writes dx once plus the (tiny) weight
+gradients. Interiors never touch HBM in either direction; the backward
+trades ~⅓ extra MXU FLOPs for the eliminated traffic — the right trade
+on a memory-bound chip (PERF.md roofline: MXU time ≈ 10.5 ms of a 47 ms
+step).
+
+**Ghost BatchNorm semantics (the opt-in departure).** Batch statistics
+are computed per batch *tile* (the kernel grid unit), not over the full
+per-chip batch: that is what makes the block tile-local and fusable.
+Each ghost batch still averages over Bt·H·W samples per channel
+(≥ 3136 even at Bt=1 on 56² feature maps), and per-subset BN is
+standard practice in large-batch training (ghost BN; per-replica BN is
+also what tf_cnn_benchmarks' data-parallel mode does — each GPU
+normalizes over its own shard). Running statistics are updated with the
+tile-averaged ghost moments. The semantics ship as an opt-in workload
+variant (`--fused-blocks`), benchmarked and validated separately from
+the exact-BN default path.
+
+Backward derivation (per tile, per channel; M = Bt·H·W samples):
+    BN: m = E[a], v = E[a²]−m², x̂ = (a−m)·rsqrt(v+eps), y = γx̂+β
+    ∂γ = Σ dy·x̂ ; ∂β = Σ dy ; with dx̂ = dy·γ:
+    ∂a = rsqrt(v+eps)·(dx̂ − mean(dx̂) − x̂·mean(dx̂·x̂))
+    conv3x3 (stride 1, pad 1) as 9 shifted matmuls; its transpose uses
+    the mirrored offsets (2−dy, 2−dx) on the padded gradient.
+
+The pure-jnp `reference_bottleneck_train` is the executable spec both
+kernels are tested against (values AND `jax.grad` gradients).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["fused_bottleneck_train", "reference_bottleneck_train",
+           "block_weights", "stats_to_tree", "default_tile_bt"]
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# -----------------------------------------------------------------------------
+# weight plumbing: the flax BottleneckBlock params subtree ↔ a flat tuple
+# -----------------------------------------------------------------------------
+
+def block_weights(params: dict) -> tuple:
+    """Flatten one flax BottleneckBlock params subtree (models/resnet
+    naming) into the kernel's positional weight tuple. Projection blocks
+    (conv_proj/norm_proj present) append 3 more entries."""
+    w = (params["Conv_0"]["kernel"][0, 0],
+         params["BatchNorm_0"]["scale"], params["BatchNorm_0"]["bias"],
+         params["Conv_1"]["kernel"],
+         params["BatchNorm_1"]["scale"], params["BatchNorm_1"]["bias"],
+         params["Conv_2"]["kernel"][0, 0],
+         params["BatchNorm_2"]["scale"], params["BatchNorm_2"]["bias"])
+    if "conv_proj" in params:
+        w += (params["conv_proj"]["kernel"][0, 0],
+              params["norm_proj"]["scale"], params["norm_proj"]["bias"])
+    return w
+
+
+def stats_to_tree(stats: tuple, has_proj: bool) -> dict:
+    """Tile-averaged ghost moments as the flax batch_stats subtree shape
+    (mean/var per BatchNorm) for the running-stat EMA update."""
+    m1, v1, m2, v2, m3, v3, mp, vp = stats
+    tree = {"BatchNorm_0": {"mean": m1, "var": v1},
+            "BatchNorm_1": {"mean": m2, "var": v2},
+            "BatchNorm_2": {"mean": m3, "var": v3}}
+    if has_proj:
+        tree["norm_proj"] = {"mean": mp, "var": vp}
+    return tree
+
+
+def default_tile_bt(n: int, h: int, w: int, cin: int, cmid: int,
+                    cout: int) -> int:
+    """Largest batch tile whose backward working set fits the VMEM
+    budget. Dominant live f32/bf16 tensors per image (backward, the
+    heavier direction): x + g + dx tiles, bf16 interiors (h1, h2, x̂3,
+    gz, da3), f32 (M,Cmid) temporaries and one f32 (M,Cout) temporary."""
+    per_image = h * w * (cin * 2 * 2 + cout * 2 * 4 + cout * 4
+                         + cmid * (2 * 2 + 4 * 4))
+    bt = max(1, int((7 * 2 ** 20) // max(per_image, 1)))
+    while n % bt:
+        bt -= 1
+    return bt
+
+
+# -----------------------------------------------------------------------------
+# executable spec (pure jnp, differentiable) — what the kernels must match
+# -----------------------------------------------------------------------------
+
+def reference_bottleneck_train(x: jax.Array, weights: tuple, *,
+                               tile_bt: int, eps: float = 1e-5
+                               ) -> tuple[jax.Array, tuple]:
+    """Ghost-BN bottleneck forward in plain jnp, tiled exactly like the
+    kernel grid ((n//tile_bt) ghost batches). Differentiable: jax.grad of
+    this is the golden gradient for the Pallas backward."""
+    has_proj = len(weights) == 12
+    w1, g1, b1, w2, g2, b2, w3, g3, b3 = weights[:9]
+    n, h, w_, cin = x.shape
+    t = n // tile_bt
+    f32 = jnp.float32
+    dt = x.dtype
+
+    def gbn(a, g, b):
+        # a: (T, M, C) f32; ghost stats over axis 1
+        m = jnp.mean(a, axis=1, keepdims=True)
+        v = jnp.mean(a * a, axis=1, keepdims=True) - m * m
+        xh = (a - m) * jax.lax.rsqrt(v + eps)
+        return g * xh + b, m[:, 0], v[:, 0]
+
+    xm = x.reshape(t, tile_bt * h * w_, cin)
+    a1 = jnp.einsum("tmc,cd->tmd", xm, w1.astype(dt),
+                    preferred_element_type=f32)
+    y1, m1, v1 = gbn(a1, g1, b1)
+    h1 = jax.nn.relu(y1).astype(dt).reshape(t * tile_bt, h, w_, -1)
+    cmid = h1.shape[-1]
+    pad = jnp.pad(h1, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    acc = jnp.zeros((t, tile_bt * h * w_, cmid), f32)
+    for dy in range(3):
+        for dx in range(3):
+            sh = pad[:, dy:dy + h, dx:dx + w_, :].reshape(
+                t, tile_bt * h * w_, cmid)
+            acc = acc + jnp.einsum("tmc,cd->tmd", sh, w2[dy, dx].astype(dt),
+                                   preferred_element_type=f32)
+    y2, m2, v2 = gbn(acc, g2, b2)
+    h2 = jax.nn.relu(y2).astype(dt)
+    a3 = jnp.einsum("tmc,cd->tmd", h2, w3.astype(dt),
+                    preferred_element_type=f32)
+    y3, m3, v3 = gbn(a3, g3, b3)
+    if has_proj:
+        wp, gp, bp = weights[9:12]
+        ap = jnp.einsum("tmc,cd->tmd", xm, wp.astype(dt),
+                        preferred_element_type=f32)
+        r, mp, vp = gbn(ap, gp, bp)
+    else:
+        r = xm.astype(f32)
+        mp = vp = jnp.zeros((t, 1), f32)
+    out = jax.nn.relu(y3 + r).astype(dt)
+    cout = out.shape[-1]
+    stats = tuple(jnp.mean(s, axis=0) for s in
+                  (m1, v1, m2, v2, m3, v3, mp, vp))
+    return out.reshape(n, h, w_, cout), stats
+
+
+# -----------------------------------------------------------------------------
+# forward kernel
+# -----------------------------------------------------------------------------
+
+def _fwd_kernel(x_ref, w1_ref, g1_ref, b1_ref, w2_ref, g2_ref, b2_ref,
+                w3_ref, g3_ref, b3_ref, wp_ref, gp_ref, bp_ref,
+                o_ref, m1_ref, v1_ref, m2_ref, v2_ref, m3_ref, v3_ref,
+                mp_ref, vp_ref, *, has_proj: bool, eps: float,
+                inv_tiles: float):
+    f32 = jnp.float32
+    x = x_ref[...]
+    bt, h, w, cin = x.shape
+    dt = x.dtype
+    xm = x.reshape(-1, cin)
+
+    def gbn(a, g, b):
+        m = jnp.mean(a, axis=0)
+        v = jnp.mean(a * a, axis=0) - m * m
+        xh = (a - m) * jax.lax.rsqrt(v + eps)
+        return g * xh + b, m, v
+
+    i = pl.program_id(0)
+
+    def acc_stat(ref, val):
+        @pl.when(i == 0)
+        def _():
+            ref[...] = val * inv_tiles
+
+        @pl.when(i > 0)
+        def _():
+            ref[...] += val * inv_tiles
+
+    a1 = jnp.dot(xm, w1_ref[...], preferred_element_type=f32)
+    y1, m1, v1 = gbn(a1, g1_ref[...], b1_ref[...])
+    h1 = jax.nn.relu(y1).astype(dt)
+    cmid = h1.shape[-1]
+    pad = jnp.pad(h1.reshape(bt, h, w, cmid), ((0, 0), (1, 1), (1, 1),
+                                               (0, 0)))
+    acc = jnp.zeros((bt * h * w, cmid), f32)
+    for dy in range(3):
+        for dx in range(3):
+            acc = acc + jnp.dot(
+                pad[:, dy:dy + h, dx:dx + w, :].reshape(-1, cmid),
+                w2_ref[dy, dx], preferred_element_type=f32)
+    y2, m2, v2 = gbn(acc, g2_ref[...], b2_ref[...])
+    h2 = jax.nn.relu(y2).astype(dt)
+    a3 = jnp.dot(h2, w3_ref[...], preferred_element_type=f32)
+    y3, m3, v3 = gbn(a3, g3_ref[...], b3_ref[...])
+    if has_proj:
+        ap = jnp.dot(xm, wp_ref[...], preferred_element_type=f32)
+        r, mp, vp = gbn(ap, gp_ref[...], bp_ref[...])
+        acc_stat(mp_ref, mp)
+        acc_stat(vp_ref, vp)
+    else:
+        r = xm.astype(f32)
+
+        @pl.when(i == 0)
+        def _():
+            mp_ref[...] = jnp.zeros_like(mp_ref)
+            vp_ref[...] = jnp.zeros_like(vp_ref)
+    out = jax.nn.relu(y3 + r).astype(dt)
+    o_ref[...] = out.reshape(bt, h, w, -1)
+    acc_stat(m1_ref, m1)
+    acc_stat(v1_ref, v1)
+    acc_stat(m2_ref, m2)
+    acc_stat(v2_ref, v2)
+    acc_stat(m3_ref, m3)
+    acc_stat(v3_ref, v3)
+
+
+# -----------------------------------------------------------------------------
+# backward kernel: recompute the interior, then block-transpose it
+# -----------------------------------------------------------------------------
+
+def _bwd_kernel(x_ref, g_ref, w1_ref, g1_ref, b1_ref, w2_ref, g2_ref,
+                b2_ref, w3_ref, g3_ref, b3_ref, wp_ref, gp_ref, bp_ref,
+                dx_ref, dw1_ref, dg1_ref, db1_ref, dw2_ref, dg2_ref,
+                db2_ref, dw3_ref, dg3_ref, db3_ref, dwp_ref, dgp_ref,
+                dbp_ref, *, has_proj: bool, eps: float):
+    f32 = jnp.float32
+    x = x_ref[...]
+    bt, h, w, cin = x.shape
+    dt = x.dtype
+    xm = x.reshape(-1, cin)
+    gout = g_ref[...].reshape(bt * h * w, -1)
+    mcount = f32(bt * h * w)
+
+    i = pl.program_id(0)
+
+    def acc_grad(ref, val):
+        @pl.when(i == 0)
+        def _():
+            ref[...] = val
+
+        @pl.when(i > 0)
+        def _():
+            ref[...] += val
+
+    def gbn_fwd(a, g, b):
+        # identical ops to the forward kernel → identical ghost stats
+        m = jnp.mean(a, axis=0)
+        v = jnp.mean(a * a, axis=0) - m * m
+        s = jax.lax.rsqrt(v + eps)
+        xh = (a - m) * s
+        return g * xh + b, xh, s
+
+    def gbn_bwd(dy, xh, g, s):
+        dg = jnp.sum(dy * xh, axis=0)
+        db = jnp.sum(dy, axis=0)
+        dxh = dy * g
+        da = s * (dxh - jnp.sum(dxh, axis=0) / mcount
+                  - xh * (jnp.sum(dxh * xh, axis=0) / mcount))
+        return da, dg, db
+
+    # ---- recompute the forward interior (VMEM-resident, bf16 storage)
+    a1 = jnp.dot(xm, w1_ref[...], preferred_element_type=f32)
+    y1, xh1, s1 = gbn_fwd(a1, g1_ref[...], b1_ref[...])
+    h1 = jax.nn.relu(y1).astype(dt)
+    cmid = h1.shape[-1]
+    pad1 = jnp.pad(h1.reshape(bt, h, w, cmid), ((0, 0), (1, 1), (1, 1),
+                                                (0, 0)))
+    acc2 = jnp.zeros((bt * h * w, cmid), f32)
+    for dy in range(3):
+        for dx in range(3):
+            acc2 = acc2 + jnp.dot(
+                pad1[:, dy:dy + h, dx:dx + w, :].reshape(-1, cmid),
+                w2_ref[dy, dx], preferred_element_type=f32)
+    y2, xh2, s2 = gbn_fwd(acc2, g2_ref[...], b2_ref[...])
+    h2 = jax.nn.relu(y2).astype(dt)
+    a3 = jnp.dot(h2, w3_ref[...], preferred_element_type=f32)
+    y3, xh3, s3 = gbn_fwd(a3, g3_ref[...], b3_ref[...])
+    if has_proj:
+        ap = jnp.dot(xm, wp_ref[...], preferred_element_type=f32)
+        r, xhp, sp = gbn_fwd(ap, gp_ref[...], bp_ref[...])
+    else:
+        r = xm.astype(f32)
+
+    # ---- transpose the block, top down
+    # final relu: sign of the recomputed pre-activation
+    gz = jnp.where(y3 + r > 0, gout.astype(f32), 0.0)
+
+    # BN3 + conv3 (1x1)
+    da3, dg3, db3 = gbn_bwd(gz, xh3, g3_ref[...], s3)
+    da3b = da3.astype(dt)
+    acc_grad(dg3_ref, dg3)
+    acc_grad(db3_ref, db3)
+    acc_grad(dw3_ref, jnp.dot(h2.T, da3b, preferred_element_type=f32))
+    dh2 = jnp.dot(da3b, w3_ref[...].T, preferred_element_type=f32)
+
+    # relu2 + BN2
+    dz2 = jnp.where(y2 > 0, dh2, 0.0)
+    da2, dg2, db2 = gbn_bwd(dz2, xh2, g2_ref[...], s2)
+    da2b = da2.astype(dt)
+    acc_grad(dg2_ref, dg2)
+    acc_grad(db2_ref, db2)
+
+    # conv3x3 transpose: wgrad reuses the forward's shifted h1 views;
+    # dgrad uses the mirrored offsets (2-dy, 2-dx) on padded da2
+    dw2 = jnp.zeros_like(dw2_ref)
+    pad2 = jnp.pad(da2b.reshape(bt, h, w, cmid), ((0, 0), (1, 1), (1, 1),
+                                                  (0, 0)))
+    dh1 = jnp.zeros((bt * h * w, cmid), f32)
+    for dy in range(3):
+        for dx in range(3):
+            h1s = pad1[:, dy:dy + h, dx:dx + w, :].reshape(-1, cmid)
+            dw2 = dw2.at[dy, dx].set(
+                jnp.dot(h1s.T, da2b, preferred_element_type=f32))
+            g2s = pad2[:, 2 - dy:2 - dy + h, 2 - dx:2 - dx + w, :] \
+                .reshape(-1, cmid)
+            dh1 = dh1 + jnp.dot(g2s, w2_ref[dy, dx].T,
+                                preferred_element_type=f32)
+    acc_grad(dw2_ref, dw2)
+
+    # relu1 + BN1 + conv1 (1x1)
+    dz1 = jnp.where(y1 > 0, dh1, 0.0)
+    da1, dg1, db1 = gbn_bwd(dz1, xh1, g1_ref[...], s1)
+    da1b = da1.astype(dt)
+    acc_grad(dg1_ref, dg1)
+    acc_grad(db1_ref, db1)
+    acc_grad(dw1_ref, jnp.dot(xm.T, da1b, preferred_element_type=f32))
+    dx = jnp.dot(da1b, w1_ref[...].T, preferred_element_type=f32)
+
+    # residual path
+    if has_proj:
+        dap, dgp, dbp = gbn_bwd(gz, xhp, gp_ref[...], sp)
+        dapb = dap.astype(dt)
+        acc_grad(dgp_ref, dgp)
+        acc_grad(dbp_ref, dbp)
+        acc_grad(dwp_ref, jnp.dot(xm.T, dapb, preferred_element_type=f32))
+        dx = dx + jnp.dot(dapb, wp_ref[...].T, preferred_element_type=f32)
+    else:
+        dx = dx + gz
+
+        @pl.when(i == 0)
+        def _():
+            dwp_ref[...] = jnp.zeros_like(dwp_ref)
+            dgp_ref[...] = jnp.zeros_like(dgp_ref)
+            dbp_ref[...] = jnp.zeros_like(dbp_ref)
+    dx_ref[...] = dx.astype(dt).reshape(bt, h, w, cin)
+
+
+# -----------------------------------------------------------------------------
+# pallas_call plumbing + custom_vjp
+# -----------------------------------------------------------------------------
+
+def _padded_weights(weights: tuple, dt) -> tuple[list, bool]:
+    has_proj = len(weights) == 12
+    w = list(weights)
+    conv_idx = {0, 3, 6, 9}
+    out = [wi.astype(dt) if k in conv_idx else wi.astype(jnp.float32)
+           for k, wi in enumerate(w)]
+    if not has_proj:
+        # dead operands keep the kernel signature static (as the eval
+        # kernel does)
+        out += [jnp.zeros((1, 1), dt), jnp.zeros((1,), jnp.float32),
+                jnp.zeros((1,), jnp.float32)]
+    return out, has_proj
+
+
+def _full_spec(shape):
+    return pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+
+
+def _pallas_fwd(x, weights, tile_bt, eps):
+    n, h, w_, cin = x.shape
+    wlist, has_proj = _padded_weights(weights, x.dtype)
+    cmid = wlist[0].shape[-1]
+    cout = wlist[6].shape[-1]
+    n_tiles = n // tile_bt
+    cp = wlist[9].shape[-1] if has_proj else 1
+
+    in_specs = [pl.BlockSpec((tile_bt, h, w_, cin), lambda i: (i, 0, 0, 0))]
+    in_specs += [_full_spec(wi.shape) for wi in wlist]
+    stat_shapes = [cmid, cmid, cmid, cmid, cout, cout, cp, cp]
+    out_shapes = [jax.ShapeDtypeStruct((n, h, w_, cout), x.dtype)] + \
+        [jax.ShapeDtypeStruct((c,), jnp.float32) for c in stat_shapes]
+    out_specs = [pl.BlockSpec((tile_bt, h, w_, cout),
+                              lambda i: (i, 0, 0, 0))] + \
+        [_full_spec((c,)) for c in stat_shapes]
+
+    res = pl.pallas_call(
+        partial(_fwd_kernel, has_proj=has_proj, eps=eps,
+                inv_tiles=1.0 / n_tiles),
+        grid=(n_tiles,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=_interpret(),
+    )(x, *wlist)
+    return res[0], tuple(res[1:])
+
+
+def _pallas_bwd(x, g, weights, tile_bt, eps):
+    n, h, w_, cin = x.shape
+    wlist, has_proj = _padded_weights(weights, x.dtype)
+    cmid = wlist[0].shape[-1]
+    cout = wlist[6].shape[-1]
+    n_tiles = n // tile_bt
+    cp = wlist[9].shape[0] if has_proj else 1
+    cpo = wlist[9].shape[-1] if has_proj else 1
+
+    tile = lambda c: pl.BlockSpec((tile_bt, h, w_, c),  # noqa: E731
+                                  lambda i: (i, 0, 0, 0))
+    in_specs = [tile(cin), tile(cout)]
+    in_specs += [_full_spec(wi.shape) for wi in wlist]
+    f32 = jnp.float32
+    grad_shapes = [(cin, cmid), (cmid,), (cmid,),          # w1, g1, b1
+                   (3, 3, cmid, cmid), (cmid,), (cmid,),   # w2, g2, b2
+                   (cmid, cout), (cout,), (cout,),         # w3, g3, b3
+                   (cp, cpo), (cpo,), (cpo,)]              # wp, gp, bp
+    out_shapes = [jax.ShapeDtypeStruct((n, h, w_, cin), x.dtype)] + \
+        [jax.ShapeDtypeStruct(s, f32) for s in grad_shapes]
+    out_specs = [tile(cin)] + [_full_spec(s) for s in grad_shapes]
+
+    res = pl.pallas_call(
+        partial(_bwd_kernel, has_proj=has_proj, eps=eps),
+        grid=(n_tiles,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=_interpret(),
+    )(x, g, *wlist)
+    dx, grads = res[0], tuple(res[1:])
+    if not has_proj:
+        grads = grads[:9]
+    return dx, grads
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _fused(tile_bt, eps, x, *weights):
+    out, stats = _pallas_fwd(x, weights, tile_bt, eps)
+    return out, stats
+
+
+def _fused_fwd(tile_bt, eps, x, *weights):
+    out, stats = _pallas_fwd(x, weights, tile_bt, eps)
+    return (out, stats), (x, weights)
+
+
+def _fused_bwd(tile_bt, eps, residuals, cts):
+    # cts[1] (the ghost-stats cotangent) is deliberately dropped: the
+    # stats feed the running-average EMA only, which is stop-gradient in
+    # flax's BatchNorm as well.
+    x, weights = residuals
+    ct_out = cts[0]
+    dx, grads = _pallas_bwd(x, ct_out.astype(x.dtype), weights, tile_bt,
+                            eps)
+    dweights = tuple(gi.astype(wi.dtype) for gi, wi in zip(grads, weights))
+    return (dx,) + dweights
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_bottleneck_train(x: jax.Array, params: dict, *,
+                           tile_bt: Optional[int] = None,
+                           eps: float = 1e-5) -> tuple[jax.Array, dict]:
+    """The fused ghost-BN training block: (out, ghost_stats_tree).
+
+    ``params`` is one flax BottleneckBlock subtree; stride-1 blocks only
+    (callers route strided blocks to XLA). ghost_stats_tree holds the
+    tile-averaged batch moments per BatchNorm, shaped for the running
+    EMA update."""
+    weights = block_weights(params)
+    has_proj = len(weights) == 12
+    n, h, w_, cin = x.shape
+    cmid = weights[0].shape[-1]
+    cout = weights[6].shape[-1]
+    if not has_proj and cin != cout:
+        raise ValueError(f"Cin {cin} != Cout {cout} needs a projection")
+    if tile_bt is None:
+        tile_bt = default_tile_bt(n, h, w_, cin, cmid, cout)
+    elif n % tile_bt:
+        raise ValueError(f"tile_bt {tile_bt} must divide batch {n}")
+    out, stats = _fused(tile_bt, eps, x, *weights)
+    return out, stats_to_tree(stats, has_proj)

@@ -48,13 +48,25 @@ class WorkloadSpec:
 
 def _resnet_spec(image_size: int = 224, num_classes: int = 1000,
                  depth: int = 50,
-                 label_smoothing: float = 0.0) -> WorkloadSpec:
+                 label_smoothing: float = 0.0,
+                 fused: bool = False,
+                 fused_tile_bt: Optional[int] = None,
+                 mesh=None) -> WorkloadSpec:
     from ..models import resnet as R
     model = R.make_resnet(depth, num_classes=num_classes)
+    if fused:
+        # opt-in ghost-BN fused-block variant (ops/fused_block_train.py):
+        # per-tile/per-shard BN statistics, one Pallas kernel per
+        # stride-1 bottleneck in each direction
+        loss_fn = R.make_fused_loss_fn(model,
+                                       label_smoothing=label_smoothing,
+                                       tile_bt=fused_tile_bt, mesh=mesh)
+    else:
+        loss_fn = R.make_loss_fn(model, label_smoothing=label_smoothing)
     return WorkloadSpec(
-        name=f"resnet{depth}",
+        name=f"resnet{depth}" + ("-fused" if fused else ""),
         init_fn=R.init_fn(model, image_size=image_size),
-        loss_fn=R.make_loss_fn(model, label_smoothing=label_smoothing),
+        loss_fn=loss_fn,
         batch_fn=lambda rng, bs: R.synthetic_batch(
             rng, bs, image_size, num_classes),
         eval_fn=R.make_eval_fn(model),
@@ -81,8 +93,12 @@ WORKLOADS: dict[str, Callable[..., WorkloadSpec]] = {
     "transformer-pipelined": _transformer_pipelined_spec,
 }
 
-# workloads whose spec factory needs the live mesh (pipeline scheduling)
-_MESH_AWARE_WORKLOADS = {"transformer-pipelined"}
+# workloads whose spec factory needs the live mesh (pipeline scheduling;
+# resnets shard_map the fused ghost-BN path over the data axes)
+_MESH_AWARE_WORKLOADS = {"transformer-pipelined"} | \
+    {f"resnet{d}" for d in RESNET_DEPTHS}
+# workloads that consume --num-microbatches (GPipe scheduling)
+_PIPELINED_WORKLOADS = {"transformer-pipelined"}
 
 # workloads that consume --data-dir (ImageNet-style record shards)
 _IMAGE_WORKLOADS = {f"resnet{d}" for d in RESNET_DEPTHS}
@@ -535,10 +551,25 @@ def main(argv=None) -> int:
     p.add_argument("--eval-data-dir",
                    help="held-out shard dir (defaults to "
                         "$KFTPU_EVAL_DATA_DIR); synthetic eval when unset")
+    p.add_argument("--fused-blocks", action="store_true",
+                   help="opt-in ghost-BN fused bottleneck kernels "
+                        "(resnet>=50): per-tile BN statistics, fewer HBM "
+                        "passes per step (docs/training.md)")
+    p.add_argument("--fused-tile-bt", type=int, default=0,
+                   help="ghost-batch tile size for --fused-blocks "
+                        "(0 = auto by VMEM budget)")
     args = p.parse_args(argv)
     workload_kwargs = {}
-    if args.workload in _MESH_AWARE_WORKLOADS:
+    if args.workload in _PIPELINED_WORKLOADS:
         workload_kwargs["num_microbatches"] = args.num_microbatches
+    if args.fused_blocks:
+        if args.workload not in _IMAGE_WORKLOADS or \
+                int(args.workload.removeprefix("resnet")) < 50:
+            p.error("--fused-blocks applies to bottleneck resnets "
+                    "(depth >= 50) only")
+        workload_kwargs["fused"] = True
+        if args.fused_tile_bt:
+            workload_kwargs["fused_tile_bt"] = args.fused_tile_bt
     result = train(
         workload=args.workload, steps=args.steps,
         global_batch=args.global_batch, learning_rate=args.learning_rate,

@@ -208,3 +208,181 @@ class TestResNetFamily:
         assert family <= set(WORKLOADS)
         assert family <= _IMAGE_WORKLOADS
         assert family <= set(_MODEL_BUILDERS)
+
+
+class TestFusedBlockTrain:
+    """ops/fused_block_train.py: the ghost-BN training kernel pair equals
+    the differentiable jnp reference — values, stats, AND jax.grad —
+    in interpret mode on CPU."""
+
+    def _params(self, rng, cin, cmid, cout, proj):
+        import numpy as np
+
+        def arr(*s):
+            return jnp.asarray(rng.normal(0, 0.1, s), jnp.float32)
+
+        p = {
+            "Conv_0": {"kernel": arr(1, 1, cin, cmid)},
+            "BatchNorm_0": {"scale": arr(cmid) + 1, "bias": arr(cmid)},
+            "Conv_1": {"kernel": arr(3, 3, cmid, cmid)},
+            "BatchNorm_1": {"scale": arr(cmid) + 1, "bias": arr(cmid)},
+            "Conv_2": {"kernel": arr(1, 1, cmid, cout)},
+            "BatchNorm_2": {"scale": arr(cout) + 1, "bias": arr(cout)},
+        }
+        if proj:
+            p["conv_proj"] = {"kernel": arr(1, 1, cin, cout)}
+            p["norm_proj"] = {"scale": arr(cout) + 1, "bias": arr(cout)}
+        return p
+
+    @pytest.mark.parametrize("proj", [False, True])
+    def test_forward_and_stats_match_reference(self, proj):
+        import numpy as np
+        from kubeflow_tpu.ops.fused_block_train import (
+            block_weights, fused_bottleneck_train,
+            reference_bottleneck_train)
+        rng = np.random.default_rng(0)
+        cin = 16 if proj else 32
+        p = self._params(rng, cin, 8, 32, proj)
+        x = jnp.asarray(rng.normal(0, 1, (8, 8, 8, cin)), jnp.float32)
+        out, stats = fused_bottleneck_train(x, p, tile_bt=2)
+        ref_out, ref_stats = reference_bottleneck_train(
+            x, block_weights(p), tile_bt=2)
+        np.testing.assert_allclose(out, ref_out, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(stats["BatchNorm_0"]["mean"],
+                                   ref_stats[0], rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(stats["BatchNorm_2"]["var"],
+                                   ref_stats[5], rtol=1e-5, atol=1e-6)
+        if proj:
+            np.testing.assert_allclose(stats["norm_proj"]["mean"],
+                                       ref_stats[6], rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("proj", [False, True])
+    def test_backward_matches_jax_grad_of_reference(self, proj):
+        import numpy as np
+        from kubeflow_tpu.ops.fused_block_train import (
+            _fused, block_weights, reference_bottleneck_train)
+        rng = np.random.default_rng(1)
+        cin = 16 if proj else 32
+        p = self._params(rng, cin, 8, 32, proj)
+        w = block_weights(p)
+        x = jnp.asarray(rng.normal(0, 1, (4, 8, 8, cin)), jnp.float32)
+
+        def loss_k(x, *w):
+            o, _ = _fused(2, 1e-5, x, *w)
+            return jnp.sum(jnp.sin(o))
+
+        def loss_r(x, *w):
+            o, _ = reference_bottleneck_train(x, w, tile_bt=2)
+            return jnp.sum(jnp.sin(o))
+
+        argnums = tuple(range(len(w) + 1))
+        gk = jax.grad(loss_k, argnums=argnums)(x, *w)
+        gr = jax.grad(loss_r, argnums=argnums)(x, *w)
+        for a, b in zip(gk, gr):
+            np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4)
+
+    def test_ghost_stats_are_per_tile_not_per_batch(self):
+        """tile_bt=n collapses ghost BN to exact batch BN; a smaller tile
+        must produce different normalization — the documented semantics
+        departure the variant is opt-in for."""
+        import numpy as np
+        from kubeflow_tpu.ops.fused_block_train import (
+            fused_bottleneck_train)
+        rng = np.random.default_rng(2)
+        p = self._params(rng, 32, 8, 32, proj=False)
+        x = jnp.asarray(rng.normal(0, 1, (8, 8, 8, 32)), jnp.float32)
+        out_full, _ = fused_bottleneck_train(x, p, tile_bt=8)
+        out_ghost, _ = fused_bottleneck_train(x, p, tile_bt=2)
+        assert float(jnp.max(jnp.abs(out_full - out_ghost))) > 1e-6
+
+    def test_tile_must_divide_batch(self):
+        import numpy as np
+        from kubeflow_tpu.ops.fused_block_train import (
+            fused_bottleneck_train)
+        rng = np.random.default_rng(3)
+        p = self._params(rng, 32, 8, 32, proj=False)
+        with pytest.raises(ValueError, match="divide"):
+            fused_bottleneck_train(
+                jnp.zeros((6, 8, 8, 32), jnp.float32), p, tile_bt=4)
+
+    def test_fused_train_apply_updates_running_stats(self):
+        import numpy as np
+        from kubeflow_tpu.models import resnet as R
+        model = R.resnet50(num_classes=10)
+        params, variables = R.init_fn(model, image_size=32, batch=2)(
+            jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+        logits, new_stats = R.fused_train_apply(
+            {"params": params, **variables}, x, tile_bt=2)
+        assert logits.shape == (4, 10)
+        assert np.isfinite(np.asarray(logits)).all()
+        # EMA moved every BN's running mean (momentum 0.9 on real data)
+        old = variables["batch_stats"]
+        moved = jax.tree.map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))), old, new_stats)
+        assert all(v > 0 for v in jax.tree.leaves(moved))
+        # structure matches flax's exactly (checkpoint compatibility)
+        assert jax.tree.structure(old) == jax.tree.structure(new_stats)
+
+    def test_fused_loss_close_to_flax_on_shared_params(self):
+        """Ghost BN differs from batch BN but must stay in the same
+        numeric neighborhood at init — a gross mismatch means a bug, not
+        a semantics difference."""
+        import numpy as np
+        from kubeflow_tpu.models import resnet as R
+        model = R.resnet50(num_classes=10)
+        params, variables = R.init_fn(model, image_size=32, batch=2)(
+            jax.random.PRNGKey(0))
+        batch = {
+            "images": jax.random.normal(jax.random.PRNGKey(1),
+                                        (8, 32, 32, 3)),
+            "labels": jnp.arange(8) % 10,
+        }
+        fused = R.make_fused_loss_fn(model, tile_bt=2)
+        std = R.make_loss_fn(model)
+        lf, _ = fused(params, variables, batch, jax.random.PRNGKey(2))
+        ls, _ = std(params, variables, batch, jax.random.PRNGKey(2))
+        assert abs(float(lf) - float(ls)) < 0.5
+
+    def test_fused_loss_shard_maps_over_data_axes(self):
+        """On a dp>1 mesh the apply runs inside shard_map (per-shard
+        ghost BN); grads flow and stats come back replicated."""
+        import numpy as np
+        from kubeflow_tpu.models import resnet as R
+        from kubeflow_tpu.parallel.mesh import build_mesh
+        mesh = build_mesh()
+        model = R.resnet50(num_classes=10)
+        params, variables = R.init_fn(model, image_size=32, batch=2)(
+            jax.random.PRNGKey(0))
+        loss_fn = R.make_fused_loss_fn(model, tile_bt=1, mesh=mesh)
+        batch = {
+            "images": jax.random.normal(jax.random.PRNGKey(1),
+                                        (16, 32, 32, 3)),
+            "labels": jnp.arange(16) % 10,
+        }
+        with mesh:
+            (loss, aux), grads = jax.jit(
+                jax.value_and_grad(loss_fn, has_aux=True))(
+                params, variables, batch, jax.random.PRNGKey(2))
+        assert np.isfinite(float(loss))
+        gsq = sum(float(jnp.sum(jnp.square(g)))
+                  for g in jax.tree.leaves(grads))
+        assert np.isfinite(gsq) and gsq > 0
+        ns = aux["variables"]["batch_stats"]
+        assert jax.tree.structure(ns) == \
+            jax.tree.structure(variables["batch_stats"])
+
+    def test_basicblock_depths_rejected(self):
+        from kubeflow_tpu.models import resnet as R
+        with pytest.raises(ValueError, match="bottleneck"):
+            R.make_fused_loss_fn(R.resnet18(num_classes=10))
+
+    def test_worker_trains_with_fused_blocks(self):
+        import numpy as np
+        from kubeflow_tpu.runtime.worker import train
+        r = train(workload="resnet50", steps=2, global_batch=16,
+                  sync_every=1, seed=0,
+                  workload_kwargs={"image_size": 32, "num_classes": 10,
+                                   "fused": True, "fused_tile_bt": 1})
+        assert r.steps == 2
+        assert np.isfinite(r.final_metrics["loss"])

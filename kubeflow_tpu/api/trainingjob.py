@@ -246,6 +246,11 @@ class TrainingJob:
     # TensorBoard event dir (KFTPU_TB_DIR) — the tensorboard component's
     # --logdir; process 0 streams scalar events there
     tensorboard_dir: str = ""
+    # persistent XLA compilation cache dir (KFTPU_COMPILE_CACHE_DIR) —
+    # warm restarts skip the multi-ten-second first-step compile
+    # (BASELINE.md north-star #2). Defaults to a subdir of checkpointDir
+    # when that is set (same volume the gang already mounts).
+    compile_cache_dir: str = ""
     raw: dict = field(default_factory=dict)
 
     # -- constructors -------------------------------------------------------
@@ -299,6 +304,7 @@ class TrainingJob:
             data_dir=spec.get("dataDir", "") or "",
             eval_data_dir=spec.get("evalDataDir", "") or "",
             tensorboard_dir=spec.get("tensorboardDir", "") or "",
+            compile_cache_dir=spec.get("compileCacheDir", "") or "",
             raw=obj,
         )
         job.validate()
@@ -392,6 +398,8 @@ class TrainingJob:
             out["spec"]["evalDataDir"] = self.eval_data_dir
         if self.tensorboard_dir:
             out["spec"]["tensorboardDir"] = self.tensorboard_dir
+        if self.compile_cache_dir:
+            out["spec"]["compileCacheDir"] = self.compile_cache_dir
         if self.raw:
             out["apiVersion"] = self.raw.get("apiVersion", out["apiVersion"])
             meta = dict(self.raw.get("metadata", {}))

@@ -276,6 +276,16 @@ class TrainingJobReconciler(Reconciler):
             env["KFTPU_EVAL_DATA_DIR"] = job.eval_data_dir
         if job.tensorboard_dir:
             env["KFTPU_TB_DIR"] = job.tensorboard_dir
+        from ..runtime.compile_cache import (COMPILE_CACHE_ENV,
+                                             default_cache_dir)
+        cache_dir = job.compile_cache_dir or (
+            default_cache_dir(job.checkpoint_dir)
+            if job.checkpoint_dir else "")
+        if cache_dir:
+            # persistent XLA compilation cache on the checkpoint volume:
+            # a restarted/warm-started gang skips the first-step compile
+            # (runtime/compile_cache.py; BASELINE.md north-star #2)
+            env[COMPILE_CACHE_ENV] = cache_dir
         if env:
             self._add_env(pod, env)
         return pod

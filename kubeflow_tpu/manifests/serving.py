@@ -35,6 +35,15 @@ def tpu_serving(namespace: str = "kubeflow", name: str = "model-server",
               f"--reload-interval={reload_interval_s}"],
         labels=lbl, port=9000)
     pod_spec = dep["spec"]["template"]["spec"]
+    if model_path:
+        # persistent XLA compile cache next to the model: replica
+        # restarts and scale-ups skip the per-bucket warmup compiles
+        # (runtime/compile_cache.py)
+        from ..runtime.compile_cache import (COMPILE_CACHE_ENV,
+                                             default_cache_dir)
+        pod_spec["containers"][0].setdefault("env", []).append(
+            {"name": COMPILE_CACHE_ENV,
+             "value": default_cache_dir(model_path)})
     pod_spec["nodeSelector"] = {
         "cloud.google.com/gke-tpu-topology": tpu_topology}
     pod_spec["containers"][0]["resources"] = {

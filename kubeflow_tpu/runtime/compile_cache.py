@@ -1,0 +1,68 @@
+"""Persistent XLA compilation cache (startup→first-step latency killer).
+
+The reference has no analog — its workloads pay TF graph-build each start
+— but on TPU the first pjit step costs tens of seconds of XLA compile
+(69s measured startup→first-step, PERF.md), and a gang restart or warm
+start repeats it identically. JAX's persistent compilation cache
+serializes compiled executables keyed by (HLO, compile options, jaxlib);
+pointing it at the checkpoint volume makes every restart after the first
+a cache hit.
+
+Wiring: the TPUJob operator renders ``KFTPU_COMPILE_CACHE_DIR`` into the
+gang's pods (defaulting to ``<checkpointDir>/.jax-compile-cache``,
+controllers/tpujob.py); the worker and the serving servers call
+``enable_compilation_cache()`` before their first jit. Serving reuses the
+same mechanism for model-server cold-start (SURVEY §7 hard part e).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+COMPILE_CACHE_ENV = "KFTPU_COMPILE_CACHE_DIR"
+# the default cache location on the checkpoint / model volume — the one
+# place this name is defined (operator + serving manifest import it)
+COMPILE_CACHE_SUBDIR = ".jax-compile-cache"
+
+# compiles cheaper than this recompile faster than a cache round-trip
+_MIN_COMPILE_SECS = 1.0
+
+
+def default_cache_dir(volume_dir: str) -> str:
+    """`<volume>/.jax-compile-cache` with normalized slashes (works for
+    local paths and gs://-style URIs alike)."""
+    return volume_dir.rstrip("/") + "/" + COMPILE_CACHE_SUBDIR
+
+
+def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``path`` (defaults to
+    $KFTPU_COMPILE_CACHE_DIR). No-op when neither is set. Returns the
+    active cache dir, or None.
+
+    Safe to call more than once and before/after backend init; failures
+    downgrade to a warning — a broken cache volume must never kill a
+    training gang or a model server."""
+    path = path or os.environ.get(COMPILE_CACHE_ENV)
+    if not path:
+        return None
+    import jax
+    try:
+        if "://" in path:
+            # bucket URI (gs://...): JAX reaches it through etils.epath;
+            # os.makedirs would create a bogus local 'gs:' directory and
+            # the cache would silently land on ephemeral disk
+            import etils.epath  # noqa: F401 — presence check
+        else:
+            os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          _MIN_COMPILE_SECS)
+        log.info("persistent compilation cache at %s", path)
+        return path
+    except Exception as e:  # noqa: BLE001 — cache is an optimization only
+        log.warning("compilation cache disabled (%s): %s", path, e)
+        return None

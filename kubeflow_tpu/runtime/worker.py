@@ -170,6 +170,10 @@ def train(
     handle_sigterm: bool = True,
     tensorboard_dir: Optional[str] = None,
 ) -> TrainResult:
+    # before any jit: warm restarts must hit the persistent cache for the
+    # very first compile (the startup→first-step dominator, PERF.md)
+    from .compile_cache import enable_compilation_cache
+    enable_compilation_cache()
     ctx = ctx or initialize()
     workload_kwargs = dict(workload_kwargs or {})
     if workload in _MESH_AWARE_WORKLOADS:

@@ -230,6 +230,12 @@ def main(argv: Optional[list[str]] = None) -> int:
                         "XLA compile)")
     args = p.parse_args(argv)
 
+    # warm server restarts skip the per-bucket XLA compiles: warmup()
+    # hits the persistent cache (KFTPU_COMPILE_CACHE_DIR, rendered by the
+    # serving manifest onto the model volume)
+    from ..runtime.compile_cache import enable_compilation_cache
+    enable_compilation_cache()
+
     repo = ModelRepository()
     servable = repo.load(args.model_name, args.model_type,
                          checkpoint_dir=args.model_path or None)

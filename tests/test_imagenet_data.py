@@ -380,3 +380,69 @@ class TestEvalTailHandling:
         with pytest.raises(ValueError, match="eval-data-dir"):
             train(workload="transformer", steps=1, global_batch=8,
                   eval_data_dir=d, eval_every=1, seed=0)
+
+
+class TestCompileCache:
+    """runtime/compile_cache.py: persistent XLA compilation cache wiring
+    (BASELINE.md north-star #2 — startup→first-step on warm restarts)."""
+
+    def test_operator_renders_cache_env_from_checkpoint_dir(self):
+        from kubeflow_tpu.cluster import FakeCluster
+        from kubeflow_tpu.controllers.runtime import Manager
+        from kubeflow_tpu.controllers.tpujob import TrainingJobReconciler
+        cluster = FakeCluster(auto_schedule=False, auto_run=False)
+        cluster.add_tpu_slice_nodes("v5e-8")
+        mgr = Manager(cluster)
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        cluster.create({
+            "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": "j", "namespace": "default"},
+            "spec": {
+                "checkpointDir": "/ckpt/run1",
+                "replicaSpecs": {"TPU": {
+                    "tpuTopology": "v5e-8",
+                    "template": {"spec": {"containers": [
+                        {"name": "worker", "image": "x"}]}}}},
+            },
+        })
+        mgr.run_pending()
+        pods = cluster.list("v1", "Pod", "default")
+        env = {e["name"]: e["value"]
+               for c in pods[0]["spec"]["containers"]
+               for e in c.get("env", [])}
+        # default: cache rides the checkpoint volume
+        assert env["KFTPU_COMPILE_CACHE_DIR"] == \
+            "/ckpt/run1/.jax-compile-cache"
+
+    def test_explicit_compile_cache_dir_roundtrips_and_wins(self):
+        from kubeflow_tpu.api.trainingjob import TrainingJob
+        m = {
+            "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": "j", "namespace": "default"},
+            "spec": {
+                "checkpointDir": "/ckpt", "compileCacheDir": "/fast/cache",
+                "replicaSpecs": {"TPU": {
+                    "tpuTopology": "v5e-8",
+                    "template": {"spec": {"containers": [
+                        {"name": "w", "image": "x"}]}}}},
+            },
+        }
+        job = TrainingJob.from_manifest(m)
+        assert job.compile_cache_dir == "/fast/cache"
+        assert job.to_manifest()["spec"]["compileCacheDir"] == "/fast/cache"
+
+    def test_worker_populates_cache_dir(self, tmp_path, monkeypatch):
+        import os
+        cache = str(tmp_path / "jaxcache")
+        monkeypatch.setenv("KFTPU_COMPILE_CACHE_DIR", cache)
+        from kubeflow_tpu.runtime.worker import train
+        train(workload="resnet18", steps=1, global_batch=8, sync_every=1,
+              workload_kwargs={"image_size": 16, "num_classes": 4}, seed=0)
+        assert os.path.isdir(cache) and os.listdir(cache), \
+            "train step executable was not persisted"
+
+    def test_enable_is_noop_without_env(self, monkeypatch):
+        from kubeflow_tpu.runtime.compile_cache import (
+            enable_compilation_cache)
+        monkeypatch.delenv("KFTPU_COMPILE_CACHE_DIR", raising=False)
+        assert enable_compilation_cache() is None

@@ -373,7 +373,8 @@ def fused_train_apply(variables: dict, images: jax.Array, *,
                          "(>= 50); BasicBlock models have no Conv_2")
     from jax import lax
 
-    from ..ops.fused_block_train import fused_bottleneck_train
+    from ..ops.fused_block_train import (fits_vmem_budget,
+                                         fused_bottleneck_train)
 
     params, stats = variables["params"], variables["batch_stats"]
     batch_moments: dict = {}
@@ -391,12 +392,17 @@ def fused_train_apply(variables: dict, images: jax.Array, *,
         for j in range(n_blocks):
             name = f"stage{i + 1}_block{j + 1}"
             strides = 2 if i > 0 and j == 0 else 1
-            if strides == 1:
-                x, bstats = fused_bottleneck_train(x, params[name],
-                                                   tile_bt=tile_bt,
+            bp = params[name]
+            _, h, w_, cin = x.shape
+            cmid = bp["Conv_0"]["kernel"].shape[-1]
+            cout = bp["Conv_2"]["kernel"].shape[-1]
+            # strided blocks the kernel doesn't cover; early-stage blocks
+            # whose one-image working set busts VMEM route to XLA too
+            if strides == 1 and fits_vmem_budget(h, w_, cin, cmid, cout):
+                x, bstats = fused_bottleneck_train(x, bp, tile_bt=tile_bt,
                                                    eps=eps)
             else:
-                x, bstats = _xla_block_train(x, params[name], strides,
+                x, bstats = _xla_block_train(x, bp, strides,
                                              dtype=dtype, eps=eps)
             batch_moments[name] = bstats
 

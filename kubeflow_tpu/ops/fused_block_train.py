@@ -52,7 +52,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 __all__ = ["fused_bottleneck_train", "reference_bottleneck_train",
-           "block_weights", "stats_to_tree", "default_tile_bt"]
+           "block_weights", "stats_to_tree", "default_tile_bt",
+           "fits_vmem_budget", "VMEM_BUDGET_BYTES"]
 
 
 def _interpret() -> bool:
@@ -91,15 +92,35 @@ def stats_to_tree(stats: tuple, has_proj: bool) -> dict:
     return tree
 
 
+VMEM_BUDGET_BYTES = 7 * 2 ** 20
+
+
+def _per_image_bytes(h: int, w: int, cin: int, cmid: int, cout: int) -> int:
+    """Backward working-set estimate per image (the heavier direction):
+    x + g + dx tiles, bf16 interiors (h1, h2, x̂3, gz, da3), f32 (M,Cmid)
+    temporaries and one f32 (M,Cout) temporary."""
+    return h * w * (cin * 2 * 2 + cout * 2 * 4 + cout * 4
+                    + cmid * (2 * 2 + 4 * 4))
+
+
+def fits_vmem_budget(h: int, w: int, cin: int, cmid: int,
+                     cout: int) -> bool:
+    """Whether even a one-image batch tile of this block's backward
+    working set fits the VMEM budget. Blocks that fail (ResNet-50's
+    56×56 stage-1/2 bottlenecks estimate ~14–17 MB/image) must route to
+    the XLA path — the kernel grid tiles batch only, so bt=1 is the
+    floor and a kernel launched past the budget VMEM-OOMs on silicon."""
+    return _per_image_bytes(h, w, cin, cmid, cout) <= VMEM_BUDGET_BYTES
+
+
 def default_tile_bt(n: int, h: int, w: int, cin: int, cmid: int,
                     cout: int) -> int:
     """Largest batch tile whose backward working set fits the VMEM
-    budget. Dominant live f32/bf16 tensors per image (backward, the
-    heavier direction): x + g + dx tiles, bf16 interiors (h1, h2, x̂3,
-    gz, da3), f32 (M,Cmid) temporaries and one f32 (M,Cout) temporary."""
-    per_image = h * w * (cin * 2 * 2 + cout * 2 * 4 + cout * 4
-                         + cmid * (2 * 2 + 4 * 4))
-    bt = max(1, int((7 * 2 ** 20) // max(per_image, 1)))
+    budget (see _per_image_bytes). Callers must have checked
+    fits_vmem_budget first: this clamps to bt=1 even when one image
+    already busts the budget."""
+    per_image = _per_image_bytes(h, w, cin, cmid, cout)
+    bt = max(1, int(VMEM_BUDGET_BYTES // max(per_image, 1)))
     while n % bt:
         bt -= 1
     return bt

@@ -182,12 +182,20 @@ def train(
     # real-data path: shard dirs are self-describing, so the dataset's
     # geometry configures the model (launcher.py --data_dir analog)
     data_dir = data_dir or os.environ.get("KFTPU_DATA_DIR")
+    eval_explicit = eval_data_dir is not None
     eval_data_dir = eval_data_dir or os.environ.get("KFTPU_EVAL_DATA_DIR")
     if eval_data_dir and workload not in _IMAGE_WORKLOADS:
-        # mirror the data_dir check below: a transformer job pointed at
-        # image shards must fail at startup, not mid-run at the first eval
-        raise ValueError(
-            f"workload {workload!r} does not consume --eval-data-dir")
+        if eval_explicit or eval_every > 0:
+            # mirror the data_dir check below: a transformer job pointed
+            # at image shards must fail at startup, not at the first eval
+            raise ValueError(
+                f"workload {workload!r} does not consume --eval-data-dir")
+        # gang-wide KFTPU_EVAL_DATA_DIR with eval disabled: the env var
+        # is set for the image workers in the gang, not this one — warn,
+        # don't crash the whole job
+        log.warning("ignoring KFTPU_EVAL_DATA_DIR for workload %r "
+                    "(eval disabled)", workload)
+        eval_data_dir = None
     data_source = None
     if data_dir:
         if workload not in _IMAGE_WORKLOADS:

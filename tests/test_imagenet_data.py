@@ -381,6 +381,26 @@ class TestEvalTailHandling:
             train(workload="transformer", steps=1, global_batch=8,
                   eval_data_dir=d, eval_every=1, seed=0)
 
+    def test_gang_env_eval_dir_ignored_when_eval_disabled(self, data_dir,
+                                                          monkeypatch):
+        # KFTPU_EVAL_DATA_DIR is set gang-wide; a transformer worker in
+        # the gang with eval off must warn and run, not crash (ADVICE r4)
+        d, *_ = data_dir
+        monkeypatch.setenv("KFTPU_EVAL_DATA_DIR", d)
+        from kubeflow_tpu.runtime.worker import train
+        r = train(workload="transformer", steps=1, global_batch=8,
+                  eval_every=0, sync_every=1, seed=0)
+        assert r.steps == 1
+
+    def test_gang_env_eval_dir_still_rejected_when_eval_enabled(
+            self, data_dir, monkeypatch):
+        d, *_ = data_dir
+        monkeypatch.setenv("KFTPU_EVAL_DATA_DIR", d)
+        from kubeflow_tpu.runtime.worker import train
+        with pytest.raises(ValueError, match="eval-data-dir"):
+            train(workload="transformer", steps=1, global_batch=8,
+                  eval_every=1, seed=0)
+
 
 class TestCompileCache:
     """runtime/compile_cache.py: persistent XLA compilation cache wiring

@@ -171,10 +171,9 @@ class TrainingJobReconciler(Reconciler):
             return self._handle_gang_failure(client, job, manifest, pods, failed)
 
         running = sum(1 for ph in phases.values() if ph == POD_RUNNING)
-        if running == job.total_pods() and running > 0:
-            self._set_condition(client, manifest, COND_RUNNING, "True",
-                                "JobRunning", "all replicas running")
-        self._update_replica_statuses(client, manifest, job, pods)
+        self._finalize_status(client, manifest, pods,
+                              all_running=(running == job.total_pods()
+                                           and running > 0))
         return Result()
 
     # ------------------------------------------------------------- children
@@ -521,8 +520,12 @@ class TrainingJobReconciler(Reconciler):
         client.update_status(fresh)
         manifest["status"] = fresh["status"]
 
-    def _update_replica_statuses(self, client: KubeClient, manifest: dict,
-                                 job: TrainingJob, pods: list[dict]) -> None:
+    def _finalize_status(self, client: KubeClient, manifest: dict,
+                         pods: list[dict], *, all_running: bool) -> None:
+        """Steady-state status tail: the Running condition AND the
+        replicaStatuses counts in ONE get+put per reconcile pass (the
+        single-update-per-reconcile idiom — two sequential get+puts race
+        with concurrent writers and double the apiserver traffic)."""
         counts: dict[str, dict[str, int]] = {}
         for p in pods:
             rtype = k8s.labels_of(p).get(REPLICA_TYPE_LABEL, "unknown")
@@ -533,10 +536,23 @@ class TrainingJobReconciler(Reconciler):
             counts.setdefault(rtype, {"active": 0, "succeeded": 0,
                                       "failed": 0})[bucket] += 1
         fresh = client.get_or_none(*k8s.key_of(manifest))
-        if fresh is not None and \
-                fresh.get("status", {}).get("replicaStatuses") != counts:
+        if fresh is None:
+            return
+        dirty = False
+        if all_running:
+            existing = k8s.get_condition(fresh, COND_RUNNING)
+            if not (existing and existing.get("status") == "True" and
+                    existing.get("reason") == "JobRunning"):
+                k8s.set_condition(fresh, k8s.Condition(
+                    COND_RUNNING, "True", "JobRunning",
+                    "all replicas running"))
+                dirty = True
+        if fresh.get("status", {}).get("replicaStatuses") != counts:
             fresh.setdefault("status", {})["replicaStatuses"] = counts
+            dirty = True
+        if dirty:
             client.update_status(fresh)
+        manifest["status"] = fresh.get("status", {})
 
 
 def all_reconcilers() -> list[TrainingJobReconciler]:

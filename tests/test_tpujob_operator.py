@@ -87,6 +87,34 @@ class TestTPUJobReconcile:
         assert k8s.condition_true(job, "Running")
         assert job["status"]["replicaStatuses"]["tpu"]["active"] == 2
 
+    def test_steady_state_reconcile_writes_status_once(self):
+        # Running condition + replicaStatuses land in ONE update_status
+        # per pass (single-update-per-reconcile idiom); a repeat pass
+        # with nothing changed writes nothing at all
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        mgr = Manager(cluster)
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        cluster.create(tpujob_manifest())
+        drive(cluster, mgr)
+        writes = []
+        orig = cluster.update_status
+        cluster.update_status = lambda obj: (writes.append(
+            k8s.name_of(obj)), orig(obj))[1]
+        try:
+            rec = TrainingJobReconciler("TPUJob")
+            rec.reconcile(cluster, ("kubeflow", "train"))
+            assert len(writes) <= 1
+            job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                              "kubeflow", "train")
+            assert k8s.condition_true(job, "Running")
+            assert job["status"]["replicaStatuses"]["tpu"]["active"] == 2
+            writes.clear()
+            rec.reconcile(cluster, ("kubeflow", "train"))
+            assert writes == []
+        finally:
+            cluster.update_status = orig
+
     def test_chief_success_completes_job_and_cleans_running_pods(self, env):
         cluster, mgr, _ = env
         cluster.create(tpujob_manifest())

@@ -13,10 +13,16 @@ Routes:
   POST /kfctl/apps/apply    {name}
   POST /kfctl/e2eDeploy     {name, ...}        (create + generate + apply)
   POST /kfctl/apps/delete   {name}
+  POST /kfctl/iam/apply     {project, cluster, email?, action?}
+  POST /kfctl/initProject   {project, projectNumber}
   GET  /kfctl/apps                              (list + conditions)
   GET  /kfctl/apps/{name}                       (show)
   GET  /metrics
   GET  /healthz
+
+The IAM routes (ksServer.go:1465-1466) run over the same GCP executor
+seam GcpPlatform uses; without one configured they 503 (zero-egress dev
+default) rather than pretending to have edited a cloud policy.
 """
 
 from __future__ import annotations
@@ -55,10 +61,11 @@ class _Counters:
 class BootstrapService:
     """App registry rooted at ``apps_root``; one directory per app."""
 
-    def __init__(self, apps_root: str):
+    def __init__(self, apps_root: str, gcp_executor=None):
         self.apps_root = os.path.abspath(apps_root)
         os.makedirs(self.apps_root, exist_ok=True)
         self.counters = _Counters()
+        self.gcp_executor = gcp_executor
         self._busy: set[str] = set()
         self._lock = threading.Lock()
 
@@ -204,6 +211,56 @@ class BootstrapService:
             raise ApiError(404, f"app {name} not found")
         return Coordinator.load(app_dir).show()
 
+    # -- project IAM (ksServer.go:1465-1466) --------------------------------
+
+    def _require_executor(self):
+        if self.gcp_executor is None:
+            raise ApiError(503, "no GCP executor configured (zero-egress "
+                                "dev: construct BootstrapService with "
+                                "gcp_executor=, e.g. a GcpSimulator)")
+        return self.gcp_executor
+
+    def apply_iam(self, body: dict) -> dict:
+        """Rewrite the project policy for a deployment's generated SAs +
+        IAP user. Serialized per project: two concurrent writers would
+        race the policy read-modify-write (the reference holds a
+        per-project mutex for the same reason, initHandler.go:45)."""
+        from .iam import apply_iam
+        executor = self._require_executor()
+        project = body.get("project", "")
+        cluster = body.get("cluster", "")
+        if not project or not cluster:
+            raise ApiError(400, "project and cluster are required")
+        action = body.get("action", "add")
+        if action not in ("add", "remove"):
+            raise ApiError(400, f"action must be add|remove, got {action!r}")
+        key = f"project:{project}"
+        self._acquire(key)
+        try:
+            policy = apply_iam(executor, project=project, cluster=cluster,
+                               email=body.get("email", ""), action=action)
+        finally:
+            self._release(key)
+        return {"project": project, "action": action, "policy": policy}
+
+    def init_project(self, body: dict) -> dict:
+        """Grant the DM service account projectIamAdmin
+        (initHandler.go makeInitProjectEndpoint)."""
+        from .iam import init_project
+        executor = self._require_executor()
+        project = body.get("project", "")
+        number = str(body.get("projectNumber", "") or "")
+        if not project or not number:
+            raise ApiError(400, "project and projectNumber are required")
+        key = f"project:{project}"
+        self._acquire(key)
+        try:
+            policy = init_project(executor, project=project,
+                                  project_number=number)
+        finally:
+            self._release(key)
+        return {"project": project, "policy": policy}
+
 
 # the click-to-deploy page (gcp-click-to-deploy React UI analog): form →
 # POST /kfctl/e2eDeploy, progress log, app listing — one static JS file
@@ -230,10 +287,24 @@ margin-top:1rem;white-space:pre-wrap}
   <label>config flavor</label><select name="flavor">
     <option value="">default</option><option>local</option>
     <option>iap</option><option>basic_auth</option></select>
+  <label>components</label><select id="components" multiple size="8">
+  </select>
   <button type="submit">Create deployment</button>
 </form>
 <div id="deploy-log"></div>
 <h2>Deployments</h2><ul id="apps"></ul>
+<h2>Project IAM</h2>
+<form id="iam-form">
+  <label>GCP project</label><input name="iamProject" required>
+  <label>project number</label><input name="iamNumber"
+    placeholder="(runs initProject first when set)">
+  <label>cluster</label><input name="iamCluster" required>
+  <label>IAP user email</label><input name="iamEmail" type="email">
+  <label>action</label><select name="iamAction">
+    <option value="add">add</option><option value="remove">remove</option>
+  </select>
+  <button type="submit">Apply IAM</button>
+</form>
 <script src="/deploy.js"></script>
 </body></html>"""
 
@@ -293,6 +364,14 @@ def build_bootstrap_app(service: BootstrapService) -> JsonApp:
             raise ApiError(400, "name is required")
         return 200, service.delete(body["name"])
 
+    @app.route("POST", "/kfctl/iam/apply")
+    def iam_apply(params, query, body):
+        return 200, service.apply_iam(body or {})
+
+    @app.route("POST", "/kfctl/initProject")
+    def init_project(params, query, body):
+        return 200, service.init_project(body or {})
+
     @app.route("GET", "/kfctl/apps")
     def list_apps(params, query, body):
         return 200, {"apps": service.list_apps()}
@@ -305,7 +384,7 @@ def build_bootstrap_app(service: BootstrapService) -> JsonApp:
 
 
 class BootstrapServer(JsonServer):
-    def __init__(self, apps_root: str, **kw):
-        self.service = BootstrapService(apps_root)
+    def __init__(self, apps_root: str, gcp_executor=None, **kw):
+        self.service = BootstrapService(apps_root, gcp_executor=gcp_executor)
         super().__init__(build_bootstrap_app(self.service), name="bootstrap",
                          **kw)

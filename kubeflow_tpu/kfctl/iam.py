@@ -1,0 +1,157 @@
+"""Project-IAM operations behind the bootstrap server's GCP seam.
+
+The reference's bootstrap server exposes two IAM routes
+(ksServer.go:1465-1466): /kfctl/iam/apply — rewrite the project policy
+for a deployment's generated service accounts + the IAP user
+(gcpUtils.go:145 ClearServiceAccountPolicy, :179 UpdatePolicy, :229
+ApplyIamPolicy over a bindings template) — and /kfctl/initProject —
+grant the Deployment-Manager service account projectIamAdmin so DM can
+edit IAM during deploy (initHandler.go makeInitProjectEndpoint/BindRole).
+
+Same semantics here over the executor seam GcpPlatform already uses
+(projects.getIamPolicy / projects.setIamPolicy), so the GcpSimulator
+exercises the full read-modify-write including etag conflicts. The
+bindings template is TPU-era: tpu.admin/container.admin for the admin
+SA, storage+aiplatform for the user SA, log/metric writers for the VM
+SA, iap.httpsResourceAccessor for the IAP account.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+IAM_ADMIN_ROLE = "roles/resourcemanager.projectIamAdmin"
+
+# Placeholder names are the reference template's contract
+# (iam_bindings_template.yaml): the request's cluster/project/email
+# resolve them to concrete accounts at apply time.
+SA_ADMIN = "set-kubeflow-admin-service-account"
+SA_USER = "set-kubeflow-user-service-account"
+SA_VM = "set-kubeflow-vm-service-account"
+SA_IAP = "set-kubeflow-iap-account"
+
+IAM_BINDINGS_TEMPLATE = {
+    "bindings": [
+        {"members": [SA_ADMIN],
+         "roles": ["roles/tpu.admin", "roles/container.admin",
+                   "roles/servicemanagement.admin",
+                   "roles/compute.networkAdmin"]},
+        {"members": [SA_USER],
+         "roles": ["roles/storage.admin", "roles/viewer",
+                   "roles/aiplatform.user", "roles/bigquery.admin"]},
+        {"members": [SA_VM],
+         "roles": ["roles/logging.logWriter",
+                   "roles/monitoring.metricWriter",
+                   "roles/storage.objectViewer"]},
+        {"members": [SA_IAP],
+         "roles": ["roles/iap.httpsResourceAccessor"]},
+    ],
+}
+
+
+def prepare_account(account: str) -> str:
+    """Prefix a bare account with its IAM member kind
+    (gcpUtils.go:168 PrepareAccount)."""
+    if account.startswith(("serviceAccount:", "user:", "group:")):
+        return account
+    if "iam.gserviceaccount.com" in account or \
+            account.endswith("gserviceaccount.com"):
+        return "serviceAccount:" + account
+    return "user:" + account
+
+
+def _generated_accounts(project: str, cluster: str) -> dict[str, str]:
+    """The deployment's auto-generated SAs, placeholder → member."""
+    return {
+        SA_ADMIN: prepare_account(
+            f"{cluster}-admin@{project}.iam.gserviceaccount.com"),
+        SA_USER: prepare_account(
+            f"{cluster}-user@{project}.iam.gserviceaccount.com"),
+        SA_VM: prepare_account(
+            f"{cluster}-vm@{project}.iam.gserviceaccount.com"),
+    }
+
+
+def clear_service_account_policy(policy: dict, project: str,
+                                 cluster: str) -> None:
+    """Drop every binding member that is one of the deployment's
+    generated SAs — leftovers from previous applies are reset before the
+    template is re-applied (gcpUtils.go:145)."""
+    generated = set(_generated_accounts(project, cluster).values())
+    policy["bindings"] = [
+        {"role": b.get("role", ""),
+         "members": [m for m in b.get("members", [])
+                     if m not in generated]}
+        for b in policy.get("bindings", [])
+    ]
+
+
+def update_policy(policy: dict, *, project: str, cluster: str,
+                  email: str, action: str = "add") -> None:
+    """Merge the resolved bindings template into ``policy`` in place
+    (gcpUtils.go:179): action "add" inserts members, "remove" deletes
+    them; untouched existing members survive (read-modify-write, never a
+    blind overwrite)."""
+    members_by_role: dict[str, list[str]] = {}
+    for b in policy.get("bindings", []):
+        members_by_role.setdefault(b.get("role", ""), [])
+        for m in b.get("members", []):
+            if m not in members_by_role[b["role"]]:
+                members_by_role[b["role"]].append(m)
+
+    mapping = _generated_accounts(project, cluster)
+    mapping[SA_IAP] = prepare_account(email) if email else ""
+    for binding in IAM_BINDINGS_TEMPLATE["bindings"]:
+        for placeholder in binding["members"]:
+            member = mapping.get(placeholder, placeholder)
+            if not member:
+                continue  # no IAP email in the request
+            for role in binding["roles"]:
+                members = members_by_role.setdefault(role, [])
+                if action == "add" and member not in members:
+                    members.append(member)
+                elif action == "remove" and member in members:
+                    members.remove(member)
+
+    policy["bindings"] = [{"role": r, "members": m}
+                          for r, m in sorted(members_by_role.items()) if m]
+
+
+def apply_iam(executor: Callable[[str, dict], dict], *, project: str,
+              cluster: str, email: str = "", action: str = "add") -> dict:
+    """The /kfctl/iam/apply operation: get → clear generated SAs →
+    apply template → set, preserving the policy etag so a concurrent
+    writer surfaces as a conflict instead of a lost update."""
+    if action not in ("add", "remove"):
+        raise ValueError(f"action must be add|remove, got {action!r}")
+    policy = executor("projects.getIamPolicy", {"project": project})
+    clear_service_account_policy(policy, project, cluster)
+    update_policy(policy, project=project, cluster=cluster, email=email,
+                  action=action)
+    return executor("projects.setIamPolicy", {
+        "project": project,
+        "policy": {"etag": policy.get("etag", ""),
+                   "bindings": policy["bindings"]},
+    })
+
+
+def init_project(executor: Callable[[str, dict], dict], *, project: str,
+                 project_number: str) -> dict:
+    """The /kfctl/initProject operation: bind the project's
+    Deployment-Manager service account
+    (<number>@cloudservices.gserviceaccount.com) to projectIamAdmin so
+    DM-driven deploys may edit IAM (initHandler.go BindRole)."""
+    dm_sa = prepare_account(
+        f"{project_number}@cloudservices.gserviceaccount.com")
+    policy = executor("projects.getIamPolicy", {"project": project})
+    bindings = {b.get("role", ""): list(b.get("members", []))
+                for b in policy.get("bindings", [])}
+    members = bindings.setdefault(IAM_ADMIN_ROLE, [])
+    if dm_sa not in members:
+        members.append(dm_sa)
+    return executor("projects.setIamPolicy", {
+        "project": project,
+        "policy": {"etag": policy.get("etag", ""),
+                   "bindings": [{"role": r, "members": m}
+                                for r, m in sorted(bindings.items())]},
+    })

@@ -1,8 +1,10 @@
 /* Click-to-deploy UI (components/gcp-click-to-deploy/src/DeployForm.tsx
  * analog, no build infra): a form over the bootstrap REST service —
- * POST /kfctl/e2eDeploy, then poll /kfctl/apps/show until conditions
- * report Available, rendering deploy progress like the React UI's
- * DeployProgress. */
+ * component picker from /kfctl/components, POST /kfctl/e2eDeploy, then
+ * poll /kfctl/apps/{name} until conditions report Available (the React
+ * UI's DeployProgress), an app table with per-app delete, and the IAM
+ * panel driving /kfctl/iam/apply + /kfctl/initProject (the reference
+ * UI's "Set up project" step). */
 (function () {
   "use strict";
 
@@ -37,15 +39,69 @@
     el.scrollTop = el.scrollHeight;
   }
 
+  // -- component picker (GET /kfctl/components → multi-select) ---------------
+
+  async function loadComponents() {
+    const sel = document.getElementById("components");
+    if (!sel) return;
+    try {
+      const { components } = await get("/kfctl/components");
+      sel.innerHTML = components.map((c) =>
+        `<option value="${esc(c)}">${esc(c)}</option>`).join("");
+    } catch (err) {
+      logLine(`component list unavailable: ${err.message}`, "error");
+    }
+  }
+
+  function selectedComponents() {
+    const sel = document.getElementById("components");
+    if (!sel) return [];
+    return Array.from(sel.selectedOptions).map((o) => o.value);
+  }
+
+  // -- app table -------------------------------------------------------------
+
+  async function deleteApp(name) {
+    logLine(`deleting ${name}…`);
+    try {
+      await post("/kfctl/apps/delete", { name });
+      logLine(`deleted ${name}`, "ok");
+    } catch (err) {
+      logLine(`delete failed: ${err.message}`, "error");
+    }
+    refreshApps();
+  }
+
   async function refreshApps() {
     const apps = (await get("/kfctl/apps")).apps;
     const el = document.getElementById("apps");
     el.innerHTML = apps.length
       ? apps.map((a) =>
           `<li><b>${esc(a.name)}</b> — ${esc(a.platform || "existing")}` +
-          ` (${esc((a.conditions || []).slice(-1)[0] || "created")})</li>`)
-        .join("")
+          ` (${esc((a.conditions || []).slice(-1)[0] || "created")})` +
+          ` <button type="button" data-del="${esc(a.name)}">delete` +
+          "</button></li>").join("")
       : "<li class=empty>no deployments yet</li>";
+    el.querySelectorAll("button[data-del]").forEach((b) => {
+      b.onclick = () => deleteApp(b.dataset.del);
+    });
+  }
+
+  // -- deploy with progress polling ------------------------------------------
+
+  async function pollUntilAvailable(name, tries) {
+    // DeployProgress: re-show the app until Available lands (apply is
+    // synchronous here, but a slow controller may converge afterwards)
+    for (let i = 0; i < (tries || 10); i++) {
+      const show = await get(`/kfctl/apps/${encodeURIComponent(name)}`);
+      const conds = show.conditions || [];
+      conds.forEach((c) => logLine(`condition: ${c}`));
+      if (conds.some((c) => String(c).startsWith("Available=True"))) {
+        return true;
+      }
+      await new Promise((r) => setTimeout(r, 1000));
+    }
+    return false;
   }
 
   async function deploy(ev) {
@@ -59,14 +115,20 @@
     };
     if (form.project.value.trim()) payload.project = form.project.value.trim();
     if (form.flavor.value) payload.flavor = form.flavor.value;
-    const button = form.querySelector("button");
+    const components = selectedComponents();
+    if (components.length) payload.components = components;
+    const button = form.querySelector("button[type=submit]");
     button.disabled = true;
     logLine(`deploying ${name}…`);
     try {
       const result = await post("/kfctl/e2eDeploy", payload);
       logLine(`applied ${result.applied} objects`, "ok");
-      const show = await get(`/kfctl/apps/${encodeURIComponent(name)}`);
-      (show.conditions || []).forEach((c) => logLine(`condition: ${c}`));
+      if ((result.failed || []).length) {
+        logLine(`failed: ${result.failed.join(", ")}`, "error");
+      }
+      const ok = await pollUntilAvailable(name, 5);
+      logLine(ok ? `${name} is Available` : `${name} not Available yet`,
+              ok ? "ok" : "error");
     } catch (err) {
       logLine(`deploy failed: ${err.message}`, "error");
     } finally {
@@ -75,9 +137,37 @@
     }
   }
 
+  // -- project IAM (POST /kfctl/iam/apply + /kfctl/initProject) --------------
+
+  async function applyIam(ev) {
+    ev.preventDefault();
+    const form = ev.target;
+    const project = form.iamProject.value.trim();
+    const payload = {
+      project: project,
+      cluster: form.iamCluster.value.trim(),
+      email: form.iamEmail.value.trim(),
+      action: form.iamAction.value,
+    };
+    try {
+      if (form.iamNumber.value.trim()) {
+        await post("/kfctl/initProject", {
+          project: project, projectNumber: form.iamNumber.value.trim() });
+        logLine(`initProject ${project} ok`, "ok");
+      }
+      const out = await post("/kfctl/iam/apply", payload);
+      logLine(`iam ${out.action} applied on ${out.project}`, "ok");
+    } catch (err) {
+      logLine(`iam failed: ${err.message}`, "error");
+    }
+  }
+
   function main() {
     document.getElementById("deploy-form")
       .addEventListener("submit", deploy);
+    const iam = document.getElementById("iam-form");
+    if (iam) iam.addEventListener("submit", applyIam);
+    loadComponents();
     refreshApps();
   }
 

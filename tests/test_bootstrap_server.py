@@ -117,3 +117,112 @@ class TestBootstrapServer:
         # a repeated e2eDeploy of an existing app applies instead of 409ing
         result = post(f"{base}/kfctl/e2eDeploy", {"name": "kf5"})
         assert result["applied"] > 0
+
+    def test_iam_routes_503_without_executor(self, server):
+        _, base = server
+        for route, body in (("iam/apply", {"project": "p", "cluster": "c"}),
+                            ("initProject", {"project": "p",
+                                             "projectNumber": "1"})):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                post(f"{base}/kfctl/{route}", body)
+            assert e.value.code == 503
+
+
+class TestIamRoutes:
+    """/kfctl/iam/apply + /kfctl/initProject over the GcpSimulator
+    (ksServer.go:1465-1466; gcpUtils.go ApplyIamPolicy; initHandler.go)."""
+
+    @pytest.fixture
+    def iam_server(self, tmp_path):
+        from kubeflow_tpu.kfctl.gcp_sim import GcpSimulator
+        sim = GcpSimulator()
+        s = BootstrapServer(str(tmp_path / "apps"), gcp_executor=sim)
+        s.start()
+        yield sim, f"http://127.0.0.1:{s.port}"
+        s.stop()
+
+    def test_iam_apply_add_binds_generated_sas_and_iap_user(self,
+                                                            iam_server):
+        sim, base = iam_server
+        out = post(f"{base}/kfctl/iam/apply",
+                   {"project": "proj", "cluster": "kf",
+                    "email": "alice@example.com"})
+        assert out["action"] == "add"
+        roles = {b["role"]: b["members"]
+                 for b in sim.iam_policy["bindings"]}
+        admin = "serviceAccount:kf-admin@proj.iam.gserviceaccount.com"
+        assert admin in roles["roles/tpu.admin"]
+        assert admin in roles["roles/container.admin"]
+        assert "serviceAccount:kf-vm@proj.iam.gserviceaccount.com" in \
+            roles["roles/logging.logWriter"]
+        assert "user:alice@example.com" in \
+            roles["roles/iap.httpsResourceAccessor"]
+
+    def test_iam_apply_preserves_unrelated_members(self, iam_server):
+        sim, base = iam_server
+        sim.iam_policy["bindings"] = [
+            {"role": "roles/owner", "members": ["user:boss@example.com"]},
+            {"role": "roles/tpu.admin",
+             "members": ["serviceAccount:other@proj.iam.gserviceaccount"
+                         ".com"]}]
+        post(f"{base}/kfctl/iam/apply",
+             {"project": "proj", "cluster": "kf"})
+        roles = {b["role"]: b["members"]
+                 for b in sim.iam_policy["bindings"]}
+        assert "user:boss@example.com" in roles["roles/owner"]
+        assert "serviceAccount:other@proj.iam.gserviceaccount.com" in \
+            roles["roles/tpu.admin"]
+
+    def test_iam_apply_remove_then_policy_clean(self, iam_server):
+        sim, base = iam_server
+        post(f"{base}/kfctl/iam/apply",
+             {"project": "proj", "cluster": "kf",
+              "email": "alice@example.com"})
+        post(f"{base}/kfctl/iam/apply",
+             {"project": "proj", "cluster": "kf",
+              "email": "alice@example.com", "action": "remove"})
+        members = [m for b in sim.iam_policy["bindings"]
+                   for m in b["members"]]
+        assert not any("kf-admin@proj" in m or "alice@" in m
+                       for m in members)
+
+    def test_iam_apply_clears_stale_generated_sa_bindings(self,
+                                                          iam_server):
+        # a leftover binding from a previous deploy under another role is
+        # reset, not accumulated (ClearServiceAccountPolicy semantics)
+        sim, base = iam_server
+        sim.iam_policy["bindings"] = [
+            {"role": "roles/owner",
+             "members": ["serviceAccount:kf-admin@proj.iam"
+                         ".gserviceaccount.com"]}]
+        post(f"{base}/kfctl/iam/apply", {"project": "proj", "cluster": "kf"})
+        roles = {b["role"]: b["members"]
+                 for b in sim.iam_policy["bindings"]}
+        assert "roles/owner" not in roles  # stale binding dropped (empty)
+        assert "serviceAccount:kf-admin@proj.iam.gserviceaccount.com" in \
+            roles["roles/tpu.admin"]
+
+    def test_init_project_binds_dm_service_account(self, iam_server):
+        sim, base = iam_server
+        out = post(f"{base}/kfctl/initProject",
+                   {"project": "proj", "projectNumber": "12345"})
+        assert out["project"] == "proj"
+        roles = {b["role"]: b["members"]
+                 for b in sim.iam_policy["bindings"]}
+        assert "serviceAccount:12345@cloudservices.gserviceaccount.com" \
+            in roles["roles/resourcemanager.projectIamAdmin"]
+        # idempotent: a second call does not duplicate the member
+        post(f"{base}/kfctl/initProject",
+             {"project": "proj", "projectNumber": "12345"})
+        roles = {b["role"]: b["members"]
+                 for b in sim.iam_policy["bindings"]}
+        assert roles["roles/resourcemanager.projectIamAdmin"].count(
+            "serviceAccount:12345@cloudservices.gserviceaccount.com") == 1
+
+    def test_iam_apply_validates_request(self, iam_server):
+        _, base = iam_server
+        for bad in ({"cluster": "kf"}, {"project": "p"},
+                    {"project": "p", "cluster": "c", "action": "wipe"}):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                post(f"{base}/kfctl/iam/apply", bad)
+            assert e.value.code == 400

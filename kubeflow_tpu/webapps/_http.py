@@ -19,9 +19,15 @@ Route = tuple[str, re.Pattern, Callable]
 
 class JsonApp:
     """Register handlers with ``app.route("GET", "/api/x/{name}")``;
-    handlers receive (params, query, body) and return (status, payload)."""
+    handlers receive (params, query, body) and return (status, payload).
 
-    def __init__(self):
+    ``prefix`` mounts the whole app under a URL base (the reference
+    jupyter-web-app's url-prefix config): an ingress routing /jupyter/
+    forwards paths verbatim, so the app strips its own prefix before
+    matching. Both the bare and the prefixed path resolve."""
+
+    def __init__(self, prefix: str = ""):
+        self.prefix = "/" + prefix.strip("/") if prefix.strip("/") else ""
         self.routes: list[Route] = []
 
     def route(self, method: str, pattern: str):
@@ -38,10 +44,14 @@ class JsonApp:
                  body: Optional[dict]) -> tuple[int, Any]:
         parsed = urlparse(path)
         query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        route_path = parsed.path
+        if self.prefix and (route_path == self.prefix or
+                            route_path.startswith(self.prefix + "/")):
+            route_path = route_path[len(self.prefix):] or "/"
         for m, regex, fn in self.routes:
             if m != method:
                 continue
-            match = regex.match(parsed.path)
+            match = regex.match(route_path)
             if match:
                 try:
                     return fn(match.groupdict(), query, body)

@@ -29,10 +29,26 @@ METRIC_TYPES = ("podcpu", "podmem", "node")
 
 # The SPA shell: sidebar + namespace selector + one view container; all
 # rendering happens in the static app bundle (static/dashboard.js — the
-# Polymer main-page.js analog, no build infra).
+# Polymer main-page.js analog, no build infra). Chart colors are CSS
+# custom properties per color-scheme: single sequential hue for the bar
+# charts, fixed status palette for run phases (icon + label pairing),
+# text in ink tokens — never the series color.
 INDEX_HTML = """<!doctype html>
 <html><head><title>Kubeflow TPU</title><meta charset="utf-8"><style>
-body{font-family:sans-serif;margin:0;display:flex;min-height:100vh}
+:root{color-scheme:light dark;
+ --surface-1:#fcfcfb;--surface-2:#f1f0ec;
+ --text-primary:#0b0b0b;--text-secondary:#52514e;--text-muted:#7c7b75;
+ --series-1:#2a78d6;--series-1-hover:#1c5cab;
+ --grid:#e3e2dd;
+ --status-good:#0ca30c;--status-warning:#fab219;
+ --status-critical:#d03b3b}
+@media (prefers-color-scheme: dark){:root{
+ --surface-1:#1a1a19;--surface-2:#262625;
+ --text-primary:#ffffff;--text-secondary:#c3c2b7;--text-muted:#8f8e86;
+ --series-1:#3987e5;--series-1-hover:#6da7ec;
+ --grid:#3a3936}}
+body{font-family:sans-serif;margin:0;display:flex;min-height:100vh;
+ background:var(--surface-1);color:var(--text-primary)}
 #sidebar{background:#1a73e8;color:#fff;min-width:13rem;padding:1rem}
 #sidebar h1{font-size:1.1rem;margin:0 0 1rem}
 #sidebar a{display:block;color:#fff;text-decoration:none;padding:0.45rem
@@ -41,9 +57,40 @@ body{font-family:sans-serif;margin:0;display:flex;min-height:100vh}
 #ns-selector{width:100%;padding:0.35rem;margin-bottom:1rem}
 main{flex:1;padding:1.5rem;max-width:70rem}
 table{border-collapse:collapse;margin:0.5rem 0 1.5rem}
-td,th{border:1px solid #ccc;padding:0.3rem 0.8rem;text-align:left}
-nav.tabs a{margin-right:0.8rem}
-.empty{color:#777}.error{color:#b00020}
+td,th{border:1px solid var(--grid);padding:0.3rem 0.8rem;text-align:left}
+th{color:var(--text-secondary);font-weight:600}
+nav.tabs a{margin-right:0.8rem;color:var(--series-1)}
+nav.tabs a.active{font-weight:700;text-decoration:none}
+.empty{color:var(--text-muted)}.error{color:var(--status-critical)}
+.tiles{display:flex;gap:0.8rem;flex-wrap:wrap;margin:0.5rem 0 1rem}
+.tile{background:var(--surface-2);border-radius:8px;
+ padding:0.7rem 1.1rem;min-width:7rem}
+.tile-label{color:var(--text-secondary);font-size:0.8rem}
+.tile-value{font-weight:600;font-size:1.6rem}
+.badge{white-space:nowrap}
+.badge-icon{font-size:0.85em}
+.badge-good{color:var(--status-good)}
+.badge-running{color:var(--series-1)}
+.badge-warning{color:var(--text-secondary)}
+.badge-critical{color:var(--status-critical)}
+button.minor{padding:0.3rem 0.8rem;border:1px solid var(--grid);
+ border-radius:4px;background:var(--surface-2);
+ color:var(--text-primary);cursor:pointer;margin-bottom:0.4rem}
+.viz-root svg{display:block;margin:0.4rem 0 1rem}
+.viz-bar{fill:var(--series-1)}
+.viz-bar.hover{fill:var(--series-1-hover)}
+.viz-grid{stroke:var(--grid);stroke-width:1}
+.viz-label{fill:var(--text-secondary);font-size:11px}
+.viz-value{fill:var(--text-primary);font-size:11px;
+ font-variant-numeric:tabular-nums}
+.viz-tick{fill:var(--text-muted);font-size:10px;
+ font-variant-numeric:tabular-nums}
+.viz-tooltip{position:absolute;display:none;pointer-events:none;
+ background:var(--surface-2);color:var(--text-primary);
+ border:1px solid var(--grid);border-radius:4px;
+ padding:0.25rem 0.55rem;font-size:0.85rem;z-index:10}
+.viz-tooltip-value{font-weight:700}
+.viz-tooltip-label{color:var(--text-secondary)}
 </style></head><body>
 <div id="sidebar">
   <h1>Kubeflow TPU</h1>

@@ -7,6 +7,8 @@ KubeClient; the spawner config gains TPU shapes (a notebook can request a
 single-host slice topology the way the reference's spawner offered GPUs).
 
 Routes:
+  GET    /                                  (spawner SPA shell)
+  GET    /app.js                            (static/jupyter.js)
   GET    /api/config
   GET    /api/namespaces/{ns}/notebooks
   POST   /api/namespaces/{ns}/notebooks
@@ -18,12 +20,13 @@ Routes:
 
 from __future__ import annotations
 
+import os
 
 from ..api import k8s
 from ..cluster.client import AlreadyExistsError, KubeClient, NotFoundError
 from ..controllers.notebook import (NOTEBOOK_API_VERSION, NOTEBOOK_KIND,
                                     TPU_RESOURCE)
-from ._http import ApiError, JsonApp, JsonServer
+from ._http import ApiError, JsonApp, JsonServer, RawResponse
 
 DEFAULT_IMAGES = [
     "ghcr.io/kubeflow-tpu/notebook-jax:latest",
@@ -128,12 +131,89 @@ def build_pvc_manifest(namespace: str, body: dict) -> dict:
     }
 
 
-def build_jupyter_app(client: KubeClient) -> JsonApp:
-    app = JsonApp()
+# The spawner SPA shell (the reference jupyter-web-app's spawner UI,
+# kubeflow_jupyter/default/static — new-notebook form + notebook/volume
+# tables; rendering lives in static/jupyter.js, no build infra).
+INDEX_HTML = """<!doctype html>
+<html><head><title>Notebooks — Kubeflow TPU</title><meta charset="utf-8">
+<style>
+body{font-family:sans-serif;margin:1.5rem auto;max-width:62rem;
+ color:#202124}
+h1{font-size:1.4rem}h2{font-size:1.1rem;margin-top:1.8rem}
+fieldset{border:1px solid #dadce0;border-radius:6px;margin:0 0 1rem;
+ padding:0.8rem 1rem}
+legend{font-weight:600;padding:0 0.4rem}
+.grid{display:grid;grid-template-columns:11rem 1fr;gap:0.55rem;
+ align-items:center}
+input,select{padding:0.4rem;border:1px solid #dadce0;border-radius:4px}
+button{padding:0.45rem 1rem;border:0;border-radius:4px;
+ background:#1a73e8;color:#fff;cursor:pointer}
+button.minor{background:#e8eaed;color:#202124}
+button:disabled{opacity:0.5}
+table{border-collapse:collapse;width:100%;margin:0.5rem 0}
+td,th{border:1px solid #dadce0;padding:0.35rem 0.7rem;text-align:left}
+.status-Running{color:#188038;font-weight:600}
+.status-Waiting{color:#e8710a}
+#message{min-height:1.4rem}.error{color:#b00020}.ok{color:#188038}
+.empty{color:#777}
+.volrow{display:flex;gap:0.5rem;margin:0.3rem 0}
+</style></head><body>
+<h1>Notebook Servers</h1>
+<div class="grid" style="max-width:28rem">
+  <label for="ns">namespace</label><input id="ns" value="kubeflow">
+</div>
+<div id="message"></div>
+<form id="spawn-form">
+<fieldset><legend>New notebook server</legend>
+  <div class="grid">
+    <label>name</label><input name="name" required
+      pattern="[a-z0-9][a-z0-9-]*">
+    <label>image</label><select name="image"></select>
+    <label>custom image</label><input name="customImage"
+      placeholder="(overrides the image list)">
+    <label>CPU</label><input name="cpu" value="1">
+    <label>memory</label><input name="memory" value="2Gi">
+    <label>TPU shape</label><select name="tpu"></select>
+    <label>workspace volume</label><select name="wsMode">
+      <option value="create">create new</option>
+      <option value="existing">use existing PVC</option>
+      <option value="none">none</option></select>
+    <label>workspace size</label><input name="wsSize" value="10Gi">
+  </div>
+  <div id="data-volumes"></div>
+  <p>
+    <button type="button" class="minor" id="add-volume">+ data volume
+    </button>
+    <button type="submit">Spawn</button>
+  </p>
+</fieldset>
+</form>
+<h2>Notebooks</h2><div id="notebooks"></div>
+<h2>Workspace volumes</h2><div id="pvcs"></div>
+<script src="app.js"></script>
+</body></html>"""
+
+_STATIC_DIR = os.path.join(os.path.dirname(__file__), "static")
+
+
+def build_jupyter_app(client: KubeClient, prefix: str = "") -> JsonApp:
+    app = JsonApp(prefix=prefix)
 
     @app.route("GET", "/healthz")
     def healthz(params, query, body):
         return 200, {"ok": True}
+
+    @app.route("GET", "/")
+    def index(params, query, body):
+        return 200, RawResponse(INDEX_HTML,
+                                content_type="text/html; charset=utf-8")
+
+    @app.route("GET", "/app.js")
+    def app_js(params, query, body):
+        with open(os.path.join(_STATIC_DIR, "jupyter.js")) as f:
+            return 200, RawResponse(
+                f.read(),
+                content_type="application/javascript; charset=utf-8")
 
     @app.route("GET", "/api/config")
     def config(params, query, body):
@@ -204,6 +284,6 @@ def build_jupyter_app(client: KubeClient) -> JsonApp:
 
 
 class JupyterWebApp(JsonServer):
-    def __init__(self, client: KubeClient, **kw):
-        super().__init__(build_jupyter_app(client), name="jupyter-web-app",
-                         **kw)
+    def __init__(self, client: KubeClient, prefix: str = "", **kw):
+        super().__init__(build_jupyter_app(client, prefix=prefix),
+                         name="jupyter-web-app", **kw)

@@ -3,11 +3,18 @@
  * The Polymer main-page.js / namespace-selector.js analog rendered
  * client-side from the dashboard JSON API (webapps/dashboard.py), with:
  *  - namespace selector (persisted in localStorage) driving activities
- *  - activities, cluster metrics, TPU slice inventory panels
- *  - hash routing (#/overview, #/activities, #/notebooks)
- *  - the notebooks view iframes the jupyter web app, the reference's
- *    iframe-embedding pattern (main-page.js)
+ *  - overview stat tiles + TPU slice inventory
+ *  - cluster metrics as single-hue SVG bar charts with per-mark hover
+ *    tooltips and a table toggle (the resource-chart.js analog)
+ *  - runs panel with status badges (icon + label, never color alone)
+ *  - hash routing (#/overview, #/runs, #/activities, #/metrics,
+ *    #/notebooks); the notebooks view iframes the jupyter web app, the
+ *    reference's iframe-embedding pattern (main-page.js)
  *  - every API 401 redirects to the gatekeeper login page
+ *
+ * All data-driven DOM is built with textContent (API values are
+ * untrusted); colors live in CSS custom properties set per color-scheme
+ * in the page shell.
  */
 (function () {
   "use strict";
@@ -15,13 +22,6 @@
   const LOGIN_PATH = "/login";
   const JUPYTER_PATH = "/jupyter/";
   const NS_KEY = "kftpu.namespace";
-
-  function esc(v) {
-    return String(v).replace(/[&<>"']/g, (ch) => ({
-      "&": "&amp;", "<": "&lt;", ">": "&gt;",
-      '"': "&quot;", "'": "&#39;",
-    }[ch]));
-  }
 
   async function api(path) {
     const resp = await fetch(path, { credentials: "same-origin" });
@@ -34,13 +34,201 @@
     return resp.json();
   }
 
-  function table(rows, cols) {
-    const head = "<tr>" + cols.map((c) => `<th>${esc(c)}</th>`).join("") +
-      "</tr>";
-    const body = rows.map((r) =>
-      "<tr>" + cols.map((c) => `<td>${esc(r[c] ?? "")}</td>`).join("") +
-      "</tr>").join("");
-    return `<table>${head}${body}</table>`;
+  // -- DOM helpers (textContent only: API strings are untrusted) -------------
+
+  function el(tag, attrs, children) {
+    const node = tag === "svg" || tag === "rect" || tag === "line" ||
+      tag === "text" || tag === "g"
+      ? document.createElementNS("http://www.w3.org/2000/svg", tag)
+      : document.createElement(tag);
+    Object.entries(attrs || {}).forEach(([k, v]) => {
+      if (k === "text") node.textContent = v;
+      else if (k.startsWith("on")) node[k] = v;
+      else node.setAttribute(k, v);
+    });
+    (children || []).forEach((c) => node.appendChild(c));
+    return node;
+  }
+
+  function table(rows, cols, renderCell) {
+    const t = el("table");
+    t.appendChild(el("tr", {}, cols.map((c) => el("th", { text: c }))));
+    rows.forEach((r) => {
+      t.appendChild(el("tr", {}, cols.map((c) => {
+        const td = el("td");
+        if (renderCell && renderCell(c, r, td)) return td;
+        td.textContent = r[c] ?? "";
+        return td;
+      })));
+    });
+    return t;
+  }
+
+  // -- status badges (fixed status palette; icon + label, never color
+  //    alone) ----------------------------------------------------------------
+
+  const PHASE_STATUS = {
+    Succeeded: ["good", "✓"],      // ✓
+    Running: ["running", "▶"],     // ▶
+    Created: ["running", "▶"],
+    Failed: ["critical", "✗"],     // ✗
+    Error: ["critical", "✗"],
+    Pending: ["warning", "⏳"],     // ⏳
+  };
+
+  function statusBadge(phase) {
+    const [cls, icon] = PHASE_STATUS[phase] || ["neutral", "•"];
+    return el("span", { class: `badge badge-${cls}` }, [
+      el("span", { class: "badge-icon", text: icon, "aria-hidden": "true" }),
+      el("span", { text: " " + phase }),
+    ]);
+  }
+
+  // -- stat tiles ------------------------------------------------------------
+
+  function compact(n) {
+    if (n >= 1e6) return (n / 1e6).toFixed(1) + "M";
+    if (n >= 1e4) return (n / 1e3).toFixed(1) + "K";
+    return String(n);
+  }
+
+  function statTile(label, value) {
+    return el("div", { class: "tile" }, [
+      el("div", { class: "tile-label", text: label }),
+      el("div", { class: "tile-value", text: compact(value) }),
+    ]);
+  }
+
+  // -- bar chart (single series, one hue; marks-and-anatomy specs) -----------
+
+  let tooltip = null;
+
+  function showTooltip(evt, label, value) {
+    if (!tooltip) {
+      tooltip = el("div", { class: "viz-tooltip", role: "status" });
+      document.body.appendChild(tooltip);
+    }
+    tooltip.replaceChildren(
+      el("span", { class: "viz-tooltip-value", text: String(value) }),
+      el("span", { class: "viz-tooltip-label", text: " " + label }));
+    tooltip.style.display = "block";
+    const pad = 12;
+    tooltip.style.left = `${evt.pageX + pad}px`;
+    tooltip.style.top = `${evt.pageY + pad}px`;
+  }
+
+  function hideTooltip() {
+    if (tooltip) tooltip.style.display = "none";
+  }
+
+  function barChart(rows, { labelKey, valueKey, maxBars = 20, unit = "" }) {
+    // magnitude → horizontal bars, sorted desc; overflow folds to "Other"
+    const sorted = rows.slice().sort((a, b) => b[valueKey] - a[valueKey]);
+    const shown = sorted.slice(0, maxBars);
+    const rest = sorted.slice(maxBars);
+    if (rest.length) {
+      shown.push({
+        [labelKey]: `Other (${rest.length})`,
+        [valueKey]: rest.reduce((s, r) => s + (r[valueKey] || 0), 0),
+      });
+    }
+    const barH = 18, gap = 8, labelW = 180, valueW = 56;
+    const plotW = 420;
+    const width = labelW + plotW + valueW;
+    const height = shown.length * (barH + gap) + 24;
+    const max = Math.max(...shown.map((r) => r[valueKey]), 1e-9);
+    const svg = el("svg", {
+      viewBox: `0 0 ${width} ${height}`, width: "100%",
+      style: `max-width:${width}px`, role: "img",
+      "aria-label": "bar chart",
+    });
+    // recessive hairline gridlines at 0/25/50/75/100%
+    for (let i = 0; i <= 4; i++) {
+      const x = labelW + (plotW * i) / 4;
+      svg.appendChild(el("line", {
+        x1: x, y1: 0, x2: x, y2: height - 20, class: "viz-grid",
+      }));
+      svg.appendChild(el("text", {
+        x, y: height - 6, class: "viz-tick", "text-anchor": "middle",
+        text: compact((max * i) / 4),
+      }));
+    }
+    shown.forEach((r, i) => {
+      const y = i * (barH + gap);
+      const w = Math.max((r[valueKey] / max) * plotW, r[valueKey] > 0 ? 2 : 0);
+      const label = String(r[labelKey]);
+      const value = r[valueKey];
+      svg.appendChild(el("text", {
+        x: labelW - 8, y: y + barH - 5, class: "viz-label",
+        "text-anchor": "end",
+        text: label.length > 26 ? label.slice(0, 25) + "…" : label,
+      }));
+      // 4px rounded data-end, square baseline: round rect clipped at the
+      // baseline by a square patch
+      const bar = el("rect", {
+        x: labelW, y, width: w, height: barH, rx: 4, class: "viz-bar",
+      });
+      const patch = w > 8 ? el("rect", {
+        x: labelW, y, width: Math.min(4, w / 2), height: barH,
+        class: "viz-bar", "aria-hidden": "true",
+      }) : null;
+      if (patch) svg.appendChild(patch);
+      svg.appendChild(bar);
+      svg.appendChild(el("text", {
+        x: labelW + w + 6, y: y + barH - 5, class: "viz-value",
+        text: compact(Math.round(value * 100) / 100) + unit,
+      }));
+      // hit target bigger than the mark: a transparent full-row rect
+      // carries pointer AND keyboard focus; the mark lifts via a class
+      // toggled here (the hit rect sits on top, so CSS :hover on the
+      // bar itself would never fire)
+      const lift = (on) => {
+        bar.classList.toggle("hover", on);
+        if (patch) patch.classList.toggle("hover", on);
+      };
+      const hit = el("rect", {
+        x: 0, y: y - gap / 2, width, height: barH + gap,
+        fill: "transparent", tabindex: "0",
+        onpointermove: (evt) => {
+          lift(true);
+          showTooltip(evt, label, value + unit);
+        },
+        onpointerleave: () => { lift(false); hideTooltip(); },
+        onfocus: (evt) => {
+          lift(true);
+          const b = evt.target.getBoundingClientRect();
+          showTooltip({ pageX: b.left + scrollX, pageY: b.top + scrollY },
+            label, value + unit);
+        },
+        onblur: () => { lift(false); hideTooltip(); },
+      });
+      svg.appendChild(hit);
+    });
+    return svg;
+  }
+
+  function chartWithTable(rows, opts, cols) {
+    const wrap = el("div", { class: "viz-root" });
+    if (!rows.length) {
+      wrap.appendChild(el("p", { class: "empty", text: "No data." }));
+      return wrap;
+    }
+    const chart = barChart(rows, opts);
+    const tbl = table(rows, cols);
+    tbl.style.display = "none";
+    const toggle = el("button", {
+      class: "minor", text: "table view",
+      onclick: () => {
+        const showTable = tbl.style.display === "none";
+        tbl.style.display = showTable ? "" : "none";
+        chart.style.display = showTable ? "none" : "";
+        toggle.textContent = showTable ? "chart view" : "table view";
+      },
+    });
+    wrap.appendChild(toggle);
+    wrap.appendChild(chart);
+    wrap.appendChild(tbl);
+    return wrap;
   }
 
   // -- namespace selector ----------------------------------------------------
@@ -49,9 +237,11 @@
     const namespaces = await api("api/namespaces");
     const current = localStorage.getItem(NS_KEY) || namespaces[0] || "default";
     const sel = document.getElementById("ns-selector");
-    sel.innerHTML = namespaces.map((n) =>
-      `<option value="${esc(n)}"${n === current ? " selected" : ""}>` +
-      `${esc(n)}</option>`).join("");
+    sel.replaceChildren(...namespaces.map((n) => {
+      const o = el("option", { value: n, text: n });
+      if (n === current) o.selected = true;
+      return o;
+    }));
     sel.onchange = () => {
       localStorage.setItem(NS_KEY, sel.value);
       render();  // re-render the active view in the new namespace
@@ -66,54 +256,100 @@
 
   // -- views -----------------------------------------------------------------
 
-  async function viewOverview(el) {
-    const [slices, nodes] = await Promise.all([
+  async function viewOverview(root) {
+    const [slices, nodes, runs] = await Promise.all([
       api("api/tpu/slices"), api("api/metrics/node"),
+      api(`api/runs/${encodeURIComponent(selectedNamespace())}`),
     ]);
-    el.innerHTML =
-      "<h2>TPU slices</h2>" +
-      (slices.length
-        ? table(slices, ["topology", "accelerator", "hosts", "chips", "ready"])
-        : "<p class=empty>No TPU slices in this cluster.</p>") +
-      "<h2>Nodes</h2>" + table(nodes, ["node", "value"]);
+    const chips = slices.reduce((s, p) => s + p.chips, 0);
+    const hosts = slices.reduce((s, p) => s + p.hosts, 0);
+    const active = runs.filter((r) =>
+      r.phase === "Running" || r.phase === "Created").length;
+    root.replaceChildren(
+      el("div", { class: "tiles" }, [
+        statTile("TPU chips", chips),
+        statTile("TPU hosts", hosts),
+        statTile("Slice pools", slices.length),
+        statTile("Cluster nodes", nodes.length),
+        statTile("Active runs", active),
+      ]),
+      el("h2", { text: "TPU slices" }),
+      slices.length
+        ? table(slices, ["topology", "accelerator", "hosts", "chips",
+                         "ready"])
+        : el("p", { class: "empty",
+                    text: "No TPU slices in this cluster." }),
+      el("h2", { text: "Pods per node" }),
+      chartWithTable(nodes, { labelKey: "node", valueKey: "value" },
+        ["node", "value"]));
   }
 
-  async function viewActivities(el) {
+  async function viewActivities(root) {
     const ns = selectedNamespace();
     const acts = await api(`api/activities/${encodeURIComponent(ns)}`);
-    el.innerHTML = `<h2>Activities in ${esc(ns)}</h2>` +
-      (acts.length
+    root.replaceChildren(
+      el("h2", { text: `Activities in ${ns}` }),
+      acts.length
         ? table(acts, ["type", "reason", "involvedObject", "message",
                        "lastTimestamp"])
-        : "<p class=empty>No recent events.</p>");
+        : el("p", { class: "empty", text: "No recent events." }));
   }
 
-  async function viewMetrics(el) {
+  const METRIC_TABS = [
+    ["podcpu", "CPU requests per pod", "podcpu"],
+    ["podmem", "Memory requests per pod", "podmem"],
+    ["node", "Pods per node", "node"],
+  ];
+
+  async function viewMetrics(root) {
     const kind = (location.hash.split("/")[2]) || "podcpu";
     const rows = await api(`api/metrics/${encodeURIComponent(kind)}`);
-    const tabs = ["podcpu", "podmem", "node"].map((k) =>
-      `<a href="#/metrics/${k}"${k === kind ? ' class="active"' : ""}>` +
-      `${k}</a>`).join(" ");
+    const tabs = el("nav", { class: "tabs" }, METRIC_TABS.map(([k]) =>
+      el("a", {
+        href: `#/metrics/${k}`, text: k,
+        class: k === kind ? "active" : "",
+      })));
+    const title = (METRIC_TABS.find(([k]) => k === kind) || [])[1] || kind;
+    const labelKey = kind === "node" ? "node" : "pod";
     const cols = kind === "node" ? ["node", "value"]
       : ["namespace", "pod", "value"];
-    el.innerHTML = `<h2>Cluster metrics</h2><nav class=tabs>${tabs}</nav>` +
-      table(rows, cols);
+    root.replaceChildren(
+      el("h2", { text: title }), tabs,
+      chartWithTable(rows, { labelKey, valueKey: "value" }, cols));
   }
 
-  async function viewRuns(el) {
+  async function viewRuns(root) {
     const ns = selectedNamespace();
     const runs = await api(`api/runs/${encodeURIComponent(ns)}`);
-    el.innerHTML = `<h2>Runs in ${esc(ns)}</h2>` +
-      (runs.length
-        ? table(runs, ["kind", "name", "phase", "progress", "finishedAt"])
-        : "<p class=empty>No training jobs or workflow runs.</p>");
+    const phases = ["all", ...new Set(runs.map((r) => r.phase))];
+    const current = (location.hash.split("/")[2]) || "all";
+    const filter = el("nav", { class: "tabs" }, phases.map((p) =>
+      el("a", {
+        href: `#/runs/${p}`, text: p,
+        class: p === current ? "active" : "",
+      })));
+    const visible = current === "all" ? runs
+      : runs.filter((r) => r.phase === current);
+    root.replaceChildren(
+      el("h2", { text: `Runs in ${ns}` }), filter,
+      visible.length
+        ? table(visible, ["kind", "name", "phase", "progress", "finishedAt"],
+            (col, row, td) => {
+              if (col !== "phase") return false;
+              td.appendChild(statusBadge(row.phase));
+              return true;
+            })
+        : el("p", { class: "empty",
+                    text: "No training jobs or workflow runs." }));
   }
 
-  function viewNotebooks(el) {
+  function viewNotebooks(root) {
     // iframe-embedding, the reference dashboard's integration pattern
-    el.innerHTML = "<h2>Notebooks</h2>" +
-      `<iframe id="jupyter-frame" src="${JUPYTER_PATH}" ` +
-      'style="width:100%;height:70vh;border:1px solid #ccc"></iframe>';
+    const frame = el("iframe", {
+      id: "jupyter-frame", src: JUPYTER_PATH,
+      style: "width:100%;height:70vh;border:1px solid #ccc",
+    });
+    root.replaceChildren(el("h2", { text: "Notebooks" }), frame);
   }
 
   const VIEWS = {
@@ -130,17 +366,18 @@
   }
 
   async function render() {
+    hideTooltip();
     const name = activeView();
     document.querySelectorAll("#sidebar a").forEach((a) => {
       a.classList.toggle("active", a.dataset.view === name);
     });
-    const el = document.getElementById("view");
-    el.innerHTML = "<p class=empty>Loading…</p>";
+    const root = document.getElementById("view");
+    root.replaceChildren(el("p", { class: "empty", text: "Loading…" }));
     try {
-      await VIEWS[name](el);
+      await VIEWS[name](root);
     } catch (err) {
       if (err.message !== "unauthenticated") {
-        el.innerHTML = `<p class=error>${esc(err.message)}</p>`;
+        root.replaceChildren(el("p", { class: "error", text: err.message }));
       }
     }
   }

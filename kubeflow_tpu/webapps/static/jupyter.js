@@ -1,0 +1,189 @@
+/* Jupyter spawner SPA (the reference jupyter-web-app's spawner UI,
+ * components/jupyter-web-app/kubeflow_jupyter/default/static — form +
+ * notebook/volume tables over the JSON API in webapps/jupyter.py):
+ *  - spawner form fed from /api/config (images, TPU slice shapes)
+ *  - workspace volume modes (create / existing PVC / none) and dynamic
+ *    data-volume rows, the reference's volume editor
+ *  - notebook table with status, connect link, delete
+ *  - PVC table; every API 401 bounces to the gatekeeper login
+ */
+(function () {
+  "use strict";
+
+  const LOGIN_PATH = "/login";
+
+  function esc(v) {
+    return String(v).replace(/[&<>"']/g, (ch) => ({
+      "&": "&amp;", "<": "&lt;", ">": "&gt;",
+      '"': "&quot;", "'": "&#39;",
+    }[ch]));
+  }
+
+  async function api(path, opts) {
+    const resp = await fetch(path, Object.assign(
+      { credentials: "same-origin" }, opts));
+    if (resp.status === 401) {
+      window.location.assign(LOGIN_PATH);
+      throw new Error("unauthenticated");
+    }
+    let body = {};
+    try { body = await resp.json(); } catch (e) { /* non-JSON error */ }
+    if (!resp.ok) throw new Error(body.error || `${path}: HTTP ${resp.status}`);
+    return body;
+  }
+
+  const post = (path, payload) => api(path, {
+    method: "POST",
+    headers: { "Content-Type": "application/json" },
+    body: JSON.stringify(payload),
+  });
+
+  function message(text, cls) {
+    document.getElementById("message").innerHTML =
+      text ? `<span class="${cls || ""}">${esc(text)}</span>` : "";
+  }
+
+  function namespace() {
+    return document.getElementById("ns").value.trim() || "kubeflow";
+  }
+
+  // -- config-driven selects -------------------------------------------------
+
+  async function loadConfig() {
+    const cfg = await api("api/config");
+    const imageSel = document.querySelector("select[name=image]");
+    imageSel.innerHTML = cfg.images.map((i) =>
+      `<option value="${esc(i)}">${esc(i)}</option>`).join("");
+    const tpuSel = document.querySelector("select[name=tpu]");
+    tpuSel.innerHTML = cfg.tpuShapes.map((s) =>
+      `<option value="${esc(s)}">${esc(s || "none")}</option>`).join("");
+    const wsSize = document.querySelector("input[name=wsSize]");
+    if (cfg.defaultWorkspaceSize) wsSize.value = cfg.defaultWorkspaceSize;
+  }
+
+  // -- dynamic data-volume rows ----------------------------------------------
+
+  let volSeq = 0;
+
+  function addVolumeRow() {
+    const row = document.createElement("div");
+    row.className = "volrow";
+    const id = volSeq++;
+    row.innerHTML =
+      `<input placeholder="pvc name" data-vol="name-${id}">` +
+      `<input placeholder="/data/${id}" data-vol="path-${id}">` +
+      '<button type="button" class="minor">remove</button>';
+    row.querySelector("button").onclick = () => row.remove();
+    document.getElementById("data-volumes").appendChild(row);
+  }
+
+  function collectDataVolumes() {
+    return Array.from(
+      document.querySelectorAll("#data-volumes .volrow")).map((row) => {
+      const inputs = row.querySelectorAll("input");
+      return { name: inputs[0].value.trim(), path: inputs[1].value.trim() };
+    }).filter((v) => v.name);
+  }
+
+  // -- tables ----------------------------------------------------------------
+
+  async function refreshNotebooks() {
+    const ns = namespace();
+    const data = await api(`api/namespaces/${encodeURIComponent(ns)}/notebooks`);
+    const el = document.getElementById("notebooks");
+    if (!data.notebooks.length) {
+      el.innerHTML = "<p class=empty>No notebook servers yet.</p>";
+      return;
+    }
+    el.innerHTML = "<table><tr><th>name</th><th>image</th><th>CPU</th>" +
+      "<th>memory</th><th>TPU</th><th>status</th><th></th></tr>" +
+      data.notebooks.map((nb) =>
+        `<tr><td>${esc(nb.name)}</td><td>${esc(nb.image)}</td>` +
+        `<td>${esc(nb.cpu)}</td><td>${esc(nb.memory)}</td>` +
+        `<td>${esc(nb.tpu || "")}</td>` +
+        `<td class="status-${esc(nb.status)}">${esc(nb.status)}</td>` +
+        `<td><a href="/notebook/${encodeURIComponent(nb.namespace)}/` +
+        `${encodeURIComponent(nb.name)}/">connect</a> ` +
+        `<button class="minor" data-delete="${esc(nb.name)}">delete` +
+        "</button></td></tr>").join("") + "</table>";
+    el.querySelectorAll("button[data-delete]").forEach((b) => {
+      b.onclick = async () => {
+        b.disabled = true;
+        try {
+          await api(`api/namespaces/${encodeURIComponent(ns)}/notebooks/` +
+            encodeURIComponent(b.dataset.delete), { method: "DELETE" });
+          message(`deleted ${b.dataset.delete}`, "ok");
+        } catch (err) {
+          message(err.message, "error");
+        }
+        refreshNotebooks();
+      };
+    });
+  }
+
+  async function refreshPvcs() {
+    const ns = namespace();
+    const data = await api(`api/namespaces/${encodeURIComponent(ns)}/pvcs`);
+    document.getElementById("pvcs").innerHTML = data.pvcs.length
+      ? "<table><tr><th>name</th><th>size</th><th>mode</th></tr>" +
+        data.pvcs.map((p) =>
+          `<tr><td>${esc(p.name)}</td><td>${esc(p.size)}</td>` +
+          `<td>${esc(p.mode)}</td></tr>`).join("") + "</table>"
+      : "<p class=empty>No volumes in this namespace.</p>";
+  }
+
+  const refresh = () => Promise.all([refreshNotebooks(), refreshPvcs()])
+    .catch((err) => {
+      if (err.message !== "unauthenticated") message(err.message, "error");
+    });
+
+  // -- spawn -----------------------------------------------------------------
+
+  async function spawn(ev) {
+    ev.preventDefault();
+    const form = ev.target;
+    const payload = {
+      name: form.name.value.trim(),
+      image: form.customImage.value.trim() || form.image.value,
+      cpu: form.cpu.value.trim(),
+      memory: form.memory.value.trim(),
+      tpu: form.tpu.value,
+      dataVolumes: collectDataVolumes(),
+    };
+    const wsMode = form.wsMode.value;
+    if (wsMode !== "none") {
+      payload.workspaceVolume = {
+        size: form.wsSize.value.trim() || "10Gi",
+        create: wsMode === "create",
+      };
+    }
+    const button = form.querySelector("button[type=submit]");
+    button.disabled = true;
+    message(`spawning ${payload.name}…`);
+    try {
+      const out = await post(
+        `api/namespaces/${encodeURIComponent(namespace())}/notebooks`,
+        payload);
+      message(`notebook ${out.notebook.name} created`, "ok");
+      form.name.value = "";
+    } catch (err) {
+      if (err.message !== "unauthenticated") message(err.message, "error");
+    } finally {
+      button.disabled = false;
+      refresh();
+    }
+  }
+
+  function main() {
+    document.getElementById("spawn-form").addEventListener("submit", spawn);
+    document.getElementById("add-volume").onclick = addVolumeRow;
+    document.getElementById("ns").addEventListener("change", refresh);
+    loadConfig().then(refresh).catch((err) => {
+      if (err.message !== "unauthenticated") message(err.message, "error");
+    });
+  }
+
+  document.readyState === "loading"
+    ? document.addEventListener("DOMContentLoaded", main)
+    : main();
+})();

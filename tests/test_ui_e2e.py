@@ -1,0 +1,176 @@
+"""Browser-flow E2E over the real wire: one auth ingress fronting the
+central dashboard AND the jupyter web app, exercised exactly as the SPA
+does it — 302 to login, cookie login, dashboard shell + bundle, notebook
+spawn through /jupyter/, runs panel showing the cluster's training job.
+
+The reference covers this surface only piecemeal (kflogin e2e, dashboard
+api_test.ts, jupyter-web-app unit tests); here the whole chain is one
+test so a route/prefix/auth regression in any hop fails loudly.
+"""
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.cluster import FakeCluster
+from kubeflow_tpu.controllers.runtime import Manager
+from kubeflow_tpu.controllers.tpujob import TrainingJobReconciler
+from kubeflow_tpu.webapps.dashboard import DashboardServer
+from kubeflow_tpu.webapps.gatekeeper import Gatekeeper, GatekeeperServer
+from kubeflow_tpu.webapps.ingress import (AuthIngress, ExtAuthzVerifier,
+                                          Route)
+from kubeflow_tpu.webapps.jupyter import JupyterWebApp
+
+
+class _NoRedirect(urllib.request.HTTPErrorProcessor):
+    def http_response(self, request, response):
+        return response
+
+
+_OPENER = urllib.request.build_opener(_NoRedirect)
+
+
+def fetch(url, cookie=None, data=None, method=None):
+    req = urllib.request.Request(url, data=data, method=method)
+    if cookie:
+        req.add_header("Cookie", cookie)
+    if data is not None and not method:
+        req.add_header("Content-Type", "application/json")
+    with _OPENER.open(req, timeout=10) as resp:
+        return resp.status, resp.read(), dict(resp.headers)
+
+
+@pytest.fixture
+def stack():
+    """cluster + dashboard + jupyter + gatekeeper behind ONE ingress."""
+    cluster = FakeCluster()
+    cluster.add_tpu_slice_nodes("v5e-8")
+    cluster.create({"apiVersion": "v1", "kind": "Namespace",
+                    "metadata": {"name": "kubeflow"}})
+    mgr = Manager(cluster)
+    mgr.add(TrainingJobReconciler("TPUJob"))
+    servers = []
+
+    def up(s):
+        s.start()
+        servers.append(s)
+        return s
+
+    dash = up(DashboardServer(cluster))
+    jupyter = up(JupyterWebApp(cluster, prefix="jupyter"))
+    gate = up(GatekeeperServer(Gatekeeper(username="admin", password="pw")))
+    ingress = up(AuthIngress(
+        ExtAuthzVerifier(auth_url=f"http://127.0.0.1:{gate.port}/auth",
+                         login_path="/login"),
+        routes=[Route("/", f"127.0.0.1:{dash.port}"),
+                Route("/jupyter/", f"127.0.0.1:{jupyter.port}"),
+                Route("/login", f"127.0.0.1:{gate.port}"),
+                Route("/logout", f"127.0.0.1:{gate.port}")],
+        public_prefixes=("/login", "/logout")))
+    base = f"http://127.0.0.1:{ingress.port}"
+    yield cluster, mgr, base
+    for s in reversed(servers):
+        s.stop()
+
+
+def test_login_dashboard_spawn_runs_flow(stack):
+    cluster, mgr, base = stack
+
+    # 1. unauthenticated dashboard → 302 to login with the rd param
+    status, _, headers = fetch(f"{base}/")
+    assert status == 302
+    assert headers["Location"] == "/login?rd=%2F"
+
+    # 2. the login page serves THROUGH the ingress; the form POST sets
+    # the session cookie and 303s back to the destination
+    status, page, _ = fetch(f"{base}/login?rd=%2F")
+    assert status == 200 and b"password" in page
+    status, _, headers = fetch(
+        f"{base}/login", data=b"username=admin&password=pw&rd=%2F",
+        method="POST")
+    assert status == 303 and headers["Location"] == "/"
+    cookie = headers["Set-Cookie"].split(";")[0]
+
+    # 3. dashboard shell + SPA bundle load with the cookie
+    status, page, _ = fetch(f"{base}/", cookie)
+    assert status == 200 and b'script src="app.js"' in page
+    status, bundle, _ = fetch(f"{base}/app.js", cookie)
+    assert status == 200 and b"viewRuns" in bundle
+
+    # 4. the notebooks view iframes /jupyter/ — spawner shell + bundle
+    # resolve through the ingress prefix
+    status, page, _ = fetch(f"{base}/jupyter/", cookie)
+    assert status == 200 and b"spawn-form" in page
+    status, bundle, _ = fetch(f"{base}/jupyter/app.js", cookie)
+    assert status == 200 and b"workspaceVolume" in bundle
+
+    # 5. spawn a TPU notebook exactly as the form does; the Notebook CR
+    # and its workspace PVC land in the cluster
+    payload = json.dumps({
+        "name": "bench-nb", "cpu": "2", "memory": "4Gi",
+        "tpu": "2x2 (4 chips)",
+        "workspaceVolume": {"size": "10Gi", "create": True},
+    }).encode()
+    status, body, _ = fetch(
+        f"{base}/jupyter/api/namespaces/kubeflow/notebooks", cookie,
+        data=payload)
+    assert status == 200
+    assert json.loads(body)["notebook"]["name"] == "bench-nb"
+    nb = cluster.get("kubeflow.org/v1alpha1", "Notebook", "kubeflow",
+                     "bench-nb")
+    limits = nb["spec"]["template"]["spec"]["containers"][0][
+        "resources"]["limits"]
+    assert limits["google.com/tpu"] == 4
+    cluster.get("v1", "PersistentVolumeClaim", "kubeflow",
+                "workspace-bench-nb")
+
+    # the spawner list shows it
+    status, body, _ = fetch(
+        f"{base}/jupyter/api/namespaces/kubeflow/notebooks", cookie)
+    assert [n["name"] for n in json.loads(body)["notebooks"]] == ["bench-nb"]
+
+    # 6. a training job reconciles and appears in the runs panel
+    cluster.create({
+        "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": "train", "namespace": "kubeflow"},
+        "spec": {"replicaSpecs": {"TPU": {
+            "tpuTopology": "v5e-8",
+            "template": {"spec": {"containers": [
+                {"name": "jax", "image": "t:v1"}]}}}}},
+    })
+    for _ in range(4):
+        mgr.run_pending()
+        cluster.tick()
+    mgr.run_pending()
+    status, body, _ = fetch(f"{base}/api/runs/kubeflow", cookie)
+    runs = {r["name"]: r for r in json.loads(body)}
+    assert runs["train"]["kind"] == "TPUJob"
+    assert runs["train"]["phase"] in ("Running", "Created")
+
+    # 7. overview data the stat tiles read
+    status, body, _ = fetch(f"{base}/api/tpu/slices", cookie)
+    slices = json.loads(body)
+    assert sum(p["chips"] for p in slices) == 8
+
+    # 8. logout revokes the session: the dashboard bounces to login again
+    fetch(f"{base}/logout", cookie)
+    status, _, headers = fetch(f"{base}/", cookie)
+    assert status == 302 and headers["Location"].startswith("/login")
+
+
+def test_jupyter_prefix_serves_bare_paths_too(stack):
+    # direct (non-ingress) access must keep working: the prefix is
+    # additive, not a rebase
+    cluster, _, base = stack
+    jupyter = JupyterWebApp(cluster, prefix="jupyter")
+    jupyter.start()
+    try:
+        d = f"http://127.0.0.1:{jupyter.port}"
+        for path in ("/api/config", "/jupyter/api/config"):
+            with urllib.request.urlopen(d + path, timeout=10) as r:
+                assert json.loads(r.read())["images"]
+    finally:
+        jupyter.stop()

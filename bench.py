@@ -31,29 +31,10 @@ import json
 import sys
 import time
 
-# First-light measurement on one TPU v5e chip (bf16, batch 256, synthetic
-# data, this repo @ milestone 3). Later rounds must beat it.
-BASELINE_IMG_S = 1000.0
-
-# ResNet-50 @224 fwd ≈ 4.09 GFLOP/image; fwd+bwd ≈ 3x fwd (dgrad + wgrad
-# each cost ~one fwd). Conventional MFU flop model (matmul/conv MACs only).
-TRAIN_GFLOP_PER_IMAGE = 3 * 4.09
-
-# bf16 peak TFLOP/s by device_kind substring (public spec sheets)
-PEAK_TFLOPS = {
-    "v5 lite": 197.0, "v5e": 197.0,
-    "v5p": 459.0, "v5": 459.0,          # 'v5' alone = v5p
-    "v4": 275.0, "v3": 123.0, "v2": 46.0,
-    "v6 lite": 918.0, "v6e": 918.0,
-}
-
-
-def detect_peak_tflops(device) -> float | None:
-    kind = getattr(device, "device_kind", "").lower()
-    for key in sorted(PEAK_TFLOPS, key=len, reverse=True):
-        if key in kind:
-            return PEAK_TFLOPS[key]
-    return None
+from kubeflow_tpu.utils.chips import (BASELINE_IMG_S,  # noqa: E402
+                                      RESNET50_TRAIN_GFLOP_PER_IMAGE
+                                      as TRAIN_GFLOP_PER_IMAGE,
+                                      detect_peak_tflops)
 
 
 def measure_achievable_tflops() -> float:

@@ -1,0 +1,97 @@
+"""Scaffold a new manifest component (the kubeflow/new-package-stub
+analog: README + newpackage.libsonnet + prototypes/newpackage.jsonnet,
+translated to this repo's builder-module shape).
+
+    python hack/new_component.py my-component --module mygroup
+
+writes kubeflow_tpu/manifests/<module>.py with a registered builder stub
+plus tests/test_<module>.py with a golden-shape test, and prints the two
+follow-ups the reference README gives (import it from manifests/__init__,
+add params).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+MODULE_TEMPLATE = '''"""{title} manifest package.
+
+Reference analog: kubeflow/new-package-stub (parts.yaml +
+prototypes/newpackage.jsonnet) — replace this docstring with the real
+package description and the reference file:line it mirrors.
+"""
+
+from __future__ import annotations
+
+from . import helpers as H
+from .registry import register
+
+VERSION = "v0.1.0"
+IMG = "ghcr.io/kubeflow-tpu"
+
+
+@register("{name}", "{title} (describe the component)")
+def {fn}(namespace: str = "kubeflow", replicas: int = 1) -> list[dict]:
+    """Build the component's manifests. Parameters become the
+    component's prototype params (surface them in docs/components)."""
+    dep = H.deployment("{name}", namespace,
+                       f"{{IMG}}/{name}:{{VERSION}}",
+                       replicas=replicas, port=8080)
+    svc = H.service("{name}", namespace, port=8080)
+    return [dep, svc]
+'''
+
+TEST_TEMPLATE = '''"""Golden-shape test for the {name} package (replace with
+behavior tests as the component grows)."""
+
+from kubeflow_tpu.manifests import build_component
+
+
+def test_{fn}_builds():
+    objs = build_component("{name}")
+    kinds = sorted(o["kind"] for o in objs)
+    assert kinds == ["Deployment", "Service"]
+    for o in objs:
+        assert o["metadata"]["namespace"] == "kubeflow"
+'''
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("name", help="component name, e.g. my-component")
+    p.add_argument("--module", default=None,
+                   help="manifests module filename (default: name with "
+                        "dashes → underscores)")
+    args = p.parse_args(argv)
+    if not re.fullmatch(r"[a-z0-9][a-z0-9-]*", args.name):
+        p.error("name must be lowercase-dashed")
+    module = args.module or args.name.replace("-", "_")
+    fn = args.name.replace("-", "_")
+    title = args.name.replace("-", " ").title()
+
+    mod_path = os.path.join(REPO, "kubeflow_tpu", "manifests",
+                            f"{module}.py")
+    test_path = os.path.join(REPO, "tests", f"test_{module}.py")
+    for path in (mod_path, test_path):
+        if os.path.exists(path):
+            print(f"refusing to overwrite {path}", file=sys.stderr)
+            return 1
+    with open(mod_path, "w") as f:
+        f.write(MODULE_TEMPLATE.format(name=args.name, fn=fn, title=title))
+    with open(test_path, "w") as f:
+        f.write(TEST_TEMPLATE.format(name=args.name, fn=fn))
+    print(f"wrote {mod_path}")
+    print(f"wrote {test_path}")
+    print("next: import the module from kubeflow_tpu/manifests/__init__.py "
+          "so the registry sees it, then run the test.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

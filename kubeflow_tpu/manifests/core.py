@@ -12,6 +12,8 @@ from . import helpers as H
 from .registry import register
 
 VERSION = "v0.1.0"
+# per-image pin the auto-update bot retags independently (image_update.py)
+CENTRALDASHBOARD_VERSION = "v0.1.0"
 IMG = "ghcr.io/kubeflow-tpu"
 
 
@@ -100,7 +102,7 @@ def centraldashboard(namespace: str = "kubeflow") -> list[dict]:
     binding = H.cluster_role_binding("centraldashboard", "centraldashboard",
                                      "centraldashboard", namespace)
     dep = H.deployment("centraldashboard", namespace,
-                       f"{IMG}/centraldashboard:{VERSION}", port=8082,
+                       f"{IMG}/centraldashboard:{CENTRALDASHBOARD_VERSION}", port=8082,
                        service_account="centraldashboard")
     svc = H.service("centraldashboard", namespace, 80, target_port=8082)
     vs = H.virtual_service("centraldashboard", namespace, "/", "centraldashboard", 80)

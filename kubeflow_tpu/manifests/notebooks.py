@@ -11,6 +11,9 @@ from . import helpers as H
 from .registry import register
 
 VERSION = "v0.1.0"
+# per-image pin the auto-update bot retags independently of the
+# module-wide VERSION (workflows/image_update.py)
+JUPYTER_WEB_APP_VERSION = "v0.1.0"
 IMG = "ghcr.io/kubeflow-tpu"
 
 # The CR wraps a full PodSpec (notebook_types.go:28-35 idiom — SURVEY §2.6).
@@ -77,7 +80,7 @@ def jupyter_web_app(namespace: str = "kubeflow", ui: str = "default",
         "default-tpu-topology": "v5e-1",
     })
     dep = H.deployment("jupyter-web-app", namespace,
-                       f"{IMG}/jupyter-web-app:{VERSION}", port=5000,
+                       f"{IMG}/jupyter-web-app:{JUPYTER_WEB_APP_VERSION}", port=5000,
                        service_account="jupyter-web-app",
                        env={"UI": ui, "URL_PREFIX": f"/{prefix}"})
     svc = H.service("jupyter-web-app", namespace, 80, target_port=5000)

@@ -15,6 +15,8 @@ from . import helpers as H
 from .registry import register
 
 VERSION = "v0.1.0"
+# per-image pin the auto-update bot retags independently (image_update.py)
+MODEL_SERVER_VERSION = "v0.1.0"
 IMG = "ghcr.io/kubeflow-tpu"
 
 
@@ -28,7 +30,7 @@ def tpu_serving(namespace: str = "kubeflow", name: str = "model-server",
                 reload_interval_s: int = 30) -> list[dict]:
     lbl = {**H.std_labels(name), "kubeflow.org/servable": model_name}
     dep = H.deployment(
-        name, namespace, f"{IMG}/tpu-model-server:{VERSION}",
+        name, namespace, f"{IMG}/tpu-model-server:{MODEL_SERVER_VERSION}",
         replicas=num_replicas,
         args=[f"--model-path={model_path}", f"--model-name={model_name}",
               "--grpc-port=9000", "--rest-port=8500",
@@ -55,7 +57,7 @@ def tpu_serving(namespace: str = "kubeflow", name: str = "model-server",
     if enable_http_proxy:
         pod_spec["containers"].append({
             "name": "http-proxy",
-            "image": f"{IMG}/serving-http-proxy:{VERSION}",
+            "image": f"{IMG}/serving-http-proxy:{MODEL_SERVER_VERSION}",
             "args": ["--port=8000", "--rpc_timeout=10.0"],
             "ports": [{"containerPort": 8000, "name": "http"}],
         })

@@ -13,6 +13,8 @@ from . import helpers as H
 from .registry import register
 
 VERSION = "v0.1.0"
+# per-image pin the auto-update bot retags independently (image_update.py)
+WORKER_VERSION = "v0.1.0"
 IMG = "ghcr.io/kubeflow-tpu"
 
 # Replica-count validation mirrored from the reference CRD schemas
@@ -165,7 +167,7 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
                 "tpuTopology": topology,
                 "template": {"spec": {"containers": [{
                     "name": "worker",
-                    "image": f"{IMG}/worker:{VERSION}",
+                    "image": f"{IMG}/worker:{WORKER_VERSION}",
                     "command": ["python", "-m", "kubeflow_tpu.runtime.worker",
                                 "--workload", "resnet50",
                                 "--steps", str(steps),

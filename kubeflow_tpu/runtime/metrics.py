@@ -121,10 +121,15 @@ class MetricsLogger:
             return {"steps": 0, "examples_per_sec": 0.0, "mean_step_time_s": 0.0}
         n = sum(s.window for s in steady)
         t = sum(s.step_time_s * s.window for s in steady)
+        first = self.history[0] if self.history else None
         return {
             "steps": sum(s.window for s in self.history),
             "mean_step_time_s": t / n if n else 0.0,
             "examples_per_sec": (self.batch_size * n / t) if t else 0.0,
+            # the first window carries compile + dispatch warmup — the
+            # startup cost a warm compile cache is meant to cut
+            "first_window_s": (first.step_time_s * first.window)
+            if first else 0.0,
         }
 
     def close(self) -> None:

@@ -111,6 +111,7 @@ class TrainResult:
     mean_step_time_s: float
     final_metrics: dict
     preempted: bool = False
+    first_window_s: float = 0.0   # compile + warmup window (startup cost)
 
 
 class PreemptionGuard:
@@ -514,6 +515,7 @@ def train(
         mean_step_time_s=summary["mean_step_time_s"],
         final_metrics=last_metrics,
         preempted=preempted,
+        first_window_s=summary.get("first_window_s", 0.0),
     )
 
 

@@ -1,0 +1,45 @@
+"""TPU chip spec sheet + FLOP models shared by the perf surfaces
+(bench.py headline artifact, workflows/kubebench.py matrix reports).
+
+One table so the MFU denominator can never disagree between artifacts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# First-light ResNet-50 measurement on one TPU v5e chip (bf16, batch
+# 256, synthetic data, this repo @ milestone 3) — the vs_baseline
+# denominator for bench.py AND the kubebench matrix.
+BASELINE_IMG_S = 1000.0
+
+# bf16 peak TFLOP/s by device_kind substring (public spec sheets)
+PEAK_TFLOPS = {
+    "v5 lite": 197.0, "v5e": 197.0,
+    "v5p": 459.0, "v5": 459.0,          # 'v5' alone = v5p
+    "v4": 275.0, "v3": 123.0, "v2": 46.0,
+    "v6 lite": 918.0, "v6e": 918.0,
+}
+
+# ResNet-50 @224 fwd ≈ 4.09 GFLOP/image; fwd+bwd ≈ 3x fwd (dgrad + wgrad
+# each cost ~one fwd). Conventional MFU flop model (matmul/conv MACs only).
+RESNET50_TRAIN_GFLOP_PER_IMAGE = 3 * 4.09
+
+
+def detect_peak_tflops(device) -> Optional[float]:
+    """Spec-sheet bf16 peak for a jax device, by device_kind substring;
+    None when the platform is unknown (CPU smoke runs)."""
+    kind = getattr(device, "device_kind", "").lower()
+    for key in sorted(PEAK_TFLOPS, key=len, reverse=True):
+        if key in kind:
+            return PEAK_TFLOPS[key]
+    return None
+
+
+def resnet50_train_mfu(images_per_sec_per_chip: float,
+                       device) -> Optional[float]:
+    peak = detect_peak_tflops(device)
+    if not peak:
+        return None
+    flops = images_per_sec_per_chip * RESNET50_TRAIN_GFLOP_PER_IMAGE * 1e9
+    return flops / (peak * 1e12)

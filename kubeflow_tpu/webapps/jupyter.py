@@ -61,7 +61,12 @@ def workspace_pvc_name(notebook_name: str, ws: dict) -> str:
 
 
 def build_notebook_manifest(namespace: str, body: dict) -> dict:
-    """POST body → Notebook CR (api.py:30-81 shape, TPU-aware)."""
+    """POST body → Notebook CR (api.py:30-81 shape, TPU-aware).
+
+    ``snapshotUri`` (the rok-skin analog: the reference's rok UI spawns
+    notebooks from a Rok snapshot URL) records the workspace seed source
+    as an annotation the storage layer resolves; gs:// is the TPU-era
+    transport where the reference used rok://."""
     name = body.get("name")
     if not name:
         raise ApiError(400, "name is required")
@@ -102,12 +107,20 @@ def build_notebook_manifest(namespace: str, body: dict) -> dict:
     if volume_mounts:
         container["volumeMounts"] = volume_mounts
         pod_spec["volumes"] = volumes
-    return {
+    manifest = {
         "apiVersion": NOTEBOOK_API_VERSION, "kind": NOTEBOOK_KIND,
         "metadata": {"name": name, "namespace": namespace,
                      "labels": {"app": name}},
         "spec": {"template": {"spec": pod_spec}},
     }
+    snapshot = body.get("snapshotUri")
+    if snapshot:
+        if not snapshot.startswith(("gs://", "file://")):
+            raise ApiError(400, f"snapshotUri must be gs:// or file://, "
+                                f"got {snapshot!r}")
+        manifest["metadata"]["annotations"] = {
+            "kubeflow-tpu.org/workspace-snapshot": snapshot}
+    return manifest
 
 
 def build_pvc_manifest(namespace: str, body: dict) -> dict:
@@ -179,6 +192,9 @@ td,th{border:1px solid #dadce0;padding:0.35rem 0.7rem;text-align:left}
       <option value="existing">use existing PVC</option>
       <option value="none">none</option></select>
     <label>workspace size</label><input name="wsSize" value="10Gi">
+    <label data-skin="snapshot" hidden>snapshot URI</label>
+    <input name="snapshotUri" data-skin="snapshot" hidden
+      placeholder="gs://bucket/workspace-snapshot">
   </div>
   <div id="data-volumes"></div>
   <p>
@@ -217,10 +233,13 @@ def build_jupyter_app(client: KubeClient, prefix: str = "") -> JsonApp:
 
     @app.route("GET", "/api/config")
     def config(params, query, body):
+        # skin selects the spawner variant (the reference's default/rok
+        # UIs): "snapshot" surfaces the workspace-seed URI field
         return 200, {
             "images": DEFAULT_IMAGES,
             "tpuShapes": TPU_SHAPES,
             "defaultWorkspaceSize": "10Gi",
+            "skin": os.environ.get("KFTPU_JUPYTER_SKIN", "default"),
         }
 
     @app.route("GET", "/api/namespaces/{ns}/notebooks")

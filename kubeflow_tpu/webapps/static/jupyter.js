@@ -59,6 +59,13 @@
       `<option value="${esc(s)}">${esc(s || "none")}</option>`).join("");
     const wsSize = document.querySelector("input[name=wsSize]");
     if (cfg.defaultWorkspaceSize) wsSize.value = cfg.defaultWorkspaceSize;
+    // the snapshot skin (reference rok-UI analog) reveals the
+    // workspace-seed URI field
+    if (cfg.skin === "snapshot") {
+      document.querySelectorAll("[data-skin=snapshot]").forEach((n) => {
+        n.hidden = false;
+      });
+    }
   }
 
   // -- dynamic data-volume rows ----------------------------------------------
@@ -156,6 +163,9 @@
         size: form.wsSize.value.trim() || "10Gi",
         create: wsMode === "create",
       };
+    }
+    if (!form.snapshotUri.hidden && form.snapshotUri.value.trim()) {
+      payload.snapshotUri = form.snapshotUri.value.trim();
     }
     const button = form.querySelector("button[type=submit]");
     button.disabled = true;

@@ -1,0 +1,181 @@
+"""Auto-update bot: pin a component's image tag to its last source commit.
+
+The reference ships a CI bot that rebuilds the jupyter-web-app image,
+rewrites the ksonnet prototype's ``@optionalParam image`` line, and opens
+a PR (py/kubeflow/kubeflow/ci/update_jupyter_web_app.py — build_image /
+_replace_parameters / update_prototype / all). Same loop here over the
+TPU-native layout: a component's image tag IS the last git commit that
+touched its source tree, the "prototype" is the manifests module's
+``VERSION = "..."`` pin, and the PR is prepared as a branch + commit via
+an injectable runner (zero-egress dev: no hub/GCB calls baked in).
+
+    python -m kubeflow_tpu.workflows.image_update jupyter-web-app
+
+Flow (the reference bot's `all`): component source commit → check the
+pin → rewrite it → regenerate the rendered examples → branch + commit →
+emit the PR payload the caller hands to its forge of choice.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import subprocess
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+REGISTRY = "ghcr.io/kubeflow-tpu"
+
+# component → (source tree whose history defines the tag, manifests
+# module holding the pin, the PER-COMPONENT pin constant). The constant
+# is per component on purpose: rewriting the module-wide VERSION would
+# silently retag every other image that module builds to a commit of an
+# unrelated source tree.
+COMPONENT_SOURCES: dict[str, tuple[str, str, str]] = {
+    "jupyter-web-app": ("kubeflow_tpu/webapps",
+                        "kubeflow_tpu/manifests/notebooks.py",
+                        "JUPYTER_WEB_APP_VERSION"),
+    "centraldashboard": ("kubeflow_tpu/webapps",
+                         "kubeflow_tpu/manifests/core.py",
+                         "CENTRALDASHBOARD_VERSION"),
+    "worker": ("kubeflow_tpu/runtime",
+               "kubeflow_tpu/manifests/training.py",
+               "WORKER_VERSION"),
+    "serving": ("kubeflow_tpu/serving",
+                "kubeflow_tpu/manifests/serving.py",
+                "MODEL_SERVER_VERSION"),
+}
+
+
+def default_runner(args: list[str], cwd: str) -> str:
+    # PYTHONPATH=cwd so child python scripts (examples/regenerate.py)
+    # resolve the in-repo package without an install
+    env = dict(os.environ, PYTHONPATH=cwd)
+    return subprocess.run(args, cwd=cwd, check=True, text=True,
+                          capture_output=True, env=env).stdout.strip()
+
+
+def replace_version(lines: list[str], new: str,
+                    pin: str = "VERSION") -> tuple[list[str], str]:
+    """Rewrite the named pin constant (the _replace_parameters analog
+    over ``// @optionalParam image`` lines). Returns (lines, old)."""
+    regex = re.compile(r'^(' + re.escape(pin) + r'\s*=\s*")([^"]*)(")\s*$')
+    old = ""
+    out = []
+    for line in lines:
+        m = regex.match(line)
+        if m and not old:
+            old = m.group(2)
+            line = f'{m.group(1)}{new}{m.group(3)}'
+        out.append(line)
+    if not old:
+        raise ValueError(f"no {pin} pin found")
+    return out, old
+
+
+def component_commit(repo_root: str, source_path: str,
+                     run: Callable = default_runner) -> str:
+    """Last commit touching the component's source tree (the bot's
+    last_commit property)."""
+    out = run(["git", "log", "-n", "1", "--pretty=format:%h", "--",
+               source_path], cwd=repo_root)
+    if not out:
+        raise ValueError(f"no commits touch {source_path}")
+    return out
+
+
+@dataclass
+class UpdateResult:
+    component: str
+    image: str
+    old_tag: str
+    new_tag: str
+    changed: bool
+    branch: str = ""
+    pr_title: str = ""
+    pr_body: str = ""
+    files: list = field(default_factory=list)
+
+
+def update_component(repo_root: str, component: str,
+                     registry: str = REGISTRY,
+                     run: Callable = default_runner,
+                     commit: bool = True) -> UpdateResult:
+    """The bot's `all`: compute the tag, rewrite the pin, regenerate the
+    rendered examples, and (optionally) branch + commit, returning the
+    PR payload. Idempotent: an up-to-date pin returns changed=False and
+    touches nothing."""
+    if component not in COMPONENT_SOURCES:
+        raise KeyError(f"unknown component {component!r}; known: "
+                       f"{sorted(COMPONENT_SOURCES)}")
+    source_path, pin_file, pin_name = COMPONENT_SOURCES[component]
+    tag = component_commit(repo_root, source_path, run=run)
+    image = f"{registry}/{component}:{tag}"
+
+    pin_path = os.path.join(repo_root, pin_file)
+    with open(pin_path) as f:
+        lines = f.read().split("\n")
+    new_lines, old_tag = replace_version(lines, tag, pin=pin_name)
+    if old_tag == tag:
+        log.info("%s already pinned to %s", component, tag)
+        return UpdateResult(component=component, image=image,
+                            old_tag=old_tag, new_tag=tag, changed=False)
+
+    # atomic rewrite, the reference bot's tmp+rename
+    tmp = pin_path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("\n".join(new_lines))
+    os.replace(tmp, pin_path)
+    files = [pin_file]
+
+    # the rendered examples are build OUTPUTS of the builders this pin
+    # feeds — regenerate so the sync gate stays green in the PR
+    regen = os.path.join(repo_root, "examples", "regenerate.py")
+    if os.path.exists(regen):
+        import sys
+        run([sys.executable, regen], cwd=repo_root)
+        files.append("examples")
+
+    branch = f"update-{component}-{tag}"
+    title = f"Update {component} image to {tag}"
+    body = (f"Automated image pin update.\n\n"
+            f"* image: `{image}`\n"
+            f"* previous tag: `{old_tag}`\n"
+            f"* source: last commit touching `{source_path}`\n")
+    if commit:
+        run(["git", "checkout", "-b", branch], cwd=repo_root)
+        run(["git", "add", *files], cwd=repo_root)
+        run(["git", "commit", "-m", title], cwd=repo_root)
+    return UpdateResult(component=component, image=image, old_tag=old_tag,
+                        new_tag=tag, changed=True, branch=branch,
+                        pr_title=title, pr_body=body, files=files)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+    logging.basicConfig(level=logging.INFO, force=True)
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("component", choices=sorted(COMPONENT_SOURCES))
+    p.add_argument("--registry", default=REGISTRY)
+    p.add_argument("--repo-root", default=".")
+    p.add_argument("--no-commit", action="store_true",
+                   help="rewrite the pin only; no branch/commit")
+    args = p.parse_args(argv)
+    result = update_component(os.path.abspath(args.repo_root),
+                              args.component, registry=args.registry,
+                              commit=not args.no_commit)
+    if not result.changed:
+        print(f"{result.component} already pinned to {result.new_tag}")
+        return 0
+    print(f"updated {result.component}: {result.old_tag} -> "
+          f"{result.new_tag} on branch {result.branch}")
+    print(result.pr_body)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -43,8 +43,16 @@ class TestPathMapping:
         post = select_workflows(["kubeflow_tpu/api/k8s.py"], entries,
                                 trigger="postsubmit")
         assert all(e.trigger == "presubmit" for e in pre)
-        assert {e.name for e in post} == {"release_images"}
-        assert post[0].params["registry"].startswith("ghcr.io")
+        assert {e.name for e in post} == {"release_images",
+                                          "unit_tests_slow"}
+        by_name = {e.name: e for e in post}
+        assert by_name["release_images"].params["registry"].startswith(
+            "ghcr.io")
+        # the fast/slow tier split: presubmit excludes slow, the
+        # postsubmit companion runs exactly the slow marker
+        assert by_name["unit_tests_slow"].params["pytest_args"] == "-m slow"
+        pre_unit = {e.name: e for e in pre}["unit_tests"]
+        assert pre_unit.params["pytest_args"] == "-m 'not slow'"
 
     def test_periodic_ignores_diff(self, entries):
         sel = select_workflows([], entries, trigger="periodic")

@@ -1,0 +1,104 @@
+"""Auto-update bot tests (the reference's
+update_jupyter_web_app_test.py covered _replace_parameters; here the
+whole loop runs against a real temp git repo)."""
+
+import os
+import subprocess
+
+import pytest
+
+from kubeflow_tpu.workflows.image_update import (COMPONENT_SOURCES,
+                                                 UpdateResult,
+                                                 component_commit,
+                                                 replace_version,
+                                                 update_component)
+
+
+def git(repo, *args):
+    return subprocess.run(["git", *args], cwd=repo, check=True, text=True,
+                          capture_output=True).stdout.strip()
+
+
+@pytest.fixture
+def repo(tmp_path):
+    root = str(tmp_path / "repo")
+    os.makedirs(os.path.join(root, "kubeflow_tpu/webapps"))
+    os.makedirs(os.path.join(root, "kubeflow_tpu/manifests"))
+    git(root, "init", "-q")
+    git(root, "config", "user.email", "ci@test")
+    git(root, "config", "user.name", "ci")
+    with open(os.path.join(root, "kubeflow_tpu/webapps/app.py"), "w") as f:
+        f.write("print('v1')\n")
+    pin = os.path.join(root, "kubeflow_tpu/manifests/notebooks.py")
+    with open(pin, "w") as f:
+        f.write('"""pins"""\nVERSION = "v0.1.0"\n'
+                'JUPYTER_WEB_APP_VERSION = "v0.1.0"\nIMG = "x"\n')
+    git(root, "add", ".")
+    git(root, "commit", "-q", "-m", "initial")
+    return root
+
+
+class TestReplaceVersion:
+    def test_rewrites_and_returns_old(self):
+        lines, old = replace_version(
+            ['x = 1', 'VERSION = "v0.1.0"', 'y = 2'], "abc123")
+        assert old == "v0.1.0"
+        assert lines[1] == 'VERSION = "abc123"'
+
+    def test_named_pin_leaves_module_version_alone(self):
+        # the bot must retag ONLY its component: the module-wide VERSION
+        # (tagging unrelated images) stays untouched
+        lines, old = replace_version(
+            ['VERSION = "v0.1.0"', 'JUPYTER_WEB_APP_VERSION = "v0.1.0"'],
+            "abc123", pin="JUPYTER_WEB_APP_VERSION")
+        assert old == "v0.1.0"
+        assert lines[0] == 'VERSION = "v0.1.0"'
+        assert lines[1] == 'JUPYTER_WEB_APP_VERSION = "abc123"'
+
+    def test_no_pin_raises(self):
+        with pytest.raises(ValueError, match="VERSION"):
+            replace_version(["x = 1"], "abc")
+
+
+class TestUpdateComponent:
+    def test_full_loop_branch_and_commit(self, repo):
+        tag = component_commit(repo, "kubeflow_tpu/webapps")
+        result = update_component(repo, "jupyter-web-app")
+        assert isinstance(result, UpdateResult)
+        assert result.changed
+        assert result.new_tag == tag
+        assert result.old_tag == "v0.1.0"
+        assert result.image == f"ghcr.io/kubeflow-tpu/jupyter-web-app:{tag}"
+        # pin rewritten on a new branch with one commit; the module-wide
+        # VERSION (other images) is untouched
+        assert git(repo, "rev-parse", "--abbrev-ref", "HEAD") == \
+            f"update-jupyter-web-app-{tag}"
+        with open(os.path.join(repo,
+                               "kubeflow_tpu/manifests/notebooks.py")) as f:
+            content = f.read()
+        assert f'JUPYTER_WEB_APP_VERSION = "{tag}"' in content
+        assert 'VERSION = "v0.1.0"' in content
+        assert git(repo, "log", "-n", "1", "--pretty=%s") == result.pr_title
+        assert result.image in result.pr_body
+
+    def test_idempotent_when_pinned(self, repo):
+        update_component(repo, "jupyter-web-app")
+        # the bot commit itself does not touch the source tree, so the
+        # tag is unchanged and a rerun is a no-op
+        again = update_component(repo, "jupyter-web-app")
+        assert not again.changed
+        assert again.branch == ""
+
+    def test_unknown_component(self, repo):
+        with pytest.raises(KeyError, match="unknown component"):
+            update_component(repo, "nope")
+
+    def test_source_map_paths_and_pins_exist(self):
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        for src, pin, pin_name in COMPONENT_SOURCES.values():
+            assert os.path.exists(os.path.join(repo_root, src)), src
+            pin_path = os.path.join(repo_root, pin)
+            assert os.path.exists(pin_path), pin
+            with open(pin_path) as f:
+                assert f'{pin_name} = "' in f.read(), pin_name

@@ -114,6 +114,7 @@ class TestShardFormat:
             np.testing.assert_array_equal(b["images"], imgs[4 + i])
 
 
+@pytest.mark.slow
 class TestWorkerRealData:
     def test_train_consumes_records_deterministically(self, data_dir):
         d, *_ = data_dir
@@ -143,6 +144,7 @@ class TestWorkerRealData:
                   data_dir=d)
 
 
+@pytest.mark.slow
 class TestOperatorDataDir:
     def test_data_dir_rendered_as_env(self):
         from kubeflow_tpu.api.trainingjob import TrainingJob
@@ -216,6 +218,7 @@ class TestOperatorDataDir:
         assert "top1" in r.final_metrics
 
 
+@pytest.mark.slow
 class TestBenchmarkMatrix:
     def test_matrix_produces_csv_per_config(self, tmp_path):
         from kubeflow_tpu.workflows.kubebench import (CONFIG_MATRIX,
@@ -277,6 +280,7 @@ class TestNativeAugment:
         np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow
 class TestUint8DeviceNormalize:
     """uint8 input mode: augmented bytes ship to the device, normalize
     runs in jit — the composition equals the host-normalized path."""
@@ -324,6 +328,7 @@ class TestUint8DeviceNormalize:
             ImageNetSource(d, batch_size=8, output="float64")
 
 
+@pytest.mark.slow
 class TestEvalTailHandling:
     """ADVICE r3: eval_batches=0 must count EVERY holdout record — the
     tail batch comes through short (drop_remainder=False), gets padded
@@ -402,6 +407,7 @@ class TestEvalTailHandling:
                   eval_every=1, seed=0)
 
 
+@pytest.mark.slow
 class TestCompileCache:
     """runtime/compile_cache.py: persistent XLA compilation cache wiring
     (BASELINE.md north-star #2 — startup→first-step on warm restarts)."""

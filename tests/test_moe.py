@@ -25,6 +25,7 @@ def tiny_moe_cfg(**kw):
     return T.TransformerConfig(**base)
 
 
+@pytest.mark.slow
 class TestRouting:
     def test_top1_router_gets_task_gradient(self):
         """Switch semantics: with top_k=1 the combine weight is the raw
@@ -118,6 +119,7 @@ class TestRouting:
                                    atol=0.15, rtol=0.15)
 
 
+@pytest.mark.slow
 class TestMoETransformer:
     def test_forward_and_loss(self):
         cfg = tiny_moe_cfg()

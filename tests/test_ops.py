@@ -81,6 +81,7 @@ def test_ring_attention_matches_reference(seq_mesh, causal):
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_grad(seq_mesh):
     q, k, v = _qkv(b=1, s=128, h=2, d=16)
 
@@ -106,6 +107,7 @@ def test_ring_attention_degenerate_axis():
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_transformer_attention_impls_agree(seq_mesh):
     """Same params, same batch → same loss across einsum/flash/ring."""
     from kubeflow_tpu.models import transformer as T
@@ -130,6 +132,7 @@ def test_transformer_attention_impls_agree(seq_mesh):
     assert abs(losses["ring"] - losses["einsum"]) < 1e-4, losses
 
 
+@pytest.mark.slow
 class TestFusedBlock:
     """ops/fused_block.py: the fused bottleneck kernel equals the jnp
     reference and the flax eval path (interpret mode on CPU)."""
@@ -210,6 +213,7 @@ class TestResNetFamily:
         assert family <= set(_MODEL_BUILDERS)
 
 
+@pytest.mark.slow
 class TestFusedBlockTrain:
     """ops/fused_block_train.py: the ghost-BN training kernel pair equals
     the differentiable jnp reference — values, stats, AND jax.grad —

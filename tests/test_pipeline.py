@@ -31,6 +31,7 @@ def _sequential(params, x):
     return out
 
 
+@pytest.mark.slow
 class TestPipelineApply:
     def test_matches_sequential(self):
         mesh = build_mesh(ShardingSpec(data=2, pipeline=4))
@@ -97,6 +98,7 @@ class TestPipelineApply:
                                    rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 class TestPipelinedTransformer:
     def test_pipelined_lm_matches_plain_scan(self):
         cfg = T.TransformerConfig(vocab_size=64, num_layers=4, embed_dim=32,

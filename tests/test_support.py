@@ -134,6 +134,7 @@ class TestEchoAndRedirect:
             server.stop()
 
 
+@pytest.mark.slow
 class TestTensorboardEvents:
     """The dependency-free event writer must produce files the REAL
     TensorBoard reader accepts (format cross-validation, not a mirror of

@@ -198,6 +198,22 @@ class TestJupyterWebApp:
             "claimName"] == "ds1"
         assert spec["containers"][0]["volumeMounts"][0]["mountPath"] == "/ds"
 
+    def test_snapshot_skin_uri_annotation(self, monkeypatch, cluster):
+        # the rok-skin analog: a gs:// workspace seed lands as an
+        # annotation; other schemes are rejected; the skin rides config
+        m = build_notebook_manifest("alice", {
+            "name": "x", "snapshotUri": "gs://bucket/snap-1"})
+        assert m["metadata"]["annotations"][
+            "kubeflow-tpu.org/workspace-snapshot"] == "gs://bucket/snap-1"
+        with pytest.raises(ApiError, match="snapshotUri"):
+            build_notebook_manifest("alice", {
+                "name": "x", "snapshotUri": "rok://old-style"})
+        monkeypatch.setenv("KFTPU_JUPYTER_SKIN", "snapshot")
+        from kubeflow_tpu.webapps.jupyter import build_jupyter_app
+        app = build_jupyter_app(cluster)
+        status, cfg = app.dispatch("GET", "/api/config", None)
+        assert status == 200 and cfg["skin"] == "snapshot"
+
     def test_unknown_route_404(self, cluster):
         app = build_dashboard_app(cluster)
         status, err = app.dispatch("GET", "/api/nope", None)

@@ -69,8 +69,9 @@ def main(argv=None) -> int:
                    help="manifests module filename (default: name with "
                         "dashes → underscores)")
     args = p.parse_args(argv)
-    if not re.fullmatch(r"[a-z0-9][a-z0-9-]*", args.name):
-        p.error("name must be lowercase-dashed")
+    if not re.fullmatch(r"[a-z][a-z0-9-]*", args.name):
+        p.error("name must be lowercase-dashed and start with a letter "
+                "(it becomes a Python identifier)")
     module = args.module or args.name.replace("-", "_")
     fn = args.name.replace("-", "_")
     title = args.name.replace("-", " ").title()

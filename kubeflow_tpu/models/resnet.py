@@ -375,6 +375,8 @@ def fused_train_apply(variables: dict, images: jax.Array, *,
 
     from ..ops.fused_block_train import (fits_vmem_budget,
                                          fused_bottleneck_train)
+    from ..ops.fused_block_train_spatial import (
+        default_tile_h, fused_bottleneck_train_spatial)
 
     params, stats = variables["params"], variables["batch_stats"]
     batch_moments: dict = {}
@@ -396,13 +398,20 @@ def fused_train_apply(variables: dict, images: jax.Array, *,
             _, h, w_, cin = x.shape
             cmid = bp["Conv_0"]["kernel"].shape[-1]
             cout = bp["Conv_2"]["kernel"].shape[-1]
-            # strided blocks the kernel doesn't cover; early-stage blocks
-            # whose one-image working set busts VMEM route to XLA too
-            if strides == 1 and fits_vmem_budget(h, w_, cin, cmid, cout):
+            # strided blocks the kernels don't cover route to XLA;
+            # stride-1 blocks batch-tile when one image fits VMEM and
+            # fall back to the spatially-tiled (halo) kernel for the
+            # large early-stage geometries, XLA as the last resort
+            if strides != 1:
+                x, bstats = _xla_block_train(x, bp, strides,
+                                             dtype=dtype, eps=eps)
+            elif fits_vmem_budget(h, w_, cin, cmid, cout):
                 x, bstats = fused_bottleneck_train(x, bp, tile_bt=tile_bt,
                                                    eps=eps)
+            elif default_tile_h(h, w_, cin, cmid, cout) is not None:
+                x, bstats = fused_bottleneck_train_spatial(x, bp, eps=eps)
             else:
-                x, bstats = _xla_block_train(x, bp, strides,
+                x, bstats = _xla_block_train(x, bp, 1,
                                              dtype=dtype, eps=eps)
             batch_moments[name] = bstats
 

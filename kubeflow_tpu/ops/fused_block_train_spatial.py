@@ -1,0 +1,571 @@
+"""Spatially-tiled fused ResNet bottleneck (TRAINING): ghost-BN over
+batch x row-strip tiles — the variant that re-admits the stage-1/2
+blocks whose ONE-IMAGE working set busts the VMEM budget of the
+batch-tiled kernel (ops/fused_block_train.py; PERF.md round 5 "spatial
+halo tiling is the path back to the 35% cut").
+
+Tiling: the image's H rows split into strips of ``tile_h`` rows; each
+kernel instance processes (tile_bt images x one strip) with a 1-row halo
+on each side so the 3x3 conv is exact at strip seams (zero rows at image
+edges — SAME-conv semantics). Strips are pre-laid-out by XLA
+(``make_strips``) because BlockSpec index maps address in whole-block
+units and cannot express overlapping halo windows; the relayout costs
+one extra pass over x (+2/tile_h overhead) and the backward pays one
+overlap-add pass over dx (``combine_strips``) — both small next to the
+~4 interior HBM passes the fusion removes.
+
+**Ghost-BN semantics (per batch x strip ghost):** statistics are
+computed over the strip's INTERIOR samples (tile_bt*tile_h*W per
+channel); halo rows are normalized with those interior stats (they only
+feed the 3x3). In the backward, halo samples contribute to dgamma/dbeta
+and to the stat-correction sums, but the 1/N divisor is the interior
+count and the correction applies to interior rows only — exactly
+``jax.grad`` of the executable spec below, which is the tested
+definition of the semantics. Running stats are EMA-updated from the
+ghost-averaged moments, same contract as the batch-tiled kernel.
+
+The pure-jnp `reference_bottleneck_train_spatial` is the executable
+spec both kernels are tested against (values AND `jax.grad` gradients).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .fused_block_train import (VMEM_BUDGET_BYTES, _interpret,
+                                _padded_weights, _per_image_bytes,
+                                block_weights, stats_to_tree)
+
+__all__ = ["fused_bottleneck_train_spatial",
+           "reference_bottleneck_train_spatial", "default_tile_h",
+           "fits_vmem_budget_spatial", "make_strips", "combine_strips"]
+
+
+def _strip_bytes(tile_h: int, w: int, cin: int, cmid: int,
+                 cout: int) -> int:
+    """Working-set estimate per image for one haloed strip."""
+    return _per_image_bytes(tile_h + 2, w, cin, cmid, cout)
+
+
+def fits_vmem_budget_spatial(tile_h: int, w: int, cin: int, cmid: int,
+                             cout: int) -> bool:
+    return _strip_bytes(tile_h, w, cin, cmid, cout) <= VMEM_BUDGET_BYTES
+
+
+def default_tile_h(h: int, w: int, cin: int, cmid: int,
+                   cout: int) -> Optional[int]:
+    """Largest strip height dividing h whose haloed working set fits the
+    budget at tile_bt=1; None when even a 1-row strip cannot fit."""
+    for th in range(h, 0, -1):
+        if h % th == 0 and fits_vmem_budget_spatial(th, w, cin, cmid,
+                                                    cout):
+            return th
+    return None
+
+
+# -----------------------------------------------------------------------------
+# strip layout (XLA side)
+# -----------------------------------------------------------------------------
+
+def make_strips(x: jax.Array, tile_h: int) -> jax.Array:
+    """(n, h, w, c) -> (S, n, tile_h+2, w, c) haloed row strips; the
+    halo is the neighbor strip's edge row, zeros at image edges."""
+    n, h, w, c = x.shape
+    s_count = h // tile_h
+    xp = jnp.pad(x, ((0, 0), (1, 1), (0, 0), (0, 0)))
+    return jnp.stack([xp[:, s * tile_h:s * tile_h + tile_h + 2]
+                      for s in range(s_count)])
+
+
+def combine_strips(dx_strips: jax.Array, h: int, tile_h: int) -> jax.Array:
+    """Overlap-add (S, n, tile_h+2, w, c) haloed strip gradients back to
+    (n, h, w, c) — seam rows receive both neighbors' halo contributions;
+    image-edge pad rows are dropped."""
+    s_count, n, _, w, c = dx_strips.shape
+    acc = jnp.zeros((n, h + 2, w, c), dx_strips.dtype)
+    for s in range(s_count):
+        acc = acc.at[:, s * tile_h:s * tile_h + tile_h + 2].add(
+            dx_strips[s])
+    return acc[:, 1:h + 1]
+
+
+# -----------------------------------------------------------------------------
+# executable spec (pure jnp, differentiable)
+# -----------------------------------------------------------------------------
+
+def _edge_mask(bt: int, th2: int, w: int, is_top, is_bottom):
+    """1 everywhere except image-edge halo rows (those are SAME-conv
+    ZERO padding of h1 — a BN with bias would otherwise turn the zero
+    INPUT rows into nonzero h1 padding). Accepts python bools (spec) or
+    traced predicates (kernel, from program_id)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bt, th2, w, 1), 1)
+    top = jnp.logical_and(rows == 0, is_top)
+    bot = jnp.logical_and(rows == th2 - 1, is_bottom)
+    return 1.0 - jnp.logical_or(top, bot).astype(jnp.float32)
+
+
+def _strip_forward(xt: jax.Array, weights: tuple, eps: float,
+                   is_top: bool, is_bottom: bool):
+    """One (tile_bt, tile_h+2, w, cin) haloed strip through the block.
+    Returns (out (tile_bt, tile_h, w, cout), ghost stats tuple). Pure
+    jnp — the kernels mirror these ops exactly."""
+    has_proj = len(weights) == 12
+    w1, g1, b1, w2, g2, b2, w3, g3, b3 = weights[:9]
+    f32 = jnp.float32
+    dt = xt.dtype
+    bt, th2, w_, cin = xt.shape
+    th = th2 - 2
+    cmid = w1.shape[-1]
+
+    xm = xt.reshape(-1, cin)
+    a1 = jnp.dot(xm, w1.astype(dt), preferred_element_type=f32)
+    a1i = a1.reshape(bt, th2, w_, cmid)[:, 1:th + 1].reshape(-1, cmid)
+    m1 = jnp.mean(a1i, axis=0)
+    v1 = jnp.mean(a1i * a1i, axis=0) - m1 * m1
+    h1 = jax.nn.relu(g1 * ((a1 - m1) * jax.lax.rsqrt(v1 + eps)) + b1) \
+        .astype(dt).reshape(bt, th2, w_, cmid)
+    h1 = (h1 * _edge_mask(bt, th2, w_, is_top, is_bottom)).astype(dt)
+
+    pad = jnp.pad(h1, ((0, 0), (0, 0), (1, 1), (0, 0)))
+    acc = jnp.zeros((bt * th * w_, cmid), f32)
+    for dy in range(3):
+        for dx in range(3):
+            acc = acc + jnp.dot(
+                pad[:, dy:dy + th, dx:dx + w_, :].reshape(-1, cmid),
+                w2[dy, dx].astype(dt), preferred_element_type=f32)
+    m2 = jnp.mean(acc, axis=0)
+    v2 = jnp.mean(acc * acc, axis=0) - m2 * m2
+    h2 = jax.nn.relu(g2 * ((acc - m2) * jax.lax.rsqrt(v2 + eps)) + b2) \
+        .astype(dt)
+    a3 = jnp.dot(h2, w3.astype(dt), preferred_element_type=f32)
+    m3 = jnp.mean(a3, axis=0)
+    v3 = jnp.mean(a3 * a3, axis=0) - m3 * m3
+    y3 = g3 * ((a3 - m3) * jax.lax.rsqrt(v3 + eps)) + b3
+
+    xi = xt[:, 1:th + 1].reshape(-1, cin)
+    if has_proj:
+        wp, gp, bp = weights[9:12]
+        ap = jnp.dot(xi, wp.astype(dt), preferred_element_type=f32)
+        mp = jnp.mean(ap, axis=0)
+        vp = jnp.mean(ap * ap, axis=0) - mp * mp
+        r = gp * ((ap - mp) * jax.lax.rsqrt(vp + eps)) + bp
+    else:
+        r = xi.astype(f32)
+        mp = vp = jnp.zeros((1,), f32)
+    out = jax.nn.relu(y3 + r).astype(dt).reshape(bt, th, w_, -1)
+    return out, (m1, v1, m2, v2, m3, v3, mp, vp)
+
+
+def reference_bottleneck_train_spatial(x: jax.Array, weights: tuple, *,
+                                       tile_bt: int, tile_h: int,
+                                       eps: float = 1e-5):
+    """Ghost-BN bottleneck forward tiled exactly like the spatial kernel
+    grid ((n//tile_bt) x (h//tile_h) ghosts). Differentiable: jax.grad
+    of this is the golden gradient for the Pallas backward."""
+    n, h, w_, cin = x.shape
+    t_count, s_count = n // tile_bt, h // tile_h
+    xs = make_strips(x, tile_h)
+    out_rows = []
+    stats = None
+    for s in range(s_count):
+        tiles = []
+        for t in range(t_count):
+            xt = xs[s, t * tile_bt:(t + 1) * tile_bt]
+            o, st = _strip_forward(xt, weights, eps, is_top=(s == 0),
+                                   is_bottom=(s == s_count - 1))
+            tiles.append(o)
+            stats = st if stats is None else \
+                tuple(a + b for a, b in zip(stats, st))
+        out_rows.append(jnp.concatenate(tiles, axis=0))
+    out = jnp.concatenate(out_rows, axis=1)
+    inv = 1.0 / (t_count * s_count)
+    return out, tuple(s * inv for s in stats)
+
+
+# -----------------------------------------------------------------------------
+# forward kernel
+# -----------------------------------------------------------------------------
+
+def _fwd_kernel(x_ref, w1_ref, g1_ref, b1_ref, w2_ref, g2_ref, b2_ref,
+                w3_ref, g3_ref, b3_ref, wp_ref, gp_ref, bp_ref,
+                o_ref, m1_ref, v1_ref, m2_ref, v2_ref, m3_ref, v3_ref,
+                mp_ref, vp_ref, *, has_proj: bool, eps: float,
+                inv_ghosts: float, s_count: int):
+    f32 = jnp.float32
+    xt = x_ref[0]                       # (bt, th+2, w, cin)
+    bt, th2, w, cin = xt.shape
+    th = th2 - 2
+    dt = xt.dtype
+    xm = xt.reshape(-1, cin)
+
+    s_id = pl.program_id(1)
+    first = (pl.program_id(0) == 0) & (s_id == 0)
+    emask = _edge_mask(bt, th2, w, s_id == 0, s_id == s_count - 1)
+
+    def acc_stat(ref, val):
+        @pl.when(first)
+        def _():
+            ref[...] = val * inv_ghosts
+
+        @pl.when(jnp.logical_not(first))
+        def _():
+            ref[...] += val * inv_ghosts
+
+    def interior_stats(a):
+        ai = a.reshape(bt, th2, w, -1)[:, 1:th + 1] \
+            .reshape(-1, a.shape[-1])
+        m = jnp.mean(ai, axis=0)
+        v = jnp.mean(ai * ai, axis=0) - m * m
+        return m, v
+
+    a1 = jnp.dot(xm, w1_ref[...], preferred_element_type=f32)
+    m1, v1 = interior_stats(a1)
+    h1 = jax.nn.relu(g1_ref[...] * ((a1 - m1)
+                                    * jax.lax.rsqrt(v1 + eps))
+                     + b1_ref[...]).astype(dt)
+    cmid = h1.shape[-1]
+    h1 = (h1.reshape(bt, th2, w, cmid) * emask).astype(dt)
+    pad = jnp.pad(h1, ((0, 0), (0, 0), (1, 1), (0, 0)))
+    acc = jnp.zeros((bt * th * w, cmid), f32)
+    for dy in range(3):
+        for dx in range(3):
+            acc = acc + jnp.dot(
+                pad[:, dy:dy + th, dx:dx + w, :].reshape(-1, cmid),
+                w2_ref[dy, dx], preferred_element_type=f32)
+    m2 = jnp.mean(acc, axis=0)
+    v2 = jnp.mean(acc * acc, axis=0) - m2 * m2
+    h2 = jax.nn.relu(g2_ref[...] * ((acc - m2)
+                                    * jax.lax.rsqrt(v2 + eps))
+                     + b2_ref[...]).astype(dt)
+    a3 = jnp.dot(h2, w3_ref[...], preferred_element_type=f32)
+    m3 = jnp.mean(a3, axis=0)
+    v3 = jnp.mean(a3 * a3, axis=0) - m3 * m3
+    y3 = g3_ref[...] * ((a3 - m3) * jax.lax.rsqrt(v3 + eps)) + b3_ref[...]
+
+    xi = xt[:, 1:th + 1].reshape(-1, cin)
+    if has_proj:
+        ap = jnp.dot(xi, wp_ref[...], preferred_element_type=f32)
+        mp = jnp.mean(ap, axis=0)
+        vp = jnp.mean(ap * ap, axis=0) - mp * mp
+        r = gp_ref[...] * ((ap - mp) * jax.lax.rsqrt(vp + eps)) \
+            + bp_ref[...]
+        acc_stat(mp_ref, mp)
+        acc_stat(vp_ref, vp)
+    else:
+        r = xi.astype(f32)
+
+        @pl.when(first)
+        def _():
+            mp_ref[...] = jnp.zeros_like(mp_ref)
+            vp_ref[...] = jnp.zeros_like(vp_ref)
+    o_ref[...] = jax.nn.relu(y3 + r).astype(dt).reshape(1, bt, th, w, -1)
+    acc_stat(m1_ref, m1)
+    acc_stat(v1_ref, v1)
+    acc_stat(m2_ref, m2)
+    acc_stat(v2_ref, v2)
+    acc_stat(m3_ref, m3)
+    acc_stat(v3_ref, v3)
+
+
+# -----------------------------------------------------------------------------
+# backward kernel
+# -----------------------------------------------------------------------------
+
+def _bwd_kernel(x_ref, g_ref, w1_ref, g1_ref, b1_ref, w2_ref, g2_ref,
+                b2_ref, w3_ref, g3_ref, b3_ref, wp_ref, gp_ref, bp_ref,
+                dx_ref, dw1_ref, dg1_ref, db1_ref, dw2_ref, dg2_ref,
+                db2_ref, dw3_ref, dg3_ref, db3_ref, dwp_ref, dgp_ref,
+                dbp_ref, *, has_proj: bool, eps: float, s_count: int):
+    f32 = jnp.float32
+    xt = x_ref[0]                       # (bt, th+2, w, cin)
+    bt, th2, w, cin = xt.shape
+    th = th2 - 2
+    dt = xt.dtype
+    xm = xt.reshape(-1, cin)
+    gout = g_ref[0].reshape(bt * th * w, -1)
+    n_int = f32(bt * th * w)
+
+    s_id = pl.program_id(1)
+    first = (pl.program_id(0) == 0) & (s_id == 0)
+    emask = _edge_mask(bt, th2, w, s_id == 0, s_id == s_count - 1) \
+        .reshape(-1, 1)
+
+    def acc_grad(ref, val):
+        @pl.when(first)
+        def _():
+            ref[...] = val
+
+        @pl.when(jnp.logical_not(first))
+        def _():
+            ref[...] += val
+
+    # interior-row mask over the haloed sample axis, shape (M_halo, 1)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bt, th2, w), 1)
+    imask = ((rows >= 1) & (rows <= th)).reshape(-1, 1).astype(f32)
+
+    def gbn_bwd_int(dy_, xh, g, s):
+        # all samples ARE interior (BN2/BN3/proj): standard ghost-BN bwd
+        dg = jnp.sum(dy_ * xh, axis=0)
+        db = jnp.sum(dy_, axis=0)
+        dxh = dy_ * g
+        da = s * (dxh - jnp.sum(dxh, axis=0) / n_int
+                  - xh * (jnp.sum(dxh * xh, axis=0) / n_int))
+        return da, dg, db
+
+    # ---- recompute the forward interior (all haloed rows)
+    a1 = jnp.dot(xm, w1_ref[...], preferred_element_type=f32)
+    a1i = a1 * imask
+    m1 = jnp.sum(a1i, axis=0) / n_int
+    v1 = jnp.sum(a1i * a1, axis=0) / n_int - m1 * m1
+    s1 = jax.lax.rsqrt(v1 + eps)
+    xh1 = (a1 - m1) * s1
+    y1 = g1_ref[...] * xh1 + b1_ref[...]
+    h1 = (jax.nn.relu(y1) * emask).astype(dt)
+    cmid = h1.shape[-1]
+    pad1 = jnp.pad(h1.reshape(bt, th2, w, cmid),
+                   ((0, 0), (0, 0), (1, 1), (0, 0)))
+    acc2 = jnp.zeros((bt * th * w, cmid), f32)
+    for dy in range(3):
+        for dx in range(3):
+            acc2 = acc2 + jnp.dot(
+                pad1[:, dy:dy + th, dx:dx + w, :].reshape(-1, cmid),
+                w2_ref[dy, dx], preferred_element_type=f32)
+    m2 = jnp.mean(acc2, axis=0)
+    v2 = jnp.mean(acc2 * acc2, axis=0) - m2 * m2
+    s2 = jax.lax.rsqrt(v2 + eps)
+    xh2 = (acc2 - m2) * s2
+    y2 = g2_ref[...] * xh2 + b2_ref[...]
+    h2 = jax.nn.relu(y2).astype(dt)
+    a3 = jnp.dot(h2, w3_ref[...], preferred_element_type=f32)
+    m3 = jnp.mean(a3, axis=0)
+    v3 = jnp.mean(a3 * a3, axis=0) - m3 * m3
+    s3 = jax.lax.rsqrt(v3 + eps)
+    xh3 = (a3 - m3) * s3
+    y3 = g3_ref[...] * xh3 + b3_ref[...]
+    xi = xt[:, 1:th + 1].reshape(-1, cin)
+    if has_proj:
+        ap = jnp.dot(xi, wp_ref[...], preferred_element_type=f32)
+        mp = jnp.mean(ap, axis=0)
+        vp = jnp.mean(ap * ap, axis=0) - mp * mp
+        sp = jax.lax.rsqrt(vp + eps)
+        xhp = (ap - mp) * sp
+        r = gp_ref[...] * xhp + bp_ref[...]
+    else:
+        r = xi.astype(f32)
+
+    # ---- transpose the block, top down
+    gz = jnp.where(y3 + r > 0, gout.astype(f32), 0.0)
+
+    da3, dg3, db3 = gbn_bwd_int(gz, xh3, g3_ref[...], s3)
+    da3b = da3.astype(dt)
+    acc_grad(dg3_ref, dg3)
+    acc_grad(db3_ref, db3)
+    acc_grad(dw3_ref, jnp.dot(h2.T, da3b, preferred_element_type=f32))
+    dh2 = jnp.dot(da3b, w3_ref[...].T, preferred_element_type=f32)
+
+    dz2 = jnp.where(y2 > 0, dh2, 0.0)
+    da2, dg2, db2 = gbn_bwd_int(dz2, xh2, g2_ref[...], s2)
+    da2b = da2.astype(dt)
+    acc_grad(dg2_ref, dg2)
+    acc_grad(db2_ref, db2)
+
+    # conv3x3 transpose: wgrad reuses the forward's shifted haloed-h1
+    # views; dgrad scatters into the HALOED h1 rows via the mirrored
+    # offsets. Rows pad (2,2): the forward used the halo (no row pad),
+    # so output row q maps to haloed h1 row r = q + dy. Cols pad (1,1):
+    # the forward zero-padded columns exactly like the batch-tiled
+    # kernel.
+    dw2 = jnp.zeros_like(dw2_ref)
+    pad2 = jnp.pad(da2b.reshape(bt, th, w, cmid),
+                   ((0, 0), (2, 2), (1, 1), (0, 0)))
+    dh1 = jnp.zeros((bt * th2 * w, cmid), f32)
+    for dy in range(3):
+        for dx in range(3):
+            h1s = pad1[:, dy:dy + th, dx:dx + w, :].reshape(-1, cmid)
+            dw2 = dw2.at[dy, dx].set(
+                jnp.dot(h1s.T, da2b, preferred_element_type=f32))
+            g2s = pad2[:, 2 - dy:2 - dy + th2, 2 - dx:2 - dx + w, :] \
+                .reshape(-1, cmid)
+            dh1 = dh1 + jnp.dot(g2s, w2_ref[dy, dx].T,
+                                preferred_element_type=f32)
+    acc_grad(dw2_ref, dw2)
+
+    # BN1 backward with halo: halo samples contribute to the sums and to
+    # dgamma/dbeta, the 1/N divisor is the interior count, and the
+    # stat-correction applies to interior rows only (jax.grad of the
+    # spec — see module docstring)
+    dz1 = jnp.where(y1 > 0, dh1 * emask, 0.0)
+    dg1 = jnp.sum(dz1 * xh1, axis=0)
+    db1 = jnp.sum(dz1, axis=0)
+    dxh1 = dz1 * g1_ref[...]
+    corr = (jnp.sum(dxh1, axis=0) / n_int
+            + xh1 * (jnp.sum(dxh1 * xh1, axis=0) / n_int))
+    da1 = s1 * (dxh1 - imask * corr)
+    da1b = da1.astype(dt)
+    acc_grad(dg1_ref, dg1)
+    acc_grad(db1_ref, db1)
+    acc_grad(dw1_ref, jnp.dot(xm.T, da1b, preferred_element_type=f32))
+    dx = jnp.dot(da1b, w1_ref[...].T, preferred_element_type=f32)
+    dx = dx.reshape(bt, th2, w, cin)
+
+    # residual path lands on interior rows only
+    if has_proj:
+        dap, dgp, dbp = gbn_bwd_int(gz, xhp, gp_ref[...], sp)
+        dapb = dap.astype(dt)
+        acc_grad(dgp_ref, dgp)
+        acc_grad(dbp_ref, dbp)
+        acc_grad(dwp_ref, jnp.dot(xi.T, dapb, preferred_element_type=f32))
+        dres = jnp.dot(dapb, wp_ref[...].T, preferred_element_type=f32)
+    else:
+        dres = gz
+
+        @pl.when(first)
+        def _():
+            dwp_ref[...] = jnp.zeros_like(dwp_ref)
+            dgp_ref[...] = jnp.zeros_like(dgp_ref)
+            dbp_ref[...] = jnp.zeros_like(dbp_ref)
+    dx = dx.at[:, 1:th + 1].add(dres.reshape(bt, th, w, cin))
+    dx_ref[...] = dx.astype(dt).reshape(1, bt, th2, w, cin)
+
+
+# -----------------------------------------------------------------------------
+# pallas_call plumbing + custom_vjp
+# -----------------------------------------------------------------------------
+
+def _full_spec(shape):
+    return pl.BlockSpec(shape, lambda t, s: (0,) * len(shape))
+
+
+def _pallas_fwd(x, weights, tile_bt, tile_h, eps):
+    n, h, w_, cin = x.shape
+    wlist, has_proj = _padded_weights(weights, x.dtype)
+    cmid = wlist[0].shape[-1]
+    cout = wlist[6].shape[-1]
+    t_count, s_count = n // tile_bt, h // tile_h
+    cp = wlist[9].shape[-1] if has_proj else 1
+
+    xs = make_strips(x, tile_h)         # (S, n, th+2, w, cin)
+    in_specs = [pl.BlockSpec((1, tile_bt, tile_h + 2, w_, cin),
+                             lambda t, s: (s, t, 0, 0, 0))]
+    in_specs += [_full_spec(wi.shape) for wi in wlist]
+    stat_shapes = [cmid, cmid, cmid, cmid, cout, cout, cp, cp]
+    out_shapes = [jax.ShapeDtypeStruct((s_count, n, tile_h, w_, cout),
+                                       x.dtype)] + \
+        [jax.ShapeDtypeStruct((c,), jnp.float32) for c in stat_shapes]
+    out_specs = [pl.BlockSpec((1, tile_bt, tile_h, w_, cout),
+                              lambda t, s: (s, t, 0, 0, 0))] + \
+        [_full_spec((c,)) for c in stat_shapes]
+
+    res = pl.pallas_call(
+        partial(_fwd_kernel, has_proj=has_proj, eps=eps,
+                inv_ghosts=1.0 / (t_count * s_count), s_count=s_count),
+        grid=(t_count, s_count),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=_interpret(),
+    )(xs, *wlist)
+    # (S, n, th, w, cout) -> (n, S*th = h, w, cout)
+    out = jnp.transpose(res[0], (1, 0, 2, 3, 4)).reshape(n, h, w_, cout)
+    return out, tuple(res[1:])
+
+
+def _pallas_bwd(x, g, weights, tile_bt, tile_h, eps):
+    n, h, w_, cin = x.shape
+    wlist, has_proj = _padded_weights(weights, x.dtype)
+    cmid = wlist[0].shape[-1]
+    cout = wlist[6].shape[-1]
+    t_count, s_count = n // tile_bt, h // tile_h
+    cp = wlist[9].shape[0] if has_proj else 1
+    cpo = wlist[9].shape[-1] if has_proj else 1
+
+    xs = make_strips(x, tile_h)
+    # (n, h, w, cout) -> (S, n, th, w, cout), interior rows only
+    gs = jnp.transpose(g.reshape(n, s_count, tile_h, w_, -1),
+                       (1, 0, 2, 3, 4))
+    in_specs = [pl.BlockSpec((1, tile_bt, tile_h + 2, w_, cin),
+                             lambda t, s: (s, t, 0, 0, 0)),
+                pl.BlockSpec((1, tile_bt, tile_h, w_, cout),
+                             lambda t, s: (s, t, 0, 0, 0))]
+    in_specs += [_full_spec(wi.shape) for wi in wlist]
+    f32 = jnp.float32
+    grad_shapes = [(cin, cmid), (cmid,), (cmid,),
+                   (3, 3, cmid, cmid), (cmid,), (cmid,),
+                   (cmid, cout), (cout,), (cout,),
+                   (cp, cpo), (cpo,), (cpo,)]
+    out_shapes = [jax.ShapeDtypeStruct(
+        (s_count, n, tile_h + 2, w_, cin), x.dtype)] + \
+        [jax.ShapeDtypeStruct(s, f32) for s in grad_shapes]
+    out_specs = [pl.BlockSpec((1, tile_bt, tile_h + 2, w_, cin),
+                              lambda t, s: (s, t, 0, 0, 0))] + \
+        [_full_spec(s) for s in grad_shapes]
+
+    res = pl.pallas_call(
+        partial(_bwd_kernel, has_proj=has_proj, eps=eps,
+                s_count=s_count),
+        grid=(t_count, s_count),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=_interpret(),
+    )(xs, gs, *wlist)
+    dx = combine_strips(res[0], h, tile_h)
+    grads = tuple(res[1:])
+    if not has_proj:
+        grads = grads[:9]
+    return dx, grads
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _fused(tile_bt, tile_h, eps, x, *weights):
+    out, stats = _pallas_fwd(x, weights, tile_bt, tile_h, eps)
+    return out, stats
+
+
+def _fused_fwd(tile_bt, tile_h, eps, x, *weights):
+    out, stats = _pallas_fwd(x, weights, tile_bt, tile_h, eps)
+    return (out, stats), (x, weights)
+
+
+def _fused_bwd(tile_bt, tile_h, eps, residuals, cts):
+    # the ghost-stats cotangent is deliberately dropped (EMA input is
+    # stop-gradient in flax's BatchNorm as well)
+    x, weights = residuals
+    dx, grads = _pallas_bwd(x, cts[0].astype(x.dtype), weights, tile_bt,
+                            tile_h, eps)
+    dweights = tuple(gi.astype(wi.dtype) for gi, wi in zip(grads, weights))
+    return (dx,) + dweights
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_bottleneck_train_spatial(x: jax.Array, params: dict, *,
+                                   tile_bt: int = 1,
+                                   tile_h: Optional[int] = None,
+                                   eps: float = 1e-5
+                                   ) -> tuple[jax.Array, dict]:
+    """The spatially-tiled fused ghost-BN training block:
+    (out, ghost_stats_tree). Stride-1 blocks only."""
+    weights = block_weights(params)
+    has_proj = len(weights) == 12
+    n, h, w_, cin = x.shape
+    cmid = weights[0].shape[-1]
+    cout = weights[6].shape[-1]
+    if not has_proj and cin != cout:
+        raise ValueError(f"Cin {cin} != Cout {cout} needs a projection")
+    if n % tile_bt:
+        raise ValueError(f"tile_bt {tile_bt} must divide batch {n}")
+    if tile_h is None:
+        tile_h = default_tile_h(h, w_, cin, cmid, cout)
+        if tile_h is None:
+            raise ValueError("no strip height fits the VMEM budget")
+    elif h % tile_h:
+        raise ValueError(f"tile_h {tile_h} must divide height {h}")
+    out, stats = _fused(tile_bt, tile_h, eps, x, *weights)
+    return out, stats_to_tree(stats, has_proj)

@@ -30,23 +30,30 @@ log = logging.getLogger(__name__)
 REGISTRY = "ghcr.io/kubeflow-tpu"
 
 # component → (source tree whose history defines the tag, manifests
-# module holding the pin, the PER-COMPONENT pin constant). The constant
-# is per component on purpose: rewriting the module-wide VERSION would
-# silently retag every other image that module builds to a commit of an
-# unrelated source tree.
-COMPONENT_SOURCES: dict[str, tuple[str, str, str]] = {
+# module holding the pin, the PER-COMPONENT pin constant, the image
+# names that pin tags). The constant is per component on purpose:
+# rewriting the module-wide VERSION would silently retag every other
+# image that module builds to a commit of an unrelated source tree. The
+# image list is what a consumer of the PR payload must BUILD at the new
+# tag — it names the images the manifests actually reference, not the
+# component key.
+COMPONENT_SOURCES: dict[str, tuple[str, str, str, tuple]] = {
     "jupyter-web-app": ("kubeflow_tpu/webapps",
                         "kubeflow_tpu/manifests/notebooks.py",
-                        "JUPYTER_WEB_APP_VERSION"),
+                        "JUPYTER_WEB_APP_VERSION",
+                        ("jupyter-web-app",)),
     "centraldashboard": ("kubeflow_tpu/webapps",
                          "kubeflow_tpu/manifests/core.py",
-                         "CENTRALDASHBOARD_VERSION"),
+                         "CENTRALDASHBOARD_VERSION",
+                         ("centraldashboard",)),
     "worker": ("kubeflow_tpu/runtime",
                "kubeflow_tpu/manifests/training.py",
-               "WORKER_VERSION"),
+               "WORKER_VERSION",
+               ("worker",)),
     "serving": ("kubeflow_tpu/serving",
                 "kubeflow_tpu/manifests/serving.py",
-                "MODEL_SERVER_VERSION"),
+                "MODEL_SERVER_VERSION",
+                ("tpu-model-server", "serving-http-proxy")),
 }
 
 
@@ -90,7 +97,7 @@ def component_commit(repo_root: str, source_path: str,
 @dataclass
 class UpdateResult:
     component: str
-    image: str
+    images: list            # full refs the PR consumer must build+push
     old_tag: str
     new_tag: str
     changed: bool
@@ -111,9 +118,10 @@ def update_component(repo_root: str, component: str,
     if component not in COMPONENT_SOURCES:
         raise KeyError(f"unknown component {component!r}; known: "
                        f"{sorted(COMPONENT_SOURCES)}")
-    source_path, pin_file, pin_name = COMPONENT_SOURCES[component]
+    source_path, pin_file, pin_name, image_names = \
+        COMPONENT_SOURCES[component]
     tag = component_commit(repo_root, source_path, run=run)
-    image = f"{registry}/{component}:{tag}"
+    images = [f"{registry}/{name}:{tag}" for name in image_names]
 
     pin_path = os.path.join(repo_root, pin_file)
     with open(pin_path) as f:
@@ -121,7 +129,7 @@ def update_component(repo_root: str, component: str,
     new_lines, old_tag = replace_version(lines, tag, pin=pin_name)
     if old_tag == tag:
         log.info("%s already pinned to %s", component, tag)
-        return UpdateResult(component=component, image=image,
+        return UpdateResult(component=component, images=images,
                             old_tag=old_tag, new_tag=tag, changed=False)
 
     # atomic rewrite, the reference bot's tmp+rename
@@ -142,16 +150,17 @@ def update_component(repo_root: str, component: str,
     branch = f"update-{component}-{tag}"
     title = f"Update {component} image to {tag}"
     body = (f"Automated image pin update.\n\n"
-            f"* image: `{image}`\n"
-            f"* previous tag: `{old_tag}`\n"
+            + "".join(f"* build+push: `{i}`\n" for i in images)
+            + f"* previous tag: `{old_tag}`\n"
             f"* source: last commit touching `{source_path}`\n")
     if commit:
         run(["git", "checkout", "-b", branch], cwd=repo_root)
         run(["git", "add", *files], cwd=repo_root)
         run(["git", "commit", "-m", title], cwd=repo_root)
-    return UpdateResult(component=component, image=image, old_tag=old_tag,
-                        new_tag=tag, changed=True, branch=branch,
-                        pr_title=title, pr_body=body, files=files)
+    return UpdateResult(component=component, images=images,
+                        old_tag=old_tag, new_tag=tag, changed=True,
+                        branch=branch, pr_title=title, pr_body=body,
+                        files=files)
 
 
 def main(argv: Optional[list[str]] = None) -> int:

@@ -68,7 +68,8 @@ class TestUpdateComponent:
         assert result.changed
         assert result.new_tag == tag
         assert result.old_tag == "v0.1.0"
-        assert result.image == f"ghcr.io/kubeflow-tpu/jupyter-web-app:{tag}"
+        assert result.images == \
+            [f"ghcr.io/kubeflow-tpu/jupyter-web-app:{tag}"]
         # pin rewritten on a new branch with one commit; the module-wide
         # VERSION (other images) is untouched
         assert git(repo, "rev-parse", "--abbrev-ref", "HEAD") == \
@@ -79,7 +80,7 @@ class TestUpdateComponent:
         assert f'JUPYTER_WEB_APP_VERSION = "{tag}"' in content
         assert 'VERSION = "v0.1.0"' in content
         assert git(repo, "log", "-n", "1", "--pretty=%s") == result.pr_title
-        assert result.image in result.pr_body
+        assert result.images[0] in result.pr_body
 
     def test_idempotent_when_pinned(self, repo):
         update_component(repo, "jupyter-web-app")
@@ -96,9 +97,15 @@ class TestUpdateComponent:
     def test_source_map_paths_and_pins_exist(self):
         repo_root = os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))
-        for src, pin, pin_name in COMPONENT_SOURCES.values():
+        for src, pin, pin_name, image_names in COMPONENT_SOURCES.values():
             assert os.path.exists(os.path.join(repo_root, src)), src
             pin_path = os.path.join(repo_root, pin)
             assert os.path.exists(pin_path), pin
             with open(pin_path) as f:
-                assert f'{pin_name} = "' in f.read(), pin_name
+                content = f.read()
+            assert f'{pin_name} = "' in content, pin_name
+            # every advertised image is actually tagged by that pin in
+            # the manifests module (the PR payload must name images the
+            # deployments reference, not the component key)
+            for name in image_names:
+                assert f"{name}:{{{pin_name}}}" in content, name

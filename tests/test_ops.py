@@ -328,6 +328,92 @@ class TestFusedBlockTrain:
         # structure matches flax's exactly (checkpoint compatibility)
         assert jax.tree.structure(old) == jax.tree.structure(new_stats)
 
+    @pytest.mark.parametrize("proj", [False, True])
+    def test_spatial_forward_and_stats_match_reference(self, proj):
+        import numpy as np
+        from kubeflow_tpu.ops.fused_block_train import block_weights
+        from kubeflow_tpu.ops.fused_block_train_spatial import (
+            fused_bottleneck_train_spatial,
+            reference_bottleneck_train_spatial)
+        rng = np.random.default_rng(4)
+        cin = 16 if proj else 32
+        p = self._params(rng, cin, 8, 32, proj)
+        x = jnp.asarray(rng.normal(0, 1, (4, 8, 8, cin)), jnp.float32)
+        out, stats = fused_bottleneck_train_spatial(x, p, tile_bt=2,
+                                                    tile_h=4)
+        ref_out, ref_stats = reference_bottleneck_train_spatial(
+            x, block_weights(p), tile_bt=2, tile_h=4)
+        np.testing.assert_allclose(out, ref_out, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(stats["BatchNorm_0"]["mean"],
+                                   ref_stats[0], rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(stats["BatchNorm_1"]["var"],
+                                   ref_stats[3], rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(stats["BatchNorm_2"]["var"],
+                                   ref_stats[5], rtol=1e-5, atol=1e-6)
+        if proj:
+            np.testing.assert_allclose(stats["norm_proj"]["mean"],
+                                       ref_stats[6], rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("proj", [False, True])
+    def test_spatial_backward_matches_jax_grad_of_reference(self, proj):
+        """The halo gradient path (seam rows feed TWO strips' conv2 and
+        the BN1 stat-correction of their owning strip only) must equal
+        jax.grad of the spec — the test that catches seam/mask bugs."""
+        import numpy as np
+        from kubeflow_tpu.ops.fused_block_train import block_weights
+        from kubeflow_tpu.ops.fused_block_train_spatial import (
+            _fused, reference_bottleneck_train_spatial)
+        rng = np.random.default_rng(5)
+        cin = 16 if proj else 32
+        p = self._params(rng, cin, 8, 32, proj)
+        w = block_weights(p)
+        x = jnp.asarray(rng.normal(0, 1, (2, 8, 8, cin)), jnp.float32)
+
+        def loss_k(x, *w):
+            o, _ = _fused(1, 4, 1e-5, x, *w)
+            return jnp.sum(jnp.sin(o))
+
+        def loss_r(x, *w):
+            o, _ = reference_bottleneck_train_spatial(x, w, tile_bt=1,
+                                                      tile_h=4)
+            return jnp.sum(jnp.sin(o))
+
+        argnums = tuple(range(len(w) + 1))
+        gk = jax.grad(loss_k, argnums=argnums)(x, *w)
+        gr = jax.grad(loss_r, argnums=argnums)(x, *w)
+        for a, b in zip(gk, gr):
+            np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4)
+
+    def test_spatial_full_height_matches_batch_tiled(self):
+        """tile_h=h (one strip, zero halo rows in play) must reproduce
+        the batch-tiled kernel exactly — same ghost batches."""
+        import numpy as np
+        from kubeflow_tpu.ops.fused_block_train import (
+            fused_bottleneck_train)
+        from kubeflow_tpu.ops.fused_block_train_spatial import (
+            fused_bottleneck_train_spatial)
+        rng = np.random.default_rng(6)
+        p = self._params(rng, 32, 8, 32, proj=False)
+        x = jnp.asarray(rng.normal(0, 1, (4, 8, 8, 32)), jnp.float32)
+        out_s, stats_s = fused_bottleneck_train_spatial(
+            x, p, tile_bt=2, tile_h=8)
+        out_b, stats_b = fused_bottleneck_train(x, p, tile_bt=2)
+        np.testing.assert_allclose(out_s, out_b, rtol=1e-5, atol=1e-5)
+        for a, b in zip(jax.tree.leaves(stats_s),
+                        jax.tree.leaves(stats_b)):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    def test_spatial_default_tile_h_fits_flagship_stage1(self):
+        # the whole point: a strip height exists for the 56x56 stage-1
+        # geometry the batch-tiled kernel cannot fit
+        from kubeflow_tpu.ops.fused_block_train import fits_vmem_budget
+        from kubeflow_tpu.ops.fused_block_train_spatial import (
+            default_tile_h, fits_vmem_budget_spatial)
+        assert not fits_vmem_budget(56, 56, 256, 64, 256)
+        th = default_tile_h(56, 56, 256, 64, 256)
+        assert th is not None and 56 % th == 0
+        assert fits_vmem_budget_spatial(th, 56, 256, 64, 256)
+
     def test_fused_loss_close_to_flax_on_shared_params(self):
         """Ghost BN differs from batch BN but must stay in the same
         numeric neighborhood at init — a gross mismatch means a bug, not

@@ -355,6 +355,49 @@ def _xla_block_train(x, params, strides, dtype=jnp.bfloat16, eps=1e-5):
     return out, stats
 
 
+def _fused_route(h: int, w: int, cin: int, cmid: int,
+                 cout: int) -> tuple:
+    """Kernel choice for one stride-1 bottleneck: ("batch", None) when
+    one image's working set fits VMEM, ("spatial", tile_h) when a halo
+    strip does, ("xla", None) otherwise. The single source of truth for
+    fused_train_apply AND the bench artifact's routing report."""
+    from ..ops.fused_block_train import fits_vmem_budget
+    from ..ops.fused_block_train_spatial import default_tile_h
+    if fits_vmem_budget(h, w, cin, cmid, cout):
+        return ("batch", None)
+    th = default_tile_h(h, w, cin, cmid, cout)
+    return ("spatial", th) if th is not None else ("xla", None)
+
+
+def fused_block_routing(depth: int = 50,
+                        image_size: int = 224) -> dict[str, str]:
+    """block name → kernel route for the fused training path, derived
+    from the same geometry walk and decision function the apply uses —
+    what `bench.py` records so the artifact says what actually ran."""
+    if depth < 50:
+        raise ValueError("fused paths cover bottleneck depths (>= 50)")
+    routes = {}
+    h = image_size // 4          # conv_init stride 2 + maxpool stride 2
+    cin = 64
+    for i, n_blocks in enumerate(STAGE_SIZES[depth]):
+        cmid = 64 * 2 ** i
+        cout = cmid * 4
+        for j in range(n_blocks):
+            strides = 2 if i > 0 and j == 0 else 1
+            if strides == 2:
+                h //= 2
+            name = f"stage{i + 1}_block{j + 1}"
+            if strides != 1:
+                routes[name] = "xla-strided"
+            else:
+                kind, th = _fused_route(h, h, cin, cmid, cout)
+                routes[name] = {"batch": "fused-batch",
+                                "xla": "xla"}.get(
+                    kind, f"fused-spatial(th={th})")
+            cin = cout
+    return routes
+
+
 def fused_train_apply(variables: dict, images: jax.Array, *,
                       depth: int = 50, tile_bt=None,
                       dtype=jnp.bfloat16, eps: float = 1e-5,
@@ -373,10 +416,9 @@ def fused_train_apply(variables: dict, images: jax.Array, *,
                          "(>= 50); BasicBlock models have no Conv_2")
     from jax import lax
 
-    from ..ops.fused_block_train import (fits_vmem_budget,
-                                         fused_bottleneck_train)
+    from ..ops.fused_block_train import fused_bottleneck_train
     from ..ops.fused_block_train_spatial import (
-        default_tile_h, fused_bottleneck_train_spatial)
+        fused_bottleneck_train_spatial)
 
     params, stats = variables["params"], variables["batch_stats"]
     batch_moments: dict = {}
@@ -402,16 +444,19 @@ def fused_train_apply(variables: dict, images: jax.Array, *,
             # stride-1 blocks batch-tile when one image fits VMEM and
             # fall back to the spatially-tiled (halo) kernel for the
             # large early-stage geometries, XLA as the last resort
-            if strides != 1:
-                x, bstats = _xla_block_train(x, bp, strides,
-                                             dtype=dtype, eps=eps)
-            elif fits_vmem_budget(h, w_, cin, cmid, cout):
+            # (_fused_route is shared with fused_block_routing so the
+            # bench artifact reports exactly this decision)
+            kind, th = ("xla", None) if strides != 1 else \
+                _fused_route(h, w_, cin, cmid, cout)
+            if kind == "batch":
                 x, bstats = fused_bottleneck_train(x, bp, tile_bt=tile_bt,
                                                    eps=eps)
-            elif default_tile_h(h, w_, cin, cmid, cout) is not None:
-                x, bstats = fused_bottleneck_train_spatial(x, bp, eps=eps)
+            elif kind == "spatial":
+                x, bstats = fused_bottleneck_train_spatial(x, bp,
+                                                           tile_h=th,
+                                                           eps=eps)
             else:
-                x, bstats = _xla_block_train(x, bp, 1,
+                x, bstats = _xla_block_train(x, bp, strides,
                                              dtype=dtype, eps=eps)
             batch_moments[name] = bstats
 

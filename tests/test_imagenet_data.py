@@ -236,10 +236,11 @@ class TestBenchmarkMatrix:
             assert len(data) == 1
         assert rows["tf_job_simple"]["examples_per_sec"] > 0
         assert rows["katib_study"]["metric_best_learning_rate"] > 0
-        # the full matrix covers every BASELINE.json config
+        # the full matrix covers every BASELINE.json config, plus the
+        # opt-in fused-blocks variant row
         assert set(CONFIG_MATRIX) == {
             "tf_job_simple", "tf_job_dp_allreduce", "pytorch_ddp",
-            "mpi_horovod", "katib_study"}
+            "mpi_horovod", "tf_job_fused_blocks", "katib_study"}
 
 
 class TestNativeAugment:

@@ -414,6 +414,22 @@ class TestFusedBlockTrain:
         assert th is not None and 56 % th == 0
         assert fits_vmem_budget_spatial(th, 56, 256, 64, 256)
 
+    def test_fused_block_routing_covers_flagship(self):
+        # the routing report shares the decision fn with the apply: at
+        # 224px every stride-1 block is fused (spatial early, batch
+        # late); tiny images all batch-tile
+        from kubeflow_tpu.models.resnet import fused_block_routing
+        r = fused_block_routing(50, 224)
+        assert len(r) == 16
+        assert r["stage1_block1"].startswith("fused-spatial")
+        assert r["stage2_block2"].startswith("fused-spatial")
+        assert r["stage3_block2"] == "fused-batch"
+        assert r["stage4_block3"] == "fused-batch"
+        assert r["stage2_block1"] == "xla-strided"
+        assert not any(v == "xla" for v in r.values())
+        tiny = fused_block_routing(50, 64)
+        assert set(tiny.values()) == {"fused-batch", "xla-strided"}
+
     def test_fused_loss_close_to_flax_on_shared_params(self):
         """Ghost BN differs from batch BN but must stay in the same
         numeric neighborhood at init — a gross mismatch means a bug, not

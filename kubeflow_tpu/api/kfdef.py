@@ -89,6 +89,10 @@ class KfDefSpec:
     # named config overlay merged over components/params at generate time
     # (the kustomize-v2 base+overlay analog, manifests/overlays.py)
     flavor: str = ""
+    # on-disk config layout (base/ + overlays/<name>/config.yaml — the
+    # kustomize-v2 repo-walk analog); when set, the base supplies the
+    # component list and spec.flavor resolves against its overlays
+    config_dir: str = ""
     # TPU-specific platform defaults applied to every training component
     default_tpu_topology: str = "v5e-8"
     version: str = "0.1.0"
@@ -128,6 +132,7 @@ class KfDef:
                 "components": list(self.spec.components),
                 "componentParams": self.spec.component_params,
                 "flavor": self.spec.flavor,
+                "configDir": self.spec.config_dir,
                 "defaultTpuTopology": self.spec.default_tpu_topology,
                 "version": self.spec.version,
                 "repo": self.spec.repo,
@@ -157,9 +162,16 @@ class KfDef:
                 namespace=spec.get("namespace", "kubeflow"),
                 use_basic_auth=bool(spec.get("useBasicAuth", False)),
                 use_istio=bool(spec.get("useIstio", True)),
-                components=list(spec.get("components") or DEFAULT_COMPONENTS),
+                # absent → defaults; an EXPLICIT empty list persists (the
+                # --config-dir convention: the on-disk base supplies the
+                # list, so `or DEFAULT_COMPONENTS` would resurrect all
+                # ~23 defaults on every reload)
+                components=(list(spec["components"])
+                            if spec.get("components") is not None
+                            else list(DEFAULT_COMPONENTS)),
                 component_params=spec.get("componentParams", {}) or {},
                 flavor=spec.get("flavor", "") or "",
+                config_dir=spec.get("configDir", "") or "",
                 default_tpu_topology=spec.get("defaultTpuTopology", "v5e-8"),
                 version=spec.get("version", "0.1.0"),
                 repo=spec.get("repo", ""),

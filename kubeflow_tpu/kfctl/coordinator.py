@@ -96,7 +96,16 @@ class Coordinator:
 
     def effective_components(self) -> tuple[list[str], dict]:
         """Components + params with the spec's flavor overlay merged (the
-        kustomize-v2 MergeKustomization analog, manifests/overlays.py)."""
+        kustomize-v2 MergeKustomization analog, manifests/overlays.py).
+        With spec.configDir set, the on-disk layout's base supplies the
+        component list and its overlays the flavors (the repo walk,
+        kustomize.go:524-560)."""
+        if self.kfdef.spec.config_dir:
+            from ..manifests.overlays import resolve_config_dir
+            return resolve_config_dir(self.kfdef.spec.config_dir,
+                                      self.kfdef.spec.components,
+                                      self.kfdef.spec.component_params,
+                                      self.kfdef.spec.flavor)
         from ..manifests.overlays import resolve
         return resolve(self.kfdef.spec.components,
                        self.kfdef.spec.component_params,
@@ -220,7 +229,12 @@ def register_verbs(sub: argparse._SubParsersAction) -> None:
                         help="comma-separated override of the component list")
     p_init.add_argument("--flavor", default="",
                         help="named config overlay (local | iap | "
-                             "basic_auth) merged at generate time")
+                             "basic_auth, or an overlay from "
+                             "--config-dir) merged at generate time")
+    p_init.add_argument("--config-dir", default="",
+                        help="on-disk config layout (base/ + overlays/"
+                             "<name>/config.yaml); base supplies the "
+                             "component list, overlays become flavors")
     p_init.add_argument("--kubeconfig", default="",
                         help="target a real apiserver instead of the "
                              "persisted simulated cluster")
@@ -277,6 +291,12 @@ def _cmd_init(args) -> int:
                   flavor=args.flavor)
     if args.components:
         kwargs["components"] = [c.strip() for c in args.components.split(",")]
+    elif args.config_dir:
+        # the on-disk base supplies the list; don't double it with the
+        # built-in defaults
+        kwargs["components"] = []
+    if args.config_dir:
+        kwargs["config_dir"] = os.path.abspath(args.config_dir)
     if args.kubeconfig:
         kwargs["kubeconfig"] = os.path.abspath(args.kubeconfig)
     coord = Coordinator.new(args.app_dir, **kwargs)

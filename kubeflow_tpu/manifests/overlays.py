@@ -69,6 +69,90 @@ def flavor_names() -> list[str]:
     return sorted(FLAVORS)
 
 
+# -- on-disk config layouts (the kustomize-v2 repo walk) ---------------------
+
+def walk_config_dir(root: str) -> tuple[Flavor, dict[str, Flavor]]:
+    """Walk a config layout on disk (the reference's
+    bootstrap/config/{base,overlays/*} shape; kustomize.go:524-560
+    mapDirs walks the manifests repo for kustomization leaves the same
+    way). Returns (base, overlays):
+
+        <root>/base/config.yaml              components, componentParams
+        <root>/overlays/<name>/config.yaml   componentsAdd/Remove,
+                                             componentParams, description
+
+    Overlay names may nest (overlays/gcp/iap → "gcp/iap"). A missing
+    base directory is an error; an empty overlays tree is fine."""
+    import os
+
+    from ..utils import yamlio
+
+    def read(path: str) -> dict:
+        return yamlio.load_file(path) or {}
+
+    base_path = os.path.join(root, "base", "config.yaml")
+    if not os.path.exists(base_path):
+        raise FileNotFoundError(
+            f"config dir {root!r} has no base/config.yaml")
+    raw = read(base_path)
+    base = Flavor(name="", description=str(raw.get("description", "")),
+                  components_add=tuple(raw.get("components") or ()),
+                  component_params=dict(raw.get("componentParams") or {}))
+
+    overlays: dict[str, Flavor] = {}
+    overlays_root = os.path.join(root, "overlays")
+    if os.path.isdir(overlays_root):
+        for dirpath, _dirnames, filenames in os.walk(overlays_root):
+            if "config.yaml" not in filenames:
+                continue
+            name = os.path.relpath(dirpath, overlays_root).replace(
+                os.sep, "/")
+            raw = read(os.path.join(dirpath, "config.yaml"))
+            overlays[name] = Flavor(
+                name=name,
+                description=str(raw.get("description", "")),
+                components_add=tuple(raw.get("componentsAdd") or ()),
+                components_remove=tuple(raw.get("componentsRemove") or ()),
+                component_params=dict(raw.get("componentParams") or {}))
+    return base, overlays
+
+
+def resolve_config_dir(root: str, components: list[str],
+                       component_params: dict, flavor: str = ""
+                       ) -> tuple[list[str], dict]:
+    """Resolve (components, params) from an on-disk config layout: the
+    base config supplies the component list, the named overlay merges
+    over it (MergeKustomization), and the caller's spec components /
+    params merge last (the more specific layer wins — user > overlay >
+    base). Unknown overlay names fall back to the built-in FLAVORS."""
+    base, overlays = walk_config_dir(root)
+    out_components = list(base.components_add)
+    out_params = {k: dict(v) for k, v in base.component_params.items()}
+
+    if flavor:
+        if flavor in overlays:
+            f = overlays[flavor]
+        elif flavor in FLAVORS:
+            f = FLAVORS[flavor]
+        else:
+            known = sorted(set(overlays) | set(FLAVORS))
+            raise KeyError(f"unknown flavor {flavor!r}; known: {known}")
+        out_components = [c for c in out_components
+                          if c not in f.components_remove]
+        for c in f.components_add:
+            if c not in out_components:
+                out_components.append(c)
+        for comp, params in f.component_params.items():
+            out_params.setdefault(comp, {}).update(params)
+
+    for c in components:
+        if c not in out_components:
+            out_components.append(c)
+    for comp, params in component_params.items():
+        out_params.setdefault(comp, {}).update(params)  # user params win
+    return out_components, out_params
+
+
 def resolve(components: list[str],
             component_params: dict[str, dict[str, Any]],
             flavor: str = "") -> tuple[list[str], dict[str, dict[str, Any]]]:

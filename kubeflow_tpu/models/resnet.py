@@ -371,13 +371,21 @@ def _fused_route(h: int, w: int, cin: int, cmid: int,
 
 def fused_block_routing(depth: int = 50,
                         image_size: int = 224) -> dict[str, str]:
-    """block name → kernel route for the fused training path, derived
-    from the same geometry walk and decision function the apply uses —
-    what `bench.py` records so the artifact says what actually ran."""
+    """block name → kernel route for the fused training path: the same
+    decision function the apply executes (_fused_route), over the same
+    geometry — SAME-padding ceil division for every stride-2 hop, widths
+    from the fixed make_resnet family (64·2^stage, the shapes the
+    params' Conv kernels carry) — what `bench.py` records so the
+    artifact says what actually ran. Pinned against the apply's real
+    tensor shapes in tests/test_ops.py."""
     if depth < 50:
         raise ValueError("fused paths cover bottleneck depths (>= 50)")
+
+    def ceil_half(n: int) -> int:     # SAME conv/pool, stride 2
+        return -(-n // 2)
+
     routes = {}
-    h = image_size // 4          # conv_init stride 2 + maxpool stride 2
+    h = ceil_half(ceil_half(image_size))   # conv_init s2 + maxpool s2
     cin = 64
     for i, n_blocks in enumerate(STAGE_SIZES[depth]):
         cmid = 64 * 2 ** i
@@ -385,7 +393,7 @@ def fused_block_routing(depth: int = 50,
         for j in range(n_blocks):
             strides = 2 if i > 0 and j == 0 else 1
             if strides == 2:
-                h //= 2
+                h = ceil_half(h)
             name = f"stage{i + 1}_block{j + 1}"
             if strides != 1:
                 routes[name] = "xla-strided"

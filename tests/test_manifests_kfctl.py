@@ -250,6 +250,75 @@ class TestCoordinator:
         with pytest.raises(KeyError, match="unknown flavor"):
             resolve(["istio"], {}, "nope")
 
+    def _write_config_dir(self, root):
+        os.makedirs(os.path.join(root, "base"))
+        os.makedirs(os.path.join(root, "overlays", "gcp", "iap"))
+        os.makedirs(os.path.join(root, "overlays", "monitoring"))
+        with open(os.path.join(root, "base", "config.yaml"), "w") as f:
+            f.write("components: [centraldashboard, echo-server]\n"
+                    "componentParams:\n  echo-server: {namespace: mon}\n")
+        with open(os.path.join(root, "overlays", "gcp", "iap",
+                               "config.yaml"), "w") as f:
+            f.write("description: IAP ingress\n"
+                    "componentsAdd: [iap-ingress]\n"
+                    "componentsRemove: [echo-server]\n"
+                    "componentParams:\n"
+                    "  iap-ingress: {hostname: kf.example.org}\n")
+        with open(os.path.join(root, "overlays", "monitoring",
+                               "config.yaml"), "w") as f:
+            f.write("componentsAdd: [prometheus]\n")
+
+    def test_config_dir_walk_and_merge(self, tmp_path):
+        """On-disk config layouts (bootstrap/config/{base,overlays/*}):
+        the walk discovers nested overlays (kustomize.go mapDirs) and
+        the merge is user > overlay > base."""
+        from kubeflow_tpu.manifests.overlays import (resolve_config_dir,
+                                                     walk_config_dir)
+        root = str(tmp_path / "config")
+        self._write_config_dir(root)
+        base, overlays = walk_config_dir(root)
+        assert base.components_add == ("centraldashboard", "echo-server")
+        assert set(overlays) == {"gcp/iap", "monitoring"}
+
+        comps, params = resolve_config_dir(
+            root, ["tensorboard"],
+            {"iap-ingress": {"hostname": "user.example.org"}},
+            flavor="gcp/iap")
+        assert comps == ["centraldashboard", "iap-ingress", "tensorboard"]
+        assert params["iap-ingress"]["hostname"] == "user.example.org"
+
+        # built-in flavors still resolve when the dir has no such overlay
+        comps2, _ = resolve_config_dir(root, [], {}, flavor="basic_auth")
+        assert "basic-auth-ingress" in comps2
+        with pytest.raises(KeyError, match="unknown flavor"):
+            resolve_config_dir(root, [], {}, flavor="nope")
+        with pytest.raises(FileNotFoundError, match="base/config.yaml"):
+            walk_config_dir(str(tmp_path / "missing"))
+
+    def test_config_dir_drives_generate(self, tmp_path):
+        # the full CLI path: base list renders, overlay flavor swaps it
+        root = str(tmp_path / "config")
+        self._write_config_dir(root)
+        app = str(tmp_path / "app")
+        coord = Coordinator.new(app, components=[], config_dir=root)
+        coord.init()
+        names = {os.path.basename(p) for p in coord.generate()}
+        assert names == {"centraldashboard.yaml", "echo-server.yaml"}
+        coord.kfdef.spec.flavor = "gcp/iap"
+        names2 = {os.path.basename(p) for p in coord.generate()}
+        assert "iap-ingress.yaml" in names2
+        assert "echo-server.yaml" not in names2
+        # persisted: a reloaded app keeps the config dir AND the
+        # explicit empty component list (a falsy-[] reload falling back
+        # to DEFAULT_COMPONENTS would resurrect ~23 components on top
+        # of the base)
+        coord3 = Coordinator.load(app)
+        assert coord3.kfdef.spec.config_dir == root
+        assert coord3.kfdef.spec.components == []
+        coord3.kfdef.spec.flavor = ""
+        names3 = {os.path.basename(p) for p in coord3.generate()}
+        assert names3 == {"centraldashboard.yaml", "echo-server.yaml"}
+
     def test_flavor_persisted_in_app_yaml(self, tmp_path):
         app = str(tmp_path / "app")
         coord = Coordinator.new(app, flavor="basic_auth")

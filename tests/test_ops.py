@@ -414,6 +414,41 @@ class TestFusedBlockTrain:
         assert th is not None and 56 % th == 0
         assert fits_vmem_budget_spatial(th, 56, 256, 64, 256)
 
+    def test_routing_geometry_matches_real_apply_shapes(self):
+        """The routing walk's per-block input geometry must equal the
+        real model's tensor shapes — at 112px the stage-4 height is
+        SAME-padding ceil(7/2)=4, where floor division would drift to 3
+        and report a route the apply never took."""
+        import jax
+        from kubeflow_tpu.models import resnet as R
+        model = R.resnet50(num_classes=10)
+
+        def f(x):
+            variables = model.init(jax.random.PRNGKey(0), x, train=False)
+            _, inter = model.apply(variables, x, train=False,
+                                   capture_intermediates=True,
+                                   mutable=["intermediates"])
+            return inter
+        shapes = jax.eval_shape(
+            f, jax.ShapeDtypeStruct((1, 112, 112, 3), jnp.float32))
+        blocks = shapes["intermediates"]
+
+        # replicate the walk's geometry and compare against the real
+        # block OUTPUT shapes (input of block j+1 = output of block j)
+        def ceil_half(n):
+            return -(-n // 2)
+        h = ceil_half(ceil_half(112))
+        from kubeflow_tpu.models.resnet import STAGE_SIZES
+        for i, n_blocks in enumerate(STAGE_SIZES[50]):
+            for j in range(n_blocks):
+                if i > 0 and j == 0:
+                    h = ceil_half(h)
+                name = f"stage{i + 1}_block{j + 1}"
+                real = blocks[name]["__call__"][0].shape
+                assert real[1] == h, (name, real, h)
+                assert real[3] == 64 * 2 ** i * 4, (name, real)
+        assert h == 4  # the ceil-division case floor would get wrong
+
     def test_fused_block_routing_covers_flagship(self):
         # the routing report shares the decision fn with the apply: at
         # 224px every stride-1 block is fused (spatial early, batch

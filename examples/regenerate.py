@@ -11,6 +11,9 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 
 EXAMPLES = [
     ("tpu-job-simple", "tpu-job-simple.yaml", {"topology": "v5e-32"}),
+    ("tpu-job-simple", "tpu-job-fused.yaml",
+     {"name": "tpu-job-fused", "topology": "v5e-32",
+      "fused_blocks": True}),
     ("tf-job-simple", "tf-job-simple.yaml", {}),
     ("tpu-serving-simple", "tpu-serving-simple.yaml", {}),
     ("katib-studyjob-example", "katib-studyjob-example.yaml", {}),

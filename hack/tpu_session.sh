@@ -1,0 +1,68 @@
+#!/bin/bash
+# TPU measurement session: run the full round-5 measurement list in
+# priority order the moment the axon tunnel answers (PERF.md round 5;
+# r4 verdict items 1/2/4/8). Each step has its own wall budget so one
+# wedged stage cannot eat the session. Artifacts land in
+# bench-results/ (JSON per step) + refreshed bench-matrix/ CSVs.
+#
+#   bash hack/tpu_session.sh [results_dir]
+#
+# Priority:
+#   0. probe (bounded) — abort early if the tunnel is dead
+#   1. resnet baseline re-confirmation        (north-star #1)
+#   2. resnet fused (first Mosaic compile of both kernel variants)
+#   3. transformer LM MFU                     (verdict item 2)
+#   4. serving data plane p50/p99             (verdict item 4)
+#   5. compile-cache warm start (cold vs warm resnet startup)
+#   6. kubebench matrix refresh               (verdict item 8)
+set -u
+cd "$(dirname "$0")/.." || exit 1
+RESULTS="${1:-bench-results}"
+mkdir -p "$RESULTS"
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+log() { echo "[tpu-session $(date -u +%T)] $*"; }
+
+log "probing backend (300s budget)"
+if ! timeout 300 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+  log "tunnel dead — aborting (nothing written)"
+  exit 1
+fi
+log "tunnel UP"
+
+run_step() {  # name, budget_s, cmd...
+  local name="$1" budget="$2"; shift 2
+  log "step $name (budget ${budget}s)"
+  if timeout "$budget" "$@" > "$RESULTS/$name-$STAMP.out" 2> \
+      "$RESULTS/$name-$STAMP.err"; then
+    grep -E '^\{' "$RESULTS/$name-$STAMP.out" | tail -1 \
+      > "$RESULTS/$name-$STAMP.json" || true
+    # a mid-session tunnel drop makes bench.py respawn its CPU-fallback
+    # child (exit 0, extras.error set): that is NOT a TPU measurement —
+    # abort instead of burning the remaining window on CPU numbers
+    if grep -q '"error": "tpu backend unreachable' \
+        "$RESULTS/$name-$STAMP.json" 2>/dev/null; then
+      log "step $name fell back to CPU (tunnel dropped mid-session) — aborting"
+      exit 2
+    fi
+    log "step $name OK: $(cut -c1-120 "$RESULTS/$name-$STAMP.json")"
+  else
+    log "step $name FAILED/timeout (see $RESULTS/$name-$STAMP.err)"
+  fi
+}
+
+run_step resnet   900 python bench.py --mode resnet
+run_step fused    1500 python bench.py --mode resnet-fused
+run_step lm       900 python bench.py --mode lm
+run_step serving  1200 python bench.py --mode serving
+
+# compile-cache warm start: cold vs warm startup_first_step_s
+CACHE=$(mktemp -d /tmp/kftpu-cache.XXXX)
+KFTPU_COMPILE_CACHE_DIR="$CACHE" run_step cache-cold 900 \
+  python bench.py --mode resnet
+KFTPU_COMPILE_CACHE_DIR="$CACHE" run_step cache-warm 900 \
+  python bench.py --mode resnet
+
+run_step matrix 1800 python -m kubeflow_tpu.workflows.kubebench matrix \
+  --out-dir bench-matrix --steps 40 --global-batch 128
+
+log "session done; artifacts in $RESULTS/ and bench-matrix/"

@@ -159,7 +159,16 @@ def openmpi_controller(namespace: str = "kubeflow") -> list[dict]:
                             "(examples/prototypes/tf-job-simple-v1.jsonnet analog)")
 def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
                    topology: str = "v5e-8", steps: int = 100,
-                   global_batch: int = 1024) -> list[dict]:
+                   global_batch: int = 1024,
+                   fused_blocks: bool = False) -> list[dict]:
+    """fused_blocks opts into the ghost-BN fused bottleneck kernels
+    (docs/training.md --fused-blocks; per-block batch/spatial routing)."""
+    command = ["python", "-m", "kubeflow_tpu.runtime.worker",
+               "--workload", "resnet50",
+               "--steps", str(steps),
+               "--global-batch", str(global_batch)]
+    if fused_blocks:
+        command.append("--fused-blocks")
     job = k8s.make(TPU_API_VERSION, "TPUJob", name, namespace)
     job["spec"] = {
         "replicaSpecs": {
@@ -168,10 +177,7 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
                 "template": {"spec": {"containers": [{
                     "name": "worker",
                     "image": f"{IMG}/worker:{WORKER_VERSION}",
-                    "command": ["python", "-m", "kubeflow_tpu.runtime.worker",
-                                "--workload", "resnet50",
-                                "--steps", str(steps),
-                                "--global-batch", str(global_batch)],
+                    "command": command,
                 }]}},
             },
         },

@@ -485,9 +485,10 @@ class TestFusedBlockTrain:
         ls, _ = std(params, variables, batch, jax.random.PRNGKey(2))
         assert abs(float(lf) - float(ls)) < 0.5
 
-    def test_fused_loss_shard_maps_over_data_axes(self):
-        """On a dp>1 mesh the apply runs inside shard_map (per-shard
-        ghost BN); grads flow and stats come back replicated."""
+    def _run_sharded_fused_step(self):
+        """One jitted value_and_grad of the fused loss under shard_map
+        on the full mesh; asserts loss/grad finiteness and the stats
+        tree shape. Shared by the plain and forced-spatial tests."""
         import numpy as np
         from kubeflow_tpu.models import resnet as R
         from kubeflow_tpu.parallel.mesh import build_mesh
@@ -512,6 +513,31 @@ class TestFusedBlockTrain:
         ns = aux["variables"]["batch_stats"]
         assert jax.tree.structure(ns) == \
             jax.tree.structure(variables["batch_stats"])
+
+    def test_fused_loss_shard_maps_over_data_axes(self):
+        """On a dp>1 mesh the apply runs inside shard_map (per-shard
+        ghost BN); grads flow and stats come back replicated."""
+        self._run_sharded_fused_step()
+
+    def test_spatial_kernel_inside_shard_map(self, monkeypatch):
+        """The composition the 224px --fused-blocks path runs on TPU:
+        the spatially-tiled kernel (2-D grid, strip relayout, overlap-add
+        backward) under shard_map over the data axes. Forced here by
+        shrinking the VMEM budget so the small test geometry routes
+        spatial exactly like the flagship stage-1 does."""
+        from kubeflow_tpu.models import resnet as R
+        from kubeflow_tpu.ops import fused_block_train as fbt
+        from kubeflow_tpu.ops import fused_block_train_spatial as fbts
+        # at 32px stage 1 runs 8x8 blocks (cin 64/256, cmid 64, cout
+        # 256): set the budget so the full image busts it but a th=4
+        # halo strip fits — the flagship stage-1 situation in miniature
+        budget = fbts._strip_bytes(4, 8, 256, 64, 256)
+        assert budget < fbt._per_image_bytes(8, 8, 64, 64, 256)
+        monkeypatch.setattr(fbt, "VMEM_BUDGET_BYTES", budget)
+        monkeypatch.setattr(fbts, "VMEM_BUDGET_BYTES", budget)
+        kind, th = R._fused_route(8, 8, 256, 64, 256)
+        assert (kind, th) == ("spatial", 4)
+        self._run_sharded_fused_step()
 
     def test_basicblock_depths_rejected(self):
         from kubeflow_tpu.models import resnet as R

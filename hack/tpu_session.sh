@@ -52,6 +52,13 @@ run_step() {  # name, budget_s, cmd...
 
 run_step resnet   900 python bench.py --mode resnet
 run_step fused    1500 python bench.py --mode resnet-fused
+if [ ! -s "$RESULTS/fused-$STAMP.json" ]; then
+  # first Mosaic compile of the spatial kernels may fail: retry with
+  # the spatial kill-switch so a stage-3/4-only fused number still lands
+  log "fused step produced no artifact — retrying with spatial disabled"
+  KFTPU_FUSED_DISABLE_SPATIAL=1 run_step fused-nospatial 1200 \
+    python bench.py --mode resnet-fused
+fi
 run_step lm       900 python bench.py --mode lm
 run_step serving  1200 python bench.py --mode serving
 

@@ -360,11 +360,21 @@ def _fused_route(h: int, w: int, cin: int, cmid: int,
     """Kernel choice for one stride-1 bottleneck: ("batch", None) when
     one image's working set fits VMEM, ("spatial", tile_h) when a halo
     strip does, ("xla", None) otherwise. The single source of truth for
-    fused_train_apply AND the bench artifact's routing report."""
+    fused_train_apply AND the bench artifact's routing report.
+
+    KFTPU_FUSED_DISABLE_SPATIAL=1 turns the spatial branch off (blocks
+    that don't batch-tile fall to XLA) — the kill-switch for a first
+    Mosaic compile of the spatial kernels going bad mid-measurement
+    (hack/tpu_session.sh retries the fused bench with it set)."""
+    import os
+
     from ..ops.fused_block_train import fits_vmem_budget
     from ..ops.fused_block_train_spatial import default_tile_h
     if fits_vmem_budget(h, w, cin, cmid, cout):
         return ("batch", None)
+    if os.environ.get("KFTPU_FUSED_DISABLE_SPATIAL", "").lower() in \
+            ("1", "true", "yes"):
+        return ("xla", None)
     th = default_tile_h(h, w, cin, cmid, cout)
     return ("spatial", th) if th is not None else ("xla", None)
 

@@ -365,27 +365,58 @@
     return VIEWS[name] ? name : "overview";
   }
 
-  async function render() {
+  // Views render into a DETACHED container that is swapped in only on
+  // success AND only if no newer render started meanwhile (generation
+  // token): a slow in-flight poll can never clobber a view the reader
+  // navigated away to, and background refreshes never blank the page
+  // (no Loading… flash, no scroll-to-top every poll).
+  let renderGen = 0;
+
+  async function renderInto(showLoading) {
     hideTooltip();
+    const gen = ++renderGen;
     const name = activeView();
     document.querySelectorAll("#sidebar a").forEach((a) => {
       a.classList.toggle("active", a.dataset.view === name);
     });
     const root = document.getElementById("view");
-    root.replaceChildren(el("p", { class: "empty", text: "Loading…" }));
-    try {
-      await VIEWS[name](root);
-    } catch (err) {
-      if (err.message !== "unauthenticated") {
-        root.replaceChildren(el("p", { class: "error", text: err.message }));
-      }
+    if (showLoading) {
+      root.replaceChildren(el("p", { class: "empty", text: "Loading…" }));
     }
+    const container = document.createElement("div");
+    try {
+      await VIEWS[name](container);
+    } catch (err) {
+      if (err.message === "unauthenticated") return;
+      if (!showLoading) return;   // keep last good content on poll errors
+      container.replaceChildren(
+        el("p", { class: "error", text: err.message }));
+    }
+    if (gen !== renderGen) return;   // a newer render superseded this one
+    root.replaceChildren(...container.childNodes);
+  }
+
+  const render = () => renderInto(true);
+
+  // live panels: runs/activities/overview re-render on a poll (the
+  // reference dashboard's behavior) — skipped while a tab is hidden or
+  // the reader is mid-interaction with a chart tooltip
+  const REFRESH_MS = 15000;
+  const LIVE_VIEWS = new Set(["overview", "runs", "activities"]);
+
+  function startAutoRefresh() {
+    setInterval(() => {
+      if (document.hidden) return;
+      if (tooltip && tooltip.style.display === "block") return;
+      if (LIVE_VIEWS.has(activeView())) renderInto(false);
+    }, REFRESH_MS);
   }
 
   async function main() {
     await renderNamespaceSelector();
     window.addEventListener("hashchange", render);
     await render();
+    startAutoRefresh();
   }
 
   document.readyState === "loading"

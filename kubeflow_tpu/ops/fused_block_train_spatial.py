@@ -7,12 +7,18 @@ halo tiling is the path back to the 35% cut").
 Tiling: the image's H rows split into strips of ``tile_h`` rows; each
 kernel instance processes (tile_bt images x one strip) with a 1-row halo
 on each side so the 3x3 conv is exact at strip seams (zero rows at image
-edges — SAME-conv semantics). Strips are pre-laid-out by XLA
-(``make_strips``) because BlockSpec index maps address in whole-block
-units and cannot express overlapping halo windows; the relayout costs
-one extra pass over x (+2/tile_h overhead) and the backward pays one
-overlap-add pass over dx (``combine_strips``) — both small next to the
-~4 interior HBM passes the fusion removes.
+edges — SAME-conv semantics). The halo is read WITHOUT any relayout
+pass: x is passed three times with different BlockSpecs — a 1-row "top"
+window at row ``max(s·th−1, 0)``, the ``th``-row body, and a 1-row
+"bottom" window at ``min((s+1)·th, h−1)`` — giving overlapping reads
+through non-overlapping block shapes (index maps address 1-row blocks).
+At image edges the clamped windows read a REAL row, which is harmless:
+the edge mask zeroes h1 there (forward) and the masked relu zeroes the
+gradient flowing through it (backward), reproducing SAME-conv zero
+padding exactly. The backward returns seam gradients as two THIN row
+arrays (S rows each) that XLA scatter-adds into dx — total layout
+overhead is a few rows, not whole-tensor passes. (``make_strips``
+remains as the executable spec's layout helper.)
 
 **Ghost-BN semantics (per batch x strip ghost):** statistics are
 computed over the strip's INTERIOR samples (tile_bt*tile_h*W per
@@ -43,7 +49,7 @@ from .fused_block_train import (VMEM_BUDGET_BYTES, _interpret,
 
 __all__ = ["fused_bottleneck_train_spatial",
            "reference_bottleneck_train_spatial", "default_tile_h",
-           "fits_vmem_budget_spatial", "make_strips", "combine_strips"]
+           "fits_vmem_budget_spatial", "make_strips"]
 
 
 def _strip_bytes(tile_h: int, w: int, cin: int, cmid: int,
@@ -80,18 +86,6 @@ def make_strips(x: jax.Array, tile_h: int) -> jax.Array:
     xp = jnp.pad(x, ((0, 0), (1, 1), (0, 0), (0, 0)))
     return jnp.stack([xp[:, s * tile_h:s * tile_h + tile_h + 2]
                       for s in range(s_count)])
-
-
-def combine_strips(dx_strips: jax.Array, h: int, tile_h: int) -> jax.Array:
-    """Overlap-add (S, n, tile_h+2, w, c) haloed strip gradients back to
-    (n, h, w, c) — seam rows receive both neighbors' halo contributions;
-    image-edge pad rows are dropped."""
-    s_count, n, _, w, c = dx_strips.shape
-    acc = jnp.zeros((n, h + 2, w, c), dx_strips.dtype)
-    for s in range(s_count):
-        acc = acc.at[:, s * tile_h:s * tile_h + tile_h + 2].add(
-            dx_strips[s])
-    return acc[:, 1:h + 1]
 
 
 # -----------------------------------------------------------------------------
@@ -191,13 +185,17 @@ def reference_bottleneck_train_spatial(x: jax.Array, weights: tuple, *,
 # forward kernel
 # -----------------------------------------------------------------------------
 
-def _fwd_kernel(x_ref, w1_ref, g1_ref, b1_ref, w2_ref, g2_ref, b2_ref,
-                w3_ref, g3_ref, b3_ref, wp_ref, gp_ref, bp_ref,
+def _fwd_kernel(xt_ref, xb_ref, xbot_ref, w1_ref, g1_ref, b1_ref,
+                w2_ref, g2_ref, b2_ref, w3_ref, g3_ref, b3_ref,
+                wp_ref, gp_ref, bp_ref,
                 o_ref, m1_ref, v1_ref, m2_ref, v2_ref, m3_ref, v3_ref,
                 mp_ref, vp_ref, *, has_proj: bool, eps: float,
                 inv_ghosts: float, s_count: int):
     f32 = jnp.float32
-    xt = x_ref[0]                       # (bt, th+2, w, cin)
+    # haloed strip assembled from the three windows (top row, body,
+    # bottom row — overlapping READS via per-row block indices)
+    xt = jnp.concatenate([xt_ref[...], xb_ref[...], xbot_ref[...]],
+                         axis=1)        # (bt, th+2, w, cin)
     bt, th2, w, cin = xt.shape
     th = th2 - 2
     dt = xt.dtype
@@ -263,7 +261,7 @@ def _fwd_kernel(x_ref, w1_ref, g1_ref, b1_ref, w2_ref, g2_ref, b2_ref,
         def _():
             mp_ref[...] = jnp.zeros_like(mp_ref)
             vp_ref[...] = jnp.zeros_like(vp_ref)
-    o_ref[...] = jax.nn.relu(y3 + r).astype(dt).reshape(1, bt, th, w, -1)
+    o_ref[...] = jax.nn.relu(y3 + r).astype(dt).reshape(bt, th, w, -1)
     acc_stat(m1_ref, m1)
     acc_stat(v1_ref, v1)
     acc_stat(m2_ref, m2)
@@ -276,18 +274,21 @@ def _fwd_kernel(x_ref, w1_ref, g1_ref, b1_ref, w2_ref, g2_ref, b2_ref,
 # backward kernel
 # -----------------------------------------------------------------------------
 
-def _bwd_kernel(x_ref, g_ref, w1_ref, g1_ref, b1_ref, w2_ref, g2_ref,
-                b2_ref, w3_ref, g3_ref, b3_ref, wp_ref, gp_ref, bp_ref,
-                dx_ref, dw1_ref, dg1_ref, db1_ref, dw2_ref, dg2_ref,
-                db2_ref, dw3_ref, dg3_ref, db3_ref, dwp_ref, dgp_ref,
-                dbp_ref, *, has_proj: bool, eps: float, s_count: int):
+def _bwd_kernel(xt_ref, xb_ref, xbot_ref, g_ref, w1_ref, g1_ref, b1_ref,
+                w2_ref, g2_ref, b2_ref, w3_ref, g3_ref, b3_ref,
+                wp_ref, gp_ref, bp_ref,
+                dx_ref, dxt_ref, dxbot_ref, dw1_ref, dg1_ref, db1_ref,
+                dw2_ref, dg2_ref, db2_ref, dw3_ref, dg3_ref, db3_ref,
+                dwp_ref, dgp_ref, dbp_ref, *, has_proj: bool, eps: float,
+                s_count: int):
     f32 = jnp.float32
-    xt = x_ref[0]                       # (bt, th+2, w, cin)
+    xt = jnp.concatenate([xt_ref[...], xb_ref[...], xbot_ref[...]],
+                         axis=1)        # (bt, th+2, w, cin)
     bt, th2, w, cin = xt.shape
     th = th2 - 2
     dt = xt.dtype
     xm = xt.reshape(-1, cin)
-    gout = g_ref[0].reshape(bt * th * w, -1)
+    gout = g_ref[...].reshape(bt * th * w, -1)
     n_int = f32(bt * th * w)
 
     s_id = pl.program_id(1)
@@ -430,7 +431,12 @@ def _bwd_kernel(x_ref, g_ref, w1_ref, g1_ref, b1_ref, w2_ref, g2_ref,
             dgp_ref[...] = jnp.zeros_like(dgp_ref)
             dbp_ref[...] = jnp.zeros_like(dbp_ref)
     dx = dx.at[:, 1:th + 1].add(dres.reshape(bt, th, w, cin))
-    dx_ref[...] = dx.astype(dt).reshape(1, bt, th2, w, cin)
+    dx = dx.astype(dt)
+    # seam gradients go out as thin per-strip rows (XLA scatter-adds
+    # them into the neighbor rows); the body writes straight into dx
+    dxt_ref[...] = dx[:, :1]
+    dx_ref[...] = dx[:, 1:th + 1]
+    dxbot_ref[...] = dx[:, th + 1:]
 
 
 # -----------------------------------------------------------------------------
@@ -441,6 +447,22 @@ def _full_spec(shape):
     return pl.BlockSpec(shape, lambda t, s: (0,) * len(shape))
 
 
+def _x_window_specs(tile_bt, tile_h, w_, cin, h):
+    """The three overlapping read windows of x: 1-row top halo at
+    max(s·th−1, 0), th-row body at s·th, 1-row bottom halo at
+    min((s+1)·th, h−1). Clamped indices read a real row at image edges;
+    the kernels' edge masks make its content irrelevant."""
+    top = pl.BlockSpec(
+        (tile_bt, 1, w_, cin),
+        lambda t, s: (t, jnp.maximum(s * tile_h - 1, 0), 0, 0))
+    body = pl.BlockSpec((tile_bt, tile_h, w_, cin),
+                        lambda t, s: (t, s, 0, 0))
+    bot = pl.BlockSpec(
+        (tile_bt, 1, w_, cin),
+        lambda t, s: (t, jnp.minimum((s + 1) * tile_h, h - 1), 0, 0))
+    return [top, body, bot]
+
+
 def _pallas_fwd(x, weights, tile_bt, tile_h, eps):
     n, h, w_, cin = x.shape
     wlist, has_proj = _padded_weights(weights, x.dtype)
@@ -449,16 +471,13 @@ def _pallas_fwd(x, weights, tile_bt, tile_h, eps):
     t_count, s_count = n // tile_bt, h // tile_h
     cp = wlist[9].shape[-1] if has_proj else 1
 
-    xs = make_strips(x, tile_h)         # (S, n, th+2, w, cin)
-    in_specs = [pl.BlockSpec((1, tile_bt, tile_h + 2, w_, cin),
-                             lambda t, s: (s, t, 0, 0, 0))]
+    in_specs = _x_window_specs(tile_bt, tile_h, w_, cin, h)
     in_specs += [_full_spec(wi.shape) for wi in wlist]
     stat_shapes = [cmid, cmid, cmid, cmid, cout, cout, cp, cp]
-    out_shapes = [jax.ShapeDtypeStruct((s_count, n, tile_h, w_, cout),
-                                       x.dtype)] + \
+    out_shapes = [jax.ShapeDtypeStruct((n, h, w_, cout), x.dtype)] + \
         [jax.ShapeDtypeStruct((c,), jnp.float32) for c in stat_shapes]
-    out_specs = [pl.BlockSpec((1, tile_bt, tile_h, w_, cout),
-                              lambda t, s: (s, t, 0, 0, 0))] + \
+    out_specs = [pl.BlockSpec((tile_bt, tile_h, w_, cout),
+                              lambda t, s: (t, s, 0, 0))] + \
         [_full_spec((c,)) for c in stat_shapes]
 
     res = pl.pallas_call(
@@ -469,10 +488,8 @@ def _pallas_fwd(x, weights, tile_bt, tile_h, eps):
         out_specs=out_specs,
         out_shape=out_shapes,
         interpret=_interpret(),
-    )(xs, *wlist)
-    # (S, n, th, w, cout) -> (n, S*th = h, w, cout)
-    out = jnp.transpose(res[0], (1, 0, 2, 3, 4)).reshape(n, h, w_, cout)
-    return out, tuple(res[1:])
+    )(x, x, x, *wlist)
+    return res[0], tuple(res[1:])
 
 
 def _pallas_bwd(x, g, weights, tile_bt, tile_h, eps):
@@ -484,25 +501,27 @@ def _pallas_bwd(x, g, weights, tile_bt, tile_h, eps):
     cp = wlist[9].shape[0] if has_proj else 1
     cpo = wlist[9].shape[-1] if has_proj else 1
 
-    xs = make_strips(x, tile_h)
-    # (n, h, w, cout) -> (S, n, th, w, cout), interior rows only
-    gs = jnp.transpose(g.reshape(n, s_count, tile_h, w_, -1),
-                       (1, 0, 2, 3, 4))
-    in_specs = [pl.BlockSpec((1, tile_bt, tile_h + 2, w_, cin),
-                             lambda t, s: (s, t, 0, 0, 0)),
-                pl.BlockSpec((1, tile_bt, tile_h, w_, cout),
-                             lambda t, s: (s, t, 0, 0, 0))]
+    in_specs = _x_window_specs(tile_bt, tile_h, w_, cin, h)
+    in_specs += [pl.BlockSpec((tile_bt, tile_h, w_, cout),
+                              lambda t, s: (t, s, 0, 0))]
     in_specs += [_full_spec(wi.shape) for wi in wlist]
     f32 = jnp.float32
     grad_shapes = [(cin, cmid), (cmid,), (cmid,),
                    (3, 3, cmid, cmid), (cmid,), (cmid,),
                    (cmid, cout), (cout,), (cout,),
                    (cp, cpo), (cpo,), (cpo,)]
-    out_shapes = [jax.ShapeDtypeStruct(
-        (s_count, n, tile_h + 2, w_, cin), x.dtype)] + \
+    # dx body writes straight into (n, h, w, cin); the two seam-row
+    # contributions come back as thin (n, S, w, cin) arrays
+    out_shapes = [jax.ShapeDtypeStruct((n, h, w_, cin), x.dtype),
+                  jax.ShapeDtypeStruct((n, s_count, w_, cin), x.dtype),
+                  jax.ShapeDtypeStruct((n, s_count, w_, cin), x.dtype)] + \
         [jax.ShapeDtypeStruct(s, f32) for s in grad_shapes]
-    out_specs = [pl.BlockSpec((1, tile_bt, tile_h + 2, w_, cin),
-                              lambda t, s: (s, t, 0, 0, 0))] + \
+    out_specs = [pl.BlockSpec((tile_bt, tile_h, w_, cin),
+                              lambda t, s: (t, s, 0, 0)),
+                 pl.BlockSpec((tile_bt, 1, w_, cin),
+                              lambda t, s: (t, s, 0, 0)),
+                 pl.BlockSpec((tile_bt, 1, w_, cin),
+                              lambda t, s: (t, s, 0, 0))] + \
         [_full_spec(s) for s in grad_shapes]
 
     res = pl.pallas_call(
@@ -513,9 +532,16 @@ def _pallas_bwd(x, g, weights, tile_bt, tile_h, eps):
         out_specs=out_specs,
         out_shape=out_shapes,
         interpret=_interpret(),
-    )(xs, gs, *wlist)
-    dx = combine_strips(res[0], h, tile_h)
-    grads = tuple(res[1:])
+    )(x, x, x, g, *wlist)
+    dx, dx_top, dx_bot = res[0], res[1], res[2]
+    # scatter the seam rows into the neighbor strips: strip s's top halo
+    # is global row s·th−1 (s ≥ 1), its bottom halo row (s+1)·th
+    # (s ≤ S−2); the image-edge contributions are zero by the masks
+    if s_count > 1:
+        th = tile_h
+        dx = dx.at[:, th - 1:h - 1:th].add(dx_top[:, 1:])
+        dx = dx.at[:, th:h:th].add(dx_bot[:, :-1])
+    grads = tuple(res[3:])
     if not has_proj:
         grads = grads[:9]
     return dx, grads

@@ -196,11 +196,17 @@ def bench_resnet(fused: bool = False, t_start: float | None = None) -> dict:
     }
 
 
-def bench_lm(t_start: float | None = None) -> dict:
+def bench_lm(t_start: float | None = None,
+             long_context: bool = False) -> dict:
     """Transformer-LM training throughput: tokens/sec + MFU (bf16, flash
     attention, chip-filling batch). The compute-bound companion to the
     memory-bound ResNet number — its MFU is the honest utilization
-    figure for the LLM parallelism stack (VERDICT r3 item 3)."""
+    figure for the LLM parallelism stack (VERDICT r3 item 3).
+
+    ``long_context`` stretches the sequence 8x at constant tokens/step
+    (seq 8192 x batch 4 on TPU) — the single-chip vehicle for the flash
+    kernel's long-sequence scaling (multi-chip ring attention is the
+    dryrun's job; one chip has no sequence axis to shard)."""
     import jax
     import optax
 
@@ -218,11 +224,19 @@ def bench_lm(t_start: float | None = None) -> dict:
         # breaching v5e HBM
         cfg = T.TransformerConfig(
             vocab_size=32000, num_layers=12, embed_dim=1024, num_heads=16,
-            head_dim=64, mlp_dim=4096, max_seq_len=1024, attention="flash")
-        seq_len, batch_per_chip, steps, warmup = 1024, 32, 20, 3
+            head_dim=64, mlp_dim=4096,
+            max_seq_len=8192 if long_context else 1024,
+            attention="flash")
+        seq_len, batch_per_chip, steps, warmup = \
+            (8192, 4, 10, 2) if long_context else (1024, 32, 20, 3)
     else:
         cfg = T.TransformerConfig.tiny()
-        seq_len, batch_per_chip, steps, warmup = 128, 4, 3, 1
+        if long_context:
+            import dataclasses
+            cfg = dataclasses.replace(cfg, max_seq_len=512,
+                                      attention="flash")
+        seq_len, batch_per_chip, steps, warmup = \
+            (512, 1, 2, 1) if long_context else (128, 4, 3, 1)
     global_batch = batch_per_chip * n_chips
 
     spec = T.workload_spec(cfg, seq_len=seq_len)
@@ -251,7 +265,8 @@ def bench_lm(t_start: float | None = None) -> dict:
     flops_per_chip = tok_s_chip * flops_per_tok
     peak = detect_peak_tflops(dev)
     return {
-        "metric": "transformer_lm_train_throughput",
+        "metric": "transformer_lm_train_throughput" +
+                  ("_long" if long_context else ""),
         "value": round(tok_s_chip, 0),
         "unit": "tokens/sec/chip",
         "vs_baseline": None,   # first measured LM line IS the baseline
@@ -429,7 +444,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--mode", default="all",
                    choices=["all", "resnet", "resnet-fused", "lm",
-                            "serving"])
+                            "lm-long", "serving"])
     args = p.parse_args(argv)
 
     # the fallback child carries this marker: never probe/respawn again
@@ -462,6 +477,8 @@ def main(argv=None) -> int:
         row = bench_resnet(fused=True, t_start=t_start)
     elif args.mode == "lm":
         row = bench_lm(t_start=t_start)
+    elif args.mode == "lm-long":
+        row = bench_lm(t_start=t_start, long_context=True)
     elif args.mode == "serving":
         row = bench_serving(t_start=t_start)
     else:
@@ -496,8 +513,11 @@ def main(argv=None) -> int:
         # interpret-mode Pallas kernels) is killed and recorded as an
         # error — it can never cost the headline line to a driver timeout
         in_process = {"resnet-fused": lambda: bench_resnet(fused=True),
-                      "lm": bench_lm, "serving": bench_serving}
+                      "lm": bench_lm,
+                      "lm-long": lambda: bench_lm(long_context=True),
+                      "serving": bench_serving}
         for key, mode in (("fused", "resnet-fused"), ("lm", "lm"),
+                          ("lm_long", "lm-long"),
                           ("serving", "serving")):
             try:
                 sub = in_process[mode]() if on_tpu else \

@@ -60,6 +60,7 @@ if [ ! -s "$RESULTS/fused-$STAMP.json" ]; then
     python bench.py --mode resnet-fused
 fi
 run_step lm       900 python bench.py --mode lm
+run_step lm-long  900 python bench.py --mode lm-long
 run_step serving  1200 python bench.py --mode serving
 
 # compile-cache warm start: cold vs warm startup_first_step_s

@@ -521,10 +521,10 @@ class TestFusedBlockTrain:
 
     def test_spatial_kernel_inside_shard_map(self, monkeypatch):
         """The composition the 224px --fused-blocks path runs on TPU:
-        the spatially-tiled kernel (2-D grid, strip relayout, overlap-add
-        backward) under shard_map over the data axes. Forced here by
-        shrinking the VMEM budget so the small test geometry routes
-        spatial exactly like the flagship stage-1 does."""
+        the spatially-tiled kernel (2-D grid, windowed halo reads, thin
+        seam-row gradient scatter) under shard_map over the data axes.
+        Forced here by shrinking the VMEM budget so the small test
+        geometry routes spatial exactly like the flagship stage-1."""
         from kubeflow_tpu.models import resnet as R
         from kubeflow_tpu.ops import fused_block_train as fbt
         from kubeflow_tpu.ops import fused_block_train_spatial as fbts

@@ -50,10 +50,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["fused_bottleneck_train", "reference_bottleneck_train",
            "block_weights", "stats_to_tree", "default_tile_bt",
-           "fits_vmem_budget", "VMEM_BUDGET_BYTES"]
+           "fits_vmem_budget", "VMEM_BUDGET_BYTES",
+           "SCOPED_VMEM_LIMIT_BYTES"]
 
 
 def _interpret() -> bool:
@@ -93,6 +95,20 @@ def stats_to_tree(stats: tuple, has_proj: bool) -> dict:
 
 
 VMEM_BUDGET_BYTES = 7 * 2 ** 20
+
+# Scoped-VMEM (kernel stack) ceiling for the fused kernels. The backward's
+# weight-grad temporaries + accumulator refs are ~fixed per kernel instance
+# — ~18.5 MB measured at stage-4 geometry (cmid=512) on first Mosaic
+# compile — so the default 16 MiB stack cap fails regardless of batch
+# tile. v5e has 128 MiB VMEM; granting 48 MiB of stack to these kernels
+# leaves ample room for block buffers. Passed per-kernel via Pallas
+# compiler_params (a process-wide XLA_FLAGS entry would fatal CPU-client
+# processes that don't know TPU flags).
+SCOPED_VMEM_LIMIT_BYTES = 48 * 1024 * 1024
+
+
+def _compiler_params():
+    return pltpu.CompilerParams(vmem_limit_bytes=SCOPED_VMEM_LIMIT_BYTES)
 
 
 def _per_image_bytes(h: int, w: int, cin: int, cmid: int, cout: int) -> int:
@@ -342,20 +358,23 @@ def _bwd_kernel(x_ref, g_ref, w1_ref, g1_ref, b1_ref, w2_ref, g2_ref,
 
     # conv3x3 transpose: wgrad reuses the forward's shifted h1 views;
     # dgrad uses the mirrored offsets (2-dy, 2-dx) on padded da2
-    dw2 = jnp.zeros_like(dw2_ref)
+    # each dw2 tap accumulates straight into its (dy,dx) sub-ref: a
+    # static-index .at[].set emits lax.scatter (unlowerable in Mosaic),
+    # and stacking all 9 taps keeps ~3x the full (3,3,cmid,cmid) f32
+    # live on the kernel stack — 28 MB at cmid=512, past the 16 MB
+    # scoped-VMEM limit (measured on first Mosaic compile)
     pad2 = jnp.pad(da2b.reshape(bt, h, w, cmid), ((0, 0), (1, 1), (1, 1),
                                                   (0, 0)))
     dh1 = jnp.zeros((bt * h * w, cmid), f32)
     for dy in range(3):
         for dx in range(3):
             h1s = pad1[:, dy:dy + h, dx:dx + w, :].reshape(-1, cmid)
-            dw2 = dw2.at[dy, dx].set(
-                jnp.dot(h1s.T, da2b, preferred_element_type=f32))
+            acc_grad(dw2_ref.at[dy, dx],
+                     jnp.dot(h1s.T, da2b, preferred_element_type=f32))
             g2s = pad2[:, 2 - dy:2 - dy + h, 2 - dx:2 - dx + w, :] \
                 .reshape(-1, cmid)
             dh1 = dh1 + jnp.dot(g2s, w2_ref[dy, dx].T,
                                 preferred_element_type=f32)
-    acc_grad(dw2_ref, dw2)
 
     # relu1 + BN1 + conv1 (1x1)
     dz1 = jnp.where(y1 > 0, dh1, 0.0)
@@ -432,6 +451,7 @@ def _pallas_fwd(x, weights, tile_bt, eps):
         out_specs=out_specs,
         out_shape=out_shapes,
         interpret=_interpret(),
+        compiler_params=_compiler_params(),
     )(x, *wlist)
     return res[0], tuple(res[1:])
 
@@ -465,6 +485,7 @@ def _pallas_bwd(x, g, weights, tile_bt, eps):
         out_specs=out_specs,
         out_shape=out_shapes,
         interpret=_interpret(),
+        compiler_params=_compiler_params(),
     )(x, g, *wlist)
     dx, grads = res[0], tuple(res[1:])
     if not has_proj:

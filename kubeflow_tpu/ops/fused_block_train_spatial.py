@@ -43,9 +43,10 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from .fused_block_train import (VMEM_BUDGET_BYTES, _interpret,
-                                _padded_weights, _per_image_bytes,
-                                block_weights, stats_to_tree)
+from .fused_block_train import (VMEM_BUDGET_BYTES, _compiler_params,
+                                _interpret, _padded_weights,
+                                _per_image_bytes, block_weights,
+                                stats_to_tree)
 
 __all__ = ["fused_bottleneck_train_spatial",
            "reference_bottleneck_train_spatial", "default_tile_h",
@@ -305,9 +306,11 @@ def _bwd_kernel(xt_ref, xb_ref, xbot_ref, g_ref, w1_ref, g1_ref, b1_ref,
         def _():
             ref[...] += val
 
-    # interior-row mask over the haloed sample axis, shape (M_halo, 1)
-    rows = jax.lax.broadcasted_iota(jnp.int32, (bt, th2, w), 1)
-    imask = ((rows >= 1) & (rows <= th)).reshape(-1, 1).astype(f32)
+    # interior-row mask over the haloed sample axis, shape (M_halo, 1).
+    # astype BEFORE reshape: Mosaic cannot reshape i1 (mask) vectors —
+    # first TPU compile failed on tpu.reshape of vector<...xi1>
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bt, th2, w, 1), 1)
+    imask = ((rows >= 1) & (rows <= th)).astype(f32).reshape(-1, 1)
 
     def gbn_bwd_int(dy_, xh, g, s):
         # all samples ARE interior (BN2/BN3/proj): standard ghost-BN bwd
@@ -381,20 +384,22 @@ def _bwd_kernel(xt_ref, xb_ref, xbot_ref, g_ref, w1_ref, g1_ref, b1_ref,
     # so output row q maps to haloed h1 row r = q + dy. Cols pad (1,1):
     # the forward zero-padded columns exactly like the batch-tiled
     # kernel.
-    dw2 = jnp.zeros_like(dw2_ref)
+    # each dw2 tap accumulates straight into its (dy,dx) sub-ref: a
+    # static-index .at[].set emits lax.scatter (unlowerable in Mosaic),
+    # and stacking all 9 taps keeps ~3x the full (3,3,cmid,cmid) f32
+    # live on the kernel stack — past the 16 MB scoped-VMEM limit
     pad2 = jnp.pad(da2b.reshape(bt, th, w, cmid),
                    ((0, 0), (2, 2), (1, 1), (0, 0)))
     dh1 = jnp.zeros((bt * th2 * w, cmid), f32)
     for dy in range(3):
         for dx in range(3):
             h1s = pad1[:, dy:dy + th, dx:dx + w, :].reshape(-1, cmid)
-            dw2 = dw2.at[dy, dx].set(
-                jnp.dot(h1s.T, da2b, preferred_element_type=f32))
+            acc_grad(dw2_ref.at[dy, dx],
+                     jnp.dot(h1s.T, da2b, preferred_element_type=f32))
             g2s = pad2[:, 2 - dy:2 - dy + th2, 2 - dx:2 - dx + w, :] \
                 .reshape(-1, cmid)
             dh1 = dh1 + jnp.dot(g2s, w2_ref[dy, dx].T,
                                 preferred_element_type=f32)
-    acc_grad(dw2_ref, dw2)
 
     # BN1 backward with halo: halo samples contribute to the sums and to
     # dgamma/dbeta, the 1/N divisor is the interior count, and the
@@ -430,7 +435,9 @@ def _bwd_kernel(xt_ref, xb_ref, xbot_ref, g_ref, w1_ref, g1_ref, b1_ref,
             dwp_ref[...] = jnp.zeros_like(dwp_ref)
             dgp_ref[...] = jnp.zeros_like(dgp_ref)
             dbp_ref[...] = jnp.zeros_like(dbp_ref)
-    dx = dx.at[:, 1:th + 1].add(dres.reshape(bt, th, w, cin))
+    # pad, don't .at[slice].add — scatter-add is unlowerable in Mosaic
+    dx = dx + jnp.pad(dres.reshape(bt, th, w, cin),
+                      ((0, 0), (1, 1), (0, 0), (0, 0)))
     dx = dx.astype(dt)
     # seam gradients go out as thin per-strip rows (XLA scatter-adds
     # them into the neighbor rows); the body writes straight into dx
@@ -488,6 +495,7 @@ def _pallas_fwd(x, weights, tile_bt, tile_h, eps):
         out_specs=out_specs,
         out_shape=out_shapes,
         interpret=_interpret(),
+        compiler_params=_compiler_params(),
     )(x, x, x, *wlist)
     return res[0], tuple(res[1:])
 
@@ -532,6 +540,7 @@ def _pallas_bwd(x, g, weights, tile_bt, tile_h, eps):
         out_specs=out_specs,
         out_shape=out_shapes,
         interpret=_interpret(),
+        compiler_params=_compiler_params(),
     )(x, x, x, g, *wlist)
     dx, dx_top, dx_bot = res[0], res[1], res[2]
     # scatter the seam rows into the neighbor strips: strip s's top halo

@@ -221,10 +221,12 @@ def bench_lm(t_start: float | None = None,
     if on_tpu:
         # ~217M-param LM (GPT-2-medium width at half its depth); 32k
         # tokens/step fills the chip (seq 1024 x batch 32/chip) without
-        # breaching v5e HBM
+        # breaching v5e HBM. head_dim 128 = the TPU lane width: head_dim
+        # 64 lane-pads every attention buffer 2x (measured HBM OOM on
+        # first chip contact) and halves flash-kernel MXU utilization
         cfg = T.TransformerConfig(
-            vocab_size=32000, num_layers=12, embed_dim=1024, num_heads=16,
-            head_dim=64, mlp_dim=4096,
+            vocab_size=32000, num_layers=12, embed_dim=1024, num_heads=8,
+            head_dim=128, mlp_dim=4096,
             max_seq_len=8192 if long_context else 1024,
             attention="flash")
         seq_len, batch_per_chip, steps, warmup = \

@@ -113,11 +113,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     def _finish():
         l = jnp.maximum(l_ref[:], 1e-30)               # fully-masked rows
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[:, 0] + jnp.log(l[:, 0])
+        lse_ref[0] = m_ref[:] + jnp.log(l)
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
-    """q,k,v: [BH, S, D] → (o [BH,S,D], lse [BH,S])."""
+    """q,k,v: [BH, S, D] → (o [BH,S,D], lse [BH,S,1]).
+
+    lse rides with a trailing singleton so its block shape is
+    (block_q, 1) in the tiled dims — Mosaic requires the last two block
+    dims be (8,128)-divisible or equal to the array dims; a (1, block_q)
+    block on a [BH, S] array satisfies neither (first TPU compile)."""
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
     grid = (bh, seq_q // block_q, seq_k // block_k)
@@ -134,11 +139,11 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),     # acc
@@ -171,8 +176,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _compute():
         q = q_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0]                               # [bq, 1]
+        delta = delta_ref[0]                           # [bq, 1]
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
@@ -211,8 +216,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0].astype(jnp.float32)
         q = q_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0]                               # [bq, 1]
+        delta = delta_ref[0]                           # [bq, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
@@ -239,7 +244,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)            # [BH, S, 1]
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -250,8 +256,8 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -268,8 +274,8 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),

@@ -1,0 +1,12 @@
+#!/bin/bash
+# Probes the axon tunnel every 5 min; appends result to .tpu_attempts.log.
+while true; do
+  ts=$(date -u +%FT%TZ)
+  out=$(timeout 120 python -c "import jax; d=jax.devices()[0]; print(d.device_kind)" 2>/dev/null | tail -1)
+  if [ -n "$out" ] && [ "$out" != "cpu" ]; then
+    echo "$ts ALIVE $out" >> /root/repo/.tpu_attempts.log
+  else
+    echo "$ts dead (timeout/err)" >> /root/repo/.tpu_attempts.log
+  fi
+  sleep 300
+done

@@ -422,6 +422,122 @@ def bench_serving(t_start: float | None = None) -> dict:
     }
 
 
+def bench_fused_blocks(t_start: float | None = None,
+                       routing_out: str | None = None) -> dict:
+    """Per-block kernel attribution: for every distinct stride-1
+    bottleneck geometry in resnet50 the fused path covers, time ONE
+    block's train step (fwd+bwd via value_and_grad) under XLA vs the
+    routed fused kernel, pick the measured winner, and (on TPU) write
+    the winners as a routing table fused_train_apply consumes via
+    KFTPU_FUSED_ROUTING_TABLE. The round-5 silicon session measured the
+    end-to-end fused path at 0.53x XLA (PERF.md) — this mode answers
+    WHICH kernels lose (and whether any win) in one tunnel window."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models import resnet as R
+    from kubeflow_tpu.ops.fused_block_train import fused_bottleneck_train
+    from kubeflow_tpu.ops.fused_block_train_spatial import (
+        fused_bottleneck_train_spatial)
+
+    # the microbench REGENERATES the measured table, so it must route by
+    # the VMEM model, not by a previously-measured table — otherwise a
+    # stale "xla" entry is sticky forever (that geometry would never get
+    # a fused measurement again)
+    os.environ.pop("KFTPU_FUSED_ROUTING_TABLE", None)
+
+    t_start = time.perf_counter() if t_start is None else t_start
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        batch, image_size, iters, warmup = 128, 224, 30, 3
+    else:  # CPU smoke: tiny geometry, interpret-mode kernels
+        batch, image_size, iters, warmup = 2, 32, 2, 1
+
+    def time_block(fn, x, params) -> float:
+        """Median-of-iters seconds for loss+grads of one block step."""
+        def loss(p, xin):
+            out, _stats = fn(xin, p)
+            return jnp.mean(out.astype(jnp.float32))
+        g = jax.jit(jax.value_and_grad(loss, argnums=(0, 1)))
+        val, _ = g(params, x)
+        float(val)                       # compile + hard barrier
+        for _ in range(warmup):
+            val, _ = g(params, x)
+        float(val)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            val, _ = g(params, x)
+        float(val)
+        return (time.perf_counter() - t0) / iters
+
+    rows, routes = {}, {}
+    xla_total = best_total = 0.0
+    for geom in R.stride1_geometries(depth=50, image_size=image_size):
+        h, cin, cmid, cout = (geom["h"], geom["cin"], geom["cmid"],
+                              geom["cout"])
+        params = R.random_block_params(jax.random.PRNGKey(0), cin, cmid,
+                                       cout, geom["proj"])
+        x = jax.random.normal(jax.random.PRNGKey(1), (batch, h, h, cin),
+                              jnp.bfloat16)
+        xla_s = time_block(
+            lambda xin, p: R._xla_block_train(xin, p, 1), x, params)
+        kind, th = R._fused_route(h, h, cin, cmid, cout)
+        row = {"count": geom["count"], "route_model": kind +
+               (f":{th}" if th is not None else ""),
+               "xla_ms": round(xla_s * 1e3, 3)}
+        fused_s = None
+        if kind == "batch":
+            fused_s = time_block(
+                lambda xin, p: fused_bottleneck_train(xin, p), x, params)
+        elif kind == "spatial":
+            fused_s = time_block(
+                lambda xin, p, _th=th: fused_bottleneck_train_spatial(
+                    xin, p, tile_h=_th), x, params)
+        if fused_s is not None:
+            row["fused_ms"] = round(fused_s * 1e3, 3)
+            row["fused_vs_xla"] = round(xla_s / fused_s, 3)
+        winner_s = min(xla_s, fused_s) if fused_s is not None else xla_s
+        winner = "xla" if winner_s == xla_s or fused_s is None else \
+            (kind + (f":{th}" if th is not None else ""))
+        row["winner"] = winner
+        rows[geom["key"]] = row
+        routes[geom["key"]] = winner
+        xla_total += xla_s * geom["count"]
+        best_total += winner_s * geom["count"]
+
+    # measured-routing estimate: stride-1 blocks are ~80% of step time
+    # (PERF.md roofline), so the end-to-end bound is conservative
+    speedup_blocks = xla_total / best_total if best_total else 1.0
+    if routing_out and on_tpu:
+        # atomic publish: a timeout mid-dump must not leave a truncated
+        # table for KFTPU_FUSED_ROUTING_TABLE consumers
+        tmp = routing_out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"device_kind": getattr(dev, "device_kind",
+                                              dev.platform),
+                       "batch": batch, "image_size": image_size,
+                       "routes": routes}, f, indent=1)
+        os.replace(tmp, routing_out)
+    return {
+        "metric": "resnet50_fused_block_microbench",
+        "value": round(speedup_blocks, 3),
+        "unit": "stride1_block_speedup_measured_routing_vs_xla",
+        "vs_baseline": None,
+        "mfu": None,
+        "extras": {
+            "device_kind": getattr(dev, "device_kind", dev.platform),
+            "global_batch": batch,
+            "image_size": image_size,
+            "blocks": rows,
+            "routing_table_written": bool(routing_out and on_tpu),
+        },
+        "_flops_per_chip": 0.0,
+    }
+
+
 def _run_sub_bench(mode: str, budget_s: float) -> dict:
     """Run ``bench.py --mode <mode>`` as a subprocess with a hard
     wall-clock budget and return its JSON row. The child inherits the
@@ -446,7 +562,11 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--mode", default="all",
                    choices=["all", "resnet", "resnet-fused", "lm",
-                            "lm-long", "serving"])
+                            "lm-long", "serving", "fused-blocks"])
+    p.add_argument("--routing-out",
+                   default="bench-matrix/fused_routing_measured.json",
+                   help="where --mode fused-blocks writes the measured "
+                        "routing table (TPU runs only)")
     args = p.parse_args(argv)
 
     # the fallback child carries this marker: never probe/respawn again
@@ -483,6 +603,9 @@ def main(argv=None) -> int:
         row = bench_lm(t_start=t_start, long_context=True)
     elif args.mode == "serving":
         row = bench_serving(t_start=t_start)
+    elif args.mode == "fused-blocks":
+        row = bench_fused_blocks(t_start=t_start,
+                                 routing_out=args.routing_out)
     else:
         row = bench_resnet(fused=False, t_start=t_start)
 

@@ -62,6 +62,18 @@ fi
 run_step lm       900 python bench.py --mode lm
 run_step lm-long  900 python bench.py --mode lm-long
 run_step serving  1200 python bench.py --mode serving
+# per-block kernel attribution for the fused path's measured 0.53x —
+# writes bench-matrix/fused_routing_measured.json (the table
+# fused_train_apply consumes via KFTPU_FUSED_ROUTING_TABLE), then
+# re-measures end-to-end with measured routing. Remove any prior
+# session's table first: the -s gate below must see THIS session's
+# measurements or nothing.
+rm -f bench-matrix/fused_routing_measured.json
+run_step fused-blocks 1800 python bench.py --mode fused-blocks
+if [ -s bench-matrix/fused_routing_measured.json ]; then
+  KFTPU_FUSED_ROUTING_TABLE=bench-matrix/fused_routing_measured.json \
+    run_step fused-measured-routing 1200 python bench.py --mode resnet-fused
+fi
 
 # compile-cache warm start: cold vs warm startup_first_step_s
 CACHE=$(mktemp -d /tmp/kftpu-cache.XXXX)

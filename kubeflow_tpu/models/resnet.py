@@ -355,6 +355,35 @@ def _xla_block_train(x, params, strides, dtype=jnp.bfloat16, eps=1e-5):
     return out, stats
 
 
+def geometry_key(h: int, w: int, cin: int, cmid: int, cout: int) -> str:
+    """Stable key for one bottleneck geometry — the lookup key of the
+    measured routing table (KFTPU_FUSED_ROUTING_TABLE)."""
+    return f"{h}x{w}_{cin}_{cmid}_{cout}"
+
+
+def _measured_routing_table() -> dict | None:
+    """Measured per-geometry kernel routing, loaded once per process from
+    the JSON file named by KFTPU_FUSED_ROUTING_TABLE (written by
+    ``bench.py --mode fused-blocks`` on real TPU): geometry_key →
+    "xla" | "batch" | "spatial:<tile_h>". Measured beats modeled — the
+    round-5 silicon session showed the VMEM traffic model mispredicts
+    which kernels win (PERF.md), so routing can be pinned to what the
+    chip actually measured."""
+    import json
+    import os
+    path = os.environ.get("KFTPU_FUSED_ROUTING_TABLE")
+    if not path:
+        return None
+    cached = _measured_routing_table.__dict__.get("cache")
+    if cached is not None and cached[0] == path:
+        return cached[1]
+    with open(path) as f:
+        table = json.load(f)
+    routes = table.get("routes", table)   # accept bare or wrapped
+    _measured_routing_table.cache = (path, routes)
+    return routes
+
+
 def _fused_route(h: int, w: int, cin: int, cmid: int,
                  cout: int) -> tuple:
     """Kernel choice for one stride-1 bottleneck: ("batch", None) when
@@ -362,39 +391,53 @@ def _fused_route(h: int, w: int, cin: int, cmid: int,
     strip does, ("xla", None) otherwise. The single source of truth for
     fused_train_apply AND the bench artifact's routing report.
 
-    KFTPU_FUSED_DISABLE_SPATIAL=1 turns the spatial branch off (blocks
-    that don't batch-tile fall to XLA) — the kill-switch for a first
-    Mosaic compile of the spatial kernels going bad mid-measurement
-    (hack/tpu_session.sh retries the fused bench with it set)."""
+    A measured table (KFTPU_FUSED_ROUTING_TABLE) overrides the model
+    for the geometries it names. KFTPU_FUSED_DISABLE_SPATIAL=1 turns
+    the spatial branch off (blocks that don't batch-tile fall to XLA)
+    — the kill-switch for a Mosaic compile of the spatial kernels
+    going bad mid-measurement (hack/tpu_session.sh retries the fused
+    bench with it set)."""
     import os
 
     from ..ops.fused_block_train import fits_vmem_budget
     from ..ops.fused_block_train_spatial import default_tile_h
+
+    spatial_disabled = os.environ.get(
+        "KFTPU_FUSED_DISABLE_SPATIAL", "").lower() in ("1", "true", "yes")
+    table = _measured_routing_table()
+    if table is not None:
+        route = table.get(geometry_key(h, w, cin, cmid, cout))
+        if route == "xla":
+            return ("xla", None)
+        if route == "batch":
+            return ("batch", None)
+        if isinstance(route, str) and route.startswith("spatial:"):
+            # the kill-switch outranks the table: a wedged spatial
+            # Mosaic compile must be stoppable even with routes pinned
+            return ("xla", None) if spatial_disabled else \
+                ("spatial", int(route.split(":", 1)[1]))
     if fits_vmem_budget(h, w, cin, cmid, cout):
         return ("batch", None)
-    if os.environ.get("KFTPU_FUSED_DISABLE_SPATIAL", "").lower() in \
-            ("1", "true", "yes"):
+    if spatial_disabled:
         return ("xla", None)
     th = default_tile_h(h, w, cin, cmid, cout)
     return ("spatial", th) if th is not None else ("xla", None)
 
 
-def fused_block_routing(depth: int = 50,
-                        image_size: int = 224) -> dict[str, str]:
-    """block name → kernel route for the fused training path: the same
-    decision function the apply executes (_fused_route), over the same
-    geometry — SAME-padding ceil division for every stride-2 hop, widths
-    from the fixed make_resnet family (64·2^stage, the shapes the
-    params' Conv kernels carry) — what `bench.py` records so the
-    artifact says what actually ran. Pinned against the apply's real
-    tensor shapes in tests/test_ops.py."""
+def _block_walk(depth: int, image_size: int):
+    """Yield every bottleneck block's geometry in model order — the ONE
+    copy of the SAME-padding ceil-division recurrence (conv_init s2 +
+    maxpool s2, then 64·2^stage widths, stride 2 at each later stage
+    head): {name, h, cin, cmid, cout, strides}. fused_block_routing,
+    stride1_geometries, and (transitively) the bench artifact all read
+    this walk, so they cannot drift from each other; pinned against the
+    apply's real tensor shapes in tests/test_ops.py."""
     if depth < 50:
         raise ValueError("fused paths cover bottleneck depths (>= 50)")
 
     def ceil_half(n: int) -> int:     # SAME conv/pool, stride 2
         return -(-n // 2)
 
-    routes = {}
     h = ceil_half(ceil_half(image_size))   # conv_init s2 + maxpool s2
     cin = 64
     for i, n_blocks in enumerate(STAGE_SIZES[depth]):
@@ -404,16 +447,74 @@ def fused_block_routing(depth: int = 50,
             strides = 2 if i > 0 and j == 0 else 1
             if strides == 2:
                 h = ceil_half(h)
-            name = f"stage{i + 1}_block{j + 1}"
-            if strides != 1:
-                routes[name] = "xla-strided"
-            else:
-                kind, th = _fused_route(h, h, cin, cmid, cout)
-                routes[name] = {"batch": "fused-batch",
-                                "xla": "xla"}.get(
-                    kind, f"fused-spatial(th={th})")
+            yield {"name": f"stage{i + 1}_block{j + 1}", "h": h,
+                   "cin": cin, "cmid": cmid, "cout": cout,
+                   "strides": strides}
             cin = cout
+
+
+def fused_block_routing(depth: int = 50,
+                        image_size: int = 224) -> dict[str, str]:
+    """block name → kernel route for the fused training path: the same
+    decision function the apply executes (_fused_route), over the same
+    geometry (_block_walk) — what `bench.py` records so the artifact
+    says what actually ran."""
+    routes = {}
+    for b in _block_walk(depth, image_size):
+        if b["strides"] != 1:
+            routes[b["name"]] = "xla-strided"
+        else:
+            kind, th = _fused_route(b["h"], b["h"], b["cin"], b["cmid"],
+                                    b["cout"])
+            routes[b["name"]] = {"batch": "fused-batch",
+                                 "xla": "xla"}.get(
+                kind, f"fused-spatial(th={th})")
     return routes
+
+
+def stride1_geometries(depth: int = 50,
+                       image_size: int = 224) -> list[dict]:
+    """The distinct stride-1 bottleneck geometries of one model config,
+    with multiplicity — the work-list for the per-block kernel
+    microbench (``bench.py --mode fused-blocks``). Aggregates
+    _block_walk (the single geometry recurrence); each entry carries
+    {key, h, cin, cmid, cout, proj, count}."""
+    geoms: dict[str, dict] = {}
+    for b in _block_walk(depth, image_size):
+        if b["strides"] != 1:
+            continue
+        key = geometry_key(b["h"], b["h"], b["cin"], b["cmid"], b["cout"])
+        g = geoms.setdefault(key, {
+            "key": key, "h": b["h"], "cin": b["cin"], "cmid": b["cmid"],
+            "cout": b["cout"], "proj": b["cin"] != b["cout"], "count": 0})
+        g["count"] += 1
+    return list(geoms.values())
+
+
+def random_block_params(rng: jax.Array, cin: int, cmid: int, cout: int,
+                        proj: bool) -> dict:
+    """He-init params for ONE bottleneck block at an arbitrary geometry
+    (the microbench's model-free block constructor; same subtree shape
+    the flax model produces)."""
+    import flax.linen as fnn
+    ks = jax.random.split(rng, 4)
+    init = fnn.initializers.he_normal()
+
+    def bn(c):
+        return {"scale": jnp.ones((c,), jnp.float32),
+                "bias": jnp.zeros((c,), jnp.float32)}
+
+    p = {"Conv_0": {"kernel": init(ks[0], (1, 1, cin, cmid), jnp.float32)},
+         "BatchNorm_0": bn(cmid),
+         "Conv_1": {"kernel": init(ks[1], (3, 3, cmid, cmid), jnp.float32)},
+         "BatchNorm_1": bn(cmid),
+         "Conv_2": {"kernel": init(ks[2], (1, 1, cmid, cout), jnp.float32)},
+         "BatchNorm_2": bn(cout)}
+    if proj:
+        p["conv_proj"] = {
+            "kernel": init(ks[3], (1, 1, cin, cout), jnp.float32)}
+        p["norm_proj"] = bn(cout)
+    return p
 
 
 def fused_train_apply(variables: dict, images: jax.Array, *,

@@ -465,6 +465,50 @@ class TestFusedBlockTrain:
         tiny = fused_block_routing(50, 64)
         assert set(tiny.values()) == {"fused-batch", "xla-strided"}
 
+    def test_measured_routing_table_overrides_model(self, tmp_path,
+                                                    monkeypatch):
+        """A measured table (KFTPU_FUSED_ROUTING_TABLE) pins routing for
+        the geometries it names — the consumption path for the on-TPU
+        fused-blocks microbench output — and unnamed geometries keep the
+        modeled route."""
+        import json as _json
+        from kubeflow_tpu.models import resnet as R
+        base = R.fused_block_routing(50, 224)
+        assert base["stage4_block2"] == "fused-batch"
+        table = {"routes": {
+            R.geometry_key(7, 7, 2048, 512, 2048): "xla",
+            R.geometry_key(56, 56, 256, 64, 256): "spatial:28",
+        }}
+        path = tmp_path / "routing.json"
+        path.write_text(_json.dumps(table))
+        monkeypatch.setenv("KFTPU_FUSED_ROUTING_TABLE", str(path))
+        R._measured_routing_table.__dict__.pop("cache", None)
+        pinned = R.fused_block_routing(50, 224)
+        assert pinned["stage4_block2"] == "xla"
+        assert pinned["stage1_block2"] == "fused-spatial(th=28)"
+        # geometries the table does not name keep the modeled route
+        assert pinned["stage3_block2"] == base["stage3_block2"]
+        # the spatial kill-switch outranks a table-pinned spatial route
+        # (a wedged Mosaic compile must be stoppable mid-measurement)
+        monkeypatch.setenv("KFTPU_FUSED_DISABLE_SPATIAL", "1")
+        assert R._fused_route(56, 56, 256, 64, 256) == ("xla", None)
+        R._measured_routing_table.__dict__.pop("cache", None)
+
+    def test_stride1_geometries_match_routing_walk(self):
+        """The microbench work-list covers exactly the stride-1 blocks
+        of the flagship config, with the right multiplicities."""
+        from kubeflow_tpu.models import resnet as R
+        geoms = R.stride1_geometries(50, 224)
+        assert sum(g["count"] for g in geoms) == 13  # 16 blocks - 3 strided
+        by_key = {g["key"]: g for g in geoms}
+        g1 = by_key[R.geometry_key(56, 56, 64, 64, 256)]
+        assert g1["proj"] and g1["count"] == 1
+        g4 = by_key[R.geometry_key(14, 14, 1024, 256, 1024)]
+        assert not g4["proj"] and g4["count"] == 5
+        # every geometry builds valid single-block params
+        p = R.random_block_params(jax.random.PRNGKey(0), 64, 64, 256, True)
+        assert p["conv_proj"]["kernel"].shape == (1, 1, 64, 256)
+
     def test_fused_loss_close_to_flax_on_shared_params(self):
         """Ghost BN differs from batch BN but must stay in the same
         numeric neighborhood at init — a gross mismatch means a bug, not

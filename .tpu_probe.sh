@@ -1,12 +1,24 @@
 #!/bin/bash
-# Probes the axon tunnel every 5 min; appends result to .tpu_attempts.log.
+# Probes the axon tunnel every 5 min; on first ALIVE, kicks off the full
+# measurement session (hack/tpu_session.sh) exactly once.
+cd /root/repo || exit 1
 while true; do
   ts=$(date -u +%FT%TZ)
   out=$(timeout 120 python -c "import jax; d=jax.devices()[0]; print(d.device_kind)" 2>/dev/null | tail -1)
   if [ -n "$out" ] && [ "$out" != "cpu" ]; then
-    echo "$ts ALIVE $out" >> /root/repo/.tpu_attempts.log
+    echo "$ts ALIVE $out" >> .tpu_attempts.log
+    if [ ! -e bench-results/.session_done ]; then
+      mkdir -p bench-results
+      echo "$ts launching hack/tpu_session.sh" >> .tpu_attempts.log
+      bash hack/tpu_session.sh bench-results >> bench-results/session.log 2>&1
+      rc=$?
+      echo "$(date -u +%FT%TZ) session script exited rc=$rc" >> .tpu_attempts.log
+      # only a clean run retires the launcher: a tunnel flap mid-session
+      # (rc!=0) must retry at the next ALIVE window
+      [ "$rc" -eq 0 ] && touch bench-results/.session_done
+    fi
   else
-    echo "$ts dead (timeout/err)" >> /root/repo/.tpu_attempts.log
+    echo "$ts dead (timeout/err)" >> .tpu_attempts.log
   fi
   sleep 300
 done

@@ -485,8 +485,8 @@ def bench_fused_blocks(t_start: float | None = None,
         xla_s = time_block(
             lambda xin, p: R._xla_block_train(xin, p, 1), x, params)
         kind, th = R._fused_route(h, h, cin, cmid, cout)
-        row = {"count": geom["count"], "route_model": kind +
-               (f":{th}" if th is not None else ""),
+        route_str = kind + (f":{th}" if th is not None else "")
+        row = {"count": geom["count"], "route_model": route_str,
                "xla_ms": round(xla_s * 1e3, 3)}
         fused_s = None
         if kind == "batch":
@@ -500,8 +500,7 @@ def bench_fused_blocks(t_start: float | None = None,
             row["fused_ms"] = round(fused_s * 1e3, 3)
             row["fused_vs_xla"] = round(xla_s / fused_s, 3)
         winner_s = min(xla_s, fused_s) if fused_s is not None else xla_s
-        winner = "xla" if winner_s == xla_s or fused_s is None else \
-            (kind + (f":{th}" if th is not None else ""))
+        winner = "xla" if winner_s == xla_s else route_str
         row["winner"] = winner
         rows[geom["key"]] = row
         routes[geom["key"]] = winner

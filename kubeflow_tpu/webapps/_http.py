@@ -29,6 +29,16 @@ class JsonApp:
     def __init__(self, prefix: str = ""):
         self.prefix = "/" + prefix.strip("/") if prefix.strip("/") else ""
         self.routes: list[Route] = []
+        self._request_ctx = threading.local()
+
+    @property
+    def request_headers(self) -> dict:
+        """Lower-cased headers of the request currently being dispatched
+        (thread-local — the server is threaded). Handlers that need
+        identity headers the ingress injects (IAP_EMAIL_HEADER) read
+        them here; empty when dispatch is called outside a request
+        (unit tests driving the app object directly)."""
+        return getattr(self._request_ctx, "headers", {})
 
     def route(self, method: str, pattern: str):
         regex = re.compile(
@@ -121,7 +131,12 @@ def _make_handler(app: JsonApp):
                 except json.JSONDecodeError:
                     self._respond(400, {"error": "invalid JSON body"})
                     return
-            status, payload = app.dispatch(method, self.path, body)
+            app._request_ctx.headers = {k.lower(): v for k, v
+                                        in self.headers.items()}
+            try:
+                status, payload = app.dispatch(method, self.path, body)
+            finally:
+                app._request_ctx.headers = {}
             self._respond(status, payload)
 
         def _respond(self, status: int, payload: Any):

@@ -54,6 +54,17 @@ body{font-family:sans-serif;margin:0;display:flex;min-height:100vh;
 #sidebar a{display:block;color:#fff;text-decoration:none;padding:0.45rem
  0.6rem;border-radius:4px;margin:0.15rem 0}
 #sidebar a.active,#sidebar a:hover{background:rgba(255,255,255,0.22)}
+#env-info{margin-top:1.2rem;font-size:0.78rem;opacity:0.85;
+ overflow-wrap:anywhere}
+.cards{display:flex;gap:0.8rem;flex-wrap:wrap;margin:0.5rem 0 1rem}
+.card{background:var(--surface-2);border-radius:8px;text-decoration:none;
+ color:var(--text-primary);padding:0.8rem 1.1rem;min-width:11rem;
+ border:1px solid var(--grid)}
+.card:hover{border-color:var(--series-1)}
+.card-title{font-weight:600;color:var(--series-1)}
+.card-desc{color:var(--text-secondary);font-size:0.85rem;margin-top:0.2rem}
+form.inline{display:flex;gap:0.5rem;align-items:center;margin:0.6rem 0}
+form.inline input,form.inline select{padding:0.35rem}
 #ns-selector{width:100%;padding:0.35rem;margin-bottom:1rem}
 main{flex:1;padding:1.5rem;max-width:70rem}
 table{border-collapse:collapse;margin:0.5rem 0 1.5rem}
@@ -100,7 +111,9 @@ button.minor{padding:0.3rem 0.8rem;border:1px solid var(--grid);
   <a href="#/activities" data-view="activities">Activities</a>
   <a href="#/metrics" data-view="metrics">Metrics</a>
   <a href="#/notebooks" data-view="notebooks">Notebooks</a>
+  <a href="#/contributors" data-view="contributors">Contributors</a>
   <a href="/logout">Log out</a>
+  <div id="env-info"></div>
 </div>
 <main><div id="view"></div></main>
 <script src="app.js"></script>
@@ -182,6 +195,43 @@ def build_dashboard_app(client: KubeClient,
         return 200, RawResponse(
             _read_app_js(),
             content_type="application/javascript; charset=utf-8")
+
+    @app.route("GET", "/api/env-info")
+    def env_info(params, query, body):
+        """Platform + user info (api.ts /env-info; k8s_service.ts
+        getPlatformInfo): provider from Node providerID, kubeflow
+        version from the Application CR when installed (the reference
+        reads spec.descriptor.version the same way), user email from
+        the identity header the auth ingress injects."""
+        from .ingress import IAP_EMAIL_HEADER
+        provider = "other://"
+        for node in client.list("v1", "Node"):
+            pid = node.get("spec", {}).get("providerID")
+            if pid:
+                provider = pid
+                break
+        version = ""
+        try:
+            from ..controllers.application import (APPLICATION_API_VERSION,
+                                                   APPLICATION_KIND)
+            for app_cr in client.list(APPLICATION_API_VERSION,
+                                      APPLICATION_KIND):
+                version = (app_cr.get("spec", {})
+                           .get("descriptor", {}).get("version", ""))
+                if version:
+                    break
+        except Exception:  # noqa: BLE001 — CRD absent is normal
+            pass
+        from .. import __version__
+        email = app.request_headers.get(IAP_EMAIL_HEADER, "")
+        # IAP prefixes the subject ("accounts.google.com:user@x")
+        email = email.split(":", 1)[-1] if email else "anonymous@kubeflow.org"
+        return 200, {
+            "user": {"email": email},
+            "platform": {"provider": provider,
+                         "providerName": provider.split(":")[0],
+                         "kubeflowVersion": version or __version__},
+        }
 
     @app.route("GET", "/api/namespaces")
     def namespaces(params, query, body):

@@ -150,8 +150,19 @@ class Gatekeeper:
 
     def authorized(self, cookie_token: Optional[str],
                    basic_header: Optional[str] = None) -> bool:
-        return self.sessions.valid(cookie_token) or \
-            self.check_basic_header(basic_header)
+        return self.authorized_user(cookie_token, basic_header) is not None
+
+    def authorized_user(self, cookie_token: Optional[str],
+                        basic_header: Optional[str] = None) -> Optional[str]:
+        """The authenticated identity, or None. The gatekeeper is
+        single-credential (AuthServer.go's u/p pair), so any valid
+        session or basic header resolves to the configured username —
+        returned on /auth as X-Auth-User for the ingress to mint the
+        upstream identity header from."""
+        if self.sessions.valid(cookie_token) or \
+                self.check_basic_header(basic_header):
+            return self.username
+        return None
 
 
 class GatekeeperServer:
@@ -209,9 +220,10 @@ def _make_handler(gate: Gatekeeper):
                                   {"Content-Type":
                                    "text/html; charset=utf-8"})
             if self.path.startswith("/auth"):
-                if gate.authorized(_cookie_token(self),
-                                   self.headers.get("Authorization")):
-                    return self._send(200)
+                user = gate.authorized_user(
+                    _cookie_token(self), self.headers.get("Authorization"))
+                if user is not None:
+                    return self._send(200, headers={"X-Auth-User": user})
                 return self._send(401, b"unauthorized",
                                   {"WWW-Authenticate": "Basic"})
             if self.path.startswith("/logout"):

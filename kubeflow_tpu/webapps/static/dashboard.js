@@ -23,14 +23,24 @@
   const JUPYTER_PATH = "/jupyter/";
   const NS_KEY = "kftpu.namespace";
 
-  async function api(path) {
-    const resp = await fetch(path, { credentials: "same-origin" });
+  async function api(path, opts) {
+    const init = { credentials: "same-origin" };
+    if (opts && opts.method) init.method = opts.method;
+    if (opts && opts.body !== undefined) {
+      init.body = JSON.stringify(opts.body);
+      init.headers = { "Content-Type": "application/json" };
+    }
+    const resp = await fetch(path, init);
     if (resp.status === 401) {
       // unauthenticated: bounce through the gatekeeper login page
       window.location.assign(LOGIN_PATH);
       throw new Error("unauthenticated");
     }
-    if (!resp.ok) throw new Error(`${path}: HTTP ${resp.status}`);
+    if (!resp.ok) {
+      let detail = "";
+      try { detail = (await resp.json()).error || ""; } catch (e) { /* raw */ }
+      throw new Error(detail || `${path}: HTTP ${resp.status}`);
+    }
     return resp.json();
   }
 
@@ -256,6 +266,18 @@
 
   // -- views -----------------------------------------------------------------
 
+  // quick shortcuts, the dashboard-view.js card row analog
+  const SHORTCUTS = [
+    ["#/notebooks", "Spawn a notebook",
+      "JupyterLab on TPU node pools via the notebook controller"],
+    ["#/runs", "Run history",
+      "Training jobs, workflows and Katib studies in this namespace"],
+    ["#/contributors", "Manage contributors",
+      "Grant namespace access through the profile access API"],
+    ["#/metrics", "Cluster metrics",
+      "Pod resource requests and per-node scheduling pressure"],
+  ];
+
   async function viewOverview(root) {
     const [slices, nodes, runs] = await Promise.all([
       api("api/tpu/slices"), api("api/metrics/node"),
@@ -266,6 +288,11 @@
     const active = runs.filter((r) =>
       r.phase === "Running" || r.phase === "Created").length;
     root.replaceChildren(
+      el("div", { class: "cards" }, SHORTCUTS.map(([href, title, desc]) =>
+        el("a", { class: "card", href }, [
+          el("div", { class: "card-title", text: title }),
+          el("div", { class: "card-desc", text: desc }),
+        ]))),
       el("div", { class: "tiles" }, [
         statTile("TPU chips", chips),
         statTile("TPU hosts", hosts),
@@ -343,6 +370,68 @@
                     text: "No training jobs or workflow runs." }));
   }
 
+  // -- contributors (the manage-users surface over the KFAM API) ------------
+
+  const KFAM_ROLES = ["kubeflow-view", "kubeflow-edit", "kubeflow-admin"];
+
+  async function viewContributors(root) {
+    const ns = selectedNamespace();
+    const data = await api(
+      `kfam/v1/bindings?namespace=${encodeURIComponent(ns)}`);
+    const rows = data.bindings.map((b) => ({
+      user: b.user.name,
+      kind: b.user.kind,
+      role: (b.roleRef || {}).name || "",
+    }));
+
+    const email = el("input", {
+      type: "email", placeholder: "user@example.com", required: "required",
+      "aria-label": "contributor email",
+    });
+    const role = el("select", { "aria-label": "role" },
+      KFAM_ROLES.map((r) => el("option", { value: r, text: r })));
+    const err = el("p", { class: "error" });
+    const form = el("form", {
+      class: "inline",
+      onsubmit: async (evt) => {
+        evt.preventDefault();
+        if (!email.value) return;
+        try {
+          await api("kfam/v1/bindings", { method: "POST", body: {
+            user: { kind: "User", name: email.value },
+            referredNamespace: ns,
+            roleRef: { kind: "ClusterRole", name: role.value },
+          } });
+          render();
+        } catch (e) { err.textContent = e.message; }
+      },
+    }, [email, role, el("button", { class: "minor", text: "Add" })]);
+
+    root.replaceChildren(
+      el("h2", { text: `Contributors to ${ns}` }),
+      form, err,
+      rows.length
+        ? table(rows, ["user", "kind", "role", ""], (col, row, td) => {
+            if (col !== "") return false;
+            td.appendChild(el("button", {
+              class: "minor", text: "Remove",
+              onclick: async () => {
+                try {
+                  await api("kfam/v1/bindings", { method: "DELETE", body: {
+                    user: { kind: row.kind, name: row.user },
+                    referredNamespace: ns,
+                    roleRef: { kind: "ClusterRole", name: row.role },
+                  } });
+                  render();
+                } catch (e) { err.textContent = e.message; }
+              },
+            }));
+            return true;
+          })
+        : el("p", { class: "empty",
+                    text: "No contributors in this namespace." }));
+  }
+
   function viewNotebooks(root) {
     // iframe-embedding, the reference dashboard's integration pattern
     const frame = el("iframe", {
@@ -358,7 +447,24 @@
     activities: viewActivities,
     metrics: viewMetrics,
     notebooks: viewNotebooks,
+    contributors: viewContributors,
   };
+
+  // -- env-info footer (user identity + platform, api.ts /env-info) ---------
+
+  async function renderEnvInfo() {
+    try {
+      const info = await api("api/env-info");
+      const footer = document.getElementById("env-info");
+      if (!footer) return;
+      footer.replaceChildren(
+        el("div", { text: info.user.email }),
+        el("div", {
+          text: `${info.platform.providerName} · v` +
+            info.platform.kubeflowVersion,
+        }));
+    } catch (e) { /* footer is decorative; views surface real errors */ }
+  }
 
   function activeView() {
     const name = (location.hash.replace(/^#\//, "") || "overview").split("/")[0];
@@ -414,6 +520,7 @@
 
   async function main() {
     await renderNamespaceSelector();
+    renderEnvInfo();
     window.addEventListener("hashchange", render);
     await render();
     startAutoRefresh();

@@ -18,6 +18,7 @@ import pytest
 from kubeflow_tpu.cluster import FakeCluster
 from kubeflow_tpu.controllers.runtime import Manager
 from kubeflow_tpu.controllers.tpujob import TrainingJobReconciler
+from kubeflow_tpu.webapps.access_management import AccessManagementServer
 from kubeflow_tpu.webapps.dashboard import DashboardServer
 from kubeflow_tpu.webapps.gatekeeper import Gatekeeper, GatekeeperServer
 from kubeflow_tpu.webapps.ingress import (AuthIngress, ExtAuthzVerifier,
@@ -61,12 +62,14 @@ def stack():
 
     dash = up(DashboardServer(cluster))
     jupyter = up(JupyterWebApp(cluster, prefix="jupyter"))
+    kfam = up(AccessManagementServer(cluster))
     gate = up(GatekeeperServer(Gatekeeper(username="admin", password="pw")))
     ingress = up(AuthIngress(
         ExtAuthzVerifier(auth_url=f"http://127.0.0.1:{gate.port}/auth",
                          login_path="/login"),
         routes=[Route("/", f"127.0.0.1:{dash.port}"),
                 Route("/jupyter/", f"127.0.0.1:{jupyter.port}"),
+                Route("/kfam/", f"127.0.0.1:{kfam.port}"),
                 Route("/login", f"127.0.0.1:{gate.port}"),
                 Route("/logout", f"127.0.0.1:{gate.port}")],
         public_prefixes=("/login", "/logout")))
@@ -155,7 +158,35 @@ def test_login_dashboard_spawn_runs_flow(stack):
     slices = json.loads(body)
     assert sum(p["chips"] for p in slices) == 8
 
-    # 8. logout revokes the session: the dashboard bounces to login again
+    # 8. env-info carries the ingress-authenticated identity + platform
+    # (the sidebar footer's data): the ExtAuthz identity is minted by the
+    # ingress, never taken from the client
+    status, body, _ = fetch(f"{base}/api/env-info", cookie)
+    env = json.loads(body)
+    assert status == 200 and env["user"]["email"] == "admin"
+    assert env["platform"]["kubeflowVersion"]
+
+    # 9. contributors flow exactly as the SPA drives it: add through the
+    # ingress-mounted KFAM app, list, remove
+    binding = json.dumps({
+        "user": {"kind": "User", "name": "alice@example.com"},
+        "referredNamespace": "kubeflow",
+        "roleRef": {"kind": "ClusterRole", "name": "kubeflow-edit"},
+    }).encode()
+    status, body, _ = fetch(f"{base}/kfam/v1/bindings", cookie, data=binding)
+    assert status == 200
+    status, body, _ = fetch(f"{base}/kfam/v1/bindings?namespace=kubeflow",
+                            cookie)
+    users = [b["user"]["name"] for b in json.loads(body)["bindings"]]
+    assert users == ["alice@example.com"]
+    status, _, _ = fetch(f"{base}/kfam/v1/bindings", cookie, data=binding,
+                         method="DELETE")
+    assert status == 200
+    status, body, _ = fetch(f"{base}/kfam/v1/bindings?namespace=kubeflow",
+                            cookie)
+    assert json.loads(body)["bindings"] == []
+
+    # 10. logout revokes the session: the dashboard bounces to login again
     fetch(f"{base}/logout", cookie)
     status, _, headers = fetch(f"{base}/", cookie)
     assert status == 302 and headers["Location"].startswith("/login")

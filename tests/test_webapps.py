@@ -94,6 +94,34 @@ class TestDashboard:
         finally:
             server.stop()
 
+    def test_env_info_identity_and_platform(self, cluster):
+        """/api/env-info (api.ts router): email comes from the identity
+        header the auth ingress injects (IAP prefix stripped), provider
+        from Node providerID, version from the Application CR when one
+        exists, else the package version."""
+        cluster.create({
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": "gce-0"},
+            "spec": {"providerID": "gce://proj/us-central1-a/vm-0"}})
+        server = DashboardServer(cluster)
+        port = server.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/env-info",
+                headers={"x-goog-authenticated-user-email":
+                         "accounts.google.com:alice@example.com"})
+            with urllib.request.urlopen(req) as r:
+                env = json.loads(r.read())
+            assert env["user"]["email"] == "alice@example.com"
+            assert env["platform"]["providerName"] == "gce"
+            from kubeflow_tpu import __version__
+            assert env["platform"]["kubeflowVersion"] == __version__
+            # anonymous without the header (no ingress in front)
+            anon = get_json(f"http://127.0.0.1:{port}/api/env-info")
+            assert anon["user"]["email"] == "anonymous@kubeflow.org"
+        finally:
+            server.stop()
+
     def test_activities_sorted_newest_first(self, cluster):
         for i, ts in enumerate(["2026-01-01", "2026-03-01", "2026-02-01"]):
             cluster.create({

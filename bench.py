@@ -639,10 +639,25 @@ def main(argv=None) -> int:
         in_process = {"resnet-fused": lambda: bench_resnet(fused=True),
                       "lm": bench_lm,
                       "lm-long": lambda: bench_lm(long_context=True),
-                      "serving": bench_serving}
+                      "serving": bench_serving,
+                      "fused-blocks": lambda: bench_fused_blocks(
+                          routing_out=args.routing_out)}
         for key, mode in (("fused", "resnet-fused"), ("lm", "lm"),
                           ("lm_long", "lm-long"),
-                          ("serving", "serving")):
+                          ("serving", "serving"),
+                          ("fused_blocks", "fused-blocks")):
+            if mode == "fused-blocks":
+                # per-block attribution is the most expensive extra
+                # (10 jit'd block microbenches): only fold it in on TPU
+                # (CPU interpret mode would crawl) and only while the
+                # run is comfortably inside a driver-timeout budget —
+                # recording WHY when skipped, like every absent number
+                if not on_tpu:
+                    continue   # CPU runs never carry this section
+                if time.perf_counter() - t_start > 900:
+                    row["extras"][key] = {
+                        "error": "skipped: elapsed budget (900s) reached"}
+                    continue
             try:
                 sub = in_process[mode]() if on_tpu else \
                     _run_sub_bench(mode, budget_s=240.0)
@@ -652,7 +667,8 @@ def main(argv=None) -> int:
                     **{k: sub["extras"][k] for k in
                        ("model_tflops", "loss", "latency",
                         "cold_first_request_s", "warmup_s",
-                        "fused_routing", "error")
+                        "fused_routing", "blocks",
+                        "routing_table_written", "error")
                        if k in sub["extras"]},
                 }
             except Exception as e:  # noqa: BLE001 — artifact must land

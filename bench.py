@@ -512,7 +512,12 @@ def bench_fused_blocks(t_start: float | None = None,
     speedup_blocks = xla_total / best_total if best_total else 1.0
     if routing_out and on_tpu:
         # atomic publish: a timeout mid-dump must not leave a truncated
-        # table for KFTPU_FUSED_ROUTING_TABLE consumers
+        # table for KFTPU_FUSED_ROUTING_TABLE consumers; create the
+        # directory — losing minutes of TPU microbench time to a missing
+        # bench-matrix/ in the cwd would be absurd
+        out_dir = os.path.dirname(routing_out)
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
         tmp = routing_out + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"device_kind": getattr(dev, "device_kind",
@@ -653,7 +658,9 @@ def main(argv=None) -> int:
                 # run is comfortably inside a driver-timeout budget —
                 # recording WHY when skipped, like every absent number
                 if not on_tpu:
-                    continue   # CPU runs never carry this section
+                    row["extras"][key] = {
+                        "error": "skipped: CPU (interpret mode too slow)"}
+                    continue
                 if time.perf_counter() - t_start > 900:
                     row["extras"][key] = {
                         "error": "skipped: elapsed budget (900s) reached"}

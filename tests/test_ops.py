@@ -473,6 +473,8 @@ class TestFusedBlockTrain:
         modeled route."""
         import json as _json
         from kubeflow_tpu.models import resnet as R
+        monkeypatch.delenv("KFTPU_FUSED_ROUTING_TABLE", raising=False)
+        R._measured_routing_table.__dict__.pop("cache", None)
         base = R.fused_block_routing(50, 224)
         assert base["stage4_block2"] == "fused-batch"
         table = {"routes": {
@@ -582,6 +584,30 @@ class TestFusedBlockTrain:
         kind, th = R._fused_route(8, 8, 256, 64, 256)
         assert (kind, th) == ("spatial", 4)
         self._run_sharded_fused_step()
+
+    def test_measured_table_drives_kernel_selection_in_apply(
+            self, tmp_path, monkeypatch):
+        """The table→kernel path end to end in a real traced apply: pin
+        a geometry the VMEM model would batch-tile to the SPATIAL kernel
+        via a measured table and run the full sharded fused step (the
+        TPU fused-measured-routing re-measurement in miniature)."""
+        import json as _json
+        from kubeflow_tpu.models import resnet as R
+        # the 32px test geometry batch-tiles under the default budget
+        # (shield the assert from any ambient table in the environment)
+        monkeypatch.delenv("KFTPU_FUSED_ROUTING_TABLE", raising=False)
+        R._measured_routing_table.__dict__.pop("cache", None)
+        assert R._fused_route(8, 8, 256, 64, 256) == ("batch", None)
+        table = {"routes": {R.geometry_key(8, 8, 256, 64, 256): "spatial:4"}}
+        path = tmp_path / "routing.json"
+        path.write_text(_json.dumps(table))
+        monkeypatch.setenv("KFTPU_FUSED_ROUTING_TABLE", str(path))
+        R._measured_routing_table.__dict__.pop("cache", None)
+        assert R._fused_route(8, 8, 256, 64, 256) == ("spatial", 4)
+        try:
+            self._run_sharded_fused_step()
+        finally:
+            R._measured_routing_table.__dict__.pop("cache", None)
 
     def test_basicblock_depths_rejected(self):
         from kubeflow_tpu.models import resnet as R

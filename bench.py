@@ -215,6 +215,7 @@ def bench_lm(t_start: float | None = None,
     from kubeflow_tpu.runtime.trainstep import TrainStepBuilder
 
     t_start = time.perf_counter() if t_start is None else t_start
+    import os
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     n_chips = len(jax.devices())
@@ -223,12 +224,15 @@ def bench_lm(t_start: float | None = None,
         # tokens/step fills the chip (seq 1024 x batch 32/chip) without
         # breaching v5e HBM. head_dim 128 = the TPU lane width: head_dim
         # 64 lane-pads every attention buffer 2x (measured HBM OOM on
-        # first chip contact) and halves flash-kernel MXU utilization
+        # first chip contact) and halves flash-kernel MXU utilization.
+        # KFTPU_LM_ATTENTION=einsum is the fallback for a flash Mosaic
+        # compile going bad on first silicon contact (hack/tpu_session.sh
+        # retries with it so SOME measured LM line still lands).
         cfg = T.TransformerConfig(
             vocab_size=32000, num_layers=12, embed_dim=1024, num_heads=8,
             head_dim=128, mlp_dim=4096,
             max_seq_len=8192 if long_context else 1024,
-            attention="flash")
+            attention=os.environ.get("KFTPU_LM_ATTENTION", "flash"))
         seq_len, batch_per_chip, steps, warmup = \
             (8192, 4, 10, 2) if long_context else (1024, 32, 20, 3)
     else:

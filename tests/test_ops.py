@@ -473,8 +473,9 @@ class TestFusedBlockTrain:
         modeled route."""
         import json as _json
         from kubeflow_tpu.models import resnet as R
+        # the cache is path-keyed and consulted only when the env var is
+        # set, so delenv alone shields the un-tabled asserts
         monkeypatch.delenv("KFTPU_FUSED_ROUTING_TABLE", raising=False)
-        R._measured_routing_table.__dict__.pop("cache", None)
         base = R.fused_block_routing(50, 224)
         assert base["stage4_block2"] == "fused-batch"
         table = {"routes": {
@@ -484,7 +485,6 @@ class TestFusedBlockTrain:
         path = tmp_path / "routing.json"
         path.write_text(_json.dumps(table))
         monkeypatch.setenv("KFTPU_FUSED_ROUTING_TABLE", str(path))
-        R._measured_routing_table.__dict__.pop("cache", None)
         pinned = R.fused_block_routing(50, 224)
         assert pinned["stage4_block2"] == "xla"
         assert pinned["stage1_block2"] == "fused-spatial(th=28)"
@@ -494,7 +494,6 @@ class TestFusedBlockTrain:
         # (a wedged Mosaic compile must be stoppable mid-measurement)
         monkeypatch.setenv("KFTPU_FUSED_DISABLE_SPATIAL", "1")
         assert R._fused_route(56, 56, 256, 64, 256) == ("xla", None)
-        R._measured_routing_table.__dict__.pop("cache", None)
 
     def test_stride1_geometries_match_routing_walk(self):
         """The microbench work-list covers exactly the stride-1 blocks
@@ -596,18 +595,13 @@ class TestFusedBlockTrain:
         # the 32px test geometry batch-tiles under the default budget
         # (shield the assert from any ambient table in the environment)
         monkeypatch.delenv("KFTPU_FUSED_ROUTING_TABLE", raising=False)
-        R._measured_routing_table.__dict__.pop("cache", None)
         assert R._fused_route(8, 8, 256, 64, 256) == ("batch", None)
         table = {"routes": {R.geometry_key(8, 8, 256, 64, 256): "spatial:4"}}
         path = tmp_path / "routing.json"
         path.write_text(_json.dumps(table))
         monkeypatch.setenv("KFTPU_FUSED_ROUTING_TABLE", str(path))
-        R._measured_routing_table.__dict__.pop("cache", None)
         assert R._fused_route(8, 8, 256, 64, 256) == ("spatial", 4)
-        try:
-            self._run_sharded_fused_step()
-        finally:
-            R._measured_routing_table.__dict__.pop("cache", None)
+        self._run_sharded_fused_step()
 
     def test_basicblock_depths_rejected(self):
         from kubeflow_tpu.models import resnet as R

@@ -92,3 +92,16 @@ run_step matrix 1800 python -m kubeflow_tpu.workflows.kubebench matrix \
   --out-dir bench-matrix --steps 40 --global-batch 128
 
 log "session done; artifacts in $RESULTS/ and bench-matrix/"
+
+# land the evidence: a session can finish minutes before the round ends,
+# so the artifacts must not sit uncommitted in the working tree
+if git -C "$(pwd)" rev-parse --is-inside-work-tree >/dev/null 2>&1; then
+  git add "$RESULTS" bench-matrix 2>/dev/null
+  git commit -q -m "TPU measurement session artifacts ($STAMP)
+
+Raw step outputs and JSON rows from hack/tpu_session.sh; see
+$RESULTS/session.log for the step-by-step record.
+
+No-Verification-Needed: measurement artifacts only" 2>/dev/null \
+    && log "artifacts committed" || log "nothing new to commit"
+fi

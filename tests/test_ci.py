@@ -48,11 +48,15 @@ class TestPathMapping:
         by_name = {e.name: e for e in post}
         assert by_name["release_images"].params["registry"].startswith(
             "ghcr.io")
-        # the fast/slow tier split: presubmit excludes slow, the
-        # postsubmit companion runs exactly the slow marker
+        # the tier split: a control-plane smoke gate (no slow, no JAX
+        # compiles), the full fast presubmit, and the slow postsubmit
+        # companion running exactly the slow marker
         assert by_name["unit_tests_slow"].params["pytest_args"] == "-m slow"
-        pre_unit = {e.name: e for e in pre}["unit_tests"]
-        assert pre_unit.params["pytest_args"] == "-m 'not slow'"
+        pre_by_name = {e.name: e for e in pre}
+        assert pre_by_name["unit_tests"].params["pytest_args"] == \
+            "-m 'not slow'"
+        assert pre_by_name["unit_tests_smoke"].params["pytest_args"] == \
+            "-m 'not slow and not compute'"
 
     def test_periodic_ignores_diff(self, entries):
         sel = select_workflows([], entries, trigger="periodic")

@@ -10,6 +10,7 @@ import pytest
 from kubeflow_tpu.data import (NativeRecordPipeline, PyRecordPipeline,
                                RecordPipeline, epoch_order, native_available)
 
+
 RECORD = 64
 
 

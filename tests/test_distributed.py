@@ -19,6 +19,7 @@ import pytest
 
 from kubeflow_tpu.api.topology import parse_topology, render_contracts
 
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

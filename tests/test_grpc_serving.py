@@ -8,6 +8,8 @@ import pytest
 
 from kubeflow_tpu.serving.grpc_server import HAVE_GRPC
 
+pytestmark = pytest.mark.compute  # JAX compile tests: not in smoke tier
+
 if not HAVE_GRPC:  # skip before touching the pb2 module (needs protobuf)
     pytest.skip("grpcio/protobuf unavailable", allow_module_level=True)
 

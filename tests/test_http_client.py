@@ -21,6 +21,7 @@ from kubeflow_tpu.cluster.apiserver import ClusterAPIServer
 from kubeflow_tpu.cluster.http_client import HttpKubeClient
 
 
+
 @pytest.fixture
 def env():
     backend = FakeCluster()

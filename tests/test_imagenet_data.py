@@ -14,6 +14,8 @@ from kubeflow_tpu.data.imagenet import (ImageNetSource, read_meta,
                                         record_bytes, write_shards)
 from kubeflow_tpu.data.pipeline import epoch_order
 
+pytestmark = pytest.mark.compute  # JAX trace/compile tests: excluded from smoke tier
+
 SIZE = 16          # tiny images so resnet runs fast on CPU
 N = 48
 CLASSES = 10

@@ -17,6 +17,8 @@ from kubeflow_tpu.models.moe import MoEMLP, load_balancing_loss
 from kubeflow_tpu.parallel.mesh import build_mesh
 from kubeflow_tpu.runtime.trainstep import TrainStepBuilder
 
+pytestmark = pytest.mark.compute  # JAX trace/compile tests: excluded from smoke tier
+
 
 def tiny_moe_cfg(**kw):
     base = dict(vocab_size=64, num_layers=2, embed_dim=32, num_heads=2,

@@ -15,6 +15,8 @@ from kubeflow_tpu.ops.ring_attention import ring_attention
 from kubeflow_tpu.api.trainingjob import ShardingSpec
 from kubeflow_tpu.parallel.mesh import build_mesh
 
+pytestmark = pytest.mark.compute  # JAX trace/compile tests: excluded from smoke tier
+
 
 def _qkv(b=2, s=128, h=2, d=32, dtype=jnp.float32, seed=0):
     ks = jax.random.split(jax.random.PRNGKey(seed), 3)

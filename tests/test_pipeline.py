@@ -13,6 +13,8 @@ from kubeflow_tpu.parallel.mesh import build_mesh
 from kubeflow_tpu.parallel.pipeline import pipeline_apply, stage_sharding_spec
 from kubeflow_tpu.runtime.trainstep import TrainStepBuilder
 
+pytestmark = pytest.mark.compute  # JAX trace/compile tests: excluded from smoke tier
+
 
 def _linear_blocks(rng, num_layers, dim):
     """Stacked tiny residual-linear blocks: params [L, dim, dim]."""

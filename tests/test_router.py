@@ -7,6 +7,8 @@ import urllib.request
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.compute  # Servable predicts jit-compile
+
 from kubeflow_tpu.serving.router import (ABTestRouter, EpsilonGreedyRouter,
                                          RoutedModel, Router, ShadowRouter)
 

@@ -20,6 +20,8 @@ from kubeflow_tpu.runtime.bootstrap import initialize, sharding_from_env
 from kubeflow_tpu.runtime.metrics import MetricsLogger
 from kubeflow_tpu.runtime.trainstep import TrainStepBuilder
 
+pytestmark = pytest.mark.compute  # JAX trace/compile tests: excluded from smoke tier
+
 
 class TestMesh:
     def test_default_mesh_is_pure_dp(self):

@@ -17,6 +17,8 @@ from kubeflow_tpu.serving import (MicroBatcher, ModelRepository, ModelServer,
 from kubeflow_tpu.serving.batch_predict import run_batch_predict
 from kubeflow_tpu.serving.servable import next_bucket, register_model
 
+pytestmark = pytest.mark.compute  # JAX trace/compile tests: excluded from smoke tier
+
 
 @register_model("double")
 def _build_double(dim: int = 4):

@@ -111,6 +111,7 @@ button.minor{padding:0.3rem 0.8rem;border:1px solid var(--grid);
   <a href="#/activities" data-view="activities">Activities</a>
   <a href="#/metrics" data-view="metrics">Metrics</a>
   <a href="#/notebooks" data-view="notebooks">Notebooks</a>
+  <a href="#/studies" data-view="studies">Studies</a>
   <a href="#/contributors" data-view="contributors">Contributors</a>
   <a href="/logout">Log out</a>
   <div id="env-info"></div>
@@ -174,6 +175,18 @@ class ClusterMetricsService(MetricsService):
                 by_node[node] = by_node.get(node, 0) + 1
         return [{"node": k8s.name_of(n),
                  "value": by_node.get(k8s.name_of(n), 0)} for n in nodes]
+
+
+def _job_phase(obj: dict) -> str:
+    """Shared condition walk for CR-shaped jobs (training jobs, studies):
+    the newest-wins order the runs panel and studies view BOTH use, so
+    one study can never show two phases on one dashboard."""
+    from ..api.trainingjob import (COND_CREATED, COND_FAILED, COND_RUNNING,
+                                   COND_SUCCEEDED)
+    for cond in (COND_SUCCEEDED, COND_FAILED, COND_RUNNING, COND_CREATED):
+        if k8s.condition_true(obj, cond):
+            return cond
+    return "Pending"
 
 
 def build_dashboard_app(client: KubeClient,
@@ -255,19 +268,11 @@ def build_dashboard_app(client: KubeClient,
         """Training jobs + pipeline workflows in one panel — phase,
         progress, timestamps (the run-history view the reference left to
         the external pipeline-ui image)."""
-        from ..api.trainingjob import (API_VERSIONS, COND_CREATED,
-                                       COND_FAILED, COND_RUNNING,
-                                       COND_SUCCEEDED, JOB_KINDS)
+        from ..api.trainingjob import API_VERSIONS, JOB_KINDS
         from ..cluster.client import KubeError
         from ..workflows.engine import (WORKFLOW_API_VERSION, WORKFLOW_KIND)
         ns = params["namespace"]
-
-        def phase_of(obj) -> str:
-            for cond in (COND_SUCCEEDED, COND_FAILED, COND_RUNNING,
-                         COND_CREATED):
-                if k8s.condition_true(obj, cond):
-                    return cond
-            return "Pending"
+        phase_of = _job_phase
 
         def list_kind(api_version, kind):
             # a kind whose CRD is not installed must not 500 the whole
@@ -316,6 +321,41 @@ def build_dashboard_app(client: KubeClient,
                 "phase": phase, "progress": progress, "finishedAt": "",
             })
         out.sort(key=lambda r: (r["kind"], r["name"]))
+        return 200, out
+
+    @app.route("GET", "/api/studies/{namespace}")
+    def studies(params, query, body):
+        """Katib study detail for the dashboard's studies view: per-study
+        phase, objective config, best trial, and the full per-trial
+        objective series (the kubebench-dashboard/katib-UI role served
+        from the StudyJob status the controller maintains)."""
+        from ..cluster.client import KubeError
+        from ..katib.studyjob import STUDYJOB_API_VERSION, STUDYJOB_KIND
+        try:
+            studyjobs = client.list(STUDYJOB_API_VERSION, STUDYJOB_KIND,
+                                    params["namespace"])
+        except KubeError:
+            return 200, []
+        out = []
+        for sj in studyjobs:
+            spec, st = sj.get("spec", {}), sj.get("status") or {}
+            out.append({
+                "name": k8s.name_of(sj),
+                "phase": _job_phase(sj),
+                "objectiveName": spec.get("objectivevaluename", "loss"),
+                "optimization": spec.get("optimizationtype", "minimize"),
+                "trialsTotal": st.get("trialsTotal", 0),
+                "trialsSucceeded": st.get("trialsSucceeded", 0),
+                "trialsFailed": st.get("trialsFailed", 0),
+                "bestTrial": st.get("bestTrial"),
+                "trials": [{
+                    "name": t.get("name", ""),
+                    "status": t.get("status", ""),
+                    "objective": t.get("objective"),
+                    "parameters": t.get("parameters", {}),
+                } for t in (st.get("trials") or [])],
+            })
+        out.sort(key=lambda s: s["name"])
         return 200, out
 
     @app.route("GET", "/api/metrics/{mtype}")

@@ -370,6 +370,99 @@
                     text: "No training jobs or workflow runs." }));
   }
 
+  // -- katib studies (per-trial objective series over /api/studies) ---------
+
+  function trialObjectiveChart(trials, best) {
+    // a SERIES chart, not a magnitude chart: bars stay in trial order
+    // (the search trajectory), widths scale min→max so negative
+    // objectives work, nothing is sorted or folded — overflow past 40
+    // trials is cut with an explicit note, and the best trial is
+    // badged. barChart's desc-sort + summed-Other semantics would be
+    // wrong on objectives (a sum of losses is not a loss).
+    const MAX = 40;
+    const shown = trials.slice(0, MAX);
+    const vals = shown.map((t) => t.objective);
+    const min = Math.min(...vals), max = Math.max(...vals);
+    const span = max - min || Math.abs(max) || 1;
+    const barH = 18, gap = 8, labelW = 170, valueW = 80, plotW = 380;
+    const width = labelW + plotW + valueW;
+    const height = shown.length * (barH + gap) + 4;
+    const svg = el("svg", {
+      viewBox: `0 0 ${width} ${height}`, width: "100%",
+      style: `max-width:${width}px`, role: "img",
+      "aria-label": "trial objectives in run order",
+    });
+    shown.forEach((t, i) => {
+      const y = i * (barH + gap);
+      // floor at 8px so the minimum bar is still visible/hoverable
+      const w = 8 + ((t.objective - min) / span) * (plotW - 8);
+      const name = t.trial + (t.trial === best ? " ★" : "");
+      svg.appendChild(el("text", {
+        x: labelW - 8, y: y + barH - 5, class: "viz-label",
+        "text-anchor": "end",
+        text: name.length > 24 ? name.slice(0, 23) + "…" : name,
+      }));
+      svg.appendChild(el("rect", {
+        x: labelW, y, width: w, height: barH, rx: 4, class: "viz-bar",
+      }));
+      svg.appendChild(el("text", {
+        x: labelW + w + 6, y: y + barH - 5, class: "viz-value",
+        text: String(t.objective),
+      }));
+    });
+    const wrap = el("div", { class: "viz-root" }, [svg]);
+    if (trials.length > MAX) {
+      wrap.appendChild(el("p", {
+        class: "empty",
+        text: `Showing first ${MAX} of ${trials.length} trials — ` +
+          "see the table for the rest.",
+      }));
+    }
+    return wrap;
+  }
+
+  async function viewStudies(root) {
+    const ns = selectedNamespace();
+    const studies = await api(`api/studies/${encodeURIComponent(ns)}`);
+    const blocks = [el("h2", { text: `Katib studies in ${ns}` })];
+    if (!studies.length) {
+      blocks.push(el("p", { class: "empty",
+                            text: "No studies in this namespace." }));
+    }
+    studies.forEach((s) => {
+      blocks.push(el("h3", {}, [
+        el("span", { text: s.name + " " }), statusBadge(s.phase),
+      ]));
+      const tiles = [
+        statTile("Trials", s.trialsTotal),
+        statTile("Succeeded", s.trialsSucceeded),
+        statTile("Failed", s.trialsFailed),
+      ];
+      if (s.bestTrial && s.bestTrial.objective != null) {
+        tiles.push(statTile(
+          `Best ${s.objectiveName} (${s.optimization})`,
+          Math.round(s.bestTrial.objective * 1e4) / 1e4));
+      }
+      blocks.push(el("div", { class: "tiles" }, tiles));
+      const done = s.trials.filter((t) => t.objective != null).map((t) => ({
+        trial: t.name,
+        objective: Math.round(t.objective * 1e4) / 1e4,
+        status: t.status,
+        parameters: JSON.stringify(t.parameters),
+      }));
+      if (done.length) {
+        blocks.push(trialObjectiveChart(
+          done, s.bestTrial && s.bestTrial.name));
+        blocks.push(table(done,
+          ["trial", "objective", "status", "parameters"]));
+      } else {
+        blocks.push(el("p", { class: "empty",
+                              text: "No finished trials yet." }));
+      }
+    });
+    root.replaceChildren(...blocks);
+  }
+
   // -- contributors (the manage-users surface over the KFAM API) ------------
 
   const KFAM_ROLES = ["kubeflow-view", "kubeflow-edit", "kubeflow-admin"];
@@ -447,6 +540,7 @@
     activities: viewActivities,
     metrics: viewMetrics,
     notebooks: viewNotebooks,
+    studies: viewStudies,
     contributors: viewContributors,
   };
 

@@ -21,7 +21,6 @@ from kubeflow_tpu.cluster.apiserver import ClusterAPIServer
 from kubeflow_tpu.cluster.http_client import HttpKubeClient
 
 
-
 @pytest.fixture
 def env():
     backend = FakeCluster()

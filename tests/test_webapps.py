@@ -122,6 +122,47 @@ class TestDashboard:
         finally:
             server.stop()
 
+    def test_studies_api_exposes_trial_series(self, cluster):
+        """/api/studies/{ns}: the studies view's per-trial objective
+        series + best-trial rollup, straight from the StudyJob status
+        the controller maintains."""
+        cluster.create({
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "StudyJob",
+            "metadata": {"name": "tune-lr", "namespace": "kubeflow"},
+            "spec": {"studyName": "tune-lr", "optimizationtype": "minimize",
+                     "objectivevaluename": "loss"},
+            "status": {
+                "conditions": [{"type": "Running", "status": "True"}],
+                "trialsTotal": 3, "trialsSucceeded": 2, "trialsFailed": 0,
+                "bestTrial": {"name": "t-1", "objective": 0.41,
+                              "parameters": {"lr": 0.01}},
+                "trials": [
+                    {"name": "t-0", "status": "Succeeded",
+                     "objective": 0.52, "parameters": {"lr": 0.1}},
+                    {"name": "t-1", "status": "Succeeded",
+                     "objective": 0.41, "parameters": {"lr": 0.01}},
+                    {"name": "t-2", "status": "Running",
+                     "parameters": {"lr": 0.001}},
+                ]},
+        })
+        server = DashboardServer(cluster)
+        port = server.start()
+        try:
+            studies = get_json(
+                f"http://127.0.0.1:{port}/api/studies/kubeflow")
+            assert len(studies) == 1
+            s = studies[0]
+            assert s["phase"] == "Running"
+            assert s["optimization"] == "minimize"
+            assert s["bestTrial"]["objective"] == 0.41
+            assert [t["objective"] for t in s["trials"]] == [0.52, 0.41,
+                                                             None]
+            # a namespace with no StudyJob CRD installed returns []
+            assert get_json(
+                f"http://127.0.0.1:{port}/api/studies/alice") == []
+        finally:
+            server.stop()
+
     def test_activities_sorted_newest_first(self, cluster):
         for i, ts in enumerate(["2026-01-01", "2026-03-01", "2026-02-01"]):
             cluster.create({

@@ -30,8 +30,11 @@ PREFIX = "/apis/v1beta1"
 
 
 def build_pipeline_app(client: KubeClient, store: RunStore,
-                       namespace: str = "kubeflow") -> JsonApp:
-    app = JsonApp()
+                       namespace: str = "kubeflow",
+                       prefix: str = "") -> JsonApp:
+    """``prefix`` mounts the API under a URL base (e.g. "pipeline") so an
+    ingress route /pipeline/ can front it, same as the jupyter app."""
+    app = JsonApp(prefix=prefix)
 
     @app.route("GET", "/healthz")
     def healthz(params, query, body):
@@ -190,7 +193,8 @@ class PipelineAPIServer(JsonServer):
     """Deployable pipeline apiserver (pipeline-apiserver.libsonnet role)."""
 
     def __init__(self, client: KubeClient, store: Optional[RunStore] = None,
-                 namespace: str = "kubeflow", **kw):
+                 namespace: str = "kubeflow", prefix: str = "", **kw):
         self.store = store or RunStore()
-        super().__init__(build_pipeline_app(client, self.store, namespace),
+        super().__init__(build_pipeline_app(client, self.store, namespace,
+                                            prefix=prefix),
                          name="pipeline-api", **kw)

@@ -111,6 +111,7 @@ button.minor{padding:0.3rem 0.8rem;border:1px solid var(--grid);
   <a href="#/activities" data-view="activities">Activities</a>
   <a href="#/metrics" data-view="metrics">Metrics</a>
   <a href="#/notebooks" data-view="notebooks">Notebooks</a>
+  <a href="#/pipelines" data-view="pipelines">Pipelines</a>
   <a href="#/studies" data-view="studies">Studies</a>
   <a href="#/contributors" data-view="contributors">Contributors</a>
   <a href="/logout">Log out</a>

@@ -370,6 +370,116 @@
                     text: "No training jobs or workflow runs." }));
   }
 
+  // -- pipelines (runs + scheduled jobs over the pipeline apiserver,
+  //    ingress-mounted at /pipeline/) ---------------------------------------
+
+  const PIPELINE_API = "pipeline/apis/v1beta1";
+
+  // which run's step detail is open — survives the 15s live re-render
+  let openStepsRun = null;
+
+  function stepsDetail(row) {
+    return el("div", {}, [
+      el("h3", { text: `Steps of ${row.name}` }),
+      table(row._nodes.map((n) => ({
+        step: n.displayName || n.name || n.id || "",
+        phase: n.phase || "",
+        message: n.message || "",
+      })), ["step", "phase", "message"], (c, r2, td2) => {
+        if (c !== "phase") return false;
+        td2.appendChild(statusBadge(r2.phase));
+        return true;
+      }),
+    ]);
+  }
+
+  async function viewPipelines(root) {
+    const ns = selectedNamespace();
+    const err = el("p", { class: "error" });
+    let runs, jobs;
+    try {
+      [runs, jobs] = await Promise.all([
+        api(`${PIPELINE_API}/runs?namespace=${encodeURIComponent(ns)}`)
+          .then((r) => r.runs),
+        api(`${PIPELINE_API}/jobs?namespace=${encodeURIComponent(ns)}`)
+          .then((r) => r.jobs || []),
+      ]);
+    } catch (e) {
+      root.replaceChildren(
+        el("h2", { text: "Pipelines" }),
+        el("p", { class: "empty",
+                  text: "Pipeline API unavailable: " + e.message }));
+      return;
+    }
+    const runRows = runs.map((r) => {
+      const nodes = Object.values(r.nodes || {});
+      const done = nodes.filter((n) => n.phase === "Succeeded").length;
+      return {
+        name: r.name, phase: r.phase,
+        steps: nodes.length ? `${done}/${nodes.length}` : "",
+        schedule: r.schedule || "",
+        _nodes: nodes,
+      };
+    });
+    const blocks = [
+      el("h2", { text: `Pipeline runs in ${ns}` }), err,
+      runRows.length
+        ? table(runRows, ["name", "phase", "steps", "schedule", ""],
+            (col, row, td) => {
+              if (col === "phase") {
+                td.appendChild(statusBadge(row.phase));
+                return true;
+              }
+              if (col !== "") return false;
+              if (!row._nodes.length) return true;
+              td.appendChild(el("button", {
+                class: "minor", text: "steps",
+                onclick: () => {
+                  openStepsRun = row.name;
+                  const detail = document.getElementById("run-steps");
+                  detail.replaceChildren(stepsDetail(row));
+                },
+              }));
+              return true;
+            })
+        : el("p", { class: "empty", text: "No pipeline runs yet." }),
+    ];
+    // re-populate the open step detail across live re-renders
+    const open = runRows.find((r) => r.name === openStepsRun);
+    blocks.push(el("div", { id: "run-steps" },
+                   open ? [stepsDetail(open)] : []));
+    blocks.push(el("h2", { text: "Scheduled jobs" }));
+    const jobRows = jobs.map((j) => {
+        const t = j.trigger || {};
+        const schedule = (t.cronSchedule && t.cronSchedule.cron) ||
+          (t.periodicSchedule &&
+            `every ${t.periodicSchedule.intervalSecond}s`) || "";
+        return { name: j.name, namespace: j.namespace, schedule,
+                 enabled: String(j.enabled), _enabled: j.enabled };
+      });
+    blocks.push(jobRows.length
+      ? table(jobRows, ["name", "schedule", "enabled", ""],
+          (col, row, td) => {
+            if (col !== "") return false;
+            const verb = row._enabled ? "disable" : "enable";
+            td.appendChild(el("button", {
+              class: "minor", text: verb,
+              onclick: async () => {
+                try {
+                  await api(`${PIPELINE_API}/jobs/` +
+                    `${encodeURIComponent(row.namespace || ns)}/` +
+                    `${encodeURIComponent(row.name)}:${verb}`,
+                    { method: "POST" });
+                  render();
+                } catch (e) { err.textContent = e.message; }
+              },
+            }));
+            return true;
+          })
+      : el("p", { class: "empty", text: "No scheduled jobs." }));
+    root.replaceChildren(...blocks);
+  }
+
   // -- katib studies (per-trial objective series over /api/studies) ---------
 
   function trialObjectiveChart(trials, best) {
@@ -540,6 +650,7 @@
     activities: viewActivities,
     metrics: viewMetrics,
     notebooks: viewNotebooks,
+    pipelines: viewPipelines,
     studies: viewStudies,
     contributors: viewContributors,
   };
@@ -602,7 +713,8 @@
   // reference dashboard's behavior) — skipped while a tab is hidden or
   // the reader is mid-interaction with a chart tooltip
   const REFRESH_MS = 15000;
-  const LIVE_VIEWS = new Set(["overview", "runs", "activities"]);
+  const LIVE_VIEWS = new Set(["overview", "runs", "activities",
+                              "pipelines"]);
 
   function startAutoRefresh() {
     setInterval(() => {

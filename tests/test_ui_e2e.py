@@ -18,6 +18,7 @@ import pytest
 from kubeflow_tpu.cluster import FakeCluster
 from kubeflow_tpu.controllers.runtime import Manager
 from kubeflow_tpu.controllers.tpujob import TrainingJobReconciler
+from kubeflow_tpu.pipelines.api_server import PipelineAPIServer
 from kubeflow_tpu.webapps.access_management import AccessManagementServer
 from kubeflow_tpu.webapps.dashboard import DashboardServer
 from kubeflow_tpu.webapps.gatekeeper import Gatekeeper, GatekeeperServer
@@ -63,6 +64,7 @@ def stack():
     dash = up(DashboardServer(cluster))
     jupyter = up(JupyterWebApp(cluster, prefix="jupyter"))
     kfam = up(AccessManagementServer(cluster))
+    pipeline = up(PipelineAPIServer(cluster, prefix="pipeline"))
     gate = up(GatekeeperServer(Gatekeeper(username="admin", password="pw")))
     ingress = up(AuthIngress(
         ExtAuthzVerifier(auth_url=f"http://127.0.0.1:{gate.port}/auth",
@@ -70,6 +72,7 @@ def stack():
         routes=[Route("/", f"127.0.0.1:{dash.port}"),
                 Route("/jupyter/", f"127.0.0.1:{jupyter.port}"),
                 Route("/kfam/", f"127.0.0.1:{kfam.port}"),
+                Route("/pipeline/", f"127.0.0.1:{pipeline.port}"),
                 Route("/login", f"127.0.0.1:{gate.port}"),
                 Route("/logout", f"127.0.0.1:{gate.port}")],
         public_prefixes=("/login", "/logout")))
@@ -165,6 +168,27 @@ def test_login_dashboard_spawn_runs_flow(stack):
     env = json.loads(body)
     assert status == 200 and env["user"]["email"] == "admin"
     assert env["platform"]["kubeflowVersion"]
+
+    # 8b. the pipelines view's API resolves through the ingress: submit a
+    # run with an inline workflow spec, and the runs list shows it
+    run_spec = json.dumps({
+        "name": "ui-run", "namespace": "kubeflow",
+        "workflow": {"spec": {"entrypoint": "main", "templates": [
+            {"name": "main", "steps": [[{"name": "s1",
+                                         "template": "noop"}]]},
+            {"name": "noop", "container": {"image": "t:v1",
+                                           "command": ["true"]}},
+        ]}},
+    }).encode()
+    status, body, _ = fetch(f"{base}/pipeline/apis/v1beta1/runs", cookie,
+                            data=run_spec)
+    assert status == 200, body
+    status, body, _ = fetch(
+        f"{base}/pipeline/apis/v1beta1/runs?namespace=kubeflow", cookie)
+    assert status == 200
+    assert "ui-run" in [r["name"] for r in json.loads(body)["runs"]]
+    status, body, _ = fetch(f"{base}/pipeline/apis/v1beta1/jobs", cookie)
+    assert status == 200 and json.loads(body)["jobs"] == []
 
     # 9. contributors flow exactly as the SPA drives it: add through the
     # ingress-mounted KFAM app, list, remove

@@ -283,6 +283,16 @@ margin-top:1rem;white-space:pre-wrap}
     <option value="gcp">gcp</option>
     <option value="minikube">minikube</option></select>
   <label>GCP project</label><input name="project" placeholder="(gcp only)">
+  <label>zone</label><input name="zone" list="tpu-zones"
+    placeholder="(gcp only, e.g. us-central2-b)">
+  <datalist id="tpu-zones">
+    <option value="us-central1-a"></option>
+    <option value="us-central2-b"></option>
+    <option value="us-east1-d"></option>
+    <option value="us-east5-a"></option>
+    <option value="europe-west4-a"></option>
+    <option value="asia-east1-c"></option>
+  </datalist>
   <label>namespace</label><input name="namespace" value="kubeflow">
   <label>config flavor</label><select name="flavor">
     <option value="">default</option><option>local</option>

@@ -396,21 +396,14 @@
   async function viewPipelines(root) {
     const ns = selectedNamespace();
     const err = el("p", { class: "error" });
-    let runs, jobs;
-    try {
-      [runs, jobs] = await Promise.all([
-        api(`${PIPELINE_API}/runs?namespace=${encodeURIComponent(ns)}`)
-          .then((r) => r.runs),
-        api(`${PIPELINE_API}/jobs?namespace=${encodeURIComponent(ns)}`)
-          .then((r) => r.jobs || []),
-      ]);
-    } catch (e) {
-      root.replaceChildren(
-        el("h2", { text: "Pipelines" }),
-        el("p", { class: "empty",
-                  text: "Pipeline API unavailable: " + e.message }));
-      return;
-    }
+    // errors propagate to renderInto: readable on navigation, and a
+    // failed background poll keeps the last good content (its contract)
+    const [runs, jobs] = await Promise.all([
+      api(`${PIPELINE_API}/runs?namespace=${encodeURIComponent(ns)}`)
+        .then((r) => r.runs),
+      api(`${PIPELINE_API}/jobs?namespace=${encodeURIComponent(ns)}`)
+        .then((r) => r.jobs || []),
+    ]);
     const runRows = runs.map((r) => {
       const nodes = Object.values(r.nodes || {});
       const done = nodes.filter((n) => n.phase === "Succeeded").length;
@@ -435,7 +428,7 @@
               td.appendChild(el("button", {
                 class: "minor", text: "steps",
                 onclick: () => {
-                  openStepsRun = row.name;
+                  openStepsRun = `${ns}/${row.name}`;
                   const detail = document.getElementById("run-steps");
                   detail.replaceChildren(stepsDetail(row));
                 },
@@ -444,8 +437,9 @@
             })
         : el("p", { class: "empty", text: "No pipeline runs yet." }),
     ];
-    // re-populate the open step detail across live re-renders
-    const open = runRows.find((r) => r.name === openStepsRun);
+    // re-populate the open step detail across live re-renders (keyed by
+    // ns/name so a same-named run in another namespace never auto-opens)
+    const open = runRows.find((r) => `${ns}/${r.name}` === openStepsRun);
     blocks.push(el("div", { id: "run-steps" },
                    open ? [stepsDetail(open)] : []));
     blocks.push(el("h2", { text: "Scheduled jobs" }));

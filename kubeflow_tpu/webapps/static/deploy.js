@@ -114,6 +114,9 @@
       namespace: form.namespace.value.trim() || "kubeflow",
     };
     if (form.project.value.trim()) payload.project = form.project.value.trim();
+    if (form.zone && form.zone.value.trim()) {
+      payload.zone = form.zone.value.trim();
+    }
     if (form.flavor.value) payload.flavor = form.flavor.value;
     const components = selectedComponents();
     if (components.length) payload.components = components;

@@ -717,6 +717,11 @@ def main(argv=None) -> int:
                 }
             except Exception as e:  # noqa: BLE001 — artifact must land
                 row["extras"][key] = {"error": f"{type(e).__name__}: {e}"}
+            # flush the partially-enriched row after EVERY sub-bench:
+            # a hard crash in a later in-process TPU sub-bench (e.g. a
+            # Mosaic segfault) must not cost the measurements already
+            # taken — the driver takes the last complete JSON line
+            print(json.dumps(row), flush=True)
 
     print(json.dumps(row))
     print(f"# platform={platform} chips={len(jax.devices())} "

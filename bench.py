@@ -688,42 +688,41 @@ def main(argv=None) -> int:
                           ("lm_long", "lm-long"),
                           ("serving", "serving"),
                           ("fused_blocks", "fused-blocks")):
-            if mode == "fused-blocks":
-                # per-block attribution is the most expensive extra
-                # (10 jit'd block microbenches): only fold it in on TPU
-                # (CPU interpret mode would crawl) and only while the
-                # run is comfortably inside a driver-timeout budget —
-                # recording WHY when skipped, like every absent number
-                if not on_tpu:
-                    row["extras"][key] = {
-                        "error": "skipped: CPU (interpret mode too slow)"}
-                    continue
-                if time.perf_counter() - t_start > 900:
-                    row["extras"][key] = {
-                        "error": "skipped: elapsed budget (900s) reached"}
-                    continue
-            try:
-                sub = in_process[mode]() if on_tpu else \
-                    _run_sub_bench(mode, budget_s=240.0)
+            if mode == "fused-blocks" and not on_tpu:
+                # per-block attribution is the most expensive extra (10
+                # jit'd block microbenches): never on CPU (interpret
+                # mode would crawl), and only inside a driver-timeout
+                # budget — recording WHY, like every absent number
                 row["extras"][key] = {
-                    "metric": sub["metric"], "value": sub["value"],
-                    "unit": sub["unit"], "mfu": sub["mfu"],
-                    **{k: sub["extras"][k] for k in
-                       ("model_tflops", "loss", "latency",
-                        "cold_first_request_s", "warmup_s",
-                        "fused_routing", "blocks",
-                        "routing_table_written", "error")
-                       if k in sub["extras"]},
-                }
-            except Exception as e:  # noqa: BLE001 — artifact must land
-                row["extras"][key] = {"error": f"{type(e).__name__}: {e}"}
-            # flush the partially-enriched row after EVERY sub-bench:
-            # a hard crash in a later in-process TPU sub-bench (e.g. a
-            # Mosaic segfault) must not cost the measurements already
-            # taken — the driver takes the last complete JSON line
+                    "error": "skipped: CPU (interpret mode too slow)"}
+            elif mode == "fused-blocks" and \
+                    time.perf_counter() - t_start > 900:
+                row["extras"][key] = {
+                    "error": "skipped: elapsed budget (900s) reached"}
+            else:
+                try:
+                    sub = in_process[mode]() if on_tpu else \
+                        _run_sub_bench(mode, budget_s=240.0)
+                    row["extras"][key] = {
+                        "metric": sub["metric"], "value": sub["value"],
+                        "unit": sub["unit"], "mfu": sub["mfu"],
+                        **{k: sub["extras"][k] for k in
+                           ("model_tflops", "loss", "latency",
+                            "cold_first_request_s", "warmup_s",
+                            "fused_routing", "blocks",
+                            "routing_table_written", "error")
+                           if k in sub["extras"]},
+                    }
+                except Exception as e:  # noqa: BLE001 — artifact lands
+                    row["extras"][key] = {
+                        "error": f"{type(e).__name__}: {e}"}
+            # flush the enriched row after EVERY sub-bench (including
+            # recorded skips): a hard crash in a later in-process TPU
+            # sub-bench (e.g. a Mosaic segfault) must not cost the
+            # measurements already taken — drivers take the last line
             print(json.dumps(row), flush=True)
-
-    print(json.dumps(row))
+    else:
+        print(json.dumps(row))
     print(f"# platform={platform} chips={len(jax.devices())} "
           f"mode={args.mode} extras={row['extras']}", file=sys.stderr)
     return 0

@@ -1,0 +1,162 @@
+"""Deploy prober: periodic end-to-end deploy drills → Prometheus metrics.
+
+The reference's click-to-deploy prober (testing/test_deploy_app.py:16-35)
+runs the bootstrap deploy API end-to-end on a schedule and exports its
+own Prometheus gauges/counters — CI doubling as availability monitoring.
+This is that component as a first-class support service: each cycle
+drives the bootstrap server's real surface (create → show-until-ready →
+delete), records success/failure counters and the last cycle's latency,
+and serves the standard text exposition through the shared
+MetricsServer handler (``metrics_text`` duck type).
+
+Deployable entrypoint::
+
+    python -m kubeflow_tpu.support.deploy_prober \
+        --url http://bootstrap:8085 --interval 600
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+SUCCESS_COUNT = "deploy_prober_success_total"
+FAILURE_COUNT = "deploy_prober_failure_total"
+LATENCY_GAUGE = "deploy_prober_last_cycle_seconds"
+UP_GAUGE = "deploy_prober_last_cycle_ok"
+
+
+class DeployProber:
+    """One prober instance per bootstrap server URL.
+
+    The cycle mirrors what the deploy UI does (webapps/static/deploy.js):
+    POST /kfctl/e2eDeploy, poll GET /kfctl/apps/{name} until the
+    Available condition lands, then POST /kfctl/apps/delete — so a green
+    prober means the whole control-plane path a user clicks through is
+    live, not just that a port answers."""
+
+    def __init__(self, url: str, app_name: str = "prober",
+                 components: Optional[list] = None,
+                 timeout_s: float = 30.0, poll_tries: int = 10,
+                 clock=time.monotonic):
+        self.url = url.rstrip("/")
+        self.app_name = app_name
+        self.components = components
+        self.timeout_s = timeout_s
+        self.poll_tries = poll_tries
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.successes = 0
+        self.failures = 0
+        self.last_cycle_s = 0.0
+        self.last_ok = 0
+        self.last_error: Optional[str] = None
+
+    # -- wire helpers --------------------------------------------------------
+
+    def _post(self, path: str, payload: dict) -> dict:
+        req = urllib.request.Request(
+            self.url + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return json.loads(resp.read())
+
+    def _get(self, path: str) -> dict:
+        with urllib.request.urlopen(self.url + path,
+                                    timeout=self.timeout_s) as resp:
+            return json.loads(resp.read())
+
+    # -- the drill -----------------------------------------------------------
+
+    def _cycle(self) -> None:
+        payload = {"name": self.app_name, "platform": "existing"}
+        if self.components:
+            payload["components"] = self.components
+        conds: list = []
+        try:
+            self._post("/kfctl/e2eDeploy", payload)
+            for _ in range(self.poll_tries):
+                show = self._get(f"/kfctl/apps/{self.app_name}")
+                conds = show.get("conditions") or []
+                if any(str(c).startswith("Available=True") for c in conds):
+                    return
+                time.sleep(0.2)
+            raise RuntimeError(
+                f"app {self.app_name} never reported Available=True "
+                f"(last conditions: {conds})")
+        finally:
+            # clean up even when the deploy phase itself fails — a
+            # leaked app makes e2eDeploy take the idempotent skip-create
+            # path forever after, so the drill would silently stop
+            # exercising create/generate
+            try:
+                self._post("/kfctl/apps/delete", {"name": self.app_name})
+            except urllib.error.URLError:
+                pass
+
+    def probe(self) -> bool:
+        """One full deploy drill; never raises — a failed deploy IS the
+        signal this prober exists to record."""
+        t0 = self._clock()
+        ok = False
+        err: Optional[str] = None
+        try:
+            self._cycle()
+            ok = True
+        except Exception as e:  # noqa: BLE001 - outage is data
+            err = f"{type(e).__name__}: {e}"
+        dt = self._clock() - t0
+        with self._lock:
+            self.last_cycle_s = dt
+            self.last_ok = 1 if ok else 0
+            if ok:
+                self.successes += 1
+            else:
+                self.failures += 1
+                self.last_error = err
+        return ok
+
+    def metrics_text(self) -> str:
+        with self._lock:
+            return (
+                f"# HELP {UP_GAUGE} 1 if the last deploy drill succeeded\n"
+                f"# TYPE {UP_GAUGE} gauge\n"
+                f"{UP_GAUGE} {self.last_ok}\n"
+                f"# TYPE {SUCCESS_COUNT} counter\n"
+                f"{SUCCESS_COUNT} {self.successes}\n"
+                f"# TYPE {FAILURE_COUNT} counter\n"
+                f"{FAILURE_COUNT} {self.failures}\n"
+                f"# TYPE {LATENCY_GAUGE} gauge\n"
+                f"{LATENCY_GAUGE} {round(self.last_cycle_s, 3)}\n")
+
+    def run_forever(self, interval_s: float = 600.0,
+                    stop: Optional[threading.Event] = None) -> None:
+        from .metric_collector import run_probe_loop
+        run_probe_loop(self.probe, interval_s, stop)
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    from .metric_collector import MetricsServer
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--url", required=True,
+                   help="bootstrap server base URL")
+    p.add_argument("--app-name", default="prober")
+    p.add_argument("--interval", type=float, default=600.0)
+    p.add_argument("--metrics-port", type=int, default=8000)
+    args = p.parse_args(argv)
+    prober = DeployProber(args.url, app_name=args.app_name)
+    server = MetricsServer(prober, port=args.metrics_port)
+    port = server.start()
+    print(f"deploy prober exporting on :{port}/metrics", flush=True)
+    prober.run_forever(interval_s=args.interval)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -75,10 +75,17 @@ class AvailabilityProber:
 
     def run_forever(self, interval_s: float = 30.0,
                     stop: Optional[threading.Event] = None) -> None:
-        stop = stop or threading.Event()
-        while not stop.is_set():
-            self.probe()
-            stop.wait(interval_s)
+        run_probe_loop(self.probe, interval_s, stop)
+
+
+def run_probe_loop(probe: Callable[[], bool], interval_s: float,
+                   stop: Optional[threading.Event] = None) -> None:
+    """Shared probe loop for the support probers (availability, deploy):
+    probe, wait, repeat until the stop event fires."""
+    stop = stop or threading.Event()
+    while not stop.is_set():
+        probe()
+        stop.wait(interval_s)
 
 
 class MetricsServer(ThreadedServer):

@@ -6,6 +6,8 @@ import urllib.request
 
 import pytest
 
+from kubeflow_tpu.support.deploy_prober import DeployProber
+
 from kubeflow_tpu.cluster import FakeCluster
 from kubeflow_tpu.support.echo_server import EchoServer
 from kubeflow_tpu.support.https_redirect import RedirectServer
@@ -54,6 +56,59 @@ class TestMetricCollector:
                 text = r.read().decode()
             assert "kubeflow_availability 1" in text
             assert "# TYPE kubeflow_availability gauge" in text
+        finally:
+            server.stop()
+
+
+class TestDeployProber:
+    """The click-to-deploy prober analog (testing/test_deploy_app.py):
+    a full deploy drill against a LIVE bootstrap server, with Prometheus
+    counters — CI doubling as availability monitoring."""
+
+    @pytest.fixture
+    def bootstrap(self, tmp_path):
+        from kubeflow_tpu.kfctl.bootstrap_server import BootstrapServer
+        server = BootstrapServer(str(tmp_path / "apps"))
+        server.start()
+        yield f"http://127.0.0.1:{server.port}"
+        server.stop()
+
+    def test_full_drill_success_and_cleanup(self, bootstrap):
+        import urllib.request
+        prober = DeployProber(bootstrap, app_name="drill",
+                              components=["access-management"])
+        assert prober.probe() is True
+        assert prober.successes == 1 and prober.failures == 0
+        text = prober.metrics_text()
+        assert "deploy_prober_last_cycle_ok 1" in text
+        assert "deploy_prober_success_total 1" in text
+        assert "deploy_prober_last_cycle_seconds" in text
+        # the drill deleted its app: the next cycle can run (no 409)
+        with urllib.request.urlopen(f"{bootstrap}/kfctl/apps") as r:
+            assert json.loads(r.read())["apps"] == []
+        assert prober.probe() is True
+        assert prober.successes == 2
+
+    def test_failure_is_recorded_not_raised(self):
+        # nothing listens here: the drill fails, the counter records it
+        prober = DeployProber("http://127.0.0.1:9", timeout_s=0.5)
+        assert prober.probe() is False
+        assert prober.failures == 1 and prober.last_ok == 0
+        assert prober.last_error
+        assert "deploy_prober_last_cycle_ok 0" in prober.metrics_text()
+
+    def test_metrics_served_over_http(self, bootstrap):
+        import urllib.request
+        from kubeflow_tpu.support.metric_collector import MetricsServer
+        prober = DeployProber(bootstrap, app_name="drill2")
+        prober.probe()
+        server = MetricsServer(prober)
+        port = server.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics") as r:
+                body = r.read().decode()
+            assert "deploy_prober_success_total 1" in body
         finally:
             server.stop()
 

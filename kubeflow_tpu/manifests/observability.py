@@ -55,6 +55,22 @@ def metric_collector(namespace: str = "kubeflow",
     return [dep, svc]
 
 
+@register("deploy-prober", "End-to-end deploy drill prober "
+                           "(click-to-deploy prober parity, "
+                           "testing/test_deploy_app.py)")
+def deploy_prober(namespace: str = "kubeflow",
+                  bootstrap_url: str = "http://bootstrap.kubeflow:8085",
+                  interval_s: int = 600) -> list[dict]:
+    dep = H.deployment("deploy-prober", namespace,
+                       f"{IMG}/deploy-prober:{VERSION}", port=8000,
+                       env={"BOOTSTRAP_URL": bootstrap_url,
+                            "PROBE_INTERVAL_S": str(interval_s)})
+    svc = H.service("deploy-prober", namespace, 8000)
+    svc["metadata"].setdefault("annotations", {})[
+        "prometheus.io/scrape"] = "true"
+    return [dep, svc]
+
+
 @register("tpu-device-plugin", "TPU device-plugin DaemonSet (the GPU-driver "
                                "installer slot, gcp/gpu-driver.libsonnet)")
 def tpu_device_plugin(namespace: str = "kube-system") -> list[dict]:

@@ -59,7 +59,8 @@ def metric_collector(namespace: str = "kubeflow",
                            "(click-to-deploy prober parity, "
                            "testing/test_deploy_app.py)")
 def deploy_prober(namespace: str = "kubeflow",
-                  bootstrap_url: str = "http://bootstrap.kubeflow:8085",
+                  bootstrap_url: str =
+                  "http://kubeflow-bootstrapper.kubeflow-admin:8085",
                   interval_s: int = 600) -> list[dict]:
     dep = H.deployment("deploy-prober", namespace,
                        f"{IMG}/deploy-prober:{VERSION}", port=8000,

@@ -140,34 +140,14 @@ class DeployProber:
 
 
 def main(argv: Optional[list] = None) -> int:
-    import argparse
-    import os
-
-    from .metric_collector import MetricsServer
-    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    # flags fall back to the env the deploy-prober manifest renders
-    # (manifests/observability.py) so the same module is the container
-    # entrypoint
-    p.add_argument("--url", default=os.environ.get("BOOTSTRAP_URL"),
-                   help="bootstrap server base URL "
-                        "(env fallback: BOOTSTRAP_URL)")
-    p.add_argument("--app-name", default="prober")
-    p.add_argument("--interval", type=float,
-                   default=float(os.environ.get("PROBE_INTERVAL_S", 600)))
-    p.add_argument("--metrics-port", type=int, default=8000)
-    p.add_argument("--metrics-host", default="0.0.0.0",
-                   help="bind address for /metrics (all interfaces by "
-                        "default: Prometheus scrapes the pod IP)")
-    args = p.parse_args(argv)
-    if not args.url:
-        p.error("--url (or BOOTSTRAP_URL) is required")
-    prober = DeployProber(args.url, app_name=args.app_name)
-    server = MetricsServer(prober, host=args.metrics_host,
-                           port=args.metrics_port)
-    port = server.start()
-    print(f"deploy prober exporting on :{port}/metrics", flush=True)
-    prober.run_forever(interval_s=args.interval)
-    return 0
+    from .metric_collector import prober_main
+    return prober_main(
+        argv, description=__doc__.splitlines()[0],
+        url_env="BOOTSTRAP_URL", default_interval=600.0,
+        make_prober=lambda args: DeployProber(args.url,
+                                              app_name=args.app_name),
+        add_args=lambda p: p.add_argument("--app-name", default="prober"),
+        banner="deploy prober")
 
 
 if __name__ == "__main__":

@@ -88,6 +88,54 @@ def run_probe_loop(probe: Callable[[], bool], interval_s: float,
         stop.wait(interval_s)
 
 
+def prober_main(argv: Optional[list], *, description: str, url_env: str,
+                default_interval: float, make_prober,
+                add_args=None, banner: str) -> int:
+    """Shared container entrypoint for the support probers: --url with an
+    env fallback (the manifests render env only), a lazily-validated
+    PROBE_INTERVAL_S, /metrics bound on all interfaces (Prometheus
+    scrapes the pod IP). ``make_prober(args)`` builds the prober;
+    ``add_args(parser)`` registers prober-specific flags."""
+    import argparse
+    import os
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("--url", default=os.environ.get(url_env),
+                   help=f"target base URL (env fallback: {url_env})")
+    p.add_argument("--interval", type=float, default=None,
+                   help="seconds between drills (env fallback: "
+                        f"PROBE_INTERVAL_S; default {default_interval})")
+    p.add_argument("--metrics-port", type=int, default=8000)
+    p.add_argument("--metrics-host", default="0.0.0.0")
+    if add_args:
+        add_args(p)
+    args = p.parse_args(argv)
+    if not args.url:
+        p.error(f"--url (or {url_env}) is required")
+    if args.interval is None:
+        raw = os.environ.get("PROBE_INTERVAL_S")
+        try:
+            args.interval = float(raw) if raw else default_interval
+        except ValueError:
+            p.error(f"PROBE_INTERVAL_S={raw!r} is not a number")
+    prober = make_prober(args)
+    server = MetricsServer(prober, host=args.metrics_host,
+                           port=args.metrics_port)
+    port = server.start()
+    print(f"{banner} exporting on :{port}/metrics", flush=True)
+    prober.run_forever(interval_s=args.interval)
+    return 0
+
+
+def main(argv: Optional[list] = None) -> int:
+    """Container entrypoint for the metric-collector manifest
+    (manifests/observability.py renders TARGET_URL/PROBE_INTERVAL_S)."""
+    return prober_main(
+        argv, description=__doc__.splitlines()[0], url_env="TARGET_URL",
+        default_interval=30.0,
+        make_prober=lambda args: AvailabilityProber(args.url),
+        banner="metric collector")
+
+
 class MetricsServer(ThreadedServer):
     """Serves the prober's /metrics (prometheus scrape target)."""
 
@@ -111,3 +159,7 @@ class MetricsServer(ThreadedServer):
 
         super().__init__(Handler, host=host, port=port,
                          name="metric-collector")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -9,10 +9,12 @@ delete), records success/failure counters and the last cycle's latency,
 and serves the standard text exposition through the shared
 MetricsServer handler (``metrics_text`` duck type).
 
-Deployable entrypoint::
+Deployable entrypoint (the deploy-prober manifest renders the same
+target as BOOTSTRAP_URL)::
 
     python -m kubeflow_tpu.support.deploy_prober \
-        --url http://bootstrap:8085 --interval 600
+        --url http://kubeflow-bootstrapper.kubeflow-admin:8085 \
+        --interval 600
 """
 
 from __future__ import annotations

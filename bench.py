@@ -435,6 +435,38 @@ def bench_serving(t_start: float | None = None) -> dict:
     }
 
 
+def assemble_block_row(count: int, route_str: str, xla_s: float,
+                       fused_s: float | None) -> tuple[dict, str, float]:
+    """Fold one geometry's timings into its artifact row: returns
+    (row, winner_route, winner_seconds). Pure — unit-tested so the
+    routing table the TPU session publishes can't regress on logic."""
+    row = {"count": count, "route_model": route_str,
+           "xla_ms": round(xla_s * 1e3, 3)}
+    if fused_s is not None:
+        row["fused_ms"] = round(fused_s * 1e3, 3)
+        row["fused_vs_xla"] = round(xla_s / fused_s, 3)
+    winner_s = min(xla_s, fused_s) if fused_s is not None else xla_s
+    winner = "xla" if winner_s == xla_s else route_str
+    row["winner"] = winner
+    return row, winner, winner_s
+
+
+def publish_routing_table(routes: dict, path: str, meta: dict) -> None:
+    """Atomically publish the measured routing table for
+    KFTPU_FUSED_ROUTING_TABLE consumers: the directory is created (losing
+    minutes of TPU microbench time to a missing bench-matrix/ in the cwd
+    would be absurd) and a timeout mid-dump can't leave a truncated
+    file."""
+    import os
+    out_dir = os.path.dirname(path)
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({**meta, "routes": routes}, f, indent=1)
+    os.replace(tmp, path)
+
+
 def bench_fused_blocks(t_start: float | None = None,
                        routing_out: str | None = None) -> dict:
     """Per-block kernel attribution: for every distinct stride-1
@@ -499,8 +531,6 @@ def bench_fused_blocks(t_start: float | None = None,
             lambda xin, p: R._xla_block_train(xin, p, 1), x, params)
         kind, th = R._fused_route(h, h, cin, cmid, cout)
         route_str = kind + (f":{th}" if th is not None else "")
-        row = {"count": geom["count"], "route_model": route_str,
-               "xla_ms": round(xla_s * 1e3, 3)}
         fused_s = None
         if kind == "batch":
             fused_s = time_block(
@@ -509,12 +539,8 @@ def bench_fused_blocks(t_start: float | None = None,
             fused_s = time_block(
                 lambda xin, p, _th=th: fused_bottleneck_train_spatial(
                     xin, p, tile_h=_th), x, params)
-        if fused_s is not None:
-            row["fused_ms"] = round(fused_s * 1e3, 3)
-            row["fused_vs_xla"] = round(xla_s / fused_s, 3)
-        winner_s = min(xla_s, fused_s) if fused_s is not None else xla_s
-        winner = "xla" if winner_s == xla_s else route_str
-        row["winner"] = winner
+        row, winner, winner_s = assemble_block_row(
+            geom["count"], route_str, xla_s, fused_s)
         rows[geom["key"]] = row
         routes[geom["key"]] = winner
         xla_total += xla_s * geom["count"]
@@ -524,20 +550,10 @@ def bench_fused_blocks(t_start: float | None = None,
     # (PERF.md roofline), so the end-to-end bound is conservative
     speedup_blocks = xla_total / best_total if best_total else 1.0
     if routing_out and on_tpu:
-        # atomic publish: a timeout mid-dump must not leave a truncated
-        # table for KFTPU_FUSED_ROUTING_TABLE consumers; create the
-        # directory — losing minutes of TPU microbench time to a missing
-        # bench-matrix/ in the cwd would be absurd
-        out_dir = os.path.dirname(routing_out)
-        if out_dir:
-            os.makedirs(out_dir, exist_ok=True)
-        tmp = routing_out + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"device_kind": getattr(dev, "device_kind",
-                                              dev.platform),
-                       "batch": batch, "image_size": image_size,
-                       "routes": routes}, f, indent=1)
-        os.replace(tmp, routing_out)
+        publish_routing_table(
+            routes, routing_out,
+            {"device_kind": getattr(dev, "device_kind", dev.platform),
+             "batch": batch, "image_size": image_size})
     return {
         "metric": "resnet50_fused_block_microbench",
         "value": round(speedup_blocks, 3),

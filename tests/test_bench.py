@@ -1,0 +1,88 @@
+"""Bench artifact contracts that must not regress before a TPU session:
+the fused-blocks row/winner assembly and the routing-table publish the
+measured-routing path consumes (bench.py; KFTPU_FUSED_ROUTING_TABLE in
+models/resnet.py). Pure logic — no kernels run here."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # bench.py lives at the repo root
+
+from bench import assemble_block_row, publish_routing_table  # noqa: E402
+
+
+class TestAssembleBlockRow:
+    def test_fused_wins(self):
+        row, winner, winner_s = assemble_block_row(
+            5, "batch", xla_s=0.010, fused_s=0.008)
+        assert winner == "batch" and winner_s == 0.008
+        assert row == {"count": 5, "route_model": "batch",
+                       "xla_ms": 10.0, "fused_ms": 8.0,
+                       "fused_vs_xla": 1.25, "winner": "batch"}
+
+    def test_xla_wins(self):
+        row, winner, winner_s = assemble_block_row(
+            3, "spatial:14", xla_s=0.010, fused_s=0.021)
+        assert winner == "xla" and winner_s == 0.010
+        assert row["fused_vs_xla"] == 0.476
+        assert row["route_model"] == "spatial:14"
+
+    def test_no_fused_measurement_keeps_xla(self):
+        row, winner, winner_s = assemble_block_row(
+            2, "xla", xla_s=0.004, fused_s=None)
+        assert winner == "xla" and winner_s == 0.004
+        assert "fused_ms" not in row and "fused_vs_xla" not in row
+
+    def test_tie_prefers_xla(self):
+        # equal times must not flip routing away from the default path
+        _, winner, _ = assemble_block_row(1, "batch", 0.01, 0.01)
+        assert winner == "xla"
+
+
+class TestPublishRoutingTable:
+    def test_written_table_round_trips_through_fused_route(self, tmp_path,
+                                                           monkeypatch):
+        """The file the microbench publishes is exactly what
+        _fused_route consumes — winner strings included."""
+        from kubeflow_tpu.models import resnet as R
+        routes = {
+            R.geometry_key(7, 7, 2048, 512, 2048): "xla",
+            R.geometry_key(14, 14, 1024, 256, 1024): "batch",
+            R.geometry_key(56, 56, 256, 64, 256): "spatial:14",
+        }
+        path = tmp_path / "out" / "routing.json"   # dir does not exist
+        publish_routing_table(routes, str(path),
+                              {"device_kind": "TPU v5 lite"})
+        saved = json.loads(path.read_text())
+        assert saved["device_kind"] == "TPU v5 lite"
+        monkeypatch.setenv("KFTPU_FUSED_ROUTING_TABLE", str(path))
+        assert R._fused_route(7, 7, 2048, 512, 2048) == ("xla", None)
+        assert R._fused_route(14, 14, 1024, 256, 1024) == ("batch", None)
+        assert R._fused_route(56, 56, 256, 64, 256) == ("spatial", 14)
+        # no stray temp file after the atomic publish
+        assert sorted(p.name for p in path.parent.iterdir()) == \
+            ["routing.json"]
+
+    def test_overwrite_is_atomic_replace(self, tmp_path):
+        path = tmp_path / "routing.json"
+        publish_routing_table({"a": "xla"}, str(path), {})
+        publish_routing_table({"a": "batch"}, str(path), {})
+        assert json.loads(path.read_text())["routes"] == {"a": "batch"}
+
+
+def test_bench_row_winner_strings_match_route_parser(tmp_path, monkeypatch):
+    """Every winner string assemble_block_row can emit parses back to a
+    route in _fused_route's vocabulary — published through the real
+    writer, consumed through the real reader."""
+    from kubeflow_tpu.models import resnet as R
+    for i, (route_str, expect) in enumerate(
+            (("batch", ("batch", None)), ("spatial:4", ("spatial", 4)))):
+        _, winner, _ = assemble_block_row(1, route_str, 1.0, 0.5)
+        assert winner == route_str
+        path = tmp_path / f"routing-{i}.json"
+        publish_routing_table({R.geometry_key(1, 1, 1, 1, 1): winner},
+                              str(path), {})
+        monkeypatch.setenv("KFTPU_FUSED_ROUTING_TABLE", str(path))
+        assert R._fused_route(1, 1, 1, 1, 1) == expect

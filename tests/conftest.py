@@ -26,3 +26,15 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_platform_name", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clear_fused_routing_env(monkeypatch):
+    """Shield every test from ambient fused-routing env (the TPU
+    measurement session exports KFTPU_FUSED_DISABLE_SPATIAL and a
+    routing table; tests that WANT them set them via monkeypatch, which
+    runs after this autouse delenv)."""
+    monkeypatch.delenv("KFTPU_FUSED_DISABLE_SPATIAL", raising=False)
+    monkeypatch.delenv("KFTPU_FUSED_ROUTING_TABLE", raising=False)

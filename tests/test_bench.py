@@ -57,7 +57,6 @@ class TestPublishRoutingTable:
                               {"device_kind": "TPU v5 lite"})
         saved = json.loads(path.read_text())
         assert saved["device_kind"] == "TPU v5 lite"
-        monkeypatch.delenv("KFTPU_FUSED_DISABLE_SPATIAL", raising=False)
         monkeypatch.setenv("KFTPU_FUSED_ROUTING_TABLE", str(path))
         assert R._fused_route(7, 7, 2048, 512, 2048) == ("xla", None)
         assert R._fused_route(14, 14, 1024, 256, 1024) == ("batch", None)
@@ -78,7 +77,6 @@ def test_bench_row_winner_strings_match_route_parser(tmp_path, monkeypatch):
     route in _fused_route's vocabulary — published through the real
     writer, consumed through the real reader."""
     from kubeflow_tpu.models import resnet as R
-    monkeypatch.delenv("KFTPU_FUSED_DISABLE_SPATIAL", raising=False)
     for i, (route_str, expect) in enumerate(
             (("batch", ("batch", None)), ("spatial:4", ("spatial", 4)))):
         _, winner, _ = assemble_block_row(1, route_str, 1.0, 0.5)

@@ -712,9 +712,14 @@ def main(argv=None) -> int:
                 row["extras"][key] = {
                     "error": "skipped: CPU (interpret mode too slow)"}
             elif mode == "fused-blocks" and \
-                    time.perf_counter() - t_start > 900:
+                    time.perf_counter() - t_start > 2400:
+                # a TPU mode=all run spends ~15-20 min on the earlier
+                # sub-benches (first-compile costs), so the gate must
+                # leave room — and it can afford to: every earlier
+                # result is already flushed, so a driver timeout during
+                # the microbench loses nothing but the microbench
                 row["extras"][key] = {
-                    "error": "skipped: elapsed budget (900s) reached"}
+                    "error": "skipped: elapsed budget (2400s) reached"}
             else:
                 try:
                     sub = in_process[mode]() if on_tpu else \

@@ -160,31 +160,61 @@ def openmpi_controller(namespace: str = "kubeflow") -> list[dict]:
 def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
                    topology: str = "v5e-8", steps: int = 100,
                    global_batch: int = 1024,
-                   fused_blocks: bool = False) -> list[dict]:
+                   fused_blocks: bool = False,
+                   fused_routing: dict | None = None) -> list[dict]:
     """fused_blocks opts into the ghost-BN fused bottleneck kernels
-    (docs/training.md --fused-blocks; per-block batch/spatial routing)."""
+    (docs/training.md --fused-blocks; per-block batch/spatial routing).
+    ``fused_routing`` pins the per-geometry kernel routing to a
+    chip-measured table (the ``bench.py --mode fused-blocks`` output's
+    ``routes`` dict): it renders as a ConfigMap mounted into the worker
+    with KFTPU_FUSED_ROUTING_TABLE pointing at it — measured beats
+    modeled (PERF.md round 5)."""
     command = ["python", "-m", "kubeflow_tpu.runtime.worker",
                "--workload", "resnet50",
                "--steps", str(steps),
                "--global-batch", str(global_batch)]
     if fused_blocks:
         command.append("--fused-blocks")
+    container: dict = {
+        "name": "worker",
+        "image": f"{IMG}/worker:{WORKER_VERSION}",
+        "command": command,
+    }
+    pod_spec: dict = {"containers": [container]}
+    out: list[dict] = []
+    if fused_routing is not None:
+        if not fused_blocks:
+            # a mounted table the worker never reads is a silent no-op
+            # the user would mistake for pinned routing
+            raise ValueError("fused_routing requires fused_blocks=True "
+                             "(only the fused path consults the table)")
+        import json
+        mount_dir = "/etc/kubeflow/fused-routing"
+        cm = H.config_map(f"{name}-fused-routing", namespace, {
+            "routing.json": json.dumps({"routes": fused_routing},
+                                       indent=1)})
+        out.append(cm)
+        container["env"] = [{"name": "KFTPU_FUSED_ROUTING_TABLE",
+                             "value": f"{mount_dir}/routing.json"}]
+        container["volumeMounts"] = [{"name": "fused-routing",
+                                      "mountPath": mount_dir,
+                                      "readOnly": True}]
+        pod_spec["volumes"] = [{"name": "fused-routing",
+                                "configMap": {
+                                    "name": cm["metadata"]["name"]}}]
     job = k8s.make(TPU_API_VERSION, "TPUJob", name, namespace)
     job["spec"] = {
         "replicaSpecs": {
             "TPU": {
                 "tpuTopology": topology,
-                "template": {"spec": {"containers": [{
-                    "name": "worker",
-                    "image": f"{IMG}/worker:{WORKER_VERSION}",
-                    "command": command,
-                }]}},
+                "template": {"spec": pod_spec},
             },
         },
         "runPolicy": {"backoffLimit": 3},
         "sharding": {"data": -1},
     }
-    return [job]
+    out.append(job)
+    return out
 
 
 @register("tf-job-simple", "Example TFJob: 1 chief + 1 worker CPU benchmark "

@@ -134,6 +134,35 @@ class TestGoldenManifests:
         parsed = TrainingJob.from_manifest(job)  # example must be admissible
         assert parsed.tpu_spec.topology.name == "v5e-32"
 
+    def test_tpu_job_measured_routing_configmap(self):
+        """fused_routing renders a mounted ConfigMap + the env var the
+        worker's routing reads — the k8s path for deploying a
+        chip-measured kernel routing table (bench fused-blocks output)."""
+        import json
+        routes = {"56x56_256_64_256": "spatial:14",
+                  "7x7_2048_512_2048": "xla"}
+        objs = build_component("tpu-job-simple", {
+            "fused_blocks": True, "fused_routing": routes})
+        cm = next(o for o in objs if o["kind"] == "ConfigMap")
+        assert json.loads(cm["data"]["routing.json"])["routes"] == routes
+        job = next(o for o in objs if o["kind"] == "TPUJob")
+        spec = job["spec"]["replicaSpecs"]["TPU"]["template"]["spec"]
+        c = spec["containers"][0]
+        assert "--fused-blocks" in c["command"]
+        env = {e["name"]: e["value"] for e in c["env"]}
+        path = env["KFTPU_FUSED_ROUTING_TABLE"]
+        mount = c["volumeMounts"][0]
+        assert path.startswith(mount["mountPath"])
+        assert spec["volumes"][0]["configMap"]["name"] == \
+            cm["metadata"]["name"]
+        # the example must stay admissible with the routing attached
+        from kubeflow_tpu.api.trainingjob import TrainingJob
+        TrainingJob.from_manifest(job)
+        # a table without the fused path would be a silent no-op: rejected
+        import pytest
+        with pytest.raises(ValueError, match="fused_blocks"):
+            build_component("tpu-job-simple", {"fused_routing": routes})
+
     def test_tpu_serving_simple_example(self):
         """tf-serving-simple analog: smallest useful serving instance."""
         objs = build_component("tpu-serving-simple")

@@ -17,13 +17,33 @@
 #   6. kubebench matrix refresh               (verdict item 8)
 set -u
 cd "$(dirname "$0")/.." || exit 1
-RESULTS="${1:-bench-results}"
+
+# KFTPU_SESSION_REHEARSAL=1: full dry run of this script's plumbing on
+# CPU (run with JAX_PLATFORMS=cpu) — results default to a separate dir,
+# the matrix + routing-table outputs stay inside it, and nothing is
+# auto-committed, so a rehearsal can never clobber or pollute real
+# measurement artifacts.
+REHEARSAL="${KFTPU_SESSION_REHEARSAL:-}"
+if [ -n "$REHEARSAL" ]; then
+  RESULTS="${1:-rehearsal-results}"
+else
+  RESULTS="${1:-bench-results}"
+fi
 mkdir -p "$RESULTS"
 STAMP=$(date -u +%Y%m%dT%H%M%SZ)
 log() { echo "[tpu-session $(date -u +%T)] $*"; }
 
+MATRIX_DIR="bench-matrix"
+ROUTING_TABLE="bench-matrix/fused_routing_measured.json"
+if [ -n "$REHEARSAL" ]; then
+  MATRIX_DIR="$RESULTS/matrix"
+  ROUTING_TABLE="$RESULTS/fused_routing_measured.json"
+  log "REHEARSAL mode: results -> $RESULTS, no artifact commit"
+fi
+
 log "probing backend (300s budget)"
-if ! timeout 300 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+if ! timeout -k 60 300 python -c "import jax; jax.devices()" \
+    >/dev/null 2>&1; then
   log "tunnel dead — aborting (nothing written)"
   exit 1
 fi
@@ -32,7 +52,10 @@ log "tunnel UP"
 run_step() {  # name, budget_s, cmd...
   local name="$1" budget="$2"; shift 2
   log "step $name (budget ${budget}s)"
-  if timeout "$budget" "$@" > "$RESULTS/$name-$STAMP.out" 2> \
+  # -k: a worker stuck in native XLA code defers SIGTERM indefinitely
+  # (observed in the CPU rehearsal) — escalate to SIGKILL so one wedged
+  # step can never absorb the rest of the tunnel window
+  if timeout -k 60 "$budget" "$@" > "$RESULTS/$name-$STAMP.out" 2> \
       "$RESULTS/$name-$STAMP.err"; then
     grep -E '^\{' "$RESULTS/$name-$STAMP.out" | tail -1 \
       > "$RESULTS/$name-$STAMP.json" || true
@@ -69,15 +92,15 @@ fi
 run_step lm-long  900 python bench.py --mode lm-long
 run_step serving  1200 python bench.py --mode serving
 # per-block kernel attribution for the fused path's measured 0.53x —
-# writes bench-matrix/fused_routing_measured.json (the table
-# fused_train_apply consumes via KFTPU_FUSED_ROUTING_TABLE), then
-# re-measures end-to-end with measured routing. Remove any prior
-# session's table first: the -s gate below must see THIS session's
-# measurements or nothing.
-rm -f bench-matrix/fused_routing_measured.json
-run_step fused-blocks 1800 python bench.py --mode fused-blocks
-if [ -s bench-matrix/fused_routing_measured.json ]; then
-  KFTPU_FUSED_ROUTING_TABLE=bench-matrix/fused_routing_measured.json \
+# writes the routing table fused_train_apply consumes via
+# KFTPU_FUSED_ROUTING_TABLE, then re-measures end-to-end with measured
+# routing. Remove any prior session's table first: the -s gate below
+# must see THIS session's measurements or nothing.
+rm -f "$ROUTING_TABLE"
+run_step fused-blocks 1800 python bench.py --mode fused-blocks \
+  --routing-out "$ROUTING_TABLE"
+if [ -s "$ROUTING_TABLE" ]; then
+  KFTPU_FUSED_ROUTING_TABLE="$ROUTING_TABLE" \
     run_step fused-measured-routing 1200 python bench.py --mode resnet-fused
 fi
 
@@ -88,14 +111,16 @@ KFTPU_COMPILE_CACHE_DIR="$CACHE" run_step cache-cold 900 \
 KFTPU_COMPILE_CACHE_DIR="$CACHE" run_step cache-warm 900 \
   python bench.py --mode resnet
 
-run_step matrix 1800 python -m kubeflow_tpu.workflows.kubebench matrix \
-  --out-dir bench-matrix --steps 40 --global-batch 128
+# several training configs + first-compile costs: needs the largest budget
+run_step matrix 2700 python -m kubeflow_tpu.workflows.kubebench matrix \
+  --out-dir "$MATRIX_DIR" --steps 40 --global-batch 128
 
 log "session done; artifacts in $RESULTS/ and bench-matrix/"
 
 # land the evidence: a session can finish minutes before the round ends,
 # so the artifacts must not sit uncommitted in the working tree
-if git -C "$(pwd)" rev-parse --is-inside-work-tree >/dev/null 2>&1; then
+if [ -z "$REHEARSAL" ] && \
+    git -C "$(pwd)" rev-parse --is-inside-work-tree >/dev/null 2>&1; then
   git add "$RESULTS" bench-matrix 2>/dev/null
   git commit -q -m "TPU measurement session artifacts ($STAMP)
 

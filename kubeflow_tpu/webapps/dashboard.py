@@ -84,6 +84,7 @@ nav.tabs a.active{font-weight:700;text-decoration:none}
 .badge-running{color:var(--series-1)}
 .badge-warning{color:var(--text-secondary)}
 .badge-critical{color:var(--status-critical)}
+.badge-neutral{color:var(--text-muted)}
 button.minor{padding:0.3rem 0.8rem;border:1px solid var(--grid);
  border-radius:4px;background:var(--surface-2);
  color:var(--text-primary);cursor:pointer;margin-bottom:0.4rem}

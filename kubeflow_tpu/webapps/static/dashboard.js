@@ -86,12 +86,16 @@
     Pending: ["warning", "⏳"],     // ⏳
   };
 
-  function statusBadge(phase) {
-    const [cls, icon] = PHASE_STATUS[phase] || ["neutral", "•"];
+  function badge(cls, icon, label) {
     return el("span", { class: `badge badge-${cls}` }, [
       el("span", { class: "badge-icon", text: icon, "aria-hidden": "true" }),
-      el("span", { text: " " + phase }),
+      el("span", { text: " " + label }),
     ]);
+  }
+
+  function statusBadge(phase) {
+    const [cls, icon] = PHASE_STATUS[phase] || ["neutral", "•"];
+    return badge(cls, icon, phase);
   }
 
   // -- stat tiles ------------------------------------------------------------
@@ -311,6 +315,25 @@
         ["node", "value"]));
   }
 
+  function relativeTime(iso) {
+    // "3m ago" with the absolute timestamp on hover (activities-list.js
+    // formatting role); empty/unparseable timestamps pass through
+    const t = Date.parse(iso);
+    if (!iso || Number.isNaN(t)) return el("span", { text: iso || "" });
+    const s = Math.max(0, (Date.now() - t) / 1000);
+    const label = s < 90 ? `${Math.round(s)}s ago`
+      : s < 5400 ? `${Math.round(s / 60)}m ago`
+      : s < 129600 ? `${Math.round(s / 3600)}h ago`
+      : `${Math.round(s / 86400)}d ago`;
+    return el("span", { title: iso, text: label });
+  }
+
+  const EVENT_ICONS = {
+    Normal: ["neutral", "ℹ"],
+    Warning: ["warning", "⚠"],
+    Error: ["critical", "✗"],
+  };
+
   async function viewActivities(root) {
     const ns = selectedNamespace();
     const acts = await api(`api/activities/${encodeURIComponent(ns)}`);
@@ -318,7 +341,19 @@
       el("h2", { text: `Activities in ${ns}` }),
       acts.length
         ? table(acts, ["type", "reason", "involvedObject", "message",
-                       "lastTimestamp"])
+                       "lastTimestamp"], (col, row, td) => {
+            if (col === "type") {
+              const [cls, icon] = EVENT_ICONS[row.type] ||
+                EVENT_ICONS.Normal;
+              td.appendChild(badge(cls, icon, row.type));
+              return true;
+            }
+            if (col === "lastTimestamp") {
+              td.appendChild(relativeTime(row.lastTimestamp));
+              return true;
+            }
+            return false;
+          })
         : el("p", { class: "empty", text: "No recent events." }));
   }
 

@@ -122,6 +122,18 @@ class TestDashboard:
         finally:
             server.stop()
 
+    def test_sidebar_links_match_registered_views(self):
+        """Every data-view link in the shell has a registered view in
+        the bundle and vice versa — a link without a view silently falls
+        back to overview, which this pins against."""
+        import re
+        from kubeflow_tpu.webapps.dashboard import INDEX_HTML, _read_app_js
+        links = set(re.findall(r'data-view="(\w+)"', INDEX_HTML))
+        views_block = re.search(r"const VIEWS = \{(.*?)\};", _read_app_js(),
+                                re.S).group(1)
+        views = set(re.findall(r"(\w+):\s*view\w+", views_block))
+        assert links == views, (links, views)
+
     def test_studies_api_exposes_trial_series(self, cluster):
         """/api/studies/{ns}: the studies view's per-trial objective
         series + best-trial rollup, straight from the StudyJob status

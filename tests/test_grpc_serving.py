@@ -94,6 +94,21 @@ class TestPredictionService:
         np.testing.assert_allclose(out, [[3.0, 5.0]])
         assert resp.model_spec.signature_name == "serving_default"
 
+    def test_client_predict_grpc_helper(self, served):
+        """serving.client.predict_grpc (the inception-client gRPC wire):
+        REST-shaped result from the binary surface."""
+        ms, _ = served
+        from kubeflow_tpu.serving.client import _first_output, predict_grpc
+        gs2 = GrpcPredictServer(ms, host="127.0.0.1", port=0)
+        gport = gs2.start()
+        try:
+            res = predict_grpc(f"127.0.0.1:{gport}", "double",
+                               [[2.0, 4.0]])
+        finally:
+            gs2.stop()
+        preds = _first_output(res["predictions"])
+        np.testing.assert_allclose(preds, [[4.0, 8.0]])
+
     def test_predict_shares_rest_batchers(self, served):
         """gRPC traffic goes through the same MicroBatcher as REST —
         one device queue per model."""

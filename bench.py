@@ -571,6 +571,153 @@ def bench_fused_blocks(t_start: float | None = None,
     }
 
 
+def estimate_weight_update_hbm(param_elems: int, state_elems: int,
+                               n_rep: int) -> dict:
+    """Estimated per-chip HBM bytes ONE optimizer update moves (all f32):
+    reads the reduced gradients + params + optimizer state, writes params
+    + optimizer state — 4·(3P + 2S) bytes replicated. The ZeRO-2 sharded
+    update touches a 1/N shard of each, so per-chip traffic is ~full/N
+    (the all-gather's full-param write is the step's one remaining
+    full-size HBM pass and is counted against BOTH paths by the final
+    param write). Pure — unit-tested, and the A/B artifact row embeds it
+    so the measured delta is always next to the modeled bound."""
+    full = 4 * (3 * param_elems + 2 * state_elems)
+    return {
+        "param_elems": param_elems,
+        "opt_state_elems": state_elems,
+        "replicas": n_rep,
+        "full_bytes_per_chip": full,
+        "sharded_bytes_per_chip": -(-full // n_rep),
+    }
+
+
+def collective_counts(hlo_text: str) -> dict:
+    """Count the weight-update collectives in compiled HLO: reduce-scatter,
+    all-gather, and NON-scalar all-reduce ops (a scalar f32[] all-reduce is
+    the loss/grad-norm mean, not a full-gradient reduction). Async forms
+    count via their ``-start`` op (XLA:TPU converts collectives to
+    start/done pairs; only the start names the operands — counting it
+    alone avoids double-counting, and the sync form still matches bare).
+    The acceptance signal for the sharded path is reduce_scatter > 0,
+    all_gather > 0, all_reduce_nonscalar == 0."""
+    import re
+    ops = {"reduce_scatter": 0, "all_gather": 0, "all_reduce_nonscalar": 0}
+    for line in hlo_text.splitlines():
+        # op lines look like "%name = f32[128,8]{1,0} reduce-scatter(..."
+        # (the result shape may be a tuple for combined collectives, so
+        # match lazily up to the opcode and inspect every shape bracket)
+        m = re.match(r"\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.*?)\s*"
+                     r"(reduce-scatter|all-gather|all-reduce)(?:-start)?\(",
+                     line)
+        if not m:
+            continue
+        shape, op = m.groups()
+        if op == "reduce-scatter":
+            ops["reduce_scatter"] += 1
+        elif op == "all-gather":
+            ops["all_gather"] += 1
+        elif any(re.findall(r"\[[0-9]", shape)):   # any non-scalar operand
+            ops["all_reduce_nonscalar"] += 1
+    return ops
+
+
+def bench_weight_update(t_start: float | None = None) -> dict:
+    """A/B the cross-replica sharded weight update (ZeRO-2, Xu et al.)
+    against the replicated update on the headline ResNet-50 regime:
+    same model, same data, same optimizer, weight_update flipped. Records
+    per-step times for both paths, the loss delta (must be ≤1e-5 — the
+    sharded path is numerics-identical), the compiled step's collective
+    mix, and the modeled per-chip optimizer HBM bytes (full vs 1/N) so
+    the measured delta lands next to the bound it is chasing (PERF.md
+    "Weight-update sharding")."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubeflow_tpu.models import resnet as R
+    from kubeflow_tpu.parallel.mesh import build_mesh, replica_degree
+    from kubeflow_tpu.runtime.trainstep import TrainStepBuilder
+
+    t_start = time.perf_counter() if t_start is None else t_start
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    n_chips = len(jax.devices())
+    if on_tpu:
+        batch_per_chip, image_size, steps, warmup = 128, 224, 30, 3
+    else:  # CPU smoke (same config bench_resnet smokes with)
+        batch_per_chip, image_size, steps, warmup = 8, 64, 3, 1
+    global_batch = batch_per_chip * n_chips
+
+    mesh = build_mesh()
+    n_rep = replica_degree(mesh)
+    model = R.resnet50(num_classes=1000)
+    loss_fn = R.make_loss_fn(model)
+    batch = R.synthetic_batch(jax.random.PRNGKey(1), global_batch,
+                              image_size)
+    if on_tpu:
+        batch["images"] = batch["images"].astype(jnp.bfloat16)
+
+    ab: dict = {}
+    hbm = None
+    for mode in ("replicated", "sharded"):
+        builder = TrainStepBuilder(
+            mesh=mesh,
+            loss_fn=loss_fn,
+            optimizer=optax.chain(optax.clip_by_global_norm(1.0),
+                                  optax.sgd(0.1, momentum=0.9)),
+            weight_update=mode,
+        )
+        state = builder.init(R.init_fn(model, image_size=image_size),
+                             jax.random.PRNGKey(0))
+        if hbm is None:
+            hbm = estimate_weight_update_hbm(
+                sum(int(l.size) for l in jax.tree.leaves(state.params)),
+                sum(int(getattr(l, "size", 0))
+                    for l in jax.tree.leaves(state.opt_state)),
+                n_rep)
+        step_fn = builder.build()
+        placed = builder.place_batch(batch)
+        # resnet carries BN batch_stats, so the sharded path reports
+        # zero2-gspmd (global-batch BN preserved; update_strategy)
+        row = {"strategy": builder.update_strategy(state.variables)}
+        if mode == "sharded":
+            # AOT-compile once: the same executable yields the HLO for the
+            # collective counts AND runs the measured loop (calling the
+            # jitted fn after lower() would re-trace and pay a second
+            # full XLA compile — minutes on TPU)
+            step_fn = step_fn.lower(state, placed).compile()
+            row["collectives"] = collective_counts(step_fn.as_text())
+        dt, _first, loss = _measure(step_fn, state, placed, steps, warmup,
+                                    time.perf_counter())
+        row["step_ms"] = round(dt / steps * 1e3, 3)
+        row["loss"] = loss
+        ab[mode] = row
+
+    loss_delta = abs(ab["replicated"]["loss"] - ab["sharded"]["loss"])
+    for row in ab.values():
+        row["loss"] = round(row.pop("loss"), 5)
+    speedup = ab["replicated"]["step_ms"] / ab["sharded"]["step_ms"] \
+        if ab["sharded"]["step_ms"] else 1.0
+    return {
+        "metric": "resnet50_weight_update_ab",
+        "value": round(speedup, 3),
+        "unit": "replicated_step_time_over_sharded",
+        "vs_baseline": None,
+        "mfu": None,
+        "extras": {
+            "device_kind": getattr(dev, "device_kind", dev.platform),
+            "global_batch": global_batch,
+            "weight_update": {
+                **ab,
+                "replicas": n_rep,
+                "loss_delta": round(loss_delta, 8),
+                "optimizer_hbm_bytes_per_chip": hbm,
+            },
+        },
+        "_flops_per_chip": 0.0,
+    }
+
+
 def _run_sub_bench(mode: str, budget_s: float) -> dict:
     """Run ``bench.py --mode <mode>`` as a subprocess with a hard
     wall-clock budget and return its JSON row. The child inherits the
@@ -597,7 +744,8 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--mode", default="all",
                    choices=["all", "resnet", "resnet-fused", "lm",
-                            "lm-long", "serving", "fused-blocks"])
+                            "lm-long", "serving", "fused-blocks",
+                            "weight-update"])
     p.add_argument("--routing-out",
                    default="bench-matrix/fused_routing_measured.json",
                    help="where --mode fused-blocks writes the measured "
@@ -641,6 +789,8 @@ def main(argv=None) -> int:
     elif args.mode == "fused-blocks":
         row = bench_fused_blocks(t_start=t_start,
                                  routing_out=args.routing_out)
+    elif args.mode == "weight-update":
+        row = bench_weight_update(t_start=t_start)
     else:
         row = bench_resnet(fused=False, t_start=t_start)
 
@@ -658,11 +808,15 @@ def main(argv=None) -> int:
         # copies the parent discards anyway.
         if not os.environ.get("KFTPU_BENCH_SUBBENCH"):
             import glob
-            logs = sorted(glob.glob(os.path.join(
+            logs = glob.glob(os.path.join(
                 os.path.dirname(os.path.abspath(__file__)),
-                "bench-matrix", "r*_tpu_session*.jsonl")))
+                "bench-matrix", "r*_tpu_session*.jsonl"))
+            # newest by mtime, NOT lexically: "r9_..." sorts after
+            # "r10_..." so a lexical [-1] pick would embed a stale
+            # session's rows once round numbers reach double digits
+            newest = max(logs, key=os.path.getmtime) if logs else None
             rows = []
-            for line in _read_lines(logs[-1]) if logs else []:
+            for line in _read_lines(newest) if newest else []:
                 try:
                     rows.append(json.loads(line))
                 except ValueError:
@@ -670,7 +824,7 @@ def main(argv=None) -> int:
             if rows:
                 row["extras"]["last_tpu_session"] = {
                     "note": "prior measured TPU rows (NOT this run)",
-                    "source": os.path.basename(logs[-1]),
+                    "source": os.path.basename(newest),
                     "rows": rows,
                 }
     flops_per_chip = row.pop("_flops_per_chip")
@@ -699,10 +853,12 @@ def main(argv=None) -> int:
                       "lm-long": lambda: bench_lm(long_context=True),
                       "serving": bench_serving,
                       "fused-blocks": lambda: bench_fused_blocks(
-                          routing_out=args.routing_out)}
+                          routing_out=args.routing_out),
+                      "weight-update": bench_weight_update}
         for key, mode in (("fused", "resnet-fused"), ("lm", "lm"),
                           ("lm_long", "lm-long"),
                           ("serving", "serving"),
+                          ("weight_update", "weight-update"),
                           ("fused_blocks", "fused-blocks")):
             if mode == "fused-blocks" and not on_tpu:
                 # per-block attribution is the most expensive extra (10
@@ -730,7 +886,7 @@ def main(argv=None) -> int:
                         **{k: sub["extras"][k] for k in
                            ("model_tflops", "loss", "latency",
                             "cold_first_request_s", "warmup_s",
-                            "fused_routing", "blocks",
+                            "fused_routing", "blocks", "weight_update",
                             "routing_table_written", "error")
                            if k in sub["extras"]},
                     }

@@ -38,6 +38,24 @@ KF_API_VERSION_V1ALPHA1 = "kubeflow.org/v1alpha1"
 JOB_KINDS = ("TPUJob", "TFJob", "PyTorchJob", "MPIJob",
              "ChainerJob", "MXJob", "PaddleJob")
 
+# How the worker lays the optimizer update out across data-parallel
+# replicas (spec.weightUpdate → KFTPU_WEIGHT_UPDATE → TrainStepBuilder;
+# runtime/recipe.py re-exports this vocabulary for the step engine):
+# "replicated" = every chip reads/writes the full optimizer state after a
+# gradient all-reduce; "sharded" = ZeRO-2 (reduce-scatter gradients, each
+# replica updates a 1/N shard of the state, all-gather the new params).
+# Same losses/params, ~1/N the optimizer HBM traffic per chip (PERF.md).
+# Defined HERE, not in runtime/: admission-time validation must stay
+# importable without pulling jax/optax into the operator layer.
+WEIGHT_UPDATE_MODES = ("replicated", "sharded")
+
+
+def validate_weight_update(mode: str) -> str:
+    if mode not in WEIGHT_UPDATE_MODES:
+        raise ValueError(
+            f"weight_update {mode!r} not one of {WEIGHT_UPDATE_MODES}")
+    return mode
+
 # apiVersion per kind (reference CRD groups/versions)
 API_VERSIONS = {
     "TPUJob": TPU_API_VERSION,
@@ -251,6 +269,13 @@ class TrainingJob:
     # (BASELINE.md north-star #2). Defaults to a subdir of checkpointDir
     # when that is set (same volume the gang already mounts).
     compile_cache_dir: str = ""
+    # optimizer-update layout across data-parallel replicas (rendered as
+    # KFTPU_WEIGHT_UPDATE; WEIGHT_UPDATE_MODES above):
+    # "sharded" = ZeRO-2 cross-replica sharded weight update — reduce-
+    # scatter grads, 1/N optimizer state per replica, all-gather params
+    # (Xu et al.; PERF.md "Weight-update sharding"). "" = worker default
+    # (replicated).
+    weight_update: str = ""
     raw: dict = field(default_factory=dict)
 
     # -- constructors -------------------------------------------------------
@@ -305,6 +330,7 @@ class TrainingJob:
             eval_data_dir=spec.get("evalDataDir", "") or "",
             tensorboard_dir=spec.get("tensorboardDir", "") or "",
             compile_cache_dir=spec.get("compileCacheDir", "") or "",
+            weight_update=spec.get("weightUpdate", "") or "",
             raw=obj,
         )
         job.validate()
@@ -336,6 +362,10 @@ class TrainingJob:
 
     def validate(self) -> None:
         k8s.validate_name(self.name, max_len=self.MAX_NAME_LEN)
+        if self.weight_update:
+            # admission-time rejection: a typo'd mode must fail at apply,
+            # not at worker startup deep inside the gang
+            validate_weight_update(self.weight_update)
         vocab = REPLICA_TYPES[self.kind]
         if not self.replica_specs:
             raise ValueError(f"{self.kind} {self.name}: no replica specs")
@@ -400,6 +430,8 @@ class TrainingJob:
             out["spec"]["tensorboardDir"] = self.tensorboard_dir
         if self.compile_cache_dir:
             out["spec"]["compileCacheDir"] = self.compile_cache_dir
+        if self.weight_update:
+            out["spec"]["weightUpdate"] = self.weight_update
         if self.raw:
             out["apiVersion"] = self.raw.get("apiVersion", out["apiVersion"])
             meta = dict(self.raw.get("metadata", {}))

@@ -275,6 +275,10 @@ class TrainingJobReconciler(Reconciler):
             env["KFTPU_EVAL_DATA_DIR"] = job.eval_data_dir
         if job.tensorboard_dir:
             env["KFTPU_TB_DIR"] = job.tensorboard_dir
+        if job.weight_update:
+            # spec.weightUpdate → the worker's ZeRO-2 weight-update knob
+            # (runtime/worker.py reads it into TrainStepBuilder)
+            env["KFTPU_WEIGHT_UPDATE"] = job.weight_update
         from ..runtime.compile_cache import (COMPILE_CACHE_ENV,
                                              default_cache_dir)
         cache_dir = job.compile_cache_dir or (

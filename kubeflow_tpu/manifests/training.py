@@ -43,7 +43,13 @@ def _job_schema(specs_key: str, max_one: list[str]) -> dict:
                       "Evaluator", "Coordinator", "Scheduler", "Server",
                       "Pserver", "Trainer")
         },
-    }}
+    },
+        # step-engine knobs the operator renders into worker env
+        # (TrainStepBuilder operator_knob fields; tests/test_lint.py
+        # enforces this schema names every one)
+        "weightUpdate": {"type": "string",
+                         "enum": ["replicated", "sharded"]},
+    }
     return {"type": "object",
             "properties": {"spec": {"type": "object", "properties": props}}}
 
@@ -161,14 +167,17 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
                    topology: str = "v5e-8", steps: int = 100,
                    global_batch: int = 1024,
                    fused_blocks: bool = False,
-                   fused_routing: dict | None = None) -> list[dict]:
+                   fused_routing: dict | None = None,
+                   weight_update: str = "") -> list[dict]:
     """fused_blocks opts into the ghost-BN fused bottleneck kernels
     (docs/training.md --fused-blocks; per-block batch/spatial routing).
     ``fused_routing`` pins the per-geometry kernel routing to a
     chip-measured table (the ``bench.py --mode fused-blocks`` output's
     ``routes`` dict): it renders as a ConfigMap mounted into the worker
     with KFTPU_FUSED_ROUTING_TABLE pointing at it — measured beats
-    modeled (PERF.md round 5)."""
+    modeled (PERF.md round 5). ``weight_update="sharded"`` opts the gang
+    into the ZeRO-2 cross-replica sharded weight update (spec.weightUpdate
+    → KFTPU_WEIGHT_UPDATE; PERF.md "Weight-update sharding")."""
     command = ["python", "-m", "kubeflow_tpu.runtime.worker",
                "--workload", "resnet50",
                "--steps", str(steps),
@@ -213,6 +222,9 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
         "runPolicy": {"backoffLimit": 3},
         "sharding": {"data": -1},
     }
+    if weight_update:
+        from ..api.trainingjob import validate_weight_update
+        job["spec"]["weightUpdate"] = validate_weight_update(weight_update)
     out.append(job)
     return out
 

@@ -377,8 +377,19 @@ def _measured_routing_table() -> dict | None:
     cached = _measured_routing_table.__dict__.get("cache")
     if cached is not None and cached[0] == path:
         return cached[1]
-    with open(path) as f:
-        table = json.load(f)
+    try:
+        with open(path) as f:
+            table = json.load(f)
+    except (OSError, ValueError) as e:
+        # a misconfigured ConfigMap mount (wrong mountPath, truncated or
+        # non-JSON data) must fail naming the knob and the path — a bare
+        # FileNotFoundError from deep inside the loss build is
+        # undiagnosable from a pod log (ADVICE.md)
+        raise RuntimeError(
+            f"KFTPU_FUSED_ROUTING_TABLE={path!r}: cannot load measured "
+            f"routing table ({type(e).__name__}: {e}); fix or unset the "
+            "env var / ConfigMap mount (manifests/training.py "
+            "tpu_job_simple fused_routing)") from e
     routes = table.get("routes", table)   # accept bare or wrapped
     _measured_routing_table.cache = (path, routes)
     return routes
@@ -627,7 +638,8 @@ def make_fused_loss_fn(model: ResNet, label_smoothing: float = 0.0,
                                          dtype=model.dtype,
                                          pmean_axes=axes)
 
-            run = jax.shard_map(
+            from ..parallel.compat import shard_map
+            run = shard_map(
                 sharded, mesh=mesh, in_specs=(P(), P(axes)),
                 out_specs=(P(axes), P()), check_vma=False)
 

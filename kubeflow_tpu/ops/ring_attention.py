@@ -45,12 +45,14 @@ def _chunk_scores(q, k, scale, my_idx, src_idx, chunk_q, chunk_k, causal):
     return jnp.where((cols <= rows)[None, None], s, NEG_INF)
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+def _ring_attention_local(q, k, v, my_idx, *, axis_name: str, causal: bool,
                           scale: float):
     """SPMD body (runs under shard_map): q,k,v are the local sequence
-    chunks [B, S_local, H, D]."""
-    n = jax.lax.axis_size(axis_name)
-    my_idx = jax.lax.axis_index(axis_name)
+    chunks [B, S_local, H, D]; my_idx this shard's ring position (passed
+    in as a sharded iota — lax.axis_index under a partial-manual
+    shard_map lowers to a PartitionId op older SPMD pipelines reject)."""
+    from ..parallel.compat import axis_size
+    n = axis_size(axis_name)
     b, sq, h, d = q.shape
     sk = k.shape[1]
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -114,6 +116,14 @@ def _ring_fn(mesh: Mesh, axis: str, causal: bool, scale: float):
     body = functools.partial(
         _ring_attention_local, axis_name=axis, causal=causal, scale=scale)
     spec = P(None, axis, None, None)
-    return jax.jit(jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        axis_names={axis}, check_vma=False))
+    from ..parallel.compat import shard_map
+    mapped = shard_map(
+        lambda q, k, v, idx: body(q, k, v, idx[0]),
+        mesh=mesh, in_specs=(spec, spec, spec, P(axis)), out_specs=spec,
+        axis_names={axis}, check_vma=False)
+
+    def run(q, k, v):
+        ring_pos = jnp.arange(mesh.shape[axis], dtype=jnp.int32)
+        return mapped(q, k, v, ring_pos)
+
+    return jax.jit(run)

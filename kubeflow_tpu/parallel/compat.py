@@ -1,0 +1,51 @@
+"""jax API compatibility: one shard_map entry point for every call site.
+
+The codebase is written against the modern ``jax.shard_map`` surface
+(``axis_names`` selects the manually-mapped axes, ``check_vma`` toggles
+the replication checker). Older jax releases ship the same machinery as
+``jax.experimental.shard_map.shard_map`` with the inverse vocabulary
+(``auto`` = the axes NOT manually mapped, ``check_rep``). This module
+translates so kernels and schedules run unchanged on both.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+_FORCE_LEGACY = False   # tests flip this to exercise the legacy branch
+
+
+def axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` on modern jax; on older releases psum of the
+    unit constant, which folds to the static mapped-axis size."""
+    if hasattr(jax.lax, "axis_size") and not _FORCE_LEGACY:
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs,
+              axis_names: Optional[set] = None, check_vma: bool = True):
+    """``jax.shard_map`` with the modern signature on any jax.
+
+    axis_names: the mesh axes the body is manual over (None = all of
+    them); check_vma: run jax's replication/VMA checker (False for bodies
+    whose collectives the checker cannot type, e.g. psum of a
+    conditionally-zeroed tensor).
+    """
+    if hasattr(jax, "shard_map") and not _FORCE_LEGACY:
+        kwargs = {"check_vma": check_vma}
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+    # Legacy jax: partial-manual lowering (auto != {}) check-fails inside
+    # XLA's sharding utils on some backends (IsManualSubgroup), so go full
+    # manual instead: axes absent from the specs are replicated, which
+    # preserves numerics exactly — the body's collectives only ever name
+    # its manual axes — at the cost of redundant compute over the auto
+    # axes. Only legacy jax pays this; modern jax gets true partial-manual.
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)

@@ -76,6 +76,22 @@ def data_axes(mesh: Mesh) -> tuple[str, ...]:
     return tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1) or ("data",)
 
 
+def replica_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Non-trivial data-parallel axes — the axes a sharded weight update
+    (ZeRO-2) distributes optimizer state over. Unlike ``data_axes`` there
+    is no size-1 fallback: an empty tuple means every chip already holds
+    the whole model alone and there is nothing to shard the update over."""
+    return tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+
+
+def replica_degree(mesh: Mesh) -> int:
+    """Number of data-parallel replicas (product of the replica axes)."""
+    n = 1
+    for a in replica_axes(mesh):
+        n *= mesh.shape[a]
+    return n
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Batch dim sharded over data+fsdp; sequence dim over the sequence axis."""
     return NamedSharding(mesh, P(data_axes(mesh)))

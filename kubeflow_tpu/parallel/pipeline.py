@@ -161,7 +161,8 @@ def _pipeline_shardmap(block_fn: BlockFn, mesh: Mesh, axis: str,
         in_specs = (specs_for(stacked_params), P())
         dtype = x_mb.dtype
         body = lambda p, x: pp_body(p, x, dtype)  # noqa: E731
-        out = jax.shard_map(
+        from .compat import shard_map
+        out = shard_map(
             body, mesh=mesh, in_specs=in_specs, out_specs=P(),
             axis_names={axis}, check_vma=False)(
                 stacked_params, x_mb.astype(jnp.float32))

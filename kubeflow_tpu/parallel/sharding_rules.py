@@ -68,6 +68,41 @@ class LogicalRules:
         )
 
 
+def weight_update_spec(spec: P, shape: Sequence[int], mesh: Mesh,
+                       axes: Sequence[str]) -> Optional[P]:
+    """Augment a param's PartitionSpec so ONE additional dimension is
+    sharded over ``axes`` — the per-leaf rule of the cross-replica sharded
+    weight update (Xu et al.): gradients reduce-scatter into this spec,
+    optimizer state lives in it, new params all-gather out of it.
+
+    The first (leading) dimension that is still unsharded in ``spec`` and
+    divisible by the product of the usable axes wins. Axes already consumed
+    by ``spec`` (e.g. fsdp on an FSDP-sharded param) are skipped — the
+    update for such a leaf is already distributed. Returns None when no
+    dimension qualifies (scalars, odd sizes): the caller keeps the leaf's
+    existing sharding, a per-leaf fallback, not an error.
+    """
+    used: set[str] = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        for t in (entry,) if isinstance(entry, str) else tuple(entry):
+            used.add(t)
+    free = tuple(a for a in axes
+                 if a not in used and mesh.shape.get(a, 1) > 1)
+    if not free:
+        return None
+    degree = 1
+    for a in free:
+        degree *= mesh.shape[a]
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for i, dim in enumerate(shape):
+        if entries[i] is None and dim and dim % degree == 0:
+            entries[i] = free if len(free) > 1 else free[0]
+            return P(*entries)
+    return None
+
+
 # Default rule tables. "embed"-style activations shard over tensor; params
 # additionally shard over fsdp for ZeRO-3-style weight sharding.
 TRANSFORMER_RULES = LogicalRules([

@@ -25,6 +25,12 @@ import optax
 OPTIMIZERS = ("sgd", "momentum", "nesterov", "adam", "adamw", "lars",
               "rmsprop")
 SCHEDULES = ("constant", "cosine", "step", "linear")
+# How the optimizer update is laid out across data-parallel replicas
+# (ZeRO-2 "sharded" vs "replicated"): the vocabulary lives in the jax-free
+# api layer so manifest admission can validate it without importing jax;
+# re-exported here because it is a step-engine knob (PERF.md).
+from ..api.trainingjob import (WEIGHT_UPDATE_MODES,  # noqa: F401,E402
+                               validate_weight_update)
 
 # classic ImageNet step-decay epochs 30/60/80 of 90, as fractions of the run
 STEP_BOUNDARIES = (1 / 3, 2 / 3, 8 / 9)

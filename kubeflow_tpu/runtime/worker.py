@@ -25,7 +25,7 @@ import jax
 
 from ..models import RESNET_DEPTHS
 from .bootstrap import WorkerContext, initialize
-from .recipe import make_optimizer, scale_lr
+from .recipe import make_optimizer, scale_lr, validate_weight_update
 from .checkpoint import CheckpointManager, HAVE_ORBAX
 from .metrics import METRICS_PATH_ENV, MetricsLogger, profile_trace
 from .trainstep import TrainStepBuilder
@@ -170,6 +170,7 @@ def train(
     eval_data_dir: Optional[str] = None,
     handle_sigterm: bool = True,
     tensorboard_dir: Optional[str] = None,
+    weight_update: Optional[str] = None,
 ) -> TrainResult:
     # before any jit: warm restarts must hit the persistent cache for the
     # very first compile (the startup→first-step dominator, PERF.md)
@@ -234,9 +235,16 @@ def train(
         optimizer, base_lr, schedule=lr_schedule, total_steps=steps,
         warmup_steps=warmup_steps, weight_decay=weight_decay,
         momentum=momentum)
+    # weight-update layout (ZeRO-2 sharded vs replicated): CLI flag wins,
+    # then the operator-rendered env (controllers/tpujob.py renders
+    # spec.weightUpdate as KFTPU_WEIGHT_UPDATE), then replicated
+    weight_update = validate_weight_update(
+        weight_update or os.environ.get("KFTPU_WEIGHT_UPDATE")
+        or "replicated")
     builder = TrainStepBuilder(
         mesh=ctx.mesh, loss_fn=spec.loss_fn, optimizer=opt,
-        rules=spec.rules, param_logical_axes=spec.param_logical_axes)
+        rules=spec.rules, param_logical_axes=spec.param_logical_axes,
+        weight_update=weight_update)
 
     rng = jax.random.PRNGKey(seed)
     state = builder.init(spec.init_fn, rng)
@@ -548,7 +556,15 @@ def main(argv=None) -> int:
     p.add_argument("--num-microbatches", type=int, default=4,
                    help="GPipe microbatches (pipelined workloads)")
     # training recipe (the tf_cnn_benchmarks flag surface, runtime/recipe.py)
-    from .recipe import OPTIMIZERS, SCHEDULES
+    from .recipe import OPTIMIZERS, SCHEDULES, WEIGHT_UPDATE_MODES
+    p.add_argument("--weight-update", default=None,
+                   choices=WEIGHT_UPDATE_MODES,
+                   help="optimizer-update layout across data-parallel "
+                        "replicas: 'sharded' = ZeRO-2 (reduce-scatter "
+                        "grads, 1/N optimizer state per replica, "
+                        "all-gather params — same numerics, ~1/N the "
+                        "optimizer HBM traffic); defaults to "
+                        "$KFTPU_WEIGHT_UPDATE or 'replicated'")
     p.add_argument("--optimizer", default="momentum", choices=OPTIMIZERS)
     p.add_argument("--lr-schedule", default="constant", choices=SCHEDULES)
     p.add_argument("--warmup-steps", type=int, default=0)
@@ -599,7 +615,8 @@ def main(argv=None) -> int:
         momentum=args.momentum, label_smoothing=args.label_smoothing,
         scale_lr_by_batch=args.scale_lr_by_batch,
         eval_every=args.eval_every, eval_batches=args.eval_batches,
-        eval_data_dir=args.eval_data_dir)
+        eval_data_dir=args.eval_data_dir,
+        weight_update=args.weight_update)
     log.info("done: %d steps, %.1f examples/sec", result.steps,
              result.examples_per_sec)
     return 0

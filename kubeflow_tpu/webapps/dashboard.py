@@ -219,8 +219,16 @@ def build_dashboard_app(client: KubeClient,
         reads spec.descriptor.version the same way), user email from
         the identity header the auth ingress injects."""
         from .ingress import IAP_EMAIL_HEADER
+        from ..cluster.client import KubeError
         provider = "other://"
-        for node in client.list("v1", "Node"):
+        try:
+            nodes = client.list("v1", "Node")
+        except KubeError:
+            # Nodes are cluster-scoped: a namespaced service account
+            # (restricted RBAC) gets 403 here — degrade to the generic
+            # provider instead of 500ing the whole env-info panel
+            nodes = []
+        for node in nodes:
             pid = node.get("spec", {}).get("providerID")
             if pid:
                 provider = pid

@@ -16,6 +16,53 @@ def test_tree_is_clean():
     assert not findings, "\n" + "\n".join(str(f) for f in findings)
 
 
+def test_step_engine_knobs_cover_the_operator_surface():
+    """Every TrainStepBuilder field tagged operator_knob must be
+    representable end-to-end: a modes vocabulary in runtime/recipe.py, a
+    train()/CLI surface in runtime/worker.py, a TPUJob spec field parsed
+    and serialized by api/trainingjob.py, a KFTPU_* env rendered by
+    controllers/tpujob.py, and a manifests/training.py schema entry — so
+    a future step-engine option can't silently bypass the operator."""
+    import dataclasses
+    import inspect
+
+    from kubeflow_tpu.api.trainingjob import TrainingJob
+    from kubeflow_tpu.runtime import recipe, worker
+    from kubeflow_tpu.runtime.trainstep import TrainStepBuilder
+
+    def src(*rel):
+        with open(os.path.join(REPO_ROOT, "kubeflow_tpu", *rel)) as f:
+            return f.read()
+
+    knobs = [f for f in dataclasses.fields(TrainStepBuilder)
+             if f.metadata.get("operator_knob")]
+    assert knobs, "expected at least the weight_update knob"
+    job_fields = {f.name for f in dataclasses.fields(TrainingJob)}
+    worker_src = src("runtime", "worker.py")
+    controller_src = src("controllers", "tpujob.py")
+    api_src = src("api", "trainingjob.py")
+    manifests_src = src("manifests", "training.py")
+    for knob in knobs:
+        # recipe: the vocabulary exists and contains the builder default
+        modes = getattr(recipe, knob.metadata["modes"])
+        assert knob.default in modes, (knob.name, modes)
+        # worker: a train() parameter and a CLI flag
+        assert knob.name in inspect.signature(worker.train).parameters
+        assert f"--{knob.name.replace('_', '-')}" in worker_src
+        # api: a typed TrainingJob field, parsed from and serialized to
+        # the declared spec field
+        spec_field = knob.metadata["spec_field"]
+        assert knob.name in job_fields
+        assert f'spec.get("{spec_field}"' in api_src
+        assert f'"{spec_field}"' in api_src
+        # controller: rendered into worker env
+        env = "KFTPU_" + knob.name.upper()
+        assert env in controller_src, (knob.name, env)
+        assert env in worker_src
+        # manifests: the CRD schema / example renderer names the field
+        assert spec_field in manifests_src, (knob.name, spec_field)
+
+
 class TestChecker:
     def _check(self, tmp_path, source, name="m.py"):
         p = tmp_path / name

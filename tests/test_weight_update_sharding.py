@@ -1,0 +1,330 @@
+"""Cross-replica sharded weight update (ZeRO-2, Xu et al.): numerics
+parity with the replicated path, the compiled collectives (reduce-scatter
++ all-gather, NO full-gradient all-reduce), checkpoint portability across
+a mode switch, and the knob's plumbing through the operator surface.
+
+Runs on the conftest 8-device virtual CPU mesh
+(--xla_force_host_platform_device_count=8)."""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))   # bench.py lives at the repo root
+
+from bench import collective_counts, estimate_weight_update_hbm  # noqa: E402
+from kubeflow_tpu.api.trainingjob import ShardingSpec  # noqa: E402
+from kubeflow_tpu.parallel.mesh import (build_mesh, replica_axes,  # noqa: E402
+                                        replica_degree)
+from kubeflow_tpu.runtime.trainstep import TrainStepBuilder  # noqa: E402
+
+# clip LOW enough that global-norm clipping actively rescales every
+# step: the regime where a shard-LOCAL norm (the bug class the explicit
+# path must not have) would visibly diverge from the replicated path
+OPT = lambda: optax.chain(optax.clip_by_global_norm(0.01),  # noqa: E731
+                          optax.sgd(0.1, momentum=0.9))
+
+
+def _linear_spec(din=16, dout=8):
+    def init_fn(rng):
+        params = {"w": jax.random.normal(rng, (din, dout)) * 3.0,
+                  "b": jnp.zeros((dout,))}
+        return params, {}
+
+    def loss_fn(params, variables, batch, rng):
+        y = batch["x"] @ params["w"] + params["b"]
+        return jnp.mean((y - batch["y"]) ** 2), {}
+
+    rs = np.random.RandomState(0)
+    batch = {"x": rs.randn(32, din).astype(np.float32),
+             "y": rs.randn(32, dout).astype(np.float32)}
+    return init_fn, loss_fn, batch
+
+
+def _run(builder, init_fn, batch, steps=5):
+    state = builder.init(init_fn, jax.random.PRNGKey(0))
+    step = builder.build()
+    placed = builder.place_batch(batch)
+    losses = []
+    for _ in range(steps):
+        state, m = step(state, placed)
+        losses.append(float(m["loss"]))
+    return state, losses
+
+
+class TestParity:
+    def test_sharded_matches_replicated_losses(self):
+        init_fn, loss_fn, batch = _linear_spec()
+        mesh = build_mesh(ShardingSpec(data=8))
+        runs = {}
+        for mode in ("replicated", "sharded"):
+            b = TrainStepBuilder(mesh=mesh, loss_fn=loss_fn,
+                                 optimizer=OPT(), weight_update=mode)
+            _, runs[mode] = _run(b, init_fn, batch, steps=5)
+        np.testing.assert_allclose(runs["replicated"], runs["sharded"],
+                                   rtol=0, atol=1e-5)
+
+    def test_batch_stats_model_falls_back_to_gspmd_and_matches(self):
+        """A model with mutable batch statistics (BatchNorm-style) must
+        NOT take the explicit shard_map path — under it the stats would
+        be per-replica where the replicated path computes them over the
+        global batch. The strategy falls back to GSPMD and numerics
+        match."""
+        def init_fn(rng):
+            params = {"w": jax.random.normal(rng, (16, 8))}
+            return params, {"stat": jnp.zeros((8,))}
+
+        def loss_fn(params, variables, batch, rng):
+            y = batch["x"] @ params["w"]
+            # batch-mean statistic, EMA'd into the mutable variables —
+            # its value depends on WHICH batch the stat sees
+            stat = 0.9 * variables["stat"] + 0.1 * jnp.mean(y, axis=0)
+            loss = jnp.mean((y - batch["y"] + stat) ** 2)
+            return loss, {"variables": {"stat": stat}}
+
+        rs = np.random.RandomState(1)
+        batch = {"x": rs.randn(32, 16).astype(np.float32),
+                 "y": rs.randn(32, 8).astype(np.float32)}
+        mesh = build_mesh(ShardingSpec(data=8))
+        runs = {}
+        for mode in ("replicated", "sharded"):
+            b = TrainStepBuilder(mesh=mesh, loss_fn=loss_fn,
+                                 optimizer=OPT(), weight_update=mode)
+            state = b.init(init_fn, jax.random.PRNGKey(0))
+            if mode == "sharded":
+                assert b.update_strategy(state.variables) == "zero2-gspmd"
+                assert b.update_strategy() == "zero2-explicit"
+            step = b.build()
+            placed = b.place_batch(batch)
+            losses = []
+            for _ in range(3):
+                state, m = step(state, placed)
+                losses.append(float(m["loss"]))
+            runs[mode] = losses
+        np.testing.assert_allclose(runs["replicated"], runs["sharded"],
+                                   rtol=0, atol=1e-5)
+
+    def test_gspmd_strategy_parity_on_mixed_mesh(self):
+        """Rules-sharded params on a dp x tp mesh take the GSPMD strategy
+        (with_sharding_constraint) — numerics must match too."""
+        from kubeflow_tpu.models import transformer as T
+        spec = T.workload_spec(cfg=T.TransformerConfig.tiny(), seq_len=32)
+        mesh = build_mesh(ShardingSpec(data=4, tensor=2))
+        runs = {}
+        for mode in ("replicated", "sharded"):
+            b = TrainStepBuilder(
+                mesh=mesh, loss_fn=spec.loss_fn, optimizer=OPT(),
+                rules=spec.rules,
+                param_logical_axes=spec.param_logical_axes,
+                weight_update=mode)
+            assert b.update_strategy() == \
+                ("zero2-gspmd" if mode == "sharded" else "replicated")
+            state = b.init(spec.init_fn, jax.random.PRNGKey(0))
+            step = b.build()
+            batch = b.place_batch(spec.batch_fn(jax.random.PRNGKey(1), 8))
+            losses = []
+            for _ in range(3):
+                state, m = step(state, batch)
+                losses.append(float(m["loss"]))
+            runs[mode] = losses
+        np.testing.assert_allclose(runs["replicated"], runs["sharded"],
+                                   rtol=0, atol=1e-5)
+
+
+class TestCompiledCollectives:
+    def test_sharded_step_reduce_scatters_no_full_allreduce(self):
+        init_fn, loss_fn, batch = _linear_spec()
+        mesh = build_mesh(ShardingSpec(data=8))
+        b = TrainStepBuilder(mesh=mesh, loss_fn=loss_fn, optimizer=OPT(),
+                             weight_update="sharded")
+        assert b.update_strategy() == "zero2-explicit"
+        state = b.init(init_fn, jax.random.PRNGKey(0))
+        placed = b.place_batch(batch)
+        hlo = b.build().lower(state, placed).compile().as_text()
+        counts = collective_counts(hlo)
+        assert counts["reduce_scatter"] > 0, counts
+        assert counts["all_gather"] > 0, counts
+        # the only all-reduces left are scalars (loss mean, global norms)
+        assert counts["all_reduce_nonscalar"] == 0, counts
+
+    def test_replicated_step_has_no_reduce_scatter(self):
+        init_fn, loss_fn, batch = _linear_spec()
+        mesh = build_mesh(ShardingSpec(data=8))
+        b = TrainStepBuilder(mesh=mesh, loss_fn=loss_fn, optimizer=OPT())
+        state = b.init(init_fn, jax.random.PRNGKey(0))
+        placed = b.place_batch(batch)
+        hlo = b.build().lower(state, placed).compile().as_text()
+        assert collective_counts(hlo)["reduce_scatter"] == 0
+
+    def test_optimizer_state_is_sharded_over_replicas(self):
+        """The point of the exercise: each replica materializes 1/N of
+        the momentum buffer instead of all of it."""
+        init_fn, loss_fn, batch = _linear_spec()
+        mesh = build_mesh(ShardingSpec(data=8))
+        b = TrainStepBuilder(mesh=mesh, loss_fn=loss_fn, optimizer=OPT(),
+                             weight_update="sharded")
+        state = b.init(init_fn, jax.random.PRNGKey(0))
+        mom = [l for l in jax.tree.leaves(state.opt_state)
+               if getattr(l, "shape", None) == (16, 8)]
+        assert mom, "momentum buffer not found"
+        shard_shapes = {s.data.shape for s in mom[0].addressable_shards}
+        assert shard_shapes == {(2, 8)}, shard_shapes   # 16/8 rows each
+
+
+@pytest.mark.slow
+class TestCheckpointModeSwitch:
+    def test_roundtrip_across_mode_switch(self, tmp_path):
+        """Save under the sharded update, restore into a replicated
+        builder (and continue): steps 3-4 must match an uninterrupted
+        replicated run — the checkpoint is layout-free."""
+        pytest.importorskip("orbax.checkpoint")
+        from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+        init_fn, loss_fn, batch = _linear_spec()
+        mesh = build_mesh(ShardingSpec(data=8))
+
+        ref = TrainStepBuilder(mesh=mesh, loss_fn=loss_fn, optimizer=OPT())
+        _, ref_losses = _run(ref, init_fn, batch, steps=4)
+
+        b1 = TrainStepBuilder(mesh=mesh, loss_fn=loss_fn, optimizer=OPT(),
+                              weight_update="sharded")
+        state = b1.init(init_fn, jax.random.PRNGKey(0))
+        step1 = b1.build()
+        placed = b1.place_batch(batch)
+        for _ in range(2):
+            state, _ = step1(state, placed)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(2, state, force=True)
+        mgr.wait()
+
+        b2 = TrainStepBuilder(mesh=mesh, loss_fn=loss_fn, optimizer=OPT())
+        template = b2.init(init_fn, jax.random.PRNGKey(0))
+        restored = mgr.restore(template)
+        mgr.close()
+        assert int(restored.step) == 2
+        step2 = b2.build()
+        losses = []
+        for _ in range(2):
+            restored, m = step2(restored, b2.place_batch(batch))
+            losses.append(float(m["loss"]))
+        np.testing.assert_allclose(losses, ref_losses[2:], rtol=0,
+                                   atol=1e-5)
+
+
+class TestPlumbing:
+    def test_invalid_mode_rejected_at_builder(self):
+        init_fn, loss_fn, _ = _linear_spec()
+        mesh = build_mesh(ShardingSpec(data=8))
+        with pytest.raises(ValueError, match="weight_update"):
+            TrainStepBuilder(mesh=mesh, loss_fn=loss_fn, optimizer=OPT(),
+                             weight_update="zero9")
+
+    def test_replica_axes_and_degree(self):
+        mesh = build_mesh(ShardingSpec(data=4, fsdp=2))
+        assert replica_axes(mesh) == ("data", "fsdp")
+        assert replica_degree(mesh) == 8
+        mesh1 = build_mesh(ShardingSpec(data=1, tensor=8))
+        assert replica_axes(mesh1) == ()
+        assert replica_degree(mesh1) == 1
+
+    def test_weight_update_spec_per_leaf_rules(self):
+        from jax.sharding import PartitionSpec as P
+        from kubeflow_tpu.parallel.sharding_rules import weight_update_spec
+        mesh = build_mesh(ShardingSpec(data=8))
+        axes = ("data",)
+        # leading dividable dim gets the axis
+        assert weight_update_spec(P(), (16, 8), mesh, axes) == \
+            P("data", None)
+        # first dim odd → second dim wins
+        assert weight_update_spec(P(), (3, 16), mesh, axes) == \
+            P(None, "data")
+        # nothing dividable → None (caller keeps the param sharding)
+        assert weight_update_spec(P(), (3, 5), mesh, axes) is None
+        assert weight_update_spec(P(), (), mesh, axes) is None
+        # an axis already consumed by the param sharding is skipped
+        assert weight_update_spec(P("data"), (16, 8), mesh, axes) is None
+
+    def test_compat_legacy_shard_map_matches_modern(self, monkeypatch):
+        """The compat shim's legacy branch (jax.experimental.shard_map +
+        check_rep) is load-bearing for trainstep/ring_attention/pipeline
+        on older jax — exercise it by forcing the flag and asserting a
+        sharded-update train step matches the modern branch exactly."""
+        from kubeflow_tpu.parallel import compat
+        init_fn, loss_fn, batch = _linear_spec()
+        mesh = build_mesh(ShardingSpec(data=8))
+
+        def one_step():
+            b = TrainStepBuilder(mesh=mesh, loss_fn=loss_fn,
+                                 optimizer=OPT(), weight_update="sharded")
+            assert b.update_strategy() == "zero2-explicit"
+            _, losses = _run(b, init_fn, batch, steps=2)
+            return losses
+
+        modern = one_step()
+        monkeypatch.setattr(compat, "_FORCE_LEGACY", True)
+        legacy = one_step()
+        np.testing.assert_allclose(modern, legacy, rtol=0, atol=0)
+
+    def test_hbm_estimate_is_one_over_n(self):
+        est = estimate_weight_update_hbm(100, 100, 8)
+        # f32 reads g+p+state, writes p+state: 4*(3P+2S)
+        assert est["full_bytes_per_chip"] == 4 * (3 * 100 + 2 * 100)
+        assert est["sharded_bytes_per_chip"] == \
+            -(-est["full_bytes_per_chip"] // 8)
+        assert est["replicas"] == 8
+
+    def test_spec_field_renders_worker_env(self):
+        """spec.weightUpdate → KFTPU_WEIGHT_UPDATE on every replica pod
+        (the operator_knob contract tests/test_lint.py enforces)."""
+        from kubeflow_tpu.api.trainingjob import TrainingJob
+        from kubeflow_tpu.cluster import FakeCluster
+        from kubeflow_tpu.controllers.runtime import Manager
+        from kubeflow_tpu.controllers.tpujob import TrainingJobReconciler
+        manifest = {
+            "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": "wu-job", "namespace": "kubeflow"},
+            "spec": {
+                "replicaSpecs": {"TPU": {
+                    "tpuTopology": "v5e-8",
+                    "template": {"spec": {"containers": [
+                        {"name": "jax", "image": "trainer:v1"}]}}}},
+                "sharding": {"data": -1},
+                "weightUpdate": "sharded",
+            },
+        }
+        job = TrainingJob.from_manifest(manifest)
+        assert job.weight_update == "sharded"
+        assert job.to_manifest()["spec"]["weightUpdate"] == "sharded"
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        mgr = Manager(cluster)
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        cluster.create(manifest)
+        mgr.run_pending()
+        pods = cluster.list("v1", "Pod", "kubeflow")
+        assert pods
+        for pod in pods:
+            envs = {e["name"]: e.get("value")
+                    for c in pod["spec"]["containers"]
+                    for e in c.get("env", [])}
+            assert envs.get("KFTPU_WEIGHT_UPDATE") == "sharded"
+
+    def test_bad_spec_value_rejected_at_admission(self):
+        from kubeflow_tpu.api.trainingjob import TrainingJob
+        manifest = {
+            "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": "wu-bad", "namespace": "default"},
+            "spec": {
+                "replicaSpecs": {"TPU": {"tpuTopology": "v5e-8",
+                                         "template": {}}},
+                "sharding": {"data": -1},
+                "weightUpdate": "sideways",
+            },
+        }
+        with pytest.raises(ValueError, match="weight_update"):
+            TrainingJob.from_manifest(manifest)

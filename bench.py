@@ -718,6 +718,76 @@ def bench_weight_update(t_start: float | None = None) -> dict:
     }
 
 
+def bench_chaos(t_start: float | None = None) -> dict:
+    """Chaos soak (cluster/chaos.py): drive ONE TPUJob end to end through
+    the full scripted fault menu — pod deletion (preemption), a pod crash
+    under an apiserver 5xx burst, a watch-stream drop, a truncated latest
+    checkpoint, and a hung-but-not-dead chief — and record whether the
+    control plane recovered the job to Succeeded every time. Correctness
+    bar: the final params must match an UNINJECTED soak of the same seed
+    to ≤1e-5 (the checkpoint/resume/replay path recomputes identical
+    numerics, including the truncated-step fallback to the previous
+    intact checkpoint). Not a throughput number — the soak's value is
+    the recovery ledger in extras (docs/operations.md "Failure
+    handling")."""
+    import os
+    import shutil
+    import tempfile
+
+    t_start = time.perf_counter() if t_start is None else t_start
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.cluster.chaos import ChaosSoak, SoakFault, final_params
+
+    faults = [SoakFault(2, "pod-kill"), SoakFault(3, "api-burst"),
+              SoakFault(4, "watch-drop"), SoakFault(5, "truncate-ckpt"),
+              SoakFault(6, "hung-chief")]
+    tmp = tempfile.mkdtemp(prefix="kftpu-chaos-")
+    try:
+        t0 = time.perf_counter()
+        report = ChaosSoak(workdir=os.path.join(tmp, "injected"),
+                           faults=faults, total_steps=8,
+                           checkpoint_every=2).run()
+        soak_s = time.perf_counter() - t0
+        # the parity reference: same seed, same steps, zero faults
+        clean = ChaosSoak(workdir=os.path.join(tmp, "clean"), faults=[],
+                          total_steps=8, checkpoint_every=2).run()
+        max_delta = float("nan")
+        if report["outcome"] == "succeeded" and \
+                clean["outcome"] == "succeeded":
+            injected_params = final_params(report["checkpoint_dir"])
+            clean_params = final_params(clean["checkpoint_dir"])
+            max_delta = max(jax.tree.leaves(jax.tree.map(
+                lambda a, b: float(np.max(np.abs(
+                    np.asarray(a) - np.asarray(b)))),
+                injected_params, clean_params)), default=0.0)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    recovered = report["outcome"] == "succeeded"
+    return {
+        "metric": "chaos_soak_faults_recovered",
+        "value": float(len(report["injected"])) if recovered else 0.0,
+        "unit": "injected_faults",
+        "vs_baseline": None,
+        "mfu": None,
+        "extras": {
+            "outcome": report["outcome"],
+            "clean_outcome": clean["outcome"],
+            "injected": report["injected"],
+            "restart_reasons": report["restart_reasons"],
+            "gang_restarts": report.get("gang_restarts"),
+            "segments": report["segments"],
+            "api_calls": report["api_calls"],
+            "api_faults_injected": report["api_faults"],
+            "soak_wall_s": round(soak_s, 1),
+            "final_params_max_abs_delta_vs_clean": max_delta,
+            "params_parity_ok": bool(recovered and max_delta <= 1e-5),
+        },
+        "_flops_per_chip": 0.0,
+    }
+
+
 def _run_sub_bench(mode: str, budget_s: float) -> dict:
     """Run ``bench.py --mode <mode>`` as a subprocess with a hard
     wall-clock budget and return its JSON row. The child inherits the
@@ -745,7 +815,7 @@ def main(argv=None) -> int:
     p.add_argument("--mode", default="all",
                    choices=["all", "resnet", "resnet-fused", "lm",
                             "lm-long", "serving", "fused-blocks",
-                            "weight-update"])
+                            "weight-update", "chaos"])
     p.add_argument("--routing-out",
                    default="bench-matrix/fused_routing_measured.json",
                    help="where --mode fused-blocks writes the measured "
@@ -791,6 +861,8 @@ def main(argv=None) -> int:
                                  routing_out=args.routing_out)
     elif args.mode == "weight-update":
         row = bench_weight_update(t_start=t_start)
+    elif args.mode == "chaos":
+        row = bench_chaos(t_start=t_start)
     else:
         row = bench_resnet(fused=False, t_start=t_start)
 

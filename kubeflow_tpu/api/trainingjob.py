@@ -118,6 +118,14 @@ RESTART_POLICY_ON_FAILURE = "OnFailure"
 # "failure detection": a dead worker kills the gang).
 RESTART_POLICY_GANG = "GangOnFailure"
 
+# Worker liveness contract (the stall watchdog, SURVEY §5 hung-not-dead):
+# workers annotate their own pod with a JSON {"step": N, "time": unix}
+# heartbeat (runtime/metrics.py HeartbeatReporter); the controller restarts
+# a gang whose CHIEF heartbeat is staler than runPolicy.stallTimeoutSeconds.
+# Defined here, not in runtime/: the controller layer must stay importable
+# without pulling jax into the operator process.
+HEARTBEAT_ANNOTATION = "kubeflow.org/worker-heartbeat"
+
 
 @dataclass
 class ReplicaSpec:
@@ -162,6 +170,18 @@ class RunPolicy:
     active_deadline_seconds: Optional[int] = None
     gang_scheduling: bool = True                # mandatory for TPU replicas
     ttl_seconds_after_finished: Optional[int] = None
+    # Restart-storm protection: delay between gang restarts grows
+    # base * 2^restarts (capped at max), with deterministic jitter, and the
+    # next-eligible time is persisted as a job annotation so a controller
+    # restart cannot shortcut the wait. 0 = restart immediately (the
+    # pre-backoff behavior, and the default).
+    restart_backoff_seconds: float = 0.0
+    restart_backoff_max_seconds: float = 300.0
+    # Stall watchdog: restart a gang whose chief heartbeat annotation
+    # (HEARTBEAT_ANNOTATION) is staler than this — hung-but-not-dead
+    # workers (wedged collective, dead TPU runtime with a live pod) never
+    # produce a Failed phase on their own. None = watchdog off.
+    stall_timeout_seconds: Optional[int] = None
 
     def to_dict(self) -> dict:
         d: dict[str, Any] = {
@@ -173,6 +193,11 @@ class RunPolicy:
             d["activeDeadlineSeconds"] = self.active_deadline_seconds
         if self.ttl_seconds_after_finished is not None:
             d["ttlSecondsAfterFinished"] = self.ttl_seconds_after_finished
+        if self.restart_backoff_seconds:
+            d["restartBackoffSeconds"] = self.restart_backoff_seconds
+            d["restartBackoffMaxSeconds"] = self.restart_backoff_max_seconds
+        if self.stall_timeout_seconds is not None:
+            d["stallTimeoutSeconds"] = self.stall_timeout_seconds
         return d
 
 
@@ -322,6 +347,11 @@ class TrainingJob:
                 active_deadline_seconds=rp.get("activeDeadlineSeconds"),
                 gang_scheduling=bool(rp.get("gangScheduling", True)),
                 ttl_seconds_after_finished=rp.get("ttlSecondsAfterFinished"),
+                restart_backoff_seconds=float(
+                    rp.get("restartBackoffSeconds", 0.0)),
+                restart_backoff_max_seconds=float(
+                    rp.get("restartBackoffMaxSeconds", 300.0)),
+                stall_timeout_seconds=rp.get("stallTimeoutSeconds"),
             ),
             sharding=ShardingSpec.from_dict(spec.get("sharding")),
             checkpoint_dir=spec.get("checkpointDir", "") or "",

@@ -1,0 +1,461 @@
+"""Fault injection for the control plane and the recovery paths it guards.
+
+SURVEY §5's failure contract ("a dead worker kills the gang",
+checkpoint-resume makes gang restarts cheap) is only as good as the
+recovery code nobody exercises: checkpoint writes interrupted mid-flight,
+restart pacing under a preemption storm, hung-but-not-dead workers, flaky
+apiservers. This module makes those scenarios first-class and repeatable:
+
+- **ChaosKubeClient** wraps any KubeClient (FakeCluster or the HTTP
+  client) and injects deterministic, seeded faults at the client surface:
+  transient 5xx-style errors (``TransientAPIError``) on a per-call budget
+  or an explicit burst, and watch-stream drops. Controllers under test run
+  against the wrapper unmodified; the test's own "hand of god" helpers
+  (tick, fail_pod, ...) pass through un-faulted.
+- **Checkpoint corruptors** (`truncate_checkpoint_payload`,
+  `uncommit_checkpoint`) produce exactly the on-disk states a writer dying
+  mid-save leaves behind, so restore-side integrity checking
+  (runtime/checkpoint.py) is testable without racing a real kill.
+- **ChaosSoak** drives one TPUJob end-to-end on the in-memory cluster,
+  running REAL training segments in-process between scripted faults, and
+  reports whether the job still converged to Succeeded with the params an
+  uninjected run produces. Used by ``bench.py --mode chaos`` and the
+  ``-m chaos`` test tier.
+
+Layering: this module is jax-free at import time (like the rest of
+cluster/ — the operator process must not pull in jax); ChaosSoak imports
+the worker runtime lazily inside run().
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api import k8s
+from .client import KubeClient, KubeError, Watch
+
+log = logging.getLogger(__name__)
+
+
+class TransientAPIError(KubeError):
+    """An injected transient failure: the 5xx / connection-timeout class a
+    real apiserver emits under load. Retryable by contract — controllers
+    and the HTTP client must survive a bounded burst of these."""
+
+
+# the client ops eligible for injection (the KubeClient surface)
+CHAOS_OPS = ("create", "get", "list", "update", "update_status", "patch",
+             "delete")
+
+# On-disk markers of a committed checkpoint step (mirrors
+# runtime/checkpoint.py, which cannot be imported here: it pulls in jax
+# at module scope and cluster/ must stay jax-free).
+ORBAX_COMMIT_MARKER = "_CHECKPOINT_METADATA"
+MANIFEST_NAME = "kftpu.manifest.json"
+
+
+@dataclass
+class ChaosPolicy:
+    """Seeded background fault schedule for ChaosKubeClient.
+
+    ``error_rate`` injects a TransientAPIError on that fraction of eligible
+    calls (seeded — the same seed replays the same fault positions);
+    ``max_errors`` bounds the total so a soak always makes progress.
+    Explicit bursts (``fail_next``) ride on top and ignore the budget.
+    """
+
+    seed: int = 0
+    error_rate: float = 0.0
+    max_errors: int = 0          # 0 = no rate-based injection
+    ops: tuple = CHAOS_OPS
+
+
+@dataclass
+class InjectedFault:
+    op: str
+    detail: str
+    at_call: int
+    kind: str = "api-error"
+
+
+class ChaosKubeClient(KubeClient):
+    """KubeClient wrapper injecting seeded transient faults.
+
+    Helper attributes not on the KubeClient surface (FakeCluster's tick,
+    fail_pod, add_tpu_slice_nodes, ...) delegate to the inner client
+    UN-faulted: they are the test driver's hand, not controller traffic.
+    """
+
+    def __init__(self, inner: KubeClient,
+                 policy: Optional[ChaosPolicy] = None):
+        self.inner = inner
+        self.policy = policy or ChaosPolicy()
+        self._rng = random.Random(self.policy.seed)
+        self._burst = 0
+        self._rate_injected = 0
+        self.calls = 0
+        self.injected: list[InjectedFault] = []
+        self._live_watches: list[Watch] = []
+
+    # ----------------------------------------------------------- injection
+
+    def fail_next(self, n: int = 1) -> None:
+        """Arm an explicit burst: the next n eligible calls raise
+        TransientAPIError (an apiserver 5xx burst / brief outage)."""
+        self._burst += int(n)
+
+    def _maybe_fail(self, op: str, detail: str) -> None:
+        self.calls += 1
+        if op not in self.policy.ops:
+            return
+        if self._burst > 0:
+            self._burst -= 1
+            self.injected.append(InjectedFault(op, detail, self.calls))
+            raise TransientAPIError(
+                f"injected 5xx: {op} {detail} (burst)")
+        if (self.policy.error_rate > 0
+                and self._rate_injected < self.policy.max_errors
+                and self._rng.random() < self.policy.error_rate):
+            self._rate_injected += 1
+            self.injected.append(InjectedFault(op, detail, self.calls))
+            raise TransientAPIError(
+                f"injected 5xx: {op} {detail} "
+                f"({self._rate_injected}/{self.policy.max_errors})")
+
+    # ------------------------------------------------- KubeClient surface
+
+    def create(self, obj: dict) -> dict:
+        self._maybe_fail("create", k8s.name_of(obj))
+        return self.inner.create(obj)
+
+    def get(self, api_version: str, kind: str, namespace: str,
+            name: str) -> dict:
+        self._maybe_fail("get", f"{kind}/{name}")
+        return self.inner.get(api_version, kind, namespace, name)
+
+    def list(self, api_version: str, kind: str, namespace=None,
+             selector=None) -> list[dict]:
+        self._maybe_fail("list", kind)
+        return self.inner.list(api_version, kind, namespace, selector)
+
+    def update(self, obj: dict) -> dict:
+        self._maybe_fail("update", k8s.name_of(obj))
+        return self.inner.update(obj)
+
+    def update_status(self, obj: dict) -> dict:
+        self._maybe_fail("update_status", k8s.name_of(obj))
+        return self.inner.update_status(obj)
+
+    def patch(self, api_version: str, kind: str, namespace: str, name: str,
+              patch: dict) -> dict:
+        self._maybe_fail("patch", f"{kind}/{name}")
+        return self.inner.patch(api_version, kind, namespace, name, patch)
+
+    def delete(self, api_version: str, kind: str, namespace: str, name: str,
+               cascade: bool = True) -> None:
+        self._maybe_fail("delete", f"{kind}/{name}")
+        return self.inner.delete(api_version, kind, namespace, name,
+                                 cascade=cascade)
+
+    def watch(self, api_version=None, kind=None) -> Watch:
+        w = self.inner.watch(api_version, kind)
+        self._live_watches.append(w)
+        return w
+
+    def drop_watch_streams(self) -> int:
+        """Close every watch opened through this client — the mid-run
+        stream drop a flaky apiserver/LB produces. FakeCluster watches do
+        not reconnect, so recovery must come from the controller's
+        periodic resync (controllers/runtime.py resync_interval)."""
+        dropped = 0
+        for w in self._live_watches:
+            if not w.closed:
+                w.close()
+                dropped += 1
+        self.injected.append(InjectedFault(
+            "watch", f"dropped {dropped} streams", self.calls,
+            kind="watch-drop"))
+        return dropped
+
+    def __getattr__(self, name):
+        # FakeCluster test helpers (tick, fail_pod, set_pod_phase, ...)
+        return getattr(self.inner, name)
+
+
+# ------------------------------------------------------ checkpoint faults
+
+
+def latest_step_dir(directory: str) -> Optional[str]:
+    """Newest integer-named step dir, committed or not — the raw view a
+    corruptor targets (restore-side code must NOT use this)."""
+    try:
+        steps = sorted(int(n) for n in os.listdir(directory)
+                       if n.isdigit()
+                       and os.path.isdir(os.path.join(directory, n)))
+    except OSError:
+        return None
+    return os.path.join(directory, str(steps[-1])) if steps else None
+
+
+def truncate_checkpoint_payload(step_dir: str, keep_frac: float = 0.5
+                                ) -> str:
+    """Truncate the largest payload file in a committed step dir — the
+    state a node dying mid-write (or a partial object PUT) leaves behind.
+    The commit marker stays, so only content verification (the checksum
+    manifest) can catch it. Returns the truncated path."""
+    candidates = []
+    for root, _dirs, files in os.walk(step_dir):
+        for fname in files:
+            if fname in (MANIFEST_NAME, ORBAX_COMMIT_MARKER):
+                continue
+            path = os.path.join(root, fname)
+            candidates.append((os.path.getsize(path), path))
+    if not candidates:
+        raise FileNotFoundError(f"no payload files under {step_dir}")
+    size, path = max(candidates)
+    with open(path, "r+b") as f:
+        f.truncate(max(1, int(size * keep_frac)))
+    log.info("chaos: truncated %s to %d/%d bytes", path,
+             max(1, int(size * keep_frac)), size)
+    return path
+
+
+def uncommit_checkpoint(step_dir: str) -> None:
+    """Remove the orbax commit marker — the state a writer dying between
+    directory rename and metadata finalize leaves behind. latest_step()
+    must skip such a step entirely."""
+    marker = os.path.join(step_dir, ORBAX_COMMIT_MARKER)
+    if os.path.exists(marker):
+        os.remove(marker)
+
+
+# ---------------------------------------------------------------- the soak
+
+
+# fault kinds the soak can inject between training segments
+SOAK_FAULT_KINDS = ("pod-kill", "pod-fail", "api-burst", "watch-drop",
+                    "truncate-ckpt", "hung-chief")
+
+
+@dataclass
+class SoakFault:
+    """Inject `kind` once training has reached `at_step` global steps."""
+
+    at_step: int
+    kind: str
+
+    def __post_init__(self):
+        if self.kind not in SOAK_FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(choose from {SOAK_FAULT_KINDS})")
+
+
+@dataclass
+class ChaosSoak:
+    """Drive one TPUJob through a scripted fault sequence, end to end.
+
+    The control plane is real (FakeCluster + scheduler + the TPUJob
+    reconciler, over a ChaosKubeClient); the data plane is real too — each
+    time the gang is fully Running, a REAL training segment
+    (runtime/worker.train, tiny transformer on the CPU mesh) runs
+    in-process using the env the operator rendered into the chief pod
+    (KFTPU_CHECKPOINT_DIR / KFTPU_RESUME_FROM), up to the next scripted
+    fault's step. Faults then hit the cluster, the controller recovers
+    (gang restart + resume), and the loop continues until the job reaches
+    ``total_steps`` and the chief succeeds.
+
+    Determinism: state.rng is checkpointed and the synthetic batch pool is
+    seed-derived, so replayed steps recompute identical params — the
+    report's final params must match an uninjected run bit-for-bit up to
+    float tolerance (bench asserts ≤1e-5).
+    """
+
+    workdir: str
+    faults: list = field(default_factory=list)
+    total_steps: int = 6
+    checkpoint_every: int = 2
+    seed: int = 0
+    global_batch: int = 8
+    stall_timeout_s: int = 30
+    restart_backoff_s: float = 0.02
+    restart_backoff_max_s: float = 0.2
+    wall_budget_s: float = 300.0
+    namespace: str = "kubeflow"
+    job_name: str = "chaos-soak"
+
+    def _manifest(self, ckpt_dir: str) -> dict:
+        return {
+            "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": self.job_name,
+                         "namespace": self.namespace},
+            "spec": {
+                "checkpointDir": ckpt_dir,
+                "replicaSpecs": {"TPU": {
+                    "tpuTopology": "v5e-8",
+                    "template": {"spec": {"containers": [
+                        {"name": "jax", "image": "trainer:v1"}]}}}},
+                "runPolicy": {
+                    "backoffLimit": len(self.faults) + 3,
+                    "restartBackoffSeconds": self.restart_backoff_s,
+                    "restartBackoffMaxSeconds": self.restart_backoff_max_s,
+                    "stallTimeoutSeconds": self.stall_timeout_s,
+                },
+            },
+        }
+
+    def _chief_env(self, cluster, chief: str) -> dict:
+        pod = cluster.get("v1", "Pod", self.namespace, chief)
+        return {e["name"]: e.get("value", "")
+                for e in pod["spec"]["containers"][0].get("env", [])}
+
+    def _run_segment(self, env_map: dict, target: int):
+        from ..runtime.worker import train  # lazy: pulls in jax
+        return train(
+            workload="transformer", steps=target,
+            global_batch=self.global_batch, sync_every=1,
+            checkpoint_dir=env_map.get("KFTPU_CHECKPOINT_DIR"),
+            checkpoint_every=self.checkpoint_every,
+            resume_from=env_map.get("KFTPU_RESUME_FROM"),
+            seed=self.seed, handle_sigterm=False, workload_kwargs={})
+
+    def _heartbeat(self, cluster, chief: str, step: int,
+                   stale_by_s: float = 0.0) -> None:
+        import json as _json
+        from ..api.trainingjob import HEARTBEAT_ANNOTATION
+        payload = _json.dumps({"step": step,
+                               "time": time.time() - stale_by_s})
+        cluster.patch("v1", "Pod", self.namespace, chief,
+                      {"metadata": {"annotations":
+                                    {HEARTBEAT_ANNOTATION: payload}}})
+
+    def _inject(self, fault: SoakFault, cluster, chaos: ChaosKubeClient,
+                ckpt_dir: str, chief: str, step: int) -> None:
+        log.info("chaos soak: injecting %s at step %d", fault.kind, step)
+        worker_pods = sorted(
+            k8s.name_of(p)
+            for p in cluster.list("v1", "Pod", self.namespace))
+        victim = worker_pods[-1] if worker_pods else chief
+        if fault.kind == "pod-kill":
+            # preemption deletes the pod OBJECT (no Failed phase): the
+            # vanish detector must gang-restart
+            cluster.delete("v1", "Pod", self.namespace, victim)
+        elif fault.kind == "pod-fail":
+            cluster.fail_pod(self.namespace, victim, "chaos: worker died")
+        elif fault.kind == "api-burst":
+            # a 5xx burst right as the gang fails: reconcile attempts hit
+            # injected errors and must retry through them
+            chaos.fail_next(3)
+            cluster.fail_pod(self.namespace, victim, "chaos: worker died")
+        elif fault.kind == "watch-drop":
+            chaos.drop_watch_streams()
+            cluster.fail_pod(self.namespace, victim, "chaos: worker died")
+        elif fault.kind == "truncate-ckpt":
+            step_dir = latest_step_dir(ckpt_dir)
+            if step_dir:
+                truncate_checkpoint_payload(step_dir)
+            cluster.fail_pod(self.namespace, victim, "chaos: worker died")
+        elif fault.kind == "hung-chief":
+            # live pod, stale heartbeat: only the stall watchdog recovers
+            self._heartbeat(cluster, chief, step,
+                            stale_by_s=self.stall_timeout_s + 5)
+
+    def run(self) -> dict:
+        from ..controllers.runtime import Manager
+        from ..controllers.tpujob import (RESTART_COUNT_ANNOTATION,
+                                          TrainingJobReconciler)
+        from .fake import FakeCluster
+
+        ckpt_dir = os.path.join(self.workdir, "ckpt")
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        chaos = ChaosKubeClient(cluster)
+        mgr = Manager(chaos)
+        ctrl = mgr.add(TrainingJobReconciler("TPUJob"))
+        # watch-drop recovery depends on the periodic resync; keep it tight
+        # so the soak converges quickly
+        ctrl.resync_interval = 0.02
+        cluster.create(self._manifest(ckpt_dir))
+
+        pending = sorted((SoakFault(f.at_step, f.kind) if
+                          not isinstance(f, SoakFault) else f
+                          for f in self.faults), key=lambda f: f.at_step)
+        report: dict = {"injected": [], "restart_reasons": [],
+                        "segments": 0, "outcome": "timeout"}
+        deadline = time.monotonic() + self.wall_budget_s
+        chief = f"{self.job_name}-worker-0-0"
+        reached = 0
+        while time.monotonic() < deadline:
+            mgr.run_pending()
+            cluster.tick()
+            mgr.run_pending()
+            job = cluster.get_or_none("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                                      self.namespace, self.job_name)
+            if job is None:
+                report["outcome"] = "deleted"
+                break
+            cond = k8s.get_condition(job, "Restarting")
+            if cond is not None and cond.get("status") == "True" and \
+                    cond.get("reason") not in report["restart_reasons"]:
+                report["restart_reasons"].append(cond.get("reason"))
+            if k8s.condition_true(job, "Succeeded"):
+                report["outcome"] = "succeeded"
+                break
+            if k8s.condition_true(job, "Failed"):
+                report["outcome"] = "failed"
+                report["failed_reason"] = k8s.get_condition(
+                    job, "Failed").get("reason")
+                break
+            pods = cluster.list("v1", "Pod", self.namespace)
+            running = [p for p in pods
+                       if p.get("status", {}).get("phase") == "Running"]
+            if len(running) != 2 or k8s.condition_true(job, "Restarting"):
+                # gang down or mid-restart: let timers (restart backoff,
+                # resync) fire and reconcile again
+                time.sleep(0.03)
+                continue
+            target = min(pending[0].at_step, self.total_steps) if pending \
+                else self.total_steps
+            result = self._run_segment(self._chief_env(cluster, chief),
+                                       target)
+            report["segments"] += 1
+            reached = max(reached, target)
+            self._heartbeat(cluster, chief, reached)
+            if pending and pending[0].at_step <= reached:
+                fault = pending.pop(0)
+                report["injected"].append({"step": reached,
+                                           "kind": fault.kind})
+                self._inject(fault, cluster, chaos, ckpt_dir, chief,
+                             reached)
+                continue
+            if reached >= self.total_steps:
+                # training done: the chief exits 0 and the operator
+                # completes the job off the Succeeded phase
+                cluster.set_pod_phase(self.namespace, chief, "Succeeded")
+        job = cluster.get_or_none("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                                  self.namespace, self.job_name)
+        if job is not None:
+            report["gang_restarts"] = int(k8s.annotations_of(job).get(
+                RESTART_COUNT_ANNOTATION, "0"))
+        report["final_step"] = reached
+        report["checkpoint_dir"] = ckpt_dir
+        report["api_calls"] = chaos.calls
+        report["api_faults"] = len(chaos.injected)
+        for c in mgr.controllers:
+            c.stop()
+        return report
+
+
+def final_params(checkpoint_dir: str):
+    """Restore the params tree at the newest INTACT step (the integrity
+    path — corrupted steps fall back). jax/orbax import is lazy."""
+    from ..runtime.checkpoint import CheckpointManager
+    mgr = CheckpointManager(checkpoint_dir)
+    try:
+        return mgr.restore_params()
+    finally:
+        mgr.close()

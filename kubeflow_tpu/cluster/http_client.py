@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import logging
+import random
 import ssl
 import threading
 import time
@@ -59,9 +60,18 @@ class HttpKubeClient(KubeClient):
                  ca_file: Optional[str] = None, insecure: bool = False,
                  client_cert: Optional[tuple[str, str]] = None,
                  basic_auth: Optional[tuple[str, str]] = None,
-                 timeout: float = 30.0, sync_watches: bool = False):
+                 timeout: float = 30.0, sync_watches: bool = False,
+                 retries: int = 3, retry_backoff_s: float = 0.2):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # transient-error budget: a 5xx / connection failure retries up to
+        # `retries` times with exponential backoff + jitter before the
+        # typed error surfaces — the controller must survive an apiserver
+        # flake (LB blip, leader election, chaos-injected burst) without
+        # burning its reconcile-retry budget. 4xx semantics (NotFound,
+        # Conflict, AlreadyExists) are MEANING, not weather: never retried.
+        self.retries = max(0, int(retries))
+        self.retry_backoff_s = retry_backoff_s
         # read-your-writes barrier for deterministic drives (tests, CLI
         # apply-then-verify); production reconcilers are level-triggered and
         # don't need it
@@ -134,16 +144,35 @@ class HttpKubeClient(KubeClient):
     def _request(self, method: str, path: str,
                  body: Optional[dict] = None) -> dict:
         data = json.dumps(body).encode() if body is not None else None
-        req = Request(self.base_url + path, data=data,
-                      headers=self._headers, method=method)
-        try:
-            with urlopen(req, timeout=self.timeout,
-                         context=self._ssl_ctx) as resp:
-                payload = json.loads(resp.read() or b"{}")
-        except Exception as e:
-            payload = self._error_payload(e)
-            raise self._typed_error(payload) from None
-        return payload
+        delay = self.retry_backoff_s
+        for attempt in range(self.retries + 1):
+            req = Request(self.base_url + path, data=data,
+                          headers=self._headers, method=method)
+            try:
+                with urlopen(req, timeout=self.timeout,
+                             context=self._ssl_ctx) as resp:
+                    return json.loads(resp.read() or b"{}")
+            except Exception as e:
+                payload = self._error_payload(e)
+                if attempt < self.retries and self._is_transient(payload):
+                    # jitter decorrelates a fleet of controllers hammering
+                    # a recovering apiserver (thundering-herd protection)
+                    sleep = delay * random.uniform(1.0, 1.5)
+                    log.warning("%s %s transient (%s); retry %d/%d in "
+                                "%.2fs", method, path,
+                                payload.get("reason", "?"), attempt + 1,
+                                self.retries, sleep)
+                    time.sleep(sleep)
+                    delay *= 2
+                    continue
+                raise self._typed_error(payload) from None
+
+    @staticmethod
+    def _is_transient(payload: dict) -> bool:
+        """5xx and connection-level failures (code 0: unreachable, timeout,
+        dropped mid-response) are retryable weather; 4xx is meaning."""
+        code = payload.get("code") or 0
+        return code == 0 or code >= 500 or code == 429
 
     @staticmethod
     def _error_payload(e: Exception) -> dict:

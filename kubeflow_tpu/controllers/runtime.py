@@ -103,11 +103,19 @@ class Controller:
     reconciler: Reconciler
     client: KubeClient
     max_retries: int = 5
+    # Periodic full relist → enqueue (controller-runtime SyncPeriod
+    # analog). A watch event lost in flight (stream drop, chaos-injected
+    # fault, apiserver hiccup between reconnect and relist) would
+    # otherwise never re-enqueue its key; the resync bounds that blind
+    # spot. 0 = off (deterministic tests drive enqueue_existing
+    # themselves).
+    resync_interval: float = 0.0
     queue: _WorkQueue = field(default_factory=_WorkQueue)
     _watches: list[Watch] = field(default_factory=list)
     _retries: dict[Key, int] = field(default_factory=dict)
     _stop: threading.Event = field(default_factory=threading.Event)
     _delayed: list[tuple[float, Key]] = field(default_factory=list)
+    _last_resync: float = 0.0
 
     # -- wiring -------------------------------------------------------------
 
@@ -160,6 +168,13 @@ class Controller:
         self._delayed = [(t, k) for t, k in self._delayed if t > now]
         for k in due:
             self.queue.add(k)
+        if self.resync_interval > 0 and \
+                now - self._last_resync >= self.resync_interval:
+            self._last_resync = now
+            try:
+                self.enqueue_existing()
+            except Exception as e:  # noqa: BLE001 — resync is best-effort
+                log.warning("resync list failed: %s", e)
         return n
 
     # -- execution ----------------------------------------------------------

@@ -34,13 +34,17 @@ from __future__ import annotations
 import copy
 import json
 import logging
+import os
+import random
+import time
 
 from ..api import k8s
 from ..api.topology import TopologyContract, render_contracts
 from ..api.trainingjob import (API_VERSIONS, COND_CREATED, COND_FAILED,
                                COND_RESTARTING, COND_RUNNING, COND_SUCCEEDED,
                                CLEAN_POD_ALL, CLEAN_POD_NONE,
-                               CLEAN_POD_RUNNING, JOB_KINDS, POD_FAILED,
+                               CLEAN_POD_RUNNING, HEARTBEAT_ANNOTATION,
+                               JOB_KINDS, POD_FAILED,
                                POD_RUNNING, POD_SUCCEEDED, ReplicaSpec,
                                TrainingJob)
 from ..cluster.client import KubeClient, NotFoundError
@@ -49,7 +53,19 @@ from .runtime import Key, Reconciler, Result
 
 log = logging.getLogger(__name__)
 
+
+def _now() -> float:
+    """Wall clock behind every timeout decision (backoff, stall, deadline,
+    TTL) — one seam for tests/chaos to control time deterministically."""
+    return time.time()
+
+
 RESTART_COUNT_ANNOTATION = "kubeflow.org/gang-restart-count"
+# unix time before which a failed gang must NOT be recreated (exponential
+# backoff with jitter between gang restarts — restart-storm protection).
+# Persisted as an annotation so a controller crash/restart cannot shortcut
+# the wait the way an in-memory timer would.
+RESTART_NOT_BEFORE_ANNOTATION = "kubeflow.org/gang-restart-not-before"
 # gang shape at last creation (topology×slices per TPU replica): a changed
 # fingerprint means the SPEC was resized/reshaped (deliberate restart on
 # the new shape), not that members vanished — pod COUNT alone can't tell
@@ -93,7 +109,7 @@ class TrainingJobReconciler(Reconciler):
 
         if k8s.condition_true(manifest, COND_SUCCEEDED) or \
                 k8s.condition_true(manifest, COND_FAILED):
-            return Result()
+            return self._handle_finished(client, job, manifest)
 
         pods = client.list("v1", "Pod", namespace, selector=job.selector())
         by_name = {k8s.name_of(p): p for p in pods}
@@ -109,6 +125,18 @@ class TrainingJobReconciler(Reconciler):
         if phases.get(chief) == POD_SUCCEEDED:
             self._set_condition(client, manifest, COND_SUCCEEDED, "True",
                                 "JobSucceeded", f"chief pod {chief} succeeded")
+            self._cleanup_pods(client, job, pods)
+            return Result()
+
+        # activeDeadlineSeconds: a job running past its wall budget is
+        # Failed (DeadlineExceeded) — measured from the Created condition's
+        # transition time, which survives controller restarts
+        deadline_in = self._deadline_remaining(job, manifest)
+        if deadline_in is not None and deadline_in <= 0:
+            self._set_condition(
+                client, manifest, COND_FAILED, "True", "DeadlineExceeded",
+                f"job exceeded activeDeadlineSeconds="
+                f"{job.run_policy.active_deadline_seconds}")
             self._cleanup_pods(client, job, pods)
             return Result()
 
@@ -150,6 +178,14 @@ class TrainingJobReconciler(Reconciler):
                     client, job, manifest, pods, missing,
                     reason="GangPodsVanished")
 
+        if k8s.condition_true(manifest, COND_RESTARTING):
+            # restart backoff: the gang stays down until the persisted
+            # not-before time passes (restart-storm protection) — requeue
+            # for the remainder instead of recreating immediately
+            wait = self._restart_backoff_remaining(manifest)
+            if wait > 0:
+                return Result(requeue_after=wait)
+
         created = self._ensure_pods(client, job, manifest, by_name,
                                     tpu_entries)
         if created:
@@ -170,11 +206,27 @@ class TrainingJobReconciler(Reconciler):
         if failed:
             return self._handle_gang_failure(client, job, manifest, pods, failed)
 
+        # stall watchdog: a chief that is Running but has stopped advancing
+        # its heartbeat is hung-not-dead (wedged collective, dead TPU
+        # runtime under a live pod) — no Failed phase will ever appear, so
+        # the watchdog is the only recovery path
+        stalled = self._stalled_chief(job, manifest, by_name, chief)
+        if stalled:
+            return self._handle_gang_failure(
+                client, job, manifest, pods, [chief], reason="StallTimeout")
+
         running = sum(1 for ph in phases.values() if ph == POD_RUNNING)
         self._finalize_status(client, manifest, pods,
                               all_running=(running == job.total_pods()
                                            and running > 0))
-        return Result()
+        # timers that need a re-check without any cluster event: the
+        # active deadline landing, and the next stall-watchdog probe
+        requeue_in = [t for t in (deadline_in,) if t is not None and t > 0]
+        if job.run_policy.stall_timeout_seconds:
+            requeue_in.append(
+                max(1.0, job.run_policy.stall_timeout_seconds / 2))
+        return Result(requeue_after=min(requeue_in)) if requeue_in \
+            else Result()
 
     # ------------------------------------------------------------- children
 
@@ -264,7 +316,16 @@ class TrainingJobReconciler(Reconciler):
         # checkpoint/resume contract on every replica kind: workers write to
         # checkpointDir and restore from resumeFrom before the loop
         # (runtime/worker.py); gang restart sets resumeFrom automatically
-        env = {}
+        # Pod self-identity (the downward-API analog): lets the worker
+        # annotate its OWN pod with the liveness heartbeat the stall
+        # watchdog reads (runtime/metrics.py HeartbeatReporter). The
+        # operator forwards its own KFTPU_APISERVER so workers can build
+        # an in-pod client for the heartbeat patch; without it the
+        # reporter is a no-op (and the watchdog, seeing no heartbeat,
+        # never trips — non-instrumented deployments keep working).
+        env = {"KFTPU_POD_NAME": name, "KFTPU_POD_NAMESPACE": job.namespace}
+        if os.environ.get("KFTPU_APISERVER"):
+            env["KFTPU_APISERVER"] = os.environ["KFTPU_APISERVER"]
         if job.checkpoint_dir:
             env["KFTPU_CHECKPOINT_DIR"] = job.checkpoint_dir
         if job.resume_from:
@@ -449,6 +510,55 @@ class TrainingJobReconciler(Reconciler):
         first = sorted(job.replica_specs)[0]
         return _replica_pod_name(job, first, 0)
 
+    def _deadline_remaining(self, job: TrainingJob,
+                            manifest: dict) -> float | None:
+        """Seconds until activeDeadlineSeconds lands (negative = already
+        over), or None when no deadline applies / the job never started."""
+        deadline = job.run_policy.active_deadline_seconds
+        if deadline is None:
+            return None
+        created = k8s.get_condition(manifest, COND_CREATED)
+        if not created or created.get("status") != "True":
+            return None
+        try:
+            started = float(created.get("lastTransitionTime") or 0)
+        except (TypeError, ValueError):
+            return None
+        if not started:
+            return None
+        return started + deadline - _now()
+
+    def _restart_backoff_remaining(self, manifest: dict) -> float:
+        nb = k8s.annotations_of(manifest).get(RESTART_NOT_BEFORE_ANNOTATION)
+        try:
+            return float(nb) - _now() if nb else 0.0
+        except (TypeError, ValueError):
+            return 0.0
+
+    def _stalled_chief(self, job: TrainingJob, manifest: dict,
+                       by_name: dict[str, dict], chief: str) -> bool:
+        """Whether the chief's heartbeat annotation is staler than
+        runPolicy.stallTimeoutSeconds. A pod with NO heartbeat is never
+        declared stalled (non-instrumented images must keep working)."""
+        timeout = job.run_policy.stall_timeout_seconds
+        if not timeout or k8s.condition_true(manifest, COND_RESTARTING):
+            return False
+        pod = by_name.get(chief)
+        if pod is None or \
+                pod.get("status", {}).get("phase") != POD_RUNNING:
+            return False
+        raw = k8s.annotations_of(pod).get(HEARTBEAT_ANNOTATION)
+        if not raw:
+            return False
+        try:
+            beat = float(json.loads(raw).get("time", 0))
+        except (AttributeError, TypeError, ValueError):
+            # AttributeError: valid JSON that isn't an object ("3",
+            # "null") — a malformed annotation must degrade to "no
+            # heartbeat", never crash the reconcile loop
+            return False
+        return bool(beat) and _now() - beat > timeout
+
     def _handle_gang_failure(self, client: KubeClient, job: TrainingJob,
                              manifest: dict, pods: list[dict],
                              failed: list[str],
@@ -474,6 +584,19 @@ class TrainingJobReconciler(Reconciler):
         if count_restart:
             patch["metadata"]["annotations"][RESTART_COUNT_ANNOTATION] = \
                 str(restarts + 1)
+        rp = job.run_policy
+        delay = 0.0
+        if count_restart and rp.restart_backoff_seconds > 0:
+            # exponential backoff + deterministic jitter (seeded by job
+            # identity and attempt, so reconcile retries compute the same
+            # schedule): spreads a fleet-wide preemption's restarts out
+            # instead of stampeding the scheduler/apiserver
+            delay = min(rp.restart_backoff_seconds * (2 ** restarts),
+                        rp.restart_backoff_max_seconds)
+            delay *= random.Random(
+                f"{job.namespace}/{job.name}:{restarts}").uniform(1.0, 1.5)
+            patch["metadata"]["annotations"][
+                RESTART_NOT_BEFORE_ANNOTATION] = f"{_now() + delay:.3f}"
         if job.checkpoint_dir and not job.resume_from:
             # close the resume loop: the recreated gang restores from the
             # job's own checkpoints and continues from the last step
@@ -484,10 +607,39 @@ class TrainingJobReconciler(Reconciler):
             else manifest
         budget = (f" ({restarts + 1}/{job.run_policy.backoff_limit})"
                   if count_restart else " (not counted against backoff)")
+        wait = f", next attempt in {delay:.1f}s" if delay else ""
         self._set_condition(
             client, patched, COND_RESTARTING, "True", reason,
-            f"pods {failed}: restarting whole gang{budget}")
-        return Result(requeue=True)
+            f"pods {failed}: restarting whole gang{budget}{wait}")
+        return Result(requeue_after=delay) if delay else Result(requeue=True)
+
+    def _handle_finished(self, client: KubeClient, job: TrainingJob,
+                         manifest: dict) -> Result:
+        """ttlSecondsAfterFinished: reap the finished job object (and its
+        children via cascade) once the TTL passes — measured from the
+        terminal condition's transition time."""
+        ttl = job.run_policy.ttl_seconds_after_finished
+        if ttl is None:
+            return Result()
+        cond = k8s.get_condition(manifest, COND_SUCCEEDED)
+        if not (cond and cond.get("status") == "True"):
+            cond = k8s.get_condition(manifest, COND_FAILED)
+        try:
+            finished = float((cond or {}).get("lastTransitionTime") or 0)
+        except (TypeError, ValueError):
+            finished = 0.0
+        if not finished:
+            return Result()
+        remaining = finished + ttl - _now()
+        if remaining > 0:
+            return Result(requeue_after=remaining)
+        log.info("job %s/%s finished %ds ago (> ttl %ds): deleting",
+                 job.namespace, job.name, int(_now() - finished), ttl)
+        try:
+            client.delete(*k8s.key_of(manifest))
+        except NotFoundError:
+            pass
+        return Result()
 
     def _cleanup_pods(self, client: KubeClient, job: TrainingJob,
                       pods: list[dict]) -> None:

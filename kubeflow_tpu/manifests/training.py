@@ -168,7 +168,15 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
                    global_batch: int = 1024,
                    fused_blocks: bool = False,
                    fused_routing: dict | None = None,
-                   weight_update: str = "") -> list[dict]:
+                   weight_update: str = "",
+                   backoff_limit: int = 3,
+                   clean_pod_policy: str = "Running",
+                   gang_scheduling: bool = True,
+                   active_deadline_seconds: int | None = None,
+                   ttl_seconds_after_finished: int | None = None,
+                   restart_backoff_seconds: float = 0.0,
+                   restart_backoff_max_seconds: float = 300.0,
+                   stall_timeout_seconds: int | None = None) -> list[dict]:
     """fused_blocks opts into the ghost-BN fused bottleneck kernels
     (docs/training.md --fused-blocks; per-block batch/spatial routing).
     ``fused_routing`` pins the per-geometry kernel routing to a
@@ -177,7 +185,19 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
     with KFTPU_FUSED_ROUTING_TABLE pointing at it — measured beats
     modeled (PERF.md round 5). ``weight_update="sharded"`` opts the gang
     into the ZeRO-2 cross-replica sharded weight update (spec.weightUpdate
-    → KFTPU_WEIGHT_UPDATE; PERF.md "Weight-update sharding")."""
+    → KFTPU_WEIGHT_UPDATE; PERF.md "Weight-update sharding").
+
+    The run-policy knobs mirror RunPolicy (api/trainingjob.py) one-to-one
+    and render through it, so the example manifest can express the FULL
+    failure-handling surface (docs/operations.md "Failure handling"):
+    ``backoff_limit``/``clean_pod_policy``/``gang_scheduling``/
+    ``active_deadline_seconds``/``ttl_seconds_after_finished`` (the
+    classic tf-operator policy), ``restart_backoff_seconds`` +
+    ``restart_backoff_max_seconds`` (exponential backoff with jitter
+    between gang restarts — restart-storm protection; spec
+    restartBackoffSeconds/restartBackoffMaxSeconds), and
+    ``stall_timeout_seconds`` (the hung-chief stall watchdog; spec
+    stallTimeoutSeconds)."""
     command = ["python", "-m", "kubeflow_tpu.runtime.worker",
                "--workload", "resnet50",
                "--steps", str(steps),
@@ -211,6 +231,16 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
         pod_spec["volumes"] = [{"name": "fused-routing",
                                 "configMap": {
                                     "name": cm["metadata"]["name"]}}]
+    from ..api.trainingjob import RunPolicy
+    run_policy = RunPolicy(
+        clean_pod_policy=clean_pod_policy,
+        backoff_limit=backoff_limit,
+        active_deadline_seconds=active_deadline_seconds,
+        gang_scheduling=gang_scheduling,
+        ttl_seconds_after_finished=ttl_seconds_after_finished,
+        restart_backoff_seconds=restart_backoff_seconds,
+        restart_backoff_max_seconds=restart_backoff_max_seconds,
+        stall_timeout_seconds=stall_timeout_seconds)
     job = k8s.make(TPU_API_VERSION, "TPUJob", name, namespace)
     job["spec"] = {
         "replicaSpecs": {
@@ -219,7 +249,7 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
                 "template": {"spec": pod_spec},
             },
         },
-        "runPolicy": {"backoffLimit": 3},
+        "runPolicy": run_policy.to_dict(),
         "sharding": {"data": -1},
     }
     if weight_update:

@@ -1,16 +1,37 @@
-"""Checkpoint / resume via orbax.
+"""Checkpoint / resume via orbax, hardened for preemption-heavy fleets.
 
 A core component here (the reference delegates model checkpoints entirely to
 workloads via storage params — SURVEY.md §5 "Checkpoint/resume"); the TPUJob
 controller exposes `resumeFrom`, and this module is what the worker runtime
 calls. Restore is sharding-aware: each host restores only its shards.
+
+Integrity layer (the part preemption actually exercises):
+
+- **Commit detection.** A step directory without orbax's commit metadata
+  (``_CHECKPOINT_METADATA``) is half-written — a writer died between
+  creating the directory and finalizing it — and is never offered by
+  ``latest_step()`` or picked by ``restore()``.
+- **Checksum manifest.** After an async save completes, process 0 writes
+  ``kftpu.manifest.json`` into the step directory: per-file size + crc32,
+  committed by atomic rename. On restore the manifest is verified first;
+  a truncated or bit-flipped payload file fails verification.
+- **Fallback restore.** ``restore()``/``restore_params()`` with no explicit
+  step walk intact steps newest-first: a step that fails verification OR
+  raises during the actual restore is logged and skipped, falling back to
+  the previous intact step. Only an empty directory raises.
+- **Retried saves.** Transient I/O errors at save submission retry with
+  exponential backoff before surfacing (async write failures still surface
+  in ``wait()``, as before).
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
-from typing import Any, Optional
+import time
+import zlib
+from typing import Any, Callable, Optional
 
 import jax
 
@@ -23,16 +44,103 @@ except ImportError:  # pragma: no cover
     ocp = None
     HAVE_ORBAX = False
 
+# orbax finalizes a step by renaming the tmp dir and writing this marker;
+# its absence means the step never committed (half-written)
+ORBAX_COMMIT_MARKER = "_CHECKPOINT_METADATA"
+# our integrity manifest, written AFTER the orbax commit (so its presence
+# implies the payload below it was complete at manifest time)
+MANIFEST_NAME = "kftpu.manifest.json"
+
+
+def _crc32_file(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                return crc
+            crc = zlib.crc32(buf, crc)
+
+
+def write_manifest(step_dir: str) -> dict:
+    """Record every payload file's size + crc32 and commit the manifest by
+    atomic rename — the cheap corruption detector a plain rename-commit
+    (which only proves the DIRECTORY was finalized) cannot give."""
+    entries: dict[str, dict] = {}
+    for root, _dirs, files in os.walk(step_dir):
+        for fname in files:
+            if fname == MANIFEST_NAME:
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, step_dir)
+            entries[rel] = {"size": os.path.getsize(path),
+                            "crc32": _crc32_file(path)}
+    manifest = {"version": 1, "files": entries}
+    tmp = os.path.join(step_dir, MANIFEST_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(step_dir, MANIFEST_NAME))
+    return manifest
+
+
+def verify_step_dir(step_dir: str) -> tuple[bool, str]:
+    """(intact, reason). Uncommitted (no orbax marker) and
+    manifest-mismatched steps are not intact; a committed step without a
+    manifest is accepted (manifests arrive asynchronously / older writers
+    never wrote one)."""
+    if not os.path.isdir(step_dir):
+        return False, "missing"
+    if not os.path.exists(os.path.join(step_dir, ORBAX_COMMIT_MARKER)):
+        return False, "uncommitted (no orbax commit metadata)"
+    mpath = os.path.join(step_dir, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        return True, "no manifest (accepted)"
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, f"unreadable manifest: {e}"
+    for rel, want in manifest.get("files", {}).items():
+        path = os.path.join(step_dir, rel)
+        if not os.path.exists(path):
+            return False, f"missing file {rel}"
+        size = os.path.getsize(path)
+        if size != want.get("size"):
+            return False, (f"size mismatch {rel}: {size} != "
+                           f"{want.get('size')} (truncated write?)")
+        if _crc32_file(path) != want.get("crc32"):
+            return False, f"checksum mismatch {rel}"
+    return True, "verified"
+
 
 class CheckpointManager:
-    """Thin wrapper over orbax CheckpointManager for TrainState pytrees."""
+    """Wrapper over orbax CheckpointManager for TrainState pytrees, with
+    commit/corruption detection and previous-step fallback on restore."""
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 save_interval_steps: int = 1):
+                 save_interval_steps: int = 1,
+                 save_retries: int = 2, retry_backoff_s: float = 0.5,
+                 save_delay_s: float = 0.0):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         if not HAVE_ORBAX:
             raise RuntimeError("orbax-checkpoint is not available")
+        self.save_retries = max(0, int(save_retries))
+        self.retry_backoff_s = retry_backoff_s
+        # fault-injection knob (cluster/chaos.py "slow checkpoint I/O"):
+        # sleep this long before submitting each save
+        self.save_delay_s = save_delay_s
+        # steps saved but not yet manifest-covered; flushed once the async
+        # write completes (wait/close) so saves stay async on the hot path
+        self._pending_manifest: set[int] = set()
+        # steps whose manifest-backed verification already passed: a
+        # committed step with its manifest is immutable, so re-verifying
+        # (a full crc32 pass over every payload byte) on every
+        # latest_step() poll — the serving registry polls it every 30s —
+        # would turn a metadata lookup into continuous disk reads
+        self._intact_cache: set[int] = set()
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -40,35 +148,175 @@ class CheckpointManager:
                 save_interval_steps=save_interval_steps),
         )
 
+    # ------------------------------------------------------------------ save
+
     def should_save(self, step: int) -> bool:
         """Whether save() at this step would actually write (interval gate).
         Lets callers avoid host-syncing device state for skipped steps."""
         return bool(self._mgr.should_save(step))
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
-        saved = self._mgr.save(
-            step, args=ocp.args.StandardSave(state), force=force)
+        if self.save_delay_s > 0:
+            time.sleep(self.save_delay_s)
+        delay = self.retry_backoff_s
+        for attempt in range(self.save_retries + 1):
+            try:
+                saved = self._mgr.save(
+                    step, args=ocp.args.StandardSave(state), force=force)
+                break
+            except Exception as e:  # noqa: BLE001 — transient fs/IO errors
+                if attempt >= self.save_retries:
+                    raise
+                # The resume-replay collision (chaos-suite find): restore
+                # fell back past a CORRUPT step N, training replayed up to
+                # N, and this save now hits orbax's "step already exists"
+                # on N's remains — unretryable unless the remains go.
+                # Clearing is gated on verify_step failing: an INTACT
+                # existing step is never deleted to paper over a
+                # programming error.
+                self._clear_corrupt_step(step)
+                log.warning("checkpoint save @%d failed (%s); retry %d/%d "
+                            "in %.1fs", step, e, attempt + 1,
+                            self.save_retries, delay)
+                time.sleep(delay)
+                delay *= 2
         if saved:
             log.info("checkpoint saved at step %d -> %s", step, self.directory)
+            self._pending_manifest.add(step)
         return saved
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()
+        self._flush_manifests()
+
+    def _clear_corrupt_step(self, step: int) -> None:
+        """Remove a NON-INTACT step directory and make orbax forget it.
+        Multi-host safe: every host may try, rmtree tolerates the loser
+        seeing a half-removed tree."""
+        step_dir = os.path.join(self.directory, str(step))
+        if not os.path.isdir(step_dir):
+            return
+        ok, reason = verify_step_dir(step_dir)
+        if ok:
+            return
+        import shutil
+        log.warning("clearing corrupt remains of step %d (%s)", step, reason)
+        shutil.rmtree(step_dir, ignore_errors=True)
+        self._intact_cache.discard(step)
+        try:
+            self._mgr.reload()   # drop orbax's cached step list
+        except Exception as e:  # noqa: BLE001 — reload is best-effort
+            log.warning("orbax reload after clearing step %d failed: %s",
+                        step, e)
+
+    def _flush_manifests(self) -> None:
+        pending, self._pending_manifest = self._pending_manifest, set()
+        if jax.process_index() != 0:
+            return  # one writer: every host sees the same fs in a gang
+        for step in sorted(pending):
+            step_dir = os.path.join(self.directory, str(step))
+            if not os.path.isdir(step_dir):
+                continue  # already pruned by max_to_keep
+            try:
+                write_manifest(step_dir)
+            except OSError as e:
+                # a missing manifest only downgrades verification, never
+                # the checkpoint itself — don't fail the run over it
+                log.warning("manifest write for step %d failed: %s", step, e)
+
+    # ----------------------------------------------------------- inspection
+
+    def all_steps(self) -> list[int]:
+        """Integer-named step directories, ascending (committed or not)."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        return sorted(int(n) for n in names
+                      if n.isdigit() and
+                      os.path.isdir(os.path.join(self.directory, n)))
+
+    def verify_step(self, step: int) -> tuple[bool, str]:
+        step_dir = os.path.join(self.directory, str(step))
+        if step in self._intact_cache:
+            if os.path.isdir(step_dir):
+                return True, "verified (cached)"
+            self._intact_cache.discard(step)   # pruned by max_to_keep
+            return False, "missing"
+        ok, reason = verify_step_dir(step_dir)
+        if ok and os.path.exists(os.path.join(step_dir, MANIFEST_NAME)):
+            # cache manifest-backed positives only: a committed step
+            # without a manifest may gain one later (async flush)
+            self._intact_cache.add(step)
+        return ok, reason
+
+    def intact_steps(self) -> list[int]:
+        """Committed + checksum-verified steps, ascending."""
+        out = []
+        for step in self.all_steps():
+            ok, reason = self.verify_step(step)
+            if ok:
+                out.append(step)
+            else:
+                log.warning("checkpoint step %d skipped: %s", step, reason)
+        return out
 
     def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
+        """Newest INTACT step — a half-written or corrupted latest
+        directory is skipped, not blindly offered to restore(). Walks
+        newest-first and stops at the first intact step, so the common
+        case (healthy newest checkpoint) verifies exactly one step."""
+        for step in reversed(self.all_steps()):
+            ok, reason = self.verify_step(step)
+            if ok:
+                return step
+            log.warning("checkpoint step %d skipped: %s", step, reason)
+        return None
+
+    # --------------------------------------------------------------- restore
+
+    def _restore_with_fallback(self, restore_fn: Callable[[int], Any],
+                               step: Optional[int]) -> Any:
+        """Explicit step: verify + restore that exact step (an operator
+        asked for it; silently restoring another would be worse than
+        failing). Implicit latest: walk intact steps newest-first and fall
+        back past any step that fails verification or restore."""
+        if step is not None:
+            ok, reason = self.verify_step(step)
+            if not ok:
+                raise ValueError(
+                    f"checkpoint step {step} in {self.directory} is not "
+                    f"intact: {reason}")
+            return restore_fn(step)
+        last_err: Optional[BaseException] = None
+        # newest-first, verifying LAZILY: older steps only pay their
+        # verification cost if every newer candidate was rejected
+        for candidate in reversed(self.all_steps()):
+            ok, reason = self.verify_step(candidate)
+            if not ok:
+                log.warning("checkpoint step %d skipped: %s",
+                            candidate, reason)
+                continue
+            try:
+                return restore_fn(candidate)
+            except Exception as e:  # noqa: BLE001 — fall back to prior step
+                last_err = e
+                log.warning("restore of step %d failed (%s); falling back "
+                            "to the previous intact step", candidate, e)
+        if last_err is not None:
+            raise last_err
+        raise FileNotFoundError(f"no intact checkpoint in {self.directory}")
 
     def restore(self, state_template: Any, step: Optional[int] = None) -> Any:
         """Restore into the template's shardings (template = an abstract or
         concrete TrainState with the target shardings attached)."""
-        step = step if step is not None else self.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint in {self.directory}")
         abstract = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
             if hasattr(x, "sharding") else x,
             state_template)
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        return self._restore_with_fallback(
+            lambda s: self._mgr.restore(
+                s, args=ocp.args.StandardRestore(abstract)), step)
 
     def restore_params(self, step: Optional[int] = None) -> Any:
         """Restore just the model params, template-free. The trainer writes
@@ -76,13 +324,19 @@ class CheckpointManager:
         params and has no opt_state template to offer — restore the raw
         tree (orbax saves pytrees as nested dicts) and take its 'params'
         subtree, or the whole tree for params-only checkpoints."""
-        step = step if step is not None else self.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint in {self.directory}")
-        raw = self._mgr.restore(step, args=ocp.args.StandardRestore())
-        if isinstance(raw, dict) and "params" in raw:
-            return raw["params"]
-        return raw
+
+        def _restore(s: int) -> Any:
+            raw = self._mgr.restore(s, args=ocp.args.StandardRestore())
+            if isinstance(raw, dict) and "params" in raw:
+                return raw["params"]
+            return raw
+
+        return self._restore_with_fallback(_restore, step)
 
     def close(self) -> None:
+        try:
+            self._mgr.wait_until_finished()
+            self._flush_manifests()
+        except Exception as e:  # noqa: BLE001 — close stays best-effort
+            log.warning("manifest flush on close failed: %s", e)
         self._mgr.close()

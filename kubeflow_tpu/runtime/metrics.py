@@ -22,6 +22,80 @@ log = logging.getLogger(__name__)
 # harnesses (workflows/kubebench reporter) can aggregate the run
 METRICS_PATH_ENV = "KFTPU_METRICS_PATH"
 
+# pod self-identity, rendered by the operator into every worker container
+# (controllers/tpujob.py — the downward-API analog); with an apiserver URL
+# the worker can annotate its OWN pod with the liveness heartbeat
+POD_NAME_ENV = "KFTPU_POD_NAME"
+POD_NAMESPACE_ENV = "KFTPU_POD_NAMESPACE"
+APISERVER_ENV = "KFTPU_APISERVER"
+
+
+class HeartbeatReporter:
+    """Worker-side liveness for the stall watchdog (SURVEY §5
+    hung-not-dead): patch our OWN pod's heartbeat annotation with the
+    current training step + wall time. The controller restarts a gang
+    whose CHIEF heartbeat is staler than runPolicy.stallTimeoutSeconds
+    (controllers/tpujob.py) — a wedged collective or a dead TPU runtime
+    under a live pod never produces a Failed phase on its own, so this
+    annotation is the only signal the watchdog has.
+
+    Failure policy: reporting is best-effort and rate-limited — a flaky
+    apiserver must never take down a healthy training loop, it only costs
+    heartbeat freshness (and, eventually, a watchdog restart)."""
+
+    def __init__(self, client, namespace: str, pod: str,
+                 interval_s: float = 10.0):
+        self.client = client
+        self.namespace = namespace
+        self.pod = pod
+        self.interval_s = interval_s
+        self._last = 0.0
+
+    @classmethod
+    def from_env(cls, client=None, env: Optional[dict] = None,
+                 interval_s: float = 10.0) -> Optional["HeartbeatReporter"]:
+        """Build from the operator-rendered pod identity env, or None when
+        this process has no pod to annotate (bare-metal runs, tests) or no
+        way to reach an apiserver."""
+        env = os.environ if env is None else env
+        pod = env.get(POD_NAME_ENV)
+        if not pod:
+            return None
+        if client is None:
+            url = env.get(APISERVER_ENV)
+            if not url:
+                return None
+            from ..cluster.http_client import HttpKubeClient
+            # beat() runs synchronously inside the train loop, so this
+            # client must fail FAST: no retries (the next window's beat is
+            # the retry) and a short timeout — with the defaults (30s x 4
+            # attempts) an apiserver outage would stall training for
+            # minutes per window and itself trip the stall watchdog
+            client = HttpKubeClient(url, timeout=5.0, retries=0)
+        return cls(client, env.get(POD_NAMESPACE_ENV, "default"), pod,
+                   interval_s=interval_s)
+
+    def beat(self, step: int, force: bool = False) -> bool:
+        """Record progress at `step`. Rate-limited to one patch per
+        interval unless forced; returns whether a patch was sent."""
+        # import here keeps module import light; trainingjob is jax-free
+        from ..api.trainingjob import HEARTBEAT_ANNOTATION
+        now = time.time()
+        if not force and now - self._last < self.interval_s:
+            return False
+        payload = json.dumps({"step": int(step), "time": now})
+        try:
+            self.client.patch(
+                "v1", "Pod", self.namespace, self.pod,
+                {"metadata": {"annotations": {HEARTBEAT_ANNOTATION:
+                                              payload}}})
+        except Exception as e:  # noqa: BLE001 — liveness must not kill work
+            log.warning("heartbeat patch for %s/%s failed: %s",
+                        self.namespace, self.pod, e)
+            return False
+        self._last = now
+        return True
+
 
 @dataclass
 class StepStats:

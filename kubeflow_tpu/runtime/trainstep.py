@@ -78,6 +78,16 @@ class TrainStepBuilder:
 
     def __post_init__(self):
         validate_weight_update(self.weight_update)
+        # Sharding-invariant RNG: with the legacy (non-partitionable)
+        # threefry, jit-with-sharded-out_shardings generates DIFFERENT
+        # random bits per layout — init(rng) under TP rules diverged ~12%
+        # from the same seed under pure DP
+        # (tests/test_runtime.py::test_tp_matches_dp_numerics).
+        # Partitionable threefry makes random generation a function of the
+        # key alone regardless of output sharding (the default in newer
+        # JAX). Set here, not at import: the flag is process-global, and
+        # only code that actually builds train steps should flip it.
+        jax.config.update("jax_threefry_partitionable", True)
 
     # -- shardings ----------------------------------------------------------
 

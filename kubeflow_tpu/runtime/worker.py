@@ -27,7 +27,8 @@ from ..models import RESNET_DEPTHS
 from .bootstrap import WorkerContext, initialize
 from .recipe import make_optimizer, scale_lr, validate_weight_update
 from .checkpoint import CheckpointManager, HAVE_ORBAX
-from .metrics import METRICS_PATH_ENV, MetricsLogger, profile_trace
+from .metrics import (METRICS_PATH_ENV, HeartbeatReporter, MetricsLogger,
+                      profile_trace)
 from .trainstep import TrainStepBuilder
 
 log = logging.getLogger(__name__)
@@ -102,6 +103,14 @@ _PIPELINED_WORKLOADS = {"transformer-pipelined"}
 
 # workloads that consume --data-dir (ImageNet-style record shards)
 _IMAGE_WORKLOADS = {f"resnet{d}" for d in RESNET_DEPTHS}
+
+
+# worker exit status after a SIGTERM-forced checkpoint: non-zero so the
+# pod lands in Failed and the operator gang-restarts with resume
+# (restart-ELIGIBLE, unlike exit 0 = Succeeded which completes the job),
+# but a recognizable code (EX_TEMPFAIL) so logs distinguish "preempted,
+# checkpointed, please restart me" from a crash
+PREEMPTED_EXIT_CODE = 75
 
 
 @dataclass
@@ -381,6 +390,14 @@ def train(
     mlog = MetricsLogger(metrics_path, batch_size=global_batch,
                          tensorboard_dir=(tensorboard_dir
                                           if ctx.process_id == 0 else None))
+    # liveness heartbeat for the stall watchdog (controllers/tpujob.py):
+    # None outside a pod (no KFTPU_POD_NAME) — bare-metal runs and tests
+    # carry no annotation to patch. The initial forced beat establishes
+    # the baseline, so a worker that wedges inside its FIRST window (the
+    # compile, the first collective) is still caught.
+    heartbeat = HeartbeatReporter.from_env()
+    if heartbeat is not None:
+        heartbeat.beat(int(state.step), force=True)
     data_rng = jax.random.PRNGKey(seed + 1)
     # the record pipeline prefetches host batches on threads; device_put of
     # batch N+1 overlaps step N because the loop only syncs at window edges.
@@ -439,6 +456,12 @@ def train(
                     last_metrics["learning_rate"] = float(lr_fn(step))
                     mlog.end_window(step + 1, window, last_metrics)
                     window = 0
+                    if heartbeat is not None:
+                        # advertise progress at every host sync (rate-
+                        # limited inside beat); a loop that stops closing
+                        # windows stops beating — exactly the signal the
+                        # stall watchdog restarts on
+                        heartbeat.beat(step + 1)
                 if ckpt is not None:
                     # preemption and normal completion force the save
                     # regardless of cadence: the final state must be
@@ -619,7 +642,7 @@ def main(argv=None) -> int:
         weight_update=args.weight_update)
     log.info("done: %d steps, %.1f examples/sec", result.steps,
              result.examples_per_sec)
-    return 0
+    return PREEMPTED_EXIT_CODE if result.preempted else 0
 
 
 if __name__ == "__main__":

@@ -1,6 +1,12 @@
 """Child for the two-process TRAIN test: the full worker loop
 (TrainStepBuilder init/place_batch/step) on a multi-process mesh — the
-scale-out path a real TPUJob gang runs, not just a bare psum."""
+scale-out path a real TPUJob gang runs, not just a bare psum.
+
+Also the vehicle for the PREEMPTION test (tests/test_chaos.py): with
+KFTPU_CHILD_SIGTERM=1 the child installs the PreemptionGuard, checkpoints
+to KFTPU_CHILD_CKPT, and exits with the worker's restart-eligible
+PREEMPTED_EXIT_CODE when a SIGTERM lands mid-train — exactly what a pod
+sees when its TPU slice is reclaimed."""
 
 import json
 import os
@@ -14,19 +20,29 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
 
 def main() -> int:
     from kubeflow_tpu.runtime.bootstrap import initialize
-    from kubeflow_tpu.runtime.worker import train
+    from kubeflow_tpu.runtime.worker import PREEMPTED_EXIT_CODE, train
+
+    steps = int(os.environ.get("KFTPU_CHILD_STEPS", "3"))
+    ckpt_dir = os.environ.get("KFTPU_CHILD_CKPT") or None
+    ckpt_every = int(os.environ.get("KFTPU_CHILD_CKPT_EVERY", "100"))
+    handle_sigterm = os.environ.get("KFTPU_CHILD_SIGTERM") == "1"
 
     ctx = initialize()
-    r = train(workload="transformer", steps=3, global_batch=16,
+    r = train(workload="transformer", steps=steps, global_batch=16,
               sync_every=1, ctx=ctx, workload_kwargs={}, seed=4,
-              handle_sigterm=False)
+              checkpoint_dir=ckpt_dir, checkpoint_every=ckpt_every,
+              handle_sigterm=handle_sigterm)
     print(json.dumps({"process_id": ctx.process_id,
                       "num_processes": ctx.num_processes,
                       "steps": r.steps,
+                      "preempted": r.preempted,
                       "loss": r.final_metrics["loss"],
                       "grad_norm": r.final_metrics["grad_norm"]}),
           flush=True)
-    return 0
+    # the worker main()'s exit contract: non-zero so the operator counts
+    # the pod Failed (restart-eligible), EX_TEMPFAIL so logs read it as
+    # "preempted, checkpointed, restart me" rather than a crash
+    return PREEMPTED_EXIT_CODE if r.preempted else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,575 @@
+"""Seeded chaos scenarios (ISSUE 2): the recovery paths SURVEY §5 promises
+— "a dead worker kills the gang", checkpoint-resume makes restarts cheap —
+exercised against injected faults instead of trusted.
+
+Fault menu (cluster/chaos.py) and the hardening each one pins:
+
+- pod deletion mid-run (preemption)      → vanish-detector gang restart
+- transient apiserver 5xx burst          → controller retry budget +
+                                           HttpKubeClient retry-with-jitter
+- watch-stream drop                      → periodic resync re-enqueue
+- truncated / uncommitted checkpoint     → integrity manifest, latest_step
+                                           skip, previous-intact fallback,
+                                           corrupt-remains clearing on
+                                           re-save
+- hung-but-not-dead chief                → heartbeat + stall watchdog
+- SIGTERM mid-train (slice reclaim)      → PreemptionGuard forced save +
+                                           PREEMPTED_EXIT_CODE
+
+Everything here is seeded/deterministic and fast enough for tier-1 (the
+``chaos`` marker, ci_config.yaml unit_tests_chaos); the end-to-end soaks
+with REAL training segments are ``slow`` (and ``bench.py --mode chaos``).
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubeflow_tpu.api import k8s
+from kubeflow_tpu.api.trainingjob import HEARTBEAT_ANNOTATION
+from kubeflow_tpu.cluster.chaos import (ChaosKubeClient, ChaosPolicy,
+                                        ChaosSoak, SoakFault,
+                                        TransientAPIError,
+                                        truncate_checkpoint_payload,
+                                        uncommit_checkpoint)
+from kubeflow_tpu.cluster.fake import FakeCluster
+from kubeflow_tpu.controllers.runtime import Manager
+from kubeflow_tpu.controllers.tpujob import (RESTART_COUNT_ANNOTATION,
+                                             RESTART_NOT_BEFORE_ANNOTATION,
+                                             TrainingJobReconciler)
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TPU_AV = "tpu.kubeflow.org/v1alpha1"
+
+
+def tpujob_manifest(name="train", **run_policy):
+    return {
+        "apiVersion": TPU_AV, "kind": "TPUJob",
+        "metadata": {"name": name, "namespace": "kubeflow"},
+        "spec": {
+            "replicaSpecs": {
+                "TPU": {"tpuTopology": "v5e-8",
+                        "template": {"spec": {"containers": [
+                            {"name": "jax", "image": "trainer:v1"}]}}},
+            },
+            "checkpointDir": "/ckpt/train",
+            "runPolicy": {"backoffLimit": 4, **run_policy},
+        },
+    }
+
+
+def make_env(policy=None):
+    cluster = FakeCluster()
+    cluster.add_tpu_slice_nodes("v5e-8")
+    chaos = ChaosKubeClient(cluster, policy)
+    mgr = Manager(chaos)
+    ctrl = mgr.add(TrainingJobReconciler("TPUJob"))
+    return cluster, chaos, mgr, ctrl
+
+
+def drive(cluster, mgr, ticks=3):
+    for _ in range(ticks):
+        mgr.run_pending()
+        cluster.tick()
+    mgr.run_pending()
+
+
+def get_job(cluster, name="train"):
+    return cluster.get(TPU_AV, "TPUJob", "kubeflow", name)
+
+
+def running_pods(cluster):
+    return [p for p in cluster.list("v1", "Pod", "kubeflow")
+            if p.get("status", {}).get("phase") == "Running"]
+
+
+# ---------------------------------------------------------------- injection
+
+
+class TestChaosKubeClient:
+    def test_seeded_rate_injection_is_deterministic(self):
+        def positions(seed):
+            c = ChaosKubeClient(FakeCluster(),
+                                ChaosPolicy(seed=seed, error_rate=0.3,
+                                            max_errors=5))
+            for _ in range(40):
+                try:
+                    c.list("v1", "Pod")
+                except TransientAPIError:
+                    pass
+            return [f.at_call for f in c.injected]
+
+        assert positions(7) == positions(7)        # replayable
+        assert positions(7) != positions(8)        # actually seeded
+        assert len(positions(7)) == 5              # budget respected
+
+    def test_burst_and_passthrough(self):
+        cluster = FakeCluster()
+        chaos = ChaosKubeClient(cluster)
+        chaos.fail_next(2)
+        with pytest.raises(TransientAPIError):
+            chaos.list("v1", "Pod")
+        with pytest.raises(TransientAPIError):
+            chaos.list("v1", "Pod")
+        assert chaos.list("v1", "Pod") == []       # burst exhausted
+        # test-driver helpers bypass injection entirely
+        chaos.fail_next(1)
+        chaos.add_tpu_slice_nodes("v5e-8")
+        assert chaos._burst == 1                    # helper consumed no fault
+
+
+# ------------------------------------------------- control-plane scenarios
+
+
+class TestGangRecovery:
+    def test_pod_kill_restarts_gang_with_resume(self):
+        """Preemption deletes the pod OBJECT — no Failed phase ever
+        appears; the vanish detector must restart the whole gang and
+        point it at its own checkpoints."""
+        cluster, _, mgr, _ = make_env()
+        cluster.create(tpujob_manifest())
+        drive(cluster, mgr)
+        assert len(running_pods(cluster)) == 2
+        cluster.delete("v1", "Pod", "kubeflow", "train-worker-0-1")
+        drive(cluster, mgr)
+        job = get_job(cluster)
+        assert k8s.annotations_of(job)[RESTART_COUNT_ANNOTATION] == "1"
+        assert job["spec"]["resumeFrom"] == "/ckpt/train"
+        assert len(running_pods(cluster)) == 2     # gang is back
+
+    def test_api_5xx_burst_survived_by_retry_budget(self):
+        """A worker dies exactly as the apiserver starts throwing 5xxs:
+        the reconciler's bounded retries must absorb the burst and still
+        complete the gang restart."""
+        cluster, chaos, mgr, _ = make_env()
+        cluster.create(tpujob_manifest())
+        drive(cluster, mgr)
+        chaos.fail_next(3)
+        cluster.fail_pod("kubeflow", "train-worker-0-1", "chaos: died")
+        drive(cluster, mgr, ticks=4)
+        assert len(chaos.injected) == 3            # faults really fired
+        job = get_job(cluster)
+        assert k8s.annotations_of(job)[RESTART_COUNT_ANNOTATION] == "1"
+        assert len(running_pods(cluster)) == 2
+
+    def test_watch_drop_recovered_by_resync(self):
+        """Every watch stream dies, then a worker fails: no event will
+        ever arrive, so only the periodic relist (controllers/runtime.py
+        resync_interval) can re-enqueue the job."""
+        cluster, chaos, mgr, ctrl = make_env()
+        cluster.create(tpujob_manifest())
+        drive(cluster, mgr)
+        assert chaos.drop_watch_streams() > 0
+        cluster.fail_pod("kubeflow", "train-worker-0-0", "chaos: died")
+        mgr.run_pending()
+        # watches are dead and resync is off: the failure went unseen
+        assert RESTART_COUNT_ANNOTATION not in \
+            k8s.annotations_of(get_job(cluster))
+        ctrl.resync_interval = 0.001
+        time.sleep(0.002)
+        drive(cluster, mgr)
+        job = get_job(cluster)
+        assert k8s.annotations_of(job)[RESTART_COUNT_ANNOTATION] == "1"
+        assert len(running_pods(cluster)) == 2
+
+    def test_hung_chief_restarted_by_stall_watchdog(self):
+        """Live pod, stale heartbeat: a wedged collective never produces
+        a Failed phase — runPolicy.stallTimeoutSeconds is the only
+        recovery path."""
+        cluster, _, mgr, _ = make_env()
+        cluster.create(tpujob_manifest(stallTimeoutSeconds=60))
+        drive(cluster, mgr)
+        chief = "train-worker-0-0"
+        stale = json.dumps({"step": 3, "time": time.time() - 120})
+        cluster.patch("v1", "Pod", "kubeflow", chief,
+                      {"metadata": {"annotations":
+                                    {HEARTBEAT_ANNOTATION: stale}}})
+        drive(cluster, mgr)
+        job = get_job(cluster)
+        assert k8s.annotations_of(job)[RESTART_COUNT_ANNOTATION] == "1"
+        # recreated chief has NO heartbeat yet: must not re-trip
+        drive(cluster, mgr)
+        assert k8s.annotations_of(
+            get_job(cluster))[RESTART_COUNT_ANNOTATION] == "1"
+
+    def test_fresh_heartbeat_never_trips_watchdog(self):
+        cluster, _, mgr, _ = make_env()
+        cluster.create(tpujob_manifest(stallTimeoutSeconds=60))
+        drive(cluster, mgr)
+        fresh = json.dumps({"step": 3, "time": time.time()})
+        cluster.patch("v1", "Pod", "kubeflow", "train-worker-0-0",
+                      {"metadata": {"annotations":
+                                    {HEARTBEAT_ANNOTATION: fresh}}})
+        drive(cluster, mgr)
+        assert RESTART_COUNT_ANNOTATION not in \
+            k8s.annotations_of(get_job(cluster))
+
+    def test_restart_backoff_gates_recreation(self, monkeypatch):
+        """The not-before annotation persists the wait: the gang stays
+        down until it passes (even across a controller restart), then
+        recreates."""
+        import kubeflow_tpu.controllers.tpujob as tpujob_mod
+
+        cluster, _, mgr, _ = make_env()
+        cluster.create(tpujob_manifest(restartBackoffSeconds=30,
+                                       restartBackoffMaxSeconds=300))
+        drive(cluster, mgr)
+        t0 = time.time()
+        cluster.fail_pod("kubeflow", "train-worker-0-1", "chaos: died")
+        drive(cluster, mgr)
+        job = get_job(cluster)
+        not_before = float(
+            k8s.annotations_of(job)[RESTART_NOT_BEFORE_ANNOTATION])
+        # base 30s, deterministic jitter in [1.0, 1.5)
+        assert 30 <= not_before - t0 <= 46
+        # inside the window: a fresh reconciler (controller restart) must
+        # still hold the gang down
+        rec = TrainingJobReconciler("TPUJob")
+        res = rec.reconcile(cluster, ("kubeflow", "train"))
+        assert res.requeue_after > 0
+        assert cluster.list("v1", "Pod", "kubeflow") == []
+        # after the window: recreate
+        monkeypatch.setattr(tpujob_mod, "_now", lambda: not_before + 1)
+        rec.reconcile(cluster, ("kubeflow", "train"))
+        assert len(cluster.list("v1", "Pod", "kubeflow")) == 2
+
+    def test_backoff_delay_grows_exponentially(self, monkeypatch):
+        """delay = min(base·2^restarts, max) · seeded jitter — computed
+        against a fake clock so the schedule is checked exactly."""
+        import random as random_mod
+
+        import kubeflow_tpu.controllers.tpujob as tpujob_mod
+
+        clock = {"t": 1000.0}
+        monkeypatch.setattr(tpujob_mod, "_now", lambda: clock["t"])
+        cluster, _, mgr, ctrl = make_env()
+        cluster.create(tpujob_manifest(restartBackoffSeconds=30,
+                                       restartBackoffMaxSeconds=300,
+                                       backoffLimit=5))
+        for attempt in range(3):
+            drive(cluster, mgr)
+            victim = k8s.name_of(running_pods(cluster)[0])
+            cluster.fail_pod("kubeflow", victim, "chaos: died")
+            drive(cluster, mgr)
+            nb = float(k8s.annotations_of(get_job(cluster))[
+                RESTART_NOT_BEFORE_ANNOTATION])
+            expected = min(30 * (2 ** attempt), 300) * random_mod.Random(
+                f"kubeflow/train:{attempt}").uniform(1.0, 1.5)
+            assert abs((nb - clock["t"]) - expected) < 1e-3
+            clock["t"] = nb + 1        # step the clock past the window
+            # the controller's requeue timer runs on REAL time; with the
+            # fake clock advanced, re-enqueue the key by hand
+            ctrl.enqueue_existing()
+
+
+# ------------------------------------------------------- worker heartbeat
+
+
+class TestHeartbeatReporter:
+    def _pod(self, cluster, name="hb-pod"):
+        cluster.create({"apiVersion": "v1", "kind": "Pod",
+                        "metadata": {"name": name,
+                                     "namespace": "kubeflow"},
+                        "spec": {"containers": [{"name": "c"}]}})
+
+    def test_beat_patches_own_pod_and_rate_limits(self):
+        from kubeflow_tpu.runtime.metrics import HeartbeatReporter
+        cluster = FakeCluster()
+        self._pod(cluster)
+        hb = HeartbeatReporter(cluster, "kubeflow", "hb-pod", interval_s=60)
+        assert hb.beat(5)
+        raw = k8s.annotations_of(
+            cluster.get("v1", "Pod", "kubeflow",
+                        "hb-pod"))[HEARTBEAT_ANNOTATION]
+        payload = json.loads(raw)
+        assert payload["step"] == 5 and payload["time"] > 0
+        assert not hb.beat(6)                  # rate-limited
+        assert hb.beat(7, force=True)          # ...unless forced
+
+    def test_flaky_apiserver_never_raises(self):
+        from kubeflow_tpu.runtime.metrics import HeartbeatReporter
+        cluster = FakeCluster()
+        self._pod(cluster)
+        chaos = ChaosKubeClient(cluster)
+        chaos.fail_next(1)
+        hb = HeartbeatReporter(chaos, "kubeflow", "hb-pod", interval_s=0)
+        assert not hb.beat(1)                  # swallowed, reported False
+        assert hb.beat(2)                      # next beat lands
+
+    def test_from_env_requires_pod_identity(self):
+        from kubeflow_tpu.runtime.metrics import HeartbeatReporter
+        assert HeartbeatReporter.from_env(env={}) is None
+        hb = HeartbeatReporter.from_env(client=FakeCluster(),
+                                        env={"KFTPU_POD_NAME": "p",
+                                             "KFTPU_POD_NAMESPACE": "ns"})
+        assert hb is not None and hb.pod == "p" and hb.namespace == "ns"
+
+
+# ----------------------------------------------------- http client retries
+
+
+class _ScriptedHandler(BaseHTTPRequestHandler):
+    script: list  # shared across requests: [(code, body), ...]
+    hits: list
+
+    def do_GET(self):
+        code, body = (self.script.pop(0) if self.script
+                      else (200, {"items": []}))
+        type(self).hits.append(code)
+        data = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+@pytest.fixture
+def scripted_server():
+    servers = []
+
+    def make(script):
+        handler = type("H", (_ScriptedHandler,),
+                       {"script": list(script), "hits": []})
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers.append(srv)
+        return f"http://127.0.0.1:{srv.server_port}", handler
+
+    yield make
+    for srv in servers:
+        srv.shutdown()
+        srv.server_close()
+
+
+class TestHttpClientRetry:
+    def test_transient_5xx_retried_to_success(self, scripted_server):
+        from kubeflow_tpu.cluster.http_client import HttpKubeClient
+        url, handler = scripted_server([
+            (503, {"code": 503, "reason": "ServiceUnavailable",
+                   "message": "leader election"}),
+            (500, {"code": 500, "reason": "InternalError",
+                   "message": "boom"}),
+            (200, {"items": [{"metadata": {"name": "ok"}}]}),
+        ])
+        client = HttpKubeClient(url, retries=3, retry_backoff_s=0.01)
+        items = client.list("v1", "Pod")
+        assert [i["metadata"]["name"] for i in items] == ["ok"]
+        assert handler.hits == [503, 500, 200]
+
+    def test_4xx_is_meaning_not_weather(self, scripted_server):
+        from kubeflow_tpu.cluster.client import NotFoundError
+        from kubeflow_tpu.cluster.http_client import HttpKubeClient
+        url, handler = scripted_server([
+            (404, {"code": 404, "reason": "NotFound", "message": "nope"}),
+        ])
+        client = HttpKubeClient(url, retries=3, retry_backoff_s=0.01)
+        with pytest.raises(NotFoundError):
+            client.get("v1", "Pod", "ns", "missing")
+        assert handler.hits == [404]           # exactly one attempt
+
+    def test_exhausted_budget_surfaces_typed_error(self, scripted_server):
+        from kubeflow_tpu.cluster.client import KubeError
+        from kubeflow_tpu.cluster.http_client import HttpKubeClient
+        url, handler = scripted_server([
+            (503, {"code": 503, "reason": "ServiceUnavailable",
+                   "message": "down"})] * 10)
+        client = HttpKubeClient(url, retries=2, retry_backoff_s=0.01)
+        with pytest.raises(KubeError):
+            client.list("v1", "Pod")
+        assert handler.hits == [503, 503, 503]  # 1 try + 2 retries
+
+
+# ------------------------------------------------ checkpoint integrity
+
+
+class TestCheckpointIntegrity:
+    """The on-disk states a writer dying mid-save leaves behind, and the
+    restore-side behavior each must produce. Uses a tiny raw pytree (no
+    train step) so the tier stays fast."""
+
+    def _mgr(self, directory):
+        import numpy as np
+        from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+        m = CheckpointManager(str(directory), save_interval_steps=1,
+                              retry_backoff_s=0.01)
+        for step in (1, 2):
+            m.save(step, {"params": {"w": np.full((64,), float(step))}},
+                   force=True)
+        m.wait()
+        return m, np
+
+    def test_manifest_written_and_verified(self, tmp_path):
+        from kubeflow_tpu.runtime.checkpoint import MANIFEST_NAME
+        m, _ = self._mgr(tmp_path)
+        try:
+            for step in (1, 2):
+                mpath = tmp_path / str(step) / MANIFEST_NAME
+                assert mpath.exists()
+                ok, reason = m.verify_step(step)
+                assert ok, reason
+            assert m.latest_step() == 2
+        finally:
+            m.close()
+
+    def test_uncommitted_latest_is_skipped(self, tmp_path):
+        m, _ = self._mgr(tmp_path)
+        try:
+            uncommit_checkpoint(str(tmp_path / "2"))
+            assert m.latest_step() == 1
+            assert m.restore_params()["w"][0] == 1.0
+        finally:
+            m.close()
+
+    def test_truncated_latest_falls_back_to_prior_intact(self, tmp_path):
+        m, _ = self._mgr(tmp_path)
+        try:
+            truncate_checkpoint_payload(str(tmp_path / "2"))
+            ok, reason = m.verify_step(2)
+            assert not ok and "mismatch" in reason
+            assert m.latest_step() == 1
+            assert m.restore_params()["w"][0] == 1.0   # prior intact step
+            # an operator asking for the corrupt step EXACTLY must get an
+            # error, not a silently different checkpoint
+            with pytest.raises(ValueError, match="not intact"):
+                m.restore_params(step=2)
+        finally:
+            m.close()
+
+    def test_resave_over_corrupt_remains_recovers(self, tmp_path):
+        """The resume-replay collision the chaos soak flushed out:
+        restore fell back past corrupt step N, training replayed to N,
+        and the re-save must clear N's remains instead of dying on
+        orbax's StepAlreadyExistsError."""
+        m, np = self._mgr(tmp_path)
+        try:
+            truncate_checkpoint_payload(str(tmp_path / "2"))
+            assert m.restore_params()["w"][0] == 1.0
+            assert m.save(2, {"params": {"w": np.full((64,), 2.5)}},
+                          force=True)
+            m.wait()
+            assert m.latest_step() == 2
+            assert m.restore_params()["w"][0] == 2.5
+        finally:
+            m.close()
+
+    def test_intact_existing_step_never_cleared(self, tmp_path):
+        """The corrupt-remains clearing is gated on verification: a save
+        retry must never delete a GOOD checkpoint."""
+        m, _ = self._mgr(tmp_path)
+        try:
+            m._clear_corrupt_step(2)               # step 2 is intact
+            assert m.latest_step() == 2
+        finally:
+            m.close()
+
+
+# ----------------------------------------------------- end-to-end (slow)
+
+
+@pytest.mark.slow
+class TestEndToEnd:
+    def test_sigterm_forces_checkpoint_and_preempted_exit(self, tmp_path):
+        """Slice reclaim: SIGTERM mid-train → PreemptionGuard finishes
+        the step, forces a save, and exits PREEMPTED_EXIT_CODE — non-zero
+        (the pod lands Failed, restart-eligible) but recognizable."""
+        from kubeflow_tpu.runtime.checkpoint import ORBAX_COMMIT_MARKER
+        from kubeflow_tpu.runtime.worker import PREEMPTED_EXIT_CODE
+
+        ckpt = tmp_path / "ckpt"
+        env = {**os.environ,
+               "KFTPU_CHILD_STEPS": "100000",   # must NOT finish on its own
+               "KFTPU_CHILD_CKPT": str(ckpt),
+               "KFTPU_CHILD_CKPT_EVERY": "5",
+               "KFTPU_CHILD_SIGTERM": "1",
+               "PYTHONPATH": REPO}
+        env.pop("XLA_FLAGS", None)
+        child = os.path.join(os.path.dirname(__file__),
+                             "_distributed_train_child.py")
+        proc = subprocess.Popen([sys.executable, child], env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+        try:
+            deadline = time.monotonic() + 300
+            committed = []
+            while time.monotonic() < deadline and not committed:
+                if proc.poll() is not None:
+                    break
+                committed = glob.glob(
+                    str(ckpt / "*" / ORBAX_COMMIT_MARKER))
+                time.sleep(0.2)
+            assert committed, "no checkpoint committed before deadline"
+            proc.send_signal(signal.SIGTERM)
+            out, err = proc.communicate(timeout=120)
+        except BaseException:
+            proc.kill()
+            raise
+        assert proc.returncode == PREEMPTED_EXIT_CODE, err[-3000:]
+        result = json.loads(out.strip().splitlines()[-1])
+        assert result["preempted"] is True
+        # the FORCED save: an intact checkpoint exists at a step the
+        # interval alone (every 5) need not have produced
+        from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+        m = CheckpointManager(str(ckpt))
+        try:
+            last = m.latest_step()
+            assert last is not None and last >= 1
+            ok, reason = m.verify_step(last)
+            assert ok, reason
+        finally:
+            m.close()
+
+    @pytest.mark.compute
+    def test_soak_truncated_checkpoint_parity(self, tmp_path):
+        """The acceptance scenario: a run whose LATEST checkpoint is
+        truncated mid-soak must restore from the prior intact step,
+        replay, and land on the same final params as an uninjected run
+        (≤1e-5)."""
+        import jax
+        import numpy as np
+        from kubeflow_tpu.cluster.chaos import final_params
+
+        injected = ChaosSoak(workdir=str(tmp_path / "injected"),
+                             faults=[SoakFault(3, "truncate-ckpt")],
+                             total_steps=5, checkpoint_every=2).run()
+        assert injected["outcome"] == "succeeded", injected
+        assert injected["restart_reasons"] == ["GangRestart"]
+        clean = ChaosSoak(workdir=str(tmp_path / "clean"), faults=[],
+                          total_steps=5, checkpoint_every=2).run()
+        assert clean["outcome"] == "succeeded", clean
+        deltas = jax.tree.map(
+            lambda a, b: float(np.max(np.abs(np.asarray(a) -
+                                             np.asarray(b)))),
+            final_params(injected["checkpoint_dir"]),
+            final_params(clean["checkpoint_dir"]))
+        assert max(jax.tree.leaves(deltas), default=0.0) <= 1e-5
+
+    @pytest.mark.compute
+    def test_soak_full_fault_menu(self, tmp_path):
+        """All five distinct fault kinds in one run, each recovered, job
+        Succeeded (the bench.py --mode chaos scenario, compressed)."""
+        report = ChaosSoak(
+            workdir=str(tmp_path),
+            faults=[SoakFault(2, "pod-kill"), SoakFault(3, "api-burst"),
+                    SoakFault(4, "watch-drop"),
+                    SoakFault(5, "truncate-ckpt"),
+                    SoakFault(6, "hung-chief")],
+            total_steps=8, checkpoint_every=2).run()
+        assert report["outcome"] == "succeeded", report
+        assert len(report["injected"]) == 5
+        assert "GangPodsVanished" in report["restart_reasons"]
+        assert "StallTimeout" in report["restart_reasons"]
+        assert report["api_faults"] >= 3           # the burst really hit

@@ -63,6 +63,65 @@ def test_step_engine_knobs_cover_the_operator_surface():
         assert spec_field in manifests_src, (knob.name, spec_field)
 
 
+def test_run_policy_fields_are_plumbed_end_to_end():
+    """Every RunPolicy field must be plumbed spec → controller →
+    manifests: round-trip through the TPUJob spec wire format
+    (api/trainingjob.py), consumed by the reconciler
+    (controllers/tpujob.py), and renderable from the example manifest
+    builder (manifests/training.py tpu-job-simple) — so a future
+    failure-handling knob (the backoffLimit / stallTimeoutSeconds
+    family) can't silently exist in one layer only."""
+    import dataclasses
+
+    from kubeflow_tpu.api.trainingjob import RunPolicy, TrainingJob
+    from kubeflow_tpu.manifests.training import tpu_job_simple
+
+    non_default = {
+        "clean_pod_policy": "None",
+        "backoff_limit": 7,
+        "active_deadline_seconds": 1234,
+        "gang_scheduling": True,    # mandatory for TPU replicas
+        "ttl_seconds_after_finished": 55,
+        "restart_backoff_seconds": 11.0,
+        "restart_backoff_max_seconds": 222.0,
+        "stall_timeout_seconds": 77,
+    }
+    fields = {f.name for f in dataclasses.fields(RunPolicy)}
+    assert fields == set(non_default), \
+        "RunPolicy field added/removed — extend this plumbing check"
+
+    # spec wire round-trip: to_dict → from_manifest → identical policy
+    rp = RunPolicy(**non_default)
+    manifest = {
+        "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": "t", "namespace": "ns"},
+        "spec": {"replicaSpecs": {"TPU": {
+            "tpuTopology": "v5e-8",
+            "template": {"spec": {"containers": [{"name": "c"}]}}}},
+            "runPolicy": rp.to_dict()},
+    }
+    assert TrainingJob.from_manifest(manifest).run_policy == rp
+
+    # controller: every field is read off run_policy somewhere in the
+    # reconciler (gang_scheduling excepted: TPU gangs ALWAYS carry the
+    # pod-group label, the knob only parameterizes the operator deploy)
+    with open(os.path.join(REPO_ROOT, "kubeflow_tpu", "controllers",
+                           "tpujob.py")) as f:
+        controller_src = f.read()
+    for name in fields - {"gang_scheduling"}:
+        assert (f"run_policy.{name}" in controller_src
+                or f"rp.{name}" in controller_src), \
+            f"RunPolicy.{name} is never consumed by controllers/tpujob.py"
+
+    # manifests: the example builder accepts each knob and renders the
+    # policy through RunPolicy.to_dict (admissible end to end)
+    job = next(o for o in tpu_job_simple(**{k: v for k, v in
+                                            non_default.items()})
+               if o["kind"] == "TPUJob")
+    assert job["spec"]["runPolicy"] == rp.to_dict()
+    assert TrainingJob.from_manifest(job).run_policy == rp
+
+
 class TestChecker:
     def _check(self, tmp_path, source, name="m.py"):
         p = tmp_path / name

@@ -718,6 +718,254 @@ def bench_weight_update(t_start: float | None = None) -> dict:
     }
 
 
+def _env_int(name: str, default: int) -> int:
+    """Strict like the worker's env parsing: a typo'd knob must fail
+    loudly, not silently run the bench at the default."""
+    import os
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return int(v)   # ValueError names the offending value
+
+
+def bench_input(t_start: float | None = None) -> dict:
+    """Input-pipeline microbench: per-stage rates (record read,
+    decode+augment, sharded H2D, the multi-process augment ring) and the
+    serial-vs-overlapped A/B (PERF.md "Overlapped input pipeline").
+
+    Both arms consume the SAME records at the same batch/geometry and
+    pace each step with a fixed simulated device-step budget — a timed
+    wait, because a real TPU computes without spending host CPU, and on
+    the CPU mesh a jitted step would burn the very cores the input
+    stages are being measured on (the A/B would then measure host-CPU
+    contention, not pipeline architecture). The serial arm runs every
+    stage on the critical path with a hard per-step barrier (the
+    pre-pipeline worker loop); the overlapped arm is the shipped path:
+    augment worker processes over the shared-memory ring
+    (data/mp_augment.py) + double-buffered device placement
+    (data/device_prefetch.py), synced only at window edges.
+
+    Both arms pin KFTPU_AUGMENT_IMPL=py on CPU hosts: the native augment
+    kernel is itself multi-threaded in-process, which would conflate
+    kernel-level parallelism with pipeline architecture on a small host
+    (on TPU hosts the default native kernel runs in both arms).
+
+    On a CPU backend with fewer than 8 devices the measurement re-execs
+    itself with the 8-device host-platform flag so the H2D stage
+    exercises the worker's real data-sharded placement."""
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+
+    t_start = time.perf_counter() if t_start is None else t_start
+    import jax
+    import numpy as np
+
+    if jax.devices()[0].platform == "cpu" and len(jax.devices()) < 8 \
+            and not os.environ.get("KFTPU_BENCH_INPUT_CHILD"):
+        # the parent's backend is already initialized with 1 device; the
+        # 8-device mesh needs the flag set before jax import → child
+        env = {**os.environ, "KFTPU_BENCH_INPUT_CHILD": "1",
+               "JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") +
+                             " --xla_force_host_platform_device_count=8")}
+        res = subprocess.run([sys.executable, __file__, "--mode", "input"],
+                             env=env, capture_output=True, text=True,
+                             timeout=900)
+        for line in reversed(res.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                row = json.loads(line)
+                row["_flops_per_chip"] = 0.0
+                return row
+        raise RuntimeError("input bench child emitted no JSON row "
+                           f"(rc={res.returncode}): {res.stderr[-2000:]}")
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from kubeflow_tpu.data.device_prefetch import DevicePrefetcher
+    from kubeflow_tpu.data.imagenet import (ImageNetSource, augment_base,
+                                            augment_batch, decode_records,
+                                            write_shards)
+
+    dev = jax.devices()[0]
+    on_cpu = dev.platform == "cpu"
+    if on_cpu:
+        os.environ.setdefault("KFTPU_AUGMENT_IMPL", "py")
+
+    B = _env_int("KFTPU_BENCH_INPUT_BATCH", 128)
+    S = _env_int("KFTPU_BENCH_INPUT_IMAGE", 96)
+    NB = _env_int("KFTPU_BENCH_INPUT_BATCHES", 18)
+    repeats = _env_int("KFTPU_BENCH_INPUT_REPEATS", 5)
+    workers = _env_int("KFTPU_BENCH_INPUT_WORKERS", 2)
+    depth = _env_int("KFTPU_BENCH_INPUT_DEPTH", 2)
+    step_ms = _env_int("KFTPU_BENCH_INPUT_STEP_MS", 40)
+    n_dev = len(jax.devices())
+    B -= B % max(n_dev, 1)   # data-sharded placement: batch % devices == 0
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+
+    def place(batch):
+        # the worker's data-sharded layout (TrainStepBuilder.place_batch):
+        # batch dim split across every device on the mesh
+        return {k: jax.device_put(v, sharding) for k, v in batch.items()}
+
+    def consume(placed):
+        # simulated device step: wait for the transfer, then hold the
+        # step budget WITHOUT host CPU (see docstring)
+        jax.block_until_ready(placed)
+        if step_ms:
+            time.sleep(step_ms / 1000.0)
+
+    tmp = tempfile.mkdtemp(prefix="kftpu-input-bench-")
+    timings: dict = {}
+    try:
+        rng = np.random.default_rng(7)
+        n_rec = B * (NB + 2)   # +2: the primed batch + slack per epoch
+        images = rng.integers(0, 256, (n_rec, S, S, 3), dtype=np.uint8)
+        labels = (np.arange(n_rec) % 100).astype(np.int64)
+        write_shards(tmp, images, labels, shard_records=max(B, 256),
+                     num_classes=100)
+        del images, labels
+
+        # -- stage attribution ------------------------------------------
+        src = ImageNetSource(tmp, batch_size=B, output="uint8")
+        pipe = src._epoch_pipeline(0, 3)
+        raws = []
+        t0 = time.perf_counter()
+        for i, raw in enumerate(pipe):
+            if i < 2:
+                raws.append(np.array(raw))
+            if i + 1 >= NB:
+                break
+        timings["record_read"] = (time.perf_counter() - t0) / NB
+        src.close()
+
+        imgs, _ = decode_records(raws[0], S)
+        base = augment_base(3, 0, 0)
+        reps = 8
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = augment_batch(imgs, base, 4, do_flip=True, do_crop=True,
+                                output="uint8")
+        timings["decode_augment"] = (time.perf_counter() - t0) / reps
+
+        host_batch = {"images": out,
+                      "labels": np.zeros(B, np.int32)}
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            jax.block_until_ready(place(host_batch))
+        timings["h2d_sharded"] = (time.perf_counter() - t0) / reps
+
+        # -- input-path-only rates (no step pacing) ---------------------
+        def serial_arm(pace_ms):
+            """Every stage on the critical path, hard per-step barrier."""
+            src = ImageNetSource(tmp, batch_size=B, output="uint8")
+            try:
+                it = src.epoch(0, seed=3)
+                consume(place(next(it)))   # prime: pipeline spin-up
+                n = 0
+                t0 = time.perf_counter()
+                for batch in it:
+                    placed = place(batch)
+                    jax.block_until_ready(placed)
+                    if pace_ms:
+                        time.sleep(pace_ms / 1000.0)
+                    n += 1
+                    if n >= NB:   # checked BEFORE pulling batch NB+1:
+                        break     # an extra pull here is a full augment
+                return (time.perf_counter() - t0) / n
+            finally:
+                src.close()
+
+        def overlapped_arm(pace_ms):
+            """The shipped pipeline: mp augment ring + device prefetch,
+            synced only on the batch being read. The step budget is a
+            DEADLINE, not a sleep after the fetch: the worker loop
+            dispatches step N and fetches/places batch N+1 while the
+            device computes, so the simulated device must likewise run
+            concurrently with the host-side input work (queue depth 1 —
+            conservative vs the real loop's deeper dispatch queue)."""
+            src = ImageNetSource(tmp, batch_size=B, output="uint8",
+                                 workers=workers)
+            try:
+                it = DevicePrefetcher(src.batches(seed=3), place,
+                                      depth=depth)
+                consume(next(it))   # prime: spawn + first fill
+                n = 0
+                t0 = time.perf_counter()
+                deadline = t0      # when the device finishes step n-1
+                for placed in it:
+                    jax.block_until_ready(placed)   # transfer complete
+                    now = time.perf_counter()
+                    if pace_ms:
+                        if now < deadline:
+                            time.sleep(deadline - now)
+                        # step n dispatched the moment its batch is ready
+                        deadline = max(now, deadline) + pace_ms / 1000.0
+                    n += 1
+                    if n >= NB:   # symmetric with the serial arm
+                        break
+                if pace_ms:         # the last dispatched step completes
+                    now = time.perf_counter()
+                    if now < deadline:
+                        time.sleep(deadline - now)
+                dt = (time.perf_counter() - t0) / n
+                it.close()
+                return dt
+            finally:
+                src.close()
+
+        # PAIRED A/B: the arms alternate within each repeat and the
+        # headline is the median of per-pair ratios — host-load drift
+        # between repeats (this box is noisy) cancels inside a pair
+        # where a median-of-arm-medians would not
+        def paired(pace_ms):
+            pairs = [(serial_arm(pace_ms), overlapped_arm(pace_ms))
+                     for _ in range(repeats)]
+            ratio = float(np.median([s / o for s, o in pairs]))
+            return (float(np.median([s for s, _ in pairs])),
+                    float(np.median([o for _, o in pairs])), ratio)
+
+        (timings["serial_input_path"], timings["overlapped_input_path"],
+         input_only_ratio) = paired(0)
+
+        # -- the A/B under a device-step budget -------------------------
+        serial_s, overlap_s, ratio = paired(step_ms)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    stages_img_s = {k: round(B / v, 1) for k, v in timings.items()}
+    return {
+        "metric": "input_pipeline_overlap_speedup",
+        "value": round(ratio, 3),
+        "unit": "serial_step_time_over_overlapped",
+        "vs_baseline": None,
+        "mfu": None,
+        "extras": {
+            "device_kind": getattr(dev, "device_kind", dev.platform),
+            "devices": n_dev,
+            "global_batch": B,
+            "image_size": S,
+            "augment_impl": os.environ.get("KFTPU_AUGMENT_IMPL", "native"),
+            "input_workers": workers,
+            "device_prefetch_depth": depth,
+            "simulated_step_ms": step_ms,
+            "batches_per_run": NB,
+            "repeats": repeats,
+            "stages_img_s": stages_img_s,
+            "serial_ms_per_batch": round(serial_s * 1e3, 1),
+            "overlapped_ms_per_batch": round(overlap_s * 1e3, 1),
+            "serial_img_s": round(B / serial_s, 1),
+            "overlapped_img_s": round(B / overlap_s, 1),
+            "input_only_speedup": round(input_only_ratio, 3),
+        },
+        "_flops_per_chip": 0.0,
+    }
+
+
 def bench_chaos(t_start: float | None = None) -> dict:
     """Chaos soak (cluster/chaos.py): drive ONE TPUJob end to end through
     the full scripted fault menu — pod deletion (preemption), a pod crash
@@ -815,7 +1063,7 @@ def main(argv=None) -> int:
     p.add_argument("--mode", default="all",
                    choices=["all", "resnet", "resnet-fused", "lm",
                             "lm-long", "serving", "fused-blocks",
-                            "weight-update", "chaos"])
+                            "weight-update", "chaos", "input"])
     p.add_argument("--routing-out",
                    default="bench-matrix/fused_routing_measured.json",
                    help="where --mode fused-blocks writes the measured "
@@ -863,6 +1111,8 @@ def main(argv=None) -> int:
         row = bench_weight_update(t_start=t_start)
     elif args.mode == "chaos":
         row = bench_chaos(t_start=t_start)
+    elif args.mode == "input":
+        row = bench_input(t_start=t_start)
     else:
         row = bench_resnet(fused=False, t_start=t_start)
 
@@ -926,11 +1176,13 @@ def main(argv=None) -> int:
                       "serving": bench_serving,
                       "fused-blocks": lambda: bench_fused_blocks(
                           routing_out=args.routing_out),
-                      "weight-update": bench_weight_update}
+                      "weight-update": bench_weight_update,
+                      "input": bench_input}
         for key, mode in (("fused", "resnet-fused"), ("lm", "lm"),
                           ("lm_long", "lm-long"),
                           ("serving", "serving"),
                           ("weight_update", "weight-update"),
+                          ("input", "input"),
                           ("fused_blocks", "fused-blocks")):
             if mode == "fused-blocks" and not on_tpu:
                 # per-block attribution is the most expensive extra (10
@@ -950,8 +1202,12 @@ def main(argv=None) -> int:
                     "error": "skipped: elapsed budget (2400s) reached"}
             else:
                 try:
+                    # the input A/B pays ~6 paired pipeline runs; the
+                    # wider budget still fits because its primary cost
+                    # is timed sleep, not compute
                     sub = in_process[mode]() if on_tpu else \
-                        _run_sub_bench(mode, budget_s=240.0)
+                        _run_sub_bench(mode, budget_s=420.0 if
+                                       mode == "input" else 240.0)
                     row["extras"][key] = {
                         "metric": sub["metric"], "value": sub["value"],
                         "unit": sub["unit"], "mfu": sub["mfu"],
@@ -959,7 +1215,10 @@ def main(argv=None) -> int:
                            ("model_tflops", "loss", "latency",
                             "cold_first_request_s", "warmup_s",
                             "fused_routing", "blocks", "weight_update",
-                            "routing_table_written", "error")
+                            "routing_table_written", "stages_img_s",
+                            "serial_img_s", "overlapped_img_s",
+                            "simulated_step_ms", "input_workers",
+                            "input_only_speedup", "error")
                            if k in sub["extras"]},
                     }
                 except Exception as e:  # noqa: BLE001 — artifact lands

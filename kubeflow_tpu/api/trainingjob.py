@@ -25,7 +25,7 @@ kubebench templates written against the reference kinds run unmodified.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, fields
 from typing import Any, Optional
 
 from . import k8s
@@ -55,6 +55,66 @@ def validate_weight_update(mode: str) -> str:
         raise ValueError(
             f"weight_update {mode!r} not one of {WEIGHT_UPDATE_MODES}")
     return mode
+
+
+@dataclass
+class InputSpec:
+    """Input-pipeline knobs (``spec.input``): how the worker feeds the
+    chips. Each field is plumbed the full operator path — parsed here at
+    admission, rendered by controllers/tpujob.py as the env named in its
+    metadata, consumed by runtime/worker.py via the CLI flag named there
+    (tests/test_lint.py enforces every layer). ``None`` = unset, worker
+    default. Defined HERE, jax-free, like WEIGHT_UPDATE_MODES: admission
+    must not import the runtime."""
+
+    # decode+augment worker processes feeding the shared-memory input
+    # ring (data/mp_augment.py); 0 = the in-process prefetch thread
+    workers: Optional[int] = field(default=None, metadata={
+        "spec_field": "workers", "env": "KFTPU_INPUT_WORKERS",
+        "cli": "--input-workers"})
+    # device batches staged ahead of the step by async device_put
+    # (data/device_prefetch.py); 0 = place on the critical path
+    device_prefetch: Optional[int] = field(default=None, metadata={
+        "spec_field": "devicePrefetch", "env": "KFTPU_DEVICE_PREFETCH",
+        "cli": "--device-prefetch"})
+
+    def validate(self) -> None:
+        for f in fields(self):
+            v = getattr(self, f.name)
+            if v is None:
+                continue
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                raise ValueError(
+                    f"input.{f.metadata['spec_field']} must be a "
+                    f"non-negative integer, got {v!r}")
+
+    def to_dict(self) -> dict:
+        return {f.metadata["spec_field"]: getattr(self, f.name)
+                for f in fields(self) if getattr(self, f.name) is not None}
+
+    def to_env(self) -> dict[str, str]:
+        """The controller-rendered worker env for every SET knob."""
+        return {f.metadata["env"]: str(getattr(self, f.name))
+                for f in fields(self) if getattr(self, f.name) is not None}
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "InputSpec":
+        if d is not None and not isinstance(d, dict):
+            # a YAML list/scalar typo must get the same clean
+            # admission-time rejection as a bad knob value
+            raise ValueError(
+                f"spec.input must be a mapping of input-pipeline knobs, "
+                f"got {type(d).__name__}: {d!r}")
+        d = dict(d or {})
+        by_spec = {f.metadata["spec_field"]: f.name for f in fields(cls)}
+        unknown = set(d) - set(by_spec)
+        if unknown:
+            raise ValueError(
+                f"unknown input-pipeline knobs {sorted(unknown)}; "
+                f"valid: {sorted(by_spec)}")
+        spec = cls(**{by_spec[k]: v for k, v in d.items()})
+        spec.validate()
+        return spec
 
 # apiVersion per kind (reference CRD groups/versions)
 API_VERSIONS = {
@@ -294,6 +354,11 @@ class TrainingJob:
     # (BASELINE.md north-star #2). Defaults to a subdir of checkpointDir
     # when that is set (same volume the gang already mounts).
     compile_cache_dir: str = ""
+    # input-pipeline knobs (spec.input → KFTPU_INPUT_WORKERS /
+    # KFTPU_DEVICE_PREFETCH): augment worker processes and device
+    # prefetch depth — the overlapped input pipeline (docs/training.md
+    # "Input pipeline")
+    input_spec: InputSpec = field(default_factory=InputSpec)
     # optimizer-update layout across data-parallel replicas (rendered as
     # KFTPU_WEIGHT_UPDATE; WEIGHT_UPDATE_MODES above):
     # "sharded" = ZeRO-2 cross-replica sharded weight update — reduce-
@@ -360,6 +425,7 @@ class TrainingJob:
             eval_data_dir=spec.get("evalDataDir", "") or "",
             tensorboard_dir=spec.get("tensorboardDir", "") or "",
             compile_cache_dir=spec.get("compileCacheDir", "") or "",
+            input_spec=InputSpec.from_dict(spec.get("input")),
             weight_update=spec.get("weightUpdate", "") or "",
             raw=obj,
         )
@@ -396,6 +462,7 @@ class TrainingJob:
             # admission-time rejection: a typo'd mode must fail at apply,
             # not at worker startup deep inside the gang
             validate_weight_update(self.weight_update)
+        self.input_spec.validate()
         vocab = REPLICA_TYPES[self.kind]
         if not self.replica_specs:
             raise ValueError(f"{self.kind} {self.name}: no replica specs")
@@ -460,6 +527,8 @@ class TrainingJob:
             out["spec"]["tensorboardDir"] = self.tensorboard_dir
         if self.compile_cache_dir:
             out["spec"]["compileCacheDir"] = self.compile_cache_dir
+        if self.input_spec.to_dict():
+            out["spec"]["input"] = self.input_spec.to_dict()
         if self.weight_update:
             out["spec"]["weightUpdate"] = self.weight_update
         if self.raw:

@@ -340,6 +340,11 @@ class TrainingJobReconciler(Reconciler):
             # spec.weightUpdate → the worker's ZeRO-2 weight-update knob
             # (runtime/worker.py reads it into TrainStepBuilder)
             env["KFTPU_WEIGHT_UPDATE"] = job.weight_update
+        # spec.input → the overlapped-input-pipeline knobs: augment
+        # worker processes (KFTPU_INPUT_WORKERS) and device prefetch
+        # depth (KFTPU_DEVICE_PREFETCH) — runtime/worker.py reads them
+        # into the shared-memory augment ring / DevicePrefetcher
+        env.update(job.input_spec.to_env())
         from ..runtime.compile_cache import (COMPILE_CACHE_ENV,
                                              default_cache_dir)
         cache_dir = job.compile_cache_dir or (

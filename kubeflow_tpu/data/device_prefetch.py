@@ -1,0 +1,85 @@
+"""Device-side input prefetch: double-buffered ``jax.device_put``.
+
+Stage host batches onto the mesh ``depth`` batches ahead of the
+consuming train step, so host→device copies overlap device compute
+instead of serializing with it — the prefetch-to-device half of the
+overlapped input pipeline (PERF.md "Real-data input path"; the standard
+design in the MLPerf-style ImageNet reference trainers).
+
+``jax.device_put`` is an async dispatch: placing batch N+depth returns
+immediately while the transfer proceeds in the background, and the step
+consuming batch N synchronizes only on the buffers it actually reads.
+Depth 2 (double buffering) hides any transfer shorter than a step;
+deeper pipelines buy slack against jittery host-side producers at the
+cost of ``depth`` extra device-resident batches — the ONLY extra HBM
+this holds (buffers are handed off, never retained, so device memory
+does not grow with iteration count).
+
+The prefetcher tops the queue up to ``depth`` BEFORE yielding, so every
+batch it returns had its transfer dispatched at least one call earlier —
+the lead time that hides H2D under the step. The flip side is accepted
+deliberately: when the host-side producer stalls, ``__next__`` waits for
+the refill even while staged batches sit ready. Buffering against
+producer jitter is the upstream augment ring's job (its slots already
+hold ``workers + 2`` finished slabs); this stage's one job is transfer
+lead, and yielding refill-first would hand 1-in-``depth`` batches to the
+step with a zero-lead, critical-path copy.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Iterable
+
+
+class DevicePrefetcher:
+    """Iterator of device-placed batches, ``depth`` ahead of the consumer.
+
+    ``place_fn`` is typically ``TrainStepBuilder.place_batch`` — whatever
+    it returns is what the consumer sees, so sharded placement is exactly
+    the non-prefetched path's (tests pin this)."""
+
+    def __init__(self, source: Iterable, place_fn: Callable[[Any], Any],
+                 depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._it = iter(source)
+        self._place = place_fn
+        self.depth = int(depth)
+        self._buf: deque = deque()
+        self._exhausted = False
+
+    @property
+    def in_flight(self) -> int:
+        """Batches currently staged on device (≤ depth — the HBM bound)."""
+        return len(self._buf)
+
+    def _fill(self) -> None:
+        while not self._exhausted and len(self._buf) < self.depth:
+            try:
+                item = next(self._it)
+            except StopIteration:
+                self._exhausted = True
+                return
+            self._buf.append(self._place(item))
+
+    def __iter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __next__(self) -> Any:
+        # fill-then-pop: topping up BEFORE yielding guarantees the
+        # returned batch was placed at least one call earlier, i.e. its
+        # transfer had a full step to complete (see module doc for why
+        # this wins over yielding staged batches refill-first)
+        self._fill()
+        if not self._buf:
+            raise StopIteration
+        return self._buf.popleft()
+
+    def close(self) -> None:
+        """Drop the staged batches (releases their device buffers) and
+        stop pulling from the source — which its owner closes; an
+        early-stopped run must not leave ``depth`` batches pinned in
+        HBM or a producer feeding a dead consumer."""
+        self._buf.clear()
+        self._exhausted = True

@@ -109,6 +109,48 @@ def _py_augment(images: np.ndarray, base: int, pad: int, *,
     return out
 
 
+def decode_records(raw: np.ndarray, image_size: int
+                   ) -> tuple[np.ndarray, np.ndarray]:
+    """(N, record_bytes) uint8 rows → ((N,H,H,3) uint8 image view,
+    (N,) int32 labels). Shared by ImageNetSource and the multi-process
+    augment workers (data/mp_augment.py) so the two paths cannot drift."""
+    n = raw.shape[0]
+    labels = raw[:, :LABEL_BYTES].copy().view("<i4").reshape(n)
+    images = raw[:, LABEL_BYTES:].reshape(n, image_size, image_size, 3)
+    return images, labels.astype(np.int32, copy=False)
+
+
+def augment_batch(images: np.ndarray, base: int, pad: int, *,
+                  do_flip: bool, do_crop: bool, output: str = "normalized",
+                  image_dtype=np.float32) -> np.ndarray:
+    """One fused augment pass over a decoded uint8 batch: flip +
+    reflect-pad crop (+ normalize unless output='uint8', the
+    device-normalize mode). Native C++ fast path
+    (native/augment/augment.cc), numpy fallback computing the
+    bit-identical result from the same splitmix64 parameters
+    (KFTPU_AUGMENT_IMPL=py kill-switches the native kernel — also how
+    ``bench.py --mode input`` pins BOTH A/B arms to the GIL-bound
+    implementation for a matched architecture comparison). Pure
+    function of (images, base) — the determinism contract the
+    single-thread and multi-process paths both ride."""
+    from .native import native_augment, native_augment_u8, native_available
+    use_native = native_available() and \
+        os.environ.get("KFTPU_AUGMENT_IMPL", "native") != "py"
+    if output == "uint8":
+        if use_native:
+            return native_augment_u8(images, base, pad,
+                                     do_flip=do_flip, do_crop=do_crop)
+        return _py_augment(images, base, pad, do_flip=do_flip,
+                           do_crop=do_crop, normalize=False)
+    if use_native:
+        out = native_augment(images, base, pad, MEAN_RGB, STDDEV_RGB,
+                             do_flip=do_flip, do_crop=do_crop)
+    else:
+        out = _py_augment(images, base, pad, do_flip=do_flip,
+                          do_crop=do_crop)
+    return out.astype(image_dtype, copy=False)
+
+
 def device_normalize(images_u8):
     """The on-device half of the uint8 input mode: identical math to the
     host normalize (x*(1/(255*std)) - mean/std, f32). Runs inside jit on
@@ -191,6 +233,14 @@ class _Prefetcher:
 
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
+        # producer outcome, tracked OUTSIDE the queue: the queued END /
+        # exception item can be lost (a stop() drain, a failed _put), and
+        # a consumer that then sees only a dead thread must be able to
+        # tell "finished cleanly" from "died mid-epoch" — the latter used
+        # to end iteration silently, truncating the epoch while the run
+        # "succeeded" on partial data.
+        self._done = False
+        self._error: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._produce, args=(it,),
                                         daemon=True,
                                         name="imagenet-prefetch")
@@ -210,9 +260,12 @@ class _Prefetcher:
         try:
             for item in it:
                 if not self._put(item):
+                    self._done = True   # consumer-initiated stop, not a death
                     return
+            self._done = True
             self._put(self._END)
         except BaseException as e:  # noqa: BLE001 - surface to consumer
+            self._error = e
             self._put(e)
 
     def __iter__(self) -> Iterator:
@@ -223,6 +276,15 @@ class _Prefetcher:
                     item = self._q.get(timeout=0.5)
                 except queue.Empty:
                     if not self._thread.is_alive():
+                        if self._error is not None:
+                            # the queued exception was lost (put raced a
+                            # stop/drain) — raise the tracked copy
+                            raise self._error
+                        if not self._done:
+                            raise RuntimeError(
+                                "prefetch producer died without an error "
+                                "or EOF — refusing to pass a truncated "
+                                "epoch off as complete")
                         return
                     continue
                 if item is self._END:
@@ -256,7 +318,11 @@ class ImageNetSource:
                  num_threads: int = 2, queue_depth: int = 4,
                  image_dtype: Optional[np.dtype] = None,
                  output: str = "normalized",
-                 drop_remainder: bool = True):
+                 drop_remainder: bool = True,
+                 workers: int = 0,
+                 ring_slots: Optional[int] = None):
+        if workers < 0:
+            raise ValueError(f"workers must be >= 0, got {workers}")
         if output not in ("normalized", "uint8"):
             raise ValueError(f"output {output!r} not in "
                              "('normalized', 'uint8')")
@@ -274,6 +340,13 @@ class ImageNetSource:
         self.augment = augment
         self.pad_px = pad_px
         self.image_dtype = image_dtype or np.float32
+        # multi-process augment stage: decode+augment fan out over
+        # `workers` spawned processes writing a shared-memory ring
+        # (data/mp_augment.py), byte-identical to the in-process path.
+        # 0 = the single prefetch-thread path.
+        self.workers = int(workers)
+        self._ring_slots = ring_slots
+        self._mp_pool = None
         self._num_threads = num_threads
         self._queue_depth = queue_depth
         self._paths = shard_paths(data_dir)
@@ -296,43 +369,19 @@ class ImageNetSource:
     # -- decode / augment (host-side) ---------------------------------------
 
     def _decode(self, raw: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-        n = raw.shape[0]
-        labels = raw[:, :LABEL_BYTES].copy().view("<i4").reshape(n)
-        images = raw[:, LABEL_BYTES:].reshape(
-            n, self.image_size, self.image_size, 3)
-        return images, labels
+        return decode_records(raw, self.image_size)
 
     def _augment_normalize(self, images: np.ndarray, base: int,
                            augment: bool) -> np.ndarray:
-        """One fused pass: flip + reflect-pad crop (+ normalize unless in
-        uint8 device-normalize mode). Native C++ fast path
-        (native/augment/augment.cc), numpy fallback computing the
-        bit-identical result from the same splitmix64 parameters."""
-        from .native import (native_augment, native_augment_u8,
-                             native_available)
-        if self.output == "uint8":
-            if native_available():
-                return native_augment_u8(images, base, self.pad_px,
-                                         do_flip=augment, do_crop=augment)
-            return _py_augment(images, base, self.pad_px, do_flip=augment,
-                               do_crop=augment, normalize=False)
-        if native_available():
-            out = native_augment(
-                images, base, self.pad_px, MEAN_RGB, STDDEV_RGB,
-                do_flip=augment, do_crop=augment)
-        else:
-            out = _py_augment(images, base, self.pad_px,
-                              do_flip=augment, do_crop=augment)
-        return out.astype(self.image_dtype, copy=False)
+        return augment_batch(images, base, self.pad_px,
+                             do_flip=augment, do_crop=augment,
+                             output=self.output,
+                             image_dtype=self.image_dtype)
 
     # -- iteration -----------------------------------------------------------
 
-    def epoch(self, epoch: int, seed: int = 0, skip: int = 0
-              ) -> Iterator[dict]:
-        """One pass over the data for the given epoch index. ``skip``
-        drops the first N batches (resume); determinism holds because the
-        augment RNG is derived per (seed, epoch, batch index), not drawn
-        sequentially."""
+    def _epoch_pipeline(self, epoch: int, seed: int):
+        """The record pipeline reset/constructed for one epoch's shuffle."""
         if self._pipeline is None:
             self._pipeline = RecordPipeline(
                 self._paths, self.meta["record_bytes"], self.batch_size,
@@ -341,14 +390,22 @@ class ImageNetSource:
                 drop_remainder=self.drop_remainder)
         else:
             self._pipeline.reset(seed + epoch)
-        for i, raw in enumerate(self._pipeline):
+        return self._pipeline
+
+    def epoch(self, epoch: int, seed: int = 0, skip: int = 0
+              ) -> Iterator[dict]:
+        """One pass over the data for the given epoch index. ``skip``
+        drops the first N batches (resume); determinism holds because the
+        augment RNG is derived per (seed, epoch, batch index), not drawn
+        sequentially."""
+        for i, raw in enumerate(self._epoch_pipeline(epoch, seed)):
             if i < skip:
                 continue
             images, labels = self._decode(raw)
             base = augment_base(seed, epoch, i)
             yield {"images": self._augment_normalize(images, base,
                                                      self.augment),
-                   "labels": labels.astype(np.int32)}
+                   "labels": labels}
 
     def batches(self, seed: int = 0, start_batch: int = 0,
                 prefetch: int = 2) -> Iterator[dict]:
@@ -356,7 +413,14 @@ class ImageNetSource:
         ``start_batch`` = global batch index to resume from (checkpoint
         restarts must not replay already-seen batches). ``prefetch``
         decode+augment batches ahead on a worker thread so host
-        preprocessing overlaps device compute (0 = synchronous)."""
+        preprocessing overlaps device compute (0 = synchronous). With
+        ``workers > 0`` the decode+augment stage instead fans out over
+        that many spawned processes through a shared-memory ring
+        (data/mp_augment.py) — same batches, byte-identical."""
+        if self.workers > 0:
+            yield from self._mp_batches(seed, start_batch)
+            return
+
         def gen():
             epoch = start_batch // self.num_batches
             skip = start_batch % self.num_batches
@@ -377,12 +441,53 @@ class ImageNetSource:
         self._prefetcher = _Prefetcher(gen(), depth=prefetch)
         yield from self._prefetcher
 
+    def _mp_batches(self, seed: int, start_batch: int) -> Iterator[dict]:
+        """The multi-process augment stage: this process only READS raw
+        record batches (the shuffle order must come from the one shared
+        pipeline) and memcpys them into the shared-memory ring; spawned
+        workers decode+augment each slab in place; batches come back in
+        submit order. Determinism: identical to the single-thread path
+        because the augment RNG is a pure function of
+        (seed, epoch, batch index) — pinned by tests."""
+        from .mp_augment import AugmentPool
+        if self._mp_pool is not None:
+            self._mp_pool.close()
+        pool = AugmentPool(
+            workers=self.workers,
+            batch_records=self.batch_size,
+            record_bytes=int(self.meta["record_bytes"]),
+            image_size=self.image_size,
+            output=self.output,
+            image_dtype=self.image_dtype,
+            pad_px=self.pad_px,
+            augment=self.augment,
+            slots=self._ring_slots)
+        self._mp_pool = pool
+
+        def feed():
+            epoch = start_batch // self.num_batches
+            skip = start_batch % self.num_batches
+            while True:
+                for i, raw in enumerate(self._epoch_pipeline(epoch, seed)):
+                    if i < skip:
+                        continue
+                    yield raw, augment_base(seed, epoch, i)
+                epoch += 1
+                skip = 0
+
+        pool.start(feed())
+        yield from pool
+
     def close(self) -> None:
-        # stop + join the prefetch producer FIRST: it may be inside the
-        # native pipeline's dp_next, which must not race dp_destroy
+        # stop + join the producers FIRST: the prefetch thread / the mp
+        # feeder may be inside the native pipeline's dp_next, which must
+        # not race dp_destroy
         if self._prefetcher is not None:
             self._prefetcher.stop()
             self._prefetcher = None
+        if self._mp_pool is not None:
+            self._mp_pool.close()
+            self._mp_pool = None
         if self._pipeline is not None:
             self._pipeline.close()
             self._pipeline = None

@@ -81,6 +81,12 @@ class PyRecordPipeline:
         self.order = epoch_order(self.total_records, seed)
         self._q: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
         self._stop = threading.Event()
+        # producer outcome tracked outside the queue (the queued EOF /
+        # exception can be lost to a stop-side drain): a consumer facing
+        # a dead thread must distinguish clean EOF from a mid-epoch death
+        # — and must never block forever on an empty queue
+        self._finished = False
+        self._error: "Exception | None" = None
         self._thread = threading.Thread(target=self._producer, daemon=True,
                                         name="py-datapipe")
         self._thread.start()
@@ -126,14 +132,27 @@ class PyRecordPipeline:
                         break
                     except queue.Full:
                         continue
+            self._finished = True
             if not self._stop.is_set():
                 self._q.put(None)  # EOF
         except Exception as e:  # noqa: BLE001 - surfaced to the consumer
+            self._error = e
             self._q.put(e)
 
     def __iter__(self) -> Iterator[np.ndarray]:
         while True:
-            item = self._q.get()
+            try:
+                item = self._q.get(timeout=0.5)
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    if self._error is not None:
+                        raise self._error   # queued copy lost to a drain
+                    if not self._finished and not self._stop.is_set():
+                        raise RuntimeError(
+                            "record pipeline producer died without an "
+                            "error or EOF — partial epoch")
+                    return
+                continue
             if item is None:
                 return
             if isinstance(item, Exception):

@@ -49,6 +49,13 @@ def _job_schema(specs_key: str, max_one: list[str]) -> dict:
         # enforces this schema names every one)
         "weightUpdate": {"type": "string",
                          "enum": ["replicated", "sharded"]},
+        # input-pipeline knobs (api/trainingjob.py InputSpec → the
+        # KFTPU_INPUT_WORKERS / KFTPU_DEVICE_PREFETCH worker env;
+        # tests/test_lint.py enforces the same full-path rule)
+        "input": {"type": "object", "properties": {
+            "workers": {"type": "integer", "minimum": 0},
+            "devicePrefetch": {"type": "integer", "minimum": 0},
+        }},
     }
     return {"type": "object",
             "properties": {"spec": {"type": "object", "properties": props}}}
@@ -169,6 +176,8 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
                    fused_blocks: bool = False,
                    fused_routing: dict | None = None,
                    weight_update: str = "",
+                   input_workers: int | None = None,
+                   device_prefetch: int | None = None,
                    backoff_limit: int = 3,
                    clean_pod_policy: str = "Running",
                    gang_scheduling: bool = True,
@@ -186,6 +195,10 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
     modeled (PERF.md round 5). ``weight_update="sharded"`` opts the gang
     into the ZeRO-2 cross-replica sharded weight update (spec.weightUpdate
     → KFTPU_WEIGHT_UPDATE; PERF.md "Weight-update sharding").
+    ``input_workers``/``device_prefetch`` render the overlapped input
+    pipeline's spec.input knobs (→ KFTPU_INPUT_WORKERS /
+    KFTPU_DEVICE_PREFETCH; docs/training.md "Input pipeline") — set
+    input_workers when the job reads record shards (spec.dataDir).
 
     The run-policy knobs mirror RunPolicy (api/trainingjob.py) one-to-one
     and render through it, so the example manifest can express the FULL
@@ -255,6 +268,12 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
     if weight_update:
         from ..api.trainingjob import validate_weight_update
         job["spec"]["weightUpdate"] = validate_weight_update(weight_update)
+    if input_workers is not None or device_prefetch is not None:
+        from ..api.trainingjob import InputSpec
+        ispec = InputSpec(workers=input_workers,
+                          device_prefetch=device_prefetch)
+        ispec.validate()
+        job["spec"]["input"] = ispec.to_dict()
     out.append(job)
     return out
 

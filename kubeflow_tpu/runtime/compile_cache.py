@@ -28,7 +28,10 @@ COMPILE_CACHE_ENV = "KFTPU_COMPILE_CACHE_DIR"
 # place this name is defined (operator + serving manifest import it)
 COMPILE_CACHE_SUBDIR = ".jax-compile-cache"
 
-# compiles cheaper than this recompile faster than a cache round-trip
+# compiles cheaper than this recompile faster than a cache round-trip.
+# KFTPU_COMPILE_CACHE_MIN_SECS overrides (tests pin 0: a warm process
+# compiles the tiny CPU models in under a second, which silently skipped
+# persistence and made cache assertions order-dependent)
 _MIN_COMPILE_SECS = 1.0
 
 
@@ -60,7 +63,22 @@ def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
             os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          _MIN_COMPILE_SECS)
+                          float(os.environ.get(
+                              "KFTPU_COMPILE_CACHE_MIN_SECS",
+                              _MIN_COMPILE_SECS)))
+        # jax builds its cache object at the FIRST compile of the
+        # process and latches (_cache_initialized): a process that
+        # compiled anything before this call — repeated in-process
+        # train() in katib studies and tests — latched a None cache and
+        # would silently never persist to the newly-set dir. Reset the
+        # latch so the config takes effect.
+        try:
+            from jax._src import compilation_cache as _cc
+            if getattr(_cc, "_cache_initialized", False) and \
+                    getattr(_cc, "_cache", None) is None:
+                _cc.reset_cache()
+        except Exception:  # noqa: BLE001 — private API, best effort
+            pass
         log.info("persistent compilation cache at %s", path)
         return path
     except Exception as e:  # noqa: BLE001 — cache is an optimization only

@@ -13,6 +13,7 @@ import json
 import logging
 import os
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -144,8 +145,16 @@ class MetricsLogger:
         line carries the window size so consumers can weight it."""
         now = time.perf_counter()
         total = now - (self._last_t if self._last_t is not None else now)
-        dt = total / max(n_steps, 1)
         self._last_t = now
+        return self.record_window(step, n_steps, total, metrics)
+
+    def record_window(self, step: int, n_steps: int, wall_s: float,
+                      metrics: Optional[dict] = None) -> StepStats:
+        """Record an already-timed window. The async-fetch worker loop
+        times windows itself (the metric fetch lags the window edge by a
+        window so it never drains the dispatch queue — AsyncWindowFetch),
+        so the wall time arrives here as data, not as "now minus last"."""
+        dt = wall_s / max(n_steps, 1)
         scalars = {}
         for k, v in (metrics or {}).items():
             try:
@@ -213,6 +222,51 @@ class MetricsLogger:
         if self._tb:
             self._tb.close()
             self._tb = None
+
+
+class AsyncWindowFetch:
+    """Window-edge metrics without draining the dispatch queue.
+
+    The worker loop used to fetch a window's metrics with blocking
+    ``float()`` at the window edge — a hard device→host barrier that
+    empties the dispatch queue; refilling it costs ~160 ms of round trips
+    on tunneled hosts (PERF.md "Worker loop vs bench loop"). Instead:
+    ``submit()`` starts the device→host copy (``copy_to_host_async``)
+    for a just-closed window and ``drain()`` resolves windows ``lag``
+    submissions later, by which point the copies have long completed and
+    the ``float()`` returns without stalling dispatch. Hard sync points
+    (checkpoint, eval, preemption, the final step) force the drain, so
+    reported metrics are always complete and ordered."""
+
+    def __init__(self, lag: int = 1):
+        self.lag = max(0, int(lag))
+        self._pending: deque = deque()
+
+    def submit(self, step: int, n_steps: int, wall_s: float,
+               metrics: dict) -> None:
+        """Queue a closed window; starts the async copy of every device
+        value (host scalars pass through untouched)."""
+        for v in metrics.values():
+            start = getattr(v, "copy_to_host_async", None)
+            if start is not None:
+                start()
+        self._pending.append((step, n_steps, wall_s, metrics))
+
+    def drain(self, force: bool = False
+              ) -> list[tuple[int, int, float, dict]]:
+        """Windows ready to report, oldest first, metric values resolved
+        to host floats. Without ``force`` the newest ``lag`` submissions
+        stay pending (their copies may still be in flight)."""
+        out = []
+        while self._pending and (force or len(self._pending) > self.lag):
+            step, n_steps, wall_s, metrics = self._pending.popleft()
+            out.append((step, n_steps, wall_s,
+                        {k: float(v) for k, v in metrics.items()}))
+        return out
+
+    @property
+    def pending(self) -> int:
+        return len(self._pending)
 
 
 @contextlib.contextmanager

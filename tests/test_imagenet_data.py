@@ -464,6 +464,10 @@ class TestCompileCache:
         import os
         cache = str(tmp_path / "jaxcache")
         monkeypatch.setenv("KFTPU_COMPILE_CACHE_DIR", cache)
+        # a warm process compiles this tiny model in <1s, under the
+        # persistence threshold — pin it to 0 so the assertion is not
+        # an ordering flake
+        monkeypatch.setenv("KFTPU_COMPILE_CACHE_MIN_SECS", "0")
         from kubeflow_tpu.runtime.worker import train
         train(workload="resnet18", steps=1, global_batch=8, sync_every=1,
               workload_kwargs={"image_size": 16, "num_classes": 4}, seed=0)

@@ -63,6 +63,77 @@ def test_step_engine_knobs_cover_the_operator_surface():
         assert spec_field in manifests_src, (knob.name, spec_field)
 
 
+def test_input_pipeline_knobs_are_plumbed_end_to_end():
+    """Every InputSpec field must be representable end-to-end, the same
+    rule as runPolicy/weightUpdate: parsed+serialized through the TPUJob
+    spec's ``input`` block (api/trainingjob.py), rendered into worker env
+    by the controller, consumed by the worker's train()/CLI surface, and
+    named in the manifests CRD schema + example builder — so a future
+    input knob can't silently exist in one layer only."""
+    import dataclasses
+
+    from kubeflow_tpu.api.trainingjob import InputSpec, TrainingJob
+    from kubeflow_tpu.manifests.training import tpu_job_simple
+    from kubeflow_tpu.runtime import worker
+
+    def src(*rel):
+        with open(os.path.join(REPO_ROOT, "kubeflow_tpu", *rel)) as f:
+            return f.read()
+
+    knobs = dataclasses.fields(InputSpec)
+    assert knobs, "expected the workers/device_prefetch knobs"
+    worker_src = src("runtime", "worker.py")
+    controller_src = src("controllers", "tpujob.py")
+    manifests_src = src("manifests", "training.py")
+    import inspect
+    train_params = inspect.signature(worker.train).parameters
+    for knob in knobs:
+        env = knob.metadata["env"]
+        # worker: a CLI flag and the env fallback
+        assert knob.metadata["cli"] in worker_src, knob.name
+        assert env in worker_src, knob.name
+        # controller: rendered into worker env (via InputSpec.to_env,
+        # whose env names are asserted against the worker above)
+        assert "input_spec.to_env" in controller_src
+        # manifests: the CRD schema names the spec field
+        assert f'"{knob.metadata["spec_field"]}"' in manifests_src, knob.name
+    # train() consumes both knobs by their canonical names
+    assert "input_workers" in train_params
+    assert "device_prefetch" in train_params
+
+    # spec wire round-trip: to_dict → from_manifest → identical spec,
+    # and the controller env render matches the declared names
+    ispec = InputSpec(workers=3, device_prefetch=5)
+    manifest = {
+        "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": "t", "namespace": "ns"},
+        "spec": {"replicaSpecs": {"TPU": {
+            "tpuTopology": "v5e-8",
+            "template": {"spec": {"containers": [{"name": "c"}]}}}},
+            "input": ispec.to_dict()},
+    }
+    job = TrainingJob.from_manifest(manifest)
+    assert job.input_spec == ispec
+    assert job.to_manifest()["spec"]["input"] == ispec.to_dict()
+    assert ispec.to_env() == {"KFTPU_INPUT_WORKERS": "3",
+                              "KFTPU_DEVICE_PREFETCH": "5"}
+
+    # admission rejects garbage (a typo'd knob must fail at apply)
+    import pytest
+    with pytest.raises(ValueError, match="input"):
+        InputSpec.from_dict({"workers": -1})
+    with pytest.raises(ValueError, match="unknown"):
+        InputSpec.from_dict({"worker": 2})
+    with pytest.raises(ValueError, match="mapping"):
+        InputSpec.from_dict([4, 2])   # YAML list typo
+
+    # example builder renders the block end to end
+    ex = next(o for o in tpu_job_simple(input_workers=3, device_prefetch=5)
+              if o["kind"] == "TPUJob")
+    assert ex["spec"]["input"] == {"workers": 3, "devicePrefetch": 5}
+    assert TrainingJob.from_manifest(ex).input_spec == ispec
+
+
 def test_run_policy_fields_are_plumbed_end_to_end():
     """Every RunPolicy field must be plumbed spec → controller →
     manifests: round-trip through the TPUJob spec wire format

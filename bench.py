@@ -1036,6 +1036,96 @@ def bench_chaos(t_start: float | None = None) -> dict:
     }
 
 
+def bench_sched(t_start: float | None = None) -> dict:
+    """Gang-scheduler A/B on a seeded contended cluster
+    (scheduler/sim.py drives the REAL plan()/inventory code): FIFO vs
+    priority+backfill vs priority+backfill+preemption over the same
+    seeded workloads, reporting makespan, chip utilization, and
+    queue-wait percentiles — plus the checkpoint-resume parity soak
+    (scheduler/soak.py): a preemptible job reclaimed mid-run must finish
+    with params identical to an uncontended run of the same seed.
+
+    Env knobs (the sched_bench_smoke CI entry shrinks the geometry):
+    KFTPU_BENCH_SCHED_SEEDS / _JOBS / _POOLS / _SOAK (0 skips the
+    real-training soak)."""
+    import os
+    import shutil
+    import tempfile
+
+    from kubeflow_tpu.scheduler.sim import compare_policies
+
+    t_start = time.perf_counter() if t_start is None else t_start
+    seeds = list(range(_env_int("KFTPU_BENCH_SCHED_SEEDS", 5)))
+    n_jobs = _env_int("KFTPU_BENCH_SCHED_JOBS", 24)
+    pools = tuple((os.environ.get("KFTPU_BENCH_SCHED_POOLS") or
+                   "v5e-32,v5e-16").split(","))
+    t0 = time.perf_counter()
+    table = compare_policies(seeds, n_jobs=n_jobs, pools=pools)
+    sim_s = time.perf_counter() - t0
+    fifo, pre = table["fifo"], table["preempt"]
+    dominates = (pre["chip_utilization"] > fifo["chip_utilization"]
+                 and pre["queue_wait_p50"] < fifo["queue_wait_p50"])
+
+    parity: dict = {"skipped": True}
+    if _env_int("KFTPU_BENCH_SCHED_SOAK", 1):
+        import jax
+        import numpy as np
+
+        from kubeflow_tpu.cluster.chaos import final_params
+        from kubeflow_tpu.scheduler.soak import PreemptionSoak
+        tmp = tempfile.mkdtemp(prefix="kftpu-sched-soak-")
+        try:
+            t0 = time.perf_counter()
+            soak = PreemptionSoak(workdir=tmp)
+            report = soak.run()
+            max_delta = float("nan")
+            if report["outcome"] == "succeeded":
+                preempted = final_params(report["checkpoint_dir"])
+                clean = soak.uncontended_params()
+                max_delta = max(jax.tree.leaves(jax.tree.map(
+                    lambda a, b: float(np.max(np.abs(
+                        np.asarray(a) - np.asarray(b)))),
+                    preempted, clean)), default=0.0)
+            parity = {
+                "outcome": report["outcome"],
+                "victim_preempted_count":
+                    report.get("victim_preempted_count"),
+                "victim_resume_step": report.get("victim_resume_step"),
+                "final_params_max_abs_delta_vs_uncontended": max_delta,
+                "params_parity_ok": bool(
+                    report["outcome"] == "succeeded"
+                    and max_delta <= 1e-5),
+                "soak_wall_s": round(time.perf_counter() - t0, 1),
+            }
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    # headline: utilization gained by the full policy over FIFO (>1 =
+    # the scheduler pays for itself before counting the wait-time win)
+    util_ratio = (pre["chip_utilization"] / fifo["chip_utilization"]
+                  if fifo["chip_utilization"] else 1.0)
+    return {
+        "metric": "gang_scheduler_contended_sim",
+        "value": round(util_ratio, 3),
+        "unit": "preempt_vs_fifo_chip_utilization",
+        "vs_baseline": None,
+        "mfu": None,
+        "extras": {
+            "seeds": len(seeds),
+            "jobs_per_seed": n_jobs,
+            "pools": list(pools),
+            "policies": table,
+            "dominates_fifo": dominates,
+            "wait_p50_fifo_over_preempt": round(
+                fifo["queue_wait_p50"] / pre["queue_wait_p50"], 2)
+            if pre["queue_wait_p50"] else None,
+            "sim_wall_s": round(sim_s, 1),
+            "parity": parity,
+        },
+        "_flops_per_chip": 0.0,
+    }
+
+
 def _run_sub_bench(mode: str, budget_s: float) -> dict:
     """Run ``bench.py --mode <mode>`` as a subprocess with a hard
     wall-clock budget and return its JSON row. The child inherits the
@@ -1063,7 +1153,7 @@ def main(argv=None) -> int:
     p.add_argument("--mode", default="all",
                    choices=["all", "resnet", "resnet-fused", "lm",
                             "lm-long", "serving", "fused-blocks",
-                            "weight-update", "chaos", "input"])
+                            "weight-update", "chaos", "input", "sched"])
     p.add_argument("--routing-out",
                    default="bench-matrix/fused_routing_measured.json",
                    help="where --mode fused-blocks writes the measured "
@@ -1113,6 +1203,8 @@ def main(argv=None) -> int:
         row = bench_chaos(t_start=t_start)
     elif args.mode == "input":
         row = bench_input(t_start=t_start)
+    elif args.mode == "sched":
+        row = bench_sched(t_start=t_start)
     else:
         row = bench_resnet(fused=False, t_start=t_start)
 
@@ -1177,12 +1269,14 @@ def main(argv=None) -> int:
                       "fused-blocks": lambda: bench_fused_blocks(
                           routing_out=args.routing_out),
                       "weight-update": bench_weight_update,
-                      "input": bench_input}
+                      "input": bench_input,
+                      "sched": bench_sched}
         for key, mode in (("fused", "resnet-fused"), ("lm", "lm"),
                           ("lm_long", "lm-long"),
                           ("serving", "serving"),
                           ("weight_update", "weight-update"),
                           ("input", "input"),
+                          ("sched", "sched"),
                           ("fused_blocks", "fused-blocks")):
             if mode == "fused-blocks" and not on_tpu:
                 # per-block attribution is the most expensive extra (10
@@ -1207,7 +1301,8 @@ def main(argv=None) -> int:
                     # is timed sleep, not compute
                     sub = in_process[mode]() if on_tpu else \
                         _run_sub_bench(mode, budget_s=420.0 if
-                                       mode == "input" else 240.0)
+                                       mode in ("input", "sched")
+                                       else 240.0)
                     row["extras"][key] = {
                         "metric": sub["metric"], "value": sub["value"],
                         "unit": sub["unit"], "mfu": sub["mfu"],
@@ -1218,7 +1313,8 @@ def main(argv=None) -> int:
                             "routing_table_written", "stages_img_s",
                             "serial_img_s", "overlapped_img_s",
                             "simulated_step_ms", "input_workers",
-                            "input_only_speedup", "error")
+                            "input_only_speedup", "policies",
+                            "dominates_fifo", "parity", "error")
                            if k in sub["extras"]},
                     }
                 except Exception as e:  # noqa: BLE001 — artifact lands

@@ -14,6 +14,10 @@ EXAMPLES = [
     ("tpu-job-simple", "tpu-job-fused.yaml",
      {"name": "tpu-job-fused", "topology": "v5e-32",
       "fused_blocks": True}),
+    ("tpu-job-simple", "tpu-job-queued.yaml",
+     {"name": "tpu-job-queued", "topology": "v5e-8",
+      "queue": "research", "priority": 1, "preemptible": True}),
+    ("tpu-scheduler", "tpu-scheduler.yaml", {}),
     ("tf-job-simple", "tf-job-simple.yaml", {}),
     ("tpu-serving-simple", "tpu-serving-simple.yaml", {}),
     ("katib-studyjob-example", "katib-studyjob-example.yaml", {}),

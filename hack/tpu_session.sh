@@ -31,6 +31,11 @@ else
 fi
 mkdir -p "$RESULTS"
 STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+# mtime anchor for artifact freshness checks: the matrix writes
+# fixed-name CSVs, so "exists" can be satisfied by a PRIOR session's
+# committed files — only files newer than this session count
+SESSION_START_MARK="$RESULTS/.session-start-$STAMP"
+touch "$SESSION_START_MARK"
 log() { echo "[tpu-session $(date -u +%T)] $*"; }
 
 MATRIX_DIR="bench-matrix"
@@ -49,7 +54,18 @@ if ! timeout -k 60 300 python -c "import jax; jax.devices()" \
 fi
 log "tunnel UP"
 
-run_step() {  # name, budget_s, cmd...
+# Per-step failure ledger: a session whose steps FAILED/timed out must
+# NOT exit 0 — the auto-launcher (.tpu_probe.sh) gates .session_done on
+# our exit code, and a half-failed session that retires the launcher
+# silently forfeits every remaining tunnel window (ADVICE.md round 5).
+FAILED_STEPS=""
+
+run_step() {  # [--no-json] name, budget_s, cmd...
+  # --no-json: steps like the kubebench matrix write CSVs, not a bench
+  # JSON row — success for them is exit 0, and the ledger must not
+  # report a healthy run as "(no-artifact)"
+  local expect_json=1
+  if [ "$1" = "--no-json" ]; then expect_json=0; shift; fi
   local name="$1" budget="$2"; shift 2
   log "step $name (budget ${budget}s)"
   # -k: a worker stuck in native XLA code defers SIGTERM indefinitely
@@ -67,10 +83,30 @@ run_step() {  # name, budget_s, cmd...
       log "step $name fell back to CPU (tunnel dropped mid-session) — aborting"
       exit 2
     fi
-    log "step $name OK: $(cut -c1-120 "$RESULTS/$name-$STAMP.json")"
+    if [ "$expect_json" -eq 0 ]; then
+      log "step $name OK (CSV/log artifacts)"
+    elif [ ! -s "$RESULTS/$name-$STAMP.json" ]; then
+      # exit 0 with no JSON row is still a failed measurement
+      FAILED_STEPS="$FAILED_STEPS $name(no-artifact)"
+      log "step $name exited 0 but produced no JSON artifact"
+    else
+      log "step $name OK: $(cut -c1-120 "$RESULTS/$name-$STAMP.json")"
+    fi
   else
+    FAILED_STEPS="$FAILED_STEPS $name"
     log "step $name FAILED/timeout (see $RESULTS/$name-$STAMP.err)"
   fi
+}
+
+# key_artifact name [fallback...]: true when any named step produced a
+# non-empty JSON row this session — kill-switch retries count (a
+# measured einsum LM line still answers the MFU question)
+key_artifact() {
+  local name
+  for name in "$@"; do
+    [ -s "$RESULTS/$name-$STAMP.json" ] && return 0
+  done
+  return 1
 }
 
 run_step resnet   900 python bench.py --mode resnet
@@ -112,10 +148,40 @@ KFTPU_COMPILE_CACHE_DIR="$CACHE" run_step cache-warm 900 \
   python bench.py --mode resnet
 
 # several training configs + first-compile costs: needs the largest budget
-run_step matrix 2700 python -m kubeflow_tpu.workflows.kubebench matrix \
-  --out-dir "$MATRIX_DIR" --steps 40 --global-batch 128
+run_step --no-json matrix 2700 python -m kubeflow_tpu.workflows.kubebench \
+  matrix --out-dir "$MATRIX_DIR" --steps 40 --global-batch 128
 
-log "session done; artifacts in $RESULTS/ and bench-matrix/"
+# the matrix writes CSVs, not a JSON row: gate on CSVs written by THIS
+# session (stale committed bench-matrix/ files must not vouch for a
+# failed/timed-out matrix step)
+MATRIX_OK=0
+if find "$MATRIX_DIR" -name '*.csv' -newer "$SESSION_START_MARK" \
+    2>/dev/null | grep -q .; then
+  MATRIX_OK=1
+fi
+
+# Session verdict: exit 0 ONLY when every key measurement landed (with
+# its kill-switch fallback counting), so the launcher's
+# `rc==0 -> .session_done` gate retires the session on evidence, not on
+# the script merely reaching its last line.
+SESSION_RC=0
+MISSING=""
+key_artifact resnet || MISSING="$MISSING resnet"
+key_artifact fused fused-nospatial || MISSING="$MISSING fused"
+key_artifact lm lm-einsum || MISSING="$MISSING lm"
+key_artifact lm-long || MISSING="$MISSING lm-long"
+key_artifact serving || MISSING="$MISSING serving"
+[ "$MATRIX_OK" -eq 1 ] || MISSING="$MISSING matrix"
+if [ -n "$MISSING" ]; then
+  SESSION_RC=3
+  log "key artifacts MISSING:$MISSING"
+fi
+if [ -n "$FAILED_STEPS" ]; then
+  log "steps that failed/timed out:$FAILED_STEPS"
+  # failed OPTIONAL steps (cache A/B, per-block attribution) don't block
+  # retirement by themselves, but a failed KEY step already set rc above
+fi
+log "session done (rc=$SESSION_RC); artifacts in $RESULTS/ and bench-matrix/"
 
 # land the evidence: a session can finish minutes before the round ends,
 # so the artifacts must not sit uncommitted in the working tree
@@ -130,3 +196,5 @@ $RESULTS/session.log for the step-by-step record.
 No-Verification-Needed: measurement artifacts only" 2>/dev/null \
     && log "artifacts committed" || log "nothing new to commit"
 fi
+
+exit "$SESSION_RC"

@@ -116,6 +116,104 @@ class InputSpec:
         spec.validate()
         return spec
 
+@dataclass
+class SchedulingPolicy:
+    """Gang-scheduling knobs (``spec.schedulingPolicy``): how the slice
+    scheduler (kubeflow_tpu/scheduler/) queues, places, and — when
+    ``preemptible`` — reclaims this job's slices. A job that carries the
+    block is SCHEDULER-MANAGED: the operator creates no pods until the
+    scheduler writes the slice binding annotation (the job sits in a
+    visible ``Queued`` condition instead of half-creating a gang). A job
+    without the block keeps the legacy admission==placement path.
+    Defined HERE, jax-free, like InputSpec: admission and the scheduler
+    process must not import the runtime."""
+
+    # scheduler queue this job submits to ("" = the default queue);
+    # quotas are enforced per (queue, namespace) — scheduler/queue.py
+    queue: str = ""
+    # higher binds first; ties break by submission order (FIFO)
+    priority: int = 0
+    # a preemptible gang may be reclaimed for a higher-priority job via
+    # the graceful path (SIGTERM → forced checkpoint → exit 75) and is
+    # RE-QUEUED by the scheduler, not failed
+    preemptible: bool = False
+
+    ENV_QUEUE = "KFTPU_SCHED_QUEUE"
+    ENV_PRIORITY = "KFTPU_SCHED_PRIORITY"
+    ENV_PREEMPTIBLE = "KFTPU_SCHED_PREEMPTIBLE"
+
+    def validate(self) -> None:
+        if not isinstance(self.queue, str):
+            raise ValueError(
+                f"schedulingPolicy.queue must be a string, got "
+                f"{self.queue!r}")
+        if not isinstance(self.priority, int) or \
+                isinstance(self.priority, bool):
+            raise ValueError(
+                f"schedulingPolicy.priority must be an integer, got "
+                f"{self.priority!r}")
+        if not isinstance(self.preemptible, bool):
+            raise ValueError(
+                f"schedulingPolicy.preemptible must be a boolean, got "
+                f"{self.preemptible!r}")
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {"priority": self.priority,
+                             "preemptible": self.preemptible}
+        if self.queue:
+            d["queue"] = self.queue
+        return d
+
+    def to_env(self) -> dict[str, str]:
+        """Rendered into every worker pod: informational for the queue
+        name/priority, behavioral for preemptible (the worker's SIGTERM
+        handler knows a reclaim is a requeue, not a failure)."""
+        return {
+            self.ENV_QUEUE: self.queue or DEFAULT_QUEUE,
+            self.ENV_PRIORITY: str(self.priority),
+            self.ENV_PREEMPTIBLE: "1" if self.preemptible else "0",
+        }
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> Optional["SchedulingPolicy"]:
+        """None (absent block) = NOT scheduler-managed — the distinction
+        the operator gates pod creation on, so it must survive the
+        parse/serialize round trip exactly."""
+        if d is None:
+            return None
+        if not isinstance(d, dict):
+            raise ValueError(
+                f"spec.schedulingPolicy must be a mapping, got "
+                f"{type(d).__name__}: {d!r}")
+        known = {"queue", "priority", "preemptible"}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown schedulingPolicy fields {sorted(unknown)}; "
+                f"valid: {sorted(known)}")
+        policy = cls(queue=d.get("queue", "") or "",
+                     priority=d.get("priority", 0),
+                     preemptible=d.get("preemptible", False))
+        policy.validate()
+        return policy
+
+
+# the queue a schedulingPolicy without an explicit queue submits to
+DEFAULT_QUEUE = "default"
+
+# Slice-binding contract between the gang scheduler and the operator
+# (scheduler/core.py writes, controllers/tpujob.py consumes): the binding
+# annotation carries the JSON placement (per-slice pool + ICI-grid rect,
+# scheduler/inventory.py Placement wire format). A scheduler-managed job
+# WITHOUT the annotation is queued — the operator creates no pods for it.
+BINDING_ANNOTATION = "scheduling.kubeflow.org/binding"
+# scheduler-visible state for dashboards/kubectl: queued | bound | preempted
+SCHED_STATE_ANNOTATION = "scheduling.kubeflow.org/state"
+# human-readable reason a job is still queued (quota, capacity, ...)
+SCHED_REASON_ANNOTATION = "scheduling.kubeflow.org/reason"
+# times this job's gang was preempted (reclaimed, not failed)
+PREEMPTED_COUNT_ANNOTATION = "scheduling.kubeflow.org/preempted-count"
+
 # apiVersion per kind (reference CRD groups/versions)
 API_VERSIONS = {
     "TPUJob": TPU_API_VERSION,
@@ -156,6 +254,10 @@ REPLICA_TYPES: dict[str, tuple[str, ...]] = {
 _MAX_ONE = {"Chief", "Master", "Coordinator", "Launcher", "Scheduler"}
 
 # Condition types, mirroring tf-operator's JobCondition vocabulary.
+# Queued is the TPU-native addition: a scheduler-managed job admitted but
+# not yet bound to slices (visible in kubectl/dashboard instead of a
+# half-created gang).
+COND_QUEUED = "Queued"
 COND_CREATED = "Created"
 COND_RUNNING = "Running"
 COND_RESTARTING = "Restarting"
@@ -359,6 +461,10 @@ class TrainingJob:
     # prefetch depth — the overlapped input pipeline (docs/training.md
     # "Input pipeline")
     input_spec: InputSpec = field(default_factory=InputSpec)
+    # gang-scheduling knobs (spec.schedulingPolicy → the slice
+    # scheduler's queue/priority/preemptible; None = not
+    # scheduler-managed, the legacy immediate-create path)
+    scheduling_policy: Optional[SchedulingPolicy] = None
     # optimizer-update layout across data-parallel replicas (rendered as
     # KFTPU_WEIGHT_UPDATE; WEIGHT_UPDATE_MODES above):
     # "sharded" = ZeRO-2 cross-replica sharded weight update — reduce-
@@ -426,6 +532,8 @@ class TrainingJob:
             tensorboard_dir=spec.get("tensorboardDir", "") or "",
             compile_cache_dir=spec.get("compileCacheDir", "") or "",
             input_spec=InputSpec.from_dict(spec.get("input")),
+            scheduling_policy=SchedulingPolicy.from_dict(
+                spec.get("schedulingPolicy")),
             weight_update=spec.get("weightUpdate", "") or "",
             raw=obj,
         )
@@ -463,6 +571,8 @@ class TrainingJob:
             # not at worker startup deep inside the gang
             validate_weight_update(self.weight_update)
         self.input_spec.validate()
+        if self.scheduling_policy is not None:
+            self.scheduling_policy.validate()
         vocab = REPLICA_TYPES[self.kind]
         if not self.replica_specs:
             raise ValueError(f"{self.kind} {self.name}: no replica specs")
@@ -529,6 +639,8 @@ class TrainingJob:
             out["spec"]["compileCacheDir"] = self.compile_cache_dir
         if self.input_spec.to_dict():
             out["spec"]["input"] = self.input_spec.to_dict()
+        if self.scheduling_policy is not None:
+            out["spec"]["schedulingPolicy"] = self.scheduling_policy.to_dict()
         if self.weight_update:
             out["spec"]["weightUpdate"] = self.weight_update
         if self.raw:

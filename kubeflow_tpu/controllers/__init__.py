@@ -21,6 +21,7 @@ def build_manager(client, vizier=None, vizier_url: Optional[str] = None):
     exposes an admission point (FakeCluster does; a real apiserver gets the
     webhook via manifests instead)."""
     from ..katib.studyjob import StudyJobReconciler
+    from ..scheduler.core import SliceScheduler
     from ..workflows.engine import WorkflowReconciler
     from ..workflows.kubebench import KubebenchJobReconciler
     from .admission import PodDefaultsWebhook
@@ -31,6 +32,10 @@ def build_manager(client, vizier=None, vizier_url: Optional[str] = None):
     from .tpujob import all_reconcilers
 
     mgr = Manager(client)
+    # the slice scheduler runs ahead of the operators: it binds
+    # scheduler-managed TPUJobs to slices; jobs without a
+    # schedulingPolicy bypass it entirely
+    mgr.add(SliceScheduler())
     for r in all_reconcilers():
         mgr.add(r)
     mgr.add(StatefulSetReconciler())

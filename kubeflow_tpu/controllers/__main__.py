@@ -41,9 +41,11 @@ def _register_defaults() -> None:
         CONTROLLER_FACTORIES[kind.lower()] = (
             lambda k=kind: TrainingJobReconciler(k))
     from ..pipelines.scheduled import ScheduledWorkflowReconciler
+    from ..scheduler.core import SliceScheduler
     from .application import ApplicationReconciler
 
     CONTROLLER_FACTORIES["application"] = ApplicationReconciler
+    CONTROLLER_FACTORIES["scheduler"] = SliceScheduler
     CONTROLLER_FACTORIES["notebook"] = NotebookReconciler
     CONTROLLER_FACTORIES["profile"] = ProfileReconciler
     CONTROLLER_FACTORIES["statefulset"] = StatefulSetReconciler

@@ -40,15 +40,19 @@ import time
 
 from ..api import k8s
 from ..api.topology import TopologyContract, render_contracts
-from ..api.trainingjob import (API_VERSIONS, COND_CREATED, COND_FAILED,
+from ..api.trainingjob import (API_VERSIONS,
+                               COND_CREATED, COND_FAILED, COND_QUEUED,
                                COND_RESTARTING, COND_RUNNING, COND_SUCCEEDED,
                                CLEAN_POD_ALL, CLEAN_POD_NONE,
                                CLEAN_POD_RUNNING, HEARTBEAT_ANNOTATION,
                                JOB_KINDS, POD_FAILED,
-                               POD_RUNNING, POD_SUCCEEDED, ReplicaSpec,
+                               POD_RUNNING, POD_SUCCEEDED,
+                               PREEMPTED_COUNT_ANNOTATION,
+                               SCHED_REASON_ANNOTATION, ReplicaSpec,
                                TrainingJob)
 from ..cluster.client import KubeClient, NotFoundError
 from ..cluster.fake import POD_GROUP_LABEL, TPU_RESOURCE
+from ..scheduler.inventory import POOL_LABEL, Placement, SliceRect
 from .runtime import Key, Reconciler, Result
 
 log = logging.getLogger(__name__)
@@ -110,6 +114,17 @@ class TrainingJobReconciler(Reconciler):
         if k8s.condition_true(manifest, COND_SUCCEEDED) or \
                 k8s.condition_true(manifest, COND_FAILED):
             return self._handle_finished(client, job, manifest)
+
+        # Scheduler-managed jobs (spec.schedulingPolicy present) create
+        # NOTHING until the slice scheduler writes the binding annotation:
+        # admission is no longer placement. Unbound jobs sit in a visible
+        # Queued condition; a binding REMOVED mid-run (preemption, or a
+        # reshape invalidating it) tears the gang down through the
+        # graceful path and re-queues — never a failure.
+        binding = self._slice_binding(job, manifest)
+        if job.scheduling_policy is not None and job.tpu_spec is not None \
+                and binding is None:
+            return self._handle_unbound(client, job, manifest)
 
         pods = client.list("v1", "Pod", namespace, selector=job.selector())
         by_name = {k8s.name_of(p): p for p in pods}
@@ -187,7 +202,7 @@ class TrainingJobReconciler(Reconciler):
                 return Result(requeue_after=wait)
 
         created = self._ensure_pods(client, job, manifest, by_name,
-                                    tpu_entries)
+                                    tpu_entries, binding=binding)
         if created:
             if tpu_names and shape_anno != shape:
                 manifest = client.patch(*k8s.key_of(manifest), {
@@ -195,6 +210,11 @@ class TrainingJobReconciler(Reconciler):
                                  {GANG_SHAPE_ANNOTATION: shape}}})
             self._set_condition(client, manifest, COND_CREATED, "True",
                                 "JobCreated", f"created {created} pods")
+            if binding is not None:
+                # the queue wait is over: the gang exists on its slices
+                self._set_condition(client, manifest, COND_QUEUED, "False",
+                                    "Bound",
+                                    "slice binding present; gang created")
             # the intentional-gap marker is consumed: the gang exists again
             if k8s.condition_true(manifest, COND_RESTARTING):
                 self._set_condition(client, manifest, COND_RESTARTING,
@@ -227,6 +247,57 @@ class TrainingJobReconciler(Reconciler):
                 max(1.0, job.run_policy.stall_timeout_seconds / 2))
         return Result(requeue_after=min(requeue_in)) if requeue_in \
             else Result()
+
+    # ---------------------------------------------------- slice scheduling
+
+    @staticmethod
+    def _slice_binding(job: TrainingJob,
+                       manifest: dict) -> Placement | None:
+        """The scheduler's placement for this job, or None when unbound.
+        A binding whose shape no longer matches the spec (resize under
+        it) reads as unbound: creating a gang on a stale placement would
+        double-book chips the scheduler has already re-planned. Parse +
+        shape check are the scheduler's own (scheduler/queue.py), so the
+        two sides of the annotation contract cannot drift."""
+        from ..scheduler.queue import binding_matches, binding_of
+        placement = binding_of(manifest)
+        if placement is None or not binding_matches(placement, job):
+            return None
+        return placement
+
+    def _handle_unbound(self, client: KubeClient, job: TrainingJob,
+                        manifest: dict) -> Result:
+        """A scheduler-managed job without a binding: tear down whatever
+        gang exists (preemption reclaim — the graceful delete path gives
+        workers SIGTERM → forced checkpoint → exit 75) and surface a
+        Queued condition. No backoff budget is burned: a preemption is a
+        requeue, not a failure."""
+        pods = client.list("v1", "Pod", job.namespace,
+                           selector=job.selector())
+        anns = k8s.annotations_of(manifest)
+        preempted = int(anns.get(PREEMPTED_COUNT_ANNOTATION, "0")) > 0
+        if pods:
+            for p in pods:
+                try:
+                    client.delete("v1", "Pod",
+                                  k8s.namespace_of(p, job.namespace),
+                                  k8s.name_of(p))
+                except NotFoundError:
+                    pass
+            if job.checkpoint_dir and not job.resume_from:
+                # same resume loop as a gang restart: the re-bound gang
+                # continues from the forced preemption checkpoint
+                client.patch(*k8s.key_of(manifest),
+                             {"spec": {"resumeFrom": job.checkpoint_dir}})
+            self._set_condition(client, manifest, COND_RUNNING, "False",
+                                "Preempted" if preempted else "Unbound",
+                                "gang torn down; awaiting re-bind")
+        reason = "Preempted" if preempted else "AwaitingBinding"
+        detail = anns.get(SCHED_REASON_ANNOTATION, "")
+        self._set_condition(
+            client, manifest, COND_QUEUED, "True", reason,
+            detail or "waiting for the slice scheduler to bind this gang")
+        return Result()
 
     # ------------------------------------------------------------- children
 
@@ -272,14 +343,20 @@ class TrainingJobReconciler(Reconciler):
 
     def _ensure_pods(self, client: KubeClient, job: TrainingJob,
                      manifest: dict, existing: dict[str, dict],
-                     tpu_entries: dict[str, list]) -> int:
+                     tpu_entries: dict[str, list],
+                     binding: Placement | None = None) -> int:
+        # slice_id -> assigned rect (the scheduler's placement order IS
+        # the slice order)
+        slice_rects = {i: r for i, r in
+                       enumerate(binding.slices)} if binding else {}
         created = 0
         for rtype, rs in job.replica_specs.items():
             if rs.is_tpu:
                 # all-or-nothing create: build every missing member first,
                 # then emit the whole set (never a partial gang)
                 gang_pods = [
-                    self._build_tpu_pod(job, manifest, rs, c, pname)
+                    self._build_tpu_pod(job, manifest, rs, c, pname,
+                                        rect=slice_rects.get(c.slice_id))
                     for pname, c in tpu_entries[rtype]
                     if pname not in existing]
                 for pod in gang_pods:
@@ -340,6 +417,12 @@ class TrainingJobReconciler(Reconciler):
             # spec.weightUpdate → the worker's ZeRO-2 weight-update knob
             # (runtime/worker.py reads it into TrainStepBuilder)
             env["KFTPU_WEIGHT_UPDATE"] = job.weight_update
+        if job.scheduling_policy is not None:
+            # spec.schedulingPolicy → KFTPU_SCHED_{QUEUE,PRIORITY,
+            # PREEMPTIBLE}: queue/priority are informational (logs,
+            # metrics labels); preemptible tells the SIGTERM handler a
+            # reclaim is a requeue, not a crash
+            env.update(job.scheduling_policy.to_env())
         # spec.input → the overlapped-input-pipeline knobs: augment
         # worker processes (KFTPU_INPUT_WORKERS) and device prefetch
         # depth (KFTPU_DEVICE_PREFETCH) — runtime/worker.py reads them
@@ -368,7 +451,8 @@ class TrainingJobReconciler(Reconciler):
                     cenv.append({"name": k, "value": v})
 
     def _build_tpu_pod(self, job: TrainingJob, manifest: dict, rs: ReplicaSpec,
-                       contract: TopologyContract, name: str) -> dict:
+                       contract: TopologyContract, name: str,
+                       rect: SliceRect | None = None) -> dict:
         pod = self._base_pod(job, manifest, rs, name, "TPU",
                              str(contract.process_id))
         spec = pod["spec"]
@@ -378,8 +462,19 @@ class TrainingJobReconciler(Reconciler):
         sel = spec.setdefault("nodeSelector", {})
         sel.setdefault("cloud.google.com/gke-tpu-accelerator",
                        f"tpu-{contract.slice_topology.generation.name}")
-        sel.setdefault("cloud.google.com/gke-tpu-topology",
-                       contract.slice_topology.name)
+        if rect is not None:
+            # slice-scheduler binding: pin to the ASSIGNED pool — the
+            # pool's topology may be larger than the job's (a v5e-8 gang
+            # carved out of a v5e-32 pool), so the pool label replaces
+            # the exact-topology pin, and the rect rides along as a pod
+            # annotation for operators/debuggers reading kubectl
+            sel.setdefault(POOL_LABEL, rect.pool)
+            pod["metadata"]["annotations"][
+                "scheduling.kubeflow.org/slice"] = json.dumps(
+                    rect.to_dict())
+        else:
+            sel.setdefault("cloud.google.com/gke-tpu-topology",
+                           contract.slice_topology.name)
         for c in spec["containers"]:
             res = c.setdefault("resources", {})
             res.setdefault("limits", {})[TPU_RESOURCE] = \

@@ -56,6 +56,15 @@ def _job_schema(specs_key: str, max_one: list[str]) -> dict:
             "workers": {"type": "integer", "minimum": 0},
             "devicePrefetch": {"type": "integer", "minimum": 0},
         }},
+        # gang-scheduling knobs (api/trainingjob.py SchedulingPolicy →
+        # the slice scheduler's queue/priority/preemptible; a job
+        # carrying this block waits in Queued until the scheduler binds
+        # it — tests/test_lint.py enforces the same full-path rule)
+        "schedulingPolicy": {"type": "object", "properties": {
+            "queue": {"type": "string"},
+            "priority": {"type": "integer"},
+            "preemptible": {"type": "boolean"},
+        }},
     }
     return {"type": "object",
             "properties": {"spec": {"type": "object", "properties": props}}}
@@ -155,6 +164,41 @@ def paddle_operator(namespace: str = "kubeflow") -> list[dict]:
                   schema=_job_schema("paddleReplicaSpecs", []))]
 
 
+@register("tpu-scheduler", "Gang-scheduling queue: the quota-aware slice "
+                           "scheduler binding TPUJobs to ICI sub-slices "
+                           "(the kube-batch/Volcano slot of the reference)")
+def tpu_scheduler(namespace: str = "kubeflow",
+                  backfill: bool = True,
+                  preemption: bool = True,
+                  queues: dict | None = None) -> list[dict]:
+    """``queues`` is the SchedulerConfig wire shape
+    (scheduler/queue.py), e.g. ``{"research": {"quotaChips":
+    {"team-a": 32, "*": 64}}}`` — per-queue, per-namespace bound-chip
+    quotas ("*" is the default for unlisted namespaces)."""
+    import json
+    sa = H.service_account("tpu-scheduler", namespace)
+    role = H.cluster_role("tpu-scheduler", [
+        {"apiGroups": ["tpu.kubeflow.org"],
+         "resources": ["tpujobs"], "verbs": ["get", "list", "watch",
+                                             "patch", "update"]},
+        {"apiGroups": [""],
+         "resources": ["nodes", "pods", "configmaps"],
+         "verbs": ["get", "list", "watch"]},
+    ])
+    binding = H.cluster_role_binding("tpu-scheduler", "tpu-scheduler",
+                                     "tpu-scheduler", namespace)
+    cm = H.config_map("tpu-scheduler-config", namespace, {
+        "config.json": json.dumps({
+            "backfill": backfill, "preemption": preemption,
+            "queues": queues or {}}, indent=1),
+    })
+    dep = H.deployment("tpu-scheduler", namespace,
+                       f"{IMG}/tpu-job-operator:{VERSION}",
+                       args=["--controllers=scheduler"],
+                       service_account="tpu-scheduler", port=8443)
+    return [sa, role, binding, cm, dep]
+
+
 @register("openmpi-controller", "Slice-sidecar config: lifecycle hooks for "
                                 "gang workers (components/openmpi-controller analog)")
 def openmpi_controller(namespace: str = "kubeflow") -> list[dict]:
@@ -185,7 +229,10 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
                    ttl_seconds_after_finished: int | None = None,
                    restart_backoff_seconds: float = 0.0,
                    restart_backoff_max_seconds: float = 300.0,
-                   stall_timeout_seconds: int | None = None) -> list[dict]:
+                   stall_timeout_seconds: int | None = None,
+                   queue: str | None = None,
+                   priority: int | None = None,
+                   preemptible: bool | None = None) -> list[dict]:
     """fused_blocks opts into the ghost-BN fused bottleneck kernels
     (docs/training.md --fused-blocks; per-block batch/spatial routing).
     ``fused_routing`` pins the per-geometry kernel routing to a
@@ -210,7 +257,17 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
     between gang restarts — restart-storm protection; spec
     restartBackoffSeconds/restartBackoffMaxSeconds), and
     ``stall_timeout_seconds`` (the hung-chief stall watchdog; spec
-    stallTimeoutSeconds)."""
+    stallTimeoutSeconds).
+
+    ``queue``/``priority``/``preemptible`` render spec.schedulingPolicy
+    (api/trainingjob.py SchedulingPolicy): set ANY of them — including
+    explicitly to a default value like ``priority=0`` — and the job
+    becomes scheduler-managed: it waits in ``Queued`` until the slice
+    scheduler (kubeflow_tpu/scheduler/) binds its gang, and a
+    ``preemptible`` gang may be reclaimed (checkpoint + requeue) for a
+    higher-priority job (docs/operations.md "Scheduling, queues, and
+    quotas"). Leave all three unset (None) for the legacy
+    immediate-create path."""
     command = ["python", "-m", "kubeflow_tpu.runtime.worker",
                "--workload", "resnet50",
                "--steps", str(steps),
@@ -274,6 +331,14 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
                           device_prefetch=device_prefetch)
         ispec.validate()
         job["spec"]["input"] = ispec.to_dict()
+    if queue is not None or priority is not None or \
+            preemptible is not None:
+        from ..api.trainingjob import SchedulingPolicy
+        policy = SchedulingPolicy(queue=queue or "",
+                                  priority=priority or 0,
+                                  preemptible=bool(preemptible))
+        policy.validate()
+        job["spec"]["schedulingPolicy"] = policy.to_dict()
     out.append(job)
     return out
 

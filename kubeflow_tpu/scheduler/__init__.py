@@ -1,0 +1,26 @@
+"""Gang-scheduling queue: quota-aware, topology-aware TPU slice scheduler.
+
+The subsystem between admission and pod creation (ISSUE 4): a slice
+inventory that bin-packs gangs onto contiguous ICI sub-slices
+(inventory.py), priority queues with namespace quotas (queue.py), the
+planning pass + k8s reconcile loop with backfill and checkpoint-aware
+preemption (core.py), the bench's seeded contended-cluster simulation
+(sim.py), and the real-training preemption-parity soak (soak.py).
+
+Everything here is jax-free at import time — the scheduler runs in the
+operator process (soak.py imports the runtime lazily inside run()).
+"""
+
+from .inventory import Placement, PoolState, SliceInventory, SliceRect
+from .queue import (JobRequest, QueueSpec, SchedulerConfig, binding_of,
+                    ordered, over_quota, request_of)
+from .core import (Plan, SliceScheduler, STATE_BOUND, STATE_PREEMPTED,
+                   STATE_QUEUED, plan)
+
+__all__ = [
+    "Placement", "PoolState", "SliceInventory", "SliceRect",
+    "JobRequest", "QueueSpec", "SchedulerConfig", "binding_of",
+    "ordered", "over_quota", "request_of",
+    "Plan", "SliceScheduler", "plan",
+    "STATE_BOUND", "STATE_PREEMPTED", "STATE_QUEUED",
+]

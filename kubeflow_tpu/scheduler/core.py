@@ -1,0 +1,322 @@
+"""The gang scheduler: one planning pass + the k8s reconcile loop.
+
+Sits between admission and pod creation. The TPUJob operator
+(controllers/tpujob.py) creates NO pods for a scheduler-managed job (one
+carrying ``spec.schedulingPolicy``) until this scheduler writes the slice
+binding annotation; until then the job shows a ``Queued`` condition. One
+planning pass:
+
+1. Build the slice inventory from the cluster's TPU node pools
+   (scheduler/inventory.py) and re-occupy it from every live binding.
+2. Order the queue (priority desc, submission order; scheduler/queue.py)
+   and walk it: quota-blocked jobs wait; placeable jobs bind (the
+   placement annotation); the FIRST unplaceable job becomes the blocked
+   head of line.
+3. The blocked head may PREEMPT: cheapest lower-priority preemptible
+   gangs (fewest chips first) are unbound until the head fits. A victim
+   is re-queued, not failed — the operator tears its gang down through
+   the graceful path (SIGTERM → forced checkpoint → exit 75) and the
+   job's own checkpoints make the eventual re-bind cheap.
+4. Behind a blocked head, BACKFILL continues — but never into the head's
+   reserved region (a geometry-only placement of the head's shape whose
+   cells only ever drain), so backfill can never starve the head.
+
+``plan()`` is pure (inventory in, actions out): the k8s loop
+(SliceScheduler) and the bench's contended-cluster simulation
+(scheduler/sim.py) run the identical policy code.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api import k8s
+from ..api.trainingjob import (BINDING_ANNOTATION, COND_FAILED,
+                               COND_SUCCEEDED, PREEMPTED_COUNT_ANNOTATION,
+                               SCHED_REASON_ANNOTATION,
+                               SCHED_STATE_ANNOTATION, TPU_API_VERSION,
+                               TrainingJob)
+from ..cluster.client import KubeClient, NotFoundError
+from ..controllers.runtime import Key, Reconciler, Result
+from .inventory import Placement, SliceInventory
+from .queue import (JobRequest, SchedulerConfig, binding_matches,
+                    binding_of, ordered, over_quota, request_of)
+
+log = logging.getLogger(__name__)
+
+# scheduler states surfaced via SCHED_STATE_ANNOTATION
+STATE_QUEUED = "queued"
+STATE_BOUND = "bound"
+STATE_PREEMPTED = "preempted"
+
+
+@dataclass
+class Plan:
+    """One pass's decisions, in apply order: victims release first (their
+    chips are what the binds below may be counting on)."""
+
+    binds: list = field(default_factory=list)       # (JobRequest, Placement)
+    preempts: list = field(default_factory=list)    # JobRequest (victims)
+    # key -> human reason a job stayed queued (quota, capacity, ...)
+    waits: dict = field(default_factory=dict)
+
+
+def _preempt_for(head: JobRequest, bound: list,
+                 inventory: SliceInventory) -> Optional[list]:
+    """Cheapest victim set that lets ``head`` fit, or None. Victims must
+    be lower priority AND preemptible; candidates are released
+    greedily cheapest-first (fewest chips, then lowest priority, then
+    newest — the least sunk work) until the head places, then PRUNED:
+    any victim whose chips turn out not to be needed (released early
+    from the wrong pool before the one that mattered) is re-bound —
+    nobody eats a SIGTERM for a placement they never blocked. The
+    inventory is mutated only when a sufficient set exists."""
+    # newest-first within equal (chips, priority): least sunk work lost.
+    # Two stable sorts because seq may be a (timestamp, uid) tuple —
+    # not negatable the way an int tiebreak would be.
+    candidates = sorted(
+        (r for r, _p in bound
+         if r.preemptible and r.priority < head.priority),
+        key=lambda r: r.seq, reverse=True)
+    candidates.sort(key=lambda r: (r.chips, r.priority))
+    if not candidates:
+        return None
+    placements = {r.key: p for r, p in bound}
+    victims: list[JobRequest] = []
+    snapshot = [[row[:] for row in p.grid]
+                for p in inventory.pools.values()]
+    fits = False
+    for victim in candidates:
+        inventory.release(victim.key)
+        victims.append(victim)
+        if inventory.place_gang(head.topology, head.num_slices) is not None:
+            fits = True
+            break
+    if not fits:
+        # insufficient even with every candidate gone: restore occupancy
+        for pool, grid in zip(inventory.pools.values(), snapshot):
+            pool.grid = [row[:] for row in grid]
+        return None
+    # prune most-expensive-first so the cheap victims stay the preferred
+    # cost when either would do
+    for victim in sorted(victims, key=lambda r: -r.chips):
+        inventory.bind(victim.key, placements[victim.key])
+        if inventory.place_gang(head.topology, head.num_slices) is not None:
+            victims.remove(victim)    # not actually in the way
+        else:
+            inventory.release(victim.key)
+    return victims
+
+
+def plan(queued: list[JobRequest], bound: list,
+         inventory: SliceInventory, config: SchedulerConfig) -> Plan:
+    """Pure planning over a pre-occupied inventory. ``bound`` is
+    [(JobRequest, Placement)] for every currently bound gang (their cells
+    already occupied in ``inventory``). Mutates the inventory to reflect
+    its own decisions (callers pass a throwaway rebuild)."""
+    out = Plan()
+    live_bound = list(bound)
+    reserved: set = set()
+    head_blocked = False
+    for req in ordered(queued, config):
+        if over_quota(req, live_bound, config):
+            out.waits[req.key] = (
+                f"quota: queue {req.queue!r} namespace {req.namespace!r} "
+                f"bound-chip quota would be exceeded")
+            continue
+        if head_blocked and not config.backfill:
+            out.waits[req.key] = "waiting: behind blocked head of line"
+            continue
+        placement = inventory.place_gang(req.topology, req.num_slices,
+                                         avoid=reserved or None)
+        if placement is not None:
+            inventory.bind(req.key, placement)
+            out.binds.append((req, placement))
+            live_bound.append((req, placement))
+            continue
+        if head_blocked:
+            out.waits[req.key] = "capacity: no contiguous slice free " \
+                                 "(backfill could not place clear of " \
+                                 "the head-of-line reservation)"
+            continue
+        # the blocked head of line: try preemption, else reserve
+        if config.preemption:
+            victims = _preempt_for(req, live_bound, inventory)
+            if victims is not None:
+                victim_keys = {v.key for v in victims}
+                live_bound = [(r, p) for r, p in live_bound
+                              if r.key not in victim_keys]
+                out.preempts.extend(victims)
+                placement = inventory.place_gang(req.topology,
+                                                 req.num_slices)
+                if placement is not None:
+                    inventory.bind(req.key, placement)
+                    out.binds.append((req, placement))
+                    live_bound.append((req, placement))
+                    continue
+        head_blocked = True
+        reserved = inventory.reserve_for(req.topology, req.num_slices)
+        out.waits[req.key] = (
+            "capacity: head of line, waiting for reserved slices to "
+            "drain" if reserved else
+            "capacity: request can never fit this cluster's pools")
+    return out
+
+
+class SliceScheduler(Reconciler):
+    """The reconcile-loop host for plan(): every TPUJob or Node event
+    triggers a full scheduling pass (level-triggered — the pass reads
+    desired state fresh, so per-key granularity would buy nothing)."""
+
+    # where the deployed scheduler reads its policy (the ConfigMap the
+    # tpu-scheduler manifest renders; manifests/training.py)
+    CONFIG_MAP = ("kubeflow", "tpu-scheduler-config")
+    CONFIG_KEY = "config.json"
+
+    def __init__(self, config: Optional[SchedulerConfig] = None):
+        # an explicitly passed config wins forever (tests, sim, embedded
+        # use); otherwise each pass reads the tpu-scheduler-config
+        # ConfigMap so deployed quota/backfill/preemption policy is
+        # actually LIVE, not a rendered artifact nothing consumes
+        self._explicit_config = config
+        self._cm_rv: Optional[str] = None
+        self._cm_config = SchedulerConfig()
+        self.primary = (TPU_API_VERSION, "TPUJob")
+        # Node events (pool added/drained) re-plan too; map_event routes
+        # them to a synthetic pass key since nodes carry no owner ref
+        self.owns = [("v1", "Node")]
+
+    @property
+    def config(self) -> SchedulerConfig:
+        return self._explicit_config or self._cm_config
+
+    def _refresh_config(self, client: KubeClient) -> None:
+        if self._explicit_config is not None:
+            return
+        cm = client.get_or_none("v1", "ConfigMap", *self.CONFIG_MAP)
+        if cm is None:
+            self._cm_rv, self._cm_config = None, SchedulerConfig()
+            return
+        rv = cm.get("metadata", {}).get("resourceVersion")
+        if rv is not None and rv == self._cm_rv:
+            return   # unchanged since last pass: keep the parsed config
+        try:
+            self._cm_config = SchedulerConfig.from_dict(json.loads(
+                (cm.get("data") or {}).get(self.CONFIG_KEY, "") or "{}"))
+        except (ValueError, TypeError) as e:
+            # a malformed ConfigMap must not take the scheduler down —
+            # fall back to defaults and keep binding
+            log.warning("scheduler: bad %s/%s %s (%s); using defaults",
+                        *self.CONFIG_MAP, self.CONFIG_KEY, e)
+            self._cm_config = SchedulerConfig()
+        self._cm_rv = rv
+
+    def map_event(self, client: KubeClient, obj: dict) -> list[Key]:
+        if obj.get("kind") == "Node":
+            return [("", "#cluster-pass")]
+        return []
+
+    # ------------------------------------------------------------- the pass
+
+    def reconcile(self, client: KubeClient, key: Key) -> Result:
+        del key  # every pass is cluster-wide
+        self._refresh_config(client)
+        inventory = SliceInventory.from_nodes(client.list("v1", "Node"))
+        queued: list[JobRequest] = []
+        bound: list = []
+        manifests: dict[str, dict] = {}
+        for manifest in client.list(*self.primary):
+            if k8s.condition_true(manifest, COND_SUCCEEDED) or \
+                    k8s.condition_true(manifest, COND_FAILED):
+                continue
+            try:
+                job = TrainingJob.from_manifest(manifest)
+            except ValueError as e:
+                log.warning("scheduler: skipping unparseable job: %s", e)
+                continue
+            req = request_of(job, manifest)
+            if req is None:
+                continue   # not scheduler-managed
+            manifests[req.key] = manifest
+            placement = binding_of(manifest)
+            ok = placement is not None \
+                and binding_matches(placement, job) \
+                and inventory.valid_binding(placement)
+            if ok:
+                try:
+                    inventory.bind(req.key, placement)
+                except ValueError as e:
+                    # overlapping bindings (scheduler-replica overlap
+                    # during a rollout, a hand-edited annotation): the
+                    # LATER job in list order loses its binding and
+                    # re-queues — one bad annotation must degrade to a
+                    # requeue, never crash every future pass
+                    log.warning("scheduler: conflicting binding for "
+                                "%s (%s); requeueing it", req.key, e)
+                    ok = False
+            if ok:
+                bound.append((req, placement))
+            else:
+                if placement is not None:
+                    # stale/conflicting binding (spec reshaped under
+                    # it, pool gone, cells double-booked): drop it so
+                    # the job re-queues cleanly
+                    self._patch_state(client, manifest, STATE_QUEUED,
+                                      "rebinding: binding no longer "
+                                      "matches spec/pools", binding=None)
+                queued.append(req)
+        decisions = plan(queued, bound, inventory, self.config)
+        for victim in decisions.preempts:
+            self._apply_preempt(client, manifests[victim.key])
+        for req, placement in decisions.binds:
+            self._patch_state(client, manifests[req.key], STATE_BOUND,
+                              "bound", binding=placement)
+        for req in queued:
+            if req.key in decisions.waits:
+                self._mark_queued(client, manifests[req.key],
+                                  decisions.waits[req.key])
+        return Result()
+
+    # -------------------------------------------------------------- patches
+
+    def _patch_state(self, client: KubeClient, manifest: dict, state: str,
+                     reason: str, binding: Optional[Placement],
+                     extra: Optional[dict] = None) -> None:
+        annotations: dict = {SCHED_STATE_ANNOTATION: state,
+                             SCHED_REASON_ANNOTATION: reason,
+                             **(extra or {})}
+        # kube null-delete semantics: a removed binding patches to None
+        annotations[BINDING_ANNOTATION] = (
+            json.dumps(binding.to_dict()) if binding is not None else None)
+        try:
+            client.patch(*k8s.key_of(manifest),
+                         {"metadata": {"annotations": annotations}})
+        except NotFoundError:
+            pass   # deleted mid-pass: the delete event re-plans anyway
+
+    def _mark_queued(self, client: KubeClient, manifest: dict,
+                     reason: str) -> None:
+        anns = k8s.annotations_of(manifest)
+        if anns.get(SCHED_STATE_ANNOTATION) in (STATE_QUEUED,
+                                                STATE_PREEMPTED) and \
+                anns.get(SCHED_REASON_ANNOTATION) == reason:
+            return  # idempotent: no write, no MODIFIED event, no loop
+        state = STATE_PREEMPTED \
+            if anns.get(SCHED_STATE_ANNOTATION) == STATE_PREEMPTED \
+            else STATE_QUEUED
+        self._patch_state(client, manifest, state, reason, binding=None)
+
+    def _apply_preempt(self, client: KubeClient, manifest: dict) -> None:
+        """Unbind a victim: the operator observes the missing binding and
+        tears the gang down through the graceful path, leaving the job
+        QUEUED with resumeFrom set — preemption is a requeue, never a
+        failure (no backoff budget burned)."""
+        count = int(k8s.annotations_of(manifest).get(
+            PREEMPTED_COUNT_ANNOTATION, "0")) + 1
+        self._patch_state(
+            client, manifest, STATE_PREEMPTED,
+            "preempted by a higher-priority job", binding=None,
+            extra={PREEMPTED_COUNT_ANNOTATION: str(count)})

@@ -1,0 +1,314 @@
+"""Slice inventory: the cluster's TPU chips as ICI-topology grids.
+
+The unit of placement is a CONTIGUOUS sub-slice: a gang's chips must form
+an axis-aligned rectangle of the pool's physical chip mesh, because XLA
+compiles collectives over the ICI torus — a fragmented allocation would
+route neighbor exchanges through chips the job does not own (Podracer's
+gang-allocated slices, arxiv 2104.06272). So the inventory models every
+TPU node pool as a 2D occupancy grid over its topology's ``ici_mesh``
+(api/topology.py is the single source of truth for what a topology name
+means) and bin-packs job gangs onto free rectangles.
+
+Placement scoring is fragmentation-first: among all feasible rectangles
+(both orientations, every pool) the inventory picks the one that leaves
+the LARGEST contiguous free rectangle behind — stranding chips in slivers
+no future gang can use is the failure mode that quietly halves a
+cluster's effective capacity. Ties break best-fit (tightest pool first)
+and then lexicographically, so placement is fully deterministic: the same
+request sequence always produces the same packing (tests pin this).
+
+Wire format: a gang's placement serializes to the JSON carried by the
+``scheduling.kubeflow.org/binding`` annotation (api/trainingjob.py
+BINDING_ANNOTATION) — one rect per slice::
+
+    {"topology": "v5e-8", "numSlices": 1, "chips": 8,
+     "slices": [{"pool": "pool-a", "x": 0, "y": 0, "h": 2, "w": 4}]}
+
+jax-free, like the rest of the control plane.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+from ..api import k8s
+from ..api.topology import SliceTopology, parse_topology
+
+# node labels the inventory reads (the ones GKE TPU node pools carry and
+# cluster/fake.py add_tpu_slice_nodes renders)
+POOL_LABEL = "kubeflow.org/pool"
+TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+
+
+@dataclass(frozen=True)
+class SliceRect:
+    """One slice's chips: an axis-aligned rectangle of a pool's grid."""
+
+    pool: str
+    x: int          # row of the top-left chip
+    y: int          # col of the top-left chip
+    h: int
+    w: int
+
+    @property
+    def chips(self) -> int:
+        return self.h * self.w
+
+    def cells(self) -> Iterable[tuple[str, int, int]]:
+        for i in range(self.x, self.x + self.h):
+            for j in range(self.y, self.y + self.w):
+                yield (self.pool, i, j)
+
+    def to_dict(self) -> dict:
+        return {"pool": self.pool, "x": self.x, "y": self.y,
+                "h": self.h, "w": self.w}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SliceRect":
+        return cls(pool=d["pool"], x=int(d["x"]), y=int(d["y"]),
+                   h=int(d["h"]), w=int(d["w"]))
+
+
+@dataclass
+class Placement:
+    """A whole gang's assignment: one rect per slice (slices may land in
+    different pools — DCN-level data parallelism does not need ICI
+    contiguity ACROSS slices, only within each)."""
+
+    topology: str
+    num_slices: int
+    slices: list[SliceRect]
+
+    @property
+    def chips(self) -> int:
+        return sum(r.chips for r in self.slices)
+
+    def to_dict(self) -> dict:
+        return {"topology": self.topology, "numSlices": self.num_slices,
+                "chips": self.chips,
+                "slices": [r.to_dict() for r in self.slices]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Placement":
+        return cls(topology=d["topology"],
+                   num_slices=int(d.get("numSlices", 1)),
+                   slices=[SliceRect.from_dict(r)
+                           for r in d.get("slices", [])])
+
+
+class PoolState:
+    """Occupancy grid over one node pool's physical chip mesh."""
+
+    def __init__(self, name: str, topology: SliceTopology):
+        self.name = name
+        self.topology = topology
+        rows, cols = (topology.ici_mesh + (1, 1))[:2]
+        self.rows, self.cols = rows, cols
+        # owner key per cell ("" = free); owners are "ns/name" job keys
+        self.grid: list[list[str]] = [[""] * cols for _ in range(rows)]
+
+    @property
+    def total_chips(self) -> int:
+        return self.rows * self.cols
+
+    @property
+    def free_chips(self) -> int:
+        return sum(1 for row in self.grid for c in row if not c)
+
+    def owners(self) -> set[str]:
+        return {c for row in self.grid for c in row if c}
+
+    def fits(self, x: int, y: int, h: int, w: int) -> bool:
+        if x + h > self.rows or y + w > self.cols:
+            return False
+        return all(not self.grid[i][j]
+                   for i in range(x, x + h) for j in range(y, y + w))
+
+    def occupy(self, owner: str, rect: SliceRect) -> None:
+        for _, i, j in rect.cells():
+            if self.grid[i][j]:
+                raise ValueError(
+                    f"pool {self.name} cell ({i},{j}) already owned by "
+                    f"{self.grid[i][j]!r} (binding drift — rebuild the "
+                    f"inventory from bindings before placing)")
+            self.grid[i][j] = owner
+
+    def release(self, owner: str) -> int:
+        freed = 0
+        for row in self.grid:
+            for j, c in enumerate(row):
+                if c == owner:
+                    row[j] = ""
+                    freed += 1
+        return freed
+
+    def max_free_rect(self) -> int:
+        """Area of the largest all-free rectangle (the classic
+        histogram-stack sweep) — the fragmentation score's numerator."""
+        best = 0
+        heights = [0] * self.cols
+        for row in self.grid:
+            for j, c in enumerate(row):
+                heights[j] = 0 if c else heights[j] + 1
+            stack: list[tuple[int, int]] = []   # (start col, height)
+            for j, hgt in enumerate(heights + [0]):
+                start = j
+                while stack and stack[-1][1] >= hgt:
+                    s, sh = stack.pop()
+                    best = max(best, sh * (j - s))
+                    start = s
+                stack.append((start, hgt))
+        return best
+
+
+class SliceInventory:
+    """All pools of the cluster; the scheduler's placement engine."""
+
+    def __init__(self, pools: Optional[list[PoolState]] = None):
+        self.pools: dict[str, PoolState] = {
+            p.name: p for p in sorted(pools or [], key=lambda p: p.name)}
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_nodes(cls, nodes: list[dict]) -> "SliceInventory":
+        """Group Ready nodes by pool label; each labeled pool is one
+        physical slice of its topology label's mesh (the shape
+        cluster/fake.py add_tpu_slice_nodes provisions and GKE TPU node
+        pools mirror). A pool missing hosts (cordoned/NotReady nodes)
+        contributes a proportionally truncated grid rather than
+        advertising chips no pod could bind to."""
+        by_pool: dict[str, tuple[SliceTopology, int]] = {}
+        for node in nodes:
+            labels = k8s.labels_of(node)
+            pool = labels.get(POOL_LABEL)
+            topo_name = labels.get(TOPOLOGY_LABEL)
+            if not pool or not topo_name:
+                continue
+            if not k8s.condition_true(node, "Ready"):
+                continue
+            try:
+                topo = parse_topology(topo_name)
+            except ValueError:
+                continue
+            prev = by_pool.get(pool)
+            by_pool[pool] = (topo, (prev[1] if prev else 0) + 1)
+        pools = []
+        for name, (topo, ready_hosts) in sorted(by_pool.items()):
+            state = PoolState(name, topo)
+            if ready_hosts < topo.num_hosts:
+                # truncate whole rows from the bottom: chips_per_host
+                # chips vanish per missing host, and a rectangular grid
+                # stays rectangular (rect packing needs that)
+                missing = topo.num_hosts - ready_hosts
+                drop_rows = -(-missing * topo.chips_per_host // state.cols)
+                state.rows = max(0, state.rows - drop_rows)
+                state.grid = state.grid[:state.rows]
+            if state.rows:
+                pools.append(state)
+        return cls(pools)
+
+    # -- accounting ---------------------------------------------------------
+
+    @property
+    def total_chips(self) -> int:
+        return sum(p.total_chips for p in self.pools.values())
+
+    @property
+    def free_chips(self) -> int:
+        return sum(p.free_chips for p in self.pools.values())
+
+    def bind(self, owner: str, placement: Placement) -> None:
+        for rect in placement.slices:
+            pool = self.pools.get(rect.pool)
+            if pool is None:
+                raise ValueError(f"binding names unknown pool {rect.pool!r}")
+            pool.occupy(owner, rect)
+
+    def release(self, owner: str) -> int:
+        return sum(p.release(owner) for p in self.pools.values())
+
+    def valid_binding(self, placement: Placement) -> bool:
+        """Whether a persisted binding still fits this inventory's
+        geometry (pool exists, rect in range) — a pool deleted or shrunk
+        under a bound job must requeue it, not crash the pass."""
+        for rect in placement.slices:
+            pool = self.pools.get(rect.pool)
+            if pool is None or rect.x + rect.h > pool.rows \
+                    or rect.y + rect.w > pool.cols:
+                return False
+        return True
+
+    # -- placement ----------------------------------------------------------
+
+    @staticmethod
+    def _orientations(topo: SliceTopology) -> list[tuple[int, int]]:
+        h, w = (topo.ici_mesh + (1, 1))[:2]
+        return [(h, w)] if h == w else [(h, w), (w, h)]
+
+    def _candidates(self, topo: SliceTopology,
+                    avoid: Optional[set] = None
+                    ) -> Iterable[tuple[tuple, SliceRect]]:
+        """Every feasible rect for ONE slice, with its score key (lower =
+        better). Score: maximize the pool's largest free rectangle AFTER
+        the cut (fragmentation), then best-fit (least free pool space),
+        then deterministic position order."""
+        for pname in sorted(self.pools):
+            pool = self.pools[pname]
+            for h, w in self._orientations(topo):
+                for x in range(pool.rows - h + 1):
+                    for y in range(pool.cols - w + 1):
+                        if not pool.fits(x, y, h, w):
+                            continue
+                        rect = SliceRect(pname, x, y, h, w)
+                        if avoid and not avoid.isdisjoint(rect.cells()):
+                            continue
+                        pool.occupy("\x00probe", rect)
+                        after = pool.max_free_rect()
+                        pool.release("\x00probe")
+                        key = (-after, pool.free_chips, pname, x, y, h)
+                        yield key, rect
+
+    def place_gang(self, topology: SliceTopology, num_slices: int,
+                   avoid: Optional[set] = None) -> Optional[Placement]:
+        """Greedy per-slice best-placement for a whole gang, or None when
+        any slice cannot be cut. ``avoid`` is a set of (pool, x, y) cells
+        placements must not touch (the head-of-line reservation —
+        scheduler/core.py). The inventory is left UNCHANGED; callers
+        bind() the returned placement explicitly."""
+        rects: list[SliceRect] = []
+        try:
+            for _ in range(num_slices):
+                best = min(self._candidates(topology, avoid),
+                           key=lambda kr: kr[0], default=None)
+                if best is None:
+                    return None
+                rect = best[1]
+                self.pools[rect.pool].occupy("\x00tentative", rect)
+                rects.append(rect)
+        finally:
+            for p in self.pools.values():
+                p.release("\x00tentative")
+        return Placement(topology=topology.name, num_slices=num_slices,
+                         slices=rects)
+
+    def reserve_for(self, topology: SliceTopology,
+                    num_slices: int) -> set:
+        """The head-of-line reservation: a geometry-only placement
+        (occupancy ignored — those chips will free when their gangs
+        finish) whose cells backfill jobs must keep clear, so the blocked
+        head's target region only ever DRAINS. Empty set when the request
+        can never fit this cluster (reserving would deadlock the queue
+        behind an impossible job)."""
+        ghost = SliceInventory(
+            [PoolState(p.name, p.topology) for p in self.pools.values()])
+        for name, pool in self.pools.items():
+            # mirror truncated grids (NotReady hosts) into the ghost
+            ghost.pools[name].rows = pool.rows
+            ghost.pools[name].grid = [[""] * pool.cols
+                                      for _ in range(pool.rows)]
+        placement = ghost.place_gang(topology, num_slices)
+        if placement is None:
+            return set()
+        return {cell for rect in placement.slices for cell in rect.cells()}

@@ -1,0 +1,189 @@
+"""Priority queues with namespace quotas for the slice scheduler.
+
+The reference platform delegated this to kube-batch/Volcano queues; here
+the queue model is first-class and small: every scheduler-managed job
+names a queue (``spec.schedulingPolicy.queue``, default "default"), jobs
+are ordered by (priority desc, submission order) — strict priority with
+FIFO ties — and each queue may cap the chips a NAMESPACE can hold bound
+at once (the multi-tenant fairness floor: one team's burst cannot occupy
+the whole cluster). Quota counts BOUND chips only: queued demand is free.
+
+jax-free; consumed by scheduler/core.py (the k8s reconcile loop) and
+scheduler/sim.py (the bench's contended-cluster simulation) so both run
+the identical ordering/quota code.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api import k8s
+from ..api.topology import SliceTopology
+from ..api.trainingjob import (BINDING_ANNOTATION, DEFAULT_QUEUE,
+                               TrainingJob)
+from .inventory import Placement
+
+
+@dataclass
+class QueueSpec:
+    """One queue's policy: per-namespace bound-chip quotas.
+
+    ``quota_chips`` maps namespace → max chips bound at once; the "*" key
+    is the default for namespaces not named; absent/None = unlimited.
+    """
+
+    name: str
+    quota_chips: dict = field(default_factory=dict)
+
+    def quota_for(self, namespace: str) -> Optional[int]:
+        q = self.quota_chips.get(namespace, self.quota_chips.get("*"))
+        return int(q) if q is not None else None
+
+
+@dataclass
+class SchedulerConfig:
+    """The scheduler's whole policy surface (rendered as the
+    tpu-scheduler ConfigMap by manifests/training.py; bench.py flips the
+    booleans to A/B FIFO vs backfill vs preemption)."""
+
+    queues: dict = field(default_factory=dict)   # name -> QueueSpec
+    # backfill: once the head-of-line job is blocked, later jobs may
+    # still bind — but never into the head's reserved region
+    backfill: bool = True
+    # preemption: a blocked higher-priority job may reclaim preemptible
+    # lower-priority gangs (cheapest victims first)
+    preemption: bool = True
+    # strict priority ordering; off = pure submission order (FIFO)
+    priority_order: bool = True
+
+    def queue(self, name: str) -> QueueSpec:
+        return self.queues.get(name) or QueueSpec(name)
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "SchedulerConfig":
+        d = dict(d or {})
+        queues = {}
+        for name, spec in (d.get("queues") or {}).items():
+            queues[name] = QueueSpec(
+                name=name, quota_chips=dict((spec or {}).get(
+                    "quotaChips", {})))
+        return cls(queues=queues,
+                   backfill=bool(d.get("backfill", True)),
+                   preemption=bool(d.get("preemption", True)),
+                   priority_order=bool(d.get("priorityOrder", True)))
+
+
+@dataclass
+class JobRequest:
+    """The scheduler's view of one gang: what it needs and where it sits
+    in the order. ``seq`` is the FIFO tiebreaker (submission order) —
+    any totally-ordered value; the k8s loop uses submission_seq()'s
+    (creationTimestamp, uid-tail) tuple, the sim uses plain ints."""
+
+    namespace: str
+    name: str
+    queue: str
+    priority: int
+    preemptible: bool
+    topology: SliceTopology
+    num_slices: int
+    seq: object
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    @property
+    def chips(self) -> int:
+        return self.topology.num_chips * self.num_slices
+
+
+_UID_NUM = re.compile(r"(\d+)$")
+
+
+def submission_seq(manifest: dict) -> tuple:
+    """Stable submission order for a job manifest:
+    (creationTimestamp, uid numeric tail). A real apiserver stamps
+    creationTimestamp (RFC3339 — lexicographic == chronological), which
+    carries the FIFO contract; UUID uids contribute nothing there.
+    FakeCluster sets no timestamp but mints "uid-N" monotonically, so
+    the numeric uid tail orders its jobs (parsed, not lexical —
+    "uid-10" must follow "uid-9"). Jobs tying on both fall back to the
+    caller's key tiebreaker."""
+    meta = manifest.get("metadata", {})
+    ts = str(meta.get("creationTimestamp", "") or "")
+    m = _UID_NUM.search(str(meta.get("uid", "")))
+    return (ts, int(m.group(1)) if m else 0)
+
+
+def request_of(job: TrainingJob, manifest: dict) -> Optional[JobRequest]:
+    """JobRequest for a scheduler-managed job with a TPU gang; None for
+    jobs the scheduler does not own (no schedulingPolicy, or no TPU
+    replicas — CPU-only legacy kinds keep the legacy path)."""
+    policy = job.scheduling_policy
+    tpu = job.tpu_spec
+    if policy is None or tpu is None or tpu.topology is None:
+        return None
+    return JobRequest(
+        namespace=job.namespace, name=job.name,
+        queue=policy.queue or DEFAULT_QUEUE,
+        priority=policy.priority, preemptible=policy.preemptible,
+        topology=tpu.topology, num_slices=tpu.num_slices,
+        seq=submission_seq(manifest))
+
+
+def binding_of(manifest: dict) -> Optional[Placement]:
+    """Parse the binding annotation; None when absent or malformed (a
+    corrupt binding reads as unbound — the scheduler re-places, which is
+    always safe: placement is idempotent against the same inventory).
+    THE one parse of the scheduling.kubeflow.org/binding wire contract:
+    the operator's gate (controllers/tpujob.py) and the scheduler's pass
+    (scheduler/core.py) both consume this + binding_matches, so the two
+    sides of the annotation cannot drift."""
+    import json
+    raw = k8s.annotations_of(manifest).get(BINDING_ANNOTATION)
+    if not raw:
+        return None
+    try:
+        return Placement.from_dict(json.loads(raw))
+    except (ValueError, KeyError, TypeError):
+        return None
+
+
+def binding_matches(placement: Placement, job: TrainingJob) -> bool:
+    """Whether a persisted binding still describes the job's CURRENT
+    gang shape — a spec resized/reshaped under its binding reads as
+    unbound on both sides (the operator must not create a gang on a
+    stale placement; the scheduler re-plans it)."""
+    tpu = job.tpu_spec
+    return (tpu is not None and tpu.topology is not None
+            and placement.topology == tpu.topology.name
+            and placement.num_slices == tpu.num_slices)
+
+
+def ordered(requests: list[JobRequest],
+            config: SchedulerConfig) -> list[JobRequest]:
+    """The scheduling order: strict priority then submission order (and
+    pure FIFO when priority_order is off — the bench's baseline arm).
+    One merged order across queues: queues scope QUOTA and dashboards,
+    not ordering — cross-queue starvation is governed by priority."""
+    if config.priority_order:
+        return sorted(requests, key=lambda r: (-r.priority, r.seq, r.key))
+    return sorted(requests, key=lambda r: (r.seq, r.key))
+
+
+def bound_chips(bound: list, queue: str, namespace: str) -> int:
+    """Chips currently bound for (queue, namespace) — the quota meter.
+    ``bound`` is [(JobRequest, Placement)]."""
+    return sum(p.chips for r, p in bound
+               if r.queue == queue and r.namespace == namespace)
+
+
+def over_quota(req: JobRequest, bound: list,
+               config: SchedulerConfig) -> bool:
+    quota = config.queue(req.queue).quota_for(req.namespace)
+    if quota is None:
+        return False
+    return bound_chips(bound, req.queue, req.namespace) + req.chips > quota

@@ -1,0 +1,207 @@
+"""Preemption soak: checkpoint-resume parity through a REAL preemption.
+
+The chaos-soak pattern (cluster/chaos.py) applied to the scheduler: a
+preemptible low-priority job trains on the only pool, a high-priority job
+arrives and reclaims its slices mid-run, the victim re-queues, re-binds
+once the winner finishes, resumes from its own checkpoints, and
+completes. The acceptance bar is numeric: the victim's final params must
+match an UNCONTENDED run of the same seed to float tolerance — the
+scheduler's preemption path must cost progress, never correctness.
+
+Control plane is real (FakeCluster + SliceScheduler + the TPUJob
+reconciler); the data plane is real too — each time a gang is fully
+Running, a real training segment (runtime/worker.train, tiny transformer
+on the CPU mesh) runs in-process with the env the operator rendered into
+the chief pod. Used by ``bench.py --mode sched`` and the slow scheduler
+tests.
+
+jax-free at import time (worker.train imports lazily inside run()).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+
+from ..api import k8s
+from ..api.trainingjob import (BINDING_ANNOTATION, COND_QUEUED,
+                               PREEMPTED_COUNT_ANNOTATION)
+
+POOL_TOPOLOGY = "v5e-8"
+
+
+@dataclass
+class PreemptionSoak:
+    """Two jobs contending for one v5e-8 pool; the scripted outcome is
+    victim-preempted → winner-runs → victim-resumes, all through the
+    real scheduler/operator loop."""
+
+    workdir: str
+    total_steps: int = 8
+    checkpoint_every: int = 2
+    preempt_at: int = 4          # victim's progress when the winner lands
+    seed: int = 0
+    global_batch: int = 8
+    wall_budget_s: float = 300.0
+    namespace: str = "kubeflow"
+
+    def _manifest(self, name: str, ckpt_dir: str, priority: int,
+                  preemptible: bool) -> dict:
+        return {
+            "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": name, "namespace": self.namespace},
+            "spec": {
+                "checkpointDir": ckpt_dir,
+                "schedulingPolicy": {"queue": "research",
+                                     "priority": priority,
+                                     "preemptible": preemptible},
+                "replicaSpecs": {"TPU": {
+                    "tpuTopology": POOL_TOPOLOGY,
+                    "template": {"spec": {"containers": [
+                        {"name": "jax", "image": "trainer:v1"}]}}}},
+                "runPolicy": {"backoffLimit": 3},
+            },
+        }
+
+    def _chief_env(self, cluster, chief: str) -> dict:
+        pod = cluster.get("v1", "Pod", self.namespace, chief)
+        return {e["name"]: e.get("value", "")
+                for e in pod["spec"]["containers"][0].get("env", [])}
+
+    def _run_segment(self, env_map: dict, target: int):
+        from ..runtime.worker import train  # lazy: pulls in jax
+        return train(
+            workload="transformer", steps=target,
+            global_batch=self.global_batch, sync_every=1,
+            checkpoint_dir=env_map.get("KFTPU_CHECKPOINT_DIR"),
+            checkpoint_every=self.checkpoint_every,
+            resume_from=env_map.get("KFTPU_RESUME_FROM"),
+            seed=self.seed, handle_sigterm=False, workload_kwargs={})
+
+    def _gang_running(self, cluster, name: str) -> bool:
+        pods = cluster.list("v1", "Pod", self.namespace,
+                            selector={"kubeflow.org/job-name": name})
+        running = [p for p in pods
+                   if p.get("status", {}).get("phase") == "Running"]
+        return len(running) == 2   # v5e-8 = 2 hosts
+
+    def run(self) -> dict:
+        from ..cluster.fake import FakeCluster
+        from ..controllers.runtime import Manager
+        from ..controllers.tpujob import TrainingJobReconciler
+        from .core import SliceScheduler
+
+        # preempt_at on a checkpoint boundary mirrors the real reclaim:
+        # SIGTERM forces a save before exit 75, so the victim's on-disk
+        # state is exactly its progress at preemption
+        assert self.preempt_at % self.checkpoint_every == 0, \
+            "preempt_at must land on a checkpoint boundary"
+        ckpt_victim = os.path.join(self.workdir, "victim")
+        ckpt_winner = os.path.join(self.workdir, "winner")
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes(POOL_TOPOLOGY)
+        mgr = Manager(cluster)
+        mgr.add(SliceScheduler())
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        report: dict = {"events": [], "outcome": "timeout",
+                        "checkpoint_dir": ckpt_victim}
+
+        def pump(ticks: int = 3) -> None:
+            for _ in range(ticks):
+                mgr.run_pending()
+                cluster.tick()
+            mgr.run_pending()
+
+        def job(name: str) -> dict:
+            return cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                               self.namespace, name)
+
+        cluster.create(self._manifest("victim", ckpt_victim,
+                                      priority=0, preemptible=True))
+        deadline = time.monotonic() + self.wall_budget_s
+        pump()
+        if not self._gang_running(cluster, "victim"):
+            report["outcome"] = "victim-never-bound"
+            return self._finish(report, mgr)
+
+        # victim trains to the preemption point
+        self._run_segment(self._chief_env(cluster, "victim-worker-0-0"),
+                          self.preempt_at)
+        report["events"].append(f"victim reached step {self.preempt_at}")
+
+        # the winner lands: higher priority, same (full-pool) shape
+        cluster.create(self._manifest("winner", ckpt_winner,
+                                      priority=10, preemptible=False))
+        while time.monotonic() < deadline:
+            pump()
+            v = job("victim")
+            if not k8s.annotations_of(v).get(BINDING_ANNOTATION) and \
+                    k8s.condition_true(v, COND_QUEUED) and \
+                    self._gang_running(cluster, "winner"):
+                break
+        else:
+            report["outcome"] = "preemption-never-happened"
+            return self._finish(report, mgr)
+        v = job("victim")
+        report["victim_preempted_count"] = int(k8s.annotations_of(v).get(
+            PREEMPTED_COUNT_ANNOTATION, "0"))
+        report["victim_resume_from"] = v["spec"].get("resumeFrom", "")
+        report["events"].append("victim preempted, winner running")
+
+        # winner trains to completion and succeeds
+        self._run_segment(self._chief_env(cluster, "winner-worker-0-0"),
+                          self.total_steps)
+        cluster.set_pod_phase(self.namespace, "winner-worker-0-0",
+                              "Succeeded")
+        # winner done -> its binding releases -> victim re-binds
+        while time.monotonic() < deadline:
+            pump()
+            if k8s.condition_true(job("winner"), "Succeeded") and \
+                    self._gang_running(cluster, "victim"):
+                break
+        else:
+            report["outcome"] = "victim-never-rebound"
+            return self._finish(report, mgr)
+        report["events"].append("winner succeeded, victim re-bound")
+
+        # victim resumes from its own checkpoints and completes; the
+        # resume step is whatever survived on disk — it must be the
+        # forced save at preemption, not step 0 (a silent replay would
+        # still pass the parity check while wasting the whole first run)
+        env_map = self._chief_env(cluster, "victim-worker-0-0")
+        report["victim_rebind_resume_env"] = env_map.get(
+            "KFTPU_RESUME_FROM", "")
+        report["victim_resume_step"] = self._latest_step(ckpt_victim)
+        self._run_segment(env_map, self.total_steps)
+        cluster.set_pod_phase(self.namespace, "victim-worker-0-0",
+                              "Succeeded")
+        while time.monotonic() < deadline:
+            pump()
+            if k8s.condition_true(job("victim"), "Succeeded"):
+                report["outcome"] = "succeeded"
+                break
+        return self._finish(report, mgr)
+
+    @staticmethod
+    def _latest_step(ckpt_dir: str):
+        from ..runtime.checkpoint import CheckpointManager  # lazy: jax
+        mgr = CheckpointManager(ckpt_dir)
+        try:
+            return mgr.latest_step()
+        finally:
+            mgr.close()
+
+    def _finish(self, report: dict, mgr) -> dict:
+        for c in mgr.controllers:
+            c.stop()
+        return report
+
+    def uncontended_params(self):
+        """The parity reference: the victim's workload run start-to-finish
+        with the same seed and no contention."""
+        env_map = {"KFTPU_CHECKPOINT_DIR":
+                   os.path.join(self.workdir, "clean")}
+        self._run_segment(env_map, self.total_steps)
+        from ..cluster.chaos import final_params
+        return final_params(env_map["KFTPU_CHECKPOINT_DIR"])

@@ -41,14 +41,37 @@ class DeployProber:
     prober means the whole control-plane path a user clicks through is
     live, not just that a port answers."""
 
+    # the poll window's shape when nothing is configured: wait up to
+    # half the probe interval (a drill may not outlive its own cadence),
+    # clamped so a tiny interval still polls a few times and a huge one
+    # does not wait forever on a dead deploy
+    MIN_POLL_WINDOW_S = 2.0
+    MAX_POLL_WINDOW_S = 120.0
+
     def __init__(self, url: str, app_name: str = "prober",
                  components: Optional[list] = None,
-                 timeout_s: float = 30.0, poll_tries: int = 10,
+                 timeout_s: float = 30.0,
+                 poll_tries: Optional[int] = None,
+                 poll_sleep_s: float = 0.2,
+                 interval_s: Optional[float] = None,
                  clock=time.monotonic):
+        """``poll_tries``/``poll_sleep_s`` bound the wait-for-Available
+        loop. When poll_tries is unset it SCALES with ``interval_s``
+        (the probe cadence): window = clamp(interval/2, 2s..120s),
+        tries = window / sleep — so a prober pointed at a slow real
+        bootstrap server (minutes-long deploys) no longer reports
+        chronic false failures off the old hard-coded ~2s window
+        (ADVICE.md round 5)."""
         self.url = url.rstrip("/")
         self.app_name = app_name
         self.components = components
         self.timeout_s = timeout_s
+        self.poll_sleep_s = poll_sleep_s
+        if poll_tries is None:
+            window = min(self.MAX_POLL_WINDOW_S,
+                         max(self.MIN_POLL_WINDOW_S,
+                             (interval_s or 0.0) / 2.0))
+            poll_tries = max(1, int(window / max(poll_sleep_s, 1e-6)))
         self.poll_tries = poll_tries
         self._clock = clock
         self._lock = threading.Lock()
@@ -86,7 +109,7 @@ class DeployProber:
                 conds = show.get("conditions") or []
                 if any(str(c).startswith("Available=True") for c in conds):
                     return
-                time.sleep(0.2)
+                time.sleep(self.poll_sleep_s)
             raise RuntimeError(
                 f"app {self.app_name} never reported Available=True "
                 f"(last conditions: {conds})")
@@ -143,12 +166,23 @@ class DeployProber:
 
 def main(argv: Optional[list] = None) -> int:
     from .metric_collector import prober_main
+
+    def add_args(p):
+        p.add_argument("--app-name", default="prober")
+        p.add_argument("--poll-tries", type=int, default=None,
+                       help="wait-for-Available polls per drill "
+                            "(default: scaled from --interval)")
+        p.add_argument("--poll-sleep", type=float, default=0.2,
+                       help="seconds between readiness polls")
+
     return prober_main(
         argv, description=__doc__.splitlines()[0],
         url_env="BOOTSTRAP_URL", default_interval=600.0,
-        make_prober=lambda args: DeployProber(args.url,
-                                              app_name=args.app_name),
-        add_args=lambda p: p.add_argument("--app-name", default="prober"),
+        make_prober=lambda args: DeployProber(
+            args.url, app_name=args.app_name,
+            poll_tries=args.poll_tries, poll_sleep_s=args.poll_sleep,
+            interval_s=args.interval),
+        add_args=add_args,
         banner="deploy prober")
 
 

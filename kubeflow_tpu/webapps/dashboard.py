@@ -13,6 +13,7 @@ Routes:
   GET /api/activities/{namespace}          (Events, newest first)
   GET /api/metrics/{type}?window=          (podcpu | podmem | node)
   GET /api/tpu/slices
+  GET /api/sched/queues                    (gang-scheduler queue state)
   GET /healthz
 """
 
@@ -183,9 +184,13 @@ def _job_phase(obj: dict) -> str:
     """Shared condition walk for CR-shaped jobs (training jobs, studies):
     the newest-wins order the runs panel and studies view BOTH use, so
     one study can never show two phases on one dashboard."""
-    from ..api.trainingjob import (COND_CREATED, COND_FAILED, COND_RUNNING,
-                                   COND_SUCCEEDED)
-    for cond in (COND_SUCCEEDED, COND_FAILED, COND_RUNNING, COND_CREATED):
+    from ..api.trainingjob import (COND_CREATED, COND_FAILED, COND_QUEUED,
+                                   COND_RUNNING, COND_SUCCEEDED)
+    # Queued outranks Created/Running remnants: a preempted gang keeps
+    # its Created condition but is WAITING — that is what the panel must
+    # say (Running is explicitly set False on teardown)
+    for cond in (COND_SUCCEEDED, COND_FAILED, COND_RUNNING, COND_QUEUED,
+                 COND_CREATED):
         if k8s.condition_true(obj, cond):
             return cond
     return "Pending"
@@ -380,6 +385,60 @@ def build_dashboard_app(client: KubeClient,
             raise ApiError(400, f"window must be an integer, got "
                                 f"{query.get('window')!r}")
         return 200, metrics.query(mtype, window)
+
+    @app.route("GET", "/api/sched/queues")
+    def sched_queues(params, query, body):
+        """Gang-scheduler queue state: per-queue depth, bound capacity,
+        and per-job scheduling status — the operator's view of why a job
+        is (not) running, fed by the scheduler's state/reason
+        annotations (scheduler/core.py) without touching the scheduler
+        process itself."""
+        from ..api.trainingjob import (BINDING_ANNOTATION, DEFAULT_QUEUE,
+                                       PREEMPTED_COUNT_ANNOTATION,
+                                       SCHED_REASON_ANNOTATION,
+                                       SCHED_STATE_ANNOTATION,
+                                       TPU_API_VERSION, TrainingJob)
+        from ..cluster.client import KubeError
+        try:
+            manifests = client.list(TPU_API_VERSION, "TPUJob")
+        except KubeError:
+            return 200, []
+        queues: dict[str, dict] = {}
+        for m in manifests:
+            try:
+                job = TrainingJob.from_manifest(m)
+            except ValueError:
+                continue
+            policy = job.scheduling_policy
+            tpu = job.tpu_spec
+            if policy is None or tpu is None or tpu.topology is None:
+                continue
+            anns = k8s.annotations_of(m)
+            bound = bool(anns.get(BINDING_ANNOTATION))
+            chips = tpu.topology.num_chips * tpu.num_slices
+            q = queues.setdefault(policy.queue or DEFAULT_QUEUE, {
+                "queue": policy.queue or DEFAULT_QUEUE,
+                "queued": 0, "bound": 0, "chipsBound": 0,
+                "chipsQueued": 0, "preemptions": 0, "jobs": []})
+            finished = _job_phase(m) in ("Succeeded", "Failed")
+            if not finished:
+                q["bound" if bound else "queued"] += 1
+                q["chipsBound" if bound else "chipsQueued"] += chips
+            q["preemptions"] += int(anns.get(
+                PREEMPTED_COUNT_ANNOTATION, "0"))
+            q["jobs"].append({
+                "name": job.name, "namespace": job.namespace,
+                "priority": policy.priority,
+                "preemptible": policy.preemptible,
+                "chips": chips, "phase": _job_phase(m),
+                "state": anns.get(SCHED_STATE_ANNOTATION,
+                                  "bound" if bound else "queued"),
+                "reason": anns.get(SCHED_REASON_ANNOTATION, ""),
+            })
+        for q in queues.values():
+            q["jobs"].sort(key=lambda j: (-j["priority"],
+                                          j["namespace"], j["name"]))
+        return 200, sorted(queues.values(), key=lambda q: q["queue"])
 
     @app.route("GET", "/api/tpu/slices")
     def tpu_slices(params, query, body):

@@ -134,6 +134,88 @@ def test_input_pipeline_knobs_are_plumbed_end_to_end():
     assert TrainingJob.from_manifest(ex).input_spec == ispec
 
 
+def test_scheduling_policy_is_plumbed_end_to_end():
+    """Every SchedulingPolicy field must be representable end-to-end,
+    the same rule as runPolicy/input: parsed+serialized through the
+    TPUJob spec's ``schedulingPolicy`` block (api/trainingjob.py),
+    rendered into worker env AND gated on by the operator
+    (controllers/tpujob.py), consumed by the scheduler's queue model
+    (scheduler/queue.py), and named in the manifests CRD schema +
+    example builder — so a future scheduling knob can't silently exist
+    in one layer only."""
+    import dataclasses
+
+    from kubeflow_tpu.api.trainingjob import (BINDING_ANNOTATION,
+                                              SchedulingPolicy,
+                                              TrainingJob)
+    from kubeflow_tpu.manifests.training import tpu_job_simple
+
+    def src(*rel):
+        with open(os.path.join(REPO_ROOT, "kubeflow_tpu", *rel)) as f:
+            return f.read()
+
+    fields = {f.name for f in dataclasses.fields(SchedulingPolicy)}
+    assert fields == {"queue", "priority", "preemptible"}, \
+        "SchedulingPolicy field added/removed — extend this check"
+    controller_src = src("controllers", "tpujob.py")
+    manifests_src = src("manifests", "training.py")
+    queue_src = src("scheduler", "queue.py")
+    # controller: env render + the binding gate both live in the
+    # operator, and the gate parses the annotation through the
+    # scheduler's OWN binding_of/binding_matches (one wire contract)
+    assert "scheduling_policy.to_env" in controller_src
+    assert "binding_of" in controller_src
+    assert "binding_matches" in controller_src
+    # scheduler: every field feeds the queue model
+    for name in fields:
+        assert name in queue_src, \
+            f"SchedulingPolicy.{name} is never consumed by the scheduler"
+    # manifests: the CRD schema names every spec field
+    for spec_field in ("queue", "priority", "preemptible",
+                       "schedulingPolicy"):
+        assert f'"{spec_field}"' in manifests_src, spec_field
+
+    # spec wire round-trip: to_dict → from_manifest → identical policy;
+    # and ABSENT block → None (the managed/unmanaged gate)
+    policy = SchedulingPolicy(queue="research", priority=7,
+                              preemptible=True)
+    manifest = {
+        "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": "t", "namespace": "ns"},
+        "spec": {"replicaSpecs": {"TPU": {
+            "tpuTopology": "v5e-8",
+            "template": {"spec": {"containers": [{"name": "c"}]}}}},
+            "schedulingPolicy": policy.to_dict()},
+    }
+    job = TrainingJob.from_manifest(manifest)
+    assert job.scheduling_policy == policy
+    assert job.to_manifest()["spec"]["schedulingPolicy"] == \
+        policy.to_dict()
+    del manifest["spec"]["schedulingPolicy"]
+    assert TrainingJob.from_manifest(manifest).scheduling_policy is None
+    # env render carries every knob under its declared name
+    assert policy.to_env() == {"KFTPU_SCHED_QUEUE": "research",
+                               "KFTPU_SCHED_PRIORITY": "7",
+                               "KFTPU_SCHED_PREEMPTIBLE": "1"}
+
+    # admission rejects garbage (a typo'd knob must fail at apply)
+    import pytest
+    with pytest.raises(ValueError, match="unknown"):
+        SchedulingPolicy.from_dict({"prio": 3})
+    with pytest.raises(ValueError, match="priority"):
+        SchedulingPolicy.from_dict({"priority": "high"})
+    with pytest.raises(ValueError, match="mapping"):
+        SchedulingPolicy.from_dict([1, 2])
+
+    # example builder renders the block end to end
+    ex = next(o for o in tpu_job_simple(queue="research", priority=7,
+                                        preemptible=True)
+              if o["kind"] == "TPUJob")
+    assert TrainingJob.from_manifest(ex).scheduling_policy == policy
+    # the binding annotation name is the one contract both sides share
+    assert BINDING_ANNOTATION == "scheduling.kubeflow.org/binding"
+
+
 def test_run_policy_fields_are_plumbed_end_to_end():
     """Every RunPolicy field must be plumbed spec → controller →
     manifests: round-trip through the TPUJob spec wire format

@@ -1,0 +1,586 @@
+"""Gang-scheduler subsystem tests (ISSUE 4).
+
+Three tiers, mirroring the chaos suite's layering:
+- pure-core: inventory packing (contiguity, fragmentation scoring,
+  determinism) and plan() policy (quota, cheapest-victim preemption,
+  backfill no-starvation) with no cluster at all;
+- control-plane: SliceScheduler + the TPUJob operator over FakeCluster
+  (Queued phase, binding → pool-pinned pods, preemption teardown →
+  resumeFrom → re-bind);
+- soak (slow): the real-training preemption-parity drill
+  (scheduler/soak.py), the bench.py --mode sched acceptance bar.
+"""
+
+import json
+import random
+
+import pytest
+
+from kubeflow_tpu.api import k8s
+from kubeflow_tpu.api.topology import parse_topology
+from kubeflow_tpu.api.trainingjob import (BINDING_ANNOTATION, COND_QUEUED,
+                                          PREEMPTED_COUNT_ANNOTATION,
+                                          SCHED_STATE_ANNOTATION)
+from kubeflow_tpu.cluster.fake import FakeCluster
+from kubeflow_tpu.controllers.runtime import Manager
+from kubeflow_tpu.controllers.tpujob import TrainingJobReconciler
+from kubeflow_tpu.scheduler.core import SliceScheduler, plan
+from kubeflow_tpu.scheduler.inventory import (PoolState, SliceInventory,
+                                              SliceRect)
+from kubeflow_tpu.scheduler.queue import JobRequest, SchedulerConfig
+
+pytestmark = pytest.mark.sched
+
+
+def req(name, topo="v5e-8", priority=0, preemptible=False, seq=0,
+        num_slices=1, queue="default", namespace="default"):
+    return JobRequest(namespace=namespace, name=name, queue=queue,
+                      priority=priority, preemptible=preemptible,
+                      topology=parse_topology(topo),
+                      num_slices=num_slices, seq=seq)
+
+
+def inventory(*pool_topos):
+    return SliceInventory([
+        PoolState(f"pool-{i}", parse_topology(t))
+        for i, t in enumerate(pool_topos)])
+
+
+class TestInventory:
+    def test_rect_is_contiguous_and_fits_pool(self):
+        inv = inventory("v5e-32")   # 4x8 grid
+        p = inv.place_gang(parse_topology("v5e-8"), 1)   # 2x4 rect
+        assert p is not None and len(p.slices) == 1
+        r = p.slices[0]
+        assert {r.h, r.w} == {2, 4}
+        assert r.x + r.h <= 4 and r.y + r.w <= 8
+
+    def test_packing_fills_pool_exactly(self):
+        # 4 x v5e-8 fill a v5e-32 with zero stranded chips
+        inv = inventory("v5e-32")
+        for i in range(4):
+            p = inv.place_gang(parse_topology("v5e-8"), 1)
+            assert p is not None, f"gang {i} did not fit"
+            inv.bind(f"j{i}", p)
+        assert inv.free_chips == 0
+        assert inv.place_gang(parse_topology("v5e-4"), 1) is None
+
+    def test_fragmentation_scoring_leaves_large_hole(self):
+        # after a v5e-16 (4x4) lands in a v5e-32 (4x8), the remaining
+        # free region must still be one contiguous 4x4 — a v5e-16 still
+        # fits (corner placement, not a middle cut)
+        inv = inventory("v5e-32")
+        p = inv.place_gang(parse_topology("v5e-16"), 1)
+        inv.bind("a", p)
+        assert inv.place_gang(parse_topology("v5e-16"), 1) is not None
+
+    def test_release_returns_chips(self):
+        inv = inventory("v5e-32")
+        p = inv.place_gang(parse_topology("v5e-8"), 1)
+        inv.bind("a", p)
+        assert inv.free_chips == 24
+        assert inv.release("a") == 8
+        assert inv.free_chips == 32
+
+    def test_multislice_gang_places_each_slice_contiguously(self):
+        inv = inventory("v5e-32")
+        p = inv.place_gang(parse_topology("v5e-8"), 3)
+        assert p is not None and len(p.slices) == 3
+        # slices never overlap
+        cells = [c for r in p.slices for c in r.cells()]
+        assert len(cells) == len(set(cells)) == 24
+
+    def test_packing_is_deterministic_under_a_seed(self):
+        # the same seeded request sequence always produces the same
+        # placements, byte for byte — no tiebreak depends on dict order
+        def run(seed):
+            rng = random.Random(seed)
+            inv = inventory("v5e-32", "v5e-16", "v5e-32")
+            out = []
+            for i in range(12):
+                topo = rng.choice(["v5e-4", "v5e-8", "v5e-16"])
+                p = inv.place_gang(parse_topology(topo), 1)
+                if p is None:
+                    out.append((topo, None))
+                    continue
+                inv.bind(f"j{i}", p)
+                if rng.random() < 0.3:
+                    inv.release(f"j{i}")
+                    out.append((topo, "released"))
+                else:
+                    out.append((topo, p.to_dict()))
+            return out
+        assert run(7) == run(7)
+        assert run(11) == run(11)
+
+    def test_binding_wire_round_trip(self):
+        inv = inventory("v5e-32")
+        p = inv.place_gang(parse_topology("v5e-8"), 2)
+        from kubeflow_tpu.scheduler.inventory import Placement
+        assert Placement.from_dict(
+            json.loads(json.dumps(p.to_dict()))).to_dict() == p.to_dict()
+
+    def test_from_nodes_truncates_not_ready_pools(self):
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-32", pool="full")
+        cluster.add_tpu_slice_nodes("v5e-8", pool="half")
+        # drain one of half's two hosts
+        node = cluster.get("v1", "Node", "", "half-v5e-8-1")
+        node["status"]["conditions"] = [{"type": "Ready",
+                                         "status": "False"}]
+        cluster.update(node)
+        inv = SliceInventory.from_nodes(cluster.list("v1", "Node"))
+        assert inv.pools["full"].total_chips == 32
+        assert inv.pools["half"].total_chips == 4   # one host gone
+
+
+class TestPlanPolicy:
+    def test_priority_order_binds_high_first(self):
+        inv = inventory("v5e-8")
+        decisions = plan([req("low", seq=0), req("high", priority=5,
+                                                 seq=1)],
+                         [], inv, SchedulerConfig())
+        assert [r.name for r, _ in decisions.binds] == ["high"]
+        assert "default/low" in decisions.waits
+
+    def test_quota_enforced_per_queue_namespace(self):
+        cfg = SchedulerConfig.from_dict({"queues": {"default": {
+            "quotaChips": {"team-a": 8}}}})
+        inv = inventory("v5e-32")
+        decisions = plan(
+            [req("a1", namespace="team-a", seq=0),
+             req("a2", namespace="team-a", seq=1),
+             req("b1", namespace="team-b", seq=2)],
+            [], inv, cfg)
+        bound = {r.key for r, _ in decisions.binds}
+        assert bound == {"team-a/a1", "team-b/b1"}
+        assert "quota" in decisions.waits["team-a/a2"]
+
+    def test_quota_counts_bound_not_queued(self):
+        cfg = SchedulerConfig.from_dict({"queues": {"default": {
+            "quotaChips": {"*": 8}}}})
+        inv = inventory("v5e-32")
+        first = plan([req("a1", seq=0)], [], inv, cfg)
+        assert len(first.binds) == 1
+        # with a1 bound, a2 is over quota; once a1 finishes (released +
+        # absent from bound), a2 binds
+        inv2 = inventory("v5e-32")
+        blocked = plan([req("a2", seq=1)], first.binds, inv2, cfg)
+        assert not blocked.binds
+        inv3 = inventory("v5e-32")
+        after = plan([req("a2", seq=1)], [], inv3, cfg)
+        assert len(after.binds) == 1
+
+    def test_preemption_picks_the_cheapest_victim(self):
+        # bound: a cheap 8-chip preemptible and an expensive 16-chip
+        # preemptible; an arriving 8-chip high-priority job must evict
+        # ONLY the 8-chip victim
+        inv = inventory("v5e-32")
+        small = req("small", "v5e-8", priority=0, preemptible=True, seq=0)
+        big = req("big", "v5e-16", priority=0, preemptible=True, seq=1)
+        b1 = inv.place_gang(small.topology, 1)
+        inv.bind(small.key, b1)
+        b2 = inv.place_gang(big.topology, 1)
+        inv.bind(big.key, b2)
+        # fill the rest so the head cannot fit without a preemption
+        filler = req("filler", "v5e-8", preemptible=False, seq=2)
+        b3 = inv.place_gang(filler.topology, 1)
+        inv.bind(filler.key, b3)
+        decisions = plan(
+            [req("urgent", "v5e-8", priority=10, seq=3)],
+            [(small, b1), (big, b2), (filler, b3)], inv,
+            SchedulerConfig())
+        assert [v.name for v in decisions.preempts] == ["small"]
+        assert [r.name for r, _ in decisions.binds] == ["urgent"]
+
+    def test_preemption_spares_victims_that_never_blocked_the_head(self):
+        # head needs a full v5e-32 (only pool-0 can hold it); a cheap
+        # 4-chip job on pool-1 is released FIRST by the greedy
+        # cheapest-order walk but contributes nothing — the prune must
+        # re-bind it so only the pool-0 job eats the SIGTERM
+        from kubeflow_tpu.scheduler.inventory import Placement
+        inv = inventory("v5e-32", "v5e-16")
+        innocent = req("innocent", "v5e-4", preemptible=True, seq=0)
+        # pin the innocent job onto pool-1 explicitly
+        bi = Placement(topology="v5e-4", num_slices=1,
+                       slices=[SliceRect("pool-1", 0, 0, 2, 2)])
+        inv.bind(innocent.key, bi)
+        blocker = req("blocker", "v5e-32", preemptible=True, seq=1)
+        bb = inv.place_gang(blocker.topology, 1)
+        inv.bind(blocker.key, bb)
+        decisions = plan(
+            [req("urgent", "v5e-32", priority=10, seq=2)],
+            [(innocent, bi), (blocker, bb)], inv, SchedulerConfig())
+        assert [v.name for v in decisions.preempts] == ["blocker"]
+        assert [r.name for r, _ in decisions.binds] == ["urgent"]
+
+    def test_preemption_never_touches_equal_or_higher_priority(self):
+        inv = inventory("v5e-8")
+        peer = req("peer", priority=5, preemptible=True, seq=0)
+        b = inv.place_gang(peer.topology, 1)
+        inv.bind(peer.key, b)
+        decisions = plan([req("urgent", priority=5, seq=1)],
+                         [(peer, b)], inv, SchedulerConfig())
+        assert decisions.preempts == []
+        assert not decisions.binds
+
+    def test_non_preemptible_victims_are_untouchable(self):
+        inv = inventory("v5e-8")
+        solid = req("solid", priority=0, preemptible=False, seq=0)
+        b = inv.place_gang(solid.topology, 1)
+        inv.bind(solid.key, b)
+        decisions = plan([req("urgent", priority=10, seq=1)],
+                         [(solid, b)], inv, SchedulerConfig())
+        assert decisions.preempts == []
+
+    def test_backfill_binds_small_jobs_behind_blocked_head(self):
+        # head needs the whole v5e-32; half is occupied -> blocked; a
+        # v5e-8 backfill job must still bind (outside the reservation a
+        # v5e-32 head claims the WHOLE pool... so use two pools: head
+        # reserves pool geometry, backfill rides the second pool)
+        inv = inventory("v5e-32", "v5e-16")
+        runner = req("runner", "v5e-16", seq=0)
+        b = inv.place_gang(runner.topology, 1)   # lands in pool-0 corner
+        inv.bind(runner.key, b)
+        decisions = plan(
+            [req("head", "v5e-32", priority=5, seq=1),
+             req("small", "v5e-8", priority=0, seq=2)],
+            [(runner, b)], inv, SchedulerConfig(preemption=False))
+        assert "default/head" in decisions.waits
+        assert [r.name for r, _ in decisions.binds] == ["small"]
+        # backfill landed clear of the head's reserved pool-0 region
+        assert all(r.pool != "pool-0"
+                   for _, p in decisions.binds for r in p.slices)
+
+    def test_backfill_never_starves_the_head(self):
+        # the no-starvation invariant, run to completion: a stream of
+        # small jobs keeps arriving; as soon as the blockers finish the
+        # head MUST bind even though small jobs are still queued
+        inv = inventory("v5e-32")
+        blocker = req("blocker", "v5e-16", preemptible=False, seq=0)
+        b = inv.place_gang(blocker.topology, 1)
+        inv.bind(blocker.key, b)
+        cfg = SchedulerConfig(preemption=False)
+        head = req("head", "v5e-32", priority=5, seq=1)
+        smalls = [req(f"small-{i}", "v5e-4", seq=2 + i)
+                  for i in range(6)]
+        decisions = plan([head, *smalls], [(blocker, b)], inv, cfg)
+        # head blocked; NO small job may take pool-0 cells the head
+        # reserved (= the whole pool) -> none bind
+        assert decisions.binds == []
+        # blocker finishes: the head binds immediately, smalls still wait
+        inv2 = inventory("v5e-32")
+        decisions2 = plan([head, *smalls], [], inv2, cfg)
+        assert [r.name for r, _ in decisions2.binds] == ["head"]
+
+    def test_fifo_config_ignores_priority(self):
+        inv = inventory("v5e-8")
+        from kubeflow_tpu.scheduler.sim import policy_config
+        cfg = policy_config("fifo")
+        decisions = plan([req("first", seq=0),
+                          req("vip", priority=99, seq=1)], [], inv, cfg)
+        assert [r.name for r, _ in decisions.binds] == ["first"]
+
+
+def tpujob(name, topo="v5e-8", priority=0, preemptible=True, ckpt="",
+           policy=True, ns="kubeflow"):
+    spec = {
+        "replicaSpecs": {"TPU": {
+            "tpuTopology": topo,
+            "template": {"spec": {"containers": [
+                {"name": "jax", "image": "trainer:v1"}]}}}},
+        "runPolicy": {"backoffLimit": 2},
+    }
+    if policy:
+        spec["schedulingPolicy"] = {"queue": "research",
+                                    "priority": priority,
+                                    "preemptible": preemptible}
+    if ckpt:
+        spec["checkpointDir"] = ckpt
+    return {"apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": name, "namespace": ns}, "spec": spec}
+
+
+@pytest.fixture
+def env():
+    cluster = FakeCluster()
+    cluster.add_tpu_slice_nodes("v5e-8")
+    mgr = Manager(cluster)
+    mgr.add(SliceScheduler())
+    mgr.add(TrainingJobReconciler("TPUJob"))
+    yield cluster, mgr
+    for c in mgr.controllers:
+        c.stop()
+
+
+def drive(cluster, mgr, ticks=4):
+    for _ in range(ticks):
+        mgr.run_pending()
+        cluster.tick()
+    mgr.run_pending()
+
+
+def get_job(cluster, name):
+    return cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob", "kubeflow",
+                       name)
+
+
+class TestControlPlane:
+    def test_unbound_job_sits_queued_with_no_pods(self):
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        mgr = Manager(cluster)
+        # operator only — no scheduler running: the job must WAIT, the
+        # pre-scheduler behavior (create immediately) would deadlock a
+        # contended cluster on partial gangs
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        cluster.create(tpujob("waiting"))
+        drive(cluster, mgr)
+        assert cluster.list("v1", "Pod", "kubeflow") == []
+        job = get_job(cluster, "waiting")
+        assert k8s.condition_true(job, COND_QUEUED)
+        for c in mgr.controllers:
+            c.stop()
+
+    def test_legacy_job_without_policy_creates_immediately(self, env):
+        cluster, mgr = env
+        cluster.create(tpujob("legacy", policy=False))
+        mgr.run_pending()
+        assert len(cluster.list("v1", "Pod", "kubeflow")) == 2
+
+    def test_bound_job_pods_pinned_to_pool(self, env):
+        cluster, mgr = env
+        cluster.create(tpujob("pinned"))
+        drive(cluster, mgr)
+        job = get_job(cluster, "pinned")
+        binding = json.loads(
+            k8s.annotations_of(job)[BINDING_ANNOTATION])
+        assert binding["topology"] == "v5e-8"
+        pod = cluster.get("v1", "Pod", "kubeflow", "pinned-worker-0-0")
+        sel = pod["spec"]["nodeSelector"]
+        assert sel["kubeflow.org/pool"] == binding["slices"][0]["pool"]
+        rect = json.loads(k8s.annotations_of(pod)[
+            "scheduling.kubeflow.org/slice"])
+        assert SliceRect.from_dict(rect).chips == 8
+        envm = {e["name"]: e["value"]
+                for e in pod["spec"]["containers"][0]["env"]}
+        assert envm["KFTPU_SCHED_QUEUE"] == "research"
+        assert envm["KFTPU_SCHED_PREEMPTIBLE"] == "1"
+        assert pod["status"]["phase"] == "Running"
+
+    def test_sub_slice_binds_on_larger_pool(self):
+        # a v5e-8 gang carved out of a v5e-32 pool: the exact-topology
+        # node pin must give way to the pool pin or the pods would wait
+        # forever for v5e-8-labeled nodes
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-32", pool="big")
+        mgr = Manager(cluster)
+        mgr.add(SliceScheduler())
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        cluster.create(tpujob("carved"))
+        drive(cluster, mgr)
+        pods = cluster.list("v1", "Pod", "kubeflow")
+        assert len(pods) == 2
+        assert all(p["status"]["phase"] == "Running" for p in pods)
+        assert all(p["spec"]["nodeSelector"]["kubeflow.org/pool"] == "big"
+                   for p in pods)
+        for c in mgr.controllers:
+            c.stop()
+
+    def test_second_job_queues_instead_of_half_creating(self, env):
+        # THE motivating scenario: two jobs on a one-slice cluster; the
+        # seed behavior started both and deadlocked on partial gangs
+        cluster, mgr = env
+        cluster.create(tpujob("first"))
+        cluster.create(tpujob("second"))
+        drive(cluster, mgr)
+        pods = cluster.list("v1", "Pod", "kubeflow")
+        assert {k8s.name_of(p) for p in pods} == \
+            {"first-worker-0-0", "first-worker-0-1"}
+        second = get_job(cluster, "second")
+        assert k8s.condition_true(second, COND_QUEUED)
+        assert k8s.annotations_of(second)[
+            SCHED_STATE_ANNOTATION] == "queued"
+        # first succeeds -> second binds
+        cluster.set_pod_phase("kubeflow", "first-worker-0-0", "Succeeded")
+        drive(cluster, mgr, ticks=6)
+        pods = cluster.list("v1", "Pod", "kubeflow")
+        assert {k8s.name_of(p) for p in pods} >= \
+            {"second-worker-0-0", "second-worker-0-1"}
+
+    def test_preemption_requeues_victim_with_resume(self, env):
+        cluster, mgr = env
+        cluster.create(tpujob("victim", priority=0, preemptible=True,
+                              ckpt="/ckpt/victim"))
+        drive(cluster, mgr)
+        cluster.create(tpujob("winner", priority=10, preemptible=False))
+        drive(cluster, mgr, ticks=6)
+        victim = get_job(cluster, "victim")
+        anns = k8s.annotations_of(victim)
+        assert not anns.get(BINDING_ANNOTATION)
+        assert anns[SCHED_STATE_ANNOTATION] == "preempted"
+        assert anns[PREEMPTED_COUNT_ANNOTATION] == "1"
+        assert victim["spec"]["resumeFrom"] == "/ckpt/victim"
+        assert k8s.condition_true(victim, COND_QUEUED)
+        # preemption is a requeue, never a failure: no backoff burned
+        assert "kubeflow.org/gang-restart-count" not in anns
+        winner_pods = [k8s.name_of(p)
+                       for p in cluster.list("v1", "Pod", "kubeflow")]
+        assert sorted(winner_pods) == ["winner-worker-0-0",
+                                       "winner-worker-0-1"]
+
+    def test_preempted_jobs_resume_env_survives_rebind(self, env):
+        # the checkpoint contract across the whole cycle: preempt ->
+        # re-bind -> the recreated gang carries KFTPU_RESUME_FROM
+        cluster, mgr = env
+        cluster.create(tpujob("victim", ckpt="/ckpt/victim"))
+        drive(cluster, mgr)
+        cluster.create(tpujob("winner", priority=10, preemptible=False))
+        drive(cluster, mgr, ticks=6)
+        cluster.set_pod_phase("kubeflow", "winner-worker-0-0",
+                              "Succeeded")
+        drive(cluster, mgr, ticks=8)
+        pod = cluster.get("v1", "Pod", "kubeflow", "victim-worker-0-0")
+        envm = {e["name"]: e["value"]
+                for e in pod["spec"]["containers"][0]["env"]}
+        assert envm["KFTPU_RESUME_FROM"] == "/ckpt/victim"
+        assert envm["KFTPU_CHECKPOINT_DIR"] == "/ckpt/victim"
+        victim = get_job(cluster, "victim")
+        assert k8s.annotations_of(victim).get(BINDING_ANNOTATION)
+        assert k8s.get_condition(victim, COND_QUEUED)["status"] == "False"
+
+    def test_deployed_scheduler_reads_configmap_quotas(self):
+        # the tpu-scheduler manifest's ConfigMap is LIVE policy: a
+        # default-constructed SliceScheduler (the deployment path) must
+        # enforce the quotas it renders, not a silent built-in default
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-32", pool="big")
+        from kubeflow_tpu.manifests.training import tpu_scheduler
+        for obj in tpu_scheduler(queues={"research": {
+                "quotaChips": {"kubeflow": 8}}}):
+            cluster.create(obj)
+        mgr = Manager(cluster)
+        mgr.add(SliceScheduler())    # no explicit config
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        cluster.create(tpujob("a1"))
+        cluster.create(tpujob("a2"))
+        drive(cluster, mgr)
+        a1 = get_job(cluster, "a1")
+        a2 = get_job(cluster, "a2")
+        assert k8s.annotations_of(a1).get(BINDING_ANNOTATION)
+        assert not k8s.annotations_of(a2).get(BINDING_ANNOTATION)
+        assert "quota" in k8s.annotations_of(a2)[
+            "scheduling.kubeflow.org/reason"]
+        for c in mgr.controllers:
+            c.stop()
+
+    def test_conflicting_bindings_requeue_not_crash(self, env):
+        # two overlapping (well-formed) bindings — scheduler-replica
+        # overlap during a rollout, or a hand-edited annotation — must
+        # requeue the later job, not abort every future pass
+        cluster, mgr = env
+        cluster.create(tpujob("one"))
+        drive(cluster, mgr)
+        one = get_job(cluster, "one")
+        stolen = k8s.annotations_of(one)[BINDING_ANNOTATION]
+        manifest = tpujob("two")
+        manifest["metadata"]["annotations"] = {BINDING_ANNOTATION: stolen}
+        cluster.create(manifest)
+        drive(cluster, mgr)   # must not raise / give up
+        two = get_job(cluster, "two")
+        assert not k8s.annotations_of(two).get(BINDING_ANNOTATION)
+        assert k8s.condition_true(two, COND_QUEUED)
+        # the original owner keeps its gang
+        assert k8s.annotations_of(
+            get_job(cluster, "one")).get(BINDING_ANNOTATION) == stolen
+
+    def test_scheduler_pass_is_idempotent_no_write_storm(self, env):
+        # a steady-state pass must not rewrite annotations: unchanged
+        # patches would MODIFIED-storm the watch and spin the manager
+        cluster, mgr = env
+        cluster.create(tpujob("steady"))
+        cluster.create(tpujob("waiting"))
+        drive(cluster, mgr)
+        rv_before = {
+            k8s.name_of(j): j["metadata"]["resourceVersion"]
+            for j in cluster.list("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                                  "kubeflow")}
+        sched = SliceScheduler()
+        sched.reconcile(cluster, ("kubeflow", "steady"))
+        rv_after = {
+            k8s.name_of(j): j["metadata"]["resourceVersion"]
+            for j in cluster.list("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                                  "kubeflow")}
+        assert rv_before == rv_after
+
+    def test_dashboard_reports_queue_state(self, env):
+        from kubeflow_tpu.webapps.dashboard import build_dashboard_app
+        cluster, mgr = env
+        cluster.create(tpujob("running"))
+        cluster.create(tpujob("parked", priority=0))
+        drive(cluster, mgr)
+        app = build_dashboard_app(cluster)
+        status, body = app.dispatch("GET", "/api/sched/queues", b"")
+        assert status == 200
+        q = next(row for row in body if row["queue"] == "research")
+        assert q["bound"] == 1 and q["queued"] == 1
+        assert q["chipsBound"] == 8 and q["chipsQueued"] == 8
+        states = {j["name"]: j["state"] for j in q["jobs"]}
+        assert states == {"running": "bound", "parked": "queued"}
+
+
+class TestSimulation:
+    def test_policies_dominate_fifo_on_seeded_contention(self):
+        from kubeflow_tpu.scheduler.sim import compare_policies
+        table = compare_policies([0, 1, 2], n_jobs=16,
+                                 pools=("v5e-32",))
+        fifo, pre = table["fifo"], table["preempt"]
+        assert pre["chip_utilization"] > fifo["chip_utilization"]
+        assert pre["queue_wait_p50"] < fifo["queue_wait_p50"]
+        assert table["backfill"]["queue_wait_mean"] <= \
+            fifo["queue_wait_mean"]
+        # every job finishes under every policy (no starvation)
+        assert all(row["unfinished"] == 0 for row in table.values())
+
+    def test_simulation_is_seed_deterministic(self):
+        from kubeflow_tpu.scheduler.sim import make_workload, simulate
+        runs = [simulate(make_workload(3, n_jobs=10),
+                         pools=("v5e-32",), policy="preempt")
+                for _ in range(2)]
+        assert runs[0] == runs[1]
+
+    def test_preemption_respects_checkpoint_cadence(self):
+        from kubeflow_tpu.scheduler.sim import make_workload, simulate
+        row = simulate(make_workload(5, n_jobs=16), pools=("v5e-16",),
+                       policy="preempt", checkpoint_every=4)
+        if row["preemptions"]:
+            # each preemption loses at most checkpoint_every-1 ticks
+            assert row["recomputed_ticks"] <= \
+                row["preemptions"] * 3
+
+
+@pytest.mark.slow
+@pytest.mark.compute
+class TestPreemptionSoak:
+    def test_preempted_job_matches_uncontended_params(self, tmp_path):
+        import jax
+        import numpy as np
+
+        from kubeflow_tpu.cluster.chaos import final_params
+        from kubeflow_tpu.scheduler.soak import PreemptionSoak
+
+        soak = PreemptionSoak(workdir=str(tmp_path), total_steps=6,
+                              checkpoint_every=2, preempt_at=4)
+        report = soak.run()
+        assert report["outcome"] == "succeeded", report
+        assert report["victim_preempted_count"] == 1
+        # the resume step is the forced checkpoint at preemption — the
+        # re-bound gang continued, it did not replay from step 0
+        assert report["victim_resume_step"] == 4
+        preempted = final_params(report["checkpoint_dir"])
+        clean = soak.uncontended_params()
+        delta = max(jax.tree.leaves(jax.tree.map(
+            lambda a, b: float(np.max(np.abs(
+                np.asarray(a) - np.asarray(b)))),
+            preempted, clean)), default=0.0)
+        assert delta <= 1e-5, f"params diverged by {delta}"

@@ -89,6 +89,37 @@ class TestDeployProber:
         assert prober.probe() is True
         assert prober.successes == 2
 
+    def test_poll_window_scales_with_probe_interval(self):
+        # unset poll_tries scales with the probe cadence (ADVICE r5):
+        # interval/2 worth of polls, clamped to [2s, 120s] of window
+        fast = DeployProber("http://x", interval_s=10.0)
+        assert fast.poll_tries == int(5.0 / 0.2)          # 25 polls
+        slow = DeployProber("http://x", interval_s=600.0)
+        assert slow.poll_tries == int(120.0 / 0.2)        # clamped cap
+        tiny = DeployProber("http://x", interval_s=0.5)
+        assert tiny.poll_tries == int(2.0 / 0.2)          # clamped floor
+        # explicit values always win over scaling
+        pinned = DeployProber("http://x", poll_tries=3,
+                              poll_sleep_s=1.5, interval_s=600.0)
+        assert pinned.poll_tries == 3 and pinned.poll_sleep_s == 1.5
+
+    def test_poll_flags_reach_the_prober(self, bootstrap, monkeypatch):
+        # prober_main wiring: --poll-tries/--poll-sleep reach the
+        # DeployProber main() constructs (run_forever stubbed out so
+        # the entrypoint returns instead of looping)
+        from kubeflow_tpu.support import deploy_prober as dp
+        built = {}
+        monkeypatch.setattr(
+            dp.DeployProber, "run_forever",
+            lambda self, interval_s, stop=None: built.update(
+                tries=self.poll_tries, sleep=self.poll_sleep_s,
+                interval=interval_s))
+        assert dp.main(["--url", bootstrap, "--interval", "30",
+                        "--poll-tries", "4", "--poll-sleep", "0.1",
+                        "--metrics-host", "127.0.0.1",
+                        "--metrics-port", "0"]) == 0
+        assert built == {"tries": 4, "sleep": 0.1, "interval": 30.0}
+
     def test_failure_is_recorded_not_raised(self):
         # nothing listens here: the drill fails, the counter records it
         prober = DeployProber("http://127.0.0.1:9", timeout_s=0.5)

@@ -1126,6 +1126,205 @@ def bench_sched(t_start: float | None = None) -> dict:
     }
 
 
+def bench_obs(t_start: float | None = None) -> dict:
+    """Observability overhead + end-to-end trace proof (ISSUE 5).
+
+    Three parts:
+
+    1. **Micro-costs** of the shared registry and span writer (per-op
+       seconds measured over large loops): counter inc, gauge set,
+       histogram observe, one span emit (JSONL write + flush), one
+       /metrics render.
+    2. **Step-time overhead**: a real train loop run with obs ON
+       (default registry enabled + span sink) and OFF
+       (KFTPU_OBS_DISABLE=1, no sink), alternated to cancel host
+       drift; plus the MODELED per-step cost — the measured per-window
+       obs work (histogram + gauge + counter + span emit) amortized
+       over sync_every steps, as a fraction of the measured step time.
+       The modeled number is the asserted one (<1%): the A/B wall
+       ratio of a microsecond-scale effect sits inside host noise and
+       is reported honestly next to it, not asserted.
+    3. **Trace end-to-end**: the seeded contended-scheduler soak
+       (scheduler/soak.py — victim preempted mid-run by a
+       higher-priority job, both on the REAL scheduler + operator loop
+       with real training segments) run with a span sink; the victim's
+       whole life must reconstruct from the JSONL alone:
+       queued → bound → created → running → windows → preempted →
+       re-bound → windows → succeeded. Skippable with
+       KFTPU_BENCH_OBS_SOAK=0 (the obs_smoke CI entry keeps it on —
+       it IS the acceptance bar).
+
+    Env knobs: KFTPU_BENCH_OBS_STEPS / _SYNC_EVERY / _REPEATS / _SOAK.
+    """
+    import os
+    import shutil
+    import statistics
+    import tempfile
+
+    from kubeflow_tpu.obs.registry import (Registry,
+                                           reset_default_registry)
+    from kubeflow_tpu.obs.trace import SpanWriter
+
+    t_start = time.perf_counter() if t_start is None else t_start
+
+    # -- 1) micro-costs ------------------------------------------------------
+    reg = Registry()
+    counter = reg.counter("bench_obs_total", "bench", labels=("stage",)) \
+        .labels(stage="x")
+    gauge = reg.gauge("bench_obs_gauge", "bench")
+    hist = reg.histogram("bench_obs_seconds", "bench")
+    n = 200_000
+
+    def per_op(fn, iters: int) -> float:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        return (time.perf_counter() - t0) / iters
+
+    counter_s = per_op(lambda: counter.inc(), n)
+    gauge_s = per_op(lambda: gauge.set(1.5), n)
+    hist_s = per_op(lambda: hist.observe(0.01), n)
+    tmp = tempfile.mkdtemp(prefix="kftpu-obs-bench-")
+    try:
+        writer = SpanWriter(os.path.join(tmp, "micro.jsonl"), "bench",
+                            trace_id="bench")
+        span_s = per_op(lambda: writer.emit("window", start=time.time(),
+                                            end=time.time(), step=1,
+                                            steps=10), 20_000)
+        writer.close()
+        # a render over a realistically sized registry (~100 series)
+        for i in range(80):
+            reg.counter("bench_obs_fill_total", "bench",
+                        labels=("i",)).labels(i=str(i)).inc()
+        render_s = per_op(lambda: reg.render(), 200)
+
+        # -- 2) step-time overhead ------------------------------------------
+        from kubeflow_tpu.runtime.worker import train
+        steps = _env_int("KFTPU_BENCH_OBS_STEPS", 24)
+        sync_every = _env_int("KFTPU_BENCH_OBS_SYNC_EVERY", 4)
+        repeats = _env_int("KFTPU_BENCH_OBS_REPEATS", 2)
+        arm_times: dict = {"on": [], "off": []}
+        # alternate arms so slow host drift hits both equally; the first
+        # (compile-paying) run is charged to neither via warmup=1 inside
+        # summary(); run one unrecorded warm-up pass to even the cache
+        train(workload="transformer", steps=4, global_batch=8,
+              sync_every=sync_every, workload_kwargs={})
+        for rep in range(repeats):
+            # alternate arm order per repeat so first-runner bias (cache
+            # warmth, host load ramps) cancels instead of accumulating
+            for arm in (("off", "on"), ("on", "off"))[rep % 2]:
+                env_keys = {"KFTPU_OBS_DISABLE": "1" if arm == "off"
+                            else "", "KFTPU_SPAN_PATH":
+                            os.path.join(tmp, "arm.jsonl")
+                            if arm == "on" else ""}
+                saved = {k: os.environ.get(k) for k in env_keys}
+                for k, v in env_keys.items():
+                    if v:
+                        os.environ[k] = v
+                    else:
+                        os.environ.pop(k, None)
+                reset_default_registry()
+                try:
+                    res = train(workload="transformer", steps=steps,
+                                global_batch=8, sync_every=sync_every,
+                                workload_kwargs={})
+                finally:
+                    for k, v in saved.items():
+                        if v is None:
+                            os.environ.pop(k, None)
+                        else:
+                            os.environ[k] = v
+                    reset_default_registry()
+                arm_times[arm].append(res.mean_step_time_s)
+        step_off = statistics.median(arm_times["off"])
+        step_on = statistics.median(arm_times["on"])
+        # modeled: what record_window + the window span actually add,
+        # amortized per step
+        per_window_s = hist_s + gauge_s + counter_s + span_s
+        modeled_pct = 100.0 * per_window_s / max(sync_every, 1) / step_on \
+            if step_on else 0.0
+        measured_pct = 100.0 * (step_on - step_off) / step_off \
+            if step_off else 0.0
+
+        # -- 3) trace end-to-end through the contended scheduler -----------
+        trace_report: dict = {"skipped": True}
+        if _env_int("KFTPU_BENCH_OBS_SOAK", 1):
+            from kubeflow_tpu.obs.trace import (TRACE_ID_ANNOTATION,
+                                                reconstruct)
+            from kubeflow_tpu.api import k8s as k8s_api
+            from kubeflow_tpu.scheduler.soak import PreemptionSoak
+            sink = os.path.join(tmp, "trace.jsonl")
+            saved_sink = os.environ.get("KFTPU_SPAN_PATH")
+            os.environ["KFTPU_SPAN_PATH"] = sink
+            try:
+                t0 = time.perf_counter()
+                soak = PreemptionSoak(workdir=os.path.join(tmp, "soak"))
+                report = soak.run()
+                victim = report.get("victim_manifest") or {}
+                trace_id = k8s_api.annotations_of(victim).get(
+                    TRACE_ID_ANNOTATION, "")
+                timeline = reconstruct(sink, trace_id)
+                names = timeline["names"]
+
+                def in_order(*want) -> bool:
+                    i = 0
+                    for name in names:
+                        if i < len(want) and name == want[i]:
+                            i += 1
+                    return i == len(want)
+
+                trace_report = {
+                    "outcome": report["outcome"],
+                    "trace_id": trace_id,
+                    "spans": len(timeline["events"]),
+                    "windows": names.count("window"),
+                    "wall_s": timeline["wallSeconds"],
+                    # the acceptance bar: the victim's whole life —
+                    # queue wait, bind, gang start, windows, preemption,
+                    # re-bind, completion — reconstructed from JSONL
+                    # spans alone, in order
+                    "end_to_end_ok": bool(
+                        report["outcome"] == "succeeded" and trace_id
+                        and in_order("queued", "bound", "created",
+                                     "running", "window", "preempted",
+                                     "queued", "bound", "window",
+                                     "succeeded")),
+                    "soak_wall_s": round(time.perf_counter() - t0, 1),
+                }
+            finally:
+                if saved_sink is None:
+                    os.environ.pop("KFTPU_SPAN_PATH", None)
+                else:
+                    os.environ["KFTPU_SPAN_PATH"] = saved_sink
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    return {
+        "metric": "obs_overhead_modeled",
+        "value": round(modeled_pct, 4),
+        "unit": "pct_of_step_time",
+        "vs_baseline": None,
+        "mfu": None,
+        "extras": {
+            "overhead_lt_1pct": bool(modeled_pct < 1.0),
+            "modeled_overhead_pct": round(modeled_pct, 4),
+            "measured_ab_overhead_pct": round(measured_pct, 2),
+            "step_time_on_s": round(step_on, 6),
+            "step_time_off_s": round(step_off, 6),
+            "sync_every": sync_every,
+            "micro_costs_us": {
+                "counter_inc": round(counter_s * 1e6, 3),
+                "gauge_set": round(gauge_s * 1e6, 3),
+                "histogram_observe": round(hist_s * 1e6, 3),
+                "span_emit": round(span_s * 1e6, 3),
+                "metrics_render": round(render_s * 1e6, 1),
+            },
+            "trace": trace_report,
+        },
+        "_flops_per_chip": 0.0,
+    }
+
+
 def _run_sub_bench(mode: str, budget_s: float) -> dict:
     """Run ``bench.py --mode <mode>`` as a subprocess with a hard
     wall-clock budget and return its JSON row. The child inherits the
@@ -1153,7 +1352,8 @@ def main(argv=None) -> int:
     p.add_argument("--mode", default="all",
                    choices=["all", "resnet", "resnet-fused", "lm",
                             "lm-long", "serving", "fused-blocks",
-                            "weight-update", "chaos", "input", "sched"])
+                            "weight-update", "chaos", "input", "sched",
+                            "obs"])
     p.add_argument("--routing-out",
                    default="bench-matrix/fused_routing_measured.json",
                    help="where --mode fused-blocks writes the measured "
@@ -1205,6 +1405,8 @@ def main(argv=None) -> int:
         row = bench_input(t_start=t_start)
     elif args.mode == "sched":
         row = bench_sched(t_start=t_start)
+    elif args.mode == "obs":
+        row = bench_obs(t_start=t_start)
     else:
         row = bench_resnet(fused=False, t_start=t_start)
 

@@ -117,6 +117,73 @@ class InputSpec:
         return spec
 
 @dataclass
+class ObsSpec:
+    """Observability knobs (``spec.observability``): where this job's
+    workers stream trace spans and whether they expose their own
+    ``/metrics``. Plumbed the full operator path like InputSpec — parsed
+    here at admission, rendered by controllers/tpujob.py as the env
+    named in each field's metadata, consumed by runtime/worker.py via
+    the CLI flag named there (tests/test_lint.py enforces every layer).
+    The job's ``trace_id`` is NOT a spec field: it is minted by the
+    control plane (observability.kubeflow.org/trace-id annotation) and
+    rendered as KFTPU_TRACE_ID alongside these. ``None`` = unset, obs
+    off. Defined HERE, jax-free: admission must not import the
+    runtime."""
+
+    # JSONL sink for trace spans (obs/trace.py SpanWriter): the worker
+    # appends window/checkpoint/profile spans the control plane's
+    # queued/bound/running events stitch into one timeline
+    span_path: Optional[str] = field(default=None, metadata={
+        "spec_field": "spanPath", "env": "KFTPU_SPAN_PATH",
+        "cli": "--span-path"})
+    # port for the worker's own /metrics exposition (obs/http.py);
+    # 0/unset = no worker scrape surface
+    metrics_port: Optional[int] = field(default=None, metadata={
+        "spec_field": "metricsPort", "env": "KFTPU_OBS_METRICS_PORT",
+        "cli": "--obs-metrics-port"})
+
+    def validate(self) -> None:
+        if self.span_path is not None and \
+                not isinstance(self.span_path, str):
+            raise ValueError(
+                f"observability.spanPath must be a string, got "
+                f"{self.span_path!r}")
+        p = self.metrics_port
+        if p is not None and (not isinstance(p, int) or
+                              isinstance(p, bool) or
+                              p < 0 or p > 65535):
+            raise ValueError(
+                f"observability.metricsPort must be a port number, got "
+                f"{p!r}")
+
+    def to_dict(self) -> dict:
+        return {f.metadata["spec_field"]: getattr(self, f.name)
+                for f in fields(self) if getattr(self, f.name) is not None}
+
+    def to_env(self) -> dict[str, str]:
+        """The controller-rendered worker env for every SET knob."""
+        return {f.metadata["env"]: str(getattr(self, f.name))
+                for f in fields(self) if getattr(self, f.name) is not None}
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "ObsSpec":
+        if d is not None and not isinstance(d, dict):
+            raise ValueError(
+                f"spec.observability must be a mapping of observability "
+                f"knobs, got {type(d).__name__}: {d!r}")
+        d = dict(d or {})
+        by_spec = {f.metadata["spec_field"]: f.name for f in fields(cls)}
+        unknown = set(d) - set(by_spec)
+        if unknown:
+            raise ValueError(
+                f"unknown observability knobs {sorted(unknown)}; "
+                f"valid: {sorted(by_spec)}")
+        spec = cls(**{by_spec[k]: v for k, v in d.items()})
+        spec.validate()
+        return spec
+
+
+@dataclass
 class SchedulingPolicy:
     """Gang-scheduling knobs (``spec.schedulingPolicy``): how the slice
     scheduler (kubeflow_tpu/scheduler/) queues, places, and — when
@@ -461,6 +528,10 @@ class TrainingJob:
     # prefetch depth — the overlapped input pipeline (docs/training.md
     # "Input pipeline")
     input_spec: InputSpec = field(default_factory=InputSpec)
+    # observability knobs (spec.observability → KFTPU_SPAN_PATH /
+    # KFTPU_OBS_METRICS_PORT): trace-span sink and the worker's own
+    # /metrics port (docs/operations.md "Observability")
+    obs_spec: ObsSpec = field(default_factory=ObsSpec)
     # gang-scheduling knobs (spec.schedulingPolicy → the slice
     # scheduler's queue/priority/preemptible; None = not
     # scheduler-managed, the legacy immediate-create path)
@@ -532,6 +603,7 @@ class TrainingJob:
             tensorboard_dir=spec.get("tensorboardDir", "") or "",
             compile_cache_dir=spec.get("compileCacheDir", "") or "",
             input_spec=InputSpec.from_dict(spec.get("input")),
+            obs_spec=ObsSpec.from_dict(spec.get("observability")),
             scheduling_policy=SchedulingPolicy.from_dict(
                 spec.get("schedulingPolicy")),
             weight_update=spec.get("weightUpdate", "") or "",
@@ -571,6 +643,7 @@ class TrainingJob:
             # not at worker startup deep inside the gang
             validate_weight_update(self.weight_update)
         self.input_spec.validate()
+        self.obs_spec.validate()
         if self.scheduling_policy is not None:
             self.scheduling_policy.validate()
         vocab = REPLICA_TYPES[self.kind]
@@ -639,6 +712,8 @@ class TrainingJob:
             out["spec"]["compileCacheDir"] = self.compile_cache_dir
         if self.input_spec.to_dict():
             out["spec"]["input"] = self.input_spec.to_dict()
+        if self.obs_spec.to_dict():
+            out["spec"]["observability"] = self.obs_spec.to_dict()
         if self.scheduling_policy is not None:
             out["spec"]["schedulingPolicy"] = self.scheduling_policy.to_dict()
         if self.weight_update:

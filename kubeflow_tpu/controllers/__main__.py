@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import threading
 
@@ -90,6 +91,13 @@ def main(argv=None) -> int:
                    help="run over an in-memory cluster (demo/testing)")
     p.add_argument("--store", default="",
                    help="run-store sqlite path (persistenceagent)")
+    p.add_argument("--metrics-port", type=int,
+                   default=int(os.environ.get("KFTPU_METRICS_PORT", "0")),
+                   help="serve /metrics (+/healthz) for Prometheus on "
+                        "this port (0 = off; env fallback "
+                        "KFTPU_METRICS_PORT) — the scrape surface the "
+                        "tpu-job-operator / tpu-scheduler manifests "
+                        "annotate")
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args(argv)
 
@@ -109,6 +117,11 @@ def main(argv=None) -> int:
 
     names = [c.strip() for c in args.controllers.split(",") if c.strip()]
     mgr = build_manager(client, names, store_path=args.store)
+    obs_server = None
+    if args.metrics_port:
+        from ..obs.http import ObsServer
+        obs_server = ObsServer(port=args.metrics_port)
+        log.info("metrics on :%d/metrics", obs_server.start())
     log.info("manager running %d controllers: %s", len(mgr.controllers),
              ", ".join(names))
     mgr.start_all()
@@ -119,6 +132,8 @@ def main(argv=None) -> int:
     stop.wait()
     log.info("shutting down")
     mgr.stop_all()
+    if obs_server is not None:
+        obs_server.stop()
     return 0
 
 

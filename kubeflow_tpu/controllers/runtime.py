@@ -27,8 +27,68 @@ from typing import Optional
 
 from ..api import k8s
 from ..cluster.client import KubeClient, Watch
+from ..obs import registry as obsreg
 
 log = logging.getLogger(__name__)
+
+
+def _reconcile_metrics(controller: str) -> tuple:
+    """(latency histogram child, error counter child, queue-depth gauge
+    child) for one controller — the per-stage accounting every hosted
+    reconciler gets for free from the manager loop. Resolved once per
+    Controller and held (the registry's resolve-once hot-path rule)."""
+    labels = ("controller",)
+    return (
+        obsreg.histogram(
+            "kftpu_reconcile_seconds",
+            "wall time of one reconcile pass",
+            labels=labels).labels(controller=controller),
+        obsreg.counter(
+            "kftpu_reconcile_errors_total",
+            "reconcile passes that raised (and were requeued)",
+            labels=labels).labels(controller=controller),
+        obsreg.gauge(
+            "kftpu_workqueue_depth",
+            "keys waiting in the controller workqueue",
+            labels=labels).labels(controller=controller),
+    )
+
+
+def ensure_trace_id(client: KubeClient, manifest: dict) -> dict:
+    """Mint a job's trace id on first control-plane contact and persist
+    it as the observability.kubeflow.org/trace-id annotation
+    (obs/trace.py). Idempotent: once written by ANY component —
+    scheduler pass or operator reconcile, whichever touches the job
+    first — everyone else reads. Shared here so the two sides of the
+    contract cannot drift (the binding_of pattern)."""
+    from ..cluster.client import NotFoundError
+    from ..obs.trace import TRACE_ID_ANNOTATION, mint_trace_id
+    if k8s.annotations_of(manifest).get(TRACE_ID_ANNOTATION):
+        return manifest
+    # uid-derived: concurrent minters agree without coordination
+    tid = mint_trace_id(str(manifest.get("metadata", {}).get("uid", "")))
+    try:
+        return client.patch(*k8s.key_of(manifest), {
+            "metadata": {"annotations": {TRACE_ID_ANNOTATION: tid}}})
+    except NotFoundError:
+        return manifest
+
+
+def trace_job_event(component: str, manifest: dict, name: str,
+                    **attrs) -> None:
+    """Append a point event to a job's trace from a control-plane
+    component (no-op without a span sink — KFTPU_SPAN_PATH unset — or
+    before the job has a trace id)."""
+    from ..obs.trace import TRACE_ID_ANNOTATION, default_tracer
+    tracer = default_tracer(component)
+    if tracer is None:
+        return
+    tid = k8s.annotations_of(manifest).get(TRACE_ID_ANNOTATION)
+    if not tid:
+        return
+    tracer.event(name, trace_id=tid,
+                 job=f"{k8s.namespace_of(manifest, 'default')}/"
+                     f"{k8s.name_of(manifest)}", **attrs)
 
 # A reconcile key: (namespace, name) of the primary object.
 Key = tuple[str, str]
@@ -116,6 +176,9 @@ class Controller:
     _stop: threading.Event = field(default_factory=threading.Event)
     _delayed: list[tuple[float, Key]] = field(default_factory=list)
     _last_resync: float = 0.0
+    # (latency, errors, depth) metric children — resolved on first use
+    # and held for the controller's lifetime (hot-path rule)
+    _metrics: Optional[tuple] = None
 
     # -- wiring -------------------------------------------------------------
 
@@ -183,6 +246,16 @@ class Controller:
         key = self.queue.pop()
         if key is None:
             return False
+        if self._metrics is None:
+            # label by the reconciler's IDENTITY, not its primary kind:
+            # the SliceScheduler's primary is also TPUJob, and merging
+            # its cluster-wide pass latencies into the operator's
+            # per-job histogram would poison both
+            self._metrics = _reconcile_metrics(
+                getattr(self.reconciler, "controller_name", None)
+                or (self.reconciler.primary[1] or "unknown").lower())
+        latency, errors, depth = self._metrics
+        t0 = time.perf_counter()
         try:
             res = self.reconciler.reconcile(self.client, key)
             self._retries.pop(key, None)
@@ -191,6 +264,7 @@ class Controller:
             elif res.requeue:
                 self.queue.add(key)
         except Exception as e:  # noqa: BLE001 - reconcile errors requeue
+            errors.inc()
             n = self._retries.get(key, 0) + 1
             self._retries[key] = n
             if n <= self.max_retries:
@@ -200,6 +274,10 @@ class Controller:
             else:
                 log.error("reconcile %s gave up after %d retries: %s",
                           key, self.max_retries, e)
+        finally:
+            # a failed pass's latency is still latency — observe both arms
+            latency.observe(time.perf_counter() - t0)
+            depth.set(len(self.queue))
         return True
 
     def run_pending(self, max_iters: int = 1000) -> int:

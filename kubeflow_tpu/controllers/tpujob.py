@@ -52,10 +52,18 @@ from ..api.trainingjob import (API_VERSIONS,
                                TrainingJob)
 from ..cluster.client import KubeClient, NotFoundError
 from ..cluster.fake import POD_GROUP_LABEL, TPU_RESOURCE
+from ..obs import registry as obsreg
+from ..obs.trace import SPAN_PATH_ENV, TRACE_ID_ANNOTATION, TRACE_ID_ENV
 from ..scheduler.inventory import POOL_LABEL, Placement, SliceRect
-from .runtime import Key, Reconciler, Result
+from .runtime import (Key, Reconciler, Result, ensure_trace_id,
+                      trace_job_event)
 
 log = logging.getLogger(__name__)
+
+# condition precedence for the exported phase gauge (newest-wins, the
+# dashboard's _job_phase walk plus Restarting)
+_PHASE_ORDER = (COND_SUCCEEDED, COND_FAILED, COND_RESTARTING, COND_RUNNING,
+                COND_QUEUED, COND_CREATED)
 
 
 def _now() -> float:
@@ -100,6 +108,9 @@ class TrainingJobReconciler(Reconciler):
         self.kind = kind
         self.primary = (API_VERSIONS[kind], kind)
         self.owns = [("v1", "Pod"), ("v1", "Service")]
+        # last exported phase per job key (the gang phase gauge clears a
+        # job's previous-phase series instead of exporting two phases)
+        self._exported_phase: dict[Key, str] = {}
 
     # ------------------------------------------------------------ reconcile
 
@@ -108,7 +119,10 @@ class TrainingJobReconciler(Reconciler):
         try:
             manifest = client.get(self.primary[0], self.kind, namespace, name)
         except NotFoundError:
+            self._export_phase(key, None)
             return Result()  # cascade GC removed the children with the owner
+        manifest = ensure_trace_id(client, manifest)
+        self._export_phase(key, manifest)
         job = TrainingJob.from_manifest(manifest)
 
         if k8s.condition_true(manifest, COND_SUCCEEDED) or \
@@ -247,6 +261,37 @@ class TrainingJobReconciler(Reconciler):
                 max(1.0, job.run_policy.stall_timeout_seconds / 2))
         return Result(requeue_after=min(requeue_in)) if requeue_in \
             else Result()
+
+    # ------------------------------------------------------- observability
+
+    def _export_phase(self, key: Key, manifest: dict | None) -> None:
+        """The gang phase gauge: kftpu_job_phase{...,phase}=1 for the
+        job's CURRENT phase only (the previous phase's series is
+        removed; a deleted job exports nothing)."""
+        g = obsreg.gauge(
+            "kftpu_job_phase",
+            "1 for the training job's current phase (condition walk)",
+            labels=("namespace", "name", "kind", "phase"))
+        namespace, name = key
+        prev = self._exported_phase.get(key)
+        phase = None
+        if manifest is not None:
+            phase = next((c for c in _PHASE_ORDER
+                          if k8s.condition_true(manifest, c)), "Pending")
+        if phase == prev:
+            return
+        if prev is not None:
+            g.remove(namespace=namespace, name=name, kind=self.kind,
+                     phase=prev)
+        if phase is None:
+            self._exported_phase.pop(key, None)
+            return
+        g.labels(namespace=namespace, name=name, kind=self.kind,
+                 phase=phase).set(1)
+        self._exported_phase[key] = phase
+
+    def _trace_event(self, manifest: dict, name: str, **attrs) -> None:
+        trace_job_event("operator", manifest, name, **attrs)
 
     # ---------------------------------------------------- slice scheduling
 
@@ -403,6 +448,19 @@ class TrainingJobReconciler(Reconciler):
         env = {"KFTPU_POD_NAME": name, "KFTPU_POD_NAMESPACE": job.namespace}
         if os.environ.get("KFTPU_APISERVER"):
             env["KFTPU_APISERVER"] = os.environ["KFTPU_APISERVER"]
+        # trace contract (obs/trace.py): the job's minted trace id rides
+        # into every worker so its window spans stitch onto the control
+        # plane's queued/bound/running events; the operator forwards its
+        # own span sink so workers write where the control plane does,
+        # unless the spec names one explicitly (obs_spec below wins)
+        trace_id = k8s.annotations_of(manifest).get(TRACE_ID_ANNOTATION)
+        if trace_id:
+            env[TRACE_ID_ENV] = trace_id
+        if os.environ.get(SPAN_PATH_ENV):
+            env[SPAN_PATH_ENV] = os.environ[SPAN_PATH_ENV]
+        # spec.observability → KFTPU_SPAN_PATH / KFTPU_OBS_METRICS_PORT:
+        # the worker's span sink and its own /metrics port
+        env.update(job.obs_spec.to_env())
         if job.checkpoint_dir:
             env["KFTPU_CHECKPOINT_DIR"] = job.checkpoint_dir
         if job.resume_from:
@@ -705,6 +763,14 @@ class TrainingJobReconciler(Reconciler):
         patched = client.patch(*k8s.key_of(manifest), patch) \
             if (patch["metadata"]["annotations"] or "spec" in patch) \
             else manifest
+        # counted AFTER the deletes/patch succeeded: a transient error in
+        # the side effects above requeues and re-runs this path, and the
+        # retry must not read as a second restart
+        obsreg.counter(
+            "kftpu_gang_restarts_total",
+            "whole-gang restarts by trigger (failed pod, vanish, resize, "
+            "stall)", labels=("kind", "reason")).labels(
+                kind=self.kind, reason=reason).inc()
         budget = (f" ({restarts + 1}/{job.run_policy.backoff_limit})"
                   if count_restart else " (not counted against backoff)")
         wait = f", next attempt in {delay:.1f}s" if delay else ""
@@ -775,6 +841,21 @@ class TrainingJobReconciler(Reconciler):
         k8s.set_condition(fresh, k8s.Condition(ctype, status, reason, message))
         client.update_status(fresh)
         manifest["status"] = fresh["status"]
+        # observability rides the idempotence guard: a condition TRANSITION
+        # is exactly one trace event (queued/created/running/succeeded/...)
+        # and one metrics update — steady-state reconciles emit nothing
+        self._trace_event(
+            manifest,
+            ctype.lower() if status == "True" else f"{ctype.lower()}-cleared",
+            reason=reason, message=message)
+        if status == "True" and ctype in (COND_SUCCEEDED, COND_FAILED):
+            obsreg.counter(
+                "kftpu_jobs_finished_total",
+                "training jobs reaching a terminal condition",
+                labels=("kind", "condition")).labels(
+                    kind=self.kind, condition=ctype).inc()
+        self._export_phase((k8s.namespace_of(manifest, "default"),
+                            k8s.name_of(manifest)), manifest)
 
     def _finalize_status(self, client: KubeClient, manifest: dict,
                          pods: list[dict], *, all_running: bool) -> None:
@@ -803,6 +884,11 @@ class TrainingJobReconciler(Reconciler):
                     COND_RUNNING, "True", "JobRunning",
                     "all replicas running"))
                 dirty = True
+                # the Running TRANSITION (guarded above) is the
+                # pod-start→running edge of the job's trace timeline
+                self._trace_event(fresh, "running", reason="JobRunning")
+                self._export_phase((k8s.namespace_of(fresh, "default"),
+                                    k8s.name_of(fresh)), fresh)
         if fresh.get("status", {}).get("replicaStatuses") != counts:
             fresh.setdefault("status", {})["replicaStatuses"] = counts
             dirty = True

@@ -48,6 +48,13 @@ class DevicePrefetcher:
         self.depth = int(depth)
         self._buf: deque = deque()
         self._exhausted = False
+        # staging rate for the shared registry: one inc per dispatched
+        # transfer (handle resolved once — _fill is per-batch)
+        from ..obs import registry as obsreg
+        self._obs_batches = obsreg.counter(
+            "kftpu_input_batches_total",
+            "batches delivered by each input-pipeline stage",
+            labels=("stage",)).labels(stage="device_put")
 
     @property
     def in_flight(self) -> int:
@@ -62,6 +69,7 @@ class DevicePrefetcher:
                 self._exhausted = True
                 return
             self._buf.append(self._place(item))
+            self._obs_batches.inc()
 
     def __iter__(self) -> "DevicePrefetcher":
         return self

@@ -166,6 +166,13 @@ class AugmentPool:
         # __init__ must still tear down cleanly instead of leaking the
         # ring segment and already-started workers
         self._closed = False
+        # input-stage rate for the shared registry (obs/registry.py):
+        # handle resolved once — __next__ is the per-batch hot path
+        from ..obs import registry as obsreg
+        self._obs_batches = obsreg.counter(
+            "kftpu_input_batches_total",
+            "batches delivered by each input-pipeline stage",
+            labels=("stage",)).labels(stage="augment")
         self._stop = threading.Event()
         self._feeder: Optional[threading.Thread] = None
         self._feed_error: Optional[BaseException] = None
@@ -257,6 +264,7 @@ class AugmentPool:
                 batch = self._copy_out(slot, n)
                 self._free.put(slot)
                 self._next_seq += 1
+                self._obs_batches.inc()
                 return batch
             total = self._feed_total
             if total is not None and self._next_seq >= total \

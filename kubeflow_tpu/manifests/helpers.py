@@ -19,7 +19,8 @@ def deployment(name: str, namespace: str, image: str, *,
                port: Optional[int] = None, replicas: int = 1,
                service_account: Optional[str] = None,
                resources: Optional[dict] = None,
-               labels: Optional[dict] = None) -> dict:
+               labels: Optional[dict] = None,
+               pod_annotations: Optional[dict] = None) -> dict:
     lbl = {**std_labels(name), **(labels or {})}
     container: dict = {"name": name, "image": image}
     if args:
@@ -30,11 +31,16 @@ def deployment(name: str, namespace: str, image: str, *,
         container["ports"] = [{"containerPort": port}]
     if resources:
         container["resources"] = resources
+    template_meta: dict = {"labels": lbl}
+    if pod_annotations:
+        # pod-template annotations (prometheus.io/scrape et al. —
+        # annotation-based discovery reads the POD, not the Deployment)
+        template_meta["annotations"] = dict(pod_annotations)
     spec: dict = {
         "replicas": replicas,
         "selector": {"matchLabels": {APP_LABEL: name}},
         "template": {
-            "metadata": {"labels": lbl},
+            "metadata": template_meta,
             "spec": {"containers": [container]},
         },
     }

@@ -15,6 +15,23 @@ from .registry import register
 VERSION = "v0.1.0"
 IMG = "ghcr.io/kubeflow-tpu"
 
+# the port the control-plane processes serve /metrics on (the
+# controller-manager / scheduler --metrics-port default the deployments
+# below render)
+METRICS_PORT = 8080
+
+
+def scrape_annotations(port: int, path: str = "/metrics") -> dict:
+    """The annotation-based Prometheus discovery contract every scrape
+    surface in the platform advertises (controller manager, scheduler,
+    model server, probers, workers via spec.observability.metricsPort) —
+    one helper so the keys cannot drift between components."""
+    return {
+        "prometheus.io/scrape": "true",
+        "prometheus.io/port": str(port),
+        "prometheus.io/path": path,
+    }
+
 
 @register("prometheus", "Prometheus deployment (gcp/prototypes/prometheus parity)")
 def prometheus(namespace: str = "kubeflow-monitoring") -> list[dict]:
@@ -48,10 +65,11 @@ def metric_collector(namespace: str = "kubeflow",
     dep = H.deployment("metric-collector", namespace,
                        f"{IMG}/metric-collector:{VERSION}", port=8000,
                        env={"TARGET_URL": target_url,
-                            "PROBE_INTERVAL_S": "30"})
+                            "PROBE_INTERVAL_S": "30"},
+                       pod_annotations=scrape_annotations(8000))
     svc = H.service("metric-collector", namespace, 8000)
-    svc["metadata"].setdefault("annotations", {})[
-        "prometheus.io/scrape"] = "true"
+    svc["metadata"].setdefault("annotations", {}).update(
+        scrape_annotations(8000))
     return [dep, svc]
 
 
@@ -65,10 +83,11 @@ def deploy_prober(namespace: str = "kubeflow",
     dep = H.deployment("deploy-prober", namespace,
                        f"{IMG}/deploy-prober:{VERSION}", port=8000,
                        env={"BOOTSTRAP_URL": bootstrap_url,
-                            "PROBE_INTERVAL_S": str(interval_s)})
+                            "PROBE_INTERVAL_S": str(interval_s)},
+                       pod_annotations=scrape_annotations(8000))
     svc = H.service("deploy-prober", namespace, 8000)
-    svc["metadata"].setdefault("annotations", {})[
-        "prometheus.io/scrape"] = "true"
+    svc["metadata"].setdefault("annotations", {}).update(
+        scrape_annotations(8000))
     return [dep, svc]
 
 

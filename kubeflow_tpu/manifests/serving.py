@@ -28,6 +28,7 @@ def tpu_serving(namespace: str = "kubeflow", name: str = "model-server",
                 enable_http_proxy: bool = True, enable_hpa: bool = False,
                 hpa_min: int = 1, hpa_max: int = 4,
                 reload_interval_s: int = 30) -> list[dict]:
+    from .observability import scrape_annotations
     lbl = {**H.std_labels(name), "kubeflow.org/servable": model_name}
     dep = H.deployment(
         name, namespace, f"{IMG}/tpu-model-server:{MODEL_SERVER_VERSION}",
@@ -35,7 +36,9 @@ def tpu_serving(namespace: str = "kubeflow", name: str = "model-server",
         args=[f"--model-path={model_path}", f"--model-name={model_name}",
               "--grpc-port=9000", "--rest-port=8500",
               f"--reload-interval={reload_interval_s}"],
-        labels=lbl, port=9000)
+        labels=lbl, port=9000,
+        # the model server's /metrics rides the REST port
+        pod_annotations=scrape_annotations(8500))
     pod_spec = dep["spec"]["template"]["spec"]
     if model_path:
         # persistent XLA compile cache next to the model: replica

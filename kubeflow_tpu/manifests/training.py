@@ -65,6 +65,14 @@ def _job_schema(specs_key: str, max_one: list[str]) -> dict:
             "priority": {"type": "integer"},
             "preemptible": {"type": "boolean"},
         }},
+        # observability knobs (api/trainingjob.py ObsSpec → the worker's
+        # KFTPU_SPAN_PATH span sink and KFTPU_OBS_METRICS_PORT /metrics
+        # port; tests/test_lint.py enforces the same full-path rule)
+        "observability": {"type": "object", "properties": {
+            "spanPath": {"type": "string"},
+            "metricsPort": {"type": "integer", "minimum": 0,
+                            "maximum": 65535},
+        }},
     }
     return {"type": "object",
             "properties": {"spec": {"type": "object", "properties": props}}}
@@ -86,12 +94,15 @@ def _operator_deployment(namespace: str, gang_scheduling: bool) -> list[dict]:
     ])
     binding = H.cluster_role_binding("tpu-job-operator", "tpu-job-operator",
                                      "tpu-job-operator", namespace)
-    args = ["--controller=trainingjobs"]
+    from .observability import METRICS_PORT, scrape_annotations
+    args = ["--controller=trainingjobs",
+            f"--metrics-port={METRICS_PORT}"]
     if gang_scheduling:
         args.append("--enable-gang-scheduling")
     dep = H.deployment("tpu-job-operator", namespace,
                        f"{IMG}/tpu-job-operator:{VERSION}", args=args,
-                       service_account="tpu-job-operator", port=8443)
+                       service_account="tpu-job-operator", port=8443,
+                       pod_annotations=scrape_annotations(METRICS_PORT))
     cm = H.config_map("tpu-job-operator-config", namespace, {
         "gang-scheduling": str(gang_scheduling).lower(),
         "coordinator-port": "8476",
@@ -192,10 +203,13 @@ def tpu_scheduler(namespace: str = "kubeflow",
             "backfill": backfill, "preemption": preemption,
             "queues": queues or {}}, indent=1),
     })
+    from .observability import METRICS_PORT, scrape_annotations
     dep = H.deployment("tpu-scheduler", namespace,
                        f"{IMG}/tpu-job-operator:{VERSION}",
-                       args=["--controllers=scheduler"],
-                       service_account="tpu-scheduler", port=8443)
+                       args=["--controllers=scheduler",
+                             f"--metrics-port={METRICS_PORT}"],
+                       service_account="tpu-scheduler", port=8443,
+                       pod_annotations=scrape_annotations(METRICS_PORT))
     return [sa, role, binding, cm, dep]
 
 
@@ -232,7 +246,9 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
                    stall_timeout_seconds: int | None = None,
                    queue: str | None = None,
                    priority: int | None = None,
-                   preemptible: bool | None = None) -> list[dict]:
+                   preemptible: bool | None = None,
+                   span_path: str | None = None,
+                   obs_metrics_port: int | None = None) -> list[dict]:
     """fused_blocks opts into the ghost-BN fused bottleneck kernels
     (docs/training.md --fused-blocks; per-block batch/spatial routing).
     ``fused_routing`` pins the per-geometry kernel routing to a
@@ -267,7 +283,12 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
     ``preemptible`` gang may be reclaimed (checkpoint + requeue) for a
     higher-priority job (docs/operations.md "Scheduling, queues, and
     quotas"). Leave all three unset (None) for the legacy
-    immediate-create path."""
+    immediate-create path.
+
+    ``span_path``/``obs_metrics_port`` render spec.observability
+    (api/trainingjob.py ObsSpec → KFTPU_SPAN_PATH /
+    KFTPU_OBS_METRICS_PORT): the worker's trace-span JSONL sink and its
+    own /metrics port (docs/operations.md "Observability")."""
     command = ["python", "-m", "kubeflow_tpu.runtime.worker",
                "--workload", "resnet50",
                "--steps", str(steps),
@@ -339,6 +360,12 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
                                   preemptible=bool(preemptible))
         policy.validate()
         job["spec"]["schedulingPolicy"] = policy.to_dict()
+    if span_path is not None or obs_metrics_port is not None:
+        from ..api.trainingjob import ObsSpec
+        ospec = ObsSpec(span_path=span_path,
+                        metrics_port=obs_metrics_port)
+        ospec.validate()
+        job["spec"]["observability"] = ospec.to_dict()
     out.append(job)
     return out
 

@@ -1,0 +1,23 @@
+"""Unified observability layer: shared metrics registry, trace spans,
+and the ``/metrics`` scrape surface (ISSUE 5).
+
+- ``obs.registry`` — dependency-free Prometheus-text Counter / Gauge /
+  Histogram families; a process-wide default registry every in-process
+  component instruments.
+- ``obs.trace`` — one trace id per TPUJob, propagated annotation → env
+  → worker, with every component appending JSONL spans to a shared sink
+  so a job's queued → bound → running → windows → done timeline
+  reconstructs end to end.
+- ``obs.http`` — ``/metrics`` + ``/healthz`` over a registry.
+
+jax-free and stdlib-only: the scheduler and operator processes import
+this without pulling the runtime in.
+"""
+
+from .registry import (DEFAULT_BUCKETS, OBS_DISABLE_ENV,  # noqa: F401
+                       Registry, counter, default_registry, gauge,
+                       histogram, reset_default_registry)
+from .trace import (SPAN_PATH_ENV, TRACE_ID_ANNOTATION,  # noqa: F401
+                    TRACE_ID_ENV, SpanWriter, default_tracer, load_spans,
+                    mint_trace_id, reconstruct, reset_default_tracers)
+from .http import ObsServer  # noqa: F401

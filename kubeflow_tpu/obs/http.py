@@ -1,0 +1,65 @@
+"""The scrape surface: ``/metrics`` (+``/healthz``) over a registry.
+
+Every long-running process grows the same two endpoints the serving
+stack already had: the controller manager and the scheduler via
+``python -m kubeflow_tpu.controllers --metrics-port``, workers via
+``spec.observability.metricsPort``, probers via the support
+MetricsServer. stdlib only — mirrors webapps/_http.py's threaded-server
+lifecycle without making the base ``obs`` layer depend on webapps.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .registry import Registry, default_registry
+
+
+class ObsServer:
+    """Serves ``registry.render()`` on ``/metrics`` and a liveness
+    ``/healthz``; daemon thread, ephemeral port when ``port=0``."""
+
+    def __init__(self, registry: Optional[Registry] = None,
+                 host: str = "0.0.0.0", port: int = 0,
+                 name: str = "obs-metrics"):
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.name = name
+        registry_ref = self.registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path == "/metrics":
+                    self._send(200, registry_ref.render().encode(),
+                               "text/plain; version=0.0.4")
+                elif path in ("/healthz", ""):
+                    self._send(200, b'{"ok": true}', "application/json")
+                else:
+                    self._send(404, b"not found", "text/plain")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name=self.name)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,267 @@
+"""Cross-layer trace spans: one trace id per TPUJob, JSONL span records.
+
+The scaling wins Podracer (arXiv:2104.06272) and TF-Replicator
+(arXiv:1902.00465) attribute to per-stage accounting need the stages
+STITCHED: a job's queue wait, its pod start, its first step, and every
+training window must reconstruct as ONE timeline. The contract:
+
+- A ``trace_id`` is minted the first time the control plane touches a
+  TPUJob (scheduler pass or operator reconcile — whichever sees it
+  first) and persisted as the ``observability.kubeflow.org/trace-id``
+  annotation, so every later actor agrees on it.
+- The operator renders it into every worker pod as ``KFTPU_TRACE_ID``
+  (next to the pod-identity env), and forwards its own
+  ``KFTPU_SPAN_PATH`` so workers write spans where the operator does.
+- Every component appends span records to that JSONL sink:
+  ``{"trace_id", "span_id", "parent_id", "name", "component",
+  "start", "end", "attrs"}`` — wall-clock seconds, so spans from
+  different processes order on one axis. Point events (queued, bound,
+  running) are zero-duration spans.
+- ``reconstruct()`` reads the sink back into the end-to-end timeline:
+  queued → bound → pod-start → running → windows → done. The dashboard
+  serves it at ``/api/obs/jobs/<ns>/<name>``; tests and ``bench.py
+  --mode obs`` assert on it.
+
+Writers are append-only and line-atomic (one ``write()`` per record), so
+scheduler, operator, and in-process workers can share a sink file the
+way the chaos/scheduler soaks share a FakeCluster. jax-free, stdlib
+only; the jax.profiler capture (``runtime/metrics.py profile_trace``)
+hooks in as a child span around its start/stop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Optional
+
+# env contract (rendered by controllers/tpujob.py into worker pods;
+# tests/test_lint.py pins the plumbing)
+TRACE_ID_ENV = "KFTPU_TRACE_ID"
+SPAN_PATH_ENV = "KFTPU_SPAN_PATH"
+
+# where the minted trace id persists on the job object (the one value
+# every component — scheduler, operator, worker, dashboard — agrees on)
+TRACE_ID_ANNOTATION = "observability.kubeflow.org/trace-id"
+
+
+def mint_trace_id(uid: str = "") -> str:
+    """A fresh trace id — DERIVED from the object's uid when one exists,
+    so concurrent minters (the scheduler pass and the operator both
+    waking on the same ADDED event) compute the SAME id and neither
+    side's early spans are orphaned by a lost patch race."""
+    if uid:
+        import hashlib
+        return hashlib.sha1(uid.encode()).hexdigest()[:16]
+    return uuid.uuid4().hex[:16]
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class _SpanCtx:
+    """Context manager for a timed span; emits on exit (errors included —
+    a failed phase's duration is still its duration)."""
+
+    def __init__(self, writer: "SpanWriter", name: str,
+                 trace_id: Optional[str], parent_id: Optional[str],
+                 attrs: dict):
+        self._writer = writer
+        self.name = name
+        self.trace_id = trace_id
+        self.parent_id = parent_id
+        self.span_id = new_span_id()
+        self.attrs = attrs
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_SpanCtx":
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, etype, evalue, tb) -> None:
+        if etype is not None:
+            self.attrs.setdefault("error", f"{etype.__name__}: {evalue}")
+        self._writer.emit(self.name, start=self._t0, end=time.time(),
+                          trace_id=self.trace_id, span_id=self.span_id,
+                          parent_id=self.parent_id, **self.attrs)
+
+
+class SpanWriter:
+    """Appends span records to a JSONL sink. One writer per component per
+    process; ``trace_id`` may be bound at construction (workers — one job
+    per process) or passed per record (control plane — many jobs)."""
+
+    def __init__(self, path: str, component: str,
+                 trace_id: Optional[str] = None):
+        self.path = path
+        self.component = component
+        self.trace_id = trace_id
+        self._lock = threading.Lock()
+        self._fh = None
+        self._warned = False
+
+    @classmethod
+    def from_env(cls, component: str,
+                 env: Optional[dict] = None) -> Optional["SpanWriter"]:
+        """A writer for the operator-rendered span contract, or None when
+        this process has no sink configured (spans off — zero cost)."""
+        env = os.environ if env is None else env
+        path = env.get(SPAN_PATH_ENV)
+        if not path:
+            return None
+        return cls(path, component, trace_id=env.get(TRACE_ID_ENV))
+
+    # ------------------------------------------------------------- emission
+
+    def emit(self, name: str, *, start: float, end: Optional[float] = None,
+             trace_id: Optional[str] = None, span_id: Optional[str] = None,
+             parent_id: Optional[str] = None, **attrs) -> dict:
+        record = {
+            "trace_id": trace_id or self.trace_id or "",
+            "span_id": span_id or new_span_id(),
+            "parent_id": parent_id or "",
+            "name": name,
+            "component": self.component,
+            "start": round(start, 6),
+            "end": round(end if end is not None else start, 6),
+        }
+        if attrs:
+            record["attrs"] = attrs
+        line = json.dumps(record) + "\n"
+        # observability must never kill the work it observes: an
+        # unwritable sink (full volume, revoked mount) drops the record
+        # — warned once — and the closed handle means the next emit
+        # retries the open, so spans resume when the sink recovers
+        with self._lock:
+            try:
+                if self._fh is None:
+                    d = os.path.dirname(self.path)
+                    if d:
+                        os.makedirs(d, exist_ok=True)
+                    self._fh = open(self.path, "a")
+                self._fh.write(line)
+                self._fh.flush()
+            except OSError as e:
+                if not self._warned:
+                    self._warned = True
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "span sink %s unwritable (%s); dropping spans "
+                        "until it recovers", self.path, e)
+                if self._fh is not None:
+                    try:
+                        self._fh.close()
+                    except OSError:
+                        pass
+                    self._fh = None
+        return record
+
+    def event(self, name: str, trace_id: Optional[str] = None,
+              **attrs) -> dict:
+        """A point event (zero-duration span): phase transitions."""
+        return self.emit(name, start=time.time(), trace_id=trace_id, **attrs)
+
+    def span(self, name: str, trace_id: Optional[str] = None,
+             parent_id: Optional[str] = None, **attrs) -> _SpanCtx:
+        """``with writer.span("restore"): ...`` — timed child span."""
+        return _SpanCtx(self, name, trace_id or self.trace_id, parent_id,
+                        dict(attrs))
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+# One cached writer per component so the control-plane reconcilers can
+# instrument without threading a writer through every constructor. The
+# cache is bounded by the component count: when the env sink changes
+# (tests/bench pointing successive runs at fresh tmp sinks), the stale
+# writer is CLOSED and replaced — never accumulated as a leaked fd.
+_writers: dict = {}   # component -> (path, SpanWriter)
+_writers_lock = threading.Lock()
+
+
+def default_tracer(component: str) -> Optional[SpanWriter]:
+    path = os.environ.get(SPAN_PATH_ENV)
+    if not path:
+        return None
+    with _writers_lock:
+        cached = _writers.get(component)
+        if cached is not None:
+            old_path, w = cached
+            if old_path == path:
+                return w
+            w.close()
+        w = SpanWriter(path, component)
+        _writers[component] = (path, w)
+        return w
+
+
+def reset_default_tracers() -> None:
+    """Close and drop every cached control-plane writer — the trace
+    analog of registry.reset_default_registry()."""
+    with _writers_lock:
+        for _, w in _writers.values():
+            w.close()
+        _writers.clear()
+
+
+# -------------------------------------------------------------- reading back
+
+def load_spans(path: str, trace_id: Optional[str] = None) -> list[dict]:
+    """All span records in the sink (optionally one trace's), sorted by
+    (start, end) so the list reads as the timeline. Torn/garbage lines
+    are skipped — a reader must cope with a writer mid-append."""
+    out: list[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict) or "name" not in rec:
+                    continue
+                if trace_id is None or rec.get("trace_id") == trace_id:
+                    out.append(rec)
+    except OSError:
+        return []
+    out.sort(key=lambda r: (r.get("start", 0.0), r.get("end", 0.0)))
+    return out
+
+
+def reconstruct(path: str, trace_id: str) -> dict:
+    """One job's end-to-end timeline from the JSONL alone:
+    ``{"traceId", "events": [ordered spans], "names": [...],
+    "wallSeconds"}``. ``names`` is the phase fingerprint tests assert
+    against (queued → bound → created → running → window... → done)."""
+    spans = load_spans(path, trace_id=trace_id)
+    events = [{
+        "name": s["name"],
+        "component": s.get("component", ""),
+        "start": s.get("start", 0.0),
+        "end": s.get("end", s.get("start", 0.0)),
+        "durationSeconds": round(
+            max(0.0, s.get("end", 0.0) - s.get("start", 0.0)), 6),
+        "attrs": s.get("attrs", {}),
+    } for s in spans]
+    # max(end) - min(start), not last-by-start's end: an early-started
+    # long span (the whole-run profile capture) may outlive every later
+    # point event
+    wall = (max(s.get("end", 0.0) for s in spans)
+            - min(s.get("start", 0.0) for s in spans)) if spans else 0.0
+    return {"traceId": trace_id, "events": events,
+            "names": [e["name"] for e in events],
+            "wallSeconds": round(max(0.0, wall), 6)}

@@ -35,7 +35,19 @@ from typing import Any, Callable, Optional
 
 import jax
 
+from ..obs import registry as obsreg
+
 log = logging.getLogger(__name__)
+
+
+def _obs_duration(op: str):
+    """Histogram child for one checkpoint operation (save submission,
+    restore, verify) — the durations the recovery paths spend."""
+    return obsreg.histogram(
+        "kftpu_checkpoint_seconds",
+        "checkpoint operation wall time by op (save = synchronous "
+        "submission of the async write; restore; verify = manifest "
+        "crc pass)", labels=("op",)).labels(op=op)
 
 try:
     import orbax.checkpoint as ocp
@@ -158,6 +170,7 @@ class CheckpointManager:
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         if self.save_delay_s > 0:
             time.sleep(self.save_delay_s)
+        t0 = time.perf_counter()
         delay = self.retry_backoff_s
         for attempt in range(self.save_retries + 1):
             try:
@@ -183,6 +196,7 @@ class CheckpointManager:
         if saved:
             log.info("checkpoint saved at step %d -> %s", step, self.directory)
             self._pending_manifest.add(step)
+            _obs_duration("save").observe(time.perf_counter() - t0)
         return saved
 
     def wait(self) -> None:
@@ -243,7 +257,9 @@ class CheckpointManager:
                 return True, "verified (cached)"
             self._intact_cache.discard(step)   # pruned by max_to_keep
             return False, "missing"
+        t0 = time.perf_counter()
         ok, reason = verify_step_dir(step_dir)
+        _obs_duration("verify").observe(time.perf_counter() - t0)
         if ok and os.path.exists(os.path.join(step_dir, MANIFEST_NAME)):
             # cache manifest-backed positives only: a committed step
             # without a manifest may gain one later (async flush)
@@ -287,7 +303,10 @@ class CheckpointManager:
                 raise ValueError(
                     f"checkpoint step {step} in {self.directory} is not "
                     f"intact: {reason}")
-            return restore_fn(step)
+            t0 = time.perf_counter()
+            out = restore_fn(step)
+            _obs_duration("restore").observe(time.perf_counter() - t0)
+            return out
         last_err: Optional[BaseException] = None
         # newest-first, verifying LAZILY: older steps only pay their
         # verification cost if every newer candidate was rejected
@@ -298,7 +317,10 @@ class CheckpointManager:
                             candidate, reason)
                 continue
             try:
-                return restore_fn(candidate)
+                t0 = time.perf_counter()
+                out = restore_fn(candidate)
+                _obs_duration("restore").observe(time.perf_counter() - t0)
+                return out
             except Exception as e:  # noqa: BLE001 — fall back to prior step
                 last_err = e
                 log.warning("restore of step %d failed (%s); falling back "

@@ -17,6 +17,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..obs import registry as obsreg
+
 log = logging.getLogger(__name__)
 
 # env contract: where the worker streams per-step JSONL so external
@@ -51,6 +53,18 @@ class HeartbeatReporter:
         self.pod = pod
         self.interval_s = interval_s
         self._last = 0.0
+        # last SUCCESSFUL beat as gauges: a scrape shows a hung chief
+        # (beat age growing past stallTimeoutSeconds) BEFORE the
+        # controller watchdog acts — alerting can fire on
+        # time() - kftpu_heartbeat_last_time_seconds without apiserver
+        # access to the annotation
+        self._g_time = obsreg.gauge(
+            "kftpu_heartbeat_last_time_seconds",
+            "unix time of the last heartbeat annotation patch that "
+            "succeeded")
+        self._g_step = obsreg.gauge(
+            "kftpu_heartbeat_last_step",
+            "training step advertised by the last successful heartbeat")
 
     @classmethod
     def from_env(cls, client=None, env: Optional[dict] = None,
@@ -95,6 +109,8 @@ class HeartbeatReporter:
                         self.namespace, self.pod, e)
             return False
         self._last = now
+        self._g_time.set(now)
+        self._g_step.set(int(step))
         return True
 
 
@@ -131,6 +147,19 @@ class MetricsLogger:
         if tensorboard_dir:
             from ..utils.tbevents import EventWriter
             self._tb = EventWriter(tensorboard_dir)
+        # shared-registry mirror of the JSONL stream (obs/registry.py):
+        # handles resolved ONCE here — record_window is on the worker
+        # loop's window edge, so its obs cost must stay at a few lock'd
+        # float ops (bench.py --mode obs holds the <1%-of-step-time line)
+        self._obs_step = obsreg.histogram(
+            "kftpu_step_seconds",
+            "per-device-step wall time (window average)")
+        self._obs_eps = obsreg.gauge(
+            "kftpu_examples_per_sec",
+            "training throughput over the last closed window")
+        self._obs_windows = obsreg.counter(
+            "kftpu_train_windows_total",
+            "closed timing windows (one host sync each)")
 
     def start_step(self) -> None:
         self._last_t = time.perf_counter()
@@ -166,6 +195,9 @@ class MetricsLogger:
             examples_per_sec=(self.batch_size / dt) if dt > 0 else 0.0,
             metrics=scalars, window=max(n_steps, 1))
         self.history.append(stats)
+        self._obs_step.observe(dt)
+        self._obs_eps.set(stats.examples_per_sec)
+        self._obs_windows.inc()
         if self._fh:
             self._fh.write(json.dumps(stats.to_dict()) + "\n")
             self._fh.flush()
@@ -197,11 +229,18 @@ class MetricsLogger:
 
     def summary(self, warmup: int = 1) -> dict[str, float]:
         """Steady-state throughput, skipping compile/warmup records.
-        Window records are weighted by the number of steps they cover."""
-        steady = self.history[warmup:] if len(self.history) > warmup \
-            else self.history
-        if not steady:
+        Window records are weighted by the number of steps they cover.
+
+        Degrades gracefully when fewer than ``warmup + 1`` windows were
+        recorded (short runs, a run preempted inside warmup): drop as
+        many leading warmup windows as the history affords while always
+        keeping at least the final window — never an empty slice whose
+        zero sums would divide into the throughput, and never the old
+        fallback of silently averaging the compile window back in."""
+        if not self.history:
             return {"steps": 0, "examples_per_sec": 0.0, "mean_step_time_s": 0.0}
+        start = min(max(int(warmup), 0), len(self.history) - 1)
+        steady = self.history[start:]
         n = sum(s.window for s in steady)
         t = sum(s.step_time_s * s.window for s in steady)
         first = self.history[0] if self.history else None
@@ -270,17 +309,24 @@ class AsyncWindowFetch:
 
 
 @contextlib.contextmanager
-def profile_trace(out_dir: Optional[str], enabled: bool = True):
+def profile_trace(out_dir: Optional[str], enabled: bool = True,
+                  tracer=None):
     """Capture an XLA/JAX profiler trace around a block (view in XProf /
-    tensorboard-plugin-profile)."""
+    tensorboard-plugin-profile). With a ``tracer`` (obs/trace.py
+    SpanWriter) the capture is recorded as a child span of the job's
+    trace — the timeline links "this window was slow" to "a profiler
+    capture covers it" — with the trace dir in the span attrs."""
     if not (enabled and out_dir):
         yield
         return
     import jax
     os.makedirs(out_dir, exist_ok=True)
-    jax.profiler.start_trace(out_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
-        log.info("profiler trace written to %s", out_dir)
+    span = tracer.span("profile", out_dir=out_dir) \
+        if tracer is not None else contextlib.nullcontext()
+    with span:
+        jax.profiler.start_trace(out_dir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
+            log.info("profiler trace written to %s", out_dir)

@@ -195,6 +195,8 @@ def train(
     weight_update: Optional[str] = None,
     input_workers: Optional[int] = None,
     device_prefetch: Optional[int] = None,
+    span_path: Optional[str] = None,
+    obs_metrics_port: Optional[int] = None,
 ) -> TrainResult:
     # before any jit: warm restarts must hit the persistent cache for the
     # very first compile (the startup→first-step dominator, PERF.md)
@@ -456,6 +458,47 @@ def train(
                 builder.place_batch(spec.batch_fn(brng, global_batch)))
 
     start_step = int(state.step)
+    # trace spans (obs/trace.py): the worker end of the job's end-to-end
+    # timeline. The operator renders KFTPU_TRACE_ID (minted at admission)
+    # and KFTPU_SPAN_PATH / spec.observability.spanPath into the pod; a
+    # bare-metal run with --span-path mints its own trace id. None = no
+    # sink configured, spans off at zero cost. Created HERE, after every
+    # failure-prone setup stage (data pipeline, device placement): the
+    # only cleanup path is the loop's finally, so nothing that can raise
+    # may sit between creation and the try below — an earlier creation
+    # would leak the bound port and span fd on a setup failure.
+    from ..obs.trace import SPAN_PATH_ENV, TRACE_ID_ENV, SpanWriter, \
+        mint_trace_id
+    span_path = span_path or os.environ.get(SPAN_PATH_ENV)
+    tracer = None
+    if span_path:
+        tracer = SpanWriter(span_path, "worker",
+                            trace_id=os.environ.get(TRACE_ID_ENV)
+                            or mint_trace_id())
+    # the worker's own scrape surface (spec.observability.metricsPort →
+    # KFTPU_OBS_METRICS_PORT → --obs-metrics-port): /metrics over the
+    # process default registry — step/window timings, input-stage rates,
+    # checkpoint durations, heartbeat freshness
+    if obs_metrics_port is None:
+        obs_metrics_port = _env_int("KFTPU_OBS_METRICS_PORT", 0)
+    obs_server = None
+    if obs_metrics_port:
+        from ..obs.http import ObsServer
+        try:
+            obs_server = ObsServer(port=obs_metrics_port)
+            obs_server.start()
+        except (OSError, OverflowError) as e:
+            # observability must never kill training: a taken port
+            # (second in-process train(), hostNetwork clash) or an
+            # out-of-range one from the raw env/CLI path costs the
+            # scrape surface, nothing else
+            log.warning("obs metrics server on :%d failed: %s",
+                        obs_metrics_port, e)
+            obs_server = None
+    if tracer is not None:
+        tracer.event("train-start", workload=spec.name,
+                     start_step=start_step, steps=steps,
+                     process=ctx.process_id)
     last_metrics: dict = {}
     guard = PreemptionGuard(install=handle_sigterm)
     preempted = False
@@ -470,7 +513,8 @@ def train(
     afetch = AsyncWindowFetch(lag=1)
     loop_error: Optional[BaseException] = None
     try:
-        with profile_trace(profile_dir, enabled=profile_dir is not None):
+        with profile_trace(profile_dir, enabled=profile_dir is not None,
+                           tracer=tracer):
             window = 0
             win_t0 = time.perf_counter()
             for step in range(start_step, steps):
@@ -503,6 +547,14 @@ def train(
                     # drain: their reported metrics must be complete.
                     afetch.submit(step + 1, window, t_now - win_t0,
                                   {**metrics, "learning_rate": lr_fn(step)})
+                    if tracer is not None:
+                        # one span per closed window, timed by the loop
+                        # itself (no device fetch): the per-window beat
+                        # of the job's end-to-end timeline
+                        now_w = time.time()
+                        tracer.emit("window",
+                                    start=now_w - (t_now - win_t0),
+                                    end=now_w, step=step + 1, steps=window)
                     for s, w, wall, vals in afetch.drain(
                             force=final or will_ckpt or will_eval
                             or stopping):
@@ -558,6 +610,18 @@ def train(
         if eval_source is not None:
             eval_source.close()
         guard.uninstall()
+        if tracer is not None:
+            attrs = {"preempted": preempted}
+            if loop_error is not None:
+                attrs["error"] = f"{type(loop_error).__name__}: {loop_error}"
+            try:
+                attrs["step"] = int(state.step)
+            except Exception:  # noqa: BLE001 — a dead backend mid-error
+                pass           # handling must not mask the loop error
+            tracer.event("train-done", **attrs)
+            tracer.close()
+        if obs_server is not None:
+            obs_server.stop()
         save_error: Optional[Exception] = None
         if ckpt is not None:
             try:
@@ -628,6 +692,16 @@ def main(argv=None) -> int:
                         "$KFTPU_TB_DIR; the tensorboard component's "
                         "--logdir)")
     p.add_argument("--profile-dir")
+    p.add_argument("--span-path", default=None,
+                   help="JSONL sink for trace spans (defaults to "
+                        "$KFTPU_SPAN_PATH; the operator renders "
+                        "spec.observability.spanPath and the job's "
+                        "$KFTPU_TRACE_ID so worker windows stitch onto "
+                        "the control plane's queued/bound/running "
+                        "timeline — docs/operations.md Observability)")
+    p.add_argument("--obs-metrics-port", type=int, default=None,
+                   help="serve this worker's /metrics here (defaults to "
+                        "$KFTPU_OBS_METRICS_PORT or off)")
     p.add_argument("--sync-every", type=int, default=10,
                    help="host-sync (and metric-fetch) interval in steps")
     p.add_argument("--data-dir",
@@ -697,6 +771,8 @@ def main(argv=None) -> int:
         checkpoint_every=args.checkpoint_every, resume=not args.no_resume,
         resume_from=args.resume_from,
         metrics_path=args.metrics_path, profile_dir=args.profile_dir,
+        span_path=args.span_path,
+        obs_metrics_port=args.obs_metrics_port,
         tensorboard_dir=args.tensorboard_dir,
         workload_kwargs=workload_kwargs, sync_every=args.sync_every,
         data_dir=args.data_dir,

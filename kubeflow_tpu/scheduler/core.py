@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import json
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -40,7 +41,9 @@ from ..api.trainingjob import (BINDING_ANNOTATION, COND_FAILED,
                                SCHED_STATE_ANNOTATION, TPU_API_VERSION,
                                TrainingJob)
 from ..cluster.client import KubeClient, NotFoundError
-from ..controllers.runtime import Key, Reconciler, Result
+from ..controllers.runtime import (Key, Reconciler, Result,
+                                   ensure_trace_id, trace_job_event)
+from ..obs import registry as obsreg
 from .inventory import Placement, SliceInventory
 from .queue import (JobRequest, SchedulerConfig, binding_matches,
                     binding_of, ordered, over_quota, request_of)
@@ -184,7 +187,18 @@ class SliceScheduler(Reconciler):
         self._explicit_config = config
         self._cm_rv: Optional[str] = None
         self._cm_config = SchedulerConfig()
+        # when each still-queued job was first seen waiting: feeds the
+        # queue-wait histogram at bind time and the "queued" trace event
+        # exactly once per wait (a preempted job re-enters and waits
+        # again — that is a second, separately measured wait)
+        self._queued_since: dict[str, float] = {}
+        # queues ever exported, so a queue that drains to zero exports
+        # zeros instead of its stale last depth
+        self._known_queues: set = set()
         self.primary = (TPU_API_VERSION, "TPUJob")
+        # reconcile-metrics label (controllers/runtime.py): the primary
+        # kind is TPUJob here too, and the operator owns that label
+        self.controller_name = "scheduler"
         # Node events (pool added/drained) re-plan too; map_event routes
         # them to a synthetic pass key since nodes carry no owner ref
         self.owns = [("v1", "Node")]
@@ -223,6 +237,7 @@ class SliceScheduler(Reconciler):
 
     def reconcile(self, client: KubeClient, key: Key) -> Result:
         del key  # every pass is cluster-wide
+        t_pass = time.perf_counter()
         self._refresh_config(client)
         inventory = SliceInventory.from_nodes(client.list("v1", "Node"))
         queued: list[JobRequest] = []
@@ -240,6 +255,7 @@ class SliceScheduler(Reconciler):
             req = request_of(job, manifest)
             if req is None:
                 continue   # not scheduler-managed
+            manifest = ensure_trace_id(client, manifest)
             manifests[req.key] = manifest
             placement = binding_of(manifest)
             ok = placement is not None \
@@ -268,17 +284,106 @@ class SliceScheduler(Reconciler):
                                       "rebinding: binding no longer "
                                       "matches spec/pools", binding=None)
                 queued.append(req)
+        self._note_queued(queued, manifests)
         decisions = plan(queued, bound, inventory, self.config)
+        # metrics/events fire AFTER their patch succeeded (the same
+        # invariant as the operator's gang-restart counter): a transient
+        # apiserver error requeues the whole pass, and the retry must
+        # not double-count a preemption or observe a bogus second wait
         for victim in decisions.preempts:
             self._apply_preempt(client, manifests[victim.key])
+            obsreg.counter(
+                "kftpu_sched_preemptions_total",
+                "gangs reclaimed (requeued, not failed) for "
+                "higher-priority work", labels=("queue",)).labels(
+                    queue=victim.queue).inc()
+            self._trace_event(manifests[victim.key], "preempted",
+                              queue=victim.queue, chips=victim.chips)
+        now = time.time()
         for req, placement in decisions.binds:
             self._patch_state(client, manifests[req.key], STATE_BOUND,
                               "bound", binding=placement)
+            waited = now - self._queued_since.pop(req.key, now)
+            obsreg.histogram(
+                "kftpu_sched_queue_wait_seconds",
+                "admission→bind wait per gang (preempted gangs wait "
+                "again)", labels=("queue",)).labels(
+                    queue=req.queue).observe(waited)
+            self._trace_event(
+                manifests[req.key], "bound", queue=req.queue,
+                chips=req.chips, wait_seconds=round(waited, 3),
+                pools=sorted({r.pool for r in placement.slices}))
         for req in queued:
             if req.key in decisions.waits:
                 self._mark_queued(client, manifests[req.key],
                                   decisions.waits[req.key])
+        self._export_queue_gauges(queued, bound, decisions)
+        obsreg.histogram(
+            "kftpu_sched_plan_seconds",
+            "wall time of one cluster-wide scheduling pass").observe(
+                time.perf_counter() - t_pass)
         return Result()
+
+    # -------------------------------------------------------- observability
+
+    def _trace_event(self, manifest: dict, name: str, **attrs) -> None:
+        trace_job_event("scheduler", manifest, name, **attrs)
+
+    def _note_queued(self, queued: list, manifests: dict) -> None:
+        """First-seen bookkeeping for the wait histogram + exactly one
+        "queued" trace event per wait; keys that left the queue by any
+        path (bound, deleted, finished) are pruned."""
+        now = time.time()
+        current = {r.key for r in queued}
+        for stale in set(self._queued_since) - current:
+            del self._queued_since[stale]
+        for req in queued:
+            if req.key not in self._queued_since:
+                self._queued_since[req.key] = now
+                self._trace_event(manifests[req.key], "queued",
+                                  queue=req.queue, chips=req.chips,
+                                  priority=req.priority)
+
+    def _export_queue_gauges(self, queued: list, bound: list,
+                             decisions: Plan) -> None:
+        """Per-queue depth and capacity gauges; a queue that drains
+        exports zeros (not its stale last values)."""
+        depth = obsreg.gauge("kftpu_sched_queue_depth",
+                             "gangs waiting for a binding",
+                             labels=("queue",))
+        qchips = obsreg.gauge("kftpu_sched_queued_chips",
+                              "chips demanded by waiting gangs",
+                              labels=("queue",))
+        bgangs = obsreg.gauge("kftpu_sched_bound_gangs",
+                              "gangs currently bound to slices",
+                              labels=("queue",))
+        bchips = obsreg.gauge("kftpu_sched_bound_chips",
+                              "chips currently bound to gangs",
+                              labels=("queue",))
+        newly_bound = {req.key for req, _ in decisions.binds}
+        preempted = {req.key for req in decisions.preempts}
+        stats: dict[str, list] = {}
+        for req in queued:
+            s = stats.setdefault(req.queue, [0, 0, 0, 0])
+            if req.key not in newly_bound:
+                s[0] += 1
+                s[1] += req.chips
+        for req, _ in bound:
+            s = stats.setdefault(req.queue, [0, 0, 0, 0])
+            if req.key not in preempted:
+                s[2] += 1
+                s[3] += req.chips
+        for req, _ in decisions.binds:
+            s = stats.setdefault(req.queue, [0, 0, 0, 0])
+            s[2] += 1
+            s[3] += req.chips
+        self._known_queues |= set(stats)
+        for q in self._known_queues:
+            d, qc, bg, bc = stats.get(q, (0, 0, 0, 0))
+            depth.labels(queue=q).set(d)
+            qchips.labels(queue=q).set(qc)
+            bgangs.labels(queue=q).set(bg)
+            bchips.labels(queue=q).set(bc)
 
     # -------------------------------------------------------------- patches
 

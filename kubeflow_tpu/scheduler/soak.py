@@ -70,14 +70,31 @@ class PreemptionSoak:
                 for e in pod["spec"]["containers"][0].get("env", [])}
 
     def _run_segment(self, env_map: dict, target: int):
+        from ..obs.trace import SPAN_PATH_ENV, TRACE_ID_ENV
         from ..runtime.worker import train  # lazy: pulls in jax
-        return train(
-            workload="transformer", steps=target,
-            global_batch=self.global_batch, sync_every=1,
-            checkpoint_dir=env_map.get("KFTPU_CHECKPOINT_DIR"),
-            checkpoint_every=self.checkpoint_every,
-            resume_from=env_map.get("KFTPU_RESUME_FROM"),
-            seed=self.seed, handle_sigterm=False, workload_kwargs={})
+        # adopt the operator-rendered trace contract for the segment:
+        # the in-process "worker" must read the SAME env a real pod
+        # would, so its window spans stitch onto the job's trace id
+        # (bench.py --mode obs asserts the end-to-end timeline)
+        saved: dict = {}
+        for k in (TRACE_ID_ENV, SPAN_PATH_ENV):
+            if env_map.get(k):
+                saved[k] = os.environ.get(k)
+                os.environ[k] = env_map[k]
+        try:
+            return train(
+                workload="transformer", steps=target,
+                global_batch=self.global_batch, sync_every=1,
+                checkpoint_dir=env_map.get("KFTPU_CHECKPOINT_DIR"),
+                checkpoint_every=self.checkpoint_every,
+                resume_from=env_map.get("KFTPU_RESUME_FROM"),
+                seed=self.seed, handle_sigterm=False, workload_kwargs={})
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
 
     def _gang_running(self, cluster, name: str) -> bool:
         pods = cluster.list("v1", "Pod", self.namespace,
@@ -181,6 +198,10 @@ class PreemptionSoak:
             if k8s.condition_true(job("victim"), "Succeeded"):
                 report["outcome"] = "succeeded"
                 break
+        # the victim's final manifest rides along so callers can read
+        # its annotations (trace id — bench.py --mode obs reconstructs
+        # the victim's end-to-end timeline from the span sink)
+        report["victim_manifest"] = job("victim")
         return self._finish(report, mgr)
 
     @staticmethod

@@ -21,11 +21,13 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
 
+from ..obs.registry import Registry
 from .batcher import MicroBatcher
 from .servable import ModelRepository
 
@@ -44,6 +46,24 @@ class ModelServer:
         self._thread: Optional[threading.Thread] = None
         # experiment routers (A/B, bandit, shadow — serving/router.py)
         self.routers: dict[str, "object"] = {}
+        # per-server registry (obs/registry.py), not the process default:
+        # several ModelServers coexist in one test process and must not
+        # share counts. The per-servable totals stay owned by the
+        # servables (warmup and direct calls count too) and are bridged
+        # into the exposition at scrape time; the REST latency histogram
+        # is observed per request.
+        self.registry = Registry()
+        self._m_requests = self.registry.counter(
+            "kubeflow_model_request_count", "requests per servable",
+            labels=("model",))
+        self._m_predict_s = self.registry.counter(
+            "kubeflow_model_predict_seconds_total",
+            "cumulative device predict seconds per servable",
+            labels=("model",))
+        self._m_latency = self.registry.histogram(
+            "kubeflow_model_request_seconds",
+            "end-to-end REST :predict latency", labels=("model",))
+        self._m_exported: set = set()
 
     def add_router(self, routed) -> None:
         """Mount a RoutedModel at /v1/routers/<name>; when it serves this
@@ -89,20 +109,25 @@ class ModelServer:
         return b
 
     def metrics_text(self) -> str:
-        lines = [
-            "# HELP kubeflow_model_request_count requests per servable",
-            "# TYPE kubeflow_model_request_count counter",
-        ]
-        for name in self.repository.names():
-            s = self.repository.get(name)
-            meta = s.metadata()["stats"]
-            lines.append(
-                f'kubeflow_model_request_count{{model="{name}"}} '
-                f'{meta["request_count"]}')
-            lines.append(
-                f'kubeflow_model_predict_seconds_total{{model="{name}"}} '
-                f'{meta["predict_seconds"]:.6f}')
-        return "\n".join(lines) + "\n"
+        """The standard exposition off the shared registry (names
+        wire-compatible with the pre-registry hand-rolled text): the
+        servable-owned totals are snapshotted in, the request-latency
+        histogram is already live."""
+        names = set(self.repository.names())
+        # a model unloaded from the repository must stop exporting (its
+        # frozen last totals would read as live — and as a counter reset
+        # if the name is later re-added from zero)
+        for gone in self._m_exported - names:
+            self._m_requests.remove(model=gone)
+            self._m_predict_s.remove(model=gone)
+            self._m_latency.remove(model=gone)
+        self._m_exported = names
+        for name in names:
+            meta = self.repository.get(name).metadata()["stats"]
+            self._m_requests.labels(model=name).set(meta["request_count"])
+            self._m_predict_s.labels(model=name).set(
+                round(meta["predict_seconds"], 6))
+        return self.registry.render()
 
 
 def _make_handler(server: ModelServer):
@@ -184,7 +209,13 @@ def _make_handler(server: ModelServer):
                     batcher = server.batcher(name)
                 except KeyError as e:  # unknown model only → 404
                     return self._error(404, str(e))
-                self._run_predict(batcher.predict, req)
+                t0 = time.perf_counter()
+                try:
+                    self._run_predict(batcher.predict, req)
+                finally:
+                    # errors are latency too (clients waited for them)
+                    server._m_latency.labels(model=name).observe(
+                        time.perf_counter() - t0)
             except Exception as e:  # noqa: BLE001 — surface to client
                 self._error(400, f"{type(e).__name__}: {e}")
 

@@ -26,6 +26,8 @@ import urllib.error
 import urllib.request
 from typing import Optional
 
+from ..obs.registry import Registry
+
 SUCCESS_COUNT = "deploy_prober_success_total"
 FAILURE_COUNT = "deploy_prober_failure_total"
 LATENCY_GAUGE = "deploy_prober_last_cycle_seconds"
@@ -80,6 +82,17 @@ class DeployProber:
         self.last_cycle_s = 0.0
         self.last_ok = 0
         self.last_error: Optional[str] = None
+        # shared-registry exposition (obs/registry.py), own Registry per
+        # instance, names unchanged from the hand-rolled text
+        self.registry = Registry()
+        self._g_ok = self.registry.gauge(
+            UP_GAUGE, "1 if the last deploy drill succeeded")
+        self._c_success = self.registry.counter(
+            SUCCESS_COUNT, "deploy drills that succeeded")
+        self._c_failure = self.registry.counter(
+            FAILURE_COUNT, "deploy drills that failed")
+        self._g_latency = self.registry.gauge(
+            LATENCY_GAUGE, "wall seconds of the last deploy drill")
 
     # -- wire helpers --------------------------------------------------------
 
@@ -143,20 +156,13 @@ class DeployProber:
             else:
                 self.failures += 1
                 self.last_error = err
+        self._g_ok.set(1 if ok else 0)
+        self._g_latency.set(round(dt, 3))
+        (self._c_success if ok else self._c_failure).inc()
         return ok
 
     def metrics_text(self) -> str:
-        with self._lock:
-            return (
-                f"# HELP {UP_GAUGE} 1 if the last deploy drill succeeded\n"
-                f"# TYPE {UP_GAUGE} gauge\n"
-                f"{UP_GAUGE} {self.last_ok}\n"
-                f"# TYPE {SUCCESS_COUNT} counter\n"
-                f"{SUCCESS_COUNT} {self.successes}\n"
-                f"# TYPE {FAILURE_COUNT} counter\n"
-                f"{FAILURE_COUNT} {self.failures}\n"
-                f"# TYPE {LATENCY_GAUGE} gauge\n"
-                f"{LATENCY_GAUGE} {round(self.last_cycle_s, 3)}\n")
+        return self.registry.render()
 
     def run_forever(self, interval_s: float = 600.0,
                     stop: Optional[threading.Event] = None) -> None:

@@ -15,10 +15,12 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler
 from typing import Callable, Optional
 
+from ..obs.registry import Registry
 from ..webapps._http import ThreadedServer
 
 GAUGE_NAME = "kubeflow_availability"
 PROBE_COUNT = "kubeflow_availability_probe_total"
+FAILURE_COUNT = "kubeflow_availability_probe_failures_total"
 
 
 class AvailabilityProber:
@@ -34,6 +36,17 @@ class AvailabilityProber:
         self.probes = 0
         self.failures = 0
         self.last_error: Optional[str] = None
+        # exposition via the shared registry (obs/registry.py) — an OWN
+        # Registry per prober instance (several coexist in one test
+        # process); metric names unchanged from the hand-rolled text
+        # this replaced, so existing scrape configs keep working
+        self.registry = Registry()
+        self._g_up = self.registry.gauge(
+            GAUGE_NAME, "1 if the kubeflow endpoint is up")
+        self._c_probes = self.registry.counter(
+            PROBE_COUNT, "availability probes attempted")
+        self._c_failures = self.registry.counter(
+            FAILURE_COUNT, "availability probes that failed")
 
     @staticmethod
     def _http_fetch(url: str, headers: dict, timeout_s: float) -> int:
@@ -60,18 +73,14 @@ class AvailabilityProber:
             if not ok:
                 self.failures += 1
                 self.last_error = err
+        self._c_probes.inc()
+        self._g_up.set(1 if ok else 0)
+        if not ok:
+            self._c_failures.inc()
         return ok
 
     def metrics_text(self) -> str:
-        with self._lock:
-            return (
-                f"# HELP {GAUGE_NAME} 1 if the kubeflow endpoint is up\n"
-                f"# TYPE {GAUGE_NAME} gauge\n"
-                f"{GAUGE_NAME} {self.available}\n"
-                f"# TYPE {PROBE_COUNT} counter\n"
-                f"{PROBE_COUNT} {self.probes}\n"
-                f"{PROBE_COUNT.replace('_total', '_failures_total')} "
-                f"{self.failures}\n")
+        return self.registry.render()
 
     def run_forever(self, interval_s: float = 30.0,
                     stop: Optional[threading.Event] = None) -> None:

@@ -386,6 +386,48 @@ def build_dashboard_app(client: KubeClient,
                                 f"{query.get('window')!r}")
         return 200, metrics.query(mtype, window)
 
+    @app.route("GET", "/metrics")
+    def metrics_exposition(params, query, body):
+        """This process's shared-registry exposition (obs/registry.py) —
+        the dashboard is a scrape target like every other component."""
+        from ..obs.registry import default_registry
+        return 200, RawResponse(default_registry().render())
+
+    @app.route("GET", "/api/obs/jobs/{namespace}/{name}")
+    def job_timeline(params, query, body):
+        """One job's end-to-end trace timeline, reconstructed from the
+        JSONL span sink alone (obs/trace.py): queued → bound →
+        pod-start → running → per-window spans → done, each with
+        component + duration — the queue-wait/startup/throughput
+        attribution the obs layer exists for. The sink location comes
+        from this process's KFTPU_SPAN_PATH (the same contract the
+        operator renders into workers)."""
+        from ..api.trainingjob import API_VERSIONS, JOB_KINDS
+        from ..obs.trace import (SPAN_PATH_ENV, TRACE_ID_ANNOTATION,
+                                 reconstruct)
+        ns, name = params["namespace"], params["name"]
+        manifest = None
+        for kind in JOB_KINDS:
+            manifest = client.get_or_none(API_VERSIONS[kind], kind, ns,
+                                          name)
+            if manifest is not None:
+                break
+        if manifest is None:
+            raise ApiError(404, f"no training job {ns}/{name}")
+        trace_id = k8s.annotations_of(manifest).get(TRACE_ID_ANNOTATION)
+        out = {"namespace": ns, "name": name, "phase": _job_phase(manifest),
+               "traceId": trace_id, "events": [], "wallSeconds": 0.0}
+        span_path = os.environ.get(SPAN_PATH_ENV)
+        if not trace_id:
+            out["note"] = "no trace id minted yet (control plane has " \
+                          "not touched this job)"
+            return 200, out
+        if not span_path:
+            out["note"] = f"no span sink configured ({SPAN_PATH_ENV} unset)"
+            return 200, out
+        out.update(reconstruct(span_path, trace_id))
+        return 200, out
+
     @app.route("GET", "/api/sched/queues")
     def sched_queues(params, query, body):
         """Gang-scheduler queue state: per-queue depth, bound capacity,

@@ -134,6 +134,100 @@ def test_input_pipeline_knobs_are_plumbed_end_to_end():
     assert TrainingJob.from_manifest(ex).input_spec == ispec
 
 
+def test_obs_knobs_are_plumbed_end_to_end():
+    """Every ObsSpec field must be representable end-to-end, the same
+    rule as input/schedulingPolicy: parsed+serialized through the TPUJob
+    spec's ``observability`` block (api/trainingjob.py), rendered into
+    worker env by the controller, consumed by the worker's train()/CLI
+    surface, and named in the manifests CRD schema + example builder —
+    and the trace-id contract (minted as an annotation, rendered as
+    KFTPU_TRACE_ID) must connect scheduler, operator, and worker, so a
+    future observability knob can't silently exist in one layer only."""
+    import dataclasses
+
+    from kubeflow_tpu.api.trainingjob import ObsSpec, TrainingJob
+    from kubeflow_tpu.manifests.training import tpu_job_simple
+    from kubeflow_tpu.obs.trace import (SPAN_PATH_ENV,
+                                        TRACE_ID_ANNOTATION, TRACE_ID_ENV)
+
+    def src(*rel):
+        with open(os.path.join(REPO_ROOT, "kubeflow_tpu", *rel)) as f:
+            return f.read()
+
+    knobs = dataclasses.fields(ObsSpec)
+    assert knobs, "expected the spanPath/metricsPort knobs"
+    worker_src = src("runtime", "worker.py")
+    controller_src = src("controllers", "tpujob.py")
+    manifests_src = src("manifests", "training.py")
+    scheduler_src = src("scheduler", "core.py")
+    for knob in knobs:
+        # worker: a CLI flag and the env fallback
+        assert knob.metadata["cli"] in worker_src, knob.name
+        assert knob.metadata["env"] in worker_src \
+            or knob.metadata["env"] == SPAN_PATH_ENV, knob.name
+        # controller: rendered into worker env (via ObsSpec.to_env)
+        assert "obs_spec.to_env" in controller_src
+        # manifests: the CRD schema names the spec field
+        assert f'"{knob.metadata["spec_field"]}"' in manifests_src, \
+            knob.name
+    # the trace-id contract: minted+persisted through the ONE shared
+    # helper (controllers/runtime.py ensure_trace_id — the binding_of
+    # pattern) by BOTH control-plane components, then rendered into
+    # worker env and consumed by the worker
+    runtime_src = src("controllers", "runtime.py")
+    assert "TRACE_ID_ANNOTATION" in runtime_src
+    for component_src in (scheduler_src, controller_src):
+        assert "ensure_trace_id" in component_src
+        assert "trace_job_event" in component_src
+    assert "TRACE_ID_ENV" in controller_src
+    assert "TRACE_ID_ENV" in worker_src
+    assert SPAN_PATH_ENV in ("KFTPU_SPAN_PATH",)
+    assert TRACE_ID_ENV in ("KFTPU_TRACE_ID",)
+    assert TRACE_ID_ANNOTATION == "observability.kubeflow.org/trace-id"
+
+    # spec wire round-trip: to_dict → from_manifest → identical spec,
+    # and the controller env render matches the declared names
+    ospec = ObsSpec(span_path="/var/log/kftpu/spans.jsonl",
+                    metrics_port=9100)
+    manifest = {
+        "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": "t", "namespace": "ns"},
+        "spec": {"replicaSpecs": {"TPU": {
+            "tpuTopology": "v5e-8",
+            "template": {"spec": {"containers": [{"name": "c"}]}}}},
+            "observability": ospec.to_dict()},
+    }
+    job = TrainingJob.from_manifest(manifest)
+    assert job.obs_spec == ospec
+    assert job.to_manifest()["spec"]["observability"] == ospec.to_dict()
+    assert ospec.to_env() == {
+        "KFTPU_SPAN_PATH": "/var/log/kftpu/spans.jsonl",
+        "KFTPU_OBS_METRICS_PORT": "9100"}
+
+    # train() consumes both knobs by their canonical names
+    import inspect
+
+    from kubeflow_tpu.runtime import worker
+    train_params = inspect.signature(worker.train).parameters
+    assert "span_path" in train_params
+    assert "obs_metrics_port" in train_params
+
+    # admission rejects garbage (a typo'd knob must fail at apply)
+    import pytest
+    with pytest.raises(ValueError, match="metricsPort"):
+        ObsSpec.from_dict({"metricsPort": -1})
+    with pytest.raises(ValueError, match="unknown"):
+        ObsSpec.from_dict({"spanpath": "/x"})
+    with pytest.raises(ValueError, match="mapping"):
+        ObsSpec.from_dict(["/x"])
+
+    # example builder renders the block end to end
+    ex = next(o for o in tpu_job_simple(
+        span_path="/var/log/kftpu/spans.jsonl", obs_metrics_port=9100)
+        if o["kind"] == "TPUJob")
+    assert TrainingJob.from_manifest(ex).obs_spec == ospec
+
+
 def test_scheduling_policy_is_plumbed_end_to_end():
     """Every SchedulingPolicy field must be representable end-to-end,
     the same rule as runPolicy/input: parsed+serialized through the

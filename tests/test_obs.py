@@ -1,0 +1,465 @@
+"""Observability layer (ISSUE 5): registry semantics, trace spans, span
+propagation job → env → worker JSONL, and endpoint smoke tests."""
+
+import json
+import math
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.obs.http import ObsServer
+from kubeflow_tpu.obs.registry import (Registry, default_registry,
+                                       reset_default_registry)
+from kubeflow_tpu.obs.trace import (SPAN_PATH_ENV, TRACE_ID_ANNOTATION,
+                                    TRACE_ID_ENV, SpanWriter, load_spans,
+                                    reconstruct)
+
+pytestmark = pytest.mark.obs
+
+
+class TestRegistry:
+    def test_counter_inc_and_labels(self):
+        r = Registry()
+        c = r.counter("jobs_total", "jobs", labels=("queue",))
+        c.labels(queue="a").inc()
+        c.labels(queue="a").inc(2)
+        c.labels(queue="b").inc()
+        assert c.labels(queue="a").value == 3
+        text = r.render()
+        assert 'jobs_total{queue="a"} 3' in text
+        assert 'jobs_total{queue="b"} 1' in text
+        assert "# TYPE jobs_total counter" in text
+
+    def test_counter_rejects_decrease(self):
+        c = Registry().counter("c_total", "c")
+        with pytest.raises(ValueError, match="increase"):
+            c.inc(-1)
+
+    def test_gauge_set_inc_dec(self):
+        g = Registry().gauge("depth", "d")
+        g.set(5)
+        g.inc(2)
+        g.dec(3)
+        assert g.value == 4
+
+    def test_label_value_escaping(self):
+        r = Registry()
+        r.counter("esc_total", "e", labels=("v",)).labels(
+            v='say "hi"\\\n').inc()
+        text = r.render()
+        assert r'esc_total{v="say \"hi\"\\\n"} 1' in text
+
+    def test_help_escaping(self):
+        r = Registry()
+        r.gauge("h", "line1\nline2 \\ slash")
+        assert r"# HELP h line1\nline2 \\ slash" in r.render()
+
+    def test_histogram_buckets_cumulative(self):
+        r = Registry()
+        h = r.histogram("lat_seconds", "l", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        counts = h.bucket_counts()
+        assert counts[0.1] == 1
+        assert counts[1.0] == 3
+        assert counts[10.0] == 4
+        assert counts[math.inf] == 5
+        text = r.render()
+        assert 'lat_seconds_bucket{le="0.1"} 1' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 5' in text
+        assert "lat_seconds_count 5" in text
+        assert "lat_seconds_sum 56.05" in text
+
+    def test_concurrent_increments_are_exact(self):
+        c = Registry().counter("conc_total", "c")
+        h = Registry().histogram("conc_seconds", "h", buckets=(1.0,))
+
+        def hammer():
+            for _ in range(5000):
+                c.inc()
+                h.observe(0.5)
+
+        threads = [threading.Thread(target=hammer) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 40000
+        assert h.bucket_counts()[1.0] == 40000
+
+    def test_reregistration_idempotent_mismatch_raises(self):
+        r = Registry()
+        a = r.counter("x_total", "x", labels=("l",))
+        assert r.counter("x_total", "x", labels=("l",)) is a
+        with pytest.raises(ValueError, match="re-registered"):
+            r.gauge("x_total", "x", labels=("l",))
+        with pytest.raises(ValueError, match="re-registered"):
+            r.counter("x_total", "x", labels=("other",))
+
+    def test_unlabeled_series_render_zero_from_registration(self):
+        r = Registry()
+        r.counter("fresh_total", "never incremented")
+        assert "fresh_total 0" in r.render()
+
+    def test_labeled_metrics_require_labels_and_validate_names(self):
+        r = Registry()
+        fam = r.counter("l_total", "l", labels=("q",))
+        with pytest.raises(ValueError, match="labels"):
+            fam.inc()
+        with pytest.raises(ValueError, match="labels"):
+            fam.labels(wrong="x")
+        with pytest.raises(ValueError, match="invalid metric name"):
+            r.counter("bad-name", "x")
+
+    def test_integer_values_render_without_decimal_point(self):
+        r = Registry()
+        r.gauge("g", "g").set(3.0)
+        assert "\ng 3\n" in "\n" + r.render()
+
+    def test_remove_drops_series(self):
+        r = Registry()
+        g = r.gauge("phase", "p", labels=("name",))
+        g.labels(name="a").set(1)
+        g.remove(name="a")
+        assert 'phase{name="a"}' not in r.render()
+
+    def test_disabled_registry_is_noop(self):
+        r = Registry(enabled=False)
+        c = r.counter("x_total", "x", labels=("l",))
+        c.labels(l="a").inc()
+        c.inc()
+        r.histogram("h", "h").observe(1)
+        assert r.render() == ""
+
+    def test_default_registry_honors_disable_env(self, monkeypatch):
+        monkeypatch.setenv("KFTPU_OBS_DISABLE", "1")
+        reset_default_registry()
+        try:
+            assert default_registry().enabled is False
+        finally:
+            monkeypatch.delenv("KFTPU_OBS_DISABLE")
+            reset_default_registry()
+
+
+class TestSpans:
+    def test_writer_emits_jsonl(self, tmp_path):
+        p = str(tmp_path / "spans.jsonl")
+        w = SpanWriter(p, "worker", trace_id="t1")
+        w.event("queued", queue="research")
+        with w.span("window", step=5):
+            pass
+        w.close()
+        records = [json.loads(line)
+                   for line in open(p).read().splitlines()]
+        assert [r["name"] for r in records] == ["queued", "window"]
+        assert all(r["trace_id"] == "t1" for r in records)
+        assert all(r["component"] == "worker" for r in records)
+        ev, span = records
+        assert ev["start"] == ev["end"]          # point event
+        assert span["end"] >= span["start"]
+        assert span["attrs"] == {"step": 5}
+        assert span["span_id"] and span["span_id"] != ev["span_id"]
+
+    def test_span_records_error(self, tmp_path):
+        p = str(tmp_path / "s.jsonl")
+        w = SpanWriter(p, "worker", trace_id="t")
+        with pytest.raises(RuntimeError):
+            with w.span("restore"):
+                raise RuntimeError("boom")
+        w.close()
+        rec = json.loads(open(p).read())
+        assert "RuntimeError: boom" in rec["attrs"]["error"]
+
+    def test_from_env(self, tmp_path):
+        assert SpanWriter.from_env("worker", env={}) is None
+        w = SpanWriter.from_env("worker", env={
+            SPAN_PATH_ENV: str(tmp_path / "s.jsonl"),
+            TRACE_ID_ENV: "abc"})
+        assert w is not None and w.trace_id == "abc"
+        w.close()
+
+    def test_load_skips_garbage_and_orders(self, tmp_path):
+        p = tmp_path / "s.jsonl"
+        p.write_text(
+            json.dumps({"trace_id": "t", "name": "b", "start": 2.0,
+                        "end": 2.5}) + "\n"
+            "not json at all\n"
+            '{"valid json": "but not a span"}\n' +
+            json.dumps({"trace_id": "t", "name": "a", "start": 1.0,
+                        "end": 1.5}) + "\n" +
+            json.dumps({"trace_id": "other", "name": "z", "start": 0.0,
+                        "end": 0.1}) + "\n")
+        spans = load_spans(str(p), trace_id="t")
+        assert [s["name"] for s in spans] == ["a", "b"]
+        t = reconstruct(str(p), "t")
+        assert t["names"] == ["a", "b"]
+        assert t["wallSeconds"] == pytest.approx(1.5)
+        assert reconstruct(str(tmp_path / "missing.jsonl"),
+                           "t")["events"] == []
+
+
+def _pump(mgr, cluster, ticks: int = 3) -> None:
+    for _ in range(ticks):
+        mgr.run_pending()
+        cluster.tick()
+    mgr.run_pending()
+
+
+@pytest.fixture
+def sched_cluster(tmp_path, monkeypatch):
+    """FakeCluster + the real scheduler and operator, with a span sink
+    configured the way a deployment would (env on the control-plane
+    process)."""
+    from kubeflow_tpu.cluster.fake import FakeCluster
+    from kubeflow_tpu.controllers.runtime import Manager
+    from kubeflow_tpu.controllers.tpujob import TrainingJobReconciler
+    from kubeflow_tpu.scheduler.core import SliceScheduler
+
+    sink = str(tmp_path / "spans.jsonl")
+    monkeypatch.setenv(SPAN_PATH_ENV, sink)
+    cluster = FakeCluster()
+    cluster.add_tpu_slice_nodes("v5e-8")
+    mgr = Manager(cluster)
+    mgr.add(SliceScheduler())
+    mgr.add(TrainingJobReconciler("TPUJob"))
+    yield cluster, mgr, sink
+    for c in mgr.controllers:
+        c.stop()
+
+
+def _tpujob(name: str = "trace-job", scheduled: bool = True) -> dict:
+    spec: dict = {"replicaSpecs": {"TPU": {
+        "tpuTopology": "v5e-8",
+        "template": {"spec": {"containers": [
+            {"name": "jax", "image": "trainer:v1"}]}}}}}
+    if scheduled:
+        spec["schedulingPolicy"] = {"queue": "research", "priority": 1}
+    return {"apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": name, "namespace": "kubeflow"},
+            "spec": spec}
+
+
+class TestTracePropagation:
+    """The span-propagation contract end to end on the real control
+    plane: trace id minted → annotation → pod env → worker JSONL →
+    reconstructable timeline. (The REAL-training version of this runs
+    in bench.py --mode obs through the contended-scheduler soak.)"""
+
+    def test_job_to_env_to_worker_jsonl(self, sched_cluster):
+        from kubeflow_tpu.api import k8s
+
+        cluster, mgr, sink = sched_cluster
+        cluster.create(_tpujob())
+        _pump(mgr, cluster)
+
+        job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                          "kubeflow", "trace-job")
+        trace_id = k8s.annotations_of(job).get(TRACE_ID_ANNOTATION)
+        assert trace_id, "control plane never minted a trace id"
+
+        # the operator rendered the contract into every worker pod
+        pod = cluster.get("v1", "Pod", "kubeflow", "trace-job-worker-0-0")
+        env = {e["name"]: e.get("value", "")
+               for e in pod["spec"]["containers"][0].get("env", [])}
+        assert env[TRACE_ID_ENV] == trace_id
+        assert env[SPAN_PATH_ENV] == sink
+
+        # the worker end: a SpanWriter built from exactly that env
+        # writes windows that stitch onto the job's trace
+        w = SpanWriter.from_env("worker", env=env)
+        w.event("train-start", start_step=0, steps=4)
+        w.emit("window", start=1.0, end=2.0, step=4, steps=4)
+        w.close()
+
+        cluster.set_pod_phase("kubeflow", "trace-job-worker-0-0",
+                              "Succeeded")
+        _pump(mgr, cluster)
+
+        names = reconstruct(sink, trace_id)["names"]
+        for phase in ("queued", "bound", "created", "running",
+                      "window", "succeeded"):
+            assert phase in names, (phase, names)
+        # queue → bind → gang-create precede the worker's windows,
+        # completion follows them (windows carry fake timestamps 1.0-2.0
+        # < wall clock, so assert order on the control-plane spine only)
+        assert names.index("queued") < names.index("bound") \
+            < names.index("created")
+        assert names.index("created") < names.index("succeeded")
+
+    def test_unmanaged_job_still_gets_trace(self, sched_cluster):
+        from kubeflow_tpu.api import k8s
+
+        cluster, mgr, sink = sched_cluster
+        cluster.create(_tpujob(name="legacy", scheduled=False))
+        _pump(mgr, cluster)
+        job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                          "kubeflow", "legacy")
+        trace_id = k8s.annotations_of(job).get(TRACE_ID_ANNOTATION)
+        assert trace_id
+        names = reconstruct(sink, trace_id)["names"]
+        assert "created" in names and "running" in names
+        assert "queued" not in names   # legacy path never queues
+
+    def test_scheduler_metrics_exported(self, sched_cluster):
+        cluster, mgr, sink = sched_cluster
+        cluster.create(_tpujob())
+        _pump(mgr, cluster)
+        text = default_registry().render()
+        assert 'kftpu_sched_queue_depth{queue="research"} 0' in text
+        assert 'kftpu_sched_bound_gangs{queue="research"} 1' in text
+        assert "kftpu_sched_queue_wait_seconds_count" in text
+        assert "kftpu_sched_plan_seconds_count" in text
+        # the manager loop's generic per-controller accounting
+        assert 'kftpu_reconcile_seconds_count{controller="tpujob"}' in text
+        # the operator's phase gauge follows the job
+        assert 'kftpu_job_phase{namespace="kubeflow",name="trace-job",' \
+               'kind="TPUJob",phase="Running"} 1' in text
+
+
+class TestEndpoints:
+    def test_obs_server_serves_registry(self):
+        r = Registry()
+        r.counter("smoke_total", "s").inc(3)
+        server = ObsServer(r, host="127.0.0.1")
+        port = server.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics") as resp:
+                body = resp.read().decode()
+                assert resp.headers["Content-Type"].startswith(
+                    "text/plain")
+            assert "smoke_total 3" in body
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz") as resp:
+                assert json.loads(resp.read())["ok"] is True
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope")
+        finally:
+            server.stop()
+
+    def test_dashboard_timeline_endpoint(self, sched_cluster):
+        from kubeflow_tpu.webapps.dashboard import build_dashboard_app
+
+        cluster, mgr, sink = sched_cluster
+        cluster.create(_tpujob())
+        _pump(mgr, cluster)
+        app = build_dashboard_app(cluster)
+        status, body = app.dispatch(
+            "GET", "/api/obs/jobs/kubeflow/trace-job", None)
+        assert status == 200
+        assert body["traceId"]
+        assert "queued" in [e["name"] for e in body["events"]]
+        assert body["phase"] == "Running"
+        status, _ = app.dispatch("GET", "/api/obs/jobs/kubeflow/ghost",
+                                 None)
+        assert status == 404
+
+    def test_dashboard_timeline_without_sink(self, tmp_path, monkeypatch):
+        from kubeflow_tpu.cluster.fake import FakeCluster
+        from kubeflow_tpu.webapps.dashboard import build_dashboard_app
+
+        monkeypatch.delenv(SPAN_PATH_ENV, raising=False)
+        cluster = FakeCluster()
+        cluster.create(_tpujob(scheduled=False))
+        app = build_dashboard_app(cluster)
+        status, body = app.dispatch(
+            "GET", "/api/obs/jobs/kubeflow/trace-job", None)
+        assert status == 200
+        assert body["events"] == [] and "note" in body
+
+    def test_dashboard_metrics_route(self):
+        from kubeflow_tpu.cluster.fake import FakeCluster
+        from kubeflow_tpu.webapps.dashboard import build_dashboard_app
+        from kubeflow_tpu.webapps._http import RawResponse
+
+        app = build_dashboard_app(FakeCluster())
+        status, body = app.dispatch("GET", "/metrics", None)
+        assert status == 200 and isinstance(body, RawResponse)
+
+    def test_controller_manager_metrics_flag(self):
+        # --metrics-port=0 keeps the manager scrape surface off; the
+        # flag itself parses (deployments render --metrics-port=8080)
+        from kubeflow_tpu.manifests.training import tpu_scheduler
+        dep = next(o for o in tpu_scheduler()
+                   if o["kind"] == "Deployment")
+        args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--metrics-port=8080" in args
+        anns = dep["spec"]["template"]["metadata"]["annotations"]
+        assert anns["prometheus.io/scrape"] == "true"
+        assert anns["prometheus.io/port"] == "8080"
+
+
+class TestHeartbeatGauges:
+    def test_last_beat_exported(self):
+        from kubeflow_tpu.runtime.metrics import HeartbeatReporter
+
+        class FakeClient:
+            def patch(self, *a, **k):
+                return {}
+
+        hb = HeartbeatReporter(FakeClient(), "ns", "pod", interval_s=0.0)
+        assert hb.beat(41, force=True)
+        text = default_registry().render()
+        assert "kftpu_heartbeat_last_step 41" in text
+        assert "kftpu_heartbeat_last_time_seconds" in text
+
+    def test_failed_beat_leaves_gauges(self):
+        from kubeflow_tpu.runtime.metrics import HeartbeatReporter
+
+        class DeadClient:
+            def patch(self, *a, **k):
+                raise OSError("apiserver down")
+
+        hb = HeartbeatReporter(DeadClient(), "ns", "pod", interval_s=0.0)
+        before = hb._g_step.value
+        assert hb.beat(99, force=True) is False
+        # a FAILED patch must not advertise progress
+        assert hb._g_step.value == before
+
+
+class TestSummaryWarmupDegrade:
+    """Satellite: summary(warmup=N) with fewer than N+1 windows must
+    degrade gracefully — drop what it can, keep at least the final
+    window, never an empty slice."""
+
+    def _logger(self, n: int):
+        from kubeflow_tpu.runtime.metrics import MetricsLogger
+        m = MetricsLogger(batch_size=10, log_every=0)
+        for i in range(n):
+            # window i covers 2 steps in 0.2s → 0.1 s/step
+            m.record_window((i + 1) * 2, 2, 0.2, {"loss": 1.0})
+        return m
+
+    def test_short_history_keeps_final_window(self):
+        m = self._logger(2)
+        s = m.summary(warmup=5)
+        assert s["steps"] == 4
+        assert s["mean_step_time_s"] == pytest.approx(0.1)
+        assert s["examples_per_sec"] == pytest.approx(100.0)
+
+    def test_single_window_history(self):
+        s = self._logger(1).summary(warmup=3)
+        assert s["mean_step_time_s"] == pytest.approx(0.1)
+        assert s["examples_per_sec"] > 0
+
+    def test_empty_history(self):
+        s = self._logger(0).summary(warmup=2)
+        assert s == {"steps": 0, "examples_per_sec": 0.0,
+                     "mean_step_time_s": 0.0}
+
+    def test_negative_warmup_treated_as_zero(self):
+        s = self._logger(3).summary(warmup=-1)
+        assert s["steps"] == 6
+        assert s["mean_step_time_s"] == pytest.approx(0.1)
+
+    def test_normal_warmup_still_skips(self):
+        from kubeflow_tpu.runtime.metrics import MetricsLogger
+        m = MetricsLogger(batch_size=10, log_every=0)
+        m.record_window(2, 2, 2.0, {})     # compile window, 1 s/step
+        m.record_window(4, 2, 0.2, {})
+        s = m.summary(warmup=1)
+        assert s["mean_step_time_s"] == pytest.approx(0.1)
+        assert s["first_window_s"] == pytest.approx(2.0)

@@ -1126,6 +1126,122 @@ def bench_sched(t_start: float | None = None) -> dict:
     }
 
 
+def bench_health(t_start: float | None = None) -> dict:
+    """Node-health quarantine A/B (ISSUE 6): does feeding runtime
+    failure evidence back into placement actually buy recovery?
+
+    Two parts, both paired quarantine-ON vs quarantine-OFF:
+
+    1. **Degraded-node sim** (scheduler/sim.py compare_health): the
+       same seeded contended workloads with the same seeded flaky host
+       (recurring crash every other tick through the contention
+       window), run through the REAL plan()/inventory code. Asserted:
+       quarantine strictly reduces recomputed ticks — crash-looping on
+       a known-bad host is pure waste the placement-blind arm keeps
+       paying.
+    2. **Flaky-host soak** (scheduler/soak.py HealthSoak): one
+       scheduler-managed TPUJob on a two-pool cluster, real training
+       segments, a pinned host that kills every pod scheduled onto it.
+       ON: the operator records the suspect, the scheduler evacuates
+       the binding within ONE rebind, the gang finishes on the clean
+       pool. OFF: the gang crash-loops in place, one restart per trip.
+       Both arms must end params-identical to a clean run (parity 0.0):
+       health changes WHERE the gang runs, never what it computes.
+       (Replay is structurally zero here — teardown is graceful, so
+       every segment checkpoints; the sim carries the recompute A/B.)
+
+    Env knobs (health_bench_smoke shrinks the geometry):
+    KFTPU_BENCH_HEALTH_SEEDS / _JOBS / _SOAK (0 skips the soak)."""
+    import os
+    import shutil
+    import tempfile
+
+    from kubeflow_tpu.scheduler.sim import compare_health
+
+    t_start = time.perf_counter() if t_start is None else t_start
+    seeds = list(range(_env_int("KFTPU_BENCH_HEALTH_SEEDS", 3)))
+    n_jobs = _env_int("KFTPU_BENCH_HEALTH_JOBS", 16)
+    t0 = time.perf_counter()
+    table = compare_health(seeds, n_jobs=n_jobs)
+    sim_s = time.perf_counter() - t0
+    on, off = table["quarantine_on"], table["quarantine_off"]
+
+    soak: dict = {"skipped": True}
+    if _env_int("KFTPU_BENCH_HEALTH_SOAK", 1):
+        import jax
+        import numpy as np
+
+        from kubeflow_tpu.cluster.chaos import final_params
+        from kubeflow_tpu.scheduler.soak import HealthSoak
+        tmp = tempfile.mkdtemp(prefix="kftpu-health-soak-")
+        try:
+            t0 = time.perf_counter()
+            arms = {}
+            clean = None
+            for arm, quarantine in (("on", True), ("off", False)):
+                drill = HealthSoak(
+                    workdir=os.path.join(tmp, arm),
+                    quarantine=quarantine)
+                report = drill.run()
+                if clean is None:
+                    clean = drill.clean_params()
+                delta = float("nan")
+                if report["outcome"] == "succeeded":
+                    params = final_params(report["checkpoint_dir"])
+                    delta = max(jax.tree.leaves(jax.tree.map(
+                        lambda a, b: float(np.max(np.abs(
+                            np.asarray(a) - np.asarray(b)))),
+                        params, clean)), default=0.0)
+                arms[arm] = {
+                    "outcome": report["outcome"],
+                    "restarts": report["restarts"],
+                    "fires": report["fires"],
+                    "rebinds": report["rebinds"],
+                    "migrated": report["migrated"],
+                    "flaky_quarantined": report["flaky_quarantined"],
+                    "time_to_recovery_s": report.get("recovery_s"),
+                    "useful_work_fraction":
+                        report["useful_work_fraction"],
+                    "final_params_max_abs_delta_vs_clean": delta,
+                    "params_parity_ok": bool(
+                        report["outcome"] == "succeeded"
+                        and delta <= 1e-5),
+                }
+            soak = {
+                **arms,
+                # the acceptance bar, machine-checkable in the artifact
+                "migrated_within_one_rebind": bool(
+                    arms["on"]["migrated"]
+                    and arms["on"]["rebinds"] == 1),
+                "off_arm_extra_restarts":
+                    arms["off"]["restarts"] - arms["on"]["restarts"],
+                "soak_wall_s": round(time.perf_counter() - t0, 1),
+            }
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    # headline: recomputed work the quarantine loop saves (>1 = pays)
+    waste_ratio = (off["recomputed_ticks"] /
+                   max(on["recomputed_ticks"], 1e-9))
+    return {
+        "metric": "node_health_quarantine_ab",
+        "value": round(waste_ratio, 2),
+        "unit": "off_over_on_recomputed_ticks",
+        "vs_baseline": None,
+        "mfu": None,
+        "extras": {
+            "seeds": len(seeds),
+            "jobs_per_seed": n_jobs,
+            "sim": table,
+            "quarantine_strictly_reduces_recompute": bool(
+                on["recomputed_ticks"] < off["recomputed_ticks"]),
+            "sim_wall_s": round(sim_s, 1),
+            "soak": soak,
+        },
+        "_flops_per_chip": 0.0,
+    }
+
+
 def bench_obs(t_start: float | None = None) -> dict:
     """Observability overhead + end-to-end trace proof (ISSUE 5).
 
@@ -1353,7 +1469,7 @@ def main(argv=None) -> int:
                    choices=["all", "resnet", "resnet-fused", "lm",
                             "lm-long", "serving", "fused-blocks",
                             "weight-update", "chaos", "input", "sched",
-                            "obs"])
+                            "health", "obs"])
     p.add_argument("--routing-out",
                    default="bench-matrix/fused_routing_measured.json",
                    help="where --mode fused-blocks writes the measured "
@@ -1405,6 +1521,8 @@ def main(argv=None) -> int:
         row = bench_input(t_start=t_start)
     elif args.mode == "sched":
         row = bench_sched(t_start=t_start)
+    elif args.mode == "health":
+        row = bench_health(t_start=t_start)
     elif args.mode == "obs":
         row = bench_obs(t_start=t_start)
     else:
@@ -1472,13 +1590,15 @@ def main(argv=None) -> int:
                           routing_out=args.routing_out),
                       "weight-update": bench_weight_update,
                       "input": bench_input,
-                      "sched": bench_sched}
+                      "sched": bench_sched,
+                      "health": bench_health}
         for key, mode in (("fused", "resnet-fused"), ("lm", "lm"),
                           ("lm_long", "lm-long"),
                           ("serving", "serving"),
                           ("weight_update", "weight-update"),
                           ("input", "input"),
                           ("sched", "sched"),
+                          ("health", "health"),
                           ("fused_blocks", "fused-blocks")):
             if mode == "fused-blocks" and not on_tpu:
                 # per-block attribution is the most expensive extra (10
@@ -1503,7 +1623,8 @@ def main(argv=None) -> int:
                     # is timed sleep, not compute
                     sub = in_process[mode]() if on_tpu else \
                         _run_sub_bench(mode, budget_s=420.0 if
-                                       mode in ("input", "sched")
+                                       mode in ("input", "sched",
+                                                "health")
                                        else 240.0)
                     row["extras"][key] = {
                         "metric": sub["metric"], "value": sub["value"],
@@ -1516,7 +1637,9 @@ def main(argv=None) -> int:
                             "serial_img_s", "overlapped_img_s",
                             "simulated_step_ms", "input_workers",
                             "input_only_speedup", "policies",
-                            "dominates_fifo", "parity", "error")
+                            "dominates_fifo", "parity", "sim", "soak",
+                            "quarantine_strictly_reduces_recompute",
+                            "error")
                            if k in sub["extras"]},
                     }
                 except Exception as e:  # noqa: BLE001 — artifact lands

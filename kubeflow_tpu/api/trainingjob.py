@@ -281,6 +281,34 @@ SCHED_REASON_ANNOTATION = "scheduling.kubeflow.org/reason"
 # times this job's gang was preempted (reclaimed, not failed)
 PREEMPTED_COUNT_ANNOTATION = "scheduling.kubeflow.org/preempted-count"
 
+# Node-health contract between the operator (evidence writer) and the
+# scheduler (policy actor) — scheduler/health.py owns the parse/fold
+# helpers, BOTH sides consume them (the binding_of pattern: one wire
+# contract, no string drift; tests/test_lint.py enforces single
+# definition). All three ride on annotations so the two processes
+# coordinate through the apiserver only:
+#
+# - HEALTH_ANNOTATION (on Nodes): exponential-decay failure score, JSON
+#   {"score": s, "time": unix, "events": n, "last": kind}. The operator
+#   folds runtime failure evidence in (pod crash attributed to the host
+#   it ran on, stalled worker, step-time skew); the scheduler decays and
+#   reads it each pass.
+# - QUARANTINE_ANNOTATION (on Nodes): set by the scheduler when a
+#   host's score crosses the threshold (or by a human, reason
+#   "manual"), JSON {"reason": r, "score": s, "since": unix, "until":
+#   unix|null}. Quarantined hosts are carved out of placeable
+#   rectangles (scheduler/inventory.py); expiry + score decay below the
+#   release threshold auto-releases (probation), manual quarantines
+#   never auto-release.
+# - SUSPECT_ANNOTATION (on TPUJobs): the host the operator attributes a
+#   gang teardown to (crash loop on one pod, stalled single worker).
+#   The scheduler replans the job's binding EXCLUDING the suspect's
+#   cells — the gang migrates instead of crash-looping in place — and
+#   clears the annotation on the rebind.
+HEALTH_ANNOTATION = "kubeflow.org/health"
+QUARANTINE_ANNOTATION = "kubeflow.org/quarantine"
+SUSPECT_ANNOTATION = "scheduling.kubeflow.org/suspect-host"
+
 # apiVersion per kind (reference CRD groups/versions)
 API_VERSIONS = {
     "TPUJob": TPU_API_VERSION,

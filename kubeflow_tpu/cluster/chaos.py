@@ -234,6 +234,84 @@ def uncommit_checkpoint(step_dir: str) -> None:
         os.remove(marker)
 
 
+# ------------------------------------------------- host-pinned faults
+
+
+@dataclass
+class HostFault:
+    """A RECURRING fault pinned to one host — the failure class the
+    node-health subsystem (scheduler/health.py) exists for. Unlike the
+    one-shot SoakFault menu below, a HostFault keeps firing at pods
+    scheduled onto its node until its ``trips`` budget runs out: a
+    flaky host crash-loops every gang placed on it, however many times
+    the operator restarts the gang — only migrating OFF the host (the
+    suspect/quarantine path) or exhausting the budget (the host
+    "recovers") ends the loop.
+
+    Modes:
+    - ``crash``: fail the pod (kubelet OOM-kill / device wedge class);
+    - ``stall``: freeze the pod's heartbeat annotation ``stall_by_s``
+      in the past (hung-but-not-dead worker — only a per-worker stall
+      watchdog sees it);
+    - ``skew``: advertise a heartbeat step ``skew_steps`` behind
+      (slow-host step inflation: the pod is alive and beating but its
+      steps lag the gang — the straggler signal).
+    """
+
+    node: str
+    mode: str = "crash"
+    trips: int = 3
+    stall_by_s: float = 60.0
+    skew_steps: int = 10
+    fired: int = 0
+
+    MODES = ("crash", "stall", "skew")
+
+    def __post_init__(self):
+        if self.mode not in self.MODES:
+            raise ValueError(f"unknown HostFault mode {self.mode!r} "
+                             f"(choose from {self.MODES})")
+
+    def target_pods(self, cluster, namespace: str) -> list[dict]:
+        """Running pods currently scheduled onto the pinned host."""
+        return sorted(
+            (p for p in cluster.list("v1", "Pod", namespace)
+             if p.get("spec", {}).get("nodeName") == self.node
+             and p.get("status", {}).get("phase") == "Running"),
+            key=k8s.name_of)
+
+    def maybe_fire(self, cluster, namespace: str,
+                   at_step: int = 0) -> Optional[str]:
+        """Fire at the first Running pod on the host, if any and the
+        trips budget allows; returns the victim pod name."""
+        if self.fired >= self.trips:
+            return None
+        pods = self.target_pods(cluster, namespace)
+        if not pods:
+            return None
+        victim = k8s.name_of(pods[0])
+        self.fired += 1
+        if self.mode == "crash":
+            cluster.fail_pod(namespace, victim,
+                             f"chaos: flaky host {self.node}")
+        else:
+            import json as _json
+
+            from ..api.trainingjob import HEARTBEAT_ANNOTATION
+            if self.mode == "stall":
+                payload = {"step": at_step,
+                           "time": time.time() - self.stall_by_s}
+            else:   # skew: alive and beating, steps lagging
+                payload = {"step": max(0, at_step - self.skew_steps),
+                           "time": time.time()}
+            cluster.patch("v1", "Pod", namespace, victim, {
+                "metadata": {"annotations": {
+                    HEARTBEAT_ANNOTATION: _json.dumps(payload)}}})
+        log.info("chaos: host fault %s/%s on %s (trip %d/%d)",
+                 self.mode, victim, self.node, self.fired, self.trips)
+        return victim
+
+
 # ---------------------------------------------------------------- the soak
 
 

@@ -274,6 +274,11 @@ class FakeCluster(KubeClient):
         return free
 
     def _fits(self, pod: dict, free: dict[str, float], node: dict) -> bool:
+        # cordoned nodes take no new pods (kubectl cordon /
+        # spec.unschedulable — the quarantine path relies on this to
+        # keep sub-slice gang pods off a bad host within a pool)
+        if node.get("spec", {}).get("unschedulable"):
+            return False
         sel = pod.get("spec", {}).get("nodeSelector") or {}
         if not all(k8s.labels_of(node).get(a) == b for a, b in sel.items()):
             return False

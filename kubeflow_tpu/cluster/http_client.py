@@ -61,7 +61,8 @@ class HttpKubeClient(KubeClient):
                  client_cert: Optional[tuple[str, str]] = None,
                  basic_auth: Optional[tuple[str, str]] = None,
                  timeout: float = 30.0, sync_watches: bool = False,
-                 retries: int = 3, retry_backoff_s: float = 0.2):
+                 retries: int = 3, retry_backoff_s: float = 0.2,
+                 retry_wall_clock_s: float = 60.0):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         # transient-error budget: a 5xx / connection failure retries up to
@@ -70,8 +71,15 @@ class HttpKubeClient(KubeClient):
         # flake (LB blip, leader election, chaos-injected burst) without
         # burning its reconcile-retry budget. 4xx semantics (NotFound,
         # Conflict, AlreadyExists) are MEANING, not weather: never retried.
+        # A throttling apiserver's Retry-After (429/503) is HONORED — a
+        # server telling us when to come back must not be hammered at our
+        # own jitter cadence during a health-event storm — and the total
+        # sleep across one request's retries is capped at
+        # `retry_wall_clock_s` so honoring it cannot pin a reconcile
+        # worker for minutes.
         self.retries = max(0, int(retries))
         self.retry_backoff_s = retry_backoff_s
+        self.retry_wall_clock_s = retry_wall_clock_s
         # read-your-writes barrier for deterministic drives (tests, CLI
         # apply-then-verify); production reconcilers are level-triggered and
         # don't need it
@@ -145,6 +153,7 @@ class HttpKubeClient(KubeClient):
                  body: Optional[dict] = None) -> dict:
         data = json.dumps(body).encode() if body is not None else None
         delay = self.retry_backoff_s
+        slept = 0.0
         for attempt in range(self.retries + 1):
             req = Request(self.base_url + path, data=data,
                           headers=self._headers, method=method)
@@ -156,16 +165,48 @@ class HttpKubeClient(KubeClient):
                 payload = self._error_payload(e)
                 if attempt < self.retries and self._is_transient(payload):
                     # jitter decorrelates a fleet of controllers hammering
-                    # a recovering apiserver (thundering-herd protection)
+                    # a recovering apiserver (thundering-herd protection);
+                    # a server-sent Retry-After (429/503 throttling) wins
+                    # over our own schedule — the server knows its load
                     sleep = delay * random.uniform(1.0, 1.5)
+                    retry_after = self._retry_after_s(e)
+                    if retry_after is not None:
+                        sleep = max(sleep, retry_after)
+                    if slept + sleep > self.retry_wall_clock_s:
+                        # wall-clock cap: honoring a long Retry-After (or
+                        # stacking backoffs) must not pin this caller past
+                        # the budget — surface the error, the reconcile
+                        # loop's own requeue is the cheaper way to wait
+                        log.warning("%s %s: retry budget exhausted "
+                                    "(%.1fs slept, next wait %.1fs > "
+                                    "%.1fs cap)", method, path, slept,
+                                    sleep, self.retry_wall_clock_s)
+                        raise self._typed_error(payload) from None
                     log.warning("%s %s transient (%s); retry %d/%d in "
                                 "%.2fs", method, path,
                                 payload.get("reason", "?"), attempt + 1,
                                 self.retries, sleep)
                     time.sleep(sleep)
+                    slept += sleep
                     delay *= 2
                     continue
                 raise self._typed_error(payload) from None
+
+    @staticmethod
+    def _retry_after_s(e: Exception) -> Optional[float]:
+        """The server's Retry-After in seconds, when the error carries
+        one (numeric form only — the HTTP-date form is not worth a
+        parser here; unparseable reads as absent)."""
+        headers = getattr(e, "headers", None)
+        if headers is None:
+            return None
+        raw = headers.get("Retry-After")
+        if raw is None:
+            return None
+        try:
+            return max(0.0, float(raw))
+        except (TypeError, ValueError):
+            return None
 
     @staticmethod
     def _is_transient(payload: dict) -> bool:

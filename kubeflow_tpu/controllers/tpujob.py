@@ -48,12 +48,13 @@ from ..api.trainingjob import (API_VERSIONS,
                                JOB_KINDS, POD_FAILED,
                                POD_RUNNING, POD_SUCCEEDED,
                                PREEMPTED_COUNT_ANNOTATION,
-                               SCHED_REASON_ANNOTATION, ReplicaSpec,
-                               TrainingJob)
+                               SCHED_REASON_ANNOTATION, SUSPECT_ANNOTATION,
+                               ReplicaSpec, TrainingJob)
 from ..cluster.client import KubeClient, NotFoundError
 from ..cluster.fake import POD_GROUP_LABEL, TPU_RESOURCE
 from ..obs import registry as obsreg
 from ..obs.trace import SPAN_PATH_ENV, TRACE_ID_ANNOTATION, TRACE_ID_ENV
+from ..scheduler import health
 from ..scheduler.inventory import POOL_LABEL, Placement, SliceRect
 from .runtime import (Key, Reconciler, Result, ensure_trace_id,
                       trace_job_event)
@@ -111,6 +112,16 @@ class TrainingJobReconciler(Reconciler):
         # last exported phase per job key (the gang phase gauge clears a
         # job's previous-phase series instead of exporting two phases)
         self._exported_phase: dict[Key, str] = {}
+        # Future-stamped heartbeats (worker clock ahead of ours): the
+        # clamp state. (namespace, pod) -> (raw_beat, first_seen_at) —
+        # staleness for a future beat is measured from when WE first saw
+        # that value, so a skewed-but-hung worker still trips the stall
+        # watchdog one timeout after we noticed it, instead of being
+        # infinitely fresh until our clock catches its skew up.
+        self._future_beats: dict[tuple, tuple] = {}
+        # consecutive reconciles a worker trailed the chief's step by
+        # >= health.STEP_SKEW_MIN_STEPS: (ns, job, pod) -> streak
+        self._skew_streak: dict[tuple, int] = {}
 
     # ------------------------------------------------------------ reconcile
 
@@ -238,7 +249,10 @@ class TrainingJobReconciler(Reconciler):
 
         failed = [n for n, ph in phases.items() if ph == POD_FAILED]
         if failed:
-            return self._handle_gang_failure(client, job, manifest, pods, failed)
+            return self._handle_gang_failure(
+                client, job, manifest, pods, failed,
+                suspect=self._suspect_node(by_name, failed),
+                evidence=health.EVENT_POD_CRASH)
 
         # stall watchdog: a chief that is Running but has stopped advancing
         # its heartbeat is hung-not-dead (wedged collective, dead TPU
@@ -247,7 +261,28 @@ class TrainingJobReconciler(Reconciler):
         stalled = self._stalled_chief(job, manifest, by_name, chief)
         if stalled:
             return self._handle_gang_failure(
-                client, job, manifest, pods, [chief], reason="StallTimeout")
+                client, job, manifest, pods, [chief], reason="StallTimeout",
+                suspect=self._suspect_node(by_name, [chief]),
+                evidence=health.EVENT_STALL)
+
+        # per-worker stall: one wedged worker under a healthy chief (the
+        # straggler-gone-dead case the chief-only watchdog misses) — the
+        # fault is attributable to the stalled worker's host, so the
+        # restart records it as the suspect and the scheduler migrates
+        # the gang instead of restarting onto the same flaky host
+        stalled_workers = self._stalled_workers(job, manifest, by_name,
+                                                tpu_names, chief)
+        if stalled_workers:
+            return self._handle_gang_failure(
+                client, job, manifest, pods, stalled_workers,
+                reason="WorkerStallTimeout",
+                suspect=self._suspect_node(by_name, stalled_workers),
+                evidence=health.EVENT_WORKER_STALL)
+
+        # straggler scoring (no teardown): per-worker step skew off the
+        # heartbeat steps feeds the host health score
+        if tpu_names:
+            self._note_step_skew(job, by_name, tpu_names, chief, client)
 
         running = sum(1 for ph in phases.values() if ph == POD_RUNNING)
         self._finalize_status(client, manifest, pods,
@@ -283,12 +318,35 @@ class TrainingJobReconciler(Reconciler):
         if prev is not None:
             g.remove(namespace=namespace, name=name, kind=self.kind,
                      phase=prev)
+        if phase in (None, COND_SUCCEEDED, COND_FAILED):
+            # done or gone: the per-job watchdog/straggler state has
+            # nothing left to watch — a long-lived controller must not
+            # accumulate entries (or stale skew series) for every job
+            # that ever stalled
+            self._prune_job_state(namespace, name)
         if phase is None:
             self._exported_phase.pop(key, None)
             return
         g.labels(namespace=namespace, name=name, kind=self.kind,
                  phase=phase).set(1)
         self._exported_phase[key] = phase
+
+    def _prune_job_state(self, namespace: str, name: str) -> None:
+        """Drop the in-memory heartbeat-clamp and skew-streak entries
+        for one job's pods (pod names are '<job>-<role>-...'), and its
+        skew gauge series."""
+        prefix = f"{name}-"
+        self._future_beats = {
+            k: v for k, v in self._future_beats.items()
+            if not (k[0] == namespace and k[1].startswith(prefix))}
+        self._skew_streak = {
+            k: v for k, v in self._skew_streak.items()
+            if not (k[0] == namespace and k[1] == name)}
+        obsreg.gauge(
+            "kftpu_job_step_skew",
+            "chief step minus the slowest worker's heartbeat step",
+            labels=("namespace", "name")).remove(
+                namespace=namespace, name=name)
 
     def _trace_event(self, manifest: dict, name: str, **attrs) -> None:
         trace_job_event("operator", manifest, name, **attrs)
@@ -693,6 +751,47 @@ class TrainingJobReconciler(Reconciler):
         except (TypeError, ValueError):
             return 0.0
 
+    @staticmethod
+    def _beat_of(pod: dict | None) -> tuple[float, int] | None:
+        """The pod's heartbeat (time, step), or None when absent or
+        malformed — a bad annotation must degrade to "no heartbeat",
+        never crash the reconcile loop."""
+        if pod is None:
+            return None
+        raw = k8s.annotations_of(pod).get(HEARTBEAT_ANNOTATION)
+        if not raw:
+            return None
+        try:
+            d = json.loads(raw)
+            beat = float(d.get("time", 0))
+            step = int(d.get("step", 0))
+        except (AttributeError, TypeError, ValueError):
+            # AttributeError: valid JSON that isn't an object ("3",
+            # "null")
+            return None
+        return (beat, step) if beat else None
+
+    def _beat_age(self, namespace: str, pod_name: str, beat: float,
+                  now: float) -> float:
+        """Heartbeat staleness with the clock-skew clamp. A beat
+        stamped in the FUTURE (worker clock ahead of the controller's)
+        is clamped to the moment we first observed that value — without
+        the clamp a hung worker with, say, an hour of skew reads as
+        infinitely fresh for an hour and the stall watchdog never fires
+        on time. A fresh (changing) beat clears the clamp state. The
+        first-seen map is in-memory: a controller restart re-clamps a
+        still-future beat to the restart time, delaying detection by at
+        most one stall timeout — the safe direction."""
+        key = (namespace, pod_name)
+        if beat <= now:
+            self._future_beats.pop(key, None)
+            return now - beat
+        seen = self._future_beats.get(key)
+        if seen is None or seen[0] != beat:
+            self._future_beats[key] = (beat, now)
+            return 0.0
+        return now - seen[1]
+
     def _stalled_chief(self, job: TrainingJob, manifest: dict,
                        by_name: dict[str, dict], chief: str) -> bool:
         """Whether the chief's heartbeat annotation is staler than
@@ -705,23 +804,111 @@ class TrainingJobReconciler(Reconciler):
         if pod is None or \
                 pod.get("status", {}).get("phase") != POD_RUNNING:
             return False
-        raw = k8s.annotations_of(pod).get(HEARTBEAT_ANNOTATION)
-        if not raw:
+        beat = self._beat_of(pod)
+        if beat is None:
             return False
-        try:
-            beat = float(json.loads(raw).get("time", 0))
-        except (AttributeError, TypeError, ValueError):
-            # AttributeError: valid JSON that isn't an object ("3",
-            # "null") — a malformed annotation must degrade to "no
-            # heartbeat", never crash the reconcile loop
-            return False
-        return bool(beat) and _now() - beat > timeout
+        return self._beat_age(job.namespace, chief, beat[0],
+                              _now()) > timeout
+
+    def _stalled_workers(self, job: TrainingJob, manifest: dict,
+                         by_name: dict[str, dict],
+                         tpu_names: list[str], chief: str) -> list[str]:
+        """Per-worker stall: Running non-chief members whose heartbeat
+        is staler than stallTimeoutSeconds. Catches the straggler
+        failure mode the chief-only watchdog is blind to — one wedged
+        worker, healthy chief (the chief keeps beating while the
+        collective stalls inside the step). Same contract as the chief
+        watchdog: no heartbeat, no verdict."""
+        timeout = job.run_policy.stall_timeout_seconds
+        if not timeout or k8s.condition_true(manifest, COND_RESTARTING):
+            return []
+        now = _now()
+        stalled = []
+        for name in tpu_names:
+            if name == chief:
+                continue
+            pod = by_name.get(name)
+            if pod is None or \
+                    pod.get("status", {}).get("phase") != POD_RUNNING:
+                continue
+            beat = self._beat_of(pod)
+            if beat is None:
+                continue
+            if self._beat_age(job.namespace, name, beat[0], now) > timeout:
+                stalled.append(name)
+        return stalled
+
+    def _note_step_skew(self, job: TrainingJob, by_name: dict[str, dict],
+                        tpu_names: list[str], chief: str,
+                        client: KubeClient) -> None:
+        """Straggler scoring from per-worker heartbeat steps: a worker
+        whose FRESH heartbeat trails the chief's step by
+        health.STEP_SKEW_MIN_STEPS on STEP_SKEW_STREAK consecutive
+        reconciles folds one step-skew event into its host's health
+        score (scheduler/health.py) — soft evidence that accumulates
+        toward quarantine without tearing anything down. The max skew
+        is exported as a gauge so dashboards see the straggler before
+        the score moves."""
+        now = _now()
+        # freshness bound: the stall timeout when the job runs a
+        # watchdog, the shared default otherwise — a STALE beat is a
+        # hung worker (the watchdogs' business), not a slow host
+        fresh_s = job.run_policy.stall_timeout_seconds or \
+            health.STEP_SKEW_FRESH_S
+        chief_beat = self._beat_of(by_name.get(chief))
+        if chief_beat is None or \
+                self._beat_age(job.namespace, chief,
+                               chief_beat[0], now) > fresh_s:
+            return
+        max_skew = 0
+        for name in tpu_names:
+            if name == chief:
+                continue
+            key = (job.namespace, job.name, name)
+            beat = self._beat_of(by_name.get(name))
+            fresh = beat is not None and self._beat_age(
+                job.namespace, name, beat[0], now) <= fresh_s
+            skew = (chief_beat[1] - beat[1]) if fresh else 0
+            if not fresh or skew < health.STEP_SKEW_MIN_STEPS:
+                self._skew_streak.pop(key, None)
+                continue
+            max_skew = max(max_skew, skew)
+            streak = self._skew_streak.get(key, 0) + 1
+            if streak >= health.STEP_SKEW_STREAK:
+                self._skew_streak[key] = 0
+                node = by_name[name].get("spec", {}).get("nodeName")
+                if node:
+                    health.record_host_event(
+                        client, node, health.EVENT_STEP_SKEW,
+                        job_key=f"{job.namespace}/{job.name}")
+            else:
+                self._skew_streak[key] = streak
+        obsreg.gauge(
+            "kftpu_job_step_skew",
+            "chief step minus the slowest worker's heartbeat step",
+            labels=("namespace", "name")).labels(
+                namespace=job.namespace, name=job.name).set(max_skew)
+
+    @staticmethod
+    def _suspect_node(by_name: dict[str, dict],
+                      pod_names: list[str]) -> str | None:
+        """The single host a failure is attributable to: every failed/
+        stalled pod ran on the same node. Multi-host failures (a whole
+        pool losing power, a fleet preemption) attribute to nobody —
+        migrating off one host would not help."""
+        nodes = {by_name[n].get("spec", {}).get("nodeName")
+                 for n in pod_names if n in by_name}
+        nodes.discard(None)
+        nodes.discard("")
+        return nodes.pop() if len(nodes) == 1 else None
 
     def _handle_gang_failure(self, client: KubeClient, job: TrainingJob,
                              manifest: dict, pods: list[dict],
                              failed: list[str],
                              reason: str = "GangRestart",
-                             count_restart: bool = True) -> Result:
+                             count_restart: bool = True,
+                             suspect: str | None = None,
+                             evidence: str | None = None) -> Result:
         restarts = int(k8s.annotations_of(manifest).get(
             RESTART_COUNT_ANNOTATION, "0"))
         if count_restart and restarts >= job.run_policy.backoff_limit:
@@ -742,6 +929,12 @@ class TrainingJobReconciler(Reconciler):
         if count_restart:
             patch["metadata"]["annotations"][RESTART_COUNT_ANNOTATION] = \
                 str(restarts + 1)
+        if suspect and job.scheduling_policy is not None:
+            # failure-domain-aware rebind: record the host this teardown
+            # is attributable to; the scheduler replans the binding
+            # EXCLUDING its cells (scheduler/core.py) so the gang
+            # migrates instead of crash-looping on the same hardware
+            patch["metadata"]["annotations"][SUSPECT_ANNOTATION] = suspect
         rp = job.run_policy
         delay = 0.0
         if count_restart and rp.restart_backoff_seconds > 0:
@@ -763,6 +956,13 @@ class TrainingJobReconciler(Reconciler):
         patched = client.patch(*k8s.key_of(manifest), patch) \
             if (patch["metadata"]["annotations"] or "spec" in patch) \
             else manifest
+        if suspect and evidence:
+            # fold the failure into the host's health score (the
+            # quarantine feedback loop); best-effort by contract —
+            # evidence must never block the restart itself
+            health.record_host_event(client, suspect, evidence,
+                                     job_key=f"{job.namespace}/{job.name}",
+                                     now=_now())
         # counted AFTER the deletes/patch succeeded: a transient error in
         # the side effects above requeues and re-runs this path, and the
         # retry must not read as a second restart

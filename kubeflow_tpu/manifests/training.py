@@ -86,6 +86,10 @@ def _operator_deployment(namespace: str, gang_scheduling: bool) -> list[dict]:
         {"apiGroups": [""],
          "resources": ["pods", "services", "events", "configmaps"],
          "verbs": ["*"]},
+        # node-health evidence: the operator folds failure events into
+        # the kubeflow.org/health node annotation (scheduler/health.py)
+        {"apiGroups": [""], "resources": ["nodes"],
+         "verbs": ["get", "list", "watch", "patch"]},
         # gang-scheduling RBAC, the kube-batch podgroups rule analog
         # (tf-job-operator.libsonnet:298-307)
         *([{"apiGroups": ["scheduling.kubeflow.org"],
@@ -181,27 +185,46 @@ def paddle_operator(namespace: str = "kubeflow") -> list[dict]:
 def tpu_scheduler(namespace: str = "kubeflow",
                   backfill: bool = True,
                   preemption: bool = True,
-                  queues: dict | None = None) -> list[dict]:
+                  queues: dict | None = None,
+                  health: dict | None = None) -> list[dict]:
     """``queues`` is the SchedulerConfig wire shape
     (scheduler/queue.py), e.g. ``{"research": {"quotaChips":
     {"team-a": 32, "*": 64}}}`` — per-queue, per-namespace bound-chip
-    quotas ("*" is the default for unlisted namespaces)."""
+    quotas ("*" is the default for unlisted namespaces). ``health`` is
+    the node-health policy block (scheduler/health.py HealthConfig wire
+    shape): ``{"enabled": true, "halfLifeSeconds": 600,
+    "quarantineThreshold": 3, "releaseThreshold": 1,
+    "quarantineSeconds": 900}`` — omitted keys keep the defaults;
+    ``{"enabled": false}`` turns the whole quarantine feedback loop
+    off (docs/operations.md "Node health and quarantine")."""
     import json
+
+    from ..scheduler.health import HealthConfig
     sa = H.service_account("tpu-scheduler", namespace)
     role = H.cluster_role("tpu-scheduler", [
         {"apiGroups": ["tpu.kubeflow.org"],
          "resources": ["tpujobs"], "verbs": ["get", "list", "watch",
                                              "patch", "update"]},
         {"apiGroups": [""],
-         "resources": ["nodes", "pods", "configmaps"],
+         "resources": ["pods", "configmaps"],
          "verbs": ["get", "list", "watch"]},
+        # nodes are read AND written: the health pass patches the
+        # quarantine / health-score annotations (scheduler/health.py)
+        {"apiGroups": [""], "resources": ["nodes"],
+         "verbs": ["get", "list", "watch", "patch"]},
     ])
     binding = H.cluster_role_binding("tpu-scheduler", "tpu-scheduler",
                                      "tpu-scheduler", namespace)
     cm = H.config_map("tpu-scheduler-config", namespace, {
         "config.json": json.dumps({
             "backfill": backfill, "preemption": preemption,
-            "queues": queues or {}}, indent=1),
+            "queues": queues or {},
+            # render the FULL health block (defaults made explicit) so
+            # the deployed knobs are discoverable with kubectl, and
+            # round-trip through HealthConfig so a typo'd key fails at
+            # render time, not silently at scheduler parse time
+            "health": HealthConfig.from_dict(health).to_dict()},
+            indent=1),
     })
     from .observability import METRICS_PORT, scrape_annotations
     dep = H.deployment("tpu-scheduler", namespace,

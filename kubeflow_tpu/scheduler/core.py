@@ -37,14 +37,16 @@ from typing import Optional
 from ..api import k8s
 from ..api.trainingjob import (BINDING_ANNOTATION, COND_FAILED,
                                COND_SUCCEEDED, PREEMPTED_COUNT_ANNOTATION,
+                               QUARANTINE_ANNOTATION,
                                SCHED_REASON_ANNOTATION,
-                               SCHED_STATE_ANNOTATION, TPU_API_VERSION,
-                               TrainingJob)
+                               SCHED_STATE_ANNOTATION, SUSPECT_ANNOTATION,
+                               TPU_API_VERSION, TrainingJob)
 from ..cluster.client import KubeClient, NotFoundError
 from ..controllers.runtime import (Key, Reconciler, Result,
                                    ensure_trace_id, trace_job_event)
 from ..obs import registry as obsreg
-from .inventory import Placement, SliceInventory
+from . import health
+from .inventory import POOL_LABEL, Placement, SliceInventory
 from .queue import (JobRequest, SchedulerConfig, binding_matches,
                     binding_of, ordered, over_quota, request_of)
 
@@ -68,7 +70,8 @@ class Plan:
 
 
 def _preempt_for(head: JobRequest, bound: list,
-                 inventory: SliceInventory) -> Optional[list]:
+                 inventory: SliceInventory,
+                 avoid: Optional[set] = None) -> Optional[list]:
     """Cheapest victim set that lets ``head`` fit, or None. Victims must
     be lower priority AND preemptible; candidates are released
     greedily cheapest-first (fewest chips, then lowest priority, then
@@ -95,7 +98,8 @@ def _preempt_for(head: JobRequest, bound: list,
     for victim in candidates:
         inventory.release(victim.key)
         victims.append(victim)
-        if inventory.place_gang(head.topology, head.num_slices) is not None:
+        if inventory.place_gang(head.topology, head.num_slices,
+                                avoid=avoid) is not None:
             fits = True
             break
     if not fits:
@@ -107,7 +111,8 @@ def _preempt_for(head: JobRequest, bound: list,
     # cost when either would do
     for victim in sorted(victims, key=lambda r: -r.chips):
         inventory.bind(victim.key, placements[victim.key])
-        if inventory.place_gang(head.topology, head.num_slices) is not None:
+        if inventory.place_gang(head.topology, head.num_slices,
+                                avoid=avoid) is not None:
             victims.remove(victim)    # not actually in the way
         else:
             inventory.release(victim.key)
@@ -115,12 +120,17 @@ def _preempt_for(head: JobRequest, bound: list,
 
 
 def plan(queued: list[JobRequest], bound: list,
-         inventory: SliceInventory, config: SchedulerConfig) -> Plan:
+         inventory: SliceInventory, config: SchedulerConfig,
+         avoid_cells: Optional[dict] = None) -> Plan:
     """Pure planning over a pre-occupied inventory. ``bound`` is
     [(JobRequest, Placement)] for every currently bound gang (their cells
-    already occupied in ``inventory``). Mutates the inventory to reflect
-    its own decisions (callers pass a throwaway rebuild)."""
+    already occupied in ``inventory``). ``avoid_cells`` maps a job key to
+    cells ITS placement must keep clear of — the suspect-host exclusion:
+    a job evacuating a flaky host must not be re-placed onto it even
+    while the host is still formally schedulable. Mutates the inventory
+    to reflect its own decisions (callers pass a throwaway rebuild)."""
     out = Plan()
+    avoid_cells = avoid_cells or {}
     live_bound = list(bound)
     reserved: set = set()
     head_blocked = False
@@ -133,8 +143,18 @@ def plan(queued: list[JobRequest], bound: list,
         if head_blocked and not config.backfill:
             out.waits[req.key] = "waiting: behind blocked head of line"
             continue
+        req_avoid = reserved | avoid_cells.get(req.key, set())
         placement = inventory.place_gang(req.topology, req.num_slices,
-                                         avoid=reserved or None)
+                                         avoid=req_avoid or None)
+        if placement is None and avoid_cells.get(req.key):
+            # suspect exclusion is PREFERENCE, not a constraint: when
+            # no placement clear of the suspect exists (single-pool
+            # cluster, full-pool gang), running on the suspect beats
+            # starving forever — retry honoring only the head-of-line
+            # reservation, which must never be violated
+            placement = inventory.place_gang(req.topology,
+                                             req.num_slices,
+                                             avoid=reserved or None)
         if placement is not None:
             inventory.bind(req.key, placement)
             out.binds.append((req, placement))
@@ -145,23 +165,37 @@ def plan(queued: list[JobRequest], bound: list,
                                  "(backfill could not place clear of " \
                                  "the head-of-line reservation)"
             continue
-        # the blocked head of line: try preemption, else reserve
+        # the blocked head of line: try preemption, else reserve — the
+        # suspect exclusion stays preference-only here too: a head that
+        # cannot preempt or reserve clear of its suspect falls back to
+        # ignoring it rather than deadlocking the queue
+        head_avoid = avoid_cells.get(req.key, set())
         if config.preemption:
-            victims = _preempt_for(req, live_bound, inventory)
+            victims = _preempt_for(req, live_bound, inventory,
+                                   avoid=head_avoid or None)
+            if victims is None and head_avoid:
+                victims = _preempt_for(req, live_bound, inventory)
+                if victims is not None:
+                    head_avoid = set()
             if victims is not None:
                 victim_keys = {v.key for v in victims}
                 live_bound = [(r, p) for r, p in live_bound
                               if r.key not in victim_keys]
                 out.preempts.extend(victims)
                 placement = inventory.place_gang(req.topology,
-                                                 req.num_slices)
+                                                 req.num_slices,
+                                                 avoid=head_avoid or None)
                 if placement is not None:
                     inventory.bind(req.key, placement)
                     out.binds.append((req, placement))
                     live_bound.append((req, placement))
                     continue
         head_blocked = True
-        reserved = inventory.reserve_for(req.topology, req.num_slices)
+        reserved = inventory.reserve_for(req.topology, req.num_slices,
+                                         avoid=head_avoid or None)
+        if not reserved and head_avoid:
+            reserved = inventory.reserve_for(req.topology,
+                                             req.num_slices)
         out.waits[req.key] = (
             "capacity: head of line, waiting for reserved slices to "
             "drain" if reserved else
@@ -195,6 +229,14 @@ class SliceScheduler(Reconciler):
         # queues ever exported, so a queue that drains to zero exports
         # zeros instead of its stale last depth
         self._known_queues: set = set()
+        # last Ready state per TPU node: a True→False transition folds a
+        # not-ready health event (flappy hosts quarantine themselves);
+        # tracked even with health disabled so re-enabling does not read
+        # one old flap as fresh evidence
+        self._node_ready: dict[str, bool] = {}
+        # nodes whose health gauges were exported (deleted nodes must
+        # drop their series, not freeze their last score)
+        self._health_exported: set = set()
         self.primary = (TPU_API_VERSION, "TPUJob")
         # reconcile-metrics label (controllers/runtime.py): the primary
         # kind is TPUJob here too, and the operator owns that label
@@ -233,16 +275,149 @@ class SliceScheduler(Reconciler):
             return [("", "#cluster-pass")]
         return []
 
+    # ---------------------------------------------------------- node health
+
+    def _health_pass(self, client: KubeClient, nodes: list[dict],
+                     now: float) -> list[dict]:
+        """Score, quarantine, and release TPU hosts from the failure
+        evidence in their health annotations (scheduler/health.py).
+        Write-on-change throughout: a steady-state pass writes nothing.
+        Returns the node list with this pass's patches folded in, so
+        the inventory built right after sees them."""
+        cfg = self.config.health
+        score_g = obsreg.gauge(
+            "kftpu_node_health_score",
+            "decayed failure score per TPU host (scheduler/health.py)",
+            labels=("node",))
+        quar_g = obsreg.gauge(
+            "kftpu_node_quarantined",
+            "1 while the host carries the quarantine annotation",
+            labels=("node",))
+        tracer_event = None
+        from ..obs.trace import default_tracer
+        tracer = default_tracer("scheduler")
+        if tracer is not None:
+            tracer_event = tracer.event
+        out, seen = [], set()
+        _UNSET = object()
+        for node in nodes:
+            name = k8s.name_of(node)
+            if POOL_LABEL not in k8s.labels_of(node):
+                out.append(node)
+                continue
+            seen.add(name)
+            ready = k8s.condition_true(node, "Ready")
+            flapped = self._node_ready.get(name) is True and not ready
+            self._node_ready[name] = ready
+            if cfg.enabled and flapped:
+                # Ready→NotReady transition: evidence, exactly once per
+                # flap — a chronically flapping host earns quarantine
+                rec = health.record_host_event(
+                    client, name, health.EVENT_NOT_READY, now=now,
+                    half_life_s=cfg.half_life_s)
+                if rec is not None:
+                    node = client.get_or_none("v1", "Node", "", name) \
+                        or node
+            score = health.decayed_score(node, now, cfg.half_life_s)
+            quarantine = health.quarantine_of(node)
+            patch_val = _UNSET
+            # spec.unschedulable to set alongside (None = untouched):
+            # cell carving alone cannot stop the kube scheduler from
+            # placing a SUB-SLICE gang's pods back on the host (pods
+            # pin by pool label only) — the cordon closes that hole
+            cordon = None
+            if cfg.enabled:
+                if quarantine is None and \
+                        score >= cfg.quarantine_threshold:
+                    patch_val = health.quarantine_record(
+                        f"health score {score:.2f} >= "
+                        f"{cfg.quarantine_threshold:g}", score, now,
+                        cfg.quarantine_s, cordoned=True)
+                    cordon = True
+                    obsreg.counter(
+                        "kftpu_sched_quarantines_total",
+                        "hosts quarantined for crossing the health "
+                        "threshold").inc()
+                    if tracer_event:
+                        tracer_event("node-quarantined", node=name,
+                                     score=round(score, 3))
+                    log.warning("scheduler: quarantining %s "
+                                "(score %.2f)", name, score)
+                elif quarantine is not None and \
+                        health.release_eligible(node, cfg, now):
+                    patch_val = None   # kube null-delete
+                    if quarantine["cordoned"]:
+                        cordon = False  # only OUR cordon is undone
+                    obsreg.counter(
+                        "kftpu_sched_quarantine_releases_total",
+                        "quarantines auto-released after expiry + score "
+                        "decay (probation)").inc()
+                    if tracer_event:
+                        tracer_event("node-released", node=name,
+                                     score=round(score, 3))
+                    log.info("scheduler: releasing %s from quarantine "
+                             "(score %.2f)", name, score)
+                elif quarantine is not None \
+                        and quarantine["until"] is not None \
+                        and now >= quarantine["until"] \
+                        and quarantine["reason"] != health.MANUAL_REASON:
+                    # expired but still hot: extend (probation re-up),
+                    # one write per expiry period
+                    patch_val = health.quarantine_record(
+                        quarantine["reason"], score, now,
+                        cfg.quarantine_s,
+                        cordoned=quarantine["cordoned"])
+            elif quarantine is not None and \
+                    quarantine["reason"] != health.MANUAL_REASON:
+                # health switched OFF: release every auto-quarantine
+                # now — "placement-blind" must not strand chips behind
+                # annotations nothing will ever expire (manual
+                # quarantines are a human's call and stay)
+                patch_val = None
+                if quarantine["cordoned"]:
+                    cordon = False
+                obsreg.counter(
+                    "kftpu_sched_quarantine_releases_total",
+                    "quarantines auto-released after expiry + score "
+                    "decay (probation)").inc()
+                log.info("scheduler: health disabled; releasing %s "
+                         "from quarantine", name)
+            if patch_val is not _UNSET:
+                body: dict = {"metadata": {"annotations": {
+                    QUARANTINE_ANNOTATION: patch_val}}}
+                if cordon is not None:
+                    body["spec"] = {"unschedulable": cordon}
+                try:
+                    node = client.patch("v1", "Node", "", name, body)
+                except Exception as e:  # noqa: BLE001 — health writes
+                    # must never take down the scheduling pass
+                    log.warning("scheduler: quarantine patch for %s "
+                                "failed: %s", name, e)
+            score_g.labels(node=name).set(round(score, 6))
+            quar_g.labels(node=name).set(
+                1 if health.is_quarantined(node) else 0)
+            out.append(node)
+        for stale in self._health_exported - seen:
+            score_g.remove(node=stale)
+            quar_g.remove(node=stale)
+            self._node_ready.pop(stale, None)
+        self._health_exported = seen
+        return out
+
     # ------------------------------------------------------------- the pass
 
     def reconcile(self, client: KubeClient, key: Key) -> Result:
         del key  # every pass is cluster-wide
         t_pass = time.perf_counter()
+        now = time.time()
         self._refresh_config(client)
-        inventory = SliceInventory.from_nodes(client.list("v1", "Node"))
+        nodes = self._health_pass(client, client.list("v1", "Node"), now)
+        inventory = SliceInventory.from_nodes(nodes)
+        health_on = self.config.health.enabled
         queued: list[JobRequest] = []
         bound: list = []
         manifests: dict[str, dict] = {}
+        avoid_cells: dict[str, set] = {}
         for manifest in client.list(*self.primary):
             if k8s.condition_true(manifest, COND_SUCCEEDED) or \
                     k8s.condition_true(manifest, COND_FAILED):
@@ -261,6 +436,30 @@ class SliceScheduler(Reconciler):
             ok = placement is not None \
                 and binding_matches(placement, job) \
                 and inventory.valid_binding(placement)
+            suspect = health.suspect_of(manifest) if health_on else None
+            suspect_cells = inventory.cells_by_node.get(suspect, set()) \
+                if suspect else set()
+            if ok and suspect_cells and any(
+                    not suspect_cells.isdisjoint(r.cells())
+                    for r in placement.slices):
+                # failure-domain-aware rebind: the operator pinned this
+                # gang's last teardown on a host the binding still
+                # covers — evacuate instead of crash-looping in place
+                log.info("scheduler: evacuating %s off suspect host %s",
+                         req.key, suspect)
+                self._patch_state(client, manifest, STATE_QUEUED,
+                                  f"rebinding: evacuating suspect host "
+                                  f"{suspect}", binding=None)
+                # counted AFTER the patch succeeded (the pass-wide
+                # invariant): a transient apiserver error above requeues
+                # the pass, and the retry must not double-count
+                obsreg.counter(
+                    "kftpu_sched_suspect_evacuations_total",
+                    "bindings dropped to migrate a gang off a suspect "
+                    "host").inc()
+                self._trace_event(manifest, "evacuating-suspect",
+                                  node=suspect)
+                ok = False
             if ok:
                 try:
                     inventory.bind(req.key, placement)
@@ -273,19 +472,40 @@ class SliceScheduler(Reconciler):
                     log.warning("scheduler: conflicting binding for "
                                 "%s (%s); requeueing it", req.key, e)
                     ok = False
-            if ok:
-                bound.append((req, placement))
-            else:
-                if placement is not None:
-                    # stale/conflicting binding (spec reshaped under
-                    # it, pool gone, cells double-booked): drop it so
-                    # the job re-queues cleanly
                     self._patch_state(client, manifest, STATE_QUEUED,
                                       "rebinding: binding no longer "
                                       "matches spec/pools", binding=None)
+                    queued.append(req)
+                    if suspect_cells:
+                        avoid_cells[req.key] = suspect_cells
+                    continue
+            if ok:
+                bound.append((req, placement))
+                if suspect:
+                    # bound clear of the suspect (already migrated, or
+                    # the node left the cluster): the record is spent —
+                    # clear it so future replans stop avoiding the host
+                    self._clear_suspect(client, manifest)
+            else:
+                if placement is not None and \
+                        binding_of(manifests[req.key]) is not None and \
+                        not suspect_cells:
+                    # stale binding (spec reshaped under it, pool gone,
+                    # host down/quarantined): drop it so the job
+                    # re-queues cleanly
+                    self._patch_state(client, manifest, STATE_QUEUED,
+                                      "rebinding: binding no longer "
+                                      "matches spec/pools/hosts",
+                                      binding=None)
                 queued.append(req)
+                if suspect_cells:
+                    # the replan must keep clear of the suspect even
+                    # while the host is still formally schedulable
+                    avoid_cells[req.key] = suspect_cells
         self._note_queued(queued, manifests)
-        decisions = plan(queued, bound, inventory, self.config)
+        inventory.carve_down()
+        decisions = plan(queued, bound, inventory, self.config,
+                         avoid_cells=avoid_cells)
         # metrics/events fire AFTER their patch succeeded (the same
         # invariant as the operator's gang-restart counter): a transient
         # apiserver error requeues the whole pass, and the retry must
@@ -301,8 +521,12 @@ class SliceScheduler(Reconciler):
                               queue=victim.queue, chips=victim.chips)
         now = time.time()
         for req, placement in decisions.binds:
+            # a rebind retires the job's suspect record: the new
+            # placement was planned around it, evidence already folded
+            extra = {SUSPECT_ANNOTATION: None} \
+                if health.suspect_of(manifests[req.key]) else None
             self._patch_state(client, manifests[req.key], STATE_BOUND,
-                              "bound", binding=placement)
+                              "bound", binding=placement, extra=extra)
             waited = now - self._queued_since.pop(req.key, now)
             obsreg.histogram(
                 "kftpu_sched_queue_wait_seconds",
@@ -401,6 +625,13 @@ class SliceScheduler(Reconciler):
                          {"metadata": {"annotations": annotations}})
         except NotFoundError:
             pass   # deleted mid-pass: the delete event re-plans anyway
+
+    def _clear_suspect(self, client: KubeClient, manifest: dict) -> None:
+        try:
+            client.patch(*k8s.key_of(manifest), {
+                "metadata": {"annotations": {SUSPECT_ANNOTATION: None}}})
+        except NotFoundError:
+            pass   # deleted mid-pass: nothing left to clear
 
     def _mark_queued(self, client: KubeClient, manifest: dict,
                      reason: str) -> None:

@@ -34,11 +34,17 @@ from typing import Iterable, Optional
 
 from ..api import k8s
 from ..api.topology import SliceTopology, parse_topology
+from . import health
 
 # node labels the inventory reads (the ones GKE TPU node pools carry and
 # cluster/fake.py add_tpu_slice_nodes renders)
 POOL_LABEL = "kubeflow.org/pool"
 TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+
+# sentinel owner for cells of unavailable hosts (NotReady, quarantined,
+# missing from the node list): carved out of placeable rectangles by
+# carve_down(), never released by a job teardown
+DOWN_OWNER = "\x00down"
 
 
 @dataclass(frozen=True)
@@ -168,46 +174,110 @@ class SliceInventory:
     def __init__(self, pools: Optional[list[PoolState]] = None):
         self.pools: dict[str, PoolState] = {
             p.name: p for p in sorted(pools or [], key=lambda p: p.name)}
+        # cells of unavailable hosts (NotReady / quarantined / missing):
+        # carve_down() occupies the still-free ones AFTER live bindings
+        # re-occupy, so a Ready-condition flap never invalidates a
+        # healthy gang's binding by itself
+        self.down_cells: set = set()
+        # node name -> that host's cells (suspect-evacuation lookups)
+        self.cells_by_node: dict[str, set] = {}
 
     # -- construction -------------------------------------------------------
 
     @classmethod
     def from_nodes(cls, nodes: list[dict]) -> "SliceInventory":
-        """Group Ready nodes by pool label; each labeled pool is one
-        physical slice of its topology label's mesh (the shape
-        cluster/fake.py add_tpu_slice_nodes provisions and GKE TPU node
-        pools mirror). A pool missing hosts (cordoned/NotReady nodes)
-        contributes a proportionally truncated grid rather than
-        advertising chips no pod could bind to."""
-        by_pool: dict[str, tuple[SliceTopology, int]] = {}
+        """Group nodes by pool label; each labeled pool is one physical
+        slice of its topology label's mesh (the shape cluster/fake.py
+        add_tpu_slice_nodes provisions and GKE TPU node pools mirror).
+        Every pool advertises its FULL grid; hosts that are NotReady,
+        quarantined (kubeflow.org/quarantine — scheduler/health.py), or
+        missing from the node list contribute their exact cells to
+        ``down_cells`` instead of the old bottom-row truncation, so the
+        carve-out lands on the failing host, not whichever host happened
+        to own the last row. Hosts map to cells row-major in natural
+        node-name order (health.host_cells)."""
+        by_pool: dict[str, tuple[SliceTopology, list]] = {}
         for node in nodes:
             labels = k8s.labels_of(node)
             pool = labels.get(POOL_LABEL)
             topo_name = labels.get(TOPOLOGY_LABEL)
             if not pool or not topo_name:
                 continue
-            if not k8s.condition_true(node, "Ready"):
-                continue
             try:
                 topo = parse_topology(topo_name)
             except ValueError:
                 continue
+            available = k8s.condition_true(node, "Ready") \
+                and not health.is_quarantined(node)
             prev = by_pool.get(pool)
-            by_pool[pool] = (topo, (prev[1] if prev else 0) + 1)
-        pools = []
-        for name, (topo, ready_hosts) in sorted(by_pool.items()):
+            hosts = prev[1] if prev else []
+            hosts.append((k8s.name_of(node), available))
+            by_pool[pool] = (topo, hosts)
+        pools, down, by_node = [], set(), {}
+        for name, (topo, hosts) in sorted(by_pool.items()):
             state = PoolState(name, topo)
-            if ready_hosts < topo.num_hosts:
-                # truncate whole rows from the bottom: chips_per_host
-                # chips vanish per missing host, and a rectangular grid
-                # stays rectangular (rect packing needs that)
-                missing = topo.num_hosts - ready_hosts
-                drop_rows = -(-missing * topo.chips_per_host // state.cols)
-                state.rows = max(0, state.rows - drop_rows)
-                state.grid = state.grid[:state.rows]
-            if state.rows:
-                pools.append(state)
-        return cls(pools)
+            hosts.sort(key=lambda h: health.host_sort_key(h[0]))
+            # Host index comes from the node's NAME (its trailing
+            # integer) when the POOL parses consistently — every name
+            # yields a distinct in-range index — so a deleted middle
+            # node does not shift its neighbors' cell attribution one
+            # block over (positional assignment would carve/quarantine
+            # the wrong chips). A pool whose names do NOT form such a
+            # set (hash-suffixed GKE names where trailing digits are
+            # noise, duplicates, out-of-range) falls back to positional
+            # assignment for the WHOLE pool: consistent-but-wrong beats
+            # half-trusted, and the natural sort keeps it deterministic.
+            name_idx = [health.host_name_index(n) for n, _a in hosts]
+            trusted = (len(hosts) <= topo.num_hosts
+                       and all(i is not None and 0 <= i < topo.num_hosts
+                               for i in name_idx)
+                       and len(set(name_idx)) == len(name_idx))
+            used: set = set()
+            assigned: list = []
+            if trusted:
+                for (node_name, available), idx in zip(hosts, name_idx):
+                    used.add(idx)
+                    assigned.append((node_name, available, idx))
+            else:
+                for idx, (node_name, available) in enumerate(hosts):
+                    if idx >= topo.num_hosts:
+                        break   # more nodes than the topology has hosts
+                    used.add(idx)
+                    assigned.append((node_name, available, idx))
+            for node_name, available, i in assigned:
+                cells = set(health.host_cells(name, topo, i))
+                by_node[node_name] = cells
+                if not available:
+                    down |= cells
+            # hosts the topology expects but no node claims (deleted
+            # node objects): their chips are down too
+            for i in range(topo.num_hosts):
+                if i not in used:
+                    down |= set(health.host_cells(name, topo, i))
+            pools.append(state)
+        inv = cls(pools)
+        inv.down_cells = down
+        inv.cells_by_node = by_node
+        return inv
+
+    def carve_down(self) -> int:
+        """Occupy every still-free down cell with the DOWN sentinel so
+        placement scoring and rect search both see them as unusable.
+        Bindings over down cells were already rejected by
+        valid_binding, so nothing live sits under the carve; repeated
+        Ready-condition flaps are absorbed by write-on-change
+        idempotence plus flap scoring (a chronically flapping host
+        quarantines itself — scheduler/core.py folds a not-ready event
+        per Ready→NotReady transition)."""
+        carved = 0
+        for pool_name, x, y in self.down_cells:
+            pool = self.pools.get(pool_name)
+            if pool is None or x >= pool.rows or y >= pool.cols:
+                continue
+            if not pool.grid[x][y]:
+                pool.grid[x][y] = DOWN_OWNER
+                carved += 1
+        return carved
 
     # -- accounting ---------------------------------------------------------
 
@@ -231,12 +301,18 @@ class SliceInventory:
 
     def valid_binding(self, placement: Placement) -> bool:
         """Whether a persisted binding still fits this inventory's
-        geometry (pool exists, rect in range) — a pool deleted or shrunk
-        under a bound job must requeue it, not crash the pass."""
+        geometry (pool exists, rect in range) AND stays clear of down
+        hosts (NotReady / quarantined / deleted) — a pool deleted, a
+        host lost, or a host quarantined under a bound job must requeue
+        it for a replan, not crash the pass or leave the gang pinned to
+        chips that cannot run it."""
         for rect in placement.slices:
             pool = self.pools.get(rect.pool)
             if pool is None or rect.x + rect.h > pool.rows \
                     or rect.y + rect.w > pool.cols:
+                return False
+            if self.down_cells and not \
+                    self.down_cells.isdisjoint(rect.cells()):
                 return False
         return True
 
@@ -293,22 +369,25 @@ class SliceInventory:
         return Placement(topology=topology.name, num_slices=num_slices,
                          slices=rects)
 
-    def reserve_for(self, topology: SliceTopology,
-                    num_slices: int) -> set:
+    def reserve_for(self, topology: SliceTopology, num_slices: int,
+                    avoid: Optional[set] = None) -> set:
         """The head-of-line reservation: a geometry-only placement
-        (occupancy ignored — those chips will free when their gangs
+        (job occupancy ignored — those chips will free when their gangs
         finish) whose cells backfill jobs must keep clear, so the blocked
-        head's target region only ever DRAINS. Empty set when the request
+        head's target region only ever DRAINS. Down-host cells DO carry
+        into the ghost (a reservation on a dead or quarantined host
+        would never drain), as does the head's own ``avoid`` set (a
+        suspect host the head is evacuating). Empty set when the request
         can never fit this cluster (reserving would deadlock the queue
         behind an impossible job)."""
         ghost = SliceInventory(
             [PoolState(p.name, p.topology) for p in self.pools.values()])
         for name, pool in self.pools.items():
-            # mirror truncated grids (NotReady hosts) into the ghost
-            ghost.pools[name].rows = pool.rows
-            ghost.pools[name].grid = [[""] * pool.cols
-                                      for _ in range(pool.rows)]
-        placement = ghost.place_gang(topology, num_slices)
+            # mirror ONLY the down sentinel: those cells never drain
+            ghost.pools[name].grid = [
+                [c if c == DOWN_OWNER else "" for c in row]
+                for row in pool.grid]
+        placement = ghost.place_gang(topology, num_slices, avoid=avoid)
         if placement is None:
             return set()
         return {cell for rect in placement.slices for cell in rect.cells()}

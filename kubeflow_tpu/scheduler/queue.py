@@ -23,6 +23,7 @@ from ..api import k8s
 from ..api.topology import SliceTopology
 from ..api.trainingjob import (BINDING_ANNOTATION, DEFAULT_QUEUE,
                                TrainingJob)
+from .health import HealthConfig
 from .inventory import Placement
 
 
@@ -57,6 +58,10 @@ class SchedulerConfig:
     preemption: bool = True
     # strict priority ordering; off = pure submission order (FIFO)
     priority_order: bool = True
+    # node-health policy (scheduler/health.py): decay half-life,
+    # quarantine/release thresholds, and the enabled master switch for
+    # the whole feedback loop (scoring, quarantine, suspect evacuation)
+    health: HealthConfig = field(default_factory=HealthConfig)
 
     def queue(self, name: str) -> QueueSpec:
         return self.queues.get(name) or QueueSpec(name)
@@ -72,7 +77,8 @@ class SchedulerConfig:
         return cls(queues=queues,
                    backfill=bool(d.get("backfill", True)),
                    preemption=bool(d.get("preemption", True)),
-                   priority_order=bool(d.get("priorityOrder", True)))
+                   priority_order=bool(d.get("priorityOrder", True)),
+                   health=HealthConfig.from_dict(d.get("health")))
 
 
 @dataclass

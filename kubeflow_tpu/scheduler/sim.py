@@ -23,12 +23,35 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..api.topology import parse_topology
+from . import health
 from .inventory import PoolState, SliceInventory
 from .queue import JobRequest, SchedulerConfig
 from .core import plan
 
 # the three bench arms, in dominance order
 POLICIES = ("fifo", "backfill", "preempt")
+
+
+@dataclass
+class DegradedHost:
+    """A host-pinned recurring fault for the sim (the flaky-host /
+    slow-host class ``bench.py --mode health`` measures): between
+    ``start`` and ``end`` ticks, any gang whose placement covers the
+    host's cells fails every ``fail_every`` ticks — it loses the work
+    since its last checkpoint, exactly the real crash-loop cost. With
+    node-health ON the first failure quarantines the host (its cells
+    carve out of the inventory, the victim requeues and re-places
+    elsewhere); with it OFF the binding is placement-blind and the gang
+    crash-loops in place until the degradation ends."""
+
+    pool: str                # sim pool name ("pool-0-v5e-32")
+    host: int                # host index (row-major cell blocks)
+    start: int
+    end: int
+    fail_every: int = 2
+    # ticks past `end` before a quarantined host is released back (the
+    # probation analog of the real decay-based auto-release)
+    probation: int = 10
 
 
 def policy_config(policy: str,
@@ -112,24 +135,40 @@ def _percentile(values: list, frac: float) -> float:
 def simulate(jobs: list[SimJob], pools: tuple = ("v5e-32",),
              policy: str = "preempt", checkpoint_every: int = 4,
              quotas: Optional[dict] = None,
+             degraded: tuple = (),
+             node_health: bool = True,
              max_ticks: int = 100_000) -> dict:
     """Run one seeded workload to completion under one policy. Returns
-    the metrics row the bench table is built from."""
+    the metrics row the bench table is built from. ``degraded`` is a
+    sequence of DegradedHost events; ``node_health`` flips the
+    quarantine feedback loop (the bench's A/B: with it off, a gang on a
+    degraded host crash-loops in place — the placement-blind
+    baseline)."""
     cfg = policy_config(policy, quotas=quotas)
     fifo = policy == "fifo"
     jobs = sorted(jobs, key=lambda j: (j.arrival, j.name))
     pool_states = [
         PoolState(f"pool-{i}-{name}", parse_topology(name))
         for i, name in enumerate(pools)]
+    pool_by_name = {p.name: p for p in pool_states}
     total_chips = sum(p.total_chips for p in pool_states)
     by_key = {f"{j.namespace}/{j.name}": j for j in jobs}
+
+    def dh_cells(pool_name: str, host: int) -> set:
+        pool = pool_by_name.get(pool_name)
+        if pool is None:
+            return set()
+        return set(health.host_cells(pool_name, pool.topology, host))
 
     pending = list(jobs)            # not yet arrived
     queued: list[tuple[int, SimJob]] = []    # (seq, job)
     bound: dict[str, tuple] = {}    # key -> (JobRequest, Placement)
     seq_of: dict[str, int] = {}     # key -> submission seq (stable)
+    # (pool, host) -> release tick for hosts the health loop pulled
+    quarantined: dict[tuple, int] = {}
     seq_counter = 0
     busy_chip_ticks = 0
+    host_faults = 0
     t = 0
     while t < max_ticks:
         while pending and pending[0].arrival <= t:
@@ -138,12 +177,47 @@ def simulate(jobs: list[SimJob], pools: tuple = ("v5e-32",),
             queued.append((seq_counter, job))
             seq_counter += 1
 
+        # host-pinned faults land before the pass (the operator's
+        # teardown precedes the scheduler's replan in the real loop)
+        for dh in degraded:
+            if not (dh.start <= t < dh.end) or \
+                    (t - dh.start) % dh.fail_every:
+                continue
+            cells = dh_cells(dh.pool, dh.host)
+            for key in list(bound):
+                _req, placement = bound[key]
+                if all(cells.isdisjoint(r.cells())
+                       for r in placement.slices):
+                    continue
+                job = by_key[key]
+                lost = job.done - job.checkpointed
+                job.recomputed += lost
+                job.done = job.checkpointed
+                host_faults += 1
+                if node_health:
+                    # quarantine + failure-domain-aware rebind: the
+                    # host carves out, the victim requeues (ORIGINAL
+                    # seq) and re-places clear of it next pass
+                    quarantined[(dh.pool, dh.host)] = \
+                        dh.end + dh.probation
+                    del bound[key]
+                    queued.append((seq_of[key], job))
+                # placement-blind: the binding survives and the gang
+                # crash-loops in place until the degradation ends
+
         # one scheduler pass over a fresh inventory (exactly what the
         # k8s loop does each reconcile)
         inventory = SliceInventory(
             [PoolState(p.name, p.topology) for p in pool_states])
         for key, (req, placement) in bound.items():
             inventory.bind(key, placement)
+        inventory.down_cells = set()
+        for (pool, host), until in list(quarantined.items()):
+            if t >= until:
+                del quarantined[(pool, host)]   # probation release
+                continue
+            inventory.down_cells |= dh_cells(pool, host)
+        inventory.carve_down()
         requests = [job.request(seq, fifo) for seq, job in queued]
         decisions = plan(requests, list(bound.values()), inventory, cfg)
 
@@ -220,6 +294,10 @@ def simulate(jobs: list[SimJob], pools: tuple = ("v5e-32",),
         if waits else 0.0,
         "preemptions": sum(j.preemptions for j in jobs),
         "recomputed_ticks": sum(j.recomputed for j in jobs),
+        "host_faults": host_faults,
+        "useful_work_fraction": round(
+            sum(j.done for j in jobs)
+            / max(1, sum(j.done + j.recomputed for j in jobs)), 4),
         "unfinished": unfinished,
     }
 
@@ -254,4 +332,53 @@ def compare_policies(seeds: list, n_jobs: int = 24,
                 sum(r[metric] for r in runs) / len(runs), 4)
         agg["unfinished"] = sum(len(r["unfinished"]) for r in runs)
         out[policy] = agg
+    return out
+
+
+def degraded_workload(seed: int, pools: tuple) -> list[DegradedHost]:
+    """Seeded degraded-host schedule for one sim run: one flaky host on
+    the first (largest) pool, failing every other tick through the
+    thick of the contention window."""
+    rng = random.Random(seed ^ 0x5EED)
+    topo = parse_topology(pools[0])
+    host = rng.randrange(topo.num_hosts)
+    start = rng.randint(4, 10)
+    return [DegradedHost(pool=f"pool-0-{pools[0]}", host=host,
+                         start=start, end=start + rng.randint(25, 40),
+                         fail_every=2)]
+
+
+def compare_health(seeds: list, n_jobs: int = 24,
+                   pools: tuple = ("v5e-32", "v5e-16"),
+                   checkpoint_every: int = 4) -> dict:
+    """The ``bench.py --mode health`` sim table: the same seeded
+    workloads + the same seeded degraded-host schedule, quarantine ON
+    vs OFF (paired comparison — the only difference is whether failure
+    evidence feeds placement). Quarantine must strictly reduce
+    recomputed ticks: crash-looping on a known-bad host is pure
+    waste."""
+    arms = {"quarantine_on": True, "quarantine_off": False}
+    rows: dict = {a: [] for a in arms}
+    for seed in seeds:
+        jobs = make_workload(seed, n_jobs=n_jobs)
+        degraded = degraded_workload(seed, pools)
+        for arm, enabled in arms.items():
+            fresh = [SimJob(**{k: getattr(j, k) for k in (
+                "name", "topology", "priority", "preemptible",
+                "num_slices", "queue", "namespace", "arrival", "work")})
+                for j in jobs]
+            rows[arm].append(simulate(
+                fresh, pools=pools, policy="preempt",
+                checkpoint_every=checkpoint_every,
+                degraded=tuple(degraded), node_health=enabled))
+    out = {}
+    for arm, runs in rows.items():
+        agg = {}
+        for metric in ("makespan_ticks", "chip_utilization",
+                       "recomputed_ticks", "host_faults",
+                       "useful_work_fraction", "queue_wait_p50"):
+            agg[metric] = round(
+                sum(r[metric] for r in runs) / len(runs), 4)
+        agg["unfinished"] = sum(len(r["unfinished"]) for r in runs)
+        out[arm] = agg
     return out

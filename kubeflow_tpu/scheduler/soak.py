@@ -226,3 +226,201 @@ class PreemptionSoak:
         self._run_segment(env_map, self.total_steps)
         from ..cluster.chaos import final_params
         return final_params(env_map["KFTPU_CHECKPOINT_DIR"])
+
+
+@dataclass
+class HealthSoak:
+    """Flaky-host migration drill: quarantine on vs off, end to end.
+
+    One scheduler-managed TPUJob trains on a two-pool cluster whose
+    first pool carries a FLAKY HOST (cluster/chaos.py HostFault): every
+    time a gang pod lands on it, the pod dies — the recurring
+    host-pinned failure the node-health subsystem exists for. With
+    ``quarantine=True`` (health enabled in the scheduler config) the
+    first crash records the suspect, the scheduler evacuates the
+    binding off the host's cells within ONE rebind, and the gang
+    finishes on the clean pool; with ``quarantine=False`` recovery is
+    placement-blind — the gang crash-loops on the flaky host until the
+    fault's trips budget runs out (the host "recovers"), burning a
+    restart per trip. Both arms must finish Succeeded with final params
+    IDENTICAL to a clean run (``bench.py --mode health`` asserts
+    parity 0.0): the health path changes WHERE the gang runs, never
+    what it computes.
+
+    The fault fires on a step schedule (after steps 3, 4, 5 — off
+    checkpoint boundaries) so the off arm pays real replay, making the
+    useful-work fraction an honest A/B, not just a restart count."""
+
+    workdir: str
+    quarantine: bool = True
+    total_steps: int = 6
+    checkpoint_every: int = 2
+    flaky_trips: int = 3
+    seed: int = 0
+    global_batch: int = 8
+    wall_budget_s: float = 300.0
+    namespace: str = "kubeflow"
+    job_name: str = "health-soak"
+
+    FLAKY_POOL = "pool-a"
+    CLEAN_POOL = "pool-b"
+
+    def _manifest(self, ckpt_dir: str) -> dict:
+        return {
+            "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": self.job_name,
+                         "namespace": self.namespace},
+            "spec": {
+                "checkpointDir": ckpt_dir,
+                "schedulingPolicy": {"queue": "research", "priority": 0,
+                                     "preemptible": False},
+                "replicaSpecs": {"TPU": {
+                    "tpuTopology": POOL_TOPOLOGY,
+                    "template": {"spec": {"containers": [
+                        {"name": "jax", "image": "trainer:v1"}]}}}},
+                "runPolicy": {
+                    "backoffLimit": self.flaky_trips + 3,
+                    # a small backoff so the scheduler's evacuation pass
+                    # wins the race against the operator's recreate
+                    "restartBackoffSeconds": 0.05,
+                    "restartBackoffMaxSeconds": 0.2,
+                },
+            },
+        }
+
+    # the segment/env plumbing is PreemptionSoak's, verbatim — one
+    # implementation of "run the real worker with the operator-rendered
+    # env" shared by every scheduler soak
+    _chief_env = PreemptionSoak._chief_env
+    _run_segment = PreemptionSoak._run_segment
+    _latest_step = staticmethod(PreemptionSoak._latest_step)
+
+    def _gang_running(self, cluster) -> list[dict]:
+        pods = cluster.list("v1", "Pod", self.namespace,
+                            selector={"kubeflow.org/job-name":
+                                      self.job_name})
+        running = [p for p in pods
+                   if p.get("status", {}).get("phase") == "Running"]
+        return running if len(running) == 2 else []   # v5e-8 = 2 hosts
+
+    def run(self) -> dict:
+        from ..cluster.chaos import HostFault
+        from ..cluster.fake import FakeCluster
+        from ..controllers.runtime import Manager
+        from ..controllers.tpujob import (RESTART_COUNT_ANNOTATION,
+                                          TrainingJobReconciler)
+        from .core import SliceScheduler
+        from .health import HealthConfig, is_quarantined
+        from .queue import SchedulerConfig, binding_of
+
+        ckpt_dir = os.path.join(self.workdir, "job")
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes(POOL_TOPOLOGY, pool=self.FLAKY_POOL)
+        cluster.add_tpu_slice_nodes(POOL_TOPOLOGY, pool=self.CLEAN_POOL)
+        flaky_node = f"{self.FLAKY_POOL}-{POOL_TOPOLOGY}-1"
+        fault = HostFault(node=flaky_node, mode="crash",
+                          trips=self.flaky_trips)
+        config = SchedulerConfig(health=HealthConfig(
+            enabled=self.quarantine,
+            # one crash (weight 1.0) is enough evidence in the drill;
+            # 0.9 leaves room for the decay between fold and pass
+            quarantine_threshold=0.9, release_threshold=0.5,
+            quarantine_s=300.0))
+        mgr = Manager(cluster)
+        mgr.add(SliceScheduler(config))
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        cluster.create(self._manifest(ckpt_dir))
+
+        # fault schedule: fire once the job has banked these steps (off
+        # the checkpoint_every=2 boundaries -> real replay in the off
+        # arm); trips beyond the schedule fire immediately on recreate
+        fault_steps = [3, 4, 5][:self.flaky_trips]
+        chief = f"{self.job_name}-worker-0-0"
+        report: dict = {"outcome": "timeout", "restarts": 0,
+                        "fires": 0, "rebinds": 0, "pools": [],
+                        "executed_steps": 0, "checkpoint_dir": ckpt_dir,
+                        "quarantine": self.quarantine}
+        deadline = time.monotonic() + self.wall_budget_s
+        first_fire_t = None
+        recovered_t = None
+        reached = 0
+        last_pools = None
+        while time.monotonic() < deadline:
+            mgr.run_pending()
+            cluster.tick()
+            mgr.run_pending()
+            job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                              self.namespace, self.job_name)
+            report["restarts"] = int(k8s.annotations_of(job).get(
+                RESTART_COUNT_ANNOTATION, "0"))
+            placement = binding_of(job)
+            pools = sorted({r.pool for r in placement.slices}) \
+                if placement else None
+            if pools is not None and pools != last_pools:
+                last_pools = pools
+                report["pools"].append(pools)
+                report["rebinds"] = len(report["pools"]) - 1
+            if k8s.condition_true(job, "Succeeded"):
+                report["outcome"] = "succeeded"
+                break
+            if k8s.condition_true(job, "Failed"):
+                report["outcome"] = "failed"
+                report["failed_reason"] = k8s.get_condition(
+                    job, "Failed").get("reason")
+                break
+            running = self._gang_running(cluster)
+            if not running or k8s.condition_true(job, "Restarting"):
+                time.sleep(0.02)
+                continue
+            on_flaky = any(p.get("spec", {}).get("nodeName") == flaky_node
+                           for p in running)
+            if first_fire_t is not None and recovered_t is None and \
+                    not (on_flaky and fault.fired < fault.trips):
+                # fully Running with nothing left for the fault to hit:
+                # the gang has outrun the flaky host (migrated, or the
+                # host's budget is spent)
+                recovered_t = time.monotonic()
+                report["recovery_s"] = round(
+                    recovered_t - first_fire_t, 3)
+            due = fault.fired < len(fault_steps) and \
+                reached >= fault_steps[fault.fired]
+            late = fault.fired >= len(fault_steps)
+            if on_flaky and fault.fired < fault.trips and (due or late):
+                if fault.maybe_fire(cluster, self.namespace,
+                                    at_step=reached):
+                    report["fires"] = fault.fired
+                    if first_fire_t is None:
+                        first_fire_t = time.monotonic()
+                    continue
+            # train to the next fault step (if one is pending and the
+            # gang still sits on the flaky host) or to the end
+            target = fault_steps[fault.fired] \
+                if (on_flaky and fault.fired < len(fault_steps)) \
+                else self.total_steps
+            if reached < target:
+                resume = self._latest_step(ckpt_dir) or 0
+                self._run_segment(self._chief_env(cluster, chief),
+                                  target)
+                report["executed_steps"] += target - resume
+                reached = target
+            if reached >= self.total_steps:
+                cluster.set_pod_phase(self.namespace, chief, "Succeeded")
+        node = cluster.get("v1", "Node", "", flaky_node)
+        report["flaky_node"] = flaky_node
+        report["flaky_quarantined"] = is_quarantined(node)
+        report["final_pools"] = last_pools
+        report["migrated"] = bool(last_pools and
+                                  self.FLAKY_POOL not in last_pools)
+        report["useful_work_fraction"] = round(
+            self.total_steps / max(1, report["executed_steps"]), 4)
+        for c in mgr.controllers:
+            c.stop()
+        return report
+
+    def clean_params(self):
+        """The parity reference: same seed and steps, no flaky host."""
+        env_map = {"KFTPU_CHECKPOINT_DIR":
+                   os.path.join(self.workdir, "clean")}
+        self._run_segment(env_map, self.total_steps)
+        from ..cluster.chaos import final_params
+        return final_params(env_map["KFTPU_CHECKPOINT_DIR"])

@@ -14,6 +14,7 @@ Routes:
   GET /api/metrics/{type}?window=          (podcpu | podmem | node)
   GET /api/tpu/slices
   GET /api/sched/queues                    (gang-scheduler queue state)
+  GET /api/sched/nodes                     (per-host health + quarantine)
   GET /healthz
 """
 
@@ -441,10 +442,20 @@ def build_dashboard_app(client: KubeClient,
                                        SCHED_STATE_ANNOTATION,
                                        TPU_API_VERSION, TrainingJob)
         from ..cluster.client import KubeError
+        from ..scheduler import health as sched_health
         try:
             manifests = client.list(TPU_API_VERSION, "TPUJob")
         except KubeError:
             return 200, []
+        # the Quarantined column: hosts the health loop is holding out
+        # of placement right now — the cluster-wide context for "why is
+        # my queue not draining" (detail under /api/sched/nodes)
+        try:
+            quarantined_hosts = sum(
+                1 for n in client.list("v1", "Node")
+                if sched_health.is_quarantined(n))
+        except KubeError:
+            quarantined_hosts = 0
         queues: dict[str, dict] = {}
         for m in manifests:
             try:
@@ -461,7 +472,8 @@ def build_dashboard_app(client: KubeClient,
             q = queues.setdefault(policy.queue or DEFAULT_QUEUE, {
                 "queue": policy.queue or DEFAULT_QUEUE,
                 "queued": 0, "bound": 0, "chipsBound": 0,
-                "chipsQueued": 0, "preemptions": 0, "jobs": []})
+                "chipsQueued": 0, "preemptions": 0,
+                "quarantinedHosts": quarantined_hosts, "jobs": []})
             finished = _job_phase(m) in ("Succeeded", "Failed")
             if not finished:
                 q["bound" if bound else "queued"] += 1
@@ -476,11 +488,69 @@ def build_dashboard_app(client: KubeClient,
                 "state": anns.get(SCHED_STATE_ANNOTATION,
                                   "bound" if bound else "queued"),
                 "reason": anns.get(SCHED_REASON_ANNOTATION, ""),
+                # the host this job's last teardown was pinned on (its
+                # next placement excludes it; scheduler/health.py)
+                "suspect": sched_health.suspect_of(m) or "",
             })
         for q in queues.values():
             q["jobs"].sort(key=lambda j: (-j["priority"],
                                           j["namespace"], j["name"]))
         return 200, sorted(queues.values(), key=lambda q: q["queue"])
+
+    @app.route("GET", "/api/sched/nodes")
+    def sched_nodes(params, query, body):
+        """Per-host node health: decayed failure score, quarantine
+        state/reason/expiry, and the gangs currently bound onto the
+        host — the operator's first stop for "which host is the health
+        loop avoiding, and why". Reads the same annotation contracts
+        the scheduler writes (scheduler/health.py), no scheduler-process
+        access needed."""
+        import time as _time
+
+        from ..cluster.client import KubeError
+        from ..scheduler import health as sched_health
+        from ..scheduler.inventory import POOL_LABEL
+        now = _time.time()
+        try:
+            nodes = client.list("v1", "Node")
+            pods = client.list("v1", "Pod")
+        except KubeError:
+            return 200, []
+        # gangs per host, off the pods' own job labels
+        gangs: dict[str, set] = {}
+        for p in pods:
+            node = p.get("spec", {}).get("nodeName")
+            jname = k8s.labels_of(p).get("kubeflow.org/job-name")
+            if node and jname and \
+                    p.get("status", {}).get("phase") in ("Pending",
+                                                         "Running"):
+                gangs.setdefault(node, set()).add(
+                    f"{k8s.namespace_of(p, 'default')}/{jname}")
+        rows = []
+        for node in nodes:
+            labels = k8s.labels_of(node)
+            pool = labels.get(POOL_LABEL)
+            if not pool:
+                continue
+            name = k8s.name_of(node)
+            rec = sched_health.health_of(node)
+            quarantine = sched_health.quarantine_of(node)
+            rows.append({
+                "node": name,
+                "pool": pool,
+                "topology": labels.get(
+                    "cloud.google.com/gke-tpu-topology", ""),
+                "ready": k8s.condition_true(node, "Ready"),
+                "healthScore": round(
+                    sched_health.decayed_score(node, now), 4),
+                "healthEvents": rec["events"],
+                "lastEvent": rec["last"],
+                "quarantined": quarantine is not None,
+                "quarantineReason": (quarantine or {}).get("reason", ""),
+                "quarantineExpiry": (quarantine or {}).get("until"),
+                "gangs": sorted(gangs.get(name, ())),
+            })
+        return 200, sorted(rows, key=lambda r: (r["pool"], r["node"]))
 
     @app.route("GET", "/api/tpu/slices")
     def tpu_slices(params, query, body):

@@ -319,17 +319,21 @@ class TestHeartbeatReporter:
 
 
 class _ScriptedHandler(BaseHTTPRequestHandler):
-    script: list  # shared across requests: [(code, body), ...]
+    # shared across requests: [(code, body)] or [(code, body, headers)]
+    script: list
     hits: list
 
     def do_GET(self):
-        code, body = (self.script.pop(0) if self.script
-                      else (200, {"items": []}))
+        entry = self.script.pop(0) if self.script else (200, {"items": []})
+        code, body = entry[0], entry[1]
+        headers = entry[2] if len(entry) > 2 else {}
         type(self).hits.append(code)
         data = json.dumps(body).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in headers.items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
@@ -391,6 +395,40 @@ class TestHttpClientRetry:
         with pytest.raises(KubeError):
             client.list("v1", "Pod")
         assert handler.hits == [503, 503, 503]  # 1 try + 2 retries
+
+    def test_retry_after_is_honored_on_429(self, scripted_server):
+        """A throttling apiserver's Retry-After beats the client's own
+        (much shorter) jitter schedule — the server said when to come
+        back, so a health-event storm must not hammer it early."""
+        from kubeflow_tpu.cluster.http_client import HttpKubeClient
+        url, handler = scripted_server([
+            (429, {"code": 429, "reason": "TooManyRequests",
+                   "message": "throttled"}, {"Retry-After": "0.4"}),
+            (200, {"items": []}),
+        ])
+        client = HttpKubeClient(url, retries=3, retry_backoff_s=0.001)
+        t0 = time.monotonic()
+        assert client.list("v1", "Pod") == []
+        # the wait was the server's 0.4s, not the client's ~1ms jitter
+        assert time.monotonic() - t0 >= 0.35
+        assert handler.hits == [429, 200]
+
+    def test_retry_wall_clock_cap_bounds_retry_after(self, scripted_server):
+        """A Retry-After larger than the wall-clock budget surfaces the
+        typed error immediately instead of pinning the caller — the
+        reconcile loop's own requeue is the cheaper way to wait."""
+        from kubeflow_tpu.cluster.client import KubeError
+        from kubeflow_tpu.cluster.http_client import HttpKubeClient
+        url, handler = scripted_server([
+            (503, {"code": 503, "reason": "ServiceUnavailable",
+                   "message": "down"}, {"Retry-After": "30"})] * 5)
+        client = HttpKubeClient(url, retries=3, retry_backoff_s=0.01,
+                                retry_wall_clock_s=0.2)
+        t0 = time.monotonic()
+        with pytest.raises(KubeError):
+            client.list("v1", "Pod")
+        assert time.monotonic() - t0 < 5.0      # no 30s sleep happened
+        assert handler.hits == [503]            # gave up before retrying
 
 
 # ------------------------------------------------ checkpoint integrity

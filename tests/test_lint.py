@@ -310,6 +310,83 @@ def test_scheduling_policy_is_plumbed_end_to_end():
     assert BINDING_ANNOTATION == "scheduling.kubeflow.org/binding"
 
 
+def test_node_health_contract_is_shared_not_duplicated():
+    """The quarantine/suspect/health annotation contract must have ONE
+    definition (api/trainingjob.py) and one parse implementation
+    (scheduler/health.py), consumed by BOTH the operator and the
+    scheduler — the binding_of rule: the two processes coordinate
+    through these annotations, so a string or parse drift between them
+    silently breaks migration."""
+    import subprocess
+
+    from kubeflow_tpu.api.trainingjob import (HEALTH_ANNOTATION,
+                                              QUARANTINE_ANNOTATION,
+                                              SUSPECT_ANNOTATION)
+    from kubeflow_tpu.scheduler import health
+    from kubeflow_tpu.scheduler.queue import SchedulerConfig
+
+    assert HEALTH_ANNOTATION == "kubeflow.org/health"
+    assert QUARANTINE_ANNOTATION == "kubeflow.org/quarantine"
+    assert SUSPECT_ANNOTATION == "scheduling.kubeflow.org/suspect-host"
+
+    # single definition: each literal appears in exactly one source
+    # file (api/trainingjob.py) — every other layer imports the name
+    pkg = os.path.join(REPO_ROOT, "kubeflow_tpu")
+    for literal in (QUARANTINE_ANNOTATION, SUSPECT_ANNOTATION,
+                    HEALTH_ANNOTATION):
+        hits = subprocess.run(
+            ["grep", "-rl", f'"{literal}"', pkg],
+            capture_output=True, text=True).stdout.split()
+        assert [os.path.relpath(h, pkg) for h in hits] == \
+            [os.path.join("api", "trainingjob.py")], \
+            f"{literal!r} defined outside api/trainingjob.py: {hits}"
+
+    def src(*rel):
+        with open(os.path.join(pkg, *rel)) as f:
+            return f.read()
+
+    # the operator records evidence + suspect through the shared
+    # helpers; the scheduler parses/acts through the same module —
+    # neither side re-implements the wire format
+    controller_src = src("controllers", "tpujob.py")
+    assert "health.record_host_event" in controller_src
+    assert "SUSPECT_ANNOTATION" in controller_src
+    core_src = src("scheduler", "core.py")
+    for consumer in ("health.suspect_of", "health.quarantine_of",
+                     "health.decayed_score", "health.release_eligible",
+                     "health.quarantine_record"):
+        assert consumer in core_src, \
+            f"scheduler/core.py must consume {consumer}"
+    inv_src = src("scheduler", "inventory.py")
+    assert "health.is_quarantined" in inv_src
+    assert "health.host_cells" in inv_src
+
+    # wire round trips through the one parse implementation
+    raw = health.quarantine_record("r", 2.5, 100.0, 60.0)
+    node = {"metadata": {"annotations": {QUARANTINE_ANNOTATION: raw}}}
+    q = health.quarantine_of(node)
+    assert (q["reason"], q["score"], q["since"], q["until"]) == \
+        ("r", 2.5, 100.0, 160.0)
+    rec = health.fold_event({"score": 0.0, "time": 0.0},
+                            health.EVENT_POD_CRASH, 50.0)
+    node = {"metadata": {"annotations": {
+        HEALTH_ANNOTATION: __import__("json").dumps(rec)}}}
+    assert health.health_of(node) == rec
+
+    # the deployed ConfigMap's health block parses into the live config
+    # (manifests render ↔ scheduler parse, one schema)
+    from kubeflow_tpu.manifests.training import tpu_scheduler
+    import json as _json
+    cm = next(o for o in tpu_scheduler(health={"enabled": False})
+              if o["kind"] == "ConfigMap")
+    cfg = SchedulerConfig.from_dict(
+        _json.loads(cm["data"]["config.json"]))
+    assert cfg.health.enabled is False
+    import pytest
+    with pytest.raises(ValueError, match="unknown"):
+        tpu_scheduler(health={"quarantineTreshold": 2})
+
+
 def test_run_policy_fields_are_plumbed_end_to_end():
     """Every RunPolicy field must be plumbed spec → controller →
     manifests: round-trip through the TPUJob spec wire format

@@ -120,7 +120,10 @@ class TestInventory:
         assert Placement.from_dict(
             json.loads(json.dumps(p.to_dict()))).to_dict() == p.to_dict()
 
-    def test_from_nodes_truncates_not_ready_pools(self):
+    def test_from_nodes_carves_not_ready_hosts(self):
+        # a NotReady host's EXACT cells leave the placeable inventory
+        # (the old behavior truncated bottom rows regardless of which
+        # host died); the pool keeps its full grid geometry
         cluster = FakeCluster()
         cluster.add_tpu_slice_nodes("v5e-32", pool="full")
         cluster.add_tpu_slice_nodes("v5e-8", pool="half")
@@ -131,7 +134,40 @@ class TestInventory:
         cluster.update(node)
         inv = SliceInventory.from_nodes(cluster.list("v1", "Node"))
         assert inv.pools["full"].total_chips == 32
-        assert inv.pools["half"].total_chips == 4   # one host gone
+        assert inv.pools["half"].total_chips == 8   # geometry intact
+        down = {c for c in inv.down_cells if c[0] == "half"}
+        assert down == set(inv.cells_by_node["half-v5e-8-1"])
+        assert len(down) == 4                       # one host's chips
+        inv.carve_down()
+        assert inv.pools["half"].free_chips == 4    # placeable half
+        # a full v5e-8 gang no longer fits the half pool, the intact
+        # pool still takes it
+        p = inv.place_gang(parse_topology("v5e-8"), 1)
+        assert p is not None and p.slices[0].pool == "full"
+
+    def test_from_nodes_carves_quarantined_hosts(self):
+        # the quarantine annotation (scheduler/health.py wire contract)
+        # carves a host exactly like NotReady — runtime failure
+        # evidence feeds placement
+        from kubeflow_tpu.scheduler import health as H
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-32", pool="big")
+        cluster.patch("v1", "Node", "", "big-v5e-32-2", {
+            "metadata": {"annotations": {
+                "kubeflow.org/quarantine": H.quarantine_record(
+                    "test", 5.0, 0.0, 60.0)}}})
+        inv = SliceInventory.from_nodes(cluster.list("v1", "Node"))
+        assert inv.down_cells == set(inv.cells_by_node["big-v5e-32-2"])
+        inv.carve_down()
+        assert inv.pools["big"].free_chips == 28
+        # bindings over the quarantined host read invalid -> replan
+        from kubeflow_tpu.scheduler.inventory import Placement
+        hit = Placement(topology="v5e-16", num_slices=1,
+                        slices=[SliceRect("big", 0, 0, 4, 4)])
+        clear = Placement(topology="v5e-16", num_slices=1,
+                          slices=[SliceRect("big", 0, 4, 4, 4)])
+        assert not inv.valid_binding(hit)    # covers host 2's cells
+        assert inv.valid_binding(clear)
 
 
 class TestPlanPolicy:
@@ -527,6 +563,99 @@ class TestControlPlane:
         assert q["chipsBound"] == 8 and q["chipsQueued"] == 8
         states = {j["name"]: j["state"] for j in q["jobs"]}
         assert states == {"running": "bound", "parked": "queued"}
+
+
+class TestNodeFlap:
+    """Node Ready-condition flaps must not thrash bindings: writes
+    happen on STATE CHANGE only (write-on-change), a flap on a host no
+    binding covers writes nothing at all, and the replan after a real
+    transition is deterministic."""
+
+    def _set_ready(self, cluster, node_name, ready: bool):
+        node = cluster.get("v1", "Node", "", node_name)
+        node["status"]["conditions"] = [
+            {"type": "Ready", "status": "True" if ready else "False"}]
+        cluster.update(node)
+
+    def _job_rvs(self, cluster):
+        return {k8s.name_of(j): j["metadata"]["resourceVersion"]
+                for j in cluster.list("tpu.kubeflow.org/v1alpha1",
+                                      "TPUJob", "kubeflow")}
+
+    def test_flap_on_uncovered_host_writes_nothing(self):
+        # the v5e-8 gang carved out of the v5e-32 pool sits on hosts
+        # 0+2 (rows 0-1, cols 0-3); host 7 (row 3, cols 4-7) flapping
+        # must not touch the binding — the OLD bottom-row truncation
+        # would have invalidated it (wrong host!) and thrashed the gang
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-32", pool="big")
+        mgr = Manager(cluster)
+        sched = SliceScheduler()
+        mgr.add(sched)
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        cluster.create(tpujob("steady"))
+        drive(cluster, mgr)
+        binding_before = k8s.annotations_of(
+            get_job(cluster, "steady"))[BINDING_ANNOTATION]
+        rv_before = self._job_rvs(cluster)
+        for ready in (False, True, False, True):
+            self._set_ready(cluster, "big-v5e-32-7", ready)
+            sched.reconcile(cluster, ("", "#cluster-pass"))
+        assert self._job_rvs(cluster) == rv_before
+        assert k8s.annotations_of(get_job(cluster, "steady"))[
+            BINDING_ANNOTATION] == binding_before
+        for c in mgr.controllers:
+            c.stop()
+
+    def test_covered_host_flap_write_on_change_holds(self, env):
+        # a flap UNDER the binding is a real state change: the binding
+        # drops (the gang cannot run on a dead host) and deterministically
+        # re-places on recovery — but repeated passes in the SAME state
+        # must write nothing (no write storm, no thrash loop)
+        cluster, mgr = env
+        cluster.create(tpujob("flappy"))
+        drive(cluster, mgr)
+        original = k8s.annotations_of(
+            get_job(cluster, "flappy"))[BINDING_ANNOTATION]
+        sched = next(c.reconciler for c in mgr.controllers
+                     if isinstance(c.reconciler, SliceScheduler))
+        self._set_ready(cluster, "tpu-pool-v5e-8-0", False)
+        sched.reconcile(cluster, ("", "#cluster-pass"))
+        job = get_job(cluster, "flappy")
+        assert not k8s.annotations_of(job).get(BINDING_ANNOTATION)
+        # steady NotReady: repeated passes are write-idempotent
+        rvs = self._job_rvs(cluster)
+        sched.reconcile(cluster, ("", "#cluster-pass"))
+        sched.reconcile(cluster, ("", "#cluster-pass"))
+        assert self._job_rvs(cluster) == rvs
+        # recovery: exactly the same placement comes back (deterministic
+        # packing), then steady Ready passes are write-idempotent again
+        self._set_ready(cluster, "tpu-pool-v5e-8-0", True)
+        sched.reconcile(cluster, ("", "#cluster-pass"))
+        assert k8s.annotations_of(get_job(cluster, "flappy"))[
+            BINDING_ANNOTATION] == original
+        rvs = self._job_rvs(cluster)
+        sched.reconcile(cluster, ("", "#cluster-pass"))
+        assert self._job_rvs(cluster) == rvs
+
+    def test_chronic_flapper_quarantines_itself(self):
+        # every Ready→NotReady transition folds a not-ready health
+        # event; a chronically flapping host crosses the threshold and
+        # is pulled from placement even while it reads Ready
+        from kubeflow_tpu.scheduler import health as H
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8", pool="flappy")
+        sched = SliceScheduler(SchedulerConfig(
+            health=H.HealthConfig(quarantine_threshold=2.5)))
+        sched.reconcile(cluster, ("", "#cluster-pass"))
+        for _ in range(3):
+            self._set_ready(cluster, "flappy-v5e-8-1", False)
+            sched.reconcile(cluster, ("", "#cluster-pass"))
+            self._set_ready(cluster, "flappy-v5e-8-1", True)
+            sched.reconcile(cluster, ("", "#cluster-pass"))
+        node = cluster.get("v1", "Node", "", "flappy-v5e-8-1")
+        assert H.is_quarantined(node)
+        assert H.health_of(node)["events"] == 3
 
 
 class TestSimulation:

@@ -1012,6 +1012,31 @@ def bench_chaos(t_start: float | None = None) -> dict:
                 injected_params, clean_params)), default=0.0)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+    # Capacity-loss scenario (ISSUE 8 chaos vocabulary): a host VANISHES
+    # from inventory mid-run (cluster/chaos.py CapacityLoss deletes the
+    # node object — not a crash on it) under an ELASTIC job; the only
+    # recovery is shrink-to-survive (no same-size rectangle exists), and
+    # the job must still end Succeeded at the degraded width. Gated by
+    # KFTPU_BENCH_CHAOS_CAPACITY=0 (the full shrink→grow arc with parity
+    # numbers runs under --mode sched).
+    capacity: dict = {"skipped": True}
+    if _env_int("KFTPU_BENCH_CHAOS_CAPACITY", 1):
+        from kubeflow_tpu.scheduler.soak import ElasticSoak
+        tmp = tempfile.mkdtemp(prefix="kftpu-chaos-capacity-")
+        try:
+            t0 = time.perf_counter()
+            cap = ElasticSoak(workdir=tmp, grow_phase=False).run()
+            capacity = {
+                "outcome": cap["outcome"],
+                "events": cap["events"],
+                "chips_seen": cap["chips_seen"],
+                "shrank_to_survive": bool(4 in cap["chips_seen"]),
+                "roundtrip_delta_across_degrees":
+                    cap.get("roundtrip_delta_at_shrink"),
+                "soak_wall_s": round(time.perf_counter() - t0, 1),
+            }
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
     recovered = report["outcome"] == "succeeded"
     return {
         "metric": "chaos_soak_faults_recovered",
@@ -1031,6 +1056,7 @@ def bench_chaos(t_start: float | None = None) -> dict:
             "soak_wall_s": round(soak_s, 1),
             "final_params_max_abs_delta_vs_clean": max_delta,
             "params_parity_ok": bool(recovered and max_delta <= 1e-5),
+            "capacity_loss": capacity,
         },
         "_flops_per_chip": 0.0,
     }
@@ -1039,15 +1065,21 @@ def bench_chaos(t_start: float | None = None) -> dict:
 def bench_sched(t_start: float | None = None) -> dict:
     """Gang-scheduler A/B on a seeded contended cluster
     (scheduler/sim.py drives the REAL plan()/inventory code): FIFO vs
-    priority+backfill vs priority+backfill+preemption over the same
-    seeded workloads, reporting makespan, chip utilization, and
-    queue-wait percentiles — plus the checkpoint-resume parity soak
-    (scheduler/soak.py): a preemptible job reclaimed mid-run must finish
-    with params identical to an uncontended run of the same seed.
+    priority+backfill vs priority+backfill+preemption vs ELASTIC
+    (preempt + resize plans for minChips/maxChips-bounded gangs) over
+    the same seeded workloads, reporting makespan, chip utilization,
+    queue-wait percentiles, and resize/recompute counts — plus two
+    real-training soaks (scheduler/soak.py): the preemption parity soak
+    (a reclaimed job must finish params-identical to an uncontended
+    run), and the ELASTIC shrink→grow soak (a host vanishes mid-run,
+    the gang re-binds degraded, capacity returns, the gang grows back —
+    ends Succeeded with a lossless cross-replica-degree checkpoint
+    round trip).
 
-    Env knobs (the sched_bench_smoke CI entry shrinks the geometry):
-    KFTPU_BENCH_SCHED_SEEDS / _JOBS / _POOLS / _SOAK (0 skips the
-    real-training soak)."""
+    Env knobs (the sched/elastic_bench_smoke CI entries shrink the
+    geometry): KFTPU_BENCH_SCHED_SEEDS / _JOBS / _POOLS / _SOAK (0
+    skips the preemption soak) / _ELASTIC_SOAK (0 skips the shrink→grow
+    soak)."""
     import os
     import shutil
     import tempfile
@@ -1063,8 +1095,68 @@ def bench_sched(t_start: float | None = None) -> dict:
     table = compare_policies(seeds, n_jobs=n_jobs, pools=pools)
     sim_s = time.perf_counter() - t0
     fifo, pre = table["fifo"], table["preempt"]
+    ela = table["elastic"]
     dominates = (pre["chip_utilization"] > fifo["chip_utilization"]
                  and pre["queue_wait_p50"] < fifo["queue_wait_p50"])
+    # the elastic acceptance bar (ISSUE 8): beat the PR 4 preempt arm's
+    # utilization with LESS thrown-away work — resizes (checkpointed
+    # restarts, zero recompute) replacing preemptions
+    elastic_ab = {
+        "chip_utilization": ela["chip_utilization"],
+        "vs_preempt_utilization": round(
+            ela["chip_utilization"] / pre["chip_utilization"], 3)
+        if pre["chip_utilization"] else None,
+        "resizes_per_run": ela["resizes"],
+        "recomputed_ticks": ela["recomputed_ticks"],
+        "recomputed_vs_preempt": round(
+            ela["recomputed_ticks"] / pre["recomputed_ticks"], 3)
+        if pre["recomputed_ticks"] else None,
+        "beats_pr4_baseline": bool(
+            ela["chip_utilization"] > pre["chip_utilization"]
+            and ela["recomputed_ticks"] <= pre["recomputed_ticks"]),
+    }
+
+    elastic_soak: dict = {"skipped": True}
+    if _env_int("KFTPU_BENCH_SCHED_ELASTIC_SOAK", 1):
+        import jax
+        import numpy as np
+
+        from kubeflow_tpu.cluster.chaos import final_params
+        from kubeflow_tpu.scheduler.soak import ElasticSoak
+        tmp = tempfile.mkdtemp(prefix="kftpu-elastic-soak-")
+        try:
+            t0 = time.perf_counter()
+            soak = ElasticSoak(workdir=tmp)
+            report = soak.run()
+            clean_delta = float("nan")
+            if report["outcome"] == "succeeded":
+                got = final_params(report["checkpoint_dir"])
+                clean = soak.clean_params()
+                clean_delta = max(jax.tree.leaves(jax.tree.map(
+                    lambda a, b: float(np.max(np.abs(
+                        np.asarray(a) - np.asarray(b)))),
+                    got, clean)), default=0.0)
+            rt = max(report.get("roundtrip_delta_at_shrink", float("nan")),
+                     report.get("roundtrip_delta_final", float("nan")))
+            elastic_soak = {
+                "outcome": report["outcome"],
+                "events": report["events"],
+                "chips_seen": report["chips_seen"],
+                "shrink_resume_step": report.get("shrink_resume_step"),
+                "grow_resume_step": report.get("grow_resume_step"),
+                # the ≤1e-5 acceptance: the checkpoint round trip across
+                # replica degrees 8↔4 (sharded optimizer state reshaped
+                # on restore) must be lossless
+                "roundtrip_delta_across_degrees": rt,
+                "roundtrip_ok": bool(rt <= 1e-5),
+                # vs an undisturbed full-width run: cross-degree
+                # reduction-order float drift only (reported, not
+                # hidden; the round trip above is the exactness bar)
+                "final_params_max_abs_delta_vs_clean": clean_delta,
+                "soak_wall_s": round(time.perf_counter() - t0, 1),
+            }
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
 
     parity: dict = {"skipped": True}
     if _env_int("KFTPU_BENCH_SCHED_SOAK", 1):
@@ -1120,6 +1212,8 @@ def bench_sched(t_start: float | None = None) -> dict:
                 fifo["queue_wait_p50"] / pre["queue_wait_p50"], 2)
             if pre["queue_wait_p50"] else None,
             "sim_wall_s": round(sim_s, 1),
+            "elastic": elastic_ab,
+            "elastic_soak": elastic_soak,
             "parity": parity,
         },
         "_flops_per_chip": 0.0,

@@ -204,10 +204,35 @@ class SchedulingPolicy:
     # the graceful path (SIGTERM → forced checkpoint → exit 75) and is
     # RE-QUEUED by the scheduler, not failed
     preemptible: bool = False
+    # Elastic gang bounds (minChips/maxChips): either set makes the job
+    # ELASTIC — the scheduler may resize the gang's binding at checkpoint
+    # boundaries anywhere in [minChips, maxChips] total chips (shrink to
+    # survive a lost host or admit a blocked head, grow into idle chips,
+    # migrate to defragment). Global batch size stays FIXED across
+    # resizes: only the data-parallel replica degree changes, and the
+    # checkpoint restore reshapes optimizer state across degrees
+    # (runtime/checkpoint.py). None = that bound pins to the nominal
+    # spec shape; both None = fixed-shape (the pre-elastic contract).
+    min_chips: Optional[int] = None
+    max_chips: Optional[int] = None
 
     ENV_QUEUE = "KFTPU_SCHED_QUEUE"
     ENV_PRIORITY = "KFTPU_SCHED_PRIORITY"
     ENV_PREEMPTIBLE = "KFTPU_SCHED_PREEMPTIBLE"
+    ENV_MIN_CHIPS = "KFTPU_SCHED_MIN_CHIPS"
+    ENV_MAX_CHIPS = "KFTPU_SCHED_MAX_CHIPS"
+
+    @property
+    def elastic(self) -> bool:
+        """Whether the scheduler may resize this gang's binding."""
+        return self.min_chips is not None or self.max_chips is not None
+
+    def chip_bounds(self, nominal: int) -> tuple[int, int]:
+        """The [min, max] total-chip envelope around the spec's nominal
+        gang size (an unset bound pins to nominal — the spec shape is
+        always inside its own envelope)."""
+        return (self.min_chips if self.min_chips is not None else nominal,
+                self.max_chips if self.max_chips is not None else nominal)
 
     def validate(self) -> None:
         if not isinstance(self.queue, str):
@@ -223,23 +248,46 @@ class SchedulingPolicy:
             raise ValueError(
                 f"schedulingPolicy.preemptible must be a boolean, got "
                 f"{self.preemptible!r}")
+        for label, v in (("minChips", self.min_chips),
+                         ("maxChips", self.max_chips)):
+            if v is None:
+                continue
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                raise ValueError(
+                    f"schedulingPolicy.{label} must be a positive "
+                    f"integer, got {v!r}")
+        if self.min_chips is not None and self.max_chips is not None \
+                and self.min_chips > self.max_chips:
+            raise ValueError(
+                f"schedulingPolicy.minChips ({self.min_chips}) must not "
+                f"exceed maxChips ({self.max_chips})")
 
     def to_dict(self) -> dict:
         d: dict[str, Any] = {"priority": self.priority,
                              "preemptible": self.preemptible}
         if self.queue:
             d["queue"] = self.queue
+        if self.min_chips is not None:
+            d["minChips"] = self.min_chips
+        if self.max_chips is not None:
+            d["maxChips"] = self.max_chips
         return d
 
     def to_env(self) -> dict[str, str]:
         """Rendered into every worker pod: informational for the queue
-        name/priority, behavioral for preemptible (the worker's SIGTERM
-        handler knows a reclaim is a requeue, not a failure)."""
-        return {
+        name/priority and the elastic bounds, behavioral for preemptible
+        (the worker's SIGTERM handler knows a reclaim is a requeue, not
+        a failure)."""
+        env = {
             self.ENV_QUEUE: self.queue or DEFAULT_QUEUE,
             self.ENV_PRIORITY: str(self.priority),
             self.ENV_PREEMPTIBLE: "1" if self.preemptible else "0",
         }
+        if self.min_chips is not None:
+            env[self.ENV_MIN_CHIPS] = str(self.min_chips)
+        if self.max_chips is not None:
+            env[self.ENV_MAX_CHIPS] = str(self.max_chips)
+        return env
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> Optional["SchedulingPolicy"]:
@@ -252,7 +300,7 @@ class SchedulingPolicy:
             raise ValueError(
                 f"spec.schedulingPolicy must be a mapping, got "
                 f"{type(d).__name__}: {d!r}")
-        known = {"queue", "priority", "preemptible"}
+        known = {"queue", "priority", "preemptible", "minChips", "maxChips"}
         unknown = set(d) - known
         if unknown:
             raise ValueError(
@@ -260,7 +308,9 @@ class SchedulingPolicy:
                 f"valid: {sorted(known)}")
         policy = cls(queue=d.get("queue", "") or "",
                      priority=d.get("priority", 0),
-                     preemptible=d.get("preemptible", False))
+                     preemptible=d.get("preemptible", False),
+                     min_chips=d.get("minChips"),
+                     max_chips=d.get("maxChips"))
         policy.validate()
         return policy
 
@@ -280,6 +330,11 @@ SCHED_STATE_ANNOTATION = "scheduling.kubeflow.org/state"
 SCHED_REASON_ANNOTATION = "scheduling.kubeflow.org/reason"
 # times this job's gang was preempted (reclaimed, not failed)
 PREEMPTED_COUNT_ANNOTATION = "scheduling.kubeflow.org/preempted-count"
+# Elastic-resize event history (scheduler/core.py writes, dashboard
+# reads): a JSON list of {"time", "fromChips", "toChips", "reason"}
+# records, newest last, capped — the audit trail of every shrink / grow
+# / defrag migration the scheduler applied to this gang's binding.
+RESIZE_HISTORY_ANNOTATION = "scheduling.kubeflow.org/resize-history"
 
 # Node-health contract between the operator (evidence writer) and the
 # scheduler (policy actor) — scheduler/health.py owns the parse/fold
@@ -693,6 +748,50 @@ class TrainingJob:
                 # Resolving the sharding spec against the slice validates the
                 # axis product here, at admission time, not at runtime.
                 self.sharding.resolve(rs.topology.num_chips * rs.num_slices)
+                policy = self.scheduling_policy
+                if policy is not None and policy.elastic:
+                    # Elastic admission contract: the nominal shape must
+                    # sit inside its own [min, max] envelope, and the
+                    # sharding must leave a data-parallel axis as the -1
+                    # wildcard — a resized gang re-resolves the mesh
+                    # against its new chip count, which a fully pinned
+                    # axis product cannot do. Rejected at apply, not at
+                    # the first resize deep inside the scheduler.
+                    nominal = rs.topology.num_chips * rs.num_slices
+                    lo, hi = policy.chip_bounds(nominal)
+                    if not lo <= nominal <= hi:
+                        raise ValueError(
+                            f"{self.kind} {self.name}: nominal gang size "
+                            f"{nominal} chips outside schedulingPolicy "
+                            f"minChips/maxChips [{lo}, {hi}]")
+                    sizes = self.sharding.axis_sizes()
+                    if sizes.get("data") != -1 and sizes.get("fsdp") != -1:
+                        raise ValueError(
+                            f"{self.kind} {self.name}: elastic resizing "
+                            "(minChips/maxChips) requires a -1 wildcard "
+                            "on the data or fsdp sharding axis — a "
+                            "pinned axis product cannot follow the "
+                            "resized chip count")
+                    # ...and EVERY shape inside the envelope must
+                    # resolve: the scheduler may legally bind any
+                    # supported slice size in [min, max], and a fixed
+                    # axis product (e.g. tensor=4) that does not divide
+                    # one of them would crash-loop the gang at the
+                    # scheduler-chosen shape — reject at apply, not at
+                    # the first resize
+                    for c in rs.topology.generation.supported_chip_counts:
+                        total = c * rs.num_slices
+                        if not lo <= total <= hi:
+                            continue
+                        try:
+                            self.sharding.resolve(total)
+                        except ValueError as e:
+                            raise ValueError(
+                                f"{self.kind} {self.name}: elastic "
+                                f"envelope admits a {total}-chip gang "
+                                f"the sharding spec cannot resolve "
+                                f"({e}); tighten minChips/maxChips or "
+                                f"relax the pinned axes") from None
         if "TPU" in self.replica_specs and not self.run_policy.gang_scheduling:
             raise ValueError(
                 f"{self.kind} {self.name}: TPU replicas require gangScheduling "

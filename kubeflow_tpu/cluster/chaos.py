@@ -312,6 +312,49 @@ class HostFault:
         return victim
 
 
+@dataclass
+class CapacityLoss:
+    """A host VANISHING from the cluster mid-run: the Node OBJECT is
+    deleted (hypervisor death, node-pool scale-down, zone reclaim) —
+    not merely flapped NotReady. The inventory then has no node
+    claiming that host's cells, so they carve out as down and any
+    binding covering them invalidates: the failure class elastic
+    shrink-to-survive exists for (scheduler/core.py — a gang with no
+    same-size rectangle left re-binds DEGRADED instead of starving).
+    ``restore()`` re-creates the node (capacity returns: spare stock,
+    pool scale-up), which is what grow-to-fill recovers into."""
+
+    node: str
+    fired: bool = False
+    _saved: Optional[dict] = field(default=None, repr=False)
+
+    def fire(self, cluster) -> bool:
+        """Delete the node object; remembers it for restore()."""
+        import copy
+        node = cluster.get_or_none("v1", "Node", "", self.node)
+        if node is None:
+            return False
+        self._saved = copy.deepcopy(node)
+        cluster.delete("v1", "Node", "", self.node)
+        self.fired = True
+        log.info("chaos: capacity loss — node %s vanished", self.node)
+        return True
+
+    def restore(self, cluster) -> bool:
+        """Bring the host back (fresh object identity, same name/labels
+        — a replacement machine, not a resurrection)."""
+        import copy
+        if self._saved is None:
+            return False
+        obj = copy.deepcopy(self._saved)
+        for stale in ("uid", "resourceVersion", "creationTimestamp"):
+            obj.get("metadata", {}).pop(stale, None)
+        cluster.create(obj)
+        self._saved = None
+        log.info("chaos: capacity restored — node %s is back", self.node)
+        return True
+
+
 # ---------------------------------------------------------------- the soak
 
 

@@ -150,6 +150,16 @@ class TrainingJobReconciler(Reconciler):
         if job.scheduling_policy is not None and job.tpu_spec is not None \
                 and binding is None:
             return self._handle_unbound(client, job, manifest)
+        if binding is not None:
+            # Elastic resize: the binding's shape IS the gang's shape.
+            # A scheduler resize rewrites the binding to a different
+            # topology inside the job's [minChips, maxChips] envelope;
+            # adopting it here makes every downstream consumer — pod
+            # entries, topology contracts, KFTPU_SHARDING, the gang
+            # fingerprint — render the RESIZED gang, and the
+            # fingerprint mismatch below restarts the old-shape gang
+            # through the graceful GangResized path.
+            job = self._job_at_binding_shape(job, binding)
 
         pods = client.list("v1", "Pod", namespace, selector=job.selector())
         by_name = {k8s.name_of(p): p for p in pods}
@@ -194,14 +204,18 @@ class TrainingJobReconciler(Reconciler):
                        if rs.is_tpu}
         tpu_names = [n for entries in tpu_entries.values()
                      for n, _ in entries]
-        shape = self._gang_shape(job)
+        shape = self._gang_shape(job, binding)
         shape_anno = k8s.annotations_of(manifest).get(GANG_SHAPE_ANNOTATION)
         if tpu_names and k8s.condition_true(manifest, COND_CREATED) \
                 and not k8s.condition_true(manifest, COND_RESTARTING):
-            if shape_anno is not None and shape_anno != shape:
-                # spec RESIZE/RESHAPE (numSlices/topology changed): the old
-                # shape is baked into every survivor's KFTPU_* env, so the
-                # gang restarts on the new shape — deliberately, without
+            if shape_anno is not None and \
+                    self._shape_changed(shape_anno, shape):
+                # spec RESIZE/RESHAPE (numSlices/topology changed), an
+                # elastic scheduler resize (the adopted binding shape
+                # changed), or a defrag migration (same shape, new
+                # rects): the old shape/placement is baked into every
+                # survivor's KFTPU_* env and node pinning, so the gang
+                # restarts on the new one — deliberately, without
                 # burning backoff budget (an operator action, not a
                 # failure). No by_name guard: even with every pod already
                 # gone this path must run so resumeFrom is set.
@@ -436,13 +450,66 @@ class TrainingJobReconciler(Reconciler):
                 for c in contracts]
 
     @staticmethod
-    def _gang_shape(job: TrainingJob) -> str:
+    def _job_at_binding_shape(job: TrainingJob,
+                              binding: Placement) -> TrainingJob:
+        """The job with its TPU replica spec swapped to the BINDING's
+        shape (elastic resize: the scheduler may bind a shape other
+        than the spec's nominal one, inside the minChips/maxChips
+        envelope — _slice_binding already validated the envelope via
+        binding_matches). Identity when the shapes agree."""
+        import dataclasses
+
+        from ..api.topology import parse_topology
+        tpu = job.tpu_spec
+        if tpu is None or tpu.topology is None:
+            return job
+        if binding.topology == tpu.topology.name \
+                and binding.num_slices == tpu.num_slices:
+            return job
+        try:
+            topo = parse_topology(binding.topology)
+        except ValueError:
+            return job
+        specs = dict(job.replica_specs)
+        specs["TPU"] = dataclasses.replace(
+            tpu, topology=topo, num_slices=binding.num_slices)
+        return dataclasses.replace(job, replica_specs=specs)
+
+    @staticmethod
+    def _shape_changed(shape_anno: str, shape: str) -> bool:
+        """Whether the persisted fingerprint and the computed one name
+        DIFFERENT gangs. A pre-placement-format annotation (no "@rects"
+        suffix — written by an operator version before defrag
+        migration existed) matches on the shape part alone: upgrading
+        the operator must not read every healthy bound gang's
+        annotation as a resize and restart the whole fleet at once.
+        The annotation adopts the new format at the next real
+        create/restart."""
+        if shape_anno == shape:
+            return False
+        if "@" not in shape_anno and shape.split("@", 1)[0] == shape_anno:
+            return False
+        return True
+
+    @staticmethod
+    def _gang_shape(job: TrainingJob,
+                    binding: Placement | None = None) -> str:
         """Shape fingerprint of the TPU replicas (topology×slices per
-        replica type): the value behind GANG_SHAPE_ANNOTATION."""
+        replica type): the value behind GANG_SHAPE_ANNOTATION. With a
+        binding, the PLACEMENT rides in the fingerprint too: a
+        scheduler defrag migration moves the gang without changing its
+        size, and the running pods (pinned to the old pool/rect) must
+        still restart onto the new cells."""
         parts = [f"{rtype}:{rs.topology.name}x{rs.num_slices}"
                  for rtype, rs in sorted(job.replica_specs.items())
                  if rs.is_tpu and rs.topology is not None]
-        return ";".join(parts)
+        shape = ";".join(parts)
+        if binding is not None and binding.slices:
+            rects = ",".join(
+                f"{r.pool}:{r.x}.{r.y}.{r.h}x{r.w}"
+                for r in binding.slices)
+            shape += f"@{rects}"
+        return shape
 
     def _ensure_pods(self, client: KubeClient, job: TrainingJob,
                      manifest: dict, existing: dict[str, dict],

@@ -59,11 +59,17 @@ def _job_schema(specs_key: str, max_one: list[str]) -> dict:
         # gang-scheduling knobs (api/trainingjob.py SchedulingPolicy →
         # the slice scheduler's queue/priority/preemptible; a job
         # carrying this block waits in Queued until the scheduler binds
-        # it — tests/test_lint.py enforces the same full-path rule)
+        # it — tests/test_lint.py enforces the same full-path rule).
+        # minChips/maxChips make the gang ELASTIC: the scheduler may
+        # resize its binding inside the envelope at checkpoint
+        # boundaries (shrink-to-survive/-admit, grow-to-fill, defrag —
+        # docs/operations.md "Elastic resizing")
         "schedulingPolicy": {"type": "object", "properties": {
             "queue": {"type": "string"},
             "priority": {"type": "integer"},
             "preemptible": {"type": "boolean"},
+            "minChips": {"type": "integer", "minimum": 1},
+            "maxChips": {"type": "integer", "minimum": 1},
         }},
         # observability knobs (api/trainingjob.py ObsSpec → the worker's
         # KFTPU_SPAN_PATH span sink and KFTPU_OBS_METRICS_PORT /metrics
@@ -186,7 +192,11 @@ def tpu_scheduler(namespace: str = "kubeflow",
                   backfill: bool = True,
                   preemption: bool = True,
                   queues: dict | None = None,
-                  health: dict | None = None) -> list[dict]:
+                  health: dict | None = None,
+                  elastic: bool = True,
+                  grow: bool = True,
+                  defrag: bool = True,
+                  grow_cooldown_seconds: float = 300.0) -> list[dict]:
     """``queues`` is the SchedulerConfig wire shape
     (scheduler/queue.py), e.g. ``{"research": {"quotaChips":
     {"team-a": 32, "*": 64}}}`` — per-queue, per-namespace bound-chip
@@ -196,7 +206,12 @@ def tpu_scheduler(namespace: str = "kubeflow",
     "quarantineThreshold": 3, "releaseThreshold": 1,
     "quarantineSeconds": 900}`` — omitted keys keep the defaults;
     ``{"enabled": false}`` turns the whole quarantine feedback loop
-    off (docs/operations.md "Node health and quarantine")."""
+    off (docs/operations.md "Node health and quarantine").
+    ``elastic``/``grow``/``defrag``/``grow_cooldown_seconds`` are the
+    elastic-resizing policy switches (scheduler/queue.py
+    SchedulerConfig; docs/operations.md "Elastic resizing"): the
+    master resize switch, grow-to-fill, defrag migration, and the
+    per-gang hysteresis between grows/migrations."""
     import json
 
     from ..scheduler.health import HealthConfig
@@ -218,6 +233,8 @@ def tpu_scheduler(namespace: str = "kubeflow",
     cm = H.config_map("tpu-scheduler-config", namespace, {
         "config.json": json.dumps({
             "backfill": backfill, "preemption": preemption,
+            "elastic": elastic, "grow": grow, "defrag": defrag,
+            "growCooldownSeconds": grow_cooldown_seconds,
             "queues": queues or {},
             # render the FULL health block (defaults made explicit) so
             # the deployed knobs are discoverable with kubectl, and
@@ -270,6 +287,8 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
                    queue: str | None = None,
                    priority: int | None = None,
                    preemptible: bool | None = None,
+                   min_chips: int | None = None,
+                   max_chips: int | None = None,
                    span_path: str | None = None,
                    obs_metrics_port: int | None = None) -> list[dict]:
     """fused_blocks opts into the ghost-BN fused bottleneck kernels
@@ -305,8 +324,12 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
     scheduler (kubeflow_tpu/scheduler/) binds its gang, and a
     ``preemptible`` gang may be reclaimed (checkpoint + requeue) for a
     higher-priority job (docs/operations.md "Scheduling, queues, and
-    quotas"). Leave all three unset (None) for the legacy
-    immediate-create path.
+    quotas"). ``min_chips``/``max_chips`` make the gang ELASTIC: the
+    scheduler may resize its binding anywhere inside the envelope at
+    checkpoint boundaries — shrink to survive a lost host or admit a
+    blocked head, grow into idle chips, migrate to defragment
+    (docs/operations.md "Elastic resizing"). Leave every scheduling
+    knob unset (None) for the legacy immediate-create path.
 
     ``span_path``/``obs_metrics_port`` render spec.observability
     (api/trainingjob.py ObsSpec → KFTPU_SPAN_PATH /
@@ -376,11 +399,14 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
         ispec.validate()
         job["spec"]["input"] = ispec.to_dict()
     if queue is not None or priority is not None or \
-            preemptible is not None:
+            preemptible is not None or min_chips is not None or \
+            max_chips is not None:
         from ..api.trainingjob import SchedulingPolicy
         policy = SchedulingPolicy(queue=queue or "",
                                   priority=priority or 0,
-                                  preemptible=bool(preemptible))
+                                  preemptible=bool(preemptible),
+                                  min_chips=min_chips,
+                                  max_chips=max_chips)
         policy.validate()
         job["spec"]["schedulingPolicy"] = policy.to_dict()
     if span_path is not None or obs_metrics_port is not None:

@@ -56,6 +56,14 @@ except ImportError:  # pragma: no cover
     ocp = None
     HAVE_ORBAX = False
 
+class ElasticContractError(ValueError):
+    """An elastic-resize restore contract breach (changed global batch,
+    non-dividing replica degree): NEVER absorbed by the newest-first
+    fallback walk — every candidate step carries the same breach, and
+    silently restoring an older one would change the trajectory the
+    check exists to protect."""
+
+
 # orbax finalizes a step by renaming the tmp dir and writing this marker;
 # its absence means the step never committed (half-written)
 ORBAX_COMMIT_MARKER = "_CHECKPOINT_METADATA"
@@ -74,10 +82,15 @@ def _crc32_file(path: str, chunk: int = 1 << 20) -> int:
             crc = zlib.crc32(buf, crc)
 
 
-def write_manifest(step_dir: str) -> dict:
+def write_manifest(step_dir: str,
+                   run_meta: Optional[dict] = None) -> dict:
     """Record every payload file's size + crc32 and commit the manifest by
     atomic rename — the cheap corruption detector a plain rename-commit
-    (which only proves the DIRECTORY was finalized) cannot give."""
+    (which only proves the DIRECTORY was finalized) cannot give.
+    ``run_meta`` (the elastic-resize contract: replicaDegree,
+    globalBatch) rides along under the "run" key so a restore at a
+    DIFFERENT replica degree can validate the fixed-global-batch
+    invariant before reshaping the state."""
     entries: dict[str, dict] = {}
     for root, _dirs, files in os.walk(step_dir):
         for fname in files:
@@ -88,6 +101,8 @@ def write_manifest(step_dir: str) -> dict:
             entries[rel] = {"size": os.path.getsize(path),
                             "crc32": _crc32_file(path)}
     manifest = {"version": 1, "files": entries}
+    if run_meta:
+        manifest["run"] = dict(run_meta)
     tmp = os.path.join(step_dir, MANIFEST_NAME + ".tmp")
     with open(tmp, "w") as f:
         json.dump(manifest, f)
@@ -134,13 +149,18 @@ class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 3,
                  save_interval_steps: int = 1,
                  save_retries: int = 2, retry_backoff_s: float = 0.5,
-                 save_delay_s: float = 0.0):
+                 save_delay_s: float = 0.0,
+                 run_meta: Optional[dict] = None):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         if not HAVE_ORBAX:
             raise RuntimeError("orbax-checkpoint is not available")
         self.save_retries = max(0, int(save_retries))
         self.retry_backoff_s = retry_backoff_s
+        # stamped into every manifest's "run" block (elastic resizing:
+        # the replica degree + global batch this writer trained at —
+        # restore across a different degree validates against it)
+        self.run_meta = dict(run_meta) if run_meta else None
         # fault-injection knob (cluster/chaos.py "slow checkpoint I/O"):
         # sleep this long before submitting each save
         self.save_delay_s = save_delay_s
@@ -232,7 +252,7 @@ class CheckpointManager:
             if not os.path.isdir(step_dir):
                 continue  # already pruned by max_to_keep
             try:
-                write_manifest(step_dir)
+                write_manifest(step_dir, run_meta=self.run_meta)
             except OSError as e:
                 # a missing manifest only downgrades verification, never
                 # the checkpoint itself — don't fail the run over it
@@ -265,6 +285,21 @@ class CheckpointManager:
             # without a manifest may gain one later (async flush)
             self._intact_cache.add(step)
         return ok, reason
+
+    def run_meta_of(self, step: int) -> dict:
+        """The "run" block of a step's manifest (replicaDegree,
+        globalBatch — what the writer trained at). {} when the step has
+        no manifest or an unreadable one: older writers never stamped
+        run metadata, and that must degrade to "no validation", not an
+        error."""
+        mpath = os.path.join(self.directory, str(step), MANIFEST_NAME)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return {}
+        run = manifest.get("run")
+        return dict(run) if isinstance(run, dict) else {}
 
     def intact_steps(self) -> list[int]:
         """Committed + checksum-verified steps, ascending."""
@@ -321,6 +356,8 @@ class CheckpointManager:
                 out = restore_fn(candidate)
                 _obs_duration("restore").observe(time.perf_counter() - t0)
                 return out
+            except ElasticContractError:
+                raise   # a breach is a breach at EVERY step: no fallback
             except Exception as e:  # noqa: BLE001 — fall back to prior step
                 last_err = e
                 log.warning("restore of step %d failed (%s); falling back "
@@ -329,16 +366,79 @@ class CheckpointManager:
             raise last_err
         raise FileNotFoundError(f"no intact checkpoint in {self.directory}")
 
-    def restore(self, state_template: Any, step: Optional[int] = None) -> Any:
+    def check_elastic_resume(self, step: Optional[int],
+                             replica_degree: Optional[int],
+                             global_batch: Optional[int]) -> dict:
+        """The elastic-resize restore contract, validated BEFORE the
+        reshape: when the checkpoint was written at a different
+        data-parallel replica degree than the reader's, the GLOBAL
+        batch size must be unchanged (resizes trade replica count for
+        per-replica batch, never the optimization trajectory — a
+        changed global batch would silently alter the data order and
+        the gradient noise scale) and must divide the new degree.
+        Returns {"resharded": bool, "from": N, "to": M}; {} when the
+        step carries no run metadata (pre-elastic writers) or no
+        degree change is happening. Raises ValueError on a contract
+        breach — loudly at restore, not subtly at step 1."""
+        if step is None:
+            step = self.latest_step()
+        if step is None or replica_degree is None:
+            return {}
+        saved = self.run_meta_of(step)
+        saved_degree = saved.get("replicaDegree")
+        if not saved_degree or saved_degree == replica_degree:
+            return {}
+        saved_gb = saved.get("globalBatch")
+        if saved_gb and global_batch and saved_gb != global_batch:
+            raise ElasticContractError(
+                f"elastic restore of step {step}: checkpoint was "
+                f"written at global batch {saved_gb} but this worker "
+                f"runs {global_batch} — resizing keeps the global "
+                f"batch FIXED (only the replica degree changes); "
+                f"refusing a silent trajectory change")
+        if global_batch and global_batch % replica_degree:
+            raise ElasticContractError(
+                f"elastic restore of step {step}: global batch "
+                f"{global_batch} does not divide the new replica "
+                f"degree {replica_degree}")
+        log.info("elastic restore @%d: reshaping state across replica "
+                 "degrees %d -> %d (global batch fixed)", step,
+                 saved_degree, replica_degree)
+        obsreg.counter(
+            "kftpu_checkpoint_elastic_restores_total",
+            "restores that reshaped sharded state across a different "
+            "data-parallel replica degree (elastic resize)").inc()
+        return {"resharded": True, "from": saved_degree,
+                "to": replica_degree}
+
+    def restore(self, state_template: Any, step: Optional[int] = None,
+                expect_run: Optional[tuple] = None) -> Any:
         """Restore into the template's shardings (template = an abstract or
-        concrete TrainState with the target shardings attached)."""
+        concrete TrainState with the target shardings attached). This IS
+        the elastic reshape: the template carries the CURRENT mesh's
+        shardings, so a checkpoint written at replica degree N restores
+        onto a degree-M mesh by resharding every leaf — params,
+        per-replica-distributed optimizer moments (weight_update=sharded
+        lays adam mu/nu over the replica axes), batch stats — into the
+        new layout on load. Leaf SHAPES are degree-invariant (global
+        logical arrays). ``expect_run`` = (replica_degree, global_batch)
+        of the READER: the elastic contract is then validated per
+        candidate step — against the step ACTUALLY restored, not merely
+        the newest one, so a fallback past a corrupt step cannot dodge
+        the check (a breach raises ElasticContractError instead of
+        falling back: every candidate carries the same breach)."""
         abstract = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
             if hasattr(x, "sharding") else x,
             state_template)
-        return self._restore_with_fallback(
-            lambda s: self._mgr.restore(
-                s, args=ocp.args.StandardRestore(abstract)), step)
+
+        def _restore(s: int) -> Any:
+            if expect_run is not None:
+                self.check_elastic_resume(s, *expect_run)
+            return self._mgr.restore(
+                s, args=ocp.args.StandardRestore(abstract))
+
+        return self._restore_with_fallback(_restore, step)
 
     def restore_params(self, step: Optional[int] = None) -> Any:
         """Restore just the model params, template-free. The trainer writes

@@ -296,20 +296,35 @@ def train(
     checkpoint_dir = checkpoint_dir or os.environ.get("KFTPU_CHECKPOINT_DIR")
     resume_from = resume_from or os.environ.get("KFTPU_RESUME_FROM")
 
+    # Elastic-resize restore contract: every save stamps the writer's
+    # replica degree + global batch; a restore at a DIFFERENT degree
+    # (the scheduler shrank/grew the gang between restarts) validates
+    # the fixed-global-batch invariant, then the template's shardings
+    # reshape the state — incl. the ZeRO-2-distributed optimizer
+    # moments — onto the new mesh (runtime/checkpoint.py).
+    from ..parallel.mesh import replica_degree
+    degree = replica_degree(ctx.mesh) or 1
+    run_meta = {"replicaDegree": degree, "globalBatch": global_batch}
+
     ckpt = None
     if checkpoint_dir and HAVE_ORBAX:
         ckpt = CheckpointManager(checkpoint_dir,
-                                 save_interval_steps=checkpoint_every)
+                                 save_interval_steps=checkpoint_every,
+                                 run_meta=run_meta)
         if resume and ckpt.latest_step() is not None:
-            state = ckpt.restore(state)
+            # expect_run: the elastic contract is checked against the
+            # step the fallback walk ACTUALLY restores
+            state = ckpt.restore(state,
+                                 expect_run=(degree, global_batch))
             log.info("resumed from step %d", int(state.step))
     if resume_from and int(state.step) == 0 and HAVE_ORBAX:
         # warm start / gang-restart restore: only when the local
         # checkpoint_dir had nothing newer
         src = ckpt if resume_from == checkpoint_dir else \
-            CheckpointManager(resume_from)
+            CheckpointManager(resume_from, run_meta=run_meta)
         if src.latest_step() is not None:
-            state = src.restore(state)
+            state = src.restore(state,
+                                expect_run=(degree, global_batch))
             log.info("resumed from %s at step %d", resume_from,
                      int(state.step))
         if src is not ckpt:

@@ -13,14 +13,16 @@ operator process (soak.py imports the runtime lazily inside run()).
 
 from .inventory import Placement, PoolState, SliceInventory, SliceRect
 from .queue import (JobRequest, QueueSpec, SchedulerConfig, binding_of,
-                    ordered, over_quota, request_of)
+                    elastic_topologies, ordered, over_quota, request_of,
+                    resize_history)
 from .core import (Plan, SliceScheduler, STATE_BOUND, STATE_PREEMPTED,
                    STATE_QUEUED, plan)
 
 __all__ = [
     "Placement", "PoolState", "SliceInventory", "SliceRect",
     "JobRequest", "QueueSpec", "SchedulerConfig", "binding_of",
-    "ordered", "over_quota", "request_of",
+    "elastic_topologies", "ordered", "over_quota", "request_of",
+    "resize_history",
     "Plan", "SliceScheduler", "plan",
     "STATE_BOUND", "STATE_PREEMPTED", "STATE_QUEUED",
 ]

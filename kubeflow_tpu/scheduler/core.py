@@ -12,14 +12,24 @@ planning pass:
    and walk it: quota-blocked jobs wait; placeable jobs bind (the
    placement annotation); the FIRST unplaceable job becomes the blocked
    head of line.
-3. The blocked head may PREEMPT: cheapest lower-priority preemptible
-   gangs (fewest chips first) are unbound until the head fits. A victim
-   is re-queued, not failed — the operator tears its gang down through
-   the graceful path (SIGTERM → forced checkpoint → exit 75) and the
-   job's own checkpoints make the eventual re-bind cheap.
+3. The blocked head's fallback ladder: SHRINK lower-priority elastic
+   gangs (minChips/maxChips jobs resize to a smaller slice size — a
+   checkpointed restart, no work lost) until the head fits; an elastic
+   head that still cannot place binds DEGRADED below its nominal shape
+   (shrink-to-survive — the lost-host case); only then PREEMPT —
+   cheapest lower-priority preemptible gangs (fewest chips first) are
+   unbound until the head fits. A victim is re-queued, not failed — the
+   operator tears its gang down through the graceful path (SIGTERM →
+   forced checkpoint → exit 75) and the job's own checkpoints make the
+   eventual re-bind cheap.
 4. Behind a blocked head, BACKFILL continues — but never into the head's
    reserved region (a geometry-only placement of the head's shape whose
    cells only ever drain), so backfill can never starve the head.
+5. With nothing waiting on capacity, the idle-chip passes run: GROW one
+   bound elastic gang into free chips, or MIGRATE one to enlarge the
+   largest contiguous free rectangle (defragmentation) — one resize per
+   pass, each executed by the operator as a checkpointed gang restart
+   at the binding's new shape.
 
 ``plan()`` is pure (inventory in, actions out): the k8s loop
 (SliceScheduler) and the bench's contended-cluster simulation
@@ -38,6 +48,7 @@ from ..api import k8s
 from ..api.trainingjob import (BINDING_ANNOTATION, COND_FAILED,
                                COND_SUCCEEDED, PREEMPTED_COUNT_ANNOTATION,
                                QUARANTINE_ANNOTATION,
+                               RESIZE_HISTORY_ANNOTATION,
                                SCHED_REASON_ANNOTATION,
                                SCHED_STATE_ANNOTATION, SUSPECT_ANNOTATION,
                                TPU_API_VERSION, TrainingJob)
@@ -48,7 +59,12 @@ from ..obs import registry as obsreg
 from . import health
 from .inventory import POOL_LABEL, Placement, SliceInventory
 from .queue import (JobRequest, SchedulerConfig, binding_matches,
-                    binding_of, ordered, over_quota, request_of)
+                    binding_of, ordered, over_quota, request_of,
+                    resize_history)
+
+# resize-history entries kept on the annotation (audit trail + the grow
+# cooldown's clock; older entries roll off)
+RESIZE_HISTORY_MAX = 20
 
 log = logging.getLogger(__name__)
 
@@ -60,11 +76,17 @@ STATE_PREEMPTED = "preempted"
 
 @dataclass
 class Plan:
-    """One pass's decisions, in apply order: victims release first (their
-    chips are what the binds below may be counting on)."""
+    """One pass's decisions, in apply order: resizes and victims release
+    first (their chips are what the binds below may be counting on)."""
 
     binds: list = field(default_factory=list)       # (JobRequest, Placement)
     preempts: list = field(default_factory=list)    # JobRequest (victims)
+    # elastic resize plans: (JobRequest, new Placement, reason) — the
+    # binding rewrites the operator executes as a checkpointed gang
+    # restart at the new shape (shrink-to-admit, grow-to-fill, defrag
+    # migration; a shrink-to-survive of a QUEUED job rides in ``binds``
+    # with a reduced-shape placement instead)
+    resizes: list = field(default_factory=list)
     # key -> human reason a job stayed queued (quota, capacity, ...)
     waits: dict = field(default_factory=dict)
 
@@ -119,6 +141,109 @@ def _preempt_for(head: JobRequest, bound: list,
     return victims
 
 
+def _rects_free(inventory: SliceInventory, placement) -> bool:
+    """Whether every cell of ``placement`` is currently free."""
+    for rect in placement.slices:
+        pool = inventory.pools.get(rect.pool)
+        if pool is None or not pool.fits(rect.x, rect.y, rect.h, rect.w):
+            return False
+    return True
+
+
+def _shrink_for(head: JobRequest, bound: list,
+                inventory: SliceInventory,
+                avoid: Optional[set] = None) -> Optional[list]:
+    """Shrink set of elastic lower-priority bound gangs that lets
+    ``head`` fit at its nominal shape, or None. The resize analog of
+    ``_preempt_for`` and tried BEFORE it: a shrink is a checkpointed
+    restart at a smaller replica degree — degraded-mode training — so
+    no work is thrown away, where a preemption costs the victim its
+    progress since the last checkpoint. Victims shrink one supported
+    slice size at a time, lowest priority first (biggest current gang
+    breaking ties — most chips freed per restart), until the head
+    places; then resizes are PRUNED: any victim whose original rects
+    are still free with the head placeable is restored — nobody eats a
+    restart for chips the head never needed. Mutates the inventory only
+    when a sufficient set exists. Returns [(victim, new Placement)]."""
+    from .queue import elastic_topologies, placement_slice_chips
+    candidates = []
+    for r, p in bound:
+        if r.priority >= head.priority or not r.elastic:
+            continue
+        cur = placement_slice_chips(p)
+        opts = [t for t in elastic_topologies(r) if t.num_chips < cur]
+        if opts:
+            candidates.append((r, p, opts))
+    if not candidates:
+        return None
+    candidates.sort(key=lambda c: (c[0].priority, -c[1].chips, c[0].key))
+    snapshot = [[row[:] for row in p.grid]
+                for p in inventory.pools.values()]
+    resized: dict[str, tuple] = {}   # key -> (victim, original, new)
+    fits = False
+    for victim, original, opts in candidates:
+        for topo in opts:   # descending: one supported size at a time
+            inventory.release(victim.key)
+            new_p = inventory.place_gang(topo, victim.num_slices,
+                                         flexible=True)
+            if new_p is None:
+                # smaller shape unplaceable (pathological fragmentation):
+                # restore the victim's current occupancy and move on
+                cur_p = resized[victim.key][2] if victim.key in resized \
+                    else original
+                inventory.bind(victim.key, cur_p)
+                break
+            inventory.bind(victim.key, new_p)
+            resized[victim.key] = (victim, original, new_p)
+            if inventory.place_gang(head.topology, head.num_slices,
+                                    avoid=avoid) is not None:
+                fits = True
+                break
+        if fits:
+            break
+    if not fits:
+        for pool, grid in zip(inventory.pools.values(), snapshot):
+            pool.grid = [row[:] for row in grid]
+        return None
+    # prune most-chips-restored-first: keep the cheapest shrink set
+    for key, (victim, original, new_p) in sorted(
+            resized.items(), key=lambda kv: -kv[1][1].chips):
+        inventory.release(victim.key)
+        if _rects_free(inventory, original):
+            inventory.bind(victim.key, original)
+            if inventory.place_gang(head.topology, head.num_slices,
+                                    avoid=avoid) is not None:
+                del resized[key]    # never actually needed to shrink
+                continue
+            inventory.release(victim.key)
+        inventory.bind(victim.key, new_p)
+    return [(v, p) for v, _o, p in resized.values()]
+
+
+def _place_degraded(inventory: SliceInventory, req: JobRequest,
+                    avoid: Optional[set], reserved: set):
+    """Shrink-to-survive placement for a QUEUED elastic job: walk the
+    allowed shapes BELOW nominal, largest first, honoring the same
+    avoid-preference semantics as the nominal attempt (suspect cells are
+    a preference; the head-of-line reservation is inviolable). Returns
+    the reduced-shape Placement or None — degraded-mode training
+    instead of starving behind a lost host or a fragmented pool."""
+    from .queue import elastic_topologies
+    for topo in elastic_topologies(req):
+        if topo.num_chips >= req.topology.num_chips:
+            continue
+        placement = inventory.place_gang(topo, req.num_slices,
+                                         avoid=avoid or None,
+                                         flexible=True)
+        if placement is None and avoid and avoid != reserved:
+            placement = inventory.place_gang(topo, req.num_slices,
+                                             avoid=reserved or None,
+                                             flexible=True)
+        if placement is not None:
+            return placement
+    return None
+
+
 def plan(queued: list[JobRequest], bound: list,
          inventory: SliceInventory, config: SchedulerConfig,
          avoid_cells: Optional[dict] = None) -> Plan:
@@ -165,11 +290,51 @@ def plan(queued: list[JobRequest], bound: list,
                                  "(backfill could not place clear of " \
                                  "the head-of-line reservation)"
             continue
-        # the blocked head of line: try preemption, else reserve — the
-        # suspect exclusion stays preference-only here too: a head that
-        # cannot preempt or reserve clear of its suspect falls back to
-        # ignoring it rather than deadlocking the queue
+        # The blocked head of line. Resize paths come FIRST — both end
+        # at a checkpoint boundary so no work is thrown away: (1) shrink
+        # elastic lower-priority gangs until the head fits at nominal
+        # (instead of preempting them to zero), (2) shrink the head
+        # ITSELF below nominal (degraded-mode training — the lost-host /
+        # no-same-size-rectangle case; better to run at half width than
+        # to starve or crash-loop). Only then preemption, else reserve —
+        # the suspect exclusion stays preference-only throughout: a head
+        # that cannot place clear of its suspect falls back to ignoring
+        # it rather than deadlocking the queue.
         head_avoid = avoid_cells.get(req.key, set())
+        if config.elastic:
+            shrunk = _shrink_for(req, live_bound, inventory,
+                                 avoid=head_avoid or None)
+            if shrunk is None and head_avoid:
+                shrunk = _shrink_for(req, live_bound, inventory)
+                if shrunk is not None:
+                    head_avoid = set()
+            if shrunk is not None:
+                new_by_key = {v.key: p for v, p in shrunk}
+                live_bound = [(r, new_by_key.get(r.key, p))
+                              for r, p in live_bound]
+                out.resizes.extend(
+                    (v, p, "shrink: admitting blocked head")
+                    for v, p in shrunk)
+                placement = inventory.place_gang(
+                    req.topology, req.num_slices,
+                    avoid=head_avoid or None)
+                if placement is not None:
+                    inventory.bind(req.key, placement)
+                    out.binds.append((req, placement))
+                    live_bound.append((req, placement))
+                    continue
+            if req.elastic:
+                placement = _place_degraded(inventory, req,
+                                            avoid=head_avoid or None,
+                                            reserved=reserved)
+                if placement is not None:
+                    # bound at a reduced shape: the binding itself is
+                    # the resize plan — grow-to-fill restores the
+                    # nominal shape once capacity returns
+                    inventory.bind(req.key, placement)
+                    out.binds.append((req, placement))
+                    live_bound.append((req, placement))
+                    continue
         if config.preemption:
             victims = _preempt_for(req, live_bound, inventory,
                                    avoid=head_avoid or None)
@@ -200,7 +365,101 @@ def plan(queued: list[JobRequest], bound: list,
             "capacity: head of line, waiting for reserved slices to "
             "drain" if reserved else
             "capacity: request can never fit this cluster's pools")
+    if config.elastic and not head_blocked:
+        _plan_grow_and_defrag(out, live_bound, inventory, config)
+    # One action per job per pass: a gang BOUND this pass and then
+    # resized by a later head's shrink (or the grow pass) folds into a
+    # single bind at the final shape — it has no running pods yet, so
+    # there is nothing to restart and no separate resize to record.
+    bind_idx = {r.key: i for i, (r, _p) in enumerate(out.binds)}
+    folded = []
+    for req, placement, reason in out.resizes:
+        i = bind_idx.get(req.key)
+        if i is not None:
+            out.binds[i] = (out.binds[i][0], placement)
+        else:
+            folded.append((req, placement, reason))
+    out.resizes = folded
     return out
+
+
+def _plan_grow_and_defrag(out: Plan, live_bound: list,
+                          inventory: SliceInventory,
+                          config: SchedulerConfig) -> None:
+    """The idle-capacity passes, run only when nothing is waiting on
+    capacity (head not blocked — with backfill on, every remaining wait
+    is quota): (1) GROW one bound elastic gang into the idle chips,
+    largest allowed shape first, highest priority gang first; (2) if
+    nothing grew, MIGRATE one bound elastic gang whose re-placement
+    strictly enlarges the cluster's largest contiguous free rectangle
+    (defragmentation — stranded slivers are what quietly halve a
+    cluster's effective capacity). One resize per pass: each is a
+    checkpointed gang restart, and the next pass sees the new state —
+    incremental beats a same-pass restart storm. Gangs inside the grow
+    cooldown (req.grow_ok False) are skipped; both passes respect
+    per-(queue, namespace) quotas via the gang's ACTUAL chip count."""
+    from ..api.topology import parse_topology
+    from .queue import elastic_topologies, placement_slice_chips
+
+    def actual_bound_chips(queue: str, namespace: str,
+                           skip_key: str) -> int:
+        return sum(p.chips for r, p in live_bound
+                   if r.queue == queue and r.namespace == namespace
+                   and r.key != skip_key)
+
+    candidates = sorted(
+        ((r, p) for r, p in live_bound if r.elastic and r.grow_ok),
+        key=lambda rp: (-rp[0].priority, rp[0].seq, rp[0].key))
+    if config.grow:
+        for req, placement in candidates:
+            cur = placement_slice_chips(placement)
+            ups = [t for t in elastic_topologies(req)
+                   if t.num_chips > cur]
+            if not ups:
+                continue
+            quota = config.queue(req.queue).quota_for(req.namespace)
+            others = actual_bound_chips(req.queue, req.namespace,
+                                        req.key)
+            inventory.release(req.key)
+            new_p = None
+            for topo in ups:    # largest allowed shape first
+                total = topo.num_chips * req.num_slices
+                if quota is not None and others + total > quota:
+                    continue
+                new_p = inventory.place_gang(topo, req.num_slices,
+                                             flexible=True)
+                if new_p is not None:
+                    break
+            if new_p is None:
+                inventory.bind(req.key, placement)
+                continue
+            inventory.bind(req.key, new_p)
+            out.resizes.append((req, new_p, "grow: idle capacity"))
+            return
+    if not config.defrag:
+        return
+    def frag_score() -> int:
+        return max((p.max_free_rect()
+                    for p in inventory.pools.values()), default=0)
+    before = frag_score()
+    for req, placement in candidates:
+        try:
+            topo = parse_topology(placement.topology)
+        except ValueError:
+            continue
+        inventory.release(req.key)
+        new_p = inventory.place_gang(topo, req.num_slices,
+                                     flexible=True)
+        if new_p is None or new_p.slices == placement.slices:
+            inventory.bind(req.key, placement)
+            continue
+        inventory.bind(req.key, new_p)
+        if frag_score() > before:
+            out.resizes.append((req, new_p, "defrag: migrating to "
+                                "enlarge the largest free rectangle"))
+            return
+        inventory.release(req.key)
+        inventory.bind(req.key, placement)
 
 
 class SliceScheduler(Reconciler):
@@ -480,6 +739,16 @@ class SliceScheduler(Reconciler):
                         avoid_cells[req.key] = suspect_cells
                     continue
             if ok:
+                # grow/defrag hysteresis: a gang resized more recently
+                # than the cooldown is not grown or migrated again (a
+                # shrink stays allowed — it happens via requeue+replan)
+                hist = resize_history(manifest)
+                if hist:
+                    try:
+                        last = float(hist[-1].get("time", 0))
+                    except (TypeError, ValueError):
+                        last = 0.0
+                    req.grow_ok = now - last >= self.config.grow_cooldown_s
                 bound.append((req, placement))
                 if suspect:
                     # bound clear of the suspect (already migrated, or
@@ -510,6 +779,10 @@ class SliceScheduler(Reconciler):
         # invariant as the operator's gang-restart counter): a transient
         # apiserver error requeues the whole pass, and the retry must
         # not double-count a preemption or observe a bogus second wait
+        for req, new_placement, reason in decisions.resizes:
+            old = next((p for r, p in bound if r.key == req.key), None)
+            self._apply_resize(client, manifests[req.key], old,
+                               new_placement, reason)
         for victim in decisions.preempts:
             self._apply_preempt(client, manifests[victim.key])
             obsreg.counter(
@@ -524,9 +797,26 @@ class SliceScheduler(Reconciler):
             # a rebind retires the job's suspect record: the new
             # placement was planned around it, evidence already folded
             extra = {SUSPECT_ANNOTATION: None} \
-                if health.suspect_of(manifests[req.key]) else None
+                if health.suspect_of(manifests[req.key]) else {}
+            resized = placement.chips != req.chips
+            if resized:
+                # a non-nominal bind IS the resize — below nominal it is
+                # shrink-to-survive, above it a grow folded into the
+                # bind (gang placed straight into idle capacity) —
+                # recorded on the history annotation so dashboards and
+                # the grow cooldown see it
+                reason = ("shrink: degraded bind (no nominal rectangle "
+                          "free)" if placement.chips < req.chips else
+                          "grow: bound above nominal into idle capacity")
+                extra[RESIZE_HISTORY_ANNOTATION] = self._history_json(
+                    manifests[req.key], req.chips, placement.chips,
+                    reason, now)
             self._patch_state(client, manifests[req.key], STATE_BOUND,
-                              "bound", binding=placement, extra=extra)
+                              "bound", binding=placement,
+                              extra=extra or None)
+            if resized:
+                self._count_resize(manifests[req.key], req.chips,
+                                   placement.chips, reason)
             waited = now - self._queued_since.pop(req.key, now)
             obsreg.histogram(
                 "kftpu_sched_queue_wait_seconds",
@@ -644,6 +934,55 @@ class SliceScheduler(Reconciler):
             if anns.get(SCHED_STATE_ANNOTATION) == STATE_PREEMPTED \
             else STATE_QUEUED
         self._patch_state(client, manifest, state, reason, binding=None)
+
+    @staticmethod
+    def _history_json(manifest: dict, from_chips: int, to_chips: int,
+                      reason: str, now: float) -> str:
+        """The updated resize-history annotation value: prior entries
+        (malformed → dropped) plus this resize, capped at
+        RESIZE_HISTORY_MAX, newest last."""
+        hist = resize_history(manifest)
+        hist.append({"time": round(now, 3), "fromChips": from_chips,
+                     "toChips": to_chips, "reason": reason})
+        return json.dumps(hist[-RESIZE_HISTORY_MAX:])
+
+    def _count_resize(self, manifest: dict, from_chips: int,
+                      to_chips: int, reason: str) -> None:
+        direction = "grow" if to_chips > from_chips else \
+            "shrink" if to_chips < from_chips else "migrate"
+        obsreg.counter(
+            "kftpu_sched_resizes_total",
+            "elastic gang resizes applied (binding rewritten; the "
+            "operator executes a checkpointed restart at the new "
+            "shape)", labels=("direction",)).labels(
+                direction=direction).inc()
+        self._trace_event(manifest, "resized", direction=direction,
+                          from_chips=from_chips, to_chips=to_chips,
+                          reason=reason)
+
+    def _apply_resize(self, client: KubeClient, manifest: dict,
+                      old: Optional[Placement], new_placement: Placement,
+                      reason: str) -> None:
+        """Rewrite a bound gang's binding to the resized placement. The
+        operator sees the binding's shape diverge from the running
+        gang's and restarts it through the graceful GangResized path
+        (SIGTERM → forced checkpoint → exit 75 → recreate at the new
+        shape with resumeFrom) — a resize never burns backoff budget
+        and never loses work past the forced save."""
+        now = time.time()
+        from_chips = old.chips if old is not None else 0
+        self._patch_state(
+            client, manifest, STATE_BOUND, f"resized: {reason}",
+            binding=new_placement,
+            extra={RESIZE_HISTORY_ANNOTATION: self._history_json(
+                manifest, from_chips, new_placement.chips, reason, now)})
+        # counted AFTER the patch succeeded (the pass-wide invariant)
+        self._count_resize(manifest, from_chips, new_placement.chips,
+                           reason)
+        log.info("scheduler: resized %s/%s %d -> %d chips (%s)",
+                 k8s.namespace_of(manifest, "default"),
+                 k8s.name_of(manifest), from_chips, new_placement.chips,
+                 reason)
 
     def _apply_preempt(self, client: KubeClient, manifest: dict) -> None:
         """Unbind a victim: the operator observes the missing binding and

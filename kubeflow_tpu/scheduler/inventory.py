@@ -319,12 +319,26 @@ class SliceInventory:
     # -- placement ----------------------------------------------------------
 
     @staticmethod
-    def _orientations(topo: SliceTopology) -> list[tuple[int, int]]:
-        h, w = (topo.ici_mesh + (1, 1))[:2]
-        return [(h, w)] if h == w else [(h, w), (w, h)]
+    def _orientations(topo: SliceTopology,
+                      flexible: bool = False) -> list[tuple[int, int]]:
+        if not flexible:
+            h, w = (topo.ici_mesh + (1, 1))[:2]
+            return [(h, w)] if h == w else [(h, w), (w, h)]
+        # flexible (elastic-resize) placement: ANY rectangle of the
+        # right chip count, not just the canonical ICI mesh — a gang
+        # shrunk onto a pool's surviving host must be able to take that
+        # host's 1 x chips_per_host strip even though the named
+        # topology's default mesh is square. Near-square shapes first
+        # (fewest ICI hops), deterministic order.
+        n = topo.num_chips
+        shapes = sorted(
+            {(h, n // h) for h in range(1, n + 1) if n % h == 0},
+            key=lambda hw: (abs(hw[0] - hw[1]), hw[0]))
+        return shapes
 
     def _candidates(self, topo: SliceTopology,
-                    avoid: Optional[set] = None
+                    avoid: Optional[set] = None,
+                    flexible: bool = False
                     ) -> Iterable[tuple[tuple, SliceRect]]:
         """Every feasible rect for ONE slice, with its score key (lower =
         better). Score: maximize the pool's largest free rectangle AFTER
@@ -332,7 +346,7 @@ class SliceInventory:
         then deterministic position order."""
         for pname in sorted(self.pools):
             pool = self.pools[pname]
-            for h, w in self._orientations(topo):
+            for h, w in self._orientations(topo, flexible=flexible):
                 for x in range(pool.rows - h + 1):
                     for y in range(pool.cols - w + 1):
                         if not pool.fits(x, y, h, w):
@@ -347,16 +361,21 @@ class SliceInventory:
                         yield key, rect
 
     def place_gang(self, topology: SliceTopology, num_slices: int,
-                   avoid: Optional[set] = None) -> Optional[Placement]:
+                   avoid: Optional[set] = None,
+                   flexible: bool = False) -> Optional[Placement]:
         """Greedy per-slice best-placement for a whole gang, or None when
         any slice cannot be cut. ``avoid`` is a set of (pool, x, y) cells
         placements must not touch (the head-of-line reservation —
-        scheduler/core.py). The inventory is left UNCHANGED; callers
-        bind() the returned placement explicitly."""
+        scheduler/core.py). ``flexible`` admits any rectangle of the
+        topology's chip count, not just its canonical mesh (elastic
+        resize placement — scheduler/core.py resize paths). The
+        inventory is left UNCHANGED; callers bind() the returned
+        placement explicitly."""
         rects: list[SliceRect] = []
         try:
             for _ in range(num_slices):
-                best = min(self._candidates(topology, avoid),
+                best = min(self._candidates(topology, avoid,
+                                            flexible=flexible),
                            key=lambda kr: kr[0], default=None)
                 if best is None:
                     return None

@@ -20,9 +20,9 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..api import k8s
-from ..api.topology import SliceTopology
+from ..api.topology import SliceTopology, parse_topology
 from ..api.trainingjob import (BINDING_ANNOTATION, DEFAULT_QUEUE,
-                               TrainingJob)
+                               RESIZE_HISTORY_ANNOTATION, TrainingJob)
 from .health import HealthConfig
 from .inventory import Placement
 
@@ -62,6 +62,22 @@ class SchedulerConfig:
     # quarantine/release thresholds, and the enabled master switch for
     # the whole feedback loop (scoring, quarantine, suspect evacuation)
     health: HealthConfig = field(default_factory=HealthConfig)
+    # elastic gang resizing (jobs carrying schedulingPolicy minChips/
+    # maxChips): the master switch for every resize plan — shrink a
+    # lower-priority gang to admit a blocked head, shrink a gang whose
+    # host died when no same-size rectangle exists, grow into idle
+    # chips, migrate to defragment. Off = elastic bounds are ignored
+    # and every gang keeps the fixed-shape contract.
+    elastic: bool = True
+    # grow-to-fill: bound elastic gangs may expand into idle chips once
+    # the queue has drained (each grow is a checkpointed gang restart)
+    grow: bool = True
+    # defragmentation: migrate a bound elastic gang when re-placing it
+    # strictly enlarges the cluster's largest contiguous free rectangle
+    defrag: bool = True
+    # a gang is not grown/migrated again until this long after its last
+    # resize (restart-storm hysteresis; shrinks are urgent and exempt)
+    grow_cooldown_s: float = 300.0
 
     def queue(self, name: str) -> QueueSpec:
         return self.queues.get(name) or QueueSpec(name)
@@ -78,7 +94,12 @@ class SchedulerConfig:
                    backfill=bool(d.get("backfill", True)),
                    preemption=bool(d.get("preemption", True)),
                    priority_order=bool(d.get("priorityOrder", True)),
-                   health=HealthConfig.from_dict(d.get("health")))
+                   health=HealthConfig.from_dict(d.get("health")),
+                   elastic=bool(d.get("elastic", True)),
+                   grow=bool(d.get("grow", True)),
+                   defrag=bool(d.get("defrag", True)),
+                   grow_cooldown_s=float(
+                       d.get("growCooldownSeconds", 300.0)))
 
 
 @dataclass
@@ -96,6 +117,15 @@ class JobRequest:
     topology: SliceTopology
     num_slices: int
     seq: object
+    # elastic bounds (schedulingPolicy.minChips/maxChips): total-chip
+    # envelope the scheduler may resize this gang within; None = that
+    # bound pins to the nominal shape (both None = fixed-shape job)
+    min_chips: Optional[int] = None
+    max_chips: Optional[int] = None
+    # grow/defrag hysteresis: False while the job's last resize is
+    # younger than the config cooldown (the k8s loop computes this from
+    # the resize-history annotation; the sim leaves it True)
+    grow_ok: bool = True
 
     @property
     def key(self) -> str:
@@ -103,7 +133,55 @@ class JobRequest:
 
     @property
     def chips(self) -> int:
+        """NOMINAL gang size (the spec shape) — quota and ordering use
+        this; a resized gang's ACTUAL size lives on its Placement."""
         return self.topology.num_chips * self.num_slices
+
+    @property
+    def elastic(self) -> bool:
+        return self.min_chips is not None or self.max_chips is not None
+
+
+def elastic_topologies(req: JobRequest) -> list[SliceTopology]:
+    """Every slice topology an elastic gang may run at: its generation's
+    supported slice sizes whose TOTAL (x num_slices) falls inside the
+    [minChips, maxChips] envelope, LARGEST first. Empty for fixed-shape
+    jobs. The nominal shape is always a member (admission pins the
+    envelope around it), so walking this list from the top is "try the
+    biggest allowed, degrade one supported size at a time"."""
+    if not req.elastic:
+        return []
+    gen = req.topology.generation
+    nominal = req.topology.num_chips * req.num_slices
+    lo = req.min_chips if req.min_chips is not None else nominal
+    hi = req.max_chips if req.max_chips is not None else nominal
+    out = []
+    for c in sorted(gen.supported_chip_counts, reverse=True):
+        if lo <= c * req.num_slices <= hi:
+            out.append(parse_topology(f"{gen.name}-{c}"))
+    return out
+
+
+def placement_slice_chips(placement: Placement) -> int:
+    """Per-slice chip count of a (possibly resized) placement."""
+    return placement.slices[0].chips if placement.slices \
+        else placement.chips
+
+
+def resize_history(manifest: dict) -> list[dict]:
+    """Parse the resize-history annotation; [] when absent/malformed (a
+    corrupt history only costs the audit trail + grow hysteresis, never
+    a pass)."""
+    import json
+    raw = k8s.annotations_of(manifest).get(RESIZE_HISTORY_ANNOTATION)
+    if not raw:
+        return []
+    try:
+        hist = json.loads(raw)
+    except ValueError:
+        return []
+    return [h for h in hist if isinstance(h, dict)] \
+        if isinstance(hist, list) else []
 
 
 _UID_NUM = re.compile(r"(\d+)$")
@@ -137,7 +215,8 @@ def request_of(job: TrainingJob, manifest: dict) -> Optional[JobRequest]:
         queue=policy.queue or DEFAULT_QUEUE,
         priority=policy.priority, preemptible=policy.preemptible,
         topology=tpu.topology, num_slices=tpu.num_slices,
-        seq=submission_seq(manifest))
+        seq=submission_seq(manifest),
+        min_chips=policy.min_chips, max_chips=policy.max_chips)
 
 
 def binding_of(manifest: dict) -> Optional[Placement]:
@@ -159,14 +238,35 @@ def binding_of(manifest: dict) -> Optional[Placement]:
 
 
 def binding_matches(placement: Placement, job: TrainingJob) -> bool:
-    """Whether a persisted binding still describes the job's CURRENT
-    gang shape — a spec resized/reshaped under its binding reads as
-    unbound on both sides (the operator must not create a gang on a
-    stale placement; the scheduler re-plans it)."""
+    """Whether a persisted binding still describes a shape this job may
+    RUN at. Fixed-shape jobs: exactly the spec shape — a spec reshaped
+    under its binding reads as unbound on both sides (the operator must
+    not create a gang on a stale placement; the scheduler re-plans it).
+    ELASTIC jobs (schedulingPolicy minChips/maxChips) additionally
+    accept a scheduler-resized shape: same generation, same slice
+    count, total chips inside the envelope — that binding is the
+    resize plan the operator executes, not drift."""
     tpu = job.tpu_spec
-    return (tpu is not None and tpu.topology is not None
-            and placement.topology == tpu.topology.name
-            and placement.num_slices == tpu.num_slices)
+    if tpu is None or tpu.topology is None:
+        return False
+    if placement.topology == tpu.topology.name \
+            and placement.num_slices == tpu.num_slices:
+        return True
+    policy = job.scheduling_policy
+    if policy is None or not policy.elastic \
+            or placement.num_slices != tpu.num_slices:
+        return False
+    try:
+        topo = parse_topology(placement.topology)
+    except ValueError:
+        return False
+    if topo.generation.name != tpu.topology.generation.name:
+        return False
+    total = topo.num_chips * placement.num_slices
+    if placement.slices and placement.chips != total:
+        return False   # rects disagree with the claimed topology
+    lo, hi = policy.chip_bounds(tpu.topology.num_chips * tpu.num_slices)
+    return lo <= total <= hi
 
 
 def ordered(requests: list[JobRequest],

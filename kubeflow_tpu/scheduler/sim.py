@@ -28,8 +28,10 @@ from .inventory import PoolState, SliceInventory
 from .queue import JobRequest, SchedulerConfig
 from .core import plan
 
-# the three bench arms, in dominance order
-POLICIES = ("fifo", "backfill", "preempt")
+# the bench arms, in dominance order; "elastic" = preempt + elastic gang
+# resizing (shrink-to-admit / shrink-to-survive / grow-to-fill / defrag)
+# for the jobs that carry minChips/maxChips bounds
+POLICIES = ("fifo", "backfill", "preempt", "elastic")
 
 
 @dataclass
@@ -58,13 +60,16 @@ def policy_config(policy: str,
                   quotas: Optional[dict] = None) -> SchedulerConfig:
     """The A/B arms: fifo = submission order only; backfill = priority
     order + head-reservation backfill; preempt = backfill + reclaiming
-    preemptible lower-priority gangs."""
+    preemptible lower-priority gangs; elastic = preempt + resize plans
+    for min/max-bounded gangs (config.elastic is OFF in every other arm
+    so the same bounded workload measures the policy, not the jobs)."""
     if policy not in POLICIES:
         raise ValueError(f"unknown policy {policy!r}; one of {POLICIES}")
     cfg = SchedulerConfig.from_dict({"queues": quotas or {}})
     cfg.priority_order = policy != "fifo"
     cfg.backfill = policy != "fifo"
-    cfg.preemption = policy == "preempt"
+    cfg.preemption = policy in ("preempt", "elastic")
+    cfg.elastic = policy == "elastic"
     return cfg
 
 
@@ -80,15 +85,22 @@ class SimJob:
     queue: str = "default"
     namespace: str = "default"
     arrival: int = 0            # tick the job is submitted
-    work: int = 10              # device ticks to completion
+    work: int = 10              # device ticks to completion (at NOMINAL
+    #                             size — a shrunk gang progresses
+    #                             proportionally slower, a grown one
+    #                             faster: pure data parallelism)
+    # elastic bounds (schedulingPolicy.minChips/maxChips); None = fixed
+    min_chips: Optional[int] = None
+    max_chips: Optional[int] = None
     # -- runtime state (the sim's, not the user's) --
-    done: int = field(default=0, repr=False)
-    high_water: int = field(default=0, repr=False)
-    checkpointed: int = field(default=0, repr=False)
+    done: float = field(default=0.0, repr=False)
+    high_water: float = field(default=0.0, repr=False)
+    checkpointed: float = field(default=0.0, repr=False)
     first_bound: Optional[int] = field(default=None, repr=False)
     finished: Optional[int] = field(default=None, repr=False)
     preemptions: int = field(default=0, repr=False)
-    recomputed: int = field(default=0, repr=False)
+    recomputed: float = field(default=0.0, repr=False)
+    resizes: int = field(default=0, repr=False)
 
     def request(self, seq: int, fifo: bool) -> JobRequest:
         return JobRequest(
@@ -96,30 +108,43 @@ class SimJob:
             priority=0 if fifo else self.priority,
             preemptible=self.preemptible,
             topology=parse_topology(self.topology),
-            num_slices=self.num_slices, seq=seq)
+            num_slices=self.num_slices, seq=seq,
+            min_chips=self.min_chips, max_chips=self.max_chips)
 
 
 def make_workload(seed: int, n_jobs: int = 24,
                   sizes: tuple = ("v5e-4", "v5e-8", "v5e-16", "v5e-32"),
                   max_priority: int = 2, preemptible_frac: float = 0.6,
                   mean_interarrival: int = 2,
-                  work_range: tuple = (6, 30)) -> list[SimJob]:
+                  work_range: tuple = (6, 30),
+                  elastic_frac: float = 0.0) -> list[SimJob]:
     """Seeded mixed workload: small jobs outnumber big ones ~2:1 per
     size step (the long-tail shape a shared research cluster sees), up
     to FULL-POOL gangs — the jobs whose head-of-line blocking is what a
     FIFO queue dies on. Priorities uniform; small jobs skew preemptible
     (big jobs are the expensive-to-lose ones); arrivals a seeded
-    renewal process."""
+    renewal process. ``elastic_frac`` of the jobs carry minChips/
+    maxChips bounds (quarter-size floor, double-size ceiling) — inert
+    under every policy except "elastic" (policy_config flips
+    config.elastic, not the workload, so the A/B is paired)."""
     rng = random.Random(seed)
+    # elastic membership draws from its OWN stream: the legacy arms'
+    # workloads (priorities, arrivals, work) must stay bit-identical to
+    # the pre-elastic bench so their numbers remain comparable
+    elastic_rng = random.Random(seed ^ 0xE1A5)
     jobs, t = [], 0
     weights = [2 ** (len(sizes) - 1 - i) for i in range(len(sizes))]
     for i in range(n_jobs):
         topo = rng.choices(sizes, weights=weights)[0]
         big = topo == sizes[-1]
+        chips = parse_topology(topo).num_chips
+        elastic = elastic_rng.random() < elastic_frac
         jobs.append(SimJob(
             name=f"job-{i:03d}", topology=topo,
             priority=rng.randint(0, max_priority),
             preemptible=not big and rng.random() < preemptible_frac,
+            min_chips=max(1, chips // 4) if elastic else None,
+            max_chips=min(2 * chips, 256) if elastic else None,
             arrival=t, work=rng.randint(*work_range)))
         t += rng.randint(0, 2 * mean_interarrival)
     return jobs
@@ -221,6 +246,15 @@ def simulate(jobs: list[SimJob], pools: tuple = ("v5e-32",),
         requests = [job.request(seq, fifo) for seq, job in queued]
         decisions = plan(requests, list(bound.values()), inventory, cfg)
 
+        for req, new_placement, _reason in decisions.resizes:
+            job = by_key[req.key]
+            # resize-at-boundary contract: the graceful teardown forces
+            # a checkpoint before exit 75, so a shrink/grow/migration
+            # reshapes the gang WITHOUT recompute — the structural
+            # difference vs preemption the elastic arm is measuring
+            job.checkpointed = job.done
+            job.resizes += 1
+            bound[req.key] = (bound[req.key][0], new_placement)
         for victim in decisions.preempts:
             job = by_key[victim.key]
             # checkpoint contract: lose only work since the last save
@@ -238,23 +272,34 @@ def simulate(jobs: list[SimJob], pools: tuple = ("v5e-32",),
             job = by_key[req.key]
             if job.first_bound is None:
                 job.first_bound = t
+            if placement.chips != req.chips:
+                job.resizes += 1   # shrink-to-survive: a degraded bind
             bound[req.key] = (req, placement)
             queued = [(s, j) for s, j in queued if j is not job]
 
         # device time advances: every bound gang makes one tick of
-        # progress, checkpointing on the checkpoint_every cadence.
+        # progress — scaled by its CURRENT size over nominal (pure data
+        # parallelism at fixed global batch: throughput ∝ chips, so a
+        # half-size degraded gang banks half a work unit per tick) —
+        # checkpointing on the checkpoint_every cadence of ticks RUN.
         # Utilization counts USEFUL work only: a tick re-running steps a
         # preemption threw away is not utilization — the win must not be
         # subsidized by its own waste (recomputed_ticks reports it).
         finished_keys = []
-        for key, (req, _p) in bound.items():
+        for key, (req, placement) in bound.items():
             job = by_key[key]
             if job.done >= job.high_water:
-                busy_chip_ticks += req.chips
-            job.done += 1
+                busy_chip_ticks += placement.chips
+            prev = job.done
+            job.done += placement.chips / req.chips
             job.high_water = max(job.high_water, job.done)
-            if job.done % checkpoint_every == 0:
-                job.checkpointed = job.done
+            # save on crossing each checkpoint_every-step PROGRESS
+            # boundary (the worker's step % N == 0 contract; for
+            # speed-1 fixed gangs this is exactly the integral cadence)
+            if int(job.done) // checkpoint_every > \
+                    int(prev) // checkpoint_every:
+                job.checkpointed = float(
+                    int(job.done) // checkpoint_every * checkpoint_every)
             if job.done >= job.work:
                 job.finished = t + 1
                 finished_keys.append(key)
@@ -293,7 +338,8 @@ def simulate(jobs: list[SimJob], pools: tuple = ("v5e-32",),
         "queue_wait_mean": round(sum(waits) / len(waits), 2)
         if waits else 0.0,
         "preemptions": sum(j.preemptions for j in jobs),
-        "recomputed_ticks": sum(j.recomputed for j in jobs),
+        "recomputed_ticks": round(sum(j.recomputed for j in jobs), 2),
+        "resizes": sum(j.resizes for j in jobs),
         "host_faults": host_faults,
         "useful_work_fraction": round(
             sum(j.done for j in jobs)
@@ -305,18 +351,24 @@ def simulate(jobs: list[SimJob], pools: tuple = ("v5e-32",),
 def compare_policies(seeds: list, n_jobs: int = 24,
                      pools: tuple = ("v5e-32", "v5e-16"),
                      checkpoint_every: int = 4,
-                     quotas: Optional[dict] = None) -> dict:
+                     quotas: Optional[dict] = None,
+                     elastic_frac: float = 1.0) -> dict:
     """The bench table: each policy over the same seeded workloads,
     metrics averaged across seeds (same jobs per seed for every arm —
-    paired comparison, seed noise cancels inside the ratio)."""
+    paired comparison, seed noise cancels inside the ratio).
+    ``elastic_frac`` of each workload's jobs carry minChips/maxChips;
+    only the "elastic" arm's config acts on them, so the bounded
+    workload is identical across arms."""
     rows: dict = {p: [] for p in POLICIES}
     for seed in seeds:
-        jobs = make_workload(seed, n_jobs=n_jobs)
+        jobs = make_workload(seed, n_jobs=n_jobs,
+                             elastic_frac=elastic_frac)
         for policy in POLICIES:
             # fresh copies: simulate mutates job state
             fresh = [SimJob(**{k: getattr(j, k) for k in (
                 "name", "topology", "priority", "preemptible",
-                "num_slices", "queue", "namespace", "arrival", "work")})
+                "num_slices", "queue", "namespace", "arrival", "work",
+                "min_chips", "max_chips")})
                 for j in jobs]
             rows[policy].append(simulate(
                 fresh, pools=pools, policy=policy,
@@ -327,7 +379,7 @@ def compare_policies(seeds: list, n_jobs: int = 24,
         for metric in ("makespan_ticks", "chip_utilization",
                        "queue_wait_p50", "queue_wait_p90",
                        "queue_wait_mean", "preemptions",
-                       "recomputed_ticks"):
+                       "recomputed_ticks", "resizes"):
             agg[metric] = round(
                 sum(r[metric] for r in runs) / len(runs), 4)
         agg["unfinished"] = sum(len(r["unfinished"]) for r in runs)

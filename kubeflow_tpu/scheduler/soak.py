@@ -229,6 +229,276 @@ class PreemptionSoak:
 
 
 @dataclass
+class ElasticSoak:
+    """Shrink-to-survive → grow-to-fill, end to end on the real loop.
+
+    One ELASTIC TPUJob (``schedulingPolicy.minChips=4, maxChips=8``,
+    ``weightUpdate=sharded`` so the optimizer state is genuinely
+    distributed over the replica axes) trains on a single two-host
+    v5e-8 pool. Mid-run a host VANISHES (cluster/chaos.py CapacityLoss
+    deletes the node object): no same-size rectangle exists anywhere,
+    so the pre-elastic scheduler could only strand the job in Queued —
+    here the replan binds it DEGRADED at v5e-4 on the surviving host,
+    the operator restarts the gang at the smaller shape with
+    ``resumeFrom``, and the worker's restore reshapes the sharded
+    optimizer state from replica degree 8 to 4 (runtime/checkpoint.py).
+    Later the host returns; the grow-to-fill pass resizes the binding
+    back to v5e-8 and the job finishes at full width.
+
+    Acceptance is numeric: the job ends Succeeded; the final checkpoint
+    restores IDENTICALLY (≤1e-5, in practice 0.0) into replica-degree-8
+    and replica-degree-4 templates — the cross-degree round trip is
+    lossless; and final params track an undisturbed same-seed run to a
+    reported tolerance (cross-degree float drift is reduction-order
+    only, ~1e-4 — reported, not hidden)."""
+
+    workdir: str
+    total_steps: int = 8
+    checkpoint_every: int = 2
+    lose_at: int = 3             # host vanishes after this many steps
+    restore_at: int = 5          # ...and returns once the job reaches this
+    # False = shrink-to-survive only: the host never comes back and the
+    # job must still finish Succeeded at the degraded width (the
+    # ``bench.py --mode chaos`` capacity-loss scenario; the full
+    # shrink→grow arc runs under --mode sched)
+    grow_phase: bool = True
+    seed: int = 0
+    global_batch: int = 8
+    wall_budget_s: float = 300.0
+    namespace: str = "kubeflow"
+    job_name: str = "elastic-soak"
+
+    POOL = "pool-a"
+
+    def _manifest(self, ckpt_dir: str) -> dict:
+        return {
+            "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": self.job_name,
+                         "namespace": self.namespace},
+            "spec": {
+                "checkpointDir": ckpt_dir,
+                "weightUpdate": "sharded",
+                "schedulingPolicy": {"queue": "research", "priority": 0,
+                                     "minChips": 4, "maxChips": 8},
+                "replicaSpecs": {"TPU": {
+                    "tpuTopology": POOL_TOPOLOGY,
+                    "template": {"spec": {"containers": [
+                        {"name": "jax", "image": "trainer:v1"}]}}}},
+                "runPolicy": {
+                    "backoffLimit": 6,
+                    "restartBackoffSeconds": 0.05,
+                    "restartBackoffMaxSeconds": 0.2,
+                },
+            },
+        }
+
+    _chief_env = PreemptionSoak._chief_env
+    _latest_step = staticmethod(PreemptionSoak._latest_step)
+
+    def _ctx(self, devices: int):
+        """A WorkerContext over the first ``devices`` CPU devices — the
+        in-process stand-in for the resized gang's smaller mesh."""
+        import jax
+
+        from ..api.trainingjob import ShardingSpec
+        from ..parallel.mesh import build_mesh
+        from ..runtime.bootstrap import WorkerContext
+        mesh = build_mesh(ShardingSpec(),
+                          list(jax.devices())[:devices])
+        return WorkerContext(contract=None, sharding=ShardingSpec(),
+                             mesh=mesh, process_id=0, num_processes=1)
+
+    def _run_segment(self, env_map: dict, target: int):
+        """One real training segment at the CURRENTLY BOUND size: the
+        chief env's topology contract names the resized shape, and the
+        segment's mesh uses exactly that many devices — so restores
+        genuinely cross replica degrees."""
+        import jax
+
+        from ..api.topology import TopologyContract, parse_topology
+        from ..runtime.worker import train  # lazy: pulls in jax
+        topo_name = env_map.get(TopologyContract.ENV_TOPOLOGY,
+                                POOL_TOPOLOGY)
+        chips = min(parse_topology(topo_name).num_chips,
+                    len(jax.devices()))
+        return train(
+            workload="transformer", steps=target,
+            global_batch=self.global_batch, sync_every=1,
+            checkpoint_dir=env_map.get("KFTPU_CHECKPOINT_DIR"),
+            checkpoint_every=self.checkpoint_every,
+            resume_from=env_map.get("KFTPU_RESUME_FROM"),
+            weight_update=env_map.get("KFTPU_WEIGHT_UPDATE"),
+            seed=self.seed, handle_sigterm=False,
+            ctx=self._ctx(chips), workload_kwargs={})
+
+    def _state_template(self, degree: int):
+        """An abstract TrainState template at the given replica degree,
+        built exactly the way train() builds its state (same workload,
+        optimizer, weight-update mode) — the restore target the
+        cross-degree round-trip check reshapes into."""
+        import jax
+
+        from ..runtime.recipe import make_optimizer
+        from ..runtime.trainstep import TrainStepBuilder
+        from ..runtime.worker import WORKLOADS
+        ctx = self._ctx(degree)
+        spec = WORKLOADS["transformer"]()
+        opt, _ = make_optimizer("momentum", 0.1, schedule="constant",
+                                total_steps=self.total_steps)
+        builder = TrainStepBuilder(mesh=ctx.mesh, loss_fn=spec.loss_fn,
+                                   optimizer=opt, rules=spec.rules,
+                                   param_logical_axes=spec.param_logical_axes,
+                                   weight_update="sharded")
+        return builder.init(spec.init_fn, jax.random.PRNGKey(self.seed))
+
+    def roundtrip_delta(self, ckpt_dir: str,
+                        degrees: tuple = (8, 4)) -> float:
+        """Restore the newest checkpoint into templates at BOTH replica
+        degrees and compare every leaf (params, sharded optimizer
+        moments, rng, step): the cross-degree reshape must be lossless.
+        Returns the max abs delta across all leaves."""
+        import jax
+        import numpy as np
+
+        from ..runtime.checkpoint import CheckpointManager
+        states = []
+        for d in degrees:
+            mgr = CheckpointManager(ckpt_dir)
+            try:
+                states.append(mgr.restore(self._state_template(d)))
+            finally:
+                mgr.close()
+        deltas = jax.tree.map(
+            lambda a, b: float(np.max(np.abs(
+                np.asarray(a, dtype=np.float64)
+                - np.asarray(b, dtype=np.float64)))) if hasattr(
+                    a, "dtype") else 0.0,
+            states[0], states[1])
+        return max(jax.tree.leaves(deltas), default=0.0)
+
+    def _gang_running(self, cluster, want: int) -> bool:
+        pods = cluster.list("v1", "Pod", self.namespace,
+                            selector={"kubeflow.org/job-name":
+                                      self.job_name})
+        running = [p for p in pods
+                   if p.get("status", {}).get("phase") == "Running"]
+        return len(running) == want
+
+    def run(self) -> dict:
+        from ..api.trainingjob import RESIZE_HISTORY_ANNOTATION
+        from ..cluster.chaos import CapacityLoss
+        from ..cluster.fake import FakeCluster
+        from ..controllers.runtime import Manager
+        from ..controllers.tpujob import TrainingJobReconciler
+        from .core import SliceScheduler
+        from .queue import SchedulerConfig, binding_of
+
+        ckpt_dir = os.path.join(self.workdir, "job")
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes(POOL_TOPOLOGY, pool=self.POOL)
+        lost_node = f"{self.POOL}-{POOL_TOPOLOGY}-1"
+        fault = CapacityLoss(node=lost_node)
+        mgr = Manager(cluster)
+        # no grow cooldown: the soak compresses hours into seconds
+        mgr.add(SliceScheduler(SchedulerConfig(grow_cooldown_s=0.0)))
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        cluster.create(self._manifest(ckpt_dir))
+
+        chief = f"{self.job_name}-worker-0-0"
+        report: dict = {"outcome": "timeout", "events": [],
+                        "chips_seen": [], "checkpoint_dir": ckpt_dir}
+        deadline = time.monotonic() + self.wall_budget_s
+
+        def pump(ticks: int = 3) -> None:
+            for _ in range(ticks):
+                mgr.run_pending()
+                cluster.tick()
+            mgr.run_pending()
+
+        def job() -> dict:
+            return cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                               self.namespace, self.job_name)
+
+        def note_chips() -> int:
+            placement = binding_of(job())
+            chips = placement.chips if placement else 0
+            if chips and (not report["chips_seen"]
+                          or report["chips_seen"][-1] != chips):
+                report["chips_seen"].append(chips)
+            return chips
+
+        def wait_for(pods: int, chips: int, tag: str) -> bool:
+            while time.monotonic() < deadline:
+                pump()
+                if note_chips() == chips and \
+                        self._gang_running(cluster, pods):
+                    return True
+                time.sleep(0.02)
+            report["outcome"] = f"timeout: {tag}"
+            return False
+
+        # phase 1: bind + train at nominal width until the host dies
+        if not wait_for(2, 8, "never bound at nominal"):
+            return self._finish(report, mgr)
+        report["events"].append("bound at 8 chips (2 hosts)")
+        self._run_segment(self._chief_env(cluster, chief), self.lose_at)
+        report["events"].append(f"trained to step {self.lose_at} @8")
+
+        # phase 2: the host vanishes -> shrink-to-survive at v5e-4
+        fault.fire(cluster)
+        if not wait_for(1, 4, "never shrank after capacity loss"):
+            return self._finish(report, mgr)
+        report["events"].append("host lost; re-bound DEGRADED at 4 chips")
+        report["shrink_resume_step"] = self._latest_step(ckpt_dir)
+        # cross-degree round trip at the shrink point: the state saved
+        # at degree 8 must restore losslessly into the degree-4 layout
+        report["roundtrip_delta_at_shrink"] = self.roundtrip_delta(
+            ckpt_dir, degrees=(8, 4))
+        if self.grow_phase:
+            self._run_segment(self._chief_env(cluster, chief),
+                              self.restore_at)
+            report["events"].append(
+                f"trained degraded to step {self.restore_at} @4")
+
+            # phase 3: capacity returns -> grow-to-fill back to v5e-8
+            fault.restore(cluster)
+            if not wait_for(2, 8, "never grew after capacity returned"):
+                return self._finish(report, mgr)
+            report["events"].append("capacity back; grown to 8 chips")
+            report["grow_resume_step"] = self._latest_step(ckpt_dir)
+        self._run_segment(self._chief_env(cluster, chief),
+                          self.total_steps)
+        cluster.set_pod_phase(self.namespace, chief, "Succeeded")
+        while time.monotonic() < deadline:
+            pump()
+            if k8s.condition_true(job(), "Succeeded"):
+                report["outcome"] = "succeeded"
+                break
+        report["resize_history"] = k8s.annotations_of(job()).get(
+            RESIZE_HISTORY_ANNOTATION, "")
+        report["roundtrip_delta_final"] = self.roundtrip_delta(
+            ckpt_dir, degrees=(8, 4))
+        return self._finish(report, mgr)
+
+    def clean_params(self):
+        """The parity reference: same seed/steps/batch, full width the
+        whole way (no capacity loss). Final params differ from the
+        shrink→grow run only by cross-degree reduction order (~1e-4) —
+        the report carries the measured delta."""
+        env_map = {"KFTPU_CHECKPOINT_DIR":
+                   os.path.join(self.workdir, "clean"),
+                   "KFTPU_WEIGHT_UPDATE": "sharded"}
+        self._run_segment(env_map, self.total_steps)
+        from ..cluster.chaos import final_params
+        return final_params(env_map["KFTPU_CHECKPOINT_DIR"])
+
+    def _finish(self, report: dict, mgr) -> dict:
+        for c in mgr.controllers:
+            c.stop()
+        return report
+
+
+@dataclass
 class HealthSoak:
     """Flaky-host migration drill: quarantine on vs off, end to end.
 

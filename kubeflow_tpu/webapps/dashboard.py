@@ -436,13 +436,14 @@ def build_dashboard_app(client: KubeClient,
         is (not) running, fed by the scheduler's state/reason
         annotations (scheduler/core.py) without touching the scheduler
         process itself."""
-        from ..api.trainingjob import (BINDING_ANNOTATION, DEFAULT_QUEUE,
+        from ..api.trainingjob import (DEFAULT_QUEUE,
                                        PREEMPTED_COUNT_ANNOTATION,
                                        SCHED_REASON_ANNOTATION,
                                        SCHED_STATE_ANNOTATION,
                                        TPU_API_VERSION, TrainingJob)
         from ..cluster.client import KubeError
         from ..scheduler import health as sched_health
+        from ..scheduler.queue import binding_of, resize_history
         try:
             manifests = client.list(TPU_API_VERSION, "TPUJob")
         except KubeError:
@@ -467,24 +468,40 @@ def build_dashboard_app(client: KubeClient,
             if policy is None or tpu is None or tpu.topology is None:
                 continue
             anns = k8s.annotations_of(m)
-            bound = bool(anns.get(BINDING_ANNOTATION))
+            placement = binding_of(m)
+            bound = placement is not None
             chips = tpu.topology.num_chips * tpu.num_slices
+            # ACTUAL bound width vs the spec's nominal: an elastic gang
+            # the scheduler shrank/grew runs at its binding's size
+            current = placement.chips if placement else 0
             q = queues.setdefault(policy.queue or DEFAULT_QUEUE, {
                 "queue": policy.queue or DEFAULT_QUEUE,
                 "queued": 0, "bound": 0, "chipsBound": 0,
-                "chipsQueued": 0, "preemptions": 0,
+                "chipsQueued": 0, "preemptions": 0, "resizes": 0,
                 "quarantinedHosts": quarantined_hosts, "jobs": []})
             finished = _job_phase(m) in ("Succeeded", "Failed")
+            resizes = resize_history(m)
             if not finished:
                 q["bound" if bound else "queued"] += 1
-                q["chipsBound" if bound else "chipsQueued"] += chips
+                if bound:
+                    q["chipsBound"] += current
+                else:
+                    q["chipsQueued"] += chips
             q["preemptions"] += int(anns.get(
                 PREEMPTED_COUNT_ANNOTATION, "0"))
+            q["resizes"] += len(resizes)
             q["jobs"].append({
                 "name": job.name, "namespace": job.namespace,
                 "priority": policy.priority,
                 "preemptible": policy.preemptible,
                 "chips": chips, "phase": _job_phase(m),
+                # elastic-resize surface: the gang's live width, its
+                # allowed envelope, and the audit trail of applied
+                # resizes (scheduling.kubeflow.org/resize-history)
+                "currentChips": current,
+                "minChips": policy.min_chips,
+                "maxChips": policy.max_chips,
+                "resizeHistory": resizes,
                 "state": anns.get(SCHED_STATE_ANNOTATION,
                                   "bound" if bound else "queued"),
                 "reason": anns.get(SCHED_REASON_ANNOTATION, ""),

@@ -249,24 +249,27 @@ def test_scheduling_policy_is_plumbed_end_to_end():
             return f.read()
 
     fields = {f.name for f in dataclasses.fields(SchedulingPolicy)}
-    assert fields == {"queue", "priority", "preemptible"}, \
+    assert fields == {"queue", "priority", "preemptible",
+                      "min_chips", "max_chips"}, \
         "SchedulingPolicy field added/removed — extend this check"
     controller_src = src("controllers", "tpujob.py")
     manifests_src = src("manifests", "training.py")
     queue_src = src("scheduler", "queue.py")
     # controller: env render + the binding gate both live in the
     # operator, and the gate parses the annotation through the
-    # scheduler's OWN binding_of/binding_matches (one wire contract)
+    # scheduler's OWN binding_of/binding_matches (one wire contract);
+    # an elastic binding's shape is ADOPTED (the resize execution path)
     assert "scheduling_policy.to_env" in controller_src
     assert "binding_of" in controller_src
     assert "binding_matches" in controller_src
+    assert "_job_at_binding_shape" in controller_src
     # scheduler: every field feeds the queue model
     for name in fields:
         assert name in queue_src, \
             f"SchedulingPolicy.{name} is never consumed by the scheduler"
     # manifests: the CRD schema names every spec field
     for spec_field in ("queue", "priority", "preemptible",
-                       "schedulingPolicy"):
+                       "minChips", "maxChips", "schedulingPolicy"):
         assert f'"{spec_field}"' in manifests_src, spec_field
 
     # spec wire round-trip: to_dict → from_manifest → identical policy;
@@ -308,6 +311,37 @@ def test_scheduling_policy_is_plumbed_end_to_end():
     assert TrainingJob.from_manifest(ex).scheduling_policy == policy
     # the binding annotation name is the one contract both sides share
     assert BINDING_ANNOTATION == "scheduling.kubeflow.org/binding"
+
+    # elastic bounds: spec → env → example round trip, plus the
+    # admission guards (nominal inside the envelope; data-parallel
+    # wildcard so the mesh can follow a resized chip count)
+    elastic = SchedulingPolicy(queue="research", priority=7,
+                               preemptible=True, min_chips=4,
+                               max_chips=16)
+    manifest["spec"]["schedulingPolicy"] = elastic.to_dict()
+    job = TrainingJob.from_manifest(manifest)
+    assert job.scheduling_policy == elastic
+    assert job.scheduling_policy.elastic
+    assert job.to_manifest()["spec"]["schedulingPolicy"] == \
+        elastic.to_dict()
+    env = elastic.to_env()
+    assert env["KFTPU_SCHED_MIN_CHIPS"] == "4"
+    assert env["KFTPU_SCHED_MAX_CHIPS"] == "16"
+    with pytest.raises(ValueError, match="minChips"):
+        SchedulingPolicy.from_dict({"minChips": 8, "maxChips": 4})
+    with pytest.raises(ValueError, match="envelope|outside"):
+        manifest["spec"]["schedulingPolicy"] = {"minChips": 1,
+                                                "maxChips": 4}
+        TrainingJob.from_manifest(manifest)   # nominal v5e-8 > max 4
+    with pytest.raises(ValueError, match="wildcard"):
+        manifest["spec"]["schedulingPolicy"] = {"minChips": 4}
+        manifest["spec"]["sharding"] = {"data": 8}
+        TrainingJob.from_manifest(manifest)
+    ex = next(o for o in tpu_job_simple(queue="research", priority=7,
+                                        preemptible=True, min_chips=4,
+                                        max_chips=16)
+              if o["kind"] == "TPUJob")
+    assert TrainingJob.from_manifest(ex).scheduling_policy == elastic
 
 
 def test_node_health_contract_is_shared_not_duplicated():

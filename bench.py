@@ -1535,6 +1535,174 @@ def bench_obs(t_start: float | None = None) -> dict:
     }
 
 
+def bench_warmstart_child() -> dict:
+    """One warm-start arm, run in its OWN process (the whole point is
+    process-fresh startup): train a few steps of the small transformer
+    and report startup→first-step plus the compile evidence. The parent
+    (bench_warmstart) owns the cache/AOT dirs; the arm name only flips
+    the AOT knob — warmth comes from whatever the dirs already hold."""
+    import os
+
+    arm = os.environ["KFTPU_WARMSTART_ARM"]
+    root = os.environ["KFTPU_WARMSTART_ROOT"]
+    os.environ["KFTPU_COMPILE_CACHE_DIR"] = os.path.join(root, "cache")
+    # tiny CPU models compile in under the persistence threshold; pin it
+    # so the cold arm actually populates the cache
+    os.environ.setdefault("KFTPU_COMPILE_CACHE_MIN_SECS", "0")
+    from kubeflow_tpu.runtime.compile_cache import compile_stats
+    from kubeflow_tpu.runtime.worker import train
+    steps = _env_int("KFTPU_BENCH_WARMSTART_STEPS", 3)
+    r = train(workload="transformer", steps=steps, global_batch=8,
+              sync_every=2, seed=0,
+              aot=(arm != "warm"),
+              aot_dir=os.path.join(root, "aot"))
+    s = compile_stats()
+    return {
+        "metric": "warmstart_child", "value": r.time_to_first_step_s,
+        "unit": "seconds", "vs_baseline": None, "mfu": None,
+        "extras": {
+            "arm": arm,
+            "start_kind": r.start_kind,
+            "xla_backend_compiles": s["xla_backend_compiles"],
+            "cache_hits": s["cache_hits"],
+            "loss": float(r.final_metrics.get("loss", 0.0)),
+        },
+        "_flops_per_chip": 0.0,
+    }
+
+
+def bench_warmstart(t_start: float | None = None) -> dict:
+    """Time-to-first-step cold vs cache-warm vs AOT on the SAME config
+    (ISSUE 9 acceptance): each arm is a fresh process (startup is a
+    process property) sharing one cache/AOT volume —
+
+    - **cold**: empty cache, AOT export ON (the first-bind path: full
+      XLA compile + executable export);
+    - **warm**: populated persistent cache, AOT OFF (trace + lower +
+      cache load — the pre-AOT warm restart);
+    - **aot**: serialized executable loaded (no trace, no lower, no
+      XLA — runtime/aot.py).
+
+    Asserted in extras: AOT ≤ warm ≤ cold on medians, the AOT arm
+    loaded a serialized executable (start_kind == "aot") with ZERO XLA
+    backend compiles observed (cache requests minus hits — see
+    runtime/compile_cache.compile_stats), and loss parity across arms.
+    Then the sched/elastic A/B re-runs with the MEASURED restart costs
+    (scheduler/sim.py compare_restart_costs): restarts were modeled
+    free in every previously published table, so extras.sim_restart_
+    costs is the honest version — and the warm/aot arms are what the
+    warm-start stack buys back. The parent never imports jax: children
+    own the backend, so this mode works on a single exclusive TPU too.
+
+    Env knobs (warmstart_bench_smoke shrinks the geometry):
+    KFTPU_BENCH_WARMSTART_{STEPS,REPEATS,SEEDS,JOBS,TICK_SECONDS}."""
+    import os
+    import shutil
+    import statistics
+    import subprocess
+    import tempfile
+
+    t_start = time.perf_counter() if t_start is None else t_start
+    repeats = _env_int("KFTPU_BENCH_WARMSTART_REPEATS", 3)
+    root = tempfile.mkdtemp(prefix="kftpu-warmstart-")
+
+    def run_arm(arm: str, arm_root: str) -> dict:
+        env = {**os.environ, "KFTPU_WARMSTART_ARM": arm,
+               "KFTPU_WARMSTART_ROOT": arm_root,
+               "KFTPU_BENCH_SUBBENCH": "1"}
+        res = subprocess.run(
+            [sys.executable, __file__, "--mode", "warmstart-child"],
+            env=env, capture_output=True, text=True, timeout=600)
+        for line in reversed(res.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                row = json.loads(line)
+                return {"first_step_s": row["value"], **row["extras"]}
+        raise RuntimeError(f"warmstart arm {arm!r} emitted no JSON "
+                           f"(rc={res.returncode}): {res.stderr[-2000:]}")
+
+    arms: dict = {"cold": [], "warm": [], "aot": []}
+    try:
+        main_root = os.path.join(root, "main")
+        os.makedirs(main_root)
+        # cold arms each get a FRESH volume; the first one doubles as
+        # the populator of the shared volume the warm/aot arms read
+        arms["cold"].append(run_arm("cold", main_root))
+        for i in range(1, repeats):
+            fresh = os.path.join(root, f"cold-{i}")
+            os.makedirs(fresh)
+            arms["cold"].append(run_arm("cold", fresh))
+            shutil.rmtree(fresh, ignore_errors=True)
+        # unmeasured priming run: the cold (AOT-on) arm cached the
+        # NON-donating step program (trainstep.build_compiled), so the
+        # first AOT-off restart still compiles the donating variant
+        # once — prime it out so the warm arm measures the steady-state
+        # cache-warm restart every subsequent gang restart actually pays
+        run_arm("warm", main_root)
+        for _ in range(repeats):
+            arms["warm"].append(run_arm("warm", main_root))
+        for _ in range(repeats):
+            arms["aot"].append(run_arm("aot", main_root))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    med = {a: statistics.median(r["first_step_s"] for r in rows)
+           for a, rows in arms.items()}
+    aot_rows, warm_rows = arms["aot"], arms["warm"]
+    losses = {round(r["loss"], 6) for rows in arms.values()
+              for r in rows}
+    checks = {
+        "aot_loaded_serialized_executable": all(
+            r["start_kind"] == "aot" for r in aot_rows),
+        "aot_no_xla_compile": all(
+            r["xla_backend_compiles"] == 0 for r in aot_rows),
+        "warm_no_xla_compile": all(
+            r["xla_backend_compiles"] == 0 for r in warm_rows),
+        "ordering_aot_le_warm_le_cold": bool(
+            med["aot"] <= med["warm"] <= med["cold"]),
+        "loss_parity_across_arms": len(losses) == 1,
+    }
+
+    # the sched/elastic A/B, re-run with the measured costs mapped to
+    # device ticks (one tick ~ tick_seconds of device time — the sim's
+    # abstract unit; 20s ≈ a checkpoint interval at the bench cadence)
+    tick_s = float(os.environ.get("KFTPU_BENCH_WARMSTART_TICK_SECONDS",
+                                  "20"))
+    from kubeflow_tpu.scheduler.sim import compare_restart_costs
+    seeds = list(range(_env_int("KFTPU_BENCH_WARMSTART_SEEDS", 3)))
+    n_jobs = _env_int("KFTPU_BENCH_WARMSTART_JOBS", 16)
+    costs = {"free": 0.0,
+             **{a: round(med[a] / tick_s, 4) for a in med}}
+    t0 = time.perf_counter()
+    sim = compare_restart_costs(seeds, costs, n_jobs=n_jobs)
+    sim_s = time.perf_counter() - t0
+
+    return {
+        "metric": "warmstart_time_to_first_step",
+        "value": round(med["cold"] / med["aot"], 3)
+        if med["aot"] else None,
+        "unit": "cold_over_aot_first_step",
+        "vs_baseline": None,
+        "mfu": None,
+        "extras": {
+            "first_step_s": {a: round(v, 3) for a, v in med.items()},
+            "repeats": repeats,
+            "arms": arms,
+            **checks,
+            "all_checks_ok": all(checks.values()),
+            "sim_restart_costs": {
+                "tick_seconds": tick_s,
+                "costs_ticks": costs,
+                "seeds": len(seeds),
+                "jobs_per_seed": n_jobs,
+                "table": sim,
+                "sim_wall_s": round(sim_s, 1),
+            },
+        },
+        "_flops_per_chip": 0.0,
+    }
+
+
 def _run_sub_bench(mode: str, budget_s: float) -> dict:
     """Run ``bench.py --mode <mode>`` as a subprocess with a hard
     wall-clock budget and return its JSON row. The child inherits the
@@ -1563,12 +1731,23 @@ def main(argv=None) -> int:
                    choices=["all", "resnet", "resnet-fused", "lm",
                             "lm-long", "serving", "fused-blocks",
                             "weight-update", "chaos", "input", "sched",
-                            "health", "obs"])
+                            "health", "obs", "warmstart",
+                            "warmstart-child"])
     p.add_argument("--routing-out",
                    default="bench-matrix/fused_routing_measured.json",
                    help="where --mode fused-blocks writes the measured "
                         "routing table (TPU runs only)")
     args = p.parse_args(argv)
+
+    if args.mode == "warmstart":
+        # the PARENT must never touch jax: each arm child owns the
+        # backend (a parent-held TPU would starve every child), and the
+        # sim side is jax-free — so this dispatch precedes the probe
+        row = bench_warmstart(t_start=t_start)
+        print(json.dumps(row))
+        print(f"# mode=warmstart extras={row['extras']}",
+              file=sys.stderr, flush=True)
+        return 0
 
     # the fallback child carries this marker: never probe/respawn again
     # (a second failure must end the chain, not fork a grandchild)
@@ -1619,6 +1798,8 @@ def main(argv=None) -> int:
         row = bench_health(t_start=t_start)
     elif args.mode == "obs":
         row = bench_obs(t_start=t_start)
+    elif args.mode == "warmstart-child":
+        row = bench_warmstart_child()
     else:
         row = bench_resnet(fused=False, t_start=t_start)
 

@@ -184,6 +184,76 @@ class ObsSpec:
 
 
 @dataclass
+class WarmStartSpec:
+    """Warm-start knobs (``spec.warmStart``): how this job's workers cut
+    the startup→first-step cost on every (re)start. Plumbed the full
+    operator path like InputSpec — parsed here at admission, rendered by
+    controllers/tpujob.py as the env named in each field's metadata,
+    consumed by runtime/worker.py via the CLI flag named there
+    (tests/test_lint.py enforces every layer). ``None`` = unset, worker
+    default. Defined HERE, jax-free: admission must not import the
+    runtime. The persistent compile cache is NOT a knob here — it is
+    always on when a cache volume exists (spec.compileCacheDir /
+    checkpointDir); warmStart adds the AOT executable rung above it
+    (docs/operations.md "Warm starts and the compile cache")."""
+
+    # AOT executable export/load (runtime/aot.py): the worker loads a
+    # keyed serialized step executable on rebind/resize — no trace, no
+    # lower, no XLA — and exports it at first bind; falls back to the
+    # compile cache, then a fresh compile
+    aot: Optional[bool] = field(default=None, metadata={
+        "spec_field": "aot", "env": "KFTPU_AOT", "cli": "--aot"})
+    # where the serialized executables live; defaults to
+    # <checkpointDir>/.jax-aot-executables (the volume the gang mounts)
+    aot_dir: Optional[str] = field(default=None, metadata={
+        "spec_field": "aotDir", "env": "KFTPU_AOT_DIR",
+        "cli": "--aot-dir"})
+
+    def validate(self) -> None:
+        if self.aot is not None and not isinstance(self.aot, bool):
+            raise ValueError(
+                f"warmStart.aot must be a boolean, got {self.aot!r}")
+        if self.aot_dir is not None and \
+                not isinstance(self.aot_dir, str):
+            raise ValueError(
+                f"warmStart.aotDir must be a string, got "
+                f"{self.aot_dir!r}")
+
+    def to_dict(self) -> dict:
+        return {f.metadata["spec_field"]: getattr(self, f.name)
+                for f in fields(self) if getattr(self, f.name) is not None}
+
+    def to_env(self) -> dict[str, str]:
+        """The controller-rendered worker env for every SET knob
+        (booleans render "1"/"0" — the worker's _env_int contract)."""
+        out = {}
+        for f in fields(self):
+            v = getattr(self, f.name)
+            if v is None:
+                continue
+            out[f.metadata["env"]] = ("1" if v else "0") \
+                if isinstance(v, bool) else str(v)
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "WarmStartSpec":
+        if d is not None and not isinstance(d, dict):
+            raise ValueError(
+                f"spec.warmStart must be a mapping of warm-start knobs, "
+                f"got {type(d).__name__}: {d!r}")
+        d = dict(d or {})
+        by_spec = {f.metadata["spec_field"]: f.name for f in fields(cls)}
+        unknown = set(d) - set(by_spec)
+        if unknown:
+            raise ValueError(
+                f"unknown warm-start knobs {sorted(unknown)}; "
+                f"valid: {sorted(by_spec)}")
+        spec = cls(**{by_spec[k]: v for k, v in d.items()})
+        spec.validate()
+        return spec
+
+
+@dataclass
 class SchedulingPolicy:
     """Gang-scheduling knobs (``spec.schedulingPolicy``): how the slice
     scheduler (kubeflow_tpu/scheduler/) queues, places, and — when
@@ -615,6 +685,10 @@ class TrainingJob:
     # KFTPU_OBS_METRICS_PORT): trace-span sink and the worker's own
     # /metrics port (docs/operations.md "Observability")
     obs_spec: ObsSpec = field(default_factory=ObsSpec)
+    # warm-start knobs (spec.warmStart → KFTPU_AOT / KFTPU_AOT_DIR):
+    # the AOT serialized-executable rung of the warm-start ladder
+    # (docs/operations.md "Warm starts and the compile cache")
+    warm_start: WarmStartSpec = field(default_factory=WarmStartSpec)
     # gang-scheduling knobs (spec.schedulingPolicy → the slice
     # scheduler's queue/priority/preemptible; None = not
     # scheduler-managed, the legacy immediate-create path)
@@ -687,6 +761,7 @@ class TrainingJob:
             compile_cache_dir=spec.get("compileCacheDir", "") or "",
             input_spec=InputSpec.from_dict(spec.get("input")),
             obs_spec=ObsSpec.from_dict(spec.get("observability")),
+            warm_start=WarmStartSpec.from_dict(spec.get("warmStart")),
             scheduling_policy=SchedulingPolicy.from_dict(
                 spec.get("schedulingPolicy")),
             weight_update=spec.get("weightUpdate", "") or "",
@@ -727,6 +802,7 @@ class TrainingJob:
             validate_weight_update(self.weight_update)
         self.input_spec.validate()
         self.obs_spec.validate()
+        self.warm_start.validate()
         if self.scheduling_policy is not None:
             self.scheduling_policy.validate()
         vocab = REPLICA_TYPES[self.kind]
@@ -841,6 +917,8 @@ class TrainingJob:
             out["spec"]["input"] = self.input_spec.to_dict()
         if self.obs_spec.to_dict():
             out["spec"]["observability"] = self.obs_spec.to_dict()
+        if self.warm_start.to_dict():
+            out["spec"]["warmStart"] = self.warm_start.to_dict()
         if self.scheduling_policy is not None:
             out["spec"]["schedulingPolicy"] = self.scheduling_policy.to_dict()
         if self.weight_update:

@@ -54,7 +54,7 @@ from ..cluster.client import KubeClient, NotFoundError
 from ..cluster.fake import POD_GROUP_LABEL, TPU_RESOURCE
 from ..obs import registry as obsreg
 from ..obs.trace import SPAN_PATH_ENV, TRACE_ID_ANNOTATION, TRACE_ID_ENV
-from ..scheduler import health
+from ..scheduler import health, warmpool
 from ..scheduler.inventory import POOL_LABEL, Placement, SliceRect
 from .runtime import (Key, Reconciler, Result, ensure_trace_id,
                       trace_job_event)
@@ -519,6 +519,13 @@ class TrainingJobReconciler(Reconciler):
         # the slice order)
         slice_rects = {i: r for i, r in
                        enumerate(binding.slices)} if binding else {}
+        # warm-pod adoption: the binding names the pre-initialized pods
+        # this placement covers (scheduler stamps warmHosts at bind
+        # time); retire them and mark the gang warm-started BEFORE the
+        # cold-create below — rebinds, elastic resizes, and preemption
+        # re-binds all come through here, which is exactly the point
+        adopted = self._adopt_warm_pods(client, binding) \
+            if binding is not None and binding.warm_hosts else []
         created = 0
         for rtype, rs in job.replica_specs.items():
             if rs.is_tpu:
@@ -530,6 +537,12 @@ class TrainingJobReconciler(Reconciler):
                     for pname, c in tpu_entries[rtype]
                     if pname not in existing]
                 for pod in gang_pods:
+                    if adopted:
+                        pod["metadata"]["annotations"][
+                            warmpool.ADOPTED_ANNOTATION] = \
+                            json.dumps(adopted)
+                        self._add_env(pod,
+                                      {warmpool.WARM_START_ENV: "1"})
                     client.create(pod)
                     created += 1
             else:
@@ -541,6 +554,29 @@ class TrainingJobReconciler(Reconciler):
                         job, manifest, rs, rtype, i, pname))
                     created += 1
         return created
+
+    def _adopt_warm_pods(self, client: KubeClient,
+                         binding: Placement) -> list[dict]:
+        """Retire the warm pods the binding's warmHosts name; returns
+        the slots whose pod actually existed (a slot whose pod is gone
+        — raced away by another bind, or never created — degrades to a
+        plain cold create for that host, never an error)."""
+        adopted: list[dict] = []
+        for slot in binding.warm_hosts:
+            name = warmpool.warm_pod_name(slot["pool"], slot["host"])
+            try:
+                client.delete("v1", "Pod", warmpool.WARM_POOL_NAMESPACE,
+                              name)
+            except NotFoundError:
+                continue
+            adopted.append({"pool": slot["pool"],
+                            "host": int(slot["host"])})
+        if adopted:
+            obsreg.counter(
+                "kftpu_warm_pod_adoptions_total",
+                "gang creations that adopted a pre-initialized warm "
+                "pod instead of cold-creating").inc(len(adopted))
+        return adopted
 
     def _base_pod(self, job: TrainingJob, manifest: dict, rs: ReplicaSpec,
                   name: str, rtype: str, index: str) -> dict:
@@ -612,8 +648,20 @@ class TrainingJobReconciler(Reconciler):
         # into the shared-memory augment ring / DevicePrefetcher
         env.update(job.input_spec.to_env())
         from ..runtime.compile_cache import (COMPILE_CACHE_ENV,
-                                             default_cache_dir)
+                                             SHARED_CACHE_ROOT_ENV,
+                                             default_cache_dir,
+                                             namespace_cache_dir)
+        # cache-dir precedence: an explicit spec.compileCacheDir wins;
+        # then the CLUSTER-SHARED compile-cache service (the operator
+        # deployment carries KFTPU_SHARED_CACHE_ROOT, backed by the
+        # tpu-compile-cache volume — every gang of a namespace shares
+        # one cache, so the first job to compile a program warms every
+        # later job/rebind/resize, not just its own pod restarts); then
+        # the per-job default on the checkpoint volume
+        shared_root = os.environ.get(SHARED_CACHE_ROOT_ENV, "")
         cache_dir = job.compile_cache_dir or (
+            namespace_cache_dir(shared_root, job.namespace)
+            if shared_root else "") or (
             default_cache_dir(job.checkpoint_dir)
             if job.checkpoint_dir else "")
         if cache_dir:
@@ -621,6 +669,18 @@ class TrainingJobReconciler(Reconciler):
             # a restarted/warm-started gang skips the first-step compile
             # (runtime/compile_cache.py; BASELINE.md north-star #2)
             env[COMPILE_CACHE_ENV] = cache_dir
+        # spec.warmStart → KFTPU_AOT / KFTPU_AOT_DIR: the serialized-
+        # executable rung above the cache (runtime/aot.py). With AOT on
+        # but no explicit dir, executables live beside the active cache
+        # so a shared cache volume shares them across jobs too.
+        env.update(job.warm_start.to_env())
+        if job.warm_start.aot and not job.warm_start.aot_dir \
+                and cache_dir:
+            from ..runtime.aot import AOT_DIR_ENV, default_aot_dir
+            volume = job.compile_cache_dir or (
+                namespace_cache_dir(shared_root, job.namespace)
+                if shared_root else job.checkpoint_dir)
+            env.setdefault(AOT_DIR_ENV, default_aot_dir(volume))
         if env:
             self._add_env(pod, env)
         return pod

@@ -85,6 +85,27 @@ def cluster_role_binding(name: str, role: str, sa: str, namespace: str) -> dict:
     return obj
 
 
+def role(name: str, namespace: str, rules: Sequence[dict]) -> dict:
+    """Namespaced Role: write verbs a component needs in ONE namespace
+    must not ride a ClusterRole (blast-radius minimization — the
+    warm-pod pool's pod/ConfigMap writes are the motivating case)."""
+    obj = k8s.make("rbac.authorization.k8s.io/v1", "Role", name, namespace,
+                   labels=std_labels(name))
+    obj["rules"] = list(rules)
+    return obj
+
+
+def role_binding(name: str, namespace: str, role_name: str,
+                 sa: str, sa_namespace: str) -> dict:
+    obj = k8s.make("rbac.authorization.k8s.io/v1", "RoleBinding", name,
+                   namespace, labels=std_labels(name))
+    obj["roleRef"] = {"apiGroup": "rbac.authorization.k8s.io",
+                      "kind": "Role", "name": role_name}
+    obj["subjects"] = [{"kind": "ServiceAccount", "name": sa,
+                        "namespace": sa_namespace}]
+    return obj
+
+
 def config_map(name: str, namespace: str, data: dict) -> dict:
     obj = k8s.make("v1", "ConfigMap", name, namespace, labels=std_labels(name))
     obj["data"] = {k: str(v) for k, v in data.items()}

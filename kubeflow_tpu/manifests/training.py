@@ -79,12 +79,25 @@ def _job_schema(specs_key: str, max_one: list[str]) -> dict:
             "metricsPort": {"type": "integer", "minimum": 0,
                             "maximum": 65535},
         }},
+        # warm-start knobs (api/trainingjob.py WarmStartSpec → KFTPU_AOT
+        # / KFTPU_AOT_DIR: the AOT serialized-executable rung above the
+        # persistent compile cache — runtime/aot.py; tests/test_lint.py
+        # enforces the same full-path rule)
+        "warmStart": {"type": "object", "properties": {
+            "aot": {"type": "boolean"},
+            "aotDir": {"type": "string"},
+        }},
+        # persistent XLA compile cache dir override (defaults to the
+        # namespace's shared cache when the operator carries
+        # KFTPU_SHARED_CACHE_ROOT, else <checkpointDir>/.jax-compile-cache)
+        "compileCacheDir": {"type": "string"},
     }
     return {"type": "object",
             "properties": {"spec": {"type": "object", "properties": props}}}
 
 
-def _operator_deployment(namespace: str, gang_scheduling: bool) -> list[dict]:
+def _operator_deployment(namespace: str, gang_scheduling: bool,
+                         shared_cache_root: str = "") -> list[dict]:
     sa = H.service_account("tpu-job-operator", namespace)
     role = H.cluster_role("tpu-job-operator", [
         {"apiGroups": ["tpu.kubeflow.org", "kubeflow.org"],
@@ -112,7 +125,14 @@ def _operator_deployment(namespace: str, gang_scheduling: bool) -> list[dict]:
     dep = H.deployment("tpu-job-operator", namespace,
                        f"{IMG}/tpu-job-operator:{VERSION}", args=args,
                        service_account="tpu-job-operator", port=8443,
-                       pod_annotations=scrape_annotations(METRICS_PORT))
+                       pod_annotations=scrape_annotations(METRICS_PORT),
+                       # shared compile-cache service: with the root set
+                       # the operator points every gang of a namespace
+                       # at <root>/<namespace> on the tpu-compile-cache
+                       # volume (runtime/compile_cache.py)
+                       env=({"KFTPU_SHARED_CACHE_ROOT":
+                             shared_cache_root}
+                            if shared_cache_root else None))
     cm = H.config_map("tpu-job-operator-config", namespace, {
         "gang-scheduling": str(gang_scheduling).lower(),
         "coordinator-port": "8476",
@@ -122,10 +142,39 @@ def _operator_deployment(namespace: str, gang_scheduling: bool) -> list[dict]:
 
 @register("tpu-job-operator", "TPUJob CRD + the gang-scheduling operator")
 def tpu_job_operator(namespace: str = "kubeflow",
-                     gang_scheduling: bool = True) -> list[dict]:
+                     gang_scheduling: bool = True,
+                     shared_cache_root: str = "") -> list[dict]:
+    """``shared_cache_root`` (e.g. ``/mnt/kftpu-cache``) turns on the
+    cluster-shared compile-cache service: the operator renders
+    KFTPU_COMPILE_CACHE_DIR=<root>/<namespace> into every gang (one
+    cache per namespace on the tpu-compile-cache volume — deploy that
+    component alongside) instead of the per-job checkpoint-volume
+    default (docs/operations.md "Warm starts and the compile cache")."""
     job_crd = H.crd("tpujobs", "TPUJob", "tpu.kubeflow.org", ["v1alpha1"],
                     schema=_job_schema("replicaSpecs", ["Coordinator"]))
-    return [job_crd, *_operator_deployment(namespace, gang_scheduling)]
+    return [job_crd, *_operator_deployment(namespace, gang_scheduling,
+                                           shared_cache_root)]
+
+
+@register("tpu-compile-cache", "Cluster-shared XLA compile-cache volume: "
+                               "one persistent cache per namespace, "
+                               "mounted by every gang (warm starts)")
+def tpu_compile_cache(namespace: str = "kubeflow",
+                      size: str = "50Gi",
+                      storage_class: str = "") -> list[dict]:
+    """The volume behind the shared compile-cache service
+    (runtime/compile_cache.py): a ReadWriteMany claim the operator's
+    shared_cache_root points into. Workers mount it via their pod
+    template; the operator only renders the env — a gang whose template
+    lacks the mount degrades to its checkpoint-volume cache."""
+    pvc = k8s.make("v1", "PersistentVolumeClaim", "tpu-compile-cache",
+                   namespace)
+    pvc["spec"] = {
+        "accessModes": ["ReadWriteMany"],
+        "resources": {"requests": {"storage": size}},
+        **({"storageClassName": storage_class} if storage_class else {}),
+    }
+    return [pvc]
 
 
 @register("tf-job-operator", "TFJob CRD served by the TPU operator "
@@ -196,7 +245,8 @@ def tpu_scheduler(namespace: str = "kubeflow",
                   elastic: bool = True,
                   grow: bool = True,
                   defrag: bool = True,
-                  grow_cooldown_seconds: float = 300.0) -> list[dict]:
+                  grow_cooldown_seconds: float = 300.0,
+                  warm_pods: int = 0) -> list[dict]:
     """``queues`` is the SchedulerConfig wire shape
     (scheduler/queue.py), e.g. ``{"research": {"quotaChips":
     {"team-a": 32, "*": 64}}}`` — per-queue, per-namespace bound-chip
@@ -211,7 +261,11 @@ def tpu_scheduler(namespace: str = "kubeflow",
     elastic-resizing policy switches (scheduler/queue.py
     SchedulerConfig; docs/operations.md "Elastic resizing"): the
     master resize switch, grow-to-fill, defrag migration, and the
-    per-gang hysteresis between grows/migrations."""
+    per-gang hysteresis between grows/migrations. ``warm_pods`` sizes
+    the warm-pod pool (scheduler/warmpool.py): the scheduler keeps up
+    to N pre-initialized pods on idle hosts and binds prefer adopting
+    them — rebinds/resizes start warm (docs/operations.md "Warm starts
+    and the compile cache")."""
     import json
 
     from ..scheduler.health import HealthConfig
@@ -230,11 +284,24 @@ def tpu_scheduler(namespace: str = "kubeflow",
     ])
     binding = H.cluster_role_binding("tpu-scheduler", "tpu-scheduler",
                                      "tpu-scheduler", namespace)
+    # warm-pod pool writes are NAMESPACED: the pool's pods and the
+    # tpu-warm-pool slots ConfigMap live only in the scheduler's own
+    # namespace (scheduler/warmpool.py WARM_POOL_NAMESPACE), so the
+    # create/delete/patch verbs ride a Role there instead of widening
+    # the cluster-wide read grant above
+    warm_role = H.role("tpu-scheduler-warm-pool", namespace, [
+        {"apiGroups": [""], "resources": ["pods", "configmaps"],
+         "verbs": ["create", "delete", "patch"]},
+    ])
+    warm_binding = H.role_binding("tpu-scheduler-warm-pool", namespace,
+                                  "tpu-scheduler-warm-pool",
+                                  "tpu-scheduler", namespace)
     cm = H.config_map("tpu-scheduler-config", namespace, {
         "config.json": json.dumps({
             "backfill": backfill, "preemption": preemption,
             "elastic": elastic, "grow": grow, "defrag": defrag,
             "growCooldownSeconds": grow_cooldown_seconds,
+            "warmPods": warm_pods,
             "queues": queues or {},
             # render the FULL health block (defaults made explicit) so
             # the deployed knobs are discoverable with kubectl, and
@@ -250,7 +317,7 @@ def tpu_scheduler(namespace: str = "kubeflow",
                              f"--metrics-port={METRICS_PORT}"],
                        service_account="tpu-scheduler", port=8443,
                        pod_annotations=scrape_annotations(METRICS_PORT))
-    return [sa, role, binding, cm, dep]
+    return [sa, role, binding, warm_role, warm_binding, cm, dep]
 
 
 @register("openmpi-controller", "Slice-sidecar config: lifecycle hooks for "
@@ -290,7 +357,9 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
                    min_chips: int | None = None,
                    max_chips: int | None = None,
                    span_path: str | None = None,
-                   obs_metrics_port: int | None = None) -> list[dict]:
+                   obs_metrics_port: int | None = None,
+                   aot: bool | None = None,
+                   aot_dir: str | None = None) -> list[dict]:
     """fused_blocks opts into the ghost-BN fused bottleneck kernels
     (docs/training.md --fused-blocks; per-block batch/spatial routing).
     ``fused_routing`` pins the per-geometry kernel routing to a
@@ -334,7 +403,13 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
     ``span_path``/``obs_metrics_port`` render spec.observability
     (api/trainingjob.py ObsSpec → KFTPU_SPAN_PATH /
     KFTPU_OBS_METRICS_PORT): the worker's trace-span JSONL sink and its
-    own /metrics port (docs/operations.md "Observability")."""
+    own /metrics port (docs/operations.md "Observability").
+
+    ``aot``/``aot_dir`` render spec.warmStart (api/trainingjob.py
+    WarmStartSpec → KFTPU_AOT / KFTPU_AOT_DIR): the AOT serialized-
+    executable warm start — rebinds/resizes load the keyed compiled
+    step and skip XLA entirely (docs/operations.md "Warm starts and
+    the compile cache")."""
     command = ["python", "-m", "kubeflow_tpu.runtime.worker",
                "--workload", "resnet50",
                "--steps", str(steps),
@@ -415,6 +490,11 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
                         metrics_port=obs_metrics_port)
         ospec.validate()
         job["spec"]["observability"] = ospec.to_dict()
+    if aot is not None or aot_dir is not None:
+        from ..api.trainingjob import WarmStartSpec
+        wspec = WarmStartSpec(aot=aot, aot_dir=aot_dir)
+        wspec.validate()
+        job["spec"]["warmStart"] = wspec.to_dict()
     out.append(job)
     return out
 

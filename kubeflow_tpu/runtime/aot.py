@@ -1,0 +1,192 @@
+"""AOT train-step executable export: rebinds skip XLA entirely.
+
+The persistent compilation cache (compile_cache.py) cuts a warm start's
+first-step cost to trace + lower + cache-load; this module removes even
+that. At first-bind time the worker AOT-compiles the train step
+(``TrainStepBuilder.build_compiled`` — ``jit(...).lower().compile()``)
+and serializes the compiled executable to the checkpoint/cache volume
+(``jax.experimental.serialize_executable``), keyed on everything that
+shapes the program: topology, slice count, model+recipe fingerprint,
+weight-update mode, sharding, global batch, and the jax/jaxlib versions.
+A rebind, elastic resize back to a known shape, preemption re-bind, or
+warm-pod adoption loads the keyed executable — no tracing, no lowering,
+no XLA — and falls back to the persistent cache, then to a fresh
+compile: a stale or mismatched key must never kill a gang.
+
+Wire contract: the operator renders ``spec.warmStart`` (aot, aotDir) as
+``KFTPU_AOT`` / ``KFTPU_AOT_DIR`` (api/trainingjob.py WarmStartSpec);
+runtime/worker.py consumes both. The executable file is written
+atomically (tmp + rename) and carries the key plus the abstract
+(treedef + shape/dtype) signature of its example args, so a collision
+or drift is detected at load, not at execution.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+from typing import Any, Optional
+
+log = logging.getLogger(__name__)
+
+AOT_ENABLE_ENV = "KFTPU_AOT"
+AOT_DIR_ENV = "KFTPU_AOT_DIR"
+# executables live beside the compile cache on the same volume — the one
+# place this name is defined (worker + operator + docs import it)
+AOT_SUBDIR = ".jax-aot-executables"
+
+# bumped when the on-disk record layout changes (old files read as
+# corrupt and fall back — never crash)
+_FORMAT = 1
+
+
+def default_aot_dir(volume_dir: str) -> str:
+    """``<volume>/.jax-aot-executables`` with normalized slashes (same
+    convention as compile_cache.default_cache_dir)."""
+    return volume_dir.rstrip("/") + "/" + AOT_SUBDIR
+
+
+def step_key(*, topology: str, num_slices: int, model_fingerprint: str,
+             weight_update: str, sharding: dict, global_batch: int,
+             extra: Optional[dict] = None) -> str:
+    """Stable key of one compiled train step. Everything that changes
+    the compiled program must feed it: the slice geometry, the model +
+    recipe fingerprint (recipe.recipe_fingerprint), the weight-update
+    layout, the resolved sharding axes, the global batch, and — added
+    here so no caller can forget — the jax/jaxlib versions and backend
+    platform (a jaxlib upgrade silently invalidates serialized
+    executables; the key must rotate with it)."""
+    import jax
+    import jaxlib
+    parts = {
+        "topology": topology,
+        "numSlices": int(num_slices),
+        "model": model_fingerprint,
+        "weightUpdate": weight_update,
+        "sharding": {k: int(v) for k, v in sorted((sharding or {}).items())},
+        "globalBatch": int(global_batch),
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": jax.devices()[0].platform,
+        "deviceKind": getattr(jax.devices()[0], "device_kind", ""),
+        "format": _FORMAT,
+        **(extra or {}),
+    }
+    blob = json.dumps(parts, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:24]
+
+
+def abstract_signature(*example_args: Any) -> dict:
+    """Treedef + per-leaf (shape, dtype) of the executable's example
+    arguments — the load-time guard against a key collision or a pytree
+    registration drift feeding mismatched buffers into a donating
+    executable."""
+    import jax
+    sig = []
+    for arg in example_args:
+        leaves, treedef = jax.tree_util.tree_flatten(arg)
+        sig.append({
+            "treedef": str(treedef),
+            "leaves": [[list(getattr(leaf, "shape", ())),
+                        str(getattr(leaf, "dtype", type(leaf).__name__))]
+                       for leaf in leaves],
+        })
+    return {"args": sig}
+
+
+def _path(aot_dir: str, key: str) -> str:
+    return aot_dir.rstrip("/") + f"/step-{key}.aotx"
+
+
+def export_step(aot_dir: str, key: str, compiled,
+                signature: dict) -> Optional[str]:
+    """Serialize a ``jax.stages.Compiled`` train step under ``key``.
+    Returns the written path, or None — export is an optimization, so
+    every failure (unserializable backend, read-only volume) downgrades
+    to a warning. The write is atomic (tmp + rename): a pod killed
+    mid-export must never leave a truncated file a rebind would trip
+    over."""
+    try:
+        from jax.experimental import serialize_executable
+        payload, in_tree, out_tree = serialize_executable.serialize(compiled)
+        record = {
+            "format": _FORMAT,
+            "key": key,
+            "signature": signature,
+            "payload": payload,
+            "in_tree": in_tree,
+            "out_tree": out_tree,
+        }
+        os.makedirs(aot_dir, exist_ok=True)
+        path = _path(aot_dir, key)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump(record, f)
+        os.replace(tmp, path)
+        log.info("AOT step executable exported to %s (%d bytes)", path,
+                 len(payload))
+        _count("export")
+        return path
+    except Exception as e:  # noqa: BLE001 — export must never kill a gang
+        log.warning("AOT export to %s failed: %s", aot_dir, e)
+        _count("export-failed")
+        return None
+
+
+def load_step(aot_dir: str, key: str, signature: dict):
+    """Load the serialized executable for ``key``, or None. EVERY
+    failure mode falls back to None — absent file, truncated/corrupt
+    pickle, a record written under a different key (hash collision on
+    the filename is impossible, but a hand-copied file is not), an
+    abstract-signature mismatch, and a deserialization error — so the
+    caller's ladder (persistent cache, then fresh compile) always has a
+    next rung. The gang must never die for a stale artifact."""
+    path = _path(aot_dir, key)
+    try:
+        with open(path, "rb") as f:
+            record = pickle.load(f)
+    except FileNotFoundError:
+        _count("miss")
+        return None
+    except Exception as e:  # noqa: BLE001 — corrupt file = miss
+        log.warning("AOT executable %s unreadable (%s); falling back to "
+                    "compile", path, e)
+        _count("corrupt")
+        return None
+    try:
+        if record.get("format") != _FORMAT or record.get("key") != key:
+            log.warning("AOT executable %s key/format mismatch "
+                        "(have %s/%s, want %s/%s); falling back",
+                        path, record.get("key"), record.get("format"),
+                        key, _FORMAT)
+            _count("key-mismatch")
+            return None
+        if record.get("signature") != signature:
+            log.warning("AOT executable %s argument-signature mismatch; "
+                        "falling back to compile", path)
+            _count("signature-mismatch")
+            return None
+        from jax.experimental import serialize_executable
+        compiled = serialize_executable.deserialize_and_load(
+            record["payload"], record["in_tree"], record["out_tree"])
+        _count("hit")
+        return compiled
+    except Exception as e:  # noqa: BLE001 — a bad record = miss
+        log.warning("AOT executable %s failed to deserialize (%s); "
+                    "falling back to compile", path, e)
+        _count("deserialize-failed")
+        return None
+
+
+def _count(outcome: str) -> None:
+    """Obs-registry counter for the AOT path's outcomes (hit / miss /
+    corrupt / mismatch / export) — the fleet-dashboard side of 'are
+    rebinds actually skipping XLA'."""
+    from ..obs import registry as obsreg
+    obsreg.counter(
+        "kftpu_aot_executable_total",
+        "AOT serialized-executable loads/exports by outcome",
+        labels=("outcome",)).labels(outcome=outcome).inc()

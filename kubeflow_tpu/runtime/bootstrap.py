@@ -111,3 +111,34 @@ def context_for_topology(name: str, sharding: Optional[ShardingSpec] = None
         slice_topology=topo)
     return WorkerContext(contract=contract, sharding=sharding, mesh=mesh,
                          process_id=0, num_processes=1)
+
+
+def main(argv=None) -> int:
+    """The warm-pod entrypoint (scheduler/warmpool.py build_warm_pod):
+    ``--prewarm`` initializes the TPU backend and the persistent compile
+    cache, then idles until adopted or retired — the whole point is that
+    backend bring-up and cache mount are PAID before a gang lands on
+    this host. SIGTERM (retirement / adoption teardown) exits cleanly."""
+    import argparse
+    p = argparse.ArgumentParser(description="kubeflow-tpu host bootstrap")
+    p.add_argument("--prewarm", action="store_true",
+                   help="initialize backend + compile cache, then idle "
+                        "(the warm-pod pool's pre-initialized state)")
+    args = p.parse_args(argv)
+    if not args.prewarm:
+        p.error("nothing to do (did you mean --prewarm?)")
+    from .compile_cache import enable_compilation_cache
+    enable_compilation_cache()
+    initialize()
+    log.info("prewarm: backend up, cache mounted; idling until adopted")
+    import signal
+    import threading
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

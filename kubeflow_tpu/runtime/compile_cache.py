@@ -28,6 +28,21 @@ COMPILE_CACHE_ENV = "KFTPU_COMPILE_CACHE_DIR"
 # place this name is defined (operator + serving manifest import it)
 COMPILE_CACHE_SUBDIR = ".jax-compile-cache"
 
+# Cluster-shared compile-cache service: the operator process carries
+# KFTPU_SHARED_CACHE_ROOT (rendered onto its Deployment by
+# manifests/training.py, backed by the tpu-compile-cache volume) and
+# points EVERY gang of a namespace at <root>/<namespace> — so the first
+# job to compile a program warms it for every other job, rebind, resize,
+# and serving scale-up in that namespace, not just its own pod restarts.
+SHARED_CACHE_ROOT_ENV = "KFTPU_SHARED_CACHE_ROOT"
+
+
+def namespace_cache_dir(root: str, namespace: str) -> str:
+    """One cache directory per namespace under the shared volume:
+    namespaces are the tenancy boundary, and a cross-namespace cache
+    would leak program shapes between tenants."""
+    return root.rstrip("/") + "/" + namespace
+
 # compiles cheaper than this recompile faster than a cache round-trip.
 # KFTPU_COMPILE_CACHE_MIN_SECS overrides (tests pin 0: a warm process
 # compiles the tiny CPU models in under a second, which silently skipped
@@ -79,8 +94,112 @@ def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
                 _cc.reset_cache()
         except Exception:  # noqa: BLE001 — private API, best effort
             pass
+        install_compile_metrics()
         log.info("persistent compilation cache at %s", path)
         return path
     except Exception as e:  # noqa: BLE001 — cache is an optimization only
         log.warning("compilation cache disabled (%s): %s", path, e)
         return None
+
+
+# ---------------------------------------------------------------- metrics
+
+# module-level snapshot the listeners below keep current; compile_stats()
+# copies it so the worker can diff before/after its first step (the
+# cold-vs-warm evidence on the job's trace timeline) and the bench can
+# assert "no XLA compile observed" on the AOT path. NOTE jax's
+# backend_compile_duration event wraps compile-OR-cache-load (it fires
+# on hits too), so the actual-XLA-compile count is derived:
+# requests - hits (each cached compile request either hits or pays XLA).
+_STATS = {"cache_hits": 0, "cache_misses": 0, "cache_requests": 0,
+          "compiles_or_loads": 0, "compile_or_load_s": 0.0,
+          "cache_load_s": 0.0}
+_METRICS_INSTALLED = False
+
+# the jax.monitoring event names this module consumes (jax emits them
+# from compiler.py / compilation_cache.py / dispatch.py)
+_EV_HIT = "/jax/compilation_cache/cache_hits"
+_EV_MISS = "/jax/compilation_cache/cache_misses"
+_EV_REQ = "/jax/compilation_cache/compile_requests_use_cache"
+_EV_BACKEND = "/jax/core/compile/backend_compile_duration"
+_EV_LOAD = "/jax/compilation_cache/cache_retrieval_time_sec"
+
+
+def install_compile_metrics() -> None:
+    """Register jax.monitoring listeners that mirror the persistent
+    cache's hit/miss/load-time and every actual XLA backend compile into
+    the shared obs registry (kftpu_compile_cache_events_total,
+    kftpu_xla_backend_compiles_total, kftpu_xla_compile_seconds_total) —
+    the per-job cold-vs-warm visibility the fleet dashboards read.
+    Idempotent; safe before backend init."""
+    global _METRICS_INSTALLED
+    if _METRICS_INSTALLED:
+        return
+    from jax import monitoring
+
+    from ..obs import registry as obsreg
+
+    # families re-resolved per event (a dict lookup — idempotent
+    # re-registration): the default registry is resettable (tests,
+    # bench arms), and a family captured at install time would keep
+    # feeding the dead registry after a reset
+
+    # jax calls listeners INSIDE its compile/cache paths — a raising
+    # listener breaks cache writes (observed: it aborts the cache put),
+    # so both handlers are wrapped: metrics must never cost the cache
+    _STAT_KEY = {_EV_HIT: ("hit", "cache_hits"),
+                 _EV_MISS: ("miss", "cache_misses"),
+                 _EV_REQ: ("request", "cache_requests")}
+
+    def on_event(event: str, **kw) -> None:
+        del kw
+        try:
+            name, stat = _STAT_KEY.get(event, (None, None))
+            if name is None:
+                return
+            _STATS[stat] += 1
+            obsreg.counter(
+                "kftpu_compile_cache_events_total",
+                "persistent compilation cache activity "
+                "(hit/miss/request)",
+                labels=("event",)).labels(event=name).inc()
+        except Exception:  # noqa: BLE001 — never break a compile
+            pass
+
+    def on_duration(event: str, duration: float, **kw) -> None:
+        del kw
+        try:
+            if event == _EV_BACKEND:
+                _STATS["compiles_or_loads"] += 1
+                _STATS["compile_or_load_s"] += duration
+                stage = "compile_or_load"
+            elif event == _EV_LOAD:
+                _STATS["cache_load_s"] += duration
+                stage = "cache_load"
+            else:
+                return
+            obsreg.counter(
+                "kftpu_xla_compile_seconds_total",
+                "cumulative seconds by stage: jit compile-or-load "
+                "(jax's event fires on cache hits too) vs the "
+                "persistent-cache executable-load slice of it",
+                labels=("stage",)).labels(stage=stage).inc(duration)
+        except Exception:  # noqa: BLE001 — never break a compile
+            pass
+
+    monitoring.register_event_listener(on_event)
+    monitoring.register_event_duration_secs_listener(on_duration)
+    _METRICS_INSTALLED = True
+
+
+def compile_stats() -> dict:
+    """Snapshot of the process's compile/cache activity since
+    install_compile_metrics() (all zeros before it). Diff two snapshots
+    around a program region to attribute its compiles.
+    ``xla_backend_compiles`` is the derived actual-XLA-compile count
+    (cache requests that did NOT hit) — exact whenever the persistent
+    cache is enabled, which every warm-start path guarantees."""
+    out = dict(_STATS)
+    out["xla_backend_compiles"] = max(
+        0, out["cache_requests"] - out["cache_hits"])
+    return out

@@ -37,6 +37,26 @@ STEP_BOUNDARIES = (1 / 3, 2 / 3, 8 / 9)
 STEP_FACTOR = 0.1
 
 
+def recipe_fingerprint(**knobs) -> str:
+    """Stable hash of everything recipe-shaped that is BAKED into the
+    compiled train step — model/workload identity, optimizer family and
+    its scalars, LR schedule (base lr, warmup, total steps: schedules
+    are traced functions whose constants land in the HLO), weight decay,
+    label smoothing. One half of the AOT executable key
+    (runtime/aot.py step_key); the other half is the geometry the
+    caller supplies there. Values must be JSON-able; unhashable knobs
+    fall back to repr so a novel workload kwarg degrades to a unique
+    (never-colliding-by-silence) fingerprint rather than an error."""
+    import hashlib
+    import json
+
+    def default(o):  # non-JSON knob: repr is stable enough for a key
+        return repr(o)
+
+    blob = json.dumps(knobs, sort_keys=True, default=default).encode()
+    return hashlib.sha256(blob).hexdigest()[:24]
+
+
 def scale_lr(base_lr: float, global_batch: int, base_batch: int = 256
              ) -> float:
     """Linear-scaling rule (Goyal et al.): lr = base · batch/256."""

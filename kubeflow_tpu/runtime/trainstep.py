@@ -267,6 +267,29 @@ class TrainStepBuilder:
             )
         return fn
 
+    def build_compiled(self, state: "TrainState", batch: PyTree):
+        """The AOT path: lower + compile the step against concrete
+        example args NOW (instead of at the first loop iteration) and
+        return the ``jax.stages.Compiled``. The compiled executable is
+        what runtime/aot.py serializes to the cache volume so a rebind /
+        resize / warm-pod adoption skips XLA entirely; it is also
+        directly callable, so the exporting worker runs the very
+        executable it persisted (compile once, not twice).
+
+        Compiled WITHOUT buffer donation, deliberately: a DESERIALIZED
+        executable's donation is unsafe against concurrent readers —
+        donating the train state while orbax's async checkpoint save
+        still references it corrupts the heap (observed: glibc
+        "corrupted double-linked list" on the jit path's equivalent the
+        runtime copy-protects). The cost is one extra live copy of the
+        state during the step; the exporting worker runs the same
+        non-donating executable so exported and first-bind numerics are
+        the identical program."""
+        from dataclasses import replace
+        nondonating = replace(self, donate=False)
+        with self.mesh:
+            return nondonating.build().lower(state, batch).compile()
+
     def _zero2_explicit_step_fn(self):
         """The sharded weight update with its gradient reduction emitted
         explicitly (returns the UNjitted step fn — build() wraps it): a

@@ -134,6 +134,13 @@ class TrainResult:
     final_metrics: dict
     preempted: bool = False
     first_window_s: float = 0.0   # compile + warmup window (startup cost)
+    # startup→first-completed-step seconds from train() entry, and how
+    # the step executable came to exist: "aot" (serialized executable
+    # loaded, no XLA), "warm" (persistent compile cache had entries), or
+    # "cold" (fresh compile) — the warm-start evidence bench.py --mode
+    # warmstart and the kftpu_time_to_first_step_seconds histogram read
+    time_to_first_step_s: float = 0.0
+    start_kind: str = "cold"
 
 
 class PreemptionGuard:
@@ -197,11 +204,22 @@ def train(
     device_prefetch: Optional[int] = None,
     span_path: Optional[str] = None,
     obs_metrics_port: Optional[int] = None,
+    aot: Optional[bool] = None,
+    aot_dir: Optional[str] = None,
 ) -> TrainResult:
     # before any jit: warm restarts must hit the persistent cache for the
-    # very first compile (the startup→first-step dominator, PERF.md)
-    from .compile_cache import enable_compilation_cache
+    # very first compile (the startup→first-step dominator, PERF.md) —
+    # and the compile/cache listeners must be live so the first step's
+    # cold-vs-warm evidence is counted, not guessed
+    t_train_start = time.perf_counter()
+    from .compile_cache import (compile_stats, enable_compilation_cache,
+                                install_compile_metrics)
+    install_compile_metrics()
     enable_compilation_cache()
+    # snapshot BEFORE any jit: the first step's cold-vs-warm verdict is
+    # the hit/compile delta from here (evidence, not a directory check
+    # — a shared namespace cache is non-empty with OTHER jobs' entries)
+    compile_stats_at_entry = compile_stats()
     ctx = ctx or initialize()
     workload_kwargs = dict(workload_kwargs or {})
     if workload in _MESH_AWARE_WORKLOADS:
@@ -472,6 +490,75 @@ def train(
             batch_pool.append(
                 builder.place_batch(spec.batch_fn(brng, global_batch)))
 
+    # -- warm start: AOT executable load/export (runtime/aot.py) ----------
+    # The fallback ladder the whole warm-start stack rests on: a keyed
+    # serialized executable (no trace, no lower, no XLA) → the
+    # persistent compile cache (trace+lower, executable loaded) → a
+    # fresh compile. Every rung downgrades to the next with a warning —
+    # a stale key, corrupt file, or missing volume must never kill a
+    # gang. start_kind records which rung actually ran the first step
+    # (resolved from the compile/cache-hit evidence at the first step).
+    start_kind = "cold"
+    aot_used = False
+    if aot is None:
+        from .aot import AOT_ENABLE_ENV
+        aot = bool(_env_int(AOT_ENABLE_ENV, 0))  # rendered "1"/"0"
+    if aot:
+        from . import aot as aot_mod
+        from .recipe import recipe_fingerprint
+        aot_dir = aot_dir or os.environ.get(aot_mod.AOT_DIR_ENV) or (
+            aot_mod.default_aot_dir(checkpoint_dir) if checkpoint_dir
+            else None)
+        if not aot_dir:
+            log.warning("AOT warm start requested but no --aot-dir / "
+                        "%s / checkpoint volume to keep executables on; "
+                        "continuing without it", aot_mod.AOT_DIR_ENV)
+        else:
+            try:
+                if data_source is not None:
+                    import numpy as np
+                    s = data_source.image_size
+                    example = builder.place_batch({
+                        "images": np.zeros((global_batch, s, s, 3),
+                                           np.uint8),
+                        "labels": np.zeros((global_batch,), np.int32)})
+                else:
+                    example = batch_pool[0]
+                fp = recipe_fingerprint(
+                    workload=spec.name, optimizer=optimizer,
+                    lr_schedule=lr_schedule, learning_rate=base_lr,
+                    warmup_steps=warmup_steps, weight_decay=weight_decay,
+                    momentum=momentum, label_smoothing=label_smoothing,
+                    steps=steps, real_data=data_source is not None,
+                    workload_kwargs=workload_kwargs)
+                sig = aot_mod.abstract_signature(state, example)
+                key = aot_mod.step_key(
+                    topology=os.environ.get("KFTPU_TOPOLOGY", "")
+                    or f"local-{ctx.num_processes}p",
+                    num_slices=int(os.environ.get("KFTPU_NUM_SLICES",
+                                                  "1") or 1),
+                    model_fingerprint=fp, weight_update=weight_update,
+                    sharding={a: int(n)
+                              for a, n in ctx.mesh.shape.items()},
+                    global_batch=global_batch)
+                loaded = aot_mod.load_step(aot_dir, key, sig)
+                if loaded is not None:
+                    step_fn = loaded
+                    aot_used = True
+                    start_kind = "aot"
+                    log.info("AOT step executable loaded (key %s): "
+                             "skipping XLA for the train step", key)
+                else:
+                    # first bind: compile ahead of time, persist the
+                    # executable, and RUN the compiled object (compile
+                    # once — the export is on the already-paid path)
+                    compiled = builder.build_compiled(state, example)
+                    aot_mod.export_step(aot_dir, key, compiled, sig)
+                    step_fn = compiled
+            except Exception as e:  # noqa: BLE001 — optimization only
+                log.warning("AOT warm-start setup failed (%s); using "
+                            "the jit path", e)
+
     start_step = int(state.step)
     # trace spans (obs/trace.py): the worker end of the job's end-to-end
     # timeline. The operator renders KFTPU_TRACE_ID (minted at admission)
@@ -515,6 +602,7 @@ def train(
                      start_step=start_step, steps=steps,
                      process=ctx.process_id)
     last_metrics: dict = {}
+    first_step_s = 0.0
     guard = PreemptionGuard(install=handle_sigterm)
     preempted = False
     # Sync to the host only every `sync_every` steps: a per-step float()
@@ -539,7 +627,63 @@ def train(
                     batch = builder.place_batch(next(data_iter))
                 else:
                     batch = batch_pool[step % len(batch_pool)]
-                state, metrics = step_fn(state, batch)
+                if step == start_step:
+                    try:
+                        state, metrics = step_fn(state, batch)
+                    except Exception as e:  # noqa: BLE001 — see below
+                        if not aot_used:
+                            raise
+                        # last rung of the AOT fallback ladder: an
+                        # executable that passed the key+signature check
+                        # but still cannot execute (backend drift a
+                        # version string did not capture) falls back to
+                        # a fresh compile — a stale artifact must never
+                        # kill the gang. Donation is consummated only on
+                        # successful dispatch, so state is still alive.
+                        log.warning("AOT executable failed at first "
+                                    "step (%s); recompiling", e)
+                        aot_used = False
+                        step_fn = builder.build()
+                        state, metrics = step_fn(state, batch)
+                    # one hard sync, once: the time-to-first-step metric
+                    # IS the startup cost this measures — never on the
+                    # steady-state path
+                    jax.block_until_ready(metrics)
+                    t_first = time.perf_counter() - t_train_start
+                    stats_now = compile_stats()
+                    d_compiles = stats_now["xla_backend_compiles"] - \
+                        compile_stats_at_entry["xla_backend_compiles"]
+                    d_hits = stats_now["cache_hits"] - \
+                        compile_stats_at_entry["cache_hits"]
+                    if not aot_used:
+                        # warm = EVERY compile so far came from the
+                        # persistent cache; any real XLA compile (or no
+                        # cache at all) is a cold start — evidence, so
+                        # a shared cache warmed by OTHER jobs' programs
+                        # (or the AOT subdir beside it) can't
+                        # masquerade as warmth. Conservative on
+                        # purpose: with the default persistence
+                        # threshold, tiny sub-threshold jits recompile
+                        # and read as cold — under-reporting warmth
+                        # beats hiding real cold starts.
+                        start_kind = "warm" if d_compiles == 0 \
+                            and d_hits > 0 else "cold"
+                    from ..obs import registry as obsreg
+                    obsreg.histogram(
+                        "kftpu_time_to_first_step_seconds",
+                        "train()-entry to first completed step, by "
+                        "start kind (cold/warm/aot)",
+                        labels=("start",)).labels(
+                            start=start_kind).observe(t_first)
+                    if tracer is not None:
+                        tracer.event("first-step",
+                                     start_kind=start_kind,
+                                     seconds=round(t_first, 3),
+                                     backend_compiles=d_compiles,
+                                     cache_hits=d_hits, step=step + 1)
+                    first_step_s = t_first
+                else:
+                    state, metrics = step_fn(state, batch)
                 window += 1
                 # checkpoint saves are their own sync point (orbax fetches
                 # the state), so close the timing window first
@@ -683,6 +827,8 @@ def train(
         final_metrics=last_metrics,
         preempted=preempted,
         first_window_s=summary.get("first_window_s", 0.0),
+        time_to_first_step_s=first_step_s,
+        start_kind=start_kind,
     )
 
 
@@ -717,6 +863,19 @@ def main(argv=None) -> int:
     p.add_argument("--obs-metrics-port", type=int, default=None,
                    help="serve this worker's /metrics here (defaults to "
                         "$KFTPU_OBS_METRICS_PORT or off)")
+    p.add_argument("--aot", default=None,
+                   action=argparse.BooleanOptionalAction,
+                   help="AOT warm start: load the keyed serialized step "
+                        "executable from --aot-dir (skipping XLA "
+                        "entirely on rebind/resize) or compile+export "
+                        "it on first bind; falls back to the persistent "
+                        "compile cache, then a fresh compile (defaults "
+                        "to $KFTPU_AOT or off — docs/operations.md "
+                        "'Warm starts and the compile cache')")
+    p.add_argument("--aot-dir", default=None,
+                   help="where the serialized step executables live "
+                        "(defaults to $KFTPU_AOT_DIR or "
+                        "<checkpointDir>/.jax-aot-executables)")
     p.add_argument("--sync-every", type=int, default=10,
                    help="host-sync (and metric-fetch) interval in steps")
     p.add_argument("--data-dir",
@@ -799,7 +958,8 @@ def main(argv=None) -> int:
         scale_lr_by_batch=args.scale_lr_by_batch,
         eval_every=args.eval_every, eval_batches=args.eval_batches,
         eval_data_dir=args.eval_data_dir,
-        weight_update=args.weight_update)
+        weight_update=args.weight_update,
+        aot=args.aot, aot_dir=args.aot_dir)
     log.info("done: %d steps, %.1f examples/sec", result.steps,
              result.examples_per_sec)
     return PREEMPTED_EXIT_CODE if result.preempted else 0

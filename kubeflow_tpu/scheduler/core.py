@@ -246,14 +246,19 @@ def _place_degraded(inventory: SliceInventory, req: JobRequest,
 
 def plan(queued: list[JobRequest], bound: list,
          inventory: SliceInventory, config: SchedulerConfig,
-         avoid_cells: Optional[dict] = None) -> Plan:
+         avoid_cells: Optional[dict] = None,
+         prefer_cells: Optional[set] = None) -> Plan:
     """Pure planning over a pre-occupied inventory. ``bound`` is
     [(JobRequest, Placement)] for every currently bound gang (their cells
     already occupied in ``inventory``). ``avoid_cells`` maps a job key to
     cells ITS placement must keep clear of — the suspect-host exclusion:
     a job evacuating a flaky host must not be re-placed onto it even
-    while the host is still formally schedulable. Mutates the inventory
-    to reflect its own decisions (callers pass a throwaway rebuild)."""
+    while the host is still formally schedulable. ``prefer_cells`` are
+    the advertised warm-pod slots (scheduler/warmpool.py): placements
+    covering them adopt a pre-initialized pod, so ties tip toward them
+    (preference only — never worth a worse fragmentation cut). Mutates
+    the inventory to reflect its own decisions (callers pass a
+    throwaway rebuild)."""
     out = Plan()
     avoid_cells = avoid_cells or {}
     live_bound = list(bound)
@@ -270,7 +275,8 @@ def plan(queued: list[JobRequest], bound: list,
             continue
         req_avoid = reserved | avoid_cells.get(req.key, set())
         placement = inventory.place_gang(req.topology, req.num_slices,
-                                         avoid=req_avoid or None)
+                                         avoid=req_avoid or None,
+                                         prefer=prefer_cells)
         if placement is None and avoid_cells.get(req.key):
             # suspect exclusion is PREFERENCE, not a constraint: when
             # no placement clear of the suspect exists (single-pool
@@ -279,7 +285,8 @@ def plan(queued: list[JobRequest], bound: list,
             # reservation, which must never be violated
             placement = inventory.place_gang(req.topology,
                                              req.num_slices,
-                                             avoid=reserved or None)
+                                             avoid=reserved or None,
+                                             prefer=prefer_cells)
         if placement is not None:
             inventory.bind(req.key, placement)
             out.binds.append((req, placement))
@@ -773,8 +780,16 @@ class SliceScheduler(Reconciler):
                     avoid_cells[req.key] = suspect_cells
         self._note_queued(queued, manifests)
         inventory.carve_down()
+        # warm-pod pools (scheduler/warmpool.py): the slots advertised
+        # LAST pass are this pass's placement preference — a bind that
+        # lands on one adopts a pre-initialized pod instead of cold-
+        # starting, so ties tip toward them
+        from . import warmpool
+        warm_slots = warmpool.slots_of(client) \
+            if self.config.warm_pods > 0 else []
+        prefer = warmpool.slot_cells(warm_slots, inventory) or None
         decisions = plan(queued, bound, inventory, self.config,
-                         avoid_cells=avoid_cells)
+                         avoid_cells=avoid_cells, prefer_cells=prefer)
         # metrics/events fire AFTER their patch succeeded (the same
         # invariant as the operator's gang-restart counter): a transient
         # apiserver error requeues the whole pass, and the retry must
@@ -794,6 +809,12 @@ class SliceScheduler(Reconciler):
                               queue=victim.queue, chips=victim.chips)
         now = time.time()
         for req, placement in decisions.binds:
+            if warm_slots:
+                # stamp the adopted warm slots into the binding: the
+                # operator retires exactly these pre-initialized pods
+                # and marks the gang warm-started
+                placement.warm_hosts = warmpool.covered_slots(
+                    placement, warm_slots, inventory)
             # a rebind retires the job's suspect record: the new
             # placement was planned around it, evidence already folded
             extra = {SUSPECT_ANNOTATION: None} \
@@ -831,12 +852,59 @@ class SliceScheduler(Reconciler):
             if req.key in decisions.waits:
                 self._mark_queued(client, manifests[req.key],
                                   decisions.waits[req.key])
+        pending_warm = {
+            (w["pool"], int(w["host"]))
+            for _r, p in [*bound, *decisions.binds]
+            for w in (p.warm_hosts or [])}
+        self._warm_pass(client, inventory, pending_warm)
         self._export_queue_gauges(queued, bound, decisions)
         obsreg.histogram(
             "kftpu_sched_plan_seconds",
             "wall time of one cluster-wide scheduling pass").observe(
                 time.perf_counter() - t_pass)
         return Result()
+
+    def _warm_pass(self, client: KubeClient, inventory: SliceInventory,
+                   pending_warm: Optional[set] = None) -> None:
+        """Advertise up to config.warm_pods still-free hosts as warm
+        slots (post-plan occupancy: a host a bind just took is no
+        longer free) and reconcile the pre-initialized pods onto them
+        (scheduler/warmpool.py). Deterministic slot choice keeps warm
+        pods from churning across steady passes; with the knob at 0
+        any leftover pods/slots from a previous config are retired.
+        Failures downgrade to a warning — warmth is an optimization,
+        the pass must bind regardless."""
+        import os
+
+        from ..runtime.compile_cache import SHARED_CACHE_ROOT_ENV
+        from . import warmpool
+        n = max(0, int(self.config.warm_pods))
+        try:
+            slots = warmpool.free_hosts(inventory)[:n] if n else []
+            warmpool.write_slots(client, slots)
+            created, deleted = warmpool.reconcile_warm_pods(
+                client, slots, inventory,
+                cache_dir=os.environ.get(SHARED_CACHE_ROOT_ENV, ""),
+                keep=pending_warm)
+            obsreg.gauge(
+                "kftpu_sched_warm_slots",
+                "idle hosts currently advertised as warm-pod slots"
+            ).set(len(slots))
+            if created or deleted:
+                obsreg.counter(
+                    "kftpu_sched_warm_pods_total",
+                    "warm pods created/retired by the scheduler's "
+                    "warm pass", labels=("action",)).labels(
+                        action="created").inc(created)
+                obsreg.counter(
+                    "kftpu_sched_warm_pods_total",
+                    "warm pods created/retired by the scheduler's "
+                    "warm pass", labels=("action",)).labels(
+                        action="deleted").inc(deleted)
+                log.info("scheduler: warm pool now %d slots "
+                         "(+%d/-%d pods)", len(slots), created, deleted)
+        except Exception as e:  # noqa: BLE001 — warmth is optional
+            log.warning("scheduler: warm-pool pass failed: %s", e)
 
     # -------------------------------------------------------- observability
 

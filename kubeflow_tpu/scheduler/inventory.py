@@ -85,22 +85,41 @@ class Placement:
     topology: str
     num_slices: int
     slices: list[SliceRect]
+    # warm-pod slots this placement covers, stamped by the scheduler at
+    # bind time ([{"pool": p, "host": i}] — scheduler/warmpool.py): the
+    # operator adopts exactly these pre-initialized pods instead of
+    # cold-creating. Advisory: absent/extra entries never invalidate a
+    # binding (binding_matches ignores it).
+    warm_hosts: list = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.warm_hosts is None:
+            self.warm_hosts = []
 
     @property
     def chips(self) -> int:
         return sum(r.chips for r in self.slices)
 
     def to_dict(self) -> dict:
-        return {"topology": self.topology, "numSlices": self.num_slices,
-                "chips": self.chips,
-                "slices": [r.to_dict() for r in self.slices]}
+        d = {"topology": self.topology, "numSlices": self.num_slices,
+             "chips": self.chips,
+             "slices": [r.to_dict() for r in self.slices]}
+        if self.warm_hosts:
+            d["warmHosts"] = [dict(w) for w in self.warm_hosts]
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "Placement":
+        warm = []
+        for w in d.get("warmHosts", []) or []:
+            if isinstance(w, dict) and "pool" in w and "host" in w:
+                warm.append({"pool": str(w["pool"]),
+                             "host": int(w["host"])})
         return cls(topology=d["topology"],
                    num_slices=int(d.get("numSlices", 1)),
                    slices=[SliceRect.from_dict(r)
-                           for r in d.get("slices", [])])
+                           for r in d.get("slices", [])],
+                   warm_hosts=warm)
 
 
 class PoolState:
@@ -338,12 +357,16 @@ class SliceInventory:
 
     def _candidates(self, topo: SliceTopology,
                     avoid: Optional[set] = None,
-                    flexible: bool = False
+                    flexible: bool = False,
+                    prefer: Optional[set] = None
                     ) -> Iterable[tuple[tuple, SliceRect]]:
         """Every feasible rect for ONE slice, with its score key (lower =
         better). Score: maximize the pool's largest free rectangle AFTER
         the cut (fragmentation), then best-fit (least free pool space),
-        then deterministic position order."""
+        then warm-slot overlap (``prefer`` cells — a rect covering a
+        pre-initialized warm pod adopts it instead of cold-starting;
+        preference only, never worth fragmenting the pool over), then
+        deterministic position order."""
         for pname in sorted(self.pools):
             pool = self.pools[pname]
             for h, w in self._orientations(topo, flexible=flexible):
@@ -357,25 +380,31 @@ class SliceInventory:
                         pool.occupy("\x00probe", rect)
                         after = pool.max_free_rect()
                         pool.release("\x00probe")
-                        key = (-after, pool.free_chips, pname, x, y, h)
+                        warm = len(prefer & set(rect.cells())) \
+                            if prefer else 0
+                        key = (-after, pool.free_chips, -warm,
+                               pname, x, y, h)
                         yield key, rect
 
     def place_gang(self, topology: SliceTopology, num_slices: int,
                    avoid: Optional[set] = None,
-                   flexible: bool = False) -> Optional[Placement]:
+                   flexible: bool = False,
+                   prefer: Optional[set] = None) -> Optional[Placement]:
         """Greedy per-slice best-placement for a whole gang, or None when
         any slice cannot be cut. ``avoid`` is a set of (pool, x, y) cells
         placements must not touch (the head-of-line reservation —
         scheduler/core.py). ``flexible`` admits any rectangle of the
         topology's chip count, not just its canonical mesh (elastic
-        resize placement — scheduler/core.py resize paths). The
-        inventory is left UNCHANGED; callers bind() the returned
-        placement explicitly."""
+        resize placement — scheduler/core.py resize paths). ``prefer``
+        cells tip otherwise-tied candidates (warm-pod slots —
+        scheduler/warmpool.py). The inventory is left UNCHANGED; callers
+        bind() the returned placement explicitly."""
         rects: list[SliceRect] = []
         try:
             for _ in range(num_slices):
                 best = min(self._candidates(topology, avoid,
-                                            flexible=flexible),
+                                            flexible=flexible,
+                                            prefer=prefer),
                            key=lambda kr: kr[0], default=None)
                 if best is None:
                     return None

@@ -78,6 +78,12 @@ class SchedulerConfig:
     # a gang is not grown/migrated again until this long after its last
     # resize (restart-storm hysteresis; shrinks are urgent and exempt)
     grow_cooldown_s: float = 300.0
+    # warm-pod pool size: the scheduler keeps up to this many
+    # pre-initialized pods on idle hosts (scheduler/warmpool.py) and
+    # prefers placements that adopt them — rebinds/resizes/scale-ups
+    # start warm instead of cold. 0 = no warm pool (the default: warm
+    # pods hold chips idle-but-initialized, an explicit capacity trade).
+    warm_pods: int = 0
 
     def queue(self, name: str) -> QueueSpec:
         return self.queues.get(name) or QueueSpec(name)
@@ -99,7 +105,8 @@ class SchedulerConfig:
                    grow=bool(d.get("grow", True)),
                    defrag=bool(d.get("defrag", True)),
                    grow_cooldown_s=float(
-                       d.get("growCooldownSeconds", 300.0)))
+                       d.get("growCooldownSeconds", 300.0)),
+                   warm_pods=int(d.get("warmPods", 0)))
 
 
 @dataclass

@@ -1,0 +1,283 @@
+"""Warm-pod pools: pre-initialized pods on idle slice rectangles.
+
+The third rung of the warm-start stack (after the shared compile cache
+and the AOT executable export — runtime/compile_cache.py, runtime/aot.py):
+even a warm-cached restart pays pod scheduling + image pull + TPU
+runtime/backend bring-up before the first byte of cache is read. The
+scheduler therefore advertises up to ``SchedulerConfig.warm_pods`` idle
+HOSTS (free of any binding after each planning pass) as warm slots, and
+keeps one pre-initialized pod on each — backend up, cache volume
+mounted, executables prefetchable. A bind whose placement covers a warm
+slot ADOPTS it: the binding records the covered slots (``warmHosts`` on
+the Placement wire format), the operator retires the warm pod and stamps
+the gang's pods with the adoption annotation + ``KFTPU_WARM_START`` env,
+and the rebind starts against an already-initialized host instead of a
+cold one. Preemption re-binds, elastic resizes, and quarantine
+migrations all ride the same path — they are exactly the restarts the
+warm pool exists for.
+
+This module is the CONTRACT between the two processes (the binding_of
+pattern): slot wire format, warm-pod naming/labels, and the parse
+helpers both sides consume. The scheduler maintains the pods
+(scheduler/core.py warm pass); the operator adopts them
+(controllers/tpujob.py). jax-free.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional
+
+from ..api import k8s
+from ..api.topology import parse_topology
+from ..cluster.fake import TPU_RESOURCE
+from .inventory import POOL_LABEL, Placement, SliceInventory
+from . import health
+
+log = logging.getLogger(__name__)
+
+# label carried by every warm pod (the operator's adoption lookup and
+# kubectl's view of the pool)
+WARM_POD_LABEL = "kubeflow.org/warm-pod"
+# the warm pod's slot, as a JSON {"pool": p, "host": i} annotation
+WARM_HOST_ANNOTATION = "scheduling.kubeflow.org/warm-host"
+# stamped on every gang pod created over an adopted slot (audit +
+# dashboards); value = JSON list of adopted {"pool","host"} slots
+ADOPTED_ANNOTATION = "scheduling.kubeflow.org/adopted-warm-pods"
+# rendered into adopted gangs' workers: the host is pre-initialized, so
+# the AOT/compile-cache rungs see a warm filesystem (informational —
+# the worker's start_kind histogram still measures what actually ran)
+WARM_START_ENV = "KFTPU_WARM_START"
+
+# where warm pods and the slots ConfigMap live (the scheduler's own
+# namespace — warm pods are cluster infrastructure, not job children)
+WARM_POOL_NAMESPACE = "kubeflow"
+SLOTS_CONFIG_MAP = "tpu-warm-pool"
+SLOTS_KEY = "slots.json"
+
+
+def warm_pod_name(pool: str, host: int) -> str:
+    return f"warm-{pool}-h{host}"
+
+
+def slots_of(client) -> list[dict]:
+    """Parse the advertised warm slots; [] when absent/malformed (a
+    corrupt advertisement only costs warmth, never a pass)."""
+    cm = client.get_or_none("v1", "ConfigMap", WARM_POOL_NAMESPACE,
+                            SLOTS_CONFIG_MAP)
+    if cm is None:
+        return []
+    try:
+        slots = json.loads((cm.get("data") or {}).get(SLOTS_KEY, "") or
+                           "[]")
+    except ValueError:
+        return []
+    out = []
+    for s in slots if isinstance(slots, list) else []:
+        try:
+            out.append({"pool": str(s["pool"]), "host": int(s["host"])})
+        except (KeyError, TypeError, ValueError):
+            continue   # one malformed slot must not cost the pass
+    return out
+
+
+def slot_cells(slots: list[dict], inventory: SliceInventory) -> set:
+    """Every cell the advertised slots cover — the placement-preference
+    set plan() nudges binds toward (adoption beats a cold rectangle)."""
+    cells: set = set()
+    for s in slots:
+        pool = inventory.pools.get(s["pool"])
+        if pool is None:
+            continue
+        cells |= set(health.host_cells(s["pool"], pool.topology,
+                                       s["host"]))
+    return cells
+
+
+def covered_slots(placement: Placement, slots: list[dict],
+                  inventory: SliceInventory) -> list[dict]:
+    """The advertised slots a placement's rects overlap — what the
+    scheduler stamps into the binding's ``warmHosts`` so the operator
+    knows exactly which warm pods this gang adopts."""
+    placed = {c for r in placement.slices for c in r.cells()}
+    out = []
+    for s in slots:
+        pool = inventory.pools.get(s["pool"])
+        if pool is None:
+            continue
+        cells = set(health.host_cells(s["pool"], pool.topology,
+                                      s["host"]))
+        if cells & placed:
+            out.append(dict(s))
+    return out
+
+
+def build_warm_pod(pool: str, host: int, topology_name: str,
+                   image: str = "ghcr.io/kubeflow-tpu/worker:v0.1.0",
+                   cache_dir: str = "",
+                   node_name: str = "") -> dict:
+    """The pre-initialized pod for one slot: pinned to the slot's pool
+    AND (when the inventory can name it) the slot's exact node — the
+    pool selector alone would let kube park the pod on a different
+    host, making the advertised slot a fiction — requesting the host's
+    TPU chips (initialize() needs real device access, and a
+    zero-resource pod would double-book a host a gang occupies),
+    running the prewarm entrypoint (backend init + cache mount held
+    open), carrying the slot annotation the adoption path reads. With a
+    shared cache root the tpu-compile-cache claim is mounted there so
+    the prewarm actually touches the volume a landing gang will read."""
+    try:
+        chips = parse_topology(topology_name).chips_per_host \
+            if topology_name else 0
+    except ValueError:
+        chips = 0
+    container: dict = {
+        "name": "prewarm",
+        "image": image,
+        "command": ["python", "-m", "kubeflow_tpu.runtime.bootstrap",
+                    "--prewarm"],
+        "env": ([{"name": "KFTPU_COMPILE_CACHE_DIR",
+                  "value": cache_dir}] if cache_dir else []),
+    }
+    if chips:
+        container["resources"] = {"limits": {TPU_RESOURCE: chips}}
+    spec: dict = {
+        "restartPolicy": "Never",
+        "nodeSelector": {POOL_LABEL: pool},
+        "containers": [container],
+    }
+    if node_name:
+        spec["nodeName"] = node_name
+    if cache_dir and "://" not in cache_dir:
+        container["volumeMounts"] = [{"name": "kftpu-cache",
+                                      "mountPath": cache_dir}]
+        spec["volumes"] = [{"name": "kftpu-cache",
+                            "persistentVolumeClaim":
+                            {"claimName": "tpu-compile-cache"}}]
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "name": warm_pod_name(pool, host),
+            "namespace": WARM_POOL_NAMESPACE,
+            "labels": {WARM_POD_LABEL: "true"},
+            "annotations": {WARM_HOST_ANNOTATION: json.dumps(
+                {"pool": pool, "host": host,
+                 "topology": topology_name})},
+        },
+        "spec": spec,
+    }
+
+
+def node_for_slot(inventory: SliceInventory, pool: str,
+                  host: int) -> str:
+    """The node name owning the slot's cells, or "" when the inventory
+    cannot say (sim-built inventories carry no node map) — the warm
+    pod then degrades to the pool selector alone."""
+    pstate = inventory.pools.get(pool)
+    if pstate is None:
+        return ""
+    cells = set(health.host_cells(pool, pstate.topology, host))
+    for node, owned in inventory.cells_by_node.items():
+        if cells <= owned:
+            return node
+    return ""
+
+
+def list_warm_pods(client) -> list[dict]:
+    return client.list("v1", "Pod", WARM_POOL_NAMESPACE,
+                       selector={WARM_POD_LABEL: "true"})
+
+
+def reconcile_warm_pods(client, slots: list[dict],
+                        inventory: SliceInventory,
+                        cache_dir: str = "",
+                        keep: Optional[set] = None) -> tuple[int, int]:
+    """Make the live warm pods match the advertised slots: create a pod
+    per slot that lacks one, delete pods whose slot is no longer
+    advertised (the host got bound, went down, or the knob shrank).
+    ``keep`` is the set of (pool, host) slots named by a live binding's
+    warmHosts — those pods are PENDING ADOPTION by the operator, which
+    runs after this pass; retiring them here would race the adoption
+    into a cold create. Write-on-change; returns (created, deleted)."""
+    from ..cluster.client import NotFoundError
+    keep = keep or set()
+    wanted = {(s["pool"], s["host"]): s for s in slots}
+    have: dict[tuple, dict] = {}
+    deleted = 0
+    for pod in list_warm_pods(client):
+        try:
+            meta = json.loads(k8s.annotations_of(pod).get(
+                WARM_HOST_ANNOTATION, "") or "{}")
+            slot_key = (str(meta["pool"]), int(meta["host"]))
+        except (KeyError, TypeError, ValueError):
+            slot_key = None
+        # a DEAD prewarm (ImagePullBackOff crash, prewarm init failure
+        # — restartPolicy Never) must not satisfy its slot: retire it
+        # so the create loop below brings a live one back, instead of
+        # the slot staying "warm" behind a corpse forever
+        dead = pod.get("status", {}).get("phase") in ("Failed",
+                                                      "Succeeded")
+        if not dead and slot_key is not None and slot_key in keep \
+                and slot_key not in wanted:
+            continue   # pending adoption: the operator retires it
+        if dead or slot_key is None or slot_key not in wanted \
+                or slot_key in have:
+            # unparseable, stale, or duplicate: retire it
+            try:
+                client.delete("v1", "Pod", WARM_POOL_NAMESPACE,
+                              k8s.name_of(pod))
+                deleted += 1
+            except NotFoundError:
+                pass
+            continue
+        have[slot_key] = pod
+    created = 0
+    for slot_key, slot in wanted.items():
+        if slot_key in have:
+            continue
+        pool = inventory.pools.get(slot["pool"])
+        topo_name = pool.topology.name if pool is not None else ""
+        client.create(build_warm_pod(
+            slot["pool"], slot["host"], topo_name, cache_dir=cache_dir,
+            node_name=node_for_slot(inventory, slot["pool"],
+                                    slot["host"])))
+        created += 1
+    return created, deleted
+
+
+def write_slots(client, slots: list[dict]) -> None:
+    """Persist the advertised slots (write-on-change: a steady-state
+    pass writes nothing)."""
+    body = json.dumps(sorted(slots, key=lambda s: (s["pool"],
+                                                   s["host"])))
+    cm = client.get_or_none("v1", "ConfigMap", WARM_POOL_NAMESPACE,
+                            SLOTS_CONFIG_MAP)
+    if cm is not None and (cm.get("data") or {}).get(SLOTS_KEY) == body:
+        return
+    if cm is None:
+        if not slots:
+            return   # feature off and never on: no empty CM litter
+        obj = k8s.make("v1", "ConfigMap", SLOTS_CONFIG_MAP,
+                       WARM_POOL_NAMESPACE)
+        obj["data"] = {SLOTS_KEY: body}
+        client.create(obj)
+    else:
+        client.patch("v1", "ConfigMap", WARM_POOL_NAMESPACE,
+                     SLOTS_CONFIG_MAP, {"data": {SLOTS_KEY: body}})
+
+
+def free_hosts(inventory: SliceInventory) -> list[dict]:
+    """Hosts whose every cell is free (no binding, not down) — the
+    candidate warm slots, deterministically ordered (sorted pools,
+    ascending host index) so repeated passes advertise the same slots
+    and warm pods never churn while the cluster is steady."""
+    out = []
+    for pname in sorted(inventory.pools):
+        pool = inventory.pools[pname]
+        for host in range(pool.topology.num_hosts):
+            cells = health.host_cells(pname, pool.topology, host)
+            if all(0 <= x < pool.rows and 0 <= y < pool.cols
+                   and not pool.grid[x][y] for _p, x, y in cells):
+                out.append({"pool": pname, "host": host})
+    return out

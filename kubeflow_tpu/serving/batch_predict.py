@@ -98,6 +98,13 @@ def main(argv=None) -> int:
     p.add_argument("--input-dtype", default=None)
     args = p.parse_args(argv)
 
+    # before the servable's first jit: a batch-predict job over a big
+    # input set restarts often (spot nodes) and re-pays the per-bucket
+    # compile every time without the persistent cache (no-op when
+    # KFTPU_COMPILE_CACHE_DIR is unset — runtime/compile_cache.py)
+    from ..runtime.compile_cache import enable_compilation_cache
+    enable_compilation_cache()
+
     repo = ModelRepository()
     servable = repo.load(args.model_name, args.model_type,
                          checkpoint_dir=args.model_path or None)

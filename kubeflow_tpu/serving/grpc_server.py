@@ -111,6 +111,13 @@ class GrpcPredictServer:
                  port: int = 9000, max_workers: int = 8):
         if not HAVE_GRPC:
             raise RuntimeError("grpcio is not available")
+        # serving cold-start: the first Predict per batch bucket pays an
+        # XLA compile unless the persistent cache is live — a gRPC-only
+        # deployment (no REST main()) must wire it too, BEFORE the first
+        # request can jit (runtime/compile_cache.py; no-op when no
+        # KFTPU_COMPILE_CACHE_DIR, idempotent beside http_server's call)
+        from ..runtime.compile_cache import enable_compilation_cache
+        enable_compilation_cache()
         self.model_server = model_server
         self.host, self.port = host, port
         self.max_workers = max_workers

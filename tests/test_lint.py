@@ -228,6 +228,99 @@ def test_obs_knobs_are_plumbed_end_to_end():
     assert TrainingJob.from_manifest(ex).obs_spec == ospec
 
 
+def test_warm_start_knobs_are_plumbed_end_to_end():
+    """Every WarmStartSpec field must be representable end-to-end, the
+    same rule as input/observability: parsed+serialized through the
+    TPUJob spec's ``warmStart`` block (api/trainingjob.py), rendered
+    into worker env by the controller, consumed by the worker's
+    train()/CLI surface, and named in the manifests CRD schema +
+    example builder — and the shared-cache / warm-pool contracts must
+    connect their two sides — so a future warm-start knob can't
+    silently exist in one layer only."""
+    import dataclasses
+    import inspect
+
+    from kubeflow_tpu.api.trainingjob import TrainingJob, WarmStartSpec
+    from kubeflow_tpu.manifests.training import tpu_job_simple
+    from kubeflow_tpu.runtime import worker
+
+    def src(*rel):
+        with open(os.path.join(REPO_ROOT, "kubeflow_tpu", *rel)) as f:
+            return f.read()
+
+    knobs = dataclasses.fields(WarmStartSpec)
+    assert knobs, "expected the aot/aotDir knobs"
+    worker_src = src("runtime", "worker.py")
+    controller_src = src("controllers", "tpujob.py")
+    manifests_src = src("manifests", "training.py")
+    for knob in knobs:
+        # worker: a CLI flag and the env fallback (env names are owned
+        # by runtime/aot.py and asserted below)
+        assert knob.metadata["cli"] in worker_src, knob.name
+        # controller: rendered into worker env (via WarmStartSpec.to_env)
+        assert "warm_start.to_env" in controller_src
+        # manifests: the CRD schema names the spec field
+        assert f'"{knob.metadata["spec_field"]}"' in manifests_src, \
+            knob.name
+    # env names are the runtime/aot.py constants on both sides
+    from kubeflow_tpu.runtime.aot import AOT_DIR_ENV, AOT_ENABLE_ENV
+    assert {k.metadata["env"] for k in knobs} == \
+        {AOT_ENABLE_ENV, AOT_DIR_ENV}
+    assert "AOT_ENABLE_ENV" in worker_src
+    assert "AOT_DIR_ENV" in worker_src or AOT_DIR_ENV in worker_src
+    # train() consumes both knobs by their canonical names
+    train_params = inspect.signature(worker.train).parameters
+    assert "aot" in train_params
+    assert "aot_dir" in train_params
+
+    # the shared-cache service: the operator resolves the namespace dir
+    # through the ONE helper pair in runtime/compile_cache.py
+    assert "SHARED_CACHE_ROOT_ENV" in controller_src
+    assert "namespace_cache_dir" in controller_src
+    # the warm-pool contract: scheduler maintains, operator adopts,
+    # both through scheduler/warmpool.py (the binding_of pattern)
+    core_src = src("scheduler", "core.py")
+    for consumer, where in (("warmpool.slots_of", core_src),
+                            ("warmpool.covered_slots", core_src),
+                            ("warmpool.reconcile_warm_pods", core_src),
+                            ("warmpool.warm_pod_name", controller_src),
+                            ("warmpool.ADOPTED_ANNOTATION",
+                             controller_src)):
+        assert consumer in where, f"{consumer} not consumed"
+
+    # spec wire round-trip: to_dict → from_manifest → identical spec,
+    # and the controller env render matches the declared names
+    wspec = WarmStartSpec(aot=True, aot_dir="/ckpt/aot")
+    manifest = {
+        "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": "t", "namespace": "ns"},
+        "spec": {"replicaSpecs": {"TPU": {
+            "tpuTopology": "v5e-8",
+            "template": {"spec": {"containers": [{"name": "c"}]}}}},
+            "warmStart": wspec.to_dict()},
+    }
+    job = TrainingJob.from_manifest(manifest)
+    assert job.warm_start == wspec
+    assert job.to_manifest()["spec"]["warmStart"] == wspec.to_dict()
+    assert wspec.to_env() == {"KFTPU_AOT": "1",
+                              "KFTPU_AOT_DIR": "/ckpt/aot"}
+    assert WarmStartSpec(aot=False).to_env() == {"KFTPU_AOT": "0"}
+
+    # admission rejects garbage (a typo'd knob must fail at apply)
+    import pytest
+    with pytest.raises(ValueError, match="aot"):
+        WarmStartSpec.from_dict({"aot": "yes"})
+    with pytest.raises(ValueError, match="unknown"):
+        WarmStartSpec.from_dict({"aotdir": "/x"})
+    with pytest.raises(ValueError, match="mapping"):
+        WarmStartSpec.from_dict(["/x"])
+
+    # example builder renders the block end to end
+    ex = next(o for o in tpu_job_simple(aot=True, aot_dir="/ckpt/aot")
+              if o["kind"] == "TPUJob")
+    assert TrainingJob.from_manifest(ex).warm_start == wspec
+
+
 def test_scheduling_policy_is_plumbed_end_to_end():
     """Every SchedulingPolicy field must be representable end-to-end,
     the same rule as runPolicy/input: parsed+serialized through the

@@ -1535,6 +1535,254 @@ def bench_obs(t_start: float | None = None) -> dict:
     }
 
 
+def bench_goodput(t_start: float | None = None) -> dict:
+    """Goodput ledger + flight recorder acceptance (ISSUE 10).
+
+    Five parts, all over ONE shared span sink (the deployment shape —
+    scheduler, operator, and in-process workers appending to one JSONL):
+
+    1. **Chaos 5-fault soak** (cluster/chaos.py): pod kill, 5xx burst,
+       watch drop, checkpoint truncation, hung chief — then the ledger
+       reconstructed from the job's spans alone. Asserted: the
+       categories sum to wall-clock within 2%, the span-derived
+       restart-recompute STEPS equal the soak's known re-executed steps
+       (executed minus final progress — ground truth the soak counts
+       itself), and the hung-chief scenario left stall badput.
+    2. **Preemption soak** (scheduler/soak.py): victim preempted at a
+       checkpoint boundary re-binds and finishes; its ledger must show
+       queue-wait badput from BOTH waits and ZERO recompute (the forced
+       checkpoint means resume loses nothing).
+    3. **Flight recorder under SIGTERM**: a real train() preempted
+       mid-run by a timer-delivered SIGTERM; the signal handler must
+       dump the step-time ring to the sink (reason=sigterm) before the
+       graceful exit — the evidence path for workers the stall watchdog
+       tears down.
+    4. **Scrape + dashboard surfaces**: the chaos job's final ledger
+       exported as kftpu_job_goodput_ratio / kftpu_job_badput_seconds_
+       total, visible on a live /metrics; the dashboard's
+       /api/obs/goodput endpoints serve the per-job decomposition and
+       the cluster chip-hour rollup from the same sink.
+    5. **Sim comparability** (scheduler/sim.py): the policy arms report
+       goodput tables in the SAME category vocabulary, so a sim arm's
+       decomposition reads against the real cluster's.
+
+    Env knobs (goodput_bench_smoke shrinks the geometry):
+    KFTPU_BENCH_GOODPUT_{SEEDS,JOBS,FLIGHT_STEPS}."""
+    import os
+    import shutil
+    import signal
+    import tempfile
+    import threading
+    import urllib.request
+
+    t_start = time.perf_counter() if t_start is None else t_start
+    from kubeflow_tpu.api import k8s as k8s_api
+    from kubeflow_tpu.obs import goodput as gp
+    from kubeflow_tpu.obs.trace import TRACE_ID_ANNOTATION, load_spans
+
+    tmp = tempfile.mkdtemp(prefix="kftpu-goodput-")
+    sink = os.path.join(tmp, "trace.jsonl")
+    saved_env = {k: os.environ.get(k)
+                 for k in ("KFTPU_SPAN_PATH", "KFTPU_TRACE_ID")}
+    os.environ["KFTPU_SPAN_PATH"] = sink
+    os.environ.pop("KFTPU_TRACE_ID", None)
+    checks: dict = {}
+    try:
+        # -- 1) chaos 5-fault soak → ledger ------------------------------
+        from kubeflow_tpu.cluster.chaos import ChaosSoak, SoakFault
+        faults = [SoakFault(2, "pod-kill"), SoakFault(3, "api-burst"),
+                  SoakFault(4, "watch-drop"), SoakFault(5, "truncate-ckpt"),
+                  SoakFault(6, "hung-chief")]
+        t0 = time.perf_counter()
+        chaos_report = ChaosSoak(workdir=os.path.join(tmp, "chaos"),
+                                 faults=faults, total_steps=8,
+                                 checkpoint_every=2).run()
+        chaos_ledger = gp.ledger_for(sink, chaos_report.get("trace_id", ""))
+        chaos_known_re = chaos_report["executed_steps"] - \
+            chaos_report["final_step"]
+        chaos = {
+            "outcome": chaos_report["outcome"],
+            "ledger": chaos_ledger,
+            "executed_steps": chaos_report["executed_steps"],
+            "final_step": chaos_report["final_step"],
+            "known_recomputed_steps": chaos_known_re,
+            "soak_wall_s": round(time.perf_counter() - t0, 1),
+        }
+        checks["chaos_categories_sum_to_wall"] = \
+            gp.categories_sum_ok(chaos_ledger)
+        checks["chaos_recompute_matches_soak"] = bool(
+            chaos_report["outcome"] == "succeeded"
+            and chaos_ledger["stepsRecomputed"] == chaos_known_re)
+        # the hung-chief fault must leave stall badput in the ledger
+        checks["chaos_stall_badput_present"] = \
+            chaos_ledger["badputSeconds"][gp.BADPUT_STALL] > 0
+
+        # -- 2) preemption soak → ledger ---------------------------------
+        from kubeflow_tpu.scheduler.soak import PreemptionSoak
+        t0 = time.perf_counter()
+        psoak = PreemptionSoak(workdir=os.path.join(tmp, "sched"))
+        p_report = psoak.run()
+        victim = p_report.get("victim_manifest") or {}
+        victim_tid = k8s_api.annotations_of(victim).get(
+            TRACE_ID_ANNOTATION, "")
+        p_ledger = gp.ledger_for(sink, victim_tid)
+        p_known_re = p_report.get("victim_executed_steps", 0) - \
+            psoak.total_steps
+        preempt = {
+            "outcome": p_report["outcome"],
+            "ledger": p_ledger,
+            "victim_executed_steps":
+                p_report.get("victim_executed_steps"),
+            "known_recomputed_steps": p_known_re,
+            "soak_wall_s": round(time.perf_counter() - t0, 1),
+        }
+        checks["preempt_categories_sum_to_wall"] = \
+            gp.categories_sum_ok(p_ledger)
+        checks["preempt_recompute_matches_soak"] = bool(
+            p_report["outcome"] == "succeeded"
+            and p_ledger["stepsRecomputed"] == max(0, p_known_re))
+        checks["preempt_queue_wait_badput_present"] = \
+            p_ledger["badputSeconds"][gp.BADPUT_QUEUE_WAIT] > 0
+
+        # -- 3) flight recorder under SIGTERM ----------------------------
+        from kubeflow_tpu.runtime.worker import train
+        # a benign outer handler: if the timer's SIGTERM lands in the
+        # sliver between train() restoring the previous handler and the
+        # cancel below, it must not kill the bench process
+        prev_handler = signal.signal(signal.SIGTERM, lambda *a: None)
+        os.environ["KFTPU_TRACE_ID"] = "goodput-flight"
+        flight_steps = _env_int("KFTPU_BENCH_GOODPUT_FLIGHT_STEPS", 50000)
+        done = threading.Event()
+
+        def kill_after_windows(min_step: int = 6,
+                               deadline_s: float = 120.0) -> None:
+            # preempt only once the ring HAS windows (watching the span
+            # sink): a fixed timer lands inside the first compile and
+            # dumps an empty ring — present but evidence-free
+            end = time.monotonic() + deadline_s
+            while time.monotonic() < end and not done.is_set():
+                if any(s.get("name") == "window"
+                       and (s.get("attrs") or {}).get("step", 0)
+                       >= min_step
+                       for s in load_spans(sink,
+                                           trace_id="goodput-flight")):
+                    break
+                time.sleep(0.1)
+            if not done.is_set():
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        killer = threading.Thread(target=kill_after_windows, daemon=True)
+        try:
+            t0 = time.perf_counter()
+            killer.start()
+            fr_result = train(workload="transformer", steps=flight_steps,
+                              global_batch=8, sync_every=1,
+                              checkpoint_dir=os.path.join(tmp, "flight"),
+                              checkpoint_every=1000,
+                              handle_sigterm=True, workload_kwargs={})
+        finally:
+            done.set()
+            signal.signal(signal.SIGTERM, prev_handler)
+            os.environ.pop("KFTPU_TRACE_ID", None)
+        dumps = [s for s in load_spans(sink, trace_id="goodput-flight")
+                 if s.get("name") == "flight-record"]
+        flight = {
+            "preempted": fr_result.preempted,
+            "steps_before_sigterm": fr_result.steps,
+            "dumps": len(dumps),
+            "dump_reason": dumps[0].get("attrs", {}).get("reason")
+            if dumps else None,
+            "ring_windows": len(dumps[0].get("attrs", {}).get(
+                "records", [])) if dumps else 0,
+            "in_progress_stage": dumps[0].get("attrs", {}).get(
+                "inProgress", {}).get("stage") if dumps else None,
+            "wall_s": round(time.perf_counter() - t0, 1),
+        }
+        checks["flight_record_dump_present"] = bool(
+            dumps and flight["dump_reason"] == "sigterm"
+            and fr_result.preempted)
+        checks["flight_record_has_stage_breakdown"] = bool(
+            dumps and flight["ring_windows"] > 0 and all(
+                k in dumps[0]["attrs"]["records"][-1]
+                for k in ("data_s", "h2d_s", "dispatch_s",
+                          "device_wait_s")))
+
+        # -- 4) gauges on /metrics + dashboard endpoints -----------------
+        gp.export_job_ledger("kubeflow", "chaos-soak", chaos_ledger)
+        from kubeflow_tpu.obs.http import ObsServer
+        srv = ObsServer(host="127.0.0.1")
+        port = srv.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics") as resp:
+                text = resp.read().decode()
+        finally:
+            srv.stop()
+        checks["ledger_gauges_on_metrics"] = (
+            "kftpu_job_goodput_ratio" in text
+            and "kftpu_job_badput_seconds_total" in text)
+
+        from kubeflow_tpu.cluster.fake import FakeCluster
+        from kubeflow_tpu.webapps.dashboard import build_dashboard_app
+        dash_ok = rollup = None
+        if victim:
+            manifest = {k: v for k, v in victim.items() if k != "status"}
+            for stale in ("uid", "resourceVersion", "creationTimestamp"):
+                manifest.get("metadata", {}).pop(stale, None)
+            cluster = FakeCluster()
+            cluster.create(manifest)
+            app = build_dashboard_app(cluster)
+            status, body = app.dispatch(
+                "GET", f"/api/obs/goodput/{psoak.namespace}/victim", None)
+            dash_ok = bool(
+                status == 200 and "ledger" in body
+                and set(body["ledger"]["badputSeconds"])
+                == set(gp.BADPUT_CATEGORIES))
+            status, rollup = app.dispatch("GET", "/api/obs/goodput", None)
+            rollup = rollup.get("chipHours") if status == 200 else None
+        checks["dashboard_endpoint_ok"] = bool(dash_ok)
+        checks["cluster_rollup_ok"] = bool(
+            rollup and rollup["total"] > 0)
+
+        # -- 5) sim arms report the same vocabulary ----------------------
+        from kubeflow_tpu.scheduler.sim import compare_policies
+        seeds = list(range(_env_int("KFTPU_BENCH_GOODPUT_SEEDS", 3)))
+        n_jobs = _env_int("KFTPU_BENCH_GOODPUT_JOBS", 16)
+        t0 = time.perf_counter()
+        sim_table = compare_policies(seeds, n_jobs=n_jobs)
+        sim = {policy: {"goodput_fraction": row["goodput_fraction"],
+                        "badput_chip_ticks": row["badput_chip_ticks"]}
+               for policy, row in sim_table.items()}
+        sim["sim_wall_s"] = round(time.perf_counter() - t0, 1)
+        checks["sim_categories_match_ledger"] = all(
+            set(row["badput_chip_ticks"]) == set(gp.BADPUT_CATEGORIES)
+            for policy, row in sim_table.items())
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    return {
+        "metric": "goodput_ledger_decomposition",
+        "value": chaos_ledger["goodputRatio"],
+        "unit": "chaos_soak_goodput_ratio",
+        "vs_baseline": None,
+        "mfu": None,
+        "extras": {
+            "chaos": chaos,
+            "preemption": preempt,
+            "flight_recorder": flight,
+            "sim": sim,
+            **checks,
+            "all_checks_ok": all(checks.values()),
+        },
+        "_flops_per_chip": 0.0,
+    }
+
+
 def bench_warmstart_child() -> dict:
     """One warm-start arm, run in its OWN process (the whole point is
     process-fresh startup): train a few steps of the small transformer
@@ -1731,7 +1979,7 @@ def main(argv=None) -> int:
                    choices=["all", "resnet", "resnet-fused", "lm",
                             "lm-long", "serving", "fused-blocks",
                             "weight-update", "chaos", "input", "sched",
-                            "health", "obs", "warmstart",
+                            "health", "obs", "goodput", "warmstart",
                             "warmstart-child"])
     p.add_argument("--routing-out",
                    default="bench-matrix/fused_routing_measured.json",
@@ -1798,6 +2046,8 @@ def main(argv=None) -> int:
         row = bench_health(t_start=t_start)
     elif args.mode == "obs":
         row = bench_obs(t_start=t_start)
+    elif args.mode == "goodput":
+        row = bench_goodput(t_start=t_start)
     elif args.mode == "warmstart-child":
         row = bench_warmstart_child()
     else:

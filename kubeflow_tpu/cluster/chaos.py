@@ -435,14 +435,20 @@ class ChaosSoak:
                 for e in pod["spec"]["containers"][0].get("env", [])}
 
     def _run_segment(self, env_map: dict, target: int):
+        from ..obs.trace import adopt_trace_env
         from ..runtime.worker import train  # lazy: pulls in jax
-        return train(
-            workload="transformer", steps=target,
-            global_batch=self.global_batch, sync_every=1,
-            checkpoint_dir=env_map.get("KFTPU_CHECKPOINT_DIR"),
-            checkpoint_every=self.checkpoint_every,
-            resume_from=env_map.get("KFTPU_RESUME_FROM"),
-            seed=self.seed, handle_sigterm=False, workload_kwargs={})
+        # adopt the operator-rendered trace contract for the segment:
+        # the in-process "worker" reads the SAME env a real pod would,
+        # so its window/ckpt spans stitch onto the job's trace id and
+        # the goodput ledger can account the whole soak (ISSUE 10)
+        with adopt_trace_env(env_map):
+            return train(
+                workload="transformer", steps=target,
+                global_batch=self.global_batch, sync_every=1,
+                checkpoint_dir=env_map.get("KFTPU_CHECKPOINT_DIR"),
+                checkpoint_every=self.checkpoint_every,
+                resume_from=env_map.get("KFTPU_RESUME_FROM"),
+                seed=self.seed, handle_sigterm=False, workload_kwargs={})
 
     def _heartbeat(self, cluster, chief: str, step: int,
                    stale_by_s: float = 0.0) -> None:
@@ -506,7 +512,8 @@ class ChaosSoak:
                           not isinstance(f, SoakFault) else f
                           for f in self.faults), key=lambda f: f.at_step)
         report: dict = {"injected": [], "restart_reasons": [],
-                        "segments": 0, "outcome": "timeout"}
+                        "segments": 0, "executed_steps": 0,
+                        "outcome": "timeout"}
         deadline = time.monotonic() + self.wall_budget_s
         chief = f"{self.job_name}-worker-0-0"
         reached = 0
@@ -544,6 +551,11 @@ class ChaosSoak:
             result = self._run_segment(self._chief_env(cluster, chief),
                                        target)
             report["segments"] += 1
+            # steps this segment actually EXECUTED (its windows): the
+            # soak's ground truth for restart-recompute — executed
+            # minus final progress = steps replayed after restores,
+            # which the goodput ledger must reproduce from spans alone
+            report["executed_steps"] += int(result.steps)
             reached = max(reached, target)
             self._heartbeat(cluster, chief, reached)
             if pending and pending[0].at_step <= reached:
@@ -562,6 +574,9 @@ class ChaosSoak:
         if job is not None:
             report["gang_restarts"] = int(k8s.annotations_of(job).get(
                 RESTART_COUNT_ANNOTATION, "0"))
+            from ..obs.trace import TRACE_ID_ANNOTATION
+            report["trace_id"] = k8s.annotations_of(job).get(
+                TRACE_ID_ANNOTATION, "")
         report["final_step"] = reached
         report["checkpoint_dir"] = ckpt_dir
         report["api_calls"] = chaos.calls

@@ -66,7 +66,14 @@ def ensure_trace_id(client: KubeClient, manifest: dict) -> dict:
     if k8s.annotations_of(manifest).get(TRACE_ID_ANNOTATION):
         return manifest
     # uid-derived: concurrent minters agree without coordination
-    tid = mint_trace_id(str(manifest.get("metadata", {}).get("uid", "")))
+    # uid + identity: concurrent minters still agree (both read the same
+    # manifest), while jobs whose uids collide across clusters sharing
+    # one span sink (FakeCluster soaks both hand out uid-1; a restored
+    # etcd could too) never merge their streams in the goodput ledger
+    meta = manifest.get("metadata", {})
+    tid = mint_trace_id(f"{meta.get('uid', '')}:"
+                        f"{k8s.namespace_of(manifest, 'default')}/"
+                        f"{k8s.name_of(manifest)}")
     try:
         return client.patch(*k8s.key_of(manifest), {
             "metadata": {"annotations": {TRACE_ID_ANNOTATION: tid}}})

@@ -53,7 +53,8 @@ from ..api.trainingjob import (API_VERSIONS,
 from ..cluster.client import KubeClient, NotFoundError
 from ..cluster.fake import POD_GROUP_LABEL, TPU_RESOURCE
 from ..obs import registry as obsreg
-from ..obs.trace import SPAN_PATH_ENV, TRACE_ID_ANNOTATION, TRACE_ID_ENV
+from ..obs.trace import (SPAN_MAX_BYTES_ENV, SPAN_PATH_ENV,
+                         TRACE_ID_ANNOTATION, TRACE_ID_ENV)
 from ..scheduler import health, warmpool
 from ..scheduler.inventory import POOL_LABEL, Placement, SliceRect
 from .runtime import (Key, Reconciler, Result, ensure_trace_id,
@@ -339,6 +340,11 @@ class TrainingJobReconciler(Reconciler):
             # that ever stalled
             self._prune_job_state(namespace, name)
         if phase is None:
+            # job object gone: its final-ledger series go with it (the
+            # same rule as the phase gauge — a deleted job must not
+            # export its decomposition forever)
+            from ..obs.goodput import remove_job_ledger
+            remove_job_ledger(namespace, name)
             self._exported_phase.pop(key, None)
             return
         g.labels(namespace=namespace, name=name, kind=self.kind,
@@ -619,6 +625,11 @@ class TrainingJobReconciler(Reconciler):
             env[TRACE_ID_ENV] = trace_id
         if os.environ.get(SPAN_PATH_ENV):
             env[SPAN_PATH_ENV] = os.environ[SPAN_PATH_ENV]
+        if os.environ.get(SPAN_MAX_BYTES_ENV):
+            # sink rotation cap rides along with the sink: workers
+            # appending to the shared JSONL honor the same rotation
+            # policy the control plane does (obs/trace.py)
+            env[SPAN_MAX_BYTES_ENV] = os.environ[SPAN_MAX_BYTES_ENV]
         # spec.observability → KFTPU_SPAN_PATH / KFTPU_OBS_METRICS_PORT:
         # the worker's span sink and its own /metrics port
         env.update(job.obs_spec.to_env())
@@ -1181,8 +1192,42 @@ class TrainingJobReconciler(Reconciler):
                 "training jobs reaching a terminal condition",
                 labels=("kind", "condition")).labels(
                     kind=self.kind, condition=ctype).inc()
+            self._finalize_ledger(client, fresh)
         self._export_phase((k8s.namespace_of(manifest, "default"),
                             k8s.name_of(manifest)), manifest)
+
+    def _finalize_ledger(self, client: KubeClient, manifest: dict) -> None:
+        """On the terminal transition: fold the job's span stream into
+        its final goodput ledger (obs/goodput.py) — stamped as the
+        goodput annotation so the decomposition survives span-sink
+        rotation, and exported as the kftpu_job_goodput_ratio /
+        kftpu_job_badput_seconds_total gauges. Rides the _set_condition
+        idempotence guard, so it runs exactly once per completion.
+        Best-effort by contract: accounting must never fail the job it
+        accounts for."""
+        try:
+            from ..obs.goodput import (GOODPUT_ANNOTATION,
+                                       annotation_payload,
+                                       export_job_ledger, ledger_for)
+            span_path = os.environ.get(SPAN_PATH_ENV)
+            trace_id = k8s.annotations_of(manifest).get(TRACE_ID_ANNOTATION)
+            if not span_path or not trace_id:
+                return
+            ledger = ledger_for(span_path, trace_id)
+            if not ledger["wallSeconds"]:
+                return
+            namespace = k8s.namespace_of(manifest, "default")
+            name = k8s.name_of(manifest)
+            export_job_ledger(namespace, name, ledger)
+            client.patch(*k8s.key_of(manifest), {
+                "metadata": {"annotations": {
+                    GOODPUT_ANNOTATION: annotation_payload(ledger)}}})
+            self._trace_event(manifest, "goodput-ledger",
+                              goodput_ratio=ledger["goodputRatio"],
+                              wall_seconds=ledger["wallSeconds"])
+        except Exception as e:  # noqa: BLE001 — accounting is best-effort
+            log.warning("final goodput ledger for %s failed: %s",
+                        k8s.name_of(manifest), e)
 
     def _finalize_status(self, client: KubeClient, manifest: dict,
                          pods: list[dict], *, all_running: bool) -> None:

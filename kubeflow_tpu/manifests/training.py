@@ -7,6 +7,7 @@ kubeflow/pytorch-job, kubeflow/mpi-job, kubeflow/examples/prototypes.
 from __future__ import annotations
 
 from ..api import k8s
+from ..obs.trace import SPAN_MAX_BYTES_ENV
 from ..api.trainingjob import (KF_API_VERSION_V1BETA2,
                                TPU_API_VERSION)
 from . import helpers as H
@@ -97,7 +98,8 @@ def _job_schema(specs_key: str, max_one: list[str]) -> dict:
 
 
 def _operator_deployment(namespace: str, gang_scheduling: bool,
-                         shared_cache_root: str = "") -> list[dict]:
+                         shared_cache_root: str = "",
+                         span_max_bytes: int = 0) -> list[dict]:
     sa = H.service_account("tpu-job-operator", namespace)
     role = H.cluster_role("tpu-job-operator", [
         {"apiGroups": ["tpu.kubeflow.org", "kubeflow.org"],
@@ -129,10 +131,16 @@ def _operator_deployment(namespace: str, gang_scheduling: bool,
                        # shared compile-cache service: with the root set
                        # the operator points every gang of a namespace
                        # at <root>/<namespace> on the tpu-compile-cache
-                       # volume (runtime/compile_cache.py)
-                       env=({"KFTPU_SHARED_CACHE_ROOT":
-                             shared_cache_root}
-                            if shared_cache_root else None))
+                       # volume (runtime/compile_cache.py); the span
+                       # rotation cap bounds the shared JSONL sink on
+                       # long-lived deployments (obs/trace.py — the
+                       # operator forwards it into every worker)
+                       env=({**({"KFTPU_SHARED_CACHE_ROOT":
+                                 shared_cache_root}
+                                if shared_cache_root else {}),
+                             **({SPAN_MAX_BYTES_ENV:
+                                 str(int(span_max_bytes))}
+                                if span_max_bytes else {})} or None))
     cm = H.config_map("tpu-job-operator-config", namespace, {
         "gang-scheduling": str(gang_scheduling).lower(),
         "coordinator-port": "8476",
@@ -143,17 +151,24 @@ def _operator_deployment(namespace: str, gang_scheduling: bool,
 @register("tpu-job-operator", "TPUJob CRD + the gang-scheduling operator")
 def tpu_job_operator(namespace: str = "kubeflow",
                      gang_scheduling: bool = True,
-                     shared_cache_root: str = "") -> list[dict]:
+                     shared_cache_root: str = "",
+                     span_max_bytes: int = 0) -> list[dict]:
     """``shared_cache_root`` (e.g. ``/mnt/kftpu-cache``) turns on the
     cluster-shared compile-cache service: the operator renders
     KFTPU_COMPILE_CACHE_DIR=<root>/<namespace> into every gang (one
     cache per namespace on the tpu-compile-cache volume — deploy that
     component alongside) instead of the per-job checkpoint-volume
-    default (docs/operations.md "Warm starts and the compile cache")."""
+    default (docs/operations.md "Warm starts and the compile cache").
+    ``span_max_bytes`` caps the trace-span JSONL sink: at the cap the
+    active file rotates to ``.1`` (one prior generation) so long-lived
+    deployments never grow the sink unbounded; the operator forwards
+    the cap into every worker (docs/operations.md "Goodput
+    accounting")."""
     job_crd = H.crd("tpujobs", "TPUJob", "tpu.kubeflow.org", ["v1alpha1"],
                     schema=_job_schema("replicaSpecs", ["Coordinator"]))
     return [job_crd, *_operator_deployment(namespace, gang_scheduling,
-                                           shared_cache_root)]
+                                           shared_cache_root,
+                                           span_max_bytes)]
 
 
 @register("tpu-compile-cache", "Cluster-shared XLA compile-cache volume: "

@@ -8,7 +8,12 @@ and the ``/metrics`` scrape surface (ISSUE 5).
   → worker, with every component appending JSONL spans to a shared sink
   so a job's queued → bound → running → windows → done timeline
   reconstructs end to end.
-- ``obs.http`` — ``/metrics`` + ``/healthz`` over a registry.
+- ``obs.http`` — ``/metrics`` + ``/healthz`` over a registry, with
+  mountable extra endpoints (the worker's profiler trigger).
+- ``obs.goodput`` — the goodput ledger (ISSUE 10): the span stream
+  folded into a per-job wall-clock decomposition, goodput vs named
+  badput categories; the one category vocabulary the ledger, sim, and
+  dashboard all share.
 
 jax-free and stdlib-only: the scheduler and operator processes import
 this without pulling the runtime in.
@@ -17,7 +22,12 @@ this without pulling the runtime in.
 from .registry import (DEFAULT_BUCKETS, OBS_DISABLE_ENV,  # noqa: F401
                        Registry, counter, default_registry, gauge,
                        histogram, reset_default_registry)
-from .trace import (SPAN_PATH_ENV, TRACE_ID_ANNOTATION,  # noqa: F401
-                    TRACE_ID_ENV, SpanWriter, default_tracer, load_spans,
+from .trace import (SPAN_MAX_BYTES_ENV, SPAN_PATH_ENV,  # noqa: F401
+                    TRACE_ID_ANNOTATION, TRACE_ID_ENV, SpanWriter,
+                    adopt_trace_env, default_tracer, load_spans,
                     mint_trace_id, reconstruct, reset_default_tracers)
 from .http import ObsServer  # noqa: F401
+from .goodput import (BADPUT_CATEGORIES, GOODPUT,  # noqa: F401
+                      GOODPUT_ANNOTATION, categories_sum_ok,
+                      cluster_rollup, decompose, export_job_ledger,
+                      ledger_for)

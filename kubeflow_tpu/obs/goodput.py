@@ -1,0 +1,413 @@
+"""Goodput ledger: per-job wall-clock accounting from queue to chip.
+
+Of a job's wall-clock chip-hours, how many trained the model — and where
+did the rest go? Every prior layer already emits the raw evidence
+(queue/bind/preempt/resize spans from the scheduler, restart and stall
+transitions from the operator, first-step/window/checkpoint spans from
+the worker); this module folds that one span stream into the operator's
+first dashboard: **goodput** (productive train steps) vs named **badput**
+categories. The decomposition vocabulary is how scheduler-policy papers
+actually compare arms ("Dynamic Scheduling of MPI-based Distributed Deep
+Learning Training Jobs" evaluates entirely in queue-wait/utilization
+decompositions; TF-Replicator motivates per-step breakdowns as the first
+debugging surface — PAPERS.md), so the sim (scheduler/sim.py) reports
+the SAME categories and an arm's table is comparable to a real cluster's.
+
+The category vocabulary is defined ONCE, here, and consumed by the
+ledger, the sim, the dashboard, and the operator's final-ledger export —
+tests/test_lint.py pins the single definition (the binding_of rule).
+
+Accounting model: the ledger partitions the job's wall interval
+[first span start, last span end] — every elementary interval between
+span boundaries is attributed to exactly ONE category by priority, so
+the categories sum to wall-clock BY CONSTRUCTION (the bench's 2%
+tolerance covers boundary fuzz between independently-clocked writers,
+not accounting leaks). Time nothing claims is reported honestly as
+``other``, never silently absorbed into goodput.
+
+jax-free, stdlib only — the scheduler, operator, and dashboard all
+import this.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Optional
+
+from . import registry as obsreg
+from .trace import load_spans
+
+# ---------------------------------------------------------- the vocabulary
+# Badput category names — the ONE definition (ledger, sim, dashboard, and
+# bench all import these; tests/test_lint.py greps that the literals
+# appear nowhere else in the package).
+GOODPUT = "goodput"
+BADPUT_QUEUE_WAIT = "queue_wait"          # admission → slice binding
+BADPUT_STARTUP = "startup"                # bind → worker first activity
+#                                           (pod create, image, backend)
+BADPUT_COMPILE = "compile"                # train() entry → first step,
+#                                           split cold/warm/aot
+BADPUT_CHECKPOINT = "checkpoint"          # save submission + restore
+BADPUT_RECOMPUTE = "restart_recompute"    # steps re-executed after resume
+BADPUT_RESIZE = "resize"                  # resize/migration downtime
+BADPUT_STALL = "stall"                    # wedged → watchdog teardown
+BADPUT_OTHER = "other"                    # unattributed residual
+
+BADPUT_CATEGORIES = (BADPUT_QUEUE_WAIT, BADPUT_STARTUP, BADPUT_COMPILE,
+                     BADPUT_CHECKPOINT, BADPUT_RECOMPUTE, BADPUT_RESIZE,
+                     BADPUT_STALL, BADPUT_OTHER)
+
+# the operator stamps a job's final ledger here on completion
+# (controllers/tpujob.py _finalize_ledger) so the decomposition survives
+# span-sink rotation/GC
+GOODPUT_ANNOTATION = "observability.kubeflow.org/goodput"
+
+# span names the ledger consumes (emitted by the worker — runtime/worker
+# + runtime/checkpoint op log; the control-plane names are condition/
+# scheduler events: queued/bound/preempted/resized/restarting/...)
+SPAN_CKPT_SAVE = "ckpt-save"
+SPAN_CKPT_RESTORE = "ckpt-restore"
+
+# overlap resolution: when two attributed intervals claim the same time,
+# the LOWEST number wins. Compile outranks the windows (the first window
+# span CONTAINS the first step's compile — that stretch is startup cost,
+# not training); recompute outranks goodput (a replayed window is waste
+# even though it looks like training); measured worker spans outrank
+# inferred control-plane intervals; everything outranks the residual.
+_PRIORITY = {
+    BADPUT_COMPILE: 0,
+    BADPUT_RECOMPUTE: 1,
+    GOODPUT: 2,
+    BADPUT_CHECKPOINT: 3,
+    BADPUT_STALL: 4,
+    BADPUT_RESIZE: 5,
+    BADPUT_QUEUE_WAIT: 6,
+    BADPUT_STARTUP: 7,
+}
+
+# operator restart reasons that read as a stall (controllers/tpujob.py)
+_STALL_REASONS = ("StallTimeout", "WorkerStallTimeout")
+
+# worker activity that ends a startup/resize-downtime interval
+_WORKER_ACTIVITY = ("train-start", "first-step", "window", SPAN_CKPT_SAVE,
+                    SPAN_CKPT_RESTORE)
+
+
+def _attrs(span: dict) -> dict:
+    a = span.get("attrs")
+    return a if isinstance(a, dict) else {}
+
+
+def _next_activity(spans: list[dict], after: float,
+                   names: tuple = _WORKER_ACTIVITY) -> Optional[float]:
+    """Start time of the first worker-activity span after ``after``."""
+    best = None
+    for s in spans:
+        if s.get("name") in names and s.get("start", 0.0) > after:
+            if best is None or s["start"] < best:
+                best = s["start"]
+    return best
+
+
+def _last_activity_end(spans: list[dict], before: float) -> Optional[float]:
+    """End of the last worker-activity span before ``before`` — where a
+    stalled worker last showed signs of life."""
+    best = None
+    for s in spans:
+        end = s.get("end", s.get("start", 0.0))
+        if s.get("name") in _WORKER_ACTIVITY and end < before:
+            if best is None or end > best:
+                best = end
+    return best
+
+
+def _window_segments(spans: list[dict]) -> tuple:
+    """Split every ``window`` span into goodput vs recompute via a
+    step high-water walk: a window re-covering steps already banked
+    before a restart is replay, charged to ``restart_recompute``
+    proportionally (the replayed steps run FIRST chronologically).
+    Returns (segments, steps_new, steps_recomputed, n_windows)."""
+    segments: list[tuple] = []
+    high_water = 0
+    steps_new = 0
+    steps_re = 0
+    windows = 0
+    for s in spans:
+        if s.get("name") != "window":
+            continue
+        a = _attrs(s)
+        try:
+            s1 = int(a.get("step", 0))
+            n = int(a.get("steps", 0))
+        except (TypeError, ValueError):
+            continue
+        start = float(s.get("start", 0.0))
+        end = float(s.get("end", start))
+        if n <= 0 or end <= start:
+            continue
+        windows += 1
+        s0 = s1 - n
+        re = min(n, max(0, min(s1, high_water) - s0))
+        new = n - re
+        split = start + (end - start) * (re / n)
+        if re:
+            segments.append((start, split, BADPUT_RECOMPUTE))
+        if new:
+            segments.append((split, end, GOODPUT))
+        high_water = max(high_water, s1)
+        steps_new += new
+        steps_re += re
+    return segments, steps_new, steps_re, windows
+
+
+def decompose(spans: list[dict]) -> dict:
+    """Fold ONE trace's span records (load_spans order) into the ledger:
+
+    ``{"wallSeconds", "goodputSeconds", "goodputRatio",
+    "badputSeconds": {category: seconds — every BADPUT_CATEGORIES key},
+    "compileByStartKind": {...}, "steps", "stepsRecomputed", "windows",
+    "chips"}``
+
+    The categories plus goodput sum to wallSeconds exactly (partition by
+    construction); ``categories_sum_ok`` is the bench's tolerance check
+    against independent wall measurements.
+    """
+    empty = {
+        "wallSeconds": 0.0, "goodputSeconds": 0.0, "goodputRatio": 0.0,
+        "badputSeconds": {c: 0.0 for c in BADPUT_CATEGORIES},
+        "compileByStartKind": {}, "steps": 0, "stepsRecomputed": 0,
+        "windows": 0, "chips": 0,
+    }
+    if not spans:
+        return empty
+    t0 = min(float(s.get("start", 0.0)) for s in spans)
+    t1 = max(float(s.get("end", s.get("start", 0.0))) for s in spans)
+    if t1 <= t0:
+        return empty
+
+    segments, steps_new, steps_re, windows = _window_segments(spans)
+    compile_by_kind: dict[str, float] = {}
+    chips = 0
+
+    open_queue: Optional[float] = None
+    for s in spans:
+        name = s.get("name")
+        start = float(s.get("start", 0.0))
+        end = float(s.get("end", start))
+        a = _attrs(s)
+        if name == "queued":
+            if open_queue is None:
+                open_queue = start
+        elif name == "bound":
+            if open_queue is not None:
+                segments.append((open_queue, start, BADPUT_QUEUE_WAIT))
+                open_queue = None
+            try:
+                chips = int(a.get("chips", chips)) or chips
+            except (TypeError, ValueError):
+                pass
+            # pod create → worker first activity: the startup stretch
+            # (low priority — measured worker spans carve their own time
+            # out of it)
+            until = _next_activity(spans, start)
+            segments.append((start, until if until is not None else t1,
+                             BADPUT_STARTUP))
+        elif name == "first-step":
+            # train() entry → first completed step; dominated by the
+            # compile/cache-load/AOT-load rung recorded in start_kind
+            try:
+                secs = float(a.get("seconds", 0.0))
+            except (TypeError, ValueError):
+                secs = 0.0
+            if secs > 0:
+                lo = max(t0, start - secs)
+                segments.append((lo, start, BADPUT_COMPILE))
+                kind = str(a.get("start_kind", "cold"))
+                # clipped to the stream: the attr measures from train()
+                # entry, which can predate the job's first span
+                compile_by_kind[kind] = \
+                    compile_by_kind.get(kind, 0.0) + (start - lo)
+        elif name in (SPAN_CKPT_SAVE, SPAN_CKPT_RESTORE):
+            if end > start:
+                segments.append((start, end, BADPUT_CHECKPOINT))
+        elif name == "resized":
+            # binding rewritten → gang restarts at the new shape; the
+            # downtime runs to the worker's next sign of life
+            until = _next_activity(spans, start)
+            segments.append((start, until if until is not None else t1,
+                             BADPUT_RESIZE))
+        elif name == "restarting":
+            # restart downtime (teardown → the recreated gang's first
+            # sign of life) is startup badput; for a watchdog-triggered
+            # restart the wedged stretch BEFORE the teardown — last
+            # worker activity → the restarting transition — is stall
+            # (the flight recorder's dump covers the same stretch from
+            # inside the worker)
+            until = _next_activity(spans, start)
+            segments.append((start, until if until is not None else t1,
+                             BADPUT_STARTUP))
+            if a.get("reason") in _STALL_REASONS:
+                last = _last_activity_end(spans, start)
+                if last is not None and start > last:
+                    segments.append((last, start, BADPUT_STALL))
+    if open_queue is not None:
+        # still waiting at the end of the stream (never bound)
+        segments.append((open_queue, t1, BADPUT_QUEUE_WAIT))
+
+    # ---- the sweep: partition [t0, t1] by priority ----------------------
+    # Two-pointer event sweep, O(n log n) in span count: this runs
+    # inside the operator's reconcile (_finalize_ledger) and on every
+    # dashboard request, so a multi-day job's thousands of window spans
+    # must not turn one decompose into a quadratic scan.
+    totals = {c: 0.0 for c in BADPUT_CATEGORIES}
+    totals[GOODPUT] = 0.0
+    segments = [(max(t0, a), min(t1, b), cat) for a, b, cat in segments
+                if min(t1, b) > max(t0, a)]
+    bounds = sorted({t0, t1, *(a for a, _b, _c in segments),
+                     *(b for _a, b, _c in segments)})
+    starts = sorted(segments, key=lambda s: s[0])
+    ends = sorted(segments, key=lambda s: s[1])
+    by_priority = sorted(_PRIORITY, key=_PRIORITY.__getitem__)
+    active = {c: 0 for c in _PRIORITY}
+    si = ei = 0
+    for lo, hi in zip(bounds, bounds[1:]):
+        # a segment [a, b] covers [lo, hi) iff a <= lo and b > lo
+        # (every b is itself a boundary, so b > lo equals b >= hi)
+        while si < len(starts) and starts[si][0] <= lo:
+            active[starts[si][2]] += 1
+            si += 1
+        while ei < len(ends) and ends[ei][1] <= lo:
+            active[ends[ei][2]] -= 1
+            ei += 1
+        cat = next((c for c in by_priority if active[c] > 0),
+                   BADPUT_OTHER)
+        totals[cat] += hi - lo
+
+    wall = t1 - t0
+    goodput = totals.pop(GOODPUT)
+    return {
+        "wallSeconds": round(wall, 6),
+        "goodputSeconds": round(goodput, 6),
+        "goodputRatio": round(goodput / wall, 6) if wall else 0.0,
+        "badputSeconds": {c: round(v, 6) for c, v in totals.items()},
+        "compileByStartKind": {k: round(v, 6)
+                               for k, v in sorted(compile_by_kind.items())},
+        "steps": steps_new,
+        "stepsRecomputed": steps_re,
+        "windows": windows,
+        "chips": chips,
+    }
+
+
+def ledger_for(path: str, trace_id: str) -> dict:
+    """One job's ledger straight from the span sink."""
+    return decompose(load_spans(path, trace_id=trace_id))
+
+
+def categories_sum_ok(ledger: dict, tolerance: float = 0.02) -> bool:
+    """goodput + every badput category must re-add to wall-clock within
+    ``tolerance`` (fractional). Exact by construction today; the check
+    guards the partition invariant against future category edits."""
+    wall = ledger.get("wallSeconds", 0.0)
+    total = ledger.get("goodputSeconds", 0.0) + \
+        sum(ledger.get("badputSeconds", {}).values())
+    if wall <= 0:
+        return total == 0
+    return math.isclose(total, wall, rel_tol=tolerance, abs_tol=1e-6)
+
+
+def annotation_payload(ledger: dict) -> str:
+    """The compact final-ledger JSON the operator stamps on completion."""
+    return json.dumps({
+        "goodputRatio": ledger["goodputRatio"],
+        "wallSeconds": round(ledger["wallSeconds"], 3),
+        "goodputSeconds": round(ledger["goodputSeconds"], 3),
+        "badputSeconds": {c: round(v, 3)
+                          for c, v in ledger["badputSeconds"].items()},
+        "stepsRecomputed": ledger["stepsRecomputed"],
+    }, sort_keys=True)
+
+
+def _ledger_families(reg) -> tuple:
+    ratio = reg.gauge(
+        "kftpu_job_goodput_ratio",
+        "fraction of the job's wall clock spent on productive (never "
+        "re-executed) train steps", labels=("namespace", "name"))
+    # a counter via the registry's snapshot bridge (set() for sources
+    # that keep their own monotonic totals — the ledger IS the
+    # bookkeeper): keeps the Prometheus _total-means-counter convention
+    # while exporting the final cumulative seconds in one shot
+    seconds = reg.counter(
+        "kftpu_job_badput_seconds_total",
+        "job wall-clock seconds lost per badput category "
+        "(docs/operations.md 'Goodput accounting')",
+        labels=("namespace", "name", "category"))
+    return ratio, seconds
+
+
+def export_job_ledger(namespace: str, name: str, ledger: dict,
+                      registry=None) -> None:
+    """Export one job's ledger as the scrape-surface series:
+    ``kftpu_job_goodput_ratio{namespace,name}`` and
+    ``kftpu_job_badput_seconds_total{namespace,name,category}``."""
+    reg = registry if registry is not None else obsreg.default_registry()
+    ratio, seconds = _ledger_families(reg)
+    ratio.labels(namespace=namespace, name=name).set(
+        ledger["goodputRatio"])
+    for cat in BADPUT_CATEGORIES:
+        seconds.labels(namespace=namespace, name=name, category=cat).set(
+            ledger["badputSeconds"].get(cat, 0.0))
+
+
+def remove_job_ledger(namespace: str, name: str, registry=None) -> None:
+    """Drop a deleted job's ledger series — a long-lived operator must
+    not export every finished job's decomposition forever (the
+    kftpu_job_phase pruning rule)."""
+    reg = registry if registry is not None else obsreg.default_registry()
+    ratio, seconds = _ledger_families(reg)
+    ratio.remove(namespace=namespace, name=name)
+    for cat in BADPUT_CATEGORIES:
+        seconds.remove(namespace=namespace, name=name, category=cat)
+
+
+def cluster_rollup(path: str) -> dict:
+    """The cluster-level chip-hour rollup: every trace in the sink,
+    weighted by its bound gang width. ``chipHours`` decomposes the
+    fleet's chip-time the way a single job's ledger decomposes its
+    wall clock (jobs that never bound contribute wait with zero chips —
+    reported in ``jobsNeverBound``, not silently dropped)."""
+    by_trace: dict[str, list] = {}
+    for rec in load_spans(path):
+        tid = rec.get("trace_id")
+        if tid:
+            by_trace.setdefault(tid, []).append(rec)
+    chip_sec = {c: 0.0 for c in BADPUT_CATEGORIES}
+    goodput_sec = 0.0
+    wall_sec = 0.0
+    never_bound = 0
+    jobs = []
+    for tid, spans in sorted(by_trace.items()):
+        ledger = decompose(spans)
+        chips = ledger["chips"]
+        if not chips:
+            never_bound += 1
+        goodput_sec += ledger["goodputSeconds"] * chips
+        wall_sec += ledger["wallSeconds"] * chips
+        for c, v in ledger["badputSeconds"].items():
+            chip_sec[c] += v * chips
+        jobs.append({"traceId": tid, "chips": chips,
+                     "goodputRatio": ledger["goodputRatio"],
+                     "wallSeconds": ledger["wallSeconds"]})
+    return {
+        "jobs": jobs,
+        "jobsNeverBound": never_bound,
+        "chipHours": {
+            "total": round(wall_sec / 3600.0, 6),
+            GOODPUT: round(goodput_sec / 3600.0, 6),
+            "badput": {c: round(v / 3600.0, 6)
+                       for c, v in chip_sec.items()},
+        },
+        "goodputRatio": round(goodput_sec / wall_sec, 6)
+        if wall_sec else 0.0,
+    }

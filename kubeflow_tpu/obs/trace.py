@@ -31,6 +31,7 @@ hooks in as a child span around its start/stop.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
@@ -42,6 +43,10 @@ from typing import Optional
 # tests/test_lint.py pins the plumbing)
 TRACE_ID_ENV = "KFTPU_TRACE_ID"
 SPAN_PATH_ENV = "KFTPU_SPAN_PATH"
+# sink size cap: at this many bytes the active JSONL rotates to
+# ``<path>.1`` (one generation — long soaks previously grew the sink
+# unbounded). 0/unset = no rotation.
+SPAN_MAX_BYTES_ENV = "KFTPU_SPAN_MAX_BYTES"
 
 # where the minted trace id persists on the job object (the one value
 # every component — scheduler, operator, worker, dashboard — agrees on)
@@ -61,6 +66,22 @@ def mint_trace_id(uid: str = "") -> str:
 
 def new_span_id() -> str:
     return uuid.uuid4().hex[:16]
+
+
+# per-path rotation locks: several SpanWriter instances in ONE process
+# (operator + scheduler default tracers, the worker's tracer + its
+# dedicated dump writer) share a sink — their rotations must serialize
+_rotate_locks: dict = {}
+_rotate_locks_guard = threading.Lock()
+
+
+def _rotate_lock(path: str) -> threading.Lock:
+    key = os.path.abspath(path)
+    with _rotate_locks_guard:
+        lock = _rotate_locks.get(key)
+        if lock is None:
+            lock = _rotate_locks[key] = threading.Lock()
+        return lock
 
 
 class _SpanCtx:
@@ -96,11 +117,24 @@ class SpanWriter:
     per process) or passed per record (control plane — many jobs)."""
 
     def __init__(self, path: str, component: str,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 max_bytes: Optional[int] = None):
         self.path = path
         self.component = component
         self.trace_id = trace_id
+        if max_bytes is None:
+            try:
+                max_bytes = int(os.environ.get(SPAN_MAX_BYTES_ENV) or 0)
+            except ValueError:
+                max_bytes = 0
+        self.max_bytes = max(0, int(max_bytes))
         self._lock = threading.Lock()
+        # the per-path rotation lock is resolved ONCE here: resolving it
+        # per-emit would take the blocking _rotate_locks_guard on the
+        # hot path — and inside the SIGTERM handler's dump, where
+        # re-acquiring a guard the interrupted main thread holds would
+        # deadlock the very teardown being evidenced
+        self._rotate = _rotate_lock(path) if self.max_bytes else None
         self._fh = None
         self._warned = False
 
@@ -143,6 +177,8 @@ class SpanWriter:
                     if d:
                         os.makedirs(d, exist_ok=True)
                     self._fh = open(self.path, "a")
+                if self.max_bytes:
+                    self._rotate_if_needed(len(line))
                 self._fh.write(line)
                 self._fh.flush()
             except OSError as e:
@@ -159,6 +195,65 @@ class SpanWriter:
                         pass
                     self._fh = None
         return record
+
+    def _rotate_if_needed(self, incoming: int) -> None:
+        """Size-cap rotation (KFTPU_SPAN_MAX_BYTES), safe for the
+        deployed shape of MANY writers appending to one sink (operator,
+        scheduler, every worker). Two hazards the naive rotate has:
+
+        - a writer holding a handle onto a file ANOTHER writer already
+          renamed keeps appending to the stale inode — its spans
+          (including flight-record dumps) silently land in ``.1`` and
+          vanish from the live trace. Every capped write re-checks the
+          handle's inode against the path and reopens on mismatch.
+        - a writer rotating off its own stale size clobbers a sibling's
+          FRESH active file over the prior generation. Rotation runs
+          under a process-wide per-path lock and re-checks the LIVE
+          file size first, so only a genuinely over-cap active file is
+          ever renamed.
+
+        Cross-process rotation remains best-effort (no file locking in
+        scope): the inode re-check bounds the damage to one writer
+        reopening a line late, never to silent span loss."""
+        try:
+            if os.stat(self.path).st_ino != os.fstat(
+                    self._fh.fileno()).st_ino:
+                self._fh.close()
+                self._fh = open(self.path, "a")
+        except OSError:
+            # path gone mid-check (sibling rotated + nothing rewrote
+            # it yet): reopen creates the fresh active generation
+            self._fh.close()
+            self._fh = open(self.path, "a")
+        if self._fh.tell() + incoming <= self.max_bytes or \
+                self._fh.tell() == 0:
+            return
+        # NON-BLOCKING: the SIGTERM flight-record dump writes through a
+        # dedicated writer that shares only THIS lock with the main
+        # thread — a handler blocking on a lock its interrupted holder
+        # can never release would deadlock the teardown. A contended
+        # rotation is simply skipped: the write overshoots the cap by
+        # one record and the next uncontended write rotates.
+        lock = self._rotate
+        if not lock.acquire(blocking=False):
+            return
+        try:
+            try:
+                live = os.path.getsize(self.path)
+            except OSError:
+                live = 0
+            if live + incoming > self.max_bytes and live > 0:
+                self._fh.close()
+                self._fh = None
+                os.replace(self.path, self.path + ".1")
+                self._fh = open(self.path, "a")
+            elif os.stat(self.path).st_ino != os.fstat(
+                    self._fh.fileno()).st_ino:
+                # a sibling rotated while we raced for the lock
+                self._fh.close()
+                self._fh = open(self.path, "a")
+        finally:
+            lock.release()
 
     def event(self, name: str, trace_id: Optional[str] = None,
               **attrs) -> dict:
@@ -213,6 +308,30 @@ def reset_default_tracers() -> None:
         for _, w in _writers.values():
             w.close()
         _writers.clear()
+
+
+@contextlib.contextmanager
+def adopt_trace_env(env_map: dict):
+    """Temporarily adopt the operator-rendered trace contract
+    (KFTPU_TRACE_ID / KFTPU_SPAN_PATH) from a pod's env map — the
+    in-process soak segments' stand-in for actually running inside the
+    pod, so their worker spans stitch onto the job's control-plane
+    trace. Shared by every soak (scheduler/soak.py, cluster/chaos.py)
+    so the adoption logic cannot drift."""
+    saved: dict = {}
+    for key in (TRACE_ID_ENV, SPAN_PATH_ENV):
+        value = env_map.get(key)
+        if value:
+            saved[key] = os.environ.get(key)
+            os.environ[key] = value
+    try:
+        yield
+    finally:
+        for key, old in saved.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
 
 
 # -------------------------------------------------------------- reading back

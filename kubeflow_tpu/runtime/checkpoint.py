@@ -36,6 +36,7 @@ from typing import Any, Callable, Optional
 import jax
 
 from ..obs import registry as obsreg
+from ..obs.goodput import SPAN_CKPT_RESTORE, SPAN_CKPT_SAVE
 
 log = logging.getLogger(__name__)
 
@@ -179,6 +180,23 @@ class CheckpointManager:
                 max_to_keep=max_to_keep,
                 save_interval_steps=save_interval_steps),
         )
+        # wall-clock op log for the goodput ledger (obs/goodput.py):
+        # (op, start, end, step) per completed save/restore, drained by
+        # the worker into ckpt-save/ckpt-restore trace spans. Bounded so
+        # undrained consumers (serving, tests) never grow it unbounded.
+        self._op_log: list[tuple] = []
+
+    def _log_op(self, op: str, t0_wall: float, step) -> None:
+        self._op_log.append((op, t0_wall, time.time(),
+                             int(step) if step is not None else -1))
+        del self._op_log[:-256]
+
+    def drain_op_log(self) -> list[tuple]:
+        """Pop the recorded (op, wall_start, wall_end, step) entries —
+        the worker turns them into trace spans so checkpoint time lands
+        in the job's badput decomposition."""
+        out, self._op_log = self._op_log, []
+        return out
 
     # ------------------------------------------------------------------ save
 
@@ -190,6 +208,7 @@ class CheckpointManager:
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         if self.save_delay_s > 0:
             time.sleep(self.save_delay_s)
+        t0_wall = time.time()
         t0 = time.perf_counter()
         delay = self.retry_backoff_s
         for attempt in range(self.save_retries + 1):
@@ -217,6 +236,7 @@ class CheckpointManager:
             log.info("checkpoint saved at step %d -> %s", step, self.directory)
             self._pending_manifest.add(step)
             _obs_duration("save").observe(time.perf_counter() - t0)
+            self._log_op(SPAN_CKPT_SAVE, t0_wall, step)
         return saved
 
     def wait(self) -> None:
@@ -338,9 +358,11 @@ class CheckpointManager:
                 raise ValueError(
                     f"checkpoint step {step} in {self.directory} is not "
                     f"intact: {reason}")
+            t0_wall = time.time()
             t0 = time.perf_counter()
             out = restore_fn(step)
             _obs_duration("restore").observe(time.perf_counter() - t0)
+            self._log_op(SPAN_CKPT_RESTORE, t0_wall, step)
             return out
         last_err: Optional[BaseException] = None
         # newest-first, verifying LAZILY: older steps only pay their
@@ -352,9 +374,11 @@ class CheckpointManager:
                             candidate, reason)
                 continue
             try:
+                t0_wall = time.time()
                 t0 = time.perf_counter()
                 out = restore_fn(candidate)
                 _obs_duration("restore").observe(time.perf_counter() - t0)
+                self._log_op(SPAN_CKPT_RESTORE, t0_wall, candidate)
                 return out
             except ElasticContractError:
                 raise   # a breach is a breach at EVERY step: no fallback

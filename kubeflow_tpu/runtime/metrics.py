@@ -12,10 +12,11 @@ import contextlib
 import json
 import logging
 import os
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 from ..obs import registry as obsreg
 
@@ -24,6 +25,11 @@ log = logging.getLogger(__name__)
 # env contract: where the worker streams per-step JSONL so external
 # harnesses (workflows/kubebench reporter) can aggregate the run
 METRICS_PATH_ENV = "KFTPU_METRICS_PATH"
+
+# flight-recorder ring depth (windows kept); 0 disables the recorder
+FLIGHT_WINDOWS_ENV = "KFTPU_FLIGHT_WINDOWS"
+# span name a flight-recorder dump lands under in the trace sink
+FLIGHT_RECORD_SPAN = "flight-record"
 
 # pod self-identity, rendered by the operator into every worker container
 # (controllers/tpujob.py — the downward-API analog); with an apiserver URL
@@ -306,6 +312,243 @@ class AsyncWindowFetch:
     @property
     def pending(self) -> int:
         return len(self._pending)
+
+
+class FlightRecorder:
+    """Step-time flight recorder: a bounded in-memory ring of per-window
+    timing records with the host-side stage breakdown (data wait, H2D,
+    dispatch, end-of-window drain, and the residual the device kept the
+    host blocked for), dumped to the span sink on SIGTERM/crash and on
+    demand — so a wedged worker the stall watchdog tears down finally
+    leaves evidence of WHERE it stuck (ISSUE 10).
+
+    The hot path is two ``mark()`` attribute writes and one
+    ``note_step()`` float-accumulate per step — no locks, no I/O; the
+    lock only guards ring snapshots against the dump paths (signal
+    handler, HTTP peek), which run concurrently with the loop."""
+
+    # input-pipeline stage counters snapshotted per window
+    # (data/mp_augment.py, data/device_prefetch.py label values)
+    INPUT_STAGES = ("augment", "device_put")
+
+    def __init__(self, windows: int = 64):
+        self.enabled = windows > 0
+        self._ring: deque = deque(maxlen=max(1, windows))
+        self._lock = threading.Lock()
+        self._stage = "init"
+        self._stage_step = -1
+        self._stage_since = time.time()
+        self._acc = self._fresh_acc()
+        self._input_counters = None
+        self._input_last: dict[str, float] = {}
+
+    @staticmethod
+    def _fresh_acc() -> dict:
+        return {"data_s": 0.0, "h2d_s": 0.0, "dispatch_s": 0.0,
+                "first_step_s": 0.0, "steps": 0}
+
+    def _input_totals(self) -> dict[str, float]:
+        if self._input_counters is None:
+            fam = obsreg.counter(
+                "kftpu_input_batches_total",
+                "batches delivered by each input-pipeline stage",
+                labels=("stage",))
+            self._input_counters = {s: fam.labels(stage=s)
+                                    for s in self.INPUT_STAGES}
+        return {s: c.value for s, c in self._input_counters.items()}
+
+    # ------------------------------------------------------------ hot path
+
+    def mark(self, stage: str, step: int) -> None:
+        """Record what the loop is ABOUT to do — the dump's "where it
+        stuck" pointer. Two attribute writes; wall time is read lazily
+        at dump, not here."""
+        self._stage = stage
+        self._stage_step = step
+        self._stage_since = time.time()
+
+    def note_step(self, data_s: float = 0.0, h2d_s: float = 0.0,
+                  dispatch_s: float = 0.0,
+                  first_step_s: float = 0.0) -> None:
+        """``first_step_s`` carries the FIRST step's compile + blocking
+        sync separately: charging a multi-second cold compile to
+        dispatch_s would make the first window's record claim the loop
+        spent seconds 'dispatching' — the opposite of the accurate
+        where-it-stuck evidence the recorder exists for."""
+        acc = self._acc
+        acc["data_s"] += data_s
+        acc["h2d_s"] += h2d_s
+        acc["dispatch_s"] += dispatch_s
+        acc["first_step_s"] += first_step_s
+        acc["steps"] += 1
+
+    def close_window(self, step: int, steps: int, wall_s: float,
+                     drain_s: float = 0.0) -> None:
+        """Fold the accumulated per-step stage times into one ring
+        record at the window edge (the same cadence as the window span,
+        so recorder and trace agree on boundaries)."""
+        if not self.enabled:
+            return
+        acc = self._acc
+        host = acc["data_s"] + acc["h2d_s"] + acc["dispatch_s"] + \
+            acc["first_step_s"]
+        totals = self._input_totals()
+        deltas = {s: round(totals[s] - self._input_last.get(s, totals[s]))
+                  for s in totals}
+        self._input_last = totals
+        rec = {
+            "step": int(step), "steps": int(steps),
+            "wall_s": round(wall_s, 6),
+            "data_s": round(acc["data_s"], 6),
+            "h2d_s": round(acc["h2d_s"], 6),
+            "dispatch_s": round(acc["dispatch_s"], 6),
+            "drain_s": round(drain_s, 6),
+            # what the host spent BLOCKED on the device inside dispatch/
+            # fetch — everything the host-side stages can't explain
+            "device_wait_s": round(max(0.0, wall_s + drain_s - host), 6),
+            "input_batches": deltas,
+        }
+        if acc["first_step_s"]:
+            rec["first_step_s"] = round(acc["first_step_s"], 6)
+        with self._lock:
+            self._ring.append(rec)
+        self._acc = self._fresh_acc()
+
+    # --------------------------------------------------------------- dumps
+
+    def snapshot(self) -> dict:
+        """The ring plus the in-progress state. SIGNAL-SAFE: the dump
+        runs inside the SIGTERM handler, which interrupts the main
+        thread mid-bytecode — if that thread holds this lock (a
+        close_window in flight), a blocking acquire would deadlock the
+        process the watchdog is trying to tear down. Non-blocking
+        acquire, then a best-effort copy (CPython deque appends are
+        atomic; a concurrent-mutation RuntimeError retries once)."""
+        got = self._lock.acquire(blocking=False)
+        try:
+            try:
+                records = list(self._ring)
+            except RuntimeError:   # mutated mid-copy (lockless path)
+                records = list(self._ring)
+        finally:
+            if got:
+                self._lock.release()
+        acc = dict(self._acc)
+        return {
+            "records": records,
+            "inProgress": {
+                "stage": self._stage,
+                "step": self._stage_step,
+                "stuckSeconds": round(time.time() - self._stage_since, 3),
+                **{k: round(v, 6) if isinstance(v, float) else v
+                   for k, v in acc.items()},
+            },
+        }
+
+    def dump(self, tracer, reason: str, **attrs) -> Optional[dict]:
+        """Write the ring to the span sink as ONE ``flight-record``
+        span. Signal-handler and finally-block safe: never raises —
+        losing the dump must not mask the failure being dumped."""
+        if not self.enabled or tracer is None:
+            return None
+        try:
+            snap = self.snapshot()
+            return tracer.emit(FLIGHT_RECORD_SPAN, start=time.time(),
+                               reason=reason, **snap, **attrs)
+        except Exception as e:  # noqa: BLE001 — evidence is best-effort
+            log.warning("flight-recorder dump (%s) failed: %s", reason, e)
+            return None
+
+
+class ProfileArm:
+    """On-demand profiler trigger (ISSUE 10 satellite): ``POST
+    /profile?steps=N`` on the worker's ObsServer arms a jax.profiler
+    capture around the NEXT N steps and returns the artifact dir —
+    previously profiling was CLI-only (``--profile-dir``) and required
+    a restart. The HTTP thread only flips armed state under the lock;
+    the capture itself starts/stops on the LOOP thread at step
+    boundaries (the profiler is not thread-safe against the program it
+    profiles)."""
+
+    def __init__(self, base_dir: str,
+                 start_fn: Optional[Callable] = None,
+                 stop_fn: Optional[Callable] = None,
+                 tracer=None):
+        self.base_dir = base_dir
+        self._start_fn = start_fn
+        self._stop_fn = stop_fn
+        self._tracer = tracer
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._active = 0
+        self._dir: Optional[str] = None
+        self._t0 = 0.0
+
+    def request(self, steps: int) -> tuple[int, dict]:
+        """The HTTP handler: arm a capture of ``steps`` steps. Returns
+        (status, body) — 409 while a capture is already armed/active
+        (two overlapping jax traces would corrupt both)."""
+        try:
+            steps = int(steps)
+        except (TypeError, ValueError):
+            return 400, {"error": "steps must be an integer"}
+        if steps <= 0:
+            return 400, {"error": f"steps must be > 0, got {steps}"}
+        with self._lock:
+            if self._pending or self._active:
+                return 409, {"error": "a profile capture is already "
+                                      "armed or active",
+                             "dir": self._dir}
+            self._dir = os.path.join(self.base_dir,
+                                     f"profile-{int(time.time())}")
+            self._pending = steps
+            return 200, {"armed": True, "steps": steps, "dir": self._dir}
+
+    def on_step_start(self) -> None:
+        """Loop thread, before dispatching a step: start a pending
+        capture. Failures disarm with a warning — profiling must never
+        kill training."""
+        with self._lock:
+            if not self._pending:
+                return
+            self._active = self._pending
+            self._pending = 0
+            out_dir = self._dir
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            if self._start_fn is not None:
+                self._start_fn(out_dir)
+            else:
+                import jax
+                jax.profiler.start_trace(out_dir)
+            self._t0 = time.time()
+        except Exception as e:  # noqa: BLE001
+            log.warning("on-demand profile start failed: %s", e)
+            with self._lock:
+                self._active = 0
+
+    def on_step_end(self, step: int) -> None:
+        """Loop thread, after a step completes: count down and stop."""
+        with self._lock:
+            if not self._active:
+                return
+            self._active -= 1
+            if self._active:
+                return
+            out_dir = self._dir
+        try:
+            if self._stop_fn is not None:
+                self._stop_fn()
+            else:
+                import jax
+                jax.profiler.stop_trace()
+            log.info("on-demand profiler trace written to %s", out_dir)
+            if self._tracer is not None:
+                self._tracer.emit("profile", start=self._t0,
+                                  end=time.time(), out_dir=out_dir,
+                                  step=step, on_demand=True)
+        except Exception as e:  # noqa: BLE001
+            log.warning("on-demand profile stop failed: %s", e)
 
 
 @contextlib.contextmanager

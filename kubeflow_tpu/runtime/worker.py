@@ -28,8 +28,9 @@ from ..models import RESNET_DEPTHS
 from .bootstrap import WorkerContext, initialize
 from .recipe import make_optimizer, scale_lr, validate_weight_update
 from .checkpoint import CheckpointManager, HAVE_ORBAX
-from .metrics import (METRICS_PATH_ENV, AsyncWindowFetch, HeartbeatReporter,
-                      MetricsLogger, profile_trace)
+from .metrics import (FLIGHT_WINDOWS_ENV, METRICS_PATH_ENV,
+                      AsyncWindowFetch, FlightRecorder, HeartbeatReporter,
+                      MetricsLogger, ProfileArm, profile_trace)
 from .trainstep import TrainStepBuilder
 
 log = logging.getLogger(__name__)
@@ -118,6 +119,16 @@ def _env_int(name: str, default: int) -> int:
         raise ValueError(f"{name} must be an integer, got {v!r}") from None
 
 
+def _emit_ckpt_spans(ckpt, tracer) -> None:
+    """Drain the checkpoint manager's wall-clock op log into
+    ckpt-save/ckpt-restore trace spans — the goodput ledger's
+    checkpoint-badput evidence (obs/goodput.py)."""
+    if ckpt is None or tracer is None:
+        return
+    for op, t0, t1, step in ckpt.drain_op_log():
+        tracer.emit(op, start=t0, end=t1, step=step)
+
+
 # worker exit status after a SIGTERM-forced checkpoint: non-zero so the
 # pod lands in Failed and the operator gang-restarts with resume
 # (restart-ELIGIBLE, unlike exit 0 = Succeeded which completes the job),
@@ -151,9 +162,14 @@ class PreemptionGuard:
     The reference leaned on restartPolicy alone (SURVEY §5 failure
     handling) — losing up to checkpoint_every steps of work per restart."""
 
-    def __init__(self, install: bool = True):
+    def __init__(self, install: bool = True, on_term=None):
         self.stop = False
         self._prev = None
+        # evidence hook: the flight recorder dumps from INSIDE the
+        # signal handler — a worker wedged in a collective never reaches
+        # the next step boundary, so the handler is the only place its
+        # ring can still leave the sink (ISSUE 10)
+        self._on_term_cb = on_term
         if install:
             import signal
             import threading
@@ -163,6 +179,11 @@ class PreemptionGuard:
     def _on_term(self, signum, frame):
         log.warning("SIGTERM: finishing step, checkpointing, exiting")
         self.stop = True
+        if self._on_term_cb is not None:
+            try:
+                self._on_term_cb()
+            except Exception:  # noqa: BLE001 — evidence must not break
+                pass           # the graceful-preemption path
 
     def uninstall(self) -> None:
         if self._prev is not None:
@@ -325,6 +346,7 @@ def train(
     run_meta = {"replicaDegree": degree, "globalBatch": global_batch}
 
     ckpt = None
+    early_ckpt_ops: list = []
     if checkpoint_dir and HAVE_ORBAX:
         ckpt = CheckpointManager(checkpoint_dir,
                                  save_interval_steps=checkpoint_every,
@@ -346,6 +368,10 @@ def train(
             log.info("resumed from %s at step %d", resume_from,
                      int(state.step))
         if src is not ckpt:
+            # keep the restore's op-log entry: the tracer that will emit
+            # it as a ckpt-restore span does not exist yet (it is created
+            # after every failure-prone setup stage), and src closes here
+            early_ckpt_ops = src.drain_op_log()
             src.close()
 
     step_fn = builder.build()
@@ -573,21 +599,49 @@ def train(
         mint_trace_id
     span_path = span_path or os.environ.get(SPAN_PATH_ENV)
     tracer = None
+    dump_tracer = None
     if span_path:
-        tracer = SpanWriter(span_path, "worker",
-                            trace_id=os.environ.get(TRACE_ID_ENV)
-                            or mint_trace_id())
+        trace_id = os.environ.get(TRACE_ID_ENV) or mint_trace_id()
+        tracer = SpanWriter(span_path, "worker", trace_id=trace_id)
+        # the flight recorder dumps from the SIGTERM handler, which can
+        # interrupt the main thread INSIDE tracer's emit lock — a
+        # dedicated writer (own lock, same sink) makes the dump path
+        # deadlock-free by construction
+        dump_tracer = SpanWriter(span_path, "worker", trace_id=trace_id)
+    # step-time flight recorder + on-demand profiler trigger (ISSUE 10):
+    # the ring records per-window host-stage breakdowns; the arm lets
+    # POST /profile?steps=N capture a jax.profiler trace around the next
+    # N steps without a restart
+    recorder = FlightRecorder(windows=_env_int(FLIGHT_WINDOWS_ENV, 64))
+    import tempfile
+    # profile artifacts beside the checkpoints ONLY for local volumes:
+    # a gs://-style checkpoint URI joined with os.path would make
+    # on_step_start os.makedirs a literal ./gs:/bucket/... tree (the
+    # bug class the compile-cache gs:// guard exists for) — bucket
+    # checkpoint dirs fall through to the local tempdir
+    profile_arm = ProfileArm(
+        base_dir=profile_dir or os.environ.get("KFTPU_PROFILE_DIR")
+        or (os.path.join(checkpoint_dir, "profiles")
+            if checkpoint_dir and "://" not in checkpoint_dir
+            else os.path.join(tempfile.gettempdir(), "kftpu-profiles")),
+        tracer=tracer)
     # the worker's own scrape surface (spec.observability.metricsPort →
     # KFTPU_OBS_METRICS_PORT → --obs-metrics-port): /metrics over the
     # process default registry — step/window timings, input-stage rates,
-    # checkpoint durations, heartbeat freshness
+    # checkpoint durations, heartbeat freshness — plus the on-demand
+    # profiler trigger and the flight-recorder peek
     if obs_metrics_port is None:
         obs_metrics_port = _env_int("KFTPU_OBS_METRICS_PORT", 0)
     obs_server = None
     if obs_metrics_port:
         from ..obs.http import ObsServer
         try:
-            obs_server = ObsServer(port=obs_metrics_port)
+            obs_server = ObsServer(port=obs_metrics_port, handlers={
+                ("POST", "/profile"):
+                    lambda q: profile_arm.request(q.get("steps", 0)),
+                ("GET", "/flightrecorder"):
+                    lambda q: (200, recorder.snapshot()),
+            })
             obs_server.start()
         except (OSError, OverflowError) as e:
             # observability must never kill training: a taken port
@@ -601,9 +655,16 @@ def train(
         tracer.event("train-start", workload=spec.name,
                      start_step=start_step, steps=steps,
                      process=ctx.process_id)
+        # the pre-tracer restores' op-log entries become spans now, so
+        # restore time lands in the ledger's checkpoint badput
+        for op, t0w, t1w, st in early_ckpt_ops:
+            tracer.emit(op, start=t0w, end=t1w, step=st)
+        _emit_ckpt_spans(ckpt, tracer)
     last_metrics: dict = {}
     first_step_s = 0.0
-    guard = PreemptionGuard(install=handle_sigterm)
+    guard = PreemptionGuard(
+        install=handle_sigterm,
+        on_term=lambda: recorder.dump(dump_tracer, "sigterm"))
     preempted = False
     # Sync to the host only every `sync_every` steps: a per-step float()
     # fetch is a full device→host round trip that defeats async dispatch
@@ -621,12 +682,22 @@ def train(
             window = 0
             win_t0 = time.perf_counter()
             for step in range(start_step, steps):
+                profile_arm.on_step_start()
+                recorder.mark("data", step)
+                t_a = time.perf_counter()
                 if dev_iter is not None:
                     batch = next(dev_iter)
+                    t_h = t_b = time.perf_counter()
                 elif data_iter is not None:
-                    batch = builder.place_batch(next(data_iter))
+                    host_batch = next(data_iter)
+                    t_h = time.perf_counter()
+                    batch = builder.place_batch(host_batch)
+                    t_b = time.perf_counter()
                 else:
                     batch = batch_pool[step % len(batch_pool)]
+                    t_h = t_b = time.perf_counter()
+                recorder.mark("first-step" if step == start_step
+                              else "step", step)
                 if step == start_step:
                     try:
                         state, metrics = step_fn(state, batch)
@@ -684,6 +755,16 @@ def train(
                     first_step_s = t_first
                 else:
                     state, metrics = step_fn(state, batch)
+                # the first step's compile + blocking sync is recorded
+                # under its OWN key: charging it to dispatch would make
+                # the first window's record lie about where time went
+                step_cost = time.perf_counter() - t_b
+                recorder.note_step(
+                    data_s=t_h - t_a, h2d_s=t_b - t_h,
+                    dispatch_s=0.0 if step == start_step else step_cost,
+                    first_step_s=step_cost if step == start_step
+                    else 0.0)
+                profile_arm.on_step_end(step + 1)
                 window += 1
                 # checkpoint saves are their own sync point (orbax fetches
                 # the state), so close the timing window first
@@ -714,11 +795,15 @@ def train(
                         tracer.emit("window",
                                     start=now_w - (t_now - win_t0),
                                     end=now_w, step=step + 1, steps=window)
+                    t_drain0 = time.perf_counter()
                     for s, w, wall, vals in afetch.drain(
                             force=final or will_ckpt or will_eval
                             or stopping):
                         last_metrics = vals
                         mlog.record_window(s, w, wall, vals)
+                    recorder.close_window(
+                        step + 1, window, t_now - win_t0,
+                        drain_s=time.perf_counter() - t_drain0)
                     if heartbeat is not None:
                         # advertise progress at EVERY window close, not
                         # per drained window: the step number needs no
@@ -735,7 +820,9 @@ def train(
                     # persisted (resume/serving read it), and under
                     # preemption the grace period is the budget — resume
                     # must lose 0 steps
+                    recorder.mark("ckpt-save", step + 1)
                     ckpt.save(step + 1, state, force=stopping or final)
+                    _emit_ckpt_spans(ckpt, tracer)
                 if stopping:
                     preempted = True
                     break
@@ -743,6 +830,7 @@ def train(
                     # the window closed above, so eval wall-time is never
                     # charged to throughput; forward-only pass, results
                     # ride the metric stream
+                    recorder.mark("eval", step + 1)
                     em = run_eval(state)
                     if em:
                         last_metrics.update(em)
@@ -769,7 +857,15 @@ def train(
         if eval_source is not None:
             eval_source.close()
         guard.uninstall()
+        if loop_error is not None:
+            # the crash dump: the ring's last records + the in-progress
+            # stage say WHERE the loop died (the SIGTERM dump rides the
+            # signal handler; this is its non-signal sibling)
+            recorder.dump(dump_tracer, "crash",
+                          error=f"{type(loop_error).__name__}: "
+                                f"{loop_error}")
         if tracer is not None:
+            _emit_ckpt_spans(ckpt, tracer)
             attrs = {"preempted": preempted}
             if loop_error is not None:
                 attrs["error"] = f"{type(loop_error).__name__}: {loop_error}"
@@ -779,6 +875,8 @@ def train(
                 pass           # handling must not mask the loop error
             tracer.event("train-done", **attrs)
             tracer.close()
+        if dump_tracer is not None:
+            dump_tracer.close()
         if obs_server is not None:
             obs_server.stop()
         save_error: Optional[Exception] = None

@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..api.topology import parse_topology
+from ..obs import goodput as gp
 from . import health
 from .inventory import PoolState, SliceInventory
 from .queue import JobRequest, SchedulerConfig
@@ -106,6 +107,21 @@ class SimJob:
     # cache load or AOT load, set at every bind/resize)
     startup_left: float = field(default=0.0, repr=False)
     startup_paid: float = field(default=0.0, repr=False)
+    # goodput-ledger bookkeeping (obs/goodput.py vocabulary): which
+    # category the outstanding debt belongs to, queue-wait ticks
+    # accumulated across (re)queues, and chip-weighted accumulators the
+    # per-run goodput table is built from
+    debt_kind: str = field(default="startup", repr=False)
+    queued_at: Optional[int] = field(default=None, repr=False)
+    wait_ticks: int = field(default=0, repr=False)
+    startup_chip: float = field(default=0.0, repr=False)
+    resize_chip: float = field(default=0.0, repr=False)
+    recompute_chip: float = field(default=0.0, repr=False)
+    goodput_chip: float = field(default=0.0, repr=False)
+
+    @property
+    def nominal_chips(self) -> int:
+        return parse_topology(self.topology).num_chips * self.num_slices
 
     def request(self, seq: int, fifo: bool) -> JobRequest:
         return JobRequest(
@@ -212,6 +228,7 @@ def simulate(jobs: list[SimJob], pools: tuple = ("v5e-32",),
         while pending and pending[0].arrival <= t:
             job = pending.pop(0)
             seq_of[f"{job.namespace}/{job.name}"] = seq_counter
+            job.queued_at = t
             queued.append((seq_counter, job))
             seq_counter += 1
 
@@ -239,6 +256,7 @@ def simulate(jobs: list[SimJob], pools: tuple = ("v5e-32",),
                     quarantined[(dh.pool, dh.host)] = \
                         dh.end + dh.probation
                     del bound[key]
+                    job.queued_at = t
                     queued.append((seq_of[key], job))
                 # placement-blind: the binding survives and the gang
                 # crash-loops in place until the degradation ends
@@ -270,6 +288,7 @@ def simulate(jobs: list[SimJob], pools: tuple = ("v5e-32",),
             job.checkpointed = job.done
             job.resizes += 1
             job.startup_left = restart_ticks
+            job.debt_kind = gp.BADPUT_RESIZE
             bound[req.key] = (bound[req.key][0], new_placement)
         for victim in decisions.preempts:
             job = by_key[victim.key]
@@ -283,6 +302,7 @@ def simulate(jobs: list[SimJob], pools: tuple = ("v5e-32",),
             # derived and survives preemption, so a requeued victim
             # keeps its FIFO standing — the sim must measure the same
             # requeue policy the k8s loop ships
+            job.queued_at = t
             queued.append((seq_of[victim.key], job))
         for req, placement in decisions.binds:
             job = by_key[req.key]
@@ -291,6 +311,10 @@ def simulate(jobs: list[SimJob], pools: tuple = ("v5e-32",),
             if placement.chips != req.chips:
                 job.resizes += 1   # shrink-to-survive: a degraded bind
             job.startup_left = restart_ticks
+            job.debt_kind = gp.BADPUT_STARTUP
+            if job.queued_at is not None:
+                job.wait_ticks += max(0, t - job.queued_at)
+                job.queued_at = None
             bound[req.key] = (req, placement)
             queued = [(s, j) for s, j in queued if j is not job]
 
@@ -313,11 +337,22 @@ def simulate(jobs: list[SimJob], pools: tuple = ("v5e-32",),
                 paid = min(1.0, job.startup_left)
                 job.startup_left -= paid
                 job.startup_paid += paid
+                # chip-weighted, by debt category: restart debt after a
+                # resize is resize downtime, after a (re)bind startup —
+                # the goodput-table decomposition (obs/goodput.py)
+                if job.debt_kind == gp.BADPUT_RESIZE:
+                    job.resize_chip += paid * placement.chips
+                else:
+                    job.startup_chip += paid * placement.chips
                 frac = 1.0 - paid
                 if frac <= 0:
                     continue
             if job.done >= job.high_water:
                 busy_chip_ticks += placement.chips * frac
+                job.goodput_chip += placement.chips * frac
+            else:
+                # replaying steps a preemption/fault threw away
+                job.recompute_chip += placement.chips * frac
             prev = job.done
             job.done += frac * placement.chips / req.chips
             job.high_water = max(job.high_water, job.done)
@@ -353,6 +388,35 @@ def simulate(jobs: list[SimJob], pools: tuple = ("v5e-32",),
                    default=0)
     waits = [j.first_bound - j.arrival for j in jobs
              if j.first_bound is not None]
+    # close out waits still open at termination (never-bound survivors)
+    for job in jobs:
+        if job.queued_at is not None:
+            job.wait_ticks += max(0, t - job.queued_at)
+            job.queued_at = None
+    # the goodput table, in the SAME category vocabulary the real
+    # cluster's ledger reports (obs/goodput.py) so a sim arm's
+    # decomposition is comparable to a deployment's. Chip-weighted:
+    # queue wait at the gang's nominal demand, debts at the width
+    # actually held. Compile/cache-load is folded into the sim's single
+    # restart cost (startup/resize); checkpoint and stall are free in
+    # the sim's model — reported as zeros, not omitted, so tables line
+    # up column-for-column.
+    goodput_chip = sum(j.goodput_chip for j in jobs)
+    badput_chip = {c: 0.0 for c in gp.BADPUT_CATEGORIES}
+    badput_chip[gp.BADPUT_QUEUE_WAIT] = float(
+        sum(j.wait_ticks * j.nominal_chips for j in jobs))
+    badput_chip[gp.BADPUT_STARTUP] = sum(j.startup_chip for j in jobs)
+    badput_chip[gp.BADPUT_RESIZE] = sum(j.resize_chip for j in jobs)
+    badput_chip[gp.BADPUT_RECOMPUTE] = sum(
+        j.recompute_chip for j in jobs)
+    accounted = goodput_chip + sum(badput_chip.values())
+    goodput_table = {
+        "unit": "chip_ticks",
+        gp.GOODPUT: round(goodput_chip, 2),
+        "badput": {c: round(v, 2) for c, v in badput_chip.items()},
+        "goodput_fraction": round(goodput_chip / accounted, 4)
+        if accounted else 0.0,
+    }
     return {
         "policy": policy,
         "jobs": len(jobs),
@@ -373,6 +437,7 @@ def simulate(jobs: list[SimJob], pools: tuple = ("v5e-32",),
         "useful_work_fraction": round(
             sum(j.done for j in jobs)
             / max(1, sum(j.done + j.recomputed for j in jobs)), 4),
+        "goodput": goodput_table,
         "unfinished": unfinished,
     }
 
@@ -411,6 +476,15 @@ def compare_policies(seeds: list, n_jobs: int = 24,
                        "recomputed_ticks", "resizes"):
             agg[metric] = round(
                 sum(r[metric] for r in runs) / len(runs), 4)
+        # the per-arm goodput decomposition (obs/goodput.py vocabulary),
+        # seed-averaged — comparable to the real cluster's ledger table
+        agg["goodput_fraction"] = round(
+            sum(r["goodput"]["goodput_fraction"] for r in runs)
+            / len(runs), 4)
+        agg["badput_chip_ticks"] = {
+            c: round(sum(r["goodput"]["badput"][c] for r in runs)
+                     / len(runs), 2)
+            for c in gp.BADPUT_CATEGORIES}
         agg["unfinished"] = sum(len(r["unfinished"]) for r in runs)
         out[policy] = agg
     return out

@@ -70,18 +70,14 @@ class PreemptionSoak:
                 for e in pod["spec"]["containers"][0].get("env", [])}
 
     def _run_segment(self, env_map: dict, target: int):
-        from ..obs.trace import SPAN_PATH_ENV, TRACE_ID_ENV
+        from ..obs.trace import adopt_trace_env
         from ..runtime.worker import train  # lazy: pulls in jax
         # adopt the operator-rendered trace contract for the segment:
         # the in-process "worker" must read the SAME env a real pod
         # would, so its window spans stitch onto the job's trace id
-        # (bench.py --mode obs asserts the end-to-end timeline)
-        saved: dict = {}
-        for k in (TRACE_ID_ENV, SPAN_PATH_ENV):
-            if env_map.get(k):
-                saved[k] = os.environ.get(k)
-                os.environ[k] = env_map[k]
-        try:
+        # (bench.py --mode obs asserts the end-to-end timeline; the
+        # goodput ledger accounts the soak from the same stream)
+        with adopt_trace_env(env_map):
             return train(
                 workload="transformer", steps=target,
                 global_batch=self.global_batch, sync_every=1,
@@ -89,12 +85,6 @@ class PreemptionSoak:
                 checkpoint_every=self.checkpoint_every,
                 resume_from=env_map.get("KFTPU_RESUME_FROM"),
                 seed=self.seed, handle_sigterm=False, workload_kwargs={})
-        finally:
-            for k, v in saved.items():
-                if v is None:
-                    os.environ.pop(k, None)
-                else:
-                    os.environ[k] = v
 
     def _gang_running(self, cluster, name: str) -> bool:
         pods = cluster.list("v1", "Pod", self.namespace,
@@ -143,8 +133,12 @@ class PreemptionSoak:
             return self._finish(report, mgr)
 
         # victim trains to the preemption point
-        self._run_segment(self._chief_env(cluster, "victim-worker-0-0"),
-                          self.preempt_at)
+        seg = self._run_segment(
+            self._chief_env(cluster, "victim-worker-0-0"),
+            self.preempt_at)
+        # executed-step ledger: the ground truth bench.py --mode goodput
+        # checks the span-derived restart-recompute number against
+        report["victim_executed_steps"] = int(seg.steps)
         report["events"].append(f"victim reached step {self.preempt_at}")
 
         # the winner lands: higher priority, same (full-pool) shape
@@ -190,7 +184,8 @@ class PreemptionSoak:
         report["victim_rebind_resume_env"] = env_map.get(
             "KFTPU_RESUME_FROM", "")
         report["victim_resume_step"] = self._latest_step(ckpt_victim)
-        self._run_segment(env_map, self.total_steps)
+        seg = self._run_segment(env_map, self.total_steps)
+        report["victim_executed_steps"] += int(seg.steps)
         cluster.set_pod_phase(self.namespace, "victim-worker-0-0",
                               "Succeeded")
         while time.monotonic() < deadline:

@@ -15,11 +15,14 @@ Routes:
   GET /api/tpu/slices
   GET /api/sched/queues                    (gang-scheduler queue state)
   GET /api/sched/nodes                     (per-host health + quarantine)
+  GET /api/obs/goodput/{ns}/{name}         (per-job goodput ledger)
+  GET /api/obs/goodput                     (cluster chip-hour rollup)
   GET /healthz
 """
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Optional
 
@@ -428,6 +431,63 @@ def build_dashboard_app(client: KubeClient,
             return 200, out
         out.update(reconstruct(span_path, trace_id))
         return 200, out
+
+    @app.route("GET", "/api/obs/goodput/{namespace}/{name}")
+    def job_goodput(params, query, body):
+        """One job's goodput ledger (obs/goodput.py): wall-clock
+        decomposed into goodput vs the named badput categories,
+        reconstructed live from the span sink. A finished job whose
+        spans have rotated away still answers from the final-ledger
+        annotation the operator stamped at completion."""
+        from ..api.trainingjob import API_VERSIONS, JOB_KINDS
+        from ..obs.goodput import GOODPUT_ANNOTATION, ledger_for
+        from ..obs.trace import SPAN_PATH_ENV, TRACE_ID_ANNOTATION
+        ns, name = params["namespace"], params["name"]
+        manifest = None
+        for kind in JOB_KINDS:
+            manifest = client.get_or_none(API_VERSIONS[kind], kind, ns,
+                                          name)
+            if manifest is not None:
+                break
+        if manifest is None:
+            raise ApiError(404, f"no training job {ns}/{name}")
+        anns = k8s.annotations_of(manifest)
+        trace_id = anns.get(TRACE_ID_ANNOTATION)
+        out = {"namespace": ns, "name": name,
+               "phase": _job_phase(manifest), "traceId": trace_id}
+        span_path = os.environ.get(SPAN_PATH_ENV)
+        ledger = ledger_for(span_path, trace_id) \
+            if (span_path and trace_id) else None
+        if ledger is not None and ledger["wallSeconds"]:
+            out["ledger"] = ledger
+            out["source"] = "spans"
+            return 200, out
+        final = anns.get(GOODPUT_ANNOTATION)
+        if final:
+            try:
+                out["ledger"] = json.loads(final)
+                out["source"] = "annotation"
+                return 200, out
+            except ValueError:
+                pass
+        out["note"] = ("no spans for this job"
+                       if span_path and trace_id else
+                       "no trace id minted yet" if span_path else
+                       f"no span sink configured ({SPAN_PATH_ENV} unset)")
+        return 200, out
+
+    @app.route("GET", "/api/obs/goodput")
+    def cluster_goodput(params, query, body):
+        """The cluster-level chip-hour rollup: every trace in the span
+        sink, each job's decomposition weighted by its bound gang
+        width (obs/goodput.py cluster_rollup)."""
+        from ..obs.goodput import cluster_rollup
+        from ..obs.trace import SPAN_PATH_ENV
+        span_path = os.environ.get(SPAN_PATH_ENV)
+        if not span_path:
+            return 200, {"note": f"no span sink configured "
+                                 f"({SPAN_PATH_ENV} unset)"}
+        return 200, cluster_rollup(span_path)
 
     @app.route("GET", "/api/sched/queues")
     def sched_queues(params, query, body):

@@ -1,0 +1,723 @@
+"""Goodput ledger + step-time flight recorder (ISSUE 10): span-stream
+decomposition semantics, ledger reconstruction from real soak streams,
+the flight recorder's ring/dump paths, the on-demand profiler trigger,
+span-sink rotation, the sim's shared-vocabulary goodput tables, and the
+dashboard/operator export surfaces."""
+
+import json
+import os
+import signal
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.obs.goodput import (BADPUT_CATEGORIES, BADPUT_CHECKPOINT,
+                                      BADPUT_COMPILE, BADPUT_OTHER,
+                                      BADPUT_QUEUE_WAIT, BADPUT_RECOMPUTE,
+                                      BADPUT_RESIZE, BADPUT_STALL,
+                                      BADPUT_STARTUP, GOODPUT_ANNOTATION,
+                                      categories_sum_ok, cluster_rollup,
+                                      decompose, export_job_ledger,
+                                      ledger_for)
+from kubeflow_tpu.obs.registry import Registry
+from kubeflow_tpu.obs.trace import (SPAN_MAX_BYTES_ENV, SPAN_PATH_ENV,
+                                    TRACE_ID_ANNOTATION, SpanWriter)
+
+pytestmark = pytest.mark.goodput
+
+
+def _span(name, start, end=None, trace_id="t", component="test", **attrs):
+    rec = {"trace_id": trace_id, "span_id": "s", "parent_id": "",
+           "name": name, "component": component, "start": float(start),
+           "end": float(end if end is not None else start)}
+    if attrs:
+        rec["attrs"] = attrs
+    return rec
+
+
+def _sum(ledger) -> float:
+    return ledger["goodputSeconds"] + sum(ledger["badputSeconds"].values())
+
+
+class TestDecompose:
+    def test_empty_stream(self):
+        led = decompose([])
+        assert led["wallSeconds"] == 0.0
+        assert set(led["badputSeconds"]) == set(BADPUT_CATEGORIES)
+        assert categories_sum_ok(led)
+
+    def test_queue_wait_from_queued_bound_pairs(self):
+        led = decompose([
+            _span("queued", 0.0, chips=8),
+            _span("bound", 10.0, chips=8),
+            _span("window", 10.0, 20.0, step=10, steps=10),
+        ])
+        assert led["badputSeconds"][BADPUT_QUEUE_WAIT] == pytest.approx(10.0)
+        assert led["goodputSeconds"] == pytest.approx(10.0)
+        assert led["chips"] == 8
+        assert categories_sum_ok(led)
+
+    def test_never_bound_job_is_all_queue_wait(self):
+        led = decompose([_span("queued", 0.0, chips=4),
+                         _span("queued-heartbeat", 30.0)])
+        assert led["badputSeconds"][BADPUT_QUEUE_WAIT] == pytest.approx(30.0)
+        assert led["goodputSeconds"] == 0.0
+        assert categories_sum_ok(led)
+
+    def test_high_water_splits_replayed_windows(self):
+        # trained to step 4, restarted from the step-2 checkpoint, and
+        # re-ran 3..4 before new ground 5..6: the replay is recompute
+        led = decompose([
+            _span("window", 0.0, 4.0, step=4, steps=4),
+            _span("window", 10.0, 14.0, step=6, steps=4),  # 3,4 replayed
+        ])
+        assert led["steps"] == 6
+        assert led["stepsRecomputed"] == 2
+        assert led["badputSeconds"][BADPUT_RECOMPUTE] == pytest.approx(2.0)
+        assert led["goodputSeconds"] == pytest.approx(6.0)
+        assert categories_sum_ok(led)
+
+    def test_compile_outranks_first_window_and_splits_by_kind(self):
+        # the first window CONTAINS the first step's compile: those
+        # seconds are startup cost, not training
+        led = decompose([
+            _span("train-start", 0.0),
+            _span("window", 0.0, 5.0, step=1, steps=1),
+            _span("first-step", 4.0, start_kind="warm", seconds=4.0,
+                  step=1),
+        ])
+        assert led["badputSeconds"][BADPUT_COMPILE] == pytest.approx(4.0)
+        assert led["compileByStartKind"] == {"warm": 4.0}
+        assert led["goodputSeconds"] == pytest.approx(1.0)
+        assert categories_sum_ok(led)
+
+    def test_compile_interval_clipped_to_stream(self):
+        # the seconds attr measures from train() entry, which can
+        # predate the job's first span — never invent pre-stream time
+        led = decompose([
+            _span("train-start", 0.0),
+            _span("first-step", 2.0, start_kind="cold", seconds=10.0),
+            _span("window", 2.0, 3.0, step=1, steps=1),
+        ])
+        assert led["wallSeconds"] == pytest.approx(3.0)
+        assert led["badputSeconds"][BADPUT_COMPILE] == pytest.approx(2.0)
+        assert led["compileByStartKind"]["cold"] == pytest.approx(2.0)
+        assert categories_sum_ok(led)
+
+    def test_checkpoint_spans_counted(self):
+        led = decompose([
+            _span("window", 0.0, 4.0, step=4, steps=4),
+            _span("ckpt-save", 4.0, 5.5, step=4),
+            _span("ckpt-restore", 6.0, 6.5, step=4),
+            _span("window", 6.5, 8.5, step=6, steps=2),
+        ])
+        assert led["badputSeconds"][BADPUT_CHECKPOINT] == pytest.approx(2.0)
+        assert categories_sum_ok(led)
+
+    def test_stall_and_restart_downtime(self):
+        # last activity at t=4; watchdog tears down at t=34; the gang's
+        # next sign of life at t=40 — wedged stretch is stall, the
+        # restart stretch startup
+        led = decompose([
+            _span("window", 0.0, 4.0, step=4, steps=4),
+            _span("restarting", 34.0, reason="StallTimeout"),
+            _span("train-start", 40.0),
+            _span("window", 40.0, 42.0, step=6, steps=2),
+        ])
+        assert led["badputSeconds"][BADPUT_STALL] == pytest.approx(30.0)
+        assert led["badputSeconds"][BADPUT_STARTUP] == pytest.approx(6.0)
+        assert categories_sum_ok(led)
+
+    def test_resize_downtime(self):
+        led = decompose([
+            _span("window", 0.0, 4.0, step=4, steps=4),
+            _span("resized", 4.0, direction="shrink"),
+            _span("train-start", 9.0),
+            _span("window", 9.0, 10.0, step=5, steps=1),
+        ])
+        assert led["badputSeconds"][BADPUT_RESIZE] == pytest.approx(5.0)
+        assert categories_sum_ok(led)
+
+    def test_unattributed_residual_lands_in_other(self):
+        led = decompose([
+            _span("window", 0.0, 1.0, step=1, steps=1),
+            _span("train-done", 11.0),
+        ])
+        assert led["badputSeconds"][BADPUT_OTHER] == pytest.approx(10.0)
+        assert categories_sum_ok(led)
+
+    def test_partition_is_exact_on_rich_stream(self):
+        led = decompose([
+            _span("queued", 0.0, chips=8),
+            _span("bound", 5.0, chips=8),
+            _span("train-start", 7.0),
+            _span("window", 7.0, 12.0, step=2, steps=2),
+            _span("first-step", 11.0, start_kind="cold", seconds=4.0,
+                  step=1),
+            _span("ckpt-save", 12.0, 12.5, step=2),
+            _span("preempted", 13.0),
+            _span("queued", 13.0, chips=8),
+            _span("bound", 20.0, chips=8),
+            _span("window", 22.0, 24.0, step=4, steps=2),
+            _span("succeeded", 24.5),
+        ])
+        assert _sum(led) == pytest.approx(led["wallSeconds"], abs=1e-6)
+        assert led["badputSeconds"][BADPUT_QUEUE_WAIT] == \
+            pytest.approx(12.0)
+        assert categories_sum_ok(led)
+
+
+class TestExportAndRollup:
+    def test_export_job_ledger_gauges(self):
+        reg = Registry()
+        led = decompose([_span("queued", 0.0), _span("bound", 2.0,
+                                                     chips=8),
+                         _span("window", 2.0, 4.0, step=2, steps=2)])
+        export_job_ledger("ns1", "job1", led, registry=reg)
+        text = reg.render()
+        assert 'kftpu_job_goodput_ratio{namespace="ns1",name="job1"}' \
+            in text
+        # _total series keeps the Prometheus counter convention (the
+        # registry's snapshot-bridge set())
+        assert "# TYPE kftpu_job_badput_seconds_total counter" in text
+        for cat in BADPUT_CATEGORIES:
+            assert f'category="{cat}"' in text
+
+    def test_remove_job_ledger_drops_series(self):
+        from kubeflow_tpu.obs.goodput import remove_job_ledger
+        reg = Registry()
+        led = decompose([_span("bound", 0.0, chips=8),
+                         _span("window", 0.0, 2.0, step=2, steps=2)])
+        export_job_ledger("ns1", "gone", led, registry=reg)
+        export_job_ledger("ns1", "kept", led, registry=reg)
+        remove_job_ledger("ns1", "gone", registry=reg)
+        text = reg.render()
+        assert 'name="gone"' not in text
+        assert 'name="kept"' in text
+
+    def test_cluster_rollup_weights_by_chips(self, tmp_path):
+        sink = str(tmp_path / "s.jsonl")
+        with open(sink, "w") as f:
+            for rec in (
+                    _span("bound", 0.0, trace_id="a", chips=8),
+                    _span("window", 0.0, 10.0, trace_id="a", step=10,
+                          steps=10),
+                    _span("queued", 0.0, trace_id="b", chips=4),
+                    _span("queued-end", 5.0, trace_id="b")):
+                f.write(json.dumps(rec) + "\n")
+        roll = cluster_rollup(sink)
+        assert len(roll["jobs"]) == 2
+        assert roll["jobsNeverBound"] == 1
+        # job a: 10s goodput on 8 chips = 80 chip-seconds (rollup
+        # rounds to 6 decimals)
+        assert roll["chipHours"]["goodput"] == \
+            pytest.approx(80 / 3600.0, abs=1e-6)
+        assert roll["goodputRatio"] == pytest.approx(1.0)
+
+    def test_ledger_for_missing_sink(self, tmp_path):
+        led = ledger_for(str(tmp_path / "missing.jsonl"), "t")
+        assert led["wallSeconds"] == 0.0
+
+
+class TestFlightRecorder:
+    def _recorder(self, windows=4):
+        from kubeflow_tpu.runtime.metrics import FlightRecorder
+        return FlightRecorder(windows=windows)
+
+    def test_ring_is_bounded(self):
+        rec = self._recorder(windows=3)
+        for i in range(6):
+            rec.note_step(data_s=0.01, dispatch_s=0.02)
+            rec.close_window(i + 1, 1, 0.05)
+        snap = rec.snapshot()
+        assert len(snap["records"]) == 3
+        assert [r["step"] for r in snap["records"]] == [4, 5, 6]
+
+    def test_window_record_stage_breakdown(self):
+        rec = self._recorder()
+        rec.note_step(data_s=0.01, h2d_s=0.005, dispatch_s=0.002)
+        rec.note_step(data_s=0.01, h2d_s=0.005, dispatch_s=0.002)
+        rec.close_window(2, 2, 0.1, drain_s=0.01)
+        r = rec.snapshot()["records"][0]
+        assert r["steps"] == 2
+        assert r["data_s"] == pytest.approx(0.02)
+        assert r["h2d_s"] == pytest.approx(0.01)
+        assert r["dispatch_s"] == pytest.approx(0.004)
+        # residual: wall + drain minus explained host time
+        assert r["device_wait_s"] == pytest.approx(0.11 - 0.034)
+        assert "input_batches" in r
+
+    def test_dump_emits_span_with_in_progress_state(self, tmp_path):
+        rec = self._recorder()
+        rec.note_step(data_s=0.01)
+        rec.close_window(1, 1, 0.05)
+        rec.mark("step", 2)
+        w = SpanWriter(str(tmp_path / "s.jsonl"), "worker", trace_id="t")
+        assert rec.dump(w, "sigterm", extra="x") is not None
+        w.close()
+        recs = [json.loads(line)
+                for line in open(tmp_path / "s.jsonl")]
+        assert len(recs) == 1
+        attrs = recs[0]["attrs"]
+        assert recs[0]["name"] == "flight-record"
+        assert attrs["reason"] == "sigterm"
+        assert attrs["inProgress"]["stage"] == "step"
+        assert attrs["inProgress"]["step"] == 2
+        assert len(attrs["records"]) == 1
+
+    def test_first_step_compile_not_charged_to_dispatch(self):
+        rec = self._recorder()
+        rec.note_step(data_s=0.001, dispatch_s=0.0, first_step_s=3.0)
+        rec.note_step(data_s=0.001, dispatch_s=0.002)
+        rec.close_window(2, 2, 3.1)
+        r = rec.snapshot()["records"][0]
+        assert r["dispatch_s"] == pytest.approx(0.002)
+        assert r["first_step_s"] == pytest.approx(3.0)
+        # the compile does not masquerade as device wait either
+        assert r["device_wait_s"] == pytest.approx(3.1 - 3.004)
+
+    def test_dump_without_tracer_or_disabled_is_noop(self):
+        rec = self._recorder()
+        assert rec.dump(None, "crash") is None
+        off = self._recorder(windows=0)
+        assert off.dump(object(), "crash") is None
+
+    def test_sigterm_handler_dumps(self, tmp_path):
+        # the teardown evidence path: PreemptionGuard's SIGTERM handler
+        # both sets the stop flag AND dumps the ring
+        from kubeflow_tpu.runtime.worker import PreemptionGuard
+        rec = self._recorder()
+        rec.close_window(1, 1, 0.05)
+        w = SpanWriter(str(tmp_path / "s.jsonl"), "worker", trace_id="t")
+        guard = PreemptionGuard(
+            install=True, on_term=lambda: rec.dump(w, "sigterm"))
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+        finally:
+            guard.uninstall()
+        w.close()
+        assert guard.stop is True
+        recs = [json.loads(line) for line in open(tmp_path / "s.jsonl")]
+        assert recs and recs[0]["name"] == "flight-record"
+
+
+class TestProfileArm:
+    def _arm(self, tmp_path, calls):
+        from kubeflow_tpu.runtime.metrics import ProfileArm
+        return ProfileArm(
+            base_dir=str(tmp_path),
+            start_fn=lambda d: calls.append(("start", d)),
+            stop_fn=lambda: calls.append(("stop",)))
+
+    def test_arm_capture_stop_cycle(self, tmp_path):
+        calls = []
+        arm = self._arm(tmp_path, calls)
+        code, body = arm.request(2)
+        assert code == 200 and body["armed"] and body["steps"] == 2
+        arm.on_step_start()
+        assert calls and calls[0][0] == "start"
+        assert calls[0][1] == body["dir"]
+        arm.on_step_end(1)
+        assert len(calls) == 1        # still one step to go
+        arm.on_step_start()           # no second start while active
+        arm.on_step_end(2)
+        assert calls[-1] == ("stop",)
+        # a finished capture can be re-armed
+        code, _ = arm.request(1)
+        assert code == 200
+
+    def test_overlapping_request_rejected(self, tmp_path):
+        arm = self._arm(tmp_path, [])
+        assert arm.request(3)[0] == 200
+        code, body = arm.request(1)
+        assert code == 409 and "error" in body
+
+    def test_bad_steps_rejected(self, tmp_path):
+        arm = self._arm(tmp_path, [])
+        assert arm.request("nope")[0] == 400
+        assert arm.request(0)[0] == 400
+
+    def test_obs_server_mounts_profile_and_flightrecorder(self, tmp_path):
+        from kubeflow_tpu.obs.http import ObsServer
+        from kubeflow_tpu.runtime.metrics import FlightRecorder
+        calls = []
+        arm = self._arm(tmp_path, calls)
+        rec = FlightRecorder(windows=2)
+        rec.close_window(1, 1, 0.1)
+        srv = ObsServer(Registry(), host="127.0.0.1", handlers={
+            ("POST", "/profile"):
+                lambda q: arm.request(q.get("steps", 0)),
+            ("GET", "/flightrecorder"): lambda q: (200, rec.snapshot()),
+        })
+        port = srv.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/profile?steps=3", data=b"",
+                method="POST")
+            with urllib.request.urlopen(req) as resp:
+                body = json.loads(resp.read())
+            assert body["armed"] and body["steps"] == 3
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/flightrecorder") as resp:
+                snap = json.loads(resp.read())
+            assert len(snap["records"]) == 1
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{port}/nope", data=b"",
+                    method="POST"))
+        finally:
+            srv.stop()
+
+
+class TestSpanRotation:
+    def test_rotation_at_cap(self, tmp_path):
+        path = str(tmp_path / "s.jsonl")
+        w = SpanWriter(path, "test", trace_id="t", max_bytes=600)
+        for i in range(40):
+            w.emit("window", start=float(i), end=float(i) + 1, step=i,
+                   steps=1)
+        w.close()
+        assert os.path.exists(path + ".1")
+        assert os.path.getsize(path) <= 600
+        # BOTH generations parse as clean JSONL (no torn lines)
+        for p in (path, path + ".1"):
+            for line in open(p):
+                json.loads(line)
+
+    def test_rotation_env_knob(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(SPAN_MAX_BYTES_ENV, "500")
+        path = str(tmp_path / "s.jsonl")
+        w = SpanWriter(path, "test", trace_id="t")
+        assert w.max_bytes == 500
+        for i in range(30):
+            w.event("queued", step=i)
+        w.close()
+        assert os.path.exists(path + ".1")
+
+    def test_two_writers_share_a_rotating_sink_without_loss(self,
+                                                            tmp_path):
+        # the deployed shape: several writers (operator, scheduler,
+        # worker + its dump writer) append to ONE capped sink. A writer
+        # holding a handle onto a file a sibling already rotated must
+        # re-open, not keep feeding the stale inode — and must never
+        # clobber the sibling's fresh active file over the prior
+        # generation. Total volume stays under 2x the cap, so every
+        # record must survive across active + .1.
+        path = str(tmp_path / "s.jsonl")
+        a = SpanWriter(path, "op", trace_id="t", max_bytes=2000)
+        b = SpanWriter(path, "wk", trace_id="t", max_bytes=2000)
+        n = 0
+        for i in range(12):
+            a.emit("window", start=float(i), end=float(i) + 1, step=i,
+                   steps=1)
+            b.emit("window", start=float(i), end=float(i) + 1, step=i,
+                   steps=1)
+            n += 2
+        a.close()
+        b.close()
+        survived = 0
+        for p in (path, path + ".1"):
+            if os.path.exists(p):
+                for line in open(p):
+                    json.loads(line)
+                    survived += 1
+        assert survived == n, f"lost {n - survived} spans to rotation"
+
+    def test_no_cap_never_rotates(self, tmp_path):
+        path = str(tmp_path / "s.jsonl")
+        w = SpanWriter(path, "test", trace_id="t")
+        for i in range(50):
+            w.event("queued", step=i)
+        w.close()
+        assert not os.path.exists(path + ".1")
+
+    def test_operator_manifest_renders_cap(self):
+        from kubeflow_tpu.manifests.training import tpu_job_operator
+        dep = next(o for o in tpu_job_operator(span_max_bytes=1048576)
+                   if o["kind"] == "Deployment")
+        env = {e["name"]: e["value"] for e in
+               dep["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env[SPAN_MAX_BYTES_ENV] == "1048576"
+        # knob off: no env block entry
+        dep = next(o for o in tpu_job_operator()
+                   if o["kind"] == "Deployment")
+        env = {e["name"]: e["value"] for e in
+               (dep["spec"]["template"]["spec"]["containers"][0]
+                .get("env") or [])}
+        assert SPAN_MAX_BYTES_ENV not in env
+
+    def test_operator_forwards_cap_to_workers(self, tmp_path,
+                                              monkeypatch):
+        from kubeflow_tpu.cluster.fake import FakeCluster
+        from kubeflow_tpu.controllers.runtime import Manager
+        from kubeflow_tpu.controllers.tpujob import TrainingJobReconciler
+        monkeypatch.setenv(SPAN_PATH_ENV, str(tmp_path / "s.jsonl"))
+        monkeypatch.setenv(SPAN_MAX_BYTES_ENV, "2048")
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        mgr = Manager(cluster)
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        try:
+            cluster.create(_job_manifest(name="cap-job"))
+            for _ in range(3):
+                mgr.run_pending()
+                cluster.tick()
+            pod = cluster.get("v1", "Pod", "kubeflow",
+                              "cap-job-worker-0-0")
+            env = {e["name"]: e.get("value", "") for e in
+                   pod["spec"]["containers"][0].get("env", [])}
+            assert env[SPAN_MAX_BYTES_ENV] == "2048"
+        finally:
+            for c in mgr.controllers:
+                c.stop()
+
+
+class TestSimGoodput:
+    def test_simulate_reports_shared_vocabulary(self):
+        from kubeflow_tpu.scheduler.sim import make_workload, simulate
+        r = simulate(make_workload(0, n_jobs=12), pools=("v5e-16",),
+                     policy="preempt")
+        table = r["goodput"]
+        assert set(table["badput"]) == set(BADPUT_CATEGORIES)
+        assert 0.0 <= table["goodput_fraction"] <= 1.0
+        # contention on one small pool must show queue-wait badput
+        assert table["badput"][BADPUT_QUEUE_WAIT] > 0
+
+    def test_restart_cost_shows_as_startup_and_resize(self):
+        from kubeflow_tpu.scheduler.sim import make_workload, simulate
+        jobs = make_workload(1, n_jobs=12, elastic_frac=1.0)
+        r = simulate(jobs, pools=("v5e-16",), policy="elastic",
+                     restart_ticks=1.0)
+        bad = r["goodput"]["badput"]
+        assert bad[BADPUT_STARTUP] > 0
+        if r["resizes"]:
+            assert bad[BADPUT_RESIZE] > 0
+
+    def test_compare_policies_aggregates_goodput(self):
+        from kubeflow_tpu.scheduler.sim import compare_policies
+        table = compare_policies([0], n_jobs=8, pools=("v5e-16",))
+        for row in table.values():
+            assert set(row["badput_chip_ticks"]) == set(BADPUT_CATEGORIES)
+            assert "goodput_fraction" in row
+
+
+def _job_manifest(name="gp-job", scheduled=False) -> dict:
+    spec: dict = {"replicaSpecs": {"TPU": {
+        "tpuTopology": "v5e-8",
+        "template": {"spec": {"containers": [
+            {"name": "jax", "image": "trainer:v1"}]}}}}}
+    if scheduled:
+        spec["schedulingPolicy"] = {"queue": "research", "priority": 1}
+    return {"apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": name, "namespace": "kubeflow"},
+            "spec": spec}
+
+
+class TestDashboardEndpoints:
+    def _sink_with_trace(self, tmp_path, trace_id):
+        sink = str(tmp_path / "spans.jsonl")
+        with open(sink, "w") as f:
+            for rec in (_span("queued", 0.0, trace_id=trace_id, chips=8),
+                        _span("bound", 4.0, trace_id=trace_id, chips=8),
+                        _span("window", 6.0, 10.0, trace_id=trace_id,
+                              step=4, steps=4)):
+                f.write(json.dumps(rec) + "\n")
+        return sink
+
+    def test_job_goodput_endpoint(self, tmp_path, monkeypatch):
+        from kubeflow_tpu.cluster.fake import FakeCluster
+        from kubeflow_tpu.webapps.dashboard import build_dashboard_app
+        sink = self._sink_with_trace(tmp_path, "dash1")
+        monkeypatch.setenv(SPAN_PATH_ENV, sink)
+        cluster = FakeCluster()
+        manifest = _job_manifest()
+        manifest["metadata"]["annotations"] = {TRACE_ID_ANNOTATION:
+                                               "dash1"}
+        cluster.create(manifest)
+        app = build_dashboard_app(cluster)
+        status, body = app.dispatch(
+            "GET", "/api/obs/goodput/kubeflow/gp-job", None)
+        assert status == 200 and body["source"] == "spans"
+        led = body["ledger"]
+        assert set(led["badputSeconds"]) == set(BADPUT_CATEGORIES)
+        assert led["badputSeconds"][BADPUT_QUEUE_WAIT] == \
+            pytest.approx(4.0)
+        # cluster rollup from the same sink
+        status, roll = app.dispatch("GET", "/api/obs/goodput", None)
+        assert status == 200 and roll["chipHours"]["total"] > 0
+
+    def test_annotation_fallback_when_spans_gone(self, tmp_path,
+                                                 monkeypatch):
+        from kubeflow_tpu.cluster.fake import FakeCluster
+        from kubeflow_tpu.webapps.dashboard import build_dashboard_app
+        monkeypatch.setenv(SPAN_PATH_ENV,
+                           str(tmp_path / "empty.jsonl"))
+        cluster = FakeCluster()
+        manifest = _job_manifest()
+        manifest["metadata"]["annotations"] = {
+            TRACE_ID_ANNOTATION: "rotated-away",
+            GOODPUT_ANNOTATION: json.dumps({"goodputRatio": 0.8}),
+        }
+        cluster.create(manifest)
+        app = build_dashboard_app(cluster)
+        status, body = app.dispatch(
+            "GET", "/api/obs/goodput/kubeflow/gp-job", None)
+        assert status == 200 and body["source"] == "annotation"
+        assert body["ledger"]["goodputRatio"] == 0.8
+
+    def test_unknown_job_404(self, tmp_path, monkeypatch):
+        from kubeflow_tpu.cluster.fake import FakeCluster
+        from kubeflow_tpu.webapps.dashboard import build_dashboard_app
+        app = build_dashboard_app(FakeCluster())
+        status, _ = app.dispatch(
+            "GET", "/api/obs/goodput/kubeflow/ghost", None)
+        assert status == 404
+
+
+class TestOperatorFinalLedger:
+    def test_completion_stamps_annotation_and_gauges(self, tmp_path,
+                                                     monkeypatch):
+        from kubeflow_tpu.api import k8s
+        from kubeflow_tpu.cluster.fake import FakeCluster
+        from kubeflow_tpu.controllers.runtime import Manager
+        from kubeflow_tpu.controllers.tpujob import TrainingJobReconciler
+        from kubeflow_tpu.obs.registry import (default_registry,
+                                               reset_default_registry)
+        from kubeflow_tpu.scheduler.core import SliceScheduler
+
+        sink = str(tmp_path / "spans.jsonl")
+        monkeypatch.setenv(SPAN_PATH_ENV, sink)
+        from kubeflow_tpu.obs.trace import reset_default_tracers
+        reset_default_tracers()
+        reset_default_registry()
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        mgr = Manager(cluster)
+        mgr.add(SliceScheduler())
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        try:
+            cluster.create(_job_manifest(name="done-job", scheduled=True))
+            for _ in range(3):
+                mgr.run_pending()
+                cluster.tick()
+            mgr.run_pending()
+            cluster.set_pod_phase("kubeflow", "done-job-worker-0-0",
+                                  "Succeeded")
+            for _ in range(3):
+                mgr.run_pending()
+                cluster.tick()
+            mgr.run_pending()
+            job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                              "kubeflow", "done-job")
+            assert k8s.condition_true(job, "Succeeded")
+            final = k8s.annotations_of(job).get(GOODPUT_ANNOTATION)
+            assert final, "no final ledger stamped on completion"
+            payload = json.loads(final)
+            assert set(payload["badputSeconds"]) == set(BADPUT_CATEGORIES)
+            assert payload["wallSeconds"] > 0
+            text = default_registry().render()
+            assert 'kftpu_job_goodput_ratio{namespace="kubeflow",' \
+                   'name="done-job"}' in text
+            assert "kftpu_job_badput_seconds_total" in text
+        finally:
+            for c in mgr.controllers:
+                c.stop()
+            reset_default_tracers()
+            reset_default_registry()
+
+
+@pytest.mark.compute
+class TestWorkerLedgerIntegration:
+    def test_train_stream_decomposes_and_sums(self, tmp_path,
+                                              monkeypatch):
+        from kubeflow_tpu.obs.trace import load_spans
+        from kubeflow_tpu.runtime.worker import train
+        sink = str(tmp_path / "spans.jsonl")
+        monkeypatch.setenv(SPAN_PATH_ENV, sink)
+        monkeypatch.setenv("KFTPU_TRACE_ID", "wk1")
+        train(workload="transformer", steps=4, global_batch=8,
+              sync_every=2, checkpoint_dir=str(tmp_path / "ckpt"),
+              checkpoint_every=2, workload_kwargs={})
+        spans = load_spans(sink, trace_id="wk1")
+        names = {s["name"] for s in spans}
+        assert {"train-start", "first-step", "window", "ckpt-save",
+                "train-done"} <= names
+        led = decompose(spans)
+        assert led["steps"] == 4 and led["stepsRecomputed"] == 0
+        assert led["badputSeconds"][BADPUT_CHECKPOINT] > 0
+        assert led["badputSeconds"][BADPUT_COMPILE] > 0
+        assert categories_sum_ok(led)
+
+    def test_resume_replay_shows_as_recompute(self, tmp_path,
+                                              monkeypatch):
+        from kubeflow_tpu.obs.trace import load_spans
+        from kubeflow_tpu.runtime.worker import train
+        sink = str(tmp_path / "spans.jsonl")
+        ckpt = str(tmp_path / "ckpt")
+        monkeypatch.setenv(SPAN_PATH_ENV, sink)
+        monkeypatch.setenv("KFTPU_TRACE_ID", "wk2")
+        # run to 3, then lose the forced final save (the crash-between-
+        # save-and-exit shape): the restart resumes at the step-2
+        # checkpoint and replays step 3 — one recomputed step
+        import shutil
+        train(workload="transformer", steps=3, global_batch=8,
+              sync_every=1, checkpoint_dir=ckpt, checkpoint_every=2,
+              workload_kwargs={})
+        shutil.rmtree(os.path.join(ckpt, "3"))
+        r = train(workload="transformer", steps=5, global_batch=8,
+                  sync_every=1, checkpoint_dir=ckpt, checkpoint_every=2,
+                  workload_kwargs={})
+        led = decompose(load_spans(sink, trace_id="wk2"))
+        executed = 3 + r.steps
+        assert led["steps"] == 5
+        assert led["stepsRecomputed"] == executed - 5 == 1
+        assert led["badputSeconds"][BADPUT_RECOMPUTE] > 0
+        assert categories_sum_ok(led)
+
+
+@pytest.mark.slow
+class TestSoakLedgers:
+    """Ledger reconstruction from REAL soak span streams (the
+    acceptance shape bench.py --mode goodput reruns): categories sum to
+    wall-clock, and restart-recompute matches the soak's own count of
+    re-executed steps."""
+
+    def test_chaos_soak_ledger(self, tmp_path, monkeypatch):
+        from kubeflow_tpu.cluster.chaos import ChaosSoak, SoakFault
+        sink = str(tmp_path / "spans.jsonl")
+        monkeypatch.setenv(SPAN_PATH_ENV, sink)
+        faults = [SoakFault(2, "pod-kill"), SoakFault(3, "api-burst"),
+                  SoakFault(4, "watch-drop"),
+                  SoakFault(5, "truncate-ckpt"),
+                  SoakFault(6, "hung-chief")]
+        report = ChaosSoak(workdir=str(tmp_path / "soak"), faults=faults,
+                           total_steps=8, checkpoint_every=2).run()
+        assert report["outcome"] == "succeeded"
+        led = ledger_for(sink, report["trace_id"])
+        assert categories_sum_ok(led)
+        known = report["executed_steps"] - report["final_step"]
+        assert led["stepsRecomputed"] == known
+        assert led["steps"] == report["final_step"]
+        # the hung-chief fault must surface as stall badput
+        assert led["badputSeconds"][BADPUT_STALL] > 0
+        assert led["badputSeconds"][BADPUT_CHECKPOINT] > 0
+
+    def test_preemption_soak_ledger(self, tmp_path, monkeypatch):
+        from kubeflow_tpu.api import k8s
+        from kubeflow_tpu.scheduler.soak import PreemptionSoak
+        sink = str(tmp_path / "spans.jsonl")
+        monkeypatch.setenv(SPAN_PATH_ENV, sink)
+        soak = PreemptionSoak(workdir=str(tmp_path / "soak"))
+        report = soak.run()
+        assert report["outcome"] == "succeeded"
+        tid = k8s.annotations_of(report["victim_manifest"]).get(
+            TRACE_ID_ANNOTATION)
+        led = ledger_for(sink, tid)
+        assert categories_sum_ok(led)
+        # preempted AT a checkpoint boundary: resume loses zero steps,
+        # and the ledger must agree with the soak's executed-step count
+        known = report["victim_executed_steps"] - soak.total_steps
+        assert led["stepsRecomputed"] == known == 0
+        # two queue waits (admission + requeue after preemption)
+        assert led["badputSeconds"][BADPUT_QUEUE_WAIT] > 0
+        assert led["chips"] == 8

@@ -514,6 +514,66 @@ def test_node_health_contract_is_shared_not_duplicated():
         tpu_scheduler(health={"quarantineTreshold": 2})
 
 
+def test_badput_categories_defined_once_and_shared():
+    """The goodput/badput category vocabulary must have ONE definition
+    (obs/goodput.py) consumed by the ledger, the sim, the dashboard,
+    the operator's final-ledger export, and the bench alike — the
+    binding_of rule: sim arms and the real cluster must report
+    COMPARABLE decompositions, so a category-name drift between them
+    silently breaks every cross-table read."""
+    import subprocess
+
+    from kubeflow_tpu.obs.goodput import (BADPUT_CATEGORIES,
+                                          BADPUT_OTHER, decompose)
+
+    assert BADPUT_CATEGORIES == (
+        "queue_wait", "startup", "compile", "checkpoint",
+        "restart_recompute", "resize", "stall", "other")
+
+    # single definition: the distinctive category literals appear as
+    # quoted strings in exactly one source file — every other layer
+    # imports the names (common-word categories like "compile" would
+    # false-positive a grep, so the check pins the unambiguous ones)
+    pkg = os.path.join(REPO_ROOT, "kubeflow_tpu")
+    for literal in ("queue_wait", "restart_recompute"):
+        hits = subprocess.run(
+            ["grep", "-rl", f'"{literal}"', pkg],
+            capture_output=True, text=True).stdout.split()
+        assert [os.path.relpath(h, pkg) for h in hits] == \
+            [os.path.join("obs", "goodput.py")], \
+            f"{literal!r} defined outside obs/goodput.py: {hits}"
+
+    def src(*rel):
+        with open(os.path.join(REPO_ROOT, *rel)) as f:
+            return f.read()
+
+    # the consumers go through the shared module, not re-spelled names
+    sim_src = src("kubeflow_tpu", "scheduler", "sim.py")
+    for use in ("from ..obs import goodput as gp", "gp.BADPUT_QUEUE_WAIT",
+                "gp.BADPUT_CATEGORIES"):
+        assert use in sim_src, f"scheduler/sim.py must consume {use}"
+    dash_src = src("kubeflow_tpu", "webapps", "dashboard.py")
+    assert "from ..obs.goodput import" in dash_src
+    ctrl_src = src("kubeflow_tpu", "controllers", "tpujob.py")
+    for use in ("export_job_ledger", "ledger_for", "GOODPUT_ANNOTATION"):
+        assert use in ctrl_src, \
+            f"controllers/tpujob.py must consume {use}"
+    bench_src = src("bench.py")
+    assert "gp.BADPUT_CATEGORIES" in bench_src
+
+    # every ledger reports the FULL vocabulary (zeros, not omissions) —
+    # tables line up column-for-column across surfaces
+    led = decompose([])
+    assert set(led["badputSeconds"]) == set(BADPUT_CATEGORIES)
+    assert BADPUT_OTHER in led["badputSeconds"]
+
+    # ...and the sim's table does too
+    from kubeflow_tpu.scheduler.sim import make_workload, simulate
+    row = simulate(make_workload(0, n_jobs=4), pools=("v5e-16",),
+                   policy="fifo")
+    assert set(row["goodput"]["badput"]) == set(BADPUT_CATEGORIES)
+
+
 def test_run_policy_fields_are_plumbed_end_to_end():
     """Every RunPolicy field must be plumbed spec → controller →
     manifests: round-trip through the TPUJob spec wire format

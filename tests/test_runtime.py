@@ -388,7 +388,7 @@ class TestPreemption:
         class FlipAfterReads:
             """Guard whose stop flag flips True after N reads — a
             deterministic stand-in for SIGTERM arriving mid-loop."""
-            def __init__(self, install=True):
+            def __init__(self, install=True, on_term=None):
                 self.reads = 0
             @property
             def stop(self):

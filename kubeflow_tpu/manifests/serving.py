@@ -27,15 +27,28 @@ def tpu_serving(namespace: str = "kubeflow", name: str = "model-server",
                 tpu_topology: str = "v5e-1", num_replicas: int = 1,
                 enable_http_proxy: bool = True, enable_hpa: bool = False,
                 hpa_min: int = 1, hpa_max: int = 4,
-                reload_interval_s: int = 30) -> list[dict]:
+                reload_interval_s: int = 30,
+                slo_p99_ms: float = None,
+                slo_availability: float = None,
+                max_pending: int = 0) -> list[dict]:
+    """``slo_p99_ms`` / ``slo_availability`` declare the model's SLO
+    (serving/replica_state.py renders burn-rate gauges on /metrics);
+    ``max_pending`` bounds the batcher queue — past it requests shed
+    with 429 instead of queueing unbounded."""
     from .observability import scrape_annotations
     lbl = {**H.std_labels(name), "kubeflow.org/servable": model_name}
+    args = [f"--model-path={model_path}", f"--model-name={model_name}",
+            "--grpc-port=9000", "--rest-port=8500",
+            f"--reload-interval={reload_interval_s}"]
+    if slo_p99_ms is not None:
+        args.append(f"--slo-p99-ms={slo_p99_ms}")
+    if slo_availability is not None:
+        args.append(f"--slo-availability={slo_availability}")
+    if max_pending:
+        args.append(f"--max-pending={max_pending}")
     dep = H.deployment(
         name, namespace, f"{IMG}/tpu-model-server:{MODEL_SERVER_VERSION}",
-        replicas=num_replicas,
-        args=[f"--model-path={model_path}", f"--model-name={model_name}",
-              "--grpc-port=9000", "--rest-port=8500",
-              f"--reload-interval={reload_interval_s}"],
+        replicas=num_replicas, args=args,
         labels=lbl, port=9000,
         # the model server's /metrics rides the REST port
         pod_annotations=scrape_annotations(8500))
@@ -127,7 +140,11 @@ def tpu_serving_simple(namespace: str = "kubeflow",
     return tpu_serving(namespace=namespace, name=name,
                        model_path="gs://kubeflow-tpu-examples/mnist/servable",
                        model_name="mnist", tpu_topology="v5e-1",
-                       enable_http_proxy=True)
+                       enable_http_proxy=True,
+                       # the declarative SLO + bounded queue the serving
+                       # observability plane tracks (ISSUE 11)
+                       slo_p99_ms=250.0, slo_availability=0.999,
+                       max_pending=256)
 
 
 @register("katib-studyjob-example", "Example StudyJob: random search over "

@@ -8,11 +8,19 @@ with a TF-Serving-compatible REST surface.
 
 - :mod:`servable` — model loading (checkpoint → jitted predict), registry.
 - :mod:`batcher`  — micro-batching queue with bucketed padding (static
-  shapes: one XLA compile per bucket, never per request).
+  shapes: one XLA compile per bucket, never per request), bounded
+  ``max_pending`` load shedding.
 - :mod:`http_server` — REST front: /v1/models/<name>[:predict|/metadata].
 - :mod:`batch_predict` — offline batch prediction job.
+- :mod:`request_trace` — per-request ids + stage spans + ledgers
+  (ISSUE 11: one slow request reconstructs from JSONL alone).
+- :mod:`replica_state` — per-model rolling health + SLO burn rates,
+  published on /metrics and /healthz?verbose=1 for the router and
+  autoscaler.
 """
 
 from .servable import Servable, ModelRepository  # noqa: F401
-from .batcher import MicroBatcher  # noqa: F401
+from .batcher import MicroBatcher, QueueFullError  # noqa: F401
 from .http_server import ModelServer  # noqa: F401
+from .replica_state import ModelSLO, ReplicaState  # noqa: F401
+from .request_trace import ServingObs  # noqa: F401

@@ -5,54 +5,102 @@ request at a time (http-proxy/server.py). On TPU, per-request dispatch
 wastes the MXU — the batcher coalesces requests that arrive within
 ``max_latency_ms`` into a single padded batch, runs one jit call, and
 fans results back out to per-request futures.
+
+Observability (ISSUE 11): each work item may carry a RequestTrace
+(serving/request_trace.py) — the batcher stamps its queue wait,
+batch-form share, H2D/device/pad-waste/drain shares onto it, so one
+request's ledger partitions its wall-clock exactly. A bounded queue
+(``max_pending``) sheds load with an explicit QueueFullError (HTTP
+429 / gRPC RESOURCE_EXHAUSTED upstream) instead of growing the queue
+unbounded — the shed request's wait is recorded as ``queue`` badput,
+never dropped from the ledger. Queue depth and oldest-waiting age are
+polled by the replica registry at scrape time (zero hot-path cost).
 """
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass
+from typing import Optional
 
 import jax
 import numpy as np
+
+
+class QueueFullError(RuntimeError):
+    """The bounded batcher queue is at max_pending: shed this request
+    (429 / RESOURCE_EXHAUSTED) rather than queue it unbounded."""
 
 
 @dataclass
 class _WorkItem:
     instances: np.ndarray
     future: Future
+    ctx: Optional[object] = None      # RequestTrace (or None)
+    t_enqueue: float = 0.0
 
 
 class MicroBatcher:
     """Collects requests for one servable and dispatches merged batches."""
 
     def __init__(self, servable, max_batch: int = 64,
-                 max_latency_ms: float = 5.0):
+                 max_latency_ms: float = 5.0, max_pending: int = 0):
         self.servable = servable
         self.max_batch = max_batch
         self.max_latency = max_latency_ms / 1000.0
+        # 0 = unbounded (the legacy behavior); N = shed at N waiting
+        self.max_pending = max(0, int(max_pending))
         self._queue: "queue.Queue[_WorkItem]" = queue.Queue()
         self._stop = threading.Event()
         self._submit_lock = threading.Lock()
+        # waiting-item enqueue times for the oldest-age gauge: keyed by
+        # item id, removed when the loop collects the item
+        self._waiting: dict[int, float] = {}
+        self._batch_ids = itertools.count(1)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"batcher-{servable.name}")
         self._thread.start()
 
-    def submit(self, instances: np.ndarray) -> Future:
-        item = _WorkItem(np.asarray(instances), Future())
+    # ------------------------------------------------------ queue telemetry
+
+    def queue_depth(self) -> int:
+        """Requests waiting (not yet pulled into a batch)."""
+        with self._submit_lock:
+            return len(self._waiting)
+
+    def oldest_wait_s(self) -> float:
+        """Age of the oldest waiting request; 0 when the queue is empty."""
+        with self._submit_lock:
+            if not self._waiting:
+                return 0.0
+            return max(0.0, time.time() - min(self._waiting.values()))
+
+    # -------------------------------------------------------------- submit
+
+    def submit(self, instances: np.ndarray,
+               ctx: Optional[object] = None) -> Future:
+        item = _WorkItem(np.asarray(instances), Future(), ctx=ctx)
         # Lock makes the stop-check + put atomic w.r.t. shutdown()'s
         # stop-set + drain, so no item can land after the final drain and
         # leave its future forever unresolved.
         with self._submit_lock:
             if self._stop.is_set():
                 raise RuntimeError("batcher is shut down")
+            if self.max_pending and len(self._waiting) >= self.max_pending:
+                raise QueueFullError(
+                    f"batcher queue full ({self.max_pending} pending)")
+            item.t_enqueue = time.time()
+            self._waiting[id(item)] = item.t_enqueue
             self._queue.put(item)
         return item.future
 
-    def predict(self, instances: np.ndarray, timeout: float = 30.0):
-        return self.submit(instances).result(timeout=timeout)
+    def predict(self, instances: np.ndarray, timeout: float = 30.0,
+                ctx: Optional[object] = None):
+        return self.submit(instances, ctx=ctx).result(timeout=timeout)
 
     def _collect(self) -> list[_WorkItem]:
         """Block for the first item, then drain what arrives within the
@@ -74,24 +122,82 @@ class MicroBatcher:
                 break
             items.append(nxt)
             total += nxt.instances.shape[0]
+        now = time.time()
+        with self._submit_lock:
+            for it in items:
+                self._waiting.pop(id(it), None)
+        for it in items:
+            if it.ctx is not None:
+                it.ctx.stage("queue", it.t_enqueue, now)
         return items
 
     def _dispatch(self, items: list[_WorkItem]):
         """One device call for a shape-compatible cohort; errors fan out
-        only to that cohort."""
+        only to that cohort. Each item's ctx gets the cohort's FULL
+        stage intervals (the request lived through the whole shared
+        pipeline — its wall-clock partitions exactly), with the device
+        interval split by the cohort's fill: the real-row fraction is
+        serving goodput (co-riders' rows are useful work the request
+        rode along with), the pad fraction is pad_waste."""
+        traced = [it for it in items if it.ctx is not None]
+        t_form0 = time.perf_counter()
+        tw_form0 = time.time()
         batch = np.concatenate([it.instances for it in items], axis=0)
+        form_s = time.perf_counter() - t_form0
         try:
-            out = self.servable.predict(batch)
+            if hasattr(self.servable, "predict_with_stages"):
+                out, stages = self.servable.predict_with_stages(batch)
+            else:
+                out, stages = self.servable.predict(batch), None
         except Exception as e:  # noqa: BLE001 — fan the error out
             for it in items:
                 it.future.set_exception(e)
             return
+        if traced:
+            self._record_stages(items, traced, stages, form_s, tw_form0)
         ofs = 0
         for it in items:
             n = it.instances.shape[0]
             it.future.set_result(
                 jax.tree.map(lambda x: x[ofs:ofs + n], out))
             ofs += n
+
+    def _record_stages(self, items, traced, stages, form_s: float,
+                       tw_form0: float) -> None:
+        rows_total = sum(it.instances.shape[0] for it in items)
+        batch_id = next(self._batch_ids)
+        if stages is None:
+            stages = {"h2d_s": 0.0, "device_s": 0.0, "drain_s": 0.0,
+                      "bucket": rows_total, "rows": rows_total,
+                      "pad_rows": 0}
+        bucket = max(1, int(stages.get("bucket", rows_total)))
+        pad_rows = int(stages.get("pad_rows", 0))
+        # padded_total covers the oversized-split case too (several
+        # chunks, each padded): real + pad rows actually computed
+        padded_total = max(1, rows_total + pad_rows)
+        fill = rows_total / padded_total
+        device_s = float(stages.get("device_s", 0.0))
+        pad_waste_total = device_s * (pad_rows / padded_total)
+        # wall-clock boundaries for the sampled stage spans (the ledger
+        # carries the shares; the spans carry the cohort's intervals)
+        tw_form1 = tw_form0 + form_s
+        tw_h2d1 = tw_form1 + float(stages.get("h2d_s", 0.0))
+        tw_dev1 = tw_h2d1 + device_s
+        tw_drain1 = tw_dev1 + float(stages.get("drain_s", 0.0))
+        for it in traced:
+            it.ctx.note(batch_id=batch_id, bucket=bucket,
+                        fill=round(fill, 4),
+                        batch_requests=len(items))
+            it.ctx.stage("batch-form", tw_form0, tw_form1,
+                         batch_id=batch_id, fill=round(fill, 4),
+                         pad_rows=pad_rows)
+            it.ctx.stage("h2d", tw_form1, tw_h2d1, bucket=bucket)
+            it.ctx.device(tw_h2d1, tw_dev1,
+                          goodput_s=device_s * fill,
+                          pad_waste_s=pad_waste_total,
+                          batch_id=batch_id)
+            it.ctx.stage("drain", tw_dev1, tw_drain1)
+            it.ctx.t_pipeline_end = tw_drain1
 
     def _loop(self):
         while not self._stop.is_set():
@@ -117,7 +223,9 @@ class MicroBatcher:
         self._thread.join(timeout=5)
         while True:  # fail any stragglers
             try:
-                self._queue.get_nowait().future.set_exception(
-                    RuntimeError("batcher shut down"))
+                item = self._queue.get_nowait()
             except queue.Empty:
                 break
+            with self._submit_lock:
+                self._waiting.pop(id(item), None)
+            item.future.set_exception(RuntimeError("batcher shut down"))

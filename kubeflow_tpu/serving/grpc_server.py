@@ -127,7 +127,22 @@ class GrpcPredictServer:
 
     def _predict(self, request: pb.PredictRequest,
                  context) -> pb.PredictResponse:
+        from .batcher import QueueFullError
+        from .request_trace import REQUEST_ID_HEADER, mint_request_id
         name = request.model_spec.name
+        # request id over gRPC metadata (the x-request-id header's
+        # wire-equivalent), echoed as initial metadata — one id stamps
+        # every stage span, REST and gRPC alike
+        rid = ""
+        for k, v in (context.invocation_metadata() or ()):
+            if k == REQUEST_ID_HEADER:
+                rid = v
+                break
+        rid = rid or mint_request_id()
+        try:
+            context.send_initial_metadata(((REQUEST_ID_HEADER, rid),))
+        except Exception:  # noqa: BLE001 — metadata is best-effort
+            pass
         try:
             batcher = self.model_server.batcher(name)
         except KeyError as e:
@@ -140,27 +155,53 @@ class GrpcPredictServer:
         key = ("instances" if "instances" in request.inputs else
                ("inputs" if "inputs" in request.inputs else
                 next(iter(request.inputs))))
+        ctx = self.model_server.obs.begin(name, request_id=rid)
+        self.model_server.replica.inflight_inc(name)
         try:
             instances = tensor_to_ndarray(request.inputs[key])
-            out = batcher.predict(instances)
+            out = batcher.predict(instances, ctx=ctx)
+        except QueueFullError as e:
+            # bounded-queue shed: explicit RESOURCE_EXHAUSTED, the
+            # request's wait recorded as queue badput in its ledger
+            ctx.finish("shed", error=str(e))
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except ValueError as e:
+            ctx.finish("error", error=f"ValueError: {e}")
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         except Exception as e:  # noqa: BLE001 — surface as INTERNAL
+            ctx.finish("error", error=f"{type(e).__name__}: {e}")
             context.abort(grpc.StatusCode.INTERNAL,
                           f"{type(e).__name__}: {e}")
-        resp = pb.PredictResponse()
-        resp.model_spec.name = name
-        resp.model_spec.signature_name = (
-            request.model_spec.signature_name or "serving_default")
-        if isinstance(out, dict):
-            wanted = set(request.output_filter)
-            for k, v in out.items():
-                if wanted and k not in wanted:
-                    continue
-                resp.outputs[k].CopyFrom(ndarray_to_tensor(np.asarray(v)))
-        else:
-            resp.outputs["outputs"].CopyFrom(
-                ndarray_to_tensor(np.asarray(out)))
+        finally:
+            self.model_server.replica.inflight_dec(name)
+        import time as _time
+        t_resp = _time.time()
+        if ctx.t_pipeline_end is not None:
+            t_resp = min(t_resp, max(ctx.t_pipeline_end, ctx.t_accept))
+        # response construction can fail too (an output dtype the
+        # tensor codec rejects) — that request must still land in the
+        # ledger and registry, never silently vanish
+        try:
+            resp = pb.PredictResponse()
+            resp.model_spec.name = name
+            resp.model_spec.signature_name = (
+                request.model_spec.signature_name or "serving_default")
+            if isinstance(out, dict):
+                wanted = set(request.output_filter)
+                for k, v in out.items():
+                    if wanted and k not in wanted:
+                        continue
+                    resp.outputs[k].CopyFrom(
+                        ndarray_to_tensor(np.asarray(v)))
+            else:
+                resp.outputs["outputs"].CopyFrom(
+                    ndarray_to_tensor(np.asarray(out)))
+        except Exception as e:  # noqa: BLE001 — surface as INTERNAL
+            ctx.finish("error", error=f"{type(e).__name__}: {e}")
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{type(e).__name__}: {e}")
+        ctx.stage("respond", t_resp, _time.time())
+        ctx.finish("ok")
         return resp
 
     def _get_model_status(self, request: pb.GetModelStatusRequest,

@@ -28,18 +28,25 @@ from typing import Optional
 import numpy as np
 
 from ..obs.registry import Registry
-from .batcher import MicroBatcher
+from .batcher import MicroBatcher, QueueFullError
+from .replica_state import ModelSLO, ReplicaState
+from .request_trace import (REQUEST_ID_HEADER, ServingObs,
+                            mint_request_id)
 from .servable import ModelRepository
 
 
 class ModelServer:
     def __init__(self, repository: Optional[ModelRepository] = None,
                  host: str = "0.0.0.0", port: int = 8500,
-                 max_batch: int = 64, max_latency_ms: float = 5.0):
+                 max_batch: int = 64, max_latency_ms: float = 5.0,
+                 max_pending: int = 0, sample_every: int = 16,
+                 span_path: Optional[str] = None,
+                 slos: Optional[dict] = None):
         self.repository = repository or ModelRepository()
         self.host, self.port = host, port
         self.max_batch = max_batch
         self.max_latency_ms = max_latency_ms
+        self.max_pending = max_pending
         self._batchers: dict[str, MicroBatcher] = {}
         self._batchers_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -64,15 +71,32 @@ class ModelServer:
             "kubeflow_model_request_seconds",
             "end-to-end REST :predict latency", labels=("model",))
         self._m_exported: set = set()
+        # replica health registry + per-request tracing (ISSUE 11):
+        # every finished request feeds the registry; spans ride the
+        # explicit span_path or the KFTPU_SPAN_PATH env contract
+        self.replica = ReplicaState(self.registry)
+        self.obs = ServingObs(replica=self.replica, span_path=span_path,
+                              sample_every=sample_every)
+        for model, slo in (slos or {}).items():
+            self.set_slo(model, slo)
+
+    def set_slo(self, model: str, slo: ModelSLO) -> None:
+        """Declare a model's SLO (manifest --slo-p99-ms /
+        --slo-availability): burn-rate gauges start tracking it."""
+        self.replica.set_slo(model, slo)
 
     def add_router(self, routed) -> None:
         """Mount a RoutedModel at /v1/routers/<name>; when it serves this
         server's repository, its arms resolve through the server's
         MicroBatchers so routed and direct traffic batch together. A
-        caller-set resolver or foreign repository is left alone."""
+        caller-set resolver or foreign repository is left alone. The
+        router also adopts this server's request observability so its
+        shadow copies trace into the same sink with role=shadow."""
         if routed.predict_resolver is None and \
                 routed.repository is self.repository:
             routed.predict_resolver = lambda arm: self.batcher(arm).predict
+        if routed.request_obs is None:
+            routed.request_obs = self.obs
         self.routers[routed.name] = routed
 
     # -- lifecycle ----------------------------------------------------------
@@ -93,6 +117,7 @@ class ModelServer:
             self._httpd.server_close()
         for b in self._batchers.values():
             b.shutdown()
+        self.obs.close()
 
     # -- dispatch -----------------------------------------------------------
 
@@ -104,8 +129,11 @@ class ModelServer:
             b = self._batchers.get(name)
             if b is None:
                 b = MicroBatcher(servable, max_batch=self.max_batch,
-                                 max_latency_ms=self.max_latency_ms)
+                                 max_latency_ms=self.max_latency_ms,
+                                 max_pending=self.max_pending)
                 self._batchers[name] = b
+                # queue depth + oldest-age gauges: scrape-time pull
+                self.replica.register_queue(name, b)
         return b
 
     def metrics_text(self) -> str:
@@ -123,10 +151,17 @@ class ModelServer:
             self._m_latency.remove(model=gone)
         self._m_exported = names
         for name in names:
-            meta = self.repository.get(name).metadata()["stats"]
+            servable = self.repository.get(name)
+            meta = servable.metadata()["stats"]
             self._m_requests.labels(model=name).set(meta["request_count"])
             self._m_predict_s.labels(model=name).set(
                 round(meta["predict_seconds"], 6))
+            self.replica.set_start_kind(
+                name, getattr(servable, "start_kind", "cold"))
+        # the replica registry prunes its own series for gone models
+        # and recomputes the rolling gauges + burn rates at scrape time
+        self.replica.prune(names)
+        self.replica.refresh()
         return self.registry.render()
 
 
@@ -135,21 +170,30 @@ def _make_handler(server: ModelServer):
         def log_message(self, *a):  # quiet
             pass
 
-        def _send(self, code: int, payload, content_type="application/json"):
+        def _send(self, code: int, payload, content_type="application/json",
+                  headers: Optional[dict] = None):
             body = (payload if isinstance(payload, bytes)
                     else json.dumps(payload).encode())
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
-        def _error(self, code: int, msg: str):
-            self._send(code, {"error": msg})
+        def _error(self, code: int, msg: str,
+                   headers: Optional[dict] = None):
+            self._send(code, {"error": msg}, headers=headers)
 
         def do_GET(self):
-            path = self.path.rstrip("/")
+            path, _, rawq = self.path.partition("?")
+            path = path.rstrip("/")
             if path == "/healthz":
+                if "verbose=1" in rawq:
+                    # the replica-health contract the router and
+                    # autoscaler poll (serving/replica_state.py)
+                    return self._send(200, server.replica.snapshot())
                 return self._send(200, {"status": "ok"})
             if path == "/metrics":
                 return self._send(200, server.metrics_text().encode(),
@@ -185,14 +229,42 @@ def _make_handler(server: ModelServer):
                 instances = instances.astype(req["dtype"])
             return instances
 
-        def _run_predict(self, predict, req: dict):
+        def _request_id(self) -> str:
+            """Honor an inbound x-request-id (echoed on the response);
+            mint otherwise — one id stamps every stage span."""
+            return self.headers.get(REQUEST_ID_HEADER) or mint_request_id()
+
+        def _force_sample(self) -> bool:
+            """``x-request-sample: 1`` forces stage spans for THIS
+            request regardless of the sampling cadence — the debug
+            handle for 'reconstruct this exact request'."""
+            return self.headers.get("x-request-sample") == "1"
+
+        def _run_predict(self, predict, req: dict, ctx=None,
+                         rid: Optional[str] = None):
             """Shared predict body: parse instances, run, serialize —
-            one implementation for model and router endpoints."""
-            out = predict(self._parse_instances(req))
+            one implementation for model and router endpoints. Instance
+            decode is charged to batch-form (it IS forming the device
+            input); the respond stage runs from the batcher's pipeline
+            end (so the future-wakeup gap is respond time, not
+            residual) through serialize + send."""
+            t_parse = time.time()
+            instances = self._parse_instances(req)
+            if ctx is not None:
+                ctx.stage("batch-form", t_parse, time.time(),
+                          decode=True)
+            out = predict(instances)
+            t_resp = time.time()
+            if ctx is not None and ctx.t_pipeline_end is not None:
+                t_resp = min(t_resp, max(ctx.t_pipeline_end,
+                                         ctx.t_accept))
             predictions = {
                 k: np.asarray(v).tolist() for k, v in out.items()
             } if isinstance(out, dict) else np.asarray(out).tolist()
-            self._send(200, {"predictions": predictions})
+            self._send(200, {"predictions": predictions},
+                       headers={REQUEST_ID_HEADER: rid} if rid else None)
+            if ctx is not None:
+                ctx.stage("respond", t_resp, time.time())
 
         def do_POST(self):
             if ":" not in self.path:
@@ -203,21 +275,39 @@ def _make_handler(server: ModelServer):
             if not route.startswith("/v1/models/") or verb != "predict":
                 return self._error(404, f"no route {self.path}")
             name = route[len("/v1/models/"):]
+            rid = self._request_id()
+            hdr = {REQUEST_ID_HEADER: rid}
+            ctx = None
             try:
                 req = self._read_body()
                 try:
                     batcher = server.batcher(name)
                 except KeyError as e:  # unknown model only → 404
-                    return self._error(404, str(e))
+                    return self._error(404, str(e), headers=hdr)
+                ctx = server.obs.begin(name, request_id=rid,
+                                       force_sample=self._force_sample())
+                server.replica.inflight_inc(name)
                 t0 = time.perf_counter()
                 try:
-                    self._run_predict(batcher.predict, req)
+                    self._run_predict(
+                        lambda x: batcher.predict(x, ctx=ctx), req,
+                        ctx=ctx, rid=rid)
+                    ctx.finish("ok")
                 finally:
+                    server.replica.inflight_dec(name)
                     # errors are latency too (clients waited for them)
                     server._m_latency.labels(model=name).observe(
                         time.perf_counter() - t0)
+            except QueueFullError as e:
+                # bounded-queue shed: explicit 429, recorded in the
+                # ledger (all-queue badput), never silently dropped
+                if ctx is not None:
+                    ctx.finish("shed", error=str(e))
+                self._error(429, f"QueueFullError: {e}", headers=hdr)
             except Exception as e:  # noqa: BLE001 — surface to client
-                self._error(400, f"{type(e).__name__}: {e}")
+                if ctx is not None:
+                    ctx.finish("error", error=f"{type(e).__name__}: {e}")
+                self._error(400, f"{type(e).__name__}: {e}", headers=hdr)
 
         def _router_post(self, name: str, verb: str):
             """/v1/routers/<name>:predict and :feedback (the seldon
@@ -225,6 +315,9 @@ def _make_handler(server: ModelServer):
             routed = server.routers.get(name)
             if routed is None:
                 return self._error(404, f"router {name!r} not found")
+            rid = self._request_id()
+            hdr = {REQUEST_ID_HEADER: rid}
+            ctx = None
             try:
                 req = self._read_body()
                 if verb == "feedback":
@@ -232,9 +325,23 @@ def _make_handler(server: ModelServer):
                     return self._send(200, routed.status())
                 if verb != "predict":
                     return self._error(404, f"unknown verb {verb!r}")
-                self._run_predict(routed.predict, req)
+                # the router stamps the chosen arm onto the ctx once
+                # routed; the span's model is the ARM, attrs carry the
+                # router name (serving/router.py)
+                ctx = server.obs.begin(f"router:{name}", request_id=rid,
+                                       force_sample=self._force_sample())
+                self._run_predict(
+                    lambda x: routed.predict(x, ctx=ctx), req,
+                    ctx=ctx, rid=rid)
+                ctx.finish("ok")
+            except QueueFullError as e:
+                if ctx is not None:
+                    ctx.finish("shed", error=str(e))
+                self._error(429, f"QueueFullError: {e}", headers=hdr)
             except Exception as e:  # noqa: BLE001 — surface to client
-                self._error(400, f"{type(e).__name__}: {e}")
+                if ctx is not None:
+                    ctx.finish("error", error=f"{type(e).__name__}: {e}")
+                self._error(400, f"{type(e).__name__}: {e}", headers=hdr)
 
     return Handler
 
@@ -259,6 +366,22 @@ def main(argv: Optional[list[str]] = None) -> int:
                    help="skip compiling the padded-bucket executables at "
                         "load (first request per bucket then pays the "
                         "XLA compile)")
+    p.add_argument("--max-pending", type=int, default=0,
+                   help="bounded batcher queue: shed with 429 past this "
+                        "many waiting requests (0 = unbounded)")
+    p.add_argument("--sample-every", type=int, default=16,
+                   help="emit per-stage trace spans for every Nth "
+                        "request (the ledger summary span is always "
+                        "emitted; 0 = summaries only)")
+    p.add_argument("--span-path", default=None,
+                   help="request-span JSONL sink (default: the "
+                        "KFTPU_SPAN_PATH env contract)")
+    p.add_argument("--slo-p99-ms", type=float, default=None,
+                   help="declarative latency SLO: target p99 in ms "
+                        "(burn-rate gauges on /metrics)")
+    p.add_argument("--slo-availability", type=float, default=None,
+                   help="declarative availability SLO target, e.g. "
+                        "0.999")
     args = p.parse_args(argv)
 
     # warm server restarts skip the per-bucket XLA compiles: warmup()
@@ -276,8 +399,16 @@ def main(argv: Optional[list[str]] = None) -> int:
         print(f"warmed buckets {buckets}", flush=True)
     if args.model_path and args.reload_interval:
         repo.start_polling(args.reload_interval)
+    slos = {}
+    if args.slo_p99_ms is not None or args.slo_availability is not None:
+        from .replica_state import ModelSLO as _SLO
+        slos[args.model_name] = _SLO(target_p99_ms=args.slo_p99_ms,
+                                     availability=args.slo_availability)
     server = ModelServer(repo, port=args.rest_port,
-                         max_batch=args.max_batch)
+                         max_batch=args.max_batch,
+                         max_pending=args.max_pending,
+                         sample_every=args.sample_every,
+                         span_path=args.span_path, slos=slos)
     port = server.start()
     grpc_server = None
     if args.grpc_port:

@@ -160,46 +160,91 @@ class RoutedModel:
     # server sets this to its MicroBatcher so routed and direct traffic
     # batch together); default is the servable's raw predict
     predict_resolver: Optional[object] = None
+    # request observability (serving/request_trace.py ServingObs):
+    # adopted from the ModelServer in add_router(). Shadow copies get
+    # their OWN request trace + latency series labeled role=shadow, so
+    # a cold shadow JIT compile is attributable and never pollutes the
+    # primary's SLO series.
+    request_obs: Optional[object] = None
     # shadow copies run here so shadow latency (e.g. a cold JIT compile)
     # never adds to the primary response — seldon mirrored-traffic
     # semantics. Failures and stats are recorded from the worker thread.
     _shadow_pool: object = field(default=None, repr=False)
 
-    def _arm_predict(self, arm: str):
+    def _arm_predict(self, arm: str, ctx=None):
         if self.predict_resolver is not None:
-            return self.predict_resolver(arm)
-        return self.repository.get(arm).predict
+            fn = self.predict_resolver(arm)
+        else:
+            fn = self.repository.get(arm).predict
+        if ctx is None:
+            return fn
+        # batcher.predict threads the request ctx through; a bare
+        # servable/fake predict doesn't take it. Decide by signature
+        # up front — a retry-on-TypeError fallback would re-execute
+        # the prediction when the predict BODY raises its own
+        # TypeError (double device work, double stats).
+        import inspect
+        try:
+            accepts_ctx = "ctx" in inspect.signature(fn).parameters
+        except (TypeError, ValueError):
+            accepts_ctx = False
+        if not accepts_ctx:
+            return fn
+        return lambda instances: fn(instances, ctx=ctx)
 
     def _record(self, arm: str, ok: bool) -> None:
         self.router.record_request(arm, failed=not ok)
         if self.implicit_reward:
             self.router.record_reward(arm, 1.0 if ok else 0.0)
 
-    def predict(self, instances: np.ndarray):
+    def predict(self, instances: np.ndarray, ctx=None):
         arm = self.router.route()
+        if ctx is not None:
+            # the span's model is the chosen ARM (per-arm latency
+            # series); the router identity rides the attrs
+            ctx.model = arm
+            ctx.note(router=self.name)
         try:
-            result = self._arm_predict(arm)(instances)
+            result = self._arm_predict(arm, ctx=ctx)(instances)
         except Exception:
             self._record(arm, ok=False)
             raise
         self._record(arm, ok=True)
         if isinstance(self.router, ShadowRouter):
-            self._shadow_submit(self.router.shadow, instances)
+            self._shadow_submit(self.router.shadow, instances,
+                                parent_ctx=ctx)
         return result
 
-    def _shadow_submit(self, shadow: str, instances: np.ndarray) -> None:
+    def _shadow_submit(self, shadow: str, instances: np.ndarray,
+                       parent_ctx=None) -> None:
         if self._shadow_pool is None:
             from concurrent.futures import ThreadPoolExecutor
             object.__setattr__(self, "_shadow_pool",
                                ThreadPoolExecutor(max_workers=1,
                                                   thread_name_prefix="shadow"))
+        # the shadow copy's own request trace: derived id (so the
+        # primary's timeline links to it), role=shadow throughout
+        shadow_ctx = None
+        if self.request_obs is not None:
+            rid = (parent_ctx.request_id + "-shadow") \
+                if parent_ctx is not None else None
+            shadow_ctx = self.request_obs.begin(
+                shadow, request_id=rid, role="shadow",
+                force_sample=bool(parent_ctx is not None
+                                  and parent_ctx.sampled))
+            shadow_ctx.note(router=self.name, shadow_of=self.router.primary)
 
         def run():
             try:
-                self._arm_predict(shadow)(instances)
+                self._arm_predict(shadow, ctx=shadow_ctx)(instances)
                 self._record(shadow, ok=True)
-            except Exception:  # noqa: BLE001 - shadow must never break serving
+                if shadow_ctx is not None:
+                    shadow_ctx.finish("ok")
+            except Exception as e:  # noqa: BLE001 - shadow must never break serving
                 self._record(shadow, ok=False)
+                if shadow_ctx is not None:
+                    shadow_ctx.finish("error",
+                                      error=f"{type(e).__name__}: {e}")
 
         self._shadow_pool.submit(run)
 

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.registry import Registry
+
 log = logging.getLogger(__name__)
 
 PyTree = Any
@@ -62,22 +64,63 @@ class Servable:
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def __post_init__(self):
-        self._stats = {"request_count": 0, "predict_seconds": 0.0}
+        # per-servable stats on the shared obs Registry machinery
+        # (they predate it and used to be a hand dict + lock): each
+        # Servable owns its OWN Registry — several servers serving the
+        # same model name coexist in one test process and must not
+        # share counts — with the wire-compatible family names the
+        # server exposition bridges (http_server.metrics_text)
+        self.registry = Registry()
+        self._m_requests = self.registry.counter(
+            "kubeflow_model_request_count", "requests served",
+            labels=("model",)).labels(model=self.name)
+        self._m_predict_s = self.registry.counter(
+            "kubeflow_model_predict_seconds_total",
+            "cumulative device predict seconds",
+            labels=("model",)).labels(model=self.name)
+        # warm/cold start kind (PR 9 evidence): set by warmup() from
+        # compile-cache stats; "cold" until proven warm
+        self.start_kind = "cold"
         # one jit wrapper: jax caches per input shape, so each padded
         # bucket gets its own executable without any bookkeeping here
         self._jit_predict = jax.jit(self.predict_fn)
 
+    @property
+    def _stats(self) -> dict:
+        """The legacy snapshot shape, now read off the registry
+        counters (metadata()['stats'] consumers keep working)."""
+        return {"request_count": int(self._m_requests.value),
+                "predict_seconds": self._m_predict_s.value}
+
     def predict(self, instances: np.ndarray) -> np.ndarray:
         """Pad to bucket, run on device, slice back. Thread-safe."""
+        out, _ = self.predict_with_stages(instances)
+        return out
+
+    def predict_with_stages(self, instances: np.ndarray) -> tuple:
+        """predict() plus the per-stage attribution the request tracer
+        charges its ledger from: ``(out, {"h2d_s", "device_s",
+        "drain_s", "bucket", "rows", "pad_rows"})``. The split is
+        host-observed: h2d = the device_put of the padded batch,
+        device = dispatch + block_until_ready, drain = device→host
+        copy of the results."""
         n = instances.shape[0]
         if n == 0:
             raise ValueError("empty batch")
         if n > self.max_batch:
-            # split oversized requests; serving never compiles > max bucket
-            parts = [self.predict(instances[i:i + self.max_batch])
-                     for i in range(0, n, self.max_batch)]
+            # split oversized requests; serving never compiles > max
+            # bucket. Stages aggregate across the chunks.
+            parts = []
+            agg = {"h2d_s": 0.0, "device_s": 0.0, "drain_s": 0.0,
+                   "bucket": self.max_batch, "rows": n, "pad_rows": 0}
+            for i in range(0, n, self.max_batch):
+                out, st = self.predict_with_stages(
+                    instances[i:i + self.max_batch])
+                parts.append(out)
+                for k in ("h2d_s", "device_s", "drain_s", "pad_rows"):
+                    agg[k] += st[k]
             return jax.tree.map(
-                lambda *xs: np.concatenate(xs, axis=0), *parts)
+                lambda *xs: np.concatenate(xs, axis=0), *parts), agg
         bucket = next_bucket(n, self.max_batch)
         padded = instances
         if bucket != n:
@@ -85,13 +128,19 @@ class Servable:
                            instances.dtype)
             padded = np.concatenate([instances, pad], axis=0)
         t0 = time.perf_counter()
-        out = self._jit_predict(self.params, jnp.asarray(padded))
+        dev_in = jnp.asarray(padded)
+        t1 = time.perf_counter()
+        out = jax.block_until_ready(
+            self._jit_predict(self.params, dev_in))
+        t2 = time.perf_counter()
         out = jax.device_get(out)
-        dt = time.perf_counter() - t0
-        with self._lock:
-            self._stats["request_count"] += 1
-            self._stats["predict_seconds"] += dt
-        return jax.tree.map(lambda x: np.asarray(x)[:n], out)
+        t3 = time.perf_counter()
+        self._m_requests.inc()
+        self._m_predict_s.inc(t3 - t0)
+        stages = {"h2d_s": t1 - t0, "device_s": t2 - t1,
+                  "drain_s": t3 - t2, "bucket": bucket, "rows": n,
+                  "pad_rows": bucket - n}
+        return jax.tree.map(lambda x: np.asarray(x)[:n], out), stages
 
     def warmup(self, buckets: Optional[list[int]] = None) -> list[int]:
         """Compile the padded-bucket executables BEFORE serving traffic
@@ -113,6 +162,13 @@ class Servable:
             # which the doubling loop skips when it is not a power of two
             buckets.append(self.max_batch)
         dtype = np.dtype(sig.get("dtype", "float32"))
+        # warm/cold evidence (the PR 9 start_kind rule, serving form):
+        # if every bucket compile was served by the persistent cache —
+        # hits and zero derived backend compiles — this replica started
+        # WARM; the replica registry exports it so the router can
+        # attribute a slow replica to a cold start
+        from ..runtime.compile_cache import compile_stats
+        before = compile_stats()
         # Compile through the jit wrapper directly: warmup must not move
         # serving metrics, and a snapshot/restore of _stats would also
         # discard increments from REAL requests landing concurrently
@@ -122,6 +178,12 @@ class Servable:
                                     jnp.asarray(np.zeros((b, *shape_tail),
                                                          dtype)))
             jax.device_get(out)
+        after = compile_stats()
+        hits = after["cache_hits"] - before["cache_hits"]
+        compiles = (after["xla_backend_compiles"]
+                    - before["xla_backend_compiles"])
+        if hits > 0 and compiles == 0:
+            self.start_kind = "warm"
         return buckets
 
     def swap(self, params: PyTree, version: int) -> None:

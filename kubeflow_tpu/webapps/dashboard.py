@@ -17,6 +17,9 @@ Routes:
   GET /api/sched/nodes                     (per-host health + quarantine)
   GET /api/obs/goodput/{ns}/{name}         (per-job goodput ledger)
   GET /api/obs/goodput                     (cluster chip-hour rollup)
+  GET /api/obs/serving                     (per-model serving rollup:
+                                            latency percentiles, goodput
+                                            vs serving badput, SLO)
   GET /healthz
 """
 
@@ -114,6 +117,7 @@ button.minor{padding:0.3rem 0.8rem;border:1px solid var(--grid);
   <select id="ns-selector" aria-label="namespace"></select>
   <a href="#/overview" data-view="overview">Overview</a>
   <a href="#/runs" data-view="runs">Runs</a>
+  <a href="#/serving" data-view="serving">Serving</a>
   <a href="#/activities" data-view="activities">Activities</a>
   <a href="#/metrics" data-view="metrics">Metrics</a>
   <a href="#/notebooks" data-view="notebooks">Notebooks</a>
@@ -488,6 +492,25 @@ def build_dashboard_app(client: KubeClient,
             return 200, {"note": f"no span sink configured "
                                  f"({SPAN_PATH_ENV} unset)"}
         return 200, cluster_rollup(span_path)
+
+    @app.route("GET", "/api/obs/serving")
+    def serving_obs(params, query, body):
+        """The serving-plane rollup (obs/goodput.py serving_rollup):
+        every ``serving-request`` summary span in the sink folded into
+        per-(model, role) rows — request/error/shed counts,
+        p50/p99/p99.9, batch fill, goodput ratio vs the serving badput
+        categories, SLO over-target fraction, and the slowest request
+        ids (each reconstructible stage-by-stage via
+        /api/obs/jobs-style span reads). Shadow traffic reports under
+        its own role row, never folded into the primary's."""
+        from ..obs.goodput import serving_rollup
+        from ..obs.trace import SPAN_PATH_ENV
+        span_path = os.environ.get(SPAN_PATH_ENV)
+        if not span_path:
+            return 200, {"note": f"no span sink configured "
+                                 f"({SPAN_PATH_ENV} unset)",
+                         "models": [], "requests": 0}
+        return 200, serving_rollup(span_path)
 
     @app.route("GET", "/api/sched/queues")
     def sched_queues(params, query, body):

@@ -664,6 +664,58 @@
                     text: "No contributors in this namespace." }));
   }
 
+  // -- serving observability (per-model ledger rollup + SLO over
+  //    /api/obs/serving — the ISSUE 11 panel) -------------------------------
+
+  async function viewServing(root) {
+    const data = await api("api/obs/serving");
+    const blocks = [el("h2", { text: "Serving observability" })];
+    if (data.note) {
+      blocks.push(el("p", { class: "empty", text: data.note }));
+    }
+    const models = data.models || [];
+    if (!models.length) {
+      blocks.push(el("p", { class: "empty",
+                            text: "No serving requests traced yet." }));
+      root.replaceChildren(...blocks);
+      return;
+    }
+    const primary = models.filter((m) => m.role === "primary");
+    blocks.push(el("div", { class: "tiles" }, [
+      statTile("Requests", data.requests || 0),
+      statTile("Models", primary.length),
+      statTile("Errors",
+        models.reduce((s, m) => s + (m.errors || 0), 0)),
+      statTile("Shed (429)",
+        models.reduce((s, m) => s + (m.shed || 0), 0)),
+    ]));
+    const rows = models.map((m) => ({
+      model: m.model, role: m.role, requests: m.requests,
+      "p50 ms": m.p50Ms, "p99 ms": m.p99Ms, "p99.9 ms": m.p999Ms,
+      "goodput": m.goodputRatio, "fill": m.meanFill ?? "",
+      errors: m.errors, shed: m.shed,
+      slo: m.slo
+        ? `${m.slo.compliant ? "✓" : "✗"} p99<${m.slo.targetP99Ms}ms`
+        : "",
+    }));
+    blocks.push(table(rows, ["model", "role", "requests", "p50 ms",
+                             "p99 ms", "p99.9 ms", "goodput", "fill",
+                             "errors", "shed", "slo"]));
+    // where the non-goodput time goes, per primary model (the serving
+    // badput categories — one bar row per category with seconds)
+    primary.forEach((m) => {
+      const bad = Object.entries(m.badputSeconds || {})
+        .map(([category, seconds]) => ({ category, seconds }))
+        .filter((r) => r.seconds > 0);
+      if (!bad.length) return;
+      blocks.push(el("h3", { text: `${m.model}: badput seconds` }));
+      blocks.push(chartWithTable(bad,
+        { labelKey: "category", valueKey: "seconds", unit: "s" },
+        ["category", "seconds"]));
+    });
+    root.replaceChildren(...blocks);
+  }
+
   function viewNotebooks(root) {
     // iframe-embedding, the reference dashboard's integration pattern
     const frame = el("iframe", {
@@ -676,6 +728,7 @@
   const VIEWS = {
     overview: viewOverview,
     runs: viewRuns,
+    serving: viewServing,
     activities: viewActivities,
     metrics: viewMetrics,
     notebooks: viewNotebooks,

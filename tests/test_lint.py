@@ -574,6 +574,60 @@ def test_badput_categories_defined_once_and_shared():
     assert set(row["goodput"]["badput"]) == set(BADPUT_CATEGORIES)
 
 
+def test_serving_badput_categories_defined_once_and_shared():
+    """The SERVING badput vocabulary (ISSUE 11) follows the same
+    single-definition rule as the training one: defined in
+    obs/goodput.py, imported by the request tracer, the replica
+    registry, the dashboard rollup, and the bench — a category-name
+    drift between the model server's ledger and the dashboard's table
+    would silently break every cross-surface read."""
+    import subprocess
+
+    from kubeflow_tpu.obs.goodput import (BADPUT_OTHER,
+                                          SERVING_BADPUT_CATEGORIES,
+                                          decompose_request)
+
+    assert SERVING_BADPUT_CATEGORIES == (
+        "queue", "batch_form", "pad_waste", "h2d", "respond", "other")
+
+    # single definition: the distinctive literals appear as quoted
+    # strings in exactly one source file (common words like "queue"
+    # and "device" would false-positive; the span NAMES use hyphenated
+    # forms — "batch-form" — so the snake_case categories are exact)
+    pkg = os.path.join(REPO_ROOT, "kubeflow_tpu")
+    for literal in ("batch_form", "pad_waste"):
+        hits = subprocess.run(
+            ["grep", "-rl", f'"{literal}"', pkg],
+            capture_output=True, text=True).stdout.split()
+        assert [os.path.relpath(h, pkg) for h in hits] == \
+            [os.path.join("obs", "goodput.py")], \
+            f"{literal!r} defined outside obs/goodput.py: {hits}"
+
+    def src(*rel):
+        with open(os.path.join(REPO_ROOT, *rel)) as f:
+            return f.read()
+
+    # consumers go through the shared module, never re-spelled names
+    tracer_src = src("kubeflow_tpu", "serving", "request_trace.py")
+    for use in ("from ..obs import goodput as gp",
+                "gp.SERVING_DEVICE", "gp.SERVING_PAD_WASTE",
+                "gp.SERVING_REQUEST_SPAN"):
+        assert use in tracer_src, \
+            f"serving/request_trace.py must consume {use}"
+    replica_src = src("kubeflow_tpu", "serving", "replica_state.py")
+    assert "gp.SERVING_BADPUT_CATEGORIES" in replica_src
+    dash_src = src("kubeflow_tpu", "webapps", "dashboard.py")
+    assert "from ..obs.goodput import serving_rollup" in dash_src
+    bench_src = src("bench.py")
+    assert "gp.SERVING_BADPUT_CATEGORIES" in bench_src
+
+    # every request ledger reports the FULL vocabulary (zeros, not
+    # omissions) so tables line up column-for-column across surfaces
+    led = decompose_request(1.0, {})
+    assert set(led["badputSeconds"]) == set(SERVING_BADPUT_CATEGORIES)
+    assert BADPUT_OTHER in led["badputSeconds"]
+
+
 def test_run_policy_fields_are_plumbed_end_to_end():
     """Every RunPolicy field must be plumbed spec → controller →
     manifests: round-trip through the TPUJob spec wire format

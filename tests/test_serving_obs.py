@@ -1,0 +1,776 @@
+"""Serving request-observability tests (ISSUE 11): request-id
+propagation HTTP → batcher → servable, per-request ledgers, the
+replica health registry + SLO burn rates, bounded-queue shedding,
+shadow-traffic attribution, and the dashboard rollup endpoint."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.obs import goodput as gp
+from kubeflow_tpu.obs.registry import Registry
+from kubeflow_tpu.obs.trace import load_spans, reconstruct
+from kubeflow_tpu.serving.replica_state import (BURN_WINDOWS, ModelSLO,
+                                                ReplicaState)
+from kubeflow_tpu.serving.request_trace import (REQUEST_ID_HEADER,
+                                                ServingObs,
+                                                mint_request_id)
+
+pytestmark = pytest.mark.serving_obs
+
+
+# ------------------------------------------------------------ pure ledger
+
+class TestDecomposeRequest:
+    def test_partition_is_exact_with_residual_as_other(self):
+        led = gp.decompose_request(0.100, {
+            gp.SERVING_QUEUE: 0.020, gp.SERVING_BATCH_FORM: 0.005,
+            gp.SERVING_H2D: 0.010, gp.SERVING_DEVICE: 0.050,
+            gp.SERVING_PAD_WASTE: 0.005, gp.SERVING_RESPOND: 0.005})
+        assert led["goodputSeconds"] == pytest.approx(0.050)
+        assert led["badputSeconds"][gp.BADPUT_OTHER] == \
+            pytest.approx(0.005)
+        total = led["goodputSeconds"] + sum(led["badputSeconds"].values())
+        assert total == pytest.approx(led["wallSeconds"])
+        assert gp.categories_sum_ok(led)
+
+    def test_full_vocabulary_zeros_not_omissions(self):
+        led = gp.decompose_request(1.0, {})
+        assert set(led["badputSeconds"]) == \
+            set(gp.SERVING_BADPUT_CATEGORIES)
+        # nothing attributed → everything is honest residual
+        assert led["badputSeconds"][gp.BADPUT_OTHER] == \
+            pytest.approx(1.0)
+        assert led["goodputRatio"] == 0.0
+
+    def test_zero_wall(self):
+        led = gp.decompose_request(0.0, {gp.SERVING_DEVICE: 0.0})
+        assert led["wallSeconds"] == 0.0 and led["goodputRatio"] == 0.0
+
+    def test_oversummed_stages_never_negative_other(self):
+        # cross-thread clock fuzz can oversum; other clamps at zero
+        led = gp.decompose_request(0.010, {gp.SERVING_DEVICE: 0.011})
+        assert led["badputSeconds"][gp.BADPUT_OTHER] == 0.0
+
+
+def _request_span(rid, model, wall, role="primary", outcome="ok",
+                  fill=None, slo_p99_ms=None, start=100.0):
+    ledger = gp.decompose_request(wall, {gp.SERVING_DEVICE: wall * 0.6,
+                                         gp.SERVING_QUEUE: wall * 0.4})
+    attrs = {"model": model, "role": role, "outcome": outcome,
+             "ledger": ledger}
+    if fill is not None:
+        attrs["fill"] = fill
+    if slo_p99_ms is not None:
+        attrs["slo_p99_ms"] = slo_p99_ms
+    return {"trace_id": rid, "span_id": rid, "name":
+            gp.SERVING_REQUEST_SPAN, "component": "serving",
+            "start": start, "end": start + wall, "attrs": attrs}
+
+
+class TestServingRollup:
+    def test_per_model_per_role_rows(self, tmp_path):
+        sink = str(tmp_path / "s.jsonl")
+        with open(sink, "w") as f:
+            for i in range(20):
+                f.write(json.dumps(_request_span(
+                    f"r{i:02d}", "m1", 0.010 + 0.001 * i, fill=0.9,
+                    slo_p99_ms=25.0)) + "\n")
+            f.write(json.dumps(_request_span(
+                "shadow1", "m2", 0.500, role="shadow")) + "\n")
+            f.write(json.dumps(_request_span(
+                "err1", "m1", 0.040, outcome="error",
+                slo_p99_ms=25.0)) + "\n")
+            f.write(json.dumps(_request_span(
+                "shed1", "m1", 0.002, outcome="shed",
+                slo_p99_ms=25.0)) + "\n")
+        roll = gp.serving_rollup(sink)
+        assert roll["requests"] == 23
+        rows = {(m["model"], m["role"]): m for m in roll["models"]}
+        m1 = rows[("m1", "primary")]
+        assert m1["requests"] == 22
+        assert m1["errors"] == 1 and m1["shed"] == 1
+        assert m1["p50Ms"] > 0 and m1["p99Ms"] >= m1["p50Ms"]
+        assert m1["meanFill"] == pytest.approx(0.9)
+        assert m1["goodputRatio"] == pytest.approx(0.6, abs=0.05)
+        assert set(m1["badputSeconds"]) == \
+            set(gp.SERVING_BADPUT_CATEGORIES)
+        # slowest ids are reconstructible handles, largest first
+        assert m1["slowest"][0]["requestId"] == "err1"
+        # SLO block: requests over 25ms against the 1% p99 budget
+        assert m1["slo"]["targetP99Ms"] == 25.0
+        assert m1["slo"]["overTargetRatio"] > 0.01
+        assert m1["slo"]["compliant"] is False
+        # shadow traffic reports under its own role row
+        shadow = rows[("m2", "shadow")]
+        assert shadow["requests"] == 1
+
+    def test_empty_sink(self, tmp_path):
+        roll = gp.serving_rollup(str(tmp_path / "none.jsonl"))
+        assert roll == {"models": [], "requests": 0}
+
+
+# ------------------------------------------------------- replica registry
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestReplicaState:
+    def _state(self, slo=None, windows=BURN_WINDOWS):
+        reg = Registry()
+        clock = FakeClock()
+        rs = ReplicaState(reg, windows=windows, clock=clock)
+        if slo:
+            rs.set_slo("m", slo)
+        return rs, reg, clock
+
+    def test_rolling_percentiles_and_error_ratio(self):
+        rs, reg, clock = self._state()
+        for i in range(100):
+            rs.observe_request("m", 0.010 + 0.0001 * i,
+                               outcome="ok" if i % 10 else "error")
+        rs.refresh()
+        snap = rs.snapshot()
+        row = snap["models"][0]
+        assert row["model"] == "m"
+        assert 10.0 < row["p50Ms"] < 20.0
+        assert row["p99Ms"] >= row["p50Ms"]
+        assert row["errorRatio"] == pytest.approx(0.1)
+        assert row["lastRequestAgeSeconds"] == 0.0
+        text = reg.render()
+        assert 'kftpu_serving_p99_seconds{model="m",role="primary"}' \
+            in text
+        assert 'kftpu_serving_requests_total{model="m",role="primary"' \
+            ',outcome="ok"}' in text
+
+    def test_burn_rates_multi_window(self):
+        rs, reg, clock = self._state(
+            slo=ModelSLO(target_p99_ms=20.0, availability=0.99),
+            windows=(60.0, 3600.0))
+        # old window: 5% of requests over target, 2% errors
+        for i in range(100):
+            over = i < 5
+            rs.observe_request("m", 0.030 if over else 0.010,
+                               outcome="error" if i < 2 else "ok")
+        clock.t += 120  # push those outside the 60s window
+        for i in range(50):
+            rs.observe_request("m", 0.010)
+        snap = rs.snapshot()
+        burns = snap["models"][0]["burnRates"]
+        # 60s window: only the clean recent traffic → burn 0
+        assert burns["60s"]["latency"] == 0.0
+        assert burns["60s"]["availability"] == 0.0
+        # 3600s window: 5/150 over the 1% p99 budget → ~3.3x burn;
+        # 2/150 errors against the 1% availability budget → ~1.3x
+        assert burns["3600s"]["latency"] == pytest.approx(
+            (5 / 150) / 0.01, rel=0.01)
+        assert burns["3600s"]["availability"] == pytest.approx(
+            (2 / 150) / 0.01, rel=0.01)
+        rs.refresh()
+        assert 'kftpu_serving_slo_burn_rate{model="m",slo="latency",' \
+            'window="3600s"}' in reg.render()
+
+    def test_badput_counters_accumulate(self):
+        rs, reg, _ = self._state()
+        led = gp.decompose_request(0.1, {gp.SERVING_QUEUE: 0.04,
+                                         gp.SERVING_DEVICE: 0.05})
+        rs.observe_request("m", 0.1, ledger=led)
+        rs.observe_request("m", 0.1, ledger=led)
+        text = reg.render()
+        assert 'kftpu_serving_badput_seconds_total{model="m",' \
+            'category="queue"} 0.08' in text
+
+    def test_shadow_role_never_pollutes_primary_series(self):
+        rs, reg, _ = self._state(slo=ModelSLO(target_p99_ms=20.0))
+        rs.observe_request("m", 0.010)            # fast primary
+        rs.observe_request("m", 5.0, role="shadow")   # cold shadow JIT
+        rs.refresh()
+        snap = rs.snapshot()
+        row = snap["models"][0]
+        # primary percentiles unaffected by the shadow's 5s outlier
+        assert row["p99Ms"] < 100.0
+        assert row["roles"]["shadow"]["p99Ms"] >= 5000.0
+        # burn rate tracks the PRIMARY only
+        assert row["burnRates"]["300s"]["latency"] == 0.0
+
+    def test_prune_removes_all_series(self):
+        rs, reg, _ = self._state(slo=ModelSLO(target_p99_ms=20.0))
+        rs.observe_request("m", 0.030, ledger=gp.decompose_request(
+            0.03, {gp.SERVING_QUEUE: 0.03}))
+        rs.observe_request("m", 0.030, role="shadow")
+        rs.set_start_kind("m", "warm")
+        rs.refresh()
+        assert 'model="m"' in reg.render()
+        rs.prune(live_models=[])
+        assert 'model="m"' not in reg.render()
+        assert rs.snapshot()["models"] == []
+
+    def test_queue_provider_polled_at_refresh(self):
+        class FakeBatcher:
+            def queue_depth(self):
+                return 7
+
+            def oldest_wait_s(self):
+                return 1.5
+
+        rs, reg, _ = self._state()
+        rs.register_queue("m", FakeBatcher())
+        rs.refresh()
+        text = reg.render()
+        assert 'kftpu_serving_queue_depth{model="m"} 7' in text
+        assert 'kftpu_serving_oldest_wait_seconds{model="m"} 1.5' in text
+
+
+# ----------------------------------------------- live server (jit paths)
+
+from kubeflow_tpu.serving import (ModelRepository, ModelServer,  # noqa: E402
+                                  Servable)
+from kubeflow_tpu.serving.servable import register_model  # noqa: E402
+
+
+@register_model("sobs_double")
+def _build_double(dim: int = 4):
+    import jax.numpy as jnp
+
+    def init_params():
+        return {"w": jnp.full((dim,), 2.0)}
+
+    def predict(params, x):
+        return {"y": x * params["w"]}
+
+    sig = {"inputs": {"shape": [-1, dim], "dtype": "float32"}}
+    return predict, init_params, sig
+
+
+def _server(tmp_path, **kw):
+    repo = ModelRepository()
+    repo.load("mnist", "sobs_double")
+    srv = ModelServer(repo, host="127.0.0.1", port=0, max_latency_ms=1,
+                      span_path=str(tmp_path / "spans.jsonl"), **kw)
+    srv.start()
+    return srv
+
+
+def _post(srv, path, payload, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}{path}") as r:
+        return r.status, r.read()
+
+
+@pytest.mark.compute
+class TestRequestIdPropagation:
+    def test_inbound_id_honored_and_echoed(self, tmp_path):
+        srv = _server(tmp_path, sample_every=1)
+        try:
+            code, _, headers = _post(
+                srv, "/v1/models/mnist:predict",
+                {"instances": [[1, 2, 3, 4]], "dtype": "float32"},
+                headers={"x-request-id": "req-abc-123"})
+            assert code == 200
+            assert headers.get("x-request-id") == "req-abc-123"
+            spans = load_spans(str(tmp_path / "spans.jsonl"))
+            assert spans and all(s["trace_id"] == "req-abc-123"
+                                 for s in spans)
+        finally:
+            srv.stop()
+
+    def test_distinct_ids_minted_otherwise(self, tmp_path):
+        srv = _server(tmp_path)
+        try:
+            ids = set()
+            for _ in range(3):
+                code, _, headers = _post(
+                    srv, "/v1/models/mnist:predict",
+                    {"instances": [[1, 2, 3, 4]], "dtype": "float32"})
+                assert code == 200
+                ids.add(headers.get(REQUEST_ID_HEADER))
+            assert len(ids) == 3 and all(ids)
+        finally:
+            srv.stop()
+
+    def test_same_id_on_every_stage_span(self, tmp_path):
+        """The acceptance path: one id stamps every stage across
+        HTTP handler → batcher → servable timings, and the timeline
+        reconstructs stage-by-stage from the JSONL alone."""
+        srv = _server(tmp_path, sample_every=1)
+        try:
+            rid = "stagetrace01"
+            code, _, _ = _post(
+                srv, "/v1/models/mnist:predict",
+                {"instances": [[1, 2, 3, 4]], "dtype": "float32"},
+                headers={REQUEST_ID_HEADER: rid})
+            assert code == 200
+        finally:
+            srv.stop()
+        timeline = reconstruct(str(tmp_path / "spans.jsonl"), rid)
+        names = timeline["names"]
+        for want in ("accept", "queue", "batch-form", "h2d", "device",
+                     "drain", "respond", gp.SERVING_REQUEST_SPAN):
+            assert want in names, f"missing stage span {want}"
+
+        def in_order(*want):
+            i = 0
+            for nm in names:
+                if i < len(want) and nm == want[i]:
+                    i += 1
+            return i == len(want)
+
+        assert in_order("accept", "queue", "batch-form", "h2d",
+                        "device", "drain", "respond")
+
+    def test_force_sample_header_emits_stage_spans(self, tmp_path):
+        """x-request-sample: 1 forces stage spans for exactly this
+        request even when the sampling cadence would skip it."""
+        srv = _server(tmp_path, sample_every=0)   # summaries only
+        try:
+            _post(srv, "/v1/models/mnist:predict",
+                  {"instances": [[1, 2, 3, 4]], "dtype": "float32"},
+                  headers={REQUEST_ID_HEADER: "unsampled"})
+            _post(srv, "/v1/models/mnist:predict",
+                  {"instances": [[1, 2, 3, 4]], "dtype": "float32"},
+                  headers={REQUEST_ID_HEADER: "forced",
+                           "x-request-sample": "1"})
+        finally:
+            srv.stop()
+        spans = load_spans(str(tmp_path / "spans.jsonl"))
+        by_rid: dict = {}
+        for s in spans:
+            by_rid.setdefault(s["trace_id"], []).append(s["name"])
+        assert by_rid["unsampled"] == [gp.SERVING_REQUEST_SPAN]
+        assert "device" in by_rid["forced"]
+
+    def test_grpc_request_id_metadata(self, tmp_path):
+        grpc_mod = pytest.importorskip("grpc")
+        from kubeflow_tpu.serving import tpu_serving_pb2 as pb
+        from kubeflow_tpu.serving.grpc_server import (GrpcPredictServer,
+                                                      ndarray_to_tensor,
+                                                      predict_stub)
+        srv = _server(tmp_path, sample_every=1)
+        gsrv = GrpcPredictServer(srv, host="127.0.0.1", port=0)
+        gport = gsrv.start()
+        channel = grpc_mod.insecure_channel(f"127.0.0.1:{gport}")
+        try:
+            stub = predict_stub(channel)
+            req = pb.PredictRequest()
+            req.model_spec.name = "mnist"
+            req.inputs["instances"].CopyFrom(ndarray_to_tensor(
+                np.ones((2, 4), np.float32)))
+            _, call = stub["Predict"].with_call(
+                req, metadata=((REQUEST_ID_HEADER, "grpcreq1"),))
+            echoed = dict(call.initial_metadata())
+            assert echoed.get(REQUEST_ID_HEADER) == "grpcreq1"
+        finally:
+            channel.close()
+            gsrv.stop()
+            srv.stop()
+        spans = load_spans(str(tmp_path / "spans.jsonl"),
+                           trace_id="grpcreq1")
+        names = {s["name"] for s in spans}
+        assert gp.SERVING_REQUEST_SPAN in names
+        assert {"queue", "device", "respond"} <= names
+
+    def test_error_request_still_echoes_id_and_lands_ledger(
+            self, tmp_path):
+        srv = _server(tmp_path)
+        try:
+            code, _, headers = _post(
+                srv, "/v1/models/mnist:predict",
+                {"wrong_key": []}, headers={REQUEST_ID_HEADER: "err1"})
+            assert code == 400
+            assert headers.get(REQUEST_ID_HEADER) == "err1"
+            # 404s echo too
+            code, _, headers = _post(
+                srv, "/v1/models/ghost:predict", {"instances": [[1]]},
+                headers={REQUEST_ID_HEADER: "err2"})
+            assert code == 404
+            assert headers.get(REQUEST_ID_HEADER) == "err2"
+        finally:
+            srv.stop()
+        spans = load_spans(str(tmp_path / "spans.jsonl"),
+                           trace_id="err1")
+        summary = [s for s in spans
+                   if s["name"] == gp.SERVING_REQUEST_SPAN]
+        assert summary and summary[0]["attrs"]["outcome"] == "error"
+
+
+@pytest.mark.compute
+class TestRequestLedger:
+    def test_ledger_sums_to_wall_over_http(self, tmp_path):
+        srv = _server(tmp_path)
+        try:
+            for _ in range(4):
+                code, _, _ = _post(
+                    srv, "/v1/models/mnist:predict",
+                    {"instances": [[1, 2, 3, 4], [5, 6, 7, 8],
+                                   [1, 1, 1, 1]],
+                     "dtype": "float32"})
+                assert code == 200
+        finally:
+            srv.stop()
+        spans = load_spans(str(tmp_path / "spans.jsonl"))
+        summaries = [s for s in spans
+                     if s["name"] == gp.SERVING_REQUEST_SPAN]
+        assert len(summaries) == 4
+        for s in summaries:
+            led = s["attrs"]["ledger"]
+            assert gp.categories_sum_ok(led)
+            assert set(led["badputSeconds"]) == \
+                set(gp.SERVING_BADPUT_CATEGORIES)
+            # 3 rows pad to bucket 4 → pad waste recorded, fill 0.75
+            assert s["attrs"]["fill"] == pytest.approx(0.75)
+            assert led["badputSeconds"][gp.SERVING_PAD_WASTE] >= 0.0
+
+    def test_replica_registry_fed_and_metrics_pruned_on_unload(
+            self, tmp_path):
+        srv = _server(tmp_path)
+        try:
+            _post(srv, "/v1/models/mnist:predict",
+                  {"instances": [[1, 2, 3, 4]], "dtype": "float32"})
+            text = srv.metrics_text()
+            assert 'kftpu_serving_requests_total{model="mnist"' in text
+            assert "kubeflow_model_request_count" in text  # wire compat
+            # unload → every serving series for the model disappears
+            with srv.repository._lock:
+                del srv.repository._models["mnist"]
+            text = srv.metrics_text()
+            assert 'model="mnist"' not in text
+        finally:
+            srv.stop()
+
+    def test_healthz_verbose_contract(self, tmp_path):
+        srv = _server(tmp_path)
+        srv.set_slo("mnist", ModelSLO(target_p99_ms=1000.0,
+                                      availability=0.99))
+        try:
+            _post(srv, "/v1/models/mnist:predict",
+                  {"instances": [[1, 2, 3, 4]], "dtype": "float32"})
+            code, body = _get(srv, "/healthz?verbose=1")
+            assert code == 200
+            snap = json.loads(body)
+            row = next(m for m in snap["models"]
+                       if m["model"] == "mnist")
+            for key in ("p50Ms", "p99Ms", "errorRatio", "queueDepth",
+                        "inFlight", "lastRequestAgeSeconds",
+                        "startKind", "burnRates", "slo"):
+                assert key in row, f"healthz missing {key}"
+            assert row["requests"] >= 1
+            # plain healthz unchanged (wire compat)
+            code, body = _get(srv, "/healthz")
+            assert json.loads(body) == {"status": "ok"}
+        finally:
+            srv.stop()
+
+
+class _SlowServable:
+    """Duck-typed servable: host-sleep device, for queue-pressure tests."""
+
+    name = "slow"
+    start_kind = "cold"
+
+    def __init__(self, delay_s=0.15):
+        self.delay_s = delay_s
+
+    def predict(self, instances):
+        time.sleep(self.delay_s)
+        return np.asarray(instances)
+
+    def metadata(self):
+        return {"stats": {"request_count": 0, "predict_seconds": 0.0}}
+
+
+@pytest.mark.compute
+class TestBoundedQueue:
+    def test_queue_full_sheds_429_and_records_ledger(self, tmp_path):
+        repo = ModelRepository()
+        repo.add(_SlowServable())
+        srv = ModelServer(repo, host="127.0.0.1", port=0, max_batch=1,
+                          max_latency_ms=0, max_pending=1,
+                          span_path=str(tmp_path / "spans.jsonl"))
+        srv.start()
+        codes = []
+
+        def fire(i):
+            code, _, headers = _post(
+                srv, "/v1/models/slow:predict",
+                {"instances": [[1.0]]},
+                headers={REQUEST_ID_HEADER: f"burst{i}"})
+            codes.append((code, headers.get(REQUEST_ID_HEADER)))
+
+        try:
+            threads = [threading.Thread(target=fire, args=(i,))
+                       for i in range(6)]
+            for t in threads:
+                t.start()
+                time.sleep(0.01)
+            for t in threads:
+                t.join()
+        finally:
+            metrics = srv.metrics_text()
+            srv.stop()
+        shed = [c for c, _ in codes if c == 429]
+        assert shed, f"no 429s in {codes}"
+        assert all(rid and rid.startswith("burst") for _, rid in codes)
+        # the shed requests' ledgers landed (outcome=shed, not dropped)
+        spans = load_spans(str(tmp_path / "spans.jsonl"))
+        shed_spans = [s for s in spans
+                      if s["name"] == gp.SERVING_REQUEST_SPAN
+                      and s["attrs"]["outcome"] == "shed"]
+        assert len(shed_spans) == len(shed)
+        for s in shed_spans:
+            led = s["attrs"]["ledger"]
+            assert gp.categories_sum_ok(led)
+            # the shed request's unattributed stretch is charged to
+            # queue (the bounded queue turned it away), never to other
+            assert led["badputSeconds"][gp.SERVING_QUEUE] > 0.0
+            assert led["badputSeconds"][gp.BADPUT_OTHER] == 0.0
+        assert "kftpu_serving_shed_total" in metrics
+
+    def test_batcher_queue_depth_and_oldest_age(self):
+        from kubeflow_tpu.serving.batcher import (MicroBatcher,
+                                                  QueueFullError)
+        b = MicroBatcher(_SlowServable(delay_s=0.2), max_batch=1,
+                         max_latency_ms=0, max_pending=2)
+        futs = [b.submit(np.ones((1, 1))) for _ in range(2)]
+        # a third submit may race the loop's collect; pending is bounded
+        with pytest.raises((QueueFullError, RuntimeError)):
+            for _ in range(4):
+                futs.append(b.submit(np.ones((1, 1))))
+        assert b.queue_depth() >= 1
+        assert b.oldest_wait_s() >= 0.0
+        for f in futs:
+            f.result(timeout=10)
+        assert b.queue_depth() == 0
+        assert b.oldest_wait_s() == 0.0
+        b.shutdown()
+
+
+@pytest.mark.compute
+class TestShadowObservability:
+    def test_shadow_gets_own_span_and_role_series(self, tmp_path):
+        from kubeflow_tpu.serving.router import RoutedModel, ShadowRouter
+        repo = ModelRepository()
+        repo.load("prod", "sobs_double")
+        repo.load("canary", "sobs_double")
+        srv = ModelServer(repo, host="127.0.0.1", port=0,
+                          max_latency_ms=1, sample_every=1,
+                          span_path=str(tmp_path / "spans.jsonl"))
+        routed = RoutedModel(ShadowRouter("prod", "canary"), repo,
+                             name="exp")
+        srv.add_router(routed)
+        srv.start()
+        try:
+            code, _, headers = _post(
+                srv, "/v1/routers/exp:predict",
+                {"instances": [[1, 2, 3, 4]], "dtype": "float32"},
+                headers={REQUEST_ID_HEADER: "shadowed1"})
+            assert code == 200
+            routed.drain_shadow()
+            metrics = srv.metrics_text()
+        finally:
+            srv.stop()
+        spans = load_spans(str(tmp_path / "spans.jsonl"))
+        summaries = {s["trace_id"]: s for s in spans
+                     if s["name"] == gp.SERVING_REQUEST_SPAN}
+        primary = summaries["shadowed1"]
+        assert primary["attrs"]["model"] == "prod"
+        assert primary["attrs"]["role"] == "primary"
+        assert primary["attrs"]["router"] == "exp"
+        # the shadow copy: derived id, role=shadow, its own ledger
+        shadow = summaries["shadowed1-shadow"]
+        assert shadow["attrs"]["model"] == "canary"
+        assert shadow["attrs"]["role"] == "shadow"
+        assert gp.categories_sum_ok(shadow["attrs"]["ledger"])
+        # latency series split by role — the cold shadow never lands
+        # in the primary's series
+        assert 'kftpu_serving_requests_total{model="canary",' \
+            'role="shadow",outcome="ok"}' in metrics
+        assert 'kftpu_serving_requests_total{model="prod",' \
+            'role="primary",outcome="ok"}' in metrics
+
+    def test_shadow_failure_recorded_with_role(self, tmp_path):
+        from kubeflow_tpu.serving.router import RoutedModel, ShadowRouter
+
+        class FailShadowRepo:
+            def get(self, name):
+                class S:
+                    def predict(self, x, ctx=None):
+                        if name == "bad":
+                            raise RuntimeError("shadow down")
+                        return np.asarray(x)
+                return S()
+
+        obs = ServingObs(span_path=str(tmp_path / "spans.jsonl"),
+                         sample_every=0)
+        routed = RoutedModel(ShadowRouter("good", "bad"),
+                             FailShadowRepo(), name="exp",
+                             request_obs=obs)
+        ctx = obs.begin("router:exp", request_id="pri1")
+        routed.predict(np.ones((1, 2)), ctx=ctx)
+        ctx.finish("ok")
+        routed.drain_shadow()
+        spans = load_spans(str(tmp_path / "spans.jsonl"))
+        shadow = [s for s in spans
+                  if s["name"] == gp.SERVING_REQUEST_SPAN
+                  and s["attrs"]["role"] == "shadow"]
+        assert shadow and shadow[0]["attrs"]["outcome"] == "error"
+
+
+@pytest.mark.compute
+class TestServableStats:
+    def test_stats_ride_the_obs_registry_wire_compatible(self):
+        repo = ModelRepository()
+        s = repo.load("m", "sobs_double")
+        s.predict(np.ones((2, 4), np.float32))
+        # the legacy snapshot shape still serves metadata()
+        assert s.metadata()["stats"]["request_count"] == 1
+        assert s.metadata()["stats"]["predict_seconds"] > 0
+        # ...but the bookkeeper is the obs Registry now
+        text = s.registry.render()
+        assert 'kubeflow_model_request_count{model="m"} 1' in text
+        assert "kubeflow_model_predict_seconds_total" in text
+
+    def test_predict_with_stages_partition(self):
+        repo = ModelRepository()
+        s = repo.load("m", "sobs_double")
+        s.max_batch = 8
+        out, stages = s.predict_with_stages(
+            np.ones((3, 4), np.float32))
+        np.testing.assert_allclose(out["y"], 2.0 * np.ones((3, 4)))
+        assert stages["bucket"] == 4 and stages["pad_rows"] == 1
+        assert stages["rows"] == 3
+        for key in ("h2d_s", "device_s", "drain_s"):
+            assert stages[key] >= 0.0
+        # oversized split aggregates stages (13 → chunks 8 + 5-pad-to-8)
+        out, stages = s.predict_with_stages(
+            np.ones((13, 4), np.float32))
+        assert out["y"].shape == (13, 4)
+        assert stages["rows"] == 13 and stages["pad_rows"] == 3
+
+    def test_start_kind_defaults_cold(self):
+        repo = ModelRepository()
+        s = repo.load("m", "sobs_double")
+        assert s.start_kind == "cold"
+        s.warmup()   # no persistent cache in tests → still cold
+        assert s.start_kind in ("cold", "warm")
+
+
+@pytest.mark.compute
+class TestBatchPredictTracing:
+    def test_run_carries_request_id_and_spans(self, tmp_path,
+                                              monkeypatch):
+        from kubeflow_tpu.serving.batch_predict import run_batch_predict
+        monkeypatch.setenv("KFTPU_SPAN_PATH",
+                           str(tmp_path / "spans.jsonl"))
+        import kubeflow_tpu.obs.trace as obstrace
+        obstrace.reset_default_tracers()
+        repo = ModelRepository()
+        s = repo.load("m", "sobs_double")
+        np.save(tmp_path / "in.npy", np.ones((5, 4), np.float32))
+        out = tmp_path / "preds.jsonl"
+        summary = run_batch_predict(
+            s, [str(tmp_path / "in.npy")], str(out), batch_size=4,
+            request_id="batchrun01")
+        assert summary["requestId"] == "batchrun01"
+        lines = [json.loads(line)
+                 for line in out.read_text().splitlines()]
+        preds = [ln for ln in lines if "prediction" in ln]
+        assert all(p["requestId"] == "batchrun01" for p in preds)
+        spans = load_spans(str(tmp_path / "spans.jsonl"))
+        summaries = [sp for sp in spans
+                     if sp["name"] == gp.SERVING_REQUEST_SPAN]
+        assert summaries
+        assert summaries[0]["trace_id"].startswith("batchrun01")
+        assert summaries[0]["attrs"]["outcome"] == "ok"
+        obstrace.reset_default_tracers()
+
+
+class TestDashboardServingEndpoint:
+    def test_api_obs_serving(self, tmp_path, monkeypatch):
+        from kubeflow_tpu.cluster.fake import FakeCluster
+        from kubeflow_tpu.obs.trace import SPAN_PATH_ENV
+        from kubeflow_tpu.webapps.dashboard import build_dashboard_app
+        sink = str(tmp_path / "spans.jsonl")
+        with open(sink, "w") as f:
+            for i in range(5):
+                f.write(json.dumps(_request_span(
+                    f"d{i}", "resnet50", 0.02, fill=0.8,
+                    slo_p99_ms=100.0)) + "\n")
+        monkeypatch.setenv(SPAN_PATH_ENV, sink)
+        app = build_dashboard_app(FakeCluster())
+        status, body = app.dispatch("GET", "/api/obs/serving", None)
+        assert status == 200
+        assert body["requests"] == 5
+        row = body["models"][0]
+        assert row["model"] == "resnet50"
+        assert row["slo"]["compliant"] is True
+        assert set(row["badputSeconds"]) == \
+            set(gp.SERVING_BADPUT_CATEGORIES)
+
+    def test_api_obs_serving_no_sink(self, monkeypatch):
+        from kubeflow_tpu.cluster.fake import FakeCluster
+        from kubeflow_tpu.obs.trace import SPAN_PATH_ENV
+        from kubeflow_tpu.webapps.dashboard import build_dashboard_app
+        monkeypatch.delenv(SPAN_PATH_ENV, raising=False)
+        app = build_dashboard_app(FakeCluster())
+        status, body = app.dispatch("GET", "/api/obs/serving", None)
+        assert status == 200 and "note" in body
+
+
+class TestManifestSLOSchema:
+    def test_tpu_serving_renders_slo_and_max_pending(self):
+        from kubeflow_tpu.manifests.serving import tpu_serving
+        objs = tpu_serving(slo_p99_ms=120.0, slo_availability=0.999,
+                           max_pending=128)
+        dep = next(o for o in objs if o["kind"] == "Deployment")
+        args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--slo-p99-ms=120.0" in args
+        assert "--slo-availability=0.999" in args
+        assert "--max-pending=128" in args
+        # defaults render no SLO flags (wire compat)
+        objs = tpu_serving()
+        dep = next(o for o in objs if o["kind"] == "Deployment")
+        args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert not any(a.startswith("--slo") for a in args)
+
+    def test_server_accepts_slo_plumbing(self):
+        """The manifest-rendered knobs land on the server (schema ↔
+        CLI ↔ constructor, one contract) — no server start needed."""
+        from kubeflow_tpu.serving import http_server as hs
+        srv = hs.ModelServer(ModelRepository(), host="127.0.0.1",
+                             port=0, max_pending=128, sample_every=0,
+                             slos={"m": ModelSLO(target_p99_ms=120.0,
+                                                 availability=0.999)})
+        assert srv.replica.slo_of("m").target_p99_ms == 120.0
+        assert srv.max_pending == 128
+        # the CLI flags exist in main()'s surface (grep-level pin)
+        import inspect
+        src = inspect.getsource(hs.main)
+        for flag in ("--slo-p99-ms", "--slo-availability",
+                     "--max-pending", "--sample-every", "--span-path"):
+            assert flag in src
+
+    def test_mint_request_id_shape(self):
+        rid = mint_request_id()
+        assert len(rid) == 16 and rid != mint_request_id()

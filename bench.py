@@ -2181,6 +2181,108 @@ def bench_serving_obs(t_start: float | None = None) -> dict:
     }
 
 
+def bench_serving_fleet(t_start: float | None = None) -> dict:
+    """Serving fleet-resilience acceptance (ISSUE 12): the 3-replica
+    kill-one-of-N availability soak (cluster/chaos.py ServingSoak) —
+    real in-process ModelServers behind the FleetRouter under scripted
+    serving chaos. Asserted:
+
+    1. **Kill one of N**: SIGKILL a replica mid-load (plus a 5xx burst
+       on a survivor and the victim's cold-slow-start restart) — client
+       success ≥ 99.9% with ZERO duplicate deliveries/side effects;
+       the restarted victim is probationally re-admitted.
+    2. **Graceful drain**: drain a replica mid-load — zero in-flight
+       requests lost, the router saw `draining` and routed away.
+    3. **Wedge**: an accepts-never-responds replica is ejected by its
+       breaker and, after recovery, probationally re-admitted.
+    4. **Hedge A/B**: on the per-replica pause heavy-tail load, tail
+       hedging must cut p99.9 vs no-hedging (recorded in PERF.md
+       against the PR 11 single-replica baseline), its duplicated work
+       ledgered as hedge_waste.
+    5. **Ledger audit**: every fleet-request ledger's wall partition
+       holds (upstream + retry + other ≈ wall, residual ≤ 2%) — a
+       hedged or retried request's extra work is NAMED badput.
+
+    Env knobs (serving_fleet_bench_smoke shrinks the geometry):
+    KFTPU_BENCH_FLEET_{SECONDS,THREADS,HEDGE_REQS,REPLICAS}."""
+    import os
+    import shutil
+    import tempfile
+
+    from kubeflow_tpu.cluster.chaos import ServingSoak
+    from kubeflow_tpu.obs import goodput as gp
+
+    t_start = time.perf_counter() if t_start is None else t_start
+    seconds = float(os.environ.get("KFTPU_BENCH_FLEET_SECONDS", "3"))
+    threads = _env_int("KFTPU_BENCH_FLEET_THREADS", 6)
+    hedge_reqs = _env_int("KFTPU_BENCH_FLEET_HEDGE_REQS", 400)
+    replicas = _env_int("KFTPU_BENCH_FLEET_REPLICAS", 3)
+
+    tmp = tempfile.mkdtemp(prefix="kftpu-fleet-")
+    sink = os.path.join(tmp, "fleet.jsonl")
+    try:
+        soak = ServingSoak(span_path=sink, replicas=replicas,
+                           seconds=seconds, threads=threads,
+                           hedge_requests=hedge_reqs)
+        report = soak.run()
+        kill, drain = report["kill"], report["drain"]
+        wedge, hedge = report["wedge"], report["hedge_ab"]
+        audit = report["audit"]
+        checks = {
+            # SIGKILL one of N: success ≥ 99.9%, at-most-once delivery
+            "kill_success_ge_999": kill["success_pct"] >= 99.9,
+            "kill_zero_duplicate_side_effects":
+                audit["duplicate_side_effects"] == 0
+                and audit["audited_server_completions"] > 0,
+            "killed_replica_readmitted": bool(
+                kill["victim_readmitted"]),
+            # graceful drain: zero in-flight lost, router routed away
+            "drain_zero_loss": drain["in_flight_lost"] == 0
+                and drain["success_pct"] == 100.0,
+            "drain_advertised": bool(drain["router_saw_draining"]),
+            # wedged replica: breaker ejection + probation
+            "wedge_ejected": bool(wedge["ejected"]),
+            "wedge_readmitted": bool(wedge["readmitted"]),
+            "wedge_success_ge_999": wedge["success_pct"] >= 99.9,
+            # hedging measurably cuts the tail, waste is named
+            "hedging_cuts_p999": bool(hedge["hedging_cuts_p999"]),
+            "hedge_waste_ledgered": audit["hedge_waste_s"] > 0,
+            "retry_badput_named": audit["retry_badput_s"] > 0,
+            # ledgers sum to wall-clock (≤2% residual)
+            "ledgers_sum_to_wall": bool(audit["ledger_sum_ok"]),
+            "other_residual_le_2pct":
+                audit["other_residual_pct"] <= 2.0,
+        }
+        rollup = gp.fleet_rollup(sink)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    worst_success = min(kill["success_pct"], drain["success_pct"],
+                        wedge["success_pct"])
+    return {
+        "metric": "serving_fleet_kill_success_pct",
+        "value": kill["success_pct"],
+        "unit": "pct",
+        "vs_baseline": None,
+        "mfu": None,
+        "extras": {
+            "replicas": replicas,
+            "kill": {k: v for k, v in kill.items() if k != "fleet"},
+            "drain": drain,
+            "wedge": wedge,
+            "hedge_ab": hedge,
+            "audit": audit,
+            "worst_scenario_success_pct": worst_success,
+            "fleet_rollup": rollup,
+            "fleet_badput_categories":
+                list(gp.FLEET_BADPUT_CATEGORIES),
+            **checks,
+            "all_checks_ok": all(checks.values()),
+        },
+        "_flops_per_chip": 0.0,
+    }
+
+
 def bench_warmstart_child() -> dict:
     """One warm-start arm, run in its OWN process (the whole point is
     process-fresh startup): train a few steps of the small transformer
@@ -2376,7 +2478,7 @@ def main(argv=None) -> int:
     p.add_argument("--mode", default="all",
                    choices=["all", "resnet", "resnet-fused", "lm",
                             "lm-long", "serving", "serving-obs",
-                            "fused-blocks",
+                            "serving-fleet", "fused-blocks",
                             "weight-update", "chaos", "input", "sched",
                             "health", "obs", "goodput", "warmstart",
                             "warmstart-child"])
@@ -2432,6 +2534,8 @@ def main(argv=None) -> int:
         row = bench_serving(t_start=t_start)
     elif args.mode == "serving-obs":
         row = bench_serving_obs(t_start=t_start)
+    elif args.mode == "serving-fleet":
+        row = bench_serving_fleet(t_start=t_start)
     elif args.mode == "fused-blocks":
         row = bench_fused_blocks(t_start=t_start,
                                  routing_out=args.routing_out)

@@ -38,6 +38,31 @@ from .client import (AlreadyExistsError, ConflictError, KubeClient,
 log = logging.getLogger(__name__)
 
 
+def retry_after_s(headers) -> Optional[float]:
+    """A server-sent Retry-After in seconds off a headers mapping, or
+    None (numeric form only — the HTTP-date form is not worth a parser
+    here; unparseable reads as absent). Shared by this client's bounded
+    retry loop and the serving-side retry paths (serving/client.py,
+    serving/fleet.py): a throttling server telling us when to come back
+    must not be hammered at our own jitter cadence."""
+    if headers is None:
+        return None
+    raw = headers.get("Retry-After")
+    if raw is None:
+        return None
+    try:
+        return max(0.0, float(raw))
+    except (TypeError, ValueError):
+        return None
+
+
+def jittered_backoff(delay_s: float, rng=random) -> float:
+    """One jittered backoff interval: uniform in [delay, 1.5*delay] —
+    the decorrelation that keeps a fleet of retriers from hammering a
+    recovering server in lockstep (thundering-herd protection)."""
+    return delay_s * rng.uniform(1.0, 1.5)
+
+
 class _HttpWatch(Watch):
     """A Watch fed by a background stream-reader thread."""
 
@@ -168,7 +193,7 @@ class HttpKubeClient(KubeClient):
                     # a recovering apiserver (thundering-herd protection);
                     # a server-sent Retry-After (429/503 throttling) wins
                     # over our own schedule — the server knows its load
-                    sleep = delay * random.uniform(1.0, 1.5)
+                    sleep = jittered_backoff(delay)
                     retry_after = self._retry_after_s(e)
                     if retry_after is not None:
                         sleep = max(sleep, retry_after)
@@ -195,18 +220,8 @@ class HttpKubeClient(KubeClient):
     @staticmethod
     def _retry_after_s(e: Exception) -> Optional[float]:
         """The server's Retry-After in seconds, when the error carries
-        one (numeric form only — the HTTP-date form is not worth a
-        parser here; unparseable reads as absent)."""
-        headers = getattr(e, "headers", None)
-        if headers is None:
-            return None
-        raw = headers.get("Retry-After")
-        if raw is None:
-            return None
-        try:
-            return max(0.0, float(raw))
-        except (TypeError, ValueError):
-            return None
+        one (the module-level retry_after_s over the error's headers)."""
+        return retry_after_s(getattr(e, "headers", None))
 
     @staticmethod
     def _is_transient(payload: dict) -> bool:

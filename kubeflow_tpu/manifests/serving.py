@@ -30,16 +30,26 @@ def tpu_serving(namespace: str = "kubeflow", name: str = "model-server",
                 reload_interval_s: int = 30,
                 slo_p99_ms: float = None,
                 slo_availability: float = None,
-                max_pending: int = 0) -> list[dict]:
+                max_pending: int = 0,
+                drain_timeout_s: float = 10.0) -> list[dict]:
     """``slo_p99_ms`` / ``slo_availability`` declare the model's SLO
     (serving/replica_state.py renders burn-rate gauges on /metrics);
     ``max_pending`` bounds the batcher queue — past it requests shed
-    with 429 instead of queueing unbounded."""
+    with 429 instead of queueing unbounded. ``num_replicas`` is the
+    fleet size behind the Service; the resilience tier (ISSUE 12)
+    rides on it: readiness probes on /healthz (flips 503 while
+    draining so the endpoints controller routes away), liveness on
+    /healthz?live=1 (stays 200 through a drain — the kubelet must not
+    kill a gracefully-draining pod), a preStop httpGet /drain hook
+    bounded by ``drain_timeout_s``, and — with 2+ replicas — a
+    PodDisruptionBudget keeping N-1 available through voluntary
+    disruptions."""
     from .observability import scrape_annotations
     lbl = {**H.std_labels(name), "kubeflow.org/servable": model_name}
     args = [f"--model-path={model_path}", f"--model-name={model_name}",
             "--grpc-port=9000", "--rest-port=8500",
-            f"--reload-interval={reload_interval_s}"]
+            f"--reload-interval={reload_interval_s}",
+            f"--drain-timeout={drain_timeout_s}"]
     if slo_p99_ms is not None:
         args.append(f"--slo-p99-ms={slo_p99_ms}")
     if slo_availability is not None:
@@ -53,6 +63,25 @@ def tpu_serving(namespace: str = "kubeflow", name: str = "model-server",
         # the model server's /metrics rides the REST port
         pod_annotations=scrape_annotations(8500))
     pod_spec = dep["spec"]["template"]["spec"]
+    serving_container = pod_spec["containers"][0]
+    # readiness flips 503 the moment the replica starts draining;
+    # liveness rides ?live=1 which stays 200 through the drain
+    serving_container["readinessProbe"] = {
+        "httpGet": {"path": "/healthz", "port": 8500},
+        "periodSeconds": 5, "failureThreshold": 2,
+    }
+    serving_container["livenessProbe"] = {
+        "httpGet": {"path": "/healthz?live=1", "port": 8500},
+        "periodSeconds": 10, "failureThreshold": 3,
+        "initialDelaySeconds": 10,
+    }
+    # preStop: the kubelet holds SIGTERM until the synchronous /drain
+    # returns — in-flight work finishes, the batcher cohort flushes
+    serving_container["lifecycle"] = {
+        "preStop": {"httpGet": {"path": "/drain", "port": 8500}}}
+    # pod teardown budget: the drain plus margin for the final flush
+    pod_spec["terminationGracePeriodSeconds"] = \
+        int(drain_timeout_s) + 20
     if model_path:
         # persistent XLA compile cache next to the model: replica
         # restarts and scale-ups skip the per-bucket warmup compiles
@@ -87,6 +116,18 @@ def tpu_serving(namespace: str = "kubeflow", name: str = "model-server",
     out = [dep, svc,
            H.virtual_service(name, namespace, f"/models/{model_name}/",
                              name, 8000 if enable_http_proxy else 8500)]
+    if num_replicas >= 2:
+        # voluntary disruptions (node drain, rollout) may take at most
+        # one replica at a time — the kill-one-of-N soak's contract.
+        # A single-replica deployment gets no PDB: minAvailable=1
+        # there would block every drain forever.
+        pdb = k8s.make("policy/v1", "PodDisruptionBudget", name,
+                       namespace, labels=lbl)
+        pdb["spec"] = {
+            "minAvailable": num_replicas - 1,
+            "selector": {"matchLabels": {H.APP_LABEL: name}},
+        }
+        out.append(pdb)
     if enable_hpa:
         hpa = k8s.make("autoscaling/v2", "HorizontalPodAutoscaler", name,
                        namespace)
@@ -144,7 +185,10 @@ def tpu_serving_simple(namespace: str = "kubeflow",
                        # the declarative SLO + bounded queue the serving
                        # observability plane tracks (ISSUE 11)
                        slo_p99_ms=250.0, slo_availability=0.999,
-                       max_pending=256)
+                       max_pending=256,
+                       # the resilience tier (ISSUE 12): a 3-replica
+                       # fleet with probes, preStop drain, and a PDB
+                       num_replicas=3, drain_timeout_s=10.0)
 
 
 @register("katib-studyjob-example", "Example StudyJob: random search over "

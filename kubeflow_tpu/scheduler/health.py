@@ -174,14 +174,19 @@ def decayed_score(node: dict, now: Optional[float] = None,
 
 
 def fold_event(rec: dict, kind: str, now: float,
-               half_life_s: float = 600.0) -> dict:
+               half_life_s: float = 600.0,
+               weight: Optional[float] = None) -> dict:
     """Pure fold: decay the stored score to ``now``, add the event's
     weight. Any writer can do this without coordination because the
-    record carries its own timestamp."""
+    record carries its own timestamp. ``weight`` overrides the node
+    EVENT_WEIGHTS lookup — other evidence vocabularies (the serving
+    fleet's per-replica circuit breakers, serving/fleet.py) reuse this
+    exact scoring shape with their own kinds and weights."""
     age = max(0.0, now - rec.get("time", 0.0))
     decayed = float(rec.get("score", 0.0)) * \
         0.5 ** (age / max(half_life_s, 1e-9))
-    return {"score": round(decayed + EVENT_WEIGHTS.get(kind, 1.0), 6),
+    w = EVENT_WEIGHTS.get(kind, 1.0) if weight is None else float(weight)
+    return {"score": round(decayed + w, 6),
             "time": now, "events": int(rec.get("events", 0)) + 1,
             "last": kind}
 

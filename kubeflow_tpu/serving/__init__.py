@@ -17,6 +17,10 @@ with a TF-Serving-compatible REST surface.
 - :mod:`replica_state` — per-model rolling health + SLO burn rates,
   published on /metrics and /healthz?verbose=1 for the router and
   autoscaler.
+- :mod:`fleet` — the resilience tier (ISSUE 12): health-routed
+  FleetRouter over N replicas with per-replica circuit breakers,
+  deadline-budgeted failover retries, tail hedging, and drain
+  awareness.
 """
 
 from .servable import Servable, ModelRepository  # noqa: F401
@@ -24,3 +28,5 @@ from .batcher import MicroBatcher, QueueFullError  # noqa: F401
 from .http_server import ModelServer  # noqa: F401
 from .replica_state import ModelSLO, ReplicaState  # noqa: F401
 from .request_trace import ServingObs  # noqa: F401
+from .fleet import (BreakerConfig, CircuitBreaker, FleetConfig,  # noqa: F401
+                    FleetRouter)

@@ -36,6 +36,16 @@ class QueueFullError(RuntimeError):
     (429 / RESOURCE_EXHAUSTED) rather than queue it unbounded."""
 
 
+class BatcherClosedError(RuntimeError):
+    """The batcher is draining or shut down: this replica is going
+    away, not misbehaving. ``http_status = 503`` makes the HTTP layer
+    answer retryable weather (the fleet router re-routes) instead of a
+    non-retryable 400 — a request racing a graceful drain must never
+    fail hard while N-1 healthy replicas could serve it."""
+
+    http_status = 503
+
+
 @dataclass
 class _WorkItem:
     instances: np.ndarray
@@ -56,6 +66,7 @@ class MicroBatcher:
         self.max_pending = max(0, int(max_pending))
         self._queue: "queue.Queue[_WorkItem]" = queue.Queue()
         self._stop = threading.Event()
+        self._draining = False
         self._submit_lock = threading.Lock()
         # waiting-item enqueue times for the oldest-age gauge: keyed by
         # item id, removed when the loop collects the item
@@ -89,7 +100,11 @@ class MicroBatcher:
         # leave its future forever unresolved.
         with self._submit_lock:
             if self._stop.is_set():
-                raise RuntimeError("batcher is shut down")
+                raise BatcherClosedError("batcher is shut down")
+            if self._draining:
+                # drain closed the door: the cohort already queued gets
+                # flushed, but no new work may land behind it
+                raise BatcherClosedError("batcher is draining")
             if self.max_pending and len(self._waiting) >= self.max_pending:
                 raise QueueFullError(
                     f"batcher queue full ({self.max_pending} pending)")
@@ -217,10 +232,36 @@ class MicroBatcher:
             for cohort in groups.values():
                 self._dispatch(cohort)
 
-    def shutdown(self):
+    def drain(self, timeout_s: float = 10.0) -> dict:
+        """Graceful close: stop accepting, flush the pending cohort
+        through the device, then stop the loop. Anything still queued
+        past the deadline is failed FAST with an explicit error — a
+        queued request must never hang forever past server shutdown —
+        and its trace closes with ledger outcome ``drained``. Returns
+        ``{"flushed": n, "failed": m}``."""
+        with self._submit_lock:
+            self._draining = True
+            pending_at_close = len(self._waiting)
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while time.monotonic() < deadline:
+            with self._submit_lock:
+                if not self._waiting:
+                    break
+            time.sleep(0.005)
+        failed = self.shutdown(
+            join_timeout=max(0.5, deadline - time.monotonic()))
+        return {"flushed": max(0, pending_at_close - failed),
+                "failed": failed}
+
+    def shutdown(self, join_timeout: float = 5.0) -> int:
+        """Hard stop: any request still queued is failed fast (never
+        left hanging) with its trace — when it carries one — finished
+        as outcome ``drained``. Returns how many stragglers were
+        failed."""
         with self._submit_lock:
             self._stop.set()
-        self._thread.join(timeout=5)
+        self._thread.join(timeout=join_timeout)
+        failed = 0
         while True:  # fail any stragglers
             try:
                 item = self._queue.get_nowait()
@@ -228,4 +269,14 @@ class MicroBatcher:
                 break
             with self._submit_lock:
                 self._waiting.pop(id(item), None)
-            item.future.set_exception(RuntimeError("batcher shut down"))
+            err = BatcherClosedError(
+                "batcher shut down before this request was "
+                "dispatched (drained)")
+            if item.ctx is not None:
+                # first-wins finish: the handler's own error path then
+                # no-ops — the ledger records the drain, not a generic
+                # error (ISSUE 12 drain contract)
+                item.ctx.finish("drained", error=str(err))
+            item.future.set_exception(err)
+            failed += 1
+        return failed

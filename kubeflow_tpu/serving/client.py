@@ -16,21 +16,64 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
+import urllib.error
 import urllib.request
 from typing import Optional
 
 import numpy as np
 
+from ..cluster.http_client import jittered_backoff, retry_after_s
+from .request_trace import (DEADLINE_HEADER, REQUEST_ID_HEADER,
+                            mint_request_id)
+
 
 def predict(server: str, model: str, instances, dtype: str = "float32",
-            timeout_s: float = 60.0) -> dict:
+            timeout_s: float = 60.0, request_id: Optional[str] = None,
+            retries: int = 2, backoff_s: float = 0.1) -> dict:
+    """POST :predict with the bounded-retry shape of
+    cluster/http_client.py: transient failures (connect errors, 5xx,
+    429) retry up to ``retries`` times with jittered backoff, a
+    server-sent Retry-After (a throttling 429/503) is honored, and 4xx
+    semantics surface immediately — meaning, not weather. One
+    ``x-request-id`` is minted up front and propagated across every
+    attempt (the server echoes it), and the remaining ``timeout_s``
+    budget rides the ``x-request-deadline`` header so the server — and
+    any fleet router in between — can never spend longer on retries
+    than this caller will wait."""
     url = f"http://{server}/v1/models/{model}:predict"
     payload = json.dumps({"instances": instances, "dtype": dtype}).encode()
-    req = urllib.request.Request(
-        url, data=payload, method="POST",
-        headers={"Content-Type": "application/json"})
-    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-        return json.loads(resp.read())
+    rid = request_id or mint_request_id()
+    deadline = time.monotonic() + timeout_s
+    delay = backoff_s
+    for attempt in range(retries + 1):
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(
+                f"predict {model!r}: deadline budget ({timeout_s:.1f}s) "
+                f"exhausted after {attempt} attempt(s)")
+        req = urllib.request.Request(
+            url, data=payload, method="POST",
+            headers={"Content-Type": "application/json",
+                     REQUEST_ID_HEADER: rid,
+                     DEADLINE_HEADER: f"{remaining:.3f}"})
+        try:
+            with urllib.request.urlopen(req, timeout=remaining) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            e.read()
+            transient = e.code == 429 or e.code >= 500
+            if not transient or attempt >= retries:
+                raise
+            sleep = max(jittered_backoff(delay),
+                        retry_after_s(e.headers) or 0.0)
+        except (urllib.error.URLError, TimeoutError, OSError):
+            if attempt >= retries:
+                raise
+            sleep = jittered_backoff(delay)
+        time.sleep(min(sleep, max(0.0, deadline - time.monotonic())))
+        delay *= 2
+    raise RuntimeError("unreachable")  # pragma: no cover
 
 
 def predict_grpc(server: str, model: str, instances,

@@ -108,9 +108,13 @@ class GrpcPredictServer:
     """PredictionService over a ModelServer (shares its MicroBatchers)."""
 
     def __init__(self, model_server, host: str = "0.0.0.0",
-                 port: int = 9000, max_workers: int = 8):
+                 port: int = 9000, max_workers: int = 8,
+                 drain_grace_s: float = 10.0):
         if not HAVE_GRPC:
             raise RuntimeError("grpcio is not available")
+        # graceful-shutdown budget: stop() lets in-flight RPCs run this
+        # long before hard-cancelling (the REST server's drain analog)
+        self.drain_grace_s = drain_grace_s
         # serving cold-start: the first Predict per batch bucket pays an
         # XLA compile unless the persistent cache is live — a gRPC-only
         # deployment (no REST main()) must wire it too, BEFORE the first
@@ -143,6 +147,11 @@ class GrpcPredictServer:
             context.send_initial_metadata(((REQUEST_ID_HEADER, rid),))
         except Exception:  # noqa: BLE001 — metadata is best-effort
             pass
+        if self.model_server.replica.draining:
+            # draining: refuse new RPCs with retryable UNAVAILABLE (the
+            # REST 503 analog) — in-flight ones keep running under the
+            # stop(grace) budget
+            context.abort(grpc.StatusCode.UNAVAILABLE, "draining")
         try:
             batcher = self.model_server.batcher(name)
         except KeyError as e:
@@ -170,8 +179,12 @@ class GrpcPredictServer:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         except Exception as e:  # noqa: BLE001 — surface as INTERNAL
             ctx.finish("error", error=f"{type(e).__name__}: {e}")
-            context.abort(grpc.StatusCode.INTERNAL,
-                          f"{type(e).__name__}: {e}")
+            # an exception may carry its own HTTP status (the chaos
+            # 5xx-burst fault): 503 maps to retryable UNAVAILABLE
+            code = grpc.StatusCode.UNAVAILABLE \
+                if int(getattr(e, "http_status", 0)) == 503 \
+                else grpc.StatusCode.INTERNAL
+            context.abort(code, f"{type(e).__name__}: {e}")
         finally:
             self.model_server.replica.inflight_dec(name)
         import time as _time
@@ -242,8 +255,14 @@ class GrpcPredictServer:
         log.info("gRPC PredictionService on :%d", self.port)
         return self.port
 
-    def stop(self, grace: float = 1.0) -> None:
+    def stop(self, grace: Optional[float] = None) -> None:
+        """Graceful shutdown: new RPCs are rejected immediately while
+        in-flight ones get ``grace`` seconds (default: the server's
+        ``drain_grace_s``) to COMPLETE before being cancelled — a
+        deploy rollout must not drop the RPCs it already accepted.
+        Blocks until the server has fully terminated."""
         if self._server is not None:
+            grace = self.drain_grace_s if grace is None else grace
             self._server.stop(grace).wait()
 
 

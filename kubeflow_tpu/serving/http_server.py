@@ -22,6 +22,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -30,8 +31,8 @@ import numpy as np
 from ..obs.registry import Registry
 from .batcher import MicroBatcher, QueueFullError
 from .replica_state import ModelSLO, ReplicaState
-from .request_trace import (REQUEST_ID_HEADER, ServingObs,
-                            mint_request_id)
+from .request_trace import (DEADLINE_HEADER, REQUEST_ID_HEADER,
+                            ServingObs, mint_request_id)
 from .servable import ModelRepository
 
 
@@ -41,16 +42,23 @@ class ModelServer:
                  max_batch: int = 64, max_latency_ms: float = 5.0,
                  max_pending: int = 0, sample_every: int = 16,
                  span_path: Optional[str] = None,
-                 slos: Optional[dict] = None):
+                 slos: Optional[dict] = None,
+                 drain_timeout_s: float = 10.0):
         self.repository = repository or ModelRepository()
         self.host, self.port = host, port
         self.max_batch = max_batch
         self.max_latency_ms = max_latency_ms
         self.max_pending = max_pending
+        self.drain_timeout_s = drain_timeout_s
         self._batchers: dict[str, MicroBatcher] = {}
         self._batchers_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # live handler connections, for kill() (simulated SIGKILL:
+        # in-flight clients see a reset, not a response)
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self._killed = False
         # experiment routers (A/B, bandit, shadow — serving/router.py)
         self.routers: dict[str, "object"] = {}
         # per-server registry (obs/registry.py), not the process default:
@@ -103,7 +111,17 @@ class ModelServer:
 
     def start(self) -> int:
         handler = _make_handler(self)
-        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        owner = self
+
+        class _Httpd(ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                # a killed server's handler threads die on purpose
+                # (OSError on send) — no traceback spam; real errors
+                # still print
+                if not owner._killed:
+                    super().handle_error(request, client_address)
+
+        self._httpd = _Httpd((self.host, self.port), handler)
         self.port = self._httpd.server_address[1]  # resolve port 0
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
@@ -118,6 +136,61 @@ class ModelServer:
         for b in self._batchers.values():
             b.shutdown()
         self.obs.close()
+
+    def drain(self, timeout_s: Optional[float] = None) -> dict:
+        """Graceful drain (the SIGTERM / preStop contract, ISSUE 12):
+        flip readiness (plain /healthz → 503, ``draining: true`` on
+        the verbose payload so the fleet router stops sending), reject
+        new :predict work with 503 + Retry-After, flush each batcher's
+        pending cohort, and wait for in-flight requests to finish — up
+        to ``drainTimeoutSeconds``. Idempotent; does NOT stop the
+        listener (the caller decides when the process dies). Returns a
+        report the soak asserts zero-loss against."""
+        timeout_s = self.drain_timeout_s if timeout_s is None else \
+            float(timeout_s)
+        already = self.replica.draining
+        self.replica.set_draining(True)
+        inflight_at_start = self.replica.total_inflight()
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        flushed = failed = 0
+        if not already:
+            with self._batchers_lock:
+                batchers = list(self._batchers.values())
+            for b in batchers:
+                r = b.drain(timeout_s=max(0.1,
+                                          deadline - time.monotonic()))
+                flushed += r["flushed"]
+                failed += r["failed"]
+        # in-flight = accepted but not yet responded; the batcher flush
+        # resolved their futures, this waits out response serialization
+        while time.monotonic() < deadline and \
+                self.replica.total_inflight() > 0:
+            time.sleep(0.005)
+        return {"draining": True,
+                "inFlightAtStart": inflight_at_start,
+                "inFlightRemaining": self.replica.total_inflight(),
+                "flushed": flushed, "failed": failed,
+                "drainTimeoutSeconds": timeout_s}
+
+    def kill(self) -> None:
+        """Simulated SIGKILL (the chaos replica-crash fault,
+        cluster/chaos.py): close the listener and every live
+        connection with NO drain — in-flight clients see a reset or
+        an empty response, queued work is abandoned. Real code never
+        calls this; the soak does, to prove the fleet survives it."""
+        self._killed = True
+        if self._httpd:
+            self._httpd.shutdown()
+            # don't wait for handler threads — SIGKILL wouldn't
+            self._httpd.block_on_close = False
+            self._httpd.server_close()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
 
     # -- dispatch -----------------------------------------------------------
 
@@ -170,8 +243,25 @@ def _make_handler(server: ModelServer):
         def log_message(self, *a):  # quiet
             pass
 
+        def setup(self):
+            super().setup()
+            with server._conns_lock:
+                server._conns.add(self.connection)
+
+        def finish(self):
+            with server._conns_lock:
+                server._conns.discard(self.connection)
+            try:
+                super().finish()
+            except OSError:
+                pass  # connection already torn down by kill()
+
         def _send(self, code: int, payload, content_type="application/json",
                   headers: Optional[dict] = None):
+            if server._killed:
+                # simulated SIGKILL: the response must never leave —
+                # the client sees a dead connection, not a late answer
+                raise OSError("server killed")
             body = (payload if isinstance(payload, bytes)
                     else json.dumps(payload).encode())
             self.send_response(code)
@@ -184,7 +274,13 @@ def _make_handler(server: ModelServer):
 
         def _error(self, code: int, msg: str,
                    headers: Optional[dict] = None):
-            self._send(code, {"error": msg}, headers=headers)
+            try:
+                self._send(code, {"error": msg}, headers=headers)
+            except OSError:
+                # the client gave up (deadline timeout, hedge winner
+                # elsewhere) — a late error answer has nobody to read
+                # it; the ledger already recorded the outcome
+                pass
 
         def do_GET(self):
             path, _, rawq = self.path.partition("?")
@@ -192,9 +288,25 @@ def _make_handler(server: ModelServer):
             if path == "/healthz":
                 if "verbose=1" in rawq:
                     # the replica-health contract the router and
-                    # autoscaler poll (serving/replica_state.py)
+                    # autoscaler poll (serving/replica_state.py) —
+                    # always 200: a draining replica must still be
+                    # pollable (the payload carries `draining`)
                     return self._send(200, server.replica.snapshot())
+                if "live=1" in rawq:
+                    # liveness: the process is up — stays 200 through a
+                    # drain so the kubelet doesn't kill a pod that is
+                    # gracefully finishing its in-flight work
+                    return self._send(200, {"status": "ok"})
+                if server.replica.draining:
+                    # readiness flip: endpoints controller pulls this
+                    # pod out of the Service before it dies
+                    return self._send(503, {"status": "draining"})
                 return self._send(200, {"status": "ok"})
+            if path == "/drain":
+                # the preStop hook (manifests/serving.py renders an
+                # httpGet here): synchronous bounded drain, so the
+                # kubelet holds SIGTERM until in-flight work finished
+                return self._send(200, server.drain())
             if path == "/metrics":
                 return self._send(200, server.metrics_text().encode(),
                                   content_type="text/plain")
@@ -266,7 +378,22 @@ def _make_handler(server: ModelServer):
             if ctx is not None:
                 ctx.stage("respond", t_resp, time.time())
 
+        def _deadline_s(self) -> Optional[float]:
+            """The client's remaining deadline budget (the
+            ``x-request-deadline`` contract: seconds the caller will
+            still wait — serving/request_trace.py). Malformed reads as
+            absent."""
+            raw = self.headers.get(DEADLINE_HEADER)
+            if raw is None:
+                return None
+            try:
+                return max(0.0, float(raw))
+            except (TypeError, ValueError):
+                return None
+
         def do_POST(self):
+            if self.path.rstrip("/") == "/drain":
+                return self._send(200, server.drain())
             if ":" not in self.path:
                 return self._error(404, "expected /v1/models/<name>:predict")
             route, verb = self.path.rsplit(":", 1)
@@ -277,6 +404,11 @@ def _make_handler(server: ModelServer):
             name = route[len("/v1/models/"):]
             rid = self._request_id()
             hdr = {REQUEST_ID_HEADER: rid}
+            if server.replica.draining:
+                # draining: refuse new work with an explicit retryable
+                # 503 — the fleet router re-routes to a live replica
+                return self._error(503, "draining",
+                                   headers={**hdr, "Retry-After": "1"})
             ctx = None
             try:
                 req = self._read_body()
@@ -284,13 +416,20 @@ def _make_handler(server: ModelServer):
                     batcher = server.batcher(name)
                 except KeyError as e:  # unknown model only → 404
                     return self._error(404, str(e), headers=hdr)
+                # the deadline budget bounds how long this request may
+                # wait on the batcher future: past it the client is
+                # gone — answer 504 instead of computing for nobody
+                deadline_s = self._deadline_s()
+                timeout = 30.0 if deadline_s is None \
+                    else max(0.001, deadline_s)
                 ctx = server.obs.begin(name, request_id=rid,
                                        force_sample=self._force_sample())
                 server.replica.inflight_inc(name)
                 t0 = time.perf_counter()
                 try:
                     self._run_predict(
-                        lambda x: batcher.predict(x, ctx=ctx), req,
+                        lambda x: batcher.predict(x, timeout=timeout,
+                                                  ctx=ctx), req,
                         ctx=ctx, rid=rid)
                     ctx.finish("ok")
                 finally:
@@ -304,10 +443,18 @@ def _make_handler(server: ModelServer):
                 if ctx is not None:
                     ctx.finish("shed", error=str(e))
                 self._error(429, f"QueueFullError: {e}", headers=hdr)
+            except FuturesTimeoutError:
+                if ctx is not None:
+                    ctx.finish("error", error="deadline exceeded")
+                self._error(504, "deadline exceeded", headers=hdr)
             except Exception as e:  # noqa: BLE001 — surface to client
                 if ctx is not None:
                     ctx.finish("error", error=f"{type(e).__name__}: {e}")
-                self._error(400, f"{type(e).__name__}: {e}", headers=hdr)
+                # an exception may carry its own HTTP status (the chaos
+                # 5xx-burst fault rides this; 5xx reads as retryable
+                # weather to the fleet router, 400 stays meaning)
+                code = int(getattr(e, "http_status", 400))
+                self._error(code, f"{type(e).__name__}: {e}", headers=hdr)
 
         def _router_post(self, name: str, verb: str):
             """/v1/routers/<name>:predict and :feedback (the seldon
@@ -382,6 +529,13 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("--slo-availability", type=float, default=None,
                    help="declarative availability SLO target, e.g. "
                         "0.999")
+    p.add_argument("--drain-timeout", type=float, default=10.0,
+                   help="graceful-drain budget in seconds: on SIGTERM "
+                        "(or GET /drain, the preStop hook) readiness "
+                        "flips, new work is refused with 503, the "
+                        "batcher's pending cohort flushes, and "
+                        "in-flight requests get this long to finish "
+                        "before the process exits")
     args = p.parse_args(argv)
 
     # warm server restarts skip the per-bucket XLA compiles: warmup()
@@ -408,7 +562,8 @@ def main(argv: Optional[list[str]] = None) -> int:
                          max_batch=args.max_batch,
                          max_pending=args.max_pending,
                          sample_every=args.sample_every,
-                         span_path=args.span_path, slos=slos)
+                         span_path=args.span_path, slos=slos,
+                         drain_timeout_s=args.drain_timeout)
     port = server.start()
     grpc_server = None
     if args.grpc_port:
@@ -419,8 +574,26 @@ def main(argv: Optional[list[str]] = None) -> int:
             print(f"gRPC PredictionService on :{gport}", flush=True)
     print(f"model server listening on :{port} "
           f"(models: {repo.names()})", flush=True)
+
+    # graceful drain on SIGTERM (the kubelet's pod-stop signal): flip
+    # readiness, flush + finish in-flight up to --drain-timeout, THEN
+    # die — the fleet router saw `draining` and stopped sending first
+    done = threading.Event()
+
+    def _sigterm(signum, frame):
+        print("SIGTERM: draining "
+              f"(budget {args.drain_timeout:.0f}s)", flush=True)
+        report = server.drain()
+        print(f"drain: {report}", flush=True)
+        if grpc_server:
+            grpc_server.stop(grace=args.drain_timeout)
+        server.stop()
+        done.set()
+
+    import signal
+    signal.signal(signal.SIGTERM, _sigterm)
     try:
-        threading.Event().wait()
+        done.wait()
     except KeyboardInterrupt:
         if grpc_server:
             grpc_server.stop()

@@ -81,6 +81,11 @@ class ReplicaState:
         self.windows = tuple(float(w) for w in windows)
         self.max_samples = max_samples
         self.clock = clock
+        # the fleet-router contract (serving/fleet.py): uptime lets the
+        # router spot a freshly-restarted (cold) replica, draining tells
+        # it to stop sending BEFORE the pod dies
+        self.started_at = self.clock()
+        self.draining = False
         self._lock = threading.Lock()
         self._models: dict[tuple, _ModelWindow] = {}   # (model, role)
         self._slos: dict[str, ModelSLO] = {}
@@ -152,6 +157,13 @@ class ReplicaState:
             "kftpu_serving_slo_burn_rate",
             "error-budget burn rate per SLO and window (1.0 = exactly "
             "consuming budget)", labels=("model", "slo", "window"))
+        self._m_draining = r.gauge(
+            "kftpu_serving_draining",
+            "1 while this replica is draining (readiness flipped, new "
+            "work rejected, in-flight finishing)")
+        self._m_uptime = r.gauge(
+            "kftpu_serving_uptime_seconds",
+            "seconds since this replica started serving")
 
     # ------------------------------------------------------------- feeding
 
@@ -188,6 +200,23 @@ class ReplicaState:
         with self._lock:
             self._inflight[model] = max(
                 0, self._inflight.get(model, 0) - 1)
+
+    def total_inflight(self) -> int:
+        """Accepted-but-unanswered requests across all models — what a
+        graceful drain waits on before the process may exit."""
+        with self._lock:
+            return sum(self._inflight.values())
+
+    def set_draining(self, draining: bool = True) -> None:
+        """Flip the replica-wide draining flag: advertised on the
+        verbose healthz payload and /metrics so the fleet router stops
+        sending BEFORE the pod dies (plain /healthz also flips to 503
+        — the kubelet readiness contract; http_server.py)."""
+        self.draining = bool(draining)
+        self._m_draining.set(1 if self.draining else 0)
+
+    def uptime_seconds(self) -> float:
+        return max(0.0, self.clock() - self.started_at)
 
     def observe_request(self, model: str, latency_s: float,
                         outcome: str = "ok", role: str = "primary",
@@ -309,6 +338,8 @@ class ReplicaState:
                         self._m_burn.labels(
                             model=model, slo=slo_name,
                             window=win_label).set(round(burn, 4))
+        self._m_uptime.set(round(self.uptime_seconds(), 3))
+        self._m_draining.set(1 if self.draining else 0)
         for model, count in inflight.items():
             self._m_inflight.labels(model=model).set(count)
         for model, batcher in queues.items():
@@ -382,7 +413,11 @@ class ReplicaState:
                 pass
         return {"models": sorted(out.values(),
                                  key=lambda m: m["model"]),
-                "windowSeconds": headline}
+                "windowSeconds": headline,
+                # the fleet-router contract: stop routing to a draining
+                # replica; spot a freshly-restarted (cold) one
+                "draining": self.draining,
+                "uptimeSeconds": round(self.uptime_seconds(), 3)}
 
     def prune(self, live_models) -> None:
         """Drop every series for models no longer loaded — a router
@@ -408,7 +443,7 @@ class ReplicaState:
             for role in model_roles:
                 for fam in (self._m_p50, self._m_p99, self._m_err):
                     fam.remove(model=model, role=role)
-                for outcome in ("ok", "error", "shed"):
+                for outcome in ("ok", "error", "shed", "drained"):
                     self._m_requests.remove(model=model, role=role,
                                             outcome=outcome)
                 self._m_latency.remove(model=model, role=role)

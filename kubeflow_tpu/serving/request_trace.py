@@ -35,6 +35,13 @@ from ..obs import trace as obstrace
 # header lookup is case-insensitive, gRPC metadata keys must be lower)
 REQUEST_ID_HEADER = "x-request-id"
 
+# remaining-deadline budget header: seconds the caller will still wait
+# for THIS attempt. The fleet router (serving/fleet.py) decrements it
+# across failover retries so retrying can never exceed what the client
+# asked for; the model server bounds its batcher wait by it (a request
+# whose client is gone must not compute for nobody).
+DEADLINE_HEADER = "x-request-deadline"
+
 # stage span name → ledger category (device splits goodput/pad_waste
 # by fill, handled in RequestTrace.device)
 _STAGE_CATEGORY = {

@@ -20,6 +20,9 @@ Routes:
   GET /api/obs/serving                     (per-model serving rollup:
                                             latency percentiles, goodput
                                             vs serving badput, SLO)
+  GET /api/obs/fleet                       (fleet-router rollup: retries,
+                                            hedges, per-replica wins,
+                                            fleet badput)
   GET /healthz
 """
 
@@ -511,6 +514,22 @@ def build_dashboard_app(client: KubeClient,
                                  f"({SPAN_PATH_ENV} unset)",
                          "models": [], "requests": 0}
         return 200, serving_rollup(span_path)
+
+    @app.route("GET", "/api/obs/fleet")
+    def fleet_obs(params, query, body):
+        """The fleet-router rollup (obs/goodput.py fleet_rollup):
+        every ``fleet-request`` summary span folded into one table —
+        routed-request outcomes, attempt/retry/hedge totals,
+        p50/p99/p99.9 client latency, the fleet badput sums (retry /
+        hedge_waste / other), and per-replica win counts (ISSUE 12)."""
+        from ..obs.goodput import fleet_rollup
+        from ..obs.trace import SPAN_PATH_ENV
+        span_path = os.environ.get(SPAN_PATH_ENV)
+        if not span_path:
+            return 200, {"note": f"no span sink configured "
+                                 f"({SPAN_PATH_ENV} unset)",
+                         "requests": 0}
+        return 200, fleet_rollup(span_path)
 
     @app.route("GET", "/api/sched/queues")
     def sched_queues(params, query, body):

@@ -628,6 +628,105 @@ def test_serving_badput_categories_defined_once_and_shared():
     assert BADPUT_OTHER in led["badputSeconds"]
 
 
+def test_fleet_badput_categories_defined_once_and_shared():
+    """The FLEET badput vocabulary (ISSUE 12: retry / hedge_waste)
+    follows the same single-definition rule: defined in
+    obs/goodput.py, consumed by the fleet router, the soak's audit,
+    the dashboard rollup, and the bench through the shared module —
+    never re-spelled."""
+    import subprocess
+
+    from kubeflow_tpu.obs.goodput import (BADPUT_OTHER,
+                                          FLEET_BADPUT_CATEGORIES,
+                                          decompose_fleet_request,
+                                          fleet_sum_ok)
+
+    assert FLEET_BADPUT_CATEGORIES == ("retry", "hedge_waste", "other")
+
+    # single definition: the distinctive literal appears as a quoted
+    # string in exactly one source file ("retry" is too common a word
+    # to grep; "hedge_waste" is the fingerprint)
+    pkg = os.path.join(REPO_ROOT, "kubeflow_tpu")
+    hits = subprocess.run(
+        ["grep", "-rl", '"hedge_waste"', pkg],
+        capture_output=True, text=True).stdout.split()
+    assert [os.path.relpath(h, pkg) for h in hits] == \
+        [os.path.join("obs", "goodput.py")], \
+        f'"hedge_waste" defined outside obs/goodput.py: {hits}'
+
+    def src(*rel):
+        with open(os.path.join(REPO_ROOT, *rel)) as f:
+            return f.read()
+
+    fleet_src = src("kubeflow_tpu", "serving", "fleet.py")
+    for use in ("gp.decompose_fleet_request", "gp.FLEET_REQUEST_SPAN"):
+        assert use in fleet_src, f"serving/fleet.py must consume {use}"
+    chaos_src = src("kubeflow_tpu", "cluster", "chaos.py")
+    assert "gp.fleet_sum_ok" in chaos_src
+    assert "gp.SERVING_HEDGE_WASTE" in chaos_src
+    dash_src = src("kubeflow_tpu", "webapps", "dashboard.py")
+    assert "from ..obs.goodput import fleet_rollup" in dash_src
+    bench_src = src("bench.py")
+    assert "gp.FLEET_BADPUT_CATEGORIES" in bench_src
+
+    # the full vocabulary on every fleet ledger, and the wall-partition
+    # check holds on a fresh decomposition by construction
+    led = decompose_fleet_request(1.0, 0.6, 0.3, 0.2)
+    assert set(led["badputSeconds"]) == set(FLEET_BADPUT_CATEGORIES)
+    assert BADPUT_OTHER in led["badputSeconds"]
+    assert fleet_sum_ok(led)
+
+
+def test_serving_resilience_knobs_are_plumbed_end_to_end():
+    """The drain/fleet knobs must exist in EVERY layer at once
+    (ISSUE 12): the serving manifest renders probes + preStop + PDB +
+    --drain-timeout, the server CLI parses --drain-timeout into
+    ModelServer.drain_timeout_s, the drain contract fields ride the
+    healthz payload, and the retry/deadline headers are defined once in
+    request_trace.py and consumed (never re-spelled) by the server, the
+    fleet router, and the client."""
+    from kubeflow_tpu.manifests.serving import tpu_serving
+
+    objs = tpu_serving(num_replicas=3, drain_timeout_s=9.0)
+    dep = next(o for o in objs if o["kind"] == "Deployment")
+    container = dep["spec"]["template"]["spec"]["containers"][0]
+    assert "--drain-timeout=9.0" in container["args"]
+    assert container["readinessProbe"]["httpGet"]["path"] == "/healthz"
+    assert container["livenessProbe"]["httpGet"]["path"] == \
+        "/healthz?live=1"
+    assert container["lifecycle"]["preStop"]["httpGet"]["path"] == \
+        "/drain"
+    assert any(o["kind"] == "PodDisruptionBudget" for o in objs)
+
+    def src(*rel):
+        with open(os.path.join(REPO_ROOT, *rel)) as f:
+            return f.read()
+
+    http_src = src("kubeflow_tpu", "serving", "http_server.py")
+    assert "--drain-timeout" in http_src
+    assert "drain_timeout_s=args.drain_timeout" in http_src
+
+    # the deadline/request-id headers: one definition, shared consumers
+    trace_src = src("kubeflow_tpu", "serving", "request_trace.py")
+    assert 'DEADLINE_HEADER = "x-request-deadline"' in trace_src
+    for consumer in ("http_server.py", "fleet.py", "client.py"):
+        csrc = src("kubeflow_tpu", "serving", consumer)
+        assert "DEADLINE_HEADER" in csrc, \
+            f"serving/{consumer} must consume DEADLINE_HEADER"
+        assert '"x-request-deadline"' not in csrc, \
+            f"serving/{consumer} re-spells the deadline header"
+
+    # the draining/uptime healthz fields the router polls exist on the
+    # snapshot, and the fleet reads exactly those names
+    from kubeflow_tpu.obs.registry import Registry
+    from kubeflow_tpu.serving.replica_state import ReplicaState
+    snap = ReplicaState(Registry()).snapshot()
+    assert "draining" in snap and "uptimeSeconds" in snap
+    fleet_src = src("kubeflow_tpu", "serving", "fleet.py")
+    assert 'snap.get("draining")' in fleet_src
+    assert 'snap.get("uptimeSeconds")' in fleet_src
+
+
 def test_run_policy_fields_are_plumbed_end_to_end():
     """Every RunPolicy field must be plumbed spec → controller →
     manifests: round-trip through the TPUJob spec wire format

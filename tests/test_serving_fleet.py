@@ -1,0 +1,807 @@
+"""Serving fleet-resilience tests (ISSUE 12): circuit-breaker state
+machine (evidence decay, trip, half-open probation, manual eject),
+health-routed picks, deadline-budgeted failover retries, Retry-After,
+tail hedging, graceful drain (server, batcher, gRPC), the client retry
+contract, fleet ledgers/metrics/rollup, and the serving manifest's
+probe/preStop/PDB plumbing."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.cluster.chaos import ChaosServable, ServingReplicaHarness
+from kubeflow_tpu.obs import goodput as gp
+from kubeflow_tpu.obs.registry import Registry
+from kubeflow_tpu.obs.trace import load_spans
+from kubeflow_tpu.serving.fleet import (BREAKER_CLOSED, BREAKER_HALF_OPEN,
+                                        BREAKER_OPEN, BreakerConfig,
+                                        CircuitBreaker, DeadlineExceededError,
+                                        FleetConfig, FleetRouter,
+                                        NoReplicaAvailableError,
+                                        RequestRejectedError)
+from kubeflow_tpu.serving.request_trace import (DEADLINE_HEADER,
+                                                REQUEST_ID_HEADER)
+
+pytestmark = pytest.mark.serving_fleet
+
+BODY = json.dumps({"instances": [[1.0, 2.0, 3.0]]}).encode()
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ------------------------------------------------------- circuit breaker
+
+
+class TestCircuitBreaker:
+    def cfg(self, **kw):
+        base = dict(half_life_s=10.0, trip_threshold=3.0,
+                    release_threshold=1.0, open_s=5.0, open_max_s=60.0,
+                    probe_successes=2)
+        base.update(kw)
+        return BreakerConfig(**base)
+
+    def test_trips_at_threshold_and_decays(self):
+        clk = FakeClock()
+        b = CircuitBreaker(self.cfg(), clock=clk)
+        assert b.state() == BREAKER_CLOSED
+        b.record_failure("5xx")            # weight 0.5
+        b.record_failure("timeout")        # weight 1.0
+        assert b.state() == BREAKER_CLOSED
+        tripped = b.record_failure("connect-failure")  # 2.5 < 3 → no
+        assert not tripped and b.state() == BREAKER_CLOSED
+        assert b.record_failure("timeout")             # 3.5 → trip
+        assert b.state() == BREAKER_OPEN
+        # decay is the forgiveness: the same evidence long ago scores ~0
+        clk.advance(100.0)
+        assert b.score() < 0.01
+
+    def test_half_open_probe_then_close_needs_decay_and_successes(self):
+        clk = FakeClock()
+        b = CircuitBreaker(self.cfg(half_life_s=5.0), clock=clk)
+        for _ in range(3):
+            b.record_failure("timeout")
+        assert b.state() == BREAKER_OPEN
+        assert not b.allow_request()       # open: nothing routes
+        clk.advance(5.1)                   # cooldown elapsed
+        assert b.state() == BREAKER_HALF_OPEN
+        # one probe at a time — the second claim loses
+        assert b.try_probe()
+        assert not b.try_probe()
+        clk.advance(10.0)                  # score decays under release
+        assert not b.record_success()      # 1/2 probes
+        assert b.try_probe()
+        assert b.record_success()          # 2/2 AND decayed → closed
+        assert b.state() == BREAKER_CLOSED
+
+    def test_probe_failure_reopens_with_extended_cooldown(self):
+        clk = FakeClock()
+        b = CircuitBreaker(self.cfg(open_s=5.0), clock=clk)
+        for _ in range(3):
+            b.record_failure("timeout")
+        clk.advance(5.1)
+        assert b.state() == BREAKER_HALF_OPEN
+        assert b.try_probe()
+        assert b.record_failure("timeout")  # probe failed → re-open
+        assert b.state() == BREAKER_OPEN
+        clk.advance(5.1)                    # old cooldown is NOT enough
+        assert b.state() == BREAKER_OPEN
+        clk.advance(5.0)                    # doubled cooldown elapses
+        assert b.state() == BREAKER_HALF_OPEN
+
+    def test_success_without_decay_keeps_half_open(self):
+        clk = FakeClock()
+        b = CircuitBreaker(self.cfg(half_life_s=1000.0), clock=clk)
+        for _ in range(4):
+            b.record_failure("timeout")
+        clk.advance(5.1)
+        assert b.state() == BREAKER_HALF_OPEN
+        for _ in range(3):
+            assert b.try_probe()
+            assert not b.record_success()   # score still hot
+        assert b.state() == BREAKER_HALF_OPEN
+
+    def test_manual_eject_never_auto_releases(self):
+        clk = FakeClock()
+        b = CircuitBreaker(self.cfg(), clock=clk)
+        b.eject(manual=True)
+        clk.advance(10_000.0)
+        assert b.state() == BREAKER_OPEN    # no half-open, ever
+        assert not b.allow_request()
+        b.release()                         # the human's explicit call
+        assert b.state() == BREAKER_CLOSED
+
+    def test_release_probe_frees_an_abandoned_slot(self):
+        clk = FakeClock()
+        b = CircuitBreaker(self.cfg(open_s=1.0), clock=clk)
+        for _ in range(3):
+            b.record_failure("timeout")
+        clk.advance(1.1)
+        assert b.try_probe()
+        assert not b.try_probe()    # slot held
+        b.release_probe()           # abandoned-hedge path
+        assert b.try_probe()        # probe-able again, no evidence
+
+    def test_config_rejects_unknown_keys(self):
+        with pytest.raises(ValueError, match="unknown breaker config"):
+            BreakerConfig.from_dict({"tripThreshold": 2, "typo": 1})
+        cfg = BreakerConfig.from_dict({"tripThreshold": 2.5})
+        assert cfg.trip_threshold == 2.5
+        assert BreakerConfig.from_dict(cfg.to_dict()) == cfg
+
+
+# ---------------------------------------------------------- fleet ledger
+
+
+class TestFleetLedger:
+    def test_partition_and_sum_check(self):
+        led = gp.decompose_fleet_request(1.0, 0.7, 0.2,
+                                         hedge_waste_seconds=0.5)
+        assert led["badputSeconds"][gp.SERVING_RETRY] == 0.2
+        assert led["badputSeconds"][gp.BADPUT_OTHER] == \
+            pytest.approx(0.1)
+        # hedge_waste overlaps the winner: named, outside the partition
+        assert led["badputSeconds"][gp.SERVING_HEDGE_WASTE] == 0.5
+        assert gp.fleet_sum_ok(led)
+        assert set(led["badputSeconds"]) == \
+            set(gp.FLEET_BADPUT_CATEGORIES)
+
+    def test_sum_check_catches_a_leak(self):
+        led = gp.decompose_fleet_request(1.0, 0.7, 0.2)
+        led["badputSeconds"][gp.BADPUT_OTHER] = 0.0  # silently absorbed
+        assert not gp.fleet_sum_ok(led)
+
+    def test_rollup_folds_fleet_spans(self, tmp_path):
+        sink = str(tmp_path / "f.jsonl")
+        from kubeflow_tpu.obs.trace import SpanWriter
+        w = SpanWriter(sink, "fleet")
+        for i, (outcome, retries) in enumerate(
+                [("ok", 0), ("ok", 2), ("deadline", 3)]):
+            w.emit(gp.FLEET_REQUEST_SPAN, start=float(i), end=i + 0.01,
+                   trace_id=f"r{i}", outcome=outcome, replica="a",
+                   attempts=retries + 1, retries=retries, hedged=i == 1,
+                   ledger=gp.decompose_fleet_request(
+                       0.01, 0.008, 0.001, 0.002 if i == 1 else 0.0))
+        w.close()
+        roll = gp.fleet_rollup(sink)
+        assert roll["requests"] == 3
+        assert roll["outcomes"] == {"ok": 2, "deadline": 1}
+        assert roll["retries"] == 5 and roll["hedged"] == 1
+        assert roll["badputSeconds"][gp.SERVING_HEDGE_WASTE] == \
+            pytest.approx(0.002)
+        assert roll["replicas"] == {"a": 3}
+
+
+# ------------------------------------------------------------- the pick
+
+
+def _router(urls, clock=None, **cfg_kw):
+    cfg = FleetConfig(poll_interval_s=0.05, poll_timeout_s=1.0,
+                      backoff_s=0.01, **cfg_kw)
+    kw = {"clock": clock} if clock is not None else {}
+    return FleetRouter(replicas=urls, config=cfg, **kw)
+
+
+class TestPick:
+    def test_least_loaded_by_queue_depth_and_p99(self):
+        router = _router({})
+        router.add_replica("busy", "http://127.0.0.1:1")
+        router.add_replica("idle", "http://127.0.0.1:2")
+        busy, idle = router.replica("busy"), router.replica("idle")
+        for rep, depth, p99 in ((busy, 8, 50.0), (idle, 0, 5.0)):
+            rep.poll_ok = True
+            rep.health = {"models": [{"model": "m", "queueDepth": depth,
+                                      "inFlight": 0, "p99Ms": p99}]}
+        assert router.pick("m").name == "idle"
+        # queue drains on busy, p99 dominates the other way
+        busy.health["models"][0]["queueDepth"] = 0
+        busy.health["models"][0]["p99Ms"] = 500.0
+        assert router.pick("m").name == "idle"
+        router.close()
+
+    def test_skips_draining_excluded_and_open(self):
+        router = _router({})
+        for name in ("a", "b", "c", "d"):
+            router.add_replica(name, f"http://127.0.0.1:{ord(name)}")
+        router.replica("a").draining = True
+        router.replica("b").breaker.eject()
+        picked = {router.pick("m", exclude={"c"}).name
+                  for _ in range(5)}
+        assert picked == {"d"}
+        with pytest.raises(NoReplicaAvailableError):
+            router.pick("m", exclude={"c", "d"})
+        router.close()
+
+    def test_half_open_probe_takes_priority_once(self):
+        clk = FakeClock()
+        router = _router({}, clock=clk,
+                         )
+        router.breaker_config = BreakerConfig(open_s=1.0)
+        router.add_replica("p", "http://127.0.0.1:1")
+        router.add_replica("q", "http://127.0.0.1:2")
+        rep = router.replica("p")
+        rep.breaker.cfg = router.breaker_config
+        for _ in range(3):
+            rep.breaker.record_failure("timeout")
+        clk.advance(1.1)
+        # first pick is the probe; while it is in flight the rest of
+        # the traffic routes to the healthy replica
+        assert router.pick("m").name == "p"
+        assert router.pick("m").name == "q"
+        router.close()
+
+
+# ------------------------------------------- live fleet: retries, drain
+
+
+@pytest.fixture
+def harness_pair(tmp_path):
+    sink = str(tmp_path / "spans.jsonl")
+    hs = []
+    for i in range(2):
+        h = ServingReplicaHarness(f"r{i}", span_path=sink,
+                                  predict_s=0.001, seed=i)
+        h.start()
+        hs.append(h)
+    yield hs, sink
+    for h in hs:
+        h.stop()
+
+
+class TestFailover:
+    def test_connect_failure_reroutes_to_different_replica(
+            self, harness_pair):
+        hs, sink = harness_pair
+        router = FleetRouter(
+            replicas={hs[0].name: hs[0].url, hs[1].name: hs[1].url},
+            config=FleetConfig(max_retries=2, backoff_s=0.01,
+                               attempt_timeout_s=1.0,
+                               default_deadline_s=5.0),
+            span_path=sink)
+        try:
+            hs[0].kill()
+            # every request succeeds; the dead replica's attempts fold
+            # into its breaker until it ejects
+            for i in range(8):
+                out = router.request("chaos", BODY,
+                                     request_id=f"fo{i}")
+                assert "predictions" in out
+            spans = [s for s in load_spans(sink)
+                     if s.get("name") == gp.FLEET_REQUEST_SPAN]
+            assert all((s["attrs"]["outcome"] == "ok") for s in spans)
+            retried = [s for s in spans if s["attrs"]["retries"] > 0]
+            assert retried, "the dead replica must have cost retries"
+            for s in retried:
+                assert gp.fleet_sum_ok(s["attrs"]["ledger"])
+                assert s["attrs"]["ledger"]["badputSeconds"][
+                    gp.SERVING_RETRY] > 0
+        finally:
+            router.close()
+
+    def test_5xx_burst_retries_and_4xx_surfaces(self, harness_pair):
+        hs, sink = harness_pair
+        router = FleetRouter(
+            replicas={hs[0].name: hs[0].url, hs[1].name: hs[1].url},
+            config=FleetConfig(max_retries=2, backoff_s=0.01,
+                               attempt_timeout_s=1.0,
+                               default_deadline_s=5.0))
+        try:
+            hs[0].servable.fail_next(1, status=500)
+            hs[1].servable.fail_next(1, status=500)
+            out = router.request("chaos", BODY)
+            assert "predictions" in out
+            # 4xx is meaning: unknown model → 404, never retried
+            t0 = time.monotonic()
+            with pytest.raises(RequestRejectedError):
+                router.request("nosuchmodel", BODY)
+            assert time.monotonic() - t0 < 1.0  # no backoff burned
+        finally:
+            router.close()
+
+    def test_deadline_budget_bounds_retries(self, harness_pair):
+        hs, _ = harness_pair
+        router = FleetRouter(
+            replicas={hs[0].name: hs[0].url, hs[1].name: hs[1].url},
+            config=FleetConfig(max_retries=50, backoff_s=0.05,
+                               attempt_timeout_s=0.2,
+                               default_deadline_s=0.4))
+        try:
+            for h in hs:
+                h.kill()
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceededError):
+                router.request("chaos", BODY)
+            # the budget, not the huge retry count, ended it
+            assert time.monotonic() - t0 < 2.0
+        finally:
+            router.close()
+
+    def test_deadline_header_bounds_the_server_side_wait(
+            self, harness_pair):
+        # the ModelServer bounds its batcher wait by the inbound
+        # x-request-deadline: an expired budget answers 504 instead of
+        # computing for a client that already left
+        hs, _ = harness_pair
+        req = urllib.request.Request(
+            f"{hs[0].url}/v1/models/chaos:predict", data=BODY,
+            method="POST",
+            headers={"Content-Type": "application/json",
+                     REQUEST_ID_HEADER: "dl1",
+                     DEADLINE_HEADER: "0.0001"})
+        hs[0].servable.wedge()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=5.0)
+            assert err.value.code == 504
+            assert err.value.headers.get(REQUEST_ID_HEADER) == "dl1"
+            err.value.read()
+        finally:
+            hs[0].servable.unwedge()
+
+    def test_retry_after_is_honored(self):
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+        hits = []
+
+        class Stub(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                hits.append(time.monotonic())
+                if len(hits) == 1:
+                    # a throttling 503 telling us when to come back
+                    body = b'{"error": "throttled"}'
+                    self.send_response(503)
+                    self.send_header("Retry-After", "0.15")
+                else:
+                    body = b'{"predictions": [[1.0]]}'
+                    self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = HTTPServer(("127.0.0.1", 0), Stub)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        router = FleetRouter(
+            replicas={"only": f"http://127.0.0.1:"
+                              f"{httpd.server_address[1]}"},
+            config=FleetConfig(max_retries=2, backoff_s=0.001,
+                               attempt_timeout_s=1.0,
+                               default_deadline_s=5.0))
+        try:
+            out = router.request("m", BODY)
+            assert out == {"predictions": [[1.0]]}
+            # the server-sent Retry-After (0.15 s) outranks the
+            # router's own ~1 ms jittered backoff
+            assert hits[1] - hits[0] >= 0.15
+        finally:
+            router.close()
+            httpd.shutdown()
+            httpd.server_close()
+
+
+class TestHedging:
+    def test_hedge_saves_the_tail_and_ledgers_waste(self, tmp_path):
+        sink = str(tmp_path / "hedge.jsonl")
+        slow = ServingReplicaHarness("slow", span_path=sink,
+                                     predict_s=0.25)
+        fast = ServingReplicaHarness("fast", span_path=sink,
+                                     predict_s=0.002)
+        slow.start()
+        fast.start()
+        router = FleetRouter(
+            replicas={"slow": slow.url, "fast": fast.url},
+            config=FleetConfig(hedge=True, hedge_delay_ms=20.0,
+                               attempt_timeout_s=2.0,
+                               default_deadline_s=5.0),
+            span_path=sink)
+        try:
+            # force the pick onto the slow replica so the hedge must
+            # rescue it
+            router.replica("fast").poll_ok = True
+            router.replica("fast").health = {
+                "models": [{"model": "chaos", "queueDepth": 99,
+                            "inFlight": 0, "p99Ms": 0.0}]}
+            t0 = time.monotonic()
+            out = router.request("chaos", BODY, request_id="hedge1")
+            elapsed = time.monotonic() - t0
+            assert "predictions" in out
+            assert elapsed < 0.2, \
+                f"hedge should beat the 250ms primary ({elapsed:.3f}s)"
+            span = [s for s in load_spans(sink)
+                    if s.get("name") == gp.FLEET_REQUEST_SPAN][-1]
+            assert span["attrs"]["hedged"] is True
+            # the win is credited to the replica that ANSWERED (the
+            # twin), not the slow primary that was hedged around
+            assert span["attrs"]["replica"] == "fast"
+            assert span["attrs"]["ledger"]["badputSeconds"][
+                gp.SERVING_HEDGE_WASTE] > 0
+            assert gp.fleet_sum_ok(span["attrs"]["ledger"])
+            hedge_events = [s for s in load_spans(sink)
+                            if s.get("name") == "fleet-hedge"]
+            assert hedge_events and \
+                hedge_events[-1]["trace_id"] == "hedge1"
+        finally:
+            router.close()
+            slow.stop()
+            fast.stop()
+
+
+# --------------------------------------------------------------- drain
+
+
+class TestDrain:
+    def test_server_drain_flips_readiness_and_advertises(self):
+        h = ServingReplicaHarness("d0", predict_s=0.001)
+        h.start()
+        try:
+            # pre-drain: ready
+            with urllib.request.urlopen(f"{h.url}/healthz",
+                                        timeout=5) as r:
+                assert r.status == 200
+            report = h.server.drain(timeout_s=1.0)
+            assert report["inFlightRemaining"] == 0
+            # readiness flips 503; liveness stays 200; verbose carries
+            # draining + uptime (the fleet-router contract)
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(f"{h.url}/healthz", timeout=5)
+            assert err.value.code == 503
+            err.value.read()
+            with urllib.request.urlopen(f"{h.url}/healthz?live=1",
+                                        timeout=5) as r:
+                assert r.status == 200
+            with urllib.request.urlopen(f"{h.url}/healthz?verbose=1",
+                                        timeout=5) as r:
+                snap = json.loads(r.read())
+            assert snap["draining"] is True
+            assert snap["uptimeSeconds"] >= 0
+            # new predict work is refused with a retryable 503
+            req = urllib.request.Request(
+                f"{h.url}/v1/models/chaos:predict", data=BODY,
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=5)
+            assert err.value.code == 503
+            assert err.value.headers.get("Retry-After") is not None
+            err.value.read()
+        finally:
+            h.stop()
+
+    def test_request_racing_a_drain_gets_retryable_503_not_400(self):
+        # a request past the handler's draining check that hits the
+        # already-draining batcher must read as weather (503 → the
+        # fleet re-routes), never as a hard 400
+        h = ServingReplicaHarness("d2", predict_s=0.001)
+        h.start()
+        try:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"{h.url}/v1/models/chaos:predict", data=BODY,
+                    method="POST",
+                    headers={"Content-Type": "application/json"}),
+                timeout=5).read()
+            h.server.batcher("chaos").drain(timeout_s=1.0)
+            req = urllib.request.Request(
+                f"{h.url}/v1/models/chaos:predict", data=BODY,
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=5)
+            assert err.value.code == 503
+            err.value.read()
+        finally:
+            h.stop()
+
+    def test_drain_endpoint_is_the_prestop_hook(self):
+        h = ServingReplicaHarness("d1", predict_s=0.001)
+        h.start()
+        try:
+            with urllib.request.urlopen(f"{h.url}/drain",
+                                        timeout=10) as r:
+                report = json.loads(r.read())
+            assert report["draining"] is True
+            assert h.server.replica.draining
+        finally:
+            h.stop()
+
+    def test_batcher_drain_flushes_pending_cohort(self, tmp_path):
+        from kubeflow_tpu.serving.batcher import MicroBatcher
+        servable = ChaosServable(predict_s=0.02)
+        b = MicroBatcher(servable, max_batch=4, max_latency_ms=1.0)
+        futures = [b.submit([[float(i)]]) for i in range(4)]
+        report = b.drain(timeout_s=5.0)
+        for f in futures:
+            assert f.result(timeout=1) is not None  # flushed, not lost
+        assert report["failed"] == 0
+        with pytest.raises(RuntimeError):
+            b.submit([[9.0]])  # the door is closed
+
+    def test_batcher_shutdown_fails_fast_with_drained_outcome(
+            self, tmp_path):
+        from kubeflow_tpu.serving.batcher import MicroBatcher
+        from kubeflow_tpu.serving.request_trace import ServingObs
+        sink = str(tmp_path / "drained.jsonl")
+        obs = ServingObs(span_path=sink, sample_every=0)
+        servable = ChaosServable(predict_s=0.01)
+        servable.wedge()   # the loop jams: queued work cannot flush
+        b = MicroBatcher(servable, max_batch=2, max_latency_ms=0.1)
+        ctxs = [obs.begin("chaos") for _ in range(3)]
+        futures = [b.submit([[1.0]], ctx=c) for c in ctxs]
+        failed = b.shutdown(join_timeout=0.2)
+        assert failed >= 1
+        # a queued request must never hang: every straggler future is
+        # resolved with an explicit error...
+        resolved = 0
+        for f in futures:
+            if f.done():
+                with pytest.raises(RuntimeError, match="drained"):
+                    f.result(timeout=0)
+                resolved += 1
+        assert resolved == failed
+        obs.close()
+        # ...and its ledger outcome reads drained
+        drained = [s for s in load_spans(sink)
+                   if s.get("name") == gp.SERVING_REQUEST_SPAN
+                   and (s.get("attrs") or {}).get("outcome") ==
+                   "drained"]
+        assert len(drained) == failed
+        servable.unwedge()
+
+    @pytest.mark.skipif(
+        not __import__("kubeflow_tpu.serving.grpc_server",
+                       fromlist=["HAVE_GRPC"]).HAVE_GRPC,
+        reason="grpcio not available")
+    def test_grpc_rejects_new_rpcs_while_draining(self):
+        import grpc as grpc_mod
+
+        from kubeflow_tpu.serving import tpu_serving_pb2 as pb
+        from kubeflow_tpu.serving.grpc_server import (GrpcPredictServer,
+                                                      predict_stub)
+        h = ServingReplicaHarness("g0", predict_s=0.001)
+        h.start()
+        g = GrpcPredictServer(h.server, port=0, drain_grace_s=2.0)
+        gport = g.start()
+        try:
+            h.server.replica.set_draining(True)
+            channel = grpc_mod.insecure_channel(f"127.0.0.1:{gport}")
+            stub = predict_stub(channel)
+            req = pb.PredictRequest()
+            req.model_spec.name = "chaos"
+            req.inputs["instances"].tensor_shape.dim.add().size = 1
+            req.inputs["instances"].dtype = pb.DT_FLOAT
+            req.inputs["instances"].float_val.append(1.0)
+            with pytest.raises(grpc_mod.RpcError) as err:
+                stub["Predict"](req, timeout=5.0)
+            assert err.value.code() == \
+                grpc_mod.StatusCode.UNAVAILABLE
+            channel.close()
+        finally:
+            g.stop(grace=0.1)
+            h.stop()
+
+
+# ----------------------------------------------------- client contract
+
+
+class TestClientRetries:
+    def test_client_propagates_rid_and_deadline_and_retries(self):
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        from kubeflow_tpu.serving.client import predict
+        seen = []
+
+        class Stub(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                seen.append({
+                    "rid": self.headers.get(REQUEST_ID_HEADER),
+                    "deadline": self.headers.get(DEADLINE_HEADER)})
+                if len(seen) < 3:
+                    # two 503s with Retry-After, then success
+                    body = b'{"error": "busy"}'
+                    self.send_response(503)
+                    self.send_header("Retry-After", "0.01")
+                    self.send_header("Content-Length",
+                                     str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                body = b'{"predictions": [[1.0]]}'
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = HTTPServer(("127.0.0.1", 0), Stub)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            out = predict(f"127.0.0.1:{port}", "m", [[1.0]],
+                          timeout_s=10.0, request_id="cli1",
+                          retries=3, backoff_s=0.01)
+            assert out == {"predictions": [[1.0]]}
+            assert len(seen) == 3
+            # ONE request id across every attempt; the deadline budget
+            # shrinks monotonically as attempts burn it
+            assert {s["rid"] for s in seen} == {"cli1"}
+            deadlines = [float(s["deadline"]) for s in seen]
+            assert deadlines == sorted(deadlines, reverse=True)
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_client_does_not_retry_meaning(self):
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        from kubeflow_tpu.serving.client import predict
+        hits = []
+
+        class Stub(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                hits.append(1)
+                body = b'{"error": "bad dtype"}'
+                self.send_response(400)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = HTTPServer(("127.0.0.1", 0), Stub)
+        port = httpd.server_address[1]
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError):
+                predict(f"127.0.0.1:{port}", "m", [[1.0]],
+                        timeout_s=5.0, retries=3, backoff_s=0.01)
+            assert len(hits) == 1   # 4xx is meaning, not weather
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+# -------------------------------------------------- metrics + registry
+
+
+class TestFleetMetrics:
+    def test_breaker_series_pruned_on_replica_removal(self):
+        reg = Registry()
+        router = FleetRouter(registry=reg)
+        router.add_replica("gone", "http://127.0.0.1:1")
+        router.replica("gone").breaker.eject()
+        router._refresh_breaker_gauges()
+        assert 'replica="gone"' in reg.render()
+        router.remove_replica("gone")
+        # the model-unload prune rule: no frozen series for a gone
+        # replica anywhere in the exposition
+        assert 'replica="gone"' not in reg.render()
+        router.close()
+
+    def test_replica_state_drained_outcome_prunes_clean(self):
+        from kubeflow_tpu.serving.replica_state import ReplicaState
+        reg = Registry()
+        rs = ReplicaState(reg)
+        rs.observe_request("m", 0.01, outcome="drained")
+        rs.refresh()
+        assert 'outcome="drained"' in reg.render()
+        rs.prune([])
+        assert 'model="m"' not in reg.render()
+
+    def test_uptime_and_draining_on_metrics(self):
+        from kubeflow_tpu.serving.replica_state import ReplicaState
+        reg = Registry()
+        rs = ReplicaState(reg, clock=FakeClock(0.0))
+        rs.clock.advance(12.5) if hasattr(rs.clock, "advance") else None
+        rs.set_draining(True)
+        rs.refresh()
+        text = reg.render()
+        assert "kftpu_serving_draining 1" in text
+        assert "kftpu_serving_uptime_seconds 12.5" in text
+
+
+# ------------------------------------------------------- manifest knobs
+
+
+class TestServingManifest:
+    def render(self, **kw):
+        from kubeflow_tpu.manifests.serving import tpu_serving
+        return tpu_serving(num_replicas=3, drain_timeout_s=7.0, **kw)
+
+    def test_probes_prestop_and_pdb_rendered(self):
+        objs = self.render()
+        dep = next(o for o in objs if o["kind"] == "Deployment")
+        spec = dep["spec"]["template"]["spec"]
+        c = spec["containers"][0]
+        assert c["readinessProbe"]["httpGet"]["path"] == "/healthz"
+        assert c["livenessProbe"]["httpGet"]["path"] == \
+            "/healthz?live=1"
+        assert c["lifecycle"]["preStop"]["httpGet"]["path"] == "/drain"
+        assert "--drain-timeout=7.0" in c["args"]
+        assert spec["terminationGracePeriodSeconds"] == 27
+        pdb = next(o for o in objs
+                   if o["kind"] == "PodDisruptionBudget")
+        assert pdb["apiVersion"] == "policy/v1"
+        assert pdb["spec"]["minAvailable"] == 2
+        assert dep["spec"]["replicas"] == 3
+
+    def test_single_replica_gets_no_pdb(self):
+        from kubeflow_tpu.manifests.serving import tpu_serving
+        objs = tpu_serving(num_replicas=1)
+        assert not [o for o in objs
+                    if o["kind"] == "PodDisruptionBudget"]
+
+    def test_example_component_is_a_three_replica_fleet(self):
+        from kubeflow_tpu.manifests.serving import tpu_serving_simple
+        objs = tpu_serving_simple()
+        dep = next(o for o in objs if o["kind"] == "Deployment")
+        assert dep["spec"]["replicas"] == 3
+        assert [o for o in objs
+                if o["kind"] == "PodDisruptionBudget"]
+
+
+# ------------------------------------------------------ chaos servable
+
+
+class TestChaosServable:
+    def test_fault_menu(self):
+        s = ChaosServable(predict_s=0.0)
+        s.fail_next(1, status=500)
+        with pytest.raises(RuntimeError) as err:
+            s.predict([[1.0]])
+        assert err.value.http_status == 500
+        assert s.predict([[1.0]]) == [[1.0]]   # budget spent
+        s.slow_start(1, 0.05)
+        t0 = time.monotonic()
+        s.predict([[1.0]])
+        assert time.monotonic() - t0 >= 0.05
+        t0 = time.monotonic()
+        s.predict([[1.0]])
+        assert time.monotonic() - t0 < 0.04    # back to fast
+
+    def test_wedge_blocks_until_unwedged(self):
+        s = ChaosServable(predict_s=0.0)
+        s.wedge()
+        done = threading.Event()
+
+        def call():
+            s.predict([[1.0]])
+            done.set()
+
+        threading.Thread(target=call, daemon=True).start()
+        assert not done.wait(0.1)
+        s.unwedge()
+        assert done.wait(2.0)
+
+    def test_pause_window_stalls_predicts(self):
+        s = ChaosServable(predict_s=0.0, pause_every_s=10.0,
+                          pause_s=0.05)
+        # phase chosen so "now" lands inside the pause window
+        s.pause_phase_s = -(time.monotonic() % 10.0) + 0.001
+        t0 = time.monotonic()
+        s.predict([[1.0]])
+        assert time.monotonic() - t0 >= 0.02

@@ -35,6 +35,11 @@ from kubeflow_tpu.utils.chips import (BASELINE_IMG_S,  # noqa: E402
                                       RESNET50_TRAIN_GFLOP_PER_IMAGE
                                       as TRAIN_GFLOP_PER_IMAGE,
                                       detect_peak_tflops)
+# the HLO collective vocabulary lives in ONE module (ISSUE 13,
+# lint-pinned): the comm analyzer and this bench count the same op
+# literals by construction. Re-exported because the dryrun and the
+# weight-update tests historically import it from here.
+from kubeflow_tpu.obs.collectives import collective_counts  # noqa: E402,F401
 
 
 def measure_achievable_tflops() -> float:
@@ -590,37 +595,6 @@ def estimate_weight_update_hbm(param_elems: int, state_elems: int,
         "sharded_bytes_per_chip": -(-full // n_rep),
     }
 
-
-def collective_counts(hlo_text: str) -> dict:
-    """Count the weight-update collectives in compiled HLO: reduce-scatter,
-    all-gather, and NON-scalar all-reduce ops (a scalar f32[] all-reduce is
-    the loss/grad-norm mean, not a full-gradient reduction). Async forms
-    count via their ``-start`` op (XLA:TPU converts collectives to
-    start/done pairs; only the start names the operands — counting it
-    alone avoids double-counting, and the sync form still matches bare).
-    The acceptance signal for the sharded path is reduce_scatter > 0,
-    all_gather > 0, all_reduce_nonscalar == 0."""
-    import re
-    ops = {"reduce_scatter": 0, "all_gather": 0, "all_reduce_nonscalar": 0}
-    for line in hlo_text.splitlines():
-        # op lines look like "%name = f32[128,8]{1,0} reduce-scatter(..."
-        # (the result shape may be a tuple for combined collectives, so
-        # match lazily up to the opcode and inspect every shape bracket)
-        m = re.match(r"\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.*?)\s*"
-                     r"(reduce-scatter|all-gather|all-reduce)(?:-start)?\(",
-                     line)
-        if not m:
-            continue
-        shape, op = m.groups()
-        if op == "reduce-scatter":
-            ops["reduce_scatter"] += 1
-        elif op == "all-gather":
-            ops["all_gather"] += 1
-        elif any(re.findall(r"\[[0-9]", shape)):   # any non-scalar operand
-            ops["all_reduce_nonscalar"] += 1
-    return ops
-
-
 def bench_weight_update(t_start: float | None = None) -> dict:
     """A/B the cross-replica sharded weight update (ZeRO-2, Xu et al.)
     against the replicated update on the headline ResNet-50 regime:
@@ -713,6 +687,195 @@ def bench_weight_update(t_start: float | None = None) -> dict:
                 "loss_delta": round(loss_delta, 8),
                 "optimizer_hbm_bytes_per_chip": hbm,
             },
+        },
+        "_flops_per_chip": 0.0,
+    }
+
+
+def bench_comm(t_start: float | None = None) -> dict:
+    """Communication observability (ISSUE 13): the DCN bytes/step
+    yardstick on the 2-slice DCN CPU mesh (the test_distributed.py dcn
+    topology — two v5e-4 slices, data axis across the modeled DCN
+    boundary), across the weight-update modes, plus the full-reshard
+    detector's positive/negative drill.
+
+    Arms (each compiled AOT, the HLO analyzed by obs/collectives.py):
+
+    - ``replicated`` / ``zero2-explicit`` / ``zero2-gspmd``: the pure-DP
+      transformer on the 2-slice contract mesh, weight-update mode
+      flipped (KFTPU_BENCH_COMM_MODES trims the list for smoke runs).
+      Asserted: the detector passes (no involuntary reshard), DCN
+      traffic is present, and the zero2 arms' modeled optimizer-update
+      DCN bytes are STRICTLY below the replicated arm's. (Total wire
+      bytes are conserved — RS+AG ≡ AR — so the totals columns are
+      recorded beside the update metric; docs/operations.md.)
+    - ``known-bad``: the dryrun's 4th config (data=2 x fsdp=2 x
+      tensor=2, rules-sharded params) whose SPMD compile logs the
+      "involuntary full rematerialization" warning (MULTICHIP_r05).
+      Asserted: the detector FLAGS it — the red flag is now data.
+    - ``single-slice``: the same pure-DP model on a 1-slice mesh.
+      Asserted: zero DCN bytes, detector clean.
+
+    The per-arm table (DCN/ICI bytes per step, collectives per link,
+    modeled update bytes) is the baseline the MPMD-pipeline PR and the
+    kill-the-involuntary-remat fix will be judged against (PERF.md
+    "Communication observability")."""
+    import os
+    import subprocess
+
+    t_start = time.perf_counter() if t_start is None else t_start
+    import jax
+
+    if jax.devices()[0].platform == "cpu" and len(jax.devices()) < 8 \
+            and not os.environ.get("KFTPU_BENCH_COMM_CHILD"):
+        # the 2-slice mesh needs 8 virtual devices; the flag must be set
+        # before jax initializes → re-exec (the bench_input pattern)
+        env = {**os.environ, "KFTPU_BENCH_COMM_CHILD": "1",
+               "JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") +
+                             " --xla_force_host_platform_device_count=8")}
+        res = subprocess.run([sys.executable, __file__, "--mode", "comm"],
+                             env=env, capture_output=True, text=True,
+                             timeout=900)
+        for line in reversed(res.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                row = json.loads(line)
+                row["_flops_per_chip"] = 0.0
+                return row
+        raise RuntimeError("comm bench child emitted no JSON row "
+                           f"(rc={res.returncode}): {res.stderr[-2000:]}")
+
+    import optax
+
+    from kubeflow_tpu.api.topology import TopologyContract, parse_topology
+    from kubeflow_tpu.api.trainingjob import ShardingSpec
+    from kubeflow_tpu.models import transformer as T
+    from kubeflow_tpu.obs.collectives import (analyze_hlo,
+                                              detect_full_reshard,
+                                              modeled_update_dcn_bytes,
+                                              slice_assignment)
+    from kubeflow_tpu.parallel.mesh import build_mesh, mesh_from_contract
+    from kubeflow_tpu.runtime.trainstep import TrainStepBuilder
+
+    dev = jax.devices()[0]
+    n_dev = len(jax.devices())
+    chips_per_slice = n_dev // 2
+    contract = TopologyContract(
+        coordinator_address="bench:8476", num_processes=2, process_id=0,
+        slice_topology=parse_topology(f"v5e-{chips_per_slice}"),
+        num_slices=2, slice_id=0)
+    cfg = T.TransformerConfig(
+        vocab_size=256, num_layers=2, embed_dim=64, num_heads=4,
+        head_dim=16, mlp_dim=128, max_seq_len=64)
+    spec = T.workload_spec(cfg=cfg, seq_len=64)
+
+    def compile_arm(mesh, weight_update="replicated", rules=False):
+        builder = TrainStepBuilder(
+            mesh=mesh, loss_fn=spec.loss_fn,
+            optimizer=optax.chain(optax.clip_by_global_norm(1.0),
+                                  optax.adamw(1e-3)),
+            rules=spec.rules if rules else None,
+            param_logical_axes=spec.param_logical_axes if rules else None,
+            weight_update=weight_update)
+        state = builder.init(spec.init_fn, jax.random.PRNGKey(0))
+        batch = builder.place_batch(
+            spec.batch_fn(jax.random.PRNGKey(1), 2 * n_dev))
+        return builder.build().lower(state, batch).compile().as_text()
+
+    def profile_arm(hlo, mesh, num_slices):
+        prof = analyze_hlo(
+            hlo, slice_assignment(mesh, num_slices),
+            mesh_axes=[(a, int(mesh.shape[a])) for a in mesh.axis_names])
+        verdict = detect_full_reshard(prof)
+        update = modeled_update_dcn_bytes(prof, hlo)
+        return prof, {
+            "dcn_bytes_per_step": round(prof.dcn_bytes_per_step),
+            "ici_bytes_per_step": round(prof.ici_bytes_per_step),
+            "dcn_collectives": prof.collectives("dcn"),
+            "ici_collectives": prof.collectives("ici"),
+            "modeled_dcn_ms": round(prof.modeled_dcn_seconds * 1e3, 3),
+            "update_style": update["style"],
+            "update_dcn_bytes": round(update["bytes"]),
+            "dcn_full_reshard": verdict.flagged,
+        }
+
+    mesh_dp = mesh_from_contract(contract, ShardingSpec(data=n_dev))
+    arms: dict = {}
+    wanted = [m.strip() for m in os.environ.get(
+        "KFTPU_BENCH_COMM_MODES",
+        "replicated,zero2-explicit,zero2-gspmd").split(",") if m.strip()]
+    arm_builders = {
+        "replicated": lambda: compile_arm(mesh_dp, "replicated"),
+        "zero2-explicit": lambda: compile_arm(mesh_dp, "sharded"),
+        # trivial rules on the pure-DP mesh force the GSPMD strategy
+        # while params stay effectively replicated — same comparison
+        # basis as the explicit arm
+        "zero2-gspmd": lambda: compile_arm(mesh_dp, "sharded",
+                                           rules=True),
+    }
+    for mode in wanted:
+        hlo = arm_builders[mode]()
+        _, arms[mode] = profile_arm(hlo, mesh_dp, num_slices=2)
+        assert not arms[mode]["dcn_full_reshard"], \
+            f"detector false-positive on clean arm {mode}: {arms[mode]}"
+        assert arms[mode]["dcn_bytes_per_step"] > 0, \
+            f"2-slice arm {mode} shows no DCN traffic: {arms[mode]}"
+
+    # the zero2 arms must model STRICTLY fewer optimizer-update DCN
+    # bytes than replicated (the broadcast redundancy the sharded
+    # update removes; totals are conserved and recorded beside it)
+    if "replicated" in arms:
+        for mode in wanted:
+            if mode == "replicated":
+                continue
+            assert arms[mode]["update_dcn_bytes"] < \
+                arms["replicated"]["update_dcn_bytes"], \
+                f"{mode} update bytes not below replicated: {arms}"
+
+    # the known-bad config (MULTICHIP_r05: involuntary full remat) —
+    # the detector must flag it
+    mesh_bad = mesh_from_contract(
+        contract, ShardingSpec(data=2, fsdp=chips_per_slice // 2,
+                               tensor=2))
+    hlo_bad = compile_arm(mesh_bad, "replicated", rules=True)
+    _, bad = profile_arm(hlo_bad, mesh_bad, num_slices=2)
+    arms["known-bad"] = bad
+    assert bad["dcn_full_reshard"], \
+        f"detector missed the known-bad DCN config: {bad}"
+
+    # single-slice control: everything is ICI, detector clean
+    mesh_one = build_mesh(ShardingSpec(data=n_dev))
+    hlo_one = compile_arm(mesh_one, "replicated")
+    _, one = profile_arm(hlo_one, mesh_one, num_slices=1)
+    arms["single-slice"] = one
+    assert one["dcn_bytes_per_step"] == 0 and \
+        not one["dcn_full_reshard"], \
+        f"single-slice arm shows DCN traffic or a flag: {one}"
+
+    # headline = the replicated 2-slice arm, or (when the modes knob
+    # trimmed it) the first 2-slice arm that DID run — the unit string
+    # names whichever arm the number came from, so a trimmed smoke run
+    # can never record the single-slice zero under a replicated label
+    headline_arm = "replicated" if "replicated" in arms else \
+        (wanted[0] if wanted else "known-bad")
+    return {
+        "metric": "comm_dcn_bytes_per_step",
+        "value": arms[headline_arm]["dcn_bytes_per_step"],
+        "unit": f"modeled_dcn_bytes_per_step_{headline_arm}_2slice",
+        "vs_baseline": None,
+        "mfu": None,
+        "extras": {
+            "device_kind": getattr(dev, "device_kind", dev.platform),
+            "devices": n_dev,
+            "slices": 2,
+            "comm": arms,
+            "detector": {
+                "flags_known_bad": bad["dcn_full_reshard"],
+                "clean_arms_pass": True,
+            },
+            "startup_first_step_s": round(
+                time.perf_counter() - t_start, 2),
         },
         "_flops_per_chip": 0.0,
     }
@@ -2480,8 +2643,8 @@ def main(argv=None) -> int:
                             "lm-long", "serving", "serving-obs",
                             "serving-fleet", "fused-blocks",
                             "weight-update", "chaos", "input", "sched",
-                            "health", "obs", "goodput", "warmstart",
-                            "warmstart-child"])
+                            "health", "obs", "goodput", "comm",
+                            "warmstart", "warmstart-child"])
     p.add_argument("--routing-out",
                    default="bench-matrix/fused_routing_measured.json",
                    help="where --mode fused-blocks writes the measured "
@@ -2553,6 +2716,8 @@ def main(argv=None) -> int:
         row = bench_obs(t_start=t_start)
     elif args.mode == "goodput":
         row = bench_goodput(t_start=t_start)
+    elif args.mode == "comm":
+        row = bench_comm(t_start=t_start)
     elif args.mode == "warmstart-child":
         row = bench_warmstart_child()
     else:

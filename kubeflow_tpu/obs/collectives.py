@@ -1,0 +1,736 @@
+"""HLO communication analyzer: per-collective ICI/DCN accounting.
+
+The multi-slice roadmap item ("Multi-slice DCN training") is judged on
+signals nobody could measure until now — DCN bytes/step, per-link
+collective traffic, and the "involuntary full rematerialization" red
+flag the DCN dryrun still logs (MULTICHIP_r05). This module makes
+cross-slice communication a first-class measured quantity: it walks a
+compiled train step's HLO text (``compiled.as_text()`` — the PR 9
+``build_compiled`` object, so it works identically on cold, cache-warm,
+and AOT-loaded executables), extracts every collective op, computes
+modeled bytes from result shapes x dtype, and classifies each op ICI vs
+DCN by intersecting its replica groups with the mesh's slice membership
+(DCN-major mesh order, parallel/mesh.py).
+
+This is also the single home of the HLO collective-op vocabulary:
+``collective_counts`` (formerly bench-local) lives here, and
+tests/test_lint.py pins the op literals to this one module so the bench
+and the analyzer can never drift.
+
+Modeling conventions (docs/operations.md "Communication observability"):
+
+- **Participant ids are device-assignment positions.** With
+  ``use_global_device_ids=true`` a replica-group entry ``p`` names the
+  p-th device of the executable's device assignment — for a jit over a
+  Mesh that is ``mesh.devices.flatten()`` order, NOT the raw jax device
+  id. ``slice_assignment`` maps those positions to slice ids.
+- **Wire bytes are per-participant ring loads.** For a group of n over
+  payload P: all-reduce moves ``2*P*(n-1)/n`` (reduce + broadcast
+  halves), all-gather / all-to-all ``P*(n-1)/n`` (P = the full gathered
+  result), reduce-scatter ``P*(n-1)/n`` with P = the full pre-scatter
+  input (result x n). A collective-permute moves its payload once per
+  pair; we report the crossing fraction.
+- **The ICI/DCN split is hierarchical.** A group spanning k slices of
+  n_local participants each is modeled as an intra-slice phase (ICI,
+  the same formula at n_local) plus an inter-slice phase (DCN, the same
+  formula at k) — the decomposition a multislice backend actually runs.
+- **Conservation, stated up front:** reduce-scatter + all-gather moves
+  exactly what one all-reduce moves (that is how rings implement
+  all-reduce), so a ZeRO-2 arm's TOTAL wire bytes equal the replicated
+  arm's. ``modeled_update_dcn_bytes`` therefore isolates the phase the
+  sharded update owns: the replicated update needs the reduced gradient
+  broadcast to EVERY replica (factor 2), the sharded update only
+  re-gathers final params (factor 1) — the broadcast redundancy Xu et
+  al.'s rewrite removes. The totals table is always reported beside it.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+# ---------------------------------------------------------------------------
+# The one HLO collective vocabulary (lint-pinned: these literals appear in
+# THIS module only — bench.py and every other consumer imports them).
+
+COLLECTIVE_OPS = (
+    "all-reduce",
+    "reduce-scatter",
+    "all-gather",
+    "all-to-all",
+    "collective-permute",
+)
+
+# XLA:TPU converts collectives to start/done pairs; only the -start op
+# names the operands and groups, so the parser counts it alone (the sync
+# form still matches bare, and "-done" lines never match).
+ASYNC_START_FORMS = (
+    "all-reduce-start",
+    "reduce-scatter-start",
+    "all-gather-start",
+    "all-to-all-start",
+    "collective-permute-start",
+)
+
+# link classes
+LINK_ICI = "ici"      # every participant pair inside one slice
+LINK_DCN = "dcn"      # at least one group/pair crosses a slice boundary
+LINK_LOCAL = "local"  # degenerate single-participant groups: no traffic
+
+# bandwidth-model knobs (GB/s). Order-of-magnitude models for the
+# modeled-seconds column, not measurements: v5e ICI is O(100 GB/s) per
+# chip, a DCN NIC share is O(50 Gbit/s) = 6.25 GB/s per host.
+ICI_GBPS_ENV = "KFTPU_COMM_ICI_GBPS"
+DCN_GBPS_ENV = "KFTPU_COMM_DCN_GBPS"
+DEFAULT_ICI_GBPS = 90.0
+DEFAULT_DCN_GBPS = 6.25
+
+# worker wiring: profile mode (env) and the span the profile lands under
+COMM_PROFILE_ENV = "KFTPU_COMM_PROFILE"   # "auto" (default) | "1" | "0"
+COMM_PROFILE_SPAN = "comm-profile"
+# ops carried verbatim on the span (largest first); the full table is
+# available from bench --mode comm / the dryrun
+COMM_TOP_OPS_ENV = "KFTPU_COMM_TOP_OPS"
+
+# Ops whose source metadata lands in these files belong to the
+# weight-update region (the optimizer update + param re-gather the
+# TrainStepBuilder emits); everything else is model forward/backward.
+# The detector treats an op with NO metadata as model-region —
+# conservative: an unattributed DCN reshard should flag, not hide.
+UPDATE_REGION_FILES = ("trainstep.py",)
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "f8e4m3fn": 1, "f8e4m3": 1, "f8e5m2": 1,
+    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "s4": 1, "u4": 1, "pred": 1, "c64": 8, "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"(\w+)\[([0-9,]*)\]")
+_OP_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?(%?[\w.\-]+)\s*=\s*(.*?)\s*"
+    r"(" + "|".join(COLLECTIVE_OPS) + r")(-start)?\(([^)]*)\)")
+_GROUPS_LIT_RE = re.compile(
+    r"replica_groups=\{(\{[0-9,]*\}(?:,\{[0-9,]*\})*)\}")
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\]"
+    r"(?:T\(([0-9,]+)\))?")
+_PAIRS_RE = re.compile(
+    r"source_target_pairs=\{(\{[0-9,]+\}(?:,\{[0-9,]+\})*)\}")
+# matched independently: one lazy regex with optional groups can skip a
+# present source_file entirely (zero-width optional match)
+_META_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+_META_SRC_RE = re.compile(r'source_file="([^"]*)"')
+_META_LINE_RE = re.compile(r"source_line=(\d+)")
+
+
+@dataclass
+class CollectiveOp:
+    """One collective instruction from optimized HLO, with its modeled
+    per-step link traffic."""
+
+    name: str                 # HLO instruction name (%all-gather.7)
+    kind: str                 # one of COLLECTIVE_OPS
+    is_async_start: bool
+    # (dtype, dims) of every result-shape bracket on the op line
+    result_shapes: list = field(default_factory=list)
+    payload_bytes: int = 0    # modeled logical payload (see payload rules)
+    groups: Optional[list] = None           # expanded replica groups
+    pairs: Optional[list] = None            # collective-permute pairs
+    operands: list = field(default_factory=list)  # operand names
+    op_name: str = ""         # metadata op_name (jvp(...)/transpose(...))
+    source_file: str = ""     # metadata source_file basename
+    source_line: int = 0
+    # filled by classification
+    link: str = LINK_LOCAL
+    slices_spanned: int = 1
+    group_size: int = 1
+    dcn_bytes: float = 0.0
+    ici_bytes: float = 0.0
+    axes: tuple = ()          # mesh axes the group varies over (if known)
+
+    @property
+    def in_update_region(self) -> bool:
+        return os.path.basename(self.source_file) in UPDATE_REGION_FILES
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "kind": self.kind, "link": self.link,
+            "payloadBytes": int(self.payload_bytes),
+            "dcnBytes": round(self.dcn_bytes, 1),
+            "iciBytes": round(self.ici_bytes, 1),
+            "groupSize": self.group_size,
+            "slicesSpanned": self.slices_spanned,
+            "axes": list(self.axes),
+            "opName": self.op_name, "sourceFile": self.source_file,
+            "sourceLine": self.source_line,
+            "updateRegion": self.in_update_region,
+        }
+
+
+def _parse_shapes(shape_str: str) -> list:
+    return [(dt, tuple(int(d) for d in dims.split(",") if d))
+            for dt, dims in _SHAPE_RE.findall(shape_str)]
+
+
+def _shape_bytes(dt: str, dims: tuple) -> int:
+    n = 1
+    for d in dims:
+        n *= d
+    return n * _DTYPE_BYTES.get(dt, 4)
+
+
+def _payload_bytes(kind: str, is_start: bool, shapes: list) -> int:
+    """Modeled logical payload from the op's result shapes.
+
+    Sync forms: the sum of all result shapes (a tuple result is a
+    combined collective — each element is real payload). Async -start
+    forms of all-gather / all-to-all / collective-permute return the
+    tuple (operands..., results...); count only the result half so the
+    operand copy is not double-charged. all-reduce-start results are
+    already result-shaped (no operand echo)."""
+    if (is_start and kind in ("all-gather", "all-to-all",
+                              "collective-permute")
+            and len(shapes) >= 2 and len(shapes) % 2 == 0):
+        shapes = shapes[len(shapes) // 2:]
+    return sum(_shape_bytes(dt, dims) for dt, dims in shapes)
+
+
+def _expand_groups(line: str) -> Optional[list]:
+    """replica_groups in either HLO syntax, expanded to explicit id
+    lists: literal ``{{0,4},{1,5}}`` or iota ``[G,S]<=[dims]T(perm)``
+    (iota of prod(dims), reshaped to dims, transposed by perm, flattened
+    row-major, split into G groups of S)."""
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        num_groups, group_size = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        total = 1
+        for d in dims:
+            total *= d
+        flat = list(range(total))
+        if m.group(4):
+            perm = [int(x) for x in m.group(4).split(",")]
+            # index math instead of numpy: this module must stay
+            # importable jax/numpy-free (dashboard, lint, operator)
+            strides = [0] * len(dims)
+            acc = 1
+            for i in range(len(dims) - 1, -1, -1):
+                strides[i] = acc
+                acc *= dims[i]
+            new_dims = [dims[p] for p in perm]
+            out = []
+            idx = [0] * len(new_dims)
+            for _ in range(total):
+                src = sum(idx[i] * strides[perm[i]]
+                          for i in range(len(perm)))
+                out.append(src)
+                for i in range(len(new_dims) - 1, -1, -1):
+                    idx[i] += 1
+                    if idx[i] < new_dims[i]:
+                        break
+                    idx[i] = 0
+            flat = out
+        return [flat[i * group_size:(i + 1) * group_size]
+                for i in range(num_groups)]
+    m = _GROUPS_LIT_RE.search(line)
+    if m:
+        return [[int(x) for x in g.split(",")] if g else []
+                for g in re.findall(r"\{([0-9,]*)\}", m.group(1))]
+    return None
+
+
+def parse_hlo_collectives(hlo_text: str) -> list[CollectiveOp]:
+    """Every collective instruction in the module, unclassified (no
+    slice map yet). ``-done`` lines never match (the ``(`` must follow
+    the opcode or its ``-start`` suffix directly), so async pairs are
+    counted exactly once."""
+    ops = []
+    for line in hlo_text.splitlines():
+        m = _OP_RE.match(line)
+        if not m:
+            continue
+        name, shape_str, kind, start_sfx, operand_str = m.groups()
+        shapes = _parse_shapes(shape_str)
+        pairs = None
+        if kind == "collective-permute":
+            pm = _PAIRS_RE.search(line)
+            if pm:
+                pairs = [tuple(int(x) for x in p.split(","))
+                         for p in re.findall(r"\{([0-9,]+)\}",
+                                             pm.group(1))]
+        mo = _META_OPNAME_RE.search(line)
+        ms = _META_SRC_RE.search(line)
+        ml = _META_LINE_RE.search(line)
+        ops.append(CollectiveOp(
+            name=name.lstrip("%"),
+            kind=kind,
+            is_async_start=bool(start_sfx),
+            result_shapes=shapes,
+            payload_bytes=_payload_bytes(kind, bool(start_sfx), shapes),
+            groups=_expand_groups(line),
+            pairs=pairs,
+            operands=[o.strip().split(" ")[-1].lstrip("%")
+                      for o in operand_str.split(",") if o.strip()],
+            op_name=mo.group(1) if mo else "",
+            source_file=ms.group(1) if ms else "",
+            source_line=int(ml.group(1)) if ml else 0,
+        ))
+    return ops
+
+
+def collective_counts(hlo_text: str) -> dict:
+    """Count the weight-update collectives in compiled HLO:
+    reduce-scatter, all-gather, and NON-scalar all-reduce ops (a scalar
+    f32[] all-reduce is the loss/grad-norm mean, not a full-gradient
+    reduction). Async forms count via their ``-start`` op. The
+    acceptance signal for the sharded path is reduce_scatter > 0,
+    all_gather > 0, all_reduce_nonscalar == 0 (PR 1; promoted here from
+    bench.py so bench and analyzer share ONE vocabulary)."""
+    counts = {"reduce_scatter": 0, "all_gather": 0,
+              "all_reduce_nonscalar": 0}
+    for op in parse_hlo_collectives(hlo_text):
+        if op.kind == "reduce-scatter":
+            counts["reduce_scatter"] += 1
+        elif op.kind == "all-gather":
+            counts["all_gather"] += 1
+        elif op.kind == "all-reduce" and \
+                any(dims for _, dims in op.result_shapes):
+            counts["all_reduce_nonscalar"] += 1
+    return counts
+
+
+# ---------------------------------------------------------------------------
+# classification
+
+
+def slice_assignment(mesh, num_slices: int) -> list[int]:
+    """Participant id → slice id for the given mesh.
+
+    Participant ids are positions in ``mesh.devices.flatten()`` (the jit
+    device assignment). Real TPU devices carry ``slice_index``; virtual
+    CPU devices fall back to ``id // chips_per_slice`` — valid because
+    the DCN-major mesh order keeps the enumeration slice-contiguous
+    (the dryrun asserts row 0 == slice 0's devices)."""
+    devs = [d for d in mesh.devices.flat]
+    per_slice = max(1, len(devs) // max(1, num_slices))
+    out = []
+    for d in devs:
+        si = getattr(d, "slice_index", None)
+        out.append(int(si) if si is not None else d.id // per_slice)
+    return out
+
+
+def _axes_of_group(group: list, mesh_axes) -> tuple:
+    """Mesh axes the group's members vary over (mesh_axes = ordered
+    (name, size) pairs; participant id = row-major position)."""
+    if not mesh_axes or len(group) < 2:
+        return ()
+    names = [a for a, _ in mesh_axes]
+    sizes = [s for _, s in mesh_axes]
+    coords = []
+    for p in group:
+        c, rem = [], p
+        for s in reversed(sizes):
+            c.append(rem % s)
+            rem //= s
+        coords.append(list(reversed(c)))
+    varying = []
+    for i, name in enumerate(names):
+        if len({c[i] for c in coords}) > 1:
+            varying.append(name)
+    return tuple(varying)
+
+
+def _ring_factor(kind: str) -> float:
+    return 2.0 if kind == "all-reduce" else 1.0
+
+
+def _classify_op(op: CollectiveOp, slice_of: Sequence[int],
+                 mesh_axes=None) -> None:
+    n_total = len(slice_of)
+    if op.kind == "collective-permute" and op.pairs is not None:
+        # same out-of-range defense as the replica-group path: a pair
+        # id beyond the slice map (wrong mesh passed) is skipped, not
+        # an IndexError
+        valid = [(s, t) for s, t in op.pairs
+                 if 0 <= s < n_total and 0 <= t < n_total]
+        real = [(s, t) for s, t in valid if s != t]
+        crossing = [(s, t) for s, t in real
+                    if slice_of[s] != slice_of[t]]
+        op.group_size = len(op.pairs)
+        op.slices_spanned = len({slice_of[s] for s, _ in valid}
+                                | {slice_of[t] for _, t in valid}) \
+            if valid else 1
+        if not real:
+            op.link = LINK_LOCAL
+            return
+        frac_dcn = len(crossing) / len(real)
+        op.link = LINK_DCN if crossing else LINK_ICI
+        op.dcn_bytes = op.payload_bytes * frac_dcn
+        op.ici_bytes = op.payload_bytes * (1.0 - frac_dcn)
+        return
+    groups = op.groups
+    if not groups or not any(groups):
+        # empty replica_groups = one group of every participant
+        groups = [list(range(n_total))]
+    g0 = max(groups, key=len)
+    n = len(g0)
+    op.group_size = n
+    if mesh_axes:
+        op.axes = _axes_of_group(g0, mesh_axes)
+    if n <= 1:
+        op.link = LINK_LOCAL
+        op.slices_spanned = 1
+        return
+    k = len({slice_of[p] for p in g0 if 0 <= p < n_total}) or 1
+    op.slices_spanned = k
+    n_local = max(1, n // k)
+    f = _ring_factor(op.kind)
+    # full logical payload: reduce-scatter's line shows the scattered
+    # RESULT, so the pre-scatter input is result x group size
+    full = op.payload_bytes * (n if op.kind == "reduce-scatter" else 1)
+    op.link = LINK_DCN if k > 1 else LINK_ICI
+    if k > 1:
+        op.dcn_bytes = f * full * (k - 1) / k
+    if n_local > 1:
+        op.ici_bytes = f * full * (n_local - 1) / n_local
+
+
+@dataclass
+class CommProfile:
+    """Per-step communication profile of one compiled train step."""
+
+    ops: list                   # list[CollectiveOp], classified
+    num_slices: int
+    ici_gbps: float
+    dcn_gbps: float
+
+    @property
+    def dcn_bytes_per_step(self) -> float:
+        return sum(o.dcn_bytes for o in self.ops)
+
+    @property
+    def ici_bytes_per_step(self) -> float:
+        return sum(o.ici_bytes for o in self.ops)
+
+    def collectives(self, link: str) -> int:
+        return sum(1 for o in self.ops if o.link == link)
+
+    def by_link_op(self) -> dict:
+        """{(link, kind): {"count", "bytes"}} — the gauge label space.
+
+        Counts bucket each op under ITS link class; bytes bucket each
+        op's ICI-phase bytes under (ici, kind) and DCN-phase bytes
+        under (dcn, kind) — a DCN-crossing collective has BOTH phases,
+        so this is what makes the per-link gauge sums reconcile with
+        ``ici_bytes_per_step`` / ``dcn_bytes_per_step`` (a DCN row may
+        therefore carry a zero-count ici sibling row)."""
+        out: dict = {}
+
+        def row(link, kind):
+            return out.setdefault((link, kind),
+                                  {"count": 0, "bytes": 0.0})
+
+        for o in self.ops:
+            row(o.link, o.kind)["count"] += 1
+            if o.dcn_bytes:
+                row(LINK_DCN, o.kind)["bytes"] += o.dcn_bytes
+            if o.ici_bytes:
+                row(LINK_ICI, o.kind)["bytes"] += o.ici_bytes
+        return out
+
+    @property
+    def modeled_ici_seconds(self) -> float:
+        return self.ici_bytes_per_step / (self.ici_gbps * 1e9)
+
+    @property
+    def modeled_dcn_seconds(self) -> float:
+        return self.dcn_bytes_per_step / (self.dcn_gbps * 1e9)
+
+    def to_dict(self, top_ops: Optional[int] = None) -> dict:
+        if top_ops is None:
+            try:
+                top_ops = int(os.environ.get(COMM_TOP_OPS_ENV, "16"))
+            except ValueError:
+                top_ops = 16
+        verdict = detect_full_reshard(self)
+        ranked = sorted(self.ops,
+                        key=lambda o: o.dcn_bytes + o.ici_bytes,
+                        reverse=True)
+        return {
+            "numSlices": self.num_slices,
+            "dcnBytesPerStep": round(self.dcn_bytes_per_step, 1),
+            "iciBytesPerStep": round(self.ici_bytes_per_step, 1),
+            "collectivesPerStep": {
+                link: self.collectives(link)
+                for link in (LINK_DCN, LINK_ICI, LINK_LOCAL)},
+            "byLinkOp": {f"{link}/{kind}": {
+                "count": row["count"], "bytes": round(row["bytes"], 1)}
+                for (link, kind), row in sorted(self.by_link_op().items())},
+            "modeledSeconds": {
+                "ici": self.modeled_ici_seconds,
+                "dcn": self.modeled_dcn_seconds,
+                "total": self.modeled_ici_seconds +
+                self.modeled_dcn_seconds,
+            },
+            "bandwidthGBps": {"ici": self.ici_gbps, "dcn": self.dcn_gbps},
+            "dcnFullReshard": verdict.to_dict(),
+            "topOps": [o.to_dict() for o in ranked[:max(0, top_ops)]],
+            "totalOps": len(self.ops),
+        }
+
+
+def _bw(env: str, default: float) -> float:
+    raw = os.environ.get(env)
+    if not raw:
+        return default
+    try:
+        v = float(raw)
+        if v > 0 and math.isfinite(v):
+            return v
+    except ValueError:
+        pass
+    # loud, but never fatal: the profile runs inside the worker's
+    # first step, where a typo'd knob must cost the operator a warning
+    # and a default-bandwidth model, not the training job
+    import logging
+    logging.getLogger(__name__).warning(
+        "%s=%r is not a positive number; modeling at the default "
+        "%g GB/s", env, raw, default)
+    return default
+
+
+def analyze_hlo(hlo_text: str, slice_of: Sequence[int],
+                mesh_axes=None,
+                ici_gbps: Optional[float] = None,
+                dcn_gbps: Optional[float] = None) -> CommProfile:
+    """Parse + classify one compiled module's collectives.
+
+    ``slice_of`` maps participant id → slice id (``slice_assignment``);
+    ``mesh_axes`` (optional ordered (name, size) pairs) labels each
+    group with the mesh axes it spans."""
+    ops = parse_hlo_collectives(hlo_text)
+    for op in ops:
+        _classify_op(op, slice_of, mesh_axes)
+    return CommProfile(
+        ops=ops,
+        num_slices=len(set(slice_of)) or 1,
+        ici_gbps=ici_gbps if ici_gbps else _bw(ICI_GBPS_ENV,
+                                               DEFAULT_ICI_GBPS),
+        dcn_gbps=dcn_gbps if dcn_gbps else _bw(DCN_GBPS_ENV,
+                                               DEFAULT_DCN_GBPS))
+
+
+def profile_step(compiled, mesh, num_slices: int,
+                 ici_gbps: Optional[float] = None,
+                 dcn_gbps: Optional[float] = None) -> CommProfile:
+    """Convenience wrapper: profile a ``jax.stages.Compiled`` train step
+    against its mesh + slice count (the worker / bench / dryrun entry)."""
+    hlo = compiled.as_text() if hasattr(compiled, "as_text") \
+        else str(compiled)
+    return analyze_hlo(
+        hlo, slice_assignment(mesh, num_slices),
+        mesh_axes=[(a, int(mesh.shape[a])) for a in mesh.axis_names],
+        ici_gbps=ici_gbps, dcn_gbps=dcn_gbps)
+
+
+# ---------------------------------------------------------------------------
+# worker metric export
+
+
+class CommSeries:
+    """Handle over the ``kftpu_comm_*`` series one profile exported, so
+    the worker can prune them at job teardown (the kftpu_job_phase
+    rule: a long-lived process must not export a finished job's comm
+    profile forever). The labeled per-(link, op) series are removed
+    outright; the unlabeled detector flag resets to 0 (unlabeled
+    families render-zero by registry design)."""
+
+    def __init__(self, bytes_fam, coll_fam, flag_fam, label_sets):
+        self._bytes = bytes_fam
+        self._coll = coll_fam
+        self._flag = flag_fam
+        self._label_sets = label_sets
+
+    def prune(self) -> None:
+        for kv in self._label_sets:
+            self._bytes.remove(**kv)
+            self._coll.remove(**kv)
+        self._label_sets = []
+        self._flag.set(0)
+
+
+def export_comm_metrics(profile: CommProfile) -> CommSeries:
+    """Export one profile as worker gauges:
+    ``kftpu_comm_bytes_per_step{link,op}``,
+    ``kftpu_comm_collectives_per_step{link,op}``, and
+    ``kftpu_comm_dcn_full_reshard`` (0/1 — the structured verdict as a
+    scrapeable red flag)."""
+    from . import registry as obsreg
+    bytes_fam = obsreg.gauge(
+        "kftpu_comm_bytes_per_step",
+        "modeled per-step collective bytes from the compiled train "
+        "step's HLO, by link class and op kind (obs/collectives.py)",
+        labels=("link", "op"))
+    coll_fam = obsreg.gauge(
+        "kftpu_comm_collectives_per_step",
+        "collective ops per compiled train step, by link class and op "
+        "kind",
+        labels=("link", "op"))
+    flag_fam = obsreg.gauge(
+        "kftpu_comm_dcn_full_reshard",
+        "1 when the compiled step carries an involuntary full-reshard "
+        "across the DCN boundary (the MULTICHIP_r05 pathology)")
+    label_sets = []
+    for (link, kind), row in profile.by_link_op().items():
+        kv = {"link": link, "op": kind}
+        bytes_fam.labels(**kv).set(row["bytes"])
+        coll_fam.labels(**kv).set(row["count"])
+        label_sets.append(kv)
+    flag_fam.set(1 if detect_full_reshard(profile).flagged else 0)
+    return CommSeries(bytes_fam, coll_fam, flag_fam, label_sets)
+
+
+# ---------------------------------------------------------------------------
+# the full-reshard / involuntary-remat detector
+
+
+@dataclass
+class ReshardVerdict:
+    """Structured verdict replacing the SPMD partitioner's
+    "involuntary full rematerialization" log line nobody parses."""
+
+    flagged: bool
+    ops: list = field(default_factory=list)     # offending op dicts
+    reason: str = ""
+
+    def to_dict(self) -> dict:
+        return {"flagged": self.flagged, "reason": self.reason,
+                "ops": self.ops}
+
+
+def detect_full_reshard(profile: CommProfile) -> ReshardVerdict:
+    """Flag replicated-parameter reshards crossing the slice boundary —
+    the MULTICHIP_r05 pathology, as a structured verdict.
+
+    Rule (pinned against the live bad config by the dryrun and bench
+    --mode comm): a DCN-crossing **all-gather or collective-permute**
+    attributed OUTSIDE the weight-update region is a forward/backward
+    re-layout paying the slow link every step — exactly what SPMD's
+    "replicate the tensor and then partition it" last resort emits.
+    Legitimate DCN traffic never matches: gradient reductions are
+    all-reduce/reduce-scatter, and the ZeRO-2 param re-gather carries
+    update-region (trainstep.py) metadata. An op with no source
+    metadata counts as model-region — an unattributed DCN reshard
+    should flag, not hide."""
+    offenders = [
+        op for op in profile.ops
+        if op.link == LINK_DCN
+        and op.kind in ("all-gather", "collective-permute")
+        and not op.in_update_region
+    ]
+    if not offenders:
+        return ReshardVerdict(
+            flagged=False,
+            reason="no DCN-crossing reshard outside the weight-update "
+                   "region")
+    total = sum(op.dcn_bytes for op in offenders)
+    return ReshardVerdict(
+        flagged=True,
+        ops=[op.to_dict() for op in offenders],
+        reason=f"{len(offenders)} DCN-crossing reshard collective(s) in "
+               f"the model forward/backward ({total:.0f} modeled DCN "
+               f"bytes/step) — the SPMD involuntary-full-"
+               f"rematerialization pathology")
+
+
+# ---------------------------------------------------------------------------
+# the optimizer-update yardstick (the ZeRO-2 decomposition)
+
+
+def _merge_split_gathers(ops: list[CollectiveOp], hlo_text: str) -> list:
+    """The CPU partitioner sometimes emits ONE logical param re-gather
+    as TWO all-gathers combined by a single consumer
+    (``add(all-gather(a), all-gather(b))`` — observed on the zero2
+    arms). Payload-dedup by shape would wrongly collapse genuinely
+    distinct same-shape leaves (8 LN scales), so the merge keys on the
+    CONSUMER: gathers with identical payload + groups referenced
+    together by one instruction count once."""
+    by_name = {op.name: op for op in ops}
+    if not by_name:
+        return ops
+    merged: set = set()
+    name_re = re.compile(r"%([\w.\-]+)")
+    for line in hlo_text.splitlines():
+        if "=" not in line or "%" not in line:
+            continue
+        m = re.match(r"\s*(?:ROOT\s+)?%?[\w.\-]+\s*=", line)
+        if not m:
+            continue
+        if _OP_RE.match(line):
+            continue   # a collective consuming a collective: not a merge
+        rhs = line.split("=", 1)[1]
+        # an already-merged gather cannot anchor (or join) a further
+        # merge — chaining through it would collapse DISTINCT logical
+        # gathers that merely share a consumer with the merged one
+        hits = [n for n in name_re.findall(rhs)
+                if n in by_name and n not in merged]
+        if len(hits) < 2:
+            continue
+        base = by_name[hits[0]]
+        for other_name in hits[1:]:
+            other = by_name[other_name]
+            if (other.payload_bytes == base.payload_bytes
+                    and other.groups == base.groups
+                    and other.name not in merged
+                    and other.name != base.name):
+                merged.add(other.name)
+    return [op for op in ops if op.name not in merged]
+
+
+def modeled_update_dcn_bytes(profile: CommProfile,
+                             hlo_text: str = "") -> dict:
+    """Modeled optimizer-update DCN bytes/step — the yardstick the
+    weight-update A/B is judged on.
+
+    Total wire bytes are CONSERVED between the replicated and sharded
+    updates (reduce-scatter + all-gather ≡ all-reduce on the wire);
+    this metric isolates the update phase each scheme owns:
+
+    - replicated: the reduced gradient must land back on EVERY replica
+      because every replica runs the full update — the gradient
+      all-reduce at its full factor-2 ring cost, ``2*G*(k-1)/k``.
+    - sharded (ZeRO-2): the update phase owns only the final param
+      re-gather, ``G*(k-1)/k`` (the reduce-scatter is gradient
+      PRODUCTION — any DP scheme pays it).
+
+    G comes from the measured op inventory (param-shaped payloads,
+    split-gather pairs merged), so the number tracks the actual
+    compiled program, and the factor-2 redundancy is the modeled part.
+    """
+    sharded_ops = [op for op in profile.ops
+                   if op.kind == "reduce-scatter"
+                   or (op.kind == "all-gather" and op.in_update_region)]
+    if sharded_ops:
+        gathers = [op for op in profile.ops
+                   if op.kind == "all-gather" and op.in_update_region]
+        if hlo_text:
+            gathers = _merge_split_gathers(gathers, hlo_text)
+        bytes_ = sum(op.payload_bytes * (op.slices_spanned - 1)
+                     / op.slices_spanned
+                     for op in gathers if op.slices_spanned > 1)
+        param_bytes = sum(op.payload_bytes for op in gathers)
+        return {"style": "sharded", "bytes": bytes_,
+                "paramBytes": param_bytes}
+    ars = [op for op in profile.ops
+           if op.kind == "all-reduce"
+           and any(dims for _, dims in op.result_shapes)]
+    bytes_ = sum(2.0 * op.payload_bytes * (op.slices_spanned - 1)
+                 / op.slices_spanned
+                 for op in ars if op.slices_spanned > 1)
+    return {"style": "replicated", "bytes": bytes_,
+            "paramBytes": sum(op.payload_bytes for op in ars)}

@@ -341,6 +341,10 @@ class FlightRecorder:
         self._acc = self._fresh_acc()
         self._input_counters = None
         self._input_last: dict[str, float] = {}
+        # modeled per-step comm split from the HLO comm profile
+        # (obs/collectives.py), set once at the first step
+        self._comm_ici_s = 0.0
+        self._comm_dcn_s = 0.0
 
     @staticmethod
     def _fresh_acc() -> dict:
@@ -356,6 +360,17 @@ class FlightRecorder:
             self._input_counters = {s: fam.labels(stage=s)
                                     for s in self.INPUT_STAGES}
         return {s: c.value for s, c in self._input_counters.items()}
+
+    def set_comm_model(self, ici_s_per_step: float,
+                       dcn_s_per_step: float) -> None:
+        """Adopt the comm profile's modeled per-step ICI/DCN seconds
+        (obs/collectives.py, computed once from the compiled step's
+        HLO). Subsequent window records carry the modeled split as its
+        OWN keyed fields — never folded into the ``device_wait``
+        residual, which stays a pure measurement (the PR 10 rule that
+        split out ``first_step_s``)."""
+        self._comm_ici_s = max(0.0, float(ici_s_per_step))
+        self._comm_dcn_s = max(0.0, float(dcn_s_per_step))
 
     # ------------------------------------------------------------ hot path
 
@@ -410,6 +425,11 @@ class FlightRecorder:
         }
         if acc["first_step_s"]:
             rec["first_step_s"] = round(acc["first_step_s"], 6)
+        if self._comm_ici_s or self._comm_dcn_s:
+            # modeled, clearly keyed as such (the device_wait residual
+            # above is measured and deliberately does NOT subtract this)
+            rec["comm_ici_s"] = round(self._comm_ici_s * steps, 6)
+            rec["comm_dcn_s"] = round(self._comm_dcn_s * steps, 6)
         with self._lock:
             self._ring.append(rec)
         self._acc = self._fresh_acc()

@@ -129,6 +129,31 @@ def _emit_ckpt_spans(ckpt, tracer) -> None:
         tracer.emit(op, start=t0, end=t1, step=step)
 
 
+def _comm_profile_hlo(step_fn, state, batch) -> Optional[str]:
+    """The compiled train step's optimized HLO for the comm profiler
+    (obs/collectives.py), or None when profiling is off or not free.
+
+    KFTPU_COMM_PROFILE: "0" disables; "auto" (default) profiles only
+    when the HLO is FREE — the step is a ``jax.stages.Compiled`` (the
+    PR 9 build_compiled / AOT-load path exposes ``as_text``); "1"
+    forces the jit path to lower+compile a second executable for the
+    text — a persistent-cache hit when the cache is live, but never
+    free, so it is opt-in."""
+    from ..obs.collectives import COMM_PROFILE_ENV
+    mode = (os.environ.get(COMM_PROFILE_ENV) or "auto").strip().lower()
+    if mode in ("0", "off", "false"):
+        return None
+    as_text = getattr(step_fn, "as_text", None)
+    if as_text is not None:
+        return as_text()
+    if mode not in ("1", "force", "true"):
+        return None
+    sds = lambda x: jax.ShapeDtypeStruct(  # noqa: E731
+        x.shape, x.dtype, sharding=x.sharding)
+    a_state, a_batch = jax.tree.map(sds, (state, batch))
+    return step_fn.lower(a_state, a_batch).compile().as_text()
+
+
 # worker exit status after a SIGTERM-forced checkpoint: non-zero so the
 # pod lands in Failed and the operator gang-restarts with resume
 # (restart-ELIGIBLE, unlike exit 0 = Succeeded which completes the job),
@@ -675,6 +700,7 @@ def train(
     # refill per window on tunneled hosts (PERF.md).
     sync_every = max(1, int(sync_every))
     afetch = AsyncWindowFetch(lag=1)
+    comm_series = None   # kftpu_comm_* handle, pruned at teardown
     loop_error: Optional[BaseException] = None
     try:
         with profile_trace(profile_dir, enabled=profile_dir is not None,
@@ -753,6 +779,36 @@ def train(
                                      backend_compiles=d_compiles,
                                      cache_hits=d_hits, step=step + 1)
                     first_step_s = t_first
+                    # communication observability (ISSUE 13): profile
+                    # the compiled step's collectives ONCE, after the
+                    # start-kind evidence above (a forced second
+                    # compile must not pollute the cold/warm verdict).
+                    # Best-effort — observability never kills training.
+                    try:
+                        hlo = _comm_profile_hlo(step_fn, state, batch)
+                        if hlo is not None:
+                            from ..obs.collectives import (
+                                COMM_PROFILE_SPAN, analyze_hlo,
+                                export_comm_metrics, slice_assignment)
+                            n_slices = ctx.contract.num_slices \
+                                if ctx.contract else \
+                                _env_int("KFTPU_NUM_SLICES", 1)
+                            comm_prof = analyze_hlo(
+                                hlo,
+                                slice_assignment(ctx.mesh, n_slices),
+                                mesh_axes=[(a, int(ctx.mesh.shape[a]))
+                                           for a in ctx.mesh.axis_names])
+                            comm_series = export_comm_metrics(comm_prof)
+                            recorder.set_comm_model(
+                                comm_prof.modeled_ici_seconds,
+                                comm_prof.modeled_dcn_seconds)
+                            if tracer is not None and \
+                                    ctx.process_id == 0:
+                                tracer.event(COMM_PROFILE_SPAN,
+                                             step=step + 1,
+                                             profile=comm_prof.to_dict())
+                    except Exception as e:  # noqa: BLE001
+                        log.warning("comm profile failed: %s", e)
                 else:
                     state, metrics = step_fn(state, batch)
                 # the first step's compile + blocking sync is recorded
@@ -879,6 +935,11 @@ def train(
             dump_tracer.close()
         if obs_server is not None:
             obs_server.stop()
+        if comm_series is not None:
+            # job teardown prunes the comm series (the kftpu_job_phase
+            # rule): a later train() in this process must not inherit
+            # this step's comm profile on its /metrics
+            comm_series.prune()
         save_error: Optional[Exception] = None
         if ckpt is not None:
             try:

@@ -23,6 +23,10 @@ Routes:
   GET /api/obs/fleet                       (fleet-router rollup: retries,
                                             hedges, per-replica wins,
                                             fleet badput)
+  GET /api/obs/comm/{ns}/{name}            (per-job comm profile: DCN vs
+                                            ICI bytes/step, per-link
+                                            collective mix, full-reshard
+                                            verdict)
   GET /healthz
 """
 
@@ -404,6 +408,17 @@ def build_dashboard_app(client: KubeClient,
         from ..obs.registry import default_registry
         return 200, RawResponse(default_registry().render())
 
+    def _find_training_job(ns: str, name: str) -> dict:
+        """The job-scoped obs endpoints' shared lookup: the named
+        training job under ANY of the job kinds, or a 404."""
+        from ..api.trainingjob import API_VERSIONS, JOB_KINDS
+        for kind in JOB_KINDS:
+            manifest = client.get_or_none(API_VERSIONS[kind], kind, ns,
+                                          name)
+            if manifest is not None:
+                return manifest
+        raise ApiError(404, f"no training job {ns}/{name}")
+
     @app.route("GET", "/api/obs/jobs/{namespace}/{name}")
     def job_timeline(params, query, body):
         """One job's end-to-end trace timeline, reconstructed from the
@@ -413,18 +428,10 @@ def build_dashboard_app(client: KubeClient,
         attribution the obs layer exists for. The sink location comes
         from this process's KFTPU_SPAN_PATH (the same contract the
         operator renders into workers)."""
-        from ..api.trainingjob import API_VERSIONS, JOB_KINDS
         from ..obs.trace import (SPAN_PATH_ENV, TRACE_ID_ANNOTATION,
                                  reconstruct)
         ns, name = params["namespace"], params["name"]
-        manifest = None
-        for kind in JOB_KINDS:
-            manifest = client.get_or_none(API_VERSIONS[kind], kind, ns,
-                                          name)
-            if manifest is not None:
-                break
-        if manifest is None:
-            raise ApiError(404, f"no training job {ns}/{name}")
+        manifest = _find_training_job(ns, name)
         trace_id = k8s.annotations_of(manifest).get(TRACE_ID_ANNOTATION)
         out = {"namespace": ns, "name": name, "phase": _job_phase(manifest),
                "traceId": trace_id, "events": [], "wallSeconds": 0.0}
@@ -446,18 +453,10 @@ def build_dashboard_app(client: KubeClient,
         reconstructed live from the span sink. A finished job whose
         spans have rotated away still answers from the final-ledger
         annotation the operator stamped at completion."""
-        from ..api.trainingjob import API_VERSIONS, JOB_KINDS
         from ..obs.goodput import GOODPUT_ANNOTATION, ledger_for
         from ..obs.trace import SPAN_PATH_ENV, TRACE_ID_ANNOTATION
         ns, name = params["namespace"], params["name"]
-        manifest = None
-        for kind in JOB_KINDS:
-            manifest = client.get_or_none(API_VERSIONS[kind], kind, ns,
-                                          name)
-            if manifest is not None:
-                break
-        if manifest is None:
-            raise ApiError(404, f"no training job {ns}/{name}")
+        manifest = _find_training_job(ns, name)
         anns = k8s.annotations_of(manifest)
         trace_id = anns.get(TRACE_ID_ANNOTATION)
         out = {"namespace": ns, "name": name,
@@ -530,6 +529,43 @@ def build_dashboard_app(client: KubeClient,
                                  f"({SPAN_PATH_ENV} unset)",
                          "requests": 0}
         return 200, fleet_rollup(span_path)
+
+    @app.route("GET", "/api/obs/comm/{namespace}/{name}")
+    def comm_obs(params, query, body):
+        """One job's communication profile (obs/collectives.py): the
+        worker emits a ``comm-profile`` span at its first step with the
+        compiled train step's per-link collective accounting — DCN vs
+        ICI bytes/step, the per-(link, op) mix, modeled seconds at the
+        configured bandwidths, and the full-reshard verdict (the
+        MULTICHIP_r05 red flag as data). The newest profile span on the
+        job's trace wins (a resize/restart recompiles and re-emits)."""
+        from ..obs.collectives import COMM_PROFILE_SPAN
+        from ..obs.trace import (SPAN_PATH_ENV, TRACE_ID_ANNOTATION,
+                                 load_spans)
+        ns, name = params["namespace"], params["name"]
+        manifest = _find_training_job(ns, name)
+        trace_id = k8s.annotations_of(manifest).get(TRACE_ID_ANNOTATION)
+        out = {"namespace": ns, "name": name, "traceId": trace_id,
+               "profile": None}
+        span_path = os.environ.get(SPAN_PATH_ENV)
+        if not trace_id:
+            out["note"] = "no trace id minted yet"
+            return 200, out
+        if not span_path:
+            out["note"] = f"no span sink configured ({SPAN_PATH_ENV} unset)"
+            return 200, out
+        newest = None
+        for span in load_spans(span_path, trace_id):
+            if span.get("name") == COMM_PROFILE_SPAN:
+                newest = span
+        if newest is None:
+            out["note"] = "no comm-profile span yet (worker has not " \
+                          "completed its first step, or profiling is off)"
+            return 200, out
+        attrs = newest.get("attrs") or {}
+        out["profile"] = attrs.get("profile")
+        out["step"] = attrs.get("step")
+        return 200, out
 
     @app.route("GET", "/api/sched/queues")
     def sched_queues(params, query, body):

@@ -380,6 +380,41 @@
       chartWithTable(rows, { labelKey, valueKey: "value" }, cols));
   }
 
+  // one job's communication profile (/api/obs/comm — the ISSUE 13
+  // panel): DCN vs ICI bytes/step, the per-(link, op) collective mix,
+  // and the full-reshard red flag as a badge
+  function commDetail(ns, name, data) {
+    const blocks = [el("h3", { text: `Comm profile of ${name}` })];
+    if (!data.profile) {
+      blocks.push(el("p", { class: "empty",
+                            text: data.note || "no profile yet" }));
+      return el("div", {}, blocks);
+    }
+    const p = data.profile;
+    const reshard = (p.dcnFullReshard || {}).flagged;
+    blocks.push(el("div", { class: "tiles" }, [
+      statTile("DCN bytes/step", p.dcnBytesPerStep),
+      statTile("ICI bytes/step", p.iciBytesPerStep),
+      statTile("DCN collectives",
+        (p.collectivesPerStep || {}).dcn ?? 0),
+      statTile("Full reshard", reshard ? "FLAGGED" : "clean"),
+    ]));
+    if (reshard) {
+      blocks.push(el("p", { class: "error",
+                            text: (p.dcnFullReshard || {}).reason || "" }));
+    }
+    const rows = Object.entries(p.byLinkOp || {}).map(([k, v]) => ({
+      "link/op": k, count: v.count, bytes: v.bytes,
+    }));
+    if (rows.length) {
+      blocks.push(table(rows, ["link/op", "count", "bytes"]));
+    }
+    return el("div", {}, blocks);
+  }
+
+  // which run's comm detail is open — survives the live re-render
+  let openCommRun = null;
+
   async function viewRuns(root) {
     const ns = selectedNamespace();
     const runs = await api(`api/runs/${encodeURIComponent(ns)}`);
@@ -392,17 +427,46 @@
       })));
     const visible = current === "all" ? runs
       : runs.filter((r) => r.phase === current);
+    // a namespace switch (or a deleted run) must not leave the panel
+    // fetching a run that no longer exists here
+    if (openCommRun && !runs.some((r) => r.name === openCommRun)) {
+      openCommRun = null;
+    }
+    const detail = el("div");
+    if (openCommRun) {
+      api(`api/obs/comm/${encodeURIComponent(ns)}/` +
+          encodeURIComponent(openCommRun))
+        .then((d) => detail.replaceChildren(commDetail(ns, openCommRun, d)))
+        .catch((e) => detail.replaceChildren(
+          el("p", { class: "error", text: e.message })));
+    }
     root.replaceChildren(
       el("h2", { text: `Runs in ${ns}` }), filter,
       visible.length
-        ? table(visible, ["kind", "name", "phase", "progress", "finishedAt"],
+        ? table(visible, ["kind", "name", "phase", "progress",
+                          "finishedAt", "comm"],
             (col, row, td) => {
-              if (col !== "phase") return false;
-              td.appendChild(statusBadge(row.phase));
-              return true;
+              if (col === "phase") {
+                td.appendChild(statusBadge(row.phase));
+                return true;
+              }
+              if (col === "comm") {
+                td.appendChild(el("button", {
+                  class: "minor",
+                  text: openCommRun === row.name ? "hide" : "comm",
+                  onclick: () => {
+                    openCommRun = openCommRun === row.name
+                      ? null : row.name;
+                    render();
+                  },
+                }));
+                return true;
+              }
+              return false;
             })
         : el("p", { class: "empty",
-                    text: "No training jobs or workflow runs." }));
+                    text: "No training jobs or workflow runs." }),
+      detail);
   }
 
   // -- pipelines (runs + scheduled jobs over the pipeline apiserver,

@@ -1,0 +1,626 @@
+"""Communication observability (ISSUE 13): the HLO collective analyzer
+(obs/collectives.py) — parser semantics on canned HLO snippets (async
+-start forms, iota/literal replica groups, tuple-shaped combined
+collectives, degenerate groups), ICI/DCN classification on 1-slice vs
+2-slice meshes, the full-reshard detector's positive/negative drill,
+the modeled optimizer-update yardstick, worker comm-profile span +
+gauge wiring, and the dashboard comm endpoint."""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.obs.collectives import (
+    COMM_PROFILE_ENV, COMM_PROFILE_SPAN, LINK_DCN, LINK_ICI, LINK_LOCAL,
+    analyze_hlo, collective_counts, detect_full_reshard,
+    export_comm_metrics, modeled_update_dcn_bytes, parse_hlo_collectives,
+    slice_assignment)
+from kubeflow_tpu.obs.trace import SPAN_PATH_ENV, TRACE_ID_ANNOTATION
+
+pytestmark = pytest.mark.comm
+
+ONE_SLICE_8 = [0] * 8
+TWO_SLICE_8 = [0, 0, 0, 0, 1, 1, 1, 1]
+
+META_MODEL = ('metadata={op_name="jit(step_fn)/jit(main)/'
+              'jvp(TransformerLM)/tok_embed/gather" '
+              'source_file="/repo/kubeflow_tpu/models/transformer.py" '
+              'source_line=138}')
+META_UPDATE = ('metadata={op_name="jit(step_fn)/jit(main)/add" '
+               'source_file="/repo/kubeflow_tpu/runtime/trainstep.py" '
+               'source_line=228}')
+
+
+def _hlo(*lines) -> str:
+    return "\n".join(["HloModule test", "ENTRY %main () -> f32[] {",
+                      *(f"  {ln}" for ln in lines), "}"])
+
+
+class TestParser:
+    def test_literal_groups_and_shapes(self):
+        hlo = _hlo('%ar = f32[128,8]{1,0} all-reduce(f32[128,8]{1,0} '
+                   '%g), channel_id=1, '
+                   'replica_groups={{0,1,2,3},{4,5,6,7}}, '
+                   'use_global_device_ids=true, to_apply=%sum')
+        ops = parse_hlo_collectives(hlo)
+        assert len(ops) == 1
+        op = ops[0]
+        assert op.kind == "all-reduce" and not op.is_async_start
+        assert op.payload_bytes == 128 * 8 * 4
+        assert op.groups == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+    def test_iota_groups_expand_with_transpose(self):
+        # [2,4]<=[4,2]T(1,0): iota(8).reshape(4,2).T.flatten() —
+        # exactly the gradient-reduction groups the 2-slice mixed mesh
+        # emits (observed in the MULTICHIP_r05 config's HLO)
+        hlo = _hlo('%ar = f32[64]{0} all-reduce(f32[64]{0} %g), '
+                   'replica_groups=[2,4]<=[4,2]T(1,0), to_apply=%sum')
+        assert parse_hlo_collectives(hlo)[0].groups == \
+            [[0, 2, 4, 6], [1, 3, 5, 7]]
+
+    def test_iota_groups_without_transpose(self):
+        hlo = _hlo('%ag = f32[64]{0} all-gather(f32[8]{0} %x), '
+                   'replica_groups=[1,8]<=[8], dimensions={0}')
+        assert parse_hlo_collectives(hlo)[0].groups == \
+            [[0, 1, 2, 3, 4, 5, 6, 7]]
+
+    def test_async_start_counted_done_ignored(self):
+        # XLA:TPU splits collectives into start/done pairs; only the
+        # -start op names the groups — counting both would double
+        hlo = _hlo(
+            '%ars = f32[128]{0} all-reduce-start(f32[128]{0} %g), '
+            'replica_groups={{0,1}}, to_apply=%sum',
+            '%ard = f32[128]{0} all-reduce-done(f32[128]{0} %ars)')
+        ops = parse_hlo_collectives(hlo)
+        assert len(ops) == 1
+        assert ops[0].is_async_start
+        assert ops[0].payload_bytes == 128 * 4
+
+    def test_all_gather_start_tuple_counts_result_half(self):
+        # all-gather-start returns (operand, result): the payload is the
+        # gathered RESULT, not operand + result
+        hlo = _hlo('%ags = (f32[8]{0}, f32[64]{0}) all-gather-start('
+                   'f32[8]{0} %x), replica_groups={{0,1,2,3,4,5,6,7}}, '
+                   'dimensions={0}')
+        assert parse_hlo_collectives(hlo)[0].payload_bytes == 64 * 4
+
+    def test_combined_tuple_collective_sums_elements(self):
+        # a combined (tuple-shaped) sync all-reduce reduces every
+        # element: payload is the sum
+        hlo = _hlo('%ar = (f32[16]{0}, bf16[32]{0}) all-reduce('
+                   'f32[16]{0} %a, bf16[32]{0} %b), '
+                   'replica_groups={{0,1}}, to_apply=%sum')
+        assert parse_hlo_collectives(hlo)[0].payload_bytes == \
+            16 * 4 + 32 * 2
+
+    def test_collective_permute_pairs(self):
+        hlo = _hlo('%cp = f32[128,32]{1,0} collective-permute('
+                   'f32[128,32]{1,0} %x), channel_id=11, '
+                   'source_target_pairs={{0,0},{4,2},{1,5}}, '
+                   + META_MODEL)
+        op = parse_hlo_collectives(hlo)[0]
+        assert op.kind == "collective-permute"
+        assert op.pairs == [(0, 0), (4, 2), (1, 5)]
+        assert op.source_file.endswith("transformer.py")
+        assert op.source_line == 138
+
+    def test_fusion_referencing_collective_not_matched(self):
+        hlo = _hlo('%f = f32[8]{0} fusion(f32[8]{0} %all-reduce.1), '
+                   'kind=kLoop, calls=%fc')
+        assert parse_hlo_collectives(hlo) == []
+
+    def test_metadata_without_source_file(self):
+        hlo = _hlo('%ag = f32[64]{0} all-gather(f32[8]{0} %x), '
+                   'replica_groups=[1,8]<=[8], dimensions={0}, '
+                   'metadata={op_name="jit(step)/gather"}')
+        op = parse_hlo_collectives(hlo)[0]
+        assert op.op_name == "jit(step)/gather"
+        assert op.source_file == "" and not op.in_update_region
+
+
+class TestClassification:
+    def test_single_slice_is_ici(self):
+        hlo = _hlo('%ar = f32[64]{0} all-reduce(f32[64]{0} %g), '
+                   'replica_groups=[1,8]<=[8], to_apply=%sum')
+        prof = analyze_hlo(hlo, ONE_SLICE_8)
+        op = prof.ops[0]
+        assert op.link == LINK_ICI and op.slices_spanned == 1
+        assert op.dcn_bytes == 0
+        # ring all-reduce over n=8: 2 * P * 7/8
+        assert op.ici_bytes == pytest.approx(2 * 64 * 4 * 7 / 8)
+        assert prof.dcn_bytes_per_step == 0
+
+    def test_two_slice_hierarchical_split(self):
+        hlo = _hlo('%ar = f32[64]{0} all-reduce(f32[64]{0} %g), '
+                   'replica_groups=[1,8]<=[8], to_apply=%sum')
+        op = analyze_hlo(hlo, TWO_SLICE_8).ops[0]
+        assert op.link == LINK_DCN and op.slices_spanned == 2
+        # inter-slice phase at k=2, intra-slice phase at n_local=4
+        assert op.dcn_bytes == pytest.approx(2 * 64 * 4 * 1 / 2)
+        assert op.ici_bytes == pytest.approx(2 * 64 * 4 * 3 / 4)
+
+    def test_reduce_scatter_full_payload_is_result_times_group(self):
+        hlo = _hlo('%rs = f32[8]{0} reduce-scatter(f32[64]{0} %g), '
+                   'replica_groups=[1,8]<=[8], dimensions={0}, '
+                   'to_apply=%sum')
+        op = analyze_hlo(hlo, TWO_SLICE_8).ops[0]
+        # pre-scatter input = result x 8; factor 1
+        assert op.dcn_bytes == pytest.approx(8 * 4 * 8 * 1 / 2)
+
+    def test_degenerate_single_member_groups_are_local(self):
+        hlo = _hlo('%ag = f32[8]{0} all-gather(f32[8]{0} %x), '
+                   'replica_groups={{0},{1},{2},{3},{4},{5},{6},{7}}, '
+                   'dimensions={0}')
+        op = analyze_hlo(hlo, TWO_SLICE_8).ops[0]
+        assert op.link == LINK_LOCAL
+        assert op.dcn_bytes == 0 and op.ici_bytes == 0
+
+    def test_empty_replica_groups_means_everyone(self):
+        hlo = _hlo('%ar = f32[4]{0} all-reduce(f32[4]{0} %g), '
+                   'replica_groups={}, to_apply=%sum')
+        op = analyze_hlo(hlo, TWO_SLICE_8).ops[0]
+        assert op.group_size == 8 and op.link == LINK_DCN
+
+    def test_permute_crossing_fraction(self):
+        # 2 real pairs, 1 crossing: half the payload is DCN
+        hlo = _hlo('%cp = f32[100]{0} collective-permute(f32[100]{0} '
+                   '%x), source_target_pairs={{0,0},{1,2},{3,4}}')
+        op = analyze_hlo(hlo, TWO_SLICE_8).ops[0]
+        assert op.link == LINK_DCN
+        assert op.dcn_bytes == pytest.approx(400 * 0.5)
+        assert op.ici_bytes == pytest.approx(400 * 0.5)
+
+    def test_mesh_axes_labeling(self):
+        mesh_axes = [("data", 2), ("fsdp", 2), ("tensor", 2)]
+        hlo = _hlo('%ar = f32[64]{0} all-reduce(f32[64]{0} %g), '
+                   'replica_groups=[2,4]<=[4,2]T(1,0), to_apply=%sum')
+        op = analyze_hlo(hlo, TWO_SLICE_8, mesh_axes=mesh_axes).ops[0]
+        # groups {0,2,4,6}: data+fsdp vary, tensor fixed
+        assert op.axes == ("data", "fsdp")
+
+    def test_bandwidth_knobs(self, monkeypatch):
+        monkeypatch.setenv("KFTPU_COMM_ICI_GBPS", "10")
+        monkeypatch.setenv("KFTPU_COMM_DCN_GBPS", "1")
+        hlo = _hlo('%ar = f32[1000]{0} all-reduce(f32[1000]{0} %g), '
+                   'replica_groups=[1,8]<=[8], to_apply=%sum')
+        prof = analyze_hlo(hlo, TWO_SLICE_8)
+        assert prof.modeled_dcn_seconds == \
+            pytest.approx(prof.dcn_bytes_per_step / 1e9)
+        assert prof.modeled_ici_seconds == \
+            pytest.approx(prof.ici_bytes_per_step / 10e9)
+
+    def test_by_link_op_bytes_reconcile_with_totals(self):
+        # a DCN-crossing op has BOTH phases: its ICI-phase bytes must
+        # land under the ici rows so the per-link gauge sums match the
+        # profile totals an operator sees beside them
+        hlo = _hlo(
+            '%ar = f32[64]{0} all-reduce(f32[64]{0} %g), '
+            'replica_groups=[1,8]<=[8], to_apply=%sum',
+            '%ag = f32[32]{0} all-gather(f32[16]{0} %x), '
+            'replica_groups={{0,1},{2,3},{4,5},{6,7}}, dimensions={0}')
+        prof = analyze_hlo(hlo, TWO_SLICE_8)
+        rows = prof.by_link_op()
+        assert sum(r["bytes"] for (link, _), r in rows.items()
+                   if link == LINK_DCN) == \
+            pytest.approx(prof.dcn_bytes_per_step)
+        assert sum(r["bytes"] for (link, _), r in rows.items()
+                   if link == LINK_ICI) == \
+            pytest.approx(prof.ici_bytes_per_step)
+        # counts still bucket each op under ITS link class
+        assert rows[(LINK_DCN, "all-reduce")]["count"] == 1
+        assert rows[(LINK_ICI, "all-gather")]["count"] == 1
+        assert rows[(LINK_ICI, "all-reduce")]["count"] == 0
+
+    def test_permute_out_of_range_pairs_skipped(self):
+        # wrong mesh passed: ids beyond the slice map are skipped like
+        # the replica-group path, never an IndexError
+        hlo = _hlo('%cp = f32[100]{0} collective-permute(f32[100]{0} '
+                   '%x), source_target_pairs={{0,1},{7,4}}')
+        op = analyze_hlo(hlo, [0, 0, 1, 1]).ops[0]
+        assert op.link == LINK_ICI and op.dcn_bytes == 0
+
+    def test_to_dict_shape(self):
+        hlo = _hlo('%ar = f32[64]{0} all-reduce(f32[64]{0} %g), '
+                   'replica_groups=[1,8]<=[8], to_apply=%sum')
+        d = analyze_hlo(hlo, TWO_SLICE_8).to_dict()
+        assert d["collectivesPerStep"] == {"dcn": 1, "ici": 0,
+                                           "local": 0}
+        assert "dcn/all-reduce" in d["byLinkOp"]
+        assert d["dcnFullReshard"]["flagged"] is False
+        assert d["topOps"][0]["kind"] == "all-reduce"
+
+
+class TestCollectiveCounts:
+    def test_scalar_all_reduce_excluded(self):
+        hlo = _hlo('%l = f32[] all-reduce(f32[] %loss), '
+                   'replica_groups={{0,1}}, to_apply=%sum',
+                   '%g = f32[64]{0} all-reduce(f32[64]{0} %grad), '
+                   'replica_groups={{0,1}}, to_apply=%sum')
+        assert collective_counts(hlo) == {
+            "reduce_scatter": 0, "all_gather": 0,
+            "all_reduce_nonscalar": 1}
+
+    def test_async_forms_counted_once(self):
+        hlo = _hlo(
+            '%rs = f32[8]{0} reduce-scatter-start(f32[64]{0} %g), '
+            'replica_groups={{0,1}}, dimensions={0}, to_apply=%sum',
+            '%rsd = f32[8]{0} reduce-scatter-done(f32[8]{0} %rs)',
+            '%ag = (f32[8]{0}, f32[64]{0}) all-gather-start(f32[8]{0} '
+            '%p), replica_groups={{0,1}}, dimensions={0}',
+            '%agd = f32[64]{0} all-gather-done((f32[8]{0}, f32[64]{0}) '
+            '%ag)')
+        assert collective_counts(hlo) == {
+            "reduce_scatter": 1, "all_gather": 1,
+            "all_reduce_nonscalar": 0}
+
+    def test_bench_reexports_the_shared_vocabulary(self):
+        import bench
+        assert bench.collective_counts is collective_counts
+
+
+def _reshard_hlo(meta=META_MODEL):
+    """A 2-slice module with a DCN-crossing parameter all-gather in the
+    model region — the involuntary-remat signature."""
+    return _hlo(
+        '%ag = f32[256,32]{1,0} all-gather(f32[128,32]{1,0} %p), '
+        'replica_groups={{0,4},{2,6},{1,5},{3,7}}, dimensions={0}, '
+        'use_global_device_ids=true, ' + meta,
+        '%ar = f32[64]{0} all-reduce(f32[64]{0} %g), '
+        'replica_groups=[1,8]<=[8], to_apply=%sum, ' + META_MODEL)
+
+
+class TestDetector:
+    def test_flags_model_region_dcn_all_gather(self):
+        prof = analyze_hlo(_reshard_hlo(), TWO_SLICE_8)
+        v = detect_full_reshard(prof)
+        assert v.flagged
+        assert len(v.ops) == 1 and v.ops[0]["kind"] == "all-gather"
+        assert "involuntary" in v.reason
+
+    def test_flags_metadata_less_dcn_reshard(self):
+        # no metadata = model region (conservative: an unattributed DCN
+        # reshard should flag, not hide)
+        hlo = _hlo('%ag = f32[64]{0} all-gather(f32[8]{0} %p), '
+                   'replica_groups=[1,8]<=[8], dimensions={0}')
+        assert detect_full_reshard(
+            analyze_hlo(hlo, TWO_SLICE_8)).flagged
+
+    def test_update_region_gather_is_clean(self):
+        # the ZeRO-2 param re-gather crosses DCN by design: never a flag
+        hlo = _hlo('%ag = f32[64]{0} all-gather(f32[8]{0} %p), '
+                   'replica_groups=[1,8]<=[8], dimensions={0}, '
+                   + META_UPDATE)
+        assert not detect_full_reshard(
+            analyze_hlo(hlo, TWO_SLICE_8)).flagged
+
+    def test_ici_gather_and_dcn_all_reduce_are_clean(self):
+        hlo = _hlo(
+            '%ag = f32[64]{0} all-gather(f32[32]{0} %x), '
+            'replica_groups={{0,1},{2,3},{4,5},{6,7}}, dimensions={0}, '
+            + META_MODEL,
+            '%ar = f32[64]{0} all-reduce(f32[64]{0} %g), '
+            'replica_groups=[1,8]<=[8], to_apply=%sum, ' + META_MODEL)
+        assert not detect_full_reshard(
+            analyze_hlo(hlo, TWO_SLICE_8)).flagged
+
+    def test_single_slice_never_flags(self):
+        assert not detect_full_reshard(
+            analyze_hlo(_reshard_hlo(), ONE_SLICE_8)).flagged
+
+    def test_crossing_permute_flags(self):
+        hlo = _hlo('%cp = f32[100]{0} collective-permute(f32[100]{0} '
+                   '%x), source_target_pairs={{0,4},{4,0}}, '
+                   + META_MODEL)
+        assert detect_full_reshard(
+            analyze_hlo(hlo, TWO_SLICE_8)).flagged
+
+
+class TestUpdateMetric:
+    def test_replicated_style_counts_factor_two(self):
+        hlo = _hlo('%ar = f32[64]{0} all-reduce(f32[64]{0} %g), '
+                   'replica_groups=[1,8]<=[8], to_apply=%sum',
+                   '%l = f32[] all-reduce(f32[] %loss), '
+                   'replica_groups=[1,8]<=[8], to_apply=%sum')
+        prof = analyze_hlo(hlo, TWO_SLICE_8)
+        u = modeled_update_dcn_bytes(prof, hlo)
+        assert u["style"] == "replicated"
+        # the scalar loss all-reduce is not optimizer-update traffic
+        assert u["bytes"] == pytest.approx(2 * 64 * 4 * 1 / 2)
+
+    def test_sharded_style_counts_param_regather_once(self):
+        hlo = _hlo(
+            '%rs = f32[8]{0} reduce-scatter(f32[64]{0} %g), '
+            'replica_groups=[1,8]<=[8], dimensions={0}, to_apply=%sum, '
+            + META_UPDATE,
+            '%ag = f32[64]{0} all-gather(f32[8]{0} %u), '
+            'replica_groups=[1,8]<=[8], dimensions={0}, ' + META_UPDATE)
+        u = modeled_update_dcn_bytes(
+            analyze_hlo(hlo, TWO_SLICE_8), hlo)
+        assert u["style"] == "sharded"
+        assert u["bytes"] == pytest.approx(64 * 4 * 1 / 2)
+
+    def test_split_gather_pair_merged_via_consumer(self):
+        # the CPU partitioner's add(all-gather, all-gather) emission:
+        # ONE logical param re-gather, counted once — while two
+        # same-shape gathers with SEPARATE consumers stay distinct
+        pair = _hlo(
+            '%ag.1 = f32[64]{0} all-gather(f32[8]{0} %a), '
+            'replica_groups=[1,8]<=[8], dimensions={0}, ' + META_UPDATE,
+            '%ag.2 = f32[64]{0} all-gather(f32[8]{0} %b), '
+            'replica_groups=[1,8]<=[8], dimensions={0}, ' + META_UPDATE,
+            '%add.1 = f32[64]{0} add(f32[64]{0} %ag.1, f32[64]{0} '
+            '%ag.2)')
+        u = modeled_update_dcn_bytes(analyze_hlo(pair, TWO_SLICE_8),
+                                     pair)
+        assert u["bytes"] == pytest.approx(64 * 4 * 1 / 2)
+
+        separate = _hlo(
+            '%ag.1 = f32[64]{0} all-gather(f32[8]{0} %a), '
+            'replica_groups=[1,8]<=[8], dimensions={0}, ' + META_UPDATE,
+            '%ag.2 = f32[64]{0} all-gather(f32[8]{0} %b), '
+            'replica_groups=[1,8]<=[8], dimensions={0}, ' + META_UPDATE,
+            '%n.1 = f32[64]{0} negate(f32[64]{0} %ag.1)',
+            '%n.2 = f32[64]{0} negate(f32[64]{0} %ag.2)')
+        u2 = modeled_update_dcn_bytes(
+            analyze_hlo(separate, TWO_SLICE_8), separate)
+        assert u2["bytes"] == pytest.approx(2 * 64 * 4 * 1 / 2)
+
+    def test_merge_never_chains_through_a_merged_gather(self):
+        # g2 merges into g1 via add; a later consumer sharing g2 with
+        # g3 must NOT merge g3 too — g3 is a distinct logical re-gather
+        hlo = _hlo(
+            '%g1 = f32[64]{0} all-gather(f32[8]{0} %a), '
+            'replica_groups=[1,8]<=[8], dimensions={0}, ' + META_UPDATE,
+            '%g2 = f32[64]{0} all-gather(f32[8]{0} %b), '
+            'replica_groups=[1,8]<=[8], dimensions={0}, ' + META_UPDATE,
+            '%g3 = f32[64]{0} all-gather(f32[8]{0} %c), '
+            'replica_groups=[1,8]<=[8], dimensions={0}, ' + META_UPDATE,
+            '%add.1 = f32[64]{0} add(f32[64]{0} %g1, f32[64]{0} %g2)',
+            '%mul.1 = f32[64]{0} multiply(f32[64]{0} %g2, f32[64]{0} '
+            '%g3)')
+        u = modeled_update_dcn_bytes(analyze_hlo(hlo, TWO_SLICE_8),
+                                     hlo)
+        # two logical re-gathers survive (g1+g2 merged, g3 distinct)
+        assert u["bytes"] == pytest.approx(2 * 64 * 4 * 1 / 2)
+
+    def test_bandwidth_knob_garbage_warns_and_defaults(self, caplog,
+                                                       monkeypatch):
+        monkeypatch.setenv("KFTPU_COMM_DCN_GBPS", "6,25")
+        hlo = _hlo('%ar = f32[1000]{0} all-reduce(f32[1000]{0} %g), '
+                   'replica_groups=[1,8]<=[8], to_apply=%sum')
+        import logging
+        with caplog.at_level(logging.WARNING,
+                             logger="kubeflow_tpu.obs.collectives"):
+            prof = analyze_hlo(hlo, TWO_SLICE_8)
+        assert prof.dcn_gbps == 6.25   # the default, loudly
+        assert any("KFTPU_COMM_DCN_GBPS" in r.message
+                   for r in caplog.records)
+
+    def test_sharded_strictly_below_replicated_same_params(self):
+        rep = _hlo('%ar = f32[64]{0} all-reduce(f32[64]{0} %g), '
+                   'replica_groups=[1,8]<=[8], to_apply=%sum')
+        sh = _hlo(
+            '%rs = f32[8]{0} reduce-scatter(f32[64]{0} %g), '
+            'replica_groups=[1,8]<=[8], dimensions={0}, to_apply=%sum, '
+            + META_UPDATE,
+            '%ag = f32[64]{0} all-gather(f32[8]{0} %u), '
+            'replica_groups=[1,8]<=[8], dimensions={0}, ' + META_UPDATE)
+        u_rep = modeled_update_dcn_bytes(
+            analyze_hlo(rep, TWO_SLICE_8), rep)
+        u_sh = modeled_update_dcn_bytes(
+            analyze_hlo(sh, TWO_SLICE_8), sh)
+        assert u_sh["bytes"] < u_rep["bytes"]
+        # ... while TOTAL wire bytes are conserved (RS+AG == AR): the
+        # documented reason the yardstick isolates the update phase
+        tot_rep = analyze_hlo(rep, TWO_SLICE_8).dcn_bytes_per_step
+        tot_sh = analyze_hlo(sh, TWO_SLICE_8).dcn_bytes_per_step
+        assert tot_sh == pytest.approx(tot_rep)
+
+
+class TestExportMetrics:
+    def test_series_visible_then_pruned(self):
+        from kubeflow_tpu.obs.registry import (default_registry,
+                                               reset_default_registry)
+        reset_default_registry()
+        try:
+            prof = analyze_hlo(_reshard_hlo(), TWO_SLICE_8)
+            series = export_comm_metrics(prof)
+            text = default_registry().render()
+            assert 'kftpu_comm_bytes_per_step{link="dcn",' \
+                   'op="all-gather"}' in text
+            assert 'kftpu_comm_collectives_per_step{link="dcn",' \
+                   'op="all-reduce"} 1' in text
+            assert "kftpu_comm_dcn_full_reshard 1" in text
+            series.prune()
+            text = default_registry().render()
+            assert 'link="dcn"' not in text
+            assert "kftpu_comm_dcn_full_reshard 0" in text
+        finally:
+            reset_default_registry()
+
+
+class TestFlightRecorderComm:
+    def test_window_records_carry_modeled_comm_split(self):
+        from kubeflow_tpu.runtime.metrics import FlightRecorder
+        rec = FlightRecorder(windows=4)
+        rec.note_step(data_s=0.01, dispatch_s=0.02)
+        rec.close_window(1, 1, 0.1)
+        base = rec.snapshot()["records"][-1]
+        assert "comm_ici_s" not in base    # no profile yet: no field
+        rec.set_comm_model(0.002, 0.005)
+        rec.note_step(data_s=0.01, dispatch_s=0.02)
+        rec.note_step(data_s=0.01, dispatch_s=0.02)
+        rec.close_window(3, 2, 0.2)
+        win = rec.snapshot()["records"][-1]
+        assert win["comm_ici_s"] == pytest.approx(0.004)
+        assert win["comm_dcn_s"] == pytest.approx(0.010)
+        # its own keyed field: the measured device_wait residual is NOT
+        # reduced by the modeled comm seconds (the first_step_s rule)
+        assert win["device_wait_s"] == pytest.approx(
+            0.2 - 0.06, abs=1e-6)
+
+
+def _job_manifest(name="comm-job") -> dict:
+    return {"apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": name, "namespace": "kubeflow",
+                         "uid": "uid-77"},
+            "spec": {"replicaSpecs": {"TPU": {
+                "tpuTopology": "v5e-8",
+                "template": {"spec": {"containers": [
+                    {"name": "jax", "image": "trainer:v1"}]}}}}}}
+
+
+class TestDashboardEndpoint:
+    def _write_profile_span(self, sink, trace_id):
+        prof = analyze_hlo(_reshard_hlo(), TWO_SLICE_8)
+        with open(sink, "w") as f:
+            f.write(json.dumps({
+                "name": COMM_PROFILE_SPAN, "trace_id": trace_id,
+                "start": 1.0, "end": 1.0,
+                "attrs": {"step": 1,
+                          "profile": prof.to_dict()}}) + "\n")
+
+    def test_comm_endpoint_serves_newest_profile(self, tmp_path,
+                                                 monkeypatch):
+        from kubeflow_tpu.cluster.fake import FakeCluster
+        from kubeflow_tpu.webapps.dashboard import build_dashboard_app
+        sink = str(tmp_path / "spans.jsonl")
+        self._write_profile_span(sink, "ct1")
+        monkeypatch.setenv(SPAN_PATH_ENV, sink)
+        cluster = FakeCluster()
+        manifest = _job_manifest()
+        manifest["metadata"]["annotations"] = {TRACE_ID_ANNOTATION:
+                                               "ct1"}
+        cluster.create(manifest)
+        app = build_dashboard_app(cluster)
+        status, body = app.dispatch(
+            "GET", "/api/obs/comm/kubeflow/comm-job", None)
+        assert status == 200
+        assert body["profile"]["dcnFullReshard"]["flagged"] is True
+        assert body["profile"]["dcnBytesPerStep"] > 0
+
+    def test_no_profile_yet_notes(self, tmp_path, monkeypatch):
+        from kubeflow_tpu.cluster.fake import FakeCluster
+        from kubeflow_tpu.webapps.dashboard import build_dashboard_app
+        monkeypatch.setenv(SPAN_PATH_ENV, str(tmp_path / "e.jsonl"))
+        cluster = FakeCluster()
+        manifest = _job_manifest()
+        manifest["metadata"]["annotations"] = {TRACE_ID_ANNOTATION:
+                                               "ct2"}
+        cluster.create(manifest)
+        app = build_dashboard_app(cluster)
+        status, body = app.dispatch(
+            "GET", "/api/obs/comm/kubeflow/comm-job", None)
+        assert status == 200 and body["profile"] is None
+        assert "note" in body
+
+    def test_unknown_job_404(self):
+        from kubeflow_tpu.cluster.fake import FakeCluster
+        from kubeflow_tpu.webapps.dashboard import build_dashboard_app
+        app = build_dashboard_app(FakeCluster())
+        status, _ = app.dispatch(
+            "GET", "/api/obs/comm/kubeflow/ghost", None)
+        assert status == 404
+
+
+@pytest.mark.compute
+class TestWorkerIntegration:
+    def test_aot_run_emits_profile_span_and_prunes_gauges(
+            self, tmp_path, monkeypatch):
+        """The free path: with AOT the step is a Compiled object, so
+        the default auto mode profiles without a second compile. The
+        comm-profile span lands on the trace; the kftpu_comm_* series
+        are pruned at teardown."""
+        from kubeflow_tpu.obs.registry import (default_registry,
+                                               reset_default_registry)
+        from kubeflow_tpu.obs.trace import load_spans
+        from kubeflow_tpu.runtime.worker import train
+        reset_default_registry()
+        sink = str(tmp_path / "spans.jsonl")
+        monkeypatch.setenv(SPAN_PATH_ENV, sink)
+        monkeypatch.setenv("KFTPU_TRACE_ID", "cw1")
+        monkeypatch.delenv(COMM_PROFILE_ENV, raising=False)
+        try:
+            train(workload="transformer", steps=3, global_batch=8,
+                  sync_every=2, aot=True,
+                  aot_dir=str(tmp_path / "aot"), workload_kwargs={})
+            spans = [s for s in load_spans(sink, trace_id="cw1")
+                     if s["name"] == COMM_PROFILE_SPAN]
+            assert len(spans) == 1
+            prof = spans[0]["attrs"]["profile"]
+            # single-slice local mesh: everything is ICI, no red flag
+            assert prof["dcnBytesPerStep"] == 0
+            assert prof["iciBytesPerStep"] > 0
+            assert prof["collectivesPerStep"]["ici"] > 0
+            assert prof["dcnFullReshard"]["flagged"] is False
+            # teardown pruned the per-(link,op) series
+            text = default_registry().render()
+            assert 'kftpu_comm_bytes_per_step{' not in text
+        finally:
+            reset_default_registry()
+
+    def test_forced_jit_profile_and_two_slice_classification(
+            self, tmp_path, monkeypatch):
+        """KFTPU_COMM_PROFILE=1 forces the jit path to produce HLO
+        (a cache-hitting second compile), and a 2-slice contract on the
+        ctx classifies the gradient all-reduce as DCN."""
+        import jax
+
+        from kubeflow_tpu.api.topology import (TopologyContract,
+                                               parse_topology)
+        from kubeflow_tpu.api.trainingjob import ShardingSpec
+        from kubeflow_tpu.obs.trace import load_spans
+        from kubeflow_tpu.parallel.mesh import build_mesh
+        from kubeflow_tpu.runtime.bootstrap import WorkerContext
+        from kubeflow_tpu.runtime.worker import train
+        n_dev = len(jax.devices())
+        if n_dev % 2:
+            pytest.skip("needs an even device count")
+        sink = str(tmp_path / "spans.jsonl")
+        monkeypatch.setenv(SPAN_PATH_ENV, sink)
+        monkeypatch.setenv("KFTPU_TRACE_ID", "cw2")
+        monkeypatch.setenv(COMM_PROFILE_ENV, "1")
+        sharding = ShardingSpec(data=n_dev)
+        ctx = WorkerContext(
+            contract=TopologyContract(
+                coordinator_address="t:1", num_processes=1,
+                process_id=0,
+                slice_topology=parse_topology(f"v5e-{n_dev // 2}"),
+                num_slices=2),
+            sharding=sharding, mesh=build_mesh(sharding),
+            process_id=0, num_processes=1)
+        train(workload="transformer", steps=2, global_batch=n_dev * 2,
+              sync_every=2, ctx=ctx, workload_kwargs={})
+        spans = [s for s in load_spans(sink, trace_id="cw2")
+                 if s["name"] == COMM_PROFILE_SPAN]
+        assert len(spans) == 1
+        prof = spans[0]["attrs"]["profile"]
+        # pure-DP gradients cross the modeled DCN boundary
+        assert prof["numSlices"] == 2
+        assert prof["dcnBytesPerStep"] > 0
+        assert prof["dcnFullReshard"]["flagged"] is False
+
+    def test_disabled_emits_nothing(self, tmp_path, monkeypatch):
+        from kubeflow_tpu.obs.trace import load_spans
+        from kubeflow_tpu.runtime.worker import train
+        sink = str(tmp_path / "spans.jsonl")
+        monkeypatch.setenv(SPAN_PATH_ENV, sink)
+        monkeypatch.setenv("KFTPU_TRACE_ID", "cw3")
+        monkeypatch.setenv(COMM_PROFILE_ENV, "0")
+        train(workload="transformer", steps=2, global_batch=8,
+              sync_every=2, aot=True, aot_dir=str(tmp_path / "aot"),
+              workload_kwargs={})
+        assert not [s for s in load_spans(sink, trace_id="cw3")
+                    if s["name"] == COMM_PROFILE_SPAN]
+
+
+def test_slice_assignment_orders_by_device_assignment():
+    import jax
+
+    from kubeflow_tpu.api.trainingjob import ShardingSpec
+    from kubeflow_tpu.parallel.mesh import build_mesh
+    n = len(jax.devices())
+    mesh = build_mesh(ShardingSpec(data=n))
+    two = slice_assignment(mesh, 2)
+    assert two == [0] * (n // 2) + [1] * (n - n // 2)
+    assert slice_assignment(mesh, 1) == [0] * n

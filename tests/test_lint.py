@@ -677,6 +677,64 @@ def test_fleet_badput_categories_defined_once_and_shared():
     assert fleet_sum_ok(led)
 
 
+def test_collective_vocabulary_defined_once_and_shared():
+    """The HLO collective-op vocabulary has ONE definition
+    (obs/collectives.py, ISSUE 13): the comm analyzer, bench.py's
+    collective_counts, the dryrun, and the weight-update tests all
+    consume that module, so the bench and the analyzer can never drift
+    on which op literals they count (the obs/goodput.py
+    single-definition rule applied to HLO opcodes)."""
+    import subprocess
+
+    from kubeflow_tpu.obs.collectives import (ASYNC_START_FORMS,
+                                              COLLECTIVE_OPS)
+
+    assert COLLECTIVE_OPS == ("all-reduce", "reduce-scatter",
+                              "all-gather", "all-to-all",
+                              "collective-permute")
+    assert ASYNC_START_FORMS == tuple(f"{op}-start"
+                                      for op in COLLECTIVE_OPS)
+
+    # single definition: the unambiguous parser literals (the async
+    # -start forms never appear in prose/docstrings) live in exactly
+    # one source file across the package, the bench, and the dryrun
+    for literal in ("all-reduce-start", "all-gather-start",
+                    "reduce-scatter-start"):
+        hits = subprocess.run(
+            ["grep", "-rl", literal,
+             os.path.join(REPO_ROOT, "kubeflow_tpu"),
+             os.path.join(REPO_ROOT, "bench.py"),
+             os.path.join(REPO_ROOT, "__graft_entry__.py")],
+            capture_output=True, text=True).stdout.split()
+        hits = [h for h in hits if "__pycache__" not in h]
+        assert [os.path.relpath(h, REPO_ROOT) for h in hits] == \
+            [os.path.join("kubeflow_tpu", "obs", "collectives.py")], \
+            f"{literal!r} defined outside obs/collectives.py: {hits}"
+
+    def src(*rel):
+        with open(os.path.join(REPO_ROOT, *rel)) as f:
+            return f.read()
+
+    # bench consumes the shared vocabulary instead of re-spelling the
+    # counting regex (collective_counts moved out of bench in ISSUE 13)
+    bench_src = src("bench.py")
+    assert "from kubeflow_tpu.obs.collectives import collective_counts" \
+        in bench_src
+    assert "def collective_counts" not in bench_src
+    # ... and the quoted hyphenated opcodes never reappear in bench
+    for literal in ('"reduce-scatter"', '"all-gather"', '"all-reduce"'):
+        assert literal not in bench_src, \
+            f"bench.py re-spells {literal}; import from obs/collectives"
+    # the dryrun's comm verdict and the worker's profile go through the
+    # analyzer, not a private parser
+    entry_src = src("__graft_entry__.py")
+    assert "from kubeflow_tpu.obs.collectives import" in entry_src
+    worker_src = src("kubeflow_tpu", "runtime", "worker.py")
+    for use in ("analyze_hlo", "export_comm_metrics", "slice_assignment",
+                "COMM_PROFILE_SPAN"):
+        assert use in worker_src, f"runtime/worker.py must consume {use}"
+
+
 def test_serving_resilience_knobs_are_plumbed_end_to_end():
     """The drain/fleet knobs must exist in EVERY layer at once
     (ISSUE 12): the serving manifest renders probes + preStop + PDB +

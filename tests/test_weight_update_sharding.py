@@ -18,7 +18,9 @@ import pytest
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))   # bench.py lives at the repo root
 
-from bench import collective_counts, estimate_weight_update_hbm  # noqa: E402
+from bench import estimate_weight_update_hbm  # noqa: E402
+# canonical home since ISSUE 13 (bench re-exports for compatibility)
+from kubeflow_tpu.obs.collectives import collective_counts  # noqa: E402
 from kubeflow_tpu.api.trainingjob import ShardingSpec  # noqa: E402
 from kubeflow_tpu.parallel.mesh import (build_mesh, replica_axes,  # noqa: E402
                                         replica_degree)

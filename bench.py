@@ -1698,6 +1698,130 @@ def bench_obs(t_start: float | None = None) -> dict:
     }
 
 
+def bench_ctrl_chaos(t_start: float | None = None) -> dict:
+    """Control-plane fault-tolerance acceptance (ISSUE 14).
+
+    Two parts. (1) ControlPlaneSoak (scheduler/soak.py): a real TPUJob
+    trains to Succeeded on the CPU mesh while the operator and the
+    scheduler — each a two-replica lease-elected set over per-replica
+    chaos clients — are killed mid-write and re-elected, and the
+    apiserver partitions; asserted: Succeeded, params parity vs a clean
+    run (≤1e-5; measured 0.0), zero duplicate pod creates, zero lost
+    annotation writes (the restart-count write audit), zero mutations
+    from any replica that never led, and the kill→new-leader failover
+    times (recorded in PERF.md). (2) The split-brain drill: partition
+    the leader, let the standby steal the lease, and prove the deposed
+    leader's writes are REJECTED by the fence before reaching the wire.
+
+    Env knobs (the ctrl_chaos_bench_smoke CI entry shrinks the
+    geometry): KFTPU_BENCH_CTRL_{STEPS,OP_KILLS,SCHED_KILLS,PARTITIONS}.
+    """
+    import os
+    import shutil
+    import tempfile
+
+    t_start = time.perf_counter() if t_start is None else t_start
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.cluster.chaos import final_params
+    from kubeflow_tpu.scheduler.soak import (ControlPlaneSoak,
+                                             split_brain_drill)
+
+    steps = _env_int("KFTPU_BENCH_CTRL_STEPS", 8)
+    soak_kw = dict(
+        total_steps=steps, checkpoint_every=2,
+        operator_kills=_env_int("KFTPU_BENCH_CTRL_OP_KILLS", 3),
+        scheduler_kills=_env_int("KFTPU_BENCH_CTRL_SCHED_KILLS", 2),
+        partitions=_env_int("KFTPU_BENCH_CTRL_PARTITIONS", 2))
+    tmp = tempfile.mkdtemp(prefix="kftpu-ctrl-chaos-")
+    try:
+        t0 = time.perf_counter()
+        soak = ControlPlaneSoak(workdir=os.path.join(tmp, "soak"),
+                                **soak_kw)
+        report = soak.run()
+        soak_s = time.perf_counter() - t0
+        max_delta = float("nan")
+        if report["outcome"] == "succeeded":
+            clean = ControlPlaneSoak(workdir=os.path.join(tmp, "soak"),
+                                     **soak_kw).clean_params()
+            injected = final_params(report["checkpoint_dir"])
+            max_delta = max(jax.tree.leaves(jax.tree.map(
+                lambda a, b: float(np.max(np.abs(
+                    np.asarray(a) - np.asarray(b)))),
+                injected, clean)), default=0.0)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    drill = split_brain_drill()
+
+    kills = soak_kw["operator_kills"] + soak_kw["scheduler_kills"]
+    failovers = report["failovers"]
+    checks = {
+        "soak_succeeded": report["outcome"] == "succeeded",
+        "params_parity_ok": bool(max_delta <= 1e-5),
+        "operator_failovers_ok":
+            failovers["operator"] >= soak_kw["operator_kills"],
+        "scheduler_failovers_ok":
+            failovers["scheduler"] >= soak_kw["scheduler_kills"],
+        "partitions_ok":
+            report["partitions"] == soak_kw["partitions"],
+        "zero_duplicate_pod_creates":
+            report["duplicate_pod_creates"] == 0,
+        "zero_lost_annotation_writes":
+            not report["lost_annotation_writes"],
+        "zero_never_leader_mutations":
+            report["never_leader_mutations"] == 0,
+        "drill_stolen_by_standby": drill["stolen_by_standby"],
+        "drill_old_leader_demoted": drill["old_leader_demoted"],
+        "drill_fenced_write_rejected": drill["fenced_write_rejected"],
+        "drill_zero_zombie_writes":
+            drill["old_leader_writes_after_steal"] == 0
+            and not drill["zombie_write_landed"],
+        "drill_zero_doubled_pods": drill["doubled_pod_creates"] == 0,
+    }
+    failover_s = report["failover_s"]
+    assert all(checks.values()), {k: v for k, v in checks.items()
+                                  if not v}
+    return {
+        "metric": "ctrl_chaos_failover_p_max_s",
+        "value": round(max(failover_s), 3) if failover_s else 0.0,
+        "unit": "seconds",
+        "vs_baseline": None,
+        "mfu": None,
+        "extras": {
+            "checks": checks,
+            "soak": {
+                "outcome": report["outcome"],
+                "injected": report["injected"],
+                "operator_kills": soak_kw["operator_kills"],
+                "scheduler_kills": soak_kw["scheduler_kills"],
+                "partitions": report["partitions"],
+                "kills_total": kills,
+                "failovers": failovers,
+                "failover_s": failover_s,
+                "failover_mean_s": round(
+                    sum(failover_s) / len(failover_s), 3)
+                if failover_s else None,
+                "gang_restarts": report.get("gang_restarts"),
+                "segments": report["segments"],
+                "executed_steps": report["executed_steps"],
+                "duplicate_pod_creates":
+                    report["duplicate_pod_creates"],
+                "restart_count_writes": report["restart_count_writes"],
+                "binding_writes": report["binding_writes"],
+                "replicas_spawned": report["replicas_spawned"],
+                "never_leader_mutations":
+                    report["never_leader_mutations"],
+                "fenced_rejections": report["fenced_rejections"],
+                "final_params_max_abs_delta_vs_clean": max_delta,
+                "soak_wall_s": round(soak_s, 1),
+            },
+            "split_brain": drill,
+        },
+        "_flops_per_chip": 0.0,
+    }
+
+
 def bench_goodput(t_start: float | None = None) -> dict:
     """Goodput ledger + flight recorder acceptance (ISSUE 10).
 
@@ -2642,7 +2766,8 @@ def main(argv=None) -> int:
                    choices=["all", "resnet", "resnet-fused", "lm",
                             "lm-long", "serving", "serving-obs",
                             "serving-fleet", "fused-blocks",
-                            "weight-update", "chaos", "input", "sched",
+                            "weight-update", "chaos", "ctrl-chaos",
+                            "input", "sched",
                             "health", "obs", "goodput", "comm",
                             "warmstart", "warmstart-child"])
     p.add_argument("--routing-out",
@@ -2706,6 +2831,8 @@ def main(argv=None) -> int:
         row = bench_weight_update(t_start=t_start)
     elif args.mode == "chaos":
         row = bench_chaos(t_start=t_start)
+    elif args.mode == "ctrl-chaos":
+        row = bench_ctrl_chaos(t_start=t_start)
     elif args.mode == "input":
         row = bench_input(t_start=t_start)
     elif args.mode == "sched":

@@ -44,6 +44,9 @@ _WELL_KNOWN_KINDS = list(k8s.CLUSTER_SCOPED_KINDS) + [
     "ChainerJob", "MXJob", "PaddleJob", "Notebook", "PodDefault",
     "Workflow", "ScheduledWorkflow", "StudyJob", "KubebenchJob",
     "Application", "VirtualService", "Gateway",
+    # leader-election Leases (cluster/lease.py): HA controller replicas
+    # coordinate through the same wire surface everything else uses
+    "Lease",
 ]
 
 

@@ -9,9 +9,10 @@ reconciler logic (controller-runtime's client.Client).
 
 from __future__ import annotations
 
+import copy
 import queue
 from dataclasses import dataclass
-from typing import Iterable, Optional
+from typing import Callable, Iterable, Optional
 
 
 class KubeError(Exception):
@@ -145,3 +146,63 @@ class KubeClient:
                 self.delete(*k8s.key_of(obj))
             except NotFoundError:
                 pass
+
+
+def apply_annotations(obj: dict, updates: dict) -> dict:
+    """Fold an annotation-update map onto an object in place (the kube
+    null-delete convention: a None value REMOVES the key). The shape
+    every conflict-safe annotation writer's ``mutate`` uses, so patch
+    semantics and update semantics cannot drift."""
+    anns = obj.setdefault("metadata", {}).setdefault("annotations", {})
+    for key, value in updates.items():
+        if value is None:
+            anns.pop(key, None)
+        else:
+            anns[key] = value
+    return obj
+
+
+def update_with_conflict_retry(
+        client: KubeClient, api_version: str, kind: str, namespace: str,
+        name: str, mutate: Callable[[dict], Optional[dict]],
+        max_attempts: int = 5) -> dict:
+    """Optimistic-concurrency read-modify-write: re-read → re-apply
+    ``mutate`` → update with the read's resourceVersion as precondition;
+    a ConflictError (another writer landed in between) re-reads and
+    re-applies. THE write primitive for every annotation RMW in the
+    control plane (restart counters, bindings, resize histories, health
+    folds, final ledgers): a blind patch computes its value from a
+    possibly-stale read and silently loses the other writer's update —
+    this loses nothing, ever, at the price of a bounded retry.
+
+    ``mutate(obj)`` receives a deep copy of the FRESH object and returns
+    the object to write (mutating in place and returning it is fine), or
+    None to skip the write entirely (the decision is re-made per
+    attempt, so "already done" short-circuits are conflict-safe too).
+
+    NotFoundError propagates — callers that tolerate a deleted object
+    catch it, same as they would around a patch.
+    """
+    last: Optional[ConflictError] = None
+    for attempt in range(max_attempts):
+        obj = client.get(api_version, kind, namespace, name)
+        desired = mutate(copy.deepcopy(obj))
+        if desired is None:
+            return obj
+        desired.setdefault("metadata", {})["resourceVersion"] = \
+            obj.get("metadata", {}).get("resourceVersion")
+        try:
+            return client.update(desired)
+        except ConflictError as e:
+            last = e
+            # lazy import: obs is dependency-free but cluster/ must not
+            # grow import-time edges it does not need
+            from ..obs import registry as obsreg
+            obsreg.counter(
+                "kftpu_conflict_retries_total",
+                "read-modify-write attempts retried after a "
+                "resourceVersion conflict", labels=("kind",)).labels(
+                    kind=kind).inc()
+    raise last if last is not None else KubeError(
+        f"update_with_conflict_retry: no attempt made for "
+        f"{kind} {namespace}/{name}")

@@ -25,6 +25,7 @@ Modeled behavior:
 from __future__ import annotations
 
 import copy
+import re
 import threading
 from typing import Callable, Optional
 
@@ -84,8 +85,31 @@ class FakeCluster(KubeClient):
             key = c._key(obj)
             c._objects[key] = copy.deepcopy(obj)
         counters = snap.get("counters", {})
-        c._uid_n = counters.get("uid", 0)
-        c._rv_n = counters.get("rv", 0)
+        # Counter restoration is CORRECTNESS, not bookkeeping: a restored
+        # control plane that re-mints uid-1 collides trace ids (they are
+        # uid-derived) and a rewound rv counter re-issues resourceVersions
+        # watchers have already seen — orderings and conflict detection
+        # both break. A legacy snapshot without counters derives them from
+        # the objects' own high-water marks (an under-estimate only for
+        # DELETED objects' rvs, which the stored counter covers whenever
+        # it exists).
+        uid, rv = counters.get("uid"), counters.get("rv")
+        if uid is None or rv is None:
+            max_uid = max_rv = 0
+            for obj in c._objects.values():
+                meta = obj.get("metadata", {})
+                m = re.search(r"(\d+)$", str(meta.get("uid", "")))
+                if m:
+                    max_uid = max(max_uid, int(m.group(1)))
+                try:
+                    max_rv = max(max_rv,
+                                 int(meta.get("resourceVersion", 0)))
+                except (TypeError, ValueError):
+                    pass
+            uid = max_uid if uid is None else uid
+            rv = max_rv if rv is None else rv
+        c._uid_n = int(uid)
+        c._rv_n = int(rv)
         return c
 
     def _next_uid(self) -> str:

@@ -56,21 +56,26 @@ def _register_defaults() -> None:
 
 
 def build_manager(client, controllers: list[str],
-                  store_path: str = "") -> Manager:
+                  store_path: str = "", elector=None) -> Manager:
+    """``elector`` (cluster/lease.py LeaderElector) gates EVERY hosted
+    controller on one lease: the deployed unit of failover is the
+    manager process, so all its controllers lead or follow together."""
     _register_defaults()
     mgr = Manager(client)
+    kwargs = {"elector": elector} if elector is not None else {}
     for name in controllers:
         if name == "persistenceagent":
             # needs the run store (pipeline-apiserver shares the same file)
             from ..pipelines.store import PersistenceAgent, RunStore
-            mgr.add(PersistenceAgent(RunStore(store_path or ":memory:")))
+            mgr.add(PersistenceAgent(RunStore(store_path or ":memory:")),
+                    **kwargs)
             continue
         factory = CONTROLLER_FACTORIES.get(name)
         if factory is None:
             raise SystemExit(
                 f"unknown controller {name!r}; "
                 f"available: {sorted(CONTROLLER_FACTORIES) + ['persistenceagent']}")
-        mgr.add(factory())
+        mgr.add(factory(), **kwargs)
     return mgr
 
 
@@ -98,6 +103,24 @@ def main(argv=None) -> int:
                         "KFTPU_METRICS_PORT) — the scrape surface the "
                         "tpu-job-operator / tpu-scheduler manifests "
                         "annotate")
+    p.add_argument("--leader-elect", action="store_true",
+                   help="run behind a coordination.k8s.io Lease "
+                        "(cluster/lease.py): every replica watches, "
+                        "only the lease holder writes — the HA "
+                        "replicas: 2 deployments render this flag "
+                        "(docs/operations.md 'Control-plane HA')")
+    p.add_argument("--lease-name", default="kubeflow-tpu-manager",
+                   help="Lease object name (one per Deployment; the "
+                        "manifests pass the component's lease)")
+    p.add_argument("--lease-namespace", default="kubeflow",
+                   help="namespace the Lease lives in")
+    p.add_argument("--lease-duration", type=float, default=15.0,
+                   help="seconds a leader may go un-renewed before a "
+                        "standby steals the lease (failover bound)")
+    p.add_argument("--identity",
+                   default=os.environ.get("KFTPU_POD_NAME", ""),
+                   help="this replica's lease identity (default: "
+                        "KFTPU_POD_NAME, else hostname.pid)")
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args(argv)
 
@@ -116,7 +139,28 @@ def main(argv=None) -> int:
         p.error("--kubeconfig is required (or --fake)")
 
     names = [c.strip() for c in args.controllers.split(",") if c.strip()]
-    mgr = build_manager(client, names, store_path=args.store)
+    elector = None
+    if args.leader_elect:
+        import socket
+
+        from ..cluster.lease import FencedKubeClient, LeaderElector
+        identity = args.identity or f"{socket.gethostname()}.{os.getpid()}"
+        # the elector renews through the RAW client (fencing the lease
+        # writes themselves would deadlock re-election); everything the
+        # CONTROLLERS write goes through the fence — a deposed leader's
+        # in-flight reconcile dies at the client boundary, it cannot
+        # race its successor (the second, independent line of defense
+        # behind the pop-time leader gate)
+        elector = LeaderElector(
+            client=client, identity=identity, name=args.lease_name,
+            namespace=args.lease_namespace,
+            duration_s=args.lease_duration)
+        client = FencedKubeClient(client, elector)
+        log.info("leader election on: lease %s/%s identity %s "
+                 "(duration %.1fs)", args.lease_namespace,
+                 args.lease_name, identity, args.lease_duration)
+    mgr = build_manager(client, names, store_path=args.store,
+                        elector=elector)
     obs_server = None
     if args.metrics_port:
         from ..obs.http import ObsServer
@@ -132,6 +176,10 @@ def main(argv=None) -> int:
     stop.wait()
     log.info("shutting down")
     mgr.stop_all()
+    if elector is not None:
+        # graceful handoff: clear the lease so the standby takes over
+        # NOW instead of waiting out the lease duration
+        elector.release()
     if obs_server is not None:
         obs_server.stop()
     return 0

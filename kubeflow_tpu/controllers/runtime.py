@@ -20,6 +20,7 @@ Production drive: `start()` spins a daemon thread per controller.
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 from dataclasses import dataclass, field
@@ -34,9 +35,10 @@ log = logging.getLogger(__name__)
 
 def _reconcile_metrics(controller: str) -> tuple:
     """(latency histogram child, error counter child, queue-depth gauge
-    child) for one controller — the per-stage accounting every hosted
-    reconciler gets for free from the manager loop. Resolved once per
-    Controller and held (the registry's resolve-once hot-path rule)."""
+    child, retries-exhausted counter child) for one controller — the
+    per-stage accounting every hosted reconciler gets for free from the
+    manager loop. Resolved once per Controller and held (the registry's
+    resolve-once hot-path rule)."""
     labels = ("controller",)
     return (
         obsreg.histogram(
@@ -50,6 +52,12 @@ def _reconcile_metrics(controller: str) -> tuple:
         obsreg.gauge(
             "kftpu_workqueue_depth",
             "keys waiting in the controller workqueue",
+            labels=labels).labels(controller=controller),
+        obsreg.counter(
+            "kftpu_reconcile_retries_exhausted_total",
+            "keys given up on after max_retries failed reconciles "
+            "(invisible to alerting as a log line; the blind resync is "
+            "the only later recovery)",
             labels=labels).labels(controller=controller),
     )
 
@@ -170,6 +178,20 @@ class Controller:
     reconciler: Reconciler
     client: KubeClient
     max_retries: int = 5
+    # Error-requeue pacing: a failing reconcile re-enters the queue after
+    # a jittered exponential delay (base * 2^(attempt-1), capped) instead
+    # of immediately — a persistently failing key must not hot-loop
+    # through its whole retry budget in microseconds, hammering the
+    # apiserver with the same doomed writes. Jitter is seeded by
+    # (key, attempt) so retries are deterministic under test.
+    retry_backoff_s: float = 0.05
+    retry_backoff_max_s: float = 5.0
+    # Leader election (cluster/lease.py LeaderElector): when set, this
+    # controller processes keys ONLY while its elector holds the lease.
+    # Events keep pumping either way (a hot standby watches but does not
+    # write); on gaining leadership the full relist re-enqueues so the
+    # new leader adopts whatever happened while it was a follower.
+    elector: Optional[object] = None
     # Periodic full relist → enqueue (controller-runtime SyncPeriod
     # analog). A watch event lost in flight (stream drop, chaos-injected
     # fault, apiserver hiccup between reconnect and relist) would
@@ -249,7 +271,28 @@ class Controller:
 
     # -- execution ----------------------------------------------------------
 
+    _was_leader: bool = False
+
+    def _leader_gate(self) -> bool:
+        """True when this replica may reconcile (no elector = always).
+        A leadership GAIN triggers a full relist: keys that changed
+        while we were a follower may have been reconciled by the old
+        leader mid-flight — the new leader re-reads everything and
+        level-triggered reconciles converge it."""
+        if self.elector is None:
+            return True
+        leading = self.elector.ensure()
+        if leading and not self._was_leader:
+            try:
+                self.enqueue_existing()
+            except Exception as e:  # noqa: BLE001 — adopt is best-effort
+                log.warning("leader-gain relist failed: %s", e)
+        self._was_leader = leading
+        return leading
+
     def process_one(self) -> bool:
+        if not self._leader_gate():
+            return False
         key = self.queue.pop()
         if key is None:
             return False
@@ -261,7 +304,7 @@ class Controller:
             self._metrics = _reconcile_metrics(
                 getattr(self.reconciler, "controller_name", None)
                 or (self.reconciler.primary[1] or "unknown").lower())
-        latency, errors, depth = self._metrics
+        latency, errors, depth, exhausted = self._metrics
         t0 = time.perf_counter()
         try:
             res = self.reconciler.reconcile(self.client, key)
@@ -275,10 +318,20 @@ class Controller:
             n = self._retries.get(key, 0) + 1
             self._retries[key] = n
             if n <= self.max_retries:
-                log.warning("reconcile %s failed (retry %d/%d): %s",
-                            key, n, self.max_retries, e)
-                self.queue.add(key)
+                # jittered exponential backoff through the _delayed
+                # mechanism: an immediate re-add would burn the whole
+                # retry budget in one hot loop with zero time for the
+                # fault (an apiserver blip, a half-written sibling
+                # object) to clear
+                delay = min(self.retry_backoff_s * (2 ** (n - 1)),
+                            self.retry_backoff_max_s)
+                delay *= random.Random(f"{key}:{n}").uniform(1.0, 1.5)
+                log.warning("reconcile %s failed (retry %d/%d in "
+                            "%.3fs): %s", key, n, self.max_retries,
+                            delay, e)
+                self._delayed.append((time.monotonic() + delay, key))
             else:
+                exhausted.inc()
                 log.error("reconcile %s gave up after %d retries: %s",
                           key, self.max_retries, e)
         finally:
@@ -288,10 +341,15 @@ class Controller:
         return True
 
     def run_pending(self, max_iters: int = 1000) -> int:
-        """Deterministic drain: pump events + process until quiescent."""
+        """Deterministic drain: pump events + process until quiescent.
+        A follower (elector present, lease not held) pumps its watches
+        and returns — watching without writing is exactly the hot
+        standby's job."""
         done = 0
         for _ in range(max_iters):
             self.pump_events()
+            if self.elector is not None and not self._leader_gate():
+                break
             if not self.process_one():
                 self.pump_events()
                 if len(self.queue) == 0:

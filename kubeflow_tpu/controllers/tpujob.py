@@ -50,7 +50,8 @@ from ..api.trainingjob import (API_VERSIONS,
                                PREEMPTED_COUNT_ANNOTATION,
                                SCHED_REASON_ANNOTATION, SUSPECT_ANNOTATION,
                                ReplicaSpec, TrainingJob)
-from ..cluster.client import KubeClient, NotFoundError
+from ..cluster.client import (KubeClient, NotFoundError, apply_annotations,
+                              update_with_conflict_retry)
 from ..cluster.fake import POD_GROUP_LABEL, TPU_RESOURCE
 from ..obs import registry as obsreg
 from ..obs.trace import (SPAN_MAX_BYTES_ENV, SPAN_PATH_ENV,
@@ -132,7 +133,16 @@ class TrainingJobReconciler(Reconciler):
             manifest = client.get(self.primary[0], self.kind, namespace, name)
         except NotFoundError:
             self._export_phase(key, None)
-            return Result()  # cascade GC removed the children with the owner
+            # cascade GC removed the children with the owner — USUALLY.
+            # The crash-consistency hole: a reconcile that read the job
+            # just before its deletion creates pods just after the
+            # cascade already ran (or a controller died mid-create and
+            # its successor raced the delete) — orphans that pin TPU
+            # chips forever, because nothing owns them anymore. The
+            # orphan's own ADDED/MODIFIED event maps back to this key,
+            # so level-triggered cleanup lands here.
+            self._gc_orphans(client, namespace, name)
+            return Result()
         manifest = ensure_trace_id(client, manifest)
         self._export_phase(key, manifest)
         job = TrainingJob.from_manifest(manifest)
@@ -245,9 +255,13 @@ class TrainingJobReconciler(Reconciler):
                                     tpu_entries, binding=binding)
         if created:
             if tpu_names and shape_anno != shape:
-                manifest = client.patch(*k8s.key_of(manifest), {
-                    "metadata": {"annotations":
-                                 {GANG_SHAPE_ANNOTATION: shape}}})
+                # conflict-safe: the scheduler writes bindings/state on
+                # this same object concurrently — a stale-read update
+                # here must re-read, not clobber (cluster/client.py)
+                manifest = update_with_conflict_retry(
+                    client, *k8s.key_of(manifest),
+                    lambda obj: apply_annotations(
+                        obj, {GANG_SHAPE_ANNOTATION: shape}))
             self._set_condition(client, manifest, COND_CREATED, "True",
                                 "JobCreated", f"created {created} pods")
             if binding is not None:
@@ -409,9 +423,17 @@ class TrainingJobReconciler(Reconciler):
                     pass
             if job.checkpoint_dir and not job.resume_from:
                 # same resume loop as a gang restart: the re-bound gang
-                # continues from the forced preemption checkpoint
-                client.patch(*k8s.key_of(manifest),
-                             {"spec": {"resumeFrom": job.checkpoint_dir}})
+                # continues from the forced preemption checkpoint.
+                # Conflict-safe RMW: the scheduler is rewriting this
+                # object's annotations in the same window
+
+                def _set_resume(obj: dict, ckpt=job.checkpoint_dir):
+                    if obj.setdefault("spec", {}).get("resumeFrom"):
+                        return None   # already set by a sibling path
+                    obj["spec"]["resumeFrom"] = ckpt
+                    return obj
+                update_with_conflict_retry(client, *k8s.key_of(manifest),
+                                           _set_resume)
             self._set_condition(client, manifest, COND_RUNNING, "False",
                                 "Preempted" if preempted else "Unbound",
                                 "gang torn down; awaiting re-bind")
@@ -1063,37 +1085,63 @@ class TrainingJobReconciler(Reconciler):
                               k8s.name_of(p))
             except NotFoundError:
                 pass
-        patch: dict = {"metadata": {"annotations": {}}}
-        if count_restart:
-            patch["metadata"]["annotations"][RESTART_COUNT_ANNOTATION] = \
-                str(restarts + 1)
-        if suspect and job.scheduling_policy is not None:
-            # failure-domain-aware rebind: record the host this teardown
-            # is attributable to; the scheduler replans the binding
-            # EXCLUDING its cells (scheduler/core.py) so the gang
-            # migrates instead of crash-looping on the same hardware
-            patch["metadata"]["annotations"][SUSPECT_ANNOTATION] = suspect
         rp = job.run_policy
-        delay = 0.0
-        if count_restart and rp.restart_backoff_seconds > 0:
-            # exponential backoff + deterministic jitter (seeded by job
-            # identity and attempt, so reconcile retries compute the same
-            # schedule): spreads a fleet-wide preemption's restarts out
-            # instead of stampeding the scheduler/apiserver
-            delay = min(rp.restart_backoff_seconds * (2 ** restarts),
+        # mutable cell: the RMW below recomputes restarts/delay from the
+        # FRESH object each attempt, and the tail of this method needs
+        # the values the WINNING attempt actually wrote
+        applied = {"restarts": restarts, "delay": 0.0}
+
+        def _mutate(obj: dict) -> dict | None:
+            # recompute from the fresh read: a concurrent writer (the
+            # scheduler's binding/state rewrites, a sibling operator
+            # replica in a brief two-leader window) may have landed
+            # between our reconcile-start read and this write — the
+            # blind patch this replaces silently double-counted or lost
+            # the restart counter in exactly that interleaving
+            fresh_restarts = int(k8s.annotations_of(obj).get(
+                RESTART_COUNT_ANNOTATION, "0"))
+            applied["restarts"] = fresh_restarts
+            updates: dict = {}
+            if count_restart:
+                updates[RESTART_COUNT_ANNOTATION] = str(fresh_restarts + 1)
+            if suspect and job.scheduling_policy is not None:
+                # failure-domain-aware rebind: record the host this
+                # teardown is attributable to; the scheduler replans the
+                # binding EXCLUDING its cells (scheduler/core.py) so the
+                # gang migrates instead of crash-looping in place
+                updates[SUSPECT_ANNOTATION] = suspect
+            applied["delay"] = 0.0
+            if count_restart and rp.restart_backoff_seconds > 0:
+                # exponential backoff + deterministic jitter (seeded by
+                # job identity and attempt, so reconcile retries compute
+                # the same schedule): spreads a fleet-wide preemption's
+                # restarts out instead of stampeding the apiserver
+                d = min(rp.restart_backoff_seconds * (2 ** fresh_restarts),
                         rp.restart_backoff_max_seconds)
-            delay *= random.Random(
-                f"{job.namespace}/{job.name}:{restarts}").uniform(1.0, 1.5)
-            patch["metadata"]["annotations"][
-                RESTART_NOT_BEFORE_ANNOTATION] = f"{_now() + delay:.3f}"
-        if job.checkpoint_dir and not job.resume_from:
-            # close the resume loop: the recreated gang restores from the
-            # job's own checkpoints and continues from the last step
-            # (SURVEY §5 — checkpoint-resume makes gang restarts cheap)
-            patch["spec"] = {"resumeFrom": job.checkpoint_dir}
-        patched = client.patch(*k8s.key_of(manifest), patch) \
-            if (patch["metadata"]["annotations"] or "spec" in patch) \
-            else manifest
+                d *= random.Random(
+                    f"{job.namespace}/{job.name}:"
+                    f"{fresh_restarts}").uniform(1.0, 1.5)
+                applied["delay"] = d
+                updates[RESTART_NOT_BEFORE_ANNOTATION] = \
+                    f"{_now() + d:.3f}"
+            dirty = bool(updates)
+            apply_annotations(obj, updates)
+            if job.checkpoint_dir and \
+                    not obj.setdefault("spec", {}).get("resumeFrom"):
+                # close the resume loop: the recreated gang restores from
+                # the job's own checkpoints and continues from the last
+                # step (SURVEY §5 — checkpoint-resume makes gang
+                # restarts cheap)
+                obj["spec"]["resumeFrom"] = job.checkpoint_dir
+                dirty = True
+            return obj if dirty else None
+
+        try:
+            patched = update_with_conflict_retry(
+                client, *k8s.key_of(manifest), _mutate)
+        except NotFoundError:
+            return Result()   # job deleted mid-teardown: nothing to restart
+        restarts, delay = applied["restarts"], applied["delay"]
         if suspect and evidence:
             # fold the failure into the host's health score (the
             # quarantine feedback loop); best-effort by contract —
@@ -1144,6 +1192,34 @@ class TrainingJobReconciler(Reconciler):
         except NotFoundError:
             pass
         return Result()
+
+    def _gc_orphans(self, client: KubeClient, namespace: str,
+                    name: str) -> None:
+        """Reap children whose owner job no longer exists. The job-name
+        selector is the ownership scope (the same labels _base_pod
+        stamps); everything matching it after the owner's deletion is
+        an orphan pinning chips — delete it, count it."""
+        selector = {"kubeflow.org/job-name": name,
+                    "kubeflow.org/job-kind": self.kind.lower()}
+        reaped = 0
+        for kind_av in (("v1", "Pod"), ("v1", "Service")):
+            for obj in client.list(*kind_av, namespace,
+                                   selector=selector):
+                try:
+                    client.delete(*kind_av,
+                                  k8s.namespace_of(obj, namespace),
+                                  k8s.name_of(obj))
+                    reaped += 1
+                except NotFoundError:
+                    pass
+        if reaped:
+            obsreg.counter(
+                "kftpu_orphan_pods_gced_total",
+                "orphaned gang children reaped after their owner job "
+                "vanished (crash-consistency GC)",
+                labels=("kind",)).labels(kind=self.kind).inc(reaped)
+            log.info("gc: reaped %d orphaned children of %s/%s",
+                     reaped, namespace, name)
 
     def _cleanup_pods(self, client: KubeClient, job: TrainingJob,
                       pods: list[dict]) -> None:
@@ -1219,9 +1295,13 @@ class TrainingJobReconciler(Reconciler):
             namespace = k8s.namespace_of(manifest, "default")
             name = k8s.name_of(manifest)
             export_job_ledger(namespace, name, ledger)
-            client.patch(*k8s.key_of(manifest), {
-                "metadata": {"annotations": {
-                    GOODPUT_ANNOTATION: annotation_payload(ledger)}}})
+            # conflict-safe: the terminal transition window is busy
+            # (scheduler state writes, TTL bookkeeping) — the final
+            # ledger must neither lose nor clobber a concurrent write
+            update_with_conflict_retry(
+                client, *k8s.key_of(manifest),
+                lambda obj: apply_annotations(obj, {
+                    GOODPUT_ANNOTATION: annotation_payload(ledger)}))
             self._trace_event(manifest, "goodput-ledger",
                               goodput_ratio=ledger["goodputRatio"],
                               wall_seconds=ledger["wallSeconds"])

@@ -99,7 +99,10 @@ def _job_schema(specs_key: str, max_one: list[str]) -> dict:
 
 def _operator_deployment(namespace: str, gang_scheduling: bool,
                          shared_cache_root: str = "",
-                         span_max_bytes: int = 0) -> list[dict]:
+                         span_max_bytes: int = 0,
+                         replicas: int = 2,
+                         leader_elect: bool = True) -> list[dict]:
+    from ..cluster.lease import OPERATOR_LEASE
     sa = H.service_account("tpu-job-operator", namespace)
     role = H.cluster_role("tpu-job-operator", [
         {"apiGroups": ["tpu.kubeflow.org", "kubeflow.org"],
@@ -124,9 +127,29 @@ def _operator_deployment(namespace: str, gang_scheduling: bool,
             f"--metrics-port={METRICS_PORT}"]
     if gang_scheduling:
         args.append("--enable-gang-scheduling")
+    extra: list[dict] = []
+    if leader_elect:
+        # HA replica set: every replica watches, exactly one (the lease
+        # holder) writes — controllers/__main__.py gates on the lease
+        # named here (cluster/lease.py; identity = the pod name)
+        args += ["--leader-elect", f"--lease-name={OPERATOR_LEASE}",
+                 f"--lease-namespace={namespace}"]
+        # lease RBAC is NAMESPACED (the lease lives beside the
+        # deployment), like the scheduler's warm-pool role
+        extra = [
+            H.role("tpu-job-operator-leases", namespace, [
+                {"apiGroups": ["coordination.k8s.io"],
+                 "resources": ["leases"],
+                 "verbs": ["get", "list", "watch", "create", "update"]},
+            ]),
+            H.role_binding("tpu-job-operator-leases", namespace,
+                           "tpu-job-operator-leases",
+                           "tpu-job-operator", namespace),
+        ]
     dep = H.deployment("tpu-job-operator", namespace,
                        f"{IMG}/tpu-job-operator:{VERSION}", args=args,
                        service_account="tpu-job-operator", port=8443,
+                       replicas=replicas if leader_elect else 1,
                        pod_annotations=scrape_annotations(METRICS_PORT),
                        # shared compile-cache service: with the root set
                        # the operator points every gang of a namespace
@@ -145,14 +168,16 @@ def _operator_deployment(namespace: str, gang_scheduling: bool,
         "gang-scheduling": str(gang_scheduling).lower(),
         "coordinator-port": "8476",
     })
-    return [sa, role, binding, cm, dep]
+    return [sa, role, binding, *extra, cm, dep]
 
 
 @register("tpu-job-operator", "TPUJob CRD + the gang-scheduling operator")
 def tpu_job_operator(namespace: str = "kubeflow",
                      gang_scheduling: bool = True,
                      shared_cache_root: str = "",
-                     span_max_bytes: int = 0) -> list[dict]:
+                     span_max_bytes: int = 0,
+                     replicas: int = 2,
+                     leader_elect: bool = True) -> list[dict]:
     """``shared_cache_root`` (e.g. ``/mnt/kftpu-cache``) turns on the
     cluster-shared compile-cache service: the operator renders
     KFTPU_COMPILE_CACHE_DIR=<root>/<namespace> into every gang (one
@@ -163,12 +188,21 @@ def tpu_job_operator(namespace: str = "kubeflow",
     active file rotates to ``.1`` (one prior generation) so long-lived
     deployments never grow the sink unbounded; the operator forwards
     the cap into every worker (docs/operations.md "Goodput
-    accounting")."""
+    accounting").
+    ``replicas``/``leader_elect`` are the control-plane HA knobs
+    (docs/operations.md "Control-plane HA"): with leader election on
+    (the default) the operator runs ``replicas`` pods behind a
+    coordination.k8s.io Lease — every replica watches, only the lease
+    holder writes, and a crashed leader fails over within one lease
+    duration. ``leader_elect=False`` drops back to a single replica
+    (two un-elected replicas would double-drive every gang)."""
     job_crd = H.crd("tpujobs", "TPUJob", "tpu.kubeflow.org", ["v1alpha1"],
                     schema=_job_schema("replicaSpecs", ["Coordinator"]))
     return [job_crd, *_operator_deployment(namespace, gang_scheduling,
                                            shared_cache_root,
-                                           span_max_bytes)]
+                                           span_max_bytes,
+                                           replicas=replicas,
+                                           leader_elect=leader_elect)]
 
 
 @register("tpu-compile-cache", "Cluster-shared XLA compile-cache volume: "
@@ -261,7 +295,9 @@ def tpu_scheduler(namespace: str = "kubeflow",
                   grow: bool = True,
                   defrag: bool = True,
                   grow_cooldown_seconds: float = 300.0,
-                  warm_pods: int = 0) -> list[dict]:
+                  warm_pods: int = 0,
+                  replicas: int = 2,
+                  leader_elect: bool = True) -> list[dict]:
     """``queues`` is the SchedulerConfig wire shape
     (scheduler/queue.py), e.g. ``{"research": {"quotaChips":
     {"team-a": 32, "*": 64}}}`` — per-queue, per-namespace bound-chip
@@ -280,9 +316,13 @@ def tpu_scheduler(namespace: str = "kubeflow",
     the warm-pod pool (scheduler/warmpool.py): the scheduler keeps up
     to N pre-initialized pods on idle hosts and binds prefer adopting
     them — rebinds/resizes start warm (docs/operations.md "Warm starts
-    and the compile cache")."""
+    and the compile cache"). ``replicas``/``leader_elect``: the
+    control-plane HA knobs — see tpu_job_operator; the scheduler's
+    replicas elect through the tpu-scheduler Lease (cluster/lease.py,
+    docs/operations.md "Control-plane HA")."""
     import json
 
+    from ..cluster.lease import SCHEDULER_LEASE
     from ..scheduler.health import HealthConfig
     sa = H.service_account("tpu-scheduler", namespace)
     role = H.cluster_role("tpu-scheduler", [
@@ -326,13 +366,32 @@ def tpu_scheduler(namespace: str = "kubeflow",
             indent=1),
     })
     from .observability import METRICS_PORT, scrape_annotations
+    args = ["--controllers=scheduler",
+            f"--metrics-port={METRICS_PORT}"]
+    extra: list[dict] = []
+    if leader_elect:
+        # HA: N replicas, one lease holder writes (cluster/lease.py;
+        # controllers/__main__.py --leader-elect gates every hosted
+        # controller on the lease named here)
+        args += ["--leader-elect", f"--lease-name={SCHEDULER_LEASE}",
+                 f"--lease-namespace={namespace}"]
+        extra = [
+            H.role("tpu-scheduler-leases", namespace, [
+                {"apiGroups": ["coordination.k8s.io"],
+                 "resources": ["leases"],
+                 "verbs": ["get", "list", "watch", "create", "update"]},
+            ]),
+            H.role_binding("tpu-scheduler-leases", namespace,
+                           "tpu-scheduler-leases",
+                           "tpu-scheduler", namespace),
+        ]
     dep = H.deployment("tpu-scheduler", namespace,
                        f"{IMG}/tpu-job-operator:{VERSION}",
-                       args=["--controllers=scheduler",
-                             f"--metrics-port={METRICS_PORT}"],
+                       args=args,
                        service_account="tpu-scheduler", port=8443,
+                       replicas=replicas if leader_elect else 1,
                        pod_annotations=scrape_annotations(METRICS_PORT))
-    return [sa, role, binding, warm_role, warm_binding, cm, dep]
+    return [sa, role, binding, warm_role, warm_binding, *extra, cm, dep]
 
 
 @register("openmpi-controller", "Slice-sidecar config: lifecycle hooks for "

@@ -52,7 +52,8 @@ from ..api.trainingjob import (BINDING_ANNOTATION, COND_FAILED,
                                SCHED_REASON_ANNOTATION,
                                SCHED_STATE_ANNOTATION, SUSPECT_ANNOTATION,
                                TPU_API_VERSION, TrainingJob)
-from ..cluster.client import KubeClient, NotFoundError
+from ..cluster.client import (KubeClient, NotFoundError, apply_annotations,
+                              update_with_conflict_retry)
 from ..controllers.runtime import (Key, Reconciler, Result,
                                    ensure_trace_id, trace_job_event)
 from ..obs import registry as obsreg
@@ -649,12 +650,20 @@ class SliceScheduler(Reconciler):
                 log.info("scheduler: health disabled; releasing %s "
                          "from quarantine", name)
             if patch_val is not _UNSET:
-                body: dict = {"metadata": {"annotations": {
-                    QUARANTINE_ANNOTATION: patch_val}}}
-                if cordon is not None:
-                    body["spec"] = {"unschedulable": cordon}
+                # conflict-safe: the operator folds health evidence onto
+                # this same node concurrently — a stale-read write here
+                # re-reads and re-applies instead of clobbering the fold
+                def _mutate(obj: dict, patch_val=patch_val,
+                            cordon=cordon) -> dict:
+                    apply_annotations(obj, {QUARANTINE_ANNOTATION:
+                                            patch_val})
+                    if cordon is not None:
+                        obj.setdefault("spec", {})["unschedulable"] = \
+                            cordon
+                    return obj
                 try:
-                    node = client.patch("v1", "Node", "", name, body)
+                    node = update_with_conflict_retry(
+                        client, "v1", "Node", "", name, _mutate)
                 except Exception as e:  # noqa: BLE001 — health writes
                     # must never take down the scheduling pass
                     log.warning("scheduler: quarantine patch for %s "
@@ -820,21 +829,26 @@ class SliceScheduler(Reconciler):
             extra = {SUSPECT_ANNOTATION: None} \
                 if health.suspect_of(manifests[req.key]) else {}
             resized = placement.chips != req.chips
+            extra_fn = None
             if resized:
                 # a non-nominal bind IS the resize — below nominal it is
                 # shrink-to-survive, above it a grow folded into the
                 # bind (gang placed straight into idle capacity) —
                 # recorded on the history annotation so dashboards and
-                # the grow cooldown see it
+                # the grow cooldown see it (extra_fn: appended onto the
+                # FRESH object's history per write attempt)
                 reason = ("shrink: degraded bind (no nominal rectangle "
                           "free)" if placement.chips < req.chips else
                           "grow: bound above nominal into idle capacity")
-                extra[RESIZE_HISTORY_ANNOTATION] = self._history_json(
-                    manifests[req.key], req.chips, placement.chips,
-                    reason, now)
+                extra_fn = (lambda obj, req=req, placement=placement,
+                            reason=reason, now=now: {
+                                RESIZE_HISTORY_ANNOTATION:
+                                self._history_json(
+                                    obj, req.chips, placement.chips,
+                                    reason, now)})
             self._patch_state(client, manifests[req.key], STATE_BOUND,
                               "bound", binding=placement,
-                              extra=extra or None)
+                              extra=extra or None, extra_fn=extra_fn)
             if resized:
                 self._count_resize(manifests[req.key], req.chips,
                                    placement.chips, reason)
@@ -971,23 +985,49 @@ class SliceScheduler(Reconciler):
 
     def _patch_state(self, client: KubeClient, manifest: dict, state: str,
                      reason: str, binding: Optional[Placement],
-                     extra: Optional[dict] = None) -> None:
-        annotations: dict = {SCHED_STATE_ANNOTATION: state,
+                     extra: Optional[dict] = None,
+                     extra_fn=None) -> None:
+        """Conflict-safe state write (cluster/client.py
+        update_with_conflict_retry): the operator bumps restart counters
+        and gang shapes on the SAME object concurrently — a stale-read
+        write here must re-read and re-apply, never clobber.
+        ``extra_fn(fresh_obj) -> annotation updates`` computes values
+        that depend on the object's CURRENT state (preempt counts,
+        resize histories) per attempt, so a retry never replays a stale
+        read. Write-on-change: an object already in the desired state is
+        left untouched (no MODIFIED event, no reconcile loop)."""
+
+        def _mutate(obj: dict) -> Optional[dict]:
+            updates: dict = {SCHED_STATE_ANNOTATION: state,
                              SCHED_REASON_ANNOTATION: reason,
                              **(extra or {})}
-        # kube null-delete semantics: a removed binding patches to None
-        annotations[BINDING_ANNOTATION] = (
-            json.dumps(binding.to_dict()) if binding is not None else None)
+            if extra_fn is not None:
+                updates.update(extra_fn(obj))
+            # kube null-delete semantics: a removed binding writes None
+            updates[BINDING_ANNOTATION] = (
+                json.dumps(binding.to_dict())
+                if binding is not None else None)
+            anns = k8s.annotations_of(obj)
+            dirty = any(
+                (value is None and key in anns)
+                or (value is not None and anns.get(key) != value)
+                for key, value in updates.items())
+            return apply_annotations(obj, updates) if dirty else None
+
         try:
-            client.patch(*k8s.key_of(manifest),
-                         {"metadata": {"annotations": annotations}})
+            update_with_conflict_retry(client, *k8s.key_of(manifest),
+                                       _mutate)
         except NotFoundError:
             pass   # deleted mid-pass: the delete event re-plans anyway
 
     def _clear_suspect(self, client: KubeClient, manifest: dict) -> None:
+        def _mutate(obj: dict) -> Optional[dict]:
+            if SUSPECT_ANNOTATION not in k8s.annotations_of(obj):
+                return None   # already cleared by a concurrent pass
+            return apply_annotations(obj, {SUSPECT_ANNOTATION: None})
         try:
-            client.patch(*k8s.key_of(manifest), {
-                "metadata": {"annotations": {SUSPECT_ANNOTATION: None}}})
+            update_with_conflict_retry(client, *k8s.key_of(manifest),
+                                       _mutate)
         except NotFoundError:
             pass   # deleted mid-pass: nothing left to clear
 
@@ -1042,8 +1082,12 @@ class SliceScheduler(Reconciler):
         self._patch_state(
             client, manifest, STATE_BOUND, f"resized: {reason}",
             binding=new_placement,
-            extra={RESIZE_HISTORY_ANNOTATION: self._history_json(
-                manifest, from_chips, new_placement.chips, reason, now)})
+            # history APPENDS, so it must be computed from the object
+            # as-written: a retry against a concurrently-updated history
+            # re-reads and re-appends instead of dropping entries
+            extra_fn=lambda obj: {
+                RESIZE_HISTORY_ANNOTATION: self._history_json(
+                    obj, from_chips, new_placement.chips, reason, now)})
         # counted AFTER the patch succeeded (the pass-wide invariant)
         self._count_resize(manifest, from_chips, new_placement.chips,
                            reason)
@@ -1056,10 +1100,13 @@ class SliceScheduler(Reconciler):
         """Unbind a victim: the operator observes the missing binding and
         tears the gang down through the graceful path, leaving the job
         QUEUED with resumeFrom set — preemption is a requeue, never a
-        failure (no backoff budget burned)."""
-        count = int(k8s.annotations_of(manifest).get(
-            PREEMPTED_COUNT_ANNOTATION, "0")) + 1
+        failure (no backoff budget burned). The count increments off the
+        FRESH read per attempt (extra_fn), so a concurrent writer can
+        never make one preemption read as zero or two."""
         self._patch_state(
             client, manifest, STATE_PREEMPTED,
             "preempted by a higher-priority job", binding=None,
-            extra={PREEMPTED_COUNT_ANNOTATION: str(count)})
+            extra_fn=lambda obj: {
+                PREEMPTED_COUNT_ANNOTATION: str(int(
+                    k8s.annotations_of(obj).get(
+                        PREEMPTED_COUNT_ANNOTATION, "0")) + 1)})

@@ -13,8 +13,10 @@ the shared vocabulary of that feedback loop:
   in (``fold_event``): the operator attributes pod crashes / stalled
   workers / step-time skew to the host they ran on; the scheduler folds
   Ready-condition flaps. The annotation itself carries ``(score, time)``
-  so any writer can decay-then-add without shared clocks (see
-  record_host_event for the concurrent-fold caveat) — the decay is
+  so any writer can decay-then-add without shared clocks — and the fold
+  is conflict-safe: record_host_event rides
+  cluster/client.py update_with_conflict_retry, so concurrent folds
+  both land. The decay is
   the forgiveness: a host that stops failing earns its way back.
 - **Quarantine.** When a host's decayed score crosses
   ``HealthConfig.quarantine_threshold`` the scheduler writes the
@@ -194,29 +196,32 @@ def fold_event(rec: dict, kind: str, now: float,
 def record_host_event(client, node_name: str, kind: str,
                       job_key: str = "", now: Optional[float] = None,
                       half_life_s: float = 600.0) -> Optional[dict]:
-    """Fold one failure event into a node's health annotation
-    (read-modify-write through the apiserver). Best-effort by contract:
-    evidence recording must never block a recovery path — any error
-    logs and returns None.
-
-    Concurrency: the RMW carries no resourceVersion precondition, so
-    two writers folding the SAME instant (operator recording a crash
-    while the scheduler folds a flap) can lose one event. Accepted
-    deliberately: evidence is additive-and-decaying — a lost fold
-    delays a quarantine by one event, never corrupts the record, and a
-    genuinely bad host keeps producing evidence. The patch surface has
-    no preconditions to build on; if that changes, guard this write."""
+    """Fold one failure event into a node's health annotation —
+    conflict-safe (cluster/client.py update_with_conflict_retry): the
+    fold recomputes off the FRESH record per attempt and the write
+    carries the read's resourceVersion, so two writers folding the
+    same instant (operator recording a crash while the scheduler folds
+    a flap) both land — the blind-patch version of this RMW could lose
+    one. Still best-effort by contract: evidence recording must never
+    block a recovery path — any error logs and returns None."""
+    from ..cluster.client import apply_annotations, update_with_conflict_retry
     now = time.time() if now is None else now
-    try:
-        node = client.get("v1", "Node", "", node_name)
-        rec = fold_event(health_of(node), kind, now,
+    out: dict = {}
+
+    def _mutate(obj: dict) -> dict:
+        rec = fold_event(health_of(obj), kind, now,
                          half_life_s=half_life_s)
-        client.patch("v1", "Node", "", node_name, {
-            "metadata": {"annotations": {
-                HEALTH_ANNOTATION: json.dumps(rec)}}})
+        out.clear()
+        out.update(rec)
+        return apply_annotations(obj, {HEALTH_ANNOTATION:
+                                       json.dumps(rec)})
+
+    try:
+        update_with_conflict_retry(client, "v1", "Node", "", node_name,
+                                   _mutate)
         log.info("health: %s on %s (job %s) -> score %.2f",
-                 kind, node_name, job_key or "?", rec["score"])
-        return rec
+                 kind, node_name, job_key or "?", out["score"])
+        return dict(out)
     except Exception as e:  # noqa: BLE001 — evidence must not kill recovery
         log.warning("health: recording %s on %s failed: %s",
                     kind, node_name, e)

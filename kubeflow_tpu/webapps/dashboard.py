@@ -27,6 +27,8 @@ Routes:
                                             ICI bytes/step, per-link
                                             collective mix, full-reshard
                                             verdict)
+  GET /api/obs/controlplane                (HA leases: current leaders,
+                                            lease age, transitions)
   GET /healthz
 """
 
@@ -529,6 +531,41 @@ def build_dashboard_app(client: KubeClient,
                                  f"({SPAN_PATH_ENV} unset)",
                          "requests": 0}
         return 200, fleet_rollup(span_path)
+
+    @app.route("GET", "/api/obs/controlplane")
+    def controlplane_obs(params, query, body):
+        """Control-plane HA state (cluster/lease.py): every Lease in
+        the cluster — current holder, lease age (now − renewTime),
+        duration, expired flag, and the transitions count (the fencing
+        token; each increment is one failover). The panel operators
+        read when "is anything leading the scheduler right now" is the
+        question (docs/operations.md "Control-plane HA")."""
+        import time as _time
+
+        from ..cluster.client import KubeError
+        from ..cluster.lease import (LEASE_API_VERSION, LEASE_KIND,
+                                     lease_record)
+        now = _time.time()
+        leases = []
+        try:
+            objs = client.list(LEASE_API_VERSION, LEASE_KIND)
+        except KubeError:
+            objs = []
+        for obj in objs:
+            rec = lease_record(obj)
+            leases.append({
+                "namespace": k8s.namespace_of(obj, "default"),
+                "name": k8s.name_of(obj),
+                "holder": rec.holder,
+                "ageSeconds": round(max(0.0, now - rec.renew_time), 3)
+                if rec.renew_time else None,
+                "durationSeconds": rec.duration_s,
+                "transitions": rec.transitions,
+                "expired": rec.expired(now),
+            })
+        return 200, {"leases": sorted(leases,
+                                      key=lambda r: (r["namespace"],
+                                                     r["name"]))}
 
     @app.route("GET", "/api/obs/comm/{namespace}/{name}")
     def comm_obs(params, query, body):

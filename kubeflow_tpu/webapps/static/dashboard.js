@@ -282,10 +282,34 @@
       "Pod resource requests and per-node scheduling pressure"],
   ];
 
+  // control-plane HA panel (/api/obs/controlplane — the ISSUE 14
+  // panel): one row per lease — who leads each controller deployment,
+  // how fresh its claim is, and how many failovers (transitions) the
+  // lease has seen. An EXPIRED lease is the "nothing is leading the
+  // scheduler" alarm, flagged with a badge, never color alone.
+  function controlPlanePanel(data) {
+    const leases = (data && data.leases) || [];
+    if (!leases.length) return [];
+    const rows = leases.map((l) => ({
+      lease: `${l.namespace}/${l.name}`,
+      leader: l.holder || "(none)",
+      "lease age": l.ageSeconds == null ? "" : `${l.ageSeconds}s`,
+      duration: `${l.durationSeconds}s`,
+      failovers: Math.max(0, (l.transitions || 1) - 1),
+      state: l.expired ? "✗ expired — no leader" : "✓ held",
+    }));
+    return [
+      el("h2", { text: "Control plane" }),
+      table(rows, ["lease", "leader", "lease age", "duration",
+                   "failovers", "state"]),
+    ];
+  }
+
   async function viewOverview(root) {
-    const [slices, nodes, runs] = await Promise.all([
+    const [slices, nodes, runs, controlplane] = await Promise.all([
       api("api/tpu/slices"), api("api/metrics/node"),
       api(`api/runs/${encodeURIComponent(selectedNamespace())}`),
+      api("api/obs/controlplane").catch(() => ({ leases: [] })),
     ]);
     const chips = slices.reduce((s, p) => s + p.chips, 0);
     const hosts = slices.reduce((s, p) => s + p.hosts, 0);
@@ -304,6 +328,7 @@
         statTile("Cluster nodes", nodes.length),
         statTile("Active runs", active),
       ]),
+      ...controlPlanePanel(controlplane),
       el("h2", { text: "TPU slices" }),
       slices.length
         ? table(slices, ["topology", "accelerator", "hosts", "chips",

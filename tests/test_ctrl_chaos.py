@@ -1,0 +1,950 @@
+"""Control-plane fault tolerance (ISSUE 14): leader election over Lease
+objects, conflict-safe read-modify-writes, controller crash points,
+orphan reconciliation, and the split-brain fence.
+
+Fast tier (-m ctrl_chaos): lease/elector/fencing semantics, the
+update_with_conflict_retry contract, a two-writer interleaving test per
+migrated RMW site, the ConflictError contract at the HTTP apiserver
+boundary, snapshot counter preservation, error-requeue backoff, and the
+seeded controller kill-points. Slow tier: the ControlPlaneSoak with real
+training segments (bench.py --mode ctrl-chaos runs the full menu).
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.api import k8s
+from kubeflow_tpu.api.trainingjob import (BINDING_ANNOTATION,
+                                          PREEMPTED_COUNT_ANNOTATION,
+                                          RESIZE_HISTORY_ANNOTATION)
+from kubeflow_tpu.cluster import lease as L
+from kubeflow_tpu.cluster.chaos import (ControllerChaos, ControllerCrash,
+                                        RecordingKubeClient,
+                                        TransientAPIError)
+from kubeflow_tpu.cluster.client import (ConflictError, NotFoundError,
+                                         apply_annotations,
+                                         update_with_conflict_retry)
+from kubeflow_tpu.cluster.fake import FakeCluster
+from kubeflow_tpu.controllers.runtime import Controller, Manager
+from kubeflow_tpu.controllers.tpujob import (RESTART_COUNT_ANNOTATION,
+                                             TrainingJobReconciler)
+from kubeflow_tpu.obs import registry as obsreg
+from kubeflow_tpu.scheduler.core import SliceScheduler
+from kubeflow_tpu.scheduler import health
+from kubeflow_tpu.scheduler.queue import SchedulerConfig, binding_of
+
+pytestmark = pytest.mark.ctrl_chaos
+
+TPU_AV = "tpu.kubeflow.org/v1alpha1"
+
+
+def tpujob_manifest(name="train", scheduled=False, **spec_extra):
+    spec = {
+        "checkpointDir": f"/ckpt/{name}",
+        "replicaSpecs": {"TPU": {
+            "tpuTopology": "v5e-8",
+            "template": {"spec": {"containers": [
+                {"name": "jax", "image": "trainer:v1"}]}}}},
+        "runPolicy": {"backoffLimit": 5},
+        **spec_extra,
+    }
+    if scheduled:
+        spec["schedulingPolicy"] = {"queue": "research", "priority": 0,
+                                    "preemptible": True}
+    return {"apiVersion": TPU_AV, "kind": "TPUJob",
+            "metadata": {"name": name, "namespace": "kubeflow"},
+            "spec": spec}
+
+
+def drive(cluster, mgr, ticks=3):
+    for _ in range(ticks):
+        mgr.run_pending()
+        cluster.tick()
+        due = [t for c in mgr.controllers for (t, _k) in c._delayed]
+        wait = min(due, default=0.0) - time.monotonic()
+        if 0 < wait <= 1.0:
+            time.sleep(wait + 0.005)
+    mgr.run_pending()
+
+
+# ------------------------------------------------------------ the lease
+
+
+class TestLeaseContract:
+    def test_acquire_creates_lease_with_fencing_token_1(self):
+        cluster = FakeCluster()
+        res = L.try_acquire(cluster, "kubeflow", "op", "a", 15.0, now=100.0)
+        assert res.acquired and res.record.transitions == 1
+        obj = cluster.get(L.LEASE_API_VERSION, L.LEASE_KIND,
+                          "kubeflow", "op")
+        assert obj["spec"][L.HOLDER_FIELD] == "a"
+
+    def test_renew_keeps_token_steal_bumps_it(self):
+        cluster = FakeCluster()
+        L.try_acquire(cluster, "kubeflow", "op", "a", 10.0, now=100.0)
+        renewed = L.try_acquire(cluster, "kubeflow", "op", "a", 10.0,
+                                now=105.0)
+        assert renewed.acquired and renewed.record.transitions == 1
+        # not expired: b cannot take it
+        held = L.try_acquire(cluster, "kubeflow", "op", "b", 10.0,
+                             now=110.0)
+        assert not held.acquired and held.reason == "held"
+        # expired: b steals, token bumps — the old holder's writes are
+        # orderable as stale by anyone comparing tokens
+        stolen = L.try_acquire(cluster, "kubeflow", "op", "b", 10.0,
+                               now=120.0)
+        assert stolen.acquired and stolen.record.transitions == 2
+
+    def test_concurrent_steal_has_exactly_one_winner(self):
+        """The race the rv precondition exists for: two standbys see the
+        same expired lease; the second update must 409 and lose."""
+        cluster = FakeCluster()
+        L.try_acquire(cluster, "kubeflow", "op", "dead", 1.0, now=0.0)
+
+        class Racer:
+            """Injects competitor b's steal between a's get and update.
+            Deliberately NOT a KubeClient subclass: the base class's
+            stub methods would shadow __getattr__ delegation."""
+
+            def __init__(self, inner):
+                self.inner = inner
+                self.armed = True
+
+            def get(self, *a, **kw):
+                out = self.inner.get(*a, **kw)
+                if self.armed and a[1] == L.LEASE_KIND:
+                    self.armed = False
+                    assert L.try_acquire(self.inner, "kubeflow", "op",
+                                         "b", 10.0, now=100.0).acquired
+                return out
+
+            def __getattr__(self, name):
+                return getattr(self.inner, name)
+
+        res = L.try_acquire(Racer(cluster), "kubeflow", "op", "a", 10.0,
+                            now=100.0)
+        assert not res.acquired and res.reason == "lost-race"
+        rec = L.lease_record(cluster.get(L.LEASE_API_VERSION,
+                                         L.LEASE_KIND, "kubeflow", "op"))
+        assert rec.holder == "b" and rec.transitions == 2
+
+    def test_release_frees_the_lease_immediately(self):
+        cluster = FakeCluster()
+        L.try_acquire(cluster, "kubeflow", "op", "a", 300.0, now=100.0)
+        assert L.release(cluster, "kubeflow", "op", "a")
+        res = L.try_acquire(cluster, "kubeflow", "op", "b", 300.0,
+                            now=101.0)
+        assert res.acquired   # no waiting out the 300s duration
+        # releasing a lease someone else holds is a no-op
+        assert not L.release(cluster, "kubeflow", "op", "a")
+
+    def test_malformed_lease_reads_as_free(self):
+        cluster = FakeCluster()
+        cluster.create({"apiVersion": L.LEASE_API_VERSION,
+                        "kind": L.LEASE_KIND,
+                        "metadata": {"name": "op",
+                                     "namespace": "kubeflow"},
+                        "spec": {L.DURATION_FIELD: "garbage"}})
+        assert L.try_acquire(cluster, "kubeflow", "op", "a", 10.0,
+                             now=100.0).acquired
+
+
+class TestLeaderElector:
+    def test_leader_follows_and_fails_over(self):
+        cluster = FakeCluster()
+        chaos_a = ControllerChaos(cluster)
+        a = L.LeaderElector(client=chaos_a, identity="a", name="op",
+                            duration_s=0.2)
+        b = L.LeaderElector(client=cluster, identity="b", name="op",
+                            duration_s=0.2)
+        assert a.ensure() and not b.ensure()
+        # a dies (its client raises everywhere): no renew possible —
+        # local expiry demotes it, b steals after the duration
+        chaos_a.kill()
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline and not b.ensure():
+            time.sleep(0.05)
+        assert b.is_leader and not a.ensure()
+        assert b.token > a.token   # the fencing token moved on
+
+    def test_graceful_release_hands_over_without_waiting(self):
+        cluster = FakeCluster()
+        a = L.LeaderElector(client=cluster, identity="a", name="op",
+                            duration_s=300.0)
+        b = L.LeaderElector(client=cluster, identity="b", name="op",
+                            duration_s=300.0, renew_every_s=0.01)
+        assert a.ensure() and not b.ensure()
+        a.release()
+        time.sleep(0.02)
+        assert b.ensure()   # immediately, not after 300s
+
+
+class TestFencedClient:
+    def test_non_leader_mutations_rejected_reads_pass(self):
+        cluster = FakeCluster()
+        cluster.create(tpujob_manifest())
+        follower = L.LeaderElector(client=cluster, identity="b",
+                                   name="op", duration_s=0.2)
+        # someone else holds the lease
+        L.try_acquire(cluster, "kubeflow", "op", "a", 300.0)
+        follower.ensure()
+        fenced = L.FencedKubeClient(cluster, follower)
+        assert fenced.get(TPU_AV, "TPUJob", "kubeflow", "train")
+        assert fenced.list(TPU_AV, "TPUJob")
+        with pytest.raises(L.FencingError):
+            fenced.patch(TPU_AV, "TPUJob", "kubeflow", "train",
+                         {"metadata": {"annotations": {"x": "1"}}})
+        with pytest.raises(L.FencingError):
+            fenced.delete(TPU_AV, "TPUJob", "kubeflow", "train")
+        assert fenced.rejected == 2
+        # nothing reached the cluster
+        job = cluster.get(TPU_AV, "TPUJob", "kubeflow", "train")
+        assert "x" not in k8s.annotations_of(job)
+
+
+# ----------------------------------------------- conflict-safe writes
+
+
+class InterleavingClient:
+    """Wrapper that fires a competing write immediately BEFORE the
+    caller's first update of the target object: the caller's
+    resourceVersion is then guaranteed stale, forcing the
+    ConflictError → re-read → re-apply path every migrated RMW site
+    must survive. ``compete(inner, obj)`` runs exactly once. (Plain
+    class, not a KubeClient subclass — the base stubs would shadow
+    __getattr__ delegation.)"""
+
+    def __init__(self, inner, kind, name, compete):
+        self.inner = inner
+        self._kind, self._name = kind, name
+        self._compete = compete
+        self.fired = False
+
+    def update(self, obj):
+        if not self.fired and obj.get("kind") == self._kind and \
+                k8s.name_of(obj) == self._name:
+            self.fired = True
+            self._compete(self.inner, obj)
+        return self.inner.update(obj)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def _competing_annotation(inner, obj):
+    """The competitor: a full-object update stamping its own annotation
+    (what another controller replica's conflict-free write looks
+    like). The site under test must retry and PRESERVE this."""
+    fresh = inner.get(*k8s.key_of(obj))
+    fresh.setdefault("metadata", {}).setdefault(
+        "annotations", {})["competitor/wrote"] = "1"
+    inner.update(fresh)
+
+
+class TestUpdateWithConflictRetry:
+    def test_retries_preserve_both_writers(self):
+        cluster = FakeCluster()
+        cluster.create(tpujob_manifest())
+        client = InterleavingClient(cluster, "TPUJob", "train",
+                                    _competing_annotation)
+        before = obsreg.counter(
+            "kftpu_conflict_retries_total",
+            "read-modify-write attempts retried after a "
+            "resourceVersion conflict", labels=("kind",)).labels(
+                kind="TPUJob").value
+        update_with_conflict_retry(
+            client, TPU_AV, "TPUJob", "kubeflow", "train",
+            lambda obj: apply_annotations(obj, {"mine": "yes"}))
+        job = cluster.get(TPU_AV, "TPUJob", "kubeflow", "train")
+        anns = k8s.annotations_of(job)
+        assert anns["mine"] == "yes"
+        assert anns["competitor/wrote"] == "1"   # nothing lost
+        after = obsreg.counter(
+            "kftpu_conflict_retries_total",
+            "read-modify-write attempts retried after a "
+            "resourceVersion conflict", labels=("kind",)).labels(
+                kind="TPUJob").value
+        assert after == before + 1
+
+    def test_none_skips_the_write(self):
+        cluster = FakeCluster()
+        cluster.create(tpujob_manifest())
+        rv = cluster.get(TPU_AV, "TPUJob", "kubeflow",
+                         "train")["metadata"]["resourceVersion"]
+        update_with_conflict_retry(cluster, TPU_AV, "TPUJob", "kubeflow",
+                                   "train", lambda obj: None)
+        assert cluster.get(TPU_AV, "TPUJob", "kubeflow",
+                           "train")["metadata"]["resourceVersion"] == rv
+
+    def test_not_found_propagates(self):
+        with pytest.raises(NotFoundError):
+            update_with_conflict_retry(FakeCluster(), TPU_AV, "TPUJob",
+                                       "kubeflow", "gone",
+                                       lambda obj: obj)
+
+    def test_persistent_conflict_raises_after_budget(self):
+        cluster = FakeCluster()
+        cluster.create(tpujob_manifest())
+
+        class AlwaysConflict:
+            def __init__(self, inner):
+                self.inner = inner
+
+            def get(self, *a, **kw):
+                out = self.inner.get(*a, **kw)
+                _competing_annotation(self.inner, out)
+                return out
+
+            def __getattr__(self, name):
+                return getattr(self.inner, name)
+
+        with pytest.raises(ConflictError):
+            update_with_conflict_retry(
+                AlwaysConflict(cluster), TPU_AV, "TPUJob", "kubeflow",
+                "train",
+                lambda obj: apply_annotations(obj, {"mine": "1"}),
+                max_attempts=3)
+
+
+def _make_operator_env(scheduled=False):
+    cluster = FakeCluster()
+    cluster.add_tpu_slice_nodes("v5e-8")
+    mgr = Manager(cluster)
+    ctrl = mgr.add(TrainingJobReconciler("TPUJob"))
+    cluster.create(tpujob_manifest(scheduled=scheduled))
+    if scheduled:
+        mgr.add(SliceScheduler(SchedulerConfig()))
+    drive(cluster, mgr)
+    return cluster, mgr, ctrl
+
+
+class TestMigratedSitesTwoWriterInterleaving:
+    """One test per migrated RMW writer: a competitor lands between the
+    site's read and write; the site must retry and both updates must
+    survive (acceptance criterion: no lost update, anywhere)."""
+
+    def test_operator_restart_count(self):
+        cluster, mgr, ctrl = _make_operator_env()
+        # the competitor bumps the restart count itself — the classic
+        # double-writer counter race (two operator replicas, a brief
+        # two-leader window)
+        def compete(inner, obj):
+            fresh = inner.get(*k8s.key_of(obj))
+            anns = fresh.setdefault("metadata", {}).setdefault(
+                "annotations", {})
+            anns[RESTART_COUNT_ANNOTATION] = str(int(
+                anns.get(RESTART_COUNT_ANNOTATION, "0")) + 1)
+            anns["competitor/wrote"] = "1"
+            inner.update(fresh)
+
+        ctrl.client = InterleavingClient(cluster, "TPUJob", "train",
+                                         compete)
+        cluster.fail_pod("kubeflow", "train-worker-0-1", "chaos: died")
+        drive(cluster, mgr, ticks=6)
+        job = cluster.get(TPU_AV, "TPUJob", "kubeflow", "train")
+        anns = k8s.annotations_of(job)
+        # competitor's +1 AND the operator's +1 both landed: 2, not 1
+        assert anns[RESTART_COUNT_ANNOTATION] == "2"
+        assert anns["competitor/wrote"] == "1"
+
+    def test_operator_gang_shape_write_preserves_competitor(self):
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        mgr = Manager(cluster)
+        ctrl = mgr.add(TrainingJobReconciler("TPUJob"))
+        cluster.create(tpujob_manifest())
+        ctrl.client = InterleavingClient(cluster, "TPUJob", "train",
+                                         _competing_annotation)
+        drive(cluster, mgr)
+        job = cluster.get(TPU_AV, "TPUJob", "kubeflow", "train")
+        anns = k8s.annotations_of(job)
+        assert "kubeflow.org/gang-shape" in anns
+        assert anns["competitor/wrote"] == "1"
+
+    def test_scheduler_binding_write_preserves_competitor(self):
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        sched = SliceScheduler(SchedulerConfig())
+        cluster.create(tpujob_manifest(scheduled=True))
+        client = InterleavingClient(cluster, "TPUJob", "train",
+                                    _competing_annotation)
+        sched.reconcile(client, ("", "#cluster-pass"))
+        job = cluster.get(TPU_AV, "TPUJob", "kubeflow", "train")
+        anns = k8s.annotations_of(job)
+        assert BINDING_ANNOTATION in anns        # the bind landed
+        assert anns["competitor/wrote"] == "1"   # and lost nothing
+
+    def test_scheduler_preempt_count(self):
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        sched = SliceScheduler(SchedulerConfig())
+        cluster.create(tpujob_manifest(scheduled=True))
+        sched.reconcile(cluster, ("", "#cluster-pass"))
+
+        def compete(inner, obj):
+            fresh = inner.get(*k8s.key_of(obj))
+            anns = fresh.setdefault("metadata", {}).setdefault(
+                "annotations", {})
+            anns[PREEMPTED_COUNT_ANNOTATION] = str(int(
+                anns.get(PREEMPTED_COUNT_ANNOTATION, "0")) + 1)
+            inner.update(fresh)
+
+        client = InterleavingClient(cluster, "TPUJob", "train", compete)
+        manifest = cluster.get(TPU_AV, "TPUJob", "kubeflow", "train")
+        sched._apply_preempt(client, manifest)
+        job = cluster.get(TPU_AV, "TPUJob", "kubeflow", "train")
+        # both increments landed: the preemption cannot be miscounted
+        assert k8s.annotations_of(job)[PREEMPTED_COUNT_ANNOTATION] == "2"
+
+    def test_scheduler_resize_history_append(self):
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        sched = SliceScheduler(SchedulerConfig())
+        cluster.create(tpujob_manifest(scheduled=True))
+        sched.reconcile(cluster, ("", "#cluster-pass"))
+        manifest = cluster.get(TPU_AV, "TPUJob", "kubeflow", "train")
+        placement = binding_of(manifest)
+
+        def compete(inner, obj):
+            import json as _json
+            fresh = inner.get(*k8s.key_of(obj))
+            fresh.setdefault("metadata", {}).setdefault(
+                "annotations", {})[RESIZE_HISTORY_ANNOTATION] = \
+                _json.dumps([{"time": 1.0, "fromChips": 8,
+                              "toChips": 4, "reason": "competitor"}])
+            inner.update(fresh)
+
+        client = InterleavingClient(cluster, "TPUJob", "train", compete)
+        sched._apply_resize(client, manifest, placement, placement,
+                            "grow: test")
+        from kubeflow_tpu.scheduler.queue import resize_history
+        hist = resize_history(cluster.get(TPU_AV, "TPUJob", "kubeflow",
+                                          "train"))
+        # the competitor's entry AND ours, in order — append, not clobber
+        assert [h["reason"] for h in hist] == ["competitor", "grow: test"]
+
+    def test_health_fold_two_writers_both_land(self):
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        node = k8s.name_of(cluster.list("v1", "Node")[0])
+
+        def compete(inner, obj):
+            # the other controller folds its own event first
+            health.record_host_event(inner, node, health.EVENT_NOT_READY,
+                                     now=100.0)
+
+        client = InterleavingClient(cluster, "Node", node, compete)
+        rec = health.record_host_event(client, node,
+                                       health.EVENT_POD_CRASH, now=100.0)
+        assert rec is not None
+        # both weight-1.0 events present in the final record
+        stored = health.health_of(cluster.get("v1", "Node", "", node))
+        assert stored["events"] == 2
+        assert stored["score"] == pytest.approx(2.0)
+
+    def test_quarantine_write_preserves_concurrent_fold(self):
+        from kubeflow_tpu.api.trainingjob import QUARANTINE_ANNOTATION
+        from kubeflow_tpu.scheduler.health import HealthConfig
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        node = k8s.name_of(cluster.list("v1", "Node")[0])
+        health.record_host_event(cluster, node, health.EVENT_POD_CRASH)
+        health.record_host_event(cluster, node, health.EVENT_POD_CRASH)
+        health.record_host_event(cluster, node, health.EVENT_POD_CRASH)
+        sched = SliceScheduler(SchedulerConfig(health=HealthConfig(
+            quarantine_threshold=2.0)))
+
+        def compete(inner, obj):
+            health.record_host_event(inner, node, health.EVENT_STALL)
+
+        client = InterleavingClient(cluster, "Node", node, compete)
+        sched.reconcile(client, ("", "#cluster-pass"))
+        stored = cluster.get("v1", "Node", "", node)
+        assert QUARANTINE_ANNOTATION in k8s.annotations_of(stored)
+        # the concurrent fold survived the quarantine write
+        assert health.health_of(stored)["events"] == 4
+
+    def test_finalize_ledger_preserves_competitor(self, tmp_path,
+                                                  monkeypatch):
+        from kubeflow_tpu.obs.goodput import GOODPUT_ANNOTATION
+        from kubeflow_tpu.obs.trace import (SPAN_PATH_ENV, SpanWriter,
+                                            reset_default_tracers)
+        span_path = str(tmp_path / "spans.jsonl")
+        monkeypatch.setenv(SPAN_PATH_ENV, span_path)
+        reset_default_tracers()
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        mgr = Manager(cluster)
+        ctrl = mgr.add(TrainingJobReconciler("TPUJob"))
+        cluster.create(tpujob_manifest())
+        drive(cluster, mgr)
+        job = cluster.get(TPU_AV, "TPUJob", "kubeflow", "train")
+        from kubeflow_tpu.obs.trace import TRACE_ID_ANNOTATION
+        tid = k8s.annotations_of(job)[TRACE_ID_ANNOTATION]
+        writer = SpanWriter(span_path, "worker")
+        writer.emit("window", start=time.time() - 5.0, end=time.time(),
+                    trace_id=tid)
+        writer.close()
+        ctrl.client = InterleavingClient(cluster, "TPUJob", "train",
+                                         _competing_annotation)
+        cluster.set_pod_phase("kubeflow", "train-worker-0-0",
+                              "Succeeded")
+        drive(cluster, mgr)
+        reset_default_tracers()
+        job = cluster.get(TPU_AV, "TPUJob", "kubeflow", "train")
+        anns = k8s.annotations_of(job)
+        assert GOODPUT_ANNOTATION in anns        # the ledger landed
+        assert anns["competitor/wrote"] == "1"   # and lost nothing
+
+
+# ------------------------------------ ConflictError at the wire boundary
+
+
+class TestApiserverConflictContract:
+    def test_stale_rv_409s_and_loser_rereads(self):
+        """The contract update_with_conflict_retry is built on, pinned
+        at the HTTP boundary independently of the helper: concurrent
+        update with a stale resourceVersion 409s as ConflictError, the
+        winner's write survives, the loser re-reads and succeeds."""
+        from kubeflow_tpu.cluster.apiserver import ClusterAPIServer
+        from kubeflow_tpu.cluster.http_client import HttpKubeClient
+        backend = FakeCluster()
+        server = ClusterAPIServer(backend, port=0)
+        port = server.start()
+        try:
+            a = HttpKubeClient(f"http://127.0.0.1:{port}", retries=0)
+            b = HttpKubeClient(f"http://127.0.0.1:{port}", retries=0)
+            a.create(tpujob_manifest())
+            obj_a = a.get(TPU_AV, "TPUJob", "kubeflow", "train")
+            obj_b = b.get(TPU_AV, "TPUJob", "kubeflow", "train")
+            apply_annotations(obj_a, {"writer": "a"})
+            a.update(obj_a)               # the winner
+            apply_annotations(obj_b, {"writer": "b"})
+            with pytest.raises(ConflictError):
+                b.update(obj_b)           # stale rv: 409, typed
+            fresh = b.get(TPU_AV, "TPUJob", "kubeflow", "train")
+            assert k8s.annotations_of(fresh)["writer"] == "a"
+            apply_annotations(fresh, {"writer": "b"})
+            b.update(fresh)               # re-read rv: accepted
+            final = a.get(TPU_AV, "TPUJob", "kubeflow", "train")
+            assert k8s.annotations_of(final)["writer"] == "b"
+        finally:
+            server.stop()
+
+    def test_lease_round_trip_over_the_wire(self):
+        """Leases are ordinary objects at the wire level: an HTTP
+        replica can elect against the simulated apiserver."""
+        from kubeflow_tpu.cluster.apiserver import ClusterAPIServer
+        from kubeflow_tpu.cluster.http_client import HttpKubeClient
+        backend = FakeCluster()
+        server = ClusterAPIServer(backend, port=0)
+        port = server.start()
+        try:
+            client = HttpKubeClient(f"http://127.0.0.1:{port}",
+                                    retries=0)
+            assert L.try_acquire(client, "kubeflow", "op", "a",
+                                 10.0).acquired
+            assert not L.try_acquire(client, "kubeflow", "op", "b",
+                                     10.0).acquired
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------- snapshot counters
+
+
+class TestSnapshotCounters:
+    def test_round_trip_preserves_uid_and_rv_counters(self):
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        cluster.create(tpujob_manifest())
+        # a delete advances rv past any live object's
+        cluster.delete(TPU_AV, "TPUJob", "kubeflow", "train")
+        uid_n, rv_n = cluster._uid_n, cluster._rv_n
+        restored = FakeCluster.from_snapshot(cluster.to_snapshot())
+        assert (restored._uid_n, restored._rv_n) == (uid_n, rv_n)
+        created = restored.create(tpujob_manifest(name="after"))
+        # a restored control plane must never re-mint uid-1 (trace-id
+        # collisions) or re-issue seen resourceVersions (orderings)
+        assert created["metadata"]["uid"] == f"uid-{uid_n + 1}"
+        assert int(created["metadata"]["resourceVersion"]) == rv_n + 1
+
+    def test_legacy_snapshot_without_counters_derives_high_water(self):
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        cluster.create(tpujob_manifest())
+        snap = cluster.to_snapshot()
+        del snap["counters"]
+        restored = FakeCluster.from_snapshot(snap)
+        existing_uids = {o["metadata"]["uid"]
+                         for o in snap["objects"]}
+        created = restored.create(tpujob_manifest(name="after"))
+        assert created["metadata"]["uid"] not in existing_uids
+        max_rv = max(int(o["metadata"]["resourceVersion"])
+                     for o in snap["objects"])
+        assert int(created["metadata"]["resourceVersion"]) > max_rv
+
+    def test_apiserver_rv_high_water_survives_restore(self):
+        from kubeflow_tpu.cluster.apiserver import ClusterAPIServer
+        cluster = FakeCluster()
+        cluster.create(tpujob_manifest())
+        restored = FakeCluster.from_snapshot(cluster.to_snapshot())
+        server = ClusterAPIServer(restored, port=0)
+        assert server.current_rv() == cluster._rv_n
+
+
+# --------------------------------------------------- controller gating
+
+
+class TestControllerLeaderGating:
+    def _replica(self, cluster, ident, duration=0.25):
+        chaos = ControllerChaos(cluster)
+        recorder = RecordingKubeClient(chaos)
+        elector = L.LeaderElector(client=chaos, identity=ident,
+                                  name="op", duration_s=duration)
+        fenced = L.FencedKubeClient(recorder, elector)
+        ctrl = Controller(reconciler=TrainingJobReconciler("TPUJob"),
+                          client=fenced, elector=elector,
+                          retry_backoff_s=0.01, retry_backoff_max_s=0.1)
+        ctrl.bind_watches()
+        ctrl.enqueue_existing()
+        return chaos, recorder, elector, ctrl
+
+    def test_standby_watches_but_writes_nothing(self):
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        cluster.create(tpujob_manifest())
+        _, rec_a, el_a, ctrl_a = self._replica(cluster, "a")
+        _, rec_b, el_b, ctrl_b = self._replica(cluster, "b")
+        for _ in range(4):
+            ctrl_a.run_pending()
+            ctrl_b.run_pending()
+            cluster.tick()
+        assert el_a.is_leader and not el_b.is_leader
+        assert len(rec_a.mutations) > 0          # the leader drove
+        assert rec_b.mutations == []             # the standby wrote zero
+        assert len(cluster.list("v1", "Pod", "kubeflow")) == 2
+        ctrl_a.stop()
+        ctrl_b.stop()
+
+    def test_failover_standby_adopts_and_finishes(self):
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        cluster.create(tpujob_manifest())
+        chaos_a, _, el_a, ctrl_a = self._replica(cluster, "a")
+        _, rec_b, el_b, ctrl_b = self._replica(cluster, "b")
+        for _ in range(4):
+            ctrl_a.run_pending()
+            ctrl_b.run_pending()
+            cluster.tick()
+        assert el_a.is_leader
+        # leader process dies; standby must take over and recover the
+        # failed gang
+        chaos_a.kill()
+        ctrl_a.stop()
+        cluster.fail_pod("kubeflow", "train-worker-0-1", "chaos: died")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            ctrl_b.run_pending()
+            cluster.tick()
+            pods = [p for p in cluster.list("v1", "Pod", "kubeflow")
+                    if p.get("status", {}).get("phase") == "Running"]
+            if el_b.is_leader and len(pods) == 2:
+                break
+            time.sleep(0.02)
+        assert el_b.is_leader
+        assert len(rec_b.mutations) > 0
+        job = cluster.get(TPU_AV, "TPUJob", "kubeflow", "train")
+        assert k8s.annotations_of(job)[RESTART_COUNT_ANNOTATION] == "1"
+        ctrl_b.stop()
+
+
+# ------------------------------------------------- error-requeue backoff
+
+
+class TestErrorRequeueBackoff:
+    class Failing:
+        primary = (TPU_AV, "TPUJob")
+        owns = []
+        controller_name = "failing"
+
+        def __init__(self):
+            self.calls = 0
+
+        def reconcile(self, client, key):
+            self.calls += 1
+            raise RuntimeError("doomed")
+
+        def map_event(self, client, obj):
+            return []
+
+    def test_retries_are_delayed_not_hot_looped(self):
+        cluster = FakeCluster()
+        cluster.create(tpujob_manifest())
+        rec = self.Failing()
+        ctrl = Controller(reconciler=rec, client=cluster,
+                          retry_backoff_s=0.2, retry_backoff_max_s=5.0)
+        ctrl.queue.add(("kubeflow", "train"))
+        assert ctrl.process_one()
+        assert rec.calls == 1
+        # the retry is in _delayed, NOT immediately back in the queue
+        assert len(ctrl.queue) == 0
+        assert len(ctrl._delayed) == 1
+        due, _key = ctrl._delayed[0]
+        assert due > time.monotonic()   # genuinely in the future
+
+    def test_backoff_grows_and_exhaustion_counts(self):
+        cluster = FakeCluster()
+        cluster.create(tpujob_manifest())
+        rec = self.Failing()
+        ctrl = Controller(reconciler=rec, client=cluster, max_retries=3,
+                          retry_backoff_s=0.01, retry_backoff_max_s=1.0)
+        exhausted = obsreg.counter(
+            "kftpu_reconcile_retries_exhausted_total",
+            "keys given up on after max_retries failed reconciles "
+            "(invisible to alerting as a log line; the blind resync is "
+            "the only later recovery)",
+            labels=("controller",)).labels(controller="failing")
+        before = exhausted.value
+        delays = []
+        ctrl.queue.add(("kubeflow", "train"))
+        for _ in range(10):
+            if not ctrl.process_one():
+                if not ctrl._delayed:
+                    break
+                due, _k = ctrl._delayed[0]
+                delays.append(due - time.monotonic())
+                time.sleep(max(0.0, due - time.monotonic()) + 0.005)
+                ctrl.pump_events()
+        assert rec.calls == 4                     # initial + 3 retries
+        assert exhausted.value == before + 1    # the give-up is visible
+        # exponential: each recorded delay at least the previous one
+        # (jitter is within [1, 1.5) of a doubling base)
+        assert all(b > a for a, b in zip(delays, delays[1:]))
+
+
+# ------------------------------------------------------------ orphan GC
+
+
+class TestOrphanReconciliation:
+    def test_orphan_pods_of_a_gone_job_are_reaped(self):
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        mgr = Manager(cluster)
+        ctrl = mgr.add(TrainingJobReconciler("TPUJob"))
+        # orphans: pods carrying the job labels + an owner reference to
+        # a job that no longer exists (a stale reconcile created them
+        # just after the cascade ran)
+        for i in range(2):
+            cluster.create({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {
+                    "name": f"ghost-worker-0-{i}", "namespace": "kubeflow",
+                    "labels": {"kubeflow.org/job-name": "ghost",
+                               "kubeflow.org/job-kind": "tpujob"},
+                    "ownerReferences": [{
+                        "apiVersion": TPU_AV, "kind": "TPUJob",
+                        "name": "ghost", "uid": "uid-999",
+                        "controller": True}]},
+                "spec": {"containers": [{"name": "jax", "image": "x"}]},
+            })
+        drive(cluster, mgr)   # the pods' own events map to the gone owner
+        assert cluster.list("v1", "Pod", "kubeflow") == []
+        ctrl.stop()
+
+    def test_live_jobs_pods_are_untouched(self):
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        mgr = Manager(cluster)
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        cluster.create(tpujob_manifest())
+        drive(cluster, mgr)
+        assert len(cluster.list("v1", "Pod", "kubeflow")) == 2
+
+
+# --------------------------------------------------- controller chaos
+
+
+class TestControllerChaos:
+    def test_die_after_lands_the_write_then_kills(self):
+        cluster = FakeCluster()
+        chaos = ControllerChaos(cluster)
+        chaos.die_after("create", 1)
+        with pytest.raises(ControllerCrash):
+            chaos.create(tpujob_manifest())
+        # the write LANDED before the death — crash consistency, not
+        # write loss
+        assert cluster.get(TPU_AV, "TPUJob", "kubeflow", "train")
+        with pytest.raises(ControllerCrash):
+            chaos.list("v1", "Pod")   # dead means dead
+        chaos.revive()
+        assert chaos.list(TPU_AV, "TPUJob")
+
+    def test_partition_raises_everything_then_heals(self):
+        cluster = FakeCluster()
+        cluster.create(tpujob_manifest())
+        chaos = ControllerChaos(cluster)
+        chaos.partition(0.15)
+        with pytest.raises(TransientAPIError):
+            chaos.get(TPU_AV, "TPUJob", "kubeflow", "train")
+        with pytest.raises(TransientAPIError):
+            chaos.list("v1", "Pod")
+        time.sleep(0.2)
+        assert chaos.get(TPU_AV, "TPUJob", "kubeflow", "train")
+
+    def test_die_mid_gang_create_successor_adopts_half_gang(self):
+        """The operator dies after creating ONE pod of a two-pod gang;
+        a fresh controller (in-memory state lost) must complete the
+        gang — exactly once, no duplicates."""
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        chaos = ControllerChaos(cluster)
+        ctrl = Controller(reconciler=TrainingJobReconciler("TPUJob"),
+                          client=chaos, retry_backoff_s=0.01,
+                          retry_backoff_max_s=0.05)
+        ctrl.bind_watches()
+        cluster.create(tpujob_manifest())
+        ctrl.enqueue_existing()
+        # service create is call 1; pod 1 is create call 2 — die there
+        chaos.die_after("create", 2)
+        for _ in range(6):
+            ctrl.run_pending()
+            cluster.tick()
+        assert chaos.dead
+        pods = cluster.list("v1", "Pod", "kubeflow")
+        assert len(pods) == 1                     # the half-created gang
+        ctrl.stop()
+        # the successor: fresh process over the same cluster
+        ctrl2 = Controller(reconciler=TrainingJobReconciler("TPUJob"),
+                           client=cluster)
+        ctrl2.bind_watches()
+        ctrl2.enqueue_existing()
+        for _ in range(4):
+            ctrl2.run_pending()
+            cluster.tick()
+        pods = cluster.list("v1", "Pod", "kubeflow")
+        assert sorted(k8s.name_of(p) for p in pods) == \
+            ["train-worker-0-0", "train-worker-0-1"]
+        ctrl2.stop()
+
+    def test_scheduler_dies_after_binding_write_no_rewrite(self):
+        """Kill the scheduler right after its binding write lands (the
+        'between binding write and pod create' window): the successor
+        must ADOPT the binding — zero rewrites — and the operator
+        creates the gang on it."""
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        chaos = ControllerChaos(cluster)
+        sched = SliceScheduler(SchedulerConfig())
+        cluster.create(tpujob_manifest(scheduled=True))
+        chaos.die_after("update", 1)   # the binding write is an update
+        with pytest.raises(Exception):
+            sched.reconcile(chaos, ("", "#cluster-pass"))
+        manifest = cluster.get(TPU_AV, "TPUJob", "kubeflow", "train")
+        assert binding_of(manifest) is not None   # the write landed
+        # successor scheduler (fresh state) + the operator
+        mgr = Manager(cluster)
+        mgr.add(SliceScheduler(SchedulerConfig()))
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        drive(cluster, mgr)
+        fresh = cluster.get(TPU_AV, "TPUJob", "kubeflow", "train")
+        assert binding_of(fresh).to_dict() == \
+            binding_of(manifest).to_dict()        # adopted, not replanned
+        anns_before = k8s.annotations_of(manifest)[BINDING_ANNOTATION]
+        assert k8s.annotations_of(fresh)[BINDING_ANNOTATION] == \
+            anns_before
+        assert len(cluster.list("v1", "Pod", "kubeflow")) == 2
+
+    def test_stale_watch_rewind_is_a_no_op(self):
+        """Replayed stale events re-enqueue keys; level-triggered
+        reconciles read fresh state and write NOTHING."""
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        chaos = ControllerChaos(cluster)
+        recorder = RecordingKubeClient(chaos, ignore_kinds=())
+        ctrl = Controller(reconciler=TrainingJobReconciler("TPUJob"),
+                          client=recorder)
+        ctrl.bind_watches()
+        cluster.create(tpujob_manifest())
+        ctrl.enqueue_existing()
+        for _ in range(4):
+            ctrl.run_pending()
+            cluster.tick()
+        writes_before = len(recorder.mutations)
+        assert chaos.rewind_watch() > 0
+        for _ in range(3):
+            ctrl.run_pending()
+            cluster.tick()
+        assert len(recorder.mutations) == writes_before
+        ctrl.stop()
+
+
+# ------------------------------------------------------ split brain
+
+
+class TestSplitBrain:
+    def test_drill_fences_the_deposed_leader(self):
+        from kubeflow_tpu.scheduler.soak import split_brain_drill
+        report = split_brain_drill(lease_duration_s=0.25)
+        assert report["initial_leader_elected"]
+        assert report["stolen_by_standby"]
+        assert report["old_leader_demoted"]
+        assert report["fenced_write_rejected"]
+        assert report["old_leader_writes_after_steal"] == 0
+        assert not report["zombie_write_landed"]
+        assert report["doubled_pod_creates"] == 0
+
+
+# ----------------------------------------------------------- the soak
+
+
+@pytest.mark.slow
+class TestControlPlaneSoak:
+    def test_soak_survives_kills_and_partition(self, tmp_path):
+        from kubeflow_tpu.scheduler.soak import ControlPlaneSoak
+        report = ControlPlaneSoak(
+            workdir=str(tmp_path), total_steps=5, operator_kills=1,
+            scheduler_kills=1, partitions=1,
+            wall_budget_s=240.0).run()
+        assert report["outcome"] == "succeeded"
+        assert report["failovers"]["operator"] >= 1
+        assert report["failovers"]["scheduler"] >= 1
+        assert report["partitions"] == 1
+        assert report["duplicate_pod_creates"] == 0
+        assert not report["lost_annotation_writes"]
+        assert report["never_leader_mutations"] == 0
+        assert report["failover_s"]
+
+
+# --------------------------------------------------------- concurrency
+
+
+class TestConcurrentRMWThreads:
+    def test_eight_threads_incrementing_lose_nothing(self):
+        """The end-to-end lost-update test: N threads each increment a
+        counter annotation M times through update_with_conflict_retry;
+        the final value must be exactly N*M."""
+        cluster = FakeCluster()
+        cluster.create(tpujob_manifest())
+        n_threads, n_incr = 8, 5
+
+        def worker():
+            for _ in range(n_incr):
+                def mutate(obj):
+                    anns = k8s.annotations_of(obj)
+                    return apply_annotations(obj, {
+                        "count": str(int(anns.get("count", "0")) + 1)})
+                update_with_conflict_retry(
+                    cluster, TPU_AV, "TPUJob", "kubeflow", "train",
+                    mutate, max_attempts=200)
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        job = cluster.get(TPU_AV, "TPUJob", "kubeflow", "train")
+        assert k8s.annotations_of(job)["count"] == str(n_threads * n_incr)

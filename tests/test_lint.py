@@ -514,6 +514,81 @@ def test_node_health_contract_is_shared_not_duplicated():
         tpu_scheduler(health={"quarantineTreshold": 2})
 
 
+def test_lease_contract_is_shared_not_duplicated():
+    """The Lease wire contract (field names, apiVersion, the per-
+    component lease names) must have ONE definition — cluster/lease.py —
+    consumed everywhere else by import (the binding_of rule): the
+    elector, the fenced client, the soaks, the dashboard's control-plane
+    panel, and the manifests all coordinate through these strings, so a
+    re-spelling in any of them silently breaks failover. Also checks
+    the manifests' leader-election knobs render through to the
+    controller CLI (a rendered flag argparse does not define is a
+    silently ignored deployment knob)."""
+    import subprocess
+
+    from kubeflow_tpu.cluster import lease as L
+
+    assert L.LEASE_API_VERSION == "coordination.k8s.io/v1"
+    assert L.HOLDER_FIELD == "holderIdentity"
+    assert L.TRANSITIONS_FIELD == "leaseTransitions"
+
+    pkg = os.path.join(REPO_ROOT, "kubeflow_tpu")
+    lease_py = os.path.join("cluster", "lease.py")
+    for literal in (L.HOLDER_FIELD, L.ACQUIRE_TIME_FIELD,
+                    L.RENEW_TIME_FIELD, L.DURATION_FIELD,
+                    L.TRANSITIONS_FIELD, L.LEASE_API_VERSION):
+        hits = subprocess.run(
+            ["grep", "-rl", f'"{literal}"', pkg],
+            capture_output=True, text=True).stdout.split()
+        assert [os.path.relpath(h, pkg) for h in hits] == [lease_py], \
+            f"{literal!r} defined outside cluster/lease.py: {hits}"
+
+    def src(*rel):
+        with open(os.path.join(pkg, *rel)) as f:
+            return f.read()
+
+    # the consumers import, never re-spell
+    assert "lease_record" in src("webapps", "dashboard.py")
+    assert "LeaderElector" in src("controllers", "__main__.py")
+    # the production write path is FENCED, not just pop-gated: a
+    # deposed leader's in-flight reconcile must die at the client
+    # boundary (docs/operations.md "Control-plane HA")
+    assert "FencedKubeClient" in src("controllers", "__main__.py")
+    for name in ("OPERATOR_LEASE", "SCHEDULER_LEASE"):
+        assert name in src("manifests", "training.py"), \
+            f"manifests must render the shared {name} constant"
+
+    # manifests → CLI plumbing: the rendered flags must exist in the
+    # controller argparse, and the HA shape must actually render
+    from kubeflow_tpu.manifests.training import (tpu_job_operator,
+                                                 tpu_scheduler)
+    for component, lease_name in ((tpu_job_operator, L.OPERATOR_LEASE),
+                                  (tpu_scheduler, L.SCHEDULER_LEASE)):
+        objs = component()
+        dep = next(o for o in objs if o["kind"] == "Deployment")
+        args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--leader-elect" in args
+        assert f"--lease-name={lease_name}" in args
+        assert dep["spec"]["replicas"] == 2, \
+            "leader election exists to run replicas: 2"
+        lease_roles = [o for o in objs if o["kind"] == "Role"
+                       and any("leases" in r.get("resources", [])
+                               for r in o.get("rules", []))]
+        assert lease_roles, "leases RBAC must ride the HA deployment"
+        # opting out drops back to a single replica — two un-elected
+        # replicas would double-drive every gang
+        solo = next(o for o in component(leader_elect=False)
+                    if o["kind"] == "Deployment")
+        solo_args = solo["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--leader-elect" not in solo_args
+        assert solo["spec"]["replicas"] == 1
+    main_src = src("controllers", "__main__.py")
+    for flag in ("--leader-elect", "--lease-name", "--lease-namespace",
+                 "--lease-duration", "--identity"):
+        assert flag in main_src, \
+            f"controllers/__main__.py must define {flag}"
+
+
 def test_badput_categories_defined_once_and_shared():
     """The goodput/badput category vocabulary must have ONE definition
     (obs/goodput.py) consumed by the ledger, the sim, the dashboard,

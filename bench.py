@@ -709,10 +709,14 @@ def bench_comm(t_start: float | None = None) -> dict:
       DCN bytes are STRICTLY below the replicated arm's. (Total wire
       bytes are conserved — RS+AG ≡ AR — so the totals columns are
       recorded beside the update metric; docs/operations.md.)
-    - ``known-bad``: the dryrun's 4th config (data=2 x fsdp=2 x
-      tensor=2, rules-sharded params) whose SPMD compile logs the
-      "involuntary full rematerialization" warning (MULTICHIP_r05).
-      Asserted: the detector FLAGS it — the red flag is now data.
+    - ``known-bad`` / ``known-bad-legacy``: the dryrun's 4th config
+      (data=2 x fsdp=2 x tensor=2, rules-sharded params), whose SPMD
+      compile used to log the "involuntary full rematerialization"
+      warning (MULTICHIP_r05). ISSUE 15 rung 1 (DCN-aware rules)
+      killed it: the fixed arm must compile CLEAN with strictly fewer
+      DCN bytes/step than the legacy arm, which recompiles the pre-fix
+      layout (dcn_aware=False) as the live positive control the
+      detector still must FLAG.
     - ``single-slice``: the same pure-DP model on a 1-slice mesh.
       Asserted: zero DCN bytes, detector clean.
 
@@ -770,14 +774,16 @@ def bench_comm(t_start: float | None = None) -> dict:
         head_dim=16, mlp_dim=128, max_seq_len=64)
     spec = T.workload_spec(cfg=cfg, seq_len=64)
 
-    def compile_arm(mesh, weight_update="replicated", rules=False):
+    def compile_arm(mesh, weight_update="replicated", rules=False,
+                    num_slices=2, dcn_aware=True):
         builder = TrainStepBuilder(
             mesh=mesh, loss_fn=spec.loss_fn,
             optimizer=optax.chain(optax.clip_by_global_norm(1.0),
                                   optax.adamw(1e-3)),
             rules=spec.rules if rules else None,
             param_logical_axes=spec.param_logical_axes if rules else None,
-            weight_update=weight_update)
+            weight_update=weight_update, num_slices=num_slices,
+            dcn_aware=dcn_aware)
         state = builder.init(spec.init_fn, jax.random.PRNGKey(0))
         batch = builder.place_batch(
             spec.batch_fn(jax.random.PRNGKey(1), 2 * n_dev))
@@ -833,20 +839,37 @@ def bench_comm(t_start: float | None = None) -> dict:
                 arms["replicated"]["update_dcn_bytes"], \
                 f"{mode} update bytes not below replicated: {arms}"
 
-    # the known-bad config (MULTICHIP_r05: involuntary full remat) —
-    # the detector must flag it
+    # the (formerly) known-bad config (MULTICHIP_r05: involuntary full
+    # remat). ISSUE 15 rung 1 killed the reshard — the DCN-aware rules
+    # (parallel/sharding_rules.py dcn_aware) replicate the tok_embed
+    # table's gather-indexed vocab dim on multi-slice meshes, so the
+    # SAME sharding spec now compiles CLEAN with strictly fewer DCN
+    # bytes/step. The legacy arm (dcn_aware=False) recompiles the
+    # pre-fix layout as the live positive control: the detector's
+    # true-positive drill stays pinned against a REAL compiled program,
+    # and the byte delta is measured, not remembered.
     mesh_bad = mesh_from_contract(
         contract, ShardingSpec(data=2, fsdp=chips_per_slice // 2,
                                tensor=2))
+    hlo_legacy = compile_arm(mesh_bad, "replicated", rules=True,
+                             dcn_aware=False)
+    _, legacy = profile_arm(hlo_legacy, mesh_bad, num_slices=2)
+    arms["known-bad-legacy"] = legacy
+    assert legacy["dcn_full_reshard"], \
+        f"detector missed the legacy known-bad DCN config: {legacy}"
+
     hlo_bad = compile_arm(mesh_bad, "replicated", rules=True)
     _, bad = profile_arm(hlo_bad, mesh_bad, num_slices=2)
     arms["known-bad"] = bad
-    assert bad["dcn_full_reshard"], \
-        f"detector missed the known-bad DCN config: {bad}"
+    assert not bad["dcn_full_reshard"], \
+        f"DCN-aware rules did not kill the involuntary reshard: {bad}"
+    assert bad["dcn_bytes_per_step"] < legacy["dcn_bytes_per_step"], \
+        f"fixed arm not strictly below the legacy reshard bytes: " \
+        f"{bad} vs {legacy}"
 
     # single-slice control: everything is ICI, detector clean
     mesh_one = build_mesh(ShardingSpec(data=n_dev))
-    hlo_one = compile_arm(mesh_one, "replicated")
+    hlo_one = compile_arm(mesh_one, "replicated", num_slices=1)
     _, one = profile_arm(hlo_one, mesh_one, num_slices=1)
     arms["single-slice"] = one
     assert one["dcn_bytes_per_step"] == 0 and \
@@ -871,9 +894,256 @@ def bench_comm(t_start: float | None = None) -> dict:
             "slices": 2,
             "comm": arms,
             "detector": {
-                "flags_known_bad": bad["dcn_full_reshard"],
+                "flags_legacy_known_bad": legacy["dcn_full_reshard"],
+                "fixed_arm_clean": not bad["dcn_full_reshard"],
+                "fixed_below_legacy_dcn_bytes":
+                    bad["dcn_bytes_per_step"] <
+                    legacy["dcn_bytes_per_step"],
                 "clean_arms_pass": True,
             },
+            "startup_first_step_s": round(
+                time.perf_counter() - t_start, 2),
+        },
+        "_flops_per_chip": 0.0,
+    }
+
+
+def bench_multislice(t_start: float | None = None) -> dict:
+    """MPMD pipeline-over-DCN (ISSUE 15 rung 2): parity, scaling, and
+    bubble accounting for the one-program-per-slice path
+    (parallel/multislice.py) against the single-program DCN mesh.
+
+    Arms (8 virtual CPU devices, slices emulated as contiguous 2- or
+    4-device groups — stated caveat: emulated slices share host cores,
+    so MEASURED serial wall does not scale; the schedule MODEL's
+    makespan from measured per-op durations is the honest parallel
+    number, and both are recorded):
+
+    - **parity**: the MPMD 2-stage pipeline vs the single-program
+      plain-scan DP arm, identical init rng + batch stream + optimizer
+      (engine cross-stage global-norm clip == optax
+      clip_by_global_norm), f32 compute. Asserted: loss trajectory
+      matches to <= 1e-5 at fixed global batch.
+    - **ladder**: 1 → 2 → 4 slices (KFTPU_BENCH_MS_SLICES), fixed
+      global batch: modeled tokens/sec (tokens / 1F1B makespan),
+      measured serial tokens/sec, scaling efficiency
+      (modeled_tput_S / (S x modeled_tput_1)), measured bubble
+      fraction vs the (S-1)/(M+S-1) ideal, explicit DCN bytes/step.
+    - **vs single-program**: the 2-slice GSPMD DP arm's modeled HLO
+      DCN bytes/step (obs/collectives.py) beside the MPMD arm's
+      measured explicit-transfer bytes — the PR 13 yardstick applied
+      to the new path.
+    - **goodput**: the WORKER-integrated path (train() with
+      multislice_pipeline over KFTPU_NUM_SLICES=2) streams window +
+      pipeline-bubble spans to a sink; the ledger must include a
+      nonzero ``pipeline_bubble`` badput category and still sum to
+      wall-clock within 2% (obs/goodput.py).
+    """
+    import os
+    import subprocess
+    import tempfile
+
+    t_start = time.perf_counter() if t_start is None else t_start
+    import jax
+
+    if jax.devices()[0].platform == "cpu" and len(jax.devices()) < 8 \
+            and not os.environ.get("KFTPU_BENCH_MS_CHILD"):
+        env = {**os.environ, "KFTPU_BENCH_MS_CHILD": "1",
+               "JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") +
+                             " --xla_force_host_platform_device_count=8")}
+        res = subprocess.run([sys.executable, __file__, "--mode",
+                              "multislice"],
+                             env=env, capture_output=True, text=True,
+                             timeout=1800)
+        for line in reversed(res.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                row = json.loads(line)
+                row["_flops_per_chip"] = 0.0
+                return row
+        raise RuntimeError("multislice bench child emitted no JSON row "
+                           f"(rc={res.returncode}): {res.stderr[-2000:]}")
+
+    import jax.numpy as jnp
+    import optax
+
+    from kubeflow_tpu.api.topology import TopologyContract, parse_topology
+    from kubeflow_tpu.api.trainingjob import ShardingSpec
+    from kubeflow_tpu.models import transformer as T
+    from kubeflow_tpu.obs import goodput as gp
+    from kubeflow_tpu.obs.collectives import (analyze_hlo,
+                                              slice_assignment)
+    from kubeflow_tpu.obs.trace import load_spans
+    from kubeflow_tpu.parallel.mesh import build_mesh, mesh_from_contract
+    from kubeflow_tpu.parallel.multislice import MPMDPipeline, stage_meshes
+    from kubeflow_tpu.runtime.trainstep import (MultisliceTrainStepBuilder,
+                                                TrainStepBuilder)
+
+    dev = jax.devices()[0]
+    n_dev = len(jax.devices())
+    steps = _env_int("KFTPU_BENCH_MS_STEPS", 3)
+    seq_len = 64
+    cfg = T.TransformerConfig(
+        vocab_size=256, num_layers=4, embed_dim=64, num_heads=4,
+        head_dim=16, mlp_dim=128, max_seq_len=seq_len,
+        dtype=jnp.float32)   # f32: the <=1e-5 parity bar is exact math,
+    #                          not bf16 re-chunking roundoff
+    spec = T.pipelined_workload_spec(cfg=cfg, seq_len=seq_len, mesh=None)
+    global_batch = 16
+    batches = [spec.batch_fn(jax.random.PRNGKey(100 + i), global_batch)
+               for i in range(steps)]
+
+    # ---- parity: MPMD 2-stage vs single-program plain-scan DP ----------
+    ref = TrainStepBuilder(
+        mesh=build_mesh(ShardingSpec(data=n_dev)), loss_fn=spec.loss_fn,
+        optimizer=optax.chain(optax.clip_by_global_norm(1.0),
+                              optax.adamw(1e-3)))
+    state_r = ref.init(spec.init_fn, jax.random.PRNGKey(0))
+    step_r = ref.build()
+    losses_ref = []
+    for b in batches:
+        state_r, m = step_r(state_r, ref.place_batch(b))
+        losses_ref.append(float(m["loss"]))
+
+    ms2 = MultisliceTrainStepBuilder(
+        cfg=cfg, num_slices=2, num_microbatches=4,
+        optimizer=optax.adamw(1e-3), grad_clip_norm=1.0)
+    state_m = ms2.init(spec.init_fn, jax.random.PRNGKey(0))
+    step_m = ms2.build()
+    losses_ms = []
+    for b in batches:
+        state_m, m = step_m(state_m, ms2.place_batch(b))
+        losses_ms.append(float(m["loss"]))
+    parity_delta = max(abs(a - b) for a, b in zip(losses_ref, losses_ms))
+    assert parity_delta <= 1e-5, \
+        f"MPMD parity broke: {losses_ref} vs {losses_ms}"
+
+    # ---- ladder: 1 -> 2 -> 4 slices at fixed global batch -------------
+    wanted = [int(s) for s in os.environ.get(
+        "KFTPU_BENCH_MS_SLICES", "1,2,4").split(",") if s.strip()]
+    chips_per = 2   # a slice = 2 emulated chips; 4 slices fit 8 devices
+    micro = 8       # mb=2 rows divides the 2-chip data axis
+    init_fn, embed_fn, block_fn, head_loss_fn = T.multislice_stage_fns(cfg)
+    ladder = {}
+    tokens_per_step = global_batch * seq_len
+    for S in wanted:
+        engine = MPMDPipeline(
+            meshes=stage_meshes(jax.devices()[:S * chips_per], S),
+            embed_fn=embed_fn, block_fn=block_fn,
+            head_loss_fn=head_loss_fn, optimizer=optax.adamw(1e-3),
+            num_microbatches=micro, grad_clip_norm=1.0)
+        st = engine.init(lambda r: init_fn(r, seq_len),
+                         jax.random.PRNGKey(0))
+        last = None
+        for i, b in enumerate(batches):
+            st, _ = engine.step(st, engine.place_batch(b))
+            if i:   # skip the compile step; keep the best-of-rest
+                rep = engine.last_report
+                if last is None or rep.makespan_s < last.makespan_s:
+                    last = rep
+        rep = last if last is not None else engine.last_report
+        ladder[S] = {
+            "modeled_tokens_per_s": round(
+                tokens_per_step / rep.makespan_s, 1)
+            if rep.makespan_s else None,
+            "measured_serial_tokens_per_s": round(
+                tokens_per_step / rep.serial_wall_s, 1)
+            if rep.serial_wall_s else None,
+            "bubble_fraction": round(rep.bubble_fraction, 4),
+            "ideal_bubble_fraction": rep.to_dict()[
+                "idealBubbleFraction"],
+            "dcn_bytes_per_step": rep.dcn_bytes,
+            "dcn_transfers_per_step": rep.dcn_transfers,
+        }
+    eff = {}
+    if 1 in ladder and ladder[1]["modeled_tokens_per_s"]:
+        base = ladder[1]["modeled_tokens_per_s"]
+        for S in wanted:
+            if S == 1 or not ladder.get(S, {}).get(
+                    "modeled_tokens_per_s"):
+                continue
+            eff[str(S)] = round(
+                ladder[S]["modeled_tokens_per_s"] / (S * base), 4)
+            ladder[S]["scaling_efficiency_modeled"] = eff[str(S)]
+
+    # ---- vs the single-program DCN mesh (the PR 13 yardstick) ----------
+    contract = TopologyContract(
+        coordinator_address="bench:8476", num_processes=2, process_id=0,
+        slice_topology=parse_topology(f"v5e-{n_dev // 2}"),
+        num_slices=2, slice_id=0)
+    mesh_sp = mesh_from_contract(contract, ShardingSpec(data=n_dev))
+    sp = TrainStepBuilder(mesh=mesh_sp, loss_fn=spec.loss_fn,
+                          optimizer=optax.adamw(1e-3), num_slices=2)
+    st_sp = sp.init(spec.init_fn, jax.random.PRNGKey(0))
+    b_sp = sp.place_batch(batches[0])
+    hlo_sp = sp.build().lower(st_sp, b_sp).compile().as_text()
+    prof_sp = analyze_hlo(
+        hlo_sp, slice_assignment(mesh_sp, 2),
+        mesh_axes=[(a, int(mesh_sp.shape[a]))
+                   for a in mesh_sp.axis_names])
+    single_program = {
+        "modeled_dcn_bytes_per_step": round(prof_sp.dcn_bytes_per_step),
+        "dcn_collectives": prof_sp.collectives("dcn"),
+    }
+
+    # ---- worker-integrated goodput drill -------------------------------
+    from kubeflow_tpu.runtime.worker import train
+    with tempfile.TemporaryDirectory() as td:
+        sink = os.path.join(td, "spans.jsonl")
+        saved = {k: os.environ.get(k)
+                 for k in ("KFTPU_NUM_SLICES",)}
+        os.environ["KFTPU_NUM_SLICES"] = "2"
+        try:
+            result = train(
+                workload="transformer-pipelined", steps=6,
+                global_batch=32, sync_every=2, span_path=sink,
+                multislice_pipeline=True, handle_sigterm=False,
+                checkpoint_dir=None)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        ledger = gp.decompose(load_spans(sink))
+    bubble_s = ledger["badputSeconds"][gp.BADPUT_PIPELINE_BUBBLE]
+    assert bubble_s > 0, \
+        f"no pipeline_bubble badput in the worker ledger: {ledger}"
+    assert gp.categories_sum_ok(ledger), \
+        f"ledger categories do not sum to wall-clock: {ledger}"
+    goodput = {
+        "worker_steps": result.steps,
+        "ledger_wall_s": ledger["wallSeconds"],
+        "pipeline_bubble_s": round(bubble_s, 4),
+        "categories_sum_ok": True,
+    }
+
+    headline = eff.get("2")
+    return {
+        "metric": "multislice_scaling_efficiency_2slice_modeled",
+        "value": headline,
+        "unit": "modeled_tput_2slice / (2 x modeled_tput_1slice); "
+                "CPU-emulated slices, schedule-model number",
+        "vs_baseline": None,
+        "mfu": None,
+        "extras": {
+            "device_kind": getattr(dev, "device_kind", dev.platform),
+            "devices": n_dev,
+            "parity": {
+                "max_loss_delta": parity_delta,
+                "steps": steps,
+                "losses_single_program": losses_ref,
+                "losses_mpmd": losses_ms,
+            },
+            "ladder": {str(k): v for k, v in sorted(ladder.items())},
+            "scaling_efficiency_modeled": eff,
+            "single_program_dcn_mesh": single_program,
+            "goodput": goodput,
+            "caveat": "CPU emulation: slices share host cores, so "
+                      "measured serial wall does not scale; the "
+                      "schedule model (measured per-op durations on "
+                      "the 1F1B grid) is the parallel number",
             "startup_first_step_s": round(
                 time.perf_counter() - t_start, 2),
         },
@@ -2769,6 +3039,7 @@ def main(argv=None) -> int:
                             "weight-update", "chaos", "ctrl-chaos",
                             "input", "sched",
                             "health", "obs", "goodput", "comm",
+                            "multislice",
                             "warmstart", "warmstart-child"])
     p.add_argument("--routing-out",
                    default="bench-matrix/fused_routing_measured.json",
@@ -2845,6 +3116,8 @@ def main(argv=None) -> int:
         row = bench_goodput(t_start=t_start)
     elif args.mode == "comm":
         row = bench_comm(t_start=t_start)
+    elif args.mode == "multislice":
+        row = bench_multislice(t_start=t_start)
     elif args.mode == "warmstart-child":
         row = bench_warmstart_child()
     else:

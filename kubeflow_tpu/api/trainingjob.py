@@ -254,6 +254,91 @@ class WarmStartSpec:
 
 
 @dataclass
+class MultisliceSpec:
+    """Multi-slice execution knobs (``spec.multislice``): how a job
+    spanning ``numSlices > 1`` runs across the DCN boundary. Plumbed the
+    full operator path like InputSpec — parsed here at admission,
+    rendered by controllers/tpujob.py as the env named in each field's
+    metadata, consumed by runtime/worker.py via the CLI flag named there
+    (tests/test_lint.py enforces every layer). ``None`` = unset, worker
+    default (the single-program GSPMD path with DCN-aware sharding
+    rules). Defined HERE, jax-free: admission must not import the
+    runtime. docs/training.md "Multi-slice training"."""
+
+    # MPMD pipeline-over-DCN (parallel/multislice.py): one program PER
+    # SLICE — pipeline stages with explicit activation/grad send-recv
+    # over DCN and a microbatched 1F1B-style schedule — instead of one
+    # SPMD program resharding across the slow link
+    pipeline: Optional[bool] = field(default=None, metadata={
+        "spec_field": "pipeline", "env": "KFTPU_MULTISLICE_PIPELINE",
+        "cli": "--multislice-pipeline"})
+    # microbatches per step for the MPMD schedule; the pipeline bubble
+    # fraction is (S-1)/(M+S-1), so M >= 4*S keeps it under 20%
+    microbatches: Optional[int] = field(default=None, metadata={
+        "spec_field": "microbatches",
+        "env": "KFTPU_MULTISLICE_MICROBATCHES",
+        "cli": "--multislice-microbatches"})
+
+    @property
+    def pipeline_enabled(self) -> bool:
+        return bool(self.pipeline)
+
+    def validate(self) -> None:
+        if self.pipeline is not None and \
+                not isinstance(self.pipeline, bool):
+            raise ValueError(
+                f"multislice.pipeline must be a boolean, got "
+                f"{self.pipeline!r}")
+        m = self.microbatches
+        if m is not None and (not isinstance(m, int) or
+                              isinstance(m, bool) or m < 1):
+            raise ValueError(
+                f"multislice.microbatches must be a positive integer, "
+                f"got {m!r}")
+        if m is not None and not self.pipeline:
+            # only the MPMD schedule consumes the knob — accepting it
+            # without the pipeline would be a silent no-op the user
+            # mistakes for a pinned schedule (the fused_routing-
+            # without-fused_blocks rule)
+            raise ValueError(
+                "multislice.microbatches requires multislice.pipeline: "
+                "true (only the MPMD schedule consumes it)")
+
+    def to_dict(self) -> dict:
+        return {f.metadata["spec_field"]: getattr(self, f.name)
+                for f in fields(self) if getattr(self, f.name) is not None}
+
+    def to_env(self) -> dict[str, str]:
+        """The controller-rendered worker env for every SET knob
+        (booleans render "1"/"0" — the worker's _env_int contract)."""
+        out = {}
+        for f in fields(self):
+            v = getattr(self, f.name)
+            if v is None:
+                continue
+            out[f.metadata["env"]] = ("1" if v else "0") \
+                if isinstance(v, bool) else str(v)
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "MultisliceSpec":
+        if d is not None and not isinstance(d, dict):
+            raise ValueError(
+                f"spec.multislice must be a mapping of multi-slice "
+                f"knobs, got {type(d).__name__}: {d!r}")
+        d = dict(d or {})
+        by_spec = {f.metadata["spec_field"]: f.name for f in fields(cls)}
+        unknown = set(d) - set(by_spec)
+        if unknown:
+            raise ValueError(
+                f"unknown multislice knobs {sorted(unknown)}; "
+                f"valid: {sorted(by_spec)}")
+        spec = cls(**{by_spec[k]: v for k, v in d.items()})
+        spec.validate()
+        return spec
+
+
+@dataclass
 class SchedulingPolicy:
     """Gang-scheduling knobs (``spec.schedulingPolicy``): how the slice
     scheduler (kubeflow_tpu/scheduler/) queues, places, and — when
@@ -645,6 +730,66 @@ class ShardingSpec:
         return cls(**{a: int(d.get(a, -1 if a == "data" else 1)) for a in cls.AXES})
 
 
+# Mesh axes a multi-slice layout may legally place across the DCN
+# boundary: data/fsdp collectives are once-per-step gradient traffic
+# (latency-tolerant), pipeline's send/recv is deliberate stage transfer.
+# tensor/sequence are PER-LAYER collectives — a layout that puts them
+# across slices pays the slow link inside every matmul, and the GSPMD
+# partitioner's fallback for the resulting layout conflicts is the
+# "involuntary full rematerialization" reshard (MULTICHIP_r05).
+DCN_LEGAL_AXES = ("data", "fsdp", "expert", "pipeline")
+
+
+def dcn_crossing_axes(sizes: dict, num_slices: int,
+                      axes: tuple = ShardingSpec.AXES) -> tuple:
+    """Mesh axes whose coordinate change crosses a slice boundary.
+
+    DCN-major device order (parallel/mesh.py): flat participant position
+    = row-major index over ``axes``; slice id = position // chips_per_
+    slice. An axis crosses DCN iff two positions differing only in that
+    axis's coordinate land in different slices. Pure arithmetic, jax-free
+    — admission (validate() below) rejects layouts the partitioner would
+    only fail at compile time, deep inside the gang."""
+    if num_slices <= 1:
+        return ()
+    total = 1
+    for a in axes:
+        total *= int(sizes.get(a, 1))
+    if total % num_slices:
+        raise ValueError(
+            f"sharding axes product {total} not divisible by "
+            f"{num_slices} slices")
+    cps = total // num_slices
+    # strides of the row-major enumeration (innermost axis stride 1)
+    strides = {}
+    inner = 1
+    for a in reversed(axes):
+        strides[a] = inner
+        inner *= int(sizes.get(a, 1))
+    crossing = []
+    for a in axes:
+        size = int(sizes.get(a, 1))
+        if size <= 1:
+            continue
+        stride = strides[a]
+        # exact: two positions differing only in this axis's coordinate
+        # land in different slices. The sweep from any base covers
+        # base + c*stride, c in [0, size); bases are every position
+        # with this coordinate zero.
+        found = False
+        for base in range(total):
+            if (base // stride) % size:
+                continue   # not a coordinate-zero base for this axis
+            s0 = base // cps
+            if any((base + c * stride) // cps != s0
+                   for c in range(1, size)):
+                found = True
+                break
+        if found:
+            crossing.append(a)
+    return tuple(crossing)
+
+
 @dataclass
 class TrainingJob:
     """Typed view over a training-job manifest (any of the four kinds)."""
@@ -689,6 +834,10 @@ class TrainingJob:
     # the AOT serialized-executable rung of the warm-start ladder
     # (docs/operations.md "Warm starts and the compile cache")
     warm_start: WarmStartSpec = field(default_factory=WarmStartSpec)
+    # multi-slice execution knobs (spec.multislice → KFTPU_MULTISLICE_*):
+    # the MPMD pipeline-over-DCN path and its microbatch schedule
+    # (docs/training.md "Multi-slice training")
+    multislice: MultisliceSpec = field(default_factory=MultisliceSpec)
     # gang-scheduling knobs (spec.schedulingPolicy → the slice
     # scheduler's queue/priority/preemptible; None = not
     # scheduler-managed, the legacy immediate-create path)
@@ -762,6 +911,7 @@ class TrainingJob:
             input_spec=InputSpec.from_dict(spec.get("input")),
             obs_spec=ObsSpec.from_dict(spec.get("observability")),
             warm_start=WarmStartSpec.from_dict(spec.get("warmStart")),
+            multislice=MultisliceSpec.from_dict(spec.get("multislice")),
             scheduling_policy=SchedulingPolicy.from_dict(
                 spec.get("schedulingPolicy")),
             weight_update=spec.get("weightUpdate", "") or "",
@@ -803,6 +953,7 @@ class TrainingJob:
         self.input_spec.validate()
         self.obs_spec.validate()
         self.warm_start.validate()
+        self.multislice.validate()
         if self.scheduling_policy is not None:
             self.scheduling_policy.validate()
         vocab = REPLICA_TYPES[self.kind]
@@ -823,7 +974,33 @@ class TrainingJob:
                         "tpuTopology (e.g. v5e-32)")
                 # Resolving the sharding spec against the slice validates the
                 # axis product here, at admission time, not at runtime.
-                self.sharding.resolve(rs.topology.num_chips * rs.num_slices)
+                sizes = self.sharding.resolve(
+                    rs.topology.num_chips * rs.num_slices)
+                if rs.num_slices > 1:
+                    # DCN-aware layout rejection: a tensor/sequence axis
+                    # crossing the slice boundary puts PER-LAYER
+                    # collectives on the slow link and forces the SPMD
+                    # partitioner's involuntary-full-rematerialization
+                    # fallback (MULTICHIP_r05) — reject at apply, not at
+                    # compile deep inside the gang.
+                    bad = tuple(a for a in dcn_crossing_axes(
+                        sizes, rs.num_slices)
+                        if a not in DCN_LEGAL_AXES)
+                    if bad:
+                        raise ValueError(
+                            f"{self.kind} {self.name}: sharding axes "
+                            f"{list(bad)} would cross the DCN slice "
+                            f"boundary ({rs.num_slices} slices x "
+                            f"{rs.topology.num_chips} chips); only "
+                            f"{list(DCN_LEGAL_AXES)} may span slices — "
+                            f"move the parallelism intra-slice or use "
+                            f"spec.multislice.pipeline")
+                if self.multislice.pipeline_enabled and rs.num_slices < 2:
+                    raise ValueError(
+                        f"{self.kind} {self.name}: "
+                        f"multislice.pipeline requires numSlices >= 2 "
+                        f"(one program per slice needs slices to "
+                        f"program)")
                 policy = self.scheduling_policy
                 if policy is not None and policy.elastic:
                     # Elastic admission contract: the nominal shape must
@@ -919,6 +1096,8 @@ class TrainingJob:
             out["spec"]["observability"] = self.obs_spec.to_dict()
         if self.warm_start.to_dict():
             out["spec"]["warmStart"] = self.warm_start.to_dict()
+        if self.multislice.to_dict():
+            out["spec"]["multislice"] = self.multislice.to_dict()
         if self.scheduling_policy is not None:
             out["spec"]["schedulingPolicy"] = self.scheduling_policy.to_dict()
         if self.weight_update:

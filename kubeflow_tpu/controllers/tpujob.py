@@ -680,6 +680,11 @@ class TrainingJobReconciler(Reconciler):
         # depth (KFTPU_DEVICE_PREFETCH) — runtime/worker.py reads them
         # into the shared-memory augment ring / DevicePrefetcher
         env.update(job.input_spec.to_env())
+        # spec.multislice → KFTPU_MULTISLICE_PIPELINE/_MICROBATCHES: the
+        # MPMD pipeline-over-DCN path (one program per slice, explicit
+        # activation transfers — runtime/worker.py,
+        # parallel/multislice.py)
+        env.update(job.multislice.to_env())
         from ..runtime.compile_cache import (COMPILE_CACHE_ENV,
                                              SHARED_CACHE_ROOT_ENV,
                                              default_cache_dir,

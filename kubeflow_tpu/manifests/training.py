@@ -88,6 +88,15 @@ def _job_schema(specs_key: str, max_one: list[str]) -> dict:
             "aot": {"type": "boolean"},
             "aotDir": {"type": "string"},
         }},
+        # multi-slice execution knobs (api/trainingjob.py MultisliceSpec
+        # → KFTPU_MULTISLICE_PIPELINE / KFTPU_MULTISLICE_MICROBATCHES:
+        # the MPMD pipeline-over-DCN path, one program per slice with
+        # explicit activation transfers — parallel/multislice.py;
+        # tests/test_lint.py enforces the same full-path rule)
+        "multislice": {"type": "object", "properties": {
+            "pipeline": {"type": "boolean"},
+            "microbatches": {"type": "integer", "minimum": 1},
+        }},
         # persistent XLA compile cache dir override (defaults to the
         # namespace's shared cache when the operator carries
         # KFTPU_SHARED_CACHE_ROOT, else <checkpointDir>/.jax-compile-cache)
@@ -433,7 +442,11 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
                    span_path: str | None = None,
                    obs_metrics_port: int | None = None,
                    aot: bool | None = None,
-                   aot_dir: str | None = None) -> list[dict]:
+                   aot_dir: str | None = None,
+                   num_slices: int = 1,
+                   multislice_pipeline: bool | None = None,
+                   multislice_microbatches: int | None = None
+                   ) -> list[dict]:
     """fused_blocks opts into the ghost-BN fused bottleneck kernels
     (docs/training.md --fused-blocks; per-block batch/spatial routing).
     ``fused_routing`` pins the per-geometry kernel routing to a
@@ -483,7 +496,14 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
     WarmStartSpec → KFTPU_AOT / KFTPU_AOT_DIR): the AOT serialized-
     executable warm start — rebinds/resizes load the keyed compiled
     step and skip XLA entirely (docs/operations.md "Warm starts and
-    the compile cache")."""
+    the compile cache").
+
+    ``num_slices`` + ``multislice_pipeline``/``multislice_microbatches``
+    render a multi-slice gang and spec.multislice (api/trainingjob.py
+    MultisliceSpec → KFTPU_MULTISLICE_PIPELINE /
+    KFTPU_MULTISLICE_MICROBATCHES): the MPMD pipeline-over-DCN path —
+    one program per slice, explicit activation transfers, 1F1B
+    microbatch schedule (docs/training.md "Multi-slice training")."""
     command = ["python", "-m", "kubeflow_tpu.runtime.worker",
                "--workload", "resnet50",
                "--steps", str(steps),
@@ -528,13 +548,14 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
         restart_backoff_max_seconds=restart_backoff_max_seconds,
         stall_timeout_seconds=stall_timeout_seconds)
     job = k8s.make(TPU_API_VERSION, "TPUJob", name, namespace)
+    tpu_spec: dict = {
+        "tpuTopology": topology,
+        "template": {"spec": pod_spec},
+    }
+    if num_slices != 1:
+        tpu_spec["numSlices"] = num_slices
     job["spec"] = {
-        "replicaSpecs": {
-            "TPU": {
-                "tpuTopology": topology,
-                "template": {"spec": pod_spec},
-            },
-        },
+        "replicaSpecs": {"TPU": tpu_spec},
         "runPolicy": run_policy.to_dict(),
         "sharding": {"data": -1},
     }
@@ -569,6 +590,33 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
         wspec = WarmStartSpec(aot=aot, aot_dir=aot_dir)
         wspec.validate()
         job["spec"]["warmStart"] = wspec.to_dict()
+    if multislice_pipeline is not None or \
+            multislice_microbatches is not None:
+        from ..api.trainingjob import MultisliceSpec
+        mspec = MultisliceSpec(pipeline=multislice_pipeline,
+                               microbatches=multislice_microbatches)
+        mspec.validate()
+        job["spec"]["multislice"] = mspec.to_dict()
+        if mspec.pipeline_enabled:
+            if fused_blocks:
+                # the wholesale command rewrite below would silently
+                # drop --fused-blocks (and the MPMD path stages the
+                # pipelined LM, not a resnet) — same rule as
+                # fused_routing-without-fused_blocks above
+                raise ValueError(
+                    "fused_blocks and multislice_pipeline are mutually "
+                    "exclusive (the MPMD path runs the pipelined LM, "
+                    "not the fused-resnet workload)")
+            # the MPMD path stages the pipelined LM, not the image
+            # model; the CLI flag only rides along when the pipeline is
+            # actually ON (pipeline=False blocks keep the default
+            # command — the env render carries the knobs either way)
+            container["command"] = [
+                "python", "-m", "kubeflow_tpu.runtime.worker",
+                "--workload", "transformer-pipelined",
+                "--steps", str(steps),
+                "--global-batch", str(global_batch),
+                "--multislice-pipeline"]
     out.append(job)
     return out
 

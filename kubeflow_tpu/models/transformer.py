@@ -235,7 +235,11 @@ class PipelinedTransformerLM:
 
 # Param-path → logical axes. Order matters: first match wins.
 _LOGICAL_PATTERNS: list[tuple[str, tuple]] = [
-    (r"tok_embed.*embedding", ("vocab", "embed")),
+    # "vocab_table", not "vocab": the table is GATHER-indexed on this
+    # dim, and the DCN-aware rules replicate it on multi-slice meshes
+    # (parallel/sharding_rules.py dcn_unsafe) — the head's matmul
+    # "vocab" below stays tensor-sharded everywhere
+    (r"tok_embed.*embedding", ("vocab_table", "embed")),
     (r"pos_embed.*embedding", (None, "embed")),
     (r"attn/qkv.*kernel", ("embed", None, "heads", "head_dim")),
     (r"attn/out.*kernel", ("heads", "head_dim", "embed")),
@@ -386,6 +390,36 @@ def pipelined_workload_spec(cfg: Optional[TransformerConfig] = None,
         rules=TRANSFORMER_RULES,
         param_logical_axes=pipelined_logical_axes(abstract),
     )
+
+
+def multislice_stage_fns(cfg: TransformerConfig) -> tuple:
+    """The MPMD pipeline engine's stage contract
+    (parallel/multislice.MPMDPipeline) for the pipelined LM:
+    ``(init_fn, embed_fn, block_fn, head_loss_fn)``. ``init_fn`` is the
+    FULL PipelinedTransformerLM init (same rng → bit-identical params to
+    the single-program arm — the parity basis bench.py --mode multislice
+    asserts); the per-stage fns reuse the exact modules the GPipe path
+    applies, so stage math is the single-program math."""
+    if cfg.num_experts > 0:
+        raise NotImplementedError(
+            "MoE is not supported on the MPMD multislice path yet "
+            "(same limit as the single-program pipelined workload)")
+    model = PipelinedTransformerLM(cfg)
+
+    def init_fn(rng, seq_len=cfg.max_seq_len):
+        return model.init(rng, jnp.zeros((2, seq_len), jnp.int32))
+
+    def embed_fn(embed_params, tokens):
+        return model.embed.apply({"params": embed_params}, tokens)
+
+    def block_fn(layer_params, h):
+        return model.block.apply({"params": layer_params}, h)
+
+    def head_loss_fn(head_params, h, tokens):
+        logits = model.head.apply({"params": head_params}, h)
+        return next_token_loss(logits, tokens)
+
+    return init_fn, embed_fn, block_fn, head_loss_fn
 
 
 def workload_spec(cfg: Optional[TransformerConfig] = None,

@@ -102,6 +102,20 @@ COMM_TOP_OPS_ENV = "KFTPU_COMM_TOP_OPS"
 # conservative: an unattributed DCN reshard should flag, not hide.
 UPDATE_REGION_FILES = ("trainstep.py",)
 
+# Ops emitted by the pipeline engines' OWN send/recv (the GPipe
+# ppermute in parallel/pipeline.py; any collective a multislice stage
+# program carries) are DELIBERATE activation traffic: a pipeline mesh
+# spanning slices pays the DCN hop by design, and the full-reshard
+# detector must never misread it as the involuntary-remat pathology —
+# ops attributed to these files carry phase="pipeline" and the detector
+# skips them.
+PIPELINE_REGION_FILES = ("pipeline.py", "multislice.py")
+
+# op phases (the by-(link, op) table's per-row breakdown)
+PHASE_MODEL = "model"        # forward/backward
+PHASE_UPDATE = "update"      # optimizer update / param re-gather
+PHASE_PIPELINE = "pipeline"  # deliberate stage send/recv
+
 _DTYPE_BYTES = {
     "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
     "f8e4m3fn": 1, "f8e4m3": 1, "f8e5m2": 1,
@@ -156,9 +170,22 @@ class CollectiveOp:
     def in_update_region(self) -> bool:
         return os.path.basename(self.source_file) in UPDATE_REGION_FILES
 
+    @property
+    def phase(self) -> str:
+        """Which region of the step this op belongs to: "pipeline"
+        (deliberate stage send/recv — detector-exempt), "update"
+        (optimizer/param re-gather), else "model"."""
+        base = os.path.basename(self.source_file)
+        if base in PIPELINE_REGION_FILES:
+            return PHASE_PIPELINE
+        if base in UPDATE_REGION_FILES:
+            return PHASE_UPDATE
+        return PHASE_MODEL
+
     def to_dict(self) -> dict:
         return {
             "name": self.name, "kind": self.kind, "link": self.link,
+            "phase": self.phase,
             "payloadBytes": int(self.payload_bytes),
             "dcnBytes": round(self.dcn_bytes, 1),
             "iciBytes": round(self.ici_bytes, 1),
@@ -428,15 +455,22 @@ class CommProfile:
         under (dcn, kind) — a DCN-crossing collective has BOTH phases,
         so this is what makes the per-link gauge sums reconcile with
         ``ici_bytes_per_step`` / ``dcn_bytes_per_step`` (a DCN row may
-        therefore carry a zero-count ici sibling row)."""
+        therefore carry a zero-count ici sibling row). Each row also
+        breaks its count down by op phase (``phases``: model / update /
+        pipeline) — deliberate pipeline send/recv traffic is visibly
+        labeled, never mistakable for a pathological reshard (the
+        detector skips phase=pipeline ops outright)."""
         out: dict = {}
 
         def row(link, kind):
             return out.setdefault((link, kind),
-                                  {"count": 0, "bytes": 0.0})
+                                  {"count": 0, "bytes": 0.0,
+                                   "phases": {}})
 
         for o in self.ops:
-            row(o.link, o.kind)["count"] += 1
+            r = row(o.link, o.kind)
+            r["count"] += 1
+            r["phases"][o.phase] = r["phases"].get(o.phase, 0) + 1
             if o.dcn_bytes:
                 row(LINK_DCN, o.kind)["bytes"] += o.dcn_bytes
             if o.ici_bytes:
@@ -469,7 +503,8 @@ class CommProfile:
                 link: self.collectives(link)
                 for link in (LINK_DCN, LINK_ICI, LINK_LOCAL)},
             "byLinkOp": {f"{link}/{kind}": {
-                "count": row["count"], "bytes": round(row["bytes"], 1)}
+                "count": row["count"], "bytes": round(row["bytes"], 1),
+                "phases": dict(sorted(row["phases"].items()))}
                 for (link, kind), row in sorted(self.by_link_op().items())},
             "modeledSeconds": {
                 "ici": self.modeled_ici_seconds,
@@ -623,15 +658,18 @@ def detect_full_reshard(profile: CommProfile) -> ReshardVerdict:
     re-layout paying the slow link every step — exactly what SPMD's
     "replicate the tensor and then partition it" last resort emits.
     Legitimate DCN traffic never matches: gradient reductions are
-    all-reduce/reduce-scatter, and the ZeRO-2 param re-gather carries
-    update-region (trainstep.py) metadata. An op with no source
-    metadata counts as model-region — an unattributed DCN reshard
-    should flag, not hide."""
+    all-reduce/reduce-scatter, the ZeRO-2 param re-gather carries
+    update-region (trainstep.py) metadata, and pipeline stage
+    send/recv (phase=pipeline: the GPipe ppermute in pipeline.py, any
+    multislice stage transfer) is DELIBERATE activation traffic —
+    skipped outright, a pipeline mesh spanning slices pays that hop by
+    design. An op with no source metadata counts as model-region — an
+    unattributed DCN reshard should flag, not hide."""
     offenders = [
         op for op in profile.ops
         if op.link == LINK_DCN
         and op.kind in ("all-gather", "collective-permute")
-        and not op.in_update_region
+        and op.phase == PHASE_MODEL
     ]
     if not offenders:
         return ReshardVerdict(

@@ -52,11 +52,16 @@ BADPUT_CHECKPOINT = "checkpoint"          # save submission + restore
 BADPUT_RECOMPUTE = "restart_recompute"    # steps re-executed after resume
 BADPUT_RESIZE = "resize"                  # resize/migration downtime
 BADPUT_STALL = "stall"                    # wedged → watchdog teardown
+BADPUT_PIPELINE_BUBBLE = "pipeline_bubble"  # MPMD pipeline fill/drain
+#                                           idle (parallel/multislice.py
+#                                           schedule model; the worker
+#                                           emits per-window
+#                                           pipeline-bubble spans)
 BADPUT_OTHER = "other"                    # unattributed residual
 
 BADPUT_CATEGORIES = (BADPUT_QUEUE_WAIT, BADPUT_STARTUP, BADPUT_COMPILE,
                      BADPUT_CHECKPOINT, BADPUT_RECOMPUTE, BADPUT_RESIZE,
-                     BADPUT_STALL, BADPUT_OTHER)
+                     BADPUT_STALL, BADPUT_PIPELINE_BUBBLE, BADPUT_OTHER)
 
 # the operator stamps a job's final ledger here on completion
 # (controllers/tpujob.py _finalize_ledger) so the decomposition survives
@@ -330,6 +335,11 @@ def serving_rollup(path: str) -> dict:
 # scheduler events: queued/bound/preempted/resized/restarting/...)
 SPAN_CKPT_SAVE = "ckpt-save"
 SPAN_CKPT_RESTORE = "ckpt-restore"
+# per-window MPMD schedule-idle interval (runtime/worker.py sizes it to
+# the engine's measured bubble seconds, anchored at the window's tail —
+# a modeled attribution inside a real interval, documented in
+# docs/operations.md "Goodput accounting")
+SPAN_PIPELINE_BUBBLE = "pipeline-bubble"
 
 # overlap resolution: when two attributed intervals claim the same time,
 # the LOWEST number wins. Compile outranks the windows (the first window
@@ -340,12 +350,16 @@ SPAN_CKPT_RESTORE = "ckpt-restore"
 _PRIORITY = {
     BADPUT_COMPILE: 0,
     BADPUT_RECOMPUTE: 1,
-    GOODPUT: 2,
-    BADPUT_CHECKPOINT: 3,
-    BADPUT_STALL: 4,
-    BADPUT_RESIZE: 5,
-    BADPUT_QUEUE_WAIT: 6,
-    BADPUT_STARTUP: 7,
+    # above goodput: a bubble span carves schedule-idle time OUT of the
+    # window interval it overlaps (the worker sizes it to the measured
+    # bubble seconds of that window's steps)
+    BADPUT_PIPELINE_BUBBLE: 2,
+    GOODPUT: 3,
+    BADPUT_CHECKPOINT: 4,
+    BADPUT_STALL: 5,
+    BADPUT_RESIZE: 6,
+    BADPUT_QUEUE_WAIT: 7,
+    BADPUT_STARTUP: 8,
 }
 
 # operator restart reasons that read as a stall (controllers/tpujob.py)
@@ -493,6 +507,9 @@ def decompose(spans: list[dict]) -> dict:
         elif name in (SPAN_CKPT_SAVE, SPAN_CKPT_RESTORE):
             if end > start:
                 segments.append((start, end, BADPUT_CHECKPOINT))
+        elif name == SPAN_PIPELINE_BUBBLE:
+            if end > start:
+                segments.append((start, end, BADPUT_PIPELINE_BUBBLE))
         elif name == "resized":
             # binding rewritten → gang restarts at the new shape; the
             # downtime runs to the worker's next sign of life

@@ -24,10 +24,11 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..api.topology import TopologyContract
-from ..api.trainingjob import ShardingSpec
+from ..api.trainingjob import ShardingSpec, dcn_crossing_axes
 
 # Canonical axis order (DCN-major). "data" first: multi-slice DP rides DCN.
 MESH_AXES = ShardingSpec.AXES  # ("data", "fsdp", "expert", "pipeline", "sequence", "tensor")
+
 
 
 def mesh_shape_from_sharding(sharding: ShardingSpec, num_devices: int) -> dict[str, int]:
@@ -69,6 +70,30 @@ def mesh_from_contract(contract: TopologyContract,
             f"jax sees {len(devices)} devices — slice not fully up?"
         )
     return build_mesh(sharding, devices)
+
+
+def num_slices_of(mesh: Mesh) -> int:
+    """Slices this mesh spans, from the devices' own ``slice_index``
+    (real multi-slice TPU backends stamp it; virtual CPU devices do not
+    — callers that emulate slices pass their count explicitly). Jax
+    interns Mesh instances (two constructions over the same devices are
+    the SAME object), so the count deliberately lives on the devices /
+    the caller, never as mutable Mesh state."""
+    indices = {getattr(d, "slice_index", None) for d in mesh.devices.flat}
+    indices.discard(None)
+    return max(1, len(indices))
+
+
+def slice_crossing_axes(mesh: Mesh,
+                        num_slices: Optional[int] = None) -> tuple:
+    """Mesh axes whose transitions cross the DCN slice boundary (the
+    jax-side wrapper over the jax-free ``api.trainingjob.
+    dcn_crossing_axes`` — DCN-major row-major enumeration, slice id =
+    flat position // chips_per_slice)."""
+    n = num_slices if num_slices is not None else num_slices_of(mesh)
+    return dcn_crossing_axes(
+        {a: int(mesh.shape[a]) for a in mesh.axis_names}, n,
+        axes=tuple(mesh.axis_names))
 
 
 def data_axes(mesh: Mesh) -> tuple[str, ...]:

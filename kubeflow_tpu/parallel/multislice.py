@@ -1,0 +1,708 @@
+"""MPMD pipeline-over-DCN: one program per slice, explicit transfers.
+
+The single-program GSPMD path treats a multi-slice job as one SPMD
+computation over one global mesh — every cross-slice layout transition
+becomes a compiler-inserted collective on the slow DCN link, and a
+layout conflict becomes the "involuntary full rematerialization"
+reshard (MULTICHIP_r05). This module is the other architecture
+("Scaling Deep Learning Training with MPMD Pipeline Parallelism",
+PAPERS.md): pipeline stages as SEPARATE programs, one per slice, each
+compiled against its own per-slice mesh, with activations/gradients
+moved across the DCN boundary by EXPLICIT ``jax.device_put`` transfers
+the schedule controls — DCN traffic is exactly the activation tensors,
+never a partitioner surprise.
+
+Shape of the engine:
+
+- **Stages** come from the existing block partitioning
+  (parallel/pipeline.py): the stacked ``[L, ...]`` block params split
+  into ``S`` contiguous chunks; stage 0 additionally owns the embedder,
+  stage S-1 the head + loss. Per-stage meshes are chosen INDEPENDENTLY
+  (pure data-parallel over the slice's chips by default — tensor axes
+  never cross DCN by construction).
+- **Programs** per stage: forward (mid stages), a fused
+  forward+loss+backward for the last stage, backward-with-recompute for
+  the others (activations are recomputed inside the stage's backward
+  program instead of stashing VJP residuals across host boundaries —
+  the standard remat trade), and a shard-local optimizer update.
+- **Schedule**: microbatched 1F1B — warmup forwards, steady one-
+  forward-one-backward, drain — executed as a dependency-driven
+  round-robin over stages (a valid linearization on one host; on real
+  multi-slice deployments each slice runs only its own column).
+  Per-op wall times feed a list-schedule model that reports the
+  pipeline-bubble fraction and per-stage busy time; bubble seconds
+  become the ``pipeline_bubble`` badput category in the goodput ledger
+  (obs/goodput.py).
+- **Accounting**: every explicit cross-stage transfer is counted
+  (direction, bytes), so DCN bytes/step is measured from the transfers
+  the schedule actually made — comparable against the single-program
+  arm's modeled HLO bytes (bench.py --mode multislice).
+
+Gradient semantics: microbatch losses are per-microbatch means, so the
+step's gradient is the microbatch-gradient mean (equal microbatch
+sizes); global-norm clipping is applied across ALL stages (per-stage
+squared norms summed on host — the cross-stage scalar every stage's
+update consumes), so the math matches the single-program
+``optax.clip_by_global_norm`` + per-leaf optimizer exactly; parity is
+asserted to <=1e-5 by the bench.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PyTree = Any
+
+# program kinds in the schedule (also the AOT-export key suffix)
+FWD = "fwd"
+BWD = "bwd"
+FWDBWD = "fwdbwd"   # the last stage's fused forward+loss+backward
+
+
+def slice_device_groups(devices: Sequence, num_slices: int) -> list:
+    """Split the global device list into per-slice groups (DCN-major
+    enumeration: slice i = the i-th contiguous chunk — the same
+    convention as obs/collectives.slice_assignment)."""
+    devices = list(devices)
+    if num_slices < 1 or len(devices) % num_slices:
+        raise ValueError(
+            f"{len(devices)} devices do not split into "
+            f"{num_slices} slices")
+    per = len(devices) // num_slices
+    return [devices[i * per:(i + 1) * per] for i in range(num_slices)]
+
+
+def stage_meshes(devices: Sequence, num_slices: int) -> list[Mesh]:
+    """One pure-DP mesh per slice ("data" over the slice's chips).
+    Per-stage meshes are independent by construction — a stage could
+    refine to data x tensor inside its slice without touching the
+    others; the DP default keeps every collective intra-slice."""
+    return [Mesh(np.asarray(g), ("data",))
+            for g in slice_device_groups(devices, num_slices)]
+
+
+def partition_stacked(params: PyTree, num_stages: int) -> list[PyTree]:
+    """Split stacked block params (leading ``layers`` dim,
+    parallel/pipeline.py convention) into ``num_stages`` contiguous
+    per-stage chunks."""
+    leaves = jax.tree.leaves(params)
+    if not leaves:
+        raise ValueError("no stacked block params to partition")
+    num_layers = leaves[0].shape[0]
+    if num_layers % num_stages:
+        raise ValueError(
+            f"{num_layers} layers not divisible by {num_stages} stages")
+    per = num_layers // num_stages
+    return [jax.tree.map(lambda l, s=s: l[s * per:(s + 1) * per], params)
+            for s in range(num_stages)]
+
+
+# --------------------------------------------------------------------------
+# 1F1B schedule: per-stage op order + the measured-duration timeline model
+
+
+def stage_op_order(stage: int, num_stages: int,
+                   num_micro: int) -> list[tuple[str, int]]:
+    """The 1F1B op sequence for one stage: warmup forwards, steady
+    one-backward-one-forward, drain backwards. The last stage runs the
+    fused forward+backward per microbatch (zero warmup)."""
+    if num_stages == 1:
+        return [(FWDBWD, m) for m in range(num_micro)]
+    if stage == num_stages - 1:
+        return [(FWDBWD, m) for m in range(num_micro)]
+    warmup = min(num_micro, num_stages - 1 - stage)
+    ops: list[tuple[str, int]] = [(FWD, m) for m in range(warmup)]
+    nf, nb = warmup, 0
+    # steady state is forward-FIRST (fwd k+warmup, then bwd k): the
+    # stage keeps S-1-stage activations in flight, so its forward for
+    # the NEXT microbatch overlaps downstream stages' work — ordering
+    # the backward first would serialize the whole pipeline
+    while nf < num_micro:
+        ops.append((FWD, nf))
+        nf += 1
+        ops.append((BWD, nb))
+        nb += 1
+    while nb < num_micro:
+        ops.append((BWD, nb))
+        nb += 1
+    return ops
+
+
+def _deps(kind: str, stage: int, micro: int,
+          num_stages: int) -> list[tuple[str, int, int]]:
+    """Cross-stage dependencies of one schedule op (intra-stage order is
+    the stage's own op list)."""
+    deps = []
+    if kind in (FWD, FWDBWD) and stage > 0:
+        deps.append((FWD, stage - 1, micro))
+    if kind == BWD and stage < num_stages - 1:
+        prev = FWDBWD if stage + 1 == num_stages - 1 else BWD
+        deps.append((prev, stage + 1, micro))
+    return deps
+
+
+@dataclass
+class ScheduleReport:
+    """The modeled parallel timeline of one executed step, from measured
+    per-op durations + modeled transfer latency. On a real multi-slice
+    deployment every stage is its own hardware and the makespan is the
+    wall clock; on the CPU emulation stages share host cores and run
+    serially, so the model (not the serial wall) is the honest bubble
+    number — stated wherever it is reported (PERF.md)."""
+
+    num_stages: int
+    num_microbatches: int
+    makespan_s: float           # modeled parallel wall of one step
+    stage_busy_s: list          # per-stage sum of op durations
+    bubble_s: float             # sum over stages of (makespan - busy)
+    bubble_fraction: float      # bubble_s / (num_stages * makespan)
+    serial_wall_s: float        # measured host wall (CPU-serial)
+    dcn_bytes: int              # explicit cross-stage transfer bytes
+    dcn_transfers: int
+
+    def to_dict(self) -> dict:
+        return {
+            "numStages": self.num_stages,
+            "numMicrobatches": self.num_microbatches,
+            "makespanS": round(self.makespan_s, 6),
+            "stageBusyS": [round(b, 6) for b in self.stage_busy_s],
+            "bubbleS": round(self.bubble_s, 6),
+            "bubbleFraction": round(self.bubble_fraction, 6),
+            "serialWallS": round(self.serial_wall_s, 6),
+            "dcnBytesPerStep": int(self.dcn_bytes),
+            "dcnTransfersPerStep": int(self.dcn_transfers),
+            # the analytic GPipe bound for reference: (S-1)/(M+S-1)
+            "idealBubbleFraction": round(
+                (self.num_stages - 1) /
+                (self.num_microbatches + self.num_stages - 1), 6),
+        }
+
+
+def model_schedule(durations: dict, num_stages: int, num_micro: int,
+                   transfer_s: float = 0.0,
+                   serial_wall_s: float = 0.0,
+                   dcn_bytes: int = 0,
+                   dcn_transfers: int = 0) -> ScheduleReport:
+    """List-schedule the 1F1B grid with measured op durations:
+    each stage is a serial resource executing its op order; an op starts
+    at max(stage free, deps done + transfer). Returns the makespan /
+    per-stage busy / bubble decomposition."""
+    finish: dict = {}
+    free = [0.0] * num_stages
+    busy = [0.0] * num_stages
+    orders = [stage_op_order(s, num_stages, num_micro)
+              for s in range(num_stages)]
+    cursor = [0] * num_stages
+    remaining = sum(len(o) for o in orders)
+    while remaining:
+        progressed = False
+        for s in range(num_stages):
+            if cursor[s] >= len(orders[s]):
+                continue
+            kind, m = orders[s][cursor[s]]
+            deps = _deps(kind, s, m, num_stages)
+            if any(d not in finish for d in deps):
+                continue
+            ready = max([finish[d] + transfer_s for d in deps],
+                        default=0.0)
+            start = max(free[s], ready)
+            dur = float(durations.get((kind, s, m), 0.0))
+            finish[(kind, s, m)] = start + dur
+            free[s] = start + dur
+            busy[s] += dur
+            cursor[s] += 1
+            remaining -= 1
+            progressed = True
+        if not progressed:   # defensive: a dep cycle would spin forever
+            raise RuntimeError("1F1B schedule deadlocked (bad deps)")
+    makespan = max(free) if num_stages else 0.0
+    bubble = sum(max(0.0, makespan - b) for b in busy)
+    return ScheduleReport(
+        num_stages=num_stages, num_microbatches=num_micro,
+        makespan_s=makespan, stage_busy_s=busy, bubble_s=bubble,
+        bubble_fraction=(bubble / (num_stages * makespan)
+                        if makespan > 0 else 0.0),
+        serial_wall_s=serial_wall_s, dcn_bytes=dcn_bytes,
+        dcn_transfers=dcn_transfers)
+
+
+# --------------------------------------------------------------------------
+# the engine
+
+
+@dataclass
+class MultisliceState:
+    """Per-stage training state: params/opt_state lists indexed by
+    stage, each resident on its own slice's mesh."""
+
+    step: jax.Array
+    params: list
+    opt_state: list
+
+
+jax.tree_util.register_dataclass(
+    MultisliceState,
+    data_fields=["step", "params", "opt_state"],
+    meta_fields=[],
+)
+
+
+@dataclass
+class MPMDPipeline:
+    """The per-slice-program train step (see module docstring).
+
+    Stage functions (the PipelinedTransformerLM contract,
+    models/transformer.py):
+
+    - ``embed_fn(embed_params, tokens) -> h``          (stage 0 prologue)
+    - ``block_fn(layer_params, h) -> h``               (one block; each
+      stage scans its chunk — parallel/pipeline.py BlockFn)
+    - ``head_loss_fn(head_params, h, tokens) -> (loss, aux)``
+                                                        (stage S-1)
+
+    ``optimizer`` must be a per-leaf transform (adamw, sgd, ...);
+    cross-leaf global-norm clipping is the engine's own
+    ``grad_clip_norm`` — applied across ALL stages' gradients, exactly
+    like ``optax.clip_by_global_norm`` in the single-program chain.
+    """
+
+    meshes: list                   # one per stage (stage_meshes)
+    embed_fn: Callable
+    block_fn: Callable
+    head_loss_fn: Callable
+    optimizer: Any                 # optax.GradientTransformation
+    num_microbatches: int
+    grad_clip_norm: Optional[float] = None
+    # modeled per-transfer DCN latency for the schedule model (the
+    # emulation's device_put does not traverse a real DCN link);
+    # bytes/bandwidth at the comm model's default DCN rate when None
+    transfer_seconds: Optional[float] = None
+    last_report: Optional[ScheduleReport] = field(default=None,
+                                                  init=False)
+    _programs: dict = field(default_factory=dict, init=False)
+    _example_args: dict = field(default_factory=dict, init=False)
+
+    def __post_init__(self):
+        if self.num_microbatches < 1:
+            raise ValueError("num_microbatches must be >= 1")
+        if not self.meshes:
+            raise ValueError("need at least one stage mesh")
+        # sharding-invariant RNG, same rationale as TrainStepBuilder
+        jax.config.update("jax_threefry_partitionable", True)
+
+    @property
+    def num_stages(self) -> int:
+        return len(self.meshes)
+
+    # -- placement ---------------------------------------------------------
+
+    def _batch_sharding(self, stage: int) -> NamedSharding:
+        return NamedSharding(self.meshes[stage], P("data"))
+
+    def _replicated(self, stage: int) -> NamedSharding:
+        return NamedSharding(self.meshes[stage], P())
+
+    def place_batch(self, batch: PyTree) -> PyTree:
+        """HOST placement, deliberately: the schedule feeds ONE
+        microbatch per tick (stage 0's data sharding) and the last
+        stage its targets, each an explicit device_put — pre-placing
+        the whole global batch on stage 0 would only be copied back to
+        host and re-split every step. Keeping the batch as numpy makes
+        the per-step split free and the per-microbatch H2D the only
+        transfer."""
+        return jax.tree.map(np.asarray, batch)
+
+    # -- init --------------------------------------------------------------
+
+    def init(self, full_init_fn: Callable[[jax.Array], PyTree],
+             rng: jax.Array) -> MultisliceState:
+        """Initialize from the FULL pipelined param tree
+        (``{"embed", "blocks", "head"}`` — PipelinedTransformerLM.init)
+        so MPMD and single-program arms share bit-identical initial
+        params, then partition: stage 0 owns embed + its block chunk,
+        stage S-1 its chunk + head."""
+        full = full_init_fn(rng)
+        chunks = partition_stacked(full["blocks"], self.num_stages)
+        params = []
+        for s in range(self.num_stages):
+            p: dict = {"blocks": chunks[s]}
+            if s == 0:
+                p["embed"] = full["embed"]
+            if s == self.num_stages - 1:
+                p["head"] = full["head"]
+            params.append(jax.device_put(p, self._replicated(s)))
+        opt = [jax.device_put(self.optimizer.init(p),
+                              self._replicated(s))
+               for s, p in enumerate(params)]
+        return MultisliceState(step=jnp.zeros((), jnp.int32),
+                               params=params, opt_state=opt)
+
+    # -- per-stage programs (jitted lazily, cached) ------------------------
+
+    def _stage_fwd(self, params: dict, x) :
+        """One stage's forward: embed (stage 0) + scan its block chunk.
+        The head is NOT applied here — the last stage runs fused."""
+        if "embed" in params:
+            x = self.embed_fn(params["embed"], x)
+
+        def body(h, p_layer):
+            return self.block_fn(p_layer, h), None
+
+        h, _ = jax.lax.scan(body, x, params["blocks"])
+        return h
+
+    def _program(self, kind: str, stage: int) -> Callable:
+        key = (kind, stage)
+        prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+        mesh = self.meshes[stage]
+        if kind == FWD:
+            def run(params, x):
+                return self._stage_fwd(params, x)
+        elif kind == FWDBWD:
+            # the last stage: forward through its blocks + head, loss,
+            # and the backward in ONE program (no separate fwd op — the
+            # 1F1B grid treats it as one op on this stage). A
+            # single-stage pipeline's input is the integer tokens — no
+            # activation cotangent exists to return.
+            x_differentiable = stage > 0
+
+            def run(params, x, tokens):
+                def f(p, h):
+                    h = self._stage_fwd({k: v for k, v in p.items()
+                                         if k != "head"}, h)
+                    loss, aux = self.head_loss_fn(p["head"], h, tokens)
+                    return loss, aux
+                argnums = (0, 1) if x_differentiable else (0,)
+                (loss, aux), grads = jax.value_and_grad(
+                    f, argnums=argnums, has_aux=True)(params, x)
+                dx = grads[1] if x_differentiable else None
+                return loss, aux, grads[0], dx
+        elif kind == BWD:
+            # backward with in-program forward recompute: dL/dparams and
+            # dL/dx from the incoming output cotangent
+            def run(params, x, g):
+                out, vjp = jax.vjp(
+                    lambda p, h: self._stage_fwd(p, h), params, x)
+                dparams, dx = vjp(g)
+                return dparams, dx
+        else:
+            raise ValueError(kind)
+        with mesh:
+            prog = jax.jit(run)
+        self._programs[key] = prog
+        return prog
+
+    def _update_program(self, stage: int) -> Callable:
+        key = ("update", stage)
+        prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+
+        def run(params, opt_state, grad_acc, scale):
+            # scale folds the microbatch average AND the cross-stage
+            # global-norm clip factor (computed on host from every
+            # stage's squared norm) into one elementwise multiply
+            grads = jax.tree.map(lambda g: g * scale, grad_acc)
+            updates, new_opt = self.optimizer.update(
+                grads, opt_state, params)
+            import optax
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt
+
+        with self.meshes[stage]:
+            prog = jax.jit(run)
+        self._programs[key] = prog
+        return prog
+
+    def _sqnorm_program(self, stage: int) -> Callable:
+        key = ("sqnorm", stage)
+        prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+
+        def run(grads):
+            return sum(jnp.sum(jnp.square(g))
+                       for g in jax.tree.leaves(grads))
+
+        with self.meshes[stage]:
+            prog = jax.jit(run)
+        self._programs[key] = prog
+        return prog
+
+    # -- the step ----------------------------------------------------------
+
+    def _transfer(self, x, stage: int, record: list):
+        """Explicit cross-stage transfer — THE DCN hop. Bytes counted
+        per transfer; on real multi-slice hardware this is the
+        host/ICI->DCN send-recv the MPMD paper schedules explicitly."""
+        y = jax.device_put(x, self._batch_sharding(stage))
+        record.append(int(getattr(x, "nbytes", 0)))
+        return y
+
+    def step(self, state: MultisliceState,
+             batch: PyTree) -> tuple[MultisliceState, dict]:
+        S = self.num_stages
+        M = self.num_microbatches
+        tokens = batch["tokens"]
+        B = tokens.shape[0]
+        if B % M:
+            raise ValueError(
+                f"global batch {B} not divisible by {M} microbatches")
+        mb = B // M
+        for s, mesh in enumerate(self.meshes):
+            dp = int(mesh.shape.get("data", 1))
+            if mb % dp:
+                raise ValueError(
+                    f"microbatch of {mb} rows (global {B} / {M} "
+                    f"microbatches) not divisible by stage {s}'s "
+                    f"{dp}-way data axis")
+        t_wall0 = time.perf_counter()
+        transfers: list[int] = []
+        durations: dict = {}
+        # per-microbatch buffers
+        fwd_out: dict = {}      # (stage, micro) -> activation (on stage)
+        cot_in: dict = {}       # (stage, micro) -> incoming cotangent
+        grad_acc: list = [None] * S
+        losses: list = []
+        auxes: list = []
+
+        # microbatch split on host, each placed on stage 0's mesh (the
+        # schedule feeds one microbatch per tick; place_batch keeps
+        # the batch host-side so this split is free — np.asarray is a
+        # no-op on numpy input, a one-time D2H only if the caller fed
+        # a device array directly)
+        tok_host = np.asarray(tokens)
+        micro_tok = [jax.device_put(tok_host[m * mb:(m + 1) * mb],
+                                    self._batch_sharding(0))
+                     for m in range(M)]
+
+        def run_op(kind, s, m):
+            t0 = time.perf_counter()
+            if kind == FWD:
+                x = micro_tok[m] if s == 0 else \
+                    self._transfer(fwd_out[(s - 1, m)], s, transfers)
+                out = self._program(FWD, s)(state.params[s], x)
+                jax.block_until_ready(out)
+                fwd_out[(s, m)] = out
+            elif kind == FWDBWD:
+                if S == 1:
+                    x = micro_tok[m]
+                    tok = micro_tok[m]
+                else:
+                    x = self._transfer(
+                        fwd_out.pop((s - 1, m)), s, transfers)
+                    tok = self._transfer(micro_tok[m], s, transfers)
+                loss, aux, dparams, dx = self._program(FWDBWD, s)(
+                    state.params[s], x, tok)
+                jax.block_until_ready(loss)
+                losses.append(loss)
+                auxes.append(aux)
+                _accumulate(grad_acc, s, dparams)
+                if S > 1:
+                    cot_in[(s - 1, m)] = dx
+            else:  # BWD
+                g = self._transfer(cot_in.pop((s, m)), s, transfers)
+                x = micro_tok[m] if s == 0 else fwd_out[(s - 1, m)]
+                if s > 0:
+                    # the saved input activation already lives on the
+                    # PREVIOUS stage's mesh; moving it back is part of
+                    # this stage's recompute cost on the emulation (a
+                    # real deployment stashes its own input locally) —
+                    # placed, not counted as DCN (it never left this
+                    # boundary's pair on hardware)
+                    x = jax.device_put(x, self._batch_sharding(s))
+                dparams, dx = self._program(BWD, s)(state.params[s], x, g)
+                jax.block_until_ready(dparams)
+                _accumulate(grad_acc, s, dparams)
+                if s > 0:
+                    cot_in[(s - 1, m)] = dx
+                fwd_out.pop((s - 1, m), None)
+            durations[(kind, s, m)] = time.perf_counter() - t0
+
+        # dependency-driven round-robin over the per-stage 1F1B orders —
+        # a valid linearization of the parallel schedule on one host
+        orders = [stage_op_order(s, S, M) for s in range(S)]
+        cursor = [0] * S
+        done: set = set()
+        remaining = sum(len(o) for o in orders)
+        while remaining:
+            progressed = False
+            for s in range(S):
+                if cursor[s] >= len(orders[s]):
+                    continue
+                kind, m = orders[s][cursor[s]]
+                if any(d not in done for d in _deps(kind, s, m, S)):
+                    continue
+                run_op(kind, s, m)
+                done.add((kind, s, m))
+                cursor[s] += 1
+                remaining -= 1
+                progressed = True
+            if not progressed:
+                raise RuntimeError("1F1B execution deadlocked")
+
+        # cross-stage global-norm clip + per-stage updates
+        sq = [float(self._sqnorm_program(s)(grad_acc[s]))
+              for s in range(S)]
+        gnorm = float(np.sqrt(sum(sq))) / M   # norm of the averaged grad
+        scale = 1.0 / M
+        if self.grad_clip_norm is not None and \
+                gnorm > self.grad_clip_norm:
+            scale *= self.grad_clip_norm / gnorm
+        new_params = []
+        new_opt = []
+        for s in range(S):
+            p, o = self._update_program(s)(
+                state.params[s], state.opt_state[s], grad_acc[s],
+                jnp.float32(scale))
+            new_params.append(p)
+            new_opt.append(o)
+        jax.block_until_ready(new_params)
+        serial_wall = time.perf_counter() - t_wall0
+
+        dcn_bytes = sum(transfers)
+        xfer_s = self.transfer_seconds
+        if xfer_s is None:
+            from ..obs.collectives import DCN_GBPS_ENV, DEFAULT_DCN_GBPS, _bw
+            per = (dcn_bytes / max(1, len(transfers))) if transfers else 0
+            xfer_s = per / (_bw(DCN_GBPS_ENV, DEFAULT_DCN_GBPS) * 1e9)
+        self.last_report = model_schedule(
+            durations, S, M, transfer_s=xfer_s,
+            serial_wall_s=serial_wall, dcn_bytes=dcn_bytes,
+            dcn_transfers=len(transfers))
+
+        loss = float(np.mean([float(l) for l in losses]))
+        # pipeline_bubble_s is the WALL-clock-equivalent idle: bubble_s
+        # is stage-seconds (summed over S stages), so the per-step wall
+        # share is bubble_s / S = bubble_fraction x makespan — the
+        # number the goodput ledger may charge against one wall clock
+        # (charging raw stage-seconds would overstate badput S-fold)
+        metrics = {"loss": loss, "grad_norm": gnorm,
+                   "pipeline_bubble_s":
+                       float(self.last_report.bubble_s / max(1, S)),
+                   "bubble_fraction":
+                       float(self.last_report.bubble_fraction)}
+        for k in (auxes[0] if auxes else {}):
+            metrics.setdefault(
+                k, float(np.mean([float(a[k]) for a in auxes])))
+        return MultisliceState(step=state.step + 1, params=new_params,
+                               opt_state=new_opt), metrics
+
+    __call__ = step
+
+    # -- per-stage AOT export ----------------------------------------------
+
+    def reset_programs(self) -> None:
+        """Drop every cached/loaded stage program — the last rung of
+        the AOT fallback ladder (a loaded executable that fails at its
+        first dispatch recompiles fresh via the jit path)."""
+        self._programs.clear()
+
+    def stage_hlo(self, kind: str, stage: int, *abstract_args) -> str:
+        """The compiled HLO of one stage program (comm-analyzer input:
+        per-stage programs must carry NO cross-slice collectives — every
+        DCN byte is an explicit transfer)."""
+        with self.meshes[stage]:
+            return self._program(kind, stage).lower(
+                *abstract_args).compile().as_text()
+
+    def export_stages(self, aot_dir: str, state: MultisliceState,
+                      batch: PyTree,
+                      key_fn: Callable[[int, str], str]) -> list[str]:
+        """AOT-export every stage program (runtime/aot.py): the caller's
+        ``key_fn(stage, program_kind)`` builds each key — aot.step_key
+        already carries topology x numSlices, so the stage index + kind
+        ride its ``extra`` and an N-program job warms N executables:
+        cold start stays flat in N (ISSUE 15 tentpole). Returns the
+        written keys; failures degrade per-program (aot.export_step
+        contract)."""
+        from ..runtime import aot as aot_mod
+        written = []
+        for s, kind, args in self._abstract_stage_args(state, batch):
+            cached = self._programs.get((kind, s))
+            if cached is not None and not hasattr(cached, "lower"):
+                # already an AOT-loaded executable (load_stages seeded
+                # it) — it came FROM this volume, so a partial warm
+                # start only exports the programs that are missing
+                continue
+            with self.meshes[s]:
+                compiled = self._program(kind, s).lower(*args).compile()
+            key = key_fn(s, kind)
+            sig = aot_mod.abstract_signature(*args)
+            aot_mod.export_step(aot_dir, key, compiled, sig)
+            written.append(key)
+        return written
+
+    @property
+    def num_programs(self) -> int:
+        """Schedule-facing programs: FWD + BWD per non-last stage, one
+        fused FWDBWD on the last — 2S-1 (1 when S == 1)."""
+        return max(1, 2 * self.num_stages - 1)
+
+    def _abstract_stage_args(self, state: MultisliceState,
+                             batch: PyTree):
+        """(stage, program kind, abstract example args) for every
+        schedule-facing program — each arg carries the SHARDING the
+        schedule actually feeds (the stage's batch sharding), so an
+        exported executable's layout matches the runtime call
+        exactly."""
+        tokens = batch["tokens"]
+        mb = tokens.shape[0] // self.num_microbatches
+        h = None
+        for s in range(self.num_stages):
+            last = s == self.num_stages - 1
+            tok_s = jax.ShapeDtypeStruct(
+                (mb,) + tokens.shape[1:], tokens.dtype,
+                sharding=self._batch_sharding(s))
+            if last:
+                x_in = tok_s if s == 0 else h
+                yield s, FWDBWD, (state.params[s], x_in, tok_s)
+                continue
+            x_in = tok_s if s == 0 else h
+            yield s, FWD, (state.params[s], x_in)
+            # abstract next-stage input from the PURE stage fn (a
+            # loaded Compiled cannot be traced by eval_shape); the
+            # stage's own output cotangent has the same shape, on ITS
+            # mesh — the backward program's third arg
+            out = jax.eval_shape(self._stage_fwd, state.params[s], x_in)
+            g_s = jax.ShapeDtypeStruct(
+                out.shape, out.dtype, sharding=self._batch_sharding(s))
+            yield s, BWD, (state.params[s], x_in, g_s)
+            h = jax.ShapeDtypeStruct(
+                out.shape, out.dtype,
+                sharding=self._batch_sharding(s + 1))
+
+    def load_stages(self, aot_dir: str, state: MultisliceState,
+                    batch: PyTree,
+                    key_fn: Callable[[int, str], str]) -> int:
+        """Seed the per-stage program cache from AOT-exported
+        executables (the warm-start rung): each loaded
+        ``jax.stages.Compiled`` stands in for the jitted program — no
+        trace, no lower, no XLA for that stage. Every failure falls back
+        to the jit path for THAT stage only (the aot.load_step ladder
+        contract). Returns how many stage programs loaded."""
+        from ..runtime import aot as aot_mod
+        loaded = 0
+        for s, kind, args in self._abstract_stage_args(state, batch):
+            prog = aot_mod.load_step(aot_dir, key_fn(s, kind),
+                                     aot_mod.abstract_signature(*args))
+            if prog is not None:
+                self._programs[(kind, s)] = prog
+                loaded += 1
+        return loaded
+
+
+def _accumulate(acc: list, stage: int, grads: PyTree) -> None:
+    if acc[stage] is None:
+        acc[stage] = grads
+    else:
+        acc[stage] = jax.tree.map(jnp.add, acc[stage], grads)

@@ -19,11 +19,37 @@ AxisTarget = Union[str, tuple[str, ...], None]
 
 
 class LogicalRules:
-    """Ordered mapping logical-axis-name → mesh axis (or axes, or None)."""
+    """Ordered mapping logical-axis-name → mesh axis (or axes, or None).
 
-    def __init__(self, rules: Sequence[tuple[str, AxisTarget]]):
+    ``dcn_unsafe`` names logical axes whose sharding must be DROPPED on
+    a multi-slice mesh (``dcn_aware``): a gather-indexed table dim (the
+    tok_embed vocab axis) sharded over tensor forces the SPMD
+    partitioner through a full rematerialization of the table — on one
+    slice that reshard rides cheap ICI, across slices it pays the DCN
+    link every step (the MULTICHIP_r05 "involuntary full
+    rematerialization" pathology the comm analyzer flags as
+    ``dcn_full_reshard``)."""
+
+    def __init__(self, rules: Sequence[tuple[str, AxisTarget]],
+                 dcn_unsafe: Sequence[str] = ()):
         self.rules = list(rules)
         self._map = dict(self.rules)
+        self.dcn_unsafe = tuple(dcn_unsafe)
+
+    def dcn_aware(self, num_slices: int) -> "LogicalRules":
+        """The rules this table resolves to on a ``num_slices``-slice
+        mesh: on a single slice, itself; across a DCN boundary, a copy
+        with every ``dcn_unsafe`` logical axis replicated — no
+        tensor/sequence-sharded leaf is forced through a DCN-crossing
+        all-gather/permute (rung 1 of the multi-slice ISSUE; measured in
+        PERF.md "Multi-slice DCN training")."""
+        if num_slices <= 1 or not self.dcn_unsafe:
+            return self
+        unsafe = set(self.dcn_unsafe)
+        return LogicalRules(
+            [(name, None if name in unsafe else target)
+             for name, target in self.rules],
+            dcn_unsafe=self.dcn_unsafe)
 
     def spec_for(self, logical_axes: Sequence[Optional[str]],
                  mesh: Optional[Mesh] = None) -> P:
@@ -114,10 +140,17 @@ TRANSFORMER_RULES = LogicalRules([
     ("kv", None),
     ("head_dim", None),
     ("vocab", "tensor"),
+    # gather-indexed table dim (tok_embed's vocab axis): sharded over
+    # tensor like the matmul "vocab" above on a single slice, but the
+    # embedding GATHER cannot run against a table sharded on its indexed
+    # dim — the partitioner replicates-then-repartitions it, and on a
+    # multi-slice mesh that transition crosses DCN every step, so
+    # dcn_aware() replicates this axis there (dcn_unsafe below)
+    ("vocab_table", "tensor"),
     ("expert", "expert"),
     ("stage", "pipeline"),
     ("layers", "pipeline"),     # stacked-block leading dim (pipeline stages)
-])
+], dcn_unsafe=("vocab_table",))
 
 RESNET_RULES = LogicalRules([
     ("batch", ("data", "fsdp")),

@@ -23,7 +23,8 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.compat import shard_map
-from ..parallel.mesh import data_axes, replica_axes, replica_degree
+from ..parallel.mesh import (data_axes, num_slices_of, replica_axes,
+                             replica_degree)
 from ..parallel.sharding_rules import LogicalRules, weight_update_spec
 from .recipe import validate_weight_update
 
@@ -75,9 +76,26 @@ class TrainStepBuilder:
     weight_update: str = field(default="replicated", metadata={
         "operator_knob": True, "spec_field": "weightUpdate",
         "modes": "WEIGHT_UPDATE_MODES"})
+    # Slices the mesh spans (the DCN geometry): None = auto-detect from
+    # the devices' slice_index (real multi-slice TPU backends stamp it;
+    # single-host and CPU meshes read 1). The worker passes the
+    # contract's count explicitly. When > 1, the sharding rules resolve
+    # DCN-AWARE (LogicalRules.dcn_aware): dcn-unsafe logical axes (the
+    # gather-indexed tok_embed vocab dim) replicate instead of forcing
+    # the partitioner's involuntary full rematerialization across the
+    # slow link — rung 1 of the multi-slice ISSUE, measured in PERF.md
+    # "Multi-slice DCN training". dcn_aware=False keeps the legacy
+    # layout (the bench's known-bad positive control).
+    num_slices: Optional[int] = None
+    dcn_aware: bool = True
 
     def __post_init__(self):
         validate_weight_update(self.weight_update)
+        if self.num_slices is None:
+            self.num_slices = num_slices_of(self.mesh)
+        if self.dcn_aware and self.rules is not None and \
+                self.num_slices > 1 and hasattr(self.rules, "dcn_aware"):
+            self.rules = self.rules.dcn_aware(self.num_slices)
         # Sharding-invariant RNG: with the legacy (non-partitionable)
         # threefry, jit-with-sharded-out_shardings generates DIFFERENT
         # random bits per layout — init(rng) under TP rules diverged ~12%
@@ -440,6 +458,81 @@ class TrainStepBuilder:
                 if getattr(x, "ndim", 1) == 2 else
                 NamedSharding(self.mesh, P(data_axes(self.mesh)))),
             batch)
+
+
+@dataclass
+class MultisliceTrainStepBuilder:
+    """The MPMD pipeline-over-DCN path (parallel/multislice.py) behind
+    the TrainStepBuilder surface the worker loop drives: ``init`` /
+    ``build`` / ``place_batch``. One program per slice — stage s's
+    params, optimizer shard, and compiled programs live entirely on
+    slice s's own mesh; activations/grads cross the DCN boundary as
+    explicit transfers under the 1F1B microbatch schedule, and
+    ``last_report`` carries the measured bubble/DCN accounting the
+    goodput ledger's ``pipeline_bubble`` category and bench --mode
+    multislice consume. Supports the pipelined transformer workload
+    (models/transformer.py multislice_stage_fns)."""
+
+    cfg: Any                       # transformer.TransformerConfig
+    num_slices: int
+    num_microbatches: int
+    optimizer: optax.GradientTransformation   # per-leaf transform
+    grad_clip_norm: Optional[float] = None    # cross-stage global clip
+    devices: Optional[list] = None
+
+    def __post_init__(self):
+        from ..models.transformer import multislice_stage_fns
+        from ..parallel.multislice import MPMDPipeline, stage_meshes
+        if self.num_slices < 2:
+            raise ValueError(
+                "the MPMD multislice path needs numSlices >= 2 (one "
+                "program per slice); single-slice jobs take the "
+                "TrainStepBuilder path")
+        devices = list(self.devices if self.devices is not None
+                       else jax.devices())
+        init_fn, embed_fn, block_fn, head_loss_fn = \
+            multislice_stage_fns(self.cfg)
+        self._full_init = init_fn
+        self.engine = MPMDPipeline(
+            meshes=stage_meshes(devices, self.num_slices),
+            embed_fn=embed_fn, block_fn=block_fn,
+            head_loss_fn=head_loss_fn, optimizer=self.optimizer,
+            num_microbatches=self.num_microbatches,
+            grad_clip_norm=self.grad_clip_norm)
+
+    @property
+    def mesh(self):
+        """Stage 0's mesh (logging / batch-geometry callers)."""
+        return self.engine.meshes[0]
+
+    @property
+    def last_report(self):
+        return self.engine.last_report
+
+    def init(self, init_fn, rng: jax.Array):
+        """Same surface as TrainStepBuilder.init: ``init_fn(rng) ->
+        (params, variables)`` — the pipelined workload's init returns
+        the full {"embed", "blocks", "head"} tree, which the engine
+        partitions per stage (bit-identical to the single-program arm's
+        init under the same rng)."""
+
+        def full(rng):
+            out = init_fn(rng)
+            params = out[0] if isinstance(out, tuple) else out
+            return params
+
+        return self.engine.init(full, rng)
+
+    def build(self):
+        return self.engine.step
+
+    def place_batch(self, batch):
+        return self.engine.place_batch(batch)
+
+    def build_eval(self, eval_fn):
+        raise NotImplementedError(
+            "eval is not supported on the MPMD multislice path yet; "
+            "run eval on a single-program mesh")
 
 
 def _optimizer_shardings(opt_state, params, param_shardings, rep):

@@ -252,6 +252,8 @@ def train(
     obs_metrics_port: Optional[int] = None,
     aot: Optional[bool] = None,
     aot_dir: Optional[str] = None,
+    multislice_pipeline: Optional[bool] = None,
+    multislice_microbatches: Optional[int] = None,
 ) -> TrainResult:
     # before any jit: warm restarts must hit the persistent cache for the
     # very first compile (the startup→first-step dominator, PERF.md) —
@@ -346,10 +348,63 @@ def train(
     weight_update = validate_weight_update(
         weight_update or os.environ.get("KFTPU_WEIGHT_UPDATE")
         or "replicated")
-    builder = TrainStepBuilder(
-        mesh=ctx.mesh, loss_fn=spec.loss_fn, optimizer=opt,
-        rules=spec.rules, param_logical_axes=spec.param_logical_axes,
-        weight_update=weight_update)
+    # DCN geometry: the contract's slice count makes the step engine's
+    # sharding-rule resolution (and the comm profile below) DCN-aware —
+    # a multi-slice mesh must not shard dcn-unsafe axes across the
+    # boundary (parallel/sharding_rules.py dcn_aware)
+    n_slices = ctx.contract.num_slices if ctx.contract else \
+        _env_int("KFTPU_NUM_SLICES", 1)
+    # spec.multislice → KFTPU_MULTISLICE_PIPELINE/_MICROBATCHES: the
+    # MPMD pipeline-over-DCN path — one program per slice with explicit
+    # activation/grad transfers instead of one SPMD program resharding
+    # across the slow link (docs/training.md "Multi-slice training")
+    if multislice_pipeline is None:
+        multislice_pipeline = bool(
+            _env_int("KFTPU_MULTISLICE_PIPELINE", 0))
+    if multislice_pipeline:
+        if workload != "transformer-pipelined":
+            raise ValueError(
+                f"multislice.pipeline supports the transformer-"
+                f"pipelined workload (stacked stages), not {workload!r}")
+        if eval_every:
+            raise ValueError(
+                "eval is not supported on the MPMD multislice path yet")
+        if weight_update != "replicated":
+            # reject, don't silently downgrade: the MPMD engine runs
+            # per-stage replicated updates (stage params already live
+            # only on their slice), so a requested ZeRO-2 layout would
+            # quietly not happen
+            raise ValueError(
+                f"weightUpdate={weight_update!r} is not supported on "
+                f"the MPMD multislice path (per-stage updates are "
+                f"replicated within each slice)")
+        from .trainstep import MultisliceTrainStepBuilder
+        from ..models import transformer as _T
+        # default 4 x slices (bubble (S-1)/(M+S-1) <= ~20%). NOT the
+        # single-program --num-microbatches knob: main() always fills
+        # that with its own default, so consulting it here would
+        # silently pin M=4 at every slice count
+        if multislice_microbatches is None:
+            multislice_microbatches = _env_int(
+                "KFTPU_MULTISLICE_MICROBATCHES", 0) or \
+                4 * max(2, n_slices)
+        # the engine owns cross-stage global-norm clipping (the same
+        # clip the single-program chain applies); its inner optimizer
+        # must stay per-leaf
+        opt_ms, lr_fn = make_optimizer(
+            optimizer, base_lr, schedule=lr_schedule, total_steps=steps,
+            warmup_steps=warmup_steps, weight_decay=weight_decay,
+            momentum=momentum, grad_clip=None)
+        builder = MultisliceTrainStepBuilder(
+            cfg=workload_kwargs.get("cfg") or _T.TransformerConfig.tiny(),
+            num_slices=n_slices,
+            num_microbatches=int(multislice_microbatches),
+            optimizer=opt_ms, grad_clip_norm=1.0)
+    else:
+        builder = TrainStepBuilder(
+            mesh=ctx.mesh, loss_fn=spec.loss_fn, optimizer=opt,
+            rules=spec.rules, param_logical_axes=spec.param_logical_axes,
+            weight_update=weight_update, num_slices=n_slices)
 
     rng = jax.random.PRNGKey(seed)
     state = builder.init(spec.init_fn, rng)
@@ -564,6 +619,50 @@ def train(
             log.warning("AOT warm start requested but no --aot-dir / "
                         "%s / checkpoint volume to keep executables on; "
                         "continuing without it", aot_mod.AOT_DIR_ENV)
+        elif multislice_pipeline:
+            # per-stage AOT (the MPMD path): one serialized executable
+            # per (stage, program) — stage index + program kind ride
+            # step_key's ``extra`` beside topology x numSlices, so an
+            # N-program job warms N executables and cold start stays
+            # flat in N. Load-all = aot start; anything less exports
+            # the missing programs on this (already-paid) compile.
+            try:
+                fp = recipe_fingerprint(
+                    workload=spec.name, optimizer=optimizer,
+                    lr_schedule=lr_schedule, learning_rate=base_lr,
+                    warmup_steps=warmup_steps, weight_decay=weight_decay,
+                    momentum=momentum, label_smoothing=label_smoothing,
+                    steps=steps, real_data=False,
+                    workload_kwargs=workload_kwargs)
+                engine = builder.engine
+                stage_sharding = {
+                    "data": int(engine.meshes[0].shape["data"])}
+
+                def ms_key(s, kind):
+                    return aot_mod.step_key(
+                        topology=os.environ.get("KFTPU_TOPOLOGY", "")
+                        or f"local-{ctx.num_processes}p",
+                        num_slices=n_slices, model_fingerprint=fp,
+                        weight_update="mpmd", sharding=stage_sharding,
+                        global_batch=global_batch,
+                        extra={"stage": s, "program": kind,
+                               "microbatches":
+                                   engine.num_microbatches})
+
+                n_loaded = engine.load_stages(aot_dir, state,
+                                              batch_pool[0], ms_key)
+                if n_loaded == engine.num_programs:
+                    aot_used = True
+                    start_kind = "aot"
+                    log.info("AOT: %d/%d stage programs loaded — "
+                             "skipping XLA for every stage", n_loaded,
+                             engine.num_programs)
+                else:
+                    engine.export_stages(aot_dir, state, batch_pool[0],
+                                         ms_key)
+            except Exception as e:  # noqa: BLE001 — optimization only
+                log.warning("multislice AOT setup failed (%s); using "
+                            "the jit path", e)
         else:
             try:
                 if data_source is not None:
@@ -701,6 +800,12 @@ def train(
     sync_every = max(1, int(sync_every))
     afetch = AsyncWindowFetch(lag=1)
     comm_series = None   # kftpu_comm_* handle, pruned at teardown
+    # MPMD schedule-idle accumulator: the engine reports modeled bubble
+    # seconds per step (host floats); each closed window emits ONE
+    # pipeline-bubble span sized to its accumulated bubble so the
+    # goodput ledger's pipeline_bubble category is fed from measured
+    # schedule evidence (obs/goodput.py)
+    win_bubble = 0.0
     loop_error: Optional[BaseException] = None
     try:
         with profile_trace(profile_dir, enabled=profile_dir is not None,
@@ -740,6 +845,8 @@ def train(
                         log.warning("AOT executable failed at first "
                                     "step (%s); recompiling", e)
                         aot_used = False
+                        if multislice_pipeline:
+                            builder.engine.reset_programs()
                         step_fn = builder.build()
                         state, metrics = step_fn(state, batch)
                     # one hard sync, once: the time-to-first-step metric
@@ -790,9 +897,6 @@ def train(
                             from ..obs.collectives import (
                                 COMM_PROFILE_SPAN, analyze_hlo,
                                 export_comm_metrics, slice_assignment)
-                            n_slices = ctx.contract.num_slices \
-                                if ctx.contract else \
-                                _env_int("KFTPU_NUM_SLICES", 1)
                             comm_prof = analyze_hlo(
                                 hlo,
                                 slice_assignment(ctx.mesh, n_slices),
@@ -809,6 +913,15 @@ def train(
                                              profile=comm_prof.to_dict())
                     except Exception as e:  # noqa: BLE001
                         log.warning("comm profile failed: %s", e)
+                    if multislice_pipeline and tracer is not None and \
+                            ctx.process_id == 0 and \
+                            builder.last_report is not None:
+                        # the MPMD analog of the comm-profile span: the
+                        # schedule model's makespan / per-stage busy /
+                        # bubble / explicit-DCN accounting of the first
+                        # step
+                        tracer.event("multislice-profile", step=step + 1,
+                                     report=builder.last_report.to_dict())
                 else:
                     state, metrics = step_fn(state, batch)
                 # the first step's compile + blocking sync is recorded
@@ -821,6 +934,9 @@ def train(
                     first_step_s=step_cost if step == start_step
                     else 0.0)
                 profile_arm.on_step_end(step + 1)
+                if multislice_pipeline:
+                    win_bubble += float(
+                        metrics.get("pipeline_bubble_s", 0.0) or 0.0)
                 window += 1
                 # checkpoint saves are their own sync point (orbax fetches
                 # the state), so close the timing window first
@@ -851,6 +967,18 @@ def train(
                         tracer.emit("window",
                                     start=now_w - (t_now - win_t0),
                                     end=now_w, step=step + 1, steps=window)
+                        if win_bubble > 0:
+                            # the window's MPMD schedule-idle seconds,
+                            # anchored at its tail (a modeled
+                            # attribution inside the real interval —
+                            # obs/goodput.py SPAN_PIPELINE_BUBBLE)
+                            from ..obs.goodput import \
+                                SPAN_PIPELINE_BUBBLE
+                            b = min(win_bubble, t_now - win_t0)
+                            tracer.emit(SPAN_PIPELINE_BUBBLE,
+                                        start=now_w - b, end=now_w,
+                                        step=step + 1)
+                    win_bubble = 0.0
                     t_drain0 = time.perf_counter()
                     for s, w, wall, vals in afetch.drain(
                             force=final or will_ckpt or will_eval
@@ -1052,6 +1180,20 @@ def main(argv=None) -> int:
                         "defaults to $KFTPU_DEVICE_PREFETCH or 2)")
     p.add_argument("--num-microbatches", type=int, default=4,
                    help="GPipe microbatches (pipelined workloads)")
+    p.add_argument("--multislice-pipeline", default=None,
+                   action=argparse.BooleanOptionalAction,
+                   help="MPMD pipeline-over-DCN: one program per slice "
+                        "with explicit activation/grad transfers and a "
+                        "1F1B microbatch schedule, instead of one SPMD "
+                        "program resharding across the DCN boundary "
+                        "(defaults to $KFTPU_MULTISLICE_PIPELINE or "
+                        "off — docs/training.md 'Multi-slice "
+                        "training')")
+    p.add_argument("--multislice-microbatches", type=int, default=None,
+                   help="microbatches per step for the MPMD schedule "
+                        "(defaults to $KFTPU_MULTISLICE_MICROBATCHES, "
+                        "then 4x the slice count; bubble fraction is "
+                        "(S-1)/(M+S-1))")
     # training recipe (the tf_cnn_benchmarks flag surface, runtime/recipe.py)
     from .recipe import OPTIMIZERS, SCHEDULES, WEIGHT_UPDATE_MODES
     p.add_argument("--weight-update", default=None,
@@ -1118,7 +1260,9 @@ def main(argv=None) -> int:
         eval_every=args.eval_every, eval_batches=args.eval_batches,
         eval_data_dir=args.eval_data_dir,
         weight_update=args.weight_update,
-        aot=args.aot, aot_dir=args.aot_dir)
+        aot=args.aot, aot_dir=args.aot_dir,
+        multislice_pipeline=args.multislice_pipeline,
+        multislice_microbatches=args.multislice_microbatches)
     log.info("done: %d steps, %.1f examples/sec", result.steps,
              result.examples_per_sec)
     return PREEMPTED_EXIT_CODE if result.preempted else 0

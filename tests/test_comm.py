@@ -29,6 +29,13 @@ META_MODEL = ('metadata={op_name="jit(step_fn)/jit(main)/'
 META_UPDATE = ('metadata={op_name="jit(step_fn)/jit(main)/add" '
                'source_file="/repo/kubeflow_tpu/runtime/trainstep.py" '
                'source_line=228}')
+META_PIPELINE = ('metadata={op_name="jit(step_fn)/jit(main)/ppermute" '
+                 'source_file="/repo/kubeflow_tpu/parallel/pipeline.py" '
+                 'source_line=143}')
+META_MULTISLICE = ('metadata={op_name="jit(run)/jit(main)/transfer" '
+                   'source_file='
+                   '"/repo/kubeflow_tpu/parallel/multislice.py" '
+                   'source_line=330}')
 
 
 def _hlo(*lines) -> str:
@@ -313,6 +320,39 @@ class TestDetector:
                    + META_MODEL)
         assert detect_full_reshard(
             analyze_hlo(hlo, TWO_SLICE_8)).flagged
+
+    def test_pipeline_phase_permute_is_clean(self):
+        """Deliberate stage send/recv (phase=pipeline) must NEVER read
+        as an involuntary reshard — the same DCN-crossing permute flags
+        when attributed to the model region (both ways, the satellite
+        drill)."""
+        permute = ('%cp = f32[100]{0} collective-permute(f32[100]{0} '
+                   '%x), source_target_pairs={{0,4},{4,0}}, ')
+        for meta in (META_PIPELINE, META_MULTISLICE):
+            prof = analyze_hlo(_hlo(permute + meta), TWO_SLICE_8)
+            assert prof.ops[0].phase == "pipeline"
+            assert not detect_full_reshard(prof).flagged, meta
+        # control: the identical op with model-region metadata flags
+        assert detect_full_reshard(
+            analyze_hlo(_hlo(permute + META_MODEL), TWO_SLICE_8)).flagged
+
+    def test_pipeline_phase_labeled_in_by_link_op(self):
+        permute = ('%cp = f32[100]{0} collective-permute(f32[100]{0} '
+                   '%x), source_target_pairs={{0,4},{4,0}}, ')
+        prof = analyze_hlo(
+            _hlo(permute + META_PIPELINE,
+                 '%ar = f32[64]{0} all-reduce(f32[64]{0} %g), '
+                 'replica_groups=[1,8]<=[8], to_apply=%sum, '
+                 + META_MODEL),
+            TWO_SLICE_8)
+        rows = prof.by_link_op()
+        assert rows[("dcn", "collective-permute")]["phases"] == \
+            {"pipeline": 1}
+        assert rows[("dcn", "all-reduce")]["phases"] == {"model": 1}
+        d = prof.to_dict()
+        assert d["byLinkOp"]["dcn/collective-permute"]["phases"] == \
+            {"pipeline": 1}
+        assert d["topOps"][0]["phase"] in ("pipeline", "model")
 
 
 class TestUpdateMetric:

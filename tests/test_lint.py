@@ -321,6 +321,85 @@ def test_warm_start_knobs_are_plumbed_end_to_end():
     assert TrainingJob.from_manifest(ex).warm_start == wspec
 
 
+def test_multislice_knobs_are_plumbed_end_to_end():
+    """Every MultisliceSpec field must be representable end-to-end, the
+    same rule as input/warmStart: parsed+serialized through the TPUJob
+    spec's ``multislice`` block (api/trainingjob.py), rendered into
+    worker env by the controller, consumed by the worker's train()/CLI
+    surface, and named in the manifests CRD schema + example builder —
+    so a future multi-slice knob can't silently exist in one layer
+    only."""
+    import dataclasses
+    import inspect
+
+    import pytest
+
+    from kubeflow_tpu.api.trainingjob import MultisliceSpec, TrainingJob
+    from kubeflow_tpu.manifests.training import tpu_job_simple
+    from kubeflow_tpu.runtime import worker
+
+    def src(*rel):
+        with open(os.path.join(REPO_ROOT, "kubeflow_tpu", *rel)) as f:
+            return f.read()
+
+    knobs = dataclasses.fields(MultisliceSpec)
+    assert knobs, "expected the pipeline/microbatches knobs"
+    worker_src = src("runtime", "worker.py")
+    controller_src = src("controllers", "tpujob.py")
+    manifests_src = src("manifests", "training.py")
+    for knob in knobs:
+        # worker: a CLI flag and the env fallback
+        assert knob.metadata["cli"] in worker_src, knob.name
+        assert knob.metadata["env"] in worker_src, knob.name
+        # controller: rendered into worker env (via MultisliceSpec.to_env)
+        assert "multislice.to_env" in controller_src
+        # manifests: the CRD schema names the spec field
+        assert f'"{knob.metadata["spec_field"]}"' in manifests_src, \
+            knob.name
+    # train() consumes both knobs by their canonical names
+    train_params = inspect.signature(worker.train).parameters
+    assert "multislice_pipeline" in train_params
+    assert "multislice_microbatches" in train_params
+
+    # spec wire round-trip: to_dict → from_manifest → identical spec,
+    # and the controller env render matches the declared names
+    mspec = MultisliceSpec(pipeline=True, microbatches=8)
+    manifest = {
+        "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": "t", "namespace": "ns"},
+        "spec": {"replicaSpecs": {"TPU": {
+            "tpuTopology": "v5e-8", "numSlices": 2,
+            "template": {"spec": {"containers": [{"name": "c"}]}}}},
+            "multislice": mspec.to_dict()},
+    }
+    job = TrainingJob.from_manifest(manifest)
+    assert job.multislice == mspec
+    assert job.to_manifest()["spec"]["multislice"] == mspec.to_dict()
+    assert mspec.to_env() == {"KFTPU_MULTISLICE_PIPELINE": "1",
+                              "KFTPU_MULTISLICE_MICROBATCHES": "8"}
+
+    # admission rejects garbage (a typo'd knob must fail at apply)
+    with pytest.raises(ValueError, match="unknown"):
+        MultisliceSpec.from_dict({"pipelined": True})
+    with pytest.raises(ValueError, match="microbatches"):
+        MultisliceSpec.from_dict({"microbatches": -1})
+    with pytest.raises(ValueError, match="mapping"):
+        MultisliceSpec.from_dict([True])
+
+    # example builder renders the block (and the pipelined workload's
+    # command) end to end
+    ex = next(o for o in tpu_job_simple(
+        num_slices=2, multislice_pipeline=True,
+        multislice_microbatches=8)
+        if o["kind"] == "TPUJob")
+    parsed = TrainingJob.from_manifest(ex)
+    assert parsed.multislice == mspec
+    assert parsed.tpu_spec.num_slices == 2
+    cmd = ex["spec"]["replicaSpecs"]["TPU"]["template"]["spec"][
+        "containers"][0]["command"]
+    assert "--multislice-pipeline" in cmd
+
+
 def test_scheduling_policy_is_plumbed_end_to_end():
     """Every SchedulingPolicy field must be representable end-to-end,
     the same rule as runPolicy/input: parsed+serialized through the
@@ -603,14 +682,18 @@ def test_badput_categories_defined_once_and_shared():
 
     assert BADPUT_CATEGORIES == (
         "queue_wait", "startup", "compile", "checkpoint",
-        "restart_recompute", "resize", "stall", "other")
+        "restart_recompute", "resize", "stall", "pipeline_bubble",
+        "other")
 
     # single definition: the distinctive category literals appear as
     # quoted strings in exactly one source file — every other layer
     # imports the names (common-word categories like "compile" would
-    # false-positive a grep, so the check pins the unambiguous ones)
+    # false-positive a grep, so the check pins the unambiguous ones;
+    # "pipeline_bubble" is the ISSUE 15 MPMD schedule-idle category —
+    # the worker emits SPAN_PIPELINE_BUBBLE spans, never re-spells it)
     pkg = os.path.join(REPO_ROOT, "kubeflow_tpu")
-    for literal in ("queue_wait", "restart_recompute"):
+    for literal in ("queue_wait", "restart_recompute",
+                    "pipeline_bubble"):
         hits = subprocess.run(
             ["grep", "-rl", f'"{literal}"', pkg],
             capture_output=True, text=True).stdout.split()

@@ -1,0 +1,486 @@
+"""Multi-slice DCN training (ISSUE 15): rung 1 (DCN-aware mesh/rules —
+no involuntary full reshard) and rung 2 (MPMD pipeline-over-DCN — one
+program per slice, explicit transfers, 1F1B schedule, pipeline_bubble
+goodput category)."""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.api.trainingjob import (DCN_LEGAL_AXES, MultisliceSpec,
+                                          ShardingSpec, TrainingJob,
+                                          dcn_crossing_axes)
+
+pytestmark = pytest.mark.multislice
+
+
+def _tpu_manifest(num_slices=2, sharding=None, multislice=None,
+                  topology="v5e-4"):
+    spec = {"replicaSpecs": {"TPU": {
+        "tpuTopology": topology, "numSlices": num_slices,
+        "template": {"spec": {"containers": [{"name": "c"}]}}}}}
+    if sharding is not None:
+        spec["sharding"] = sharding
+    if multislice is not None:
+        spec["multislice"] = multislice
+    return {"apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": "ms", "namespace": "ns"},
+            "spec": spec}
+
+
+class TestDcnCrossingAxes:
+    """The jax-free DCN-major arithmetic admission rejects on."""
+
+    def test_single_slice_never_crosses(self):
+        assert dcn_crossing_axes({"data": 2, "tensor": 4}, 1) == ()
+
+    def test_data_major_axis_crosses(self):
+        # DCN-major order: the outermost nontrivial axis spans slices
+        assert dcn_crossing_axes(
+            {"data": 2, "fsdp": 2, "tensor": 2}, 2) == ("data",)
+
+    def test_inner_axes_stay_intra_slice(self):
+        crossing = dcn_crossing_axes(
+            {"data": 2, "fsdp": 2, "tensor": 2}, 2)
+        assert "tensor" not in crossing and "fsdp" not in crossing
+
+    def test_tensor_spanning_slices_crosses(self):
+        assert dcn_crossing_axes({"tensor": 8}, 2) == ("tensor",)
+
+    def test_fsdp_can_legally_cross(self):
+        # with data=1, fsdp is the outermost nontrivial axis — it spans
+        # slices, and it is a DCN_LEGAL axis (gradient traffic)
+        assert dcn_crossing_axes({"fsdp": 4, "tensor": 2}, 2) == \
+            ("fsdp",)
+        assert "fsdp" in DCN_LEGAL_AXES
+
+    def test_matches_brute_force(self):
+        # exactness drill: compare against direct position enumeration
+        axes = ("data", "fsdp", "expert", "pipeline", "sequence",
+                "tensor")
+        cases = [
+            ({"data": 2, "fsdp": 2, "tensor": 2}, 2),
+            ({"data": 4, "tensor": 2}, 4),
+            ({"fsdp": 2, "sequence": 2, "tensor": 2}, 2),
+            ({"data": 2, "pipeline": 2, "tensor": 2}, 4),
+            ({"expert": 2, "tensor": 4}, 2),
+        ]
+        for sizes, n_slices in cases:
+            total = 1
+            for a in axes:
+                total *= sizes.get(a, 1)
+            cps = total // n_slices
+            strides = {}
+            inner = 1
+            for a in reversed(axes):
+                strides[a] = inner
+                inner *= sizes.get(a, 1)
+            expect = []
+            for a in axes:
+                size = sizes.get(a, 1)
+                if size <= 1:
+                    continue
+                hit = False
+                for p in range(total):
+                    coord = (p // strides[a]) % size
+                    for c in range(size):
+                        q = p + (c - coord) * strides[a]
+                        if q // cps != p // cps:
+                            hit = True
+                            break
+                    if hit:
+                        break
+                if hit:
+                    expect.append(a)
+            assert dcn_crossing_axes(sizes, n_slices, axes=axes) == \
+                tuple(expect), (sizes, n_slices)
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError, match="divisible"):
+            dcn_crossing_axes({"data": 3}, 2)
+
+
+class TestAdmission:
+    """DCN-layout rejection happens at apply, not at compile."""
+
+    def test_legal_multislice_sharding_admits(self):
+        job = TrainingJob.from_manifest(_tpu_manifest(
+            sharding={"data": 2, "fsdp": 2, "tensor": 2}))
+        assert job.tpu_spec.num_slices == 2
+
+    def test_cross_dcn_tensor_layout_rejected(self):
+        with pytest.raises(ValueError, match="cross the DCN"):
+            TrainingJob.from_manifest(_tpu_manifest(
+                sharding={"data": 1, "tensor": 8}))
+
+    def test_cross_dcn_sequence_layout_rejected(self):
+        with pytest.raises(ValueError, match="cross the DCN"):
+            TrainingJob.from_manifest(_tpu_manifest(
+                sharding={"data": 1, "sequence": 8}))
+
+    def test_single_slice_tensor_everything_admits(self):
+        job = TrainingJob.from_manifest(_tpu_manifest(
+            num_slices=1, topology="v5e-8",
+            sharding={"data": 1, "tensor": 8}))
+        assert job.tpu_spec.num_slices == 1
+
+    def test_pipeline_axis_may_cross(self):
+        # pipeline over DCN is deliberate stage traffic, not rejected
+        job = TrainingJob.from_manifest(_tpu_manifest(
+            sharding={"data": 1, "pipeline": 2, "tensor": 4}))
+        assert job.tpu_spec.num_slices == 2
+
+    def test_multislice_pipeline_needs_two_slices(self):
+        with pytest.raises(ValueError, match="numSlices >= 2"):
+            TrainingJob.from_manifest(_tpu_manifest(
+                num_slices=1, topology="v5e-8",
+                multislice={"pipeline": True}))
+
+
+class TestMultisliceSpec:
+    def test_round_trip_and_env(self):
+        spec = MultisliceSpec.from_dict({"pipeline": True,
+                                         "microbatches": 8})
+        assert spec.pipeline_enabled
+        assert spec.to_dict() == {"pipeline": True, "microbatches": 8}
+        assert spec.to_env() == {"KFTPU_MULTISLICE_PIPELINE": "1",
+                                 "KFTPU_MULTISLICE_MICROBATCHES": "8"}
+        job = TrainingJob.from_manifest(_tpu_manifest(
+            multislice={"pipeline": True, "microbatches": 8}))
+        assert job.multislice == spec
+        assert job.to_manifest()["spec"]["multislice"] == spec.to_dict()
+
+    def test_absent_block_is_default_off(self):
+        job = TrainingJob.from_manifest(_tpu_manifest())
+        assert not job.multislice.pipeline_enabled
+        assert job.multislice.to_env() == {}
+        assert "multislice" not in job.to_manifest()["spec"]
+
+    def test_admission_rejects_garbage(self):
+        with pytest.raises(ValueError, match="unknown"):
+            MultisliceSpec.from_dict({"pipelines": True})
+        with pytest.raises(ValueError, match="microbatches"):
+            MultisliceSpec.from_dict({"microbatches": 0})
+        with pytest.raises(ValueError, match="boolean"):
+            MultisliceSpec.from_dict({"pipeline": "yes"})
+        with pytest.raises(ValueError, match="mapping"):
+            MultisliceSpec.from_dict([True])
+        # microbatches without the pipeline is a silent no-op — reject
+        with pytest.raises(ValueError, match="requires"):
+            MultisliceSpec.from_dict({"microbatches": 8})
+
+
+class TestDcnAwareRules:
+    def test_transformer_rules_declare_vocab_table_unsafe(self):
+        from kubeflow_tpu.parallel.sharding_rules import \
+            TRANSFORMER_RULES
+        assert "vocab_table" in TRANSFORMER_RULES.dcn_unsafe
+        # single-slice resolution is IDENTICAL (the same object)
+        assert TRANSFORMER_RULES.dcn_aware(1) is TRANSFORMER_RULES
+
+    def test_dcn_aware_replicates_unsafe_axes(self):
+        from kubeflow_tpu.parallel.mesh import build_mesh
+        from kubeflow_tpu.parallel.sharding_rules import \
+            TRANSFORMER_RULES
+        mesh = build_mesh(ShardingSpec(data=2, fsdp=2, tensor=2))
+        rules2 = TRANSFORMER_RULES.dcn_aware(2)
+        assert rules2 is not TRANSFORMER_RULES
+        # the gather-indexed table dim replicates...
+        assert rules2.spec_for(("vocab_table", "embed"), mesh) == \
+            rules2.spec_for((None, "embed"), mesh)
+        # ...but the head's matmul vocab stays tensor-sharded
+        base = TRANSFORMER_RULES.spec_for(("embed", "vocab"), mesh)
+        assert rules2.spec_for(("embed", "vocab"), mesh) == base
+
+    def test_builder_applies_dcn_aware_only_multislice(self):
+        import optax
+
+        from kubeflow_tpu.parallel.mesh import build_mesh
+        from kubeflow_tpu.parallel.sharding_rules import \
+            TRANSFORMER_RULES
+        from kubeflow_tpu.runtime.trainstep import TrainStepBuilder
+
+        mesh = build_mesh(ShardingSpec(data=2, fsdp=2, tensor=2))
+
+        def mk(**kw):
+            return TrainStepBuilder(
+                mesh=mesh, loss_fn=lambda *a: None,
+                optimizer=optax.sgd(1e-2), rules=TRANSFORMER_RULES,
+                param_logical_axes={}, **kw)
+
+        assert mk(num_slices=1).rules is TRANSFORMER_RULES
+        assert mk(num_slices=2).rules is not TRANSFORMER_RULES
+        assert mk(num_slices=2, dcn_aware=False).rules is \
+            TRANSFORMER_RULES
+
+
+class TestMeshInvariants:
+    """mesh_from_contract DCN-major invariants (the satellite drill)."""
+
+    def test_data_axis_spans_slices(self):
+        import jax
+
+        from kubeflow_tpu.api.topology import (TopologyContract,
+                                               parse_topology)
+        from kubeflow_tpu.parallel.mesh import mesh_from_contract
+        contract = TopologyContract(
+            coordinator_address="t:1", num_processes=2, process_id=0,
+            slice_topology=parse_topology("v5e-4"), num_slices=2,
+            slice_id=0)
+        mesh = mesh_from_contract(contract,
+                                  ShardingSpec(data=2, fsdp=2, tensor=2))
+        devices = jax.devices()
+        # row 0 of the data axis is exactly slice 0's devices
+        assert {d.id for d in mesh.devices[0].flatten()} == \
+            {d.id for d in devices[:4]}
+        from kubeflow_tpu.parallel.mesh import slice_crossing_axes
+        crossing = slice_crossing_axes(mesh, 2)
+        assert "data" in crossing
+        assert "tensor" not in crossing and "sequence" not in crossing
+
+    def test_num_slices_of_defaults_single(self):
+        from kubeflow_tpu.parallel.mesh import build_mesh, num_slices_of
+        assert num_slices_of(build_mesh(ShardingSpec(data=8))) == 1
+
+
+class TestScheduleModel:
+    """1F1B order + list-schedule bubble model (pure host math)."""
+
+    def test_stage_op_order_covers_all_ops(self):
+        from kubeflow_tpu.parallel.multislice import (BWD, FWD, FWDBWD,
+                                                      stage_op_order)
+        S, M = 4, 8
+        for s in range(S):
+            ops = stage_op_order(s, S, M)
+            if s == S - 1:
+                assert ops == [(FWDBWD, m) for m in range(M)]
+            else:
+                assert sorted(o for o in ops if o[0] == FWD) == \
+                    [(FWD, m) for m in range(M)]
+                assert sorted(o for o in ops if o[0] == BWD) == \
+                    [(BWD, m) for m in range(M)]
+                # a microbatch's backward never precedes its forward
+                for m in range(M):
+                    assert ops.index((FWD, m)) < ops.index((BWD, m))
+
+    def test_balanced_durations_hit_near_ideal_bubble(self):
+        from kubeflow_tpu.parallel.multislice import (BWD, FWD, FWDBWD,
+                                                      model_schedule,
+                                                      stage_op_order)
+        S, M = 2, 8
+        durations = {}
+        for s in range(S):
+            for kind, m in stage_op_order(s, S, M):
+                # forward 1 unit, backward 2, fused 3 — balanced stages
+                durations[(kind, s, m)] = \
+                    {FWD: 1.0, BWD: 2.0, FWDBWD: 3.0}[kind]
+        rep = model_schedule(durations, S, M)
+        assert rep.makespan_s > 0
+        ideal = (S - 1) / (M + S - 1)
+        # balanced stages land near the analytic GPipe bound
+        assert rep.bubble_fraction == pytest.approx(ideal, abs=0.08)
+        assert rep.to_dict()["idealBubbleFraction"] == \
+            pytest.approx(ideal, abs=1e-6)
+
+    def test_single_stage_has_no_bubble(self):
+        from kubeflow_tpu.parallel.multislice import (FWDBWD,
+                                                      model_schedule)
+        durations = {(FWDBWD, 0, m): 1.0 for m in range(4)}
+        rep = model_schedule(durations, 1, 4)
+        assert rep.bubble_fraction == 0.0
+        assert rep.makespan_s == pytest.approx(4.0)
+
+    def test_partition_and_groups(self):
+        import jax
+        import numpy as np
+
+        from kubeflow_tpu.parallel.multislice import (
+            partition_stacked, slice_device_groups, stage_meshes)
+        groups = slice_device_groups(jax.devices(), 2)
+        assert [len(g) for g in groups] == [4, 4]
+        meshes = stage_meshes(jax.devices(), 4)
+        assert len(meshes) == 4
+        assert all(int(m.shape["data"]) == 2 for m in meshes)
+        with pytest.raises(ValueError, match="split"):
+            slice_device_groups(jax.devices(), 3)
+        chunks = partition_stacked({"w": np.arange(8).reshape(8, 1)}, 2)
+        assert chunks[0]["w"].tolist() == [[0], [1], [2], [3]]
+        assert chunks[1]["w"].tolist() == [[4], [5], [6], [7]]
+        with pytest.raises(ValueError, match="divisible"):
+            partition_stacked({"w": np.arange(6).reshape(6, 1)}, 4)
+
+
+@pytest.mark.compute
+class TestEngine:
+    """The MPMD engine end-to-end on emulated slices (8 CPU devices)."""
+
+    def _cfg(self, layers=2):
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.models import transformer as T
+        return T.TransformerConfig(
+            vocab_size=64, num_layers=layers, embed_dim=32, num_heads=2,
+            head_dim=16, mlp_dim=64, max_seq_len=16, dtype=jnp.float32)
+
+    def _engine(self, cfg, num_slices=2, micro=4, devices=None):
+        import jax
+        import optax
+
+        from kubeflow_tpu.models.transformer import multislice_stage_fns
+        from kubeflow_tpu.parallel.multislice import (MPMDPipeline,
+                                                      stage_meshes)
+        init_fn, embed_fn, block_fn, head_loss_fn = \
+            multislice_stage_fns(cfg)
+        engine = MPMDPipeline(
+            meshes=stage_meshes(devices or jax.devices(), num_slices),
+            embed_fn=embed_fn, block_fn=block_fn,
+            head_loss_fn=head_loss_fn, optimizer=optax.adamw(1e-3),
+            num_microbatches=micro, grad_clip_norm=1.0)
+        return engine, init_fn
+
+    def test_parity_vs_single_program(self):
+        import jax
+        import optax
+
+        from kubeflow_tpu.models import transformer as T
+        from kubeflow_tpu.parallel.mesh import build_mesh
+        from kubeflow_tpu.runtime.trainstep import TrainStepBuilder
+
+        cfg = self._cfg()
+        spec = T.pipelined_workload_spec(cfg=cfg, seq_len=16, mesh=None)
+        ref = TrainStepBuilder(
+            mesh=build_mesh(ShardingSpec(data=8)),
+            loss_fn=spec.loss_fn,
+            optimizer=optax.chain(optax.clip_by_global_norm(1.0),
+                                  optax.adamw(1e-3)))
+        state_r = ref.init(spec.init_fn, jax.random.PRNGKey(0))
+        step_r = ref.build()
+
+        engine, init_fn = self._engine(cfg)
+        state_m = engine.init(lambda r: init_fn(r, 16),
+                              jax.random.PRNGKey(0))
+
+        batches = [spec.batch_fn(jax.random.PRNGKey(7 + i), 16)
+                   for i in range(2)]
+        for b in batches:
+            state_r, mr = step_r(state_r, ref.place_batch(b))
+            state_m, mm = engine.step(state_m, engine.place_batch(b))
+            assert abs(float(mr["loss"]) - mm["loss"]) <= 1e-5
+        assert int(state_m.step) == 2
+
+    def test_report_counts_explicit_transfers(self):
+        import jax
+        engine, init_fn = self._engine(self._cfg(), micro=4)
+        state = engine.init(lambda r: init_fn(r, 16),
+                            jax.random.PRNGKey(0))
+        tokens = {"tokens": jax.numpy.zeros((16, 16), jax.numpy.int32)}
+        engine.step(state, engine.place_batch(tokens))
+        rep = engine.last_report
+        # (S-1)*M activations fwd + M targets + (S-1)*M cotangents
+        assert rep.dcn_transfers == 4 + 4 + 4
+        assert rep.dcn_bytes > 0
+        assert 0.0 <= rep.bubble_fraction < 1.0
+        d = rep.to_dict()
+        assert d["numStages"] == 2 and d["numMicrobatches"] == 4
+        json.dumps(d)   # span/bench payload must be JSON-clean
+
+    def test_microbatch_divisibility_rejected(self):
+        import jax
+        engine, init_fn = self._engine(self._cfg(), micro=5)
+        state = engine.init(lambda r: init_fn(r, 16),
+                            jax.random.PRNGKey(0))
+        tokens = {"tokens": jax.numpy.zeros((16, 16), jax.numpy.int32)}
+        with pytest.raises(ValueError, match="divisible"):
+            engine.step(state, engine.place_batch(tokens))
+
+    def test_stage_programs_carry_no_cross_slice_collectives(self):
+        """The MPMD promise: per-stage programs have NO compiler-
+        inserted cross-slice traffic — every DCN byte is an explicit
+        transfer the schedule counts."""
+        import jax
+
+        from kubeflow_tpu.obs.collectives import parse_hlo_collectives
+        from kubeflow_tpu.parallel.multislice import FWD
+        engine, init_fn = self._engine(self._cfg())
+        state = engine.init(lambda r: init_fn(r, 16),
+                            jax.random.PRNGKey(0))
+        tok0 = jax.ShapeDtypeStruct((4, 16), jax.numpy.int32)
+        hlo = engine.stage_hlo(FWD, 0, state.params[0], tok0)
+        for op in parse_hlo_collectives(hlo):
+            groups = op.groups or []
+            for g in groups:
+                # stage 0's mesh is its own 4 devices: participant ids
+                # beyond them would be cross-slice
+                assert all(p < 4 for p in g), (op.name, g)
+
+    def test_aot_export_load_round_trip(self, tmp_path):
+        import jax
+
+        from kubeflow_tpu.runtime import aot as aot_mod
+        cfg = self._cfg()
+        engine, init_fn = self._engine(cfg)
+        state = engine.init(lambda r: init_fn(r, 16),
+                            jax.random.PRNGKey(0))
+        tokens = {"tokens": jax.numpy.zeros((16, 16), jax.numpy.int32)}
+        batch = engine.place_batch(tokens)
+
+        def key_fn(s, kind):
+            return aot_mod.step_key(
+                topology="v5e-4", num_slices=2, model_fingerprint="fp",
+                weight_update="mpmd", sharding={"data": 4},
+                global_batch=16,
+                extra={"stage": s, "program": kind})
+
+        keys = engine.export_stages(str(tmp_path), state, batch, key_fn)
+        # 2S-1 schedule-facing programs: fwd+bwd per non-last stage,
+        # one fused fwd+loss+bwd on the last
+        assert len(keys) == 3 and len(set(keys)) == 3
+        state1, m1 = engine.step(state, batch)
+
+        # a FRESH engine loads every stage program — no XLA
+        engine2, init_fn2 = self._engine(cfg)
+        state2 = engine2.init(lambda r: init_fn2(r, 16),
+                              jax.random.PRNGKey(0))
+        n = engine2.load_stages(str(tmp_path), state2, batch, key_fn)
+        assert n == engine2.num_programs == 3
+        state2b, m2 = engine2.step(state2, batch)
+        assert m2["loss"] == pytest.approx(m1["loss"], abs=1e-6)
+        # reset drops the loaded programs (the fallback ladder's rung)
+        engine2.reset_programs()
+        assert not engine2._programs
+
+
+@pytest.mark.compute
+class TestWorkerIntegration:
+    def test_train_multislice_emits_bubble_ledger(self, tmp_path,
+                                                  monkeypatch):
+        """The worker-integrated path: train(multislice_pipeline=True)
+        over 2 emulated slices streams window + pipeline-bubble spans,
+        and the goodput ledger carries a nonzero pipeline_bubble
+        category that still sums to wall-clock."""
+        from kubeflow_tpu.models import transformer as T
+        from kubeflow_tpu.obs import goodput as gp
+        from kubeflow_tpu.obs.trace import load_spans
+        from kubeflow_tpu.runtime.worker import train
+
+        monkeypatch.setenv("KFTPU_NUM_SLICES", "2")
+        sink = str(tmp_path / "spans.jsonl")
+        result = train(
+            workload="transformer-pipelined", steps=4, global_batch=16,
+            sync_every=2, span_path=sink, multislice_pipeline=True,
+            multislice_microbatches=4, handle_sigterm=False,
+            workload_kwargs={"cfg": T.TransformerConfig.tiny()})
+        assert result.steps == 4
+        ledger = gp.decompose(load_spans(sink))
+        assert ledger["badputSeconds"][gp.BADPUT_PIPELINE_BUBBLE] > 0
+        assert gp.categories_sum_ok(ledger)
+        names = {s.get("name") for s in load_spans(sink)}
+        assert gp.SPAN_PIPELINE_BUBBLE in names
+        assert "multislice-profile" in names
+
+    def test_train_multislice_rejects_wrong_workload(self, monkeypatch):
+        from kubeflow_tpu.runtime.worker import train
+        monkeypatch.setenv("KFTPU_NUM_SLICES", "2")
+        with pytest.raises(ValueError, match="transformer-pipelined"):
+            train(workload="transformer", steps=1,
+                  multislice_pipeline=True, handle_sigterm=False)

@@ -57,6 +57,19 @@ def validate_weight_update(mode: str) -> str:
     return mode
 
 
+# Kernel-tier vocabularies (spec.kernels → KFTPU_KERNEL_* → the recipe
+# fingerprint and the AOT step key). Each names an optimized execution
+# path for one segment of the compute: which attention implementation
+# transformer workloads run, whether the (shard-local) optimizer update
+# runs as the fused Pallas kernel or the stock optax chain, and whether
+# a served model is int8-quantized behind the parity gate. Defined HERE,
+# jax-free, like WEIGHT_UPDATE_MODES: admission must not import the
+# runtime. docs/training.md "Kernel tier".
+ATTENTION_KERNELS = ("einsum", "flash", "ring")
+OPTIMIZER_KERNELS = ("stock", "fused_adam")
+SERVING_KERNELS = ("stock", "int8")
+
+
 @dataclass
 class InputSpec:
     """Input-pipeline knobs (``spec.input``): how the worker feeds the
@@ -332,6 +345,76 @@ class MultisliceSpec:
         if unknown:
             raise ValueError(
                 f"unknown multislice knobs {sorted(unknown)}; "
+                f"valid: {sorted(by_spec)}")
+        spec = cls(**{by_spec[k]: v for k, v in d.items()})
+        spec.validate()
+        return spec
+
+
+@dataclass
+class KernelSpec:
+    """Kernel-tier knobs (``spec.kernels``): which optimized execution
+    path each compute segment runs (ISSUE 16 "Raw-speed kernel tier").
+    Plumbed the full operator path like InputSpec — parsed here at
+    admission, rendered by controllers/tpujob.py as the env named in
+    each field's metadata, consumed by runtime/worker.py via the CLI
+    flag named there (tests/test_lint.py enforces every layer).
+    ``None`` = unset, worker default (stock/einsum — the tier is opt-in).
+    Every set knob is baked into ``recipe_fingerprint`` and the AOT
+    ``step_key`` so a tier flip can never alias a cached executable.
+    Defined HERE, jax-free: admission must not import the runtime."""
+
+    # attention implementation for transformer workloads: "einsum"
+    # (stock XLA), "flash" (ops/flash_attention.py Pallas kernel — falls
+    # back to einsum on unaligned shapes, visibly:
+    # kftpu_kernel_fallback_total), or "ring" (sequence-parallel)
+    attention: Optional[str] = field(default=None, metadata={
+        "spec_field": "attention", "env": "KFTPU_KERNEL_ATTENTION",
+        "cli": "--kernel-attention"})
+    # optimizer update: "stock" (optax chain) or "fused_adam"
+    # (ops/fused_adam.py — one Pallas kernel for decay+moments+step over
+    # the shard-local slab; requires --optimizer adam)
+    optimizer: Optional[str] = field(default=None, metadata={
+        "spec_field": "optimizer", "env": "KFTPU_KERNEL_OPTIMIZER",
+        "cli": "--kernel-optimizer"})
+    # serving path: "stock" (f32 weights) or "int8" (per-channel absmax
+    # quantized matmul weights behind the accuracy parity gate —
+    # serving/servable.py)
+    serving: Optional[str] = field(default=None, metadata={
+        "spec_field": "serving", "env": "KFTPU_KERNEL_SERVING",
+        "cli": "--kernel-serving"})
+
+    def validate(self) -> None:
+        for name, value, vocab in (
+                ("attention", self.attention, ATTENTION_KERNELS),
+                ("optimizer", self.optimizer, OPTIMIZER_KERNELS),
+                ("serving", self.serving, SERVING_KERNELS)):
+            if value is not None and value not in vocab:
+                raise ValueError(
+                    f"kernels.{name} {value!r} not one of {vocab}")
+
+    def to_dict(self) -> dict:
+        return {f.metadata["spec_field"]: getattr(self, f.name)
+                for f in fields(self) if getattr(self, f.name) is not None}
+
+    def to_env(self) -> dict[str, str]:
+        """The controller-rendered worker env for every SET knob."""
+        return {f.metadata["env"]: str(getattr(self, f.name))
+                for f in fields(self)
+                if getattr(self, f.name) is not None}
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "KernelSpec":
+        if d is not None and not isinstance(d, dict):
+            raise ValueError(
+                f"spec.kernels must be a mapping of kernel-tier knobs, "
+                f"got {type(d).__name__}: {d!r}")
+        d = dict(d or {})
+        by_spec = {f.metadata["spec_field"]: f.name for f in fields(cls)}
+        unknown = set(d) - set(by_spec)
+        if unknown:
+            raise ValueError(
+                f"unknown kernel-tier knobs {sorted(unknown)}; "
                 f"valid: {sorted(by_spec)}")
         spec = cls(**{by_spec[k]: v for k, v in d.items()})
         spec.validate()
@@ -838,6 +921,11 @@ class TrainingJob:
     # the MPMD pipeline-over-DCN path and its microbatch schedule
     # (docs/training.md "Multi-slice training")
     multislice: MultisliceSpec = field(default_factory=MultisliceSpec)
+    # kernel-tier knobs (spec.kernels → KFTPU_KERNEL_*): which optimized
+    # execution path each compute segment runs — attention / optimizer /
+    # serving (docs/training.md "Kernel tier"); every set knob is baked
+    # into the recipe fingerprint and AOT step key
+    kernels: KernelSpec = field(default_factory=KernelSpec)
     # gang-scheduling knobs (spec.schedulingPolicy → the slice
     # scheduler's queue/priority/preemptible; None = not
     # scheduler-managed, the legacy immediate-create path)
@@ -912,6 +1000,7 @@ class TrainingJob:
             obs_spec=ObsSpec.from_dict(spec.get("observability")),
             warm_start=WarmStartSpec.from_dict(spec.get("warmStart")),
             multislice=MultisliceSpec.from_dict(spec.get("multislice")),
+            kernels=KernelSpec.from_dict(spec.get("kernels")),
             scheduling_policy=SchedulingPolicy.from_dict(
                 spec.get("schedulingPolicy")),
             weight_update=spec.get("weightUpdate", "") or "",
@@ -954,6 +1043,7 @@ class TrainingJob:
         self.obs_spec.validate()
         self.warm_start.validate()
         self.multislice.validate()
+        self.kernels.validate()
         if self.scheduling_policy is not None:
             self.scheduling_policy.validate()
         vocab = REPLICA_TYPES[self.kind]
@@ -1098,6 +1188,8 @@ class TrainingJob:
             out["spec"]["warmStart"] = self.warm_start.to_dict()
         if self.multislice.to_dict():
             out["spec"]["multislice"] = self.multislice.to_dict()
+        if self.kernels.to_dict():
+            out["spec"]["kernels"] = self.kernels.to_dict()
         if self.scheduling_policy is not None:
             out["spec"]["schedulingPolicy"] = self.scheduling_policy.to_dict()
         if self.weight_update:

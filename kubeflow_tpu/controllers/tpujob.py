@@ -685,6 +685,11 @@ class TrainingJobReconciler(Reconciler):
         # activation transfers — runtime/worker.py,
         # parallel/multislice.py)
         env.update(job.multislice.to_env())
+        # spec.kernels → KFTPU_KERNEL_ATTENTION/_OPTIMIZER/_SERVING: the
+        # kernel tier (flash attention / fused-Adam update / int8
+        # serving) — runtime/worker.py consumes them and bakes every set
+        # knob into the recipe fingerprint + AOT step key
+        env.update(job.kernels.to_env())
         from ..runtime.compile_cache import (COMPILE_CACHE_ENV,
                                              SHARED_CACHE_ROOT_ENV,
                                              default_cache_dir,

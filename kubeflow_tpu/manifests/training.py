@@ -97,6 +97,20 @@ def _job_schema(specs_key: str, max_one: list[str]) -> dict:
             "pipeline": {"type": "boolean"},
             "microbatches": {"type": "integer", "minimum": 1},
         }},
+        # kernel-tier knobs (api/trainingjob.py KernelSpec →
+        # KFTPU_KERNEL_ATTENTION / KFTPU_KERNEL_OPTIMIZER /
+        # KFTPU_KERNEL_SERVING: flash attention, the fused-Adam Pallas
+        # update, int8 quantized serving — every set knob is baked into
+        # the recipe fingerprint + AOT step key; tests/test_lint.py
+        # enforces the same full-path rule)
+        "kernels": {"type": "object", "properties": {
+            "attention": {"type": "string",
+                          "enum": ["einsum", "flash", "ring"]},
+            "optimizer": {"type": "string",
+                          "enum": ["stock", "fused_adam"]},
+            "serving": {"type": "string",
+                        "enum": ["stock", "int8"]},
+        }},
         # persistent XLA compile cache dir override (defaults to the
         # namespace's shared cache when the operator carries
         # KFTPU_SHARED_CACHE_ROOT, else <checkpointDir>/.jax-compile-cache)

@@ -267,6 +267,7 @@ def serving_rollup(path: str) -> dict:
         bad = {c: 0.0 for c in SERVING_BADPUT_CATEGORIES}
         errors = shed = 0
         slo_target_ms = None
+        quant_delta = None
         over_slo = 0
         slowest: list[tuple] = []
         for rec, a in recs:
@@ -299,6 +300,13 @@ def serving_rollup(path: str) -> dict:
                         over_slo += 1
                 except (TypeError, ValueError):
                     pass
+            if a.get("quant_delta") is not None:
+                # int8 tier's measured accuracy delta (one value per
+                # loaded model version; last span wins)
+                try:
+                    quant_delta = float(a["quant_delta"])
+                except (TypeError, ValueError):
+                    pass
             slowest.append((wall, str(rec.get("trace_id", ""))))
         lat.sort()
         slowest.sort(reverse=True)
@@ -318,6 +326,8 @@ def serving_rollup(path: str) -> dict:
             "slowest": [{"requestId": rid, "wallMs": round(w * 1e3, 3)}
                         for w, rid in slowest[:3]],
         }
+        if quant_delta is not None:
+            row["quantDelta"] = round(quant_delta, 6)
         if slo_target_ms is not None:
             # p99 target → 1% of requests are allowed over it; the
             # over-target fraction against that budget is the window

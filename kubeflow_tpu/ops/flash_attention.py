@@ -26,6 +26,7 @@ TPU notes:
 from __future__ import annotations
 
 import functools
+import logging
 import math
 from typing import Optional
 
@@ -34,7 +35,36 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+log = logging.getLogger(__name__)
+
 NEG_INF = -1e30  # big-but-finite: avoids NaN from (-inf) - (-inf)
+
+# once-per-(kernel,reason) warning guard — a job that requested `flash`
+# but silently ran einsum every step was invisible before ISSUE 16
+_warned_fallbacks: set = set()
+
+
+def count_fallback(kernel: str, reason: str, detail: str = "") -> None:
+    """Record that an optimized kernel declined a shape and ran its
+    reference path instead: once-per-process WARNING plus the
+    ``kftpu_kernel_fallback_total{kernel,reason}`` counter (worker
+    /metrics + dashboard). Called at trace time — block selection is
+    static Python over shapes — so it fires once per compiled program,
+    not once per step; the counter answers "did the tier I asked for
+    actually run", not "how many steps"."""
+    from ..obs import registry as obsreg
+    obsreg.counter(
+        "kftpu_kernel_fallback_total",
+        "optimized-kernel requests that fell back to the reference path",
+        labels=("kernel", "reason")).labels(
+            kernel=kernel, reason=reason).inc()
+    if (kernel, reason) not in _warned_fallbacks:
+        _warned_fallbacks.add((kernel, reason))
+        log.warning(
+            "kernel %s fell back to its reference path (%s%s) — the "
+            "requested tier is NOT running; see "
+            "kftpu_kernel_fallback_total on /metrics", kernel, reason,
+            f": {detail}" if detail else "")
 
 
 def _interpret() -> bool:
@@ -343,6 +373,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         if with_lse:
             raise ValueError(
                 f"with_lse needs block-divisible seq lens, got {sq},{sk}")
+        # fixed-vocabulary reason (metric label cardinality stays bounded);
+        # the offending shape goes to the log line via the warning
+        count_fallback("flash_attention", "unaligned-seq", f"seq {sq}x{sk}")
         return reference_attention(q, k, v, causal=causal, scale=scale)
 
     def fold(x):  # [B,S,H,D] -> [B*H, S, D]

@@ -1,0 +1,207 @@
+"""Fused shard-local Adam update as a Pallas kernel (ISSUE 16 rung 2).
+
+The zero2-explicit path (runtime/trainstep.py) reduce-scatters gradients
+and then runs the optimizer over the shard-local slab as a stock optax
+chain: weight decay, moment update, bias correction and the parameter
+step each materialize intermediates in HBM — five reads and three writes
+per element where one read of (p, m, v, g) and one write of (Δp, m', v')
+suffices. "Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training" (PAPERS.md) makes the weight update a first-class
+optimization target; this kernel is the compute half of that argument.
+
+One Pallas kernel fuses, per element of the shard-local slab:
+
+    g  ← g + wd·p                 (L2-into-gradient, recipe decay_mask)
+    m' ← β₁·m + (1−β₁)·g
+    v' ← β₂·v + (1−β₂)·g²
+    Δp ← −lr · (m'/bc₁) / (√(v'/bc₂) + ε)
+
+streaming (p, m, v, g) through VMEM in (rows × 128-lane) tiles with f32
+accumulate, emitting (Δp, m', v') in one pass. Exposed as an optax
+``GradientTransformation`` (``fused_adam``) so it drops into every
+TrainStepBuilder weight-update mode unchanged — under zero2-explicit the
+update runs under GSPMD sharding constraints, so the kernel operates on
+exactly the shard-local shard; under replicated it fuses the full slab.
+
+Numerics contract: parity ≤ 1e-5 against the stock optax reference
+``chain(add_decayed_weights(wd, decay_mask), adam(sched))`` — enforced by
+tests/test_kernels.py and re-measured by ``bench.py --mode kernels``.
+
+TPU notes:
+- each leaf is flattened, zero-padded to a whole number of (8, 128) f32
+  tiles, and processed as a [rows, 128] slab; zero padding is a fixed
+  point of the update (m'=v'=Δp=0), so the pad lanes never leak.
+- lr / wd / bias corrections arrive as a (4,) SMEM operand — lr is a
+  traced schedule value, so it cannot be a Python closure constant.
+- off-TPU (tests, CPU smoke) the same kernel runs with ``interpret=True``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128       # TPU lane width: last dim of every tile
+SUBLANES = 8      # f32 sublane alignment
+# rows per grid step: 256×128 f32 ≈ 128 KiB per operand; 7 operands in
+# flight ≈ 0.9 MiB of VMEM — comfortably under the ~16 MiB budget while
+# long enough to amortize DMA issue
+BLOCK_ROWS = 256
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _adam_kernel(scal_ref, p_ref, m_ref, v_ref, g_ref,
+                 dp_ref, m_out_ref, v_out_ref, *, b1: float, b2: float,
+                 eps: float):
+    """One (rows, 128) tile of the fused update. scal_ref (SMEM, f32[4])
+    carries [lr, wd, bias_corr1, bias_corr2]; β/ε are compile-time."""
+    lr = scal_ref[0]
+    wd = scal_ref[1]
+    bc1 = scal_ref[2]
+    bc2 = scal_ref[3]
+    p = p_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32) + wd * p
+    m = b1 * m_ref[:] + (1.0 - b1) * g
+    v = b2 * v_ref[:] + (1.0 - b2) * (g * g)
+    m_hat = m / bc1
+    v_hat = v / bc2
+    dp_ref[:] = (-lr * m_hat / (jnp.sqrt(v_hat) + eps)).astype(dp_ref.dtype)
+    m_out_ref[:] = m
+    v_out_ref[:] = v
+
+
+def _fused_leaf_update(p: jax.Array, m: jax.Array, v: jax.Array,
+                       g: jax.Array, scalars: jax.Array, *, b1: float,
+                       b2: float, eps: float):
+    """Run the fused kernel over one (arbitrary-shape) leaf. Returns
+    (Δp, m', v') with Δp in the leaf dtype and m'/v' in f32."""
+    shape, dtype = p.shape, p.dtype
+    n = int(p.size)
+    if n == 0:
+        z = jnp.zeros(shape, jnp.float32)
+        return jnp.zeros(shape, dtype), z, z
+
+    rows = max(-(-n // LANES), SUBLANES)
+    rows += (-rows) % SUBLANES
+    block_rows = min(rows, BLOCK_ROWS)
+    rows += (-rows) % block_rows
+    padded = rows * LANES
+
+    def slab(x, dt):
+        flat = x.reshape(-1).astype(dt)
+        return jnp.pad(flat, (0, padded - n)).reshape(rows, LANES)
+
+    kernel = functools.partial(_adam_kernel, b1=b1, b2=b2, eps=eps)
+    grid = (rows // block_rows,)
+    bspec = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0))
+    dp, m2, v2 = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  bspec, bspec, bspec, bspec],
+        out_specs=[pl.BlockSpec((block_rows, LANES), lambda i: (i, 0)),
+                   pl.BlockSpec((block_rows, LANES), lambda i: (i, 0)),
+                   pl.BlockSpec((block_rows, LANES), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((rows, LANES), dtype),
+                   jax.ShapeDtypeStruct((rows, LANES), jnp.float32),
+                   jax.ShapeDtypeStruct((rows, LANES), jnp.float32)],
+        interpret=_interpret(),
+    )(scalars, slab(p, jnp.float32), slab(m, jnp.float32),
+      slab(v, jnp.float32), slab(g, jnp.float32))
+    unpad = lambda x: x.reshape(-1)[:n].reshape(shape)  # noqa: E731
+    return unpad(dp), unpad(m2), unpad(v2)
+
+
+class FusedAdamState(NamedTuple):
+    """Mirrors optax scale_by_adam's (count, mu, nu); mu/nu held in f32
+    regardless of param dtype (the kernel accumulates in f32)."""
+    count: jax.Array
+    mu: Any
+    nu: Any
+
+
+def fused_adam(
+    learning_rate: Union[float, optax.Schedule],
+    *,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    mask: Optional[Union[Any, Callable[[Any], Any]]] = None,
+) -> optax.GradientTransformation:
+    """Drop-in for ``chain(add_decayed_weights(wd, mask), adam(lr))`` that
+    executes the whole per-leaf update as ONE Pallas kernel. Matches
+    optax semantics exactly: lr evaluated at the pre-increment count,
+    bias correction at count+1, L2 folded into the gradient before the
+    moment update, decay applied only where ``mask`` is True."""
+
+    def init_fn(params):
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
+        return FusedAdamState(count=jnp.zeros([], jnp.int32), mu=zeros,
+                              nu=jax.tree.map(jnp.copy, zeros))
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("fused_adam needs params (weight decay + "
+                             "parameter-relative update)")
+        mask_tree = mask(params) if callable(mask) else mask
+        if mask_tree is None:
+            mask_tree = jax.tree.map(lambda _: True, params)
+        count_inc = optax.safe_int32_increment(state.count)
+        lr = (learning_rate(state.count) if callable(learning_rate)
+              else learning_rate)
+        lr = jnp.asarray(lr, jnp.float32)
+        bc1 = 1.0 - jnp.power(jnp.asarray(b1, jnp.float32), count_inc)
+        bc2 = 1.0 - jnp.power(jnp.asarray(b2, jnp.float32), count_inc)
+
+        leaves_p, treedef = jax.tree_util.tree_flatten(params)
+        leaves_g = treedef.flatten_up_to(updates)
+        leaves_m = treedef.flatten_up_to(state.mu)
+        leaves_v = treedef.flatten_up_to(state.nu)
+        leaves_mask = treedef.flatten_up_to(mask_tree)
+
+        out_dp, out_m, out_v = [], [], []
+        for p, g, m, v, decay in zip(leaves_p, leaves_g, leaves_m,
+                                     leaves_v, leaves_mask):
+            wd = jnp.asarray(weight_decay if decay else 0.0, jnp.float32)
+            scalars = jnp.stack([lr, wd, bc1, bc2])
+            dp, m2, v2 = _fused_leaf_update(p, m, v, g, scalars, b1=b1,
+                                            b2=b2, eps=eps)
+            out_dp.append(dp)
+            out_m.append(m2)
+            out_v.append(v2)
+        new_state = FusedAdamState(
+            count=count_inc,
+            mu=jax.tree_util.tree_unflatten(treedef, out_m),
+            nu=jax.tree_util.tree_unflatten(treedef, out_v))
+        return jax.tree_util.tree_unflatten(treedef, out_dp), new_state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def reference_adam(
+    learning_rate: Union[float, optax.Schedule],
+    *,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    mask: Optional[Union[Any, Callable[[Any], Any]]] = None,
+) -> optax.GradientTransformation:
+    """The stock optax chain the fused kernel must match to ≤1e-5 —
+    the executable spec for tests and ``bench.py --mode kernels``."""
+    txs = []
+    if weight_decay:
+        txs.append(optax.add_decayed_weights(weight_decay, mask=mask))
+    txs.append(optax.adam(learning_rate, b1=b1, b2=b2, eps=eps))
+    return optax.chain(*txs)

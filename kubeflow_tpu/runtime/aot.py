@@ -51,14 +51,18 @@ def default_aot_dir(volume_dir: str) -> str:
 
 def step_key(*, topology: str, num_slices: int, model_fingerprint: str,
              weight_update: str, sharding: dict, global_batch: int,
+             kernels: Optional[dict] = None,
              extra: Optional[dict] = None) -> str:
     """Stable key of one compiled train step. Everything that changes
     the compiled program must feed it: the slice geometry, the model +
     recipe fingerprint (recipe.recipe_fingerprint), the weight-update
-    layout, the resolved sharding axes, the global batch, and — added
-    here so no caller can forget — the jax/jaxlib versions and backend
-    platform (a jaxlib upgrade silently invalidates serialized
-    executables; the key must rotate with it)."""
+    layout, the resolved sharding axes, the global batch, the kernel
+    tier (ISSUE 16 — the tier is ALSO inside the recipe fingerprint,
+    but it rides here explicitly so a caller composing its own
+    fingerprint cannot alias a flash/fused executable with a stock
+    one), and — added here so no caller can forget — the jax/jaxlib
+    versions and backend platform (a jaxlib upgrade silently
+    invalidates serialized executables; the key must rotate with it)."""
     import jax
     import jaxlib
     parts = {
@@ -68,6 +72,8 @@ def step_key(*, topology: str, num_slices: int, model_fingerprint: str,
         "weightUpdate": weight_update,
         "sharding": {k: int(v) for k, v in sorted((sharding or {}).items())},
         "globalBatch": int(global_batch),
+        "kernels": {k: str(v)
+                    for k, v in sorted((kernels or {}).items())},
         "jax": jax.__version__,
         "jaxlib": jaxlib.__version__,
         "platform": jax.devices()[0].platform,

@@ -31,6 +31,11 @@ SCHEDULES = ("constant", "cosine", "step", "linear")
 # re-exported here because it is a step-engine knob (PERF.md).
 from ..api.trainingjob import (WEIGHT_UPDATE_MODES,  # noqa: F401,E402
                                validate_weight_update)
+# Kernel-tier vocabularies (ISSUE 16): same jax-free admission-layer
+# home, re-exported here because the optimizer rung is a recipe knob
+# (make_optimizer(kernels=...)).
+from ..api.trainingjob import (ATTENTION_KERNELS,  # noqa: F401,E402
+                               OPTIMIZER_KERNELS, SERVING_KERNELS)
 
 # classic ImageNet step-decay epochs 30/60/80 of 90, as fractions of the run
 STEP_BOUNDARIES = (1 / 3, 2 / 3, 8 / 9)
@@ -116,12 +121,40 @@ def make_optimizer(
     weight_decay: float = 0.0,
     momentum: float = 0.9,
     grad_clip: Optional[float] = 1.0,
+    kernels: str = "stock",
 ) -> tuple[optax.GradientTransformation, optax.Schedule]:
     """One optax chain for the whole recipe. Returns (transform, schedule);
-    the schedule is also returned alone so callers can log lr(step)."""
+    the schedule is also returned alone so callers can log lr(step).
+
+    ``kernels`` selects the optimizer rung of the kernel tier
+    (OPTIMIZER_KERNELS): "fused_adam" replaces the
+    add_decayed_weights+adam sub-chain with the single fused Pallas
+    kernel (ops/fused_adam.py — parity ≤1e-5 vs this function's stock
+    chain). Cross-leaf global-norm clipping stays a separate outer
+    transform either way. The tier is baked into recipe_fingerprint by
+    the worker, so a flip can never alias a cached executable."""
     if name not in OPTIMIZERS:
         raise ValueError(f"optimizer {name!r} not one of {OPTIMIZERS}")
+    if kernels not in OPTIMIZER_KERNELS:
+        raise ValueError(
+            f"kernels.optimizer {kernels!r} not one of {OPTIMIZER_KERNELS}")
     sched = lr_schedule(schedule, learning_rate, total_steps, warmup_steps)
+
+    if kernels == "fused_adam":
+        # reject, don't silently downgrade: a requested fused tier that
+        # quietly ran the stock chain would be invisible (the same rule
+        # as multislice.microbatches-without-pipeline)
+        if name != "adam":
+            raise ValueError(
+                f"kernels.optimizer 'fused_adam' requires optimizer "
+                f"'adam', got {name!r}")
+        from ..ops.fused_adam import fused_adam
+        txs = []
+        if grad_clip:
+            txs.append(optax.clip_by_global_norm(grad_clip))
+        txs.append(fused_adam(sched, weight_decay=weight_decay,
+                              mask=decay_mask))
+        return optax.chain(*txs), sched
 
     txs: list[optax.GradientTransformation] = []
     if grad_clip:

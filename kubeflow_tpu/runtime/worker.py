@@ -103,6 +103,10 @@ _MESH_AWARE_WORKLOADS = {"transformer-pipelined"} | \
 # workloads that consume --num-microbatches (GPipe scheduling)
 _PIPELINED_WORKLOADS = {"transformer-pipelined"}
 
+# workloads whose spec factory takes a TransformerConfig (cfg=) — the
+# kernels.attention tier rewrites cfg.attention for these
+_TRANSFORMER_WORKLOADS = {"transformer", "transformer-pipelined"}
+
 # workloads that consume --data-dir (ImageNet-style record shards)
 _IMAGE_WORKLOADS = {f"resnet{d}" for d in RESNET_DEPTHS}
 
@@ -254,6 +258,9 @@ def train(
     aot_dir: Optional[str] = None,
     multislice_pipeline: Optional[bool] = None,
     multislice_microbatches: Optional[int] = None,
+    kernel_attention: Optional[str] = None,
+    kernel_optimizer: Optional[str] = None,
+    kernel_serving: Optional[str] = None,
 ) -> TrainResult:
     # before any jit: warm restarts must hit the persistent cache for the
     # very first compile (the startup→first-step dominator, PERF.md) —
@@ -322,6 +329,49 @@ def train(
 
     if label_smoothing and workload in _IMAGE_WORKLOADS:
         workload_kwargs.setdefault("label_smoothing", label_smoothing)
+
+    # kernel tier (ISSUE 16): CLI flag wins, then the operator-rendered
+    # env (controllers/tpujob.py renders spec.kernels.* as
+    # KFTPU_KERNEL_*), then stock. Every resolved knob is baked into the
+    # recipe fingerprint + AOT step key below — a tier flip can never
+    # alias a cached executable.
+    from ..api.trainingjob import (ATTENTION_KERNELS, OPTIMIZER_KERNELS,
+                                   SERVING_KERNELS)
+    ka_set = kernel_attention or os.environ.get("KFTPU_KERNEL_ATTENTION")
+    kernel_attention = ka_set or "einsum"
+    kernel_optimizer = kernel_optimizer or \
+        os.environ.get("KFTPU_KERNEL_OPTIMIZER") or "stock"
+    kernel_serving = kernel_serving or \
+        os.environ.get("KFTPU_KERNEL_SERVING") or "stock"
+    for _seg, _val, _vocab in (
+            ("attention", kernel_attention, ATTENTION_KERNELS),
+            ("optimizer", kernel_optimizer, OPTIMIZER_KERNELS),
+            ("serving", kernel_serving, SERVING_KERNELS)):
+        if _val not in _vocab:
+            raise ValueError(
+                f"kernels.{_seg} {_val!r} not one of {_vocab}")
+    if ka_set:
+        # the attention tier configures the transformer's attention
+        # implementation; on any other workload it would be a silent
+        # no-op the user mistakes for a speedup — reject at startup
+        if workload not in _TRANSFORMER_WORKLOADS:
+            raise ValueError(
+                f"kernels.attention applies to transformer workloads, "
+                f"not {workload!r}")
+        from ..models import transformer as _TK
+        _cfg = workload_kwargs.get("cfg") or _TK.TransformerConfig.tiny()
+        workload_kwargs["cfg"] = replace(_cfg, attention=kernel_attention)
+    # active tier on /metrics (labels, value 1): the dashboard's runs
+    # panel and a flight-recorder dump both read it; pairs with
+    # kftpu_kernel_fallback_total to answer "did the tier actually run"
+    from ..obs import registry as obsreg
+    obsreg.gauge(
+        "kftpu_kernel_tier_info",
+        "active kernel tier of this worker (info-style: value is 1)",
+        labels=("attention", "optimizer", "serving")).labels(
+            attention=kernel_attention, optimizer=kernel_optimizer,
+            serving=kernel_serving).set(1)
+
     spec = WORKLOADS[workload](**workload_kwargs)
     if data_source is not None:
         from ..data.imagenet import device_normalize
@@ -341,7 +391,7 @@ def train(
     opt, lr_fn = make_optimizer(
         optimizer, base_lr, schedule=lr_schedule, total_steps=steps,
         warmup_steps=warmup_steps, weight_decay=weight_decay,
-        momentum=momentum)
+        momentum=momentum, kernels=kernel_optimizer)
     # weight-update layout (ZeRO-2 sharded vs replicated): CLI flag wins,
     # then the operator-rendered env (controllers/tpujob.py renders
     # spec.weightUpdate as KFTPU_WEIGHT_UPDATE), then replicated
@@ -394,7 +444,7 @@ def train(
         opt_ms, lr_fn = make_optimizer(
             optimizer, base_lr, schedule=lr_schedule, total_steps=steps,
             warmup_steps=warmup_steps, weight_decay=weight_decay,
-            momentum=momentum, grad_clip=None)
+            momentum=momentum, grad_clip=None, kernels=kernel_optimizer)
         builder = MultisliceTrainStepBuilder(
             cfg=workload_kwargs.get("cfg") or _T.TransformerConfig.tiny(),
             num_slices=n_slices,
@@ -633,6 +683,8 @@ def train(
                     warmup_steps=warmup_steps, weight_decay=weight_decay,
                     momentum=momentum, label_smoothing=label_smoothing,
                     steps=steps, real_data=False,
+                    kernels={"attention": kernel_attention,
+                             "optimizer": kernel_optimizer},
                     workload_kwargs=workload_kwargs)
                 engine = builder.engine
                 stage_sharding = {
@@ -645,6 +697,8 @@ def train(
                         num_slices=n_slices, model_fingerprint=fp,
                         weight_update="mpmd", sharding=stage_sharding,
                         global_batch=global_batch,
+                        kernels={"attention": kernel_attention,
+                                 "optimizer": kernel_optimizer},
                         extra={"stage": s, "program": kind,
                                "microbatches":
                                    engine.num_microbatches})
@@ -680,6 +734,8 @@ def train(
                     warmup_steps=warmup_steps, weight_decay=weight_decay,
                     momentum=momentum, label_smoothing=label_smoothing,
                     steps=steps, real_data=data_source is not None,
+                    kernels={"attention": kernel_attention,
+                             "optimizer": kernel_optimizer},
                     workload_kwargs=workload_kwargs)
                 sig = aot_mod.abstract_signature(state, example)
                 key = aot_mod.step_key(
@@ -690,7 +746,9 @@ def train(
                     model_fingerprint=fp, weight_update=weight_update,
                     sharding={a: int(n)
                               for a, n in ctx.mesh.shape.items()},
-                    global_batch=global_batch)
+                    global_batch=global_batch,
+                    kernels={"attention": kernel_attention,
+                             "optimizer": kernel_optimizer})
                 loaded = aot_mod.load_step(aot_dir, key, sig)
                 if loaded is not None:
                     step_fn = loaded
@@ -1195,7 +1253,8 @@ def main(argv=None) -> int:
                         "then 4x the slice count; bubble fraction is "
                         "(S-1)/(M+S-1))")
     # training recipe (the tf_cnn_benchmarks flag surface, runtime/recipe.py)
-    from .recipe import OPTIMIZERS, SCHEDULES, WEIGHT_UPDATE_MODES
+    from .recipe import (ATTENTION_KERNELS, OPTIMIZER_KERNELS, OPTIMIZERS,
+                         SCHEDULES, SERVING_KERNELS, WEIGHT_UPDATE_MODES)
     p.add_argument("--weight-update", default=None,
                    choices=WEIGHT_UPDATE_MODES,
                    help="optimizer-update layout across data-parallel "
@@ -1227,6 +1286,23 @@ def main(argv=None) -> int:
     p.add_argument("--fused-tile-bt", type=int, default=0,
                    help="ghost-batch tile size for --fused-blocks "
                         "(0 = auto by VMEM budget)")
+    p.add_argument("--kernel-attention", default=None,
+                   choices=list(ATTENTION_KERNELS),
+                   help="attention kernel tier for transformer "
+                        "workloads (default $KFTPU_KERNEL_ATTENTION "
+                        "or einsum); baked into the recipe "
+                        "fingerprint + AOT step key")
+    p.add_argument("--kernel-optimizer", default=None,
+                   choices=list(OPTIMIZER_KERNELS),
+                   help="optimizer kernel tier: fused_adam runs the "
+                        "fused Pallas update (requires --optimizer "
+                        "adam; default $KFTPU_KERNEL_OPTIMIZER or "
+                        "stock)")
+    p.add_argument("--kernel-serving", default=None,
+                   choices=list(SERVING_KERNELS),
+                   help="serving kernel tier recorded for this job "
+                        "(int8 = quantized serving behind the parity "
+                        "gate; default $KFTPU_KERNEL_SERVING or stock)")
     args = p.parse_args(argv)
     workload_kwargs = {}
     if args.workload in _PIPELINED_WORKLOADS:
@@ -1262,7 +1338,10 @@ def main(argv=None) -> int:
         weight_update=args.weight_update,
         aot=args.aot, aot_dir=args.aot_dir,
         multislice_pipeline=args.multislice_pipeline,
-        multislice_microbatches=args.multislice_microbatches)
+        multislice_microbatches=args.multislice_microbatches,
+        kernel_attention=args.kernel_attention,
+        kernel_optimizer=args.kernel_optimizer,
+        kernel_serving=args.kernel_serving)
     log.info("done: %d steps, %.1f examples/sec", result.steps,
              result.examples_per_sec)
     return PREEMPTED_EXIT_CODE if result.preempted else 0

@@ -199,10 +199,16 @@ class MicroBatcher:
         tw_h2d1 = tw_form1 + float(stages.get("h2d_s", 0.0))
         tw_dev1 = tw_h2d1 + device_s
         tw_drain1 = tw_dev1 + float(stages.get("drain_s", 0.0))
+        quant = getattr(self.servable, "quant", None)
         for it in traced:
             it.ctx.note(batch_id=batch_id, bucket=bucket,
                         fill=round(fill, 4),
                         batch_requests=len(items))
+            if quant:
+                # the int8 tier's ledgered accuracy delta rides every
+                # sampled span — the dashboard's serving table shows it
+                # next to the SLO badge (ISSUE 16)
+                it.ctx.note(quant_delta=quant["accuracy_delta"])
             it.ctx.stage("batch-form", tw_form0, tw_form1,
                          batch_id=batch_id, fill=round(fill, 4),
                          pad_rows=pad_rows)

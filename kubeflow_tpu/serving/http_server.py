@@ -513,6 +513,17 @@ def main(argv: Optional[list[str]] = None) -> int:
                    help="skip compiling the padded-bucket executables at "
                         "load (first request per bucket then pays the "
                         "XLA compile)")
+    p.add_argument("--kernel-serving", default=None,
+                   choices=["stock", "int8"],
+                   help="serving kernel tier (spec.kernels.serving): "
+                        "int8 = per-channel absmax quantized weights "
+                        "behind the accuracy parity gate (default "
+                        "$KFTPU_KERNEL_SERVING or stock)")
+    p.add_argument("--int8-max-delta", type=float, default=None,
+                   help="parity-gate threshold for --kernel-serving "
+                        "int8: refuse to serve when the measured "
+                        "argmax-disagreement delta exceeds this "
+                        "(default $KFTPU_INT8_MAX_DELTA or 0.02)")
     p.add_argument("--max-pending", type=int, default=0,
                    help="bounded batcher queue: shed with 429 past this "
                         "many waiting requests (0 = unbounded)")
@@ -545,9 +556,19 @@ def main(argv: Optional[list[str]] = None) -> int:
     enable_compilation_cache()
 
     repo = ModelRepository()
+    # a QuantizationRefused from the int8 parity gate propagates and
+    # kills the server at startup — an operator asking for a quantized
+    # tier past its accuracy budget must see the refusal, not a
+    # silently-float replica
     servable = repo.load(args.model_name, args.model_type,
-                         checkpoint_dir=args.model_path or None)
+                         checkpoint_dir=args.model_path or None,
+                         kernels=args.kernel_serving,
+                         quant_max_delta=args.int8_max_delta)
     servable.max_batch = args.max_batch
+    if servable.quant is not None:
+        print(f"int8 serving: accuracy delta "
+              f"{servable.quant['accuracy_delta']} (gate "
+              f"{servable.quant['max_delta']})", flush=True)
     if not args.no_warmup:
         buckets = servable.warmup()
         print(f"warmed buckets {buckets}", flush=True)

@@ -51,6 +51,207 @@ def next_bucket(n: int, max_batch: int) -> int:
     return min(b, max_batch)
 
 
+# -- int8 quantized serving (ISSUE 16 kernel tier, serving rung) ----------
+#
+# Per-channel absmax weight quantization: every float matrix leaf
+# (ndim >= 2) is stored as int8 with one f32 scale per OUTPUT channel
+# (the last axis — the matmul's N dimension), computed as
+# absmax/127 over the remaining axes. At predict the weights dequantize
+# to f32 inside the jitted program, so every matmul accumulates in f32
+# — XLA fuses the (int8 → f32 · scale) expansion into the matmul
+# prologue; HBM holds 1/4 the weight bytes. Rank-0/1 leaves (biases,
+# norm scales) stay float: they are bytes-irrelevant and
+# precision-critical.
+#
+# The PARITY GATE is the contract that makes the tier shippable: the
+# accuracy delta vs the float model is MEASURED on calibration batches
+# at quantize time, ledgered (metadata + registry gauge — never
+# hidden), and a delta past the configurable threshold REFUSES to
+# serve (QuantizationRefused) rather than silently degrading.
+
+INT8_MAX_DELTA_ENV = "KFTPU_INT8_MAX_DELTA"
+DEFAULT_INT8_MAX_DELTA = 0.02  # ≤2% argmax disagreement by default
+
+_Q_KEY = "__int8_q__"
+_SCALE_KEY = "__int8_scale__"
+
+
+class QuantizationRefused(RuntimeError):
+    """The measured int8 accuracy delta exceeds the parity-gate
+    threshold: the model must keep serving float."""
+
+
+def quantize_params_int8(params: PyTree) -> tuple[PyTree, dict]:
+    """Per-channel absmax int8 quantization of every float leaf with
+    ndim >= 2. Returns (qtree, stats); quantized leaves become
+    ``{_Q_KEY: int8, _SCALE_KEY: f32[..., 1, channels]}`` sub-dicts the
+    pytree machinery carries like any other node."""
+    n_q = n_kept = 0
+    bytes_f = bytes_q = 0
+
+    def q(p):
+        nonlocal n_q, n_kept, bytes_f, bytes_q
+        if getattr(p, "ndim", 0) >= 2 and \
+                jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating):
+            p32 = jnp.asarray(p, jnp.float32)
+            amax = jnp.max(jnp.abs(p32), axis=tuple(range(p32.ndim - 1)),
+                           keepdims=True)
+            scale = jnp.maximum(amax, 1e-12) / 127.0
+            qv = jnp.clip(jnp.round(p32 / scale), -127, 127
+                          ).astype(jnp.int8)
+            n_q += 1
+            bytes_f += p32.size * 4
+            bytes_q += qv.size + scale.size * 4
+            return {_Q_KEY: qv, _SCALE_KEY: scale.astype(jnp.float32)}
+        n_kept += 1
+        sz = int(getattr(p, "size", 0)) * 4
+        bytes_f += sz
+        bytes_q += sz
+        return p
+
+    qtree = jax.tree.map(q, params)
+    return qtree, {"quantized_leaves": n_q, "float_leaves": n_kept,
+                   "weight_bytes_float": bytes_f,
+                   "weight_bytes_int8": bytes_q}
+
+
+def _is_qleaf(node) -> bool:
+    return isinstance(node, dict) and _Q_KEY in node
+
+
+def dequantize_params(qtree: PyTree) -> PyTree:
+    """int8 · per-channel f32 scale → f32 weights; runs INSIDE the
+    jitted predict so XLA fuses it into each matmul's prologue."""
+    return jax.tree.map(
+        lambda n: (n[_Q_KEY].astype(jnp.float32) * n[_SCALE_KEY])
+        if _is_qleaf(n) else n,
+        qtree, is_leaf=_is_qleaf)
+
+
+def _argmax_fields(out) -> Optional[np.ndarray]:
+    """The discrete prediction the accuracy delta is measured on —
+    'classes' (image models) or 'next_token' (LMs); None for models
+    exposing neither (delta falls back to relative logits error)."""
+    if isinstance(out, dict):
+        for k in ("classes", "next_token"):
+            if k in out:
+                return np.asarray(out[k])
+    return None
+
+
+def quantize_servable(
+    servable: "Servable",
+    calibration: Optional[list] = None,
+    *,
+    max_delta: Optional[float] = None,
+    calib_batches: int = 4,
+    calib_batch_size: int = 8,
+    seed: int = 0,
+) -> "Servable":
+    """Build the int8 Servable from a float one, behind the parity gate.
+
+    ``calibration`` is a list of input batches (np arrays); when omitted
+    they are synthesized from the input signature with a fixed seed —
+    deterministic, so the ledgered delta is reproducible. ``max_delta``
+    is the gate threshold (argmax-disagreement fraction); default
+    $KFTPU_INT8_MAX_DELTA or 0.02. Raises QuantizationRefused past the
+    threshold — the caller keeps serving the float model. The measured
+    delta is ledgered either way: Servable.quant, metadata()['quantization'],
+    and the kubeflow_model_quant_accuracy_delta gauge."""
+    if max_delta is None:
+        import os
+        max_delta = float(os.environ.get(INT8_MAX_DELTA_ENV, "")
+                          or DEFAULT_INT8_MAX_DELTA)
+    if calibration is None:
+        sig = servable.input_signature.get("inputs") or {}
+        shape_tail = list(sig.get("shape") or [])[1:]
+        if not shape_tail or any(d is None or d <= 0 for d in shape_tail):
+            raise ValueError(
+                f"model {servable.name!r} declares no synthesizable "
+                f"input shape; pass calibration batches explicitly")
+        dtype = np.dtype(sig.get("dtype", "float32"))
+        rng = np.random.default_rng(seed)
+        if np.issubdtype(dtype, np.integer):
+            # token inputs: the transformer signature has no vocab
+            # bound, keep ids small and valid for any vocab >= 256
+            calibration = [rng.integers(
+                0, 256, size=(calib_batch_size, *shape_tail)).astype(dtype)
+                for _ in range(calib_batches)]
+        else:
+            calibration = [rng.standard_normal(
+                (calib_batch_size, *shape_tail)).astype(dtype)
+                for _ in range(calib_batches)]
+
+    qparams, qstats = quantize_params_int8(servable.params)
+    float_predict = servable.predict_fn
+
+    def predict_int8(qtree, x):
+        return float_predict(dequantize_params(qtree), x)
+
+    quantized = Servable(
+        name=servable.name, predict_fn=predict_int8, params=qparams,
+        version=servable.version,
+        input_signature=servable.input_signature,
+        max_batch=servable.max_batch)
+
+    # -- measure the delta: float vs int8 over the calibration set ------
+    n_total = n_flipped = 0
+    logits_err = 0.0
+    for batch in calibration:
+        out_f = servable.predict(np.asarray(batch))
+        out_q = quantized.predict(np.asarray(batch))
+        af, aq = _argmax_fields(out_f), _argmax_fields(out_q)
+        if af is not None and aq is not None:
+            n_total += af.size
+            n_flipped += int(np.sum(af.reshape(-1) != aq.reshape(-1)))
+        lf = out_f.get("logits") if isinstance(out_f, dict) else out_f
+        lq = out_q.get("logits") if isinstance(out_q, dict) else out_q
+        if lf is not None and lq is not None:
+            lf, lq = np.asarray(lf, np.float64), np.asarray(lq, np.float64)
+            denom = max(float(np.max(np.abs(lf))), 1e-12)
+            logits_err = max(logits_err,
+                             float(np.max(np.abs(lf - lq))) / denom)
+    delta = (n_flipped / n_total) if n_total else logits_err
+
+    quant_info = {
+        "kernel": "int8",
+        "accuracy_delta": round(float(delta), 6),
+        "max_delta": float(max_delta),
+        "logits_rel_err": round(float(logits_err), 6),
+        "calibration_examples": int(
+            sum(np.asarray(b).shape[0] for b in calibration)),
+        **qstats,
+    }
+    # ledgered, never hidden: the gauge and metadata carry the delta
+    # whether the gate passes or refuses
+    quantized.quant = quant_info
+    # the un-wrapped float predict: ModelRepository.reload rebuilds the
+    # quantized servable from a NEW checkpoint version through the same
+    # gate, so it needs the original predict_fn back
+    quantized._float_predict = float_predict
+    quantized.registry.gauge(
+        "kubeflow_model_quant_accuracy_delta",
+        "measured int8-vs-float accuracy delta (argmax disagreement)",
+        labels=("model",)).labels(model=servable.name).set(float(delta))
+    log.info("int8 quantization of %s: delta=%.4f (gate %.4f), "
+             "logits_rel_err=%.5f, weight bytes %d -> %d",
+             servable.name, delta, max_delta, logits_err,
+             qstats["weight_bytes_float"], qstats["weight_bytes_int8"])
+    if delta > max_delta:
+        err = QuantizationRefused(
+            f"int8 accuracy delta {delta:.4f} exceeds the parity gate "
+            f"{max_delta:.4f} for model {servable.name!r}: refusing to "
+            f"serve quantized (measured on "
+            f"{quant_info['calibration_examples']} calibration "
+            f"examples; delta ledgered)")
+        # the measured delta rides the exception so refusal handlers
+        # (bench gate drill, reload keep-old path) can ledger it without
+        # re-parsing the message
+        err.delta = float(delta)
+        raise err
+    return quantized
+
+
 @dataclass
 class Servable:
     """One loaded model version behind a compiled predict."""
@@ -61,6 +262,9 @@ class Servable:
     version: int = 1
     input_signature: dict = field(default_factory=dict)
     max_batch: int = 256
+    # set by quantize_servable: the ledgered quantization record
+    # (kernel, measured accuracy_delta, gate threshold, weight bytes)
+    quant: Optional[dict] = None
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def __post_init__(self):
@@ -197,12 +401,18 @@ class Servable:
     def metadata(self) -> dict:
         """TF-Serving /metadata analog (reference http-proxy
         server.py model-metadata handler)."""
-        return {
+        out = {
             "model_spec": {"name": self.name,
                            "version": str(self.version)},
             "signature_def": self.input_signature,
             "stats": dict(self._stats),
         }
+        if self.quant is not None:
+            # the quantization ledger rides the metadata surface the
+            # dashboard's runs panel reads — the measured delta is
+            # never hidden
+            out["quantization"] = dict(self.quant)
+        return out
 
     def status(self) -> dict:
         return {"model_version_status": [{
@@ -232,11 +442,25 @@ class ModelRepository:
             self._models[servable.name] = servable
 
     def load(self, name: str, model_type: str,
-             checkpoint_dir: Optional[str] = None, **kw) -> Servable:
+             checkpoint_dir: Optional[str] = None,
+             kernels: Optional[str] = None,
+             quant_max_delta: Optional[float] = None, **kw) -> Servable:
+        """Load a servable; ``kernels`` selects the serving rung of the
+        kernel tier (spec.kernels.serving → KFTPU_KERNEL_SERVING):
+        "int8" quantizes behind the parity gate — a QuantizationRefused
+        (delta past ``quant_max_delta``) propagates to the caller, it
+        is NEVER downgraded silently."""
         if model_type not in _MODEL_BUILDERS:
             raise KeyError(
                 f"unknown model type {model_type!r}; "
                 f"registered: {sorted(_MODEL_BUILDERS)}")
+        if kernels is None:
+            import os
+            kernels = os.environ.get("KFTPU_KERNEL_SERVING") or "stock"
+        if kernels not in ("stock", "int8"):
+            raise ValueError(
+                f"kernels.serving {kernels!r} not one of "
+                f"('stock', 'int8')")
         predict_fn, init_params, signature = _MODEL_BUILDERS[model_type](**kw)
         params = init_params()
         version = 1
@@ -255,6 +479,9 @@ class ModelRepository:
             mgr.close()
         servable = Servable(name=name, predict_fn=predict_fn, params=params,
                             version=version, input_signature=signature)
+        if kernels == "int8":
+            servable = quantize_servable(servable,
+                                         max_delta=quant_max_delta)
         self.add(servable)
         if checkpoint_dir:
             with self._lock:
@@ -284,6 +511,29 @@ class ModelRepository:
             params = mgr.restore_params(step)
         finally:
             mgr.close()
+        if servable.quant is not None:
+            # a quantized servable can't swap raw float params in — the
+            # new version re-quantizes through the SAME parity gate; a
+            # refusal keeps the old quantized version serving
+            base = Servable(
+                name=servable.name,
+                predict_fn=servable._float_predict, params=params,
+                version=step, input_signature=servable.input_signature,
+                max_batch=servable.max_batch)
+            try:
+                newq = quantize_servable(
+                    base, max_delta=servable.quant["max_delta"])
+            except QuantizationRefused as e:
+                log.warning(
+                    "model %s version %d refused by the int8 parity "
+                    "gate (%s); keeping version %d", name, step, e,
+                    servable.version)
+                return False
+            self.add(newq)
+            log.info("model %s reloaded to version %d (int8, delta "
+                     "%.4f)", name, step,
+                     newq.quant["accuracy_delta"])
+            return True
         servable.swap(params, step)
         log.info("model %s reloaded to version %d", name, step)
         return True

@@ -332,9 +332,20 @@ def build_dashboard_app(client: KubeClient,
                 rstat = (job.get("status") or {}).get("replicaStatuses", {})
                 active = sum(int(v.get("active", 0))
                              for v in rstat.values() if isinstance(v, dict))
+                # active kernel tier (spec.kernels, ISSUE 16): compact
+                # "attn:flash opt:fused_adam srv:int8" — blank when the
+                # job runs stock everywhere
+                kern = (job.get("spec") or {}).get("kernels") or {}
+                kernels = " ".join(
+                    f"{short}:{kern[key]}"
+                    for short, key in (("attn", "attention"),
+                                       ("opt", "optimizer"),
+                                       ("srv", "serving"))
+                    if kern.get(key))
                 out.append({
                     "kind": kind, "name": k8s.name_of(job), "phase": phase,
                     "progress": f"{active} active" if active else "",
+                    "kernels": kernels,
                     "finishedAt": "",
                 })
         from ..katib.studyjob import STUDYJOB_API_VERSION, STUDYJOB_KIND

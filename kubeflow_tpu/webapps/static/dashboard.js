@@ -469,7 +469,7 @@
       el("h2", { text: `Runs in ${ns}` }), filter,
       visible.length
         ? table(visible, ["kind", "name", "phase", "progress",
-                          "finishedAt", "comm"],
+                          "kernels", "finishedAt", "comm"],
             (col, row, td) => {
               if (col === "phase") {
                 td.appendChild(statusBadge(row.phase));
@@ -786,10 +786,13 @@
       slo: m.slo
         ? `${m.slo.compliant ? "✓" : "✗"} p99<${m.slo.targetP99Ms}ms`
         : "",
+      // int8 kernel tier's ledgered accuracy delta (parity gate) —
+      // shown beside the SLO badge, blank for float-serving models
+      "quant Δ": m.quantDelta != null ? String(m.quantDelta) : "",
     }));
     blocks.push(table(rows, ["model", "role", "requests", "p50 ms",
                              "p99 ms", "p99.9 ms", "goodput", "fill",
-                             "errors", "shed", "slo"]));
+                             "errors", "shed", "slo", "quant Δ"]));
     // where the non-goodput time goes, per primary model (the serving
     // badput categories — one bar row per category with seconds)
     primary.forEach((m) => {

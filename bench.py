@@ -1717,6 +1717,215 @@ def bench_chaos(t_start: float | None = None) -> dict:
     }
 
 
+def bench_sentinel(t_start: float | None = None) -> dict:
+    """Numeric-integrity sentinel drills (runtime/sentinel.py +
+    cluster/chaos.py SentinelSoak):
+
+    1. **Detection latency** per fault kind: an in-process train() with
+       the numeric-fault hook armed must trip within checkEverySteps of
+       the damage surfacing (NaN via the non-finite detector, a finite
+       excursion via the rolling z-score).
+    2. **Rollback drill**: a full SentinelSoak (real operator on
+       FakeCluster) with a NaN injection — the job rolls back to the
+       LKG step (never the newest checkpoint) and the recovered params
+       must match a clean soak of the same seed to ≤1e-5.
+    3. **False-positive soak**: a clean run at the DEFAULT spikeZ over
+       KFTPU_BENCH_SENT_FP_STEPS steps (200; smoke trims) — zero trips.
+    4. **Bisection soak**: BitFlipGrad pinned to one host, firing twice
+       at the same step — the second trip arms replay, the clean replay
+       publishes the verdict span, the host's folded evidence crosses
+       the quarantine threshold, and the goodput ledger names the
+       replayed steps as rollback_recompute while still summing to
+       wall-clock.
+    5. **Overhead**: measured cost of NumericSentinel.observe per step
+       against the drill's mean step time — modeled overhead <1%."""
+    import os
+    import shutil
+    import tempfile
+
+    t_start = time.perf_counter() if t_start is None else t_start
+    import jax
+    import numpy as np
+
+    from kubeflow_tpu.cluster.chaos import (BitFlipGrad, NaNInjector,
+                                            SentinelSoak, final_params)
+    from kubeflow_tpu.obs import goodput as gp
+    from kubeflow_tpu.obs.trace import load_spans
+    from kubeflow_tpu.runtime import sentinel as sent
+    from kubeflow_tpu.runtime.worker import train
+
+    def _injected_train(tmp, injector, steps, **integrity_kw):
+        """In-process train() with the numeric-fault hook armed via its
+        env contract (the integrity knobs go through kwargs)."""
+        env = {}
+        if injector is not None:
+            env = {sent.NUMERIC_FAULT_ENV: injector.spec(),
+                   sent.NUMERIC_FAULT_MARK_ENV:
+                       os.path.join(tmp, "fault.mark"),
+                   sent.NUMERIC_FAULT_FIRES_ENV: str(injector.fires)}
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            return train(
+                workload="transformer", steps=steps, global_batch=8,
+                sync_every=1, checkpoint_dir=None, seed=0,
+                handle_sigterm=False, integrity=True, **integrity_kw)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    check_every = 4
+    # ---- drill 1: detection latency per kind ---------------------------
+    detection = {}
+    with tempfile.TemporaryDirectory() as td:
+        # nan: poison after step 5 completes — damage surfaces in step
+        # 6's metrics; the trip must land within checkEverySteps of that
+        res = _injected_train(td, NaNInjector(at_step=5), steps=16,
+                              integrity_check_every=check_every)
+        surfaced = 5 + 1
+        detection["nan"] = {
+            "kind": (res.anomaly or {}).get("kind"),
+            "trip_step": (res.anomaly or {}).get("step"),
+            "steps_to_detect": res.steps - surfaced,
+            "within_check_every":
+                bool(res.anomaly) and 0 <= res.steps - surfaced
+                < check_every,
+        }
+    with tempfile.TemporaryDirectory() as td:
+        # spike: a finite 8x excursion after the rolling window armed
+        from kubeflow_tpu.cluster.chaos import LossSpikePoisoner
+        res = _injected_train(td, LossSpikePoisoner(at_step=8, scale=8.0),
+                              steps=24, integrity_check_every=check_every,
+                              integrity_window=4, integrity_spike_z=4.0)
+        surfaced = 8 + 1
+        detection["spike"] = {
+            "kind": (res.anomaly or {}).get("kind"),
+            "trip_step": (res.anomaly or {}).get("step"),
+            "steps_to_detect": res.steps - surfaced,
+            "within_check_every":
+                bool(res.anomaly) and 0 <= res.steps - surfaced
+                < check_every,
+        }
+    detected_ok = all(d["within_check_every"] for d in detection.values())
+
+    # ---- drill 2: LKG rollback + parity vs clean -----------------------
+    tmp = tempfile.mkdtemp(prefix="kftpu-sentinel-")
+    try:
+        t0 = time.perf_counter()
+        report = SentinelSoak(workdir=os.path.join(tmp, "injected"),
+                              fault=NaNInjector(at_step=5),
+                              total_steps=10).run()
+        clean = SentinelSoak(workdir=os.path.join(tmp, "clean"),
+                             fault=None, total_steps=10).run()
+        rollback_s = time.perf_counter() - t0
+        max_delta = float("nan")
+        if report["outcome"] == "succeeded" and \
+                clean["outcome"] == "succeeded":
+            injected_params = final_params(report["checkpoint_dir"])
+            clean_params = final_params(clean["checkpoint_dir"])
+            max_delta = max(jax.tree.leaves(jax.tree.map(
+                lambda a, b: float(np.max(np.abs(
+                    np.asarray(a) - np.asarray(b)))),
+                injected_params, clean_params)), default=0.0)
+        rollback = {
+            "outcome": report["outcome"],
+            "clean_outcome": clean["outcome"],
+            "anomalies": report["anomalies"],
+            "rollbacks": report.get("rollbacks"),
+            "final_params_max_abs_delta_vs_clean": max_delta,
+            "params_parity_ok": bool(max_delta <= 1e-5),
+            "soak_wall_s": round(rollback_s, 1),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # ---- drill 3: false-positive soak at default spikeZ ----------------
+    fp_steps = _env_int("KFTPU_BENCH_SENT_FP_STEPS", 200)
+    with tempfile.TemporaryDirectory() as td:
+        t0 = time.perf_counter()
+        res = _injected_train(td, None, steps=fp_steps)
+        fp_wall = time.perf_counter() - t0
+    false_positive = {
+        "steps": fp_steps,
+        "trips": 0 if res.anomaly is None else 1,
+        "anomaly": res.anomaly,
+        "spike_z": sent.DEFAULT_SPIKE_Z,
+    }
+    mean_step_s = fp_wall / max(1, fp_steps)
+
+    # ---- drill 4: pinned bit-flip → bisection + quarantine + ledger ----
+    tmp = tempfile.mkdtemp(prefix="kftpu-sentinel-bisect-")
+    try:
+        t0 = time.perf_counter()
+        suspect = "tpu-pool-v5e-8-1"
+        breport = SentinelSoak(
+            workdir=tmp,
+            fault=BitFlipGrad(at_step=5, node=suspect, scale=1e30,
+                              fires=2),
+            total_steps=10).run()
+        ledger = gp.decompose(load_spans(
+            breport["span_path"], trace_id=breport.get("trace_id")))
+        bisect_s = time.perf_counter() - t0
+        bisection = {
+            "outcome": breport["outcome"],
+            "rollbacks": breport.get("rollbacks"),
+            "verdict_span": breport.get("bisection"),
+            "suspect_quarantined":
+                suspect in breport.get("quarantined", []),
+            "rollback_recompute_s": round(
+                ledger["badputSeconds"][gp.BADPUT_ROLLBACK], 4),
+            "steps_rolled_back": ledger.get("stepsRolledBack"),
+            "ledger_sums_to_wall": gp.categories_sum_ok(ledger),
+            "soak_wall_s": round(bisect_s, 1),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # ---- drill 5: modeled per-step sentinel overhead -------------------
+    probe = sent.NumericSentinel(spike_z=sent.DEFAULT_SPIKE_Z,
+                                 window_steps=sent.DEFAULT_WINDOW_STEPS)
+    n_obs = 10_000
+    t0 = time.perf_counter()
+    for i in range(n_obs):
+        probe.observe(i + 1, loss=4.0 + 0.01 * (i % 7),
+                      grad_norm=1.0)
+    observe_s = (time.perf_counter() - t0) / n_obs
+    overhead_pct = 100.0 * observe_s / max(mean_step_s, 1e-9)
+
+    ok = (detected_ok and rollback["params_parity_ok"]
+          and false_positive["trips"] == 0
+          and bisection["suspect_quarantined"]
+          and bisection["verdict_span"] is not None
+          and bisection["ledger_sums_to_wall"]
+          and overhead_pct < 1.0)
+    return {
+        "metric": "sentinel_drills_passed",
+        "value": 1.0 if ok else 0.0,
+        "unit": "all_sentinel_drills_green",
+        "vs_baseline": None,
+        "mfu": None,
+        "extras": {
+            "detection": detection,
+            "check_every_steps": check_every,
+            "rollback": rollback,
+            "false_positive": false_positive,
+            "bisection": bisection,
+            "overhead": {
+                "observe_us_per_step": round(observe_s * 1e6, 2),
+                "mean_step_ms": round(mean_step_s * 1e3, 2),
+                "modeled_overhead_pct": round(overhead_pct, 4),
+                "under_1pct": bool(overhead_pct < 1.0),
+            },
+            "startup_first_step_s": round(
+                time.perf_counter() - t_start, 2),
+        },
+        "_flops_per_chip": 0.0,
+    }
+
+
 def bench_sched(t_start: float | None = None) -> dict:
     """Gang-scheduler A/B on a seeded contended cluster
     (scheduler/sim.py drives the REAL plan()/inventory code): FIFO vs
@@ -3259,7 +3468,7 @@ def main(argv=None) -> int:
                             "lm-long", "serving", "serving-obs",
                             "serving-fleet", "fused-blocks",
                             "weight-update", "kernels", "chaos",
-                            "ctrl-chaos",
+                            "ctrl-chaos", "sentinel",
                             "input", "sched",
                             "health", "obs", "goodput", "comm",
                             "multislice",
@@ -3329,6 +3538,8 @@ def main(argv=None) -> int:
         row = bench_chaos(t_start=t_start)
     elif args.mode == "ctrl-chaos":
         row = bench_ctrl_chaos(t_start=t_start)
+    elif args.mode == "sentinel":
+        row = bench_sentinel(t_start=t_start)
     elif args.mode == "input":
         row = bench_input(t_start=t_start)
     elif args.mode == "sched":

@@ -422,6 +422,114 @@ class KernelSpec:
 
 
 @dataclass
+class IntegritySpec:
+    """Numeric-integrity sentinel knobs (``spec.integrity``): in-step
+    NaN/Inf and loss-spike detection with last-known-good rollback
+    (ISSUE 17, runtime/sentinel.py). Plumbed the full operator path like
+    InputSpec — parsed here at admission, rendered by
+    controllers/tpujob.py as the env named in each field's metadata,
+    consumed by runtime/worker.py via the CLI flag named there
+    (tests/test_lint.py enforces every layer). ``None`` = unset, worker
+    default (sentinel OFF). Deliberately EXCLUDED from the recipe
+    fingerprint and the AOT step key: the sentinel observes the metrics
+    the worker already fetches and changes no math, so flipping it must
+    never invalidate a cached executable. Defined HERE, jax-free:
+    admission must not import the runtime. docs/operations.md "Numeric
+    integrity"."""
+
+    # master switch: NaN/Inf checks on loss / global grad norm, the
+    # rolling z-score spike detector, and the cross-replica agreement
+    # check (ZeRO-2 path) ride the worker's window drain
+    enabled: Optional[bool] = field(default=None, metadata={
+        "spec_field": "enabled", "env": "KFTPU_INTEGRITY",
+        "cli": "--integrity"})
+    # one-sided z-score threshold for the loss-spike detector (EWMA
+    # mean/variance); default 8 — the false-positive budget is zero
+    spike_z: Optional[float] = field(default=None, metadata={
+        "spec_field": "spikeZ", "env": "KFTPU_INTEGRITY_SPIKE_Z",
+        "cli": "--integrity-spike-z"})
+    # EWMA window (steps) the spike baseline averages over; the detector
+    # arms only after the window has filled
+    window_steps: Optional[int] = field(default=None, metadata={
+        "spec_field": "windowSteps", "env": "KFTPU_INTEGRITY_WINDOW",
+        "cli": "--integrity-window"})
+    # detection cadence: the worker closes a metrics window at least
+    # every this many steps so a trip is caught within the bound
+    check_every_steps: Optional[int] = field(default=None, metadata={
+        "spec_field": "checkEverySteps",
+        "env": "KFTPU_INTEGRITY_CHECK_EVERY",
+        "cli": "--integrity-check-every"})
+
+    @property
+    def is_enabled(self) -> bool:
+        return bool(self.enabled)
+
+    def validate(self) -> None:
+        if self.enabled is not None and \
+                not isinstance(self.enabled, bool):
+            raise ValueError(
+                f"integrity.enabled must be a boolean, got "
+                f"{self.enabled!r}")
+        z = self.spike_z
+        if z is not None and (isinstance(z, bool) or
+                              not isinstance(z, (int, float)) or z <= 0):
+            raise ValueError(
+                f"integrity.spikeZ must be a positive number, got {z!r}")
+        for name, v, lo in (("windowSteps", self.window_steps, 2),
+                            ("checkEverySteps",
+                             self.check_every_steps, 1)):
+            if v is not None and (not isinstance(v, int) or
+                                  isinstance(v, bool) or v < lo):
+                raise ValueError(
+                    f"integrity.{name} must be an integer >= {lo}, "
+                    f"got {v!r}")
+        if not self.enabled and (z is not None or
+                                 self.window_steps is not None or
+                                 self.check_every_steps is not None):
+            # only the sentinel consumes the tuning knobs — accepting
+            # them without enabled: true would be a silent no-op the
+            # user mistakes for armed detection (the
+            # multislice.microbatches-without-pipeline rule)
+            raise ValueError(
+                "integrity.spikeZ/windowSteps/checkEverySteps require "
+                "integrity.enabled: true (only the sentinel consumes "
+                "them)")
+
+    def to_dict(self) -> dict:
+        return {f.metadata["spec_field"]: getattr(self, f.name)
+                for f in fields(self) if getattr(self, f.name) is not None}
+
+    def to_env(self) -> dict[str, str]:
+        """The controller-rendered worker env for every SET knob
+        (booleans render "1"/"0" — the worker's _env_int contract)."""
+        out = {}
+        for f in fields(self):
+            v = getattr(self, f.name)
+            if v is None:
+                continue
+            out[f.metadata["env"]] = ("1" if v else "0") \
+                if isinstance(v, bool) else str(v)
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "IntegritySpec":
+        if d is not None and not isinstance(d, dict):
+            raise ValueError(
+                f"spec.integrity must be a mapping of integrity-sentinel "
+                f"knobs, got {type(d).__name__}: {d!r}")
+        d = dict(d or {})
+        by_spec = {f.metadata["spec_field"]: f.name for f in fields(cls)}
+        unknown = set(d) - set(by_spec)
+        if unknown:
+            raise ValueError(
+                f"unknown integrity knobs {sorted(unknown)}; "
+                f"valid: {sorted(by_spec)}")
+        spec = cls(**{by_spec[k]: v for k, v in d.items()})
+        spec.validate()
+        return spec
+
+
+@dataclass
 class SchedulingPolicy:
     """Gang-scheduling knobs (``spec.schedulingPolicy``): how the slice
     scheduler (kubeflow_tpu/scheduler/) queues, places, and — when
@@ -674,7 +782,36 @@ RESTART_POLICY_GANG = "GangOnFailure"
 # a gang whose CHIEF heartbeat is staler than runPolicy.stallTimeoutSeconds.
 # Defined here, not in runtime/: the controller layer must stay importable
 # without pulling jax into the operator process.
+# The heartbeat payload MAY also carry "lastLoss"/"lastGradNorm" (the
+# last drained window's host floats, stringified so NaN/Inf survive
+# strict-JSON consumers): the operator flags a NaN-emitting worker even
+# when that worker's own sentinel is disabled — after the same
+# freshness clamp the stall watchdog applies (a future-stamped beat
+# must not be trusted).
 HEARTBEAT_ANNOTATION = "kubeflow.org/worker-heartbeat"
+
+# Numeric-integrity anomaly contract (ISSUE 17; runtime/sentinel.py is
+# the worker side, controllers/tpujob.py the operator side):
+#
+# - ANOMALY_ANNOTATION (on Pods): a worker whose sentinel trips patches
+#   its own pod with the AnomalyEvidence JSON {"kind", "step", "value",
+#   "lkg", "detail"} BEFORE exiting ANOMALY_EXIT_CODE. The operator's
+#   failed-pod branch reads it to route the gang failure down the
+#   rollback path instead of the plain restart path.
+# - ANOMALY_COUNT_ANNOTATION (on TPUJobs): rollbacks consumed so far;
+#   compared against runPolicy.maxAnomalyRollbacks — exhausted → the
+#   job Fails with the evidence in the condition.
+# - ANOMALY_ROLLBACK_ANNOTATION (on TPUJobs): the ACTIVE rollback, JSON
+#   {"lkgStep", "tripStep", "kind", "count", "replay"?}. The controller
+#   renders it into the recreated gang as KFTPU_RESUME_STEP (restore
+#   the newest intact step <= LKG, not newest overall) and — on the
+#   second trip at the same LKG, when "replay" is set — as
+#   KFTPU_REPLAY_RANGE (replay bisection over the suspect steps with
+#   the suspect host evacuated). Cleared once the chief's heartbeat
+#   advances past the trip step.
+ANOMALY_ANNOTATION = "kubeflow.org/numeric-anomaly"
+ANOMALY_COUNT_ANNOTATION = "kubeflow.org/anomaly-rollback-count"
+ANOMALY_ROLLBACK_ANNOTATION = "kubeflow.org/anomaly-rollback"
 
 
 @dataclass
@@ -732,6 +869,13 @@ class RunPolicy:
     # workers (wedged collective, dead TPU runtime with a live pod) never
     # produce a Failed phase on their own. None = watchdog off.
     stall_timeout_seconds: Optional[int] = None
+    # Anomaly budget: last-known-good rollbacks (a worker exiting
+    # ANOMALY_EXIT_CODE with evidence in ANOMALY_ANNOTATION) before the
+    # job Fails with the evidence in the condition. Separate from
+    # backoffLimit — a rollback is a recovery, not a crash — and
+    # tracked in ANOMALY_COUNT_ANNOTATION. docs/operations.md "Numeric
+    # integrity".
+    max_anomaly_rollbacks: int = 2
 
     def to_dict(self) -> dict:
         d: dict[str, Any] = {
@@ -748,6 +892,8 @@ class RunPolicy:
             d["restartBackoffMaxSeconds"] = self.restart_backoff_max_seconds
         if self.stall_timeout_seconds is not None:
             d["stallTimeoutSeconds"] = self.stall_timeout_seconds
+        if self.max_anomaly_rollbacks != 2:
+            d["maxAnomalyRollbacks"] = self.max_anomaly_rollbacks
         return d
 
 
@@ -926,6 +1072,11 @@ class TrainingJob:
     # serving (docs/training.md "Kernel tier"); every set knob is baked
     # into the recipe fingerprint and AOT step key
     kernels: KernelSpec = field(default_factory=KernelSpec)
+    # numeric-integrity sentinel knobs (spec.integrity →
+    # KFTPU_INTEGRITY_*): in-step anomaly detectors + LKG rollback
+    # (docs/operations.md "Numeric integrity"); deliberately EXCLUDED
+    # from the recipe fingerprint — the sentinel changes no math
+    integrity: IntegritySpec = field(default_factory=IntegritySpec)
     # gang-scheduling knobs (spec.schedulingPolicy → the slice
     # scheduler's queue/priority/preemptible; None = not
     # scheduler-managed, the legacy immediate-create path)
@@ -988,6 +1139,7 @@ class TrainingJob:
                 restart_backoff_max_seconds=float(
                     rp.get("restartBackoffMaxSeconds", 300.0)),
                 stall_timeout_seconds=rp.get("stallTimeoutSeconds"),
+                max_anomaly_rollbacks=int(rp.get("maxAnomalyRollbacks", 2)),
             ),
             sharding=ShardingSpec.from_dict(spec.get("sharding")),
             checkpoint_dir=spec.get("checkpointDir", "") or "",
@@ -1001,6 +1153,7 @@ class TrainingJob:
             warm_start=WarmStartSpec.from_dict(spec.get("warmStart")),
             multislice=MultisliceSpec.from_dict(spec.get("multislice")),
             kernels=KernelSpec.from_dict(spec.get("kernels")),
+            integrity=IntegritySpec.from_dict(spec.get("integrity")),
             scheduling_policy=SchedulingPolicy.from_dict(
                 spec.get("schedulingPolicy")),
             weight_update=spec.get("weightUpdate", "") or "",
@@ -1044,6 +1197,7 @@ class TrainingJob:
         self.warm_start.validate()
         self.multislice.validate()
         self.kernels.validate()
+        self.integrity.validate()
         if self.scheduling_policy is not None:
             self.scheduling_policy.validate()
         vocab = REPLICA_TYPES[self.kind]
@@ -1190,6 +1344,8 @@ class TrainingJob:
             out["spec"]["multislice"] = self.multislice.to_dict()
         if self.kernels.to_dict():
             out["spec"]["kernels"] = self.kernels.to_dict()
+        if self.integrity.to_dict():
+            out["spec"]["integrity"] = self.integrity.to_dict()
         if self.scheduling_policy is not None:
             out["spec"]["schedulingPolicy"] = self.scheduling_policy.to_dict()
         if self.weight_update:

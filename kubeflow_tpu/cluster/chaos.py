@@ -823,6 +823,320 @@ def final_params(checkpoint_dir: str):
         mgr.close()
 
 
+# ------------------------------------------------- numeric-fault injectors
+# The sentinel tier's fault menu (runtime/sentinel.py NumericFaultHook):
+# each injector renders the KFTPU_CHAOS_NUMERIC env contract the worker's
+# hook consumes — the poison happens INSIDE the training loop (after the
+# named step completes, so the damage surfaces in the NEXT window's
+# metrics), not between segments like SoakFault. jax-free here; the hook
+# imports jax lazily in-process.
+
+
+@dataclass
+class NaNInjector:
+    """Multiply the params by NaN once step ``at_step`` completes — the
+    hard-failure SDC: every downstream loss/grad is NaN, the sentinel's
+    non-finite detector must trip within checkEverySteps."""
+
+    at_step: int
+    fires: int = 1
+    node: Optional[str] = None
+    kind = "nan"
+
+    def spec(self) -> str:
+        return f"nan:{self.at_step}"
+
+
+@dataclass
+class LossSpikePoisoner:
+    """Scale the params by ``scale`` once ``at_step`` completes — a
+    finite-but-wrong excursion only the rolling z-score detector sees
+    (everything stays representable; nothing is NaN)."""
+
+    at_step: int
+    scale: float = 8.0
+    fires: int = 1
+    node: Optional[str] = None
+    kind = "spike"
+
+    def spec(self) -> str:
+        return f"spike:{self.at_step}:{self.scale}"
+
+
+@dataclass
+class BitFlipGrad:
+    """A silent bit-flip pinned to one host: a small multiplicative
+    perturbation (exponent-bit flavor) fired ``fires`` times at the same
+    step — the repeat-offender shape replay bisection exists for. The
+    ``node`` pin names the host whose pod carries the evidence, so two
+    trips fold two numeric-anomaly events onto it and its health score
+    crosses the quarantine threshold."""
+
+    at_step: int
+    node: Optional[str] = None
+    scale: float = 1.25
+    fires: int = 2
+    kind = "bitflip"
+
+    def spec(self) -> str:
+        return f"bitflip:{self.at_step}:{self.scale}"
+
+
+@dataclass
+class SentinelSoak:
+    """Drive one TPUJob through a numeric-corruption episode, end to end:
+    in-step detection → deliberate anomaly exit → operator LKG rollback
+    (resumeFrom pinned to the last-known-good step, NOT the newest
+    checkpoint) → clean re-run to completion; with a repeat-firing fault
+    (BitFlipGrad), the second trip over the same LKG arms replay
+    bisection and the third, clean segment publishes the verdict span.
+
+    Same architecture as ChaosSoak (real control plane on FakeCluster,
+    real in-process training segments using the env the operator rendered
+    into the chief pod), with two twists: the fault fires INSIDE the
+    worker via the KFTPU_CHAOS_NUMERIC hook (a fire-count marker file
+    keeps it from re-firing forever across rollback segments), and on a
+    trip the soak plays the pod's part — it annotates the victim pod with
+    the evidence the real worker would have self-annotated and fails it,
+    which is exactly what the operator's anomaly path watches for.
+
+    ``corrupt_lkg=True`` additionally truncates the LKG step's payload at
+    trip time: the rollback restore must then walk back to the
+    next-oldest INTACT step (verify-then-fallback) and still converge.
+    """
+
+    workdir: str
+    fault: Optional[object] = None     # one numeric injector (None = clean)
+    total_steps: int = 10
+    checkpoint_every: int = 2
+    check_every: int = 1
+    window_steps: int = 4
+    spike_z: float = 4.0
+    max_rollbacks: int = 3
+    corrupt_lkg: bool = False
+    seed: int = 0
+    global_batch: int = 8
+    wall_budget_s: float = 300.0
+    namespace: str = "kubeflow"
+    job_name: str = "sentinel-soak"
+
+    def _manifest(self, ckpt_dir: str, span_path: str) -> dict:
+        return {
+            "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": self.job_name,
+                         "namespace": self.namespace},
+            "spec": {
+                "checkpointDir": ckpt_dir,
+                "observability": {"spanPath": span_path},
+                "integrity": {"enabled": True,
+                              "spikeZ": self.spike_z,
+                              "windowSteps": self.window_steps,
+                              "checkEverySteps": self.check_every},
+                "replicaSpecs": {"TPU": {
+                    "tpuTopology": "v5e-8",
+                    "template": {"spec": {"containers": [
+                        {"name": "jax", "image": "trainer:v1"}]}}}},
+                "runPolicy": {
+                    "backoffLimit": 3,
+                    "maxAnomalyRollbacks": self.max_rollbacks,
+                    "restartBackoffSeconds": 0.02,
+                    "restartBackoffMaxSeconds": 0.2,
+                },
+            },
+        }
+
+    def _chief_env(self, cluster, chief: str) -> dict:
+        pod = cluster.get("v1", "Pod", self.namespace, chief)
+        return {e["name"]: e.get("value", "")
+                for e in pod["spec"]["containers"][0].get("env", [])}
+
+    # env the worker reads from os.environ (not train() kwargs): the
+    # sentinel knobs the operator rendered into the pod, the rollback
+    # directive, and the in-loop fault hook
+    _PASS_ENV = ("KFTPU_INTEGRITY", "KFTPU_INTEGRITY_SPIKE_Z",
+                 "KFTPU_INTEGRITY_WINDOW", "KFTPU_INTEGRITY_CHECK_EVERY",
+                 "KFTPU_RESUME_STEP", "KFTPU_REPLAY_RANGE")
+
+    def _run_segment(self, env_map: dict, target: int, mark_path: str):
+        from ..obs.trace import adopt_trace_env
+        from ..runtime import sentinel as sent
+        from ..runtime.worker import train  # lazy: pulls in jax
+        patched = {k: env_map.get(k) for k in self._PASS_ENV}
+        if self.fault is not None:
+            patched[sent.NUMERIC_FAULT_ENV] = self.fault.spec()
+            patched[sent.NUMERIC_FAULT_MARK_ENV] = mark_path
+            patched[sent.NUMERIC_FAULT_FIRES_ENV] = str(self.fault.fires)
+        saved = {k: os.environ.get(k) for k in patched}
+        for k, v in patched.items():
+            if v:
+                os.environ[k] = v
+            else:
+                os.environ.pop(k, None)
+        try:
+            with adopt_trace_env(env_map):
+                return train(
+                    workload="transformer", steps=target,
+                    global_batch=self.global_batch, sync_every=1,
+                    checkpoint_dir=env_map.get("KFTPU_CHECKPOINT_DIR"),
+                    checkpoint_every=self.checkpoint_every,
+                    resume_from=env_map.get("KFTPU_RESUME_FROM"),
+                    seed=self.seed, handle_sigterm=False,
+                    workload_kwargs={})
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def _heartbeat(self, cluster, chief: str, step: int) -> None:
+        import json as _json
+        from ..api.trainingjob import HEARTBEAT_ANNOTATION
+        payload = _json.dumps({"step": step, "time": time.time()})
+        cluster.patch("v1", "Pod", self.namespace, chief,
+                      {"metadata": {"annotations":
+                                    {HEARTBEAT_ANNOTATION: payload}}})
+
+    def _victim(self, cluster, chief: str) -> str:
+        """The pod that carries the evidence: the one on the fault's
+        pinned node when there is a pin, else the chief."""
+        node = getattr(self.fault, "node", None)
+        if node:
+            for p in cluster.list("v1", "Pod", self.namespace):
+                if p.get("spec", {}).get("nodeName") == node:
+                    return k8s.name_of(p)
+        return chief
+
+    def run(self) -> dict:
+        import json as _json
+
+        from ..controllers.runtime import Manager
+        from ..controllers.tpujob import (RESTART_COUNT_ANNOTATION,
+                                          TrainingJobReconciler)
+        from ..api.trainingjob import (ANOMALY_ANNOTATION,
+                                       ANOMALY_COUNT_ANNOTATION)
+        from ..scheduler import health
+        from .fake import FakeCluster
+
+        ckpt_dir = os.path.join(self.workdir, "ckpt")
+        span_path = os.path.join(self.workdir, "spans.jsonl")
+        mark_path = os.path.join(self.workdir, "numeric-fault.mark")
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        chaos = ChaosKubeClient(cluster)
+        mgr = Manager(chaos)
+        ctrl = mgr.add(TrainingJobReconciler("TPUJob"))
+        ctrl.resync_interval = 0.02
+        cluster.create(self._manifest(ckpt_dir, span_path))
+
+        report: dict = {"anomalies": [], "restart_reasons": [],
+                        "segments": 0, "executed_steps": 0,
+                        "outcome": "timeout", "lkg_corrupted": False}
+        deadline = time.monotonic() + self.wall_budget_s
+        chief = f"{self.job_name}-worker-0-0"
+        reached = 0
+        while time.monotonic() < deadline:
+            mgr.run_pending()
+            cluster.tick()
+            mgr.run_pending()
+            job = cluster.get_or_none("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                                      self.namespace, self.job_name)
+            if job is None:
+                report["outcome"] = "deleted"
+                break
+            cond = k8s.get_condition(job, "Restarting")
+            if cond is not None and cond.get("status") == "True" and \
+                    cond.get("reason") not in report["restart_reasons"]:
+                report["restart_reasons"].append(cond.get("reason"))
+            if k8s.condition_true(job, "Succeeded"):
+                report["outcome"] = "succeeded"
+                break
+            if k8s.condition_true(job, "Failed"):
+                report["outcome"] = "failed"
+                report["failed_reason"] = k8s.get_condition(
+                    job, "Failed").get("reason")
+                break
+            pods = cluster.list("v1", "Pod", self.namespace)
+            running = [p for p in pods
+                       if p.get("status", {}).get("phase") == "Running"]
+            if len(running) != 2 or k8s.condition_true(job, "Restarting"):
+                time.sleep(0.03)
+                continue
+            env_map = self._chief_env(cluster, chief)
+            result = self._run_segment(env_map, self.total_steps,
+                                       mark_path)
+            report["segments"] += 1
+            report["executed_steps"] += int(result.steps)
+            if result.anomaly:
+                # play the failed pod's part: the in-process worker
+                # can't self-annotate (no apiserver env), so the soak
+                # attaches the evidence and fails the victim — the
+                # operator's anomaly path takes it from here
+                report["anomalies"].append(dict(result.anomaly))
+                if self.corrupt_lkg and not report["lkg_corrupted"]:
+                    lkg = result.anomaly.get("lkg")
+                    step_dir = (os.path.join(ckpt_dir, str(int(lkg)))
+                                if lkg else None)
+                    if step_dir and os.path.isdir(step_dir):
+                        truncate_checkpoint_payload(step_dir)
+                        report["lkg_corrupted"] = True
+                victim = self._victim(cluster, chief)
+                cluster.patch(
+                    "v1", "Pod", self.namespace, victim,
+                    {"metadata": {"annotations": {
+                        ANOMALY_ANNOTATION:
+                            _json.dumps(result.anomaly)}}})
+                cluster.fail_pod(self.namespace, victim,
+                                 f"sentinel: {result.anomaly['kind']}")
+                continue
+            reached = self.total_steps
+            self._heartbeat(cluster, chief, self.total_steps)
+            if reached >= self.total_steps:
+                cluster.set_pod_phase(self.namespace, chief, "Succeeded")
+        job = cluster.get_or_none("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                                  self.namespace, self.job_name)
+        if job is not None:
+            anns = k8s.annotations_of(job)
+            report["gang_restarts"] = int(anns.get(
+                RESTART_COUNT_ANNOTATION, "0"))
+            report["rollbacks"] = int(anns.get(
+                ANOMALY_COUNT_ANNOTATION, "0"))
+            from ..obs.trace import TRACE_ID_ANNOTATION
+            report["trace_id"] = anns.get(TRACE_ID_ANNOTATION, "")
+        # bisection verdict: the worker's clean replay over the armed
+        # range publishes an anomaly-bisection span — the evidence that
+        # converts "this job is cursed" into a per-host verdict
+        report["bisection"] = None
+        try:
+            with open(span_path, encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        span = _json.loads(line)
+                    except ValueError:
+                        continue
+                    if span.get("name") == "anomaly-bisection":
+                        report["bisection"] = span.get("attrs", span)
+        except OSError:
+            pass
+        # hosts whose folded numeric-anomaly evidence crossed the
+        # quarantine threshold (the scheduler's health sweep in
+        # scheduler/core.py writes the actual quarantine annotation;
+        # the score IS the criterion)
+        cfg = health.HealthConfig()
+        report["quarantined"] = sorted(
+            k8s.name_of(n) for n in cluster.list("v1", "Node", "")
+            if health.is_quarantined(n)
+            or health.decayed_score(n) >= cfg.quarantine_threshold)
+        report["final_step"] = reached
+        report["checkpoint_dir"] = ckpt_dir
+        report["span_path"] = span_path
+        report["api_calls"] = chaos.calls
+        report["api_faults"] = len(chaos.injected)
+        for c in mgr.controllers:
+            c.stop()
+        return report
+
+
 # ------------------------------------------------- serving-plane faults
 # The serving resilience tier's fault menu (ISSUE 12): the failure
 # classes one replica of a fleet WILL have, injectable against a real

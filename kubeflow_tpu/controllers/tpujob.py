@@ -34,13 +34,16 @@ from __future__ import annotations
 import copy
 import json
 import logging
+import math
 import os
 import random
 import time
 
 from ..api import k8s
 from ..api.topology import TopologyContract, render_contracts
-from ..api.trainingjob import (API_VERSIONS,
+from ..api.trainingjob import (ANOMALY_ANNOTATION,
+                               ANOMALY_COUNT_ANNOTATION,
+                               ANOMALY_ROLLBACK_ANNOTATION, API_VERSIONS,
                                COND_CREATED, COND_FAILED, COND_QUEUED,
                                COND_RESTARTING, COND_RUNNING, COND_SUCCEEDED,
                                CLEAN_POD_ALL, CLEAN_POD_NONE,
@@ -124,6 +127,10 @@ class TrainingJobReconciler(Reconciler):
         # consecutive reconciles a worker trailed the chief's step by
         # >= health.STEP_SKEW_MIN_STEPS: (ns, job, pod) -> streak
         self._skew_streak: dict[tuple, int] = {}
+        # heartbeat numeric-canary dedup: (ns, pod) -> last heartbeat
+        # step already flagged for a non-finite lastLoss/lastGradNorm —
+        # one health event per bad step, not one per reconcile tick
+        self._numeric_flagged: dict[tuple, int] = {}
 
     # ------------------------------------------------------------ reconcile
 
@@ -278,6 +285,17 @@ class TrainingJobReconciler(Reconciler):
 
         failed = [n for n, ph in phases.items() if ph == POD_FAILED]
         if failed:
+            # a failed pod carrying the sentinel's anomaly-evidence
+            # annotation is NOT a crash: the worker tripped a numeric
+            # detector and exited deliberately so the control plane can
+            # roll the job back to its last-known-good checkpoint — a
+            # separate budget, a rollback (not a plain restart), and SDC
+            # evidence folded onto the suspect host
+            evidence_pod, anomaly = self._anomaly_of(by_name, failed)
+            if anomaly is not None:
+                return self._handle_anomaly(
+                    client, job, manifest, pods, failed, anomaly,
+                    suspect=self._suspect_node(by_name, [evidence_pod]))
             return self._handle_gang_failure(
                 client, job, manifest, pods, failed,
                 suspect=self._suspect_node(by_name, failed),
@@ -312,6 +330,16 @@ class TrainingJobReconciler(Reconciler):
         # heartbeat steps feeds the host health score
         if tpu_names:
             self._note_step_skew(job, by_name, tpu_names, chief, client)
+            # numeric canary off the same heartbeats: a worker reporting
+            # a non-finite lastLoss/lastGradNorm is flagged (host health
+            # event + metric) even when the in-step sentinel is disabled
+            self._note_numeric_health(job, by_name, tpu_names, client)
+        # the rollback directive is consumed once the recreated gang
+        # provably trained PAST the trip step: clear it so the NEXT
+        # restart (whatever its cause) resumes from the newest
+        # checkpoint again instead of the stale LKG pin
+        self._clear_rollback_annotation(client, job, manifest, by_name,
+                                        chief)
 
         running = sum(1 for ph in phases.values() if ph == POD_RUNNING)
         self._finalize_status(client, manifest, pods,
@@ -372,6 +400,9 @@ class TrainingJobReconciler(Reconciler):
         prefix = f"{name}-"
         self._future_beats = {
             k: v for k, v in self._future_beats.items()
+            if not (k[0] == namespace and k[1].startswith(prefix))}
+        self._numeric_flagged = {
+            k: v for k, v in self._numeric_flagged.items()
             if not (k[0] == namespace and k[1].startswith(prefix))}
         self._skew_streak = {
             k: v for k, v in self._skew_streak.items()
@@ -690,6 +721,33 @@ class TrainingJobReconciler(Reconciler):
         # serving) — runtime/worker.py consumes them and bakes every set
         # knob into the recipe fingerprint + AOT step key
         env.update(job.kernels.to_env())
+        # spec.integrity → KFTPU_INTEGRITY*: the numeric sentinel knobs
+        # (runtime/sentinel.py). Deliberately EXCLUDED from the recipe
+        # fingerprint — toggling detection must not invalidate warm
+        # compile caches or AOT executables (the probe's program shape
+        # is layout-gated, not integrity-gated).
+        env.update(job.integrity.to_env())
+        # anomaly-rollback directive → KFTPU_RESUME_STEP (pin the
+        # restore to the LKG step, NOT the newest checkpoint — newest
+        # may carry the corruption) and, when the operator armed
+        # bisection on a repeat trip, KFTPU_REPLAY_RANGE (the worker
+        # re-runs the suspect steps deterministically and publishes a
+        # clean/reproduced verdict span)
+        rollback = k8s.annotations_of(manifest).get(
+            ANOMALY_ROLLBACK_ANNOTATION)
+        if rollback:
+            from ..runtime.sentinel import (REPLAY_RANGE_ENV,
+                                            RESUME_STEP_ENV)
+            try:
+                directive = json.loads(rollback)
+                lkg_step = int(directive.get("lkgStep", 0))
+                replay_range = directive.get("replay")
+            except (AttributeError, TypeError, ValueError):
+                lkg_step, replay_range = 0, None
+            if lkg_step > 0:
+                env[RESUME_STEP_ENV] = str(lkg_step)
+            if replay_range:
+                env[REPLAY_RANGE_ENV] = str(replay_range)
         from ..runtime.compile_cache import (COMPILE_CACHE_ENV,
                                              SHARED_CACHE_ROOT_ENV,
                                              default_cache_dir,
@@ -1071,6 +1129,220 @@ class TrainingJobReconciler(Reconciler):
         nodes.discard(None)
         nodes.discard("")
         return nodes.pop() if len(nodes) == 1 else None
+
+    # ------------------------------------------------- numeric integrity
+
+    @staticmethod
+    def _anomaly_of(by_name: dict[str, dict], failed: list[str]):
+        """(pod_name, AnomalyEvidence) from the first failed pod carrying
+        a parseable sentinel evidence annotation, else (None, None).
+        Evidence is on the POD (the worker annotates itself before
+        exiting) so it survives the worker process and arrives with the
+        same Failed phase the reconcile loop already watches."""
+        from ..runtime.sentinel import AnomalyEvidence
+        for name in failed:
+            pod = by_name.get(name)
+            if pod is None:
+                continue
+            raw = k8s.annotations_of(pod).get(ANOMALY_ANNOTATION)
+            if not raw:
+                continue
+            ev = AnomalyEvidence.from_json(raw)
+            if ev is not None:
+                return name, ev
+        return None, None
+
+    def _handle_anomaly(self, client: KubeClient, job: TrainingJob,
+                        manifest: dict, pods: list[dict],
+                        failed: list[str], anomaly,
+                        suspect: str | None = None) -> Result:
+        """The LKG rollback path. A sentinel trip is a DELIBERATE exit,
+        not a crash: the rollback budget (runPolicy.maxAnomalyRollbacks)
+        is separate from backoffLimit and the gang restart does not
+        count against it. The rollback directive annotation pins the
+        recreated gang's restore to the last-known-good step (not the
+        newest checkpoint, which may carry the corruption), and a SECOND
+        trip over the same LKG arms the replay-bisection window — the
+        worker re-runs the suspect step range deterministically with the
+        suspect host's health event already folded, converting "this job
+        is cursed" into "host N is bad"."""
+        anns = k8s.annotations_of(manifest)
+        count = int(anns.get(ANOMALY_COUNT_ANNOTATION, "0"))
+        budget = job.run_policy.max_anomaly_rollbacks
+        summary = (f"{anomaly.kind} at step {anomaly.step} "
+                   f"(lkg {anomaly.lkg})")
+        if count >= budget:
+            self._set_condition(
+                client, manifest, COND_FAILED, "True",
+                "AnomalyBudgetExceeded",
+                f"numeric anomaly {summary}; rolled back {count} times "
+                f"(runPolicy.maxAnomalyRollbacks={budget})")
+            self._cleanup_pods(client, job, pods)
+            return Result()
+        # replay bisection arms on the SECOND trip against the same LKG:
+        # same range re-failing means the fault reproduces — re-run it
+        # deterministically and let the verdict blame (or clear) the host
+        prev_lkg = None
+        try:
+            prev = json.loads(anns.get(ANOMALY_ROLLBACK_ANNOTATION) or "")
+            prev_lkg = int(prev.get("lkgStep"))
+        except (AttributeError, TypeError, ValueError):
+            prev_lkg = None
+        lkg = int(anomaly.lkg or 0)
+        replay = (f"{lkg}:{int(anomaly.step)}"
+                  if prev_lkg is not None and prev_lkg == lkg
+                  and int(anomaly.step) > lkg else None)
+        for p in pods:
+            try:
+                client.delete("v1", "Pod", k8s.namespace_of(p, job.namespace),
+                              k8s.name_of(p))
+            except NotFoundError:
+                pass
+        applied = {"count": count}
+
+        def _mutate(obj: dict) -> dict | None:
+            fresh = int(k8s.annotations_of(obj).get(
+                ANOMALY_COUNT_ANNOTATION, "0"))
+            applied["count"] = fresh
+            directive: dict = {"lkgStep": lkg,
+                               "tripStep": int(anomaly.step),
+                               "kind": anomaly.kind,
+                               "count": fresh + 1}
+            if replay:
+                directive["replay"] = replay
+            updates = {ANOMALY_COUNT_ANNOTATION: str(fresh + 1),
+                       ANOMALY_ROLLBACK_ANNOTATION: json.dumps(directive)}
+            if suspect and job.scheduling_policy is not None:
+                # same failure-domain contract as crash restarts: the
+                # scheduler replans the rebind excluding the SDC suspect
+                updates[SUSPECT_ANNOTATION] = suspect
+            apply_annotations(obj, updates)
+            if job.checkpoint_dir and \
+                    not obj.setdefault("spec", {}).get("resumeFrom"):
+                obj["spec"]["resumeFrom"] = job.checkpoint_dir
+            return obj
+
+        try:
+            patched = update_with_conflict_retry(
+                client, *k8s.key_of(manifest), _mutate)
+        except NotFoundError:
+            return Result()
+        if suspect:
+            # SDC evidence onto the host the anomalous worker ran on:
+            # two trips cross health's quarantine threshold, so a
+            # repeat-offender host drains out of the placement pool
+            health.record_host_event(
+                client, suspect, health.EVENT_NUMERIC_ANOMALY,
+                job_key=f"{job.namespace}/{job.name}", now=_now())
+        obsreg.counter(
+            "kftpu_gang_restarts_total",
+            "whole-gang restarts by trigger (failed pod, vanish, resize, "
+            "stall)", labels=("kind", "reason")).labels(
+                kind=self.kind, reason="NumericAnomaly").inc()
+        used = applied["count"] + 1
+        mode = f", replaying {replay} for bisection" if replay else ""
+        self._trace_event(patched, "anomaly-rollback", kind=anomaly.kind,
+                          step=int(anomaly.step), lkg=lkg, count=used,
+                          **({"replay": replay} if replay else {}),
+                          **({"suspect": suspect} if suspect else {}))
+        self._set_condition(
+            client, patched, COND_RESTARTING, "True", "NumericAnomaly",
+            f"{summary}: rolling back to LKG step {lkg} "
+            f"({used}/{budget} rollbacks){mode}")
+        return Result(requeue=True)
+
+    def _clear_rollback_annotation(self, client: KubeClient,
+                                   job: TrainingJob, manifest: dict,
+                                   by_name: dict[str, dict],
+                                   chief: str) -> None:
+        """Consume the rollback directive once the chief's FRESH
+        heartbeat shows training advanced past the trip step — the
+        suspect range re-ran clean, so future restarts must resume from
+        the newest checkpoint, not stay pinned to the old LKG."""
+        raw = k8s.annotations_of(manifest).get(ANOMALY_ROLLBACK_ANNOTATION)
+        if not raw:
+            return
+        try:
+            trip = int(json.loads(raw).get("tripStep", 0))
+        except (AttributeError, TypeError, ValueError):
+            trip = 0
+        beat = self._beat_of(by_name.get(chief))
+        if beat is None:
+            return
+        fresh_s = job.run_policy.stall_timeout_seconds or \
+            health.STEP_SKEW_FRESH_S
+        if self._beat_age(job.namespace, chief, beat[0], _now()) > fresh_s \
+                or beat[1] <= trip:
+            return
+        try:
+            update_with_conflict_retry(
+                client, *k8s.key_of(manifest),
+                lambda obj: apply_annotations(
+                    obj, {ANOMALY_ROLLBACK_ANNOTATION: None})
+                if ANOMALY_ROLLBACK_ANNOTATION in k8s.annotations_of(obj)
+                else None)
+        except NotFoundError:
+            pass
+
+    def _note_numeric_health(self, job: TrainingJob,
+                             by_name: dict[str, dict],
+                             tpu_names: list[str],
+                             client: KubeClient) -> None:
+        """The heartbeat numeric canary: a worker whose FRESH heartbeat
+        reports a non-finite lastLoss/lastGradNorm gets flagged (host
+        health event + anomaly counter) even when spec.integrity is
+        disabled — the payload rides the liveness beat for free, so
+        non-instrumented detection costs nothing extra. Freshness is
+        clamped the same way the stall watchdog's is (PR 6): a stale or
+        future-stamped beat is not evidence."""
+        now = _now()
+        fresh_s = job.run_policy.stall_timeout_seconds or \
+            health.STEP_SKEW_FRESH_S
+        for name in tpu_names:
+            pod = by_name.get(name)
+            if pod is None:
+                continue
+            raw = k8s.annotations_of(pod).get(HEARTBEAT_ANNOTATION)
+            if not raw:
+                continue
+            try:
+                d = json.loads(raw)
+                beat = float(d.get("time", 0))
+                step = int(d.get("step", 0))
+            except (AttributeError, TypeError, ValueError):
+                continue
+            if not beat or self._beat_age(job.namespace, name,
+                                          beat, now) > fresh_s:
+                continue
+            bad = None
+            for field in ("lastLoss", "lastGradNorm"):
+                v = d.get(field)
+                if v is None:
+                    continue
+                try:
+                    val = float(v)
+                except (TypeError, ValueError):
+                    continue
+                if not math.isfinite(val):
+                    bad = (field, v)
+                    break
+            key = (job.namespace, name)
+            if bad is None:
+                continue
+            if self._numeric_flagged.get(key) == step:
+                continue
+            self._numeric_flagged[key] = step
+            log.warning("pod %s/%s heartbeat reports non-finite %s=%s "
+                        "at step %d", job.namespace, name, bad[0], bad[1],
+                        step)
+            from ..runtime.sentinel import KIND_HEARTBEAT_NAN, \
+                anomaly_counter
+            anomaly_counter().labels(kind=KIND_HEARTBEAT_NAN).inc()
+            node = pod.get("spec", {}).get("nodeName")
+            if node:
+                health.record_host_event(
+                    client, node, health.EVENT_NUMERIC_ANOMALY,
+                    job_key=f"{job.namespace}/{job.name}", now=now)
 
     def _handle_gang_failure(self, client: KubeClient, job: TrainingJob,
                              manifest: dict, pods: list[dict],

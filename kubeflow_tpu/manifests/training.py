@@ -111,6 +111,18 @@ def _job_schema(specs_key: str, max_one: list[str]) -> dict:
             "serving": {"type": "string",
                         "enum": ["stock", "int8"]},
         }},
+        # numeric-integrity sentinel knobs (api/trainingjob.py
+        # IntegritySpec → KFTPU_INTEGRITY / _SPIKE_Z / _WINDOW /
+        # _CHECK_EVERY: in-step NaN/Inf + loss-spike detection with LKG
+        # rollback — runtime/sentinel.py; deliberately EXCLUDED from the
+        # recipe fingerprint; tests/test_lint.py enforces the same
+        # full-path rule)
+        "integrity": {"type": "object", "properties": {
+            "enabled": {"type": "boolean"},
+            "spikeZ": {"type": "number", "exclusiveMinimum": 0},
+            "windowSteps": {"type": "integer", "minimum": 2},
+            "checkEverySteps": {"type": "integer", "minimum": 1},
+        }},
         # persistent XLA compile cache dir override (defaults to the
         # namespace's shared cache when the operator carries
         # KFTPU_SHARED_CACHE_ROOT, else <checkpointDir>/.jax-compile-cache)
@@ -448,6 +460,11 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
                    restart_backoff_seconds: float = 0.0,
                    restart_backoff_max_seconds: float = 300.0,
                    stall_timeout_seconds: int | None = None,
+                   max_anomaly_rollbacks: int = 2,
+                   integrity: bool | None = None,
+                   integrity_spike_z: float | None = None,
+                   integrity_window_steps: int | None = None,
+                   integrity_check_every_steps: int | None = None,
                    queue: str | None = None,
                    priority: int | None = None,
                    preemptible: bool | None = None,
@@ -485,7 +502,17 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
     between gang restarts — restart-storm protection; spec
     restartBackoffSeconds/restartBackoffMaxSeconds), and
     ``stall_timeout_seconds`` (the hung-chief stall watchdog; spec
-    stallTimeoutSeconds).
+    stallTimeoutSeconds), and ``max_anomaly_rollbacks`` (the numeric-
+    integrity sentinel's LKG-rollback budget, separate from
+    backoffLimit; spec maxAnomalyRollbacks — docs/operations.md
+    "Numeric integrity").
+
+    ``integrity`` + ``integrity_spike_z``/``integrity_window_steps``/
+    ``integrity_check_every_steps`` render spec.integrity
+    (api/trainingjob.py IntegritySpec → KFTPU_INTEGRITY / _SPIKE_Z /
+    _WINDOW / _CHECK_EVERY): the in-step NaN/Inf + loss-spike sentinel
+    with last-known-good rollback (docs/operations.md "Numeric
+    integrity").
 
     ``queue``/``priority``/``preemptible`` render spec.schedulingPolicy
     (api/trainingjob.py SchedulingPolicy): set ANY of them — including
@@ -560,7 +587,8 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
         ttl_seconds_after_finished=ttl_seconds_after_finished,
         restart_backoff_seconds=restart_backoff_seconds,
         restart_backoff_max_seconds=restart_backoff_max_seconds,
-        stall_timeout_seconds=stall_timeout_seconds)
+        stall_timeout_seconds=stall_timeout_seconds,
+        max_anomaly_rollbacks=max_anomaly_rollbacks)
     job = k8s.make(TPU_API_VERSION, "TPUJob", name, namespace)
     tpu_spec: dict = {
         "tpuTopology": topology,
@@ -582,6 +610,16 @@ def tpu_job_simple(namespace: str = "kubeflow", name: str = "tpu-job-simple",
                           device_prefetch=device_prefetch)
         ispec.validate()
         job["spec"]["input"] = ispec.to_dict()
+    if integrity is not None or integrity_spike_z is not None or \
+            integrity_window_steps is not None or \
+            integrity_check_every_steps is not None:
+        from ..api.trainingjob import IntegritySpec
+        sspec = IntegritySpec(
+            enabled=integrity, spike_z=integrity_spike_z,
+            window_steps=integrity_window_steps,
+            check_every_steps=integrity_check_every_steps)
+        sspec.validate()
+        job["spec"]["integrity"] = sspec.to_dict()
     if queue is not None or priority is not None or \
             preemptible is not None or min_chips is not None or \
             max_chips is not None:

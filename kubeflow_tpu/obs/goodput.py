@@ -50,6 +50,11 @@ BADPUT_COMPILE = "compile"                # train() entry → first step,
 #                                           split cold/warm/aot
 BADPUT_CHECKPOINT = "checkpoint"          # save submission + restore
 BADPUT_RECOMPUTE = "restart_recompute"    # steps re-executed after resume
+BADPUT_ROLLBACK = "rollback_recompute"    # steps replayed LKG → trip after
+#                                           an anomaly rollback (the
+#                                           sentinel's recovery cost —
+#                                           split out of restart_recompute
+#                                           so SDC waste is its own line)
 BADPUT_RESIZE = "resize"                  # resize/migration downtime
 BADPUT_STALL = "stall"                    # wedged → watchdog teardown
 BADPUT_PIPELINE_BUBBLE = "pipeline_bubble"  # MPMD pipeline fill/drain
@@ -60,8 +65,9 @@ BADPUT_PIPELINE_BUBBLE = "pipeline_bubble"  # MPMD pipeline fill/drain
 BADPUT_OTHER = "other"                    # unattributed residual
 
 BADPUT_CATEGORIES = (BADPUT_QUEUE_WAIT, BADPUT_STARTUP, BADPUT_COMPILE,
-                     BADPUT_CHECKPOINT, BADPUT_RECOMPUTE, BADPUT_RESIZE,
-                     BADPUT_STALL, BADPUT_PIPELINE_BUBBLE, BADPUT_OTHER)
+                     BADPUT_CHECKPOINT, BADPUT_RECOMPUTE, BADPUT_ROLLBACK,
+                     BADPUT_RESIZE, BADPUT_STALL, BADPUT_PIPELINE_BUBBLE,
+                     BADPUT_OTHER)
 
 # the operator stamps a job's final ledger here on completion
 # (controllers/tpujob.py _finalize_ledger) so the decomposition survives
@@ -350,6 +356,12 @@ SPAN_CKPT_RESTORE = "ckpt-restore"
 # a modeled attribution inside a real interval, documented in
 # docs/operations.md "Goodput accounting")
 SPAN_PIPELINE_BUBBLE = "pipeline-bubble"
+# tripped numeric-integrity detector (runtime/worker.py emits it with
+# the evidence — step, kind, lkg — right before exiting for rollback);
+# decompose reads its (lkg, step] range to split replayed steps into
+# rollback_recompute. THE anomaly-event literal (tests/test_lint.py
+# pins it here).
+SPAN_ANOMALY = "anomaly"
 
 # overlap resolution: when two attributed intervals claim the same time,
 # the LOWEST number wins. Compile outranks the windows (the first window
@@ -359,17 +371,18 @@ SPAN_PIPELINE_BUBBLE = "pipeline-bubble"
 # inferred control-plane intervals; everything outranks the residual.
 _PRIORITY = {
     BADPUT_COMPILE: 0,
-    BADPUT_RECOMPUTE: 1,
+    BADPUT_ROLLBACK: 1,
+    BADPUT_RECOMPUTE: 2,
     # above goodput: a bubble span carves schedule-idle time OUT of the
     # window interval it overlaps (the worker sizes it to the measured
     # bubble seconds of that window's steps)
-    BADPUT_PIPELINE_BUBBLE: 2,
-    GOODPUT: 3,
-    BADPUT_CHECKPOINT: 4,
-    BADPUT_STALL: 5,
-    BADPUT_RESIZE: 6,
-    BADPUT_QUEUE_WAIT: 7,
-    BADPUT_STARTUP: 8,
+    BADPUT_PIPELINE_BUBBLE: 3,
+    GOODPUT: 4,
+    BADPUT_CHECKPOINT: 5,
+    BADPUT_STALL: 6,
+    BADPUT_RESIZE: 7,
+    BADPUT_QUEUE_WAIT: 8,
+    BADPUT_STARTUP: 9,
 }
 
 # operator restart reasons that read as a stall (controllers/tpujob.py)
@@ -408,16 +421,23 @@ def _last_activity_end(spans: list[dict], before: float) -> Optional[float]:
     return best
 
 
-def _window_segments(spans: list[dict]) -> tuple:
+def _window_segments(spans: list[dict],
+                     rollback_ranges: tuple = ()) -> tuple:
     """Split every ``window`` span into goodput vs recompute via a
     step high-water walk: a window re-covering steps already banked
     before a restart is replay, charged to ``restart_recompute``
     proportionally (the replayed steps run FIRST chronologically).
-    Returns (segments, steps_new, steps_recomputed, n_windows)."""
+    ``rollback_ranges`` — (anomaly_time, lkg, trip) per anomaly span —
+    reclassifies the replayed steps inside a rollback's (lkg, trip]
+    range as ``rollback_recompute``, but only for windows AFTER the
+    trip: the original run of those steps was goodput at the time.
+    Returns (segments, steps_new, steps_recomputed, steps_rolled_back,
+    n_windows)."""
     segments: list[tuple] = []
     high_water = 0
     steps_new = 0
     steps_re = 0
+    steps_rb = 0
     windows = 0
     for s in spans:
         if s.get("name") != "window":
@@ -436,15 +456,28 @@ def _window_segments(spans: list[dict]) -> tuple:
         s0 = s1 - n
         re = min(n, max(0, min(s1, high_water) - s0))
         new = n - re
-        split = start + (end - start) * (re / n)
+        re_rb = 0
         if re:
-            segments.append((start, split, BADPUT_RECOMPUTE))
+            for at, lkg, trip in rollback_ranges:
+                if start >= at:
+                    overlap = min(s0 + re, trip) - max(s0, lkg)
+                    if overlap > 0:
+                        re_rb = max(re_rb, min(re, overlap))
+        # chronological order inside the window: the replayed steps run
+        # first (rollback replay before restart replay before new work)
+        split_rb = start + (end - start) * (re_rb / n)
+        split = start + (end - start) * (re / n)
+        if re_rb:
+            segments.append((start, split_rb, BADPUT_ROLLBACK))
+        if re - re_rb:
+            segments.append((split_rb, split, BADPUT_RECOMPUTE))
         if new:
             segments.append((split, end, GOODPUT))
         high_water = max(high_water, s1)
         steps_new += new
         steps_re += re
-    return segments, steps_new, steps_re, windows
+        steps_rb += re_rb
+    return segments, steps_new, steps_re, steps_rb, windows
 
 
 def decompose(spans: list[dict]) -> dict:
@@ -452,8 +485,8 @@ def decompose(spans: list[dict]) -> dict:
 
     ``{"wallSeconds", "goodputSeconds", "goodputRatio",
     "badputSeconds": {category: seconds — every BADPUT_CATEGORIES key},
-    "compileByStartKind": {...}, "steps", "stepsRecomputed", "windows",
-    "chips"}``
+    "compileByStartKind": {...}, "steps", "stepsRecomputed",
+    "stepsRolledBack", "windows", "chips"}``
 
     The categories plus goodput sum to wallSeconds exactly (partition by
     construction); ``categories_sum_ok`` is the bench's tolerance check
@@ -463,7 +496,7 @@ def decompose(spans: list[dict]) -> dict:
         "wallSeconds": 0.0, "goodputSeconds": 0.0, "goodputRatio": 0.0,
         "badputSeconds": {c: 0.0 for c in BADPUT_CATEGORIES},
         "compileByStartKind": {}, "steps": 0, "stepsRecomputed": 0,
-        "windows": 0, "chips": 0,
+        "stepsRolledBack": 0, "windows": 0, "chips": 0,
     }
     if not spans:
         return empty
@@ -472,7 +505,25 @@ def decompose(spans: list[dict]) -> dict:
     if t1 <= t0:
         return empty
 
-    segments, steps_new, steps_re, windows = _window_segments(spans)
+    # anomaly-rollback evidence pre-pass: each anomaly span's
+    # (lkg, trip] range marks the steps whose replay is the sentinel's
+    # recovery cost, not generic restart recompute
+    rollback_ranges = []
+    for s in spans:
+        if s.get("name") != SPAN_ANOMALY:
+            continue
+        a = _attrs(s)
+        try:
+            trip = int(a.get("step", 0))
+            lkg = int(a.get("lkg") or 0)
+        except (TypeError, ValueError):
+            continue
+        if trip > lkg >= 0:
+            rollback_ranges.append(
+                (float(s.get("start", 0.0)), lkg, trip))
+
+    segments, steps_new, steps_re, steps_rb, windows = \
+        _window_segments(spans, tuple(rollback_ranges))
     compile_by_kind: dict[str, float] = {}
     chips = 0
 
@@ -584,6 +635,7 @@ def decompose(spans: list[dict]) -> dict:
                                for k, v in sorted(compile_by_kind.items())},
         "steps": steps_new,
         "stepsRecomputed": steps_re,
+        "stepsRolledBack": steps_rb,
         "windows": windows,
         "chips": chips,
     }

@@ -71,6 +71,10 @@ ORBAX_COMMIT_MARKER = "_CHECKPOINT_METADATA"
 # our integrity manifest, written AFTER the orbax commit (so its presence
 # implies the payload below it was complete at manifest time)
 MANIFEST_NAME = "kftpu.manifest.json"
+# last-known-good marker (runtime/sentinel.py): the newest step the
+# numeric-integrity sentinel cleared the FOLLOWING window for — the step
+# an anomaly rollback resumes from. Atomic-rename committed, monotonic.
+LKG_MARKER = "kftpu.lkg.json"
 
 
 def _crc32_file(path: str, chunk: int = 1 << 20) -> int:
@@ -174,10 +178,15 @@ class CheckpointManager:
         # latest_step() poll — the serving registry polls it every 30s —
         # would turn a metadata lookup into continuous disk reads
         self._intact_cache: set[int] = set()
+        # retention is OURS, not orbax's: orbax keep-last-N counts every
+        # step directory — an uncommitted/corrupt newest step would
+        # consume a retention slot and evict the last RESTORABLE step.
+        # _retain() counts only intact steps and never drops the LKG.
+        self.max_to_keep = max_to_keep
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep,
+                max_to_keep=None,
                 save_interval_steps=save_interval_steps),
         )
         # wall-clock op log for the goodput ledger (obs/goodput.py):
@@ -270,13 +279,108 @@ class CheckpointManager:
         for step in sorted(pending):
             step_dir = os.path.join(self.directory, str(step))
             if not os.path.isdir(step_dir):
-                continue  # already pruned by max_to_keep
+                continue  # already pruned by retention
             try:
                 write_manifest(step_dir, run_meta=self.run_meta)
             except OSError as e:
                 # a missing manifest only downgrades verification, never
                 # the checkpoint itself — don't fail the run over it
                 log.warning("manifest write for step %d failed: %s", step, e)
+        self._retain()
+
+    def _retain(self) -> None:
+        """Keep-last-N counting only INTACT steps, never the LKG.
+
+        Only intact steps beyond the keep set are deleted: a non-intact
+        directory may be an in-flight async save (deleting it would race
+        the writer), and it costs no retention slot anyway. Process 0
+        only (called under the _flush_manifests gate).
+
+        Deliberately does NOT warm the intact cache: retention runs on
+        every flush, and caching "intact at write time" here would mask
+        corruption that lands AFTER the save (truncation, bit rot) from
+        every later restore-side verify in this same process — the
+        exact faults tests/test_chaos.py injects."""
+        if not self.max_to_keep or self.max_to_keep <= 0:
+            return
+        intact = []
+        for s in self.all_steps():
+            if s in self._intact_cache or \
+                    verify_step_dir(os.path.join(self.directory,
+                                                 str(s)))[0]:
+                intact.append(s)
+        keep = set(intact[-self.max_to_keep:])
+        lkg = self.lkg_step()
+        if lkg is not None:
+            keep.add(lkg)
+        drop = [s for s in intact if s not in keep]
+        if not drop:
+            return
+        import shutil
+        for s in drop:
+            log.info("retention: dropping intact step %d (keep-last-%d "
+                     "+ LKG)", s, self.max_to_keep)
+            shutil.rmtree(os.path.join(self.directory, str(s)),
+                          ignore_errors=True)
+            self._intact_cache.discard(s)
+        try:
+            self._mgr.reload()   # drop orbax's cached step list
+        except Exception as e:  # noqa: BLE001 — reload is best-effort
+            log.warning("orbax reload after retention failed: %s", e)
+
+    # -------------------------------------------------------- LKG tagging
+
+    def lkg_step(self) -> Optional[int]:
+        """Last-known-good step per the marker file, or None. The marker
+        outlives manager instances (a rollback-restarted worker reads the
+        LKG its predecessor tagged)."""
+        try:
+            with open(os.path.join(self.directory, LKG_MARKER)) as f:
+                step = json.load(f).get("step")
+        except (OSError, ValueError):
+            return None
+        return int(step) if isinstance(step, int) else None
+
+    def tag_lkg(self, step: int) -> None:
+        """Mark ``step`` last-known-good — the sentinel cleared the window
+        AFTER it, so its state is trusted for anomaly rollback. Monotonic
+        (an older tag never overwrites a newer one) and atomic; retention
+        (_retain) never GCs the tagged step."""
+        step = int(step)
+        cur = self.lkg_step()
+        if cur is not None and cur >= step:
+            return
+        if jax.process_index() == 0:
+            tmp = os.path.join(self.directory, LKG_MARKER + ".tmp")
+            with open(tmp, "w") as f:
+                json.dump({"step": step, "time": time.time()}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self.directory, LKG_MARKER))
+        from .sentinel import lkg_gauge
+        lkg_gauge().set(step)
+
+    def discard_steps_after(self, step: int) -> None:
+        """Delete every step directory NEWER than ``step``: the anomaly
+        rollback path restored the LKG, so newer steps are tainted by the
+        trip and must not shadow it on the next restore — and their
+        remains would trip orbax's "step already exists" when training
+        replays through them. Process 0 only."""
+        if jax.process_index() != 0:
+            return
+        import shutil
+        for s in self.all_steps():
+            if s > step:
+                log.warning("rollback: discarding tainted step %d "
+                            "(> LKG %d)", s, step)
+                shutil.rmtree(os.path.join(self.directory, str(s)),
+                              ignore_errors=True)
+                self._intact_cache.discard(s)
+                self._pending_manifest.discard(s)
+        try:
+            self._mgr.reload()
+        except Exception as e:  # noqa: BLE001 — reload is best-effort
+            log.warning("orbax reload after rollback discard failed: %s", e)
 
     # ----------------------------------------------------------- inspection
 
@@ -347,11 +451,15 @@ class CheckpointManager:
     # --------------------------------------------------------------- restore
 
     def _restore_with_fallback(self, restore_fn: Callable[[int], Any],
-                               step: Optional[int]) -> Any:
+                               step: Optional[int],
+                               max_step: Optional[int] = None) -> Any:
         """Explicit step: verify + restore that exact step (an operator
         asked for it; silently restoring another would be worse than
         failing). Implicit latest: walk intact steps newest-first and fall
-        back past any step that fails verification or restore."""
+        back past any step that fails verification or restore.
+        ``max_step`` caps the walk (anomaly rollback: resume from the
+        newest intact step ≤ LKG, never a newer tainted one) — if the
+        capped step itself is corrupt the walk falls back past it."""
         if step is not None:
             ok, reason = self.verify_step(step)
             if not ok:
@@ -368,6 +476,8 @@ class CheckpointManager:
         # newest-first, verifying LAZILY: older steps only pay their
         # verification cost if every newer candidate was rejected
         for candidate in reversed(self.all_steps()):
+            if max_step is not None and candidate > max_step:
+                continue
             ok, reason = self.verify_step(candidate)
             if not ok:
                 log.warning("checkpoint step %d skipped: %s",
@@ -436,7 +546,8 @@ class CheckpointManager:
                 "to": replica_degree}
 
     def restore(self, state_template: Any, step: Optional[int] = None,
-                expect_run: Optional[tuple] = None) -> Any:
+                expect_run: Optional[tuple] = None,
+                max_step: Optional[int] = None) -> Any:
         """Restore into the template's shardings (template = an abstract or
         concrete TrainState with the target shardings attached). This IS
         the elastic reshape: the template carries the CURRENT mesh's
@@ -462,7 +573,8 @@ class CheckpointManager:
             return self._mgr.restore(
                 s, args=ocp.args.StandardRestore(abstract))
 
-        return self._restore_with_fallback(_restore, step)
+        return self._restore_with_fallback(_restore, step,
+                                           max_step=max_step)
 
     def restore_params(self, step: Optional[int] = None) -> Any:
         """Restore just the model params, template-free. The trainer writes

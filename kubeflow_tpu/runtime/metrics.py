@@ -96,15 +96,29 @@ class HeartbeatReporter:
         return cls(client, env.get(POD_NAMESPACE_ENV, "default"), pod,
                    interval_s=interval_s)
 
-    def beat(self, step: int, force: bool = False) -> bool:
+    def beat(self, step: int, force: bool = False,
+             loss: Optional[float] = None,
+             grad_norm: Optional[float] = None) -> bool:
         """Record progress at `step`. Rate-limited to one patch per
-        interval unless forced; returns whether a patch was sent."""
+        interval unless forced; returns whether a patch was sent.
+
+        `loss`/`grad_norm` ride along as lastLoss/lastGradNorm so the
+        operator can flag a NaN-emitting worker even when the worker's
+        own sentinel is disabled (controllers/tpujob.py
+        _note_numeric_health). Stringified via repr(): json.dumps would
+        emit bare NaN/Infinity, which strict parsers reject — and NaN is
+        exactly the value this channel exists to carry."""
         # import here keeps module import light; trainingjob is jax-free
         from ..api.trainingjob import HEARTBEAT_ANNOTATION
         now = time.time()
         if not force and now - self._last < self.interval_s:
             return False
-        payload = json.dumps({"step": int(step), "time": now})
+        body: dict = {"step": int(step), "time": now}
+        if loss is not None:
+            body["lastLoss"] = repr(float(loss))
+        if grad_norm is not None:
+            body["lastGradNorm"] = repr(float(grad_norm))
+        payload = json.dumps(body)
         try:
             self.client.patch(
                 "v1", "Pod", self.namespace, self.pod,
@@ -117,6 +131,21 @@ class HeartbeatReporter:
         self._last = now
         self._g_time.set(now)
         self._g_step.set(int(step))
+        return True
+
+    def annotate(self, annotation: str, payload: str) -> bool:
+        """Patch an arbitrary annotation onto our own pod — the anomaly
+        evidence channel (ANOMALY_ANNOTATION): the sentinel trips, the
+        worker posts the evidence here, then exits ANOMALY_EXIT_CODE so
+        the operator finds both. Best-effort like beat()."""
+        try:
+            self.client.patch(
+                "v1", "Pod", self.namespace, self.pod,
+                {"metadata": {"annotations": {annotation: payload}}})
+        except Exception as e:  # noqa: BLE001 — evidence is best-effort
+            log.warning("annotation patch %s for %s/%s failed: %s",
+                        annotation, self.namespace, self.pod, e)
+            return False
         return True
 
 

@@ -342,6 +342,7 @@ class TrainStepBuilder:
         mesh = self.mesh
         P0 = P()
         rep = NamedSharding(mesh, P0)
+        model_axes = tuple(a for a in mesh.axis_names if a not in set(axes))
 
         def spec_dim(spec) -> Optional[int]:
             for i, entry in enumerate(spec):
@@ -430,6 +431,35 @@ class TrainStepBuilder:
                 new_params, self.param_shardings(state.params))
             metrics = {"loss": loss,
                        "grad_norm": optax.global_norm(grads), **aux}
+            # Replicated-math integrity probe (runtime/sentinel.py): every
+            # replica recomputes the SAME scalar — the global param sqnorm
+            # after the update's all-gather — and the per-replica vector
+            # leaves for the host. Absent corruption the entries agree up
+            # to reduce-order noise; a replica that disagrees is silent-
+            # data-corruption evidence NAMING a host. Cost: one vdot
+            # chain + a scalar all-gather per step. Only emitted when the
+            # params are genuinely replicated over the replica axes (an
+            # fsdp-style layout would make the entries differ
+            # legitimately).
+            psh = self.param_shardings(state.params)
+            if n_rep > 1 and not any(
+                    spec_dim(s.spec) is not None
+                    for s in jax.tree.leaves(psh)):
+                pspecs = jax.tree.map(lambda s: s.spec, psh,
+                                      is_leaf=is_ns)
+
+                def integrity_probe(params):
+                    p2 = jnp.zeros((), jnp.float32)
+                    for leaf in jax.tree.leaves(params):
+                        x = leaf.astype(jnp.float32)
+                        p2 = p2 + jnp.vdot(x, x)
+                    if model_axes:
+                        p2 = jax.lax.psum(p2, model_axes)
+                    return jax.lax.all_gather(p2, axes)
+
+                metrics["param_sqnorm_replicas"] = shard_map(
+                    integrity_probe, mesh=mesh, in_specs=(pspecs,),
+                    out_specs=P0, check_vma=False)(new_params)
             new_state = TrainState(step=state.step + 1, params=new_params,
                                    opt_state=new_opt, variables=new_vars,
                                    rng=rng)

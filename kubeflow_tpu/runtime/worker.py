@@ -123,6 +123,18 @@ def _env_int(name: str, default: int) -> int:
         raise ValueError(f"{name} must be an integer, got {v!r}") from None
 
 
+def _env_float(name: str, default: float) -> float:
+    """Float knob from the operator-rendered env (same loud-failure
+    policy as _env_int)."""
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        raise ValueError(f"{name} must be a number, got {v!r}") from None
+
+
 def _emit_ckpt_spans(ckpt, tracer) -> None:
     """Drain the checkpoint manager's wall-clock op log into
     ckpt-save/ckpt-restore trace spans — the goodput ledger's
@@ -181,6 +193,10 @@ class TrainResult:
     # warmstart and the kftpu_time_to_first_step_seconds histogram read
     time_to_first_step_s: float = 0.0
     start_kind: str = "cold"
+    # tripped-detector evidence (AnomalyEvidence.to_dict()) when the
+    # numeric-integrity sentinel ended the run; None on a clean run.
+    # main() maps truthiness to ANOMALY_EXIT_CODE (runtime/sentinel.py).
+    anomaly: Optional[dict] = None
 
 
 class PreemptionGuard:
@@ -261,6 +277,10 @@ def train(
     kernel_attention: Optional[str] = None,
     kernel_optimizer: Optional[str] = None,
     kernel_serving: Optional[str] = None,
+    integrity: Optional[bool] = None,
+    integrity_spike_z: Optional[float] = None,
+    integrity_window: Optional[int] = None,
+    integrity_check_every: Optional[int] = None,
 ) -> TrainResult:
     # before any jit: warm restarts must hit the persistent cache for the
     # very first compile (the startup→first-step dominator, PERF.md) —
@@ -459,6 +479,39 @@ def train(
     rng = jax.random.PRNGKey(seed)
     state = builder.init(spec.init_fn, rng)
 
+    # numeric-integrity sentinel (runtime/sentinel.py): CLI flag wins,
+    # then the operator-rendered env (controllers/tpujob.py renders
+    # spec.integrity.* as KFTPU_INTEGRITY*), then off. Deliberately NOT
+    # in the recipe fingerprint — the sentinel changes no math.
+    from . import sentinel as sentinel_mod
+    if integrity is None:
+        integrity = bool(_env_int("KFTPU_INTEGRITY", 0))
+    if integrity_spike_z is None:
+        integrity_spike_z = _env_float("KFTPU_INTEGRITY_SPIKE_Z",
+                                       sentinel_mod.DEFAULT_SPIKE_Z)
+    if integrity_window is None:
+        integrity_window = _env_int("KFTPU_INTEGRITY_WINDOW",
+                                    sentinel_mod.DEFAULT_WINDOW_STEPS)
+    if integrity_check_every is None:
+        integrity_check_every = _env_int("KFTPU_INTEGRITY_CHECK_EVERY",
+                                         sentinel_mod.DEFAULT_CHECK_EVERY)
+    sentinel = sentinel_mod.NumericSentinel(
+        spike_z=float(integrity_spike_z),
+        window_steps=int(integrity_window)) if integrity else None
+    # operator anomaly-rollback contract (NOT spec knobs — rendered from
+    # the job's anomaly-rollback annotation): resume from the newest
+    # intact step <= the LKG, never the newest (tainted) one; the replay
+    # range arms bisection over the suspect steps (the deterministic
+    # input pipeline replays byte-identical batches per (seed, index))
+    resume_step = _env_int(sentinel_mod.RESUME_STEP_ENV, 0) or None
+    replay = sentinel_mod.parse_replay_range(
+        os.environ.get(sentinel_mod.REPLAY_RANGE_ENV))
+    # chaos numeric-fault hook (cluster/chaos.py injectors): poisons the
+    # state at an armed step so the detectors have something to catch
+    fault_hook = sentinel_mod.NumericFaultHook.from_env()
+    anomaly = None       # AnomalyEvidence once a detector trips
+    replay_done = False  # bisection verdict emitted
+
     # operator-rendered checkpoint/resume contract (controllers/tpujob.py
     # renders spec.checkpointDir/resumeFrom as these env vars; gang restart
     # sets resumeFrom automatically)
@@ -483,10 +536,20 @@ def train(
                                  run_meta=run_meta)
         if resume and ckpt.latest_step() is not None:
             # expect_run: the elastic contract is checked against the
-            # step the fallback walk ACTUALLY restores
+            # step the fallback walk ACTUALLY restores. max_step caps
+            # the fallback walk for anomaly rollback (resume the LKG,
+            # not the newest tainted step; a corrupt LKG falls back to
+            # the next-oldest intact step).
             state = ckpt.restore(state,
-                                 expect_run=(degree, global_batch))
+                                 expect_run=(degree, global_batch),
+                                 max_step=resume_step)
             log.info("resumed from step %d", int(state.step))
+            if resume_step is not None:
+                # the steps after the LKG are tainted by the trip:
+                # delete them so they can't shadow the rollback on the
+                # next restore (and so orbax doesn't refuse re-saving
+                # them as training replays through)
+                ckpt.discard_steps_after(int(state.step))
     if resume_from and int(state.step) == 0 and HAVE_ORBAX:
         # warm start / gang-restart restore: only when the local
         # checkpoint_dir had nothing newer
@@ -503,6 +566,13 @@ def train(
             # after every failure-prone setup stage), and src closes here
             early_ckpt_ops = src.drain_op_log()
             src.close()
+
+    # LKG promotion bookkeeping: steps with a checkpoint on disk,
+    # promoted to last-known-good once a LATER window drains clean
+    # through the sentinel (ckpt.tag_lkg below)
+    saved_steps: list = []
+    if ckpt is not None and ckpt.latest_step() is not None:
+        saved_steps.append(int(ckpt.latest_step()))
 
     step_fn = builder.build()
 
@@ -856,6 +926,11 @@ def train(
     # never empties — the blocking edge fetch cost ~160 ms of queue
     # refill per window on tunneled hosts (PERF.md).
     sync_every = max(1, int(sync_every))
+    if sentinel is not None:
+        # the sentinel reads window-drained floats, so the window edge
+        # bounds detection latency: cap the sync interval at the check
+        # cadence (spec.integrity.checkEverySteps)
+        sync_every = min(sync_every, max(1, int(integrity_check_every)))
     afetch = AsyncWindowFetch(lag=1)
     comm_series = None   # kftpu_comm_* handle, pruned at teardown
     # MPMD schedule-idle accumulator: the engine reports modeled bubble
@@ -992,6 +1067,13 @@ def train(
                     first_step_s=step_cost if step == start_step
                     else 0.0)
                 profile_arm.on_step_end(step + 1)
+                if fault_hook is not None and \
+                        fault_hook.should_fire(step + 1):
+                    # chaos numeric fault (cluster/chaos.py): corrupt
+                    # the state AFTER the step completes, so the damage
+                    # surfaces in the NEXT window's metrics — the way
+                    # real SDC would
+                    state = fault_hook.poison(state, step + 1)
                 if multislice_pipeline:
                     win_bubble += float(
                         metrics.get("pipeline_bubble_s", 0.0) or 0.0)
@@ -1041,8 +1123,41 @@ def train(
                     for s, w, wall, vals in afetch.drain(
                             force=final or will_ckpt or will_eval
                             or stopping):
+                        # the zero2 integrity probe's per-replica VECTOR
+                        # must not reach the scalar metric stream
+                        rep_sq = vals.pop("param_sqnorm_replicas", None)
                         last_metrics = vals
                         mlog.record_window(s, w, wall, vals)
+                        if sentinel is not None and anomaly is None:
+                            anomaly = sentinel.observe(
+                                s, loss=vals.get("loss"),
+                                grad_norm=vals.get("grad_norm"),
+                                replica_sqnorms=None if rep_sq is None
+                                else [float(v) for v in rep_sq],
+                                lkg=ckpt.lkg_step()
+                                if ckpt is not None else None)
+                            if anomaly is None and ckpt is not None:
+                                # window ending at s drained clean:
+                                # every saved step < s now has a
+                                # sentinel-cleared window after it —
+                                # promote the newest to last-known-good
+                                cleared = [n for n in saved_steps
+                                           if n < s]
+                                if cleared:
+                                    ckpt.tag_lkg(cleared[-1])
+                            if anomaly is None and replay is not None \
+                                    and not replay_done \
+                                    and s >= replay[1]:
+                                # the suspect range replayed CLEAN with
+                                # the suspect host evacuated: the
+                                # bisection verdict that converts "the
+                                # job is cursed" into "host N is bad"
+                                replay_done = True
+                                if tracer is not None:
+                                    tracer.event(
+                                        "anomaly-bisection",
+                                        lo=replay[0], hi=replay[1],
+                                        verdict="clean", step=s)
                     recorder.close_window(
                         step + 1, window, t_now - win_t0,
                         drain_s=time.perf_counter() - t_drain0)
@@ -1054,8 +1169,38 @@ def train(
                         # stall watchdog sees right after a forced
                         # drain. A loop that stops closing windows
                         # stops beating — exactly the watchdog's signal.
-                        heartbeat.beat(step + 1)
+                        # lastLoss/lastGradNorm ride along so the
+                        # operator can flag a NaN-emitting worker even
+                        # with the worker's own sentinel disabled.
+                        heartbeat.beat(
+                            step + 1,
+                            loss=last_metrics.get("loss"),
+                            grad_norm=last_metrics.get("grad_norm"))
                     window = 0
+                if anomaly is not None:
+                    # tripped detector: dump the flight record, post the
+                    # evidence, and exit WITHOUT checkpointing — the
+                    # state is tainted; the operator rolls the job back
+                    # to the LKG (controllers/tpujob.py _handle_anomaly)
+                    log.error("numeric anomaly %s at step %d (value %s, "
+                              "lkg %s): exiting for LKG rollback",
+                              anomaly.kind, anomaly.step,
+                              anomaly.to_dict()["value"], anomaly.lkg)
+                    from ..obs.goodput import SPAN_ANOMALY
+                    recorder.dump(dump_tracer, SPAN_ANOMALY,
+                                  error=f"{anomaly.kind}@{anomaly.step}")
+                    if tracer is not None:
+                        tracer.event(SPAN_ANOMALY, step=anomaly.step,
+                                     kind=anomaly.kind,
+                                     value=anomaly.to_dict()["value"],
+                                     lkg=anomaly.lkg,
+                                     **({"replay": list(replay)}
+                                        if replay is not None else {}))
+                    if heartbeat is not None:
+                        from ..api.trainingjob import ANOMALY_ANNOTATION
+                        heartbeat.annotate(ANOMALY_ANNOTATION,
+                                           anomaly.to_json())
+                    break
                 if ckpt is not None:
                     # preemption and normal completion force the save
                     # regardless of cadence: the final state must be
@@ -1063,7 +1208,9 @@ def train(
                     # preemption the grace period is the budget — resume
                     # must lose 0 steps
                     recorder.mark("ckpt-save", step + 1)
-                    ckpt.save(step + 1, state, force=stopping or final)
+                    if ckpt.save(step + 1, state,
+                                 force=stopping or final):
+                        saved_steps.append(step + 1)
                     _emit_ckpt_spans(ckpt, tracer)
                 if stopping:
                     preempted = True
@@ -1109,6 +1256,8 @@ def train(
         if tracer is not None:
             _emit_ckpt_spans(ckpt, tracer)
             attrs = {"preempted": preempted}
+            if anomaly is not None:
+                attrs["anomaly"] = anomaly.kind
             if loop_error is not None:
                 attrs["error"] = f"{type(loop_error).__name__}: {loop_error}"
             try:
@@ -1174,6 +1323,7 @@ def train(
         first_window_s=summary.get("first_window_s", 0.0),
         time_to_first_step_s=first_step_s,
         start_kind=start_kind,
+        anomaly=anomaly.to_dict() if anomaly is not None else None,
     )
 
 
@@ -1303,6 +1453,25 @@ def main(argv=None) -> int:
                    help="serving kernel tier recorded for this job "
                         "(int8 = quantized serving behind the parity "
                         "gate; default $KFTPU_KERNEL_SERVING or stock)")
+    p.add_argument("--integrity", default=None,
+                   action=argparse.BooleanOptionalAction,
+                   help="numeric-integrity sentinel: NaN/Inf, loss-"
+                        "spike, and cross-replica-agreement detectors "
+                        "over the window-drained metrics; a trip exits "
+                        "76 for last-known-good rollback (default "
+                        "$KFTPU_INTEGRITY or off — docs/operations.md "
+                        "'Numeric integrity')")
+    p.add_argument("--integrity-spike-z", type=float, default=None,
+                   help="z-score threshold for the loss-spike detector "
+                        "(default $KFTPU_INTEGRITY_SPIKE_Z or 8.0)")
+    p.add_argument("--integrity-window", type=int, default=None,
+                   help="EWMA window (steps) for the spike baseline; "
+                        "no spike trips until it fills (default "
+                        "$KFTPU_INTEGRITY_WINDOW or 32)")
+    p.add_argument("--integrity-check-every", type=int, default=None,
+                   help="detector cadence in steps — caps --sync-every "
+                        "so detection latency is bounded (default "
+                        "$KFTPU_INTEGRITY_CHECK_EVERY or 10)")
     args = p.parse_args(argv)
     workload_kwargs = {}
     if args.workload in _PIPELINED_WORKLOADS:
@@ -1341,9 +1510,16 @@ def main(argv=None) -> int:
         multislice_microbatches=args.multislice_microbatches,
         kernel_attention=args.kernel_attention,
         kernel_optimizer=args.kernel_optimizer,
-        kernel_serving=args.kernel_serving)
+        kernel_serving=args.kernel_serving,
+        integrity=args.integrity,
+        integrity_spike_z=args.integrity_spike_z,
+        integrity_window=args.integrity_window,
+        integrity_check_every=args.integrity_check_every)
     log.info("done: %d steps, %.1f examples/sec", result.steps,
              result.examples_per_sec)
+    if result.anomaly:
+        from .sentinel import ANOMALY_EXIT_CODE
+        return ANOMALY_EXIT_CODE
     return PREEMPTED_EXIT_CODE if result.preempted else 0
 
 

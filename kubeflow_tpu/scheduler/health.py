@@ -67,6 +67,12 @@ EVENT_STALL = "stall"
 EVENT_WORKER_STALL = "worker-stall"
 EVENT_NOT_READY = "not-ready"
 EVENT_STEP_SKEW = "step-skew"
+# numeric-integrity anomaly (runtime/sentinel.py → the operator's
+# rollback path): NaN/spike/replica-disagreement evidence naming this
+# host. Weighted ABOVE a crash — silent data corruption wastes a full
+# rollback per occurrence and crashes nothing on its own — so two trips
+# (2 × 2.0 ≥ quarantine_threshold 3.0) quarantine the host.
+EVENT_NUMERIC_ANOMALY = "numeric-anomaly"
 
 EVENT_WEIGHTS = {
     EVENT_POD_CRASH: 1.0,
@@ -74,6 +80,7 @@ EVENT_WEIGHTS = {
     EVENT_WORKER_STALL: 1.0,
     EVENT_NOT_READY: 1.0,
     EVENT_STEP_SKEW: 0.25,
+    EVENT_NUMERIC_ANOMALY: 2.0,
 }
 
 # quarantine reason a human writes; never auto-released

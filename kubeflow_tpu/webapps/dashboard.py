@@ -17,6 +17,10 @@ Routes:
   GET /api/sched/nodes                     (per-host health + quarantine)
   GET /api/obs/goodput/{ns}/{name}         (per-job goodput ledger)
   GET /api/obs/goodput                     (cluster chip-hour rollup)
+  GET /api/obs/anomalies/{ns}/{name}       (per-job numeric-integrity
+                                            panel: rollback budget, LKG
+                                            directive, anomaly +
+                                            bisection-verdict spans)
   GET /api/obs/serving                     (per-model serving rollup:
                                             latency percentiles, goodput
                                             vs serving badput, SLO)
@@ -493,6 +497,51 @@ def build_dashboard_app(client: KubeClient,
                        if span_path and trace_id else
                        "no trace id minted yet" if span_path else
                        f"no span sink configured ({SPAN_PATH_ENV} unset)")
+        return 200, out
+
+    @app.route("GET", "/api/obs/anomalies/{namespace}/{name}")
+    def job_anomalies(params, query, body):
+        """One job's numeric-integrity panel (docs/operations.md
+        "Numeric integrity"): the rollback budget and how much of it is
+        spent, the active rollback directive (LKG pin + armed replay
+        range) if any, and the anomaly / bisection-verdict spans from
+        the sink — the evidence trail from detection through LKG
+        rollback to the per-host verdict."""
+        from ..api.trainingjob import (ANOMALY_COUNT_ANNOTATION,
+                                       ANOMALY_ROLLBACK_ANNOTATION,
+                                       TrainingJob)
+        from ..obs.goodput import SPAN_ANOMALY
+        from ..obs.trace import (SPAN_PATH_ENV, TRACE_ID_ANNOTATION,
+                                 load_spans)
+        ns, name = params["namespace"], params["name"]
+        manifest = _find_training_job(ns, name)
+        anns = k8s.annotations_of(manifest)
+        try:
+            budget = TrainingJob.from_manifest(
+                manifest).run_policy.max_anomaly_rollbacks
+        except (ValueError, KeyError, TypeError):
+            budget = None
+        out = {"namespace": ns, "name": name,
+               "phase": _job_phase(manifest),
+               "rollbacks": int(anns.get(ANOMALY_COUNT_ANNOTATION, "0")),
+               "maxAnomalyRollbacks": budget,
+               "rollback": None, "anomalies": [], "bisection": []}
+        raw = anns.get(ANOMALY_ROLLBACK_ANNOTATION)
+        if raw:
+            try:
+                out["rollback"] = json.loads(raw)
+            except ValueError:
+                pass
+        span_path = os.environ.get(SPAN_PATH_ENV)
+        trace_id = anns.get(TRACE_ID_ANNOTATION)
+        if span_path and trace_id:
+            for s in load_spans(span_path, trace_id=trace_id):
+                if s.get("name") == SPAN_ANOMALY:
+                    out["anomalies"].append(s.get("attrs", {}))
+                elif s.get("name") == "anomaly-bisection":
+                    out["bisection"].append(s.get("attrs", {}))
+        elif not span_path:
+            out["note"] = f"no span sink configured ({SPAN_PATH_ENV} unset)"
         return 200, out
 
     @app.route("GET", "/api/obs/goodput")

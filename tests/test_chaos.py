@@ -620,3 +620,36 @@ class TestEndToEnd:
         assert "GangPodsVanished" in report["restart_reasons"]
         assert "StallTimeout" in report["restart_reasons"]
         assert report["api_faults"] >= 3           # the burst really hit
+
+    @pytest.mark.compute
+    @pytest.mark.sentinel
+    def test_soak_chaos_eats_the_lkg_falls_back_to_next_intact(
+            self, tmp_path):
+        """Satellite (c) of ISSUE 17: a NaN trip rolls the job back to
+        the LKG, but chaos truncates the LKG step's payload at trip
+        time — the rollback restore must walk back to the NEXT-oldest
+        intact step, replay, and still land on the clean run's params
+        (≤1e-5)."""
+        import jax
+        import numpy as np
+        from kubeflow_tpu.cluster.chaos import (NaNInjector, SentinelSoak,
+                                                final_params)
+
+        injected = SentinelSoak(
+            workdir=str(tmp_path / "injected"),
+            fault=NaNInjector(at_step=5),
+            total_steps=10, checkpoint_every=2,
+            corrupt_lkg=True).run()
+        assert injected["outcome"] == "succeeded", injected
+        assert injected["lkg_corrupted"] is True
+        assert len(injected["anomalies"]) == 1
+        assert injected["rollbacks"] == 1
+        clean = SentinelSoak(workdir=str(tmp_path / "clean"),
+                             total_steps=10, checkpoint_every=2).run()
+        assert clean["outcome"] == "succeeded", clean
+        deltas = jax.tree.map(
+            lambda a, b: float(np.max(np.abs(np.asarray(a) -
+                                             np.asarray(b)))),
+            final_params(injected["checkpoint_dir"]),
+            final_params(clean["checkpoint_dir"]))
+        assert max(jax.tree.leaves(deltas), default=0.0) <= 1e-5

@@ -682,18 +682,20 @@ def test_badput_categories_defined_once_and_shared():
 
     assert BADPUT_CATEGORIES == (
         "queue_wait", "startup", "compile", "checkpoint",
-        "restart_recompute", "resize", "stall", "pipeline_bubble",
-        "other")
+        "restart_recompute", "rollback_recompute", "resize", "stall",
+        "pipeline_bubble", "other")
 
     # single definition: the distinctive category literals appear as
     # quoted strings in exactly one source file — every other layer
     # imports the names (common-word categories like "compile" would
     # false-positive a grep, so the check pins the unambiguous ones;
     # "pipeline_bubble" is the ISSUE 15 MPMD schedule-idle category —
-    # the worker emits SPAN_PIPELINE_BUBBLE spans, never re-spells it)
+    # the worker emits SPAN_PIPELINE_BUBBLE spans, never re-spells it;
+    # "rollback_recompute" is the ISSUE 17 sentinel LKG-rollback
+    # category — replayed steps inside an anomaly's (lkg, trip] range)
     pkg = os.path.join(REPO_ROOT, "kubeflow_tpu")
     for literal in ("queue_wait", "restart_recompute",
-                    "pipeline_bubble"):
+                    "rollback_recompute", "pipeline_bubble"):
         hits = subprocess.run(
             ["grep", "-rl", f'"{literal}"', pkg],
             capture_output=True, text=True).stdout.split()
@@ -965,6 +967,7 @@ def test_run_policy_fields_are_plumbed_end_to_end():
         "restart_backoff_seconds": 11.0,
         "restart_backoff_max_seconds": 222.0,
         "stall_timeout_seconds": 77,
+        "max_anomaly_rollbacks": 5,
     }
     fields = {f.name for f in dataclasses.fields(RunPolicy)}
     assert fields == set(non_default), \
@@ -1000,6 +1003,135 @@ def test_run_policy_fields_are_plumbed_end_to_end():
                if o["kind"] == "TPUJob")
     assert job["spec"]["runPolicy"] == rp.to_dict()
     assert TrainingJob.from_manifest(job).run_policy == rp
+
+
+def test_integrity_knobs_are_plumbed_end_to_end():
+    """Every IntegritySpec field (ISSUE 17 ``spec.integrity``) must be
+    representable end-to-end, the InputSpec rule: parsed+serialized
+    through the TPUJob spec (api/trainingjob.py), rendered into worker
+    env by the controller via to_env, consumed by the worker's
+    train()/CLI surface, and named in the manifests CRD schema +
+    example builder — so a sentinel knob can't silently exist in one
+    layer only."""
+    import dataclasses
+    import inspect
+
+    import pytest
+
+    from kubeflow_tpu.api.trainingjob import IntegritySpec, TrainingJob
+    from kubeflow_tpu.manifests.training import tpu_job_simple
+    from kubeflow_tpu.runtime import worker
+
+    def src(*rel):
+        with open(os.path.join(REPO_ROOT, "kubeflow_tpu", *rel)) as f:
+            return f.read()
+
+    knobs = dataclasses.fields(IntegritySpec)
+    assert {k.name for k in knobs} == {
+        "enabled", "spike_z", "window_steps", "check_every_steps"}
+    worker_src = src("runtime", "worker.py")
+    controller_src = src("controllers", "tpujob.py")
+    manifests_src = src("manifests", "training.py")
+    train_params = inspect.signature(worker.train).parameters
+    for knob in knobs:
+        # worker: a CLI flag and the env fallback
+        assert knob.metadata["cli"] in worker_src, knob.name
+        assert knob.metadata["env"] in worker_src, knob.name
+        # controller: rendered into worker env through the one shared
+        # serializer (env names asserted against the worker above)
+        assert "job.integrity.to_env()" in controller_src
+        # manifests: the CRD schema names the spec field
+        assert f'"{knob.metadata["spec_field"]}"' in manifests_src, \
+            knob.name
+    # train() consumes the knobs by their canonical kwarg names
+    for kwarg in ("integrity", "integrity_spike_z", "integrity_window",
+                  "integrity_check_every"):
+        assert kwarg in train_params, kwarg
+
+    # spec wire round-trip: to_dict → from_manifest → identical spec,
+    # and the controller env render matches the declared names
+    ispec = IntegritySpec(enabled=True, spike_z=6.0, window_steps=16,
+                          check_every_steps=5)
+    manifest = {
+        "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": "t", "namespace": "ns"},
+        "spec": {"replicaSpecs": {"TPU": {
+            "tpuTopology": "v5e-8",
+            "template": {"spec": {"containers": [{"name": "c"}]}}}},
+            "integrity": ispec.to_dict()},
+    }
+    job = TrainingJob.from_manifest(manifest)
+    assert job.integrity == ispec
+    assert job.to_manifest()["spec"]["integrity"] == ispec.to_dict()
+    assert ispec.to_env() == {
+        "KFTPU_INTEGRITY": "1", "KFTPU_INTEGRITY_SPIKE_Z": "6.0",
+        "KFTPU_INTEGRITY_WINDOW": "16",
+        "KFTPU_INTEGRITY_CHECK_EVERY": "5"}
+
+    # admission rejects garbage (a typo'd knob must fail at apply), and
+    # tuning knobs without enabled: true are a hard error, not a silent
+    # unarmed sentinel
+    with pytest.raises(ValueError, match="spikeZ"):
+        IntegritySpec.from_dict({"enabled": True, "spikeZ": 0})
+    with pytest.raises(ValueError, match="unknown"):
+        IntegritySpec.from_dict({"spike_z": 4.0})
+    with pytest.raises(ValueError, match="mapping"):
+        IntegritySpec.from_dict([True])   # YAML list typo
+    with pytest.raises(ValueError, match="enabled"):
+        IntegritySpec.from_dict({"windowSteps": 8})
+
+    # example builder renders the block end to end
+    ex = next(o for o in tpu_job_simple(
+        integrity=True, integrity_spike_z=6.0,
+        integrity_window_steps=16, integrity_check_every_steps=5)
+        if o["kind"] == "TPUJob")
+    assert ex["spec"]["integrity"] == ispec.to_dict()
+    assert TrainingJob.from_manifest(ex).integrity == ispec
+
+
+def test_anomaly_event_literals_defined_once_and_shared():
+    """The sentinel's event vocabulary must have ONE definition each —
+    the badput-categories rule applied to ISSUE 17: the ``anomaly``
+    span literal lives in obs/goodput.py (SPAN_ANOMALY) and the
+    ``numeric-anomaly`` health-event literal in scheduler/health.py
+    (EVENT_NUMERIC_ANOMALY); every emitter/consumer imports the name.
+    A re-spelled literal would silently decouple the worker's trip
+    from the ledger's rollback_recompute split or the host blame."""
+    import subprocess
+
+    from kubeflow_tpu.obs.goodput import SPAN_ANOMALY
+    from kubeflow_tpu.scheduler import health
+
+    assert SPAN_ANOMALY == "anomaly"
+    assert health.EVENT_NUMERIC_ANOMALY == "numeric-anomaly"
+
+    pkg = os.path.join(REPO_ROOT, "kubeflow_tpu")
+
+    def griep(pattern):
+        hits = subprocess.run(
+            ["grep", "-rl", "--include=*.py", pattern, pkg],
+            capture_output=True, text=True).stdout.split()
+        return sorted(os.path.relpath(h, pkg) for h in hits)
+
+    # single definition sites (assignment form, not mere mention)
+    assert griep("SPAN_ANOMALY = ") == [os.path.join("obs", "goodput.py")]
+    assert griep("EVENT_NUMERIC_ANOMALY = ") == \
+        [os.path.join("scheduler", "health.py")]
+    assert griep('"numeric-anomaly"') == \
+        [os.path.join("scheduler", "health.py")]
+    # no emitter re-spells the span name into the tracer
+    assert griep('event("anomaly"') == []
+
+    def src(*rel):
+        with open(os.path.join(REPO_ROOT, "kubeflow_tpu", *rel)) as f:
+            return f.read()
+
+    # consumers import the shared names
+    assert "SPAN_ANOMALY" in src("runtime", "worker.py")
+    assert "SPAN_ANOMALY" in src("webapps", "dashboard.py")
+    assert "EVENT_NUMERIC_ANOMALY" in src("controllers", "tpujob.py")
+    # and the ledger's rollback split keys off the shared span name
+    assert "SPAN_ANOMALY" in src("obs", "goodput.py")
 
 
 class TestChecker:

@@ -1,0 +1,677 @@
+"""Numeric-integrity sentinel tests (ISSUE 17).
+
+Tiered like the health/chaos suites:
+- pure-core: the detector bank (NaN/Inf, rolling z-score arming rules,
+  cross-replica agreement naming the replica), the evidence wire
+  format, the replay-range contract, the chaos fault hook — no cluster,
+  no jax compute;
+- checkpoint: LKG tagging monotonicity, retention that counts only
+  INTACT steps and never evicts the LKG, rollback discard, and the
+  max_step-capped restore walk — tiny raw numpy pytrees;
+- control-plane: the operator's anomaly rollback over FakeCluster
+  (directive annotation, budget exhaustion, replay arming on the
+  second same-LKG trip, suspect host blame, the rendered worker env)
+  plus the heartbeat numeric canary;
+- ledger: the rollback_recompute split in obs/goodput.py decompose;
+- soak (slow): the worker-level trip drill; the full SentinelSoak
+  scenarios ride tests/test_chaos.py and bench.py --mode sentinel.
+"""
+
+import dataclasses
+import json
+import math
+import time
+
+import pytest
+
+from kubeflow_tpu.api import k8s
+from kubeflow_tpu.api.trainingjob import (ANOMALY_ANNOTATION,
+                                          ANOMALY_COUNT_ANNOTATION,
+                                          ANOMALY_ROLLBACK_ANNOTATION,
+                                          HEARTBEAT_ANNOTATION,
+                                          SUSPECT_ANNOTATION)
+from kubeflow_tpu.cluster.fake import FakeCluster
+from kubeflow_tpu.controllers.runtime import Manager
+from kubeflow_tpu.controllers.tpujob import (RESTART_COUNT_ANNOTATION,
+                                             TrainingJobReconciler)
+from kubeflow_tpu.runtime import sentinel as S
+from kubeflow_tpu.scheduler import health as H
+from kubeflow_tpu.scheduler.core import SliceScheduler
+from kubeflow_tpu.scheduler.queue import SchedulerConfig
+
+pytestmark = pytest.mark.sentinel
+
+
+# ------------------------------------------------------------ detectors
+
+
+class TestDetectors:
+    def test_nan_loss_trips_immediately(self):
+        s = S.NumericSentinel()
+        ev = s.observe(3, loss=float("nan"), lkg=2)
+        assert ev is not None and ev.kind == S.KIND_NAN_LOSS
+        assert ev.step == 3 and ev.lkg == 2 and math.isnan(ev.value)
+
+    def test_inf_grad_trips_before_loss(self):
+        s = S.NumericSentinel()
+        ev = s.observe(1, loss=1.0, grad_norm=float("inf"))
+        assert ev is not None and ev.kind == S.KIND_NAN_GRAD
+        assert math.isinf(ev.value)
+
+    def test_spike_arms_only_after_window_fills(self):
+        # the first window_steps samples SET the baseline: a huge value
+        # inside the warmup must not trip (a fresh model's loss cliff)
+        s = S.NumericSentinel(spike_z=3.0, window_steps=4)
+        assert s.observe(1, loss=1.0) is None
+        assert s.observe(2, loss=50.0) is None     # warmup: no trip
+        s2 = S.NumericSentinel(spike_z=3.0, window_steps=4)
+        for step, loss in enumerate((1.0, 1.1, 0.9, 1.05), start=1):
+            assert s2.observe(step, loss=loss) is None
+        ev = s2.observe(5, loss=50.0, lkg=4)       # armed: trips
+        assert ev is not None and ev.kind == S.KIND_LOSS_SPIKE
+        assert ev.lkg == 4 and ev.detail["z"] > 3.0
+
+    def test_descending_loss_never_trips(self):
+        # a healthy converging curve reads as NEGATIVE z: zero
+        # false-positive budget on the happy path
+        s = S.NumericSentinel(spike_z=2.0, window_steps=8)
+        for step in range(1, 41):
+            loss = 10.0 / (1.0 + 0.1 * step)
+            assert s.observe(step, loss=loss) is None, step
+
+    def test_tripping_sample_never_launders_the_baseline(self):
+        # stats update only on ACCEPTED samples: the same spike value
+        # must trip again on the next window, not absorb into the mean
+        s = S.NumericSentinel(spike_z=3.0, window_steps=4)
+        for step, loss in enumerate((1.0, 1.1, 0.9, 1.05), start=1):
+            s.observe(step, loss=loss)
+        assert s.observe(5, loss=50.0) is not None
+        assert s.observe(6, loss=50.0) is not None
+        assert s.trips == 2
+
+    def test_replica_skew_names_the_replica(self):
+        s = S.NumericSentinel()
+        ev = s.observe(7, replica_sqnorms=[1.0, 1.0, 1.002, 1.0], lkg=4)
+        assert ev is not None and ev.kind == S.KIND_REPLICA_SKEW
+        assert ev.detail["replica"] == 2 and ev.lkg == 4
+        # a NaN replica is named too (the comparison can't rank it)
+        ev = S.NumericSentinel().observe(
+            7, replica_sqnorms=[1.0, float("nan")])
+        assert ev is not None and ev.detail["replica"] == 1
+
+    def test_agreement_tolerance_absorbs_reduce_order(self):
+        s = S.NumericSentinel()
+        # sub-rtol jitter (nondeterministic reduce order) and a single
+        # replica (nothing to compare) both stay silent
+        assert s.observe(1, replica_sqnorms=[1.0, 1.0 + 1e-7]) is None
+        assert s.observe(2, replica_sqnorms=[1.0]) is None
+
+    def test_parse_replay_range(self):
+        assert S.parse_replay_range("4:6") == (4, 6)
+        for bad in (None, "", "garbage", "6:4", "4:4", "-1:2", "a:b"):
+            assert S.parse_replay_range(bad) is None, bad
+
+    def test_evidence_wire_round_trip_carries_nan(self):
+        ev = S.AnomalyEvidence(kind=S.KIND_NAN_LOSS, step=12,
+                               value=float("nan"), lkg=8,
+                               detail={"z": 9.1})
+        raw = ev.to_json()
+        json.loads(raw)                      # strict-JSON parseable
+        back = S.AnomalyEvidence.from_json(raw)
+        assert back is not None and math.isnan(back.value)
+        assert (back.kind, back.step, back.lkg) == (ev.kind, 12, 8)
+        assert back.detail == {"z": 9.1}
+
+    def test_evidence_from_json_degrades_on_garbage(self):
+        # a malformed annotation must read as "no evidence", never
+        # crash the operator's reconcile loop
+        for raw in ("not json", "{}", json.dumps({"kind": "x"}),
+                    json.dumps({"step": "NaN", "kind": "x"})):
+            assert S.AnomalyEvidence.from_json(raw) is None, raw
+
+    def test_sentinel_rejects_degenerate_config(self):
+        with pytest.raises(ValueError, match="spike_z"):
+            S.NumericSentinel(spike_z=0)
+        with pytest.raises(ValueError, match="window_steps"):
+            S.NumericSentinel(window_steps=1)
+
+
+# ------------------------------------------------------ chaos fault hook
+
+
+class TestNumericFaultHook:
+    def test_from_env_contract(self, tmp_path):
+        assert S.NumericFaultHook.from_env(env={}) is None
+        with pytest.raises(ValueError, match="kind:step"):
+            S.NumericFaultHook.from_env(env={S.NUMERIC_FAULT_ENV: "nan"})
+        hook = S.NumericFaultHook.from_env(env={
+            S.NUMERIC_FAULT_ENV: "spike:7:16.0",
+            S.NUMERIC_FAULT_MARK_ENV: str(tmp_path / "mark"),
+            S.NUMERIC_FAULT_FIRES_ENV: "2"})
+        assert (hook.kind, hook.at_step, hook.scale,
+                hook.max_fires) == ("spike", 7, 16.0, 2)
+        with pytest.raises(ValueError, match="unknown numeric fault"):
+            S.NumericFaultHook("rowhammer", 1, 1.0, None)
+
+    def test_fire_budget_persists_across_processes(self, tmp_path):
+        # the mark file is the whole point: a rollback-restarted segment
+        # must not re-poison itself forever
+        mark = str(tmp_path / "mark")
+        hook = S.NumericFaultHook("nan", 5, float("nan"), mark,
+                                  max_fires=2)
+        assert not hook.should_fire(4)
+        assert hook.should_fire(5)
+        hook._record_fire()
+        assert hook.should_fire(5)           # 1 < max_fires=2
+        hook._record_fire()
+        assert not hook.should_fire(5)       # budget spent
+        fresh = S.NumericFaultHook("nan", 5, float("nan"), mark,
+                                   max_fires=2)
+        assert not fresh.should_fire(5)      # ...and it persisted
+
+    @pytest.mark.compute
+    def test_poison_corrupts_params_at_armed_step_only(self, tmp_path):
+        import jax.numpy as jnp
+
+        @dataclasses.dataclass
+        class _State:
+            params: dict
+
+        state = _State(params={"w": jnp.ones((4,))})
+        hook = S.NumericFaultHook("nan", 3, float("nan"),
+                                  str(tmp_path / "mark"))
+        assert hook.poison(state, 2) is state          # not armed
+        out = hook.poison(state, 3)
+        assert bool(jnp.isnan(out.params["w"]).all())
+        assert hook.poison(state, 3) is state          # budget spent
+        spiked = S.NumericFaultHook("spike", 1, 8.0, None).poison(
+            _State(params={"w": jnp.ones((4,))}), 1)
+        assert float(spiked.params["w"][0]) == pytest.approx(8.0)
+
+
+# ------------------------------------------------- checkpoint LKG tier
+
+
+class TestCheckpointLKG:
+    def _mgr(self, directory, steps=(1, 2, 3), max_to_keep=3):
+        import numpy as np
+        from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+        m = CheckpointManager(str(directory), max_to_keep=max_to_keep,
+                              save_interval_steps=1,
+                              retry_backoff_s=0.01)
+        for step in steps:
+            m.save(step, {"params": {"w": np.full((64,), float(step))}},
+                   force=True)
+        m.wait()
+        return m, np
+
+    def test_lkg_tag_is_monotonic_and_outlives_the_manager(self, tmp_path):
+        from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+        m, _ = self._mgr(tmp_path, steps=(1, 2))
+        try:
+            assert m.lkg_step() is None
+            m.tag_lkg(1)
+            assert m.lkg_step() == 1
+            m.tag_lkg(2)
+            m.tag_lkg(1)                    # stale tag never regresses
+            assert m.lkg_step() == 2
+        finally:
+            m.close()
+        m2 = CheckpointManager(str(tmp_path))
+        try:
+            assert m2.lkg_step() == 2       # a restarted worker reads it
+        finally:
+            m2.close()
+
+    def test_retention_never_evicts_the_lkg(self, tmp_path):
+        import numpy as np
+        m, _ = self._mgr(tmp_path, steps=(1,), max_to_keep=2)
+        try:
+            m.tag_lkg(1)
+            for step in (2, 3, 4, 5):
+                m.save(step, {"params": {"w": np.full((64,),
+                                                      float(step))}},
+                       force=True)
+            m.wait()
+            # keep-last-2 newest + the LKG, which costs no slot
+            assert m.all_steps() == [1, 4, 5]
+            ok, reason = m.verify_step(1)
+            assert ok, reason
+        finally:
+            m.close()
+
+    def test_truncated_newest_cannot_evict_the_last_restorable(
+            self, tmp_path):
+        # satellite (b): retention counts only INTACT committed steps —
+        # with keep-last-1, a truncated newest must not let the prior
+        # (only restorable) step be GC'd, and restore falls back to it
+        import numpy as np
+        from kubeflow_tpu.cluster.chaos import truncate_checkpoint_payload
+        m, _ = self._mgr(tmp_path, steps=(1,), max_to_keep=1)
+        try:
+            m.tag_lkg(1)
+            m.save(2, {"params": {"w": np.full((64,), 2.0)}}, force=True)
+            m.wait()
+            truncate_checkpoint_payload(str(tmp_path / "2"))
+            assert m.latest_step() == 1
+            assert m.restore_params()["w"][0] == 1.0
+            # a later save retains over the corrupt step without
+            # touching it (it may be an in-flight writer) or the LKG
+            m.save(3, {"params": {"w": np.full((64,), 3.0)}}, force=True)
+            m.wait()
+            assert m.all_steps() == [1, 2, 3]
+            assert m.latest_step() == 3
+        finally:
+            m.close()
+
+    def test_discard_steps_after_clears_tainted_remains(self, tmp_path):
+        m, _ = self._mgr(tmp_path, steps=(1, 2, 3))
+        try:
+            m.discard_steps_after(1)
+            assert m.all_steps() == [1]
+            assert m.restore_params()["w"][0] == 1.0
+        finally:
+            m.close()
+
+    def test_restore_walk_capped_at_lkg_falls_back_past_corrupt(
+            self, tmp_path):
+        # the anomaly-rollback restore: newest intact step <= LKG, and
+        # if the capped step itself is corrupt the walk keeps falling
+        from kubeflow_tpu.cluster.chaos import truncate_checkpoint_payload
+        from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+        m, _ = self._mgr(tmp_path, steps=(1, 2, 3))
+        try:
+            assert m._restore_with_fallback(lambda s: s, None,
+                                            max_step=2) == 2
+        finally:
+            m.close()
+        truncate_checkpoint_payload(str(tmp_path / "2"))
+        # the rollback restore runs in the RESTARTED worker: a fresh
+        # manager (fresh verify cache) must reject the corrupt LKG and
+        # keep walking down
+        m2 = CheckpointManager(str(tmp_path))
+        try:
+            assert m2._restore_with_fallback(lambda s: s, None,
+                                             max_step=2) == 1
+        finally:
+            m2.close()
+
+
+# ------------------------------------------------------- control plane
+
+
+def tpujob(name="job", ckpt="/ckpt/job", max_rollbacks=None,
+           integrity=None):
+    spec = {
+        "replicaSpecs": {"TPU": {
+            "tpuTopology": "v5e-8",
+            "template": {"spec": {"containers": [
+                {"name": "jax", "image": "trainer:v1"}]}}}},
+        "schedulingPolicy": {"queue": "research", "priority": 0,
+                             "preemptible": False},
+        "checkpointDir": ckpt,
+    }
+    rp = {"backoffLimit": 6, "restartBackoffSeconds": 0}
+    if max_rollbacks is not None:
+        rp["maxAnomalyRollbacks"] = max_rollbacks
+    spec["runPolicy"] = rp
+    if integrity is not None:
+        spec["integrity"] = integrity
+    return {"apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": name, "namespace": "kubeflow"},
+            "spec": spec}
+
+
+def sched_env():
+    # two pools: a second trip's folded evidence (2 x weight 2.0) can
+    # quarantine the suspect host, and the gang must still have
+    # somewhere to rebind
+    cluster = FakeCluster()
+    cluster.add_tpu_slice_nodes("v5e-8", pool="pool-a")
+    cluster.add_tpu_slice_nodes("v5e-8", pool="pool-b")
+    mgr = Manager(cluster)
+    mgr.add(SliceScheduler(SchedulerConfig()))
+    mgr.add(TrainingJobReconciler("TPUJob"))
+    return cluster, mgr
+
+
+def drive(cluster, mgr, ticks=4):
+    for _ in range(ticks):
+        mgr.run_pending()
+        cluster.tick()
+    mgr.run_pending()
+
+
+def get_job(cluster, name="job"):
+    return cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob", "kubeflow",
+                       name)
+
+
+def pod_env(cluster, name):
+    pod = cluster.get("v1", "Pod", "kubeflow", name)
+    return {e["name"]: e.get("value")
+            for e in pod["spec"]["containers"][0].get("env", [])}
+
+
+def trip(cluster, victim="job-worker-0-1", step=12, lkg=8,
+         kind=S.KIND_NAN_LOSS):
+    """Play the worker's part of the contract: post evidence on our own
+    pod, then die with the anomaly exit (Failed phase)."""
+    ev = S.AnomalyEvidence(kind=kind, step=step, value=float("nan"),
+                           lkg=lkg)
+    cluster.patch("v1", "Pod", "kubeflow", victim,
+                  {"metadata": {"annotations": {
+                      ANOMALY_ANNOTATION: ev.to_json()}}})
+    cluster.fail_pod("kubeflow", victim, "sentinel trip (exit 76)")
+
+
+def stop(mgr):
+    for c in mgr.controllers:
+        c.stop()
+
+
+class TestOperatorRollback:
+    def test_trip_writes_rollback_directive_and_blames_host(self):
+        cluster, mgr = sched_env()
+        cluster.create(tpujob())
+        drive(cluster, mgr)
+        victim = "job-worker-0-1"
+        node = cluster.get("v1", "Pod", "kubeflow",
+                           victim)["spec"]["nodeName"]
+        restarts_before = k8s.annotations_of(get_job(cluster)).get(
+            RESTART_COUNT_ANNOTATION)
+        trip(cluster, victim)
+        op = TrainingJobReconciler("TPUJob")
+        op.reconcile(cluster, ("kubeflow", "job"))
+        job = get_job(cluster)
+        anns = k8s.annotations_of(job)
+        assert anns[ANOMALY_COUNT_ANNOTATION] == "1"
+        d = json.loads(anns[ANOMALY_ROLLBACK_ANNOTATION])
+        assert d == {"lkgStep": 8, "tripStep": 12,
+                     "kind": S.KIND_NAN_LOSS, "count": 1}
+        # rolled back to the LKG via resumeFrom + the directive (NOT a
+        # crash: the gang-restart count is untouched — the budget that
+        # moved is the anomaly one)
+        assert job["spec"]["resumeFrom"] == "/ckpt/job"
+        assert anns.get(RESTART_COUNT_ANNOTATION) == restarts_before
+        # the evidence pod's host carries the blame
+        assert anns[SUSPECT_ANNOTATION] == node
+        rec = H.health_of(cluster.get("v1", "Node", "", node))
+        assert rec["last"] == H.EVENT_NUMERIC_ANOMALY
+        cond = k8s.get_condition(job, "Restarting")
+        assert cond["reason"] == "NumericAnomaly"
+        assert "LKG step 8" in cond["message"]
+        stop(mgr)
+
+    def test_second_trip_same_lkg_arms_replay_and_renders_env(self):
+        cluster, mgr = sched_env()
+        cluster.create(tpujob())
+        drive(cluster, mgr)
+        trip(cluster)
+        drive(cluster, mgr, ticks=6)
+        # the recreated gang resumes pinned to the LKG, no replay yet
+        env = pod_env(cluster, "job-worker-0-0")
+        assert env.get(S.RESUME_STEP_ENV) == "8"
+        assert S.REPLAY_RANGE_ENV not in env
+        # second trip over the SAME lkg: the fault reproduces — arm the
+        # deterministic replay of the suspect range
+        trip(cluster)
+        op = TrainingJobReconciler("TPUJob")
+        op.reconcile(cluster, ("kubeflow", "job"))
+        d = json.loads(k8s.annotations_of(get_job(cluster))[
+            ANOMALY_ROLLBACK_ANNOTATION])
+        assert d["count"] == 2 and d["replay"] == "8:12"
+        drive(cluster, mgr, ticks=6)
+        env = pod_env(cluster, "job-worker-0-0")
+        assert env.get(S.RESUME_STEP_ENV) == "8"
+        assert env.get(S.REPLAY_RANGE_ENV) == "8:12"
+        stop(mgr)
+
+    def test_integrity_spec_rendered_into_worker_env(self):
+        cluster, mgr = sched_env()
+        cluster.create(tpujob(integrity={
+            "enabled": True, "spikeZ": 6.0, "windowSteps": 16,
+            "checkEverySteps": 5}))
+        drive(cluster, mgr)
+        env = pod_env(cluster, "job-worker-0-0")
+        assert env.get("KFTPU_INTEGRITY") == "1"
+        assert env.get("KFTPU_INTEGRITY_SPIKE_Z") == "6.0"
+        assert env.get("KFTPU_INTEGRITY_WINDOW") == "16"
+        assert env.get("KFTPU_INTEGRITY_CHECK_EVERY") == "5"
+        stop(mgr)
+
+    def test_budget_exhaustion_fails_the_job_with_evidence(self):
+        cluster, mgr = sched_env()
+        cluster.create(tpujob(max_rollbacks=1))
+        drive(cluster, mgr)
+        trip(cluster)
+        op = TrainingJobReconciler("TPUJob")
+        op.reconcile(cluster, ("kubeflow", "job"))
+        drive(cluster, mgr, ticks=6)
+        trip(cluster)
+        op.reconcile(cluster, ("kubeflow", "job"))
+        job = get_job(cluster)
+        cond = k8s.get_condition(job, "Failed")
+        assert cond is not None and cond["status"] == "True"
+        assert cond["reason"] == "AnomalyBudgetExceeded"
+        assert "nan-loss at step 12" in cond["message"]
+        # the budget, not the count, is what stopped it
+        assert k8s.annotations_of(job)[ANOMALY_COUNT_ANNOTATION] == "1"
+        stop(mgr)
+
+    def test_directive_cleared_once_chief_passes_the_trip(self):
+        cluster, mgr = sched_env()
+        cluster.create(tpujob())
+        drive(cluster, mgr)
+        trip(cluster)
+        op = TrainingJobReconciler("TPUJob")
+        op.reconcile(cluster, ("kubeflow", "job"))
+        drive(cluster, mgr, ticks=6)
+
+        def beat(step):
+            cluster.patch(
+                "v1", "Pod", "kubeflow", "job-worker-0-0",
+                {"metadata": {"annotations": {HEARTBEAT_ANNOTATION:
+                    json.dumps({"step": step, "time": time.time()})}}})
+
+        # still replaying the suspect range: the directive stays
+        beat(10)
+        op.reconcile(cluster, ("kubeflow", "job"))
+        anns = k8s.annotations_of(get_job(cluster))
+        assert ANOMALY_ROLLBACK_ANNOTATION in anns
+        # past the trip step: the range re-ran clean — consume it so
+        # future restarts resume from the NEWEST checkpoint again
+        beat(13)
+        op.reconcile(cluster, ("kubeflow", "job"))
+        anns = k8s.annotations_of(get_job(cluster))
+        assert not anns.get(ANOMALY_ROLLBACK_ANNOTATION)
+        # ...but the consumed-rollback count survives for the budget
+        assert anns[ANOMALY_COUNT_ANNOTATION] == "1"
+        stop(mgr)
+
+    def test_malformed_evidence_degrades_to_crash_restart(self):
+        cluster, mgr = sched_env()
+        cluster.create(tpujob())
+        drive(cluster, mgr)
+        cluster.patch("v1", "Pod", "kubeflow", "job-worker-0-1",
+                      {"metadata": {"annotations": {
+                          ANOMALY_ANNOTATION: "not json"}}})
+        cluster.fail_pod("kubeflow", "job-worker-0-1", "crash")
+        op = TrainingJobReconciler("TPUJob")
+        op.reconcile(cluster, ("kubeflow", "job"))
+        anns = k8s.annotations_of(get_job(cluster))
+        # no rollback directive, no anomaly budget spend — the ordinary
+        # gang-restart path (which DOES count) handled it
+        assert ANOMALY_ROLLBACK_ANNOTATION not in anns
+        assert ANOMALY_COUNT_ANNOTATION not in anns
+        assert anns.get(RESTART_COUNT_ANNOTATION) == "1"
+        stop(mgr)
+
+
+class TestHeartbeatCanary:
+    def _running(self):
+        cluster, mgr = sched_env()
+        cluster.create(tpujob())
+        drive(cluster, mgr)
+        return cluster, mgr
+
+    def _beat(self, cluster, pod, step, t=None, **extra):
+        body = {"step": step, "time": time.time() if t is None else t}
+        body.update(extra)
+        cluster.patch("v1", "Pod", "kubeflow", pod,
+                      {"metadata": {"annotations": {
+                          HEARTBEAT_ANNOTATION: json.dumps(body)}}})
+
+    def test_nan_heartbeat_flags_host_even_without_sentinel(self):
+        # satellite (a): lastLoss rides the liveness beat, so the
+        # operator flags a NaN-emitting worker with spec.integrity OFF
+        cluster, mgr = self._running()
+        node = cluster.get("v1", "Pod", "kubeflow",
+                           "job-worker-0-0")["spec"]["nodeName"]
+        op = TrainingJobReconciler("TPUJob")
+        self._beat(cluster, "job-worker-0-0", 7, lastLoss="nan")
+        op.reconcile(cluster, ("kubeflow", "job"))
+        rec = H.health_of(cluster.get("v1", "Node", "", node))
+        assert rec["events"] == 1
+        assert rec["last"] == H.EVENT_NUMERIC_ANOMALY
+        # same beat re-observed: deduped, no double-charge
+        op.reconcile(cluster, ("kubeflow", "job"))
+        rec = H.health_of(cluster.get("v1", "Node", "", node))
+        assert rec["events"] == 1
+        # a NEW step still reporting garbage is new evidence
+        self._beat(cluster, "job-worker-0-0", 8, lastGradNorm="inf")
+        op.reconcile(cluster, ("kubeflow", "job"))
+        rec = H.health_of(cluster.get("v1", "Node", "", node))
+        assert rec["events"] == 2
+        stop(mgr)
+
+    def test_stale_or_finite_beats_never_flag(self):
+        cluster, mgr = self._running()
+        op = TrainingJobReconciler("TPUJob")
+        node1 = cluster.get("v1", "Pod", "kubeflow",
+                            "job-worker-0-1")["spec"]["nodeName"]
+        # a stale NaN beat is not evidence (the worker may be long gone)
+        self._beat(cluster, "job-worker-0-1", 5,
+                   t=time.time() - 10_000, lastLoss="nan")
+        # a fresh FINITE beat is the healthy path
+        self._beat(cluster, "job-worker-0-0", 5, lastLoss="2.25",
+                   lastGradNorm="0.5")
+        op.reconcile(cluster, ("kubeflow", "job"))
+        rec = H.health_of(cluster.get("v1", "Node", "", node1))
+        assert rec["events"] == 0
+        stop(mgr)
+
+
+class TestHeartbeatReporterPayload:
+    def test_beat_carries_repr_floats_and_annotate_posts_evidence(self):
+        from kubeflow_tpu.runtime.metrics import HeartbeatReporter
+        cluster = FakeCluster()
+        cluster.create(k8s.make("v1", "Pod", "w0", namespace="kubeflow"))
+        hr = HeartbeatReporter(cluster, "kubeflow", "w0", interval_s=0)
+        assert hr.beat(7, force=True, loss=float("nan"), grad_norm=2.0)
+        raw = k8s.annotations_of(cluster.get(
+            "v1", "Pod", "kubeflow", "w0"))[HEARTBEAT_ANNOTATION]
+        body = json.loads(raw)           # strict JSON: NaN is a string
+        assert body["step"] == 7
+        assert math.isnan(float(body["lastLoss"]))
+        assert float(body["lastGradNorm"]) == 2.0
+        ev = S.AnomalyEvidence(S.KIND_NAN_LOSS, 7, float("nan"), lkg=4)
+        assert hr.annotate(ANOMALY_ANNOTATION, ev.to_json())
+        posted = k8s.annotations_of(cluster.get(
+            "v1", "Pod", "kubeflow", "w0"))[ANOMALY_ANNOTATION]
+        assert S.AnomalyEvidence.from_json(posted).lkg == 4
+
+
+# ------------------------------------------------------- goodput ledger
+
+
+class TestRollbackLedger:
+    def _span(self, name, start, end=None, **attrs):
+        rec = {"trace_id": "t", "span_id": "s", "parent_id": "",
+               "name": name, "component": "test", "start": float(start),
+               "end": float(end if end is not None else start)}
+        if attrs:
+            rec["attrs"] = attrs
+        return rec
+
+    def test_replay_after_anomaly_is_rollback_recompute(self):
+        from kubeflow_tpu.obs import goodput as gp
+        led = gp.decompose([
+            self._span("window", 0.0, 6.0, step=6, steps=6),
+            self._span(gp.SPAN_ANOMALY, 6.5, step=6, lkg=4),
+            # rolled back to 4: steps 5,6 replay, then new ground 7,8
+            self._span("window", 10.0, 12.0, step=6, steps=2),
+            self._span("window", 12.0, 14.0, step=8, steps=2),
+        ])
+        assert led["stepsRolledBack"] == 2
+        assert led["badputSeconds"][gp.BADPUT_ROLLBACK] == \
+            pytest.approx(2.0)
+        assert led["badputSeconds"][gp.BADPUT_RECOMPUTE] == \
+            pytest.approx(0.0)
+        assert led["goodputSeconds"] == pytest.approx(8.0)
+        assert gp.categories_sum_ok(led)
+
+    def test_replay_before_anomaly_stays_restart_recompute(self):
+        # only windows AFTER the anomaly span are the sentinel's bill —
+        # an ordinary crash replay earlier in the stream keeps its
+        # restart_recompute attribution
+        from kubeflow_tpu.obs import goodput as gp
+        led = gp.decompose([
+            self._span("window", 0.0, 6.0, step=6, steps=6),
+            self._span("window", 8.0, 10.0, step=6, steps=2),
+            self._span(gp.SPAN_ANOMALY, 20.0, step=6, lkg=4),
+        ])
+        assert led["stepsRolledBack"] == 0
+        assert led["badputSeconds"][gp.BADPUT_ROLLBACK] == \
+            pytest.approx(0.0)
+        assert led["badputSeconds"][gp.BADPUT_RECOMPUTE] == \
+            pytest.approx(2.0)
+        assert gp.categories_sum_ok(led)
+
+    def test_garbage_anomaly_span_ignored(self):
+        from kubeflow_tpu.obs import goodput as gp
+        led = gp.decompose([
+            self._span("window", 0.0, 4.0, step=4, steps=4),
+            self._span(gp.SPAN_ANOMALY, 4.5),            # no attrs
+            self._span(gp.SPAN_ANOMALY, 4.6, step=2, lkg=6),  # inverted
+        ])
+        assert led["stepsRolledBack"] == 0
+        assert gp.categories_sum_ok(led)
+
+
+# --------------------------------------------------- worker trip (slow)
+
+
+@pytest.mark.slow
+@pytest.mark.compute
+class TestWorkerTrip:
+    def test_trip_exits_with_evidence_and_untainted_lkg(
+            self, tmp_path, monkeypatch):
+        """The worker-level acceptance drill: poison after step 5, the
+        sentinel trips when the damage surfaces at step 6, the evidence
+        names the LKG (step 4 — cleared by the window AFTER it), and no
+        tainted checkpoint was committed past it."""
+        from kubeflow_tpu.runtime.checkpoint import CheckpointManager
+        from kubeflow_tpu.runtime.worker import train
+        monkeypatch.setenv(S.NUMERIC_FAULT_ENV, "nan:5")
+        monkeypatch.setenv(S.NUMERIC_FAULT_MARK_ENV,
+                           str(tmp_path / "mark"))
+        ckpt = str(tmp_path / "ckpt")
+        res = train(workload="transformer", steps=16, global_batch=8,
+                    sync_every=1, checkpoint_dir=ckpt,
+                    checkpoint_every=2, seed=0, handle_sigterm=False,
+                    integrity=True, integrity_check_every=1,
+                    integrity_window=4)
+        assert res.anomaly is not None
+        # NaN params poison loss AND grads; the grad-norm check runs
+        # first in the bank, so that's the kind that names the trip
+        assert res.anomaly["kind"] in (S.KIND_NAN_GRAD, S.KIND_NAN_LOSS)
+        assert res.anomaly["step"] == 6 and res.anomaly["lkg"] == 4
+        m = CheckpointManager(ckpt)
+        try:
+            assert m.lkg_step() == 4
+            # the trip aborted BEFORE the step-6 save: nothing newer
+            # than the LKG was committed
+            assert max(m.all_steps()) <= 4
+        finally:
+            m.close()

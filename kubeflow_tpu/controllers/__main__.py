@@ -45,8 +45,11 @@ def _register_defaults() -> None:
     from ..scheduler.core import SliceScheduler
     from .application import ApplicationReconciler
 
+    from .autoscaler import ServingFleetReconciler
+
     CONTROLLER_FACTORIES["application"] = ApplicationReconciler
     CONTROLLER_FACTORIES["scheduler"] = SliceScheduler
+    CONTROLLER_FACTORIES["autoscaler"] = ServingFleetReconciler
     CONTROLLER_FACTORIES["notebook"] = NotebookReconciler
     CONTROLLER_FACTORIES["profile"] = ProfileReconciler
     CONTROLLER_FACTORIES["statefulset"] = StatefulSetReconciler

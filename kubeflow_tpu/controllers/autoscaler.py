@@ -1,0 +1,476 @@
+"""Metrics-driven serving autoscaler (ISSUE 18).
+
+PR 11 built the signals (`/healthz?verbose=1` + `/metrics`: queue
+depth, oldest-waiting age, SLO burn rates), PR 9 built the warm pods
+(~1 s first inference off the AOT/compile-cache ladder), PR 12 built
+the actuators (fleet ``add_replica`` for scale-up, graceful drain for
+zero-loss scale-down) — but nothing consumed the signals: replica
+count was static configuration. This module closes the loop, the
+"plan scaling actions on measured signals" pattern from the dynamic
+MPI-scheduling line of work (PAPERS.md):
+
+- **AutoscalerPolicy** — the pure decision core (clock injected, no
+  I/O): asymmetric hysteresis. Scale-UP is fast — one poll over the
+  burn-rate / queue-depth / oldest-wait thresholds is a paying user
+  waiting, act now. Scale-DOWN is slow — the whole fleet must be
+  *sustainedly* idle (``idleDownSeconds``) before a replica is
+  drained; a momentary lull must not shed capacity a burst will want
+  back. A shared ``cooldownSeconds`` after ANY scale event means the
+  policy can never flap against the drain it just started.
+- **FleetAutoscaler** — the live control loop over a FleetRouter:
+  polls every replica's verbose healthz, feeds the policy, scales up
+  by launching onto a warm pod + ``router.add_replica`` and down by
+  graceful drain (`POST /drain`, zero-loss asserted by the bench)
+  then ``router.remove_replica``. ``bench.py --mode autoscaler``
+  drives it.
+- **ServingFleetReconciler** — the controller-manager face: reconciles
+  ``ServingFleet`` objects (rendered by ``manifests/serving.py``
+  ``tpu_serving(autoscale=True)``), registered as ``autoscaler`` in
+  ``controllers/__main__.py`` so it runs under the PR 14
+  leader-election/fencing machinery like every other controller.
+
+Every scale event lands on the trace (component ``autoscaler``, the
+KFTPU_SPAN_PATH contract) and in the ``kftpu_autoscaler_*`` gauges.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import urllib.request
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..obs import registry as obsreg
+from ..obs import trace as obstrace
+from .runtime import Key, Reconciler, Result, status_snapshot
+
+log = logging.getLogger(__name__)
+
+SERVING_FLEET_KIND = "ServingFleet"
+SERVING_FLEET_API_VERSION = "kubeflow.org/v1alpha1"
+
+
+# --------------------------------------------------------------- signals
+
+
+@dataclass
+class ReplicaSignals:
+    """One replica's scaling-relevant slice of the verbose healthz
+    payload (serving/replica_state.py snapshot())."""
+
+    name: str = ""
+    queue_depth: int = 0          # sum over models: waiting, NOT admitted
+    oldest_wait_s: float = 0.0    # max over models
+    inflight: int = 0             # sum over models
+    burn_fast: float = 0.0        # max 60s-window burn (latency|availability)
+    draining: bool = False
+
+    @classmethod
+    def from_snapshot(cls, name: str, snap: dict) -> "ReplicaSignals":
+        qdepth = inflight = 0
+        oldest = burn = 0.0
+        for m in snap.get("models", []):
+            qdepth += int(m.get("queueDepth", 0) or 0)
+            inflight += int(m.get("inFlight", 0) or 0)
+            oldest = max(oldest,
+                         float(m.get("oldestWaitSeconds", 0.0) or 0.0))
+            fast = (m.get("burnRates") or {}).get("60s") or {}
+            for v in fast.values():
+                burn = max(burn, float(v or 0.0))
+        return cls(name=name, queue_depth=qdepth, oldest_wait_s=oldest,
+                   inflight=inflight, burn_fast=burn,
+                   draining=bool(snap.get("draining")))
+
+
+def fetch_signals(name: str, base_url: str,
+                  timeout_s: float = 1.0) -> Optional[ReplicaSignals]:
+    """Poll one replica's ``/healthz?verbose=1``; None when
+    unreachable (an unpollable replica is neither pressure nor idle —
+    the policy treats missing data conservatively)."""
+    try:
+        with urllib.request.urlopen(f"{base_url}/healthz?verbose=1",
+                                    timeout=timeout_s) as resp:
+            return ReplicaSignals.from_snapshot(name, json.loads(resp.read()))
+    except Exception:  # noqa: BLE001 — poll failure is a signal, not a crash
+        return None
+
+
+# ---------------------------------------------------------------- config
+
+
+@dataclass
+class AutoscalerConfig:
+    """The knob set the ServingFleet manifest carries
+    (``spec.autoscaler``) and the CLI/reconciler consume. camelCase
+    keys to match the manifest surface; ``from_dict`` fails loudly on
+    typos (the BreakerConfig pattern)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # scale-up triggers (fast path — any one over threshold fires)
+    burn_up_threshold: float = 2.0       # 60s-window SLO burn rate
+    queue_up_threshold: float = 4.0      # mean queue depth per live replica
+    oldest_wait_up_s: float = 0.5        # oldest queued request's age
+    # scale-down trigger (slow path — ALL replicas idle this long)
+    idle_down_s: float = 300.0
+    # flap guard: no second scale event inside this window
+    cooldown_s: float = 60.0
+    poll_interval_s: float = 5.0
+
+    KEYS = ("minReplicas", "maxReplicas", "burnUpThreshold",
+            "queueUpThreshold", "oldestWaitUpSeconds",
+            "idleDownSeconds", "cooldownSeconds", "pollIntervalSeconds")
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "AutoscalerConfig":
+        d = dict(d or {})
+        unknown = set(d) - set(cls.KEYS)
+        if unknown:
+            # a typo'd knob must fail loudly, not silently default
+            raise ValueError(
+                f"unknown autoscaler config keys {sorted(unknown)}; "
+                f"valid: {list(cls.KEYS)}")
+        return cls(
+            min_replicas=int(d.get("minReplicas", 1)),
+            max_replicas=int(d.get("maxReplicas", 4)),
+            burn_up_threshold=float(d.get("burnUpThreshold", 2.0)),
+            queue_up_threshold=float(d.get("queueUpThreshold", 4.0)),
+            oldest_wait_up_s=float(d.get("oldestWaitUpSeconds", 0.5)),
+            idle_down_s=float(d.get("idleDownSeconds", 300.0)),
+            cooldown_s=float(d.get("cooldownSeconds", 60.0)),
+            poll_interval_s=float(d.get("pollIntervalSeconds", 5.0)))
+
+    def to_dict(self) -> dict:
+        return {"minReplicas": self.min_replicas,
+                "maxReplicas": self.max_replicas,
+                "burnUpThreshold": self.burn_up_threshold,
+                "queueUpThreshold": self.queue_up_threshold,
+                "oldestWaitUpSeconds": self.oldest_wait_up_s,
+                "idleDownSeconds": self.idle_down_s,
+                "cooldownSeconds": self.cooldown_s,
+                "pollIntervalSeconds": self.poll_interval_s}
+
+
+# ---------------------------------------------------------------- policy
+
+
+@dataclass
+class Decision:
+    direction: Optional[str]  # "up" | "down" | None
+    reason: str = ""
+
+
+class AutoscalerPolicy:
+    """Pure hysteresis core: feed it signals + a clock, get a
+    direction. Holds only the temporal state hysteresis needs
+    (last-scale time for the cooldown, idle-since for the sustained-
+    idle window); everything else is recomputed from this poll's
+    signals — restart-safe by construction."""
+
+    def __init__(self, config: Optional[AutoscalerConfig] = None):
+        self.config = config or AutoscalerConfig()
+        self._last_scale_t: Optional[float] = None
+        self._idle_since: Optional[float] = None
+
+    def in_cooldown(self, now: float) -> bool:
+        return (self._last_scale_t is not None and
+                now - self._last_scale_t < self.config.cooldown_s)
+
+    def decide(self, signals: list[Optional[ReplicaSignals]],
+               replicas: int, now: float) -> Decision:
+        cfg = self.config
+        live = [s for s in signals if s is not None and not s.draining]
+        qdepth = sum(s.queue_depth for s in live)
+        oldest = max((s.oldest_wait_s for s in live), default=0.0)
+        burn = max((s.burn_fast for s in live), default=0.0)
+        inflight = sum(s.inflight for s in live)
+        mean_q = qdepth / max(1, len(live))
+
+        pressure = []
+        if burn >= cfg.burn_up_threshold:
+            pressure.append(f"burn {burn:.1f}≥{cfg.burn_up_threshold:g}")
+        if mean_q >= cfg.queue_up_threshold:
+            pressure.append(
+                f"queue {mean_q:.1f}≥{cfg.queue_up_threshold:g}/replica")
+        if oldest >= cfg.oldest_wait_up_s:
+            pressure.append(
+                f"oldest wait {oldest:.2f}s≥{cfg.oldest_wait_up_s:g}s")
+
+        if pressure:
+            # fast path: pressure is a user waiting — but never inside
+            # the cooldown (the capacity we just added, or the drain we
+            # just started, has not settled yet)
+            self._idle_since = None
+            if replicas >= cfg.max_replicas:
+                return Decision(None, "pressure but at maxReplicas")
+            if self.in_cooldown(now):
+                return Decision(None, "pressure but in cooldown")
+            self._last_scale_t = now
+            return Decision("up", "; ".join(pressure))
+
+        # unpollable replicas block scale-down: missing data must read
+        # as "unknown load", never as idle capacity to shed
+        all_polled = len(live) == replicas and replicas > 0
+        idle = (all_polled and qdepth == 0 and inflight == 0
+                and burn < 1.0)
+        if not idle:
+            self._idle_since = None
+            return Decision(None, "steady")
+        if self._idle_since is None:
+            self._idle_since = now
+        idle_for = now - self._idle_since
+        if replicas <= cfg.min_replicas:
+            return Decision(None, "idle but at minReplicas")
+        if idle_for < cfg.idle_down_s:
+            return Decision(
+                None, f"idle {idle_for:.0f}s < {cfg.idle_down_s:g}s")
+        if self.in_cooldown(now):
+            return Decision(None, "idle but in cooldown")
+        self._last_scale_t = now
+        # the next scale-down needs a full fresh idle window — one
+        # long lull drains one replica, not the whole fleet at once
+        self._idle_since = now
+        return Decision("down", f"fleet idle {idle_for:.0f}s")
+
+
+# --------------------------------------------------------------- metrics
+
+
+class _AutoscalerMetrics:
+    """kftpu_autoscaler_* on the default registry (the controller
+    manager's /metrics surface), labeled by fleet."""
+
+    def __init__(self):
+        self.replicas = obsreg.gauge(
+            "kftpu_autoscaler_replicas",
+            "current fleet replica count", labels=("fleet",))
+        self.desired = obsreg.gauge(
+            "kftpu_autoscaler_desired_replicas",
+            "replica count the policy wants", labels=("fleet",))
+        self.events = obsreg.counter(
+            "kftpu_autoscaler_scale_events_total",
+            "scale actions taken", labels=("fleet", "direction"))
+        self.cooldown = obsreg.gauge(
+            "kftpu_autoscaler_cooldown_active",
+            "1 while the flap-guard cooldown holds scaling",
+            labels=("fleet",))
+
+    def observe(self, fleet: str, replicas: int, desired: int,
+                cooldown: bool) -> None:
+        self.replicas.labels(fleet=fleet).set(replicas)
+        self.desired.labels(fleet=fleet).set(desired)
+        self.cooldown.labels(fleet=fleet).set(1 if cooldown else 0)
+
+
+def _emit_scale_span(fleet: str, direction: str, replica: str,
+                     reason: str, replicas: int) -> None:
+    """Scale events ride the trace (KFTPU_SPAN_PATH contract) so a
+    latency investigation can line capacity changes up against the
+    request series."""
+    tracer = obstrace.default_tracer("autoscaler")
+    if tracer is None:
+        return
+    now = time.time()
+    tracer.emit(f"autoscale-{direction}", start=now, end=now,
+                trace_id=f"autoscaler-{fleet}", fleet=fleet,
+                replica=replica, reason=reason, replicas=replicas)
+
+
+# --------------------------------------------------- live fleet actuator
+
+
+class FleetAutoscaler:
+    """The closed loop over a live FleetRouter: poll → decide → act.
+
+    ``launcher()`` must return ``(name, base_url)`` for a NEW replica —
+    the warm-pod contract says it comes up with its model already
+    loaded off the AOT/compile-cache ladder (``start_kind`` warm/aot),
+    so its first inference is ~1–2 s away, not a cold XLA compile.
+    ``stopper(name)`` tears a drained replica down. Scale-down is
+    graceful by construction: ``POST /drain`` flushes the in-flight
+    cohort and refuses new work BEFORE the replica leaves the router —
+    the bench asserts the drain report shows zero loss.
+    """
+
+    def __init__(self, router,
+                 launcher: Callable[[], tuple[str, str]],
+                 stopper: Optional[Callable[[str], None]] = None,
+                 config: Optional[AutoscalerConfig] = None,
+                 fleet: str = "fleet",
+                 clock: Callable[[], float] = time.monotonic,
+                 poll_timeout_s: float = 1.0):
+        self.router = router
+        self.launcher = launcher
+        self.stopper = stopper
+        self.fleet = fleet
+        self.clock = clock
+        self.poll_timeout_s = poll_timeout_s
+        self.policy = AutoscalerPolicy(config)
+        self.replicas: dict[str, str] = {}   # name → base_url, add order
+        self.events: list[dict] = []
+        self._metrics = _AutoscalerMetrics()
+
+    def adopt(self, name: str, base_url: str) -> None:
+        """Register an already-running replica (the fleet's seed set)."""
+        self.replicas[name] = base_url
+
+    def step(self, now: Optional[float] = None) -> Decision:
+        """One control iteration; returns the decision for the bench's
+        event accounting."""
+        now = self.clock() if now is None else now
+        signals = [fetch_signals(n, u, timeout_s=self.poll_timeout_s)
+                   for n, u in self.replicas.items()]
+        decision = self.policy.decide(signals, len(self.replicas), now)
+        desired = len(self.replicas) + (
+            1 if decision.direction == "up"
+            else -1 if decision.direction == "down" else 0)
+        self._metrics.observe(self.fleet, len(self.replicas), desired,
+                              self.policy.in_cooldown(now))
+        if decision.direction == "up":
+            self._scale_up(decision, now)
+        elif decision.direction == "down":
+            self._scale_down(decision, now)
+        return decision
+
+    def _scale_up(self, decision: Decision, now: float) -> None:
+        name, url = self.launcher()
+        self.replicas[name] = url
+        self.router.add_replica(name, url)
+        self._record("up", name, decision.reason, now)
+
+    def _scale_down(self, decision: Decision, now: float) -> None:
+        # LIFO victim: the most recently added non-draining replica —
+        # the warm pool keeps its oldest (most-proven) members
+        victim = next((n for n in reversed(list(self.replicas))), None)
+        if victim is None:
+            return
+        url = self.replicas[victim]
+        report = {}
+        try:
+            req = urllib.request.Request(f"{url}/drain", method="POST",
+                                         data=b"")
+            with urllib.request.urlopen(req, timeout=30.0) as resp:
+                report = json.loads(resp.read())
+        except Exception as e:  # noqa: BLE001 — a dead replica is drained
+            log.warning("autoscaler: drain of %s failed: %s", victim, e)
+        self.router.remove_replica(victim)
+        del self.replicas[victim]
+        if self.stopper is not None:
+            self.stopper(victim)
+        self._record("down", victim, decision.reason, now,
+                     drain_report=report)
+
+    def _record(self, direction: str, replica: str, reason: str,
+                now: float, **extra) -> None:
+        self._metrics.events.labels(fleet=self.fleet,
+                                    direction=direction).inc()
+        _emit_scale_span(self.fleet, direction, replica, reason,
+                         len(self.replicas))
+        self.events.append({"direction": direction, "replica": replica,
+                            "reason": reason, "t": now, **extra})
+        log.info("autoscaler[%s]: scale-%s %s (%s) → %d replicas",
+                 self.fleet, direction, replica, reason,
+                 len(self.replicas))
+
+
+# ------------------------------------------------------------ reconciler
+
+
+class ServingFleetReconciler(Reconciler):
+    """Controller-manager face of the autoscaler: level-triggered over
+    ``ServingFleet`` objects. Each object's ``spec.autoscaler`` carries
+    the AutoscalerConfig knobs; ``status.replicas`` is the live
+    endpoint list (seeded from ``spec.endpoints``, then owned by this
+    reconciler as it scales). Runs under the PR 14 leader-election/
+    fencing machinery like every hosted controller — a deposed
+    leader's scale action dies at the fenced client boundary.
+
+    An ``actuator`` (the FleetAutoscaler launcher/stopper pair wrapped
+    as ``scale_up() → {"name","url","startKind"}`` and
+    ``scale_down(name)``) makes decisions real; without one the
+    reconciler is declarative-only — it publishes
+    ``status.desiredReplicas`` + conditions for an external actuator,
+    the HPA-writes-the-scale-subresource shape.
+    """
+
+    primary = (SERVING_FLEET_API_VERSION, SERVING_FLEET_KIND)
+    controller_name = "autoscaler"
+
+    def __init__(self, actuator=None,
+                 poller: Callable[..., Optional[ReplicaSignals]] =
+                 fetch_signals,
+                 clock: Callable[[], float] = time.monotonic):
+        self.actuator = actuator
+        self.poller = poller
+        self.clock = clock
+        # hysteresis state is per object and lives across reconciles
+        self._policies: dict[Key, AutoscalerPolicy] = {}
+        self._metrics = _AutoscalerMetrics()
+
+    def reconcile(self, client, key: Key) -> Result:
+        from ..cluster.client import NotFoundError
+        ns, name = key
+        try:
+            obj = client.get(SERVING_FLEET_API_VERSION,
+                             SERVING_FLEET_KIND, ns, name)
+        except NotFoundError:
+            self._policies.pop(key, None)
+            return Result()
+        spec = obj.get("spec", {}) or {}
+        cfg = AutoscalerConfig.from_dict(spec.get("autoscaler"))
+        policy = self._policies.get(key)
+        if policy is None:
+            policy = self._policies[key] = AutoscalerPolicy(cfg)
+        else:
+            policy.config = cfg  # spec edits apply next decision
+
+        status = dict(obj.get("status", {}) or {})
+        replicas = list(status.get("replicas") or
+                        [{"name": f"{name}-{i}", "url": u}
+                         for i, u in enumerate(spec.get("endpoints") or [])])
+        now = self.clock()
+        signals = [self.poller(r.get("name", ""), r.get("url", ""))
+                   for r in replicas]
+        decision = policy.decide(signals, len(replicas), now)
+
+        desired = len(replicas) + (1 if decision.direction == "up"
+                                   else -1 if decision.direction == "down"
+                                   else 0)
+        desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
+        if self.actuator is not None:
+            if decision.direction == "up":
+                rep = self.actuator.scale_up()
+                replicas.append(rep)
+                self._record(name, "up", rep.get("name", ""),
+                             decision.reason, len(replicas))
+            elif decision.direction == "down" and replicas:
+                victim = replicas[-1]
+                self.actuator.scale_down(victim.get("name", ""))
+                replicas = replicas[:-1]
+                self._record(name, "down", victim.get("name", ""),
+                             decision.reason, len(replicas))
+
+        self._metrics.observe(name, len(replicas), desired,
+                              policy.in_cooldown(now))
+        before = status_snapshot(status)
+        status.update({"replicas": replicas,
+                       "desiredReplicas": desired,
+                       "observedReplicas": len(replicas)})
+        if decision.direction:
+            status["lastScale"] = {"direction": decision.direction,
+                                   "reason": decision.reason}
+        if status_snapshot(status) != before:
+            fresh = client.get(SERVING_FLEET_API_VERSION,
+                               SERVING_FLEET_KIND, ns, name)
+            fresh["status"] = status
+            client.update_status(fresh)
+        return Result(requeue_after=cfg.poll_interval_s)
+
+    def _record(self, fleet: str, direction: str, replica: str,
+                reason: str, replicas: int) -> None:
+        self._metrics.events.labels(fleet=fleet, direction=direction).inc()
+        _emit_scale_span(fleet, direction, replica, reason, replicas)
+        log.info("autoscaler[%s]: scale-%s %s (%s) → %d replicas",
+                 fleet, direction, replica, reason, replicas)

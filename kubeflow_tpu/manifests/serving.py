@@ -31,7 +31,16 @@ def tpu_serving(namespace: str = "kubeflow", name: str = "model-server",
                 slo_p99_ms: float = None,
                 slo_availability: float = None,
                 max_pending: int = 0,
-                drain_timeout_s: float = 10.0) -> list[dict]:
+                drain_timeout_s: float = 10.0,
+                batching: str = "continuous",
+                max_wait_ms: float = None,
+                autoscale: bool = False,
+                autoscale_min: int = 1, autoscale_max: int = 4,
+                autoscale_burn_threshold: float = 2.0,
+                autoscale_queue_threshold: float = 4.0,
+                autoscale_oldest_wait_s: float = 0.5,
+                autoscale_idle_down_s: float = 300.0,
+                autoscale_cooldown_s: float = 60.0) -> list[dict]:
     """``slo_p99_ms`` / ``slo_availability`` declare the model's SLO
     (serving/replica_state.py renders burn-rate gauges on /metrics);
     ``max_pending`` bounds the batcher queue — past it requests shed
@@ -43,13 +52,26 @@ def tpu_serving(namespace: str = "kubeflow", name: str = "model-server",
     kill a gracefully-draining pod), a preStop httpGet /drain hook
     bounded by ``drain_timeout_s``, and — with 2+ replicas — a
     PodDisruptionBudget keeping N-1 available through voluntary
-    disruptions."""
+    disruptions.
+
+    ``batching`` picks the micro-batcher's admission scheduler
+    (ISSUE 18): ``continuous`` (in-flight batching, the default) or
+    ``window`` (the legacy fixed collect window); ``max_wait_ms`` is
+    continuous mode's idle-device coalescing bound. ``autoscale=True``
+    emits a ``ServingFleet`` object carrying the ``autoscale_*``
+    knobs — the ``autoscaler`` controller (controllers/autoscaler.py)
+    reconciles it: scale-up onto warm pods on burn-rate/queue
+    pressure, scale-down by graceful drain after sustained idle,
+    with the cooldown as the flap guard."""
     from .observability import scrape_annotations
     lbl = {**H.std_labels(name), "kubeflow.org/servable": model_name}
     args = [f"--model-path={model_path}", f"--model-name={model_name}",
             "--grpc-port=9000", "--rest-port=8500",
             f"--reload-interval={reload_interval_s}",
-            f"--drain-timeout={drain_timeout_s}"]
+            f"--drain-timeout={drain_timeout_s}",
+            f"--batching={batching}"]
+    if max_wait_ms is not None:
+        args.append(f"--max-wait-ms={max_wait_ms}")
     if slo_p99_ms is not None:
         args.append(f"--slo-p99-ms={slo_p99_ms}")
     if slo_availability is not None:
@@ -141,6 +163,29 @@ def tpu_serving(namespace: str = "kubeflow", name: str = "model-server",
                            "averageUtilization": 80}}}],
         }
         out.append(hpa)
+    if autoscale:
+        # the metrics-driven serving autoscaler (ISSUE 18): unlike the
+        # CPU-utilization HPA above, the ServingFleet scales on the
+        # replica health registry's own signals (queue depth, oldest
+        # wait, SLO burn rate) and actuates warm-pod add / graceful
+        # drain through the autoscaler reconciler. Keys match
+        # controllers/autoscaler.py AutoscalerConfig.KEYS.
+        fleet = k8s.make(KF_API_VERSION_V1ALPHA1, "ServingFleet", name,
+                         namespace, labels=lbl)
+        fleet["spec"] = {
+            "model": model_name,
+            "service": name,
+            "autoscaler": {
+                "minReplicas": autoscale_min,
+                "maxReplicas": autoscale_max,
+                "burnUpThreshold": autoscale_burn_threshold,
+                "queueUpThreshold": autoscale_queue_threshold,
+                "oldestWaitUpSeconds": autoscale_oldest_wait_s,
+                "idleDownSeconds": autoscale_idle_down_s,
+                "cooldownSeconds": autoscale_cooldown_s,
+            },
+        }
+        out.append(fleet)
     return out
 
 

@@ -2,9 +2,23 @@
 
 TF-Serving batches on-device; the reference's HTTP proxy forwards one
 request at a time (http-proxy/server.py). On TPU, per-request dispatch
-wastes the MXU — the batcher coalesces requests that arrive within
-``max_latency_ms`` into a single padded batch, runs one jit call, and
-fans results back out to per-request futures.
+wastes the MXU — the batcher coalesces concurrent requests into a
+single padded batch, runs one jit call, and fans results back out to
+per-request futures. Two admission schedulers (``batching=``):
+
+- ``continuous`` (default, ISSUE 18): in-flight batching. The moment
+  the previous device dispatch returns, the next batch is formed
+  greedily — oldest-first, everything already queued, up to
+  ``max_batch`` — and dispatched immediately; nobody waits for a
+  window edge while the device has work to do. Only when the device
+  was IDLE (the queue was empty when the loop came back) does the
+  first arrival wait, and then at most ``max_wait_ms``, purely as a
+  coalescing bound so a lone request can pick up co-riders.
+- ``window`` (legacy, the PR 11 baseline and the bench A/B arm): the
+  fixed ``max_latency_ms`` collect window — first arrival opens a
+  window, dispatch happens at the window edge or at ``max_batch``.
+  Under load this queues bursts behind the window edge: the measured
+  p99 knee (102→191 ms at 2× load) continuous batching removes.
 
 Observability (ISSUE 11): each work item may carry a RequestTrace
 (serving/request_trace.py) — the batcher stamps its queue wait,
@@ -13,8 +27,12 @@ request's ledger partitions its wall-clock exactly. A bounded queue
 (``max_pending``) sheds load with an explicit QueueFullError (HTTP
 429 / gRPC RESOURCE_EXHAUSTED upstream) instead of growing the queue
 unbounded — the shed request's wait is recorded as ``queue`` badput,
-never dropped from the ledger. Queue depth and oldest-waiting age are
-polled by the replica registry at scrape time (zero hot-path cost).
+never dropped from the ledger, and the error carries a ``Retry-After``
+hint from the measured drain rate. Queue depth and oldest-waiting age
+are polled by the replica registry at scrape time (zero hot-path
+cost); an item leaves both gauges the moment it is admitted to a
+forming cohort — admitted work is device backlog, not queue backlog,
+and the autoscaler scales on the queue gauges (ISSUE 18).
 """
 
 from __future__ import annotations
@@ -33,7 +51,15 @@ import numpy as np
 
 class QueueFullError(RuntimeError):
     """The bounded batcher queue is at max_pending: shed this request
-    (429 / RESOURCE_EXHAUSTED) rather than queue it unbounded."""
+    (429 / RESOURCE_EXHAUSTED) rather than queue it unbounded.
+
+    ``retry_after_s`` is the shed hint the HTTP layer surfaces as a
+    ``Retry-After`` header: current queue depth over the measured
+    dispatch drain rate (EWMA requests/s through the device), clamped
+    to [1, 30] s — "come back when the backlog you were shed behind
+    has drained", not a bare 429 the client can only guess at."""
+
+    retry_after_s: float = 1.0
 
 
 class BatcherClosedError(RuntimeError):
@@ -57,13 +83,30 @@ class _WorkItem:
 class MicroBatcher:
     """Collects requests for one servable and dispatches merged batches."""
 
+    BATCHING_MODES = ("continuous", "window")
+
     def __init__(self, servable, max_batch: int = 64,
-                 max_latency_ms: float = 5.0, max_pending: int = 0):
+                 max_latency_ms: float = 5.0, max_pending: int = 0,
+                 batching: str = "continuous",
+                 max_wait_ms: Optional[float] = None):
+        if batching not in self.BATCHING_MODES:
+            raise ValueError(
+                f"batching must be one of {self.BATCHING_MODES}, "
+                f"got {batching!r}")
         self.servable = servable
         self.max_batch = max_batch
         self.max_latency = max_latency_ms / 1000.0
+        self.batching = batching
+        # continuous mode's idle-device coalescing bound; defaults to
+        # the window knob so one number tunes either scheduler
+        self.max_wait = (max_latency_ms if max_wait_ms is None
+                         else max_wait_ms) / 1000.0
         # 0 = unbounded (the legacy behavior); N = shed at N waiting
         self.max_pending = max(0, int(max_pending))
+        # EWMA of requests/s through the device: the Retry-After hint's
+        # denominator. Written only by the loop thread, read anywhere
+        # (float store is atomic under the GIL).
+        self._drain_rate = 0.0
         self._queue: "queue.Queue[_WorkItem]" = queue.Queue()
         self._stop = threading.Event()
         self._draining = False
@@ -90,6 +133,20 @@ class MicroBatcher:
                 return 0.0
             return max(0.0, time.time() - min(self._waiting.values()))
 
+    def retry_after_s(self) -> float:
+        """The shed hint: seconds until the current backlog drains at
+        the measured dispatch rate, clamped to [1, 30]. 1 s when no
+        rate has been measured yet (cold batcher)."""
+        with self._submit_lock:
+            depth = len(self._waiting)
+        return self._retry_hint(depth)
+
+    def _retry_hint(self, depth: int) -> float:
+        rate = self._drain_rate
+        if rate <= 0.0:
+            return 1.0
+        return min(30.0, max(1.0, depth / rate))
+
     # -------------------------------------------------------------- submit
 
     def submit(self, instances: np.ndarray,
@@ -106,8 +163,10 @@ class MicroBatcher:
                 # flushed, but no new work may land behind it
                 raise BatcherClosedError("batcher is draining")
             if self.max_pending and len(self._waiting) >= self.max_pending:
-                raise QueueFullError(
+                err = QueueFullError(
                     f"batcher queue full ({self.max_pending} pending)")
+                err.retry_after_s = self._retry_hint(len(self._waiting))
+                raise err
             item.t_enqueue = time.time()
             self._waiting[id(item)] = item.t_enqueue
             self._queue.put(item)
@@ -117,12 +176,74 @@ class MicroBatcher:
                 ctx: Optional[object] = None):
         return self.submit(instances, ctx=ctx).result(timeout=timeout)
 
-    def _collect(self) -> list[_WorkItem]:
-        """Block for the first item, then drain what arrives within the
-        latency window (or until the batch is full)."""
+    def _take(self, timeout: Optional[float] = None) -> Optional[_WorkItem]:
+        """Pull one queued item into the forming cohort. Admission is
+        when it leaves the queue GAUGES (scrape-time depth/oldest-age
+        must stop counting it immediately — admitted work is device
+        backlog the autoscaler must not double-count as queue backlog),
+        so ``_waiting`` is popped here, at pull time, not at dispatch.
+        ``timeout=None`` means non-blocking."""
         try:
-            first = self._queue.get(timeout=0.1)
+            item = (self._queue.get_nowait() if timeout is None
+                    else self._queue.get(timeout=timeout))
         except queue.Empty:
+            return None
+        with self._submit_lock:
+            self._waiting.pop(id(item), None)
+        return item
+
+    def _seal(self, items: list[_WorkItem]) -> None:
+        """The cohort is final: close every member's ``queue`` ledger
+        stage at one shared seal instant (enqueue → admission-to-cohort;
+        dispatch starts immediately after, so the ledger still
+        partitions wall-clock exactly — no unattributed gap)."""
+        now = time.time()
+        for it in items:
+            if it.ctx is not None:
+                it.ctx.stage("queue", it.t_enqueue, now)
+
+    def _admit(self) -> list[_WorkItem]:
+        """Continuous (in-flight) admission: greedily form the next
+        batch from whatever is queued RIGHT NOW — the loop re-enters
+        the moment the previous dispatch returned, so under load no
+        request ever waits on a window edge. Only when the device was
+        idle (nothing queued on re-entry) does the first arrival hold
+        for co-riders, bounded by ``max_wait_ms``; a drain skips even
+        that (flush now, nobody new is coming)."""
+        first = self._take()
+        was_idle = first is None
+        if was_idle:
+            first = self._take(timeout=0.1)
+            if first is None:
+                return []
+        items, total = [first], first.instances.shape[0]
+        while total < self.max_batch:
+            nxt = self._take()
+            if nxt is None:
+                break
+            items.append(nxt)
+            total += nxt.instances.shape[0]
+        if was_idle and total < self.max_batch and self.max_wait > 0 \
+                and not self._draining:
+            t0 = time.perf_counter()
+            while total < self.max_batch:
+                remaining = self.max_wait - (time.perf_counter() - t0)
+                if remaining <= 0:
+                    break
+                nxt = self._take(timeout=remaining)
+                if nxt is None:
+                    break
+                items.append(nxt)
+                total += nxt.instances.shape[0]
+        self._seal(items)
+        return items
+
+    def _collect(self) -> list[_WorkItem]:
+        """Fixed-window collect (``batching="window"``, the PR 11
+        baseline): block for the first item, then drain what arrives
+        within the latency window (or until the batch is full)."""
+        first = self._take(timeout=0.1)
+        if first is None:
             return []
         items, total = [first], first.instances.shape[0]
         deadline = self.max_latency
@@ -131,19 +252,12 @@ class MicroBatcher:
             remaining = deadline - (time.perf_counter() - t0)
             if remaining <= 0:
                 break
-            try:
-                nxt = self._queue.get(timeout=remaining)
-            except queue.Empty:
+            nxt = self._take(timeout=remaining)
+            if nxt is None:
                 break
             items.append(nxt)
             total += nxt.instances.shape[0]
-        now = time.time()
-        with self._submit_lock:
-            for it in items:
-                self._waiting.pop(id(it), None)
-        for it in items:
-            if it.ctx is not None:
-                it.ctx.stage("queue", it.t_enqueue, now)
+        self._seal(items)
         return items
 
     def _dispatch(self, items: list[_WorkItem]):
@@ -222,11 +336,13 @@ class MicroBatcher:
 
     def _loop(self):
         while not self._stop.is_set():
-            items = self._collect()
+            items = (self._admit() if self.batching == "continuous"
+                     else self._collect())
             if not items:
                 continue
+            t_d0 = time.perf_counter()
             # Group by trailing shape + dtype: one malformed request must
-            # not poison the other requests coalesced into its window.
+            # not poison the other requests coalesced into its cohort.
             groups: dict[tuple, list[_WorkItem]] = {}
             for it in items:
                 if it.instances.ndim < 1:
@@ -237,6 +353,11 @@ class MicroBatcher:
                 groups.setdefault(key, []).append(it)
             for cohort in groups.values():
                 self._dispatch(cohort)
+            # drain-rate EWMA (requests/s through the device) feeding
+            # the Retry-After shed hint
+            rate = len(items) / max(time.perf_counter() - t_d0, 1e-6)
+            self._drain_rate = rate if self._drain_rate <= 0.0 \
+                else 0.7 * self._drain_rate + 0.3 * rate
 
     def drain(self, timeout_s: float = 10.0) -> dict:
         """Graceful close: stop accepting, flush the pending cohort

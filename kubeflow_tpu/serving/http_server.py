@@ -43,13 +43,20 @@ class ModelServer:
                  max_pending: int = 0, sample_every: int = 16,
                  span_path: Optional[str] = None,
                  slos: Optional[dict] = None,
-                 drain_timeout_s: float = 10.0):
+                 drain_timeout_s: float = 10.0,
+                 batching: str = "continuous",
+                 max_wait_ms: Optional[float] = None):
         self.repository = repository or ModelRepository()
         self.host, self.port = host, port
         self.max_batch = max_batch
         self.max_latency_ms = max_latency_ms
         self.max_pending = max_pending
         self.drain_timeout_s = drain_timeout_s
+        # batcher admission scheduler (ISSUE 18): "continuous" =
+        # in-flight batching; "window" = the fixed-window PR 11
+        # baseline, kept for the bench A/B arm
+        self.batching = batching
+        self.max_wait_ms = max_wait_ms
         self._batchers: dict[str, MicroBatcher] = {}
         self._batchers_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -203,7 +210,9 @@ class ModelServer:
             if b is None:
                 b = MicroBatcher(servable, max_batch=self.max_batch,
                                  max_latency_ms=self.max_latency_ms,
-                                 max_pending=self.max_pending)
+                                 max_pending=self.max_pending,
+                                 batching=self.batching,
+                                 max_wait_ms=self.max_wait_ms)
                 self._batchers[name] = b
                 # queue depth + oldest-age gauges: scrape-time pull
                 self.replica.register_queue(name, b)
@@ -439,10 +448,15 @@ def _make_handler(server: ModelServer):
                         time.perf_counter() - t0)
             except QueueFullError as e:
                 # bounded-queue shed: explicit 429, recorded in the
-                # ledger (all-queue badput), never silently dropped
+                # ledger (all-queue badput), never silently dropped.
+                # Retry-After carries the drain-rate hint (ISSUE 18):
+                # come back when the backlog you were shed behind has
+                # drained, not at the client's blind jitter cadence.
                 if ctx is not None:
                     ctx.finish("shed", error=str(e))
-                self._error(429, f"QueueFullError: {e}", headers=hdr)
+                self._error(429, f"QueueFullError: {e}", headers={
+                    **hdr, "Retry-After":
+                        f"{getattr(e, 'retry_after_s', 1.0):.1f}"})
             except FuturesTimeoutError:
                 if ctx is not None:
                     ctx.finish("error", error="deadline exceeded")
@@ -484,7 +498,9 @@ def _make_handler(server: ModelServer):
             except QueueFullError as e:
                 if ctx is not None:
                     ctx.finish("shed", error=str(e))
-                self._error(429, f"QueueFullError: {e}", headers=hdr)
+                self._error(429, f"QueueFullError: {e}", headers={
+                    **hdr, "Retry-After":
+                        f"{getattr(e, 'retry_after_s', 1.0):.1f}"})
             except Exception as e:  # noqa: BLE001 — surface to client
                 if ctx is not None:
                     ctx.finish("error", error=f"{type(e).__name__}: {e}")
@@ -524,9 +540,27 @@ def main(argv: Optional[list[str]] = None) -> int:
                         "int8: refuse to serve when the measured "
                         "argmax-disagreement delta exceeds this "
                         "(default $KFTPU_INT8_MAX_DELTA or 0.02)")
+    p.add_argument("--batching", default="continuous",
+                   choices=["continuous", "window"],
+                   help="batcher admission scheduler: 'continuous' = "
+                        "in-flight batching (the next batch forms from "
+                        "everything queued the moment the previous "
+                        "dispatch returns; ISSUE 18), 'window' = the "
+                        "legacy fixed collect window (the PR 11 "
+                        "baseline, kept for A/B)")
+    p.add_argument("--max-wait-ms", type=float, default=None,
+                   help="continuous batching's idle-device coalescing "
+                        "bound: how long a lone request may hold for "
+                        "co-riders when the device is idle (default: "
+                        "the --max-latency window value; under load "
+                        "nobody waits)")
+    p.add_argument("--max-latency", type=float, default=5.0,
+                   help="window mode's collect window in ms (and the "
+                        "max-wait default for continuous mode)")
     p.add_argument("--max-pending", type=int, default=0,
                    help="bounded batcher queue: shed with 429 past this "
-                        "many waiting requests (0 = unbounded)")
+                        "many waiting requests (0 = unbounded; sheds "
+                        "carry a drain-rate Retry-After hint)")
     p.add_argument("--sample-every", type=int, default=16,
                    help="emit per-stage trace spans for every Nth "
                         "request (the ledger summary span is always "
@@ -581,10 +615,13 @@ def main(argv: Optional[list[str]] = None) -> int:
                                      availability=args.slo_availability)
     server = ModelServer(repo, port=args.rest_port,
                          max_batch=args.max_batch,
+                         max_latency_ms=args.max_latency,
                          max_pending=args.max_pending,
                          sample_every=args.sample_every,
                          span_path=args.span_path, slos=slos,
-                         drain_timeout_s=args.drain_timeout)
+                         drain_timeout_s=args.drain_timeout,
+                         batching=args.batching,
+                         max_wait_ms=args.max_wait_ms)
     port = server.start()
     grpc_server = None
     if args.grpc_port:

@@ -188,7 +188,12 @@ class ReplicaState:
     def register_queue(self, model: str, batcher) -> None:
         """The model's MicroBatcher: polled at refresh()/snapshot()
         time for queue depth + oldest-waiting age (scrape-time pull,
-        zero hot-path cost)."""
+        zero hot-path cost). Under continuous batching (ISSUE 18) the
+        batcher removes an item from both gauges the moment it is
+        admitted to a forming cohort — the gauges count work the
+        DEVICE has not yet claimed, which is exactly the backlog the
+        autoscaler reconciler scales on; counting admitted (in-flight)
+        work here would double-book it against ``inFlight``."""
         with self._lock:
             self._queues[model] = batcher
 

@@ -1,0 +1,329 @@
+"""Serving-autoscaler scenarios (ISSUE 18): the pure hysteresis policy
+(fast up on pressure, slow down on sustained idle, cooldown flap
+guard, min/max bounds, unpollable-blocks-down), the manifest-facing
+AutoscalerConfig (loud on typos), and the ServingFleetReconciler
+against a FakeCluster with fake poller + actuator. All jax-free."""
+
+import pytest
+
+from kubeflow_tpu.cluster.fake import FakeCluster
+from kubeflow_tpu.controllers.autoscaler import (SERVING_FLEET_API_VERSION,
+                                                 SERVING_FLEET_KIND,
+                                                 AutoscalerConfig,
+                                                 AutoscalerPolicy,
+                                                 ReplicaSignals,
+                                                 ServingFleetReconciler)
+
+pytestmark = pytest.mark.serving_batch
+
+
+def _cfg(**kw):
+    base = dict(min_replicas=1, max_replicas=4, burn_up_threshold=2.0,
+                queue_up_threshold=4.0, oldest_wait_up_s=0.5,
+                idle_down_s=10.0, cooldown_s=5.0, poll_interval_s=1.0)
+    base.update(kw)
+    return AutoscalerConfig(**base)
+
+
+def _idle(name="r0"):
+    return ReplicaSignals(name=name)
+
+
+def _busy(name="r0", **kw):
+    sig = dict(queue_depth=10, oldest_wait_s=1.0, inflight=2,
+               burn_fast=0.0)
+    sig.update(kw)
+    return ReplicaSignals(name=name, **sig)
+
+
+# ---------------------------------------------------------------- policy
+
+
+def test_scale_up_fast_on_burn_rate():
+    p = AutoscalerPolicy(_cfg())
+    d = p.decide([ReplicaSignals(name="r0", burn_fast=3.0)], 1, now=100.0)
+    assert d.direction == "up"
+    assert "burn" in d.reason
+
+
+def test_scale_up_fast_on_queue_depth_per_replica():
+    p = AutoscalerPolicy(_cfg())
+    # 10 queued across 2 replicas = 5/replica ≥ 4 threshold
+    d = p.decide([ReplicaSignals(name="a", queue_depth=6),
+                  ReplicaSignals(name="b", queue_depth=4)], 2, now=1.0)
+    assert d.direction == "up"
+    assert "queue" in d.reason
+
+
+def test_scale_up_fast_on_oldest_wait():
+    p = AutoscalerPolicy(_cfg())
+    d = p.decide([ReplicaSignals(name="r0", oldest_wait_s=0.9)], 1,
+                 now=1.0)
+    assert d.direction == "up"
+    assert "oldest wait" in d.reason
+
+
+def test_scale_up_blocked_at_max_replicas():
+    p = AutoscalerPolicy(_cfg(max_replicas=2))
+    d = p.decide([_busy("a"), _busy("b")], 2, now=1.0)
+    assert d.direction is None
+    assert "maxReplicas" in d.reason
+
+
+def test_scale_up_blocked_inside_cooldown():
+    p = AutoscalerPolicy(_cfg(cooldown_s=60.0))
+    assert p.decide([_busy()], 1, now=0.0).direction == "up"
+    d = p.decide([_busy()], 2, now=30.0)
+    assert d.direction is None
+    assert "cooldown" in d.reason
+    # cooldown expired: pressure may scale again
+    assert p.decide([_busy()], 2, now=61.0).direction == "up"
+
+
+def test_scale_down_requires_sustained_idle():
+    p = AutoscalerPolicy(_cfg(idle_down_s=10.0))
+    assert p.decide([_idle("a"), _idle("b")], 2, now=0.0).direction is None
+    # still inside the idle window: hold
+    assert p.decide([_idle("a"), _idle("b")], 2, now=5.0).direction is None
+    # sustained past idleDownSeconds: drain one
+    assert p.decide([_idle("a"), _idle("b")], 2, now=11.0).direction == "down"
+
+
+def test_momentary_lull_resets_the_idle_window():
+    p = AutoscalerPolicy(_cfg(idle_down_s=10.0))
+    p.decide([_idle("a"), _idle("b")], 2, now=0.0)
+    # a burst interrupts the lull (not enough for scale-up pressure)
+    p.decide([ReplicaSignals(name="a", inflight=1), _idle("b")], 2,
+             now=8.0)
+    # 11s after the FIRST idle poll, but the window restarted at t=9
+    p.decide([_idle("a"), _idle("b")], 2, now=9.0)
+    assert p.decide([_idle("a"), _idle("b")], 2, now=11.0).direction is None
+    assert p.decide([_idle("a"), _idle("b")], 2, now=20.0).direction == "down"
+
+
+def test_scale_down_blocked_at_min_replicas():
+    p = AutoscalerPolicy(_cfg(min_replicas=1, idle_down_s=1.0))
+    p.decide([_idle()], 1, now=0.0)
+    d = p.decide([_idle()], 1, now=5.0)
+    assert d.direction is None
+    assert "minReplicas" in d.reason
+
+
+def test_unpollable_replica_blocks_scale_down():
+    """Missing data must read as unknown load, never as idle capacity
+    to shed."""
+    p = AutoscalerPolicy(_cfg(idle_down_s=1.0))
+    p.decide([_idle("a"), None], 2, now=0.0)
+    assert p.decide([_idle("a"), None], 2, now=5.0).direction is None
+
+
+def test_one_lull_drains_one_replica_not_the_fleet():
+    """After a scale-down the idle window restarts: the same long lull
+    must not cascade a second drain right after the first."""
+    p = AutoscalerPolicy(_cfg(idle_down_s=10.0, cooldown_s=0.0,
+                              min_replicas=1))
+    idle3 = [_idle("a"), _idle("b"), _idle("c")]
+    p.decide(idle3, 3, now=0.0)
+    assert p.decide(idle3, 3, now=11.0).direction == "down"
+    # immediately after: a fresh full idle window is required
+    assert p.decide(idle3[:2], 2, now=12.0).direction is None
+    assert p.decide(idle3[:2], 2, now=22.0).direction == "down"
+
+
+def test_cooldown_guards_down_then_up_flap():
+    p = AutoscalerPolicy(_cfg(idle_down_s=1.0, cooldown_s=60.0))
+    p.decide([_idle("a"), _idle("b")], 2, now=0.0)
+    assert p.decide([_idle("a"), _idle("b")], 2, now=2.0).direction == "down"
+    # pressure right behind the drain: the cooldown holds it
+    d = p.decide([_busy("a")], 1, now=10.0)
+    assert d.direction is None
+    assert "cooldown" in d.reason
+
+
+def test_draining_replica_is_not_pressure():
+    p = AutoscalerPolicy(_cfg())
+    d = p.decide([_idle("a"), _busy("b", draining=True)], 2, now=1.0)
+    assert d.direction is None
+
+
+# ---------------------------------------------------------------- config
+
+
+def test_config_round_trips_through_manifest_keys():
+    cfg = _cfg(min_replicas=2, max_replicas=8)
+    again = AutoscalerConfig.from_dict(cfg.to_dict())
+    assert again == cfg
+    assert set(cfg.to_dict()) == set(AutoscalerConfig.KEYS)
+
+
+def test_config_rejects_unknown_keys_loudly():
+    with pytest.raises(ValueError, match="maxReplica"):
+        AutoscalerConfig.from_dict({"maxReplica": 3})  # typo'd knob
+
+
+def test_signals_aggregate_over_models():
+    snap = {"draining": False,
+            "models": [
+                {"queueDepth": 3, "inFlight": 1, "oldestWaitSeconds": 0.2,
+                 "burnRates": {"60s": {"latency": 0.5}}},
+                {"queueDepth": 2, "inFlight": 0, "oldestWaitSeconds": 0.7,
+                 "burnRates": {"60s": {"availability": 2.5}}},
+            ]}
+    sig = ReplicaSignals.from_snapshot("r0", snap)
+    assert sig.queue_depth == 5
+    assert sig.inflight == 1
+    assert sig.oldest_wait_s == 0.7
+    assert sig.burn_fast == 2.5
+    assert not sig.draining
+
+
+# ------------------------------------------------------------ reconciler
+
+
+class _FakeActuator:
+    def __init__(self):
+        self.ups = 0
+        self.downs = []
+
+    def scale_up(self):
+        self.ups += 1
+        return {"name": f"scaled-{self.ups}",
+                "url": f"http://127.0.0.1:{9000 + self.ups}",
+                "startKind": "warm"}
+
+    def scale_down(self, name):
+        self.downs.append(name)
+
+
+def _fleet_obj(autoscaler=None, endpoints=("http://127.0.0.1:8500",)):
+    return {"apiVersion": SERVING_FLEET_API_VERSION,
+            "kind": SERVING_FLEET_KIND,
+            "metadata": {"name": "fleet", "namespace": "serving"},
+            "spec": {"model": "resnet18", "endpoints": list(endpoints),
+                     "autoscaler": autoscaler or
+                     {"minReplicas": 1, "maxReplicas": 3,
+                      "idleDownSeconds": 10.0, "cooldownSeconds": 0.0,
+                      "pollIntervalSeconds": 0.5}}}
+
+
+def _mk(cluster, signals_by_name, t):
+    """Reconciler with a fake poller (name → ReplicaSignals) and a
+    settable clock."""
+    rec = ServingFleetReconciler(
+        actuator=_FakeActuator(),
+        poller=lambda name, url, **kw: signals_by_name.get(name),
+        clock=lambda: t[0])
+    return rec
+
+
+def test_reconciler_scales_up_on_pressure_and_publishes_status():
+    fc = FakeCluster()
+    fc.create(_fleet_obj())
+    t = [0.0]
+    signals = {"fleet-0": _busy("fleet-0")}
+    rec = _mk(fc, signals, t)
+    res = rec.reconcile(fc, ("serving", "fleet"))
+    assert res.requeue_after == 0.5
+    obj = fc.get(SERVING_FLEET_API_VERSION, SERVING_FLEET_KIND,
+                 "serving", "fleet")
+    st = obj["status"]
+    names = [r["name"] for r in st["replicas"]]
+    assert names == ["fleet-0", "scaled-1"]
+    assert st["observedReplicas"] == 2
+    assert st["lastScale"]["direction"] == "up"
+    assert rec.actuator.ups == 1
+
+
+def test_reconciler_scales_down_after_sustained_idle():
+    fc = FakeCluster()
+    fc.create(_fleet_obj(endpoints=("http://a", "http://b")))
+    t = [0.0]
+    signals = {"fleet-0": _idle("fleet-0"), "fleet-1": _idle("fleet-1")}
+    rec = _mk(fc, signals, t)
+    rec.reconcile(fc, ("serving", "fleet"))      # idle window opens
+    t[0] = 11.0
+    rec.reconcile(fc, ("serving", "fleet"))      # sustained → drain
+    obj = fc.get(SERVING_FLEET_API_VERSION, SERVING_FLEET_KIND,
+                 "serving", "fleet")
+    assert [r["name"] for r in obj["status"]["replicas"]] == ["fleet-0"]
+    assert rec.actuator.downs == ["fleet-1"]     # LIFO victim
+    assert obj["status"]["lastScale"]["direction"] == "down"
+
+
+def test_reconciler_respects_cooldown_between_events():
+    fc = FakeCluster()
+    fc.create(_fleet_obj(autoscaler={"minReplicas": 1, "maxReplicas": 3,
+                                     "cooldownSeconds": 60.0}))
+    t = [0.0]
+    signals = {"fleet-0": _busy("fleet-0"), "scaled-1": _busy("scaled-1")}
+    rec = _mk(fc, signals, t)
+    rec.reconcile(fc, ("serving", "fleet"))
+    t[0] = 10.0                                   # still pressured, in cooldown
+    rec.reconcile(fc, ("serving", "fleet"))
+    obj = fc.get(SERVING_FLEET_API_VERSION, SERVING_FLEET_KIND,
+                 "serving", "fleet")
+    assert obj["status"]["observedReplicas"] == 2  # no second event
+    assert rec.actuator.ups == 1
+
+
+def test_reconciler_bad_config_raises_loudly():
+    fc = FakeCluster()
+    fc.create(_fleet_obj(autoscaler={"maxReplica": 3}))
+    rec = ServingFleetReconciler(poller=lambda *a, **k: None)
+    with pytest.raises(ValueError, match="maxReplica"):
+        rec.reconcile(fc, ("serving", "fleet"))
+
+
+def test_reconciler_forgets_deleted_fleet():
+    fc = FakeCluster()
+    fc.create(_fleet_obj())
+    t = [0.0]
+    rec = _mk(fc, {"fleet-0": _busy("fleet-0")}, t)
+    rec.reconcile(fc, ("serving", "fleet"))
+    assert ("serving", "fleet") in rec._policies
+    fc.delete(SERVING_FLEET_API_VERSION, SERVING_FLEET_KIND,
+              "serving", "fleet")
+    res = rec.reconcile(fc, ("serving", "fleet"))
+    assert ("serving", "fleet") not in rec._policies
+    assert not res.requeue_after  # gone: no periodic requeue
+
+
+def test_reconciler_without_actuator_is_declarative_only():
+    """No actuator: the reconciler publishes desiredReplicas (the
+    HPA-writes-the-scale-subresource shape) but touches nothing."""
+    fc = FakeCluster()
+    fc.create(_fleet_obj())
+    t = [0.0]
+    rec = ServingFleetReconciler(
+        poller=lambda name, url, **kw: _busy(name), clock=lambda: t[0])
+    rec.reconcile(fc, ("serving", "fleet"))
+    obj = fc.get(SERVING_FLEET_API_VERSION, SERVING_FLEET_KIND,
+                 "serving", "fleet")
+    assert obj["status"]["observedReplicas"] == 1   # unchanged
+    assert obj["status"]["desiredReplicas"] == 2    # the ask is published
+
+
+def test_reconciler_registered_with_controller_manager():
+    from kubeflow_tpu.controllers.__main__ import (CONTROLLER_FACTORIES,
+                                                   _register_defaults)
+    _register_defaults()
+    assert CONTROLLER_FACTORIES["autoscaler"] is ServingFleetReconciler
+
+
+def test_live_fetch_signals_reads_verbose_healthz():
+    """fetch_signals against a real in-process replica (ChaosServable —
+    no jax): queued work shows up as queue_depth/oldest_wait."""
+    from kubeflow_tpu.cluster.chaos import ServingReplicaHarness
+    from kubeflow_tpu.controllers.autoscaler import fetch_signals
+    h = ServingReplicaHarness("sig0", model="m", predict_s=0.01)
+    try:
+        url = h.start()
+        sig = fetch_signals("sig0", url, timeout_s=2.0)
+        assert sig is not None
+        assert sig.name == "sig0"
+        assert not sig.draining
+        assert sig.queue_depth == 0
+    finally:
+        h.stop()
+    # a dead replica polls as None, never raises
+    assert fetch_signals("sig0", url, timeout_s=0.5) is None

@@ -945,6 +945,54 @@ def test_serving_resilience_knobs_are_plumbed_end_to_end():
     assert 'snap.get("uptimeSeconds")' in fleet_src
 
 
+def test_serving_batching_and_autoscaler_knobs_are_plumbed_end_to_end():
+    """The ISSUE 18 knobs must exist in EVERY layer at once: the
+    serving manifest renders ``--batching`` and (with autoscale=True) a
+    ServingFleet whose ``spec.autoscaler`` keys the reconciler's
+    AutoscalerConfig accepts verbatim; the server CLI parses
+    ``--batching`` into the MicroBatcher; and the autoscaler controller
+    is registered so the rendered object has a consumer."""
+    from kubeflow_tpu.controllers.autoscaler import (AutoscalerConfig,
+                                                     ServingFleetReconciler)
+    from kubeflow_tpu.manifests.serving import tpu_serving
+
+    objs = tpu_serving(batching="window", autoscale=True,
+                       autoscale_min=2, autoscale_max=6)
+    dep = next(o for o in objs if o["kind"] == "Deployment")
+    container = dep["spec"]["template"]["spec"]["containers"][0]
+    assert "--batching=window" in container["args"]
+
+    fleet = next(o for o in objs if o["kind"] == "ServingFleet")
+    knobs = fleet["spec"]["autoscaler"]
+    # every rendered knob is one the reconciler's config accepts — a
+    # renamed key on either side fails loudly here, not silently at
+    # reconcile time
+    cfg = AutoscalerConfig.from_dict(knobs)
+    assert cfg.min_replicas == 2 and cfg.max_replicas == 6
+    assert set(knobs) <= set(AutoscalerConfig.KEYS)
+
+    # unscaled renders carry no ServingFleet
+    assert not any(o["kind"] == "ServingFleet" for o in tpu_serving())
+
+    def src(*rel):
+        with open(os.path.join(REPO_ROOT, *rel)) as f:
+            return f.read()
+
+    # the CLI parses --batching and hands it to the batcher layer
+    http_src = src("kubeflow_tpu", "serving", "http_server.py")
+    assert "--batching" in http_src
+    assert "batching=args.batching" in http_src
+    batcher_src = src("kubeflow_tpu", "serving", "batcher.py")
+    assert "BATCHING_MODES" in batcher_src
+
+    # the rendered ServingFleet has a registered consumer
+    from kubeflow_tpu.controllers.__main__ import (CONTROLLER_FACTORIES,
+                                                   _register_defaults)
+    _register_defaults()
+    assert CONTROLLER_FACTORIES["autoscaler"] is ServingFleetReconciler
+    assert ServingFleetReconciler.primary[1] == fleet["kind"]
+
+
 def test_run_policy_fields_are_plumbed_end_to_end():
     """Every RunPolicy field must be plumbed spec → controller →
     manifests: round-trip through the TPUJob spec wire format

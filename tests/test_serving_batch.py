@@ -1,0 +1,292 @@
+"""Continuous (in-flight) batching scenarios (ISSUE 18): the batcher's
+admission semantics, the Retry-After shed hint, and the admission-time
+queue-gauge contract. All jax-free: a duck-typed servable with
+scriptable blocking stands in for the model, so the tier runs in the
+control-plane smoke lane."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.batcher import MicroBatcher, QueueFullError
+
+pytestmark = pytest.mark.serving_batch
+
+
+class _BlockingServable:
+    """Echo servable whose predict blocks until released — freezes the
+    dispatch loop mid-flight so tests can observe queue state while the
+    device is 'busy'."""
+
+    name = "blk"
+
+    def __init__(self, hold: bool = False):
+        self._gate = threading.Event()
+        if not hold:
+            self._gate.set()
+        self.calls = []          # list of row-counts per dispatch
+
+    def release(self):
+        self._gate.set()
+
+    def hold(self):
+        self._gate.clear()
+
+    def predict(self, batch):
+        self._gate.wait(timeout=30.0)
+        self.calls.append(batch.shape[0])
+        return batch
+
+
+def _items(n, rows=1):
+    return [np.full((rows, 2), float(i), np.float32) for i in range(n)]
+
+
+def test_batching_mode_is_validated():
+    with pytest.raises(ValueError, match="batching"):
+        MicroBatcher(_BlockingServable(), batching="sliding")
+
+
+def test_continuous_is_the_default_mode():
+    b = MicroBatcher(_BlockingServable())
+    try:
+        assert b.batching == "continuous"
+    finally:
+        b.shutdown()
+
+
+def test_continuous_backlog_skips_the_window_wait():
+    """Under load the batch forms from whatever is queued the moment
+    the device frees — the window knob (max_latency_ms, here a huge
+    5 s) is IGNORED for backlogged work; only the small idle-device
+    coalescing bound (max_wait_ms) ever holds a request, and only an
+    idle-start one (the PR 11 knee this mode kills)."""
+    s = _BlockingServable(hold=True)
+    b = MicroBatcher(s, max_batch=8, max_latency_ms=5_000.0,
+                     max_wait_ms=50.0, batching="continuous")
+    try:
+        head = b.submit(np.zeros((1, 2), np.float32))
+        deadline = time.monotonic() + 5.0
+        # head-of-line admitted (device 'busy' inside predict)...
+        while b.queue_depth() > 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        # ...then a backlog queues behind it
+        futs = [b.submit(x) for x in _items(4)]
+        t0 = time.perf_counter()
+        s.release()
+        head.result(timeout=10.0)
+        for f in futs:
+            f.result(timeout=10.0)
+        elapsed = time.perf_counter() - t0
+        # window mode would hold each partial batch to the 5 s edge;
+        # continuous drains the whole backlog in well under a second
+        assert elapsed < 2.0, f"backlog waited a window edge ({elapsed:.1f}s)"
+    finally:
+        b.shutdown()
+
+
+def test_continuous_greedy_refill_batches_the_backlog():
+    """Requests queued while the device was busy ride ONE dispatch
+    (greedy refill to max_batch), not N serial singletons."""
+    s = _BlockingServable(hold=True)
+    b = MicroBatcher(s, max_batch=8, max_latency_ms=1.0,
+                     batching="continuous")
+    try:
+        first = b.submit(np.zeros((1, 2), np.float32))
+        deadline = time.monotonic() + 5.0
+        # wait until the loop has admitted the first item (it left the
+        # queue gauges) and is blocked inside predict
+        while b.queue_depth() > 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        futs = [b.submit(x) for x in _items(4)]
+        s.release()
+        first.result(timeout=10.0)
+        for f in futs:
+            f.result(timeout=10.0)
+        # first dispatch carried the lone head-of-line request; the 4
+        # backlogged rows must coalesce into the (one) next dispatch
+        assert s.calls[0] == 1
+        assert s.calls[1] == 4, f"backlog fragmented: {s.calls}"
+    finally:
+        b.shutdown()
+
+
+def test_gauges_drop_at_admission_not_at_dispatch_end():
+    """The satellite contract: an admitted request is device backlog,
+    not queue backlog — queue_depth/oldest_wait_s must stop counting
+    it the moment it is pulled into a forming cohort, even while its
+    dispatch is still in flight (the autoscaler would double-count
+    otherwise)."""
+    s = _BlockingServable(hold=True)
+    b = MicroBatcher(s, max_batch=2, max_latency_ms=1.0,
+                     batching="continuous")
+    try:
+        f0 = b.submit(np.zeros((1, 2), np.float32))
+        deadline = time.monotonic() + 5.0
+        while b.queue_depth() > 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        # the in-flight request left the gauges at admission
+        assert b.queue_depth() == 0
+        assert b.oldest_wait_s() == 0.0
+        # new arrivals behind the busy device DO count
+        f1 = b.submit(np.zeros((1, 2), np.float32))
+        f2 = b.submit(np.zeros((1, 2), np.float32))
+        assert b.queue_depth() == 2
+        assert b.oldest_wait_s() >= 0.0
+        s.release()
+        for f in (f0, f1, f2):
+            f.result(timeout=10.0)
+        deadline = time.monotonic() + 5.0
+        while b.queue_depth() > 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert b.queue_depth() == 0
+    finally:
+        b.shutdown()
+
+
+def test_queue_full_carries_retry_after_hint():
+    s = _BlockingServable(hold=True)
+    b = MicroBatcher(s, max_batch=1, max_latency_ms=1.0, max_pending=2,
+                     batching="continuous")
+    try:
+        # head-of-line admitted (blocks in predict), then fill the queue
+        b.submit(np.zeros((1, 2), np.float32))
+        deadline = time.monotonic() + 5.0
+        while b.queue_depth() > 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        b.submit(np.zeros((1, 2), np.float32))
+        b.submit(np.zeros((1, 2), np.float32))
+        with pytest.raises(QueueFullError) as ei:
+            b.submit(np.zeros((1, 2), np.float32))
+        assert 1.0 <= ei.value.retry_after_s <= 30.0
+        assert 1.0 <= b.retry_after_s() <= 30.0
+    finally:
+        s.release()
+        b.shutdown()
+
+
+def test_retry_hint_tracks_drain_rate():
+    b = MicroBatcher(_BlockingServable(), max_latency_ms=1.0)
+    try:
+        # cold batcher (no measured rate): conservative 1 s floor
+        assert b._retry_hint(100) == 1.0
+        b._drain_rate = 10.0          # 10 req/s measured
+        assert b._retry_hint(5) == 1.0         # clamp floor
+        assert b._retry_hint(50) == 5.0        # depth / rate
+        assert b._retry_hint(100000) == 30.0   # clamp ceiling
+    finally:
+        b.shutdown()
+
+
+def test_drain_rate_ewma_updates_after_dispatch():
+    s = _BlockingServable()
+    b = MicroBatcher(s, max_latency_ms=1.0, batching="continuous")
+    try:
+        b.predict(np.zeros((1, 2), np.float32), timeout=10.0)
+        assert b._drain_rate > 0.0
+    finally:
+        b.shutdown()
+
+
+def test_single_request_determinism_across_modes():
+    """A lone request's result must be identical whichever scheduler
+    formed the (one-item) cohort — batch determinism for
+    single-request traffic."""
+    class _Echo:
+        name = "echo"
+
+        def predict(self, batch):
+            return batch * 2.0
+
+    x = np.arange(6, dtype=np.float32).reshape(3, 2)
+    outs = {}
+    for mode in MicroBatcher.BATCHING_MODES:
+        b = MicroBatcher(_Echo(), max_batch=8, max_latency_ms=1.0,
+                         batching=mode)
+        try:
+            outs[mode] = b.predict(x, timeout=10.0)
+        finally:
+            b.shutdown()
+    np.testing.assert_array_equal(outs["continuous"], outs["window"])
+    np.testing.assert_array_equal(outs["continuous"], x * 2.0)
+
+
+def test_queue_stage_sealed_at_one_cohort_instant():
+    """Ledger exactness: every cohort member's ``queue`` stage ends at
+    the shared seal instant (enqueue → admission-to-cohort), so the
+    per-request ledger partitions wall-clock with no unattributed gap
+    between pull time and dispatch start."""
+    class _Ctx:
+        def __init__(self):
+            self.stages = []
+
+        def stage(self, name, start, end, **kw):
+            self.stages.append((name, start, end))
+
+        def note(self, **kw):
+            pass
+
+        def device(self, *a, **kw):
+            pass
+
+    s = _BlockingServable(hold=True)
+    b = MicroBatcher(s, max_batch=8, max_latency_ms=1.0,
+                     batching="continuous")
+    try:
+        b.submit(np.zeros((1, 2), np.float32))
+        deadline = time.monotonic() + 5.0
+        while b.queue_depth() > 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        ctxs = [_Ctx(), _Ctx()]
+        futs = [b.submit(np.zeros((1, 2), np.float32), ctx=c)
+                for c in ctxs]
+        s.release()
+        for f in futs:
+            f.result(timeout=10.0)
+        ends = []
+        for c in ctxs:
+            queue_stages = [st for st in c.stages if st[0] == "queue"]
+            assert len(queue_stages) == 1
+            ends.append(queue_stages[0][2])
+        # both co-riders sealed at the SAME instant
+        assert ends[0] == ends[1]
+    finally:
+        b.shutdown()
+
+
+def test_window_mode_still_honors_the_window():
+    """The PR 11 baseline stays selectable: in window mode a partial
+    batch holds for the latency window (the A/B's fixed-window arm)."""
+    s = _BlockingServable()
+    b = MicroBatcher(s, max_batch=8, max_latency_ms=150.0,
+                     batching="window")
+    try:
+        t0 = time.perf_counter()
+        b.predict(np.zeros((1, 2), np.float32), timeout=10.0)
+        elapsed = time.perf_counter() - t0
+        assert elapsed >= 0.10, (
+            f"window mode dispatched a partial batch early ({elapsed:.3f}s)")
+    finally:
+        b.shutdown()
+
+
+def test_continuous_drain_flushes_and_fails_stragglers_fast():
+    """Graceful drain under continuous admission: the queued cohort
+    flushes through the device, and anything still queued past the
+    deadline fails FAST with BatcherClosedError — zero hangs."""
+    s = _BlockingServable()
+    b = MicroBatcher(s, max_batch=8, max_latency_ms=1.0,
+                     batching="continuous")
+    try:
+        futs = [b.submit(x) for x in _items(3)]
+        report = b.drain(timeout_s=5.0)
+        for f in futs:
+            f.result(timeout=1.0)  # flushed, not dropped
+        assert report["failed"] == 0
+        from kubeflow_tpu.serving.batcher import BatcherClosedError
+        with pytest.raises(BatcherClosedError):
+            b.submit(np.zeros((1, 2), np.float32))
+    finally:
+        b.shutdown()

@@ -532,6 +532,49 @@ class TestDrain:
         with pytest.raises(RuntimeError):
             b.submit([[9.0]])  # the door is closed
 
+    def test_drain_during_continuous_batching_loses_nothing(self):
+        """ISSUE 18 drill: a drain landing mid-continuous-admission is
+        still zero-loss — every request racing the drain either rides
+        a flushed cohort to 200 or bounces with a retryable 503 (the
+        fleet re-routes it); nothing hangs, nothing hard-fails."""
+        h = ServingReplicaHarness("cd0", predict_s=0.03, max_batch=4,
+                                  max_latency_ms=1.0)
+        h.start()
+        try:
+            assert h.server.batcher("chaos").batching == "continuous"
+            outcomes: list[object] = []
+            lock = threading.Lock()
+
+            def fire():
+                req = urllib.request.Request(
+                    f"{h.url}/v1/models/chaos:predict", data=BODY,
+                    method="POST",
+                    headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        r.read()
+                        out = r.status
+                except urllib.error.HTTPError as e:
+                    e.read()
+                    out = e.code
+                with lock:
+                    outcomes.append(out)
+
+            threads = [threading.Thread(target=fire) for _ in range(8)]
+            for t in threads:
+                t.start()
+            time.sleep(0.02)   # let admission start mid-stream
+            report = h.server.drain(timeout_s=5.0)
+            for t in threads:
+                t.join(timeout=15.0)
+            assert not any(t.is_alive() for t in threads), "request hung"
+            assert report["inFlightRemaining"] == 0
+            # zero loss: only success or a retryable shed, never 4xx/hang
+            assert set(outcomes) <= {200, 503}, outcomes
+            assert outcomes.count(200) >= 1   # the admitted cohort flushed
+        finally:
+            h.stop()
+
     def test_batcher_shutdown_fails_fast_with_drained_outcome(
             self, tmp_path):
         from kubeflow_tpu.serving.batcher import MicroBatcher

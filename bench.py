@@ -3570,6 +3570,348 @@ def bench_autoscaler(t_start: float | None = None) -> dict:
     }
 
 
+def bench_katib_child() -> dict:
+    """ONE real hyperparameter trial, run in its own process (trial
+    startup is a process property — exactly the warm-start child's
+    framing): train a few steps of the small transformer at the lr the
+    Experiment reconciler assigned, with the runtime-lr schedule on and
+    the shared AOT volume mounted. Every trial after the first must load
+    the SAME serialized executable (the compile-shape fingerprint drops
+    runtime constants), which is the whole warm-start-fraction bar."""
+    import os
+
+    root = os.environ["KFTPU_KATIB_ROOT"]
+    os.environ["KFTPU_COMPILE_CACHE_DIR"] = os.path.join(root, "cache")
+    os.environ.setdefault("KFTPU_COMPILE_CACHE_MIN_SECS", "0")
+    from kubeflow_tpu.runtime.worker import train
+    r = train(workload="transformer",
+              steps=_env_int("KFTPU_BENCH_KATIB_STEPS", 4),
+              global_batch=8, sync_every=2, seed=0,
+              learning_rate=float(os.environ["KFTPU_KATIB_LR"]),
+              runtime_schedule=True,
+              aot=True, aot_dir=os.path.join(root, "aot"))
+    return {
+        "metric": "katib_trial", "value": r.time_to_first_step_s,
+        "unit": "seconds", "vs_baseline": None, "mfu": None,
+        "extras": {
+            "start_kind": r.start_kind,
+            "lr": float(os.environ["KFTPU_KATIB_LR"]),
+            "loss": float(r.final_metrics.get("loss", 0.0)),
+        },
+        "_flops_per_chip": 0.0,
+    }
+
+
+def bench_katib(t_start: float | None = None) -> dict:
+    """Hyperparameter-search acceptance (ISSUE 19) in four arms:
+
+    1. **Burst vs sequential**: a 200-trial Experiment at parallelism 16
+       driven through the real reconciler + operator on FakeCluster vs
+       the same machinery at parallelism 1 — the burst must beat the
+       sequential arm on trials/hour while never exceeding its
+       parallelism bound.
+    2. **Median early stopping**: a seeded bad trial (objective below
+       the peer median at its window) must be killed mid-flight and its
+       remaining chip-time ledgered as saved.
+    3. **Warm-start fraction**: a REAL sequential search (each trial a
+       fresh process running train() at its assigned lr, sharing one
+       AOT volume) must report warmStartFraction >= 0.9 — every trial
+       after the first loads the first trial's executable because the
+       compile-shape key drops runtime constants.
+    4. **Ledger honesty**: each real trial's goodput ledger categories
+       must sum to its wall-clock within 2% (categories_sum_ok).
+
+    The parent never imports jax: the sim arms are control-plane only
+    and the real trials own the backend in child processes.
+
+    Env knobs (katib_bench_smoke shrinks the geometry):
+    KFTPU_BENCH_KATIB_{TRIALS,PARALLELISM,SEQ_TRIALS,REAL_TRIALS,STEPS}.
+    """
+    import json as _json
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+
+    from kubeflow_tpu.api import k8s
+    from kubeflow_tpu.api.experiment import (EXPERIMENT_API_VERSION,
+                                             EXPERIMENT_KIND)
+    from kubeflow_tpu.cluster import FakeCluster
+    from kubeflow_tpu.controllers.experiment import ExperimentReconciler
+    from kubeflow_tpu.controllers.runtime import Manager
+    from kubeflow_tpu.controllers.tpujob import TrainingJobReconciler
+    from kubeflow_tpu.katib.studyjob import OBSERVATION_ANNOTATION
+    from kubeflow_tpu.obs.goodput import categories_sum_ok, ledger_for
+    from kubeflow_tpu.obs.trace import TRACE_ID_ANNOTATION
+
+    t_start = time.perf_counter() if t_start is None else t_start
+    n_burst = _env_int("KFTPU_BENCH_KATIB_TRIALS", 200)
+    parallelism = _env_int("KFTPU_BENCH_KATIB_PARALLELISM", 16)
+    n_seq = min(n_burst, _env_int("KFTPU_BENCH_KATIB_SEQ_TRIALS", 30))
+    n_real = _env_int("KFTPU_BENCH_KATIB_REAL_TRIALS", 4)
+
+    def experiment_manifest(name, n, par, **spec_extra):
+        spec = {
+            "objective": {"type": "maximize", "metric": "accuracy"},
+            "algorithm": {"name": "random"},
+            "parameters": [{"name": "--lr", "type": "double",
+                            "min": 0.05, "max": 0.5}],
+            "maxTrials": n, "parallelism": par,
+            "trialTemplate": {
+                "kind": "TPUJob",
+                "spec": {"replicaSpecs": {"TPU": {
+                    "tpuTopology": "v5e-8",
+                    "template": {"spec": {"containers": [
+                        {"name": "train", "image": "trainer:v1"}]}},
+                }}},
+            },
+        }
+        spec.update(spec_extra)
+        return {"apiVersion": EXPERIMENT_API_VERSION,
+                "kind": EXPERIMENT_KIND,
+                "metadata": {"name": name, "namespace": "kubeflow"},
+                "spec": spec}
+
+    def new_env(pools, span_path=None):
+        cluster = FakeCluster()
+        for i in range(pools):
+            cluster.add_tpu_slice_nodes("v5e-8", pool=f"p{i}")
+        mgr = Manager(cluster)
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        mgr.add(ExperimentReconciler(seed=7, span_path=span_path))
+        return cluster, mgr
+
+    def drive_to_done(cluster, mgr, name, max_rounds=4000):
+        for _ in range(max_rounds):
+            mgr.run_pending()
+            cluster.tick()
+            mgr.run_pending()
+            exp = cluster.get(EXPERIMENT_API_VERSION, EXPERIMENT_KIND,
+                              "kubeflow", name)
+            if k8s.condition_true(exp, "Succeeded") or \
+                    k8s.condition_true(exp, "Failed"):
+                return exp
+        return exp
+
+    def trial_env(pod):
+        return {e["name"]: e.get("value")
+                for c in pod["spec"]["containers"]
+                for e in c.get("env", [])}
+
+    def sim_rate(name, n, par):
+        """Drive n instantly-completing trials at the given parallelism
+        through the real control plane; return (trials/hour, max
+        in-flight, final status)."""
+        cluster, mgr = new_env(pools=par)
+        in_flight = [0]
+
+        def on_running(pod):
+            live = [j for j in cluster.list("tpu.kubeflow.org/v1alpha1",
+                                            "TPUJob", "kubeflow")
+                    if not (k8s.condition_true(j, "Succeeded") or
+                            k8s.condition_true(j, "Failed"))]
+            in_flight[0] = max(in_flight[0], len(live))
+            trial = trial_env(pod).get("KFTPU_TRIAL")
+            if trial:
+                job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                                  "kubeflow", trial)
+                job["metadata"].setdefault("annotations", {})[
+                    OBSERVATION_ANNOTATION] = _json.dumps(
+                        {"accuracy": 0.5})
+                cluster.apply(job)
+            cluster.set_pod_phase(k8s.namespace_of(pod, "default"),
+                                  k8s.name_of(pod), "Succeeded")
+        cluster.on_pod_running = on_running
+        cluster.create(experiment_manifest(name, n, par))
+        t0 = time.perf_counter()
+        exp = drive_to_done(cluster, mgr, name)
+        elapsed = max(time.perf_counter() - t0, 1e-9)
+        st = exp.get("status") or {}
+        done = st.get("trialsSucceeded", 0)
+        return done / (elapsed / 3600.0), in_flight[0], st
+
+    checks: dict = {}
+
+    # -- arm 1: burst vs sequential ------------------------------------
+    burst_rate, burst_peak, burst_st = sim_rate("burst", n_burst,
+                                                parallelism)
+    seq_rate, _, seq_st = sim_rate("seq", n_seq, 1)
+    checks["burst_completed"] = \
+        burst_st.get("trialsSucceeded", 0) == n_burst
+    checks["parallelism_bounded"] = burst_peak <= parallelism
+    checks["burst_beats_sequential"] = burst_rate > seq_rate
+
+    # -- arm 2: median early stopping with saved chip-hours ------------
+    stop_dir = tempfile.mkdtemp(prefix="kftpu-katib-stop-")
+    stop_path = os.path.join(stop_dir, "spans.jsonl")
+    try:
+        cluster, mgr = new_env(pools=4, span_path=stop_path)
+        cluster.on_pod_running = lambda pod: None
+        cluster.create(experiment_manifest(
+            "stopper", 4, 4,
+            earlyStopping={"policy": "median", "minTrials": 2,
+                           "startWindow": 2}))
+        for _ in range(4):
+            mgr.run_pending()
+            cluster.tick()
+            mgr.run_pending()
+        exp = cluster.get(EXPERIMENT_API_VERSION, EXPERIMENT_KIND,
+                          "kubeflow", "stopper")
+        trials = (exp.get("status") or {}).get("trials") or []
+
+        def write_spans(tid, values, wall=None):
+            with open(stop_path, "a") as f:
+                if wall:
+                    f.write(_json.dumps({
+                        "trace_id": tid, "span_id": "w", "parent_id": "",
+                        "name": "trial", "component": "bench",
+                        "start": 0.0, "end": float(wall)}) + "\n")
+                for w, v in enumerate(values):
+                    f.write(_json.dumps({
+                        "trace_id": tid, "span_id": f"s{w}",
+                        "parent_id": "", "name": "objective",
+                        "component": "worker", "start": float(w),
+                        "end": float(w),
+                        "attrs": {"step": w * 10, "window": w,
+                                  "accuracy": v}}) + "\n")
+
+        # two trials finish at wall=60s; of the two still running, the
+        # seeded bad one trails the peer median and must die
+        good, bad = [0.6, 0.7, 0.8], [0.2, 0.15, 0.1]
+        for i, t in enumerate(trials):
+            if i < 2:
+                write_spans(t["traceId"], good, wall=60.0)
+            else:
+                write_spans(t["traceId"], good if i == 2 else bad)
+        for i, t in enumerate(trials[:2]):
+            job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                              "kubeflow", t["name"])
+            job["metadata"].setdefault("annotations", {})[
+                OBSERVATION_ANNOTATION] = _json.dumps({"accuracy": 0.8})
+            cluster.apply(job)
+            for pod in cluster.list("v1", "Pod", "kubeflow"):
+                if k8s.name_of(pod).startswith(t["name"]):
+                    cluster.set_pod_phase("kubeflow", k8s.name_of(pod),
+                                          "Succeeded")
+        mgr.run_pending()
+        recon = next(c.reconciler for c in mgr.controllers
+                     if isinstance(c.reconciler, ExperimentReconciler))
+        recon.reconcile(cluster, ("kubeflow", "stopper"))
+        exp = cluster.get(EXPERIMENT_API_VERSION, EXPERIMENT_KIND,
+                          "kubeflow", "stopper")
+        st = exp.get("status") or {}
+        stopped = [t for t in (st.get("trials") or [])
+                   if t.get("stoppedEarly")]
+        checks["early_stopped_a_seeded_bad_trial"] = len(stopped) >= 1
+        checks["stopped_chip_hours_ledgered_as_saved"] = bool(
+            stopped and stopped[0].get("chipSecondsSaved", 0) > 0 and
+            (st.get("chipHours") or {}).get("saved", 0) > 0)
+        stop_extras = {
+            "trials_stopped": len(stopped),
+            "chip_hours_saved": (st.get("chipHours") or {}).get("saved"),
+        }
+    finally:
+        shutil.rmtree(stop_dir, ignore_errors=True)
+
+    # -- arms 3+4: real trials — warm-start fraction + ledger honesty --
+    real_dir = tempfile.mkdtemp(prefix="kftpu-katib-real-")
+    real_path = os.path.join(real_dir, "spans.jsonl")
+    trial_rows: list = []
+    try:
+        cluster, mgr = new_env(pools=1, span_path=real_path)
+
+        started: set = set()
+
+        def on_running(pod):
+            envm = trial_env(pod)
+            trial = envm.get("KFTPU_TRIAL")
+            # one training child per TRIAL, not per gang pod (a v5e-8
+            # gang runs two hosts; the trial is still one program)
+            if trial and trial not in started:
+                started.add(trial)
+                job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                                  "kubeflow", trial)
+                trace = k8s.annotations_of(job).get(TRACE_ID_ANNOTATION)
+                args = [a for c in pod["spec"]["containers"]
+                        for a in c.get("args", [])]
+                lr = next(a.split("=", 1)[1] for a in args
+                          if a.startswith("--lr="))
+                env = {**os.environ, "KFTPU_BENCH_SUBBENCH": "1",
+                       "KFTPU_KATIB_ROOT": real_dir,
+                       "KFTPU_KATIB_LR": lr,
+                       "KFTPU_SPAN_PATH": real_path,
+                       "KFTPU_TRACE_ID": trace or ""}
+                res = subprocess.run(
+                    [sys.executable, __file__, "--mode", "katib-child"],
+                    env=env, capture_output=True, text=True, timeout=900)
+                row = None
+                for line in reversed(res.stdout.splitlines()):
+                    if line.strip().startswith("{"):
+                        row = _json.loads(line)
+                        break
+                if row is None:
+                    raise RuntimeError(
+                        f"katib trial child emitted no JSON "
+                        f"(rc={res.returncode}): {res.stderr[-2000:]}")
+                trial_rows.append({"trial": trial, "trace": trace,
+                                   "first_step_s": row["value"],
+                                   **row["extras"]})
+            cluster.set_pod_phase(k8s.namespace_of(pod, "default"),
+                                  k8s.name_of(pod), "Succeeded")
+        cluster.on_pod_running = on_running
+        m = experiment_manifest("real", n_real, 1)
+        m["spec"]["objective"] = {"type": "minimize", "metric": "loss"}
+        m["spec"]["algorithm"] = {"name": "grid",
+                                  "settings": {"DefaultGrid": n_real}}
+        cluster.create(m)
+        exp = drive_to_done(cluster, mgr, "real", max_rounds=200)
+        st = exp.get("status") or {}
+        warm_fraction = st.get("warmStartFraction")
+        kinds = [t.get("startKind") for t in (st.get("trials") or [])]
+        ledger_ok = []
+        for t in (st.get("trials") or []):
+            ledger = ledger_for(real_path, t.get("traceId") or "")
+            if ledger.get("wallSeconds"):
+                ledger_ok.append(categories_sum_ok(ledger,
+                                                   tolerance=0.02))
+        checks["real_search_succeeded"] = bool(
+            k8s.condition_true(exp, "Succeeded") and
+            st.get("trialsSucceeded", 0) == n_real)
+        checks["warm_start_fraction_ok"] = bool(
+            warm_fraction is not None and warm_fraction >= 0.9)
+        checks["ledger_categories_sum_to_wall"] = bool(
+            ledger_ok and len(ledger_ok) == n_real and all(ledger_ok))
+    finally:
+        shutil.rmtree(real_dir, ignore_errors=True)
+
+    return {
+        "metric": "katib_burst_trials_per_hour",
+        "value": round(burst_rate, 1),
+        "unit": "trials/hour",
+        "vs_baseline": None,
+        "mfu": None,
+        "extras": {
+            "burst_trials": n_burst,
+            "parallelism": parallelism,
+            "burst_peak_in_flight": burst_peak,
+            "sequential_trials": n_seq,
+            "sequential_trials_per_hour": round(seq_rate, 1),
+            "speedup_vs_sequential": round(burst_rate / max(seq_rate,
+                                                            1e-9), 2),
+            "burst_chip_hours": burst_st.get("chipHours"),
+            **stop_extras,
+            "real_trials": trial_rows,
+            "real_warm_start_fraction": warm_fraction,
+            "real_start_kinds": kinds,
+            "real_best": st.get("bestTrial"),
+            **checks,
+            "all_checks_ok": all(checks.values()),
+            "bench_wall_s": round(time.perf_counter() - t_start, 1),
+        },
+        "_flops_per_chip": 0.0,
+    }
+
+
 def bench_warmstart_child() -> dict:
     """One warm-start arm, run in its OWN process (the whole point is
     process-fresh startup): train a few steps of the small transformer
@@ -3772,7 +4114,8 @@ def main(argv=None) -> int:
                             "input", "sched",
                             "health", "obs", "goodput", "comm",
                             "multislice",
-                            "warmstart", "warmstart-child"])
+                            "warmstart", "warmstart-child",
+                            "katib", "katib-child"])
     p.add_argument("--routing-out",
                    default="bench-matrix/fused_routing_measured.json",
                    help="where --mode fused-blocks writes the measured "
@@ -3786,6 +4129,16 @@ def main(argv=None) -> int:
         row = bench_warmstart(t_start=t_start)
         print(json.dumps(row))
         print(f"# mode=warmstart extras={row['extras']}",
+              file=sys.stderr, flush=True)
+        return 0
+
+    if args.mode == "katib":
+        # same contract as warmstart: the parent is jax-free (the sim
+        # arms are control-plane only, the real trials own the backend
+        # in child processes), so this dispatch precedes the probe too
+        row = bench_katib(t_start=t_start)
+        print(json.dumps(row))
+        print(f"# mode=katib extras={row['extras']}",
               file=sys.stderr, flush=True)
         return 0
 
@@ -3858,6 +4211,8 @@ def main(argv=None) -> int:
         row = bench_multislice(t_start=t_start)
     elif args.mode == "warmstart-child":
         row = bench_warmstart_child()
+    elif args.mode == "katib-child":
+        row = bench_katib_child()
     else:
         row = bench_resnet(fused=False, t_start=t_start)
 

@@ -21,6 +21,7 @@ EXAMPLES = [
     ("tf-job-simple", "tf-job-simple.yaml", {}),
     ("tpu-serving-simple", "tpu-serving-simple.yaml", {}),
     ("katib-studyjob-example", "katib-studyjob-example.yaml", {}),
+    ("tpu-experiment-example", "tpu-experiment-example.yaml", {}),
     ("deploy-prober", "deploy-prober.yaml", {}),
 ]
 

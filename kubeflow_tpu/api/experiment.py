@@ -1,0 +1,457 @@
+"""Experiment CRD types: massively-multi-trial hyperparameter search.
+
+The reference ships Katib as a core platform component (PAPER.md §Katib;
+kubeflow/katib/studyjobcontroller.libsonnet); its StudyJob v1alpha1 shape
+survives here only as a compat parser (katib/studyjob.py
+``studyjob_to_experiment``). The native object is ``Experiment``
+(kubeflow.org/v1alpha1): a search space over TPUJob template parameters,
+an objective (metric + direction + optional goal), a trial budget
+(maxTrials bounded by ``parallelism`` in flight), an algorithm
+(random | grid | pbt), and a median-stopping early-termination policy.
+
+The reconciler (controllers/experiment.py) fans trials through the slice
+scheduler as ordinary TPUJobs — every trial is a gang-scheduled slice,
+subject to queue quota and FIFO like any other job — and reads
+per-window objective values from the trace-span sink (runtime/worker.py
+emits one ``SPAN_OBJECTIVE`` event per drained metrics window).
+
+Trials differing only in tuned scalars share one compiled executable:
+the trial env sets ``KFTPU_RUNTIME_SCHEDULE=1`` so the worker feeds
+lr/warmup/total-steps to the optimizer as runtime state and keys the
+AOT/compile cache on ``compile_shape_fingerprint``
+(runtime/recipe.py) — every trial after the first starts warm.
+
+Jax-free like the rest of the api layer: admission and the controller
+must not import the runtime.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .trainingjob import JOB_KINDS, KF_API_VERSION_V1ALPHA1, TrainingJob
+
+EXPERIMENT_API_VERSION = KF_API_VERSION_V1ALPHA1
+EXPERIMENT_KIND = "Experiment"
+# trial names append "-t<index>"; the base name + longest suffix must
+# still fit the TrainingJob name budget (its derived pod hostnames are
+# the binding constraint)
+MAX_NAME_LEN = TrainingJob.MAX_NAME_LEN - 6
+EXPERIMENT_LABEL = "katib.kubeflow.org/experiment"
+TRIAL_LABEL = "katib.kubeflow.org/trial"
+
+#: objective metric assumed when spec.objective.metric is unset — the
+#: name the worker's metrics stream (and its per-window objective span)
+#: reports training loss under. Defined ONCE, here: the worker span
+#: emitter, the reconciler's median-stopping read, the dashboard trial
+#: table, and the manifests schema all import it (tests/test_lint.py).
+DEFAULT_OBJECTIVE_METRIC = "loss"
+
+#: point-event name the worker emits per drained metrics window
+#: (runtime/worker.py) carrying that window's scalar metrics; the
+#: reconciler's early-stopping policy reads these from the span sink.
+SPAN_OBJECTIVE = "objective"
+
+#: trial-job annotation carrying a final ``{metric: value}`` JSON map —
+#: the out-of-band reporting fallback when no span sink is mounted
+#: (the same contract StudyJob v1alpha1 used).
+OBSERVATION_ANNOTATION = "kubeflow.org/observation"
+
+ALGORITHMS = ("random", "grid", "pbt")
+OBJECTIVE_TYPES = ("minimize", "maximize")
+EARLY_STOPPING_POLICIES = ("none", "median")
+
+# trial states recorded in Experiment status. "Stopped" = terminated
+# early by policy: counts as DONE (its best-so-far objective stands as
+# the trial's result) and its remaining chip-hours are ledgered as
+# saved, not spent.
+T_PENDING = "Pending"
+T_RUNNING = "Running"
+T_SUCCEEDED = "Succeeded"
+T_FAILED = "Failed"
+T_STOPPED = "Stopped"
+
+_PARAM_TYPES = ("double", "int", "discrete", "categorical")
+
+
+@dataclass
+class ParameterRange:
+    """One axis of the search space (``spec.parameters[]``): a feasible
+    range or value list for a named template parameter. The name is both
+    the ``$(param.<name>)`` placeholder key and (unless
+    injectParameters=false) the ``--<name>=<value>`` flag appended to
+    the trial container."""
+
+    name: str
+    type: str = "double"
+    min: Optional[float] = None
+    max: Optional[float] = None
+    values: Optional[list] = None
+
+    _KEYS = ("name", "type", "min", "max", "values")
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ParameterRange":
+        if not isinstance(d, dict):
+            raise ValueError(
+                f"spec.parameters entries must be mappings, got {d!r}")
+        unknown = set(d) - set(cls._KEYS)
+        if unknown:
+            raise ValueError(
+                f"unknown parameter field(s) {sorted(unknown)}; "
+                f"supported: {list(cls._KEYS)}")
+        if not d.get("name"):
+            raise ValueError("spec.parameters entries need a name")
+        return cls(name=str(d["name"]), type=str(d.get("type", "double")),
+                   min=d.get("min"), max=d.get("max"),
+                   values=d.get("values"))
+
+    def validate(self) -> None:
+        if self.type not in _PARAM_TYPES:
+            raise ValueError(
+                f"parameter {self.name}: type {self.type!r} not one of "
+                f"{_PARAM_TYPES}")
+        if self.type in ("double", "int"):
+            if self.min is None or self.max is None or \
+                    float(self.min) > float(self.max):
+                raise ValueError(
+                    f"parameter {self.name}: {self.type} needs "
+                    f"min <= max, got [{self.min}, {self.max}]")
+        elif not self.values:
+            raise ValueError(
+                f"parameter {self.name}: {self.type} needs a non-empty "
+                f"values list")
+
+    def to_dict(self) -> dict:
+        out: dict[str, Any] = {"name": self.name, "type": self.type}
+        if self.min is not None:
+            out["min"] = self.min
+        if self.max is not None:
+            out["max"] = self.max
+        if self.values is not None:
+            out["values"] = self.values
+        return out
+
+    def to_parameter_config(self) -> dict:
+        """The katib/suggestion.py ``parameterconfigs`` shape the
+        suggestion engines parse (min/max/list under ``feasible``)."""
+        feasible: dict[str, Any] = {}
+        if self.min is not None:
+            feasible["min"] = self.min
+        if self.max is not None:
+            feasible["max"] = self.max
+        if self.values is not None:
+            feasible["list"] = self.values
+        return {"name": self.name, "parametertype": self.type,
+                "feasible": feasible}
+
+
+@dataclass
+class EarlyStoppingSpec:
+    """``spec.earlyStopping``: median-stopping rule (Google Vizier §3.2,
+    the katib medianstop service). A running trial is stopped when its
+    best objective so far is worse than the median of all other trials'
+    objectives at the same window index — judged only after
+    ``minTrials`` trials have reported and the trial has produced at
+    least ``startWindow`` objective windows."""
+
+    policy: str = "median"
+    min_trials: int = 3
+    start_window: int = 2
+
+    _KEYS = ("policy", "minTrials", "startWindow")
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> Optional["EarlyStoppingSpec"]:
+        if d is None:
+            return None
+        if not isinstance(d, dict):
+            raise ValueError(
+                f"spec.earlyStopping must be a mapping, got {d!r}")
+        unknown = set(d) - set(cls._KEYS)
+        if unknown:
+            raise ValueError(
+                f"unknown earlyStopping field(s) {sorted(unknown)}; "
+                f"supported: {list(cls._KEYS)}")
+        return cls(policy=str(d.get("policy", "median")),
+                   min_trials=int(d.get("minTrials", 3)),
+                   start_window=int(d.get("startWindow", 2)))
+
+    def validate(self) -> None:
+        if self.policy not in EARLY_STOPPING_POLICIES:
+            raise ValueError(
+                f"earlyStopping.policy {self.policy!r} not one of "
+                f"{EARLY_STOPPING_POLICIES}")
+        if self.min_trials < 1 or self.start_window < 1:
+            raise ValueError(
+                "earlyStopping.minTrials and startWindow must be >= 1")
+
+    def to_dict(self) -> dict:
+        return {"policy": self.policy, "minTrials": self.min_trials,
+                "startWindow": self.start_window}
+
+
+@dataclass
+class PBTSpec:
+    """``spec.pbt`` (algorithm: pbt only): population-based training.
+    Trials run in generations of ``spec.parallelism``; when a generation
+    completes, the bottom ``truncation`` fraction is replaced by clones
+    of top performers — exploit = resume from the winner's checkpoint
+    (the elastic-restore machinery reshapes it onto the clone's slice),
+    explore = each numeric parameter multiplied by a factor drawn from
+    ``perturbFactors``."""
+
+    truncation: float = 0.25
+    perturb_factors: tuple = (0.8, 1.25)
+
+    _KEYS = ("truncation", "perturbFactors")
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> Optional["PBTSpec"]:
+        if d is None:
+            return None
+        if not isinstance(d, dict):
+            raise ValueError(f"spec.pbt must be a mapping, got {d!r}")
+        unknown = set(d) - set(cls._KEYS)
+        if unknown:
+            raise ValueError(
+                f"unknown pbt field(s) {sorted(unknown)}; "
+                f"supported: {list(cls._KEYS)}")
+        factors = d.get("perturbFactors", (0.8, 1.25))
+        return cls(truncation=float(d.get("truncation", 0.25)),
+                   perturb_factors=tuple(float(f) for f in factors))
+
+    def validate(self) -> None:
+        if not 0.0 < self.truncation < 1.0:
+            raise ValueError(
+                f"pbt.truncation must be in (0, 1), got {self.truncation}")
+        if not self.perturb_factors or \
+                any(f <= 0 for f in self.perturb_factors):
+            raise ValueError("pbt.perturbFactors must be positive factors")
+
+    def to_dict(self) -> dict:
+        return {"truncation": self.truncation,
+                "perturbFactors": list(self.perturb_factors)}
+
+
+_SPEC_KEYS = ("objective", "algorithm", "parameters", "maxTrials",
+              "parallelism", "maxFailedTrials", "earlyStopping", "pbt",
+              "trialTemplate", "injectParameters")
+_OBJECTIVE_KEYS = ("type", "metric", "goal")
+_ALGORITHM_KEYS = ("name", "settings")
+
+
+@dataclass
+class Experiment:
+    """Typed view of an Experiment manifest. ``from_manifest`` is the
+    admission gate (unknown keys and bad values raise ValueError with
+    the field path); ``to_manifest`` round-trips."""
+
+    name: str
+    namespace: str = "default"
+    objective_type: str = "minimize"
+    objective_metric: str = DEFAULT_OBJECTIVE_METRIC
+    objective_goal: Optional[float] = None
+    algorithm: str = "random"
+    algorithm_settings: dict = field(default_factory=dict)
+    parameters: list = field(default_factory=list)
+    max_trials: int = 10
+    parallelism: int = 2
+    max_failed_trials: Optional[int] = None
+    early_stopping: Optional[EarlyStoppingSpec] = None
+    pbt: Optional[PBTSpec] = None
+    trial_template: dict = field(default_factory=dict)
+    inject_parameters: bool = True
+    metadata: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_manifest(cls, manifest: dict) -> "Experiment":
+        if manifest.get("kind", EXPERIMENT_KIND) != EXPERIMENT_KIND:
+            raise ValueError(
+                f"kind {manifest.get('kind')!r} is not {EXPERIMENT_KIND}")
+        meta = manifest.get("metadata", {}) or {}
+        spec = manifest.get("spec", {}) or {}
+        if not isinstance(spec, dict):
+            raise ValueError(f"spec must be a mapping, got {spec!r}")
+        unknown = set(spec) - set(_SPEC_KEYS)
+        if unknown:
+            raise ValueError(
+                f"unknown spec field(s) {sorted(unknown)}; "
+                f"supported: {list(_SPEC_KEYS)}")
+
+        objective = spec.get("objective", {}) or {}
+        if not isinstance(objective, dict):
+            raise ValueError(
+                f"spec.objective must be a mapping, got {objective!r}")
+        bad = set(objective) - set(_OBJECTIVE_KEYS)
+        if bad:
+            raise ValueError(
+                f"unknown objective field(s) {sorted(bad)}; "
+                f"supported: {list(_OBJECTIVE_KEYS)}")
+        algo = spec.get("algorithm", {}) or {}
+        if isinstance(algo, str):  # shorthand: algorithm: random
+            algo = {"name": algo}
+        if not isinstance(algo, dict):
+            raise ValueError(
+                f"spec.algorithm must be a mapping or name, got {algo!r}")
+        bad = set(algo) - set(_ALGORITHM_KEYS)
+        if bad:
+            raise ValueError(
+                f"unknown algorithm field(s) {sorted(bad)}; "
+                f"supported: {list(_ALGORITHM_KEYS)}")
+
+        goal = objective.get("goal")
+        exp = cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            objective_type=str(objective.get("type", "minimize")),
+            objective_metric=str(objective.get("metric",
+                                               DEFAULT_OBJECTIVE_METRIC)),
+            objective_goal=float(goal) if goal is not None else None,
+            algorithm=str(algo.get("name", "random")),
+            algorithm_settings=dict(algo.get("settings", {}) or {}),
+            parameters=[ParameterRange.from_dict(p)
+                        for p in spec.get("parameters", []) or []],
+            max_trials=int(spec.get("maxTrials", 10)),
+            parallelism=int(spec.get("parallelism", 2)),
+            max_failed_trials=(
+                int(spec["maxFailedTrials"])
+                if spec.get("maxFailedTrials") is not None else None),
+            early_stopping=EarlyStoppingSpec.from_dict(
+                spec.get("earlyStopping")),
+            pbt=PBTSpec.from_dict(spec.get("pbt")),
+            trial_template=spec.get("trialTemplate") or {},
+            inject_parameters=bool(spec.get("injectParameters", True)),
+            metadata=dict(meta),
+        )
+        exp.validate()
+        return exp
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValueError("metadata.name is required")
+        if self.objective_type not in OBJECTIVE_TYPES:
+            raise ValueError(
+                f"objective.type {self.objective_type!r} not one of "
+                f"{OBJECTIVE_TYPES}")
+        if not self.objective_metric:
+            raise ValueError("objective.metric must be non-empty")
+        if self.algorithm not in ALGORITHMS:
+            raise ValueError(
+                f"algorithm.name {self.algorithm!r} not one of "
+                f"{ALGORITHMS}")
+        if not self.parameters:
+            raise ValueError("spec.parameters must name at least one "
+                             "search dimension")
+        for p in self.parameters:
+            p.validate()
+        if self.max_trials < 1:
+            raise ValueError(f"maxTrials must be >= 1, got "
+                             f"{self.max_trials}")
+        if self.parallelism < 1:
+            raise ValueError(f"parallelism must be >= 1, got "
+                             f"{self.parallelism}")
+        if self.max_failed_trials is not None and \
+                self.max_failed_trials < 0:
+            raise ValueError("maxFailedTrials must be >= 0")
+        if self.early_stopping is not None:
+            self.early_stopping.validate()
+        if self.pbt is not None:
+            if self.algorithm != "pbt":
+                raise ValueError(
+                    "spec.pbt requires algorithm: pbt")
+            self.pbt.validate()
+        if self.algorithm == "pbt":
+            if self.early_stopping is not None:
+                # PBT's truncation IS its stopping rule; layering the
+                # median policy on top would stop population members the
+                # exploit step needs as clone donors
+                raise ValueError(
+                    "algorithm pbt and earlyStopping are mutually "
+                    "exclusive (truncation replaces median stopping)")
+            numeric = [p for p in self.parameters
+                       if p.type in ("double", "int")]
+            if not numeric:
+                raise ValueError(
+                    "algorithm pbt needs at least one numeric parameter "
+                    "to perturb")
+        if not self.trial_template:
+            raise ValueError("spec.trialTemplate is required")
+        kind = self.trial_template.get("kind", "TPUJob")
+        if kind not in JOB_KINDS:
+            raise ValueError(
+                f"trialTemplate kind {kind!r} not one of {JOB_KINDS}")
+        # trial names append "-t<index>" (and the k8s name rules cap the
+        # whole thing); reject at admission, not at trial 100
+        if len(self.name) > MAX_NAME_LEN:
+            raise ValueError(
+                f"metadata.name {self.name!r} too long for trial "
+                f"suffixes (max {MAX_NAME_LEN})")
+
+    def to_manifest(self) -> dict:
+        spec: dict[str, Any] = {
+            "objective": {"type": self.objective_type,
+                          "metric": self.objective_metric},
+            "algorithm": {"name": self.algorithm},
+            "parameters": [p.to_dict() for p in self.parameters],
+            "maxTrials": self.max_trials,
+            "parallelism": self.parallelism,
+            "trialTemplate": self.trial_template,
+        }
+        if self.objective_goal is not None:
+            spec["objective"]["goal"] = self.objective_goal
+        if self.algorithm_settings:
+            spec["algorithm"]["settings"] = dict(self.algorithm_settings)
+        if self.max_failed_trials is not None:
+            spec["maxFailedTrials"] = self.max_failed_trials
+        if self.early_stopping is not None:
+            spec["earlyStopping"] = self.early_stopping.to_dict()
+        if self.pbt is not None:
+            spec["pbt"] = self.pbt.to_dict()
+        if not self.inject_parameters:
+            spec["injectParameters"] = False
+        meta = dict(self.metadata)
+        meta["name"] = self.name
+        meta["namespace"] = self.namespace
+        return {"apiVersion": EXPERIMENT_API_VERSION,
+                "kind": EXPERIMENT_KIND, "metadata": meta, "spec": spec}
+
+    # -- engine plumbing -----------------------------------------------------
+
+    @property
+    def sign(self) -> float:
+        """Multiplier that makes HIGHER always better (the suggestion
+        engines' observe() contract)."""
+        return -1.0 if self.objective_type == "minimize" else 1.0
+
+    def parameter_configs(self) -> list:
+        """The search space in katib/suggestion.py's ParameterConfig
+        form (lazy import: api stays importable without numpy)."""
+        from ..katib.suggestion import parse_parameter_configs
+        return parse_parameter_configs(
+            [p.to_parameter_config() for p in self.parameters])
+
+    def make_engine(self, seed: int = 0):
+        """Suggestion engine for this spec. PBT samples its population
+        with the random engine (explore/exploit happens in the
+        reconciler's generation step, not here)."""
+        from ..katib.suggestion import make_suggestion
+        algo = "random" if self.algorithm == "pbt" else self.algorithm
+        return make_suggestion(algo, self.parameter_configs(),
+                               seed=seed, settings=self.algorithm_settings)
+
+    def goal_reached(self, objective: Optional[float]) -> bool:
+        if objective is None or self.objective_goal is None:
+            return False
+        if self.objective_type == "minimize":
+            return objective <= self.objective_goal
+        return objective >= self.objective_goal
+
+    def better(self, a: Optional[float], b: Optional[float]) -> bool:
+        """True when objective ``a`` beats ``b`` (handles None)."""
+        if a is None:
+            return False
+        if b is None:
+            return True
+        return self.sign * a > self.sign * b

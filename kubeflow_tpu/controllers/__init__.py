@@ -20,11 +20,12 @@ def build_manager(client, vizier=None, vizier_url: Optional[str] = None):
     statefulsets — plus the PodDefault admission hook when the client
     exposes an admission point (FakeCluster does; a real apiserver gets the
     webhook via manifests instead)."""
-    from ..katib.studyjob import StudyJobReconciler
+    from ..katib.studyjob import StudyJobCompatReconciler
     from ..scheduler.core import SliceScheduler
     from ..workflows.engine import WorkflowReconciler
     from ..workflows.kubebench import KubebenchJobReconciler
     from .admission import PodDefaultsWebhook
+    from .experiment import ExperimentReconciler
     from .notebook import NotebookReconciler
     from .profile import ProfileReconciler
     from .runtime import Manager
@@ -43,7 +44,10 @@ def build_manager(client, vizier=None, vizier_url: Optional[str] = None):
     mgr.add(ProfileReconciler())
     mgr.add(WorkflowReconciler())
     mgr.add(KubebenchJobReconciler())
-    mgr.add(StudyJobReconciler(vizier=vizier, vizier_url=vizier_url))
+    mgr.add(ExperimentReconciler())
+    # legacy StudyJob objects convert into owned Experiments; vizier=/
+    # vizier_url= are accepted (and ignored) for caller compatibility
+    mgr.add(StudyJobCompatReconciler(vizier=vizier, vizier_url=vizier_url))
     if hasattr(client, "admission_hooks"):
         client.admission_hooks.append(PodDefaultsWebhook(client))
     return mgr

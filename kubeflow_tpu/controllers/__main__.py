@@ -30,8 +30,9 @@ CONTROLLER_FACTORIES = {}
 
 
 def _register_defaults() -> None:
-    from ..katib.studyjob import StudyJobReconciler
+    from ..katib.studyjob import StudyJobCompatReconciler
     from ..workflows.engine import WorkflowReconciler
+    from .experiment import ExperimentReconciler
     from .notebook import NotebookReconciler
     from .profile import ProfileReconciler
     from .statefulset import StatefulSetReconciler
@@ -54,7 +55,9 @@ def _register_defaults() -> None:
     CONTROLLER_FACTORIES["profile"] = ProfileReconciler
     CONTROLLER_FACTORIES["statefulset"] = StatefulSetReconciler
     CONTROLLER_FACTORIES["workflow"] = WorkflowReconciler
-    CONTROLLER_FACTORIES["studyjob"] = StudyJobReconciler
+    CONTROLLER_FACTORIES["experiment"] = ExperimentReconciler
+    # legacy StudyJob objects convert to Experiments (one search API)
+    CONTROLLER_FACTORIES["studyjob"] = StudyJobCompatReconciler
     CONTROLLER_FACTORIES["scheduledworkflow"] = ScheduledWorkflowReconciler
 
 

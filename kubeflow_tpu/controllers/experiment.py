@@ -1,0 +1,766 @@
+"""Experiment reconciler: hyperparameter-search trials as TPUJob gangs.
+
+The reference's studyjob-controller loop (SURVEY.md §3.5) rebuilt on the
+Experiment API (api/experiment.py): ask the in-process suggestion engine
+for assignments, stamp them into ``spec.trialTemplate``, and keep up to
+``spec.parallelism`` trials in flight as ordinary TPUJobs — every trial
+is a gang-scheduled slice riding the same queue, quota, and FIFO as any
+production job (the scheduler never learns trials exist).
+
+What makes a trial swarm cheap here (ISSUE 19):
+
+- **Warm starts.** Each trial's env sets ``KFTPU_RUNTIME_SCHEDULE=1``:
+  the worker feeds tuned scalars (lr/warmup/total steps) to the
+  optimizer as runtime state and keys the AOT/compile cache on
+  ``compile_shape_fingerprint`` — trials differing only in tuned scalars
+  share one executable, so every trial after the first skips XLA.
+- **Median stopping.** The worker emits one ``SPAN_OBJECTIVE`` event per
+  drained metrics window; the reconciler reads the per-window series
+  from the span sink and deletes a running trial whose objective falls
+  below the median of its peers at the same window — the saved
+  chip-hours are ledgered, not just implied.
+- **Per-experiment ledger.** Completed trials' goodput ledgers
+  (obs/goodput.py, chip-weighted like ``cluster_rollup``) roll into
+  trials/hour, chip-hour goodput, warm-start fraction, and best
+  objective — exported as the ``kftpu_experiment_*`` gauges.
+
+PBT (``algorithm: pbt``) runs the population in generations of
+``parallelism``: when a generation completes, the bottom ``truncation``
+fraction is replaced by clones of top performers — exploit resumes from
+the winner's checkpoint via ``spec.resumeFrom`` (the elastic-restore
+machinery reshapes it onto the clone's slice), explore perturbs each
+numeric parameter.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from statistics import median
+from typing import Any, Optional
+
+from ..api import k8s
+from ..api.experiment import (EXPERIMENT_API_VERSION, EXPERIMENT_KIND,
+                              EXPERIMENT_LABEL, OBSERVATION_ANNOTATION,
+                              SPAN_OBJECTIVE, T_FAILED, T_PENDING,
+                              T_RUNNING, T_STOPPED, T_SUCCEEDED,
+                              TRIAL_LABEL, Experiment)
+from ..api.trainingjob import (COND_FAILED, COND_RUNNING, COND_SUCCEEDED,
+                               KF_API_VERSION_V1ALPHA1,
+                               KF_API_VERSION_V1BETA2, TPU_API_VERSION,
+                               TrainingJob)
+from ..cluster.client import KubeClient, NotFoundError
+from ..obs import registry as obsreg
+from ..obs.trace import TRACE_ID_ANNOTATION
+from .runtime import (Key, Reconciler, Result, ensure_trace_id,
+                      status_snapshot)
+
+log = logging.getLogger(__name__)
+
+#: env the reconciler injects into every trial container (beside
+#: KFTPU_RUNTIME_SCHEDULE=1): which experiment/trial the worker belongs
+#: to, for log lines and custom reporters.
+EXPERIMENT_ENV = "KFTPU_EXPERIMENT"
+TRIAL_NAME_ENV = "KFTPU_TRIAL"
+
+_JOB_API = {"TPUJob": TPU_API_VERSION, "TFJob": KF_API_VERSION_V1BETA2,
+            "PyTorchJob": KF_API_VERSION_V1BETA2,
+            "MPIJob": KF_API_VERSION_V1ALPHA1}
+
+_TERMINAL = (T_SUCCEEDED, T_FAILED, T_STOPPED)
+
+
+def _inject_env(manifest: dict, env: dict[str, str]) -> None:
+    """Append env vars to every container list in the manifest (the
+    template's shape varies by job kind, so walk generically); values
+    already present win — the template author knows better."""
+    def walk(node):
+        if isinstance(node, dict):
+            containers = node.get("containers")
+            if isinstance(containers, list):
+                for c in containers:
+                    if isinstance(c, dict):
+                        ce = c.setdefault("env", [])
+                        present = {e.get("name") for e in ce}
+                        for name, value in env.items():
+                            if name not in present:
+                                ce.append({"name": name, "value": value})
+            for v in node.values():
+                walk(v)
+        elif isinstance(node, list):
+            for v in node:
+                walk(v)
+    walk(manifest)
+
+
+def _inject_args(manifest: dict, assignments: dict[str, Any]) -> None:
+    """Append ``--name=value`` pairs to the first container's args — the
+    katib workerTemplate idiom (parameter names are literal CLI flags)."""
+    def first_containers(node):
+        if isinstance(node, dict):
+            containers = node.get("containers")
+            if isinstance(containers, list) and containers:
+                return containers
+            for v in node.values():
+                found = first_containers(v)
+                if found:
+                    return found
+        elif isinstance(node, list):
+            for v in node:
+                found = first_containers(v)
+                if found:
+                    return found
+        return None
+
+    containers = first_containers(manifest) or []
+    for c in containers:
+        args = c.setdefault("args", [])
+        for name, value in assignments.items():
+            flag = name if name.startswith("-") else f"--{name}"
+            args.append(f"{flag}={value}")
+
+
+@dataclass
+class _ExpState:
+    """In-memory per-experiment state (the suggestion engine is
+    stateful). Rebuilt from status on controller restart — the status
+    trial list is the durable record."""
+    engine: Any
+    next_index: int = 0
+    params: dict = field(default_factory=dict)  # trial -> assignment
+    collect_retries: dict = field(default_factory=dict)
+    rng: Any = None  # PBT perturbation randomness
+
+
+def _experiment_gauges():
+    """The kftpu_experiment_* scrape surface (docs/operations.md metric
+    catalog). Resolved lazily per call — the registry dedupes."""
+    g = obsreg.gauge
+    return {
+        "trials": g("kftpu_experiment_trials",
+                    "trial count per phase for one experiment",
+                    labels=("namespace", "name", "phase")),
+        "best": g("kftpu_experiment_best_objective",
+                  "best objective value observed across the "
+                  "experiment's completed trials",
+                  labels=("namespace", "name")),
+        "tph": g("kftpu_experiment_trials_per_hour",
+                 "completed trials per wall-clock hour since the "
+                 "experiment started", labels=("namespace", "name")),
+        "chip_hours": g("kftpu_experiment_chip_hours",
+                        "chip-hours by disposition: goodput/badput from "
+                        "trial ledgers, saved = early-stop avoided",
+                        labels=("namespace", "name", "category")),
+        "warm": g("kftpu_experiment_warm_start_fraction",
+                  "fraction of finished trials after the first that "
+                  "started from a shared cached/AOT executable",
+                  labels=("namespace", "name")),
+    }
+
+
+class ExperimentReconciler(Reconciler):
+    primary = (EXPERIMENT_API_VERSION, EXPERIMENT_KIND)
+    owns = [(TPU_API_VERSION, "TPUJob"), (KF_API_VERSION_V1BETA2, "TFJob"),
+            (KF_API_VERSION_V1BETA2, "PyTorchJob"),
+            (KF_API_VERSION_V1ALPHA1, "MPIJob")]
+
+    #: reconciles to wait for a finished trial's metrics before
+    #: declaring them unavailable (in-flight span drain / reporter lag)
+    max_collect_retries = 5
+    #: poll interval while a median stopping policy watches running trials
+    stopping_poll_s = 1.0
+
+    def __init__(self, seed: int = 0, span_path: Optional[str] = None):
+        self.seed = seed
+        self._span_path = span_path
+        self._states: dict[str, _ExpState] = {}
+
+    # -- plumbing ------------------------------------------------------------
+
+    @property
+    def span_path(self) -> Optional[str]:
+        if self._span_path:
+            return self._span_path
+        from ..obs.trace import SPAN_PATH_ENV
+        return os.environ.get(SPAN_PATH_ENV)
+
+    def _state(self, exp: Experiment, manifest: dict) -> _ExpState:
+        eid = manifest.get("metadata", {}).get("uid") or exp.name
+        if eid in self._states:
+            return self._states[eid]
+        import random as _random
+        state = _ExpState(engine=exp.make_engine(seed=self.seed),
+                          rng=_random.Random(self.seed ^ hash(exp.name)))
+        # restart recovery: replay the status trial list so the engine
+        # (and the grid cursor) catch up to the previous process
+        trials = manifest.get("status", {}).get("trials", []) or []
+        if trials:
+            state.next_index = len(trials)
+            state.engine.suggest(len(trials))  # advance cursors
+            for t in trials:
+                state.params[t["name"]] = t.get("parameters", {})
+                if t.get("status") in (T_SUCCEEDED, T_STOPPED) and \
+                        t.get("objective") is not None:
+                    state.engine.observe(t.get("parameters", {}),
+                                         exp.sign * float(t["objective"]))
+                elif t.get("status") == T_FAILED:
+                    state.engine.observe_failure(t.get("parameters", {}))
+        self._states[eid] = state
+        return state
+
+    # -- objective reads -----------------------------------------------------
+
+    def _objective_series(self, trace_id: Optional[str],
+                          metric: str) -> list[float]:
+        """Per-window objective values for one trial from the span sink
+        (runtime/worker.py SPAN_OBJECTIVE events), window-ordered."""
+        path = self.span_path
+        if not path or not trace_id or not os.path.exists(path):
+            return []
+        from ..obs.trace import load_spans
+        try:
+            spans = load_spans(path, trace_id=trace_id)
+        except (OSError, ValueError):
+            return []
+        series: list[tuple[int, float]] = []
+        for s in spans:
+            if s.get("name") != SPAN_OBJECTIVE:
+                continue
+            a = s.get("attrs") or {}
+            if metric not in a:
+                continue
+            try:
+                series.append((int(a.get("window", len(series))),
+                               float(a[metric])))
+            except (TypeError, ValueError):
+                continue
+        series.sort(key=lambda wv: wv[0])
+        return [v for _, v in series]
+
+    def _collect_objective(self, client: KubeClient, ns: str,
+                           trial: dict, job: dict,
+                           metric: str) -> Optional[float]:
+        """A finished trial's objective, in priority order: span-sink
+        window series (last window) → observation annotation →
+        ``<trial>-metrics`` ConfigMap. None = not reported (yet)."""
+        series = self._objective_series(trial.get("traceId"), metric)
+        if series:
+            trial["windows"] = len(series)
+            return series[-1]
+        raw = k8s.annotations_of(job).get(OBSERVATION_ANNOTATION)
+        if raw:
+            try:
+                obs = json.loads(raw)
+                if isinstance(obs, dict) and metric in obs:
+                    return float(obs[metric])
+            except (TypeError, ValueError):
+                pass
+        cm = client.get_or_none("v1", "ConfigMap", ns,
+                                f"{trial['name']}-metrics")
+        if cm is not None:
+            raw = (cm.get("data") or {}).get(metric)
+            if raw is not None:
+                try:
+                    return float(raw)
+                except (TypeError, ValueError):
+                    pass
+        return None
+
+    def _trial_ledger(self, trial: dict) -> Optional[dict]:
+        """The trial's goodput ledger from the span sink (None without
+        a sink or trace). Works mid-flight too — wallSeconds grows as
+        windows land — which is what the early-stop savings estimate
+        reads."""
+        path = self.span_path
+        tid = trial.get("traceId")
+        if not path or not tid or not os.path.exists(path):
+            return None
+        from ..obs.goodput import ledger_for
+        try:
+            ledger = ledger_for(path, tid)
+        except (OSError, ValueError):
+            return None
+        return ledger if ledger.get("wallSeconds") else None
+
+    @staticmethod
+    def _start_kind(ledger: Optional[dict]) -> str:
+        """warm/cold/aot verdict from the ledger's compile evidence."""
+        if not ledger:
+            return "unknown"
+        kinds = ledger.get("compileByStartKind") or {}
+        for k in ("aot", "warm"):
+            if kinds.get(k):
+                return k
+        return "cold" if kinds.get("cold") else "unknown"
+
+    # -- reconcile -----------------------------------------------------------
+
+    def reconcile(self, client: KubeClient, key: Key) -> Result:
+        ns, name = key
+        try:
+            manifest = client.get(EXPERIMENT_API_VERSION, EXPERIMENT_KIND,
+                                  ns, name)
+        except NotFoundError:
+            return Result()  # owner refs cascade trial deletion
+
+        if k8s.condition_true(manifest, COND_SUCCEEDED) or \
+                k8s.condition_true(manifest, COND_FAILED):
+            return Result()
+        status = manifest.setdefault("status", {})
+        status_before = status_snapshot(status)
+
+        try:
+            exp = Experiment.from_manifest(manifest)
+        except ValueError as e:
+            self._finish(client, manifest, COND_FAILED, "InvalidSpec",
+                         str(e))
+            return Result()
+        state = self._state(exp, manifest)
+        if not status.get("startedAt"):
+            status["startedAt"] = round(time.time(), 3)
+
+        trials: list[dict] = status.get("trials", []) or []
+        metric = exp.objective_metric
+
+        # 1. sync trial states from worker jobs; collect objectives
+        pending_collect = False
+        for t in trials:
+            if t["status"] in _TERMINAL:
+                continue
+            job = client.get_or_none(_JOB_API[t["kind"]], t["kind"], ns,
+                                     t["name"])
+            if job is None:
+                t["status"] = T_FAILED
+                t["message"] = "trial job disappeared"
+                state.engine.observe_failure(
+                    state.params.get(t["name"], t.get("parameters", {})))
+                continue
+            if not t.get("traceId"):
+                job = ensure_trace_id(client, job)
+                tid = k8s.annotations_of(job).get(TRACE_ID_ANNOTATION)
+                if tid:
+                    t["traceId"] = tid
+            if k8s.condition_true(job, COND_FAILED):
+                t["status"] = T_FAILED
+                state.engine.observe_failure(
+                    state.params.get(t["name"], t.get("parameters", {})))
+                self._seal_ledger(t)
+            elif k8s.condition_true(job, COND_SUCCEEDED):
+                done = self._settle_success(client, ns, t, job, exp, state)
+                pending_collect = pending_collect or not done
+            elif k8s.condition_true(job, COND_RUNNING):
+                t["status"] = T_RUNNING
+
+        # 2. median early stopping over the span-sink window series
+        if exp.early_stopping is not None and \
+                exp.early_stopping.policy == "median":
+            self._median_stop(client, ns, trials, exp, state)
+
+        # 3. spawn up to parallelism (PBT spawns via generations)
+        n_failed = sum(1 for t in trials if t["status"] == T_FAILED)
+        max_failed = exp.max_failed_trials if \
+            exp.max_failed_trials is not None else exp.max_trials
+        best = self._best_trial(trials, exp)
+        budget_left = exp.max_trials - len(trials)
+        goal_met = best is not None and \
+            exp.goal_reached(best.get("objective"))
+        created = 0
+        if n_failed <= max_failed and budget_left > 0 and not goal_met \
+                and not state.engine.exhausted():
+            in_flight = sum(1 for t in trials
+                            if t["status"] not in _TERMINAL)
+            if exp.algorithm == "pbt":
+                created = self._pbt_generation(
+                    client, manifest, exp, state, trials, in_flight,
+                    budget_left)
+            else:
+                want = min(exp.parallelism - in_flight, budget_left)
+                for assignment in (state.engine.suggest(want)
+                                   if want > 0 else []):
+                    trials.append(self._spawn_trial(
+                        client, manifest, exp, state, assignment))
+                    created += 1
+
+        # 4. roll up status + metrics
+        best = self._best_trial(trials, exp)
+        self._rollup(status, trials, best, exp)
+        status["trials"] = trials
+
+        # 5. completion
+        n_failed = status["trialsFailed"]
+        n_done = sum(1 for t in trials if t["status"] in _TERMINAL)
+        if n_failed > max_failed:
+            self._finish(client, manifest, COND_FAILED, "TrialsFailed",
+                         f"{n_failed} trials failed (max {max_failed})",
+                         status)
+            return Result()
+        exhausted = state.engine.exhausted() or \
+            len(trials) >= exp.max_trials or goal_met
+        if trials and n_done == len(trials) and created == 0 and \
+                not pending_collect and exhausted:
+            if status["trialsSucceeded"] + status["trialsStopped"] == 0:
+                self._finish(client, manifest, COND_FAILED,
+                             "NoSuccessfulTrial", "all trials failed",
+                             status)
+            else:
+                msg = (f"best trial {best['name']} objective "
+                       f"{best['objective']}" if best else "completed")
+                if goal_met:
+                    msg += " (objective goal reached)"
+                self._finish(client, manifest, COND_SUCCEEDED,
+                             "ExperimentCompleted", msg, status)
+            return Result()
+
+        if status_snapshot(status) != status_before:
+            self._write_status(client, manifest, status)
+        if not k8s.condition_true(manifest, COND_RUNNING) and trials:
+            self._set_condition(client, manifest, COND_RUNNING,
+                                "TrialsRunning", "trials in progress")
+        if pending_collect:
+            return Result(requeue_after=0.05)
+        if exp.early_stopping is not None and \
+                exp.early_stopping.policy == "median" and \
+                any(t["status"] == T_RUNNING for t in trials):
+            # running trials publish new objective windows out-of-band
+            # (the span sink) — no watch event fires, so the median
+            # policy has to poll
+            return Result(requeue_after=self.stopping_poll_s)
+        return Result()
+
+    # -- trial lifecycle -----------------------------------------------------
+
+    def _settle_success(self, client: KubeClient, ns: str, trial: dict,
+                        job: dict, exp: Experiment,
+                        state: _ExpState) -> bool:
+        """Terminal collection for a succeeded trial; False = metrics
+        may still be in flight, requeue."""
+        value = self._collect_objective(client, ns, trial, job,
+                                        exp.objective_metric)
+        if value is None:
+            n = state.collect_retries.get(trial["name"], 0) + 1
+            state.collect_retries[trial["name"]] = n
+            if n < self.max_collect_retries:
+                return False
+            trial["status"] = T_FAILED
+            trial["message"] = "objective metrics unavailable"
+            state.engine.observe_failure(
+                state.params.get(trial["name"],
+                                 trial.get("parameters", {})))
+            return True
+        trial["status"] = T_SUCCEEDED
+        trial["objective"] = value
+        state.engine.observe(
+            state.params.get(trial["name"], trial.get("parameters", {})),
+            exp.sign * value)
+        self._seal_ledger(trial)
+        return True
+
+    def _seal_ledger(self, trial: dict) -> None:
+        """Fold the trial's final span-sink ledger into its record."""
+        ledger = self._trial_ledger(trial)
+        if ledger:
+            trial["wallSeconds"] = ledger["wallSeconds"]
+            trial["goodputSeconds"] = ledger["goodputSeconds"]
+            chips = trial.get("chips") or ledger.get("chips") or 0
+            trial["chipSeconds"] = round(
+                chips * ledger["wallSeconds"], 3)
+        trial["startKind"] = self._start_kind(ledger)
+
+    def _median_stop(self, client: KubeClient, ns: str,
+                     trials: list[dict], exp: Experiment,
+                     state: _ExpState) -> None:
+        """Median-stopping rule over aligned window indices: a running
+        trial whose sign-normalized objective at its latest window is
+        below the median of every OTHER reporting trial's value at that
+        same window index gets deleted, its best-so-far standing as its
+        result and its remaining chip-time ledgered as saved."""
+        es = exp.early_stopping
+        series_by_trial = {
+            t["name"]: self._objective_series(t.get("traceId"),
+                                              exp.objective_metric)
+            for t in trials}
+        reporting = {n: s for n, s in series_by_trial.items() if s}
+        if len(reporting) < es.min_trials:
+            return
+        done_walls = [t["wallSeconds"] for t in trials
+                      if t["status"] == T_SUCCEEDED
+                      and t.get("wallSeconds")]
+        for t in trials:
+            if t["status"] != T_RUNNING:
+                continue
+            series = series_by_trial.get(t["name"]) or []
+            w = len(series) - 1
+            if w + 1 < es.start_window:
+                continue
+            peers = [s[min(w, len(s) - 1)] for n, s in reporting.items()
+                     if n != t["name"]]
+            if len(peers) < es.min_trials:
+                continue
+            mine = exp.sign * series[w]
+            if mine >= exp.sign * median(peers):
+                continue
+            # stop: best-so-far is the trial's result (sign-normalized
+            # best, reported in raw metric units)
+            best_raw = max(series, key=lambda v: exp.sign * v)
+            try:
+                client.delete(_JOB_API[t["kind"]], t["kind"], ns,
+                              t["name"])
+            except NotFoundError:
+                pass
+            t["status"] = T_STOPPED
+            t["stoppedEarly"] = True
+            t["objective"] = best_raw
+            t["message"] = (f"median-stopped at window {w + 1}: "
+                            f"{series[w]:.6g} vs peer median")
+            state.engine.observe(
+                state.params.get(t["name"], t.get("parameters", {})),
+                exp.sign * best_raw)
+            self._seal_ledger(t)
+            # chip-hours saved: expected full-trial wall (mean of
+            # completed peers) minus what this trial already spent
+            spent = t.get("wallSeconds", 0.0)
+            chips = t.get("chips", 0)
+            if done_walls and chips:
+                expected = sum(done_walls) / len(done_walls)
+                t["chipSecondsSaved"] = round(
+                    max(0.0, (expected - spent)) * chips, 3)
+            log.info("experiment %s/%s stopped trial %s early (%s)",
+                     ns, exp.name, t["name"], t["message"])
+
+    def _pbt_generation(self, client: KubeClient, manifest: dict,
+                        exp: Experiment, state: _ExpState,
+                        trials: list[dict], in_flight: int,
+                        budget_left: int) -> int:
+        """Generation step: gen 0 samples the population; each later
+        generation starts only when the previous one has fully drained,
+        replacing the bottom ``truncation`` fraction with perturbed
+        clones resuming from winners' checkpoints."""
+        pop = exp.parallelism
+        if not trials:
+            created = 0
+            for assignment in state.engine.suggest(
+                    min(pop, budget_left)):
+                trials.append(self._spawn_trial(
+                    client, manifest, exp, state, assignment,
+                    generation=0))
+                created += 1
+            return created
+        if in_flight > 0:
+            return 0  # generation still draining
+        gen = max(t.get("generation", 0) for t in trials)
+        cohort = [t for t in trials if t.get("generation", 0) == gen]
+        ranked = sorted(
+            (t for t in cohort if t["status"] in (T_SUCCEEDED, T_STOPPED)
+             and t.get("objective") is not None),
+            key=lambda t: exp.sign * t["objective"], reverse=True)
+        if not ranked:
+            return 0  # whole generation failed; completion path decides
+        n_replace = max(1, int(exp.pbt.truncation * len(ranked))) \
+            if exp.pbt else 1
+        created = 0
+        for i, t in enumerate(ranked):
+            if created >= budget_left:
+                break
+            if i >= len(ranked) - n_replace:
+                # exploit+explore: clone a top performer, perturb params
+                winner = ranked[i % max(1, len(ranked) - n_replace)]
+                params = self._perturb(exp, state,
+                                       winner.get("parameters", {}))
+                parent = winner
+            else:
+                params = dict(t.get("parameters", {}))
+                parent = t
+            trials.append(self._spawn_trial(
+                client, manifest, exp, state, params,
+                generation=gen + 1,
+                resume_from=parent.get("checkpointDir") or None,
+                parent=parent["name"]))
+            created += 1
+        return created
+
+    def _perturb(self, exp: Experiment, state: _ExpState,
+                 params: dict) -> dict:
+        out = dict(params)
+        for p in exp.parameters:
+            if p.name not in out:
+                continue
+            if p.type in ("double", "int"):
+                factor = state.rng.choice(exp.pbt.perturb_factors) \
+                    if exp.pbt else 1.2
+                v = float(out[p.name]) * factor
+                v = min(max(v, float(p.min)), float(p.max))
+                out[p.name] = int(round(v)) if p.type == "int" else v
+            else:
+                out[p.name] = state.rng.choice(p.values)
+        return out
+
+    def _spawn_trial(self, client: KubeClient, manifest: dict,
+                     exp: Experiment, state: _ExpState,
+                     assignment: dict[str, Any], generation: int = 0,
+                     resume_from: Optional[str] = None,
+                     parent: Optional[str] = None) -> dict:
+        ns = exp.namespace
+        trial_name = f"{exp.name}-t{state.next_index}"
+        state.next_index += 1
+        state.params[trial_name] = dict(assignment)
+
+        job = copy.deepcopy(exp.trial_template)
+        kind = job.get("kind", "TPUJob")
+        job.setdefault("apiVersion", _JOB_API[kind])
+        meta = job.setdefault("metadata", {})
+        meta["name"] = trial_name
+        meta["namespace"] = meta.get("namespace") or ns
+        labels = meta.setdefault("labels", {})
+        labels[EXPERIMENT_LABEL] = exp.name
+        labels[TRIAL_LABEL] = trial_name
+
+        subs = {"trialName": trial_name, "experimentName": exp.name}
+        for pname, v in assignment.items():
+            subs[f"param.{pname.lstrip('-')}"] = v
+        job = k8s.substitute_params(job, subs)
+        if exp.inject_parameters:
+            _inject_args(job, assignment)
+        if resume_from:
+            job.setdefault("spec", {})["resumeFrom"] = resume_from
+        # the warm-start enabler: tuned scalars become runtime inputs so
+        # this trial shares the namespace compile cache / AOT executable
+        # with every sibling of the same compile shape
+        _inject_env(job, {EXPERIMENT_ENV: exp.name,
+                          TRIAL_NAME_ENV: trial_name,
+                          "KFTPU_RUNTIME_SCHEDULE": "1"})
+        k8s.set_owner(job, manifest)
+        created = client.create(job)
+        created = ensure_trace_id(client, created)
+
+        trial = {"name": trial_name, "kind": kind, "status": T_PENDING,
+                 "parameters": dict(assignment), "objective": None,
+                 "generation": generation, "stoppedEarly": False,
+                 "startKind": "unknown",
+                 "chips": self._chips_of(job),
+                 "checkpointDir": (job.get("spec") or {}).get(
+                     "checkpointDir") or None}
+        tid = k8s.annotations_of(created).get(TRACE_ID_ANNOTATION)
+        if tid:
+            trial["traceId"] = tid
+        if parent:
+            trial["parent"] = parent
+        return trial
+
+    @staticmethod
+    def _chips_of(job: dict) -> int:
+        try:
+            tj = TrainingJob.from_manifest(job)
+            tpu = tj.tpu_spec
+            if tpu is not None and tpu.topology is not None:
+                return tpu.topology.num_chips * tpu.num_slices
+        except (ValueError, KeyError):
+            pass
+        return 0
+
+    # -- rollup --------------------------------------------------------------
+
+    def _best_trial(self, trials: list[dict],
+                    exp: Experiment) -> Optional[dict]:
+        best = None
+        for t in trials:
+            if t.get("objective") is None:
+                continue
+            if best is None or exp.better(t["objective"],
+                                          best["objective"]):
+                best = t
+        return best
+
+    def _rollup(self, status: dict, trials: list[dict],
+                best: Optional[dict], exp: Experiment) -> None:
+        n = {T_FAILED: 0, T_SUCCEEDED: 0, T_STOPPED: 0}
+        for t in trials:
+            if t["status"] in n:
+                n[t["status"]] += 1
+        done = sum(n.values())
+        status["trialsTotal"] = len(trials)
+        status["trialsRunning"] = len(trials) - done
+        status["trialsSucceeded"] = n[T_SUCCEEDED]
+        status["trialsFailed"] = n[T_FAILED]
+        status["trialsStopped"] = n[T_STOPPED]
+        if best is not None:
+            status["bestTrial"] = {"name": best["name"],
+                                   "parameters": best["parameters"],
+                                   "objective": best["objective"]}
+        elapsed_h = max(time.time() - float(status.get("startedAt")
+                                            or time.time()), 1e-9) / 3600
+        status["trialsPerHour"] = round(done / elapsed_h, 3)
+
+        chip_s = sum(t.get("chipSeconds", 0.0) or 0.0 for t in trials)
+        good_s = sum((t.get("goodputSeconds", 0.0) or 0.0)
+                     * (t.get("chips", 0) or 0) for t in trials)
+        saved_s = sum(t.get("chipSecondsSaved", 0.0) or 0.0
+                      for t in trials)
+        status["chipHours"] = {
+            "total": round(chip_s / 3600, 6),
+            "goodput": round(good_s / 3600, 6),
+            "badput": round(max(chip_s - good_s, 0.0) / 3600, 6),
+            "saved": round(saved_s / 3600, 6),
+        }
+        finished = [t for t in trials if t["status"] in _TERMINAL]
+        known = [t for t in finished[1:]
+                 if t.get("startKind") != "unknown"]
+        warm = sum(1 for t in known
+                   if t.get("startKind") in ("warm", "aot"))
+        status["warmStartFraction"] = round(warm / len(known), 4) \
+            if known else None
+
+        g = _experiment_gauges()
+        ns, name = exp.namespace, exp.name
+        for phase, count in (("Running", status["trialsRunning"]),
+                             ("Succeeded", n[T_SUCCEEDED]),
+                             ("Failed", n[T_FAILED]),
+                             ("Stopped", n[T_STOPPED])):
+            g["trials"].labels(namespace=ns, name=name,
+                               phase=phase).set(count)
+        if best is not None:
+            g["best"].labels(namespace=ns, name=name).set(
+                best["objective"])
+        g["tph"].labels(namespace=ns, name=name).set(
+            status["trialsPerHour"])
+        for cat, hours in status["chipHours"].items():
+            g["chip_hours"].labels(namespace=ns, name=name,
+                                   category=cat).set(hours)
+        if status["warmStartFraction"] is not None:
+            g["warm"].labels(namespace=ns, name=name).set(
+                status["warmStartFraction"])
+
+    # -- status plumbing -----------------------------------------------------
+
+    def _write_status(self, client: KubeClient, manifest: dict,
+                      status: dict) -> None:
+        fresh = client.get(EXPERIMENT_API_VERSION, EXPERIMENT_KIND,
+                           k8s.namespace_of(manifest, "default"),
+                           k8s.name_of(manifest))
+        merged = dict(fresh.get("status", {}))
+        merged.update({k: v for k, v in status.items()
+                       if k != "conditions"})
+        fresh["status"] = merged
+        client.update_status(fresh)
+
+    def _set_condition(self, client: KubeClient, manifest: dict,
+                       ctype: str, reason: str, message: str) -> None:
+        fresh = client.get(EXPERIMENT_API_VERSION, EXPERIMENT_KIND,
+                           k8s.namespace_of(manifest, "default"),
+                           k8s.name_of(manifest))
+        k8s.set_condition(fresh, k8s.Condition(ctype, "True", reason,
+                                               message))
+        client.update_status(fresh)
+
+    def _finish(self, client: KubeClient, manifest: dict, ctype: str,
+                reason: str, message: str,
+                status: Optional[dict] = None) -> None:
+        if status is not None:
+            self._write_status(client, manifest, status)
+        self._set_condition(client, manifest, ctype, reason, message)
+        log.info("experiment %s/%s finished: %s (%s)",
+                 k8s.namespace_of(manifest, "default"),
+                 k8s.name_of(manifest), ctype, message)

@@ -4,17 +4,18 @@ The reference deploys katib (vizier core + MySQL + per-algorithm suggestion
 services + studyjob-controller, kubeflow/katib/*.libsonnet). Here the same
 capability is native: suggestion algorithms are in-process engines
 (suggestion.py), the observation store is VizierDB with an optional HTTP
-front (vizier.py), and the StudyJob controller drives TPUJob trials through
-the same controller runtime as the training operator (studyjob.py).
+front (vizier.py), and the search object is the Experiment CRD
+(api/experiment.py) reconciled by controllers/experiment.py — the legacy
+StudyJob shape survives only as a compat converter (studyjob.py).
 """
 
 from .suggestion import (ParameterConfig, Suggestion, make_suggestion,
                          SUGGESTION_ALGORITHMS)
 from .vizier import VizierDB, VizierService
-from .studyjob import StudyJobReconciler
+from .studyjob import StudyJobCompatReconciler, studyjob_to_experiment
 
 __all__ = [
     "ParameterConfig", "Suggestion", "make_suggestion",
     "SUGGESTION_ALGORITHMS", "VizierDB", "VizierService",
-    "StudyJobReconciler",
+    "StudyJobCompatReconciler", "studyjob_to_experiment",
 ]

@@ -1,452 +1,230 @@
-"""StudyJob controller: HP-search trials as gang-scheduled training jobs.
+"""StudyJob v1alpha1 compat: convert to the Experiment API.
 
-The reference's studyjob-controller (deployed by
-kubeflow/katib/studyjobcontroller.libsonnet:294-323) runs the loop in
-SURVEY.md §3.5: ask a suggestion service for assignments, stamp them into the
-workerTemplate, create per-trial worker jobs, inject a metrics-collector, and
-iterate until done. Here the worker jobs are our TPUJob/TFJob kinds (so every
-trial is a gang-scheduled TPU slice), suggestions are in-process engines, and
-metric collection is the VizierDB contract (env-injected reporter URL or a
-``<trial>-metrics`` ConfigMap) instead of a log-scraping CronJob.
+The StudyJob shape (kind StudyJob, kubeflow.org/v1alpha1 — field names
+from kubeflow/examples/prototypes/katib-studyjob-test-v1alpha1.jsonnet)
+was the reference's HP-search object; this platform's native object is
+``Experiment`` (api/experiment.py), reconciled by
+controllers/experiment.py. Two competing search APIs must never coexist,
+so this module is now a THIN compat layer:
 
-StudyJob spec (kind StudyJob, kubeflow.org/v1alpha1 — schema registered by
-manifests/katib.py, field names from
-kubeflow/examples/prototypes/katib-studyjob-test-v1alpha1.jsonnet):
+- ``studyjob_to_experiment(manifest)`` — pure conversion of a StudyJob
+  manifest into an Experiment manifest (the admission-time migration
+  path; also what ``kftpu`` tooling uses to upgrade stored YAML).
+- ``StudyJobCompatReconciler`` — watches legacy StudyJob objects,
+  creates the converted Experiment (owner-ref'd for cascade delete),
+  and mirrors the Experiment's rollup + terminal conditions back onto
+  the StudyJob status so old clients keep seeing progress.
 
-  studyName, owner, optimizationtype: maximize|minimize, objectivevaluename,
-  metricsnames: [..], parameterconfigs: [{name, parametertype, feasible}],
+The trial loop itself (suggest → spawn → collect → early-stop → roll up)
+lives ONLY in controllers/experiment.py.
+
+StudyJob spec, for reference:
+
+  studyName, owner, optimizationtype: maximize|minimize,
+  objectivevaluename, metricsnames: [..],
+  parameterconfigs: [{name, parametertype, feasible: {min, max, list}}],
   suggestionSpec: {suggestionAlgorithm, requestNumber,
                    suggestionParameters: [{name, value}]},
-  workerSpec: {template: <TPUJob/TFJob/PyTorchJob/MPIJob manifest>,
-               injectParameters: true},
+  workerSpec: {template: <job manifest>, injectParameters: true},
   maxTrials, maxFailedTrials
 """
 
 from __future__ import annotations
 
 import logging
-from dataclasses import dataclass, field
-from typing import Any, Optional
 
 from ..api import k8s
-from ..api.trainingjob import (COND_CREATED, COND_FAILED, COND_RUNNING,
-                               COND_SUCCEEDED, JOB_KINDS, KF_API_VERSION_V1ALPHA1,
-                               KF_API_VERSION_V1BETA2, TPU_API_VERSION)
+from ..api.experiment import (DEFAULT_OBJECTIVE_METRIC,
+                              EXPERIMENT_API_VERSION, EXPERIMENT_KIND,
+                              OBSERVATION_ANNOTATION, TRIAL_LABEL)
+from ..api.trainingjob import (COND_FAILED, COND_RUNNING, COND_SUCCEEDED,
+                               KF_API_VERSION_V1ALPHA1)
 from ..cluster.client import KubeClient, NotFoundError
-from ..controllers.runtime import (Key, Reconciler, Result,
-                                   status_snapshot)
-from .suggestion import Suggestion, make_suggestion, parse_parameter_configs
-from .vizier import STUDY_ENV, TRIAL_ENV, VIZIER_URL_ENV, VizierDB
+from ..controllers.runtime import Key, Reconciler, Result, status_snapshot
 
 log = logging.getLogger(__name__)
 
 STUDYJOB_API_VERSION = KF_API_VERSION_V1ALPHA1
 STUDYJOB_KIND = "StudyJob"
-TRIAL_LABEL = "katib.kubeflow.org/trial"
 STUDY_LABEL = "katib.kubeflow.org/study"
-OBSERVATION_ANNOTATION = "kubeflow.org/observation"
 
-_JOB_API = {"TPUJob": TPU_API_VERSION, "TFJob": KF_API_VERSION_V1BETA2,
-            "PyTorchJob": KF_API_VERSION_V1BETA2,
-            "MPIJob": KF_API_VERSION_V1ALPHA1}
+__all__ = ["STUDYJOB_API_VERSION", "STUDYJOB_KIND", "STUDY_LABEL",
+           "TRIAL_LABEL", "OBSERVATION_ANNOTATION",
+           "studyjob_to_experiment", "StudyJobCompatReconciler"]
 
-# trial states recorded in StudyJob status
-T_PENDING = "Pending"
-T_RUNNING = "Running"
-T_SUCCEEDED = "Succeeded"
-T_FAILED = "Failed"
+# StudyJob algorithms with no Experiment equivalent degrade to random
+# search (grid survives; hyperband/bayesianoptimization were in-process
+# conveniences the Experiment API deliberately does not carry).
+_ALGORITHM_MAP = {"grid": "grid", "random": "random"}
 
 
-@dataclass
-class _StudyState:
-    """In-memory per-study state (suggestion engines are stateful; the
-    reference keeps the analog inside vizier-core + the suggestion service
-    processes). Rebuilt from status on controller restart."""
-    engine: Suggestion
-    sign: float
-    next_index: int = 0
-    # trial name -> exact parameter dict handed to the engine
-    params: dict[str, dict[str, Any]] = field(default_factory=dict)
-    collect_retries: dict[str, int] = field(default_factory=dict)
+def studyjob_to_experiment(manifest: dict) -> dict:
+    """Convert a StudyJob v1alpha1 manifest into an Experiment manifest.
+
+    Pure function of the input; raises ValueError on shapes that cannot
+    be expressed (missing workerSpec.template, empty parameterconfigs).
+    The result still goes through ``Experiment.from_manifest`` admission
+    when applied — this only maps field names.
+    """
+    if manifest.get("kind", STUDYJOB_KIND) != STUDYJOB_KIND:
+        raise ValueError(
+            f"kind {manifest.get('kind')!r} is not {STUDYJOB_KIND}")
+    meta = manifest.get("metadata", {}) or {}
+    spec = manifest.get("spec", {}) or {}
+
+    worker = spec.get("workerSpec", {}) or {}
+    template = worker.get("template")
+    if not template:
+        raise ValueError("workerSpec.template is required")
+
+    parameters = []
+    for pc in spec.get("parameterconfigs", []) or []:
+        feasible = pc.get("feasible", {}) or {}
+        p = {"name": pc.get("name"),
+             "type": pc.get("parametertype", "double")}
+        if feasible.get("min") is not None:
+            p["min"] = float(feasible["min"])
+        if feasible.get("max") is not None:
+            p["max"] = float(feasible["max"])
+        if feasible.get("list") is not None:
+            p["values"] = list(feasible["list"])
+        parameters.append(p)
+    if not parameters:
+        raise ValueError("parameterconfigs must name at least one "
+                         "search dimension")
+
+    sugg = spec.get("suggestionSpec", {}) or {}
+    algorithm = _ALGORITHM_MAP.get(
+        str(sugg.get("suggestionAlgorithm", "random")).lower(), "random")
+    settings = {p["name"]: p["value"]
+                for p in sugg.get("suggestionParameters", []) or []}
+    request = int(sugg.get("requestNumber", 3))
+
+    # StudyJob without maxTrials ran 4 rounds of requestNumber for
+    # open-ended samplers; grid enumerated itself. Experiment requires a
+    # finite budget, so grid gets a generous cap (its engine exhausts
+    # first) and the rest keep the 4-round default.
+    if spec.get("maxTrials") is not None:
+        max_trials = int(spec["maxTrials"])
+    elif algorithm == "grid":
+        max_trials = 1 << 10
+    else:
+        max_trials = 4 * request
+
+    exp_spec = {
+        "objective": {
+            "type": spec.get("optimizationtype", "minimize"),
+            "metric": spec.get("objectivevaluename",
+                               DEFAULT_OBJECTIVE_METRIC),
+        },
+        "algorithm": ({"name": algorithm, "settings": settings}
+                      if settings else {"name": algorithm}),
+        "parameters": parameters,
+        "maxTrials": max_trials,
+        "parallelism": max(1, request),
+        "trialTemplate": template,
+    }
+    if spec.get("maxFailedTrials") is not None:
+        exp_spec["maxFailedTrials"] = int(spec["maxFailedTrials"])
+    if not worker.get("injectParameters", True):
+        exp_spec["injectParameters"] = False
+
+    out_meta = {"name": meta.get("name", ""),
+                "namespace": meta.get("namespace", "default")}
+    labels = dict(meta.get("labels", {}) or {})
+    labels[STUDY_LABEL] = spec.get("studyName") or meta.get("name", "")
+    out_meta["labels"] = labels
+    return {"apiVersion": EXPERIMENT_API_VERSION, "kind": EXPERIMENT_KIND,
+            "metadata": out_meta, "spec": exp_spec}
 
 
-def _inject_env(manifest: dict, env: dict[str, str]) -> None:
-    """Append env vars to every container list in the manifest (the worker
-    template's shape varies by job kind, so walk generically)."""
-    def walk(node):
-        if isinstance(node, dict):
-            containers = node.get("containers")
-            if isinstance(containers, list):
-                for c in containers:
-                    if isinstance(c, dict):
-                        ce = c.setdefault("env", [])
-                        present = {e.get("name") for e in ce}
-                        for name, value in env.items():
-                            if name not in present:
-                                ce.append({"name": name, "value": value})
-            for v in node.values():
-                walk(v)
-        elif isinstance(node, list):
-            for v in node:
-                walk(v)
-    walk(manifest)
+#: status fields mirrored from the Experiment back onto the StudyJob
+_MIRROR_FIELDS = ("trials", "trialsTotal", "trialsRunning",
+                  "trialsSucceeded", "trialsFailed", "trialsStopped",
+                  "bestTrial", "trialsPerHour", "chipHours",
+                  "warmStartFraction")
 
 
-def _inject_args(manifest: dict, assignments: dict[str, Any]) -> None:
-    """Append ``--name=value`` pairs to the first container's args — the
-    katib workerTemplate idiom (parameter names are literal CLI flags,
-    katib-studyjob-test-v1alpha1.jsonnet parameterconfigs)."""
-    def first_containers(node):
-        if isinstance(node, dict):
-            containers = node.get("containers")
-            if isinstance(containers, list) and containers:
-                return containers
-            for v in node.values():
-                found = first_containers(v)
-                if found:
-                    return found
-        elif isinstance(node, list):
-            for v in node:
-                found = first_containers(v)
-                if found:
-                    return found
-        return None
+class StudyJobCompatReconciler(Reconciler):
+    """Legacy adapter: StudyJob → owned Experiment, status mirrored back.
 
-    containers = first_containers(manifest) or []
-    for c in containers:
-        args = c.setdefault("args", [])
-        for name, value in assignments.items():
-            flag = name if name.startswith("-") else f"--{name}"
-            args.append(f"{flag}={value}")
+    Deliberately does NOT run trials. The owned Experiment is the single
+    source of truth; deleting the StudyJob cascades to the Experiment
+    (and through it to the trial jobs).
+    """
 
-
-class StudyJobReconciler(Reconciler):
     primary = (STUDYJOB_API_VERSION, STUDYJOB_KIND)
-    owns = [(TPU_API_VERSION, "TPUJob"), (KF_API_VERSION_V1BETA2, "TFJob"),
-            (KF_API_VERSION_V1BETA2, "PyTorchJob"),
-            (KF_API_VERSION_V1ALPHA1, "MPIJob")]
+    owns = [(EXPERIMENT_API_VERSION, EXPERIMENT_KIND)]
 
-    #: how many reconciles to wait for a finished trial's metrics before
-    #: declaring them unavailable (the metrics-collector retry budget)
-    max_collect_retries = 5
-
-    def __init__(self, vizier: Optional[VizierDB] = None,
-                 vizier_url: Optional[str] = None, seed: int = 0):
-        self.vizier = vizier or VizierDB()
-        self.vizier_url = vizier_url
-        self.seed = seed
-        self._states: dict[str, _StudyState] = {}
-
-    # -- state ---------------------------------------------------------------
-
-    def _study_id(self, manifest: dict) -> str:
-        return manifest.get("metadata", {}).get("uid") or k8s.name_of(manifest)
-
-    def _engine_state(self, manifest: dict) -> _StudyState:
-        sid = self._study_id(manifest)
-        if sid in self._states:
-            return self._states[sid]
-        spec = manifest.get("spec", {})
-        sugg = spec.get("suggestionSpec", {}) or {}
-        settings = {p["name"]: p["value"]
-                    for p in sugg.get("suggestionParameters", []) or []}
-        params = parse_parameter_configs(spec.get("parameterconfigs", []))
-        engine = make_suggestion(sugg.get("suggestionAlgorithm", "random"),
-                                 params, seed=self.seed, settings=settings)
-        sign = -1.0 if spec.get("optimizationtype", "minimize") == "minimize" \
-            else 1.0
-        state = _StudyState(engine=engine, sign=sign)
-        # restart recovery: replay finished trials from status so the engine
-        # (and grid cursor) catch up to where the previous process stopped
-        trials = manifest.get("status", {}).get("trials", []) or []
-        if trials:
-            state.next_index = len(trials)
-            replayed = engine.suggest(len(trials))  # advance grid/hyperband
-            del replayed
-            for t in trials:
-                state.params[t["name"]] = t.get("parameters", {})
-                if t.get("status") == T_SUCCEEDED and t.get("objective") is not None:
-                    engine.observe(t.get("parameters", {}),
-                                   state.sign * float(t["objective"]))
-                elif t.get("status") == T_FAILED:
-                    # failed trials must settle too, or hyperband's pending
-                    # queue re-suggests known-failed configs after restart
-                    engine.observe_failure(t.get("parameters", {}))
-        self._states[sid] = state
-        return state
-
-    # -- reconcile -----------------------------------------------------------
+    def __init__(self, **_legacy):
+        # vizier=/vizier_url=/seed= accepted for drop-in compatibility
+        # with the retired StudyJobReconciler signature, ignored: metric
+        # collection now rides the Experiment contract.
+        if _legacy:
+            log.debug("StudyJobCompatReconciler ignoring legacy "
+                      "arguments: %s", sorted(_legacy))
 
     def reconcile(self, client: KubeClient, key: Key) -> Result:
         ns, name = key
         try:
-            manifest = client.get(STUDYJOB_API_VERSION, STUDYJOB_KIND, ns, name)
+            manifest = client.get(STUDYJOB_API_VERSION, STUDYJOB_KIND,
+                                  ns, name)
         except NotFoundError:
-            return Result()  # cascade deletion reaps trials via owner refs
+            return Result()  # owner ref cascades the Experiment away
 
-        status = manifest.setdefault("status", {})
         if k8s.condition_true(manifest, COND_SUCCEEDED) or \
                 k8s.condition_true(manifest, COND_FAILED):
             return Result()
-        status_before = status_snapshot(status)
 
-        spec = manifest.get("spec", {})
-        study = spec.get("studyName") or name
-        objective = spec.get("objectivevaluename", "loss")
-        self.vizier.create_study(
-            study, objective_name=objective,
-            optimization_type=spec.get("optimizationtype", "minimize"),
-            metrics_names=spec.get("metricsnames"))
-
-        worker = spec.get("workerSpec", {}) or {}
-        template = worker.get("template")
-        if not template:
-            self._finish(client, manifest, COND_FAILED,
-                         "InvalidSpec", "workerSpec.template is required")
-            return Result()
-        kind = template.get("kind", "TPUJob")
-        if kind not in JOB_KINDS:
-            self._finish(client, manifest, COND_FAILED, "InvalidSpec",
-                         f"workerSpec.template kind {kind!r} not one of "
-                         f"{JOB_KINDS}")
+        exp = client.get_or_none(EXPERIMENT_API_VERSION, EXPERIMENT_KIND,
+                                 ns, name)
+        if exp is None:
+            try:
+                exp = studyjob_to_experiment(manifest)
+            except ValueError as e:
+                self._set_condition(client, manifest, COND_FAILED,
+                                    "InvalidSpec", str(e))
+                return Result()
+            k8s.set_owner(exp, manifest)
+            client.create(exp)
+            log.info("studyjob %s/%s converted to Experiment", ns, name)
             return Result()
 
-        try:
-            state = self._engine_state(manifest)
-        except ValueError as e:
-            self._finish(client, manifest, COND_FAILED, "InvalidSpec", str(e))
-            return Result()
+        # mirror the experiment's rollup + terminal state
+        status = dict(manifest.get("status", {}) or {})
+        before = status_snapshot(status)
+        exp_status = exp.get("status", {}) or {}
+        for f in _MIRROR_FIELDS:
+            if f in exp_status:
+                status[f] = exp_status[f]
+        if status_snapshot(status) != before:
+            fresh = client.get(STUDYJOB_API_VERSION, STUDYJOB_KIND, ns,
+                               name)
+            merged = dict(fresh.get("status", {}))
+            merged.update(
+                {k: v for k, v in status.items() if k != "conditions"})
+            fresh["status"] = merged
+            client.update_status(fresh)
 
-        if not k8s.condition_true(manifest, COND_CREATED):
-            self._set_condition(client, manifest, COND_CREATED,
-                                "StudyJobCreated", f"study {study} registered")
-            manifest = client.get(STUDYJOB_API_VERSION, STUDYJOB_KIND, ns, name)
-            status = manifest.setdefault("status", {})
-
-        trials: list[dict] = status.get("trials", []) or []
-
-        # 1. sync trial states from worker jobs; collect objectives
-        pending_collect = False
-        for t in trials:
-            if t["status"] in (T_SUCCEEDED, T_FAILED):
-                continue
-            job = client.get_or_none(_JOB_API[t["kind"]], t["kind"], ns,
-                                     t["name"])
-            if job is None:
-                t["status"] = T_FAILED
-                t["message"] = "worker job disappeared"
-                state.engine.observe_failure(
-                    state.params.get(t["name"], t.get("parameters", {})))
-                continue
-            if k8s.condition_true(job, COND_FAILED):
-                t["status"] = T_FAILED
-                self.vizier.set_trial_status(study, t["name"], T_FAILED)
-                state.engine.observe_failure(
-                    state.params.get(t["name"], t.get("parameters", {})))
-            elif k8s.condition_true(job, COND_SUCCEEDED):
-                done = self._collect(client, study, ns, t, state, job)
-                pending_collect = pending_collect or not done
-            elif k8s.condition_true(job, COND_RUNNING):
-                t["status"] = T_RUNNING
-                self.vizier.set_trial_status(study, t["name"], T_RUNNING)
-
-        max_trials = self._max_trials(spec, state.engine)
-        max_failed = int(spec.get("maxFailedTrials", max_trials or 1 << 30))
-        n_failed = sum(1 for t in trials if t["status"] == T_FAILED)
-        n_done = sum(1 for t in trials if t["status"] in (T_SUCCEEDED, T_FAILED))
-        outstanding = len(trials) - n_done
-
-        # 2. schedule the next batch once the current round has drained
-        created = 0
-        if outstanding == 0 and not pending_collect and \
-                n_failed <= max_failed and \
-                (max_trials is None or len(trials) < max_trials):
-            request = int((spec.get("suggestionSpec") or {})
-                          .get("requestNumber", 3))
-            if max_trials is not None:
-                request = min(request, max_trials - len(trials))
-            assignments = state.engine.suggest(request) if request > 0 else []
-            for assignment in assignments:
-                trial = self._spawn_trial(client, manifest, study, assignment,
-                                          state)
-                trials.append(trial)
-                created += 1
-
-        # 3. roll up status + completion
-        n_failed = sum(1 for t in trials if t["status"] == T_FAILED)
-        n_done = sum(1 for t in trials if t["status"] in (T_SUCCEEDED, T_FAILED))
-        status["trials"] = trials
-        status["trialsTotal"] = len(trials)
-        status["trialsRunning"] = len(trials) - n_done
-        status["trialsSucceeded"] = n_done - n_failed
-        status["trialsFailed"] = n_failed
-        best = self.vizier.best_trial(study)
-        if best is not None:
-            status["bestTrial"] = {"name": best.name,
-                                   "parameters": best.parameters,
-                                   "objective": best.objective}
-
-        if n_failed > max_failed:
-            self._finish(client, manifest, COND_FAILED, "TrialsFailed",
-                         f"{n_failed} trials failed (max {max_failed})",
-                         status)
-            return Result()
-
-        exhausted = state.engine.exhausted() or \
-            (max_trials is not None and len(trials) >= max_trials)
-        if n_done == len(trials) and created == 0 and not pending_collect \
-                and exhausted:
-            if status.get("trialsSucceeded", 0) == 0:
-                self._finish(client, manifest, COND_FAILED, "NoSuccessfulTrial",
-                             "all trials failed", status)
-            else:
-                msg = (f"best trial {best.name} objective {best.objective}"
-                       if best else "completed")
-                self._finish(client, manifest, COND_SUCCEEDED,
-                             "StudyCompleted", msg, status)
-            return Result()
-
-        if status_snapshot(status) != status_before:
-            self._write_status(client, manifest, status)
-        if not k8s.condition_true(manifest, COND_RUNNING) and trials:
+        for ctype, reason in ((COND_SUCCEEDED, "StudyCompleted"),
+                              (COND_FAILED, "ExperimentFailed")):
+            if k8s.condition_true(exp, ctype) and \
+                    not k8s.condition_true(manifest, ctype):
+                self._set_condition(
+                    client, manifest, ctype, reason,
+                    f"mirrored from Experiment {ns}/{name}")
+                return Result()
+        if k8s.condition_true(exp, COND_RUNNING) and \
+                not k8s.condition_true(manifest, COND_RUNNING):
             self._set_condition(client, manifest, COND_RUNNING,
                                 "TrialsRunning", "trials in progress")
-        return Result(requeue_after=0.05) if pending_collect else Result()
+        return Result()
 
-    # -- pieces --------------------------------------------------------------
-
-    def _max_trials(self, spec: dict, engine: Suggestion) -> Optional[int]:
-        if spec.get("maxTrials") is not None:
-            return int(spec["maxTrials"])
-        algo = ((spec.get("suggestionSpec") or {})
-                .get("suggestionAlgorithm", "random")).lower()
-        # grid/hyperband carry their own termination; open-ended samplers
-        # need a budget (katib v1alpha1 used requestcount rounds; we default
-        # to 4 rounds of requestNumber)
-        if algo in ("grid", "hyperband"):
-            return None
-        request = int((spec.get("suggestionSpec") or {})
-                      .get("requestNumber", 3))
-        return 4 * request
-
-    def _collect(self, client: KubeClient, study: str, ns: str, trial: dict,
-                 state: _StudyState, job: dict) -> bool:
-        """Objective collection, in priority order: vizier observation →
-        <trial>-metrics ConfigMap → observation annotation on the worker job.
-        Returns True when the trial reached a terminal collection state."""
-        name = trial["name"]
-        value = self.vizier.objective_of(study, name)
-        if value is None:
-            cm = client.get_or_none("v1", "ConfigMap", ns, f"{name}-metrics")
-            if cm is not None:
-                for metric, raw in (cm.get("data") or {}).items():
-                    try:
-                        self.vizier.report(study, name, metric, float(raw))
-                    except ValueError:
-                        continue
-                value = self.vizier.objective_of(study, name)
-        if value is None:
-            raw = k8s.annotations_of(job).get(OBSERVATION_ANNOTATION)
-            if raw:
-                try:
-                    import json as _json
-                    obs = _json.loads(raw)
-                    for metric, v in obs.items():
-                        self.vizier.report(study, name, metric, float(v))
-                    value = self.vizier.objective_of(study, name)
-                except (ValueError, AttributeError):
-                    pass
-        if value is None:
-            n = state.collect_retries.get(name, 0) + 1
-            state.collect_retries[name] = n
-            if n < self.max_collect_retries:
-                return False  # requeue; metrics may still be in flight
-            trial["status"] = T_FAILED
-            trial["message"] = "objective metrics unavailable"
-            self.vizier.set_trial_status(study, name, T_FAILED)
-            state.engine.observe_failure(
-                state.params.get(name, trial.get("parameters", {})))
-            return True
-        trial["status"] = T_SUCCEEDED
-        trial["objective"] = value
-        self.vizier.set_trial_status(study, name, T_SUCCEEDED)
-        rec = self.vizier.get_study(study).trials.get(name)
-        if rec is not None:
-            rec.objective = value
-        state.engine.observe(state.params.get(name, trial.get("parameters", {})),
-                             state.sign * value)
-        return True
-
-    def _spawn_trial(self, client: KubeClient, manifest: dict, study: str,
-                     assignment: dict[str, Any], state: _StudyState) -> dict:
-        ns = k8s.namespace_of(manifest, "default")
-        name = k8s.name_of(manifest)
-        trial_name = f"{name}-trial-{state.next_index}"
-        state.next_index += 1
-        state.params[trial_name] = dict(assignment)
-
-        spec = manifest.get("spec", {})
-        worker = spec.get("workerSpec", {}) or {}
-        import copy as _copy
-        job = _copy.deepcopy(worker["template"])
-        kind = job.get("kind", "TPUJob")
-        if kind not in JOB_KINDS:
-            raise ValueError(f"workerSpec.template kind {kind!r} not one of "
-                             f"{JOB_KINDS}")
-        job.setdefault("apiVersion", _JOB_API[kind])
-        meta = job.setdefault("metadata", {})
-        meta["name"] = trial_name
-        meta["namespace"] = ns
-        labels = meta.setdefault("labels", {})
-        labels[STUDY_LABEL] = name
-        labels[TRIAL_LABEL] = trial_name
-
-        # $(param.<name>) / $(trialName) placeholders, then the katib
-        # flag-append idiom unless disabled
-        subs = {"trialName": trial_name, "studyName": study}
-        for pname, v in assignment.items():
-            subs[f"param.{pname.lstrip('-')}"] = v
-        job = k8s.substitute_params(job, subs)
-        if worker.get("injectParameters", True):
-            _inject_args(job, assignment)
-
-        env = {STUDY_ENV: study, TRIAL_ENV: trial_name}
-        if self.vizier_url:
-            env[VIZIER_URL_ENV] = self.vizier_url
-        _inject_env(job, env)
-
-        k8s.set_owner(job, manifest)
-        client.create(job)
-        self.vizier.register_trial(study, trial_name, dict(assignment))
-        return {"name": trial_name, "kind": kind, "status": T_PENDING,
-                "parameters": dict(assignment), "objective": None}
-
-    # -- status plumbing -----------------------------------------------------
-
-    def _write_status(self, client: KubeClient, manifest: dict,
-                      status: dict) -> None:
+    def _set_condition(self, client: KubeClient, manifest: dict,
+                       ctype: str, reason: str, message: str) -> None:
         fresh = client.get(STUDYJOB_API_VERSION, STUDYJOB_KIND,
                            k8s.namespace_of(manifest, "default"),
                            k8s.name_of(manifest))
-        merged = dict(fresh.get("status", {}))
-        merged.update({k: v for k, v in status.items() if k != "conditions"})
-        fresh["status"] = merged
+        k8s.set_condition(fresh, k8s.Condition(ctype, "True", reason,
+                                               message))
         client.update_status(fresh)
-
-    def _set_condition(self, client: KubeClient, manifest: dict, ctype: str,
-                       reason: str, message: str) -> None:
-        fresh = client.get(STUDYJOB_API_VERSION, STUDYJOB_KIND,
-                           k8s.namespace_of(manifest, "default"),
-                           k8s.name_of(manifest))
-        k8s.set_condition(fresh, k8s.Condition(ctype, "True", reason, message))
-        client.update_status(fresh)
-
-    def _finish(self, client: KubeClient, manifest: dict, ctype: str,
-                reason: str, message: str,
-                status: Optional[dict] = None) -> None:
-        if status is not None:
-            self._write_status(client, manifest, status)
-        self._set_condition(client, manifest, ctype, reason, message)
-        log.info("studyjob %s/%s finished: %s (%s)",
-                 k8s.namespace_of(manifest, "default"), k8s.name_of(manifest),
-                 ctype, message)

@@ -7,6 +7,8 @@ studyjobcontroller.libsonnet:131-147,294-323,368-408).
 
 from __future__ import annotations
 
+from ..api import k8s
+from ..api.trainingjob import KF_API_VERSION_V1ALPHA1, TPU_API_VERSION
 from . import helpers as H
 from .registry import register
 
@@ -40,6 +42,37 @@ def katib(namespace: str = "kubeflow",
                               }}}})
     out.append(study_crd)
 
+    # Experiment CRD: the native search object (api/experiment.py) —
+    # StudyJobs survive only as a compat shape converted into Experiments
+    # by katib/studyjob.py
+    exp_crd = H.crd("experiments", "Experiment", "kubeflow.org",
+                    ["v1alpha1"],
+                    schema={
+                        "type": "object",
+                        "properties": {"spec": {
+                            "type": "object",
+                            "properties": {
+                                "objective": {
+                                    "type": "object",
+                                    "properties": {
+                                        "type": {"type": "string",
+                                                 "enum": ["maximize",
+                                                          "minimize"]},
+                                        "metric": {"type": "string"},
+                                        "goal": {"type": "number"},
+                                    }},
+                                "algorithm": {"type": "object"},
+                                "parameters": {"type": "array"},
+                                "maxTrials": {"type": "integer"},
+                                "parallelism": {"type": "integer"},
+                                "maxFailedTrials": {"type": "integer"},
+                                "earlyStopping": {"type": "object"},
+                                "pbt": {"type": "object"},
+                                "trialTemplate": {"type": "object"},
+                                "injectParameters": {"type": "boolean"},
+                            }}}})
+    out.append(exp_crd)
+
     # vizier core + db (vizier.libsonnet:4-20)
     db = H.deployment("vizier-db", namespace, f"{IMG}/mysql:{VERSION}",
                       port=3306, env={"MYSQL_ROOT_PASSWORD": "vizier",
@@ -70,8 +103,8 @@ def katib(namespace: str = "kubeflow",
     sa = H.service_account("studyjob-controller", namespace)
     role = H.cluster_role("studyjob-controller", [
         {"apiGroups": ["kubeflow.org", "tpu.kubeflow.org"],
-         "resources": ["studyjobs", "tfjobs", "pytorchjobs", "tpujobs",
-                       "mpijobs"], "verbs": ["*"]},
+         "resources": ["studyjobs", "experiments", "tfjobs", "pytorchjobs",
+                       "tpujobs", "mpijobs"], "verbs": ["*"]},
         {"apiGroups": ["batch"], "resources": ["jobs", "cronjobs"],
          "verbs": ["*"]},
         {"apiGroups": [""], "resources": ["pods", "pods/log", "configmaps"],
@@ -90,3 +123,55 @@ def katib(namespace: str = "kubeflow",
     })
     out += [sa, role, binding, ctrl, mc_template]
     return out
+
+
+@register("tpu-experiment-example", "Example Experiment: grid search over "
+                                    "the ResNet-50 TPUJob's learning rate "
+                                    "with median early stopping (the native "
+                                    "search object reconciled by "
+                                    "controllers/experiment.py)")
+def tpu_experiment_example(namespace: str = "kubeflow",
+                           name: str = "experiment-example",
+                           max_trials: int = 8,
+                           parallelism: int = 4) -> list[dict]:
+    """Canonical Experiment example: grid over learning rate with median
+    early stopping. The reconciler injects KFTPU_RUNTIME_SCHEDULE=1 into
+    every trial so lr-variant trials share one compiled executable
+    (compile-shape fingerprint split, runtime/recipe.py)."""
+    exp = k8s.make(KF_API_VERSION_V1ALPHA1, "Experiment", name, namespace)
+    exp["spec"] = {
+        "objective": {"type": "maximize", "metric": "accuracy"},
+        "algorithm": {"name": "grid", "settings": {"DefaultGrid": 8}},
+        "parameters": [
+            {"name": "--learning-rate", "type": "double",
+             "min": 0.01, "max": 0.3},
+        ],
+        "maxTrials": max_trials,
+        "parallelism": parallelism,
+        "maxFailedTrials": 2,
+        "earlyStopping": {"policy": "median", "minTrials": 3,
+                          "startWindow": 2},
+        "trialTemplate": {
+            "apiVersion": TPU_API_VERSION, "kind": "TPUJob",
+            "metadata": {"name": "$(trialName)", "namespace": namespace},
+            "spec": {
+                "replicaSpecs": {"TPU": {
+                    "tpuTopology": "v5e-8",
+                    "template": {"spec": {"containers": [{
+                        "name": "worker",
+                        "image": f"{IMG}/worker:{VERSION}",
+                        "command": [
+                            "python", "-m",
+                            "kubeflow_tpu.runtime.worker",
+                            "--workload", "resnet50",
+                            "--steps", "200"],
+                    }]}},
+                }},
+                "runPolicy": {"backoffLimit": 1},
+                "sharding": {"data": -1},
+                "checkpointDir": "/checkpoints/$(experimentName)/"
+                                 "$(trialName)",
+            },
+        },
+    }
+    return [exp]

@@ -17,7 +17,7 @@ pytree so XLA sees a fixed program.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import optax
@@ -43,15 +43,16 @@ STEP_FACTOR = 0.1
 
 
 def recipe_fingerprint(**knobs) -> str:
-    """Stable hash of everything recipe-shaped that is BAKED into the
-    compiled train step — model/workload identity, optimizer family and
-    its scalars, LR schedule (base lr, warmup, total steps: schedules
-    are traced functions whose constants land in the HLO), weight decay,
-    label smoothing. One half of the AOT executable key
-    (runtime/aot.py step_key); the other half is the geometry the
-    caller supplies there. Values must be JSON-able; unhashable knobs
-    fall back to repr so a novel workload kwarg degrades to a unique
-    (never-colliding-by-silence) fingerprint rather than an error."""
+    """Stable hash of the WHOLE recipe — model/workload identity,
+    optimizer family and its scalars, LR schedule constants, weight
+    decay, label smoothing. This is trial/run identity (checkpoints,
+    ledgers, logs). For the AOT executable / compile-cache key the
+    worker uses ``compile_shape_fingerprint`` instead when the tuned
+    scalars (lr, warmup, total steps) are RUNTIME inputs rather than
+    trace-time constants — see RUNTIME_CONSTANT_KNOBS. Values must be
+    JSON-able; unhashable knobs fall back to repr so a novel workload
+    kwarg degrades to a unique (never-colliding-by-silence) fingerprint
+    rather than an error."""
     import hashlib
     import json
 
@@ -60,6 +61,46 @@ def recipe_fingerprint(**knobs) -> str:
 
     blob = json.dumps(knobs, sort_keys=True, default=default).encode()
     return hashlib.sha256(blob).hexdigest()[:24]
+
+
+# The tuned-scalar knobs that stop being compile-time constants when the
+# runtime schedule is active (make_optimizer(runtime_schedule=True)):
+# they live in the optimizer STATE as device scalars, so the traced HLO
+# is identical for any value — and they must NOT key the AOT executable
+# or the persistent compile cache, or a hyperparameter sweep would pay
+# one cold compile per trial for byte-identical programs. Accepts both
+# the worker's kwarg names and the generic shorthand used in tests.
+RUNTIME_CONSTANT_KNOBS = frozenset({
+    "learning_rate", "lr", "warmup_steps", "steps", "total_steps"})
+
+
+def split_recipe_knobs(knobs: dict) -> tuple[dict, dict]:
+    """Partition recipe knobs into (compile-shape, runtime-constants).
+    The compile-shape side is everything that changes the traced
+    program; the runtime side is the tuned scalars a runtime-schedule
+    trial feeds in as data."""
+    shape = {k: v for k, v in knobs.items()
+             if k not in RUNTIME_CONSTANT_KNOBS}
+    runtime = {k: v for k, v in knobs.items()
+               if k in RUNTIME_CONSTANT_KNOBS}
+    return shape, runtime
+
+
+def compile_shape_fingerprint(**knobs) -> str:
+    """The AOT/compile-cache half of the split key: hash of every knob
+    EXCEPT the runtime constants. Two trials differing only in lr /
+    warmup / total steps share this fingerprint — and therefore (with
+    the runtime schedule active) one cached executable."""
+    shape, _ = split_recipe_knobs(knobs)
+    return recipe_fingerprint(**shape)
+
+
+def runtime_constants_key(**knobs) -> str:
+    """Hash of ONLY the runtime-constant knobs — the other half of the
+    split: trial identity within a shared compile shape (ledgers, PBT
+    lineage), never part of the executable key."""
+    _, runtime = split_recipe_knobs(knobs)
+    return recipe_fingerprint(**runtime)
 
 
 def scale_lr(base_lr: float, global_batch: int, base_batch: int = 256
@@ -105,6 +146,94 @@ def lr_schedule(name: str, base_lr: float, total_steps: int,
     return optax.join_schedules([warmup, decay], [warmup_steps])
 
 
+def _runtime_lr_at(name: str, count, base_lr, warmup_steps, total_steps, *,
+                   end_scale: float = 0.0,
+                   boundaries: tuple = STEP_BOUNDARIES,
+                   factor: float = STEP_FACTOR):
+    """``lr_schedule`` re-derived as traced jnp math over RUNTIME scalar
+    inputs. The schedule NAME (and step boundaries/factor) stay static —
+    they change the program — but base_lr/warmup/total arrive as device
+    scalars, so every lr-variant trial lowers to byte-identical HLO.
+    Semantics mirror the optax chain exactly: linear 0→base warmup over
+    min(warmup, total) steps, then the named decay over
+    max(total−warmup, 1) steps; step-decay factors apply at
+    count ≥ boundary and compound on collision."""
+    import jax.numpy as jnp
+    if name not in SCHEDULES:
+        raise ValueError(f"schedule {name!r} not one of {SCHEDULES}")
+    count = jnp.asarray(count, jnp.float32)
+    base = jnp.asarray(base_lr, jnp.float32)
+    total = jnp.maximum(jnp.asarray(total_steps, jnp.float32), 1.0)
+    warm = jnp.clip(jnp.asarray(warmup_steps, jnp.float32), 0.0, total)
+    decay_steps = jnp.maximum(total - warm, 1.0)
+    t = jnp.clip((count - warm) / decay_steps, 0.0, 1.0)
+
+    if name == "constant":
+        decayed = base
+    elif name == "cosine":
+        cosine = 0.5 * (1.0 + jnp.cos(jnp.pi * t))
+        decayed = base * ((1.0 - end_scale) * cosine + end_scale)
+    elif name == "linear":
+        decayed = base + (base * end_scale - base) * t
+    else:  # step
+        decayed = base
+        for b in boundaries:
+            k = jnp.maximum(jnp.round(b * decay_steps), 1.0)
+            decayed = decayed * jnp.where((count - warm) >= k, factor, 1.0)
+
+    warm_frac = jnp.clip(count / jnp.maximum(warm, 1.0), 0.0, 1.0)
+    return jnp.where(count < warm, base * warm_frac, decayed)
+
+
+class RuntimeLRState(NamedTuple):
+    """Tuned scalars ride in the optimizer STATE — jitted-step inputs,
+    not trace-time constants — which is the whole trick: the compiled
+    executable is shared across trials, each trial's values live in its
+    own state (and checkpoint, so restores keep the trial's schedule)."""
+    count: object   # int32 scalar: updates applied so far
+    base_lr: object       # float32 scalar
+    warmup_steps: object  # float32 scalar
+    total_steps: object   # float32 scalar
+
+
+def scale_by_runtime_lr(schedule: str = "constant",
+                        learning_rate: float = 0.1,
+                        total_steps: int = 1, warmup_steps: int = 0, *,
+                        end_scale: float = 0.0,
+                        boundaries: tuple = STEP_BOUNDARIES,
+                        factor: float = STEP_FACTOR
+                        ) -> "optax.GradientTransformation":
+    """Multiply updates by lr(count) computed from runtime state. Chains
+    AFTER a base optimizer built at lr=1.0: every stock optimizer here
+    ends in scale(-lr), so unit-lr descent direction × runtime lr is
+    mathematically identical to the baked schedule (momentum traces and
+    adam statistics accumulate pre-scale either way). The multiply is
+    POSITIVE — the base chain already applied the minus sign."""
+    import jax.numpy as jnp
+    if schedule not in SCHEDULES:
+        raise ValueError(f"schedule {schedule!r} not one of {SCHEDULES}")
+
+    def init_fn(params):
+        del params
+        return RuntimeLRState(
+            count=jnp.zeros([], jnp.int32),
+            base_lr=jnp.asarray(learning_rate, jnp.float32),
+            warmup_steps=jnp.asarray(warmup_steps, jnp.float32),
+            total_steps=jnp.asarray(total_steps, jnp.float32))
+
+    def update_fn(updates, state, params=None):
+        del params
+        lr = _runtime_lr_at(schedule, state.count, state.base_lr,
+                            state.warmup_steps, state.total_steps,
+                            end_scale=end_scale, boundaries=boundaries,
+                            factor=factor)
+        updates = jax.tree.map(lambda u: (lr * u.astype(jnp.float32)
+                                          ).astype(u.dtype), updates)
+        return updates, state._replace(count=state.count + 1)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def decay_mask(params) -> object:
     """Weight decay applies to kernels only — never to biases or
     BatchNorm scales/offsets (rank-1 leaves), the standard ResNet rule."""
@@ -122,6 +251,7 @@ def make_optimizer(
     momentum: float = 0.9,
     grad_clip: Optional[float] = 1.0,
     kernels: str = "stock",
+    runtime_schedule: bool = False,
 ) -> tuple[optax.GradientTransformation, optax.Schedule]:
     """One optax chain for the whole recipe. Returns (transform, schedule);
     the schedule is also returned alone so callers can log lr(step).
@@ -132,13 +262,32 @@ def make_optimizer(
     kernel (ops/fused_adam.py — parity ≤1e-5 vs this function's stock
     chain). Cross-leaf global-norm clipping stays a separate outer
     transform either way. The tier is baked into recipe_fingerprint by
-    the worker, so a flip can never alias a cached executable."""
+    the worker, so a flip can never alias a cached executable.
+
+    ``runtime_schedule`` builds the base optimizer at unit lr and chains
+    ``scale_by_runtime_lr`` after it, moving lr/warmup/total_steps out of
+    the traced constants and into optimizer state — the enabler for
+    hyperparameter-sweep trials sharing one AOT executable (the worker
+    keys the compile cache on ``compile_shape_fingerprint`` when this is
+    on). Numerically identical to the baked schedule for every stock
+    optimizer. Incompatible with 'fused_adam', which consumes the
+    schedule inside the fused kernel."""
     if name not in OPTIMIZERS:
         raise ValueError(f"optimizer {name!r} not one of {OPTIMIZERS}")
     if kernels not in OPTIMIZER_KERNELS:
         raise ValueError(
             f"kernels.optimizer {kernels!r} not one of {OPTIMIZER_KERNELS}")
+    if runtime_schedule and kernels == "fused_adam":
+        # reject, don't silently downgrade: the fused kernel bakes
+        # sched(count) into its launch, so "runtime" lr would be a lie
+        raise ValueError(
+            "runtime_schedule is incompatible with kernels.optimizer "
+            "'fused_adam' (the fused kernel bakes the schedule); use the "
+            "stock chain for swept trials")
     sched = lr_schedule(schedule, learning_rate, total_steps, warmup_steps)
+    # With the runtime schedule, the inner optimizer runs at unit lr and
+    # the trailing scale_by_runtime_lr supplies lr(count) from state.
+    inner: object = 1.0 if runtime_schedule else sched
 
     if kernels == "fused_adam":
         # reject, don't silently downgrade: a requested fused tier that
@@ -166,20 +315,44 @@ def make_optimizer(
         txs.append(optax.add_decayed_weights(weight_decay, mask=decay_mask))
 
     if name == "sgd":
-        txs.append(optax.sgd(sched))
+        txs.append(optax.sgd(inner))
     elif name == "momentum":
-        txs.append(optax.sgd(sched, momentum=momentum))
+        txs.append(optax.sgd(inner, momentum=momentum))
     elif name == "nesterov":
-        txs.append(optax.sgd(sched, momentum=momentum, nesterov=True))
+        txs.append(optax.sgd(inner, momentum=momentum, nesterov=True))
     elif name == "adam":
-        txs.append(optax.adam(sched))
+        txs.append(optax.adam(inner))
     elif name == "adamw":
-        txs.append(optax.adamw(sched, weight_decay=weight_decay,
+        txs.append(optax.adamw(inner, weight_decay=weight_decay,
                                mask=decay_mask))
     elif name == "lars":
-        txs.append(optax.lars(sched, weight_decay=weight_decay,
-                              weight_decay_mask=decay_mask,
-                              momentum=momentum))
+        # lars and rmsprop scale by lr BEFORE the momentum trace (the
+        # trace accumulates lr-scaled updates), so the runtime scale
+        # must sit in that same slot — a trailing multiply would change
+        # the momentum dynamics under non-constant schedules.
+        if runtime_schedule:
+            txs.append(optax.add_decayed_weights(weight_decay,
+                                                 mask=decay_mask))
+            txs.append(optax.masked(   # optax.lars's trust_coefficient
+                optax.scale_by_trust_ratio(trust_coefficient=0.001), True))
+            txs.append(optax.scale(-1.0))
+            txs.append(scale_by_runtime_lr(
+                schedule, learning_rate, total_steps, warmup_steps))
+            txs.append(optax.trace(decay=momentum))
+        else:
+            txs.append(optax.lars(sched, weight_decay=weight_decay,
+                                  weight_decay_mask=decay_mask,
+                                  momentum=momentum))
     elif name == "rmsprop":
-        txs.append(optax.rmsprop(sched, momentum=momentum))
+        if runtime_schedule:
+            txs.append(optax.scale_by_rms())
+            txs.append(optax.scale(-1.0))
+            txs.append(scale_by_runtime_lr(
+                schedule, learning_rate, total_steps, warmup_steps))
+            txs.append(optax.trace(decay=momentum))
+        else:
+            txs.append(optax.rmsprop(sched, momentum=momentum))
+    if runtime_schedule and name not in ("lars", "rmsprop"):
+        txs.append(scale_by_runtime_lr(
+            schedule, learning_rate, total_steps, warmup_steps))
     return optax.chain(*txs), sched

@@ -281,6 +281,7 @@ def train(
     integrity_spike_z: Optional[float] = None,
     integrity_window: Optional[int] = None,
     integrity_check_every: Optional[int] = None,
+    runtime_schedule: Optional[bool] = None,
 ) -> TrainResult:
     # before any jit: warm restarts must hit the persistent cache for the
     # very first compile (the startup→first-step dominator, PERF.md) —
@@ -408,10 +409,21 @@ def train(
 
     base_lr = scale_lr(learning_rate, global_batch) if scale_lr_by_batch \
         else learning_rate
+    # runtime LR schedule (ISSUE 19): lr/warmup/total_steps become
+    # optimizer-STATE scalars instead of traced constants, so every
+    # hyperparameter-sweep trial after the first shares one cached /
+    # AOT'd executable (the fingerprint below switches to
+    # compile_shape_fingerprint). CLI flag wins, then the
+    # experiment-injected env, then off — the baked path stays the
+    # byte-for-byte default. fused_adam + runtime_schedule is rejected
+    # inside make_optimizer (the kernel bakes the schedule).
+    if runtime_schedule is None:
+        runtime_schedule = bool(_env_int("KFTPU_RUNTIME_SCHEDULE", 0))
     opt, lr_fn = make_optimizer(
         optimizer, base_lr, schedule=lr_schedule, total_steps=steps,
         warmup_steps=warmup_steps, weight_decay=weight_decay,
-        momentum=momentum, kernels=kernel_optimizer)
+        momentum=momentum, kernels=kernel_optimizer,
+        runtime_schedule=runtime_schedule)
     # weight-update layout (ZeRO-2 sharded vs replicated): CLI flag wins,
     # then the operator-rendered env (controllers/tpujob.py renders
     # spec.weightUpdate as KFTPU_WEIGHT_UPDATE), then replicated
@@ -464,7 +476,8 @@ def train(
         opt_ms, lr_fn = make_optimizer(
             optimizer, base_lr, schedule=lr_schedule, total_steps=steps,
             warmup_steps=warmup_steps, weight_decay=weight_decay,
-            momentum=momentum, grad_clip=None, kernels=kernel_optimizer)
+            momentum=momentum, grad_clip=None, kernels=kernel_optimizer,
+            runtime_schedule=runtime_schedule)
         builder = MultisliceTrainStepBuilder(
             cfg=workload_kwargs.get("cfg") or _T.TransformerConfig.tiny(),
             num_slices=n_slices,
@@ -731,7 +744,19 @@ def train(
         aot = bool(_env_int(AOT_ENABLE_ENV, 0))  # rendered "1"/"0"
     if aot:
         from . import aot as aot_mod
-        from .recipe import recipe_fingerprint
+        from .recipe import compile_shape_fingerprint, recipe_fingerprint
+
+        def _fingerprint(**knobs):
+            # With the runtime schedule active, lr/warmup/steps are
+            # executable INPUTS, not constants — drop them from the key
+            # so lr-variant trials share one AOT executable; the flag
+            # itself is a program change, so it joins the key (a
+            # runtime-schedule step can never alias a baked one).
+            if runtime_schedule:
+                return compile_shape_fingerprint(
+                    runtime_schedule=True, **knobs)
+            return recipe_fingerprint(**knobs)
+
         aot_dir = aot_dir or os.environ.get(aot_mod.AOT_DIR_ENV) or (
             aot_mod.default_aot_dir(checkpoint_dir) if checkpoint_dir
             else None)
@@ -747,7 +772,7 @@ def train(
             # flat in N. Load-all = aot start; anything less exports
             # the missing programs on this (already-paid) compile.
             try:
-                fp = recipe_fingerprint(
+                fp = _fingerprint(
                     workload=spec.name, optimizer=optimizer,
                     lr_schedule=lr_schedule, learning_rate=base_lr,
                     warmup_steps=warmup_steps, weight_decay=weight_decay,
@@ -798,7 +823,7 @@ def train(
                         "labels": np.zeros((global_batch,), np.int32)})
                 else:
                     example = batch_pool[0]
-                fp = recipe_fingerprint(
+                fp = _fingerprint(
                     workload=spec.name, optimizer=optimizer,
                     lr_schedule=lr_schedule, learning_rate=base_lr,
                     warmup_steps=warmup_steps, weight_decay=weight_decay,
@@ -1128,6 +1153,21 @@ def train(
                         rep_sq = vals.pop("param_sqnorm_replicas", None)
                         last_metrics = vals
                         mlog.record_window(s, w, wall, vals)
+                        if tracer is not None:
+                            # per-window objective event for the
+                            # experiment reconciler's median-stopping
+                            # read (api/experiment.py SPAN_OBJECTIVE):
+                            # drained values are complete, one window
+                            # behind the live edge by design
+                            from ..api.experiment import SPAN_OBJECTIVE
+                            obj_vals = {}
+                            for k, v in vals.items():
+                                try:
+                                    obj_vals[k] = float(v)
+                                except (TypeError, ValueError):
+                                    pass  # non-scalar diagnostic
+                            tracer.event(SPAN_OBJECTIVE, step=s,
+                                         window=w, **obj_vals)
                         if sentinel is not None and anomaly is None:
                             anomaly = sentinel.observe(
                                 s, loss=vals.get("loss"),
@@ -1472,6 +1512,14 @@ def main(argv=None) -> int:
                    help="detector cadence in steps — caps --sync-every "
                         "so detection latency is bounded (default "
                         "$KFTPU_INTEGRITY_CHECK_EVERY or 10)")
+    p.add_argument("--runtime-schedule", default=None,
+                   action=argparse.BooleanOptionalAction,
+                   help="feed lr/warmup/total-steps to the optimizer as "
+                        "runtime state instead of traced constants so "
+                        "hyperparameter-sweep trials share one compiled "
+                        "executable (default $KFTPU_RUNTIME_SCHEDULE or "
+                        "off; experiment trials set it — "
+                        "docs/operations.md 'Hyperparameter search')")
     args = p.parse_args(argv)
     workload_kwargs = {}
     if args.workload in _PIPELINED_WORKLOADS:
@@ -1514,7 +1562,8 @@ def main(argv=None) -> int:
         integrity=args.integrity,
         integrity_spike_z=args.integrity_spike_z,
         integrity_window=args.integrity_window,
-        integrity_check_every=args.integrity_check_every)
+        integrity_check_every=args.integrity_check_every,
+        runtime_schedule=args.runtime_schedule)
     log.info("done: %d steps, %.1f examples/sec", result.steps,
              result.examples_per_sec)
     if result.anomaly:

@@ -136,6 +136,7 @@ button.minor{padding:0.3rem 0.8rem;border:1px solid var(--grid);
   <a href="#/notebooks" data-view="notebooks">Notebooks</a>
   <a href="#/pipelines" data-view="pipelines">Pipelines</a>
   <a href="#/studies" data-view="studies">Studies</a>
+  <a href="#/experiments" data-view="experiments">Experiments</a>
   <a href="#/contributors" data-view="contributors">Contributors</a>
   <a href="/logout">Log out</a>
   <div id="env-info"></div>
@@ -404,6 +405,75 @@ def build_dashboard_app(client: KubeClient,
             })
         out.sort(key=lambda s: s["name"])
         return 200, out
+
+    def _experiment_summary(exp):
+        spec, st = exp.get("spec", {}), exp.get("status") or {}
+        obj = spec.get("objective") or {}
+        alg = spec.get("algorithm") or {}
+        if isinstance(alg, str):  # admission shorthand: algorithm: random
+            alg = {"name": alg}
+        return {
+            "namespace": k8s.namespace_of(exp, "default"),
+            "name": k8s.name_of(exp),
+            "phase": _job_phase(exp),
+            "algorithm": alg.get("name", ""),
+            "objectiveMetric": obj.get("metric", ""),
+            "optimization": obj.get("type", ""),
+            "trialsTotal": st.get("trialsTotal", 0),
+            "trialsRunning": st.get("trialsRunning", 0),
+            "trialsSucceeded": st.get("trialsSucceeded", 0),
+            "trialsFailed": st.get("trialsFailed", 0),
+            "trialsStopped": st.get("trialsStopped", 0),
+            "bestTrial": st.get("bestTrial"),
+            "trialsPerHour": st.get("trialsPerHour"),
+            "chipHours": st.get("chipHours"),
+            "warmStartFraction": st.get("warmStartFraction"),
+        }
+
+    @app.route("GET", "/api/katib/experiments")
+    def experiments(params, query, body):
+        """Fleet-wide Experiment rollup: one row per search with the
+        throughput/goodput economics the reconciler maintains
+        (trials/hour, chip-hours by category, warm-start fraction)."""
+        from ..api.experiment import (EXPERIMENT_API_VERSION,
+                                      EXPERIMENT_KIND)
+        from ..cluster.client import KubeError
+        try:
+            exps = client.list(EXPERIMENT_API_VERSION, EXPERIMENT_KIND)
+        except KubeError:
+            return 200, []
+        out = [_experiment_summary(e) for e in exps]
+        out.sort(key=lambda e: (e["namespace"], e["name"]))
+        return 200, out
+
+    @app.route("GET", "/api/katib/experiments/{namespace}/{name}")
+    def experiment_detail(params, query, body):
+        """One Experiment with its full trial table: phase, objective,
+        chips, warm/cold start kind, stopped-early flag."""
+        from ..api.experiment import (EXPERIMENT_API_VERSION,
+                                      EXPERIMENT_KIND)
+        from ..cluster.client import KubeError, NotFoundError
+        try:
+            exp = client.get(EXPERIMENT_API_VERSION, EXPERIMENT_KIND,
+                             params["namespace"], params["name"])
+        except (KubeError, NotFoundError):
+            raise ApiError(404, f"experiment {params['namespace']}/"
+                                f"{params['name']} not found")
+        st = exp.get("status") or {}
+        detail = _experiment_summary(exp)
+        detail["parameters"] = (exp.get("spec") or {}).get("parameters", [])
+        detail["trials"] = [{
+            "name": t.get("name", ""),
+            "status": t.get("status", ""),
+            "objective": t.get("objective"),
+            "parameters": t.get("parameters", {}),
+            "chips": t.get("chips", 0),
+            "startKind": t.get("startKind", "unknown"),
+            "stoppedEarly": bool(t.get("stoppedEarly")),
+            "generation": t.get("generation", 0),
+            "parent": t.get("parent"),
+        } for t in (st.get("trials") or [])]
+        return 200, detail
 
     @app.route("GET", "/api/metrics/{mtype}")
     def metrics_route(params, query, body):

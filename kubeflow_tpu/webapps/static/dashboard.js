@@ -691,6 +691,63 @@
     root.replaceChildren(...blocks);
   }
 
+  // -- experiments (the Experiment CRD rollup over /api/katib/experiments) --
+
+  async function viewExperiments(root) {
+    const exps = await api("api/katib/experiments");
+    const blocks = [el("h2", { text: "Experiments" })];
+    if (!exps.length) {
+      blocks.push(el("p", { class: "empty", text: "No experiments." }));
+    }
+    for (const e of exps) {
+      blocks.push(el("h3", {}, [
+        el("span", { text: `${e.namespace}/${e.name} ` }),
+        statusBadge(e.phase),
+      ]));
+      const tiles = [
+        statTile("Algorithm", e.algorithm || "—"),
+        statTile("Trials", `${e.trialsSucceeded + e.trialsStopped}/` +
+          `${e.trialsTotal}`),
+        statTile("Trials/hour", e.trialsPerHour != null
+          ? Math.round(e.trialsPerHour * 100) / 100 : "—"),
+        statTile("Warm-start", e.warmStartFraction != null
+          ? `${Math.round(e.warmStartFraction * 100)}%` : "—"),
+      ];
+      if (e.bestTrial && e.bestTrial.objective != null) {
+        tiles.push(statTile(
+          `Best ${e.objectiveMetric} (${e.optimization})`,
+          Math.round(e.bestTrial.objective * 1e4) / 1e4));
+      }
+      if (e.chipHours && e.chipHours.total != null) {
+        tiles.push(statTile("Chip-hours",
+          Math.round(e.chipHours.total * 100) / 100));
+        if (e.chipHours.saved) {
+          tiles.push(statTile("Saved (early stop)",
+            Math.round(e.chipHours.saved * 100) / 100));
+        }
+      }
+      blocks.push(el("div", { class: "tiles" }, tiles));
+      const detail = await api("api/katib/experiments/" +
+        `${encodeURIComponent(e.namespace)}/${encodeURIComponent(e.name)}`);
+      const rows = detail.trials.map((t) => ({
+        trial: t.name,
+        status: t.status + (t.stoppedEarly ? " (early stop)" : ""),
+        objective: t.objective != null
+          ? Math.round(t.objective * 1e4) / 1e4 : "—",
+        chips: t.chips,
+        start: t.startKind,
+        parameters: JSON.stringify(t.parameters),
+      }));
+      if (rows.length) {
+        blocks.push(table(rows, ["trial", "status", "objective", "chips",
+                                 "start", "parameters"]));
+      } else {
+        blocks.push(el("p", { class: "empty", text: "No trials yet." }));
+      }
+    }
+    root.replaceChildren(...blocks);
+  }
+
   // -- contributors (the manage-users surface over the KFAM API) ------------
 
   const KFAM_ROLES = ["kubeflow-view", "kubeflow-edit", "kubeflow-admin"];
@@ -826,6 +883,7 @@
     notebooks: viewNotebooks,
     pipelines: viewPipelines,
     studies: viewStudies,
+    experiments: viewExperiments,
     contributors: viewContributors,
   };
 

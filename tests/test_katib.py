@@ -1,24 +1,36 @@
-"""Katib-equivalent tests: suggestion algorithms + StudyJob controller E2E.
+"""Hyperparameter-search tests: suggestion engines, the Experiment
+reconciler E2E, StudyJob compat conversion, and the 200-trial
+scheduler-burst coverage (ISSUE 19).
 
 The reference exercised katib only E2E on a real cluster
-(testing/katib_studyjob_test.py:42-119 polls StudyJob conditions); here the
-same loop runs against the in-memory apiserver with the real training-job
-operator creating the trial gangs (SURVEY.md §4 envtest tier).
+(testing/katib_studyjob_test.py:42-119 polls StudyJob conditions); here
+the same loop runs against the in-memory apiserver with the real
+training-job operator creating the trial gangs (SURVEY.md §4 envtest
+tier). The search object is the Experiment CRD (api/experiment.py);
+legacy StudyJobs convert through katib/studyjob.py.
 """
 
 import json
+import time
 
 import pytest
 
 from kubeflow_tpu.api import k8s
+from kubeflow_tpu.api.experiment import (EXPERIMENT_API_VERSION,
+                                         EXPERIMENT_KIND, Experiment)
 from kubeflow_tpu.cluster import FakeCluster
+from kubeflow_tpu.controllers.experiment import ExperimentReconciler
 from kubeflow_tpu.controllers.runtime import Manager
 from kubeflow_tpu.controllers.tpujob import TrainingJobReconciler
-from kubeflow_tpu.katib.studyjob import StudyJobReconciler
+from kubeflow_tpu.katib.studyjob import (OBSERVATION_ANNOTATION,
+                                         StudyJobCompatReconciler,
+                                         studyjob_to_experiment)
 from kubeflow_tpu.katib.suggestion import (ParameterConfig,
                                            make_suggestion,
                                            parse_parameter_configs)
 from kubeflow_tpu.katib.vizier import VizierDB, VizierService, report_observation
+
+pytestmark = pytest.mark.katib
 
 
 PARAM_CONFIGS = [
@@ -168,6 +180,90 @@ class TestVizier:
             svc.stop()
 
 
+# ------------------------------------------------------- experiment spec
+
+
+def trial_template(topo="v5e-8", **spec_extra):
+    spec = {"replicaSpecs": {"TPU": {
+        "tpuTopology": topo,
+        "template": {"spec": {"containers": [
+            {"name": "train", "image": "trainer:v1",
+             "args": ["--model=resnet50"]}]}},
+    }}}
+    spec.update(spec_extra)
+    return {"kind": "TPUJob", "spec": spec}
+
+
+def experiment_manifest(name="exp", ns="kubeflow", algorithm=None,
+                        parameters=None, template=None, **spec_extra):
+    spec = {
+        "objective": {"type": "maximize", "metric": "accuracy"},
+        "algorithm": algorithm or {"name": "grid",
+                                   "settings": {"DefaultGrid": 3}},
+        "parameters": parameters or [
+            {"name": "--lr", "type": "double", "min": 0.1, "max": 0.9}],
+        "maxTrials": 3,
+        "parallelism": 2,
+        "trialTemplate": template or trial_template(),
+    }
+    spec.update(spec_extra)
+    return {"apiVersion": EXPERIMENT_API_VERSION, "kind": EXPERIMENT_KIND,
+            "metadata": {"name": name, "namespace": ns}, "spec": spec}
+
+
+class TestExperimentSpec:
+    def test_roundtrip(self):
+        exp = Experiment.from_manifest(experiment_manifest())
+        again = Experiment.from_manifest(exp.to_manifest())
+        assert again.objective_metric == "accuracy"
+        assert again.algorithm == "grid"
+        assert again.parameters[0].name == "--lr"
+        assert again.max_trials == 3 and again.parallelism == 2
+
+    def test_unknown_spec_field_rejected(self):
+        m = experiment_manifest()
+        m["spec"]["maxTrails"] = 5  # the classic typo
+        with pytest.raises(ValueError, match="maxTrails"):
+            Experiment.from_manifest(m)
+
+    def test_pbt_and_early_stopping_mutually_exclusive(self):
+        m = experiment_manifest(
+            algorithm="pbt", pbt={"truncation": 0.5},
+            earlyStopping={"policy": "median"})
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            Experiment.from_manifest(m)
+
+    def test_pbt_needs_a_numeric_parameter(self):
+        m = experiment_manifest(
+            algorithm="pbt",
+            parameters=[{"name": "--opt", "type": "categorical",
+                         "values": ["sgd", "adam"]}])
+        with pytest.raises(ValueError, match="numeric parameter"):
+            Experiment.from_manifest(m)
+
+    def test_bad_algorithm_and_template_kind_rejected(self):
+        with pytest.raises(ValueError, match="algorithm"):
+            Experiment.from_manifest(
+                experiment_manifest(algorithm="tpe"))
+        m = experiment_manifest()
+        m["spec"]["trialTemplate"]["kind"] = "Deployment"
+        with pytest.raises(ValueError, match="Deployment"):
+            Experiment.from_manifest(m)
+
+    def test_goal_and_better_follow_direction(self):
+        exp = Experiment.from_manifest(experiment_manifest())
+        m = experiment_manifest()
+        m["spec"]["objective"] = {"type": "minimize", "metric": "loss",
+                                  "goal": 0.1}
+        lo = Experiment.from_manifest(m)
+        assert exp.better(0.9, 0.5) and not exp.better(0.5, 0.9)
+        assert lo.better(0.05, 0.2)
+        assert lo.goal_reached(0.05) and not lo.goal_reached(0.2)
+
+
+# --------------------------------------------------- studyjob conversion
+
+
 def studyjob_manifest(name="study", algorithm="grid", request_number=3,
                       **spec_extra):
     return {
@@ -186,18 +282,48 @@ def studyjob_manifest(name="study", algorithm="grid", request_number=3,
                                "requestNumber": request_number,
                                "suggestionParameters": [
                                    {"name": "DefaultGrid", "value": 3}]},
-            "workerSpec": {"template": {
-                "kind": "TPUJob",
-                "spec": {"replicaSpecs": {"TPU": {
-                    "tpuTopology": "v5e-8",
-                    "template": {"spec": {"containers": [
-                        {"name": "train", "image": "trainer:v1",
-                         "args": ["--model=resnet50"]}]}},
-                }}},
-            }},
+            "workerSpec": {"template": trial_template()},
             **spec_extra,
         },
     }
+
+
+class TestStudyJobConversion:
+    def test_field_mapping_admits(self):
+        m = studyjob_to_experiment(studyjob_manifest())
+        exp = Experiment.from_manifest(m)
+        assert exp.objective_type == "maximize"
+        assert exp.objective_metric == "accuracy"
+        assert exp.algorithm == "grid"
+        assert exp.algorithm_settings == {"DefaultGrid": 3}
+        assert exp.parameters[0].name == "--lr"
+        assert exp.parameters[0].min == 0.1 and exp.parameters[0].max == 0.9
+        assert exp.parallelism == 3
+        assert exp.trial_template["kind"] == "TPUJob"
+
+    def test_unsupported_algorithm_degrades_to_random(self):
+        m = studyjob_to_experiment(
+            studyjob_manifest(algorithm="bayesianoptimization"))
+        assert m["spec"]["algorithm"]["name"] == "random"
+
+    def test_trial_budget_defaults(self):
+        # explicit maxTrials wins; grid gets a generous cap (engine
+        # exhausts first); open-ended samplers keep 4 x requestNumber
+        assert studyjob_to_experiment(studyjob_manifest(
+            maxTrials=7))["spec"]["maxTrials"] == 7
+        assert studyjob_to_experiment(studyjob_manifest())[
+            "spec"]["maxTrials"] == 1 << 10
+        assert studyjob_to_experiment(studyjob_manifest(
+            algorithm="random", request_number=2))["spec"]["maxTrials"] == 8
+
+    def test_missing_template_rejected(self):
+        m = studyjob_manifest()
+        del m["spec"]["workerSpec"]["template"]
+        with pytest.raises(ValueError, match="template"):
+            studyjob_to_experiment(m)
+
+
+# ----------------------------------------------------- reconciler E2E
 
 
 @pytest.fixture
@@ -205,103 +331,157 @@ def env():
     cluster = FakeCluster()
     for i in range(4):  # one slice pool per concurrent trial
         cluster.add_tpu_slice_nodes("v5e-8", pool=f"tpu-pool-{i}")
-    vizier = VizierDB()
     mgr = Manager(cluster)
     mgr.add(TrainingJobReconciler("TPUJob"))
-    study_ctrl = StudyJobReconciler(vizier=vizier, seed=11)
-    mgr.add(study_ctrl)
-    return cluster, mgr, vizier
+    mgr.add(ExperimentReconciler(seed=11))
+    mgr.add(StudyJobCompatReconciler())
+    yield cluster, mgr
+    for c in mgr.controllers:
+        c.stop()
 
 
-def run_trials_to_completion(cluster, mgr, vizier, objective_fn,
-                             max_rounds=60):
-    """Drive controllers + scheduler; whenever a trial pod runs, report the
-    objective (simulating the workload's report_observation call) and finish
-    the pod."""
+def report_and_succeed(cluster, objective_fn):
+    """Pod hook: report the objective through the observation annotation
+    (the jax-free out-of-band path) and finish the pod."""
     def on_running(pod):
         env_map = {e["name"]: e.get("value")
                    for c in pod["spec"]["containers"]
                    for e in c.get("env", [])}
         trial = env_map.get("KFTPU_TRIAL")
-        study = env_map.get("KFTPU_STUDY")
-        if trial and study:
+        if trial:
             args = [a for c in pod["spec"]["containers"]
                     for a in c.get("args", [])]
             lr = next((float(a.split("=", 1)[1]) for a in args
                        if a.startswith("--lr=")), 0.0)
-            vizier.report(study, trial, "accuracy", objective_fn(lr))
-        ns, name = (k8s.namespace_of(pod, "default"), k8s.name_of(pod))
-        cluster.set_pod_phase(ns, name, "Succeeded")
+            ns = k8s.namespace_of(pod, "default")
+            job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob", ns,
+                              trial)
+            job["metadata"].setdefault("annotations", {})[
+                OBSERVATION_ANNOTATION] = json.dumps(
+                    {"accuracy": objective_fn(lr)})
+            cluster.apply(job)
+        cluster.set_pod_phase(k8s.namespace_of(pod, "default"),
+                              k8s.name_of(pod), "Succeeded")
+    return on_running
 
-    cluster.on_pod_running = on_running
+
+def run_to_completion(cluster, mgr, kind=EXPERIMENT_KIND,
+                      api=EXPERIMENT_API_VERSION, name="exp",
+                      max_rounds=80):
+    obj = None
     for _ in range(max_rounds):
         mgr.run_pending()
         cluster.tick()
         mgr.run_pending()
-        study = cluster.list("kubeflow.org/v1alpha1", "StudyJob", "kubeflow")
-        if study and (k8s.condition_true(study[0], "Succeeded") or
-                      k8s.condition_true(study[0], "Failed")):
-            return study[0]
-    return cluster.list("kubeflow.org/v1alpha1", "StudyJob", "kubeflow")[0]
+        obj = cluster.get(api, kind, "kubeflow", name)
+        if k8s.condition_true(obj, "Succeeded") or \
+                k8s.condition_true(obj, "Failed"):
+            return obj
+    return obj
 
 
-class TestStudyJobController:
-    def test_grid_study_runs_all_trials_and_picks_best(self, env):
-        cluster, mgr, vizier = env
-        cluster.create(studyjob_manifest())
-        study = run_trials_to_completion(
-            cluster, mgr, vizier, objective_fn=lambda lr: 1.0 - (lr - 0.5) ** 2)
-        assert k8s.condition_true(study, "Succeeded"), study.get("status")
-        st = study["status"]
+class TestExperimentController:
+    def test_grid_runs_all_trials_and_picks_best(self, env):
+        cluster, mgr = env
+        cluster.create(experiment_manifest())
+        cluster.on_pod_running = report_and_succeed(
+            cluster, lambda lr: 1.0 - (lr - 0.5) ** 2)
+        exp = run_to_completion(cluster, mgr)
+        assert k8s.condition_true(exp, "Succeeded"), exp.get("status")
+        st = exp["status"]
         assert st["trialsTotal"] == 3  # grid of 3 lr points
         assert st["trialsSucceeded"] == 3
         # grid points are 0.1, 0.5, 0.9 — best is lr=0.5
         assert abs(st["bestTrial"]["parameters"]["--lr"] - 0.5) < 1e-9
-        # trial jobs carried the hyperparameter as a CLI flag
+        assert st["trialsPerHour"] > 0
+        # the trial job carried the hyperparameter as a CLI flag and the
+        # warm-start env (runtime schedule on)
         trial_name = st["bestTrial"]["name"]
-        job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob", "kubeflow",
-                          trial_name)
-        args = job["spec"]["replicaSpecs"]["TPU"]["template"]["spec"][
-            "containers"][0]["args"]
-        assert any(a.startswith("--lr=") for a in args)
-        assert "--model=resnet50" in args
+        job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                          "kubeflow", trial_name)
+        c0 = job["spec"]["replicaSpecs"]["TPU"]["template"]["spec"][
+            "containers"][0]
+        assert any(a.startswith("--lr=") for a in c0["args"])
+        assert "--model=resnet50" in c0["args"]
+        env_map = {e["name"]: e.get("value") for e in c0["env"]}
+        assert env_map["KFTPU_RUNTIME_SCHEDULE"] == "1"
+        assert env_map["KFTPU_EXPERIMENT"] == "exp"
 
-    def test_random_study_respects_max_trials(self, env):
-        cluster, mgr, vizier = env
-        cluster.create(studyjob_manifest(algorithm="random", request_number=2,
-                                         maxTrials=4))
-        study = run_trials_to_completion(
-            cluster, mgr, vizier, objective_fn=lambda lr: lr)
-        assert k8s.condition_true(study, "Succeeded")
-        assert study["status"]["trialsTotal"] == 4
+    def test_parallelism_bounds_trials_in_flight(self, env):
+        cluster, mgr = env
+        cluster.create(experiment_manifest(algorithm="random", maxTrials=6,
+                                           parallelism=2))
+        seen_in_flight = []
+
+        def on_running(pod):
+            jobs = cluster.list("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                                "kubeflow")
+            live = [j for j in jobs
+                    if not (k8s.condition_true(j, "Succeeded") or
+                            k8s.condition_true(j, "Failed"))]
+            seen_in_flight.append(len(live))
+            report_and_succeed(cluster, lambda lr: lr)(pod)
+        cluster.on_pod_running = on_running
+        exp = run_to_completion(cluster, mgr)
+        assert k8s.condition_true(exp, "Succeeded"), exp.get("status")
+        assert exp["status"]["trialsTotal"] == 6
+        assert seen_in_flight and max(seen_in_flight) <= 2
+
+    def test_random_respects_max_trials(self, env):
+        cluster, mgr = env
+        cluster.create(experiment_manifest(
+            algorithm="random", maxTrials=4))
+        cluster.on_pod_running = report_and_succeed(cluster,
+                                                    lambda lr: lr)
+        exp = run_to_completion(cluster, mgr)
+        assert k8s.condition_true(exp, "Succeeded")
+        assert exp["status"]["trialsTotal"] == 4
+
+    def test_goal_reached_stops_spawning(self, env):
+        cluster, mgr = env
+        m = experiment_manifest(algorithm="random", maxTrials=10,
+                                parallelism=1)
+        m["spec"]["objective"]["goal"] = 0.5
+        cluster.create(m)
+        cluster.on_pod_running = report_and_succeed(cluster,
+                                                    lambda lr: 0.9)
+        exp = run_to_completion(cluster, mgr)
+        assert k8s.condition_true(exp, "Succeeded"), exp.get("status")
+        # first trial hit the goal; no further budget spent
+        assert exp["status"]["trialsTotal"] == 1
+        msgs = " ".join(c.get("message", "")
+                        for c in exp["status"].get("conditions", []))
+        assert "goal reached" in msgs
 
     def test_trials_are_owned_and_cascade_deleted(self, env):
-        cluster, mgr, vizier = env
-        cluster.create(studyjob_manifest())
+        cluster, mgr = env
+        cluster.create(experiment_manifest())
         cluster.on_pod_running = lambda pod: None
         mgr.run_pending()
         cluster.tick()
         mgr.run_pending()
-        jobs = cluster.list("tpu.kubeflow.org/v1alpha1", "TPUJob", "kubeflow")
-        assert jobs, "first trial round should exist"
+        jobs = cluster.list("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                            "kubeflow")
+        assert jobs, "first trials should exist"
         for j in jobs:
             refs = j["metadata"]["ownerReferences"]
-            assert refs[0]["kind"] == "StudyJob"
-        cluster.delete("kubeflow.org/v1alpha1", "StudyJob", "kubeflow", "study")
+            assert refs[0]["kind"] == EXPERIMENT_KIND
+        cluster.delete(EXPERIMENT_API_VERSION, EXPERIMENT_KIND,
+                       "kubeflow", "exp")
         assert cluster.list("tpu.kubeflow.org/v1alpha1", "TPUJob",
                             "kubeflow") == []
 
     def test_metrics_via_configmap_collector_path(self, env):
-        cluster, mgr, vizier = env
-        cluster.create(studyjob_manifest(algorithm="random", request_number=1,
-                                         maxTrials=1))
+        cluster, mgr = env
+        cluster.create(experiment_manifest(algorithm="random",
+                                           maxTrials=1, parallelism=1))
 
         def on_running(pod):
             env_map = {e["name"]: e.get("value")
                        for c in pod["spec"]["containers"]
                        for e in c.get("env", [])}
             trial = env_map.get("KFTPU_TRIAL")
-            if trial:  # workload writes its metrics ConfigMap, no vizier URL
+            if trial:  # workload writes its metrics ConfigMap
                 cluster.apply({
                     "apiVersion": "v1", "kind": "ConfigMap",
                     "metadata": {"name": f"{trial}-metrics",
@@ -309,67 +489,397 @@ class TestStudyJobController:
                     "data": {"accuracy": "0.91"}})
             cluster.set_pod_phase(k8s.namespace_of(pod, "default"),
                                   k8s.name_of(pod), "Succeeded")
-
         cluster.on_pod_running = on_running
-        study = None
-        for _ in range(40):
+        exp = run_to_completion(cluster, mgr)
+        assert k8s.condition_true(exp, "Succeeded"), exp.get("status")
+        assert exp["status"]["bestTrial"]["objective"] == 0.91
+
+    def test_running_trials_without_stopping_policy_reconcile_clean(
+            self, env):
+        """Regression: a pass over RUNNING trials with no earlyStopping
+        spec must not crash in the stopping-poll tail (controller retry
+        used to swallow the AttributeError silently)."""
+        cluster, mgr = env
+        cluster.create(experiment_manifest())
+        cluster.on_pod_running = lambda pod: None
+        for _ in range(4):
             mgr.run_pending()
             cluster.tick()
             mgr.run_pending()
-            study = cluster.get("kubeflow.org/v1alpha1", "StudyJob",
-                                "kubeflow", "study")
-            if k8s.condition_true(study, "Succeeded"):
-                break
+        exp = cluster.get(EXPERIMENT_API_VERSION, EXPERIMENT_KIND,
+                          "kubeflow", "exp")
+        assert any(t["status"] == "Running"
+                   for t in exp["status"]["trials"])
+        recon = next(c.reconciler for c in mgr.controllers
+                     if isinstance(c.reconciler, ExperimentReconciler))
+        res = recon.reconcile(cluster, ("kubeflow", "exp"))  # no raise
+        assert res.requeue_after == 0
+
+    def test_invalid_spec_fails_experiment(self, env):
+        cluster, mgr = env
+        m = experiment_manifest()
+        del m["spec"]["trialTemplate"]
+        cluster.create(m)
+        mgr.run_pending()
+        exp = cluster.get(EXPERIMENT_API_VERSION, EXPERIMENT_KIND,
+                          "kubeflow", "exp")
+        assert k8s.condition_true(exp, "Failed")
+
+    def test_failed_trials_fail_experiment_past_threshold(self, env):
+        cluster, mgr = env
+        cluster.create(experiment_manifest(
+            algorithm="random", maxTrials=3, parallelism=1,
+            maxFailedTrials=0))
+        cluster.on_pod_running = lambda pod: cluster.fail_pod(
+            k8s.namespace_of(pod, "default"), k8s.name_of(pod))
+        exp = run_to_completion(cluster, mgr)
+        assert k8s.condition_true(exp, "Failed"), exp.get("status")
+
+    def test_median_early_stopping_kills_seeded_bad_trial(self, env,
+                                                          tmp_path):
+        """Three trials report per-window objective spans; the seeded
+        bad one (objective below the peer median at its window) is
+        deleted mid-flight, recorded Stopped with stoppedEarly, and the
+        experiment still completes off the survivors."""
+        cluster, mgr = env
+        span_path = str(tmp_path / "spans.jsonl")
+        recon = next(c.reconciler for c in mgr.controllers
+                     if isinstance(c.reconciler, ExperimentReconciler))
+        recon._span_path = span_path
+        cluster.create(experiment_manifest(
+            parallelism=3,
+            earlyStopping={"policy": "median", "minTrials": 2,
+                           "startWindow": 2}))
+
+        def write_windows(tid, values):
+            with open(span_path, "a") as f:
+                for w, v in enumerate(values):
+                    f.write(json.dumps({
+                        "trace_id": tid, "span_id": f"s{w}",
+                        "parent_id": "", "name": "objective",
+                        "component": "worker", "start": float(w),
+                        "end": float(w),
+                        "attrs": {"step": w * 10, "window": w,
+                                  "accuracy": v}}) + "\n")
+
+        # let the trials spawn and reach Running (pods stay up)
+        cluster.on_pod_running = lambda pod: None
+        for _ in range(4):
+            mgr.run_pending()
+            cluster.tick()
+            mgr.run_pending()
+        exp = cluster.get(EXPERIMENT_API_VERSION, EXPERIMENT_KIND,
+                          "kubeflow", "exp")
+        trials = exp["status"]["trials"]
+        assert len(trials) == 3
+        by_lr = {t["parameters"]["--lr"]: t for t in trials}
+        # lr=0.1 is the seeded bad trial; the others track high accuracy
+        write_windows(by_lr[0.1]["traceId"], [0.2, 0.15, 0.1])
+        write_windows(by_lr[0.5]["traceId"], [0.6, 0.7, 0.8])
+        write_windows(by_lr[0.9]["traceId"], [0.5, 0.6, 0.7])
+        # new windows arrive out-of-band — drive the stopping poll
+        recon.reconcile(cluster, ("kubeflow", "exp"))
+        mgr.run_pending()
+        exp = cluster.get(EXPERIMENT_API_VERSION, EXPERIMENT_KIND,
+                          "kubeflow", "exp")
+        stopped = [t for t in exp["status"]["trials"]
+                   if t["status"] == "Stopped"]
+        assert len(stopped) == 1
+        assert stopped[0]["parameters"]["--lr"] == 0.1
+        assert stopped[0]["stoppedEarly"] is True
+        # its best-so-far stands as the result
+        assert stopped[0]["objective"] == 0.2
+        # the trial job is gone; survivors still run
+        assert cluster.get_or_none("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                                   "kubeflow", stopped[0]["name"]) is None
+        # finish the survivors through the annotation path
+        cluster.on_pod_running = report_and_succeed(
+            cluster, lambda lr: lr)
+        for t in exp["status"]["trials"]:
+            if t["status"] != "Stopped":
+                job = cluster.get_or_none("tpu.kubeflow.org/v1alpha1",
+                                          "TPUJob", "kubeflow", t["name"])
+                if job is not None:
+                    job["metadata"].setdefault("annotations", {})[
+                        OBSERVATION_ANNOTATION] = json.dumps(
+                            {"accuracy": t["parameters"]["--lr"]})
+                    cluster.apply(job)
+        for pod in cluster.list("v1", "Pod", "kubeflow"):
+            cluster.set_pod_phase("kubeflow", k8s.name_of(pod),
+                                  "Succeeded")
+        exp = run_to_completion(cluster, mgr)
+        assert k8s.condition_true(exp, "Succeeded"), exp.get("status")
+        st = exp["status"]
+        assert st["trialsStopped"] == 1 and st["trialsSucceeded"] == 2
+        # the span sink is the source of truth for the final objective
+        # too: lr=0.5 peaked at 0.8 in its last window
+        assert st["bestTrial"]["parameters"]["--lr"] == 0.5
+        assert st["bestTrial"]["objective"] == 0.8
+
+    def test_pbt_generations_clone_from_winner_checkpoint(self, env):
+        cluster, mgr = env
+        template = trial_template(checkpointDir="/ckpt/$(trialName)")
+        cluster.create(experiment_manifest(
+            algorithm="pbt", parameters=[
+                {"name": "--lr", "type": "double",
+                 "min": 0.05, "max": 1.0}],
+            template=template, maxTrials=4, parallelism=2,
+            pbt={"truncation": 0.5, "perturbFactors": [0.8, 1.25]}))
+        cluster.on_pod_running = report_and_succeed(cluster,
+                                                    lambda lr: lr)
+        exp = run_to_completion(cluster, mgr)
+        assert k8s.condition_true(exp, "Succeeded"), exp.get("status")
+        trials = exp["status"]["trials"]
+        assert len(trials) == 4
+        gen0 = [t for t in trials if t["generation"] == 0]
+        gen1 = [t for t in trials if t["generation"] == 1]
+        assert len(gen0) == len(gen1) == 2
+        winner = max(gen0, key=lambda t: t["objective"])
+        # every gen-1 member resumed from a gen-0 checkpoint
+        for t in gen1:
+            assert t["parent"] in {g["name"] for g in gen0}
+            job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                              "kubeflow", t["name"])
+            assert job["spec"]["resumeFrom"] == f"/ckpt/{t['parent']}"
+            assert job["spec"]["checkpointDir"] == f"/ckpt/{t['name']}"
+            # perturbed params stay inside the feasible range
+            assert 0.05 <= t["parameters"]["--lr"] <= 1.0
+        # the clone exploits the WINNER (not the loser it replaces)
+        clones = [t for t in gen1 if t["parent"] == winner["name"]]
+        assert clones, [t["parent"] for t in gen1]
+
+    def test_legacy_studyjob_converts_and_mirrors(self, env):
+        cluster, mgr = env
+        cluster.create(studyjob_manifest())
+        cluster.on_pod_running = report_and_succeed(
+            cluster, lambda lr: 1.0 - (lr - 0.5) ** 2)
+        study = run_to_completion(cluster, mgr,
+                                  kind="StudyJob",
+                                  api="kubeflow.org/v1alpha1",
+                                  name="study")
         assert k8s.condition_true(study, "Succeeded"), study.get("status")
-        assert study["status"]["bestTrial"]["objective"] == 0.91
+        st = study["status"]
+        assert st["trialsTotal"] == 3 and st["trialsSucceeded"] == 3
+        assert abs(st["bestTrial"]["parameters"]["--lr"] - 0.5) < 1e-9
+        # deleting the StudyJob cascades through the Experiment to jobs
+        cluster.delete("kubeflow.org/v1alpha1", "StudyJob", "kubeflow",
+                       "study")
+        assert cluster.list(EXPERIMENT_API_VERSION, EXPERIMENT_KIND,
+                            "kubeflow") == []
+        assert cluster.list("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                            "kubeflow") == []
 
     def test_example_prototype_end_to_end(self, env):
-        """The shipped katib-studyjob-example prototype runs to completion
-        unmodified through the real controllers (SURVEY §2.3 hard part d:
-        katib works against the TPU replica spec)."""
+        """The shipped katib-studyjob-example prototype still runs to
+        completion unmodified — now through the compat converter + the
+        Experiment reconciler."""
         from kubeflow_tpu.manifests import build_component
-        cluster, mgr, vizier = env
+        cluster, mgr = env
         study_manifest = build_component(
             "katib-studyjob-example",
             {"namespace": "kubeflow", "name": "study",
              "max_trials": 4, "request_number": 2})[0]
         cluster.create(study_manifest)
-        study = run_trials_to_completion(
-            cluster, mgr, vizier, objective_fn=lambda lr: 0.9)
+        cluster.on_pod_running = report_and_succeed(cluster,
+                                                    lambda lr: 0.9)
+        study = run_to_completion(cluster, mgr, kind="StudyJob",
+                                  api="kubeflow.org/v1alpha1",
+                                  name="study")
         assert k8s.condition_true(study, "Succeeded"), study.get("status")
         assert study["status"]["trialsTotal"] == 4
         best = study["status"]["bestTrial"]["name"]
-        job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob", "kubeflow",
-                          best)
+        job = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                          "kubeflow", best)
         args = job["spec"]["replicaSpecs"]["TPU"]["template"]["spec"][
             "containers"][0]["args"]
         assert any(a.startswith("--learning-rate=") for a in args)
         assert any(a.startswith("--global-batch=") for a in args)
 
-    def test_missing_worker_template_fails_study(self, env):
-        cluster, mgr, _ = env
-        m = studyjob_manifest()
-        del m["spec"]["workerSpec"]["template"]
-        cluster.create(m)
-        mgr.run_pending()
-        study = cluster.get("kubeflow.org/v1alpha1", "StudyJob", "kubeflow",
-                            "study")
-        assert k8s.condition_true(study, "Failed")
 
-    def test_failed_trials_fail_study_past_threshold(self, env):
-        cluster, mgr, vizier = env
-        cluster.create(studyjob_manifest(algorithm="random", request_number=1,
-                                         maxTrials=3, maxFailedTrials=0))
-        # every trial pod fails → gang restarts exhaust backoff → job Failed
-        cluster.on_pod_running = lambda pod: cluster.fail_pod(
-            k8s.namespace_of(pod, "default"), k8s.name_of(pod))
-        study = None
-        for _ in range(60):
+# ------------------------------------------------- 200-trial burst
+
+
+@pytest.mark.sched
+class TestTrialBurst:
+    """ISSUE 19 satellite: a 200-trial burst is the production
+    arrival-rate stress test for the gang queue — quota holds across
+    trial namespaces, FIFO tiebreaks stay stable for same-timestamp
+    bulk creates, steady-state passes write nothing, and the queue
+    gauges drain to zero when the swarm completes."""
+
+    def trial_job(self, i, ns="kubeflow", queue="search"):
+        return {
+            "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": f"burst-t{i}", "namespace": ns,
+                         "labels": {
+                             "katib.kubeflow.org/experiment": "burst",
+                             "katib.kubeflow.org/trial": f"burst-t{i}"}},
+            "spec": {
+                "replicaSpecs": {"TPU": {
+                    "tpuTopology": "v5e-4",
+                    "template": {"spec": {"containers": [
+                        {"name": "train", "image": "trainer:v1"}]}}}},
+                "schedulingPolicy": {"queue": queue},
+            },
+        }
+
+    def _mgr(self, cluster, config=None):
+        from kubeflow_tpu.scheduler.core import SliceScheduler
+        mgr = Manager(cluster)
+        mgr.add(SliceScheduler(config))
+        mgr.add(TrainingJobReconciler("TPUJob"))
+        return mgr
+
+    def test_200_trial_burst_fifo_and_gauges_drain(self):
+        from kubeflow_tpu.obs import registry as obsreg
+        obsreg.reset_default_registry()
+        cluster = FakeCluster()
+        for i in range(8):
+            cluster.add_tpu_slice_nodes("v5e-4", pool=f"p{i}")
+        mgr = self._mgr(cluster)
+        # bulk create: one burst, same wall-clock second
+        for i in range(200):
+            cluster.create(self.trial_job(i))
+        cluster.on_pod_running = lambda pod: cluster.set_pod_phase(
+            k8s.namespace_of(pod, "default"), k8s.name_of(pod),
+            "Succeeded")
+        from kubeflow_tpu.api.trainingjob import BINDING_ANNOTATION
+        bind_order = []
+        bound_seen = set()
+        done = 0
+        for _ in range(400):
             mgr.run_pending()
             cluster.tick()
             mgr.run_pending()
-            study = cluster.get("kubeflow.org/v1alpha1", "StudyJob",
-                                "kubeflow", "study")
-            if k8s.condition_true(study, "Failed"):
+            jobs = cluster.list("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                                "kubeflow")
+            for j in sorted(jobs, key=lambda j: int(
+                    k8s.name_of(j).rsplit("t", 1)[1])):
+                name = k8s.name_of(j)
+                if name not in bound_seen and \
+                        k8s.annotations_of(j).get(BINDING_ANNOTATION):
+                    bound_seen.add(name)
+                    bind_order.append(name)
+            done = sum(1 for j in jobs
+                       if k8s.condition_true(j, "Succeeded"))
+            if done == 200:
                 break
-        assert k8s.condition_true(study, "Failed"), study.get("status")
+        assert done == 200, f"only {done}/200 trials completed"
+        # FIFO tiebreak stability: same-timestamp bulk creates bind in
+        # submission (uid) order — a later trial never jumps an earlier
+        # one within the burst
+        indices = [int(n.rsplit("t", 1)[1]) for n in bind_order]
+        assert indices == sorted(indices), \
+            "burst bound out of submission order"
+        # queue gauges drain to zero
+        from kubeflow_tpu.scheduler.core import SliceScheduler
+        sched = next(c.reconciler for c in mgr.controllers
+                     if isinstance(c.reconciler, SliceScheduler))
+        sched.reconcile(cluster, ("", "#cluster-pass"))
+        text = obsreg.default_registry().render()
+        assert 'kftpu_sched_queue_depth{queue="search"} 0' in text
+        assert 'kftpu_sched_bound_gangs{queue="search"} 0' in text
+        assert 'kftpu_sched_queued_chips{queue="search"} 0' in text
+        for c in mgr.controllers:
+            c.stop()
+
+    def test_quota_holds_across_trial_namespaces(self):
+        from kubeflow_tpu.api.trainingjob import BINDING_ANNOTATION
+        from kubeflow_tpu.scheduler.queue import (QueueSpec,
+                                                  SchedulerConfig)
+        cluster = FakeCluster()
+        for i in range(8):
+            cluster.add_tpu_slice_nodes("v5e-4", pool=f"p{i}")
+        cfg = SchedulerConfig(queues={"search": QueueSpec(
+            "search", quota_chips={"team-a": 8, "team-b": 4})})
+        mgr = self._mgr(cluster, cfg)
+        for i in range(10):
+            cluster.create(self.trial_job(i, ns="team-a"))
+        for i in range(10, 20):
+            cluster.create(self.trial_job(i, ns="team-b"))
+        cluster.on_pod_running = lambda pod: None  # trials stay up
+        for _ in range(6):
+            mgr.run_pending()
+            cluster.tick()
+            mgr.run_pending()
+        bound = {"team-a": 0, "team-b": 0}
+        for ns in bound:
+            for j in cluster.list("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                                  ns):
+                if k8s.annotations_of(j).get(BINDING_ANNOTATION):
+                    bound[ns] += 4  # v5e-4 chips
+        # quota caps each trial namespace despite free capacity
+        assert bound["team-a"] == 8, bound
+        assert bound["team-b"] == 4, bound
+        for c in mgr.controllers:
+            c.stop()
+
+    def test_steady_burst_pass_is_write_idempotent(self):
+        from kubeflow_tpu.scheduler.core import SliceScheduler
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-4", pool="p0")
+        mgr = self._mgr(cluster)
+        for i in range(50):  # 1 binds, 49 wait
+            cluster.create(self.trial_job(i))
+        cluster.on_pod_running = lambda pod: None
+        for _ in range(4):
+            mgr.run_pending()
+            cluster.tick()
+            mgr.run_pending()
+        rvs = {k8s.name_of(j): j["metadata"]["resourceVersion"]
+               for j in cluster.list("tpu.kubeflow.org/v1alpha1",
+                                     "TPUJob", "kubeflow")}
+        sched = next(c.reconciler for c in mgr.controllers
+                     if isinstance(c.reconciler, SliceScheduler))
+        for _ in range(3):
+            sched.reconcile(cluster, ("", "#cluster-pass"))
+        after = {k8s.name_of(j): j["metadata"]["resourceVersion"]
+                 for j in cluster.list("tpu.kubeflow.org/v1alpha1",
+                                       "TPUJob", "kubeflow")}
+        assert rvs == after, "steady-state burst pass rewrote objects"
+        for c in mgr.controllers:
+            c.stop()
+
+
+# ------------------------------------------------------ rollup units
+
+
+class TestRollup:
+    def _exp(self):
+        return Experiment.from_manifest(experiment_manifest())
+
+    def test_warm_start_fraction_skips_first_trial(self):
+        from kubeflow_tpu.obs import registry as obsreg
+        obsreg.reset_default_registry()
+        r = ExperimentReconciler()
+        status = {"startedAt": time.time() - 3600}
+        trials = [
+            {"name": "t0", "status": "Succeeded", "startKind": "cold",
+             "parameters": {}, "objective": 1.0},
+            {"name": "t1", "status": "Succeeded", "startKind": "aot",
+             "parameters": {}, "objective": 2.0},
+            {"name": "t2", "status": "Succeeded", "startKind": "warm",
+             "parameters": {}, "objective": 3.0},
+            {"name": "t3", "status": "Stopped", "startKind": "aot",
+             "parameters": {}, "objective": 0.5,
+             "chipSecondsSaved": 7200.0},
+        ]
+        exp = self._exp()
+        r._rollup(status, trials, trials[2], exp)
+        # trials after the first: aot, warm, aot -> all warm
+        assert status["warmStartFraction"] == 1.0
+        assert status["chipHours"]["saved"] == 2.0
+        assert status["trialsPerHour"] == 4.0
+        text = obsreg.default_registry().render()
+        assert "kftpu_experiment_warm_start_fraction" in text
+        assert 'category="saved"' in text
+        obsreg.reset_default_registry()
+
+    def test_start_kind_from_ledger_evidence(self):
+        sk = ExperimentReconciler._start_kind
+        assert sk(None) == "unknown"
+        assert sk({"compileByStartKind": {"aot": 1.0}}) == "aot"
+        assert sk({"compileByStartKind": {"warm": 2.0,
+                                          "cold": 0.0}}) == "warm"
+        assert sk({"compileByStartKind": {"cold": 5.0}}) == "cold"
+        assert sk({"compileByStartKind": {}}) == "unknown"

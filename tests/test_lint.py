@@ -1182,6 +1182,100 @@ def test_anomaly_event_literals_defined_once_and_shared():
     assert "SPAN_ANOMALY" in src("obs", "goodput.py")
 
 
+def test_experiment_contract_is_plumbed_end_to_end():
+    """The hyperparameter-search wire contract has ONE definition per
+    literal, all in api/experiment.py: the default objective metric
+    (``DEFAULT_OBJECTIVE_METRIC``), the per-window objective span name
+    (``SPAN_OBJECTIVE``) and the out-of-band observation annotation
+    (``OBSERVATION_ANNOTATION``). The worker's span emitter, the
+    Experiment reconciler's median-stopping read, the StudyJob compat
+    parser and the bench harness all import the names — a re-spelled
+    ``"loss"`` would silently decouple what the worker reports from
+    what the reconciler ranks trials by."""
+    import subprocess
+
+    import pytest
+
+    from kubeflow_tpu.api.experiment import (DEFAULT_OBJECTIVE_METRIC,
+                                             OBSERVATION_ANNOTATION,
+                                             SPAN_OBJECTIVE, Experiment)
+
+    assert DEFAULT_OBJECTIVE_METRIC == "loss"
+    assert SPAN_OBJECTIVE == "objective"
+    assert OBSERVATION_ANNOTATION == "kubeflow.org/observation"
+
+    pkg = os.path.join(REPO_ROOT, "kubeflow_tpu")
+
+    def griep(pattern):
+        hits = subprocess.run(
+            ["grep", "-rl", "--include=*.py", pattern, pkg],
+            capture_output=True, text=True).stdout.split()
+        return sorted(os.path.relpath(h, pkg) for h in hits)
+
+    # single definition sites (assignment form, not mere mention)
+    assert griep("DEFAULT_OBJECTIVE_METRIC = ") == \
+        [os.path.join("api", "experiment.py")]
+    assert griep("SPAN_OBJECTIVE = ") == \
+        [os.path.join("api", "experiment.py")]
+    assert griep("OBSERVATION_ANNOTATION = ") == \
+        [os.path.join("api", "experiment.py")]
+    assert griep('"kubeflow.org/observation"') == \
+        [os.path.join("api", "experiment.py")]
+
+    def src(*rel):
+        with open(os.path.join(REPO_ROOT, "kubeflow_tpu", *rel)) as f:
+            return f.read()
+
+    # the experiment layers never re-spell the default metric literal
+    for rel in (("controllers", "experiment.py"),
+                ("katib", "studyjob.py")):
+        assert '"loss"' not in src(*rel), os.path.join(*rel)
+    # consumers import the shared names
+    assert "SPAN_OBJECTIVE" in src("runtime", "worker.py")
+    assert "SPAN_OBJECTIVE" in src("controllers", "experiment.py")
+    assert "OBSERVATION_ANNOTATION" in src("controllers", "experiment.py")
+    assert "OBSERVATION_ANNOTATION" in src("katib", "studyjob.py")
+    assert "DEFAULT_OBJECTIVE_METRIC" in src("katib", "studyjob.py")
+    # manifests: the Experiment CRD schema names every spec block
+    manifests_src = src("manifests", "katib.py")
+    for spec_field in ("objective", "algorithm", "parameters",
+                       "maxTrials", "parallelism", "earlyStopping",
+                       "trialTemplate"):
+        assert f'"{spec_field}"' in manifests_src, spec_field
+    # dashboard: the rollup surface carries the shared field names
+    dash_src = src("webapps", "dashboard.py")
+    for key in ("objectiveMetric", "warmStartFraction", "stoppedEarly"):
+        assert f'"{key}"' in dash_src, key
+
+    # spec wire round-trip: objective.metric unset → the shared default,
+    # and the default survives to_manifest → from_manifest unchanged
+    template = {
+        "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": "t"},
+        "spec": {"replicaSpecs": {"TPU": {
+            "tpuTopology": "v5e-8",
+            "template": {"spec": {"containers": [{"name": "c"}]}}}}},
+    }
+    manifest = {
+        "apiVersion": "kubeflow.org/v1alpha1", "kind": "Experiment",
+        "metadata": {"name": "e", "namespace": "ns"},
+        "spec": {"parameters": [{"name": "--lr", "type": "double",
+                                 "min": 0.1, "max": 0.9}],
+                 "maxTrials": 2, "trialTemplate": template},
+    }
+    exp = Experiment.from_manifest(manifest)
+    assert exp.objective_metric == DEFAULT_OBJECTIVE_METRIC
+    rt = Experiment.from_manifest(exp.to_manifest())
+    assert rt.objective_metric == DEFAULT_OBJECTIVE_METRIC
+    assert exp.to_manifest()["spec"]["objective"]["metric"] == \
+        DEFAULT_OBJECTIVE_METRIC
+    # admission rejects garbage (a typo'd objective knob fails at apply)
+    bad = dict(manifest)
+    bad["spec"] = dict(manifest["spec"], objective={"metirc": "loss"})
+    with pytest.raises(ValueError, match="unknown"):
+        Experiment.from_manifest(bad)
+
+
 class TestChecker:
     def _check(self, tmp_path, source, name="m.py"):
         p = tmp_path / name

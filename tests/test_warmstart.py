@@ -270,6 +270,64 @@ class TestStepKey:
         assert recipe_fingerprint(obj=object) != a
 
 
+@pytest.mark.katib
+class TestCompileShapeFingerprint:
+    """ISSUE 19 over-keying fix: tuned scalars (lr/warmup/steps) are
+    runtime INPUTS under the runtime schedule, so they must drop out of
+    the compile-shape key — while anything that changes the program
+    still rotates it, and the full recipe_fingerprint stays scalar-
+    sensitive (it is trial identity, not a cache key)."""
+
+    BASE = dict(workload="transformer", optimizer="adam",
+                lr_schedule="cosine", learning_rate=0.1,
+                warmup_steps=5, steps=100, global_batch=64)
+
+    def test_runtime_constants_drop_out_of_shape_key(self):
+        from kubeflow_tpu.runtime.recipe import compile_shape_fingerprint
+        k = compile_shape_fingerprint(**self.BASE)
+        # lr-variant trials: same shape key — the whole warm-start story
+        for delta in (dict(learning_rate=0.9), dict(warmup_steps=500),
+                      dict(steps=7000),
+                      dict(learning_rate=0.3, warmup_steps=0, steps=42)):
+            assert compile_shape_fingerprint(**{**self.BASE, **delta}) \
+                == k, delta
+
+    def test_program_changes_still_rotate_the_shape_key(self):
+        from kubeflow_tpu.runtime.recipe import compile_shape_fingerprint
+        k = compile_shape_fingerprint(**self.BASE)
+        for delta in (dict(workload="resnet50"), dict(optimizer="sgd"),
+                      dict(lr_schedule="linear"),
+                      dict(global_batch=128)):
+            assert compile_shape_fingerprint(**{**self.BASE, **delta}) \
+                != k, delta
+
+    def test_runtime_constants_key_captures_the_scalars(self):
+        from kubeflow_tpu.runtime.recipe import (runtime_constants_key,
+                                                 split_recipe_knobs)
+        a = runtime_constants_key(**self.BASE)
+        assert a == runtime_constants_key(**self.BASE)
+        assert a != runtime_constants_key(
+            **{**self.BASE, "learning_rate": 0.9})
+        # shape-only change leaves the runtime key alone
+        assert a == runtime_constants_key(
+            **{**self.BASE, "workload": "resnet50"})
+        shape, runtime = split_recipe_knobs(dict(self.BASE))
+        assert set(runtime) == {"learning_rate", "warmup_steps", "steps"}
+        assert "global_batch" in shape and "learning_rate" not in shape
+
+    def test_full_fingerprint_remains_scalar_sensitive(self):
+        """The split must NOT weaken recipe_fingerprint — it stays the
+        trial-identity hash, sensitive to every knob."""
+        from kubeflow_tpu.runtime.recipe import (compile_shape_fingerprint,
+                                                 recipe_fingerprint)
+        a = recipe_fingerprint(**self.BASE)
+        b = recipe_fingerprint(**{**self.BASE, "learning_rate": 0.9})
+        assert a != b
+        assert compile_shape_fingerprint(**self.BASE) == \
+            compile_shape_fingerprint(**{**self.BASE,
+                                         "learning_rate": 0.9})
+
+
 # ------------------------------------------------- worker-level drills
 
 
@@ -375,6 +433,71 @@ class TestWorkerWarmStart:
         assert r.steps == 2
         assert any("no --aot-dir" in rec.message
                    for rec in caplog.records)
+
+    def test_lr_variant_trials_share_one_executable(self, tmp_path,
+                                                    monkeypatch):
+        """THE katib warm-start regression (ISSUE 19): two trials that
+        differ only in tuned scalars (lr, total steps) under the runtime
+        schedule hit the SAME AOT executable — trial 2 starts 'aot' off
+        trial 1's export, and the AOT dir holds exactly one record."""
+        from kubeflow_tpu.runtime.worker import train
+        monkeypatch.setenv("KFTPU_COMPILE_CACHE_MIN_SECS", "0")
+        aot_dir = tmp_path / "aot"
+        r1 = train(steps=4, learning_rate=0.1, lr_schedule="cosine",
+                   runtime_schedule=True, aot=True, aot_dir=str(aot_dir),
+                   **self.KW)
+        assert r1.start_kind == "cold"
+        assert len(list(aot_dir.iterdir())) == 1
+        r2 = train(steps=6, learning_rate=0.37, lr_schedule="cosine",
+                   runtime_schedule=True, aot=True, aot_dir=str(aot_dir),
+                   **self.KW)
+        assert r2.start_kind == "aot", \
+            "lr-variant trial recompiled: fingerprint is over-keyed"
+        assert len(list(aot_dir.iterdir())) == 1, \
+            "lr-variant trial exported a second executable"
+
+    def test_changed_model_shape_still_misses(self, tmp_path,
+                                              monkeypatch):
+        """The split must not UNDER-key: a different global batch (a
+        real program change) must miss trial 1's executable and export
+        its own."""
+        from kubeflow_tpu.runtime.worker import train
+        monkeypatch.setenv("KFTPU_COMPILE_CACHE_MIN_SECS", "0")
+        aot_dir = tmp_path / "aot"
+        train(steps=4, learning_rate=0.1, runtime_schedule=True,
+              aot=True, aot_dir=str(aot_dir), **self.KW)
+        kw = dict(self.KW, global_batch=16)
+        r = train(steps=4, learning_rate=0.1, runtime_schedule=True,
+                  aot=True, aot_dir=str(aot_dir), **kw)
+        assert r.start_kind != "aot"
+        assert len(list(aot_dir.iterdir())) == 2
+
+    def test_runtime_schedule_never_aliases_baked_executables(
+            self, tmp_path, monkeypatch):
+        """A baked-schedule run and a runtime-schedule run of the same
+        spec are DIFFERENT programs: the flag joins the key, so neither
+        can load the other's executable."""
+        from kubeflow_tpu.runtime.worker import train
+        monkeypatch.setenv("KFTPU_COMPILE_CACHE_MIN_SECS", "0")
+        aot_dir = tmp_path / "aot"
+        train(steps=4, learning_rate=0.1, aot=True,
+              aot_dir=str(aot_dir), **self.KW)
+        r = train(steps=4, learning_rate=0.1, runtime_schedule=True,
+                  aot=True, aot_dir=str(aot_dir), **self.KW)
+        assert r.start_kind != "aot"
+        assert len(list(aot_dir.iterdir())) == 2
+
+    def test_runtime_schedule_parity_with_baked(self, monkeypatch):
+        """Feeding lr through optimizer state must train IDENTICALLY to
+        baking it into the program (the schedule math is mirrored in
+        runtime/recipe.py _runtime_lr_at)."""
+        from kubeflow_tpu.runtime.worker import train
+        kw = dict(self.KW, steps=6, learning_rate=0.2,
+                  lr_schedule="cosine", warmup_steps=2)
+        r_baked = train(**kw)
+        r_rt = train(runtime_schedule=True, **kw)
+        assert _final_loss(r_rt) == pytest.approx(_final_loss(r_baked),
+                                                  abs=1e-5)
 
     def test_first_step_metric_and_span(self, tmp_path, monkeypatch):
         """The worker emits kftpu_time_to_first_step_seconds labeled by

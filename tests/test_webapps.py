@@ -175,6 +175,82 @@ class TestDashboard:
         finally:
             server.stop()
 
+    @pytest.mark.katib
+    def test_experiments_api_rollup_and_trial_table(self, cluster):
+        """/api/katib/experiments: fleet rollup with search economics;
+        the detail route exposes the full trial table (phase, objective,
+        chips, start kind, stopped-early)."""
+        cluster.create({
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "Experiment",
+            "metadata": {"name": "sweep", "namespace": "kubeflow"},
+            "spec": {
+                "objective": {"type": "maximize", "metric": "accuracy"},
+                "algorithm": {"name": "grid"},
+            },
+            "status": {
+                "conditions": [{"type": "Running", "status": "True"}],
+                "trialsTotal": 3, "trialsRunning": 1,
+                "trialsSucceeded": 1, "trialsFailed": 0,
+                "trialsStopped": 1,
+                "bestTrial": {"name": "sweep-t1", "objective": 0.93,
+                              "parameters": {"--lr": 0.1}},
+                "trialsPerHour": 12.5,
+                "chipHours": {"total": 4.0, "goodput": 3.6,
+                              "badput": 0.4, "saved": 1.2},
+                "warmStartFraction": 1.0,
+                "trials": [
+                    {"name": "sweep-t0", "status": "Stopped",
+                     "objective": 0.4, "parameters": {"--lr": 0.01},
+                     "chips": 8, "startKind": "cold",
+                     "stoppedEarly": True, "generation": 0},
+                    {"name": "sweep-t1", "status": "Succeeded",
+                     "objective": 0.93, "parameters": {"--lr": 0.1},
+                     "chips": 8, "startKind": "aot",
+                     "stoppedEarly": False, "generation": 0},
+                    {"name": "sweep-t2", "status": "Running",
+                     "parameters": {"--lr": 0.5}, "chips": 8,
+                     "startKind": "warm", "stoppedEarly": False,
+                     "generation": 0},
+                ]},
+        })
+        # the admission shorthand (algorithm as a plain name) must not
+        # 500 the list view — it regressed once
+        cluster.create({
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "Experiment",
+            "metadata": {"name": "shorthand", "namespace": "kubeflow"},
+            "spec": {"algorithm": "random"},
+        })
+        server = DashboardServer(cluster)
+        port = server.start()
+        try:
+            exps = get_json(
+                f"http://127.0.0.1:{port}/api/katib/experiments")
+            assert len(exps) == 2
+            assert {x["name"]: x["algorithm"] for x in exps} == \
+                {"shorthand": "random", "sweep": "grid"}
+            e = next(x for x in exps if x["name"] == "sweep")
+            assert e["phase"] == "Running"
+            assert e["algorithm"] == "grid"
+            assert e["trialsPerHour"] == 12.5
+            assert e["warmStartFraction"] == 1.0
+            assert e["chipHours"]["saved"] == 1.2
+            assert "trials" not in e  # the list view stays light
+            detail = get_json(f"http://127.0.0.1:{port}"
+                              f"/api/katib/experiments/kubeflow/sweep")
+            assert [t["startKind"] for t in detail["trials"]] == \
+                ["cold", "aot", "warm"]
+            assert [t["stoppedEarly"] for t in detail["trials"]] == \
+                [True, False, False]
+            assert all(t["chips"] == 8 for t in detail["trials"])
+            # unknown experiment 404s instead of 500ing
+            import urllib.error
+            with pytest.raises(urllib.error.HTTPError) as err:
+                get_json(f"http://127.0.0.1:{port}"
+                         f"/api/katib/experiments/kubeflow/nope")
+            assert err.value.code == 404
+        finally:
+            server.stop()
+
     def test_activities_sorted_newest_first(self, cluster):
         for i, ts in enumerate(["2026-01-01", "2026-03-01", "2026-02-01"]):
             cluster.create({

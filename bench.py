@@ -2523,6 +2523,330 @@ def bench_ctrl_chaos(t_start: float | None = None) -> dict:
     }
 
 
+def bench_ctrl_scale(t_start: float | None = None) -> dict:
+    """Control-plane telemetry scale baseline (ISSUE 20).
+
+    The seeded churn ladder (100 → 1k → 10k jobs over 50 → 250 → 1k
+    nodes) driven through the REAL controllers — SliceScheduler + the
+    TPUJob operator over FakeCluster — recording per rung: plan-pass
+    p50/p99, write amplification, watch fan-out, and the no-op-pass
+    fraction. Asserted per rung: the client-side audit reconciles
+    EXACTLY against the apiserver's server-side totals (every request,
+    list object count, and list byte total, per component). Asserted on
+    the top rung: the slowest plan pass reconstructs phase-by-phase
+    from the span JSONL alone, and the modeled audit overhead on a
+    no-op pass stays under 1%.
+
+    Most jobs at each rung are pre-completed (Succeeded) so the ACTIVE
+    set stays bounded while the list payload — the thing that scales —
+    grows with the rung: a 10k-job pass still parses a 10k-manifest
+    snapshot. Churn per round: an admission burst, completions through
+    the real pod path, a node Ready flap, and (once per rung) a forced
+    preemption with every pool occupied.
+
+    Env knobs (the ctrl_scale_bench_smoke CI entry shrinks the ladder):
+    KFTPU_BENCH_CTRL_SCALE_JOBS (top-rung jobs, default 10000),
+    KFTPU_BENCH_CTRL_SCALE_NODES (top-rung nodes, default 1000),
+    KFTPU_BENCH_CTRL_SCALE_SEEDS (churn seeds per rung, default 1).
+
+    Jax-free: dispatched before the backend probe, like warmstart."""
+    import os
+    import random
+    import shutil
+    import tempfile
+
+    from kubeflow_tpu.api import k8s
+    from kubeflow_tpu.api.topology import parse_topology
+    from kubeflow_tpu.api.trainingjob import BINDING_ANNOTATION
+    from kubeflow_tpu.cluster.fake import FakeCluster
+    from kubeflow_tpu.controllers.runtime import Manager
+    from kubeflow_tpu.controllers.tpujob import TrainingJobReconciler
+    from kubeflow_tpu.obs import controlplane as ctrlobs
+    from kubeflow_tpu.obs import registry as obsreg
+    from kubeflow_tpu.obs import trace as obstrace
+    from kubeflow_tpu.scheduler.core import SliceScheduler
+
+    t_start = time.perf_counter() if t_start is None else t_start
+    top_jobs = _env_int("KFTPU_BENCH_CTRL_SCALE_JOBS", 10000)
+    top_nodes = _env_int("KFTPU_BENCH_CTRL_SCALE_NODES", 1000)
+    seeds = max(1, _env_int("KFTPU_BENCH_CTRL_SCALE_SEEDS", 1))
+    pool_topo = "v5e-32"
+    hosts_per_pool = parse_topology(pool_topo).num_hosts
+
+    ladder: list[tuple[int, int]] = []
+    for div_j, div_n in ((100, 20), (10, 4), (1, 1)):
+        rung = (max(4, top_jobs // div_j),
+                max(hosts_per_pool, top_nodes // div_n))
+        if rung not in ladder:
+            ladder.append(rung)
+
+    def tpujob(name, topo="v5e-8", priority=0, preemptible=True,
+               completed=False):
+        job = {
+            "apiVersion": "tpu.kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": name, "namespace": "kubeflow"},
+            "spec": {
+                "replicaSpecs": {"TPU": {
+                    "tpuTopology": topo,
+                    "template": {"spec": {"containers": [
+                        {"name": "jax", "image": "trainer:v1"}]}}}},
+                "runPolicy": {"backoffLimit": 2},
+                "schedulingPolicy": {"queue": "scale",
+                                     "priority": priority,
+                                     "preemptible": preemptible},
+            }}
+        if completed:
+            job["status"] = {"conditions": [
+                {"type": "Succeeded", "status": "True"}]}
+        return job
+
+    def flip_node(cluster, name, ready):
+        node = cluster.get("v1", "Node", "", name)
+        for c in node.setdefault("status", {}).setdefault(
+                "conditions", []):
+            if c.get("type") == "Ready":
+                c["status"] = "True" if ready else "False"
+        cluster.update_status(node)
+
+    def complete_job(cluster, manifest):
+        """Finish a bound job through the real path: every pod of the
+        gang succeeds; the operator folds that into the Succeeded
+        condition on its next reconcile."""
+        name = manifest["metadata"]["name"]
+        for pod in cluster.list("v1", "Pod", "kubeflow"):
+            if pod["metadata"]["name"].startswith(name + "-worker"):
+                cluster.set_pod_phase("kubeflow",
+                                      pod["metadata"]["name"],
+                                      "Succeeded")
+
+    rows = []
+    checks: dict = {}
+    span_dir = tempfile.mkdtemp(prefix="kftpu-ctrl-scale-")
+    recon_names: list = []
+    overhead_fraction = float("nan")
+    try:
+        for rung_i, (jobs, nodes) in enumerate(ladder):
+            top = rung_i == len(ladder) - 1
+            span_path = os.path.join(span_dir, f"rung-{jobs}.jsonl")
+            os.environ[obstrace.SPAN_PATH_ENV] = span_path
+            obsreg.reset_default_registry()
+            obstrace.reset_default_tracers()
+            ctrlobs.reset_span_sampling()
+
+            pools = max(1, nodes // hosts_per_pool)
+            cluster = FakeCluster()
+            for p in range(pools):
+                cluster.add_tpu_slice_nodes(pool_topo, pool=f"pool-{p}")
+            node_names = [n["metadata"]["name"]
+                          for n in cluster.list("v1", "Node")]
+            # the completed bulk, created BEFORE any watcher exists:
+            # the rung's list-payload ballast, not churn
+            active_budget = min(24, max(4, jobs // 4))
+            for i in range(max(0, jobs - active_budget)):
+                cluster.create(tpujob(f"done-{i}", completed=True))
+
+            mgr = Manager(cluster)
+            sched_ctrl = mgr.add(SliceScheduler())
+            op_ctrl = mgr.add(TrainingJobReconciler("TPUJob"))
+            # the operator is per-key: drain its initial backlog (each
+            # completed-job reconcile is a cheap no-op) so churn keys
+            # are reachable. The scheduler stays budget-bounded — its
+            # pass is level-triggered, any key pop reads fresh state.
+            op_ctrl.run_pending(max_iters=jobs + 500)
+
+            t_rung = time.perf_counter()
+            admitted = 0
+            for seed in range(seeds):
+                rng = random.Random(1000 * (seed + 1) + rung_i)
+                for rnd in range(4):
+                    burst = min(active_budget // 2,
+                                4 + rng.randrange(4))
+                    for _ in range(burst):
+                        cluster.create(tpujob(f"live-{admitted}"))
+                        admitted += 1
+                    for _ in range(2):
+                        sched_ctrl.run_pending(max_iters=10)
+                        op_ctrl.run_pending(max_iters=400)
+                        cluster.tick()
+                    # complete roughly half the bound live jobs
+                    live = [m for m in cluster.list(
+                        "tpu.kubeflow.org/v1alpha1", "TPUJob")
+                        if m["metadata"]["name"].startswith("live-")
+                        and BINDING_ANNOTATION in k8s.annotations_of(m)
+                        and not k8s.condition_true(m, "Succeeded")]
+                    for m in live[: max(1, len(live) // 2)]:
+                        complete_job(cluster, m)
+                    flap = rng.choice(node_names)
+                    flip_node(cluster, flap, False)
+                    sched_ctrl.run_pending(max_iters=10)
+                    op_ctrl.run_pending(max_iters=400)
+                    flip_node(cluster, flap, True)
+                    sched_ctrl.run_pending(max_iters=10)
+                    op_ctrl.run_pending(max_iters=400)
+                    cluster.tick()
+
+            # forced preemption: occupy EVERY pool with a preemptible
+            # full-pool gang, then admit a higher-priority head — the
+            # cheapest victim is unbound, the head binds
+            for p in range(pools):
+                cluster.create(tpujob(f"filler-{p}", topo=pool_topo))
+            for _ in range(4):
+                sched_ctrl.run_pending(max_iters=max(12, pools + 4))
+                op_ctrl.run_pending(max_iters=12 * pools + 200)
+                cluster.tick()
+            cluster.create(tpujob("vip", priority=10,
+                                  preemptible=False))
+            for _ in range(4):
+                sched_ctrl.run_pending(max_iters=10)
+                op_ctrl.run_pending(max_iters=400)
+                cluster.tick()
+            vip = cluster.get("tpu.kubeflow.org/v1alpha1", "TPUJob",
+                              "kubeflow", "vip")
+            checks[f"preempt_bound_vip_{jobs}"] = \
+                BINDING_ANNOTATION in k8s.annotations_of(vip)
+
+            # no-op tail: steady state, measured — the no-op-pass
+            # latency the audit-overhead model divides by
+            if len(sched_ctrl.queue) == 0:
+                sched_ctrl.queue.add(("", "#noop-tail"))
+            t_tail = time.perf_counter()
+            tail = 0
+            for _ in range(30):
+                if len(sched_ctrl.queue) == 0:
+                    sched_ctrl.queue.add(("", "#noop-tail"))
+                sched_ctrl.pump_events()
+                if sched_ctrl.process_one():
+                    tail += 1
+            noop_mean_s = (time.perf_counter() - t_tail) / max(1, tail)
+
+            # exact reconciliation: every component's client-side audit
+            # against the server-side ledger — requests, list object
+            # counts, AND list byte totals, bidirectionally
+            clients = {c._name(): c.client for c in mgr.controllers}
+            mismatches = ctrlobs.audit_mismatches(clients, cluster.audit)
+            checks[f"audit_reconciles_exactly_{jobs}"] = not mismatches
+            if mismatches:
+                log_lines = mismatches[:8]
+                print(f"# ctrl-scale rung {jobs}: audit mismatches: "
+                      f"{log_lines}", file=sys.stderr, flush=True)
+
+            stats = ctrlobs.pass_stats()
+            sched = stats.get("scheduler", {})
+            server = cluster.audit.totals()
+            n_req = sum(server["requests"].values())
+            rows.append({
+                "jobs": jobs, "nodes": len(node_names),
+                "pools": pools, "seeds": seeds,
+                "sched_passes": sched.get("passes", 0),
+                "plan_pass_p50_ms": round(
+                    1e3 * sched.get("p50Seconds", 0.0), 2),
+                "plan_pass_p99_ms": round(
+                    1e3 * sched.get("p99Seconds", 0.0), 2),
+                "noop_pass_fraction": sched.get("noopFraction", 0.0),
+                "write_amplification": sched.get(
+                    "writeAmplification", 0.0),
+                "watch_fanout": round(cluster.audit.fanout(), 2),
+                "server_requests": n_req,
+                "relist_objects": sum(
+                    s.get("relistObjects", 0) for s in stats.values()),
+                "noop_pass_mean_ms": round(1e3 * noop_mean_s, 2),
+                "rung_wall_s": round(time.perf_counter() - t_rung, 1),
+            })
+
+            if top:
+                # (a) the slowest pass must reconstruct phase-by-phase
+                # from the JSONL alone — no registry, no process state
+                spans = obstrace.load_spans(span_path)
+                passes = [s for s in spans
+                          if s.get("name") == ctrlobs.CTRL_PASS_SPAN
+                          and s.get("component") == "scheduler"]
+                checks["top_rung_emitted_pass_spans"] = bool(passes)
+                slow = max(passes, key=lambda s: s.get("end", 0.0)
+                           - s.get("start", 0.0), default=None)
+                if slow is not None:
+                    recon = obstrace.reconstruct(span_path,
+                                                 slow["trace_id"])
+                    recon_names = recon["names"]
+                    phases = [n for n in recon_names
+                              if n in ctrlobs.PHASES]
+                    checks["slow_pass_reconstructs_phases"] = (
+                        ctrlobs.CTRL_PASS_SPAN in recon_names
+                        and ctrlobs.PHASE_SNAPSHOT in phases
+                        and ctrlobs.PHASE_PLAN in phases
+                        and all(n in ctrlobs.PHASES
+                                or n == ctrlobs.CTRL_PASS_SPAN
+                                for n in recon_names))
+                else:
+                    checks["slow_pass_reconstructs_phases"] = False
+
+                # (b) modeled audit overhead of a no-op pass: per-call
+                # accounting cost (client note + server record, deltas
+                # measured against the unwrapped inner call) times the
+                # pass's request count, over the measured no-op latency
+                probe = ctrlobs.AuditingKubeClient(cluster, "probe")
+                node0 = node_names[0]
+                M = 2000
+                t0 = time.perf_counter()
+                for _ in range(M):
+                    probe.get("v1", "Node", "", node0)
+                t_wrapped = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                for _ in range(M):
+                    cluster.get("v1", "Node", "", node0)
+                t_inner = time.perf_counter() - t0
+                get_delta = max(0.0, (t_wrapped - t_inner) / M)
+                L = 200
+                t0 = time.perf_counter()
+                for _ in range(L):
+                    probe.list("v1", "ConfigMap", "kubeflow")
+                t_wrapped = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                for _ in range(L):
+                    cluster.list("v1", "ConfigMap", "kubeflow")
+                t_inner = time.perf_counter() - t0
+                list_delta = max(0.0, (t_wrapped - t_inner) / L)
+                aud = ctrlobs.ServerAudit()
+                t0 = time.perf_counter()
+                for _ in range(M):
+                    aud.record(ctrlobs.VERB_GET, "Node")
+                record_cost = (time.perf_counter() - t0) / M
+                # a no-op scheduler pass: 1 config get + 2 lists
+                per_pass = get_delta + 2 * list_delta + 3 * record_cost
+                overhead_fraction = per_pass / max(1e-9, noop_mean_s)
+                checks["noop_audit_overhead_under_1pct"] = \
+                    overhead_fraction < 0.01
+            for c in mgr.controllers:
+                c.stop()
+    finally:
+        os.environ.pop(obstrace.SPAN_PATH_ENV, None)
+        obstrace.reset_default_tracers()
+        obsreg.reset_default_registry()
+        ctrlobs.reset_span_sampling()
+        shutil.rmtree(span_dir, ignore_errors=True)
+
+    assert all(checks.values()), {k: v for k, v in checks.items()
+                                  if not v}
+    top_row = rows[-1]
+    return {
+        "metric": "ctrl_scale_plan_pass_p99_s",
+        "value": round(top_row["plan_pass_p99_ms"] / 1e3, 4),
+        "unit": "seconds",
+        "vs_baseline": None,
+        "mfu": None,
+        "extras": {
+            "checks": checks,
+            "ladder": rows,
+            "top_rung": {
+                "jobs": top_row["jobs"], "nodes": top_row["nodes"],
+                "noop_audit_overhead_fraction": round(
+                    overhead_fraction, 6),
+                "slow_pass_phases": recon_names,
+            },
+        },
+        "_flops_per_chip": 0.0,
+    }
+
+
 def bench_goodput(t_start: float | None = None) -> dict:
     """Goodput ledger + flight recorder acceptance (ISSUE 10).
 
@@ -4110,7 +4434,7 @@ def main(argv=None) -> int:
                             "serving-fleet", "autoscaler",
                             "fused-blocks",
                             "weight-update", "kernels", "chaos",
-                            "ctrl-chaos", "sentinel",
+                            "ctrl-chaos", "ctrl-scale", "sentinel",
                             "input", "sched",
                             "health", "obs", "goodput", "comm",
                             "multislice",
@@ -4139,6 +4463,15 @@ def main(argv=None) -> int:
         row = bench_katib(t_start=t_start)
         print(json.dumps(row))
         print(f"# mode=katib extras={row['extras']}",
+              file=sys.stderr, flush=True)
+        return 0
+
+    if args.mode == "ctrl-scale":
+        # control-plane only (FakeCluster + the real controllers):
+        # jax-free by construction, so it precedes the probe too
+        row = bench_ctrl_scale(t_start=t_start)
+        print(json.dumps(row))
+        print(f"# mode=ctrl-scale extras={row['extras']}",
               file=sys.stderr, flush=True)
         return 0
 

@@ -20,6 +20,7 @@ measure stream catch-up; kube allowWatchBookmarks analog).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import threading
@@ -28,6 +29,7 @@ from typing import Optional
 from urllib.parse import parse_qs, urlsplit
 
 from ..api import k8s
+from ..obs import controlplane as ctrlobs
 from . import wire
 from .client import (AlreadyExistsError, ConflictError, KubeClient,
                      NotFoundError)
@@ -82,6 +84,12 @@ class ClusterAPIServer:
         # FakeCluster exposes its counter directly; any other backend is
         # tracked from the rvs observed in responses and watch events
         self._rv_high = 0
+        # wire-level request ledger (obs/controlplane.py): the SAME
+        # vocabulary as FakeCluster's server-side audit, so sim and REST
+        # report through one set of (component, verb, kind) rows. The
+        # caller's X-Kftpu-Component header attributes the request (and
+        # flows through to the backend's own ledger via the contextvar).
+        self.audit = ctrlobs.ServerAudit()
         for kind in _WELL_KNOWN_KINDS:
             self.learn_kind(kind)
 
@@ -197,11 +205,19 @@ def _make_handler(server: ClusterAPIServer):
             if parsed is None:
                 return self._send_error(
                     ApiError(404, "NotFound", f"no route {split.path}"))
+            # adopt the caller's component for attribution: this
+            # handler thread's ledger rows (and the backend's, via the
+            # contextvar) land under the caller's name, not unattributed
+            comp = self.headers.get(ctrlobs.COMPONENT_HEADER)
             if verb == "GET" and query.get("watch", ["false"])[0] == "true":
-                return self._stream_watch(parsed, query)
+                with ctrlobs.attributed(comp) if comp \
+                        else contextlib.nullcontext():
+                    return self._stream_watch(parsed, query)
             try:
-                self._send_json(200,
-                                self._handle(verb, parsed, query, body))
+                with ctrlobs.attributed(comp) if comp \
+                        else contextlib.nullcontext():
+                    self._send_json(200,
+                                    self._handle(verb, parsed, query, body))
             except ApiError as e:
                 self._send_error(e)
             except ValueError as e:  # bad selector/object → client error
@@ -215,6 +231,7 @@ def _make_handler(server: ClusterAPIServer):
             if verb == "GET":
                 kind = server.kind_for(parsed)
                 if parsed.name:
+                    server.audit.record(ctrlobs.VERB_GET, kind)
                     return backend.get(parsed.api_version, kind,
                                        parsed.namespace or "", parsed.name)
                 selector = None
@@ -223,6 +240,9 @@ def _make_handler(server: ClusterAPIServer):
                 items = backend.list(parsed.api_version, kind,
                                      namespace=parsed.namespace,
                                      selector=selector)
+                server.audit.record(ctrlobs.VERB_LIST, kind,
+                                    objects=len(items),
+                                    nbytes=ctrlobs.payload_bytes(items))
                 return {"apiVersion": parsed.api_version,
                         "kind": f"{kind}List", "items": items}
             if verb == "POST":
@@ -234,22 +254,29 @@ def _make_handler(server: ClusterAPIServer):
                     body.setdefault("metadata", {}).setdefault(
                         "namespace", parsed.namespace)
                 server.learn_kind(body.get("kind", ""))
+                server.audit.record(ctrlobs.VERB_CREATE,
+                                    str(body.get("kind", "")))
                 return self._observed(backend.create(body))
             if verb == "PUT":
                 if not parsed.name:
                     raise ApiError(405, "MethodNotAllowed",
                                    "PUT targets objects")
                 if parsed.subresource == "status":
+                    server.audit.record(ctrlobs.VERB_UPDATE_STATUS,
+                                        str(body.get("kind", "")))
                     return self._observed(backend.update_status(body))
                 if parsed.subresource:
                     raise ApiError(404, "NotFound",
                                    f"subresource {parsed.subresource!r}")
+                server.audit.record(ctrlobs.VERB_UPDATE,
+                                    str(body.get("kind", "")))
                 return self._observed(backend.update(body))
             if verb == "PATCH":
                 if not parsed.name:
                     raise ApiError(405, "MethodNotAllowed",
                                    "PATCH targets objects")
                 kind = server.kind_for(parsed)
+                server.audit.record(ctrlobs.VERB_PATCH, kind)
                 return self._observed(backend.patch(
                     parsed.api_version, kind, parsed.namespace or "",
                     parsed.name, body))
@@ -260,6 +287,7 @@ def _make_handler(server: ClusterAPIServer):
                 kind = server.kind_for(parsed)
                 cascade = query.get("propagationPolicy",
                                     ["Background"])[0] != "Orphan"
+                server.audit.record(ctrlobs.VERB_DELETE, kind)
                 backend.delete(parsed.api_version, kind,
                                parsed.namespace or "", parsed.name,
                                cascade=cascade)
@@ -311,6 +339,7 @@ def _make_handler(server: ClusterAPIServer):
             # Subscribe BEFORE reading the current rv: a mutation in the gap
             # is then either queued on w or covered by the initial bookmark.
             w = server.backend.watch()
+            server.audit.record(ctrlobs.VERB_WATCH, kind)
             current_rv = str(server.current_rv())
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
@@ -355,6 +384,7 @@ def _make_handler(server: ClusterAPIServer):
                              or k8s.matches_selector(obj, selector)))
                     if matches:
                         line = {"type": ev.type, "object": obj}
+                        server.audit.record_delivered(kind)
                     else:
                         line = {"type": wire.BOOKMARK, "object": {
                             "apiVersion": parsed.api_version, "kind": kind,

@@ -24,12 +24,14 @@ Modeled behavior:
 
 from __future__ import annotations
 
+import contextlib
 import copy
 import re
 import threading
 from typing import Callable, Optional
 
 from ..api import k8s
+from ..obs import controlplane as ctrlobs
 from .client import (ADDED, AlreadyExistsError, ConflictError, DELETED,
                      KubeClient, MODIFIED, NotFoundError, Watch, WatchEvent)
 
@@ -68,6 +70,31 @@ class FakeCluster(KubeClient):
         # persistence — the MutatingWebhookConfiguration analog
         # (controllers/admission.py PodDefaultsWebhook plugs in here)
         self.admission_hooks: list[Callable[[dict], dict]] = []
+        # server-side request ledger (obs/controlplane.py): every
+        # TOP-LEVEL request is accounted per (component, verb, kind);
+        # internal reentry (patch reads before merging, cascade GC
+        # deletes, set_pod_phase's read-modify-write) stays one request
+        # — that depth guard is what lets client-side audits reconcile
+        # EXACTLY against this ledger
+        self.audit = ctrlobs.ServerAudit()
+        self._audit_local = threading.local()
+
+    @contextlib.contextmanager
+    def _audited(self, verb: str, kind: str):
+        """Account one apiserver request at the outermost entry only.
+        Failures count too (the server processed the request); list
+        extras (object count/bytes) are filled into the yielded dict by
+        the caller on success."""
+        tl = self._audit_local
+        depth = getattr(tl, "depth", 0)
+        tl.depth = depth + 1
+        info: dict = {}
+        try:
+            yield info
+        finally:
+            tl.depth = depth
+            if depth == 0:
+                self.audit.record(verb, kind, **info)
 
     # ------------------------------------------------------------- snapshot
 
@@ -128,7 +155,8 @@ class FakeCluster(KubeClient):
         return av, kind, ns, k8s.name_of(obj)
 
     def create(self, obj: dict) -> dict:
-        with self._lock:
+        with self._audited(ctrlobs.VERB_CREATE, str(obj.get("kind", ""))), \
+                self._lock:
             obj = copy.deepcopy(obj)
             for hook in self.admission_hooks:
                 obj = hook(obj)
@@ -147,7 +175,7 @@ class FakeCluster(KubeClient):
             return copy.deepcopy(obj)
 
     def get(self, api_version: str, kind: str, namespace: str, name: str) -> dict:
-        with self._lock:
+        with self._audited(ctrlobs.VERB_GET, kind), self._lock:
             ns = "" if kind in CLUSTER_SCOPED_KINDS else (namespace or "default")
             obj = self._objects.get((api_version, kind, ns, name))
             if obj is None:
@@ -156,7 +184,7 @@ class FakeCluster(KubeClient):
 
     def list(self, api_version: str, kind: str, namespace: Optional[str] = None,
              selector: Optional[dict] = None) -> list[dict]:
-        with self._lock:
+        with self._audited(ctrlobs.VERB_LIST, kind) as info, self._lock:
             out = []
             for (av, k, ns, _), obj in self._objects.items():
                 if av != api_version or k != kind:
@@ -166,7 +194,12 @@ class FakeCluster(KubeClient):
                 if selector and not k8s.matches_selector(obj, selector):
                     continue
                 out.append(copy.deepcopy(obj))
-            return sorted(out, key=lambda o: (k8s.namespace_of(o), k8s.name_of(o)))
+            out.sort(key=lambda o: (k8s.namespace_of(o), k8s.name_of(o)))
+            # estimated on the SORTED payload: the client estimates on
+            # the same first object, so byte totals reconcile exactly
+            info["objects"] = len(out)
+            info["nbytes"] = ctrlobs.payload_bytes(out)
+            return out
 
     def _store_update(self, obj: dict, *, check_rv: bool = True) -> dict:
         key = self._key(obj)
@@ -188,12 +221,14 @@ class FakeCluster(KubeClient):
         return copy.deepcopy(obj)
 
     def update(self, obj: dict) -> dict:
-        with self._lock:
+        with self._audited(ctrlobs.VERB_UPDATE, str(obj.get("kind", ""))), \
+                self._lock:
             return self._store_update(obj)
 
     def update_status(self, obj: dict) -> dict:
         """Status-subresource update: merges only .status onto the stored spec."""
-        with self._lock:
+        with self._audited(ctrlobs.VERB_UPDATE_STATUS,
+                           str(obj.get("kind", ""))), self._lock:
             key = self._key(obj)
             existing = self._objects.get(key)
             if existing is None:
@@ -204,7 +239,7 @@ class FakeCluster(KubeClient):
 
     def patch(self, api_version: str, kind: str, namespace: str, name: str,
               patch: dict) -> dict:
-        with self._lock:
+        with self._audited(ctrlobs.VERB_PATCH, kind), self._lock:
             existing = self.get(api_version, kind, namespace, name)
             merged = k8s.deep_merge(existing, patch)
             merged["metadata"]["resourceVersion"] = \
@@ -213,7 +248,7 @@ class FakeCluster(KubeClient):
 
     def delete(self, api_version: str, kind: str, namespace: str, name: str,
                cascade: bool = True) -> None:
-        with self._lock:
+        with self._audited(ctrlobs.VERB_DELETE, kind), self._lock:
             ns = "" if kind in CLUSTER_SCOPED_KINDS else (namespace or "default")
             key = (api_version, kind, ns, name)
             obj = self._objects.pop(key, None)
@@ -239,15 +274,23 @@ class FakeCluster(KubeClient):
 
     def watch(self, api_version: Optional[str] = None,
               kind: Optional[str] = None) -> Watch:
-        with self._lock:
+        with self._audited(ctrlobs.VERB_WATCH, kind or ctrlobs.KIND_ANY), \
+                self._lock:
             w = Watch(api_version, kind)
             self._watches.append(w)
             return w
 
     def _broadcast(self, event: WatchEvent) -> None:
         self._watches = [w for w in self._watches if not w.closed]
+        delivered = 0
         for w in self._watches:
+            if w.matches(event.obj):
+                delivered += 1
             w.deliver(event)
+        # fan-out = delivered copies per broadcast event; counted even
+        # at zero watchers (the broadcast happened, nobody listened)
+        self.audit.record_broadcast(str(event.obj.get("kind", "")),
+                                    delivered)
 
     # ------------------------------------------------------------- node pool
 

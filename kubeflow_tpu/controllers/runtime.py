@@ -28,6 +28,7 @@ from typing import Optional
 
 from ..api import k8s
 from ..cluster.client import KubeClient, Watch
+from ..obs import controlplane as ctrlobs
 from ..obs import registry as obsreg
 
 log = logging.getLogger(__name__)
@@ -35,10 +36,10 @@ log = logging.getLogger(__name__)
 
 def _reconcile_metrics(controller: str) -> tuple:
     """(latency histogram child, error counter child, queue-depth gauge
-    child, retries-exhausted counter child) for one controller — the
-    per-stage accounting every hosted reconciler gets for free from the
-    manager loop. Resolved once per Controller and held (the registry's
-    resolve-once hot-path rule)."""
+    child, retries-exhausted counter child, workqueue-dwell histogram
+    child) for one controller — the per-stage accounting every hosted
+    reconciler gets for free from the manager loop. Resolved once per
+    Controller and held (the registry's resolve-once hot-path rule)."""
     labels = ("controller",)
     return (
         obsreg.histogram(
@@ -59,6 +60,7 @@ def _reconcile_metrics(controller: str) -> tuple:
             "(invisible to alerting as a log line; the blind resync is "
             "the only later recovery)",
             labels=labels).labels(controller=controller),
+        ctrlobs.workqueue_dwell_histogram(controller),
     )
 
 
@@ -150,12 +152,19 @@ class _WorkQueue:
         self._set: set[Key] = set()
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        # dwell accounting: first-enqueue time per queued key (a re-add
+        # while queued dedups, so dwell measures from the FIRST add —
+        # the latency the owner object actually experienced)
+        self._added: dict[Key, float] = {}
+        #: enqueue→pop dwell of the most recently popped key
+        self.last_dwell_s: float = 0.0
 
     def add(self, key: Key) -> None:
         with self._cv:
             if key not in self._set:
                 self._set.add(key)
                 self._items.append(key)
+                self._added[key] = time.monotonic()
                 self._cv.notify()
 
     def pop(self, timeout: Optional[float] = None) -> Optional[Key]:
@@ -166,6 +175,8 @@ class _WorkQueue:
                 return None
             key = self._items.pop(0)
             self._set.discard(key)
+            self.last_dwell_s = \
+                time.monotonic() - self._added.pop(key, time.monotonic())
             return key
 
     def __len__(self) -> int:
@@ -200,6 +211,9 @@ class Controller:
     # themselves).
     resync_interval: float = 0.0
     queue: _WorkQueue = field(default_factory=_WorkQueue)
+    #: relist records ({reason, objects, time}) — initial sync, resync,
+    #: leadership gain; the failover tests assert exactly-one here
+    relists: list = field(default_factory=list)
     _watches: list[Watch] = field(default_factory=list)
     _retries: dict[Key, int] = field(default_factory=dict)
     _stop: threading.Event = field(default_factory=threading.Event)
@@ -208,6 +222,29 @@ class Controller:
     # (latency, errors, depth) metric children — resolved on first use
     # and held for the controller's lifetime (hot-path rule)
     _metrics: Optional[tuple] = None
+
+    def __post_init__(self):
+        # the audit seam (obs/controlplane.py): every hosted reconciler
+        # drives the cluster through an AuditingKubeClient labeled by
+        # its controller identity, so per-pass write attribution and the
+        # client-vs-server reconciliation work on ALL production paths.
+        # Stacked wrappers (chaos, recording) audit what the component
+        # ISSUED; an already-audited client is not double-wrapped.
+        if not isinstance(self.client, ctrlobs.AuditingKubeClient):
+            self.client = ctrlobs.AuditingKubeClient(self.client,
+                                                     self._name())
+
+    def _name(self) -> str:
+        """The controller's metric/audit identity — the reconciler's
+        declared controller_name, falling back to its primary kind
+        (the same rule the reconcile metrics use)."""
+        return (getattr(self.reconciler, "controller_name", None)
+                or (self.reconciler.primary[1] or "unknown").lower())
+
+    def _note_relist(self, reason: str, objects: int) -> None:
+        self.relists.append({"reason": reason, "objects": objects,
+                             "time": time.time()})
+        ctrlobs.record_relist(self._name(), reason, objects)
 
     # -- wiring -------------------------------------------------------------
 
@@ -218,11 +255,15 @@ class Controller:
         for oav, okind in self.reconciler.owns:
             self._watches.append(self.client.watch(oav, okind))
 
-    def enqueue_existing(self) -> None:
-        """Initial list → enqueue (informer initial sync analog)."""
+    def enqueue_existing(self) -> int:
+        """Initial list → enqueue (informer initial sync analog).
+        Returns the number of objects listed — relist accounting at the
+        call sites (initial/resync/leader-gain) records it."""
         av, kind = self.reconciler.primary
-        for obj in self.client.list(av, kind):
+        objs = self.client.list(av, kind)
+        for obj in objs:
             self.queue.add((k8s.namespace_of(obj, "default"), k8s.name_of(obj)))
+        return len(objs)
 
     def _map_event_key(self, obj: dict) -> Optional[Key]:
         av_kind = (obj.get("apiVersion"), obj.get("kind"))
@@ -264,9 +305,11 @@ class Controller:
                 now - self._last_resync >= self.resync_interval:
             self._last_resync = now
             try:
-                self.enqueue_existing()
+                listed = self.enqueue_existing()
             except Exception as e:  # noqa: BLE001 — resync is best-effort
                 log.warning("resync list failed: %s", e)
+            else:
+                self._note_relist(ctrlobs.RELIST_RESYNC, listed)
         return n
 
     # -- execution ----------------------------------------------------------
@@ -284,9 +327,11 @@ class Controller:
         leading = self.elector.ensure()
         if leading and not self._was_leader:
             try:
-                self.enqueue_existing()
+                listed = self.enqueue_existing()
             except Exception as e:  # noqa: BLE001 — adopt is best-effort
                 log.warning("leader-gain relist failed: %s", e)
+            else:
+                self._note_relist(ctrlobs.RELIST_LEADER_GAIN, listed)
         self._was_leader = leading
         return leading
 
@@ -301,13 +346,18 @@ class Controller:
             # the SliceScheduler's primary is also TPUJob, and merging
             # its cluster-wide pass latencies into the operator's
             # per-job histogram would poison both
-            self._metrics = _reconcile_metrics(
-                getattr(self.reconciler, "controller_name", None)
-                or (self.reconciler.primary[1] or "unknown").lower())
-        latency, errors, depth, exhausted = self._metrics
+            self._metrics = _reconcile_metrics(self._name())
+        latency, errors, depth, exhausted, dwell = self._metrics
+        dwell.observe(self.queue.last_dwell_s)
         t0 = time.perf_counter()
         try:
-            res = self.reconciler.reconcile(self.client, key)
+            # pass-scoped audit: phase timings, per-key reconcile→write
+            # attribution, no-op classification. Reentrant — a
+            # reconciler opening its own ctrl_pass (the scheduler)
+            # joins this context instead of double-counting.
+            with ctrlobs.ctrl_pass(self._name(),
+                                   key=f"{key[0]}/{key[1]}"):
+                res = self.reconciler.reconcile(self.client, key)
             self._retries.pop(key, None)
             if res.requeue_after > 0:
                 self._delayed.append((time.monotonic() + res.requeue_after, key))
@@ -385,7 +435,7 @@ class Manager:
     def add(self, reconciler: Reconciler, **kwargs) -> Controller:
         c = Controller(reconciler=reconciler, client=self.client, **kwargs)
         c.bind_watches()
-        c.enqueue_existing()
+        c._note_relist(ctrlobs.RELIST_INITIAL, c.enqueue_existing())
         self.controllers.append(c)
         return c
 

@@ -217,6 +217,13 @@ class _Family:
         with self._lock:
             self._children.pop(key, None)
 
+    def children(self) -> dict[tuple, "_Child"]:
+        """Snapshot of the labeled children (label-value tuple -> child)
+        — the read surface dashboards/tests use to walk series without
+        reaching into _children."""
+        with self._lock:
+            return dict(self._children)
+
     # unlabeled families proxy the single default child ---------------------
 
     def _default(self) -> _Child:
@@ -320,6 +327,22 @@ class Registry:
                   buckets: Sequence[float] = DEFAULT_BUCKETS) -> _Family:
         return self._get(name, help, "histogram", labels, buckets=buckets)
 
+    def family(self, name: str) -> Optional[_Family]:
+        """The registered family, or None — the public read accessor
+        (registration stays through counter/gauge/histogram)."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            return self._families.get(name)
+
+    def series_counts(self) -> dict[str, int]:
+        """Live series (labeled children) per family — the registry's
+        own cardinality self-audit. A leaked per-job series shows up
+        here long before a scrape slows down."""
+        with self._lock:
+            families = list(self._families.values())
+        return {fam.name: len(fam.children()) for fam in families}
+
     def render(self) -> str:
         """The Prometheus text exposition, families in name order."""
         lines: list[str] = []
@@ -368,3 +391,32 @@ def gauge(name: str, help: str, labels: Sequence[str] = ()):
 def histogram(name: str, help: str, labels: Sequence[str] = (),
               buckets: Sequence[float] = DEFAULT_BUCKETS):
     return default_registry().histogram(name, help, labels, buckets=buckets)
+
+
+# the cardinality self-audit gauge (observability watching itself):
+# metric-series leaks are a control-plane scale risk of their own
+OBS_SERIES_FAMILY = "kftpu_obs_series_total"
+
+
+def export_series_totals(registry: Optional[Registry] = None) -> dict:
+    """Refresh ``kftpu_obs_series_total{family}`` from the registry's
+    live series counts (stale family rows are removed — a pruned family
+    must not keep exporting its last count). Called on scrape/endpoint
+    boundaries, not per mutation; returns the counts it exported."""
+    reg = registry if registry is not None else default_registry()
+    counts = reg.series_counts()
+    gauge = reg.gauge(OBS_SERIES_FAMILY,
+                      "live series (labeled children) per metric family",
+                      labels=("family",))
+    if gauge is _NULL:   # disabled registry: nothing to export
+        return counts
+    # count the self-audit family itself AFTER registration so the
+    # export is internally consistent (it appears in its own table)
+    counts[OBS_SERIES_FAMILY] = len(counts) + (
+        0 if OBS_SERIES_FAMILY in counts else 1)
+    for stale_key in set(gauge.children()) - {
+            (name,) for name in counts}:
+        gauge.remove(family=stale_key[0])
+    for name, n in counts.items():
+        gauge.labels(family=name).set(n)
+    return counts

@@ -56,6 +56,7 @@ from ..cluster.client import (KubeClient, NotFoundError, apply_annotations,
                               update_with_conflict_retry)
 from ..controllers.runtime import (Key, Reconciler, Result,
                                    ensure_trace_id, trace_job_event)
+from ..obs import controlplane as ctrlobs
 from ..obs import registry as obsreg
 from . import health
 from .inventory import POOL_LABEL, Placement, SliceInventory
@@ -683,17 +684,53 @@ class SliceScheduler(Reconciler):
 
     def reconcile(self, client: KubeClient, key: Key) -> Result:
         del key  # every pass is cluster-wide
+        # the audit seam: direct-drive callers (tests, bench, sim-replay)
+        # get write attribution too; under the controller runtime the
+        # client arrives already audited and ctrl_pass joins the
+        # runtime's open pass context instead of double-counting
+        if not isinstance(client, ctrlobs.AuditingKubeClient):
+            client = ctrlobs.AuditingKubeClient(client,
+                                                self.controller_name)
+        with ctrlobs.ctrl_pass(self.controller_name) as pctx:
+            return self._plan_pass(client, pctx)
+
+    def _plan_pass(self, client: KubeClient,
+                   pctx: "ctrlobs.PassContext") -> Result:
         t_pass = time.perf_counter()
         now = time.time()
-        self._refresh_config(client)
-        nodes = self._health_pass(client, client.list("v1", "Node"), now)
+        with pctx.phase(ctrlobs.PHASE_SNAPSHOT):
+            self._refresh_config(client)
+            raw_nodes = client.list("v1", "Node")
+        with pctx.phase(ctrlobs.PHASE_HEALTH):
+            nodes = self._health_pass(client, raw_nodes, now)
         inventory = SliceInventory.from_nodes(nodes)
         health_on = self.config.health.enabled
         queued: list[JobRequest] = []
         bound: list = []
         manifests: dict[str, dict] = {}
         avoid_cells: dict[str, set] = {}
-        for manifest in client.list(*self.primary):
+        with pctx.phase(ctrlobs.PHASE_SNAPSHOT):
+            # the job-scan loop is snapshot work (parse + binding
+            # validation); its corrective writes (evacuations,
+            # stale-binding drops) are timed here too
+            jobs_scanned = self._scan_jobs(client, inventory, health_on,
+                                           now, queued, bound,
+                                           manifests, avoid_cells)
+        return self._finish_pass(client, pctx, inventory, queued, bound,
+                                 manifests, avoid_cells, jobs_scanned,
+                                 len(nodes), t_pass)
+
+    def _scan_jobs(self, client: KubeClient, inventory: SliceInventory,
+                   health_on: bool, now: float, queued: list,
+                   bound: list, manifests: dict,
+                   avoid_cells: dict) -> int:
+        """Parse + validate every TPUJob manifest against the inventory
+        (the pass's job snapshot): re-occupy valid bindings, queue the
+        rest, evacuate gangs off suspect hosts. Returns manifests
+        scanned (completed jobs included — the skip is part of the
+        scan)."""
+        job_manifests = client.list(*self.primary)
+        for manifest in job_manifests:
             if k8s.condition_true(manifest, COND_SUCCEEDED) or \
                     k8s.condition_true(manifest, COND_FAILED):
                 continue
@@ -787,91 +824,117 @@ class SliceScheduler(Reconciler):
                     # the replan must keep clear of the suspect even
                     # while the host is still formally schedulable
                     avoid_cells[req.key] = suspect_cells
+        return len(job_manifests)
+
+    def _finish_pass(self, client: KubeClient,
+                     pctx: "ctrlobs.PassContext",
+                     inventory: SliceInventory, queued: list, bound: list,
+                     manifests: dict, avoid_cells: dict,
+                     jobs_scanned: int, nodes_scanned: int,
+                     t_pass: float) -> Result:
+        """Plan + apply + warm pass, phase-attributed (plan / writes /
+        warm-pass)."""
         self._note_queued(queued, manifests)
-        inventory.carve_down()
-        # warm-pod pools (scheduler/warmpool.py): the slots advertised
-        # LAST pass are this pass's placement preference — a bind that
-        # lands on one adopts a pre-initialized pod instead of cold-
-        # starting, so ties tip toward them
-        from . import warmpool
-        warm_slots = warmpool.slots_of(client) \
-            if self.config.warm_pods > 0 else []
-        prefer = warmpool.slot_cells(warm_slots, inventory) or None
-        decisions = plan(queued, bound, inventory, self.config,
-                         avoid_cells=avoid_cells, prefer_cells=prefer)
+        with pctx.phase(ctrlobs.PHASE_PLAN):
+            inventory.carve_down()
+            # warm-pod pools (scheduler/warmpool.py): the slots
+            # advertised LAST pass are this pass's placement preference
+            # — a bind that lands on one adopts a pre-initialized pod
+            # instead of cold-starting, so ties tip toward them
+            from . import warmpool
+            warm_slots = warmpool.slots_of(client) \
+                if self.config.warm_pods > 0 else []
+            prefer = warmpool.slot_cells(warm_slots, inventory) or None
+            decisions = plan(queued, bound, inventory, self.config,
+                             avoid_cells=avoid_cells, prefer_cells=prefer)
         # metrics/events fire AFTER their patch succeeded (the same
         # invariant as the operator's gang-restart counter): a transient
         # apiserver error requeues the whole pass, and the retry must
         # not double-count a preemption or observe a bogus second wait
-        for req, new_placement, reason in decisions.resizes:
-            old = next((p for r, p in bound if r.key == req.key), None)
-            self._apply_resize(client, manifests[req.key], old,
-                               new_placement, reason)
-        for victim in decisions.preempts:
-            self._apply_preempt(client, manifests[victim.key])
-            obsreg.counter(
-                "kftpu_sched_preemptions_total",
-                "gangs reclaimed (requeued, not failed) for "
-                "higher-priority work", labels=("queue",)).labels(
-                    queue=victim.queue).inc()
-            self._trace_event(manifests[victim.key], "preempted",
-                              queue=victim.queue, chips=victim.chips)
-        now = time.time()
-        for req, placement in decisions.binds:
-            if warm_slots:
-                # stamp the adopted warm slots into the binding: the
-                # operator retires exactly these pre-initialized pods
-                # and marks the gang warm-started
-                placement.warm_hosts = warmpool.covered_slots(
-                    placement, warm_slots, inventory)
-            # a rebind retires the job's suspect record: the new
-            # placement was planned around it, evidence already folded
-            extra = {SUSPECT_ANNOTATION: None} \
-                if health.suspect_of(manifests[req.key]) else {}
-            resized = placement.chips != req.chips
-            extra_fn = None
-            if resized:
-                # a non-nominal bind IS the resize — below nominal it is
-                # shrink-to-survive, above it a grow folded into the
-                # bind (gang placed straight into idle capacity) —
-                # recorded on the history annotation so dashboards and
-                # the grow cooldown see it (extra_fn: appended onto the
-                # FRESH object's history per write attempt)
-                reason = ("shrink: degraded bind (no nominal rectangle "
-                          "free)" if placement.chips < req.chips else
-                          "grow: bound above nominal into idle capacity")
-                extra_fn = (lambda obj, req=req, placement=placement,
-                            reason=reason, now=now: {
-                                RESIZE_HISTORY_ANNOTATION:
-                                self._history_json(
-                                    obj, req.chips, placement.chips,
-                                    reason, now)})
-            self._patch_state(client, manifests[req.key], STATE_BOUND,
-                              "bound", binding=placement,
-                              extra=extra or None, extra_fn=extra_fn)
-            if resized:
-                self._count_resize(manifests[req.key], req.chips,
-                                   placement.chips, reason)
-            waited = now - self._queued_since.pop(req.key, now)
-            obsreg.histogram(
-                "kftpu_sched_queue_wait_seconds",
-                "admission→bind wait per gang (preempted gangs wait "
-                "again)", labels=("queue",)).labels(
-                    queue=req.queue).observe(waited)
-            self._trace_event(
-                manifests[req.key], "bound", queue=req.queue,
-                chips=req.chips, wait_seconds=round(waited, 3),
-                pools=sorted({r.pool for r in placement.slices}))
-        for req in queued:
-            if req.key in decisions.waits:
-                self._mark_queued(client, manifests[req.key],
-                                  decisions.waits[req.key])
-        pending_warm = {
-            (w["pool"], int(w["host"]))
-            for _r, p in [*bound, *decisions.binds]
-            for w in (p.warm_hosts or [])}
-        self._warm_pass(client, inventory, pending_warm)
+        with pctx.phase(ctrlobs.PHASE_WRITES):
+            for req, new_placement, reason in decisions.resizes:
+                old = next((p for r, p in bound if r.key == req.key), None)
+                self._apply_resize(client, manifests[req.key], old,
+                                   new_placement, reason)
+            for victim in decisions.preempts:
+                self._apply_preempt(client, manifests[victim.key])
+                obsreg.counter(
+                    "kftpu_sched_preemptions_total",
+                    "gangs reclaimed (requeued, not failed) for "
+                    "higher-priority work", labels=("queue",)).labels(
+                        queue=victim.queue).inc()
+                self._trace_event(manifests[victim.key], "preempted",
+                                  queue=victim.queue, chips=victim.chips)
+            now = time.time()
+            for req, placement in decisions.binds:
+                if warm_slots:
+                    # stamp the adopted warm slots into the binding: the
+                    # operator retires exactly these pre-initialized pods
+                    # and marks the gang warm-started
+                    placement.warm_hosts = warmpool.covered_slots(
+                        placement, warm_slots, inventory)
+                # a rebind retires the job's suspect record: the new
+                # placement was planned around it, evidence already folded
+                extra = {SUSPECT_ANNOTATION: None} \
+                    if health.suspect_of(manifests[req.key]) else {}
+                resized = placement.chips != req.chips
+                extra_fn = None
+                if resized:
+                    # a non-nominal bind IS the resize — below nominal it
+                    # is shrink-to-survive, above it a grow folded into
+                    # the bind (gang placed straight into idle capacity)
+                    # — recorded on the history annotation so dashboards
+                    # and the grow cooldown see it (extra_fn: appended
+                    # onto the FRESH object's history per write attempt)
+                    reason = ("shrink: degraded bind (no nominal "
+                              "rectangle free)"
+                              if placement.chips < req.chips else
+                              "grow: bound above nominal into idle "
+                              "capacity")
+                    extra_fn = (lambda obj, req=req, placement=placement,
+                                reason=reason, now=now: {
+                                    RESIZE_HISTORY_ANNOTATION:
+                                    self._history_json(
+                                        obj, req.chips, placement.chips,
+                                        reason, now)})
+                self._patch_state(client, manifests[req.key], STATE_BOUND,
+                                  "bound", binding=placement,
+                                  extra=extra or None, extra_fn=extra_fn)
+                if resized:
+                    self._count_resize(manifests[req.key], req.chips,
+                                       placement.chips, reason)
+                waited = now - self._queued_since.pop(req.key, now)
+                obsreg.histogram(
+                    "kftpu_sched_queue_wait_seconds",
+                    "admission→bind wait per gang (preempted gangs wait "
+                    "again)", labels=("queue",)).labels(
+                        queue=req.queue).observe(waited)
+                self._trace_event(
+                    manifests[req.key], "bound", queue=req.queue,
+                    chips=req.chips, wait_seconds=round(waited, 3),
+                    pools=sorted({r.pool for r in placement.slices}))
+            for req in queued:
+                if req.key in decisions.waits:
+                    self._mark_queued(client, manifests[req.key],
+                                      decisions.waits[req.key])
+        with pctx.phase(ctrlobs.PHASE_WARM):
+            pending_warm = {
+                (w["pool"], int(w["host"]))
+                for _r, p in [*bound, *decisions.binds]
+                for w in (p.warm_hosts or [])}
+            self._warm_pass(client, inventory, pending_warm)
         self._export_queue_gauges(queued, bound, decisions)
+        obsreg.gauge(
+            "kftpu_sched_pass_jobs_scanned",
+            "TPUJob manifests scanned by the last plan pass").set(
+                jobs_scanned)
+        obsreg.gauge(
+            "kftpu_sched_pass_nodes_scanned",
+            "nodes scanned by the last plan pass").set(nodes_scanned)
+        pctx.note(jobs_scanned=jobs_scanned, nodes_scanned=nodes_scanned,
+                  queued=len(queued), bound=len(bound),
+                  binds=len(decisions.binds),
+                  preempts=len(decisions.preempts))
         obsreg.histogram(
             "kftpu_sched_plan_seconds",
             "wall time of one cluster-wide scheduling pass").observe(

@@ -32,7 +32,10 @@ Routes:
                                             collective mix, full-reshard
                                             verdict)
   GET /api/obs/controlplane                (HA leases: current leaders,
-                                            lease age, transitions)
+                                            lease age, transitions; plus
+                                            telemetry: per-component pass
+                                            stats, apiserver audit rollup,
+                                            metric-series cardinality)
   GET /healthz
 """
 
@@ -664,17 +667,25 @@ def build_dashboard_app(client: KubeClient,
 
     @app.route("GET", "/api/obs/controlplane")
     def controlplane_obs(params, query, body):
-        """Control-plane HA state (cluster/lease.py): every Lease in
-        the cluster — current holder, lease age (now − renewTime),
-        duration, expired flag, and the transitions count (the fencing
-        token; each increment is one failover). The panel operators
-        read when "is anything leading the scheduler right now" is the
-        question (docs/operations.md "Control-plane HA")."""
+        """Control-plane HA state + telemetry. HA (cluster/lease.py):
+        every Lease in the cluster — current holder, lease age (now −
+        renewTime), duration, expired flag, and the transitions count
+        (the fencing token; each increment is one failover). Telemetry
+        (obs/controlplane.py): per-component pass statistics (no-op
+        fraction, p50/p99 pass latency, write amplification, relists),
+        the server-side audit rollup when the apiserver ledger is
+        in-process (FakeCluster / the sim — absent over a remote
+        apiserver), and the metric-series cardinality self-audit. The
+        panel operators read when "is anything leading the scheduler
+        right now" or "what is hammering the apiserver" is the
+        question (docs/operations.md "Control-plane telemetry")."""
         import time as _time
 
         from ..cluster.client import KubeError
         from ..cluster.lease import (LEASE_API_VERSION, LEASE_KIND,
                                      lease_record)
+        from ..obs import controlplane as ctrlobs
+        from ..obs.registry import export_series_totals
         now = _time.time()
         leases = []
         try:
@@ -693,9 +704,38 @@ def build_dashboard_app(client: KubeClient,
                 "transitions": rec.transitions,
                 "expired": rec.expired(now),
             })
-        return 200, {"leases": sorted(leases,
-                                      key=lambda r: (r["namespace"],
-                                                     r["name"]))}
+        # server-side ledger: the raw client may be wrapped (audit /
+        # chaos / recording stacks) — walk .inner to the backend
+        server = None
+        backend = client
+        while backend is not None and not hasattr(backend, "audit"):
+            backend = getattr(backend, "inner", None)
+        audit = getattr(backend, "audit", None)
+        if audit is not None:
+            totals = audit.totals()
+            by_verb: dict = {}
+            for (_c, verb, _k), n in totals["requests"].items():
+                by_verb[verb] = by_verb.get(verb, 0) + n
+            server = {
+                "requests": sum(totals["requests"].values()),
+                "byVerb": dict(sorted(by_verb.items())),
+                "listObjects": sum(totals["list_objects"].values()),
+                "listBytes": sum(totals["list_bytes"].values()),
+                "watchFanout": round(audit.fanout(), 3),
+            }
+        series = export_series_totals()
+        return 200, {
+            "leases": sorted(leases, key=lambda r: (r["namespace"],
+                                                    r["name"])),
+            "passes": ctrlobs.pass_stats(),
+            "server": server,
+            "series": {
+                "families": len(series),
+                "total": sum(series.values()),
+                "top": dict(sorted(series.items(),
+                                   key=lambda kv: -kv[1])[:10]),
+            },
+        }
 
     @app.route("GET", "/api/obs/comm/{namespace}/{name}")
     def comm_obs(params, query, body):

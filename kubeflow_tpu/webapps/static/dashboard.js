@@ -289,20 +289,58 @@
   // scheduler" alarm, flagged with a badge, never color alone.
   function controlPlanePanel(data) {
     const leases = (data && data.leases) || [];
-    if (!leases.length) return [];
-    const rows = leases.map((l) => ({
-      lease: `${l.namespace}/${l.name}`,
-      leader: l.holder || "(none)",
-      "lease age": l.ageSeconds == null ? "" : `${l.ageSeconds}s`,
-      duration: `${l.durationSeconds}s`,
-      failovers: Math.max(0, (l.transitions || 1) - 1),
-      state: l.expired ? "✗ expired — no leader" : "✓ held",
-    }));
-    return [
-      el("h2", { text: "Control plane" }),
-      table(rows, ["lease", "leader", "lease age", "duration",
-                   "failovers", "state"]),
-    ];
+    const passes = (data && data.passes) || {};
+    const server = data && data.server;
+    const series = data && data.series;
+    const out = [];
+    if (leases.length) {
+      const rows = leases.map((l) => ({
+        lease: `${l.namespace}/${l.name}`,
+        leader: l.holder || "(none)",
+        "lease age": l.ageSeconds == null ? "" : `${l.ageSeconds}s`,
+        duration: `${l.durationSeconds}s`,
+        failovers: Math.max(0, (l.transitions || 1) - 1),
+        state: l.expired ? "✗ expired — no leader" : "✓ held",
+      }));
+      out.push(
+        el("h2", { text: "Control plane" }),
+        table(rows, ["lease", "leader", "lease age", "duration",
+                     "failovers", "state"]));
+    }
+    // telemetry tiles (ISSUE 20): apiserver pressure + series
+    // cardinality at a glance; per-component pass stats as a table
+    const comps = Object.keys(passes).sort();
+    if (server || series || comps.length) {
+      if (!leases.length) out.push(el("h2", { text: "Control plane" }));
+      const tiles = [];
+      if (server) {
+        tiles.push(
+          statTile("API requests", server.requests),
+          statTile("List objects", server.listObjects),
+          statTile("Watch fan-out", server.watchFanout));
+      }
+      if (series) tiles.push(statTile("Metric series", series.total));
+      if (tiles.length) out.push(el("div", { class: "tiles" }, tiles));
+    }
+    if (comps.length) {
+      const rows = comps.map((c) => {
+        const p = passes[c];
+        return {
+          component: c,
+          passes: p.passes,
+          "no-op %": `${Math.round(p.noopFraction * 100)}%`,
+          "pass p50": `${Math.round(p.p50Seconds * 1e3)}ms`,
+          "pass p99": `${Math.round(p.p99Seconds * 1e3)}ms`,
+          "write amp": p.writeAmplification || "",
+          relists: p.relists,
+        };
+      });
+      out.push(
+        el("h3", { text: "Reconcile passes" }),
+        table(rows, ["component", "passes", "no-op %", "pass p50",
+                     "pass p99", "write amp", "relists"]));
+    }
+    return out;
   }
 
   async function viewOverview(root) {

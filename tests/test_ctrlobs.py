@@ -1,0 +1,604 @@
+"""Control-plane telemetry tests (ISSUE 20).
+
+Four tiers, mirroring the module's layering (obs/controlplane.py):
+- audit accounting: AuditingKubeClient vs FakeCluster's server-side
+  ledger — every request, list object count, and list byte total must
+  reconcile EXACTLY, per component, failures included; two writers on
+  one cluster must never cross-charge;
+- pass profiling: ctrl_pass phase attribution, write amplification,
+  no-op classification, reentrancy, span sampling pins (write-bearing
+  passes are NEVER sampled away);
+- runtime attribution: leadership-churn relist records (failover =
+  exactly one leader-gain record on the gaining replica), workqueue
+  dwell, the REST apiserver's header-carried attribution;
+- cardinality: kftpu_obs_series_total and the 200-job churn leak
+  regression (kftpu_job_phase, job ledgers, replica prune).
+
+The 10k-job/1k-node scale ladder rides bench.py --mode ctrl-scale.
+"""
+
+import math
+import time
+
+import pytest
+
+from kubeflow_tpu.cluster.fake import FakeCluster
+from kubeflow_tpu.controllers.runtime import Controller, Manager
+from kubeflow_tpu.controllers.tpujob import TrainingJobReconciler
+from kubeflow_tpu.obs import controlplane as ctrlobs
+from kubeflow_tpu.obs import registry as obsreg
+from kubeflow_tpu.obs import trace as obstrace
+from kubeflow_tpu.scheduler.core import SliceScheduler
+
+pytestmark = pytest.mark.ctrlobs
+
+TPU_AV = "tpu.kubeflow.org/v1alpha1"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry(monkeypatch):
+    """Every test starts from a zeroed registry, sampling counters, and
+    span-writer cache — and leaves none of them behind."""
+    monkeypatch.delenv(obstrace.SPAN_PATH_ENV, raising=False)
+    monkeypatch.delenv(ctrlobs.CTRL_SPAN_SAMPLE_ENV, raising=False)
+    obsreg.reset_default_registry()
+    ctrlobs.reset_span_sampling()
+    obstrace.reset_default_tracers()
+    yield
+    obstrace.reset_default_tracers()
+    obsreg.reset_default_registry()
+    ctrlobs.reset_span_sampling()
+
+
+def tpujob(name, ns="kubeflow", policy=True):
+    spec = {
+        "replicaSpecs": {"TPU": {
+            "tpuTopology": "v5e-8",
+            "template": {"spec": {"containers": [
+                {"name": "jax", "image": "trainer:v1"}]}}}},
+        "runPolicy": {"backoffLimit": 2},
+    }
+    if policy:
+        spec["schedulingPolicy"] = {"queue": "default", "priority": 0,
+                                    "preemptible": True}
+    return {"apiVersion": TPU_AV, "kind": "TPUJob",
+            "metadata": {"name": name, "namespace": ns}, "spec": spec}
+
+
+# ------------------------------------------------------- audit accounting
+
+
+class TestAuditAccounting:
+    def test_client_and_server_reconcile_exactly(self):
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        cli = ctrlobs.AuditingKubeClient(cluster, "sched")
+        cli.list("v1", "Node")
+        node = cli.get("v1", "Node", "", "tpu-pool-v5e-8-0")
+        cli.patch("v1", "Node", "", node["metadata"]["name"],
+                  {"metadata": {"labels": {"x": "y"}}})
+        with pytest.raises(Exception):
+            cli.get("v1", "Node", "", "no-such-node")
+        assert ctrlobs.audit_mismatches({"sched": cli},
+                                        cluster.audit) == []
+        # the failed get COUNTED on both sides (the server processed it)
+        assert cli.totals()["requests"][("get", "Node")] == 2
+
+    def test_list_payload_objects_and_bytes_match(self):
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")   # 2 hosts
+        cli = ctrlobs.AuditingKubeClient(cluster, "sched")
+        out = cli.list("v1", "Node")
+        want = ctrlobs.payload_bytes(out)
+        assert cli.totals()["list_objects"]["Node"] == len(out) == 2
+        assert cli.totals()["list_bytes"]["Node"] == want
+        st = cluster.audit.totals()
+        assert st["list_objects"][("sched", "Node")] == 2
+        assert st["list_bytes"][("sched", "Node")] == want
+
+    def test_two_writers_never_cross_charge(self):
+        """The operator and the scheduler on ONE cluster: the server's
+        ledger keeps their rows apart — pod creates land on the
+        operator's account, binding patches on the scheduler's — and
+        both reconcile exactly at once."""
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        mgr = Manager(cluster)
+        sched = mgr.add(SliceScheduler())
+        op = mgr.add(TrainingJobReconciler("TPUJob"))
+        cluster.create(tpujob("train"))
+        for _ in range(4):
+            mgr.run_pending()
+            cluster.tick()
+        try:
+            assert len(cluster.list("v1", "Pod", "kubeflow")) == 2
+            clients = {c._name(): c.client for c in mgr.controllers}
+            assert set(clients) == {"scheduler", "tpujob"}
+            assert ctrlobs.audit_mismatches(clients,
+                                            cluster.audit) == []
+            req = cluster.audit.totals()["requests"]
+            assert req[("tpujob", "create", "Pod")] == 2
+            assert ("scheduler", "create", "Pod") not in req
+            assert req[("scheduler", "patch", "TPUJob")] >= 1
+            # ... and the registry carries the same split
+            fam = obsreg.default_registry().family(
+                "kftpu_ctrl_requests_total")
+            by_comp = {k: int(c.value)
+                       for k, c in fam.children().items()}
+            assert by_comp[("tpujob", "create", "Pod")] == 2
+            assert ("scheduler", "create", "Pod") not in by_comp
+        finally:
+            sched.stop()
+            op.stop()
+
+    def test_unattributed_writes_ignored_by_reconciliation(self):
+        cluster = FakeCluster()
+        cli = ctrlobs.AuditingKubeClient(cluster, "sched")
+        cli.list("v1", "Node")
+        # hand-of-god helper traffic: server-side rows exist, but under
+        # "unattributed" — no client ledger to reconcile against
+        cluster.create(tpujob("direct"))
+        st = cluster.audit.totals()
+        assert st["requests"][(ctrlobs.UNATTRIBUTED, "create",
+                               "TPUJob")] == 1
+        assert ctrlobs.audit_mismatches({"sched": cli},
+                                        cluster.audit) == []
+
+    def test_mismatch_reported_both_directions(self):
+        cluster = FakeCluster()
+        cli = ctrlobs.AuditingKubeClient(cluster, "sched")
+        cli.list("v1", "Node")
+        # a server row the client never issued (cross-charged traffic)
+        with ctrlobs.attributed("sched"):
+            cluster.create(tpujob("forged"))
+        lines = ctrlobs.audit_mismatches({"sched": cli}, cluster.audit)
+        assert any("create/TPUJob" in line for line in lines)
+
+    def test_vocabulary_shape(self):
+        assert ctrlobs.MUTATING_VERBS == frozenset((
+            "create", "update", "update_status", "patch", "delete"))
+        assert ctrlobs.VERB_LIST not in ctrlobs.MUTATING_VERBS
+        assert ctrlobs.VERB_WATCH not in ctrlobs.MUTATING_VERBS
+        assert ctrlobs.PHASES == ("snapshot", "health-pass", "plan",
+                                  "writes", "warm-pass")
+        assert ctrlobs.RELIST_REASONS == ("initial", "resync",
+                                          "leader-gain")
+
+
+# -------------------------------------------------------- pass profiling
+
+
+class TestPassProfiling:
+    def test_phase_attribution_accumulates(self):
+        with ctrlobs.ctrl_pass("sched") as pctx:
+            with pctx.phase(ctrlobs.PHASE_SNAPSHOT):
+                time.sleep(0.01)
+            with pctx.phase(ctrlobs.PHASE_PLAN):
+                pass
+            with pctx.phase(ctrlobs.PHASE_SNAPSHOT):   # re-entry adds
+                time.sleep(0.01)
+        assert pctx.phases[ctrlobs.PHASE_SNAPSHOT][0] >= 0.02
+        assert set(pctx.phases) == {ctrlobs.PHASE_SNAPSHOT,
+                                    ctrlobs.PHASE_PLAN}
+        with pytest.raises(ValueError):
+            with pctx.phase("not-a-phase"):
+                pass
+
+    def test_write_amplification_counts_distinct_objects(self):
+        cluster = FakeCluster()
+        cluster.add_node("n0", {"cpu": 1})
+        cluster.add_node("n1", {"cpu": 1})
+        cli = ctrlobs.AuditingKubeClient(cluster, "sched")
+        with ctrlobs.ctrl_pass("sched") as pctx:
+            cli.patch("v1", "Node", "", "n0",
+                      {"metadata": {"labels": {"a": "1"}}})
+            cli.patch("v1", "Node", "", "n0",
+                      {"metadata": {"labels": {"a": "2"}}})
+            cli.patch("v1", "Node", "", "n1",
+                      {"metadata": {"labels": {"a": "1"}}})
+        assert pctx.mutating_calls == 3
+        assert len(pctx.changed) == 2
+        assert pctx.write_amplification == pytest.approx(1.5)
+        g = obsreg.default_registry().family(
+            "kftpu_ctrl_write_amplification")
+        assert g.children()[("sched",)].value == pytest.approx(1.5)
+
+    def test_failed_mutation_amplifies_without_changing(self):
+        cluster = FakeCluster()
+        cluster.add_node("n0", {"cpu": 1})
+        cli = ctrlobs.AuditingKubeClient(cluster, "sched")
+        with ctrlobs.ctrl_pass("sched") as pctx:
+            cli.patch("v1", "Node", "", "n0",
+                      {"metadata": {"labels": {"a": "1"}}})
+            with pytest.raises(Exception):
+                cli.patch("v1", "Node", "", "ghost",
+                          {"metadata": {"labels": {"a": "1"}}})
+        # numerator counts the failed call; denominator does not
+        assert pctx.mutating_calls == 2
+        assert len(pctx.changed) == 1
+        assert pctx.write_amplification == pytest.approx(2.0)
+
+    def test_noop_and_write_outcomes_counted(self):
+        cluster = FakeCluster()
+        cluster.add_node("n0", {"cpu": 1})
+        cli = ctrlobs.AuditingKubeClient(cluster, "sched")
+        with ctrlobs.ctrl_pass("sched"):
+            cli.list("v1", "Node")          # reads only: a no-op pass
+        with ctrlobs.ctrl_pass("sched"):
+            cli.patch("v1", "Node", "", "n0",
+                      {"metadata": {"labels": {"b": "1"}}})
+        fam = obsreg.default_registry().family("kftpu_ctrl_passes_total")
+        by_outcome = {k: int(c.value) for k, c in fam.children().items()}
+        assert by_outcome[("sched", ctrlobs.OUTCOME_NOOP)] == 1
+        assert by_outcome[("sched", ctrlobs.OUTCOME_WRITE)] == 1
+
+    def test_reentrant_pass_joins_not_double_counts(self):
+        with ctrlobs.ctrl_pass("op", key="a/b") as outer:
+            with ctrlobs.ctrl_pass("op") as inner:
+                assert inner is outer
+        fam = obsreg.default_registry().family("kftpu_ctrl_passes_total")
+        assert sum(int(c.value) for c in fam.children().values()) == 1
+
+    def test_pass_stats_rollup(self):
+        with ctrlobs.ctrl_pass("sched"):
+            pass
+        with ctrlobs.ctrl_pass("sched") as pctx:
+            pctx.note_request(ctrlobs.VERB_PATCH, "Node", ok=True,
+                              changed_key=("Node", "", "n0"))
+        ctrlobs.record_relist("sched", ctrlobs.RELIST_INITIAL, 7)
+        stats = ctrlobs.pass_stats()["sched"]
+        assert stats["passes"] == 2
+        assert stats["noopPasses"] == 1
+        assert stats["noopFraction"] == pytest.approx(0.5)
+        assert stats["writeAmplification"] == pytest.approx(1.0)
+        assert stats["relists"] == 1 and stats["relistObjects"] == 7
+        with pytest.raises(ValueError):
+            ctrlobs.record_relist("sched", "vibes", 1)
+
+    def test_quantile_from_buckets_interpolates(self):
+        buckets = {0.1: 10, 0.5: 20, math.inf: 20}
+        assert ctrlobs.quantile_from_buckets(buckets, 0.5) == \
+            pytest.approx(0.1)
+        assert ctrlobs.quantile_from_buckets(buckets, 0.75) == \
+            pytest.approx(0.3)
+        assert ctrlobs.quantile_from_buckets({}, 0.5) == 0.0
+
+
+# --------------------------------------------------------- span sampling
+
+
+class TestSpanSampling:
+    def _emit_passes(self, tmp_path, monkeypatch, n, write_every=None,
+                     sample="5"):
+        monkeypatch.setenv(obstrace.SPAN_PATH_ENV,
+                           str(tmp_path / "spans.jsonl"))
+        monkeypatch.setenv(ctrlobs.CTRL_SPAN_SAMPLE_ENV, sample)
+        ctrlobs.reset_span_sampling()
+        for i in range(n):
+            with ctrlobs.ctrl_pass("sched") as pctx:
+                with pctx.phase(ctrlobs.PHASE_SNAPSHOT):
+                    pass
+                with pctx.phase(ctrlobs.PHASE_PLAN):
+                    pass
+                if write_every and i % write_every == 0:
+                    pctx.note_request(
+                        ctrlobs.VERB_PATCH, "TPUJob", ok=True,
+                        changed_key=("TPUJob", "kubeflow", f"j{i}"))
+        obstrace.reset_default_tracers()   # flush writers
+        return obstrace.load_spans(str(tmp_path / "spans.jsonl"))
+
+    def test_noop_passes_sampled_one_in_n(self, tmp_path, monkeypatch):
+        spans = self._emit_passes(tmp_path, monkeypatch, 10, sample="5")
+        passes = [s for s in spans
+                  if s["name"] == ctrlobs.CTRL_PASS_SPAN]
+        # deterministic 1-in-5: passes 0 and 5 emit
+        assert len(passes) == 2
+        assert all(s["attrs"]["outcome"] == "noop" for s in passes)
+        assert all(s["attrs"]["sample_n"] == 5 for s in passes)
+
+    def test_write_bearing_passes_never_sampled_away(self, tmp_path,
+                                                     monkeypatch):
+        spans = self._emit_passes(tmp_path, monkeypatch, 10,
+                                  write_every=1, sample="1000000")
+        passes = [s for s in spans
+                  if s["name"] == ctrlobs.CTRL_PASS_SPAN]
+        assert len(passes) == 10   # every single one, sampling ignored
+        assert all(s["attrs"]["outcome"] == "write" for s in passes)
+
+    def test_sample_one_emits_every_noop(self, tmp_path, monkeypatch):
+        spans = self._emit_passes(tmp_path, monkeypatch, 4, sample="1")
+        passes = [s for s in spans
+                  if s["name"] == ctrlobs.CTRL_PASS_SPAN]
+        assert len(passes) == 4
+
+    def test_pass_reconstructs_phase_by_phase_from_jsonl(
+            self, tmp_path, monkeypatch):
+        spans = self._emit_passes(tmp_path, monkeypatch, 1,
+                                  write_every=1)
+        parent = next(s for s in spans
+                      if s["name"] == ctrlobs.CTRL_PASS_SPAN)
+        assert parent["trace_id"].startswith(
+            ctrlobs.CTRL_PASS_TRACE_PREFIX)
+        recon = obstrace.reconstruct(str(tmp_path / "spans.jsonl"),
+                                     parent["trace_id"])
+        assert recon["names"][0] == ctrlobs.CTRL_PASS_SPAN
+        assert recon["names"][1:] == [ctrlobs.PHASE_SNAPSHOT,
+                                      ctrlobs.PHASE_PLAN]
+        # children nest inside the parent window
+        # serialized timestamps are rounded — allow ms-level slack
+        p = recon["events"][0]
+        for child in recon["events"][1:]:
+            assert child["start"] >= p["start"] - 1e-3
+            assert child["end"] <= p["end"] + 1e-3
+
+
+# ------------------------------------------- runtime/REST attribution
+
+
+class TestRuntimeAttribution:
+    def test_manager_add_records_initial_relist(self):
+        cluster = FakeCluster()
+        cluster.create(tpujob("a"))
+        cluster.create(tpujob("b"))
+        mgr = Manager(cluster)
+        op = mgr.add(TrainingJobReconciler("TPUJob"))
+        try:
+            assert [r["reason"] for r in op.relists] == \
+                [ctrlobs.RELIST_INITIAL]
+            assert op.relists[0]["objects"] == 2
+        finally:
+            op.stop()
+
+    def test_resync_records_relist(self):
+        cluster = FakeCluster()
+        cluster.create(tpujob("a"))
+        ctrl = Controller(reconciler=TrainingJobReconciler("TPUJob"),
+                          client=cluster, resync_interval=0.01)
+        try:
+            ctrl.pump_events()
+            resyncs = [r for r in ctrl.relists
+                       if r["reason"] == ctrlobs.RELIST_RESYNC]
+            assert len(resyncs) == 1 and resyncs[0]["objects"] == 1
+        finally:
+            ctrl.stop()
+
+    def test_failover_exactly_one_leader_gain_on_gaining_replica(self):
+        from kubeflow_tpu.cluster import lease as L
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        cluster.create(tpujob("train"))
+
+        def replica(ident):
+            elector = L.LeaderElector(client=cluster, identity=ident,
+                                      name="op", duration_s=0.25)
+            ctrl = Controller(
+                reconciler=TrainingJobReconciler("TPUJob"),
+                client=L.FencedKubeClient(cluster, elector),
+                elector=elector)
+            ctrl.bind_watches()
+            return elector, ctrl
+
+        el_a, ctrl_a = replica("a")
+        el_b, ctrl_b = replica("b")
+        try:
+            for _ in range(3):
+                ctrl_a.run_pending()
+                ctrl_b.run_pending()
+                cluster.tick()
+            assert el_a.is_leader and not el_b.is_leader
+            gains_a = [r for r in ctrl_a.relists
+                       if r["reason"] == ctrlobs.RELIST_LEADER_GAIN]
+            assert len(gains_a) == 1       # winning the FIRST election
+            assert ctrl_b.relists == []    # the standby adopted nothing
+            # leader stops renewing; the standby steals after expiry
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and not el_b.is_leader:
+                ctrl_b.run_pending()
+                cluster.tick()
+                time.sleep(0.02)
+            assert el_b.is_leader
+            gains_b = [r for r in ctrl_b.relists
+                       if r["reason"] == ctrlobs.RELIST_LEADER_GAIN]
+            assert len(gains_b) == 1       # EXACTLY one adopt-the-world
+            assert gains_b[0]["objects"] == 1
+            # the deposed replica gained nothing new
+            assert len([r for r in ctrl_a.relists
+                        if r["reason"] ==
+                        ctrlobs.RELIST_LEADER_GAIN]) == 1
+        finally:
+            ctrl_a.stop()
+            ctrl_b.stop()
+
+    def test_workqueue_dwell_observed(self):
+        cluster = FakeCluster()
+        cluster.create(tpujob("train", policy=False))
+        ctrl = Controller(reconciler=TrainingJobReconciler("TPUJob"),
+                          client=cluster)
+        try:
+            ctrl.enqueue_existing()
+            time.sleep(0.01)
+            assert ctrl.process_one()
+            fam = obsreg.default_registry().family(
+                "kftpu_ctrl_workqueue_dwell_seconds")
+            buckets = fam.children()[("tpujob",)].bucket_counts()
+            assert buckets[math.inf] == 1
+            assert ctrl.queue.last_dwell_s >= 0.01
+        finally:
+            ctrl.stop()
+
+    def test_rest_apiserver_reconciles_with_component_header(self):
+        from kubeflow_tpu.cluster.apiserver import ClusterAPIServer
+        from kubeflow_tpu.cluster.http_client import HttpKubeClient
+        backend = FakeCluster()
+        srv = ClusterAPIServer(backend, port=0)
+        srv.start()
+        try:
+            inner = HttpKubeClient(f"http://127.0.0.1:{srv.port}")
+            cli = ctrlobs.AuditingKubeClient(inner, "op")
+            assert inner._headers[ctrlobs.COMPONENT_HEADER] == "op"
+            cli.create({"apiVersion": "v1", "kind": "ConfigMap",
+                        "metadata": {"name": "cm", "namespace": "d"},
+                        "data": {"k": "v"}})
+            cli.list("v1", "ConfigMap", "d")
+            cli.get("v1", "ConfigMap", "d", "cm")
+            cli.patch("v1", "ConfigMap", "d", "cm",
+                      {"data": {"k": "v2"}})
+            cli.delete("v1", "ConfigMap", "d", "cm")
+            assert ctrlobs.audit_mismatches({"op": cli},
+                                            srv.audit) == []
+        finally:
+            srv.stop()
+
+    def test_rest_watch_counts_stream_deliveries(self):
+        from kubeflow_tpu.cluster.apiserver import ClusterAPIServer
+        from kubeflow_tpu.cluster.http_client import HttpKubeClient
+        backend = FakeCluster()
+        srv = ClusterAPIServer(backend, port=0)
+        srv.start()
+        try:
+            cli = ctrlobs.AuditingKubeClient(
+                HttpKubeClient(f"http://127.0.0.1:{srv.port}"), "op")
+            w = cli.watch("v1", "ConfigMap")
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and \
+                    ("op", "watch", "ConfigMap") not in \
+                    srv.audit.totals()["requests"]:
+                time.sleep(0.02)
+            cli.create({"apiVersion": "v1", "kind": "ConfigMap",
+                        "metadata": {"name": "cm", "namespace": "d"},
+                        "data": {}})
+            got = None
+            while time.monotonic() < deadline and got is None:
+                got = w.get(timeout=0.1)
+            assert got is not None
+            assert srv.audit.totals()["requests"][
+                ("op", "watch", "ConfigMap")] == 1
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline and \
+                    srv.audit.totals()["watch_delivered"].get(
+                        "ConfigMap", 0) < 1:
+                time.sleep(0.02)
+            assert srv.audit.totals()["watch_delivered"][
+                "ConfigMap"] >= 1
+            w.close()
+        finally:
+            srv.stop()
+
+    def test_fake_cluster_watch_fanout(self):
+        cluster = FakeCluster()
+        a = cluster.watch("v1", "ConfigMap")
+        b = cluster.watch("v1", "ConfigMap")
+        cluster.create({"apiVersion": "v1", "kind": "ConfigMap",
+                        "metadata": {"name": "cm", "namespace": "d"},
+                        "data": {}})
+        assert cluster.audit.fanout("ConfigMap") == pytest.approx(2.0)
+        a.close()
+        b.close()
+
+
+# ----------------------------------------------------- series cardinality
+
+
+class TestSeriesCardinality:
+    def test_series_totals_gauge_counts_every_family(self):
+        obsreg.counter("kftpu_t_total", "t",
+                       labels=("a",)).labels(a="1").inc()
+        obsreg.gauge("kftpu_t_g", "t").set(1)
+        counts = obsreg.export_series_totals()
+        assert counts["kftpu_t_total"] == 1
+        assert counts["kftpu_t_g"] == 1
+        # the self-series: one row per family, itself included
+        assert counts[obsreg.OBS_SERIES_FAMILY] == len(counts)
+        fam = obsreg.default_registry().family(obsreg.OBS_SERIES_FAMILY)
+        assert len(fam.children()) == len(counts)
+
+    def test_series_totals_drops_stale_family_rows(self):
+        g = obsreg.gauge("kftpu_t_g", "t", labels=("x",))
+        g.labels(x="1").set(1)
+        obsreg.export_series_totals()
+        g.remove(x="1")
+        counts = obsreg.export_series_totals()
+        assert counts["kftpu_t_g"] == 0
+        # twice more: the export is idempotent, not self-growing
+        first = dict(obsreg.export_series_totals())
+        assert obsreg.export_series_totals() == first
+
+    def test_200_job_churn_does_not_leak_series(self):
+        """The leak regression the ISSUE pins: 200 jobs through the
+        REAL create → bind → run → succeed → delete path must leave the
+        per-job series families (kftpu_job_phase, the goodput ledgers)
+        empty, and the overall cardinality flat between churn halves."""
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        mgr = Manager(cluster)
+        controllers = [mgr.add(SliceScheduler()),
+                       mgr.add(TrainingJobReconciler("TPUJob"))]
+
+        def churn(start, n, batch=10):
+            for base in range(start, start + n, batch):
+                names = [f"j{i}" for i in range(base, base + batch)]
+                for name in names:
+                    cluster.create(tpujob(name))
+                for _ in range(4):
+                    mgr.run_pending()
+                    cluster.tick()
+                for name in names:
+                    for pod in cluster.list("v1", "Pod", "kubeflow"):
+                        if pod["metadata"]["name"].startswith(
+                                name + "-worker"):
+                            cluster.set_pod_phase(
+                                "kubeflow", pod["metadata"]["name"],
+                                "Succeeded")
+                mgr.run_pending()
+                for name in names:
+                    cluster.delete(TPU_AV, "TPUJob", "kubeflow", name)
+                mgr.run_pending()
+
+        try:
+            churn(0, 100)
+            mid = obsreg.export_series_totals()
+            churn(100, 100)
+            end = obsreg.export_series_totals()
+            # per-job families fully pruned
+            assert end.get("kftpu_job_phase", 0) == 0
+            assert end.get("kftpu_job_goodput_ratio", 0) == 0
+            assert end.get("kftpu_job_badput_seconds_total", 0) == 0
+            # cardinality FLAT between halves: same families, same
+            # counts — 100 more jobs bought zero new series
+            assert end == mid
+            assert not cluster.list(TPU_AV, "TPUJob", "kubeflow")
+        finally:
+            for c in controllers:
+                c.stop()
+
+    def test_replica_registry_prune_drops_series(self):
+        from kubeflow_tpu.serving.replica_state import ReplicaState
+        reg = obsreg.default_registry()
+        rr = ReplicaState(reg)
+        for i in range(20):
+            rr.observe_request(f"m{i}", 0.01)
+        before = reg.series_counts()["kftpu_serving_requests_total"]
+        assert before >= 20
+        rr.prune(["m0"])
+        counts = obsreg.export_series_totals()
+        assert counts["kftpu_serving_requests_total"] < before
+        # everything gone → per-model latency series all pruned
+        rr.prune([])
+        assert reg.series_counts()["kftpu_serving_request_seconds"] == 0
+
+    def test_scale_gauges_exported_by_scheduler_pass(self):
+        cluster = FakeCluster()
+        cluster.add_tpu_slice_nodes("v5e-8")
+        mgr = Manager(cluster)
+        controllers = [mgr.add(SliceScheduler())]
+        cluster.create(tpujob("train"))
+        try:
+            mgr.run_pending()
+            reg = obsreg.default_registry()
+            jobs_g = reg.family("kftpu_sched_pass_jobs_scanned")
+            nodes_g = reg.family("kftpu_sched_pass_nodes_scanned")
+            assert jobs_g.children()[()].value == 1
+            assert nodes_g.children()[()].value == 2
+        finally:
+            for c in controllers:
+                c.stop()

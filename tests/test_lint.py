@@ -1348,3 +1348,68 @@ class TestChecker:
             __all__ = ["path"]
         """)
         assert fs == []
+
+
+def test_ctrl_telemetry_vocabulary_defined_once_and_shared():
+    """The control-plane telemetry vocabulary (ISSUE 20) — verbs,
+    reconcile-pass phases, relist reasons, the component header, the
+    ctrl-pass trace prefix — must have ONE definition
+    (obs/controlplane.py) consumed by the scheduler, both apiserver
+    layers, the controller runtime, and the bench. The acceptance gate
+    is EXACT client/server reconciliation: a verb or phase re-spelled
+    in any consumer would silently fork the ledgers."""
+    import subprocess
+
+    from kubeflow_tpu.obs import controlplane as ctrlobs
+
+    assert ctrlobs.VERBS == (
+        "create", "get", "list", "update", "update_status", "patch",
+        "delete", "watch")
+    assert ctrlobs.MUTATING_VERBS == frozenset((
+        "create", "update", "update_status", "patch", "delete"))
+    assert ctrlobs.PHASES == (
+        "snapshot", "health-pass", "plan", "writes", "warm-pass")
+    assert ctrlobs.RELIST_REASONS == ("initial", "resync",
+                                      "leader-gain")
+    assert ctrlobs.COMPONENT_HEADER == "X-Kftpu-Component"
+
+    # single definition: the distinctive literals appear as quoted
+    # strings in exactly one source file — every other layer imports
+    # the names (common words like "snapshot"/"plan"/"get" would
+    # false-positive a grep, so the check pins the unambiguous ones:
+    # the hyphenated phases, the leader-gain relist reason, the trace
+    # prefix, and the attribution header)
+    pkg = os.path.join(REPO_ROOT, "kubeflow_tpu")
+    for literal in ("health-pass", "warm-pass", "leader-gain",
+                    "ctrlpass-", "X-Kftpu-Component"):
+        hits = subprocess.run(
+            ["grep", "-rl", f'"{literal}"', pkg],
+            capture_output=True, text=True).stdout.split()
+        assert [os.path.relpath(h, pkg) for h in hits] == \
+            [os.path.join("obs", "controlplane.py")], \
+            f"{literal!r} defined outside obs/controlplane.py: {hits}"
+
+    def src(*rel):
+        with open(os.path.join(REPO_ROOT, *rel)) as f:
+            return f.read()
+
+    # the consumers go through the shared module, not re-spelled names
+    sched_src = src("kubeflow_tpu", "scheduler", "core.py")
+    for use in ("ctrlobs.PHASE_SNAPSHOT", "ctrlobs.PHASE_PLAN",
+                "ctrlobs.PHASE_WRITES"):
+        assert use in sched_src, f"scheduler/core.py must consume {use}"
+    fake_src = src("kubeflow_tpu", "cluster", "fake.py")
+    assert "ctrlobs.VERB_" in fake_src
+    api_src = src("kubeflow_tpu", "cluster", "apiserver.py")
+    for use in ("ctrlobs.COMPONENT_HEADER", "ctrlobs.VERB_",
+                "ctrlobs.payload_bytes"):
+        assert use in api_src, f"cluster/apiserver.py must consume {use}"
+    rt_src = src("kubeflow_tpu", "controllers", "runtime.py")
+    for use in ("ctrlobs.RELIST_INITIAL", "ctrlobs.RELIST_RESYNC",
+                "ctrlobs.RELIST_LEADER_GAIN", "ctrl_pass"):
+        assert use in rt_src, \
+            f"controllers/runtime.py must consume {use}"
+    bench_src = src("bench.py")
+    for use in ("ctrlobs.CTRL_PASS_SPAN", "ctrlobs.audit_mismatches",
+                "ctrlobs.pass_stats"):
+        assert use in bench_src, f"bench.py must consume {use}"
